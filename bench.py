@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Headline benchmark: same-host echo RPC throughput, large payloads.
+
+Mirrors the reference's headline number (docs/cn/benchmark.md:104 — up to
+2.3 GB/s same-host multi-connection echo on 2×E5-2620).  Runs the native
+echo benchmark (client+server in one process over loopback) and prints ONE
+JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+BUILD = os.path.join(ROOT, "cpp", "build")
+BASELINE_GBPS = 2.3  # reference same-host multi-connection echo throughput
+
+
+def ensure_built() -> str:
+    bench = os.path.join(BUILD, "echo_bench")
+    if os.path.exists(bench):
+        return bench
+    os.makedirs(BUILD, exist_ok=True)
+    subprocess.run(
+        ["cmake", "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release", ".."],
+        cwd=BUILD, check=True, capture_output=True,
+    )
+    subprocess.run(["ninja", "echo_bench"], cwd=BUILD, check=True,
+                   capture_output=True)
+    return bench
+
+
+def main() -> int:
+    try:
+        bench = ensure_built()
+        out = subprocess.run(
+            [bench, "--payload", str(64 * 1024), "--connections", "8",
+             "--seconds", "5"],
+            check=True, capture_output=True, text=True, timeout=300,
+        ).stdout
+        # echo_bench prints a JSON line {"gbps": X, "qps": Y, "p50_us": Z}
+        stats = json.loads(out.strip().splitlines()[-1])
+        gbps = stats["gbps"]
+        print(json.dumps({
+            "metric": "same_host_echo_throughput",
+            "value": round(gbps, 3),
+            "unit": "GB/s",
+            "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+        }))
+        return 0
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({
+            "metric": "same_host_echo_throughput",
+            "value": 0.0,
+            "unit": "GB/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:200],
+        }))
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

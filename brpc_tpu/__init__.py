@@ -1,0 +1,25 @@
+"""brpc_tpu — a TPU-native RPC and parameter-server fabric.
+
+A from-scratch rebuild of the capabilities of Apache bRPC (reference:
+/root/reference, see SURVEY.md) designed TPU-first:
+
+- ``cpp/``            native C++ core: IOBuf, M:N fiber scheduler, wait-free
+                      socket transport, RPC runtime (Server/Channel/Controller),
+                      cluster layer (naming services, load balancers, circuit
+                      breaker), bvar-style metrics.  Mirrors bRPC's
+                      butil/bthread/bvar/brpc layering (SURVEY.md §1).
+- ``brpc_tpu.rpc``    ctypes bindings over the native core's C ABI.
+- ``brpc_tpu.parallel`` the combo-channel contract (ParallelChannel /
+                      SelectiveChannel / PartitionChannel, reference
+                      src/brpc/parallel_channel.h:185) mapped onto XLA
+                      collectives over a jax.sharding.Mesh: CollectiveChannel
+                      (AllReduce/AllGather/ReduceScatter on ICI), ring
+                      attention for sequence parallelism, pipeline stages as
+                      the streaming-RPC analog.
+- ``brpc_tpu.models`` flagship models for the parameter-server workloads
+                      (Llama-family embedding shards + transformer).
+- ``brpc_tpu.ops``    TPU kernels (pallas) and numerics helpers.
+- ``brpc_tpu.obs``    observability: metrics registry, rpcz-style tracing.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+from brpc_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    param_specs,
+    batch_specs,
+)

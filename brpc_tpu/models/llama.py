@@ -1,0 +1,218 @@
+"""Llama-family transformer, TPU-first functional JAX.
+
+This is the flagship model for the parameter-server workloads (the reference's
+north-star config: Llama-3-8B embedding-shard serving + gradient allreduce,
+BASELINE.json).  Design choices are TPU-idiomatic rather than a torch port:
+
+- params are a plain pytree; per-layer weights are *stacked* on a leading
+  ``n_layers`` axis and the decoder runs under ``lax.scan`` — one compiled
+  layer body regardless of depth (fast XLA compiles, MXU-friendly).
+- compute dtype is bfloat16 by default, accumulation in float32 where it
+  matters (RMSNorm reductions, attention softmax, final logits).
+- sharding is declared, not hand-scheduled: ``param_specs`` / ``batch_specs``
+  give PartitionSpecs over a mesh with axes ``('dp', 'tp')`` (+ optional
+  ``'sp'`` sequence axis used by ring attention); XLA inserts the ICI
+  collectives.
+- GQA attention with RoPE; SwiGLU MLP; RMSNorm; untied LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    intermediate: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+        """A toy config for tests / dry runs (shapes stay MXU-tileable)."""
+        return LlamaConfig(
+            vocab_size=vocab_size,
+            hidden=128,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=2,
+            head_dim=32,
+            intermediate=256,
+        )
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()  # defaults are Llama-3-8B
+
+
+def _dense_init(key, shape, dtype, fan_in):
+    scale = fan_in ** -0.5
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Initialise a parameter pytree. Per-layer tensors are stacked on axis 0."""
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+    h, L = cfg.hidden, cfg.n_layers
+    q_out = cfg.n_heads * cfg.head_dim
+    kv_out = cfg.n_kv_heads * cfg.head_dim
+    ks = jax.random.split(k_layers, 7)
+
+    def stacked(key, shape, fan_in):
+        return _dense_init(key, (L,) + shape, cfg.dtype, fan_in)
+
+    layers = {
+        "wq": stacked(ks[0], (h, q_out), h),
+        "wk": stacked(ks[1], (h, kv_out), h),
+        "wv": stacked(ks[2], (h, kv_out), h),
+        "wo": stacked(ks[3], (q_out, h), q_out),
+        "w_gate": stacked(ks[4], (h, cfg.intermediate), h),
+        "w_up": stacked(ks[5], (h, cfg.intermediate), h),
+        "w_down": stacked(ks[6], (cfg.intermediate, h), cfg.intermediate),
+        "attn_norm": jnp.ones((L, h), cfg.dtype),
+        "mlp_norm": jnp.ones((L, h), cfg.dtype),
+    }
+    return {
+        "embed": _dense_init(k_emb, (cfg.vocab_size, h), cfg.dtype, 1.0),
+        "layers": layers,
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "lm_head": _dense_init(k_out, (h, cfg.vocab_size), cfg.dtype, h),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpecs for each param over mesh axes ('dp','tp').
+
+    Megatron-style tensor parallelism: attention/MLP first matmuls are
+    column-sharded, second matmuls row-sharded, embeddings vocab-sharded.
+    XLA inserts the psum on the row-sharded outputs.
+    """
+    layers = {
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "w_gate": P(None, None, "tp"),
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+    }
+    return {
+        "embed": P("tp", None),
+        "layers": layers,
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def batch_specs() -> P:
+    """Token batches are sharded over data-parallel axis."""
+    return P("dp", None)
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings. x: [B, T, H, D], positions: [B, T]."""
+    d_half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,Dh]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def attention(q, k, v, *, causal: bool = True):
+    """Grouped-query attention. q: [B,T,Hq,D], k/v: [B,T,Hkv,D]."""
+    b, t, hq, d = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    q = q.reshape(b, t, hkv, group, d)
+    scores = jnp.einsum("bthgd,bshd->bhgts", q, k).astype(jnp.float32)
+    scores = scores * (d ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgts,bshd->bthgd", probs, v)
+    return out.reshape(b, t, hq * d)
+
+
+def _layer(cfg: LlamaConfig, x: jax.Array, lp: Params, positions: jax.Array) -> jax.Array:
+    b, t, h = x.shape
+    # attention block
+    y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (y @ lp["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = (y @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    v = (y @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    x = x + attention(q, k, v) @ lp["wo"]
+    # mlp block
+    y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    x = x + (jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])) @ lp["w_down"]
+    return x
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """tokens: [B, T] int32 -> logits [B, T, vocab] float32."""
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+
+    def body(x, lp):
+        return _layer(cfg, x, lp, positions), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Next-token cross-entropy (last position predicts nothing)."""
+    logits = forward(params, tokens, cfg)[:, :-1]
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def make_train_step(cfg: LlamaConfig, optimizer):
+    """Returns jittable (params, opt_state, tokens) -> (params, opt_state, loss).
+
+    Data-parallel gradient reduction is *not* hand-written: with params
+    replicated over 'dp' and batch sharded over 'dp', jit inserts the
+    allreduce (the ParallelChannel-fan-out analog, SURVEY.md §2.7).
+    """
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p + u.astype(p.dtype)), params, updates
+        )
+        return params, opt_state, loss
+
+    return step

@@ -1,0 +1,1 @@
+from brpc_tpu.parallel.mesh import make_mesh, shard_params, shard_batch  # noqa: F401

@@ -1,0 +1,72 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference scales fan-out through combo channels over sockets
+(src/brpc/parallel_channel.h:185, partition_channel.h:75); the TPU-native
+equivalent is a jax.sharding.Mesh whose axes name the parallelism dimensions:
+
+- dp: data parallel (ParallelChannel fan-out + merge == grad allreduce)
+- tp: tensor parallel (PartitionChannel's N/M sharding)
+- sp: sequence parallel (ring attention over ICI neighbours)
+- pp: pipeline parallel (streaming-RPC activation pipe)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axis_sizes: Dict[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh. Unspecified leading 'dp' absorbs leftover devices.
+
+    make_mesh({'tp': 4}) on 8 devices -> Mesh(dp=2, tp=4).
+    make_mesh() -> all devices on 'dp'.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axis_sizes = dict(axis_sizes or {})
+    n = len(devices)
+    named = int(np.prod(list(axis_sizes.values()))) if axis_sizes else 1
+    if n % named != 0:
+        raise ValueError(f"{n} devices not divisible by axes {axis_sizes}")
+    if "dp" not in axis_sizes:
+        axis_sizes = {"dp": n // named, **axis_sizes}
+    shape = tuple(axis_sizes.values())
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_sizes.keys()))
+
+
+def _norm_spec(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes the mesh doesn't have (lets one spec table serve
+    dp-only and dp+tp meshes)."""
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(e for e in entry if e in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def shard_params(params, specs, mesh: Mesh):
+    """Device-put a param pytree with per-leaf PartitionSpecs."""
+    return jax.tree_util.tree_map(
+        lambda s, p: jax.device_put(p, NamedSharding(mesh, _norm_spec(s, mesh))),
+        specs,
+        params,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_batch(batch, spec: P, mesh: Mesh):
+    return jax.device_put(batch, NamedSharding(mesh, _norm_spec(spec, mesh)))

@@ -1,0 +1,15 @@
+import os
+
+# 8 virtual CPU devices for multi-chip sharding tests (the driver dry-runs the
+# real multi-chip path separately via __graft_entry__.dryrun_multichip).
+# XLA_FLAGS must be set before the CPU backend initialises; the axon
+# sitecustomize forces jax_platforms="axon,cpu", so override it post-import.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
