@@ -50,12 +50,18 @@ def main() -> int:
         }))
         return 0
     except Exception as e:  # noqa: BLE001
+        detail = f"{type(e).__name__}: {e}"
+        stderr = getattr(e, "stderr", None)
+        if stderr:
+            if isinstance(stderr, bytes):
+                stderr = stderr.decode(errors="replace")
+            detail += " | stderr: " + stderr.strip()[-300:]
         print(json.dumps({
             "metric": "same_host_echo_throughput",
             "value": 0.0,
             "unit": "GB/s",
             "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}"[:200],
+            "error": detail[:400],
         }))
         return 0
 

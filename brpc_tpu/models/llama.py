@@ -41,7 +41,8 @@ class LlamaConfig:
     intermediate: int = 14336
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
-    dtype: Any = jnp.bfloat16
+    dtype: Any = jnp.bfloat16        # compute dtype (MXU-friendly)
+    param_dtype: Any = jnp.float32   # master weights / optimizer state
 
     @staticmethod
     def tiny(vocab_size: int = 512) -> "LlamaConfig":
@@ -73,9 +74,10 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     q_out = cfg.n_heads * cfg.head_dim
     kv_out = cfg.n_kv_heads * cfg.head_dim
     ks = jax.random.split(k_layers, 7)
+    pd = cfg.param_dtype
 
     def stacked(key, shape, fan_in):
-        return _dense_init(key, (L,) + shape, cfg.dtype, fan_in)
+        return _dense_init(key, (L,) + shape, pd, fan_in)
 
     layers = {
         "wq": stacked(ks[0], (h, q_out), h),
@@ -85,14 +87,14 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
         "w_gate": stacked(ks[4], (h, cfg.intermediate), h),
         "w_up": stacked(ks[5], (h, cfg.intermediate), h),
         "w_down": stacked(ks[6], (cfg.intermediate, h), cfg.intermediate),
-        "attn_norm": jnp.ones((L, h), cfg.dtype),
-        "mlp_norm": jnp.ones((L, h), cfg.dtype),
+        "attn_norm": jnp.ones((L, h), pd),
+        "mlp_norm": jnp.ones((L, h), pd),
     }
     return {
-        "embed": _dense_init(k_emb, (cfg.vocab_size, h), cfg.dtype, 1.0),
+        "embed": _dense_init(k_emb, (cfg.vocab_size, h), pd, 1.0),
         "layers": layers,
-        "final_norm": jnp.ones((h,), cfg.dtype),
-        "lm_head": _dense_init(k_out, (h, cfg.vocab_size), cfg.dtype, h),
+        "final_norm": jnp.ones((h,), pd),
+        "lm_head": _dense_init(k_out, (h, cfg.vocab_size), pd, h),
     }
 
 
@@ -178,16 +180,24 @@ def _layer(cfg: LlamaConfig, x: jax.Array, lp: Params, positions: jax.Array) -> 
 
 
 def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    """tokens: [B, T] int32 -> logits [B, T, vocab] float32."""
-    x = params["embed"][tokens]
+    """tokens: [B, T] int32 -> logits [B, T, vocab] float32.
+
+    Master weights stay in cfg.param_dtype (fp32); compute runs in cfg.dtype
+    (bf16) — the cast happens per-layer inside the scan so only one layer's
+    bf16 copy is live at a time.
+    """
+    cast = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda a: a.astype(cfg.dtype), t
+    )
+    x = cast(params["embed"])[tokens]
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
 
     def body(x, lp):
-        return _layer(cfg, x, lp, positions), None
+        return _layer(cfg, x, cast(lp), positions), None
 
     x, _ = lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    x = rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
+    return (x @ cast(params["lm_head"])).astype(jnp.float32)
 
 
 def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
@@ -210,9 +220,8 @@ def make_train_step(cfg: LlamaConfig, optimizer):
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(
-            lambda p, u: (p + u.astype(p.dtype)), params, updates
-        )
+        # params/updates are fp32 master copies; no precision-losing casts.
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
     return step

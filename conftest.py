@@ -1,0 +1,6 @@
+import os
+import sys
+
+# Make `brpc_tpu` and `__graft_entry__` importable under a bare `pytest`
+# invocation (no packaging yet).
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,114 @@
+// Read-mostly RCU-ish double buffer: readers are wait-free on their own
+// thread-local mutex (uncontended fast path), writers modify the background
+// copy, flip the index, then serialize on every reader mutex to prove no
+// reader still sees the old copy.  Parity target: reference
+// src/butil/containers/doubly_buffered_data.h:86 (used by load balancers and
+// SocketMap for server lists).
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+namespace brt {
+
+template <typename T>
+class DoublyBufferedData {
+ public:
+  class ScopedPtr {
+   public:
+    ScopedPtr() = default;
+    ~ScopedPtr() {
+      if (mu_) mu_->unlock();
+    }
+    ScopedPtr(const ScopedPtr&) = delete;
+    ScopedPtr& operator=(const ScopedPtr&) = delete;
+    const T* get() const { return data_; }
+    const T& operator*() const { return *data_; }
+    const T* operator->() const { return data_; }
+
+   private:
+    friend class DoublyBufferedData;
+    const T* data_ = nullptr;
+    std::mutex* mu_ = nullptr;
+  };
+
+  DoublyBufferedData() = default;
+
+  // Wait-free for readers (own TLS mutex, uncontended unless a writer is
+  // mid-flip).
+  int Read(ScopedPtr* ptr) {
+    Wrapper* w = tls_wrapper();
+    w->mu.lock();
+    ptr->data_ = &data_[index_.load(std::memory_order_acquire)];
+    ptr->mu_ = &w->mu;
+    return 0;
+  }
+
+  // fn(background_copy) -> true if modified. Called twice (once per copy).
+  template <typename Fn>
+  size_t Modify(Fn&& fn) {
+    std::lock_guard<std::mutex> g(modify_mu_);
+    int bg = 1 - index_.load(std::memory_order_relaxed);
+    if (!fn(data_[bg])) return 0;
+    index_.store(bg, std::memory_order_release);
+    // Wait for readers on the old copy: grab every wrapper mutex once.
+    {
+      std::lock_guard<std::mutex> lg(wrappers_mu_);
+      for (Wrapper* w : wrappers_) {
+        w->mu.lock();
+        w->mu.unlock();
+      }
+    }
+    fn(data_[1 - bg]);  // apply to the (now) background copy too
+    return 1;
+  }
+
+ private:
+  struct Wrapper {
+    std::mutex mu;
+    DoublyBufferedData* owner = nullptr;
+    ~Wrapper() {
+      if (owner) owner->remove_wrapper(this);
+    }
+  };
+
+  // NOTE: a DoublyBufferedData instance must outlive any thread that Read()
+  // it (true for its users here: LB/SocketMap tables live for the process).
+  Wrapper* tls_wrapper() {
+    thread_local std::vector<
+        std::pair<DoublyBufferedData*, std::unique_ptr<Wrapper>>>
+        cache;
+    for (auto& [o, w] : cache)
+      if (o == this) return w.get();
+    auto w = std::make_unique<Wrapper>();
+    w->owner = this;
+    {
+      std::lock_guard<std::mutex> g(wrappers_mu_);
+      wrappers_.push_back(w.get());
+    }
+    cache.emplace_back(this, std::move(w));
+    return cache.back().second.get();
+  }
+
+  void remove_wrapper(Wrapper* w) {
+    std::lock_guard<std::mutex> g(wrappers_mu_);
+    for (size_t i = 0; i < wrappers_.size(); ++i) {
+      if (wrappers_[i] == w) {
+        wrappers_[i] = wrappers_.back();
+        wrappers_.pop_back();
+        break;
+      }
+    }
+  }
+
+  T data_[2];
+  std::atomic<int> index_{0};
+  std::mutex modify_mu_;
+  std::mutex wrappers_mu_;
+  std::vector<Wrapper*> wrappers_;
+};
+
+}  // namespace brt

@@ -1,0 +1,65 @@
+// ip:port value type (reference: src/butil/endpoint.h).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace brt {
+
+struct EndPoint {
+  uint32_t ip = 0;  // host byte order
+  uint16_t port = 0;
+
+  EndPoint() = default;
+  EndPoint(uint32_t ip_, uint16_t port_) : ip(ip_), port(port_) {}
+
+  bool operator==(const EndPoint& o) const = default;
+
+  std::string to_string() const {
+    char buf[32];
+    uint32_t n = htonl(ip);
+    char ipbuf[INET_ADDRSTRLEN];
+    inet_ntop(AF_INET, &n, ipbuf, sizeof(ipbuf));
+    snprintf(buf, sizeof(buf), "%s:%u", ipbuf, port);
+    return buf;
+  }
+
+  sockaddr_in to_sockaddr() const {
+    sockaddr_in sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(ip);
+    sa.sin_port = htons(port);
+    return sa;
+  }
+
+  static bool parse(const std::string& s, EndPoint* out) {
+    auto pos = s.rfind(':');
+    if (pos == std::string::npos) return false;
+    std::string host = s.substr(0, pos);
+    int port = atoi(s.c_str() + pos + 1);
+    if (port < 0 || port > 65535) return false;
+    in_addr addr;
+    if (host.empty() || host == "*" || host == "0.0.0.0") {
+      addr.s_addr = INADDR_ANY;
+    } else if (inet_pton(AF_INET, host.c_str(), &addr) != 1) {
+      return false;
+    }
+    out->ip = ntohl(addr.s_addr);
+    out->port = uint16_t(port);
+    return true;
+  }
+};
+
+struct EndPointHash {
+  size_t operator()(const EndPoint& e) const {
+    return (size_t(e.ip) << 16) ^ e.port;
+  }
+};
+
+}  // namespace brt

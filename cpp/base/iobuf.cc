@@ -1,0 +1,377 @@
+#include "base/iobuf.h"
+
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include "base/logging.h"
+
+namespace brt {
+
+struct IOBuf::Block {
+  std::atomic<int> ref{1};
+  uint32_t cap = 0;
+  uint32_t size = 0;       // write cursor (filled bytes)
+  char* data = nullptr;
+  UserDeleter deleter = nullptr;  // null => pooled block, data is inline
+  void* deleter_arg = nullptr;
+  uint64_t user_meta = 0;
+  Block* pool_next = nullptr;
+
+  bool pooled() const { return deleter == nullptr; }
+};
+
+namespace {
+
+// Thread-local freelist of pooled 8KB blocks.
+struct BlockCache {
+  IOBuf::Block* head = nullptr;
+  int count = 0;
+  ~BlockCache() {
+    while (head) {
+      IOBuf::Block* b = head;
+      head = b->pool_next;
+      ::free(b);
+    }
+  }
+};
+thread_local BlockCache tls_block_cache;
+
+IOBuf::Block* new_block() {
+  BlockCache& c = tls_block_cache;
+  if (c.head) {
+    IOBuf::Block* b = c.head;
+    c.head = b->pool_next;
+    --c.count;
+    b->ref.store(1, std::memory_order_relaxed);
+    b->size = 0;
+    return b;
+  }
+  char* mem = (char*)::malloc(sizeof(IOBuf::Block) + IOBuf::kBlockSize);
+  auto* b = new (mem) IOBuf::Block();
+  b->cap = IOBuf::kBlockSize;
+  b->data = mem + sizeof(IOBuf::Block);
+  return b;
+}
+
+void free_block(IOBuf::Block* b) {
+  if (b->pooled()) {
+    BlockCache& c = tls_block_cache;
+    if (c.count < 64) {
+      b->pool_next = c.head;
+      c.head = b;
+      ++c.count;
+      return;
+    }
+    ::free(b);
+  } else {
+    b->deleter(b->data, b->deleter_arg);
+    ::free(b);
+  }
+}
+
+inline void block_ref(IOBuf::Block* b) {
+  b->ref.fetch_add(1, std::memory_order_relaxed);
+}
+
+inline void block_unref(IOBuf::Block* b) {
+  if (b->ref.fetch_sub(1, std::memory_order_acq_rel) == 1) free_block(b);
+}
+
+}  // namespace
+
+void IOBuf::clear() {
+  for (auto& r : refs_) block_unref(r.block);
+  refs_.clear();
+  size_ = 0;
+}
+
+void IOBuf::push_ref(const BlockRef& r) {
+  if (r.length == 0) return;
+  if (!refs_.empty()) {
+    BlockRef& last = refs_.back();
+    if (last.block == r.block && last.offset + last.length == r.offset) {
+      last.length += r.length;  // merge adjacent view of same block
+      size_ += r.length;
+      block_unref(r.block);  // merged: drop the extra ref the caller took
+      return;
+    }
+  }
+  refs_.push_back(r);
+  size_ += r.length;
+}
+
+void IOBuf::append(const void* data, size_t n) {
+  const char* p = (const char*)data;
+  while (n > 0) {
+    Block* b = nullptr;
+    if (!refs_.empty()) {
+      BlockRef& last = refs_.back();
+      Block* lb = last.block;
+      // Extend in place only if our ref ends exactly at the write cursor
+      // (no other IOBuf can be viewing the bytes we are about to write).
+      if (lb->pooled() && last.offset + last.length == lb->size &&
+          lb->size < lb->cap) {
+        b = lb;
+      }
+    }
+    if (b == nullptr) {
+      b = new_block();
+      refs_.push_back({b, b->size, 0});
+    }
+    uint32_t room = b->cap - b->size;
+    uint32_t take = uint32_t(n < room ? n : room);
+    memcpy(b->data + b->size, p, take);
+    b->size += take;
+    refs_.back().length += take;
+    size_ += take;
+    p += take;
+    n -= take;
+  }
+}
+
+void IOBuf::append(const IOBuf& other) {
+  refs_.reserve(refs_.size() + other.refs_.size());
+  for (const auto& r : other.refs_) {
+    block_ref(r.block);
+    push_ref(r);
+  }
+}
+
+void IOBuf::append(IOBuf&& other) {
+  if (refs_.empty()) {
+    swap(other);
+    return;
+  }
+  for (const auto& r : other.refs_) push_ref(r);  // transfer refs
+  size_t moved = other.size_;
+  (void)moved;
+  other.refs_.clear();
+  other.size_ = 0;
+}
+
+void IOBuf::append_user_data(void* data, size_t n, UserDeleter deleter,
+                             void* arg, uint64_t meta) {
+  BRT_CHECK(deleter != nullptr);
+  char* mem = (char*)::malloc(sizeof(Block));
+  auto* b = new (mem) Block();
+  b->cap = uint32_t(n);
+  b->size = uint32_t(n);
+  b->data = (char*)data;
+  b->deleter = deleter;
+  b->deleter_arg = arg;
+  b->user_meta = meta;
+  refs_.push_back({b, 0, uint32_t(n)});
+  size_ += n;
+}
+
+uint64_t IOBuf::user_meta_at(int i) const { return refs_[i].block->user_meta; }
+
+size_t IOBuf::cutn(IOBuf* out, size_t n) {
+  n = n < size_ ? n : size_;
+  size_t left = n;
+  size_t consumed_refs = 0;
+  for (auto& r : refs_) {
+    if (left == 0) break;
+    if (r.length <= left) {
+      out->push_ref(r);  // ref ownership moves
+      left -= r.length;
+      ++consumed_refs;
+    } else {
+      block_ref(r.block);
+      out->push_ref({r.block, r.offset, uint32_t(left)});
+      r.offset += uint32_t(left);
+      r.length -= uint32_t(left);
+      left = 0;
+    }
+  }
+  refs_.erase(refs_.begin(), refs_.begin() + consumed_refs);
+  size_ -= n;
+  return n;
+}
+
+size_t IOBuf::cutn(void* out, size_t n) {
+  n = copy_to(out, n);
+  pop_front(n);
+  return n;
+}
+
+size_t IOBuf::cutn(std::string* out, size_t n) {
+  n = n < size_ ? n : size_;
+  size_t old = out->size();
+  out->resize(old + n);
+  copy_to(&(*out)[old], n);
+  pop_front(n);
+  return n;
+}
+
+void IOBuf::pop_front(size_t n) {
+  n = n < size_ ? n : size_;
+  size_ -= n;
+  while (n > 0) {
+    BlockRef& r = refs_.front();
+    if (r.length <= n) {
+      n -= r.length;
+      block_unref(r.block);
+      refs_.erase(refs_.begin());
+    } else {
+      r.offset += uint32_t(n);
+      r.length -= uint32_t(n);
+      n = 0;
+    }
+  }
+}
+
+void IOBuf::pop_back(size_t n) {
+  n = n < size_ ? n : size_;
+  size_ -= n;
+  while (n > 0) {
+    BlockRef& r = refs_.back();
+    if (r.length <= n) {
+      n -= r.length;
+      block_unref(r.block);
+      refs_.pop_back();
+    } else {
+      r.length -= uint32_t(n);
+      n = 0;
+    }
+  }
+}
+
+size_t IOBuf::copy_to(void* out, size_t n, size_t from) const {
+  if (from >= size_) return 0;
+  n = std::min(n, size_ - from);
+  char* dst = (char*)out;
+  size_t copied = 0;
+  for (const auto& r : refs_) {
+    if (copied == n) break;
+    if (from >= r.length) {
+      from -= r.length;
+      continue;
+    }
+    size_t take = std::min<size_t>(r.length - from, n - copied);
+    memcpy(dst + copied, r.block->data + r.offset + from, take);
+    copied += take;
+    from = 0;
+  }
+  return copied;
+}
+
+size_t IOBuf::copy_to(std::string* out, size_t n, size_t from) const {
+  if (from >= size_) {
+    out->clear();
+    return 0;
+  }
+  n = std::min(n, size_ - from);
+  out->resize(n);
+  return copy_to(&(*out)[0], n, from);
+}
+
+const void* IOBuf::fetch(void* aux, size_t n) const {
+  if (size_ < n) return nullptr;
+  if (!refs_.empty() && refs_[0].length >= n)
+    return refs_[0].block->data + refs_[0].offset;
+  copy_to(aux, n);
+  return aux;
+}
+
+bool IOBuf::equals(const std::string& s) const {
+  if (s.size() != size_) return false;
+  size_t off = 0;
+  for (const auto& r : refs_) {
+    if (memcmp(r.block->data + r.offset, s.data() + off, r.length) != 0)
+      return false;
+    off += r.length;
+  }
+  return true;
+}
+
+ssize_t IOBuf::cut_into_writev(int fd) {
+  constexpr int kMaxIov = 64;
+  iovec iov[kMaxIov];
+  int cnt = 0;
+  for (const auto& r : refs_) {
+    if (cnt == kMaxIov) break;
+    iov[cnt].iov_base = r.block->data + r.offset;
+    iov[cnt].iov_len = r.length;
+    ++cnt;
+  }
+  if (cnt == 0) return 0;
+  ssize_t nw = ::writev(fd, iov, cnt);
+  if (nw > 0) pop_front(size_t(nw));
+  return nw;
+}
+
+ssize_t IOBuf::cut_into_fd(int fd, size_t max) {
+  size_t total = 0;
+  while (!empty() && total < max) {
+    ssize_t nw = cut_into_writev(fd);
+    if (nw < 0) return total > 0 ? ssize_t(total) : -1;
+    if (nw == 0) break;
+    total += size_t(nw);
+  }
+  return ssize_t(total);
+}
+
+IOPortal::~IOPortal() {
+  if (partial_) block_unref(partial_);
+}
+
+ssize_t IOPortal::append_from_fd(int fd, size_t max_read) {
+  constexpr int kMaxIov = 4;
+  iovec iov[kMaxIov];
+  Block* blocks[kMaxIov];
+  int cnt = 0;
+  size_t want = 0;
+  if (partial_ && partial_->size < partial_->cap) {
+    blocks[cnt] = partial_;
+    iov[cnt].iov_base = partial_->data + partial_->size;
+    iov[cnt].iov_len = partial_->cap - partial_->size;
+    want += iov[cnt].iov_len;
+    ++cnt;
+  }
+  while (cnt < kMaxIov && want < max_read) {
+    Block* b = new_block();
+    blocks[cnt] = b;
+    iov[cnt].iov_base = b->data;
+    iov[cnt].iov_len = b->cap;
+    want += b->cap;
+    ++cnt;
+  }
+  ssize_t nr = ::readv(fd, iov, cnt);
+  int start = (partial_ != nullptr) ? 1 : 0;
+  if (nr <= 0) {
+    // return fresh blocks to the pool; keep partial_
+    for (int i = start; i < cnt; ++i) block_unref(blocks[i]);
+    return nr;
+  }
+  // Fill blocks in readv order. For every block receiving bytes, the IOBuf
+  // takes its own ref (push_ref consumes exactly one); our ownership ref
+  // (construction ref for fresh blocks, partial_ ref for the old partial)
+  // is handled separately below.
+  size_t left = size_t(nr);
+  Block* new_partial = nullptr;
+  for (int i = 0; i < cnt; ++i) {
+    Block* b = blocks[i];
+    uint32_t off = b->size;
+    uint32_t room = uint32_t(iov[i].iov_len);
+    uint32_t got = uint32_t(std::min<size_t>(left, room));
+    if (got > 0) {
+      b->size += got;
+      block_ref(b);
+      push_ref({b, off, got});
+      left -= got;
+      if (b->size < b->cap) new_partial = b;  // only possible for last filled
+    }
+    if (i >= start && b != new_partial) {
+      block_unref(b);  // fresh block, full or untouched: drop our ref
+    }
+  }
+  if (partial_ != nullptr && partial_ != new_partial) {
+    block_unref(partial_);  // old partial filled up: release our ref
+    partial_ = nullptr;
+  }
+  partial_ = new_partial ? new_partial : partial_;
+  return nr;
+}
+
+}  // namespace brt

@@ -1,0 +1,70 @@
+// Minimal stream logging + CHECK macros.
+// Parity target: reference src/butil/logging.h (Chromium-style LOG streams);
+// redesigned as a ~100-line header for the TPU build.
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <atomic>
+
+namespace brt {
+
+enum LogLevel { LOG_TRACE = 0, LOG_INFO, LOG_WARNING, LOG_ERROR, LOG_FATAL };
+
+// Runtime-adjustable minimum level (the /flags analog for logging).
+inline std::atomic<int>& min_log_level() {
+  static std::atomic<int> lvl{LOG_INFO};
+  return lvl;
+}
+
+class LogMessage {
+ public:
+  LogMessage(const char* file, int line, int level) : level_(level) {
+    const char* base = strrchr(file, '/');
+    static const char kLevelChar[] = {'T', 'I', 'W', 'E', 'F'};
+    stream_ << kLevelChar[level] << ' ' << (base ? base + 1 : file) << ':'
+            << line << "] ";
+  }
+  ~LogMessage() {
+    stream_ << '\n';
+    fputs(stream_.str().c_str(), stderr);
+    if (level_ >= LOG_FATAL) abort();
+  }
+  std::ostringstream& stream() { return stream_; }
+
+ private:
+  std::ostringstream stream_;
+  int level_;
+};
+
+class VoidLog {
+ public:
+  void operator&(std::ostream&) {}
+};
+
+}  // namespace brt
+
+#define BRT_LOG_STREAM(level) \
+  ::brt::LogMessage(__FILE__, __LINE__, ::brt::level).stream()
+
+#define LOG_AT_LEVEL(level)                           \
+  ((::brt::level) < ::brt::min_log_level().load(std::memory_order_relaxed)) \
+      ? (void)0                                       \
+      : ::brt::VoidLog() & BRT_LOG_STREAM(level)
+
+#ifndef BRT_LOG
+#define BRT_LOG(severity) LOG_AT_LEVEL(LOG_##severity)
+#endif
+
+#define BRT_CHECK(cond)                                              \
+  (cond) ? (void)0                                                   \
+         : ::brt::VoidLog() & BRT_LOG_STREAM(LOG_FATAL)              \
+                                  << "Check failed: " #cond " "
+
+#define BRT_CHECK_EQ(a, b) BRT_CHECK((a) == (b))
+#define BRT_CHECK_NE(a, b) BRT_CHECK((a) != (b))
+#define BRT_CHECK_LE(a, b) BRT_CHECK((a) <= (b))
+#define BRT_CHECK_LT(a, b) BRT_CHECK((a) < (b))
+#define BRT_CHECK_GE(a, b) BRT_CHECK((a) >= (b))

@@ -1,0 +1,111 @@
+// Versioned-slot pool: the ABA-safe foundation for SocketId / fiber ids /
+// correlation ids.  Parity target: reference src/butil/resource_pool.h —
+// redesigned: ids are [version:32|index:32]; a slot's version is odd while
+// live, bumped on acquire and release, so a stale id can never address a
+// recycled object.  Slot memory is never returned to the OS (same contract as
+// the reference), so address() on a stale id is memory-safe and returns null.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <new>
+#include <vector>
+
+#include "base/logging.h"
+
+namespace brt {
+
+template <typename T>
+class ResourcePool {
+ public:
+  static constexpr uint32_t kBlockSlots = 256;
+  static constexpr uint32_t kMaxBlocks = 16384;  // 4M slots max
+
+  struct Slot {
+    std::atomic<uint32_t> version{0};  // odd = live
+    alignas(T) unsigned char storage[sizeof(T)];
+    T* obj() { return std::launder(reinterpret_cast<T*>(storage)); }
+  };
+
+  static ResourcePool& singleton() {
+    static ResourcePool pool;
+    return pool;
+  }
+
+  // Construct a T in a fresh slot; returns its versioned id.
+  template <typename... Args>
+  uint64_t acquire(T** out, Args&&... args) {
+    uint32_t index;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (!free_.empty()) {
+        index = free_.back();
+        free_.pop_back();
+      } else {
+        index = next_index_++;
+        uint32_t b = index / kBlockSlots;
+        BRT_CHECK_LT(b, kMaxBlocks) << "ResourcePool exhausted";
+        if (blocks_[b].load(std::memory_order_acquire) == nullptr) {
+          blocks_[b].store(new Slot[kBlockSlots], std::memory_order_release);
+        }
+      }
+    }
+    Slot* s = slot(index);
+    uint32_t v = s->version.load(std::memory_order_relaxed) + 1;
+    BRT_CHECK(v & 1);
+    new (s->storage) T(std::forward<Args>(args)...);
+    s->version.store(v, std::memory_order_release);
+    if (out) *out = s->obj();
+    return make_id(v, index);
+  }
+
+  // Live object for id, or null if the id is stale.
+  T* address(uint64_t id) {
+    uint32_t index = uint32_t(id);
+    if (index >= next_index_.load(std::memory_order_acquire)) return nullptr;
+    Slot* s = slot(index);
+    uint32_t v = uint32_t(id >> 32);
+    if (!(v & 1) || s->version.load(std::memory_order_acquire) != v)
+      return nullptr;
+    return s->obj();
+  }
+
+  // Destroys the object. Returns false if id was already stale.
+  bool release(uint64_t id) {
+    uint32_t index = uint32_t(id);
+    if (index >= next_index_.load(std::memory_order_acquire)) return false;
+    Slot* s = slot(index);
+    uint32_t v = uint32_t(id >> 32);
+    uint32_t cur = s->version.load(std::memory_order_relaxed);
+    if (cur != v ||
+        !s->version.compare_exchange_strong(cur, v + 1,
+                                            std::memory_order_acq_rel))
+      return false;
+    s->obj()->~T();
+    std::lock_guard<std::mutex> g(mu_);
+    free_.push_back(index);
+    return true;
+  }
+
+  static uint64_t make_id(uint32_t version, uint32_t index) {
+    return (uint64_t(version) << 32) | index;
+  }
+
+ private:
+  ResourcePool() : blocks_(new std::atomic<Slot*>[kMaxBlocks]) {
+    for (uint32_t i = 0; i < kMaxBlocks; ++i) blocks_[i].store(nullptr);
+  }
+
+  Slot* slot(uint32_t index) {
+    Slot* b = blocks_[index / kBlockSlots].load(std::memory_order_acquire);
+    return &b[index % kBlockSlots];
+  }
+
+  std::mutex mu_;
+  std::vector<uint32_t> free_;
+  std::atomic<uint32_t> next_index_{0};
+  std::atomic<Slot*>* blocks_;
+};
+
+}  // namespace brt

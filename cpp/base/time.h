@@ -1,0 +1,35 @@
+// Monotonic/realtime clock helpers (reference: src/butil/time.h).
+#pragma once
+
+#include <cstdint>
+#include <ctime>
+
+namespace brt {
+
+inline int64_t monotonic_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000000000 + ts.tv_nsec;
+}
+
+inline int64_t monotonic_us() { return monotonic_ns() / 1000; }
+
+inline int64_t realtime_us() {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return int64_t(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+inline timespec us_to_abstime_monotonic(int64_t us_from_now) {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  ts.tv_sec += us_from_now / 1000000;
+  ts.tv_nsec += (us_from_now % 1000000) * 1000;
+  if (ts.tv_nsec >= 1000000000) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000;
+  }
+  return ts;
+}
+
+}  // namespace brt

@@ -1,0 +1,235 @@
+#include "fiber/butex.h"
+
+#include <errno.h>
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <mutex>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber_internal.h"
+#include "fiber/timer.h"
+
+namespace brt {
+
+namespace {
+
+enum WaiterState : int { WS_CREATED = 0, WS_PARKED = 1, WS_WAKING = 2 };
+
+struct ButexWaiter {
+  ButexWaiter* prev = nullptr;
+  ButexWaiter* next = nullptr;
+  bool in_list = false;
+  std::atomic<int> state{WS_CREATED};
+  int result = 0;             // 0 woken, ETIMEDOUT
+  fiber_t tid = INVALID_FIBER;  // set → fiber waiter, else pthread waiter
+  std::atomic<int> futex_word{0};  // pthread waiters block here
+};
+
+long waiter_futex(std::atomic<int>* addr, int op, int val,
+                  const timespec* ts = nullptr) {
+  return syscall(SYS_futex, reinterpret_cast<int*>(addr), op, val, ts, nullptr,
+                 0);
+}
+
+}  // namespace
+
+struct Butex {
+  std::atomic<int> value{0};
+  std::mutex mu;
+  ButexWaiter head;  // sentinel of doubly-linked ring
+
+  Butex() {
+    head.prev = &head;
+    head.next = &head;
+  }
+
+  void push_back(ButexWaiter* w) {
+    w->prev = head.prev;
+    w->next = &head;
+    head.prev->next = w;
+    head.prev = w;
+    w->in_list = true;
+  }
+
+  static void erase(ButexWaiter* w) {
+    w->prev->next = w->next;
+    w->next->prev = w->prev;
+    w->in_list = false;
+  }
+
+  ButexWaiter* pop_front() {
+    if (head.next == &head) return nullptr;
+    ButexWaiter* w = head.next;
+    erase(w);
+    return w;
+  }
+};
+
+Butex* butex_create() { return new Butex(); }
+
+void butex_destroy(Butex* b) { delete b; }
+
+std::atomic<int>& butex_value(Butex* b) { return b->value; }
+
+// Final leg of waking a fiber waiter: requeue once it has fully parked.
+static void wake_fiber_waiter(ButexWaiter* w) {
+  int old = w->state.exchange(WS_WAKING, std::memory_order_acq_rel);
+  if (old == WS_PARKED) {
+    // Fiber completed its context switch: safe to requeue.
+    requeue_fiber(w->tid);
+  }
+  // old == WS_CREATED: the fiber is mid-switch; its commit callback will see
+  // WS_WAKING and requeue itself.
+}
+
+static void wake_pthread_waiter(ButexWaiter* w) {
+  w->futex_word.store(1, std::memory_order_release);
+  waiter_futex(&w->futex_word, FUTEX_WAKE_PRIVATE, 1);
+}
+
+static void wake_one(ButexWaiter* w) {
+  if (w->tid != INVALID_FIBER) {
+    wake_fiber_waiter(w);
+  } else {
+    wake_pthread_waiter(w);
+  }
+}
+
+int butex_wake(Butex* b) {
+  ButexWaiter* w;
+  {
+    std::lock_guard<std::mutex> g(b->mu);
+    w = b->pop_front();
+  }
+  if (w == nullptr) return 0;
+  wake_one(w);
+  return 1;
+}
+
+int butex_wake_all(Butex* b) {
+  // Detach the whole list under the lock, wake outside it.
+  ButexWaiter* first = nullptr;
+  {
+    std::lock_guard<std::mutex> g(b->mu);
+    ButexWaiter* w;
+    ButexWaiter** tail = &first;
+    while ((w = b->pop_front()) != nullptr) {
+      w->next = nullptr;
+      *tail = w;
+      tail = &w->next;
+    }
+  }
+  int n = 0;
+  while (first != nullptr) {
+    ButexWaiter* nx = first->next;  // read before wake: wake frees the frame
+    wake_one(first);
+    first = nx;
+    ++n;
+  }
+  return n;
+}
+
+namespace {
+
+struct TimeoutCtx {
+  Butex* butex;
+  ButexWaiter* waiter;
+};
+
+// Runs on the timer pthread. butex_wait blocks on timer_cancel before its
+// stack frame (holding the waiter) dies, so the deref here is safe.
+void butex_timeout_cb(void* arg) {
+  auto* ctx = static_cast<TimeoutCtx*>(arg);
+  ButexWaiter* w;
+  {
+    std::lock_guard<std::mutex> g(ctx->butex->mu);
+    w = ctx->waiter;
+    if (!w->in_list) return;  // already woken
+    Butex::erase(w);
+    w->result = ETIMEDOUT;
+  }
+  wake_one(w);
+}
+
+// Remained callback: runs on the next context right after the parking fiber
+// has left its stack.
+void commit_parked(void* arg) {
+  auto* w = static_cast<ButexWaiter*>(arg);
+  int old = w->state.exchange(WS_PARKED, std::memory_order_acq_rel);
+  if (old == WS_WAKING) {
+    // A waker beat us between list-insert and switch: run it now.
+    requeue_fiber(w->tid);
+  }
+}
+
+int butex_wait_pthread(Butex* b, int expected, int64_t timeout_us) {
+  ButexWaiter w;
+  {
+    std::lock_guard<std::mutex> g(b->mu);
+    if (b->value.load(std::memory_order_acquire) != expected)
+      return EWOULDBLOCK;
+    b->push_back(&w);
+  }
+  timespec ts;
+  timespec* tsp = nullptr;
+  if (timeout_us >= 0) {
+    ts.tv_sec = timeout_us / 1000000;
+    ts.tv_nsec = (timeout_us % 1000000) * 1000;
+    tsp = &ts;
+  }
+  for (;;) {
+    if (w.futex_word.load(std::memory_order_acquire) != 0) return w.result;
+    long rc = waiter_futex(&w.futex_word, FUTEX_WAIT_PRIVATE, 0, tsp);
+    if (w.futex_word.load(std::memory_order_acquire) != 0) return w.result;
+    if (rc == -1 && errno == ETIMEDOUT) {
+      // Try to withdraw; a racing waker that already popped us will set the
+      // futex word soon — spin for it so our frame stays valid.
+      {
+        std::lock_guard<std::mutex> g(b->mu);
+        if (w.in_list) {
+          Butex::erase(&w);
+          return ETIMEDOUT;
+        }
+      }
+      while (w.futex_word.load(std::memory_order_acquire) == 0) {
+      }
+      return w.result;
+    }
+    // else: spurious wake / EINTR → loop
+  }
+}
+
+}  // namespace
+
+int butex_wait(Butex* b, int expected, int64_t timeout_us) {
+  TaskGroup* g = tls_task_group;
+  if (g == nullptr || g->cur_meta()->is_main) {
+    return butex_wait_pthread(b, expected, timeout_us);
+  }
+  TaskMeta* m = g->cur_meta();
+  ButexWaiter w;
+  w.tid = (uint64_t(m->version.load(std::memory_order_relaxed)) << 32) |
+          m->index;
+  {
+    std::lock_guard<std::mutex> lg(b->mu);
+    if (b->value.load(std::memory_order_acquire) != expected)
+      return EWOULDBLOCK;
+    b->push_back(&w);
+  }
+  TimeoutCtx tctx{b, &w};
+  TimerId timer = kInvalidTimerId;
+  if (timeout_us >= 0) {
+    timer = timer_add(monotonic_us() + timeout_us, butex_timeout_cb, &tctx);
+  }
+  g->set_remained(commit_parked, &w);
+  g->sched(false);
+  // Resumed by a waker (or timeout). Make sure no timer callback can still
+  // touch our frame, then report.
+  if (timer != kInvalidTimerId) timer_cancel(timer);
+  return w.result;
+}
+
+}  // namespace brt

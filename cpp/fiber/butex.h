@@ -1,0 +1,26 @@
+// Futex in user space over a 32-bit word: the single blocking primitive all
+// higher-level sync builds on (reference: src/bthread/butex.h:32-71).
+// A waiting fiber parks (the worker steals other work); a waiting non-worker
+// thread blocks on a private futex word.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace brt {
+
+struct Butex;
+
+Butex* butex_create();
+void butex_destroy(Butex* b);
+std::atomic<int>& butex_value(Butex* b);
+
+// Parks while *value == expected. timeout_us < 0 → infinite.
+// Returns 0 (woken), EWOULDBLOCK (value differed on entry), ETIMEDOUT.
+int butex_wait(Butex* b, int expected, int64_t timeout_us = -1);
+
+// Wake one / all waiters. Returns the number woken.
+int butex_wake(Butex* b);
+int butex_wake_all(Butex* b);
+
+}  // namespace brt

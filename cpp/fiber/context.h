@@ -1,0 +1,45 @@
+// Fiber context switching: sp-as-handle, asm in context.S.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+// Saves the current context (sp stored to *from_sp), resumes to_sp; `arg`
+// becomes brt_jump_context's return value in the resumed context.
+void* brt_jump_context(void** from_sp, void* to_sp, void* arg);
+void brt_context_tramp();
+}
+
+namespace brt {
+
+// Builds an initial context on [stack_base, stack_base+size) that will call
+// fn(arg_from_first_jump) when first jumped to. Returns the context sp.
+inline void* make_context(void* stack_base, size_t size, void (*fn)(void*)) {
+  // Frame layout must mirror brt_jump_context's restore sequence:
+  //   [fcw:2][pad:2][mxcsr:4] [r15][r14][r13][r12][rbx][rbp] [ret]
+  uintptr_t top = (uintptr_t(stack_base) + size) & ~uintptr_t(15);
+  // After 'ret' pops the entry address, rsp must be 16-byte aligned at the
+  // call site inside the trampoline; start from an 8-byte-misaligned ret slot.
+  uint64_t* sp = reinterpret_cast<uint64_t*>(top);
+  // ret target at top-8: after 'ret' rsp == top (16-aligned), and the
+  // trampoline's call then gives the entry function rsp%16==8 per SysV.
+  *--sp = uintptr_t(&brt_context_tramp);        // ret target
+  *--sp = 0;                                    // rbp
+  *--sp = 0;                                    // rbx
+  *--sp = uintptr_t(fn);                        // r12 = entry fn
+  *--sp = 0;                                    // r13
+  *--sp = 0;                                    // r14
+  *--sp = 0;                                    // r15
+  // mxcsr + fcw slot: capture current thread's values
+  uint32_t mxcsr;
+  uint16_t fcw;
+  __asm__ volatile("stmxcsr %0" : "=m"(mxcsr));
+  __asm__ volatile("fnstcw %0" : "=m"(fcw));
+  --sp;
+  memcpy(reinterpret_cast<char*>(sp) + 4, &mxcsr, 4);
+  memcpy(reinterpret_cast<char*>(sp), &fcw, 2);
+  return sp;
+}
+
+}  // namespace brt

@@ -1,0 +1,165 @@
+// MPSC execution queue: producers from any thread, one consumer fiber
+// draining batches — the serialized-write primitive (reference:
+// src/bthread/execution_queue.h:142; used there for H2/RTMP writes).
+// Header-only template; Vyukov-style intrusive MPSC under the hood.
+#pragma once
+
+#include <atomic>
+
+#include "base/logging.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+
+namespace brt {
+
+template <typename T>
+class ExecutionQueue {
+ public:
+  class TaskIterator {
+   public:
+    explicit TaskIterator(typename ExecutionQueue::Node* n) : node_(n) {}
+    bool valid() const { return node_ != nullptr; }
+    T& operator*() { return node_->value; }
+    T* operator->() { return &node_->value; }
+    void operator++() { node_ = node_->consumer_next; }
+
+   private:
+    friend class ExecutionQueue;
+    typename ExecutionQueue::Node* node_;
+  };
+
+  // fn(meta, iter): consume ALL tasks the iterator yields. Returns 0.
+  using ExecuteFn = int (*)(void* meta, TaskIterator& iter);
+
+  ExecutionQueue() : stub_(new Node), joined_(1) {
+    head_.store(stub_, std::memory_order_relaxed);
+    tail_ = stub_;
+  }
+
+  ~ExecutionQueue() {
+    // drain leftover nodes (queue must be stopped/idle)
+    Node* n = tail_;
+    while (n) {
+      Node* nx = n->next.load(std::memory_order_acquire);
+      delete n;
+      n = nx;
+    }
+  }
+
+  int start(ExecuteFn fn, void* meta) {
+    fn_ = fn;
+    meta_ = meta;
+    started_ = true;
+    return 0;
+  }
+
+  // Thread-safe. Returns EINVAL after stop().
+  int execute(T value) {
+    if (stopping_.load(std::memory_order_acquire)) return EINVAL;
+    push(new Node(std::move(value), false));
+    return 0;
+  }
+
+  // No more execute()s accepted; consumer drains remaining then exits.
+  int stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return 0;
+    push(new Node(T{}, true));
+    return 0;
+  }
+
+  int join() {
+    joined_.wait();
+    return 0;
+  }
+
+ private:
+  struct Node {
+    Node() = default;
+    explicit Node(T&& v, bool s) : value(std::move(v)), stop_sentinel(s) {}
+    T value{};
+    bool stop_sentinel = false;
+    std::atomic<Node*> next{nullptr};
+    Node* consumer_next = nullptr;  // batch chain handed to the iterator
+  };
+  friend class TaskIterator;
+
+  void push(Node* n) {
+    BRT_CHECK(started_) << "ExecutionQueue not started";
+    Node* prev = head_.exchange(n, std::memory_order_acq_rel);
+    prev->next.store(n, std::memory_order_release);
+    // Become the consumer if idle.
+    int expected = 0;
+    if (running_.compare_exchange_strong(expected, 1,
+                                         std::memory_order_acq_rel)) {
+      fiber_t tid;
+      fiber_start(&tid, &ExecutionQueue::consume_entry, this);
+    }
+  }
+
+  static void* consume_entry(void* arg) {
+    static_cast<ExecutionQueue*>(arg)->consume();
+    return nullptr;
+  }
+
+  void consume() {
+    for (;;) {
+      Node* first = tail_->next.load(std::memory_order_acquire);
+      if (first != nullptr) {
+        // Walk the linked batch; chain non-sentinel nodes for the iterator.
+        bool saw_stop = false;
+        Node* batch_head = nullptr;
+        Node** chain = &batch_head;
+        Node* last = nullptr;
+        for (Node* n = first; n != nullptr;
+             n = n->next.load(std::memory_order_acquire)) {
+          last = n;
+          if (n->stop_sentinel) {
+            saw_stop = true;
+          } else {
+            *chain = n;
+            chain = &n->consumer_next;
+          }
+        }
+        *chain = nullptr;
+        if (batch_head != nullptr) {
+          TaskIterator it(batch_head);
+          fn_(meta_, it);
+        }
+        // Free the old stub and consumed nodes; 'last' becomes the new stub.
+        Node* n = tail_;
+        while (n != last) {
+          Node* nx = n->next.load(std::memory_order_relaxed);
+          delete n;
+          n = nx;
+        }
+        tail_ = last;
+        if (saw_stop) {
+          joined_.signal();
+          running_.store(0, std::memory_order_release);
+          return;
+        }
+        continue;
+      }
+      // Go idle; recheck for racing producers.
+      running_.store(0, std::memory_order_release);
+      if (tail_->next.load(std::memory_order_acquire) == nullptr) return;
+      int expected = 0;
+      if (!running_.compare_exchange_strong(expected, 1,
+                                            std::memory_order_acq_rel))
+        return;  // another consumer took over
+    }
+  }
+
+  std::atomic<Node*> head_;  // producers swing this
+  Node* tail_;               // consumer-only (current stub)
+  std::atomic<int> running_{0};
+  std::atomic<bool> stopping_{false};
+  bool started_ = false;
+  ExecuteFn fn_ = nullptr;
+  void* meta_ = nullptr;
+  Node* stub_;
+  CountdownEvent joined_;
+};
+
+}  // namespace brt

@@ -1,0 +1,440 @@
+#include "fiber/fiber.h"
+
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <mutex>
+#include <thread>
+
+#include "base/logging.h"
+#include "fiber/fiber_internal.h"
+
+namespace brt {
+
+thread_local TaskGroup* tls_task_group = nullptr;
+
+// ---------------- TaskMetaPool ----------------
+
+TaskMetaPool& TaskMetaPool::get() {
+  static TaskMetaPool pool;
+  return pool;
+}
+
+TaskMetaPool::TaskMetaPool()
+    : blocks_(new std::atomic<TaskMeta*>[kMaxBlocks]) {
+  for (uint32_t i = 0; i < kMaxBlocks; ++i) blocks_[i].store(nullptr);
+}
+
+TaskMeta* TaskMetaPool::slot(uint32_t index) {
+  return &blocks_[index / kBlockSlots].load(std::memory_order_acquire)
+              [index % kBlockSlots];
+}
+
+fiber_t TaskMetaPool::acquire(TaskMeta** out) {
+  uint32_t index;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!free_.empty()) {
+      index = free_.back();
+      free_.pop_back();
+    } else {
+      index = next_index_++;
+      BRT_CHECK_LT(index / kBlockSlots, kMaxBlocks) << "fiber pool exhausted";
+      uint32_t b = index / kBlockSlots;
+      if (blocks_[b].load(std::memory_order_relaxed) == nullptr) {
+        TaskMeta* blk = new TaskMeta[kBlockSlots];
+        for (uint32_t i = 0; i < kBlockSlots; ++i) {
+          blk[i].index = b * kBlockSlots + i;
+          blk[i].join_butex = butex_create();
+          blk[i].sleep_butex = butex_create();
+        }
+        blocks_[b].store(blk, std::memory_order_release);
+      }
+    }
+  }
+  TaskMeta* m = slot(index);
+  uint32_t v = m->version.load(std::memory_order_relaxed) + 1;  // → odd
+  m->fn = nullptr;
+  m->arg = nullptr;
+  m->ctx_sp = nullptr;
+  m->stop_requested.store(false, std::memory_order_relaxed);
+  butex_value(m->join_butex).store(int(v), std::memory_order_relaxed);
+  m->version.store(v, std::memory_order_release);
+  *out = m;
+  return (uint64_t(v) << 32) | index;
+}
+
+void TaskMetaPool::release(TaskMeta* m) {
+  uint32_t v = m->version.load(std::memory_order_relaxed);
+  m->version.store(v + 1, std::memory_order_release);  // → even (stale)
+  butex_value(m->join_butex).store(int(v + 1), std::memory_order_release);
+  butex_wake_all(m->join_butex);
+  std::lock_guard<std::mutex> g(mu_);
+  free_.push_back(m->index);
+}
+
+TaskMeta* TaskMetaPool::address(fiber_t id) {
+  uint32_t index = uint32_t(id);
+  if (index >= next_index_.load(std::memory_order_acquire)) return nullptr;
+  TaskMeta* m = slot(index);
+  uint32_t v = uint32_t(id >> 32);
+  if (!(v & 1) || m->version.load(std::memory_order_acquire) != v)
+    return nullptr;
+  return m;
+}
+
+TaskMeta* TaskMetaPool::address_unsafe(fiber_t id) {
+  uint32_t index = uint32_t(id);
+  if (index >= next_index_.load(std::memory_order_acquire)) return nullptr;
+  return slot(index);
+}
+
+// ---------------- ParkingLot ----------------
+
+static long sys_futex(std::atomic<int>* addr, int op, int val) {
+  return syscall(SYS_futex, reinterpret_cast<int*>(addr), op, val, nullptr,
+                 nullptr, 0);
+}
+
+void ParkingLot::signal(int nwake) {
+  word_.fetch_add(1, std::memory_order_release);
+  if (parked_.load(std::memory_order_acquire) > 0) {
+    sys_futex(&word_, FUTEX_WAKE_PRIVATE, nwake);
+  }
+}
+
+void ParkingLot::wait(int expected) {
+  parked_.fetch_add(1, std::memory_order_acq_rel);
+  if (word_.load(std::memory_order_acquire) == expected) {
+    sys_futex(&word_, FUTEX_WAIT_PRIVATE, expected);
+  }
+  parked_.fetch_sub(1, std::memory_order_acq_rel);
+}
+
+// ---------------- TaskControl ----------------
+
+static TaskControl* g_control = nullptr;
+static std::once_flag g_control_once;
+
+TaskControl* TaskControl::get() {
+  std::call_once(g_control_once, [] {
+    auto* c = new TaskControl();
+    int n = 0;
+    if (const char* env = getenv("BRT_WORKERS")) n = atoi(env);
+    if (n <= 0) {
+      int ncpu = int(std::thread::hardware_concurrency());
+      n = ncpu > 4 ? ncpu : 4;
+    }
+    c->start(n);
+    g_control = c;
+  });
+  return g_control;
+}
+
+TaskControl* TaskControl::get_or_null() { return g_control; }
+
+void TaskControl::start(int concurrency) {
+  concurrency_ = concurrency;
+  groups_.reserve(concurrency);
+  for (int i = 0; i < concurrency; ++i) {
+    auto* g = new TaskGroup(this, i);
+    groups_.push_back(g);
+  }
+  for (int i = 0; i < concurrency; ++i) {
+    std::thread([g = groups_[i]] { g->run_main_loop(); }).detach();
+  }
+}
+
+void TaskControl::signal_task(int n) {
+  if (n <= 0) return;
+  pl_.signal(n > 2 ? 2 : n);
+}
+
+bool TaskControl::steal_task(fiber_t* out, uint64_t* seed, int skip) {
+  const size_t n = groups_.size();
+  // xorshift over group indices
+  for (size_t attempts = 0; attempts < n * 2; ++attempts) {
+    *seed = *seed * 6364136223846793005ULL + 1442695040888963407ULL;
+    size_t i = (*seed >> 33) % n;
+    if (int(i) == skip) continue;
+    if (groups_[i]->rq_.steal(out)) return true;
+    if (groups_[i]->pop_remote(out)) return true;
+  }
+  return false;
+}
+
+TaskGroup* TaskControl::choose_group() {
+  int i = next_remote_.fetch_add(1, std::memory_order_relaxed);
+  return groups_[size_t(i) % groups_.size()];
+}
+
+// ---------------- TaskGroup ----------------
+
+TaskGroup::TaskGroup(TaskControl* c, int index)
+    : control_(c), index_(index),
+      steal_seed_(0x9e3779b97f4a7c15ULL ^ (uint64_t(index) << 17)) {
+  main_meta_.is_main = true;
+}
+
+void TaskGroup::ready_to_run(fiber_t tid) {
+  if (!rq_.push(tid)) {
+    push_remote(tid);  // overflow: spill to own remote queue
+    return;
+  }
+  control_->signal_task(1);
+}
+
+void TaskGroup::push_remote(fiber_t tid) {
+  {
+    std::lock_guard<std::mutex> g(remote_mu_);
+    remote_rq_.push_back(tid);
+  }
+  control_->signal_task(1);
+}
+
+bool TaskGroup::pop_remote(fiber_t* out) {
+  std::lock_guard<std::mutex> g(remote_mu_);
+  if (remote_rq_.empty()) return false;
+  *out = remote_rq_.front();
+  remote_rq_.pop_front();
+  return true;
+}
+
+void requeue_fiber(fiber_t tid) {
+  TaskGroup* g = tls_task_group;
+  if (g != nullptr) {
+    g->ready_to_run(tid);
+  } else {
+    TaskControl::get()->choose_group()->push_remote(tid);
+  }
+}
+
+bool TaskGroup::wait_task(fiber_t* out) {
+  for (;;) {
+    if (rq_.pop(out)) return true;
+    if (pop_remote(out)) return true;
+    if (control_->steal_task(out, &steal_seed_, index_)) return true;
+    int expected = control_->pl_.state();
+    // one more scan after snapshotting to close the lost-wake window
+    if (rq_.pop(out) || pop_remote(out) ||
+        control_->steal_task(out, &steal_seed_, index_))
+      return true;
+    control_->pl_.wait(expected);
+  }
+}
+
+void TaskGroup::run_main_loop() {
+  tls_task_group = this;
+  cur_meta_ = &main_meta_;
+  fiber_t tid;
+  for (;;) {
+    if (!wait_task(&tid)) break;
+    TaskMeta* m = TaskMetaPool::get().address(tid);
+    if (m == nullptr) continue;  // fiber already finished (spurious)
+    sched_to(m);
+  }
+}
+
+struct CleanupCtx {
+  TaskMeta* meta;
+};
+
+static void cleanup_terminated(void* arg) {
+  TaskMeta* m = static_cast<TaskMeta*>(arg);
+  // Runs on the NEXT context: safe to recycle m's stack now.
+  if (m->has_stack) {
+    return_stack(m->stack);
+    m->has_stack = false;
+  }
+  m->ctx_sp = nullptr;
+  TaskMetaPool::get().release(m);
+}
+
+void TaskGroup::task_runner(void* /*jump_arg*/) {
+  TaskGroup* g = tls_task_group;
+  g->run_remained();
+  TaskMeta* m = g->cur_meta_;
+  m->fn(m->arg);
+  // Fiber terminated. We might have migrated workers while running.
+  g = tls_task_group;
+  g->set_remained(cleanup_terminated, m);
+  g->sched(false);
+  BRT_LOG(FATAL) << "terminated fiber resumed";
+}
+
+void TaskGroup::sched_to(TaskMeta* next) {
+  TaskMeta* cur = cur_meta_;
+  if (next == cur) {
+    run_remained();
+    return;
+  }
+  if (!next->is_main && next->ctx_sp == nullptr) {
+    if (!next->has_stack) {
+      BRT_CHECK(get_stack(next->stack_type, &next->stack))
+          << "fiber stack allocation failed";
+      next->has_stack = true;
+    }
+    next->ctx_sp = make_context(next->stack.base, next->stack.size,
+                                &TaskGroup::task_runner);
+  }
+  cur_meta_ = next;
+  brt_jump_context(&cur->ctx_sp, next->ctx_sp, this);
+  // 'cur' resumed — possibly on a different worker.
+  tls_task_group->run_remained();
+}
+
+void TaskGroup::sched(bool requeue_current) {
+  TaskMeta* cur = cur_meta_;
+  fiber_t next_tid = 0;
+  TaskMeta* next = nullptr;
+  if (rq_.pop(&next_tid) || pop_remote(&next_tid)) {
+    next = TaskMetaPool::get().address(next_tid);
+  }
+  if (next == nullptr) next = &main_meta_;
+  if (requeue_current && !cur->is_main) {
+    // Requeue AFTER we've left this stack (remained runs on next context).
+    static thread_local fiber_t requeue_tid;
+    requeue_tid =
+        (uint64_t(cur->version.load(std::memory_order_relaxed)) << 32) |
+        cur->index;
+    set_remained(
+        [](void* arg) {
+          tls_task_group->ready_to_run(*static_cast<fiber_t*>(arg));
+        },
+        &requeue_tid);
+  }
+  sched_to(next);
+}
+
+// ---------------- public API ----------------
+
+void fiber_init(int concurrency) {
+  if (concurrency > 0) {
+    std::call_once(g_control_once, [concurrency] {
+      auto* c = new TaskControl();
+      c->start(concurrency);
+      g_control = c;
+    });
+  } else {
+    TaskControl::get();
+  }
+}
+
+int fiber_concurrency() { return TaskControl::get()->concurrency_; }
+
+static fiber_t create_meta(void* (*fn)(void*), void* arg,
+                           const FiberAttr* attr, TaskMeta** out) {
+  TaskMeta* m;
+  fiber_t tid = TaskMetaPool::get().acquire(&m);
+  m->fn = fn;
+  m->arg = arg;
+  m->stack_type = attr ? attr->stack_type : StackType::NORMAL;
+  if (m->has_stack && m->stack.type != m->stack_type) {
+    return_stack(m->stack);
+    m->has_stack = false;
+  }
+  *out = m;
+  return tid;
+}
+
+int fiber_start(fiber_t* tid_out, void* (*fn)(void*), void* arg,
+                const FiberAttr* attr) {
+  TaskControl::get();
+  TaskMeta* m;
+  fiber_t tid = create_meta(fn, arg, attr, &m);
+  if (tid_out) *tid_out = tid;
+  requeue_fiber(tid);
+  return 0;
+}
+
+int fiber_start_urgent(fiber_t* tid_out, void* (*fn)(void*), void* arg,
+                       const FiberAttr* attr) {
+  TaskControl::get();
+  TaskGroup* g = tls_task_group;
+  if (g == nullptr || g->cur_meta()->is_main) {
+    return fiber_start(tid_out, fn, arg, attr);
+  }
+  TaskMeta* m;
+  fiber_t tid = create_meta(fn, arg, attr, &m);
+  if (tid_out) *tid_out = tid;
+  // Run the new fiber NOW; requeue the caller (after the switch).
+  TaskMeta* cur = g->cur_meta();
+  static thread_local fiber_t requeue_tid;
+  requeue_tid =
+      (uint64_t(cur->version.load(std::memory_order_relaxed)) << 32) |
+      cur->index;
+  g->set_remained(
+      [](void* arg2) {
+        tls_task_group->ready_to_run(*static_cast<fiber_t*>(arg2));
+      },
+      &requeue_tid);
+  g->sched_to(m);
+  return 0;
+}
+
+int fiber_join(fiber_t tid) {
+  if (tid == INVALID_FIBER) return -1;
+  TaskMeta* m = TaskMetaPool::get().address_unsafe(tid);
+  if (m == nullptr) return 0;
+  int expected = int(uint32_t(tid >> 32));
+  // join_butex value tracks version: changes exactly when the fiber ends.
+  while (butex_value(m->join_butex).load(std::memory_order_acquire) ==
+         expected) {
+    butex_wait(m->join_butex, expected);
+  }
+  return 0;
+}
+
+void fiber_yield() {
+  TaskGroup* g = tls_task_group;
+  if (g == nullptr || g->cur_meta()->is_main) {
+    std::this_thread::yield();
+    return;
+  }
+  g->sched(true);
+}
+
+int fiber_usleep(int64_t us) {
+  TaskMeta* m =
+      (tls_task_group && !tls_task_group->cur_meta()->is_main)
+          ? tls_task_group->cur_meta()
+          : nullptr;
+  if (m == nullptr) {
+    std::this_thread::sleep_for(std::chrono::microseconds(us));
+    return 0;
+  }
+  if (m->stop_requested.load(std::memory_order_acquire)) return EINTR;
+  int val = butex_value(m->sleep_butex).load(std::memory_order_acquire);
+  int rc = butex_wait(m->sleep_butex, val, us);
+  if (m->stop_requested.load(std::memory_order_acquire)) return EINTR;
+  return rc == ETIMEDOUT ? 0 : rc;
+}
+
+int fiber_stop(fiber_t tid) {
+  TaskMeta* m = TaskMetaPool::get().address(tid);
+  if (m == nullptr) return ESRCH;
+  m->stop_requested.store(true, std::memory_order_release);
+  butex_value(m->sleep_butex).fetch_add(1, std::memory_order_release);
+  butex_wake_all(m->sleep_butex);
+  return 0;
+}
+
+bool fiber_stopped(fiber_t tid) {
+  TaskMeta* m = TaskMetaPool::get().address(tid);
+  return m == nullptr || m->stop_requested.load(std::memory_order_acquire);
+}
+
+bool in_fiber() {
+  return tls_task_group != nullptr && !tls_task_group->cur_meta()->is_main;
+}
+
+fiber_t fiber_self() {
+  if (!in_fiber()) return INVALID_FIBER;
+  TaskMeta* m = tls_task_group->cur_meta();
+  return (uint64_t(m->version.load(std::memory_order_relaxed)) << 32) |
+         m->index;
+}
+
+}  // namespace brt

@@ -1,0 +1,52 @@
+// M:N fiber runtime public API (reference: src/bthread/bthread.h).
+// Fibers are versioned 64-bit handles; blocking primitives park the *fiber*,
+// never the worker pthread.
+#pragma once
+
+#include <cstdint>
+
+#include "fiber/stack.h"
+
+namespace brt {
+
+using fiber_t = uint64_t;
+constexpr fiber_t INVALID_FIBER = 0;
+
+struct FiberAttr {
+  StackType stack_type = StackType::NORMAL;
+};
+
+// Starts worker pthreads (idempotent). concurrency<=0 → default
+// (BRT_WORKERS env or max(4, ncpu)).
+void fiber_init(int concurrency = 0);
+int fiber_concurrency();
+
+// Schedules fn(arg) on a worker ("background": current fiber keeps running;
+// reference bthread_start_background).
+int fiber_start(fiber_t* tid, void* (*fn)(void*), void* arg,
+                const FiberAttr* attr = nullptr);
+
+// If called from a worker fiber, the NEW fiber runs immediately and the
+// caller is requeued — the RPC fast path ("thread jump", reference
+// bthread_start_urgent / TaskGroup::start_foreground).
+int fiber_start_urgent(fiber_t* tid, void* (*fn)(void*), void* arg,
+                       const FiberAttr* attr = nullptr);
+
+// Waits for fiber termination. Safe on stale ids (returns immediately).
+int fiber_join(fiber_t tid);
+
+void fiber_yield();
+
+// Sleep without blocking the worker. Returns 0, or EINTR if fiber_stop-ed.
+int fiber_usleep(int64_t us);
+
+// Requests stop: sets the stop flag and interrupts a current/future
+// fiber_usleep with EINTR. (Parked butex waits are not interrupted in this
+// build — periodic tasks should sleep via fiber_usleep.)
+int fiber_stop(fiber_t tid);
+bool fiber_stopped(fiber_t tid);
+
+bool in_fiber();        // true when on a worker fiber (not the main context)
+fiber_t fiber_self();   // INVALID_FIBER when not in a fiber
+
+}  // namespace brt

@@ -1,0 +1,216 @@
+#include "fiber/fiber_id.h"
+
+#include <deque>
+#include <mutex>
+
+#include "base/logging.h"
+#include "fiber/butex.h"
+#include "fiber/fiber_internal.h"
+
+namespace brt {
+
+namespace {
+
+// Slots live forever (slab, never freed) — stale fids stay memory-safe.
+struct IdSlot {
+  std::mutex mu;
+  bool locked = false;
+  uint32_t index = 0;
+  std::atomic<uint32_t> version{0};  // odd = live
+  std::deque<int> pending_errors;
+  void* data = nullptr;
+  int (*on_error)(fid_t, void*, int) = nullptr;
+  Butex* lock_butex = nullptr;  // bumped on unlock/destroy; waiters re-try
+  Butex* join_butex = nullptr;  // value = version; changes on destroy
+};
+
+class IdPool {
+ public:
+  static IdPool& get() {
+    static IdPool* p = new IdPool();
+    return *p;
+  }
+
+  fid_t acquire(IdSlot** out) {
+    uint32_t index;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (!free_.empty()) {
+        index = free_.back();
+        free_.pop_back();
+      } else {
+        index = next_index_++;
+        uint32_t b = index / kBlockSlots;
+        BRT_CHECK_LT(b, kMaxBlocks) << "fid pool exhausted";
+        if (blocks_[b].load(std::memory_order_relaxed) == nullptr) {
+          IdSlot* blk = new IdSlot[kBlockSlots];
+          for (uint32_t i = 0; i < kBlockSlots; ++i) {
+            blk[i].index = b * kBlockSlots + i;
+            blk[i].lock_butex = butex_create();
+            blk[i].join_butex = butex_create();
+          }
+          blocks_[b].store(blk, std::memory_order_release);
+        }
+      }
+    }
+    IdSlot* s = slot(index);
+    uint32_t v = s->version.load(std::memory_order_relaxed) + 1;  // odd
+    butex_value(s->join_butex).store(int(v), std::memory_order_relaxed);
+    s->version.store(v, std::memory_order_release);
+    *out = s;
+    return (uint64_t(v) << 32) | index;
+  }
+
+  void release_index(uint32_t index) {
+    std::lock_guard<std::mutex> g(mu_);
+    free_.push_back(index);
+  }
+
+  IdSlot* slot(uint32_t index) {
+    return &blocks_[index / kBlockSlots].load(std::memory_order_acquire)
+                [index % kBlockSlots];
+  }
+
+  IdSlot* address(fid_t id) {
+    uint32_t index = uint32_t(id);
+    if (index >= next_index_.load(std::memory_order_acquire)) return nullptr;
+    return slot(index);
+  }
+
+ private:
+  static constexpr uint32_t kBlockSlots = 256;
+  static constexpr uint32_t kMaxBlocks = 16384;
+  IdPool() : blocks_(new std::atomic<IdSlot*>[kMaxBlocks]) {
+    for (uint32_t i = 0; i < kMaxBlocks; ++i) blocks_[i].store(nullptr);
+  }
+  std::mutex mu_;
+  std::vector<uint32_t> free_;
+  std::atomic<uint32_t> next_index_{0};
+  std::atomic<IdSlot*>* blocks_;
+};
+
+inline uint32_t id_version(fid_t id) { return uint32_t(id >> 32); }
+
+inline bool slot_matches(IdSlot* s, fid_t id) {
+  uint32_t v = id_version(id);
+  return (v & 1) && s->version.load(std::memory_order_acquire) == v;
+}
+
+}  // namespace
+
+int fid_create(fid_t* out, void* data,
+               int (*on_error)(fid_t, void*, int)) {
+  IdSlot* s;
+  fid_t id = IdPool::get().acquire(&s);
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->locked = false;
+    s->pending_errors.clear();
+    s->data = data;
+    s->on_error = on_error;
+  }
+  *out = id;
+  return 0;
+}
+
+int fid_lock(fid_t id, void** data) {
+  IdSlot* s = IdPool::get().address(id);
+  if (s == nullptr) return EINVAL;
+  for (;;) {
+    int seq;
+    {
+      std::lock_guard<std::mutex> g(s->mu);
+      if (!slot_matches(s, id)) return EINVAL;
+      if (!s->locked) {
+        s->locked = true;
+        if (data) *data = s->data;
+        return 0;
+      }
+      seq = butex_value(s->lock_butex).load(std::memory_order_relaxed);
+    }
+    butex_wait(s->lock_butex, seq);  // woken on unlock/destroy; re-try
+  }
+}
+
+static void wake_lock_waiters(IdSlot* s) {
+  butex_value(s->lock_butex).fetch_add(1, std::memory_order_release);
+  butex_wake_all(s->lock_butex);
+}
+
+int fid_unlock(fid_t id) {
+  IdSlot* s = IdPool::get().address(id);
+  if (s == nullptr) return EINVAL;
+  int next_error = 0;
+  void* data;
+  int (*handler)(fid_t, void*, int);
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (!slot_matches(s, id)) return EINVAL;
+    if (!s->locked) return EPERM;
+    if (s->pending_errors.empty()) {
+      s->locked = false;
+    } else {
+      next_error = s->pending_errors.front();
+      s->pending_errors.pop_front();
+      // stay locked for the handler
+    }
+    data = s->data;
+    handler = s->on_error;
+  }
+  if (next_error == 0) {
+    wake_lock_waiters(s);
+    return 0;
+  }
+  return handler(id, data, next_error);  // handler unlocks/destroys
+}
+
+int fid_unlock_and_destroy(fid_t id) {
+  IdSlot* s = IdPool::get().address(id);
+  if (s == nullptr) return EINVAL;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (!slot_matches(s, id)) return EINVAL;
+    if (!s->locked) return EPERM;
+    uint32_t v = id_version(id);
+    s->version.store(v + 1, std::memory_order_release);
+    s->locked = false;
+    s->pending_errors.clear();
+    butex_value(s->join_butex).store(int(v + 1), std::memory_order_release);
+  }
+  wake_lock_waiters(s);
+  butex_wake_all(s->join_butex);
+  IdPool::get().release_index(s->index);
+  return 0;
+}
+
+int fid_error(fid_t id, int error_code) {
+  IdSlot* s = IdPool::get().address(id);
+  if (s == nullptr) return EINVAL;
+  void* data;
+  int (*handler)(fid_t, void*, int);
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (!slot_matches(s, id)) return EINVAL;
+    if (s->locked) {
+      s->pending_errors.push_back(error_code);
+      return 0;
+    }
+    s->locked = true;
+    data = s->data;
+    handler = s->on_error;
+  }
+  return handler(id, data, error_code);  // handler unlocks/destroys
+}
+
+int fid_join(fid_t id) {
+  IdSlot* s = IdPool::get().address(id);
+  if (s == nullptr) return 0;
+  int expected = int(id_version(id));
+  while (butex_value(s->join_butex).load(std::memory_order_acquire) ==
+         expected) {
+    butex_wait(s->join_butex, expected);
+  }
+  return 0;
+}
+
+}  // namespace brt

@@ -1,0 +1,38 @@
+// Lockable, versioned 64-bit handle with error propagation — one per
+// in-flight RPC (= correlation id).  Serializes all concurrent events racing
+// on one RPC: response arrival, timeout, backup-request timer, cancel.
+// Parity target: reference src/bthread/id.h:31-38 (bthread_id_create/lock/
+// unlock/unlock_and_destroy/error/join).
+#pragma once
+
+#include <cstdint>
+
+namespace brt {
+
+using fid_t = uint64_t;
+constexpr fid_t INVALID_FID = 0;
+
+// on_error(id, data, error_code) is invoked with the id LOCKED; the handler
+// MUST eventually fid_unlock(id) or fid_unlock_and_destroy(id).
+int fid_create(fid_t* id, void* data,
+               int (*on_error)(fid_t id, void* data, int error_code));
+
+// Locks the id; parks the calling fiber while another holder has it.
+// Returns EINVAL if the id was destroyed (stale).
+int fid_lock(fid_t id, void** data);
+
+// Releases the lock. If errors queued while locked, the first queued error's
+// on_error runs in THIS thread (id stays locked for the handler).
+int fid_unlock(fid_t id);
+
+// Releases + invalidates the id; wakes joiners; pending errors are dropped.
+int fid_unlock_and_destroy(fid_t id);
+
+// Delivers an asynchronous error: locks and runs on_error if free, queues it
+// if currently locked. EINVAL if destroyed.
+int fid_error(fid_t id, int error_code);
+
+// Waits until the id is destroyed. Safe on stale ids.
+int fid_join(fid_t id);
+
+}  // namespace brt

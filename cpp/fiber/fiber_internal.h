@@ -1,0 +1,143 @@
+// Scheduler internals shared by fiber.cc / butex.cc (reference layering:
+// src/bthread/task_group.h, task_control.h, task_meta.h).
+#pragma once
+
+#include <atomic>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+#include "fiber/butex.h"
+#include "fiber/context.h"
+#include "fiber/fiber.h"
+#include "fiber/stack.h"
+#include "fiber/work_stealing_queue.h"
+
+namespace brt {
+
+class TaskGroup;
+class TaskControl;
+
+struct TaskMeta {
+  void* (*fn)(void*) = nullptr;
+  void* arg = nullptr;
+  void* ctx_sp = nullptr;       // saved context (sp); null until first run
+  FiberStack stack{};
+  bool has_stack = false;
+  bool is_main = false;
+  StackType stack_type = StackType::NORMAL;
+  uint32_t index = 0;           // slot index in the meta pool
+  std::atomic<uint32_t> version{0};  // odd = live (id ABA guard)
+  Butex* join_butex = nullptr;  // value := version; bumped at termination
+  Butex* sleep_butex = nullptr; // parked on by fiber_usleep
+  std::atomic<bool> stop_requested{false};
+};
+
+// Slab pool of TaskMeta; slots live forever (stale handles stay memory-safe,
+// same contract as the reference's ResourcePool-backed bthread_t).
+class TaskMetaPool {
+ public:
+  static TaskMetaPool& get();
+  fiber_t acquire(TaskMeta** out);
+  void release(TaskMeta* m);      // invalidates id, recycles slot
+  TaskMeta* address(fiber_t id);  // null if stale
+  TaskMeta* address_unsafe(fiber_t id);  // ignores version (slot memory safe)
+
+ private:
+  static constexpr uint32_t kBlockSlots = 256;
+  static constexpr uint32_t kMaxBlocks = 4096;
+  TaskMetaPool();
+  TaskMeta* slot(uint32_t index);
+  std::mutex mu_;
+  std::vector<uint32_t> free_;
+  std::atomic<uint32_t> next_index_{0};
+  std::atomic<TaskMeta*>* blocks_;
+};
+
+class ParkingLot {
+ public:
+  int state() const { return word_.load(std::memory_order_acquire); }
+  void signal(int nwake);
+  void wait(int expected);
+  int parked() const { return parked_.load(std::memory_order_relaxed); }
+
+ private:
+  friend class TaskGroup;
+  std::atomic<int> word_{0};
+  std::atomic<int> parked_{0};
+};
+
+class TaskGroup {
+ public:
+  explicit TaskGroup(TaskControl* c, int index);
+
+  void run_main_loop();
+
+  // Pick next runnable (local rq → remote) or the main context, and jump.
+  // requeue_current: push the current fiber back AFTER the switch.
+  void sched(bool requeue_current);
+  void sched_to(TaskMeta* next);
+
+  // One-slot callback executed right after the next context switch completes
+  // (runs on the next fiber's stack) — the mechanism that makes "requeue me
+  // after I've left my stack" and butex-park commits race-free.
+  void set_remained(void (*fn)(void*), void* arg) {
+    remained_fn_ = fn;
+    remained_arg_ = arg;
+  }
+  void run_remained() {
+    if (remained_fn_) {
+      auto fn = remained_fn_;
+      remained_fn_ = nullptr;
+      fn(remained_arg_);
+    }
+  }
+
+  void ready_to_run(fiber_t tid);          // from this worker
+  void push_remote(fiber_t tid);           // from any thread
+  bool pop_remote(fiber_t* out);
+
+  TaskMeta* cur_meta() { return cur_meta_; }
+  TaskControl* control() { return control_; }
+
+  static void task_runner(void* arg);
+
+  TaskMeta main_meta_;
+  WorkStealingQueue<fiber_t> rq_;
+  std::mutex remote_mu_;
+  std::deque<fiber_t> remote_rq_;
+  TaskMeta* cur_meta_ = nullptr;
+  TaskControl* control_;
+  int index_;
+  uint64_t steal_seed_;
+
+ private:
+  bool wait_task(fiber_t* out);
+  void (*remained_fn_)(void*) = nullptr;
+  void* remained_arg_ = nullptr;
+};
+
+class TaskControl {
+ public:
+  // Lazily started global runtime.
+  static TaskControl* get();
+  static TaskControl* get_or_null();
+  void start(int concurrency);
+
+  void signal_task(int n);
+  bool steal_task(fiber_t* out, uint64_t* seed, int skip_group);
+  TaskGroup* choose_group();  // for remote pushes
+
+  std::vector<TaskGroup*> groups_;
+  ParkingLot pl_;
+  std::atomic<int> next_remote_{0};
+  int concurrency_ = 0;
+};
+
+extern thread_local TaskGroup* tls_task_group;
+
+// Push a runnable fiber from ANY thread context (worker → local rq,
+// non-worker → some group's remote queue). Used by butex wakes and timers.
+void requeue_fiber(fiber_t tid);
+
+}  // namespace brt

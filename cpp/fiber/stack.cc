@@ -1,0 +1,70 @@
+#include "fiber/stack.h"
+
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <mutex>
+#include <vector>
+
+#include "base/logging.h"
+
+namespace brt {
+
+namespace {
+
+size_t stack_bytes(StackType t) {
+  switch (t) {
+    case StackType::SMALL: return 32 * 1024;
+    case StackType::NORMAL: return 128 * 1024;
+    case StackType::LARGE: return 1024 * 1024;
+  }
+  return 128 * 1024;
+}
+
+struct StackPool {
+  std::mutex mu;
+  std::vector<void*> free_bases[3];
+};
+StackPool g_pool;
+
+}  // namespace
+
+bool get_stack(StackType type, FiberStack* out) {
+  size_t usable = stack_bytes(type);
+  {
+    std::lock_guard<std::mutex> g(g_pool.mu);
+    auto& v = g_pool.free_bases[int(type)];
+    if (!v.empty()) {
+      out->base = v.back();
+      v.pop_back();
+      out->size = usable;
+      out->type = type;
+      return true;
+    }
+  }
+  size_t page = size_t(sysconf(_SC_PAGESIZE));
+  void* mem = mmap(nullptr, usable + page, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+  if (mem == MAP_FAILED) return false;
+  if (mprotect(mem, page, PROT_NONE) != 0) {
+    munmap(mem, usable + page);
+    return false;
+  }
+  out->base = (char*)mem + page;
+  out->size = usable;
+  out->type = type;
+  return true;
+}
+
+void return_stack(const FiberStack& s) {
+  std::lock_guard<std::mutex> g(g_pool.mu);
+  auto& v = g_pool.free_bases[int(s.type)];
+  if (v.size() < 128) {
+    v.push_back(s.base);
+  } else {
+    size_t page = size_t(sysconf(_SC_PAGESIZE));
+    munmap((char*)s.base - page, s.size + page);
+  }
+}
+
+}  // namespace brt

@@ -1,0 +1,20 @@
+// Pooled fiber stacks with guard pages (reference: src/bthread/stack.h:56).
+#pragma once
+
+#include <cstddef>
+
+namespace brt {
+
+enum class StackType { SMALL, NORMAL, LARGE };
+
+struct FiberStack {
+  void* base = nullptr;     // usable low address (above guard page)
+  size_t size = 0;          // usable bytes
+  StackType type = StackType::NORMAL;
+};
+
+// 32KB / 128KB / 1MB usable (+1 guard page each).
+bool get_stack(StackType type, FiberStack* out);
+void return_stack(const FiberStack& s);
+
+}  // namespace brt

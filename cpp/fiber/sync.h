@@ -1,0 +1,109 @@
+// Fiber-aware sync primitives over butex (reference: src/bthread/mutex.cpp,
+// condition_variable.cpp, countdown_event.cpp). Parking a fiber frees the
+// worker; from non-worker threads they degrade to futex blocking.
+#pragma once
+
+#include "fiber/butex.h"
+
+namespace brt {
+
+class FiberMutex {
+ public:
+  FiberMutex() : b_(butex_create()) {}
+  ~FiberMutex() { butex_destroy(b_); }
+  FiberMutex(const FiberMutex&) = delete;
+  FiberMutex& operator=(const FiberMutex&) = delete;
+
+  void lock() {
+    auto& v = butex_value(b_);
+    int expected = 0;
+    if (v.compare_exchange_strong(expected, 1, std::memory_order_acquire))
+      return;
+    // contended: set to 2 (has waiters) and park
+    do {
+      if (expected == 2 ||
+          v.compare_exchange_weak(expected, 2, std::memory_order_acquire)) {
+        butex_wait(b_, 2);
+      }
+      expected = 0;
+    } while (
+        !v.compare_exchange_weak(expected, 2, std::memory_order_acquire));
+  }
+
+  bool try_lock() {
+    int expected = 0;
+    return butex_value(b_).compare_exchange_strong(expected, 1,
+                                                   std::memory_order_acquire);
+  }
+
+  void unlock() {
+    auto& v = butex_value(b_);
+    int prev = v.exchange(0, std::memory_order_release);
+    if (prev == 2) butex_wake(b_);
+  }
+
+  Butex* butex() { return b_; }
+
+ private:
+  Butex* b_;
+};
+
+class FiberCond {
+ public:
+  FiberCond() : b_(butex_create()) {}
+  ~FiberCond() { butex_destroy(b_); }
+
+  // mutex must be held.
+  int wait(FiberMutex& mu, int64_t timeout_us = -1) {
+    int seq = butex_value(b_).load(std::memory_order_acquire);
+    mu.unlock();
+    int rc = butex_wait(b_, seq, timeout_us);
+    mu.lock();
+    return rc == EWOULDBLOCK ? 0 : rc;
+  }
+
+  void notify_one() {
+    butex_value(b_).fetch_add(1, std::memory_order_release);
+    butex_wake(b_);
+  }
+
+  void notify_all() {
+    butex_value(b_).fetch_add(1, std::memory_order_release);
+    butex_wake_all(b_);
+  }
+
+ private:
+  Butex* b_;
+};
+
+class CountdownEvent {
+ public:
+  explicit CountdownEvent(int count = 1) : b_(butex_create()) {
+    butex_value(*&b_).store(count, std::memory_order_relaxed);
+  }
+  ~CountdownEvent() { butex_destroy(b_); }
+
+  void signal(int n = 1) {
+    auto& v = butex_value(b_);
+    int prev = v.fetch_sub(n, std::memory_order_acq_rel);
+    if (prev - n <= 0) butex_wake_all(b_);
+  }
+
+  void add_count(int n = 1) {
+    butex_value(b_).fetch_add(n, std::memory_order_release);
+  }
+
+  int wait(int64_t timeout_us = -1) {
+    for (;;) {
+      int v = butex_value(b_).load(std::memory_order_acquire);
+      if (v <= 0) return 0;
+      int rc = butex_wait(b_, v, timeout_us);
+      if (rc == ETIMEDOUT) return ETIMEDOUT;
+    }
+  }
+
+ private:
+  Butex* b_;
+};
+
+}  // namespace brt

@@ -1,0 +1,123 @@
+#include "fiber/timer.h"
+
+#include <condition_variable>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "base/time.h"
+
+namespace brt {
+
+namespace {
+
+enum class TState { PENDING, RUNNING, DONE, CANCELLED };
+
+struct TimerEntry {
+  int64_t when_us;
+  void (*fn)(void*);
+  void* arg;
+  TState state = TState::PENDING;
+};
+
+struct HeapItem {
+  int64_t when_us;
+  TimerId id;
+  bool operator>(const HeapItem& o) const { return when_us > o.when_us; }
+};
+
+class TimerThread {
+ public:
+  static TimerThread& get() {
+    // Intentionally leaked: the detached timer pthread waits on cv_ forever,
+    // and glibc's pthread_cond_destroy blocks while a waiter is present —
+    // destroying this at exit would hang the process.
+    static TimerThread* t = new TimerThread();
+    return *t;
+  }
+
+  TimerId add(int64_t when_us, void (*fn)(void*), void* arg) {
+    std::unique_lock<std::mutex> lk(mu_);
+    TimerId id = ++next_id_;
+    entries_.emplace(id, TimerEntry{when_us, fn, arg});
+    heap_.push({when_us, id});
+    if (when_us < next_wake_us_) cv_.notify_one();
+    return id;
+  }
+
+  int cancel(TimerId id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      auto it = entries_.find(id);
+      if (it == entries_.end()) return 1;  // already ran and was erased
+      if (it->second.state == TState::PENDING) {
+        it->second.state = TState::CANCELLED;  // lazily dropped from heap
+        return 0;
+      }
+      if (it->second.state == TState::CANCELLED) return 0;
+      done_cv_.wait(lk);  // RUNNING: wait for the callback to finish
+    }
+  }
+
+ private:
+  TimerThread() : worker_([this] { run(); }) { worker_.detach(); }
+
+  void run() {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      int64_t now = monotonic_us();
+      while (!heap_.empty()) {
+        HeapItem top = heap_.top();
+        auto it = entries_.find(top.id);
+        if (it == entries_.end() || it->second.state == TState::CANCELLED) {
+          heap_.pop();
+          if (it != entries_.end()) entries_.erase(it);
+          continue;
+        }
+        if (top.when_us > now) break;
+        heap_.pop();
+        TimerEntry& e = it->second;
+        e.state = TState::RUNNING;
+        auto fn = e.fn;
+        auto arg = e.arg;
+        lk.unlock();
+        fn(arg);
+        lk.lock();
+        // re-find: map may have rehashed
+        auto it2 = entries_.find(top.id);
+        if (it2 != entries_.end()) {
+          it2->second.state = TState::DONE;
+          entries_.erase(it2);
+        }
+        done_cv_.notify_all();
+        now = monotonic_us();
+      }
+      next_wake_us_ = heap_.empty() ? INT64_MAX : heap_.top().when_us;
+      if (next_wake_us_ == INT64_MAX) {
+        cv_.wait(lk);
+      } else {
+        cv_.wait_for(lk, std::chrono::microseconds(next_wake_us_ - now));
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  std::priority_queue<HeapItem, std::vector<HeapItem>, std::greater<>> heap_;
+  std::unordered_map<TimerId, TimerEntry> entries_;
+  TimerId next_id_ = 0;
+  int64_t next_wake_us_ = INT64_MAX;
+  std::thread worker_;
+};
+
+}  // namespace
+
+TimerId timer_add(int64_t abstime_us, void (*fn)(void*), void* arg) {
+  return TimerThread::get().add(abstime_us, fn, arg);
+}
+
+int timer_cancel(TimerId id) { return TimerThread::get().cancel(id); }
+
+}  // namespace brt

@@ -1,0 +1,20 @@
+// Dedicated timer pthread driving RPC deadlines, backup-request timers and
+// fiber sleeps (reference: src/bthread/timer_thread.h:53).
+#pragma once
+
+#include <cstdint>
+
+namespace brt {
+
+using TimerId = uint64_t;
+constexpr TimerId kInvalidTimerId = 0;
+
+// Schedules fn(arg) at absolute monotonic time (us). Thread-safe.
+TimerId timer_add(int64_t abstime_us, void (*fn)(void*), void* arg);
+
+// Cancels the timer. If the callback is currently running, BLOCKS until it
+// finishes (so callers may free state the callback touches right after).
+// Returns 0 if cancelled before running, 1 if it already ran / unknown id.
+int timer_cancel(TimerId id);
+
+}  // namespace brt

@@ -1,0 +1,84 @@
+// Chase-Lev work-stealing deque: owner pushes/pops at bottom, thieves steal
+// at top with CAS.  Parity target: reference src/bthread/work_stealing_queue.h:32
+// (same algorithm family; written from the published Chase-Lev/Le et al.
+// memory-model treatment).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+#include "base/logging.h"
+
+namespace brt {
+
+template <typename T>
+class WorkStealingQueue {
+ public:
+  explicit WorkStealingQueue(size_t capacity_pow2 = 4096)
+      : cap_(capacity_pow2), mask_(capacity_pow2 - 1),
+        buf_(new std::atomic<T>[capacity_pow2]) {
+    BRT_CHECK((cap_ & mask_) == 0) << "capacity must be a power of 2";
+  }
+  ~WorkStealingQueue() { delete[] buf_; }
+
+  // Owner only. Returns false when full.
+  bool push(T v) {
+    uint64_t b = bottom_.load(std::memory_order_relaxed);
+    uint64_t t = top_.load(std::memory_order_acquire);
+    if (b - t >= cap_) return false;
+    buf_[b & mask_].store(v, std::memory_order_relaxed);
+    bottom_.store(b + 1, std::memory_order_release);
+    return true;
+  }
+
+  // Owner only.
+  bool pop(T* out) {
+    uint64_t b = bottom_.load(std::memory_order_relaxed);
+    uint64_t t = top_.load(std::memory_order_relaxed);
+    if (t >= b) return false;
+    b -= 1;
+    bottom_.store(b, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    t = top_.load(std::memory_order_relaxed);
+    if (t > b) {  // emptied by thieves
+      bottom_.store(b + 1, std::memory_order_relaxed);
+      return false;
+    }
+    *out = buf_[b & mask_].load(std::memory_order_relaxed);
+    if (t == b) {  // last element: race with thieves
+      if (!top_.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                        std::memory_order_relaxed)) {
+        bottom_.store(b + 1, std::memory_order_relaxed);
+        return false;
+      }
+      bottom_.store(b + 1, std::memory_order_relaxed);
+    }
+    return true;
+  }
+
+  // Any thread.
+  bool steal(T* out) {
+    uint64_t t = top_.load(std::memory_order_acquire);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    uint64_t b = bottom_.load(std::memory_order_acquire);
+    if (t >= b) return false;
+    *out = buf_[t & mask_].load(std::memory_order_relaxed);
+    return top_.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                        std::memory_order_relaxed);
+  }
+
+  size_t approx_size() const {
+    uint64_t b = bottom_.load(std::memory_order_relaxed);
+    uint64_t t = top_.load(std::memory_order_relaxed);
+    return b > t ? size_t(b - t) : 0;
+  }
+
+ private:
+  const uint64_t cap_;
+  const uint64_t mask_;
+  std::atomic<T>* buf_;
+  alignas(64) std::atomic<uint64_t> top_{0};
+  alignas(64) std::atomic<uint64_t> bottom_{0};
+};
+
+}  // namespace brt

@@ -1,0 +1,164 @@
+// Scheduler / butex / sync smoke + stress tests (assert-based; mirrors the
+// reference's test/bthread_*unittest.cpp coverage at smaller scale).
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <vector>
+
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+
+using namespace brt;
+
+static std::atomic<int> g_counter{0};
+
+static void* inc_fn(void*) {
+  g_counter.fetch_add(1);
+  return nullptr;
+}
+
+static void test_start_join() {
+  g_counter = 0;
+  std::vector<fiber_t> tids(1000);
+  for (auto& t : tids) assert(fiber_start(&t, inc_fn, nullptr) == 0);
+  for (auto& t : tids) fiber_join(t);
+  assert(g_counter.load() == 1000);
+  printf("test_start_join ok\n");
+}
+
+static void test_urgent_from_fiber() {
+  g_counter = 0;
+  fiber_t outer;
+  fiber_start(&outer, [](void*) -> void* {
+    fiber_t inner;
+    fiber_start_urgent(&inner, inc_fn, nullptr);
+    fiber_join(inner);
+    g_counter.fetch_add(10);
+    return nullptr;
+  }, nullptr);
+  fiber_join(outer);
+  assert(g_counter.load() == 11);
+  printf("test_urgent_from_fiber ok\n");
+}
+
+static void test_yield_pingpong() {
+  static std::atomic<int> turns{0};
+  auto fn = [](void*) -> void* {
+    for (int i = 0; i < 1000; ++i) {
+      turns.fetch_add(1);
+      fiber_yield();
+    }
+    return nullptr;
+  };
+  fiber_t a, b;
+  fiber_start(&a, fn, nullptr);
+  fiber_start(&b, fn, nullptr);
+  fiber_join(a);
+  fiber_join(b);
+  assert(turns.load() == 2000);
+  printf("test_yield_pingpong ok\n");
+}
+
+static void test_usleep() {
+  fiber_t t;
+  int64_t start = monotonic_us();
+  fiber_start(&t, [](void*) -> void* {
+    fiber_usleep(20000);
+    return nullptr;
+  }, nullptr);
+  fiber_join(t);
+  int64_t el = monotonic_us() - start;
+  assert(el >= 18000);
+  printf("test_usleep ok (%lldus)\n", (long long)el);
+}
+
+static void test_stop_interrupts_sleep() {
+  fiber_t t;
+  fiber_start(&t, [](void*) -> void* {
+    int rc = fiber_usleep(10 * 1000 * 1000);
+    assert(rc == EINTR);
+    return nullptr;
+  }, nullptr);
+  fiber_usleep(50000);
+  int64_t start = monotonic_us();
+  fiber_stop(t);
+  fiber_join(t);
+  assert(monotonic_us() - start < 1000000);
+  printf("test_stop_interrupts_sleep ok\n");
+}
+
+static void test_mutex_stress() {
+  static FiberMutex mu;
+  static int64_t shared = 0;
+  constexpr int kFibers = 16;
+  constexpr int kIters = 10000;
+  std::vector<fiber_t> tids(kFibers);
+  for (auto& t : tids) {
+    fiber_start(&t, [](void*) -> void* {
+      for (int i = 0; i < kIters; ++i) {
+        mu.lock();
+        ++shared;
+        mu.unlock();
+      }
+      return nullptr;
+    }, nullptr);
+  }
+  for (auto& t : tids) fiber_join(t);
+  assert(shared == int64_t(kFibers) * kIters);
+  printf("test_mutex_stress ok\n");
+}
+
+static void test_countdown_from_pthread() {
+  // non-worker thread waits; fibers signal
+  CountdownEvent ev(8);
+  for (int i = 0; i < 8; ++i) {
+    fiber_t t;
+    fiber_start(&t, [](void* arg) -> void* {
+      fiber_usleep(1000);
+      static_cast<CountdownEvent*>(arg)->signal();
+      return nullptr;
+    }, &ev);
+  }
+  assert(ev.wait(2000000) == 0);
+  printf("test_countdown_from_pthread ok\n");
+}
+
+static void test_cond() {
+  static FiberMutex mu;
+  static FiberCond cond;
+  static int stage = 0;
+  fiber_t t;
+  fiber_start(&t, [](void*) -> void* {
+    mu.lock();
+    while (stage == 0) cond.wait(mu);
+    stage = 2;
+    mu.unlock();
+    cond.notify_all();
+    return nullptr;
+  }, nullptr);
+  fiber_usleep(10000);
+  mu.lock();
+  stage = 1;
+  mu.unlock();
+  cond.notify_all();
+  mu.lock();
+  while (stage != 2) cond.wait(mu);
+  mu.unlock();
+  fiber_join(t);
+  printf("test_cond ok\n");
+}
+
+int main() {
+  fiber_init(4);
+  test_start_join();
+  test_urgent_from_fiber();
+  test_yield_pingpong();
+  test_usleep();
+  test_stop_interrupts_sleep();
+  test_mutex_stress();
+  test_countdown_from_pthread();
+  test_cond();
+  printf("ALL FIBER TESTS PASSED\n");
+  return 0;
+}

@@ -1,0 +1,147 @@
+// ExecutionQueue + fiber_id (correlation id) tests, including the
+// response-vs-timeout race the RPC layer depends on.
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <vector>
+
+#include "fiber/execution_queue.h"
+#include "fiber/fiber.h"
+#include "fiber/fiber_id.h"
+#include "fiber/sync.h"
+
+using namespace brt;
+
+static void test_execution_queue_serial() {
+  static std::atomic<int> concurrent{0};
+  static std::atomic<int> max_concurrent{0};
+  static std::atomic<long> sum{0};
+  ExecutionQueue<int> q;
+  q.start(
+      [](void*, ExecutionQueue<int>::TaskIterator& it) -> int {
+        int c = concurrent.fetch_add(1) + 1;
+        int m = max_concurrent.load();
+        while (c > m && !max_concurrent.compare_exchange_weak(m, c)) {
+        }
+        for (; it.valid(); ++it) sum.fetch_add(*it);
+        concurrent.fetch_sub(1);
+        return 0;
+      },
+      nullptr);
+  constexpr int kProducers = 8, kEach = 2000;
+  std::vector<fiber_t> tids(kProducers);
+  for (auto& t : tids) {
+    fiber_start(&t, [](void* arg) -> void* {
+      auto* qq = static_cast<ExecutionQueue<int>*>(arg);
+      for (int i = 1; i <= kEach; ++i) qq->execute(i);
+      return nullptr;
+    }, &q);
+  }
+  for (auto& t : tids) fiber_join(t);
+  q.stop();
+  q.join();
+  assert(max_concurrent.load() == 1);  // single consumer at a time
+  assert(sum.load() == long(kProducers) * kEach * (kEach + 1) / 2);
+  printf("test_execution_queue_serial ok\n");
+}
+
+struct RpcState {
+  std::atomic<int> errors_seen{0};
+  std::atomic<int> last_error{0};
+};
+
+static int rpc_on_error(fid_t id, void* data, int ec) {
+  auto* st = static_cast<RpcState*>(data);
+  st->errors_seen.fetch_add(1);
+  st->last_error.store(ec);
+  return fid_unlock_and_destroy(id);
+}
+
+static void test_fid_error_unlocked() {
+  RpcState st;
+  fid_t id;
+  fid_create(&id, &st, rpc_on_error);
+  assert(fid_error(id, 42) == 0);  // runs handler inline, destroys
+  assert(st.errors_seen.load() == 1);
+  assert(st.last_error.load() == 42);
+  assert(fid_error(id, 43) == EINVAL);  // stale
+  assert(fid_lock(id, nullptr) == EINVAL);
+  fid_join(id);  // returns immediately
+  printf("test_fid_error_unlocked ok\n");
+}
+
+static void test_fid_error_while_locked_queues() {
+  RpcState st;
+  fid_t id;
+  fid_create(&id, &st, rpc_on_error);
+  void* data;
+  assert(fid_lock(id, &data) == 0);
+  assert(data == &st);
+  assert(fid_error(id, 7) == 0);  // queued (we hold the lock)
+  assert(st.errors_seen.load() == 0);
+  assert(fid_unlock(id) == 0);  // dequeues error → handler → destroy
+  assert(st.errors_seen.load() == 1);
+  assert(st.last_error.load() == 7);
+  assert(fid_lock(id, nullptr) == EINVAL);
+  printf("test_fid_error_while_locked_queues ok\n");
+}
+
+static void test_fid_join_waits() {
+  RpcState st;
+  static fid_t id;
+  fid_create(&id, &st, rpc_on_error);
+  void* data;
+  fid_lock(id, &data);
+  fiber_t t;
+  fiber_start(&t, [](void*) -> void* {
+    fiber_usleep(30000);
+    fid_unlock_and_destroy(id);
+    return nullptr;
+  }, nullptr);
+  fid_join(id);  // must block ~30ms then return
+  assert(fid_lock(id, nullptr) == EINVAL);
+  fiber_join(t);
+  printf("test_fid_join_waits ok\n");
+}
+
+static void test_fid_lock_contention() {
+  static std::atomic<int> holders{0};
+  static std::atomic<int> total{0};
+  RpcState st;
+  fid_t id;
+  fid_create(&id, &st, rpc_on_error);
+  constexpr int kFibers = 8;
+  static fid_t gid;
+  gid = id;
+  std::vector<fiber_t> tids(kFibers);
+  for (auto& t : tids) {
+    fiber_start(&t, [](void*) -> void* {
+      for (int i = 0; i < 200; ++i) {
+        if (fid_lock(gid, nullptr) != 0) return nullptr;
+        int h = holders.fetch_add(1);
+        assert(h == 0);
+        total.fetch_add(1);
+        holders.fetch_sub(1);
+        fid_unlock(gid);
+      }
+      return nullptr;
+    }, nullptr);
+  }
+  for (auto& t : tids) fiber_join(t);
+  assert(total.load() == kFibers * 200);
+  void* d;
+  fid_lock(id, &d);
+  fid_unlock_and_destroy(id);
+  printf("test_fid_lock_contention ok\n");
+}
+
+int main() {
+  fiber_init(4);
+  test_execution_queue_serial();
+  test_fid_error_unlocked();
+  test_fid_error_while_locked_queues();
+  test_fid_join_waits();
+  test_fid_lock_contention();
+  printf("ALL FIBER2 TESTS PASSED\n");
+  return 0;
+}
