@@ -189,7 +189,9 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
     cast = lambda t: jax.tree_util.tree_map(  # noqa: E731
         lambda a: a.astype(cfg.dtype), t
     )
-    x = cast(params["embed"])[tokens]
+    # Gather rows first, THEN cast: avoids materializing a full bf16 copy of
+    # the [vocab, hidden] table (≈1GB at 128k vocab) just to read B*T rows.
+    x = params["embed"][tokens].astype(cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
 
     def body(x, lp):

@@ -143,8 +143,6 @@ void IOBuf::append(IOBuf&& other) {
     return;
   }
   for (const auto& r : other.refs_) push_ref(r);  // transfer refs
-  size_t moved = other.size_;
-  (void)moved;
   other.refs_.clear();
   other.size_ = 0;
 }
@@ -322,7 +320,9 @@ ssize_t IOPortal::append_from_fd(int fd, size_t max_read) {
   Block* blocks[kMaxIov];
   int cnt = 0;
   size_t want = 0;
+  bool used_partial = false;
   if (partial_ && partial_->size < partial_->cap) {
+    used_partial = true;
     blocks[cnt] = partial_;
     iov[cnt].iov_base = partial_->data + partial_->size;
     iov[cnt].iov_len = partial_->cap - partial_->size;
@@ -338,7 +338,10 @@ ssize_t IOPortal::append_from_fd(int fd, size_t max_read) {
     ++cnt;
   }
   ssize_t nr = ::readv(fd, iov, cnt);
-  int start = (partial_ != nullptr) ? 1 : 0;
+  // partial_ may be non-null yet NOT in iov[0] (it was already full, e.g.
+  // after an in-place append extended it to cap): only skip slot 0 in the
+  // fresh-block cleanup when the partial actually occupies it.
+  int start = used_partial ? 1 : 0;
   if (nr <= 0) {
     // return fresh blocks to the pool; keep partial_
     for (int i = start; i < cnt; ++i) block_unref(blocks[i]);

@@ -173,18 +173,30 @@ int butex_wait_pthread(Butex* b, int expected, int64_t timeout_us) {
       return EWOULDBLOCK;
     b->push_back(&w);
   }
-  timespec ts;
-  timespec* tsp = nullptr;
-  if (timeout_us >= 0) {
-    ts.tv_sec = timeout_us / 1000000;
-    ts.tv_nsec = (timeout_us % 1000000) * 1000;
-    tsp = &ts;
-  }
+  // Absolute deadline so spurious wakes / EINTR don't restart the clock.
+  const int64_t deadline_us =
+      timeout_us >= 0 ? monotonic_us() + timeout_us : -1;
   for (;;) {
     if (w.futex_word.load(std::memory_order_acquire) != 0) return w.result;
-    long rc = waiter_futex(&w.futex_word, FUTEX_WAIT_PRIVATE, 0, tsp);
+    timespec ts;
+    timespec* tsp = nullptr;
+    bool deadline_hit = false;
+    if (deadline_us >= 0) {
+      int64_t left = deadline_us - monotonic_us();
+      if (left <= 0) {
+        deadline_hit = true;
+      } else {
+        ts.tv_sec = left / 1000000;
+        ts.tv_nsec = (left % 1000000) * 1000;
+        tsp = &ts;
+      }
+    }
+    long rc = -1;
+    if (!deadline_hit) {
+      rc = waiter_futex(&w.futex_word, FUTEX_WAIT_PRIVATE, 0, tsp);
+    }
     if (w.futex_word.load(std::memory_order_acquire) != 0) return w.result;
-    if (rc == -1 && errno == ETIMEDOUT) {
+    if (deadline_hit || (rc == -1 && errno == ETIMEDOUT)) {
       // Try to withdraw; a racing waker that already popped us will set the
       // futex word soon — spin for it so our frame stays valid.
       {

@@ -32,7 +32,8 @@ class ExecutionQueue {
   using ExecuteFn = int (*)(void* meta, TaskIterator& iter);
 
   ExecutionQueue() : stub_(new Node), joined_(1) {
-    head_.store(stub_, std::memory_order_relaxed);
+    head_.store(reinterpret_cast<uintptr_t>(stub_),
+                std::memory_order_relaxed);
     tail_ = stub_;
   }
 
@@ -55,16 +56,21 @@ class ExecutionQueue {
 
   // Thread-safe. Returns EINVAL after stop().
   int execute(T value) {
-    if (stopping_.load(std::memory_order_acquire)) return EINVAL;
-    push(new Node(std::move(value), false));
+    Node* n = new Node(std::move(value), false);
+    if (!push(n, /*stop_bit=*/false)) {
+      delete n;
+      return EINVAL;
+    }
     return 0;
   }
 
   // No more execute()s accepted; consumer drains remaining then exits.
+  // The stop decision rides the head word itself (low tag bit), so a
+  // producer can never slip a task in after the stop sentinel — once join()
+  // returns, no consumer will run again.
   int stop() {
-    bool expected = false;
-    if (!stopping_.compare_exchange_strong(expected, true)) return 0;
-    push(new Node(T{}, true));
+    Node* s = new Node(T{}, true);
+    if (!push(s, /*stop_bit=*/true)) delete s;  // already stopped
     return 0;
   }
 
@@ -84,9 +90,17 @@ class ExecutionQueue {
   };
   friend class TaskIterator;
 
-  void push(Node* n) {
+  // Returns false (without linking n) if the queue was already stopped.
+  bool push(Node* n, bool stop_bit) {
     BRT_CHECK(started_) << "ExecutionQueue not started";
-    Node* prev = head_.exchange(n, std::memory_order_acq_rel);
+    uintptr_t h = head_.load(std::memory_order_relaxed);
+    uintptr_t tagged = reinterpret_cast<uintptr_t>(n) | uintptr_t(stop_bit);
+    do {
+      if (h & 1) return false;  // stopped
+    } while (!head_.compare_exchange_weak(h, tagged,
+                                          std::memory_order_acq_rel,
+                                          std::memory_order_relaxed));
+    Node* prev = reinterpret_cast<Node*>(h);
     prev->next.store(n, std::memory_order_release);
     // Become the consumer if idle.
     int expected = 0;
@@ -95,6 +109,7 @@ class ExecutionQueue {
       fiber_t tid;
       fiber_start(&tid, &ExecutionQueue::consume_entry, this);
     }
+    return true;
   }
 
   static void* consume_entry(void* arg) {
@@ -151,10 +166,9 @@ class ExecutionQueue {
     }
   }
 
-  std::atomic<Node*> head_;  // producers swing this
-  Node* tail_;               // consumer-only (current stub)
+  std::atomic<uintptr_t> head_;  // producers swing this; bit0 = stopped
+  Node* tail_;                   // consumer-only (current stub)
   std::atomic<int> running_{0};
-  std::atomic<bool> stopping_{false};
   bool started_ = false;
   ExecuteFn fn_ = nullptr;
   void* meta_ = nullptr;

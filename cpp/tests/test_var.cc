@@ -1,0 +1,131 @@
+// Unit tests for the var (metrics) layer — model: reference
+// test/bvar_reducer_unittest.cpp, bvar_variable_unittest.cpp.
+#include <cassert>
+#include <cstdio>
+#include <sstream>
+#include <thread>
+#include <vector>
+
+#include "var/latency_recorder.h"
+#include "var/reducer.h"
+#include "var/variable.h"
+#include "var/window.h"
+
+using namespace brt::var;
+
+static void test_adder_concurrent() {
+  Adder<int64_t> a;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 8; ++t) {
+    ts.emplace_back([&a] {
+      for (int i = 0; i < 100000; ++i) a << 1;
+    });
+  }
+  for (auto& t : ts) t.join();
+  assert(a.get_value() == 800000);
+  printf("adder_concurrent OK\n");
+}
+
+static void test_maxer_miner() {
+  Maxer<int64_t> mx;
+  Miner<int64_t> mn;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; ++t) {
+    ts.emplace_back([&, t] {
+      for (int i = 0; i < 1000; ++i) {
+        mx << (t * 1000 + i);
+        mn << (t * 1000 + i);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  assert(mx.get_value() == 3999);
+  assert(mn.get_value() == 0);
+  printf("maxer_miner OK\n");
+}
+
+static void test_registry_dump() {
+  Adder<int64_t> a;
+  a << 42;
+  a.expose("test_dump_counter");
+  Status<double> s(2.5);
+  s.expose("test_dump_status");
+  int found = 0;
+  Variable::dump_exposed(
+      [&](const std::string& name, const std::string& val) {
+        if (name == "test_dump_counter") {
+          assert(val == "42");
+          ++found;
+        }
+        if (name == "test_dump_status") {
+          assert(val == "2.5");
+          ++found;
+        }
+      },
+      "test_dump");
+  assert(found == 2);
+  std::ostringstream prom;
+  Variable::dump_prometheus(prom);
+  assert(prom.str().find("test_dump_counter 42") != std::string::npos);
+  a.hide();
+  found = 0;
+  Variable::dump_exposed(
+      [&](const std::string&, const std::string&) { ++found; }, "test_dump");
+  assert(found == 1);
+  printf("registry_dump OK\n");
+}
+
+static void test_window() {
+  Adder<int64_t> a;
+  Window<Adder<int64_t>> w(&a, 3);
+  PerSecond<Adder<int64_t>> ps(&a, 3);
+  for (int i = 0; i < 5; ++i) {
+    a << 10;
+    sampler_tick_for_test();
+  }
+  // After 5 ticks of +10/s with window 3, windowed delta = 30, per-second 10.
+  assert(w.get_value() == 30);
+  assert(ps.get_value() == 10);
+  printf("window OK\n");
+}
+
+static void test_latency_recorder() {
+  LatencyRecorder lr(10);
+  for (int i = 1; i <= 1000; ++i) lr << i;
+  sampler_tick_for_test();
+  assert(lr.count() == 1000);
+  assert(lr.max_latency() == 1000);
+  assert(lr.qps() == 1000);
+  int64_t p50 = lr.latency_percentile(0.5);
+  assert(p50 > 300 && p50 < 700);
+  int64_t p99 = lr.latency_percentile(0.99);
+  assert(p99 > 900);
+  assert(lr.latency() >= 400 && lr.latency() <= 600);
+  lr.expose("test_lr");
+  bool has_qps = false;
+  Variable::dump_exposed(
+      [&](const std::string& n, const std::string&) {
+        if (n == "test_lr_qps") has_qps = true;
+      },
+      "test_lr");
+  assert(has_qps);
+  printf("latency_recorder OK\n");
+}
+
+static void test_thread_exit_residual() {
+  Adder<int64_t> a;
+  std::thread([&a] { a << 7; }).join();
+  assert(a.get_value() == 7);  // agent retired into residual
+  printf("thread_exit_residual OK\n");
+}
+
+int main() {
+  test_adder_concurrent();
+  test_maxer_miner();
+  test_registry_dump();
+  test_window();
+  test_latency_recorder();
+  test_thread_exit_residual();
+  printf("test_var: ALL OK\n");
+  return 0;
+}

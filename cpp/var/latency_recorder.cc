@@ -1,0 +1,55 @@
+#include "var/latency_recorder.h"
+
+namespace brt {
+namespace var {
+
+namespace {
+// A PassiveStatus closure over a member getter.
+class RecorderStat : public Variable {
+ public:
+  using Getter = int64_t (*)(const LatencyRecorder*);
+  RecorderStat(const LatencyRecorder* r, Getter g) : r_(r), g_(g) {}
+  void describe(std::ostream& os) const override { os << g_(r_); }
+
+ private:
+  const LatencyRecorder* r_;
+  Getter g_;
+};
+}  // namespace
+
+int LatencyRecorder::expose(const std::string& prefix) {
+  hide();
+  struct Entry {
+    const char* suffix;
+    RecorderStat::Getter getter;
+  };
+  static const Entry kEntries[] = {
+      {"_qps", [](const LatencyRecorder* r) { return r->qps(); }},
+      {"_count", [](const LatencyRecorder* r) { return r->count(); }},
+      {"_latency", [](const LatencyRecorder* r) { return r->latency(); }},
+      {"_latency_p50",
+       [](const LatencyRecorder* r) { return r->latency_percentile(0.5); }},
+      {"_latency_p90",
+       [](const LatencyRecorder* r) { return r->latency_percentile(0.9); }},
+      {"_latency_p99",
+       [](const LatencyRecorder* r) { return r->latency_percentile(0.99); }},
+      {"_latency_p999",
+       [](const LatencyRecorder* r) { return r->latency_percentile(0.999); }},
+      {"_max_latency",
+       [](const LatencyRecorder* r) { return r->max_latency(); }},
+  };
+  for (const Entry& e : kEntries) {
+    auto* v = new RecorderStat(this, e.getter);
+    v->expose(prefix + e.suffix);
+    exposed_.push_back(v);
+  }
+  return 0;
+}
+
+void LatencyRecorder::hide() {
+  for (Variable* v : exposed_) delete v;  // ~Variable() unregisters
+  exposed_.clear();
+}
+
+}  // namespace var
+}  // namespace brt

@@ -1,0 +1,87 @@
+// Bundled latency/qps/percentile recorder: one `<< latency_us` feeds qps,
+// count, avg latency, p50/p90/p99/p999 and max over a trailing window.
+// Parity target: reference src/bvar/latency_recorder.h:49-75.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "var/percentile.h"
+#include "var/reducer.h"
+#include "var/sampler.h"
+#include "var/window.h"
+
+namespace brt {
+namespace var {
+
+class LatencyRecorder : public Sampler {
+ public:
+  explicit LatencyRecorder(int window_size = 10) : window_(window_size) {
+    schedule();
+  }
+
+  LatencyRecorder& operator<<(int64_t latency_us) {
+    count_ << 1;
+    latency_sum_ << latency_us;
+    max_latency_ << latency_us;
+    percentile_.record(latency_us);
+    return *this;
+  }
+
+  // Requests per second over the window.
+  int64_t qps() const {
+    std::lock_guard<std::mutex> g(mu_);
+    if (seconds_ == 0) return 0;
+    return (count_.get_value() - count_at_window_start_) / seconds_;
+  }
+
+  int64_t count() const { return count_.get_value(); }
+
+  // Mean latency over the window (us).
+  int64_t latency() const {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t dc = count_.get_value() - count_at_window_start_;
+    if (dc <= 0) return 0;
+    return (latency_sum_.get_value() - sum_at_window_start_) / dc;
+  }
+
+  int64_t latency_percentile(double p) const { return percentile_.get(p); }
+  int64_t max_latency() const {
+    int64_t m = max_latency_.get_value();
+    return m == INT64_MIN ? 0 : m;
+  }
+
+  void take_sample() override {
+    std::lock_guard<std::mutex> g(mu_);
+    ++seconds_;
+    if (seconds_ > window_) {
+      // Slide: approximate trailing window by restarting each `window_`
+      // seconds (cheap and adequate for /status-grade numbers).
+      count_at_window_start_ = count_.get_value();
+      sum_at_window_start_ = latency_sum_.get_value();
+      percentile_.reset();
+      max_latency_.reset();
+      seconds_ = 1;
+    }
+  }
+
+  // Exposes sub-vars as <prefix>_qps, <prefix>_latency, <prefix>_latency_p99…
+  int expose(const std::string& prefix);
+  void hide();
+  ~LatencyRecorder() override { hide(); }
+
+ private:
+  Adder<int64_t> count_;
+  Adder<int64_t> latency_sum_;
+  Maxer<int64_t> max_latency_;
+  Percentile percentile_;
+  int window_;
+  mutable std::mutex mu_;
+  int64_t seconds_ = 0;
+  int64_t count_at_window_start_ = 0;
+  int64_t sum_at_window_start_ = 0;
+  std::vector<Variable*> exposed_;
+};
+
+}  // namespace var
+}  // namespace brt

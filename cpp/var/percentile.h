@@ -1,0 +1,65 @@
+// Reservoir-sampled latency distribution.
+// Parity target: reference src/bvar/detail/percentile.h:446. Redesigned: one
+// mutex-guarded reservoir per interval (the write rate is per-RPC, and the
+// LatencyRecorder in front of it batches through thread-local Adders; the
+// reference's lock-free TLS agents are overkill at our write rates).
+#pragma once
+
+#include <algorithm>
+#include <array>
+#include <cstdint>
+#include <mutex>
+#include <random>
+#include <vector>
+
+namespace brt {
+namespace var {
+
+class Percentile {
+ public:
+  static constexpr size_t kReservoir = 1024;
+
+  void record(int64_t value_us) {
+    std::lock_guard<std::mutex> g(mu_);
+    ++count_;
+    if (samples_.size() < kReservoir) {
+      samples_.push_back(value_us);
+    } else {
+      // Vitter's algorithm R.
+      uint64_t j = rng_() % count_;
+      if (j < kReservoir) samples_[j] = value_us;
+    }
+  }
+
+  // p in (0,1]. Returns 0 when empty.
+  int64_t get(double p) const {
+    std::lock_guard<std::mutex> g(mu_);
+    if (samples_.empty()) return 0;
+    std::vector<int64_t> s = samples_;
+    size_t idx = size_t(p * s.size());
+    if (idx >= s.size()) idx = s.size() - 1;
+    std::nth_element(s.begin(), s.begin() + idx, s.end());
+    return s[idx];
+  }
+
+  uint64_t count() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return count_;
+  }
+
+  // Merge-and-clear into a cumulative interval (used on window rotation).
+  void reset() {
+    std::lock_guard<std::mutex> g(mu_);
+    samples_.clear();
+    count_ = 0;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::vector<int64_t> samples_;
+  uint64_t count_ = 0;
+  mutable std::minstd_rand rng_{12345};
+};
+
+}  // namespace var
+}  // namespace brt

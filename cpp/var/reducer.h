@@ -1,0 +1,161 @@
+// Write-local-read-combine counters: each thread writes its own cache-line-
+// private agent cell; reads sweep all agents. O(1) contention-free writes.
+// Parity target: reference src/bvar/reducer.h:224 (Adder/Maxer/Miner) +
+// detail/agent_group.h. Redesigned: agents live in a per-reducer list guarded
+// by a mutex taken only on first-touch / thread-exit / read, with each
+// thread's agent found through a small TLS cache (same trick as
+// DoublyBufferedData).
+#pragma once
+
+#include <atomic>
+#include <limits>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "var/variable.h"
+
+namespace brt {
+namespace var {
+
+// Op must provide: static T identity(); static T combine(T, T);
+// static T apply(T current, T delta)  (what a write does to the local cell).
+template <typename T, typename Op>
+class Reducer : public Variable {
+ public:
+  Reducer() = default;
+  ~Reducer() override {
+    hide();
+    std::lock_guard<std::mutex> g(mu_);
+    for (Agent* a : agents_) a->owner = nullptr;
+  }
+
+  Reducer& operator<<(T delta) {
+    Agent* a = tls_agent();
+    // Single-writer cell: relaxed RMW is enough; readers see it via the
+    // acquire sweep in get_value().
+    T cur = a->value.load(std::memory_order_relaxed);
+    a->value.store(Op::apply(cur, delta), std::memory_order_relaxed);
+    return *this;
+  }
+
+  T get_value() const {
+    std::lock_guard<std::mutex> g(mu_);
+    T v = residual_;
+    for (Agent* a : agents_)
+      v = Op::combine(v, a->value.load(std::memory_order_acquire));
+    return v;
+  }
+
+  // Combined value, then all cells reset to identity (used by Window samples
+  // on reset-style reducers; races lose at most in-flight deltas).
+  T reset() {
+    std::lock_guard<std::mutex> g(mu_);
+    T v = residual_;
+    residual_ = Op::identity();
+    for (Agent* a : agents_)
+      v = Op::combine(v, a->value.exchange(Op::identity(),
+                                           std::memory_order_acq_rel));
+    return v;
+  }
+
+  void describe(std::ostream& os) const override { os << get_value(); }
+
+ private:
+  struct Agent {
+    std::atomic<T> value{Op::identity()};
+    Reducer* owner = nullptr;
+    ~Agent() {
+      if (owner) owner->retire(this);
+    }
+  };
+
+  void retire(Agent* a) {
+    std::lock_guard<std::mutex> g(mu_);
+    residual_ =
+        Op::combine(residual_, a->value.load(std::memory_order_acquire));
+    for (size_t i = 0; i < agents_.size(); ++i) {
+      if (agents_[i] == a) {
+        agents_[i] = agents_.back();
+        agents_.pop_back();
+        break;
+      }
+    }
+  }
+
+  Agent* tls_agent() {
+    thread_local std::vector<std::pair<Reducer*, std::unique_ptr<Agent>>>
+        cache;
+    for (auto& [o, a] : cache)
+      if (o == this) return a.get();
+    auto a = std::make_unique<Agent>();
+    a->owner = this;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      agents_.push_back(a.get());
+    }
+    cache.emplace_back(this, std::move(a));
+    return cache.back().second.get();
+  }
+
+  mutable std::mutex mu_;
+  std::vector<Agent*> agents_;
+  T residual_ = Op::identity();
+};
+
+template <typename T>
+struct AddOp {
+  static T identity() { return T(); }
+  static T combine(T a, T b) { return a + b; }
+  static T apply(T cur, T d) { return cur + d; }
+};
+template <typename T>
+struct MaxOp {
+  static T identity() { return std::numeric_limits<T>::lowest(); }
+  static T combine(T a, T b) { return a > b ? a : b; }
+  static T apply(T cur, T d) { return cur > d ? cur : d; }
+};
+template <typename T>
+struct MinOp {
+  static T identity() { return std::numeric_limits<T>::max(); }
+  static T combine(T a, T b) { return a < b ? a : b; }
+  static T apply(T cur, T d) { return cur < d ? cur : d; }
+};
+
+template <typename T>
+using Adder = Reducer<T, AddOp<T>>;
+template <typename T>
+using Maxer = Reducer<T, MaxOp<T>>;
+template <typename T>
+using Miner = Reducer<T, MinOp<T>>;
+
+// Value computed on demand by a callback (reference bvar::PassiveStatus).
+template <typename T>
+class PassiveStatus : public Variable {
+ public:
+  using Fn = T (*)(void*);
+  PassiveStatus(Fn fn, void* arg) : fn_(fn), arg_(arg) {}
+  T get_value() const { return fn_(arg_); }
+  void describe(std::ostream& os) const override { os << get_value(); }
+
+ private:
+  Fn fn_;
+  void* arg_;
+};
+
+// Plain exposed value (reference bvar::Status).
+template <typename T>
+class Status : public Variable {
+ public:
+  Status() = default;
+  explicit Status(T v) : value_(v) {}
+  void set_value(T v) { value_.store(v, std::memory_order_relaxed); }
+  T get_value() const { return value_.load(std::memory_order_relaxed); }
+  void describe(std::ostream& os) const override { os << get_value(); }
+
+ private:
+  std::atomic<T> value_{};
+};
+
+}  // namespace var
+}  // namespace brt

@@ -1,0 +1,69 @@
+#include "var/sampler.h"
+
+#include <chrono>
+#include <condition_variable>
+#include <thread>
+
+namespace brt {
+namespace var {
+
+namespace {
+struct Collector {
+  std::mutex mu;
+  std::vector<Sampler*> samplers;
+  bool started = false;
+
+  static Collector& singleton() {
+    static Collector* c = new Collector;
+    return *c;
+  }
+
+  void add(Sampler* s) {
+    std::lock_guard<std::mutex> g(mu);
+    samplers.push_back(s);
+    if (!started) {
+      started = true;
+      std::thread([] {
+        for (;;) {
+          std::this_thread::sleep_for(std::chrono::seconds(1));
+          singleton().tick();
+        }
+      }).detach();
+    }
+  }
+
+  void remove(Sampler* s) {
+    std::lock_guard<std::mutex> g(mu);
+    for (size_t i = 0; i < samplers.size(); ++i) {
+      if (samplers[i] == s) {
+        samplers[i] = samplers.back();
+        samplers.pop_back();
+        break;
+      }
+    }
+  }
+
+  void tick() {
+    // take_sample() is cheap and non-blocking by contract, so holding the
+    // mutex across the sweep keeps removal (dtor) race-free.
+    std::lock_guard<std::mutex> g(mu);
+    for (Sampler* s : samplers) s->take_sample();
+  }
+};
+}  // namespace
+
+Sampler::~Sampler() {
+  if (scheduled_) Collector::singleton().remove(this);
+}
+
+void Sampler::schedule() {
+  if (!scheduled_) {
+    scheduled_ = true;
+    Collector::singleton().add(this);
+  }
+}
+
+void sampler_tick_for_test() { Collector::singleton().tick(); }
+
+}  // namespace var
+}  // namespace brt

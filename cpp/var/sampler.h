@@ -1,0 +1,31 @@
+// Global 1Hz sampler thread: owners register and get take_sample() called
+// once per second — powers Window/PerSecond/LatencyRecorder.
+// Parity target: reference src/bvar/detail/sampler.{h,cpp} (SamplerCollector
+// bthread). Redesigned: one std::thread + intrusive list (no bthread
+// dependency, preserving the bvar→butil-only layering).
+#pragma once
+
+#include <mutex>
+#include <vector>
+
+namespace brt {
+namespace var {
+
+class Sampler {
+ public:
+  virtual ~Sampler();
+  virtual void take_sample() = 0;
+
+ protected:
+  // Starts the global sampler thread on first use.
+  void schedule();
+
+ private:
+  bool scheduled_ = false;
+};
+
+// Test hook: run one sampling pass synchronously.
+void sampler_tick_for_test();
+
+}  // namespace var
+}  // namespace brt

@@ -1,0 +1,90 @@
+#include "var/variable.h"
+
+#include <cctype>
+#include <sstream>
+#include <vector>
+
+namespace brt {
+namespace var {
+
+namespace {
+struct Registry {
+  std::mutex mu;
+  std::map<std::string, Variable*> vars;
+};
+Registry& registry() {
+  static Registry* r = new Registry;  // leaked: vars may outlive main()
+  return *r;
+}
+}  // namespace
+
+int Variable::expose(const std::string& name) {
+  hide();
+  auto& r = registry();
+  std::lock_guard<std::mutex> g(r.mu);
+  name_ = name;
+  r.vars[name] = this;
+  return 0;
+}
+
+void Variable::hide() {
+  if (name_.empty()) return;
+  auto& r = registry();
+  std::lock_guard<std::mutex> g(r.mu);
+  auto it = r.vars.find(name_);
+  if (it != r.vars.end() && it->second == this) r.vars.erase(it);
+  name_.clear();
+}
+
+std::string Variable::get_description() const {
+  std::ostringstream os;
+  describe(os);
+  return os.str();
+}
+
+size_t Variable::dump_exposed(
+    const std::function<void(const std::string&, const std::string&)>& cb,
+    const std::string& filter) {
+  // Snapshot names first: describe() may take arbitrary user locks.
+  std::vector<std::pair<std::string, Variable*>> snap;
+  {
+    auto& r = registry();
+    std::lock_guard<std::mutex> g(r.mu);
+    for (auto& [name, v] : r.vars) {
+      if (filter.empty() || name.find(filter) != std::string::npos)
+        snap.emplace_back(name, v);
+    }
+  }
+  size_t n = 0;
+  for (auto& [name, v] : snap) {
+    // Re-verify liveness under the lock before touching the object.
+    std::string desc;
+    {
+      auto& r = registry();
+      std::lock_guard<std::mutex> g(r.mu);
+      auto it = r.vars.find(name);
+      if (it == r.vars.end() || it->second != v) continue;
+      desc = v->get_description();
+    }
+    cb(name, desc);
+    ++n;
+  }
+  return n;
+}
+
+void Variable::dump_prometheus(std::ostream& os) {
+  dump_exposed([&os](const std::string& name, const std::string& value) {
+    if (value.empty()) return;
+    char* end = nullptr;
+    strtod(value.c_str(), &end);
+    if (end == value.c_str() || *end != '\0') return;  // non-numeric
+    std::string metric = name;
+    for (char& c : metric) {
+      if (!isalnum(static_cast<unsigned char>(c)) && c != '_') c = '_';
+    }
+    os << metric << ' ' << value << '\n';
+  });
+}
+
+}  // namespace var
+}  // namespace brt

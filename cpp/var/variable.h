@@ -1,0 +1,46 @@
+// Global name→variable registry with filtered text + Prometheus dumps —
+// the metrics substrate every Socket/method/server accounting hook feeds.
+// Parity target: reference src/bvar/variable.h:102 (Variable::dump_exposed),
+// builtin/prometheus_metrics_service.cpp:207. Redesigned: single mutex-guarded
+// map (exposure is rare; reads of hot counters never touch the registry).
+#pragma once
+
+#include <functional>
+#include <map>
+#include <mutex>
+#include <ostream>
+#include <string>
+
+namespace brt {
+namespace var {
+
+class Variable {
+ public:
+  virtual ~Variable() { hide(); }
+
+  // Prints the current value ("55", "12.3", ...).
+  virtual void describe(std::ostream& os) const = 0;
+
+  // Registers under `name` (replaces any previous exposure of this object).
+  int expose(const std::string& name);
+  void hide();
+  const std::string& name() const { return name_; }
+
+  std::string get_description() const;
+
+  // Invokes cb(name, value_text) for every exposed variable whose name
+  // contains `filter` (empty filter = all), in name order.
+  static size_t dump_exposed(
+      const std::function<void(const std::string&, const std::string&)>& cb,
+      const std::string& filter = "");
+
+  // Prometheus text exposition: one "name value" line per variable, with
+  // [^a-zA-Z0-9_] in names mapped to '_'. Non-numeric variables are skipped.
+  static void dump_prometheus(std::ostream& os);
+
+ private:
+  std::string name_;
+};
+
+}  // namespace var
+}  // namespace brt

@@ -1,0 +1,80 @@
+// Time-windowed views over a Reducer, fed by the 1Hz Sampler.
+// Parity target: reference src/bvar/window.h (Window, PerSecond).
+#pragma once
+
+#include <array>
+#include <cstdint>
+
+#include "var/reducer.h"
+#include "var/sampler.h"
+
+namespace brt {
+namespace var {
+
+// Value accumulated over the trailing `window_size` seconds, for an
+// Adder-like reducer (delta of a monotone sum). Samples a ring of absolute
+// values once per second; value = newest - oldest.
+template <typename R>
+class Window : public Variable, public Sampler {
+ public:
+  static constexpr int kMaxWindow = 120;
+
+  explicit Window(R* reducer, int window_size = 10)
+      : reducer_(reducer),
+        window_(window_size < kMaxWindow ? window_size : kMaxWindow) {
+    samples_.fill(0);
+    schedule();
+  }
+
+  void take_sample() override {
+    std::lock_guard<std::mutex> g(mu_);
+    samples_[pos_ % (window_ + 1)] = int64_t(reducer_->get_value());
+    ++pos_;
+  }
+
+  int64_t get_value() const {
+    std::lock_guard<std::mutex> g(mu_);
+    if (pos_ == 0) return int64_t(reducer_->get_value());
+    int64_t newest = samples_[(pos_ - 1) % (window_ + 1)];
+    if (pos_ <= window_) return newest;  // window not full: baseline is 0
+    // (pos_-1-window_) ≡ pos_ (mod window_+1): the slot about to be reused.
+    return newest - samples_[pos_ % (window_ + 1)];
+  }
+
+  int window_size() const { return window_; }
+  void describe(std::ostream& os) const override { os << get_value(); }
+
+ protected:
+  R* reducer_;
+  int window_;
+  mutable std::mutex mu_;
+  std::array<int64_t, kMaxWindow + 1> samples_{};
+  int pos_ = 0;  // number of samples taken
+};
+
+// Windowed value divided by elapsed seconds (reference bvar::PerSecond).
+template <typename R>
+class PerSecond : public Window<R> {
+ public:
+  explicit PerSecond(R* reducer, int window_size = 10)
+      : Window<R>(reducer, window_size) {}
+
+  int64_t get_value() const {
+    std::lock_guard<std::mutex> g(this->mu_);
+    int n = this->pos_ < this->window_ ? this->pos_ : this->window_;
+    if (n <= 0) return 0;
+    int64_t newest = this->samples_[(this->pos_ - 1) % (this->window_ + 1)];
+    int64_t oldest;
+    if (this->pos_ <= this->window_) {
+      oldest = 0;
+    } else {
+      oldest = this->samples_[this->pos_ % (this->window_ + 1)];
+    }
+    return (newest - oldest) / n;
+  }
+
+  void describe(std::ostream& os) const override { os << get_value(); }
+};
+
+}  // namespace var
+}  // namespace brt
