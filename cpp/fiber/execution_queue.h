@@ -150,8 +150,9 @@ class ExecutionQueue {
         }
         tail_ = last;
         if (saw_stop) {
-          joined_.signal();
+          // running_ first: after joined_ fires the owner may destroy us.
           running_.store(0, std::memory_order_release);
+          joined_.signal();
           return;
         }
         continue;

@@ -1,0 +1,144 @@
+// Transport-layer tests: loopback echo through Acceptor + EventDispatcher +
+// InputMessenger + Socket wait-free writes. Model: reference
+// test/brpc_socket_unittest.cpp (loopback pattern of SURVEY §4).
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "transport/acceptor.h"
+#include "transport/event_dispatcher.h"
+#include "transport/input_messenger.h"
+#include "transport/socket.h"
+
+using namespace brt;
+
+// Fixed-frame test protocol: 4-byte magic "TST0" + 4-byte big-endian length
+// + payload. Server echoes the frame back.
+static ParseResult tst_parse(IOBuf* source, IOBuf* msg, Socket*) {
+  if (source->size() < 8) return ParseResult::NOT_ENOUGH_DATA;
+  char hdr[8];
+  source->copy_to(hdr, 8);
+  if (memcmp(hdr, "TST0", 4) != 0) return ParseResult::TRY_OTHER;
+  uint32_t len = (uint8_t(hdr[4]) << 24) | (uint8_t(hdr[5]) << 16) |
+                 (uint8_t(hdr[6]) << 8) | uint8_t(hdr[7]);
+  if (source->size() < 8 + len) return ParseResult::NOT_ENOUGH_DATA;
+  source->pop_front(8);
+  source->cutn(msg, len);
+  return ParseResult::OK;
+}
+
+static CountdownEvent* g_client_got;
+static std::string g_client_payload;
+static std::atomic<int> g_server_msgs{0};
+
+static void frame(IOBuf* out, const std::string& payload) {
+  char hdr[8] = {'T', 'S', 'T', '0'};
+  uint32_t len = payload.size();
+  hdr[4] = char(len >> 24);
+  hdr[5] = char(len >> 16);
+  hdr[6] = char(len >> 8);
+  hdr[7] = char(len);
+  out->append(hdr, 8);
+  out->append(payload);
+}
+
+// Server side: echo back.
+static void tst_process_server(IOBuf&& msg, SocketId sid) {
+  SocketUniquePtr ptr;
+  if (Socket::Address(sid, &ptr) != 0) return;
+  g_server_msgs.fetch_add(1);
+  IOBuf out;
+  frame(&out, msg.to_string());
+  ptr->Write(&out);
+}
+
+// Client side: record and signal.
+static void tst_process_client(IOBuf&& msg, SocketId) {
+  g_client_payload = msg.to_string();
+  g_client_got->signal();
+}
+
+int g_server_proto, g_client_proto;
+
+static void test_echo_roundtrip(const EndPoint& server_addr) {
+  Socket::Options copts;
+  copts.on_edge_triggered = InputMessengerOnEdgeTriggered;
+  SocketId cid;
+  int rc = Socket::Connect(server_addr, copts, &cid);
+  assert(rc == 0);
+  SocketUniquePtr cptr;
+  assert(Socket::Address(cid, &cptr) == 0);
+  // Force the client socket to parse with the client protocol.
+  cptr->preferred_protocol = g_client_proto;
+
+  CountdownEvent done(1);
+  g_client_got = &done;
+  IOBuf req;
+  frame(&req, "hello transport");
+  assert(cptr->Write(&req) == 0);
+  assert(done.wait(5 * 1000 * 1000) == 0);
+  assert(g_client_payload == "hello transport");
+  printf("echo_roundtrip OK\n");
+
+  // Large payload (multi-block, exercises writev + KeepWrite).
+  std::string big(1 << 20, 'x');
+  for (size_t i = 0; i < big.size(); i += 4096) big[i] = char('a' + (i / 4096) % 26);
+  CountdownEvent done2(1);
+  g_client_got = &done2;
+  IOBuf req2;
+  frame(&req2, big);
+  assert(cptr->Write(&req2) == 0);
+  assert(done2.wait(10 * 1000 * 1000) == 0);
+  assert(g_client_payload == big);
+  printf("echo_large OK\n");
+
+  cptr->SetFailed(ECANCELED, "test done");
+}
+
+static void test_stale_id() {
+  SocketId stale = (uint64_t(99) << 32) | 12345;
+  SocketUniquePtr p;
+  assert(Socket::Address(stale, &p) == EINVAL);
+  printf("stale_id OK\n");
+}
+
+static void test_connect_refused() {
+  Socket::Options opts;
+  SocketId sid;
+  EndPoint dead;
+  EndPoint::parse("127.0.0.1:1", &dead);
+  int rc = Socket::Connect(dead, opts, &sid, 2 * 1000 * 1000);
+  assert(rc != 0);
+  printf("connect_refused OK\n");
+}
+
+int main() {
+  fiber_init(4);
+  // Two protocol personalities of the same wire format: the server echoes,
+  // the client completes a waiter. Distinct protocols also exercise the
+  // multi-protocol scan in cut_message.
+  g_server_proto =
+      RegisterProtocol({"tst_server", tst_parse, tst_process_server});
+  g_client_proto =
+      RegisterProtocol({"tst_client", tst_parse, tst_process_client});
+
+  Acceptor acceptor;
+  acceptor.conn_options.on_edge_triggered = InputMessengerOnEdgeTriggered;
+  EndPoint any;
+  EndPoint::parse("127.0.0.1:0", &any);
+  assert(acceptor.StartAccept(any) == 0);
+  // Accepted sockets must try the server protocol first.
+  // (cut_message scans all protocols; tst_parse matches both, so pin it.)
+  acceptor.conn_options.user = nullptr;
+
+  test_stale_id();
+  test_connect_refused();
+  test_echo_roundtrip(acceptor.listen_point());
+  assert(g_server_msgs.load() == 2);
+  acceptor.StopAccept();
+  printf("test_transport: ALL OK\n");
+  return 0;
+}

@@ -1,0 +1,69 @@
+#include "transport/acceptor.h"
+
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "base/logging.h"
+
+namespace brt {
+
+int Acceptor::StartAccept(const EndPoint& listen_point) {
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) return errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa = listen_point.to_sockaddr();
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+      ::listen(fd, 4096) != 0) {
+    int err = errno;
+    ::close(fd);
+    return err;
+  }
+  listen_point_ = listen_point;
+  if (listen_point.port == 0) {
+    socklen_t len = sizeof(sa);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&sa), &len);
+    listen_point_.port = ntohs(sa.sin_port);
+  }
+  Socket::Options o;
+  o.fd = fd;
+  o.remote = listen_point_;
+  o.user = this;
+  o.on_edge_triggered = &Acceptor::OnNewConnections;
+  return Socket::Create(o, &listen_sid_);
+}
+
+void Acceptor::StopAccept() {
+  SocketUniquePtr ptr;
+  if (Socket::Address(listen_sid_, &ptr) == 0) {
+    ptr->SetFailed(ESHUTDOWN, "acceptor stopped");
+  }
+  listen_sid_ = INVALID_SOCKET_ID;
+}
+
+void Acceptor::OnNewConnections(Socket* listener) {
+  auto* self = static_cast<Acceptor*>(listener->user());
+  for (;;) {
+    sockaddr_in sa;
+    socklen_t len = sizeof(sa);
+    int fd = ::accept4(listener->fd(), reinterpret_cast<sockaddr*>(&sa),
+                       &len, SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      if (errno == EINTR || errno == ECONNABORTED) continue;
+      BRT_LOG(WARNING) << "accept failed: " << strerror(errno);
+      return;
+    }
+    Socket::Options o = self->conn_options;
+    o.fd = fd;
+    o.remote = EndPoint(ntohl(sa.sin_addr.s_addr), ntohs(sa.sin_port));
+    SocketId sid;
+    if (Socket::Create(o, &sid) != 0) {
+      BRT_LOG(WARNING) << "Socket::Create failed for accepted fd";
+    }
+  }
+}
+
+}  // namespace brt

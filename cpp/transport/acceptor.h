@@ -1,0 +1,32 @@
+// Listens and accepts until EAGAIN, creating per-connection Sockets wired to
+// the InputMessenger. Parity target: reference src/brpc/acceptor.{h,cpp}
+// (StartAccept, OnNewConnections accept-to-EAGAIN loop, acceptor.cpp:255,341).
+#pragma once
+
+#include "base/endpoint.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+class Acceptor {
+ public:
+  // Options applied to every accepted connection (fd/remote overwritten).
+  Socket::Options conn_options;
+
+  // Binds + listens on `listen_point` and registers with the dispatcher.
+  // Returns 0 on success. The actually bound port (for port 0) is written
+  // back to listen_point_.port.
+  int StartAccept(const EndPoint& listen_point);
+  void StopAccept();
+
+  const EndPoint& listen_point() const { return listen_point_; }
+  SocketId listen_socket() const { return listen_sid_; }
+
+ private:
+  static void OnNewConnections(Socket* listener);
+
+  EndPoint listen_point_;
+  SocketId listen_sid_ = INVALID_SOCKET_ID;
+};
+
+}  // namespace brt

@@ -1,0 +1,87 @@
+#include "transport/event_dispatcher.h"
+
+#include <sys/epoll.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <thread>
+
+#include "base/logging.h"
+#include "fiber/fiber.h"
+
+namespace brt {
+
+void dispatcher_handle_event(SocketId sid, uint32_t events);  // socket.cc
+
+int EventDispatcher::num_dispatchers() {
+  static int n = [] {
+    const char* e = getenv("BRT_EVENT_DISPATCHERS");
+    int v = e ? atoi(e) : 1;
+    return v > 0 ? v : 1;
+  }();
+  return n;
+}
+
+EventDispatcher& EventDispatcher::at(int index) {
+  static EventDispatcher* ds = [] {
+    fiber_init();
+    auto* arr = new EventDispatcher[size_t(num_dispatchers())];
+    return arr;
+  }();
+  return ds[index % num_dispatchers()];
+}
+
+EventDispatcher& EventDispatcher::global(int fd) {
+  return at(fd % num_dispatchers());
+}
+
+EventDispatcher::EventDispatcher() {
+  epfd_ = epoll_create1(EPOLL_CLOEXEC);
+  BRT_CHECK_GE(epfd_, 0);
+  std::thread([this] { Loop(); }).detach();
+}
+
+static constexpr uint32_t kBaseEvents = EPOLLIN | EPOLLET | EPOLLRDHUP;
+
+int EventDispatcher::AddConsumer(int fd, SocketId sid) {
+  epoll_event ev;
+  ev.events = kBaseEvents;
+  ev.data.u64 = sid;
+  return epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+}
+
+int EventDispatcher::RegisterEpollOut(int fd, SocketId sid) {
+  epoll_event ev;
+  ev.events = kBaseEvents | EPOLLOUT;
+  ev.data.u64 = sid;
+  return epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ev);
+}
+
+int EventDispatcher::UnregisterEpollOut(int fd, SocketId sid) {
+  epoll_event ev;
+  ev.events = kBaseEvents;
+  ev.data.u64 = sid;
+  return epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ev);
+}
+
+void EventDispatcher::RemoveConsumer(int fd) {
+  epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+}
+
+void EventDispatcher::Loop() {
+  constexpr int kMaxEvents = 256;
+  epoll_event events[kMaxEvents];
+  for (;;) {
+    int n = epoll_wait(epfd_, events, kMaxEvents, -1);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      BRT_LOG(ERROR) << "epoll_wait: " << strerror(errno);
+      return;
+    }
+    for (int i = 0; i < n; ++i) {
+      dispatcher_handle_event(events[i].data.u64, events[i].events);
+    }
+  }
+}
+
+}  // namespace brt

@@ -1,0 +1,35 @@
+// Edge-triggered epoll loops feeding socket events into fibers.
+// Parity target: reference src/brpc/event_dispatcher.h:31-100 — N loops
+// sharded by fd, consumers get new fibers per event. Redesigned: each loop
+// is a dedicated pthread (the reference parks a whole bthread worker in
+// epoll_wait anyway); event handling itself always runs in fibers.
+#pragma once
+
+#include <cstdint>
+
+#include "transport/socket.h"
+
+namespace brt {
+
+class EventDispatcher {
+ public:
+  // Number of loops (BRT_EVENT_DISPATCHERS env, default 1 like the
+  // reference's event_dispatcher_num).
+  static int num_dispatchers();
+  static EventDispatcher& global(int fd);  // sharded by fd
+  static EventDispatcher& at(int index);
+
+  // Registers fd for edge-triggered EPOLLIN, events routed to socket id.
+  int AddConsumer(int fd, SocketId sid);
+  // One-shot EPOLLOUT interest (used by WaitEpollOut / connect).
+  int RegisterEpollOut(int fd, SocketId sid);
+  int UnregisterEpollOut(int fd, SocketId sid);
+  void RemoveConsumer(int fd);
+
+ private:
+  EventDispatcher();
+  void Loop();
+  int epfd_ = -1;
+};
+
+}  // namespace brt

@@ -1,0 +1,127 @@
+#include "transport/input_messenger.h"
+
+#include <vector>
+
+#include "base/logging.h"
+#include "fiber/fiber.h"
+
+namespace brt {
+
+namespace {
+constexpr int kMaxProtocols = 32;
+Protocol g_protocols[kMaxProtocols];
+int g_nprotocols = 0;
+}  // namespace
+
+int RegisterProtocol(const Protocol& p) {
+  BRT_CHECK_LT(g_nprotocols, kMaxProtocols);
+  g_protocols[g_nprotocols] = p;
+  return g_nprotocols++;
+}
+
+const Protocol* GetProtocol(int index) {
+  return (index >= 0 && index < g_nprotocols) ? &g_protocols[index] : nullptr;
+}
+
+int protocol_count() { return g_nprotocols; }
+
+namespace {
+
+struct ProcessArg {
+  const Protocol* proto;
+  IOBuf msg;
+  SocketId sid;
+};
+
+void* process_entry(void* argp) {
+  auto* arg = static_cast<ProcessArg*>(argp);
+  arg->proto->process(std::move(arg->msg), arg->sid);
+  delete arg;
+  return nullptr;
+}
+
+// Cut one message using the socket's remembered protocol first, else scan
+// all registered ones (reference CutInputMessage, input_messenger.cpp:77).
+// Returns the protocol index, -1 for need-more-data, -2 for fatal.
+int cut_message(Socket* s, IOBuf* source, IOBuf* msg) {
+  int pref = s->preferred_protocol;
+  if (pref >= 0) {
+    ParseResult r = g_protocols[pref].parse(source, msg, s);
+    if (r == ParseResult::OK) return pref;
+    if (r == ParseResult::NOT_ENOUGH_DATA) return -1;
+    if (r == ParseResult::ERROR) return -2;
+    // TRY_OTHER: fall through to the full scan.
+  }
+  for (int i = 0; i < g_nprotocols; ++i) {
+    if (i == pref) continue;
+    ParseResult r = g_protocols[i].parse(source, msg, s);
+    if (r == ParseResult::OK) {
+      s->preferred_protocol = i;
+      return i;
+    }
+    if (r == ParseResult::NOT_ENOUGH_DATA) {
+      s->preferred_protocol = i;
+      return -1;
+    }
+    if (r == ParseResult::ERROR) return -2;
+  }
+  // No protocol claimed it: if the buffer is still small it may be a
+  // not-yet-complete magic; over a small threshold it's garbage.
+  return source->size() < 16 ? -1 : -2;
+}
+
+}  // namespace
+
+void InputMessengerOnEdgeTriggered(Socket* s) {
+  IOPortal& portal = s->read_buf;
+  // Read to EAGAIN first; EOF/errors are acted on only AFTER dispatching any
+  // complete messages already buffered (a peer may write a full request and
+  // immediately close — the reference processes those too).
+  int pending_err = 0;
+  const char* pending_msg = nullptr;
+  for (;;) {
+    ssize_t nr = portal.append_from_fd(s->fd());
+    if (nr == 0) {
+      pending_err = ECONNRESET;
+      pending_msg = "peer closed connection";
+      break;
+    }
+    if (nr < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      pending_err = errno;
+      pending_msg = "read failed";
+      break;
+    }
+    s->bytes_read.fetch_add(uint64_t(nr), std::memory_order_relaxed);
+  }
+  // Cut and dispatch all complete messages now buffered.
+  std::vector<ProcessArg*> batch;
+  for (;;) {
+    IOBuf msg;
+    int pi = cut_message(s, &portal, &msg);
+    if (pi == -1) break;
+    if (pi == -2) {
+      s->SetFailed(EPROTO, "unparsable input (%zu bytes)", portal.size());
+      for (auto* a : batch) delete a;
+      return;
+    }
+    s->messages_read.fetch_add(1, std::memory_order_relaxed);
+    batch.push_back(new ProcessArg{&g_protocols[pi], std::move(msg), s->id()});
+  }
+  if (pending_err != 0) {
+    s->SetFailed(pending_err, "%s", pending_msg);
+  }
+  if (batch.empty()) return;
+  // All but the last message get their own fibers; the last runs inline
+  // ("thread jump": the read fiber becomes the processing fiber).
+  for (size_t i = 0; i + 1 < batch.size(); ++i) {
+    fiber_t tid;
+    if (fiber_start(&tid, process_entry, batch[i]) != 0) {
+      process_entry(batch[i]);
+    }
+  }
+  process_entry(batch.back());
+}
+
+}  // namespace brt

@@ -1,0 +1,40 @@
+// Protocol-agnostic message ingestion: reads the fd into an IOPortal,
+// tries registered protocols in order to cut whole messages (remembering the
+// last match per socket), then runs each message's process fn in a fiber —
+// the LAST message of a batch runs inline in the reading fiber (the
+// reference's "thread jump", input_messenger.cpp:183,286).
+// Parity target: reference src/brpc/input_messenger.{h,cpp} +
+// protocol.h:77-160 (Protocol as a table of function pointers).
+#pragma once
+
+#include "base/iobuf.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+enum class ParseResult {
+  OK,               // one message cut into *msg
+  NOT_ENOUGH_DATA,  // header matches, need more bytes
+  TRY_OTHER,        // magic mismatch: not this protocol
+  ERROR,            // malformed: fail the socket
+};
+
+struct Protocol {
+  const char* name;
+  // Cut ONE complete message from *source into *msg.
+  ParseResult (*parse)(IOBuf* source, IOBuf* msg, Socket* s);
+  // Handle a cut message; runs in a fiber. May use s->user() to reach the
+  // owning Server/Channel.
+  void (*process)(IOBuf&& msg, SocketId sid);
+};
+
+// Registers at startup (not thread-safe vs traffic; mirror of the
+// reference's GlobalInitializeOrDie, global.cpp:409-589). Returns index.
+int RegisterProtocol(const Protocol& p);
+const Protocol* GetProtocol(int index);
+int protocol_count();
+
+// The standard on_edge_triggered callback for RPC sockets.
+void InputMessengerOnEdgeTriggered(Socket* s);
+
+}  // namespace brt

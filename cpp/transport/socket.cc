@@ -1,0 +1,435 @@
+#include "transport/socket.h"
+
+#include <fcntl.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <mutex>
+#include <unordered_set>
+#include <vector>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "transport/event_dispatcher.h"
+
+namespace brt {
+
+// ---------------------------------------------------------------------------
+// Slab of Socket slots. Slots are constructed once and never destroyed
+// (reference contract: stale SocketId dereferences must be memory-safe,
+// socket.h:229 + socket_id.h).
+// ---------------------------------------------------------------------------
+struct SocketSlab {
+  static constexpr uint32_t kBlockSlots = 256;
+  static constexpr uint32_t kMaxBlocks = 4096;  // 1M sockets
+
+  static SocketSlab& singleton() {
+    static SocketSlab* s = new SocketSlab;
+    return *s;
+  }
+
+  SocketSlab() : blocks(new std::atomic<Socket*>[kMaxBlocks]) {
+    for (uint32_t i = 0; i < kMaxBlocks; ++i) blocks[i].store(nullptr);
+  }
+
+  Socket* slot(uint32_t index) {
+    Socket* b = blocks[index / kBlockSlots].load(std::memory_order_acquire);
+    return &b[index % kBlockSlots];
+  }
+
+  uint32_t alloc_index() {
+    std::lock_guard<std::mutex> g(mu);
+    if (!free_list.empty()) {
+      uint32_t i = free_list.back();
+      free_list.pop_back();
+      return i;
+    }
+    uint32_t i = next_index.load(std::memory_order_relaxed);
+    uint32_t b = i / kBlockSlots;
+    BRT_CHECK_LT(b, kMaxBlocks) << "socket slab exhausted";
+    if (blocks[b].load(std::memory_order_acquire) == nullptr) {
+      blocks[b].store(new Socket[kBlockSlots], std::memory_order_release);
+    }
+    // Publish AFTER the block exists so lock-free readers of next_index
+    // always find slot memory.
+    next_index.store(i + 1, std::memory_order_release);
+    return i;
+  }
+
+  void free_index(uint32_t i) {
+    std::lock_guard<std::mutex> g(mu);
+    free_list.push_back(i);
+  }
+
+  std::mutex mu;
+  std::vector<uint32_t> free_list;
+  std::atomic<uint32_t> next_index{0};
+  std::atomic<Socket*>* blocks;
+
+  // Live-id registry for /connections.
+  std::mutex live_mu;
+  std::unordered_set<SocketId> live;
+};
+
+static uint32_t id_index(SocketId id) { return uint32_t(id); }
+static uint32_t id_version(SocketId id) { return uint32_t(id >> 32); }
+
+void SocketUniquePtr::reset() {
+  if (s_) {
+    s_->Dereference();
+    s_ = nullptr;
+  }
+}
+
+static int set_nonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+int Socket::Create(const Options& opts, SocketId* id_out) {
+  BRT_CHECK_GE(opts.fd, 0);
+  set_nonblocking(opts.fd);
+  int one = 1;
+  setsockopt(opts.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  SocketSlab& slab = SocketSlab::singleton();
+  uint32_t index = slab.alloc_index();
+  Socket* s = slab.slot(index);
+
+  uint32_t v = uint32_t(s->vref_.load(std::memory_order_relaxed) >> 32) + 1;
+  BRT_CHECK(v & 1);
+  s->fd_ = opts.fd;
+  s->remote_ = opts.remote;
+  s->user_ = opts.user;
+  s->on_edge_triggered_ = opts.on_edge_triggered;
+  s->on_failed_ = opts.on_failed;
+  s->failed_.store(0, std::memory_order_relaxed);
+  s->error_text_.clear();
+  s->preferred_protocol = -1;
+  s->bytes_read.store(0, std::memory_order_relaxed);
+  s->bytes_written.store(0, std::memory_order_relaxed);
+  s->messages_read.store(0, std::memory_order_relaxed);
+  s->read_state.store(0, std::memory_order_relaxed);
+  s->read_buf.clear();
+  if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
+  s->write_head_.store(nullptr, std::memory_order_relaxed);
+  s->id_ = (uint64_t(v) << 32) | index;
+  // One "ownership" reference representing the live fd; dropped by
+  // SetFailed so the socket recycles once all users release.
+  s->vref_.store((uint64_t(v) << 32) | 1, std::memory_order_release);
+
+  {
+    std::lock_guard<std::mutex> g(slab.live_mu);
+    slab.live.insert(s->id_);
+  }
+
+  EventDispatcher& d = opts.dispatcher_index >= 0
+                           ? EventDispatcher::at(opts.dispatcher_index)
+                           : EventDispatcher::global(opts.fd);
+  s->dispatcher_ = &d;
+  if (d.AddConsumer(opts.fd, s->id_) != 0) {
+    int err = errno;
+    *id_out = s->id_;
+    s->SetFailed(err, "epoll_ctl add failed");
+    return -1;
+  }
+  *id_out = s->id_;
+  return 0;
+}
+
+int Socket::Address(SocketId id, SocketUniquePtr* out) {
+  // Lock-free: this runs on every epoll event and every RPC lookup.
+  SocketSlab& slab = SocketSlab::singleton();
+  uint32_t index = id_index(id);
+  if (index >= slab.next_index.load(std::memory_order_acquire)) return EINVAL;
+  Socket* s = slab.slot(index);
+  uint64_t vref = s->vref_.load(std::memory_order_acquire);
+  for (;;) {
+    if (uint32_t(vref >> 32) != id_version(id)) return EINVAL;
+    if (s->vref_.compare_exchange_weak(vref, vref + 1,
+                                       std::memory_order_acq_rel)) {
+      out->reset();
+      out->s_ = s;
+      return 0;
+    }
+  }
+}
+
+void Socket::Dereference() {
+  uint64_t prev = vref_.fetch_sub(1, std::memory_order_acq_rel);
+  if (uint32_t(prev) == 1) OnRecycle();
+}
+
+void Socket::OnRecycle() {
+  // Reference Socket::OnRecycle (socket.cpp:1084): close fd, release
+  // pending write chain, bump version, return the slot.
+  SocketSlab& slab = SocketSlab::singleton();
+  {
+    std::lock_guard<std::mutex> g(slab.live_mu);
+    slab.live.erase(id_);
+  }
+  if (fd_ >= 0) {
+    if (dispatcher_) dispatcher_->RemoveConsumer(fd_);
+    ::close(fd_);
+    fd_ = -1;
+  }
+  // Every Write() happens under a live reference and its chain is always
+  // drained by a flusher that also holds one, so the chain must be empty by
+  // the time the last reference drops.
+  WriteReq* head = write_head_.exchange(nullptr, std::memory_order_acq_rel);
+  if (head != nullptr) {
+    BRT_LOG(ERROR) << "write chain not empty at recycle, leaking it";
+  }
+  read_buf.clear();
+  uint32_t v = id_version(id_);
+  vref_.store(uint64_t(v + 1) << 32, std::memory_order_release);
+  slab.free_index(id_index(id_));
+}
+
+void Socket::SetFailed(int err, const char* fmt, ...) {
+  int expected = 0;
+  if (!failed_.compare_exchange_strong(expected, err ? err : ECONNRESET,
+                                       std::memory_order_acq_rel)) {
+    return;  // already failed
+  }
+  if (fmt != nullptr) {
+    char buf[256];
+    va_list ap;
+    va_start(ap, fmt);
+    vsnprintf(buf, sizeof(buf), fmt, ap);
+    va_end(ap);
+    error_text_ = buf;
+  }
+  // Wake EPOLLOUT waiters so KeepWrite notices the failure.
+  butex_value(epollout_butex_).fetch_add(1, std::memory_order_release);
+  butex_wake_all(epollout_butex_);
+  if (on_failed_) on_failed_(this);
+  Dereference();  // drop the ownership ref
+}
+
+// ---------------------------------------------------------------------------
+// Wait-free write path (reference socket.cpp:1583,1657,1758,1863).
+// Producers push onto a lock-free MPSC chain; whoever finds the chain empty
+// becomes the flusher: writes inline once, and on EAGAIN hands off to a
+// KeepWrite fiber that parks on EPOLLOUT.
+// ---------------------------------------------------------------------------
+int Socket::Write(IOBuf* data, fid_t cid) {
+  int err = failed_.load(std::memory_order_acquire);
+  if (err != 0) {
+    data->clear();
+    if (cid != 0) fid_error(cid, err);
+    return err;
+  }
+  WriteReq* req = new WriteReq;
+  req->data.swap(*data);
+  req->cid = cid;
+  WriteReq* prev = write_head_.exchange(req, std::memory_order_acq_rel);
+  if (prev != nullptr) {
+    // Another writer is (or will become) the flusher; just link in.
+    prev->next.store(req, std::memory_order_release);
+    return 0;
+  }
+  return FlushWriteChain(req, /*in_keepwrite_fiber=*/false);
+}
+
+struct KeepWriteArg {
+  SocketId sid;
+  Socket::WriteReq* cur;
+};
+
+void* Socket::KeepWriteEntry(void* argp) {
+  auto* arg = static_cast<KeepWriteArg*>(argp);
+  SocketUniquePtr ptr;
+  if (Socket::Address(arg->sid, &ptr) == 0) {
+    ptr->FlushWriteChain(arg->cur, /*in_keepwrite_fiber=*/true);
+  } else {
+    // Socket recycled under us: free the chain outright.
+    Socket::WriteReq* c = arg->cur;
+    while (c) {
+      Socket::WriteReq* n = c->next.load(std::memory_order_acquire);
+      if (c->cid) fid_error(c->cid, ECONNRESET);
+      delete c;
+      c = n;
+    }
+  }
+  delete arg;
+  return nullptr;
+}
+
+int Socket::FlushWriteChain(WriteReq* cur, bool in_keepwrite_fiber) {
+  for (;;) {
+    // Drain cur->data into the fd.
+    while (!cur->data.empty()) {
+      ssize_t nw = cur->data.cut_into_writev(fd_);
+      if (nw > 0) {
+        bytes_written.fetch_add(uint64_t(nw), std::memory_order_relaxed);
+        continue;
+      }
+      if (nw < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (!in_keepwrite_fiber) {
+          auto* arg = new KeepWriteArg{id_, cur};
+          fiber_t tid;
+          if (fiber_start(&tid, &Socket::KeepWriteEntry, arg) != 0) {
+            delete arg;
+            SetFailed(ENOMEM, "fiber_start failed in Write");
+            ReleaseChainOnError(cur, ENOMEM);
+            return ENOMEM;
+          }
+          return 0;
+        }
+        int rc = WaitEpollOut(/*timeout_us=*/-1);
+        int err = failed_.load(std::memory_order_acquire);
+        if (err != 0) {
+          ReleaseChainOnError(cur, err);
+          return err;
+        }
+        (void)rc;
+        continue;
+      }
+      if (nw < 0 && errno == EINTR) continue;
+      int err = errno != 0 ? errno : EPIPE;
+      SetFailed(err, "write failed: %s", strerror(err));
+      ReleaseChainOnError(cur, err);
+      return err;
+    }
+    // cur fully written: advance or terminate.
+    WriteReq* next = AdvanceWriteChain(cur);
+    if (next == nullptr) return 0;
+    cur = next;
+  }
+}
+
+// Frees cur and returns its successor, or nullptr after successfully
+// detaching the chain (CAS head cur→null; spins for a racing producer's
+// not-yet-visible link otherwise). The single subtle piece of the MPSC
+// protocol — shared by the success and error drains.
+Socket::WriteReq* Socket::AdvanceWriteChain(WriteReq* cur) {
+  WriteReq* next = cur->next.load(std::memory_order_acquire);
+  if (next == nullptr) {
+    WriteReq* expected = cur;
+    if (write_head_.compare_exchange_strong(expected, nullptr,
+                                            std::memory_order_acq_rel)) {
+      delete cur;
+      return nullptr;
+    }
+    do {
+      next = cur->next.load(std::memory_order_acquire);
+    } while (next == nullptr);
+  }
+  delete cur;
+  return next;
+}
+
+void Socket::ReleaseChainOnError(WriteReq* cur, int err) {
+  // We are the flusher: drain everything (including racing pushes) and
+  // propagate err to each request's correlation id.
+  while (cur != nullptr) {
+    if (cur->cid != 0) fid_error(cur->cid, err);
+    cur = AdvanceWriteChain(cur);
+  }
+}
+
+int Socket::WaitEpollOut(int64_t timeout_us) {
+  int expected = butex_value(epollout_butex_).load(std::memory_order_acquire);
+  dispatcher_->RegisterEpollOut(fd_, id_);
+  int rc = butex_wait(epollout_butex_, expected, timeout_us);
+  dispatcher_->UnregisterEpollOut(fd_, id_);
+  return rc == EWOULDBLOCK ? 0 : rc;
+}
+
+int Socket::Connect(const EndPoint& remote, const Options& opts,
+                    SocketId* id_out, int64_t timeout_us) {
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return errno;
+  sockaddr_in sa = remote.to_sockaddr();
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa));
+  if (rc != 0 && errno != EINPROGRESS) {
+    int err = errno;
+    ::close(fd);
+    return err;
+  }
+  Options o = opts;
+  o.fd = fd;
+  o.remote = remote;
+  if (Socket::Create(o, id_out) != 0) return ECONNREFUSED;
+  if (rc != 0) {
+    // Wait for writability, then check SO_ERROR.
+    SocketUniquePtr ptr;
+    if (Socket::Address(*id_out, &ptr) != 0) return ECONNREFUSED;
+    int wrc = ptr->WaitEpollOut(timeout_us);
+    if (wrc == ETIMEDOUT) {
+      ptr->SetFailed(ETIMEDOUT, "connect timeout");
+      return ETIMEDOUT;
+    }
+    int soerr = 0;
+    socklen_t len = sizeof(soerr);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &len);
+    if (soerr != 0) {
+      ptr->SetFailed(soerr, "connect failed: %s", strerror(soerr));
+      return soerr;
+    }
+  }
+  return 0;
+}
+
+void Socket::ListSockets(std::vector<SocketId>* out) {
+  SocketSlab& slab = SocketSlab::singleton();
+  std::lock_guard<std::mutex> g(slab.live_mu);
+  out->assign(slab.live.begin(), slab.live.end());
+}
+
+// ---------------------------------------------------------------------------
+// Event entry points (called from dispatcher threads).
+// ---------------------------------------------------------------------------
+void* Socket::ReadEventEntry(void* arg) {
+  SocketId sid = reinterpret_cast<uintptr_t>(arg);
+  SocketUniquePtr ptr;
+  if (Socket::Address(sid, &ptr) != 0) return nullptr;
+  Socket* s = ptr.get();
+  for (;;) {
+    s->on_edge_triggered_(s);
+    int st = 1;
+    if (s->read_state.compare_exchange_strong(st, 0,
+                                              std::memory_order_acq_rel)) {
+      return nullptr;
+    }
+    // st was 2: more events arrived while reading; go again.
+    s->read_state.store(1, std::memory_order_release);
+  }
+}
+
+void dispatcher_handle_event(SocketId sid, uint32_t events) {
+  SocketUniquePtr ptr;
+  if (Socket::Address(sid, &ptr) != 0) return;
+  Socket* s = ptr.get();
+  if (events & EPOLLOUT) {
+    butex_value(s->epollout_butex_).fetch_add(1, std::memory_order_release);
+    butex_wake_all(s->epollout_butex_);
+  }
+  if ((events & (EPOLLIN | EPOLLHUP | EPOLLRDHUP | EPOLLERR)) &&
+      s->on_edge_triggered_ != nullptr) {
+    int st = s->read_state.load(std::memory_order_acquire);
+    for (;;) {
+      if (st == 0) {
+        if (s->read_state.compare_exchange_weak(st, 1,
+                                                std::memory_order_acq_rel)) {
+          fiber_t tid;
+          fiber_start(&tid, &Socket::ReadEventEntry,
+                      reinterpret_cast<void*>(uintptr_t(sid)));
+          return;
+        }
+      } else {
+        if (s->read_state.compare_exchange_weak(st, 2,
+                                                std::memory_order_acq_rel)) {
+          return;  // the active reader will loop again
+        }
+      }
+    }
+  }
+}
+
+}  // namespace brt

@@ -1,0 +1,175 @@
+// The central connection object.
+// Parity target: reference src/brpc/socket.h:229 — versioned SocketId
+// (use-after-free-safe handles), wait-free write path (lock-free MPSC
+// request chain; the first writer flushes inline, overflow continues in a
+// dedicated KeepWrite fiber, socket.cpp:1583-1863), SetFailed + recycle on
+// last dereference, per-socket stats.
+// Redesigned: the version and the reference count share one atomic word
+// ([version:32|nref:32]); slots live in a never-freed ResourcePool-style
+// arena so stale-id dereferences are memory-safe.
+#pragma once
+
+#include <atomic>
+#include <cstdarg>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include "fiber/butex.h"
+#include "fiber/fiber_id.h"
+
+namespace brt {
+
+class Socket;
+class EventDispatcher;
+using SocketId = uint64_t;
+constexpr SocketId INVALID_SOCKET_ID = 0;
+
+// Scoped, refcounted reference to a live Socket.
+class SocketUniquePtr {
+ public:
+  SocketUniquePtr() = default;
+  ~SocketUniquePtr() { reset(); }
+  SocketUniquePtr(const SocketUniquePtr&) = delete;
+  SocketUniquePtr& operator=(const SocketUniquePtr&) = delete;
+  SocketUniquePtr(SocketUniquePtr&& o) noexcept : s_(o.s_) { o.s_ = nullptr; }
+  SocketUniquePtr& operator=(SocketUniquePtr&& o) noexcept {
+    if (this != &o) {
+      reset();
+      s_ = o.s_;
+      o.s_ = nullptr;
+    }
+    return *this;
+  }
+  Socket* get() const { return s_; }
+  Socket* operator->() const { return s_; }
+  Socket& operator*() const { return *s_; }
+  explicit operator bool() const { return s_ != nullptr; }
+  void reset();
+  Socket* release() {
+    Socket* s = s_;
+    s_ = nullptr;
+    return s;
+  }
+
+ private:
+  friend class Socket;
+  Socket* s_ = nullptr;
+};
+
+class Socket {
+ public:
+  struct Options {
+    int fd = -1;
+    EndPoint remote;
+    void* user = nullptr;  // owner cookie (Server*, Channel state, ...)
+    // Called in a fiber when the fd becomes readable (edge-triggered:
+    // implementations must read until EAGAIN). Null for connect-only
+    // sockets whose reads are driven elsewhere.
+    void (*on_edge_triggered)(Socket*) = nullptr;
+    // Called once when the socket transitions to failed.
+    void (*on_failed)(Socket*) = nullptr;
+    int dispatcher_index = -1;  // -1: shard by fd
+  };
+
+  // Wraps an existing connected/listening fd, registers it with the event
+  // dispatcher, returns a versioned id.
+  static int Create(const Options& opts, SocketId* id);
+
+  // Non-blocking connect + dispatcher registration; parks the calling fiber
+  // until connected or timeout. Returns 0 on success.
+  static int Connect(const EndPoint& remote, const Options& opts,
+                     SocketId* id, int64_t timeout_us = 1000000);
+
+  // Live reference for id (nullptr-safe failure): EINVAL on stale id.
+  static int Address(SocketId id, SocketUniquePtr* out);
+
+  // Wait-free write: steals *data. Thread/fiber-safe. On socket failure the
+  // data is dropped and cid (if non-zero) receives fid_error(err).
+  // Returns 0 if accepted (delivery still asynchronous).
+  int Write(IOBuf* data, fid_t cid = 0);
+
+  // Marks failed; pending & future writes error out; on_failed runs once;
+  // fd is closed when the last reference drops.
+  void SetFailed(int err, const char* fmt = nullptr, ...);
+  bool Failed() const {
+    return failed_.load(std::memory_order_acquire) != 0;
+  }
+  int error_code() const { return failed_.load(std::memory_order_acquire); }
+  const std::string& error_text() const { return error_text_; }
+
+  SocketId id() const { return id_; }
+  int fd() const { return fd_; }
+  const EndPoint& remote() const { return remote_; }
+  void* user() const { return user_; }
+
+  // Last-matched protocol index for InputMessenger (reference keeps this on
+  // the socket too, input_messenger.cpp:77).
+  int preferred_protocol = -1;
+  // Correlation-id of the in-flight RPC for single-connection client sockets
+  // is tracked by the Controller, not here.
+
+  // --- stats (reference socket.h:124-156) ---
+  std::atomic<uint64_t> bytes_read{0};
+  std::atomic<uint64_t> bytes_written{0};
+  std::atomic<uint64_t> messages_read{0};
+
+  // Read-side reentrancy guard for edge-triggered events; used by the
+  // dispatcher. 0 idle / 1 reading / 2 reading+pending.
+  std::atomic<int> read_state{0};
+
+  // Ingestion buffer (only touched by the single active read fiber).
+  IOPortal read_buf;
+
+  // Parking spot for fibers waiting for EPOLLOUT (value bumped + woken by
+  // the dispatcher on writable events).
+  Butex* epollout_butex() { return epollout_butex_; }
+  // Blocks the calling fiber until the fd reports writable (or timeout).
+  int WaitEpollOut(int64_t timeout_us);
+
+  // In-process registry walk (builtin /connections service).
+  static void ListSockets(std::vector<SocketId>* out);
+
+ private:
+  friend class SocketUniquePtr;
+  struct WriteReq {
+    IOBuf data;
+    fid_t cid = 0;
+    std::atomic<WriteReq*> next{nullptr};
+  };
+
+  Socket() = default;
+  ~Socket() = default;
+
+  void Dereference();
+  void OnRecycle();
+
+  // Flusher internals.
+  int FlushWriteChain(WriteReq* head, bool in_keepwrite_fiber);
+  static void* KeepWriteEntry(void* arg);
+  WriteReq* AdvanceWriteChain(WriteReq* cur);
+  void ReleaseChainOnError(WriteReq* head, int err);
+
+  static void* ReadEventEntry(void* arg);
+
+  SocketId id_ = INVALID_SOCKET_ID;
+  int fd_ = -1;
+  EndPoint remote_;
+  void* user_ = nullptr;
+  void (*on_edge_triggered_)(Socket*) = nullptr;
+  void (*on_failed_)(Socket*) = nullptr;
+  std::atomic<int> failed_{0};
+  std::string error_text_;
+  std::atomic<WriteReq*> write_head_{nullptr};  // MPSC chain, Vyukov-style
+  Butex* epollout_butex_ = nullptr;
+  EventDispatcher* dispatcher_ = nullptr;
+  std::atomic<uint64_t> vref_{0};  // [version:32|nref:32]
+
+  friend struct SocketSlab;
+  friend struct KeepWriteArg;
+  friend void dispatcher_handle_event(SocketId, uint32_t);
+};
+
+}  // namespace brt

@@ -68,7 +68,10 @@ class LatencyRecorder : public Sampler {
   // Exposes sub-vars as <prefix>_qps, <prefix>_latency, <prefix>_latency_p99…
   int expose(const std::string& prefix);
   void hide();
-  ~LatencyRecorder() override { hide(); }
+  ~LatencyRecorder() override {
+    unschedule();  // before members die: the tick thread may be in take_sample
+    hide();
+  }
 
  private:
   Adder<int64_t> count_;

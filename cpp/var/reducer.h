@@ -11,6 +11,7 @@
 #include <limits>
 #include <memory>
 #include <mutex>
+#include <type_traits>
 #include <vector>
 
 #include "var/variable.h"
@@ -18,39 +19,43 @@
 namespace brt {
 namespace var {
 
-// Op must provide: static T identity(); static T combine(T, T);
-// static T apply(T current, T delta)  (what a write does to the local cell).
+// One process-wide mutex for agent registration/retirement and read sweeps.
+// Writes (operator<<) never touch it after first use; the only contenders
+// are thread exit, reducer destruction, and metrics dumps — all rare.
+inline std::mutex& reducer_lifecycle_mu() {
+  static std::mutex* mu = new std::mutex;
+  return *mu;
+}
+
+// Op must provide: identity(), combine(a,b) and apply_atomic(cell, delta) —
+// the latter a true atomic RMW so a concurrent reset()/exchange can never
+// resurrect a pre-reset value through a load-modify-store window.
 template <typename T, typename Op>
 class Reducer : public Variable {
  public:
   Reducer() = default;
   ~Reducer() override {
     hide();
-    std::lock_guard<std::mutex> g(mu_);
-    for (Agent* a : agents_) a->owner = nullptr;
+    std::lock_guard<std::mutex> g(reducer_lifecycle_mu());
+    for (Agent* a : agents_) a->owner.store(nullptr, std::memory_order_release);
   }
 
   Reducer& operator<<(T delta) {
-    Agent* a = tls_agent();
-    // Single-writer cell: relaxed RMW is enough; readers see it via the
-    // acquire sweep in get_value().
-    T cur = a->value.load(std::memory_order_relaxed);
-    a->value.store(Op::apply(cur, delta), std::memory_order_relaxed);
+    Op::apply_atomic(tls_agent()->value, delta);
     return *this;
   }
 
   T get_value() const {
-    std::lock_guard<std::mutex> g(mu_);
+    std::lock_guard<std::mutex> g(reducer_lifecycle_mu());
     T v = residual_;
     for (Agent* a : agents_)
       v = Op::combine(v, a->value.load(std::memory_order_acquire));
     return v;
   }
 
-  // Combined value, then all cells reset to identity (used by Window samples
-  // on reset-style reducers; races lose at most in-flight deltas).
+  // Combined value, then all cells reset to identity.
   T reset() {
-    std::lock_guard<std::mutex> g(mu_);
+    std::lock_guard<std::mutex> g(reducer_lifecycle_mu());
     T v = residual_;
     residual_ = Op::identity();
     for (Agent* a : agents_)
@@ -64,14 +69,15 @@ class Reducer : public Variable {
  private:
   struct Agent {
     std::atomic<T> value{Op::identity()};
-    Reducer* owner = nullptr;
-    ~Agent() {
-      if (owner) owner->retire(this);
+    std::atomic<Reducer*> owner{nullptr};
+    ~Agent() {  // thread exit: fold this cell into the owner's residual
+      std::lock_guard<std::mutex> g(reducer_lifecycle_mu());
+      Reducer* o = owner.load(std::memory_order_acquire);
+      if (o) o->retire_locked(this);
     }
   };
 
-  void retire(Agent* a) {
-    std::lock_guard<std::mutex> g(mu_);
+  void retire_locked(Agent* a) {  // lifecycle mutex held
     residual_ =
         Op::combine(residual_, a->value.load(std::memory_order_acquire));
     for (size_t i = 0; i < agents_.size(); ++i) {
@@ -84,22 +90,32 @@ class Reducer : public Variable {
   }
 
   Agent* tls_agent() {
-    thread_local std::vector<std::pair<Reducer*, std::unique_ptr<Agent>>>
-        cache;
-    for (auto& [o, a] : cache)
-      if (o == this) return a.get();
+    thread_local std::vector<std::unique_ptr<Agent>> cache;
+    // Match on the agent's owner pointer, NOT a cached Reducer* key: a dead
+    // reducer orphans its agents (owner=null), so a new reducer reusing the
+    // same address can never pick up a stale cell. Dead entries are pruned
+    // here to bound growth.
+    for (size_t i = 0; i < cache.size();) {
+      Reducer* o = cache[i]->owner.load(std::memory_order_acquire);
+      if (o == this) return cache[i].get();
+      if (o == nullptr) {
+        cache[i].swap(cache.back());
+        cache.pop_back();
+        continue;
+      }
+      ++i;
+    }
     auto a = std::make_unique<Agent>();
-    a->owner = this;
+    a->owner.store(this, std::memory_order_release);
     {
-      std::lock_guard<std::mutex> g(mu_);
+      std::lock_guard<std::mutex> g(reducer_lifecycle_mu());
       agents_.push_back(a.get());
     }
-    cache.emplace_back(this, std::move(a));
-    return cache.back().second.get();
+    cache.push_back(std::move(a));
+    return cache.back().get();
   }
 
-  mutable std::mutex mu_;
-  std::vector<Agent*> agents_;
+  std::vector<Agent*> agents_;  // guarded by reducer_lifecycle_mu()
   T residual_ = Op::identity();
 };
 
@@ -107,19 +123,38 @@ template <typename T>
 struct AddOp {
   static T identity() { return T(); }
   static T combine(T a, T b) { return a + b; }
-  static T apply(T cur, T d) { return cur + d; }
+  static void apply_atomic(std::atomic<T>& cell, T d) {
+    if constexpr (std::is_integral_v<T>) {
+      cell.fetch_add(d, std::memory_order_relaxed);
+    } else {
+      T cur = cell.load(std::memory_order_relaxed);
+      while (!cell.compare_exchange_weak(cur, cur + d,
+                                         std::memory_order_relaxed)) {
+      }
+    }
+  }
 };
 template <typename T>
 struct MaxOp {
   static T identity() { return std::numeric_limits<T>::lowest(); }
   static T combine(T a, T b) { return a > b ? a : b; }
-  static T apply(T cur, T d) { return cur > d ? cur : d; }
+  static void apply_atomic(std::atomic<T>& cell, T d) {
+    T cur = cell.load(std::memory_order_relaxed);
+    while (cur < d && !cell.compare_exchange_weak(cur, d,
+                                                  std::memory_order_relaxed)) {
+    }
+  }
 };
 template <typename T>
 struct MinOp {
   static T identity() { return std::numeric_limits<T>::max(); }
   static T combine(T a, T b) { return a < b ? a : b; }
-  static T apply(T cur, T d) { return cur < d ? cur : d; }
+  static void apply_atomic(std::atomic<T>& cell, T d) {
+    T cur = cell.load(std::memory_order_relaxed);
+    while (cur > d && !cell.compare_exchange_weak(cur, d,
+                                                  std::memory_order_relaxed)) {
+    }
+  }
 };
 
 template <typename T>

@@ -52,8 +52,13 @@ struct Collector {
 };
 }  // namespace
 
-Sampler::~Sampler() {
-  if (scheduled_) Collector::singleton().remove(this);
+Sampler::~Sampler() { unschedule(); }
+
+void Sampler::unschedule() {
+  if (scheduled_) {
+    scheduled_ = false;
+    Collector::singleton().remove(this);  // waits out a concurrent tick
+  }
 }
 
 void Sampler::schedule() {

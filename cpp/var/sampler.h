@@ -19,6 +19,10 @@ class Sampler {
  protected:
   // Starts the global sampler thread on first use.
   void schedule();
+  // MUST be called first thing in every derived destructor: ~Sampler() runs
+  // only after derived members are gone, by which point the tick thread may
+  // already be mid-call into the dying object's take_sample().
+  void unschedule();
 
  private:
   bool scheduled_ = false;

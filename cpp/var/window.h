@@ -26,6 +26,8 @@ class Window : public Variable, public Sampler {
     schedule();
   }
 
+  ~Window() override { unschedule(); }
+
   void take_sample() override {
     std::lock_guard<std::mutex> g(mu_);
     samples_[pos_ % (window_ + 1)] = int64_t(reducer_->get_value());
