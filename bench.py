@@ -35,8 +35,8 @@ def main() -> int:
     try:
         bench = ensure_built()
         out = subprocess.run(
-            [bench, "--payload", str(64 * 1024), "--connections", "8",
-             "--seconds", "5"],
+            [bench, "--payload", str(256 * 1024), "--connections", "8",
+             "--depth", "8", "--seconds", "5"],
             check=True, capture_output=True, text=True, timeout=300,
         ).stdout
         # echo_bench prints a JSON line {"gbps": X, "qps": Y, "p50_us": Z}

@@ -47,7 +47,7 @@ class TimerThread {
     return id;
   }
 
-  int cancel(TimerId id) {
+  int cancel(TimerId id, bool wait_running) {
     std::unique_lock<std::mutex> lk(mu_);
     for (;;) {
       auto it = entries_.find(id);
@@ -57,6 +57,7 @@ class TimerThread {
         return 0;
       }
       if (it->second.state == TState::CANCELLED) return 0;
+      if (!wait_running) return 1;  // RUNNING and caller won't wait
       done_cv_.wait(lk);  // RUNNING: wait for the callback to finish
     }
   }
@@ -118,6 +119,10 @@ TimerId timer_add(int64_t abstime_us, void (*fn)(void*), void* arg) {
   return TimerThread::get().add(abstime_us, fn, arg);
 }
 
-int timer_cancel(TimerId id) { return TimerThread::get().cancel(id); }
+int timer_cancel(TimerId id) { return TimerThread::get().cancel(id, true); }
+
+int timer_cancel_nonblocking(TimerId id) {
+  return TimerThread::get().cancel(id, false);
+}
 
 }  // namespace brt

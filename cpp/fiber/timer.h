@@ -17,4 +17,10 @@ TimerId timer_add(int64_t abstime_us, void (*fn)(void*), void* arg);
 // Returns 0 if cancelled before running, 1 if it already ran / unknown id.
 int timer_cancel(TimerId id);
 
+// Best-effort cancel that never blocks: if the callback is currently
+// running it is left to finish (callers must tolerate a late firing —
+// Controller::EndRPC does, because a late fid_error on a destroyed id is a
+// no-op).
+int timer_cancel_nonblocking(TimerId id);
+
 }  // namespace brt

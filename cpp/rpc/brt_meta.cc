@@ -1,0 +1,162 @@
+#include "rpc/brt_meta.h"
+
+#include <cstring>
+
+namespace brt {
+
+namespace {
+
+constexpr char kMagic[4] = {'B', 'R', 'T', '1'};
+constexpr size_t kHeaderLen = 12;
+constexpr size_t kMaxMetaLen = 64 * 1024;
+
+// Meta fields are (tag:u8, value) pairs; integers are unsigned LEB128
+// varints, strings are varint-length-prefixed bytes. Unknown tags with
+// varint values are skipped (forward compatibility).
+enum Tag : uint8_t {
+  TAG_TYPE = 1,
+  TAG_CID = 2,
+  TAG_SERVICE = 3,
+  TAG_METHOD = 4,
+  TAG_ERROR_CODE = 5,
+  TAG_ERROR_TEXT = 6,
+  TAG_ATTACHMENT = 7,
+  TAG_TIMEOUT_MS = 8,
+  TAG_TRACE_ID = 9,
+  TAG_SPAN_ID = 10,
+  TAG_PARENT_SPAN = 11,
+  TAG_COMPRESS = 12,
+  TAG_STREAM_ID = 13,
+  TAG_STREAM_FLAGS = 14,
+};
+
+void put_varint(std::string* out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(char(v | 0x80));
+    v >>= 7;
+  }
+  out->push_back(char(v));
+}
+
+void put_field(std::string* out, uint8_t tag, uint64_t v) {
+  out->push_back(char(tag));
+  put_varint(out, v);
+}
+
+void put_str(std::string* out, uint8_t tag, const std::string& s) {
+  out->push_back(char(tag));
+  put_varint(out, s.size());
+  out->append(s);
+}
+
+bool get_varint(const uint8_t*& p, const uint8_t* end, uint64_t* v) {
+  uint64_t r = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = *p++;
+    r |= uint64_t(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *v = r;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+}  // namespace
+
+void EncodeMeta(const RpcMeta& meta, std::string* out) {
+  out->clear();
+  put_field(out, TAG_TYPE, uint8_t(meta.type));
+  put_field(out, TAG_CID, meta.correlation_id);
+  if (!meta.service.empty()) put_str(out, TAG_SERVICE, meta.service);
+  if (!meta.method.empty()) put_str(out, TAG_METHOD, meta.method);
+  if (meta.error_code) put_field(out, TAG_ERROR_CODE, uint32_t(meta.error_code));
+  if (!meta.error_text.empty()) put_str(out, TAG_ERROR_TEXT, meta.error_text);
+  if (meta.attachment_size) put_field(out, TAG_ATTACHMENT, meta.attachment_size);
+  if (meta.timeout_ms) put_field(out, TAG_TIMEOUT_MS, meta.timeout_ms);
+  if (meta.trace_id) put_field(out, TAG_TRACE_ID, meta.trace_id);
+  if (meta.span_id) put_field(out, TAG_SPAN_ID, meta.span_id);
+  if (meta.parent_span_id) put_field(out, TAG_PARENT_SPAN, meta.parent_span_id);
+  if (meta.compress_type) put_field(out, TAG_COMPRESS, meta.compress_type);
+  if (meta.stream_id) put_field(out, TAG_STREAM_ID, meta.stream_id);
+  if (meta.stream_flags) put_field(out, TAG_STREAM_FLAGS, meta.stream_flags);
+}
+
+bool DecodeMeta(const void* data, size_t n, RpcMeta* meta) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  const uint8_t* end = p + n;
+  while (p < end) {
+    uint8_t tag = *p++;
+    uint64_t v;
+    if (!get_varint(p, end, &v)) return false;
+    switch (tag) {
+      case TAG_TYPE:
+        if (v > 2) return false;
+        meta->type = MetaType(v);
+        break;
+      case TAG_CID: meta->correlation_id = v; break;
+      case TAG_SERVICE:
+      case TAG_METHOD:
+      case TAG_ERROR_TEXT: {
+        if (size_t(end - p) < v) return false;
+        std::string s(reinterpret_cast<const char*>(p), v);
+        p += v;
+        if (tag == TAG_SERVICE) meta->service = std::move(s);
+        else if (tag == TAG_METHOD) meta->method = std::move(s);
+        else meta->error_text = std::move(s);
+        break;
+      }
+      case TAG_ERROR_CODE: meta->error_code = int32_t(v); break;
+      case TAG_ATTACHMENT: meta->attachment_size = v; break;
+      case TAG_TIMEOUT_MS: meta->timeout_ms = uint32_t(v); break;
+      case TAG_TRACE_ID: meta->trace_id = v; break;
+      case TAG_SPAN_ID: meta->span_id = v; break;
+      case TAG_PARENT_SPAN: meta->parent_span_id = v; break;
+      case TAG_COMPRESS: meta->compress_type = uint8_t(v); break;
+      case TAG_STREAM_ID: meta->stream_id = v; break;
+      case TAG_STREAM_FLAGS: meta->stream_flags = uint8_t(v); break;
+      default: break;  // skipped varint already consumed
+    }
+  }
+  return true;
+}
+
+void PackFrame(IOBuf* out, const RpcMeta& meta, IOBuf&& body) {
+  std::string mbuf;
+  EncodeMeta(meta, &mbuf);
+  char hdr[kHeaderLen];
+  memcpy(hdr, kMagic, 4);
+  uint32_t mlen = mbuf.size();
+  uint32_t blen = body.size();
+  hdr[4] = char(mlen >> 24); hdr[5] = char(mlen >> 16);
+  hdr[6] = char(mlen >> 8);  hdr[7] = char(mlen);
+  hdr[8] = char(blen >> 24); hdr[9] = char(blen >> 16);
+  hdr[10] = char(blen >> 8); hdr[11] = char(blen);
+  out->append(hdr, kHeaderLen);
+  out->append(mbuf);
+  out->append(std::move(body));
+}
+
+int ParseFrame(IOBuf* source, RpcMeta* meta, IOBuf* body) {
+  if (source->size() < kHeaderLen) return EAGAIN;
+  char hdr[kHeaderLen];
+  source->copy_to(hdr, kHeaderLen);
+  if (memcmp(hdr, kMagic, 4) != 0) return EINVAL;
+  uint32_t mlen = (uint8_t(hdr[4]) << 24) | (uint8_t(hdr[5]) << 16) |
+                  (uint8_t(hdr[6]) << 8) | uint8_t(hdr[7]);
+  uint32_t blen = (uint8_t(hdr[8]) << 24) | (uint8_t(hdr[9]) << 16) |
+                  (uint8_t(hdr[10]) << 8) | uint8_t(hdr[11]);
+  if (mlen > kMaxMetaLen) return EBADMSG;
+  if (source->size() < kHeaderLen + mlen + blen) return EAGAIN;
+  source->pop_front(kHeaderLen);
+  std::string mbuf;
+  source->cutn(&mbuf, mlen);
+  if (!DecodeMeta(mbuf.data(), mbuf.size(), meta)) return EBADMSG;
+  if (meta->attachment_size > blen) return EBADMSG;
+  source->cutn(body, blen);
+  return 0;
+}
+
+}  // namespace brt

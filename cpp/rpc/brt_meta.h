@@ -1,0 +1,50 @@
+// Wire format of the native "brt_std" protocol — the baidu_std equivalent
+// (reference: src/brpc/policy/baidu_rpc_protocol.cpp + baidu_rpc_meta.proto,
+// wire doc docs/cn/baidu_std.md: 12-byte header "PRPC" + meta + payload +
+// attachment). Redesigned: magic "BRT1", fixed 12-byte header
+// [magic:4][meta_len:4][body_len:4] (big-endian), then a compact tag-byte
+// encoded meta (no protobuf dependency in the native core), then
+// body = payload ++ attachment (meta.attachment_size gives the split).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "base/iobuf.h"
+
+namespace brt {
+
+enum class MetaType : uint8_t { REQUEST = 0, RESPONSE = 1, STREAM = 2 };
+
+struct RpcMeta {
+  MetaType type = MetaType::REQUEST;
+  uint64_t correlation_id = 0;
+  std::string service;       // request only
+  std::string method;        // request only
+  int32_t error_code = 0;    // response only
+  std::string error_text;    // response only
+  uint64_t attachment_size = 0;
+  uint32_t timeout_ms = 0;   // request: remaining budget hint for the server
+  uint64_t trace_id = 0;     // rpcz span propagation
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
+  uint8_t compress_type = 0; // 0 none, 1 snappy-like (reserved)
+  uint64_t stream_id = 0;    // STREAM frames + stream-settings on REQUEST
+  uint8_t stream_flags = 0;  // see stream.h: FLAG_CLOSE / FLAG_FEEDBACK
+};
+
+// Serializes meta and frames header+meta+body into *out. Steals *body.
+void PackFrame(IOBuf* out, const RpcMeta& meta, IOBuf&& body);
+
+// Parses one complete frame from *source: fills meta, moves body bytes into
+// *body. Mirrors the reference's Protocol.parse contract
+// (input_messenger.cpp:77). Caller layers this under InputMessenger.
+// Returns: 0 ok, EAGAIN not-enough-data, EINVAL magic mismatch,
+// EBADMSG malformed meta.
+int ParseFrame(IOBuf* source, RpcMeta* meta, IOBuf* body);
+
+// Meta-only (de)serialization, exposed for tests.
+void EncodeMeta(const RpcMeta& meta, std::string* out);
+bool DecodeMeta(const void* data, size_t n, RpcMeta* meta);
+
+}  // namespace brt

@@ -1,0 +1,129 @@
+#include "rpc/channel.h"
+
+#include "base/time.h"
+#include "rpc/protocol_brt.h"
+
+namespace brt {
+
+namespace {
+
+// Timer callbacks carry the fid by value: a late firing after the call ended
+// hits a destroyed versioned id and is a no-op (never a dangling pointer).
+void TimeoutFn(void* arg) {
+  fid_error(fid_t(uintptr_t(arg)), ERPCTIMEDOUT);
+}
+void BackupFn(void* arg) {
+  fid_error(fid_t(uintptr_t(arg)), EBACKUPREQUEST);
+}
+
+}  // namespace
+
+int Channel::Init(const std::string& server_addr, const ChannelOptions* opts) {
+  EndPoint ep;
+  if (!EndPoint::parse(server_addr, &ep)) return EINVAL;
+  return Init(ep, opts);
+}
+
+int Channel::Init(const EndPoint& server, const ChannelOptions* opts) {
+  if (opts) options_ = *opts;
+  server_ = server;
+  RegisterBrtProtocol();
+  inited_ = true;
+  return 0;
+}
+
+void Channel::CallMethod(const std::string& service, const std::string& method,
+                         Controller* cntl, const IOBuf& request,
+                         IOBuf* response, Closure done) {
+  const int64_t timeout_ms =
+      cntl->timeout_ms != INT64_MIN ? cntl->timeout_ms : options_.timeout_ms;
+  const int max_retry =
+      cntl->max_retry >= 0 ? cntl->max_retry : options_.max_retry;
+  const int64_t backup_ms = cntl->backup_request_ms != INT64_MIN
+                                ? cntl->backup_request_ms
+                                : options_.backup_request_ms;
+  const bool sync = !done;
+
+  fid_t cid = 0;
+  fid_create(&cid, cntl, Controller::HandleError);
+  cntl->set_cid(cid);
+  Controller::Call& c = cntl->call;
+  c.cid = cid;
+  c.issuer = this;
+  c.response = response;
+  c.done = std::move(done);
+  c.start_us = monotonic_us();
+  c.remaining_retries = max_retry;
+  c.abs_deadline_us = timeout_ms < 0 ? -1 : c.start_us + timeout_ms * 1000;
+
+  c.request_meta.type = MetaType::REQUEST;
+  c.request_meta.correlation_id = cid;
+  c.request_meta.service = service;
+  c.request_meta.method = method;
+  c.request_meta.timeout_ms = timeout_ms < 0 ? 0 : uint32_t(timeout_ms);
+  c.request_meta.attachment_size = cntl->request_attachment().size();
+  c.request_meta.trace_id = cntl->trace_id;
+  c.request_meta.span_id = cntl->span_id;
+  c.request_body = request;  // shares blocks — no copy
+  c.request_body.append(cntl->request_attachment());
+
+  void* data = nullptr;
+  if (fid_lock(cid, &data) != 0) {
+    // Impossible for a fresh id; defend anyway.
+    cntl->SetFailed(EINVAL, "fresh correlation id unusable");
+    if (c.done) c.done();
+    return;
+  }
+  if (!inited_) {
+    cntl->SetFailed(EINVAL, "channel not initialized");
+    cntl->EndRPC();
+    return;
+  }
+  // Arm timers BEFORE the first attempt: a first attempt that fails
+  // synchronously but retries successfully must still be covered by the
+  // deadline (EndRPC cancels both timers on any termination).
+  if (c.abs_deadline_us >= 0) {
+    c.timeout_timer = timer_add(c.abs_deadline_us, TimeoutFn,
+                                reinterpret_cast<void*>(uintptr_t(cid)));
+  }
+  if (backup_ms >= 0 && (timeout_ms < 0 || backup_ms < timeout_ms)) {
+    c.backup_timer = timer_add(c.start_us + backup_ms * 1000, BackupFn,
+                               reinterpret_cast<void*>(uintptr_t(cid)));
+  }
+  const int rc = IssueRPC(cntl);
+  if (rc != 0) {
+    // Route through the same serialized error funnel as async failures so
+    // the retry policy applies uniformly (reference HandleSendFailed,
+    // controller.cpp:998). The queued error fires on unlock.
+    fid_error(cid, rc);
+  }
+  fid_unlock(cid);
+  if (sync) fid_join(cid);
+}
+
+int Channel::IssueRPC(Controller* cntl) {
+  Controller::Call& c = cntl->call;
+  SocketUniquePtr sock;
+  const int rc = GetOrNewSocket(server_, options_.connection_type, &sock,
+                                options_.connect_timeout_us,
+                                options_.connection_group);
+  if (rc != 0) {
+    cntl->SetFailed(rc == ETIMEDOUT ? ECONNREFUSED : rc,
+                    "fail to connect %s", server_.to_string().c_str());
+    return rc ? rc : ECONNREFUSED;
+  }
+  cntl->set_remote_side(server_);
+  c.last_socket = sock->id();
+  c.conn_type = int(options_.connection_type);
+  c.conn_group = options_.connection_group;
+  IOBuf frame;
+  IOBuf body = c.request_body;  // keep the original for retries
+  PackFrame(&frame, c.request_meta, std::move(body));
+  // A write failure surfaces through fid_error(cid) (Socket::Write
+  // contract) and re-enters Controller::HandleError — report success here
+  // so the funnel stays single-entry.
+  sock->Write(&frame, c.cid);
+  return 0;
+}
+
+}  // namespace brt

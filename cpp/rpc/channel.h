@@ -1,0 +1,56 @@
+// Client stub: packs a call, selects a connection, arms timers, fires.
+// Parity target: reference src/brpc/channel.h:151 (Channel::Init single
+// server / CallMethod channel.cpp:409) + Controller::IssueRPC
+// (controller.cpp:1015). Cluster init (ns_url + load balancer) is layered
+// on top by cluster/cluster_channel.h.
+#pragma once
+
+#include <string>
+
+#include "rpc/controller.h"
+#include "rpc/socket_map.h"
+
+namespace brt {
+
+struct ChannelOptions {
+  int64_t timeout_ms = 500;          // reference default (channel.h:69)
+  int max_retry = 3;                 // reference default (channel.h:115)
+  int64_t backup_request_ms = -1;    // <0: disabled
+  int64_t connect_timeout_us = 200 * 1000;
+  ConnectionType connection_type = ConnectionType::SINGLE;
+  // SINGLE connections are shared per (endpoint, connection_group): distinct
+  // groups get private multiplexed connections (the reference's
+  // ChannelSignature role in SocketMap keys).
+  int connection_group = 0;
+};
+
+class Channel : public CallIssuer {
+ public:
+  Channel() = default;
+  ~Channel() override = default;
+
+  // Single-server init ("ip:port" or EndPoint). Returns 0 on success.
+  int Init(const std::string& server_addr, const ChannelOptions* opts = nullptr);
+  int Init(const EndPoint& server, const ChannelOptions* opts = nullptr);
+
+  // Issues `service`.`method` carrying `request` (+ cntl->request_attachment).
+  // done == nullptr → synchronous: blocks the calling fiber/thread until the
+  // call ends. done != nullptr → asynchronous: done runs exactly once, in a
+  // fiber, after cntl/response are filled.
+  void CallMethod(const std::string& service, const std::string& method,
+                  Controller* cntl, const IOBuf& request, IOBuf* response,
+                  Closure done);
+
+  // CallIssuer: one delivery attempt; called with the correlation id locked.
+  int IssueRPC(Controller* cntl) override;
+
+  const ChannelOptions& options() const { return options_; }
+  const EndPoint& server() const { return server_; }
+
+ protected:
+  ChannelOptions options_;
+  EndPoint server_;
+  bool inited_ = false;
+};
+
+}  // namespace brt

@@ -1,0 +1,190 @@
+#include "rpc/controller.h"
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstring>
+
+#include "base/time.h"
+#include "rpc/socket_map.h"
+
+namespace brt {
+
+const char* RpcErrorText(int code) {
+  switch (code) {
+    case ENOSERVICE: return "service not found";
+    case ENOMETHOD: return "method not found";
+    case EREQUEST: return "malformed request";
+    case ETOOMANYFAILS: return "too many sub-call failures";
+    case EBACKUPREQUEST: return "backup request";
+    case ERPCTIMEDOUT: return "rpc timed out";
+    case EFAILEDSOCKET: return "connection broken";
+    case EOVERCROWDED: return "too many buffered writes";
+    case EINTERNAL: return "server internal error";
+    case ERESPONSE: return "malformed response";
+    case ELOGOFF: return "server is stopping";
+    case ELIMIT: return "concurrency limit reached";
+    case ECANCELEDRPC: return "rpc canceled";
+    default: return strerror(code);
+  }
+}
+
+Controller::~Controller() = default;
+
+void Controller::SetFailed(int code, const char* fmt, ...) {
+  error_code_ = code ? code : EINTERNAL;
+  if (fmt) {
+    char buf[256];
+    va_list ap;
+    va_start(ap, fmt);
+    vsnprintf(buf, sizeof(buf), fmt, ap);
+    va_end(ap);
+    error_text_ = buf;
+  } else {
+    error_text_ = RpcErrorText(error_code_);
+  }
+}
+
+void Controller::Reset() {
+  error_code_ = 0;
+  error_text_.clear();
+  request_attachment_.clear();
+  response_attachment_.clear();
+  latency_us_ = 0;
+  retried_ = 0;
+  backup_fired_ = false;
+  cid_ = 0;
+  call = Call();
+  trace_id = span_id = parent_span_id = 0;
+}
+
+namespace {
+
+// Errors that justify another attempt (reference DefaultRetryPolicy,
+// retry_policy.cpp: EFAILEDSOCKET/EHOSTDOWN/ELOGOFF and connect errnos).
+bool Retryable(int err) {
+  switch (err) {
+    case EFAILEDSOCKET:
+    case ELOGOFF:
+    case EOVERCROWDED:
+    case ECONNREFUSED:
+    case ECONNRESET:
+    case EPIPE:
+    case EHOSTDOWN:
+    case EHOSTUNREACH:
+    case ENETUNREACH:
+      return true;
+    default:
+      return false;
+  }
+}
+
+}  // namespace
+
+int Controller::HandleError(fid_t id, void* data, int error_code) {
+  auto* cntl = static_cast<Controller*>(data);
+  Controller::Call& c = cntl->call;
+  const int64_t now = monotonic_us();
+
+  if (error_code == EBACKUPREQUEST) {
+    // Hedge: fire a second attempt, keep waiting for whichever response
+    // arrives first (reference controller.cpp:337, docs/en/backup_request.md).
+    // A failed backup issue must not poison the still-pending primary call:
+    // clear any error the issuer recorded.
+    cntl->backup_fired_ = true;
+    if (c.issuer && c.issuer->IssueRPC(cntl) != 0) {
+      cntl->error_code_ = 0;
+      cntl->error_text_.clear();
+    }
+    fid_unlock(id);
+    return 0;
+  }
+
+  const bool before_deadline = c.abs_deadline_us < 0 || now < c.abs_deadline_us;
+  if (Retryable(error_code) && before_deadline && c.issuer) {
+    // Synchronous issue failures (connect refused) loop here; asynchronous
+    // ones (write failed later) come back through another fid_error.
+    while (c.remaining_retries > 0) {
+      --c.remaining_retries;
+      ++cntl->retried_;
+      if (c.issuer->IssueRPC(cntl) == 0) {
+        fid_unlock(id);
+        return 0;
+      }
+    }
+    if (!cntl->Failed()) cntl->SetFailed(error_code);
+  } else {
+    cntl->SetFailed(error_code);
+  }
+  cntl->EndRPC();
+  return 0;
+}
+
+void Controller::OnResponse(RpcMeta&& meta, IOBuf&& body) {
+  Call& c = call;
+  if (meta.error_code != 0) {
+    // Server-reported failure: retryable codes re-issue like socket errors.
+    const int64_t now = monotonic_us();
+    const bool before_deadline =
+        c.abs_deadline_us < 0 || now < c.abs_deadline_us;
+    if (Retryable(meta.error_code) && c.remaining_retries > 0 &&
+        before_deadline && c.issuer) {
+      --c.remaining_retries;
+      ++retried_;
+      if (c.issuer->IssueRPC(this) == 0) {
+        fid_unlock(cid_);
+        return;
+      }
+    }
+    error_code_ = meta.error_code;
+    error_text_ = !meta.error_text.empty() ? meta.error_text
+                                           : RpcErrorText(meta.error_code);
+    EndRPC();
+    return;
+  }
+  // Success: any error recorded by a failed earlier attempt (retry/backup
+  // issue failure) is superseded by this response.
+  error_code_ = 0;
+  error_text_.clear();
+  const size_t att = meta.attachment_size;
+  const size_t payload = body.size() - att;
+  if (c.response) body.cutn(c.response, payload);
+  else body.pop_front(payload);
+  body.cutn(&response_attachment_, att);
+  EndRPC();
+}
+
+void Controller::EndRPC() {
+  Call& c = call;
+  set_latency(monotonic_us() - c.start_us);
+  const fid_t id = cid_;
+  Closure done;
+  done.swap(c.done);
+  // Exclusive connections: POOLED sockets go back to their group's freelist
+  // on success; errored POOLED sockets are closed (a late response may still
+  // be in flight on them) and SHORT sockets always close (reference
+  // socket_map.h:147 / adaptive_connection_type.h:30-36).
+  if (c.last_socket != INVALID_SOCKET_ID) {
+    const ConnectionType ct = ConnectionType(c.conn_type);
+    if (ct == ConnectionType::POOLED && error_code_ == 0) {
+      ReturnPooledSocket(remote_side_, c.last_socket, c.conn_group);
+    } else if (ct == ConnectionType::SHORT ||
+               (ct == ConnectionType::POOLED && error_code_ != 0)) {
+      SocketUniquePtr p;
+      if (Socket::Address(c.last_socket, &p) == 0) {
+        p->SetFailed(ECANCELED, "exclusive connection done");
+      }
+    }
+  }
+  // Timers: do not block on cancel — a concurrently running timeout callback
+  // only does fid_error, which is a no-op after the destroy below.
+  if (c.timeout_timer) timer_cancel_nonblocking(c.timeout_timer);
+  if (c.backup_timer) timer_cancel_nonblocking(c.backup_timer);
+  c.timeout_timer = c.backup_timer = kInvalidTimerId;
+  // Destroy wakes synchronous joiners and invalidates future fid_error
+  // (timeout/cancel racing in are dropped) — the reference's
+  // unlock_and_destroy contract (id.h:35).
+  fid_unlock_and_destroy(id);
+  if (done) done();
+}
+
+}  // namespace brt

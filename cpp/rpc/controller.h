@@ -1,0 +1,137 @@
+// Per-RPC state machine for both client and server side.
+// Parity target: reference src/brpc/controller.h:113 — deadline, retries,
+// backup request, attachments, error code/text, cancellation; client-side
+// completion funnel serialized by the correlation id (bthread_id /
+// OnVersionedRPCReturned, controller.cpp:581), timeout via the timer thread
+// (controller.cpp:576).
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <string>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include "fiber/fiber_id.h"
+#include "fiber/timer.h"
+#include "rpc/brt_meta.h"
+#include "rpc/errors.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+class Controller;
+using Closure = std::function<void()>;
+
+// Implemented by Channel and the combo channels: (re-)issues the packed
+// request for one attempt. Called with the correlation id LOCKED.
+class CallIssuer {
+ public:
+  virtual ~CallIssuer() = default;
+  virtual int IssueRPC(Controller* cntl) = 0;
+};
+
+class Controller {
+ public:
+  Controller() = default;
+  ~Controller();
+  Controller(const Controller&) = delete;
+  Controller& operator=(const Controller&) = delete;
+
+  // ---- options (effective for the next call through this controller) ----
+  // <0 means "inherit channel option"; timeout -1 after inherit = no deadline.
+  int64_t timeout_ms = INT64_MIN;
+  int max_retry = -1;
+  int64_t backup_request_ms = INT64_MIN;
+
+  // ---- error state ----
+  void SetFailed(int code, const char* fmt = nullptr, ...);
+  bool Failed() const { return error_code_ != 0; }
+  int ErrorCode() const { return error_code_; }
+  const std::string& ErrorText() const { return error_text_; }
+
+  // ---- payload extras ----
+  IOBuf& request_attachment() { return request_attachment_; }
+  IOBuf& response_attachment() { return response_attachment_; }
+
+  // ---- introspection ----
+  EndPoint remote_side() const { return remote_side_; }
+  EndPoint local_side() const { return local_side_; }
+  int64_t latency_us() const { return latency_us_; }
+  fid_t call_id() const { return cid_; }
+  int retried_count() const { return retried_; }
+  bool has_backup_request() const { return backup_fired_; }
+
+  // Requests cancellation of the in-flight call; completion (done / sync
+  // wakeup) still happens exactly once. Safe from any thread.
+  void StartCancel() {
+    if (cid_) fid_error(cid_, ECANCELEDRPC);
+  }
+
+  // Resets error/latency state so the controller can be reused for another
+  // call (reference Controller::Reset).
+  void Reset();
+
+  // ---- tracing (rpcz span propagation, reference span.h:47) ----
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
+
+  // ================= internal (Channel / protocol / Server) =================
+  struct Call {
+    fid_t cid = 0;
+    CallIssuer* issuer = nullptr;
+    IOBuf request_body;            // retained for retries/backup
+    RpcMeta request_meta;          // cid/service/method prefilled
+    IOBuf* response = nullptr;     // user output
+    Closure done;                  // empty = synchronous call
+    int64_t abs_deadline_us = -1;  // monotonic
+    int64_t start_us = 0;
+    int remaining_retries = 0;
+    TimerId timeout_timer = kInvalidTimerId;
+    TimerId backup_timer = kInvalidTimerId;
+    SocketId last_socket = INVALID_SOCKET_ID;
+    int conn_type = 0;   // ConnectionType; POOLED sockets return on success
+    int conn_group = 0;  // SocketMap group the socket came from
+    // Sub-call bookkeeping for combo channels (parallel_channel.cpp:46).
+    void* parent_done = nullptr;
+    int sub_index = -1;
+  };
+  Call call;
+
+  // fid on_error handler: serializes timeout / cancel / socket-failure /
+  // backup-request events (reference OnVersionedRPCReturned).
+  static int HandleError(fid_t id, void* data, int error_code);
+
+  // Response arrival (id already locked by the caller).
+  void OnResponse(RpcMeta&& meta, IOBuf&& body);
+
+  // Finalizes: destroys the id, records latency, runs done / wakes joiner.
+  // Id must be locked; consumed by this call.
+  void EndRPC();
+
+  void set_remote_side(const EndPoint& ep) { remote_side_ = ep; }
+  void set_local_side(const EndPoint& ep) { local_side_ = ep; }
+  void set_latency(int64_t us) { latency_us_ = us; }
+  void set_cid(fid_t id) { cid_ = id; }
+
+  // Server side: accounting cookie (MethodStatus*), response meta basis.
+  void* server_cookie = nullptr;
+  uint64_t server_cid = 0;
+
+ private:
+  int error_code_ = 0;
+  std::string error_text_;
+  IOBuf request_attachment_;
+  IOBuf response_attachment_;
+  EndPoint remote_side_;
+  EndPoint local_side_;
+  int64_t latency_us_ = 0;
+  int retried_ = 0;
+  bool backup_fired_ = false;
+  fid_t cid_ = 0;
+
+  friend class Channel;
+};
+
+}  // namespace brt

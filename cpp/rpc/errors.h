@@ -1,0 +1,26 @@
+// RPC error space (reference: src/brpc/errno.proto — ENOSERVICE/ENOMETHOD/
+// ERPCTIMEDOUT/EFAILEDSOCKET/... share the errno namespace above 1000).
+#pragma once
+
+namespace brt {
+
+enum RpcError {
+  ENOSERVICE = 1001,     // service not found on server
+  ENOMETHOD = 1002,      // method not found in service
+  EREQUEST = 1003,       // malformed request
+  ETOOMANYFAILS = 1005,  // too many sub-channel failures (ParallelChannel)
+  EBACKUPREQUEST = 1007, // internal: backup-request timer fired
+  ERPCTIMEDOUT = 1008,   // RPC deadline exceeded
+  EFAILEDSOCKET = 1009,  // the connection broke during the RPC
+  EOVERCROWDED = 1011,   // too many buffered writes
+  EINTERNAL = 2001,      // server-side internal error
+  ERESPONSE = 2002,      // malformed response
+  ELOGOFF = 2003,        // server is stopping
+  ELIMIT = 2004,         // concurrency limit reached
+  ECANCELEDRPC = 2005,   // StartCancel()ed by caller
+};
+
+// Human-readable name for the codes above; falls back to strerror.
+const char* RpcErrorText(int code);
+
+}  // namespace brt

@@ -1,0 +1,192 @@
+// The brt_std wire protocol plugged into the InputMessenger.
+// Server path mirrors reference ProcessRpcRequest
+// (policy/baidu_rpc_protocol.cpp:327): concurrency check → find service →
+// user CallMethod in this fiber → done sends the response via the wait-free
+// Socket::Write. Client path mirrors ProcessRpcResponse (:584): lock the
+// correlation id, hand the frame to the Controller (which owns the
+// retry/timeout/backup race resolution).
+#include "rpc/protocol_brt.h"
+
+#include <mutex>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "rpc/controller.h"
+#include "rpc/server.h"
+#include "transport/input_messenger.h"
+
+namespace brt {
+
+uint32_t FLAGS_max_body_size = 64u * 1024 * 1024;
+
+namespace {
+
+std::atomic<StreamFrameHandler> g_stream_handler{nullptr};
+
+constexpr size_t kHeaderLen = 12;
+
+ParseResult BrtParse(IOBuf* source, IOBuf* msg, Socket*) {
+  if (source->size() < kHeaderLen) return ParseResult::NOT_ENOUGH_DATA;
+  char hdr[kHeaderLen];
+  source->copy_to(hdr, kHeaderLen);
+  if (memcmp(hdr, "BRT1", 4) != 0) return ParseResult::TRY_OTHER;
+  uint32_t mlen = (uint8_t(hdr[4]) << 24) | (uint8_t(hdr[5]) << 16) |
+                  (uint8_t(hdr[6]) << 8) | uint8_t(hdr[7]);
+  uint32_t blen = (uint8_t(hdr[8]) << 24) | (uint8_t(hdr[9]) << 16) |
+                  (uint8_t(hdr[10]) << 8) | uint8_t(hdr[11]);
+  if (mlen > 64 * 1024) return ParseResult::ERROR;
+  if (blen > FLAGS_max_body_size) return ParseResult::ERROR;
+  const size_t total = kHeaderLen + size_t(mlen) + blen;
+  if (source->size() < total) return ParseResult::NOT_ENOUGH_DATA;
+  source->cutn(msg, total);
+  return ParseResult::OK;
+}
+
+// One in-flight server-side request (freed by the done closure).
+struct RpcSession {
+  Controller cntl;
+  IOBuf request;
+  IOBuf response;
+  SocketId sock = INVALID_SOCKET_ID;
+  uint64_t cid = 0;
+  Server* server = nullptr;
+  MethodStatus* mstatus = nullptr;
+  int64_t start_us = 0;
+};
+
+void SendResponse(RpcSession* sess) {
+  const int64_t lat = monotonic_us() - sess->start_us;
+  RpcMeta meta;
+  meta.type = MetaType::RESPONSE;
+  meta.correlation_id = sess->cid;
+  meta.error_code = sess->cntl.ErrorCode();
+  if (meta.error_code) meta.error_text = sess->cntl.ErrorText();
+  meta.attachment_size = sess->cntl.response_attachment().size();
+  IOBuf body;
+  body.append(std::move(sess->response));
+  body.append(std::move(sess->cntl.response_attachment()));
+  IOBuf frame;
+  PackFrame(&frame, meta, std::move(body));
+  SocketUniquePtr ptr;
+  if (Socket::Address(sess->sock, &ptr) == 0) ptr->Write(&frame);
+  if (sess->mstatus) sess->mstatus->OnResponded(meta.error_code, lat);
+  if (sess->server) {
+    sess->server->OnRequestDone();
+    sess->server->requests_processed.fetch_add(1, std::memory_order_relaxed);
+  }
+  delete sess;
+}
+
+// Failure answer without a session (bad request / no server / limits).
+void SendErrorResponse(SocketId sock, uint64_t cid, int code,
+                       const char* text) {
+  RpcMeta meta;
+  meta.type = MetaType::RESPONSE;
+  meta.correlation_id = cid;
+  meta.error_code = code;
+  meta.error_text = text ? text : RpcErrorText(code);
+  IOBuf frame;
+  PackFrame(&frame, meta, IOBuf());
+  SocketUniquePtr ptr;
+  if (Socket::Address(sock, &ptr) == 0) ptr->Write(&frame);
+}
+
+void ProcessRequest(RpcMeta&& meta, IOBuf&& body, SocketId sock,
+                    Socket* s) {
+  auto* server = static_cast<Server*>(s->user());
+  if (!server || !server->IsRunning()) {
+    SendErrorResponse(sock, meta.correlation_id, ELOGOFF, nullptr);
+    return;
+  }
+  if (!server->OnRequestArrived()) {
+    SendErrorResponse(sock, meta.correlation_id, ELIMIT, nullptr);
+    return;
+  }
+  Service* svc = server->FindService(meta.service);
+  if (!svc) {
+    server->OnRequestDone();
+    SendErrorResponse(sock, meta.correlation_id, ENOSERVICE, nullptr);
+    return;
+  }
+  MethodStatus* ms = server->GetMethodStatus(meta.service, meta.method);
+  if (!ms->OnRequested()) {
+    server->OnRequestDone();
+    SendErrorResponse(sock, meta.correlation_id, ELIMIT, nullptr);
+    return;
+  }
+  auto* sess = new RpcSession;
+  sess->sock = sock;
+  sess->cid = meta.correlation_id;
+  sess->server = server;
+  sess->mstatus = ms;
+  sess->start_us = monotonic_us();
+  sess->cntl.set_remote_side(s->remote());
+  sess->cntl.trace_id = meta.trace_id;
+  sess->cntl.parent_span_id = meta.span_id;
+  // Split payload / attachment.
+  const size_t att = meta.attachment_size;
+  const size_t payload = body.size() - att;
+  body.cutn(&sess->request, payload);
+  body.cutn(&sess->cntl.request_attachment(), att);
+  const std::string method = std::move(meta.method);
+  svc->CallMethod(method, &sess->cntl, sess->request, &sess->response,
+                  [sess] { SendResponse(sess); });
+}
+
+void ProcessResponse(RpcMeta&& meta, IOBuf&& body) {
+  const fid_t cid = meta.correlation_id;
+  void* data = nullptr;
+  if (fid_lock(cid, &data) != 0) {
+    // Late response after timeout/cancel, or the loser of a backup-request
+    // race: silently dropped (reference controller.cpp:581 EINVAL path).
+    return;
+  }
+  static_cast<Controller*>(data)->OnResponse(std::move(meta), std::move(body));
+}
+
+void BrtProcess(IOBuf&& msg, SocketId sock) {
+  RpcMeta meta;
+  IOBuf body;
+  const int rc = ParseFrame(&msg, &meta, &body);
+  SocketUniquePtr ptr;
+  if (Socket::Address(sock, &ptr) != 0) return;
+  if (rc != 0) {
+    ptr->SetFailed(EBADMSG, "malformed brt frame");
+    return;
+  }
+  switch (meta.type) {
+    case MetaType::REQUEST:
+      ProcessRequest(std::move(meta), std::move(body), sock, ptr.get());
+      break;
+    case MetaType::RESPONSE:
+      ProcessResponse(std::move(meta), std::move(body));
+      break;
+    case MetaType::STREAM: {
+      StreamFrameHandler h = g_stream_handler.load(std::memory_order_acquire);
+      if (h) h(std::move(meta), std::move(body), sock);
+      break;
+    }
+  }
+}
+
+int g_proto_index = -1;
+
+}  // namespace
+
+void SetStreamFrameHandler(StreamFrameHandler h) {
+  g_stream_handler.store(h, std::memory_order_release);
+}
+
+int RegisterBrtProtocol() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.name = "brt_std";
+    p.parse = BrtParse;
+    p.process = BrtProcess;
+    g_proto_index = RegisterProtocol(p);
+  });
+  return g_proto_index;
+}
+
+}  // namespace brt

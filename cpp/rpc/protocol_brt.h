@@ -1,0 +1,26 @@
+// brt_std protocol registration with the InputMessenger (reference:
+// RegisterProtocol of baidu_std in global.cpp:409 + the server/client
+// process paths of policy/baidu_rpc_protocol.cpp:327,584).
+#pragma once
+
+#include <cstdint>
+
+#include "rpc/brt_meta.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+// Idempotent; returns the protocol index.
+int RegisterBrtProtocol();
+
+// Largest accepted frame body; oversized frames fail the connection
+// (reference FLAGS_max_body_size, protocol.cpp — default 64MB).
+extern uint32_t FLAGS_max_body_size;
+
+// Hook for the streaming layer: frames with meta.type == STREAM are handed
+// here (set by stream.cc at init; null → frames dropped).
+using StreamFrameHandler = void (*)(RpcMeta&& meta, IOBuf&& body,
+                                    SocketId sock);
+void SetStreamFrameHandler(StreamFrameHandler h);
+
+}  // namespace brt

@@ -1,0 +1,96 @@
+#include "rpc/server.h"
+
+#include "base/logging.h"
+#include "fiber/fiber.h"
+#include "rpc/protocol_brt.h"
+#include "transport/input_messenger.h"
+
+namespace brt {
+
+Server::~Server() {
+  Stop();
+  Join();
+}
+
+int Server::AddService(Service* svc, const std::string& name) {
+  if (running_.load()) return EPERM;
+  if (!svc || name.empty()) return EINVAL;
+  if (!services_.emplace(name, svc).second) return EEXIST;
+  return 0;
+}
+
+int Server::Start(const std::string& addr, const Options* opts) {
+  EndPoint ep;
+  if (!EndPoint::parse(addr, &ep)) return EINVAL;
+  return Start(ep, opts);
+}
+
+int Server::Start(const EndPoint& addr, const Options* opts) {
+  if (running_.exchange(true)) return EPERM;
+  if (opts) options_ = *opts;
+  fiber_init(options_.fiber_workers);
+  RegisterBrtProtocol();
+  acceptor_.conn_options.user = this;
+  acceptor_.conn_options.on_edge_triggered = InputMessengerOnEdgeTriggered;
+  int rc = acceptor_.StartAccept(addr);
+  if (rc != 0) {
+    running_.store(false);
+    return rc;
+  }
+  BRT_LOG(INFO) << "server started on " << listen_address().to_string();
+  return 0;
+}
+
+int Server::Stop() {
+  if (!running_.exchange(false)) return 0;
+  acceptor_.StopAccept();
+  // Fail every accepted connection pointing at this server: their sockets
+  // hold a raw user_ cookie, and a frame arriving after ~Server would be a
+  // use-after-free. In-flight requests are covered by Join().
+  std::vector<SocketId> all;
+  Socket::ListSockets(&all);
+  for (SocketId sid : all) {
+    SocketUniquePtr p;
+    if (Socket::Address(sid, &p) == 0 && p->user() == this) {
+      p->SetFailed(ELOGOFF, "server stopped");
+    }
+  }
+  return 0;
+}
+
+int Server::Join() {
+  while (concurrency_.load(std::memory_order_acquire) > 0) {
+    fiber_usleep(10 * 1000);
+  }
+  return 0;
+}
+
+Service* Server::FindService(const std::string& name) const {
+  auto it = services_.find(name);
+  return it == services_.end() ? nullptr : it->second;
+}
+
+MethodStatus* Server::GetMethodStatus(const std::string& service,
+                                      const std::string& method) {
+  std::string key = service + "." + method;
+  {
+    std::shared_lock lk(method_mu_);
+    auto it = methods_.find(key);
+    if (it != methods_.end()) return it->second.get();
+  }
+  std::unique_lock lk(method_mu_);
+  // Bound the map: method names come off the wire, and each entry pins a
+  // sampler-registered LatencyRecorder forever — a client sending random
+  // names must not grow memory without bound.
+  constexpr size_t kMaxTrackedMethods = 1024;
+  if (methods_.size() >= kMaxTrackedMethods) {
+    auto& overflow = methods_["*overflow*"];
+    if (!overflow) overflow = std::make_unique<MethodStatus>();
+    return overflow.get();
+  }
+  auto& slot = methods_[key];
+  if (!slot) slot = std::make_unique<MethodStatus>();
+  return slot.get();
+}
+
+}  // namespace brt

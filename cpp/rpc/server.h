@@ -1,0 +1,113 @@
+// Server: owns services, acceptor, per-method accounting, concurrency
+// limiting. Parity target: reference src/brpc/server.h:347 (AddService /
+// Start / Stop / Join, ServerOptions max_concurrency server.h:129,
+// per-method MethodStatus details/method_status.h:33) and the request
+// lifecycle of SURVEY §3.1 (baidu_rpc_protocol.cpp:327 ProcessRpcRequest →
+// user CallMethod → SendRpcResponse).
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+
+#include "rpc/controller.h"
+#include "transport/acceptor.h"
+#include "var/latency_recorder.h"
+
+namespace brt {
+
+// User-implemented service. `done` must run exactly once (possibly after
+// CallMethod returns — asynchronous handlers are first-class, reference
+// docs/en/server.md "Asynchronous service").
+class Service {
+ public:
+  virtual ~Service() = default;
+  virtual void CallMethod(const std::string& method, Controller* cntl,
+                          const IOBuf& request, IOBuf* response,
+                          Closure done) = 0;
+};
+
+// Per-method stats + concurrency gate (reference details/method_status.h).
+struct MethodStatus {
+  var::LatencyRecorder latency;
+  std::atomic<int> concurrency{0};
+  std::atomic<uint64_t> nerror{0};
+  int max_concurrency = 0;  // 0 = inherit server-wide only
+
+  bool OnRequested() {
+    int c = concurrency.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (max_concurrency > 0 && c > max_concurrency) {
+      concurrency.fetch_sub(1, std::memory_order_relaxed);
+      return false;
+    }
+    return true;
+  }
+  void OnResponded(int error_code, int64_t latency_us) {
+    concurrency.fetch_sub(1, std::memory_order_relaxed);
+    if (error_code == 0) latency << latency_us;
+    else nerror.fetch_add(1, std::memory_order_relaxed);
+  }
+};
+
+class Server {
+ public:
+  struct Options {
+    int max_concurrency = 0;  // 0 = unlimited (reference server.h:129)
+    int fiber_workers = 0;    // fiber_init hint
+  };
+
+  Server() = default;
+  ~Server();
+
+  // Registers `svc` under `name` (the wire meta.service key). Must precede
+  // Start. Ownership stays with the caller.
+  int AddService(Service* svc, const std::string& name);
+
+  // Binds "ip:port" (port 0 = ephemeral) and serves. Returns 0 on success.
+  int Start(const std::string& addr, const Options* opts = nullptr);
+  int Start(const EndPoint& addr, const Options* opts = nullptr);
+
+  // Stops accepting and answers new requests with ELOGOFF.
+  int Stop();
+  // Blocks until in-flight requests drain.
+  int Join();
+
+  const EndPoint& listen_address() const { return acceptor_.listen_point(); }
+  bool IsRunning() const { return running_.load(std::memory_order_acquire); }
+
+  // ---- used by the protocol layer ----
+  Service* FindService(const std::string& name) const;
+  MethodStatus* GetMethodStatus(const std::string& service,
+                                const std::string& method);
+  bool OnRequestArrived() {
+    int c = concurrency_.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (options_.max_concurrency > 0 && c > options_.max_concurrency) {
+      concurrency_.fetch_sub(1, std::memory_order_relaxed);
+      return false;
+    }
+    return true;
+  }
+  void OnRequestDone() {
+    concurrency_.fetch_sub(1, std::memory_order_relaxed);
+  }
+  int current_concurrency() const {
+    return concurrency_.load(std::memory_order_relaxed);
+  }
+  const Options& options() const { return options_; }
+
+  // Builtin-service hook points (observability layer).
+  std::atomic<uint64_t> requests_processed{0};
+
+ private:
+  Options options_;
+  Acceptor acceptor_;
+  std::unordered_map<std::string, Service*> services_;
+  mutable std::shared_mutex method_mu_;
+  std::unordered_map<std::string, std::unique_ptr<MethodStatus>> methods_;
+  std::atomic<int> concurrency_{0};
+  std::atomic<bool> running_{false};
+};
+
+}  // namespace brt

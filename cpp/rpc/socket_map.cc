@@ -1,0 +1,119 @@
+#include "rpc/socket_map.h"
+
+#include <deque>
+#include <mutex>
+#include <shared_mutex>
+#include <unordered_map>
+
+#include "transport/input_messenger.h"
+
+namespace brt {
+
+namespace {
+
+struct MapKey {
+  EndPoint ep;
+  int group;
+  bool operator==(const MapKey&) const = default;
+};
+
+struct MapKeyHash {
+  size_t operator()(const MapKey& k) const {
+    return (size_t(k.ep.ip) << 16) ^ k.ep.port ^ (size_t(k.group) << 48);
+  }
+};
+
+struct Entry {
+  SocketId single = INVALID_SOCKET_ID;
+  std::deque<SocketId> pooled;
+};
+
+std::shared_mutex g_mu;
+std::unordered_map<MapKey, Entry, MapKeyHash> g_map;
+
+int NewConnection(const EndPoint& remote, SocketUniquePtr* out,
+                  int64_t timeout_us) {
+  Socket::Options opts;
+  opts.on_edge_triggered = InputMessengerOnEdgeTriggered;
+  // Failed sockets are dropped from the map so the next call reconnects
+  // (health-check-driven revival lands with the cluster layer).
+  opts.on_failed = [](Socket* s) { RemoveSingleSocket(s->remote(), s->id()); };
+  SocketId sid;
+  int rc = Socket::Connect(remote, opts, &sid, timeout_us);
+  if (rc != 0) return rc;
+  return Socket::Address(sid, out);
+}
+
+}  // namespace
+
+int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
+                   SocketUniquePtr* out, int64_t connect_timeout_us,
+                   int group) {
+  const MapKey key{remote, group};
+  if (type == ConnectionType::SHORT) {
+    return NewConnection(remote, out, connect_timeout_us);
+  }
+  if (type == ConnectionType::POOLED) {
+    for (;;) {
+      SocketId sid = INVALID_SOCKET_ID;
+      {
+        std::unique_lock lk(g_mu);
+        auto& e = g_map[key];
+        if (e.pooled.empty()) break;
+        sid = e.pooled.front();
+        e.pooled.pop_front();
+      }
+      if (Socket::Address(sid, out) == 0 && !(*out)->Failed()) return 0;
+      out->reset();
+    }
+    return NewConnection(remote, out, connect_timeout_us);
+  }
+  // SINGLE: shared multiplexed socket.
+  {
+    std::shared_lock lk(g_mu);
+    auto it = g_map.find(key);
+    if (it != g_map.end() && it->second.single != INVALID_SOCKET_ID) {
+      if (Socket::Address(it->second.single, out) == 0 && !(*out)->Failed()) {
+        return 0;
+      }
+      out->reset();
+    }
+  }
+  // Connect OUTSIDE g_mu: a failing connect runs the socket's on_failed
+  // (→ RemoveSingleSocket) on this thread, which must be free to relock.
+  // Losers of a concurrent-connect race close their extra socket.
+  int rc = NewConnection(remote, out, connect_timeout_us);
+  if (rc != 0) return rc;
+  std::unique_lock lk(g_mu);
+  auto& e = g_map[key];
+  if (e.single != INVALID_SOCKET_ID) {
+    SocketUniquePtr winner;
+    if (Socket::Address(e.single, &winner) == 0 && !winner->Failed()) {
+      lk.unlock();
+      (*out)->SetFailed(ECANCELED, "lost connect race");
+      out->reset();
+      *out = std::move(winner);
+      return 0;
+    }
+  }
+  e.single = (*out)->id();
+  return 0;
+}
+
+void ReturnPooledSocket(const EndPoint& remote, SocketId sid, int group) {
+  SocketUniquePtr p;
+  if (Socket::Address(sid, &p) != 0 || p->Failed()) return;
+  std::unique_lock lk(g_mu);
+  g_map[MapKey{remote, group}].pooled.push_back(sid);
+}
+
+void RemoveSingleSocket(const EndPoint& remote, SocketId sid) {
+  // The failing socket may belong to any group: sweep matches (failure is
+  // rare; the map is small).
+  std::unique_lock lk(g_mu);
+  for (auto& [k, e] : g_map) {
+    if (k.ep == remote && e.single == sid) e.single = INVALID_SOCKET_ID;
+  }
+}
+
+}  // namespace brt

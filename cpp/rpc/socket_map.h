@@ -1,0 +1,33 @@
+// Process-wide client connection pool keyed by remote endpoint.
+// Parity target: reference src/brpc/socket_map.h:147 (GetOrNewSocket keyed
+// by (endpoint, ChannelSignature)) + connection types
+// (adaptive_connection_type.h:30-36: SINGLE multiplexed / POOLED per-call /
+// SHORT). Redesigned: SINGLE is the fast path via a shared_mutex map;
+// POOLED keeps a per-endpoint freelist of exclusive sockets.
+#pragma once
+
+#include "base/endpoint.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+enum class ConnectionType { SINGLE, POOLED, SHORT };
+
+// Returns a live socket to `remote`, creating/reviving as needed.
+// For SINGLE the same multiplexed socket is shared by all callers with the
+// same `group` (the reference keys its SocketMap by (endpoint,
+// ChannelSignature), socket_map.h:147 — `group` plays the signature role;
+// channels wanting a private connection pass a distinct group).
+// For POOLED/SHORT an exclusive socket is returned; give it back with
+// ReturnPooledSocket (POOLED) or just SetFailed+drop it (SHORT).
+int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
+                   SocketUniquePtr* out, int64_t connect_timeout_us,
+                   int group = 0);
+
+void ReturnPooledSocket(const EndPoint& remote, SocketId sid, int group = 0);
+
+// Drops the cached SINGLE socket for `remote` if it matches sid (called on
+// failure so the next call reconnects).
+void RemoveSingleSocket(const EndPoint& remote, SocketId sid);
+
+}  // namespace brt

@@ -1,0 +1,248 @@
+// RPC-core tests: loopback Server + Channel (the reference's key test
+// pattern, SURVEY §4 — real servers on 127.0.0.1 inside the test process,
+// model test/brpc_server_unittest.cpp / brpc_channel_unittest.cpp).
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/brt_meta.h"
+#include "rpc/channel.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+namespace {
+
+class EchoService : public Service {
+ public:
+  std::atomic<int> calls{0};
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    calls.fetch_add(1);
+    if (method == "Echo") {
+      response->append(request);
+      // attachment round-trips too
+      cntl->response_attachment() = cntl->request_attachment();
+    } else if (method == "Fail") {
+      cntl->SetFailed(EINTERNAL, "requested failure");
+    } else if (method == "Slow") {
+      fiber_usleep(300 * 1000);
+      response->append("slow done");
+    } else {
+      cntl->SetFailed(ENOMETHOD, nullptr);
+    }
+    done();
+  }
+};
+
+void test_meta_roundtrip() {
+  RpcMeta m;
+  m.type = MetaType::RESPONSE;
+  m.correlation_id = 0x1234567890abcdefULL;
+  m.service = "EchoService";
+  m.method = "Echo";
+  m.error_code = 1008;
+  m.error_text = "rpc timed out";
+  m.attachment_size = 42;
+  m.timeout_ms = 500;
+  m.trace_id = 7;
+  std::string buf;
+  EncodeMeta(m, &buf);
+  RpcMeta d;
+  assert(DecodeMeta(buf.data(), buf.size(), &d));
+  assert(d.type == m.type && d.correlation_id == m.correlation_id);
+  assert(d.service == m.service && d.method == m.method);
+  assert(d.error_code == m.error_code && d.error_text == m.error_text);
+  assert(d.attachment_size == 42 && d.timeout_ms == 500 && d.trace_id == 7);
+  printf("meta_roundtrip OK\n");
+}
+
+void test_sync_echo(Channel& ch) {
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("hello rpc");
+  cntl.request_attachment().append("ATTACH");
+  ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  assert(rsp.to_string() == "hello rpc");
+  assert(cntl.response_attachment().to_string() == "ATTACH");
+  assert(cntl.latency_us() > 0);
+  printf("sync_echo OK (latency=%ldus)\n", long(cntl.latency_us()));
+}
+
+void test_async_echo(Channel& ch) {
+  auto* cntl = new Controller;
+  auto* rsp = new IOBuf;
+  IOBuf req;
+  req.append("async payload");
+  CountdownEvent ev(1);
+  ch.CallMethod("Echo", "Echo", cntl, req, rsp, [&] {
+    assert(!cntl->Failed());
+    assert(rsp->to_string() == "async payload");
+    ev.signal();
+  });
+  assert(ev.wait(5 * 1000 * 1000) == 0);
+  delete cntl;
+  delete rsp;
+  printf("async_echo OK\n");
+}
+
+void test_server_error(Channel& ch) {
+  Controller cntl;
+  IOBuf req, rsp;
+  ch.CallMethod("Echo", "Fail", &cntl, req, &rsp, nullptr);
+  assert(cntl.Failed());
+  assert(cntl.ErrorCode() == EINTERNAL);
+  assert(cntl.ErrorText() == "requested failure");
+  printf("server_error OK\n");
+}
+
+void test_no_service(Channel& ch) {
+  Controller cntl;
+  IOBuf req, rsp;
+  ch.CallMethod("Nope", "Echo", &cntl, req, &rsp, nullptr);
+  assert(cntl.Failed() && cntl.ErrorCode() == ENOSERVICE);
+  Controller cntl2;
+  ch.CallMethod("Echo", "Nope", &cntl2, req, &rsp, nullptr);
+  assert(cntl2.Failed() && cntl2.ErrorCode() == ENOMETHOD);
+  printf("no_service/no_method OK\n");
+}
+
+void test_timeout(Channel& ch) {
+  Controller cntl;
+  cntl.timeout_ms = 50;  // Slow takes 300ms
+  IOBuf req, rsp;
+  ch.CallMethod("Echo", "Slow", &cntl, req, &rsp, nullptr);
+  assert(cntl.Failed());
+  assert(cntl.ErrorCode() == ERPCTIMEDOUT);
+  assert(cntl.latency_us() >= 50 * 1000 && cntl.latency_us() < 250 * 1000);
+  printf("timeout OK\n");
+}
+
+void test_cancel(Channel& ch) {
+  auto* cntl = new Controller;
+  cntl->timeout_ms = 5000;
+  IOBuf req;
+  auto* rsp = new IOBuf;
+  CountdownEvent ev(1);
+  ch.CallMethod("Echo", "Slow", cntl, req, rsp, [&] { ev.signal(); });
+  cntl->StartCancel();
+  assert(ev.wait(2 * 1000 * 1000) == 0);
+  assert(cntl->Failed() && cntl->ErrorCode() == ECANCELEDRPC);
+  delete cntl;
+  delete rsp;
+  printf("cancel OK\n");
+}
+
+void test_connect_fail_retry() {
+  Channel ch;
+  ChannelOptions opts;
+  opts.timeout_ms = 500;
+  opts.max_retry = 2;
+  opts.connect_timeout_us = 100 * 1000;
+  assert(ch.Init("127.0.0.1:1", &opts) == 0);  // nothing listens there
+  Controller cntl;
+  IOBuf req, rsp;
+  ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  assert(cntl.Failed());
+  assert(cntl.retried_count() == 2);
+  printf("connect_fail_retry OK (err=%d %s)\n", cntl.ErrorCode(),
+         cntl.ErrorText().c_str());
+}
+
+void test_big_payload(Channel& ch) {
+  std::string big(4 << 20, 'q');
+  for (size_t i = 0; i < big.size(); i += 1000) big[i] = char('A' + i % 26);
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append(big);
+  ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  assert(rsp.size() == big.size());
+  assert(rsp.to_string() == big);
+  printf("big_payload OK (4MB)\n");
+}
+
+void test_concurrent_calls(Channel& ch) {
+  constexpr int N = 64;
+  CountdownEvent ev(N);
+  std::atomic<int> ok{0};
+  struct Ctx {
+    Controller cntl;
+    IOBuf req, rsp;
+    CountdownEvent* ev;
+    std::atomic<int>* ok;
+    Channel* ch;
+  };
+  for (int i = 0; i < N; ++i) {
+    auto* c = new Ctx{.ev = &ev, .ok = &ok, .ch = &ch};
+    c->req.append("msg" + std::to_string(i));
+    fiber_t fid;
+    fiber_start(&fid, [](void* p) -> void* {
+      auto* c = static_cast<Ctx*>(p);
+      c->ch->CallMethod("Echo", "Echo", &c->cntl, c->req, &c->rsp, nullptr);
+      if (!c->cntl.Failed() && c->rsp.to_string() == c->req.to_string()) {
+        c->ok->fetch_add(1);
+      }
+      c->ev->signal();
+      delete c;
+      return nullptr;
+    }, c);
+  }
+  assert(ev.wait(10 * 1000 * 1000) == 0);
+  assert(ok.load() == N);
+  printf("concurrent_calls OK (%d fibers)\n", N);
+}
+
+void test_pooled_and_short(const EndPoint& addr) {
+  for (ConnectionType t : {ConnectionType::POOLED, ConnectionType::SHORT}) {
+    Channel ch;
+    ChannelOptions opts;
+    opts.connection_type = t;
+    assert(ch.Init(addr, &opts) == 0);
+    for (int i = 0; i < 3; ++i) {
+      Controller cntl;
+      IOBuf req, rsp;
+      req.append("conn");
+      ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+      assert(!cntl.Failed());
+      assert(rsp.to_string() == "conn");
+    }
+  }
+  printf("pooled_and_short OK\n");
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  test_meta_roundtrip();
+
+  Server server;
+  EchoService echo;
+  assert(server.AddService(&echo, "Echo") == 0);
+  assert(server.Start("127.0.0.1:0") == 0);
+  const EndPoint addr = server.listen_address();
+
+  Channel ch;
+  assert(ch.Init(addr) == 0);
+  test_sync_echo(ch);
+  test_async_echo(ch);
+  test_server_error(ch);
+  test_no_service(ch);
+  test_timeout(ch);
+  test_cancel(ch);
+  test_big_payload(ch);
+  test_concurrent_calls(ch);
+  test_pooled_and_short(addr);
+  test_connect_fail_retry();
+
+  server.Stop();
+  server.Join();
+  printf("ALL rpc tests OK\n");
+  return 0;
+}
