@@ -1,0 +1,89 @@
+// Per-node circuit breaker: EMA error windows (long + short) isolate a
+// node; isolation expires after a duration that doubles with consecutive
+// isolations. Parity target: reference src/brpc/circuit_breaker.h:25-48
+// (+ cluster_recover_policy.h safety valve, applied in cluster_channel.cc).
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+
+#include "base/time.h"
+
+namespace brt {
+
+class CircuitBreaker {
+ public:
+  struct Options {
+    // EMA window sizes in samples (reference flags
+    // circuit_breaker_long_window_size=1024 / short_window_size=128).
+    int long_window = 1024;
+    int short_window = 128;
+    // Max tolerated error ratio of the windows (reference
+    // *_error_rate flags: 1% long / 5% short).
+    double long_max_error_rate = 0.01;
+    double short_max_error_rate = 0.05;
+    int64_t min_isolation_us = 100 * 1000;        // 100ms
+    int64_t max_isolation_us = 30 * 1000 * 1000;  // 30s
+  };
+
+  CircuitBreaker() : opt_(Options{}) {}
+  explicit CircuitBreaker(const Options& opt) : opt_(opt) {}
+
+  // Returns false if this call's outcome isolates the node.
+  bool OnCallEnd(int error_code) {
+    if (isolated()) return false;
+    const double err = error_code == 0 ? 0.0 : 1.0;
+    const double l = update_ema(long_ema_, err, opt_.long_window);
+    const double s = update_ema(short_ema_, err, opt_.short_window);
+    // Require a minimum sample count before tripping.
+    const int64_t n = samples_.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (n < opt_.short_window / 4) return true;
+    if (l > opt_.long_max_error_rate || s > opt_.short_max_error_rate) {
+      Isolate();
+      return false;
+    }
+    return true;
+  }
+
+  bool isolated() const {
+    return monotonic_us() <
+           isolation_until_us_.load(std::memory_order_acquire);
+  }
+
+  void Isolate() {
+    const int k = std::min(isolation_count_.fetch_add(1) + 1, 8);
+    const int64_t dur = std::min(opt_.min_isolation_us << (k - 1),
+                                 opt_.max_isolation_us);
+    isolation_until_us_.store(monotonic_us() + dur,
+                              std::memory_order_release);
+    // Reset windows so the half-open probe starts fresh.
+    long_ema_.store(0, std::memory_order_relaxed);
+    short_ema_.store(0, std::memory_order_relaxed);
+    samples_.store(0, std::memory_order_relaxed);
+  }
+
+  // Successful traffic after recovery decays the isolation backoff.
+  void OnRecoveredSuccess() {
+    int c = isolation_count_.load(std::memory_order_relaxed);
+    if (c > 0) isolation_count_.store(c - 1, std::memory_order_relaxed);
+  }
+
+ private:
+  // Fixed-point EMA (error rate ×10000) over `window` samples; returns the
+  // updated rate as a ratio in [0,1].
+  double update_ema(std::atomic<int64_t>& ema, double sample, int window) {
+    int64_t prev = ema.load(std::memory_order_relaxed);
+    int64_t next = prev + (int64_t(sample * 10000) - prev) / window;
+    ema.store(next, std::memory_order_relaxed);
+    return double(next) / 10000.0;
+  }
+
+  Options opt_;
+  std::atomic<int64_t> long_ema_{0}, short_ema_{0};
+  std::atomic<int64_t> samples_{0};
+  std::atomic<int64_t> isolation_until_us_{0};
+  std::atomic<int> isolation_count_{0};
+};
+
+}  // namespace brt

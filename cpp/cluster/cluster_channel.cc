@@ -1,0 +1,138 @@
+#include "cluster/cluster_channel.h"
+
+#include "base/time.h"
+#include "rpc/brt_meta.h"
+#include "rpc/protocol_brt.h"
+#include "rpc/socket_map.h"
+
+namespace brt {
+
+namespace {
+inline uint64_t ep_key(const EndPoint& ep) {
+  return (uint64_t(ep.ip) << 16) | ep.port;
+}
+}  // namespace
+
+ClusterChannel::~ClusterChannel() {
+  if (ns_) ns_->Stop();
+}
+
+int ClusterChannel::Init(const std::string& ns_url, const std::string& lb_name,
+                         const ChannelOptions* opts) {
+  if (opts) options_ = *opts;
+  lb_ = CreateLoadBalancer(lb_name);
+  if (!lb_) return EINVAL;
+  RegisterBrtProtocol();
+  ns_ = StartNamingService(ns_url, [this](const std::vector<ServerNode>& s) {
+    lb_->ResetServers(s);
+    std::lock_guard<std::mutex> g(nodes_mu_);
+    nodes_ = s;
+  });
+  if (!ns_) return EINVAL;
+  inited_ = true;
+  return 0;
+}
+
+std::vector<ServerNode> ClusterChannel::ListServers() const {
+  std::lock_guard<std::mutex> g(nodes_mu_);
+  return nodes_;
+}
+
+std::shared_ptr<CircuitBreaker> ClusterChannel::GetBreaker(
+    const EndPoint& ep) {
+  std::lock_guard<std::mutex> g(nodes_mu_);
+  auto& b = breakers_[ep_key(ep)];
+  if (!b) b = std::make_shared<CircuitBreaker>();
+  return b;
+}
+
+void ClusterChannel::OnCallEnd(Controller* cntl, void* arg) {
+  auto* self = static_cast<ClusterChannel*>(arg);
+  Controller::Call& c = cntl->call;
+  if (!c.attempt_pending) return;
+  c.attempt_pending = false;
+  const EndPoint ep = cntl->remote_side();
+  self->lb_->Feedback(ep, cntl->latency_us(), cntl->ErrorCode());
+  auto breaker = self->GetBreaker(ep);
+  breaker->OnCallEnd(cntl->ErrorCode());
+  if (cntl->ErrorCode() == 0) breaker->OnRecoveredSuccess();
+}
+
+int ClusterChannel::IssueRPC(Controller* cntl) {
+  Controller::Call& c = cntl->call;
+  c.on_end = OnCallEnd;
+  c.on_end_arg = this;
+
+  // Close out a failed previous attempt: feed the LB/breaker and exclude
+  // that node for the rest of this call (reference excluded_servers.h +
+  // CircuitBreaker::OnCallEnd).
+  if (c.attempt_pending) {
+    c.attempt_pending = false;
+    const EndPoint prev = cntl->remote_side();
+    const int err = cntl->Failed() ? cntl->ErrorCode() : EFAILEDSOCKET;
+    lb_->Feedback(prev, monotonic_us() - c.start_us, err);
+    GetBreaker(prev)->OnCallEnd(err);
+    c.excluded.push_back(prev);
+  }
+
+  // Selection exclusion = tried-this-call ∪ currently isolated.
+  std::vector<EndPoint> excl = c.excluded;
+  {
+    std::lock_guard<std::mutex> g(nodes_mu_);
+    for (const ServerNode& n : nodes_) {
+      auto it = breakers_.find(ep_key(n.ep));
+      if (it != breakers_.end() && it->second->isolated()) {
+        excl.push_back(n.ep);
+      }
+    }
+  }
+  SelectIn in;
+  in.request_code = cntl->request_code;
+  in.excluded = &excl;
+  SelectOut out;
+  int rc = lb_->SelectServer(in, &out);
+  if (rc != 0 && excl.size() > c.excluded.size()) {
+    // ClusterRecoverPolicy: every node isolated → ignore isolation and let
+    // a probe through rather than failing the whole cluster
+    // (cluster_recover_policy.h).
+    in.excluded = &c.excluded;
+    rc = lb_->SelectServer(in, &out);
+  }
+  if (rc != 0) {
+    cntl->SetFailed(EHOSTDOWN, "no available server in cluster");
+    return EHOSTDOWN;
+  }
+
+  SocketUniquePtr sock;
+  rc = GetOrNewSocket(out.node.ep, options_.connection_type, &sock,
+                      options_.connect_timeout_us,
+                      options_.connection_group);
+  if (rc != 0) {
+    // Connect failure counts against the node, then the caller's retry
+    // loop re-enters and excludes it.
+    cntl->set_remote_side(out.node.ep);
+    c.attempt_pending = true;
+    cntl->SetFailed(rc, "fail to connect %s",
+                    out.node.ep.to_string().c_str());
+    return rc;
+  }
+  if (c.last_socket != INVALID_SOCKET_ID && c.last_socket != sock->id()) {
+    SocketUniquePtr prev;
+    if (Socket::Address(c.last_socket, &prev) == 0) {
+      prev->RemoveWaiter(c.cid);
+    }
+  }
+  cntl->set_remote_side(out.node.ep);
+  c.attempt_pending = true;
+  c.last_socket = sock->id();
+  c.conn_type = int(options_.connection_type);
+  c.conn_group = options_.connection_group;
+  sock->AddWaiter(c.cid);
+  IOBuf frame;
+  IOBuf body = c.request_body;
+  PackFrame(&frame, c.request_meta, std::move(body));
+  sock->Write(&frame, c.cid);
+  return 0;
+}
+
+}  // namespace brt

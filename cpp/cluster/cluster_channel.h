@@ -1,0 +1,48 @@
+// Channel over a named cluster: NamingService feeds a LoadBalancer; every
+// attempt selects a (non-excluded, non-isolated) server, with per-node
+// circuit breakers and LB feedback on completion.
+// Parity target: reference Channel::Init(ns_url, lb_name)
+// (channel.cpp:319,356) + details/load_balancer_with_naming.{h,cpp} +
+// CircuitBreaker integration (OnCallEnd) + ClusterRecoverPolicy
+// (cluster_recover_policy.h: if every node is isolated, traffic is let
+// through anyway to probe recovery).
+#pragma once
+
+#include <memory>
+#include <mutex>
+#include <unordered_map>
+
+#include "cluster/circuit_breaker.h"
+#include "cluster/load_balancer.h"
+#include "cluster/naming_service.h"
+#include "rpc/channel.h"
+
+namespace brt {
+
+class ClusterChannel : public Channel {
+ public:
+  ClusterChannel() = default;
+  ~ClusterChannel() override;
+
+  // ns_url: "list://ip:port,...", "file://path", "dns://host:port".
+  // lb_name: "rr" | "random" | "wrr" | "wr" | "c_murmurhash" | "la".
+  int Init(const std::string& ns_url, const std::string& lb_name,
+           const ChannelOptions* opts = nullptr);
+
+  int IssueRPC(Controller* cntl) override;
+
+  // Snapshot of live nodes (builtin services / tests).
+  std::vector<ServerNode> ListServers() const;
+
+ private:
+  static void OnCallEnd(Controller* cntl, void* arg);
+  std::shared_ptr<CircuitBreaker> GetBreaker(const EndPoint& ep);
+
+  std::unique_ptr<NamingService> ns_;
+  std::unique_ptr<LoadBalancer> lb_;
+  mutable std::mutex nodes_mu_;
+  std::vector<ServerNode> nodes_;  // last pushed list
+  std::unordered_map<uint64_t, std::shared_ptr<CircuitBreaker>> breakers_;
+};
+
+}  // namespace brt

@@ -1,0 +1,357 @@
+#include "cluster/load_balancer.h"
+
+#include <algorithm>
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+
+#include "base/doubly_buffered.h"
+
+namespace brt {
+
+namespace {
+
+inline bool IsExcluded(const SelectIn& in, const EndPoint& ep) {
+  if (!in.excluded) return false;
+  for (const EndPoint& e : *in.excluded) {
+    if (e == ep) return true;
+  }
+  return false;
+}
+
+inline uint64_t thread_rand() {
+  // xorshift64* per thread — cheap, no locks (reference fast_rand.cpp role).
+  static thread_local uint64_t s =
+      0x9e3779b97f4a7c15ULL ^ uint64_t(uintptr_t(&s));
+  s ^= s >> 12;
+  s ^= s << 25;
+  s ^= s >> 27;
+  return s * 0x2545F4914F6CDD1DULL;
+}
+
+// 64-bit avalanche (splitmix64 finalizer) — stands in for murmur's fmix in
+// the consistent-hash ring (the reference uses murmurhash32,
+// policy/hasher.cpp; any well-mixed hash preserves the ring contract).
+inline uint64_t mix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+// ---------------- rr / random / wrr / wr --------------------------------
+
+struct PlainList {
+  std::vector<ServerNode> list;
+  uint64_t total_weight = 0;
+};
+
+class RoundRobinLB : public LoadBalancer {
+ public:
+  explicit RoundRobinLB(bool weighted = false) : weighted_(weighted) {}
+
+  void ResetServers(const std::vector<ServerNode>& servers) override {
+    dbd_.Modify([&](PlainList& bg) {
+      bg.list = servers;
+      bg.total_weight = 0;
+      for (const auto& n : servers) bg.total_weight += uint64_t(n.weight);
+      return true;
+    });
+  }
+
+  int SelectServer(const SelectIn& in, SelectOut* out) override {
+    DoublyBufferedData<PlainList>::ScopedPtr p;
+    dbd_.Read(&p);
+    const auto& list = p->list;
+    if (list.empty()) return EHOSTDOWN;
+    const uint64_t start = counter_.fetch_add(1, std::memory_order_relaxed);
+    if (!weighted_) {
+      for (size_t i = 0; i < list.size(); ++i) {
+        const ServerNode& n = list[(start + i) % list.size()];
+        if (!IsExcluded(in, n.ep)) {
+          out->node = n;
+          return 0;
+        }
+      }
+      return EHOSTDOWN;
+    }
+    // wrr: stride through cumulative weights (reference
+    // weighted_round_robin_load_balancer.cpp).
+    uint64_t tick = start % std::max<uint64_t>(p->total_weight, 1);
+    for (size_t rounds = 0; rounds < 2; ++rounds) {
+      for (const ServerNode& n : list) {
+        if (tick < uint64_t(n.weight)) {
+          if (!IsExcluded(in, n.ep)) {
+            out->node = n;
+            return 0;
+          }
+        }
+        tick = tick < uint64_t(n.weight) ? 0 : tick - uint64_t(n.weight);
+      }
+      // excluded hit: fall back to first non-excluded
+      for (const ServerNode& n : list) {
+        if (!IsExcluded(in, n.ep)) {
+          out->node = n;
+          return 0;
+        }
+      }
+      return EHOSTDOWN;
+    }
+    return EHOSTDOWN;
+  }
+
+  const char* name() const override { return weighted_ ? "wrr" : "rr"; }
+
+ private:
+  DoublyBufferedData<PlainList> dbd_;
+  std::atomic<uint64_t> counter_{0};
+  bool weighted_;
+};
+
+class RandomLB : public LoadBalancer {
+ public:
+  explicit RandomLB(bool weighted = false) : weighted_(weighted) {}
+
+  void ResetServers(const std::vector<ServerNode>& servers) override {
+    dbd_.Modify([&](PlainList& bg) {
+      bg.list = servers;
+      bg.total_weight = 0;
+      for (const auto& n : servers) bg.total_weight += uint64_t(n.weight);
+      return true;
+    });
+  }
+
+  int SelectServer(const SelectIn& in, SelectOut* out) override {
+    DoublyBufferedData<PlainList>::ScopedPtr p;
+    dbd_.Read(&p);
+    const auto& list = p->list;
+    if (list.empty()) return EHOSTDOWN;
+    for (int attempt = 0; attempt < 8; ++attempt) {
+      const ServerNode* n;
+      if (!weighted_) {
+        n = &list[thread_rand() % list.size()];
+      } else {
+        uint64_t t = thread_rand() % std::max<uint64_t>(p->total_weight, 1);
+        n = &list.back();
+        for (const ServerNode& cand : list) {
+          if (t < uint64_t(cand.weight)) {
+            n = &cand;
+            break;
+          }
+          t -= uint64_t(cand.weight);
+        }
+      }
+      if (!IsExcluded(in, n->ep)) {
+        out->node = *n;
+        return 0;
+      }
+    }
+    for (const ServerNode& n : list) {
+      if (!IsExcluded(in, n.ep)) {
+        out->node = n;
+        return 0;
+      }
+    }
+    return EHOSTDOWN;
+  }
+
+  const char* name() const override { return weighted_ ? "wr" : "random"; }
+
+ private:
+  DoublyBufferedData<PlainList> dbd_;
+  bool weighted_;
+};
+
+// ---------------- consistent hashing ------------------------------------
+
+struct HashRing {
+  std::vector<ServerNode> list;
+  // sorted (point, index into list); 64 virtual nodes per weight unit
+  std::vector<std::pair<uint64_t, uint32_t>> ring;
+};
+
+class ConsistentHashLB : public LoadBalancer {
+ public:
+  void ResetServers(const std::vector<ServerNode>& servers) override {
+    dbd_.Modify([&](HashRing& bg) {
+      bg.list = servers;
+      bg.ring.clear();
+      for (uint32_t i = 0; i < servers.size(); ++i) {
+        const uint64_t base =
+            (uint64_t(servers[i].ep.ip) << 16) | servers[i].ep.port;
+        const int vnodes = 64 * std::max(servers[i].weight, 1);
+        for (int v = 0; v < vnodes; ++v) {
+          bg.ring.emplace_back(mix64(base * 1315423911u + v), i);
+        }
+      }
+      std::sort(bg.ring.begin(), bg.ring.end());
+      return true;
+    });
+  }
+
+  int SelectServer(const SelectIn& in, SelectOut* out) override {
+    DoublyBufferedData<HashRing>::ScopedPtr p;
+    dbd_.Read(&p);
+    if (p->ring.empty()) return EHOSTDOWN;
+    const uint64_t point = mix64(in.request_code);
+    auto it = std::lower_bound(
+        p->ring.begin(), p->ring.end(),
+        std::make_pair(point, uint32_t(0)));
+    // Walk clockwise past excluded nodes (reference
+    // consistent_hashing_load_balancer.cpp same-direction probe).
+    for (size_t i = 0; i < p->ring.size(); ++i) {
+      if (it == p->ring.end()) it = p->ring.begin();
+      const ServerNode& n = p->list[it->second];
+      if (!IsExcluded(in, n.ep)) {
+        out->node = n;
+        return 0;
+      }
+      ++it;
+    }
+    return EHOSTDOWN;
+  }
+
+  const char* name() const override { return "c_murmurhash"; }
+
+ private:
+  DoublyBufferedData<HashRing> dbd_;
+};
+
+// ---------------- locality-aware ----------------------------------------
+
+// Per-node moving stats shared across list flips (keyed by endpoint).
+struct NodeStat {
+  std::atomic<int64_t> avg_latency_us{1};  // EMA, starts optimistic
+  std::atomic<int> inflight{0};
+  std::atomic<int64_t> errors{0};
+};
+
+struct LaList {
+  std::vector<ServerNode> list;
+  std::vector<std::shared_ptr<NodeStat>> stats;  // parallel to list
+};
+
+// Weight ∝ 1 / (latency × (inflight+1)) — the reference's la balancer
+// divides capacity by latency*inflight too (locality_aware_load_balancer.cpp,
+// docs/cn/lalb.md).
+class LocalityAwareLB : public LoadBalancer {
+ public:
+  void ResetServers(const std::vector<ServerNode>& servers) override {
+    std::lock_guard<std::mutex> g(stat_mu_);
+    dbd_.Modify([&](LaList& bg) {
+      bg.list = servers;
+      bg.stats.clear();
+      for (const auto& n : servers) {
+        auto key = (uint64_t(n.ep.ip) << 16) | n.ep.port;
+        auto& s = stat_pool_[key];
+        if (!s) s = std::make_shared<NodeStat>();
+        bg.stats.push_back(s);
+      }
+      return true;
+    });
+  }
+
+  int SelectServer(const SelectIn& in, SelectOut* out) override {
+    DoublyBufferedData<LaList>::ScopedPtr p;
+    dbd_.Read(&p);
+    const auto& list = p->list;
+    if (list.empty()) return EHOSTDOWN;
+    double best = -1;
+    int best_i = -1;
+    for (size_t i = 0; i < list.size(); ++i) {
+      if (IsExcluded(in, list[i].ep)) continue;
+      const auto& st = *p->stats[i];
+      const double lat = double(st.avg_latency_us.load(
+          std::memory_order_relaxed));
+      const double infl = double(st.inflight.load(std::memory_order_relaxed));
+      // Jittered score keeps cold nodes probed (reference uses explicit
+      // probing; random jitter achieves the same exploration).
+      const double w = double(list[i].weight) * 1e6 /
+                       (std::max(lat, 1.0) * (infl + 1.0));
+      const double score = w * (0.75 + double(thread_rand() % 1024) / 2048.0);
+      if (score > best) {
+        best = score;
+        best_i = int(i);
+      }
+    }
+    if (best_i < 0) return EHOSTDOWN;
+    p->stats[best_i]->inflight.fetch_add(1, std::memory_order_relaxed);
+    out->node = list[best_i];
+    return 0;
+  }
+
+  void Feedback(const EndPoint& server, int64_t latency_us,
+                int error_code) override {
+    std::shared_ptr<NodeStat> st;
+    {
+      std::lock_guard<std::mutex> g(stat_mu_);
+      auto it = stat_pool_.find((uint64_t(server.ip) << 16) | server.port);
+      if (it == stat_pool_.end()) return;
+      st = it->second;
+    }
+    st->inflight.fetch_sub(1, std::memory_order_relaxed);
+    if (error_code == 0) {
+      // EMA with alpha 1/8
+      int64_t prev = st->avg_latency_us.load(std::memory_order_relaxed);
+      st->avg_latency_us.store(prev + (latency_us - prev) / 8,
+                               std::memory_order_relaxed);
+    } else {
+      st->errors.fetch_add(1, std::memory_order_relaxed);
+      // Penalize errors as slow responses.
+      int64_t prev = st->avg_latency_us.load(std::memory_order_relaxed);
+      st->avg_latency_us.store(prev * 2 + 1000, std::memory_order_relaxed);
+    }
+  }
+
+  const char* name() const override { return "la"; }
+
+ private:
+  DoublyBufferedData<LaList> dbd_;
+  std::mutex stat_mu_;
+  std::unordered_map<uint64_t, std::shared_ptr<NodeStat>> stat_pool_;
+};
+
+std::mutex g_lb_mu;
+std::map<std::string, LoadBalancerFactory>& lb_registry() {
+  static auto* m = new std::map<std::string, LoadBalancerFactory>();
+  return *m;
+}
+
+void RegisterBuiltinLb() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    auto reg = [](const char* n, LoadBalancerFactory f) {
+      RegisterLoadBalancer(n, std::move(f));
+    };
+    reg("rr", [] { return std::unique_ptr<LoadBalancer>(
+        new RoundRobinLB(false)); });
+    reg("wrr", [] { return std::unique_ptr<LoadBalancer>(
+        new RoundRobinLB(true)); });
+    reg("random", [] { return std::unique_ptr<LoadBalancer>(
+        new RandomLB(false)); });
+    reg("wr", [] { return std::unique_ptr<LoadBalancer>(
+        new RandomLB(true)); });
+    reg("c_murmurhash", [] { return std::unique_ptr<LoadBalancer>(
+        new ConsistentHashLB); });
+    reg("la", [] { return std::unique_ptr<LoadBalancer>(
+        new LocalityAwareLB); });
+  });
+}
+
+}  // namespace
+
+void RegisterLoadBalancer(const std::string& name, LoadBalancerFactory f) {
+  std::lock_guard<std::mutex> g(g_lb_mu);
+  lb_registry()[name] = std::move(f);
+}
+
+std::unique_ptr<LoadBalancer> CreateLoadBalancer(const std::string& name) {
+  RegisterBuiltinLb();
+  std::lock_guard<std::mutex> g(g_lb_mu);
+  auto it = lb_registry().find(name);
+  if (it == lb_registry().end()) return nullptr;
+  return it->second();
+}
+
+}  // namespace brt

@@ -1,0 +1,53 @@
+// Load balancer framework: wait-free server selection over a
+// DoublyBufferedData server list, with per-call feedback.
+// Parity target: reference src/brpc/load_balancer.h:35 (SelectServer with
+// excluded set + Feedback) and the concrete policies of
+// src/brpc/policy/*load_balancer.cpp registered in global.cpp:376-384:
+// rr, wrr, random, wr, la (locality-aware, docs/cn/lalb.md), consistent
+// hashing (c_murmurhash), _dynpart.
+#pragma once
+
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "cluster/naming_service.h"
+
+namespace brt {
+
+struct SelectIn {
+  uint64_t request_code = 0;           // consistent hashing key
+  const std::vector<EndPoint>* excluded = nullptr;  // failed this call
+};
+
+struct SelectOut {
+  ServerNode node;
+};
+
+class LoadBalancer {
+ public:
+  virtual ~LoadBalancer() = default;
+
+  // Full-list replacement (NS push; reference ResetServers).
+  virtual void ResetServers(const std::vector<ServerNode>& servers) = 0;
+
+  // Picks a server; EHOSTDOWN when none available. Wait-free on the read
+  // path (DoublyBufferedData).
+  virtual int SelectServer(const SelectIn& in, SelectOut* out) = 0;
+
+  // Post-call feedback (latency in us; error_code 0 = success). Default
+  // no-op; `la` uses it to maintain per-node weights.
+  virtual void Feedback(const EndPoint& server, int64_t latency_us,
+                        int error_code) {}
+
+  virtual const char* name() const = 0;
+};
+
+// Registry (reference global.cpp:376-384). Builtin names: "rr", "random",
+// "wrr", "wr", "c_murmurhash", "la".
+using LoadBalancerFactory = std::function<std::unique_ptr<LoadBalancer>()>;
+void RegisterLoadBalancer(const std::string& name, LoadBalancerFactory f);
+std::unique_ptr<LoadBalancer> CreateLoadBalancer(const std::string& name);
+
+}  // namespace brt

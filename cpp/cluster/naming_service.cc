@@ -1,0 +1,230 @@
+#include "cluster/naming_service.h"
+
+#include <netdb.h>
+#include <sys/stat.h>
+
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+
+#include "base/logging.h"
+#include "fiber/fiber.h"
+
+namespace brt {
+
+namespace {
+
+std::mutex g_reg_mu;
+std::map<std::string, NamingServiceFactory>& registry() {
+  static auto* m = new std::map<std::string, NamingServiceFactory>();
+  return *m;
+}
+
+// "ip:port", "ip:port:w=3", "ip:port:tag" → node. Returns false on junk.
+bool ParseNode(const std::string& tok, ServerNode* out) {
+  size_t c1 = tok.find(':');
+  if (c1 == std::string::npos) return false;
+  size_t c2 = tok.find(':', c1 + 1);
+  std::string addr = tok.substr(0, c2);
+  if (!EndPoint::parse(addr, &out->ep)) return false;
+  if (c2 != std::string::npos) {
+    std::string extra = tok.substr(c2 + 1);
+    if (extra.rfind("w=", 0) == 0) out->weight = atoi(extra.c_str() + 2);
+    else out->tag = extra;
+    if (out->weight <= 0) out->weight = 1;
+  }
+  return true;
+}
+
+std::vector<ServerNode> ParseNodeList(const std::string& text,
+                                      const char* seps) {
+  std::vector<ServerNode> nodes;
+  std::string tok;
+  for (size_t i = 0; i <= text.size(); ++i) {
+    char ch = i < text.size() ? text[i] : seps[0];
+    if (strchr(seps, ch)) {
+      if (!tok.empty()) {
+        ServerNode n;
+        if (ParseNode(tok, &n)) nodes.push_back(n);
+        tok.clear();
+      }
+    } else {
+      tok.push_back(ch);
+    }
+  }
+  return nodes;
+}
+
+// ---- list:// — inline, static (reference policy/list_naming_service.cpp) --
+class ListNamingService : public NamingService {
+ public:
+  int Start(const std::string& param, ServerListCallback cb) override {
+    auto nodes = ParseNodeList(param, ",");
+    if (nodes.empty()) return EINVAL;
+    cb(nodes);
+    return 0;
+  }
+};
+
+// ---- file:// — watched file (reference policy/file_naming_service.cpp,
+// butil file_watcher) ----
+class FileNamingService : public NamingService {
+ public:
+  ~FileNamingService() override { Stop(); }
+
+  int Start(const std::string& param, ServerListCallback cb) override {
+    path_ = param;
+    cb_ = std::move(cb);
+    if (!Reload()) return ENOENT;
+    return fiber_start(&fid_, WatchEntry, this);
+  }
+
+  void Stop() override {
+    if (fid_) {
+      fiber_stop(fid_);
+      fiber_join(fid_);
+      fid_ = 0;
+    }
+  }
+
+ private:
+  bool Reload() {
+    FILE* f = fopen(path_.c_str(), "r");
+    if (!f) return false;
+    std::string text;
+    char buf[4096];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), f)) > 0) text.append(buf, n);
+    fclose(f);
+    cb_(ParseNodeList(text, "\n\r \t"));
+    return true;
+  }
+
+  static void* WatchEntry(void* arg) {
+    auto* self = static_cast<FileNamingService*>(arg);
+    struct stat st {};
+    stat(self->path_.c_str(), &st);
+    time_t last = st.st_mtime;
+    while (fiber_usleep(500 * 1000) == 0) {
+      if (stat(self->path_.c_str(), &st) == 0 && st.st_mtime != last) {
+        last = st.st_mtime;
+        self->Reload();
+      }
+    }
+    return nullptr;
+  }
+
+  std::string path_;
+  ServerListCallback cb_;
+  fiber_t fid_ = 0;
+};
+
+// ---- dns:// — periodic getaddrinfo (reference
+// policy/domain_naming_service.cpp) ----
+class DnsNamingService : public NamingService {
+ public:
+  ~DnsNamingService() override { Stop(); }
+
+  int Start(const std::string& param, ServerListCallback cb) override {
+    // host:port[/interval_s]
+    std::string p = param;
+    size_t slash = p.find('/');
+    if (slash != std::string::npos) {
+      interval_s_ = atoi(p.c_str() + slash + 1);
+      p = p.substr(0, slash);
+    }
+    size_t colon = p.rfind(':');
+    if (colon == std::string::npos) return EINVAL;
+    host_ = p.substr(0, colon);
+    port_ = uint16_t(atoi(p.c_str() + colon + 1));
+    cb_ = std::move(cb);
+    if (!Resolve()) return EHOSTUNREACH;
+    return fiber_start(&fid_, RefreshEntry, this);
+  }
+
+  void Stop() override {
+    if (fid_) {
+      fiber_stop(fid_);
+      fiber_join(fid_);
+      fid_ = 0;
+    }
+  }
+
+ private:
+  bool Resolve() {
+    addrinfo hints {};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(host_.c_str(), nullptr, &hints, &res) != 0) return false;
+    std::vector<ServerNode> nodes;
+    for (addrinfo* p = res; p; p = p->ai_next) {
+      auto* sa = reinterpret_cast<sockaddr_in*>(p->ai_addr);
+      ServerNode n;
+      n.ep = EndPoint(ntohl(sa->sin_addr.s_addr), port_);
+      nodes.push_back(n);
+    }
+    freeaddrinfo(res);
+    if (nodes.empty()) return false;
+    cb_(nodes);
+    return true;
+  }
+
+  static void* RefreshEntry(void* arg) {
+    auto* self = static_cast<DnsNamingService*>(arg);
+    while (fiber_usleep(self->interval_s_ * 1000000LL) == 0) self->Resolve();
+    return nullptr;
+  }
+
+  std::string host_;
+  uint16_t port_ = 0;
+  int interval_s_ = 5;
+  ServerListCallback cb_;
+  fiber_t fid_ = 0;
+};
+
+void RegisterBuiltinNs() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    RegisterNamingService("list", [] {
+      return std::unique_ptr<NamingService>(new ListNamingService);
+    });
+    RegisterNamingService("file", [] {
+      return std::unique_ptr<NamingService>(new FileNamingService);
+    });
+    RegisterNamingService("dns", [] {
+      return std::unique_ptr<NamingService>(new DnsNamingService);
+    });
+  });
+}
+
+}  // namespace
+
+void RegisterNamingService(const std::string& scheme,
+                           NamingServiceFactory factory) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  registry()[scheme] = std::move(factory);
+}
+
+std::unique_ptr<NamingService> StartNamingService(const std::string& url,
+                                                  ServerListCallback cb) {
+  RegisterBuiltinNs();
+  size_t pos = url.find("://");
+  if (pos == std::string::npos) return nullptr;
+  std::string scheme = url.substr(0, pos);
+  NamingServiceFactory factory;
+  {
+    std::lock_guard<std::mutex> g(g_reg_mu);
+    auto it = registry().find(scheme);
+    if (it == registry().end()) return nullptr;
+    factory = it->second;
+  }
+  auto ns = factory();
+  if (!ns || ns->Start(url.substr(pos + 3), std::move(cb)) != 0) {
+    return nullptr;
+  }
+  return ns;
+}
+
+}  // namespace brt

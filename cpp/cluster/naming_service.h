@@ -1,0 +1,54 @@
+// Naming service framework: resolves a cluster url ("list://...",
+// "file://...", "dns://...") into a server list, pushed to a watcher from a
+// dedicated fiber. Parity target: reference src/brpc/naming_service.h:45 +
+// details/naming_service_thread.h:58 (NS runs in its own bthread, pushes
+// full lists via ResetServers) and the concrete services of
+// src/brpc/policy/*naming_service.cpp (registered global.cpp:362-373).
+#pragma once
+
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base/endpoint.h"
+
+namespace brt {
+
+struct ServerNode {
+  EndPoint ep;
+  int weight = 1;      // used by wrr/wr LBs
+  std::string tag;     // partition tag ("N/M" for PartitionChannel)
+
+  bool operator==(const ServerNode& o) const {
+    return ep == o.ep && weight == o.weight && tag == o.tag;
+  }
+};
+
+// Receives FULL server lists (not deltas — reference ResetServers contract).
+using ServerListCallback =
+    std::function<void(const std::vector<ServerNode>&)>;
+
+class NamingService {
+ public:
+  virtual ~NamingService() = default;
+  // Starts resolving `param` (the part after "scheme://"); pushes the first
+  // list before returning when possible. Periodic refreshers run in a fiber.
+  virtual int Start(const std::string& param, ServerListCallback cb) = 0;
+  virtual void Stop() {}
+};
+
+// Registry (startup-time, mirror of global.cpp:362-373).
+using NamingServiceFactory = std::function<std::unique_ptr<NamingService>()>;
+void RegisterNamingService(const std::string& scheme,
+                           NamingServiceFactory factory);
+
+// Creates + starts the NS for "scheme://param". Nullptr on unknown scheme
+// or failed start. Registers the builtin schemes on first use:
+//   list://ip:port[:w=N],ip:port,...   inline list (policy/list_naming_service)
+//   file://path                        watched file, one "ip:port [w]" per line
+//   dns://host:port[/interval_s]      periodic re-resolution
+std::unique_ptr<NamingService> StartNamingService(const std::string& url,
+                                                  ServerListCallback cb);
+
+}  // namespace brt

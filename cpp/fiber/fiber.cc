@@ -153,11 +153,16 @@ void TaskControl::signal_task(int n) {
 }
 
 bool TaskControl::steal_task(fiber_t* out, uint64_t* seed, int skip) {
+  // Full sweep from a random start: wait_task's park decision relies on
+  // this scan being COMPLETE — a probabilistic probe can miss the one
+  // group holding a ready fiber, and the worker then parks with no future
+  // signal coming (the push already signalled), stranding that fiber until
+  // unrelated traffic arrives.
   const size_t n = groups_.size();
-  // xorshift over group indices
-  for (size_t attempts = 0; attempts < n * 2; ++attempts) {
-    *seed = *seed * 6364136223846793005ULL + 1442695040888963407ULL;
-    size_t i = (*seed >> 33) % n;
+  *seed = *seed * 6364136223846793005ULL + 1442695040888963407ULL;
+  const size_t start = (*seed >> 33) % n;
+  for (size_t k = 0; k < n; ++k) {
+    const size_t i = (start + k) % n;
     if (int(i) == skip) continue;
     if (groups_[i]->rq_.steal(out)) return true;
     if (groups_[i]->pop_remote(out)) return true;

@@ -112,10 +112,20 @@ int Channel::IssueRPC(Controller* cntl) {
                     "fail to connect %s", server_.to_string().c_str());
     return rc ? rc : ECONNREFUSED;
   }
+  // A retry attempt abandons the previous socket's response wait.
+  if (c.last_socket != INVALID_SOCKET_ID && c.last_socket != sock->id()) {
+    SocketUniquePtr prev;
+    if (Socket::Address(c.last_socket, &prev) == 0) {
+      prev->RemoveWaiter(c.cid);
+    }
+  }
   cntl->set_remote_side(server_);
   c.last_socket = sock->id();
   c.conn_type = int(options_.connection_type);
   c.conn_group = options_.connection_group;
+  // Register for failure notification BEFORE the bytes leave: a socket that
+  // dies after a successful Write must still error this call.
+  sock->AddWaiter(c.cid);
   IOBuf frame;
   IOBuf body = c.request_body;  // keep the original for retries
   PackFrame(&frame, c.request_meta, std::move(body));

@@ -112,7 +112,9 @@ int Controller::HandleError(fid_t id, void* data, int error_code) {
       }
     }
     if (!cntl->Failed()) cntl->SetFailed(error_code);
-  } else {
+  } else if (!cntl->Failed() || cntl->ErrorCode() != error_code) {
+    // Keep a more descriptive message recorded by the issuer for the same
+    // error; otherwise record this one.
     cntl->SetFailed(error_code);
   }
   cntl->EndRPC();
@@ -156,9 +158,16 @@ void Controller::OnResponse(RpcMeta&& meta, IOBuf&& body) {
 void Controller::EndRPC() {
   Call& c = call;
   set_latency(monotonic_us() - c.start_us);
+  if (c.on_end) c.on_end(this, c.on_end_arg);
   const fid_t id = cid_;
   Closure done;
   done.swap(c.done);
+  // Deregister from the socket's failure wait-list (no response coming /
+  // already consumed).
+  if (c.last_socket != INVALID_SOCKET_ID) {
+    SocketUniquePtr p;
+    if (Socket::Address(c.last_socket, &p) == 0) p->RemoveWaiter(id);
+  }
   // Exclusive connections: POOLED sockets go back to their group's freelist
   // on success; errored POOLED sockets are closed (a late response may still
   // be in flight on them) and SHORT sockets always close (reference

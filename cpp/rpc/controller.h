@@ -9,6 +9,7 @@
 #include <atomic>
 #include <functional>
 #include <string>
+#include <vector>
 
 #include "base/endpoint.h"
 #include "base/iobuf.h"
@@ -72,6 +73,10 @@ class Controller {
   // call (reference Controller::Reset).
   void Reset();
 
+  // Consistent-hashing key for "c_murmurhash" load balancers (reference
+  // Controller::set_request_code).
+  uint64_t request_code = 0;
+
   // ---- tracing (rpcz span propagation, reference span.h:47) ----
   uint64_t trace_id = 0;
   uint64_t span_id = 0;
@@ -93,6 +98,14 @@ class Controller {
     SocketId last_socket = INVALID_SOCKET_ID;
     int conn_type = 0;   // ConnectionType; POOLED sockets return on success
     int conn_group = 0;  // SocketMap group the socket came from
+    // Cluster layer: endpoints already tried this call (reference
+    // excluded_servers.h), and an end-of-call hook for LB feedback /
+    // circuit breaker (reference LoadBalancer::Feedback +
+    // CircuitBreaker::OnCallEnd).
+    std::vector<EndPoint> excluded;
+    void (*on_end)(Controller*, void*) = nullptr;
+    void* on_end_arg = nullptr;
+    bool attempt_pending = false;  // a selected attempt awaits feedback
     // Sub-call bookkeeping for combo channels (parallel_channel.cpp:46).
     void* parent_done = nullptr;
     int sub_index = -1;

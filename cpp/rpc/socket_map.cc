@@ -41,7 +41,14 @@ int NewConnection(const EndPoint& remote, SocketUniquePtr* out,
   SocketId sid;
   int rc = Socket::Connect(remote, opts, &sid, timeout_us);
   if (rc != 0) return rc;
-  return Socket::Address(sid, out);
+  rc = Socket::Address(sid, out);
+  if (rc != 0) return ECONNREFUSED;  // failed+recycled right after connect
+  if ((*out)->Failed()) {
+    rc = (*out)->error_code();
+    out->reset();
+    return rc ? rc : ECONNREFUSED;
+  }
+  return 0;
 }
 
 }  // namespace

@@ -1,0 +1,133 @@
+// Cluster-layer tests: multiple loopback servers behind list:// naming +
+// load balancers (the reference's multi-"node"-in-one-process pattern,
+// SURVEY §4 — test/brpc_naming_service_unittest.cpp /
+// load_balancer_unittest.cpp).
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <set>
+#include <string>
+
+#include "cluster/cluster_channel.h"
+#include "fiber/fiber.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+namespace {
+
+// Each server answers with its own index so tests can see routing.
+class WhoAmIService : public Service {
+ public:
+  explicit WhoAmIService(int idx) : idx_(idx) {}
+  std::atomic<int> calls{0};
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    calls.fetch_add(1);
+    response->append(std::to_string(idx_));
+    done();
+  }
+
+ private:
+  int idx_;
+};
+
+struct Node {
+  Server server;
+  std::unique_ptr<WhoAmIService> svc;
+};
+
+std::string CallWho(Channel& ch, uint64_t request_code = 0) {
+  Controller cntl;
+  cntl.request_code = request_code;
+  IOBuf req, rsp;
+  ch.CallMethod("Who", "Who", &cntl, req, &rsp, nullptr);
+  if (cntl.Failed()) return "ERR:" + std::to_string(cntl.ErrorCode());
+  return rsp.to_string();
+}
+
+void test_rr_distribution(const std::string& ns_url, Node* nodes, int n) {
+  ClusterChannel ch;
+  assert(ch.Init(ns_url, "rr") == 0);
+  std::set<std::string> seen;
+  for (int i = 0; i < 3 * n; ++i) seen.insert(CallWho(ch));
+  assert(int(seen.size()) == n);  // rr visits every node
+  printf("rr_distribution OK (%d nodes)\n", n);
+}
+
+void test_random_and_la(const std::string& ns_url, int n) {
+  for (const char* lb : {"random", "wr", "wrr", "la"}) {
+    ClusterChannel ch;
+    assert(ch.Init(ns_url, lb) == 0);
+    std::set<std::string> seen;
+    for (int i = 0; i < 40 * n; ++i) {
+      std::string who = CallWho(ch);
+      if (who.rfind("ERR", 0) == 0) {
+        fprintf(stderr, "lb=%s call %d failed: %s\n", lb, i, who.c_str());
+        assert(false);
+      }
+      seen.insert(who);
+    }
+    assert(int(seen.size()) >= 2);  // spreads load
+  }
+  printf("random/wr/wrr/la OK\n");
+}
+
+void test_consistent_hash(const std::string& ns_url) {
+  ClusterChannel ch;
+  assert(ch.Init(ns_url, "c_murmurhash") == 0);
+  // Same key → same node, across many keys the ring spreads.
+  std::set<std::string> spread;
+  for (uint64_t key = 0; key < 64; ++key) {
+    std::string first = CallWho(ch, key);
+    for (int rep = 0; rep < 3; ++rep) assert(CallWho(ch, key) == first);
+    spread.insert(first);
+  }
+  assert(spread.size() >= 2);
+  printf("consistent_hash OK (spread=%zu)\n", spread.size());
+}
+
+void test_failover(Node* nodes, int n, const std::string& ns_url) {
+  ClusterChannel ch;
+  ChannelOptions opts;
+  opts.max_retry = 3;
+  assert(ch.Init(ns_url, "rr", &opts) == 0);
+  // Kill node 0; calls must all keep succeeding via retry+exclusion.
+  nodes[0].server.Stop();
+  nodes[0].server.Join();
+  int ok = 0;
+  for (int i = 0; i < 20; ++i) {
+    if (CallWho(ch).rfind("ERR", 0) != 0) ++ok;
+  }
+  assert(ok == 20);
+  printf("failover OK (node0 down, 20/20 succeeded)\n");
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  constexpr int N = 3;
+  static Node nodes[N];
+  std::string list = "list://";
+  for (int i = 0; i < N; ++i) {
+    nodes[i].svc = std::make_unique<WhoAmIService>(i);
+    assert(nodes[i].server.AddService(nodes[i].svc.get(), "Who") == 0);
+    assert(nodes[i].server.Start("127.0.0.1:0") == 0);
+    if (i) list += ",";
+    list += nodes[i].server.listen_address().to_string();
+  }
+
+  test_rr_distribution(list, nodes, N);
+  test_random_and_la(list, N);
+  test_consistent_hash(list);
+  test_failover(nodes, N, list);  // stops node 0 — keep last
+
+  for (int i = 1; i < N; ++i) {
+    nodes[i].server.Stop();
+    nodes[i].server.Join();
+  }
+  printf("ALL cluster tests OK\n");
+  return 0;
+}

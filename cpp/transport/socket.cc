@@ -115,6 +115,7 @@ int Socket::Create(const Options& opts, SocketId* id_out) {
   s->messages_read.store(0, std::memory_order_relaxed);
   s->read_state.store(0, std::memory_order_relaxed);
   s->read_buf.clear();
+  s->waiters_.clear();
   if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
   s->write_head_.store(nullptr, std::memory_order_relaxed);
   s->id_ = (uint64_t(v) << 32) | index;
@@ -207,8 +208,40 @@ void Socket::SetFailed(int err, const char* fmt, ...) {
   // Wake EPOLLOUT waiters so KeepWrite notices the failure.
   butex_value(epollout_butex_).fetch_add(1, std::memory_order_release);
   butex_wake_all(epollout_butex_);
+  // Error every in-flight RPC whose response can no longer arrive
+  // (reference id-wait-list semantics).
+  std::vector<fid_t> waiters;
+  {
+    std::lock_guard<std::mutex> g(waiters_mu_);
+    waiters.swap(waiters_);
+  }
+  const int werr = failed_.load(std::memory_order_acquire);
+  for (fid_t cid : waiters) fid_error(cid, werr);
   if (on_failed_) on_failed_(this);
   Dereference();  // drop the ownership ref
+}
+
+void Socket::AddWaiter(fid_t cid) {
+  {
+    std::lock_guard<std::mutex> g(waiters_mu_);
+    if (failed_.load(std::memory_order_acquire) == 0) {
+      waiters_.push_back(cid);
+      return;
+    }
+  }
+  // Raced with SetFailed's drain: deliver directly.
+  fid_error(cid, failed_.load(std::memory_order_acquire));
+}
+
+void Socket::RemoveWaiter(fid_t cid) {
+  std::lock_guard<std::mutex> g(waiters_mu_);
+  for (size_t i = 0; i < waiters_.size(); ++i) {
+    if (waiters_[i] == cid) {
+      waiters_[i] = waiters_.back();
+      waiters_.pop_back();
+      return;
+    }
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -361,13 +394,20 @@ int Socket::Connect(const EndPoint& remote, const Options& opts,
     SocketUniquePtr ptr;
     if (Socket::Address(*id_out, &ptr) != 0) return ECONNREFUSED;
     int wrc = ptr->WaitEpollOut(timeout_us);
+    // The fd is already registered for reads: on a refused connect the
+    // read path may consume the error (read() → ECONNREFUSED → SetFailed)
+    // before we get here, leaving SO_ERROR clean — trust the socket state
+    // first.
+    if (ptr->Failed()) return ptr->error_code();
     if (wrc == ETIMEDOUT) {
       ptr->SetFailed(ETIMEDOUT, "connect timeout");
       return ETIMEDOUT;
     }
     int soerr = 0;
     socklen_t len = sizeof(soerr);
-    getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &len);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &len) != 0) {
+      soerr = ptr->Failed() ? ptr->error_code() : ECONNREFUSED;
+    }
     if (soerr != 0) {
       ptr->SetFailed(soerr, "connect failed: %s", strerror(soerr));
       return soerr;

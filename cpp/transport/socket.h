@@ -11,6 +11,7 @@
 
 #include <atomic>
 #include <cstdarg>
+#include <mutex>
 #include <cstdint>
 #include <string>
 #include <vector>
@@ -132,6 +133,14 @@ class Socket {
   // In-process registry walk (builtin /connections service).
   static void ListSockets(std::vector<SocketId>* out);
 
+  // In-flight RPC registration: a correlation id registered here receives
+  // fid_error(EFAILEDSOCKET-mapped errno) when the socket fails — the
+  // reference's id-wait-list (socket.h:229 region, wakes RPCs whose
+  // response can no longer arrive). Register BEFORE writing the request;
+  // deregister on response arrival / call end.
+  void AddWaiter(fid_t cid);
+  void RemoveWaiter(fid_t cid);
+
  private:
   friend class SocketUniquePtr;
   struct WriteReq {
@@ -163,6 +172,8 @@ class Socket {
   std::atomic<int> failed_{0};
   std::string error_text_;
   std::atomic<WriteReq*> write_head_{nullptr};  // MPSC chain, Vyukov-style
+  std::mutex waiters_mu_;
+  std::vector<fid_t> waiters_;  // in-flight RPC ids awaiting responses
   Butex* epollout_butex_ = nullptr;
   EventDispatcher* dispatcher_ = nullptr;
   std::atomic<uint64_t> vref_{0};  // [version:32|nref:32]
