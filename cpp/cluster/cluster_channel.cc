@@ -19,18 +19,32 @@ ClusterChannel::~ClusterChannel() {
 
 int ClusterChannel::Init(const std::string& ns_url, const std::string& lb_name,
                          const ChannelOptions* opts) {
+  int rc = InitWithLb(lb_name, opts);
+  if (rc != 0) return rc;
+  ns_ = StartNamingService(ns_url, [this](const std::vector<ServerNode>& s) {
+    UpdateServers(s);
+  });
+  if (!ns_) {
+    inited_ = false;
+    return EINVAL;
+  }
+  return 0;
+}
+
+int ClusterChannel::InitWithLb(const std::string& lb_name,
+                               const ChannelOptions* opts) {
   if (opts) options_ = *opts;
   lb_ = CreateLoadBalancer(lb_name);
   if (!lb_) return EINVAL;
   RegisterBrtProtocol();
-  ns_ = StartNamingService(ns_url, [this](const std::vector<ServerNode>& s) {
-    lb_->ResetServers(s);
-    std::lock_guard<std::mutex> g(nodes_mu_);
-    nodes_ = s;
-  });
-  if (!ns_) return EINVAL;
   inited_ = true;
   return 0;
+}
+
+void ClusterChannel::UpdateServers(const std::vector<ServerNode>& servers) {
+  lb_->ResetServers(servers);
+  std::lock_guard<std::mutex> g(nodes_mu_);
+  nodes_ = servers;
 }
 
 std::vector<ServerNode> ClusterChannel::ListServers() const {

@@ -29,6 +29,13 @@ class ClusterChannel : public Channel {
   int Init(const std::string& ns_url, const std::string& lb_name,
            const ChannelOptions* opts = nullptr);
 
+  // NS-less init: the owner pushes server lists via UpdateServers — used by
+  // PartitionChannel, which splits ONE naming service across partitions
+  // (reference partition_channel.cpp SubPartitionChannel role).
+  int InitWithLb(const std::string& lb_name,
+                 const ChannelOptions* opts = nullptr);
+  void UpdateServers(const std::vector<ServerNode>& servers);
+
   int IssueRPC(Controller* cntl) override;
 
   // Snapshot of live nodes (builtin services / tests).

@@ -1,0 +1,161 @@
+#include "cluster/parallel_channel.h"
+
+#include "base/time.h"
+#include "fiber/sync.h"
+
+namespace brt {
+
+namespace {
+
+// Aggregates sub-call completions; the LAST finisher merges and fires the
+// parent (reference ParallelChannelDone, parallel_channel.cpp:46 — sub
+// completions may land on arbitrary threads).
+struct ParallelDone {
+  struct SubState {
+    Controller cntl;
+    IOBuf response;
+    ResponseMerger* merger = nullptr;
+    bool skipped = false;
+  };
+
+  Controller* parent = nullptr;
+  IOBuf* parent_response = nullptr;
+  Closure parent_done;
+  int fail_limit = 0;
+  int64_t start_us = 0;
+  std::atomic<int> pending{0};
+  std::unique_ptr<SubState[]> subs;  // Controller is pinned: no vector moves
+  int nsubs = 0;
+
+  void OnSubDone() {
+    if (pending.fetch_sub(1, std::memory_order_acq_rel) == 1) Finish();
+  }
+
+  void Finish() {
+    int nfail = 0;
+    for (int i = 0; i < nsubs; ++i) {
+      if (!subs[i].skipped && subs[i].cntl.Failed()) ++nfail;
+    }
+    if (nfail > fail_limit) {
+      std::string first_text;
+      for (int i = 0; i < nsubs; ++i) {
+        if (!subs[i].skipped && subs[i].cntl.Failed()) {
+          first_text = subs[i].cntl.ErrorText();
+          break;
+        }
+      }
+      parent->SetFailed(ETOOMANYFAILS, "%d/%d sub-calls failed (first: %s)",
+                        nfail, nsubs, first_text.c_str());
+    } else {
+      // Merge successes in channel order (reference ResponseMerger contract).
+      for (int i = 0; i < nsubs; ++i) {
+        SubState& s = subs[i];
+        if (s.skipped || s.cntl.Failed()) continue;
+        if (s.merger != nullptr) {
+          if (s.merger->Merge(parent_response, s.response) < 0) {
+            parent->SetFailed(ERESPONSE, "response merge failed");
+            break;
+          }
+        } else if (parent_response != nullptr) {
+          parent_response->append(std::move(s.response));
+        }
+      }
+    }
+    parent->set_latency(monotonic_us() - start_us);
+    Closure done;
+    done.swap(parent_done);
+    delete this;
+    if (done) done();
+  }
+};
+
+}  // namespace
+
+int ParallelChannel::AddChannel(ChannelBase* sub,
+                                std::shared_ptr<CallMapper> mapper,
+                                std::shared_ptr<ResponseMerger> merger) {
+  if (!sub) return EINVAL;
+  subs_.push_back(Sub{sub, std::move(mapper), std::move(merger)});
+  return 0;
+}
+
+void ParallelChannel::CallMethod(const std::string& service,
+                                 const std::string& method, Controller* cntl,
+                                 const IOBuf& request, IOBuf* response,
+                                 Closure done) {
+  const int n = int(subs_.size());
+  if (n == 0) {
+    cntl->SetFailed(EHOSTDOWN, "parallel channel has no sub-channels");
+    if (done) done();
+    return;
+  }
+  const int64_t timeout_ms =
+      cntl->timeout_ms != INT64_MIN ? cntl->timeout_ms : options_.timeout_ms;
+
+  auto* agg = new ParallelDone;
+  agg->parent = cntl;
+  agg->parent_response = response;
+  agg->fail_limit = options_.fail_limit < 0 ? 0 : options_.fail_limit;
+  agg->start_us = monotonic_us();
+  agg->subs.reset(new ParallelDone::SubState[size_t(n)]);
+  agg->nsubs = n;
+
+  CountdownEvent sync_ev(1);
+  const bool sync = !done;
+  agg->parent_done = sync ? Closure([&sync_ev] { sync_ev.signal(); })
+                          : std::move(done);
+
+  // Map all sub-requests FIRST: pending must be fully counted before any
+  // completion can race the aggregate.
+  struct Plan {
+    bool run = false;
+    std::string method;
+    IOBuf request;
+  };
+  std::vector<Plan> plans{size_t(n)};
+  int live = 0;
+  for (int i = 0; i < n; ++i) {
+    Sub& sub = subs_[size_t(i)];
+    Plan& pl = plans[size_t(i)];
+    if (sub.mapper) {
+      SubCall sc = sub.mapper->Map(i, n, method, request);
+      if (sc.skip) {
+        agg->subs[i].skipped = true;
+        continue;
+      }
+      pl.method = sc.method.empty() ? method : std::move(sc.method);
+      pl.request = std::move(sc.request);
+    } else {
+      pl.method = method;
+      pl.request = request;  // shares blocks
+    }
+    pl.run = true;
+    agg->subs[i].merger = sub.merger.get();
+    ++live;
+  }
+  if (live == 0) {
+    cntl->SetFailed(EHOSTDOWN, "all sub-calls skipped");
+    Closure d;
+    d.swap(agg->parent_done);
+    delete agg;
+    if (d) d();  // async: user done / sync: signals the event below
+    if (sync) sync_ev.wait(-1);
+    return;
+  }
+  agg->pending.store(live, std::memory_order_release);
+
+  for (int i = 0; i < n; ++i) {
+    if (!plans[size_t(i)].run) continue;
+    ParallelDone::SubState& st = agg->subs[i];
+    st.cntl.timeout_ms = timeout_ms;
+    st.cntl.request_code = cntl->request_code;
+    st.cntl.trace_id = cntl->trace_id;
+    st.cntl.span_id = cntl->span_id;
+    subs_[size_t(i)].channel->CallMethod(
+        service, plans[size_t(i)].method, &st.cntl, plans[size_t(i)].request,
+        &st.response, [agg] { agg->OnSubDone(); });
+  }
+  if (sync) sync_ev.wait(-1);
+}
+
+}  // namespace brt

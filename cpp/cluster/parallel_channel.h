@@ -1,0 +1,83 @@
+// ParallelChannel: one call fans out to N sub-channels concurrently; each
+// sub-call's request comes from a CallMapper (slicing), successful
+// responses fold through a ResponseMerger, and fail_limit controls
+// partial-failure tolerance. Sub-channels may themselves be combo channels
+// (recursive composition).
+// Parity target: reference src/brpc/parallel_channel.h:185 (CallMapper :94,
+// ResponseMerger :127, ParallelChannelOptions.fail_limit :151, shared
+// ParallelChannelDone aggregation parallel_channel.cpp:46,219).
+// This is the RPC-tier sibling of the compiled ICI collective path
+// (brpc_tpu.parallel.collective_channel maps the same contract onto
+// jax.lax collectives — SURVEY §2.7 / §5.8).
+#pragma once
+
+#include <memory>
+#include <vector>
+
+#include "rpc/channel.h"
+
+namespace brt {
+
+// A sub-call produced by CallMapper::Map. skip=true drops that sub-channel
+// from this call (reference SubCall::Skip()).
+struct SubCall {
+  std::string method;  // empty → inherit parent method
+  IOBuf request;
+  bool skip = false;
+};
+
+class CallMapper {
+ public:
+  virtual ~CallMapper() = default;
+  virtual SubCall Map(int channel_index, int channel_count,
+                      const std::string& method, const IOBuf& request) = 0;
+};
+
+class ResponseMerger {
+ public:
+  virtual ~ResponseMerger() = default;
+  // Folds one successful sub-response into *response. Returns 0 on success,
+  // <0 to count the sub-call as failed (reference FAIL_ALL semantics kept
+  // simple: merge failure = sub failure).
+  virtual int Merge(IOBuf* response, const IOBuf& sub_response) = 0;
+};
+
+struct ParallelChannelOptions {
+  // Parent fails once failures exceed fail_limit; <0 → any failure fails
+  // the whole call (reference ParallelChannelOptions, parallel_channel.h:151).
+  int fail_limit = -1;
+  int64_t timeout_ms = 500;
+};
+
+class ParallelChannel : public ChannelBase {
+ public:
+  explicit ParallelChannel(const ParallelChannelOptions& opts =
+                               ParallelChannelOptions())
+      : options_(opts) {}
+
+  // mapper/merger may be null: null mapper = every sub-channel gets the
+  // whole request; null merger = sub-responses are concatenated in
+  // channel order. Ownership shared.
+  int AddChannel(ChannelBase* sub, std::shared_ptr<CallMapper> mapper = nullptr,
+                 std::shared_ptr<ResponseMerger> merger = nullptr);
+
+  int channel_count() const { return int(subs_.size()); }
+
+  // Fans out; done runs (or the sync caller wakes) after EVERY sub-call
+  // finished and the merge completed. Partial failures within fail_limit
+  // still produce a merged success.
+  void CallMethod(const std::string& service, const std::string& method,
+                  Controller* cntl, const IOBuf& request, IOBuf* response,
+                  Closure done) override;
+
+ private:
+  struct Sub {
+    ChannelBase* channel;
+    std::shared_ptr<CallMapper> mapper;
+    std::shared_ptr<ResponseMerger> merger;
+  };
+  ParallelChannelOptions options_;
+  std::vector<Sub> subs_;
+};
+
+}  // namespace brt

@@ -1,0 +1,85 @@
+#include "cluster/partition_channel.h"
+
+#include <cstdlib>
+
+namespace brt {
+
+bool PartitionParser::Parse(const std::string& tag, int* index, int* total) {
+  const size_t slash = tag.find('/');
+  if (slash == std::string::npos || slash == 0) return false;
+  char* end = nullptr;
+  long i = strtol(tag.c_str(), &end, 10);
+  if (end != tag.c_str() + slash) return false;
+  long n = strtol(tag.c_str() + slash + 1, &end, 10);
+  if (*end != '\0' || n <= 0 || i < 0 || i >= n) return false;
+  *index = int(i);
+  *total = int(n);
+  return true;
+}
+
+PartitionChannel::~PartitionChannel() {
+  if (ns_) ns_->Stop();
+}
+
+int PartitionChannel::Init(int num_partitions, const std::string& ns_url,
+                           const PartitionChannelOptions* opts,
+                           std::shared_ptr<CallMapper> mapper,
+                           std::shared_ptr<ResponseMerger> merger,
+                           std::unique_ptr<PartitionParser> parser) {
+  if (num_partitions <= 0) return EINVAL;
+  if (opts) options_ = *opts;
+  parser_ = parser ? std::move(parser) : std::make_unique<PartitionParser>();
+
+  ParallelChannelOptions popts;
+  popts.fail_limit = options_.fail_limit;
+  popts.timeout_ms = options_.timeout_ms;
+  fanout_ = std::make_unique<ParallelChannel>(popts);
+  for (int i = 0; i < num_partitions; ++i) {
+    auto part = std::make_unique<ClusterChannel>();
+    int rc = part->InitWithLb(options_.lb_name, &options_.sub);
+    if (rc != 0) return rc;
+    fanout_->AddChannel(part.get(), mapper, merger);
+    parts_.push_back(std::move(part));
+  }
+  // Subscribe ONE naming service; tag-split pushes to each partition.
+  ns_ = StartNamingService(ns_url, [this](const std::vector<ServerNode>& s) {
+    OnServers(s);
+  });
+  return ns_ ? 0 : EINVAL;
+}
+
+void PartitionChannel::OnServers(const std::vector<ServerNode>& servers) {
+  const int n = int(parts_.size());
+  const size_t nparts = size_t(n);
+  std::vector<std::vector<ServerNode>> split(nparts);
+  for (const ServerNode& node : servers) {
+    int idx = 0, total = 0;
+    if (!parser_->Parse(node.tag, &idx, &total)) continue;
+    if (total != n || idx >= n) continue;  // foreign partitioning scheme
+    split[size_t(idx)].push_back(node);
+  }
+  for (int i = 0; i < n; ++i) parts_[size_t(i)]->UpdateServers(split[size_t(i)]);
+}
+
+void PartitionChannel::CallMethod(const std::string& service,
+                                  const std::string& method, Controller* cntl,
+                                  const IOBuf& request, IOBuf* response,
+                                  Closure done) {
+  fanout_->CallMethod(service, method, cntl, request, response,
+                      std::move(done));
+}
+
+void PartitionChannel::CallPartition(int index, const std::string& service,
+                                     const std::string& method,
+                                     Controller* cntl, const IOBuf& request,
+                                     IOBuf* response, Closure done) {
+  if (index < 0 || index >= int(parts_.size())) {
+    cntl->SetFailed(EINVAL, "partition %d out of range", index);
+    if (done) done();
+    return;
+  }
+  parts_[size_t(index)]->CallMethod(service, method, cntl, request, response,
+                                    std::move(done));
+}
+
+}  // namespace brt

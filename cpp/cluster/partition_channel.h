@@ -1,0 +1,68 @@
+// PartitionChannel: addresses a service sharded into N partitions. One
+// naming service feeds all partitions; nodes carry "i/N" tags parsed by a
+// PartitionParser; a call fans out to every partition (ParallelChannel
+// machinery) with optional request slicing / response merging.
+// Parity target: reference src/brpc/partition_channel.h:75 (PartitionParser
+// :35; partition tags "N/M" from NS; example/partition_echo_c++).
+#pragma once
+
+#include <memory>
+
+#include "cluster/cluster_channel.h"
+#include "cluster/parallel_channel.h"
+
+namespace brt {
+
+// Parses a server tag into (index, total). Default accepts "i/N" with
+// 0 <= i < N (reference DefaultPartitionParser accepts "N/M" 1-based; here
+// 0-based for mesh-coordinate affinity).
+class PartitionParser {
+ public:
+  virtual ~PartitionParser() = default;
+  virtual bool Parse(const std::string& tag, int* index, int* total);
+};
+
+struct PartitionChannelOptions {
+  ChannelOptions sub;            // per-partition channel options
+  std::string lb_name = "rr";    // LB within a partition's replicas
+  int fail_limit = -1;           // across partitions (ParallelChannel)
+  int64_t timeout_ms = 500;
+};
+
+class PartitionChannel : public ChannelBase {
+ public:
+  PartitionChannel() = default;
+  ~PartitionChannel() override;
+
+  // num_partitions must match the NS tags' "/N". mapper/merger as in
+  // ParallelChannel (null mapper broadcasts the whole request to every
+  // partition — the parameter-server "replicated read" shape; a slicing
+  // mapper gives the sharded-write shape).
+  int Init(int num_partitions, const std::string& ns_url,
+           const PartitionChannelOptions* opts = nullptr,
+           std::shared_ptr<CallMapper> mapper = nullptr,
+           std::shared_ptr<ResponseMerger> merger = nullptr,
+           std::unique_ptr<PartitionParser> parser = nullptr);
+
+  int partition_count() const { return int(parts_.size()); }
+
+  void CallMethod(const std::string& service, const std::string& method,
+                  Controller* cntl, const IOBuf& request, IOBuf* response,
+                  Closure done) override;
+
+  // Calls ONE partition only (shard-addressed access — the PS fast path).
+  void CallPartition(int index, const std::string& service,
+                     const std::string& method, Controller* cntl,
+                     const IOBuf& request, IOBuf* response, Closure done);
+
+ private:
+  void OnServers(const std::vector<ServerNode>& servers);
+
+  PartitionChannelOptions options_;
+  std::unique_ptr<PartitionParser> parser_;
+  std::unique_ptr<NamingService> ns_;
+  std::vector<std::unique_ptr<ClusterChannel>> parts_;
+  std::unique_ptr<ParallelChannel> fanout_;
+};
+
+}  // namespace brt

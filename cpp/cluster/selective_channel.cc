@@ -1,0 +1,116 @@
+#include "cluster/selective_channel.h"
+
+#include "base/time.h"
+#include "fiber/sync.h"
+
+namespace brt {
+
+namespace {
+
+// One whole selective call: tries sub-channels in rotation until success,
+// retries exhausted, or the deadline passes.
+struct SelectiveCall {
+  SelectiveChannel* owner = nullptr;
+  std::vector<ChannelBase*>* subs = nullptr;
+  std::string service, method;
+  Controller* parent = nullptr;
+  IOBuf request;
+  IOBuf* parent_response = nullptr;
+  Closure parent_done;
+  int64_t deadline_us = -1;
+  int attempts_left = 0;
+  uint64_t next_index = 0;
+  int64_t start_us = 0;
+
+  Controller sub_cntl;
+  IOBuf sub_response;
+
+  void IssueNext() {
+    ChannelBase* target = (*subs)[size_t(next_index % subs->size())];
+    ++next_index;
+    sub_cntl.Reset();
+    sub_cntl.request_code = parent->request_code;
+    sub_cntl.trace_id = parent->trace_id;
+    const int64_t remain_ms =
+        deadline_us < 0 ? -1 : (deadline_us - monotonic_us()) / 1000;
+    if (deadline_us >= 0 && remain_ms <= 0) {
+      parent->SetFailed(ERPCTIMEDOUT, nullptr);
+      Finish();
+      return;
+    }
+    sub_cntl.timeout_ms = remain_ms;
+    sub_response.clear();
+    target->CallMethod(service, method, &sub_cntl, request, &sub_response,
+                       [this] { OnSubDone(); });
+  }
+
+  void OnSubDone() {
+    if (!sub_cntl.Failed()) {
+      if (parent_response) *parent_response = std::move(sub_response);
+      Finish();
+      return;
+    }
+    const bool budget_left =
+        deadline_us < 0 || monotonic_us() < deadline_us;
+    if (attempts_left > 0 && budget_left &&
+        sub_cntl.ErrorCode() != ECANCELEDRPC) {
+      --attempts_left;
+      IssueNext();  // a DIFFERENT channel (rotation advanced)
+      return;
+    }
+    parent->SetFailed(sub_cntl.ErrorCode(), "%s",
+                      sub_cntl.ErrorText().c_str());
+    Finish();
+  }
+
+  void Finish() {
+    parent->set_latency(monotonic_us() - start_us);
+    Closure d;
+    d.swap(parent_done);
+    delete this;
+    if (d) d();
+  }
+};
+
+}  // namespace
+
+int SelectiveChannel::AddChannel(ChannelBase* sub) {
+  if (!sub) return EINVAL;
+  subs_.push_back(sub);
+  return 0;
+}
+
+void SelectiveChannel::CallMethod(const std::string& service,
+                                  const std::string& method, Controller* cntl,
+                                  const IOBuf& request, IOBuf* response,
+                                  Closure done) {
+  if (subs_.empty()) {
+    cntl->SetFailed(EHOSTDOWN, "selective channel has no sub-channels");
+    if (done) done();
+    return;
+  }
+  const int64_t timeout_ms =
+      cntl->timeout_ms != INT64_MIN ? cntl->timeout_ms : options_.timeout_ms;
+
+  auto* call = new SelectiveCall;
+  call->owner = this;
+  call->subs = &subs_;
+  call->service = service;
+  call->method = method;
+  call->parent = cntl;
+  call->request = request;  // shares blocks
+  call->parent_response = response;
+  call->start_us = monotonic_us();
+  call->deadline_us =
+      timeout_ms < 0 ? -1 : call->start_us + timeout_ms * 1000;
+  call->attempts_left = std::min(options_.max_retry, int(subs_.size()) - 1);
+  call->next_index = cursor_.fetch_add(1, std::memory_order_relaxed);
+
+  CountdownEvent ev(1);
+  const bool sync = !done;
+  call->parent_done = sync ? Closure([&ev] { ev.signal(); }) : std::move(done);
+  call->IssueNext();
+  if (sync) ev.wait(-1);
+}
+
+}  // namespace brt

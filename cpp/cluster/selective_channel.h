@@ -1,0 +1,40 @@
+// SelectiveChannel: load-balances whole CALLS over heterogeneous
+// sub-channels (each possibly a combo channel itself); a failed sub-call
+// retries on a DIFFERENT sub-channel.
+// Parity target: reference src/brpc/selective_channel.h:52 (+ the RPCSender
+// interception of selective_channel.cpp:126-291 — here realized as a
+// chained-async state machine over ChannelBase).
+#pragma once
+
+#include <atomic>
+#include <vector>
+
+#include "rpc/channel.h"
+
+namespace brt {
+
+struct SelectiveChannelOptions {
+  int max_retry = 2;        // additional sub-channels tried after a failure
+  int64_t timeout_ms = 500; // per whole call (budget shared by retries)
+};
+
+class SelectiveChannel : public ChannelBase {
+ public:
+  explicit SelectiveChannel(const SelectiveChannelOptions& opts =
+                                SelectiveChannelOptions())
+      : options_(opts) {}
+
+  int AddChannel(ChannelBase* sub);
+  int channel_count() const { return int(subs_.size()); }
+
+  void CallMethod(const std::string& service, const std::string& method,
+                  Controller* cntl, const IOBuf& request, IOBuf* response,
+                  Closure done) override;
+
+ private:
+  SelectiveChannelOptions options_;
+  std::vector<ChannelBase*> subs_;
+  std::atomic<uint64_t> cursor_{0};
+};
+
+}  // namespace brt

@@ -24,7 +24,19 @@ struct ChannelOptions {
   int connection_group = 0;
 };
 
-class Channel : public CallIssuer {
+// Anything callable like a channel: plain Channel, ClusterChannel, and the
+// combo channels (Parallel/Selective/Partition) all share this surface so
+// they compose recursively (reference ChannelBase, channel_base.h).
+class ChannelBase {
+ public:
+  virtual ~ChannelBase() = default;
+  virtual void CallMethod(const std::string& service,
+                          const std::string& method, Controller* cntl,
+                          const IOBuf& request, IOBuf* response,
+                          Closure done) = 0;
+};
+
+class Channel : public ChannelBase, public CallIssuer {
  public:
   Channel() = default;
   ~Channel() override = default;
@@ -39,7 +51,7 @@ class Channel : public CallIssuer {
   // fiber, after cntl/response are filled.
   void CallMethod(const std::string& service, const std::string& method,
                   Controller* cntl, const IOBuf& request, IOBuf* response,
-                  Closure done);
+                  Closure done) override;
 
   // CallIssuer: one delivery attempt; called with the correlation id locked.
   int IssueRPC(Controller* cntl) override;

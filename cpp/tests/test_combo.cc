@@ -1,0 +1,246 @@
+// Combo-channel tests (reference test pattern: multiple real servers in one
+// process — SURVEY §4; models brpc_parallel_channel_unittest /
+// selective/partition examples).
+#include <cassert>
+#include <cstdio>
+#include <set>
+#include <string>
+
+#include "cluster/parallel_channel.h"
+#include "cluster/partition_channel.h"
+#include "cluster/selective_channel.h"
+#include "fiber/fiber.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+namespace {
+
+// Responds "<idx>:<payload>"; "Fail" method fails.
+class ShardService : public Service {
+ public:
+  explicit ShardService(int idx) : idx_(idx) {}
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    if (method == "Fail") {
+      cntl->SetFailed(EINTERNAL, "shard down");
+    } else {
+      response->append(std::to_string(idx_) + ":" + request.to_string() + ";");
+    }
+    done();
+  }
+
+ private:
+  int idx_;
+};
+
+// Slices "a,b,c" by sub-channel index.
+class SliceMapper : public CallMapper {
+ public:
+  SubCall Map(int i, int n, const std::string& method,
+              const IOBuf& request) override {
+    std::string all = request.to_string();
+    // split by ','
+    std::vector<std::string> toks;
+    size_t start = 0;
+    for (size_t p = 0; p <= all.size(); ++p) {
+      if (p == all.size() || all[p] == ',') {
+        toks.push_back(all.substr(start, p - start));
+        start = p + 1;
+      }
+    }
+    SubCall sc;
+    if (i < int(toks.size())) sc.request.append(toks[size_t(i)]);
+    else sc.skip = true;
+    return sc;
+  }
+};
+
+struct Fixture {
+  static constexpr int N = 3;
+  Server servers[N];
+  std::unique_ptr<ShardService> svcs[N];
+  Channel channels[N];
+  std::string addrs[N];
+
+  Fixture() {
+    for (int i = 0; i < N; ++i) {
+      svcs[i] = std::make_unique<ShardService>(i);
+      assert(servers[i].AddService(svcs[i].get(), "Shard") == 0);
+      assert(servers[i].Start("127.0.0.1:0") == 0);
+      addrs[i] = servers[i].listen_address().to_string();
+      assert(channels[i].Init(servers[i].listen_address()) == 0);
+    }
+  }
+  ~Fixture() {
+    for (auto& s : servers) {
+      s.Stop();
+      s.Join();
+    }
+  }
+};
+
+void test_parallel_broadcast(Fixture& fx) {
+  ParallelChannel pc;
+  for (auto& ch : fx.channels) pc.AddChannel(&ch);
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("X");
+  pc.CallMethod("Shard", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  assert(rsp.to_string() == "0:X;1:X;2:X;");  // channel order preserved
+  printf("parallel_broadcast OK\n");
+}
+
+void test_parallel_slice(Fixture& fx) {
+  ParallelChannel pc;
+  auto mapper = std::make_shared<SliceMapper>();
+  for (auto& ch : fx.channels) pc.AddChannel(&ch, mapper);
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("a,b,c");
+  pc.CallMethod("Shard", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  assert(rsp.to_string() == "0:a;1:b;2:c;");
+  printf("parallel_slice OK\n");
+}
+
+void test_parallel_fail_limit(Fixture& fx) {
+  // One shard fails (method Fail on sub 1 via mapper override).
+  class FailOneMapper : public CallMapper {
+   public:
+    SubCall Map(int i, int, const std::string&, const IOBuf& req) override {
+      SubCall sc;
+      sc.request = req;
+      if (i == 1) sc.method = "Fail";
+      return sc;
+    }
+  };
+  auto mapper = std::make_shared<FailOneMapper>();
+  {
+    ParallelChannelOptions opts;
+    opts.fail_limit = 1;  // tolerate one failure
+    ParallelChannel pc(opts);
+    for (auto& ch : fx.channels) pc.AddChannel(&ch, mapper);
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("Y");
+    pc.CallMethod("Shard", "Echo", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed());
+    assert(rsp.to_string() == "0:Y;2:Y;");  // failed sub skipped in merge
+  }
+  {
+    ParallelChannel pc;  // fail_limit -1 → all must succeed
+    for (auto& ch : fx.channels) pc.AddChannel(&ch, mapper);
+    Controller cntl;
+    IOBuf req, rsp;
+    pc.CallMethod("Shard", "Echo", &cntl, req, &rsp, nullptr);
+    assert(cntl.Failed());
+    assert(cntl.ErrorCode() == ETOOMANYFAILS);
+  }
+  printf("parallel_fail_limit OK\n");
+}
+
+void test_selective(Fixture& fx) {
+  SelectiveChannel sc;
+  for (auto& ch : fx.channels) sc.AddChannel(&ch);
+  std::set<std::string> seen;
+  for (int i = 0; i < 9; ++i) {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("s");
+    sc.CallMethod("Shard", "Echo", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed());
+    seen.insert(rsp.to_string());
+  }
+  assert(seen.size() == 3);  // rotates over sub-channels
+  printf("selective_rotation OK\n");
+
+  // Kill server 0: calls must fail over to other channels.
+  fx.servers[0].Stop();
+  fx.servers[0].Join();
+  for (int i = 0; i < 9; ++i) {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("f");
+    sc.CallMethod("Shard", "Echo", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed());
+  }
+  printf("selective_failover OK\n");
+}
+
+void test_partition() {
+  // 2 partitions × 1 replica, tags "0/2" and "1/2".
+  constexpr int P = 2;
+  static Server servers[P];
+  static std::unique_ptr<ShardService> svcs[P];
+  std::string list = "list://";
+  for (int i = 0; i < P; ++i) {
+    svcs[i] = std::make_unique<ShardService>(i);
+    assert(servers[i].AddService(svcs[i].get(), "Shard") == 0);
+    assert(servers[i].Start("127.0.0.1:0") == 0);
+    if (i) list += ",";
+    list += servers[i].listen_address().to_string() + ":" +
+            std::to_string(i) + "/" + std::to_string(P);
+  }
+  PartitionChannel pc;
+  assert(pc.Init(P, list) == 0);
+  {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("p");
+    pc.CallMethod("Shard", "Echo", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed());
+    assert(rsp.to_string() == "0:p;1:p;");
+  }
+  {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("q");
+    pc.CallPartition(1, "Shard", "Echo", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed());
+    assert(rsp.to_string() == "1:q;");
+  }
+  for (auto& s : servers) {
+    s.Stop();
+    s.Join();
+  }
+  printf("partition OK\n");
+}
+
+void test_nested_combo(Fixture& fx) {
+  // ParallelChannel over {Channel0, Selective{1,2}} — recursive composition.
+  SelectiveChannel sel;
+  sel.AddChannel(&fx.channels[1]);
+  sel.AddChannel(&fx.channels[2]);
+  ParallelChannel pc;
+  pc.AddChannel(&fx.channels[0]);
+  pc.AddChannel(&sel);
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("n");
+  pc.CallMethod("Shard", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  std::string out = rsp.to_string();
+  assert(out.rfind("0:n;", 0) == 0);
+  assert(out == "0:n;1:n;" || out == "0:n;2:n;");
+  printf("nested_combo OK (%s)\n", out.c_str());
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  {
+    Fixture fx;
+    test_parallel_broadcast(fx);
+    test_parallel_slice(fx);
+    test_parallel_fail_limit(fx);
+    test_nested_combo(fx);
+    test_selective(fx);  // kills server 0 — keep last
+  }
+  test_partition();
+  printf("ALL combo tests OK\n");
+  return 0;
+}
