@@ -130,7 +130,11 @@ void PackFrame(IOBuf* out, const RpcMeta& meta, IOBuf&& body) {
   memcpy(hdr, kMagic, 4);
   uint32_t mlen = mbuf.size();
   uint32_t blen = body.size();
-  hdr[4] = char(mlen >> 24); hdr[5] = char(mlen >> 16);
+  // Byte 4 carries the frame kind so the transport can spot ordered
+  // (stream) frames without decoding the meta; meta length is 24-bit
+  // (capped at 64KB anyway).
+  hdr[4] = meta.type == MetaType::STREAM ? 1 : 0;
+  hdr[5] = char(mlen >> 16);
   hdr[6] = char(mlen >> 8);  hdr[7] = char(mlen);
   hdr[8] = char(blen >> 24); hdr[9] = char(blen >> 16);
   hdr[10] = char(blen >> 8); hdr[11] = char(blen);
@@ -144,7 +148,7 @@ int ParseFrame(IOBuf* source, RpcMeta* meta, IOBuf* body) {
   char hdr[kHeaderLen];
   source->copy_to(hdr, kHeaderLen);
   if (memcmp(hdr, kMagic, 4) != 0) return EINVAL;
-  uint32_t mlen = (uint8_t(hdr[4]) << 24) | (uint8_t(hdr[5]) << 16) |
+  uint32_t mlen = (uint8_t(hdr[5]) << 16) |
                   (uint8_t(hdr[6]) << 8) | uint8_t(hdr[7]);
   uint32_t blen = (uint8_t(hdr[8]) << 24) | (uint8_t(hdr[9]) << 16) |
                   (uint8_t(hdr[10]) << 8) | uint8_t(hdr[11]);

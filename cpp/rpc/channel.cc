@@ -64,6 +64,7 @@ void Channel::CallMethod(const std::string& service, const std::string& method,
   c.request_meta.attachment_size = cntl->request_attachment().size();
   c.request_meta.trace_id = cntl->trace_id;
   c.request_meta.span_id = cntl->span_id;
+  c.request_meta.stream_id = cntl->pending_stream_id;
   c.request_body = request;  // shares blocks — no copy
   c.request_body.append(cntl->request_attachment());
 

@@ -28,6 +28,8 @@ const char* RpcErrorText(int code) {
   }
 }
 
+void (*g_stream_connect_hook)(Controller*) = nullptr;
+
 Controller::~Controller() = default;
 
 void Controller::SetFailed(int code, const char* fmt, ...) {
@@ -147,6 +149,13 @@ void Controller::OnResponse(RpcMeta&& meta, IOBuf&& body) {
   // issue failure) is superseded by this response.
   error_code_ = 0;
   error_text_.clear();
+  // Bind a pending stream to the connection that answered (stream.cc hook;
+  // kept as a function pointer so the core has no stream dependency).
+  if (pending_stream_id != 0) {
+    peer_stream_id = meta.stream_id;
+    stream_socket = c.last_socket;
+    if (g_stream_connect_hook) g_stream_connect_hook(this);
+  }
   const size_t att = meta.attachment_size;
   const size_t payload = body.size() - att;
   if (c.response) body.cutn(c.response, payload);

@@ -24,6 +24,10 @@ namespace brt {
 class Controller;
 using Closure = std::function<void()>;
 
+// Set by stream.cc: invoked (with the correlation id locked) when a
+// response binds a client-created stream to its connection.
+extern void (*g_stream_connect_hook)(Controller*);
+
 // Implemented by Channel and the combo channels: (re-)issues the packed
 // request for one attempt. Called with the correlation id LOCKED.
 class CallIssuer {
@@ -76,6 +80,13 @@ class Controller {
   // Consistent-hashing key for "c_murmurhash" load balancers (reference
   // Controller::set_request_code).
   uint64_t request_code = 0;
+
+  // ---- streaming (rpc/stream.h; reference stream.cpp rides stream
+  // settings on the RPC meta) ----
+  uint64_t pending_stream_id = 0;   // client: set by StreamCreate
+  uint64_t accepted_stream_id = 0;  // server: set by StreamAccept
+  uint64_t peer_stream_id = 0;      // learned from the peer's meta
+  SocketId stream_socket = 0;       // connection the stream binds to
 
   // ---- tracing (rpcz span propagation, reference span.h:47) ----
   uint64_t trace_id = 0;

@@ -30,7 +30,7 @@ ParseResult BrtParse(IOBuf* source, IOBuf* msg, Socket*) {
   char hdr[kHeaderLen];
   source->copy_to(hdr, kHeaderLen);
   if (memcmp(hdr, "BRT1", 4) != 0) return ParseResult::TRY_OTHER;
-  uint32_t mlen = (uint8_t(hdr[4]) << 24) | (uint8_t(hdr[5]) << 16) |
+  uint32_t mlen = (uint8_t(hdr[5]) << 16) |
                   (uint8_t(hdr[6]) << 8) | uint8_t(hdr[7]);
   uint32_t blen = (uint8_t(hdr[8]) << 24) | (uint8_t(hdr[9]) << 16) |
                   (uint8_t(hdr[10]) << 8) | uint8_t(hdr[11]);
@@ -62,6 +62,7 @@ void SendResponse(RpcSession* sess) {
   meta.error_code = sess->cntl.ErrorCode();
   if (meta.error_code) meta.error_text = sess->cntl.ErrorText();
   meta.attachment_size = sess->cntl.response_attachment().size();
+  meta.stream_id = sess->cntl.accepted_stream_id;
   IOBuf body;
   body.append(std::move(sess->response));
   body.append(std::move(sess->cntl.response_attachment()));
@@ -123,6 +124,8 @@ void ProcessRequest(RpcMeta&& meta, IOBuf&& body, SocketId sock,
   sess->cntl.set_remote_side(s->remote());
   sess->cntl.trace_id = meta.trace_id;
   sess->cntl.parent_span_id = meta.span_id;
+  sess->cntl.peer_stream_id = meta.stream_id;  // client wants a stream
+  sess->cntl.stream_socket = sock;
   // Split payload / attachment.
   const size_t att = meta.attachment_size;
   const size_t payload = body.size() - att;
@@ -169,6 +172,14 @@ void BrtProcess(IOBuf&& msg, SocketId sock) {
   }
 }
 
+// Stream frames (header kind byte == 1) must be handed over in arrival
+// order; requests/responses fan out to fibers.
+bool BrtIsOrdered(const IOBuf& msg) {
+  char hdr[5];
+  if (msg.copy_to(hdr, 5) < 5) return false;
+  return hdr[4] == 1;
+}
+
 int g_proto_index = -1;
 
 }  // namespace
@@ -184,6 +195,7 @@ int RegisterBrtProtocol() {
     p.name = "brt_std";
     p.parse = BrtParse;
     p.process = BrtProcess;
+    p.is_ordered = BrtIsOrdered;
     g_proto_index = RegisterProtocol(p);
   });
   return g_proto_index;

@@ -1,0 +1,276 @@
+#include "rpc/stream.h"
+
+#include <mutex>
+#include <unordered_map>
+
+#include "base/logging.h"
+#include "fiber/butex.h"
+#include "fiber/execution_queue.h"
+#include "rpc/protocol_brt.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+namespace {
+
+enum StreamFlags : uint8_t {
+  STREAM_DATA = 0,
+  STREAM_CLOSE = 1,
+  STREAM_FEEDBACK = 2,
+};
+
+struct Stream {
+  StreamId id = 0;
+  uint64_t peer_id = 0;
+  SocketId sock = INVALID_SOCKET_ID;
+  size_t max_buf = 2 * 1024 * 1024;
+  StreamHandler* handler = nullptr;
+
+  // Writer-side flow control (produced vs peer-acknowledged bytes,
+  // reference stream.cpp:274 AppendIfNotFull/_remote_consumed).
+  std::atomic<uint64_t> produced{0};
+  std::atomic<uint64_t> remote_consumed{0};
+  Butex* wr_butex = nullptr;    // bumped on feedback/close to wake writers
+  Butex* join_butex = nullptr;  // bumped when fully closed
+
+  std::atomic<bool> bound{false};        // connected to a socket
+  std::atomic<bool> local_closed{false};
+  std::atomic<bool> peer_closed{false};
+
+  // Receiver-side consumed accounting (only touched by the exec fiber).
+  uint64_t local_consumed = 0;
+  uint64_t last_reported = 0;
+
+  // Serialized delivery (reference: handler runs in an ExecutionQueue,
+  // stream.cpp:447). CLOSE rides the queue too so on_closed never overtakes
+  // queued data.
+  struct Msg {
+    IOBuf data;
+    bool close = false;
+  };
+  ExecutionQueue<Msg> exec;
+
+  ~Stream() {
+    if (wr_butex) butex_destroy(wr_butex);
+    if (join_butex) butex_destroy(join_butex);
+  }
+};
+
+std::mutex g_streams_mu;
+std::unordered_map<StreamId, std::shared_ptr<Stream>>& streams() {
+  static auto* m = new std::unordered_map<StreamId, std::shared_ptr<Stream>>();
+  return *m;
+}
+std::atomic<uint64_t> g_next_stream_id{1};
+
+std::shared_ptr<Stream> find_stream(StreamId id) {
+  std::lock_guard<std::mutex> g(g_streams_mu);
+  auto it = streams().find(id);
+  return it == streams().end() ? nullptr : it->second;
+}
+
+void unregister_stream(StreamId id) {
+  std::lock_guard<std::mutex> g(g_streams_mu);
+  streams().erase(id);
+}
+
+void wake_writers(Stream* s) {
+  butex_value(s->wr_butex).fetch_add(1, std::memory_order_release);
+  butex_wake_all(s->wr_butex);
+}
+
+void finish_if_fully_closed(const std::shared_ptr<Stream>& s) {
+  if (s->local_closed.load(std::memory_order_acquire) &&
+      s->peer_closed.load(std::memory_order_acquire)) {
+    butex_value(s->join_butex).fetch_add(1, std::memory_order_release);
+    butex_wake_all(s->join_butex);
+    unregister_stream(s->id);
+  }
+}
+
+int send_stream_frame(const std::shared_ptr<Stream>& s, uint8_t flags,
+                      IOBuf&& body) {
+  SocketUniquePtr sock;
+  if (Socket::Address(s->sock, &sock) != 0 || sock->Failed()) {
+    return EFAILEDSOCKET;
+  }
+  RpcMeta meta;
+  meta.type = MetaType::STREAM;
+  meta.stream_id = s->peer_id;  // addressed to the RECEIVER's local id
+  meta.stream_flags = flags;
+  IOBuf frame;
+  PackFrame(&frame, meta, std::move(body));
+  return sock->Write(&frame);
+}
+
+// Exec-queue consumer: delivers messages in order, reports consumed bytes
+// when a quarter of the window has been eaten (reference feedback cadence).
+int DeliverMessages(void* meta, ExecutionQueue<Stream::Msg>::TaskIterator& iter) {
+  auto s = find_stream(reinterpret_cast<uintptr_t>(meta));
+  for (; iter.valid(); ++iter) {
+    Stream::Msg& msg = *iter;
+    if (!s) continue;
+    if (msg.close) {
+      s->peer_closed.store(true, std::memory_order_release);
+      if (s->handler) s->handler->on_closed(s->id);
+      wake_writers(s.get());
+      finish_if_fully_closed(s);
+      continue;
+    }
+    const size_t n = msg.data.size();
+    if (s->handler) s->handler->on_received(s->id, std::move(msg.data));
+    s->local_consumed += n;
+    if (s->local_consumed - s->last_reported >= s->max_buf / 4) {
+      s->last_reported = s->local_consumed;
+      IOBuf fb;
+      uint64_t v = s->local_consumed;
+      fb.append(&v, sizeof(v));
+      send_stream_frame(s, STREAM_FEEDBACK, std::move(fb));
+    }
+  }
+  return 0;
+}
+
+std::shared_ptr<Stream> new_stream(const StreamOptions& opts) {
+  auto s = std::make_shared<Stream>();
+  s->id = g_next_stream_id.fetch_add(1, std::memory_order_relaxed);
+  s->max_buf = opts.max_buf_size;
+  s->handler = opts.handler;
+  s->wr_butex = butex_create();
+  s->join_butex = butex_create();
+  s->exec.start(DeliverMessages, reinterpret_cast<void*>(uintptr_t(s->id)));
+  std::lock_guard<std::mutex> g(g_streams_mu);
+  streams()[s->id] = s;
+  return s;
+}
+
+// Incoming STREAM frames (hooked into protocol_brt).
+void OnStreamFrame(RpcMeta&& meta, IOBuf&& body, SocketId /*sock*/) {
+  auto s = find_stream(meta.stream_id);
+  if (!s) return;  // closed/unknown: at-most-once semantics, drop
+  switch (meta.stream_flags) {
+    case STREAM_DATA:
+      s->exec.execute(Stream::Msg{std::move(body), false});
+      break;
+    case STREAM_FEEDBACK: {
+      uint64_t consumed = 0;
+      if (body.copy_to(&consumed, sizeof(consumed)) == sizeof(consumed)) {
+        uint64_t prev = s->remote_consumed.load(std::memory_order_relaxed);
+        while (consumed > prev &&
+               !s->remote_consumed.compare_exchange_weak(
+                   prev, consumed, std::memory_order_acq_rel)) {
+        }
+        wake_writers(s.get());
+      }
+      break;
+    }
+    case STREAM_CLOSE:
+      // Ordered after queued data; if our side already stopped the queue
+      // (local close first), handle inline so joiners still wake.
+      if (s->exec.execute(Stream::Msg{IOBuf(), true}) != 0) {
+        s->peer_closed.store(true, std::memory_order_release);
+        if (s->handler) s->handler->on_closed(s->id);
+        wake_writers(s.get());
+        finish_if_fully_closed(s);
+      }
+      break;
+    default:
+      break;
+  }
+}
+
+void StreamConnectHook(Controller* cntl) {
+  auto s = find_stream(cntl->pending_stream_id);
+  if (!s) return;
+  s->peer_id = cntl->peer_stream_id;
+  s->sock = cntl->stream_socket;
+  s->bound.store(true, std::memory_order_release);
+  wake_writers(s.get());
+}
+
+void InitStreamLayer() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    RegisterBrtProtocol();
+    SetStreamFrameHandler(OnStreamFrame);
+    g_stream_connect_hook = StreamConnectHook;
+  });
+}
+
+}  // namespace
+
+int StreamCreate(StreamId* id, Controller* cntl, const StreamOptions& opts) {
+  if (!id || !cntl) return EINVAL;
+  InitStreamLayer();
+  auto s = new_stream(opts);
+  cntl->pending_stream_id = s->id;
+  *id = s->id;
+  return 0;
+}
+
+int StreamAccept(StreamId* id, Controller* cntl, const StreamOptions& opts) {
+  if (!id || !cntl) return EINVAL;
+  if (cntl->peer_stream_id == 0) return EINVAL;  // client sent no stream
+  InitStreamLayer();
+  auto s = new_stream(opts);
+  s->peer_id = cntl->peer_stream_id;
+  s->sock = cntl->stream_socket;
+  s->bound.store(true, std::memory_order_release);
+  cntl->accepted_stream_id = s->id;
+  *id = s->id;
+  return 0;
+}
+
+int StreamWrite(StreamId id, IOBuf* message) {
+  auto s = find_stream(id);
+  if (!s || s->local_closed.load(std::memory_order_acquire)) return EINVAL;
+  const size_t n = message->size();
+  for (;;) {
+    if (s->peer_closed.load(std::memory_order_acquire)) return EPIPE;
+    const bool bound = s->bound.load(std::memory_order_acquire);
+    const uint64_t inflight =
+        s->produced.load(std::memory_order_relaxed) -
+        s->remote_consumed.load(std::memory_order_acquire);
+    if (bound && inflight + n <= s->max_buf) break;
+    // Window full (or not yet connected): park until feedback/bind
+    // (reference StreamWait/TriggerOnWritable, stream.cpp:358,394).
+    const int expected =
+        butex_value(s->wr_butex).load(std::memory_order_acquire);
+    const uint64_t inflight2 =
+        s->produced.load(std::memory_order_relaxed) -
+        s->remote_consumed.load(std::memory_order_acquire);
+    if (s->bound.load(std::memory_order_acquire) &&
+        inflight2 + n <= s->max_buf) {
+      break;
+    }
+    butex_wait(s->wr_butex, expected, 30 * 1000 * 1000);
+  }
+  s->produced.fetch_add(n, std::memory_order_relaxed);
+  return send_stream_frame(s, STREAM_DATA, std::move(*message));
+}
+
+int StreamClose(StreamId id) {
+  auto s = find_stream(id);
+  if (!s) return 0;
+  if (s->local_closed.exchange(true)) return 0;
+  send_stream_frame(s, STREAM_CLOSE, IOBuf());
+  s->exec.stop();
+  finish_if_fully_closed(s);
+  return 0;
+}
+
+int StreamJoin(StreamId id) {
+  for (;;) {
+    auto s = find_stream(id);
+    if (!s) return 0;  // fully closed & unregistered
+    const int expected =
+        butex_value(s->join_butex).load(std::memory_order_acquire);
+    if (s->local_closed.load(std::memory_order_acquire) &&
+        s->peer_closed.load(std::memory_order_acquire)) {
+      return 0;
+    }
+    butex_wait(s->join_butex, expected, -1);
+  }
+}
+
+}  // namespace brt

@@ -1,0 +1,59 @@
+// Streaming RPC: an ordered, flow-controlled message stream bound to an
+// RPC's connection — created client-side before the call, accepted
+// server-side inside the handler, then both ends StreamWrite freely.
+// Parity target: reference src/brpc/stream.{h,cpp}
+// (StreamCreate/StreamAccept/StreamWrite stream.cpp:736,68,685; flow control
+// via remote-consumed feedback with max_buf_size default 2MB stream.h:53;
+// ordered at-most-once delivery; handler callbacks serialized in an
+// ExecutionQueue stream.cpp:447). This is the PP activation-pipe substrate
+// (SURVEY §2.7: streaming_rpc → 2-stage pipeline parallelism;
+// brpc_tpu.parallel.pipeline drives the compiled-collective sibling).
+#pragma once
+
+#include <cstdint>
+#include <memory>
+
+#include "base/iobuf.h"
+#include "rpc/controller.h"
+
+namespace brt {
+
+using StreamId = uint64_t;
+constexpr StreamId INVALID_STREAM_ID = 0;
+
+// Callbacks run serialized (one ExecutionQueue per stream) — a slow handler
+// back-pressures the peer through the consumed-bytes feedback.
+class StreamHandler {
+ public:
+  virtual ~StreamHandler() = default;
+  virtual void on_received(StreamId id, IOBuf&& message) = 0;
+  virtual void on_closed(StreamId id) {}
+};
+
+struct StreamOptions {
+  // Max unacknowledged bytes in flight; writers block (fiber-park) beyond
+  // this (reference max_buf_size, stream.h:53).
+  size_t max_buf_size = 2 * 1024 * 1024;
+  StreamHandler* handler = nullptr;  // may be null on the write-only side
+};
+
+// Client side: call BEFORE Channel::CallMethod on the same Controller; the
+// stream rides the RPC (settings in the request meta, peer id in the
+// response meta). The stream becomes writable once the RPC succeeds.
+int StreamCreate(StreamId* id, Controller* cntl, const StreamOptions& opts);
+
+// Server side: call INSIDE the service method (before done); the stream is
+// writable immediately after the response is sent.
+int StreamAccept(StreamId* id, Controller* cntl, const StreamOptions& opts);
+
+// Ordered write. Blocks the calling fiber while the flow-control window is
+// full; returns 0, EINVAL (unknown/closed id), or the socket error.
+int StreamWrite(StreamId id, IOBuf* message);
+
+// Graceful close: flushes, sends CLOSE, peer gets on_closed. Idempotent.
+int StreamClose(StreamId id);
+
+// Blocks until the peer closes (or the stream dies). Test/shutdown helper.
+int StreamJoin(StreamId id);
+
+}  // namespace brt

@@ -1,0 +1,208 @@
+// Streaming RPC tests (reference model: test/brpc_streaming_rpc_unittest.cpp
+// — loopback server, StreamCreate/StreamAccept/StreamWrite, flow control).
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/channel.h"
+#include "rpc/server.h"
+#include "rpc/stream.h"
+
+using namespace brt;
+
+namespace {
+
+struct Collector : public StreamHandler {
+  std::atomic<int> count{0};
+  std::atomic<uint64_t> bytes{0};
+  std::string concat;  // ordered (handler is serialized)
+  std::atomic<bool> closed{false};
+  CountdownEvent* close_ev = nullptr;
+
+  void on_received(StreamId, IOBuf&& msg) override {
+    count.fetch_add(1);
+    bytes.fetch_add(msg.size());
+    if (concat.size() < 4096) concat += msg.to_string();
+  }
+  void on_closed(StreamId) override {
+    closed.store(true);
+    if (close_ev) close_ev->signal();
+  }
+};
+
+// Accepts a stream per call; echoes nothing on the RPC itself.
+class StreamService : public Service {
+ public:
+  Collector collector;
+  CountdownEvent close_ev{1};
+  StreamId last_stream = INVALID_STREAM_ID;
+
+  StreamService() { collector.close_ev = &close_ev; }
+
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    StreamOptions opts;
+    opts.handler = &collector;
+    if (StreamAccept(&last_stream, cntl, opts) != 0) {
+      cntl->SetFailed(EREQUEST, "no stream in request");
+    }
+    response->append("accepted");
+    done();
+  }
+};
+
+void test_basic_stream(const EndPoint& addr, StreamService& svc) {
+  Channel ch;
+  assert(ch.Init(addr) == 0);
+  Controller cntl;
+  StreamId sid;
+  StreamOptions sopts;
+  assert(StreamCreate(&sid, &cntl, sopts) == 0);
+  IOBuf req, rsp;
+  ch.CallMethod("Stream", "Open", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  assert(rsp.to_string() == "accepted");
+
+  for (int i = 0; i < 100; ++i) {
+    IOBuf msg;
+    msg.append("m" + std::to_string(i) + ";");
+    assert(StreamWrite(sid, &msg) == 0);
+  }
+  StreamClose(sid);
+  assert(svc.close_ev.wait(5 * 1000 * 1000) == 0);
+  assert(svc.collector.count.load() == 100);
+  assert(svc.collector.concat.rfind("m0;m1;m2;", 0) == 0);  // ordered
+  StreamClose(svc.last_stream);  // server side closes too
+  StreamJoin(sid);
+  printf("basic_stream OK (100 ordered messages)\n");
+}
+
+void test_flow_control(const EndPoint& addr) {
+  // Tiny window: writer must survive (block+resume), all bytes delivered.
+  class SlowHandler : public StreamHandler {
+   public:
+    std::atomic<uint64_t> bytes{0};
+    std::atomic<bool> closed{false};
+    void on_received(StreamId, IOBuf&& msg) override {
+      fiber_usleep(2000);  // slow consumer → feedback throttles producer
+      bytes.fetch_add(msg.size());
+    }
+    void on_closed(StreamId) override { closed.store(true); }
+  };
+
+  class FcService : public Service {
+   public:
+    SlowHandler handler;
+    StreamId accepted = INVALID_STREAM_ID;
+    void CallMethod(const std::string&, Controller* cntl, const IOBuf&,
+                    IOBuf* response, Closure done) override {
+      StreamOptions opts;
+      opts.max_buf_size = 64 * 1024;  // small receive window
+      opts.handler = &handler;
+      StreamAccept(&accepted, cntl, opts);
+      done();
+    }
+  };
+
+  static FcService svc;
+  static Server server;
+  assert(server.AddService(&svc, "Fc") == 0);
+  assert(server.Start("127.0.0.1:0") == 0);
+
+  Channel ch;
+  assert(ch.Init(server.listen_address()) == 0);
+  Controller cntl;
+  StreamId sid;
+  StreamOptions sopts;
+  sopts.max_buf_size = 64 * 1024;  // writer window
+  assert(StreamCreate(&sid, &cntl, sopts) == 0);
+  IOBuf req, rsp;
+  ch.CallMethod("Fc", "Open", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+
+  const uint64_t total = 1 << 20;  // 1MB through a 64KB window
+  std::string blob(8 * 1024, 'f');
+  for (uint64_t sent = 0; sent < total; sent += blob.size()) {
+    IOBuf msg;
+    msg.append(blob);
+    assert(StreamWrite(sid, &msg) == 0);
+  }
+  StreamClose(sid);
+  // Slow consumer: wait up to 30s for full delivery.
+  for (int i = 0; i < 3000 && svc.handler.bytes.load() < total; ++i) {
+    fiber_usleep(10 * 1000);
+  }
+  assert(svc.handler.bytes.load() == total);
+  StreamClose(svc.accepted);
+  StreamJoin(sid);
+  server.Stop();
+  server.Join();
+  printf("flow_control OK (1MB through 64KB window)\n");
+}
+
+void test_bidirectional(const EndPoint& addr, StreamService& unused) {
+  // Server writes back on ITS stream end; client collects.
+  class PingPongService : public Service {
+   public:
+    StreamId accepted = INVALID_STREAM_ID;
+    void CallMethod(const std::string&, Controller* cntl, const IOBuf&,
+                    IOBuf* response, Closure done) override {
+      StreamOptions opts;  // write-only side: no handler
+      StreamAccept(&accepted, cntl, opts);
+      done();
+      // After the response: push 10 messages down the stream.
+      for (int i = 0; i < 10; ++i) {
+        IOBuf m;
+        m.append("srv" + std::to_string(i));
+        StreamWrite(accepted, &m);
+      }
+      StreamClose(accepted);
+    }
+  };
+  static PingPongService svc;
+  static Server server;
+  assert(server.AddService(&svc, "PP") == 0);
+  assert(server.Start("127.0.0.1:0") == 0);
+
+  Collector col;
+  CountdownEvent ev(1);
+  col.close_ev = &ev;
+  Channel ch;
+  assert(ch.Init(server.listen_address()) == 0);
+  Controller cntl;
+  StreamId sid;
+  StreamOptions sopts;
+  sopts.handler = &col;
+  assert(StreamCreate(&sid, &cntl, sopts) == 0);
+  IOBuf req, rsp;
+  ch.CallMethod("PP", "Open", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  assert(ev.wait(5 * 1000 * 1000) == 0);
+  assert(col.count.load() == 10);
+  assert(col.concat.rfind("srv0srv1", 0) == 0);
+  StreamClose(sid);
+  server.Stop();
+  server.Join();
+  printf("bidirectional OK (server→client push)\n");
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  static StreamService svc;
+  static Server server;
+  assert(server.AddService(&svc, "Stream") == 0);
+  assert(server.Start("127.0.0.1:0") == 0);
+  test_basic_stream(server.listen_address(), svc);
+  test_bidirectional(server.listen_address(), svc);
+  test_flow_control(server.listen_address());
+  server.Stop();
+  server.Join();
+  printf("ALL stream tests OK\n");
+  return 0;
+}

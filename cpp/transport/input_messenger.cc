@@ -107,7 +107,14 @@ void InputMessengerOnEdgeTriggered(Socket* s) {
       return;
     }
     s->messages_read.fetch_add(1, std::memory_order_relaxed);
-    batch.push_back(new ProcessArg{&g_protocols[pi], std::move(msg), s->id()});
+    const Protocol& proto = g_protocols[pi];
+    if (proto.is_ordered != nullptr && proto.is_ordered(msg)) {
+      // Ordered frames (streams) are handed over NOW, in arrival order —
+      // fanning them out to fibers would scramble the stream.
+      proto.process(std::move(msg), s->id());
+      continue;
+    }
+    batch.push_back(new ProcessArg{&proto, std::move(msg), s->id()});
   }
   if (pending_err != 0) {
     s->SetFailed(pending_err, "%s", pending_msg);

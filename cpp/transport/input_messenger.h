@@ -26,6 +26,11 @@ struct Protocol {
   // Handle a cut message; runs in a fiber. May use s->user() to reach the
   // owning Server/Channel.
   void (*process)(IOBuf&& msg, SocketId sid);
+  // Optional: messages answering true are processed INLINE in the read
+  // fiber, preserving arrival order (stream frames — the reference routes
+  // those through the socket-ordered path into the stream's
+  // ExecutionQueue, stream.cpp:447; requests/responses stay parallel).
+  bool (*is_ordered)(const IOBuf& msg) = nullptr;
 };
 
 // Registers at startup (not thread-safe vs traffic; mirror of the
