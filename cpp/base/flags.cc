@@ -1,0 +1,98 @@
+#include "base/flags.h"
+
+#include <map>
+#include <mutex>
+
+namespace brt {
+
+namespace {
+
+struct Entry {
+  std::function<std::string()> get;
+  std::function<int(const std::string&)> set;  // 0/EINVAL
+  std::string description;
+  bool reloadable;
+};
+
+std::mutex g_mu;
+std::map<std::string, Entry>& registry() {
+  static auto* m = new std::map<std::string, Entry>();
+  return *m;
+}
+
+void add(const std::string& name, Entry e) {
+  std::lock_guard<std::mutex> g(g_mu);
+  registry()[name] = std::move(e);
+}
+
+}  // namespace
+
+void RegisterFlag(const std::string& name, int64_t* storage,
+                  const std::string& description, bool reloadable,
+                  std::function<bool(int64_t)> validator) {
+  add(name, Entry{
+      [storage] { return std::to_string(*storage); },
+      [storage, validator](const std::string& v) {
+        char* end = nullptr;
+        long long x = strtoll(v.c_str(), &end, 10);
+        if (end == v.c_str() || *end) return EINVAL;
+        if (validator && !validator(x)) return EINVAL;
+        *storage = x;
+        return 0;
+      },
+      description, reloadable});
+}
+
+void RegisterFlag(const std::string& name, uint32_t* storage,
+                  const std::string& description, bool reloadable) {
+  add(name, Entry{
+      [storage] { return std::to_string(*storage); },
+      [storage](const std::string& v) {
+        char* end = nullptr;
+        unsigned long long x = strtoull(v.c_str(), &end, 10);
+        if (end == v.c_str() || *end || x > UINT32_MAX) return EINVAL;
+        *storage = uint32_t(x);
+        return 0;
+      },
+      description, reloadable});
+}
+
+void RegisterFlag(const std::string& name, bool* storage,
+                  const std::string& description, bool reloadable) {
+  add(name, Entry{
+      [storage] { return std::string(*storage ? "true" : "false"); },
+      [storage](const std::string& v) {
+        if (v == "true" || v == "1") *storage = true;
+        else if (v == "false" || v == "0") *storage = false;
+        else return EINVAL;
+        return 0;
+      },
+      description, reloadable});
+}
+
+std::vector<FlagInfo> ListFlags() {
+  std::lock_guard<std::mutex> g(g_mu);
+  std::vector<FlagInfo> out;
+  for (auto& [name, e] : registry()) {
+    out.push_back(FlagInfo{name, e.get(), e.description, e.reloadable});
+  }
+  return out;
+}
+
+int SetFlag(const std::string& name, const std::string& value) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = registry().find(name);
+  if (it == registry().end()) return ENOENT;
+  if (!it->second.reloadable) return EPERM;
+  return it->second.set(value);
+}
+
+bool GetFlag(const std::string& name, std::string* value) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = registry().find(name);
+  if (it == registry().end()) return false;
+  *value = it->second.get();
+  return true;
+}
+
+}  // namespace brt

@@ -1,0 +1,37 @@
+// Runtime-reloadable flag registry behind the /flags builtin service.
+// Parity target: reference gflags + reloadable_flags.h (validators make
+// flags safely mutable through builtin/flags_service.cpp; doc
+// docs/cn/flags.md). Redesigned: a tiny registry of typed accessors — no
+// gflags dependency in the native core.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace brt {
+
+struct FlagInfo {
+  std::string name;
+  std::string value;
+  std::string description;
+  bool reloadable = false;
+};
+
+// Registers a flag backed by an int64-ish variable. `validator` (optional)
+// rejects bad values before the store. Registration is startup-time.
+void RegisterFlag(const std::string& name, int64_t* storage,
+                  const std::string& description, bool reloadable = true,
+                  std::function<bool(int64_t)> validator = nullptr);
+void RegisterFlag(const std::string& name, uint32_t* storage,
+                  const std::string& description, bool reloadable = true);
+void RegisterFlag(const std::string& name, bool* storage,
+                  const std::string& description, bool reloadable = true);
+
+std::vector<FlagInfo> ListFlags();
+// Returns 0, ENOENT (unknown), EPERM (not reloadable), EINVAL (bad value).
+int SetFlag(const std::string& name, const std::string& value);
+bool GetFlag(const std::string& name, std::string* value);
+
+}  // namespace brt

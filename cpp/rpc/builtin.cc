@@ -1,0 +1,137 @@
+#include "rpc/builtin.h"
+
+#include <sstream>
+
+#include "base/flags.h"
+#include "base/time.h"
+#include "rpc/server.h"
+#include "transport/socket.h"
+#include "var/variable.h"
+
+namespace brt {
+
+namespace {
+
+constexpr const char* kVersion = "brpc-tpu/0.1";
+
+void StatusPage(Server* server, std::ostringstream& os) {
+  os << "version: " << kVersion << "\n";
+  if (server) {
+    const int64_t up_s = (monotonic_us() - server->start_time_us) / 1000000;
+    os << "listen: " << server->listen_address().to_string() << "\n"
+       << "uptime_s: " << up_s << "\n"
+       << "concurrency: " << server->current_concurrency() << "\n"
+       << "requests_processed: " << server->requests_processed.load() << "\n"
+       << "services:";
+    for (const auto& s : server->ListServices()) os << " " << s;
+    os << "\n\n[methods]\n";
+    server->ListMethodStats([&](const std::string& key, MethodStatus* ms) {
+      os << key << "  count=" << ms->latency.count()
+         << " qps=" << ms->latency.qps()
+         << " latency_us=" << ms->latency.latency()
+         << " p50=" << ms->latency.latency_percentile(0.5)
+         << " p99=" << ms->latency.latency_percentile(0.99)
+         << " max=" << ms->latency.max_latency()
+         << " concurrency=" << ms->concurrency.load()
+         << " errors=" << ms->nerror.load() << "\n";
+    });
+  }
+}
+
+void ConnectionsPage(std::ostringstream& os) {
+  std::vector<SocketId> ids;
+  Socket::ListSockets(&ids);
+  os << "socket_count: " << ids.size() << "\n"
+     << "id  fd  remote  in_bytes  out_bytes  in_msgs  failed\n";
+  for (SocketId id : ids) {
+    SocketUniquePtr p;
+    if (Socket::Address(id, &p) != 0) continue;
+    os << std::hex << id << std::dec << "  " << p->fd() << "  "
+       << p->remote().to_string() << "  " << p->bytes_read.load() << "  "
+       << p->bytes_written.load() << "  " << p->messages_read.load() << "  "
+       << (p->Failed() ? "yes" : "no") << "\n";
+  }
+}
+
+void FlagsPage(const std::string& sub, const std::string& query,
+               HttpResponse* out) {
+  if (!sub.empty()) {
+    // /flags/<name>?setvalue=v  → live reload (reference flags_service.cpp)
+    const std::string setkey = "setvalue=";
+    size_t pos = query.find(setkey);
+    if (pos != std::string::npos) {
+      std::string val = query.substr(pos + setkey.size());
+      size_t amp = val.find('&');
+      if (amp != std::string::npos) val = val.substr(0, amp);
+      int rc = SetFlag(sub, val);
+      if (rc == 0) out->body = sub + " set to " + val + "\n";
+      else {
+        out->status = rc == ENOENT ? 404 : 403;
+        out->body = "cannot set " + sub + "\n";
+      }
+      return;
+    }
+    std::string v;
+    if (GetFlag(sub, &v)) out->body = sub + ": " + v + "\n";
+    else {
+      out->status = 404;
+      out->body = "unknown flag " + sub + "\n";
+    }
+    return;
+  }
+  std::ostringstream os;
+  for (const FlagInfo& f : ListFlags()) {
+    os << f.name << "=" << f.value << (f.reloadable ? " (R)" : "") << "  # "
+       << f.description << "\n";
+  }
+  out->body = os.str();
+}
+
+}  // namespace
+
+bool HandleBuiltinPage(Server* server, const std::string& method,
+                       const std::string& path, const std::string& query,
+                       HttpResponse* out) {
+  std::ostringstream os;
+  if (path == "/health") {
+    out->body = "OK\n";
+    return true;
+  }
+  if (path == "/version") {
+    out->body = std::string(kVersion) + "\n";
+    return true;
+  }
+  if (path == "/status" || path == "/") {
+    StatusPage(server, os);
+    out->body = os.str();
+    return true;
+  }
+  if (path == "/vars" || path.rfind("/vars/", 0) == 0) {
+    std::string filter =
+        path.size() > 6 ? path.substr(6) : query;  // /vars/foo or ?foo
+    var::Variable::dump_exposed(
+        [&](const std::string& name, const std::string& value) {
+          os << name << " : " << value << "\n";
+        },
+        filter);
+    out->body = os.str();
+    return true;
+  }
+  if (path == "/brpc_metrics" || path == "/metrics") {
+    var::Variable::dump_prometheus(os);
+    out->body = os.str();
+    return true;
+  }
+  if (path == "/connections") {
+    ConnectionsPage(os);
+    out->body = os.str();
+    return true;
+  }
+  if (path == "/flags" || path.rfind("/flags/", 0) == 0) {
+    FlagsPage(path.size() > 7 ? path.substr(7) : "", query, out);
+    return true;
+  }
+  return false;
+}
+
+}  // namespace brt

@@ -1,0 +1,27 @@
+// Builtin HTTP observability services, auto-served on every Server's port.
+// Parity target: reference src/brpc/builtin/ (25+ services registered by
+// Server::AddBuiltinServices, server.cpp:471): /status /vars /flags /health
+// /connections /version /index + Prometheus /brpc_metrics
+// (prometheus_metrics_service.cpp:207).
+#pragma once
+
+#include <string>
+
+namespace brt {
+
+class Server;
+
+struct HttpResponse {
+  int status = 200;
+  std::string content_type = "text/plain";
+  std::string body;
+};
+
+// Dispatches a builtin path ("/status", "/vars?filter", "/flags/foo?setvalue=1",
+// ...). Returns false if the path is not a builtin (caller falls through to
+// user-service routing).
+bool HandleBuiltinPage(Server* server, const std::string& method,
+                       const std::string& path, const std::string& query,
+                       HttpResponse* out);
+
+}  // namespace brt

@@ -1,0 +1,216 @@
+// Minimal HTTP/1.1 server protocol: serves the builtin observability pages
+// and exposes every registered Service at POST/GET /<Service>/<Method>
+// (body in, body out) — the reference's "pb services accessible via
+// HTTP+JSON" surface (policy/http_rpc_protocol.cpp:1668 + restful.cpp),
+// here as a transparent byte-payload mapping (JSON handling stays in the
+// application or the Python layer).
+// Shares the port with brt_std: the InputMessenger tries protocols in
+// order (multi-protocol-same-port, reference input_messenger.cpp:77).
+#include <algorithm>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#include "base/time.h"
+
+#include "rpc/builtin.h"
+#include "rpc/controller.h"
+#include "rpc/http_protocol.h"
+#include "rpc/server.h"
+#include "transport/input_messenger.h"
+
+namespace brt {
+
+namespace {
+
+bool LooksLikeHttp(const char* p, size_t n) {
+  static const char* kMethods[] = {"GET ",    "POST ",  "PUT ",
+                                   "DELETE ", "HEAD ",  "OPTIONS ",
+                                   "PATCH "};
+  for (const char* m : kMethods) {
+    const size_t len = strlen(m);
+    if (n >= len && memcmp(p, m, len) == 0) return true;
+  }
+  return false;
+}
+
+// Finds header end; returns content-length via *body_len (0 if absent).
+ssize_t FindHeaderEnd(const std::string& s, size_t* body_len) {
+  size_t pos = s.find("\r\n\r\n");
+  if (pos == std::string::npos) return -1;
+  *body_len = 0;
+  // scan headers case-insensitively for content-length
+  size_t line = s.find("\r\n");
+  while (line < pos) {
+    size_t next = s.find("\r\n", line + 2);
+    std::string h = s.substr(line + 2, next - line - 2);
+    std::string lower = h;
+    std::transform(lower.begin(), lower.end(), lower.begin(), ::tolower);
+    if (lower.rfind("content-length:", 0) == 0) {
+      *body_len = size_t(atoll(h.c_str() + 15));
+    }
+    line = next;
+  }
+  return ssize_t(pos + 4);
+}
+
+ParseResult HttpParse(IOBuf* source, IOBuf* msg, Socket*) {
+  char probe[8];
+  const size_t pn = std::min<size_t>(source->size(), 8);
+  if (pn < 4) return ParseResult::NOT_ENOUGH_DATA;
+  source->copy_to(probe, pn);
+  if (!LooksLikeHttp(probe, pn)) return ParseResult::TRY_OTHER;
+  // Header must fit in 64KB.
+  std::string head;
+  source->copy_to(&head, std::min<size_t>(source->size(), 64 * 1024));
+  size_t body_len = 0;
+  ssize_t hdr_end = FindHeaderEnd(head, &body_len);
+  if (hdr_end < 0) {
+    return source->size() >= 64 * 1024 ? ParseResult::ERROR
+                                       : ParseResult::NOT_ENOUGH_DATA;
+  }
+  const size_t total = size_t(hdr_end) + body_len;
+  if (source->size() < total) return ParseResult::NOT_ENOUGH_DATA;
+  source->cutn(msg, total);
+  return ParseResult::OK;
+}
+
+void WriteHttpResponse(Socket* s, const HttpResponse& r, bool keep_alive) {
+  const char* reason = r.status == 200   ? "OK"
+                       : r.status == 404 ? "Not Found"
+                       : r.status == 403 ? "Forbidden"
+                       : r.status == 500 ? "Internal Server Error"
+                                         : "Error";
+  std::string head = "HTTP/1.1 " + std::to_string(r.status) + " " + reason +
+                     "\r\nContent-Type: " + r.content_type +
+                     "\r\nContent-Length: " + std::to_string(r.body.size()) +
+                     (keep_alive ? "\r\nConnection: keep-alive"
+                                 : "\r\nConnection: close") +
+                     "\r\n\r\n";
+  IOBuf out;
+  out.append(head);
+  out.append(r.body);
+  s->Write(&out);
+}
+
+// Server-side HTTP session for user-service calls (async done supported).
+struct HttpSession {
+  Controller cntl;
+  IOBuf request;
+  IOBuf response;
+  SocketId sock;
+  bool keep_alive = true;
+};
+
+void HttpProcess(IOBuf&& msg, SocketId sid) {
+  SocketUniquePtr ptr;
+  if (Socket::Address(sid, &ptr) != 0) return;
+  std::string text = msg.to_string();
+
+  // Request line.
+  size_t eol = text.find("\r\n");
+  if (eol == std::string::npos) return;
+  std::string reqline = text.substr(0, eol);
+  size_t sp1 = reqline.find(' ');
+  size_t sp2 = reqline.rfind(' ');
+  if (sp1 == std::string::npos || sp2 <= sp1) return;
+  std::string method = reqline.substr(0, sp1);
+  std::string target = reqline.substr(sp1 + 1, sp2 - sp1 - 1);
+  std::string path = target, query;
+  size_t q = target.find('?');
+  if (q != std::string::npos) {
+    path = target.substr(0, q);
+    query = target.substr(q + 1);
+  }
+  size_t body_len = 0;
+  ssize_t hdr_end = FindHeaderEnd(text, &body_len);
+  if (hdr_end < 0) return;
+  const bool keep_alive =
+      text.find("Connection: close") == std::string::npos;
+
+  auto* server = static_cast<Server*>(ptr->user());
+
+  HttpResponse builtin;
+  if (HandleBuiltinPage(server, method, path, query, &builtin)) {
+    WriteHttpResponse(ptr.get(), builtin, keep_alive);
+    return;
+  }
+
+  // /Service/Method dispatch.
+  if (server == nullptr || !server->IsRunning()) {
+    WriteHttpResponse(ptr.get(), HttpResponse{503, "text/plain",
+                                              "server stopped\n"},
+                      false);
+    return;
+  }
+  size_t slash = path.find('/', 1);
+  if (path.size() < 2 || slash == std::string::npos ||
+      slash + 1 >= path.size()) {
+    WriteHttpResponse(ptr.get(), HttpResponse{404, "text/plain",
+                                              "no such page or service\n"},
+                      keep_alive);
+    return;
+  }
+  std::string service = path.substr(1, slash - 1);
+  std::string rpc_method = path.substr(slash + 1);
+  Service* svc = server->FindService(service);
+  if (svc == nullptr) {
+    WriteHttpResponse(ptr.get(),
+                      HttpResponse{404, "text/plain",
+                                   "service " + service + " not found\n"},
+                      keep_alive);
+    return;
+  }
+  if (!server->OnRequestArrived()) {
+    WriteHttpResponse(ptr.get(), HttpResponse{503, "text/plain",
+                                              "too many requests\n"},
+                      keep_alive);
+    return;
+  }
+  MethodStatus* ms = server->GetMethodStatus(service, rpc_method);
+  ms->OnRequested();
+  auto* sess = new HttpSession;
+  sess->sock = sid;
+  sess->keep_alive = keep_alive;
+  sess->cntl.set_remote_side(ptr->remote());
+  sess->request.append(text.data() + hdr_end, body_len);
+  const int64_t start_us = monotonic_us();
+  svc->CallMethod(rpc_method, &sess->cntl, sess->request, &sess->response,
+                  [sess, server, ms, start_us] {
+    HttpResponse r;
+    if (sess->cntl.Failed()) {
+      r.status = 500;
+      r.body = std::to_string(sess->cntl.ErrorCode()) + ": " +
+               sess->cntl.ErrorText() + "\n";
+    } else {
+      r.content_type = "application/octet-stream";
+      r.body = sess->response.to_string();
+      r.body += sess->cntl.response_attachment().to_string();
+    }
+    SocketUniquePtr p2;
+    if (Socket::Address(sess->sock, &p2) == 0) {
+      WriteHttpResponse(p2.get(), r, sess->keep_alive);
+    }
+    ms->OnResponded(sess->cntl.ErrorCode(), monotonic_us() - start_us);
+    server->OnRequestDone();
+    server->requests_processed.fetch_add(1, std::memory_order_relaxed);
+    delete sess;
+  });
+}
+
+}  // namespace
+
+int RegisterHttpProtocol() {
+  static int index = -1;
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.name = "http";
+    p.parse = HttpParse;
+    p.process = HttpProcess;
+    index = RegisterProtocol(p);
+  });
+  return index;
+}
+
+}  // namespace brt

@@ -1,0 +1,12 @@
+// HTTP/1.1 server protocol registration (see http_protocol.cc).
+#pragma once
+
+namespace brt {
+
+// Idempotent; returns the protocol index. Registered automatically by
+// Server::Start so every RPC port also answers HTTP (builtin pages +
+// /Service/Method dispatch) — the reference serves its builtin services on
+// the same port the same way (server.cpp:471).
+int RegisterHttpProtocol();
+
+}  // namespace brt

@@ -9,6 +9,7 @@
 
 #include <mutex>
 
+#include "base/flags.h"
 #include "base/logging.h"
 #include "base/time.h"
 #include "rpc/controller.h"
@@ -191,6 +192,8 @@ void SetStreamFrameHandler(StreamFrameHandler h) {
 int RegisterBrtProtocol() {
   static std::once_flag once;
   std::call_once(once, [] {
+    RegisterFlag("max_body_size", &FLAGS_max_body_size,
+                 "largest accepted rpc frame body in bytes");
     Protocol p;
     p.name = "brt_std";
     p.parse = BrtParse;

@@ -1,7 +1,9 @@
 #include "rpc/server.h"
 
 #include "base/logging.h"
+#include "base/time.h"
 #include "fiber/fiber.h"
+#include "rpc/http_protocol.h"
 #include "rpc/protocol_brt.h"
 #include "transport/input_messenger.h"
 
@@ -30,6 +32,8 @@ int Server::Start(const EndPoint& addr, const Options* opts) {
   if (opts) options_ = *opts;
   fiber_init(options_.fiber_workers);
   RegisterBrtProtocol();
+  RegisterHttpProtocol();
+  start_time_us = monotonic_us();
   acceptor_.conn_options.user = this;
   acceptor_.conn_options.on_edge_triggered = InputMessengerOnEdgeTriggered;
   int rc = acceptor_.StartAccept(addr);

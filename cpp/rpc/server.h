@@ -7,10 +7,12 @@
 #pragma once
 
 #include <atomic>
+#include <functional>
 #include <memory>
 #include <shared_mutex>
 #include <string>
 #include <unordered_map>
+#include <vector>
 
 #include "rpc/controller.h"
 #include "transport/acceptor.h"
@@ -99,6 +101,19 @@ class Server {
 
   // Builtin-service hook points (observability layer).
   std::atomic<uint64_t> requests_processed{0};
+  int64_t start_time_us = 0;
+
+  // Snapshot walk for the /status builtin.
+  void ListMethodStats(
+      const std::function<void(const std::string&, MethodStatus*)>& cb) {
+    std::shared_lock lk(method_mu_);
+    for (auto& [key, ms] : methods_) cb(key, ms.get());
+  }
+  std::vector<std::string> ListServices() const {
+    std::vector<std::string> out;
+    for (auto& [name, svc] : services_) out.push_back(name);
+    return out;
+  }
 
  private:
   Options options_;

@@ -1,0 +1,137 @@
+// HTTP surface tests: builtin pages + /Service/Method dispatch over a raw
+// TCP client (reference model: test/brpc_http_rpc_protocol_unittest.cpp +
+// builtin service tests).
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "base/flags.h"
+#include "fiber/fiber.h"
+#include "rpc/channel.h"
+#include "rpc/server.h"
+#include "var/latency_recorder.h"
+
+using namespace brt;
+
+namespace {
+
+class EchoService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    if (method == "Echo") response->append(request);
+    else cntl->SetFailed(ENOMETHOD, nullptr);
+    done();
+  }
+};
+
+// Blocking mini HTTP client: one request, reads until close or full body.
+std::string HttpGet(const EndPoint& addr, const std::string& request) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  assert(fd >= 0);
+  sockaddr_in sa = addr.to_sockaddr();
+  assert(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0);
+  assert(write(fd, request.data(), request.size()) ==
+         ssize_t(request.size()));
+  std::string out;
+  char buf[4096];
+  // Read headers + content-length body.
+  ssize_t n;
+  size_t want = SIZE_MAX;
+  while (out.size() < want && (n = read(fd, buf, sizeof(buf))) > 0) {
+    out.append(buf, size_t(n));
+    if (want == SIZE_MAX) {
+      size_t he = out.find("\r\n\r\n");
+      if (he != std::string::npos) {
+        size_t cl = out.find("Content-Length: ");
+        if (cl != std::string::npos && cl < he) {
+          want = he + 4 + size_t(atoll(out.c_str() + cl + 16));
+        }
+      }
+    }
+  }
+  close(fd);
+  return out;
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  Server server;
+  EchoService echo;
+  assert(server.AddService(&echo, "Echo") == 0);
+  assert(server.Start("127.0.0.1:0") == 0);
+  const EndPoint addr = server.listen_address();
+
+  // Warm some RPC stats so /status has content.
+  Channel ch;
+  assert(ch.Init(addr) == 0);
+  for (int i = 0; i < 5; ++i) {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("warm");
+    ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed());
+  }
+
+  std::string r = HttpGet(addr, "GET /health HTTP/1.1\r\n\r\n");
+  assert(r.rfind("HTTP/1.1 200", 0) == 0);
+  assert(r.find("OK") != std::string::npos);
+  printf("http_health OK\n");
+
+  r = HttpGet(addr, "GET /status HTTP/1.1\r\n\r\n");
+  assert(r.find("services: Echo") != std::string::npos);
+  assert(r.find("Echo.Echo") != std::string::npos);
+  assert(r.find("count=5") != std::string::npos);
+  printf("http_status OK\n");
+
+  // /vars with an exposed variable.
+  static var::LatencyRecorder rec;
+  rec.expose("test_http_latency");
+  rec << 100;
+  r = HttpGet(addr, "GET /vars HTTP/1.1\r\n\r\n");
+  assert(r.find("test_http_latency") != std::string::npos);
+  printf("http_vars OK\n");
+
+  r = HttpGet(addr, "GET /brpc_metrics HTTP/1.1\r\n\r\n");
+  assert(r.rfind("HTTP/1.1 200", 0) == 0);
+  printf("http_metrics OK\n");
+
+  r = HttpGet(addr, "GET /connections HTTP/1.1\r\n\r\n");
+  assert(r.find("socket_count") != std::string::npos);
+  printf("http_connections OK\n");
+
+  // Flags: read + live reload.
+  r = HttpGet(addr, "GET /flags HTTP/1.1\r\n\r\n");
+  assert(r.find("max_body_size") != std::string::npos);
+  r = HttpGet(addr, "GET /flags/max_body_size?setvalue=1048576 HTTP/1.1\r\n\r\n");
+  assert(r.rfind("HTTP/1.1 200", 0) == 0);
+  std::string v;
+  assert(GetFlag("max_body_size", &v) && v == "1048576");
+  SetFlag("max_body_size", "67108864");  // restore
+  printf("http_flags OK\n");
+
+  // Service dispatch: POST /Echo/Echo with body.
+  std::string body = "http payload!";
+  r = HttpGet(addr, "POST /Echo/Echo HTTP/1.1\r\nContent-Length: " +
+                        std::to_string(body.size()) + "\r\n\r\n" + body);
+  assert(r.rfind("HTTP/1.1 200", 0) == 0);
+  assert(r.find(body) != std::string::npos);
+  printf("http_service_dispatch OK\n");
+
+  r = HttpGet(addr, "GET /Nope/Nothing HTTP/1.1\r\n\r\n");
+  assert(r.rfind("HTTP/1.1 404", 0) == 0);
+  printf("http_404 OK\n");
+
+  server.Stop();
+  server.Join();
+  printf("ALL http tests OK\n");
+  return 0;
+}
