@@ -36,6 +36,7 @@ struct Stream {
   std::atomic<bool> bound{false};        // connected to a socket
   std::atomic<bool> local_closed{false};
   std::atomic<bool> peer_closed{false};
+  std::atomic<bool> reaped{false};       // teardown started
 
   // Receiver-side consumed accounting (only touched by the exec fiber).
   uint64_t local_consumed = 0;
@@ -79,11 +80,28 @@ void wake_writers(Stream* s) {
   butex_wake_all(s->wr_butex);
 }
 
+void* StreamReaperEntry(void* arg) {
+  // Holds the LAST reference until the exec consumer fiber has fully
+  // drained — the queue lives inside the Stream, so dropping the ref while
+  // consume() still walks nodes is a use-after-free.
+  auto* sp = static_cast<std::shared_ptr<Stream>*>(arg);
+  (*sp)->exec.join();
+  delete sp;
+  return nullptr;
+}
+
 void finish_if_fully_closed(const std::shared_ptr<Stream>& s) {
   if (s->local_closed.load(std::memory_order_acquire) &&
-      s->peer_closed.load(std::memory_order_acquire)) {
+      s->peer_closed.load(std::memory_order_acquire) &&
+      !s->reaped.exchange(true, std::memory_order_acq_rel)) {
     butex_value(s->join_butex).fetch_add(1, std::memory_order_release);
     butex_wake_all(s->join_butex);
+    s->exec.stop();  // guarantees a consumer run that signals join()
+    fiber_t tid;
+    auto* keep = new std::shared_ptr<Stream>(s);
+    if (fiber_start(&tid, StreamReaperEntry, keep) != 0) {
+      delete keep;  // degraded: rely on registry ref being gone later
+    }
     unregister_stream(s->id);
   }
 }
