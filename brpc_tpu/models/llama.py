@@ -163,7 +163,8 @@ def attention(q, k, v, *, causal: bool = True):
     return out.reshape(b, t, hq * d)
 
 
-def _layer(cfg: LlamaConfig, x: jax.Array, lp: Params, positions: jax.Array) -> jax.Array:
+def _layer(cfg: LlamaConfig, x: jax.Array, lp: Params, positions: jax.Array,
+           attn_fn=None) -> jax.Array:
     b, t, h = x.shape
     # attention block
     y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -172,14 +173,16 @@ def _layer(cfg: LlamaConfig, x: jax.Array, lp: Params, positions: jax.Array) -> 
     v = (y @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    x = x + attention(q, k, v) @ lp["wo"]
+    attend = attn_fn if attn_fn is not None else attention
+    x = x + attend(q, k, v) @ lp["wo"]
     # mlp block
     y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     x = x + (jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])) @ lp["w_down"]
     return x
 
 
-def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+            attn_fn=None) -> jax.Array:
     """tokens: [B, T] int32 -> logits [B, T, vocab] float32.
 
     Master weights stay in cfg.param_dtype (fp32); compute runs in cfg.dtype
@@ -195,23 +198,24 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
 
     def body(x, lp):
-        return _layer(cfg, x, cast(lp), positions), None
+        return _layer(cfg, x, cast(lp), positions, attn_fn), None
 
     x, _ = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
     return (x @ cast(params["lm_head"])).astype(jnp.float32)
 
 
-def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+            attn_fn=None) -> jax.Array:
     """Next-token cross-entropy (last position predicts nothing)."""
-    logits = forward(params, tokens, cfg)[:, :-1]
+    logits = forward(params, tokens, cfg, attn_fn)[:, :-1]
     targets = tokens[:, 1:]
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(logz - gold)
 
 
-def make_train_step(cfg: LlamaConfig, optimizer):
+def make_train_step(cfg: LlamaConfig, optimizer, attn_fn=None):
     """Returns jittable (params, opt_state, tokens) -> (params, opt_state, loss).
 
     Data-parallel gradient reduction is *not* hand-written: with params
@@ -220,7 +224,8 @@ def make_train_step(cfg: LlamaConfig, optimizer):
     """
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg,
+                                                  attn_fn)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         # params/updates are fp32 master copies; no precision-losing casts.
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)

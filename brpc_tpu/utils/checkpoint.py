@@ -1,0 +1,67 @@
+"""Checkpoint / resume for sharded training state.
+
+The reference has no model checkpointing (SURVEY.md §5.5 — its nearest
+analogs are rpc_dump's recordio capture and rpcz's LevelDB); this is the
+new scope the TPU build adds: async, sharding-preserving checkpoints of the
+(params, opt_state, step) pytree via orbax, restoring onto any mesh (orbax
+re-shards on load).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _manager(ckpt_dir: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(ckpt_dir),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True
+        ),
+    )
+
+
+def save_checkpoint(ckpt_dir: str, step: int, state: Any,
+                    max_to_keep: int = 3, blocking: bool = True) -> None:
+    """Saves a pytree (arrays keep their shardings). ``state`` is any
+    pytree: {'params': ..., 'opt_state': ..., ...}."""
+    mgr = _manager(ckpt_dir, max_to_keep)
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    if blocking:
+        mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(ckpt_dir: str) -> int | None:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    mgr = _manager(ckpt_dir)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore_checkpoint(ckpt_dir: str, step: int | None = None,
+                       template: Any = None) -> Any:
+    """Restores the pytree saved at ``step`` (default: latest). With
+    ``template`` (a pytree of like-shaped, possibly-sharded arrays), the
+    restore re-shards onto the template's layout."""
+    mgr = _manager(ckpt_dir)
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            mgr.close()
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+    if template is not None:
+        restored = mgr.restore(
+            step,
+            args=ocp.args.StandardRestore(template),
+        )
+    else:
+        restored = mgr.restore(step)
+    mgr.close()
+    return restored
