@@ -1,0 +1,156 @@
+"""ctypes bindings over the native RPC core (cpp/ → libbrpc_tpu_c.so).
+
+Gives Python the reference's user surface — Server/Channel/Controller
+(src/brpc/server.h:347, channel.h:151) — backed by the C++ fiber scheduler,
+wait-free socket transport and cluster layer. Payloads are bytes; structure
+(JSON, msgpack, numpy buffers) is the caller's choice.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Callable, Optional
+
+_HANDLER = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+    ctypes.c_size_t, ctypes.c_void_p
+)
+
+_lib = None
+
+
+def _build_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "cpp", "build")
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = os.path.join(_build_dir(), "libbrpc_tpu_c.so")
+    if not os.path.exists(so):
+        build = _build_dir()
+        os.makedirs(build, exist_ok=True)
+        subprocess.run(["cmake", "-G", "Ninja",
+                        "-DCMAKE_BUILD_TYPE=Release", ".."],
+                       cwd=build, check=True, capture_output=True)
+        subprocess.run(["ninja", "brpc_tpu_c"], cwd=build, check=True,
+                       capture_output=True)
+    lib = ctypes.CDLL(so)
+    lib.brt_server_new.restype = ctypes.c_void_p
+    lib.brt_server_add_service.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, _HANDLER, ctypes.c_void_p]
+    lib.brt_server_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.brt_server_port.argtypes = [ctypes.c_void_p]
+    lib.brt_server_stop.argtypes = [ctypes.c_void_p]
+    lib.brt_server_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_session_respond.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+        ctypes.c_char_p]
+    lib.brt_channel_new.restype = ctypes.c_void_p
+    lib.brt_channel_new.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+    lib.brt_channel_call.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_channel_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_free.argtypes = [ctypes.c_void_p]
+    lib.brt_init.argtypes = [ctypes.c_int]
+    lib.brt_init(0)
+    _lib = lib
+    return lib
+
+
+class RpcError(RuntimeError):
+    def __init__(self, code: int, text: str):
+        super().__init__(f"rpc failed ({code}): {text}")
+        self.code = code
+
+
+class Server:
+    """Native RPC server. Handlers: fn(method: str, request: bytes) -> bytes
+    (raise to fail the call)."""
+
+    def __init__(self):
+        self._lib = _load()
+        self._ptr = self._lib.brt_server_new()
+        self._handlers = []  # keep CFUNCTYPE refs alive
+
+    def add_service(self, name: str,
+                    handler: Callable[[str, bytes], bytes]) -> None:
+        lib = self._lib
+
+        @_HANDLER
+        def trampoline(user, method, req, req_len, session):
+            try:
+                data = ctypes.string_at(req, req_len) if req_len else b""
+                out = handler(method.decode(), data)
+                if out is None:
+                    out = b""
+                lib.brt_session_respond(session, out, len(out), 0, None)
+            except Exception as e:  # noqa: BLE001
+                lib.brt_session_respond(session, None, 0, 2001,
+                                        str(e).encode())
+
+        rc = lib.brt_server_add_service(self._ptr, name.encode(),
+                                        trampoline, None)
+        if rc != 0:
+            raise RuntimeError(f"add_service failed: {rc}")
+        self._handlers.append(trampoline)
+
+    def start(self, addr: str = "127.0.0.1:0") -> int:
+        rc = self._lib.brt_server_start(self._ptr, addr.encode())
+        if rc != 0:
+            raise RuntimeError(f"server start failed: {rc}")
+        return self._lib.brt_server_port(self._ptr)
+
+    @property
+    def port(self) -> int:
+        return self._lib.brt_server_port(self._ptr)
+
+    def stop(self) -> None:
+        if self._ptr:
+            self._lib.brt_server_stop(self._ptr)
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.brt_server_destroy(self._ptr)
+            self._ptr = None
+
+
+class Channel:
+    """Client channel. addr: "ip:port" single-server, or a cluster url
+    ("list://h1,h2", "file://path", "dns://host:port") + lb name."""
+
+    def __init__(self, addr: str, lb: Optional[str] = None,
+                 timeout_ms: int = 1000, max_retry: int = 3):
+        self._lib = _load()
+        self._ptr = self._lib.brt_channel_new(
+            addr.encode(), lb.encode() if lb else None, timeout_ms,
+            max_retry)
+        if not self._ptr:
+            raise RuntimeError(f"channel init failed for {addr}")
+
+    def call(self, service: str, method: str, request: bytes = b"") -> bytes:
+        rsp = ctypes.c_void_p()
+        rsp_len = ctypes.c_size_t()
+        errbuf = ctypes.create_string_buffer(256)
+        rc = self._lib.brt_channel_call(
+            self._ptr, service.encode(), method.encode(), request,
+            len(request), ctypes.byref(rsp), ctypes.byref(rsp_len), errbuf,
+            256)
+        if rc != 0:
+            raise RpcError(rc, errbuf.value.decode(errors="replace"))
+        try:
+            return ctypes.string_at(rsp, rsp_len.value)
+        finally:
+            self._lib.brt_free(rsp)
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.brt_channel_destroy(self._ptr)
+            self._ptr = None

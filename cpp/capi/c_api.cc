@@ -1,0 +1,156 @@
+#include "capi/c_api.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <string>
+
+#include "cluster/cluster_channel.h"
+#include "fiber/fiber.h"
+#include "rpc/channel.h"
+#include "rpc/server.h"
+
+namespace {
+
+using namespace brt;
+
+struct CSession {
+  Controller* cntl;
+  IOBuf* response;
+  Closure done;
+};
+
+class CService : public Service {
+ public:
+  CService(brt_service_handler h, void* user) : handler_(h), user_(user) {}
+
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    auto* sess = new CSession{cntl, response, std::move(done)};
+    const std::string req = request.to_string();
+    handler_(user_, method.c_str(), req.data(), req.size(), sess);
+  }
+
+ private:
+  brt_service_handler handler_;
+  void* user_;
+};
+
+struct CServer {
+  Server server;
+  std::vector<std::unique_ptr<CService>> services;
+};
+
+struct CChannel {
+  std::unique_ptr<ChannelBase> channel;
+};
+
+}  // namespace
+
+extern "C" {
+
+void brt_init(int fiber_workers) { brt::fiber_init(fiber_workers); }
+
+void* brt_server_new(void) { return new CServer; }
+
+int brt_server_add_service(void* server, const char* name,
+                           brt_service_handler handler, void* user) {
+  auto* s = static_cast<CServer*>(server);
+  auto svc = std::make_unique<CService>(handler, user);
+  int rc = s->server.AddService(svc.get(), name);
+  if (rc == 0) s->services.push_back(std::move(svc));
+  return rc;
+}
+
+int brt_server_start(void* server, const char* addr) {
+  return static_cast<CServer*>(server)->server.Start(std::string(addr));
+}
+
+int brt_server_port(void* server) {
+  return static_cast<CServer*>(server)->server.listen_address().port;
+}
+
+void brt_server_stop(void* server) {
+  auto* s = static_cast<CServer*>(server);
+  s->server.Stop();
+  s->server.Join();
+}
+
+void brt_server_destroy(void* server) {
+  auto* s = static_cast<CServer*>(server);
+  s->server.Stop();
+  s->server.Join();
+  delete s;
+}
+
+void brt_session_respond(void* session, const void* data, size_t len,
+                         int error_code, const char* error_text) {
+  auto* sess = static_cast<CSession*>(session);
+  if (error_code != 0) {
+    sess->cntl->SetFailed(error_code, "%s",
+                          error_text ? error_text : "handler error");
+  } else if (data != nullptr && len > 0) {
+    sess->response->append(data, len);
+  }
+  Closure done = std::move(sess->done);
+  delete sess;
+  done();
+}
+
+void* brt_channel_new(const char* addr, const char* lb, int64_t timeout_ms,
+                      int max_retry) {
+  brt::fiber_init(0);
+  auto* c = new CChannel;
+  ChannelOptions opts;
+  opts.timeout_ms = timeout_ms;
+  opts.max_retry = max_retry;
+  const std::string a = addr;
+  if (a.find("://") != std::string::npos) {
+    auto cc = std::make_unique<ClusterChannel>();
+    if (cc->Init(a, lb ? lb : "rr", &opts) != 0) {
+      delete c;
+      return nullptr;
+    }
+    c->channel = std::move(cc);
+  } else {
+    auto ch = std::make_unique<Channel>();
+    if (ch->Init(a, &opts) != 0) {
+      delete c;
+      return nullptr;
+    }
+    c->channel = std::move(ch);
+  }
+  return c;
+}
+
+int brt_channel_call(void* channel, const char* service, const char* method,
+                     const void* req, size_t req_len, void** rsp,
+                     size_t* rsp_len, char* errbuf, size_t errbuf_len) {
+  auto* c = static_cast<CChannel*>(channel);
+  Controller cntl;
+  IOBuf request, response;
+  if (req && req_len) request.append(req, req_len);
+  c->channel->CallMethod(service, method, &cntl, request, &response,
+                         nullptr);
+  if (cntl.Failed()) {
+    if (errbuf && errbuf_len) {
+      snprintf(errbuf, errbuf_len, "%s", cntl.ErrorText().c_str());
+    }
+    return cntl.ErrorCode() ? cntl.ErrorCode() : -1;
+  }
+  const size_t n = response.size();
+  void* buf = malloc(n ? n : 1);
+  response.copy_to(buf, n);
+  *rsp = buf;
+  *rsp_len = n;
+  return 0;
+}
+
+void brt_channel_destroy(void* channel) {
+  delete static_cast<CChannel*>(channel);
+}
+
+void brt_free(void* p) { free(p); }
+
+}  // extern "C"

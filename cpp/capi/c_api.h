@@ -1,0 +1,56 @@
+// C ABI over the native RPC core for language bindings (Python ctypes —
+// brpc_tpu/rpc.py). The reference exposes C++ directly; a flat C surface is
+// the TPU build's equivalent of its "thin binding layer" (SURVEY.md intro).
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---- server ----
+
+// Handler runs in a fiber. Respond exactly once per session via
+// brt_session_respond (may happen after the handler returns — async
+// services are first-class, mirroring rpc/server.h Closure semantics).
+typedef void (*brt_service_handler)(void* user, const char* method,
+                                    const void* req, size_t req_len,
+                                    void* session);
+
+void* brt_server_new(void);
+int brt_server_add_service(void* server, const char* name,
+                           brt_service_handler handler, void* user);
+// addr: "ip:port" (port 0 = ephemeral). Returns 0 on success.
+int brt_server_start(void* server, const char* addr);
+int brt_server_port(void* server);
+void brt_server_stop(void* server);
+void brt_server_destroy(void* server);
+
+void brt_session_respond(void* session, const void* data, size_t len,
+                         int error_code, const char* error_text);
+
+// ---- client ----
+
+// Single-server channel: addr "ip:port". Cluster channel: addr
+// "list://...|file://...|dns://..." with lb ("rr","la",...). lb may be
+// NULL for single-server.
+void* brt_channel_new(const char* addr, const char* lb, int64_t timeout_ms,
+                      int max_retry);
+// Synchronous call. On success returns 0 and *rsp/*rsp_len hold a
+// malloc'd buffer (free with brt_free). On failure returns the error code
+// and fills errbuf.
+int brt_channel_call(void* channel, const char* service, const char* method,
+                     const void* req, size_t req_len, void** rsp,
+                     size_t* rsp_len, char* errbuf, size_t errbuf_len);
+void brt_channel_destroy(void* channel);
+
+void brt_free(void* p);
+
+// ---- runtime ----
+void brt_init(int fiber_workers);
+
+#ifdef __cplusplus
+}
+#endif
