@@ -1,0 +1,31 @@
+// Echo client (reference example/echo_c++/client.cpp shape).
+//   echo_client [ip:port] [message]
+#include <cstdio>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "rpc/channel.h"
+
+using namespace brt;
+
+int main(int argc, char** argv) {
+  const std::string addr = argc > 1 ? argv[1] : "127.0.0.1:8000";
+  const std::string msg = argc > 2 ? argv[2] : "hello brpc-tpu";
+  fiber_init(0);
+  Channel ch;
+  if (ch.Init(addr) != 0) {
+    fprintf(stderr, "bad address %s\n", addr.c_str());
+    return 1;
+  }
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append(msg);
+  ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  if (cntl.Failed()) {
+    fprintf(stderr, "rpc failed: %s\n", cntl.ErrorText().c_str());
+    return 1;
+  }
+  printf("%s (latency=%ldus)\n", rsp.to_string().c_str(),
+         long(cntl.latency_us()));
+  return 0;
+}

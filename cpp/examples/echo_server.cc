@@ -1,0 +1,38 @@
+// Echo server (reference example/echo_c++/server.cpp shape): serves Echo
+// over brt_std + HTTP on one port; builtin pages live at /status etc.
+//   echo_server [port]
+#include <cstdio>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+class EchoService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    response->append(request);
+    cntl->response_attachment() = cntl->request_attachment();
+    done();
+  }
+};
+
+int main(int argc, char** argv) {
+  const int port = argc > 1 ? atoi(argv[1]) : 8000;
+  fiber_init(0);
+  Server server;
+  EchoService echo;
+  server.AddService(&echo, "Echo");
+  Server::Options opts;
+  opts.concurrency_limiter = "auto";
+  if (server.Start("0.0.0.0:" + std::to_string(port), &opts) != 0) {
+    fprintf(stderr, "start failed\n");
+    return 1;
+  }
+  printf("echo_server on %s (try /status over HTTP)\n",
+         server.listen_address().to_string().c_str());
+  for (;;) fiber_usleep(1000000);
+}

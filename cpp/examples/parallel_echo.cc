@@ -1,0 +1,33 @@
+// ParallelChannel fan-out example (reference example/parallel_echo_c++):
+// one call broadcast to N servers, replies concatenated in channel order.
+//   parallel_echo ip:port ip:port ...
+#include <cstdio>
+#include <vector>
+
+#include "cluster/parallel_channel.h"
+#include "fiber/fiber.h"
+
+using namespace brt;
+
+int main(int argc, char** argv) {
+  fiber_init(0);
+  std::vector<Channel> subs(argc > 1 ? argc - 1 : 0);
+  ParallelChannel pc;
+  for (int i = 1; i < argc; ++i) {
+    if (subs[i - 1].Init(std::string(argv[i])) != 0) {
+      fprintf(stderr, "bad address %s\n", argv[i]);
+      return 1;
+    }
+    pc.AddChannel(&subs[i - 1]);
+  }
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("fanout");
+  pc.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  if (cntl.Failed()) {
+    fprintf(stderr, "failed: %s\n", cntl.ErrorText().c_str());
+    return 1;
+  }
+  printf("merged %zu bytes from %d servers\n", rsp.size(), pc.channel_count());
+  return 0;
+}

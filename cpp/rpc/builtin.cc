@@ -5,6 +5,7 @@
 #include "base/flags.h"
 #include "base/time.h"
 #include "rpc/server.h"
+#include "rpc/span.h"
 #include "transport/socket.h"
 #include "var/variable.h"
 
@@ -124,6 +125,11 @@ bool HandleBuiltinPage(Server* server, const std::string& method,
   }
   if (path == "/connections") {
     ConnectionsPage(os);
+    out->body = os.str();
+    return true;
+  }
+  if (path == "/rpcz") {
+    SpanDump(os, 200, query);
     out->body = os.str();
     return true;
   }

@@ -1,7 +1,9 @@
 #include "rpc/channel.h"
 
 #include "base/time.h"
+#include "rpc/compress.h"
 #include "rpc/protocol_brt.h"
+#include "rpc/span.h"
 
 namespace brt {
 
@@ -56,6 +58,20 @@ void Channel::CallMethod(const std::string& service, const std::string& method,
   c.remaining_retries = max_retry;
   c.abs_deadline_us = timeout_ms < 0 ? -1 : c.start_us + timeout_ms * 1000;
 
+  if (cntl->trace_id != 0 || SpanShouldSample()) {
+    auto* sp = new Span;
+    sp->trace_id = cntl->trace_id ? cntl->trace_id : SpanRandomId();
+    sp->span_id = SpanRandomId();
+    sp->parent_span_id = cntl->span_id;  // the caller's span, if any
+    sp->service = service;
+    sp->method = method;
+    sp->start_us = c.start_us;
+    sp->start_real_us = realtime_us();
+    sp->annotate("call started");
+    cntl->trace_id = sp->trace_id;
+    cntl->span_id = sp->span_id;
+    c.span = sp;
+  }
   c.request_meta.type = MetaType::REQUEST;
   c.request_meta.correlation_id = cid;
   c.request_meta.service = service;
@@ -67,6 +83,15 @@ void Channel::CallMethod(const std::string& service, const std::string& method,
   c.request_meta.stream_id = cntl->pending_stream_id;
   c.request_body = request;  // shares blocks — no copy
   c.request_body.append(cntl->request_attachment());
+  if (cntl->request_compress_type != 0) {
+    const CompressHandler* h =
+        GetCompressHandler(cntl->request_compress_type);
+    IOBuf packed;
+    if (h != nullptr && h->compress(c.request_body, &packed)) {
+      c.request_body = std::move(packed);
+      c.request_meta.compress_type = cntl->request_compress_type;
+    }
+  }
 
   void* data = nullptr;
   if (fid_lock(cid, &data) != 0) {

@@ -1,0 +1,71 @@
+#include "rpc/compress.h"
+
+#include <zlib.h>
+
+#include <mutex>
+#include <string>
+
+namespace brt {
+
+namespace {
+
+CompressHandler g_handlers[256];
+bool g_registered[256];
+
+bool ZlibCompress(const IOBuf& in, IOBuf* out) {
+  const std::string src = in.to_string();  // zlib wants contiguous
+  uLong bound = compressBound(src.size());
+  std::string dst(bound, '\0');
+  uLongf dlen = bound;
+  if (compress2(reinterpret_cast<Bytef*>(dst.data()), &dlen,
+                reinterpret_cast<const Bytef*>(src.data()), src.size(),
+                Z_DEFAULT_COMPRESSION) != Z_OK) {
+    return false;
+  }
+  // 8-byte original-size prefix so decompression can size its buffer.
+  uint64_t orig = src.size();
+  out->append(&orig, sizeof(orig));
+  out->append(dst.data(), dlen);
+  return true;
+}
+
+bool ZlibDecompress(const IOBuf& in, IOBuf* out) {
+  if (in.size() < sizeof(uint64_t)) return false;
+  IOBuf tmp = in;
+  uint64_t orig = 0;
+  tmp.cutn(&orig, sizeof(orig));
+  if (orig > (1ull << 32)) return false;  // sanity
+  const std::string src = tmp.to_string();
+  std::string dst(orig, '\0');
+  uLongf dlen = orig;
+  if (uncompress(reinterpret_cast<Bytef*>(dst.data()), &dlen,
+                 reinterpret_cast<const Bytef*>(src.data()),
+                 src.size()) != Z_OK ||
+      dlen != orig) {
+    return false;
+  }
+  out->append(dst.data(), dlen);
+  return true;
+}
+
+}  // namespace
+
+void RegisterCompressHandler(uint8_t type, CompressHandler handler) {
+  g_handlers[type] = handler;
+  g_registered[type] = true;
+}
+
+const CompressHandler* GetCompressHandler(uint8_t type) {
+  RegisterBuiltinCompress();
+  return g_registered[type] ? &g_handlers[type] : nullptr;
+}
+
+void RegisterBuiltinCompress() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    RegisterCompressHandler(COMPRESS_ZLIB,
+                            CompressHandler{ZlibCompress, ZlibDecompress});
+  });
+}
+
+}  // namespace brt

@@ -1,0 +1,33 @@
+// Pluggable compression registry for RPC payloads.
+// Parity target: reference src/brpc/compress.h:28,43 (CompressHandler
+// registry; gzip/zlib via policy/gzip_compress.cpp, snappy via
+// policy/snappy_compress.cpp, registered global.cpp:389-399). Here: zlib
+// ("gzip"-class) built in; others register at startup. The wire carries
+// RpcMeta.compress_type over the body (payload + attachment compressed as
+// one unit on the sender, split after decompression on the receiver).
+#pragma once
+
+#include <cstdint>
+
+#include "base/iobuf.h"
+
+namespace brt {
+
+enum CompressType : uint8_t {
+  COMPRESS_NONE = 0,
+  COMPRESS_ZLIB = 1,
+};
+
+struct CompressHandler {
+  bool (*compress)(const IOBuf& in, IOBuf* out);
+  bool (*decompress)(const IOBuf& in, IOBuf* out);
+};
+
+// type 1..255. Startup-time registration.
+void RegisterCompressHandler(uint8_t type, CompressHandler handler);
+const CompressHandler* GetCompressHandler(uint8_t type);
+
+// Registers the builtin zlib handler (idempotent).
+void RegisterBuiltinCompress();
+
+}  // namespace brt

@@ -1,0 +1,17 @@
+#include "rpc/concurrency_limiter.h"
+
+namespace brt {
+
+std::unique_ptr<ConcurrencyLimiter> CreateConcurrencyLimiter(
+    const std::string& name, int max_concurrency) {
+  if (name == "auto") {
+    return std::make_unique<AutoLimiter>();
+  }
+  if (name == "constant" || name.empty()) {
+    if (max_concurrency <= 0) return nullptr;  // unlimited
+    return std::make_unique<ConstantLimiter>(max_concurrency);
+  }
+  return nullptr;
+}
+
+}  // namespace brt

@@ -1,0 +1,117 @@
+// Server-side concurrency limiters.
+// Parity target: reference src/brpc/concurrency_limiter.h:29 + policy
+// implementations registered in global.cpp:612-614: "constant"
+// (max_concurrency), "auto" (gradient/Vegas-style adaptive,
+// policy/auto_concurrency_limiter.cpp, doc docs/cn/auto_concurrency_limiter.md),
+// "timeout" (reject when queueing exceeds the deadline budget).
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "base/time.h"
+
+namespace brt {
+
+class ConcurrencyLimiter {
+ public:
+  virtual ~ConcurrencyLimiter() = default;
+  // true → admit (caller increments its concurrency counter around the
+  // request); false → reject with ELIMIT.
+  virtual bool OnRequested(int current_concurrency) = 0;
+  virtual void OnResponded(int error_code, int64_t latency_us) {}
+  virtual int max_concurrency() const = 0;
+};
+
+class ConstantLimiter : public ConcurrencyLimiter {
+ public:
+  explicit ConstantLimiter(int max) : max_(max) {}
+  bool OnRequested(int c) override { return max_ <= 0 || c <= max_; }
+  int max_concurrency() const override { return max_; }
+
+ private:
+  int max_;
+};
+
+// Vegas/gradient-style: track the no-load latency floor and recent peak
+// qps; the sustainable concurrency is peak_qps × min_latency (Little's
+// law) with headroom alpha; periodically decay the floor so the limiter
+// re-probes (reference auto_concurrency_limiter.cpp:267 structure).
+class AutoLimiter : public ConcurrencyLimiter {
+ public:
+  struct Options {
+    double alpha = 0.3;          // headroom over Little's-law estimate
+    int min_limit = 8;           // never throttle below this
+    int64_t window_us = 500000;  // sampling window
+  };
+
+  AutoLimiter() : AutoLimiter(Options{}) {}
+  explicit AutoLimiter(const Options& opt) : opt_(opt), limit_(100) {}
+
+  bool OnRequested(int c) override {
+    return c <= limit_.load(std::memory_order_relaxed);
+  }
+
+  void OnResponded(int error_code, int64_t latency_us) override {
+    if (error_code != 0) return;
+    const int64_t now = monotonic_us();
+    count_.fetch_add(1, std::memory_order_relaxed);
+    lat_sum_.fetch_add(latency_us, std::memory_order_relaxed);
+    // latency floor: EMA toward the smallest observations
+    int64_t floor = min_latency_us_.load(std::memory_order_relaxed);
+    if (floor == 0 || latency_us < floor) {
+      min_latency_us_.store(
+          floor == 0 ? latency_us : (floor * 7 + latency_us) / 8,
+          std::memory_order_relaxed);
+    }
+    int64_t start = window_start_us_.load(std::memory_order_relaxed);
+    if (now - start >= opt_.window_us &&
+        window_start_us_.compare_exchange_strong(
+            start, now, std::memory_order_acq_rel)) {
+      Recompute(now - start);
+    }
+  }
+
+  int max_concurrency() const override {
+    return limit_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  void Recompute(int64_t elapsed_us) {
+    const int64_t n = count_.exchange(0, std::memory_order_relaxed);
+    const int64_t lat_sum = lat_sum_.exchange(0, std::memory_order_relaxed);
+    if (n == 0 || elapsed_us <= 0) return;
+    const double qps = double(n) * 1e6 / double(elapsed_us);
+    peak_qps_ = std::max(peak_qps_ * 0.98, qps);  // decaying peak
+    const double avg_lat = double(lat_sum) / double(n);
+    int64_t floor = min_latency_us_.load(std::memory_order_relaxed);
+    if (floor <= 0) floor = int64_t(avg_lat);
+    // Little's law with headroom; congestion (avg >> floor) shrinks.
+    double est = peak_qps_ * double(floor) / 1e6 * (1.0 + opt_.alpha);
+    if (avg_lat > double(floor) * (1.0 + 2 * opt_.alpha)) {
+      est *= 0.9;  // gradient down under queueing
+    }
+    limit_.store(std::max<int>(opt_.min_limit, int(est)),
+                 std::memory_order_relaxed);
+    // slow floor decay: lets the estimate track service-time changes
+    min_latency_us_.store(floor + std::max<int64_t>(floor / 64, 1),
+                          std::memory_order_relaxed);
+  }
+
+  Options opt_;
+  std::atomic<int> limit_;
+  std::atomic<int64_t> count_{0}, lat_sum_{0};
+  std::atomic<int64_t> min_latency_us_{0};
+  std::atomic<int64_t> window_start_us_{0};
+  double peak_qps_ = 0;  // only touched under the CAS winner
+};
+
+// Factory: "constant" (uses max_concurrency), "auto", "" → nullptr
+// (unlimited).
+std::unique_ptr<ConcurrencyLimiter> CreateConcurrencyLimiter(
+    const std::string& name, int max_concurrency);
+
+}  // namespace brt

@@ -5,6 +5,7 @@
 #include <cstring>
 
 #include "base/time.h"
+#include "rpc/compress.h"
 #include "rpc/socket_map.h"
 
 namespace brt {
@@ -108,6 +109,10 @@ int Controller::HandleError(fid_t id, void* data, int error_code) {
     while (c.remaining_retries > 0) {
       --c.remaining_retries;
       ++cntl->retried_;
+      if (c.span) {
+        c.span->annotate(std::string("retrying: ") +
+                         RpcErrorText(error_code));
+      }
       if (c.issuer->IssueRPC(cntl) == 0) {
         fid_unlock(id);
         return 0;
@@ -156,6 +161,17 @@ void Controller::OnResponse(RpcMeta&& meta, IOBuf&& body) {
     stream_socket = c.last_socket;
     if (g_stream_connect_hook) g_stream_connect_hook(this);
   }
+  if (meta.compress_type != 0) {
+    const CompressHandler* h = GetCompressHandler(meta.compress_type);
+    IOBuf plain;
+    if (h == nullptr || !h->decompress(body, &plain)) {
+      error_code_ = ERESPONSE;
+      error_text_ = "cannot decompress response";
+      EndRPC();
+      return;
+    }
+    body = std::move(plain);
+  }
   const size_t att = meta.attachment_size;
   const size_t payload = body.size() - att;
   if (c.response) body.cutn(c.response, payload);
@@ -168,6 +184,14 @@ void Controller::EndRPC() {
   Call& c = call;
   set_latency(monotonic_us() - c.start_us);
   if (c.on_end) c.on_end(this, c.on_end_arg);
+  if (c.span != nullptr) {
+    c.span->remote = remote_side_;
+    c.span->end_us = monotonic_us();
+    c.span->error_code = error_code_;
+    SpanSubmit(std::move(*c.span));
+    delete c.span;
+    c.span = nullptr;
+  }
   const fid_t id = cid_;
   Closure done;
   done.swap(c.done);

@@ -17,6 +17,7 @@
 #include "fiber/timer.h"
 #include "rpc/brt_meta.h"
 #include "rpc/errors.h"
+#include "rpc/span.h"
 #include "transport/socket.h"
 
 namespace brt {
@@ -81,6 +82,12 @@ class Controller {
   // Controller::set_request_code).
   uint64_t request_code = 0;
 
+  // Compression (rpc/compress.h): client sets request_compress_type before
+  // the call; servers answer with response_compress_type (defaults to the
+  // request's — reference Controller::set_request_compress_type).
+  uint8_t request_compress_type = 0;
+  uint8_t response_compress_type = 0;
+
   // ---- streaming (rpc/stream.h; reference stream.cpp rides stream
   // settings on the RPC meta) ----
   uint64_t pending_stream_id = 0;   // client: set by StreamCreate
@@ -117,6 +124,7 @@ class Controller {
     void (*on_end)(Controller*, void*) = nullptr;
     void* on_end_arg = nullptr;
     bool attempt_pending = false;  // a selected attempt awaits feedback
+    Span* span = nullptr;          // rpcz client span (sampled)
     // Sub-call bookkeeping for combo channels (parallel_channel.cpp:46).
     void* parent_done = nullptr;
     int sub_index = -1;

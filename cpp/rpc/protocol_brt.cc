@@ -12,8 +12,11 @@
 #include "base/flags.h"
 #include "base/logging.h"
 #include "base/time.h"
+#include "rpc/compress.h"
 #include "rpc/controller.h"
+#include "rpc/rpc_dump.h"
 #include "rpc/server.h"
+#include "rpc/span.h"
 #include "transport/input_messenger.h"
 
 namespace brt {
@@ -53,10 +56,19 @@ struct RpcSession {
   Server* server = nullptr;
   MethodStatus* mstatus = nullptr;
   int64_t start_us = 0;
+  Span* span = nullptr;  // rpcz (sampled or trace-propagated)
 };
 
 void SendResponse(RpcSession* sess) {
   const int64_t lat = monotonic_us() - sess->start_us;
+  if (sess->span != nullptr) {
+    sess->span->annotate("sending response");
+    sess->span->end_us = monotonic_us();
+    sess->span->error_code = sess->cntl.ErrorCode();
+    SpanSubmit(std::move(*sess->span));
+    delete sess->span;
+    sess->span = nullptr;
+  }
   RpcMeta meta;
   meta.type = MetaType::RESPONSE;
   meta.correlation_id = sess->cid;
@@ -67,6 +79,15 @@ void SendResponse(RpcSession* sess) {
   IOBuf body;
   body.append(std::move(sess->response));
   body.append(std::move(sess->cntl.response_attachment()));
+  if (sess->cntl.response_compress_type != 0 && meta.error_code == 0) {
+    const CompressHandler* h =
+        GetCompressHandler(sess->cntl.response_compress_type);
+    IOBuf packed;
+    if (h != nullptr && h->compress(body, &packed)) {
+      body = std::move(packed);
+      meta.compress_type = sess->cntl.response_compress_type;
+    }
+  }
   IOBuf frame;
   PackFrame(&frame, meta, std::move(body));
   SocketUniquePtr ptr;
@@ -74,6 +95,7 @@ void SendResponse(RpcSession* sess) {
   if (sess->mstatus) sess->mstatus->OnResponded(meta.error_code, lat);
   if (sess->server) {
     sess->server->OnRequestDone();
+    sess->server->OnResponseSent(meta.error_code, lat);
     sess->server->requests_processed.fetch_add(1, std::memory_order_relaxed);
   }
   delete sess;
@@ -127,6 +149,42 @@ void ProcessRequest(RpcMeta&& meta, IOBuf&& body, SocketId sock,
   sess->cntl.parent_span_id = meta.span_id;
   sess->cntl.peer_stream_id = meta.stream_id;  // client wants a stream
   sess->cntl.stream_socket = sock;
+  if (meta.trace_id != 0 || SpanShouldSample()) {
+    // reference span.cpp: the server span is a child of the client's span;
+    // ids ride the protocol meta (SURVEY §5.1)
+    auto* sp = new Span;
+    sp->trace_id = meta.trace_id ? meta.trace_id : SpanRandomId();
+    sp->span_id = SpanRandomId();
+    sp->parent_span_id = meta.span_id;
+    sp->server_side = true;
+    sp->service = meta.service;
+    sp->method = meta.method;
+    sp->remote = s->remote();
+    sp->start_us = sess->start_us;
+    sp->start_real_us = realtime_us();
+    sp->annotate("request received");
+    sess->span = sp;
+    sess->cntl.trace_id = sp->trace_id;
+    sess->cntl.span_id = sp->span_id;
+  }
+  if (meta.compress_type != 0) {
+    const CompressHandler* h = GetCompressHandler(meta.compress_type);
+    IOBuf plain;
+    if (h == nullptr || !h->decompress(body, &plain)) {
+      server->OnRequestDone();
+      ms->OnResponded(EREQUEST, 0);
+      delete sess;
+      SendErrorResponse(sock, meta.correlation_id, EREQUEST,
+                        "cannot decompress request");
+      return;
+    }
+    body = std::move(plain);
+    sess->cntl.request_compress_type = meta.compress_type;
+    sess->cntl.response_compress_type = meta.compress_type;
+  }
+  if (RpcDumpWanted()) {
+    RpcDumpRecord(meta, body);  // decompressed body, pre-split
+  }
   // Split payload / attachment.
   const size_t att = meta.attachment_size;
   const size_t payload = body.size() - att;

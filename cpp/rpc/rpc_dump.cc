@@ -1,0 +1,92 @@
+#include "rpc/rpc_dump.h"
+
+#include <cstdio>
+#include <mutex>
+#include <string>
+
+#include "base/flags.h"
+
+namespace brt {
+
+uint32_t FLAGS_rpc_dump_ppm = 0;
+
+namespace {
+
+std::mutex g_mu;
+std::string g_path;
+FILE* g_file = nullptr;
+
+inline uint64_t rng64() {
+  static thread_local uint64_t s =
+      0xda3e39cb94b95bdbULL ^ (uint64_t(uintptr_t(&s)) << 1);
+  s ^= s >> 12;
+  s ^= s << 25;
+  s ^= s >> 27;
+  return s * 0x2545F4914F6CDD1DULL;
+}
+
+}  // namespace
+
+void SetRpcDumpFile(const std::string& path) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (g_file) {
+    fclose(g_file);
+    g_file = nullptr;
+  }
+  g_path = path;
+  if (!path.empty()) g_file = fopen(path.c_str(), "ab");
+}
+
+bool RpcDumpWanted() {
+  const uint32_t ppm = FLAGS_rpc_dump_ppm;
+  if (ppm == 0) return false;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    if (g_file == nullptr) return false;
+  }
+  return rng64() % 1000000 < ppm;
+}
+
+void RpcDumpRecord(const RpcMeta& meta, const IOBuf& body) {
+  std::string mbuf;
+  EncodeMeta(meta, &mbuf);
+  const std::string payload = body.to_string();
+  char hdr[12] = {'B', 'R', 'T', 'D'};
+  uint32_t mlen = mbuf.size(), blen = payload.size();
+  memcpy(hdr + 4, &mlen, 4);
+  memcpy(hdr + 8, &blen, 4);
+  std::lock_guard<std::mutex> g(g_mu);
+  if (!g_file) return;
+  fwrite(hdr, 1, sizeof(hdr), g_file);
+  fwrite(mbuf.data(), 1, mbuf.size(), g_file);
+  fwrite(payload.data(), 1, payload.size(), g_file);
+  fflush(g_file);
+}
+
+bool RpcDumpReadRecord(void* file, RpcMeta* meta, IOBuf* body) {
+  FILE* f = static_cast<FILE*>(file);
+  char hdr[12];
+  if (fread(hdr, 1, sizeof(hdr), f) != sizeof(hdr)) return false;
+  if (memcmp(hdr, "BRTD", 4) != 0) return false;
+  uint32_t mlen, blen;
+  memcpy(&mlen, hdr + 4, 4);
+  memcpy(&blen, hdr + 8, 4);
+  if (mlen > 64 * 1024 || blen > (256u << 20)) return false;
+  std::string mbuf(mlen, '\0');
+  if (fread(mbuf.data(), 1, mlen, f) != mlen) return false;
+  if (!DecodeMeta(mbuf.data(), mlen, meta)) return false;
+  std::string payload(blen, '\0');
+  if (fread(payload.data(), 1, blen, f) != blen) return false;
+  body->append(payload.data(), blen);
+  return true;
+}
+
+void RegisterRpcDumpFlags() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    RegisterFlag("rpc_dump_ppm", &FLAGS_rpc_dump_ppm,
+                 "requests per million captured to the rpc_dump file");
+  });
+}
+
+}  // namespace brt

@@ -1,0 +1,31 @@
+// rpc_dump: sampled capture of live request traffic to a record file,
+// replayable by tools/rpc_replay.
+// Parity target: reference src/brpc/rpc_dump.cpp:48-58 (AskToBeSampled +
+// recordio files, SURVEY §5.5) — flags here: rpc_dump_ppm (sampling rate),
+// rpc_dump_file (target path).
+// Record format: "BRTD" u32 meta_len u32 body_len, meta (EncodeMeta of the
+// request meta, decompressed body), body.
+#pragma once
+
+#include <ostream>
+
+#include "rpc/brt_meta.h"
+
+namespace brt {
+
+extern uint32_t FLAGS_rpc_dump_ppm;
+
+// True ~ppm/1e6 of the time AND a dump file is configured.
+bool RpcDumpWanted();
+
+// Appends one sampled request (serialized under an internal mutex).
+void RpcDumpRecord(const RpcMeta& meta, const IOBuf& body);
+
+// Replay-side: reads the next record from `in` (C FILE*). Returns false on
+// EOF/corruption.
+bool RpcDumpReadRecord(void* file, RpcMeta* meta, IOBuf* body);
+
+void SetRpcDumpFile(const std::string& path);
+void RegisterRpcDumpFlags();
+
+}  // namespace brt

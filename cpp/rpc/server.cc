@@ -5,6 +5,8 @@
 #include "fiber/fiber.h"
 #include "rpc/http_protocol.h"
 #include "rpc/protocol_brt.h"
+#include "rpc/rpc_dump.h"
+#include "rpc/span.h"
 #include "transport/input_messenger.h"
 
 namespace brt {
@@ -30,9 +32,16 @@ int Server::Start(const std::string& addr, const Options* opts) {
 int Server::Start(const EndPoint& addr, const Options* opts) {
   if (running_.exchange(true)) return EPERM;
   if (opts) options_ = *opts;
+  limiter_ = CreateConcurrencyLimiter(options_.concurrency_limiter,
+                                      options_.max_concurrency);
   fiber_init(options_.fiber_workers);
   RegisterBrtProtocol();
   RegisterHttpProtocol();
+  RegisterSpanFlags();
+  RegisterRpcDumpFlags();
+  if (const char* dump = getenv("BRT_RPC_DUMP_FILE")) {
+    SetRpcDumpFile(dump);
+  }
   start_time_us = monotonic_us();
   acceptor_.conn_options.user = this;
   acceptor_.conn_options.on_edge_triggered = InputMessengerOnEdgeTriggered;

@@ -14,6 +14,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "rpc/concurrency_limiter.h"
 #include "rpc/controller.h"
 #include "transport/acceptor.h"
 #include "var/latency_recorder.h"
@@ -58,6 +59,9 @@ class Server {
   struct Options {
     int max_concurrency = 0;  // 0 = unlimited (reference server.h:129)
     int fiber_workers = 0;    // fiber_init hint
+    // "constant" (bounded by max_concurrency), "auto" (adaptive,
+    // reference policy/auto_concurrency_limiter.cpp), "" = unlimited.
+    std::string concurrency_limiter = "constant";
   };
 
   Server() = default;
@@ -85,7 +89,7 @@ class Server {
                                 const std::string& method);
   bool OnRequestArrived() {
     int c = concurrency_.fetch_add(1, std::memory_order_relaxed) + 1;
-    if (options_.max_concurrency > 0 && c > options_.max_concurrency) {
+    if (limiter_ && !limiter_->OnRequested(c)) {
       concurrency_.fetch_sub(1, std::memory_order_relaxed);
       return false;
     }
@@ -94,6 +98,11 @@ class Server {
   void OnRequestDone() {
     concurrency_.fetch_sub(1, std::memory_order_relaxed);
   }
+  // Feeds the adaptive limiter (call once per response).
+  void OnResponseSent(int error_code, int64_t latency_us) {
+    if (limiter_) limiter_->OnResponded(error_code, latency_us);
+  }
+  ConcurrencyLimiter* limiter() const { return limiter_.get(); }
   int current_concurrency() const {
     return concurrency_.load(std::memory_order_relaxed);
   }
@@ -123,6 +132,7 @@ class Server {
   std::unordered_map<std::string, std::unique_ptr<MethodStatus>> methods_;
   std::atomic<int> concurrency_{0};
   std::atomic<bool> running_{false};
+  std::unique_ptr<ConcurrencyLimiter> limiter_;
 };
 
 }  // namespace brt

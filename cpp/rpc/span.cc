@@ -1,0 +1,91 @@
+#include "rpc/span.h"
+
+#include <deque>
+#include <mutex>
+
+#include "base/flags.h"
+#include "base/time.h"
+
+namespace brt {
+
+uint32_t FLAGS_rpcz_sample_ppm = 0;        // off by default (like reference's
+                                           // rpcz disabled until enabled)
+uint32_t FLAGS_rpcz_max_spans = 1024;
+
+namespace {
+
+std::mutex g_mu;
+std::deque<Span>& store() {
+  static auto* d = new std::deque<Span>();
+  return *d;
+}
+
+inline uint64_t rng64() {
+  static thread_local uint64_t s =
+      0x853c49e6748fea9bULL ^ (uint64_t(uintptr_t(&s)) << 1);
+  s ^= s >> 12;
+  s ^= s << 25;
+  s ^= s >> 27;
+  return s * 0x2545F4914F6CDD1DULL;
+}
+
+}  // namespace
+
+void Span::annotate(const std::string& text) {
+  annotations.emplace_back(monotonic_us(), text);
+}
+
+bool SpanShouldSample() {
+  const uint32_t ppm = FLAGS_rpcz_sample_ppm;
+  if (ppm == 0) return false;
+  return rng64() % 1000000 < ppm;
+}
+
+uint64_t SpanRandomId() {
+  uint64_t v = rng64();
+  return v ? v : 1;
+}
+
+void SpanSubmit(Span&& span) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto& d = store();
+  d.push_back(std::move(span));
+  while (d.size() > FLAGS_rpcz_max_spans) d.pop_front();
+}
+
+void SpanDump(std::ostream& os, size_t max, const std::string& filter) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto& d = store();
+  size_t shown = 0;
+  for (auto it = d.rbegin(); it != d.rend() && shown < max; ++it) {
+    const Span& s = *it;
+    const std::string id = s.service + "." + s.method;
+    if (!filter.empty() && id.find(filter) == std::string::npos) continue;
+    ++shown;
+    os << (s.server_side ? "S " : "C ") << "trace=" << std::hex
+       << s.trace_id << " span=" << s.span_id;
+    if (s.parent_span_id) os << " parent=" << s.parent_span_id;
+    os << std::dec << " " << id << " peer=" << s.remote.to_string()
+       << " latency_us=" << (s.end_us - s.start_us)
+       << " error=" << s.error_code << "\n";
+    for (const auto& [ts, text] : s.annotations) {
+      os << "    +" << (ts - s.start_us) << "us " << text << "\n";
+    }
+  }
+  if (shown == 0) {
+    os << "(no spans; set /flags/rpcz_sample_ppm?setvalue=1000000 to trace "
+          "every request)\n";
+  }
+}
+
+void RegisterSpanFlags() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    RegisterFlag("rpcz_sample_ppm", &FLAGS_rpcz_sample_ppm,
+                 "requests per million that start a new rpcz trace");
+    RegisterFlag("rpcz_max_spans", &FLAGS_rpcz_max_spans,
+                 "bounded in-memory span store size");
+  });
+}
+
+}  // namespace brt

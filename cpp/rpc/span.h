@@ -1,0 +1,54 @@
+// rpcz spans: per-RPC trace records with timestamped annotations, kept in a
+// bounded in-memory store and browsed via the /rpcz builtin.
+// Parity target: reference src/brpc/span.h:47 + span.cpp (sampled via
+// bvar::Collector, persisted to LevelDB, propagated through protocol meta —
+// trace/span/parent ids ride RpcMeta here too). Redesigned: a lock-striped
+// ring of recent spans instead of an on-disk DB; sampling is rate-based
+// (FLAGS_rpcz_sample_ppm) with trace-id propagation forcing sampling on
+// downstream hops (docs/cn/rpcz.md behavior).
+#pragma once
+
+#include <cstdint>
+#include <ostream>
+#include <string>
+#include <vector>
+
+#include "base/endpoint.h"
+
+namespace brt {
+
+struct Span {
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
+  bool server_side = false;
+  std::string service, method;
+  EndPoint remote;
+  int64_t start_us = 0;   // monotonic
+  int64_t end_us = 0;
+  int64_t start_real_us = 0;  // wall clock at start (display)
+  int error_code = 0;
+  std::vector<std::pair<int64_t, std::string>> annotations;
+
+  void annotate(const std::string& text);
+};
+
+// 0 disables tracing; N → ~N per million unsampled requests start traces.
+// A request arriving WITH a trace id is always recorded (propagation).
+extern uint32_t FLAGS_rpcz_sample_ppm;
+extern uint32_t FLAGS_rpcz_max_spans;
+
+bool SpanShouldSample();
+uint64_t SpanRandomId();
+
+// Takes ownership; bounded store evicts oldest.
+void SpanSubmit(Span&& span);
+
+// Text dump of the most recent `max` spans (newest first) — /rpcz page.
+void SpanDump(std::ostream& os, size_t max = 100,
+              const std::string& filter = "");
+
+// Registers rpcz flags (idempotent).
+void RegisterSpanFlags();
+
+}  // namespace brt

@@ -130,6 +130,23 @@ int main() {
   assert(r.rfind("HTTP/1.1 404", 0) == 0);
   printf("http_404 OK\n");
 
+  // rpcz: enable full sampling, make a traced call, see both spans.
+  assert(SetFlag("rpcz_sample_ppm", "1000000") == 0);
+  {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("traced");
+    ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed());
+    assert(cntl.trace_id != 0);
+  }
+  SetFlag("rpcz_sample_ppm", "0");
+  r = HttpGet(addr, "GET /rpcz HTTP/1.1\r\n\r\n");
+  assert(r.find("Echo.Echo") != std::string::npos);
+  assert(r.find("C trace=") != std::string::npos);  // client span
+  assert(r.find("S trace=") != std::string::npos);  // server span (child)
+  printf("http_rpcz OK\n");
+
   server.Stop();
   server.Join();
   printf("ALL http tests OK\n");

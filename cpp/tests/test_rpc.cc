@@ -218,6 +218,21 @@ void test_pooled_and_short(const EndPoint& addr) {
 
 }  // namespace
 
+void test_compression(Channel& ch) {
+  std::string text(256 * 1024, 'z');  // highly compressible
+  for (size_t i = 0; i < text.size(); i += 97) text[i] = char('a' + i % 26);
+  Controller cntl;
+  cntl.request_compress_type = 1;  // COMPRESS_ZLIB
+  IOBuf req, rsp;
+  req.append(text);
+  cntl.request_attachment().append("att-data");
+  ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  assert(rsp.to_string() == text);
+  assert(cntl.response_attachment().to_string() == "att-data");
+  printf("compression OK (zlib, 256KB)\n");
+}
+
 int main() {
   fiber_init(4);
   test_meta_roundtrip();
@@ -237,6 +252,7 @@ int main() {
   test_timeout(ch);
   test_cancel(ch);
   test_big_payload(ch);
+  test_compression(ch);
   test_concurrent_calls(ch);
   test_pooled_and_short(addr);
   test_connect_fail_retry();

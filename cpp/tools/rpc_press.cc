@@ -1,0 +1,147 @@
+// rpc_press: load generator with qps control and latency percentiles.
+// Parity target: reference tools/rpc_press (pb-JSON-driven load generator
+// with qps control, rpc_press_impl.cpp). This one drives the brt_std
+// protocol with byte payloads.
+//
+//   rpc_press --server 127.0.0.1:8000 --service Echo --method Echo \
+//             --qps 10000 --connections 4 --depth 8 --payload 1024 \
+//             --seconds 10
+//
+// qps 0 = unthrottled. Prints one status line per second and a final JSON
+// summary.
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/channel.h"
+
+using namespace brt;
+
+namespace {
+
+struct Stats {
+  std::atomic<uint64_t> calls{0};
+  std::atomic<uint64_t> errors{0};
+  std::atomic<uint64_t> bytes{0};
+};
+
+struct WorkerArg {
+  Channel* channel;
+  std::string service, method, payload;
+  int64_t deadline_us;
+  double interval_us;  // per-worker pacing; 0 = unthrottled
+  Stats* stats;
+  std::vector<int64_t>* latencies;
+  CountdownEvent* done;
+};
+
+void* Worker(void* argp) {
+  auto* a = static_cast<WorkerArg*>(argp);
+  IOBuf request;
+  request.append(a->payload);
+  int64_t next_fire = monotonic_us();
+  int sample = 0;
+  while (monotonic_us() < a->deadline_us) {
+    if (a->interval_us > 0) {
+      const int64_t now = monotonic_us();
+      if (now < next_fire) fiber_usleep(next_fire - now);
+      next_fire += int64_t(a->interval_us);
+    }
+    Controller cntl;
+    cntl.timeout_ms = 5000;
+    IOBuf rsp;
+    a->channel->CallMethod(a->service, a->method, &cntl, request, &rsp,
+                           nullptr);
+    a->stats->calls.fetch_add(1, std::memory_order_relaxed);
+    if (cntl.Failed()) {
+      a->stats->errors.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      a->stats->bytes.fetch_add(rsp.size(), std::memory_order_relaxed);
+      if ((sample++ & 7) == 0) a->latencies->push_back(cntl.latency_us());
+    }
+  }
+  a->done->signal();
+  return nullptr;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string server = "127.0.0.1:8000", service = "Echo", method = "Echo";
+  int qps = 0, connections = 4, depth = 8, seconds = 10;
+  size_t payload = 1024;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    if (!strcmp(argv[i], "--server")) server = argv[i + 1];
+    else if (!strcmp(argv[i], "--service")) service = argv[i + 1];
+    else if (!strcmp(argv[i], "--method")) method = argv[i + 1];
+    else if (!strcmp(argv[i], "--qps")) qps = atoi(argv[i + 1]);
+    else if (!strcmp(argv[i], "--connections")) connections = atoi(argv[i + 1]);
+    else if (!strcmp(argv[i], "--depth")) depth = atoi(argv[i + 1]);
+    else if (!strcmp(argv[i], "--seconds")) seconds = atoi(argv[i + 1]);
+    else if (!strcmp(argv[i], "--payload")) payload = atoll(argv[i + 1]);
+  }
+  fiber_init(0);
+
+  std::vector<Channel> channels(connections);
+  for (int i = 0; i < connections; ++i) {
+    ChannelOptions opts;
+    opts.connection_group = i + 1;
+    opts.timeout_ms = 5000;
+    if (channels[i].Init(server, &opts) != 0) {
+      fprintf(stderr, "cannot reach %s\n", server.c_str());
+      return 1;
+    }
+  }
+
+  const int nworkers = connections * depth;
+  Stats stats;
+  CountdownEvent done(nworkers);
+  std::vector<std::vector<int64_t>> lat(nworkers);
+  std::vector<WorkerArg> args(nworkers);
+  const int64_t start = monotonic_us();
+  const int64_t deadline = start + int64_t(seconds) * 1000000;
+  for (int i = 0; i < nworkers; ++i) {
+    args[i] = WorkerArg{
+        &channels[i % connections], service, method,
+        std::string(payload, 'p'), deadline,
+        qps > 0 ? double(nworkers) * 1e6 / qps : 0.0, &stats, &lat[i],
+        &done};
+    fiber_t fid;
+    fiber_start(&fid, Worker, &args[i]);
+  }
+
+  uint64_t last_calls = 0;
+  for (int s = 0; s < seconds; ++s) {
+    fiber_usleep(1000000);
+    const uint64_t c = stats.calls.load();
+    printf("t=%ds qps=%llu errors=%llu\n", s + 1,
+           (unsigned long long)(c - last_calls),
+           (unsigned long long)stats.errors.load());
+    fflush(stdout);
+    last_calls = c;
+  }
+  done.wait(-1);
+  const double elapsed = double(monotonic_us() - start) / 1e6;
+
+  std::vector<int64_t> all;
+  for (auto& v : lat) all.insert(all.end(), v.begin(), v.end());
+  std::sort(all.begin(), all.end());
+  auto pct = [&](double p) -> long {
+    return all.empty() ? 0 : long(all[size_t(p * (all.size() - 1))]);
+  };
+  printf("{\"qps\": %.0f, \"calls\": %llu, \"errors\": %llu, "
+         "\"p50_us\": %ld, \"p90_us\": %ld, \"p99_us\": %ld, "
+         "\"p999_us\": %ld, \"rsp_gbps\": %.3f}\n",
+         double(stats.calls.load()) / elapsed,
+         (unsigned long long)stats.calls.load(),
+         (unsigned long long)stats.errors.load(), pct(0.5), pct(0.9),
+         pct(0.99), pct(0.999),
+         double(stats.bytes.load()) / elapsed / 1e9);
+  return 0;
+}

@@ -1,0 +1,59 @@
+// rpc_replay: re-sends traffic captured by rpc_dump against a target
+// server. Parity target: reference tools/rpc_replay (replays rpc_dump
+// recordio files).
+//
+//   rpc_replay --file dump.brtd --server 127.0.0.1:8000 [--times 1]
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "rpc/channel.h"
+#include "rpc/rpc_dump.h"
+
+using namespace brt;
+
+int main(int argc, char** argv) {
+  std::string file, server = "127.0.0.1:8000";
+  int times = 1;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    if (!strcmp(argv[i], "--file")) file = argv[i + 1];
+    else if (!strcmp(argv[i], "--server")) server = argv[i + 1];
+    else if (!strcmp(argv[i], "--times")) times = atoi(argv[i + 1]);
+  }
+  if (file.empty()) {
+    fprintf(stderr, "usage: rpc_replay --file dump.brtd --server ip:port\n");
+    return 1;
+  }
+  fiber_init(0);
+  Channel ch;
+  if (ch.Init(server) != 0) {
+    fprintf(stderr, "cannot reach %s\n", server.c_str());
+    return 1;
+  }
+  long sent = 0, failed = 0;
+  for (int t = 0; t < times; ++t) {
+    FILE* f = fopen(file.c_str(), "rb");
+    if (!f) {
+      fprintf(stderr, "cannot open %s\n", file.c_str());
+      return 1;
+    }
+    RpcMeta meta;
+    IOBuf body;
+    while (RpcDumpReadRecord(f, &meta, &body)) {
+      Controller cntl;
+      IOBuf req, rsp;
+      const size_t att = meta.attachment_size;
+      body.cutn(&req, body.size() - att);
+      body.cutn(&cntl.request_attachment(), att);
+      ch.CallMethod(meta.service, meta.method, &cntl, req, &rsp, nullptr);
+      ++sent;
+      if (cntl.Failed()) ++failed;
+      meta = RpcMeta();
+      body.clear();
+    }
+    fclose(f);
+  }
+  printf("{\"replayed\": %ld, \"failed\": %ld}\n", sent, failed);
+  return failed != 0;
+}
