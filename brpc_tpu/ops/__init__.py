@@ -1,0 +1,1 @@
+from brpc_tpu.ops.flash_attention import flash_attention  # noqa: F401

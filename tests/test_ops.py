@@ -1,0 +1,45 @@
+"""Pallas kernel tests (interpret mode on CPU; the same kernel compiles for
+TPU — guide /opt/skills/guides/pallas_guide.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from brpc_tpu.models import llama
+from brpc_tpu.ops import flash_attention
+
+
+def _inputs(key, b=2, t=128, hq=4, hkv=2, d=32, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, hq, d), dtype)
+    k = jax.random.normal(kk, (b, t, hkv, d), dtype)
+    v = jax.random.normal(kv, (b, t, hkv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_dense(causal):
+    q, k, v = _inputs(jax.random.PRNGKey(0))
+    want = llama.attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_uneven_blocks():
+    q, k, v = _inputs(jax.random.PRNGKey(1), t=64)
+    want = llama.attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, block_q=16, block_k=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_bf16():
+    q, k, v = _inputs(jax.random.PRNGKey(2), t=64, dtype=jnp.bfloat16)
+    want = llama.attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=5e-2, atol=5e-2)
