@@ -1,0 +1,360 @@
+#include "rpc/redis.h"
+
+#include <algorithm>
+#include <deque>
+#include <mutex>
+
+#include "base/logging.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/server.h"
+#include "transport/input_messenger.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+// ---------------------------------------------------------------------------
+// RESP encoding / decoding
+// ---------------------------------------------------------------------------
+
+void RedisReply::SerializeTo(IOBuf* out) const {
+  switch (type) {
+    case NIL:
+      out->append("$-1\r\n");
+      break;
+    case STATUS:
+      out->append("+" + str + "\r\n");
+      break;
+    case ERROR:
+      out->append("-ERR " + str + "\r\n");
+      break;
+    case INTEGER:
+      out->append(":" + std::to_string(integer) + "\r\n");
+      break;
+    case STRING:
+      out->append("$" + std::to_string(str.size()) + "\r\n" + str + "\r\n");
+      break;
+    case ARRAY:
+      out->append("*" + std::to_string(elems.size()) + "\r\n");
+      for (const RedisReply& e : elems) e.SerializeTo(out);
+      break;
+  }
+}
+
+namespace {
+
+// Reads one CRLF-terminated line from `text` at *pos.
+bool GetLine(const std::string& text, size_t* pos, std::string* line) {
+  size_t end = text.find("\r\n", *pos);
+  if (end == std::string::npos) return false;
+  *line = text.substr(*pos, end - *pos);
+  *pos = end + 2;
+  return true;
+}
+
+int ParseReplyText(const std::string& text, size_t* pos, RedisReply* out) {
+  std::string line;
+  if (!GetLine(text, pos, &line)) return EAGAIN;
+  if (line.empty()) return EBADMSG;
+  const char tag = line[0];
+  const std::string rest = line.substr(1);
+  switch (tag) {
+    case '+':
+      out->type = RedisReply::STATUS;
+      out->str = rest;
+      return 0;
+    case '-':
+      out->type = RedisReply::ERROR;
+      out->str = rest;
+      return 0;
+    case ':':
+      out->type = RedisReply::INTEGER;
+      out->integer = atoll(rest.c_str());
+      return 0;
+    case '$': {
+      long n = atol(rest.c_str());
+      if (n < 0) {
+        out->type = RedisReply::NIL;
+        return 0;
+      }
+      if (text.size() < *pos + size_t(n) + 2) return EAGAIN;
+      out->type = RedisReply::STRING;
+      out->str = text.substr(*pos, size_t(n));
+      *pos += size_t(n) + 2;
+      return 0;
+    }
+    case '*': {
+      long n = atol(rest.c_str());
+      if (n < 0) {
+        out->type = RedisReply::NIL;
+        return 0;
+      }
+      out->type = RedisReply::ARRAY;
+      out->elems.resize(size_t(n));
+      for (long i = 0; i < n; ++i) {
+        int rc = ParseReplyText(text, pos, &out->elems[size_t(i)]);
+        if (rc != 0) return rc;
+      }
+      return 0;
+    }
+    default:
+      return EBADMSG;
+  }
+}
+
+}  // namespace
+
+int RedisReply::ParseFrom(IOBuf* in) {
+  const std::string text = in->to_string();
+  size_t pos = 0;
+  RedisReply tmp;
+  int rc = ParseReplyText(text, &pos, &tmp);
+  if (rc != 0) return rc;
+  *this = std::move(tmp);
+  in->pop_front(pos);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Server side
+// ---------------------------------------------------------------------------
+
+bool RedisService::AddCommandHandler(const std::string& cmd,
+                                     Handler handler) {
+  std::string up = cmd;
+  std::transform(up.begin(), up.end(), up.begin(), ::toupper);
+  return handlers_.emplace(up, std::move(handler)).second;
+}
+
+RedisReply RedisService::Dispatch(
+    const std::vector<std::string>& args) const {
+  if (args.empty()) return RedisReply::Error("empty command");
+  std::string up = args[0];
+  std::transform(up.begin(), up.end(), up.begin(), ::toupper);
+  if (up == "PING") return RedisReply::Status("PONG");
+  if (up == "COMMAND") return RedisReply{RedisReply::ARRAY, "", 0, {}};
+  auto it = handlers_.find(up);
+  if (it == handlers_.end()) {
+    return RedisReply::Error("unknown command '" + args[0] + "'");
+  }
+  return it->second(args);
+}
+
+namespace {
+
+RedisService* GetRedisService(Server* server);
+
+// Cuts one RESP command (*N array of bulk strings). Returns consumed bytes
+// via *consumed and the args; 0 ok, EAGAIN, EBADMSG.
+int CutCommand(const std::string& text, size_t* pos,
+               std::vector<std::string>* args) {
+  std::string line;
+  if (!GetLine(text, pos, &line)) return EAGAIN;
+  if (line.empty() || line[0] != '*') return EBADMSG;
+  long n = atol(line.c_str() + 1);
+  if (n <= 0 || n > 1024) return EBADMSG;
+  args->clear();
+  for (long i = 0; i < n; ++i) {
+    if (!GetLine(text, pos, &line)) return EAGAIN;
+    if (line.empty() || line[0] != '$') return EBADMSG;
+    long len = atol(line.c_str() + 1);
+    if (len < 0 || len > (64 << 20)) return EBADMSG;
+    if (text.size() < *pos + size_t(len) + 2) return EAGAIN;
+    args->push_back(text.substr(*pos, size_t(len)));
+    *pos += size_t(len) + 2;
+  }
+  return 0;
+}
+
+ParseResult RedisParse(IOBuf* source, IOBuf* msg, Socket* s) {
+  char first;
+  if (source->copy_to(&first, 1) < 1) return ParseResult::NOT_ENOUGH_DATA;
+  if (first != '*') return ParseResult::TRY_OTHER;
+  auto* server = static_cast<Server*>(s->user());
+  if (server == nullptr || GetRedisService(server) == nullptr) {
+    return ParseResult::TRY_OTHER;  // no redis service on this server
+  }
+  const std::string text = source->to_string();
+  size_t pos = 0;
+  std::vector<std::string> args;
+  int rc = CutCommand(text, &pos, &args);
+  if (rc == EAGAIN) return ParseResult::NOT_ENOUGH_DATA;
+  if (rc != 0) return ParseResult::ERROR;
+  source->cutn(msg, pos);
+  return ParseResult::OK;
+}
+
+void RedisProcess(IOBuf&& msg, SocketId sid) {
+  SocketUniquePtr ptr;
+  if (Socket::Address(sid, &ptr) != 0) return;
+  auto* server = static_cast<Server*>(ptr->user());
+  RedisService* svc = server ? GetRedisService(server) : nullptr;
+  const std::string text = msg.to_string();
+  size_t pos = 0;
+  std::vector<std::string> args;
+  if (CutCommand(text, &pos, &args) != 0 || svc == nullptr) {
+    ptr->SetFailed(EBADMSG, "bad redis command");
+    return;
+  }
+  RedisReply reply = svc->Dispatch(args);
+  IOBuf out;
+  reply.SerializeTo(&out);
+  ptr->Write(&out);
+}
+
+// Redis commands must execute in arrival order per connection (pipelining
+// semantics) — same inline treatment as stream frames.
+bool RedisIsOrdered(const IOBuf&) { return true; }
+
+std::mutex g_redis_mu;
+std::map<Server*, RedisService*>& redis_map() {
+  static auto* m = new std::map<Server*, RedisService*>();
+  return *m;
+}
+
+RedisService* GetRedisService(Server* server) {
+  std::lock_guard<std::mutex> g(g_redis_mu);
+  auto it = redis_map().find(server);
+  return it == redis_map().end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+void ServeRedisOn(Server* server, RedisService* service) {
+  {
+    std::lock_guard<std::mutex> g(g_redis_mu);
+    redis_map()[server] = service;
+  }
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.name = "redis";
+    p.parse = RedisParse;
+    p.process = RedisProcess;
+    p.is_ordered = RedisIsOrdered;
+    RegisterProtocol(p);
+  });
+}
+
+// ---------------------------------------------------------------------------
+// Pipelined client
+// ---------------------------------------------------------------------------
+
+struct RedisClient::Impl {
+  SocketId sock = INVALID_SOCKET_ID;
+  std::mutex mu;
+  IOPortal inbuf;
+  struct Waiter {
+    RedisReply* out;
+    CountdownEvent ev{1};
+    int rc = 0;
+  };
+  std::deque<Waiter*> waiters;  // FIFO matching
+  int64_t timeout_us = 1000000;
+
+  static void OnData(Socket* s);
+  void Fail(int err);
+};
+
+void RedisClient::Impl::OnData(Socket* s) {
+  auto* impl = static_cast<RedisClient::Impl*>(s->user());
+  for (;;) {
+    ssize_t nr = impl->inbuf.append_from_fd(s->fd());
+    if (nr == 0) {
+      s->SetFailed(ECONNRESET, "redis server closed");
+      impl->Fail(ECONNRESET);
+      return;
+    }
+    if (nr < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      s->SetFailed(errno, "redis read failed");
+      impl->Fail(errno);
+      return;
+    }
+  }
+  for (;;) {
+    RedisReply reply;
+    std::lock_guard<std::mutex> g(impl->mu);
+    if (impl->waiters.empty()) break;
+    int rc = reply.ParseFrom(&impl->inbuf);
+    if (rc == EAGAIN) break;
+    Impl::Waiter* w = impl->waiters.front();
+    impl->waiters.pop_front();
+    if (rc == 0) {
+      *w->out = std::move(reply);
+    } else {
+      w->rc = rc;
+    }
+    w->ev.signal();
+    if (rc != 0) break;
+  }
+}
+
+void RedisClient::Impl::Fail(int err) {
+  std::lock_guard<std::mutex> g(mu);
+  while (!waiters.empty()) {
+    Waiter* w = waiters.front();
+    waiters.pop_front();
+    w->rc = err;
+    w->ev.signal();
+  }
+}
+
+RedisClient::RedisClient() : impl_(new Impl) {}
+
+RedisClient::~RedisClient() {
+  if (impl_->sock != INVALID_SOCKET_ID) {
+    SocketUniquePtr p;
+    if (Socket::Address(impl_->sock, &p) == 0) {
+      p->SetFailed(ECANCELED, "client closed");
+    }
+  }
+}
+
+int RedisClient::Init(const std::string& addr, int64_t timeout_ms) {
+  EndPoint ep;
+  if (!EndPoint::parse(addr, &ep)) return EINVAL;
+  return Init(ep, timeout_ms);
+}
+
+int RedisClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  fiber_init(0);
+  impl_->timeout_us = timeout_ms * 1000;
+  Socket::Options opts;
+  opts.user = impl_.get();
+  opts.on_edge_triggered = Impl::OnData;
+  return Socket::Connect(server, opts, &impl_->sock, impl_->timeout_us);
+}
+
+RedisReply RedisClient::Command(const std::vector<std::string>& args) {
+  SocketUniquePtr p;
+  if (Socket::Address(impl_->sock, &p) != 0 || p->Failed()) {
+    return RedisReply::Error("connection lost");
+  }
+  IOBuf cmd;
+  cmd.append("*" + std::to_string(args.size()) + "\r\n");
+  for (const std::string& a : args) {
+    cmd.append("$" + std::to_string(a.size()) + "\r\n" + a + "\r\n");
+  }
+  RedisReply reply;
+  Impl::Waiter waiter;
+  waiter.out = &reply;
+  {
+    std::lock_guard<std::mutex> g(impl_->mu);
+    impl_->waiters.push_back(&waiter);
+  }
+  p->Write(&cmd);
+  if (waiter.ev.wait(impl_->timeout_us) != 0) {
+    // Timed out: the waiter must not dangle — fail the connection, which
+    // drains the FIFO (including us) before we return.
+    p->SetFailed(ETIMEDOUT, "redis reply timeout");
+    impl_->Fail(ETIMEDOUT);
+    waiter.ev.wait(-1);
+    return RedisReply::Error("timeout");
+  }
+  if (waiter.rc != 0) return RedisReply::Error("io error");
+  return reply;
+}
+
+}  // namespace brt

@@ -1,0 +1,84 @@
+// Redis (RESP) support: server side serves real redis clients on the same
+// port as brt_std/HTTP (multi-protocol cut); client side is a pipelined
+// FIFO-matched connection.
+// Parity target: reference src/brpc/redis.{h,cpp} (RedisService /
+// RedisCommandHandler redis.h:227,249 — server-side redis serving) +
+// policy/redis_protocol.cpp (RESP parse) + the pipelined client
+// (PipelinedInfo on Socket, socket.h:157).
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+
+namespace brt {
+
+struct RedisReply {
+  enum Type { NIL, STATUS, ERROR, INTEGER, STRING, ARRAY };
+  Type type = NIL;
+  std::string str;                 // STATUS/ERROR/STRING payload
+  int64_t integer = 0;
+  std::vector<RedisReply> elems;   // ARRAY
+
+  static RedisReply Status(std::string s) {
+    return RedisReply{STATUS, std::move(s), 0, {}};
+  }
+  static RedisReply Error(std::string s) {
+    return RedisReply{ERROR, std::move(s), 0, {}};
+  }
+  static RedisReply Integer(int64_t v) {
+    return RedisReply{INTEGER, "", v, {}};
+  }
+  static RedisReply Bulk(std::string s) {
+    return RedisReply{STRING, std::move(s), 0, {}};
+  }
+  static RedisReply Nil() { return RedisReply{}; }
+
+  void SerializeTo(IOBuf* out) const;
+  // Parses ONE reply; 0 ok, EAGAIN need-more, EBADMSG corrupt.
+  int ParseFrom(IOBuf* in);
+};
+
+// Server-side command table (reference RedisService::AddCommandHandler).
+class RedisService {
+ public:
+  using Handler =
+      std::function<RedisReply(const std::vector<std::string>& args)>;
+
+  // cmd is case-insensitive ("GET"). Returns false if duplicated.
+  bool AddCommandHandler(const std::string& cmd, Handler handler);
+  RedisReply Dispatch(const std::vector<std::string>& args) const;
+
+ private:
+  std::map<std::string, Handler> handlers_;
+};
+
+// Attach to a Server BEFORE Start (serves redis-cli on the RPC port).
+class Server;
+void ServeRedisOn(Server* server, RedisService* service);
+
+// Pipelined client: commands are FIFO-matched to replies on one
+// connection (redis semantics). Thread/fiber-safe.
+class RedisClient {
+ public:
+  RedisClient();
+  ~RedisClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  int Init(const std::string& addr, int64_t timeout_ms = 1000);
+
+  // Sync call: Command({"SET", "k", "v"}) -> reply. On transport failure
+  // returns an ERROR reply.
+  RedisReply Command(const std::vector<std::string>& args);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace brt

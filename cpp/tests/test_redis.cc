@@ -1,0 +1,93 @@
+// Redis protocol tests: RESP server on the shared RPC port + pipelined
+// client (reference model: test/brpc_redis_unittest.cpp; server-side
+// serving per redis.h:227).
+#include <cassert>
+#include <cstdio>
+#include <map>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "rpc/redis.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+int main() {
+  fiber_init(4);
+
+  // In-memory KV store behind GET/SET/DEL/INCR.
+  static std::map<std::string, std::string> kv;
+  static std::mutex mu;
+  RedisService redis;
+  redis.AddCommandHandler("SET", [](const std::vector<std::string>& a) {
+    if (a.size() != 3) return RedisReply::Error("wrong args");
+    std::lock_guard<std::mutex> g(mu);
+    kv[a[1]] = a[2];
+    return RedisReply::Status("OK");
+  });
+  redis.AddCommandHandler("GET", [](const std::vector<std::string>& a) {
+    if (a.size() != 2) return RedisReply::Error("wrong args");
+    std::lock_guard<std::mutex> g(mu);
+    auto it = kv.find(a[1]);
+    return it == kv.end() ? RedisReply::Nil() : RedisReply::Bulk(it->second);
+  });
+  redis.AddCommandHandler("DEL", [](const std::vector<std::string>& a) {
+    std::lock_guard<std::mutex> g(mu);
+    int64_t n = 0;
+    for (size_t i = 1; i < a.size(); ++i) n += kv.erase(a[i]);
+    return RedisReply::Integer(n);
+  });
+  redis.AddCommandHandler("INCR", [](const std::vector<std::string>& a) {
+    std::lock_guard<std::mutex> g(mu);
+    int64_t v = atoll(kv[a[1]].c_str()) + 1;
+    kv[a[1]] = std::to_string(v);
+    return RedisReply::Integer(v);
+  });
+
+  Server server;
+  ServeRedisOn(&server, &redis);
+  assert(server.Start("127.0.0.1:0") == 0);
+
+  RedisClient cli;
+  assert(cli.Init(server.listen_address()) == 0);
+
+  RedisReply r = cli.Command({"PING"});
+  assert(r.type == RedisReply::STATUS && r.str == "PONG");
+  printf("redis_ping OK\n");
+
+  r = cli.Command({"SET", "name", "brpc-tpu"});
+  assert(r.type == RedisReply::STATUS && r.str == "OK");
+  r = cli.Command({"GET", "name"});
+  assert(r.type == RedisReply::STRING && r.str == "brpc-tpu");
+  r = cli.Command({"GET", "missing"});
+  assert(r.type == RedisReply::NIL);
+  printf("redis_get_set OK\n");
+
+  for (int i = 0; i < 10; ++i) {
+    r = cli.Command({"INCR", "counter"});
+    assert(r.type == RedisReply::INTEGER && r.integer == i + 1);
+  }
+  printf("redis_incr OK\n");
+
+  r = cli.Command({"DEL", "name", "counter", "missing"});
+  assert(r.type == RedisReply::INTEGER && r.integer == 2);
+  printf("redis_del OK\n");
+
+  r = cli.Command({"FLUSHDB"});
+  assert(r.type == RedisReply::ERROR);
+  printf("redis_unknown_cmd OK\n");
+
+  // Binary-safe values.
+  std::string blob(4096, '\0');
+  for (size_t i = 0; i < blob.size(); ++i) blob[i] = char(i % 251);
+  r = cli.Command({"SET", "blob", blob});
+  assert(r.type == RedisReply::STATUS);
+  r = cli.Command({"GET", "blob"});
+  assert(r.type == RedisReply::STRING && r.str == blob);
+  printf("redis_binary OK\n");
+
+  server.Stop();
+  server.Join();
+  printf("ALL redis tests OK\n");
+  return 0;
+}
