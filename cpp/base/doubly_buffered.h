@@ -4,9 +4,14 @@
 // reader still sees the old copy.  Parity target: reference
 // src/butil/containers/doubly_buffered_data.h:86 (used by load balancers and
 // SocketMap for server lists).
+// Instances may die before reader threads (cluster channels are destroyed
+// mid-process): wrapper↔owner links are guarded by one global mutex, the
+// destructor orphans its wrappers, and the TLS cache revalidates owners
+// (an address-reused instance must not adopt a stale wrapper).
 #pragma once
 
 #include <atomic>
+#include <cstdint>
 #include <functional>
 #include <memory>
 #include <mutex>
@@ -37,6 +42,12 @@ class DoublyBufferedData {
 
   DoublyBufferedData() = default;
 
+  ~DoublyBufferedData() {
+    std::lock_guard<std::mutex> g(link_mu());
+    for (Wrapper* w : wrappers_) w->owner = nullptr;
+    wrappers_.clear();
+  }
+
   // Wait-free for readers (own TLS mutex, uncontended unless a writer is
   // mid-flip).
   int Read(ScopedPtr* ptr) {
@@ -56,7 +67,7 @@ class DoublyBufferedData {
     index_.store(bg, std::memory_order_release);
     // Wait for readers on the old copy: grab every wrapper mutex once.
     {
-      std::lock_guard<std::mutex> lg(wrappers_mu_);
+      std::lock_guard<std::mutex> lg(link_mu());
       for (Wrapper* w : wrappers_) {
         w->mu.lock();
         w->mu.unlock();
@@ -69,32 +80,56 @@ class DoublyBufferedData {
  private:
   struct Wrapper {
     std::mutex mu;
-    DoublyBufferedData* owner = nullptr;
+    DoublyBufferedData* owner = nullptr;  // guarded by link_mu()
     ~Wrapper() {
-      if (owner) owner->remove_wrapper(this);
+      std::lock_guard<std::mutex> g(link_mu());
+      if (owner) owner->remove_wrapper_locked(this);
     }
   };
 
-  // NOTE: a DoublyBufferedData instance must outlive any thread that Read()
-  // it (true for its users here: LB/SocketMap tables live for the process).
-  Wrapper* tls_wrapper() {
-    thread_local std::vector<
-        std::pair<DoublyBufferedData*, std::unique_ptr<Wrapper>>>
-        cache;
-    for (auto& [o, w] : cache)
-      if (o == this) return w.get();
-    auto w = std::make_unique<Wrapper>();
-    w->owner = this;
-    {
-      std::lock_guard<std::mutex> g(wrappers_mu_);
-      wrappers_.push_back(w.get());
-    }
-    cache.emplace_back(this, std::move(w));
-    return cache.back().second.get();
+  // One global mutex for all wrapper↔owner links (touched only on wrapper
+  // creation, instance destruction, thread exit and Modify — never on the
+  // Read fast path).
+  static std::mutex& link_mu() {
+    static std::mutex* m = new std::mutex;  // leaked: TLS dtors at exit
+    return *m;
   }
 
-  void remove_wrapper(Wrapper* w) {
-    std::lock_guard<std::mutex> g(wrappers_mu_);
+  struct CacheEntry {
+    DoublyBufferedData* owner;
+    uint64_t owner_id;
+    std::unique_ptr<Wrapper> wrapper;
+  };
+
+  Wrapper* tls_wrapper() {
+    thread_local std::vector<CacheEntry> cache;
+    for (size_t i = 0; i < cache.size(); ++i) {
+      if (cache[i].owner != this) continue;
+      // Lock-free revalidation: an instance that died and was replaced by
+      // a new one at the same address has a different generation id (we
+      // only read the LIVE instance's id_, never freed memory).
+      if (cache[i].owner_id == id_) return cache[i].wrapper.get();
+      std::swap(cache[i], cache.back());
+      cache.pop_back();  // stale entry for a dead instance
+      break;
+    }
+    auto w = std::make_unique<Wrapper>();
+    {
+      std::lock_guard<std::mutex> g(link_mu());
+      w->owner = this;
+      wrappers_.push_back(w.get());
+    }
+    Wrapper* raw = w.get();
+    cache.push_back(CacheEntry{this, id_, std::move(w)});
+    return raw;
+  }
+
+  static uint64_t next_id() {
+    static std::atomic<uint64_t> c{1};
+    return c.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  void remove_wrapper_locked(Wrapper* w) {
     for (size_t i = 0; i < wrappers_.size(); ++i) {
       if (wrappers_[i] == w) {
         wrappers_[i] = wrappers_.back();
@@ -106,8 +141,8 @@ class DoublyBufferedData {
 
   T data_[2];
   std::atomic<int> index_{0};
+  const uint64_t id_ = next_id();  // generation tag for TLS revalidation
   std::mutex modify_mu_;
-  std::mutex wrappers_mu_;
   std::vector<Wrapper*> wrappers_;
 };
 

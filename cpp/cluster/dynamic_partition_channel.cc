@@ -1,0 +1,107 @@
+#include "cluster/dynamic_partition_channel.h"
+
+namespace brt {
+
+DynamicPartitionChannel::~DynamicPartitionChannel() {
+  if (ns_) ns_->Stop();
+}
+
+int DynamicPartitionChannel::Init(const std::string& ns_url,
+                                  const PartitionChannelOptions* opts,
+                                  std::shared_ptr<CallMapper> mapper,
+                                  std::shared_ptr<ResponseMerger> merger) {
+  if (opts) options_ = *opts;
+  mapper_ = std::move(mapper);
+  merger_ = std::move(merger);
+  ns_ = StartNamingService(ns_url, [this](const std::vector<ServerNode>& s) {
+    OnServers(s);
+  });
+  return ns_ ? 0 : EINVAL;
+}
+
+void DynamicPartitionChannel::OnServers(
+    const std::vector<ServerNode>& servers) {
+  // Bucket servers by scheme N, split by partition index.
+  std::map<int, std::vector<std::vector<ServerNode>>> split;
+  for (const ServerNode& node : servers) {
+    int idx = 0, total = 0;
+    if (!parser_.Parse(node.tag, &idx, &total)) continue;
+    auto& buckets = split[total];
+    if (buckets.empty()) buckets.resize(size_t(total));
+    buckets[size_t(idx)].push_back(node);
+  }
+  std::lock_guard<std::mutex> g(mu_);
+  // New schemes appear; existing ones get fresh lists; schemes absent from
+  // this push drain to zero capacity (never destroyed under traffic).
+  for (auto& [n, buckets] : split) {
+    auto& scheme = schemes_[n];
+    if (!scheme) {
+      scheme = std::make_unique<Scheme>();
+      scheme->nparts = n;
+      ParallelChannelOptions popts;
+      popts.fail_limit = options_.fail_limit;
+      popts.timeout_ms = options_.timeout_ms;
+      scheme->fanout = std::make_unique<ParallelChannel>(popts);
+      for (int i = 0; i < n; ++i) {
+        auto part = std::make_unique<ClusterChannel>();
+        part->InitWithLb(options_.lb_name, &options_.sub);
+        scheme->fanout->AddChannel(part.get(), mapper_, merger_);
+        scheme->parts.push_back(std::move(part));
+      }
+    }
+    int cap = 0;
+    for (int i = 0; i < n; ++i) {
+      scheme->parts[size_t(i)]->UpdateServers(buckets[size_t(i)]);
+      cap += int(buckets[size_t(i)].size());
+    }
+    scheme->capacity = cap;
+  }
+  for (auto& [n, scheme] : schemes_) {
+    if (split.find(n) == split.end()) {
+      for (auto& part : scheme->parts) part->UpdateServers({});
+      scheme->capacity = 0;
+    }
+  }
+}
+
+DynamicPartitionChannel::Scheme* DynamicPartitionChannel::PickScheme() {
+  std::lock_guard<std::mutex> g(mu_);
+  int total = 0;
+  for (auto& [n, s] : schemes_) total += s->capacity;
+  if (total == 0) return nullptr;
+  // capacity-weighted pick (the reference's _dynpart LB weights by
+  // partition-count-normalized capacity)
+  pick_seed_ ^= pick_seed_ >> 12;
+  pick_seed_ ^= pick_seed_ << 25;
+  pick_seed_ ^= pick_seed_ >> 27;
+  int r = int((pick_seed_ * 0x2545F4914F6CDD1DULL) % uint64_t(total));
+  for (auto& [n, s] : schemes_) {
+    if (r < s->capacity) return s.get();
+    r -= s->capacity;
+  }
+  return schemes_.rbegin()->second.get();
+}
+
+void DynamicPartitionChannel::CallMethod(const std::string& service,
+                                         const std::string& method,
+                                         Controller* cntl,
+                                         const IOBuf& request,
+                                         IOBuf* response, Closure done) {
+  Scheme* scheme = PickScheme();
+  if (scheme == nullptr) {
+    cntl->SetFailed(EHOSTDOWN, "no partition scheme has servers");
+    if (done) done();
+    return;
+  }
+  scheme->fanout->CallMethod(service, method, cntl, request, response,
+                             std::move(done));
+}
+
+std::map<int, int> DynamicPartitionChannel::SchemeCapacities() const {
+  std::lock_guard<std::mutex> g(mu_);
+  std::map<int, int> out;
+  for (auto& [n, s] : schemes_) out[n] = s->capacity;
+  return out;
+}
+
+}  // namespace brt

@@ -1,0 +1,59 @@
+// DynamicPartitionChannel: several partitioning schemes (different N in
+// "i/N" tags) live at once; calls pick a scheme weighted by its capacity
+// (server count), so traffic migrates as a resharding rollout progresses.
+// Parity target: reference src/brpc/partition_channel.h:136 +
+// policy/dynpart_load_balancer.cpp (example
+// example/dynamic_partition_echo_c++) — the online-resharding /
+// elastic-repartitioning shape of SURVEY §2.7.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <mutex>
+
+#include "cluster/partition_channel.h"
+
+namespace brt {
+
+class DynamicPartitionChannel : public ChannelBase {
+ public:
+  DynamicPartitionChannel() = default;
+  ~DynamicPartitionChannel() override;
+
+  int Init(const std::string& ns_url,
+           const PartitionChannelOptions* opts = nullptr,
+           std::shared_ptr<CallMapper> mapper = nullptr,
+           std::shared_ptr<ResponseMerger> merger = nullptr);
+
+  void CallMethod(const std::string& service, const std::string& method,
+                  Controller* cntl, const IOBuf& request, IOBuf* response,
+                  Closure done) override;
+
+  // (scheme N → live server count); tests/introspection.
+  std::map<int, int> SchemeCapacities() const;
+
+ private:
+  // One partitioning scheme: N partition ClusterChannels + fan-out.
+  struct Scheme {
+    int nparts = 0;
+    int capacity = 0;  // total servers currently in this scheme
+    std::vector<std::unique_ptr<ClusterChannel>> parts;
+    std::unique_ptr<ParallelChannel> fanout;
+  };
+
+  void OnServers(const std::vector<ServerNode>& servers);
+  Scheme* PickScheme();
+
+  PartitionChannelOptions options_;
+  std::shared_ptr<CallMapper> mapper_;
+  std::shared_ptr<ResponseMerger> merger_;
+  PartitionParser parser_;
+  std::unique_ptr<NamingService> ns_;
+  mutable std::mutex mu_;
+  // Schemes are only ever added (capacity may drop to 0) so in-flight
+  // calls never race a destruction.
+  std::map<int, std::unique_ptr<Scheme>> schemes_;
+  uint64_t pick_seed_ = 0x9e3779b97f4a7c15ULL;
+};
+
+}  // namespace brt

@@ -25,15 +25,20 @@ struct StackPool {
   std::mutex mu;
   std::vector<void*> free_bases[3];
 };
-StackPool g_pool;
+// Leaked: detached worker threads allocate/return stacks during and after
+// static destruction (same rule as every other runtime singleton here).
+StackPool& pool() {
+  static StackPool* p = new StackPool;
+  return *p;
+}
 
 }  // namespace
 
 bool get_stack(StackType type, FiberStack* out) {
   size_t usable = stack_bytes(type);
   {
-    std::lock_guard<std::mutex> g(g_pool.mu);
-    auto& v = g_pool.free_bases[int(type)];
+    std::lock_guard<std::mutex> g(pool().mu);
+    auto& v = pool().free_bases[int(type)];
     if (!v.empty()) {
       out->base = v.back();
       v.pop_back();
@@ -57,8 +62,8 @@ bool get_stack(StackType type, FiberStack* out) {
 }
 
 void return_stack(const FiberStack& s) {
-  std::lock_guard<std::mutex> g(g_pool.mu);
-  auto& v = g_pool.free_bases[int(s.type)];
+  std::lock_guard<std::mutex> g(pool().mu);
+  auto& v = pool().free_bases[int(s.type)];
   if (v.size() < 128) {
     v.push_back(s.base);
   } else {

@@ -8,6 +8,7 @@
 #include "rpc/rpc_dump.h"
 #include "rpc/span.h"
 #include "transport/input_messenger.h"
+#include "var/default_variables.h"
 
 namespace brt {
 
@@ -39,6 +40,7 @@ int Server::Start(const EndPoint& addr, const Options* opts) {
   RegisterHttpProtocol();
   RegisterSpanFlags();
   RegisterRpcDumpFlags();
+  var::ExposeDefaultVariables();
   if (const char* dump = getenv("BRT_RPC_DUMP_FILE")) {
     SetRpcDumpFile(dump);
   }

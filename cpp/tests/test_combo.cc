@@ -6,6 +6,7 @@
 #include <set>
 #include <string>
 
+#include "cluster/dynamic_partition_channel.h"
 #include "cluster/parallel_channel.h"
 #include "cluster/partition_channel.h"
 #include "cluster/selective_channel.h"
@@ -228,6 +229,46 @@ void test_nested_combo(Fixture& fx) {
   printf("nested_combo OK (%s)\n", out.c_str());
 }
 
+void test_dynamic_partition() {
+  // Two schemes live at once: 1-way (1 server "0/1") and 2-way ("0/2",
+  // "1/2"); calls succeed against whichever scheme is picked, and
+  // capacities are tracked per scheme.
+  constexpr int N = 3;
+  static Server servers[N];
+  static std::unique_ptr<ShardService> svcs[N];
+  const char* tags[N] = {"0/1", "0/2", "1/2"};
+  std::string list = "list://";
+  for (int i = 0; i < N; ++i) {
+    svcs[i] = std::make_unique<ShardService>(i);
+    assert(servers[i].AddService(svcs[i].get(), "Shard") == 0);
+    assert(servers[i].Start("127.0.0.1:0") == 0);
+    if (i) list += ",";
+    list += servers[i].listen_address().to_string() + ":" + tags[i];
+  }
+  DynamicPartitionChannel dc;
+  assert(dc.Init(list) == 0);
+  auto caps = dc.SchemeCapacities();
+  assert(caps[1] == 1 && caps[2] == 2);
+  int len1 = 0, len2 = 0;
+  for (int i = 0; i < 30; ++i) {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("d");
+    dc.CallMethod("Shard", "Echo", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed());
+    const std::string out = rsp.to_string();
+    if (out == "0:d;") ++len1;                 // 1-way scheme
+    else if (out == "1:d;2:d;") ++len2;        // 2-way scheme
+    else assert(false);
+  }
+  assert(len1 > 0 && len2 > 0);  // both schemes take traffic
+  for (auto& s : servers) {
+    s.Stop();
+    s.Join();
+  }
+  printf("dynamic_partition OK (1-way=%d 2-way=%d)\n", len1, len2);
+}
+
 }  // namespace
 
 int main() {
@@ -241,6 +282,7 @@ int main() {
     test_selective(fx);  // kills server 0 — keep last
   }
   test_partition();
+  test_dynamic_partition();
   printf("ALL combo tests OK\n");
   return 0;
 }

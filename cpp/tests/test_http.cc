@@ -15,6 +15,7 @@
 #include "rpc/channel.h"
 #include "rpc/server.h"
 #include "var/latency_recorder.h"
+#include "var/multi_dimension.h"
 
 using namespace brt;
 
@@ -102,7 +103,21 @@ int main() {
 
   r = HttpGet(addr, "GET /brpc_metrics HTTP/1.1\r\n\r\n");
   assert(r.rfind("HTTP/1.1 200", 0) == 0);
-  printf("http_metrics OK\n");
+  assert(r.find("process_resident_memory_bytes") != std::string::npos);
+  assert(r.find("process_open_fds") != std::string::npos);
+  printf("http_metrics OK (incl. process vars)\n");
+
+  // Labeled metric (mbvar) shows per-combination lines.
+  {
+    static var::MultiDimension<var::Adder<int64_t>> mvar({"method", "code"});
+    mvar.expose("test_requests_total");
+    *mvar.stat({"Echo", "200"}) << 7;
+    *mvar.stat({"Echo", "500"}) << 2;
+    r = HttpGet(addr, "GET /vars/test_requests_total HTTP/1.1\r\n\r\n");
+    assert(r.find("method=\"Echo\",code=\"200\"") != std::string::npos);
+    assert(r.find("7") != std::string::npos);
+    printf("http_mbvar OK\n");
+  }
 
   r = HttpGet(addr, "GET /connections HTTP/1.1\r\n\r\n");
   assert(r.find("socket_count") != std::string::npos);

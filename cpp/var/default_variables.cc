@@ -1,0 +1,117 @@
+// Process-level metrics from /proc — cpu, rss, vsize, fd count, thread
+// count, uptime — exposed as vars (shown in /vars and /brpc_metrics).
+// Parity target: reference src/bvar/default_variables.cpp:78-211 (reads
+// /proc/self/stat, statm, rusage).
+#include <dirent.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+
+#include "base/time.h"
+#include "var/reducer.h"
+#include "var/variable.h"
+
+namespace brt {
+namespace var {
+
+namespace {
+
+struct ProcStat {
+  double cpu_seconds = 0;
+  long rss_bytes = 0;
+  long vsize_bytes = 0;
+  int threads = 0;
+  int fds = 0;
+};
+
+ProcStat read_proc() {
+  ProcStat ps;
+  const long page = sysconf(_SC_PAGESIZE);
+  const long hz = sysconf(_SC_CLK_TCK);
+  FILE* f = fopen("/proc/self/stat", "r");
+  if (f) {
+    // pid (comm) state ppid ... utime(14) stime(15) ... num_threads(20)
+    // ... vsize(23) rss(24)
+    char comm[256], state;
+    long ppid, pgrp, session, tty, tpgid;
+    unsigned long flags, minflt, cminflt, majflt, cmajflt, utime, stime;
+    long cutime, cstime, priority, nice, nthreads, itrealvalue;
+    unsigned long long starttime;
+    unsigned long vsize;
+    long rss;
+    int pid;
+    if (fscanf(f,
+               "%d %255s %c %ld %ld %ld %ld %ld %lu %lu %lu %lu %lu %lu %lu "
+               "%ld %ld %ld %ld %ld %ld %llu %lu %ld",
+               &pid, comm, &state, &ppid, &pgrp, &session, &tty, &tpgid,
+               &flags, &minflt, &cminflt, &majflt, &cmajflt, &utime, &stime,
+               &cutime, &cstime, &priority, &nice, &nthreads, &itrealvalue,
+               &starttime, &vsize, &rss) == 24) {
+      ps.cpu_seconds = double(utime + stime) / double(hz > 0 ? hz : 100);
+      ps.threads = int(nthreads);
+      ps.vsize_bytes = long(vsize);
+      ps.rss_bytes = rss * page;
+    }
+    fclose(f);
+  }
+  if (DIR* d = opendir("/proc/self/fd")) {
+    while (readdir(d)) ++ps.fds;
+    closedir(d);
+    ps.fds -= 2;  // . and ..
+  }
+  return ps;
+}
+
+// Cache with 1s freshness: several vars share one /proc read.
+const ProcStat& cached() {
+  static ProcStat ps;
+  static int64_t last = 0;
+  static std::mutex mu;
+  std::lock_guard<std::mutex> g(mu);
+  const int64_t now = monotonic_us();
+  if (now - last > 1000000) {
+    ps = read_proc();
+    last = now;
+  }
+  return ps;
+}
+
+int64_t start_us() {
+  static const int64_t t = monotonic_us();
+  return t;
+}
+
+}  // namespace
+
+void ExposeDefaultVariables() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    start_us();  // pin process start
+    static PassiveStatus<int64_t> rss(
+        [](void*) -> int64_t { return cached().rss_bytes; }, nullptr);
+    rss.expose("process_resident_memory_bytes");
+    static PassiveStatus<int64_t> vsz(
+        [](void*) -> int64_t { return cached().vsize_bytes; }, nullptr);
+    vsz.expose("process_virtual_memory_bytes");
+    static PassiveStatus<int64_t> fds(
+        [](void*) -> int64_t { return cached().fds; }, nullptr);
+    fds.expose("process_open_fds");
+    static PassiveStatus<int64_t> thr(
+        [](void*) -> int64_t { return cached().threads; }, nullptr);
+    thr.expose("process_threads");
+    static PassiveStatus<double> cpu(
+        [](void*) -> double { return cached().cpu_seconds; }, nullptr);
+    cpu.expose("process_cpu_seconds_total");
+    static PassiveStatus<int64_t> up(
+        [](void*) -> int64_t {
+          return (monotonic_us() - start_us()) / 1000000;
+        },
+        nullptr);
+    up.expose("process_uptime_seconds");
+  });
+}
+
+}  // namespace var
+}  // namespace brt

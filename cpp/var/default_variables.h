@@ -1,0 +1,11 @@
+// Process-level /proc metrics exposure (see default_variables.cc).
+#pragma once
+
+namespace brt {
+namespace var {
+
+// Idempotent; called by Server::Start so every server exports process vars.
+void ExposeDefaultVariables();
+
+}  // namespace var
+}  // namespace brt

@@ -1,0 +1,90 @@
+// Labeled metrics: one logical metric fanned out by label values, dumped
+// Prometheus-style (`name{label="v",...} value`).
+// Parity target: reference src/bvar/multi_dimension.h + mvariable.cpp
+// (mbvar — map label-values → bvar, SURVEY §2.3). Redesigned: a
+// shared_mutex map of heap sub-vars; the hot path (stat(labels) lookup) is
+// a shared-lock hit after first use.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <shared_mutex>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "var/variable.h"
+
+namespace brt {
+namespace var {
+
+template <typename Var>
+class MultiDimension : public Variable {
+ public:
+  explicit MultiDimension(std::vector<std::string> label_names)
+      : labels_(std::move(label_names)) {}
+
+  // Sub-var for this label-value combination (created on first use).
+  Var* stat(const std::vector<std::string>& values) {
+    const std::string key = join(values);
+    {
+      std::shared_lock lk(mu_);
+      auto it = vars_.find(key);
+      if (it != vars_.end()) return it->second.get();
+    }
+    std::unique_lock lk(mu_);
+    auto& slot = vars_[key];
+    if (!slot) slot = std::make_unique<Var>();
+    return slot.get();
+  }
+
+  size_t count_stats() const {
+    std::shared_lock lk(mu_);
+    return vars_.size();
+  }
+
+  // Multi-line: one `name{labels} value` per combination.
+  void describe(std::ostream& os) const override {
+    std::shared_lock lk(mu_);
+    bool first = true;
+    for (const auto& [key, var] : vars_) {
+      if (!first) os << "\n";
+      first = false;
+      os << name() << "{" << format_labels(key) << "} ";
+      var->describe(os);
+    }
+  }
+
+ private:
+  static std::string join(const std::vector<std::string>& values) {
+    std::string out;
+    for (const auto& v : values) {
+      if (!out.empty()) out.push_back('\x1f');
+      out += v;
+    }
+    return out;
+  }
+
+  std::string format_labels(const std::string& key) const {
+    std::string out;
+    size_t start = 0, li = 0;
+    while (li < labels_.size()) {
+      size_t end = key.find('\x1f', start);
+      const std::string v = key.substr(
+          start, end == std::string::npos ? std::string::npos : end - start);
+      if (!out.empty()) out += ",";
+      out += labels_[li] + "=\"" + v + "\"";
+      if (end == std::string::npos) break;
+      start = end + 1;
+      ++li;
+    }
+    return out;
+  }
+
+  std::vector<std::string> labels_;
+  mutable std::shared_mutex mu_;
+  std::map<std::string, std::unique_ptr<Var>> vars_;
+};
+
+}  // namespace var
+}  // namespace brt
