@@ -52,7 +52,9 @@ bool GetLine(const std::string& text, size_t* pos, std::string* line) {
   return true;
 }
 
-int ParseReplyText(const std::string& text, size_t* pos, RedisReply* out) {
+int ParseReplyText(const std::string& text, size_t* pos, RedisReply* out,
+                   int depth = 0) {
+  if (depth > 32) return EBADMSG;  // nesting cap: wire input, bounded stack
   std::string line;
   if (!GetLine(text, pos, &line)) return EAGAIN;
   if (line.empty()) return EBADMSG;
@@ -92,7 +94,8 @@ int ParseReplyText(const std::string& text, size_t* pos, RedisReply* out) {
       out->type = RedisReply::ARRAY;
       out->elems.resize(size_t(n));
       for (long i = 0; i < n; ++i) {
-        int rc = ParseReplyText(text, pos, &out->elems[size_t(i)]);
+        int rc = ParseReplyText(text, pos, &out->elems[size_t(i)],
+                                depth + 1);
         if (rc != 0) return rc;
       }
       return 0;
