@@ -1,0 +1,105 @@
+"""Remote parameter-server tier: embedding shards served over the native
+RPC fabric, driven from JAX training loops.
+
+This is the DCN tier of the BASELINE #5 workload ("param-server serving
+embedding shards, allreduce grads"): each shard is a native Server
+(cpp/rpc) holding rows [i*rows_per, (i+1)*rows_per); the client routes ids
+to owners (the PartitionChannel "i/N" contract, cpp/cluster/
+partition_channel.*) and runs Lookup / ApplyGrad calls. The intra-pod tier
+— where the table fits in pod HBM — is brpc_tpu.ps (compiled collectives).
+
+Wire format (little-endian): Lookup req = int32 count ++ int32 ids;
+rsp = float32 rows [count, dim]. ApplyGrad req = int32 count ++ int32 ids
+++ float32 grads [count, dim]; rsp = empty.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+from brpc_tpu import rpc
+
+
+class PsShardServer:
+    """One embedding shard behind a native RPC server."""
+
+    def __init__(self, vocab: int, dim: int, shard_index: int,
+                 num_shards: int, lr: float = 0.1, seed: int = 0):
+        if vocab % num_shards:
+            raise ValueError("vocab must divide num_shards")
+        self.rows_per = vocab // num_shards
+        self.base = shard_index * self.rows_per
+        self.dim = dim
+        self.lr = lr
+        rng = np.random.default_rng(seed + shard_index)
+        self.table = (rng.standard_normal((self.rows_per, dim)) * 0.02
+                      ).astype(np.float32)
+        self.server = rpc.Server()
+        self.server.add_service("Ps", self._handle)
+        self.port = self.server.start("127.0.0.1:0")
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def _handle(self, method: str, payload: bytes) -> bytes:
+        (count,) = struct.unpack_from("<i", payload, 0)
+        ids = np.frombuffer(payload, np.int32, count, 4) - self.base
+        if method == "Lookup":
+            return self.table[ids].tobytes()
+        if method == "ApplyGrad":
+            grads = np.frombuffer(payload, np.float32,
+                                  count * self.dim, 4 + 4 * count)
+            np.subtract.at(self.table, ids,
+                           self.lr * grads.reshape(count, self.dim))
+            return b""
+        raise ValueError(f"unknown method {method}")
+
+    def close(self):
+        self.server.close()
+
+
+class RemoteEmbedding:
+    """Client view of a sharded remote table (owner-routed access)."""
+
+    def __init__(self, addresses: Sequence[str], vocab: int, dim: int,
+                 timeout_ms: int = 2000):
+        self.vocab = vocab
+        self.dim = dim
+        self.n = len(addresses)
+        self.rows_per = vocab // self.n
+        self.channels: List[rpc.Channel] = [
+            rpc.Channel(a, timeout_ms=timeout_ms) for a in addresses
+        ]
+
+    def _owner_split(self, flat_ids: np.ndarray):
+        owners = flat_ids // self.rows_per
+        for s in range(self.n):
+            mask = owners == s
+            if mask.any():
+                yield s, np.nonzero(mask)[0], flat_ids[mask]
+
+    def lookup(self, ids: np.ndarray) -> np.ndarray:
+        flat = np.asarray(ids, np.int32).reshape(-1)
+        out = np.empty((flat.size, self.dim), np.float32)
+        for s, positions, owned in self._owner_split(flat):
+            req = struct.pack("<i", owned.size) + owned.tobytes()
+            rsp = self.channels[s].call("Ps", "Lookup", req)
+            out[positions] = np.frombuffer(rsp, np.float32).reshape(
+                owned.size, self.dim)
+        return out.reshape(*np.shape(ids), self.dim)
+
+    def apply_gradients(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        flat = np.asarray(ids, np.int32).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
+        for s, positions, owned in self._owner_split(flat):
+            req = (struct.pack("<i", owned.size) + owned.tobytes() +
+                   g[positions].tobytes())
+            self.channels[s].call("Ps", "ApplyGrad", req)
+
+    def close(self):
+        for c in self.channels:
+            c.close()
