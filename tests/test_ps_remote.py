@@ -44,8 +44,9 @@ def test_remote_training_converges(cluster):
     @jax.jit
     def loss_and_grad(rows, tgt):
         loss = jnp.mean((rows - tgt) ** 2)
+        # sum-loss gradient: per-row step size independent of batch size
         return loss, jax.grad(
-            lambda r: jnp.mean((r - tgt) ** 2))(rows)
+            lambda r: 0.5 * jnp.sum((r - tgt) ** 2))(rows)
 
     losses = []
     for _ in range(25):
