@@ -1,0 +1,231 @@
+#include "rpc/memcache.h"
+
+#include <cstring>
+#include <deque>
+#include <mutex>
+
+#include "base/iobuf.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+namespace {
+
+enum Opcode : uint8_t {
+  OP_GET = 0x00,
+  OP_SET = 0x01,
+  OP_ADD = 0x02,
+  OP_DELETE = 0x04,
+  OP_INCR = 0x05,
+  OP_VERSION = 0x0b,
+};
+
+#pragma pack(push, 1)
+struct Header {
+  uint8_t magic;
+  uint8_t opcode;
+  uint16_t key_len;     // network order
+  uint8_t extras_len;
+  uint8_t data_type;
+  uint16_t status;      // network order (rsp) / vbucket (req)
+  uint32_t body_len;    // network order
+  uint32_t opaque;
+  uint64_t cas;
+};
+#pragma pack(pop)
+static_assert(sizeof(Header) == 24);
+
+void PackRequest(IOBuf* out, uint8_t opcode, const std::string& key,
+                 const std::string& extras, const std::string& value) {
+  Header h{};
+  h.magic = 0x80;
+  h.opcode = opcode;
+  h.key_len = htons(uint16_t(key.size()));
+  h.extras_len = uint8_t(extras.size());
+  h.body_len = htonl(uint32_t(extras.size() + key.size() + value.size()));
+  out->append(&h, sizeof(h));
+  out->append(extras);
+  out->append(key);
+  out->append(value);
+}
+
+}  // namespace
+
+struct MemcacheClient::Impl {
+  SocketId sock = INVALID_SOCKET_ID;
+  std::mutex mu;
+  IOPortal inbuf;
+  struct Waiter {
+    MemcacheResult* out;
+    CountdownEvent ev{1};
+    int rc = 0;
+  };
+  std::deque<Waiter*> waiters;
+  int64_t timeout_us = 1000000;
+
+  static void OnData(Socket* s);
+  void Fail(int err);
+
+  MemcacheResult Roundtrip(IOBuf* frame);
+};
+
+void MemcacheClient::Impl::OnData(Socket* s) {
+  auto* impl = static_cast<MemcacheClient::Impl*>(s->user());
+  for (;;) {
+    ssize_t nr = impl->inbuf.append_from_fd(s->fd());
+    if (nr == 0) {
+      s->SetFailed(ECONNRESET, "memcache server closed");
+      impl->Fail(ECONNRESET);
+      return;
+    }
+    if (nr < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      s->SetFailed(errno, "memcache read failed");
+      impl->Fail(errno);
+      return;
+    }
+  }
+  for (;;) {
+    std::lock_guard<std::mutex> g(impl->mu);
+    if (impl->waiters.empty()) break;
+    Header h;
+    if (impl->inbuf.copy_to(&h, sizeof(h)) < sizeof(h)) break;
+    const uint32_t body = ntohl(h.body_len);
+    if (impl->inbuf.size() < sizeof(h) + body) break;
+    impl->inbuf.pop_front(sizeof(h));
+    std::string payload;
+    impl->inbuf.cutn(&payload, body);
+    Waiter* w = impl->waiters.front();
+    impl->waiters.pop_front();
+    if (h.magic == 0x81) {
+      w->out->status = ntohs(h.status);
+      w->out->cas = h.cas;
+      const size_t skip = h.extras_len + ntohs(h.key_len);
+      if (payload.size() >= skip) w->out->value = payload.substr(skip);
+    } else {
+      w->rc = EBADMSG;
+    }
+    w->ev.signal();
+  }
+}
+
+void MemcacheClient::Impl::Fail(int err) {
+  std::lock_guard<std::mutex> g(mu);
+  while (!waiters.empty()) {
+    Waiter* w = waiters.front();
+    waiters.pop_front();
+    w->rc = err;
+    w->ev.signal();
+  }
+}
+
+MemcacheResult MemcacheClient::Impl::Roundtrip(IOBuf* frame) {
+  MemcacheResult result;
+  SocketUniquePtr p;
+  if (Socket::Address(sock, &p) != 0 || p->Failed()) {
+    result.status = 0xffff;
+    return result;
+  }
+  Waiter waiter;
+  waiter.out = &result;
+  {
+    std::lock_guard<std::mutex> g(mu);
+    waiters.push_back(&waiter);
+  }
+  p->Write(frame);
+  if (waiter.ev.wait(timeout_us) != 0) {
+    p->SetFailed(ETIMEDOUT, "memcache reply timeout");
+    Fail(ETIMEDOUT);
+    waiter.ev.wait(-1);
+    result.status = 0xffff;
+    return result;
+  }
+  if (waiter.rc != 0) result.status = 0xffff;
+  return result;
+}
+
+MemcacheClient::MemcacheClient() : impl_(new Impl) {}
+
+MemcacheClient::~MemcacheClient() {
+  if (impl_->sock != INVALID_SOCKET_ID) {
+    SocketUniquePtr p;
+    if (Socket::Address(impl_->sock, &p) == 0) {
+      p->SetFailed(ECANCELED, "client closed");
+    }
+  }
+}
+
+int MemcacheClient::Init(const std::string& addr, int64_t timeout_ms) {
+  EndPoint ep;
+  if (!EndPoint::parse(addr, &ep)) return EINVAL;
+  return Init(ep, timeout_ms);
+}
+
+int MemcacheClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  fiber_init(0);
+  impl_->timeout_us = timeout_ms * 1000;
+  Socket::Options opts;
+  opts.user = impl_.get();
+  opts.on_edge_triggered = Impl::OnData;
+  return Socket::Connect(server, opts, &impl_->sock, impl_->timeout_us);
+}
+
+MemcacheResult MemcacheClient::Get(const std::string& key) {
+  IOBuf f;
+  PackRequest(&f, OP_GET, key, "", "");
+  return impl_->Roundtrip(&f);
+}
+
+MemcacheResult MemcacheClient::Set(const std::string& key,
+                                   const std::string& value, uint32_t flags,
+                                   uint32_t exptime) {
+  char extras[8];
+  uint32_t nf = htonl(flags), ne = htonl(exptime);
+  memcpy(extras, &nf, 4);
+  memcpy(extras + 4, &ne, 4);
+  IOBuf f;
+  PackRequest(&f, OP_SET, key, std::string(extras, 8), value);
+  return impl_->Roundtrip(&f);
+}
+
+MemcacheResult MemcacheClient::Add(const std::string& key,
+                                   const std::string& value, uint32_t flags,
+                                   uint32_t exptime) {
+  char extras[8];
+  uint32_t nf = htonl(flags), ne = htonl(exptime);
+  memcpy(extras, &nf, 4);
+  memcpy(extras + 4, &ne, 4);
+  IOBuf f;
+  PackRequest(&f, OP_ADD, key, std::string(extras, 8), value);
+  return impl_->Roundtrip(&f);
+}
+
+MemcacheResult MemcacheClient::Delete(const std::string& key) {
+  IOBuf f;
+  PackRequest(&f, OP_DELETE, key, "", "");
+  return impl_->Roundtrip(&f);
+}
+
+MemcacheResult MemcacheClient::Incr(const std::string& key, uint64_t delta,
+                                    uint64_t initial) {
+  char extras[20];
+  uint64_t nd = htobe64(delta), ni = htobe64(initial);
+  uint32_t ne = htonl(0);
+  memcpy(extras, &nd, 8);
+  memcpy(extras + 8, &ni, 8);
+  memcpy(extras + 16, &ne, 4);
+  IOBuf f;
+  PackRequest(&f, OP_INCR, key, std::string(extras, 20), "");
+  return impl_->Roundtrip(&f);
+}
+
+MemcacheResult MemcacheClient::Version() {
+  IOBuf f;
+  PackRequest(&f, OP_VERSION, "", "", "");
+  return impl_->Roundtrip(&f);
+}
+
+}  // namespace brt

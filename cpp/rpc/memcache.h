@@ -1,0 +1,46 @@
+// Memcache binary-protocol client, pipelined over one connection.
+// Parity target: reference src/brpc/memcache.{h,cpp} +
+// policy/memcache_binary_protocol.cpp (client side; pipelined like redis).
+// Wire: 24-byte binary header (magic 0x80 req / 0x81 rsp), opcodes
+// GET/SET/DELETE/INCR/ADD/REPLACE/VERSION.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "base/endpoint.h"
+
+namespace brt {
+
+struct MemcacheResult {
+  uint16_t status = 0;  // 0 = OK, 1 = key not found, ...
+  std::string value;    // GET payload
+  uint64_t cas = 0;
+  bool ok() const { return status == 0; }
+  bool not_found() const { return status == 1; }
+};
+
+class MemcacheClient {
+ public:
+  MemcacheClient();
+  ~MemcacheClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  int Init(const std::string& addr, int64_t timeout_ms = 1000);
+
+  MemcacheResult Get(const std::string& key);
+  MemcacheResult Set(const std::string& key, const std::string& value,
+                     uint32_t flags = 0, uint32_t exptime = 0);
+  MemcacheResult Add(const std::string& key, const std::string& value,
+                     uint32_t flags = 0, uint32_t exptime = 0);
+  MemcacheResult Delete(const std::string& key);
+  MemcacheResult Incr(const std::string& key, uint64_t delta,
+                      uint64_t initial = 0);
+  MemcacheResult Version();
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace brt

@@ -1,0 +1,290 @@
+#include "rpc/thrift.h"
+
+#include <arpa/inet.h>
+
+#include <cstring>
+#include <deque>
+#include <mutex>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/server.h"
+#include "transport/input_messenger.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+namespace {
+
+constexpr uint32_t kVersionMask = 0xffff0000;
+constexpr uint32_t kVersion1 = 0x80010000;
+enum MsgType : uint32_t { T_CALL = 1, T_REPLY = 2, T_EXCEPTION = 3 };
+
+void put_u32(std::string* out, uint32_t v) {
+  uint32_t n = htonl(v);
+  out->append(reinterpret_cast<char*>(&n), 4);
+}
+
+// TMessage header: i32 version|type, string name, i32 seqid.
+void PackMessage(IOBuf* out, uint32_t type, const std::string& method,
+                 uint32_t seqid, const IOBuf& payload) {
+  std::string head;
+  put_u32(&head, kVersion1 | type);
+  put_u32(&head, uint32_t(method.size()));
+  head += method;
+  put_u32(&head, seqid);
+  std::string frame_len;
+  put_u32(&frame_len, uint32_t(head.size() + payload.size()));
+  out->append(frame_len);
+  out->append(head);
+  out->append(payload);
+}
+
+// Parses a framed message. Returns 0/EAGAIN/EBADMSG.
+int ParseMessage(IOBuf* in, uint32_t* type, std::string* method,
+                 uint32_t* seqid, IOBuf* payload) {
+  if (in->size() < 4) return EAGAIN;
+  uint32_t flen = 0;
+  in->copy_to(&flen, 4);
+  flen = ntohl(flen);
+  if (flen > (64u << 20) || flen < 12) return EBADMSG;
+  if (in->size() < 4 + flen) return EAGAIN;
+  in->pop_front(4);
+  std::string head;
+  in->cutn(&head, 8);  // version|type (4) + name length (4)
+  uint32_t vt, nlen;
+  memcpy(&vt, head.data(), 4);
+  memcpy(&nlen, head.data() + 4, 4);
+  vt = ntohl(vt);
+  nlen = ntohl(nlen);
+  if ((vt & kVersionMask) != kVersion1 || nlen > flen - 12) {
+    in->pop_front(flen - 8);
+    return EBADMSG;
+  }
+  *type = vt & 0xff;
+  std::string rest;
+  in->cutn(&rest, nlen + 4);
+  *method = rest.substr(0, nlen);
+  uint32_t sid;
+  memcpy(&sid, rest.data() + nlen, 4);
+  *seqid = ntohl(sid);
+  in->cutn(payload, flen - 12 - nlen);
+  return 0;
+}
+
+// TApplicationException result struct: field 1 (string message), field 2
+// (i32 type), stop.
+void PackException(IOBuf* out, const std::string& message) {
+  std::string s;
+  s.push_back(11);  // TType STRING
+  s.push_back(0);
+  s.push_back(1);   // field id 1
+  put_u32(&s, uint32_t(message.size()));
+  s += message;
+  s.push_back(8);   // TType I32
+  s.push_back(0);
+  s.push_back(2);   // field id 2
+  put_u32(&s, 6);   // INTERNAL_ERROR
+  s.push_back(0);   // STOP
+  out->append(s);
+}
+
+// ---- server ----
+
+std::mutex g_thrift_mu;
+std::map<Server*, ThriftService*>& thrift_map() {
+  static auto* m = new std::map<Server*, ThriftService*>();
+  return *m;
+}
+
+ThriftService* GetThriftService(Server* server) {
+  std::lock_guard<std::mutex> g(g_thrift_mu);
+  auto it = thrift_map().find(server);
+  return it == thrift_map().end() ? nullptr : it->second;
+}
+
+ParseResult ThriftParse(IOBuf* source, IOBuf* msg, Socket* s) {
+  // framed: [len:4][0x80 0x01 ...]: check version bytes at offset 4..5
+  char probe[6];
+  if (source->copy_to(probe, 6) < 6) return ParseResult::NOT_ENOUGH_DATA;
+  if (uint8_t(probe[4]) != 0x80 || uint8_t(probe[5]) != 0x01) {
+    return ParseResult::TRY_OTHER;
+  }
+  auto* server = static_cast<Server*>(s->user());
+  if (server == nullptr || GetThriftService(server) == nullptr) {
+    return ParseResult::TRY_OTHER;
+  }
+  uint32_t flen = 0;
+  source->copy_to(&flen, 4);
+  flen = ntohl(flen);
+  if (flen > (64u << 20)) return ParseResult::ERROR;
+  if (source->size() < 4 + size_t(flen)) return ParseResult::NOT_ENOUGH_DATA;
+  source->cutn(msg, 4 + flen);
+  return ParseResult::OK;
+}
+
+void ThriftProcess(IOBuf&& msg, SocketId sid) {
+  SocketUniquePtr ptr;
+  if (Socket::Address(sid, &ptr) != 0) return;
+  auto* server = static_cast<Server*>(ptr->user());
+  ThriftService* svc = server ? GetThriftService(server) : nullptr;
+  uint32_t type = 0, seqid = 0;
+  std::string method;
+  IOBuf args;
+  if (ParseMessage(&msg, &type, &method, &seqid, &args) != 0 ||
+      type != T_CALL || svc == nullptr) {
+    ptr->SetFailed(EBADMSG, "bad thrift call");
+    return;
+  }
+  IOBuf result, out;
+  if (svc->Dispatch(method, args, &result)) {
+    PackMessage(&out, T_REPLY, method, seqid, result);
+  } else {
+    IOBuf exc;
+    PackException(&exc, "handler failed for " + method);
+    PackMessage(&out, T_EXCEPTION, method, seqid, exc);
+  }
+  ptr->Write(&out);
+}
+
+}  // namespace
+
+void ServeThriftOn(Server* server, ThriftService* service) {
+  {
+    std::lock_guard<std::mutex> g(g_thrift_mu);
+    thrift_map()[server] = service;
+  }
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.name = "thrift";
+    p.parse = ThriftParse;
+    p.process = ThriftProcess;
+    RegisterProtocol(p);
+  });
+}
+
+// ---- client ----
+
+struct ThriftClient::Impl {
+  SocketId sock = INVALID_SOCKET_ID;
+  std::mutex mu;
+  IOPortal inbuf;
+  struct Waiter {
+    ThriftReply* out;
+    CountdownEvent ev{1};
+  };
+  std::deque<Waiter*> waiters;  // FIFO (seqid monotonic on one connection)
+  uint32_t next_seqid = 1;
+  int64_t timeout_us = 1000000;
+
+  static void OnData(Socket* s);
+  void Fail(const char* what);
+};
+
+void ThriftClient::Impl::OnData(Socket* s) {
+  auto* impl = static_cast<ThriftClient::Impl*>(s->user());
+  for (;;) {
+    ssize_t nr = impl->inbuf.append_from_fd(s->fd());
+    if (nr == 0) {
+      s->SetFailed(ECONNRESET, "thrift server closed");
+      impl->Fail("connection closed");
+      return;
+    }
+    if (nr < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      s->SetFailed(errno, "thrift read failed");
+      impl->Fail("read failed");
+      return;
+    }
+  }
+  for (;;) {
+    std::lock_guard<std::mutex> g(impl->mu);
+    if (impl->waiters.empty()) break;
+    uint32_t type = 0, seqid = 0;
+    std::string method;
+    IOBuf payload;
+    int rc = ParseMessage(&impl->inbuf, &type, &method, &seqid, &payload);
+    if (rc == EAGAIN) break;
+    Impl::Waiter* w = impl->waiters.front();
+    impl->waiters.pop_front();
+    if (rc == 0 && type == T_REPLY) {
+      w->out->ok = true;
+      w->out->result = std::move(payload);
+    } else if (rc == 0 && type == T_EXCEPTION) {
+      w->out->error = "remote exception";
+    } else {
+      w->out->error = "protocol error";
+    }
+    w->ev.signal();
+    if (rc != 0) break;
+  }
+}
+
+void ThriftClient::Impl::Fail(const char* what) {
+  std::lock_guard<std::mutex> g(mu);
+  while (!waiters.empty()) {
+    Waiter* w = waiters.front();
+    waiters.pop_front();
+    w->out->error = what;
+    w->ev.signal();
+  }
+}
+
+ThriftClient::ThriftClient() : impl_(new Impl) {}
+
+ThriftClient::~ThriftClient() {
+  if (impl_->sock != INVALID_SOCKET_ID) {
+    SocketUniquePtr p;
+    if (Socket::Address(impl_->sock, &p) == 0) {
+      p->SetFailed(ECANCELED, "client closed");
+    }
+  }
+}
+
+int ThriftClient::Init(const std::string& addr, int64_t timeout_ms) {
+  EndPoint ep;
+  if (!EndPoint::parse(addr, &ep)) return EINVAL;
+  return Init(ep, timeout_ms);
+}
+
+int ThriftClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  fiber_init(0);
+  impl_->timeout_us = timeout_ms * 1000;
+  Socket::Options opts;
+  opts.user = impl_.get();
+  opts.on_edge_triggered = Impl::OnData;
+  return Socket::Connect(server, opts, &impl_->sock, impl_->timeout_us);
+}
+
+ThriftReply ThriftClient::Call(const std::string& method, const IOBuf& args) {
+  ThriftReply reply;
+  SocketUniquePtr p;
+  if (Socket::Address(impl_->sock, &p) != 0 || p->Failed()) {
+    reply.error = "connection lost";
+    return reply;
+  }
+  IOBuf frame;
+  uint32_t seqid;
+  Impl::Waiter waiter;
+  waiter.out = &reply;
+  {
+    std::lock_guard<std::mutex> g(impl_->mu);
+    seqid = impl_->next_seqid++;
+    impl_->waiters.push_back(&waiter);
+  }
+  PackMessage(&frame, T_CALL, method, seqid, args);
+  p->Write(&frame);
+  if (waiter.ev.wait(impl_->timeout_us) != 0) {
+    p->SetFailed(ETIMEDOUT, "thrift reply timeout");
+    impl_->Fail("timeout");
+    waiter.ev.wait(-1);
+    reply.ok = false;
+    reply.error = "timeout";
+    return reply;
+  }
+  return reply;
+}
+
+}  // namespace brt

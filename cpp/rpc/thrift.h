@@ -1,0 +1,63 @@
+// Thrift framed-binary protocol: server adaptor sharing the RPC port +
+// pipelined client. Parity target: reference policy/thrift_protocol.cpp
+// (766 LoC) + thrift_service.h (native server adaptor).
+// Scope: the TMessage envelope (framed transport, strict binary header:
+// version|type, method, seqid) is parsed/built here; the args/result
+// STRUCT payload passes through as raw bytes, so apps using real thrift
+// IDL serializers interoperate while the framework stays IDL-free.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+
+namespace brt {
+
+class Server;
+
+// Handler receives the raw args-struct bytes, returns raw result-struct
+// bytes (thrift-encoded by the app). Throwing semantics: return false to
+// send a TApplicationException envelope.
+class ThriftService {
+ public:
+  using Handler = std::function<bool(const std::string& method,
+                                     const IOBuf& args, IOBuf* result)>;
+  explicit ThriftService(Handler h) : handler_(std::move(h)) {}
+  bool Dispatch(const std::string& method, const IOBuf& args,
+                IOBuf* result) const {
+    return handler_(method, args, result);
+  }
+
+ private:
+  Handler handler_;
+};
+
+// Attach BEFORE Server::Start.
+void ServeThriftOn(Server* server, ThriftService* service);
+
+struct ThriftReply {
+  bool ok = false;
+  IOBuf result;  // raw result-struct bytes
+  std::string error;
+};
+
+class ThriftClient {
+ public:
+  ThriftClient();
+  ~ThriftClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  int Init(const std::string& addr, int64_t timeout_ms = 1000);
+
+  ThriftReply Call(const std::string& method, const IOBuf& args);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace brt

@@ -1,0 +1,163 @@
+// Memcache binary-protocol client test against a minimal in-test server
+// (reference model: test/brpc_memcache_unittest.cpp crafts wire bytes; here
+// a loopback server speaks enough of the binary protocol for the client).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <thread>
+
+#include "fiber/fiber.h"
+#include "rpc/memcache.h"
+
+using namespace brt;
+
+namespace {
+
+#pragma pack(push, 1)
+struct Hdr {
+  uint8_t magic, opcode;
+  uint16_t key_len;
+  uint8_t extras_len, data_type;
+  uint16_t status;
+  uint32_t body_len;
+  uint32_t opaque;
+  uint64_t cas;
+};
+#pragma pack(pop)
+
+// Blocking single-connection memcache server (test fixture only).
+void ServeOne(int cfd) {
+  std::map<std::string, std::string> store;
+  std::string buf;
+  char tmp[4096];
+  for (;;) {
+    while (buf.size() < sizeof(Hdr)) {
+      ssize_t n = read(cfd, tmp, sizeof(tmp));
+      if (n <= 0) return;
+      buf.append(tmp, size_t(n));
+    }
+    Hdr h;
+    memcpy(&h, buf.data(), sizeof(h));
+    const uint32_t body = ntohl(h.body_len);
+    while (buf.size() < sizeof(Hdr) + body) {
+      ssize_t n = read(cfd, tmp, sizeof(tmp));
+      if (n <= 0) return;
+      buf.append(tmp, size_t(n));
+    }
+    const std::string payload = buf.substr(sizeof(Hdr), body);
+    buf.erase(0, sizeof(Hdr) + body);
+    const uint16_t klen = ntohs(h.key_len);
+    const std::string key = payload.substr(h.extras_len, klen);
+    const std::string value = payload.substr(h.extras_len + klen);
+
+    Hdr r{};
+    r.magic = 0x81;
+    r.opcode = h.opcode;
+    std::string rbody;
+    switch (h.opcode) {
+      case 0x00:  // GET
+        if (store.count(key)) {
+          rbody = std::string(4, '\0') + store[key];  // flags extras
+          r.extras_len = 4;
+        } else {
+          r.status = htons(1);
+        }
+        break;
+      case 0x01:  // SET
+        store[key] = value;
+        break;
+      case 0x02:  // ADD
+        if (store.count(key)) r.status = htons(2);
+        else store[key] = value;
+        break;
+      case 0x04:  // DELETE
+        if (!store.erase(key)) r.status = htons(1);
+        break;
+      case 0x05: {  // INCR
+        uint64_t delta, initial;
+        memcpy(&delta, payload.data(), 8);
+        memcpy(&initial, payload.data() + 8, 8);
+        delta = be64toh(delta);
+        initial = be64toh(initial);
+        uint64_t v = store.count(key)
+                         ? strtoull(store[key].c_str(), nullptr, 10) + delta
+                         : initial;
+        store[key] = std::to_string(v);
+        uint64_t nv = htobe64(v);
+        rbody.assign(reinterpret_cast<char*>(&nv), 8);
+        break;
+      }
+      case 0x0b:  // VERSION
+        rbody = "1.6.0-test";
+        break;
+      default:
+        r.status = htons(0x81);  // unknown command
+    }
+    r.body_len = htonl(uint32_t(rbody.size()));
+    std::string out(reinterpret_cast<char*>(&r), sizeof(r));
+    out += rbody;
+    if (write(cfd, out.data(), out.size()) != ssize_t(out.size())) return;
+  }
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  assert(bind(lfd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0);
+  assert(listen(lfd, 4) == 0);
+  socklen_t sl = sizeof(sa);
+  getsockname(lfd, reinterpret_cast<sockaddr*>(&sa), &sl);
+  std::thread srv([lfd] {
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd >= 0) {
+      ServeOne(cfd);
+      close(cfd);
+    }
+  });
+
+  {
+  MemcacheClient cli;
+  EndPoint ep(ntohl(sa.sin_addr.s_addr), ntohs(sa.sin_port));
+  assert(cli.Init(ep) == 0);
+
+  assert(cli.Version().value == "1.6.0-test");
+  printf("memcache_version OK\n");
+
+  assert(cli.Set("k1", "v1").ok());
+  MemcacheResult r = cli.Get("k1");
+  assert(r.ok() && r.value == "v1");
+  assert(cli.Get("missing").not_found());
+  printf("memcache_get_set OK\n");
+
+  assert(cli.Add("k1", "other").status == 2);  // exists
+  assert(cli.Add("k2", "v2").ok());
+  printf("memcache_add OK\n");
+
+  r = cli.Incr("counter", 5, 100);
+  assert(r.ok());
+  r = cli.Incr("counter", 5, 0);
+  assert(r.ok());
+  printf("memcache_incr OK\n");
+
+  assert(cli.Delete("k1").ok());
+  assert(cli.Get("k1").not_found());
+  printf("memcache_delete OK\n");
+  }  // client dtor closes the connection → server thread unblocks
+
+  close(lfd);
+  srv.join();
+  printf("ALL memcache tests OK\n");
+  return 0;
+}
