@@ -60,6 +60,10 @@ def _load():
     lib.brt_channel_destroy.argtypes = [ctypes.c_void_p]
     lib.brt_free.argtypes = [ctypes.c_void_p]
     lib.brt_init.argtypes = [ctypes.c_int]
+    lib.brt_event_new.restype = ctypes.c_void_p
+    lib.brt_event_set.argtypes = [ctypes.c_void_p]
+    lib.brt_event_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.brt_event_destroy.argtypes = [ctypes.c_void_p]
     lib.brt_init(0)
     _lib = lib
     return lib
@@ -100,6 +104,38 @@ class Server:
                                         trampoline, None)
         if rc != 0:
             raise RuntimeError(f"add_service failed: {rc}")
+        self._handlers.append(trampoline)
+
+    def add_async_service(self, name: str, handler) -> None:
+        """handler(method: str, request: bytes, respond) — call
+        ``respond(data: bytes)`` or ``respond(error=str)`` EXACTLY once,
+        from any thread, any time (the fiber worker is released
+        immediately — the "enqueue JAX work without blocking workers"
+        shape: dispatch, return, respond from the completion callback)."""
+        lib = self._lib
+
+        @_HANDLER
+        def trampoline(user, method, req, req_len, session):
+            data = ctypes.string_at(req, req_len) if req_len else b""
+            sess = ctypes.c_void_p(session)
+
+            def respond(payload: bytes = b"", error: Optional[str] = None):
+                if error is not None:
+                    lib.brt_session_respond(sess, None, 0, 2001,
+                                            error.encode())
+                else:
+                    lib.brt_session_respond(sess, payload, len(payload), 0,
+                                            None)
+
+            try:
+                handler(method.decode(), data, respond)
+            except Exception as e:  # noqa: BLE001
+                respond(error=str(e))
+
+        rc = lib.brt_server_add_service(self._ptr, name.encode(),
+                                        trampoline, None)
+        if rc != 0:
+            raise RuntimeError(f"add_async_service failed: {rc}")
         self._handlers.append(trampoline)
 
     def start(self, addr: str = "127.0.0.1:0") -> int:

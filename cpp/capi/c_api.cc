@@ -7,6 +7,7 @@
 
 #include "cluster/cluster_channel.h"
 #include "fiber/fiber.h"
+#include "fiber/sync.h"
 #include "rpc/channel.h"
 #include "rpc/server.h"
 
@@ -152,5 +153,23 @@ void brt_channel_destroy(void* channel) {
 }
 
 void brt_free(void* p) { free(p); }
+
+}  // extern "C"
+
+extern "C" {
+
+void* brt_event_new(void) { return new brt::CountdownEvent(1); }
+
+void brt_event_set(void* event) {
+  static_cast<brt::CountdownEvent*>(event)->signal();
+}
+
+int brt_event_wait(void* event, int64_t timeout_us) {
+  return static_cast<brt::CountdownEvent*>(event)->wait(timeout_us);
+}
+
+void brt_event_destroy(void* event) {
+  delete static_cast<brt::CountdownEvent*>(event);
+}
 
 }  // extern "C"

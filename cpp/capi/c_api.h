@@ -51,6 +51,18 @@ void brt_free(void* p);
 // ---- runtime ----
 void brt_init(int fiber_workers);
 
+// ---- fiber events (the "yield on TPU stream events" bridge) ----
+// A native fiber can wait without blocking its worker pthread while any
+// thread (e.g. a JAX async-dispatch completion callback in Python) sets
+// the event. This is the bthread↔TPU-stream analog of the BASELINE north
+// star ("async RPC handlers enqueue JAX/XLA computations without blocking
+// workers").
+void* brt_event_new(void);
+void brt_event_set(void* event);
+// Returns 0 (set) or ETIMEDOUT. timeout_us < 0 = forever.
+int brt_event_wait(void* event, int64_t timeout_us);
+void brt_event_destroy(void* event);
+
 #ifdef __cplusplus
 }
 #endif

@@ -64,3 +64,33 @@ def test_unknown_service(server):
     with pytest.raises(rpc.RpcError):
         ch.call("Ghost", "Echo")
     ch.close()
+
+
+def test_async_handler_jax_completion():
+    """The north-star shape: the handler enqueues device work and returns;
+    a completion thread responds — fiber workers never block on compute."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    srv = rpc.Server()
+
+    def async_matmul(method, request, respond):
+        arr = np.frombuffer(request, np.float32).reshape(16, 16)
+
+        def completion():
+            out = jax.jit(lambda a: a @ a)(jnp.asarray(arr))
+            respond(np.asarray(out).tobytes())
+
+        threading.Thread(target=completion).start()  # handler returns NOW
+
+    srv.add_async_service("Compute", async_matmul)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=10000)
+    a = np.arange(256, dtype=np.float32).reshape(16, 16) / 256.0
+    out = np.frombuffer(ch.call("Compute", "MatMul", a.tobytes()),
+                        np.float32).reshape(16, 16)
+    np.testing.assert_allclose(out, a @ a, rtol=1e-5)
+    ch.close()
+    srv.close()
