@@ -63,6 +63,12 @@ class CircuitBreaker {
     samples_.store(0, std::memory_order_relaxed);
   }
 
+  // Health-check prober verified the node is reachable: lift isolation now
+  // (reference HealthCheckTask revival, details/health_check.cpp:146).
+  void Revive() {
+    isolation_until_us_.store(0, std::memory_order_release);
+  }
+
   // Successful traffic after recovery decays the isolation backoff.
   void OnRecoveredSuccess() {
     int c = isolation_count_.load(std::memory_order_relaxed);

@@ -14,7 +14,45 @@ inline uint64_t ep_key(const EndPoint& ep) {
 }  // namespace
 
 ClusterChannel::~ClusterChannel() {
+  if (prober_) {
+    fiber_stop(prober_);
+    fiber_join(prober_);
+    prober_ = 0;
+  }
   if (ns_) ns_->Stop();
+}
+
+// Active revival: while a node is isolated, periodically try a bare TCP
+// connect; success lifts the isolation immediately instead of waiting out
+// the exponential backoff (reference details/health_check.cpp:42-157).
+void* ClusterChannel::ProberEntry(void* arg) {
+  auto* self = static_cast<ClusterChannel*>(arg);
+  const int64_t interval_us =
+      self->options_.health_check_interval_ms * 1000;
+  while (fiber_usleep(interval_us) == 0) {
+    std::vector<std::pair<EndPoint, std::shared_ptr<CircuitBreaker>>> iso;
+    {
+      std::lock_guard<std::mutex> g(self->nodes_mu_);
+      for (const ServerNode& n : self->nodes_) {
+        auto it = self->breakers_.find(ep_key(n.ep));
+        if (it != self->breakers_.end() && it->second->isolated()) {
+          iso.emplace_back(n.ep, it->second);
+        }
+      }
+    }
+    for (auto& [ep, breaker] : iso) {
+      Socket::Options sopts;  // bare probe: no messenger callbacks
+      SocketId sid = INVALID_SOCKET_ID;
+      if (Socket::Connect(ep, sopts, &sid, 500 * 1000) == 0) {
+        breaker->Revive();
+        SocketUniquePtr p;
+        if (Socket::Address(sid, &p) == 0) {
+          p->SetFailed(ECANCELED, "health probe done");
+        }
+      }
+    }
+  }
+  return nullptr;
 }
 
 int ClusterChannel::Init(const std::string& ns_url, const std::string& lb_name,
@@ -27,6 +65,9 @@ int ClusterChannel::Init(const std::string& ns_url, const std::string& lb_name,
   if (!ns_) {
     inited_ = false;
     return EINVAL;
+  }
+  if (options_.health_check_interval_ms > 0) {
+    fiber_start(&prober_, ProberEntry, this);
   }
   return 0;
 }

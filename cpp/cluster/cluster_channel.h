@@ -15,6 +15,7 @@
 #include "cluster/circuit_breaker.h"
 #include "cluster/load_balancer.h"
 #include "cluster/naming_service.h"
+#include "fiber/fiber.h"
 #include "rpc/channel.h"
 
 namespace brt {
@@ -43,6 +44,7 @@ class ClusterChannel : public Channel {
 
  private:
   static void OnCallEnd(Controller* cntl, void* arg);
+  static void* ProberEntry(void* arg);
   std::shared_ptr<CircuitBreaker> GetBreaker(const EndPoint& ep);
 
   std::unique_ptr<NamingService> ns_;
@@ -50,6 +52,7 @@ class ClusterChannel : public Channel {
   mutable std::mutex nodes_mu_;
   std::vector<ServerNode> nodes_;  // last pushed list
   std::unordered_map<uint64_t, std::shared_ptr<CircuitBreaker>> breakers_;
+  fiber_t prober_ = 0;
 };
 
 }  // namespace brt

@@ -22,6 +22,10 @@ struct ChannelOptions {
   // groups get private multiplexed connections (the reference's
   // ChannelSignature role in SocketMap keys).
   int connection_group = 0;
+  // Cluster channels: probe isolated nodes every interval and lift their
+  // isolation when TCP comes back (reference FLAGS_health_check_interval +
+  // HealthCheckTask). <=0 disables active probing.
+  int64_t health_check_interval_ms = 3000;
 };
 
 // Anything callable like a channel: plain Channel, ClusterChannel, and the
