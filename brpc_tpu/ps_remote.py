@@ -29,7 +29,7 @@ class PsShardServer:
     def __init__(self, vocab: int, dim: int, shard_index: int,
                  num_shards: int, lr: float = 0.1, seed: int = 0):
         if vocab % num_shards:
-            raise ValueError("vocab must divide num_shards")
+            raise ValueError("num_shards must divide vocab")
         self.rows_per = vocab // num_shards
         self.base = shard_index * self.rows_per
         self.dim = dim
@@ -48,6 +48,12 @@ class PsShardServer:
     def _handle(self, method: str, payload: bytes) -> bytes:
         (count,) = struct.unpack_from("<i", payload, 0)
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
+        if ids.size and (ids.min() < 0 or ids.max() >= self.rows_per):
+            # Out-of-range ids would wrap to wrong rows via negative indexing.
+            raise ValueError(
+                f"ids outside shard [{self.base}, "
+                f"{self.base + self.rows_per}) for shard base {self.base}"
+            )
         if method == "Lookup":
             return self.table[ids].tobytes()
         if method == "ApplyGrad":
@@ -76,6 +82,14 @@ class RemoteEmbedding:
         ]
 
     def _owner_split(self, flat_ids: np.ndarray):
+        if flat_ids.size and (flat_ids.min() < 0
+                              or flat_ids.max() >= self.vocab):
+            # An out-of-range id matches no shard: lookup() would otherwise
+            # return uninitialized rows for it.
+            raise ValueError(
+                f"ids must be in [0, {self.vocab}); got "
+                f"[{flat_ids.min()}, {flat_ids.max()}]"
+            )
         owners = flat_ids // self.rows_per
         for s in range(self.n):
             mask = owners == s

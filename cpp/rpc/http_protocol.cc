@@ -34,7 +34,13 @@ bool LooksLikeHttp(const char* p, size_t n) {
   return false;
 }
 
+// Max body accepted before the parse fails the connection (vs buffering an
+// attacker-supplied Content-Length unboundedly).
+constexpr int64_t kMaxHttpBody = 64ll << 20;
+
 // Finds header end; returns content-length via *body_len (0 if absent).
+// Returns -2 on an invalid/oversized Content-Length, -1 if headers are
+// incomplete.
 ssize_t FindHeaderEnd(const std::string& s, size_t* body_len) {
   size_t pos = s.find("\r\n\r\n");
   if (pos == std::string::npos) return -1;
@@ -47,7 +53,15 @@ ssize_t FindHeaderEnd(const std::string& s, size_t* body_len) {
     std::string lower = h;
     std::transform(lower.begin(), lower.end(), lower.begin(), ::tolower);
     if (lower.rfind("content-length:", 0) == 0) {
-      *body_len = size_t(atoll(h.c_str() + 15));
+      errno = 0;
+      char* end = nullptr;
+      long long v = strtoll(h.c_str() + 15, &end, 10);
+      while (end && (*end == ' ' || *end == '\t')) ++end;
+      if (errno != 0 || end == h.c_str() + 15 || *end != '\0' || v < 0 ||
+          v > kMaxHttpBody) {
+        return -2;
+      }
+      *body_len = size_t(v);
     }
     line = next;
   }
@@ -65,6 +79,7 @@ ParseResult HttpParse(IOBuf* source, IOBuf* msg, Socket*) {
   source->copy_to(&head, std::min<size_t>(source->size(), 64 * 1024));
   size_t body_len = 0;
   ssize_t hdr_end = FindHeaderEnd(head, &body_len);
+  if (hdr_end == -2) return ParseResult::ERROR;
   if (hdr_end < 0) {
     return source->size() >= 64 * 1024 ? ParseResult::ERROR
                                        : ParseResult::NOT_ENOUGH_DATA;

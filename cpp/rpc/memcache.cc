@@ -89,26 +89,34 @@ void MemcacheClient::Impl::OnData(Socket* s) {
     }
   }
   for (;;) {
-    std::lock_guard<std::mutex> g(impl->mu);
-    if (impl->waiters.empty()) break;
-    Header h;
-    if (impl->inbuf.copy_to(&h, sizeof(h)) < sizeof(h)) break;
-    const uint32_t body = ntohl(h.body_len);
-    if (impl->inbuf.size() < sizeof(h) + body) break;
-    impl->inbuf.pop_front(sizeof(h));
-    std::string payload;
-    impl->inbuf.cutn(&payload, body);
-    Waiter* w = impl->waiters.front();
-    impl->waiters.pop_front();
-    if (h.magic == 0x81) {
-      w->out->status = ntohs(h.status);
-      w->out->cas = h.cas;
-      const size_t skip = h.extras_len + ntohs(h.key_len);
-      if (payload.size() >= skip) w->out->value = payload.substr(skip);
-    } else {
-      w->rc = EBADMSG;
+    bool bad = false;
+    {
+      std::lock_guard<std::mutex> g(impl->mu);
+      if (impl->waiters.empty()) break;
+      Header h;
+      if (impl->inbuf.copy_to(&h, sizeof(h)) < sizeof(h)) break;
+      const uint32_t body = ntohl(h.body_len);
+      if (h.magic != 0x81 || body > (64u << 20)) {
+        bad = true;  // desynchronized stream; fail below outside the lock
+      } else {
+        if (impl->inbuf.size() < sizeof(h) + body) break;
+        impl->inbuf.pop_front(sizeof(h));
+        std::string payload;
+        impl->inbuf.cutn(&payload, body);
+        Waiter* w = impl->waiters.front();
+        impl->waiters.pop_front();
+        w->out->status = ntohs(h.status);
+        w->out->cas = be64toh(h.cas);
+        const size_t skip = h.extras_len + ntohs(h.key_len);
+        if (payload.size() >= skip) w->out->value = payload.substr(skip);
+        w->ev.signal();
+      }
     }
-    w->ev.signal();
+    if (bad) {
+      s->SetFailed(EBADMSG, "memcache reply desynchronized");
+      impl->Fail(EBADMSG);
+      return;
+    }
   }
 }
 
@@ -132,10 +140,12 @@ MemcacheResult MemcacheClient::Impl::Roundtrip(IOBuf* frame) {
   Waiter waiter;
   waiter.out = &result;
   {
+    // Write under the lock that orders the waiter FIFO so enqueue order
+    // equals wire order under concurrent callers.
     std::lock_guard<std::mutex> g(mu);
     waiters.push_back(&waiter);
+    p->Write(frame);
   }
-  p->Write(frame);
   if (waiter.ev.wait(timeout_us) != 0) {
     p->SetFailed(ETIMEDOUT, "memcache reply timeout");
     Fail(ETIMEDOUT);

@@ -52,6 +52,23 @@ bool GetLine(const std::string& text, size_t* pos, std::string* line) {
   return true;
 }
 
+// Strict signed-integer parse: the whole string must be a valid number
+// (atol would silently map garbage length fields to 0 and desync the cursor).
+bool ParseI64(const std::string& s, int64_t* out) {
+  if (s.empty()) return false;
+  errno = 0;
+  char* end = nullptr;
+  long long v = strtoll(s.c_str(), &end, 10);
+  if (errno != 0 || end != s.c_str() + s.size()) return false;
+  *out = v;
+  return true;
+}
+
+// Payload of a bulk string must be followed by CRLF exactly.
+bool HasCrlfAt(const std::string& text, size_t pos) {
+  return text[pos] == '\r' && text[pos + 1] == '\n';
+}
+
 int ParseReplyText(const std::string& text, size_t* pos, RedisReply* out,
                    int depth = 0) {
   if (depth > 32) return EBADMSG;  // nesting cap: wire input, bounded stack
@@ -69,28 +86,36 @@ int ParseReplyText(const std::string& text, size_t* pos, RedisReply* out,
       out->type = RedisReply::ERROR;
       out->str = rest;
       return 0;
-    case ':':
+    case ':': {
+      int64_t v = 0;
+      if (!ParseI64(rest, &v)) return EBADMSG;
       out->type = RedisReply::INTEGER;
-      out->integer = atoll(rest.c_str());
+      out->integer = v;
       return 0;
+    }
     case '$': {
-      long n = atol(rest.c_str());
+      int64_t n = 0;
+      if (!ParseI64(rest, &n)) return EBADMSG;
       if (n < 0) {
         out->type = RedisReply::NIL;
         return 0;
       }
+      if (n > (64ll << 20)) return EBADMSG;  // cap: wire input
       if (text.size() < *pos + size_t(n) + 2) return EAGAIN;
+      if (!HasCrlfAt(text, *pos + size_t(n))) return EBADMSG;
       out->type = RedisReply::STRING;
       out->str = text.substr(*pos, size_t(n));
       *pos += size_t(n) + 2;
       return 0;
     }
     case '*': {
-      long n = atol(rest.c_str());
+      int64_t n = 0;
+      if (!ParseI64(rest, &n)) return EBADMSG;
       if (n < 0) {
         out->type = RedisReply::NIL;
         return 0;
       }
+      if (n > (1 << 20)) return EBADMSG;  // cap: wire input
       out->type = RedisReply::ARRAY;
       out->elems.resize(size_t(n));
       for (long i = 0; i < n; ++i) {
@@ -154,15 +179,18 @@ int CutCommand(const std::string& text, size_t* pos,
   std::string line;
   if (!GetLine(text, pos, &line)) return EAGAIN;
   if (line.empty() || line[0] != '*') return EBADMSG;
-  long n = atol(line.c_str() + 1);
-  if (n <= 0 || n > 1024) return EBADMSG;
+  int64_t n = 0;
+  if (!ParseI64(line.substr(1), &n) || n <= 0 || n > 1024) return EBADMSG;
   args->clear();
-  for (long i = 0; i < n; ++i) {
+  for (int64_t i = 0; i < n; ++i) {
     if (!GetLine(text, pos, &line)) return EAGAIN;
     if (line.empty() || line[0] != '$') return EBADMSG;
-    long len = atol(line.c_str() + 1);
-    if (len < 0 || len > (64 << 20)) return EBADMSG;
+    int64_t len = 0;
+    if (!ParseI64(line.substr(1), &len) || len < 0 || len > (64 << 20)) {
+      return EBADMSG;
+    }
     if (text.size() < *pos + size_t(len) + 2) return EAGAIN;
+    if (!HasCrlfAt(text, *pos + size_t(len))) return EBADMSG;
     args->push_back(text.substr(*pos, size_t(len)));
     *pos += size_t(len) + 2;
   }
@@ -278,19 +306,28 @@ void RedisClient::Impl::OnData(Socket* s) {
   }
   for (;;) {
     RedisReply reply;
-    std::lock_guard<std::mutex> g(impl->mu);
-    if (impl->waiters.empty()) break;
-    int rc = reply.ParseFrom(&impl->inbuf);
-    if (rc == EAGAIN) break;
-    Impl::Waiter* w = impl->waiters.front();
-    impl->waiters.pop_front();
-    if (rc == 0) {
-      *w->out = std::move(reply);
-    } else {
-      w->rc = rc;
+    int rc;
+    {
+      std::lock_guard<std::mutex> g(impl->mu);
+      if (impl->waiters.empty()) break;
+      rc = reply.ParseFrom(&impl->inbuf);
+      if (rc == EAGAIN) break;
+      Impl::Waiter* w = impl->waiters.front();
+      impl->waiters.pop_front();
+      if (rc == 0) {
+        *w->out = std::move(reply);
+      } else {
+        w->rc = rc;
+      }
+      w->ev.signal();
     }
-    w->ev.signal();
-    if (rc != 0) break;
+    if (rc != 0) {
+      // Malformed frame: the cursor may be desynchronized from the stream —
+      // no later reply can be trusted. Fail the connection and drain waiters.
+      s->SetFailed(rc, "redis reply desynchronized");
+      impl->Fail(rc);
+      return;
+    }
   }
 }
 
@@ -344,10 +381,14 @@ RedisReply RedisClient::Command(const std::vector<std::string>& args) {
   Impl::Waiter waiter;
   waiter.out = &reply;
   {
+    // Write under the same lock that orders the waiter FIFO: with concurrent
+    // callers, enqueue order must equal wire order or replies are delivered
+    // to the wrong caller. Socket::Write is wait-free, so the critical
+    // section stays short.
     std::lock_guard<std::mutex> g(impl_->mu);
     impl_->waiters.push_back(&waiter);
+    p->Write(&cmd);
   }
-  p->Write(&cmd);
   if (waiter.ev.wait(impl_->timeout_us) != 0) {
     // Timed out: the waiter must not dangle — fail the connection, which
     // drains the FIFO (including us) before we return.

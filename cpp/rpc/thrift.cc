@@ -172,9 +172,10 @@ struct ThriftClient::Impl {
   IOPortal inbuf;
   struct Waiter {
     ThriftReply* out;
+    uint32_t seqid = 0;
     CountdownEvent ev{1};
   };
-  std::deque<Waiter*> waiters;  // FIFO (seqid monotonic on one connection)
+  std::deque<Waiter*> waiters;  // wire order; replies matched by seqid
   uint32_t next_seqid = 1;
   int64_t timeout_us = 1000000;
 
@@ -200,25 +201,39 @@ void ThriftClient::Impl::OnData(Socket* s) {
     }
   }
   for (;;) {
-    std::lock_guard<std::mutex> g(impl->mu);
-    if (impl->waiters.empty()) break;
     uint32_t type = 0, seqid = 0;
     std::string method;
     IOBuf payload;
-    int rc = ParseMessage(&impl->inbuf, &type, &method, &seqid, &payload);
-    if (rc == EAGAIN) break;
-    Impl::Waiter* w = impl->waiters.front();
-    impl->waiters.pop_front();
-    if (rc == 0 && type == T_REPLY) {
-      w->out->ok = true;
-      w->out->result = std::move(payload);
-    } else if (rc == 0 && type == T_EXCEPTION) {
-      w->out->error = "remote exception";
-    } else {
-      w->out->error = "protocol error";
+    int rc;
+    {
+      std::lock_guard<std::mutex> g(impl->mu);
+      if (impl->waiters.empty()) break;
+      rc = ParseMessage(&impl->inbuf, &type, &method, &seqid, &payload);
+      if (rc == EAGAIN) break;
+      Impl::Waiter* w = impl->waiters.front();
+      if (rc == 0 && w->seqid != seqid) {
+        // Reply seqid must match the oldest in-flight call (writes are
+        // ordered under mu); a mismatch means the stream is desynchronized.
+        rc = EBADMSG;
+      }
+      impl->waiters.pop_front();
+      if (rc == 0 && type == T_REPLY) {
+        w->out->ok = true;
+        w->out->result = std::move(payload);
+      } else if (rc == 0 && type == T_EXCEPTION) {
+        w->out->error = "remote exception";
+      } else {
+        w->out->error = "protocol error";
+      }
+      w->ev.signal();
     }
-    w->ev.signal();
-    if (rc != 0) break;
+    if (rc != 0) {
+      // Desynchronized stream: no later reply can be matched safely. Fail
+      // the connection and drain every remaining waiter.
+      s->SetFailed(EBADMSG, "thrift reply desynchronized");
+      impl->Fail("protocol error");
+      return;
+    }
   }
 }
 
@@ -270,12 +285,15 @@ ThriftReply ThriftClient::Call(const std::string& method, const IOBuf& args) {
   Impl::Waiter waiter;
   waiter.out = &reply;
   {
+    // Pack + Write under the lock that orders the waiter FIFO so enqueue
+    // order equals wire order (Socket::Write itself is wait-free).
     std::lock_guard<std::mutex> g(impl_->mu);
     seqid = impl_->next_seqid++;
+    waiter.seqid = seqid;
     impl_->waiters.push_back(&waiter);
+    PackMessage(&frame, T_CALL, method, seqid, args);
+    p->Write(&frame);
   }
-  PackMessage(&frame, T_CALL, method, seqid, args);
-  p->Write(&frame);
   if (waiter.ev.wait(impl_->timeout_us) != 0) {
     p->SetFailed(ETIMEDOUT, "thrift reply timeout");
     impl_->Fail("timeout");
