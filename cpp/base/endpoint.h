@@ -1,9 +1,13 @@
-// ip:port value type (reference: src/butil/endpoint.h).
+// ip:port value type, plus unix-domain addresses ("unix:/path" or abstract
+// "unix:@name") — reference: src/butil/endpoint.h, which likewise extends
+// EndPoint to unix sockets for the same-host fast path.
 #pragma once
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <sys/un.h>
 
+#include <cstddef>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -14,13 +18,21 @@ namespace brt {
 struct EndPoint {
   uint32_t ip = 0;  // host byte order
   uint16_t port = 0;
+  // Non-empty for unix-domain addresses. A leading '@' means the Linux
+  // abstract namespace (no filesystem entry, no unlink needed). ip/port are
+  // then filled with a hash of the path so numeric (ip,port) keys used by
+  // load balancers stay distinct per path.
+  std::string upath;
 
   EndPoint() = default;
   EndPoint(uint32_t ip_, uint16_t port_) : ip(ip_), port(port_) {}
 
+  bool is_unix() const { return !upath.empty(); }
+
   bool operator==(const EndPoint& o) const = default;
 
   std::string to_string() const {
+    if (is_unix()) return "unix:" + upath;
     char buf[32];
     uint32_t n = htonl(ip);
     char ipbuf[INET_ADDRSTRLEN];
@@ -38,7 +50,35 @@ struct EndPoint {
     return sa;
   }
 
+  // Fills *sa for a unix-domain address; returns the sockaddr length to pass
+  // to bind/connect (abstract names use a leading NUL and exclude trailing
+  // padding from the length).
+  socklen_t to_sockaddr_un(sockaddr_un* sa) const {
+    memset(sa, 0, sizeof(*sa));
+    sa->sun_family = AF_UNIX;
+    if (!upath.empty() && upath[0] == '@') {
+      sa->sun_path[0] = '\0';
+      memcpy(sa->sun_path + 1, upath.data() + 1, upath.size() - 1);
+      return socklen_t(offsetof(sockaddr_un, sun_path) + upath.size());
+    }
+    memcpy(sa->sun_path, upath.data(), upath.size());
+    return socklen_t(offsetof(sockaddr_un, sun_path) + upath.size() + 1);
+  }
+
   static bool parse(const std::string& s, EndPoint* out) {
+    if (s.rfind("unix:", 0) == 0) {
+      std::string path = s.substr(5);
+      if (path.empty() || path.size() >= sizeof(sockaddr_un{}.sun_path) - 1) {
+        return false;
+      }
+      out->upath = std::move(path);
+      // FNV-1a over the path → stable numeric key for LB/socket-map tables.
+      uint64_t h = 1469598103934665603ull;
+      for (char c : out->upath) h = (h ^ uint8_t(c)) * 1099511628211ull;
+      out->ip = uint32_t(h);
+      out->port = uint16_t(h >> 32);
+      return true;
+    }
     auto pos = s.rfind(':');
     if (pos == std::string::npos) return false;
     std::string host = s.substr(0, pos);
@@ -52,6 +92,7 @@ struct EndPoint {
     }
     out->ip = ntohl(addr.s_addr);
     out->port = uint16_t(port);
+    out->upath.clear();
     return true;
   }
 };

@@ -1,0 +1,79 @@
+// Thread-cached object pool for hot-path request objects.
+// Parity target: reference src/butil/object_pool.h (lock-free freelist slabs
+// feeding Socket::WriteRequest and InputMessenger batches) — redesigned:
+// per-thread vectors with batched spill/refill through one global list, which
+// is simpler and just as contention-free for our thread counts.
+//
+// Objects are recycled raw: Get() may return a previously-used object, and the
+// caller is responsible for resetting any fields it relies on (the pool calls
+// neither constructor nor destructor on reuse; first allocation is `new T`).
+#pragma once
+
+#include <algorithm>
+#include <mutex>
+#include <vector>
+
+namespace brt {
+
+template <typename T, size_t kLocalCap = 64, size_t kBatch = 32>
+class ObjectPool {
+  static_assert(kBatch <= kLocalCap);
+
+ public:
+  static T* Get() {
+    Tls& tls = local();
+    if (tls.items.empty()) Refill(tls);
+    if (!tls.items.empty()) {
+      T* o = tls.items.back();
+      tls.items.pop_back();
+      return o;
+    }
+    return new T;
+  }
+
+  static void Put(T* o) {
+    Tls& tls = local();
+    tls.items.push_back(o);
+    if (tls.items.size() >= kLocalCap) Spill(tls);
+  }
+
+ private:
+  struct Tls {
+    std::vector<T*> items;
+    ~Tls() {
+      std::lock_guard<std::mutex> g(mu());
+      auto& gl = global();
+      gl.insert(gl.end(), items.begin(), items.end());
+    }
+  };
+
+  static Tls& local() {
+    static thread_local Tls t;
+    return t;
+  }
+  static std::mutex& mu() {
+    static std::mutex* m = new std::mutex;
+    return *m;
+  }
+  static std::vector<T*>& global() {
+    static auto* v = new std::vector<T*>();
+    return *v;
+  }
+
+  static void Refill(Tls& tls) {
+    std::lock_guard<std::mutex> g(mu());
+    auto& gl = global();
+    const size_t n = std::min(kBatch, gl.size());
+    tls.items.insert(tls.items.end(), gl.end() - ptrdiff_t(n), gl.end());
+    gl.resize(gl.size() - n);
+  }
+
+  static void Spill(Tls& tls) {
+    std::lock_guard<std::mutex> g(mu());
+    auto& gl = global();
+    gl.insert(gl.end(), tls.items.end() - ptrdiff_t(kBatch), tls.items.end());
+    tls.items.resize(tls.items.size() - kBatch);
+  }
+};
+
+}  // namespace brt

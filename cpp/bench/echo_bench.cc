@@ -3,6 +3,8 @@
 // up to 2.3 GB/s multi-connection large-payload echo;
 // example/multi_threaded_echo_c++ is the reference load driver).
 // Prints one JSON line: {"gbps": X, "qps": Y, "p50_us": Z, "p99_us": W}.
+#include <unistd.h>
+
 #include <algorithm>
 #include <atomic>
 #include <cstdio>
@@ -79,18 +81,31 @@ int main(int argc, char** argv) {
   int connections = 8;
   int depth = 16;  // concurrent in-flight calls per connection
   int seconds = 5;
+  int uds = 0;  // 1: unix-domain (abstract) instead of TCP loopback
   for (int i = 1; i + 1 < argc; i += 2) {
     if (!strcmp(argv[i], "--payload")) payload = atoll(argv[i + 1]);
     else if (!strcmp(argv[i], "--connections")) connections = atoi(argv[i + 1]);
     else if (!strcmp(argv[i], "--depth")) depth = atoi(argv[i + 1]);
     else if (!strcmp(argv[i], "--seconds")) seconds = atoi(argv[i + 1]);
+    else if (!strcmp(argv[i], "--uds")) uds = atoi(argv[i + 1]);
   }
 
+  // Scale epoll loops with the connection count (latched at first use).
+  if (getenv("BRT_EVENT_DISPATCHERS") == nullptr && connections >= 4) {
+    char nd[8];
+    snprintf(nd, sizeof(nd), "%d", std::min(4, connections / 2));
+    setenv("BRT_EVENT_DISPATCHERS", nd, 0);
+  }
   fiber_init(0);
   Server server;
   EchoService echo;
+  char listen_addr[64] = "127.0.0.1:0";
+  if (uds) {
+    snprintf(listen_addr, sizeof(listen_addr), "unix:@brt_echo_bench_%d",
+             getpid());
+  }
   if (server.AddService(&echo, "Echo") != 0 ||
-      server.Start("127.0.0.1:0") != 0) {
+      server.Start(listen_addr) != 0) {
     fprintf(stderr, "server start failed\n");
     return 1;
   }
@@ -138,9 +153,9 @@ int main(int argc, char** argv) {
   };
   const double gbps = double(bytes.load()) / elapsed / 1e9;
   printf("{\"gbps\": %.3f, \"qps\": %.0f, \"p50_us\": %ld, \"p99_us\": %ld, "
-         "\"payload\": %zu, \"connections\": %d, \"depth\": %d}\n",
+         "\"payload\": %zu, \"connections\": %d, \"depth\": %d, \"uds\": %d}\n",
          gbps, double(calls.load()) / elapsed, pct(0.5), pct(0.99), payload,
-         connections, depth);
+         connections, depth, uds);
   server.Stop();
   return 0;
 }

@@ -1,6 +1,9 @@
 // RPC-core tests: loopback Server + Channel (the reference's key test
 // pattern, SURVEY §4 — real servers on 127.0.0.1 inside the test process,
 // model test/brpc_server_unittest.cpp / brpc_channel_unittest.cpp).
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <cassert>
 #include <cstdio>
@@ -256,6 +259,67 @@ int main() {
   test_concurrent_calls(ch);
   test_pooled_and_short(addr);
   test_connect_fail_retry();
+
+  // Same suite of calls over a unix-domain (abstract namespace) listener —
+  // the same-host fast path bench.py exercises.
+  {
+    Server userver;
+    EchoService uecho;
+    assert(userver.AddService(&uecho, "Echo") == 0);
+    char uaddr[64];
+    snprintf(uaddr, sizeof(uaddr), "unix:@brt_test_rpc_%d", getpid());
+    assert(userver.Start(uaddr) == 0);
+    assert(userver.listen_address().is_unix());
+    Channel uch;
+    assert(uch.Init(userver.listen_address()) == 0);
+    test_sync_echo(uch);
+    test_async_echo(uch);
+    test_big_payload(uch);
+    test_concurrent_calls(uch);
+    userver.Stop();
+    userver.Join();
+  }
+
+  // Filesystem unix path: live-server protection, stale-file cleanup on
+  // stop, and rebinding over a stale socket file left by a dead process.
+  {
+    char upath[64];
+    snprintf(upath, sizeof(upath), "/tmp/brt_test_rpc_%d.sock", getpid());
+    char uaddr[80];
+    snprintf(uaddr, sizeof(uaddr), "unix:%s", upath);
+    Server s1;
+    EchoService e1;
+    assert(s1.AddService(&e1, "Echo") == 0);
+    assert(s1.Start(uaddr) == 0);
+    Channel c1;
+    assert(c1.Init(s1.listen_address()) == 0);
+    test_sync_echo(c1);
+    // A second server must refuse to hijack the live endpoint.
+    Server s2;
+    EchoService e2;
+    assert(s2.AddService(&e2, "Echo") == 0);
+    assert(s2.Start(uaddr) != 0);
+    s1.Stop();
+    s1.Join();
+    struct stat st;
+    assert(stat(upath, &st) != 0);  // unlinked on stop
+    // Simulate a crash leftover: create a stale socket file, then rebind.
+    int sfd = socket(AF_UNIX, SOCK_STREAM, 0);
+    sockaddr_un su;
+    socklen_t slen = s1.listen_address().to_sockaddr_un(&su);
+    assert(bind(sfd, reinterpret_cast<sockaddr*>(&su), slen) == 0);
+    close(sfd);  // bound but no listener: connect will fail -> stale
+    assert(stat(upath, &st) == 0);
+    Server s3;
+    EchoService e3;
+    assert(s3.AddService(&e3, "Echo") == 0);
+    assert(s3.Start(uaddr) == 0);
+    Channel c3;
+    assert(c3.Init(s3.listen_address()) == 0);
+    test_sync_echo(c3);
+    s3.Stop();
+    s3.Join();
+  }
 
   server.Stop();
   server.Join();

@@ -3,26 +3,65 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include "base/logging.h"
 
 namespace brt {
 
+namespace {
+
+// Removes a stale unix socket file: only if it IS a socket and nothing
+// answers a connect (never delete a live server's endpoint or a plain file).
+int RemoveStaleUnixSocket(const EndPoint& ep) {
+  struct stat st;
+  if (::stat(ep.upath.c_str(), &st) != 0) return 0;  // nothing there
+  if (!S_ISSOCK(st.st_mode)) return ENOTSOCK;
+  int probe = ::socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (probe < 0) return errno;
+  sockaddr_un su;
+  socklen_t slen = ep.to_sockaddr_un(&su);
+  int rc = ::connect(probe, reinterpret_cast<sockaddr*>(&su), slen);
+  ::close(probe);
+  if (rc == 0) return EADDRINUSE;  // a live server owns it
+  ::unlink(ep.upath.c_str());
+  return 0;
+}
+
+}  // namespace
+
 int Acceptor::StartAccept(const EndPoint& listen_point) {
-  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  const int family = listen_point.is_unix() ? AF_UNIX : AF_INET;
+  int fd = ::socket(family, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
   if (fd < 0) return errno;
-  int one = 1;
-  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  sockaddr_in sa = listen_point.to_sockaddr();
-  if (::bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+  sockaddr_storage ss;
+  socklen_t slen;
+  if (listen_point.is_unix()) {
+    if (listen_point.upath[0] != '@') {
+      int rc = RemoveStaleUnixSocket(listen_point);
+      if (rc != 0) {
+        ::close(fd);
+        return rc;
+      }
+    }
+    slen = listen_point.to_sockaddr_un(reinterpret_cast<sockaddr_un*>(&ss));
+  } else {
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    *reinterpret_cast<sockaddr_in*>(&ss) = listen_point.to_sockaddr();
+    slen = sizeof(sockaddr_in);
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&ss), slen) != 0 ||
       ::listen(fd, 4096) != 0) {
     int err = errno;
     ::close(fd);
     return err;
   }
   listen_point_ = listen_point;
-  if (listen_point.port == 0) {
+  if (!listen_point.is_unix() && listen_point.port == 0) {
+    sockaddr_in sa;
     socklen_t len = sizeof(sa);
     getsockname(fd, reinterpret_cast<sockaddr*>(&sa), &len);
     listen_point_.port = ntohs(sa.sin_port);
@@ -41,14 +80,18 @@ void Acceptor::StopAccept() {
     ptr->SetFailed(ESHUTDOWN, "acceptor stopped");
   }
   listen_sid_ = INVALID_SOCKET_ID;
+  if (listen_point_.is_unix() && listen_point_.upath[0] != '@') {
+    ::unlink(listen_point_.upath.c_str());
+  }
 }
 
 void Acceptor::OnNewConnections(Socket* listener) {
   auto* self = static_cast<Acceptor*>(listener->user());
+  const bool is_unix = listener->remote().is_unix();
   for (;;) {
-    sockaddr_in sa;
-    socklen_t len = sizeof(sa);
-    int fd = ::accept4(listener->fd(), reinterpret_cast<sockaddr*>(&sa),
+    sockaddr_storage ss;
+    socklen_t len = sizeof(ss);
+    int fd = ::accept4(listener->fd(), reinterpret_cast<sockaddr*>(&ss),
                        &len, SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) return;
@@ -58,7 +101,13 @@ void Acceptor::OnNewConnections(Socket* listener) {
     }
     Socket::Options o = self->conn_options;
     o.fd = fd;
-    o.remote = EndPoint(ntohl(sa.sin_addr.s_addr), ntohs(sa.sin_port));
+    if (is_unix) {
+      // Unix peers are anonymous; tag them with the listener's address.
+      o.remote = listener->remote();
+    } else {
+      auto* sa = reinterpret_cast<sockaddr_in*>(&ss);
+      o.remote = EndPoint(ntohl(sa->sin_addr.s_addr), ntohs(sa->sin_port));
+    }
     SocketId sid;
     if (Socket::Create(o, &sid) != 0) {
       BRT_LOG(WARNING) << "Socket::Create failed for accepted fd";

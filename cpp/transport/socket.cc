@@ -12,11 +12,32 @@
 #include <vector>
 
 #include "base/logging.h"
+#include "base/object_pool.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "transport/event_dispatcher.h"
 
 namespace brt {
+
+namespace {
+
+// WriteReq allocation is on the per-call hot path (reference pools its
+// WriteRequest through butil::ObjectPool for the same reason).
+using WriteReqPool = ObjectPool<Socket::WriteReq>;
+
+Socket::WriteReq* GetWriteReq() {
+  Socket::WriteReq* r = WriteReqPool::Get();
+  r->next.store(nullptr, std::memory_order_relaxed);
+  r->cid = 0;
+  return r;
+}
+
+void PutWriteReq(Socket::WriteReq* r) {
+  r->data.clear();
+  WriteReqPool::Put(r);
+}
+
+}  // namespace
 
 // ---------------------------------------------------------------------------
 // Slab of Socket slots. Slots are constructed once and never destroyed
@@ -257,7 +278,7 @@ int Socket::Write(IOBuf* data, fid_t cid) {
     if (cid != 0) fid_error(cid, err);
     return err;
   }
-  WriteReq* req = new WriteReq;
+  WriteReq* req = GetWriteReq();
   req->data.swap(*data);
   req->cid = cid;
   WriteReq* prev = write_head_.exchange(req, std::memory_order_acq_rel);
@@ -285,7 +306,7 @@ void* Socket::KeepWriteEntry(void* argp) {
     while (c) {
       Socket::WriteReq* n = c->next.load(std::memory_order_acquire);
       if (c->cid) fid_error(c->cid, ECONNRESET);
-      delete c;
+      PutWriteReq(c);
       c = n;
     }
   }
@@ -346,14 +367,14 @@ Socket::WriteReq* Socket::AdvanceWriteChain(WriteReq* cur) {
     WriteReq* expected = cur;
     if (write_head_.compare_exchange_strong(expected, nullptr,
                                             std::memory_order_acq_rel)) {
-      delete cur;
+      PutWriteReq(cur);
       return nullptr;
     }
     do {
       next = cur->next.load(std::memory_order_acquire);
     } while (next == nullptr);
   }
-  delete cur;
+  PutWriteReq(cur);
   return next;
 }
 
@@ -376,10 +397,18 @@ int Socket::WaitEpollOut(int64_t timeout_us) {
 
 int Socket::Connect(const EndPoint& remote, const Options& opts,
                     SocketId* id_out, int64_t timeout_us) {
-  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  const int family = remote.is_unix() ? AF_UNIX : AF_INET;
+  int fd = ::socket(family, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return errno;
-  sockaddr_in sa = remote.to_sockaddr();
-  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa));
+  sockaddr_storage ss;
+  socklen_t slen;
+  if (remote.is_unix()) {
+    slen = remote.to_sockaddr_un(reinterpret_cast<sockaddr_un*>(&ss));
+  } else {
+    *reinterpret_cast<sockaddr_in*>(&ss) = remote.to_sockaddr();
+    slen = sizeof(sockaddr_in);
+  }
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&ss), slen);
   if (rc != 0 && errno != EINPROGRESS) {
     int err = errno;
     ::close(fd);

@@ -141,13 +141,16 @@ class Socket {
   void AddWaiter(fid_t cid);
   void RemoveWaiter(fid_t cid);
 
- private:
-  friend class SocketUniquePtr;
+  // One node of the wait-free MPSC write chain (pooled via ObjectPool — the
+  // per-call hot path must not malloc).
   struct WriteReq {
     IOBuf data;
     fid_t cid = 0;
     std::atomic<WriteReq*> next{nullptr};
   };
+
+ private:
+  friend class SocketUniquePtr;
 
   Socket() = default;
   ~Socket() = default;
