@@ -50,6 +50,15 @@ struct EndPoint {
     return sa;
   }
 
+  // Family-dispatched fill for connect/bind: unix or inet.
+  socklen_t to_sockaddr_storage(sockaddr_storage* ss) const {
+    if (is_unix()) {
+      return to_sockaddr_un(reinterpret_cast<sockaddr_un*>(ss));
+    }
+    *reinterpret_cast<sockaddr_in*>(ss) = to_sockaddr();
+    return sizeof(sockaddr_in);
+  }
+
   // Fills *sa for a unix-domain address; returns the sockaddr length to pass
   // to bind/connect (abstract names use a leading NUL and exclude trailing
   // padding from the length).

@@ -164,6 +164,11 @@ void IOBuf::append_user_data(void* data, size_t n, UserDeleter deleter,
 
 uint64_t IOBuf::user_meta_at(int i) const { return refs_[i].block->user_meta; }
 
+const void* IOBuf::ref_data(int i) const {
+  const BlockRef& r = refs_[size_t(i)];
+  return r.block->data + r.offset;
+}
+
 size_t IOBuf::cutn(IOBuf* out, size_t n) {
   n = n < size_ ? n : size_;
   size_t left = n;

@@ -94,6 +94,9 @@ class IOBuf {
   int block_count() const { return int(refs_.size()); }
   const BlockRef& ref_at(int i) const { return refs_[i]; }
   uint64_t user_meta_at(int i) const;
+  // Data pointer of ref i (valid while the ref is held) — the zero-copy DMA
+  // source/target for the device staging path.
+  const void* ref_data(int i) const;
 
   void swap(IOBuf& o) {
     refs_.swap(o.refs_);

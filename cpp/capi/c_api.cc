@@ -173,3 +173,67 @@ void brt_event_destroy(void* event) {
 }
 
 }  // extern "C"
+
+// ---- device staging (cpp/device/pjrt_device.h) ----
+
+#include "device/pjrt_device.h"
+
+extern "C" {
+
+void* brt_device_client_new(const char* plugin_path, char* errbuf,
+                            size_t errbuf_len) {
+  brt::PjrtClient::Options opts;
+  if (plugin_path != nullptr) opts.plugin_path = plugin_path;
+  std::string err;
+  auto client = brt::PjrtClient::Create(opts, &err);
+  if (client == nullptr) {
+    if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "%s", err.c_str());
+    return nullptr;
+  }
+  return client.release();
+}
+
+int brt_device_count(void* client) {
+  return static_cast<brt::PjrtClient*>(client)->addressable_device_count();
+}
+
+uint64_t brt_device_stage(void* client, const void* data, size_t len,
+                          int device_index, char* errbuf, size_t errbuf_len) {
+  brt::IOBuf buf;
+  buf.append(data, len);
+  std::string err;
+  uint64_t h = static_cast<brt::PjrtClient*>(client)->StageToDevice(
+      buf, device_index, &err);
+  if (h == 0 && errbuf && errbuf_len) {
+    snprintf(errbuf, errbuf_len, "%s", err.c_str());
+  }
+  return h;
+}
+
+int brt_device_fetch(void* client, uint64_t handle, void** out,
+                     size_t* out_len, char* errbuf, size_t errbuf_len) {
+  brt::IOBuf buf;
+  std::string err;
+  int rc = static_cast<brt::PjrtClient*>(client)->StageFromDevice(
+      handle, &buf, &err);
+  if (rc != 0) {
+    if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "%s", err.c_str());
+    return rc;
+  }
+  const size_t n = buf.size();
+  void* mem = malloc(n ? n : 1);
+  buf.copy_to(mem, n);
+  *out = mem;
+  *out_len = n;
+  return 0;
+}
+
+int brt_device_release(uint64_t handle) {
+  return brt::DeviceBufferRegistry::Release(handle) ? 0 : EINVAL;
+}
+
+void brt_device_client_destroy(void* client) {
+  delete static_cast<brt::PjrtClient*>(client);
+}
+
+}  // extern "C"

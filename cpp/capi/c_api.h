@@ -51,6 +51,25 @@ void brt_free(void* p);
 // ---- runtime ----
 void brt_init(int fiber_workers);
 
+// ---- device (native PJRT staging — the RDMA-analog tier) ----
+// Creates a PJRT client over the given plugin (NULL/"" = $BRT_PJRT_PLUGIN
+// or the platform default). NULL on failure; errbuf holds the reason.
+void* brt_device_client_new(const char* plugin_path, char* errbuf,
+                            size_t errbuf_len);
+int brt_device_count(void* client);
+// DMAs bytes to device memory on device_index; returns a nonzero 64-bit
+// buffer handle (the lkey analog carried in IOBuf meta), 0 on failure.
+uint64_t brt_device_stage(void* client, const void* data, size_t len,
+                          int device_index, char* errbuf, size_t errbuf_len);
+// DMAs the buffer behind handle back to host. *out is malloc'd (free with
+// brt_free); the calling fiber (or thread) parks while the DMA runs.
+// Returns 0 on success.
+int brt_device_fetch(void* client, uint64_t handle, void** out,
+                     size_t* out_len, char* errbuf, size_t errbuf_len);
+// Frees the device buffer behind handle. Returns 0, or EINVAL if stale.
+int brt_device_release(uint64_t handle);
+void brt_device_client_destroy(void* client);
+
 // ---- fiber events (the "yield on TPU stream events" bridge) ----
 // A native fiber can wait without blocking its worker pthread while any
 // thread (e.g. a JAX async-dispatch completion callback in Python) sets

@@ -1,0 +1,182 @@
+// PJRT device-layer tests: IOBuf staged through a real PJRT device buffer,
+// fibers parking on PJRT events, and an RPC echo whose payload rides HBM.
+// Mirrors the reference's rdma_endpoint zero-copy contract
+// (src/brpc/rdma/rdma_endpoint.cpp:774,1011) with PJRT as the fabric.
+//
+// Skips (exit 0, prints SKIP) when no PJRT plugin is loadable — the TPU
+// plugin needs live hardware; CI boxes without it still run the rest of the
+// suite.
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "base/iobuf.h"
+#include "device/pjrt_device.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/channel.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+namespace {
+
+PjrtClient* g_client = nullptr;
+
+// Echo service that bounces the attachment through device memory: request
+// bytes DMA to HBM, DMA back, and the response attachment references the
+// D2H landing block directly (no memcpy on the host path).
+class DeviceEchoService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response, Closure done) override {
+    std::string err;
+    uint64_t h = g_client->StageToDevice(cntl->request_attachment(), 0, &err);
+    if (h == 0) {
+      cntl->SetFailed(5001, "stage to device failed: %s", err.c_str());
+      done();
+      return;
+    }
+    IOBuf from_dev;
+    int rc = g_client->StageFromDevice(h, &from_dev, &err);
+    if (rc != 0) {
+      DeviceBufferRegistry::Release(h);
+      cntl->SetFailed(5002, "stage from device failed: %s", err.c_str());
+      done();
+      return;
+    }
+    // The attachment's block meta carries the device-buffer handle — the
+    // lkey analog a smarter peer could use to keep the tensor in HBM.
+    assert(from_dev.user_meta_at(0) == h);
+    cntl->response_attachment() = from_dev;
+    response->append(request);
+    done();
+    DeviceBufferRegistry::Release(h);
+  }
+};
+
+void test_roundtrip(PjrtClient* client) {
+  // Single-block payload: staged zero-copy from the block.
+  IOBuf small;
+  small.append(std::string(1000, 'x'));
+  IOBuf back;
+  std::string err;
+  assert(client->Roundtrip(small, &back, 0, &err) == 0);
+  assert(back.size() == 1000);
+  assert(back.equals(std::string(1000, 'x')));
+
+  // Multi-block payload (coalesced once, then DMA'd).
+  IOBuf big;
+  std::string blob(100000, 'y');
+  for (int i = 0; i < 3; ++i) big.append(blob);
+  IOBuf back2;
+  assert(client->Roundtrip(big, &back2, 0, &err) == 0);
+  assert(back2.size() == 300000);
+  std::string s = back2.to_string();
+  for (char c : s) assert(c == 'y');
+  printf("  roundtrip ok\n");
+}
+
+void test_handle_registry(PjrtClient* client) {
+  IOBuf payload;
+  payload.append("registry");
+  std::string err;
+  uint64_t h = client->StageToDevice(payload, 0, &err);
+  assert(h != 0);
+  assert(DeviceBufferRegistry::Lookup(h) != nullptr);
+  // Two independent D2H stages from the same resident buffer.
+  IOBuf a, b;
+  assert(client->StageFromDevice(h, &a, &err) == 0);
+  assert(client->StageFromDevice(h, &b, &err) == 0);
+  assert(a.equals("registry") && b.equals("registry"));
+  assert(a.user_meta_at(0) == h);
+  assert(DeviceBufferRegistry::Release(h));
+  assert(!DeviceBufferRegistry::Release(h));  // stale now
+  assert(DeviceBufferRegistry::Lookup(h) == nullptr);
+  printf("  handle registry ok\n");
+}
+
+struct FiberArg {
+  PjrtClient* client;
+  CountdownEvent* ev;
+  bool ok = false;
+};
+
+void* FiberStage(void* argp) {
+  auto* arg = static_cast<FiberArg*>(argp);
+  IOBuf in, out;
+  in.append(std::string(5000, 'f'));
+  std::string err;
+  // The D2H wait inside parks THIS fiber on the PJRT event.
+  arg->ok = arg->client->Roundtrip(in, &out, 0, &err) == 0 &&
+            out.equals(std::string(5000, 'f'));
+  arg->ev->signal();
+  return nullptr;
+}
+
+void test_fiber_event_wait(PjrtClient* client) {
+  // Many concurrent fibers, each parking on its own device event.
+  constexpr int kN = 8;
+  CountdownEvent ev(kN);
+  FiberArg args[kN];
+  for (auto& a : args) {
+    a.client = client;
+    a.ev = &ev;
+    fiber_t tid;
+    assert(fiber_start(&tid, FiberStage, &a) == 0);
+  }
+  ev.wait(-1);
+  for (auto& a : args) assert(a.ok);
+  printf("  fiber event wait ok (%d concurrent)\n", kN);
+}
+
+void test_device_echo_rpc(PjrtClient* client) {
+  g_client = client;
+  Server server;
+  DeviceEchoService svc;
+  assert(server.AddService(&svc, "DevEcho") == 0);
+  assert(server.Start("127.0.0.1:0") == 0);
+  Channel ch;
+  assert(ch.Init(server.listen_address()) == 0);
+
+  Controller cntl;
+  cntl.timeout_ms = 30000;
+  std::string payload(64 * 1024, 'd');
+  cntl.request_attachment().append(payload);
+  IOBuf req, rsp;
+  req.append("via-device");
+  ch.CallMethod("DevEcho", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  assert(rsp.equals("via-device"));
+  assert(cntl.response_attachment().size() == payload.size());
+  assert(cntl.response_attachment().equals(payload));
+  server.Stop();
+  server.Join();
+  printf("  device echo rpc ok\n");
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  std::string err;
+  PjrtClient::Options opts;
+  auto client = PjrtClient::Create(opts, &err);
+  if (client == nullptr) {
+    printf("SKIP: no PJRT device available (%s)\n", err.c_str());
+    return 0;
+  }
+  printf("platform=%s devices=%d api_minor=%d\n",
+         client->platform_name().c_str(),
+         client->addressable_device_count(),
+         client->api()->api_minor_version());
+  assert(client->addressable_device_count() >= 1);
+
+  test_roundtrip(client.get());
+  test_handle_registry(client.get());
+  test_fiber_event_wait(client.get());
+  test_device_echo_rpc(client.get());
+  printf("ALL device tests OK\n");
+  return 0;
+}

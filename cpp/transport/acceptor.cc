@@ -1,7 +1,9 @@
 #include "transport/acceptor.h"
 
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <sys/file.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/un.h>
@@ -15,6 +17,8 @@ namespace {
 
 // Removes a stale unix socket file: only if it IS a socket and nothing
 // answers a connect (never delete a live server's endpoint or a plain file).
+// Caller must hold the path's flock (see below) so the probe/unlink/bind
+// sequence is atomic across cooperating processes.
 int RemoveStaleUnixSocket(const EndPoint& ep) {
   struct stat st;
   if (::stat(ep.upath.c_str(), &st) != 0) return 0;  // nothing there
@@ -30,34 +34,58 @@ int RemoveStaleUnixSocket(const EndPoint& ep) {
   return 0;
 }
 
+// Serializes probe+unlink+bind+listen for a filesystem unix path across
+// processes (closes the TOCTOU where B's stale-probe hits A between A's
+// bind and listen and unlinks A's live file). The lock file persists; the
+// lock itself is released when fd closes.
+int LockUnixPath(const std::string& upath) {
+  std::string lock_path = upath + ".lock";
+  int lfd = ::open(lock_path.c_str(), O_CREAT | O_RDWR | O_CLOEXEC, 0644);
+  if (lfd < 0) return -1;
+  if (::flock(lfd, LOCK_EX) != 0) {
+    ::close(lfd);
+    return -1;
+  }
+  return lfd;
+}
+
 }  // namespace
 
 int Acceptor::StartAccept(const EndPoint& listen_point) {
   const int family = listen_point.is_unix() ? AF_UNIX : AF_INET;
   int fd = ::socket(family, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
   if (fd < 0) return errno;
-  sockaddr_storage ss;
-  socklen_t slen;
+  const bool fs_unix =
+      listen_point.is_unix() && listen_point.upath[0] != '@';
+  int lock_fd = -1;
+  auto fail = [&](int err) {
+    ::close(fd);
+    if (lock_fd >= 0) ::close(lock_fd);
+    return err;
+  };
   if (listen_point.is_unix()) {
-    if (listen_point.upath[0] != '@') {
+    if (fs_unix) {
+      lock_fd = LockUnixPath(listen_point.upath);
       int rc = RemoveStaleUnixSocket(listen_point);
-      if (rc != 0) {
-        ::close(fd);
-        return rc;
-      }
+      if (rc != 0) return fail(rc);
     }
-    slen = listen_point.to_sockaddr_un(reinterpret_cast<sockaddr_un*>(&ss));
   } else {
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-    *reinterpret_cast<sockaddr_in*>(&ss) = listen_point.to_sockaddr();
-    slen = sizeof(sockaddr_in);
   }
-  if (::bind(fd, reinterpret_cast<sockaddr*>(&ss), slen) != 0 ||
-      ::listen(fd, 4096) != 0) {
+  sockaddr_storage ss;
+  socklen_t slen = listen_point.to_sockaddr_storage(&ss);
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&ss), slen) != 0) {
+    return fail(errno);  // bind failed: the path file (if any) isn't ours
+  }
+  if (::listen(fd, 4096) != 0) {
     int err = errno;
-    ::close(fd);
-    return err;
+    if (fs_unix) ::unlink(listen_point.upath.c_str());  // we created it
+    return fail(err);
+  }
+  if (lock_fd >= 0) {
+    ::close(lock_fd);  // bind+listen done: safe to release the path lock
+    lock_fd = -1;
   }
   listen_point_ = listen_point;
   if (!listen_point.is_unix() && listen_point.port == 0) {
@@ -71,7 +99,15 @@ int Acceptor::StartAccept(const EndPoint& listen_point) {
   o.remote = listen_point_;
   o.user = this;
   o.on_edge_triggered = &Acceptor::OnNewConnections;
-  return Socket::Create(o, &listen_sid_);
+  int rc = Socket::Create(o, &listen_sid_);
+  if (rc != 0) {
+    // Socket::Create closes the fd through SetFailed/recycle on its own
+    // failure path only after registration; on registration failure the fd
+    // is still ours — release the address so a retry can bind.
+    if (fs_unix) ::unlink(listen_point_.upath.c_str());
+    return rc;
+  }
+  return 0;
 }
 
 void Acceptor::StopAccept() {

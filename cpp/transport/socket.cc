@@ -401,14 +401,20 @@ int Socket::Connect(const EndPoint& remote, const Options& opts,
   int fd = ::socket(family, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return errno;
   sockaddr_storage ss;
-  socklen_t slen;
-  if (remote.is_unix()) {
-    slen = remote.to_sockaddr_un(reinterpret_cast<sockaddr_un*>(&ss));
-  } else {
-    *reinterpret_cast<sockaddr_in*>(&ss) = remote.to_sockaddr();
-    slen = sizeof(sockaddr_in);
-  }
+  socklen_t slen = remote.to_sockaddr_storage(&ss);
   int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&ss), slen);
+  // AF_UNIX returns EAGAIN (not EINPROGRESS) when the listener backlog is
+  // full, and the connect will NOT complete later via EPOLLOUT — retry with
+  // a backoff for up to the connect timeout before giving up.
+  if (rc != 0 && errno == EAGAIN && remote.is_unix()) {
+    const int64_t give_up = monotonic_us() + timeout_us;
+    int64_t delay_us = 1000;
+    while (rc != 0 && errno == EAGAIN && monotonic_us() < give_up) {
+      fiber_usleep(delay_us);
+      if (delay_us < 32000) delay_us *= 2;
+      rc = ::connect(fd, reinterpret_cast<sockaddr*>(&ss), slen);
+    }
+  }
   if (rc != 0 && errno != EINPROGRESS) {
     int err = errno;
     ::close(fd);
