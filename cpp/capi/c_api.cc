@@ -222,6 +222,10 @@ int brt_device_fetch(void* client, uint64_t handle, void** out,
   }
   const size_t n = buf.size();
   void* mem = malloc(n ? n : 1);
+  if (mem == nullptr) {
+    if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "out of memory");
+    return ENOMEM;
+  }
   buf.copy_to(mem, n);
   *out = mem;
   *out_len = n;

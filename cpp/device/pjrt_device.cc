@@ -178,6 +178,8 @@ namespace {
 struct RegisteredBuffer {
   const PjrtApi* api;
   PJRT_Buffer* buf;
+  int refs;   // 1 registry ref (until Release) + one per outstanding Pin
+  bool dead;  // Release() called; Lookup/Pin fail from then on
 };
 
 std::mutex g_reg_mu;
@@ -187,20 +189,58 @@ std::unordered_map<uint64_t, RegisteredBuffer>& registry() {
 }
 std::atomic<uint64_t> g_next_handle{1};
 
+void DestroyPjrtBuffer(const PjrtApi* api, PJRT_Buffer* buf) {
+  auto args = BRT_PJRT_ARGS(PJRT_Buffer_Destroy_Args);
+  args.buffer = buf;
+  if (PJRT_Error* err = api->raw()->PJRT_Buffer_Destroy(&args)) {
+    BRT_LOG(ERROR) << "PJRT_Buffer_Destroy: " << api->ConsumeError(err);
+  }
+}
+
 }  // namespace
 
 uint64_t DeviceBufferRegistry::Register(const PjrtApi* api,
                                         PJRT_Buffer* buf) {
   const uint64_t h = g_next_handle.fetch_add(1, std::memory_order_relaxed);
   std::lock_guard<std::mutex> g(g_reg_mu);
-  registry()[h] = RegisteredBuffer{api, buf};
+  registry()[h] = RegisteredBuffer{api, buf, /*refs=*/1, /*dead=*/false};
   return h;
 }
 
 PJRT_Buffer* DeviceBufferRegistry::Lookup(uint64_t handle) {
   std::lock_guard<std::mutex> g(g_reg_mu);
   auto it = registry().find(handle);
-  return it == registry().end() ? nullptr : it->second.buf;
+  if (it == registry().end() || it->second.dead) return nullptr;
+  return it->second.buf;
+}
+
+PJRT_Buffer* DeviceBufferRegistry::Pin(uint64_t handle) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  auto it = registry().find(handle);
+  if (it == registry().end() || it->second.dead) return nullptr;
+  ++it->second.refs;
+  return it->second.buf;
+}
+
+void DeviceBufferRegistry::Unpin(uint64_t handle) {
+  RegisteredBuffer rb;
+  {
+    std::lock_guard<std::mutex> g(g_reg_mu);
+    auto it = registry().find(handle);
+    if (it == registry().end()) return;
+    if (--it->second.refs > 0) return;
+    if (!it->second.dead) {
+      // Unbalanced Unpin on a live handle: the registry's own ref was never
+      // dropped by Release, so destroying here would be a use-after-free for
+      // other holders. Restore the ref and flag the bug.
+      it->second.refs = 1;
+      BRT_LOG(ERROR) << "unbalanced Unpin on live device handle " << handle;
+      return;
+    }
+    rb = it->second;
+    registry().erase(it);
+  }
+  DestroyPjrtBuffer(rb.api, rb.buf);
 }
 
 bool DeviceBufferRegistry::Release(uint64_t handle) {
@@ -208,15 +248,13 @@ bool DeviceBufferRegistry::Release(uint64_t handle) {
   {
     std::lock_guard<std::mutex> g(g_reg_mu);
     auto it = registry().find(handle);
-    if (it == registry().end()) return false;
+    if (it == registry().end() || it->second.dead) return false;
+    it->second.dead = true;
+    if (--it->second.refs > 0) return true;  // a pinned DMA finishes it
     rb = it->second;
     registry().erase(it);
   }
-  auto args = BRT_PJRT_ARGS(PJRT_Buffer_Destroy_Args);
-  args.buffer = rb.buf;
-  if (PJRT_Error* err = rb.api->raw()->PJRT_Buffer_Destroy(&args)) {
-    BRT_LOG(ERROR) << "PJRT_Buffer_Destroy: " << rb.api->ConsumeError(err);
-  }
+  DestroyPjrtBuffer(rb.api, rb.buf);
   return true;
 }
 
@@ -389,6 +427,10 @@ uint64_t PjrtClient::StageToDevice(const IOBuf& data, int device_index,
     base = src.ref_data(0);
   } else {
     char* flat = static_cast<char*>(::malloc(len ? len : 1));
+    if (flat == nullptr) {
+      if (error) *error = "out of memory coalescing H2D payload";
+      return 0;
+    }
     src.copy_to(flat, len);
     IOBuf owned;
     owned.append_user_data(
@@ -431,22 +473,31 @@ uint64_t PjrtClient::StageToDevice(const IOBuf& data, int device_index,
 
 int PjrtClient::StageFromDevice(uint64_t handle, IOBuf* out,
                                 std::string* error) {
-  PJRT_Buffer* buf = DeviceBufferRegistry::Lookup(handle);
+  // Pin across the blocking DMA: a concurrent Release of the same handle
+  // (the "ship the handle" pattern) must not destroy the buffer mid-read.
+  PJRT_Buffer* buf = DeviceBufferRegistry::Pin(handle);
   if (buf == nullptr) {
     if (error) *error = "stale device buffer handle";
     return EINVAL;
   }
+  auto unpin = [handle] { DeviceBufferRegistry::Unpin(handle); };
   auto szargs = BRT_PJRT_ARGS(PJRT_Buffer_OnDeviceSizeInBytes_Args);
   szargs.buffer = buf;
   if (PJRT_Error* err =
           api_->raw()->PJRT_Buffer_OnDeviceSizeInBytes(&szargs)) {
     if (error) *error = "OnDeviceSizeInBytes: " + api_->ConsumeError(err);
+    unpin();
     return EIO;
   }
   const size_t n = szargs.on_device_size_in_bytes;
   // D2H lands directly in the block that the caller's IOBuf will reference
   // — no bounce buffer (reference recv-side zero copy, docs/en/rdma.md:38).
   char* dst = static_cast<char*>(::malloc(n ? n : 1));
+  if (dst == nullptr) {
+    if (error) *error = "out of memory for D2H landing buffer";
+    unpin();
+    return ENOMEM;
+  }
   auto args = BRT_PJRT_ARGS(PJRT_Buffer_ToHostBuffer_Args);
   args.src = buf;
   args.dst = dst;
@@ -454,10 +505,12 @@ int PjrtClient::StageFromDevice(uint64_t handle, IOBuf* out,
   if (PJRT_Error* err = api_->raw()->PJRT_Buffer_ToHostBuffer(&args)) {
     if (error) *error = "ToHostBuffer: " + api_->ConsumeError(err);
     ::free(dst);
+    unpin();
     return EIO;
   }
   PjrtEvent ev(api_, args.event);
   int rc = ev.FiberWait();  // fiber parks; DMA completion wakes it
+  unpin();
   if (rc != 0) {
     if (error) *error = "D2H event failed";
     ::free(dst);

@@ -87,12 +87,27 @@ class PjrtEvent {
 // Registry of live device buffers addressable by 64-bit handles — the meta
 // value carried in IOBuf user-data blocks (reference: lkey in
 // append_user_data_with_meta, docs/en/rdma.md:44-46).
+// Entries are refcounted: Pin() takes a reference for the duration of a DMA
+// (or any other use across a blocking wait) so a concurrent Release() of the
+// same handle — the advertised "ship the handle" pattern — cannot destroy
+// the PJRT buffer out from under the user. Release() marks the handle dead
+// (subsequent Lookup/Pin fail) and destroys the buffer once the last pin
+// drops.
 class DeviceBufferRegistry {
  public:
   static uint64_t Register(const PjrtApi* api, PJRT_Buffer* buf);
-  // Live buffer for the handle, or nullptr.
+  // Live buffer for the handle, or nullptr. Non-owning peek: the result is
+  // only safe to use while the caller otherwise guarantees no concurrent
+  // Release (use Pin/Unpin across blocking operations).
   static PJRT_Buffer* Lookup(uint64_t handle);
-  // Destroys the PJRT buffer and frees the handle. False if stale.
+  // Takes a reference and returns the buffer (nullptr if stale/dead). Every
+  // successful Pin must be paired with an Unpin.
+  static PJRT_Buffer* Pin(uint64_t handle);
+  // Drops a Pin reference; destroys the PJRT buffer if the handle was
+  // Released and this was the last reference.
+  static void Unpin(uint64_t handle);
+  // Marks the handle dead and destroys the PJRT buffer once no pins remain.
+  // False if stale.
   static bool Release(uint64_t handle);
 };
 

@@ -91,8 +91,15 @@ void test_handle_registry(PjrtClient* client) {
   assert(client->StageFromDevice(h, &b, &err) == 0);
   assert(a.equals("registry") && b.equals("registry"));
   assert(a.user_meta_at(0) == h);
+  // Pin keeps the buffer alive across a Release (ship-the-handle race):
+  // Release marks the handle dead immediately but destroys the PJRT buffer
+  // only when the last pin drops.
+  assert(DeviceBufferRegistry::Pin(h) != nullptr);
   assert(DeviceBufferRegistry::Release(h));
   assert(!DeviceBufferRegistry::Release(h));  // stale now
+  assert(DeviceBufferRegistry::Lookup(h) == nullptr);
+  assert(DeviceBufferRegistry::Pin(h) == nullptr);  // dead: no new pins
+  DeviceBufferRegistry::Unpin(h);  // last ref → buffer destroyed here
   assert(DeviceBufferRegistry::Lookup(h) == nullptr);
   printf("  handle registry ok\n");
 }

@@ -23,13 +23,19 @@ int RemoveStaleUnixSocket(const EndPoint& ep) {
   struct stat st;
   if (::stat(ep.upath.c_str(), &st) != 0) return 0;  // nothing there
   if (!S_ISSOCK(st.st_mode)) return ENOTSOCK;
-  int probe = ::socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  // Probe non-blocking: a live listener with a full backlog must report
+  // EADDRINUSE, not hang this process in connect().
+  int probe =
+      ::socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
   if (probe < 0) return errno;
   sockaddr_un su;
   socklen_t slen = ep.to_sockaddr_un(&su);
   int rc = ::connect(probe, reinterpret_cast<sockaddr*>(&su), slen);
+  int cerr = rc == 0 ? 0 : errno;
   ::close(probe);
-  if (rc == 0) return EADDRINUSE;  // a live server owns it
+  if (rc == 0 || cerr == EINPROGRESS || cerr == EAGAIN) {
+    return EADDRINUSE;  // a live server owns it (or its backlog is full)
+  }
   ::unlink(ep.upath.c_str());
   return 0;
 }
@@ -38,13 +44,15 @@ int RemoveStaleUnixSocket(const EndPoint& ep) {
 // processes (closes the TOCTOU where B's stale-probe hits A between A's
 // bind and listen and unlinks A's live file). The lock file persists; the
 // lock itself is released when fd closes.
+// Returns the lock fd (>=0) or -errno on failure.
 int LockUnixPath(const std::string& upath) {
   std::string lock_path = upath + ".lock";
   int lfd = ::open(lock_path.c_str(), O_CREAT | O_RDWR | O_CLOEXEC, 0644);
-  if (lfd < 0) return -1;
+  if (lfd < 0) return -errno;
   if (::flock(lfd, LOCK_EX) != 0) {
+    int err = errno;
     ::close(lfd);
-    return -1;
+    return -err;
   }
   return lfd;
 }
@@ -66,6 +74,14 @@ int Acceptor::StartAccept(const EndPoint& listen_point) {
   if (listen_point.is_unix()) {
     if (fs_unix) {
       lock_fd = LockUnixPath(listen_point.upath);
+      if (lock_fd < 0) {
+        // Proceeding without the flock would reintroduce the cross-process
+        // probe/unlink/bind TOCTOU the lock exists to close.
+        int err = -lock_fd;
+        lock_fd = -1;
+        BRT_LOG(ERROR) << "cannot lock unix path " << listen_point.upath;
+        return fail(err);
+      }
       int rc = RemoveStaleUnixSocket(listen_point);
       if (rc != 0) return fail(rc);
     }

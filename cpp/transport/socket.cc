@@ -407,7 +407,10 @@ int Socket::Connect(const EndPoint& remote, const Options& opts,
   // full, and the connect will NOT complete later via EPOLLOUT — retry with
   // a backoff for up to the connect timeout before giving up.
   if (rc != 0 && errno == EAGAIN && remote.is_unix()) {
-    const int64_t give_up = monotonic_us() + timeout_us;
+    // timeout_us <= 0 means "no timeout": retry without a deadline
+    // (matching WaitEpollOut, where <=0 waits indefinitely).
+    const int64_t give_up =
+        timeout_us > 0 ? monotonic_us() + timeout_us : INT64_MAX;
     int64_t delay_us = 1000;
     while (rc != 0 && errno == EAGAIN && monotonic_us() < give_up) {
       fiber_usleep(delay_us);
