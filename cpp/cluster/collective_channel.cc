@@ -1,0 +1,228 @@
+#include "cluster/collective_channel.h"
+
+#include <cstring>
+
+#include "base/logging.h"
+
+namespace brt {
+
+namespace {
+
+// CallMapper that hands sub-channel i its own member contribution —
+// per-sub request slicing (reference parallel_channel.h:94).
+class MemberMapper : public CallMapper {
+ public:
+  explicit MemberMapper(const std::vector<IOBuf>* inputs)
+      : inputs_(inputs) {}
+  SubCall Map(int channel_index, int channel_count,
+              const std::string& method, const IOBuf& request) override {
+    SubCall c;
+    c.method = method;
+    c.request = (*inputs_)[size_t(channel_index)];  // shares blocks
+    return c;
+  }
+
+ private:
+  const std::vector<IOBuf>* inputs_;
+};
+
+// Elementwise f32 sum merger (the additive ResponseMerger). Stateful —
+// one instance per call; ParallelChannel folds successes sequentially in
+// channel order, so the internal accumulator needs no locking.
+class SumMerger : public ResponseMerger {
+ public:
+  int Merge(IOBuf* response, const IOBuf& sub_response) override {
+    if (sub_response.size() % 4 != 0) return -1;
+    if (acc_.empty()) {
+      acc_.resize(sub_response.size() / 4, 0.f);
+    } else if (acc_.size() * 4 != sub_response.size()) {
+      return -1;
+    }
+    std::string add = sub_response.to_string();
+    auto* b = reinterpret_cast<const float*>(add.data());
+    for (size_t i = 0; i < acc_.size(); ++i) acc_[i] += b[i];
+    response->clear();
+    response->append(acc_.data(), acc_.size() * 4);
+    return 0;
+  }
+
+ private:
+  std::vector<float> acc_;
+};
+
+// Handle of a live f32 buffer already resident on member `member`'s
+// device, carried in a single user-data block — or 0 (then the bytes are
+// restaged). Placement is validated against the Register-time metadata so
+// a u8 or wrong-device buffer never rides into a launch.
+uint64_t ResidentHandle(const IOBuf& b, int member) {
+  if (b.block_count() != 1) return 0;
+  uint64_t h = b.user_meta_at(0);
+  if (h == 0) return 0;
+  int device = -1, dtype = -1;
+  if (!DeviceBufferRegistry::Info(h, &device, &dtype)) return 0;
+  if (device != member || dtype != int(PjrtClient::DType::kF32)) return 0;
+  return h;
+}
+
+}  // namespace
+
+CollectiveChannel::CollectiveChannel(const CollectiveChannelOptions& opts)
+    : options_(opts) {}
+
+int CollectiveChannel::AddChannel(ChannelBase* sub) {
+  if (sub == nullptr) return EINVAL;
+  subs_.push_back(sub);
+  return 0;
+}
+
+int CollectiveChannel::AllReduceSum(const std::vector<IOBuf>& inputs,
+                                    IOBuf* out, std::string* error) {
+  return Call(Op::kAllReduce, inputs, out, error);
+}
+
+int CollectiveChannel::AllGather(const std::vector<IOBuf>& inputs,
+                                 IOBuf* out, std::string* error) {
+  return Call(Op::kAllGather, inputs, out, error);
+}
+
+int CollectiveChannel::Call(Op op, const std::vector<IOBuf>& inputs,
+                            IOBuf* out, std::string* error) {
+  if (inputs.empty()) {
+    if (error) *error = "no members";
+    return EINVAL;
+  }
+  const size_t n = inputs[0].size();
+  for (const IOBuf& b : inputs) {
+    if (b.size() != n || n % 4 != 0) {
+      if (error) *error = "member payloads must be equal-size f32 vectors";
+      return EINVAL;
+    }
+  }
+  last_used_device_.store(false, std::memory_order_relaxed);
+  PjrtClient* dev = options_.device_client;
+  if (dev != nullptr &&
+      dev->addressable_device_count() >= int(inputs.size())) {
+    std::string dev_err;
+    int rc = DeviceCall(op, inputs, out, &dev_err);
+    if (rc == 0) {
+      last_used_device_.store(true, std::memory_order_relaxed);
+      return 0;
+    }
+    // Bulk-synchronous tier failed: fall back to the partial-failure-
+    // tolerant RPC tier if one is configured (SURVEY §7 hard part (c)).
+    BRT_LOG(WARNING) << "collective device path failed (" << dev_err
+                     << "); trying RPC tier";
+    out->clear();
+  }
+  if (!subs_.empty() && subs_.size() == inputs.size()) {
+    return RpcCall(op, inputs, out, error);
+  }
+  if (error) {
+    *error = dev == nullptr ? "no device fabric and no RPC members"
+                            : "device path failed, no matching RPC tier";
+  }
+  return EIO;
+}
+
+PjrtExecutable* CollectiveChannel::GetExecutable(Op op, size_t n,
+                                                 int members,
+                                                 std::string* error) {
+  const auto key = std::make_tuple(int(op), n, members);
+  {
+    std::lock_guard<std::mutex> g(exe_mu_);
+    auto it = exe_cache_.find(key);
+    if (it != exe_cache_.end()) return it->second.get();
+  }
+  // Compile OUTSIDE the lock: XLA compiles take seconds and must not
+  // serialize cache hits for other shapes. Racing compilers waste at most
+  // one duplicate compile.
+  std::string mlir = op == Op::kAllReduce
+                         ? MlirAllReduceSumF32(n, members)
+                         : MlirAllGatherF32(n, members);
+  auto exe = PjrtExecutable::Compile(options_.device_client, mlir, members,
+                                     error);
+  if (exe == nullptr) return nullptr;
+  std::lock_guard<std::mutex> g(exe_mu_);
+  auto [it, inserted] = exe_cache_.try_emplace(key, std::move(exe));
+  return it->second.get();
+}
+
+int CollectiveChannel::DeviceCall(Op op, const std::vector<IOBuf>& inputs,
+                                  IOBuf* out, std::string* error) {
+  PjrtClient* dev = options_.device_client;
+  const size_t elems = inputs[0].size() / 4;
+  const int members = int(inputs.size());
+  PjrtExecutable* exe = GetExecutable(op, elems, members, error);
+  if (exe == nullptr) return EIO;
+
+  // Stage each member's contribution onto its replica device — unless it
+  // already lives there (single user-data block whose meta is a live
+  // handle: the zero-copy ship-the-handle path).
+  std::vector<uint64_t> handles(inputs.size(), 0);
+  std::vector<bool> owned(inputs.size(), false);
+  auto cleanup_inputs = [&] {
+    for (size_t i = 0; i < handles.size(); ++i) {
+      if (owned[i] && handles[i] != 0) {
+        DeviceBufferRegistry::Release(handles[i]);
+      }
+    }
+  };
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    uint64_t resident = ResidentHandle(inputs[i], int(i));
+    if (resident != 0) {
+      handles[i] = resident;
+      continue;
+    }
+    handles[i] = dev->StageToDeviceShaped(inputs[i], int(i),
+                                          PjrtClient::DType::kF32,
+                                          {int64_t(elems)}, error);
+    owned[i] = true;
+    if (handles[i] == 0) {
+      cleanup_inputs();
+      return EIO;
+    }
+  }
+  std::vector<std::vector<uint64_t>> args(inputs.size());
+  for (size_t i = 0; i < inputs.size(); ++i) args[i] = {handles[i]};
+  std::vector<std::vector<uint64_t>> outs;
+  int rc = exe->Execute(args, &outs, error);
+  cleanup_inputs();
+  if (rc != 0) return rc;
+  // Every replica holds the merged result; land replica 0's bytes and hand
+  // its handle to the caller (meta of the returned block) so the result
+  // can feed the next collective zero-copy — the caller releases it (or
+  // ships it onward). Replicas 1..n-1 are released here.
+  rc = dev->StageFromDevice(outs[0][0], out, error);
+  for (size_t d = 0; d < outs.size(); ++d) {
+    for (uint64_t h : outs[d]) {
+      if (rc == 0 && d == 0 && h == outs[0][0]) continue;  // caller's now
+      DeviceBufferRegistry::Release(h);
+    }
+  }
+  return rc;
+}
+
+int CollectiveChannel::RpcCall(Op op, const std::vector<IOBuf>& inputs,
+                               IOBuf* out, std::string* error) {
+  ParallelChannelOptions popts;
+  popts.fail_limit = options_.fail_limit;
+  popts.timeout_ms = options_.timeout_ms;
+  ParallelChannel pchan(popts);
+  auto mapper = std::make_shared<MemberMapper>(&inputs);
+  std::shared_ptr<ResponseMerger> merger;
+  if (op == Op::kAllReduce) merger = std::make_shared<SumMerger>();
+  // kAllGather keeps the default concat-in-channel-order merger.
+  for (ChannelBase* sub : subs_) pchan.AddChannel(sub, mapper, merger);
+  Controller cntl;
+  cntl.timeout_ms = options_.timeout_ms;
+  const std::string method =
+      op == Op::kAllReduce ? "AllReduce" : "AllGather";
+  pchan.CallMethod("Collective", method, &cntl, IOBuf(), out, nullptr);
+  if (cntl.Failed()) {
+    if (error) *error = cntl.ErrorText();
+    return cntl.ErrorCode() != 0 ? cntl.ErrorCode() : EIO;
+  }
+  return 0;
+}
+
+}  // namespace brt

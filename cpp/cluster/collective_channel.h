@@ -1,0 +1,100 @@
+// CollectiveChannel — the ParallelChannel contract mapped onto the device
+// fabric (the north-star component: SURVEY §2.7/§5.9).
+//
+// The reference fans a call out to N sub-channels with per-sub CallMapper
+// slicing and folds replies through a ResponseMerger with fail_limit
+// partial-failure tolerance (src/brpc/parallel_channel.h:94,127,151,185).
+// On a TPU host the same contract has a *compiled* fast path: the
+// "sub-channels" are the PJRT client's addressable devices, the mapper is
+// which replica a contribution lands on, and the merger is one compiled
+// cross-replica collective riding ICI (device/pjrt_executable.h). XLA
+// collectives are bulk-synchronous, so fail_limit semantics live only on
+// the RPC fallback tier (hard part (c) of SURVEY §7): any device-tier
+// failure falls back to the RPC ParallelChannel fan-out when sub-channels
+// are configured.
+//
+// Data currency: per-member IOBufs. An input that is a user-data block
+// whose 64-bit meta is a live DeviceBufferRegistry handle (the lkey
+// analog, reference src/butil/iobuf.h:250-254 + docs/en/rdma.md:44-46)
+// is consumed IN PLACE — no restaging — so staged tensors and prior
+// collective results compose zero-copy.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "cluster/parallel_channel.h"
+#include "device/pjrt_device.h"
+#include "device/pjrt_executable.h"
+
+namespace brt {
+
+struct CollectiveChannelOptions {
+  // Arms the compiled fast path. May be null (RPC tier only). Not owned.
+  PjrtClient* device_client = nullptr;
+  // RPC-fallback partial-failure budget (reference
+  // ParallelChannelOptions.fail_limit). <0 → any failure fails the call.
+  int fail_limit = -1;
+  int64_t timeout_ms = 1000;
+};
+
+class CollectiveChannel {
+ public:
+  explicit CollectiveChannel(
+      const CollectiveChannelOptions& opts = CollectiveChannelOptions());
+
+  // Adds an RPC fallback member (the DCN tier). Sub-channel i receives
+  // member i's contribution with method `method` ("AllReduce"/"AllGather")
+  // on service "Collective" and must reply with its own f32 vector.
+  int AddChannel(ChannelBase* sub);
+  int member_count() const { return int(subs_.size()); }
+
+  // One collective call: member i contributes inputs[i] (an f32 vector;
+  // all the same length). AllReduceSum merges elementwise sums,
+  // AllGather concatenates in member order (the reference's default
+  // "append responses in channel order" merger). Fast path: ONE compiled
+  // launch across inputs.size() devices. Fallback: ParallelChannel
+  // fan-out + merge with fail_limit. Returns 0 on success.
+  //
+  // Device-path results carry their replica-0 output handle as the
+  // returned block's meta, OWNED BY THE CALLER: release it
+  // (DeviceBufferRegistry::Release(out->user_meta_at(0))) when done, or
+  // feed `*out` into a later collective to consume it in place. RPC-tier
+  // results are plain bytes (meta 0).
+  int AllReduceSum(const std::vector<IOBuf>& inputs, IOBuf* out,
+                   std::string* error);
+  int AllGather(const std::vector<IOBuf>& inputs, IOBuf* out,
+                std::string* error);
+
+  // True if the last successful call rode the compiled device path.
+  // (Channel-wide, not per-caller: under concurrent calls this reports
+  // the most recent call's path.)
+  bool last_used_device() const {
+    return last_used_device_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  enum class Op { kAllReduce, kAllGather };
+  int Call(Op op, const std::vector<IOBuf>& inputs, IOBuf* out,
+           std::string* error);
+  int DeviceCall(Op op, const std::vector<IOBuf>& inputs, IOBuf* out,
+                 std::string* error);
+  int RpcCall(Op op, const std::vector<IOBuf>& inputs, IOBuf* out,
+              std::string* error);
+  PjrtExecutable* GetExecutable(Op op, size_t n, int members,
+                                std::string* error);
+
+  CollectiveChannelOptions options_;
+  std::vector<ChannelBase*> subs_;
+  std::mutex exe_mu_;
+  std::map<std::tuple<int, size_t, int>, std::unique_ptr<PjrtExecutable>>
+      exe_cache_;
+  std::atomic<bool> last_used_device_{false};
+};
+
+}  // namespace brt

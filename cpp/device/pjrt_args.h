@@ -1,0 +1,20 @@
+// Zero-initialized PJRT C API arg structs with struct_size set — the
+// calling convention every PJRT_* call requires. Shared by the device
+// layer's translation units (device-internal; include only from .cc files
+// that also include third_party/pjrt/pjrt_c_api.h).
+#pragma once
+
+#include <cstring>
+
+namespace brt {
+
+template <typename T>
+T MakePjrtArgs(size_t size) {
+  T args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = size;
+  return args;
+}
+#define BRT_PJRT_ARGS(T) ::brt::MakePjrtArgs<T>(T##_STRUCT_SIZE)
+
+}  // namespace brt

@@ -9,25 +9,11 @@
 #include <unordered_map>
 
 #include "base/logging.h"
+#include "device/pjrt_args.h"
 #include "fiber/butex.h"
 #include "third_party/pjrt/pjrt_c_api.h"
 
 namespace brt {
-
-namespace {
-
-// Zero-initialized arg struct with struct_size set — the C API's required
-// calling convention.
-template <typename T>
-T MakeArgs(size_t size) {
-  T args;
-  memset(&args, 0, sizeof(args));
-  args.struct_size = size;
-  return args;
-}
-#define BRT_PJRT_ARGS(T) MakeArgs<T>(T##_STRUCT_SIZE)
-
-}  // namespace
 
 // ---------------------------------------------------------------------------
 // PjrtApi
@@ -180,6 +166,8 @@ struct RegisteredBuffer {
   PJRT_Buffer* buf;
   int refs;   // 1 registry ref (until Release) + one per outstanding Pin
   bool dead;  // Release() called; Lookup/Pin fail from then on
+  int device = -1;  // placement metadata (see Register)
+  int dtype = -1;
 };
 
 std::mutex g_reg_mu;
@@ -200,11 +188,23 @@ void DestroyPjrtBuffer(const PjrtApi* api, PJRT_Buffer* buf) {
 }  // namespace
 
 uint64_t DeviceBufferRegistry::Register(const PjrtApi* api,
-                                        PJRT_Buffer* buf) {
+                                        PJRT_Buffer* buf, int device_index,
+                                        int dtype) {
   const uint64_t h = g_next_handle.fetch_add(1, std::memory_order_relaxed);
   std::lock_guard<std::mutex> g(g_reg_mu);
-  registry()[h] = RegisteredBuffer{api, buf, /*refs=*/1, /*dead=*/false};
+  registry()[h] = RegisteredBuffer{api,   buf,          /*refs=*/1,
+                                   false, device_index, dtype};
   return h;
+}
+
+bool DeviceBufferRegistry::Info(uint64_t handle, int* device_index,
+                                int* dtype) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  auto it = registry().find(handle);
+  if (it == registry().end() || it->second.dead) return false;
+  if (device_index != nullptr) *device_index = it->second.device;
+  if (dtype != nullptr) *dtype = it->second.dtype;
+  return true;
 }
 
 PJRT_Buffer* DeviceBufferRegistry::Lookup(uint64_t handle) {
@@ -413,8 +413,23 @@ void ReleaseHostPin(PJRT_Error* err, void* user_arg) {
 
 uint64_t PjrtClient::StageToDevice(const IOBuf& data, int device_index,
                                    std::string* error) {
+  return StageToDeviceShaped(data, device_index, DType::kU8,
+                             {int64_t(data.size())}, error);
+}
+
+uint64_t PjrtClient::StageToDeviceShaped(const IOBuf& data, int device_index,
+                                         DType dtype,
+                                         const std::vector<int64_t>& dims,
+                                         std::string* error) {
   if (device_index < 0 || device_index >= addressable_device_count()) {
     if (error) *error = "bad device index";
+    return 0;
+  }
+  size_t elem = dtype == DType::kU8 ? 1 : 4;
+  int64_t nelem = 1;
+  for (int64_t d : dims) nelem *= d;
+  if (size_t(nelem) * elem != data.size()) {
+    if (error) *error = "dims do not match payload size";
     return 0;
   }
   // The DMA source must be one contiguous region. Single-block payloads
@@ -442,10 +457,13 @@ uint64_t PjrtClient::StageToDevice(const IOBuf& data, int device_index,
   auto args = BRT_PJRT_ARGS(PJRT_Client_BufferFromHostBuffer_Args);
   args.client = client_;
   args.data = base;
-  args.type = PJRT_Buffer_Type_U8;
-  const int64_t dims[1] = {int64_t(len)};
-  args.dims = dims;
-  args.num_dims = 1;
+  switch (dtype) {
+    case DType::kU8: args.type = PJRT_Buffer_Type_U8; break;
+    case DType::kF32: args.type = PJRT_Buffer_Type_F32; break;
+    case DType::kS32: args.type = PJRT_Buffer_Type_S32; break;
+  }
+  args.dims = dims.data();
+  args.num_dims = dims.size();
   args.host_buffer_semantics =
       PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
   args.device = addressable_[size_t(device_index)];
@@ -468,7 +486,8 @@ uint64_t PjrtClient::StageToDevice(const IOBuf& data, int device_index,
       // use-after-free DMA; this path indicates a broken plugin.
     }
   }
-  return DeviceBufferRegistry::Register(api_, args.buffer);
+  return DeviceBufferRegistry::Register(api_, args.buffer, device_index,
+                                        int(dtype));
 }
 
 int PjrtClient::StageFromDevice(uint64_t handle, IOBuf* out,

@@ -95,7 +95,13 @@ class PjrtEvent {
 // drops.
 class DeviceBufferRegistry {
  public:
-  static uint64_t Register(const PjrtApi* api, PJRT_Buffer* buf);
+  // device_index / dtype record where the buffer lives and what it holds
+  // (dtype = int(PjrtClient::DType), -1 unknown) so consumers that accept
+  // shipped handles can validate placement before a launch.
+  static uint64_t Register(const PjrtApi* api, PJRT_Buffer* buf,
+                           int device_index = -1, int dtype = -1);
+  // Placement metadata recorded at Register time. False if stale/dead.
+  static bool Info(uint64_t handle, int* device_index, int* dtype);
   // Live buffer for the handle, or nullptr. Non-owning peek: the result is
   // only safe to use while the caller otherwise guarantees no concurrent
   // Release (use Pin/Unpin across blocking operations).
@@ -147,9 +153,14 @@ class PjrtClient {
   ~PjrtClient();
 
   const PjrtApi* api() const { return api_; }
+  PJRT_Client* raw_client() const { return client_; }
   std::string platform_name() const;
   int addressable_device_count() const;
   PJRT_Device* addressable_device(int i) const;
+
+  // Element type for shaped staging (subset the fabric needs; mapped to
+  // PJRT_Buffer_Type internally).
+  enum class DType { kU8, kF32, kS32 };
 
   // DMAs `data` (treated as a 1-D u8 array — the RPC payload level) into
   // device memory on addressable device `device_index`. Zero host copies
@@ -159,6 +170,14 @@ class PjrtClient {
   // block first. Returns a DeviceBufferRegistry handle (0 on failure).
   uint64_t StageToDevice(const IOBuf& data, int device_index,
                          std::string* error);
+
+  // Shaped variant for executable arguments: stages `data` as an array of
+  // `dtype` with the given dims (byte size must match). Same zero-copy /
+  // host-pin behavior as StageToDevice.
+  uint64_t StageToDeviceShaped(const IOBuf& data, int device_index,
+                               DType dtype,
+                               const std::vector<int64_t>& dims,
+                               std::string* error);
 
   // DMAs the device buffer behind `handle` back to host, landing the bytes
   // directly in a fresh block appended to `out` as user data with
