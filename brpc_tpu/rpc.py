@@ -64,6 +64,38 @@ def _load():
     lib.brt_event_set.argtypes = [ctypes.c_void_p]
     lib.brt_event_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.brt_event_destroy.argtypes = [ctypes.c_void_p]
+    # device fabric (native PJRT staging + compiled execution)
+    lib.brt_device_client_new.restype = ctypes.c_void_p
+    lib.brt_device_client_new.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_device_count.argtypes = [ctypes.c_void_p]
+    lib.brt_device_stage.restype = ctypes.c_uint64
+    lib.brt_device_stage.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_device_stage_shaped.restype = ctypes.c_uint64
+    lib.brt_device_stage_shaped.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_device_fetch.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_device_release.argtypes = [ctypes.c_uint64]
+    lib.brt_device_client_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_mlir_module.restype = ctypes.c_void_p
+    lib.brt_mlir_module.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+    lib.brt_device_compile.restype = ctypes.c_void_p
+    lib.brt_device_compile.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_size_t]
+    lib.brt_device_executable_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.brt_device_execute.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_device_executable_destroy.argtypes = [ctypes.c_void_p]
     lib.brt_init(0)
     _lib = lib
     return lib
@@ -189,4 +221,122 @@ class Channel:
     def close(self) -> None:
         if self._ptr:
             self._lib.brt_channel_destroy(self._ptr)
+            self._ptr = None
+
+
+class DeviceExecutable:
+    """A compiled StableHLO program launched via the native executable tier
+    (cpp/device/pjrt_executable.cc) — no JAX in the launch path."""
+
+    def __init__(self, lib, ptr):
+        self._lib = lib
+        self._ptr = ptr
+        self.num_outputs = lib.brt_device_executable_num_outputs(ptr)
+
+    def execute(self, args, nreplicas: int = 1):
+        """args: flat list of buffer handles, row-major [replica][arg].
+        Returns [replica][output] handles (release each when done)."""
+        nargs = len(args) // nreplicas
+        a = (ctypes.c_uint64 * len(args))(*args)
+        outs = (ctypes.c_uint64 * (nreplicas * self.num_outputs))()
+        errbuf = ctypes.create_string_buffer(512)
+        rc = self._lib.brt_device_execute(
+            self._ptr, a, nargs, nreplicas, outs, len(outs), errbuf, 512)
+        if rc != 0:
+            raise RpcError(rc, errbuf.value.decode(errors="replace"))
+        flat = list(outs)
+        return [flat[d * self.num_outputs:(d + 1) * self.num_outputs]
+                for d in range(nreplicas)]
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.brt_device_executable_destroy(self._ptr)
+            self._ptr = None
+
+
+class DeviceClient:
+    """Native PJRT device fabric: staging + compiled execution, addressed by
+    64-bit buffer handles (the RDMA-lkey analog). This is the binding the PS
+    tier uses to keep embedding tables resident in HBM
+    (brpc_tpu/ps_remote.py) — bytes move host<->device by DMA through the
+    native layer, not through JAX."""
+
+    DTYPE = {"u8": 0, "f32": 1, "i32": 2}
+
+    def __init__(self, plugin_path: Optional[str] = None):
+        self._lib = _load()
+        errbuf = ctypes.create_string_buffer(512)
+        self._ptr = self._lib.brt_device_client_new(
+            plugin_path.encode() if plugin_path else None, errbuf, 512)
+        if not self._ptr:
+            raise RuntimeError(
+                f"device client: {errbuf.value.decode(errors='replace')}")
+
+    @property
+    def device_count(self) -> int:
+        return self._lib.brt_device_count(self._ptr)
+
+    def stage(self, data, device_index: int = 0, dtype: str = "u8",
+              dims=None) -> int:
+        """DMAs bytes (or a numpy array) into device memory; returns a
+        buffer handle."""
+        import numpy as np
+        if isinstance(data, np.ndarray):
+            if dims is None:
+                dims = list(data.shape)
+            if dtype == "u8" and data.dtype != np.uint8:
+                dtype = {"float32": "f32", "int32": "i32"}.get(
+                    data.dtype.name, dtype)
+            data = np.ascontiguousarray(data).tobytes()
+        if dims is None:
+            dims = [len(data)]
+        errbuf = ctypes.create_string_buffer(512)
+        d = (ctypes.c_int64 * len(dims))(*dims)
+        h = self._lib.brt_device_stage_shaped(
+            self._ptr, data, len(data), device_index, self.DTYPE[dtype], d,
+            len(dims), errbuf, 512)
+        if h == 0:
+            raise RpcError(5002, errbuf.value.decode(errors="replace"))
+        return h
+
+    def fetch(self, handle: int) -> bytes:
+        """DMAs the buffer behind handle back to host (fiber parks during
+        the DMA); the buffer stays resident until released."""
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_size_t()
+        errbuf = ctypes.create_string_buffer(512)
+        rc = self._lib.brt_device_fetch(
+            self._ptr, handle, ctypes.byref(out), ctypes.byref(out_len),
+            errbuf, 512)
+        if rc != 0:
+            raise RpcError(rc, errbuf.value.decode(errors="replace"))
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.brt_free(out)
+
+    def release(self, handle: int) -> None:
+        self._lib.brt_device_release(handle)
+
+    def mlir(self, kind: str, p0: int, p1: int = 0, p2: int = 0) -> str:
+        p = self._lib.brt_mlir_module(kind.encode(), p0, p1, p2)
+        if not p:
+            raise ValueError(f"unknown mlir builder kind {kind!r}")
+        try:
+            return ctypes.string_at(p).decode()
+        finally:
+            self._lib.brt_free(p)
+
+    def compile(self, mlir_text: str,
+                num_replicas: int = 1) -> DeviceExecutable:
+        errbuf = ctypes.create_string_buffer(1024)
+        ptr = self._lib.brt_device_compile(
+            self._ptr, mlir_text.encode(), num_replicas, errbuf, 1024)
+        if not ptr:
+            raise RpcError(5003, errbuf.value.decode(errors="replace"))
+        return DeviceExecutable(self._lib, ptr)
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.brt_device_client_destroy(self._ptr)
             self._ptr = None

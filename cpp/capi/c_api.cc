@@ -177,6 +177,7 @@ void brt_event_destroy(void* event) {
 // ---- device staging (cpp/device/pjrt_device.h) ----
 
 #include "device/pjrt_device.h"
+#include "device/pjrt_executable.h"
 
 extern "C" {
 
@@ -234,6 +235,103 @@ int brt_device_fetch(void* client, uint64_t handle, void** out,
 
 int brt_device_release(uint64_t handle) {
   return brt::DeviceBufferRegistry::Release(handle) ? 0 : EINVAL;
+}
+
+uint64_t brt_device_stage_shaped(void* client, const void* data, size_t len,
+                                 int device_index, int dtype,
+                                 const int64_t* dims, size_t ndims,
+                                 char* errbuf, size_t errbuf_len) {
+  if (dtype < 0 || dtype > 2) {
+    if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "bad dtype");
+    return 0;
+  }
+  brt::IOBuf buf;
+  buf.append(data, len);
+  std::string err;
+  uint64_t h = static_cast<brt::PjrtClient*>(client)->StageToDeviceShaped(
+      buf, device_index, brt::PjrtClient::DType(dtype),
+      std::vector<int64_t>(dims, dims + ndims), &err);
+  if (h == 0 && errbuf && errbuf_len) {
+    snprintf(errbuf, errbuf_len, "%s", err.c_str());
+  }
+  return h;
+}
+
+char* brt_mlir_module(const char* kind, int64_t p0, int64_t p1, int64_t p2) {
+  std::string k(kind ? kind : ""), text;
+  if (k == "add") {
+    text = brt::MlirAddF32(size_t(p0));
+  } else if (k == "reduce_sum") {
+    text = brt::MlirReduceSumF32(size_t(p0));
+  } else if (k == "all_reduce_sum") {
+    text = brt::MlirAllReduceSumF32(size_t(p0), int(p1));
+  } else if (k == "all_gather") {
+    text = brt::MlirAllGatherF32(size_t(p0), int(p1));
+  } else if (k == "gather_rows") {
+    text = brt::MlirGatherRowsF32(size_t(p0), size_t(p1), size_t(p2));
+  } else if (k == "scatter_sub") {
+    text = brt::MlirScatterSubF32(size_t(p0), size_t(p1), size_t(p2));
+  } else {
+    return nullptr;
+  }
+  char* out = static_cast<char*>(malloc(text.size() + 1));
+  if (out == nullptr) return nullptr;
+  memcpy(out, text.c_str(), text.size() + 1);
+  return out;
+}
+
+void* brt_device_compile(void* client, const char* mlir, int num_replicas,
+                         char* errbuf, size_t errbuf_len) {
+  std::string err;
+  auto exe = brt::PjrtExecutable::Compile(
+      static_cast<brt::PjrtClient*>(client), mlir, num_replicas, &err);
+  if (exe == nullptr) {
+    if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "%s", err.c_str());
+    return nullptr;
+  }
+  return exe.release();
+}
+
+int brt_device_executable_num_outputs(void* exe) {
+  return static_cast<brt::PjrtExecutable*>(exe)->num_outputs();
+}
+
+int brt_device_execute(void* exe, const uint64_t* args, size_t nargs,
+                       size_t nreplicas, uint64_t* outs, size_t outs_cap,
+                       char* errbuf, size_t errbuf_len) {
+  auto* e = static_cast<brt::PjrtExecutable*>(exe);
+  if (size_t(e->num_replicas()) != nreplicas) {
+    if (errbuf && errbuf_len) {
+      snprintf(errbuf, errbuf_len, "nreplicas != %d", e->num_replicas());
+    }
+    return EINVAL;
+  }
+  const size_t nouts = size_t(e->num_outputs());
+  if (outs_cap < nreplicas * nouts) {
+    if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "outs too small");
+    return EINVAL;
+  }
+  std::vector<std::vector<uint64_t>> arg_lists(nreplicas);
+  for (size_t d = 0; d < nreplicas; ++d) {
+    arg_lists[d].assign(args + d * nargs, args + (d + 1) * nargs);
+  }
+  std::vector<std::vector<uint64_t>> out_lists;
+  std::string err;
+  int rc = e->Execute(arg_lists, &out_lists, &err);
+  if (rc != 0) {
+    if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "%s", err.c_str());
+    return rc;
+  }
+  for (size_t d = 0; d < nreplicas; ++d) {
+    for (size_t o = 0; o < nouts; ++o) {
+      outs[d * nouts + o] = out_lists[d][o];
+    }
+  }
+  return 0;
+}
+
+void brt_device_executable_destroy(void* exe) {
+  delete static_cast<brt::PjrtExecutable*>(exe);
 }
 
 void brt_device_client_destroy(void* client) {

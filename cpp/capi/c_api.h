@@ -70,6 +70,31 @@ int brt_device_fetch(void* client, uint64_t handle, void** out,
 int brt_device_release(uint64_t handle);
 void brt_device_client_destroy(void* client);
 
+// ---- compiled execution (device/pjrt_executable.h) ----
+// Shaped staging for executable arguments. dtype: 0=u8, 1=f32, 2=i32.
+// len must equal product(dims)*elemsize. Returns a handle (0 on failure).
+uint64_t brt_device_stage_shaped(void* client, const void* data, size_t len,
+                                 int device_index, int dtype,
+                                 const int64_t* dims, size_t ndims,
+                                 char* errbuf, size_t errbuf_len);
+// Textual StableHLO from the builtin builders (device/pjrt_executable.h).
+// kind: "add"|"reduce_sum"|"all_reduce_sum"|"all_gather" (p0=n,
+// p1=replicas) or "gather_rows"|"scatter_sub" (p0=rows, p1=dim, p2=k).
+// malloc'd string (free with brt_free); NULL on unknown kind.
+char* brt_mlir_module(const char* kind, int64_t p0, int64_t p1, int64_t p2);
+// Compiles textual StableHLO for num_replicas. NULL on failure.
+void* brt_device_compile(void* client, const char* mlir, int num_replicas,
+                         char* errbuf, size_t errbuf_len);
+int brt_device_executable_num_outputs(void* exe);
+// Launches across all replicas. args is row-major [nreplicas][nargs]
+// buffer handles; outs receives [nreplicas][num_outputs] fresh handles
+// (caller must brt_device_release each). The calling fiber/thread parks
+// until every replica completes. Returns 0 on success.
+int brt_device_execute(void* exe, const uint64_t* args, size_t nargs,
+                       size_t nreplicas, uint64_t* outs, size_t outs_cap,
+                       char* errbuf, size_t errbuf_len);
+void brt_device_executable_destroy(void* exe);
+
 // ---- fiber events (the "yield on TPU stream events" bridge) ----
 // A native fiber can wait without blocking its worker pthread while any
 // thread (e.g. a JAX async-dispatch completion callback in Python) sets
