@@ -1,0 +1,355 @@
+// HPACK implementation. See hpack.h for the design notes; constant tables
+// (RFC 7541 appendices) live in hpack_tables.h.
+#include "rpc/hpack.h"
+
+#include <cstring>
+#include <mutex>
+
+#include "rpc/hpack_tables.h"
+
+namespace brt {
+
+using hpack_tables::kHuffman;
+using hpack_tables::kStatic;
+
+constexpr uint32_t kStaticCount = 61;
+constexpr uint32_t kEntryOverhead = 32;  // RFC 7541 §4.1
+
+// ---------------- integers ----------------
+
+void HpackEncodeInt(std::string* out, uint8_t first_byte_flags,
+                    int prefix_bits, uint64_t value) {
+  const uint64_t limit = (1ull << prefix_bits) - 1;
+  if (value < limit) {
+    out->push_back(char(first_byte_flags | uint8_t(value)));
+    return;
+  }
+  out->push_back(char(first_byte_flags | uint8_t(limit)));
+  value -= limit;
+  while (value >= 128) {
+    out->push_back(char(0x80 | (value & 0x7f)));
+    value >>= 7;
+  }
+  out->push_back(char(value));
+}
+
+int HpackDecodeInt(const uint8_t* in, size_t n, int prefix_bits,
+                   uint64_t* value) {
+  if (n == 0) return 0;
+  const uint64_t limit = (1ull << prefix_bits) - 1;
+  uint64_t v = in[0] & limit;
+  if (v < limit) {
+    *value = v;
+    return 1;
+  }
+  uint64_t shift = 0;
+  for (size_t i = 1; i < n; ++i) {
+    const uint64_t b = in[i] & 0x7f;
+    if (shift >= 63 || (b << shift) >> shift != b) return -1;  // overflow
+    v += b << shift;
+    shift += 7;
+    if ((in[i] & 0x80) == 0) {
+      *value = v;
+      return int(i + 1);
+    }
+    if (i > 10) return -1;  // > 70 bits of continuation: malformed
+  }
+  return 0;  // truncated
+}
+
+// ---------------- Huffman ----------------
+
+size_t HuffmanEncodedSize(const std::string& in) {
+  uint64_t bits = 0;
+  for (unsigned char c : in) bits += kHuffman[c].nbits;
+  return size_t((bits + 7) / 8);
+}
+
+void HuffmanEncode(const std::string& in, std::string* out) {
+  uint64_t acc = 0;
+  int nacc = 0;
+  for (unsigned char c : in) {
+    const auto& h = kHuffman[c];
+    acc = (acc << h.nbits) | h.code;
+    nacc += h.nbits;
+    while (nacc >= 8) {
+      nacc -= 8;
+      out->push_back(char(uint8_t(acc >> nacc)));
+    }
+  }
+  if (nacc > 0) {
+    // Pad with the MSBs of EOS (all ones), RFC 7541 §5.2.
+    out->push_back(char(uint8_t((acc << (8 - nacc)) | (0xff >> nacc))));
+  }
+}
+
+namespace {
+
+// Binary trie for decoding; 513 nodes max (257 leaves). Built once.
+struct HuffNode {
+  int16_t child[2] = {-1, -1};
+  int16_t sym = -1;  // 0-255 byte, 256 EOS
+};
+
+struct HuffTrie {
+  std::vector<HuffNode> nodes;
+  HuffTrie() {
+    nodes.emplace_back();
+    for (int s = 0; s < 257; ++s) {
+      const auto& h = kHuffman[s];
+      int cur = 0;
+      for (int b = h.nbits - 1; b >= 0; --b) {
+        const int bit = (h.code >> b) & 1;
+        if (nodes[cur].child[bit] < 0) {
+          nodes[cur].child[bit] = int16_t(nodes.size());
+          nodes.emplace_back();
+        }
+        cur = nodes[cur].child[bit];
+      }
+      nodes[cur].sym = int16_t(s);
+    }
+  }
+};
+
+const HuffTrie& huff_trie() {
+  static const HuffTrie t;
+  return t;
+}
+
+}  // namespace
+
+bool HuffmanDecode(const uint8_t* in, size_t n, std::string* out) {
+  const HuffTrie& t = huff_trie();
+  int cur = 0;
+  int depth = 0;       // bits consumed since last emitted symbol
+  bool all_ones = true;  // current partial path is a valid EOS-prefix pad
+  for (size_t i = 0; i < n; ++i) {
+    for (int b = 7; b >= 0; --b) {
+      const int bit = (in[i] >> b) & 1;
+      cur = t.nodes[cur].child[bit];
+      if (cur < 0) return false;
+      if (bit == 0) all_ones = false;
+      ++depth;
+      const int16_t sym = t.nodes[cur].sym;
+      if (sym >= 0) {
+        if (sym == 256) return false;  // explicit EOS is a coding error
+        out->push_back(char(uint8_t(sym)));
+        cur = 0;
+        depth = 0;
+        all_ones = true;
+      }
+    }
+  }
+  // Padding must be < 8 bits and equal to the MSBs of EOS (all ones).
+  return depth < 8 && all_ones;
+}
+
+// ---------------- encoder ----------------
+
+HpackEncoder::HpackEncoder(uint32_t max_table_size)
+    : max_size_(max_table_size) {}
+
+void HpackEncoder::SetMaxTableSize(uint32_t bytes) {
+  if (bytes == max_size_) return;
+  max_size_ = bytes;
+  pending_size_update_ = bytes;
+  while (size_ > max_size_) {
+    const Entry& e = dynamic_.back();
+    size_ -= uint32_t(e.name.size() + e.value.size() + kEntryOverhead);
+    dynamic_.pop_back();
+  }
+}
+
+uint32_t HpackEncoder::FindFull(const std::string& name,
+                                const std::string& value) const {
+  for (uint32_t i = 0; i < kStaticCount; ++i) {
+    if (name == kStatic[i].name && value == kStatic[i].value) return i + 1;
+  }
+  for (size_t i = 0; i < dynamic_.size(); ++i) {
+    if (dynamic_[i].name == name && dynamic_[i].value == value) {
+      return uint32_t(kStaticCount + 1 + i);
+    }
+  }
+  return 0;
+}
+
+uint32_t HpackEncoder::FindName(const std::string& name) const {
+  for (uint32_t i = 0; i < kStaticCount; ++i) {
+    if (name == kStatic[i].name) return i + 1;
+  }
+  for (size_t i = 0; i < dynamic_.size(); ++i) {
+    if (dynamic_[i].name == name) return uint32_t(kStaticCount + 1 + i);
+  }
+  return 0;
+}
+
+void HpackEncoder::Insert(const std::string& name, const std::string& value) {
+  const uint32_t sz = uint32_t(name.size() + value.size() + kEntryOverhead);
+  while (!dynamic_.empty() && size_ + sz > max_size_) {
+    const Entry& e = dynamic_.back();
+    size_ -= uint32_t(e.name.size() + e.value.size() + kEntryOverhead);
+    dynamic_.pop_back();
+  }
+  if (sz <= max_size_) {
+    dynamic_.push_front(Entry{name, value});
+    size_ += sz;
+  }
+}
+
+void HpackEncoder::EncodeString(const std::string& s, std::string* out) {
+  // Prefer Huffman on ties — matches the RFC Appendix C encodings.
+  const size_t hlen = HuffmanEncodedSize(s);
+  if (hlen <= s.size()) {
+    HpackEncodeInt(out, 0x80, 7, hlen);
+    HuffmanEncode(s, out);
+  } else {
+    HpackEncodeInt(out, 0x00, 7, s.size());
+    out->append(s);
+  }
+}
+
+void HpackEncoder::Encode(const HeaderList& headers, std::string* out) {
+  if (pending_size_update_ != UINT32_MAX) {
+    HpackEncodeInt(out, 0x20, 5, pending_size_update_);
+    pending_size_update_ = UINT32_MAX;
+  }
+  for (const HeaderField& h : headers) {
+    if (h.never_index) {
+      const uint32_t ni = FindName(h.name);
+      HpackEncodeInt(out, 0x10, 4, ni);  // never-indexed literal
+      if (ni == 0) EncodeString(h.name, out);
+      EncodeString(h.value, out);
+      continue;
+    }
+    const uint32_t full = FindFull(h.name, h.value);
+    if (full != 0) {
+      HpackEncodeInt(out, 0x80, 7, full);  // indexed field
+      continue;
+    }
+    const uint32_t ni = FindName(h.name);
+    HpackEncodeInt(out, 0x40, 6, ni);  // literal w/ incremental indexing
+    if (ni == 0) EncodeString(h.name, out);
+    EncodeString(h.value, out);
+    Insert(h.name, h.value);
+  }
+}
+
+// ---------------- decoder ----------------
+
+HpackDecoder::HpackDecoder(uint32_t max_table_size)
+    : max_size_(max_table_size), settings_max_(max_table_size) {}
+
+void HpackDecoder::SetMaxTableSize(uint32_t bytes) {
+  settings_max_ = bytes;
+  if (max_size_ > settings_max_) max_size_ = settings_max_;
+  EvictTo(max_size_);
+}
+
+void HpackDecoder::EvictTo(uint32_t limit) {
+  while (size_ > limit && !dynamic_.empty()) {
+    const Entry& e = dynamic_.back();
+    size_ -= uint32_t(e.name.size() + e.value.size() + kEntryOverhead);
+    dynamic_.pop_back();
+  }
+}
+
+void HpackDecoder::Insert(const std::string& name, const std::string& value) {
+  const uint32_t sz = uint32_t(name.size() + value.size() + kEntryOverhead);
+  EvictTo(max_size_ >= sz ? max_size_ - sz : 0);
+  if (sz <= max_size_) {
+    dynamic_.push_front(Entry{name, value});
+    size_ += sz;
+  } else {
+    EvictTo(0);  // an entry larger than the table empties it (RFC §4.4)
+  }
+}
+
+bool HpackDecoder::GetIndexed(uint64_t index, std::string* name,
+                              std::string* value) const {
+  if (index == 0) return false;
+  if (index <= kStaticCount) {
+    *name = kStatic[index - 1].name;
+    *value = kStatic[index - 1].value;
+    return true;
+  }
+  const uint64_t di = index - kStaticCount - 1;
+  if (di >= dynamic_.size()) return false;
+  *name = dynamic_[di].name;
+  *value = dynamic_[di].value;
+  return true;
+}
+
+int HpackDecoder::DecodeString(const uint8_t* in, size_t n, std::string* out) {
+  if (n == 0) return -1;
+  const bool huffman = (in[0] & 0x80) != 0;
+  uint64_t len = 0;
+  const int c = HpackDecodeInt(in, n, 7, &len);
+  if (c <= 0) return -1;
+  if (len > n - size_t(c)) return -1;
+  if (len > (64u << 20)) return -1;  // 64MB single-string bound
+  if (huffman) {
+    if (!HuffmanDecode(in + c, size_t(len), out)) return -1;
+  } else {
+    out->assign(reinterpret_cast<const char*>(in + c), size_t(len));
+  }
+  return c + int(len);
+}
+
+bool HpackDecoder::Decode(const uint8_t* in, size_t n, HeaderList* out) {
+  bool seen_field = false;
+  while (n > 0) {
+    const uint8_t b = in[0];
+    if (b & 0x80) {  // indexed header field
+      uint64_t idx = 0;
+      const int c = HpackDecodeInt(in, n, 7, &idx);
+      if (c <= 0) return false;
+      HeaderField f;
+      if (!GetIndexed(idx, &f.name, &f.value)) return false;
+      out->push_back(std::move(f));
+      in += c;
+      n -= size_t(c);
+      seen_field = true;
+    } else if ((b & 0xe0) == 0x20) {  // dynamic table size update
+      // Must precede any field in the block (RFC 7541 §4.2).
+      if (seen_field) return false;
+      uint64_t sz = 0;
+      const int c = HpackDecodeInt(in, n, 5, &sz);
+      if (c <= 0) return false;
+      if (sz > settings_max_) return false;
+      max_size_ = uint32_t(sz);
+      EvictTo(max_size_);
+      in += c;
+      n -= size_t(c);
+    } else {  // literal (incremental 0x40 / without 0x00 / never 0x10)
+      const bool incremental = (b & 0xc0) == 0x40;
+      const bool never = (b & 0xf0) == 0x10;
+      const int prefix = incremental ? 6 : 4;
+      uint64_t idx = 0;
+      int c = HpackDecodeInt(in, n, prefix, &idx);
+      if (c <= 0) return false;
+      in += c;
+      n -= size_t(c);
+      HeaderField f;
+      f.never_index = never;
+      if (idx != 0) {
+        std::string unused;
+        if (!GetIndexed(idx, &f.name, &unused)) return false;
+      } else {
+        c = DecodeString(in, n, &f.name);
+        if (c < 0) return false;
+        in += c;
+        n -= size_t(c);
+      }
+      c = DecodeString(in, n, &f.value);
+      if (c < 0) return false;
+      in += c;
+      n -= size_t(c);
+      if (incremental) Insert(f.name, f.value);
+      out->push_back(std::move(f));
+      seen_field = true;
+    }
+  }
+  return true;
+}
+
+}  // namespace brt

@@ -1,0 +1,99 @@
+// HPACK (RFC 7541) header compression for the native HTTP/2 tier.
+// Parity target: reference src/brpc/details/hpack.{h,cpp} (880 LoC —
+// static+dynamic table, Huffman coding, integer prefix varints).
+// Redesigned: one encoder/decoder pair per h2 connection direction; the
+// Huffman decoder walks a binary trie built once at startup from the RFC
+// Appendix B table (hpack_tables.h) instead of the reference's
+// hand-unrolled state machine.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace brt {
+
+struct HeaderField {
+  std::string name;   // lowercase on the wire (h2 requirement)
+  std::string value;
+  // Sensitive fields are emitted as never-indexed literals (RFC 7541 §6.2.3)
+  // and excluded from the dynamic table on both sides.
+  bool never_index = false;
+};
+
+using HeaderList = std::vector<HeaderField>;
+
+// Prefix-coded integer primitives (RFC 7541 §5.1), exposed for tests.
+// first_byte_flags is OR'd into the first octet above the prefix.
+void HpackEncodeInt(std::string* out, uint8_t first_byte_flags,
+                    int prefix_bits, uint64_t value);
+// Returns consumed bytes, 0 if *in* is truncated, -1 on overflow/malformed.
+int HpackDecodeInt(const uint8_t* in, size_t n, int prefix_bits,
+                   uint64_t* value);
+
+// Huffman primitives (RFC 7541 §5.2), exposed for tests.
+void HuffmanEncode(const std::string& in, std::string* out);
+bool HuffmanDecode(const uint8_t* in, size_t n, std::string* out);
+size_t HuffmanEncodedSize(const std::string& in);
+
+class HpackEncoder {
+ public:
+  explicit HpackEncoder(uint32_t max_table_size = 4096);
+
+  // Appends the encoded header block for `headers` to *out.
+  void Encode(const HeaderList& headers, std::string* out);
+
+  // Lowers the dynamic-table ceiling (emits a table-size-update in the next
+  // block, RFC 7541 §6.3) — h2 SETTINGS_HEADER_TABLE_SIZE plumbing.
+  void SetMaxTableSize(uint32_t bytes);
+
+  uint32_t table_size() const { return size_; }
+
+ private:
+  struct Entry {
+    std::string name, value;
+  };
+  // Returns 1-based HPACK index of a full match / name match, 0 if none.
+  uint32_t FindFull(const std::string& name, const std::string& value) const;
+  uint32_t FindName(const std::string& name) const;
+  void Insert(const std::string& name, const std::string& value);
+  void EncodeString(const std::string& s, std::string* out);
+
+  std::deque<Entry> dynamic_;  // front = most recent (index 62)
+  uint32_t size_ = 0;          // current dynamic table octets (RFC rule)
+  uint32_t max_size_;
+  uint32_t pending_size_update_ = UINT32_MAX;  // UINT32_MAX = none pending
+};
+
+class HpackDecoder {
+ public:
+  explicit HpackDecoder(uint32_t max_table_size = 4096);
+
+  // Decodes one complete header block. Returns false on malformed input
+  // (connection error COMPRESSION_ERROR per RFC 7540 §4.3).
+  bool Decode(const uint8_t* in, size_t n, HeaderList* out);
+
+  // Raises the allowed ceiling (h2 SETTINGS from our side).
+  void SetMaxTableSize(uint32_t bytes);
+
+  uint32_t table_size() const { return size_; }
+
+ private:
+  struct Entry {
+    std::string name, value;
+  };
+  bool GetIndexed(uint64_t index, std::string* name, std::string* value) const;
+  void Insert(const std::string& name, const std::string& value);
+  void EvictTo(uint32_t limit);
+  // Returns consumed bytes, -1 on error.
+  int DecodeString(const uint8_t* in, size_t n, std::string* out);
+
+  std::deque<Entry> dynamic_;
+  uint32_t size_ = 0;
+  uint32_t max_size_;       // current effective ceiling (table updates)
+  uint32_t settings_max_;   // ceiling allowed by our SETTINGS
+};
+
+}  // namespace brt

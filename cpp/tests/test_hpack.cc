@@ -1,0 +1,287 @@
+// HPACK byte-exact tests against RFC 7541 Appendix C vectors (the
+// reference tests the same vectors in test/brpc_hpack_unittest.cpp).
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "rpc/hpack.h"
+
+using namespace brt;
+
+static std::string unhex(const char* h) {
+  std::string out;
+  for (size_t i = 0; h[i] && h[i + 1]; i += 2) {
+    auto nib = [](char c) {
+      return c <= '9' ? c - '0' : (c | 0x20) - 'a' + 10;
+    };
+    out.push_back(char(nib(h[i]) * 16 + nib(h[i + 1])));
+  }
+  return out;
+}
+
+static void expect_headers(const HeaderList& got,
+                           std::vector<std::pair<std::string, std::string>>
+                               want) {
+  assert(got.size() == want.size());
+  for (size_t i = 0; i < got.size(); ++i) {
+    assert(got[i].name == want[i].first);
+    assert(got[i].value == want[i].second);
+  }
+}
+
+static void test_integers() {
+  // C.1.1: 10 in a 5-bit prefix -> 0x0a.
+  std::string out;
+  HpackEncodeInt(&out, 0, 5, 10);
+  assert(out == std::string("\x0a", 1));
+  // C.1.2: 1337 in a 5-bit prefix -> 1f 9a 0a.
+  out.clear();
+  HpackEncodeInt(&out, 0, 5, 1337);
+  assert(out == unhex("1f9a0a"));
+  // C.1.3: 42 in an 8-bit prefix -> 2a.
+  out.clear();
+  HpackEncodeInt(&out, 0, 8, 42);
+  assert(out == std::string("\x2a", 1));
+  uint64_t v = 0;
+  assert(HpackDecodeInt((const uint8_t*)"\x1f\x9a\x0a", 3, 5, &v) == 3 &&
+         v == 1337);
+  // Truncated continuation returns 0 (need more bytes).
+  assert(HpackDecodeInt((const uint8_t*)"\x1f\x9a", 2, 5, &v) == 0);
+  // Overflow is rejected.
+  const uint8_t evil[] = {0x1f, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+                          0xff, 0xff, 0xff, 0xff, 0x7f};
+  assert(HpackDecodeInt(evil, sizeof(evil), 5, &v) == -1);
+  printf("integers ok\n");
+}
+
+static void test_huffman() {
+  // C.4.1: "www.example.com" -> f1e3 c2e5 f23a 6ba0 ab90 f4ff.
+  std::string out;
+  HuffmanEncode("www.example.com", &out);
+  assert(out == unhex("f1e3c2e5f23a6ba0ab90f4ff"));
+  std::string back;
+  assert(HuffmanDecode((const uint8_t*)out.data(), out.size(), &back));
+  assert(back == "www.example.com");
+  // C.6.1: "private" -> ae c3 77 1a 4b.
+  out.clear();
+  HuffmanEncode("private", &out);
+  assert(out == unhex("aec3771a4b"));
+  // Round-trip all byte values.
+  std::string all;
+  for (int i = 0; i < 256; ++i) all.push_back(char(i));
+  out.clear();
+  HuffmanEncode(all, &out);
+  back.clear();
+  assert(HuffmanDecode((const uint8_t*)out.data(), out.size(), &back));
+  assert(back == all);
+  // Bad padding (a zero bit in padding) must be rejected: 'w' is 1111000
+  // (7 bits) so one pad bit of 0 -> 0xf0 is invalid, 0xf1 valid.
+  back.clear();
+  const uint8_t bad[] = {0xf0};
+  assert(!HuffmanDecode(bad, 1, &back));
+  const uint8_t good[] = {0xf1};
+  back.clear();
+  assert(HuffmanDecode(good, 1, &back) && back == "w");
+  printf("huffman ok\n");
+}
+
+// RFC 7541 C.3: three requests on one connection, without Huffman.
+static void test_c3_decode_encode() {
+  const char* wire[3] = {
+      "828684410f7777772e6578616d706c652e636f6d",
+      "828684be58086e6f2d6361636865",
+      "828785bf400a637573746f6d2d6b65790c637573746f6d2d76616c7565"};
+  HpackDecoder dec;
+  HeaderList h1, h2, h3;
+  std::string w1 = unhex(wire[0]);
+  assert(dec.Decode((const uint8_t*)w1.data(), w1.size(), &h1));
+  expect_headers(h1, {{":method", "GET"},
+                      {":scheme", "http"},
+                      {":path", "/"},
+                      {":authority", "www.example.com"}});
+  assert(dec.table_size() == 57);
+  std::string w2 = unhex(wire[1]);
+  assert(dec.Decode((const uint8_t*)w2.data(), w2.size(), &h2));
+  expect_headers(h2, {{":method", "GET"},
+                      {":scheme", "http"},
+                      {":path", "/"},
+                      {":authority", "www.example.com"},
+                      {"cache-control", "no-cache"}});
+  assert(dec.table_size() == 110);
+  std::string w3 = unhex(wire[2]);
+  assert(dec.Decode((const uint8_t*)w3.data(), w3.size(), &h3));
+  expect_headers(h3, {{":method", "GET"},
+                      {":scheme", "https"},
+                      {":path", "/index.html"},
+                      {":authority", "www.example.com"},
+                      {"custom-key", "custom-value"}});
+  assert(dec.table_size() == 164);
+  printf("C.3 decode ok\n");
+}
+
+// RFC 7541 C.4: the same requests with Huffman — our encoder must
+// reproduce the RFC bytes exactly (same policy: indexed when possible,
+// else literal w/ incremental indexing, Huffman when shorter).
+static void test_c4_byte_exact() {
+  const char* wire[3] = {"828684418cf1e3c2e5f23a6ba0ab90f4ff",
+                         "828684be5886a8eb10649cbf",
+                         "408825a849e95ba97d7f8925a849e95bb8e8b4bf"};
+  HpackEncoder enc;
+  HpackDecoder dec;
+  HeaderList r1 = {{":method", "GET"},
+                   {":scheme", "http"},
+                   {":path", "/"},
+                   {":authority", "www.example.com"}};
+  std::string out;
+  enc.Encode(r1, &out);
+  assert(out == unhex(wire[0]));
+  HeaderList back;
+  assert(dec.Decode((const uint8_t*)out.data(), out.size(), &back));
+  expect_headers(back, {{":method", "GET"},
+                        {":scheme", "http"},
+                        {":path", "/"},
+                        {":authority", "www.example.com"}});
+  assert(enc.table_size() == 57 && dec.table_size() == 57);
+
+  HeaderList r2 = {{":method", "GET"},
+                   {":scheme", "http"},
+                   {":path", "/"},
+                   {":authority", "www.example.com"},
+                   {"cache-control", "no-cache"}};
+  out.clear();
+  enc.Encode(r2, &out);
+  assert(out == unhex(wire[1]));
+  assert(enc.table_size() == 110);
+
+  // Third request: check the new-name literal bytes (custom-key).
+  HeaderList r3 = {{"custom-key", "custom-value"}};
+  out.clear();
+  enc.Encode(r3, &out);
+  assert(out == unhex(wire[2]));
+  printf("C.4 byte-exact ok\n");
+}
+
+// RFC 7541 C.6: responses with a 256-byte table — exercises eviction.
+static void test_c6_eviction() {
+  const char* wire[3] = {
+      "488264025885aec3771a4b6196d07abe941054d444a8200595040b8166e082a62d1b"
+      "ff6e919d29ad171863c78f0b97c8e9ae82ae43d3",
+      "4883640effc1c0bf",
+      "88c16196d07abe941054d444a8200595040b8166e084a62d1bffc05a839bd9ab77ad"
+      "94e7821dd7f2e6c7b335dfdfcd5b3960d5af27087f3672c1ab270fb5291f95873160"
+      "65c003ed4ee5b1063d5007"};
+  HpackDecoder dec(256);
+  HpackEncoder enc(256);
+  HeaderList resp1 = {{":status", "302"},
+                      {"cache-control", "private"},
+                      {"date", "Mon, 21 Oct 2013 20:13:21 GMT"},
+                      {"location", "https://www.example.com"}};
+  std::string out;
+  enc.Encode(resp1, &out);
+  assert(out == unhex(wire[0]));
+  HeaderList h;
+  assert(dec.Decode((const uint8_t*)out.data(), out.size(), &h));
+  assert(dec.table_size() == 222 && enc.table_size() == 222);
+
+  HeaderList resp2 = {{":status", "307"},
+                      {"cache-control", "private"},
+                      {"date", "Mon, 21 Oct 2013 20:13:21 GMT"},
+                      {"location", "https://www.example.com"}};
+  out.clear();
+  enc.Encode(resp2, &out);
+  assert(out == unhex(wire[1]));
+  h.clear();
+  assert(dec.Decode((const uint8_t*)out.data(), out.size(), &h));
+  expect_headers(h, {{":status", "307"},
+                     {"cache-control", "private"},
+                     {"date", "Mon, 21 Oct 2013 20:13:21 GMT"},
+                     {"location", "https://www.example.com"}});
+  assert(dec.table_size() == 222);
+
+  HeaderList resp3 = {{":status", "200"},
+                      {"cache-control", "private"},
+                      {"date", "Mon, 21 Oct 2013 20:13:22 GMT"},
+                      {"location", "https://www.example.com"},
+                      {"content-encoding", "gzip"},
+                      {"set-cookie",
+                       "foo=ASDJKHQKBZXOQWEOPIUAXQWEOIU; max-age=3600; "
+                       "version=1"}};
+  out.clear();
+  enc.Encode(resp3, &out);
+  assert(out == unhex(wire[2]));
+  h.clear();
+  assert(dec.Decode((const uint8_t*)out.data(), out.size(), &h));
+  assert(h.size() == 6 && h[5].name == "set-cookie");
+  assert(dec.table_size() == 215);
+  printf("C.6 eviction ok\n");
+}
+
+static void test_size_update_and_sensitive() {
+  HpackEncoder enc;
+  HpackDecoder dec;
+  // Sensitive header: never-indexed on the wire, round-trips, and does NOT
+  // enter either dynamic table.
+  HeaderList h = {{"authorization", "Bearer s3cr3t", true}};
+  std::string out;
+  enc.Encode(h, &out);
+  assert((uint8_t(out[0]) & 0xf0) == 0x10);
+  HeaderList back;
+  assert(dec.Decode((const uint8_t*)out.data(), out.size(), &back));
+  assert(back.size() == 1 && back[0].value == "Bearer s3cr3t" &&
+         back[0].never_index);
+  assert(enc.table_size() == 0 && dec.table_size() == 0);
+
+  // Table size update flows encoder -> decoder and evicts.
+  HeaderList filler = {{"x-a", std::string(100, 'a')}};
+  out.clear();
+  enc.Encode(filler, &out);
+  assert(dec.Decode((const uint8_t*)out.data(), out.size(), &back));
+  assert(enc.table_size() > 0 && dec.table_size() == enc.table_size());
+  enc.SetMaxTableSize(0);
+  out.clear();
+  enc.Encode(HeaderList{{"x-b", "v"}}, &out);
+  assert((uint8_t(out[0]) & 0xe0) == 0x20);  // leads with a size update
+  assert(dec.Decode((const uint8_t*)out.data(), out.size(), &back));
+  assert(enc.table_size() == 0 && dec.table_size() == 0);
+
+  // A size update above our SETTINGS ceiling is a compression error.
+  HpackDecoder small(128);
+  std::string evil;
+  HpackEncodeInt(&evil, 0x20, 5, 4096);
+  HeaderList sink;
+  assert(!small.Decode((const uint8_t*)evil.data(), evil.size(), &sink));
+  printf("size-update/sensitive ok\n");
+}
+
+static void test_malformed() {
+  HpackDecoder dec;
+  HeaderList sink;
+  // Index 0 is invalid.
+  const uint8_t zero[] = {0x80};
+  assert(!dec.Decode(zero, 1, &sink));
+  // Index beyond both tables.
+  std::string big;
+  HpackEncodeInt(&big, 0x80, 7, 1000);
+  assert(!dec.Decode((const uint8_t*)big.data(), big.size(), &sink));
+  // String length past end of block.
+  const uint8_t trunc[] = {0x40, 0x05, 'a', 'b'};
+  assert(!dec.Decode(trunc, sizeof(trunc), &sink));
+  // Size update after a field.
+  const uint8_t late_update[] = {0x82, 0x3f, 0x00};
+  assert(!dec.Decode(late_update, sizeof(late_update), &sink));
+  printf("malformed ok\n");
+}
+
+int main() {
+  test_integers();
+  test_huffman();
+  test_c3_decode_encode();
+  test_c4_byte_exact();
+  test_c6_eviction();
+  test_size_update_and_sensitive();
+  test_malformed();
+  printf("test_hpack OK\n");
+  return 0;
+}
