@@ -1,0 +1,189 @@
+// Open-addressing hash map tuned for RPC metadata, plus the case-ignored
+// variant used for HTTP headers.
+// Parity target: reference src/butil/containers/flat_map.h:132 (FlatMap) and
+// case_ignored_flat_map.h. Redesigned: single flat array of slots with
+// triangular probing and tombstones; the case-ignored variant reuses the
+// same template with a folding hash/eq pair instead of a parallel class.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace brt {
+
+struct CaseIgnoredHash {
+  size_t operator()(const std::string& s) const {
+    // FNV-1a over lowercased bytes.
+    uint64_t h = 1469598103934665603ull;
+    for (unsigned char c : s) {
+      if (c >= 'A' && c <= 'Z') c |= 0x20;
+      h = (h ^ c) * 1099511628211ull;
+    }
+    return size_t(h);
+  }
+};
+
+struct CaseIgnoredEqual {
+  bool operator()(const std::string& a, const std::string& b) const {
+    if (a.size() != b.size()) return false;
+    for (size_t i = 0; i < a.size(); ++i) {
+      unsigned char x = a[i], y = b[i];
+      if (x >= 'A' && x <= 'Z') x |= 0x20;
+      if (y >= 'A' && y <= 'Z') y |= 0x20;
+      if (x != y) return false;
+    }
+    return true;
+  }
+};
+
+// Open-addressing map. Insertion order is preserved for iteration (slots
+// index into a dense entry vector) — HTTP headers serialize in the order
+// they were added, like the reference's HttpHeader.
+// Tombstones count toward the load factor (they lengthen probe chains just
+// like live entries) so the table keeps >=1/4 truly-empty slots and every
+// probe loop terminates; lookups are strictly const (no lazy init).
+template <typename K, typename V, typename Hash = std::hash<K>,
+          typename Eq = std::equal_to<K>>
+class FlatMap {
+ public:
+  struct Entry {
+    K first;
+    V second;
+  };
+  using iterator = typename std::vector<Entry>::iterator;
+  using const_iterator = typename std::vector<Entry>::const_iterator;
+
+  FlatMap() = default;
+
+  V& operator[](const K& key) {
+    size_t slot;
+    if (Lookup(key, &slot)) return entries_[slots_[slot] - 1].second;
+    return Emplace(key, V())->second;
+  }
+
+  const V* seek(const K& key) const {
+    size_t slot;
+    if (!Lookup(key, &slot)) return nullptr;
+    return &entries_[slots_[slot] - 1].second;
+  }
+  V* seek(const K& key) {
+    size_t slot;
+    if (!Lookup(key, &slot)) return nullptr;
+    return &entries_[slots_[slot] - 1].second;
+  }
+
+  // Returns true if the key was newly inserted.
+  bool insert(const K& key, V value) {
+    size_t slot;
+    if (Lookup(key, &slot)) {
+      entries_[slots_[slot] - 1].second = std::move(value);
+      return false;
+    }
+    Emplace(key, std::move(value));
+    return true;
+  }
+
+  // Erase keeps iteration order of the remaining entries (tail shift is
+  // O(n); header maps are small, clarity wins).
+  bool erase(const K& key) {
+    size_t slot;
+    if (!Lookup(key, &slot)) return false;
+    const uint32_t idx = slots_[slot] - 1;
+    slots_[slot] = kTombstone;
+    ++tombstones_;
+    entries_.erase(entries_.begin() + idx);
+    // Fix up dense indices above the removed entry.
+    for (auto& s : slots_) {
+      if (s != kEmpty && s != kTombstone && s - 1 > idx) --s;
+    }
+    return true;
+  }
+
+  size_t size() const { return entries_.size(); }
+  bool empty() const { return entries_.empty(); }
+  void clear() {
+    slots_.clear();
+    entries_.clear();
+    tombstones_ = 0;
+  }
+
+  iterator begin() { return entries_.begin(); }
+  iterator end() { return entries_.end(); }
+  const_iterator begin() const { return entries_.begin(); }
+  const_iterator end() const { return entries_.end(); }
+
+ private:
+  static constexpr uint32_t kEmpty = 0;
+  static constexpr uint32_t kTombstone = UINT32_MAX;
+
+  // Pure lookup; never mutates. False when absent (slot undefined then).
+  bool Lookup(const K& key, size_t* out) const {
+    if (slots_.empty()) return false;
+    const size_t mask = slots_.size() - 1;
+    size_t i = hash_(key) & mask;
+    for (size_t probe = 0; probe <= mask; ++probe) {
+      const uint32_t s = slots_[i];
+      if (s == kEmpty) return false;
+      if (s != kTombstone && eq_(entries_[s - 1].first, key)) {
+        *out = i;
+        return true;
+      }
+      i = (i + probe + 1) & mask;
+    }
+    return false;  // unreachable while the load invariant holds
+  }
+
+  // Inserts a key known to be absent.
+  Entry* Emplace(const K& key, V value) {
+    if (slots_.empty() ||
+        (entries_.size() + tombstones_ + 1) * 4 > slots_.size() * 3) {
+      Rehash(slots_.empty() ? 16 : slots_.size() * 2);
+    }
+    const size_t mask = slots_.size() - 1;
+    size_t i = hash_(key) & mask;
+    for (size_t probe = 0;; ++probe) {
+      const uint32_t s = slots_[i];
+      if (s == kEmpty || s == kTombstone) {
+        if (s == kTombstone) --tombstones_;
+        entries_.push_back(Entry{key, std::move(value)});
+        slots_[i] = uint32_t(entries_.size());
+        return &entries_.back();
+      }
+      i = (i + probe + 1) & mask;
+    }
+  }
+
+  void Rehash(size_t n) {
+    // Size for live entries only — tombstones are dropped here, so a
+    // rehash at the same capacity also de-tombstones the table.
+    if (n < 16) n = 16;
+    while ((entries_.size() + 1) * 4 > n * 3) n *= 2;
+    slots_.assign(n, kEmpty);
+    tombstones_ = 0;
+    const size_t mask = slots_.size() - 1;
+    for (uint32_t e = 0; e < entries_.size(); ++e) {
+      size_t i = hash_(entries_[e].first) & mask;
+      for (size_t probe = 0; slots_[i] != kEmpty; ++probe) {
+        i = (i + probe + 1) & mask;
+      }
+      slots_[i] = e + 1;
+    }
+  }
+
+  std::vector<uint32_t> slots_;  // 0 empty, UINT32_MAX tombstone, else idx+1
+  std::vector<Entry> entries_;   // dense, insertion-ordered
+  size_t tombstones_ = 0;
+  Hash hash_;
+  Eq eq_;
+};
+
+// HTTP header map: case-ignored keys, insertion-ordered iteration.
+template <typename V>
+using CaseIgnoredFlatMap =
+    FlatMap<std::string, V, CaseIgnoredHash, CaseIgnoredEqual>;
+
+}  // namespace brt

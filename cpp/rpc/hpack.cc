@@ -297,6 +297,14 @@ int HpackDecoder::DecodeString(const uint8_t* in, size_t n, std::string* out) {
 
 bool HpackDecoder::Decode(const uint8_t* in, size_t n, HeaderList* out) {
   bool seen_field = false;
+  uint64_t list_size = 0;
+  // RFC 7540 §10.5.1 accounting: name + value + 32 per decoded field.
+  auto emit = [&](HeaderField&& f) {
+    list_size += f.name.size() + f.value.size() + 32;
+    out->push_back(std::move(f));
+    seen_field = true;
+    return list_size <= max_header_list_size_;
+  };
   while (n > 0) {
     const uint8_t b = in[0];
     if (b & 0x80) {  // indexed header field
@@ -305,10 +313,9 @@ bool HpackDecoder::Decode(const uint8_t* in, size_t n, HeaderList* out) {
       if (c <= 0) return false;
       HeaderField f;
       if (!GetIndexed(idx, &f.name, &f.value)) return false;
-      out->push_back(std::move(f));
+      if (!emit(std::move(f))) return false;
       in += c;
       n -= size_t(c);
-      seen_field = true;
     } else if ((b & 0xe0) == 0x20) {  // dynamic table size update
       // Must precede any field in the block (RFC 7541 §4.2).
       if (seen_field) return false;
@@ -345,8 +352,7 @@ bool HpackDecoder::Decode(const uint8_t* in, size_t n, HeaderList* out) {
       in += c;
       n -= size_t(c);
       if (incremental) Insert(f.name, f.value);
-      out->push_back(std::move(f));
-      seen_field = true;
+      if (!emit(std::move(f))) return false;
     }
   }
   return true;

@@ -72,8 +72,15 @@ class HpackDecoder {
   explicit HpackDecoder(uint32_t max_table_size = 4096);
 
   // Decodes one complete header block. Returns false on malformed input
-  // (connection error COMPRESSION_ERROR per RFC 7540 §4.3).
+  // (connection error COMPRESSION_ERROR per RFC 7540 §4.3) or when the
+  // decoded list exceeds max_header_list_size (the
+  // SETTINGS_MAX_HEADER_LIST_SIZE analog — indexed fields amplify, so the
+  // cap is on decoded octets, not input octets).
   bool Decode(const uint8_t* in, size_t n, HeaderList* out);
+
+  void set_max_header_list_size(uint64_t bytes) {
+    max_header_list_size_ = bytes;
+  }
 
   // Raises the allowed ceiling (h2 SETTINGS from our side).
   void SetMaxTableSize(uint32_t bytes);
@@ -94,6 +101,7 @@ class HpackDecoder {
   uint32_t size_ = 0;
   uint32_t max_size_;       // current effective ceiling (table updates)
   uint32_t settings_max_;   // ceiling allowed by our SETTINGS
+  uint64_t max_header_list_size_ = 1 << 20;  // decoded-octet cap per block
 };
 
 }  // namespace brt

@@ -1,0 +1,387 @@
+// Incremental HTTP/1.x parser — see http_message.h for the design.
+#include "rpc/http_message.h"
+
+#include <cstring>
+
+namespace brt {
+
+namespace {
+
+constexpr size_t kMaxLineBytes = 16 * 1024;
+
+bool ContainsTokenCaseless(const std::string& list, const char* token) {
+  // Comma-separated token scan, case-insensitive (Connection/TE headers).
+  const size_t tn = strlen(token);
+  size_t i = 0;
+  while (i < list.size()) {
+    while (i < list.size() && (list[i] == ' ' || list[i] == '\t' ||
+                               list[i] == ',')) {
+      ++i;
+    }
+    size_t j = i;
+    while (j < list.size() && list[j] != ',') ++j;
+    size_t k = j;
+    while (k > i && (list[k - 1] == ' ' || list[k - 1] == '\t')) --k;
+    if (k - i == tn) {
+      bool eq = true;
+      for (size_t t = 0; t < tn; ++t) {
+        if ((list[i + t] | 0x20) != (token[t] | 0x20)) {
+          eq = false;
+          break;
+        }
+      }
+      if (eq) return true;
+    }
+    i = j + 1;
+  }
+  return false;
+}
+
+}  // namespace
+
+bool HttpMessage::keep_alive() const {
+  const std::string* c = headers.seek("connection");
+  if (c != nullptr) {
+    if (ContainsTokenCaseless(*c, "close")) return false;
+    if (ContainsTokenCaseless(*c, "keep-alive")) return true;
+  }
+  return version_major > 1 || (version_major == 1 && version_minor >= 1);
+}
+
+void HttpParser::Reset() {
+  stage_ = Stage::START_LINE;
+  partial_line_.clear();
+  header_bytes_ = 0;
+  body_remaining_ = 0;
+  chunked_ = false;
+  msg_ = HttpMessage();
+}
+
+HttpParser::Result HttpParser::TakeLine(IOBuf* source, std::string* line) {
+  while (!source->empty()) {
+    const char* data = static_cast<const char*>(source->ref_data(0));
+    const size_t len = source->ref_at(0).length;
+    const void* nl = memchr(data, '\n', len);
+    const size_t take = nl ? size_t(static_cast<const char*>(nl) - data) + 1
+                           : len;
+    if (partial_line_.size() + take > kMaxLineBytes) {
+      stage_ = Stage::FAILED;
+      return ERROR;
+    }
+    partial_line_.append(data, take);
+    source->pop_front(take);
+    if (nl != nullptr) {
+      partial_line_.pop_back();  // '\n'
+      if (!partial_line_.empty() && partial_line_.back() == '\r') {
+        partial_line_.pop_back();
+      }
+      *line = std::move(partial_line_);
+      partial_line_.clear();
+      return DONE;
+    }
+  }
+  return NEED_MORE;
+}
+
+HttpParser::Result HttpParser::ParseStartLine(const std::string& line) {
+  if (is_request_) {
+    const size_t sp1 = line.find(' ');
+    const size_t sp2 = line.rfind(' ');
+    if (sp1 == std::string::npos || sp2 == sp1) return ERROR;
+    msg_.method = line.substr(0, sp1);
+    if (msg_.method.empty()) return ERROR;
+    for (char c : msg_.method) {
+      if (c < 'A' || c > 'Z') return ERROR;  // token: upper-alpha methods
+    }
+    std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+    if (target.empty()) return ERROR;
+    const size_t q = target.find('?');
+    if (q != std::string::npos) {
+      msg_.path = target.substr(0, q);
+      msg_.query = target.substr(q + 1);
+    } else {
+      msg_.path = std::move(target);
+      msg_.query.clear();
+    }
+    const std::string ver = line.substr(sp2 + 1);
+    if (ver.size() != 8 || ver.compare(0, 5, "HTTP/") != 0 ||
+        ver[6] != '.') {
+      return ERROR;
+    }
+    msg_.version_major = ver[5] - '0';
+    msg_.version_minor = ver[7] - '0';
+    if (msg_.version_major != 1) return ERROR;
+  } else {
+    // "HTTP/1.1 200 OK"
+    if (line.size() < 12 || line.compare(0, 5, "HTTP/") != 0 ||
+        line[6] != '.' || line[8] != ' ') {
+      return ERROR;
+    }
+    msg_.version_major = line[5] - '0';
+    msg_.version_minor = line[7] - '0';
+    int st = 0;
+    for (int i = 9; i < 12; ++i) {
+      if (line[i] < '0' || line[i] > '9') return ERROR;
+      st = st * 10 + (line[i] - '0');
+    }
+    msg_.status = st;
+    msg_.reason = line.size() > 13 ? line.substr(13) : "";
+  }
+  return DONE;
+}
+
+HttpParser::Result HttpParser::ParseHeaderLine(const std::string& line,
+                                               bool trailer) {
+  const size_t colon = line.find(':');
+  if (colon == std::string::npos || colon == 0) return ERROR;
+  std::string name = line.substr(0, colon);
+  if (name.find(' ') != std::string::npos ||
+      name.find('\t') != std::string::npos) {
+    return ERROR;  // no whitespace in field names (smuggling defense)
+  }
+  size_t vb = colon + 1;
+  while (vb < line.size() && (line[vb] == ' ' || line[vb] == '\t')) ++vb;
+  size_t ve = line.size();
+  while (ve > vb && (line[ve - 1] == ' ' || line[ve - 1] == '\t')) --ve;
+  std::string value = line.substr(vb, ve - vb);
+  (void)trailer;  // trailers land in the same map
+  msg_.append_header(name, value);
+  return DONE;
+}
+
+HttpParser::Result HttpParser::OnHeadersComplete() {
+  const std::string* te = msg_.headers.seek("transfer-encoding");
+  const std::string* cl = msg_.headers.seek("content-length");
+  if (te != nullptr) {
+    if (!ContainsTokenCaseless(*te, "chunked")) return ERROR;
+    if (cl != nullptr) return ERROR;  // CL+TE: request-smuggling vector
+    chunked_ = true;
+    stage_ = Stage::CHUNK_SIZE;
+    return DONE;
+  }
+  if (cl != nullptr) {
+    uint64_t v = 0;
+    if (cl->empty()) return ERROR;
+    for (char c : *cl) {
+      if (c < '0' || c > '9') return ERROR;
+      if (v > kMaxBodyBytes) return ERROR;
+      v = v * 10 + uint64_t(c - '0');
+    }
+    if (v > kMaxBodyBytes) return ERROR;
+    const bool bodyless_response =
+        !is_request_ && (no_body_expected_ || msg_.status / 100 == 1 ||
+                         msg_.status == 204 || msg_.status == 304);
+    if (v == 0 || bodyless_response) {
+      stage_ = Stage::COMPLETE;
+      return DONE;
+    }
+    body_remaining_ = v;
+    stage_ = Stage::BODY_CL;
+    return DONE;
+  }
+  if (is_request_) {
+    stage_ = Stage::COMPLETE;  // requests without CL/TE have no body
+    return DONE;
+  }
+  if (no_body_expected_ || msg_.status / 100 == 1 || msg_.status == 204 ||
+      msg_.status == 304) {
+    stage_ = Stage::COMPLETE;
+    return DONE;
+  }
+  stage_ = Stage::BODY_TO_EOF;
+  return DONE;
+}
+
+HttpParser::Result HttpParser::Consume(IOBuf* source) {
+  std::string line;
+  for (;;) {
+    switch (stage_) {
+      case Stage::START_LINE: {
+        Result r = TakeLine(source, &line);
+        if (r != DONE) return r;
+        if (line.empty()) continue;  // tolerate leading blank lines
+        header_bytes_ += line.size();
+        if (ParseStartLine(line) != DONE) {
+          stage_ = Stage::FAILED;
+          return ERROR;
+        }
+        stage_ = Stage::HEADERS;
+        break;
+      }
+      case Stage::HEADERS: {
+        Result r = TakeLine(source, &line);
+        if (r != DONE) return r;
+        header_bytes_ += line.size() + 2;
+        if (header_bytes_ > kMaxHeaderBytes) {
+          stage_ = Stage::FAILED;
+          return ERROR;
+        }
+        if (line.empty()) {
+          if (OnHeadersComplete() != DONE) {
+            stage_ = Stage::FAILED;
+            return ERROR;
+          }
+          if (stage_ == Stage::COMPLETE) return DONE;
+        } else if (ParseHeaderLine(line, false) != DONE) {
+          stage_ = Stage::FAILED;
+          return ERROR;
+        }
+        break;
+      }
+      case Stage::BODY_CL: {
+        const size_t n =
+            source->cutn(&msg_.body, size_t(body_remaining_) < source->size()
+                                         ? size_t(body_remaining_)
+                                         : source->size());
+        body_remaining_ -= n;
+        if (body_remaining_ == 0) {
+          stage_ = Stage::COMPLETE;
+          return DONE;
+        }
+        return NEED_MORE;
+      }
+      case Stage::BODY_TO_EOF: {
+        if (msg_.body.size() + source->size() > kMaxBodyBytes) {
+          stage_ = Stage::FAILED;
+          return ERROR;
+        }
+        source->cutn(&msg_.body, source->size());
+        return NEED_MORE;
+      }
+      case Stage::CHUNK_SIZE: {
+        Result r = TakeLine(source, &line);
+        if (r != DONE) return r;
+        if (line.empty()) continue;  // tolerate CRLF after previous chunk
+        uint64_t sz = 0;
+        size_t i = 0;
+        for (; i < line.size() && line[i] != ';'; ++i) {
+          const char c = line[i];
+          uint64_t d;
+          if (c >= '0' && c <= '9') {
+            d = uint64_t(c - '0');
+          } else if ((c | 0x20) >= 'a' && (c | 0x20) <= 'f') {
+            d = uint64_t((c | 0x20) - 'a' + 10);
+          } else {
+            stage_ = Stage::FAILED;
+            return ERROR;
+          }
+          sz = (sz << 4) | d;
+          if (sz > kMaxBodyBytes) {
+            stage_ = Stage::FAILED;
+            return ERROR;
+          }
+        }
+        if (i == 0) {  // no hex digit at all
+          stage_ = Stage::FAILED;
+          return ERROR;
+        }
+        if (sz == 0) {
+          stage_ = Stage::TRAILERS;
+        } else if (msg_.body.size() + sz > kMaxBodyBytes) {
+          stage_ = Stage::FAILED;
+          return ERROR;
+        } else {
+          body_remaining_ = sz;
+          stage_ = Stage::CHUNK_DATA;
+        }
+        break;
+      }
+      case Stage::CHUNK_DATA: {
+        const size_t n =
+            source->cutn(&msg_.body, size_t(body_remaining_) < source->size()
+                                         ? size_t(body_remaining_)
+                                         : source->size());
+        body_remaining_ -= n;
+        if (body_remaining_ != 0) return NEED_MORE;
+        stage_ = Stage::CHUNK_CRLF;
+        break;
+      }
+      case Stage::CHUNK_CRLF: {
+        Result r = TakeLine(source, &line);
+        if (r != DONE) return r;
+        if (!line.empty()) {
+          stage_ = Stage::FAILED;
+          return ERROR;
+        }
+        stage_ = Stage::CHUNK_SIZE;
+        break;
+      }
+      case Stage::TRAILERS: {
+        Result r = TakeLine(source, &line);
+        if (r != DONE) return r;
+        header_bytes_ += line.size() + 2;
+        if (header_bytes_ > kMaxHeaderBytes) {
+          stage_ = Stage::FAILED;
+          return ERROR;
+        }
+        if (line.empty()) {
+          stage_ = Stage::COMPLETE;
+          return DONE;
+        }
+        if (ParseHeaderLine(line, true) != DONE) {
+          stage_ = Stage::FAILED;
+          return ERROR;
+        }
+        break;
+      }
+      case Stage::COMPLETE:
+        return DONE;
+      case Stage::FAILED:
+        return ERROR;
+    }
+  }
+}
+
+HttpParser::Result HttpParser::OnEof() {
+  if (stage_ == Stage::BODY_TO_EOF) {
+    stage_ = Stage::COMPLETE;
+    return DONE;
+  }
+  if (stage_ == Stage::START_LINE && partial_line_.empty()) {
+    return NEED_MORE;  // clean close between messages
+  }
+  stage_ = Stage::FAILED;
+  return ERROR;
+}
+
+void SerializeHttpHead(const HttpMessage& m, bool is_request, IOBuf* out) {
+  std::string head;
+  head.reserve(256);
+  if (is_request) {
+    head += m.method;
+    head += ' ';
+    head += m.path.empty() ? "/" : m.path;
+    if (!m.query.empty()) {
+      head += '?';
+      head += m.query;
+    }
+    head += " HTTP/1.1\r\n";
+  } else {
+    head += "HTTP/1.1 ";
+    head += std::to_string(m.status);
+    head += ' ';
+    head += m.reason.empty() ? "OK" : m.reason;
+    head += "\r\n";
+  }
+  for (const auto& h : m.headers) {
+    head += h.first;
+    head += ": ";
+    head += h.second;
+    head += "\r\n";
+  }
+  head += "\r\n";
+  out->append(head);
+}
+
+void AppendChunk(IOBuf* out, const IOBuf& piece) {
+  if (piece.empty()) return;  // a 0-size chunk would terminate the body
+  char szline[24];
+  const int n = snprintf(szline, sizeof(szline), "%zx\r\n", piece.size());
+  out->append(szline, size_t(n));
+  out->append(piece);
+  out->append("\r\n", 2);
+}
+
+void AppendLastChunk(IOBuf* out) { out->append("0\r\n\r\n", 5); }
+
+}  // namespace brt

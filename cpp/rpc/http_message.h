@@ -1,0 +1,138 @@
+// HTTP/1.x message model + incremental parser.
+// Parity target: reference src/brpc/details/http_message.{h,cpp} and the
+// node.js-fork state machine in details/http_parser.cpp (2466 LoC).
+// Redesigned: one hand-written incremental parser over IOBuf that never
+// re-scans — line stages remember how far they scanned for the newline;
+// body stages cut bytes zero-copy out of the source buffer. Handles
+// requests and responses, content-length and chunked bodies, trailers, and
+// connection-delimited response bodies.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "base/flat_map.h"
+#include "base/iobuf.h"
+
+namespace brt {
+
+// Headers: case-ignored keys, insertion-ordered serialization. Repeated
+// headers are comma-joined per RFC 9110 §5.2 (same as the reference's
+// HttpHeader::AppendHeader).
+using HttpHeaderMap = CaseIgnoredFlatMap<std::string>;
+
+struct HttpMessage {
+  // Request fields.
+  std::string method;       // "GET", "POST", ...
+  std::string path;         // decoded target path, no query
+  std::string query;        // raw query string ('' if none)
+  // Response fields.
+  int status = 0;
+  std::string reason;
+
+  int version_major = 1, version_minor = 1;
+  HttpHeaderMap headers;
+  IOBuf body;
+
+  const std::string* header(const std::string& name) const {
+    return headers.seek(name);
+  }
+  void set_header(const std::string& name, const std::string& value) {
+    headers.insert(name, value);
+  }
+  void append_header(const std::string& name, const std::string& value) {
+    std::string* v = headers.seek(name);
+    if (v == nullptr) {
+      headers.insert(name, value);
+    } else {
+      *v += ", ";
+      *v += value;
+    }
+  }
+
+  // keep-alive default follows the version; Connection header overrides.
+  bool keep_alive() const;
+  std::string content_type() const {
+    const std::string* v = headers.seek("content-type");
+    return v ? *v : "";
+  }
+};
+
+class HttpParser {
+ public:
+  enum Result {
+    DONE = 0,       // one complete message parsed; *msg() valid
+    NEED_MORE = 1,  // consumed everything available; call again with data
+    ERROR = 2,      // malformed — fail the connection
+  };
+
+  // is_request: parse request grammar (method line); else status line.
+  explicit HttpParser(bool is_request = true) : is_request_(is_request) {}
+
+  // Consumes parsed bytes from *source (leaves unparsed tail in place so a
+  // pipelined next message stays buffered). After DONE, take the message
+  // with steal() and Reset() for the next one.
+  Result Consume(IOBuf* source);
+
+  // For client-side response parsing: HEAD/204/304 responses have no body
+  // even with content-length; connection-close responses end at EOF.
+  void set_no_body_expected(bool v) { no_body_expected_ = v; }
+  // Signals peer EOF: a connection-delimited body completes (DONE) or
+  // mid-message truncation errors out.
+  Result OnEof();
+
+  HttpMessage* msg() { return &msg_; }
+  HttpMessage steal() { return std::move(msg_); }
+  void Reset();
+
+  // True once the start line has matched the protocol (used by the
+  // protocol-sniffing layer: after this point the socket is HTTP).
+  bool start_line_parsed() const { return stage_ > Stage::START_LINE; }
+
+  // Bounds (apply per message).
+  static constexpr size_t kMaxHeaderBytes = 64 * 1024;
+  static constexpr uint64_t kMaxBodyBytes = 256ull << 20;
+
+ private:
+  enum class Stage : uint8_t {
+    START_LINE,
+    HEADERS,
+    BODY_CL,        // content-length delimited
+    BODY_TO_EOF,    // response delimited by connection close
+    CHUNK_SIZE,
+    CHUNK_DATA,
+    CHUNK_CRLF,
+    TRAILERS,
+    COMPLETE,
+    FAILED,
+  };
+
+  // Pulls one '\n'-terminated line (stripping "\r\n"/"\n") from *source
+  // into *line without re-scanning previously seen bytes. Returns DONE when
+  // a full line is cut, NEED_MORE / ERROR otherwise.
+  Result TakeLine(IOBuf* source, std::string* line);
+
+  Result ParseStartLine(const std::string& line);
+  Result ParseHeaderLine(const std::string& line, bool trailer);
+  Result OnHeadersComplete();
+
+  bool is_request_;
+  bool no_body_expected_ = false;
+  Stage stage_ = Stage::START_LINE;
+  std::string partial_line_;   // accumulated bytes of the unfinished line
+  size_t header_bytes_ = 0;    // header-section size guard
+  uint64_t body_remaining_ = 0;
+  bool chunked_ = false;
+  HttpMessage msg_;
+};
+
+// Serializes a response/request head (start line + headers + CRLF) in
+// insertion order. Body is appended by the caller (or chunk-encoded below).
+void SerializeHttpHead(const HttpMessage& m, bool is_request, IOBuf* out);
+
+// Chunk-encodes one body piece (progressive/chunked writing).
+void AppendChunk(IOBuf* out, const IOBuf& piece);
+// Terminal 0-chunk (+ optional trailers serialized by the caller).
+void AppendLastChunk(IOBuf* out);
+
+}  // namespace brt

@@ -255,6 +255,29 @@ static void test_size_update_and_sensitive() {
   printf("size-update/sensitive ok\n");
 }
 
+static void test_list_size_cap() {
+  // Indexed-field amplification: one big dynamic entry, then thousands of
+  // 1-byte references to it. The decoded-list cap must stop it.
+  HpackEncoder enc;
+  HpackDecoder dec;
+  dec.set_max_header_list_size(64 * 1024);
+  std::string out;
+  enc.Encode(HeaderList{{"x-big", std::string(4000, 'v')}}, &out);
+  HeaderList sink;
+  assert(dec.Decode((const uint8_t*)out.data(), out.size(), &sink));
+  std::string bomb;
+  for (int i = 0; i < 1000; ++i) HpackEncodeInt(&bomb, 0x80, 7, 62);
+  sink.clear();
+  assert(!dec.Decode((const uint8_t*)bomb.data(), bomb.size(), &sink));
+  // Well under the cap still works.
+  std::string few;
+  for (int i = 0; i < 3; ++i) HpackEncodeInt(&few, 0x80, 7, 62);
+  sink.clear();
+  assert(dec.Decode((const uint8_t*)few.data(), few.size(), &sink));
+  assert(sink.size() == 3);
+  printf("list-size cap ok\n");
+}
+
 static void test_malformed() {
   HpackDecoder dec;
   HeaderList sink;
@@ -281,6 +304,7 @@ int main() {
   test_c4_byte_exact();
   test_c6_eviction();
   test_size_update_and_sensitive();
+  test_list_size_cap();
   test_malformed();
   printf("test_hpack OK\n");
   return 0;

@@ -13,6 +13,7 @@
 #include "base/flags.h"
 #include "fiber/fiber.h"
 #include "rpc/channel.h"
+#include "rpc/http_message.h"
 #include "rpc/server.h"
 #include "var/latency_recorder.h"
 #include "var/multi_dimension.h"
@@ -28,6 +29,24 @@ class EchoService : public Service {
                   Closure done) override {
     if (method == "Echo") response->append(request);
     else cntl->SetFailed(ENOMETHOD, nullptr);
+    done();
+  }
+};
+
+// Echoes after a delay inversely proportional to the trailing digit —
+// pipelined request #0 completes LAST, forcing the response sequencer to
+// park out-of-order completions.
+class SlowRevEchoService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    (void)method;
+    (void)cntl;
+    std::string s = request.to_string();
+    const int digit = s.empty() ? 0 : (s.back() - '0');
+    fiber_usleep((9 - digit) * 20000);
+    response->append(s);
     done();
   }
 };
@@ -68,6 +87,8 @@ int main() {
   Server server;
   EchoService echo;
   assert(server.AddService(&echo, "Echo") == 0);
+  SlowRevEchoService rev;
+  assert(server.AddService(&rev, "Rev") == 0);
   assert(server.Start("127.0.0.1:0") == 0);
   const EndPoint addr = server.listen_address();
 
@@ -88,7 +109,8 @@ int main() {
   printf("http_health OK\n");
 
   r = HttpGet(addr, "GET /status HTTP/1.1\r\n\r\n");
-  assert(r.find("services: Echo") != std::string::npos);
+  assert(r.find("services:") != std::string::npos &&
+         r.find("Echo") != std::string::npos);
   assert(r.find("Echo.Echo") != std::string::npos);
   assert(r.find("count=5") != std::string::npos);
   printf("http_status OK\n");
@@ -161,6 +183,86 @@ int main() {
   assert(r.find("C trace=") != std::string::npos);  // client span
   assert(r.find("S trace=") != std::string::npos);  // server span (child)
   printf("http_rpcz OK\n");
+
+  // Chunked POST (curl-style): body arrives in chunks with a trailer.
+  r = HttpGet(addr,
+              "POST /Echo/Echo HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+              "7\r\nchunked\r\n1\r\n \r\n7\r\npayload\r\n0\r\n\r\n");
+  assert(r.rfind("HTTP/1.1 200", 0) == 0);
+  assert(r.find("chunked payload") != std::string::npos);
+  printf("http_chunked_post OK\n");
+
+  // 10 pipelined keep-alive requests on ONE connection: all served, all
+  // responses in request order (handlers run concurrently; the protocol
+  // sequences the writes).
+  {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    assert(fd >= 0);
+    sockaddr_in sa = addr.to_sockaddr();
+    assert(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0);
+    std::string burst;
+    for (int i = 0; i < 10; ++i) {
+      std::string body = "pipelined-" + std::to_string(i);
+      burst += "POST /Echo/Echo HTTP/1.1\r\nContent-Length: " +
+               std::to_string(body.size()) + "\r\n\r\n" + body;
+    }
+    assert(write(fd, burst.data(), burst.size()) == ssize_t(burst.size()));
+    // Parse the 10 responses with our own response parser.
+    HttpParser rp(false);
+    IOBuf acc;
+    int got = 0;
+    char buf[4096];
+    while (got < 10) {
+      ssize_t n = read(fd, buf, sizeof(buf));
+      assert(n > 0);
+      acc.append(buf, size_t(n));
+      while (rp.Consume(&acc) == HttpParser::DONE) {
+        HttpMessage resp = rp.steal();
+        rp.Reset();
+        assert(resp.status == 200);
+        assert(resp.body.to_string() == "pipelined-" + std::to_string(got));
+        ++got;
+        if (got == 10) break;
+      }
+    }
+    close(fd);
+    printf("http_pipelined_keepalive OK (10 in-order)\n");
+  }
+
+  // Pipelining under REVERSED completion order: request 0 finishes last,
+  // responses still arrive 0..9.
+  {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    assert(fd >= 0);
+    sockaddr_in sa = addr.to_sockaddr();
+    assert(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0);
+    std::string burst;
+    for (int i = 0; i < 10; ++i) {
+      std::string body = "rev-" + std::to_string(i);
+      burst += "POST /Rev/Echo HTTP/1.1\r\nContent-Length: " +
+               std::to_string(body.size()) + "\r\n\r\n" + body;
+    }
+    assert(write(fd, burst.data(), burst.size()) == ssize_t(burst.size()));
+    HttpParser rp(false);
+    IOBuf acc;
+    int got = 0;
+    char buf[4096];
+    while (got < 10) {
+      ssize_t n = read(fd, buf, sizeof(buf));
+      assert(n > 0);
+      acc.append(buf, size_t(n));
+      while (rp.Consume(&acc) == HttpParser::DONE) {
+        HttpMessage resp = rp.steal();
+        rp.Reset();
+        assert(resp.status == 200);
+        assert(resp.body.to_string() == "rev-" + std::to_string(got));
+        ++got;
+        if (got == 10) break;
+      }
+    }
+    close(fd);
+    printf("http_pipelined_reversed_completion OK\n");
+  }
 
   server.Stop();
   server.Join();

@@ -1,0 +1,223 @@
+// Unit tests for the incremental HTTP/1.x parser (http_message.{h,cc}) —
+// the reference covers the same surface in test/brpc_http_message_unittest
+// + the http_parser corpus.
+#include <cassert>
+#include <cstdio>
+#include <string>
+
+#include "base/flat_map.h"
+#include "rpc/http_message.h"
+
+using namespace brt;
+
+static void test_flat_map() {
+  CaseIgnoredFlatMap<std::string> m;
+  m.insert("Content-Type", "text/plain");
+  assert(m.seek("content-type") != nullptr);
+  assert(*m.seek("CONTENT-TYPE") == "text/plain");
+  m["X-A"] = "1";
+  m["X-B"] = "2";
+  m["x-a"] = "3";  // overwrite through case fold
+  assert(*m.seek("X-A") == "3");
+  assert(m.size() == 3);
+  // Insertion order preserved.
+  auto it = m.begin();
+  assert(it->first == "Content-Type");
+  ++it;
+  assert(it->first == "X-A");
+  assert(m.erase("x-b"));
+  assert(m.seek("X-B") == nullptr);
+  assert(m.size() == 2);
+  // Grow through rehash.
+  FlatMap<int, int> big;
+  for (int i = 0; i < 1000; ++i) big[i] = i * 2;
+  for (int i = 0; i < 1000; ++i) assert(*big.seek(i) == i * 2);
+  assert(big.seek(1234) == nullptr);
+  // Tombstone churn: alternating insert/erase of distinct keys must not
+  // wedge the probe loop (tombstones count toward the load factor).
+  FlatMap<int, int> churn;
+  for (int i = 0; i < 10000; ++i) {
+    churn[i] = i;
+    assert(churn.erase(i));
+    assert(churn.seek(i) == nullptr);  // lookup of absent key terminates
+  }
+  assert(churn.empty());
+  // A const empty map never lazily allocates.
+  const FlatMap<int, int> empty_map;
+  assert(empty_map.seek(1) == nullptr);
+  printf("flat_map ok\n");
+}
+
+static void test_simple_request() {
+  HttpParser p(true);
+  IOBuf in;
+  in.append("POST /Echo/Echo?x=1&y=2 HTTP/1.1\r\nHost: a\r\n"
+            "Content-Length: 5\r\n\r\nhello");
+  assert(p.Consume(&in) == HttpParser::DONE);
+  HttpMessage m = p.steal();
+  assert(m.method == "POST" && m.path == "/Echo/Echo" && m.query == "x=1&y=2");
+  assert(*m.header("host") == "a");
+  assert(m.body.to_string() == "hello");
+  assert(m.keep_alive());
+  assert(in.empty());
+  printf("simple request ok\n");
+}
+
+static void test_byte_at_a_time() {
+  const std::string wire =
+      "POST /up HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+      "4\r\nwiki\r\n5\r\npedia\r\nA\r\n 0123456\r\n\r\n"
+      "0\r\nX-Trailer: t\r\n\r\n";
+  HttpParser p(true);
+  IOBuf in;
+  for (size_t i = 0; i + 1 < wire.size(); ++i) {
+    in.append(wire.data() + i, 1);
+    assert(p.Consume(&in) == HttpParser::NEED_MORE);
+  }
+  in.append(wire.data() + wire.size() - 1, 1);
+  assert(p.Consume(&in) == HttpParser::DONE);
+  HttpMessage m = p.steal();
+  assert(m.body.to_string() == "wikipedia 0123456\r\n");
+  assert(*m.header("x-trailer") == "t");
+  printf("byte-at-a-time chunked ok\n");
+}
+
+static void test_pipelined_messages() {
+  HttpParser p(true);
+  IOBuf in;
+  in.append("GET /a HTTP/1.1\r\n\r\nGET /b HTTP/1.1\r\n\r\n"
+            "POST /c HTTP/1.1\r\nContent-Length: 2\r\n\r\nzz");
+  assert(p.Consume(&in) == HttpParser::DONE);
+  assert(p.msg()->path == "/a");
+  p.Reset();
+  assert(p.Consume(&in) == HttpParser::DONE);
+  assert(p.msg()->path == "/b");
+  p.Reset();
+  assert(p.Consume(&in) == HttpParser::DONE);
+  assert(p.msg()->path == "/c" && p.msg()->body.to_string() == "zz");
+  assert(in.empty());
+  printf("pipelined parse ok\n");
+}
+
+static void test_response_parsing() {
+  // Content-length response.
+  HttpParser p(false);
+  IOBuf in;
+  in.append("HTTP/1.1 200 OK\r\nContent-Length: 3\r\n\r\nabc");
+  assert(p.Consume(&in) == HttpParser::DONE);
+  assert(p.msg()->status == 200 && p.msg()->body.to_string() == "abc");
+
+  // EOF-delimited response body.
+  HttpParser q(false);
+  IOBuf in2;
+  in2.append("HTTP/1.0 200 OK\r\n\r\npartial body");
+  assert(q.Consume(&in2) == HttpParser::NEED_MORE);
+  assert(q.OnEof() == HttpParser::DONE);
+  assert(q.msg()->body.to_string() == "partial body");
+  assert(!q.msg()->keep_alive());  // 1.0 default close
+
+  // 204 has no body even without content-length.
+  HttpParser r(false);
+  IOBuf in3;
+  in3.append("HTTP/1.1 204 No Content\r\n\r\n");
+  assert(r.Consume(&in3) == HttpParser::DONE);
+  assert(r.msg()->body.empty());
+
+  // Mid-message EOF is an error.
+  HttpParser s(false);
+  IOBuf in4;
+  in4.append("HTTP/1.1 200 OK\r\nContent-Length: 10\r\n\r\nabc");
+  assert(s.Consume(&in4) == HttpParser::NEED_MORE);
+  assert(s.OnEof() == HttpParser::ERROR);
+  printf("response parsing ok\n");
+}
+
+static void test_malformed() {
+  // CL + TE together: smuggling vector, rejected.
+  {
+    HttpParser p(true);
+    IOBuf in;
+    in.append("POST / HTTP/1.1\r\nContent-Length: 3\r\n"
+              "Transfer-Encoding: chunked\r\n\r\n");
+    assert(p.Consume(&in) == HttpParser::ERROR);
+  }
+  // Space in header name.
+  {
+    HttpParser p(true);
+    IOBuf in;
+    in.append("GET / HTTP/1.1\r\nBad Header: x\r\n\r\n");
+    assert(p.Consume(&in) == HttpParser::ERROR);
+  }
+  // Non-numeric content length.
+  {
+    HttpParser p(true);
+    IOBuf in;
+    in.append("GET / HTTP/1.1\r\nContent-Length: 12x\r\n\r\n");
+    assert(p.Consume(&in) == HttpParser::ERROR);
+  }
+  // Bad chunk size.
+  {
+    HttpParser p(true);
+    IOBuf in;
+    in.append("POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\nzz\r\n");
+    assert(p.Consume(&in) == HttpParser::ERROR);
+  }
+  // Oversized header line.
+  {
+    HttpParser p(true);
+    IOBuf in;
+    in.append("GET / HTTP/1.1\r\nX: " + std::string(20000, 'a'));
+    assert(p.Consume(&in) == HttpParser::ERROR);
+  }
+  // HTTP/2.0 start line is not ours.
+  {
+    HttpParser p(true);
+    IOBuf in;
+    in.append("GET / HTTP/2.0\r\n\r\n");
+    assert(p.Consume(&in) == HttpParser::ERROR);
+  }
+  printf("malformed ok\n");
+}
+
+static void test_serialize_and_chunks() {
+  HttpMessage m;
+  m.status = 200;
+  m.set_header("Content-Type", "text/plain");
+  m.set_header("Transfer-Encoding", "chunked");
+  IOBuf out;
+  SerializeHttpHead(m, false, &out);
+  IOBuf piece;
+  piece.append("hello ");
+  AppendChunk(&out, piece);
+  piece.clear();
+  piece.append("chunked world");
+  AppendChunk(&out, piece);
+  AppendLastChunk(&out);
+
+  HttpParser p(false);
+  assert(p.Consume(&out) == HttpParser::DONE);
+  assert(p.msg()->body.to_string() == "hello chunked world");
+  printf("serialize+chunks round-trip ok\n");
+}
+
+static void test_repeated_headers() {
+  HttpParser p(true);
+  IOBuf in;
+  in.append("GET / HTTP/1.1\r\nAccept: a\r\nACCEPT: b\r\n\r\n");
+  assert(p.Consume(&in) == HttpParser::DONE);
+  assert(*p.msg()->header("accept") == "a, b");
+  printf("repeated headers ok\n");
+}
+
+int main() {
+  test_flat_map();
+  test_simple_request();
+  test_byte_at_a_time();
+  test_pipelined_messages();
+  test_response_parsing();
+  test_malformed();
+  test_serialize_and_chunks();
+  test_repeated_headers();
+  printf("test_http_message OK\n");
+  return 0;
+}

@@ -207,6 +207,11 @@ void Socket::OnRecycle() {
     BRT_LOG(ERROR) << "write chain not empty at recycle, leaking it";
   }
   read_buf.clear();
+  if (parsing_context_ != nullptr) {
+    if (parsing_context_destroyer_) parsing_context_destroyer_(parsing_context_);
+    parsing_context_ = nullptr;
+    parsing_context_destroyer_ = nullptr;
+  }
   uint32_t v = id_version(id_);
   vref_.store(uint64_t(v + 1) << 32, std::memory_order_release);
   slab.free_index(id_index(id_));

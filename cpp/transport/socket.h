@@ -109,6 +109,19 @@ class Socket {
   // Last-matched protocol index for InputMessenger (reference keeps this on
   // the socket too, input_messenger.cpp:77).
   int preferred_protocol = -1;
+
+  // Per-protocol connection state (HTTP parser, h2 session, ...). Owned by
+  // the socket: the destroyer runs at recycle (reference keeps
+  // parsing_context on Socket the same way, socket.h:229 region). Only the
+  // read fiber installs it; completion paths reach it under a live ref.
+  void* parsing_context() const { return parsing_context_; }
+  void reset_parsing_context(void* ctx, void (*destroyer)(void*)) {
+    if (parsing_context_ != nullptr && parsing_context_destroyer_) {
+      parsing_context_destroyer_(parsing_context_);
+    }
+    parsing_context_ = ctx;
+    parsing_context_destroyer_ = destroyer;
+  }
   // Correlation-id of the in-flight RPC for single-connection client sockets
   // is tracked by the Controller, not here.
 
@@ -174,6 +187,8 @@ class Socket {
   void (*on_failed_)(Socket*) = nullptr;
   std::atomic<int> failed_{0};
   std::string error_text_;
+  void* parsing_context_ = nullptr;
+  void (*parsing_context_destroyer_)(void*) = nullptr;
   std::atomic<WriteReq*> write_head_{nullptr};  // MPSC chain, Vyukov-style
   std::mutex waiters_mu_;
   std::vector<fid_t> waiters_;  // in-flight RPC ids awaiting responses
