@@ -1,0 +1,891 @@
+// HTTP/2 server + gRPC layering on the shared RPC port.
+// Parity target: reference src/brpc/policy/http2_rpc_protocol.cpp (1842
+// LoC) + grpc.cpp (status/timeout mapping, grpc.h:27,151). Redesigned:
+// frames are cut by the InputMessenger and processed IN ORDER in the read
+// fiber (HPACK state is sequential by construction); request handlers run
+// in their own fibers and completions re-enter the session under its lock,
+// where HPACK blocks are encoded at the moment they are appended to the
+// wire so encoder state always matches wire order — including trailers
+// parked behind flow-control windows.
+#include "rpc/http2_protocol.h"
+
+#include <cstring>
+#include <map>
+#include <vector>
+#include <mutex>
+#include <string>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "rpc/builtin.h"
+#include "rpc/controller.h"
+#include "rpc/errors.h"
+#include "rpc/hpack.h"
+#include "rpc/http_dispatch.h"
+#include "rpc/server.h"
+#include "transport/input_messenger.h"
+
+namespace brt {
+
+void AppendH2FrameHeader(IOBuf* out, uint32_t payload_len, H2FrameType type,
+                         uint8_t flags, uint32_t stream_id) {
+  uint8_t h[9];
+  h[0] = uint8_t(payload_len >> 16);
+  h[1] = uint8_t(payload_len >> 8);
+  h[2] = uint8_t(payload_len);
+  h[3] = uint8_t(type);
+  h[4] = flags;
+  h[5] = uint8_t(stream_id >> 24) & 0x7f;
+  h[6] = uint8_t(stream_id >> 16);
+  h[7] = uint8_t(stream_id >> 8);
+  h[8] = uint8_t(stream_id);
+  out->append(h, 9);
+}
+
+void AppendGrpcMessage(IOBuf* out, const IOBuf& message) {
+  uint8_t h[5];
+  h[0] = 0;  // not compressed
+  const uint32_t n = uint32_t(message.size());
+  h[1] = uint8_t(n >> 24);
+  h[2] = uint8_t(n >> 16);
+  h[3] = uint8_t(n >> 8);
+  h[4] = uint8_t(n);
+  out->append(h, 5);
+  out->append(message);
+}
+
+bool CutGrpcMessage(IOBuf* in, IOBuf* message) {
+  uint8_t h[5];
+  if (in->size() < 5) return false;
+  in->copy_to(h, 5);
+  if (h[0] != 0) return false;  // compression unsupported (no codec set)
+  const uint32_t n = (uint32_t(h[1]) << 24) | (uint32_t(h[2]) << 16) |
+                     (uint32_t(h[3]) << 8) | uint32_t(h[4]);
+  if (in->size() != 5 + size_t(n)) return false;  // exactly one message
+  in->pop_front(5);
+  in->cutn(message, n);
+  return true;
+}
+
+int64_t ParseGrpcTimeoutMs(const std::string& v) {
+  if (v.size() < 2) return -1;
+  int64_t n = 0;
+  for (size_t i = 0; i + 1 < v.size(); ++i) {
+    if (v[i] < '0' || v[i] > '9') return -1;
+    n = n * 10 + (v[i] - '0');
+    if (n > (int64_t(1) << 40)) return -1;
+  }
+  switch (v.back()) {
+    case 'H': return n * 3600 * 1000;
+    case 'M': return n * 60 * 1000;
+    case 'S': return n * 1000;
+    case 'm': return n;
+    case 'u': return n / 1000;
+    case 'n': return n / 1000000;
+    default: return -1;
+  }
+}
+
+namespace {
+
+// h2 error codes (RFC 7540 §7).
+constexpr uint32_t H2_NO_ERROR = 0;
+constexpr uint32_t H2_PROTOCOL_ERROR = 1;
+constexpr uint32_t H2_FLOW_CONTROL_ERROR = 3;
+constexpr uint32_t H2_FRAME_SIZE_ERROR = 6;
+constexpr uint32_t H2_REFUSED_STREAM = 7;
+constexpr uint32_t H2_COMPRESSION_ERROR = 9;
+
+// SETTINGS ids.
+constexpr uint16_t SET_HEADER_TABLE_SIZE = 1;
+constexpr uint16_t SET_MAX_CONCURRENT_STREAMS = 3;
+constexpr uint16_t SET_INITIAL_WINDOW_SIZE = 4;
+constexpr uint16_t SET_MAX_FRAME_SIZE = 5;
+constexpr uint16_t SET_MAX_HEADER_LIST_SIZE = 6;
+
+constexpr int64_t kOurConnWindow = 1 << 20;    // advertised connection window
+constexpr int32_t kOurStreamWindow = 1 << 20;  // advertised per-stream window
+constexpr uint32_t kOurMaxStreams = 1024;
+constexpr uint64_t kMaxH2Body = 64ull << 20;       // per-stream request body
+constexpr uint64_t kMaxSessionBuffer = 256ull << 20;  // aggregate, fatal
+// Stop replenishing flow windows once this much is parked — flow control
+// becomes real backpressure instead of an unbounded buffer.
+constexpr uint64_t kStreamReplenishCap = 8ull << 20;
+constexpr uint64_t kSessionReplenishCap = 64ull << 20;
+
+struct H2Stream {
+  HeaderList req_headers;
+  IOBuf body;
+  bool headers_done = false;
+  bool remote_closed = false;
+  bool local_closed = false;
+  bool dispatched = false;
+  int64_t send_window = 65535;  // peer-advertised, bytes we may still send
+  int32_t recv_window = kOurStreamWindow;
+  uint64_t buffered_bytes = 0;  // this stream's share of session->buffered
+  // Response bytes parked behind flow control; trailers are kept as a
+  // HeaderList and HPACK-encoded only at wire-append time.
+  IOBuf pending_data;
+  bool pending_end_stream = false;
+  bool has_pending_trailers = false;
+  HeaderList pending_trailers;
+};
+
+struct H2Session {
+  std::mutex mu;  // guards everything below + HPACK enc + writes
+  HpackDecoder dec{4096};
+  HpackEncoder enc{4096};
+  std::map<uint32_t, H2Stream> streams;
+  uint32_t last_stream_id = 0;
+  uint32_t goaway_sent = 0;       // nonzero once we sent GOAWAY
+  bool peer_goaway = false;
+  uint32_t peer_max_frame = 16384;
+  int64_t conn_send_window = 65535;
+  int64_t conn_recv_window = kOurConnWindow;
+  uint32_t peer_initial_window = 65535;
+  uint64_t buffered = 0;  // request bytes buffered across all streams
+  // continuation accumulation
+  uint32_t cont_stream = 0;
+  uint8_t cont_flags = 0;
+  std::string cont_buf;
+  SocketId sid = 0;
+};
+
+void DestroyH2Session(void* p) { delete static_cast<H2Session*>(p); }
+
+H2Session* GetSession(Socket* s) {
+  return static_cast<H2Session*>(s->parsing_context());
+}
+
+void AppendSettings(IOBuf* out,
+                    const std::vector<std::pair<uint16_t, uint32_t>>& kv) {
+  AppendH2FrameHeader(out, uint32_t(kv.size() * 6), H2FrameType::SETTINGS, 0,
+                      0);
+  for (auto [id, v] : kv) {
+    uint8_t b[6] = {uint8_t(id >> 8),  uint8_t(id),      uint8_t(v >> 24),
+                    uint8_t(v >> 16),  uint8_t(v >> 8),  uint8_t(v)};
+    out->append(b, 6);
+  }
+}
+
+void SendGoAwayLocked(H2Session* sess, Socket* s, uint32_t err) {
+  if (sess->goaway_sent) return;
+  sess->goaway_sent = err + 1;
+  IOBuf out;
+  AppendH2FrameHeader(&out, 8, H2FrameType::GOAWAY, 0, 0);
+  uint8_t b[8] = {uint8_t(sess->last_stream_id >> 24) & 0x7f,
+                  uint8_t(sess->last_stream_id >> 16),
+                  uint8_t(sess->last_stream_id >> 8),
+                  uint8_t(sess->last_stream_id),
+                  uint8_t(err >> 24), uint8_t(err >> 16),
+                  uint8_t(err >> 8), uint8_t(err)};
+  out.append(b, 8);
+  s->Write(&out);
+}
+
+void SendRstLocked(Socket* s, uint32_t stream_id, uint32_t err) {
+  IOBuf out;
+  AppendH2FrameHeader(&out, 4, H2FrameType::RST_STREAM, 0, stream_id);
+  uint8_t b[4] = {uint8_t(err >> 24), uint8_t(err >> 16), uint8_t(err >> 8),
+                  uint8_t(err)};
+  out.append(b, 4);
+  s->Write(&out);
+}
+
+// Emits as much of the stream's parked DATA (and trailers) as the flow
+// windows allow. Caller holds sess->mu. Appends to *wire.
+void FlushStreamLocked(H2Session* sess, uint32_t id, H2Stream* st,
+                       IOBuf* wire) {
+  while (!st->pending_data.empty() && sess->conn_send_window > 0 &&
+         st->send_window > 0) {
+    size_t n = st->pending_data.size();
+    if (int64_t(n) > sess->conn_send_window) {
+      n = size_t(sess->conn_send_window);
+    }
+    if (int64_t(n) > st->send_window) n = size_t(st->send_window);
+    if (n > sess->peer_max_frame) n = sess->peer_max_frame;
+    IOBuf piece;
+    st->pending_data.cutn(&piece, n);
+    const bool last = st->pending_data.empty() && st->pending_end_stream &&
+                      !st->has_pending_trailers;
+    AppendH2FrameHeader(wire, uint32_t(n), H2FrameType::DATA,
+                        last ? kH2FlagEndStream : 0, id);
+    wire->append(std::move(piece));
+    sess->conn_send_window -= int64_t(n);
+    st->send_window -= int64_t(n);
+    if (last) st->local_closed = true;
+  }
+  if (st->pending_data.empty() && st->has_pending_trailers) {
+    // Trailers are encoded HERE so the HPACK encoder sees blocks in wire
+    // order even when data was parked behind flow control.
+    std::string block;
+    sess->enc.Encode(st->pending_trailers, &block);
+    AppendH2FrameHeader(wire, uint32_t(block.size()), H2FrameType::HEADERS,
+                        kH2FlagEndHeaders | kH2FlagEndStream, id);
+    wire->append(block);
+    st->has_pending_trailers = false;
+    st->local_closed = true;
+  }
+}
+
+// Removes a stream, returning its buffered request bytes to the session
+// budget (all erase sites must go through here).
+void EraseStreamLocked(H2Session* sess,
+                       std::map<uint32_t, H2Stream>::iterator it) {
+  sess->buffered -= sess->buffered < it->second.buffered_bytes
+                        ? sess->buffered
+                        : it->second.buffered_bytes;
+  sess->streams.erase(it);
+}
+
+void EraseStreamLocked(H2Session* sess, uint32_t id) {
+  auto it = sess->streams.find(id);
+  if (it != sess->streams.end()) EraseStreamLocked(sess, it);
+}
+
+bool StreamRetired(const H2Stream& st) {
+  return st.local_closed && st.remote_closed && !st.has_pending_trailers &&
+         st.pending_data.empty();
+}
+
+void MaybeEraseStreamLocked(H2Session* sess, uint32_t id) {
+  auto it = sess->streams.find(id);
+  if (it != sess->streams.end() && StreamRetired(it->second)) {
+    EraseStreamLocked(sess, it);
+  }
+}
+
+// Queues a complete response on the stream: HEADERS now (wire-ordered),
+// DATA/trailers through the flow-control path.
+void SendResponseLocked(H2Session* sess, Socket* s, uint32_t id,
+                        H2Stream* st, const HeaderList& resp_headers,
+                        IOBuf&& data, bool grpc,
+                        const HeaderList& trailers) {
+  IOBuf wire;
+  std::string block;
+  sess->enc.Encode(resp_headers, &block);
+  const bool end_now = data.empty() && !grpc;
+  AppendH2FrameHeader(&wire, uint32_t(block.size()), H2FrameType::HEADERS,
+                      end_now ? (kH2FlagEndHeaders | kH2FlagEndStream)
+                              : kH2FlagEndHeaders,
+                      id);
+  wire.append(block);
+  if (end_now) {
+    st->local_closed = true;
+  } else {
+    st->pending_data = std::move(data);
+    st->pending_end_stream = true;
+    if (grpc) {
+      st->has_pending_trailers = true;
+      st->pending_trailers = trailers;
+    }
+    FlushStreamLocked(sess, id, st, &wire);
+  }
+  s->Write(&wire);
+  MaybeEraseStreamLocked(sess, id);
+}
+
+// ---- request dispatch (shared with the gRPC layer) ----
+
+const std::string* FindHeader(const HeaderList& h, const char* name) {
+  for (const auto& f : h) {
+    if (f.name == name) return &f.value;
+  }
+  return nullptr;
+}
+
+int GrpcStatusFromError(int ec) {
+  // gRPC status codes (grpc.h:27 analog).
+  switch (ec) {
+    case 0: return 0;            // OK
+    case ENOSERVICE:
+    case ENOMETHOD: return 12;   // UNIMPLEMENTED
+    case ELIMIT: return 8;       // RESOURCE_EXHAUSTED
+    case ERPCTIMEDOUT: return 4; // DEADLINE_EXCEEDED
+    case ECANCELEDRPC: return 1;  // CANCELLED
+    default: return 13;          // INTERNAL
+  }
+}
+
+struct H2RequestCtx {
+  SocketId sid;
+  uint32_t stream_id;
+  bool grpc = false;
+  Controller cntl;
+  IOBuf request;
+  IOBuf response;
+  MethodStatus* ms = nullptr;
+  Server* server = nullptr;
+  int64_t start_us = 0;
+};
+
+void RespondH2(H2RequestCtx* ctx, int http_status,
+               const std::string& content_type, IOBuf&& body,
+               int grpc_status, const std::string& grpc_message) {
+  SocketUniquePtr s;
+  if (Socket::Address(ctx->sid, &s) != 0) return;
+  H2Session* sess = GetSession(s.get());
+  if (sess == nullptr) return;
+  std::lock_guard<std::mutex> g(sess->mu);
+  auto it = sess->streams.find(ctx->stream_id);
+  if (it == sess->streams.end()) return;  // stream reset meanwhile
+  HeaderList rh;
+  rh.push_back({":status", std::to_string(http_status)});
+  rh.push_back({"content-type", content_type});
+  IOBuf data;
+  HeaderList trailers;
+  if (ctx->grpc) {
+    if (grpc_status == 0) AppendGrpcMessage(&data, body);
+    trailers.push_back({"grpc-status", std::to_string(grpc_status)});
+    if (!grpc_message.empty()) {
+      trailers.push_back({"grpc-message", grpc_message});
+    }
+  } else {
+    rh.push_back({"content-length", std::to_string(body.size())});
+    data = std::move(body);
+  }
+  SendResponseLocked(sess, s.get(), ctx->stream_id, &it->second, rh,
+                     std::move(data), ctx->grpc, trailers);
+}
+
+void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
+                       H2Stream* st) {
+  st->dispatched = true;
+  const std::string* method = FindHeader(st->req_headers, ":method");
+  const std::string* target = FindHeader(st->req_headers, ":path");
+  auto* server = static_cast<Server*>(s->user());
+  if (method == nullptr || target == nullptr) {
+    std::lock_guard<std::mutex> g(sess->mu);
+    SendRstLocked(s, id, H2_PROTOCOL_ERROR);
+    EraseStreamLocked(sess, id);
+    return;
+  }
+  const std::string* ctype = FindHeader(st->req_headers, "content-type");
+  const bool grpc =
+      ctype != nullptr && ctype->rfind("application/grpc", 0) == 0;
+
+  std::string path = *target, query;
+  const size_t q = path.find('?');
+  if (q != std::string::npos) {
+    query = path.substr(q + 1);
+    path.resize(q);
+  }
+
+  auto* ctx = new H2RequestCtx;
+  ctx->sid = s->id();
+  ctx->stream_id = id;
+  ctx->grpc = grpc;
+  ctx->server = server;
+  ctx->cntl.set_remote_side(s->remote());
+
+  auto fail = [&](int http_status, const std::string& text, int gstatus) {
+    IOBuf body;
+    body.append(text);
+    RespondH2(ctx, grpc ? 200 : http_status,
+              grpc ? "application/grpc" : "text/plain", std::move(body),
+              gstatus, gstatus ? text : "");
+    delete ctx;
+  };
+
+  if (!grpc) {
+    HttpResponse builtin;
+    if (HandleBuiltinPage(server, *method, path, query, &builtin)) {
+      IOBuf body;
+      body.append(builtin.body);
+      RespondH2(ctx, builtin.status, builtin.content_type, std::move(body),
+                0, "");
+      delete ctx;
+      return;
+    }
+  }
+  // Shared resolution/admission ladder — identical routing to HTTP/1.1.
+  HttpAdmission adm;
+  if (!AdmitHttpRequest(server, path, &adm)) {
+    fail(adm.http_status, adm.error, adm.grpc_status);
+    return;
+  }
+  ctx->ms = adm.ms;
+  ctx->start_us = monotonic_us();
+  if (grpc) {
+    const std::string* tmo = FindHeader(st->req_headers, "grpc-timeout");
+    if (tmo != nullptr) {
+      const int64_t ms_left = ParseGrpcTimeoutMs(*tmo);
+      if (ms_left >= 0) ctx->cntl.timeout_ms = ms_left;
+    }
+    if (!CutGrpcMessage(&st->body, &ctx->request)) {
+      FinishHttpRequest(server, adm.ms, EREQUEST, 0);
+      fail(200, "malformed grpc framing", 13);
+      return;
+    }
+  } else {
+    ctx->request = std::move(st->body);
+  }
+  {
+    std::lock_guard<std::mutex> g(sess->mu);
+    sess->buffered -= sess->buffered < st->buffered_bytes
+                          ? sess->buffered
+                          : st->buffered_bytes;
+    st->buffered_bytes = 0;
+  }
+  adm.svc->CallMethod(adm.method, &ctx->cntl, ctx->request, &ctx->response,
+                      [ctx] {
+    const int ec = ctx->cntl.Failed() ? ctx->cntl.ErrorCode() : 0;
+    if (ec == 0) {
+      IOBuf body = std::move(ctx->response);
+      body.append(std::move(ctx->cntl.response_attachment()));
+      RespondH2(ctx, 200,
+                ctx->grpc ? "application/grpc" : "application/octet-stream",
+                std::move(body), 0, "");
+    } else if (ctx->grpc) {
+      IOBuf empty;
+      RespondH2(ctx, 200, "application/grpc", std::move(empty),
+                GrpcStatusFromError(ec), ctx->cntl.ErrorText());
+    } else {
+      IOBuf body;
+      body.append(std::to_string(ec) + ": " + ctx->cntl.ErrorText() + "\n");
+      RespondH2(ctx, 500, "text/plain", std::move(body), 0, "");
+    }
+    FinishHttpRequest(ctx->server, ctx->ms, ec,
+                      monotonic_us() - ctx->start_us);
+    delete ctx;
+  });
+}
+
+// ---- frame processing (runs inline, in order, in the read fiber) ----
+
+void FailConnection(Socket* s, H2Session* sess, uint32_t err,
+                    const char* why) {
+  {
+    std::lock_guard<std::mutex> g(sess->mu);
+    SendGoAwayLocked(sess, s, err);
+  }
+  s->SetFailed(EPROTO, "h2 connection error: %s", why);
+}
+
+// Decodes one complete header block for `id`, appending to req_headers.
+// Returns false on compression error (connection-fatal).
+bool DecodeHeaderBlock(H2Session* sess, const std::string& block,
+                       H2Stream* st) {
+  return sess->dec.Decode(
+      reinterpret_cast<const uint8_t*>(block.data()), block.size(),
+      &st->req_headers);
+}
+
+void HandleCompleteHeaders(Socket* s, H2Session* sess, uint32_t id,
+                           uint8_t flags, const std::string& block) {
+  H2Stream* st;
+  {
+    std::lock_guard<std::mutex> g(sess->mu);
+    auto it = sess->streams.find(id);
+    if (it == sess->streams.end()) {
+      // New stream.
+      if (id <= sess->last_stream_id || (id & 1) == 0) {
+        // PROTOCOL_ERROR: ids must be odd and increasing. A headers frame
+        // for an old (already erased) stream is tolerated as trailers-after
+        // -close would be — but decode to keep HPACK state, then drop.
+        H2Stream scratch;
+        if (!DecodeHeaderBlock(sess, block, &scratch)) {
+          SendGoAwayLocked(sess, s, H2_COMPRESSION_ERROR);
+          s->SetFailed(EPROTO, "hpack error");
+        }
+        return;
+      }
+      // After either side's GOAWAY no new streams are admitted (the peer
+      // said it is going away; we honor that instead of doing dead work).
+      if (sess->streams.size() >= kOurMaxStreams || sess->goaway_sent ||
+          sess->peer_goaway) {
+        H2Stream scratch;
+        if (!DecodeHeaderBlock(sess, block, &scratch)) {
+          SendGoAwayLocked(sess, s, H2_COMPRESSION_ERROR);
+          s->SetFailed(EPROTO, "hpack error");
+          return;
+        }
+        SendRstLocked(s, id, H2_REFUSED_STREAM);
+        return;
+      }
+      sess->last_stream_id = id;
+      it = sess->streams.emplace(id, H2Stream()).first;
+      it->second.send_window = sess->peer_initial_window;
+    }
+    st = &it->second;
+    if (!DecodeHeaderBlock(sess, block, st)) {
+      SendGoAwayLocked(sess, s, H2_COMPRESSION_ERROR);
+      s->SetFailed(EPROTO, "hpack error");
+      return;
+    }
+    st->headers_done = true;
+    if (flags & kH2FlagEndStream) st->remote_closed = true;
+  }
+  if (st->remote_closed && !st->dispatched) {
+    DispatchH2Request(s, sess, id, st);
+  }
+}
+
+// Returns false on connection-fatal error.
+bool ProcessFrame(Socket* s, H2Session* sess, uint8_t type, uint8_t flags,
+                  uint32_t stream_id, IOBuf&& payload) {
+  // A started header block admits ONLY its CONTINUATION frames until
+  // END_HEADERS (RFC 7540 §6.2) — anything else is connection-fatal.
+  if (sess->cont_stream != 0 &&
+      H2FrameType(type) != H2FrameType::CONTINUATION) {
+    FailConnection(s, sess, H2_PROTOCOL_ERROR,
+                   "non-CONTINUATION frame inside a header block");
+    return false;
+  }
+  switch (H2FrameType(type)) {
+    case H2FrameType::HEADERS: {
+      if (stream_id == 0) {
+        FailConnection(s, sess, H2_PROTOCOL_ERROR, "HEADERS on stream 0");
+        return false;
+      }
+      std::string block;
+      size_t skip = 0, pad = 0;
+      const size_t n = payload.size();
+      uint8_t tmp[5];
+      if (flags & kH2FlagPadded) {
+        if (n < 1) {
+          FailConnection(s, sess, H2_PROTOCOL_ERROR, "empty padded HEADERS");
+          return false;
+        }
+        payload.copy_to(tmp, 1);
+        pad = tmp[0];
+        skip += 1;
+      }
+      if (flags & kH2FlagPriority) skip += 5;
+      if (skip + pad > n) {
+        FailConnection(s, sess, H2_PROTOCOL_ERROR, "bad padding");
+        return false;
+      }
+      payload.pop_front(skip);
+      payload.pop_back(pad);
+      payload.copy_to(&block);
+      if (flags & kH2FlagEndHeaders) {
+        HandleCompleteHeaders(s, sess, stream_id, flags, block);
+      } else {
+        sess->cont_stream = stream_id;
+        sess->cont_flags = flags;
+        sess->cont_buf = std::move(block);
+      }
+      return true;
+    }
+    case H2FrameType::CONTINUATION: {
+      if (sess->cont_stream == 0 || stream_id != sess->cont_stream) {
+        FailConnection(s, sess, H2_PROTOCOL_ERROR, "orphan CONTINUATION");
+        return false;
+      }
+      std::string more;
+      payload.copy_to(&more);
+      sess->cont_buf += more;
+      if (sess->cont_buf.size() > 1 << 20) {
+        FailConnection(s, sess, H2_PROTOCOL_ERROR, "header block too big");
+        return false;
+      }
+      if (flags & kH2FlagEndHeaders) {
+        const uint32_t id = sess->cont_stream;
+        const uint8_t first_flags = sess->cont_flags;
+        std::string block = std::move(sess->cont_buf);
+        sess->cont_stream = 0;
+        sess->cont_buf.clear();
+        HandleCompleteHeaders(s, sess, id, first_flags, block);
+      }
+      return true;
+    }
+    case H2FrameType::DATA: {
+      if (stream_id == 0) {
+        FailConnection(s, sess, H2_PROTOCOL_ERROR, "DATA on stream 0");
+        return false;
+      }
+      const size_t flen = payload.size();
+      size_t pad = 0;
+      if (flags & kH2FlagPadded) {
+        uint8_t p0;
+        if (flen < 1) {
+          FailConnection(s, sess, H2_PROTOCOL_ERROR, "empty padded DATA");
+          return false;
+        }
+        payload.copy_to(&p0, 1);
+        pad = p0;
+        if (pad + 1 > flen) {
+          FailConnection(s, sess, H2_PROTOCOL_ERROR, "bad DATA padding");
+          return false;
+        }
+        payload.pop_front(1);
+        payload.pop_back(pad);
+      }
+      H2Stream* st = nullptr;
+      bool dispatch = false;
+      {
+        std::lock_guard<std::mutex> g(sess->mu);
+        sess->conn_recv_window -= int64_t(flen);
+        if (sess->conn_recv_window < 0) {
+          SendGoAwayLocked(sess, s, H2_FLOW_CONTROL_ERROR);
+          s->SetFailed(EPROTO, "connection flow window exceeded");
+          return false;
+        }
+        auto it = sess->streams.find(stream_id);
+        if (it == sess->streams.end()) {
+          // Already reset: still account + replenish connection window.
+        } else {
+          st = &it->second;
+          st->recv_window -= int32_t(flen);
+          if (st->recv_window < 0) {
+            SendRstLocked(s, stream_id, H2_FLOW_CONTROL_ERROR);
+            EraseStreamLocked(sess, it);
+            st = nullptr;
+          } else if (!st->headers_done || st->remote_closed) {
+            SendRstLocked(s, stream_id, H2_PROTOCOL_ERROR);
+            EraseStreamLocked(sess, it);
+            st = nullptr;
+          } else if (st->body.size() + payload.size() > kMaxH2Body) {
+            SendRstLocked(s, stream_id, H2_PROTOCOL_ERROR);
+            EraseStreamLocked(sess, it);
+            st = nullptr;
+          } else {
+            const size_t n = payload.size();
+            st->body.append(std::move(payload));
+            st->buffered_bytes += n;
+            sess->buffered += n;
+            if (sess->buffered > kMaxSessionBuffer) {
+              // One connection does not get to hold this much memory.
+              SendGoAwayLocked(sess, s, H2_FLOW_CONTROL_ERROR);
+              s->SetFailed(EPROTO, "h2 session buffer exhausted");
+              return false;
+            }
+            if (flags & kH2FlagEndStream) {
+              st->remote_closed = true;
+              dispatch = !st->dispatched;
+            }
+          }
+        }
+        // Replenish windows at half-way (WINDOW_UPDATE batching) — but only
+        // while buffered bytes stay modest: past the caps the windows run
+        // dry and flow control becomes backpressure on the sender.
+        IOBuf wu;
+        if (sess->conn_recv_window < kOurConnWindow / 2 &&
+            sess->buffered < kSessionReplenishCap) {
+          const uint32_t delta =
+              uint32_t(kOurConnWindow - sess->conn_recv_window);
+          AppendH2FrameHeader(&wu, 4, H2FrameType::WINDOW_UPDATE, 0, 0);
+          uint8_t b[4] = {uint8_t(delta >> 24), uint8_t(delta >> 16),
+                          uint8_t(delta >> 8), uint8_t(delta)};
+          wu.append(b, 4);
+          sess->conn_recv_window = kOurConnWindow;
+        }
+        if (st != nullptr && !st->remote_closed &&
+            st->recv_window < kOurStreamWindow / 2 &&
+            st->buffered_bytes < kStreamReplenishCap) {
+          const uint32_t delta =
+              uint32_t(kOurStreamWindow - st->recv_window);
+          AppendH2FrameHeader(&wu, 4, H2FrameType::WINDOW_UPDATE, 0,
+                              stream_id);
+          uint8_t b[4] = {uint8_t(delta >> 24), uint8_t(delta >> 16),
+                          uint8_t(delta >> 8), uint8_t(delta)};
+          wu.append(b, 4);
+          st->recv_window = kOurStreamWindow;
+        }
+        if (!wu.empty()) s->Write(&wu);
+      }
+      if (dispatch && st != nullptr) {
+        DispatchH2Request(s, sess, stream_id, st);
+      }
+      return true;
+    }
+    case H2FrameType::SETTINGS: {
+      if (flags & kH2FlagAck) return true;
+      if (payload.size() % 6 != 0) {
+        FailConnection(s, sess, H2_FRAME_SIZE_ERROR, "bad SETTINGS size");
+        return false;
+      }
+      std::string raw;
+      payload.copy_to(&raw);
+      {
+        std::lock_guard<std::mutex> g(sess->mu);
+        for (size_t i = 0; i + 6 <= raw.size(); i += 6) {
+          const uint8_t* p = reinterpret_cast<const uint8_t*>(raw.data()) + i;
+          const uint16_t id = uint16_t((p[0] << 8) | p[1]);
+          const uint32_t v = (uint32_t(p[2]) << 24) | (uint32_t(p[3]) << 16) |
+                             (uint32_t(p[4]) << 8) | uint32_t(p[5]);
+          switch (id) {
+            case SET_HEADER_TABLE_SIZE:
+              // Clamp: the peer may lower our encoder table but not grow
+              // it beyond the default — unbounded peer-controlled encoder
+              // state is a memory/CPU amplification vector.
+              sess->enc.SetMaxTableSize(v < 4096 ? v : 4096);
+              break;
+            case SET_INITIAL_WINDOW_SIZE: {
+              if (v > 0x7fffffffu) {
+                SendGoAwayLocked(sess, s, H2_FLOW_CONTROL_ERROR);
+                s->SetFailed(EPROTO, "bad initial window");
+                return false;
+              }
+              const int64_t delta =
+                  int64_t(v) - int64_t(sess->peer_initial_window);
+              sess->peer_initial_window = v;
+              IOBuf wire;
+              for (auto& [sid2, st2] : sess->streams) {
+                st2.send_window += delta;
+                if (delta > 0) FlushStreamLocked(sess, sid2, &st2, &wire);
+              }
+              if (!wire.empty()) s->Write(&wire);
+              break;
+            }
+            case SET_MAX_FRAME_SIZE:
+              if (v >= 16384 && v <= 16777215) sess->peer_max_frame = v;
+              break;
+            default:
+              break;  // MAX_CONCURRENT_STREAMS etc: accepted, unenforced
+          }
+        }
+        IOBuf ack;
+        AppendH2FrameHeader(&ack, 0, H2FrameType::SETTINGS, kH2FlagAck, 0);
+        s->Write(&ack);
+      }
+      return true;
+    }
+    case H2FrameType::WINDOW_UPDATE: {
+      if (payload.size() != 4) {
+        FailConnection(s, sess, H2_FRAME_SIZE_ERROR, "bad WINDOW_UPDATE");
+        return false;
+      }
+      uint8_t b[4];
+      payload.copy_to(b, 4);
+      const uint32_t delta = ((uint32_t(b[0]) & 0x7f) << 24) |
+                             (uint32_t(b[1]) << 16) | (uint32_t(b[2]) << 8) |
+                             uint32_t(b[3]);
+      if (delta == 0) {
+        FailConnection(s, sess, H2_PROTOCOL_ERROR, "zero WINDOW_UPDATE");
+        return false;
+      }
+      std::lock_guard<std::mutex> g(sess->mu);
+      IOBuf wire;
+      if (stream_id == 0) {
+        sess->conn_send_window += delta;
+        if (sess->conn_send_window > 0x7fffffff) {
+          SendGoAwayLocked(sess, s, H2_FLOW_CONTROL_ERROR);
+          s->SetFailed(EPROTO, "window overflow");
+          return false;
+        }
+        for (auto& [sid2, st2] : sess->streams) {
+          FlushStreamLocked(sess, sid2, &st2, &wire);
+        }
+      } else {
+        auto it = sess->streams.find(stream_id);
+        if (it != sess->streams.end()) {
+          it->second.send_window += delta;
+          FlushStreamLocked(sess, stream_id, &it->second, &wire);
+        }
+      }
+      if (!wire.empty()) s->Write(&wire);
+      for (auto it = sess->streams.begin(); it != sess->streams.end();) {
+        auto cur = it++;
+        if (StreamRetired(cur->second)) EraseStreamLocked(sess, cur);
+      }
+      return true;
+    }
+    case H2FrameType::RST_STREAM: {
+      if (stream_id == 0 || payload.size() != 4) {
+        FailConnection(s, sess, H2_PROTOCOL_ERROR, "bad RST_STREAM");
+        return false;
+      }
+      std::lock_guard<std::mutex> g(sess->mu);
+      EraseStreamLocked(sess, stream_id);
+      return true;
+    }
+    case H2FrameType::PING: {
+      if (payload.size() != 8) {
+        FailConnection(s, sess, H2_FRAME_SIZE_ERROR, "bad PING");
+        return false;
+      }
+      if (flags & kH2FlagAck) return true;
+      IOBuf out;
+      AppendH2FrameHeader(&out, 8, H2FrameType::PING, kH2FlagAck, 0);
+      out.append(std::move(payload));
+      s->Write(&out);
+      return true;
+    }
+    case H2FrameType::GOAWAY:
+      sess->peer_goaway = true;
+      return true;
+    case H2FrameType::PUSH_PROMISE:
+      FailConnection(s, sess, H2_PROTOCOL_ERROR, "client PUSH_PROMISE");
+      return false;
+    case H2FrameType::PRIORITY:
+      return true;  // advisory; ignored
+    default:
+      return true;  // unknown frame types are ignored (RFC 7540 §4.1)
+  }
+}
+
+// ---- InputMessenger protocol hooks ----
+
+ParseResult H2Parse(IOBuf* source, IOBuf* msg, Socket* s) {
+  H2Session* sess = GetSession(s);
+  if (sess == nullptr) {
+    const size_t n = source->size() < kH2PrefaceLen ? source->size()
+                                                    : kH2PrefaceLen;
+    char probe[kH2PrefaceLen];
+    source->copy_to(probe, n);
+    if (memcmp(probe, kH2Preface, n) != 0) return ParseResult::TRY_OTHER;
+    if (n < kH2PrefaceLen) return ParseResult::NOT_ENOUGH_DATA;
+    source->pop_front(kH2PrefaceLen);
+    sess = new H2Session;
+    sess->sid = s->id();
+    s->reset_parsing_context(sess, DestroyH2Session);
+    // Our SETTINGS + connection window bump go out immediately.
+    IOBuf hello;
+    AppendSettings(&hello,
+                   {{SET_HEADER_TABLE_SIZE, 4096},
+                    {SET_MAX_CONCURRENT_STREAMS, kOurMaxStreams},
+                    {SET_INITIAL_WINDOW_SIZE, uint32_t(kOurStreamWindow)},
+                    {SET_MAX_FRAME_SIZE, 16384}});
+    const uint32_t delta = uint32_t(kOurConnWindow - 65535);
+    AppendH2FrameHeader(&hello, 4, H2FrameType::WINDOW_UPDATE, 0, 0);
+    uint8_t b[4] = {uint8_t(delta >> 24), uint8_t(delta >> 16),
+                    uint8_t(delta >> 8), uint8_t(delta)};
+    hello.append(b, 4);
+    s->Write(&hello);
+  }
+  if (source->size() < 9) return ParseResult::NOT_ENOUGH_DATA;
+  uint8_t h[9];
+  source->copy_to(h, 9);
+  const uint32_t len = (uint32_t(h[0]) << 16) | (uint32_t(h[1]) << 8) |
+                       uint32_t(h[2]);
+  if (len > 16384 + 1024) return ParseResult::ERROR;  // > our MAX_FRAME_SIZE
+  if (source->size() < 9 + size_t(len)) return ParseResult::NOT_ENOUGH_DATA;
+  source->cutn(msg, 9 + size_t(len));
+  return ParseResult::OK;
+}
+
+bool H2IsOrdered(const IOBuf&) { return true; }
+
+void H2Process(IOBuf&& msg, SocketId sid) {
+  SocketUniquePtr ptr;
+  if (Socket::Address(sid, &ptr) != 0) return;
+  H2Session* sess = GetSession(ptr.get());
+  if (sess == nullptr) return;
+  uint8_t h[9];
+  msg.copy_to(h, 9);
+  msg.pop_front(9);
+  const uint32_t stream_id =
+      ((uint32_t(h[5]) & 0x7f) << 24) | (uint32_t(h[6]) << 16) |
+      (uint32_t(h[7]) << 8) | uint32_t(h[8]);
+  ProcessFrame(ptr.get(), sess, h[3], h[4], stream_id, std::move(msg));
+}
+
+}  // namespace
+
+int RegisterHttp2Protocol() {
+  static int index = -1;
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.name = "h2";
+    p.parse = H2Parse;
+    p.process = H2Process;
+    p.is_ordered = H2IsOrdered;
+    index = RegisterProtocol(p);
+  });
+  return index;
+}
+
+}  // namespace brt

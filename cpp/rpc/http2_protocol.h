@@ -1,0 +1,54 @@
+// HTTP/2 server protocol + gRPC layering (see http2_protocol.cc).
+#pragma once
+
+#include <cstdint>
+
+#include "base/iobuf.h"
+
+namespace brt {
+
+// Idempotent; returns the protocol index. Registered by Server::Start —
+// the shared RPC port answers h2 prior-knowledge clients (incl. gRPC) next
+// to brt_std and HTTP/1.1 (reference: policy/http2_rpc_protocol.cpp served
+// through the same InputMessenger cut).
+int RegisterHttp2Protocol();
+
+// ---- frame-level helpers, exposed for tests and the in-test client ----
+
+enum class H2FrameType : uint8_t {
+  DATA = 0,
+  HEADERS = 1,
+  PRIORITY = 2,
+  RST_STREAM = 3,
+  SETTINGS = 4,
+  PUSH_PROMISE = 5,
+  PING = 6,
+  GOAWAY = 7,
+  WINDOW_UPDATE = 8,
+  CONTINUATION = 9,
+};
+
+constexpr uint8_t kH2FlagEndStream = 0x1;
+constexpr uint8_t kH2FlagAck = 0x1;
+constexpr uint8_t kH2FlagEndHeaders = 0x4;
+constexpr uint8_t kH2FlagPadded = 0x8;
+constexpr uint8_t kH2FlagPriority = 0x20;
+
+constexpr char kH2Preface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kH2PrefaceLen = 24;
+
+// Appends the 9-byte frame header.
+void AppendH2FrameHeader(IOBuf* out, uint32_t payload_len, H2FrameType type,
+                         uint8_t flags, uint32_t stream_id);
+
+// gRPC 5-byte message framing (length-prefixed).
+void AppendGrpcMessage(IOBuf* out, const IOBuf& message);
+// Strips one message; returns false if the framing is malformed or the
+// buffer holds anything other than exactly one whole message.
+bool CutGrpcMessage(IOBuf* in, IOBuf* message);
+
+// "1h"/"20S"/"100m"/... -> milliseconds (gRPC grpc-timeout header).
+// Returns -1 on parse failure.
+int64_t ParseGrpcTimeoutMs(const std::string& v);
+
+}  // namespace brt

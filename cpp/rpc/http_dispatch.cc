@@ -1,0 +1,69 @@
+#include "rpc/http_dispatch.h"
+
+#include "base/time.h"
+#include "rpc/errors.h"
+#include "rpc/server.h"
+
+namespace brt {
+
+bool AdmitHttpRequest(Server* server, const std::string& path,
+                      HttpAdmission* out) {
+  if (server == nullptr || !server->IsRunning()) {
+    out->http_status = 503;
+    out->grpc_status = 14;  // UNAVAILABLE
+    out->error = "server stopped";
+    return false;
+  }
+  const size_t slash = path.find('/', 1);
+  if (path.size() < 2 || slash == std::string::npos || slash == 1 ||
+      slash + 1 >= path.size()) {
+    out->http_status = 404;
+    out->grpc_status = 12;  // UNIMPLEMENTED
+    out->error = "no such page or service";
+    return false;
+  }
+  out->service = path.substr(1, slash - 1);
+  out->method = path.substr(slash + 1);
+  out->svc = server->FindService(out->service);
+  if (out->svc == nullptr) {
+    // Tolerate a gRPC package prefix: "pkg.Echo" -> "Echo".
+    const size_t dot = out->service.rfind('.');
+    if (dot != std::string::npos && dot + 1 < out->service.size()) {
+      const std::string bare = out->service.substr(dot + 1);
+      out->svc = server->FindService(bare);
+      if (out->svc != nullptr) out->service = bare;
+    }
+  }
+  if (out->svc == nullptr) {
+    out->http_status = 404;
+    out->grpc_status = 12;
+    out->error = "service " + out->service + " not found";
+    return false;
+  }
+  if (!server->OnRequestArrived()) {
+    out->http_status = 503;
+    out->grpc_status = 8;  // RESOURCE_EXHAUSTED
+    out->error = "too many requests";
+    return false;
+  }
+  out->ms = server->GetMethodStatus(out->service, out->method);
+  if (!out->ms->OnRequested()) {
+    server->OnRequestDone();
+    out->ms = nullptr;
+    out->http_status = 503;
+    out->grpc_status = 8;
+    out->error = "method concurrency limit reached";
+    return false;
+  }
+  return true;
+}
+
+void FinishHttpRequest(Server* server, MethodStatus* ms, int error_code,
+                       int64_t latency_us) {
+  ms->OnResponded(error_code, latency_us);
+  server->OnResponseSent(error_code, latency_us);
+  server->OnRequestDone();
+  server->requests_processed.fetch_add(1, std::memory_order_relaxed);
+}
+
+}  // namespace brt

@@ -1,0 +1,41 @@
+// Shared service-resolution + admission ladder for the HTTP/1.1 and
+// HTTP/2/gRPC front-ends, so routing and concurrency policy cannot drift
+// between protocols (reference keeps one copy inside
+// policy/http_rpc_protocol.cpp; h2 reuses it the same way).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace brt {
+
+class Server;
+class Service;
+struct MethodStatus;
+
+struct HttpAdmission {
+  // On success: svc/ms non-null and admission counters are held (caller
+  // must run FinishHttpRequest exactly once). On failure: http_status /
+  // grpc_status / error describe the rejection; nothing is held.
+  Service* svc = nullptr;
+  MethodStatus* ms = nullptr;
+  std::string service;
+  std::string method;
+  int http_status = 200;
+  int grpc_status = 0;
+  std::string error;
+};
+
+// Resolves "/Service/Method" (first-slash split; a gRPC-style
+// "/pkg.Service/Method" package prefix is tolerated) and performs
+// admission: Server::OnRequestArrived + MethodStatus::OnRequested.
+// Returns false with rejection info filled in.
+bool AdmitHttpRequest(Server* server, const std::string& path,
+                      HttpAdmission* out);
+
+// Completion accounting for an admitted request (per-method stats,
+// adaptive limiter feed, concurrency release).
+void FinishHttpRequest(Server* server, MethodStatus* ms, int error_code,
+                       int64_t latency_us);
+
+}  // namespace brt

@@ -17,6 +17,7 @@
 
 #include "rpc/builtin.h"
 #include "rpc/controller.h"
+#include "rpc/http_dispatch.h"
 #include "rpc/http_message.h"
 #include "rpc/http_protocol.h"
 #include "rpc/server.h"
@@ -51,12 +52,18 @@ void DeleteParsedRequest(void* data, void*) {
 }
 
 // Per-connection state: parser + response sequencing for pipelining.
+struct ParkedResponse {
+  IOBuf buf;
+  bool close = false;  // response announced "Connection: close"
+};
+
 struct HttpSocketCtx {
   HttpParser parser{/*is_request=*/true};
   uint64_t next_in = 0;   // seq of the next request to finish parsing
   uint64_t next_out = 0;  // seq allowed to write its response next
+  bool closing = false;   // a close-announced response is on the wire
   std::mutex mu;
-  std::map<uint64_t, IOBuf> parked;  // out-of-order completed responses
+  std::map<uint64_t, ParkedResponse> parked;  // out-of-order completions
 };
 
 void DestroyHttpSocketCtx(void* p) { delete static_cast<HttpSocketCtx*>(p); }
@@ -68,27 +75,33 @@ HttpSocketCtx* GetCtx(Socket* s) {
 // Writes the seq'th response, holding earlier-completed later-seq responses
 // until their turn (HTTP/1.1 pipelining: responses MUST be in request
 // order even though we process requests concurrently).
-void WriteSequenced(Socket* s, uint64_t seq, IOBuf&& out) {
+void WriteSequenced(Socket* s, uint64_t seq, IOBuf&& out, bool close) {
   HttpSocketCtx* ctx = GetCtx(s);
   if (ctx == nullptr) return;  // connection already torn down
   std::unique_lock<std::mutex> lk(ctx->mu);
   if (seq != ctx->next_out) {
-    ctx->parked.emplace(seq, std::move(out));
+    ctx->parked.emplace(seq, ParkedResponse{std::move(out), close});
     return;
   }
   IOBuf ready = std::move(out);
+  bool close_now = close;
   for (;;) {
     ++ctx->next_out;
     auto it = ctx->parked.find(ctx->next_out);
     if (it == ctx->parked.end()) break;
-    ready.append(std::move(it->second));
+    ready.append(std::move(it->second.buf));
+    close_now = close_now || it->second.close;
     ctx->parked.erase(it);
   }
+  if (close_now) ctx->closing = true;
   // The enqueue itself must happen under the lock: releasing first would
   // let a later seq that observes the bumped next_out reach the socket's
   // write chain ahead of this batch. Socket::Write is wait-free, so the
   // critical section stays short.
   s->Write(&ready);
+  // A close-announced response actually closes the connection once it has
+  // reached the kernel (HTTP/1.0 clients wait for EOF).
+  if (close_now) s->CloseAfterFlush();
 }
 
 ParseResult HttpParse(IOBuf* source, IOBuf* msg, Socket* s) {
@@ -101,6 +114,15 @@ ParseResult HttpParse(IOBuf* source, IOBuf* msg, Socket* s) {
     if (!LooksLikeHttp(probe, pn)) return ParseResult::TRY_OTHER;
     ctx = new HttpSocketCtx;
     s->reset_parsing_context(ctx, DestroyHttpSocketCtx);
+  }
+  {
+    // After a close-announced response, later pipelined requests are
+    // swallowed: the connection dies once the final response flushes.
+    std::lock_guard<std::mutex> g(ctx->mu);
+    if (ctx->closing) {
+      source->clear();
+      return ParseResult::NOT_ENOUGH_DATA;
+    }
   }
   switch (ctx->parser.Consume(source)) {
     case HttpParser::NEED_MORE:
@@ -118,7 +140,8 @@ ParseResult HttpParse(IOBuf* source, IOBuf* msg, Socket* s) {
   return ParseResult::OK;
 }
 
-void MakeResponseBytes(const HttpMessage& req, int status,
+// Returns true when the response announces Connection: close.
+bool MakeResponseBytes(const HttpMessage& req, int status,
                        const std::string& content_type, IOBuf&& body,
                        IOBuf* out) {
   HttpMessage resp;
@@ -131,10 +154,11 @@ void MakeResponseBytes(const HttpMessage& req, int status,
                                 : "Error";
   resp.set_header("Content-Type", content_type);
   resp.set_header("Content-Length", std::to_string(body.size()));
-  resp.set_header("Connection",
-                  req.keep_alive() ? "keep-alive" : "close");
+  const bool close = !req.keep_alive();
+  resp.set_header("Connection", close ? "close" : "keep-alive");
   SerializeHttpHead(resp, /*is_request=*/false, out);
   out->append(std::move(body));
+  return close;
 }
 
 // Server-side session for async user-service calls.
@@ -160,8 +184,9 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
 
   auto respond = [&](int status, const std::string& ctype, IOBuf&& body) {
     IOBuf out;
-    MakeResponseBytes(m, status, ctype, std::move(body), &out);
-    WriteSequenced(ptr.get(), seq, std::move(out));
+    const bool close = MakeResponseBytes(m, status, ctype, std::move(body),
+                                         &out);
+    WriteSequenced(ptr.get(), seq, std::move(out), close);
   };
 
   HttpResponse builtin;
@@ -172,43 +197,16 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
     return;
   }
 
-  if (server == nullptr || !server->IsRunning()) {
+  HttpAdmission adm;
+  if (!AdmitHttpRequest(server, m.path, &adm)) {
     IOBuf body;
-    body.append("server stopped\n");
-    respond(503, "text/plain", std::move(body));
+    body.append(adm.error + "\n");
+    respond(adm.http_status, "text/plain", std::move(body));
     return;
   }
-  const size_t slash = m.path.find('/', 1);
-  if (m.path.size() < 2 || slash == std::string::npos ||
-      slash + 1 >= m.path.size()) {
-    IOBuf body;
-    body.append("no such page or service\n");
-    respond(404, "text/plain", std::move(body));
-    return;
-  }
-  const std::string service = m.path.substr(1, slash - 1);
-  const std::string rpc_method = m.path.substr(slash + 1);
-  Service* svc = server->FindService(service);
-  if (svc == nullptr) {
-    IOBuf body;
-    body.append("service " + service + " not found\n");
-    respond(404, "text/plain", std::move(body));
-    return;
-  }
-  if (!server->OnRequestArrived()) {
-    IOBuf body;
-    body.append("too many requests\n");
-    respond(503, "text/plain", std::move(body));
-    return;
-  }
-  MethodStatus* ms = server->GetMethodStatus(service, rpc_method);
-  if (!ms->OnRequested()) {
-    server->OnRequestDone();
-    IOBuf body;
-    body.append("method concurrency limit reached\n");
-    respond(503, "text/plain", std::move(body));
-    return;
-  }
+  Service* svc = adm.svc;
+  MethodStatus* ms = adm.ms;
+  const std::string rpc_method = adm.method;
   auto* sess = new HttpSession;
   sess->sock = sid;
   sess->seq = seq;
@@ -219,27 +217,26 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
   svc->CallMethod(rpc_method, &sess->cntl, sess->request, &sess->response,
                   [sess, server, ms, start_us] {
     IOBuf out;
+    bool close;
     if (sess->cntl.Failed()) {
       IOBuf body;
       body.append(std::to_string(sess->cntl.ErrorCode()) + ": " +
                   sess->cntl.ErrorText() + "\n");
-      MakeResponseBytes(sess->req_head, 500, "text/plain", std::move(body),
-                        &out);
+      close = MakeResponseBytes(sess->req_head, 500, "text/plain",
+                                std::move(body), &out);
     } else {
       IOBuf body = std::move(sess->response);
       body.append(std::move(sess->cntl.response_attachment()));
-      MakeResponseBytes(sess->req_head, 200, "application/octet-stream",
-                        std::move(body), &out);
+      close = MakeResponseBytes(sess->req_head, 200,
+                                "application/octet-stream", std::move(body),
+                                &out);
     }
     SocketUniquePtr p2;
     if (Socket::Address(sess->sock, &p2) == 0) {
-      WriteSequenced(p2.get(), sess->seq, std::move(out));
+      WriteSequenced(p2.get(), sess->seq, std::move(out), close);
     }
-    ms->OnResponded(sess->cntl.ErrorCode(), monotonic_us() - start_us);
-    server->OnResponseSent(sess->cntl.ErrorCode(),
-                           monotonic_us() - start_us);
-    server->OnRequestDone();
-    server->requests_processed.fetch_add(1, std::memory_order_relaxed);
+    FinishHttpRequest(server, ms, sess->cntl.ErrorCode(),
+                      monotonic_us() - start_us);
     delete sess;
   });
 }

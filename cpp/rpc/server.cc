@@ -3,6 +3,7 @@
 #include "base/logging.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
+#include "rpc/http2_protocol.h"
 #include "rpc/http_protocol.h"
 #include "rpc/protocol_brt.h"
 #include "rpc/rpc_dump.h"
@@ -37,6 +38,7 @@ int Server::Start(const EndPoint& addr, const Options* opts) {
                                       options_.max_concurrency);
   fiber_init(options_.fiber_workers);
   RegisterBrtProtocol();
+  RegisterHttp2Protocol();  // before http/1.1: owns the "PRI " preface
   RegisterHttpProtocol();
   RegisterSpanFlags();
   RegisterRpcDumpFlags();

@@ -264,6 +264,26 @@ int main() {
     printf("http_pipelined_reversed_completion OK\n");
   }
 
+  // HTTP/1.0 (and Connection: close) responses really close the socket:
+  // the client must observe EOF after the full response.
+  {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    assert(fd >= 0);
+    sockaddr_in sa = addr.to_sockaddr();
+    assert(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0);
+    const char req[] = "GET /health HTTP/1.0\r\n\r\n";
+    assert(write(fd, req, sizeof(req) - 1) == ssize_t(sizeof(req) - 1));
+    std::string out;
+    char buf[4096];
+    ssize_t n;
+    while ((n = read(fd, buf, sizeof(buf))) > 0) out.append(buf, size_t(n));
+    assert(n == 0);  // EOF — server closed after the final response
+    assert(out.find("Connection: close") != std::string::npos);
+    assert(out.find("OK") != std::string::npos);
+    close(fd);
+    printf("http_10_close OK\n");
+  }
+
   server.Stop();
   server.Join();
   printf("ALL http tests OK\n");

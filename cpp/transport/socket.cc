@@ -357,8 +357,23 @@ int Socket::FlushWriteChain(WriteReq* cur, bool in_keepwrite_fiber) {
     }
     // cur fully written: advance or terminate.
     WriteReq* next = AdvanceWriteChain(cur);
-    if (next == nullptr) return 0;
+    if (next == nullptr) {
+      // Chain drained: honor a pending graceful close. The check sits
+      // after the detach-CAS, so a CloseAfterFlush racing with this drain
+      // is seen either here or by its own empty-chain check.
+      if (close_after_flush_.load(std::memory_order_acquire)) {
+        SetFailed(EPIPE, "closed after final response");
+      }
+      return 0;
+    }
     cur = next;
+  }
+}
+
+void Socket::CloseAfterFlush() {
+  close_after_flush_.store(true, std::memory_order_release);
+  if (write_head_.load(std::memory_order_acquire) == nullptr) {
+    SetFailed(EPIPE, "closed after final response");
   }
 }
 

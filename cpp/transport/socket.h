@@ -95,6 +95,11 @@ class Socket {
   // Marks failed; pending & future writes error out; on_failed runs once;
   // fd is closed when the last reference drops.
   void SetFailed(int err, const char* fmt = nullptr, ...);
+
+  // Graceful close: fails the socket once the write chain has fully
+  // drained (HTTP "Connection: close" — the final response must reach the
+  // kernel before the fd dies). If nothing is in flight, fails now.
+  void CloseAfterFlush();
   bool Failed() const {
     return failed_.load(std::memory_order_acquire) != 0;
   }
@@ -189,6 +194,7 @@ class Socket {
   std::string error_text_;
   void* parsing_context_ = nullptr;
   void (*parsing_context_destroyer_)(void*) = nullptr;
+  std::atomic<bool> close_after_flush_{false};
   std::atomic<WriteReq*> write_head_{nullptr};  // MPSC chain, Vyukov-style
   std::mutex waiters_mu_;
   std::vector<fid_t> waiters_;  // in-flight RPC ids awaiting responses
