@@ -16,6 +16,7 @@ rsp = float32 rows [count, dim]. ApplyGrad req = int32 count ++ int32 ids
 from __future__ import annotations
 
 import struct
+import threading
 from typing import List, Sequence
 
 import numpy as np
@@ -66,6 +67,140 @@ class PsShardServer:
 
     def close(self):
         self.server.close()
+
+
+class DevicePsShardServer:
+    """Embedding shard whose table is RESIDENT IN DEVICE HBM.
+
+    The CPU variant above holds its table in host numpy; this one keeps it
+    behind a native device-buffer handle (the RDMA-lkey analog,
+    cpp/device/pjrt_device.h) and serves Lookup/ApplyGrad as compiled
+    gather / scatter-sub launches (cpp/device/pjrt_executable.cc). Request
+    ids and gradients DMA host->HBM through the registered block pool;
+    looked-up rows DMA back into pooled blocks. No JAX anywhere in the
+    serving path — this is the reference's "transport swap is invisible
+    above Socket" contract with PJRT as the transport
+    (docs/en/rdma.md:34 analog).
+    """
+
+    def __init__(self, vocab: int, dim: int, shard_index: int,
+                 num_shards: int, lr: float = 0.1, seed: int = 0,
+                 device_client: "rpc.DeviceClient | None" = None,
+                 device_index: int = 0):
+        if vocab % num_shards:
+            raise ValueError("num_shards must divide vocab")
+        self.rows_per = vocab // num_shards
+        self.base = shard_index * self.rows_per
+        self.dim = dim
+        self.lr = lr
+        self.dev = device_client or rpc.DeviceClient()
+        self.device_index = device_index
+        rng = np.random.default_rng(seed + shard_index)
+        table = (rng.standard_normal((self.rows_per, dim)) * 0.02
+                 ).astype(np.float32)
+        # The table lives on-device from here on; the handle is the table.
+        self.table_h = self.dev.stage(table, device_index)
+        # Resident lr scalar: scatter_sub's 4th operand (stays in HBM).
+        self.lr_h = self.dev.stage(np.array(lr, np.float32), device_index)
+        self._gather = {}   # bucket size -> compiled gather executable
+        self._scatter = {}  # bucket size -> compiled scatter-sub executable
+        # Handlers run concurrently on fiber workers (ctypes releases the
+        # GIL across device calls): the read-execute-swap on table_h must
+        # be serialized or a concurrent ApplyGrad uses a released handle /
+        # drops an update.
+        self._mu = threading.Lock()
+        self.server = rpc.Server()
+        self.server.add_service("Ps", self._handle)
+        self.port = self.server.start("127.0.0.1:0")
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    @property
+    def table(self) -> np.ndarray:
+        """Host snapshot (DMAs the resident table down; test/debug use)."""
+        raw = self.dev.fetch(self.table_h)
+        return np.frombuffer(raw, np.float32).reshape(self.rows_per,
+                                                      self.dim).copy()
+
+    def _gather_exe(self, k: int):
+        exe = self._gather.get(k)
+        if exe is None:
+            mlir = self.dev.mlir("gather_rows", self.rows_per, self.dim, k)
+            exe = self._gather[k] = self.dev.compile(mlir)
+        return exe
+
+    def _scatter_exe(self, k: int):
+        exe = self._scatter.get(k)
+        if exe is None:
+            mlir = self.dev.mlir("scatter_sub", self.rows_per, self.dim, k)
+            exe = self._scatter[k] = self.dev.compile(mlir)
+        return exe
+
+    @staticmethod
+    def _bucket(count: int) -> int:
+        """Round the batch size up to a power of two so the executable
+        cache stays log-bounded instead of compiling per distinct count
+        (padding: extra ids hit row 0 with zero gradients — a no-op)."""
+        b = 1
+        while b < count:
+            b *= 2
+        return b
+
+    def _handle(self, method: str, payload: bytes) -> bytes:
+        (count,) = struct.unpack_from("<i", payload, 0)
+        ids = np.frombuffer(payload, np.int32, count, 4) - self.base
+        if ids.size and (ids.min() < 0 or ids.max() >= self.rows_per):
+            raise ValueError(
+                f"ids outside shard [{self.base}, "
+                f"{self.base + self.rows_per}) for shard base {self.base}"
+            )
+        bucket = self._bucket(count)
+        padded_ids = np.zeros(bucket, np.int32)
+        padded_ids[:count] = ids
+        with self._mu:
+            ids_h = self.dev.stage(padded_ids, self.device_index)
+            try:
+                if method == "Lookup":
+                    outs = self._gather_exe(bucket).execute(
+                        [self.table_h, ids_h])
+                    rows_h = outs[0][0]
+                    try:
+                        raw = self.dev.fetch(rows_h)
+                    finally:
+                        self.dev.release(rows_h)
+                    return raw[:count * self.dim * 4]
+                if method == "ApplyGrad":
+                    grads = np.zeros((bucket, self.dim), np.float32)
+                    grads[:count] = np.frombuffer(
+                        payload, np.float32, count * self.dim,
+                        4 + 4 * count).reshape(count, self.dim)
+                    g_h = self.dev.stage(grads, self.device_index)
+                    try:
+                        # scatter_sub scales by the resident lr scalar
+                        # on-chip: table[ids] -= lr * grads.
+                        outs = self._scatter_exe(bucket).execute(
+                            [self.table_h, ids_h, g_h, self.lr_h])
+                    finally:
+                        self.dev.release(g_h)
+                    # The update is functional on-device: the output buffer
+                    # IS the new resident table; the old one retires.
+                    new_table = outs[0][0]
+                    self.dev.release(self.table_h)
+                    self.table_h = new_table
+                    return b""
+                raise ValueError(f"unknown method {method}")
+            finally:
+                self.dev.release(ids_h)
+
+    def close(self):
+        self.server.close()
+        for exe in list(self._gather.values()) + list(
+                self._scatter.values()):
+            exe.close()
+        self.dev.release(self.table_h)
+        self.dev.release(self.lr_h)
 
 
 class RemoteEmbedding:

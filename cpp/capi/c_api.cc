@@ -191,6 +191,10 @@ void* brt_device_client_new(const char* plugin_path, char* errbuf,
     if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "%s", err.c_str());
     return nullptr;
   }
+  // C-API clients are driven from Python: completion waits must block the
+  // calling OS thread, never fiber-park — ctypes' GIL state is bound to
+  // the OS thread, and a fiber resuming on another worker would corrupt it.
+  client->set_thread_wait(true);
   return client.release();
 }
 

@@ -1,0 +1,130 @@
+#include "device/block_pool.h"
+
+#include <cstdlib>
+#include <mutex>
+#include <vector>
+
+#include "var/reducer.h"
+
+namespace brt {
+
+struct DeviceBlockPool::Impl {
+  std::mutex mu;
+  std::vector<void*> free_lists[4];
+};
+
+DeviceBlockPool::Impl* DeviceBlockPool::impl() {
+  // Leaked singleton: lent blocks may come back during late shutdown.
+  static Impl* i = new Impl;
+  return i;
+}
+
+DeviceBlockPool& DeviceBlockPool::singleton() {
+  static DeviceBlockPool* p = new DeviceBlockPool;
+  return *p;
+}
+
+static int ClassFor(size_t n) {
+  for (int c = 0; c < 4; ++c) {
+    if (n <= DeviceBlockPool::kClasses[c]) return c;
+  }
+  return -1;
+}
+
+void* DeviceBlockPool::Acquire(size_t n, size_t* cap) {
+  const int c = ClassFor(n);
+  if (c < 0) {
+    oversize_allocs.fetch_add(1, std::memory_order_relaxed);
+    void* p = nullptr;
+    if (posix_memalign(&p, 4096, n) != 0) return nullptr;
+    *cap = n;
+    outstanding.fetch_add(1, std::memory_order_relaxed);
+    return p;
+  }
+  const size_t sz = kClasses[c];
+  Impl* im = impl();
+  {
+    std::lock_guard<std::mutex> g(im->mu);
+    if (!im->free_lists[c].empty()) {
+      void* p = im->free_lists[c].back();
+      im->free_lists[c].pop_back();
+      pooled_bytes.fetch_sub(int64_t(sz), std::memory_order_relaxed);
+      hits.fetch_add(1, std::memory_order_relaxed);
+      outstanding.fetch_add(1, std::memory_order_relaxed);
+      *cap = sz;
+      return p;
+    }
+  }
+  misses.fetch_add(1, std::memory_order_relaxed);
+  void* p = nullptr;
+  if (posix_memalign(&p, 4096, sz) != 0) return nullptr;
+  outstanding.fetch_add(1, std::memory_order_relaxed);
+  *cap = sz;
+  return p;
+}
+
+void DeviceBlockPool::Release(void* p, size_t cap) {
+  if (p == nullptr) return;
+  outstanding.fetch_sub(1, std::memory_order_relaxed);
+  int c = -1;
+  for (int i = 0; i < 4; ++i) {
+    if (cap == kClasses[i]) {
+      c = i;
+      break;
+    }
+  }
+  if (c < 0) {
+    ::free(p);  // oversize block: not pooled
+    return;
+  }
+  Impl* im = impl();
+  std::lock_guard<std::mutex> g(im->mu);
+  // Bound each free list so a burst doesn't pin memory forever.
+  constexpr size_t kMaxPerClass[4] = {256, 128, 32, 8};
+  if (im->free_lists[c].size() >= kMaxPerClass[c]) {
+    ::free(p);
+    return;
+  }
+  im->free_lists[c].push_back(p);
+  pooled_bytes.fetch_add(int64_t(cap), std::memory_order_relaxed);
+}
+
+void DeviceBlockPool::IOBufDeleter(void* data, void* arg) {
+  DeviceBlockPool::singleton().Release(data,
+                                       size_t(reinterpret_cast<uintptr_t>(arg)));
+}
+
+void DeviceBlockPool::ExposeVars() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    auto& pool = DeviceBlockPool::singleton();
+    static var::PassiveStatus<int64_t> hits(
+        [](void* p) {
+          return int64_t(
+              static_cast<DeviceBlockPool*>(p)->hits.load());
+        },
+        &pool);
+    hits.expose("brt_device_block_pool_hits");
+    static var::PassiveStatus<int64_t> misses(
+        [](void* p) {
+          return int64_t(
+              static_cast<DeviceBlockPool*>(p)->misses.load());
+        },
+        &pool);
+    misses.expose("brt_device_block_pool_misses");
+    static var::PassiveStatus<int64_t> outstanding(
+        [](void* p) {
+          return static_cast<DeviceBlockPool*>(p)->outstanding.load();
+        },
+        &pool);
+    outstanding.expose("brt_device_block_pool_outstanding");
+    static var::PassiveStatus<int64_t> pooled(
+        [](void* p) {
+          return static_cast<DeviceBlockPool*>(p)->pooled_bytes.load();
+        },
+        &pool);
+    pooled.expose("brt_device_block_pool_bytes");
+  });
+}
+
+}  // namespace brt

@@ -1,0 +1,54 @@
+// Registered block pool for the device staging path — the analog of the
+// reference's RDMA block pool that replaces IOBuf's allocator with
+// NIC-registered memory (src/brpc/rdma/block_pool.cpp:39).
+//
+// TPU redesign: PJRT owns the DMA engine, so "registered" here means
+// pool-owned, page-aligned, reusable host regions handed to
+// BufferFromHostBuffer / ToHostBuffer — the staging hot path never
+// malloc()s. Blocks come back through the IOBuf user-data deleter when the
+// last reference drops, exactly like the reference returns recv blocks when
+// the IOBuf releases them.
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+
+namespace brt {
+
+class DeviceBlockPool {
+ public:
+  static DeviceBlockPool& singleton();
+
+  // Returns a page-aligned region of at least n bytes; *cap receives the
+  // region's actual capacity (pass it back to Release). Oversize requests
+  // (beyond the largest size class) fall through to the system allocator
+  // and are counted in oversize_allocs.
+  void* Acquire(size_t n, size_t* cap);
+  void Release(void* p, size_t cap);
+
+  // An IOBuf user-data deleter that returns the block to the pool; `arg`
+  // carries the capacity as a uintptr_t.
+  static void IOBufDeleter(void* data, void* arg);
+
+  // ---- stats (exposed as brt_device_block_pool_* vars) ----
+  std::atomic<uint64_t> hits{0};         // served from a free list
+  std::atomic<uint64_t> misses{0};       // grew the pool
+  std::atomic<uint64_t> oversize_allocs{0};
+  std::atomic<int64_t> outstanding{0};   // blocks currently lent out
+  std::atomic<int64_t> pooled_bytes{0};  // bytes parked on free lists
+
+  // Registers the stats with the var registry (idempotent).
+  static void ExposeVars();
+
+  // Size classes (bytes). Kept small-to-large; requests above the last
+  // class bypass the pool.
+  static constexpr size_t kClasses[4] = {4096, 65536, 1 << 20, 16 << 20};
+
+ private:
+  DeviceBlockPool() = default;
+  struct Impl;
+  Impl* impl();
+};
+
+}  // namespace brt

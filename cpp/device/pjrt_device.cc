@@ -1,9 +1,12 @@
 #include "device/pjrt_device.h"
 
+#include "device/block_pool.h"
+
 #include <dlfcn.h>
 #include <unistd.h>
 
 #include <atomic>
+#include <condition_variable>
 #include <cstring>
 #include <mutex>
 #include <unordered_map>
@@ -155,6 +158,62 @@ int PjrtEvent::FiberWait() {
   return rc;
 }
 
+namespace {
+
+// Shared by ThreadWait and the plugin callback; same two-ref protocol as
+// EventWaitCtx but on a plain mutex/condvar (no fiber runtime involved).
+struct ThreadWaitCtx {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  int rc = 0;
+  const PjrtApi* api = nullptr;
+  std::atomic<int> refs{2};
+
+  void Unref() {
+    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+  }
+};
+
+}  // namespace
+
+int PjrtEvent::ThreadWait() {
+  if (ev_ == nullptr) return EINVAL;
+  auto* ctx = new ThreadWaitCtx;
+  ctx->api = api_;
+  auto args = BRT_PJRT_ARGS(PJRT_Event_OnReady_Args);
+  args.event = ev_;
+  args.user_arg = ctx;
+  args.callback = [](PJRT_Error* err, void* user_arg) {
+    auto* c = static_cast<ThreadWaitCtx*>(user_arg);
+    {
+      std::lock_guard<std::mutex> g(c->mu);
+      if (err != nullptr) {
+        BRT_LOG(ERROR) << "PJRT event error: " << c->api->ConsumeError(err);
+        c->rc = EIO;
+      }
+      c->done = true;
+    }
+    c->cv.notify_all();
+    c->Unref();
+  };
+  if (PJRT_Error* err = api_->raw()->PJRT_Event_OnReady(&args)) {
+    BRT_LOG(ERROR) << "PJRT_Event_OnReady failed: "
+                   << api_->ConsumeError(err);
+    ctx->Unref();  // callback will never run
+    ctx->Unref();
+    return EIO;
+  }
+  int rc;
+  {
+    std::unique_lock<std::mutex> lk(ctx->mu);
+    ctx->cv.wait(lk, [&] { return ctx->done; });
+    rc = ctx->rc;
+  }
+  ctx->Unref();
+  return rc;
+}
+
 // ---------------------------------------------------------------------------
 // DeviceBufferRegistry: 64-bit handles for live HBM buffers (lkey analog).
 // ---------------------------------------------------------------------------
@@ -298,6 +357,7 @@ std::vector<PjrtClient::Option> AxonDefaultOptions() {
 
 std::unique_ptr<PjrtClient> PjrtClient::Create(const Options& opts,
                                                std::string* error) {
+  DeviceBlockPool::ExposeVars();
   std::string path = opts.plugin_path.empty() ? DefaultPjrtPluginPath()
                                               : opts.plugin_path;
   if (path.empty()) {
@@ -441,15 +501,20 @@ uint64_t PjrtClient::StageToDeviceShaped(const IOBuf& data, int device_index,
   if (src.block_count() == 1) {
     base = src.ref_data(0);
   } else {
-    char* flat = static_cast<char*>(::malloc(len ? len : 1));
+    // PJRT's host-buffer API takes one contiguous region (no scatter list
+    // like ibverbs sge), so multi-block payloads coalesce once — into a
+    // pooled registered block, not a malloc (block_pool.cpp:39 analog).
+    size_t cap = 0;
+    char* flat = static_cast<char*>(
+        DeviceBlockPool::singleton().Acquire(len ? len : 1, &cap));
     if (flat == nullptr) {
       if (error) *error = "out of memory coalescing H2D payload";
       return 0;
     }
     src.copy_to(flat, len);
     IOBuf owned;
-    owned.append_user_data(
-        flat, len, [](void* p, void*) { ::free(p); }, nullptr);
+    owned.append_user_data(flat, len, DeviceBlockPool::IOBufDeleter,
+                           reinterpret_cast<void*>(uintptr_t(cap)));
     src = std::move(owned);
     base = flat;
   }
@@ -509,9 +574,12 @@ int PjrtClient::StageFromDevice(uint64_t handle, IOBuf* out,
     return EIO;
   }
   const size_t n = szargs.on_device_size_in_bytes;
-  // D2H lands directly in the block that the caller's IOBuf will reference
-  // — no bounce buffer (reference recv-side zero copy, docs/en/rdma.md:38).
-  char* dst = static_cast<char*>(::malloc(n ? n : 1));
+  // D2H lands directly in a pooled registered block that the caller's
+  // IOBuf will reference — no bounce buffer, no malloc (reference
+  // recv-side zero copy, docs/en/rdma.md:38 + block_pool.cpp:39).
+  size_t cap = 0;
+  char* dst = static_cast<char*>(
+      DeviceBlockPool::singleton().Acquire(n ? n : 1, &cap));
   if (dst == nullptr) {
     if (error) *error = "out of memory for D2H landing buffer";
     unpin();
@@ -523,21 +591,21 @@ int PjrtClient::StageFromDevice(uint64_t handle, IOBuf* out,
   args.dst_size = n;
   if (PJRT_Error* err = api_->raw()->PJRT_Buffer_ToHostBuffer(&args)) {
     if (error) *error = "ToHostBuffer: " + api_->ConsumeError(err);
-    ::free(dst);
+    DeviceBlockPool::singleton().Release(dst, cap);
     unpin();
     return EIO;
   }
   PjrtEvent ev(api_, args.event);
-  int rc = ev.FiberWait();  // fiber parks; DMA completion wakes it
+  int rc = ev.Wait(thread_wait_);  // parks fiber (or blocks thread)
   unpin();
   if (rc != 0) {
     if (error) *error = "D2H event failed";
-    ::free(dst);
+    DeviceBlockPool::singleton().Release(dst, cap);
     return rc;
   }
-  out->append_user_data(
-      dst, n, [](void* p, void*) { ::free(p); }, nullptr,
-      /*meta=*/handle);
+  out->append_user_data(dst, n, DeviceBlockPool::IOBufDeleter,
+                        reinterpret_cast<void*>(uintptr_t(cap)),
+                        /*meta=*/handle);
   return 0;
 }
 

@@ -77,6 +77,17 @@ class PjrtEvent {
   // degrades to a futex wait).
   int FiberWait();
 
+  // Blocks the calling OS THREAD (mutex/condvar; never touches the fiber
+  // runtime). Required by callers holding per-thread state across the wait
+  // — a parked fiber may resume on a different worker, which breaks e.g.
+  // Python's ctypes GIL bookkeeping (PyGILState is per-OS-thread).
+  int ThreadWait();
+
+  // Dispatches on mode: thread_blocking ? ThreadWait() : FiberWait().
+  int Wait(bool thread_blocking) {
+    return thread_blocking ? ThreadWait() : FiberWait();
+  }
+
   bool valid() const { return ev_ != nullptr; }
 
  private:
@@ -191,11 +202,20 @@ class PjrtClient {
   int Roundtrip(const IOBuf& in, IOBuf* out, int device_index,
                 std::string* error);
 
+  // When true, DMA/execute completion waits block the calling OS thread
+  // (PjrtEvent::ThreadWait) instead of parking the fiber. The C API sets
+  // this for clients driven from Python: ctypes GIL state is
+  // per-OS-thread, so a fiber that resumes on another worker would crash
+  // the interpreter.
+  void set_thread_wait(bool v) { thread_wait_ = v; }
+  bool thread_wait() const { return thread_wait_; }
+
  private:
   PjrtClient() = default;
   const PjrtApi* api_ = nullptr;
   PJRT_Client* client_ = nullptr;
   std::vector<PJRT_Device*> addressable_;
+  bool thread_wait_ = false;
 };
 
 // Default plugin path resolution: $BRT_PJRT_PLUGIN, else the axon TPU

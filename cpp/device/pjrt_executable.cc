@@ -296,7 +296,7 @@ int PjrtExecutable::Execute(const std::vector<std::vector<uint64_t>>& args,
   int rc = 0;
   for (size_t d = 0; d < ndev; ++d) {
     PjrtEvent ev(api, done[d]);
-    int erc = ev.FiberWait();
+    int erc = ev.Wait(client_->thread_wait());
     if (erc != 0 && rc == 0) rc = erc;
   }
   unpin_all();

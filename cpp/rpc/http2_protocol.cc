@@ -348,9 +348,10 @@ void RespondH2(H2RequestCtx* ctx, int http_status,
                      std::move(data), ctx->grpc, trailers);
 }
 
+// Caller must have claimed st->dispatched under sess->mu (so no completion
+// fiber can erase the stream while we hold the bare pointer).
 void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
                        H2Stream* st) {
-  st->dispatched = true;
   const std::string* method = FindHeader(st->req_headers, ":method");
   const std::string* target = FindHeader(st->req_headers, ":path");
   auto* server = static_cast<Server*>(s->user());
@@ -474,6 +475,7 @@ bool DecodeHeaderBlock(H2Session* sess, const std::string& block,
 void HandleCompleteHeaders(Socket* s, H2Session* sess, uint32_t id,
                            uint8_t flags, const std::string& block) {
   H2Stream* st;
+  bool dispatch = false;
   {
     std::lock_guard<std::mutex> g(sess->mu);
     auto it = sess->streams.find(id);
@@ -515,10 +517,16 @@ void HandleCompleteHeaders(Socket* s, H2Session* sess, uint32_t id,
     }
     st->headers_done = true;
     if (flags & kH2FlagEndStream) st->remote_closed = true;
+    // The dispatch claim happens UNDER the lock: a trailers frame for an
+    // already-dispatched stream must not touch `st` after unlock — its
+    // completion fiber may erase the map node concurrently. A stream
+    // claimed here has no completion yet, so the pointer stays valid.
+    if (st->remote_closed && !st->dispatched) {
+      st->dispatched = true;
+      dispatch = true;
+    }
   }
-  if (st->remote_closed && !st->dispatched) {
-    DispatchH2Request(s, sess, id, st);
-  }
+  if (dispatch) DispatchH2Request(s, sess, id, st);
 }
 
 // Returns false on connection-fatal error.
@@ -653,7 +661,10 @@ bool ProcessFrame(Socket* s, H2Session* sess, uint8_t type, uint8_t flags,
             }
             if (flags & kH2FlagEndStream) {
               st->remote_closed = true;
-              dispatch = !st->dispatched;
+              if (!st->dispatched) {
+                st->dispatched = true;  // claim under the lock (see HEADERS)
+                dispatch = true;
+              }
             }
           }
         }

@@ -12,6 +12,7 @@
 #include <string>
 
 #include "base/iobuf.h"
+#include "device/block_pool.h"
 #include "device/pjrt_device.h"
 #include "device/pjrt_executable.h"
 #include "fiber/fiber.h"
@@ -77,6 +78,54 @@ void test_roundtrip(PjrtClient* client) {
   std::string s = back2.to_string();
   for (char c : s) assert(c == 'y');
   printf("  roundtrip ok\n");
+}
+
+void test_block_pool_unit() {
+  auto& pool = DeviceBlockPool::singleton();
+  size_t cap = 0;
+  void* p = pool.Acquire(1000, &cap);
+  assert(p != nullptr && cap == 4096);
+  pool.Release(p, cap);
+  // Same-class acquire reuses the parked block.
+  size_t cap2 = 0;
+  void* q = pool.Acquire(4096, &cap2);
+  assert(q == p && cap2 == 4096);
+  pool.Release(q, cap2);
+  // Oversize requests bypass the pool but are still accounted.
+  const uint64_t over0 = pool.oversize_allocs.load();
+  size_t cap3 = 0;
+  void* r = pool.Acquire((16u << 20) + 1, &cap3);
+  assert(r != nullptr && cap3 == (16u << 20) + 1);
+  pool.Release(r, cap3);
+  assert(pool.oversize_allocs.load() == over0 + 1);
+  printf("  block pool unit ok\n");
+}
+
+// The staging hot path must not allocate: after warmup, repeated stagings
+// are pure pool hits and every block comes back (the zero-malloc assertion
+// VERDICT asked for, backed by the pool-stats vars).
+void test_block_pool_staging(PjrtClient* client) {
+  auto& pool = DeviceBlockPool::singleton();
+  std::string err;
+  {
+    IOBuf in, out;
+    in.append(std::string(1000, 'w'));
+    assert(client->Roundtrip(in, &out, 0, &err) == 0);  // warm the class
+  }
+  const uint64_t misses0 = pool.misses.load();
+  const uint64_t over0 = pool.oversize_allocs.load();
+  const int64_t out0 = pool.outstanding.load();
+  for (int i = 0; i < 8; ++i) {
+    IOBuf in, out;
+    in.append(std::string(1000, 'z'));
+    assert(client->Roundtrip(in, &out, 0, &err) == 0);
+    // `out` drops here → its landing block returns to the pool.
+  }
+  assert(pool.misses.load() == misses0);          // zero fresh allocations
+  assert(pool.oversize_allocs.load() == over0);   // nothing bypassed
+  assert(pool.hits.load() >= 8);
+  assert(pool.outstanding.load() == out0);        // all blocks came back
+  printf("  block pool staging reuse ok (zero malloc on hot path)\n");
 }
 
 void test_handle_registry(PjrtClient* client) {
@@ -308,7 +357,9 @@ int main() {
          client->api()->api_minor_version());
   assert(client->addressable_device_count() >= 1);
 
+  test_block_pool_unit();
   test_roundtrip(client.get());
+  test_block_pool_staging(client.get());
   test_handle_registry(client.get());
   test_fiber_event_wait(client.get());
   test_device_echo_rpc(client.get());

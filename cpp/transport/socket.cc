@@ -358,10 +358,13 @@ int Socket::FlushWriteChain(WriteReq* cur, bool in_keepwrite_fiber) {
     // cur fully written: advance or terminate.
     WriteReq* next = AdvanceWriteChain(cur);
     if (next == nullptr) {
-      // Chain drained: honor a pending graceful close. The check sits
-      // after the detach-CAS, so a CloseAfterFlush racing with this drain
-      // is seen either here or by its own empty-chain check.
-      if (close_after_flush_.load(std::memory_order_acquire)) {
+      // Chain drained: honor a pending graceful close. This is a Dekker
+      // handshake with CloseAfterFlush (flag-store vs head-CAS on one
+      // side, head-load vs flag-load on the other): both sides' accesses
+      // are seq_cst so at least one of them observes the other — plain
+      // acquire/release would allow both to miss (store-load reordering)
+      // and the close to be lost.
+      if (close_after_flush_.load(std::memory_order_seq_cst)) {
         SetFailed(EPIPE, "closed after final response");
       }
       return 0;
@@ -371,8 +374,8 @@ int Socket::FlushWriteChain(WriteReq* cur, bool in_keepwrite_fiber) {
 }
 
 void Socket::CloseAfterFlush() {
-  close_after_flush_.store(true, std::memory_order_release);
-  if (write_head_.load(std::memory_order_acquire) == nullptr) {
+  close_after_flush_.store(true, std::memory_order_seq_cst);
+  if (write_head_.load(std::memory_order_seq_cst) == nullptr) {
     SetFailed(EPIPE, "closed after final response");
   }
 }
@@ -385,8 +388,10 @@ Socket::WriteReq* Socket::AdvanceWriteChain(WriteReq* cur) {
   WriteReq* next = cur->next.load(std::memory_order_acquire);
   if (next == nullptr) {
     WriteReq* expected = cur;
+    // seq_cst: one side of the CloseAfterFlush Dekker handshake (the
+    // flag check after a drained chain must not miss a racing closer).
     if (write_head_.compare_exchange_strong(expected, nullptr,
-                                            std::memory_order_acq_rel)) {
+                                            std::memory_order_seq_cst)) {
       PutWriteReq(cur);
       return nullptr;
     }
