@@ -49,24 +49,43 @@ def main() -> int:
             (1024 * 1024, min(4, max(2, ncpu)), 4),
             (1024 * 1024, min(8, max(2, ncpu)), 8),
         ]
+        def run(payload, conns, depth, uds, seconds=3):
+            env = dict(os.environ)
+            # Inflight calls bound usable parallelism: extra workers only
+            # add context switches (biggest effect on small hosts).
+            env.setdefault("BRT_WORKERS",
+                           str(min(ncpu, max(1, conns * depth))))
+            out = subprocess.run(
+                [bench, "--payload", str(payload), "--connections",
+                 str(conns), "--depth", str(depth), "--seconds",
+                 str(seconds), "--uds", str(uds)],
+                check=True, capture_output=True, text=True, timeout=300,
+                env=env,
+            ).stdout
+            return json.loads(out.strip().splitlines()[-1])
+
         best = None
         for payload, conns, depth in shapes:
             for uds in (0, 1):
-                env = dict(os.environ)
-                # Inflight calls bound usable parallelism: extra workers only
-                # add context switches (biggest effect on small hosts).
-                env.setdefault("BRT_WORKERS",
-                               str(min(ncpu, max(1, conns * depth))))
-                out = subprocess.run(
-                    [bench, "--payload", str(payload), "--connections",
-                     str(conns), "--depth", str(depth), "--seconds", "3",
-                     "--uds", str(uds)],
-                    check=True, capture_output=True, text=True, timeout=300,
-                    env=env,
-                ).stdout
-                stats = json.loads(out.strip().splitlines()[-1])
+                stats = run(payload, conns, depth, uds)
                 if best is None or stats["gbps"] > best["gbps"]:
                     best = stats
+
+        # Small-payload envelope (docs/cn/benchmark.md:7 — the 1M-5M QPS
+        # regime): trivial 16B echo. Serial shape gives the latency floor;
+        # a client sweep shows QPS scaling with concurrency (the
+        # reference's defining multi-client property, benchmark.md:142).
+        serial = run(16, 1, 1, 1)
+        small_best = serial
+        scaling = [{"connections": 1, "depth": 1, "qps": serial["qps"]}]
+        for conns in (2, 4, 8, 16):
+            depth = 16
+            stats = run(16, conns, depth, 1)
+            scaling.append({"connections": conns, "depth": depth,
+                            "qps": stats["qps"]})
+            if stats["qps"] > small_best["qps"]:
+                small_best = stats
+
         gbps = best["gbps"]
         print(json.dumps({
             "metric": "same_host_echo_throughput",
@@ -78,6 +97,12 @@ def main() -> int:
             "p99_us": best["p99_us"],
             "config": {k: best[k] for k in
                        ("payload", "connections", "depth", "uds")},
+            "small_qps": small_best["qps"],
+            "small_p50_us": serial["p50_us"],
+            "small_p99_us": serial["p99_us"],
+            "small_config": {k: small_best[k] for k in
+                             ("payload", "connections", "depth", "uds")},
+            "small_scaling": scaling,
         }))
         return 0
     except Exception as e:  # noqa: BLE001

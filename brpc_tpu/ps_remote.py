@@ -93,6 +93,7 @@ class DevicePsShardServer:
         self.base = shard_index * self.rows_per
         self.dim = dim
         self.lr = lr
+        self._owns_dev = device_client is None
         self.dev = device_client or rpc.DeviceClient()
         self.device_index = device_index
         rng = np.random.default_rng(seed + shard_index)
@@ -120,7 +121,8 @@ class DevicePsShardServer:
     @property
     def table(self) -> np.ndarray:
         """Host snapshot (DMAs the resident table down; test/debug use)."""
-        raw = self.dev.fetch(self.table_h)
+        with self._mu:  # table_h may be mid-swap in a concurrent ApplyGrad
+            raw = self.dev.fetch(self.table_h)
         return np.frombuffer(raw, np.float32).reshape(self.rows_per,
                                                       self.dim).copy()
 
@@ -201,6 +203,8 @@ class DevicePsShardServer:
             exe.close()
         self.dev.release(self.table_h)
         self.dev.release(self.lr_h)
+        if self._owns_dev:
+            self.dev.close()
 
 
 class RemoteEmbedding:
