@@ -1,0 +1,179 @@
+#include "base/profiler.h"
+
+#include <execinfo.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <vector>
+
+#include "var/collector.h"
+
+namespace brt {
+
+namespace {
+
+constexpr int kMaxFrames = 26;
+constexpr int kRingSize = 16384;  // samples per session (99hz * ~160s)
+
+struct RawSample {
+  void* frames[kMaxFrames];
+  // release-published by the handler after frames are written; Start()
+  // zeroes it so the reader never pairs stale frames with a new session.
+  std::atomic<int> nframes{0};
+};
+
+// Claimed lock-free from the signal handler.
+RawSample g_ring[kRingSize];
+std::atomic<int> g_ring_next{0};
+std::atomic<bool> g_running{false};
+std::atomic<int64_t> g_overflowed{0};
+int g_hz = 99;
+
+void ProfSignalHandler(int, siginfo_t*, void*) {
+  if (!g_running.load(std::memory_order_relaxed)) return;
+  // A tick during the fiber context switch would unwind a half-switched
+  // stack: drop it.
+  if (t_in_context_switch) return;
+  const int idx = g_ring_next.fetch_add(1, std::memory_order_relaxed);
+  if (idx >= kRingSize) {
+    g_overflowed.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  // backtrace() is not formally async-signal-safe, but after a warm-up
+  // call (which loads libgcc eagerly) it does no allocation; this is the
+  // standard practice for signal-driven profilers without a custom
+  // unwinder.
+  RawSample& s = g_ring[idx];
+  const int n = backtrace(s.frames, kMaxFrames);
+  s.nframes.store(n, std::memory_order_release);
+}
+
+std::mutex g_session_mu;
+
+}  // namespace
+
+thread_local volatile int t_in_context_switch = 0;
+
+void ProfilerSetupThisThreadAltStack() {
+  static thread_local bool done = false;
+  if (done) return;
+  done = true;
+  const size_t sz = SIGSTKSZ > 64 * 1024 ? size_t(SIGSTKSZ) : 64 * 1024;
+  stack_t ss;
+  ss.ss_sp = malloc(sz);  // lives for the thread's lifetime
+  ss.ss_size = sz;
+  ss.ss_flags = 0;
+  if (ss.ss_sp != nullptr) sigaltstack(&ss, nullptr);
+}
+
+CpuProfiler& CpuProfiler::singleton() {
+  static auto* p = new CpuProfiler;
+  return *p;
+}
+
+bool CpuProfiler::running() const {
+  return g_running.load(std::memory_order_acquire);
+}
+
+bool CpuProfiler::Start(int hz) {
+  std::lock_guard<std::mutex> g(g_session_mu);
+  if (g_running.load(std::memory_order_acquire)) return false;
+  if (hz <= 0 || hz > 1000) hz = 99;
+  g_hz = hz;
+  // Warm up the unwinder before signals fly (dlopen of libgcc happens on
+  // first use and takes locks).
+  void* warm[4];
+  backtrace(warm, 4);
+  ProfilerSetupThisThreadAltStack();
+
+  for (auto& s : g_ring) s.nframes.store(0, std::memory_order_relaxed);
+  g_ring_next.store(0, std::memory_order_relaxed);
+  g_overflowed.store(0, std::memory_order_relaxed);
+
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_sigaction = ProfSignalHandler;
+  // SA_ONSTACK: the handler + backtrace must not land on a small fiber
+  // stack (workers install a sigaltstack at start).
+  sa.sa_flags = SA_RESTART | SA_SIGINFO | SA_ONSTACK;
+  sigemptyset(&sa.sa_mask);
+  if (sigaction(SIGPROF, &sa, nullptr) != 0) return false;
+
+  g_running.store(true, std::memory_order_release);
+  itimerval timer;
+  timer.it_interval.tv_sec = 0;
+  timer.it_interval.tv_usec = 1000000 / hz;
+  timer.it_value = timer.it_interval;
+  if (setitimer(ITIMER_PROF, &timer, nullptr) != 0) {
+    g_running.store(false, std::memory_order_release);
+    return false;
+  }
+  return true;
+}
+
+std::string CpuProfiler::StopAndReport() {
+  std::lock_guard<std::mutex> g(g_session_mu);
+  if (!g_running.load(std::memory_order_acquire)) return "not running\n";
+  itimerval off;
+  memset(&off, 0, sizeof(off));
+  setitimer(ITIMER_PROF, &off, nullptr);
+  g_running.store(false, std::memory_order_release);
+  // Drain in-flight handlers: a handler is microseconds long, and the
+  // session mutex keeps the next Start() (which re-zeroes nframes) at
+  // least this far away. nframes is release/acquire-published, so a slot
+  // either shows 0 (skipped) or a fully written stack.
+  usleep(50 * 1000);
+
+  const int n = std::min(g_ring_next.load(std::memory_order_relaxed),
+                         kRingSize);
+  // Aggregate identical stacks and leaf frames.
+  std::map<std::vector<void*>, int> stacks;
+  std::map<void*, int> leaves;
+  for (int i = 0; i < n; ++i) {
+    const RawSample& s = g_ring[i];
+    const int nf = s.nframes.load(std::memory_order_acquire);
+    if (nf <= 2 || nf > kMaxFrames) continue;
+    // Frames 0-1 are the signal handler + trampoline: drop them.
+    std::vector<void*> key(s.frames + 2, s.frames + nf);
+    stacks[key]++;
+    leaves[key.empty() ? nullptr : key[0]]++;
+  }
+  std::ostringstream os;
+  os << "cpu profile: " << n << " samples @ " << g_hz << "hz ("
+     << double(n) / g_hz << "s of cpu time)";
+  const int64_t lost = g_overflowed.load(std::memory_order_relaxed);
+  if (lost > 0) os << ", " << lost << " lost to ring overflow";
+  os << "\n\n[hot leaf frames]\n";
+  std::vector<std::pair<void*, int>> top_leaves(leaves.begin(),
+                                                leaves.end());
+  std::sort(top_leaves.begin(), top_leaves.end(),
+            [](auto& a, auto& b) { return a.second > b.second; });
+  int shown = 0;
+  for (const auto& [addr, cnt] : top_leaves) {
+    if (++shown > 25 || addr == nullptr) continue;
+    os << "  " << cnt << "  (" << 100.0 * cnt / std::max(1, n) << "%)  "
+       << var::SymbolizeFrame(addr) << "\n";
+  }
+  os << "\n[hot stacks]\n";
+  std::vector<std::pair<std::vector<void*>, int>> top_stacks(
+      stacks.begin(), stacks.end());
+  std::sort(top_stacks.begin(), top_stacks.end(),
+            [](auto& a, auto& b) { return a.second > b.second; });
+  shown = 0;
+  for (const auto& [key, cnt] : top_stacks) {
+    if (++shown > 10) break;
+    os << cnt << " samples:\n";
+    for (void* f : key) os << "    " << var::SymbolizeFrame(f) << "\n";
+    os << "\n";
+  }
+  return os.str();
+}
+
+}  // namespace brt

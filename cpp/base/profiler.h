@@ -1,0 +1,41 @@
+// Self-sampling CPU profiler behind the /hotspots builtin.
+// Parity target: reference src/brpc/builtin/hotspots_service.cpp (1244 LoC
+// — CPU/heap/growth profilers driven by tcmalloc's profiler). Redesigned:
+// no tcmalloc dependency — SIGPROF/ITIMER_PROF samples whichever thread is
+// burning CPU, the signal handler claims a preallocated ring slot and
+// captures a raw backtrace, and Stop() aggregates + symbolizes (dladdr +
+// demangle) into a text report with leaf-frame totals and top stacks.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace brt {
+
+class CpuProfiler {
+ public:
+  static CpuProfiler& singleton();
+
+  // Begins sampling at `hz`. False if already running (one session at a
+  // time — the signal handler writes into shared rings).
+  bool Start(int hz = 99);
+
+  // Stops sampling and returns the aggregated symbolized report.
+  std::string StopAndReport();
+
+  bool running() const;
+
+ private:
+  CpuProfiler() = default;
+};
+
+// Installs a per-thread alternate signal stack so SIGPROF never lands on a
+// (small, guard-paged) fiber stack. Called by every fiber worker at start;
+// idempotent per thread.
+void ProfilerSetupThisThreadAltStack();
+
+// Worker-local guard: while a context switch is in flight the sampler
+// drops the tick instead of unwinding a half-switched stack.
+extern thread_local volatile int t_in_context_switch;
+
+}  // namespace brt

@@ -1,0 +1,59 @@
+// Contention profiler hooks for FiberMutex (sync.h).
+// Parity target: reference src/bthread/mutex.cpp:267-333 — sampled lock
+// waits with stacks, flowing through the shared bvar Collector. Redesigned:
+// a token from the StackCollector's per-second budget is taken BEFORE the
+// backtrace, so the uncontended path pays nothing and the contended path
+// pays the unwind cost at most kBudgetPerSec times a second.
+#include <execinfo.h>
+#include <time.h>
+
+#include <atomic>
+
+#include "base/flags.h"
+#include "fiber/sync.h"
+#include "var/collector.h"
+
+namespace brt {
+
+namespace {
+bool g_contention_enabled = true;
+int64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000000000 + ts.tv_nsec;
+}
+}  // namespace
+
+void RegisterContentionFlags() {
+  static std::atomic<bool> once{false};
+  bool expected = false;
+  if (once.compare_exchange_strong(expected, true)) {
+    RegisterFlag("enable_contention_profiler", &g_contention_enabled,
+                 "sample fiber-mutex lock waits into /contention");
+  }
+}
+
+int64_t ContentionSampleStart() {
+  if (!g_contention_enabled) return 0;
+  return now_ns();
+}
+
+void ContentionSampleEnd(int64_t start_ns) {
+  if (start_ns == 0) return;
+  const int64_t waited = now_ns() - start_ns;
+  // Skip sub-microsecond blips: they are scheduling noise, and the budget
+  // is better spent on real convoys.
+  if (waited < 1000) return;
+  // Token FIRST: when the budget is gone this costs two loads, not a full
+  // stack unwind — a hot convoy must not pay backtrace() per acquisition.
+  auto& collector = var::StackCollector::contention();
+  if (!collector.TryAcquireToken()) return;
+  void* frames[var::StackCollector::kMaxFrames];
+  const int n = backtrace(frames, var::StackCollector::kMaxFrames);
+  if (n > 2) {
+    // Drop this function + lock() itself.
+    collector.SubmitTokened(frames + 2, n - 2, waited);
+  }
+}
+
+}  // namespace brt

@@ -1,5 +1,7 @@
 #include "fiber/fiber.h"
 
+#include "base/profiler.h"
+
 #include <linux/futex.h>
 #include <sys/syscall.h>
 #include <unistd.h>
@@ -143,7 +145,11 @@ void TaskControl::start(int concurrency) {
     groups_.push_back(g);
   }
   for (int i = 0; i < concurrency; ++i) {
-    std::thread([g = groups_[i]] { g->run_main_loop(); }).detach();
+    std::thread([g = groups_[i]] {
+      // SIGPROF (cpu profiler) must not land on small fiber stacks.
+      ProfilerSetupThisThreadAltStack();
+      g->run_main_loop();
+    }).detach();
   }
 }
 
@@ -257,11 +263,19 @@ static void cleanup_terminated(void* arg) {
   TaskMetaPool::get().release(m);
 }
 
+// Runtime-wide counters for the /fibers builtin page.
+std::atomic<uint64_t> g_fibers_created{0};
+std::atomic<uint64_t> g_fibers_finished{0};
+
 void TaskGroup::task_runner(void* /*jump_arg*/) {
+  // Fresh fibers arrive here straight out of the stack switch: the
+  // switch-guard set by sched_to must be cleared on this entry path too.
+  t_in_context_switch = 0;
   TaskGroup* g = tls_task_group;
   g->run_remained();
   TaskMeta* m = g->cur_meta_;
   m->fn(m->arg);
+  g_fibers_finished.fetch_add(1, std::memory_order_relaxed);
   // Fiber terminated. We might have migrated workers while running.
   g = tls_task_group;
   g->set_remained(cleanup_terminated, m);
@@ -285,7 +299,11 @@ void TaskGroup::sched_to(TaskMeta* next) {
                                 &TaskGroup::task_runner);
   }
   cur_meta_ = next;
+  // The profiler's sampler drops ticks landing inside the raw stack
+  // switch (it would unwind a half-switched frame).
+  t_in_context_switch = 1;
   brt_jump_context(&cur->ctx_sp, next->ctx_sp, this);
+  t_in_context_switch = 0;
   // 'cur' resumed — possibly on a different worker.
   tls_task_group->run_remained();
 }
@@ -350,8 +368,19 @@ int fiber_start(fiber_t* tid_out, void* (*fn)(void*), void* arg,
   TaskMeta* m;
   fiber_t tid = create_meta(fn, arg, attr, &m);
   if (tid_out) *tid_out = tid;
+  g_fibers_created.fetch_add(1, std::memory_order_relaxed);
   requeue_fiber(tid);
   return 0;
+}
+
+FiberRuntimeStats fiber_runtime_stats() {
+  FiberRuntimeStats s;
+  s.workers = fiber_concurrency();
+  // finished first: a racing create+finish between the loads then shows
+  // alive slightly HIGH instead of underflowing the subtraction.
+  s.finished = g_fibers_finished.load(std::memory_order_relaxed);
+  s.created = g_fibers_created.load(std::memory_order_relaxed);
+  return s;
 }
 
 int fiber_start_urgent(fiber_t* tid_out, void* (*fn)(void*), void* arg,
@@ -364,6 +393,7 @@ int fiber_start_urgent(fiber_t* tid_out, void* (*fn)(void*), void* arg,
   TaskMeta* m;
   fiber_t tid = create_meta(fn, arg, attr, &m);
   if (tid_out) *tid_out = tid;
+  g_fibers_created.fetch_add(1, std::memory_order_relaxed);
   // Run the new fiber NOW; requeue the caller (after the switch).
   TaskMeta* cur = g->cur_meta();
   static thread_local fiber_t requeue_tid;

@@ -21,6 +21,14 @@ struct FiberAttr {
 void fiber_init(int concurrency = 0);
 int fiber_concurrency();
 
+// Runtime-wide counters for the /fibers builtin page.
+struct FiberRuntimeStats {
+  int workers = 0;
+  uint64_t created = 0;
+  uint64_t finished = 0;
+};
+FiberRuntimeStats fiber_runtime_stats();
+
 // Schedules fn(arg) on a worker ("background": current fiber keeps running;
 // reference bthread_start_background).
 int fiber_start(fiber_t* tid, void* (*fn)(void*), void* arg,

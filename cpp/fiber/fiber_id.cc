@@ -77,6 +77,12 @@ class IdPool {
     return slot(index);
   }
 
+  void stats(uint32_t* total, uint32_t* free_count) {
+    *total = next_index_.load(std::memory_order_acquire);
+    std::lock_guard<std::mutex> g(mu_);
+    *free_count = uint32_t(free_.size());
+  }
+
  private:
   static constexpr uint32_t kBlockSlots = 256;
   static constexpr uint32_t kMaxBlocks = 16384;
@@ -211,6 +217,12 @@ int fid_join(fid_t id) {
     butex_wait(s->join_butex, expected);
   }
   return 0;
+}
+
+FidPoolStats fid_pool_stats() {
+  FidPoolStats s;
+  IdPool::get().stats(&s.total_slots, &s.free_slots);
+  return s;
 }
 
 }  // namespace brt

@@ -35,4 +35,11 @@ int fid_error(fid_t id, int error_code);
 // Waits until the id is destroyed. Safe on stale ids.
 int fid_join(fid_t id);
 
+// Slab occupancy for the /ids builtin page.
+struct FidPoolStats {
+  uint32_t total_slots = 0;  // slots ever allocated
+  uint32_t free_slots = 0;   // currently on the free list
+};
+FidPoolStats fid_pool_stats();
+
 }  // namespace brt

@@ -7,6 +7,12 @@
 
 namespace brt {
 
+// Contention-profiler hooks (contention.cc): Start returns 0 when sampling
+// is off; End submits the waited time + stack to the shared collector.
+int64_t ContentionSampleStart();
+void ContentionSampleEnd(int64_t start_ns);
+void RegisterContentionFlags();
+
 class FiberMutex {
  public:
   FiberMutex() : b_(butex_create()) {}
@@ -19,7 +25,9 @@ class FiberMutex {
     int expected = 0;
     if (v.compare_exchange_strong(expected, 1, std::memory_order_acquire))
       return;
-    // contended: set to 2 (has waiters) and park
+    // Contended: set to 2 (has waiters) and park. The wait is sampled
+    // into /contention (reference mutex.cpp:267 contention profiler).
+    const int64_t t0 = ContentionSampleStart();
     do {
       if (expected == 2 ||
           v.compare_exchange_weak(expected, 2, std::memory_order_acquire)) {
@@ -28,6 +36,7 @@ class FiberMutex {
       expected = 0;
     } while (
         !v.compare_exchange_weak(expected, 2, std::memory_order_acquire));
+    ContentionSampleEnd(t0);
   }
 
   bool try_lock() {

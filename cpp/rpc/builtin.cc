@@ -1,5 +1,10 @@
 #include "rpc/builtin.h"
 
+#include "base/profiler.h"
+#include "fiber/fiber.h"
+#include "fiber/fiber_id.h"
+#include "var/collector.h"
+
 #include <sstream>
 
 #include "base/flags.h"
@@ -135,6 +140,70 @@ bool HandleBuiltinPage(Server* server, const std::string& method,
   }
   if (path == "/flags" || path.rfind("/flags/", 0) == 0) {
     FlagsPage(path.size() > 7 ? path.substr(7) : "", query, out);
+    return true;
+  }
+  if (path == "/hotspots") {
+    // Self-sampling CPU profile: ?seconds=N (default 2, cap 30). The
+    // serving fiber sleeps while SIGPROF samples whoever burns CPU
+    // (reference hotspots_service.cpp, sans tcmalloc).
+    int seconds = 2;
+    const size_t pos = query.find("seconds=");
+    if (pos != std::string::npos) seconds = atoi(query.c_str() + pos + 8);
+    if (seconds < 1) seconds = 1;
+    if (seconds > 30) seconds = 30;
+    if (!CpuProfiler::singleton().Start()) {
+      out->status = 503;
+      out->body = "another profiling session is running\n";
+      return true;
+    }
+    fiber_usleep(int64_t(seconds) * 1000000);
+    out->body = CpuProfiler::singleton().StopAndReport();
+    return true;
+  }
+  if (path == "/contention") {
+    if (query.find("reset=1") != std::string::npos) {
+      var::StackCollector::contention().Reset();
+      out->body = "contention samples reset\n";
+      return true;
+    }
+    os << "[lock contention] (sampled fiber-mutex waits; ?reset=1 to "
+          "clear)\n\n"
+       << var::StackCollector::contention().Render("us-waited", 1000);
+    out->body = os.str();
+    return true;
+  }
+  if (path == "/fibers") {
+    const FiberRuntimeStats fs = fiber_runtime_stats();
+    // `finished` is snapshotted before `created` inside
+    // fiber_runtime_stats, so alive can transiently read high but never
+    // underflows; clamp anyway for safety.
+    const uint64_t alive =
+        fs.created >= fs.finished ? fs.created - fs.finished : 0;
+    os << "workers: " << fs.workers << "\n"
+       << "fibers_created: " << fs.created << "\n"
+       << "fibers_finished: " << fs.finished << "\n"
+       << "fibers_alive: " << alive << "\n";
+    out->body = os.str();
+    return true;
+  }
+  if (path == "/ids") {
+    const FidPoolStats is = fid_pool_stats();
+    os << "id_slots_total: " << is.total_slots << "\n"
+       << "id_slots_free: " << is.free_slots << "\n"
+       << "ids_live: " << (is.total_slots - is.free_slots) << "\n";
+    out->body = os.str();
+    return true;
+  }
+  if (path == "/sockets") {
+    // Same data as /connections (the reference serves both names).
+    ConnectionsPage(os);
+    out->body = os.str();
+    return true;
+  }
+  if (path == "/index") {
+    out->body =
+        "/status /vars /brpc_metrics /connections /sockets /rpcz /flags\n"
+        "/hotspots /contention /fibers /ids /health /version\n";
     return true;
   }
   return false;

@@ -3,6 +3,7 @@
 #include "base/logging.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
+#include "fiber/sync.h"
 #include "rpc/http2_protocol.h"
 #include "rpc/http_protocol.h"
 #include "rpc/protocol_brt.h"
@@ -41,6 +42,7 @@ int Server::Start(const EndPoint& addr, const Options* opts) {
   RegisterHttp2Protocol();  // before http/1.1: owns the "PRI " preface
   RegisterHttpProtocol();
   RegisterSpanFlags();
+  RegisterContentionFlags();
   RegisterRpcDumpFlags();
   var::ExposeDefaultVariables();
   if (const char* dump = getenv("BRT_RPC_DUMP_FILE")) {

@@ -1,0 +1,167 @@
+// Profiling tier tests: a busy workload yields a non-empty /hotspots CPU
+// profile and a /contention report over HTTP (reference model:
+// hotspots_service + the mutex contention profiler, bthread/mutex.cpp:267).
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/channel.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+namespace {
+
+class SpinEchoService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    // Burn a little CPU so the profiler has something to see.
+    volatile uint64_t acc = 1;
+    for (int i = 0; i < 20000; ++i) acc = acc * 1664525u + 1013904223u;
+    (void)acc;
+    response->append(request);
+    done();
+  }
+};
+
+std::string HttpGet(const EndPoint& addr, const std::string& request) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  assert(fd >= 0);
+  sockaddr_in sa = addr.to_sockaddr();
+  assert(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0);
+  assert(write(fd, request.data(), request.size()) ==
+         ssize_t(request.size()));
+  std::string out;
+  char buf[8192];
+  ssize_t n;
+  size_t want = SIZE_MAX;
+  while (out.size() < want && (n = read(fd, buf, sizeof(buf))) > 0) {
+    out.append(buf, size_t(n));
+    if (want == SIZE_MAX) {
+      size_t he = out.find("\r\n\r\n");
+      if (he != std::string::npos) {
+        size_t cl = out.find("Content-Length: ");
+        if (cl != std::string::npos && cl < he) {
+          want = he + 4 + size_t(atoll(out.c_str() + cl + 16));
+        }
+      }
+    }
+  }
+  close(fd);
+  return out;
+}
+
+struct LoadArg {
+  EndPoint addr;
+  std::atomic<bool>* stop;
+  CountdownEvent* done;
+};
+
+void* LoadLoop(void* argp) {
+  auto* arg = static_cast<LoadArg*>(argp);
+  Channel ch;
+  if (ch.Init(arg->addr) == 0) {
+    IOBuf req;
+    req.append("busy");
+    while (!arg->stop->load(std::memory_order_relaxed)) {
+      Controller cntl;
+      IOBuf rsp;
+      ch.CallMethod("Spin", "Echo", &cntl, req, &rsp, nullptr);
+    }
+  }
+  arg->done->signal();
+  return nullptr;
+}
+
+struct ContendArg {
+  FiberMutex* mu;
+  CountdownEvent* done;
+};
+
+void* ContendLoop(void* argp) {
+  auto* arg = static_cast<ContendArg*>(argp);
+  for (int i = 0; i < 50; ++i) {
+    arg->mu->lock();
+    fiber_usleep(2000);  // hold the lock: everyone else piles up
+    arg->mu->unlock();
+    fiber_yield();
+  }
+  arg->done->signal();
+  return nullptr;
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  Server server;
+  SpinEchoService spin;
+  assert(server.AddService(&spin, "Spin") == 0);
+  assert(server.Start("127.0.0.1:0") == 0);
+  const EndPoint addr = server.listen_address();
+
+  // ---- /hotspots under load ----
+  std::atomic<bool> stop{false};
+  CountdownEvent load_done(2);
+  LoadArg la{addr, &stop, &load_done};
+  for (int i = 0; i < 2; ++i) {
+    fiber_t t;
+    assert(fiber_start(&t, LoadLoop, &la) == 0);
+  }
+  std::string prof =
+      HttpGet(addr, "GET /hotspots?seconds=1 HTTP/1.1\r\n\r\n");
+  stop.store(true);
+  load_done.wait(-1);
+  assert(prof.rfind("HTTP/1.1 200", 0) == 0);
+  assert(prof.find("cpu profile:") != std::string::npos);
+  assert(prof.find("[hot leaf frames]") != std::string::npos);
+  // A busy run must actually collect samples.
+  const size_t cp = prof.find("cpu profile: ");
+  const int samples = atoi(prof.c_str() + cp + 13);
+  assert(samples > 10);
+  printf("hotspots OK (%d samples)\n", samples);
+
+  // ---- /contention with a convoy on one mutex ----
+  FiberMutex hot_mu;
+  CountdownEvent contend_done(4);
+  ContendArg ca{&hot_mu, &contend_done};
+  for (int i = 0; i < 4; ++i) {
+    fiber_t t;
+    assert(fiber_start(&t, ContendLoop, &ca) == 0);
+  }
+  contend_done.wait(-1);
+  std::string cont = HttpGet(addr, "GET /contention HTTP/1.1\r\n\r\n");
+  assert(cont.rfind("HTTP/1.1 200", 0) == 0);
+  assert(cont.find("samples:") != std::string::npos);
+  assert(cont.find("us-waited") != std::string::npos);
+  // The convoy must show up with real waited time and a stack.
+  assert(cont.find("distinct_stacks: 0") == std::string::npos);
+  printf("contention OK\n");
+
+  // ---- misc new pages ----
+  std::string fibers = HttpGet(addr, "GET /fibers HTTP/1.1\r\n\r\n");
+  assert(fibers.find("fibers_created:") != std::string::npos);
+  std::string idsp = HttpGet(addr, "GET /ids HTTP/1.1\r\n\r\n");
+  assert(idsp.find("id_slots_total:") != std::string::npos);
+  std::string socks = HttpGet(addr, "GET /sockets HTTP/1.1\r\n\r\n");
+  assert(socks.find("socket_count:") != std::string::npos);
+  assert(socks.find("fd") != std::string::npos);
+  std::string idx = HttpGet(addr, "GET /index HTTP/1.1\r\n\r\n");
+  assert(idx.find("/hotspots") != std::string::npos);
+  printf("builtin pages OK\n");
+
+  server.Stop();
+  server.Join();
+  printf("ALL profiler tests OK\n");
+  return 0;
+}

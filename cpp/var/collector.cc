@@ -1,0 +1,168 @@
+#include "var/collector.h"
+
+#include <cxxabi.h>
+#include <dlfcn.h>
+#include <time.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+#include <vector>
+
+namespace brt {
+namespace var {
+
+StackCollector& StackCollector::contention() {
+  static auto* c = new StackCollector;
+  return *c;
+}
+
+static uint64_t HashStack(void* const* frames, int n) {
+  uint64_t h = 1469598103934665603ull;
+  for (int i = 0; i < n; ++i) {
+    h = (h ^ reinterpret_cast<uint64_t>(frames[i])) * 1099511628211ull;
+  }
+  return h ? h : 1;  // 0 means empty slot
+}
+
+bool StackCollector::TakeToken() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC_COARSE, &ts);
+  const uint32_t sec = uint32_t(ts.tv_sec);
+  uint64_t cur = bucket_.load(std::memory_order_relaxed);
+  for (;;) {
+    uint32_t cur_sec = uint32_t(cur >> 32);
+    uint32_t used = uint32_t(cur);
+    uint64_t next;
+    if (cur_sec != sec) {
+      next = (uint64_t(sec) << 32) | 1;
+    } else if (used >= kBudgetPerSec) {
+      return false;
+    } else {
+      next = (uint64_t(sec) << 32) | (used + 1);
+    }
+    if (bucket_.compare_exchange_weak(cur, next,
+                                      std::memory_order_relaxed)) {
+      return true;
+    }
+  }
+}
+
+void StackCollector::Submit(void* const* frames, int nframes,
+                            int64_t weight) {
+  if (!TakeToken()) {
+    dropped_.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  SubmitTokened(frames, nframes, weight);
+}
+
+void StackCollector::SubmitTokened(void* const* frames, int nframes,
+                                   int64_t weight) {
+  if (nframes <= 0) return;
+  if (nframes > kMaxFrames) nframes = kMaxFrames;
+  const uint64_t h = HashStack(frames, nframes);
+  const int start = int(h % kSlots);
+  for (int probe = 0; probe < 8; ++probe) {
+    Slot& s = slots_[(start + probe) % kSlots];
+    uint64_t cur = s.hash.load(std::memory_order_acquire);
+    if (cur == h) {
+      s.weight.fetch_add(weight, std::memory_order_relaxed);
+      s.count.fetch_add(1, std::memory_order_relaxed);
+      total_samples_.fetch_add(1, std::memory_order_relaxed);
+      return;
+    }
+    if (cur == 0) {
+      uint64_t expected = 0;
+      if (s.hash.compare_exchange_strong(expected, h,
+                                         std::memory_order_acq_rel)) {
+        // We own the slot: only this thread ever writes frames, and the
+        // release-store of nframes publishes them (readers acquire-load
+        // nframes before touching frames).
+        memcpy(s.frames, frames, sizeof(void*) * size_t(nframes));
+        s.nframes.store(nframes, std::memory_order_release);
+        s.weight.fetch_add(weight, std::memory_order_relaxed);
+        s.count.fetch_add(1, std::memory_order_relaxed);
+        total_samples_.fetch_add(1, std::memory_order_relaxed);
+        return;
+      }
+      if (expected == h) {
+        s.weight.fetch_add(weight, std::memory_order_relaxed);
+        s.count.fetch_add(1, std::memory_order_relaxed);
+        total_samples_.fetch_add(1, std::memory_order_relaxed);
+        return;
+      }
+    }
+  }
+  dropped_.fetch_add(1, std::memory_order_relaxed);  // table crowded
+}
+
+void StackCollector::Reset() {
+  for (auto& s : slots_) {
+    s.hash.store(0, std::memory_order_relaxed);
+    s.weight.store(0, std::memory_order_relaxed);
+    s.count.store(0, std::memory_order_relaxed);
+    s.nframes.store(0, std::memory_order_relaxed);
+  }
+  total_samples_.store(0, std::memory_order_relaxed);
+  dropped_.store(0, std::memory_order_relaxed);
+}
+
+std::string SymbolizeFrame(void* addr) {
+  Dl_info info;
+  if (dladdr(addr, &info) != 0 && info.dli_sname != nullptr) {
+    int status = 0;
+    char* dem = abi::__cxa_demangle(info.dli_sname, nullptr, nullptr,
+                                    &status);
+    std::string name = (status == 0 && dem) ? dem : info.dli_sname;
+    free(dem);
+    char off[32];
+    snprintf(off, sizeof(off), "+0x%zx",
+             size_t(reinterpret_cast<char*>(addr) -
+                    reinterpret_cast<char*>(info.dli_saddr)));
+    return name + off;
+  }
+  char buf[32];
+  snprintf(buf, sizeof(buf), "%p", addr);
+  return buf;
+}
+
+std::string StackCollector::Render(const std::string& unit,
+                                   int64_t weight_divisor) const {
+  struct Row {
+    const Slot* s;
+    int64_t weight;
+  };
+  std::vector<Row> rows;
+  for (const auto& s : slots_) {
+    if (s.hash.load(std::memory_order_acquire) != 0 &&
+        s.count.load(std::memory_order_relaxed) > 0) {
+      rows.push_back({&s, s.weight.load(std::memory_order_relaxed)});
+    }
+  }
+  std::sort(rows.begin(), rows.end(),
+            [](const Row& a, const Row& b) { return a.weight > b.weight; });
+  std::ostringstream os;
+  os << "samples: " << total_samples_.load(std::memory_order_relaxed)
+     << "  distinct_stacks: " << rows.size()
+     << "  dropped: " << dropped_.load(std::memory_order_relaxed) << "\n\n";
+  int shown = 0;
+  for (const Row& r : rows) {
+    if (++shown > 32) break;
+    os << r.weight / (weight_divisor > 0 ? weight_divisor : 1) << " " << unit
+       << "  x" << r.s->count.load(std::memory_order_relaxed) << "\n";
+    const int nf = r.s->nframes.load(std::memory_order_acquire);
+    if (nf == 0) {
+      os << "    (stack being published)\n";
+    }
+    for (int i = 0; i < nf; ++i) {
+      os << "    " << SymbolizeFrame(r.s->frames[i]) << "\n";
+    }
+    os << "\n";
+  }
+  return os.str();
+}
+
+}  // namespace var
+}  // namespace brt

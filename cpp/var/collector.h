@@ -1,0 +1,73 @@
+// Shared speed-limited sample collector: call sites submit weighted stack
+// samples; aggregation/rendering is centralized and bounded.
+// Parity target: reference src/bvar/collector.{h,cpp} (the shared,
+// speed-limited collection pipeline behind the contention profiler and
+// rpcz sampling). Redesigned: instead of the reference's background
+// grab-thread + linked sample chains, submissions take a token from a
+// per-second budget and aggregate directly into a small fixed-slot hash of
+// stacks — no allocation, no dedicated thread.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+namespace brt {
+namespace var {
+
+class StackCollector {
+ public:
+  // A process-wide instance per sample family.
+  static StackCollector& contention();
+
+  // Submits one stack with a weight (e.g. nanoseconds waited). Cheap and
+  // thread/fiber-safe; silently drops when over the per-second budget or
+  // when all slots for new stacks are taken.
+  void Submit(void* const* frames, int nframes, int64_t weight);
+
+  // Rate-limit check exposed so callers can skip expensive sample
+  // *collection* (backtrace) when the budget is exhausted; pair with
+  // SubmitTokened.
+  bool TryAcquireToken() { return TakeToken(); }
+  void SubmitTokened(void* const* frames, int nframes, int64_t weight);
+
+  // Human-readable report: top stacks by total weight, symbolized.
+  // unit labels the weight column (e.g. "us-waited").
+  std::string Render(const std::string& unit, int64_t weight_divisor) const;
+
+  void Reset();
+
+  int64_t total_samples() const {
+    return total_samples_.load(std::memory_order_relaxed);
+  }
+
+  static constexpr int kMaxFrames = 26;
+  static constexpr int kSlots = 256;  // distinct stacks tracked
+  static constexpr int kBudgetPerSec = 1000;
+
+ private:
+  struct Slot {
+    std::atomic<uint64_t> hash{0};  // 0 = empty
+    void* frames[kMaxFrames];
+    // Written (release) once by the claiming thread AFTER frames; readers
+    // acquire-load it and only then touch frames — 0 means "stack not yet
+    // published", rendered as pending.
+    std::atomic<int> nframes{0};
+    std::atomic<int64_t> weight{0};
+    std::atomic<int64_t> count{0};
+  };
+
+  bool TakeToken();
+
+  Slot slots_[kSlots];
+  std::atomic<int64_t> total_samples_{0};
+  std::atomic<int64_t> dropped_{0};
+  // token bucket: [epoch_second:32 | used:32]
+  std::atomic<uint64_t> bucket_{0};
+};
+
+// Symbolizes one return address ("func+0x1a" or the raw hex).
+std::string SymbolizeFrame(void* addr);
+
+}  // namespace var
+}  // namespace brt
