@@ -64,6 +64,21 @@ class ExecutionQueue {
     return 0;
   }
 
+  // High-priority lane (reference execution_queue_inl.h:57
+  // TASK_OPTIONS_URGENT): urgent tasks lead the batch they land in — the
+  // consumer reorders each snapshot so everything urgent runs before any
+  // still-waiting normal task. Rides the same stop-safe MPSC chain as
+  // execute(), so the stop()/join() guarantees hold for this lane too.
+  int execute_urgent(T value) {
+    Node* n = new Node(std::move(value), false);
+    n->urgent = true;
+    if (!push(n, /*stop_bit=*/false)) {
+      delete n;
+      return EINVAL;
+    }
+    return 0;
+  }
+
   // No more execute()s accepted; consumer drains remaining then exits.
   // The stop decision rides the head word itself (low tag bit), so a
   // producer can never slip a task in after the stop sentinel — once join()
@@ -85,6 +100,7 @@ class ExecutionQueue {
     explicit Node(T&& v, bool s) : value(std::move(v)), stop_sentinel(s) {}
     T value{};
     bool stop_sentinel = false;
+    bool urgent = false;
     std::atomic<Node*> next{nullptr};
     Node* consumer_next = nullptr;  // batch chain handed to the iterator
   };
@@ -121,24 +137,32 @@ class ExecutionQueue {
     for (;;) {
       Node* first = tail_->next.load(std::memory_order_acquire);
       if (first != nullptr) {
-        // Walk the linked batch; chain non-sentinel nodes for the iterator.
+        // Walk the linked batch; urgent nodes are chained FIRST so they
+        // overtake every normal task in the same snapshot.
         bool saw_stop = false;
-        Node* batch_head = nullptr;
-        Node** chain = &batch_head;
+        Node* urgent_head = nullptr;
+        Node** uchain = &urgent_head;
+        Node* normal_head = nullptr;
+        Node** nchain = &normal_head;
         Node* last = nullptr;
         for (Node* n = first; n != nullptr;
              n = n->next.load(std::memory_order_acquire)) {
           last = n;
           if (n->stop_sentinel) {
             saw_stop = true;
+          } else if (n->urgent) {
+            *uchain = n;
+            uchain = &n->consumer_next;
           } else {
-            *chain = n;
-            chain = &n->consumer_next;
+            *nchain = n;
+            nchain = &n->consumer_next;
           }
         }
-        *chain = nullptr;
-        if (batch_head != nullptr) {
-          TaskIterator it(batch_head);
+        *uchain = normal_head;  // urgent sub-chain leads
+        *nchain = nullptr;
+        if (urgent_head != nullptr || normal_head != nullptr) {
+          TaskIterator it(urgent_head != nullptr ? urgent_head
+                                                 : normal_head);
           fn_(meta_, it);
         }
         // Free the old stub and consumed nodes; 'last' becomes the new stub.

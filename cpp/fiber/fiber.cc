@@ -139,54 +139,72 @@ TaskControl* TaskControl::get_or_null() { return g_control; }
 
 void TaskControl::start(int concurrency) {
   concurrency_ = concurrency;
-  groups_.reserve(concurrency);
-  for (int i = 0; i < concurrency; ++i) {
-    auto* g = new TaskGroup(this, i);
-    groups_.push_back(g);
-  }
-  for (int i = 0; i < concurrency; ++i) {
-    std::thread([g = groups_[i]] {
+  ensure_tag_workers(0, concurrency);
+}
+
+void TaskControl::ensure_tag_workers(int tag, int n) {
+  TagRuntime* rt = tag_runtime(tag);
+  if (n > TagRuntime::kMaxWorkers) n = TagRuntime::kMaxWorkers;
+  std::lock_guard<std::mutex> g(rt->grow_mu);
+  const int have = rt->ngroups.load(std::memory_order_relaxed);
+  for (int i = have; i < n; ++i) {
+    auto* grp = new TaskGroup(this, i, tag, rt);
+    rt->groups[i] = grp;
+    // Publish the pointer before the count: a stealer that sees the new
+    // count always sees a valid group.
+    rt->ngroups.store(i + 1, std::memory_order_release);
+    std::thread([grp] {
       // SIGPROF (cpu profiler) must not land on small fiber stacks.
       ProfilerSetupThisThreadAltStack();
-      g->run_main_loop();
+      grp->run_main_loop();
     }).detach();
   }
 }
 
-void TaskControl::signal_task(int n) {
+void TaskControl::signal_task(TagRuntime* rt, int n) {
   if (n <= 0) return;
-  pl_.signal(n > 2 ? 2 : n);
+  rt->pl.signal(n > 2 ? 2 : n);
 }
 
-bool TaskControl::steal_task(fiber_t* out, uint64_t* seed, int skip) {
+bool TaskControl::steal_task(TagRuntime* rt, fiber_t* out, uint64_t* seed,
+                             int skip) {
   // Full sweep from a random start: wait_task's park decision relies on
   // this scan being COMPLETE — a probabilistic probe can miss the one
   // group holding a ready fiber, and the worker then parks with no future
   // signal coming (the push already signalled), stranding that fiber until
-  // unrelated traffic arrives.
-  const size_t n = groups_.size();
+  // unrelated traffic arrives. Stealing never crosses a tag boundary.
+  const size_t n = size_t(rt->ngroups.load(std::memory_order_acquire));
+  if (n == 0) return false;
   *seed = *seed * 6364136223846793005ULL + 1442695040888963407ULL;
   const size_t start = (*seed >> 33) % n;
   for (size_t k = 0; k < n; ++k) {
     const size_t i = (start + k) % n;
     if (int(i) == skip) continue;
-    if (groups_[i]->rq_.steal(out)) return true;
-    if (groups_[i]->pop_remote(out)) return true;
+    if (rt->groups[i]->rq_.steal(out)) return true;
+    if (rt->groups[i]->pop_remote(out)) return true;
   }
   return false;
 }
 
-TaskGroup* TaskControl::choose_group() {
-  int i = next_remote_.fetch_add(1, std::memory_order_relaxed);
-  return groups_[size_t(i) % groups_.size()];
+TaskGroup* TaskControl::choose_group(int tag) {
+  TagRuntime* rt = tag_runtime(tag);
+  if (rt->ngroups.load(std::memory_order_acquire) == 0) {
+    // First traffic for this tag: give it a minimal worker pair.
+    ensure_tag_workers(tag, 2);
+  }
+  const int n = rt->ngroups.load(std::memory_order_acquire);
+  int i = rt->next_remote.fetch_add(1, std::memory_order_relaxed);
+  return rt->groups[size_t(i) % size_t(n)];
 }
 
 // ---------------- TaskGroup ----------------
 
-TaskGroup::TaskGroup(TaskControl* c, int index)
-    : control_(c), index_(index),
-      steal_seed_(0x9e3779b97f4a7c15ULL ^ (uint64_t(index) << 17)) {
+TaskGroup::TaskGroup(TaskControl* c, int index, int tag, TagRuntime* rt)
+    : control_(c), index_(index), tag_(tag), rt_(rt),
+      steal_seed_(0x9e3779b97f4a7c15ULL ^ (uint64_t(index) << 17) ^
+                  (uint64_t(tag) << 49)) {
   main_meta_.is_main = true;
+  main_meta_.tag = tag;
 }
 
 void TaskGroup::ready_to_run(fiber_t tid) {
@@ -194,7 +212,7 @@ void TaskGroup::ready_to_run(fiber_t tid) {
     push_remote(tid);  // overflow: spill to own remote queue
     return;
   }
-  control_->signal_task(1);
+  control_->signal_task(rt_, 1);
 }
 
 void TaskGroup::push_remote(fiber_t tid) {
@@ -202,7 +220,7 @@ void TaskGroup::push_remote(fiber_t tid) {
     std::lock_guard<std::mutex> g(remote_mu_);
     remote_rq_.push_back(tid);
   }
-  control_->signal_task(1);
+  control_->signal_task(rt_, 1);
 }
 
 bool TaskGroup::pop_remote(fiber_t* out) {
@@ -215,10 +233,13 @@ bool TaskGroup::pop_remote(fiber_t* out) {
 
 void requeue_fiber(fiber_t tid) {
   TaskGroup* g = tls_task_group;
-  if (g != nullptr) {
+  TaskMeta* m = TaskMetaPool::get().address_unsafe(tid);
+  const int tag = m != nullptr ? m->tag : 0;
+  if (g != nullptr && g->tag_ == tag) {
     g->ready_to_run(tid);
   } else {
-    TaskControl::get()->choose_group()->push_remote(tid);
+    // Cross-tag (or non-worker) push: route to the fiber's own partition.
+    TaskControl::get()->choose_group(tag)->push_remote(tid);
   }
 }
 
@@ -226,13 +247,13 @@ bool TaskGroup::wait_task(fiber_t* out) {
   for (;;) {
     if (rq_.pop(out)) return true;
     if (pop_remote(out)) return true;
-    if (control_->steal_task(out, &steal_seed_, index_)) return true;
-    int expected = control_->pl_.state();
+    if (control_->steal_task(rt_, out, &steal_seed_, index_)) return true;
+    int expected = rt_->pl.state();
     // one more scan after snapshotting to close the lost-wake window
     if (rq_.pop(out) || pop_remote(out) ||
-        control_->steal_task(out, &steal_seed_, index_))
+        control_->steal_task(rt_, out, &steal_seed_, index_))
       return true;
-    control_->pl_.wait(expected);
+    rt_->pl.wait(expected);
   }
 }
 
@@ -275,6 +296,12 @@ void TaskGroup::task_runner(void* /*jump_arg*/) {
   g->run_remained();
   TaskMeta* m = g->cur_meta_;
   m->fn(m->arg);
+  // Fiber-local keys: run destructors on THIS stack before termination
+  // (reference bthread/key.cpp KeyTable teardown).
+  if (m->key_table != nullptr) {
+    DestroyKeyTable(m->key_table);
+    m->key_table = nullptr;
+  }
   g_fibers_finished.fetch_add(1, std::memory_order_relaxed);
   // Fiber terminated. We might have migrated workers while running.
   g = tls_task_group;
@@ -345,7 +372,15 @@ void fiber_init(int concurrency) {
   }
 }
 
-int fiber_concurrency() { return TaskControl::get()->concurrency_; }
+int fiber_concurrency() {
+  // Total live workers across all tag partitions.
+  TaskControl* c = TaskControl::get();
+  int total = 0;
+  for (int t = 0; t < TaskControl::kMaxTags; ++t) {
+    total += c->tags_[t].ngroups.load(std::memory_order_acquire);
+  }
+  return total;
+}
 
 static fiber_t create_meta(void* (*fn)(void*), void* arg,
                            const FiberAttr* attr, TaskMeta** out) {
@@ -354,6 +389,8 @@ static fiber_t create_meta(void* (*fn)(void*), void* arg,
   m->fn = fn;
   m->arg = arg;
   m->stack_type = attr ? attr->stack_type : StackType::NORMAL;
+  m->tag = attr ? attr->tag : 0;
+  m->key_table = nullptr;
   if (m->has_stack && m->stack.type != m->stack_type) {
     return_stack(m->stack);
     m->has_stack = false;
@@ -364,6 +401,10 @@ static fiber_t create_meta(void* (*fn)(void*), void* arg,
 
 int fiber_start(fiber_t* tid_out, void* (*fn)(void*), void* arg,
                 const FiberAttr* attr) {
+  if (attr != nullptr &&
+      (attr->tag < 0 || attr->tag >= TaskControl::kMaxTags)) {
+    return EINVAL;  // silently clamping would break the isolation promise
+  }
   TaskControl::get();
   TaskMeta* m;
   fiber_t tid = create_meta(fn, arg, attr, &m);
@@ -371,6 +412,18 @@ int fiber_start(fiber_t* tid_out, void* (*fn)(void*), void* arg,
   g_fibers_created.fetch_add(1, std::memory_order_relaxed);
   requeue_fiber(tid);
   return 0;
+}
+
+int fiber_init_tag(int tag, int concurrency) {
+  if (tag < 0 || tag >= TaskControl::kMaxTags) return EINVAL;
+  if (concurrency <= 0) concurrency = 2;
+  TaskControl::get()->ensure_tag_workers(tag, concurrency);
+  return 0;
+}
+
+int fiber_self_tag() {
+  TaskGroup* g = tls_task_group;
+  return g != nullptr ? g->tag_ : 0;
 }
 
 FiberRuntimeStats fiber_runtime_stats() {
@@ -385,6 +438,10 @@ FiberRuntimeStats fiber_runtime_stats() {
 
 int fiber_start_urgent(fiber_t* tid_out, void* (*fn)(void*), void* arg,
                        const FiberAttr* attr) {
+  if (attr != nullptr &&
+      (attr->tag < 0 || attr->tag >= TaskControl::kMaxTags)) {
+    return EINVAL;
+  }
   TaskControl::get();
   TaskGroup* g = tls_task_group;
   if (g == nullptr || g->cur_meta()->is_main) {

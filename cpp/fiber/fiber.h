@@ -14,12 +14,32 @@ constexpr fiber_t INVALID_FIBER = 0;
 
 struct FiberAttr {
   StackType stack_type = StackType::NORMAL;
+  // Worker-tag partition (reference bthread tags): the fiber runs ONLY on
+  // workers of this tag. 0 = the default pool.
+  int tag = 0;
 };
 
 // Starts worker pthreads (idempotent). concurrency<=0 → default
 // (BRT_WORKERS env or max(4, ncpu)).
 void fiber_init(int concurrency = 0);
 int fiber_concurrency();
+
+// Provisions at least `concurrency` workers for `tag` (0..7; EINVAL
+// outside that range). Tag-tagged fibers are isolated to those workers
+// (dispatcher-affinity analog of the reference's bthread_tag,
+// task_control.cpp:42).
+int fiber_init_tag(int tag, int concurrency);
+// Tag of the calling fiber (0 on non-worker threads).
+int fiber_self_tag();
+
+// ---- fiber-local storage (reference bthread/key.cpp) ----
+// Keys are versioned: a deleted key's values become unreachable and its
+// slot is safely reusable. dtor runs at fiber exit for live keys.
+using fiber_key_t = uint64_t;
+int fiber_key_create(fiber_key_t* key, void (*dtor)(void*));
+int fiber_key_delete(fiber_key_t key);
+int fiber_setspecific(fiber_key_t key, void* data);
+void* fiber_getspecific(fiber_key_t key);
 
 // Runtime-wide counters for the /fibers builtin page.
 struct FiberRuntimeStats {

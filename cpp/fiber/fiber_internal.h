@@ -18,6 +18,8 @@ namespace brt {
 class TaskGroup;
 class TaskControl;
 
+struct KeyTable;  // fiber-local storage (keys.cc)
+
 struct TaskMeta {
   void* (*fn)(void*) = nullptr;
   void* arg = nullptr;
@@ -26,12 +28,17 @@ struct TaskMeta {
   bool has_stack = false;
   bool is_main = false;
   StackType stack_type = StackType::NORMAL;
+  int tag = 0;                  // worker-tag partition this fiber runs in
+  KeyTable* key_table = nullptr;  // lazily created; dtors run at exit
   uint32_t index = 0;           // slot index in the meta pool
   std::atomic<uint32_t> version{0};  // odd = live (id ABA guard)
   Butex* join_butex = nullptr;  // value := version; bumped at termination
   Butex* sleep_butex = nullptr; // parked on by fiber_usleep
   std::atomic<bool> stop_requested{false};
 };
+
+// Runs destructors for all live keys in the table and frees it (keys.cc).
+void DestroyKeyTable(KeyTable* kt);
 
 // Slab pool of TaskMeta; slots live forever (stale handles stay memory-safe,
 // same contract as the reference's ResourcePool-backed bthread_t).
@@ -67,9 +74,25 @@ class ParkingLot {
   std::atomic<int> parked_{0};
 };
 
+// Workers are partitioned by TAG (reference task_control.cpp:42 worker
+// tags): fibers with tag T run only on tag-T workers, steal only within
+// the tag, and park on the tag's own ParkingLot — traffic isolation
+// between tag groups is structural, not best-effort.
+struct TagRuntime {
+  static constexpr int kMaxWorkers = 128;
+  // Fixed-capacity array + release-published count: running workers scan
+  // [0, ngroups) lock-free while ensure_tag_workers appends — no vector
+  // reallocation can yank the backing store out from under a stealer.
+  TaskGroup* groups[kMaxWorkers] = {};
+  std::atomic<int> ngroups{0};
+  ParkingLot pl;
+  std::atomic<int> next_remote{0};
+  std::mutex grow_mu;  // serializes appends
+};
+
 class TaskGroup {
  public:
-  explicit TaskGroup(TaskControl* c, int index);
+  TaskGroup(TaskControl* c, int index, int tag, TagRuntime* rt);
 
   void run_main_loop();
 
@@ -109,6 +132,8 @@ class TaskGroup {
   TaskMeta* cur_meta_ = nullptr;
   TaskControl* control_;
   int index_;
+  int tag_ = 0;
+  TagRuntime* rt_ = nullptr;  // this worker's tag partition
   uint64_t steal_seed_;
 
  private:
@@ -119,19 +144,27 @@ class TaskGroup {
 
 class TaskControl {
  public:
-  // Lazily started global runtime.
+  static constexpr int kMaxTags = 8;
+
+  // Lazily started global runtime (tag 0).
   static TaskControl* get();
   static TaskControl* get_or_null();
   void start(int concurrency);
 
-  void signal_task(int n);
-  bool steal_task(fiber_t* out, uint64_t* seed, int skip_group);
-  TaskGroup* choose_group();  // for remote pushes
+  // Ensures tag `tag` has at least n workers (spawns the difference).
+  void ensure_tag_workers(int tag, int n);
 
-  std::vector<TaskGroup*> groups_;
-  ParkingLot pl_;
-  std::atomic<int> next_remote_{0};
-  int concurrency_ = 0;
+  void signal_task(TagRuntime* rt, int n);
+  bool steal_task(TagRuntime* rt, fiber_t* out, uint64_t* seed,
+                  int skip_group);
+  TaskGroup* choose_group(int tag);  // for remote pushes
+
+  TagRuntime* tag_runtime(int tag) {
+    return &tags_[tag >= 0 && tag < kMaxTags ? tag : 0];
+  }
+
+  TagRuntime tags_[kMaxTags];
+  int concurrency_ = 0;  // tag-0 worker count
 };
 
 extern thread_local TaskGroup* tls_task_group;

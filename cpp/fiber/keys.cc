@@ -1,0 +1,153 @@
+// Fiber-local storage keys.
+// Parity target: reference src/bthread/key.cpp (bthread_key_create /
+// setspecific / getspecific with versioned key reuse and destructors run at
+// fiber exit). Redesigned: one flat per-fiber table indexed by key slot
+// (the reference uses a two-level sub-keytable); key slots are versioned so
+// a deleted+recreated key never reads a stale value. Works from plain
+// pthreads too (thread-local table).
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+#include "fiber/fiber.h"
+#include "fiber/fiber_internal.h"
+
+namespace brt {
+
+namespace {
+
+constexpr uint32_t kMaxKeys = 4096;
+
+struct KeyInfo {
+  std::atomic<uint32_t> version{0};  // even = free, odd = live
+  void (*dtor)(void*) = nullptr;
+};
+
+std::mutex g_keys_mu;
+KeyInfo g_keys[kMaxKeys];
+uint32_t g_nkeys = 0;
+std::vector<uint32_t> g_free_keys;
+
+}  // namespace
+
+struct KeyTable {
+  struct Entry {
+    uint32_t version = 0;
+    void* data = nullptr;
+  };
+  std::vector<Entry> entries;
+};
+
+void DestroyKeyTable(KeyTable* kt) {
+  if (kt == nullptr) return;
+  // Destructors may set other keys; loop until quiescent (bounded).
+  for (int round = 0; round < 4; ++round) {
+    bool any = false;
+    for (uint32_t i = 0; i < kt->entries.size(); ++i) {
+      KeyTable::Entry& e = kt->entries[i];
+      if (e.data == nullptr) continue;
+      void (*dtor)(void*) = nullptr;
+      {
+        std::lock_guard<std::mutex> g(g_keys_mu);
+        if (i < g_nkeys &&
+            g_keys[i].version.load(std::memory_order_acquire) ==
+                e.version) {
+          dtor = g_keys[i].dtor;
+        }
+      }
+      void* data = e.data;
+      e.data = nullptr;
+      if (dtor != nullptr) {
+        dtor(data);
+        any = true;
+      }
+    }
+    if (!any) break;
+  }
+  delete kt;
+}
+
+namespace {
+
+// The calling context's key table: the current fiber's, or a thread-local
+// one for plain pthreads (destructors run at thread exit).
+struct PthreadKeyTableOwner {
+  KeyTable* kt = nullptr;
+  ~PthreadKeyTableOwner() { DestroyKeyTable(kt); }
+};
+
+KeyTable** CurrentKeyTableSlot() {
+  TaskGroup* g = tls_task_group;
+  if (g != nullptr && !g->cur_meta()->is_main) {
+    return &g->cur_meta()->key_table;
+  }
+  static thread_local PthreadKeyTableOwner owner;
+  return &owner.kt;
+}
+
+}  // namespace
+
+int fiber_key_create(fiber_key_t* key, void (*dtor)(void*)) {
+  std::lock_guard<std::mutex> g(g_keys_mu);
+  uint32_t index;
+  if (!g_free_keys.empty()) {
+    index = g_free_keys.back();
+    g_free_keys.pop_back();
+  } else {
+    if (g_nkeys >= kMaxKeys) return EAGAIN;
+    index = g_nkeys++;
+  }
+  const uint32_t v =
+      g_keys[index].version.load(std::memory_order_relaxed) + 1;  // → odd
+  g_keys[index].dtor = dtor;
+  g_keys[index].version.store(v, std::memory_order_release);
+  *key = (uint64_t(v) << 32) | index;
+  return 0;
+}
+
+int fiber_key_delete(fiber_key_t key) {
+  const uint32_t index = uint32_t(key);
+  const uint32_t version = uint32_t(key >> 32);
+  std::lock_guard<std::mutex> g(g_keys_mu);
+  if (index >= g_nkeys ||
+      g_keys[index].version.load(std::memory_order_relaxed) != version ||
+      !(version & 1)) {
+    return EINVAL;
+  }
+  // → even (dead); values become unreachable everywhere immediately
+  g_keys[index].version.store(version + 1, std::memory_order_release);
+  g_keys[index].dtor = nullptr;
+  g_free_keys.push_back(index);
+  return 0;
+}
+
+int fiber_setspecific(fiber_key_t key, void* data) {
+  const uint32_t index = uint32_t(key);
+  const uint32_t version = uint32_t(key >> 32);
+  if (!(version & 1) || index >= kMaxKeys ||
+      g_keys[index].version.load(std::memory_order_acquire) != version) {
+    return EINVAL;  // stale/deleted key
+  }
+  KeyTable** slot = CurrentKeyTableSlot();
+  if (*slot == nullptr) *slot = new KeyTable;
+  KeyTable* kt = *slot;
+  if (kt->entries.size() <= index) kt->entries.resize(index + 1);
+  kt->entries[index].version = version;
+  kt->entries[index].data = data;
+  return 0;
+}
+
+void* fiber_getspecific(fiber_key_t key) {
+  const uint32_t index = uint32_t(key);
+  const uint32_t version = uint32_t(key >> 32);
+  if (index >= kMaxKeys ||
+      g_keys[index].version.load(std::memory_order_acquire) != version) {
+    return nullptr;  // deleted key: values are unreachable
+  }
+  KeyTable* kt = *CurrentKeyTableSlot();
+  if (kt == nullptr || index >= kt->entries.size()) return nullptr;
+  const KeyTable::Entry& e = kt->entries[index];
+  return e.version == version ? e.data : nullptr;
+}
+
+}  // namespace brt

@@ -85,6 +85,56 @@ class FiberCond {
   Butex* b_;
 };
 
+// Write-preferring reader/writer lock (reference bthread_rwlock): parked
+// fibers free their workers; pending writers block new readers so a write
+// convoy cannot be starved by a read stream.
+class FiberRWLock {
+ public:
+  void rlock() {
+    mu_.lock();
+    while (writer_ || wwaiters_ > 0) rcond_.wait(mu_);
+    ++readers_;
+    mu_.unlock();
+  }
+  void runlock() {
+    mu_.lock();
+    if (--readers_ == 0 && wwaiters_ > 0) wcond_.notify_one();
+    mu_.unlock();
+  }
+  void wlock() {
+    mu_.lock();
+    ++wwaiters_;
+    while (writer_ || readers_ > 0) wcond_.wait(mu_);
+    --wwaiters_;
+    writer_ = true;
+    mu_.unlock();
+  }
+  void wunlock() {
+    mu_.lock();
+    writer_ = false;
+    if (wwaiters_ > 0) {
+      wcond_.notify_one();
+    } else {
+      rcond_.notify_all();
+    }
+    mu_.unlock();
+  }
+  bool try_rlock() {
+    if (!mu_.try_lock()) return false;
+    const bool ok = !writer_ && wwaiters_ == 0;
+    if (ok) ++readers_;
+    mu_.unlock();
+    return ok;
+  }
+
+ private:
+  FiberMutex mu_;
+  FiberCond rcond_, wcond_;
+  int readers_ = 0;
+  int wwaiters_ = 0;
+  bool writer_ = false;
+};
+
 class CountdownEvent {
  public:
   explicit CountdownEvent(int count = 1) : b_(butex_create()) {

@@ -1,0 +1,70 @@
+#include "fiber/usercode_pool.h"
+
+#include <condition_variable>
+#include <cstdlib>
+#include <deque>
+#include <mutex>
+#include <thread>
+
+namespace brt {
+
+namespace {
+
+struct PoolState {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::function<void()>> queue;
+};
+
+// Leaked: pool threads outlive static destruction order.
+PoolState* state() {
+  static auto* s = new PoolState;
+  return s;
+}
+
+}  // namespace
+
+UsercodePool& UsercodePool::singleton() {
+  static auto* p = new UsercodePool;
+  return *p;
+}
+
+void UsercodePool::EnsureStarted() {
+  static std::once_flag once;
+  std::call_once(once, [this] {
+    int n = 0;
+    if (const char* env = getenv("BRT_USERCODE_THREADS")) n = atoi(env);
+    if (n <= 0) {
+      n = int(std::thread::hardware_concurrency());
+      if (n < 2) n = 2;
+    }
+    nthreads_ = n;
+    for (int i = 0; i < n; ++i) {
+      std::thread([] {
+        PoolState* s = state();
+        for (;;) {
+          std::function<void()> work;
+          {
+            std::unique_lock<std::mutex> lk(s->mu);
+            s->cv.wait(lk, [s] { return !s->queue.empty(); });
+            work = std::move(s->queue.front());
+            s->queue.pop_front();
+          }
+          work();
+        }
+      }).detach();
+    }
+  });
+}
+
+void UsercodePool::Run(std::function<void()> work) {
+  EnsureStarted();
+  PoolState* s = state();
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->queue.push_back(std::move(work));
+  }
+  s->cv.notify_one();
+}
+
+}  // namespace brt

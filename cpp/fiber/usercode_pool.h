@@ -1,0 +1,30 @@
+// Usercode backup pool: runs service handlers on dedicated pthreads when a
+// server opts in — blocking user code then parks a pool thread instead of
+// starving the fiber workers that drive IO.
+// Parity target: reference src/brpc/details/usercode_backup_pool.cpp:37
+// (usercode_in_pthread). Redesigned: a lazily-started fixed pool with a
+// condvar queue; no global usercode counter — opting in routes ALL of a
+// server's handlers here, which is the reference's documented sane use.
+#pragma once
+
+#include <functional>
+
+namespace brt {
+
+class UsercodePool {
+ public:
+  static UsercodePool& singleton();
+
+  // Enqueues work; pool threads (lazily spawned on first use, count from
+  // $BRT_USERCODE_THREADS or ncpu, min 2) run it FIFO.
+  void Run(std::function<void()> work);
+
+  int thread_count() const { return nthreads_; }
+
+ private:
+  UsercodePool() = default;
+  void EnsureStarted();
+  int nthreads_ = 0;
+};
+
+}  // namespace brt

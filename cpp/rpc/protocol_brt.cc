@@ -15,6 +15,7 @@
 #include "rpc/compress.h"
 #include "rpc/controller.h"
 #include "rpc/rpc_dump.h"
+#include "fiber/usercode_pool.h"
 #include "rpc/server.h"
 #include "rpc/span.h"
 #include "transport/input_messenger.h"
@@ -191,6 +192,16 @@ void ProcessRequest(RpcMeta&& meta, IOBuf&& body, SocketId sock,
   body.cutn(&sess->request, payload);
   body.cutn(&sess->cntl.request_attachment(), att);
   const std::string method = std::move(meta.method);
+  if (server->options().usercode_in_pthread) {
+    // Blocking user code runs on the backup pthread pool so it cannot
+    // starve the fiber workers driving IO
+    // (reference details/usercode_backup_pool.cpp:37).
+    UsercodePool::singleton().Run([svc, method, sess] {
+      svc->CallMethod(method, &sess->cntl, sess->request, &sess->response,
+                      [sess] { SendResponse(sess); });
+    });
+    return;
+  }
   svc->CallMethod(method, &sess->cntl, sess->request, &sess->response,
                   [sess] { SendResponse(sess); });
 }

@@ -58,6 +58,9 @@ class Server {
  public:
   struct Options {
     int max_concurrency = 0;  // 0 = unlimited (reference server.h:129)
+    // Run service handlers on the usercode backup pthread pool instead of
+    // fiber workers (for blocking user code; reference usercode_in_pthread).
+    bool usercode_in_pthread = false;
     int fiber_workers = 0;    // fiber_init hint
     // "constant" (bounded by max_concurrency), "auto" (adaptive,
     // reference policy/auto_concurrency_limiter.cpp), "" = unlimited.
