@@ -1,0 +1,23 @@
+// Credential generation/verification shared by client and server.
+// Parity target: reference src/brpc/authenticator.h:58 — the client
+// attaches a generated credential to outgoing request meta; the server
+// verifies it before dispatch (EAUTH on failure).
+#pragma once
+
+#include <string>
+
+#include "base/endpoint.h"
+
+namespace brt {
+
+class Authenticator {
+ public:
+  virtual ~Authenticator() = default;
+  // Client: fill *auth (attached to outgoing request meta). 0 on success.
+  virtual int GenerateCredential(std::string* auth) const = 0;
+  // Server: non-zero rejects the request with EAUTH.
+  virtual int VerifyCredential(const std::string& auth,
+                               const EndPoint& client) const = 0;
+};
+
+}  // namespace brt

@@ -28,6 +28,7 @@ enum Tag : uint8_t {
   TAG_COMPRESS = 12,
   TAG_STREAM_ID = 13,
   TAG_STREAM_FLAGS = 14,
+  TAG_AUTH = 15,
 };
 
 void put_varint(std::string* out, uint64_t v) {
@@ -82,6 +83,7 @@ void EncodeMeta(const RpcMeta& meta, std::string* out) {
   if (meta.compress_type) put_field(out, TAG_COMPRESS, meta.compress_type);
   if (meta.stream_id) put_field(out, TAG_STREAM_ID, meta.stream_id);
   if (meta.stream_flags) put_field(out, TAG_STREAM_FLAGS, meta.stream_flags);
+  if (!meta.auth.empty()) put_str(out, TAG_AUTH, meta.auth);
 }
 
 bool DecodeMeta(const void* data, size_t n, RpcMeta* meta) {
@@ -99,12 +101,14 @@ bool DecodeMeta(const void* data, size_t n, RpcMeta* meta) {
       case TAG_CID: meta->correlation_id = v; break;
       case TAG_SERVICE:
       case TAG_METHOD:
+      case TAG_AUTH:
       case TAG_ERROR_TEXT: {
         if (size_t(end - p) < v) return false;
         std::string s(reinterpret_cast<const char*>(p), v);
         p += v;
         if (tag == TAG_SERVICE) meta->service = std::move(s);
         else if (tag == TAG_METHOD) meta->method = std::move(s);
+        else if (tag == TAG_AUTH) meta->auth = std::move(s);
         else meta->error_text = std::move(s);
         break;
       }

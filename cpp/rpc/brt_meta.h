@@ -28,9 +28,10 @@ struct RpcMeta {
   uint64_t trace_id = 0;     // rpcz span propagation
   uint64_t span_id = 0;
   uint64_t parent_span_id = 0;
-  uint8_t compress_type = 0; // 0 none, 1 snappy-like (reserved)
+  uint8_t compress_type = 0; // CompressType: 0 none, 1 zlib, 2 snappy
   uint64_t stream_id = 0;    // STREAM frames + stream-settings on REQUEST
   uint8_t stream_flags = 0;  // see stream.h: FLAG_CLOSE / FLAG_FEEDBACK
+  std::string auth;          // Authenticator credential (request only)
 };
 
 // Serializes meta and frames header+meta+body into *out. Steals *body.

@@ -81,6 +81,9 @@ void Channel::CallMethod(const std::string& service, const std::string& method,
   c.request_meta.trace_id = cntl->trace_id;
   c.request_meta.span_id = cntl->span_id;
   c.request_meta.stream_id = cntl->pending_stream_id;
+  const bool auth_failed =
+      options_.auth != nullptr &&
+      options_.auth->GenerateCredential(&c.request_meta.auth) != 0;
   c.request_body = request;  // shares blocks — no copy
   c.request_body.append(cntl->request_attachment());
   if (cntl->request_compress_type != 0) {
@@ -102,6 +105,13 @@ void Channel::CallMethod(const std::string& service, const std::string& method,
   }
   if (!inited_) {
     cntl->SetFailed(EINVAL, "channel not initialized");
+    cntl->EndRPC();
+    return;
+  }
+  if (auth_failed) {
+    // Fail locally: shipping a broken credential would burn a round trip
+    // and retries just to learn EAUTH from the server.
+    cntl->SetFailed(EAUTH, "GenerateCredential failed");
     cntl->EndRPC();
     return;
   }

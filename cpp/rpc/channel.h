@@ -7,6 +7,7 @@
 
 #include <string>
 
+#include "rpc/authenticator.h"
 #include "rpc/controller.h"
 #include "rpc/socket_map.h"
 
@@ -26,6 +27,10 @@ struct ChannelOptions {
   // isolation when TCP comes back (reference FLAGS_health_check_interval +
   // HealthCheckTask). <=0 disables active probing.
   int64_t health_check_interval_ms = 3000;
+  // Client credential source (reference authenticator.h:58): when set, the
+  // generated credential rides every request's meta. Ownership stays with
+  // the caller; must outlive the channel.
+  const Authenticator* auth = nullptr;
 };
 
 // Anything callable like a channel: plain Channel, ClusterChannel, and the

@@ -1,9 +1,11 @@
 #include "rpc/compress.h"
 
+#include <string.h>
 #include <zlib.h>
 
 #include <mutex>
-#include <string>
+
+#include "rpc/snappy_codec.h"
 
 namespace brt {
 
@@ -12,40 +14,92 @@ namespace {
 CompressHandler g_handlers[256];
 bool g_registered[256];
 
+// zlib streamed ACROSS IOBuf blocks: deflate consumes each block in place
+// (no contiguous copy of the payload — the reference feeds zlib through
+// zero-copy stream adaptors the same way) and emits into fixed chunks
+// appended to the output buffer.
+constexpr size_t kZChunk = 16 * 1024;
+
 bool ZlibCompress(const IOBuf& in, IOBuf* out) {
-  const std::string src = in.to_string();  // zlib wants contiguous
-  uLong bound = compressBound(src.size());
-  std::string dst(bound, '\0');
-  uLongf dlen = bound;
-  if (compress2(reinterpret_cast<Bytef*>(dst.data()), &dlen,
-                reinterpret_cast<const Bytef*>(src.data()), src.size(),
-                Z_DEFAULT_COMPRESSION) != Z_OK) {
-    return false;
-  }
-  // 8-byte original-size prefix so decompression can size its buffer.
-  uint64_t orig = src.size();
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (deflateInit(&zs, Z_DEFAULT_COMPRESSION) != Z_OK) return false;
+  // 8-byte original-size prefix so decompression can sanity-bound.
+  uint64_t orig = in.size();
   out->append(&orig, sizeof(orig));
-  out->append(dst.data(), dlen);
-  return true;
+  char chunk[kZChunk];
+  bool ok = true;
+  bool ended = false;
+  const int nblocks = in.block_count();
+  for (int b = 0; b < nblocks && ok; ++b) {
+    zs.next_in =
+        reinterpret_cast<Bytef*>(const_cast<void*>(in.ref_data(b)));
+    zs.avail_in = in.ref_at(b).length;
+    const int flush = (b + 1 == nblocks) ? Z_FINISH : Z_NO_FLUSH;
+    do {
+      zs.next_out = reinterpret_cast<Bytef*>(chunk);
+      zs.avail_out = kZChunk;
+      const int rc = deflate(&zs, flush);
+      if (rc == Z_STREAM_ERROR) {
+        ok = false;
+        break;
+      }
+      if (rc == Z_STREAM_END) ended = true;
+      out->append(chunk, kZChunk - zs.avail_out);
+    } while (zs.avail_out == 0 || zs.avail_in > 0);
+  }
+  if (nblocks == 0) {  // empty payload still needs the zlib trailer
+    zs.next_in = nullptr;
+    zs.avail_in = 0;
+    zs.next_out = reinterpret_cast<Bytef*>(chunk);
+    zs.avail_out = kZChunk;
+    ok = deflate(&zs, Z_FINISH) == Z_STREAM_END;
+    ended = ok;
+    out->append(chunk, kZChunk - zs.avail_out);
+  }
+  deflateEnd(&zs);
+  return ok && ended;
 }
 
 bool ZlibDecompress(const IOBuf& in, IOBuf* out) {
   if (in.size() < sizeof(uint64_t)) return false;
-  IOBuf tmp = in;
+  IOBuf src = in;
   uint64_t orig = 0;
-  tmp.cutn(&orig, sizeof(orig));
+  src.cutn(&orig, sizeof(orig));
   if (orig > (1ull << 32)) return false;  // sanity
-  const std::string src = tmp.to_string();
-  std::string dst(orig, '\0');
-  uLongf dlen = orig;
-  if (uncompress(reinterpret_cast<Bytef*>(dst.data()), &dlen,
-                 reinterpret_cast<const Bytef*>(src.data()),
-                 src.size()) != Z_OK ||
-      dlen != orig) {
-    return false;
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (inflateInit(&zs) != Z_OK) return false;
+  char chunk[kZChunk];
+  bool ok = true;
+  bool done = false;
+  uint64_t produced = 0;
+  const int nblocks = src.block_count();
+  for (int b = 0; b < nblocks && ok && !done; ++b) {
+    zs.next_in =
+        reinterpret_cast<Bytef*>(const_cast<void*>(src.ref_data(b)));
+    zs.avail_in = src.ref_at(b).length;
+    do {
+      zs.next_out = reinterpret_cast<Bytef*>(chunk);
+      zs.avail_out = kZChunk;
+      const int rc = inflate(&zs, Z_NO_FLUSH);
+      if (rc == Z_STREAM_END) {
+        done = true;
+      } else if (rc != Z_OK) {
+        ok = false;
+        break;
+      }
+      const size_t got = kZChunk - zs.avail_out;
+      produced += got;
+      if (produced > orig) {  // liar prefix
+        ok = false;
+        break;
+      }
+      out->append(chunk, got);
+    } while ((zs.avail_out == 0 || zs.avail_in > 0) && !done);
   }
-  out->append(dst.data(), dlen);
-  return true;
+  inflateEnd(&zs);
+  return ok && done && produced == orig;
 }
 
 }  // namespace
@@ -65,6 +119,9 @@ void RegisterBuiltinCompress() {
   std::call_once(once, [] {
     RegisterCompressHandler(COMPRESS_ZLIB,
                             CompressHandler{ZlibCompress, ZlibDecompress});
+    RegisterCompressHandler(COMPRESS_SNAPPY,
+                            CompressHandler{SnappyCompress,
+                                            SnappyDecompress});
   });
 }
 

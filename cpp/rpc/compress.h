@@ -16,6 +16,7 @@ namespace brt {
 enum CompressType : uint8_t {
   COMPRESS_NONE = 0,
   COMPRESS_ZLIB = 1,
+  COMPRESS_SNAPPY = 2,
 };
 
 struct CompressHandler {

@@ -1,11 +1,21 @@
 #include "rpc/concurrency_limiter.h"
 
+#include <cstdlib>
+
 namespace brt {
 
 std::unique_ptr<ConcurrencyLimiter> CreateConcurrencyLimiter(
     const std::string& name, int max_concurrency) {
   if (name == "auto") {
     return std::make_unique<AutoLimiter>();
+  }
+  if (name == "timeout" || name.rfind("timeout:", 0) == 0) {
+    TimeoutLimiter::Options opt;
+    if (name.size() > 8) {
+      const long long us = atoll(name.c_str() + 8);
+      if (us > 0) opt.timeout_us = us;
+    }
+    return std::make_unique<TimeoutLimiter>(opt);
   }
   if (name == "constant" || name.empty()) {
     if (max_concurrency <= 0) return nullptr;  // unlimited

@@ -109,8 +109,50 @@ class AutoLimiter : public ConcurrencyLimiter {
   double peak_qps_ = 0;  // only touched under the CAS winner
 };
 
-// Factory: "constant" (uses max_concurrency), "auto", "" → nullptr
-// (unlimited).
+// Rejects requests whose expected queueing delay would blow the deadline:
+// with average service latency L and c requests in flight, a new arrival
+// waits ~c*L/workers; admit only while that stays inside the budget
+// (reference policy/timeout_concurrency_limiter.cpp).
+class TimeoutLimiter : public ConcurrencyLimiter {
+ public:
+  struct Options {
+    int64_t timeout_us = 100000;  // admission budget per request
+    int min_limit = 4;            // always admit this much
+  };
+
+  TimeoutLimiter() : TimeoutLimiter(Options{}) {}
+  explicit TimeoutLimiter(const Options& opt) : opt_(opt) {}
+
+  bool OnRequested(int c) override {
+    if (c <= opt_.min_limit) return true;
+    const int64_t avg = avg_latency_us_.load(std::memory_order_relaxed);
+    if (avg <= 0) return true;  // no signal yet
+    // Expected sojourn for the newcomer: everyone ahead must drain first.
+    return int64_t(c) * avg <= opt_.timeout_us;
+  }
+
+  void OnResponded(int error_code, int64_t latency_us) override {
+    if (error_code != 0) return;
+    int64_t avg = avg_latency_us_.load(std::memory_order_relaxed);
+    // EMA (1/8 step), seeded by the first sample.
+    const int64_t next =
+        avg == 0 ? latency_us : avg + (latency_us - avg) / 8;
+    avg_latency_us_.store(next, std::memory_order_relaxed);
+  }
+
+  int max_concurrency() const override {
+    const int64_t avg = avg_latency_us_.load(std::memory_order_relaxed);
+    if (avg <= 0) return 0;
+    return std::max<int>(opt_.min_limit, int(opt_.timeout_us / avg));
+  }
+
+ private:
+  Options opt_;
+  std::atomic<int64_t> avg_latency_us_{0};
+};
+
+// Factory: "constant" (uses max_concurrency), "auto", "timeout" /
+// "timeout:<us>", "" → nullptr (unlimited).
 std::unique_ptr<ConcurrencyLimiter> CreateConcurrencyLimiter(
     const std::string& name, int max_concurrency);
 

@@ -25,6 +25,8 @@ const char* RpcErrorText(int code) {
     case ELOGOFF: return "server is stopping";
     case ELIMIT: return "concurrency limit reached";
     case ECANCELEDRPC: return "rpc canceled";
+    case EAUTH: return "authentication failed";
+    case EREJECT: return "rejected by interceptor";
     default: return strerror(code);
   }
 }

@@ -143,6 +143,11 @@ class Controller {
   void EndRPC();
 
   void set_remote_side(const EndPoint& ep) { remote_side_ = ep; }
+
+  // Pooled per-request user data (server-side; nullptr without a
+  // DataFactory — reference Controller::session_local_data()).
+  void* session_local_data() const { return session_local_data_; }
+  void set_session_local_data(void* d) { session_local_data_ = d; }
   void set_local_side(const EndPoint& ep) { local_side_ = ep; }
   void set_latency(int64_t us) { latency_us_ = us; }
   void set_cid(fid_t id) { cid_ = id; }
@@ -156,6 +161,7 @@ class Controller {
   std::string error_text_;
   IOBuf request_attachment_;
   IOBuf response_attachment_;
+  void* session_local_data_ = nullptr;
   EndPoint remote_side_;
   EndPoint local_side_;
   int64_t latency_us_ = 0;

@@ -18,6 +18,8 @@ enum RpcError {
   ELOGOFF = 2003,        // server is stopping
   ELIMIT = 2004,         // concurrency limit reached
   ECANCELEDRPC = 2005,   // StartCancel()ed by caller
+  EAUTH = 1004,          // credential verification failed
+  EREJECT = 2006,        // rejected by a server interceptor
 };
 
 // Human-readable name for the codes above; falls back to strerror.

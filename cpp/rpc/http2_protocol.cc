@@ -399,9 +399,12 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
       return;
     }
   }
-  // Shared resolution/admission ladder — identical routing to HTTP/1.1.
+  // Shared resolution/admission ladder — identical routing AND the same
+  // auth/interceptor gates as HTTP/1.1 and brt_std.
   HttpAdmission adm;
-  if (!AdmitHttpRequest(server, path, &adm)) {
+  const std::string* authz = FindHeader(st->req_headers, "authorization");
+  if (!AdmitHttpRequest(server, path, authz ? *authz : "", s->remote(),
+                        &adm)) {
     fail(adm.http_status, adm.error, adm.grpc_status);
     return;
   }

@@ -7,11 +7,21 @@
 namespace brt {
 
 bool AdmitHttpRequest(Server* server, const std::string& path,
+                      const std::string& auth, const EndPoint& remote,
                       HttpAdmission* out) {
   if (server == nullptr || !server->IsRunning()) {
     out->http_status = 503;
     out->grpc_status = 14;  // UNAVAILABLE
     out->error = "server stopped";
+    return false;
+  }
+  // Credential gate first — same order as the brt protocol: nothing is
+  // committed before the caller proves itself.
+  if (server->options().auth != nullptr &&
+      server->options().auth->VerifyCredential(auth, remote) != 0) {
+    out->http_status = 403;
+    out->grpc_status = 16;  // UNAUTHENTICATED
+    out->error = "authentication failed";
     return false;
   }
   const size_t slash = path.find('/', 1);
@@ -54,6 +64,22 @@ bool AdmitHttpRequest(Server* server, const std::string& path,
     out->grpc_status = 8;
     out->error = "method concurrency limit reached";
     return false;
+  }
+  if (server->options().interceptor) {
+    int ec = EREJECT;
+    Controller probe;
+    probe.set_remote_side(remote);
+    if (!server->options().interceptor(&probe, out->service, out->method,
+                                       &ec)) {
+      out->ms->OnResponded(ec, 0);
+      server->OnRequestDone();
+      out->ms = nullptr;
+      out->svc = nullptr;
+      out->http_status = 403;
+      out->grpc_status = 7;  // PERMISSION_DENIED
+      out->error = RpcErrorText(ec);
+      return false;
+    }
   }
   return true;
 }

@@ -7,6 +7,8 @@
 #include <cstdint>
 #include <string>
 
+#include "base/endpoint.h"
+
 namespace brt {
 
 class Server;
@@ -27,10 +29,14 @@ struct HttpAdmission {
 };
 
 // Resolves "/Service/Method" (first-slash split; a gRPC-style
-// "/pkg.Service/Method" package prefix is tolerated) and performs
-// admission: Server::OnRequestArrived + MethodStatus::OnRequested.
-// Returns false with rejection info filled in.
+// "/pkg.Service/Method" package prefix is tolerated) and performs the full
+// server-side gate: Authenticator (credential = the request's
+// Authorization header value, verbatim), Server::OnRequestArrived,
+// MethodStatus::OnRequested, and the Interceptor — the SAME policy the
+// brt_std protocol enforces, so configuring auth cannot be bypassed by
+// switching protocols. Returns false with rejection info filled in.
 bool AdmitHttpRequest(Server* server, const std::string& path,
+                      const std::string& auth, const EndPoint& remote,
                       HttpAdmission* out);
 
 // Completion accounting for an admitted request (per-method stats,

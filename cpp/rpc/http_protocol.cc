@@ -198,7 +198,9 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
   }
 
   HttpAdmission adm;
-  if (!AdmitHttpRequest(server, m.path, &adm)) {
+  const std::string* authz = m.header("authorization");
+  if (!AdmitHttpRequest(server, m.path, authz ? *authz : "",
+                        ptr->remote(), &adm)) {
     IOBuf body;
     body.append(adm.error + "\n");
     respond(adm.http_status, "text/plain", std::move(body));

@@ -95,6 +95,7 @@ void SendResponse(RpcSession* sess) {
   if (Socket::Address(sess->sock, &ptr) == 0) ptr->Write(&frame);
   if (sess->mstatus) sess->mstatus->OnResponded(meta.error_code, lat);
   if (sess->server) {
+    sess->server->ReturnSessionData(sess->cntl.session_local_data());
     sess->server->OnRequestDone();
     sess->server->OnResponseSent(meta.error_code, lat);
     sess->server->requests_processed.fetch_add(1, std::memory_order_relaxed);
@@ -123,6 +124,14 @@ void ProcessRequest(RpcMeta&& meta, IOBuf&& body, SocketId sock,
     SendErrorResponse(sock, meta.correlation_id, ELOGOFF, nullptr);
     return;
   }
+  // Credential gate (reference authenticator.h:58): verified before any
+  // resource is committed to the request.
+  if (server->options().auth != nullptr &&
+      server->options().auth->VerifyCredential(meta.auth, s->remote()) !=
+          0) {
+    SendErrorResponse(sock, meta.correlation_id, EAUTH, nullptr);
+    return;
+  }
   if (!server->OnRequestArrived()) {
     SendErrorResponse(sock, meta.correlation_id, ELIMIT, nullptr);
     return;
@@ -140,6 +149,20 @@ void ProcessRequest(RpcMeta&& meta, IOBuf&& body, SocketId sock,
     return;
   }
   auto* sess = new RpcSession;
+  // Interceptor hook (reference interceptor.h:26): may veto the call.
+  if (server->options().interceptor) {
+    int ec = EREJECT;
+    sess->cntl.set_remote_side(s->remote());
+    if (!server->options().interceptor(&sess->cntl, meta.service,
+                                       meta.method, &ec)) {
+      ms->OnResponded(ec, 0);
+      server->OnRequestDone();
+      delete sess;
+      SendErrorResponse(sock, meta.correlation_id, ec, nullptr);
+      return;
+    }
+  }
+  sess->cntl.set_session_local_data(server->BorrowSessionData());
   sess->sock = sock;
   sess->cid = meta.correlation_id;
   sess->server = server;
@@ -172,6 +195,7 @@ void ProcessRequest(RpcMeta&& meta, IOBuf&& body, SocketId sock,
     const CompressHandler* h = GetCompressHandler(meta.compress_type);
     IOBuf plain;
     if (h == nullptr || !h->decompress(body, &plain)) {
+      server->ReturnSessionData(sess->cntl.session_local_data());
       server->OnRequestDone();
       ms->OnResponded(EREQUEST, 0);
       delete sess;

@@ -60,6 +60,32 @@ int Server::Start(const EndPoint& addr, const Options* opts) {
   return 0;
 }
 
+void* Server::BorrowSessionData() {
+  const DataFactory* f = options_.session_local_data_factory;
+  if (f == nullptr) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(session_pool_mu_);
+    if (!session_pool_.empty()) {
+      void* d = session_pool_.back();
+      session_pool_.pop_back();
+      return d;
+    }
+  }
+  return f->CreateData();
+}
+
+void Server::ReturnSessionData(void* d) {
+  if (d == nullptr) return;
+  const DataFactory* f = options_.session_local_data_factory;
+  if (f == nullptr) return;
+  std::lock_guard<std::mutex> g(session_pool_mu_);
+  if (session_pool_.size() < 1024) {
+    session_pool_.push_back(d);
+  } else {
+    f->DestroyData(d);
+  }
+}
+
 int Server::Stop() {
   if (!running_.exchange(false)) return 0;
   acceptor_.StopAccept();
@@ -80,6 +106,15 @@ int Server::Stop() {
 int Server::Join() {
   while (concurrency_.load(std::memory_order_acquire) > 0) {
     fiber_usleep(10 * 1000);
+  }
+  // Session pool teardown happens AFTER the drain: in-flight requests
+  // return their data through ReturnSessionData right up to this point.
+  if (options_.session_local_data_factory != nullptr) {
+    std::lock_guard<std::mutex> g(session_pool_mu_);
+    for (void* d : session_pool_) {
+      options_.session_local_data_factory->DestroyData(d);
+    }
+    session_pool_.clear();
   }
   return 0;
 }

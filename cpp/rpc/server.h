@@ -14,6 +14,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "rpc/authenticator.h"
 #include "rpc/concurrency_limiter.h"
 #include "rpc/controller.h"
 #include "transport/acceptor.h"
@@ -30,6 +31,23 @@ class Service {
   virtual void CallMethod(const std::string& method, Controller* cntl,
                           const IOBuf& request, IOBuf* response,
                           Closure done) = 0;
+};
+
+// Server-side request interception (reference interceptor.h:26): runs
+// before the service method; returning false rejects the call with
+// *error_code (EREJECT default).
+using Interceptor =
+    std::function<bool(const Controller* cntl, const std::string& service,
+                       const std::string& method, int* error_code)>;
+
+// Per-request user data pooled across calls (reference
+// details/simple_data_pool.h + data_factory.h): CreateData once per pooled
+// slot, reused for later requests, DestroyData at server stop.
+class DataFactory {
+ public:
+  virtual ~DataFactory() = default;
+  virtual void* CreateData() const = 0;
+  virtual void DestroyData(void* d) const = 0;
 };
 
 // Per-method stats + concurrency gate (reference details/method_status.h).
@@ -63,8 +81,18 @@ class Server {
     bool usercode_in_pthread = false;
     int fiber_workers = 0;    // fiber_init hint
     // "constant" (bounded by max_concurrency), "auto" (adaptive,
-    // reference policy/auto_concurrency_limiter.cpp), "" = unlimited.
+    // reference policy/auto_concurrency_limiter.cpp), "timeout[:us]"
+    // (reject when expected queueing blows the budget), "" = unlimited.
     std::string concurrency_limiter = "constant";
+    // Request interception hook; rejection answers EREJECT (or the
+    // interceptor-chosen code) without reaching the service.
+    Interceptor interceptor;
+    // Credential verification; requests failing it answer EAUTH.
+    // Ownership stays with the caller; must outlive the server.
+    const Authenticator* auth = nullptr;
+    // Pooled per-request user data (Controller::session_local_data()).
+    // Ownership stays with the caller; must outlive the server.
+    const DataFactory* session_local_data_factory = nullptr;
   };
 
   Server() = default;
@@ -111,6 +139,12 @@ class Server {
   }
   const Options& options() const { return options_; }
 
+  // Pooled session-local data (reference simple_data_pool.h): Borrow hands
+  // out a pooled (or freshly created) datum; Return parks it for reuse.
+  // nullptr when no factory is configured.
+  void* BorrowSessionData();
+  void ReturnSessionData(void* d);
+
   // Builtin-service hook points (observability layer).
   std::atomic<uint64_t> requests_processed{0};
   int64_t start_time_us = 0;
@@ -136,6 +170,8 @@ class Server {
   std::atomic<int> concurrency_{0};
   std::atomic<bool> running_{false};
   std::unique_ptr<ConcurrencyLimiter> limiter_;
+  std::mutex session_pool_mu_;
+  std::vector<void*> session_pool_;
 };
 
 }  // namespace brt

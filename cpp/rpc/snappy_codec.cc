@@ -1,0 +1,217 @@
+#include "rpc/snappy_codec.h"
+
+#include <cstring>
+#include <vector>
+
+namespace brt {
+
+namespace {
+
+// Little-endian 32-bit load (matching is byte-oriented; x86/TPU hosts are
+// little-endian).
+inline uint32_t Load32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t HashBytes(uint32_t bytes) {
+  return (bytes * 0x1e35a7bd) >> 17;  // 15-bit table
+}
+
+constexpr size_t kHashBits = 15;
+constexpr size_t kHashSize = 1u << kHashBits;
+constexpr size_t kMaxOffset = 1u << 16;  // copies reach back at most 64KB
+
+void EmitLiteral(std::string* out, const char* p, size_t len) {
+  while (len > 0) {
+    // One tag covers up to 2^32 bytes; keep it simple with the 4-byte form
+    // only when needed.
+    const size_t n = len;
+    if (n < 60) {
+      out->push_back(char(uint8_t((n - 1) << 2)));
+    } else if (n < (1u << 8)) {
+      out->push_back(char(60 << 2));
+      out->push_back(char(uint8_t(n - 1)));
+    } else if (n < (1u << 16)) {
+      out->push_back(char(61 << 2));
+      out->push_back(char(uint8_t((n - 1))));
+      out->push_back(char(uint8_t((n - 1) >> 8)));
+    } else if (n < (1u << 24)) {
+      out->push_back(char(62 << 2));
+      out->push_back(char(uint8_t(n - 1)));
+      out->push_back(char(uint8_t((n - 1) >> 8)));
+      out->push_back(char(uint8_t((n - 1) >> 16)));
+    } else {
+      out->push_back(char(63 << 2));
+      const uint32_t m = uint32_t(n - 1);
+      out->push_back(char(uint8_t(m)));
+      out->push_back(char(uint8_t(m >> 8)));
+      out->push_back(char(uint8_t(m >> 16)));
+      out->push_back(char(uint8_t(m >> 24)));
+    }
+    out->append(p, n);
+    return;
+  }
+}
+
+// Emits copies, splitting to the encodable length ranges.
+void EmitCopy(std::string* out, size_t offset, size_t len) {
+  // 2-byte-offset form encodes len 1..64; 1-byte-offset form len 4..11
+  // with offset < 2048. Prefer the short form when it fits.
+  while (len >= 68) {
+    // max 64 per tag; leave >=4 for the tail so it stays encodable
+    out->push_back(char(uint8_t(2 | ((64 - 1) << 2))));
+    out->push_back(char(uint8_t(offset)));
+    out->push_back(char(uint8_t(offset >> 8)));
+    len -= 64;
+  }
+  if (len > 64) {
+    out->push_back(char(uint8_t(2 | ((60 - 1) << 2))));
+    out->push_back(char(uint8_t(offset)));
+    out->push_back(char(uint8_t(offset >> 8)));
+    len -= 60;
+  }
+  if (len >= 4 && len <= 11 && offset < 2048) {
+    out->push_back(char(uint8_t(1 | ((len - 4) << 2) |
+                                ((offset >> 8) << 5))));
+    out->push_back(char(uint8_t(offset)));
+  } else {
+    out->push_back(char(uint8_t(2 | ((len - 1) << 2))));
+    out->push_back(char(uint8_t(offset)));
+    out->push_back(char(uint8_t(offset >> 8)));
+  }
+}
+
+void AppendVarint(std::string* out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(char(uint8_t(v) | 0x80));
+    v >>= 7;
+  }
+  out->push_back(char(uint8_t(v)));
+}
+
+}  // namespace
+
+void SnappyCompressRaw(const char* in, size_t n, std::string* out) {
+  AppendVarint(out, n);
+  if (n == 0) return;
+  std::vector<uint16_t> table(kHashSize, 0);
+  // table stores position+1 (0 = empty); positions are taken modulo 64K
+  // windows by re-basing, so uint16 is enough with an epoch base.
+  size_t base = 0;  // positions in table are relative to base
+  size_t i = 0;
+  size_t lit_start = 0;
+  while (i + 4 <= n) {
+    if (i - base >= kMaxOffset - 1) {
+      // Re-base the window; stale entries die with the epoch.
+      base = i - 1;
+      std::fill(table.begin(), table.end(), 0);
+      table[HashBytes(Load32(in + i - 1))] = 0 + 1;  // pos (i-1)-base = 0
+    }
+    const uint32_t h = HashBytes(Load32(in + i));
+    const uint16_t cand = table[h];
+    table[h] = uint16_t(i - base + 1);
+    if (cand != 0) {
+      const size_t cpos = base + cand - 1;
+      if (cpos < i && i - cpos < kMaxOffset &&
+          Load32(in + cpos) == Load32(in + i)) {
+        // Extend the match.
+        size_t len = 4;
+        while (i + len < n && in[cpos + len] == in[i + len] && len < 1u << 20) {
+          ++len;
+        }
+        if (lit_start < i) EmitLiteral(out, in + lit_start, i - lit_start);
+        EmitCopy(out, i - cpos, len);
+        i += len;
+        lit_start = i;
+        continue;
+      }
+    }
+    ++i;
+  }
+  if (lit_start < n) EmitLiteral(out, in + lit_start, n - lit_start);
+}
+
+bool SnappyDecompressRaw(const char* in, size_t n, std::string* out) {
+  // Preamble: uncompressed length varint.
+  uint64_t ulen = 0;
+  int shift = 0;
+  size_t i = 0;
+  for (;;) {
+    if (i >= n || shift > 35) return false;
+    const uint8_t b = uint8_t(in[i++]);
+    ulen |= uint64_t(b & 0x7f) << shift;
+    shift += 7;
+    if ((b & 0x80) == 0) break;
+  }
+  if (ulen > (1ull << 32)) return false;
+  out->reserve(out->size() + size_t(ulen));
+  const size_t out_base = out->size();
+  while (i < n) {
+    const uint8_t tag = uint8_t(in[i++]);
+    const uint8_t kind = tag & 3;
+    if (kind == 0) {  // literal
+      size_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        const size_t nbytes = len - 60;
+        if (i + nbytes > n) return false;
+        len = 0;
+        for (size_t k = 0; k < nbytes; ++k) {
+          len |= size_t(uint8_t(in[i + k])) << (8 * k);
+        }
+        len += 1;
+        i += nbytes;
+      }
+      if (i + len > n) return false;
+      out->append(in + i, len);
+      i += len;
+    } else {
+      size_t len, offset;
+      if (kind == 1) {
+        if (i >= n) return false;
+        len = ((tag >> 2) & 7) + 4;
+        offset = (size_t(tag >> 5) << 8) | uint8_t(in[i++]);
+      } else if (kind == 2) {
+        if (i + 2 > n) return false;
+        len = (tag >> 2) + 1;
+        offset = uint8_t(in[i]) | (size_t(uint8_t(in[i + 1])) << 8);
+        i += 2;
+      } else {
+        if (i + 4 > n) return false;
+        len = (tag >> 2) + 1;
+        offset = uint8_t(in[i]) | (size_t(uint8_t(in[i + 1])) << 8) |
+                 (size_t(uint8_t(in[i + 2])) << 16) |
+                 (size_t(uint8_t(in[i + 3])) << 24);
+        i += 4;
+      }
+      const size_t produced = out->size() - out_base;
+      if (offset == 0 || offset > produced) return false;
+      // Byte-by-byte: copies may overlap themselves (RLE pattern).
+      size_t src = out->size() - offset;
+      for (size_t k = 0; k < len; ++k) {
+        out->push_back((*out)[src + k]);
+      }
+    }
+  }
+  return out->size() - out_base == ulen;
+}
+
+bool SnappyCompress(const IOBuf& in, IOBuf* out) {
+  const std::string src = in.to_string();
+  std::string dst;
+  dst.reserve(src.size() / 2 + 32);
+  SnappyCompressRaw(src.data(), src.size(), &dst);
+  out->append(dst);
+  return true;
+}
+
+bool SnappyDecompress(const IOBuf& in, IOBuf* out) {
+  const std::string src = in.to_string();
+  std::string dst;
+  if (!SnappyDecompressRaw(src.data(), src.size(), &dst)) return false;
+  out->append(dst);
+  return true;
+}
+
+}  // namespace brt

@@ -1,0 +1,25 @@
+// Snappy block-format codec (the format of google/snappy, implemented
+// from the public format description — varint preamble, literal/copy tags,
+// 64KB-windowed greedy matching).
+// Parity target: the reference's snappy compression option
+// (CompressTypeSnappy via butil/third_party/snappy). Redesigned: own
+// implementation, no vendored library; the compressor is hash-table greedy
+// like the original, the decompressor handles the complete tag set.
+#pragma once
+
+#include <string>
+
+#include "base/iobuf.h"
+
+namespace brt {
+
+// Compresses `in` into snappy block format appended to *out.
+bool SnappyCompress(const IOBuf& in, IOBuf* out);
+// Returns false on malformed input (bad varint/offsets/lengths).
+bool SnappyDecompress(const IOBuf& in, IOBuf* out);
+
+// Contiguous-buffer primitives (exposed for tests).
+void SnappyCompressRaw(const char* in, size_t n, std::string* out);
+bool SnappyDecompressRaw(const char* in, size_t n, std::string* out);
+
+}  // namespace brt

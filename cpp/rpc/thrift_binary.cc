@@ -1,0 +1,316 @@
+#include "rpc/thrift_binary.h"
+
+#include <cstring>
+
+namespace brt {
+
+namespace {
+
+constexpr int kMaxDepth = 32;
+constexpr uint64_t kMaxBytes = 64ull << 20;
+
+// Big-endian cursor over a contiguous snapshot of the input.
+struct Cursor {
+  const uint8_t* p;
+  size_t n;
+  size_t off = 0;
+  uint64_t budget = kMaxBytes;
+
+  bool need(size_t k) const { return off + k <= n; }
+  bool u8(uint8_t* v) {
+    if (!need(1)) return false;
+    *v = p[off++];
+    return true;
+  }
+  bool u16(uint16_t* v) {
+    if (!need(2)) return false;
+    *v = (uint16_t(p[off]) << 8) | p[off + 1];
+    off += 2;
+    return true;
+  }
+  bool u32(uint32_t* v) {
+    if (!need(4)) return false;
+    *v = (uint32_t(p[off]) << 24) | (uint32_t(p[off + 1]) << 16) |
+         (uint32_t(p[off + 2]) << 8) | p[off + 3];
+    off += 4;
+    return true;
+  }
+  bool u64(uint64_t* v) {
+    uint32_t hi, lo;
+    if (!u32(&hi) || !u32(&lo)) return false;
+    *v = (uint64_t(hi) << 32) | lo;
+    return true;
+  }
+};
+
+bool ValidType(uint8_t t) {
+  switch (TType(t)) {
+    case TType::BOOL:
+    case TType::BYTE:
+    case TType::DOUBLE:
+    case TType::I16:
+    case TType::I32:
+    case TType::I64:
+    case TType::STRING:
+    case TType::STRUCT:
+    case TType::MAP:
+    case TType::SET:
+    case TType::LIST:
+      return true;
+    default:
+      return false;
+  }
+}
+
+bool ParseValue(Cursor* c, TType t, ThriftValue* out, int depth);
+
+bool ParseStructBody(Cursor* c, ThriftValue* out, int depth) {
+  if (depth > kMaxDepth) return false;
+  out->type = TType::STRUCT;
+  for (;;) {
+    uint8_t ft;
+    if (!c->u8(&ft)) return false;
+    if (TType(ft) == TType::STOP) return true;
+    if (!ValidType(ft)) return false;
+    uint16_t fid;
+    if (!c->u16(&fid)) return false;
+    ThriftValue v;
+    if (!ParseValue(c, TType(ft), &v, depth + 1)) return false;
+    out->add_field(int16_t(fid), std::move(v));
+    if (out->fields.size() > 10000) return false;
+  }
+}
+
+bool ParseValue(Cursor* c, TType t, ThriftValue* out, int depth) {
+  if (depth > kMaxDepth) return false;
+  out->type = t;
+  switch (t) {
+    case TType::BOOL: {
+      uint8_t v;
+      if (!c->u8(&v)) return false;
+      out->b = v != 0;
+      return true;
+    }
+    case TType::BYTE: {
+      uint8_t v;
+      if (!c->u8(&v)) return false;
+      out->i = int8_t(v);
+      return true;
+    }
+    case TType::I16: {
+      uint16_t v;
+      if (!c->u16(&v)) return false;
+      out->i = int16_t(v);
+      return true;
+    }
+    case TType::I32: {
+      uint32_t v;
+      if (!c->u32(&v)) return false;
+      out->i = int32_t(v);
+      return true;
+    }
+    case TType::I64: {
+      uint64_t v;
+      if (!c->u64(&v)) return false;
+      out->i = int64_t(v);
+      return true;
+    }
+    case TType::DOUBLE: {
+      uint64_t v;
+      if (!c->u64(&v)) return false;
+      memcpy(&out->d, &v, 8);
+      return true;
+    }
+    case TType::STRING: {
+      uint32_t len;
+      if (!c->u32(&len)) return false;
+      if (len > c->budget || !c->need(len)) return false;
+      c->budget -= len;
+      out->str.assign(reinterpret_cast<const char*>(c->p + c->off), len);
+      c->off += len;
+      return true;
+    }
+    case TType::STRUCT:
+      return ParseStructBody(c, out, depth + 1);
+    case TType::LIST:
+    case TType::SET: {
+      uint8_t et;
+      uint32_t count;
+      if (!c->u8(&et) || !c->u32(&count)) return false;
+      if (!ValidType(et) || count > c->budget) return false;
+      out->elem_type = TType(et);
+      out->elems.reserve(count < 4096 ? count : 4096);
+      for (uint32_t i = 0; i < count; ++i) {
+        ThriftValue e;
+        if (!ParseValue(c, TType(et), &e, depth + 1)) return false;
+        out->elems.push_back(std::move(e));
+      }
+      return true;
+    }
+    case TType::MAP: {
+      uint8_t kt, vt;
+      uint32_t count;
+      if (!c->u8(&kt) || !c->u8(&vt) || !c->u32(&count)) return false;
+      if (!ValidType(kt) || !ValidType(vt) || count > c->budget) {
+        return false;
+      }
+      out->key_type = TType(kt);
+      out->val_type = TType(vt);
+      out->kvs.reserve(count < 4096 ? count : 4096);
+      for (uint32_t i = 0; i < count; ++i) {
+        ThriftValue k, v;
+        if (!ParseValue(c, TType(kt), &k, depth + 1)) return false;
+        if (!ParseValue(c, TType(vt), &v, depth + 1)) return false;
+        out->kvs.emplace_back(std::move(k), std::move(v));
+      }
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+void PutU16(std::string* s, uint16_t v) {
+  s->push_back(char(v >> 8));
+  s->push_back(char(v));
+}
+void PutU32(std::string* s, uint32_t v) {
+  s->push_back(char(v >> 24));
+  s->push_back(char(v >> 16));
+  s->push_back(char(v >> 8));
+  s->push_back(char(v));
+}
+void PutU64(std::string* s, uint64_t v) {
+  PutU32(s, uint32_t(v >> 32));
+  PutU32(s, uint32_t(v));
+}
+
+bool SerializeValue(const ThriftValue& v, std::string* out, int depth);
+
+bool SerializeStructBody(const ThriftValue& v, std::string* out,
+                         int depth) {
+  if (depth > kMaxDepth) return false;
+  for (const auto& [fid, fv] : v.fields) {
+    out->push_back(char(fv.type));
+    PutU16(out, uint16_t(fid));
+    if (!SerializeValue(fv, out, depth + 1)) return false;
+  }
+  out->push_back(char(TType::STOP));
+  return true;
+}
+
+bool SerializeValue(const ThriftValue& v, std::string* out, int depth) {
+  if (depth > kMaxDepth) return false;
+  switch (v.type) {
+    case TType::BOOL:
+      out->push_back(v.b ? 1 : 0);
+      return true;
+    case TType::BYTE:
+      out->push_back(char(int8_t(v.i)));
+      return true;
+    case TType::I16:
+      PutU16(out, uint16_t(int16_t(v.i)));
+      return true;
+    case TType::I32:
+      PutU32(out, uint32_t(int32_t(v.i)));
+      return true;
+    case TType::I64:
+      PutU64(out, uint64_t(v.i));
+      return true;
+    case TType::DOUBLE: {
+      uint64_t bits;
+      memcpy(&bits, &v.d, 8);
+      PutU64(out, bits);
+      return true;
+    }
+    case TType::STRING:
+      PutU32(out, uint32_t(v.str.size()));
+      out->append(v.str);
+      return true;
+    case TType::STRUCT:
+      return SerializeStructBody(v, out, depth + 1);
+    case TType::LIST:
+    case TType::SET:
+      out->push_back(char(v.elem_type));
+      PutU32(out, uint32_t(v.elems.size()));
+      for (const ThriftValue& e : v.elems) {
+        if (e.type != v.elem_type) return false;
+        if (!SerializeValue(e, out, depth + 1)) return false;
+      }
+      return true;
+    case TType::MAP:
+      out->push_back(char(v.key_type));
+      out->push_back(char(v.val_type));
+      PutU32(out, uint32_t(v.kvs.size()));
+      for (const auto& [k, val] : v.kvs) {
+        if (k.type != v.key_type || val.type != v.val_type) return false;
+        if (!SerializeValue(k, out, depth + 1)) return false;
+        if (!SerializeValue(val, out, depth + 1)) return false;
+      }
+      return true;
+    default:
+      return false;
+  }
+}
+
+}  // namespace
+
+ThriftValue ThriftValue::Bool(bool v) {
+  ThriftValue t;
+  t.type = TType::BOOL;
+  t.b = v;
+  return t;
+}
+ThriftValue ThriftValue::I32(int32_t v) {
+  ThriftValue t;
+  t.type = TType::I32;
+  t.i = v;
+  return t;
+}
+ThriftValue ThriftValue::I64(int64_t v) {
+  ThriftValue t;
+  t.type = TType::I64;
+  t.i = v;
+  return t;
+}
+ThriftValue ThriftValue::Double(double v) {
+  ThriftValue t;
+  t.type = TType::DOUBLE;
+  t.d = v;
+  return t;
+}
+ThriftValue ThriftValue::String(std::string v) {
+  ThriftValue t;
+  t.type = TType::STRING;
+  t.str = std::move(v);
+  return t;
+}
+ThriftValue ThriftValue::Struct() {
+  ThriftValue t;
+  t.type = TType::STRUCT;
+  return t;
+}
+ThriftValue ThriftValue::List(TType elem) {
+  ThriftValue t;
+  t.type = TType::LIST;
+  t.elem_type = elem;
+  return t;
+}
+
+ssize_t ThriftParseStruct(const IOBuf& in, ThriftValue* out) {
+  if (in.size() > kMaxBytes) return -1;
+  const std::string snap = in.to_string();
+  Cursor c{reinterpret_cast<const uint8_t*>(snap.data()), snap.size()};
+  if (!ParseStructBody(&c, out, 0)) return -1;
+  return ssize_t(c.off);
+}
+
+bool ThriftSerializeStruct(const ThriftValue& v, IOBuf* out) {
+  if (v.type != TType::STRUCT) return false;
+  std::string s;
+  if (!SerializeStructBody(v, &s, 0)) return false;
+  out->append(s);
+  return true;
+}
+
+}  // namespace brt

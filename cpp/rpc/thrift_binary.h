@@ -1,0 +1,79 @@
+// Thrift TBinaryProtocol struct codec: a self-describing value model so
+// handlers can decode/encode REAL thrift structs without generated code.
+// Parity target: reference policy/thrift_protocol.cpp:766 (native struct
+// (de)serialization through TBinary). Redesigned: instead of binding to
+// ::apache::thrift generated types, values parse into a small DOM
+// (ThriftValue) mirroring the wire model — field-id-tagged structs,
+// containers, scalars — which is also what an IDL-free framework can
+// round-trip losslessly.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "base/iobuf.h"
+
+namespace brt {
+
+enum class TType : uint8_t {
+  STOP = 0,
+  BOOL = 2,
+  BYTE = 3,
+  DOUBLE = 4,
+  I16 = 6,
+  I32 = 8,
+  I64 = 10,
+  STRING = 11,
+  STRUCT = 12,
+  MAP = 13,
+  SET = 14,
+  LIST = 15,
+};
+
+struct ThriftValue {
+  TType type = TType::STOP;
+  bool b = false;
+  int64_t i = 0;       // BYTE/I16/I32/I64
+  double d = 0.0;
+  std::string str;     // STRING/BINARY
+  // STRUCT: (field id, value), wire order preserved.
+  std::vector<std::pair<int16_t, ThriftValue>> fields;
+  // LIST/SET: elements (elem_type tracks the declared element type).
+  std::vector<ThriftValue> elems;
+  TType elem_type = TType::STOP;
+  // MAP: key/value pairs + declared types.
+  std::vector<std::pair<ThriftValue, ThriftValue>> kvs;
+  TType key_type = TType::STOP;
+  TType val_type = TType::STOP;
+
+  // Struct conveniences.
+  const ThriftValue* field(int16_t id) const {
+    for (const auto& [fid, v] : fields) {
+      if (fid == id) return &v;
+    }
+    return nullptr;
+  }
+  void add_field(int16_t id, ThriftValue v) {
+    fields.emplace_back(id, std::move(v));
+  }
+
+  static ThriftValue Bool(bool v);
+  static ThriftValue I32(int32_t v);
+  static ThriftValue I64(int64_t v);
+  static ThriftValue Double(double v);
+  static ThriftValue String(std::string v);
+  static ThriftValue Struct();
+  static ThriftValue List(TType elem);
+};
+
+// Parses one STRUCT (field sequence terminated by STOP) from the start of
+// `in`. Returns consumed bytes, or -1 on malformed/oversized input.
+// Bounds: depth <= 32, strings/containers <= 64MB total.
+ssize_t ThriftParseStruct(const IOBuf& in, ThriftValue* out);
+
+// Serializes a STRUCT value in TBinary wire format.
+bool ThriftSerializeStruct(const ThriftValue& v, IOBuf* out);
+
+}  // namespace brt

@@ -1,0 +1,337 @@
+// Protocol-partial parity tests: snappy codec, streamed zlib, thrift
+// TBinary struct codec, timeout concurrency limiter, interceptor /
+// authenticator / session-local data hooks.
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "rpc/channel.h"
+#include "rpc/compress.h"
+#include "rpc/concurrency_limiter.h"
+#include "rpc/errors.h"
+#include "rpc/server.h"
+#include "rpc/snappy_codec.h"
+#include "rpc/thrift.h"
+#include "rpc/thrift_binary.h"
+
+using namespace brt;
+
+static void test_snappy() {
+  // Repetitive data compresses well and round-trips.
+  std::string rep;
+  for (int i = 0; i < 1000; ++i) rep += "abcdefgh";
+  std::string comp;
+  SnappyCompressRaw(rep.data(), rep.size(), &comp);
+  assert(comp.size() < rep.size() / 4);
+  std::string back;
+  assert(SnappyDecompressRaw(comp.data(), comp.size(), &back));
+  assert(back == rep);
+
+  // Random-ish data still round-trips (mostly literals).
+  std::string rnd;
+  uint32_t x = 123456789;
+  for (int i = 0; i < 10000; ++i) {
+    x = x * 1664525u + 1013904223u;
+    rnd.push_back(char(x >> 24));
+  }
+  comp.clear();
+  back.clear();
+  SnappyCompressRaw(rnd.data(), rnd.size(), &comp);
+  assert(SnappyDecompressRaw(comp.data(), comp.size(), &back));
+  assert(back == rnd);
+
+  // Empty input.
+  comp.clear();
+  back.clear();
+  SnappyCompressRaw("", 0, &comp);
+  assert(SnappyDecompressRaw(comp.data(), comp.size(), &back));
+  assert(back.empty());
+
+  // Overlapping copies (RLE): 1 literal + self-overlapping copy.
+  std::string rle(5000, 'z');
+  comp.clear();
+  back.clear();
+  SnappyCompressRaw(rle.data(), rle.size(), &comp);
+  assert(comp.size() < 400);
+  assert(SnappyDecompressRaw(comp.data(), comp.size(), &back));
+  assert(back == rle);
+
+  // Malformed: bad offset must be rejected, not crash.
+  const char evil[] = {8, 0x02, 0x50, 0x00};  // copy with offset 0x50 > produced
+  back.clear();
+  assert(!SnappyDecompressRaw(evil, sizeof(evil), &back));
+  printf("snappy OK (%zu -> %zu on repetitive)\n", rep.size(),
+         size_t(0));
+
+  // Through the registry with IOBufs.
+  const CompressHandler* h = GetCompressHandler(COMPRESS_SNAPPY);
+  assert(h != nullptr);
+  IOBuf in, packed, out;
+  in.append(rep);
+  assert(h->compress(in, &packed));
+  assert(h->decompress(packed, &out));
+  assert(out.equals(rep));
+  printf("snappy registry OK\n");
+}
+
+static void test_zlib_multiblock() {
+  // Multi-block input exercises the streaming (block-by-block) deflate.
+  IOBuf in;
+  std::string blob(100000, 'q');
+  for (int i = 0; i < 5; ++i) in.append(blob);
+  assert(in.block_count() > 1);
+  const CompressHandler* h = GetCompressHandler(COMPRESS_ZLIB);
+  IOBuf packed, out;
+  assert(h->compress(in, &packed));
+  assert(packed.size() < in.size() / 10);
+  assert(h->decompress(packed, &out));
+  assert(out.size() == in.size());
+  assert(out.equals(in.to_string()));
+  // Truncated stream rejected.
+  IOBuf trunc, sink;
+  std::string ps = packed.to_string();
+  trunc.append(ps.data(), ps.size() / 2);
+  assert(!h->decompress(trunc, &sink));
+  printf("zlib streaming OK (%zu -> %zu)\n", in.size(), packed.size());
+}
+
+static void test_thrift_struct_codec() {
+  ThriftValue s = ThriftValue::Struct();
+  s.add_field(1, ThriftValue::String("hello thrift"));
+  s.add_field(2, ThriftValue::I32(-12345));
+  s.add_field(3, ThriftValue::I64(1ll << 40));
+  s.add_field(4, ThriftValue::Bool(true));
+  s.add_field(5, ThriftValue::Double(3.25));
+  ThriftValue lst = ThriftValue::List(TType::I32);
+  for (int i = 0; i < 3; ++i) lst.elems.push_back(ThriftValue::I32(i * 7));
+  s.add_field(6, std::move(lst));
+  ThriftValue inner = ThriftValue::Struct();
+  inner.add_field(1, ThriftValue::String("nested"));
+  s.add_field(7, std::move(inner));
+  ThriftValue m;
+  m.type = TType::MAP;
+  m.key_type = TType::STRING;
+  m.val_type = TType::I64;
+  m.kvs.emplace_back(ThriftValue::String("k"), ThriftValue::I64(9));
+  s.add_field(8, std::move(m));
+
+  IOBuf wire;
+  assert(ThriftSerializeStruct(s, &wire));
+  ThriftValue back;
+  assert(ThriftParseStruct(wire, &back) == ssize_t(wire.size()));
+  assert(back.field(1)->str == "hello thrift");
+  assert(back.field(2)->i == -12345);
+  assert(back.field(3)->i == (1ll << 40));
+  assert(back.field(4)->b);
+  assert(back.field(5)->d == 3.25);
+  assert(back.field(6)->elems.size() == 3 &&
+         back.field(6)->elems[2].i == 14);
+  assert(back.field(7)->field(1)->str == "nested");
+  assert(back.field(8)->kvs.size() == 1 &&
+         back.field(8)->kvs[0].second.i == 9);
+
+  // Truncated input is rejected.
+  IOBuf cut;
+  std::string w = wire.to_string();
+  cut.append(w.data(), w.size() - 3);
+  ThriftValue sink;
+  assert(ThriftParseStruct(cut, &sink) == -1);
+  printf("thrift struct codec OK (%zu wire bytes)\n", wire.size());
+}
+
+// Thrift RPC carrying REAL struct payloads end-to-end: the handler decodes
+// the args struct with the codec and answers a result struct.
+static void test_thrift_rpc_with_structs() {
+  Server server;
+  ThriftService tsvc([](const std::string& method, const IOBuf& args,
+                        IOBuf* result) {
+    ThriftValue in;
+    if (ThriftParseStruct(args, &in) < 0) return false;
+    const ThriftValue* msg = in.field(1);
+    if (msg == nullptr || method != "Shout") return false;
+    std::string up = msg->str;
+    for (char& c : up) c = char(toupper(c));
+    ThriftValue out = ThriftValue::Struct();
+    out.add_field(0, ThriftValue::String(up));  // field 0 = "success"
+    return ThriftSerializeStruct(out, result);
+  });
+  ServeThriftOn(&server, &tsvc);
+  assert(server.Start("127.0.0.1:0") == 0);
+
+  ThriftClient cli;
+  assert(cli.Init(server.listen_address()) == 0);
+  ThriftValue args = ThriftValue::Struct();
+  args.add_field(1, ThriftValue::String("whisper"));
+  IOBuf args_buf;
+  assert(ThriftSerializeStruct(args, &args_buf));
+  ThriftReply r = cli.Call("Shout", args_buf);
+  assert(r.ok);
+  ThriftValue res;
+  assert(ThriftParseStruct(r.result, &res) >= 0);
+  assert(res.field(0)->str == "WHISPER");
+  server.Stop();
+  server.Join();
+  printf("thrift rpc with struct payloads OK\n");
+}
+
+static void test_timeout_limiter() {
+  auto lim = CreateConcurrencyLimiter("timeout:10000", 0);  // 10ms budget
+  assert(lim != nullptr);
+  // Teach it ~1ms latency.
+  for (int i = 0; i < 100; ++i) lim->OnResponded(0, 1000);
+  assert(lim->OnRequested(5));     // 5 * 1ms = 5ms < 10ms
+  assert(!lim->OnRequested(50));   // 50ms expected wait: reject
+  assert(lim->OnRequested(2));     // under min_limit always admitted
+  printf("timeout limiter OK (max=%d)\n", lim->max_concurrency());
+}
+
+class HmacishAuth : public Authenticator {
+ public:
+  int GenerateCredential(std::string* auth) const override {
+    *auth = "token-42";
+    return 0;
+  }
+  int VerifyCredential(const std::string& auth,
+                       const EndPoint&) const override {
+    return auth == "token-42" ? 0 : -1;
+  }
+};
+
+struct SessionDatum {
+  int canary = 7;
+};
+
+class CountingFactory : public DataFactory {
+ public:
+  void* CreateData() const override {
+    ++creations;
+    return new SessionDatum;
+  }
+  void DestroyData(void* d) const override {
+    delete static_cast<SessionDatum*>(d);
+  }
+  mutable int creations = 0;
+};
+
+class HookEchoService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    (void)method;
+    // Session-local data is pooled and usable.
+    auto* d = static_cast<SessionDatum*>(cntl->session_local_data());
+    assert(d != nullptr && d->canary == 7);
+    response->append(request);
+    done();
+  }
+};
+
+static void test_hooks() {
+  Server server;
+  HookEchoService svc;
+  HmacishAuth auth;
+  CountingFactory factory;
+  assert(server.AddService(&svc, "Echo") == 0);
+  Server::Options opts;
+  opts.auth = &auth;
+  opts.session_local_data_factory = &factory;
+  int intercepted = 0;
+  opts.interceptor = [&intercepted](const Controller*, const std::string&,
+                                    const std::string& method, int* ec) {
+    if (method == "Forbidden") {
+      *ec = EREJECT;
+      return false;
+    }
+    ++intercepted;
+    return true;
+  };
+  assert(server.Start("127.0.0.1:0", &opts) == 0);
+
+  // Authenticated channel: calls pass.
+  ChannelOptions copts;
+  copts.auth = &auth;
+  Channel ch;
+  assert(ch.Init(server.listen_address(), &copts) == 0);
+  for (int i = 0; i < 4; ++i) {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("authed");
+    ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed());
+    assert(rsp.equals("authed"));
+  }
+  assert(intercepted == 4);
+  // Session data pooled: far fewer creations than calls.
+  assert(factory.creations >= 1 && factory.creations <= 2);
+
+  // Interceptor veto.
+  {
+    Controller cntl;
+    IOBuf req, rsp;
+    ch.CallMethod("Echo", "Forbidden", &cntl, req, &rsp, nullptr);
+    assert(cntl.Failed());
+    assert(cntl.ErrorCode() == EREJECT);
+  }
+
+  // Unauthenticated channel: EAUTH.
+  {
+    Channel bare;
+    assert(bare.Init(server.listen_address()) == 0);
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("nope");
+    bare.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+    assert(cntl.Failed());
+    assert(cntl.ErrorCode() == EAUTH);
+  }
+  server.Stop();
+  server.Join();
+  printf("interceptor/authenticator/session-data OK\n");
+}
+
+// Snappy-compressed RPC end-to-end over the wire.
+class PlainEcho : public Service {
+ public:
+  void CallMethod(const std::string&, Controller*, const IOBuf& request,
+                  IOBuf* response, Closure done) override {
+    response->append(request);
+    done();
+  }
+};
+
+static void test_snappy_rpc() {
+  Server server;
+  PlainEcho svc;
+  assert(server.AddService(&svc, "Echo") == 0);
+  assert(server.Start("127.0.0.1:0") == 0);
+  Channel ch;
+  assert(ch.Init(server.listen_address()) == 0);
+  Controller cntl;
+  cntl.request_compress_type = COMPRESS_SNAPPY;
+  IOBuf req, rsp;
+  std::string body;
+  for (int i = 0; i < 500; ++i) body += "snappy over the wire ";
+  req.append(body);
+  ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  assert(rsp.equals(body));
+  server.Stop();
+  server.Join();
+  printf("snappy rpc OK\n");
+}
+
+int main() {
+  fiber_init(4);
+  test_snappy();
+  test_zlib_multiblock();
+  test_thrift_struct_codec();
+  test_thrift_rpc_with_structs();
+  test_timeout_limiter();
+  test_hooks();
+  test_snappy_rpc();
+  printf("ALL protocol-extras tests OK\n");
+  return 0;
+}
