@@ -27,6 +27,11 @@ def ensure_built() -> str:
             ["cmake", "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release", ".."],
             cwd=BUILD, check=True, capture_output=True,
         )
+    else:
+        # Re-run cmake: the build uses file globs, so an existing ninja file
+        # would silently miss sources added since it was generated.
+        subprocess.run(["cmake", "."], cwd=BUILD, check=True,
+                       capture_output=True)
     subprocess.run(["ninja", "echo_bench"], cwd=BUILD, check=True,
                    capture_output=True)
     return bench

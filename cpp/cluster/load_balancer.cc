@@ -283,12 +283,28 @@ class LocalityAwareLB : public LoadBalancer {
 
   void Feedback(const EndPoint& server, int64_t latency_us,
                 int error_code) override {
-    std::shared_ptr<NodeStat> st;
-    {
+    // Call-end hot path: NO mutex (reference locality_aware_load_balancer
+    // keeps feedback lock-free the same way) — stats are reached through
+    // the wait-free DoublyBufferedData read, like SelectServer.
+    DoublyBufferedData<LaList>::ScopedPtr p;
+    dbd_.Read(&p);
+    NodeStat* st = nullptr;
+    std::shared_ptr<NodeStat> held;
+    for (size_t i = 0; i < p->list.size(); ++i) {
+      if (p->list[i].ep == server) {
+        st = p->stats[i].get();
+        break;
+      }
+    }
+    if (st == nullptr) {
+      // Node removed mid-flight (reconfig window, rare): fall back to the
+      // persistent pool under its mutex so the inflight decrement is never
+      // lost — the same NodeStat is re-attached if the node comes back.
       std::lock_guard<std::mutex> g(stat_mu_);
       auto it = stat_pool_.find((uint64_t(server.ip) << 16) | server.port);
       if (it == stat_pool_.end()) return;
-      st = it->second;
+      held = it->second;
+      st = held.get();
     }
     st->inflight.fetch_sub(1, std::memory_order_relaxed);
     if (error_code == 0) {

@@ -6,6 +6,7 @@
 #include <unistd.h>
 
 #include <mutex>
+#include <vector>
 
 #include "base/logging.h"
 #include "base/time.h"
@@ -68,9 +69,68 @@ struct Butex {
   }
 };
 
-Butex* butex_create() { return new Butex(); }
+// Butex memory is POOLED AND NEVER FREED (reference: butex slots come from
+// ResourcePool, butex.cpp). The lifetime hazard this kills: a fast-path
+// waiter (e.g. CountdownEvent::wait seeing value<=0 via the atomic) may
+// destroy the butex while the signaller is still inside butex_wake_all —
+// with pooled slots the straggler touches valid memory and at worst
+// produces a spurious wake, which every waiter tolerates by re-checking
+// its predicate in a loop.
+namespace {
+// Leaked (mutex and list): detached workers create/destroy butexes right
+// up to process exit; static-by-value globals would be destroyed under
+// them (glibc double-free at exit).
+std::mutex& g_butex_pool_mu = *new std::mutex();
+std::vector<Butex*>& g_butex_pool = *new std::vector<Butex*>();
 
-void butex_destroy(Butex* b) { delete b; }
+// Per-thread cache in front of the global list: butex create/destroy sits
+// on sync-primitive construction hot paths (every FiberMutex/CountdownEvent
+// /event-wait ctor), and a single global mutex there would serialize all
+// workers (the reference's ResourcePool uses thread-local free lists for
+// the same reason). TRIVIALLY DESTRUCTIBLE on purpose: static destructors
+// (global FiberMutex members etc.) call butex_destroy AFTER the main
+// thread's TLS destructors have run — a vector here would already be dead.
+// Cost: up to 32 butexes leak per exited thread (workers never exit).
+constexpr size_t kButexCacheMax = 32;
+struct ButexCache {
+  Butex* items[kButexCacheMax];
+  size_t count = 0;
+};
+thread_local ButexCache t_butex_cache;
+
+}  // namespace
+
+Butex* butex_create() {
+  ButexCache& cache = t_butex_cache;
+  if (cache.count > 0) {
+    Butex* b = cache.items[--cache.count];
+    b->value.store(0, std::memory_order_relaxed);
+    return b;
+  }
+  {
+    std::lock_guard<std::mutex> g(g_butex_pool_mu);
+    if (!g_butex_pool.empty()) {
+      Butex* b = g_butex_pool.back();
+      g_butex_pool.pop_back();
+      b->value.store(0, std::memory_order_relaxed);
+      return b;
+    }
+  }
+  return new Butex();
+}
+
+void butex_destroy(Butex* b) {
+  // Caller contract: no waiter is still in the ring (joining/waking has
+  // completed); stragglers inside wake paths are the case pooling exists
+  // for.
+  ButexCache& cache = t_butex_cache;
+  if (cache.count < kButexCacheMax) {
+    cache.items[cache.count++] = b;
+    return;
+  }
+  std::lock_guard<std::mutex> g(g_butex_pool_mu);
+  g_butex_pool.push_back(b);
+}
 
 std::atomic<int>& butex_value(Butex* b) { return b->value; }
 

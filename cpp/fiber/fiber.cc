@@ -11,6 +11,7 @@
 #include <thread>
 
 #include "base/logging.h"
+#include "base/time.h"
 #include "fiber/fiber_internal.h"
 
 namespace brt {
@@ -20,8 +21,10 @@ thread_local TaskGroup* tls_task_group = nullptr;
 // ---------------- TaskMetaPool ----------------
 
 TaskMetaPool& TaskMetaPool::get() {
-  static TaskMetaPool pool;
-  return pool;
+  // Leaked: detached workers recycle fibers right up to process exit; a
+  // static-by-value pool would be destroyed under them (TSan-caught).
+  static auto* pool = new TaskMetaPool;
+  return *pool;
 }
 
 TaskMetaPool::TaskMetaPool()
@@ -101,15 +104,19 @@ static long sys_futex(std::atomic<int>* addr, int op, int val) {
 }
 
 void ParkingLot::signal(int nwake) {
-  word_.fetch_add(1, std::memory_order_release);
-  if (parked_.load(std::memory_order_acquire) > 0) {
+  // seq_cst Dekker pairing with wait(): the word_ bump must be globally
+  // ordered before the parked_ read, and the waiter's parked_ bump before
+  // its word_ read — with weaker orders both sides can miss and the wake
+  // is lost (x86's locked RMWs hide this; TSan and ARM do not).
+  word_.fetch_add(1, std::memory_order_seq_cst);
+  if (parked_.load(std::memory_order_seq_cst) > 0) {
     sys_futex(&word_, FUTEX_WAKE_PRIVATE, nwake);
   }
 }
 
 void ParkingLot::wait(int expected) {
-  parked_.fetch_add(1, std::memory_order_acq_rel);
-  if (word_.load(std::memory_order_acquire) == expected) {
+  parked_.fetch_add(1, std::memory_order_seq_cst);
+  if (word_.load(std::memory_order_seq_cst) == expected) {
     sys_futex(&word_, FUTEX_WAIT_PRIVATE, expected);
   }
   parked_.fetch_sub(1, std::memory_order_acq_rel);
@@ -260,6 +267,9 @@ bool TaskGroup::wait_task(fiber_t* out) {
 void TaskGroup::run_main_loop() {
   tls_task_group = this;
   cur_meta_ = &main_meta_;
+#ifdef BRT_TSAN_FIBERS
+  main_meta_.tsan_fiber = __tsan_get_current_fiber();
+#endif
   fiber_t tid;
   for (;;) {
     if (!wait_task(&tid)) break;
@@ -281,6 +291,12 @@ static void cleanup_terminated(void* arg) {
     m->has_stack = false;
   }
   m->ctx_sp = nullptr;
+#ifdef BRT_TSAN_FIBERS
+  if (m->tsan_fiber != nullptr) {
+    __tsan_destroy_fiber(m->tsan_fiber);
+    m->tsan_fiber = nullptr;
+  }
+#endif
   TaskMetaPool::get().release(m);
 }
 
@@ -324,11 +340,19 @@ void TaskGroup::sched_to(TaskMeta* next) {
     }
     next->ctx_sp = make_context(next->stack.base, next->stack.size,
                                 &TaskGroup::task_runner);
+#ifdef BRT_TSAN_FIBERS
+    if (next->tsan_fiber == nullptr) {
+      next->tsan_fiber = __tsan_create_fiber(0);
+    }
+#endif
   }
   cur_meta_ = next;
   // The profiler's sampler drops ticks landing inside the raw stack
   // switch (it would unwind a half-switched frame).
   t_in_context_switch = 1;
+#ifdef BRT_TSAN_FIBERS
+  __tsan_switch_to_fiber(next->tsan_fiber, 0);
+#endif
   brt_jump_context(&cur->ctx_sp, next->ctx_sp, this);
   t_in_context_switch = 0;
   // 'cur' resumed — possibly on a different worker.
@@ -498,10 +522,18 @@ int fiber_usleep(int64_t us) {
     return 0;
   }
   if (m->stop_requested.load(std::memory_order_acquire)) return EINTR;
-  int val = butex_value(m->sleep_butex).load(std::memory_order_acquire);
-  int rc = butex_wait(m->sleep_butex, val, us);
-  if (m->stop_requested.load(std::memory_order_acquire)) return EINTR;
-  return rc == ETIMEDOUT ? 0 : rc;
+  // Loop to the absolute deadline: pooled butexes can deliver spurious
+  // wakes from stragglers of a prior life (butex.cc pooling note), and a
+  // sleep must not be silently shortened by one.
+  const int64_t deadline = monotonic_us() + us;
+  for (;;) {
+    const int64_t left = deadline - monotonic_us();
+    if (left <= 0) return 0;
+    int val = butex_value(m->sleep_butex).load(std::memory_order_acquire);
+    int rc = butex_wait(m->sleep_butex, val, left);
+    if (m->stop_requested.load(std::memory_order_acquire)) return EINTR;
+    if (rc == ETIMEDOUT) return 0;
+  }
 }
 
 int fiber_stop(fiber_t tid) {

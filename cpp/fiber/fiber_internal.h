@@ -20,6 +20,19 @@ class TaskControl;
 
 struct KeyTable;  // fiber-local storage (keys.cc)
 
+// TSan needs to be told about stack switches (it keeps per-"fiber" shadow
+// state); without these annotations a TSan build wedges on the first raw
+// context jump. Zero-cost in normal builds.
+#if defined(__SANITIZE_THREAD__)
+#define BRT_TSAN_FIBERS 1
+extern "C" {
+void* __tsan_get_current_fiber(void);
+void* __tsan_create_fiber(unsigned flags);
+void __tsan_destroy_fiber(void* fiber);
+void __tsan_switch_to_fiber(void* fiber, unsigned flags);
+}
+#endif
+
 struct TaskMeta {
   void* (*fn)(void*) = nullptr;
   void* arg = nullptr;
@@ -30,6 +43,9 @@ struct TaskMeta {
   StackType stack_type = StackType::NORMAL;
   int tag = 0;                  // worker-tag partition this fiber runs in
   KeyTable* key_table = nullptr;  // lazily created; dtors run at exit
+#ifdef BRT_TSAN_FIBERS
+  void* tsan_fiber = nullptr;
+#endif
   uint32_t index = 0;           // slot index in the meta pool
   std::atomic<uint32_t> version{0};  // odd = live (id ABA guard)
   Butex* join_butex = nullptr;  // value := version; bumped at termination

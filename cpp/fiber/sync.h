@@ -46,9 +46,11 @@ class FiberMutex {
   }
 
   void unlock() {
-    auto& v = butex_value(b_);
-    int prev = v.exchange(0, std::memory_order_release);
-    if (prev == 2) butex_wake(b_);
+    // Cache b_: the exchange releases the lock, and a racing acquirer may
+    // destroy this mutex before our wake — the pooled butex stays valid.
+    Butex* b = b_;
+    int prev = butex_value(b).exchange(0, std::memory_order_release);
+    if (prev == 2) butex_wake(b);
   }
 
   Butex* butex() { return b_; }
@@ -72,13 +74,15 @@ class FiberCond {
   }
 
   void notify_one() {
-    butex_value(b_).fetch_add(1, std::memory_order_release);
-    butex_wake(b_);
+    Butex* b = b_;  // see FiberMutex::unlock — `this` may die mid-notify
+    butex_value(b).fetch_add(1, std::memory_order_release);
+    butex_wake(b);
   }
 
   void notify_all() {
-    butex_value(b_).fetch_add(1, std::memory_order_release);
-    butex_wake_all(b_);
+    Butex* b = b_;
+    butex_value(b).fetch_add(1, std::memory_order_release);
+    butex_wake_all(b);
   }
 
  private:
@@ -143,9 +147,12 @@ class CountdownEvent {
   ~CountdownEvent() { butex_destroy(b_); }
 
   void signal(int n = 1) {
-    auto& v = butex_value(b_);
-    int prev = v.fetch_sub(n, std::memory_order_acq_rel);
-    if (prev - n <= 0) butex_wake_all(b_);
+    // `this` may be destroyed by the woken waiter the instant the count
+    // hits zero (fast-path wait returns on the atomic alone): no member
+    // access after the fetch_sub. The pooled butex outlives us safely.
+    Butex* b = b_;
+    int prev = butex_value(b).fetch_sub(n, std::memory_order_acq_rel);
+    if (prev - n <= 0) butex_wake_all(b);
   }
 
   void add_count(int n = 1) {

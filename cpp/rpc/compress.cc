@@ -85,6 +85,17 @@ bool ZlibDecompress(const IOBuf& in, IOBuf* out) {
       const int rc = inflate(&zs, Z_NO_FLUSH);
       if (rc == Z_STREAM_END) {
         done = true;
+      } else if (rc == Z_BUF_ERROR) {
+        // Non-fatal "need more input": happens when a block's input runs
+        // out exactly as a 16KB chunk fills — advance to the next block.
+        const size_t got0 = kZChunk - zs.avail_out;
+        produced += got0;
+        if (produced > orig) {
+          ok = false;
+        } else {
+          out->append(chunk, got0);
+        }
+        break;
       } else if (rc != Z_OK) {
         ok = false;
         break;

@@ -57,7 +57,7 @@ void Controller::Reset() {
   latency_us_ = 0;
   retried_ = 0;
   backup_fired_ = false;
-  cid_ = 0;
+  cid_.store(0, std::memory_order_release);
   call = Call();
   trace_id = span_id = parent_span_id = 0;
 }
@@ -142,7 +142,7 @@ void Controller::OnResponse(RpcMeta&& meta, IOBuf&& body) {
       --c.remaining_retries;
       ++retried_;
       if (c.issuer->IssueRPC(this) == 0) {
-        fid_unlock(cid_);
+        fid_unlock(cid_.load(std::memory_order_acquire));
         return;
       }
     }
@@ -194,7 +194,7 @@ void Controller::EndRPC() {
     delete c.span;
     c.span = nullptr;
   }
-  const fid_t id = cid_;
+  const fid_t id = cid_.load(std::memory_order_acquire);
   Closure done;
   done.swap(c.done);
   // Deregister from the socket's failure wait-list (no response coming /

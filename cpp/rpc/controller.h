@@ -64,14 +64,18 @@ class Controller {
   EndPoint remote_side() const { return remote_side_; }
   EndPoint local_side() const { return local_side_; }
   int64_t latency_us() const { return latency_us_; }
-  fid_t call_id() const { return cid_; }
+  fid_t call_id() const { return cid_.load(std::memory_order_acquire); }
   int retried_count() const { return retried_; }
   bool has_backup_request() const { return backup_fired_; }
 
   // Requests cancellation of the in-flight call; completion (done / sync
   // wakeup) still happens exactly once. Safe from any thread.
   void StartCancel() {
-    if (cid_) fid_error(cid_, ECANCELEDRPC);
+    // cid_ is atomic: cancel may race the issuing thread's set_cid
+    // (cancel-before-issue reads 0 and is a no-op; the versioned fid makes
+    // a stale id harmless).
+    const fid_t id = cid_.load(std::memory_order_acquire);
+    if (id) fid_error(id, ECANCELEDRPC);
   }
 
   // Resets error/latency state so the controller can be reused for another
@@ -150,7 +154,7 @@ class Controller {
   void set_session_local_data(void* d) { session_local_data_ = d; }
   void set_local_side(const EndPoint& ep) { local_side_ = ep; }
   void set_latency(int64_t us) { latency_us_ = us; }
-  void set_cid(fid_t id) { cid_ = id; }
+  void set_cid(fid_t id) { cid_.store(id, std::memory_order_release); }
 
   // Server side: accounting cookie (MethodStatus*), response meta basis.
   void* server_cookie = nullptr;
@@ -167,7 +171,7 @@ class Controller {
   int64_t latency_us_ = 0;
   int retried_ = 0;
   bool backup_fired_ = false;
-  fid_t cid_ = 0;
+  std::atomic<fid_t> cid_{0};
 
   friend class Channel;
 };

@@ -388,6 +388,17 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
     delete ctx;
   };
 
+  const std::string* authz = FindHeader(st->req_headers, "authorization");
+  const std::string auth_cred = authz ? *authz : "";
+  if (path != "/health" && !HttpAuthOk(server, auth_cred, s->remote())) {
+    IOBuf body;
+    body.append("authentication failed\n");
+    RespondH2(ctx, grpc ? 200 : 403,
+              grpc ? "application/grpc" : "text/plain", std::move(body),
+              16 /*UNAUTHENTICATED*/, grpc ? "authentication failed" : "");
+    delete ctx;
+    return;
+  }
   if (!grpc) {
     HttpResponse builtin;
     if (HandleBuiltinPage(server, *method, path, query, &builtin)) {
@@ -402,14 +413,13 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
   // Shared resolution/admission ladder — identical routing AND the same
   // auth/interceptor gates as HTTP/1.1 and brt_std.
   HttpAdmission adm;
-  const std::string* authz = FindHeader(st->req_headers, "authorization");
-  if (!AdmitHttpRequest(server, path, authz ? *authz : "", s->remote(),
-                        &adm)) {
+  if (!AdmitHttpRequest(server, path, auth_cred, s->remote(), &adm)) {
     fail(adm.http_status, adm.error, adm.grpc_status);
     return;
   }
   ctx->ms = adm.ms;
   ctx->start_us = monotonic_us();
+  ctx->cntl.set_session_local_data(server->BorrowSessionData());
   if (grpc) {
     const std::string* tmo = FindHeader(st->req_headers, "grpc-timeout");
     if (tmo != nullptr) {
@@ -417,6 +427,7 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
       if (ms_left >= 0) ctx->cntl.timeout_ms = ms_left;
     }
     if (!CutGrpcMessage(&st->body, &ctx->request)) {
+      server->ReturnSessionData(ctx->cntl.session_local_data());
       FinishHttpRequest(server, adm.ms, EREQUEST, 0);
       fail(200, "malformed grpc framing", 13);
       return;
@@ -449,6 +460,7 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
       body.append(std::to_string(ec) + ": " + ctx->cntl.ErrorText() + "\n");
       RespondH2(ctx, 500, "text/plain", std::move(body), 0, "");
     }
+    ctx->server->ReturnSessionData(ctx->cntl.session_local_data());
     FinishHttpRequest(ctx->server, ctx->ms, ec,
                       monotonic_us() - ctx->start_us);
     delete ctx;

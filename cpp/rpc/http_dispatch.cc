@@ -84,12 +84,20 @@ bool AdmitHttpRequest(Server* server, const std::string& path,
   return true;
 }
 
+bool HttpAuthOk(Server* server, const std::string& auth,
+                const EndPoint& remote) {
+  return server == nullptr || server->options().auth == nullptr ||
+         server->options().auth->VerifyCredential(auth, remote) == 0;
+}
+
 void FinishHttpRequest(Server* server, MethodStatus* ms, int error_code,
                        int64_t latency_us) {
   ms->OnResponded(error_code, latency_us);
   server->OnResponseSent(error_code, latency_us);
-  server->OnRequestDone();
   server->requests_processed.fetch_add(1, std::memory_order_relaxed);
+  // Last touch (see Server::OnRequestDone): Join()/~Server may run the
+  // instant concurrency drops to zero.
+  server->OnRequestDone();
 }
 
 }  // namespace brt

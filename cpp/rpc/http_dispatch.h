@@ -39,6 +39,11 @@ bool AdmitHttpRequest(Server* server, const std::string& path,
                       const std::string& auth, const EndPoint& remote,
                       HttpAdmission* out);
 
+// Credential check alone (used to gate the builtin observability pages
+// before any dispatch — /hotspots etc. must not leak when auth is on).
+bool HttpAuthOk(Server* server, const std::string& auth,
+                const EndPoint& remote);
+
 // Completion accounting for an admitted request (per-method stats,
 // adaptive limiter feed, concurrency release).
 void FinishHttpRequest(Server* server, MethodStatus* ms, int error_code,

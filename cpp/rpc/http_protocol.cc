@@ -189,6 +189,17 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
     WriteSequenced(ptr.get(), seq, std::move(out), close);
   };
 
+  const std::string* authz = m.header("authorization");
+  const std::string auth_cred = authz ? *authz : "";
+  // The builtin observability pages sit behind the same credential gate as
+  // services (only /health stays open for load-balancer probes).
+  if (m.path != "/health" &&
+      !HttpAuthOk(server, auth_cred, ptr->remote())) {
+    IOBuf body;
+    body.append("authentication failed\n");
+    respond(403, "text/plain", std::move(body));
+    return;
+  }
   HttpResponse builtin;
   if (HandleBuiltinPage(server, m.method, m.path, m.query, &builtin)) {
     IOBuf body;
@@ -198,8 +209,7 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
   }
 
   HttpAdmission adm;
-  const std::string* authz = m.header("authorization");
-  if (!AdmitHttpRequest(server, m.path, authz ? *authz : "",
+  if (!AdmitHttpRequest(server, m.path, auth_cred,
                         ptr->remote(), &adm)) {
     IOBuf body;
     body.append(adm.error + "\n");
@@ -213,6 +223,7 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
   sess->sock = sid;
   sess->seq = seq;
   sess->cntl.set_remote_side(ptr->remote());
+  sess->cntl.set_session_local_data(server->BorrowSessionData());
   sess->request = std::move(m.body);
   sess->req_head = std::move(m);
   const int64_t start_us = monotonic_us();
@@ -237,6 +248,7 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
     if (Socket::Address(sess->sock, &p2) == 0) {
       WriteSequenced(p2.get(), sess->seq, std::move(out), close);
     }
+    server->ReturnSessionData(sess->cntl.session_local_data());
     FinishHttpRequest(server, ms, sess->cntl.ErrorCode(),
                       monotonic_us() - start_us);
     delete sess;

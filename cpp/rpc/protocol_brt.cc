@@ -96,9 +96,11 @@ void SendResponse(RpcSession* sess) {
   if (sess->mstatus) sess->mstatus->OnResponded(meta.error_code, lat);
   if (sess->server) {
     sess->server->ReturnSessionData(sess->cntl.session_local_data());
-    sess->server->OnRequestDone();
     sess->server->OnResponseSent(meta.error_code, lat);
     sess->server->requests_processed.fetch_add(1, std::memory_order_relaxed);
+    // Last touch: after this decrement Join() may return and the Server
+    // may be destroyed.
+    sess->server->OnRequestDone();
   }
   delete sess;
 }
@@ -196,8 +198,8 @@ void ProcessRequest(RpcMeta&& meta, IOBuf&& body, SocketId sock,
     IOBuf plain;
     if (h == nullptr || !h->decompress(body, &plain)) {
       server->ReturnSessionData(sess->cntl.session_local_data());
-      server->OnRequestDone();
       ms->OnResponded(EREQUEST, 0);
+      server->OnRequestDone();  // last touch (Join may return after this)
       delete sess;
       SendErrorResponse(sock, meta.correlation_id, EREQUEST,
                         "cannot decompress request");

@@ -121,13 +121,20 @@ class Server {
   bool OnRequestArrived() {
     int c = concurrency_.fetch_add(1, std::memory_order_relaxed) + 1;
     if (limiter_ && !limiter_->OnRequested(c)) {
-      concurrency_.fetch_sub(1, std::memory_order_relaxed);
+      // release: same contract as OnRequestDone — this decrement may be
+      // what lets Join() return and ~Server run.
+      concurrency_.fetch_sub(1, std::memory_order_release);
       return false;
     }
     return true;
   }
+  // MUST be the caller's LAST touch of this Server for the request:
+  // Join() returns the moment concurrency hits zero, and ~Server may run
+  // immediately after. The release decrement pairs with Join's acquire
+  // load so everything the request did (method stats, limiter feeds)
+  // happens-before destruction.
   void OnRequestDone() {
-    concurrency_.fetch_sub(1, std::memory_order_relaxed);
+    concurrency_.fetch_sub(1, std::memory_order_release);
   }
   // Feeds the adaptive limiter (call once per response).
   void OnResponseSent(int error_code, int64_t latency_us) {

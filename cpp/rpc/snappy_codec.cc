@@ -145,8 +145,12 @@ bool SnappyDecompressRaw(const char* in, size_t n, std::string* out) {
     shift += 7;
     if ((b & 0x80) == 0) break;
   }
-  if (ulen > (1ull << 32)) return false;
-  out->reserve(out->size() + size_t(ulen));
+  // Bound the claimed length by the maximum legal expansion of the actual
+  // input: the densest tag (3-byte 2-byte-offset copy) yields 64 output
+  // bytes, so anything above ~22x input (+ slack) is a forged preamble —
+  // reject instead of reserving attacker-chosen gigabytes.
+  if (ulen > 24 * uint64_t(n) + 64) return false;
+  out->reserve(out->size() + size_t(ulen < (1u << 20) ? ulen : (1u << 20)));
   const size_t out_base = out->size();
   while (i < n) {
     const uint8_t tag = uint8_t(in[i++]);
@@ -198,18 +202,33 @@ bool SnappyDecompressRaw(const char* in, size_t n, std::string* out) {
 }
 
 bool SnappyCompress(const IOBuf& in, IOBuf* out) {
-  const std::string src = in.to_string();
+  // Matching needs random access to a contiguous region; the common case
+  // (single-block payload) compresses straight from the block, multi-block
+  // pays one coalesce.
   std::string dst;
-  dst.reserve(src.size() / 2 + 32);
-  SnappyCompressRaw(src.data(), src.size(), &dst);
+  dst.reserve(in.size() / 2 + 32);
+  if (in.block_count() == 1) {
+    SnappyCompressRaw(static_cast<const char*>(in.ref_data(0)), in.size(),
+                      &dst);
+  } else {
+    const std::string src = in.to_string();
+    SnappyCompressRaw(src.data(), src.size(), &dst);
+  }
   out->append(dst);
   return true;
 }
 
 bool SnappyDecompress(const IOBuf& in, IOBuf* out) {
-  const std::string src = in.to_string();
   std::string dst;
-  if (!SnappyDecompressRaw(src.data(), src.size(), &dst)) return false;
+  if (in.block_count() == 1) {
+    if (!SnappyDecompressRaw(static_cast<const char*>(in.ref_data(0)),
+                             in.size(), &dst)) {
+      return false;
+    }
+  } else {
+    const std::string src = in.to_string();
+    if (!SnappyDecompressRaw(src.data(), src.size(), &dst)) return false;
+  }
   out->append(dst);
   return true;
 }

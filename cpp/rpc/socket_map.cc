@@ -28,8 +28,11 @@ struct Entry {
   std::deque<SocketId> pooled;
 };
 
-std::shared_mutex g_mu;
-std::unordered_map<MapKey, Entry, MapKeyHash> g_map;
+// Leaked (mutex AND map): detached read fibers drop failed sockets from
+// the map right up to process exit — static-by-value globals would be
+// destroyed under them (TSan-caught at-exit race).
+auto& g_mu = *new std::shared_mutex();
+auto& g_map = *new std::unordered_map<MapKey, Entry, MapKeyHash>();
 
 int NewConnection(const EndPoint& remote, SocketUniquePtr* out,
                   int64_t timeout_us) {

@@ -1,6 +1,10 @@
 // Protocol-partial parity tests: snappy codec, streamed zlib, thrift
 // TBinary struct codec, timeout concurrency limiter, interceptor /
 // authenticator / session-local data hooks.
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
 #include <cassert>
 #include <cstdio>
 #include <cstring>
@@ -286,6 +290,30 @@ static void test_hooks() {
     bare.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
     assert(cntl.Failed());
     assert(cntl.ErrorCode() == EAUTH);
+  }
+
+  // The builtin HTTP pages sit behind the same credential (only /health
+  // stays open): no Authorization -> 403, correct header -> 200.
+  {
+    auto http = [&](const std::string& req_text) {
+      int fd = socket(AF_INET, SOCK_STREAM, 0);
+      assert(fd >= 0);
+      sockaddr_in sa = server.listen_address().to_sockaddr();
+      assert(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) ==
+             0);
+      assert(write(fd, req_text.data(), req_text.size()) ==
+             ssize_t(req_text.size()));
+      char buf[2048];
+      ssize_t n = read(fd, buf, sizeof(buf));
+      close(fd);
+      return std::string(buf, n > 0 ? size_t(n) : 0);
+    };
+    assert(http("GET /status HTTP/1.1\r\n\r\n").rfind("HTTP/1.1 403", 0) ==
+           0);
+    assert(http("GET /status HTTP/1.1\r\nAuthorization: token-42\r\n\r\n")
+               .rfind("HTTP/1.1 200", 0) == 0);
+    assert(http("GET /health HTTP/1.1\r\n\r\n").rfind("HTTP/1.1 200", 0) ==
+           0);
   }
   server.Stop();
   server.Join();

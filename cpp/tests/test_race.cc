@@ -1,0 +1,313 @@
+// Scheduler stress + client-state race coverage (reference models:
+// test/bthread_ping_pong_unittest.cpp and the response x timeout x backup
+// x cancel races resolved on one correlation id by Controller/fiber_id).
+// Also: randomized mutation fuzz loops over the wire parsers — the
+// coverage-style complement to test_fuzz.cc's deterministic corpora.
+//
+// TSan recipe (clean as of the fiber-annotation work — the scheduler
+// declares its stack switches via __tsan_switch_to_fiber):
+//   g++ -std=c++20 -fsanitize=thread -g -I cpp cpp/tests/test_race.cc \
+//       -L <tsan-build-of-brt_core> -lbrt_core -lpthread -lz -ldl -o t
+//   BRT_RACE_SCALE=10 ./t        # scale divides iteration counts
+// Build brt_core with -fsanitize=thread and WITHOUT -O2 (TSan + optimized
+// code on custom stacks wedges; -O0/-Og instrumented builds run fine).
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "base/time.h"
+#include "fiber/butex.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/brt_meta.h"
+#include "rpc/channel.h"
+#include "rpc/errors.h"
+#include "rpc/hpack.h"
+#include "rpc/http_message.h"
+#include "rpc/server.h"
+#include "rpc/snappy_codec.h"
+#include "rpc/thrift_binary.h"
+
+using namespace brt;
+
+namespace {
+
+// TSan multiplies every sync op's cost; BRT_RACE_SCALE divides iteration
+// counts so the instrumented run still finishes (correctness coverage is
+// per-iteration, not count-dependent).
+int Scale(int n) {
+  static const int div_ = [] {
+    const char* e = getenv("BRT_RACE_SCALE");
+    const int d = e != nullptr ? atoi(e) : 1;
+    return d > 0 ? d : 1;
+  }();
+  const int v = n / div_;
+  return v > 0 ? v : 1;
+}
+
+// Thread-local: Rand() is called from handler fibers on many workers.
+thread_local uint64_t t_rng = 0x2545f4914f6cdd1dull;
+uint32_t Rand() {
+  t_rng ^= t_rng << 13;
+  t_rng ^= t_rng >> 7;
+  t_rng ^= t_rng << 17;
+  return uint32_t(t_rng);
+}
+
+// ---------------- fiber ping-pong ----------------
+
+struct PingPong {
+  Butex* a = butex_create();
+  Butex* b = butex_create();
+  int rounds = Scale(20000);
+  CountdownEvent done{2};
+};
+
+void* Pinger(void* argp) {
+  auto* pp = static_cast<PingPong*>(argp);
+  for (int i = 0; i < pp->rounds; ++i) {
+    butex_value(pp->a).fetch_add(1, std::memory_order_release);
+    butex_wake(pp->a);
+    const int want = (i + 1) * 1;
+    while (butex_value(pp->b).load(std::memory_order_acquire) < want) {
+      butex_wait(pp->b, want - 1, -1);
+    }
+  }
+  pp->done.signal();
+  return nullptr;
+}
+
+void* Ponger(void* argp) {
+  auto* pp = static_cast<PingPong*>(argp);
+  for (int i = 0; i < pp->rounds; ++i) {
+    const int want = i + 1;
+    while (butex_value(pp->a).load(std::memory_order_acquire) < want) {
+      butex_wait(pp->a, want - 1, -1);
+    }
+    butex_value(pp->b).fetch_add(1, std::memory_order_release);
+    butex_wake(pp->b);
+  }
+  pp->done.signal();
+  return nullptr;
+}
+
+void test_ping_pong() {
+  PingPong pp;
+  const int64_t t0 = monotonic_us();
+  fiber_t t1, t2;
+  assert(fiber_start(&t1, Pinger, &pp) == 0);
+  assert(fiber_start(&t2, Ponger, &pp) == 0);
+  pp.done.wait(-1);
+  const int64_t dt = monotonic_us() - t0;
+  butex_destroy(pp.a);
+  butex_destroy(pp.b);
+  printf("ping-pong OK: %d round-trips in %lldms (%.0f switches/s)\n",
+         pp.rounds, (long long)dt / 1000,
+         2.0 * pp.rounds * 1e6 / double(dt));
+}
+
+// ---------------- correlation-id race loop ----------------
+
+class JitterEchoService : public Service {
+ public:
+  void CallMethod(const std::string&, Controller*, const IOBuf& request,
+                  IOBuf* response, Closure done) override {
+    const uint32_t r = Rand() % 4;
+    if (r != 0) fiber_usleep(r * 700);  // 0 / 0.7 / 1.4 / 2.1 ms
+    response->append(request);
+    done();
+  }
+};
+
+struct CancelArg {
+  Controller* cntl;
+  CountdownEvent* go;
+  CountdownEvent* did;
+};
+
+void* CancelFiber(void* argp) {
+  auto* a = static_cast<CancelArg*>(argp);
+  a->go->wait(-1);
+  fiber_usleep(Rand() % 1500);
+  a->cntl->StartCancel();
+  a->did->signal();
+  return nullptr;
+}
+
+void test_correlation_race() {
+  Server server;
+  JitterEchoService svc;
+  assert(server.AddService(&svc, "Echo") == 0);
+  assert(server.Start("127.0.0.1:0") == 0);
+  Channel ch;
+  ChannelOptions copts;
+  copts.timeout_ms = 2;          // ~half the calls blow the deadline
+  copts.backup_request_ms = 1;   // backup fires on the slow half
+  copts.max_retry = 1;
+  assert(ch.Init(server.listen_address(), &copts) == 0);
+
+  const int kIters = Scale(10000);
+  int ok = 0, timed_out = 0, canceled = 0, other = 0;
+  for (int i = 0; i < kIters; ++i) {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("race");
+    const bool with_cancel = (i % 3) == 0;
+    CountdownEvent go(1), did(1);
+    CancelArg ca{&cntl, &go, &did};
+    if (with_cancel) {
+      fiber_t t;
+      assert(fiber_start(&t, CancelFiber, &ca) == 0);
+      go.signal();
+    }
+    ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+    if (with_cancel) did.wait(-1);
+    if (!cntl.Failed()) {
+      assert(rsp.equals("race"));
+      ++ok;
+    } else if (cntl.ErrorCode() == ERPCTIMEDOUT) {
+      ++timed_out;
+    } else if (cntl.ErrorCode() == ECANCELEDRPC) {
+      ++canceled;
+    } else {
+      ++other;
+    }
+  }
+  printf("correlation race OK: %d ok, %d timeout, %d canceled, %d other "
+         "of %d\n",
+         ok, timed_out, canceled, other, kIters);
+  // The distribution must show all three outcomes actually racing.
+  assert(ok > 0 && timed_out > 0);
+  assert(other == 0);
+  server.Stop();
+  server.Join();
+}
+
+// ---------------- mutation fuzz loops ----------------
+
+void Mutate(std::string* s) {
+  if (s->empty()) return;
+  const int edits = 1 + int(Rand() % 8);
+  for (int e = 0; e < edits; ++e) {
+    switch (Rand() % 4) {
+      case 0:
+        (*s)[Rand() % s->size()] = char(Rand());
+        break;
+      case 1:
+        s->insert(s->begin() + Rand() % s->size(), char(Rand()));
+        break;
+      case 2:
+        s->erase(s->begin() + Rand() % s->size());
+        if (s->empty()) return;
+        break;
+      case 3:
+        s->resize(Rand() % (s->size() + 1));
+        if (s->empty()) return;
+        break;
+    }
+  }
+}
+
+void test_fuzz_loops() {
+  // Seed: a valid brt frame.
+  RpcMeta meta;
+  meta.type = MetaType::REQUEST;
+  meta.correlation_id = 42;
+  meta.service = "Echo";
+  meta.method = "Echo";
+  IOBuf seed_frame;
+  {
+    IOBuf body;
+    body.append("hello");
+    PackFrame(&seed_frame, meta, std::move(body));
+  }
+  const std::string brt_seed = seed_frame.to_string();
+  for (int i = 0; i < Scale(20000); ++i) {
+    std::string m = brt_seed;
+    Mutate(&m);
+    IOBuf in, body;
+    in.append(m);
+    RpcMeta out;
+    ParseFrame(&in, &out, &body);  // must not crash on any mutation
+  }
+
+  // HTTP request parser.
+  const std::string http_seed =
+      "POST /a/b?x=1 HTTP/1.1\r\nHost: h\r\nTransfer-Encoding: chunked"
+      "\r\n\r\n5\r\nhello\r\n0\r\nX-T: 1\r\n\r\n";
+  for (int i = 0; i < Scale(20000); ++i) {
+    std::string m = http_seed;
+    Mutate(&m);
+    HttpParser p(true);
+    IOBuf in;
+    in.append(m);
+    while (p.Consume(&in) == HttpParser::DONE && !in.empty()) p.Reset();
+  }
+
+  // HPACK decoder.
+  std::string hpack_seed;
+  {
+    HpackEncoder enc;
+    HeaderList h = {{":method", "POST"},
+                    {":path", "/x"},
+                    {"content-type", "application/grpc"},
+                    {"x-custom", "abcdefghijklmnop"}};
+    enc.Encode(h, &hpack_seed);
+  }
+  for (int i = 0; i < Scale(20000); ++i) {
+    std::string m = hpack_seed;
+    Mutate(&m);
+    HpackDecoder dec;
+    HeaderList sink;
+    dec.Decode(reinterpret_cast<const uint8_t*>(m.data()), m.size(), &sink);
+  }
+
+  // Snappy decompressor.
+  std::string snappy_seed;
+  {
+    std::string payload;
+    for (int i = 0; i < 50; ++i) payload += "fuzzing snappy ";
+    SnappyCompressRaw(payload.data(), payload.size(), &snappy_seed);
+  }
+  for (int i = 0; i < Scale(20000); ++i) {
+    std::string m = snappy_seed;
+    Mutate(&m);
+    std::string sink;
+    SnappyDecompressRaw(m.data(), m.size(), &sink);
+  }
+
+  // Thrift struct parser.
+  std::string thrift_seed;
+  {
+    ThriftValue s = ThriftValue::Struct();
+    s.add_field(1, ThriftValue::String("fuzz"));
+    ThriftValue lst = ThriftValue::List(TType::I64);
+    lst.elems.push_back(ThriftValue::I64(7));
+    s.add_field(2, std::move(lst));
+    IOBuf w;
+    assert(ThriftSerializeStruct(s, &w));
+    thrift_seed = w.to_string();
+  }
+  for (int i = 0; i < Scale(20000); ++i) {
+    std::string m = thrift_seed;
+    Mutate(&m);
+    IOBuf in;
+    in.append(m);
+    ThriftValue sink;
+    ThriftParseStruct(in, &sink);
+  }
+  printf("mutation fuzz loops OK (5 parsers x 20k mutations)\n");
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  test_ping_pong();
+  test_fuzz_loops();
+  test_correlation_race();
+  printf("ALL race/stress tests OK\n");
+  return 0;
+}
