@@ -286,17 +286,21 @@ class LocalityAwareLB : public LoadBalancer {
     // Call-end hot path: NO mutex (reference locality_aware_load_balancer
     // keeps feedback lock-free the same way) — stats are reached through
     // the wait-free DoublyBufferedData read, like SelectServer.
-    DoublyBufferedData<LaList>::ScopedPtr p;
-    dbd_.Read(&p);
-    NodeStat* st = nullptr;
     std::shared_ptr<NodeStat> held;
-    for (size_t i = 0; i < p->list.size(); ++i) {
-      if (p->list[i].ep == server) {
-        st = p->stats[i].get();
-        break;
+    {
+      // The ScopedPtr holds this thread's DBD wrapper mutex; it MUST be
+      // released before stat_mu_ below — ResetServers holds stat_mu_
+      // across dbd_.Modify, which sweeps every wrapper mutex (ABBA).
+      DoublyBufferedData<LaList>::ScopedPtr p;
+      dbd_.Read(&p);
+      for (size_t i = 0; i < p->list.size(); ++i) {
+        if (p->list[i].ep == server) {
+          held = p->stats[i];
+          break;
+        }
       }
     }
-    if (st == nullptr) {
+    if (held == nullptr) {
       // Node removed mid-flight (reconfig window, rare): fall back to the
       // persistent pool under its mutex so the inflight decrement is never
       // lost — the same NodeStat is re-attached if the node comes back.
@@ -304,8 +308,8 @@ class LocalityAwareLB : public LoadBalancer {
       auto it = stat_pool_.find((uint64_t(server.ip) << 16) | server.port);
       if (it == stat_pool_.end()) return;
       held = it->second;
-      st = held.get();
     }
+    NodeStat* st = held.get();
     st->inflight.fetch_sub(1, std::memory_order_relaxed);
     if (error_code == 0) {
       // EMA with alpha 1/8

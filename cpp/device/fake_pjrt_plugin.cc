@@ -48,6 +48,12 @@ struct Buffer {
   std::vector<char> data;
   std::vector<int64_t> dims;
   PJRT_Buffer_Type type = PJRT_Buffer_Type_U8;
+  // $BRT_FAKE_COLMAJOR mode: rank-2 buffers store column-major bytes and
+  // report minor_to_major={0,1}, mimicking the real TPU tunnel's landings
+  // so RepackDeviceLayout gets native coverage (it is a no-op on the
+  // default row-major fake layout).
+  bool colmajor = false;
+  std::vector<int64_t> mtm;  // lazily-built layout storage (buffer-owned)
 };
 
 enum class Kind {
@@ -184,8 +190,22 @@ PJRT_Error* BufferFromHostBuffer(PJRT_Client_BufferFromHostBuffer_Args* a) {
   int64_t n = 1;
   for (int64_t d : b->dims) n *= d;
   const size_t bytes = size_t(n) * ElemSize(a->type);
-  b->data.assign(static_cast<const char*>(a->data),
-                 static_cast<const char*>(a->data) + bytes);
+  const char* src = static_cast<const char*>(a->data);
+  if (getenv("BRT_FAKE_COLMAJOR") != nullptr && b->dims.size() == 2) {
+    // Host input is dense row-major (byte_strides unset); store it
+    // transposed, as a column-major device would.
+    const size_t e = ElemSize(a->type);
+    const size_t rows = size_t(b->dims[0]), cols = size_t(b->dims[1]);
+    b->colmajor = true;
+    b->data.resize(bytes);
+    for (size_t r = 0; r < rows; ++r) {
+      for (size_t c = 0; c < cols; ++c) {
+        memcpy(&b->data[(c * rows + r) * e], src + (r * cols + c) * e, e);
+      }
+    }
+  } else {
+    b->data.assign(src, src + bytes);
+  }
   a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
   a->done_with_host_buffer = reinterpret_cast<PJRT_Event*>(new Event());
   return nullptr;
@@ -197,6 +217,33 @@ PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* a) {
 PJRT_Error* BufferOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* a) {
   a->on_device_size_in_bytes =
       reinterpret_cast<Buffer*>(a->buffer)->data.size();
+  return nullptr;
+}
+PJRT_Error* BufferDimensions(PJRT_Buffer_Dimensions_Args* a) {
+  auto* b = reinterpret_cast<Buffer*>(a->buffer);
+  a->dims = b->dims.data();
+  a->num_dims = b->dims.size();
+  return nullptr;
+}
+PJRT_Error* BufferElementType(PJRT_Buffer_ElementType_Args* a) {
+  a->type = reinterpret_cast<Buffer*>(a->buffer)->type;
+  return nullptr;
+}
+PJRT_Error* BufferGetMemoryLayout(PJRT_Buffer_GetMemoryLayout_Args* a) {
+  auto* b = reinterpret_cast<Buffer*>(a->buffer);
+  const size_t rank = b->dims.size();
+  if (b->mtm.empty()) {
+    for (size_t i = 0; i < rank; ++i) {
+      b->mtm.push_back(b->colmajor ? int64_t(i)
+                                   : int64_t(rank) - 1 - int64_t(i));
+    }
+  }
+  memset(&a->layout, 0, sizeof(a->layout));
+  a->layout.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
+  a->layout.type = PJRT_Buffer_MemoryLayout_Type_Tiled;
+  a->layout.tiled.struct_size = PJRT_Buffer_MemoryLayout_Tiled_STRUCT_SIZE;
+  a->layout.tiled.minor_to_major = b->mtm.data();
+  a->layout.tiled.minor_to_major_size = rank;
   return nullptr;
 }
 PJRT_Error* BufferToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* a) {
@@ -410,6 +457,9 @@ PJRT_Api MakeApi() {
   api.PJRT_Client_Compile = ClientCompile;
   api.PJRT_Buffer_Destroy = BufferDestroy;
   api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSize;
+  api.PJRT_Buffer_Dimensions = BufferDimensions;
+  api.PJRT_Buffer_ElementType = BufferElementType;
+  api.PJRT_Buffer_GetMemoryLayout = BufferGetMemoryLayout;
   api.PJRT_Buffer_ToHostBuffer = BufferToHostBuffer;
   api.PJRT_LoadedExecutable_Destroy = LoadedDestroy;
   api.PJRT_LoadedExecutable_GetExecutable = LoadedGetExecutable;

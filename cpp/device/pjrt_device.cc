@@ -555,6 +555,130 @@ uint64_t PjrtClient::StageToDeviceShaped(const IOBuf& data, int device_index,
                                         int(dtype));
 }
 
+char* PjrtClient::RepackDeviceLayout(PJRT_Buffer* buf, char* src, size_t n,
+                                     size_t* cap) {
+  const PJRT_Api* raw = api_->raw();
+  if (raw->PJRT_Buffer_Dimensions == nullptr ||
+      raw->PJRT_Buffer_GetMemoryLayout == nullptr) {
+    return nullptr;
+  }
+  auto dargs = BRT_PJRT_ARGS(PJRT_Buffer_Dimensions_Args);
+  dargs.buffer = buf;
+  if (PJRT_Error* err = raw->PJRT_Buffer_Dimensions(&dargs)) {
+    api_->ConsumeError(err);
+    return nullptr;
+  }
+  const size_t rank = dargs.num_dims;
+  if (rank < 2 || rank > 16) return nullptr;  // rank<2: layout is trivial
+  auto largs = BRT_PJRT_ARGS(PJRT_Buffer_GetMemoryLayout_Args);
+  largs.buffer = buf;
+  if (PJRT_Error* err = raw->PJRT_Buffer_GetMemoryLayout(&largs)) {
+    api_->ConsumeError(err);
+    return nullptr;
+  }
+  if (largs.layout.type != PJRT_Buffer_MemoryLayout_Type_Tiled ||
+      largs.layout.tiled.minor_to_major_size != rank) {
+    return nullptr;  // strided landings not seen in practice
+  }
+  const int64_t* mtm = largs.layout.tiled.minor_to_major;
+  // Plugin-supplied input: must be a permutation of [0, rank) before it
+  // can index the stride array below.
+  bool seen[16] = {false};
+  bool row_major = true;
+  for (size_t i = 0; i < rank; ++i) {
+    if (mtm[i] < 0 || mtm[i] >= int64_t(rank) || seen[mtm[i]]) {
+      return nullptr;  // malformed layout: leave bytes untouched
+    }
+    seen[mtm[i]] = true;
+    if (mtm[i] != int64_t(rank) - 1 - int64_t(i)) row_major = false;
+  }
+  if (row_major) return nullptr;
+  size_t total = 1;
+  for (size_t d = 0; d < rank; ++d) total *= size_t(dargs.dims[d]);
+  // The landed byte count must be exactly the dense footprint: the TPU
+  // tunnel untiles on the way out but keeps the permutation (layout says
+  // tile (8,128) yet hands back total*elem bytes — verified on-chip for
+  // both padded (16,8) and evenly-divisible (16,256)/(32,128) shapes). A
+  // truly tile-padded landing (n > dense) cannot be fixed by permutation
+  // alone. Known limitation: a plugin that lands genuinely
+  // tile-INTERLEAVED bytes whose tiles divide the shape exactly would be
+  // indistinguishable from a permuted-dense landing; no observed plugin
+  // does that (they all materialize the logical array).
+  size_t elem = 0;
+  if (raw->PJRT_Buffer_ElementType != nullptr) {
+    auto eargs = BRT_PJRT_ARGS(PJRT_Buffer_ElementType_Args);
+    eargs.buffer = buf;
+    if (PJRT_Error* err = raw->PJRT_Buffer_ElementType(&eargs)) {
+      api_->ConsumeError(err);
+    } else {
+      switch (eargs.type) {
+        case PJRT_Buffer_Type_PRED:
+        case PJRT_Buffer_Type_S8:
+        case PJRT_Buffer_Type_U8: elem = 1; break;
+        case PJRT_Buffer_Type_S16:
+        case PJRT_Buffer_Type_U16:
+        case PJRT_Buffer_Type_F16:
+        case PJRT_Buffer_Type_BF16: elem = 2; break;
+        case PJRT_Buffer_Type_S32:
+        case PJRT_Buffer_Type_U32:
+        case PJRT_Buffer_Type_F32: elem = 4; break;
+        case PJRT_Buffer_Type_S64:
+        case PJRT_Buffer_Type_U64:
+        case PJRT_Buffer_Type_F64:
+        case PJRT_Buffer_Type_C64: elem = 8; break;
+        default: elem = 0; break;
+      }
+    }
+  }
+  if (total == 0 || elem == 0 || n != total * elem) return nullptr;
+  // Element strides of the landed (device-layout) bytes per logical dim.
+  int64_t stride[16];
+  int64_t acc = 1;
+  for (size_t i = 0; i < rank; ++i) {
+    stride[mtm[i]] = acc;
+    acc *= dargs.dims[mtm[i]];
+  }
+  size_t dcap = 0;
+  char* dense = static_cast<char*>(
+      DeviceBlockPool::singleton().Acquire(n, &dcap));
+  if (dense == nullptr) return nullptr;  // keep device-layout bytes
+  // Walk logical indices in row-major order, maintaining the source
+  // element offset incrementally (+stride on the dim that increments,
+  // -(dim-1)*stride on each wrap) — no per-element dot product. When the
+  // logical innermost dim is contiguous in the device layout, whole rows
+  // copy with one memcpy; otherwise fixed-size stores (constant-size
+  // memcpy inlines to a load/store pair).
+  int64_t idx[16] = {0};
+  const int64_t run = (stride[rank - 1] == 1) ? dargs.dims[rank - 1] : 1;
+  int64_t off = 0;
+  char* out_p = dense;
+  for (size_t i = 0; i < total; i += size_t(run)) {
+    const char* in_p = src + size_t(off) * elem;
+    if (run > 1) {
+      memcpy(out_p, in_p, size_t(run) * elem);
+    } else {
+      switch (elem) {
+        case 1: *out_p = *in_p; break;
+        case 2: memcpy(out_p, in_p, 2); break;
+        case 4: memcpy(out_p, in_p, 4); break;
+        default: memcpy(out_p, in_p, 8); break;
+      }
+    }
+    out_p += size_t(run) * elem;
+    for (int d = int(rank) - 1 - (run > 1 ? 1 : 0); d >= 0; --d) {
+      if (++idx[d] < dargs.dims[d]) {
+        off += stride[d];
+        break;
+      }
+      idx[d] = 0;
+      off -= stride[d] * (dargs.dims[d] - 1);
+    }
+  }
+  DeviceBlockPool::singleton().Release(src, *cap);
+  *cap = dcap;
+  return dense;
+}
+
 int PjrtClient::StageFromDevice(uint64_t handle, IOBuf* out,
                                 std::string* error) {
   // Pin across the blocking DMA: a concurrent Release of the same handle
@@ -565,15 +689,14 @@ int PjrtClient::StageFromDevice(uint64_t handle, IOBuf* out,
     return EINVAL;
   }
   auto unpin = [handle] { DeviceBufferRegistry::Unpin(handle); };
-  auto szargs = BRT_PJRT_ARGS(PJRT_Buffer_OnDeviceSizeInBytes_Args);
-  szargs.buffer = buf;
-  if (PJRT_Error* err =
-          api_->raw()->PJRT_Buffer_OnDeviceSizeInBytes(&szargs)) {
-    if (error) *error = "OnDeviceSizeInBytes: " + api_->ConsumeError(err);
+  auto szargs = BRT_PJRT_ARGS(PJRT_Buffer_ToHostBuffer_Args);
+  szargs.src = buf;
+  if (PJRT_Error* err = api_->raw()->PJRT_Buffer_ToHostBuffer(&szargs)) {
+    if (error) *error = "ToHostBuffer(size query): " + api_->ConsumeError(err);
     unpin();
     return EIO;
   }
-  const size_t n = szargs.on_device_size_in_bytes;
+  const size_t n = szargs.dst_size;
   // D2H lands directly in a pooled registered block that the caller's
   // IOBuf will reference — no bounce buffer, no malloc (reference
   // recv-side zero copy, docs/en/rdma.md:38 + block_pool.cpp:39).
@@ -595,14 +718,26 @@ int PjrtClient::StageFromDevice(uint64_t handle, IOBuf* out,
     unpin();
     return EIO;
   }
-  PjrtEvent ev(api_, args.event);
-  int rc = ev.Wait(thread_wait_);  // parks fiber (or blocks thread)
-  unpin();
+  int rc = 0;
+  if (args.event != nullptr) {  // no event => plugin copied synchronously
+    PjrtEvent ev(api_, args.event);
+    rc = ev.Wait(thread_wait_);  // parks fiber (or blocks thread)
+  }
   if (rc != 0) {
+    unpin();
     if (error) *error = "D2H event failed";
     DeviceBlockPool::singleton().Release(dst, cap);
     return rc;
   }
+  // With host_layout unset the plugin copies in the buffer's ON-DEVICE
+  // layout (PJRT_Buffer_ToHostBuffer_Args contract) — and on a real TPU
+  // that is not row-major for rank>=2 arrays (observed: column-major
+  // landings for (R,D) f32 tables on the axon plugin, which also ignores
+  // an explicit host_layout request). Un-permute host-side into dense
+  // row-major so callers always see numpy-compatible bytes.
+  char* repacked = RepackDeviceLayout(buf, dst, n, &cap);
+  unpin();
+  if (repacked != nullptr) dst = repacked;
   out->append_user_data(dst, n, DeviceBlockPool::IOBufDeleter,
                         reinterpret_cast<void*>(uintptr_t(cap)),
                         /*meta=*/handle);

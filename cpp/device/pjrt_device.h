@@ -212,6 +212,13 @@ class PjrtClient {
 
  private:
   PjrtClient() = default;
+  // If `buf`'s on-device layout is an untiled non-row-major permutation
+  // (what ToHostBuffer landed in `src`), returns a fresh pooled block
+  // holding the dense row-major repack, releasing `src` and updating
+  // *cap. Returns nullptr when the bytes are already row-major (or the
+  // layout is unknown/tiled — left as-is).
+  char* RepackDeviceLayout(PJRT_Buffer* buf, char* src, size_t n,
+                           size_t* cap);
   const PjrtApi* api_ = nullptr;
   PJRT_Client* client_ = nullptr;
   std::vector<PJRT_Device*> addressable_;

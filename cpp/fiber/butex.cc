@@ -98,38 +98,69 @@ struct ButexCache {
 };
 thread_local ButexCache t_butex_cache;
 
+// Separate pool for SEQUENCE butexes (condition variables). A straggling
+// FiberCond::notify_* mutates the value (fetch_add) at a point where the
+// cond may already be destroyed — sanctioned, because slots from this pool
+// are only ever reused as other sequence butexes, where a stray +1 is an
+// ordinary seq advance (spurious wake, re-checked by every waiter).
+// Mixing these with the value-semantics pool (mutex 0/1/2, countdown
+// counters) would let that +1 corrupt a recycled primitive's state.
+std::mutex& g_seq_pool_mu = *new std::mutex();
+std::vector<Butex*>& g_seq_pool = *new std::vector<Butex*>();
+thread_local ButexCache t_seq_cache;
+
+// Shared cache-then-global-pool logic for both pools. `reset_value`:
+// value-semantics slots start at 0; sequence slots keep their old value
+// (cond waiters read the current seq before parking, and skipping the
+// store keeps the straggler-+1 window indistinguishable from a notify).
+Butex* PooledCreate(ButexCache& cache, std::mutex& mu,
+                    std::vector<Butex*>& pool, bool reset_value) {
+  Butex* b = nullptr;
+  if (cache.count > 0) {
+    b = cache.items[--cache.count];
+  } else {
+    std::lock_guard<std::mutex> g(mu);
+    if (!pool.empty()) {
+      b = pool.back();
+      pool.pop_back();
+    }
+  }
+  if (b == nullptr) return new Butex();
+  if (reset_value) b->value.store(0, std::memory_order_relaxed);
+  return b;
+}
+
+void PooledDestroy(ButexCache& cache, std::mutex& mu,
+                   std::vector<Butex*>& pool, Butex* b) {
+  if (cache.count < kButexCacheMax) {
+    cache.items[cache.count++] = b;
+    return;
+  }
+  std::lock_guard<std::mutex> g(mu);
+  pool.push_back(b);
+}
+
 }  // namespace
 
 Butex* butex_create() {
-  ButexCache& cache = t_butex_cache;
-  if (cache.count > 0) {
-    Butex* b = cache.items[--cache.count];
-    b->value.store(0, std::memory_order_relaxed);
-    return b;
-  }
-  {
-    std::lock_guard<std::mutex> g(g_butex_pool_mu);
-    if (!g_butex_pool.empty()) {
-      Butex* b = g_butex_pool.back();
-      g_butex_pool.pop_back();
-      b->value.store(0, std::memory_order_relaxed);
-      return b;
-    }
-  }
-  return new Butex();
+  return PooledCreate(t_butex_cache, g_butex_pool_mu, g_butex_pool,
+                      /*reset_value=*/true);
 }
 
 void butex_destroy(Butex* b) {
   // Caller contract: no waiter is still in the ring (joining/waking has
   // completed); stragglers inside wake paths are the case pooling exists
   // for.
-  ButexCache& cache = t_butex_cache;
-  if (cache.count < kButexCacheMax) {
-    cache.items[cache.count++] = b;
-    return;
-  }
-  std::lock_guard<std::mutex> g(g_butex_pool_mu);
-  g_butex_pool.push_back(b);
+  PooledDestroy(t_butex_cache, g_butex_pool_mu, g_butex_pool, b);
+}
+
+Butex* butex_create_seq() {
+  return PooledCreate(t_seq_cache, g_seq_pool_mu, g_seq_pool,
+                      /*reset_value=*/false);
+}
+
+void butex_destroy_seq(Butex* b) {
+  PooledDestroy(t_seq_cache, g_seq_pool_mu, g_seq_pool, b);
 }
 
 std::atomic<int>& butex_value(Butex* b) { return b->value; }

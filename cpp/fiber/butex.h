@@ -13,6 +13,13 @@ struct Butex;
 
 Butex* butex_create();
 void butex_destroy(Butex* b);
+
+// Sequence-butex pool for condition variables: slots only ever recycle
+// into other sequence butexes, so a straggling notify's value bump cannot
+// corrupt a recycled mutex/countdown (it reads as a spurious seq advance).
+// Value is unspecified at create; cond waiters read it before parking.
+Butex* butex_create_seq();
+void butex_destroy_seq(Butex* b);
 std::atomic<int>& butex_value(Butex* b);
 
 // Parks while *value == expected. timeout_us < 0 → infinite.

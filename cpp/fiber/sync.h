@@ -61,8 +61,11 @@ class FiberMutex {
 
 class FiberCond {
  public:
-  FiberCond() : b_(butex_create()) {}
-  ~FiberCond() { butex_destroy(b_); }
+  // Sequence pool: a straggling notify after ~FiberCond (legal once no
+  // waiter is blocked) may still bump the recycled slot's value — safe
+  // only because that slot can never become a mutex/countdown butex.
+  FiberCond() : b_(butex_create_seq()) {}
+  ~FiberCond() { butex_destroy_seq(b_); }
 
   // mutex must be held.
   int wait(FiberMutex& mu, int64_t timeout_us = -1) {

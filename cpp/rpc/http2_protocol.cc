@@ -390,14 +390,14 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
 
   const std::string* authz = FindHeader(st->req_headers, "authorization");
   const std::string auth_cred = authz ? *authz : "";
-  if (path != "/health" && !HttpAuthOk(server, auth_cred, s->remote())) {
-    IOBuf body;
-    body.append("authentication failed\n");
-    RespondH2(ctx, grpc ? 200 : 403,
-              grpc ? "application/grpc" : "text/plain", std::move(body),
-              16 /*UNAUTHENTICATED*/, grpc ? "authentication failed" : "");
-    delete ctx;
-    return;
+  // Verified exactly once here; AdmitHttpRequest is told not to re-verify.
+  bool auth_verified = false;
+  if (path != "/health") {
+    if (!HttpAuthOk(server, auth_cred, s->remote())) {
+      fail(403, "authentication failed", 16 /*UNAUTHENTICATED*/);
+      return;
+    }
+    auth_verified = true;
   }
   if (!grpc) {
     HttpResponse builtin;
@@ -413,7 +413,8 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
   // Shared resolution/admission ladder — identical routing AND the same
   // auth/interceptor gates as HTTP/1.1 and brt_std.
   HttpAdmission adm;
-  if (!AdmitHttpRequest(server, path, auth_cred, s->remote(), &adm)) {
+  if (!AdmitHttpRequest(server, path, auth_cred, s->remote(), &adm,
+                        auth_verified)) {
     fail(adm.http_status, adm.error, adm.grpc_status);
     return;
   }

@@ -8,7 +8,7 @@ namespace brt {
 
 bool AdmitHttpRequest(Server* server, const std::string& path,
                       const std::string& auth, const EndPoint& remote,
-                      HttpAdmission* out) {
+                      HttpAdmission* out, bool auth_verified) {
   if (server == nullptr || !server->IsRunning()) {
     out->http_status = 503;
     out->grpc_status = 14;  // UNAVAILABLE
@@ -17,7 +17,7 @@ bool AdmitHttpRequest(Server* server, const std::string& path,
   }
   // Credential gate first — same order as the brt protocol: nothing is
   // committed before the caller proves itself.
-  if (server->options().auth != nullptr &&
+  if (!auth_verified && server->options().auth != nullptr &&
       server->options().auth->VerifyCredential(auth, remote) != 0) {
     out->http_status = 403;
     out->grpc_status = 16;  // UNAUTHENTICATED

@@ -35,9 +35,12 @@ struct HttpAdmission {
 // MethodStatus::OnRequested, and the Interceptor — the SAME policy the
 // brt_std protocol enforces, so configuring auth cannot be bypassed by
 // switching protocols. Returns false with rejection info filled in.
+// `auth_verified`: the front-end already ran HttpAuthOk on this request
+// (the builtin-page gate) — skip re-verifying so stateful authenticators
+// (audit logs, rate counters) see each request exactly once.
 bool AdmitHttpRequest(Server* server, const std::string& path,
                       const std::string& auth, const EndPoint& remote,
-                      HttpAdmission* out);
+                      HttpAdmission* out, bool auth_verified = false);
 
 // Credential check alone (used to gate the builtin observability pages
 // before any dispatch — /hotspots etc. must not leak when auth is on).

@@ -192,13 +192,17 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
   const std::string* authz = m.header("authorization");
   const std::string auth_cred = authz ? *authz : "";
   // The builtin observability pages sit behind the same credential gate as
-  // services (only /health stays open for load-balancer probes).
-  if (m.path != "/health" &&
-      !HttpAuthOk(server, auth_cred, ptr->remote())) {
-    IOBuf body;
-    body.append("authentication failed\n");
-    respond(403, "text/plain", std::move(body));
-    return;
+  // services (only /health stays open for load-balancer probes). Verified
+  // exactly once here; AdmitHttpRequest is told not to re-verify.
+  bool auth_verified = false;
+  if (m.path != "/health") {
+    if (!HttpAuthOk(server, auth_cred, ptr->remote())) {
+      IOBuf body;
+      body.append("authentication failed\n");
+      respond(403, "text/plain", std::move(body));
+      return;
+    }
+    auth_verified = true;
   }
   HttpResponse builtin;
   if (HandleBuiltinPage(server, m.method, m.path, m.query, &builtin)) {
@@ -210,7 +214,7 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
 
   HttpAdmission adm;
   if (!AdmitHttpRequest(server, m.path, auth_cred,
-                        ptr->remote(), &adm)) {
+                        ptr->remote(), &adm, auth_verified)) {
     IOBuf body;
     body.append(adm.error + "\n");
     respond(adm.http_status, "text/plain", std::move(body));

@@ -274,10 +274,35 @@ void test_fail_limit_on_rpc_tier() {
 
 }  // namespace
 
+void test_nonrowmajor_landing_repacked() {
+  // $BRT_FAKE_COLMAJOR makes the fake store rank-2 buffers column-major
+  // and report minor_to_major={0,1} — the real TPU tunnel's landing shape.
+  // StageFromDevice must hand back dense ROW-major bytes regardless
+  // (pjrt_device.cc RepackDeviceLayout).
+  setenv("BRT_FAKE_COLMAJOR", "1", 1);
+  auto client = FakeClient(1);
+  assert(client != nullptr);
+  std::string err;
+  std::vector<float> rowmajor(4 * 6);
+  for (size_t i = 0; i < rowmajor.size(); ++i) rowmajor[i] = float(i);
+  uint64_t h = client->StageToDeviceShaped(
+      F32Buf(rowmajor), 0, PjrtClient::DType::kF32, {4, 6}, &err);
+  assert(h != 0);
+  IOBuf back;
+  assert(client->StageFromDevice(h, &back, &err) == 0);
+  auto v = ToF32(back);
+  assert(v.size() == rowmajor.size());
+  for (size_t i = 0; i < v.size(); ++i) assert(v[i] == rowmajor[i]);
+  DeviceBufferRegistry::Release(h);
+  unsetenv("BRT_FAKE_COLMAJOR");
+  printf("non-row-major landing repack OK\n");
+}
+
 int main() {
   test_device_allreduce();
   test_device_allgather();
   test_ship_the_handle_input();
+  test_nonrowmajor_landing_repacked();
   test_rpc_fallback();
   test_device_failure_falls_back();
   test_fail_limit_on_rpc_tier();

@@ -164,6 +164,10 @@ void test_big_payload(Channel& ch) {
   IOBuf req, rsp;
   req.append(big);
   ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  if (cntl.Failed()) {
+    fprintf(stderr, "big_payload FAILED: err=%d %s\n", cntl.ErrorCode(),
+            cntl.ErrorText().c_str());
+  }
   assert(!cntl.Failed());
   assert(rsp.size() == big.size());
   assert(rsp.to_string() == big);
