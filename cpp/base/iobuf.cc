@@ -1,7 +1,11 @@
 #include "base/iobuf.h"
 
+#include <sys/socket.h>
 #include <sys/uio.h>
 #include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
 
 #include "base/logging.h"
 
@@ -299,7 +303,16 @@ ssize_t IOBuf::cut_into_writev(int fd) {
     ++cnt;
   }
   if (cnt == 0) return 0;
-  ssize_t nw = ::writev(fd, iov, cnt);
+  // MSG_NOSIGNAL: a peer that closed mid-write must surface as EPIPE on
+  // this call, not kill the process with SIGPIPE (a library cannot assume
+  // the application ignores it). Non-socket fds (pipes/files in tests and
+  // tools) take the writev path.
+  msghdr msg;
+  memset(&msg, 0, sizeof(msg));
+  msg.msg_iov = iov;
+  msg.msg_iovlen = size_t(cnt);
+  ssize_t nw = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+  if (nw < 0 && errno == ENOTSOCK) nw = ::writev(fd, iov, cnt);
   if (nw > 0) pop_front(size_t(nw));
   return nw;
 }

@@ -53,7 +53,17 @@ struct Buffer {
   // so RepackDeviceLayout gets native coverage (it is a no-op on the
   // default row-major fake layout).
   bool colmajor = false;
-  std::vector<int64_t> mtm;  // lazily-built layout storage (buffer-owned)
+  // Layout storage handed out by GetMemoryLayout (buffer-owned). Built
+  // eagerly at creation: concurrent StageFromDevice on one pinned handle
+  // is a supported pattern, so no lazy mutation after publication.
+  std::vector<int64_t> mtm;
+
+  void InitLayout() {
+    const size_t rank = dims.size();
+    for (size_t i = 0; i < rank; ++i) {
+      mtm.push_back(colmajor ? int64_t(i) : int64_t(rank) - 1 - int64_t(i));
+    }
+  }
 };
 
 enum class Kind {
@@ -206,6 +216,7 @@ PJRT_Error* BufferFromHostBuffer(PJRT_Client_BufferFromHostBuffer_Args* a) {
   } else {
     b->data.assign(src, src + bytes);
   }
+  b->InitLayout();
   a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
   a->done_with_host_buffer = reinterpret_cast<PJRT_Event*>(new Event());
   return nullptr;
@@ -232,12 +243,6 @@ PJRT_Error* BufferElementType(PJRT_Buffer_ElementType_Args* a) {
 PJRT_Error* BufferGetMemoryLayout(PJRT_Buffer_GetMemoryLayout_Args* a) {
   auto* b = reinterpret_cast<Buffer*>(a->buffer);
   const size_t rank = b->dims.size();
-  if (b->mtm.empty()) {
-    for (size_t i = 0; i < rank; ++i) {
-      b->mtm.push_back(b->colmajor ? int64_t(i)
-                                   : int64_t(rank) - 1 - int64_t(i));
-    }
-  }
   memset(&a->layout, 0, sizeof(a->layout));
   a->layout.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
   a->layout.type = PJRT_Buffer_MemoryLayout_Type_Tiled;
@@ -334,6 +339,7 @@ Buffer* NewF32(const std::vector<int64_t>& dims) {
   int64_t n = 1;
   for (int64_t d : dims) n *= d;
   b->data.assign(size_t(n) * 4, 0);
+  b->InitLayout();
   return b;
 }
 float* F(Buffer* b) { return reinterpret_cast<float*>(b->data.data()); }

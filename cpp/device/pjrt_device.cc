@@ -614,7 +614,14 @@ char* PjrtClient::RepackDeviceLayout(PJRT_Buffer* buf, char* src, size_t n,
       switch (eargs.type) {
         case PJRT_Buffer_Type_PRED:
         case PJRT_Buffer_Type_S8:
-        case PJRT_Buffer_Type_U8: elem = 1; break;
+        case PJRT_Buffer_Type_U8:
+        case PJRT_Buffer_Type_F8E5M2:
+        case PJRT_Buffer_Type_F8E4M3FN:
+        case PJRT_Buffer_Type_F8E4M3B11FNUZ:
+        case PJRT_Buffer_Type_F8E5M2FNUZ:
+        case PJRT_Buffer_Type_F8E4M3FNUZ:
+        case PJRT_Buffer_Type_F8E4M3:
+        case PJRT_Buffer_Type_F8E3M4: elem = 1; break;
         case PJRT_Buffer_Type_S16:
         case PJRT_Buffer_Type_U16:
         case PJRT_Buffer_Type_F16:
@@ -626,11 +633,20 @@ char* PjrtClient::RepackDeviceLayout(PJRT_Buffer* buf, char* src, size_t n,
         case PJRT_Buffer_Type_U64:
         case PJRT_Buffer_Type_F64:
         case PJRT_Buffer_Type_C64: elem = 8; break;
-        default: elem = 0; break;
+        case PJRT_Buffer_Type_C128: elem = 16; break;
+        default: elem = 0; break;  // sub-byte (S4/U4) and unknown types
       }
     }
   }
-  if (total == 0 || elem == 0 || n != total * elem) return nullptr;
+  if (total == 0 || elem == 0 || n != total * elem) {
+    // We KNOW the landing is permuted (non-row-major layout above) but
+    // cannot repack it — surface that loudly instead of handing the
+    // caller silently transposed bytes.
+    BRT_LOG(ERROR) << "D2H landing is non-row-major but cannot be "
+                      "repacked (elem=" << elem << " total=" << total
+                   << " n=" << n << "); returning device-layout bytes";
+    return nullptr;
+  }
   // Element strides of the landed (device-layout) bytes per logical dim.
   int64_t stride[16];
   int64_t acc = 1;
