@@ -317,6 +317,9 @@ struct H2RequestCtx {
   MethodStatus* ms = nullptr;
   Server* server = nullptr;
   int64_t start_us = 0;
+  // Non-null when the request arrived as JSON and was transcoded to a
+  // thrift struct — the response transcodes back (restful bridge).
+  const Server::JsonMapping* json = nullptr;
 };
 
 void RespondH2(H2RequestCtx* ctx, int http_status,
@@ -435,6 +438,16 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
     }
   } else {
     ctx->request = std::move(st->body);
+    bool json_bad = false;
+    std::string json_err;
+    ctx->json = TranscodeJsonRequest(server, adm.service, adm.method, ctype,
+                                     &ctx->request, &json_err, &json_bad);
+    if (json_bad) {
+      server->ReturnSessionData(ctx->cntl.session_local_data());
+      FinishHttpRequest(server, adm.ms, EREQUEST, 0);
+      fail(400, json_err, 3 /*INVALID_ARGUMENT*/);
+      return;
+    }
   }
   {
     std::lock_guard<std::mutex> g(sess->mu);
@@ -445,13 +458,26 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
   }
   adm.svc->CallMethod(adm.method, &ctx->cntl, ctx->request, &ctx->response,
                       [ctx] {
-    const int ec = ctx->cntl.Failed() ? ctx->cntl.ErrorCode() : 0;
+    int ec = ctx->cntl.Failed() ? ctx->cntl.ErrorCode() : 0;
     if (ec == 0) {
       IOBuf body = std::move(ctx->response);
       body.append(std::move(ctx->cntl.response_attachment()));
-      RespondH2(ctx, 200,
-                ctx->grpc ? "application/grpc" : "application/octet-stream",
-                std::move(body), 0, "");
+      std::string ctype2 =
+          ctx->grpc ? "application/grpc" : "application/octet-stream";
+      int status = 200;
+      std::string jerr;
+      if (ctx->json != nullptr) {
+        if (TranscodeJsonResponse(ctx->json, &body, &jerr)) {
+          ctype2 = "application/json";
+        } else {
+          body.clear();
+          body.append(jerr + "\n");
+          ctype2 = "text/plain";
+          status = 500;
+          ec = ERESPONSE;  // stats must not record this 500 as a success
+        }
+      }
+      RespondH2(ctx, status, ctype2, std::move(body), 0, "");
     } else if (ctx->grpc) {
       IOBuf empty;
       RespondH2(ctx, 200, "application/grpc", std::move(empty),

@@ -1,5 +1,7 @@
 #include "rpc/http_dispatch.h"
 
+#include <string_view>
+
 #include "base/time.h"
 #include "rpc/errors.h"
 #include "rpc/server.h"
@@ -88,6 +90,67 @@ bool HttpAuthOk(Server* server, const std::string& auth,
                 const EndPoint& remote) {
   return server == nullptr || server->options().auth == nullptr ||
          server->options().auth->VerifyCredential(auth, remote) == 0;
+}
+
+const Server::JsonMapping* TranscodeJsonRequest(
+    Server* server, const std::string& service, const std::string& method,
+    const std::string* ctype, IOBuf* body, std::string* errmsg, bool* bad) {
+  *bad = false;
+  // Exactly application/json (parameters like "; charset=utf-8" allowed);
+  // distinct media types such as application/json-seq pass through raw.
+  constexpr std::string_view kJson = "application/json";
+  if (ctype == nullptr || ctype->rfind(kJson, 0) != 0 ||
+      (ctype->size() > kJson.size() && (*ctype)[kJson.size()] != ';' &&
+       (*ctype)[kJson.size()] != ' ')) {
+    return nullptr;
+  }
+  const Server::JsonMapping* jm = server->FindJsonMapping(service, method);
+  if (jm == nullptr) return nullptr;  // raw JSON passes through untouched
+  JsonValue j;
+  std::string jerr;
+  if (!JsonParse(body->to_string(), &j, &jerr)) {
+    *bad = true;
+    *errmsg = "malformed JSON: " + jerr;
+    return nullptr;
+  }
+  ThriftValue req;
+  if (!JsonToThriftStruct(j, jm->request, &req, &jerr)) {
+    *bad = true;
+    *errmsg = "JSON does not match request schema: " + jerr;
+    return nullptr;
+  }
+  IOBuf wire;
+  if (!ThriftSerializeStruct(req, &wire)) {
+    *bad = true;
+    *errmsg = "request struct serialization failed";
+    return nullptr;
+  }
+  *body = std::move(wire);
+  return jm;
+}
+
+bool TranscodeJsonResponse(const Server::JsonMapping* jm, IOBuf* body,
+                           std::string* errmsg) {
+  ThriftValue resp;
+  const ssize_t consumed = ThriftParseStruct(*body, &resp);
+  if (consumed < 0) {
+    *errmsg = "response is not a thrift struct";
+    return false;
+  }
+  if (size_t(consumed) != body->size()) {
+    // A JSON response has nowhere to carry extra bytes (e.g. a response
+    // attachment appended after the struct) — fail loudly rather than
+    // silently truncating what the handler produced.
+    *errmsg = "response has trailing bytes after the struct (JSON-mapped "
+              "methods cannot use response attachments)";
+    return false;
+  }
+  JsonValue j;
+  if (!ThriftStructToJson(resp, jm->response, &j, errmsg)) return false;
+  IOBuf out;
+  JsonSerialize(j, &out);
+  *body = std::move(out);
+  return true;
 }
 
 void FinishHttpRequest(Server* server, MethodStatus* ms, int error_code,

@@ -8,10 +8,10 @@
 #include <string>
 
 #include "base/endpoint.h"
+#include "rpc/server.h"  // Server::JsonMapping in the transcode helpers
 
 namespace brt {
 
-class Server;
 class Service;
 struct MethodStatus;
 
@@ -51,5 +51,20 @@ bool HttpAuthOk(Server* server, const std::string& auth,
 // adaptive limiter feed, concurrency release).
 void FinishHttpRequest(Server* server, MethodStatus* ms, int error_code,
                        int64_t latency_us);
+
+// Restful JSON bridge, shared by the h1 and h2 front-ends (json2pb
+// analog). When `ctype` announces application/json AND the method has a
+// Server::MapJsonMethod registration, parses the JSON body and replaces
+// it with the thrift TBinary struct the service consumes, returning the
+// mapping (the caller transcodes the response back with
+// TranscodeJsonResponse). Returns nullptr untouched when not JSON-mapped.
+// Malformed JSON / schema mismatch: nullptr with *bad=true and *errmsg.
+const Server::JsonMapping* TranscodeJsonRequest(
+    Server* server, const std::string& service, const std::string& method,
+    const std::string* ctype, IOBuf* body, std::string* errmsg, bool* bad);
+
+// Struct response -> JSON bytes per the mapping. False on mismatch.
+bool TranscodeJsonResponse(const Server::JsonMapping* jm, IOBuf* body,
+                           std::string* errmsg);
 
 }  // namespace brt

@@ -17,6 +17,7 @@
 
 #include "rpc/builtin.h"
 #include "rpc/controller.h"
+#include "rpc/errors.h"
 #include "rpc/http_dispatch.h"
 #include "rpc/http_message.h"
 #include "rpc/http_protocol.h"
@@ -169,6 +170,9 @@ struct HttpSession {
   SocketId sock;
   uint64_t seq = 0;
   HttpMessage req_head;  // headers/path kept for response shaping
+  // Non-null when the request arrived as JSON and was transcoded to a
+  // thrift struct — the response transcodes back (restful bridge).
+  const Server::JsonMapping* json = nullptr;
 };
 
 void HttpProcess(IOBuf&& msg, SocketId sid) {
@@ -223,7 +227,20 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
   Service* svc = adm.svc;
   MethodStatus* ms = adm.ms;
   const std::string rpc_method = adm.method;
+  bool json_bad = false;
+  std::string json_err;
+  const Server::JsonMapping* jm = TranscodeJsonRequest(
+      server, adm.service, adm.method, m.header("content-type"), &m.body,
+      &json_err, &json_bad);
+  if (json_bad) {
+    FinishHttpRequest(server, ms, EREQUEST, 0);
+    IOBuf body;
+    body.append(json_err + "\n");
+    respond(400, "text/plain", std::move(body));
+    return;
+  }
   auto* sess = new HttpSession;
+  sess->json = jm;
   sess->sock = sid;
   sess->seq = seq;
   sess->cntl.set_remote_side(ptr->remote());
@@ -244,9 +261,24 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
     } else {
       IOBuf body = std::move(sess->response);
       body.append(std::move(sess->cntl.response_attachment()));
-      close = MakeResponseBytes(sess->req_head, 200,
-                                "application/octet-stream", std::move(body),
-                                &out);
+      std::string ctype = "application/octet-stream";
+      int status = 200;
+      std::string jerr;
+      if (sess->json != nullptr) {
+        if (TranscodeJsonResponse(sess->json, &body, &jerr)) {
+          ctype = "application/json";
+        } else {
+          body.clear();
+          body.append(jerr + "\n");
+          ctype = "text/plain";
+          status = 500;
+          // Surface in server stats too (error counters, /status, LB
+          // feedback) — the client saw a 500, not a success.
+          sess->cntl.SetFailed(ERESPONSE, "%s", jerr.c_str());
+        }
+      }
+      close = MakeResponseBytes(sess->req_head, status, ctype,
+                                std::move(body), &out);
     }
     SocketUniquePtr p2;
     if (Socket::Address(sess->sock, &p2) == 0) {

@@ -1,0 +1,634 @@
+#include "rpc/json.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace brt {
+
+JsonValue JsonValue::Bool(bool v) {
+  JsonValue j;
+  j.type = Type::kBool;
+  j.b = v;
+  return j;
+}
+JsonValue JsonValue::Int(int64_t v) {
+  JsonValue j;
+  j.type = Type::kInt;
+  j.i = v;
+  return j;
+}
+JsonValue JsonValue::Double(double v) {
+  JsonValue j;
+  j.type = Type::kDouble;
+  j.d = v;
+  return j;
+}
+JsonValue JsonValue::String(std::string v) {
+  JsonValue j;
+  j.type = Type::kString;
+  j.str = std::move(v);
+  return j;
+}
+JsonValue JsonValue::Array() {
+  JsonValue j;
+  j.type = Type::kArray;
+  return j;
+}
+JsonValue JsonValue::Object() {
+  JsonValue j;
+  j.type = Type::kObject;
+  return j;
+}
+
+// ---------------------------------------------------------------------------
+// Parser
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr size_t kMaxJsonInput = 64u << 20;
+constexpr int kMaxJsonDepth = 64;
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  std::string* err;
+
+  bool Fail(const char* msg) {
+    if (err) *err = msg;
+    return false;
+  }
+  void SkipWs() {
+    while (p < end &&
+           (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) {
+      ++p;
+    }
+  }
+  bool Literal(const char* lit) {
+    const size_t n = strlen(lit);
+    if (size_t(end - p) < n || memcmp(p, lit, n) != 0) {
+      return Fail("bad literal");
+    }
+    p += n;
+    return true;
+  }
+
+  static void AppendUtf8(uint32_t cp, std::string* s) {
+    if (cp < 0x80) {
+      s->push_back(char(cp));
+    } else if (cp < 0x800) {
+      s->push_back(char(0xC0 | (cp >> 6)));
+      s->push_back(char(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      s->push_back(char(0xE0 | (cp >> 12)));
+      s->push_back(char(0x80 | ((cp >> 6) & 0x3F)));
+      s->push_back(char(0x80 | (cp & 0x3F)));
+    } else {
+      s->push_back(char(0xF0 | (cp >> 18)));
+      s->push_back(char(0x80 | ((cp >> 12) & 0x3F)));
+      s->push_back(char(0x80 | ((cp >> 6) & 0x3F)));
+      s->push_back(char(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  bool Hex4(uint32_t* out) {
+    if (end - p < 4) return Fail("truncated \\u escape");
+    uint32_t v = 0;
+    for (int k = 0; k < 4; ++k) {
+      const char c = *p++;
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= uint32_t(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= uint32_t(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= uint32_t(c - 'A' + 10);
+      else return Fail("bad hex in \\u escape");
+    }
+    *out = v;
+    return true;
+  }
+
+  bool String(std::string* out) {
+    if (p >= end || *p != '"') return Fail("expected string");
+    ++p;
+    while (p < end) {
+      const unsigned char c = (unsigned char)*p;
+      if (c == '"') {
+        ++p;
+        return true;
+      }
+      if (c < 0x20) return Fail("unescaped control char in string");
+      if (c != '\\') {
+        out->push_back(char(c));
+        ++p;
+        continue;
+      }
+      ++p;
+      if (p >= end) return Fail("truncated escape");
+      switch (*p++) {
+        case '"': out->push_back('"'); break;
+        case '\\': out->push_back('\\'); break;
+        case '/': out->push_back('/'); break;
+        case 'b': out->push_back('\b'); break;
+        case 'f': out->push_back('\f'); break;
+        case 'n': out->push_back('\n'); break;
+        case 'r': out->push_back('\r'); break;
+        case 't': out->push_back('\t'); break;
+        case 'u': {
+          uint32_t cp;
+          if (!Hex4(&cp)) return false;
+          if (cp >= 0xD800 && cp <= 0xDBFF) {  // high surrogate
+            if (end - p < 2 || p[0] != '\\' || p[1] != 'u') {
+              return Fail("lone high surrogate");
+            }
+            p += 2;
+            uint32_t lo;
+            if (!Hex4(&lo)) return false;
+            if (lo < 0xDC00 || lo > 0xDFFF) {
+              return Fail("bad low surrogate");
+            }
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+          } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+            return Fail("lone low surrogate");
+          }
+          AppendUtf8(cp, out);
+          break;
+        }
+        default: return Fail("bad escape");
+      }
+    }
+    return Fail("unterminated string");
+  }
+
+  bool Number(JsonValue* out) {
+    const char* start = p;
+    if (p < end && *p == '-') ++p;
+    if (p >= end) return Fail("truncated number");
+    if (*p == '0') {
+      ++p;
+    } else if (*p >= '1' && *p <= '9') {
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    } else {
+      return Fail("bad number");
+    }
+    bool integral = true;
+    if (p < end && *p == '.') {
+      integral = false;
+      ++p;
+      if (p >= end || *p < '0' || *p > '9') return Fail("bad fraction");
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      integral = false;
+      ++p;
+      if (p < end && (*p == '+' || *p == '-')) ++p;
+      if (p >= end || *p < '0' || *p > '9') return Fail("bad exponent");
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    const std::string text(start, p);
+    if (integral) {
+      errno = 0;
+      char* endp = nullptr;
+      const long long v = strtoll(text.c_str(), &endp, 10);
+      if (errno == 0 && endp == text.c_str() + text.size()) {
+        *out = JsonValue::Int(v);
+        return true;
+      }
+      // Out of int64 range: fall through to double.
+    }
+    errno = 0;
+    const double d = strtod(text.c_str(), nullptr);
+    if (errno != 0 && !std::isfinite(d)) return Fail("number overflow");
+    *out = JsonValue::Double(d);
+    return true;
+  }
+
+  bool Value(JsonValue* out, int depth) {
+    if (depth > kMaxJsonDepth) return Fail("nesting too deep");
+    SkipWs();
+    if (p >= end) return Fail("truncated document");
+    switch (*p) {
+      case '{': {
+        ++p;
+        *out = JsonValue::Object();
+        SkipWs();
+        if (p < end && *p == '}') {
+          ++p;
+          return true;
+        }
+        for (;;) {
+          SkipWs();
+          std::string key;
+          if (!String(&key)) return false;
+          SkipWs();
+          if (p >= end || *p != ':') return Fail("expected ':'");
+          ++p;
+          JsonValue v;
+          if (!Value(&v, depth + 1)) return false;
+          out->members.emplace_back(std::move(key), std::move(v));
+          SkipWs();
+          if (p >= end) return Fail("unterminated object");
+          if (*p == ',') {
+            ++p;
+            continue;
+          }
+          if (*p == '}') {
+            ++p;
+            return true;
+          }
+          return Fail("expected ',' or '}'");
+        }
+      }
+      case '[': {
+        ++p;
+        *out = JsonValue::Array();
+        SkipWs();
+        if (p < end && *p == ']') {
+          ++p;
+          return true;
+        }
+        for (;;) {
+          JsonValue v;
+          if (!Value(&v, depth + 1)) return false;
+          out->elems.push_back(std::move(v));
+          SkipWs();
+          if (p >= end) return Fail("unterminated array");
+          if (*p == ',') {
+            ++p;
+            continue;
+          }
+          if (*p == ']') {
+            ++p;
+            return true;
+          }
+          return Fail("expected ',' or ']'");
+        }
+      }
+      case '"': {
+        std::string s;
+        if (!String(&s)) return false;
+        *out = JsonValue::String(std::move(s));
+        return true;
+      }
+      case 't':
+        if (!Literal("true")) return false;
+        *out = JsonValue::Bool(true);
+        return true;
+      case 'f':
+        if (!Literal("false")) return false;
+        *out = JsonValue::Bool(false);
+        return true;
+      case 'n':
+        if (!Literal("null")) return false;
+        *out = JsonValue::Null();
+        return true;
+      default:
+        return Number(out);
+    }
+  }
+};
+
+}  // namespace
+
+bool JsonParse(std::string_view in, JsonValue* out, std::string* err) {
+  if (in.size() > kMaxJsonInput) {
+    if (err) *err = "document too large";
+    return false;
+  }
+  JsonParser ps{in.data(), in.data() + in.size(), err};
+  if (!ps.Value(out, 0)) return false;
+  ps.SkipWs();
+  if (ps.p != ps.end) {
+    if (err) *err = "trailing garbage";
+    return false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Writer
+// ---------------------------------------------------------------------------
+
+namespace {
+
+void EscapeTo(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (const unsigned char c : s) {
+    switch (c) {
+      case '"': out->append("\\\""); break;
+      case '\\': out->append("\\\\"); break;
+      case '\b': out->append("\\b"); break;
+      case '\f': out->append("\\f"); break;
+      case '\n': out->append("\\n"); break;
+      case '\r': out->append("\\r"); break;
+      case '\t': out->append("\\t"); break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out->append(buf);
+        } else {
+          out->push_back(char(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void SerializeTo(const JsonValue& v, std::string* out) {
+  switch (v.type) {
+    case JsonValue::Type::kNull: out->append("null"); break;
+    case JsonValue::Type::kBool: out->append(v.b ? "true" : "false"); break;
+    case JsonValue::Type::kInt: out->append(std::to_string(v.i)); break;
+    case JsonValue::Type::kDouble: {
+      if (!std::isfinite(v.d)) {
+        out->append("null");  // JSON has no Inf/NaN
+        break;
+      }
+      char buf[32];
+      // Shortest representation that round-trips a double.
+      snprintf(buf, sizeof(buf), "%.17g", v.d);
+      double back = strtod(buf, nullptr);
+      if (back == v.d) {
+        char probe[32];
+        for (int prec = 1; prec < 17; ++prec) {
+          snprintf(probe, sizeof(probe), "%.*g", prec, v.d);
+          if (strtod(probe, nullptr) == v.d) {
+            memcpy(buf, probe, sizeof(probe));
+            break;
+          }
+        }
+      }
+      out->append(buf);
+      break;
+    }
+    case JsonValue::Type::kString: EscapeTo(v.str, out); break;
+    case JsonValue::Type::kArray: {
+      out->push_back('[');
+      for (size_t i = 0; i < v.elems.size(); ++i) {
+        if (i) out->push_back(',');
+        SerializeTo(v.elems[i], out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case JsonValue::Type::kObject: {
+      out->push_back('{');
+      for (size_t i = 0; i < v.members.size(); ++i) {
+        if (i) out->push_back(',');
+        EscapeTo(v.members[i].first, out);
+        out->push_back(':');
+        SerializeTo(v.members[i].second, out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+std::string JsonToString(const JsonValue& v) {
+  std::string s;
+  SerializeTo(v, &s);
+  return s;
+}
+
+void JsonSerialize(const JsonValue& v, IOBuf* out) {
+  out->append(JsonToString(v));
+}
+
+// ---------------------------------------------------------------------------
+// Schema bridge
+// ---------------------------------------------------------------------------
+
+namespace {
+
+bool FieldFail(std::string* err, const std::string& name, const char* msg) {
+  if (err) *err = "field '" + name + "': " + msg;
+  return false;
+}
+
+bool IntInRange(int64_t v, TType t) {
+  switch (t) {
+    case TType::BYTE: return v >= -128 && v <= 127;
+    case TType::I16: return v >= -32768 && v <= 32767;
+    case TType::I32: return v >= INT32_MIN && v <= INT32_MAX;
+    default: return true;  // I64
+  }
+}
+
+bool JsonToThriftValue(const JsonValue& j, const JsonFieldSpec& f,
+                       TType t, const std::string& name, ThriftValue* out,
+                       std::string* err);
+
+bool JsonToThriftScalar(const JsonValue& j, TType t, const std::string& name,
+                        ThriftValue* out, std::string* err) {
+  switch (t) {
+    case TType::BOOL:
+      if (j.type != JsonValue::Type::kBool) {
+        return FieldFail(err, name, "expected bool");
+      }
+      *out = ThriftValue::Bool(j.b);
+      return true;
+    case TType::BYTE:
+    case TType::I16:
+    case TType::I32:
+    case TType::I64:
+      if (j.type != JsonValue::Type::kInt) {
+        return FieldFail(err, name, "expected integer");
+      }
+      if (!IntInRange(j.i, t)) return FieldFail(err, name, "out of range");
+      out->type = t;
+      out->i = j.i;
+      return true;
+    case TType::DOUBLE:
+      if (j.type != JsonValue::Type::kInt &&
+          j.type != JsonValue::Type::kDouble) {
+        return FieldFail(err, name, "expected number");
+      }
+      *out = ThriftValue::Double(j.as_double());
+      return true;
+    case TType::STRING:
+      if (j.type != JsonValue::Type::kString) {
+        return FieldFail(err, name, "expected string");
+      }
+      *out = ThriftValue::String(j.str);
+      return true;
+    default:
+      return FieldFail(err, name, "unsupported scalar type");
+  }
+}
+
+bool JsonToThriftValue(const JsonValue& j, const JsonFieldSpec& f,
+                       TType t, const std::string& name, ThriftValue* out,
+                       std::string* err) {
+  switch (t) {
+    case TType::STRUCT: {
+      if (j.type != JsonValue::Type::kObject) {
+        return FieldFail(err, name, "expected object");
+      }
+      if (f.sub == nullptr) {
+        return FieldFail(err, name, "schema missing sub-struct");
+      }
+      return JsonToThriftStruct(j, *f.sub, out, err);
+    }
+    case TType::LIST: {
+      if (j.type != JsonValue::Type::kArray) {
+        return FieldFail(err, name, "expected array");
+      }
+      out->type = TType::LIST;
+      out->elem_type = f.sub != nullptr ? TType::STRUCT : f.elem;
+      for (const auto& e : j.elems) {
+        ThriftValue ev;
+        if (out->elem_type == TType::STRUCT) {
+          if (e.type != JsonValue::Type::kObject) {
+            return FieldFail(err, name, "expected array of objects");
+          }
+          if (!JsonToThriftStruct(e, *f.sub, &ev, err)) return false;
+        } else {
+          if (!JsonToThriftScalar(e, out->elem_type, name, &ev, err)) {
+            return false;
+          }
+        }
+        out->elems.push_back(std::move(ev));
+      }
+      return true;
+    }
+    case TType::MAP: {
+      if (j.type != JsonValue::Type::kObject) {
+        return FieldFail(err, name, "expected object (map)");
+      }
+      out->type = TType::MAP;
+      out->key_type = TType::STRING;
+      out->val_type = f.sub != nullptr ? TType::STRUCT : f.elem;
+      for (const auto& [k, v] : j.members) {
+        ThriftValue kv = ThriftValue::String(k);
+        ThriftValue vv;
+        if (out->val_type == TType::STRUCT) {
+          if (v.type != JsonValue::Type::kObject) {
+            return FieldFail(err, name, "expected object map values");
+          }
+          if (!JsonToThriftStruct(v, *f.sub, &vv, err)) return false;
+        } else {
+          if (!JsonToThriftScalar(v, out->val_type, name, &vv, err)) {
+            return false;
+          }
+        }
+        out->kvs.emplace_back(std::move(kv), std::move(vv));
+      }
+      return true;
+    }
+    default:
+      return JsonToThriftScalar(j, t, name, out, err);
+  }
+}
+
+bool ThriftToJsonScalar(const ThriftValue& v, JsonValue* out,
+                        std::string* err) {
+  switch (v.type) {
+    case TType::BOOL: *out = JsonValue::Bool(v.b); return true;
+    case TType::BYTE:
+    case TType::I16:
+    case TType::I32:
+    case TType::I64: *out = JsonValue::Int(v.i); return true;
+    case TType::DOUBLE: *out = JsonValue::Double(v.d); return true;
+    case TType::STRING: *out = JsonValue::String(v.str); return true;
+    default:
+      if (err) *err = "unsupported scalar in struct";
+      return false;
+  }
+}
+
+bool ThriftToJsonValue(const ThriftValue& v, const JsonFieldSpec& f,
+                       JsonValue* out, std::string* err) {
+  switch (v.type) {
+    case TType::STRUCT:
+      if (f.sub == nullptr) {
+        if (err) *err = "schema missing sub-struct";
+        return false;
+      }
+      return ThriftStructToJson(v, *f.sub, out, err);
+    case TType::LIST:
+    case TType::SET: {
+      *out = JsonValue::Array();
+      for (const auto& e : v.elems) {
+        JsonValue je;
+        if (e.type == TType::STRUCT) {
+          if (f.sub == nullptr) {
+            if (err) *err = "schema missing sub-struct";
+            return false;
+          }
+          if (!ThriftStructToJson(e, *f.sub, &je, err)) return false;
+        } else {
+          if (!ThriftToJsonScalar(e, &je, err)) return false;
+        }
+        out->elems.push_back(std::move(je));
+      }
+      return true;
+    }
+    case TType::MAP: {
+      *out = JsonValue::Object();
+      for (const auto& [k, val] : v.kvs) {
+        if (k.type != TType::STRING) {
+          if (err) *err = "only string-keyed maps map to JSON";
+          return false;
+        }
+        JsonValue jv;
+        if (val.type == TType::STRUCT) {
+          if (f.sub == nullptr) {
+            if (err) *err = "schema missing sub-struct";
+            return false;
+          }
+          if (!ThriftStructToJson(val, *f.sub, &jv, err)) return false;
+        } else {
+          if (!ThriftToJsonScalar(val, &jv, err)) return false;
+        }
+        out->members.emplace_back(k.str, std::move(jv));
+      }
+      return true;
+    }
+    default:
+      return ThriftToJsonScalar(v, out, err);
+  }
+}
+
+}  // namespace
+
+bool JsonToThriftStruct(const JsonValue& j, const StructSchema& s,
+                        ThriftValue* out, std::string* err) {
+  if (j.type != JsonValue::Type::kObject) {
+    if (err) *err = "expected JSON object";
+    return false;
+  }
+  *out = ThriftValue::Struct();
+  for (const auto& [key, val] : j.members) {
+    const JsonFieldSpec* f = s.by_name(key);
+    if (f == nullptr) {
+      if (err) *err = "unknown field '" + key + "'";
+      return false;
+    }
+    ThriftValue tv;
+    if (!JsonToThriftValue(val, *f, f->type, key, &tv, err)) return false;
+    out->add_field(f->id, std::move(tv));
+  }
+  return true;
+}
+
+bool ThriftStructToJson(const ThriftValue& v, const StructSchema& s,
+                        JsonValue* out, std::string* err) {
+  if (v.type != TType::STRUCT) {
+    if (err) *err = "expected thrift STRUCT";
+    return false;
+  }
+  *out = JsonValue::Object();
+  for (const auto& [id, fv] : v.fields) {
+    const auto* named = s.by_id(id);
+    if (named == nullptr) continue;  // unknown id: skip (fwd compat)
+    JsonValue jv;
+    if (!ThriftToJsonValue(fv, named->second, &jv, err)) return false;
+    out->members.emplace_back(named->first, std::move(jv));
+  }
+  return true;
+}
+
+}  // namespace brt

@@ -1,0 +1,136 @@
+// JSON codec + struct bridge — the json2pb analog for an IDL-light
+// framework. Parity target: reference src/json2pb/json_to_pb.cpp /
+// pb_to_json.cpp (~1.7k LoC on rapidjson), which powers HTTP+JSON access
+// to the same services binary protocols serve. Redesigned: instead of
+// protobuf descriptors, a StructSchema maps JSON object keys onto the
+// ThriftValue wire DOM (rpc/thrift_binary.h) — one registered service is
+// then callable via thrift TBinary RPC and restful HTTP+JSON with the
+// transcoding handled by the server (http_dispatch.cc), exactly the
+// reference's restful contract.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include "base/iobuf.h"
+#include "rpc/thrift_binary.h"
+
+namespace brt {
+
+// ---------------------------------------------------------------------------
+// JsonValue: a small ordered DOM.
+// ---------------------------------------------------------------------------
+
+struct JsonValue {
+  enum class Type { kNull, kBool, kInt, kDouble, kString, kArray, kObject };
+  Type type = Type::kNull;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string str;
+  std::vector<JsonValue> elems;                             // kArray
+  std::vector<std::pair<std::string, JsonValue>> members;  // kObject, ordered
+
+  const JsonValue* member(std::string_view key) const {
+    for (const auto& [k, v] : members) {
+      if (k == key) return &v;
+    }
+    return nullptr;
+  }
+  double as_double() const { return type == Type::kInt ? double(i) : d; }
+
+  static JsonValue Null() { return JsonValue(); }
+  static JsonValue Bool(bool v);
+  static JsonValue Int(int64_t v);
+  static JsonValue Double(double v);
+  static JsonValue String(std::string v);
+  static JsonValue Array();
+  static JsonValue Object();
+};
+
+// Strict RFC 8259 parse of exactly one document (trailing whitespace ok,
+// trailing garbage is an error). Bounds: depth <= 64, input <= 64MB.
+// Integral numbers that fit int64 parse as kInt, everything else kDouble.
+// \uXXXX escapes (incl. surrogate pairs) decode to UTF-8. Returns false
+// with *err set on malformed input.
+bool JsonParse(std::string_view in, JsonValue* out, std::string* err);
+
+// Serializes (minified). Strings escape ", \, control chars. kDouble uses
+// shortest round-trip formatting.
+void JsonSerialize(const JsonValue& v, IOBuf* out);
+std::string JsonToString(const JsonValue& v);
+
+// ---------------------------------------------------------------------------
+// StructSchema: JSON key <-> thrift field-id mapping (descriptor analog).
+// ---------------------------------------------------------------------------
+
+struct StructSchema;
+
+struct JsonFieldSpec {
+  int16_t id = 0;
+  TType type = TType::STOP;     // BOOL/BYTE/I16/I32/I64/DOUBLE/STRING/
+                                // STRUCT/LIST/MAP
+  TType elem = TType::STOP;     // LIST element type / MAP value type
+  std::shared_ptr<StructSchema> sub;  // STRUCT, or LIST/MAP of STRUCT
+};
+
+struct StructSchema {
+  // Ordered: serialization follows declaration order, like an IDL.
+  std::vector<std::pair<std::string, JsonFieldSpec>> fields;
+
+  StructSchema& Add(std::string name, int16_t id, TType type) {
+    fields.emplace_back(std::move(name), JsonFieldSpec{id, type, TType::STOP,
+                                                       nullptr});
+    return *this;
+  }
+  StructSchema& AddStruct(std::string name, int16_t id,
+                          std::shared_ptr<StructSchema> sub) {
+    fields.emplace_back(std::move(name),
+                        JsonFieldSpec{id, TType::STRUCT, TType::STOP,
+                                      std::move(sub)});
+    return *this;
+  }
+  StructSchema& AddList(std::string name, int16_t id, TType elem,
+                        std::shared_ptr<StructSchema> sub = nullptr) {
+    fields.emplace_back(std::move(name),
+                        JsonFieldSpec{id, TType::LIST, elem, std::move(sub)});
+    return *this;
+  }
+  // MAP: keys are JSON object keys (STRING on the wire), `elem` the value
+  // type.
+  StructSchema& AddMap(std::string name, int16_t id, TType elem,
+                       std::shared_ptr<StructSchema> sub = nullptr) {
+    fields.emplace_back(std::move(name),
+                        JsonFieldSpec{id, TType::MAP, elem, std::move(sub)});
+    return *this;
+  }
+  const JsonFieldSpec* by_name(std::string_view name) const {
+    for (const auto& [n, f] : fields) {
+      if (n == name) return &f;
+    }
+    return nullptr;
+  }
+  const std::pair<std::string, JsonFieldSpec>* by_id(int16_t id) const {
+    for (const auto& p : fields) {
+      if (p.second.id == id) return &p;
+    }
+    return nullptr;
+  }
+};
+
+// JSON object -> thrift STRUCT per schema. Unknown keys are errors (the
+// reference json2pb rejects unknown fields unless configured); missing
+// keys are simply absent fields. Numeric coercions: kInt accepted for all
+// integer widths (range-checked) and DOUBLE; kDouble only for DOUBLE.
+bool JsonToThriftStruct(const JsonValue& j, const StructSchema& s,
+                        ThriftValue* out, std::string* err);
+
+// thrift STRUCT -> JSON object per schema. Fields whose id the schema does
+// not know are skipped (forward compatibility).
+bool ThriftStructToJson(const ThriftValue& v, const StructSchema& s,
+                        JsonValue* out, std::string* err);
+
+}  // namespace brt

@@ -26,6 +26,21 @@ int Server::AddService(Service* svc, const std::string& name) {
   return 0;
 }
 
+int Server::MapJsonMethod(const std::string& service,
+                          const std::string& method, StructSchema request,
+                          StructSchema response) {
+  if (running_.load()) return EPERM;  // same contract as AddService
+  json_methods_[service + "/" + method] =
+      JsonMapping{std::move(request), std::move(response)};
+  return 0;
+}
+
+const Server::JsonMapping* Server::FindJsonMapping(
+    const std::string& service, const std::string& method) const {
+  auto it = json_methods_.find(service + "/" + method);
+  return it == json_methods_.end() ? nullptr : &it->second;
+}
+
 int Server::Start(const std::string& addr, const Options* opts) {
   EndPoint ep;
   if (!EndPoint::parse(addr, &ep)) return EINVAL;

@@ -17,6 +17,7 @@
 #include "rpc/authenticator.h"
 #include "rpc/concurrency_limiter.h"
 #include "rpc/controller.h"
+#include "rpc/json.h"
 #include "transport/acceptor.h"
 #include "var/latency_recorder.h"
 
@@ -102,6 +103,23 @@ class Server {
   // Start. Ownership stays with the caller.
   int AddService(Service* svc, const std::string& name);
 
+  // Restful JSON bridge (json2pb analog, rpc/json.h; reference
+  // src/json2pb/ + policy/http_rpc_protocol.cpp restful mapping): HTTP
+  // requests for service/method carrying Content-Type: application/json
+  // are parsed and transcoded into the thrift TBinary struct the service
+  // consumes; the struct response transcodes back to JSON. The same
+  // method stays callable with raw TBinary over any binary protocol —
+  // one service, every access protocol. Must precede Start.
+  struct JsonMapping {
+    StructSchema request;
+    StructSchema response;
+  };
+  // Returns 0, or EPERM after Start (same contract as AddService).
+  int MapJsonMethod(const std::string& service, const std::string& method,
+                    StructSchema request, StructSchema response);
+  const JsonMapping* FindJsonMapping(const std::string& service,
+                                     const std::string& method) const;
+
   // Binds "ip:port" (port 0 = ephemeral) and serves. Returns 0 on success.
   int Start(const std::string& addr, const Options* opts = nullptr);
   int Start(const EndPoint& addr, const Options* opts = nullptr);
@@ -172,6 +190,9 @@ class Server {
   Options options_;
   Acceptor acceptor_;
   std::unordered_map<std::string, Service*> services_;
+  // Populated before Start, read-only afterwards (no lock needed on the
+  // request path).
+  std::unordered_map<std::string, JsonMapping> json_methods_;
   mutable std::shared_mutex method_mu_;
   std::unordered_map<std::string, std::unique_ptr<MethodStatus>> methods_;
   std::atomic<int> concurrency_{0};

@@ -176,6 +176,30 @@ void test_grpc_timeout_parse() {
 
 }  // namespace
 
+// TBinary struct in, struct out ({1: list<i64>} -> {1: sum}) — used to
+// prove the restful JSON bridge works identically on the h2 front-end.
+class SumService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    ThriftValue req;
+    if (method != "Sum" || ThriftParseStruct(request, &req) < 0) {
+      cntl->SetFailed(EREQUEST, "bad request");
+      done();
+      return;
+    }
+    int64_t sum = 0;
+    if (const ThriftValue* vals = req.field(1)) {
+      for (const auto& e : vals->elems) sum += e.i;
+    }
+    ThriftValue resp = ThriftValue::Struct();
+    resp.add_field(1, ThriftValue::I64(sum));
+    ThriftSerializeStruct(resp, response);
+    done();
+  }
+};
+
 int main() {
   fiber_init(4);
   test_grpc_timeout_parse();
@@ -183,8 +207,43 @@ int main() {
   Server server;
   EchoService echo;
   assert(server.AddService(&echo, "Echo") == 0);
+  SumService sum;
+  assert(server.AddService(&sum, "Calc") == 0);
+  {
+    StructSchema req_schema, resp_schema;
+    req_schema.AddList("vals", 1, TType::I64);
+    resp_schema.Add("sum", 1, TType::I64);
+    server.MapJsonMethod("Calc", "Sum", req_schema, resp_schema);
+  }
   assert(server.Start("127.0.0.1:0") == 0);
   const EndPoint addr = server.listen_address();
+
+  // ---- restful JSON over h2 (same bridge as HTTP/1.1) ----
+  {
+    H2Client c(addr);
+    c.SendHeaders(1,
+                  {{":method", "POST"},
+                   {":scheme", "http"},
+                   {":path", "/Calc/Sum"},
+                   {":authority", "test"},
+                   {"content-type", "application/json"}},
+                  false);
+    c.SendData(1, R"({"vals":[1,2,40]})", true);
+    std::string status, body;
+    for (;;) {
+      Frame f = c.ReadContentFrame();
+      if (f.type == uint8_t(H2FrameType::HEADERS)) {
+        HeaderList resp = c.DecodeHeaders(f);
+        if (const std::string* s = Find(resp, ":status")) status = *s;
+      } else if (f.type == uint8_t(H2FrameType::DATA)) {
+        body += f.payload;
+        if (f.flags & kH2FlagEndStream) break;
+      }
+    }
+    assert(status == "200");
+    assert(body == R"({"sum":43})");
+    printf("h2 restful json OK\n");
+  }
 
   // ---- plain h2 GET on a builtin page ----
   {

@@ -1,0 +1,268 @@
+// JSON codec + restful bridge tests (json2pb analog).
+// Reference model: test/brpc_protobuf_json_unittest.cpp (codec vectors) +
+// brpc_http_rpc_protocol_unittest.cpp (pb service over HTTP+JSON). Here
+// the same SumService is exercised over raw thrift TBinary AND over
+// HTTP/1.1 with application/json — one registration, both access paths.
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "rpc/json.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+namespace {
+
+void test_parse_scalars() {
+  JsonValue v;
+  std::string err;
+  assert(JsonParse("42", &v, &err) && v.type == JsonValue::Type::kInt &&
+         v.i == 42);
+  assert(JsonParse("-7", &v, &err) && v.i == -7);
+  assert(JsonParse("3.5", &v, &err) &&
+         v.type == JsonValue::Type::kDouble && v.d == 3.5);
+  assert(JsonParse("1e3", &v, &err) && v.d == 1000.0);
+  assert(JsonParse("true", &v, &err) && v.b);
+  assert(JsonParse("null", &v, &err) &&
+         v.type == JsonValue::Type::kNull);
+  assert(JsonParse("\"hi\"", &v, &err) && v.str == "hi");
+  // int64 overflow degrades to double, not failure
+  assert(JsonParse("99999999999999999999", &v, &err) &&
+         v.type == JsonValue::Type::kDouble);
+  printf("json scalars OK\n");
+}
+
+void test_parse_strings() {
+  JsonValue v;
+  std::string err;
+  assert(JsonParse(R"("a\"b\\c\/d\n\t")", &v, &err));
+  assert(v.str == "a\"b\\c/d\n\t");
+  // \u escape + surrogate pair
+  assert(JsonParse(R"("Aé中😀")", &v, &err));
+  assert(v.str == "A\xc3\xa9\xe4\xb8\xad\xf0\x9f\x98\x80");
+  assert(!JsonParse(R"("\ud800")", &v, &err));       // lone surrogate
+  assert(!JsonParse("\"a\nb\"", &v, &err));          // raw control char
+  assert(!JsonParse(R"("\x41")", &v, &err));         // bad escape
+  printf("json strings OK\n");
+}
+
+void test_parse_structure() {
+  JsonValue v;
+  std::string err;
+  assert(JsonParse(R"({"a":[1,2,{"b":null}],"c":{}})", &v, &err));
+  assert(v.type == JsonValue::Type::kObject && v.members.size() == 2);
+  const JsonValue* a = v.member("a");
+  assert(a != nullptr && a->elems.size() == 3);
+  assert(a->elems[2].member("b")->type == JsonValue::Type::kNull);
+  // strictness
+  assert(!JsonParse("{", &v, &err));
+  assert(!JsonParse("[1,]", &v, &err));
+  assert(!JsonParse("{\"a\":1,}", &v, &err));
+  assert(!JsonParse("[1] x", &v, &err));   // trailing garbage
+  assert(!JsonParse("'a'", &v, &err));
+  assert(!JsonParse("{a:1}", &v, &err));   // unquoted key
+  // depth bound
+  std::string deep(100, '[');
+  deep += std::string(100, ']');
+  assert(!JsonParse(deep, &v, &err));
+  printf("json structure OK\n");
+}
+
+void test_roundtrip() {
+  const char* docs[] = {
+      R"({"a":1,"b":[true,false,null],"c":{"d":"x\ny"},"e":-2.5})",
+      R"([])",
+      R"({})",
+      R"(["中"])",
+  };
+  for (const char* doc : docs) {
+    JsonValue v;
+    std::string err;
+    assert(JsonParse(doc, &v, &err));
+    const std::string out = JsonToString(v);
+    JsonValue v2;
+    assert(JsonParse(out, &v2, &err));
+    assert(JsonToString(v2) == out);  // serialize is a fixed point
+  }
+  // double round trip is exact
+  JsonValue v;
+  std::string err;
+  assert(JsonParse("0.1", &v, &err));
+  JsonValue v2;
+  assert(JsonParse(JsonToString(v), &v2, &err));
+  assert(v2.d == v.d);
+  printf("json roundtrip OK\n");
+}
+
+std::shared_ptr<StructSchema> PointSchema() {
+  auto s = std::make_shared<StructSchema>();
+  s->Add("x", 1, TType::I32).Add("y", 2, TType::I32);
+  return s;
+}
+
+void test_schema_bridge() {
+  StructSchema req;
+  req.Add("name", 1, TType::STRING)
+     .Add("count", 2, TType::I64)
+     .Add("ratio", 3, TType::DOUBLE)
+     .Add("on", 4, TType::BOOL)
+     .AddList("vals", 5, TType::I32)
+     .AddStruct("origin", 6, PointSchema())
+     .AddList("points", 7, TType::STRUCT, PointSchema())
+     .AddMap("tags", 8, TType::STRING);
+  JsonValue j;
+  std::string err;
+  assert(JsonParse(
+      R"({"name":"n","count":9,"ratio":0.5,"on":true,"vals":[1,2,3],)"
+      R"("origin":{"x":4,"y":5},"points":[{"x":1,"y":2}],)"
+      R"("tags":{"k":"v"}})",
+      &j, &err));
+  ThriftValue tv;
+  assert(JsonToThriftStruct(j, req, &tv, &err));
+  // wire round trip through TBinary
+  IOBuf wire;
+  assert(ThriftSerializeStruct(tv, &wire));
+  ThriftValue back;
+  assert(ThriftParseStruct(wire, &back) > 0);
+  JsonValue j2;
+  assert(ThriftStructToJson(back, req, &j2, &err));
+  assert(JsonToString(j2) == JsonToString(j));
+  // type errors are rejected, not coerced
+  JsonValue bad;
+  assert(JsonParse(R"({"count":"nope"})", &bad, &err));
+  assert(!JsonToThriftStruct(bad, req, &tv, &err));
+  assert(JsonParse(R"({"unknown":1})", &bad, &err));
+  assert(!JsonToThriftStruct(bad, req, &tv, &err));
+  assert(JsonParse(R"({"vals":[300000000000]})", &bad, &err));
+  assert(!JsonToThriftStruct(bad, req, &tv, &err));  // i32 range
+  printf("schema bridge OK\n");
+}
+
+// Consumes a TBinary struct {1: list<i64> vals}, replies {1: i64 sum} —
+// the service itself never sees JSON.
+class SumService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    ThriftValue req;
+    if (method != "Sum" || ThriftParseStruct(request, &req) < 0) {
+      cntl->SetFailed(EREQUEST, "bad request");
+      done();
+      return;
+    }
+    int64_t sum = 0;
+    if (const ThriftValue* vals = req.field(1)) {
+      for (const auto& e : vals->elems) sum += e.i;
+    }
+    ThriftValue resp = ThriftValue::Struct();
+    resp.add_field(1, ThriftValue::I64(sum));
+    assert(ThriftSerializeStruct(resp, response));
+    done();
+  }
+};
+
+std::string HttpRoundtrip(const EndPoint& addr, const std::string& req) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  assert(fd >= 0);
+  sockaddr_in sa = addr.to_sockaddr();
+  assert(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0);
+  assert(write(fd, req.data(), req.size()) == ssize_t(req.size()));
+  std::string out;
+  char buf[4096];
+  for (;;) {
+    ssize_t n = read(fd, buf, sizeof(buf));
+    if (n <= 0) break;
+    out.append(buf, size_t(n));
+  }
+  close(fd);
+  return out;
+}
+
+void test_restful_http_json() {
+  Server server;
+  SumService sum;
+  assert(server.AddService(&sum, "Calc") == 0);
+  StructSchema req_schema, resp_schema;
+  req_schema.AddList("vals", 1, TType::I64);
+  resp_schema.Add("sum", 1, TType::I64);
+  server.MapJsonMethod("Calc", "Sum", req_schema, resp_schema);
+  assert(server.Start("127.0.0.1:0") == 0);
+  const EndPoint addr = server.listen_address();
+
+  const std::string body = R"({"vals":[1,2,3,40]})";
+  std::string http = "POST /Calc/Sum HTTP/1.1\r\n"
+                     "Content-Type: application/json\r\n"
+                     "Content-Length: " + std::to_string(body.size()) +
+                     "\r\nConnection: close\r\n\r\n" + body;
+  std::string resp = HttpRoundtrip(addr, http);
+  assert(resp.rfind("HTTP/1.1 200", 0) == 0);
+  assert(resp.find("application/json") != std::string::npos);
+  assert(resp.find(R"({"sum":46})") != std::string::npos);
+
+  // Bad JSON answers 400 before the service runs.
+  const std::string bad = "{\"vals\":[1,";
+  http = "POST /Calc/Sum HTTP/1.1\r\n"
+         "Content-Type: application/json\r\n"
+         "Content-Length: " + std::to_string(bad.size()) +
+         "\r\nConnection: close\r\n\r\n" + bad;
+  resp = HttpRoundtrip(addr, http);
+  assert(resp.rfind("HTTP/1.1 400", 0) == 0);
+
+  // Schema mismatch answers 400 too.
+  const std::string wrong = R"({"vals":"nope"})";
+  http = "POST /Calc/Sum HTTP/1.1\r\n"
+         "Content-Type: application/json\r\n"
+         "Content-Length: " + std::to_string(wrong.size()) +
+         "\r\nConnection: close\r\n\r\n" + wrong;
+  resp = HttpRoundtrip(addr, http);
+  assert(resp.rfind("HTTP/1.1 400", 0) == 0);
+
+  // The SAME method still takes raw TBinary bytes (one service, every
+  // access protocol): non-JSON content type passes through untouched.
+  ThriftValue treq = ThriftValue::Struct();
+  ThriftValue vals = ThriftValue::List(TType::I64);
+  for (int64_t v : {5, 6}) vals.elems.push_back(ThriftValue::I64(v));
+  treq.add_field(1, std::move(vals));
+  IOBuf twire;
+  assert(ThriftSerializeStruct(treq, &twire));
+  const std::string tbody = twire.to_string();
+  http = "POST /Calc/Sum HTTP/1.1\r\n"
+         "Content-Type: application/octet-stream\r\n"
+         "Content-Length: " + std::to_string(tbody.size()) +
+         "\r\nConnection: close\r\n\r\n" + tbody;
+  resp = HttpRoundtrip(addr, http);
+  assert(resp.rfind("HTTP/1.1 200", 0) == 0);
+  const size_t hdr_end = resp.find("\r\n\r\n");
+  assert(hdr_end != std::string::npos);
+  IOBuf rwire;
+  rwire.append(resp.substr(hdr_end + 4));
+  ThriftValue tresp;
+  assert(ThriftParseStruct(rwire, &tresp) > 0);
+  assert(tresp.field(1) != nullptr && tresp.field(1)->i == 11);
+
+  server.Stop();
+  server.Join();
+  printf("restful http+json OK\n");
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  test_parse_scalars();
+  test_parse_strings();
+  test_parse_structure();
+  test_roundtrip();
+  test_schema_bridge();
+  test_restful_http_json();
+  printf("ALL json tests OK\n");
+  return 0;
+}
