@@ -60,7 +60,16 @@ int ClusterChannel::Init(const std::string& ns_url, const std::string& lb_name,
   int rc = InitWithLb(lb_name, opts);
   if (rc != 0) return rc;
   ns_ = StartNamingService(ns_url, [this](const std::vector<ServerNode>& s) {
-    UpdateServers(s);
+    if (options_.ns_filter != nullptr) {
+      std::vector<ServerNode> kept;
+      kept.reserve(s.size());
+      for (const ServerNode& n : s) {
+        if (options_.ns_filter->Accept(n)) kept.push_back(n);
+      }
+      UpdateServers(kept);
+    } else {
+      UpdateServers(s);
+    }
   });
   if (!ns_) {
     inited_ = false;

@@ -1,5 +1,7 @@
 #include "cluster/naming_service.h"
 
+#include "cluster/remote_naming.h"
+
 #include <netdb.h>
 #include <sys/stat.h>
 
@@ -195,6 +197,11 @@ void RegisterBuiltinNs() {
     });
     RegisterNamingService("dns", [] {
       return std::unique_ptr<NamingService>(new DnsNamingService);
+    });
+    // remote://host:port/cluster — long-poll watcher over the in-framework
+    // registry (cluster/remote_naming.h, the consul analog).
+    RegisterNamingService("remote", [] {
+      return std::unique_ptr<NamingService>(new RemoteNamingService);
     });
   });
 }

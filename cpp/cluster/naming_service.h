@@ -29,6 +29,16 @@ struct ServerNode {
 using ServerListCallback =
     std::function<void(const std::vector<ServerNode>&)>;
 
+// Drops nodes from every pushed list before the load balancer sees them
+// (reference naming_service_filter.h:31) — e.g. keep only nodes with a
+// given tag, or exclude a canary. Stateless and called concurrently.
+class NamingServiceFilter {
+ public:
+  virtual ~NamingServiceFilter() = default;
+  // True keeps the node.
+  virtual bool Accept(const ServerNode& node) const = 0;
+};
+
 class NamingService {
  public:
   virtual ~NamingService() = default;

@@ -1,0 +1,376 @@
+#include "cluster/remote_naming.h"
+
+#include <cstdlib>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "rpc/errors.h"
+#include "rpc/json.h"
+#include "rpc/server.h"
+
+namespace brt {
+
+namespace {
+
+constexpr int64_t kDefaultWatchMs = 30 * 1000;
+constexpr int64_t kMaxWatchMs = 120 * 1000;
+
+// Node struct {1:"ip:port" 2:weight 3:tag} <-> ServerNode.
+ThriftValue NodeToStruct(const ServerNode& n) {
+  ThriftValue v = ThriftValue::Struct();
+  v.add_field(1, ThriftValue::String(n.ep.to_string()));
+  v.add_field(2, ThriftValue::I32(n.weight));
+  if (!n.tag.empty()) v.add_field(3, ThriftValue::String(n.tag));
+  return v;
+}
+
+bool StructToNode(const ThriftValue& v, ServerNode* out) {
+  const ThriftValue* addr = v.field(1);
+  if (addr == nullptr || !EndPoint::parse(addr->str, &out->ep)) return false;
+  if (const ThriftValue* w = v.field(2)) {
+    out->weight = int(w->i) > 0 ? int(w->i) : 1;
+  }
+  if (const ThriftValue* t = v.field(3)) out->tag = t->str;
+  return true;
+}
+
+std::string FieldStr(const ThriftValue& req, int16_t id) {
+  const ThriftValue* f = req.field(id);
+  return f == nullptr ? std::string() : f->str;
+}
+
+int64_t FieldInt(const ThriftValue& req, int16_t id, int64_t dflt = 0) {
+  const ThriftValue* f = req.field(id);
+  return f == nullptr ? dflt : f->i;
+}
+
+}  // namespace
+
+void NamingRegistryService::SweepLocked(Cluster* c) {
+  const int64_t now = monotonic_us();
+  bool dropped = false;
+  for (size_t i = 0; i < c->entries.size();) {
+    if (c->entries[i].expire_us != 0 && c->entries[i].expire_us <= now) {
+      c->entries.erase(c->entries.begin() + ssize_t(i));
+      dropped = true;
+    } else {
+      ++i;
+    }
+  }
+  if (dropped) {
+    ++c->version;
+    changed_.notify_all();
+  }
+}
+
+void NamingRegistryService::CallMethod(const std::string& method,
+                                       Controller* cntl,
+                                       const IOBuf& request, IOBuf* response,
+                                       Closure done) {
+  ThriftValue req;
+  if (ThriftParseStruct(request, &req) < 0) {
+    cntl->SetFailed(EREQUEST, "not a thrift struct");
+    done();
+    return;
+  }
+  const std::string cluster = FieldStr(req, 1);
+  if (cluster.empty()) {
+    cntl->SetFailed(EREQUEST, "missing cluster (field 1)");
+    done();
+    return;
+  }
+  ThriftValue resp = ThriftValue::Struct();
+
+  auto list_response = [&](Cluster* c) {
+    resp.add_field(1, ThriftValue::I64(c->version));
+    ThriftValue nodes = ThriftValue::List(TType::STRUCT);
+    for (const Entry& e : c->entries) nodes.elems.push_back(
+        NodeToStruct(e.node));
+    resp.add_field(2, std::move(nodes));
+  };
+
+  if (method == "Register") {
+    ServerNode node;
+    if (!EndPoint::parse(FieldStr(req, 2), &node.ep)) {
+      cntl->SetFailed(EREQUEST, "bad address (field 2)");
+      done();
+      return;
+    }
+    node.weight = int(FieldInt(req, 3, 1));
+    if (node.weight <= 0) node.weight = 1;
+    node.tag = FieldStr(req, 4);
+    const int64_t ttl_ms = FieldInt(req, 5, 0);
+    mu_.lock();
+    Cluster& c = clusters_[cluster];
+    SweepLocked(&c);
+    bool found = false;
+    for (Entry& e : c.entries) {
+      if (e.node.ep == node.ep) {
+        // Heartbeat / update: only bump the version when the node data
+        // actually changed (pure TTL renewals must not wake watchers).
+        if (!(e.node == node)) {
+          e.node = node;
+          ++c.version;
+          changed_.notify_all();
+        }
+        e.expire_us =
+            ttl_ms > 0 ? monotonic_us() + ttl_ms * 1000 : 0;
+        found = true;
+        break;
+      }
+    }
+    if (!found) {
+      c.entries.push_back(
+          Entry{node, ttl_ms > 0 ? monotonic_us() + ttl_ms * 1000 : 0});
+      ++c.version;
+      changed_.notify_all();
+    }
+    resp.add_field(1, ThriftValue::I64(c.version));
+    mu_.unlock();
+  } else if (method == "Deregister") {
+    EndPoint ep;
+    if (!EndPoint::parse(FieldStr(req, 2), &ep)) {
+      cntl->SetFailed(EREQUEST, "bad address (field 2)");
+      done();
+      return;
+    }
+    mu_.lock();
+    Cluster& c = clusters_[cluster];
+    for (size_t i = 0; i < c.entries.size(); ++i) {
+      if (c.entries[i].node.ep == ep) {
+        c.entries.erase(c.entries.begin() + ssize_t(i));
+        ++c.version;
+        changed_.notify_all();
+        break;
+      }
+    }
+    resp.add_field(1, ThriftValue::I64(c.version));
+    mu_.unlock();
+  } else if (method == "List") {
+    mu_.lock();
+    Cluster& c = clusters_[cluster];
+    SweepLocked(&c);
+    list_response(&c);
+    mu_.unlock();
+  } else if (method == "Watch") {
+    const int64_t known = FieldInt(req, 2, 0);
+    int64_t wait_ms = FieldInt(req, 3, kDefaultWatchMs);
+    if (wait_ms < 0) wait_ms = 0;
+    if (wait_ms > kMaxWatchMs) wait_ms = kMaxWatchMs;
+    const int64_t deadline = monotonic_us() + wait_ms * 1000;
+    mu_.lock();
+    for (;;) {
+      Cluster& c = clusters_[cluster];
+      SweepLocked(&c);
+      if (c.version > known) break;
+      const int64_t now = monotonic_us();
+      if (now >= deadline) break;
+      // Slice the wait so TTL expiries surface without a dedicated sweep
+      // fiber (entries can lapse while no registration wakes us).
+      int64_t slice = deadline - now;
+      if (slice > 500 * 1000) slice = 500 * 1000;
+      changed_.wait(mu_, slice);
+    }
+    list_response(&clusters_[cluster]);
+    mu_.unlock();
+  } else {
+    cntl->SetFailed(ENOMETHOD, "no such method");
+    done();
+    return;
+  }
+  if (!ThriftSerializeStruct(resp, response)) {
+    cntl->SetFailed(ERESPONSE, "serialize failed");
+  }
+  done();
+}
+
+void NamingRegistryService::MapJsonMethods(Server* server,
+                                           const std::string& service_name) {
+  auto node = std::make_shared<StructSchema>();
+  node->Add("addr", 1, TType::STRING)
+      .Add("weight", 2, TType::I32)
+      .Add("tag", 3, TType::STRING);
+  StructSchema list_resp;
+  list_resp.Add("version", 1, TType::I64)
+           .AddList("nodes", 2, TType::STRUCT, node);
+  StructSchema reg_req;
+  reg_req.Add("cluster", 1, TType::STRING)
+         .Add("addr", 2, TType::STRING)
+         .Add("weight", 3, TType::I32)
+         .Add("tag", 4, TType::STRING)
+         .Add("ttl_ms", 5, TType::I64);
+  StructSchema ver_resp;
+  ver_resp.Add("version", 1, TType::I64);
+  StructSchema dereg_req;
+  dereg_req.Add("cluster", 1, TType::STRING).Add("addr", 2, TType::STRING);
+  StructSchema list_req;
+  list_req.Add("cluster", 1, TType::STRING);
+  StructSchema watch_req;
+  watch_req.Add("cluster", 1, TType::STRING)
+           .Add("known_version", 2, TType::I64)
+           .Add("wait_ms", 3, TType::I64);
+  server->MapJsonMethod(service_name, "Register", reg_req, ver_resp);
+  server->MapJsonMethod(service_name, "Deregister", dereg_req, ver_resp);
+  server->MapJsonMethod(service_name, "List", list_req, list_resp);
+  server->MapJsonMethod(service_name, "Watch", watch_req, list_resp);
+}
+
+// ---------------------------------------------------------------------------
+// RemoteNamingService
+// ---------------------------------------------------------------------------
+
+int RemoteNamingService::Start(const std::string& param,
+                               ServerListCallback cb) {
+  // param: "host:port/cluster[?watch_ms=N]"
+  const size_t slash = param.find('/');
+  if (slash == std::string::npos || slash + 1 >= param.size()) return EINVAL;
+  const std::string addr = param.substr(0, slash);
+  std::string rest = param.substr(slash + 1);
+  const size_t q = rest.find('?');
+  if (q != std::string::npos) {
+    const std::string query = rest.substr(q + 1);
+    rest.resize(q);
+    if (query.rfind("watch_ms=", 0) == 0) {
+      watch_ms_ = atoll(query.c_str() + 9);
+      if (watch_ms_ <= 0) watch_ms_ = kDefaultWatchMs;
+    }
+  }
+  cluster_ = rest;
+  if (cluster_.empty()) return EINVAL;
+  ChannelOptions copts;
+  copts.timeout_ms = watch_ms_ + 5000;  // must outlive the blocking Watch
+  copts.max_retry = 0;                  // the watch loop IS the retry
+  if (channel_.Init(addr, &copts) != 0) return EINVAL;
+  cb_ = std::move(cb);
+  return fiber_start(&fid_, WatchEntry, this);
+}
+
+void RemoteNamingService::Stop() {
+  if (fid_ == 0) return;
+  stopping_.store(true, std::memory_order_release);
+  {
+    std::lock_guard<std::mutex> g(cntl_mu_);
+    if (active_cntl_ != nullptr) active_cntl_->StartCancel();
+  }
+  fiber_stop(fid_);
+  fiber_join(fid_);
+  fid_ = 0;
+}
+
+void* RemoteNamingService::WatchEntry(void* arg) {
+  auto* self = static_cast<RemoteNamingService*>(arg);
+  int64_t version = 0;
+  bool first = true;
+  while (!self->stopping_.load(std::memory_order_acquire)) {
+    ThriftValue req = ThriftValue::Struct();
+    req.add_field(1, ThriftValue::String(self->cluster_));
+    req.add_field(2, ThriftValue::I64(version));
+    // First call returns immediately (known version 0 vs empty cluster
+    // version 0 — ask with wait 0) so the channel starts with a list.
+    req.add_field(3, ThriftValue::I64(first ? 0 : self->watch_ms_));
+    IOBuf reqbuf, respbuf;
+    if (!ThriftSerializeStruct(req, &reqbuf)) return nullptr;
+    Controller cntl;
+    {
+      std::lock_guard<std::mutex> g(self->cntl_mu_);
+      if (self->stopping_.load(std::memory_order_acquire)) break;
+      self->active_cntl_ = &cntl;
+    }
+    self->channel_.CallMethod("Naming", "Watch", &cntl, reqbuf, &respbuf,
+                              nullptr);
+    {
+      std::lock_guard<std::mutex> g(self->cntl_mu_);
+      self->active_cntl_ = nullptr;
+    }
+    if (self->stopping_.load(std::memory_order_acquire)) break;
+    if (cntl.Failed()) {
+      // Registry unreachable: keep the last pushed list, retry with
+      // backoff (reference NS threads are fail-safe the same way).
+      if (fiber_usleep(1000 * 1000) != 0) break;
+      continue;
+    }
+    ThriftValue resp;
+    if (ThriftParseStruct(respbuf, &resp) < 0) {
+      if (fiber_usleep(1000 * 1000) != 0) break;
+      continue;
+    }
+    const int64_t new_version = FieldInt(resp, 1, 0);
+    if (first || new_version != version) {
+      std::vector<ServerNode> nodes;
+      if (const ThriftValue* list = resp.field(2)) {
+        for (const ThriftValue& e : list->elems) {
+          ServerNode n;
+          if (StructToNode(e, &n)) nodes.push_back(n);
+        }
+      }
+      self->cb_(nodes);
+      version = new_version;
+    }
+    first = false;
+  }
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// NamingRegistrant
+// ---------------------------------------------------------------------------
+
+int NamingRegistrant::Start(const std::string& registry_addr,
+                            const std::string& cluster,
+                            const ServerNode& self, int64_t ttl_ms) {
+  cluster_ = cluster;
+  self_ = self;
+  ttl_ms_ = ttl_ms > 0 ? ttl_ms : 10 * 1000;
+  if (channel_.Init(registry_addr, nullptr) != 0) return EINVAL;
+  const int rc = RegisterOnce();
+  if (rc != 0) return rc;
+  return fiber_start(&fid_, HeartbeatEntry, this);
+}
+
+void NamingRegistrant::Stop() {
+  if (fid_ == 0) return;
+  fiber_stop(fid_);
+  fiber_join(fid_);
+  fid_ = 0;
+  // Best-effort deregistration so the entry drops before its TTL.
+  ThriftValue req = ThriftValue::Struct();
+  req.add_field(1, ThriftValue::String(cluster_));
+  req.add_field(2, ThriftValue::String(self_.ep.to_string()));
+  IOBuf reqbuf, respbuf;
+  if (ThriftSerializeStruct(req, &reqbuf)) {
+    Controller cntl;
+    channel_.CallMethod("Naming", "Deregister", &cntl, reqbuf, &respbuf,
+                        nullptr);
+  }
+}
+
+int NamingRegistrant::RegisterOnce() {
+  ThriftValue req = ThriftValue::Struct();
+  req.add_field(1, ThriftValue::String(cluster_));
+  req.add_field(2, ThriftValue::String(self_.ep.to_string()));
+  req.add_field(3, ThriftValue::I32(self_.weight));
+  if (!self_.tag.empty()) req.add_field(4, ThriftValue::String(self_.tag));
+  req.add_field(5, ThriftValue::I64(ttl_ms_));
+  IOBuf reqbuf, respbuf;
+  if (!ThriftSerializeStruct(req, &reqbuf)) return EINVAL;
+  Controller cntl;
+  channel_.CallMethod("Naming", "Register", &cntl, reqbuf, &respbuf,
+                      nullptr);
+  return cntl.Failed() ? cntl.ErrorCode() : 0;
+}
+
+void* NamingRegistrant::HeartbeatEntry(void* arg) {
+  auto* self = static_cast<NamingRegistrant*>(arg);
+  const int64_t period_us = self->ttl_ms_ * 1000 / 3;
+  while (fiber_usleep(period_us) == 0) {
+    const int rc = self->RegisterOnce();
+    if (rc != 0) {
+      BRT_LOG(WARNING) << "naming heartbeat failed: " << rc
+                       << " (entry lapses in " << self->ttl_ms_
+                       << "ms unless the registry returns)";
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace brt

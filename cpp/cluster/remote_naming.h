@@ -1,0 +1,118 @@
+// Watched remote naming — the consul/discovery/nacos analog.
+// Parity target: reference policy/consul_naming_service.cpp:73 (blocking
+// queries riding X-Consul-Index) + policy/discovery_naming_service.cpp
+// (register + heartbeat + watch). Redesigned: instead of speaking an
+// external agent's REST API, the framework ships its OWN registry — a
+// plain Service (TBinary structs; JSON-mappable like any method) that any
+// brt server can host — plus a NamingService client that long-polls it.
+// A version number plays the consul-index role: Watch blocks until the
+// cluster's version passes the caller's, so updates propagate in one RTT
+// with no polling interval, and registrations carry a TTL kept alive by a
+// heartbeat fiber (NamingRegistrant).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "cluster/naming_service.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/channel.h"
+#include "rpc/controller.h"
+#include "rpc/server.h"  // Service base + MapJsonMethod
+
+namespace brt {
+
+// The registry service. Host it under any name (conventionally "Naming"):
+//   Server s; NamingRegistryService naming; s.AddService(&naming, "Naming");
+// Methods (request/response are thrift TBinary structs):
+//   Register   {1:cluster 2:"ip:port" 3:weight 4:tag 5:ttl_ms} -> {1:version}
+//   Deregister {1:cluster 2:"ip:port"}                         -> {1:version}
+//   List       {1:cluster}                  -> {1:version 2:[node structs]}
+//   Watch      {1:cluster 2:known_version 3:wait_ms} -> same as List, but
+//              blocks (up to wait_ms, default 30s) until version >
+//              known_version — the consul blocking query.
+// Node struct: {1:"ip:port" 2:weight 3:tag}.
+class NamingRegistryService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override;
+
+  // Registers the JSON mappings for all four methods on `server` under
+  // `service_name`, making the registry curl-able (restful bridge).
+  static void MapJsonMethods(Server* server,
+                             const std::string& service_name = "Naming");
+
+ private:
+  struct Entry {
+    ServerNode node;
+    int64_t expire_us = 0;  // 0 = no TTL
+  };
+  struct Cluster {
+    int64_t version = 0;
+    std::vector<Entry> entries;
+  };
+
+  // Drops expired entries; bumps version if any lapsed. Caller holds mu_.
+  void SweepLocked(Cluster* c);
+
+  FiberMutex mu_;
+  FiberCond changed_;  // broadcast on every version bump
+  std::map<std::string, Cluster> clusters_;
+};
+
+// NamingService for "remote://host:port/cluster[?watch_ms=N]": long-polls
+// NamingRegistryService ("Naming") at host:port for `cluster`, pushing
+// every new list to the watcher. Connection loss keeps the last list
+// (fail-safe, like the reference's NS thread) and retries with backoff.
+// Registered under the "remote" scheme by StartNamingService.
+class RemoteNamingService : public NamingService {
+ public:
+  ~RemoteNamingService() override { Stop(); }
+  int Start(const std::string& param, ServerListCallback cb) override;
+  void Stop() override;
+
+ private:
+  static void* WatchEntry(void* arg);
+
+  Channel channel_;
+  std::string cluster_;
+  int64_t watch_ms_ = 30 * 1000;
+  ServerListCallback cb_;
+  fiber_t fid_ = 0;
+  // Stop() must not wait out a 30s blocking Watch: it cancels the
+  // in-flight call (StartCancel is safe from any thread).
+  std::mutex cntl_mu_;
+  Controller* active_cntl_ = nullptr;
+  std::atomic<bool> stopping_{false};
+};
+
+// Keeps one server registered in a remote registry: Register immediately,
+// then heartbeat at ttl/3 so the entry never lapses while the process
+// lives; Deregister on Stop (reference discovery_naming_service.cpp
+// register+renew thread).
+class NamingRegistrant {
+ public:
+  ~NamingRegistrant() { Stop(); }
+  // registry_addr: "ip:port" of the server hosting NamingRegistryService.
+  int Start(const std::string& registry_addr, const std::string& cluster,
+            const ServerNode& self, int64_t ttl_ms = 10 * 1000);
+  void Stop();
+
+ private:
+  static void* HeartbeatEntry(void* arg);
+  int RegisterOnce();
+
+  Channel channel_;
+  std::string cluster_;
+  ServerNode self_;
+  int64_t ttl_ms_ = 0;
+  fiber_t fid_ = 0;
+};
+
+}  // namespace brt

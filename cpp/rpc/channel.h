@@ -31,6 +31,10 @@ struct ChannelOptions {
   // generated credential rides every request's meta. Ownership stays with
   // the caller; must outlive the channel.
   const Authenticator* auth = nullptr;
+  // Cluster channels: filters naming-service pushes before the LB sees
+  // them (reference ChannelOptions.ns_filter, naming_service_filter.h).
+  // Ownership stays with the caller; must outlive the channel.
+  const class NamingServiceFilter* ns_filter = nullptr;
 };
 
 // Anything callable like a channel: plain Channel, ClusterChannel, and the

@@ -465,17 +465,8 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
       std::string ctype2 =
           ctx->grpc ? "application/grpc" : "application/octet-stream";
       int status = 200;
-      std::string jerr;
-      if (ctx->json != nullptr) {
-        if (TranscodeJsonResponse(ctx->json, &body, &jerr)) {
-          ctype2 = "application/json";
-        } else {
-          body.clear();
-          body.append(jerr + "\n");
-          ctype2 = "text/plain";
-          status = 500;
-          ec = ERESPONSE;  // stats must not record this 500 as a success
-        }
+      if (int jrc = FinishJsonResponse(ctx->json, &body, &ctype2, &status)) {
+        ec = jrc;  // stats must not record this 500 as a success
       }
       RespondH2(ctx, status, ctype2, std::move(body), 0, "");
     } else if (ctx->grpc) {

@@ -1,5 +1,6 @@
 #include "rpc/http_dispatch.h"
 
+#include <cctype>
 #include <string_view>
 
 #include "base/time.h"
@@ -96,12 +97,16 @@ const Server::JsonMapping* TranscodeJsonRequest(
     Server* server, const std::string& service, const std::string& method,
     const std::string* ctype, IOBuf* body, std::string* errmsg, bool* bad) {
   *bad = false;
-  // Exactly application/json (parameters like "; charset=utf-8" allowed);
-  // distinct media types such as application/json-seq pass through raw.
+  // Exactly application/json, case-insensitively (RFC 9110 media types);
+  // parameters like "; charset=utf-8" allowed. Distinct media types such
+  // as application/json-seq pass through raw.
   constexpr std::string_view kJson = "application/json";
-  if (ctype == nullptr || ctype->rfind(kJson, 0) != 0 ||
-      (ctype->size() > kJson.size() && (*ctype)[kJson.size()] != ';' &&
-       (*ctype)[kJson.size()] != ' ')) {
+  if (ctype == nullptr || ctype->size() < kJson.size()) return nullptr;
+  for (size_t i = 0; i < kJson.size(); ++i) {
+    if (std::tolower((unsigned char)(*ctype)[i]) != kJson[i]) return nullptr;
+  }
+  if (ctype->size() > kJson.size() && (*ctype)[kJson.size()] != ';' &&
+      (*ctype)[kJson.size()] != ' ') {
     return nullptr;
   }
   const Server::JsonMapping* jm = server->FindJsonMapping(service, method);
@@ -151,6 +156,21 @@ bool TranscodeJsonResponse(const Server::JsonMapping* jm, IOBuf* body,
   JsonSerialize(j, &out);
   *body = std::move(out);
   return true;
+}
+
+int FinishJsonResponse(const Server::JsonMapping* jm, IOBuf* body,
+                       std::string* ctype, int* status) {
+  if (jm == nullptr) return 0;
+  std::string jerr;
+  if (TranscodeJsonResponse(jm, body, &jerr)) {
+    *ctype = "application/json";
+    return 0;
+  }
+  body->clear();
+  body->append(jerr + "\n");
+  *ctype = "text/plain";
+  *status = 500;
+  return ERESPONSE;
 }
 
 void FinishHttpRequest(Server* server, MethodStatus* ms, int error_code,

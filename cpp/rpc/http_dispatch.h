@@ -67,4 +67,12 @@ const Server::JsonMapping* TranscodeJsonRequest(
 bool TranscodeJsonResponse(const Server::JsonMapping* jm, IOBuf* body,
                            std::string* errmsg);
 
+// Completion-side wrapper shared by the h1 and h2 front-ends: transcodes
+// a successful handler response for a JSON-mapped request, rewriting
+// *body/*ctype/*status in place. Returns 0, or ERESPONSE on transcode
+// failure (with *body/*ctype/*status describing the 500) — the caller
+// must record that code in its stats so schema bugs stay visible.
+int FinishJsonResponse(const Server::JsonMapping* jm, IOBuf* body,
+                       std::string* ctype, int* status);
+
 }  // namespace brt

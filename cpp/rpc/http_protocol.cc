@@ -263,19 +263,10 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
       body.append(std::move(sess->cntl.response_attachment()));
       std::string ctype = "application/octet-stream";
       int status = 200;
-      std::string jerr;
-      if (sess->json != nullptr) {
-        if (TranscodeJsonResponse(sess->json, &body, &jerr)) {
-          ctype = "application/json";
-        } else {
-          body.clear();
-          body.append(jerr + "\n");
-          ctype = "text/plain";
-          status = 500;
-          // Surface in server stats too (error counters, /status, LB
-          // feedback) — the client saw a 500, not a success.
-          sess->cntl.SetFailed(ERESPONSE, "%s", jerr.c_str());
-        }
+      if (int jrc = FinishJsonResponse(sess->json, &body, &ctype, &status)) {
+        // Surface in server stats too (error counters, /status) — the
+        // client saw a 500, not a success.
+        sess->cntl.SetFailed(jrc, "response transcode failed");
       }
       close = MakeResponseBytes(sess->req_head, status, ctype,
                                 std::move(body), &out);

@@ -478,6 +478,9 @@ bool JsonToThriftValue(const JsonValue& j, const JsonFieldSpec& f,
       }
       out->type = TType::LIST;
       out->elem_type = f.sub != nullptr ? TType::STRUCT : f.elem;
+      if (out->elem_type == TType::STRUCT && f.sub == nullptr) {
+        return FieldFail(err, name, "schema missing sub-struct");
+      }
       for (const auto& e : j.elems) {
         ThriftValue ev;
         if (out->elem_type == TType::STRUCT) {
@@ -501,6 +504,9 @@ bool JsonToThriftValue(const JsonValue& j, const JsonFieldSpec& f,
       out->type = TType::MAP;
       out->key_type = TType::STRING;
       out->val_type = f.sub != nullptr ? TType::STRUCT : f.elem;
+      if (out->val_type == TType::STRUCT && f.sub == nullptr) {
+        return FieldFail(err, name, "schema missing sub-struct");
+      }
       for (const auto& [k, v] : j.members) {
         ThriftValue kv = ThriftValue::String(k);
         ThriftValue vv;
@@ -605,6 +611,13 @@ bool JsonToThriftStruct(const JsonValue& j, const StructSchema& s,
     const JsonFieldSpec* f = s.by_name(key);
     if (f == nullptr) {
       if (err) *err = "unknown field '" + key + "'";
+      return false;
+    }
+    if (out->field(f->id) != nullptr) {
+      // Duplicate keys would write the field id twice on the wire, and
+      // first-wins (this DOM) vs last-wins (conventional thrift) readers
+      // would disagree — a smuggling ambiguity. Reject.
+      if (err) *err = "duplicate field '" + key + "'";
       return false;
     }
     ThriftValue tv;

@@ -65,27 +65,27 @@ struct MemcacheClient::Impl {
   std::deque<Waiter*> waiters;
   int64_t timeout_us = 1000000;
 
-  static void OnData(Socket* s);
+  static void* OnData(Socket* s);
   void Fail(int err);
 
   MemcacheResult Roundtrip(IOBuf* frame);
 };
 
-void MemcacheClient::Impl::OnData(Socket* s) {
+void* MemcacheClient::Impl::OnData(Socket* s) {
   auto* impl = static_cast<MemcacheClient::Impl*>(s->user());
   for (;;) {
     ssize_t nr = impl->inbuf.append_from_fd(s->fd());
     if (nr == 0) {
       s->SetFailed(ECONNRESET, "memcache server closed");
       impl->Fail(ECONNRESET);
-      return;
+      return nullptr;
     }
     if (nr < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;
       s->SetFailed(errno, "memcache read failed");
       impl->Fail(errno);
-      return;
+      return nullptr;
     }
   }
   for (;;) {
@@ -115,9 +115,10 @@ void MemcacheClient::Impl::OnData(Socket* s) {
     if (bad) {
       s->SetFailed(EBADMSG, "memcache reply desynchronized");
       impl->Fail(EBADMSG);
-      return;
+      return nullptr;
     }
   }
+  return nullptr;
 }
 
 void MemcacheClient::Impl::Fail(int err) {

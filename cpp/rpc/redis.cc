@@ -283,25 +283,25 @@ struct RedisClient::Impl {
   std::deque<Waiter*> waiters;  // FIFO matching
   int64_t timeout_us = 1000000;
 
-  static void OnData(Socket* s);
+  static void* OnData(Socket* s);
   void Fail(int err);
 };
 
-void RedisClient::Impl::OnData(Socket* s) {
+void* RedisClient::Impl::OnData(Socket* s) {
   auto* impl = static_cast<RedisClient::Impl*>(s->user());
   for (;;) {
     ssize_t nr = impl->inbuf.append_from_fd(s->fd());
     if (nr == 0) {
       s->SetFailed(ECONNRESET, "redis server closed");
       impl->Fail(ECONNRESET);
-      return;
+      return nullptr;
     }
     if (nr < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;
       s->SetFailed(errno, "redis read failed");
       impl->Fail(errno);
-      return;
+      return nullptr;
     }
   }
   for (;;) {
@@ -326,9 +326,10 @@ void RedisClient::Impl::OnData(Socket* s) {
       // no later reply can be trusted. Fail the connection and drain waiters.
       s->SetFailed(rc, "redis reply desynchronized");
       impl->Fail(rc);
-      return;
+      return nullptr;
     }
   }
+  return nullptr;
 }
 
 void RedisClient::Impl::Fail(int err) {

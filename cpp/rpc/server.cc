@@ -66,6 +66,7 @@ int Server::Start(const EndPoint& addr, const Options* opts) {
   start_time_us = monotonic_us();
   acceptor_.conn_options.user = this;
   acceptor_.conn_options.on_edge_triggered = InputMessengerOnEdgeTriggered;
+  acceptor_.conn_options.run_deferred = InputMessengerProcessDeferred;
   int rc = acceptor_.StartAccept(addr);
   if (rc != 0) {
     running_.store(false);

@@ -38,6 +38,7 @@ int NewConnection(const EndPoint& remote, SocketUniquePtr* out,
                   int64_t timeout_us) {
   Socket::Options opts;
   opts.on_edge_triggered = InputMessengerOnEdgeTriggered;
+  opts.run_deferred = InputMessengerProcessDeferred;
   // Failed sockets are dropped from the map so the next call reconnects
   // (health-check-driven revival lands with the cluster layer).
   opts.on_failed = [](Socket* s) { RemoveSingleSocket(s->remote(), s->id()); };

@@ -179,25 +179,25 @@ struct ThriftClient::Impl {
   uint32_t next_seqid = 1;
   int64_t timeout_us = 1000000;
 
-  static void OnData(Socket* s);
+  static void* OnData(Socket* s);
   void Fail(const char* what);
 };
 
-void ThriftClient::Impl::OnData(Socket* s) {
+void* ThriftClient::Impl::OnData(Socket* s) {
   auto* impl = static_cast<ThriftClient::Impl*>(s->user());
   for (;;) {
     ssize_t nr = impl->inbuf.append_from_fd(s->fd());
     if (nr == 0) {
       s->SetFailed(ECONNRESET, "thrift server closed");
       impl->Fail("connection closed");
-      return;
+      return nullptr;
     }
     if (nr < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;
       s->SetFailed(errno, "thrift read failed");
       impl->Fail("read failed");
-      return;
+      return nullptr;
     }
   }
   for (;;) {
@@ -232,9 +232,10 @@ void ThriftClient::Impl::OnData(Socket* s) {
       // the connection and drain every remaining waiter.
       s->SetFailed(EBADMSG, "thrift reply desynchronized");
       impl->Fail("protocol error");
-      return;
+      return nullptr;
     }
   }
+  return nullptr;
 }
 
 void ThriftClient::Impl::Fail(const char* what) {

@@ -1,0 +1,233 @@
+// Remote/watched naming tests: the in-framework registry (consul analog),
+// the long-poll RemoteNamingService, registrant heartbeats + TTL lapse,
+// and NamingServiceFilter. Reference model:
+// test/brpc_naming_service_unittest.cpp (consul/discovery sections).
+#include <cassert>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "base/time.h"
+#include "cluster/cluster_channel.h"
+#include "cluster/remote_naming.h"
+#include "fiber/fiber.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+namespace {
+
+// Calls the registry directly (what RemoteNamingService does internally).
+ThriftValue Call(Channel& ch, const std::string& method, ThriftValue req) {
+  IOBuf reqbuf, respbuf;
+  assert(ThriftSerializeStruct(req, &reqbuf));
+  Controller cntl;
+  cntl.timeout_ms = 10 * 1000;
+  ch.CallMethod("Naming", method, &cntl, reqbuf, &respbuf, nullptr);
+  if (cntl.Failed()) {
+    fprintf(stderr, "Call(%s) failed: %d %s\n", method.c_str(),
+            cntl.ErrorCode(), cntl.ErrorText().c_str());
+  }
+  assert(!cntl.Failed());
+  ThriftValue resp;
+  assert(ThriftParseStruct(respbuf, &resp) > 0);
+  return resp;
+}
+
+ThriftValue RegisterReq(const std::string& cluster, const std::string& addr,
+                        int64_t ttl_ms = 0, const std::string& tag = "") {
+  ThriftValue req = ThriftValue::Struct();
+  req.add_field(1, ThriftValue::String(cluster));
+  req.add_field(2, ThriftValue::String(addr));
+  req.add_field(3, ThriftValue::I32(1));
+  if (!tag.empty()) req.add_field(4, ThriftValue::String(tag));
+  if (ttl_ms > 0) req.add_field(5, ThriftValue::I64(ttl_ms));
+  return req;
+}
+
+size_t NodeCount(const ThriftValue& resp) {
+  const ThriftValue* nodes = resp.field(2);
+  return nodes == nullptr ? 0 : nodes->elems.size();
+}
+
+class EchoService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response,
+                  Closure done) override {
+    (void)method;
+    (void)cntl;
+    response->append(request);
+    done();
+  }
+};
+
+void test_registry_basics(const EndPoint& reg_addr) {
+  Channel ch;
+  assert(ch.Init(reg_addr) == 0);
+  ThriftValue r = Call(ch, "Register", RegisterReq("c1", "10.0.0.1:100"));
+  const int64_t v1 = r.field(1)->i;
+  assert(v1 >= 1);
+  Call(ch, "Register", RegisterReq("c1", "10.0.0.2:100"));
+  ThriftValue list = Call(ch, "List", RegisterReq("c1", "10.0.0.1:100"));
+  assert(NodeCount(list) == 2);
+  // Re-registering identical data must NOT bump the version (heartbeat).
+  ThriftValue again = Call(ch, "Register", RegisterReq("c1", "10.0.0.1:100"));
+  ThriftValue list2 = Call(ch, "List", RegisterReq("c1", ""));
+  assert(again.field(1)->i == list2.field(1)->i);
+  Call(ch, "Deregister", RegisterReq("c1", "10.0.0.2:100"));
+  list = Call(ch, "List", RegisterReq("c1", ""));
+  assert(NodeCount(list) == 1);
+  printf("registry basics OK\n");
+}
+
+void test_watch_blocks_until_change(const EndPoint& reg_addr) {
+  Channel ch;
+  assert(ch.Init(reg_addr) == 0);
+  ThriftValue list = Call(ch, "List", RegisterReq("c2", ""));
+  const int64_t v = list.field(1)->i;
+  // A watcher at the current version blocks; a registration releases it.
+  struct Ctx {
+    EndPoint addr;
+    int64_t after_us = 0;
+  } ctx{reg_addr, 0};
+  fiber_t registrar;
+  fiber_start(&registrar, [](void* arg) -> void* {
+    auto* c = static_cast<Ctx*>(arg);
+    fiber_usleep(300 * 1000);
+    Channel ch2;
+    assert(ch2.Init(c->addr) == 0);
+    Call(ch2, "Register", RegisterReq("c2", "10.0.0.9:900"));
+    c->after_us = monotonic_us();
+    return nullptr;
+  }, &ctx);
+  ThriftValue watch_req = ThriftValue::Struct();
+  watch_req.add_field(1, ThriftValue::String("c2"));
+  watch_req.add_field(2, ThriftValue::I64(v));
+  watch_req.add_field(3, ThriftValue::I64(10 * 1000));
+  const int64_t t0 = monotonic_us();
+  ThriftValue resp = Call(ch, "Watch", watch_req);
+  const int64_t unblocked = monotonic_us();
+  fiber_join(registrar);
+  assert(resp.field(1)->i > v);
+  assert(NodeCount(resp) == 1);
+  assert(unblocked - t0 >= 250 * 1000);      // actually blocked
+  assert(unblocked - t0 < 8 * 1000 * 1000);  // not the full wait
+  printf("watch long-poll OK (blocked %.0fms)\n",
+         double(unblocked - t0) / 1000);
+}
+
+void test_ttl_lapse(const EndPoint& reg_addr) {
+  Channel ch;
+  assert(ch.Init(reg_addr) == 0);
+  Call(ch, "Register", RegisterReq("c3", "10.0.0.3:300", /*ttl_ms=*/400));
+  assert(NodeCount(Call(ch, "List", RegisterReq("c3", ""))) == 1);
+  fiber_usleep(700 * 1000);
+  assert(NodeCount(Call(ch, "List", RegisterReq("c3", ""))) == 0);
+  printf("ttl lapse OK\n");
+}
+
+void test_remote_ns_end_to_end(const EndPoint& reg_addr) {
+  // Two real echo servers; one registered up front, one added later —
+  // the cluster channel must pick up the change via the long-poll.
+  Server e1, e2;
+  EchoService svc1, svc2;
+  assert(e1.AddService(&svc1, "Echo") == 0);
+  assert(e2.AddService(&svc2, "Echo") == 0);
+  assert(e1.Start("127.0.0.1:0") == 0);
+  assert(e2.Start("127.0.0.1:0") == 0);
+
+  NamingRegistrant reg1;
+  ServerNode n1;
+  n1.ep = e1.listen_address();
+  assert(reg1.Start(reg_addr.to_string(), "echo", n1, /*ttl_ms=*/2000) == 0);
+
+  ClusterChannel cc;
+  const std::string url =
+      "remote://" + reg_addr.to_string() + "/echo?watch_ms=2000";
+  assert(cc.Init(url, "rr") == 0);
+  // First list arrives synchronously enough for an immediate call.
+  for (int i = 0; i < 50 && cc.ListServers().empty(); ++i) {
+    fiber_usleep(20 * 1000);
+  }
+  assert(cc.ListServers().size() == 1);
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("ping");
+  cc.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed() && rsp.to_string() == "ping");
+
+  // Second server registers: the watcher must push the new list without
+  // any polling interval.
+  NamingRegistrant reg2;
+  ServerNode n2;
+  n2.ep = e2.listen_address();
+  assert(reg2.Start(reg_addr.to_string(), "echo", n2, /*ttl_ms=*/2000) == 0);
+  for (int i = 0; i < 100 && cc.ListServers().size() < 2; ++i) {
+    fiber_usleep(20 * 1000);
+  }
+  assert(cc.ListServers().size() == 2);
+
+  // Deregistration propagates the same way.
+  reg2.Stop();
+  for (int i = 0; i < 100 && cc.ListServers().size() > 1; ++i) {
+    fiber_usleep(20 * 1000);
+  }
+  assert(cc.ListServers().size() == 1);
+
+  reg1.Stop();
+  e1.Stop();
+  e1.Join();
+  e2.Stop();
+  e2.Join();
+  printf("remote NS end-to-end OK\n");
+}
+
+class TagFilter : public NamingServiceFilter {
+ public:
+  explicit TagFilter(std::string keep) : keep_(std::move(keep)) {}
+  bool Accept(const ServerNode& node) const override {
+    return node.tag == keep_;
+  }
+
+ private:
+  std::string keep_;
+};
+
+void test_ns_filter() {
+  ClusterChannel cc;
+  ChannelOptions opts;
+  TagFilter keep_blue("blue");
+  opts.ns_filter = &keep_blue;
+  assert(cc.Init("list://10.0.0.1:100:blue,10.0.0.2:100:green,"
+                 "10.0.0.3:100:blue",
+                 "rr", &opts) == 0);
+  auto servers = cc.ListServers();
+  assert(servers.size() == 2);
+  for (const auto& n : servers) assert(n.tag == "blue");
+  printf("ns filter OK\n");
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+
+  Server registry;
+  NamingRegistryService naming;
+  assert(registry.AddService(&naming, "Naming") == 0);
+  NamingRegistryService::MapJsonMethods(&registry);
+  assert(registry.Start("127.0.0.1:0") == 0);
+  const EndPoint reg_addr = registry.listen_address();
+
+  test_registry_basics(reg_addr);
+  test_watch_blocks_until_change(reg_addr);
+  test_ttl_lapse(reg_addr);
+  test_remote_ns_end_to_end(reg_addr);
+  test_ns_filter();
+
+  registry.Stop();
+  registry.Join();
+  printf("ALL naming tests OK\n");
+  return 0;
+}

@@ -66,6 +66,7 @@ int g_server_proto, g_client_proto;
 static void test_echo_roundtrip(const EndPoint& server_addr) {
   Socket::Options copts;
   copts.on_edge_triggered = InputMessengerOnEdgeTriggered;
+  copts.run_deferred = InputMessengerProcessDeferred;
   SocketId cid;
   int rc = Socket::Connect(server_addr, copts, &cid);
   assert(rc == 0);
@@ -127,6 +128,7 @@ int main() {
 
   Acceptor acceptor;
   acceptor.conn_options.on_edge_triggered = InputMessengerOnEdgeTriggered;
+  acceptor.conn_options.run_deferred = InputMessengerProcessDeferred;
   EndPoint any;
   EndPoint::parse("127.0.0.1:0", &any);
   assert(acceptor.StartAccept(any) == 0);

@@ -137,7 +137,7 @@ void Acceptor::StopAccept() {
   }
 }
 
-void Acceptor::OnNewConnections(Socket* listener) {
+void* Acceptor::OnNewConnections(Socket* listener) {
   auto* self = static_cast<Acceptor*>(listener->user());
   const bool is_unix = listener->remote().is_unix();
   for (;;) {
@@ -146,10 +146,10 @@ void Acceptor::OnNewConnections(Socket* listener) {
     int fd = ::accept4(listener->fd(), reinterpret_cast<sockaddr*>(&ss),
                        &len, SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return nullptr;
       if (errno == EINTR || errno == ECONNABORTED) continue;
       BRT_LOG(WARNING) << "accept failed: " << strerror(errno);
-      return;
+      return nullptr;
     }
     Socket::Options o = self->conn_options;
     o.fd = fd;

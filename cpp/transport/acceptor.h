@@ -23,7 +23,7 @@ class Acceptor {
   SocketId listen_socket() const { return listen_sid_; }
 
  private:
-  static void OnNewConnections(Socket* listener);
+  static void* OnNewConnections(Socket* listener);
 
   EndPoint listen_point_;
   SocketId listen_sid_ = INVALID_SOCKET_ID;

@@ -88,7 +88,7 @@ int cut_message(Socket* s, IOBuf* source, IOBuf* msg) {
 
 }  // namespace
 
-void InputMessengerOnEdgeTriggered(Socket* s) {
+void* InputMessengerOnEdgeTriggered(Socket* s) {
   IOPortal& portal = s->read_buf;
   // Read to EAGAIN first; EOF/errors are acted on only AFTER dispatching any
   // complete messages already buffered (a peer may write a full request and
@@ -120,7 +120,7 @@ void InputMessengerOnEdgeTriggered(Socket* s) {
     if (pi == -2) {
       s->SetFailed(EPROTO, "unparsable input (%zu bytes)", portal.size());
       for (auto* a : batch) PutProcessArg(a);
-      return;
+      return nullptr;
     }
     s->messages_read.fetch_add(1, std::memory_order_relaxed);
     const Protocol& proto = g_protocols[pi];
@@ -135,16 +135,20 @@ void InputMessengerOnEdgeTriggered(Socket* s) {
   if (pending_err != 0) {
     s->SetFailed(pending_err, "%s", pending_msg);
   }
-  if (batch.empty()) return;
-  // All but the last message get their own fibers; the last runs inline
-  // ("thread jump": the read fiber becomes the processing fiber).
+  if (batch.empty()) return nullptr;
+  // All but the last message get their own fibers; the last is DEFERRED to
+  // the caller ("thread jump": the read fiber becomes the processing fiber
+  // — but only after it releases the socket's read gate, so a blocking
+  // handler cannot stall this connection's reads).
   for (size_t i = 0; i + 1 < batch.size(); ++i) {
     fiber_t tid;
     if (fiber_start(&tid, process_entry, batch[i]) != 0) {
       process_entry(batch[i]);
     }
   }
-  process_entry(batch.back());
+  return batch.back();
 }
+
+void* InputMessengerProcessDeferred(void* arg) { return process_entry(arg); }
 
 }  // namespace brt

@@ -39,7 +39,12 @@ int RegisterProtocol(const Protocol& p);
 const Protocol* GetProtocol(int index);
 int protocol_count();
 
-// The standard on_edge_triggered callback for RPC sockets.
-void InputMessengerOnEdgeTriggered(Socket* s);
+// The standard on_edge_triggered callback for RPC sockets. Returns the
+// last cut message as a DEFERRED item (Socket::Options.run_deferred must
+// be InputMessengerProcessDeferred): the socket runs it after releasing
+// its read gate, keeping the thread-jump optimization without letting a
+// blocking handler stall the connection's reads.
+void* InputMessengerOnEdgeTriggered(Socket* s);
+void* InputMessengerProcessDeferred(void* arg);
 
 }  // namespace brt

@@ -127,6 +127,7 @@ int Socket::Create(const Options& opts, SocketId* id_out) {
   s->remote_ = opts.remote;
   s->user_ = opts.user;
   s->on_edge_triggered_ = opts.on_edge_triggered;
+  s->run_deferred_ = opts.run_deferred;
   s->on_failed_ = opts.on_failed;
   s->failed_.store(0, std::memory_order_relaxed);
   s->error_text_.clear();
@@ -494,14 +495,26 @@ void* Socket::ReadEventEntry(void* arg) {
   if (Socket::Address(sid, &ptr) != 0) return nullptr;
   Socket* s = ptr.get();
   for (;;) {
-    s->on_edge_triggered_(s);
+    void* deferred = s->on_edge_triggered_(s);
     int st = 1;
     if (s->read_state.compare_exchange_strong(st, 0,
                                               std::memory_order_acq_rel)) {
+      // Gate released FIRST: new input now spawns a fresh read fiber, so
+      // running the deferred handler inline here (the "thread jump"
+      // optimization) cannot stall the connection even if it blocks for
+      // seconds (e.g. a registry Watch long-poll on a shared connection).
+      if (deferred != nullptr) s->run_deferred_(deferred);
       return nullptr;
     }
-    // st was 2: more events arrived while reading; go again.
+    // st was 2: more events arrived while reading; we must read again NOW,
+    // so the deferred item gets its own fiber instead of running inline.
     s->read_state.store(1, std::memory_order_release);
+    if (deferred != nullptr) {
+      fiber_t tid;
+      if (fiber_start(&tid, s->run_deferred_, deferred) != 0) {
+        s->run_deferred_(deferred);
+      }
+    }
   }
 }
 

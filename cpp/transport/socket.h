@@ -68,8 +68,15 @@ class Socket {
     void* user = nullptr;  // owner cookie (Server*, Channel state, ...)
     // Called in a fiber when the fd becomes readable (edge-triggered:
     // implementations must read until EAGAIN). Null for connect-only
-    // sockets whose reads are driven elsewhere.
-    void (*on_edge_triggered)(Socket*) = nullptr;
+    // sockets whose reads are driven elsewhere. May return one DEFERRED
+    // work item: it runs only after the read gate is released (or in its
+    // own fiber when more input is pending), so a handler that blocks —
+    // e.g. a naming Watch long-poll — can never stall reads on a shared
+    // connection (see ReadEventEntry).
+    void* (*on_edge_triggered)(Socket*) = nullptr;
+    // Runs a deferred item (fiber-entry signature). Required when
+    // on_edge_triggered can return non-null.
+    void* (*run_deferred)(void*) = nullptr;
     // Called once when the socket transitions to failed.
     void (*on_failed)(Socket*) = nullptr;
     int dispatcher_index = -1;  // -1: shard by fd
@@ -188,7 +195,8 @@ class Socket {
   int fd_ = -1;
   EndPoint remote_;
   void* user_ = nullptr;
-  void (*on_edge_triggered_)(Socket*) = nullptr;
+  void* (*on_edge_triggered_)(Socket*) = nullptr;
+  void* (*run_deferred_)(void*) = nullptr;
   void (*on_failed_)(Socket*) = nullptr;
   std::atomic<int> failed_{0};
   std::string error_text_;
