@@ -1,0 +1,97 @@
+// Region allocator: many small allocations, one bulk free. Parity target:
+// reference src/butil/arena.{h,cc} (used by mcpack/json DOM building).
+// Blocks double from 4KB to 64KB; oversized requests get dedicated blocks.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+namespace brt {
+
+class Arena {
+ public:
+  Arena() = default;
+  ~Arena() {
+    Block* b = head_;
+    while (b != nullptr) {
+      Block* next = b->next;
+      free(b);
+      b = next;
+    }
+  }
+  Arena(const Arena&) = delete;
+  Arena& operator=(const Arena&) = delete;
+
+  void* allocate(size_t n, size_t align = 8) {
+    uintptr_t p = (cur_ + (align - 1)) & ~uintptr_t(align - 1);
+    if (p + n > end_) {
+      // Oversized requests get a DEDICATED side block: the current block
+      // keeps filling, so interleaved big/small allocations don't abandon
+      // a free tail per big one.
+      if (n + align + sizeof(Block) > next_block_) {
+        const size_t want = n + align + sizeof(Block);
+        Block* b = static_cast<Block*>(malloc(want));
+        if (b == nullptr) return nullptr;
+        b->next = head_;
+        head_ = b;
+        reserved_ += want;
+        used_ += n;
+        const uintptr_t q = reinterpret_cast<uintptr_t>(b) + sizeof(Block);
+        return reinterpret_cast<void*>((q + (align - 1)) &
+                                       ~uintptr_t(align - 1));
+      }
+      if (!Grow()) return nullptr;
+      p = (cur_ + (align - 1)) & ~uintptr_t(align - 1);
+    }
+    cur_ = p + n;
+    used_ += n;
+    return reinterpret_cast<void*>(p);
+  }
+
+  char* dup(const void* data, size_t n) {
+    char* p = static_cast<char*>(allocate(n ? n : 1, 1));
+    if (p != nullptr) memcpy(p, data, n);
+    return p;
+  }
+
+  template <typename T, typename... Args>
+  T* make(Args&&... args) {
+    void* p = allocate(sizeof(T), alignof(T));
+    return p == nullptr ? nullptr
+                        : new (p) T(static_cast<Args&&>(args)...);
+  }
+
+  size_t used() const { return used_; }        // bytes handed out
+  size_t reserved() const { return reserved_; }  // bytes malloc'd
+
+ private:
+  struct Block {
+    Block* next;
+  };
+
+  bool Grow() {
+    const size_t want = next_block_;
+    Block* b = static_cast<Block*>(malloc(want));
+    if (b == nullptr) return false;
+    b->next = head_;
+    head_ = b;
+    cur_ = reinterpret_cast<uintptr_t>(b) + sizeof(Block);
+    end_ = reinterpret_cast<uintptr_t>(b) + want;
+    reserved_ += want;
+    if (next_block_ < kMaxBlock) next_block_ *= 2;
+    return true;
+  }
+
+  static constexpr size_t kMaxBlock = 64 * 1024;
+  Block* head_ = nullptr;
+  uintptr_t cur_ = 0;
+  uintptr_t end_ = 0;
+  size_t next_block_ = 4 * 1024;
+  size_t used_ = 0;
+  size_t reserved_ = 0;
+};
+
+}  // namespace brt

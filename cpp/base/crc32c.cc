@@ -1,0 +1,70 @@
+#include "base/crc32c.h"
+
+#include <mutex>
+
+namespace brt {
+
+namespace {
+
+constexpr uint32_t kPoly = 0x82f63b78;  // reflected CRC32-C
+
+uint32_t g_table[8][256];
+
+void InitTables() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = i;
+    for (int k = 0; k < 8; ++k) {
+      crc = (crc >> 1) ^ ((crc & 1) ? kPoly : 0);
+    }
+    g_table[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = g_table[0][i];
+    for (int t = 1; t < 8; ++t) {
+      crc = g_table[0][crc & 0xff] ^ (crc >> 8);
+      g_table[t][i] = crc;
+    }
+  }
+}
+
+std::once_flag g_once;
+
+}  // namespace
+
+uint32_t crc32c_extend(uint32_t init_crc, const void* data, size_t n) {
+  std::call_once(g_once, InitTables);
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint32_t crc = ~init_crc;
+  // Head: align to 8.
+  while (n > 0 && (reinterpret_cast<uintptr_t>(p) & 7) != 0) {
+    crc = g_table[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+    --n;
+  }
+  // Body: 8 bytes per step via the sliced tables.
+  while (n >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    v ^= crc;
+    crc = g_table[7][v & 0xff] ^ g_table[6][(v >> 8) & 0xff] ^
+          g_table[5][(v >> 16) & 0xff] ^ g_table[4][(v >> 24) & 0xff] ^
+          g_table[3][(v >> 32) & 0xff] ^ g_table[2][(v >> 40) & 0xff] ^
+          g_table[1][(v >> 48) & 0xff] ^ g_table[0][(v >> 56) & 0xff];
+    p += 8;
+    n -= 8;
+  }
+  while (n > 0) {
+    crc = g_table[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+    --n;
+  }
+  return ~crc;
+}
+
+uint32_t crc32c(const IOBuf& buf) {
+  uint32_t crc = 0;
+  for (int i = 0; i < buf.block_count(); ++i) {
+    crc = crc32c_extend(crc, buf.ref_data(i), buf.ref_at(i).length);
+  }
+  return crc;
+}
+
+}  // namespace brt

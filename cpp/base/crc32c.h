@@ -1,0 +1,25 @@
+// CRC32-C (Castagnoli, the iSCSI/ext4 polynomial) — slicing-by-8 software
+// implementation. Parity target: reference src/butil/crc32c.{h,cc} (which
+// adds SSE4.2 dispatch; XLA hosts are x86-64 so the hot user — recordio
+// frame checksums — stays bandwidth-bound either way, and slicing-by-8
+// keeps this dependency-free).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "base/iobuf.h"
+
+namespace brt {
+
+// Extends `init_crc` (0 for a fresh checksum) over data[0,n).
+uint32_t crc32c_extend(uint32_t init_crc, const void* data, size_t n);
+
+inline uint32_t crc32c(const void* data, size_t n) {
+  return crc32c_extend(0, data, n);
+}
+
+// Block-wise over an IOBuf (no flattening).
+uint32_t crc32c(const IOBuf& buf);
+
+}  // namespace brt

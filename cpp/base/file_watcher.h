@@ -1,0 +1,54 @@
+// Polling file watcher: detects create/update/delete by stat'ing mtime +
+// size + inode. Parity target: reference src/butil/files/file_watcher.{h,cc}
+// (used by file naming service and reloadable flag files).
+#pragma once
+
+#include <sys/stat.h>
+
+#include <string>
+
+namespace brt {
+
+class FileWatcher {
+ public:
+  enum Change { UNCHANGED = 0, CREATED, UPDATED, DELETED };
+
+  int Init(const std::string& path) {
+    path_ = path;
+    exists_ = Stat(&last_);
+    return 0;
+  }
+
+  // One poll step: what happened since the previous check()/Init()?
+  Change check() {
+    struct stat st;
+    const bool now = Stat(&st);
+    if (!exists_ && !now) return UNCHANGED;
+    if (!exists_ && now) {
+      exists_ = true;
+      last_ = st;
+      return CREATED;
+    }
+    if (exists_ && !now) {
+      exists_ = false;
+      return DELETED;
+    }
+    if (st.st_mtime != last_.st_mtime || st.st_size != last_.st_size ||
+        st.st_ino != last_.st_ino) {
+      last_ = st;
+      return UPDATED;
+    }
+    return UNCHANGED;
+  }
+
+  const std::string& path() const { return path_; }
+
+ private:
+  bool Stat(struct stat* st) { return stat(path_.c_str(), st) == 0; }
+
+  std::string path_;
+  struct stat last_ {};
+  bool exists_ = false;
+};
+
+}  // namespace brt

@@ -1,0 +1,78 @@
+#include "base/rand.h"
+
+#include <time.h>
+#include <unistd.h>
+
+namespace brt {
+
+namespace {
+
+struct Xoshiro {
+  uint64_t s[4];
+  bool seeded = false;
+};
+thread_local Xoshiro t_rng;
+
+uint64_t splitmix64(uint64_t* x) {
+  uint64_t z = (*x += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+uint64_t rotl(uint64_t x, int k) { return (x << k) | (x >> (64 - k)); }
+
+void Seed(Xoshiro* r) {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  uint64_t seed = uint64_t(ts.tv_nsec) ^ (uint64_t(ts.tv_sec) << 20) ^
+                  (uint64_t(gettid()) << 40) ^
+                  reinterpret_cast<uintptr_t>(r);
+  for (auto& word : r->s) word = splitmix64(&seed);
+  r->seeded = true;
+}
+
+}  // namespace
+
+uint64_t fast_rand() {
+  Xoshiro& r = t_rng;
+  if (!r.seeded) Seed(&r);
+  const uint64_t result = rotl(r.s[0] + r.s[3], 23) + r.s[0];
+  const uint64_t t = r.s[1] << 17;
+  r.s[2] ^= r.s[0];
+  r.s[3] ^= r.s[1];
+  r.s[1] ^= r.s[2];
+  r.s[0] ^= r.s[3];
+  r.s[2] ^= t;
+  r.s[3] = rotl(r.s[3], 45);
+  return result;
+}
+
+uint64_t fast_rand_less_than(uint64_t n) {
+  if (n == 0) return 0;
+  // Rejection sampling kills the modulo bias (reference fast_rand.cc does
+  // the same).
+  const uint64_t limit = UINT64_MAX - UINT64_MAX % n;
+  uint64_t v;
+  do {
+    v = fast_rand();
+  } while (v >= limit);
+  return v % n;
+}
+
+int64_t fast_rand_in(int64_t lo, int64_t hi) {
+  if (lo > hi) {
+    const int64_t t = lo;
+    lo = hi;
+    hi = t;
+  }
+  const uint64_t span = uint64_t(hi) - uint64_t(lo) + 1;
+  return span == 0 ? int64_t(fast_rand())  // full-range: hi-lo+1 wrapped
+                   : lo + int64_t(fast_rand_less_than(span));
+}
+
+double fast_rand_double() {
+  return double(fast_rand() >> 11) * (1.0 / double(1ULL << 53));
+}
+
+}  // namespace brt

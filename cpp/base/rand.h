@@ -1,0 +1,16 @@
+// Per-thread fast PRNG (xoshiro256++ seeded by splitmix64) — the
+// butil/fast_rand analog: no locks, no syscalls after seeding, good enough
+// for jitter/sampling/shuffles (NOT cryptography).
+// Parity target: reference src/butil/fast_rand.{h,cc}.
+#pragma once
+
+#include <cstdint>
+
+namespace brt {
+
+uint64_t fast_rand();                       // uniform u64
+uint64_t fast_rand_less_than(uint64_t n);   // [0, n); 0 when n == 0
+int64_t fast_rand_in(int64_t lo, int64_t hi);  // inclusive range
+double fast_rand_double();                  // [0, 1)
+
+}  // namespace brt

@@ -1,0 +1,88 @@
+#include "base/recordio.h"
+
+#include <cstring>
+#include <string>
+
+#include "base/crc32c.h"
+
+namespace brt {
+
+namespace {
+
+constexpr char kMagic[4] = {'R', 'I', 'O', '1'};
+constexpr size_t kHeader = 12;  // magic + len + crc
+constexpr uint32_t kMaxRecord = 256u << 20;
+
+void PutU32(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v);
+  p[1] = uint8_t(v >> 8);
+  p[2] = uint8_t(v >> 16);
+  p[3] = uint8_t(v >> 24);
+}
+
+uint32_t GetU32(const uint8_t* p) {
+  return uint32_t(p[0]) | (uint32_t(p[1]) << 8) | (uint32_t(p[2]) << 16) |
+         (uint32_t(p[3]) << 24);
+}
+
+}  // namespace
+
+bool RecordWriter::Write(const IOBuf& payload) {
+  uint8_t hdr[kHeader];
+  memcpy(hdr, kMagic, 4);
+  PutU32(hdr + 4, uint32_t(payload.size()));
+  PutU32(hdr + 8, crc32c(payload));
+  if (fwrite(hdr, 1, kHeader, file_) != kHeader) return false;
+  for (int i = 0; i < payload.block_count(); ++i) {
+    const size_t n = payload.ref_at(i).length;
+    if (fwrite(payload.ref_data(i), 1, n, file_) != n) return false;
+  }
+  return true;
+}
+
+bool RecordWriter::Write(const void* data, size_t n) {
+  IOBuf b;
+  b.append(data, n);
+  return Write(b);
+}
+
+bool RecordReader::Read(IOBuf* out) {
+  out->clear();
+  uint8_t hdr[kHeader];
+  for (;;) {
+    if (fread(hdr, 1, kHeader, file_) != kHeader) return false;  // EOF
+    if (memcmp(hdr, kMagic, 4) != 0) {
+      // Out of sync: slide one byte at a time — every shift pulls one
+      // fresh byte into hdr[11] so the 12-byte window is always real file
+      // content (a corrupt region costs its own bytes only).
+      do {
+        const int c = fgetc(file_);
+        if (c == EOF) return false;
+        memmove(hdr, hdr + 1, kHeader - 1);
+        hdr[kHeader - 1] = uint8_t(c);
+        ++skipped_;
+      } while (memcmp(hdr, kMagic, 4) != 0);
+    }
+    const uint32_t len = GetU32(hdr + 4);
+    const uint32_t want_crc = GetU32(hdr + 8);
+    if (len > kMaxRecord) {
+      skipped_ += kHeader;
+      continue;  // insane length: treat the header as garbage, rescan
+    }
+    std::string body(len, '\0');
+    const size_t got = fread(body.data(), 1, len, file_);
+    if (got != len) return false;  // torn tail
+    if (crc32c(body.data(), len) != want_crc) {
+      // Corrupt payload: drop it, keep scanning from right after the
+      // header (the payload bytes may contain the next record's magic —
+      // but seeking back mid-stream isn't possible on pipes, so charge
+      // the whole frame and continue).
+      skipped_ += kHeader + len;
+      continue;
+    }
+    out->append(body);
+    return true;
+  }
+}
+
+}  // namespace brt

@@ -1,0 +1,47 @@
+// Record-structured file IO with per-record CRC32-C and magic-scan
+// resynchronization: a torn tail or corrupt region loses only the records
+// it covers, never the rest of the file. Parity target: reference
+// src/butil/recordio.{h,cc} (rpc_dump's on-disk format).
+// Frame: "RIO1" u32 payload_len u32 crc32c(payload), then payload bytes.
+// All integers little-endian.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+
+#include "base/iobuf.h"
+
+namespace brt {
+
+class RecordWriter {
+ public:
+  // Does not own `file`; caller manages open/close/flush policy.
+  explicit RecordWriter(FILE* file) : file_(file) {}
+
+  // Appends one framed record. False on write failure.
+  bool Write(const IOBuf& payload);
+  bool Write(const void* data, size_t n);
+  bool Flush() { return fflush(file_) == 0; }
+
+ private:
+  FILE* file_;
+};
+
+class RecordReader {
+ public:
+  explicit RecordReader(FILE* file) : file_(file) {}
+
+  // Reads the next valid record into `out` (cleared first). On a bad
+  // magic/length/CRC it scans forward for the next magic (skipping the
+  // corrupt region) instead of failing the whole file. False on EOF.
+  bool Read(IOBuf* out);
+
+  // Bytes skipped over corrupt/unsyncable regions so far.
+  uint64_t skipped_bytes() const { return skipped_; }
+
+ private:
+  FILE* file_;
+  uint64_t skipped_ = 0;
+};
+
+}  // namespace brt

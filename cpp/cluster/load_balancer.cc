@@ -1,5 +1,7 @@
 #include "cluster/load_balancer.h"
 
+#include "base/rand.h"
+
 #include <algorithm>
 #include <atomic>
 #include <map>
@@ -20,15 +22,6 @@ inline bool IsExcluded(const SelectIn& in, const EndPoint& ep) {
   return false;
 }
 
-inline uint64_t thread_rand() {
-  // xorshift64* per thread — cheap, no locks (reference fast_rand.cpp role).
-  static thread_local uint64_t s =
-      0x9e3779b97f4a7c15ULL ^ uint64_t(uintptr_t(&s));
-  s ^= s >> 12;
-  s ^= s << 25;
-  s ^= s >> 27;
-  return s * 0x2545F4914F6CDD1DULL;
-}
 
 // 64-bit avalanche (splitmix64 finalizer) — stands in for murmur's fmix in
 // the consistent-hash ring (the reference uses murmurhash32,
@@ -130,9 +123,9 @@ class RandomLB : public LoadBalancer {
     for (int attempt = 0; attempt < 8; ++attempt) {
       const ServerNode* n;
       if (!weighted_) {
-        n = &list[thread_rand() % list.size()];
+        n = &list[fast_rand() % list.size()];
       } else {
-        uint64_t t = thread_rand() % std::max<uint64_t>(p->total_weight, 1);
+        uint64_t t = fast_rand() % std::max<uint64_t>(p->total_weight, 1);
         n = &list.back();
         for (const ServerNode& cand : list) {
           if (t < uint64_t(cand.weight)) {
@@ -269,7 +262,7 @@ class LocalityAwareLB : public LoadBalancer {
       // probing; random jitter achieves the same exploration).
       const double w = double(list[i].weight) * 1e6 /
                        (std::max(lat, 1.0) * (infl + 1.0));
-      const double score = w * (0.75 + double(thread_rand() % 1024) / 2048.0);
+      const double score = w * (0.75 + double(fast_rand() % 1024) / 2048.0);
       if (score > best) {
         best = score;
         best_i = int(i);

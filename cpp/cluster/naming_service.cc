@@ -10,6 +10,7 @@
 #include <map>
 #include <mutex>
 
+#include "base/file_watcher.h"
 #include "base/logging.h"
 #include "fiber/fiber.h"
 
@@ -105,13 +106,17 @@ class FileNamingService : public NamingService {
 
   static void* WatchEntry(void* arg) {
     auto* self = static_cast<FileNamingService*>(arg);
-    struct stat st {};
-    stat(self->path_.c_str(), &st);
-    time_t last = st.st_mtime;
+    FileWatcher fw;
+    fw.Init(self->path_);
     while (fiber_usleep(500 * 1000) == 0) {
-      if (stat(self->path_.c_str(), &st) == 0 && st.st_mtime != last) {
-        last = st.st_mtime;
-        self->Reload();
+      switch (fw.check()) {
+        case FileWatcher::CREATED:
+        case FileWatcher::UPDATED:
+          self->Reload();
+          break;
+        case FileWatcher::DELETED:  // keep last list (fail-safe)
+        case FileWatcher::UNCHANGED:
+          break;
       }
     }
     return nullptr;

@@ -1,10 +1,13 @@
 #include "rpc/rpc_dump.h"
 
 #include <cstdio>
+#include <cstring>
 #include <mutex>
 #include <string>
 
 #include "base/flags.h"
+#include "base/rand.h"
+#include "base/recordio.h"
 
 namespace brt {
 
@@ -15,15 +18,6 @@ namespace {
 std::mutex g_mu;
 std::string g_path;
 FILE* g_file = nullptr;
-
-inline uint64_t rng64() {
-  static thread_local uint64_t s =
-      0xda3e39cb94b95bdbULL ^ (uint64_t(uintptr_t(&s)) << 1);
-  s ^= s >> 12;
-  s ^= s << 25;
-  s ^= s >> 27;
-  return s * 0x2545F4914F6CDD1DULL;
-}
 
 }  // namespace
 
@@ -44,41 +38,47 @@ bool RpcDumpWanted() {
     std::lock_guard<std::mutex> g(g_mu);
     if (g_file == nullptr) return false;
   }
-  return rng64() % 1000000 < ppm;
+  return fast_rand_less_than(1000000) < ppm;
 }
 
 void RpcDumpRecord(const RpcMeta& meta, const IOBuf& body) {
+  // Record payload: u32 meta_len, meta, body — framed + checksummed by
+  // recordio, so a torn tail or corrupt region only loses its own
+  // records on replay (reference rpc_dump.cpp uses butil recordio the
+  // same way).
   std::string mbuf;
   EncodeMeta(meta, &mbuf);
-  const std::string payload = body.to_string();
-  char hdr[12] = {'B', 'R', 'T', 'D'};
-  uint32_t mlen = mbuf.size(), blen = payload.size();
-  memcpy(hdr + 4, &mlen, 4);
-  memcpy(hdr + 8, &blen, 4);
+  IOBuf payload;
+  uint32_t mlen = uint32_t(mbuf.size());
+  char lenbuf[4];
+  memcpy(lenbuf, &mlen, 4);
+  payload.append(lenbuf, 4);
+  payload.append(mbuf);
+  payload.append(body);
   std::lock_guard<std::mutex> g(g_mu);
   if (!g_file) return;
-  fwrite(hdr, 1, sizeof(hdr), g_file);
-  fwrite(mbuf.data(), 1, mbuf.size(), g_file);
-  fwrite(payload.data(), 1, payload.size(), g_file);
-  fflush(g_file);
+  RecordWriter w(g_file);
+  w.Write(payload);
+  w.Flush();
 }
 
 bool RpcDumpReadRecord(void* file, RpcMeta* meta, IOBuf* body) {
-  FILE* f = static_cast<FILE*>(file);
-  char hdr[12];
-  if (fread(hdr, 1, sizeof(hdr), f) != sizeof(hdr)) return false;
-  if (memcmp(hdr, "BRTD", 4) != 0) return false;
-  uint32_t mlen, blen;
-  memcpy(&mlen, hdr + 4, 4);
-  memcpy(&blen, hdr + 8, 4);
-  if (mlen > 64 * 1024 || blen > (256u << 20)) return false;
-  std::string mbuf(mlen, '\0');
-  if (fread(mbuf.data(), 1, mlen, f) != mlen) return false;
-  if (!DecodeMeta(mbuf.data(), mlen, meta)) return false;
-  std::string payload(blen, '\0');
-  if (fread(payload.data(), 1, blen, f) != blen) return false;
-  body->append(payload.data(), blen);
-  return true;
+  RecordReader r(static_cast<FILE*>(file));
+  IOBuf rec;
+  for (;;) {
+    if (!r.Read(&rec)) return false;
+    if (rec.size() < 4) continue;  // runt record: skip, keep replaying
+    uint32_t mlen;
+    rec.copy_to(&mlen, 4);
+    rec.pop_front(4);
+    if (mlen > 64 * 1024 || mlen > rec.size()) continue;
+    std::string mbuf(mlen, '\0');
+    rec.copy_to(mbuf.data(), mlen);
+    rec.pop_front(mlen);
+    if (!DecodeMeta(mbuf.data(), mlen, meta)) continue;
+    body->append(rec);
+    return true;
+  }
 }
 
 void RegisterRpcDumpFlags() {

@@ -1,5 +1,7 @@
 #include "rpc/span.h"
 
+#include "base/rand.h"
+
 #include <deque>
 #include <mutex>
 
@@ -20,15 +22,6 @@ std::deque<Span>& store() {
   return *d;
 }
 
-inline uint64_t rng64() {
-  static thread_local uint64_t s =
-      0x853c49e6748fea9bULL ^ (uint64_t(uintptr_t(&s)) << 1);
-  s ^= s >> 12;
-  s ^= s << 25;
-  s ^= s >> 27;
-  return s * 0x2545F4914F6CDD1DULL;
-}
-
 }  // namespace
 
 void Span::annotate(const std::string& text) {
@@ -38,11 +31,11 @@ void Span::annotate(const std::string& text) {
 bool SpanShouldSample() {
   const uint32_t ppm = FLAGS_rpcz_sample_ppm;
   if (ppm == 0) return false;
-  return rng64() % 1000000 < ppm;
+  return fast_rand_less_than(1000000) < ppm;
 }
 
 uint64_t SpanRandomId() {
-  uint64_t v = rng64();
+  uint64_t v = fast_rand();
   return v ? v : 1;
 }
 
