@@ -26,8 +26,11 @@ class Arena {
   Arena& operator=(const Arena&) = delete;
 
   void* allocate(size_t n, size_t align = 8) {
+    // Overflow guard: sizes can derive from parsed input (DOM building).
+    // Anything within 64KB of SIZE_MAX would wrap the arithmetic below.
+    if (n > SIZE_MAX - (64 * 1024)) return nullptr;
     uintptr_t p = (cur_ + (align - 1)) & ~uintptr_t(align - 1);
-    if (p + n > end_) {
+    if (p < cur_ || p > end_ || n > size_t(end_ - p)) {
       // Oversized requests get a DEDICATED side block: the current block
       // keeps filling, so interleaved big/small allocations don't abandon
       // a free tail per big one.

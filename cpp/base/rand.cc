@@ -67,8 +67,10 @@ int64_t fast_rand_in(int64_t lo, int64_t hi) {
     hi = t;
   }
   const uint64_t span = uint64_t(hi) - uint64_t(lo) + 1;
+  // Unsigned add then convert: spans over INT64_MAX would overflow a
+  // signed `lo + draw` (UB); two's-complement wraparound is the intent.
   return span == 0 ? int64_t(fast_rand())  // full-range: hi-lo+1 wrapped
-                   : lo + int64_t(fast_rand_less_than(span));
+                   : int64_t(uint64_t(lo) + fast_rand_less_than(span));
 }
 
 double fast_rand_double() {

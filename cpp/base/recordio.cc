@@ -28,6 +28,9 @@ uint32_t GetU32(const uint8_t* p) {
 }  // namespace
 
 bool RecordWriter::Write(const IOBuf& payload) {
+  // A frame the reader would reject (or whose length would truncate in
+  // u32) must fail HERE, not desync the file for whoever replays it.
+  if (payload.size() > kMaxRecord) return false;
   uint8_t hdr[kHeader];
   memcpy(hdr, kMagic, 4);
   PutU32(hdr + 4, uint32_t(payload.size()));
@@ -49,35 +52,38 @@ bool RecordWriter::Write(const void* data, size_t n) {
 bool RecordReader::Read(IOBuf* out) {
   out->clear();
   uint8_t hdr[kHeader];
+  if (fread(hdr, 1, kHeader, file_) != kHeader) return false;  // EOF
   for (;;) {
-    if (fread(hdr, 1, kHeader, file_) != kHeader) return false;  // EOF
-    if (memcmp(hdr, kMagic, 4) != 0) {
-      // Out of sync: slide one byte at a time — every shift pulls one
-      // fresh byte into hdr[11] so the 12-byte window is always real file
-      // content (a corrupt region costs its own bytes only).
-      do {
-        const int c = fgetc(file_);
-        if (c == EOF) return false;
-        memmove(hdr, hdr + 1, kHeader - 1);
-        hdr[kHeader - 1] = uint8_t(c);
-        ++skipped_;
-      } while (memcmp(hdr, kMagic, 4) != 0);
+    // A usable header needs the magic AND a sane length — a fabricated
+    // magic with an insane length is garbage too, and both resync the
+    // same way: slide ONE byte (a real record may start anywhere inside
+    // the bogus header's bytes), pulling one fresh byte into hdr[11] so
+    // the 12-byte window is always real file content.
+    uint32_t len = 0;
+    bool plausible = memcmp(hdr, kMagic, 4) == 0;
+    if (plausible) {
+      len = GetU32(hdr + 4);
+      if (len > kMaxRecord) plausible = false;
     }
-    const uint32_t len = GetU32(hdr + 4);
+    if (!plausible) {
+      const int c = fgetc(file_);
+      if (c == EOF) return false;
+      memmove(hdr, hdr + 1, kHeader - 1);
+      hdr[kHeader - 1] = uint8_t(c);
+      ++skipped_;
+      continue;
+    }
     const uint32_t want_crc = GetU32(hdr + 8);
-    if (len > kMaxRecord) {
-      skipped_ += kHeader;
-      continue;  // insane length: treat the header as garbage, rescan
-    }
     std::string body(len, '\0');
     const size_t got = fread(body.data(), 1, len, file_);
     if (got != len) return false;  // torn tail
     if (crc32c(body.data(), len) != want_crc) {
       // Corrupt payload: drop it, keep scanning from right after the
-      // header (the payload bytes may contain the next record's magic —
+      // frame (the payload bytes may contain the next record's magic —
       // but seeking back mid-stream isn't possible on pipes, so charge
       // the whole frame and continue).
       skipped_ += kHeader + len;
+      if (fread(hdr, 1, kHeader, file_) != kHeader) return false;
       continue;
     }
     out->append(body);

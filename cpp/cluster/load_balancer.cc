@@ -123,9 +123,9 @@ class RandomLB : public LoadBalancer {
     for (int attempt = 0; attempt < 8; ++attempt) {
       const ServerNode* n;
       if (!weighted_) {
-        n = &list[fast_rand() % list.size()];
+        n = &list[fast_rand_less_than(list.size())];
       } else {
-        uint64_t t = fast_rand() % std::max<uint64_t>(p->total_weight, 1);
+        uint64_t t = fast_rand_less_than(std::max<uint64_t>(p->total_weight, 1));
         n = &list.back();
         for (const ServerNode& cand : list) {
           if (t < uint64_t(cand.weight)) {

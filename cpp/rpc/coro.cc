@@ -1,0 +1,28 @@
+#include "rpc/coro.h"
+
+#include "base/time.h"
+#include "fiber/timer.h"
+
+namespace brt {
+
+namespace {
+
+// Timer callbacks run on the timer thread — too precious to execute user
+// coroutine code on. Hop to a fiber for the resume.
+void* ResumeEntry(void* p) {
+  std::coroutine_handle<>::from_address(p).resume();
+  return nullptr;
+}
+
+void TimerFire(void* p) {
+  fiber_t tid;
+  if (fiber_start(&tid, ResumeEntry, p) != 0) ResumeEntry(p);
+}
+
+}  // namespace
+
+void CoSleep::await_suspend(std::coroutine_handle<> h) {
+  timer_add(monotonic_us() + us_, TimerFire, h.address());
+}
+
+}  // namespace brt

@@ -1,0 +1,177 @@
+// C++20 coroutine bridge over the fiber runtime.
+// Parity target: reference src/brpc/coroutine.h (experimental::Awaitable /
+// Coroutine: co_await an async RPC instead of writing done-closures).
+// Redesigned for this framework's callback contract: an RpcAwaitable
+// suspends the coroutine and issues Channel::CallMethod with a done that
+// resumes it (on the completion fiber — coroutines hop workers the same
+// way fibers do), Awaitable<T> composes coroutine calls, and CoTask is the
+// eager root a plain function can launch and join.
+//
+//   CoTask t = [&]() -> CoTask {
+//     Controller cntl;
+//     IOBuf rsp;
+//     co_await AwaitRpc(&ch, "Echo", "Echo", &cntl, req, &rsp);
+//     co_await CoSleep(1000);             // fiber-timer sleep
+//     int x = co_await SomeAwaitableFn(); // Awaitable<int> composition
+//   }();
+//   t.join();
+#pragma once
+
+#include <coroutine>
+#include <utility>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/channel.h"
+#include "rpc/controller.h"
+
+namespace brt {
+
+// Awaits one RPC: suspends, issues the call, resumes in the done callback
+// with the Controller carrying the outcome.
+class RpcAwaitable {
+ public:
+  RpcAwaitable(ChannelBase* ch, std::string service, std::string method,
+               Controller* cntl, IOBuf request, IOBuf* response)
+      : ch_(ch),
+        service_(std::move(service)),
+        method_(std::move(method)),
+        cntl_(cntl),
+        request_(std::move(request)),
+        response_(response) {}
+
+  bool await_ready() const noexcept { return false; }
+  void await_suspend(std::coroutine_handle<> h) {
+    ch_->CallMethod(service_, method_, cntl_, request_, response_,
+                    [h] { h.resume(); });
+  }
+  void await_resume() const noexcept {}
+
+ private:
+  ChannelBase* ch_;
+  std::string service_, method_;
+  Controller* cntl_;
+  IOBuf request_;
+  IOBuf* response_;
+};
+
+inline RpcAwaitable AwaitRpc(ChannelBase* ch, std::string service,
+                             std::string method, Controller* cntl,
+                             IOBuf request, IOBuf* response) {
+  return RpcAwaitable(ch, std::move(service), std::move(method), cntl,
+                      std::move(request), response);
+}
+
+// co_await CoSleep(us): parks a fiber-timer, resumes when it fires.
+class CoSleep {
+ public:
+  explicit CoSleep(int64_t us) : us_(us) {}
+  bool await_ready() const noexcept { return us_ <= 0; }
+  void await_suspend(std::coroutine_handle<> h);
+  void await_resume() const noexcept {}
+
+ private:
+  int64_t us_;
+};
+
+// Eager root coroutine: starts running on creation, joinable from any
+// fiber/thread. The coroutine frame lives until join() observes the final
+// suspend (join is REQUIRED exactly once).
+class CoTask {
+ public:
+  struct promise_type {
+    CountdownEvent done{1};
+
+    CoTask get_return_object() {
+      return CoTask(
+          std::coroutine_handle<promise_type>::from_promise(*this));
+    }
+    std::suspend_never initial_suspend() noexcept { return {}; }
+    // Final suspend keeps the frame alive so join() can synchronize on
+    // `done` before destroying it. The signal happens inside the final
+    // awaiter's await_suspend — the coroutine counts as suspended there,
+    // so a concurrent join() may destroy the frame the instant it fires
+    // (signal touches nothing after its atomic; see CountdownEvent).
+    struct FinalAwaiter {
+      bool await_ready() noexcept { return false; }
+      void await_suspend(std::coroutine_handle<promise_type> h) noexcept {
+        h.promise().done.signal();  // last touch of the frame
+      }
+      void await_resume() noexcept {}
+    };
+    FinalAwaiter final_suspend() noexcept { return {}; }
+    void return_void() noexcept {}
+    void unhandled_exception() noexcept { abort(); }  // -fno-exceptions tier
+  };
+
+  CoTask() = default;
+  explicit CoTask(std::coroutine_handle<promise_type> h) : h_(h) {}
+  CoTask(CoTask&& o) noexcept : h_(std::exchange(o.h_, nullptr)) {}
+  CoTask& operator=(CoTask&& o) noexcept {
+    h_ = std::exchange(o.h_, nullptr);
+    return *this;
+  }
+  CoTask(const CoTask&) = delete;
+  ~CoTask() { /* join() owns destruction */ }
+
+  // Parks the caller (fiber-aware) until the coroutine completes, then
+  // frees its frame.
+  void join() {
+    if (!h_) return;
+    h_.promise().done.wait();
+    h_.destroy();
+    h_ = nullptr;
+  }
+
+ private:
+  std::coroutine_handle<promise_type> h_;
+};
+
+// Composable coroutine value: `Awaitable<int> f();  int x = co_await f();`
+// Lazy — runs when awaited; the result moves out at resume. (Reference
+// experimental::Awaitable<T> contract.)
+template <typename T>
+class Awaitable {
+ public:
+  struct promise_type {
+    T value{};
+    std::coroutine_handle<> continuation;
+
+    Awaitable get_return_object() {
+      return Awaitable(
+          std::coroutine_handle<promise_type>::from_promise(*this));
+    }
+    std::suspend_always initial_suspend() noexcept { return {}; }
+    // Resume whoever co_awaited us, via symmetric transfer.
+    struct FinalAwaiter {
+      bool await_ready() noexcept { return false; }
+      std::coroutine_handle<> await_suspend(
+          std::coroutine_handle<promise_type> h) noexcept {
+        return h.promise().continuation;
+      }
+      void await_resume() noexcept {}
+    };
+    FinalAwaiter final_suspend() noexcept { return {}; }
+    void return_value(T v) noexcept { value = std::move(v); }
+    void unhandled_exception() noexcept { abort(); }
+  };
+
+  explicit Awaitable(std::coroutine_handle<promise_type> h) : h_(h) {}
+  Awaitable(Awaitable&& o) noexcept : h_(std::exchange(o.h_, nullptr)) {}
+  Awaitable(const Awaitable&) = delete;
+  ~Awaitable() {
+    if (h_) h_.destroy();
+  }
+
+  bool await_ready() const noexcept { return false; }
+  std::coroutine_handle<> await_suspend(std::coroutine_handle<> caller) {
+    h_.promise().continuation = caller;
+    return h_;  // start the child now (symmetric transfer)
+  }
+  T await_resume() { return std::move(h_.promise().value); }
+
+ private:
+  std::coroutine_handle<promise_type> h_;
+};
+
+}  // namespace brt
