@@ -1,0 +1,263 @@
+#include "base/heap_profiler.h"
+
+#include <execinfo.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <sstream>
+#include <vector>
+
+#include "base/profiler.h"
+#include "var/collector.h"
+
+namespace brt {
+
+namespace {
+
+constexpr int kMaxFrames = 24;
+constexpr int kSkipFrames = 2;  // hook + operator new
+constexpr int kShards = 64;
+
+struct Sample {
+  void* frames[kMaxFrames];
+  int nframes = 0;
+  size_t size = 0;
+};
+
+// Sharded by pointer hash: frees only contend within a shard, and only
+// while a session is active (or samples linger).
+struct Shard {
+  std::mutex mu;
+  // malloc-backed containers would recurse through our own operator new
+  // hooks; std::map with the default allocator is safe because the hooks
+  // set t_in_hook around any internal allocation.
+  std::map<void*, Sample> live;
+};
+
+Shard* g_shards = nullptr;  // leaked on first use (hooks outlive statics)
+std::once_flag g_shards_once;
+std::atomic<bool> g_enabled{false};
+std::atomic<int64_t> g_live_count{0};
+std::atomic<int64_t> g_sample_bytes{512 * 1024};
+
+thread_local int64_t t_budget = 0;
+thread_local bool t_in_hook = false;
+
+Shard& ShardOf(void* p) {
+  std::call_once(g_shards_once, [] { g_shards = new Shard[kShards]; });
+  const uintptr_t h = reinterpret_cast<uintptr_t>(p);
+  return g_shards[(h >> 4) % kShards];
+}
+
+void RecordAlloc(void* p, size_t n) {
+  Sample s;
+  s.size = n;
+  s.nframes = backtrace(s.frames, kMaxFrames);
+  Shard& sh = ShardOf(p);
+  std::lock_guard<std::mutex> g(sh.mu);
+  sh.live.emplace(p, s);
+  g_live_count.fetch_add(1, std::memory_order_relaxed);
+  if (!g_enabled.load(std::memory_order_acquire)) {
+    // StopAndReport drained the shards while we were unwinding: our entry
+    // would linger forever (pinning the HookedFree slow path and polluting
+    // the next session). Take it back out.
+    sh.live.erase(p);
+    g_live_count.fetch_sub(1, std::memory_order_relaxed);
+  }
+}
+
+void RecordFree(void* p) {
+  Shard& sh = ShardOf(p);
+  std::lock_guard<std::mutex> g(sh.mu);
+  auto it = sh.live.find(p);
+  if (it != sh.live.end()) {
+    sh.live.erase(it);
+    g_live_count.fetch_sub(1, std::memory_order_relaxed);
+  }
+}
+
+void* MaybeSample(void* p, size_t n) {
+  if (p == nullptr) return nullptr;
+  if (!g_enabled.load(std::memory_order_relaxed) || t_in_hook) return p;
+  t_budget -= int64_t(n);
+  if (t_budget >= 0) return p;
+  t_budget = g_sample_bytes.load(std::memory_order_relaxed);
+  t_in_hook = true;
+  RecordAlloc(p, n);
+  t_in_hook = false;
+  return p;
+}
+
+void* HookedAlloc(size_t n) { return MaybeSample(malloc(n ? n : 1), n); }
+
+void* HookedAlignedAlloc(size_t n, size_t align) {
+  const size_t rounded = (n + align - 1) & ~(align - 1);
+  return MaybeSample(aligned_alloc(align, rounded ? rounded : align), n);
+}
+
+void HookedFree(void* p) {
+  if (p == nullptr) return;
+  // Cheap when idle: a relaxed load each; the shard lock is taken only
+  // while samples can exist.
+  if ((g_enabled.load(std::memory_order_relaxed) ||
+       g_live_count.load(std::memory_order_relaxed) > 0) &&
+      !t_in_hook) {
+    t_in_hook = true;
+    RecordFree(p);
+    t_in_hook = false;
+  }
+  free(p);
+}
+
+struct StackKey {
+  std::vector<void*> frames;
+  bool operator<(const StackKey& o) const { return frames < o.frames; }
+};
+
+}  // namespace
+
+HeapProfiler& HeapProfiler::singleton() {
+  static HeapProfiler* p = new HeapProfiler();
+  return *p;
+}
+
+bool HeapProfiler::running() const {
+  return g_enabled.load(std::memory_order_acquire);
+}
+
+bool HeapProfiler::Start(int64_t sample_bytes) {
+  // Mutually exclusive with the SIGPROF CPU profiler: a heap session puts
+  // worker threads inside backtrace() constantly, and a SIGPROF tick
+  // landing mid-unwind would re-enter the non-reentrant unwinder.
+  if (CpuProfiler::singleton().running()) return false;
+  if (sample_bytes < 4096) sample_bytes = 4096;
+  std::call_once(g_shards_once, [] { g_shards = new Shard[kShards]; });
+  g_sample_bytes.store(sample_bytes, std::memory_order_relaxed);
+  bool expected = false;
+  if (!g_enabled.compare_exchange_strong(expected, true,
+                                         std::memory_order_acq_rel)) {
+    return false;
+  }
+  return true;
+}
+
+std::string HeapProfiler::StopAndReport() {
+  if (!g_enabled.exchange(false, std::memory_order_acq_rel)) {
+    return "heap profiler was not running\n";
+  }
+  // This function's own allocations/frees MUST bypass the hooks: the
+  // drain below holds shard mutexes, and a free of our temporaries would
+  // re-enter RecordFree and self-deadlock on the held shard (1-in-64 per
+  // free). RAII so every return path restores.
+  struct HookGuard {
+    HookGuard() { t_in_hook = true; }
+    ~HookGuard() { t_in_hook = false; }
+  } in_hook;
+  // Drain the table under the shard locks; frees racing us just miss
+  // (their entries show as live — a sampling profiler tolerates that).
+  struct Agg {
+    int64_t bytes = 0;
+    int64_t count = 0;
+  };
+  std::map<StackKey, Agg> by_stack;
+  int64_t total_bytes = 0, total_count = 0;
+  for (int i = 0; i < kShards; ++i) {
+    std::lock_guard<std::mutex> g(g_shards[i].mu);
+    for (auto& [p, s] : g_shards[i].live) {
+      StackKey key;
+      const int skip = s.nframes > kSkipFrames ? kSkipFrames : 0;
+      key.frames.assign(s.frames + skip, s.frames + s.nframes);
+      Agg& a = by_stack[key];
+      a.bytes += int64_t(s.size);
+      a.count += 1;
+      total_bytes += int64_t(s.size);
+      total_count += 1;
+    }
+    g_shards[i].live.clear();
+  }
+  g_live_count.store(0, std::memory_order_relaxed);
+
+  const int64_t rate = g_sample_bytes.load(std::memory_order_relaxed);
+  std::ostringstream os;
+  os << "heap profile: " << total_count << " sampled live allocations, "
+     << total_bytes << " sampled bytes (sample interval " << rate
+     << " bytes; each sample stands for ~interval allocated bytes)\n\n";
+  std::vector<std::pair<const StackKey*, const Agg*>> order;
+  order.reserve(by_stack.size());
+  for (auto& [k, a] : by_stack) order.emplace_back(&k, &a);
+  std::sort(order.begin(), order.end(), [](auto& x, auto& y) {
+    return x.second->bytes > y.second->bytes;
+  });
+  int shown = 0;
+  for (auto& [k, a] : order) {
+    if (++shown > 40) break;
+    os << a->bytes << " bytes in " << a->count << " sampled allocation"
+       << (a->count == 1 ? "" : "s") << ":\n";
+    for (void* f : k->frames) {
+      os << "    " << var::SymbolizeFrame(f) << "\n";
+    }
+    os << "\n";
+  }
+  if (order.empty()) {
+    os << "(no live sampled allocations — everything allocated during the "
+          "session was freed)\n";
+  }
+  return os.str();
+}
+
+}  // namespace brt
+
+// ---------------------------------------------------------------------------
+// Global operator new/delete interposition (whole-process, link-time).
+// ---------------------------------------------------------------------------
+
+void* operator new(size_t n) {
+  void* p = brt::HookedAlloc(n);
+  if (p == nullptr) throw std::bad_alloc();
+  return p;
+}
+void* operator new[](size_t n) { return operator new(n); }
+void* operator new(size_t n, const std::nothrow_t&) noexcept {
+  return brt::HookedAlloc(n);
+}
+void* operator new[](size_t n, const std::nothrow_t&) noexcept {
+  return brt::HookedAlloc(n);
+}
+void operator delete(void* p) noexcept { brt::HookedFree(p); }
+void operator delete[](void* p) noexcept { brt::HookedFree(p); }
+void operator delete(void* p, size_t) noexcept { brt::HookedFree(p); }
+void operator delete[](void* p, size_t) noexcept { brt::HookedFree(p); }
+void operator delete(void* p, const std::nothrow_t&) noexcept {
+  brt::HookedFree(p);
+}
+void operator delete[](void* p, const std::nothrow_t&) noexcept {
+  brt::HookedFree(p);
+}
+
+// Aligned variants (C++17): aligned_alloc + the same sampling as the
+// plain operators (an over-aligned leak must show up in /heap too).
+void* operator new(size_t n, std::align_val_t al) {
+  void* p = brt::HookedAlignedAlloc(n, size_t(al));
+  if (p == nullptr) throw std::bad_alloc();
+  return p;
+}
+void* operator new[](size_t n, std::align_val_t al) {
+  return operator new(n, al);
+}
+void operator delete(void* p, std::align_val_t) noexcept {
+  brt::HookedFree(p);
+}
+void operator delete[](void* p, std::align_val_t) noexcept {
+  brt::HookedFree(p);
+}
+void operator delete(void* p, size_t, std::align_val_t) noexcept {
+  brt::HookedFree(p);
+}
+void operator delete[](void* p, size_t, std::align_val_t) noexcept {
+  brt::HookedFree(p);
+}

@@ -1,0 +1,34 @@
+// Sampling heap profiler behind the /heap builtin.
+// Parity target: reference src/brpc/builtin/hotspots_service.cpp heap/
+// growth modes (driven by tcmalloc's allocation sampler). Redesigned with
+// no tcmalloc: global operator new/delete are interposed in-process; a
+// profiling SESSION (Start..StopAndReport, like CpuProfiler) samples every
+// ~sample_bytes of allocation, records the allocation stack, and drops
+// entries on free — the report shows what was allocated during the
+// session and is STILL LIVE, aggregated by stack, largest first. When no
+// session is active the hooks cost one thread-local check per new/delete.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace brt {
+
+class HeapProfiler {
+ public:
+  static HeapProfiler& singleton();
+
+  // Begins sampling roughly every `sample_bytes` allocated on each
+  // thread. False if already running.
+  bool Start(int64_t sample_bytes = 512 * 1024);
+
+  // Stops sampling and returns the symbolized live-allocation report.
+  std::string StopAndReport();
+
+  bool running() const;
+
+ private:
+  HeapProfiler() = default;
+};
+
+}  // namespace brt

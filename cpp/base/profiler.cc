@@ -1,5 +1,7 @@
 #include "base/profiler.h"
 
+#include "base/heap_profiler.h"
+
 #include <execinfo.h>
 #include <signal.h>
 #include <string.h>
@@ -85,6 +87,9 @@ bool CpuProfiler::running() const {
 bool CpuProfiler::Start(int hz) {
   std::lock_guard<std::mutex> g(g_session_mu);
   if (g_running.load(std::memory_order_acquire)) return false;
+  // See HeapProfiler::Start — the two sessions must not overlap (SIGPROF's
+  // backtrace vs the heap sampler's constant in-backtrace time).
+  if (HeapProfiler::singleton().running()) return false;
   if (hz <= 0 || hz > 1000) hz = 99;
   g_hz = hz;
   // Warm up the unwinder before signals fly (dlopen of libgcc happens on

@@ -1,0 +1,76 @@
+#include "base/stack_trace.h"
+
+#include <execinfo.h>
+#include <signal.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <mutex>
+#include <sstream>
+
+namespace brt {
+
+namespace var {
+std::string SymbolizeFrame(void* addr);  // collector.cc
+}
+
+std::string CurrentStackTrace(int skip) {
+  void* frames[48];
+  const int n = backtrace(frames, 48);
+  std::ostringstream os;
+  for (int i = skip + 1; i < n; ++i) {  // +1: this function
+    os << "    " << var::SymbolizeFrame(frames[i]) << "\n";
+  }
+  return os.str();
+}
+
+namespace {
+
+const char* SigName(int sig) {
+  switch (sig) {
+    case SIGSEGV: return "SIGSEGV";
+    case SIGBUS: return "SIGBUS";
+    case SIGFPE: return "SIGFPE";
+    case SIGILL: return "SIGILL";
+    case SIGABRT: return "SIGABRT";
+    default: return "signal";
+  }
+}
+
+void FailureHandler(int sig, siginfo_t* info, void*) {
+  // Async-signal-safety is deliberately traded for diagnostics here — the
+  // process is dying anyway (the reference's crash reporter makes the
+  // same call). backtrace_symbols_fd avoids malloc at least.
+  char head[128];
+  const int hn = snprintf(head, sizeof(head),
+                          "\n*** %s (si_addr=%p) — stack: ***\n",
+                          SigName(sig), info ? info->si_addr : nullptr);
+  if (hn > 0) {
+    ssize_t unused = write(STDERR_FILENO, head, size_t(hn));
+    (void)unused;
+  }
+  void* frames[48];
+  const int n = backtrace(frames, 48);
+  backtrace_symbols_fd(frames, n, STDERR_FILENO);
+  // Restore default and re-raise so the exit status / core dump are real.
+  signal(sig, SIG_DFL);
+  raise(sig);
+}
+
+}  // namespace
+
+void InstallFailureSignalHandler() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = FailureHandler;
+    sa.sa_flags = SA_SIGINFO | SA_ONSTACK;
+    for (int sig : {SIGSEGV, SIGBUS, SIGFPE, SIGILL, SIGABRT}) {
+      sigaction(sig, &sa, nullptr);
+    }
+  });
+}
+
+}  // namespace brt

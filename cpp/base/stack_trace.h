@@ -1,0 +1,20 @@
+// Symbolized stack traces + fatal-signal dumper.
+// Parity target: reference src/butil/debug/stack_trace.{h,cc} (StackTrace
+// class, crash reporting) — backtrace() + the shared dladdr/demangle
+// symbolizer (var::SymbolizeFrame) instead of glog's symbolize fork.
+#pragma once
+
+#include <string>
+
+namespace brt {
+
+// Symbolized trace of the calling stack ("    func+0x12 [module]\n" per
+// frame), skipping `skip` innermost frames (0 = include the caller).
+std::string CurrentStackTrace(int skip = 0);
+
+// Installs SIGSEGV/SIGBUS/SIGFPE/SIGILL/SIGABRT handlers that write the
+// signal name + a symbolized stack to stderr, then re-raise with default
+// disposition (core dumps still happen). Idempotent.
+void InstallFailureSignalHandler();
+
+}  // namespace brt

@@ -1,5 +1,6 @@
 #include "rpc/builtin.h"
 
+#include "base/heap_profiler.h"
 #include "base/profiler.h"
 #include "fiber/fiber.h"
 #include "fiber/fiber_id.h"
@@ -160,6 +161,30 @@ bool HandleBuiltinPage(Server* server, const std::string& method,
     out->body = CpuProfiler::singleton().StopAndReport();
     return true;
   }
+  if (path == "/heap") {
+    // Sampling heap profile: ?seconds=N observation window (default 2,
+    // cap 60), ?sample_bytes=N (default 512KB). Reports allocations made
+    // DURING the window that are still live at its end, by stack
+    // (reference hotspots_service.cpp heap mode, sans tcmalloc).
+    int seconds = 2;
+    int64_t sample_bytes = 512 * 1024;
+    size_t pos = query.find("seconds=");
+    if (pos != std::string::npos) seconds = atoi(query.c_str() + pos + 8);
+    pos = query.find("sample_bytes=");
+    if (pos != std::string::npos) {
+      sample_bytes = atoll(query.c_str() + pos + 13);
+    }
+    if (seconds < 1) seconds = 1;
+    if (seconds > 60) seconds = 60;
+    if (!HeapProfiler::singleton().Start(sample_bytes)) {
+      out->status = 503;
+      out->body = "another heap profiling session is running\n";
+      return true;
+    }
+    fiber_usleep(int64_t(seconds) * 1000000);
+    out->body = HeapProfiler::singleton().StopAndReport();
+    return true;
+  }
   if (path == "/contention") {
     if (query.find("reset=1") != std::string::npos) {
       var::StackCollector::contention().Reset();
@@ -203,7 +228,7 @@ bool HandleBuiltinPage(Server* server, const std::string& method,
   if (path == "/index") {
     out->body =
         "/status /vars /brpc_metrics /connections /sockets /rpcz /flags\n"
-        "/hotspots /contention /fibers /ids /health /version\n";
+        "/hotspots /heap /contention /fibers /ids /health /version\n";
     return true;
   }
   return false;

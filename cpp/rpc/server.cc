@@ -1,6 +1,7 @@
 #include "rpc/server.h"
 
 #include "base/logging.h"
+#include "base/stack_trace.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
@@ -64,6 +65,9 @@ int Server::Start(const EndPoint& addr, const Options* opts) {
     SetRpcDumpFile(dump);
   }
   start_time_us = monotonic_us();
+  // Fatal signals dump a symbolized stack before the default disposition
+  // re-raises (reference crash reporter behavior).
+  InstallFailureSignalHandler();
   acceptor_.conn_options.user = this;
   acceptor_.conn_options.on_edge_triggered = InputMessengerOnEdgeTriggered;
   acceptor_.conn_options.run_deferred = InputMessengerProcessDeferred;

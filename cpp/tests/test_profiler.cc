@@ -3,6 +3,7 @@
 // hotspots_service + the mutex contention profiler, bthread/mutex.cpp:267).
 #include <arpa/inet.h>
 #include <sys/socket.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -10,7 +11,9 @@
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <vector>
 
+#include "base/stack_trace.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
 #include "rpc/channel.h"
@@ -147,6 +150,79 @@ int main() {
   // The convoy must show up with real waited time and a stack.
   assert(cont.find("distinct_stacks: 0") == std::string::npos);
   printf("contention OK\n");
+
+  // ---- /heap: leak made during the window must show with a stack ----
+  {
+    struct LeakArg {
+      std::atomic<bool> stop{false};
+      CountdownEvent done{1};
+      std::vector<char*> kept;
+    } leak;
+    fiber_t t;
+    assert(fiber_start(&t, [](void* p) -> void* {
+      auto* a = static_cast<LeakArg*>(p);
+      // Allocate ~64MB in 64KB chunks and KEEP them live — with a 64KB
+      // sample interval the profiler must catch plenty.
+      for (int i = 0; i < 1000 && !a->stop.load(); ++i) {
+        char* c = new char[64 * 1024];
+        memset(c, 1, 64 * 1024);
+        a->kept.push_back(c);
+        fiber_usleep(500);
+      }
+      a->done.signal();
+      return nullptr;
+    }, &leak) == 0);
+    std::string heap = HttpGet(
+        addr, "GET /heap?seconds=1&sample_bytes=65536 HTTP/1.1\r\n\r\n");
+    leak.stop.store(true);
+    leak.done.wait(-1);
+    assert(heap.rfind("HTTP/1.1 200", 0) == 0);
+    assert(heap.find("heap profile:") != std::string::npos);
+    const size_t hp = heap.find("heap profile: ");
+    const int live = atoi(heap.c_str() + hp + 14);
+    assert(live > 10);  // the kept chunks were sampled
+    assert(heap.find("bytes in") != std::string::npos);
+    for (char* c : leak.kept) delete[] c;
+    printf("heap profile OK (%d live sampled)\n", live);
+  }
+
+  // ---- stack trace symbolization ----
+  {
+    const std::string st = CurrentStackTrace();
+    assert(!st.empty());
+    assert(st.find("main") != std::string::npos);
+    printf("stack trace OK\n");
+  }
+
+  // ---- fatal-signal handler: child segfaults, dumps a stack, re-raises
+  {
+    int pipefd[2];
+    assert(pipe(pipefd) == 0);
+    const pid_t child = fork();
+    if (child == 0) {
+      dup2(pipefd[1], STDERR_FILENO);
+      close(pipefd[0]);
+      close(pipefd[1]);
+      InstallFailureSignalHandler();
+      volatile int* bad = nullptr;
+      *bad = 42;  // SIGSEGV
+      _exit(0);   // unreachable
+    }
+    close(pipefd[1]);
+    std::string err;
+    char cbuf[4096];
+    ssize_t cn;
+    while ((cn = read(pipefd[0], cbuf, sizeof(cbuf))) > 0) {
+      err.append(cbuf, size_t(cn));
+    }
+    close(pipefd[0]);
+    int wstatus = 0;
+    assert(waitpid(child, &wstatus, 0) == child);
+    assert(WIFSIGNALED(wstatus) && WTERMSIG(wstatus) == SIGSEGV);
+    assert(err.find("SIGSEGV") != std::string::npos);
+    assert(err.find("stack") != std::string::npos);
+    printf("failure signal handler OK\n");
+  }
 
   // ---- misc new pages ----
   std::string fibers = HttpGet(addr, "GET /fibers HTTP/1.1\r\n\r\n");
