@@ -1,0 +1,222 @@
+#include "rpc/bson.h"
+
+#include <cstring>
+
+namespace brt {
+
+namespace {
+
+constexpr size_t kMaxBson = 16u << 20;
+constexpr int kMaxDepth = 32;
+
+void PutI32(std::string* s, int32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);  // x86-64: little-endian, as BSON requires
+  s->append(b, 4);
+}
+void PutI64(std::string* s, int64_t v) {
+  char b[8];
+  memcpy(b, &v, 8);
+  s->append(b, 8);
+}
+void PutF64(std::string* s, double v) {
+  char b[8];
+  memcpy(b, &v, 8);
+  s->append(b, 8);
+}
+
+bool EncodeValue(const JsonValue& v, const std::string& key,
+                 std::string* out, int depth);
+
+bool EncodeDocBody(const JsonValue& doc, std::string* out, int depth) {
+  if (depth > kMaxDepth) return false;
+  std::string body;
+  if (doc.type == JsonValue::Type::kObject) {
+    for (const auto& [k, v] : doc.members) {
+      if (k.find('\0') != std::string::npos) return false;
+      if (!EncodeValue(v, k, &body, depth)) return false;
+    }
+  } else {  // kArray: keys are "0", "1", ...
+    for (size_t i = 0; i < doc.elems.size(); ++i) {
+      if (!EncodeValue(doc.elems[i], std::to_string(i), &body, depth)) {
+        return false;
+      }
+    }
+  }
+  PutI32(out, int32_t(body.size() + 5));  // len + body + trailing 0
+  out->append(body);
+  out->push_back('\0');
+  return true;
+}
+
+bool EncodeValue(const JsonValue& v, const std::string& key,
+                 std::string* out, int depth) {
+  auto put_key = [&](char type) {
+    out->push_back(type);
+    out->append(key);
+    out->push_back('\0');
+  };
+  switch (v.type) {
+    case JsonValue::Type::kDouble:
+      put_key(0x01);
+      PutF64(out, v.d);
+      return true;
+    case JsonValue::Type::kString:
+      if (v.str.find('\0') != std::string::npos) return false;
+      put_key(0x02);
+      PutI32(out, int32_t(v.str.size() + 1));
+      out->append(v.str);
+      out->push_back('\0');
+      return true;
+    case JsonValue::Type::kObject:
+      put_key(0x03);
+      return EncodeDocBody(v, out, depth + 1);
+    case JsonValue::Type::kArray:
+      put_key(0x04);
+      return EncodeDocBody(v, out, depth + 1);
+    case JsonValue::Type::kBool:
+      put_key(0x08);
+      out->push_back(v.b ? 1 : 0);
+      return true;
+    case JsonValue::Type::kNull:
+      put_key(0x0A);
+      return true;
+    case JsonValue::Type::kInt:
+      if (v.i >= INT32_MIN && v.i <= INT32_MAX) {
+        put_key(0x10);
+        PutI32(out, int32_t(v.i));
+      } else {
+        put_key(0x12);
+        PutI64(out, v.i);
+      }
+      return true;
+  }
+  return false;
+}
+
+struct BsonParser {
+  const uint8_t* p;
+  const uint8_t* end;
+  std::string* err;
+
+  bool Fail(const char* m) {
+    if (err) *err = m;
+    return false;
+  }
+  bool I32(int32_t* v) {
+    if (end - p < 4) return Fail("truncated int32");
+    memcpy(v, p, 4);
+    p += 4;
+    return true;
+  }
+  bool CStr(std::string* s) {
+    const uint8_t* z =
+        static_cast<const uint8_t*>(memchr(p, 0, size_t(end - p)));
+    if (z == nullptr) return Fail("unterminated cstring");
+    s->assign(reinterpret_cast<const char*>(p), size_t(z - p));
+    p = z + 1;
+    return true;
+  }
+
+  bool Doc(JsonValue* out, int depth, bool as_array) {
+    if (depth > kMaxDepth) return Fail("nesting too deep");
+    int32_t len;
+    const uint8_t* doc_start = p;
+    if (!I32(&len)) return false;
+    if (len < 5 || len > int32_t(end - doc_start)) {
+      return Fail("bad document length");
+    }
+    const uint8_t* doc_end = doc_start + len;
+    *out = as_array ? JsonValue::Array() : JsonValue::Object();
+    while (p < doc_end - 1) {
+      const uint8_t type = *p++;
+      std::string key;
+      if (!CStr(&key)) return false;
+      JsonValue v;
+      switch (type) {
+        case 0x01: {
+          if (doc_end - p < 8) return Fail("truncated double");
+          double d;
+          memcpy(&d, p, 8);
+          p += 8;
+          v = JsonValue::Double(d);
+          break;
+        }
+        case 0x02: {
+          int32_t slen;
+          if (!I32(&slen)) return false;
+          if (slen < 1 || slen > doc_end - p) return Fail("bad string len");
+          if (p[slen - 1] != 0) return Fail("string not NUL-terminated");
+          v = JsonValue::String(
+              std::string(reinterpret_cast<const char*>(p),
+                          size_t(slen - 1)));
+          p += slen;
+          break;
+        }
+        case 0x03:
+          if (!Doc(&v, depth + 1, /*as_array=*/false)) return false;
+          break;
+        case 0x04:
+          if (!Doc(&v, depth + 1, /*as_array=*/true)) return false;
+          break;
+        case 0x08:
+          if (p >= doc_end) return Fail("truncated bool");
+          if (*p > 1) return Fail("bad bool value");
+          v = JsonValue::Bool(*p++ != 0);
+          break;
+        case 0x0A:
+          v = JsonValue::Null();
+          break;
+        case 0x10: {
+          int32_t i;
+          if (!I32(&i)) return false;
+          v = JsonValue::Int(i);
+          break;
+        }
+        case 0x12: {
+          if (doc_end - p < 8) return Fail("truncated int64");
+          int64_t i;
+          memcpy(&i, p, 8);
+          p += 8;
+          v = JsonValue::Int(i);
+          break;
+        }
+        default:
+          return Fail("unsupported BSON element type");
+      }
+      if (as_array) {
+        out->elems.push_back(std::move(v));
+      } else {
+        out->members.emplace_back(std::move(key), std::move(v));
+      }
+    }
+    if (p != doc_end - 1 || *p != 0) return Fail("document framing broken");
+    ++p;
+    return true;
+  }
+};
+
+}  // namespace
+
+bool BsonEncode(const JsonValue& doc, IOBuf* out) {
+  if (doc.type != JsonValue::Type::kObject) return false;
+  std::string bytes;
+  if (!EncodeDocBody(doc, &bytes, 0)) return false;
+  if (bytes.size() > kMaxBson) return false;
+  out->append(bytes);
+  return true;
+}
+
+ssize_t BsonDecode(const void* data, size_t n, JsonValue* out,
+                   std::string* err) {
+  if (n > kMaxBson) {
+    if (err) *err = "document too large";
+    return -1;
+  }
+  BsonParser ps{static_cast<const uint8_t*>(data),
+                static_cast<const uint8_t*>(data) + n, err};
+  if (!ps.Doc(out, 0, /*as_array=*/false)) return -1;
+  return ps.p - static_cast<const uint8_t*>(data);
+}
+
+}  // namespace brt

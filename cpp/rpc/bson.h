@@ -1,0 +1,28 @@
+// BSON codec over the JsonValue DOM — the document layer under the mongo
+// wire protocol (rpc/mongo.h). Parity target: reference
+// src/brpc/policy/mongo_protocol.cpp + mongo.pb (which lean on an external
+// BSON library); here the subset mongo commands actually use is
+// implemented directly: double(0x01) string(0x02) document(0x03)
+// array(0x04) bool(0x08) null(0x0A) int32(0x10) int64(0x12).
+#pragma once
+
+#include <string>
+
+#include "base/iobuf.h"
+#include "rpc/json.h"
+
+namespace brt {
+
+// Serializes an OBJECT JsonValue as one BSON document. kInt encodes as
+// int32 when it fits, else int64; arrays become BSON arrays with "0","1"…
+// keys, per spec. False if `doc` is not an object or holds an unmappable
+// value.
+bool BsonEncode(const JsonValue& doc, IOBuf* out);
+
+// Parses one BSON document from data[0,n). Strict: lengths must agree,
+// strings NUL-terminated, depth <= 32, n <= 16MB (mongo's own max).
+// Returns consumed bytes or -1 with *err.
+ssize_t BsonDecode(const void* data, size_t n, JsonValue* out,
+                   std::string* err);
+
+}  // namespace brt

@@ -1,0 +1,381 @@
+#include "rpc/legacy.h"
+
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/server.h"
+#include "transport/input_messenger.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+namespace {
+
+constexpr uint32_t kMaxLegacyBody = 64u << 20;
+
+// ---------------------------------------------------------------------------
+// Server-side registries (one handler per Server, reference
+// nshead_service.h contract).
+// ---------------------------------------------------------------------------
+
+std::mutex g_reg_mu;
+std::map<Server*, NsheadService*>& nshead_map() {
+  static auto* m = new std::map<Server*, NsheadService*>();
+  return *m;
+}
+std::map<Server*, EspService*>& esp_map() {
+  static auto* m = new std::map<Server*, EspService*>();
+  return *m;
+}
+
+template <typename M>
+typename M::mapped_type FindHandler(M& m, Server* s) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  auto it = m.find(s);
+  return it == m.end() ? nullptr : it->second;
+}
+
+// ---------------------------------------------------------------------------
+// nshead framing
+// ---------------------------------------------------------------------------
+
+ParseResult NsheadParse(IOBuf* source, IOBuf* msg, Socket*) {
+  // The magic sits at offset 24..27: once that much arrived, a mismatch
+  // must yield to the other protocols rather than hold the stream.
+  if (source->size() >= 28) {
+    uint32_t magic;
+    source->copy_to(&magic, 4, offsetof(NsheadHead, magic_num));
+    if (magic != 0xfb709394) return ParseResult::TRY_OTHER;
+  }
+  if (source->size() < sizeof(NsheadHead)) {
+    return ParseResult::NOT_ENOUGH_DATA;
+  }
+  NsheadHead head;
+  source->copy_to(&head, sizeof(head));
+  if (head.body_len > kMaxLegacyBody) return ParseResult::ERROR;
+  const size_t total = sizeof(head) + head.body_len;
+  if (source->size() < total) return ParseResult::NOT_ENOUGH_DATA;
+  source->cutn(msg, total);
+  return ParseResult::OK;
+}
+
+void AppendNshead(IOBuf* out, NsheadHead head, const IOBuf& body) {
+  head.body_len = uint32_t(body.size());
+  out->append(&head, sizeof(head));
+  out->append(body);
+}
+
+void NsheadProcess(IOBuf&& msg, SocketId sid) {
+  SocketUniquePtr ptr;
+  if (Socket::Address(sid, &ptr) != 0) return;
+  auto* server = static_cast<Server*>(ptr->user());
+  NsheadService* svc =
+      server != nullptr ? FindHandler(nshead_map(), server) : nullptr;
+  NsheadHead head;
+  msg.copy_to(&head, sizeof(head));
+  msg.pop_front(sizeof(head));
+  if (svc == nullptr) {
+    ptr->SetFailed(EBADMSG, "no nshead handler on this server");
+    return;
+  }
+  IOBuf response_body;
+  svc->ProcessNsheadRequest(head, msg, &response_body);
+  IOBuf out;
+  AppendNshead(&out, head, response_body);  // mirrors id/version/log_id
+  ptr->Write(&out);
+}
+
+// ---------------------------------------------------------------------------
+// esp framing
+// ---------------------------------------------------------------------------
+
+ParseResult EspParse(IOBuf* source, IOBuf* msg, Socket*) {
+  // esp has no magic; it is only reachable on connections whose FIRST
+  // bytes already failed every magic-bearing protocol. Discriminate via
+  // the head's msg field high byte (reserved 0xE5 marker in this
+  // framework's dialect) so random traffic cannot alias it.
+  if (source->size() < sizeof(EspHead)) return ParseResult::NOT_ENOUGH_DATA;
+  EspHead head;
+  source->copy_to(&head, sizeof(head));
+  if ((head.msg >> 24) != 0xE5) return ParseResult::TRY_OTHER;
+  if (head.body_len < 0 || uint32_t(head.body_len) > kMaxLegacyBody) {
+    return ParseResult::ERROR;
+  }
+  const size_t total = sizeof(head) + size_t(head.body_len);
+  if (source->size() < total) return ParseResult::NOT_ENOUGH_DATA;
+  source->cutn(msg, total);
+  return ParseResult::OK;
+}
+
+void AppendEsp(IOBuf* out, EspHead head, const IOBuf& body) {
+  head.body_len = int32_t(body.size());
+  out->append(&head, sizeof(head));
+  out->append(body);
+}
+
+void EspProcess(IOBuf&& msg, SocketId sid) {
+  SocketUniquePtr ptr;
+  if (Socket::Address(sid, &ptr) != 0) return;
+  auto* server = static_cast<Server*>(ptr->user());
+  EspService* svc =
+      server != nullptr ? FindHandler(esp_map(), server) : nullptr;
+  EspHead head;
+  msg.copy_to(&head, sizeof(head));
+  msg.pop_front(sizeof(head));
+  if (svc == nullptr) {
+    ptr->SetFailed(EBADMSG, "no esp handler on this server");
+    return;
+  }
+  IOBuf response_body;
+  svc->ProcessEspRequest(head, msg, &response_body);
+  EspHead rhead = head;
+  rhead.from = head.to;  // addressed reply
+  rhead.to = head.from;
+  IOBuf out;
+  AppendEsp(&out, rhead, response_body);
+  ptr->Write(&out);
+}
+
+// ---------------------------------------------------------------------------
+// Shared pipelined sync client core (wire-order FIFO matching, the redis
+// client's pattern).
+// ---------------------------------------------------------------------------
+
+struct FramedClientCore {
+  SocketId sock = INVALID_SOCKET_ID;
+  IOPortal inbuf;
+  std::mutex mu;
+  struct Waiter {
+    IOBuf* body = nullptr;
+    void* rhead = nullptr;  // optional out-head (protocol-sized)
+    CountdownEvent ev{1};
+    int rc = 0;
+  };
+  std::deque<Waiter*> waiters;
+  int64_t timeout_us = 1000000;
+  // Cuts one response frame: fills *head_bytes (head_size) + *body.
+  // Returns 0, EAGAIN (need more), or an errno (desync).
+  int (*cut)(IOPortal* in, void* head_bytes, IOBuf* body) = nullptr;
+  size_t head_size = 0;
+
+  static void* OnData(Socket* s);
+  void Fail(int err);
+  int Call(const void* head_bytes, size_t head_sz_unused, IOBuf&& frame,
+           IOBuf* response_body, void* rhead);
+};
+
+void* FramedClientCore::OnData(Socket* s) {
+  auto* c = static_cast<FramedClientCore*>(s->user());
+  for (;;) {
+    ssize_t nr = c->inbuf.append_from_fd(s->fd());
+    if (nr == 0) {
+      s->SetFailed(ECONNRESET, "legacy server closed");
+      c->Fail(ECONNRESET);
+      return nullptr;
+    }
+    if (nr < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      s->SetFailed(errno, "legacy read failed");
+      c->Fail(errno);
+      return nullptr;
+    }
+  }
+  for (;;) {
+    int rc;
+    {
+      std::lock_guard<std::mutex> g(c->mu);
+      if (c->waiters.empty()) break;
+      char head[64];
+      IOBuf body;
+      rc = c->cut(&c->inbuf, head, &body);
+      if (rc == EAGAIN) break;
+      Waiter* w = c->waiters.front();
+      c->waiters.pop_front();
+      if (rc == 0) {
+        if (w->rhead != nullptr) memcpy(w->rhead, head, c->head_size);
+        *w->body = std::move(body);
+      } else {
+        w->rc = rc;
+      }
+      w->ev.signal();
+    }
+    if (rc != 0) {
+      s->SetFailed(rc, "legacy reply desynchronized");
+      c->Fail(rc);
+      return nullptr;
+    }
+  }
+  return nullptr;
+}
+
+void FramedClientCore::Fail(int err) {
+  std::lock_guard<std::mutex> g(mu);
+  while (!waiters.empty()) {
+    Waiter* w = waiters.front();
+    waiters.pop_front();
+    w->rc = err;
+    w->ev.signal();
+  }
+}
+
+int FramedClientCore::Call(const void*, size_t, IOBuf&& frame,
+                           IOBuf* response_body, void* rhead) {
+  SocketUniquePtr p;
+  if (Socket::Address(sock, &p) != 0 || p->Failed()) return ECONNRESET;
+  Waiter waiter;
+  waiter.body = response_body;
+  waiter.rhead = rhead;
+  {
+    // Enqueue order must equal wire order (see RedisClient).
+    std::lock_guard<std::mutex> g(mu);
+    waiters.push_back(&waiter);
+    p->Write(&frame);
+  }
+  if (waiter.ev.wait(timeout_us) != 0) {
+    p->SetFailed(ETIMEDOUT, "legacy reply timeout");
+    Fail(ETIMEDOUT);
+    waiter.ev.wait(-1);
+    return ETIMEDOUT;
+  }
+  return waiter.rc;
+}
+
+int ConnectCore(FramedClientCore* c, const EndPoint& server,
+                int64_t timeout_ms) {
+  fiber_init(0);
+  c->timeout_us = timeout_ms * 1000;
+  Socket::Options opts;
+  opts.user = c;
+  opts.on_edge_triggered = FramedClientCore::OnData;
+  return Socket::Connect(server, opts, &c->sock, c->timeout_us);
+}
+
+void CloseCore(FramedClientCore* c) {
+  if (c->sock == INVALID_SOCKET_ID) return;
+  SocketUniquePtr p;
+  if (Socket::Address(c->sock, &p) == 0) {
+    p->SetFailed(ECANCELED, "client closed");
+  }
+}
+
+int CutNshead(IOPortal* in, void* head_bytes, IOBuf* body) {
+  if (in->size() < sizeof(NsheadHead)) return EAGAIN;
+  NsheadHead head;
+  in->copy_to(&head, sizeof(head));
+  if (head.magic_num != 0xfb709394 || head.body_len > kMaxLegacyBody) {
+    return EBADMSG;
+  }
+  if (in->size() < sizeof(head) + head.body_len) return EAGAIN;
+  in->pop_front(sizeof(head));
+  in->cutn(body, head.body_len);
+  memcpy(head_bytes, &head, sizeof(head));
+  return 0;
+}
+
+int CutEsp(IOPortal* in, void* head_bytes, IOBuf* body) {
+  if (in->size() < sizeof(EspHead)) return EAGAIN;
+  EspHead head;
+  in->copy_to(&head, sizeof(head));
+  if ((head.msg >> 24) != 0xE5 || head.body_len < 0 ||
+      uint32_t(head.body_len) > kMaxLegacyBody) {
+    return EBADMSG;
+  }
+  if (in->size() < sizeof(head) + size_t(head.body_len)) return EAGAIN;
+  in->pop_front(sizeof(head));
+  in->cutn(body, size_t(head.body_len));
+  memcpy(head_bytes, &head, sizeof(head));
+  return 0;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Registration
+// ---------------------------------------------------------------------------
+
+void ServeNsheadOn(Server* server, NsheadService* service) {
+  {
+    std::lock_guard<std::mutex> g(g_reg_mu);
+    nshead_map()[server] = service;
+  }
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.name = "nshead";
+    p.parse = NsheadParse;
+    p.process = NsheadProcess;
+    p.scan_priority = 10;  // magic at offset 24: scan after zero-offset magics
+    RegisterProtocol(p);
+  });
+}
+
+void ServeEspOn(Server* server, EspService* service) {
+  {
+    std::lock_guard<std::mutex> g(g_reg_mu);
+    esp_map()[server] = service;
+  }
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.name = "esp";
+    p.parse = EspParse;
+    p.process = EspProcess;
+    p.scan_priority = 20;  // weakest discriminator: scan last
+    RegisterProtocol(p);
+  });
+}
+
+// ---------------------------------------------------------------------------
+// Clients
+// ---------------------------------------------------------------------------
+
+struct NsheadClient::Impl {
+  FramedClientCore core;
+};
+
+NsheadClient::NsheadClient() : impl_(new Impl) {
+  impl_->core.cut = CutNshead;
+  impl_->core.head_size = sizeof(NsheadHead);
+}
+NsheadClient::~NsheadClient() { CloseCore(&impl_->core); }
+
+int NsheadClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  return ConnectCore(&impl_->core, server, timeout_ms);
+}
+
+int NsheadClient::Call(const NsheadHead& head, const IOBuf& body,
+                       IOBuf* response_body, NsheadHead* rhead) {
+  IOBuf frame;
+  AppendNshead(&frame, head, body);
+  return impl_->core.Call(nullptr, 0, std::move(frame), response_body,
+                          rhead);
+}
+
+struct EspClient::Impl {
+  FramedClientCore core;
+};
+
+EspClient::EspClient() : impl_(new Impl) {
+  impl_->core.cut = CutEsp;
+  impl_->core.head_size = sizeof(EspHead);
+}
+EspClient::~EspClient() { CloseCore(&impl_->core); }
+
+int EspClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  return ConnectCore(&impl_->core, server, timeout_ms);
+}
+
+int EspClient::Call(const EspHead& head, const IOBuf& body,
+                    IOBuf* response_body, EspHead* rhead) {
+  IOBuf frame;
+  AppendEsp(&frame, head, body);
+  return impl_->core.Call(nullptr, 0, std::move(frame), response_body,
+                          rhead);
+}
+
+}  // namespace brt

@@ -1,0 +1,96 @@
+// Legacy framed-protocol family on the shared RPC port: nshead and esp.
+// Parity target: reference src/brpc/policy/nshead_protocol.cpp +
+// nshead_service.h (36-byte fixed header, body opaque to the framework;
+// ALL nshead traffic on a server routes to one registered handler) and
+// policy/esp_protocol.cpp + esp_message.h (32-byte head, addressed
+// messages). Redesigned onto this framework's protocol registry: the
+// adaptors parse/frame on the shared port next to brt_std/HTTP/redis, the
+// handlers see head + raw body, and responses mirror the request head —
+// the contract legacy Baidu clients expect.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+
+namespace brt {
+
+class Server;
+
+#pragma pack(push, 1)
+struct NsheadHead {
+  uint16_t id = 0;
+  uint16_t version = 0;
+  uint32_t log_id = 0;
+  char provider[16] = {0};
+  uint32_t magic_num = 0xfb709394;
+  uint32_t reserved = 0;
+  uint32_t body_len = 0;
+};
+static_assert(sizeof(NsheadHead) == 36, "nshead is 36 bytes on the wire");
+
+struct EspHead {
+  uint64_t from = 0;
+  uint64_t to = 0;
+  uint32_t msg = 0;
+  uint64_t msg_id = 0;
+  int32_t body_len = 0;
+};
+static_assert(sizeof(EspHead) == 32, "esp head is 32 bytes on the wire");
+#pragma pack(pop)
+
+// One handler per server (reference NsheadService). The response head
+// mirrors id/version/log_id/provider; body_len is filled by the adaptor.
+class NsheadService {
+ public:
+  virtual ~NsheadService() = default;
+  virtual void ProcessNsheadRequest(const NsheadHead& head,
+                                    const IOBuf& body,
+                                    IOBuf* response_body) = 0;
+};
+void ServeNsheadOn(Server* server, NsheadService* service);
+
+class EspService {
+ public:
+  virtual ~EspService() = default;
+  // Response head mirrors msg/msg_id with from/to swapped.
+  virtual void ProcessEspRequest(const EspHead& head, const IOBuf& body,
+                                 IOBuf* response_body) = 0;
+};
+void ServeEspOn(Server* server, EspService* service);
+
+// Sync pipelined clients (responses match requests in wire order — these
+// protocols carry no correlation id beyond esp's msg_id, which legacy
+// servers echo but do not reorder on).
+class NsheadClient {
+ public:
+  NsheadClient();
+  ~NsheadClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  // Sends head(+body); *response_body receives the reply body, *rhead
+  // (optional) the reply head. Returns 0 or errno-style.
+  int Call(const NsheadHead& head, const IOBuf& body, IOBuf* response_body,
+           NsheadHead* rhead = nullptr);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+class EspClient {
+ public:
+  EspClient();
+  ~EspClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  int Call(const EspHead& head, const IOBuf& body, IOBuf* response_body,
+           EspHead* rhead = nullptr);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace brt

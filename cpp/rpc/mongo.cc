@@ -1,0 +1,391 @@
+#include "rpc/mongo.h"
+
+#include <atomic>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/bson.h"
+#include "rpc/server.h"
+#include "transport/input_messenger.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+namespace {
+
+constexpr int32_t kOpMsg = 2013;
+constexpr uint32_t kMaxMongoMessage = 48u << 20;  // mongo's own 48MB cap
+constexpr uint32_t kFlagChecksumPresent = 1u << 0;
+constexpr uint32_t kFlagMoreToCome = 1u << 1;
+
+#pragma pack(push, 1)
+struct MsgHeader {
+  int32_t message_length = 0;
+  int32_t request_id = 0;
+  int32_t response_to = 0;
+  int32_t op_code = kOpMsg;
+};
+#pragma pack(pop)
+
+// Frames one OP_MSG: header + flagBits + kind-0 section (BSON doc).
+// False (nothing appended) when the document cannot encode (embedded NUL,
+// oversized) — callers must fail locally, not emit a malformed frame.
+bool AppendOpMsg(IOBuf* out, int32_t request_id, int32_t response_to,
+                 const JsonValue& doc) {
+  IOBuf body;
+  if (!BsonEncode(doc, &body)) return false;
+  MsgHeader h;
+  h.message_length = int32_t(sizeof(MsgHeader) + 4 + 1 + body.size());
+  h.request_id = request_id;
+  h.response_to = response_to;
+  out->append(&h, sizeof(h));
+  const uint32_t flags = 0;
+  out->append(&flags, 4);
+  const uint8_t kind = 0;
+  out->append(&kind, 1);
+  out->append(body);
+  return true;
+}
+
+// Decodes one complete OP_MSG frame: exactly one kind-0 body document,
+// plus any kind-1 document-sequence sections, which fold into the command
+// doc as an array member named by the sequence identifier — drivers send
+// insert/update payloads that way ("documents" rides a kind-1 section).
+// *flags_out receives the flagBits. Returns false on malformed sections.
+bool DecodeOpMsg(const IOBuf& frame, MsgHeader* h, JsonValue* doc,
+                 uint32_t* flags_out, std::string* err) {
+  const std::string bytes = frame.to_string();
+  if (bytes.size() < sizeof(MsgHeader) + 5) {
+    *err = "short OP_MSG";
+    return false;
+  }
+  memcpy(h, bytes.data(), sizeof(MsgHeader));
+  uint32_t flags;
+  memcpy(&flags, bytes.data() + sizeof(MsgHeader), 4);
+  *flags_out = flags;
+  size_t off = sizeof(MsgHeader) + 4;
+  size_t end = bytes.size();
+  if (flags & kFlagChecksumPresent) {
+    if (end - off < 4) {
+      *err = "truncated checksum";
+      return false;
+    }
+    end -= 4;  // CRC-32C trailer; tolerated, not verified (drivers allow)
+  }
+  *doc = JsonValue::Object();
+  bool have_body = false;
+  while (off < end) {
+    const uint8_t kind = uint8_t(bytes[off]);
+    ++off;
+    if (kind == 0) {
+      if (have_body) {
+        *err = "multiple kind-0 sections";
+        return false;
+      }
+      JsonValue body_doc;
+      const ssize_t consumed =
+          BsonDecode(bytes.data() + off, end - off, &body_doc, err);
+      if (consumed < 0) return false;
+      // Kind-1 members parsed before the body fold into it.
+      for (auto& [k, v] : doc->members) {
+        body_doc.members.emplace_back(k, std::move(v));
+      }
+      *doc = std::move(body_doc);
+      have_body = true;
+      off += size_t(consumed);
+      continue;
+    }
+    if (kind == 1) {
+      if (end - off < 4) {
+        *err = "truncated kind-1 section";
+        return false;
+      }
+      int32_t sec_len;
+      memcpy(&sec_len, bytes.data() + off, 4);
+      if (sec_len < 5 || size_t(sec_len) > end - off) {
+        *err = "bad kind-1 section length";
+        return false;
+      }
+      const size_t sec_end = off + size_t(sec_len);
+      size_t p = off + 4;
+      const char* z = static_cast<const char*>(
+          memchr(bytes.data() + p, 0, sec_end - p));
+      if (z == nullptr) {
+        *err = "unterminated kind-1 identifier";
+        return false;
+      }
+      std::string ident(bytes.data() + p, z);
+      p = size_t(z - bytes.data()) + 1;
+      JsonValue seq = JsonValue::Array();
+      while (p < sec_end) {
+        JsonValue d;
+        const ssize_t consumed =
+            BsonDecode(bytes.data() + p, sec_end - p, &d, err);
+        if (consumed < 0) return false;
+        seq.elems.push_back(std::move(d));
+        p += size_t(consumed);
+      }
+      doc->members.emplace_back(std::move(ident), std::move(seq));
+      off = sec_end;
+      continue;
+    }
+    *err = "unsupported OP_MSG section kind";
+    return false;
+  }
+  if (!have_body) {
+    *err = "no kind-0 section";
+    return false;
+  }
+  return true;
+}
+
+ParseResult MongoParse(IOBuf* source, IOBuf* msg, Socket*) {
+  if (source->size() < sizeof(MsgHeader)) return ParseResult::NOT_ENOUGH_DATA;
+  MsgHeader h;
+  source->copy_to(&h, sizeof(h));
+  if (h.op_code != kOpMsg) return ParseResult::TRY_OTHER;
+  if (h.message_length < int32_t(sizeof(MsgHeader) + 5) ||
+      uint32_t(h.message_length) > kMaxMongoMessage) {
+    return ParseResult::TRY_OTHER;  // not a plausible mongo frame
+  }
+  if (source->size() < size_t(h.message_length)) {
+    return ParseResult::NOT_ENOUGH_DATA;
+  }
+  source->cutn(msg, size_t(h.message_length));
+  return ParseResult::OK;
+}
+
+std::mutex g_mongo_mu;
+std::map<Server*, MongoService*>& mongo_map() {
+  static auto* m = new std::map<Server*, MongoService*>();
+  return *m;
+}
+
+std::atomic<int32_t> g_server_request_id{1};
+
+void MongoProcess(IOBuf&& msg, SocketId sid) {
+  SocketUniquePtr ptr;
+  if (Socket::Address(sid, &ptr) != 0) return;
+  auto* server = static_cast<Server*>(ptr->user());
+  MongoService* svc = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_mongo_mu);
+    auto it = mongo_map().find(server);
+    if (it != mongo_map().end()) svc = it->second;
+  }
+  MsgHeader h;
+  JsonValue cmd;
+  uint32_t flags = 0;
+  std::string err;
+  if (svc == nullptr || !DecodeOpMsg(msg, &h, &cmd, &flags, &err)) {
+    ptr->SetFailed(EBADMSG, "bad mongo message: %s",
+                   svc == nullptr ? "no handler" : err.c_str());
+    return;
+  }
+  JsonValue reply = svc->RunCommand(cmd);
+  // moreToCome = fire-and-forget (unacknowledged writes): the driver
+  // registered no pending operation and treats any reply as protocol
+  // breakage.
+  if (flags & kFlagMoreToCome) return;
+  IOBuf out;
+  if (!AppendOpMsg(&out, g_server_request_id.fetch_add(1), h.request_id,
+                   reply)) {
+    JsonValue e = JsonValue::Object();
+    e.members.emplace_back("ok", JsonValue::Double(0));
+    e.members.emplace_back(
+        "errmsg", JsonValue::String("reply document not BSON-encodable"));
+    AppendOpMsg(&out, g_server_request_id.fetch_add(1), h.request_id, e);
+  }
+  ptr->Write(&out);
+}
+
+}  // namespace
+
+JsonValue MongoService::RunCommand(const JsonValue& cmd) {
+  JsonValue reply = JsonValue::Object();
+  const std::string first =
+      cmd.members.empty() ? std::string() : cmd.members[0].first;
+  if (first == "ping") {
+    reply.members.emplace_back("ok", JsonValue::Double(1));
+    return reply;
+  }
+  if (first == "hello" || first == "isMaster" || first == "ismaster") {
+    reply.members.emplace_back("isWritablePrimary", JsonValue::Bool(true));
+    reply.members.emplace_back("maxBsonObjectSize",
+                               JsonValue::Int(16 * 1024 * 1024));
+    reply.members.emplace_back("maxWireVersion", JsonValue::Int(17));
+    reply.members.emplace_back("minWireVersion", JsonValue::Int(0));
+    reply.members.emplace_back("ok", JsonValue::Double(1));
+    return reply;
+  }
+  if (first == "buildInfo" || first == "buildinfo") {
+    reply.members.emplace_back("version", JsonValue::String("7.0.0-brt"));
+    reply.members.emplace_back("ok", JsonValue::Double(1));
+    return reply;
+  }
+  reply.members.emplace_back("ok", JsonValue::Double(0));
+  reply.members.emplace_back(
+      "errmsg", JsonValue::String("no such command: " + first));
+  reply.members.emplace_back("code", JsonValue::Int(59));
+  return reply;
+}
+
+void ServeMongoOn(Server* server, MongoService* service) {
+  {
+    std::lock_guard<std::mutex> g(g_mongo_mu);
+    mongo_map()[server] = service;
+  }
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.name = "mongo";
+    p.parse = MongoParse;
+    p.process = MongoProcess;
+    p.scan_priority = 10;  // opcode at offset 12: scan after zero-offset magics
+    RegisterProtocol(p);
+  });
+}
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+
+struct MongoClient::Impl {
+  SocketId sock = INVALID_SOCKET_ID;
+  IOPortal inbuf;
+  std::mutex mu;
+  struct Waiter {
+    int32_t request_id = 0;
+    JsonValue* reply = nullptr;
+    CountdownEvent ev{1};
+    int rc = 0;
+  };
+  std::deque<Waiter*> waiters;  // matched by response_to
+  int64_t timeout_us = 1000000;
+  std::atomic<int32_t> next_id{1};
+
+  static void* OnData(Socket* s);
+  void Fail(int err);
+};
+
+void* MongoClient::Impl::OnData(Socket* s) {
+  auto* impl = static_cast<MongoClient::Impl*>(s->user());
+  for (;;) {
+    ssize_t nr = impl->inbuf.append_from_fd(s->fd());
+    if (nr == 0) {
+      s->SetFailed(ECONNRESET, "mongo server closed");
+      impl->Fail(ECONNRESET);
+      return nullptr;
+    }
+    if (nr < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      s->SetFailed(errno, "mongo read failed");
+      impl->Fail(errno);
+      return nullptr;
+    }
+  }
+  for (;;) {
+    IOBuf frame;
+    {
+      std::lock_guard<std::mutex> g(impl->mu);
+      if (impl->inbuf.size() < sizeof(MsgHeader)) break;
+      MsgHeader h;
+      impl->inbuf.copy_to(&h, sizeof(h));
+      if (h.op_code != kOpMsg ||
+          h.message_length < int32_t(sizeof(MsgHeader) + 5) ||
+          uint32_t(h.message_length) > kMaxMongoMessage) {
+        s->SetFailed(EBADMSG, "mongo reply desynchronized");
+        impl->Fail(EBADMSG);
+        return nullptr;
+      }
+      if (impl->inbuf.size() < size_t(h.message_length)) break;
+      impl->inbuf.cutn(&frame, size_t(h.message_length));
+      MsgHeader fh;
+      JsonValue doc;
+      uint32_t rflags = 0;
+      std::string err;
+      const bool ok = DecodeOpMsg(frame, &fh, &doc, &rflags, &err);
+      Waiter* hit = nullptr;
+      for (auto it = impl->waiters.begin(); it != impl->waiters.end();
+           ++it) {
+        if ((*it)->request_id == fh.response_to) {
+          hit = *it;
+          impl->waiters.erase(it);
+          break;
+        }
+      }
+      if (hit != nullptr) {
+        if (ok) {
+          *hit->reply = std::move(doc);
+        } else {
+          hit->rc = EBADMSG;
+        }
+        hit->ev.signal();
+      }
+      // Unmatched replies (e.g. moreToCome exhaust) are dropped.
+      continue;
+    }
+  }
+  return nullptr;
+}
+
+void MongoClient::Impl::Fail(int err) {
+  std::lock_guard<std::mutex> g(mu);
+  while (!waiters.empty()) {
+    Waiter* w = waiters.front();
+    waiters.pop_front();
+    w->rc = err;
+    w->ev.signal();
+  }
+}
+
+MongoClient::MongoClient() : impl_(new Impl) {}
+
+MongoClient::~MongoClient() {
+  if (impl_->sock == INVALID_SOCKET_ID) return;
+  SocketUniquePtr p;
+  if (Socket::Address(impl_->sock, &p) == 0) {
+    p->SetFailed(ECANCELED, "client closed");
+  }
+}
+
+int MongoClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  fiber_init(0);
+  impl_->timeout_us = timeout_ms * 1000;
+  Socket::Options opts;
+  opts.user = impl_.get();
+  opts.on_edge_triggered = Impl::OnData;
+  return Socket::Connect(server, opts, &impl_->sock, impl_->timeout_us);
+}
+
+int MongoClient::RunCommand(const JsonValue& cmd, JsonValue* reply) {
+  SocketUniquePtr p;
+  if (Socket::Address(impl_->sock, &p) != 0 || p->Failed()) {
+    return ECONNRESET;
+  }
+  Impl::Waiter waiter;
+  waiter.request_id = impl_->next_id.fetch_add(1);
+  waiter.reply = reply;
+  IOBuf frame;
+  if (!AppendOpMsg(&frame, waiter.request_id, 0, cmd)) return EINVAL;
+  {
+    std::lock_guard<std::mutex> g(impl_->mu);
+    impl_->waiters.push_back(&waiter);
+    p->Write(&frame);
+  }
+  if (waiter.ev.wait(impl_->timeout_us) != 0) {
+    p->SetFailed(ETIMEDOUT, "mongo reply timeout");
+    impl_->Fail(ETIMEDOUT);
+    waiter.ev.wait(-1);
+    return ETIMEDOUT;
+  }
+  return waiter.rc;
+}
+
+}  // namespace brt

@@ -1,0 +1,48 @@
+// Mongo wire protocol (OP_MSG) on the shared RPC port + a sync client.
+// Parity target: reference src/brpc/policy/mongo_protocol.cpp +
+// mongo_head.h + mongo_service_adaptor.h (server-side mongo endpoint).
+// Redesigned: OP_MSG (opcode 2013, the only opcode modern drivers use)
+// carrying one kind-0 BSON section; documents surface as the JsonValue
+// DOM via the in-tree BSON codec (rpc/bson.h) — a MongoService handles
+// command documents and returns reply documents, with ping/hello/
+// buildInfo answered by the default implementation so stock drivers can
+// handshake.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+
+#include "base/endpoint.h"
+#include "rpc/json.h"
+
+namespace brt {
+
+class Server;
+
+class MongoService {
+ public:
+  virtual ~MongoService() = default;
+  // One command document in, one reply document out. The default answers
+  // ping/hello/isMaster/buildInfo and returns {ok:0, errmsg:...} for
+  // everything else.
+  virtual JsonValue RunCommand(const JsonValue& cmd);
+};
+
+// Routes OP_MSG traffic arriving on `server`'s port to `service`
+// (one handler per server, like ServeRedisOn/ServeNsheadOn).
+void ServeMongoOn(Server* server, MongoService* service);
+
+class MongoClient {
+ public:
+  MongoClient();
+  ~MongoClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  // Sync command round trip. Returns 0 or errno-style.
+  int RunCommand(const JsonValue& cmd, JsonValue* reply);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace brt

@@ -1,5 +1,7 @@
 #include "transport/input_messenger.h"
 
+#include <atomic>
+
 #include <vector>
 
 #include "base/logging.h"
@@ -14,10 +16,36 @@ Protocol g_protocols[kMaxProtocols];
 int g_nprotocols = 0;
 }  // namespace
 
+// Scan order published as an immutable snapshot: RegisterProtocol may run
+// while OTHER servers' IO fibers are mid-scan (the lazy call_once
+// registrations in ServeMongoOn etc.), so the order array is rebuilt into
+// a fresh buffer and swapped in with one release store — readers never
+// see a half-rebuilt array.
+struct ScanOrder {
+  int n = 0;
+  int order[kMaxProtocols];
+};
+std::atomic<const ScanOrder*> g_scan_order{nullptr};
+
 int RegisterProtocol(const Protocol& p) {
   BRT_CHECK_LT(g_nprotocols, kMaxProtocols);
   g_protocols[g_nprotocols] = p;
-  return g_nprotocols++;
+  // Clamp: the rebuild below buckets by priority value.
+  if (g_protocols[g_nprotocols].scan_priority < 0) {
+    g_protocols[g_nprotocols].scan_priority = 0;
+  }
+  if (g_protocols[g_nprotocols].scan_priority > 100) {
+    g_protocols[g_nprotocols].scan_priority = 100;
+  }
+  const int index = g_nprotocols++;
+  auto* next = new ScanOrder();  // leaked: readers may hold old snapshots
+  for (int pri = 0; pri <= 100; ++pri) {
+    for (int i = 0; i < g_nprotocols; ++i) {
+      if (g_protocols[i].scan_priority == pri) next->order[next->n++] = i;
+    }
+  }
+  g_scan_order.store(next, std::memory_order_release);
+  return index;
 }
 
 const Protocol* GetProtocol(int index) {
@@ -68,7 +96,9 @@ int cut_message(Socket* s, IOBuf* source, IOBuf* msg) {
     if (r == ParseResult::ERROR) return -2;
     // TRY_OTHER: fall through to the full scan.
   }
-  for (int i = 0; i < g_nprotocols; ++i) {
+  const ScanOrder* scan = g_scan_order.load(std::memory_order_acquire);
+  for (int k = 0; scan != nullptr && k < scan->n; ++k) {
+    const int i = scan->order[k];
     if (i == pref) continue;
     ParseResult r = g_protocols[i].parse(source, msg, s);
     if (r == ParseResult::OK) {

@@ -31,6 +31,14 @@ struct Protocol {
   // those through the socket-ordered path into the stream's
   // ExecutionQueue, stream.cpp:447; requests/responses stay parallel).
   bool (*is_ordered)(const IOBuf& msg) = nullptr;
+  // Unknown-protocol scan order (lower scans first). Protocols that
+  // discriminate on a magic at offset 0 (brt/h2/http) keep 0; ones whose
+  // magic sits deeper (nshead @24, mongo opcode @12) or that have no
+  // magic at all (esp) must scan AFTER them — their NOT_ENOUGH_DATA on a
+  // short prefix would otherwise hold a stream that belongs to a
+  // zero-offset protocol (reference orders its protocol array the same
+  // way, global.cpp registration order).
+  int scan_priority = 0;
 };
 
 // Registers at startup (not thread-safe vs traffic; mirror of the
