@@ -1,0 +1,443 @@
+// idlc: IDL-to-C++ code generator — the mcpack2pb/generator analog.
+// Parity target: reference src/mcpack2pb/generator.cpp (1427 LoC protoc
+// plugin binding the mcpack wire format to typed structs). Redesigned for
+// this framework's wire model: one small IDL describes field-id-tagged
+// structs; the generated header gives each struct
+//   - typed C++ members,
+//   - ToValue/FromValue against the ThriftValue DOM,
+//   - Serialize/Parse in TBinary (the native struct wire format),
+//   - Schema() producing the StructSchema that powers the restful
+//     HTTP+JSON bridge (Server::MapJsonMethod),
+// so ONE definition serves binary RPC, JSON access, and typed code.
+//
+// IDL grammar (line-oriented, '#' comments):
+//   struct Name {
+//     <field-id>: <type> <name>;
+//   }
+//   type := bool | i8 | i16 | i32 | i64 | double | string
+//         | StructName | list<type> | map<type>     (map keys are string)
+//
+// Usage: idlc input.bidl output.h
+#include <cctype>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Type {
+  enum Kind { kBool, kI8, kI16, kI32, kI64, kDouble, kString, kStruct,
+              kList, kMap };
+  Kind kind = kI32;
+  std::string struct_name;        // kStruct
+  std::shared_ptr<Type> elem;     // kList / kMap value
+};
+
+struct Field {
+  int id = 0;
+  Type type;
+  std::string name;
+};
+
+struct StructDef {
+  std::string name;
+  std::vector<Field> fields;
+};
+
+[[noreturn]] void Die(const std::string& msg, int line) {
+  fprintf(stderr, "idlc: %s (line %d)\n", msg.c_str(), line);
+  exit(1);
+}
+
+std::string Trim(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
+Type ParseType(const std::string& text, int line) {
+  const std::string t = Trim(text);
+  Type ty;
+  if (t == "bool") ty.kind = Type::kBool;
+  else if (t == "i8" || t == "byte") ty.kind = Type::kI8;
+  else if (t == "i16") ty.kind = Type::kI16;
+  else if (t == "i32") ty.kind = Type::kI32;
+  else if (t == "i64") ty.kind = Type::kI64;
+  else if (t == "double") ty.kind = Type::kDouble;
+  else if (t == "string") ty.kind = Type::kString;
+  else if (t.rfind("list<", 0) == 0 && t.back() == '>') {
+    ty.kind = Type::kList;
+    ty.elem = std::make_shared<Type>(
+        ParseType(t.substr(5, t.size() - 6), line));
+  } else if (t.rfind("map<", 0) == 0 && t.back() == '>') {
+    ty.kind = Type::kMap;
+    ty.elem = std::make_shared<Type>(
+        ParseType(t.substr(4, t.size() - 5), line));
+  } else if (!t.empty() && (isupper((unsigned char)t[0]) || t[0] == '_')) {
+    ty.kind = Type::kStruct;
+    ty.struct_name = t;
+  } else {
+    Die("unknown type '" + t + "'", line);
+  }
+  if (ty.kind == Type::kList || ty.kind == Type::kMap) {
+    if (ty.elem->kind == Type::kList || ty.elem->kind == Type::kMap) {
+      Die("nested containers are not supported (wrap in a struct)", line);
+    }
+  }
+  return ty;
+}
+
+// ---- generation helpers ----
+
+std::string CppType(const Type& t) {
+  switch (t.kind) {
+    case Type::kBool: return "bool";
+    case Type::kI8: return "int8_t";
+    case Type::kI16: return "int16_t";
+    case Type::kI32: return "int32_t";
+    case Type::kI64: return "int64_t";
+    case Type::kDouble: return "double";
+    case Type::kString: return "std::string";
+    case Type::kStruct: return t.struct_name;
+    case Type::kList: return "std::vector<" + CppType(*t.elem) + ">";
+    case Type::kMap:
+      return "std::map<std::string, " + CppType(*t.elem) + ">";
+  }
+  return "?";
+}
+
+std::string TType(const Type& t) {
+  switch (t.kind) {
+    case Type::kBool: return "::brt::TType::BOOL";
+    case Type::kI8: return "::brt::TType::BYTE";
+    case Type::kI16: return "::brt::TType::I16";
+    case Type::kI32: return "::brt::TType::I32";
+    case Type::kI64: return "::brt::TType::I64";
+    case Type::kDouble: return "::brt::TType::DOUBLE";
+    case Type::kString: return "::brt::TType::STRING";
+    case Type::kStruct: return "::brt::TType::STRUCT";
+    case Type::kList: return "::brt::TType::LIST";
+    case Type::kMap: return "::brt::TType::MAP";
+  }
+  return "?";
+}
+
+// Scalar value -> ThriftValue expression.
+std::string ScalarToValue(const Type& t, const std::string& expr) {
+  switch (t.kind) {
+    case Type::kBool: return "::brt::ThriftValue::Bool(" + expr + ")";
+    case Type::kI8: {
+      std::string v = "::brt::ThriftValue::I32(" + expr + ")";
+      return "[&]{ auto tv_ = " + v +
+             "; tv_.type = ::brt::TType::BYTE; return tv_; }()";
+    }
+    case Type::kI16: {
+      std::string v = "::brt::ThriftValue::I32(" + expr + ")";
+      return "[&]{ auto tv_ = " + v +
+             "; tv_.type = ::brt::TType::I16; return tv_; }()";
+    }
+    case Type::kI32: return "::brt::ThriftValue::I32(" + expr + ")";
+    case Type::kI64: return "::brt::ThriftValue::I64(" + expr + ")";
+    case Type::kDouble: return "::brt::ThriftValue::Double(" + expr + ")";
+    case Type::kString: return "::brt::ThriftValue::String(" + expr + ")";
+    case Type::kStruct: return expr + ".ToValue()";
+    default: return "?";
+  }
+}
+
+// ThriftValue -> scalar assignment with type check. `src` is a
+// `const ThriftValue&` expression, `dst` an lvalue.
+void EmitScalarFrom(std::ostringstream& os, const Type& t,
+                    const std::string& src, const std::string& dst,
+                    const std::string& indent) {
+  switch (t.kind) {
+    case Type::kBool:
+      os << indent << "if (" << src << ".type != ::brt::TType::BOOL) "
+         << "return false;\n"
+         << indent << dst << " = " << src << ".b;\n";
+      break;
+    case Type::kI8:
+    case Type::kI16:
+    case Type::kI32:
+    case Type::kI64: {
+      os << indent << "switch (" << src << ".type) {\n"
+         << indent << "  case ::brt::TType::BYTE:\n"
+         << indent << "  case ::brt::TType::I16:\n"
+         << indent << "  case ::brt::TType::I32:\n"
+         << indent << "  case ::brt::TType::I64: break;\n"
+         << indent << "  default: return false;\n"
+         << indent << "}\n";
+      // Range-check narrower targets: silent truncation would corrupt
+      // values from a peer whose schema widened the field (matches the
+      // JSON bridge's IntInRange policy).
+      const char* cpp = t.kind == Type::kI8 ? "int8_t"
+                        : t.kind == Type::kI16 ? "int16_t"
+                        : t.kind == Type::kI32 ? "int32_t"
+                                               : "int64_t";
+      if (t.kind != Type::kI64) {
+        os << indent << "if (" << src << ".i < INT64_C("
+           << (t.kind == Type::kI8 ? "-128"
+               : t.kind == Type::kI16 ? "-32768" : "-2147483648")
+           << ") || " << src << ".i > INT64_C("
+           << (t.kind == Type::kI8 ? "127"
+               : t.kind == Type::kI16 ? "32767" : "2147483647")
+           << ")) return false;\n";
+      }
+      os << indent << dst << " = " << cpp << "(" << src << ".i);\n";
+      break;
+    }
+    case Type::kDouble:
+      os << indent << "if (" << src << ".type != ::brt::TType::DOUBLE) "
+         << "return false;\n"
+         << indent << dst << " = " << src << ".d;\n";
+      break;
+    case Type::kString:
+      os << indent << "if (" << src << ".type != ::brt::TType::STRING) "
+         << "return false;\n"
+         << indent << dst << " = " << src << ".str;\n";
+      break;
+    case Type::kStruct:
+      os << indent << "if (!" << dst << ".FromValue(" << src
+         << ")) return false;\n";
+      break;
+    default:
+      break;
+  }
+}
+
+void EmitStruct(std::ostringstream& os, const StructDef& sd) {
+  os << "struct " << sd.name << " {\n";
+  for (const Field& f : sd.fields) {
+    os << "  " << CppType(f.type) << " " << f.name;
+    switch (f.type.kind) {
+      case Type::kBool: os << " = false"; break;
+      case Type::kI8:
+      case Type::kI16:
+      case Type::kI32:
+      case Type::kI64: os << " = 0"; break;
+      case Type::kDouble: os << " = 0.0"; break;
+      default: break;
+    }
+    os << ";\n";
+  }
+
+  // ---- ToValue ----
+  os << "\n  ::brt::ThriftValue ToValue() const {\n"
+     << "    ::brt::ThriftValue v_ = ::brt::ThriftValue::Struct();\n";
+  for (const Field& f : sd.fields) {
+    if (f.type.kind == Type::kList) {
+      os << "    {\n"
+         << "      ::brt::ThriftValue lv_ = ::brt::ThriftValue::List("
+         << TType(*f.type.elem) << ");\n"
+         << "      for (const auto& e_ : " << f.name << ") {\n"
+         << "        lv_.elems.push_back("
+         << ScalarToValue(*f.type.elem, "e_") << ");\n"
+         << "      }\n"
+         << "      v_.add_field(" << f.id << ", std::move(lv_));\n"
+         << "    }\n";
+    } else if (f.type.kind == Type::kMap) {
+      os << "    {\n"
+         << "      ::brt::ThriftValue mv_;\n"
+         << "      mv_.type = ::brt::TType::MAP;\n"
+         << "      mv_.key_type = ::brt::TType::STRING;\n"
+         << "      mv_.val_type = " << TType(*f.type.elem) << ";\n"
+         << "      for (const auto& [k_, e_] : " << f.name << ") {\n"
+         << "        mv_.kvs.emplace_back(::brt::ThriftValue::String(k_), "
+         << ScalarToValue(*f.type.elem, "e_") << ");\n"
+         << "      }\n"
+         << "      v_.add_field(" << f.id << ", std::move(mv_));\n"
+         << "    }\n";
+    } else {
+      os << "    v_.add_field(" << f.id << ", "
+         << ScalarToValue(f.type, f.name) << ");\n";
+    }
+  }
+  os << "    return v_;\n  }\n";
+
+  // ---- FromValue ----
+  os << "\n  bool FromValue(const ::brt::ThriftValue& v_) {\n"
+     << "    if (v_.type != ::brt::TType::STRUCT) return false;\n"
+     << "    *this = " << sd.name << "();\n";
+  for (const Field& f : sd.fields) {
+    os << "    if (const ::brt::ThriftValue* f_ = v_.field(" << f.id
+       << ")) {\n";
+    if (f.type.kind == Type::kList) {
+      os << "      if (f_->type != ::brt::TType::LIST && "
+         << "f_->type != ::brt::TType::SET) return false;\n"
+         << "      for (const auto& e_ : f_->elems) {\n"
+         << "        " << CppType(*f.type.elem) << " out_{};\n";
+      EmitScalarFrom(os, *f.type.elem, "e_", "out_", "        ");
+      os << "        " << f.name << ".push_back(std::move(out_));\n"
+         << "      }\n";
+    } else if (f.type.kind == Type::kMap) {
+      os << "      if (f_->type != ::brt::TType::MAP) return false;\n"
+         << "      for (const auto& [k_, e_] : f_->kvs) {\n"
+         << "        if (k_.type != ::brt::TType::STRING) return false;\n"
+         << "        " << CppType(*f.type.elem) << " out_{};\n";
+      EmitScalarFrom(os, *f.type.elem, "e_", "out_", "        ");
+      os << "        " << f.name << ".emplace(k_.str, std::move(out_));\n"
+         << "      }\n";
+    } else {
+      EmitScalarFrom(os, f.type, "(*f_)", f.name, "      ");
+    }
+    os << "    }\n";
+  }
+  os << "    return true;\n  }\n";
+
+  // ---- wire + schema ----
+  os << "\n  bool Serialize(::brt::IOBuf* out_) const {\n"
+     << "    return ::brt::ThriftSerializeStruct(ToValue(), out_);\n"
+     << "  }\n"
+     << "  bool Parse(const ::brt::IOBuf& in_) {\n"
+     << "    ::brt::ThriftValue v_;\n"
+     << "    if (::brt::ThriftParseStruct(in_, &v_) < 0) return false;\n"
+     << "    return FromValue(v_);\n"
+     << "  }\n";
+
+  os << "\n  // JSON bridge schema (Server::MapJsonMethod).\n"
+     << "  static std::shared_ptr<::brt::StructSchema> Schema() {\n"
+     << "    auto s_ = std::make_shared<::brt::StructSchema>();\n";
+  for (const Field& f : sd.fields) {
+    switch (f.type.kind) {
+      case Type::kStruct:
+        os << "    s_->AddStruct(\"" << f.name << "\", " << f.id << ", "
+           << f.type.struct_name << "::Schema());\n";
+        break;
+      case Type::kList:
+        if (f.type.elem->kind == Type::kStruct) {
+          os << "    s_->AddList(\"" << f.name << "\", " << f.id
+             << ", ::brt::TType::STRUCT, " << f.type.elem->struct_name
+             << "::Schema());\n";
+        } else {
+          os << "    s_->AddList(\"" << f.name << "\", " << f.id << ", "
+             << TType(*f.type.elem) << ");\n";
+        }
+        break;
+      case Type::kMap:
+        if (f.type.elem->kind == Type::kStruct) {
+          os << "    s_->AddMap(\"" << f.name << "\", " << f.id
+             << ", ::brt::TType::STRUCT, " << f.type.elem->struct_name
+             << "::Schema());\n";
+        } else {
+          os << "    s_->AddMap(\"" << f.name << "\", " << f.id << ", "
+             << TType(*f.type.elem) << ");\n";
+        }
+        break;
+      default:
+        os << "    s_->Add(\"" << f.name << "\", " << f.id << ", "
+           << TType(f.type) << ");\n";
+    }
+  }
+  os << "    return s_;\n  }\n";
+  os << "};\n\n";
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: idlc input.bidl output.h\n");
+    return 1;
+  }
+  std::ifstream in(argv[1]);
+  if (!in) {
+    fprintf(stderr, "idlc: cannot open %s\n", argv[1]);
+    return 1;
+  }
+
+  std::vector<StructDef> structs;
+  std::map<std::string, bool> known;
+  StructDef cur;
+  bool in_struct = false;
+  std::string raw;
+  int line = 0;
+  while (std::getline(in, raw)) {
+    ++line;
+    const size_t hash = raw.find('#');
+    std::string text = Trim(hash == std::string::npos ? raw
+                                                      : raw.substr(0, hash));
+    if (text.empty()) continue;
+    if (!in_struct) {
+      if (text.rfind("struct ", 0) != 0 || text.back() != '{') {
+        Die("expected 'struct Name {'", line);
+      }
+      cur = StructDef();
+      cur.name = Trim(text.substr(7, text.size() - 8));
+      if (cur.name.empty()) Die("missing struct name", line);
+      in_struct = true;
+      continue;
+    }
+    if (text == "}") {
+      for (const Field& f : cur.fields) {
+        // Struct references must be defined EARLIER (single pass, like
+        // the wire: no forward refs, no recursion).
+        const Type* t = &f.type;
+        if (t->kind == Type::kList || t->kind == Type::kMap) {
+          t = t->elem.get();
+        }
+        if (t->kind == Type::kStruct && !known.count(t->struct_name)) {
+          Die("struct '" + t->struct_name + "' used before definition",
+              line);
+        }
+      }
+      if (known.count(cur.name)) {
+        Die("duplicate struct '" + cur.name + "'", line);
+      }
+      structs.push_back(cur);
+      known[cur.name] = true;
+      in_struct = false;
+      continue;
+    }
+    // "<id>: <type> <name>;"
+    if (text.back() != ';') Die("field must end with ';'", line);
+    text.pop_back();
+    const size_t colon = text.find(':');
+    if (colon == std::string::npos) Die("field needs '<id>:'", line);
+    Field f;
+    {
+      const std::string id_text = Trim(text.substr(0, colon));
+      char* endp = nullptr;
+      const long v = strtol(id_text.c_str(), &endp, 10);
+      if (id_text.empty() || endp != id_text.c_str() + id_text.size()) {
+        Die("malformed field id '" + id_text + "'", line);
+      }
+      if (v <= 0 || v > 32767) Die("field id out of range", line);
+      f.id = int(v);
+    }
+    std::string rest = Trim(text.substr(colon + 1));
+    const size_t sp = rest.find_last_of(" \t");
+    if (sp == std::string::npos) Die("field needs '<type> <name>'", line);
+    f.name = Trim(rest.substr(sp + 1));
+    f.type = ParseType(rest.substr(0, sp), line);
+    for (const Field& prev : cur.fields) {
+      if (prev.id == f.id) Die("duplicate field id", line);
+      if (prev.name == f.name) Die("duplicate field name", line);
+    }
+    cur.fields.push_back(std::move(f));
+  }
+  if (in_struct) Die("unterminated struct", line);
+
+  std::ostringstream os;
+  os << "// Generated by idlc from " << argv[1] << " — DO NOT EDIT.\n"
+     << "#pragma once\n\n"
+     << "#include <cstdint>\n#include <map>\n#include <memory>\n"
+     << "#include <string>\n#include <vector>\n\n"
+     << "#include \"base/iobuf.h\"\n"
+     << "#include \"rpc/json.h\"\n"
+     << "#include \"rpc/thrift_binary.h\"\n\n";
+  for (const StructDef& sd : structs) EmitStruct(os, sd);
+
+  std::ofstream out(argv[2]);
+  if (!out) {
+    fprintf(stderr, "idlc: cannot write %s\n", argv[2]);
+    return 1;
+  }
+  out << os.str();
+  return 0;
+}
