@@ -1,0 +1,46 @@
+// Coroutine example: sequential awaited RPCs without callback nesting
+// (reference example/coroutine_echo_c++).
+#include <cstdio>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "rpc/coro.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+class EchoService : public Service {
+ public:
+  void CallMethod(const std::string&, Controller*, const IOBuf& req,
+                  IOBuf* response, Closure done) override {
+    response->append(req);
+    done();
+  }
+};
+
+CoTask Run(Channel* ch) {
+  for (int i = 0; i < 3; ++i) {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("co-" + std::to_string(i));
+    co_await AwaitRpc(ch, "Echo", "Echo", &cntl, std::move(req), &rsp);
+    printf("await #%d -> %s (%ldus)\n", i, rsp.to_string().c_str(),
+           long(cntl.latency_us()));
+    co_await CoSleep(10 * 1000);
+  }
+}
+
+int main() {
+  fiber_init(4);
+  Server server;
+  EchoService echo;
+  server.AddService(&echo, "Echo");
+  server.Start("127.0.0.1:0");
+  Channel ch;
+  ch.Init(server.listen_address());
+  CoTask t = Run(&ch);
+  t.join();
+  server.Stop();
+  server.Join();
+  return 0;
+}

@@ -1,0 +1,46 @@
+// Restful HTTP+JSON example: one struct service answers binary RPC AND
+// application/json (reference example/http_c++). Try:
+//   curl -d '{"vals":[1,2,3]}' -H 'Content-Type: application/json' \
+//        http://127.0.0.1:8010/Calc/Sum
+#include <cstdio>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "rpc/json.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+class SumService : public Service {
+ public:
+  void CallMethod(const std::string&, Controller* cntl, const IOBuf& req,
+                  IOBuf* response, Closure done) override {
+    ThriftValue r;
+    int64_t sum = 0;
+    if (ThriftParseStruct(req, &r) > 0 && r.field(1) != nullptr) {
+      for (const auto& e : r.field(1)->elems) sum += e.i;
+    } else {
+      cntl->SetFailed(EREQUEST, "bad request");
+    }
+    ThriftValue out = ThriftValue::Struct();
+    out.add_field(1, ThriftValue::I64(sum));
+    ThriftSerializeStruct(out, response);
+    done();
+  }
+};
+
+int main(int argc, char** argv) {
+  const int port = argc > 1 ? atoi(argv[1]) : 8010;
+  fiber_init(4);
+  Server server;
+  SumService sum;
+  server.AddService(&sum, "Calc");
+  StructSchema req_schema, resp_schema;
+  req_schema.AddList("vals", 1, TType::I64);
+  resp_schema.Add("sum", 1, TType::I64);
+  server.MapJsonMethod("Calc", "Sum", req_schema, resp_schema);
+  if (server.Start("0.0.0.0:" + std::to_string(port)) != 0) return 1;
+  printf("POST JSON to http://127.0.0.1:%d/Calc/Sum (ctrl-c to stop)\n",
+         port);
+  for (;;) fiber_usleep(1000 * 1000);
+}

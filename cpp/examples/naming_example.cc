@@ -1,0 +1,70 @@
+// Service discovery example: host the in-framework registry, register two
+// echo servers with TTL heartbeats, resolve them via remote:// long-poll
+// (reference consul/discovery naming examples).
+#include <cstdio>
+#include <string>
+
+#include "cluster/cluster_channel.h"
+#include "cluster/remote_naming.h"
+#include "fiber/fiber.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+class EchoService : public Service {
+ public:
+  void CallMethod(const std::string&, Controller*, const IOBuf& req,
+                  IOBuf* response, Closure done) override {
+    response->append(req);
+    done();
+  }
+};
+
+int main() {
+  fiber_init(4);
+  // Registry (any brt server can host it).
+  Server registry;
+  NamingRegistryService naming;
+  registry.AddService(&naming, "Naming");
+  NamingRegistryService::MapJsonMethods(&registry);  // curl-able too
+  registry.Start("127.0.0.1:0");
+  const std::string reg_addr = registry.listen_address().to_string();
+
+  // Two backends register themselves with TTL heartbeats.
+  Server b1, b2;
+  EchoService e1, e2;
+  b1.AddService(&e1, "Echo");
+  b2.AddService(&e2, "Echo");
+  b1.Start("127.0.0.1:0");
+  b2.Start("127.0.0.1:0");
+  NamingRegistrant r1, r2;
+  ServerNode n1, n2;
+  n1.ep = b1.listen_address();
+  n2.ep = b2.listen_address();
+  r1.Start(reg_addr, "echo", n1, 3000);
+  r2.Start(reg_addr, "echo", n2, 3000);
+
+  // Client resolves the cluster via the long-poll watcher.
+  ClusterChannel cc;
+  cc.Init("remote://" + reg_addr + "/echo", "rr");
+  for (int i = 0; i < 20 && cc.ListServers().size() < 2; ++i) {
+    fiber_usleep(50 * 1000);
+  }
+  printf("resolved %zu backends from the registry\n",
+         cc.ListServers().size());
+  for (int i = 0; i < 4; ++i) {
+    Controller cntl;
+    IOBuf req, rsp;
+    req.append("hi-" + std::to_string(i));
+    cc.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+    printf("call %d -> %s via %s\n", i, rsp.to_string().c_str(),
+           cntl.remote_side().to_string().c_str());
+  }
+  r1.Stop();
+  r2.Stop();
+  b1.Stop(); b1.Join();
+  b2.Stop(); b2.Join();
+  registry.Stop();
+  registry.Join();
+  return 0;
+}

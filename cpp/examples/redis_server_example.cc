@@ -1,0 +1,37 @@
+// Redis-protocol server example: redis-cli can GET/SET against a brt
+// server (reference example/redis_c++).
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "rpc/redis.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+int main(int argc, char** argv) {
+  const int port = argc > 1 ? atoi(argv[1]) : 6380;
+  fiber_init(4);
+  static std::mutex mu;
+  static std::map<std::string, std::string> kv;
+  RedisService redis;
+  redis.AddCommandHandler("SET", [](const std::vector<std::string>& a) {
+    if (a.size() != 3) return RedisReply::Error("wrong args");
+    std::lock_guard<std::mutex> g(mu);
+    kv[a[1]] = a[2];
+    return RedisReply::Status("OK");
+  });
+  redis.AddCommandHandler("GET", [](const std::vector<std::string>& a) {
+    if (a.size() != 2) return RedisReply::Error("wrong args");
+    std::lock_guard<std::mutex> g(mu);
+    auto it = kv.find(a[1]);
+    return it == kv.end() ? RedisReply::Nil() : RedisReply::Bulk(it->second);
+  });
+  Server server;
+  ServeRedisOn(&server, &redis);
+  if (server.Start("0.0.0.0:" + std::to_string(port)) != 0) return 1;
+  printf("redis-cli -p %d (ctrl-c to stop)\n", port);
+  for (;;) fiber_usleep(1000 * 1000);
+}

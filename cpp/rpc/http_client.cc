@@ -1,0 +1,166 @@
+#include "rpc/http_client.h"
+
+#include <atomic>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+namespace {
+
+// Installed as the socket's initial parsing_context (present from the
+// first read event; freed when the socket fully recycles, so a late read
+// event can never touch freed state). The caller keeps the socket
+// referenced (SocketUniquePtr) while it reads results.
+//
+// Completion protocol: exactly one finisher wins Claim(); ONLY the winner
+// may touch `out`/`rc`, and done.signal() is its last ctx access. The
+// loser (a racing EOF/timeout/late parse) must not write anything — the
+// caller may already be reading the result.
+struct FetchCtx {
+  HttpParser parser{/*is_request=*/false};
+  CountdownEvent done{1};
+  std::atomic<bool> claimed{false};
+  int rc = EIO;
+  HttpClientResult* out = nullptr;
+
+  bool Claim() { return !claimed.exchange(true, std::memory_order_acq_rel); }
+};
+
+void DestroyFetchCtx(void* p) { delete static_cast<FetchCtx*>(p); }
+
+void FinishParse(Socket* s, FetchCtx* ctx, HttpParser::Result pr) {
+  switch (pr) {
+    case HttpParser::DONE: {
+      if (!ctx->Claim()) return;
+      HttpMessage m = ctx->parser.steal();
+      ctx->out->status = m.status;
+      ctx->out->body = m.body.to_string();
+      ctx->out->head = std::move(m);
+      ctx->rc = 0;
+      ctx->done.signal();
+      return;
+    }
+    case HttpParser::ERROR:
+      if (ctx->Claim()) {
+        ctx->rc = EBADMSG;
+        ctx->done.signal();
+      }
+      s->SetFailed(EBADMSG, "bad http response");
+      return;
+    case HttpParser::NEED_MORE:
+      return;
+  }
+}
+
+void* FetchOnData(Socket* s) {
+  auto* ctx = static_cast<FetchCtx*>(s->parsing_context());
+  IOPortal& in = s->read_buf;
+  bool eof = false;
+  for (;;) {
+    ssize_t nr = in.append_from_fd(s->fd());
+    if (nr == 0) {
+      eof = true;
+      break;
+    }
+    if (nr < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      eof = true;
+      break;
+    }
+  }
+  if (!ctx->claimed.load(std::memory_order_acquire)) {
+    FinishParse(s, ctx, ctx->parser.Consume(&in));
+    if (eof && !ctx->claimed.load(std::memory_order_acquire)) {
+      // A close-delimited body (no Content-Length) completes on EOF.
+      FinishParse(s, ctx, ctx->parser.OnEof());
+    }
+  }
+  if (eof) {
+    // If the response never completed, on_failed (below) finishes the
+    // parked caller with the error.
+    s->SetFailed(ECONNRESET, "server closed before full response");
+  }
+  return nullptr;
+}
+
+void FetchOnFailed(Socket* s) {
+  auto* ctx = static_cast<FetchCtx*>(s->parsing_context());
+  if (ctx != nullptr && ctx->Claim()) {
+    ctx->rc = s->error_code();
+    ctx->done.signal();
+  }
+}
+
+}  // namespace
+
+int HttpFetch(const EndPoint& server, const std::string& method,
+              const std::string& path, const std::string& body,
+              const std::string& content_type, HttpClientResult* out,
+              int64_t timeout_ms) {
+  fiber_init(0);
+  auto* ctx = new FetchCtx;
+  ctx->out = out;
+  Socket::Options opts;
+  opts.on_edge_triggered = FetchOnData;
+  opts.on_failed = FetchOnFailed;
+  // Present before the fd is armed: an instant RST cannot find a null
+  // ctx (and there is no post-create install racing the read fiber).
+  opts.initial_parsing_context = ctx;
+  opts.parsing_context_destroyer = DestroyFetchCtx;
+  SocketId sid = INVALID_SOCKET_ID;
+  const int64_t timeout_us = timeout_ms * 1000;
+  int rc = Socket::Connect(server, opts, &sid, timeout_us);
+  if (rc != 0) {
+    // Create attaches ctx to the socket (freed at recycle); only a
+    // pre-Create failure leaves it ours to free.
+    if (sid == INVALID_SOCKET_ID) delete ctx;
+    return rc;
+  }
+  SocketUniquePtr p;
+  if (Socket::Address(sid, &p) != 0) return ECONNRESET;
+
+  HttpMessage req;
+  req.method = method;
+  req.path = path;
+  req.set_header("Host", server.to_string());
+  req.set_header("Connection", "close");
+  if (!body.empty() || method == "POST" || method == "PUT") {
+    req.set_header("Content-Length", std::to_string(body.size()));
+    if (!content_type.empty()) {
+      req.set_header("Content-Type", content_type);
+    }
+  }
+  IOBuf wire;
+  SerializeHttpHead(req, /*is_request=*/true, &wire);
+  wire.append(body);
+  if (p->Write(&wire) != 0 || p->Failed()) {
+    // Either the socket failed before the send, or it failed right after
+    // a (fast) complete response — Connection: close makes the server
+    // hang up the moment it answers. ctx->rc distinguishes: the claimed
+    // finisher set 0 on a completed response, the error otherwise.
+    ctx->done.wait(-1);
+    return ctx->rc;
+  }
+
+  if (ctx->done.wait(timeout_us) != 0) {
+    // Timeout: claim if we can; a finisher that already claimed is
+    // completing right now, so wait for its signal instead.
+    if (ctx->Claim()) {
+      ctx->rc = ETIMEDOUT;
+      p->SetFailed(ETIMEDOUT, "http response timeout");
+      return ETIMEDOUT;
+    }
+    ctx->done.wait(-1);
+  }
+  const int result = ctx->rc;
+  // Single-shot client: tear the connection down (the response either
+  // completed or the socket already failed).
+  p->SetFailed(ECANCELED, "fetch complete");
+  return result;
+}
+
+}  // namespace brt

@@ -1,0 +1,33 @@
+// Minimal HTTP/1.1 client over the native transport: one request/response
+// at a time per call, fiber-friendly (used by rpc_view and parallel_http;
+// reference keeps an HTTP client inside Channel's http protocol —
+// policy/http_rpc_protocol.cpp client half).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "base/endpoint.h"
+#include "rpc/http_message.h"
+
+namespace brt {
+
+struct HttpClientResult {
+  int status = 0;
+  std::string body;
+  HttpMessage head;  // headers etc.
+};
+
+// Blocking GET/POST to host:port (fiber parks, worker stays free).
+// `path` includes query. Returns 0 or errno-style.
+int HttpFetch(const EndPoint& server, const std::string& method,
+              const std::string& path, const std::string& body,
+              const std::string& content_type, HttpClientResult* out,
+              int64_t timeout_ms = 5000);
+
+inline int HttpGet(const EndPoint& server, const std::string& path,
+                   HttpClientResult* out, int64_t timeout_ms = 5000) {
+  return HttpFetch(server, "GET", path, "", "", out, timeout_ms);
+}
+
+}  // namespace brt

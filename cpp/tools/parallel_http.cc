@@ -1,0 +1,135 @@
+// parallel_http: mass concurrent HTTP fetcher on the fiber runtime.
+// Parity target: reference tools/parallel_http (fetch many URLs at once).
+// Reads "ip:port/path" lines from a file (or repeats one URL -n times),
+// fans out up to -c concurrent fiber fetches, reports per-URL status and
+// an aggregate throughput line.
+//   parallel_http -l urls.txt [-c 64]
+//   parallel_http -u 10.0.0.1:8000/health -n 1000 [-c 64]
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+#include "base/endpoint.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/http_client.h"
+
+using namespace brt;
+
+namespace {
+
+struct Job {
+  EndPoint server;
+  std::string path;
+  int status = 0;
+  int rc = -1;
+  size_t bytes = 0;
+};
+
+struct Shared {
+  std::vector<Job>* jobs;
+  std::atomic<size_t> next{0};
+  CountdownEvent done{1};
+  std::atomic<int> live{0};
+};
+
+void* Worker(void* arg) {
+  auto* sh = static_cast<Shared*>(arg);
+  for (;;) {
+    const size_t i = sh->next.fetch_add(1);
+    if (i >= sh->jobs->size()) break;
+    Job& j = (*sh->jobs)[i];
+    HttpClientResult res;
+    j.rc = HttpGet(j.server, j.path, &res, 10 * 1000);
+    j.status = res.status;
+    j.bytes = res.body.size();
+  }
+  if (sh->live.fetch_sub(1) == 1) sh->done.signal();
+  return nullptr;
+}
+
+bool ParseUrl(const std::string& line, Job* j) {
+  const size_t slash = line.find('/');
+  const std::string addr =
+      slash == std::string::npos ? line : line.substr(0, slash);
+  j->path = slash == std::string::npos ? "/" : line.substr(slash);
+  return EndPoint::parse(addr, &j->server);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string list_file, url;
+  int repeat = 1, concurrency = 64;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    if (strcmp(argv[i], "-l") == 0) list_file = argv[i + 1];
+    else if (strcmp(argv[i], "-u") == 0) url = argv[i + 1];
+    else if (strcmp(argv[i], "-n") == 0) repeat = atoi(argv[i + 1]);
+    else if (strcmp(argv[i], "-c") == 0) concurrency = atoi(argv[i + 1]);
+  }
+  std::vector<Job> jobs;
+  if (!list_file.empty()) {
+    std::ifstream in(list_file);
+    std::string line;
+    while (std::getline(in, line)) {
+      if (line.empty() || line[0] == '#') continue;
+      Job j;
+      if (!ParseUrl(line, &j)) {
+        fprintf(stderr, "skipping bad url: %s\n", line.c_str());
+        continue;
+      }
+      jobs.push_back(std::move(j));
+    }
+  } else if (!url.empty()) {
+    Job j;
+    if (!ParseUrl(url, &j)) {
+      fprintf(stderr, "bad url %s\n", url.c_str());
+      return 1;
+    }
+    jobs.assign(size_t(repeat > 0 ? repeat : 1), j);
+  } else {
+    fprintf(stderr,
+            "usage: parallel_http -l urls.txt [-c 64]\n"
+            "       parallel_http -u ip:port/path -n 1000 [-c 64]\n");
+    return 1;
+  }
+  if (jobs.empty()) {
+    fprintf(stderr, "no urls\n");
+    return 1;
+  }
+  fiber_init(0);
+  if (concurrency < 1) concurrency = 1;
+  if (size_t(concurrency) > jobs.size()) concurrency = int(jobs.size());
+  Shared sh;
+  sh.jobs = &jobs;
+  sh.live.store(concurrency);
+  const int64_t t0 = monotonic_us();
+  for (int i = 0; i < concurrency; ++i) {
+    fiber_t t;
+    if (fiber_start(&t, Worker, &sh) != 0) {
+      Worker(&sh);
+    }
+  }
+  sh.done.wait(-1);
+  const double secs = double(monotonic_us() - t0) / 1e6;
+  size_t ok = 0, bytes = 0;
+  for (const Job& j : jobs) {
+    if (j.rc == 0 && j.status == 200) ++ok;
+    bytes += j.bytes;
+  }
+  if (!list_file.empty()) {
+    for (const Job& j : jobs) {
+      printf("%-40s %s %d %zuB\n",
+             (j.server.to_string() + j.path).c_str(),
+             j.rc == 0 ? "ok" : strerror(j.rc), j.status, j.bytes);
+    }
+  }
+  printf("%zu/%zu ok, %.2fs, %.0f fetch/s, %.2f MB\n", ok, jobs.size(),
+         secs, double(jobs.size()) / (secs > 0 ? secs : 1e-9),
+         double(bytes) / 1e6);
+  return ok == jobs.size() ? 0 : 2;
+}

@@ -1,0 +1,57 @@
+// rpc_view: terminal viewer for any brt server's builtin observability
+// pages. Parity target: reference tools/rpc_view (a proxy that renders a
+// remote server's builtin services). Usage:
+//   rpc_view <ip:port> [page] [--watch seconds]
+// Pages: /status /vars /connections /rpcz /flags /fibers /heap /hotspots …
+// (default /status). --watch refreshes in place.
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "base/endpoint.h"
+#include "fiber/fiber.h"
+#include "rpc/http_client.h"
+
+using namespace brt;
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr,
+            "usage: rpc_view <ip:port> [page] [--watch seconds]\n"
+            "e.g.   rpc_view 127.0.0.1:8000 /status --watch 2\n");
+    return 1;
+  }
+  EndPoint server;
+  if (!EndPoint::parse(argv[1], &server)) {
+    fprintf(stderr, "bad address %s\n", argv[1]);
+    return 1;
+  }
+  std::string page = "/status";
+  int watch_s = 0;
+  for (int i = 2; i < argc; ++i) {
+    if (strcmp(argv[i], "--watch") == 0 && i + 1 < argc) {
+      watch_s = atoi(argv[++i]);
+    } else if (argv[i][0] == '/') {
+      page = argv[i];
+    }
+  }
+  fiber_init(2);
+  for (;;) {
+    HttpClientResult res;
+    const int rc = HttpGet(server, page, &res, 70 * 1000);
+    if (rc != 0) {
+      fprintf(stderr, "fetch %s%s failed: %s\n", argv[1], page.c_str(),
+              strerror(rc));
+      return 1;
+    }
+    if (watch_s > 0) printf("\033[2J\033[H");  // clear + home
+    printf("== %s%s (HTTP %d) ==\n%s", argv[1], page.c_str(), res.status,
+           res.body.c_str());
+    if (watch_s <= 0) break;
+    sleep(unsigned(watch_s));
+  }
+  return 0;
+}

@@ -128,6 +128,8 @@ int Socket::Create(const Options& opts, SocketId* id_out) {
   s->user_ = opts.user;
   s->on_edge_triggered_ = opts.on_edge_triggered;
   s->run_deferred_ = opts.run_deferred;
+  s->parsing_context_ = opts.initial_parsing_context;
+  s->parsing_context_destroyer_ = opts.parsing_context_destroyer;
   s->on_failed_ = opts.on_failed;
   s->failed_.store(0, std::memory_order_relaxed);
   s->error_text_.clear();

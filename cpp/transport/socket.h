@@ -79,6 +79,13 @@ class Socket {
     void* (*run_deferred)(void*) = nullptr;
     // Called once when the socket transitions to failed.
     void (*on_failed)(Socket*) = nullptr;
+    // Installed as the socket's parsing_context BEFORE the fd is armed
+    // with the dispatcher — per-connection state that on_edge_triggered /
+    // on_failed need from their very first invocation (a post-Create
+    // reset_parsing_context would race the read fiber). Freed by the
+    // destroyer when the socket recycles.
+    void* initial_parsing_context = nullptr;
+    void (*parsing_context_destroyer)(void*) = nullptr;
     int dispatcher_index = -1;  // -1: shard by fd
   };
 
