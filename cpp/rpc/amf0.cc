@@ -1,0 +1,212 @@
+#include "rpc/amf0.h"
+
+#include <cstring>
+
+namespace brt {
+
+namespace {
+
+constexpr int kMaxDepth = 16;
+
+void PutU16(std::string* s, uint16_t v) {
+  s->push_back(char(v >> 8));
+  s->push_back(char(v));
+}
+void PutU32(std::string* s, uint32_t v) {
+  s->push_back(char(v >> 24));
+  s->push_back(char(v >> 16));
+  s->push_back(char(v >> 8));
+  s->push_back(char(v));
+}
+void PutF64(std::string* s, double d) {
+  uint64_t bits;
+  memcpy(&bits, &d, 8);
+  for (int i = 7; i >= 0; --i) s->push_back(char(bits >> (i * 8)));
+}
+
+bool EncodeValue(const JsonValue& v, std::string* out, int depth) {
+  if (depth > kMaxDepth) return false;
+  switch (v.type) {
+    case JsonValue::Type::kInt:
+      out->push_back(0x00);
+      PutF64(out, double(v.i));
+      return true;
+    case JsonValue::Type::kDouble:
+      out->push_back(0x00);
+      PutF64(out, v.d);
+      return true;
+    case JsonValue::Type::kBool:
+      out->push_back(0x01);
+      out->push_back(v.b ? 1 : 0);
+      return true;
+    case JsonValue::Type::kString:
+      if (v.str.size() > 0xFFFFFFFFu) return false;  // length prefix is u32
+      if (v.str.size() <= 0xFFFF) {
+        out->push_back(0x02);
+        PutU16(out, uint16_t(v.str.size()));
+      } else {
+        out->push_back(0x0C);
+        PutU32(out, uint32_t(v.str.size()));
+      }
+      out->append(v.str);
+      return true;
+    case JsonValue::Type::kObject:
+      out->push_back(0x03);
+      for (const auto& [k, e] : v.members) {
+        // klen 0 is the decoder's end-of-object sentinel.
+        if (k.empty() || k.size() > 0xFFFF) return false;
+        PutU16(out, uint16_t(k.size()));
+        out->append(k);
+        if (!EncodeValue(e, out, depth + 1)) return false;
+      }
+      PutU16(out, 0);
+      out->push_back(0x09);  // object end
+      return true;
+    case JsonValue::Type::kArray:
+      out->push_back(0x0A);
+      PutU32(out, uint32_t(v.elems.size()));
+      for (const JsonValue& e : v.elems) {
+        if (!EncodeValue(e, out, depth + 1)) return false;
+      }
+      return true;
+    case JsonValue::Type::kNull:
+      out->push_back(0x05);
+      return true;
+  }
+  return false;
+}
+
+struct Amf0Parser {
+  const uint8_t* p;
+  size_t n;
+  size_t off;
+  std::string* err;
+
+  bool Fail(const char* m) {
+    if (err) *err = m;
+    return false;
+  }
+  bool Need(size_t k) {
+    return off + k <= n ? true : Fail("truncated AMF0");
+  }
+  uint16_t U16() {
+    uint16_t v = uint16_t(p[off]) << 8 | p[off + 1];
+    off += 2;
+    return v;
+  }
+  uint32_t U32() {
+    uint32_t v = uint32_t(p[off]) << 24 | uint32_t(p[off + 1]) << 16 |
+                 uint32_t(p[off + 2]) << 8 | p[off + 3];
+    off += 4;
+    return v;
+  }
+  double F64() {
+    uint64_t bits = 0;
+    for (int i = 0; i < 8; ++i) bits = bits << 8 | p[off + i];
+    off += 8;
+    double d;
+    memcpy(&d, &bits, 8);
+    return d;
+  }
+
+  bool Value(JsonValue* out, int depth) {
+    if (depth > kMaxDepth) return Fail("AMF0 nesting too deep");
+    if (!Need(1)) return false;
+    const uint8_t marker = p[off++];
+    switch (marker) {
+      case 0x00: {  // number
+        if (!Need(8)) return false;
+        const double d = F64();
+        // Integral doubles surface as kInt (AMF0 has only doubles;
+        // command transaction ids are integral). The range guard keeps
+        // the int64 conversion defined on hostile numbers (NaN, 1e300).
+        if (d >= -9.2233720368547758e18 && d < 9.2233720368547758e18 &&
+            d == double(int64_t(d))) {
+          *out = JsonValue::Int(int64_t(d));
+        } else {
+          *out = JsonValue::Double(d);
+        }
+        return true;
+      }
+      case 0x01:
+        if (!Need(1)) return false;
+        *out = JsonValue::Bool(p[off++] != 0);
+        return true;
+      case 0x02: {
+        if (!Need(2)) return false;
+        const uint16_t len = U16();
+        if (!Need(len)) return false;
+        *out = JsonValue::String(
+            std::string(reinterpret_cast<const char*>(p + off), len));
+        off += len;
+        return true;
+      }
+      case 0x0C: {  // long string
+        if (!Need(4)) return false;
+        const uint32_t len = U32();
+        if (!Need(len)) return false;
+        *out = JsonValue::String(
+            std::string(reinterpret_cast<const char*>(p + off), len));
+        off += len;
+        return true;
+      }
+      case 0x08: {  // ECMA array: count then object-style pairs
+        if (!Need(4)) return false;
+        U32();  // advisory count; terminated by the end marker anyway
+        [[fallthrough]];
+      }
+      case 0x03: {  // object
+        *out = JsonValue::Object();
+        for (;;) {
+          if (!Need(2)) return false;
+          const uint16_t klen = U16();
+          if (klen == 0) {
+            if (!Need(1)) return false;
+            if (p[off++] != 0x09) return Fail("missing object end");
+            return true;
+          }
+          if (!Need(klen)) return false;
+          std::string key(reinterpret_cast<const char*>(p + off), klen);
+          off += klen;
+          JsonValue v;
+          if (!Value(&v, depth + 1)) return false;
+          out->members.emplace_back(std::move(key), std::move(v));
+        }
+      }
+      case 0x0A: {  // strict array
+        if (!Need(4)) return false;
+        const uint32_t count = U32();
+        if (count > n - off) return Fail("array count exceeds input");
+        *out = JsonValue::Array();
+        for (uint32_t i = 0; i < count; ++i) {
+          JsonValue v;
+          if (!Value(&v, depth + 1)) return false;
+          out->elems.push_back(std::move(v));
+        }
+        return true;
+      }
+      case 0x05:  // null
+      case 0x06:  // undefined
+        *out = JsonValue::Null();
+        return true;
+      default:
+        return Fail("unsupported AMF0 marker");
+    }
+  }
+};
+
+}  // namespace
+
+bool Amf0Encode(const JsonValue& v, std::string* out) {
+  return EncodeValue(v, out, 0);
+}
+
+bool Amf0Decode(const void* data, size_t n, size_t* off, JsonValue* out,
+                std::string* err) {
+  Amf0Parser ps{static_cast<const uint8_t*>(data), n, *off, err};
+  if (!ps.Value(out, 0)) return false;
+  *off = ps.off;
+  return true;
+}
+
+}  // namespace brt

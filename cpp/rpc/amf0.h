@@ -1,0 +1,26 @@
+// AMF0 codec over the JsonValue DOM — the command-message layer under
+// RTMP (rpc/rtmp.h). Parity target: reference src/brpc/amf.{h,cpp}
+// (AMF0 for rtmp_protocol.cpp). Supported markers: number(0x00),
+// boolean(0x01), string(0x02), object(0x03), null(0x05), undefined(0x06),
+// ECMA array(0x08, decoded as object), strict array(0x0A), long
+// string(0x0C) — the set RTMP command messages actually use.
+#pragma once
+
+#include <string>
+
+#include "base/iobuf.h"
+#include "rpc/json.h"
+
+namespace brt {
+
+// Appends one AMF0 value. Numbers: kInt/kDouble encode as number;
+// kObject as object; kArray as strict array; kNull as null. False on
+// unencodable input (strings > 4GB only, practically).
+bool Amf0Encode(const JsonValue& v, std::string* out);
+
+// Decodes one AMF0 value from data[off, n); advances *off. Depth- and
+// bounds-checked. False with *err on malformed input.
+bool Amf0Decode(const void* data, size_t n, size_t* off, JsonValue* out,
+                std::string* err);
+
+}  // namespace brt

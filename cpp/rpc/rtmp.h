@@ -1,0 +1,105 @@
+// RTMP live-streaming tier on the shared RPC port + FLV recording.
+// Parity target: reference src/brpc/policy/rtmp_protocol.cpp (3677 LoC) +
+// src/brpc/rtmp.cpp (RtmpService/RtmpServerStream/RtmpClientStream) and
+// the FLV writer in rtmp.h. Redesigned to this framework's shape: the
+// plain handshake + chunk stream is a stateful parse on the shared port
+// (first byte 0x03 claims the connection), the server answers the
+// NetConnection/NetStream command flow (connect/createStream/publish/
+// play) over AMF0 (rpc/amf0.h), and published audio/video/data frames
+// relay live to every player of the same stream name — the RTMP server's
+// core job — with an RtmpService hook seeing accept/reject decisions and
+// every frame. Blocking publisher/player clients cover tooling and tests.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+
+namespace brt {
+
+class Server;
+
+struct RtmpFrame {
+  uint8_t type = 0;  // 8 audio, 9 video, 18 data(AMF0)
+  uint32_t timestamp_ms = 0;
+  IOBuf payload;
+};
+
+class RtmpService {
+ public:
+  virtual ~RtmpService() = default;
+  // Accept/reject a publisher / player of `stream` in `app`.
+  virtual bool OnPublish(const std::string& app, const std::string& stream) {
+    (void)app;
+    (void)stream;
+    return true;
+  }
+  virtual bool OnPlay(const std::string& app, const std::string& stream) {
+    (void)app;
+    (void)stream;
+    return true;
+  }
+  // Every frame a publisher pushes (after the built-in relay fan-out).
+  virtual void OnFrame(const std::string& stream, const RtmpFrame& frame) {
+    (void)stream;
+    (void)frame;
+  }
+  virtual void OnPublishStop(const std::string& stream) { (void)stream; }
+};
+
+// Routes RTMP connections on `server`'s port to `service` (one per
+// server, like ServeRedisOn). The service must outlive the server's
+// traffic; call StopRtmpOn before destroying either.
+void ServeRtmpOn(Server* server, RtmpService* service);
+void StopRtmpOn(Server* server);
+
+// Blocking publisher: handshake + connect(app) + createStream + publish,
+// then Write() pushes frames. Tooling/test tier (the reference's async
+// RtmpClientStream maps to the server-side relay here).
+class RtmpPublisher {
+ public:
+  RtmpPublisher();
+  ~RtmpPublisher();
+  int Connect(const EndPoint& server, const std::string& app,
+              const std::string& stream, int64_t timeout_ms = 3000);
+  int Write(const RtmpFrame& frame);
+  void Close();
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+// Blocking player: handshake + connect + play, then Read() pops relayed
+// frames in arrival order.
+class RtmpPlayer {
+ public:
+  RtmpPlayer();
+  ~RtmpPlayer();
+  int Connect(const EndPoint& server, const std::string& app,
+              const std::string& stream, int64_t timeout_ms = 3000);
+  // Blocks up to timeout_ms for the next media/data frame.
+  int Read(RtmpFrame* frame, int64_t timeout_ms = 3000);
+  void Close();
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+// FLV file writer (reference rtmp.h FlvWriter): header + one tag per
+// frame. Does not own `file`.
+class FlvWriter {
+ public:
+  explicit FlvWriter(FILE* file) : file_(file) {}
+  bool WriteHeader(bool has_audio = true, bool has_video = true);
+  bool WriteFrame(const RtmpFrame& frame);
+
+ private:
+  FILE* file_;
+};
+
+}  // namespace brt
