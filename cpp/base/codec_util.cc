@@ -1,0 +1,160 @@
+#include "base/codec_util.h"
+
+#include <cstring>
+
+namespace brt {
+
+namespace {
+
+constexpr char kB64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+int8_t B64Index(char c) {
+  if (c >= 'A' && c <= 'Z') return int8_t(c - 'A');
+  if (c >= 'a' && c <= 'z') return int8_t(c - 'a' + 26);
+  if (c >= '0' && c <= '9') return int8_t(c - '0' + 52);
+  if (c == '+') return 62;
+  if (c == '/') return 63;
+  return -1;
+}
+
+}  // namespace
+
+std::string Base64Encode(std::string_view in) {
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  for (; i + 3 <= in.size(); i += 3) {
+    const uint32_t v = uint32_t(uint8_t(in[i])) << 16 |
+                       uint32_t(uint8_t(in[i + 1])) << 8 |
+                       uint8_t(in[i + 2]);
+    out.push_back(kB64[v >> 18]);
+    out.push_back(kB64[(v >> 12) & 63]);
+    out.push_back(kB64[(v >> 6) & 63]);
+    out.push_back(kB64[v & 63]);
+  }
+  const size_t rem = in.size() - i;
+  if (rem == 1) {
+    const uint32_t v = uint32_t(uint8_t(in[i])) << 16;
+    out.push_back(kB64[v >> 18]);
+    out.push_back(kB64[(v >> 12) & 63]);
+    out.append("==");
+  } else if (rem == 2) {
+    const uint32_t v = uint32_t(uint8_t(in[i])) << 16 |
+                       uint32_t(uint8_t(in[i + 1])) << 8;
+    out.push_back(kB64[v >> 18]);
+    out.push_back(kB64[(v >> 12) & 63]);
+    out.push_back(kB64[(v >> 6) & 63]);
+    out.push_back('=');
+  }
+  return out;
+}
+
+bool Base64Decode(std::string_view in, std::string* out) {
+  out->clear();
+  if (in.empty()) return true;
+  if (in.size() % 4 != 0) return false;
+  out->reserve(in.size() / 4 * 3);
+  for (size_t i = 0; i < in.size(); i += 4) {
+    int8_t a = B64Index(in[i]);
+    int8_t b = B64Index(in[i + 1]);
+    const bool last = i + 4 == in.size();
+    const char c3 = in[i + 2];
+    const char c4 = in[i + 3];
+    int8_t c = c3 == '=' ? 0 : B64Index(c3);
+    int8_t d = c4 == '=' ? 0 : B64Index(c4);
+    if (a < 0 || b < 0 || c < 0 || d < 0) return false;
+    if ((c3 == '=' || c4 == '=') && !last) return false;
+    if (c3 == '=' && c4 != '=') return false;
+    const uint32_t v = uint32_t(a) << 18 | uint32_t(b) << 12 |
+                       uint32_t(c) << 6 | uint32_t(d);
+    out->push_back(char(v >> 16));
+    if (c3 != '=') out->push_back(char((v >> 8) & 0xFF));
+    if (c4 != '=') out->push_back(char(v & 0xFF));
+  }
+  return true;
+}
+
+std::string Sha1(std::string_view in) {
+  uint32_t h[5] = {0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476,
+                   0xC3D2E1F0};
+  // Streamed over the input: full 64-byte blocks hash in place (no copy
+  // of the message); the tail + 0x80 + zero pad + 64-bit bit length go
+  // through one or two stack blocks.
+  const uint64_t bitlen = uint64_t(in.size()) * 8;
+  const size_t full = in.size() / 64 * 64;
+  unsigned char tail[128];
+  size_t tail_len = in.size() - full;
+  memcpy(tail, in.data() + full, tail_len);
+  tail[tail_len++] = 0x80;
+  while (tail_len % 64 != 56) tail[tail_len++] = 0;
+  for (int i = 7; i >= 0; --i) tail[tail_len++] = uint8_t(bitlen >> (i * 8));
+
+  auto rotl = [](uint32_t x, int k) { return (x << k) | (x >> (32 - k)); };
+  auto block_at = [&](size_t off) -> const unsigned char* {
+    return off < full
+               ? reinterpret_cast<const unsigned char*>(in.data()) + off
+               : tail + (off - full);
+  };
+  for (size_t off = 0; off < full + tail_len; off += 64) {
+    const unsigned char* blk = block_at(off);
+    uint32_t w[80];
+    for (int t = 0; t < 16; ++t) {
+      w[t] = uint32_t(blk[t * 4]) << 24 | uint32_t(blk[t * 4 + 1]) << 16 |
+             uint32_t(blk[t * 4 + 2]) << 8 | blk[t * 4 + 3];
+    }
+    for (int t = 16; t < 80; ++t) {
+      w[t] = rotl(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1);
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4];
+    for (int t = 0; t < 80; ++t) {
+      uint32_t f, k;
+      if (t < 20) {
+        f = (b & c) | (~b & d);
+        k = 0x5A827999;
+      } else if (t < 40) {
+        f = b ^ c ^ d;
+        k = 0x6ED9EBA1;
+      } else if (t < 60) {
+        f = (b & c) | (b & d) | (c & d);
+        k = 0x8F1BBCDC;
+      } else {
+        f = b ^ c ^ d;
+        k = 0xCA62C1D6;
+      }
+      const uint32_t tmp = rotl(a, 5) + f + e + k + w[t];
+      e = d;
+      d = c;
+      c = rotl(b, 30);
+      b = a;
+      a = tmp;
+    }
+    h[0] += a;
+    h[1] += b;
+    h[2] += c;
+    h[3] += d;
+    h[4] += e;
+  }
+  std::string digest(20, '\0');
+  for (int i = 0; i < 5; ++i) {
+    digest[i * 4] = char(h[i] >> 24);
+    digest[i * 4 + 1] = char(h[i] >> 16);
+    digest[i * 4 + 2] = char(h[i] >> 8);
+    digest[i * 4 + 3] = char(h[i]);
+  }
+  return digest;
+}
+
+std::string Sha1Hex(std::string_view in) {
+  const std::string d = Sha1(in);
+  static const char* hex = "0123456789abcdef";
+  std::string out;
+  out.reserve(40);
+  for (unsigned char c : d) {
+    out.push_back(hex[c >> 4]);
+    out.push_back(hex[c & 15]);
+  }
+  return out;
+}
+
+}  // namespace brt

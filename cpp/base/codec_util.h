@@ -1,0 +1,21 @@
+// Small codec utilities: base64 + SHA-1. Parity target: reference
+// src/butil/base64.{h,cc} (modp_b64 vendored) and src/butil/sha1.{h,cc}.
+// Self-contained implementations — no vendored third_party.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <string_view>
+
+namespace brt {
+
+std::string Base64Encode(std::string_view in);
+// Strict decode (standard alphabet, '=' padding). False on bad input.
+bool Base64Decode(std::string_view in, std::string* out);
+
+// 20-byte binary digest.
+std::string Sha1(std::string_view in);
+// Lowercase hex of the digest (40 chars).
+std::string Sha1Hex(std::string_view in);
+
+}  // namespace brt

@@ -50,6 +50,7 @@ void Controller::SetFailed(int code, const char* fmt, ...) {
 }
 
 void Controller::Reset() {
+  progressive_attachment.reset();
   error_code_ = 0;
   error_text_.clear();
   request_attachment_.clear();

@@ -8,6 +8,7 @@
 
 #include <atomic>
 #include <functional>
+#include <memory>
 #include <string>
 #include <vector>
 
@@ -152,6 +153,11 @@ class Controller {
   // DataFactory — reference Controller::session_local_data()).
   void* session_local_data() const { return session_local_data_; }
   void set_session_local_data(void* d) { session_local_data_ = d; }
+
+  // Set by CreateProgressiveAttachment (rpc/progressive_attachment.h);
+  // consumed by the HTTP/1.1 front-end to switch the response to chunked
+  // streaming. shared_ptr<ProgressiveAttachment> under the hood.
+  std::shared_ptr<void> progressive_attachment;
   void set_local_side(const EndPoint& ep) { local_side_ = ep; }
   void set_latency(int64_t us) { latency_us_ = us; }
   void set_cid(fid_t id) { cid_.store(id, std::memory_order_release); }

@@ -22,6 +22,7 @@
 #include "rpc/errors.h"
 #include "rpc/hpack.h"
 #include "rpc/http_dispatch.h"
+#include "rpc/progressive_attachment.h"
 #include "rpc/server.h"
 #include "transport/input_messenger.h"
 
@@ -458,6 +459,9 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
   }
   adm.svc->CallMethod(adm.method, &ctx->cntl, ctx->request, &ctx->response,
                       [ctx] {
+    // h2 responses are not chunk-streamable here: abort any progressive
+    // attachment so its writer learns instead of buffering forever.
+    AbortProgressiveIfAny(&ctx->cntl);
     int ec = ctx->cntl.Failed() ? ctx->cntl.ErrorCode() : 0;
     if (ec == 0) {
       IOBuf body = std::move(ctx->response);

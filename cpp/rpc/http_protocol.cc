@@ -21,6 +21,7 @@
 #include "rpc/http_dispatch.h"
 #include "rpc/http_message.h"
 #include "rpc/http_protocol.h"
+#include "rpc/progressive_attachment.h"
 #include "rpc/server.h"
 #include "transport/input_messenger.h"
 
@@ -56,6 +57,9 @@ void DeleteParsedRequest(void* data, void*) {
 struct ParkedResponse {
   IOBuf buf;
   bool close = false;  // response announced "Connection: close"
+  // Progressive response: bound to the socket only when this batch hits
+  // the wire (chunks must never overtake earlier pipelined responses).
+  std::shared_ptr<ProgressiveAttachment> pa;
 };
 
 struct HttpSocketCtx {
@@ -76,25 +80,38 @@ HttpSocketCtx* GetCtx(Socket* s) {
 // Writes the seq'th response, holding earlier-completed later-seq responses
 // until their turn (HTTP/1.1 pipelining: responses MUST be in request
 // order even though we process requests concurrently).
-void WriteSequenced(Socket* s, uint64_t seq, IOBuf&& out, bool close) {
+void WriteSequenced(Socket* s, uint64_t seq, IOBuf&& out, bool close,
+                    std::shared_ptr<ProgressiveAttachment> pa = nullptr) {
   HttpSocketCtx* ctx = GetCtx(s);
-  if (ctx == nullptr) return;  // connection already torn down
+  if (ctx == nullptr) {
+    if (pa != nullptr) pa->Abort();  // connection already torn down
+    return;
+  }
   std::unique_lock<std::mutex> lk(ctx->mu);
   if (seq != ctx->next_out) {
-    ctx->parked.emplace(seq, ParkedResponse{std::move(out), close});
+    ctx->parked.emplace(seq,
+                        ParkedResponse{std::move(out), close, std::move(pa)});
     return;
   }
   IOBuf ready = std::move(out);
   bool close_now = close;
+  std::vector<std::shared_ptr<ProgressiveAttachment>> to_bind;
+  if (pa != nullptr) to_bind.push_back(std::move(pa));
   for (;;) {
     ++ctx->next_out;
     auto it = ctx->parked.find(ctx->next_out);
     if (it == ctx->parked.end()) break;
     ready.append(std::move(it->second.buf));
     close_now = close_now || it->second.close;
+    if (it->second.pa != nullptr) {
+      to_bind.push_back(std::move(it->second.pa));
+    }
     ctx->parked.erase(it);
   }
-  if (close_now) ctx->closing = true;
+  // A progressive response owns the connection until its final chunk:
+  // swallow later pipelined requests, but do NOT CloseAfterFlush (the
+  // attachment closes when destroyed).
+  if (close_now || !to_bind.empty()) ctx->closing = true;
   // The enqueue itself must happen under the lock: releasing first would
   // let a later seq that observes the bumped next_out reach the socket's
   // write chain ahead of this batch. Socket::Write is wait-free, so the
@@ -102,7 +119,11 @@ void WriteSequenced(Socket* s, uint64_t seq, IOBuf&& out, bool close) {
   s->Write(&ready);
   // A close-announced response actually closes the connection once it has
   // reached the kernel (HTTP/1.0 clients wait for EOF).
-  if (close_now) s->CloseAfterFlush();
+  if (close_now && to_bind.empty()) s->CloseAfterFlush();
+  lk.unlock();
+  // Headers (and everything queued before them) are on the write chain in
+  // order; the attachments' direct writes can only land after them.
+  for (auto& bind : to_bind) bind->BindSocket(s->id());
 }
 
 ParseResult HttpParse(IOBuf* source, IOBuf* msg, Socket* s) {
@@ -253,11 +274,43 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
     IOBuf out;
     bool close;
     if (sess->cntl.Failed()) {
+      // A handler that created a progressive attachment but failed must
+      // not leave its writer buffering into the void.
+      AbortProgressiveIfAny(&sess->cntl);
       IOBuf body;
       body.append(std::to_string(sess->cntl.ErrorCode()) + ": " +
                   sess->cntl.ErrorText() + "\n");
       close = MakeResponseBytes(sess->req_head, 500, "text/plain",
                                 std::move(body), &out);
+    } else if (sess->cntl.progressive_attachment != nullptr) {
+      // Progressive response: chunked header now, body (if any) as the
+      // first chunk; the attachment streams the rest and terminates the
+      // connection when destroyed (reference ProgressiveAttachment).
+      HttpMessage resp;
+      resp.status = 200;
+      resp.reason = "OK";
+      resp.set_header("Content-Type", "application/octet-stream");
+      resp.set_header("Transfer-Encoding", "chunked");
+      resp.set_header("Connection", "close");
+      SerializeHttpHead(resp, /*is_request=*/false, &out);
+      IOBuf first = std::move(sess->response);
+      first.append(std::move(sess->cntl.response_attachment()));
+      if (!first.empty()) AppendHttpChunk(&out, first);
+      auto pa = std::static_pointer_cast<ProgressiveAttachment>(
+          sess->cntl.progressive_attachment);
+      SocketUniquePtr pp;
+      if (Socket::Address(sess->sock, &pp) == 0) {
+        // close=false: the attachment terminates the connection; the
+        // sequencer binds it only when these headers hit the wire.
+        WriteSequenced(pp.get(), sess->seq, std::move(out), false,
+                       std::move(pa));
+      } else {
+        pa->Abort();
+      }
+      server->ReturnSessionData(sess->cntl.session_local_data());
+      FinishHttpRequest(server, ms, 0, monotonic_us() - start_us);
+      delete sess;
+      return;
     } else {
       IOBuf body = std::move(sess->response);
       body.append(std::move(sess->cntl.response_attachment()));

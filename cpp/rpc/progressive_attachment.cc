@@ -1,0 +1,92 @@
+#include "rpc/progressive_attachment.h"
+
+#include <cstdio>
+
+#include "rpc/controller.h"
+
+namespace brt {
+
+void AppendHttpChunk(IOBuf* out, const IOBuf& data) {
+  char head[16];
+  const int n = snprintf(head, sizeof(head), "%zx\r\n", data.size());
+  out->append(head, size_t(n));
+  out->append(data);
+  out->append("\r\n");
+}
+
+ProgressiveAttachment::~ProgressiveAttachment() {
+  std::lock_guard<std::mutex> g(mu_);
+  if (sid_ == INVALID_SOCKET_ID) return;
+  SocketUniquePtr p;
+  if (Socket::Address(sid_, &p) == 0 && !p->Failed()) {
+    IOBuf tail;
+    tail.append("0\r\n\r\n");  // terminating chunk
+    p->Write(&tail);
+    // Progressive responses are the last on their connection (the
+    // front-end announced Connection: close).
+    p->CloseAfterFlush();
+  }
+}
+
+int ProgressiveAttachment::Write(const IOBuf& data) {
+  if (data.empty()) return 0;  // a zero-size chunk would terminate
+  std::lock_guard<std::mutex> g(mu_);
+  if (failed_) return ECONNRESET;
+  if (sid_ == INVALID_SOCKET_ID) {
+    pending_.push_back(data);  // headers not on the wire yet
+    return 0;
+  }
+  SocketUniquePtr p;
+  if (Socket::Address(sid_, &p) != 0 || p->Failed()) {
+    failed_ = true;
+    return ECONNRESET;
+  }
+  IOBuf out;
+  AppendHttpChunk(&out, data);
+  return p->Write(&out);
+}
+
+int ProgressiveAttachment::Write(const std::string& data) {
+  IOBuf b;
+  b.append(data);
+  return Write(b);
+}
+
+void ProgressiveAttachment::Abort() {
+  std::lock_guard<std::mutex> g(mu_);
+  failed_ = true;
+  pending_.clear();
+}
+
+void ProgressiveAttachment::BindSocket(SocketId sid) {
+  std::lock_guard<std::mutex> g(mu_);
+  sid_ = sid;
+  if (pending_.empty()) return;
+  SocketUniquePtr p;
+  if (Socket::Address(sid_, &p) != 0 || p->Failed()) {
+    failed_ = true;
+    pending_.clear();
+    return;
+  }
+  IOBuf out;
+  for (const IOBuf& chunk : pending_) AppendHttpChunk(&out, chunk);
+  pending_.clear();
+  p->Write(&out);
+}
+
+std::shared_ptr<ProgressiveAttachment> CreateProgressiveAttachment(
+    Controller* cntl) {
+  std::shared_ptr<ProgressiveAttachment> pa(new ProgressiveAttachment());
+  cntl->progressive_attachment = pa;
+  return pa;
+}
+
+void AbortProgressiveIfAny(Controller* cntl) {
+  if (cntl->progressive_attachment != nullptr) {
+    static_cast<ProgressiveAttachment*>(cntl->progressive_attachment.get())
+        ->Abort();
+    cntl->progressive_attachment.reset();
+  }
+}
+
+}  // namespace brt

@@ -1,0 +1,71 @@
+// ProgressiveAttachment: stream an HTTP response body AFTER the handler
+// returned. Parity target: reference src/brpc/progressive_attachment.h
+// (Controller::CreateProgressiveAttachment + chunked writes until the
+// attachment is destroyed). The handler creates one before done(); the
+// HTTP/1.1 front-end then answers with Transfer-Encoding: chunked and
+// every Write() becomes a chunk; destroying the attachment sends the
+// terminating chunk and closes the connection (progressive responses are
+// last on their connection, like the reference's).
+#pragma once
+
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "base/iobuf.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+class Controller;
+
+class ProgressiveAttachment {
+ public:
+  ~ProgressiveAttachment();
+
+  // Appends one chunk (may be called from any fiber/thread, before or
+  // after the front-end sent the headers — early writes buffer until the
+  // headers are on the wire). Returns 0, or the socket error.
+  int Write(const IOBuf& data);
+  int Write(const std::string& data);
+
+  // ---- front-end internals ----
+  // Binds the attachment to its connection once the chunked header (and
+  // any buffered chunks) are ON THE WIRE — on a pipelined connection that
+  // may be when a parked batch drains, not when the handler finishes.
+  // Flushes the buffer.
+  void BindSocket(SocketId sid);
+
+  // Marks the attachment dead (connection gone, handler failed, or the
+  // protocol cannot stream). Buffered chunks drop; Write() returns
+  // ECONNRESET from here on.
+  void Abort();
+
+ private:
+  friend std::shared_ptr<ProgressiveAttachment>
+  CreateProgressiveAttachment(Controller* cntl);
+  ProgressiveAttachment() = default;
+
+  std::mutex mu_;
+  SocketId sid_ = INVALID_SOCKET_ID;
+  std::vector<IOBuf> pending_;  // chunks written before BindSocket
+  bool failed_ = false;
+};
+
+// Call INSIDE a service handler (before done) on an HTTP request's
+// Controller: switches the response to chunked streaming. The response
+// body (if any) becomes the first chunk. Returns the writable attachment;
+// keep it alive as long as you stream. Non-HTTP callers get a valid
+// attachment whose writes fail with ENOTSUP at bind time.
+std::shared_ptr<ProgressiveAttachment> CreateProgressiveAttachment(
+    Controller* cntl);
+
+// Front-ends that cannot stream (brt_std, h2, failed HTTP paths) call
+// this after the handler completes: any attachment the handler created is
+// aborted so its writer learns the truth instead of buffering forever.
+void AbortProgressiveIfAny(Controller* cntl);
+
+// Shared HTTP/1.1 chunk framing ("<hex>\r\n" + data + "\r\n").
+void AppendHttpChunk(IOBuf* out, const IOBuf& data);
+
+}  // namespace brt

@@ -5,6 +5,7 @@
 // Socket::Write. Client path mirrors ProcessRpcResponse (:584): lock the
 // correlation id, hand the frame to the Controller (which owns the
 // retry/timeout/backup race resolution).
+#include "rpc/progressive_attachment.h"
 #include "rpc/protocol_brt.h"
 
 #include <mutex>
@@ -61,6 +62,9 @@ struct RpcSession {
 };
 
 void SendResponse(RpcSession* sess) {
+  // brt_std cannot stream a response: a progressive attachment the
+  // handler created must fail loudly for its writer, not buffer forever.
+  AbortProgressiveIfAny(&sess->cntl);
   const int64_t lat = monotonic_us() - sess->start_us;
   if (sess->span != nullptr) {
     sess->span->annotate("sending response");

@@ -7,6 +7,7 @@
 #include <vector>
 
 #include "base/arena.h"
+#include "base/codec_util.h"
 #include "base/crc32c.h"
 #include "base/file_watcher.h"
 #include "base/rand.h"
@@ -286,6 +287,32 @@ void test_file_watcher() {
   printf("file_watcher OK\n");
 }
 
+void test_codec_util() {
+  // RFC 4648 base64 vectors.
+  assert(Base64Encode("") == "");
+  assert(Base64Encode("f") == "Zg==");
+  assert(Base64Encode("fo") == "Zm8=");
+  assert(Base64Encode("foo") == "Zm9v");
+  assert(Base64Encode("foobar") == "Zm9vYmFy");
+  std::string out;
+  assert(Base64Decode("Zm9vYmFy", &out) && out == "foobar");
+  assert(Base64Decode("Zg==", &out) && out == "f");
+  assert(Base64Decode("", &out) && out.empty());
+  assert(!Base64Decode("Zg=", &out));    // bad length
+  assert(!Base64Decode("Z!==", &out));   // bad alphabet
+  assert(!Base64Decode("Zg==Zg==", &out));  // padding mid-stream
+  // binary round trip
+  std::string bin;
+  for (int i = 0; i < 256; ++i) bin.push_back(char(i));
+  assert(Base64Decode(Base64Encode(bin), &out) && out == bin);
+  // FIPS 180-1 SHA-1 vectors.
+  assert(Sha1Hex("abc") == "a9993e364706816aba3e25717850c26c9cd0d89d");
+  assert(Sha1Hex("") == "da39a3ee5e6b4b0d3255bfef95601890afd80709");
+  assert(Sha1Hex("abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq")
+         == "84983e441c3bd26ebaae4aa1f95129e5e54670f1");
+  printf("codec_util OK\n");
+}
+
 int main() {
   test_iobuf_basic();
   test_iobuf_large();
@@ -299,6 +326,7 @@ int main() {
   test_arena();
   test_recordio();
   test_file_watcher();
+  test_codec_util();
   printf("ALL BASE TESTS PASSED\n");
   return 0;
 }

@@ -14,6 +14,7 @@
 #include "fiber/fiber.h"
 #include "rpc/channel.h"
 #include "rpc/http_message.h"
+#include "rpc/progressive_attachment.h"
 #include "rpc/server.h"
 #include "var/latency_recorder.h"
 #include "var/multi_dimension.h"
@@ -82,6 +83,92 @@ std::string HttpGet(const EndPoint& addr, const std::string& request) {
 
 }  // namespace
 
+
+// Progressive (chunked, handler-returns-first) response: the handler
+// creates a ProgressiveAttachment, done()s, then streams chunks from a
+// separate fiber; the client must see a chunked response that decodes to
+// every chunk in order (reference ProgressiveAttachment contract).
+class ProgressiveService : public Service {
+ public:
+  void CallMethod(const std::string&, Controller* cntl, const IOBuf&,
+                  IOBuf* response, Closure done) override {
+    auto pa = CreateProgressiveAttachment(cntl);
+    response->append("head;");
+    done();
+    struct Arg {
+      std::shared_ptr<ProgressiveAttachment> pa;
+    };
+    auto* arg = new Arg{pa};
+    fiber_t t;
+    fiber_start(&t, [](void* p) -> void* {
+      auto* a = static_cast<Arg*>(p);
+      for (int i = 0; i < 3; ++i) {
+        fiber_usleep(30 * 1000);
+        const int wrc = a->pa->Write("chunk" + std::to_string(i) + ";");
+        if (wrc != 0) fprintf(stderr, "pa write %d rc=%d\n", i, wrc);
+      }
+      delete a;  // drops the pa ref: terminating chunk + close
+      return nullptr;
+    }, arg);
+  }
+};
+
+void test_progressive(const EndPoint& addr) {
+  std::string resp = HttpGet(
+      addr, "GET /Progressive/Stream HTTP/1.1\r\n\r\n");
+  assert(resp.rfind("HTTP/1.1 200", 0) == 0);
+  assert(resp.find("Transfer-Encoding: chunked") != std::string::npos ||
+         resp.find("transfer-encoding: chunked") != std::string::npos);
+  // Decode the chunked body.
+  const size_t he = resp.find("\r\n\r\n");
+  assert(he != std::string::npos);
+  std::string body;
+  size_t pos = he + 4;
+  for (;;) {
+    const size_t eol = resp.find("\r\n", pos);
+    if (eol == std::string::npos) {
+      fprintf(stderr, "RAW RESPONSE (%zu bytes):\n%s\n", resp.size(),
+              resp.c_str());
+    }
+    assert(eol != std::string::npos);
+    const size_t len = strtoul(resp.c_str() + pos, nullptr, 16);
+    if (len == 0) break;
+    body.append(resp, eol + 2, len);
+    pos = eol + 2 + len + 2;
+  }
+  assert(body == "head;chunk0;chunk1;chunk2;");
+  printf("progressive response OK\n");
+}
+
+// Pipelined: a SLOW normal request then a progressive one on the same
+// connection — the progressive headers/chunks must wait for the parked
+// earlier response (the sequencer binds the attachment on drain).
+void test_progressive_pipelined(const EndPoint& addr) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  assert(fd >= 0);
+  sockaddr_in sa = addr.to_sockaddr();
+  assert(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0);
+  const std::string reqs =
+      "POST /Rev/Echo HTTP/1.1\r\nContent-Length: 2\r\n\r\nr0"
+      "GET /Progressive/Stream HTTP/1.1\r\n\r\n";
+  assert(write(fd, reqs.data(), reqs.size()) == ssize_t(reqs.size()));
+  std::string all;
+  char buf[8192];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) all.append(buf, size_t(n));
+  close(fd);
+  // First response: the slow echo, complete, BEFORE any chunked bytes.
+  const size_t first_end = all.find("r0");
+  const size_t chunked_at = all.find("Transfer-Encoding: chunked");
+  assert(first_end != std::string::npos);
+  assert(chunked_at != std::string::npos);
+  assert(first_end < chunked_at);
+  assert(all.find("head;") != std::string::npos);
+  assert(all.find("chunk2;") != std::string::npos);
+  assert(all.find("0\r\n\r\n") != std::string::npos);
+  printf("progressive pipelined OK\n");
+}
+
 int main() {
   fiber_init(4);
   Server server;
@@ -89,6 +176,8 @@ int main() {
   assert(server.AddService(&echo, "Echo") == 0);
   SlowRevEchoService rev;
   assert(server.AddService(&rev, "Rev") == 0);
+  ProgressiveService prog;
+  assert(server.AddService(&prog, "Progressive") == 0);
   assert(server.Start("127.0.0.1:0") == 0);
   const EndPoint addr = server.listen_address();
 
@@ -283,6 +372,9 @@ int main() {
     close(fd);
     printf("http_10_close OK\n");
   }
+
+  test_progressive(addr);
+  test_progressive_pipelined(addr);
 
   server.Stop();
   server.Join();

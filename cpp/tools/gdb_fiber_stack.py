@@ -1,0 +1,84 @@
+# gdb script: walk the user-space fiber stacks of a brt process.
+# Parity target: reference tools/gdb_bthread_stack.py (walks parked
+# bthread stacks that gdb's thread view cannot see).
+#
+# Usage:
+#   gdb -p <pid> -ex 'source cpp/tools/gdb_fiber_stack.py'
+#   (gdb) fiber_stacks          # backtrace every parked fiber
+#   (gdb) fiber_stacks 12       # only pool slot 12
+#
+# How it works: every fiber lives in TaskMetaPool (cpp/fiber/fiber.cc); a
+# parked fiber's TaskMeta.ctx_sp points at the register save area written
+# by brt_jump_context (cpp/fiber/context.S):
+#   ctx_sp + 0   fpu/mxcsr (8 bytes)
+#   ctx_sp + 8   r15   +16 r14   +24 r13   +32 r12   +40 rbx   +48 rbp
+#   ctx_sp + 56  return rip
+#   ctx_sp + 64  the fiber's rsp after resuming
+# We point gdb's unwinder at that rip/rsp/rbp, print the backtrace, and
+# restore the live registers.
+
+import gdb
+
+
+def _u64(addr):
+    return int(gdb.parse_and_eval("*(unsigned long long*)%d" % addr))
+
+
+class FiberStacks(gdb.Command):
+    """Backtrace parked fibers: fiber_stacks [slot]"""
+
+    def __init__(self):
+        super(FiberStacks, self).__init__("fiber_stacks", gdb.COMMAND_STACK)
+
+    def invoke(self, arg, from_tty):
+        try:
+            pool = gdb.parse_and_eval("'brt::TaskMetaPool::get'()")
+        except gdb.error as e:
+            print("fiber runtime symbols not found (%s) — build with -g" % e)
+            return
+        only = arg.strip()
+        n = int(pool["next_index_"]["_M_i"]) if pool.type.code else 0
+        try:
+            n = int(gdb.parse_and_eval(
+                "'brt::TaskMetaPool::get'().next_index_._M_i"))
+        except gdb.error:
+            pass
+        shown = 0
+        for i in range(n):
+            if only and str(i) != only:
+                continue
+            try:
+                meta = gdb.parse_and_eval(
+                    "'brt::TaskMetaPool::get'().slot(%d)" % i)
+                ctx_sp = int(meta["ctx_sp"])
+                version = int(gdb.parse_and_eval(
+                    "('brt::TaskMetaPool::get'().slot(%d))->version._M_i"
+                    % i))
+            except gdb.error:
+                continue
+            if ctx_sp == 0 or version % 2 == 0:  # running inline or free
+                continue
+            rip = _u64(ctx_sp + 56)
+            rbp = _u64(ctx_sp + 48)
+            rsp = ctx_sp + 64
+            print("=== fiber slot %d (ctx_sp=0x%x) ===" % (i, ctx_sp))
+            gdb.execute("set $save_rsp = $rsp")
+            gdb.execute("set $save_rip = $rip")
+            gdb.execute("set $save_rbp = $rbp")
+            try:
+                gdb.execute("set $rip = 0x%x" % rip)
+                gdb.execute("set $rsp = 0x%x" % rsp)
+                gdb.execute("set $rbp = 0x%x" % rbp)
+                gdb.execute("bt 16")
+            except gdb.error as e:
+                print("  unwind failed: %s" % e)
+            finally:
+                gdb.execute("set $rip = $save_rip")
+                gdb.execute("set $rsp = $save_rsp")
+                gdb.execute("set $rbp = $save_rbp")
+            shown += 1
+        print("%d parked fiber(s) shown" % shown)
+
+
+FiberStacks()
+print("loaded: fiber_stacks [slot]")

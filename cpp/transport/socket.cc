@@ -138,6 +138,9 @@ int Socket::Create(const Options& opts, SocketId* id_out) {
   s->bytes_written.store(0, std::memory_order_relaxed);
   s->messages_read.store(0, std::memory_order_relaxed);
   s->read_state.store(0, std::memory_order_relaxed);
+  // Recycled slot: a stale close-after-flush from the previous connection
+  // would kill this one at its first write-chain drain.
+  s->close_after_flush_.store(false, std::memory_order_relaxed);
   s->read_buf.clear();
   s->waiters_.clear();
   if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
