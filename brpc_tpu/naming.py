@@ -1,0 +1,102 @@
+"""Service discovery for the Python tier over the native naming registry.
+
+The registry itself is native (cpp/cluster/remote_naming.h — the consul
+analog: versioned clusters, blocking Watch, TTL registrations); any brt
+server hosts it via ``rpc.Server.add_naming_registry()``. This module is
+the Python-side client, speaking the registry's JSON mapping over plain
+HTTP (the restful bridge, cpp/rpc/json.h) so no binary codec is needed:
+
+    reg = NamingClient("127.0.0.1:7000")
+    reg.register("ps", "127.0.0.1:7100", ttl_ms=10_000)   # + heartbeats
+    nodes, version = reg.list("ps")
+    nodes, version = reg.watch("ps", known_version=version, wait_ms=30_000)
+
+`RemoteEmbedding.from_registry` (ps_remote.py) builds the PS shard list
+from a cluster, ordered by registration tag "<shard>/<num_shards>".
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from typing import Optional
+
+
+class NamingClient:
+    def __init__(self, registry_addr: str, timeout_s: float = 35.0):
+        self.addr = registry_addr
+        self.timeout_s = timeout_s
+        self._heartbeats: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def _call(self, method: str, payload: dict,
+              timeout_s: Optional[float] = None) -> dict:
+        host, port = self.addr.rsplit(":", 1)
+        conn = http.client.HTTPConnection(
+            host, int(port), timeout=timeout_s or self.timeout_s)
+        try:
+            body = json.dumps(payload)
+            conn.request("POST", f"/Naming/{method}", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"Naming/{method} -> {resp.status}: {data!r}")
+            return json.loads(data)
+        finally:
+            conn.close()
+
+    def register(self, cluster: str, addr: str, weight: int = 1,
+                 tag: str = "", ttl_ms: int = 0,
+                 heartbeat: bool = True) -> int:
+        """Registers addr in cluster; with a TTL and heartbeat=True a
+        daemon thread renews at ttl/3 until close()."""
+        if self._stop.is_set():
+            raise RuntimeError("NamingClient is closed")
+        req = {"cluster": cluster, "addr": addr, "weight": weight}
+        if tag:
+            req["tag"] = tag
+        if ttl_ms > 0:
+            req["ttl_ms"] = ttl_ms
+        version = int(self._call("Register", req).get("version", 0))
+        if ttl_ms > 0 and heartbeat:
+            t = threading.Thread(
+                target=self._heartbeat_loop, args=(dict(req), ttl_ms / 3000.0),
+                daemon=True)
+            t.start()
+            self._heartbeats.append(t)
+        return version
+
+    def _heartbeat_loop(self, req: dict, period_s: float) -> None:
+        while not self._stop.wait(period_s):
+            try:
+                self._call("Register", req)
+            except Exception:  # noqa: BLE001 — registry outage: keep trying
+                pass
+
+    def deregister(self, cluster: str, addr: str) -> None:
+        self._call("Deregister", {"cluster": cluster, "addr": addr})
+
+    @staticmethod
+    def _nodes(resp: dict) -> list[dict]:
+        return resp.get("nodes", [])
+
+    def list(self, cluster: str) -> tuple[list[dict], int]:
+        resp = self._call("List", {"cluster": cluster})
+        return self._nodes(resp), int(resp.get("version", 0))
+
+    def watch(self, cluster: str, known_version: int = 0,
+              wait_ms: int = 30_000) -> tuple[list[dict], int]:
+        """Blocking query: returns when the cluster version passes
+        known_version (or wait_ms elapses)."""
+        resp = self._call(
+            "Watch",
+            {"cluster": cluster, "known_version": known_version,
+             "wait_ms": wait_ms},
+            timeout_s=wait_ms / 1000.0 + 5.0)
+        return self._nodes(resp), int(resp.get("version", 0))
+
+    def close(self) -> None:
+        self._stop.set()

@@ -210,6 +210,53 @@ class DevicePsShardServer:
 class RemoteEmbedding:
     """Client view of a sharded remote table (owner-routed access)."""
 
+    @classmethod
+    def from_registry(cls, registry_addr: str, cluster: str, vocab: int,
+                      dim: int, timeout_ms: int = 2000,
+                      wait_ms: int = 5000) -> "RemoteEmbedding":
+        """Resolves the shard list from the native naming registry
+        (brpc_tpu.naming): shards register with tag "<shard>/<num>", and
+        the watch blocks until a CONSISTENT full set is present (all
+        shards 0..num-1 with one num). Service discovery for the PS tier
+        — no static address list."""
+        from brpc_tpu.naming import NamingClient
+        reg = NamingClient(registry_addr)
+        import time
+        deadline = time.monotonic() + wait_ms / 1000.0
+        version = 0
+        groups: dict = {}
+        while True:
+            nodes, version = reg.watch(cluster, known_version=version,
+                                       wait_ms=1000)
+            # Group by the tag's "/num" so a stale entry from an old
+            # sharding cannot block a complete consistent new set.
+            groups = {}
+            for n in nodes:
+                tag = n.get("tag", "")
+                if "/" not in tag:
+                    continue
+                s_str, num_str = tag.split("/", 1)
+                try:
+                    sh, nm = int(s_str), int(num_str)
+                except ValueError:
+                    continue
+                shard_map = groups.setdefault(nm, {})
+                # Duplicate index within one sharding: a restarted shard's
+                # fresh registration supersedes a TTL-lingering stale one;
+                # the registry lists entries in registration order, so the
+                # LAST occurrence is the newest.
+                shard_map[sh] = n["addr"]
+            for num, shard_map in sorted(groups.items(), reverse=True):
+                if num > 0 and all(i in shard_map for i in range(num))                         and len(shard_map) == num:
+                    addrs = [shard_map[i] for i in range(num)]
+                    reg.close()
+                    return cls(addrs, vocab, dim, timeout_ms=timeout_ms)
+            if time.monotonic() > deadline:
+                reg.close()
+                raise TimeoutError(
+                    f"cluster '{cluster}' has no complete sharding: "
+                    f"{ {nm: sorted(m) for nm, m in groups.items()} }")
+
     def __init__(self, addresses: Sequence[str], vocab: int, dim: int,
                  timeout_ms: int = 2000):
         self.vocab = vocab

@@ -44,6 +44,7 @@ def _load():
     lib.brt_server_add_service.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, _HANDLER, ctypes.c_void_p]
     lib.brt_server_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.brt_server_add_naming_registry.argtypes = [ctypes.c_void_p]
     lib.brt_server_port.argtypes = [ctypes.c_void_p]
     lib.brt_server_stop.argtypes = [ctypes.c_void_p]
     lib.brt_server_destroy.argtypes = [ctypes.c_void_p]
@@ -169,6 +170,13 @@ class Server:
         if rc != 0:
             raise RuntimeError(f"add_async_service failed: {rc}")
         self._handlers.append(trampoline)
+
+    def add_naming_registry(self) -> None:
+        """Hosts the native service registry on this server ("Naming",
+        JSON-mapped — see brpc_tpu.naming for the client side)."""
+        rc = self._lib.brt_server_add_naming_registry(self._ptr)
+        if rc != 0:
+            raise RuntimeError(f"add_naming_registry failed: {rc}")
 
     def start(self, addr: str = "127.0.0.1:0") -> int:
         rc = self._lib.brt_server_start(self._ptr, addr.encode())

@@ -6,6 +6,7 @@
 #include <string>
 
 #include "cluster/cluster_channel.h"
+#include "cluster/remote_naming.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
 #include "rpc/channel.h"
@@ -41,6 +42,7 @@ class CService : public Service {
 struct CServer {
   Server server;
   std::vector<std::unique_ptr<CService>> services;
+  std::unique_ptr<NamingRegistryService> naming;
 };
 
 struct CChannel {
@@ -66,6 +68,22 @@ int brt_server_add_service(void* server, const char* name,
 
 int brt_server_start(void* server, const char* addr) {
   return static_cast<CServer*>(server)->server.Start(std::string(addr));
+}
+
+int brt_server_add_naming_registry(void* server) {
+  // Hosts the in-framework service registry (cluster/remote_naming.h) on
+  // this server under "Naming", JSON-mapped so HTTP+JSON clients (the
+  // Python tier) can Register/Watch with no binary codec.
+  auto* s = static_cast<CServer*>(server);
+  if (s->naming != nullptr) return EEXIST;
+  s->naming = std::make_unique<NamingRegistryService>();
+  const int rc = s->server.AddService(s->naming.get(), "Naming");
+  if (rc != 0) {
+    s->naming.reset();
+    return rc;
+  }
+  NamingRegistryService::MapJsonMethods(&s->server);
+  return 0;
 }
 
 int brt_server_port(void* server) {

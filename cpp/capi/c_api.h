@@ -20,6 +20,9 @@ typedef void (*brt_service_handler)(void* user, const char* method,
                                     void* session);
 
 void* brt_server_new(void);
+// Hosts the in-framework naming registry on this server ("Naming"
+// service, JSON-mapped). 0 on success.
+int brt_server_add_naming_registry(void* server);
 int brt_server_add_service(void* server, const char* name,
                            brt_service_handler handler, void* user);
 // addr: "ip:port" (port 0 = ephemeral). Returns 0 on success.

@@ -1,0 +1,112 @@
+"""Python-tier service discovery over the native naming registry: a brt
+server hosts the registry (C API), shards register with TTL heartbeats,
+and RemoteEmbedding resolves its shard list from the cluster — no static
+addresses (cpp/cluster/remote_naming.h through the JSON bridge)."""
+
+import threading
+import time
+
+import numpy as np
+
+from brpc_tpu import rpc
+from brpc_tpu.naming import NamingClient
+from brpc_tpu.ps_remote import PsShardServer, RemoteEmbedding
+
+VOCAB, DIM = 32, 8
+
+
+def test_registry_register_list_watch():
+    reg_server = rpc.Server()
+    reg_server.add_naming_registry()
+    port = reg_server.start("127.0.0.1:0")
+    reg = NamingClient(f"127.0.0.1:{port}")
+
+    v = reg.register("c1", "10.0.0.1:100", heartbeat=False)
+    assert v >= 1
+    nodes, version = reg.list("c1")
+    assert [n["addr"] for n in nodes] == ["10.0.0.1:100"]
+
+    # Watch blocks until a later registration bumps the version.
+    t0 = time.monotonic()
+    result = {}
+
+    def registrar():
+        time.sleep(0.3)
+        reg2 = NamingClient(f"127.0.0.1:{port}")
+        reg2.register("c1", "10.0.0.2:100", heartbeat=False)
+        result["registered_at"] = time.monotonic()
+
+    th = threading.Thread(target=registrar)
+    th.start()
+    nodes, version2 = reg.watch("c1", known_version=version, wait_ms=5000)
+    blocked_s = time.monotonic() - t0
+    th.join()
+    assert version2 > version
+    assert len(nodes) == 2
+    assert blocked_s >= 0.25, f"watch returned too early ({blocked_s}s)"
+
+    # TTL lapse without heartbeat drops the node.
+    reg.register("c2", "10.0.0.3:1", ttl_ms=400, heartbeat=False)
+    time.sleep(0.8)
+    nodes, _ = reg.list("c2")
+    assert nodes == []
+
+    # With heartbeats the entry survives several TTL windows.
+    reg.register("c3", "10.0.0.4:1", ttl_ms=400, heartbeat=True)
+    time.sleep(1.2)
+    nodes, _ = reg.list("c3")
+    assert [n["addr"] for n in nodes] == ["10.0.0.4:1"]
+    reg.close()
+    reg_server.stop()
+
+
+def test_remote_embedding_from_registry():
+    reg_server = rpc.Server()
+    reg_server.add_naming_registry()
+    port = reg_server.start("127.0.0.1:0")
+    registry = f"127.0.0.1:{port}"
+
+    shards = [PsShardServer(VOCAB, DIM, s, 2, lr=0.5) for s in range(2)]
+    reg = NamingClient(registry)
+    for s_idx, s in enumerate(shards):
+        reg.register("ps", s.address, tag=f"{s_idx}/2", ttl_ms=5000)
+
+    emb = RemoteEmbedding.from_registry(registry, "ps", VOCAB, DIM)
+    assert emb.n == 2
+
+    # Owner routing works across the discovered shards; training converges.
+    ids = np.array([1, 5, 17, 29], np.int32)
+    target = np.zeros((4, DIM), np.float32)
+    rows = emb.lookup(ids)
+    assert rows.shape == (4, DIM)
+    np.testing.assert_allclose(rows[0], shards[0].table[1], rtol=1e-6)
+    np.testing.assert_allclose(rows[2], shards[1].table[1], rtol=1e-6)
+    first = float(((rows - target) ** 2).mean())
+    for _ in range(5):
+        rows = emb.lookup(ids)
+        emb.apply_gradients(ids, rows - target)
+    final = float(((emb.lookup(ids) - target) ** 2).mean())
+    assert final < first
+
+    emb.close()
+    reg.close()
+    for s in shards:
+        s.close()
+    reg_server.stop()
+
+
+def test_from_registry_times_out_on_incomplete_cluster():
+    reg_server = rpc.Server()
+    reg_server.add_naming_registry()
+    port = reg_server.start("127.0.0.1:0")
+    registry = f"127.0.0.1:{port}"
+    reg = NamingClient(registry)
+    reg.register("partial", "10.0.0.9:1", tag="0/2", heartbeat=False)
+    try:
+        RemoteEmbedding.from_registry(registry, "partial", VOCAB, DIM,
+                                      wait_ms=800)
+        raise AssertionError("expected TimeoutError")
+    except TimeoutError:
+        pass
+    reg.close()
+    reg_server.stop()
