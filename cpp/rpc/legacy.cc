@@ -7,6 +7,7 @@
 
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
+#include "rpc/pipelined_client.h"
 #include "rpc/server.h"
 #include "transport/input_messenger.h"
 #include "transport/socket.h"
@@ -141,156 +142,18 @@ void EspProcess(IOBuf&& msg, SocketId sid) {
 }
 
 // ---------------------------------------------------------------------------
-// Shared pipelined sync client core (wire-order FIFO matching, the redis
-// client's pattern).
+// Clients: thin wrappers over PipelinedClient (rpc/pipelined_client.h).
 // ---------------------------------------------------------------------------
 
-struct FramedClientCore {
-  SocketId sock = INVALID_SOCKET_ID;
-  IOPortal inbuf;
-  std::mutex mu;
-  struct Waiter {
-    IOBuf* body = nullptr;
-    void* rhead = nullptr;  // optional out-head (protocol-sized)
-    CountdownEvent ev{1};
-    int rc = 0;
-  };
-  std::deque<Waiter*> waiters;
-  int64_t timeout_us = 1000000;
-  // Cuts one response frame: fills *head_bytes (head_size) + *body.
-  // Returns 0, EAGAIN (need more), or an errno (desync).
-  int (*cut)(IOPortal* in, void* head_bytes, IOBuf* body) = nullptr;
-  size_t head_size = 0;
-
-  static void* OnData(Socket* s);
-  void Fail(int err);
-  int Call(const void* head_bytes, size_t head_sz_unused, IOBuf&& frame,
-           IOBuf* response_body, void* rhead);
+struct NsheadReply {
+  NsheadHead head;
+  IOBuf body;
 };
 
-void* FramedClientCore::OnData(Socket* s) {
-  auto* c = static_cast<FramedClientCore*>(s->user());
-  for (;;) {
-    ssize_t nr = c->inbuf.append_from_fd(s->fd());
-    if (nr == 0) {
-      s->SetFailed(ECONNRESET, "legacy server closed");
-      c->Fail(ECONNRESET);
-      return nullptr;
-    }
-    if (nr < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-      if (errno == EINTR) continue;
-      s->SetFailed(errno, "legacy read failed");
-      c->Fail(errno);
-      return nullptr;
-    }
-  }
-  for (;;) {
-    int rc;
-    {
-      std::lock_guard<std::mutex> g(c->mu);
-      if (c->waiters.empty()) break;
-      char head[64];
-      IOBuf body;
-      rc = c->cut(&c->inbuf, head, &body);
-      if (rc == EAGAIN) break;
-      Waiter* w = c->waiters.front();
-      c->waiters.pop_front();
-      if (rc == 0) {
-        if (w->rhead != nullptr) memcpy(w->rhead, head, c->head_size);
-        *w->body = std::move(body);
-      } else {
-        w->rc = rc;
-      }
-      w->ev.signal();
-    }
-    if (rc != 0) {
-      s->SetFailed(rc, "legacy reply desynchronized");
-      c->Fail(rc);
-      return nullptr;
-    }
-  }
-  return nullptr;
-}
-
-void FramedClientCore::Fail(int err) {
-  std::lock_guard<std::mutex> g(mu);
-  while (!waiters.empty()) {
-    Waiter* w = waiters.front();
-    waiters.pop_front();
-    w->rc = err;
-    w->ev.signal();
-  }
-}
-
-int FramedClientCore::Call(const void*, size_t, IOBuf&& frame,
-                           IOBuf* response_body, void* rhead) {
-  SocketUniquePtr p;
-  if (Socket::Address(sock, &p) != 0 || p->Failed()) return ECONNRESET;
-  Waiter waiter;
-  waiter.body = response_body;
-  waiter.rhead = rhead;
-  {
-    // Enqueue order must equal wire order (see RedisClient).
-    std::lock_guard<std::mutex> g(mu);
-    waiters.push_back(&waiter);
-    p->Write(&frame);
-  }
-  if (waiter.ev.wait(timeout_us) != 0) {
-    p->SetFailed(ETIMEDOUT, "legacy reply timeout");
-    Fail(ETIMEDOUT);
-    waiter.ev.wait(-1);
-    return ETIMEDOUT;
-  }
-  return waiter.rc;
-}
-
-int ConnectCore(FramedClientCore* c, const EndPoint& server,
-                int64_t timeout_ms) {
-  fiber_init(0);
-  c->timeout_us = timeout_ms * 1000;
-  Socket::Options opts;
-  opts.user = c;
-  opts.on_edge_triggered = FramedClientCore::OnData;
-  return Socket::Connect(server, opts, &c->sock, c->timeout_us);
-}
-
-void CloseCore(FramedClientCore* c) {
-  if (c->sock == INVALID_SOCKET_ID) return;
-  SocketUniquePtr p;
-  if (Socket::Address(c->sock, &p) == 0) {
-    p->SetFailed(ECANCELED, "client closed");
-  }
-}
-
-int CutNshead(IOPortal* in, void* head_bytes, IOBuf* body) {
-  if (in->size() < sizeof(NsheadHead)) return EAGAIN;
-  NsheadHead head;
-  in->copy_to(&head, sizeof(head));
-  if (head.magic_num != 0xfb709394 || head.body_len > kMaxLegacyBody) {
-    return EBADMSG;
-  }
-  if (in->size() < sizeof(head) + head.body_len) return EAGAIN;
-  in->pop_front(sizeof(head));
-  in->cutn(body, head.body_len);
-  memcpy(head_bytes, &head, sizeof(head));
-  return 0;
-}
-
-int CutEsp(IOPortal* in, void* head_bytes, IOBuf* body) {
-  if (in->size() < sizeof(EspHead)) return EAGAIN;
+struct EspReply {
   EspHead head;
-  in->copy_to(&head, sizeof(head));
-  if ((head.msg >> 24) != 0xE5 || head.body_len < 0 ||
-      uint32_t(head.body_len) > kMaxLegacyBody) {
-    return EBADMSG;
-  }
-  if (in->size() < sizeof(head) + size_t(head.body_len)) return EAGAIN;
-  in->pop_front(sizeof(head));
-  in->cutn(body, size_t(head.body_len));
-  memcpy(head_bytes, &head, sizeof(head));
-  return 0;
-}
+  IOBuf body;
+};
 
 }  // namespace
 
@@ -330,52 +193,77 @@ void ServeEspOn(Server* server, EspService* service) {
   });
 }
 
-// ---------------------------------------------------------------------------
-// Clients
-// ---------------------------------------------------------------------------
-
-struct NsheadClient::Impl {
-  FramedClientCore core;
+struct NsheadClient::Impl
+    : PipelinedClient<NsheadClient::Impl, NsheadReply> {
+  using PipelinedClient::CallFrame;
+  int CutReply(IOPortal* in, NsheadReply* out) {
+    if (in->size() < sizeof(NsheadHead)) return EAGAIN;
+    in->copy_to(&out->head, sizeof(out->head));
+    if (out->head.magic_num != 0xfb709394 ||
+        out->head.body_len > kMaxLegacyBody) {
+      return EBADMSG;
+    }
+    if (in->size() < sizeof(out->head) + out->head.body_len) return EAGAIN;
+    in->pop_front(sizeof(out->head));
+    in->cutn(&out->body, out->head.body_len);
+    return 0;
+  }
 };
 
-NsheadClient::NsheadClient() : impl_(new Impl) {
-  impl_->core.cut = CutNshead;
-  impl_->core.head_size = sizeof(NsheadHead);
-}
-NsheadClient::~NsheadClient() { CloseCore(&impl_->core); }
+NsheadClient::NsheadClient() : impl_(new Impl) {}
+NsheadClient::~NsheadClient() = default;
 
 int NsheadClient::Init(const EndPoint& server, int64_t timeout_ms) {
-  return ConnectCore(&impl_->core, server, timeout_ms);
+  return impl_->Connect(server, timeout_ms);
 }
 
 int NsheadClient::Call(const NsheadHead& head, const IOBuf& body,
                        IOBuf* response_body, NsheadHead* rhead) {
   IOBuf frame;
   AppendNshead(&frame, head, body);
-  return impl_->core.Call(nullptr, 0, std::move(frame), response_body,
-                          rhead);
+  NsheadReply reply;
+  const int rc = impl_->CallFrame(std::move(frame), 0, &reply);
+  if (rc != 0) return rc;
+  if (rhead != nullptr) *rhead = reply.head;
+  *response_body = std::move(reply.body);
+  return 0;
 }
 
-struct EspClient::Impl {
-  FramedClientCore core;
+struct EspClient::Impl : PipelinedClient<EspClient::Impl, EspReply> {
+  using PipelinedClient::CallFrame;
+  int CutReply(IOPortal* in, EspReply* out) {
+    if (in->size() < sizeof(EspHead)) return EAGAIN;
+    in->copy_to(&out->head, sizeof(out->head));
+    if ((out->head.msg >> 24) != 0xE5 || out->head.body_len < 0 ||
+        uint32_t(out->head.body_len) > kMaxLegacyBody) {
+      return EBADMSG;
+    }
+    if (in->size() < sizeof(out->head) + size_t(out->head.body_len)) {
+      return EAGAIN;
+    }
+    in->pop_front(sizeof(out->head));
+    in->cutn(&out->body, size_t(out->head.body_len));
+    return 0;
+  }
 };
 
-EspClient::EspClient() : impl_(new Impl) {
-  impl_->core.cut = CutEsp;
-  impl_->core.head_size = sizeof(EspHead);
-}
-EspClient::~EspClient() { CloseCore(&impl_->core); }
+EspClient::EspClient() : impl_(new Impl) {}
+EspClient::~EspClient() = default;
 
 int EspClient::Init(const EndPoint& server, int64_t timeout_ms) {
-  return ConnectCore(&impl_->core, server, timeout_ms);
+  return impl_->Connect(server, timeout_ms);
 }
 
 int EspClient::Call(const EspHead& head, const IOBuf& body,
                     IOBuf* response_body, EspHead* rhead) {
   IOBuf frame;
   AppendEsp(&frame, head, body);
-  return impl_->core.Call(nullptr, 0, std::move(frame), response_body,
-                          rhead);
+  EspReply reply;
+  const int rc = impl_->CallFrame(std::move(frame), 0, &reply);
+  if (rc != 0) return rc;
+  if (rhead != nullptr) *rhead = reply.head;
+  *response_body = std::move(reply.body);
+  return 0;
 }
 
 }  // namespace brt

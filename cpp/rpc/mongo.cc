@@ -12,6 +12,7 @@
 #include "rpc/bson.h"
 #include "rpc/server.h"
 #include "transport/input_messenger.h"
+#include "rpc/pipelined_client.h"
 #include "transport/socket.h"
 
 namespace brt {
@@ -252,140 +253,66 @@ void ServeMongoOn(Server* server, MongoService* service) {
 }
 
 // ---------------------------------------------------------------------------
-// Client
+// Client (PipelinedClient with response_to matching)
 // ---------------------------------------------------------------------------
 
-struct MongoClient::Impl {
-  SocketId sock = INVALID_SOCKET_ID;
-  IOPortal inbuf;
-  std::mutex mu;
-  struct Waiter {
-    int32_t request_id = 0;
-    JsonValue* reply = nullptr;
-    CountdownEvent ev{1};
-    int rc = 0;
-  };
-  std::deque<Waiter*> waiters;  // matched by response_to
-  int64_t timeout_us = 1000000;
-  std::atomic<int32_t> next_id{1};
+namespace {
 
-  static void* OnData(Socket* s);
-  void Fail(int err);
+struct MongoReply {
+  MsgHeader h;
+  JsonValue doc;
+  bool decode_ok = false;  // framing was intact but BSON failed
 };
 
-void* MongoClient::Impl::OnData(Socket* s) {
-  auto* impl = static_cast<MongoClient::Impl*>(s->user());
-  for (;;) {
-    ssize_t nr = impl->inbuf.append_from_fd(s->fd());
-    if (nr == 0) {
-      s->SetFailed(ECONNRESET, "mongo server closed");
-      impl->Fail(ECONNRESET);
-      return nullptr;
-    }
-    if (nr < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-      if (errno == EINTR) continue;
-      s->SetFailed(errno, "mongo read failed");
-      impl->Fail(errno);
-      return nullptr;
-    }
-  }
-  for (;;) {
-    IOBuf frame;
-    {
-      std::lock_guard<std::mutex> g(impl->mu);
-      if (impl->inbuf.size() < sizeof(MsgHeader)) break;
-      MsgHeader h;
-      impl->inbuf.copy_to(&h, sizeof(h));
-      if (h.op_code != kOpMsg ||
-          h.message_length < int32_t(sizeof(MsgHeader) + 5) ||
-          uint32_t(h.message_length) > kMaxMongoMessage) {
-        s->SetFailed(EBADMSG, "mongo reply desynchronized");
-        impl->Fail(EBADMSG);
-        return nullptr;
-      }
-      if (impl->inbuf.size() < size_t(h.message_length)) break;
-      impl->inbuf.cutn(&frame, size_t(h.message_length));
-      MsgHeader fh;
-      JsonValue doc;
-      uint32_t rflags = 0;
-      std::string err;
-      const bool ok = DecodeOpMsg(frame, &fh, &doc, &rflags, &err);
-      Waiter* hit = nullptr;
-      for (auto it = impl->waiters.begin(); it != impl->waiters.end();
-           ++it) {
-        if ((*it)->request_id == fh.response_to) {
-          hit = *it;
-          impl->waiters.erase(it);
-          break;
-        }
-      }
-      if (hit != nullptr) {
-        if (ok) {
-          *hit->reply = std::move(doc);
-        } else {
-          hit->rc = EBADMSG;
-        }
-        hit->ev.signal();
-      }
-      // Unmatched replies (e.g. moreToCome exhaust) are dropped.
-      continue;
-    }
-  }
-  return nullptr;
-}
+}  // namespace
 
-void MongoClient::Impl::Fail(int err) {
-  std::lock_guard<std::mutex> g(mu);
-  while (!waiters.empty()) {
-    Waiter* w = waiters.front();
-    waiters.pop_front();
-    w->rc = err;
-    w->ev.signal();
+struct MongoClient::Impl
+    : PipelinedClient<MongoClient::Impl, MongoReply, /*MatchByKey=*/true> {
+  using PipelinedClient::CallFrame;
+  std::atomic<int32_t> next_id{1};
+
+  int CutReply(IOPortal* in, MongoReply* out) {
+    if (in->size() < sizeof(MsgHeader)) return EAGAIN;
+    MsgHeader h;
+    in->copy_to(&h, sizeof(h));
+    if (h.op_code != kOpMsg ||
+        h.message_length < int32_t(sizeof(MsgHeader) + 5) ||
+        uint32_t(h.message_length) > kMaxMongoMessage) {
+      return EBADMSG;  // desync: the cursor cannot be trusted
+    }
+    if (in->size() < size_t(h.message_length)) return EAGAIN;
+    IOBuf frame;
+    in->cutn(&frame, size_t(h.message_length));
+    uint32_t rflags = 0;
+    std::string err;
+    out->decode_ok = DecodeOpMsg(frame, &out->h, &out->doc, &rflags, &err);
+    if (!out->decode_ok) out->h = h;  // keep response_to for matching
+    return 0;
   }
-}
+
+  uint64_t ReplyKey(const MongoReply& r) {
+    return uint64_t(uint32_t(r.h.response_to));
+  }
+};
 
 MongoClient::MongoClient() : impl_(new Impl) {}
-
-MongoClient::~MongoClient() {
-  if (impl_->sock == INVALID_SOCKET_ID) return;
-  SocketUniquePtr p;
-  if (Socket::Address(impl_->sock, &p) == 0) {
-    p->SetFailed(ECANCELED, "client closed");
-  }
-}
+MongoClient::~MongoClient() = default;
 
 int MongoClient::Init(const EndPoint& server, int64_t timeout_ms) {
-  fiber_init(0);
-  impl_->timeout_us = timeout_ms * 1000;
-  Socket::Options opts;
-  opts.user = impl_.get();
-  opts.on_edge_triggered = Impl::OnData;
-  return Socket::Connect(server, opts, &impl_->sock, impl_->timeout_us);
+  return impl_->Connect(server, timeout_ms);
 }
 
 int MongoClient::RunCommand(const JsonValue& cmd, JsonValue* reply) {
-  SocketUniquePtr p;
-  if (Socket::Address(impl_->sock, &p) != 0 || p->Failed()) {
-    return ECONNRESET;
-  }
-  Impl::Waiter waiter;
-  waiter.request_id = impl_->next_id.fetch_add(1);
-  waiter.reply = reply;
+  const int32_t id = impl_->next_id.fetch_add(1);
   IOBuf frame;
-  if (!AppendOpMsg(&frame, waiter.request_id, 0, cmd)) return EINVAL;
-  {
-    std::lock_guard<std::mutex> g(impl_->mu);
-    impl_->waiters.push_back(&waiter);
-    p->Write(&frame);
-  }
-  if (waiter.ev.wait(impl_->timeout_us) != 0) {
-    p->SetFailed(ETIMEDOUT, "mongo reply timeout");
-    impl_->Fail(ETIMEDOUT);
-    waiter.ev.wait(-1);
-    return ETIMEDOUT;
-  }
-  return waiter.rc;
+  if (!AppendOpMsg(&frame, id, 0, cmd)) return EINVAL;
+  MongoReply r;
+  const int rc = impl_->CallFrame(std::move(frame),
+                                  uint64_t(uint32_t(id)), &r);
+  if (rc != 0) return rc;
+  if (!r.decode_ok) return EBADMSG;
+  *reply = std::move(r.doc);
+  return 0;
 }
 
 }  // namespace brt

@@ -1,0 +1,168 @@
+// Shared scaffolding for blocking clients of pipelined foreign protocols
+// (redis, nshead, esp, mongo): one connection, a waiter registry, the
+// read-to-EAGAIN + cut loop, desync teardown, and the timeout/drain
+// dance. Before this header the same ~120 lines existed three times
+// (redis.cc, legacy.cc, mongo.cc) and fixes had to be applied to each.
+//
+// CRTP: Derived provides
+//   int CutReply(IOPortal* in, Reply* out);
+//     -> 0 cut one reply, EAGAIN need more bytes, errno = desync (the
+//        connection fails and every waiter drains with that error).
+//   uint64_t ReplyKey(const Reply&);   // only when MatchByKey
+// and calls CallFrame() to issue requests. Matching is FIFO (wire order)
+// unless MatchByKey — then replies resolve the waiter whose key matches,
+// and unmatched replies are dropped (mongo moreToCome exhaust frames).
+#pragma once
+
+#include <deque>
+
+#include <mutex>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+template <typename Derived, typename Reply, bool MatchByKey = false>
+class PipelinedClient {
+ public:
+  ~PipelinedClient() { Shutdown(); }
+
+  int Connect(const EndPoint& server, int64_t timeout_ms) {
+    fiber_init(0);
+    timeout_us_ = timeout_ms * 1000;
+    Socket::Options opts;
+    opts.user = this;
+    opts.on_edge_triggered = &PipelinedClient::OnData;
+    return Socket::Connect(server, opts, &sock_, timeout_us_);
+  }
+
+  void Shutdown(const char* why = "client closed") {
+    if (sock_ == INVALID_SOCKET_ID) return;
+    SocketUniquePtr p;
+    if (Socket::Address(sock_, &p) == 0) p->SetFailed(ECANCELED, "%s", why);
+    sock_ = INVALID_SOCKET_ID;
+  }
+
+  bool connected() const {
+    SocketUniquePtr p;
+    return sock_ != INVALID_SOCKET_ID && Socket::Address(sock_, &p) == 0 &&
+           !p->Failed();
+  }
+
+ protected:
+  // Issues one framed request; parks until its reply (FIFO order, or the
+  // reply whose ReplyKey == key). Returns 0 with *out filled, or errno.
+  int CallFrame(IOBuf&& frame, uint64_t key, Reply* out) {
+    SocketUniquePtr p;
+    if (Socket::Address(sock_, &p) != 0 || p->Failed()) return ECONNRESET;
+    Waiter waiter;
+    waiter.key = key;
+    waiter.out = out;
+    {
+      // Enqueue order must equal wire order: with concurrent callers a
+      // reply would otherwise resolve the wrong FIFO waiter.
+      std::lock_guard<std::mutex> g(mu_);
+      waiters_.push_back(&waiter);
+      p->Write(&frame);
+    }
+    if (waiter.ev.wait(timeout_us_) != 0) {
+      // Timed out: the waiter must not dangle — fail the connection,
+      // which drains the FIFO (including us) before we return.
+      p->SetFailed(ETIMEDOUT, "pipelined reply timeout");
+      FailAll(ETIMEDOUT);
+      waiter.ev.wait(-1);
+      return ETIMEDOUT;
+    }
+    return waiter.rc;
+  }
+
+  void FailAll(int err) {
+    std::lock_guard<std::mutex> g(mu_);
+    while (!waiters_.empty()) {
+      Waiter* w = waiters_.front();
+      waiters_.pop_front();
+      w->rc = err;
+      w->ev.signal();
+    }
+  }
+
+ private:
+  struct Waiter {
+    CountdownEvent ev{1};
+    int rc = 0;
+    uint64_t key = 0;
+    Reply* out = nullptr;
+  };
+
+  static void* OnData(Socket* s) {
+    auto* self = static_cast<PipelinedClient*>(s->user());
+    for (;;) {
+      ssize_t nr = self->inbuf_.append_from_fd(s->fd());
+      if (nr == 0) {
+        s->SetFailed(ECONNRESET, "pipelined server closed");
+        self->FailAll(ECONNRESET);
+        return nullptr;
+      }
+      if (nr < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        s->SetFailed(errno, "pipelined read failed");
+        self->FailAll(errno);
+        return nullptr;
+      }
+    }
+    for (;;) {
+      int rc;
+      {
+        std::lock_guard<std::mutex> g(self->mu_);
+        if constexpr (!MatchByKey) {
+          if (self->waiters_.empty()) break;
+        }
+        Reply reply;
+        rc = static_cast<Derived*>(self)->CutReply(&self->inbuf_, &reply);
+        if (rc == EAGAIN) break;
+        if (rc == 0) {
+          Waiter* hit = nullptr;
+          if constexpr (MatchByKey) {
+            const uint64_t key =
+                static_cast<Derived*>(self)->ReplyKey(reply);
+            for (auto it = self->waiters_.begin();
+                 it != self->waiters_.end(); ++it) {
+              if ((*it)->key == key) {
+                hit = *it;
+                self->waiters_.erase(it);
+                break;
+              }
+            }
+            // No waiter: an unsolicited reply (exhaust frame) — drop.
+          } else {
+            hit = self->waiters_.front();
+            self->waiters_.pop_front();
+          }
+          if (hit != nullptr) {
+            *hit->out = std::move(reply);
+            hit->ev.signal();
+          }
+          continue;
+        }
+      }
+      // Desync: the cursor cannot be trusted for any later reply.
+      s->SetFailed(rc, "pipelined reply desynchronized");
+      self->FailAll(rc);
+      return nullptr;
+    }
+    return nullptr;
+  }
+
+  SocketId sock_ = INVALID_SOCKET_ID;
+  IOPortal inbuf_;
+  std::mutex mu_;
+  std::deque<Waiter*> waiters_;
+  int64_t timeout_us_ = 1000000;
+};
+
+}  // namespace brt

@@ -9,6 +9,7 @@
 #include "fiber/sync.h"
 #include "rpc/server.h"
 #include "transport/input_messenger.h"
+#include "rpc/pipelined_client.h"
 #include "transport/socket.h"
 
 namespace brt {
@@ -268,90 +269,21 @@ void ServeRedisOn(Server* server, RedisService* service) {
 }
 
 // ---------------------------------------------------------------------------
-// Pipelined client
+// Pipelined client (shared PipelinedClient scaffolding, FIFO matching)
 // ---------------------------------------------------------------------------
 
-struct RedisClient::Impl {
-  SocketId sock = INVALID_SOCKET_ID;
-  std::mutex mu;
-  IOPortal inbuf;
-  struct Waiter {
-    RedisReply* out;
-    CountdownEvent ev{1};
-    int rc = 0;
-  };
-  std::deque<Waiter*> waiters;  // FIFO matching
-  int64_t timeout_us = 1000000;
+struct RedisClient::Impl
+    : PipelinedClient<RedisClient::Impl, RedisReply> {
+  using PipelinedClient::CallFrame;
 
-  static void* OnData(Socket* s);
-  void Fail(int err);
+  int CutReply(IOPortal* in, RedisReply* out) {
+    return out->ParseFrom(in);
+  }
 };
-
-void* RedisClient::Impl::OnData(Socket* s) {
-  auto* impl = static_cast<RedisClient::Impl*>(s->user());
-  for (;;) {
-    ssize_t nr = impl->inbuf.append_from_fd(s->fd());
-    if (nr == 0) {
-      s->SetFailed(ECONNRESET, "redis server closed");
-      impl->Fail(ECONNRESET);
-      return nullptr;
-    }
-    if (nr < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-      if (errno == EINTR) continue;
-      s->SetFailed(errno, "redis read failed");
-      impl->Fail(errno);
-      return nullptr;
-    }
-  }
-  for (;;) {
-    RedisReply reply;
-    int rc;
-    {
-      std::lock_guard<std::mutex> g(impl->mu);
-      if (impl->waiters.empty()) break;
-      rc = reply.ParseFrom(&impl->inbuf);
-      if (rc == EAGAIN) break;
-      Impl::Waiter* w = impl->waiters.front();
-      impl->waiters.pop_front();
-      if (rc == 0) {
-        *w->out = std::move(reply);
-      } else {
-        w->rc = rc;
-      }
-      w->ev.signal();
-    }
-    if (rc != 0) {
-      // Malformed frame: the cursor may be desynchronized from the stream —
-      // no later reply can be trusted. Fail the connection and drain waiters.
-      s->SetFailed(rc, "redis reply desynchronized");
-      impl->Fail(rc);
-      return nullptr;
-    }
-  }
-  return nullptr;
-}
-
-void RedisClient::Impl::Fail(int err) {
-  std::lock_guard<std::mutex> g(mu);
-  while (!waiters.empty()) {
-    Waiter* w = waiters.front();
-    waiters.pop_front();
-    w->rc = err;
-    w->ev.signal();
-  }
-}
 
 RedisClient::RedisClient() : impl_(new Impl) {}
 
-RedisClient::~RedisClient() {
-  if (impl_->sock != INVALID_SOCKET_ID) {
-    SocketUniquePtr p;
-    if (Socket::Address(impl_->sock, &p) == 0) {
-      p->SetFailed(ECANCELED, "client closed");
-    }
-  }
-}
+RedisClient::~RedisClient() = default;
 
 int RedisClient::Init(const std::string& addr, int64_t timeout_ms) {
   EndPoint ep;
@@ -360,45 +292,19 @@ int RedisClient::Init(const std::string& addr, int64_t timeout_ms) {
 }
 
 int RedisClient::Init(const EndPoint& server, int64_t timeout_ms) {
-  fiber_init(0);
-  impl_->timeout_us = timeout_ms * 1000;
-  Socket::Options opts;
-  opts.user = impl_.get();
-  opts.on_edge_triggered = Impl::OnData;
-  return Socket::Connect(server, opts, &impl_->sock, impl_->timeout_us);
+  return impl_->Connect(server, timeout_ms);
 }
 
 RedisReply RedisClient::Command(const std::vector<std::string>& args) {
-  SocketUniquePtr p;
-  if (Socket::Address(impl_->sock, &p) != 0 || p->Failed()) {
-    return RedisReply::Error("connection lost");
-  }
   IOBuf cmd;
   cmd.append("*" + std::to_string(args.size()) + "\r\n");
   for (const std::string& a : args) {
     cmd.append("$" + std::to_string(a.size()) + "\r\n" + a + "\r\n");
   }
   RedisReply reply;
-  Impl::Waiter waiter;
-  waiter.out = &reply;
-  {
-    // Write under the same lock that orders the waiter FIFO: with concurrent
-    // callers, enqueue order must equal wire order or replies are delivered
-    // to the wrong caller. Socket::Write is wait-free, so the critical
-    // section stays short.
-    std::lock_guard<std::mutex> g(impl_->mu);
-    impl_->waiters.push_back(&waiter);
-    p->Write(&cmd);
-  }
-  if (waiter.ev.wait(impl_->timeout_us) != 0) {
-    // Timed out: the waiter must not dangle — fail the connection, which
-    // drains the FIFO (including us) before we return.
-    p->SetFailed(ETIMEDOUT, "redis reply timeout");
-    impl_->Fail(ETIMEDOUT);
-    waiter.ev.wait(-1);
-    return RedisReply::Error("timeout");
-  }
-  if (waiter.rc != 0) return RedisReply::Error("io error");
+  const int rc = impl_->CallFrame(std::move(cmd), 0, &reply);
+  if (rc == ETIMEDOUT) return RedisReply::Error("timeout");
+  if (rc != 0) return RedisReply::Error("io error");
   return reply;
 }
 
