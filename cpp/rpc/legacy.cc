@@ -196,7 +196,7 @@ void ServeEspOn(Server* server, EspService* service) {
 struct NsheadClient::Impl
     : PipelinedClient<NsheadClient::Impl, NsheadReply> {
   using PipelinedClient::CallFrame;
-  int CutReply(IOPortal* in, NsheadReply* out) {
+  static int CutReply(IOPortal* in, NsheadReply* out) {
     if (in->size() < sizeof(NsheadHead)) return EAGAIN;
     in->copy_to(&out->head, sizeof(out->head));
     if (out->head.magic_num != 0xfb709394 ||
@@ -231,7 +231,7 @@ int NsheadClient::Call(const NsheadHead& head, const IOBuf& body,
 
 struct EspClient::Impl : PipelinedClient<EspClient::Impl, EspReply> {
   using PipelinedClient::CallFrame;
-  int CutReply(IOPortal* in, EspReply* out) {
+  static int CutReply(IOPortal* in, EspReply* out) {
     if (in->size() < sizeof(EspHead)) return EAGAIN;
     in->copy_to(&out->head, sizeof(out->head));
     if ((out->head.msg >> 24) != 0xE5 || out->head.body_len < 0 ||

@@ -271,7 +271,7 @@ struct MongoClient::Impl
   using PipelinedClient::CallFrame;
   std::atomic<int32_t> next_id{1};
 
-  int CutReply(IOPortal* in, MongoReply* out) {
+  static int CutReply(IOPortal* in, MongoReply* out) {
     if (in->size() < sizeof(MsgHeader)) return EAGAIN;
     MsgHeader h;
     in->copy_to(&h, sizeof(h));
@@ -290,7 +290,7 @@ struct MongoClient::Impl
     return 0;
   }
 
-  uint64_t ReplyKey(const MongoReply& r) {
+  static uint64_t ReplyKey(const MongoReply& r) {
     return uint64_t(uint32_t(r.h.response_to));
   }
 };

@@ -4,18 +4,26 @@
 // dance. Before this header the same ~120 lines existed three times
 // (redis.cc, legacy.cc, mongo.cc) and fixes had to be applied to each.
 //
-// CRTP: Derived provides
-//   int CutReply(IOPortal* in, Reply* out);
+// CRTP: Derived provides STATIC hooks (they run on the read fiber, which
+// can outlive the client object — state must live in the socket-owned
+// core, not the client):
+//   static int CutReply(IOPortal* in, Reply* out);
 //     -> 0 cut one reply, EAGAIN need more bytes, errno = desync (the
 //        connection fails and every waiter drains with that error).
-//   uint64_t ReplyKey(const Reply&);   // only when MatchByKey
+//   static uint64_t ReplyKey(const Reply&);   // only when MatchByKey
 // and calls CallFrame() to issue requests. Matching is FIFO (wire order)
 // unless MatchByKey — then replies resolve the waiter whose key matches,
 // and unmatched replies are dropped (mongo moreToCome exhaust frames).
+//
+// Lifetime: the mutable connection state (waiters/buffer) lives in a
+// heap Core installed as the socket's parsing_context BEFORE the fd is
+// armed — it is freed only when the socket fully recycles, so a read
+// fiber still inside OnData after ~Derived() touches valid memory.
+// CallFrame holds a SocketUniquePtr across its wait, which blocks the
+// recycle while any call is in flight.
 #pragma once
 
 #include <deque>
-
 #include <mutex>
 
 #include "base/endpoint.h"
@@ -33,11 +41,20 @@ class PipelinedClient {
 
   int Connect(const EndPoint& server, int64_t timeout_ms) {
     fiber_init(0);
-    timeout_us_ = timeout_ms * 1000;
+    auto* core = new Core;
+    core->timeout_us = timeout_ms * 1000;
     Socket::Options opts;
-    opts.user = this;
+    opts.user = core;
     opts.on_edge_triggered = &PipelinedClient::OnData;
-    return Socket::Connect(server, opts, &sock_, timeout_us_);
+    opts.initial_parsing_context = core;
+    opts.parsing_context_destroyer = [](void* p) {
+      delete static_cast<Core*>(p);
+    };
+    const int rc = Socket::Connect(server, opts, &sock_, core->timeout_us);
+    if (rc != 0 && sock_ == INVALID_SOCKET_ID) {
+      delete core;  // pre-Create failure: the socket never owned it
+    }
+    return rc;
   }
 
   void Shutdown(const char* why = "client closed") {
@@ -57,37 +74,31 @@ class PipelinedClient {
   // Issues one framed request; parks until its reply (FIFO order, or the
   // reply whose ReplyKey == key). Returns 0 with *out filled, or errno.
   int CallFrame(IOBuf&& frame, uint64_t key, Reply* out) {
-    SocketUniquePtr p;
-    if (Socket::Address(sock_, &p) != 0 || p->Failed()) return ECONNRESET;
+    SocketUniquePtr p;  // held across the wait: keeps Core alive too
+    if (sock_ == INVALID_SOCKET_ID || Socket::Address(sock_, &p) != 0 ||
+        p->Failed()) {
+      return ECONNRESET;
+    }
+    Core* core = static_cast<Core*>(p->user());
     Waiter waiter;
     waiter.key = key;
     waiter.out = out;
     {
       // Enqueue order must equal wire order: with concurrent callers a
       // reply would otherwise resolve the wrong FIFO waiter.
-      std::lock_guard<std::mutex> g(mu_);
-      waiters_.push_back(&waiter);
+      std::lock_guard<std::mutex> g(core->mu);
+      core->waiters.push_back(&waiter);
       p->Write(&frame);
     }
-    if (waiter.ev.wait(timeout_us_) != 0) {
+    if (waiter.ev.wait(core->timeout_us) != 0) {
       // Timed out: the waiter must not dangle — fail the connection,
       // which drains the FIFO (including us) before we return.
       p->SetFailed(ETIMEDOUT, "pipelined reply timeout");
-      FailAll(ETIMEDOUT);
+      core->FailAll(ETIMEDOUT);
       waiter.ev.wait(-1);
       return ETIMEDOUT;
     }
     return waiter.rc;
-  }
-
-  void FailAll(int err) {
-    std::lock_guard<std::mutex> g(mu_);
-    while (!waiters_.empty()) {
-      Waiter* w = waiters_.front();
-      waiters_.pop_front();
-      w->rc = err;
-      w->ev.signal();
-    }
   }
 
  private:
@@ -98,50 +109,66 @@ class PipelinedClient {
     Reply* out = nullptr;
   };
 
+  struct Core {
+    std::mutex mu;
+    IOPortal inbuf;
+    std::deque<Waiter*> waiters;
+    int64_t timeout_us = 1000000;
+
+    void FailAll(int err) {
+      std::lock_guard<std::mutex> g(mu);
+      while (!waiters.empty()) {
+        Waiter* w = waiters.front();
+        waiters.pop_front();
+        w->rc = err;
+        w->ev.signal();
+      }
+    }
+  };
+
   static void* OnData(Socket* s) {
-    auto* self = static_cast<PipelinedClient*>(s->user());
+    auto* core = static_cast<Core*>(s->user());
     for (;;) {
-      ssize_t nr = self->inbuf_.append_from_fd(s->fd());
+      ssize_t nr = core->inbuf.append_from_fd(s->fd());
       if (nr == 0) {
         s->SetFailed(ECONNRESET, "pipelined server closed");
-        self->FailAll(ECONNRESET);
+        core->FailAll(ECONNRESET);
         return nullptr;
       }
       if (nr < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) break;
         if (errno == EINTR) continue;
         s->SetFailed(errno, "pipelined read failed");
-        self->FailAll(errno);
+        core->FailAll(errno);
         return nullptr;
       }
     }
     for (;;) {
       int rc;
       {
-        std::lock_guard<std::mutex> g(self->mu_);
+        std::lock_guard<std::mutex> g(core->mu);
         if constexpr (!MatchByKey) {
-          if (self->waiters_.empty()) break;
+          if (core->waiters.empty()) break;
         }
         Reply reply;
-        rc = static_cast<Derived*>(self)->CutReply(&self->inbuf_, &reply);
+        rc = Derived::CutReply(&core->inbuf, &reply);
         if (rc == EAGAIN) break;
         if (rc == 0) {
           Waiter* hit = nullptr;
           if constexpr (MatchByKey) {
-            const uint64_t key =
-                static_cast<Derived*>(self)->ReplyKey(reply);
-            for (auto it = self->waiters_.begin();
-                 it != self->waiters_.end(); ++it) {
+            const uint64_t key = Derived::ReplyKey(reply);
+            for (auto it = core->waiters.begin();
+                 it != core->waiters.end(); ++it) {
               if ((*it)->key == key) {
                 hit = *it;
-                self->waiters_.erase(it);
+                core->waiters.erase(it);
                 break;
               }
             }
             // No waiter: an unsolicited reply (exhaust frame) — drop.
           } else {
-            hit = self->waiters_.front();
-            self->waiters_.pop_front();
+            hit = core->waiters.front();
+            core->waiters.pop_front();
           }
           if (hit != nullptr) {
             *hit->out = std::move(reply);
@@ -152,17 +179,13 @@ class PipelinedClient {
       }
       // Desync: the cursor cannot be trusted for any later reply.
       s->SetFailed(rc, "pipelined reply desynchronized");
-      self->FailAll(rc);
+      core->FailAll(rc);
       return nullptr;
     }
     return nullptr;
   }
 
   SocketId sock_ = INVALID_SOCKET_ID;
-  IOPortal inbuf_;
-  std::mutex mu_;
-  std::deque<Waiter*> waiters_;
-  int64_t timeout_us_ = 1000000;
 };
 
 }  // namespace brt

@@ -276,7 +276,7 @@ struct RedisClient::Impl
     : PipelinedClient<RedisClient::Impl, RedisReply> {
   using PipelinedClient::CallFrame;
 
-  int CutReply(IOPortal* in, RedisReply* out) {
+  static int CutReply(IOPortal* in, RedisReply* out) {
     return out->ParseFrom(in);
   }
 };
