@@ -1,0 +1,50 @@
+// gRPC client over HTTP/2 — the client half of the h2 tier.
+// Parity target: reference src/brpc/policy/http2_rpc_protocol.cpp client
+// side (H2Context stream management) + grpc status mapping (grpc.h:27).
+// Redesigned to this framework's blocking-client shape (one connection,
+// calls multiplex as h2 streams, replies match by stream id): Connect
+// performs the preface/SETTINGS exchange, each Call opens a stream with
+// HPACK-encoded headers and one gRPC-framed message, and the reply's
+// trailers carry grpc-status. Interops with this framework's h2 server
+// and any standard gRPC server speaking h2c.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+
+namespace brt {
+
+struct GrpcResult {
+  int grpc_status = -1;       // 0 = OK (grpc-status trailer)
+  std::string grpc_message;   // grpc-message trailer
+  int http_status = 0;        // :status pseudo-header
+  IOBuf response;             // de-framed message payload
+};
+
+class GrpcClient {
+ public:
+  GrpcClient();
+  ~GrpcClient();
+
+  int Connect(const EndPoint& server, int64_t timeout_ms = 2000);
+
+  // Sync unary call: POST /<service>/<method>, body = one gRPC-framed
+  // `request`. Concurrent Calls multiplex on the connection. Returns 0
+  // with *out filled (check out->grpc_status), or an errno-style
+  // transport error.
+  int Call(const std::string& service, const std::string& method,
+           const IOBuf& request, GrpcResult* out,
+           int64_t timeout_ms = -1);  // -1: the Connect timeout
+
+  bool connected() const;
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace brt

@@ -44,16 +44,22 @@ class PipelinedClient {
     auto* core = new Core;
     core->timeout_us = timeout_ms * 1000;
     Socket::Options opts;
-    opts.user = core;
     opts.on_edge_triggered = &PipelinedClient::OnData;
+    // Single owner: the socket's parsing_context (freed at recycle) — the
+    // lifetime contract every access below goes through.
     opts.initial_parsing_context = core;
     opts.parsing_context_destroyer = [](void* p) {
       delete static_cast<Core*>(p);
     };
-    const int rc = Socket::Connect(server, opts, &sock_, core->timeout_us);
-    if (rc != 0 && sock_ == INVALID_SOCKET_ID) {
+    // Local id: on a RETRY after a failed Init, sock_ may hold a stale id
+    // and must not decide whether THIS call's socket took Core ownership.
+    SocketId sid = INVALID_SOCKET_ID;
+    const int rc = Socket::Connect(server, opts, &sid, core->timeout_us);
+    if (rc != 0 && sid == INVALID_SOCKET_ID) {
       delete core;  // pre-Create failure: the socket never owned it
+      return rc;
     }
+    sock_ = sid;
     return rc;
   }
 
@@ -79,7 +85,7 @@ class PipelinedClient {
         p->Failed()) {
       return ECONNRESET;
     }
-    Core* core = static_cast<Core*>(p->user());
+    Core* core = static_cast<Core*>(p->parsing_context());
     Waiter waiter;
     waiter.key = key;
     waiter.out = out;
@@ -127,7 +133,7 @@ class PipelinedClient {
   };
 
   static void* OnData(Socket* s) {
-    auto* core = static_cast<Core*>(s->user());
+    auto* core = static_cast<Core*>(s->parsing_context());
     for (;;) {
       ssize_t nr = core->inbuf.append_from_fd(s->fd());
       if (nr == 0) {

@@ -5,6 +5,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cassert>
 #include <cstdio>
 #include <cstring>
@@ -13,7 +14,9 @@
 #include <vector>
 
 #include "fiber/fiber.h"
+#include "fiber/sync.h"
 #include "rpc/hpack.h"
+#include "rpc/grpc_client.h"
 #include "rpc/http2_protocol.h"
 #include "rpc/server.h"
 
@@ -217,6 +220,56 @@ int main() {
   }
   assert(server.Start("127.0.0.1:0") == 0);
   const EndPoint addr = server.listen_address();
+
+  // ---- native gRPC CLIENT against our own h2 server ----
+  {
+    GrpcClient gc;
+    assert(gc.Connect(addr) == 0);
+    // Unary echo round trip.
+    IOBuf req;
+    req.append("grpc-client-payload");
+    GrpcResult res;
+    assert(gc.Call("Echo", "Echo", req, &res) == 0);
+    assert(res.http_status == 200);
+    assert(res.grpc_status == 0);
+    assert(res.response.to_string() == "grpc-client-payload");
+    // Error mapping: unknown method -> non-OK grpc-status, connection
+    // stays usable.
+    GrpcResult err;
+    assert(gc.Call("Echo", "Nope", req, &err) == 0);
+    assert(err.grpc_status != 0);
+    // Concurrent multiplexed calls on ONE connection.
+    constexpr int N = 16;
+    struct CallCtx {
+      GrpcClient* gc;
+      int i;
+      CountdownEvent* done;
+      std::atomic<int>* ok;
+    };
+    CountdownEvent all(N);
+    std::atomic<int> ok{0};
+    for (int i = 0; i < N; ++i) {
+      auto* c = new CallCtx{&gc, i, &all, &ok};
+      fiber_t t;
+      assert(fiber_start(&t, [](void* p) -> void* {
+        auto* c = static_cast<CallCtx*>(p);
+        IOBuf rq;
+        rq.append("m" + std::to_string(c->i));
+        GrpcResult r;
+        if (c->gc->Call("Echo", "Echo", rq, &r) == 0 &&
+            r.grpc_status == 0 &&
+            r.response.to_string() == "m" + std::to_string(c->i)) {
+          c->ok->fetch_add(1);
+        }
+        c->done->signal();
+        delete c;
+        return nullptr;
+      }, c) == 0);
+    }
+    assert(all.wait(10 * 1000 * 1000) == 0);
+    assert(ok.load() == N);
+    printf("grpc client OK (%d multiplexed)\n", N);
+  }
 
   // ---- restful JSON over h2 (same bridge as HTTP/1.1) ----
   {
