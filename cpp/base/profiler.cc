@@ -123,9 +123,11 @@ bool CpuProfiler::Start(int hz) {
   return true;
 }
 
-std::string CpuProfiler::StopAndReport() {
-  std::lock_guard<std::mutex> g(g_session_mu);
-  if (!g_running.load(std::memory_order_acquire)) return "not running\n";
+namespace {
+
+// Stops the timer and publishes quiescence; returns usable sample count.
+// Caller holds g_session_mu.
+int StopTimerLocked() {
   itimerval off;
   memset(&off, 0, sizeof(off));
   setitimer(ITIMER_PROF, &off, nullptr);
@@ -135,9 +137,56 @@ std::string CpuProfiler::StopAndReport() {
   // least this far away. nframes is release/acquire-published, so a slot
   // either shows 0 (skipped) or a fully written stack.
   usleep(50 * 1000);
+  return std::min(g_ring_next.load(std::memory_order_relaxed), kRingSize);
+}
 
-  const int n = std::min(g_ring_next.load(std::memory_order_relaxed),
-                         kRingSize);
+}  // namespace
+
+std::string CpuProfiler::StopAndReportPprof() {
+  std::lock_guard<std::mutex> g(g_session_mu);
+  if (!g_running.load(std::memory_order_acquire)) return "";
+  const int n = StopTimerLocked();
+  // gperftools CPU profile: words of uintptr. Header
+  // {0, 3, 0, period_usec, 0}; per sample {count, depth, pc...};
+  // trailer {0, 1, 0}; then /proc/self/maps as text (pprof uses it to
+  // map PCs back to objects).
+  std::map<std::vector<void*>, int> stacks;
+  for (int i = 0; i < n; ++i) {
+    const RawSample& s = g_ring[i];
+    const int nf = s.nframes.load(std::memory_order_acquire);
+    if (nf <= 2 || nf > kMaxFrames) continue;
+    stacks[std::vector<void*>(s.frames + 2, s.frames + nf)]++;
+  }
+  std::string out;
+  auto put = [&out](uintptr_t w) {
+    out.append(reinterpret_cast<const char*>(&w), sizeof(w));
+  };
+  put(0);
+  put(3);
+  put(0);
+  put(uintptr_t(1000000 / std::max(1, g_hz)));
+  put(0);
+  for (const auto& [key, cnt] : stacks) {
+    put(uintptr_t(cnt));
+    put(uintptr_t(key.size()));
+    for (void* f : key) put(reinterpret_cast<uintptr_t>(f));
+  }
+  put(0);
+  put(1);
+  put(0);
+  if (FILE* maps = fopen("/proc/self/maps", "r")) {
+    char buf[4096];
+    size_t nr;
+    while ((nr = fread(buf, 1, sizeof(buf), maps)) > 0) out.append(buf, nr);
+    fclose(maps);
+  }
+  return out;
+}
+
+std::string CpuProfiler::StopAndReport() {
+  std::lock_guard<std::mutex> g(g_session_mu);
+  if (!g_running.load(std::memory_order_acquire)) return "not running\n";
+  const int n = StopTimerLocked();
   // Aggregate identical stacks and leaf frames.
   std::map<std::vector<void*>, int> stacks;
   std::map<void*, int> leaves;

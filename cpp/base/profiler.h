@@ -23,6 +23,13 @@ class CpuProfiler {
   // Stops sampling and returns the aggregated symbolized report.
   std::string StopAndReport();
 
+  // Stops sampling and returns the profile in the gperftools/pprof
+  // BINARY CPU-profile format (header+samples words, then
+  // /proc/self/maps) — downloadable via /hotspots?format=pprof and
+  // analyzable with the standard `pprof` tool (reference
+  // hotspots_service.cpp serves the same format).
+  std::string StopAndReportPprof();
+
   bool running() const;
 
  private:

@@ -158,7 +158,15 @@ bool HandleBuiltinPage(Server* server, const std::string& method,
       return true;
     }
     fiber_usleep(int64_t(seconds) * 1000000);
-    out->body = CpuProfiler::singleton().StopAndReport();
+    if (query.find("format=pprof") != std::string::npos) {
+      // Raw gperftools-format profile for the standard pprof tool:
+      //   curl -o prof "http://host/hotspots?seconds=5&format=pprof"
+      //   pprof --text ./binary prof
+      out->content_type = "application/octet-stream";
+      out->body = CpuProfiler::singleton().StopAndReportPprof();
+    } else {
+      out->body = CpuProfiler::singleton().StopAndReport();
+    }
     return true;
   }
   if (path == "/heap") {

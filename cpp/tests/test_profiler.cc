@@ -151,6 +151,32 @@ int main() {
   assert(cont.find("distinct_stacks: 0") == std::string::npos);
   printf("contention OK\n");
 
+  // ---- /hotspots?format=pprof: standard pprof binary profile ----
+  {
+    std::atomic<bool> pstop{false};
+    CountdownEvent pdone(1);
+    LoadArg pla{addr, &pstop, &pdone};
+    fiber_t t;
+    assert(fiber_start(&t, LoadLoop, &pla) == 0);
+    std::string prof2 = HttpGet(
+        addr, "GET /hotspots?seconds=1&format=pprof HTTP/1.1\r\n\r\n");
+    pstop.store(true);
+    pdone.wait(-1);
+    assert(prof2.rfind("HTTP/1.1 200", 0) == 0);
+    const size_t he2 = prof2.find("\r\n\r\n");
+    assert(he2 != std::string::npos);
+    const char* body = prof2.data() + he2 + 4;
+    const size_t blen = prof2.size() - he2 - 4;
+    assert(blen > 5 * sizeof(uintptr_t));
+    const uintptr_t* w = reinterpret_cast<const uintptr_t*>(body);
+    assert(w[0] == 0 && w[1] == 3 && w[2] == 0);  // gperftools header
+    assert(w[3] > 0);                             // sampling period (us)
+    // the maps section rides at the end
+    assert(std::string(body, blen).find("/proc") != std::string::npos ||
+           std::string(body, blen).find("r-xp") != std::string::npos);
+    printf("pprof format OK (%zu bytes)\n", blen);
+  }
+
   // ---- /heap: leak made during the window must show with a stack ----
   {
     struct LeakArg {
