@@ -208,6 +208,80 @@ void test_ns_filter() {
   printf("ns filter OK\n");
 }
 
+// Churn: concurrent registrations/deregistrations from many fibers with
+// two live watchers; the registry must stay consistent (final List shows
+// exactly the survivors).
+void test_registry_churn(const EndPoint& reg_addr) {
+  struct Arg {
+    EndPoint addr;
+    int idx;
+    CountdownEvent* done;
+  };
+  CountdownEvent done(8);
+  std::atomic<bool> stop_watch{false};
+  // Watchers hammer blocking queries through the churn.
+  fiber_t watchers[2];
+  struct WArg {
+    EndPoint addr;
+    std::atomic<bool>* stop;
+    CountdownEvent* done;
+  } wa{reg_addr, &stop_watch, nullptr};
+  CountdownEvent wdone(2);
+  wa.done = &wdone;
+  for (fiber_t& w : watchers) {
+    fiber_start(&w, [](void* p) -> void* {
+      auto* a = static_cast<WArg*>(p);
+      Channel ch;
+      assert(ch.Init(a->addr) == 0);
+      int64_t version = 0;
+      while (!a->stop->load()) {
+        ThriftValue req = ThriftValue::Struct();
+        req.add_field(1, ThriftValue::String("churn"));
+        req.add_field(2, ThriftValue::I64(version));
+        req.add_field(3, ThriftValue::I64(200));
+        ThriftValue resp = Call(ch, "Watch", std::move(req));
+        version = resp.field(1)->i;
+      }
+      a->done->signal();
+      return nullptr;
+    }, &wa);
+  }
+  for (int i = 0; i < 8; ++i) {
+    auto* arg = new Arg{reg_addr, i, &done};
+    fiber_t t;
+    fiber_start(&t, [](void* p) -> void* {
+      auto* a = static_cast<Arg*>(p);
+      Channel ch;
+      assert(ch.Init(a->addr) == 0);
+      const std::string addr_str =
+          "10.1.0." + std::to_string(a->idx) + ":99";
+      for (int round = 0; round < 25; ++round) {
+        Call(ch, "Register", RegisterReq("churn", addr_str));
+        if (round % 2 == 1) {
+          Call(ch, "Deregister", RegisterReq("churn", addr_str));
+        }
+      }
+      // Odd-index fibers end deregistered, even-index end registered.
+      if (a->idx % 2 == 1) {
+        Call(ch, "Deregister", RegisterReq("churn", addr_str));
+      } else {
+        Call(ch, "Register", RegisterReq("churn", addr_str));
+      }
+      a->done->signal();
+      delete a;
+      return nullptr;
+    }, arg);
+  }
+  done.wait(-1);
+  stop_watch.store(true);
+  wdone.wait(-1);
+  Channel ch;
+  assert(ch.Init(reg_addr) == 0);
+  ThriftValue list = Call(ch, "List", RegisterReq("churn", ""));
+  assert(NodeCount(list) == 4);  // the even-index survivors
+  printf("registry churn OK\n");
+}
+
 }  // namespace
 
 int main() {
@@ -225,6 +299,7 @@ int main() {
   test_ttl_lapse(reg_addr);
   test_remote_ns_end_to_end(reg_addr);
   test_ns_filter();
+  test_registry_churn(reg_addr);
 
   registry.Stop();
   registry.Join();
