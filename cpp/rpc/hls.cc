@@ -1,0 +1,243 @@
+#include "rpc/hls.h"
+
+#include <cstring>
+
+namespace brt {
+
+namespace {
+
+constexpr uint16_t kPidPat = 0x0000;
+constexpr uint16_t kPidPmt = 0x1000;
+constexpr uint16_t kPidVideo = 0x0100;
+constexpr uint16_t kPidAudio = 0x0101;
+
+// CRC32 (MPEG-2 variant: big-endian, poly 0x04C11DB7, no reflection).
+uint32_t Mpeg2Crc(const uint8_t* p, size_t n) {
+  uint32_t crc = 0xFFFFFFFF;
+  for (size_t i = 0; i < n; ++i) {
+    crc ^= uint32_t(p[i]) << 24;
+    for (int b = 0; b < 8; ++b) {
+      crc = (crc & 0x80000000) ? (crc << 1) ^ 0x04C11DB7 : crc << 1;
+    }
+  }
+  return crc;
+}
+
+// One 188-byte TS packet: header + (stuffed) payload slice.
+void PackTs(std::string* out, uint16_t pid, bool start, int* cc,
+            const char* payload, size_t n) {
+  uint8_t pkt[188];
+  memset(pkt, 0xFF, sizeof(pkt));
+  pkt[0] = 0x47;
+  pkt[1] = uint8_t((start ? 0x40 : 0x00) | (pid >> 8));
+  pkt[2] = uint8_t(pid);
+  const size_t room = 184;
+  if (n >= room) {
+    pkt[3] = uint8_t(0x10 | (*cc & 0xF));  // payload only
+    memcpy(pkt + 4, payload, room);
+  } else {
+    // Adaptation field of stuffing pads short payloads to 188.
+    const size_t af_len = room - n - 1;  // bytes after the af-length byte
+    pkt[3] = uint8_t(0x30 | (*cc & 0xF));  // adaptation + payload
+    pkt[4] = uint8_t(af_len);
+    if (af_len > 0) {
+      pkt[5] = 0x00;  // no flags; rest is 0xFF stuffing (memset above)
+    }
+    memcpy(pkt + 4 + 1 + af_len, payload, n);
+  }
+  *cc = (*cc + 1) & 0xF;
+  out->append(reinterpret_cast<const char*>(pkt), sizeof(pkt));
+}
+
+std::string PsiPacket(uint16_t pid, const std::string& section, int* cc) {
+  std::string payload;
+  payload.push_back('\0');  // pointer_field
+  payload += section;
+  std::string out;
+  PackTs(&out, pid, /*start=*/true, cc, payload.data(), payload.size());
+  return out;
+}
+
+std::string PatSection() {
+  std::string s;
+  s.push_back(0x00);        // table_id: PAT
+  s.push_back(char(0xB0));  // section_syntax + length hi
+  s.push_back(13);          // section_length (9 header/crc + 4 program)
+  s.push_back(0x00);
+  s.push_back(0x01);        // transport_stream_id
+  s.push_back(char(0xC1));  // version 0, current
+  s.push_back(0x00);        // section_number
+  s.push_back(0x00);        // last_section_number
+  s.push_back(0x00);
+  s.push_back(0x01);        // program_number 1
+  s.push_back(char(0xE0 | (kPidPmt >> 8)));
+  s.push_back(char(kPidPmt & 0xFF));
+  const uint32_t crc =
+      Mpeg2Crc(reinterpret_cast<const uint8_t*>(s.data()), s.size());
+  for (int i = 3; i >= 0; --i) s.push_back(char(crc >> (i * 8)));
+  return s;
+}
+
+std::string PmtSection() {
+  std::string s;
+  s.push_back(0x02);        // table_id: PMT
+  s.push_back(char(0xB0));
+  s.push_back(23);          // section_length
+  s.push_back(0x00);
+  s.push_back(0x01);        // program_number
+  s.push_back(char(0xC1));
+  s.push_back(0x00);
+  s.push_back(0x00);
+  s.push_back(char(0xE0 | (kPidVideo >> 8)));  // PCR PID = video
+  s.push_back(char(kPidVideo & 0xFF));
+  s.push_back(char(0xF0));
+  s.push_back(0x00);        // program_info_length 0
+  // H.264 video stream
+  s.push_back(0x1B);
+  s.push_back(char(0xE0 | (kPidVideo >> 8)));
+  s.push_back(char(kPidVideo & 0xFF));
+  s.push_back(char(0xF0));
+  s.push_back(0x00);
+  // AAC audio stream
+  s.push_back(0x0F);
+  s.push_back(char(0xE0 | (kPidAudio >> 8)));
+  s.push_back(char(kPidAudio & 0xFF));
+  s.push_back(char(0xF0));
+  s.push_back(0x00);
+  const uint32_t crc =
+      Mpeg2Crc(reinterpret_cast<const uint8_t*>(s.data()), s.size());
+  for (int i = 3; i >= 0; --i) s.push_back(char(crc >> (i * 8)));
+  return s;
+}
+
+// PES packet wrapping one elementary-stream access unit with a PTS.
+std::string PesWrap(uint8_t stream_id, uint32_t pts_ms,
+                    const IOBuf& payload) {
+  const uint64_t pts = uint64_t(pts_ms) * 90;  // 90kHz clock
+  std::string pes;
+  pes.push_back('\0');
+  pes.push_back('\0');
+  pes.push_back(0x01);
+  pes.push_back(char(stream_id));
+  const size_t body = 3 + 5 + payload.size();  // flags + PTS + data
+  const size_t len = body <= 0xFFFF ? body : 0;  // 0 = unbounded (video)
+  pes.push_back(char(len >> 8));
+  pes.push_back(char(len));
+  pes.push_back(char(0x80));  // marker bits
+  pes.push_back(char(0x80));  // PTS only
+  pes.push_back(0x05);        // header data length
+  pes.push_back(char(0x21 | ((pts >> 29) & 0x0E)));
+  pes.push_back(char(pts >> 22));
+  pes.push_back(char(0x01 | ((pts >> 14) & 0xFE)));
+  pes.push_back(char(pts >> 7));
+  pes.push_back(char(0x01 | ((pts << 1) & 0xFE)));
+  pes += payload.to_string();
+  return pes;
+}
+
+}  // namespace
+
+HlsSegmenter::HlsSegmenter(const Options& opts) : opts_(opts) {}
+
+HlsSegmenter::~HlsSegmenter() { Finish(); }
+
+std::string HlsSegmenter::playlist_path() const {
+  return opts_.dir + "/" + opts_.name + ".m3u8";
+}
+
+void HlsSegmenter::WriteTsPackets(uint16_t pid, const std::string& pes,
+                                  int* cc) {
+  std::string out;
+  size_t off = 0;
+  bool start = true;
+  while (off < pes.size()) {
+    const size_t n = pes.size() - off;
+    PackTs(&out, pid, start, cc, pes.data() + off, n > 184 ? 184 : n);
+    off += n > 184 ? 184 : n;
+    start = false;
+  }
+  fwrite(out.data(), 1, out.size(), seg_);
+}
+
+void HlsSegmenter::OpenSegment(uint32_t start_ms) {
+  const std::string path = opts_.dir + "/" + opts_.name + "-" +
+                           std::to_string(seq_) + ".ts";
+  seg_ = fopen(path.c_str(), "wb");
+  seg_start_ms_ = start_ms;
+  wrote_frame_ = false;
+  if (seg_ == nullptr) return;
+  // Every segment is self-describing: PAT + PMT lead it.
+  const std::string pat = PsiPacket(kPidPat, PatSection(), &cc_pat_);
+  const std::string pmt = PsiPacket(kPidPmt, PmtSection(), &cc_pat_);
+  fwrite(pat.data(), 1, pat.size(), seg_);
+  fwrite(pmt.data(), 1, pmt.size(), seg_);
+}
+
+void HlsSegmenter::CloseSegment(uint32_t end_ms) {
+  if (seg_ == nullptr) return;
+  fclose(seg_);
+  seg_ = nullptr;
+  const double dur =
+      double(end_ms > seg_start_ms_ ? end_ms - seg_start_ms_ : 0) / 1000.0;
+  window_.push_back({seq_, dur});
+  ++seq_;
+  while (int(window_.size()) > opts_.window_segments) {
+    const std::string old = opts_.dir + "/" + opts_.name + "-" +
+                            std::to_string(window_.front().seq) + ".ts";
+    remove(old.c_str());
+    window_.pop_front();
+  }
+  WritePlaylist(/*ended=*/false);
+}
+
+void HlsSegmenter::WritePlaylist(bool ended) {
+  FILE* f = fopen(playlist_path().c_str(), "w");
+  if (f == nullptr) return;
+  double max_dur = opts_.target_duration_s;
+  for (const SegInfo& s : window_) {
+    if (s.duration_s > max_dur) max_dur = s.duration_s;
+  }
+  fprintf(f,
+          "#EXTM3U\n#EXT-X-VERSION:3\n#EXT-X-TARGETDURATION:%d\n"
+          "#EXT-X-MEDIA-SEQUENCE:%d\n",
+          int(max_dur + 0.999),
+          window_.empty() ? 0 : window_.front().seq);
+  for (const SegInfo& s : window_) {
+    fprintf(f, "#EXTINF:%.3f,\n%s-%d.ts\n", s.duration_s,
+            opts_.name.c_str(), s.seq);
+  }
+  if (ended) fprintf(f, "#EXT-X-ENDLIST\n");
+  fclose(f);
+}
+
+void HlsSegmenter::OnFrame(const RtmpFrame& frame) {
+  if (frame.type != 8 && frame.type != 9) return;
+  if (seg_ == nullptr) OpenSegment(frame.timestamp_ms);
+  if (seg_ == nullptr) return;  // directory missing etc.
+  // Cut at a video frame once the target duration passed (video frames
+  // approximate keyframe boundaries at this layer; the RTMP payload's
+  // first byte carries the real keyframe flag for codec-aware cutting).
+  if (frame.type == 9 && wrote_frame_ &&
+      frame.timestamp_ms >=
+          seg_start_ms_ + uint32_t(opts_.target_duration_s) * 1000) {
+    CloseSegment(frame.timestamp_ms);
+    OpenSegment(frame.timestamp_ms);
+    if (seg_ == nullptr) return;
+  }
+  const bool video = frame.type == 9;
+  const std::string pes =
+      PesWrap(video ? 0xE0 : 0xC0, frame.timestamp_ms, frame.payload);
+  WriteTsPackets(video ? kPidVideo : kPidAudio, pes,
+                 video ? &cc_video_ : &cc_audio_);
+  wrote_frame_ = true;
+}
+
+void HlsSegmenter::Finish() {
+  if (seg_ != nullptr) {
+    CloseSegment(seg_start_ms_ +
+                 uint32_t(opts_.target_duration_s) * 1000);
+    WritePlaylist(/*ended=*/true);
+  }
+}
+
+}  // namespace brt

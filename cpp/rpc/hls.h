@@ -1,0 +1,63 @@
+// HLS segmenter atop the RTMP tier: consumes published frames (e.g. from
+// RtmpService::OnFrame), wraps them into MPEG-TS segments, and maintains
+// a rolling m3u8 playlist — the reference's RTMP→HLS remuxing role
+// (policy/rtmp_protocol.cpp + its hls sibling servers). The TS layer is
+// structural: PAT/PMT + PES wrapping with correct 188-byte packets,
+// continuity counters, and PTS timestamps; payloads pass through as
+// carried by RTMP (H.264/AAC elementary streams remux losslessly; the
+// segmenter does not transcode).
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <deque>
+#include <string>
+
+#include "rpc/rtmp.h"
+
+namespace brt {
+
+class HlsSegmenter {
+ public:
+  struct Options {
+    std::string dir;             // segment + playlist directory
+    std::string name = "live";   // playlist base name
+    int target_duration_s = 4;   // segment cut threshold
+    int window_segments = 5;     // rolling window size (old ones delete)
+  };
+
+  explicit HlsSegmenter(const Options& opts);
+  ~HlsSegmenter();
+
+  // Feeds one published frame (video=9 / audio=8; data frames ignored).
+  // Segments cut at the first video frame past the target duration.
+  void OnFrame(const RtmpFrame& frame);
+
+  // Flushes the open segment and finalizes the playlist (#EXT-X-ENDLIST).
+  void Finish();
+
+  std::string playlist_path() const;
+  int segments_written() const { return seq_; }
+
+ private:
+  void OpenSegment(uint32_t start_ms);
+  void CloseSegment(uint32_t end_ms);
+  void WritePlaylist(bool ended);
+  void WriteTsPackets(uint16_t pid, const std::string& pes, int* cc);
+
+  Options opts_;
+  FILE* seg_ = nullptr;
+  int seq_ = 0;
+  uint32_t seg_start_ms_ = 0;
+  bool wrote_frame_ = false;
+  int cc_video_ = 0;
+  int cc_audio_ = 0;
+  int cc_pat_ = 0;
+  struct SegInfo {
+    int seq;
+    double duration_s;
+  };
+  std::deque<SegInfo> window_;
+};
+
+}  // namespace brt

@@ -11,6 +11,7 @@
 #include "fiber/fiber.h"
 #include "rpc/amf0.h"
 #include "rpc/channel.h"
+#include "rpc/hls.h"
 #include "rpc/rtmp.h"
 #include "rpc/server.h"
 
@@ -194,6 +195,78 @@ void test_play_churn(const EndPoint& addr) {
   printf("rtmp play churn OK (%d frames across 24 joins)\n", got.load());
 }
 
+// HLS: frames segment into MPEG-TS files + a rolling m3u8. Structural
+// validation: 188-byte sync-aligned packets, PAT/PMT lead each segment,
+// playlist lists the window and ends with ENDLIST after Finish().
+void test_hls_segmenter() {
+  char dirt[] = "/tmp/brt_hls_XXXXXX";
+  assert(mkdtemp(dirt) != nullptr);
+  HlsSegmenter::Options o;
+  o.dir = dirt;
+  o.target_duration_s = 1;
+  o.window_segments = 3;
+  HlsSegmenter hls(o);
+  // 10 seconds of 25fps "video" + some audio: expect ~10 segments, with
+  // only the last 3 retained.
+  for (uint32_t ms = 0; ms < 10000; ms += 40) {
+    RtmpFrame v;
+    v.type = 9;
+    v.timestamp_ms = ms;
+    v.payload.append(std::string(300, 'V'));
+    hls.OnFrame(v);
+    if (ms % 120 == 0) {
+      RtmpFrame a;
+      a.type = 8;
+      a.timestamp_ms = ms;
+      a.payload.append(std::string(64, 'A'));
+      hls.OnFrame(a);
+    }
+  }
+  hls.Finish();
+  assert(hls.segments_written() >= 9);
+  // Playlist: rolling window of 3, ENDLIST present.
+  FILE* f = fopen(hls.playlist_path().c_str(), "r");
+  assert(f != nullptr);
+  std::string pl;
+  char buf[4096];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) pl.append(buf, n);
+  fclose(f);
+  assert(pl.rfind("#EXTM3U", 0) == 0);
+  assert(pl.find("#EXT-X-ENDLIST") != std::string::npos);
+  size_t count = 0;
+  for (size_t pos = 0; (pos = pl.find(".ts", pos)) != std::string::npos;
+       ++pos) {
+    ++count;
+  }
+  assert(count == 3);
+  // A retained segment: sync-aligned TS with PAT (pid 0) first.
+  const size_t seq_pos = pl.find("live-");
+  assert(seq_pos != std::string::npos);
+  const std::string seg_name =
+      pl.substr(seq_pos, pl.find(".ts", seq_pos) + 3 - seq_pos);
+  f = fopen((std::string(dirt) + "/" + seg_name).c_str(), "rb");
+  assert(f != nullptr);
+  std::string ts;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) ts.append(buf, n);
+  fclose(f);
+  assert(ts.size() % 188 == 0 && ts.size() >= 188 * 3);
+  for (size_t off = 0; off < ts.size(); off += 188) {
+    assert(uint8_t(ts[off]) == 0x47);  // sync byte on every packet
+  }
+  // First packet: PAT (pid 0, payload_unit_start).
+  assert((uint8_t(ts[1]) & 0x5F) == 0x40 && uint8_t(ts[2]) == 0x00);
+  // Second packet: PMT at pid 0x1000.
+  const uint16_t pid2 =
+      (uint16_t(uint8_t(ts[188 + 1]) & 0x1F) << 8) | uint8_t(ts[188 + 2]);
+  assert(pid2 == 0x1000);
+  // Old segments beyond the window were deleted.
+  assert(fopen((std::string(dirt) + "/live-0.ts").c_str(), "rb") ==
+         nullptr);
+  printf("hls segmenter OK (%d segments, window 3)\n",
+         hls.segments_written());
+}
+
 }  // namespace
 
 int main() {
@@ -211,6 +284,7 @@ int main() {
   test_reject(addr, &rtmp);
   test_play_churn(addr);
   test_flv_record();
+  test_hls_segmenter();
 
   // Shared port: native RPC still answers next to RTMP.
   Channel ch;
