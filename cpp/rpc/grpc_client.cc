@@ -114,7 +114,14 @@ bool ProcessFrame(Socket* s, GrpcCore* core, uint8_t type, uint8_t flags,
                            uint32_t(uint8_t(payload[off + 4])) << 8 |
                            uint8_t(payload[off + 5]);
         if (id == 5) core->peer_max_frame = v;
-        if (id == 4) core->peer_initial_window = v;
+        if (id == 4) {
+          // RFC 9113 §6.9.2: a mid-connection INITIAL_WINDOW_SIZE change
+          // adjusts every open stream's send window by the delta.
+          const int64_t delta =
+              int64_t(v) - int64_t(core->peer_initial_window);
+          for (auto& kv : core->stream_send_window) kv.second += delta;
+          core->peer_initial_window = v;
+        }
         (void)0;  // header-table-size updates not applied (we emit no
                   // dynamic-table-dependent encodings beyond our own)
       }
@@ -146,7 +153,10 @@ bool ProcessFrame(Socket* s, GrpcCore* core, uint8_t type, uint8_t flags,
       if (stream_id == 0) {
         core->conn_send_window += inc;
       } else {
-        core->stream_send_window[stream_id] += inc;
+        // Only known streams: a WINDOW_UPDATE for a finished/RST stream
+        // must not re-insert a dead entry in the accounting map.
+        auto wit = core->stream_send_window.find(stream_id);
+        if (wit != core->stream_send_window.end()) wit->second += inc;
       }
       core->wcond.notify_all();
       return true;
@@ -185,15 +195,21 @@ bool ProcessFrame(Socket* s, GrpcCore* core, uint8_t type, uint8_t flags,
         core->cont_stream = 0;
       }
       auto it = core->streams.find(stream_id);
-      if (it == core->streams.end()) return true;  // stale stream
-      CallWaiter* w = it->second;
+      CallWaiter* w = (it == core->streams.end()) ? nullptr : it->second;
+      // HPACK's dynamic table is connection-wide: the block must run
+      // through the decoder even for a stale (timed-out) stream, or every
+      // later header block on this connection decodes against a wrong
+      // table. Decode into a scratch list and discard if stream unknown.
+      HeaderList scratch;
       if (!core->dec.Decode(
               reinterpret_cast<const uint8_t*>(block.data()), block.size(),
-              &w->headers)) {
+              w ? &w->headers : &scratch)) {
         *err = "HPACK decode failed";
         return false;
       }
-      if (hflags & 0x1) FinishStreamLocked(core, stream_id, w);
+      if (w != nullptr && (hflags & 0x1)) {
+        FinishStreamLocked(core, stream_id, w);
+      }
       return true;
     }
     case H2FrameType::DATA: {
@@ -227,6 +243,7 @@ bool ProcessFrame(Socket* s, GrpcCore* core, uint8_t type, uint8_t flags,
       if (it != core->streams.end()) {
         CallWaiter* w = it->second;
         core->streams.erase(it);
+        core->stream_send_window.erase(stream_id);
         w->rc = ECONNRESET;
         w->done.signal();
       }

@@ -168,7 +168,7 @@ void HlsSegmenter::OpenSegment(uint32_t start_ms) {
   if (seg_ == nullptr) return;
   // Every segment is self-describing: PAT + PMT lead it.
   const std::string pat = PsiPacket(kPidPat, PatSection(), &cc_pat_);
-  const std::string pmt = PsiPacket(kPidPmt, PmtSection(), &cc_pat_);
+  const std::string pmt = PsiPacket(kPidPmt, PmtSection(), &cc_pmt_);
   fwrite(pat.data(), 1, pat.size(), seg_);
   fwrite(pmt.data(), 1, pmt.size(), seg_);
 }

@@ -52,7 +52,9 @@ class HlsSegmenter {
   bool wrote_frame_ = false;
   int cc_video_ = 0;
   int cc_audio_ = 0;
+  // Continuity counters are per-PID (ISO 13818-1 §2.4.3.3).
   int cc_pat_ = 0;
+  int cc_pmt_ = 0;
   struct SegInfo {
     int seq;
     double duration_s;

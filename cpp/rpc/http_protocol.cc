@@ -67,6 +67,7 @@ struct HttpSocketCtx {
   uint64_t next_in = 0;   // seq of the next request to finish parsing
   uint64_t next_out = 0;  // seq allowed to write its response next
   bool closing = false;   // a close-announced response is on the wire
+  bool owned = false;     // a progressive response owns the connection
   std::mutex mu;
   std::map<uint64_t, ParkedResponse> parked;  // out-of-order completions
 };
@@ -88,6 +89,13 @@ void WriteSequenced(Socket* s, uint64_t seq, IOBuf&& out, bool close,
     return;
   }
   std::unique_lock<std::mutex> lk(ctx->mu);
+  if (ctx->owned) {
+    // A progressive response already owns the connection; nothing written
+    // after its headers may reach the wire before its terminating chunk,
+    // and the connection dies when it finishes. Drop (abort) late comers.
+    if (pa != nullptr) pa->Abort();
+    return;
+  }
   if (seq != ctx->next_out) {
     ctx->parked.emplace(seq,
                         ParkedResponse{std::move(out), close, std::move(pa)});
@@ -95,23 +103,35 @@ void WriteSequenced(Socket* s, uint64_t seq, IOBuf&& out, bool close,
   }
   IOBuf ready = std::move(out);
   bool close_now = close;
-  std::vector<std::shared_ptr<ProgressiveAttachment>> to_bind;
-  if (pa != nullptr) to_bind.push_back(std::move(pa));
-  for (;;) {
-    ++ctx->next_out;
+  std::shared_ptr<ProgressiveAttachment> to_bind = std::move(pa);
+  ++ctx->next_out;
+  // Drain consecutive parked responses into the same batch — but a
+  // progressive (chunked) response owns the connection from its headers
+  // until its terminating chunk, so the drain stops at the first entry
+  // carrying one: later responses' bytes must not land between the chunked
+  // headers and the attachment's terminator.
+  while (to_bind == nullptr) {
     auto it = ctx->parked.find(ctx->next_out);
     if (it == ctx->parked.end()) break;
     ready.append(std::move(it->second.buf));
     close_now = close_now || it->second.close;
-    if (it->second.pa != nullptr) {
-      to_bind.push_back(std::move(it->second.pa));
-    }
+    to_bind = std::move(it->second.pa);
     ctx->parked.erase(it);
+    ++ctx->next_out;
+  }
+  if (to_bind != nullptr) {
+    // Anything still parked can never be delivered on this connection
+    // (the progressive response holds it until close): abort, don't leak.
+    for (auto& kv : ctx->parked) {
+      if (kv.second.pa != nullptr) kv.second.pa->Abort();
+    }
+    ctx->parked.clear();
   }
   // A progressive response owns the connection until its final chunk:
   // swallow later pipelined requests, but do NOT CloseAfterFlush (the
   // attachment closes when destroyed).
-  if (close_now || !to_bind.empty()) ctx->closing = true;
+  if (close_now || to_bind != nullptr) ctx->closing = true;
+  if (to_bind != nullptr) ctx->owned = true;
   // The enqueue itself must happen under the lock: releasing first would
   // let a later seq that observes the bumped next_out reach the socket's
   // write chain ahead of this batch. Socket::Write is wait-free, so the
@@ -119,11 +139,11 @@ void WriteSequenced(Socket* s, uint64_t seq, IOBuf&& out, bool close,
   s->Write(&ready);
   // A close-announced response actually closes the connection once it has
   // reached the kernel (HTTP/1.0 clients wait for EOF).
-  if (close_now && to_bind.empty()) s->CloseAfterFlush();
+  if (close_now && to_bind == nullptr) s->CloseAfterFlush();
   lk.unlock();
   // Headers (and everything queued before them) are on the write chain in
-  // order; the attachments' direct writes can only land after them.
-  for (auto& bind : to_bind) bind->BindSocket(s->id());
+  // order; the attachment's direct writes can only land after them.
+  if (to_bind != nullptr) to_bind->BindSocket(s->id());
 }
 
 ParseResult HttpParse(IOBuf* source, IOBuf* msg, Socket* s) {

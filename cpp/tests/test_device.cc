@@ -6,10 +6,14 @@
 // Skips (exit 0, prints SKIP) when no PJRT plugin is loadable — the TPU
 // plugin needs live hardware; CI boxes without it still run the rest of the
 // suite.
+#include <unistd.h>
+
+#include <atomic>
 #include <cassert>
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <thread>
 
 #include "base/iobuf.h"
 #include "device/block_pool.h"
@@ -340,10 +344,36 @@ void test_gather_scatter(PjrtClient* client) {
   printf("  gather/scatter (PS embedding ops) ok\n");
 }
 
+// 0 = client init, 1 = tests running, 2 = done.
+std::atomic<int> g_watchdog_phase{0};
+
+// A wedged device tunnel makes PJRT_Client_Create block forever instead of
+// failing, which the "no plugin -> SKIP" path cannot catch. The watchdog
+// turns an init-phase hang into a loud SKIP (environment fault, exit 0) and
+// a post-init hang into a loud timeout (real failure, exit 124), so a plain
+// `for t in test_*; do ./$t; done` always completes unattended.
+void StartWatchdog() {
+  std::thread([] {
+    for (int i = 0; i < 60 && g_watchdog_phase.load() == 0; ++i) sleep(1);
+    if (g_watchdog_phase.load() == 0) {
+      printf("SKIP: PJRT client init exceeded 60s (device tunnel wedged?)\n");
+      fflush(stdout);
+      _exit(0);
+    }
+    for (int i = 0; i < 300 && g_watchdog_phase.load() == 1; ++i) sleep(1);
+    if (g_watchdog_phase.load() == 1) {
+      fprintf(stderr, "TIMEOUT: device tests exceeded 300s deadline\n");
+      fflush(nullptr);
+      _exit(124);
+    }
+  }).detach();
+}
+
 }  // namespace
 
 int main() {
   fiber_init(4);
+  StartWatchdog();
   std::string err;
   PjrtClient::Options opts;
   auto client = PjrtClient::Create(opts, &err);
@@ -351,6 +381,7 @@ int main() {
     printf("SKIP: no PJRT device available (%s)\n", err.c_str());
     return 0;
   }
+  g_watchdog_phase.store(1);
   printf("platform=%s devices=%d api_minor=%d\n",
          client->platform_name().c_str(),
          client->addressable_device_count(),
@@ -365,6 +396,7 @@ int main() {
   test_device_echo_rpc(client.get());
   test_compile_execute(client.get());
   test_gather_scatter(client.get());
+  g_watchdog_phase.store(2);
   printf("ALL device tests OK\n");
   return 0;
 }

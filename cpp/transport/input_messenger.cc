@@ -1,6 +1,7 @@
 #include "transport/input_messenger.h"
 
 #include <atomic>
+#include <mutex>
 
 #include <vector>
 
@@ -13,7 +14,10 @@ namespace brt {
 namespace {
 constexpr int kMaxProtocols = 32;
 Protocol g_protocols[kMaxProtocols];
-int g_nprotocols = 0;
+// release-stored after the slot is fully written; acquire loads on the
+// read side so GetProtocol/protocol_count never observe a half-written
+// Protocol during a concurrent lazy registration.
+std::atomic<int> g_nprotocols{0};
 }  // namespace
 
 // Scan order published as an immutable snapshot: RegisterProtocol may run
@@ -28,19 +32,27 @@ struct ScanOrder {
 std::atomic<const ScanOrder*> g_scan_order{nullptr};
 
 int RegisterProtocol(const Protocol& p) {
-  BRT_CHECK_LT(g_nprotocols, kMaxProtocols);
-  g_protocols[g_nprotocols] = p;
+  // Registration is reachable lazily (ServeRedisOn/ServeMongoOn/... each
+  // behind their own call_once), so two protocols may register
+  // concurrently; the snapshot swap protects readers, not writers.
+  static std::mutex g_register_mu;
+  std::lock_guard<std::mutex> lock(g_register_mu);
+  const int index = g_nprotocols.load(std::memory_order_relaxed);
+  BRT_CHECK_LT(index, kMaxProtocols);
+  g_protocols[index] = p;
   // Clamp: the rebuild below buckets by priority value.
-  if (g_protocols[g_nprotocols].scan_priority < 0) {
-    g_protocols[g_nprotocols].scan_priority = 0;
+  if (g_protocols[index].scan_priority < 0) {
+    g_protocols[index].scan_priority = 0;
   }
-  if (g_protocols[g_nprotocols].scan_priority > 100) {
-    g_protocols[g_nprotocols].scan_priority = 100;
+  if (g_protocols[index].scan_priority > 100) {
+    g_protocols[index].scan_priority = 100;
   }
-  const int index = g_nprotocols++;
+  // Publish the slot before the count: readers that see the bumped count
+  // are guaranteed a fully-written Protocol.
+  g_nprotocols.store(index + 1, std::memory_order_release);
   auto* next = new ScanOrder();  // leaked: readers may hold old snapshots
   for (int pri = 0; pri <= 100; ++pri) {
-    for (int i = 0; i < g_nprotocols; ++i) {
+    for (int i = 0; i <= index; ++i) {
       if (g_protocols[i].scan_priority == pri) next->order[next->n++] = i;
     }
   }
@@ -49,10 +61,13 @@ int RegisterProtocol(const Protocol& p) {
 }
 
 const Protocol* GetProtocol(int index) {
-  return (index >= 0 && index < g_nprotocols) ? &g_protocols[index] : nullptr;
+  const int n = g_nprotocols.load(std::memory_order_acquire);
+  return (index >= 0 && index < n) ? &g_protocols[index] : nullptr;
 }
 
-int protocol_count() { return g_nprotocols; }
+int protocol_count() {
+  return g_nprotocols.load(std::memory_order_acquire);
+}
 
 namespace {
 

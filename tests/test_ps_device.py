@@ -12,19 +12,39 @@ from brpc_tpu.ps_remote import DevicePsShardServer, RemoteEmbedding
 VOCAB, DIM = 16, 8
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
 def _axon_tunnel_alive() -> bool:
-    # The axon plugin talks to a local relay; when the relay is gone the
-    # plugin blocks forever instead of failing, so probe the port first.
+    # The axon plugin talks to a local relay; the relay's port being open is
+    # NOT enough (a wedged tunnel accepts connects but blocks client init
+    # forever), so probe by actually creating a device client in a child
+    # process under a hard deadline. Cached: the tunnel state won't flip
+    # mid-run, and the probe costs seconds.
+    import os
     import socket
+    import subprocess
+    import sys
+
     s = socket.socket()
     s.settimeout(0.5)
     try:
         s.connect(("127.0.0.1", 8082))
-        return True
     except OSError:
         return False
     finally:
         s.close()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from brpc_tpu import rpc; rpc.DeviceClient().close(); "
+             "print('ok')"],
+            capture_output=True, text=True, timeout=60, cwd=repo_root)
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+    return proc.returncode == 0 and "ok" in proc.stdout
 
 
 def _device_client():
