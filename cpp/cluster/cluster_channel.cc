@@ -87,6 +87,7 @@ int ClusterChannel::InitWithLb(const std::string& lb_name,
   lb_ = CreateLoadBalancer(lb_name);
   if (!lb_) return EINVAL;
   RegisterBrtProtocol();
+  if (InitTls() != 0) return EINVAL;
   inited_ = true;
   return 0;
 }
@@ -170,7 +171,8 @@ int ClusterChannel::IssueRPC(Controller* cntl) {
   SocketUniquePtr sock;
   rc = GetOrNewSocket(out.node.ep, options_.connection_type, &sock,
                       options_.connect_timeout_us,
-                      options_.connection_group);
+                      options_.connection_group, tls_ctx_.get(),
+                      options_.ssl_sni);
   if (rc != 0) {
     // Connect failure counts against the node, then the caller's retry
     // loop re-enters and excludes it.
@@ -191,6 +193,7 @@ int ClusterChannel::IssueRPC(Controller* cntl) {
   c.last_socket = sock->id();
   c.conn_type = int(options_.connection_type);
   c.conn_group = options_.connection_group;
+  c.conn_tls = tls_ctx_.get();
   sock->AddWaiter(c.cid);
   IOBuf frame;
   IOBuf body = c.request_body;

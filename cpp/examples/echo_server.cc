@@ -28,6 +28,11 @@ int main(int argc, char** argv) {
   server.AddService(&echo, "Echo");
   Server::Options opts;
   opts.concurrency_limiter = "auto";
+  // --ssl: TLS + plaintext sniffed on the same port (self-signed dev cert;
+  // try `curl -k https://...:port/status`).
+  for (int i = 1; i < argc; ++i) {
+    if (std::string(argv[i]) == "--ssl") opts.ssl.enable = true;
+  }
   if (server.Start("0.0.0.0:" + std::to_string(port), &opts) != 0) {
     fprintf(stderr, "start failed\n");
     return 1;

@@ -1,9 +1,11 @@
 #include "rpc/channel.h"
 
+#include "base/logging.h"
 #include "base/time.h"
 #include "rpc/compress.h"
 #include "rpc/protocol_brt.h"
 #include "rpc/span.h"
+#include "transport/tls.h"
 
 namespace brt {
 
@@ -26,10 +28,26 @@ int Channel::Init(const std::string& server_addr, const ChannelOptions* opts) {
   return Init(ep, opts);
 }
 
+int Channel::InitTls() {
+  if (!options_.use_ssl) return 0;
+  TlsOptions to;
+  to.verify_peer = options_.ssl_verify_peer;
+  to.ca_file = options_.ssl_ca_file;
+  to.alpn = options_.ssl_alpn;
+  std::string err;
+  tls_ctx_ = TlsContext::NewClient(to, &err);
+  if (tls_ctx_ == nullptr) {
+    BRT_LOG(ERROR) << "channel tls init failed: " << err;
+    return EINVAL;
+  }
+  return 0;
+}
+
 int Channel::Init(const EndPoint& server, const ChannelOptions* opts) {
   if (opts) options_ = *opts;
   server_ = server;
   RegisterBrtProtocol();
+  if (InitTls() != 0) return EINVAL;
   inited_ = true;
   return 0;
 }
@@ -142,7 +160,8 @@ int Channel::IssueRPC(Controller* cntl) {
   SocketUniquePtr sock;
   const int rc = GetOrNewSocket(server_, options_.connection_type, &sock,
                                 options_.connect_timeout_us,
-                                options_.connection_group);
+                                options_.connection_group, tls_ctx_.get(),
+                                options_.ssl_sni);
   if (rc != 0) {
     cntl->SetFailed(rc == ETIMEDOUT ? ECONNREFUSED : rc,
                     "fail to connect %s", server_.to_string().c_str());
@@ -159,6 +178,7 @@ int Channel::IssueRPC(Controller* cntl) {
   c.last_socket = sock->id();
   c.conn_type = int(options_.connection_type);
   c.conn_group = options_.connection_group;
+  c.conn_tls = tls_ctx_.get();
   // Register for failure notification BEFORE the bytes leave: a socket that
   // dies after a successful Write must still error this call.
   sock->AddWaiter(c.cid);

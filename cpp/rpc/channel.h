@@ -5,7 +5,9 @@
 // on top by cluster/cluster_channel.h.
 #pragma once
 
+#include <memory>
 #include <string>
+#include <vector>
 
 #include "rpc/authenticator.h"
 #include "rpc/controller.h"
@@ -35,6 +37,15 @@ struct ChannelOptions {
   // them (reference ChannelOptions.ns_filter, naming_service_filter.h).
   // Ownership stays with the caller; must outlive the channel.
   const class NamingServiceFilter* ns_filter = nullptr;
+  // Client TLS (reference ChannelOptions.has_ssl_options): connections to
+  // the server complete a TLS handshake before the first call. Default
+  // trust model accepts any cert (`curl -k`); set ssl_verify_peer (+
+  // ssl_ca_file) for chain verification.
+  bool use_ssl = false;
+  std::string ssl_sni;
+  bool ssl_verify_peer = false;
+  std::string ssl_ca_file;
+  std::vector<std::string> ssl_alpn;
 };
 
 // Anything callable like a channel: plain Channel, ClusterChannel, and the
@@ -73,9 +84,14 @@ class Channel : public ChannelBase, public CallIssuer {
   const EndPoint& server() const { return server_; }
 
  protected:
+  // Builds tls_ctx_ from options_ when use_ssl is set (shared by Channel
+  // and ClusterChannel inits). Returns 0 or EINVAL.
+  int InitTls();
+
   ChannelOptions options_;
   EndPoint server_;
   bool inited_ = false;
+  std::shared_ptr<class TlsContext> tls_ctx_;  // null for plaintext
 };
 
 }  // namespace brt

@@ -211,7 +211,8 @@ void Controller::EndRPC() {
   if (c.last_socket != INVALID_SOCKET_ID) {
     const ConnectionType ct = ConnectionType(c.conn_type);
     if (ct == ConnectionType::POOLED && error_code_ == 0) {
-      ReturnPooledSocket(remote_side_, c.last_socket, c.conn_group);
+      ReturnPooledSocket(remote_side_, c.last_socket, c.conn_group,
+                         c.conn_tls);
     } else if (ct == ConnectionType::SHORT ||
                (ct == ConnectionType::POOLED && error_code_ != 0)) {
       SocketUniquePtr p;

@@ -121,6 +121,7 @@ class Controller {
     SocketId last_socket = INVALID_SOCKET_ID;
     int conn_type = 0;   // ConnectionType; POOLED sockets return on success
     int conn_group = 0;  // SocketMap group the socket came from
+    class TlsContext* conn_tls = nullptr;  // SocketMap TLS key part
     // Cluster layer: endpoints already tried this call (reference
     // excluded_servers.h), and an end-of-call hook for LB feedback /
     // circuit breaker (reference LoadBalancer::Feedback +

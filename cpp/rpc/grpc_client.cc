@@ -9,6 +9,7 @@
 #include "fiber/sync.h"
 #include "rpc/hpack.h"
 #include "rpc/http2_protocol.h"
+#include "transport/tls.h"
 #include "transport/socket.h"
 
 namespace brt {
@@ -260,7 +261,7 @@ bool ProcessFrame(Socket* s, GrpcCore* core, uint8_t type, uint8_t flags,
 void* GrpcOnData(Socket* s) {
   auto* core = static_cast<GrpcCore*>(s->parsing_context());
   for (;;) {
-    ssize_t nr = core->inbuf.append_from_fd(s->fd());
+    ssize_t nr = s->AppendFromFd(&core->inbuf);
     if (nr == 0) {
       s->SetFailed(ECONNRESET, "grpc server closed");
       core->FailAll(ECONNRESET);
@@ -329,7 +330,8 @@ bool GrpcClient::connected() const {
          Socket::Address(impl_->sock, &p) == 0 && !p->Failed();
 }
 
-int GrpcClient::Connect(const EndPoint& server, int64_t timeout_ms) {
+int GrpcClient::Connect(const EndPoint& server, int64_t timeout_ms,
+                        bool use_tls) {
   fiber_init(0);
   auto* core = new GrpcCore;
   core->timeout_us = timeout_ms * 1000;
@@ -349,6 +351,29 @@ int GrpcClient::Connect(const EndPoint& server, int64_t timeout_ms) {
   impl_->sock = sid;
   SocketUniquePtr p;
   if (Socket::Address(impl_->sock, &p) != 0) return ECONNRESET;
+  if (use_tls) {
+    // Shared anonymous-trust h2 context; a failed creation is retried on
+    // the next Connect, not cached forever.
+    static std::mutex tls_mu;
+    static TlsContext* tls = nullptr;
+    {
+      std::lock_guard<std::mutex> g(tls_mu);
+      if (tls == nullptr) {
+        TlsOptions to;
+        to.alpn = {"h2"};
+        std::string err;
+        tls = TlsContext::NewClient(to, &err).release();
+        if (tls == nullptr) {
+          BRT_LOG(ERROR) << "grpc client tls context: " << err;
+          return EPROTO;
+        }
+      }
+    }
+    // SNI omitted: the endpoint is an IP literal (RFC 6066 forbids those
+    // in server_name); hostname-carrying callers use Channel's ssl_sni.
+    const int trc = p->StartTlsClient(tls, "", core->timeout_us);
+    if (trc != 0) return trc;
+  }
   IOBuf hello;
   hello.append(kPreface, sizeof(kPreface) - 1);
   AppendH2FrameHeader(&hello, 12, H2FrameType::SETTINGS, 0, 0);

@@ -30,7 +30,10 @@ class GrpcClient {
   GrpcClient();
   ~GrpcClient();
 
-  int Connect(const EndPoint& server, int64_t timeout_ms = 2000);
+  // use_tls: gRPC over TLS (ALPN "h2"; certs accepted unverified — the
+  // in-framework `curl -k` trust model).
+  int Connect(const EndPoint& server, int64_t timeout_ms = 2000,
+              bool use_tls = false);
 
   // Sync unary call: POST /<service>/<method>, body = one gRPC-framed
   // `request`. Concurrent Calls multiplex on the connection. Returns 0

@@ -2,9 +2,11 @@
 
 #include <atomic>
 
+#include "base/logging.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
 #include "transport/socket.h"
+#include "transport/tls.h"
 
 namespace brt {
 
@@ -60,7 +62,7 @@ void* FetchOnData(Socket* s) {
   IOPortal& in = s->read_buf;
   bool eof = false;
   for (;;) {
-    ssize_t nr = in.append_from_fd(s->fd());
+    ssize_t nr = s->AppendFromFd(&in);
     if (nr == 0) {
       eof = true;
       break;
@@ -97,10 +99,24 @@ void FetchOnFailed(Socket* s) {
 
 }  // namespace
 
+// Shared anonymous-trust client context (https without verification).
+// A failed creation is logged and retried next call, not cached forever.
+TlsContext* DefaultClientTls() {
+  static std::mutex mu;
+  static TlsContext* ctx = nullptr;
+  std::lock_guard<std::mutex> g(mu);
+  if (ctx == nullptr) {
+    std::string err;
+    ctx = TlsContext::NewClient(TlsOptions{}, &err).release();
+    if (ctx == nullptr) BRT_LOG(ERROR) << "https client tls context: " << err;
+  }
+  return ctx;
+}
+
 int HttpFetch(const EndPoint& server, const std::string& method,
               const std::string& path, const std::string& body,
               const std::string& content_type, HttpClientResult* out,
-              int64_t timeout_ms) {
+              int64_t timeout_ms, bool use_tls) {
   fiber_init(0);
   auto* ctx = new FetchCtx;
   ctx->out = out;
@@ -122,6 +138,13 @@ int HttpFetch(const EndPoint& server, const std::string& method,
   }
   SocketUniquePtr p;
   if (Socket::Address(sid, &p) != 0) return ECONNRESET;
+  if (use_tls) {
+    TlsContext* tls = DefaultClientTls();
+    if (tls == nullptr) return EPROTO;
+    // SNI omitted: endpoints here are IP literals (RFC 6066).
+    rc = p->StartTlsClient(tls, "", timeout_us);
+    if (rc != 0) return rc;
+  }
 
   HttpMessage req;
   req.method = method;

@@ -20,14 +20,21 @@ struct HttpClientResult {
 
 // Blocking GET/POST to host:port (fiber parks, worker stays free).
 // `path` includes query. Returns 0 or errno-style.
+// use_tls: speak https (certs accepted unverified — `curl -k` trust model).
 int HttpFetch(const EndPoint& server, const std::string& method,
               const std::string& path, const std::string& body,
               const std::string& content_type, HttpClientResult* out,
-              int64_t timeout_ms = 5000);
+              int64_t timeout_ms = 5000, bool use_tls = false);
 
 inline int HttpGet(const EndPoint& server, const std::string& path,
                    HttpClientResult* out, int64_t timeout_ms = 5000) {
   return HttpFetch(server, "GET", path, "", "", out, timeout_ms);
+}
+
+inline int HttpsGet(const EndPoint& server, const std::string& path,
+                    HttpClientResult* out, int64_t timeout_ms = 5000) {
+  return HttpFetch(server, "GET", path, "", "", out, timeout_ms,
+                   /*use_tls=*/true);
 }
 
 }  // namespace brt

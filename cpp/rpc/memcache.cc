@@ -74,7 +74,7 @@ struct MemcacheClient::Impl {
 void* MemcacheClient::Impl::OnData(Socket* s) {
   auto* impl = static_cast<MemcacheClient::Impl*>(s->user());
   for (;;) {
-    ssize_t nr = impl->inbuf.append_from_fd(s->fd());
+    ssize_t nr = s->AppendFromFd(&impl->inbuf);
     if (nr == 0) {
       s->SetFailed(ECONNRESET, "memcache server closed");
       impl->Fail(ECONNRESET);

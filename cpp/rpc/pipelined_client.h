@@ -135,7 +135,7 @@ class PipelinedClient {
   static void* OnData(Socket* s) {
     auto* core = static_cast<Core*>(s->parsing_context());
     for (;;) {
-      ssize_t nr = core->inbuf.append_from_fd(s->fd());
+      ssize_t nr = s->AppendFromFd(&core->inbuf);
       if (nr == 0) {
         s->SetFailed(ECONNRESET, "pipelined server closed");
         core->FailAll(ECONNRESET);

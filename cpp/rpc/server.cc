@@ -11,6 +11,7 @@
 #include "rpc/rpc_dump.h"
 #include "rpc/span.h"
 #include "transport/input_messenger.h"
+#include "transport/tls.h"
 #include "var/default_variables.h"
 
 namespace brt {
@@ -71,6 +72,22 @@ int Server::Start(const EndPoint& addr, const Options* opts) {
   acceptor_.conn_options.user = this;
   acceptor_.conn_options.on_edge_triggered = InputMessengerOnEdgeTriggered;
   acceptor_.conn_options.run_deferred = InputMessengerProcessDeferred;
+  if (options_.ssl.enable) {
+    TlsOptions to;
+    to.cert_file = options_.ssl.cert_file;
+    to.key_file = options_.ssl.key_file;
+    to.cert_pem = options_.ssl.cert_pem;
+    to.key_pem = options_.ssl.key_pem;
+    to.alpn = options_.ssl.alpn;
+    std::string err;
+    tls_ctx_ = TlsContext::NewServer(to, &err);
+    if (tls_ctx_ == nullptr) {
+      BRT_LOG(ERROR) << "server tls init failed: " << err;
+      running_.store(false);
+      return EINVAL;
+    }
+    acceptor_.conn_options.tls_server_ctx = tls_ctx_.get();
+  }
   int rc = acceptor_.StartAccept(addr);
   if (rc != 0) {
     running_.store(false);

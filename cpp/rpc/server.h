@@ -16,6 +16,7 @@
 
 #include "rpc/authenticator.h"
 #include "rpc/concurrency_limiter.h"
+#include "transport/tls.h"
 #include "rpc/controller.h"
 #include "rpc/json.h"
 #include "transport/acceptor.h"
@@ -94,6 +95,19 @@ class Server {
     // Pooled per-request user data (Controller::session_local_data()).
     // Ownership stays with the caller; must outlive the server.
     const DataFactory* session_local_data_factory = nullptr;
+    // TLS on the listening port (reference ServerOptions ssl options +
+    // details/ssl_helper.cpp): TLS and plaintext are sniffed on the SAME
+    // port, so every registered protocol is speakable over both. Empty
+    // cert material generates a self-signed dev cert.
+    struct SslOptions {
+      bool enable = false;
+      std::string cert_file;
+      std::string key_file;
+      std::string cert_pem;
+      std::string key_pem;
+      std::vector<std::string> alpn = {"h2", "http/1.1"};
+    };
+    SslOptions ssl;
   };
 
   Server() = default;
@@ -198,6 +212,7 @@ class Server {
   std::atomic<int> concurrency_{0};
   std::atomic<bool> running_{false};
   std::unique_ptr<ConcurrencyLimiter> limiter_;
+  std::unique_ptr<TlsContext> tls_ctx_;  // when options_.ssl.enable
   std::mutex session_pool_mu_;
   std::vector<void*> session_pool_;
 };

@@ -14,12 +14,14 @@ namespace {
 struct MapKey {
   EndPoint ep;
   int group;
+  const TlsContext* tls;  // distinct contexts never share connections
   bool operator==(const MapKey&) const = default;
 };
 
 struct MapKeyHash {
   size_t operator()(const MapKey& k) const {
-    return (size_t(k.ep.ip) << 16) ^ k.ep.port ^ (size_t(k.group) << 48);
+    return (size_t(k.ep.ip) << 16) ^ k.ep.port ^ (size_t(k.group) << 48) ^
+           (reinterpret_cast<uintptr_t>(k.tls) >> 4);
   }
 };
 
@@ -35,7 +37,8 @@ auto& g_mu = *new std::shared_mutex();
 auto& g_map = *new std::unordered_map<MapKey, Entry, MapKeyHash>();
 
 int NewConnection(const EndPoint& remote, SocketUniquePtr* out,
-                  int64_t timeout_us) {
+                  int64_t timeout_us, TlsContext* tls,
+                  const std::string& sni) {
   Socket::Options opts;
   opts.on_edge_triggered = InputMessengerOnEdgeTriggered;
   opts.run_deferred = InputMessengerProcessDeferred;
@@ -52,6 +55,13 @@ int NewConnection(const EndPoint& remote, SocketUniquePtr* out,
     out->reset();
     return rc ? rc : ECONNREFUSED;
   }
+  if (tls != nullptr) {
+    rc = (*out)->StartTlsClient(tls, sni, timeout_us);
+    if (rc != 0) {
+      out->reset();
+      return rc;
+    }
+  }
   return 0;
 }
 
@@ -59,10 +69,10 @@ int NewConnection(const EndPoint& remote, SocketUniquePtr* out,
 
 int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
                    SocketUniquePtr* out, int64_t connect_timeout_us,
-                   int group) {
-  const MapKey key{remote, group};
+                   int group, TlsContext* tls, const std::string& sni) {
+  const MapKey key{remote, group, tls};
   if (type == ConnectionType::SHORT) {
-    return NewConnection(remote, out, connect_timeout_us);
+    return NewConnection(remote, out, connect_timeout_us, tls, sni);
   }
   if (type == ConnectionType::POOLED) {
     for (;;) {
@@ -77,7 +87,7 @@ int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
       if (Socket::Address(sid, out) == 0 && !(*out)->Failed()) return 0;
       out->reset();
     }
-    return NewConnection(remote, out, connect_timeout_us);
+    return NewConnection(remote, out, connect_timeout_us, tls, sni);
   }
   // SINGLE: shared multiplexed socket.
   {
@@ -93,7 +103,7 @@ int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
   // Connect OUTSIDE g_mu: a failing connect runs the socket's on_failed
   // (→ RemoveSingleSocket) on this thread, which must be free to relock.
   // Losers of a concurrent-connect race close their extra socket.
-  int rc = NewConnection(remote, out, connect_timeout_us);
+  int rc = NewConnection(remote, out, connect_timeout_us, tls, sni);
   if (rc != 0) return rc;
   std::unique_lock lk(g_mu);
   auto& e = g_map[key];
@@ -111,11 +121,12 @@ int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
   return 0;
 }
 
-void ReturnPooledSocket(const EndPoint& remote, SocketId sid, int group) {
+void ReturnPooledSocket(const EndPoint& remote, SocketId sid, int group,
+                        TlsContext* tls) {
   SocketUniquePtr p;
   if (Socket::Address(sid, &p) != 0 || p->Failed()) return;
   std::unique_lock lk(g_mu);
-  g_map[MapKey{remote, group}].pooled.push_back(sid);
+  g_map[MapKey{remote, group, tls}].pooled.push_back(sid);
 }
 
 void RemoveSingleSocket(const EndPoint& remote, SocketId sid) {

@@ -20,11 +20,16 @@ enum class ConnectionType { SINGLE, POOLED, SHORT };
 // channels wanting a private connection pass a distinct group).
 // For POOLED/SHORT an exclusive socket is returned; give it back with
 // ReturnPooledSocket (POOLED) or just SetFailed+drop it (SHORT).
+// When `tls` (a CLIENT TlsContext) is set, new connections complete a TLS
+// handshake before being returned/cached; the context pointer is part of
+// the pool key so TLS and plaintext connections never mix.
 int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
                    SocketUniquePtr* out, int64_t connect_timeout_us,
-                   int group = 0);
+                   int group = 0, class TlsContext* tls = nullptr,
+                   const std::string& sni = "");
 
-void ReturnPooledSocket(const EndPoint& remote, SocketId sid, int group = 0);
+void ReturnPooledSocket(const EndPoint& remote, SocketId sid, int group = 0,
+                        class TlsContext* tls = nullptr);
 
 // Drops the cached SINGLE socket for `remote` if it matches sid (called on
 // failure so the next call reconnects).

@@ -186,7 +186,7 @@ struct ThriftClient::Impl {
 void* ThriftClient::Impl::OnData(Socket* s) {
   auto* impl = static_cast<ThriftClient::Impl*>(s->user());
   for (;;) {
-    ssize_t nr = impl->inbuf.append_from_fd(s->fd());
+    ssize_t nr = s->AppendFromFd(&impl->inbuf);
     if (nr == 0) {
       s->SetFailed(ECONNRESET, "thrift server closed");
       impl->Fail("connection closed");

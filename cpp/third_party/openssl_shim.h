@@ -1,0 +1,154 @@
+// Minimal OpenSSL 3 ABI declarations.
+//
+// This image ships libssl.so.3 / libcrypto.so.3 but NO OpenSSL development
+// headers, so — exactly like third_party/pjrt/pjrt_c_api.h for the PJRT
+// ABI — the subset of the stable public OpenSSL 3.0 C ABI that the TLS
+// tier (transport/tls.cc) uses is declared here by hand. Every function
+// below is a real exported symbol (verified with nm -D against the runtime
+// libraries); the few upstream convenience macros (SSL_CTX_set_min_proto_
+// version, BIO_get_mem_data, ...) are reproduced as inline wrappers over
+// the exported *_ctrl entry points with their documented command codes.
+//
+// Signatures and constants follow the OpenSSL 3.0 public documentation;
+// all object types are opaque.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+typedef struct ssl_ctx_st SSL_CTX;
+typedef struct ssl_st SSL;
+typedef struct ssl_method_st SSL_METHOD;
+typedef struct bio_st BIO;
+typedef struct bio_method_st BIO_METHOD;
+typedef struct x509_st X509;
+typedef struct X509_name_st X509_NAME;
+typedef struct x509_store_ctx_st X509_STORE_CTX;
+typedef struct evp_pkey_st EVP_PKEY;
+typedef struct evp_md_st EVP_MD;
+typedef struct evp_cipher_st EVP_CIPHER;
+typedef struct asn1_string_st ASN1_INTEGER;
+typedef struct asn1_string_st ASN1_TIME;
+typedef struct ossl_lib_ctx_st OSSL_LIB_CTX;
+typedef int pem_password_cb(char* buf, int size, int rwflag, void* userdata);
+
+// ---- libssl ----
+int OPENSSL_init_ssl(uint64_t opts, const void* settings);
+SSL_CTX* SSL_CTX_new(const SSL_METHOD* meth);
+void SSL_CTX_free(SSL_CTX* ctx);
+const SSL_METHOD* TLS_server_method(void);
+const SSL_METHOD* TLS_client_method(void);
+long SSL_CTX_ctrl(SSL_CTX* ctx, int cmd, long larg, void* parg);
+int SSL_CTX_use_certificate(SSL_CTX* ctx, X509* x);
+int SSL_CTX_use_certificate_chain_file(SSL_CTX* ctx, const char* file);
+int SSL_CTX_use_PrivateKey(SSL_CTX* ctx, EVP_PKEY* pkey);
+int SSL_CTX_use_PrivateKey_file(SSL_CTX* ctx, const char* file, int type);
+int SSL_CTX_check_private_key(const SSL_CTX* ctx);
+typedef int (*SSL_verify_cb)(int preverify_ok, X509_STORE_CTX* ctx);
+void SSL_CTX_set_verify(SSL_CTX* ctx, int mode, SSL_verify_cb callback);
+int SSL_CTX_load_verify_locations(SSL_CTX* ctx, const char* CAfile,
+                                  const char* CApath);
+int SSL_CTX_set_default_verify_paths(SSL_CTX* ctx);
+typedef int (*SSL_CTX_alpn_select_cb_func)(SSL* ssl, const unsigned char** out,
+                                           unsigned char* outlen,
+                                           const unsigned char* in,
+                                           unsigned int inlen, void* arg);
+void SSL_CTX_set_alpn_select_cb(SSL_CTX* ctx, SSL_CTX_alpn_select_cb_func cb,
+                                void* arg);
+int SSL_CTX_set_alpn_protos(SSL_CTX* ctx, const unsigned char* protos,
+                            unsigned int protos_len);
+SSL* SSL_new(SSL_CTX* ctx);
+void SSL_free(SSL* ssl);
+void SSL_set_bio(SSL* s, BIO* rbio, BIO* wbio);
+void SSL_set_accept_state(SSL* s);
+void SSL_set_connect_state(SSL* s);
+long SSL_ctrl(SSL* ssl, int cmd, long larg, void* parg);
+int SSL_do_handshake(SSL* s);
+int SSL_is_init_finished(const SSL* s);
+int SSL_read(SSL* ssl, void* buf, int num);
+int SSL_write(SSL* ssl, const void* buf, int num);
+int SSL_get_error(const SSL* s, int ret_code);
+void SSL_get0_alpn_selected(const SSL* ssl, const unsigned char** data,
+                            unsigned int* len);
+
+// ---- libcrypto ----
+BIO* BIO_new(const BIO_METHOD* type);
+const BIO_METHOD* BIO_s_mem(void);
+long BIO_ctrl(BIO* bp, int cmd, long larg, void* parg);
+int BIO_read(BIO* b, void* data, int dlen);
+int BIO_write(BIO* b, const void* data, int dlen);
+BIO* BIO_new_mem_buf(const void* buf, int len);
+int BIO_free(BIO* a);
+size_t BIO_ctrl_pending(BIO* b);
+X509* PEM_read_bio_X509(BIO* bp, X509** x, pem_password_cb* cb, void* u);
+EVP_PKEY* PEM_read_bio_PrivateKey(BIO* bp, EVP_PKEY** x, pem_password_cb* cb,
+                                  void* u);
+int PEM_write_bio_X509(BIO* bp, X509* x);
+int PEM_write_bio_PrivateKey(BIO* bp, const EVP_PKEY* x,
+                             const EVP_CIPHER* enc, const unsigned char* kstr,
+                             int klen, pem_password_cb* cb, void* u);
+X509* X509_new(void);
+void X509_free(X509* a);
+ASN1_INTEGER* X509_get_serialNumber(X509* x);
+int ASN1_INTEGER_set(ASN1_INTEGER* a, long v);
+ASN1_TIME* X509_gmtime_adj(ASN1_TIME* s, long adj);
+ASN1_TIME* X509_getm_notBefore(const X509* x);
+ASN1_TIME* X509_getm_notAfter(const X509* x);
+int X509_set_pubkey(X509* x, EVP_PKEY* pkey);
+X509_NAME* X509_get_subject_name(const X509* a);
+int X509_NAME_add_entry_by_txt(X509_NAME* name, const char* field, int type,
+                               const unsigned char* bytes, int len, int loc,
+                               int set);
+int X509_set_issuer_name(X509* x, X509_NAME* name);
+int X509_sign(X509* x, EVP_PKEY* pkey, const EVP_MD* md);
+const EVP_MD* EVP_sha256(void);
+EVP_PKEY* EVP_PKEY_Q_keygen(OSSL_LIB_CTX* libctx, const char* propq,
+                            const char* type, ...);
+void EVP_PKEY_free(EVP_PKEY* pkey);
+unsigned long ERR_get_error(void);
+void ERR_error_string_n(unsigned long e, char* buf, size_t len);
+void ERR_clear_error(void);
+
+}  // extern "C"
+
+// ---- documented constants (OpenSSL 3.0 public headers) ----
+#define SSL_ERROR_WANT_READ 2
+#define SSL_ERROR_WANT_WRITE 3
+#define SSL_ERROR_ZERO_RETURN 6
+#define SSL_VERIFY_NONE 0x00
+#define SSL_VERIFY_PEER 0x01
+#define SSL_FILETYPE_PEM 1
+#define TLS1_2_VERSION 0x0303
+#define SSL_TLSEXT_ERR_OK 0
+#define SSL_TLSEXT_ERR_NOACK 3
+#define TLSEXT_NAMETYPE_host_name 0
+#define MBSTRING_ASC 0x1001
+
+#define OPENSSL_INIT_NO_ATEXIT 0x00080000L
+
+// ctrl command codes backing the upstream convenience macros.
+#define SSL_CTRL_EXTRA_CHAIN_CERT 14
+#define SSL_CTRL_SET_TLSEXT_HOSTNAME 55
+#define SSL_CTRL_SET_MIN_PROTO_VERSION 123
+#define BIO_CTRL_INFO 3
+#define BIO_C_SET_BUF_MEM_EOF_RETURN 130
+
+// Upstream convenience macros, reproduced as inline wrappers.
+inline long SSL_CTX_set_min_proto_version(SSL_CTX* ctx, int version) {
+  return SSL_CTX_ctrl(ctx, SSL_CTRL_SET_MIN_PROTO_VERSION, version, nullptr);
+}
+inline long SSL_CTX_add_extra_chain_cert(SSL_CTX* ctx, X509* x) {
+  return SSL_CTX_ctrl(ctx, SSL_CTRL_EXTRA_CHAIN_CERT, 0, x);
+}
+inline long SSL_set_tlsext_host_name(SSL* s, const char* name) {
+  return SSL_ctrl(s, SSL_CTRL_SET_TLSEXT_HOSTNAME, TLSEXT_NAMETYPE_host_name,
+                  const_cast<char*>(name));
+}
+inline long BIO_set_mem_eof_return(BIO* b, long v) {
+  return BIO_ctrl(b, BIO_C_SET_BUF_MEM_EOF_RETURN, v, nullptr);
+}
+inline long BIO_get_mem_data(BIO* b, char** pp) {
+  return BIO_ctrl(b, BIO_CTRL_INFO, 0, pp);
+}

@@ -141,7 +141,7 @@ void* InputMessengerOnEdgeTriggered(Socket* s) {
   int pending_err = 0;
   const char* pending_msg = nullptr;
   for (;;) {
-    ssize_t nr = portal.append_from_fd(s->fd());
+    ssize_t nr = s->AppendFromFd(&portal);
     if (nr == 0) {
       pending_err = ECONNRESET;
       pending_msg = "peer closed connection";

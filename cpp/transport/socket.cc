@@ -16,6 +16,7 @@
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "transport/event_dispatcher.h"
+#include "transport/tls.h"
 
 namespace brt {
 
@@ -29,6 +30,7 @@ Socket::WriteReq* GetWriteReq() {
   Socket::WriteReq* r = WriteReqPool::Get();
   r->next.store(nullptr, std::memory_order_relaxed);
   r->cid = 0;
+  r->raw = false;
   return r;
 }
 
@@ -142,7 +144,10 @@ int Socket::Create(const Options& opts, SocketId* id_out) {
   // would kill this one at its first write-chain drain.
   s->close_after_flush_.store(false, std::memory_order_relaxed);
   s->read_buf.clear();
+  s->tls_wire_buf.clear();
   s->waiters_.clear();
+  s->tls_.store(nullptr, std::memory_order_relaxed);
+  s->tls_server_ctx_ = opts.tls_server_ctx;
   if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
   s->write_head_.store(nullptr, std::memory_order_relaxed);
   s->id_ = (uint64_t(v) << 32) | index;
@@ -213,6 +218,10 @@ void Socket::OnRecycle() {
     BRT_LOG(ERROR) << "write chain not empty at recycle, leaking it";
   }
   read_buf.clear();
+  tls_wire_buf.clear();
+  TlsSession* tls = tls_.exchange(nullptr, std::memory_order_acq_rel);
+  delete tls;
+  tls_server_ctx_ = nullptr;
   if (parsing_context_ != nullptr) {
     if (parsing_context_destroyer_) parsing_context_destroyer_(parsing_context_);
     parsing_context_ = nullptr;
@@ -240,6 +249,10 @@ void Socket::SetFailed(int err, const char* fmt, ...) {
   // Wake EPOLLOUT waiters so KeepWrite notices the failure.
   butex_value(epollout_butex_).fetch_add(1, std::memory_order_release);
   butex_wake_all(epollout_butex_);
+  // A handshake waiter must not sleep to its timeout on a dead socket.
+  if (TlsSession* tls = tls_.load(std::memory_order_acquire)) {
+    tls->FailHandshake();
+  }
   // Error every in-flight RPC whose response can no longer arrive
   // (reference id-wait-list semantics).
   std::vector<fid_t> waiters;
@@ -301,6 +314,23 @@ int Socket::Write(IOBuf* data, fid_t cid) {
   return FlushWriteChain(req, /*in_keepwrite_fiber=*/false);
 }
 
+int Socket::WriteWire(IOBuf* data) {
+  int err = failed_.load(std::memory_order_acquire);
+  if (err != 0) {
+    data->clear();
+    return err;
+  }
+  WriteReq* req = GetWriteReq();
+  req->data.swap(*data);
+  req->raw = true;
+  WriteReq* prev = write_head_.exchange(req, std::memory_order_acq_rel);
+  if (prev != nullptr) {
+    prev->next.store(req, std::memory_order_release);
+    return 0;
+  }
+  return FlushWriteChain(req, /*in_keepwrite_fiber=*/false);
+}
+
 struct KeepWriteArg {
   SocketId sid;
   Socket::WriteReq* cur;
@@ -327,6 +357,20 @@ void* Socket::KeepWriteEntry(void* argp) {
 
 int Socket::FlushWriteChain(WriteReq* cur, bool in_keepwrite_fiber) {
   for (;;) {
+    // TLS: encrypt the request's plaintext into wire records. Exactly one
+    // flusher runs at a time, so the session sees writes in chain order;
+    // raw is flipped so a KeepWrite handoff can't double-encrypt.
+    TlsSession* tls = tls_.load(std::memory_order_acquire);
+    if (tls != nullptr && !cur->raw && !cur->data.empty()) {
+      IOBuf wire;
+      if (tls->Encrypt(&cur->data, &wire) != 0) {
+        SetFailed(EPROTO, "tls encrypt failed");
+        ReleaseChainOnError(cur, EPROTO);
+        return EPROTO;
+      }
+      cur->data.swap(wire);
+      cur->raw = true;
+    }
     // Drain cur->data into the fd.
     while (!cur->data.empty()) {
       ssize_t nw = cur->data.cut_into_writev(fd_);
@@ -483,6 +527,119 @@ int Socket::Connect(const EndPoint& remote, const Options& opts,
     }
   }
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// TLS read seam + client handshake.
+// ---------------------------------------------------------------------------
+ssize_t Socket::AppendFromFd(IOPortal* out) {
+  TlsSession* tls = tls_.load(std::memory_order_acquire);
+  if (tls == nullptr && tls_server_ctx_ == nullptr) {
+    return out->append_from_fd(fd_);  // plaintext fast path
+  }
+  const size_t before = out->size();
+  IOBuf wire_out;
+  int rc = 0;
+  if (tls == nullptr) {
+    // Server-side sniff (only the single active read fiber gets here,
+    // before any plaintext has ever been delivered): the first byte
+    // decides — 0x16 is a TLS handshake record, nothing any supported
+    // plaintext protocol starts with.
+    ssize_t nr = out->append_from_fd(fd_);
+    if (nr <= 0) return nr;
+    char b0 = 0;
+    out->copy_to(&b0, 1, before);
+    if (uint8_t(b0) != 0x16) {
+      tls_server_ctx_ = nullptr;  // plaintext connection: stop sniffing
+      return nr;
+    }
+    std::string err;
+    TlsSession* sess = TlsSession::New(tls_server_ctx_, "", &err);
+    if (sess == nullptr) {
+      BRT_LOG(WARNING) << "tls session create failed: " << err;
+      errno = EPROTO;
+      return -1;
+    }
+    tls_.store(sess, std::memory_order_release);
+    tls = sess;
+    // The sniffed bytes are wire data for the session, not app plaintext.
+    IOBuf wire;
+    out->cutn(&wire, out->size() - before);
+    rc = tls->OnWireData(&wire, out, &wire_out);
+  }
+  // Drain the fd (edge-triggered contract — returning EAGAIN with wire
+  // bytes still readable would lose the edge), decrypt, hand plaintext to
+  // the caller.
+  bool saw_eof = false;
+  if (rc == 0) {
+    for (;;) {
+      ssize_t nr = tls_wire_buf.append_from_fd(fd_);
+      if (nr > 0) {
+        if (tls_wire_buf.size() >= 512 * 1024) break;  // fairness bound
+        continue;
+      }
+      if (nr == 0) {
+        saw_eof = true;
+        break;
+      }
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      return -1;  // real IO error, errno set
+    }
+    IOBuf wire_in;
+    tls_wire_buf.cutn(&wire_in, tls_wire_buf.size());
+    rc = tls->OnWireData(&wire_in, out, &wire_out);
+  }
+  if (!wire_out.empty()) WriteWire(&wire_out);
+  // Publish handshake completion only now — after the final handshake
+  // record is on the write chain — so a woken writer's first encrypted
+  // app record cannot overtake it.
+  tls->PublishHandshakeState();
+  if (rc == EPROTO) {
+    errno = EPROTO;
+    return -1;
+  }
+  if (out->size() > before) return ssize_t(out->size() - before);
+  if (saw_eof || rc == ESHUTDOWN) return 0;
+  errno = EAGAIN;
+  return -1;
+}
+
+int Socket::StartTlsClient(TlsContext* ctx, const std::string& sni,
+                           int64_t timeout_us) {
+  std::string err;
+  TlsSession* sess = TlsSession::New(ctx, sni, &err);
+  if (sess == nullptr) {
+    SetFailed(EPROTO, "tls session create failed: %s", err.c_str());
+    return EPROTO;
+  }
+  IOBuf first;
+  if (sess->Pump(&first) != 0) {
+    delete sess;
+    SetFailed(EPROTO, "tls client hello failed");
+    return EPROTO;
+  }
+  // Publish BEFORE the first flight hits the wire: the server's reply may
+  // arrive (and must decrypt) on the read fiber immediately after.
+  tls_.store(sess, std::memory_order_release);
+  // A failure that landed before the publish (instant RST consumed by the
+  // plaintext read path) skipped FailHandshake — re-check so the waiter
+  // below cannot sleep to its timeout on a dead socket.
+  if (Failed()) {
+    sess->FailHandshake();
+    return error_code();
+  }
+  int wrc = first.empty() ? 0 : WriteWire(&first);
+  if (wrc != 0) {
+    sess->FailHandshake();
+    return wrc;
+  }
+  int rc = sess->WaitHandshake(timeout_us);
+  if (rc != 0) {
+    SetFailed(rc, "tls handshake %s",
+              rc == ETIMEDOUT ? "timeout" : "failed");
+  }
+  return rc;
 }
 
 void Socket::ListSockets(std::vector<SocketId>* out) {

@@ -25,6 +25,8 @@ namespace brt {
 
 class Socket;
 class EventDispatcher;
+class TlsContext;
+class TlsSession;
 using SocketId = uint64_t;
 constexpr SocketId INVALID_SOCKET_ID = 0;
 
@@ -87,6 +89,11 @@ class Socket {
     void* initial_parsing_context = nullptr;
     void (*parsing_context_destroyer)(void*) = nullptr;
     int dispatcher_index = -1;  // -1: shard by fd
+    // Server-side TLS: when set, the connection's first bytes are sniffed
+    // (0x16 handshake record => TLS session; anything else => plaintext on
+    // the same port — the reference's ssl-vs-plaintext sniffing). Ownership
+    // stays with the server; must outlive the socket.
+    TlsContext* tls_server_ctx = nullptr;
   };
 
   // Wraps an existing connected/listening fd, registers it with the event
@@ -155,6 +162,26 @@ class Socket {
 
   // Ingestion buffer (only touched by the single active read fiber).
   IOPortal read_buf;
+  // Wire-side staging for TLS sockets (ciphertext before decryption);
+  // persistent so IOPortal's partial-block reuse works per connection.
+  IOPortal tls_wire_buf;
+
+  // The ONE read seam: reads the fd into *out. Plaintext sockets readv
+  // straight into the portal; TLS sockets (or server-side TLS candidates
+  // still sniffing) decrypt first, so every caller parses plaintext
+  // unchanged. Same contract as IOPortal::append_from_fd: >0 bytes
+  // appended, 0 EOF, -1 with errno (EAGAIN = nothing yet).
+  ssize_t AppendFromFd(IOPortal* out);
+
+  // Client-side TLS: starts the handshake and parks the calling fiber
+  // until it completes (the read path must be live — handshake replies
+  // arrive through AppendFromFd). Call before the first Write. Returns 0,
+  // ETIMEDOUT or EPROTO (socket failed on error).
+  int StartTlsClient(TlsContext* ctx, const std::string& sni,
+                     int64_t timeout_us);
+
+  // Live TLS session (null for plaintext connections). alpn() etc.
+  TlsSession* tls() const { return tls_.load(std::memory_order_acquire); }
 
   // Parking spot for fibers waiting for EPOLLOUT (value bumped + woken by
   // the dispatcher on writable events).
@@ -178,6 +205,9 @@ class Socket {
   struct WriteReq {
     IOBuf data;
     fid_t cid = 0;
+    // Bytes are already wire-format (TLS handshake records / encrypted):
+    // the flusher must not run them through the session again.
+    bool raw = false;
     std::atomic<WriteReq*> next{nullptr};
   };
 
@@ -211,6 +241,10 @@ class Socket {
   void (*parsing_context_destroyer_)(void*) = nullptr;
   std::atomic<bool> close_after_flush_{false};
   std::atomic<WriteReq*> write_head_{nullptr};  // MPSC chain, Vyukov-style
+  // Wire-format write that bypasses TLS encryption (handshake replies).
+  int WriteWire(IOBuf* data);
+  std::atomic<TlsSession*> tls_{nullptr};  // owned; freed at recycle
+  TlsContext* tls_server_ctx_ = nullptr;   // sniffing candidate (server)
   std::mutex waiters_mu_;
   std::vector<fid_t> waiters_;  // in-flight RPC ids awaiting responses
   Butex* epollout_butex_ = nullptr;

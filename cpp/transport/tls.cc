@@ -1,0 +1,430 @@
+#include "transport/tls.h"
+
+#include <cerrno>
+#include <cstring>
+
+#include "third_party/openssl_shim.h"
+
+#include "base/logging.h"
+
+namespace brt {
+
+namespace {
+
+std::string OpensslError(const char* what) {
+  char buf[256];
+  unsigned long e = ERR_get_error();
+  ERR_error_string_n(e, buf, sizeof(buf));
+  ERR_clear_error();
+  std::string s(what);
+  s += ": ";
+  s += buf;
+  return s;
+}
+
+void InitOpenssl() {
+  static int once = [] {
+    // NO_ATEXIT: detached read fibers may still be inside SSL calls when
+    // main returns; OPENSSL_cleanup would free the error-string locks
+    // under them (the same reason every singleton in this runtime is
+    // leaked, not destroyed at exit).
+    OPENSSL_init_ssl(OPENSSL_INIT_NO_ATEXIT, nullptr);
+    return 0;
+  }();
+  (void)once;
+}
+
+// {"h2","http/1.1"} -> length-prefixed wire format.
+std::vector<unsigned char> AlpnWire(const std::vector<std::string>& protos) {
+  std::vector<unsigned char> w;
+  for (const auto& p : protos) {
+    if (p.empty() || p.size() > 255) continue;
+    w.push_back(static_cast<unsigned char>(p.size()));
+    w.insert(w.end(), p.begin(), p.end());
+  }
+  return w;
+}
+
+// Server ALPN selection: first of OUR protocols the client offered.
+int AlpnSelectCb(SSL* ssl, const unsigned char** out, unsigned char* outlen,
+                 const unsigned char* in, unsigned int inlen, void* arg) {
+  (void)ssl;
+  auto* ours = static_cast<std::vector<unsigned char>*>(arg);
+  for (size_t o = 0; o + 1 <= ours->size();) {
+    const unsigned char olen = (*ours)[o];
+    for (unsigned int i = 0; i + 1 <= inlen;) {
+      const unsigned char ilen = in[i];
+      if (ilen == olen && i + 1 + ilen <= inlen &&
+          memcmp(&(*ours)[o + 1], in + i + 1, ilen) == 0) {
+        *out = in + i + 1;
+        *outlen = ilen;
+        return SSL_TLSEXT_ERR_OK;
+      }
+      i += 1 + ilen;
+    }
+    o += 1 + olen;
+  }
+  return SSL_TLSEXT_ERR_NOACK;
+}
+
+int UsePem(SSL_CTX* ctx, const TlsOptions& o, std::string* err) {
+  // Certificate (chain).
+  if (!o.cert_pem.empty()) {
+    BIO* b = BIO_new_mem_buf(o.cert_pem.data(), int(o.cert_pem.size()));
+    X509* x = PEM_read_bio_X509(b, nullptr, nullptr, nullptr);
+    if (x == nullptr || SSL_CTX_use_certificate(ctx, x) != 1) {
+      if (x) X509_free(x);
+      BIO_free(b);
+      *err = OpensslError("use_certificate");
+      return EINVAL;
+    }
+    X509_free(x);
+    // Remaining PEM blocks are the chain.
+    for (;;) {
+      X509* extra = PEM_read_bio_X509(b, nullptr, nullptr, nullptr);
+      if (extra == nullptr) {
+        ERR_clear_error();
+        break;
+      }
+      SSL_CTX_add_extra_chain_cert(ctx, extra);  // ownership transferred
+    }
+    BIO_free(b);
+  } else if (!o.cert_file.empty()) {
+    if (SSL_CTX_use_certificate_chain_file(ctx, o.cert_file.c_str()) != 1) {
+      *err = OpensslError("use_certificate_chain_file");
+      return EINVAL;
+    }
+  }
+  // Private key.
+  if (!o.key_pem.empty()) {
+    BIO* b = BIO_new_mem_buf(o.key_pem.data(), int(o.key_pem.size()));
+    EVP_PKEY* k = PEM_read_bio_PrivateKey(b, nullptr, nullptr, nullptr);
+    BIO_free(b);
+    if (k == nullptr || SSL_CTX_use_PrivateKey(ctx, k) != 1) {
+      if (k) EVP_PKEY_free(k);
+      *err = OpensslError("use_privatekey");
+      return EINVAL;
+    }
+    EVP_PKEY_free(k);
+  } else if (!o.key_file.empty()) {
+    if (SSL_CTX_use_PrivateKey_file(ctx, o.key_file.c_str(),
+                                    SSL_FILETYPE_PEM) != 1) {
+      *err = OpensslError("use_privatekey_file");
+      return EINVAL;
+    }
+  }
+  if (SSL_CTX_check_private_key(ctx) != 1) {
+    *err = OpensslError("check_private_key");
+    return EINVAL;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int GenerateSelfSignedCert(const std::string& cn, std::string* cert_pem,
+                           std::string* key_pem, std::string* err) {
+  InitOpenssl();
+  EVP_PKEY* pkey = EVP_PKEY_Q_keygen(nullptr, nullptr, "EC", "P-256");
+  if (pkey == nullptr) {
+    *err = OpensslError("keygen");
+    return EINVAL;
+  }
+  X509* x = X509_new();
+  ASN1_INTEGER_set(X509_get_serialNumber(x), 1);
+  X509_gmtime_adj(X509_getm_notBefore(x), -3600);
+  X509_gmtime_adj(X509_getm_notAfter(x), 10L * 365 * 24 * 3600);
+  X509_set_pubkey(x, pkey);
+  X509_NAME* name = X509_get_subject_name(x);
+  X509_NAME_add_entry_by_txt(
+      name, "CN", MBSTRING_ASC,
+      reinterpret_cast<const unsigned char*>(cn.c_str()), -1, -1, 0);
+  X509_set_issuer_name(x, name);  // self-signed
+  if (X509_sign(x, pkey, EVP_sha256()) == 0) {
+    *err = OpensslError("x509_sign");
+    X509_free(x);
+    EVP_PKEY_free(pkey);
+    return EINVAL;
+  }
+  BIO* cb = BIO_new(BIO_s_mem());
+  PEM_write_bio_X509(cb, x);
+  char* p = nullptr;
+  long n = BIO_get_mem_data(cb, &p);
+  cert_pem->assign(p, size_t(n));
+  BIO_free(cb);
+  BIO* kb = BIO_new(BIO_s_mem());
+  PEM_write_bio_PrivateKey(kb, pkey, nullptr, nullptr, 0, nullptr, nullptr);
+  n = BIO_get_mem_data(kb, &p);
+  key_pem->assign(p, size_t(n));
+  BIO_free(kb);
+  X509_free(x);
+  EVP_PKEY_free(pkey);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// TlsContext
+// ---------------------------------------------------------------------------
+std::unique_ptr<TlsContext> TlsContext::NewServer(const TlsOptions& opts,
+                                                  std::string* err) {
+  InitOpenssl();
+  SSL_CTX* ctx = SSL_CTX_new(TLS_server_method());
+  if (ctx == nullptr) {
+    *err = OpensslError("SSL_CTX_new");
+    return nullptr;
+  }
+  SSL_CTX_set_min_proto_version(ctx, TLS1_2_VERSION);
+  TlsOptions o = opts;
+  if (o.cert_pem.empty() && o.cert_file.empty()) {
+    // Dev mode: self-signed on the fly (reference ssl_helper generates
+    // nothing — it requires certs — but a dev default removes the most
+    // common setup papercut; production passes real key material).
+    if (GenerateSelfSignedCert("brt.dev", &o.cert_pem, &o.key_pem, err) !=
+        0) {
+      SSL_CTX_free(ctx);
+      return nullptr;
+    }
+  }
+  if (UsePem(ctx, o, err) != 0) {
+    SSL_CTX_free(ctx);
+    return nullptr;
+  }
+  auto t = std::unique_ptr<TlsContext>(new TlsContext);
+  t->ctx_ = ctx;
+  t->server_ = true;
+  t->alpn_wire_ = AlpnWire(opts.alpn);
+  if (!t->alpn_wire_.empty()) {
+    SSL_CTX_set_alpn_select_cb(ctx, &AlpnSelectCb, &t->alpn_wire_);
+  }
+  return t;
+}
+
+std::unique_ptr<TlsContext> TlsContext::NewClient(const TlsOptions& opts,
+                                                  std::string* err) {
+  InitOpenssl();
+  SSL_CTX* ctx = SSL_CTX_new(TLS_client_method());
+  if (ctx == nullptr) {
+    *err = OpensslError("SSL_CTX_new");
+    return nullptr;
+  }
+  SSL_CTX_set_min_proto_version(ctx, TLS1_2_VERSION);
+  if (opts.verify_peer) {
+    SSL_CTX_set_verify(ctx, SSL_VERIFY_PEER, nullptr);
+    if (!opts.ca_file.empty()) {
+      if (SSL_CTX_load_verify_locations(ctx, opts.ca_file.c_str(),
+                                        nullptr) != 1) {
+        *err = OpensslError("load_verify_locations");
+        SSL_CTX_free(ctx);
+        return nullptr;
+      }
+    } else {
+      SSL_CTX_set_default_verify_paths(ctx);
+    }
+  }
+  // Client cert (mutual TLS) if provided.
+  if (!opts.cert_pem.empty() || !opts.cert_file.empty()) {
+    if (UsePem(ctx, opts, err) != 0) {
+      SSL_CTX_free(ctx);
+      return nullptr;
+    }
+  }
+  auto t = std::unique_ptr<TlsContext>(new TlsContext);
+  t->ctx_ = ctx;
+  t->server_ = false;
+  t->alpn_wire_ = AlpnWire(opts.alpn);
+  if (!t->alpn_wire_.empty()) {
+    SSL_CTX_set_alpn_protos(ctx, t->alpn_wire_.data(),
+                            unsigned(t->alpn_wire_.size()));
+  }
+  return t;
+}
+
+TlsContext::~TlsContext() {
+  if (ctx_ != nullptr) SSL_CTX_free(ctx_);
+}
+
+// ---------------------------------------------------------------------------
+// TlsSession
+// ---------------------------------------------------------------------------
+TlsSession* TlsSession::New(TlsContext* ctx, const std::string& sni,
+                            std::string* err) {
+  SSL* ssl = SSL_new(ctx->ctx());
+  if (ssl == nullptr) {
+    *err = OpensslError("SSL_new");
+    return nullptr;
+  }
+  BIO* rbio = BIO_new(BIO_s_mem());
+  BIO* wbio = BIO_new(BIO_s_mem());
+  BIO_set_mem_eof_return(rbio, -1);  // empty rbio reads as WANT_READ
+  BIO_set_mem_eof_return(wbio, -1);
+  SSL_set_bio(ssl, rbio, wbio);  // ssl owns both
+  if (ctx->is_server()) {
+    SSL_set_accept_state(ssl);
+  } else {
+    SSL_set_connect_state(ssl);
+    if (!sni.empty()) SSL_set_tlsext_host_name(ssl, sni.c_str());
+  }
+  auto* s = new TlsSession;
+  s->ssl_ = ssl;
+  s->rbio_ = rbio;
+  s->wbio_ = wbio;
+  s->hs_butex_ = butex_create();
+  return s;
+}
+
+TlsSession::~TlsSession() {
+  if (ssl_ != nullptr) SSL_free(ssl_);  // frees both BIOs
+  // hs_butex_ is pooled/never-freed by design (fiber/butex.cc); leaking the
+  // handle back to the pool happens in butex_destroy.
+  if (hs_butex_ != nullptr) butex_destroy(hs_butex_);
+}
+
+void TlsSession::DrainWbioLocked(IOBuf* wire_out) {
+  char buf[16 * 1024];
+  while (BIO_ctrl_pending(wbio_) > 0) {
+    int n = BIO_read(wbio_, buf, int(sizeof(buf)));
+    if (n <= 0) break;
+    wire_out->append(buf, size_t(n));
+  }
+}
+
+int TlsSession::ProgressLocked(IOBuf* plain_out, IOBuf* wire_out) {
+  int result = 0;
+  if (!SSL_is_init_finished(ssl_)) {
+    int rc = SSL_do_handshake(ssl_);
+    if (rc != 1) {
+      int e = SSL_get_error(ssl_, rc);
+      if (e != SSL_ERROR_WANT_READ && e != SSL_ERROR_WANT_WRITE) {
+        BRT_LOG(WARNING) << OpensslError("tls handshake");
+        hs_failed_ = true;  // published by PublishHandshakeState
+        DrainWbioLocked(wire_out);  // flush the fatal alert to the peer
+        return EPROTO;
+      }
+    }
+    // Completion is NOT published here: the final handshake record is
+    // still in wbio/wire_out, and a waiter woken now could write app data
+    // ahead of it. The socket layer publishes after queueing wire_out.
+  }
+  if (SSL_is_init_finished(ssl_) && plain_out != nullptr) {
+    char buf[16 * 1024];
+    for (;;) {
+      int n = SSL_read(ssl_, buf, int(sizeof(buf)));
+      if (n > 0) {
+        plain_out->append(buf, size_t(n));
+        continue;
+      }
+      int e = SSL_get_error(ssl_, n);
+      if (e == SSL_ERROR_WANT_READ || e == SSL_ERROR_WANT_WRITE) break;
+      if (e == SSL_ERROR_ZERO_RETURN) {  // peer close_notify
+        result = ESHUTDOWN;
+        break;
+      }
+      BRT_LOG(WARNING) << OpensslError("tls read");
+      result = EPROTO;
+      break;
+    }
+  }
+  DrainWbioLocked(wire_out);
+  return result;
+}
+
+int TlsSession::OnWireData(IOBuf* wire_in, IOBuf* plain_out,
+                           IOBuf* wire_out) {
+  std::lock_guard<std::mutex> g(mu_);
+  for (int i = 0; i < wire_in->block_count(); ++i) {
+    const auto& r = wire_in->ref_at(i);
+    size_t off = 0;
+    while (off < r.length) {
+      int n = BIO_write(
+          rbio_, static_cast<const char*>(wire_in->ref_data(i)) + off,
+          int(r.length - off));
+      if (n <= 0) return EPROTO;  // mem BIO only fails on alloc
+      off += size_t(n);
+    }
+  }
+  wire_in->clear();
+  return ProgressLocked(plain_out, wire_out);
+}
+
+int TlsSession::Pump(IOBuf* wire_out) {
+  std::lock_guard<std::mutex> g(mu_);
+  return ProgressLocked(nullptr, wire_out);
+}
+
+int TlsSession::Encrypt(IOBuf* plain_in, IOBuf* wire_out) {
+  std::lock_guard<std::mutex> g(mu_);
+  for (int i = 0; i < plain_in->block_count(); ++i) {
+    const auto& r = plain_in->ref_at(i);
+    size_t off = 0;
+    while (off < r.length) {
+      int n = SSL_write(
+          ssl_, static_cast<const char*>(plain_in->ref_data(i)) + off,
+          int(r.length - off));
+      if (n <= 0) {
+        // Post-handshake SSL_write into a memory BIO cannot legitimately
+        // want IO; anything else is fatal for the connection.
+        BRT_LOG(WARNING) << OpensslError("tls write");
+        DrainWbioLocked(wire_out);
+        return EPROTO;
+      }
+      off += size_t(n);
+    }
+  }
+  plain_in->clear();
+  DrainWbioLocked(wire_out);
+  return 0;
+}
+
+void TlsSession::PublishHandshakeState() {
+  bool wake = false;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    if (ssl_ != nullptr && SSL_is_init_finished(ssl_) &&
+        !done_.load(std::memory_order_relaxed)) {
+      done_.store(true, std::memory_order_release);
+      wake = true;
+    }
+    if (hs_failed_ && !failed_.load(std::memory_order_relaxed)) {
+      failed_.store(true, std::memory_order_release);
+      wake = true;
+    }
+  }
+  if (wake) {
+    butex_value(hs_butex_).fetch_add(1, std::memory_order_release);
+    butex_wake_all(hs_butex_);
+  }
+}
+
+void TlsSession::FailHandshake() {
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    if (done_.load(std::memory_order_relaxed)) return;  // already complete
+    hs_failed_ = true;
+  }
+  PublishHandshakeState();
+}
+
+int TlsSession::WaitHandshake(int64_t timeout_us) {
+  for (;;) {
+    if (done_.load(std::memory_order_acquire)) return 0;
+    if (failed_.load(std::memory_order_acquire)) return EPROTO;
+    int expected = butex_value(hs_butex_).load(std::memory_order_acquire);
+    // Re-check after snapshotting the butex value (wake could land between
+    // the flag check and the wait).
+    if (done_.load(std::memory_order_acquire)) return 0;
+    if (failed_.load(std::memory_order_acquire)) return EPROTO;
+    int rc = butex_wait(hs_butex_, expected, timeout_us);
+    if (rc == ETIMEDOUT) return ETIMEDOUT;
+  }
+}
+
+std::string TlsSession::alpn() const {
+  std::lock_guard<std::mutex> g(mu_);
+  const unsigned char* p = nullptr;
+  unsigned len = 0;
+  SSL_get0_alpn_selected(ssl_, &p, &len);
+  return p != nullptr ? std::string(reinterpret_cast<const char*>(p), len)
+                      : std::string();
+}
+
+}  // namespace brt
