@@ -70,6 +70,12 @@ void RegisterFlag(const std::string& name, bool* storage,
       description, reloadable});
 }
 
+void RegisterFlag(const std::string& name, std::function<std::string()> get,
+                  std::function<int(const std::string&)> set,
+                  const std::string& description, bool reloadable) {
+  add(name, Entry{std::move(get), std::move(set), description, reloadable});
+}
+
 std::vector<FlagInfo> ListFlags() {
   std::lock_guard<std::mutex> g(g_mu);
   std::vector<FlagInfo> out;

@@ -28,6 +28,12 @@ void RegisterFlag(const std::string& name, uint32_t* storage,
                   const std::string& description, bool reloadable = true);
 void RegisterFlag(const std::string& name, bool* storage,
                   const std::string& description, bool reloadable = true);
+// Generic registration for flags with custom storage/locking (strings,
+// values that trigger side effects on change). `get`/`set` run under the
+// registry lock; `set` returns 0 or EINVAL.
+void RegisterFlag(const std::string& name, std::function<std::string()> get,
+                  std::function<int(const std::string&)> set,
+                  const std::string& description, bool reloadable = true);
 
 std::vector<FlagInfo> ListFlags();
 // Returns 0, ENOENT (unknown), EPERM (not reloadable), EINVAL (bad value).

@@ -39,11 +39,27 @@ struct Shard {
   std::map<void*, Sample> live;
 };
 
+struct StackKey {
+  std::vector<void*> frames;
+  bool operator<(const StackKey& o) const { return frames < o.frames; }
+};
+
+struct Agg {
+  int64_t bytes = 0;
+  int64_t count = 0;
+};
+
 Shard* g_shards = nullptr;  // leaked on first use (hooks outlive statics)
 std::once_flag g_shards_once;
 std::atomic<bool> g_enabled{false};
 std::atomic<int64_t> g_live_count{0};
 std::atomic<int64_t> g_sample_bytes{512 * 1024};
+
+// Cumulative per-session allocation totals by stack — entries never drop
+// on free. This is the reference's "growth" profile (hotspots_service.cpp
+// growth mode): where memory was allocated, whether or not it survived.
+std::mutex g_growth_mu;
+std::map<StackKey, Agg>* g_growth = nullptr;  // leaked; reset at Start
 
 thread_local int64_t t_budget = 0;
 thread_local bool t_in_hook = false;
@@ -58,6 +74,19 @@ void RecordAlloc(void* p, size_t n) {
   Sample s;
   s.size = n;
   s.nframes = backtrace(s.frames, kMaxFrames);
+  {
+    // Growth totals (sampled rate — the mutex sees ~1 hit per
+    // sample_bytes allocated, contention is negligible).
+    const int skip = s.nframes > kSkipFrames ? kSkipFrames : 0;
+    StackKey key;
+    key.frames.assign(s.frames + skip, s.frames + s.nframes);
+    std::lock_guard<std::mutex> g(g_growth_mu);
+    if (g_growth != nullptr) {
+      Agg& a = (*g_growth)[key];
+      a.bytes += int64_t(n);
+      a.count += 1;
+    }
+  }
   Shard& sh = ShardOf(p);
   std::lock_guard<std::mutex> g(sh.mu);
   sh.live.emplace(p, s);
@@ -114,10 +143,26 @@ void HookedFree(void* p) {
   free(p);
 }
 
-struct StackKey {
-  std::vector<void*> frames;
-  bool operator<(const StackKey& o) const { return frames < o.frames; }
-};
+// Drains the live shards into a by-stack aggregation (session is over);
+// caller must have flipped g_enabled and set t_in_hook.
+void DrainLive(std::map<StackKey, Agg>* by_stack, int64_t* total_bytes,
+               int64_t* total_count) {
+  for (int i = 0; i < kShards; ++i) {
+    std::lock_guard<std::mutex> g(g_shards[i].mu);
+    for (auto& [p, s] : g_shards[i].live) {
+      StackKey key;
+      const int skip = s.nframes > kSkipFrames ? kSkipFrames : 0;
+      key.frames.assign(s.frames + skip, s.frames + s.nframes);
+      Agg& a = (*by_stack)[key];
+      a.bytes += int64_t(s.size);
+      a.count += 1;
+      *total_bytes += int64_t(s.size);
+      *total_count += 1;
+    }
+    g_shards[i].live.clear();
+  }
+  g_live_count.store(0, std::memory_order_relaxed);
+}
 
 }  // namespace
 
@@ -137,11 +182,22 @@ bool HeapProfiler::Start(int64_t sample_bytes) {
   if (CpuProfiler::singleton().running()) return false;
   if (sample_bytes < 4096) sample_bytes = 4096;
   std::call_once(g_shards_once, [] { g_shards = new Shard[kShards]; });
-  g_sample_bytes.store(sample_bytes, std::memory_order_relaxed);
+  // Win the session FIRST: a losing concurrent Start must not touch the
+  // running session's sample rate or growth totals.
   bool expected = false;
   if (!g_enabled.compare_exchange_strong(expected, true,
                                          std::memory_order_acq_rel)) {
     return false;
+  }
+  g_sample_bytes.store(sample_bytes, std::memory_order_relaxed);
+  {
+    // Hooks bypassed: the map allocation itself must not get sampled, or
+    // RecordAlloc self-deadlocks on g_growth_mu held right here.
+    t_in_hook = true;
+    std::lock_guard<std::mutex> g(g_growth_mu);
+    if (g_growth == nullptr) g_growth = new std::map<StackKey, Agg>();
+    g_growth->clear();
+    t_in_hook = false;
   }
   return true;
 }
@@ -160,27 +216,9 @@ std::string HeapProfiler::StopAndReport() {
   } in_hook;
   // Drain the table under the shard locks; frees racing us just miss
   // (their entries show as live — a sampling profiler tolerates that).
-  struct Agg {
-    int64_t bytes = 0;
-    int64_t count = 0;
-  };
   std::map<StackKey, Agg> by_stack;
   int64_t total_bytes = 0, total_count = 0;
-  for (int i = 0; i < kShards; ++i) {
-    std::lock_guard<std::mutex> g(g_shards[i].mu);
-    for (auto& [p, s] : g_shards[i].live) {
-      StackKey key;
-      const int skip = s.nframes > kSkipFrames ? kSkipFrames : 0;
-      key.frames.assign(s.frames + skip, s.frames + s.nframes);
-      Agg& a = by_stack[key];
-      a.bytes += int64_t(s.size);
-      a.count += 1;
-      total_bytes += int64_t(s.size);
-      total_count += 1;
-    }
-    g_shards[i].live.clear();
-  }
-  g_live_count.store(0, std::memory_order_relaxed);
+  DrainLive(&by_stack, &total_bytes, &total_count);
 
   const int64_t rate = g_sample_bytes.load(std::memory_order_relaxed);
   std::ostringstream os;
@@ -206,6 +244,96 @@ std::string HeapProfiler::StopAndReport() {
   if (order.empty()) {
     os << "(no live sampled allocations — everything allocated during the "
           "session was freed)\n";
+  }
+  return os.str();
+}
+
+std::string HeapProfiler::StopAndReportGrowth() {
+  if (!g_enabled.exchange(false, std::memory_order_acq_rel)) {
+    return "heap profiler was not running\n";
+  }
+  struct HookGuard {
+    HookGuard() { t_in_hook = true; }
+    ~HookGuard() { t_in_hook = false; }
+  } in_hook;
+  std::map<StackKey, Agg> live;
+  int64_t lb = 0, lc = 0;
+  DrainLive(&live, &lb, &lc);
+  std::map<StackKey, Agg> growth;
+  {
+    std::lock_guard<std::mutex> g(g_growth_mu);
+    if (g_growth != nullptr) growth.swap(*g_growth);
+  }
+  int64_t total_bytes = 0, total_count = 0;
+  for (auto& [k, a] : growth) {
+    total_bytes += a.bytes;
+    total_count += a.count;
+  }
+  const int64_t rate = g_sample_bytes.load(std::memory_order_relaxed);
+  std::ostringstream os;
+  os << "heap growth: " << total_count << " sampled allocations, "
+     << total_bytes << " sampled bytes allocated during the session "
+     << "(freed or not; sample interval " << rate << " bytes)\n\n";
+  std::vector<std::pair<const StackKey*, const Agg*>> order;
+  order.reserve(growth.size());
+  for (auto& [k, a] : growth) order.emplace_back(&k, &a);
+  std::sort(order.begin(), order.end(), [](auto& x, auto& y) {
+    return x.second->bytes > y.second->bytes;
+  });
+  int shown = 0;
+  for (auto& [k, a] : order) {
+    if (++shown > 40) break;
+    os << a->bytes << " bytes in " << a->count << " sampled allocation"
+       << (a->count == 1 ? "" : "s") << ":\n";
+    for (void* f : k->frames) {
+      os << "    " << var::SymbolizeFrame(f) << "\n";
+    }
+    os << "\n";
+  }
+  if (order.empty()) os << "(nothing sampled during the session)\n";
+  return os.str();
+}
+
+std::string HeapProfiler::StopAndReportPprofHeap() {
+  if (!g_enabled.exchange(false, std::memory_order_acq_rel)) {
+    return "heap profiler was not running\n";
+  }
+  struct HookGuard {
+    HookGuard() { t_in_hook = true; }
+    ~HookGuard() { t_in_hook = false; }
+  } in_hook;
+  std::map<StackKey, Agg> live;
+  int64_t lb = 0, lc = 0;
+  DrainLive(&live, &lb, &lc);
+  std::map<StackKey, Agg> growth;
+  {
+    std::lock_guard<std::mutex> g(g_growth_mu);
+    if (g_growth != nullptr) growth.swap(*g_growth);
+  }
+  int64_t gb = 0, gc = 0;
+  for (auto& [k, a] : growth) {
+    gb += a.bytes;
+    gc += a.count;
+  }
+  const int64_t rate = g_sample_bytes.load(std::memory_order_relaxed);
+  std::ostringstream os;
+  // tcmalloc heap-profile text format: pprof reads "live [cumulative]".
+  os << "heap profile: " << lc << ": " << lb << " [" << gc << ": " << gb
+     << "] @ heap_v2/" << rate << "\n";
+  for (auto& [k, a] : growth) {
+    auto it = live.find(k);
+    const int64_t ln = it != live.end() ? it->second.count : 0;
+    const int64_t lby = it != live.end() ? it->second.bytes : 0;
+    os << ln << ": " << lby << " [" << a.count << ": " << a.bytes << "] @";
+    for (void* f : k.frames) os << " " << f;
+    os << "\n";
+  }
+  os << "\nMAPPED_LIBRARIES:\n";
+  if (FILE* maps = fopen("/proc/self/maps", "r")) {
+    char buf[4096];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), maps)) > 0) os.write(buf, n);
+    fclose(maps);
   }
   return os.str();
 }

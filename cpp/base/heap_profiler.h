@@ -25,6 +25,15 @@ class HeapProfiler {
   // Stops sampling and returns the symbolized live-allocation report.
   std::string StopAndReport();
 
+  // Stops sampling and reports CUMULATIVE session allocations by stack —
+  // freed or not (the reference's heap *growth* profile).
+  std::string StopAndReportGrowth();
+
+  // Stops sampling and returns the standard tcmalloc heap-profile text
+  // format (live [cumulative] per stack + MAPPED_LIBRARIES), consumable
+  // by the stock `pprof` tool — served at /pprof/heap.
+  std::string StopAndReportPprofHeap();
+
   bool running() const;
 
  private:

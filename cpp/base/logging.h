@@ -19,6 +19,14 @@ inline std::atomic<int>& min_log_level() {
   return lvl;
 }
 
+// Verbose-log level for BRT_VLOG(n): messages with n <= level print.
+// Runtime-togglable through the /vlog builtin (reference vlog_service.cpp)
+// or /flags/verbose. 0 (default) silences all VLOGs.
+inline std::atomic<int>& verbose_level() {
+  static std::atomic<int> lvl{0};
+  return lvl;
+}
+
 class LogMessage {
  public:
   LogMessage(const char* file, int line, int level) : level_(level) {
@@ -57,6 +65,13 @@ class VoidLog {
 #ifndef BRT_LOG
 #define BRT_LOG(severity) LOG_AT_LEVEL(LOG_##severity)
 #endif
+
+// Verbose logging (reference VLOG(n) + /vlog): compiled in, gated at
+// runtime on verbose_level().
+#define BRT_VLOG(n)                                                       \
+  ((n) > ::brt::verbose_level().load(std::memory_order_relaxed))          \
+      ? (void)0                                                           \
+      : ::brt::VoidLog() & BRT_LOG_STREAM(LOG_INFO) << "V" << (n) << " "
 
 #define BRT_CHECK(cond)                                              \
   (cond) ? (void)0                                                   \

@@ -1,0 +1,134 @@
+#include "base/thread_dump.h"
+
+#include <dirent.h>
+#include <execinfo.h>
+#include <semaphore.h>
+#include <signal.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <sstream>
+
+#include "var/collector.h"
+
+namespace brt {
+
+namespace {
+
+constexpr int kMaxFrames = 48;
+constexpr int kDumpSignal = SIGURG;  // unused elsewhere in the runtime
+
+// One in-flight dump at a time; the handler writes into these.
+void* g_frames[kMaxFrames];
+std::atomic<int> g_nframes{0};
+sem_t g_done;
+
+void DumpHandler(int, siginfo_t*, void*) {
+  // backtrace() is the same (technically non-async-signal-safe, in
+  // practice fine after a warm-up call) unwind the SIGPROF profiler
+  // already performs from signal context.
+  g_nframes.store(backtrace(g_frames, kMaxFrames),
+                  std::memory_order_release);
+  sem_post(&g_done);
+}
+
+// "1234 (comm) S ..." → 'S'
+char TaskState(int tid) {
+  char path[64];
+  snprintf(path, sizeof(path), "/proc/self/task/%d/stat", tid);
+  FILE* f = fopen(path, "r");
+  if (f == nullptr) return '?';
+  char buf[256];
+  const size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  buf[n] = 0;
+  const char* close = strrchr(buf, ')');
+  return (close != nullptr && close[1] == ' ') ? close[2] : '?';
+}
+
+std::string TaskName(int tid) {
+  char path[64];
+  snprintf(path, sizeof(path), "/proc/self/task/%d/comm", tid);
+  FILE* f = fopen(path, "r");
+  if (f == nullptr) return "?";
+  char buf[64] = {0};
+  if (fgets(buf, sizeof(buf), f) == nullptr) buf[0] = 0;
+  fclose(f);
+  if (char* nl = strchr(buf, '\n')) *nl = 0;
+  return buf;
+}
+
+}  // namespace
+
+std::string DumpAllThreads() {
+  static std::mutex mu;  // one dump at a time (shared slot + handler)
+  std::lock_guard<std::mutex> g(mu);
+
+  // Warm libgcc's unwinder outside signal context (its first call
+  // allocates) and install the handler.
+  void* warm[4];
+  backtrace(warm, 4);
+  sem_init(&g_done, 0, 0);
+  struct sigaction sa, old;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_sigaction = &DumpHandler;
+  sa.sa_flags = SA_SIGINFO | SA_RESTART | SA_ONSTACK;
+  sigaction(kDumpSignal, &sa, &old);
+
+  std::ostringstream os;
+  const int self_tid = int(syscall(SYS_gettid));
+  const int pid = getpid();
+  int nthreads = 0;
+  DIR* d = opendir("/proc/self/task");
+  if (d != nullptr) {
+    while (dirent* e = readdir(d)) {
+      const int tid = atoi(e->d_name);
+      if (tid <= 0) continue;
+      ++nthreads;
+      os << "-- thread " << tid << " (" << TaskName(tid) << ") state "
+         << TaskState(tid) << (tid == self_tid ? " [dumper]" : "") << "\n";
+      int nf = 0;
+      void* frames[kMaxFrames];
+      if (tid == self_tid) {
+        nf = backtrace(frames, kMaxFrames);
+      } else {
+        g_nframes.store(0, std::memory_order_relaxed);
+        if (syscall(SYS_tgkill, pid, tid, kDumpSignal) != 0) {
+          os << "    (signal failed: " << strerror(errno) << ")\n";
+          continue;
+        }
+        timespec ts;
+        clock_gettime(CLOCK_REALTIME, &ts);
+        ts.tv_nsec += 200 * 1000 * 1000;
+        if (ts.tv_nsec >= 1000000000) {
+          ts.tv_sec += 1;
+          ts.tv_nsec -= 1000000000;
+        }
+        if (sem_timedwait(&g_done, &ts) != 0) {
+          os << "    (no response within 200ms — blocked in uninterruptible "
+                "state?)\n";
+          continue;
+        }
+        nf = g_nframes.load(std::memory_order_acquire);
+        memcpy(frames, g_frames, sizeof(void*) * size_t(nf));
+      }
+      // Skip the handler/backtrace frames themselves (top 2-3).
+      const int skip = (tid == self_tid) ? 1 : 3;
+      for (int i = skip < nf ? skip : 0; i < nf; ++i) {
+        os << "    " << var::SymbolizeFrame(frames[i]) << "\n";
+      }
+    }
+    closedir(d);
+  }
+  sigaction(kDumpSignal, &old, nullptr);
+  sem_destroy(&g_done);
+  std::ostringstream head;
+  head << nthreads << " threads\n\n";
+  return head.str() + os.str();
+}
+
+}  // namespace brt

@@ -1,0 +1,19 @@
+// Live in-process thread dump behind the /threads builtin.
+// Parity target: reference src/brpc/builtin/threads_service.cpp — which
+// shells out to pstack/gdb to dump every pthread's stack. Redesigned
+// in-process: a dump signal is sent to each task in /proc/self/task, the
+// handler captures a backtrace into a shared slot, and the caller
+// symbolizes — no external tools, works in containers without ptrace.
+// (Parked FIBER stacks are the separate gdb_fiber_stack.py tool, exactly
+// as the reference splits pstack vs gdb_bthread_stack.py.)
+#pragma once
+
+#include <string>
+
+namespace brt {
+
+// Dumps every thread: tid, name, kernel state, user-space stack.
+// Serialized internally; safe to call from a serving fiber.
+std::string DumpAllThreads();
+
+}  // namespace brt

@@ -1,18 +1,23 @@
 #include "rpc/builtin.h"
 
-#include "base/heap_profiler.h"
-#include "base/profiler.h"
-#include "fiber/fiber.h"
-#include "fiber/fiber_id.h"
-#include "var/collector.h"
+#include <dirent.h>
+#include <sys/stat.h>
 
 #include <sstream>
 
 #include "base/flags.h"
+#include "base/heap_profiler.h"
+#include "base/logging.h"
+#include "base/profiler.h"
+#include "base/thread_dump.h"
 #include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/fiber_id.h"
 #include "rpc/server.h"
 #include "rpc/span.h"
+#include "rpc/thrift_binary.h"
 #include "transport/socket.h"
+#include "var/collector.h"
 #include "var/variable.h"
 
 namespace brt {
@@ -94,11 +99,73 @@ void FlagsPage(const std::string& sub, const std::string& query,
   out->body = os.str();
 }
 
+const char* TTypeName(TType t) {
+  switch (t) {
+    case TType::BOOL: return "bool";
+    case TType::BYTE: return "byte";
+    case TType::I16: return "i16";
+    case TType::I32: return "i32";
+    case TType::I64: return "i64";
+    case TType::DOUBLE: return "double";
+    case TType::STRING: return "string";
+    case TType::STRUCT: return "struct";
+    case TType::LIST: return "list";
+    case TType::MAP: return "map";
+    default: return "?";
+  }
+}
+
+void PrintSchema(std::ostringstream& os, const StructSchema& s, int indent) {
+  const std::string pad(size_t(indent) * 2, ' ');
+  for (const auto& [name, f] : s.fields) {
+    os << pad << f.id << ": ";
+    if (f.type == TType::LIST || f.type == TType::MAP) {
+      os << TTypeName(f.type) << "<"
+         << (f.sub ? "struct" : TTypeName(f.elem)) << ">";
+    } else {
+      os << TTypeName(f.type);
+    }
+    os << " " << name << "\n";
+    if (f.sub && indent < 6) PrintSchema(os, *f.sub, indent + 1);
+  }
+}
+
+// /dir?path=/x — filesystem browser (reference dir_service.cpp; an
+// internal debug page, gated by the same auth hook as every builtin).
+void DirPage(const std::string& query, HttpResponse* out) {
+  std::string path = ".";
+  const size_t pos = query.find("path=");
+  if (pos != std::string::npos) {
+    path = query.substr(pos + 5);
+    const size_t amp = path.find('&');
+    if (amp != std::string::npos) path = path.substr(0, amp);
+  }
+  DIR* d = opendir(path.c_str());
+  if (d == nullptr) {
+    out->status = 404;
+    out->body = "cannot open " + path + ": " + strerror(errno) + "\n";
+    return;
+  }
+  std::ostringstream os;
+  os << path << ":\n";
+  while (dirent* e = readdir(d)) {
+    const std::string full = path + "/" + e->d_name;
+    struct stat st;
+    if (lstat(full.c_str(), &st) != 0) continue;
+    const char kind = S_ISDIR(st.st_mode)   ? 'd'
+                      : S_ISLNK(st.st_mode) ? 'l'
+                                            : '-';
+    os << kind << " " << st.st_size << "\t" << e->d_name << "\n";
+  }
+  closedir(d);
+  out->body = os.str();
+}
+
 }  // namespace
 
 bool HandleBuiltinPage(Server* server, const std::string& method,
                        const std::string& path, const std::string& query,
-                       HttpResponse* out) {
+                       HttpResponse* out, const std::string& body) {
   std::ostringstream os;
   if (path == "/health") {
     out->body = "OK\n";
@@ -135,7 +202,14 @@ bool HandleBuiltinPage(Server* server, const std::string& method,
     return true;
   }
   if (path == "/rpcz") {
-    SpanDump(os, 200, query);
+    // /rpcz?trace=<hex> drills into one trace (client + server spans
+    // joined, memory + disk); any other query filters the list view.
+    if (query.rfind("trace=", 0) == 0) {
+      const uint64_t tid = strtoull(query.c_str() + 6, nullptr, 16);
+      SpanDumpTrace(os, tid);
+    } else {
+      SpanDump(os, 200, query);
+    }
     out->body = os.str();
     return true;
   }
@@ -233,10 +307,123 @@ bool HandleBuiltinPage(Server* server, const std::string& method,
     out->body = os.str();
     return true;
   }
+  if (path == "/threads") {
+    // Live pstack, in-process (reference threads_service.cpp shells out
+    // to gdb; here a dump signal + in-handler backtrace per task).
+    out->body = DumpAllThreads();
+    return true;
+  }
+  if (path == "/vlog") {
+    // Toggle verbose logging at runtime (reference vlog_service.cpp):
+    // /vlog?setvalue=N; plain /vlog shows the current levels.
+    const size_t pos = query.find("setvalue=");
+    if (pos != std::string::npos) {
+      verbose_level().store(atoi(query.c_str() + pos + 9),
+                            std::memory_order_relaxed);
+    }
+    os << "verbose_level: "
+       << verbose_level().load(std::memory_order_relaxed) << "\n"
+       << "min_log_level: "
+       << min_log_level().load(std::memory_order_relaxed)
+       << " (0=TRACE 1=INFO 2=WARNING 3=ERROR)\n"
+       << "set with /vlog?setvalue=N (BRT_VLOG(n) prints when n <= "
+          "verbose_level)\n";
+    out->body = os.str();
+    return true;
+  }
+  if (path == "/dir") {
+    DirPage(query, out);
+    return true;
+  }
+  if (path == "/protobufs") {
+    // Schema browser over the idlc-generated StructSchemas registered via
+    // MapJsonMethod (reference protobufs_service.cpp browses descriptors).
+    if (server == nullptr || server->json_mappings().empty()) {
+      os << "(no mapped struct schemas; Server::MapJsonMethod registers "
+            "them)\n";
+    }
+    if (server != nullptr) {
+      for (const auto& [key, m] : server->json_mappings()) {
+        os << key << "\n  request {\n";
+        PrintSchema(os, m.request, 2);
+        os << "  }\n  response {\n";
+        PrintSchema(os, m.response, 2);
+        os << "  }\n";
+      }
+    }
+    out->body = os.str();
+    return true;
+  }
+  // pprof wire endpoints (reference pprof_service.cpp): the standard tool
+  // can point straight at the server.
+  if (path == "/pprof/profile") {
+    int seconds = 10;
+    const size_t pos = query.find("seconds=");
+    if (pos != std::string::npos) seconds = atoi(query.c_str() + pos + 8);
+    if (seconds < 1) seconds = 1;
+    if (seconds > 60) seconds = 60;
+    if (!CpuProfiler::singleton().Start()) {
+      out->status = 503;
+      out->body = "another profiling session is running\n";
+      return true;
+    }
+    fiber_usleep(int64_t(seconds) * 1000000);
+    out->content_type = "application/octet-stream";
+    out->body = CpuProfiler::singleton().StopAndReportPprof();
+    return true;
+  }
+  if (path == "/pprof/heap" || path == "/pprof/growth") {
+    int seconds = 2;
+    const size_t pos = query.find("seconds=");
+    if (pos != std::string::npos) seconds = atoi(query.c_str() + pos + 8);
+    if (seconds < 1) seconds = 1;
+    if (seconds > 60) seconds = 60;
+    if (!HeapProfiler::singleton().Start(512 * 1024)) {
+      out->status = 503;
+      out->body = "another heap profiling session is running\n";
+      return true;
+    }
+    fiber_usleep(int64_t(seconds) * 1000000);
+    out->body = path == "/pprof/heap"
+                    ? HeapProfiler::singleton().StopAndReportPprofHeap()
+                    : HeapProfiler::singleton().StopAndReportGrowth();
+    return true;
+  }
+  if (path == "/pprof/cmdline") {
+    if (FILE* f = fopen("/proc/self/cmdline", "r")) {
+      char buf[4096];
+      const size_t n = fread(buf, 1, sizeof(buf), f);
+      fclose(f);
+      out->body.assign(buf, n);
+    }
+    return true;
+  }
+  if (path == "/pprof/symbol") {
+    // GET: advertise symbolization; POST body "0xaddr+0xaddr" → lines
+    // "0xaddr\tname" (the pprof tool's remote-symbol protocol).
+    if (method != "POST") {
+      out->body = "num_symbols: 1\n";
+      return true;
+    }
+    std::istringstream in(body);
+    std::string tok;
+    while (std::getline(in, tok, '+')) {
+      const uint64_t addr = strtoull(tok.c_str(), nullptr, 16);
+      if (addr == 0) continue;
+      os << "0x" << std::hex << addr << std::dec << "\t"
+         << var::SymbolizeFrame(reinterpret_cast<void*>(uintptr_t(addr)))
+         << "\n";
+    }
+    out->body = os.str();
+    return true;
+  }
   if (path == "/index") {
     out->body =
         "/status /vars /brpc_metrics /connections /sockets /rpcz /flags\n"
-        "/hotspots /heap /contention /fibers /ids /health /version\n";
+        "/hotspots /heap /contention /fibers /ids /health /version\n"
+        "/threads /vlog /dir /protobufs\n"
+        "/pprof/profile /pprof/heap /pprof/growth /pprof/symbol "
+        "/pprof/cmdline\n";
     return true;
   }
   return false;

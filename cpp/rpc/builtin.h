@@ -19,9 +19,10 @@ struct HttpResponse {
 
 // Dispatches a builtin path ("/status", "/vars?filter", "/flags/foo?setvalue=1",
 // ...). Returns false if the path is not a builtin (caller falls through to
-// user-service routing).
+// user-service routing). `body` is the request payload (POSTing pages like
+// /pprof/symbol consume it).
 bool HandleBuiltinPage(Server* server, const std::string& method,
                        const std::string& path, const std::string& query,
-                       HttpResponse* out);
+                       HttpResponse* out, const std::string& body = "");
 
 }  // namespace brt

@@ -405,7 +405,8 @@ void DispatchH2Request(Socket* s, H2Session* sess, uint32_t id,
   }
   if (!grpc) {
     HttpResponse builtin;
-    if (HandleBuiltinPage(server, *method, path, query, &builtin)) {
+    if (HandleBuiltinPage(server, *method, path, query, &builtin,
+                          st->body.to_string())) {
       IOBuf body;
       body.append(builtin.body);
       RespondH2(ctx, builtin.status, builtin.content_type, std::move(body),

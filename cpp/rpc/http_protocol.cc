@@ -250,7 +250,8 @@ void HttpProcess(IOBuf&& msg, SocketId sid) {
     auth_verified = true;
   }
   HttpResponse builtin;
-  if (HandleBuiltinPage(server, m.method, m.path, m.query, &builtin)) {
+  if (HandleBuiltinPage(server, m.method, m.path, m.query, &builtin,
+                        m.body.to_string())) {
     IOBuf body;
     body.append(builtin.body);
     respond(builtin.status, builtin.content_type, std::move(body));

@@ -1,5 +1,6 @@
 #include "rpc/server.h"
 
+#include "base/flags.h"
 #include "base/logging.h"
 #include "base/stack_trace.h"
 #include "base/time.h"
@@ -59,6 +60,25 @@ int Server::Start(const EndPoint& addr, const Options* opts) {
   RegisterHttp2Protocol();  // before http/1.1: owns the "PRI " preface
   RegisterHttpProtocol();
   RegisterSpanFlags();
+  {
+    // verbose (BRT_VLOG gate) as a live-reloadable flag, also settable
+    // via the /vlog page.
+    static std::once_flag once;
+    std::call_once(once, [] {
+      RegisterFlag(
+          "verbose",
+          [] {
+            return std::to_string(
+                verbose_level().load(std::memory_order_relaxed));
+          },
+          [](const std::string& v) {
+            verbose_level().store(atoi(v.c_str()),
+                                  std::memory_order_relaxed);
+            return 0;
+          },
+          "BRT_VLOG(n) prints when n <= verbose");
+    });
+  }
   RegisterContentionFlags();
   RegisterRpcDumpFlags();
   var::ExposeDefaultVariables();

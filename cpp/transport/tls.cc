@@ -239,7 +239,18 @@ std::unique_ptr<TlsContext> TlsContext::NewClient(const TlsOptions& opts,
   return t;
 }
 
+namespace {
+std::atomic<void (*)(const TlsContext*)> g_ctx_destroy_observer{nullptr};
+}  // namespace
+
+void TlsContext::SetDestroyObserver(void (*fn)(const TlsContext*)) {
+  g_ctx_destroy_observer.store(fn, std::memory_order_release);
+}
+
 TlsContext::~TlsContext() {
+  if (auto* fn = g_ctx_destroy_observer.load(std::memory_order_acquire)) {
+    fn(this);
+  }
   if (ctx_ != nullptr) SSL_CTX_free(ctx_);
 }
 

@@ -64,6 +64,12 @@ class TlsContext {
   SSL_CTX* ctx() const { return ctx_; }
   bool is_server() const { return server_; }
 
+  // Invoked from ~TlsContext so caches keyed by context POINTER (the
+  // client socket map) can drop their entries before the address can be
+  // reused by a context with a different trust config. One observer,
+  // installed once (by the socket map).
+  static void SetDestroyObserver(void (*fn)(const TlsContext*));
+
  private:
   TlsContext() = default;
   SSL_CTX* ctx_ = nullptr;

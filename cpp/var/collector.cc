@@ -26,10 +26,11 @@ static uint64_t HashStack(void* const* frames, int n) {
   return h ? h : 1;  // 0 means empty slot
 }
 
-bool StackCollector::TakeToken() {
+bool RateLimiter::TryAcquire() {
   timespec ts;
   clock_gettime(CLOCK_MONOTONIC_COARSE, &ts);
   const uint32_t sec = uint32_t(ts.tv_sec);
+  const uint32_t budget = budget_.load(std::memory_order_relaxed);
   uint64_t cur = bucket_.load(std::memory_order_relaxed);
   for (;;) {
     uint32_t cur_sec = uint32_t(cur >> 32);
@@ -37,7 +38,8 @@ bool StackCollector::TakeToken() {
     uint64_t next;
     if (cur_sec != sec) {
       next = (uint64_t(sec) << 32) | 1;
-    } else if (used >= kBudgetPerSec) {
+    } else if (used >= budget) {
+      dropped_.fetch_add(1, std::memory_order_relaxed);
       return false;
     } else {
       next = (uint64_t(sec) << 32) | (used + 1);

@@ -15,6 +15,31 @@
 namespace brt {
 namespace var {
 
+// Reusable per-second token budget — the collector's speed limit, shared
+// with rpcz span sampling (reference bvar/collector.h:40 semantics: a
+// bounded number of expensive collections per second, excess dropped).
+class RateLimiter {
+ public:
+  explicit RateLimiter(uint32_t budget_per_sec) : budget_(budget_per_sec) {}
+
+  // Takes one token; false (and counts a drop) when this second's budget
+  // is spent. Lock-free, thread/fiber-safe.
+  bool TryAcquire();
+
+  void set_budget(uint32_t b) {
+    budget_.store(b, std::memory_order_relaxed);
+  }
+  int64_t dropped() const {
+    return dropped_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  std::atomic<uint32_t> budget_;
+  // [epoch_second:32 | used:32]
+  std::atomic<uint64_t> bucket_{0};
+  std::atomic<int64_t> dropped_{0};
+};
+
 class StackCollector {
  public:
   // A process-wide instance per sample family.
@@ -57,13 +82,12 @@ class StackCollector {
     std::atomic<int64_t> count{0};
   };
 
-  bool TakeToken();
+  bool TakeToken() { return limiter_.TryAcquire(); }
 
   Slot slots_[kSlots];
   std::atomic<int64_t> total_samples_{0};
   std::atomic<int64_t> dropped_{0};
-  // token bucket: [epoch_second:32 | used:32]
-  std::atomic<uint64_t> bucket_{0};
+  RateLimiter limiter_{kBudgetPerSec};
 };
 
 // Symbolizes one return address ("func+0x1a" or the raw hex).
