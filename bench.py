@@ -37,6 +37,51 @@ def ensure_built() -> str:
     return bench
 
 
+def run_device_bench(deadline_s: int = 600) -> dict:
+    """Runs bench_device.py under a hard deadline; explicit skip otherwise."""
+    import socket
+
+    # Fast pre-check: the axon relay port. Closed → no chip, skip quickly.
+    s = socket.socket()
+    s.settimeout(0.5)
+    try:
+        s.connect(("127.0.0.1", 8082))
+    except OSError:
+        return {"skipped": "no device tunnel (port 8082 closed)"}
+    finally:
+        s.close()
+    # The port being open is NOT enough — a wedged tunnel accepts connects
+    # but blocks client init forever. Probe by real client creation under
+    # a short deadline before committing to the full measurement.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "from brpc_tpu import rpc; rpc.DeviceClient().close(); "
+             "print('ok')"],
+            capture_output=True, text=True, timeout=60, cwd=ROOT,
+        )
+        if probe.returncode != 0 or "ok" not in probe.stdout:
+            return {"skipped": "device client probe failed"}
+    except subprocess.TimeoutExpired:
+        return {"skipped": "device tunnel wedged (probe init >60s)"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench_device.py")],
+            capture_output=True, text=True, timeout=deadline_s, cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        return {"skipped": f"device bench exceeded {deadline_s}s deadline "
+                           "(tunnel wedged?)"}
+    if proc.returncode != 0 or not proc.stdout.strip():
+        tail = (proc.stderr or "").strip()[-200:]
+        return {"skipped": f"device bench failed rc={proc.returncode}: "
+                           f"{tail}"}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except ValueError:
+        return {"skipped": "device bench emitted no JSON"}
+
+
 def main() -> int:
     try:
         bench = ensure_built()
@@ -54,7 +99,7 @@ def main() -> int:
             (1024 * 1024, min(4, max(2, ncpu)), 4),
             (1024 * 1024, min(8, max(2, ncpu)), 8),
         ]
-        def run(payload, conns, depth, uds, seconds=3):
+        def run(payload, conns, depth, uds, seconds=3, ssl=0):
             env = dict(os.environ)
             # Inflight calls bound usable parallelism: extra workers only
             # add context switches (biggest effect on small hosts).
@@ -63,7 +108,7 @@ def main() -> int:
             out = subprocess.run(
                 [bench, "--payload", str(payload), "--connections",
                  str(conns), "--depth", str(depth), "--seconds",
-                 str(seconds), "--uds", str(uds)],
+                 str(seconds), "--uds", str(uds), "--ssl", str(ssl)],
                 check=True, capture_output=True, text=True, timeout=300,
                 env=env,
             ).stdout
@@ -101,6 +146,25 @@ def main() -> int:
             if stats["qps"] > small_best["qps"]:
                 small_best = stats
 
+        # TLS row: the winning shape, encrypted, over TCP — paired with a
+        # plaintext TCP run of the SAME shape so the delta is the crypto
+        # tax alone (the sweep winner may have been uds).
+        try:
+            plain_tcp = run(best["payload"], best["connections"],
+                            best["depth"], 0, ssl=0)
+            tls = run(best["payload"], best["connections"], best["depth"],
+                      0, ssl=1)
+            tls_stats = {"gbps": tls["gbps"], "qps": tls["qps"],
+                         "p50_us": tls["p50_us"],
+                         "plain_tcp_gbps": plain_tcp["gbps"]}
+        except Exception as e:  # noqa: BLE001
+            tls_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+        # Device tier (BASELINE north stars): measured by bench_device.py
+        # in a deadline-guarded child — a wedged TPU tunnel blocks device
+        # init forever and must not hang the host bench.
+        device = run_device_bench()
+
         gbps = best["gbps"]
         print(json.dumps({
             "metric": "same_host_echo_throughput",
@@ -118,6 +182,8 @@ def main() -> int:
             "small_config": {k: small_best[k] for k in
                              ("payload", "connections", "depth", "uds")},
             "small_scaling": scaling,
+            "tls": tls_stats,
+            "device": device,
         }))
         return 0
     except Exception as e:  # noqa: BLE001

@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""Device-tier bench: the TPU north-star numbers (BASELINE.md:19-22).
+
+Run BY bench.py in a deadline-guarded subprocess (a wedged tunnel blocks
+device init forever — the parent enforces the deadline, this child just
+measures). Prints ONE JSON object:
+  h2d_gbps / d2h_gbps   — zero-copy staging through the registered block
+                          pool (cpp/device/pjrt_device.cc), the RDMA-verbs
+                          analog path;
+  ps_lookup_qps         — device-resident PS shard: embedding rows served
+                          from HBM via compiled gather;
+  step_time_ms / achieved_tflops / mxu_utilization
+                        — single-chip compiled train step on the tiny
+                          Llama config (utilization against the v5e bf16
+                          peak of 197 TFLOP/s, the published figure for
+                          the chip this tunnel fronts).
+"""
+
+import json
+import sys
+import time
+
+
+def bench_staging(dev, out):
+    from brpc_tpu import rpc  # noqa: F401
+
+    mb = 64
+    blob = b"x" * (mb << 20)
+    # Warm-up (first transfer sets up the pool).
+    h = dev.stage(blob)
+    dev.fetch(h)
+    dev.release(h)
+    reps = 5
+    t0 = time.monotonic()
+    handles = []
+    for _ in range(reps):
+        handles.append(dev.stage(blob))
+    t1 = time.monotonic()
+    for h in handles:
+        got = dev.fetch(h)
+        assert len(got) == len(blob)
+        dev.release(h)
+    t2 = time.monotonic()
+    out["h2d_gbps"] = round(reps * mb / 1024 / (t1 - t0), 2)
+    out["d2h_gbps"] = round(reps * mb / 1024 / (t2 - t1), 2)
+
+
+def bench_ps(dev, out):
+    import numpy as np
+
+    from brpc_tpu.ps_remote import DevicePsShardServer, RemoteEmbedding
+
+    vocab, dim = 65536, 128
+    s = DevicePsShardServer(vocab, dim, 0, 1, lr=0.1, device_client=dev)
+    emb = RemoteEmbedding([s.address], vocab, dim, timeout_ms=120000)
+    ids = np.arange(256, dtype=np.int64) * 13 % vocab
+    emb.lookup(ids)  # warm (compiles the gather)
+    n = 0
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 3.0:
+        emb.lookup(ids)
+        n += 1
+    dt = time.monotonic() - t0
+    out["ps_lookup_qps"] = round(n / dt, 1)
+    out["ps_rows_per_s"] = round(n * len(ids) / dt, 0)
+    emb.close()
+    s.close()
+
+
+def bench_step(out):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from brpc_tpu.models import llama
+    from brpc_tpu.parallel import make_mesh, shard_batch, shard_params
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=2048)
+    mesh = make_mesh({}, devices=jax.devices()[:1])
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    params = shard_params(params, llama.param_specs(cfg), mesh)
+    optimizer = optax.adamw(1e-3)
+    opt_state = optimizer.init(params)
+    batch, seq = 8, 256
+    tokens = shard_batch(
+        jnp.zeros((batch, seq), jnp.int32), llama.batch_specs(), mesh)
+    step = jax.jit(llama.make_train_step(cfg, optimizer, None))
+    with mesh:
+        params, opt_state, loss = step(params, opt_state, tokens)  # compile
+        jax.block_until_ready(loss)
+        reps = 20
+        t0 = time.monotonic()
+        for _ in range(reps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        jax.block_until_ready(loss)
+        dt = (time.monotonic() - t0) / reps
+    nparams = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    # Training step ≈ 6 * params * tokens FLOPs (fwd 2x + bwd 4x).
+    flops = 6.0 * nparams * batch * seq
+    out["step_time_ms"] = round(dt * 1000, 2)
+    out["model_params"] = nparams
+    out["achieved_tflops"] = round(flops / dt / 1e12, 3)
+    out["mxu_utilization"] = round(flops / dt / 197e12, 4)
+    out["loss"] = round(float(loss), 4)
+
+
+def main() -> int:
+    out = {}
+    try:
+        from brpc_tpu import rpc
+
+        dev = rpc.DeviceClient()
+        out["device_count"] = dev.device_count
+        bench_staging(dev, out)
+        bench_ps(dev, out)
+        dev.close()
+    except Exception as e:  # noqa: BLE001
+        out["staging_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        bench_step(out)
+    except Exception as e:  # noqa: BLE001
+        out["step_error"] = f"{type(e).__name__}: {e}"[:200]
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -144,18 +144,21 @@ void HookedFree(void* p) {
 }
 
 // Drains the live shards into a by-stack aggregation (session is over);
-// caller must have flipped g_enabled and set t_in_hook.
+// caller must have flipped g_enabled and set t_in_hook. by_stack may be
+// null when only the clearing side effect is wanted (growth report).
 void DrainLive(std::map<StackKey, Agg>* by_stack, int64_t* total_bytes,
                int64_t* total_count) {
   for (int i = 0; i < kShards; ++i) {
     std::lock_guard<std::mutex> g(g_shards[i].mu);
     for (auto& [p, s] : g_shards[i].live) {
-      StackKey key;
-      const int skip = s.nframes > kSkipFrames ? kSkipFrames : 0;
-      key.frames.assign(s.frames + skip, s.frames + s.nframes);
-      Agg& a = (*by_stack)[key];
-      a.bytes += int64_t(s.size);
-      a.count += 1;
+      if (by_stack != nullptr) {
+        StackKey key;
+        const int skip = s.nframes > kSkipFrames ? kSkipFrames : 0;
+        key.frames.assign(s.frames + skip, s.frames + s.nframes);
+        Agg& a = (*by_stack)[key];
+        a.bytes += int64_t(s.size);
+        a.count += 1;
+      }
       *total_bytes += int64_t(s.size);
       *total_count += 1;
     }
@@ -256,9 +259,8 @@ std::string HeapProfiler::StopAndReportGrowth() {
     HookGuard() { t_in_hook = true; }
     ~HookGuard() { t_in_hook = false; }
   } in_hook;
-  std::map<StackKey, Agg> live;
   int64_t lb = 0, lc = 0;
-  DrainLive(&live, &lb, &lc);
+  DrainLive(nullptr, &lb, &lc);  // only the clearing side effect
   std::map<StackKey, Agg> growth;
   {
     std::lock_guard<std::mutex> g(g_growth_mu);

@@ -22,12 +22,20 @@ namespace {
 constexpr int kMaxFrames = 48;
 constexpr int kDumpSignal = SIGURG;  // unused elsewhere in the runtime
 
-// One in-flight dump at a time; the handler writes into these.
+// One in-flight dump at a time; the handler writes into these. The
+// target-tid gate makes a LATE handler (its thread was stuck past the
+// dumper's per-thread deadline) a no-op instead of misattributing its
+// stack to the next thread or posting a stale wakeup.
 void* g_frames[kMaxFrames];
 std::atomic<int> g_nframes{0};
-sem_t g_done;
+std::atomic<int> g_target_tid{0};
+sem_t g_done;  // initialized once, never destroyed (late posts are legal)
 
 void DumpHandler(int, siginfo_t*, void*) {
+  if (int(syscall(SYS_gettid)) !=
+      g_target_tid.load(std::memory_order_acquire)) {
+    return;  // the dumper gave up on this thread and moved on
+  }
   // backtrace() is the same (technically non-async-signal-safe, in
   // practice fine after a warm-up call) unwind the SIGPROF profiler
   // already performs from signal context.
@@ -72,7 +80,8 @@ std::string DumpAllThreads() {
   // allocates) and install the handler.
   void* warm[4];
   backtrace(warm, 4);
-  sem_init(&g_done, 0, 0);
+  static int sem_once = [] { return sem_init(&g_done, 0, 0); }();
+  (void)sem_once;
   struct sigaction sa, old;
   memset(&sa, 0, sizeof(sa));
   sa.sa_sigaction = &DumpHandler;
@@ -97,7 +106,13 @@ std::string DumpAllThreads() {
         nf = backtrace(frames, kMaxFrames);
       } else {
         g_nframes.store(0, std::memory_order_relaxed);
+        // Drain any stale post (a thread that answered after its
+        // deadline in a PREVIOUS dump), then aim the handler gate.
+        while (sem_trywait(&g_done) == 0) {
+        }
+        g_target_tid.store(tid, std::memory_order_release);
         if (syscall(SYS_tgkill, pid, tid, kDumpSignal) != 0) {
+          g_target_tid.store(0, std::memory_order_release);
           os << "    (signal failed: " << strerror(errno) << ")\n";
           continue;
         }
@@ -109,10 +124,14 @@ std::string DumpAllThreads() {
           ts.tv_nsec -= 1000000000;
         }
         if (sem_timedwait(&g_done, &ts) != 0) {
+          // Close the gate BEFORE moving on: a handler that fires later
+          // sees a different target and becomes a no-op.
+          g_target_tid.store(0, std::memory_order_release);
           os << "    (no response within 200ms — blocked in uninterruptible "
                 "state?)\n";
           continue;
         }
+        g_target_tid.store(0, std::memory_order_release);
         nf = g_nframes.load(std::memory_order_acquire);
         memcpy(frames, g_frames, sizeof(void*) * size_t(nf));
       }
@@ -125,7 +144,6 @@ std::string DumpAllThreads() {
     closedir(d);
   }
   sigaction(kDumpSignal, &old, nullptr);
-  sem_destroy(&g_done);
   std::ostringstream head;
   head << nthreads << " threads\n\n";
   return head.str() + os.str();

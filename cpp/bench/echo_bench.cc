@@ -82,12 +82,14 @@ int main(int argc, char** argv) {
   int depth = 16;  // concurrent in-flight calls per connection
   int seconds = 5;
   int uds = 0;  // 1: unix-domain (abstract) instead of TCP loopback
+  int ssl = 0;  // 1: TLS on the loopback connections (self-signed)
   for (int i = 1; i + 1 < argc; i += 2) {
     if (!strcmp(argv[i], "--payload")) payload = atoll(argv[i + 1]);
     else if (!strcmp(argv[i], "--connections")) connections = atoi(argv[i + 1]);
     else if (!strcmp(argv[i], "--depth")) depth = atoi(argv[i + 1]);
     else if (!strcmp(argv[i], "--seconds")) seconds = atoi(argv[i + 1]);
     else if (!strcmp(argv[i], "--uds")) uds = atoi(argv[i + 1]);
+    else if (!strcmp(argv[i], "--ssl")) ssl = atoi(argv[i + 1]);
   }
 
   // Scale epoll loops with the connection count (latched at first use).
@@ -104,8 +106,10 @@ int main(int argc, char** argv) {
     snprintf(listen_addr, sizeof(listen_addr), "unix:@brt_echo_bench_%d",
              getpid());
   }
+  Server::Options sopts;
+  sopts.ssl.enable = ssl != 0;
   if (server.AddService(&echo, "Echo") != 0 ||
-      server.Start(listen_addr) != 0) {
+      server.Start(listen_addr, &sopts) != 0) {
     fprintf(stderr, "server start failed\n");
     return 1;
   }
@@ -115,6 +119,10 @@ int main(int argc, char** argv) {
     ChannelOptions opts;
     opts.connection_group = i + 1;  // private connection per channel
     opts.timeout_ms = 10000;
+    opts.use_ssl = ssl != 0;
+    // TLS handshakes contend with the load on small hosts: give connect
+    // establishment real headroom.
+    if (ssl) opts.connect_timeout_us = 5 * 1000 * 1000;
     if (channels[i].Init(server.listen_address(), &opts) != 0) {
       fprintf(stderr, "channel init failed\n");
       return 1;
@@ -153,9 +161,10 @@ int main(int argc, char** argv) {
   };
   const double gbps = double(bytes.load()) / elapsed / 1e9;
   printf("{\"gbps\": %.3f, \"qps\": %.0f, \"p50_us\": %ld, \"p99_us\": %ld, "
-         "\"payload\": %zu, \"connections\": %d, \"depth\": %d, \"uds\": %d}\n",
+         "\"payload\": %zu, \"connections\": %d, \"depth\": %d, \"uds\": %d, "
+         "\"ssl\": %d}\n",
          gbps, double(calls.load()) / elapsed, pct(0.5), pct(0.99), payload,
-         connections, depth, uds);
+         connections, depth, uds, ssl);
   server.Stop();
   return 0;
 }

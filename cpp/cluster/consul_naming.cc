@@ -1,0 +1,112 @@
+#include "cluster/consul_naming.h"
+
+#include <algorithm>
+
+#include "base/logging.h"
+#include "rpc/http_client.h"
+#include "rpc/json.h"
+
+namespace brt {
+
+namespace {
+
+// One health entry: {"Service": {"Address": "...", "Port": N}, ...}.
+// Weight rides the optional Service.Weights.Passing field (consul's
+// native weighting).
+bool ParseHealthJson(const std::string& body, std::vector<ServerNode>* out) {
+  JsonValue doc;
+  std::string err;
+  if (!JsonParse(body, &doc, &err)) {
+    BRT_LOG(WARNING) << "consul: bad health JSON: " << err;
+    return false;
+  }
+  if (doc.type != JsonValue::Type::kArray) return false;
+  out->clear();
+  for (const JsonValue& entry : doc.elems) {
+    const JsonValue* svc = entry.member("Service");
+    if (svc == nullptr) continue;
+    const JsonValue* addr = svc->member("Address");
+    const JsonValue* port = svc->member("Port");
+    if (addr == nullptr || port == nullptr ||
+        addr->type != JsonValue::Type::kString ||
+        port->type != JsonValue::Type::kInt) {
+      continue;
+    }
+    ServerNode n;
+    if (!EndPoint::parse(addr->str + ":" + std::to_string(port->i),
+                         &n.ep)) {
+      continue;
+    }
+    if (const JsonValue* w = svc->member("Weights")) {
+      if (const JsonValue* p = w->member("Passing")) {
+        if (p->type == JsonValue::Type::kInt && p->i > 0) {
+          n.weight = int(p->i);
+        }
+      }
+    }
+    out->push_back(std::move(n));
+  }
+  return true;
+}
+
+}  // namespace
+
+int ConsulNamingService::Start(const std::string& param,
+                               ServerListCallback cb) {
+  // param: host:port/service-name
+  const size_t slash = param.find('/');
+  if (slash == std::string::npos) return EINVAL;
+  if (!EndPoint::parse(param.substr(0, slash), &agent_)) return EINVAL;
+  service_ = param.substr(slash + 1);
+  if (service_.empty()) return EINVAL;
+  cb_ = std::move(cb);
+  fiber_init(0);
+  return fiber_start(&fid_, &ConsulNamingService::PollEntry, this);
+}
+
+void ConsulNamingService::Stop() {
+  stopping_.store(true, std::memory_order_release);
+  if (fid_ != 0) {
+    fiber_join(fid_);
+    fid_ = 0;
+  }
+}
+
+void* ConsulNamingService::PollEntry(void* arg) {
+  auto* self = static_cast<ConsulNamingService*>(arg);
+  std::string index = "0";
+  std::vector<ServerNode> last;
+  bool pushed_any = false;
+  while (!self->stopping_.load(std::memory_order_acquire)) {
+    const std::string path = "/v1/health/service/" + self->service_ +
+                             "?stale&passing&index=" + index +
+                             "&wait=" + std::to_string(self->wait_s) + "s";
+    HttpClientResult res;
+    const int rc = HttpFetch(self->agent_, "GET", path, "", "", &res,
+                             (self->wait_s + 5) * 1000);
+    if (self->stopping_.load(std::memory_order_acquire)) break;
+    if (rc != 0 || res.status != 200) {
+      // Agent unreachable / 5xx: keep the last list, back off, re-poll
+      // from scratch (consul semantics: index resets on error).
+      index = "0";
+      fiber_usleep(2 * 1000 * 1000);
+      continue;
+    }
+    if (const std::string* idx = res.head.header("X-Consul-Index")) {
+      index = *idx;
+    }
+    std::vector<ServerNode> nodes;
+    if (!ParseHealthJson(res.body, &nodes)) {
+      fiber_usleep(2 * 1000 * 1000);
+      continue;
+    }
+    if (!pushed_any || nodes != last) {
+      self->cb_(nodes);
+      last = std::move(nodes);
+      pushed_any = true;
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace brt

@@ -1,0 +1,42 @@
+// Consul-dialect naming service: speaks the real Consul HTTP long-poll
+// API, so a cluster channel can sit directly on an external Consul agent.
+// Parity target: reference src/brpc/policy/consul_naming_service.cpp —
+//   GET /v1/health/service/<name>?stale&passing&index=<X>&wait=60s
+// blocking-query loop: the response is a JSON array of health entries
+// ({"Service": {"Address": ..., "Port": ...}, ...}); the X-Consul-Index
+// response header is echoed back as ?index= so the next poll blocks until
+// membership changes.
+//
+// url: consul://host:port/service-name
+#pragma once
+
+#include <atomic>
+#include <string>
+
+#include "base/endpoint.h"
+#include "cluster/naming_service.h"
+#include "fiber/fiber.h"
+
+namespace brt {
+
+class ConsulNamingService : public NamingService {
+ public:
+  ~ConsulNamingService() override { Stop(); }
+  int Start(const std::string& param, ServerListCallback cb) override;
+  void Stop() override;
+
+  // Long-poll wait the agent is asked for (also bounds Stop latency:
+  // stop is checked between polls). Exposed for tests.
+  int wait_s = 60;
+
+ private:
+  static void* PollEntry(void* arg);
+
+  EndPoint agent_;
+  std::string service_;
+  ServerListCallback cb_;
+  fiber_t fid_ = 0;
+  std::atomic<bool> stopping_{false};
+};
+
+}  // namespace brt

@@ -9,6 +9,7 @@
 #include <unordered_map>
 
 #include "base/doubly_buffered.h"
+#include "third_party/openssl_shim.h"
 
 namespace brt {
 
@@ -164,19 +165,41 @@ struct HashRing {
   std::vector<std::pair<uint64_t, uint32_t>> ring;
 };
 
+// The three ring constructions the reference registers
+// (consistent_hashing_load_balancer.cpp:400): the default numeric hash
+// ("c_murmurhash" here — our mix64 plays murmur's role), 32-bit MD5
+// points over "ip:port-i" ("c_md5"), and libmemcached-compatible ketama
+// ("c_ketama": one MD5 per 4 points, digest bytes little-endian — matches
+// KetamaReplicaPolicy::Build byte order).
+enum class RingHash { MIX64, MD5, KETAMA };
+
+// The j'th little-endian 4-byte group of an MD5 digest — the
+// libmemcached byte order both c_md5 and ketama rings rely on.
+inline uint32_t Md5DigestU32(const unsigned char* d, int j) {
+  return uint32_t(d[3 + j * 4]) << 24 | uint32_t(d[2 + j * 4]) << 16 |
+         uint32_t(d[1 + j * 4]) << 8 | uint32_t(d[0 + j * 4]);
+}
+
+// Low 4 digest bytes, little-endian (reference hasher.cpp MD5Hash32).
+uint32_t Md5Hash32(const void* data, size_t len) {
+  unsigned char d[16];
+  unsigned int n = 16;
+  EVP_Digest(data, len, d, &n, EVP_md5(), nullptr);
+  return Md5DigestU32(d, 0);
+}
+
 class ConsistentHashLB : public LoadBalancer {
  public:
+  explicit ConsistentHashLB(RingHash hash = RingHash::MIX64)
+      : hash_(hash) {}
+
   void ResetServers(const std::vector<ServerNode>& servers) override {
     dbd_.Modify([&](HashRing& bg) {
       bg.list = servers;
       bg.ring.clear();
       for (uint32_t i = 0; i < servers.size(); ++i) {
-        const uint64_t base =
-            (uint64_t(servers[i].ep.ip) << 16) | servers[i].ep.port;
         const int vnodes = 64 * std::max(servers[i].weight, 1);
-        for (int v = 0; v < vnodes; ++v) {
-          bg.ring.emplace_back(mix64(base * 1315423911u + v), i);
-        }
+        AppendReplicas(servers[i], i, vnodes, &bg.ring);
       }
       std::sort(bg.ring.begin(), bg.ring.end());
       return true;
@@ -187,7 +210,12 @@ class ConsistentHashLB : public LoadBalancer {
     DoublyBufferedData<HashRing>::ScopedPtr p;
     dbd_.Read(&p);
     if (p->ring.empty()) return EHOSTDOWN;
-    const uint64_t point = mix64(in.request_code);
+    // MIX64 scrambles the request code (64-bit ring); the MD5 rings hold
+    // raw 32-bit points, so the code is used as-is like the reference
+    // (callers hash their own keys into request_code).
+    const uint64_t point = hash_ == RingHash::MIX64
+                               ? mix64(in.request_code)
+                               : (in.request_code & 0xFFFFFFFFu);
     auto it = std::lower_bound(
         p->ring.begin(), p->ring.end(),
         std::make_pair(point, uint32_t(0)));
@@ -205,9 +233,53 @@ class ConsistentHashLB : public LoadBalancer {
     return EHOSTDOWN;
   }
 
-  const char* name() const override { return "c_murmurhash"; }
+  const char* name() const override {
+    switch (hash_) {
+      case RingHash::MIX64: return "c_murmurhash";
+      case RingHash::MD5: return "c_md5";
+      case RingHash::KETAMA: return "c_ketama";
+    }
+    return "c_?";
+  }
 
  private:
+  void AppendReplicas(const ServerNode& s, uint32_t index, int vnodes,
+                      std::vector<std::pair<uint64_t, uint32_t>>* ring) {
+    switch (hash_) {
+      case RingHash::MIX64: {
+        const uint64_t base = (uint64_t(s.ep.ip) << 16) | s.ep.port;
+        for (int v = 0; v < vnodes; ++v) {
+          ring->emplace_back(mix64(base * 1315423911u + v), index);
+        }
+        return;
+      }
+      case RingHash::MD5: {
+        for (int v = 0; v < vnodes; ++v) {
+          const std::string host =
+              s.ep.to_string() + "-" + std::to_string(v);
+          ring->emplace_back(Md5Hash32(host.data(), host.size()), index);
+        }
+        return;
+      }
+      case RingHash::KETAMA: {
+        // 4 points per digest; vnodes rounded up to a multiple of 4.
+        const int ndigests = (vnodes + 3) / 4;
+        for (int v = 0; v < ndigests; ++v) {
+          const std::string host =
+              s.ep.to_string() + "-" + std::to_string(v);
+          unsigned char d[16];
+          unsigned int n = 16;
+          EVP_Digest(host.data(), host.size(), d, &n, EVP_md5(), nullptr);
+          for (int j = 0; j < 4; ++j) {
+            ring->emplace_back(Md5DigestU32(d, j), index);
+          }
+        }
+        return;
+      }
+    }
+  }
+
+  RingHash hash_;
   DoublyBufferedData<HashRing> dbd_;
 };
 
@@ -346,7 +418,11 @@ void RegisterBuiltinLb() {
     reg("wr", [] { return std::unique_ptr<LoadBalancer>(
         new RandomLB(true)); });
     reg("c_murmurhash", [] { return std::unique_ptr<LoadBalancer>(
-        new ConsistentHashLB); });
+        new ConsistentHashLB(RingHash::MIX64)); });
+    reg("c_md5", [] { return std::unique_ptr<LoadBalancer>(
+        new ConsistentHashLB(RingHash::MD5)); });
+    reg("c_ketama", [] { return std::unique_ptr<LoadBalancer>(
+        new ConsistentHashLB(RingHash::KETAMA)); });
     reg("la", [] { return std::unique_ptr<LoadBalancer>(
         new LocalityAwareLB); });
   });

@@ -1,5 +1,6 @@
 #include "cluster/naming_service.h"
 
+#include "cluster/consul_naming.h"
 #include "cluster/remote_naming.h"
 
 #include <netdb.h>
@@ -207,6 +208,11 @@ void RegisterBuiltinNs() {
     // registry (cluster/remote_naming.h, the consul analog).
     RegisterNamingService("remote", [] {
       return std::unique_ptr<NamingService>(new RemoteNamingService);
+    });
+    // consul://host:port/service — the REAL Consul blocking-query dialect
+    // (cluster/consul_naming.h; reference consul_naming_service.cpp).
+    RegisterNamingService("consul", [] {
+      return std::unique_ptr<NamingService>(new ConsulNamingService);
     });
   });
 }

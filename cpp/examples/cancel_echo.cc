@@ -1,0 +1,55 @@
+// Cancellation tour (reference example/cancel_c++): fire a slow async
+// call, cancel it mid-flight, and observe the ECANCELEDRPC completion —
+// the serialized-error-funnel contract: done runs exactly once.
+#include <cstdio>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/channel.h"
+#include "rpc/errors.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+class SlowEcho : public Service {
+ public:
+  void CallMethod(const std::string&, Controller*, const IOBuf& req,
+                  IOBuf* response, Closure done) override {
+    fiber_usleep(2 * 1000 * 1000);  // far longer than the caller waits
+    response->append(req);
+    done();
+  }
+};
+
+int main() {
+  fiber_init(4);
+  Server server;
+  SlowEcho svc;
+  server.AddService(&svc, "Echo");
+  if (server.Start("127.0.0.1:0", nullptr) != 0) return 1;
+
+  Channel ch;
+  ChannelOptions opts;
+  opts.timeout_ms = 10000;
+  ch.Init(server.listen_address(), &opts);
+
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("will be canceled");
+  CountdownEvent done(1);
+  ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, [&] { done.signal(); });
+
+  fiber_usleep(50 * 1000);  // let the request reach the server
+  printf("canceling the in-flight call...\n");
+  cntl.StartCancel();
+
+  done.wait(-1);
+  printf("call ended: failed=%d code=%d (%s)\n", int(cntl.Failed()),
+         cntl.ErrorCode(),
+         cntl.ErrorCode() == ECANCELEDRPC ? "ECANCELEDRPC as expected"
+                                          : "unexpected");
+  server.Stop();
+  server.Join();
+  return cntl.ErrorCode() == ECANCELEDRPC ? 0 : 1;
+}

@@ -1,0 +1,83 @@
+// Cascade tour (reference example/cascade_echo_c++): server A's handler
+// calls server B before answering — the multi-hop pattern. With rpcz
+// sampling on, all three spans (client, A-as-server/A-as-client, B) share
+// one trace id: run with /rpcz to see the join.
+#include <cstdio>
+#include <sstream>
+#include <string>
+
+#include "fiber/fiber.h"
+#include "rpc/channel.h"
+#include "rpc/server.h"
+#include "rpc/span.h"
+
+using namespace brt;
+
+class LeafEcho : public Service {
+ public:
+  void CallMethod(const std::string&, Controller*, const IOBuf& req,
+                  IOBuf* response, Closure done) override {
+    response->append("leaf(");
+    response->append(req);
+    response->append(")");
+    done();
+  }
+};
+
+// Calls the next hop from inside the handler; the Controller's trace ids
+// propagate through the nested channel automatically.
+class FrontEcho : public Service {
+ public:
+  explicit FrontEcho(const EndPoint& next) { next_.Init(next, nullptr); }
+
+  void CallMethod(const std::string&, Controller* cntl, const IOBuf& req,
+                  IOBuf* response, Closure done) override {
+    Controller sub;
+    sub.trace_id = cntl->trace_id;   // same trace
+    sub.span_id = cntl->span_id;     // we are the parent
+    IOBuf sub_rsp;
+    next_.CallMethod("Echo", "Echo", &sub, req, &sub_rsp, nullptr);
+    response->append("front(");
+    response->append(sub_rsp);
+    response->append(")");
+    done();
+  }
+
+ private:
+  Channel next_;
+};
+
+int main() {
+  fiber_init(4);
+  FLAGS_rpcz_sample_ppm = 1000000;  // trace everything for the demo
+
+  Server leaf;
+  LeafEcho leaf_svc;
+  leaf.AddService(&leaf_svc, "Echo");
+  if (leaf.Start("127.0.0.1:0", nullptr) != 0) return 1;
+
+  Server front;
+  FrontEcho front_svc(leaf.listen_address());
+  front.AddService(&front_svc, "Echo");
+  if (front.Start("127.0.0.1:0", nullptr) != 0) return 1;
+
+  Channel ch;
+  ch.Init(front.listen_address(), nullptr);
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("hi");
+  ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+  printf("cascade result: %s (failed=%d)\n", rsp.to_string().c_str(),
+         int(cntl.Failed()));
+  printf("trace id %llx spans:\n", (unsigned long long)cntl.trace_id);
+  fiber_usleep(100 * 1000);  // let server spans land
+  std::ostringstream os;
+  SpanDumpTrace(os, cntl.trace_id);
+  printf("%s", os.str().c_str());
+
+  front.Stop();
+  front.Join();
+  leaf.Stop();
+  leaf.Join();
+  return rsp.equals("front(leaf(hi))") ? 0 : 1;
+}

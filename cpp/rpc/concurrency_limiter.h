@@ -10,6 +10,7 @@
 #include <atomic>
 #include <cstdint>
 #include <memory>
+#include <mutex>
 #include <string>
 
 #include "base/time.h"
@@ -36,43 +37,66 @@ class ConstantLimiter : public ConcurrencyLimiter {
   int max_;
 };
 
-// Vegas/gradient-style: track the no-load latency floor and recent peak
-// qps; the sustainable concurrency is peak_qps × min_latency (Little's
-// law) with headroom alpha; periodically decay the floor so the limiter
-// re-probes (reference auto_concurrency_limiter.cpp:267 structure).
+// Gradient/Vegas-style adaptive limiter, to the reference's fidelity
+// (policy/auto_concurrency_limiter.cpp:1-267 + its doc):
+//   * responses are SAMPLED (at most one per sample_interval_us) into a
+//     window that closes after window_us or max_samples, and is discarded
+//     if it closes with fewer than min_samples;
+//   * a no-load latency floor tracks downward by EMA; peak qps jumps up
+//     instantly and decays slowly;
+//   * limit = floor_qps product (Little's law) × (1 + explore), where the
+//     explore ratio walks within [min_explore, max_explore]: up while
+//     latency stays near the floor (probe for more), down under queueing;
+//   * periodically (randomized remeasure interval) the limit is pulled to
+//     reduce_ratio × the estimate and the floor is re-measured at the
+//     resulting low load — the warm-up/drift correction;
+//   * failed requests punish the average latency; an all-failed window
+//     halves the limit.
 class AutoLimiter : public ConcurrencyLimiter {
  public:
   struct Options {
-    double alpha = 0.3;          // headroom over Little's-law estimate
-    int min_limit = 8;           // never throttle below this
-    int64_t window_us = 500000;  // sampling window
+    int initial_limit = 40;             // warm-up ceiling (ref default)
+    int min_limit = 4;
+    int64_t window_us = 1000000;        // sample window duration
+    int min_samples = 20;               // discard smaller windows
+    int max_samples = 200;              // close early past this
+    int64_t sample_interval_us = 100;   // ≤1 sample per interval
+    double ema_alpha = 0.1;             // latency-floor smoothing
+    double max_explore = 0.3;
+    double min_explore = 0.06;
+    double explore_step = 0.02;
+    double fail_punish = 1.0;           // failed-latency weight
+    int64_t remeasure_interval_us = 50 * 1000000;
+    double remeasure_reduce = 0.9;
   };
 
   AutoLimiter() : AutoLimiter(Options{}) {}
-  explicit AutoLimiter(const Options& opt) : opt_(opt), limit_(100) {}
+  explicit AutoLimiter(const Options& opt)
+      : opt_(opt),
+        limit_(opt.initial_limit),
+        explore_(opt.max_explore),
+        remeasure_at_us_(NextRemeasure(monotonic_us())) {}
 
   bool OnRequested(int c) override {
     return c <= limit_.load(std::memory_order_relaxed);
   }
 
   void OnResponded(int error_code, int64_t latency_us) override {
-    if (error_code != 0) return;
+    if (error_code == 0) {
+      total_succ_.fetch_add(1, std::memory_order_relaxed);
+    } else if (error_code == 2004 /*ELIMIT*/) {
+      return;  // our own rejections are not a load signal
+    }
+    // Sampling interval: at most one response per interval enters the
+    // window (keeps the mutex off the hot path at high qps).
     const int64_t now = monotonic_us();
-    count_.fetch_add(1, std::memory_order_relaxed);
-    lat_sum_.fetch_add(latency_us, std::memory_order_relaxed);
-    // latency floor: EMA toward the smallest observations
-    int64_t floor = min_latency_us_.load(std::memory_order_relaxed);
-    if (floor == 0 || latency_us < floor) {
-      min_latency_us_.store(
-          floor == 0 ? latency_us : (floor * 7 + latency_us) / 8,
-          std::memory_order_relaxed);
+    int64_t last = last_sample_us_.load(std::memory_order_relaxed);
+    if (last != 0 && now - last < opt_.sample_interval_us) return;
+    if (!last_sample_us_.compare_exchange_strong(
+            last, now, std::memory_order_relaxed)) {
+      return;
     }
-    int64_t start = window_start_us_.load(std::memory_order_relaxed);
-    if (now - start >= opt_.window_us &&
-        window_start_us_.compare_exchange_strong(
-            start, now, std::memory_order_acq_rel)) {
-      Recompute(now - start);
-    }
+    AddSample(error_code, latency_us, now);
   }
 
   int max_concurrency() const override {
@@ -80,33 +104,114 @@ class AutoLimiter : public ConcurrencyLimiter {
   }
 
  private:
-  void Recompute(int64_t elapsed_us) {
-    const int64_t n = count_.exchange(0, std::memory_order_relaxed);
-    const int64_t lat_sum = lat_sum_.exchange(0, std::memory_order_relaxed);
-    if (n == 0 || elapsed_us <= 0) return;
-    const double qps = double(n) * 1e6 / double(elapsed_us);
-    peak_qps_ = std::max(peak_qps_ * 0.98, qps);  // decaying peak
-    const double avg_lat = double(lat_sum) / double(n);
-    int64_t floor = min_latency_us_.load(std::memory_order_relaxed);
-    if (floor <= 0) floor = int64_t(avg_lat);
-    // Little's law with headroom; congestion (avg >> floor) shrinks.
-    double est = peak_qps_ * double(floor) / 1e6 * (1.0 + opt_.alpha);
-    if (avg_lat > double(floor) * (1.0 + 2 * opt_.alpha)) {
-      est *= 0.9;  // gradient down under queueing
+  int64_t NextRemeasure(int64_t now) const {
+    // Randomized in [T/2, T): herds of servers must not re-probe in sync.
+    const int64_t half = opt_.remeasure_interval_us / 2;
+    return now + half + (now % (half > 0 ? half : 1));
+  }
+
+  void AddSample(int error_code, int64_t latency_us, int64_t now) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (reset_at_us_ != 0) {
+      if (reset_at_us_ > now) return;  // draining to low load: ignore
+      // Low load reached: re-measure the no-load floor from scratch.
+      min_latency_us_ = -1;
+      reset_at_us_ = 0;
+      remeasure_at_us_ = NextRemeasure(now);
+      ResetWindow(now);
     }
-    limit_.store(std::max<int>(opt_.min_limit, int(est)),
-                 std::memory_order_relaxed);
-    // slow floor decay: lets the estimate track service-time changes
-    min_latency_us_.store(floor + std::max<int64_t>(floor / 64, 1),
-                          std::memory_order_relaxed);
+    if (win_start_us_ == 0) win_start_us_ = now;
+    if (error_code != 0) {
+      ++win_fail_;
+      win_fail_lat_us_ += latency_us;
+    } else {
+      ++win_succ_;
+      win_succ_lat_us_ += latency_us;
+    }
+    const int n = win_succ_ + win_fail_;
+    if (n < opt_.min_samples) {
+      if (now - win_start_us_ >= opt_.window_us) ResetWindow(now);
+      return;  // window too small (yet)
+    }
+    if (now - win_start_us_ < opt_.window_us && n < opt_.max_samples) {
+      return;  // window still open
+    }
+    if (win_succ_ > 0) {
+      Update(now);
+    } else {
+      SetLimit(limit_.load(std::memory_order_relaxed) / 2);  // all failed
+    }
+    ResetWindow(now);
+  }
+
+  void ResetWindow(int64_t now) {
+    total_succ_.store(0, std::memory_order_relaxed);
+    win_start_us_ = now;
+    win_succ_ = win_fail_ = 0;
+    win_succ_lat_us_ = win_fail_lat_us_ = 0;
+  }
+
+  void SetLimit(int v) {
+    limit_.store(std::max(opt_.min_limit, v), std::memory_order_relaxed);
+  }
+
+  void Update(int64_t now) {
+    const double punished =
+        double(win_fail_lat_us_) * opt_.fail_punish + double(win_succ_lat_us_);
+    const int64_t avg_lat = int64_t(punished / double(win_succ_)) + 1;
+    const double qps = 1e6 *
+                       double(total_succ_.load(std::memory_order_relaxed)) /
+                       double(now - win_start_us_);
+    // Latency floor: EMA downward only.
+    if (min_latency_us_ <= 0) {
+      min_latency_us_ = avg_lat;
+    } else if (avg_lat < min_latency_us_) {
+      min_latency_us_ = int64_t(double(avg_lat) * opt_.ema_alpha +
+                                double(min_latency_us_) *
+                                    (1 - opt_.ema_alpha));
+    }
+    // Peak qps: jump up, decay slowly.
+    if (qps >= ema_max_qps_) {
+      ema_max_qps_ = qps;
+    } else {
+      const double a = opt_.ema_alpha / 10;
+      ema_max_qps_ = qps * a + ema_max_qps_ * (1 - a);
+    }
+    if (remeasure_at_us_ <= now) {
+      // Pull load down and re-measure the floor once drained.
+      reset_at_us_ = now + avg_lat * 2;
+      SetLimit(int(ema_max_qps_ * double(min_latency_us_) / 1e6 *
+                   opt_.remeasure_reduce) +
+               1);
+      return;
+    }
+    // Explore walk: widen while latency hugs the floor (or qps sits
+    // below peak — not limit-bound), narrow under queueing.
+    if (double(avg_lat) <=
+            double(min_latency_us_) * (1.0 + opt_.min_explore) ||
+        qps <= ema_max_qps_ / (1.0 + opt_.min_explore)) {
+      explore_ = std::min(opt_.max_explore, explore_ + opt_.explore_step);
+    } else {
+      explore_ = std::max(opt_.min_explore, explore_ - opt_.explore_step);
+    }
+    SetLimit(int(double(min_latency_us_) * ema_max_qps_ / 1e6 *
+                 (1 + explore_)) +
+             1);
   }
 
   Options opt_;
   std::atomic<int> limit_;
-  std::atomic<int64_t> count_{0}, lat_sum_{0};
-  std::atomic<int64_t> min_latency_us_{0};
-  std::atomic<int64_t> window_start_us_{0};
-  double peak_qps_ = 0;  // only touched under the CAS winner
+  std::atomic<int64_t> last_sample_us_{0};
+  std::atomic<int64_t> total_succ_{0};
+  std::mutex mu_;  // window + estimator state below
+  int64_t win_start_us_ = 0;
+  int win_succ_ = 0, win_fail_ = 0;
+  int64_t win_succ_lat_us_ = 0, win_fail_lat_us_ = 0;
+  int64_t min_latency_us_ = -1;
+  double ema_max_qps_ = -1;
+  double explore_;
+  int64_t reset_at_us_ = 0;
+  int64_t remeasure_at_us_;
 };
 
 // Rejects requests whose expected queueing delay would blow the deadline:

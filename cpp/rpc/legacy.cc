@@ -5,6 +5,7 @@
 #include <map>
 #include <mutex>
 
+#include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
 #include "rpc/pipelined_client.h"
@@ -264,6 +265,348 @@ int EspClient::Call(const EspHead& head, const IOBuf& body,
   if (rhead != nullptr) *rhead = reply.head;
   *response_body = std::move(reply.body);
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// hulu/sofa-style framed RPC.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Compact meta shared by both frames: u64 correlation, u8 flags (bit0 =
+// response), u32 error_code, then len-prefixed service + method (request)
+// or error_text (response).
+struct LegacyRpcMeta {
+  uint64_t correlation = 0;
+  bool is_response = false;
+  uint32_t error_code = 0;
+  std::string service, method, error_text;
+};
+
+void EncodeLegacyMeta(const LegacyRpcMeta& m, std::string* out) {
+  auto put_u32 = [out](uint32_t v) {
+    char b[4] = {char(v), char(v >> 8), char(v >> 16), char(v >> 24)};
+    out->append(b, 4);
+  };
+  put_u32(uint32_t(m.correlation));
+  put_u32(uint32_t(m.correlation >> 32));
+  out->push_back(m.is_response ? 1 : 0);
+  put_u32(m.error_code);
+  auto put_str = [&](const std::string& s) {
+    put_u32(uint32_t(s.size()));
+    out->append(s);
+  };
+  if (m.is_response) {
+    put_str(m.error_text);
+  } else {
+    put_str(m.service);
+    put_str(m.method);
+  }
+}
+
+bool DecodeLegacyMeta(const std::string& in, LegacyRpcMeta* m) {
+  size_t off = 0;
+  auto get_u32 = [&](uint32_t* v) {
+    if (off + 4 > in.size()) return false;
+    *v = uint32_t(uint8_t(in[off])) | uint32_t(uint8_t(in[off + 1])) << 8 |
+         uint32_t(uint8_t(in[off + 2])) << 16 |
+         uint32_t(uint8_t(in[off + 3])) << 24;
+    off += 4;
+    return true;
+  };
+  uint32_t lo = 0, hi = 0;
+  if (!get_u32(&lo) || !get_u32(&hi)) return false;
+  m->correlation = uint64_t(hi) << 32 | lo;
+  if (off >= in.size()) return false;
+  m->is_response = in[off++] != 0;
+  if (!get_u32(&m->error_code)) return false;
+  auto get_str = [&](std::string* s) {
+    uint32_t n = 0;
+    if (!get_u32(&n) || off + n > in.size()) return false;
+    s->assign(in, off, n);
+    off += n;
+    return true;
+  };
+  if (m->is_response) return get_str(&m->error_text);
+  return get_str(&m->service) && get_str(&m->method);
+}
+
+// Frame shapes. hulu: "HULU" u32 body_size u32 meta_size, body = meta +
+// data (reference hulu_pbrpc header layout). sofa: "SOFA" u32 meta_size
+// u32 data_size u32 reserved (reference sofa_pbrpc 24-byte head, less the
+// pb-specific fields).
+enum class LegacyKind { HULU, SOFA };
+
+void AppendLegacyFrame(LegacyKind kind, IOBuf* out, const LegacyRpcMeta& m,
+                       const IOBuf& data) {
+  std::string meta;
+  EncodeLegacyMeta(m, &meta);
+  char head[12];
+  auto put = [&](int at, uint32_t v) {
+    head[at] = char(v);
+    head[at + 1] = char(v >> 8);
+    head[at + 2] = char(v >> 16);
+    head[at + 3] = char(v >> 24);
+  };
+  if (kind == LegacyKind::HULU) {
+    memcpy(head, "HULU", 4);
+    put(4, uint32_t(meta.size() + data.size()));
+    put(8, uint32_t(meta.size()));
+  } else {
+    memcpy(head, "SOFA", 4);
+    put(4, uint32_t(meta.size()));
+    put(8, uint32_t(data.size()));
+  }
+  out->append(head, sizeof(head));
+  out->append(meta);
+  out->append(data);
+}
+
+// Returns OK with (*meta, *data) filled, or NOT_ENOUGH_DATA / TRY_OTHER /
+// ERROR — the standard admission contract.
+ParseResult LegacyParse(LegacyKind kind, IOBuf* source, IOBuf* msg) {
+  if (source->size() < 4) return ParseResult::NOT_ENOUGH_DATA;
+  char magic[4];
+  source->copy_to(magic, 4);
+  if (memcmp(magic, kind == LegacyKind::HULU ? "HULU" : "SOFA", 4) != 0) {
+    return ParseResult::TRY_OTHER;
+  }
+  if (source->size() < 12) return ParseResult::NOT_ENOUGH_DATA;
+  uint8_t head[12];
+  source->copy_to(head, 12);
+  auto get = [&](int at) {
+    return uint32_t(head[at]) | uint32_t(head[at + 1]) << 8 |
+           uint32_t(head[at + 2]) << 16 | uint32_t(head[at + 3]) << 24;
+  };
+  uint64_t total;
+  if (kind == LegacyKind::HULU) {
+    const uint64_t body = get(4);
+    if (get(8) > body || body > kMaxLegacyBody) return ParseResult::ERROR;
+    total = 12 + body;
+  } else {
+    const uint64_t meta = get(4), data = get(8);
+    if (meta + data > kMaxLegacyBody) return ParseResult::ERROR;
+    total = 12 + meta + data;
+  }
+  if (source->size() < total) return ParseResult::NOT_ENOUGH_DATA;
+  source->cutn(msg, total);
+  return ParseResult::OK;
+}
+
+bool SplitLegacyFrame(LegacyKind kind, IOBuf&& msg, LegacyRpcMeta* meta,
+                      IOBuf* data) {
+  uint8_t head[12];
+  msg.copy_to(head, 12);
+  msg.pop_front(12);
+  auto get = [&](int at) {
+    return uint32_t(head[at]) | uint32_t(head[at + 1]) << 8 |
+           uint32_t(head[at + 2]) << 16 | uint32_t(head[at + 3]) << 24;
+  };
+  const uint32_t meta_size =
+      kind == LegacyKind::HULU ? get(8) : get(4);
+  std::string meta_bytes;
+  msg.cutn(&meta_bytes, meta_size);
+  *data = std::move(msg);
+  return DecodeLegacyMeta(meta_bytes, meta);
+}
+
+// Server side: route to the Service registry with the standard admission
+// ladder (auth → limiter → service/method lookup → method stats), answer
+// with a mirrored-correlation response frame. Handlers may complete
+// asynchronously; the client serializes calls, so ordering is theirs.
+void LegacyProcess(LegacyKind kind, IOBuf&& raw, SocketId sock) {
+  SocketUniquePtr ptr;
+  if (Socket::Address(sock, &ptr) != 0) return;
+  auto* server = static_cast<Server*>(ptr->user());
+  LegacyRpcMeta meta;
+  IOBuf data;
+  if (!SplitLegacyFrame(kind, std::move(raw), &meta, &data) ||
+      meta.is_response) {
+    ptr->SetFailed(EBADMSG, "bad legacy rpc frame");
+    return;
+  }
+  auto respond = [kind, sock](uint64_t cid, uint32_t code,
+                              const std::string& text, const IOBuf& body) {
+    LegacyRpcMeta rm;
+    rm.correlation = cid;
+    rm.is_response = true;
+    rm.error_code = code;
+    rm.error_text = text;
+    IOBuf frame;
+    AppendLegacyFrame(kind, &frame, rm, body);
+    SocketUniquePtr p;
+    if (Socket::Address(sock, &p) == 0) p->Write(&frame);
+  };
+  if (server == nullptr || !server->IsRunning()) {
+    respond(meta.correlation, ELOGOFF, "server stopping", IOBuf());
+    return;
+  }
+  if (server->options().auth != nullptr &&
+      server->options().auth->VerifyCredential("", ptr->remote()) != 0) {
+    respond(meta.correlation, EAUTH, "auth failed", IOBuf());
+    return;
+  }
+  if (!server->OnRequestArrived()) {
+    respond(meta.correlation, ELIMIT, "over concurrency limit", IOBuf());
+    return;
+  }
+  Service* svc = server->FindService(meta.service);
+  if (svc == nullptr) {
+    server->OnRequestDone();
+    respond(meta.correlation, ENOSERVICE, "no such service", IOBuf());
+    return;
+  }
+  MethodStatus* ms = server->GetMethodStatus(meta.service, meta.method);
+  if (!ms->OnRequested()) {
+    server->OnRequestDone();
+    respond(meta.correlation, ELIMIT, "method over limit", IOBuf());
+    return;
+  }
+  struct Sess {
+    Controller cntl;
+    IOBuf response;
+    int64_t start_us;
+  };
+  auto* sess = new Sess;
+  sess->start_us = monotonic_us();
+  sess->cntl.set_remote_side(ptr->remote());
+  const uint64_t cid = meta.correlation;
+  const std::string method = meta.method;
+  svc->CallMethod(method, &sess->cntl, data, &sess->response,
+                  [sess, server, ms, respond, cid] {
+                    const int64_t lat = monotonic_us() - sess->start_us;
+                    const int ec = sess->cntl.ErrorCode();
+                    respond(cid, uint32_t(ec), sess->cntl.ErrorText(),
+                            sess->response);
+                    ms->OnResponded(ec, lat);
+                    server->OnResponseSent(ec, lat);
+                    server->requests_processed.fetch_add(
+                        1, std::memory_order_relaxed);
+                    server->OnRequestDone();  // last server touch
+                    delete sess;
+                  });
+}
+
+ParseResult HuluParseFn(IOBuf* s, IOBuf* m, Socket*) {
+  return LegacyParse(LegacyKind::HULU, s, m);
+}
+ParseResult SofaParseFn(IOBuf* s, IOBuf* m, Socket*) {
+  return LegacyParse(LegacyKind::SOFA, s, m);
+}
+void HuluProcessFn(IOBuf&& m, SocketId sid) {
+  LegacyProcess(LegacyKind::HULU, std::move(m), sid);
+}
+void SofaProcessFn(IOBuf&& m, SocketId sid) {
+  LegacyProcess(LegacyKind::SOFA, std::move(m), sid);
+}
+
+struct LegacyRpcReply {
+  LegacyRpcMeta meta;
+  IOBuf data;
+};
+
+template <LegacyKind K>
+struct LegacyRpcCore
+    : PipelinedClient<LegacyRpcCore<K>, LegacyRpcReply> {
+  using PipelinedClient<LegacyRpcCore<K>, LegacyRpcReply>::CallFrame;
+  static int CutReply(IOPortal* in, LegacyRpcReply* out) {
+    IOBuf frame;
+    IOBuf* src = in;
+    switch (LegacyParse(K, src, &frame)) {
+      case ParseResult::OK: break;
+      case ParseResult::NOT_ENOUGH_DATA: return EAGAIN;
+      default: return EBADMSG;
+    }
+    if (!SplitLegacyFrame(K, std::move(frame), &out->meta, &out->data) ||
+        !out->meta.is_response) {
+      return EBADMSG;
+    }
+    return 0;
+  }
+};
+
+template <LegacyKind K>
+int LegacyCall(LegacyRpcCore<K>* core, std::mutex* mu, uint64_t* next_cid,
+               const std::string& service, const std::string& method,
+               const IOBuf& request, IOBuf* response) {
+  // One outstanding call per connection: the correlation check is then a
+  // strict match (the simple legacy-client shape).
+  std::lock_guard<std::mutex> g(*mu);
+  LegacyRpcMeta m;
+  m.correlation = (*next_cid)++;
+  m.service = service;
+  m.method = method;
+  IOBuf frame;
+  AppendLegacyFrame(K, &frame, m, request);
+  LegacyRpcReply reply;
+  const int rc = core->CallFrame(std::move(frame), 0, &reply);
+  if (rc != 0) return rc;
+  if (reply.meta.correlation != m.correlation) return EBADMSG;
+  if (reply.meta.error_code != 0) return int(reply.meta.error_code);
+  *response = std::move(reply.data);
+  return 0;
+}
+
+}  // namespace
+
+void EnableHuluProtocol() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.name = "hulu";
+    p.parse = HuluParseFn;
+    p.process = HuluProcessFn;
+    RegisterProtocol(p);
+  });
+}
+
+void EnableSofaProtocol() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.name = "sofa";
+    p.parse = SofaParseFn;
+    p.process = SofaProcessFn;
+    RegisterProtocol(p);
+  });
+}
+
+struct HuluClient::Impl {
+  LegacyRpcCore<LegacyKind::HULU> core;
+  std::mutex mu;
+  uint64_t next_cid = 1;
+};
+
+HuluClient::HuluClient() : impl_(new Impl) {}
+HuluClient::~HuluClient() = default;
+
+int HuluClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  return impl_->core.Connect(server, timeout_ms);
+}
+
+int HuluClient::Call(const std::string& service, const std::string& method,
+                     const IOBuf& request, IOBuf* response) {
+  return LegacyCall(&impl_->core, &impl_->mu, &impl_->next_cid, service,
+                    method, request, response);
+}
+
+struct SofaClient::Impl {
+  LegacyRpcCore<LegacyKind::SOFA> core;
+  std::mutex mu;
+  uint64_t next_cid = 1;
+};
+
+SofaClient::SofaClient() : impl_(new Impl) {}
+SofaClient::~SofaClient() = default;
+
+int SofaClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  return impl_->core.Connect(server, timeout_ms);
+}
+
+int SofaClient::Call(const std::string& service, const std::string& method,
+                     const IOBuf& request, IOBuf* response) {
+  return LegacyCall(&impl_->core, &impl_->mu, &impl_->next_cid, service,
+                    method, request, response);
 }
 
 }  // namespace brt

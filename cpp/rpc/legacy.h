@@ -93,4 +93,50 @@ class EspClient {
   std::unique_ptr<Impl> impl_;
 };
 
+// ---------------------------------------------------------------------------
+// hulu/sofa-style framed RPC (reference policy/hulu_pbrpc_protocol.cpp and
+// policy/sofa_pbrpc_protocol.cpp): unlike nshead/esp these are FULL rpc
+// protocols — the meta names a service/method and requests route to the
+// same Service registry as brt_std, on the same port. Frame shapes follow
+// the respective families ("HULU" + body/meta sizes with meta leading the
+// body; "SOFA" + meta/data sizes); the metas are this framework's compact
+// binary (the reference metas are protobuf messages — this build is
+// pb-free by design, so wire-level interop with the original Baidu
+// clients is out of scope; the capability and port-sharing are in).
+// ---------------------------------------------------------------------------
+
+// Enables serving the protocol on every Server in the process (framed
+// admission happens per-connection via the shared protocol scan).
+void EnableHuluProtocol();
+void EnableSofaProtocol();
+
+// Blocking clients, one outstanding call per connection (the simple
+// legacy-client shape; responses match by correlation id).
+class HuluClient {
+ public:
+  HuluClient();
+  ~HuluClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  // Returns 0 and fills *response, or an errno-style / server error code.
+  int Call(const std::string& service, const std::string& method,
+           const IOBuf& request, IOBuf* response);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+class SofaClient {
+ public:
+  SofaClient();
+  ~SofaClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  int Call(const std::string& service, const std::string& method,
+           const IOBuf& request, IOBuf* response);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
 }  // namespace brt

@@ -5,8 +5,11 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <condition_variable>
 #include <deque>
 #include <mutex>
+
+#include "fiber/fiber.h"
 
 #include "base/flags.h"
 #include "base/iobuf.h"
@@ -77,8 +80,16 @@ struct SpanStore {
   std::mutex mu;
   std::deque<Span> ring;
   std::string dir;           // empty = memory only
-  FILE* seg_file = nullptr;  // active segment
+  FILE* seg_file = nullptr;  // active segment (flusher-owned, under mu)
   int64_t seg_bucket = -1;
+  // Disk writes happen on a background flusher fiber, never on the RPC
+  // completion path (the reference's collector-thread pattern): Submit
+  // only queues; the flusher drains `pending` and does the
+  // fopen/fwrite/retention work.
+  std::deque<Span> pending;
+  bool flusher_running = false;
+  int flush_waiters = 0;
+  std::condition_variable flushed_cv;
 
   void CloseSegLocked() {
     if (seg_file != nullptr) {
@@ -253,14 +264,78 @@ bool SpanDecode(const IOBuf& in, Span* out) {
   return c.ok;
 }
 
+namespace {
+
+// Drains pending spans to disk; exits when the queue runs dry (restarted
+// lazily by the next submit). Segment IO runs OUTSIDE st.mu so neither
+// submitters nor /rpcz readers ever wait on fwrite/fflush/retention.
+void* SpanFlusherEntry(void*) {
+  SpanStore& st = store();
+  for (;;) {
+    std::deque<Span> batch;
+    {
+      std::lock_guard<std::mutex> g(st.mu);
+      if (st.pending.empty()) {
+        st.flusher_running = false;
+        st.flushed_cv.notify_all();
+        return nullptr;
+      }
+      batch.swap(st.pending);
+    }
+    for (Span& s : batch) {
+      std::lock_guard<std::mutex> g(st.mu);  // guards seg state vs SetDir
+      st.AppendDiskLocked(s);
+    }
+    {
+      std::lock_guard<std::mutex> g(st.mu);
+      if (st.flush_waiters > 0 && st.pending.empty()) {
+        st.flushed_cv.notify_all();
+      }
+    }
+  }
+}
+
+}  // namespace
+
 void SpanSubmit(Span&& span) {
   limiter().set_budget(FLAGS_rpcz_max_per_second);
   if (!limiter().TryAcquire()) return;  // speed-limited, like the collector
   SpanStore& st = store();
-  std::lock_guard<std::mutex> g(st.mu);
-  st.AppendDiskLocked(span);
-  st.ring.push_back(std::move(span));
-  while (st.ring.size() > FLAGS_rpcz_max_spans) st.ring.pop_front();
+  bool start_flusher = false;
+  {
+    std::lock_guard<std::mutex> g(st.mu);
+    if (!st.dir.empty()) {
+      st.pending.push_back(span);
+      if (!st.flusher_running && st.pending.size() == 1) {
+        st.flusher_running = true;
+        start_flusher = true;
+      }
+    }
+    st.ring.push_back(std::move(span));
+    while (st.ring.size() > FLAGS_rpcz_max_spans) st.ring.pop_front();
+  }
+  if (start_flusher) {
+    fiber_t t;
+    if (fiber_start(&t, SpanFlusherEntry, nullptr) != 0) {
+      // No fiber runtime (degenerate caller): write inline.
+      std::lock_guard<std::mutex> g(st.mu);
+      while (!st.pending.empty()) {
+        st.AppendDiskLocked(st.pending.front());
+        st.pending.pop_front();
+      }
+      st.flusher_running = false;
+    }
+  }
+}
+
+void SpanStoreFlush() {
+  SpanStore& st = store();
+  std::unique_lock<std::mutex> lk(st.mu);
+  ++st.flush_waiters;
+  st.flushed_cv.wait(lk, [&] {
+    return st.pending.empty() && !st.flusher_running;
+  });
+  --st.flush_waiters;
 }
 
 void SpanDump(std::ostream& os, size_t max, const std::string& filter) {

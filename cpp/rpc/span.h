@@ -75,6 +75,10 @@ std::string SpanGetDatabaseDir();
 void SpanEncode(const Span& s, IOBuf* out);
 bool SpanDecode(const IOBuf& in, Span* out);
 
+// Blocks until queued spans have reached disk (the background flusher
+// drained). Pthread-blocking: call from a non-worker thread (tests).
+void SpanStoreFlush();
+
 // Test hook: drops the in-memory ring and closes the active segment —
 // the moral equivalent of a process restart (disk remains).
 void SpanStoreReset();

@@ -303,6 +303,31 @@ class EchoService : public Service {
   }
 };
 
+// hulu/sofa-style framed RPC: full service/method routing on the shared
+// port (reference hulu_pbrpc/sofa_pbrpc family).
+void test_hulu_sofa(const EndPoint& addr) {
+  HuluClient hulu;
+  assert(hulu.Init(addr, 3000) == 0);
+  IOBuf req, rsp;
+  req.append("framed-by-hulu");
+  assert(hulu.Call("Echo", "Echo", req, &rsp) == 0);
+  assert(rsp.equals("framed-by-hulu"));
+  // Unknown service surfaces the server's error code, connection stays up.
+  IOBuf rsp2;
+  assert(hulu.Call("Nope", "Echo", req, &rsp2) == ENOSERVICE);
+  IOBuf rsp3;
+  assert(hulu.Call("Echo", "Echo", req, &rsp3) == 0);
+  assert(rsp3.equals("framed-by-hulu"));
+
+  SofaClient sofa;
+  assert(sofa.Init(addr, 3000) == 0);
+  IOBuf sreq, srsp;
+  sreq.append("framed-by-sofa");
+  assert(sofa.Call("Echo", "Echo", sreq, &srsp) == 0);
+  assert(srsp.equals("framed-by-sofa"));
+  printf("hulu/sofa framed RPC OK\n");
+}
+
 }  // namespace
 
 int main() {
@@ -316,6 +341,8 @@ int main() {
   ServeNsheadOn(&server, &nshead);
   ServeEspOn(&server, &esp);
   ServeMongoOn(&server, &mongo);
+  EnableHuluProtocol();
+  EnableSofaProtocol();
   assert(server.Start("127.0.0.1:0") == 0);
   const EndPoint addr = server.listen_address();
 
@@ -324,6 +351,7 @@ int main() {
   test_esp(addr);
   test_mongo(addr);
   test_mongo_kind1(addr);
+  test_hulu_sofa(addr);
 
   // Shared-port sanity: native RPC still answers.
   Channel ch;

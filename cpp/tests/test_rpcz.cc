@@ -113,6 +113,7 @@ void test_persistence(const EndPoint& addr, const std::string& dir) {
     usleep(20 * 1000);
   }
   // Simulated restart: in-memory ring gone, disk remains.
+  SpanStoreFlush();  // background flusher must land them first
   SpanStoreReset();
   {
     std::ostringstream os;
@@ -143,6 +144,7 @@ void test_retention(const std::string& dir) {
   s.service = "R";
   s.method = "r";
   SpanSubmit(std::move(s));
+  SpanStoreFlush();  // retention runs on the flusher's segment roll
   assert(access(old_seg.c_str(), F_OK) != 0);  // reaped
   printf("  retention reaps old segments ok\n");
 }

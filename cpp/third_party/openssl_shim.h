@@ -104,6 +104,9 @@ int X509_NAME_add_entry_by_txt(X509_NAME* name, const char* field, int type,
 int X509_set_issuer_name(X509* x, X509_NAME* name);
 int X509_sign(X509* x, EVP_PKEY* pkey, const EVP_MD* md);
 const EVP_MD* EVP_sha256(void);
+const EVP_MD* EVP_md5(void);
+int EVP_Digest(const void* data, size_t count, unsigned char* md,
+               unsigned int* size, const EVP_MD* type, void* impl);
 EVP_PKEY* EVP_PKEY_Q_keygen(OSSL_LIB_CTX* libctx, const char* propq,
                             const char* type, ...);
 void EVP_PKEY_free(EVP_PKEY* pkey);

@@ -1,0 +1,110 @@
+-- Wireshark dissector for the brt_std wire protocol.
+-- Parity target: reference tools/wireshark_baidu_std.lua (the baidu_std
+-- dissector), adapted to this framework's frame (rpc/brt_meta.cc):
+--   12-byte header: "BRT1" | kind:u8 (0 rpc, 1 stream) | meta_len:u24 BE
+--                   | body_len:u32 BE
+--   meta: (tag:u8, value) pairs — ints are unsigned LEB128 varints,
+--   strings are varint-length-prefixed bytes.
+--
+-- Usage: wireshark -X lua_script:wireshark_brt_std.lua, then decode the
+-- server port as BRT_STD (or rely on the heuristic below).
+
+local brt = Proto("brt_std", "brpc-tpu brt_std RPC")
+
+local f_kind = ProtoField.uint8("brt_std.kind", "Kind", base.DEC,
+                                {[0] = "rpc", [1] = "stream"})
+local f_meta_len = ProtoField.uint24("brt_std.meta_len", "Meta length")
+local f_body_len = ProtoField.uint32("brt_std.body_len", "Body length")
+local f_type = ProtoField.uint32("brt_std.type", "Message type", base.DEC,
+                                 {[0] = "request", [1] = "response"})
+local f_cid = ProtoField.uint64("brt_std.correlation_id", "Correlation id")
+local f_service = ProtoField.string("brt_std.service", "Service")
+local f_method = ProtoField.string("brt_std.method", "Method")
+local f_error = ProtoField.uint32("brt_std.error_code", "Error code")
+local f_error_text = ProtoField.string("brt_std.error_text", "Error text")
+local f_attachment = ProtoField.uint32("brt_std.attachment_size",
+                                       "Attachment size")
+local f_timeout = ProtoField.uint32("brt_std.timeout_ms", "Timeout (ms)")
+local f_trace = ProtoField.uint64("brt_std.trace_id", "Trace id")
+local f_span = ProtoField.uint64("brt_std.span_id", "Span id")
+local f_body = ProtoField.bytes("brt_std.body", "Body")
+
+brt.fields = {f_kind, f_meta_len, f_body_len, f_type, f_cid, f_service,
+              f_method, f_error, f_error_text, f_attachment, f_timeout,
+              f_trace, f_span, f_body}
+
+-- Unsigned LEB128; returns value, next offset (or nil on truncation).
+local function varint(tvb, off, limit)
+  local v, shift = UInt64(0), 0
+  while off < limit do
+    local b = tvb(off, 1):uint()
+    v = v + UInt64(bit.band(b, 0x7f)):lshift(shift)
+    off = off + 1
+    if bit.band(b, 0x80) == 0 then return v, off end
+    shift = shift + 7
+    if shift > 63 then return nil end
+  end
+  return nil
+end
+
+local tag_fields = {
+  [1] = {f_type, "int"},   [2] = {f_cid, "int"},
+  [3] = {f_service, "str"}, [4] = {f_method, "str"},
+  [5] = {f_error, "int"},  [6] = {f_error_text, "str"},
+  [7] = {f_attachment, "int"}, [8] = {f_timeout, "int"},
+  [9] = {f_trace, "int"},  [10] = {f_span, "int"},
+}
+
+function brt.dissector(tvb, pinfo, tree)
+  local off = 0
+  while off + 12 <= tvb:len() do
+    if tvb(off, 4):string() ~= "BRT1" then return off end
+    local meta_len = tvb(off + 5, 3):uint()
+    local body_len = tvb(off + 8, 4):uint()
+    local frame_len = 12 + meta_len + body_len
+    if off + frame_len > tvb:len() then
+      -- Ask TCP reassembly for the rest of the frame.
+      pinfo.desegment_offset = off
+      pinfo.desegment_len = off + frame_len - tvb:len()
+      return tvb:len()
+    end
+    pinfo.cols.protocol = "BRT_STD"
+    local sub = tree:add(brt, tvb(off, frame_len))
+    sub:add(f_kind, tvb(off + 4, 1))
+    sub:add(f_meta_len, tvb(off + 5, 3))
+    sub:add(f_body_len, tvb(off + 8, 4))
+    -- Decode the tagged meta.
+    local m = off + 12
+    local m_end = m + meta_len
+    while m < m_end do
+      local tag = tvb(m, 1):uint()
+      m = m + 1
+      local spec = tag_fields[tag]
+      if spec == nil or spec[2] == "int" then
+        local v, nxt = varint(tvb, m, m_end)
+        if v == nil then break end
+        if spec ~= nil then sub:add(spec[1], tvb(m, nxt - m), v) end
+        m = nxt
+      else
+        local n, nxt = varint(tvb, m, m_end)
+        if n == nil or nxt + n:tonumber() > m_end then break end
+        sub:add(spec[1], tvb(nxt, n:tonumber()))
+        m = nxt + n:tonumber()
+      end
+    end
+    if body_len > 0 then
+      sub:add(f_body, tvb(off + 12 + meta_len, body_len))
+    end
+    off = off + frame_len
+  end
+  return off
+end
+
+-- Heuristic: any TCP payload starting with "BRT1".
+local function heuristic(tvb, pinfo, tree)
+  if tvb:len() < 4 or tvb(0, 4):string() ~= "BRT1" then return false end
+  brt.dissector(tvb, pinfo, tree)
+  return true
+end
+
+brt:register_heuristic("tcp", heuristic)

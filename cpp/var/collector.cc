@@ -36,6 +36,10 @@ bool RateLimiter::TryAcquire() {
     uint32_t cur_sec = uint32_t(cur >> 32);
     uint32_t used = uint32_t(cur);
     uint64_t next;
+    if (budget == 0) {  // budget 0 = fully off, even on a fresh second
+      dropped_.fetch_add(1, std::memory_order_relaxed);
+      return false;
+    }
     if (cur_sec != sec) {
       next = (uint64_t(sec) << 32) | 1;
     } else if (used >= budget) {
