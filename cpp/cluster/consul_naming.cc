@@ -97,6 +97,10 @@ void* ConsulNamingService::PollEntry(void* arg) {
     }
     std::vector<ServerNode> nodes;
     if (!ParseHealthJson(res.body, &nodes)) {
+      // The index header was already advanced: reset it, or the next
+      // blocking query would hang until the NEXT membership change and
+      // this (unparsed) list would never be delivered.
+      index = "0";
       fiber_usleep(2 * 1000 * 1000);
       continue;
     }

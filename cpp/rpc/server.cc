@@ -92,6 +92,11 @@ int Server::Start(const EndPoint& addr, const Options* opts) {
   acceptor_.conn_options.user = this;
   acceptor_.conn_options.on_edge_triggered = InputMessengerOnEdgeTriggered;
   acceptor_.conn_options.run_deferred = InputMessengerProcessDeferred;
+  acceptor_.conn_options.keepalive = options_.tcp_keepalive;
+  acceptor_.conn_options.keepalive_idle_s = options_.tcp_keepalive_idle_s;
+  acceptor_.conn_options.keepalive_interval_s =
+      options_.tcp_keepalive_interval_s;
+  acceptor_.conn_options.keepalive_count = options_.tcp_keepalive_count;
   if (options_.ssl.enable) {
     TlsOptions to;
     to.cert_file = options_.ssl.cert_file;
@@ -146,22 +151,49 @@ void Server::ReturnSessionData(void* d) {
 int Server::Stop() {
   if (!running_.exchange(false)) return 0;
   acceptor_.StopAccept();
-  // Fail every accepted connection pointing at this server: their sockets
-  // hold a raw user_ cookie, and a frame arriving after ~Server would be a
-  // use-after-free. In-flight requests are covered by Join().
-  std::vector<SocketId> all;
-  Socket::ListSockets(&all);
-  for (SocketId sid : all) {
-    SocketUniquePtr p;
-    if (Socket::Address(sid, &p) == 0 && p->user() == this) {
-      p->SetFailed(ELOGOFF, "server stopped");
-    }
-  }
+  // Connections stay up: in-flight requests must still DELIVER their
+  // responses (reference Stop/Join contract — Join returns only after
+  // responses reached the wire). New requests answer ELOGOFF via the
+  // IsRunning gate; Join() fails the sockets once the drain completes.
   return 0;
 }
 
 int Server::Join() {
+  // Reference contract: Join on a RUNNING server blocks until Stop() is
+  // called — it must never sever live clients itself.
+  while (running_.load(std::memory_order_acquire)) {
+    fiber_usleep(20 * 1000);
+  }
   while (concurrency_.load(std::memory_order_acquire) > 0) {
+    fiber_usleep(10 * 1000);
+  }
+  // Drained: every accepted response is on its socket's write chain
+  // (enqueued before OnRequestDone, the request's last server touch).
+  // NOW close the connections — their sockets hold a raw user_ cookie,
+  // and a frame arriving after ~Server would be a use-after-free.
+  // CloseAfterFlush (not SetFailed) lets a still-draining chain put its
+  // queued responses on the wire before the fd dies; then wait for the
+  // sockets to actually RECYCLE (drop out of the live registry): once no
+  // socket carries this server's cookie, no read fiber can reach the
+  // Server again, so returning is destruction-safe. A grace period
+  // bounds a slow-reader drain, after which stragglers are hard-failed.
+  const auto sweep = [this](bool hard) {
+    std::vector<SocketId> all;
+    Socket::ListSockets(&all);
+    size_t mine = 0;
+    for (SocketId sid : all) {
+      SocketUniquePtr p;
+      if (Socket::Address(sid, &p) == 0 && p->user() == this) {
+        ++mine;
+        if (hard) p->SetFailed(ELOGOFF, "server stopped");
+        else p->CloseAfterFlush();
+      }
+    }
+    return mine;
+  };
+  sweep(/*hard=*/false);
+  const int64_t grace_until = monotonic_us() + 2 * 1000 * 1000;
+  while (sweep(monotonic_us() >= grace_until) > 0) {
     fiber_usleep(10 * 1000);
   }
   // Session pool teardown happens AFTER the drain: in-flight requests

@@ -108,6 +108,13 @@ class Server {
       std::vector<std::string> alpn = {"h2", "http/1.1"};
     };
     SslOptions ssl;
+    // TCP keepalive on accepted connections (reference
+    // SocketKeepaliveOptions): dead peers behind quiet NATs are detected
+    // by the kernel instead of lingering forever. <=0 = kernel default.
+    bool tcp_keepalive = false;
+    int tcp_keepalive_idle_s = 0;
+    int tcp_keepalive_interval_s = 0;
+    int tcp_keepalive_count = 0;
   };
 
   Server() = default;

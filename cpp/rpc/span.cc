@@ -77,10 +77,13 @@ struct Cursor {
 constexpr int64_t kBucketSeconds = 60;
 
 struct SpanStore {
-  std::mutex mu;
+  std::mutex mu;             // ring + pending + dir + flusher state
   std::deque<Span> ring;
   std::string dir;           // empty = memory only
-  FILE* seg_file = nullptr;  // active segment (flusher-owned, under mu)
+  // Segment-file state lives under its OWN mutex so fwrite/fflush/
+  // retention never block SpanSubmit or /rpcz readers on st.mu.
+  std::mutex disk_mu;
+  FILE* seg_file = nullptr;  // active segment (under disk_mu)
   int64_t seg_bucket = -1;
   // Disk writes happen on a background flusher fiber, never on the RPC
   // completion path (the reference's collector-thread pattern): Submit
@@ -102,47 +105,47 @@ struct SpanStore {
   static int64_t BucketOf(int64_t real_us) {
     return real_us / 1000000 / kBucketSeconds;
   }
-  std::string SegPath(int64_t bucket) const {
-    return dir + "/spans_" + std::to_string(bucket) + ".rio";
+  static std::string SegPath(const std::string& d, int64_t bucket) {
+    return d + "/spans_" + std::to_string(bucket) + ".rio";
   }
 
   // Unlinks segments older than the retention window. Called on roll.
-  void RetainLocked(int64_t now_bucket) {
+  static void Retain(const std::string& sdir, int64_t now_bucket) {
     const int64_t keep_buckets =
         (int64_t(FLAGS_rpcz_keep_span_seconds) + kBucketSeconds - 1) /
         kBucketSeconds;
-    DIR* d = opendir(dir.c_str());
+    DIR* d = opendir(sdir.c_str());
     if (d == nullptr) return;
     while (dirent* e = readdir(d)) {
       const std::string n = e->d_name;
       if (n.rfind("spans_", 0) != 0) continue;
       const int64_t b = atoll(n.c_str() + 6);
       if (b < now_bucket - keep_buckets) {
-        ::unlink((dir + "/" + n).c_str());
+        ::unlink((sdir + "/" + n).c_str());
       }
     }
     closedir(d);
   }
 
-  void AppendDiskLocked(const Span& s) {
-    if (dir.empty()) return;
+  // Caller holds disk_mu (NOT mu); `sdir` is the caller's dir snapshot.
+  void AppendDiskLocked(const std::string& sdir, const Span& s) {
+    if (sdir.empty()) return;
     const int64_t bucket = BucketOf(s.start_real_us);
     if (bucket != seg_bucket || seg_file == nullptr) {
       CloseSegLocked();
-      seg_file = fopen(SegPath(bucket).c_str(), "ab");
+      seg_file = fopen(SegPath(sdir, bucket).c_str(), "ab");
       if (seg_file == nullptr) {
-        BRT_LOG(WARNING) << "rpcz: cannot open segment in " << dir;
+        BRT_LOG(WARNING) << "rpcz: cannot open segment in " << sdir;
         return;
       }
       seg_bucket = bucket;
-      RetainLocked(bucket);
+      Retain(sdir, bucket);
     }
     IOBuf rec;
     SpanEncode(s, &rec);
     RecordWriter w(seg_file);
     if (w.Write(rec)) w.Flush();
   }
-
 };
 
 // Scans every retained segment (newest first) for `trace_id` matches.
@@ -282,9 +285,15 @@ void* SpanFlusherEntry(void*) {
       }
       batch.swap(st.pending);
     }
-    for (Span& s : batch) {
-      std::lock_guard<std::mutex> g(st.mu);  // guards seg state vs SetDir
-      st.AppendDiskLocked(s);
+    std::string dir;
+    {
+      std::lock_guard<std::mutex> g(st.mu);
+      dir = st.dir;
+    }
+    {
+      // Disk IO under disk_mu only: SpanSubmit/readers stay unblocked.
+      std::lock_guard<std::mutex> g(st.disk_mu);
+      for (Span& s : batch) st.AppendDiskLocked(dir, s);
     }
     {
       std::lock_guard<std::mutex> g(st.mu);
@@ -318,12 +327,17 @@ void SpanSubmit(Span&& span) {
     fiber_t t;
     if (fiber_start(&t, SpanFlusherEntry, nullptr) != 0) {
       // No fiber runtime (degenerate caller): write inline.
-      std::lock_guard<std::mutex> g(st.mu);
-      while (!st.pending.empty()) {
-        st.AppendDiskLocked(st.pending.front());
-        st.pending.pop_front();
+      std::deque<Span> batch;
+      std::string dir;
+      {
+        std::lock_guard<std::mutex> g(st.mu);
+        batch.swap(st.pending);
+        dir = st.dir;
+        st.flusher_running = false;
+        st.flushed_cv.notify_all();  // a Flush() waiter must not hang
       }
-      st.flusher_running = false;
+      std::lock_guard<std::mutex> g(st.disk_mu);
+      for (Span& s : batch) st.AppendDiskLocked(dir, s);
     }
   }
 }
@@ -390,7 +404,9 @@ size_t SpanDumpTrace(std::ostream& os, uint64_t trace_id) {
 
 void SpanSetDatabaseDir(const std::string& dir) {
   SpanStore& st = store();
+  // Lock order everywhere: mu, then disk_mu (the flusher never nests).
   std::lock_guard<std::mutex> g(st.mu);
+  std::lock_guard<std::mutex> gd(st.disk_mu);
   st.CloseSegLocked();
   st.dir = dir;
   if (!dir.empty()) {
@@ -407,6 +423,7 @@ std::string SpanGetDatabaseDir() {
 void SpanStoreReset() {
   SpanStore& st = store();
   std::lock_guard<std::mutex> g(st.mu);
+  std::lock_guard<std::mutex> gd(st.disk_mu);
   st.ring.clear();
   st.CloseSegLocked();
 }

@@ -118,6 +118,21 @@ int Socket::Create(const Options& opts, SocketId* id_out) {
   set_nonblocking(opts.fd);
   int one = 1;
   setsockopt(opts.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (opts.keepalive) {
+    setsockopt(opts.fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+    if (opts.keepalive_idle_s > 0) {
+      setsockopt(opts.fd, IPPROTO_TCP, TCP_KEEPIDLE, &opts.keepalive_idle_s,
+                 sizeof(int));
+    }
+    if (opts.keepalive_interval_s > 0) {
+      setsockopt(opts.fd, IPPROTO_TCP, TCP_KEEPINTVL,
+                 &opts.keepalive_interval_s, sizeof(int));
+    }
+    if (opts.keepalive_count > 0) {
+      setsockopt(opts.fd, IPPROTO_TCP, TCP_KEEPCNT, &opts.keepalive_count,
+                 sizeof(int));
+    }
+  }
 
   SocketSlab& slab = SocketSlab::singleton();
   uint32_t index = slab.alloc_index();

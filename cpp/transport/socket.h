@@ -94,6 +94,12 @@ class Socket {
     // the same port — the reference's ssl-vs-plaintext sniffing). Ownership
     // stays with the server; must outlive the socket.
     TlsContext* tls_server_ctx = nullptr;
+    // TCP keepalive (reference SocketKeepaliveOptions, socket.h:178):
+    // enable with keepalive=true; <=0 leaves a knob at the kernel default.
+    bool keepalive = false;
+    int keepalive_idle_s = 0;      // TCP_KEEPIDLE
+    int keepalive_interval_s = 0;  // TCP_KEEPINTVL
+    int keepalive_count = 0;       // TCP_KEEPCNT
   };
 
   // Wraps an existing connected/listening fd, registers it with the event
