@@ -193,7 +193,16 @@ int Server::Join() {
   };
   sweep(/*hard=*/false);
   const int64_t grace_until = monotonic_us() + 2 * 1000 * 1000;
-  while (sweep(monotonic_us() >= grace_until) > 0) {
+  // BOTH conditions, re-checked together each pass: a request that beat
+  // the IsRunning gate can bump concurrency_ after the drain loop above
+  // (its fiber still holds the socket ref, so sweep sees it) and then run
+  // user code past the socket's death (usercode pthread pool / async
+  // done) — concurrency_ covers that tail.
+  for (;;) {
+    if (concurrency_.load(std::memory_order_acquire) == 0 &&
+        sweep(monotonic_us() >= grace_until) == 0) {
+      break;
+    }
     fiber_usleep(10 * 1000);
   }
   // Session pool teardown happens AFTER the drain: in-flight requests

@@ -85,6 +85,7 @@ struct SpanStore {
   std::mutex disk_mu;
   FILE* seg_file = nullptr;  // active segment (under disk_mu)
   int64_t seg_bucket = -1;
+  std::string seg_dir;       // dir seg_file lives in (under disk_mu)
   // Disk writes happen on a background flusher fiber, never on the RPC
   // completion path (the reference's collector-thread pattern): Submit
   // only queues; the flusher drains `pending` and does the
@@ -100,6 +101,7 @@ struct SpanStore {
       seg_file = nullptr;
     }
     seg_bucket = -1;
+    seg_dir.clear();
   }
 
   static int64_t BucketOf(int64_t real_us) {
@@ -131,7 +133,9 @@ struct SpanStore {
   void AppendDiskLocked(const std::string& sdir, const Span& s) {
     if (sdir.empty()) return;
     const int64_t bucket = BucketOf(s.start_real_us);
-    if (bucket != seg_bucket || seg_file == nullptr) {
+    // Reopen on a bucket roll OR a dir change: a racing
+    // SpanSetDatabaseDir must not leave records landing in the old dir.
+    if (bucket != seg_bucket || sdir != seg_dir || seg_file == nullptr) {
       CloseSegLocked();
       seg_file = fopen(SegPath(sdir, bucket).c_str(), "ab");
       if (seg_file == nullptr) {
@@ -139,6 +143,7 @@ struct SpanStore {
         return;
       }
       seg_bucket = bucket;
+      seg_dir = sdir;
       Retain(sdir, bucket);
     }
     IOBuf rec;
@@ -276,6 +281,7 @@ void* SpanFlusherEntry(void*) {
   SpanStore& st = store();
   for (;;) {
     std::deque<Span> batch;
+    std::string dir;
     {
       std::lock_guard<std::mutex> g(st.mu);
       if (st.pending.empty()) {
@@ -284,11 +290,7 @@ void* SpanFlusherEntry(void*) {
         return nullptr;
       }
       batch.swap(st.pending);
-    }
-    std::string dir;
-    {
-      std::lock_guard<std::mutex> g(st.mu);
-      dir = st.dir;
+      dir = st.dir;  // same critical section: no SetDatabaseDir between
     }
     {
       // Disk IO under disk_mu only: SpanSubmit/readers stay unblocked.
@@ -326,18 +328,23 @@ void SpanSubmit(Span&& span) {
   if (start_flusher) {
     fiber_t t;
     if (fiber_start(&t, SpanFlusherEntry, nullptr) != 0) {
-      // No fiber runtime (degenerate caller): write inline.
+      // No fiber runtime (degenerate caller): write inline. The flush
+      // flag clears (and waiters wake) only AFTER the records are on
+      // disk — SpanStoreFlush's guarantee.
       std::deque<Span> batch;
       std::string dir;
       {
         std::lock_guard<std::mutex> g(st.mu);
         batch.swap(st.pending);
         dir = st.dir;
-        st.flusher_running = false;
-        st.flushed_cv.notify_all();  // a Flush() waiter must not hang
       }
-      std::lock_guard<std::mutex> g(st.disk_mu);
-      for (Span& s : batch) st.AppendDiskLocked(dir, s);
+      {
+        std::lock_guard<std::mutex> g(st.disk_mu);
+        for (Span& s : batch) st.AppendDiskLocked(dir, s);
+      }
+      std::lock_guard<std::mutex> g(st.mu);
+      st.flusher_running = false;
+      st.flushed_cv.notify_all();
     }
   }
 }

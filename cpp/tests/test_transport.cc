@@ -4,6 +4,8 @@
 #include <cassert>
 #include <cstdio>
 #include <cstring>
+#include <atomic>
+#include <vector>
 #include <string>
 
 #include "fiber/fiber.h"
@@ -116,6 +118,140 @@ static void test_connect_refused() {
   printf("connect_refused OK\n");
 }
 
+// Regression: Socket::Address must not resurrect a socket whose refcount
+// already hit zero (the window between the final Dereference and
+// OnRecycle's version bump) — the double-recycle corrupted the slab
+// (`CHECK failed: v & 1`, hit reliably by rpc_press against a dead port).
+// Hammer connect-fail + concurrent Address on the dying ids.
+static void test_address_recycle_race() {
+  EndPoint dead;
+  EndPoint::parse("127.0.0.1:1", &dead);
+  std::atomic<bool> stop{false};
+  std::atomic<SocketId> latest{INVALID_SOCKET_ID};
+  constexpr int kSpinners = 4;
+  CountdownEvent done(kSpinners);
+  struct Arg {
+    std::atomic<bool>* stop;
+    std::atomic<SocketId>* latest;
+    CountdownEvent* done;
+  } arg{&stop, &latest, &done};
+  for (int i = 0; i < kSpinners; ++i) {
+    fiber_t t;
+    fiber_start(&t, [](void* p) -> void* {
+      auto* a = static_cast<Arg*>(p);
+      while (!a->stop->load(std::memory_order_relaxed)) {
+        SocketUniquePtr ptr;
+        Socket::Address(a->latest->load(std::memory_order_relaxed), &ptr);
+        // ptr drops immediately: another deref racing the recycle path.
+      }
+      a->done->signal();
+      return nullptr;
+    }, &arg);
+  }
+  for (int i = 0; i < 400; ++i) {
+    Socket::Options opts;
+    SocketId sid = INVALID_SOCKET_ID;
+    (void)Socket::Connect(dead, opts, &sid, 50 * 1000);
+    if (sid != INVALID_SOCKET_ID) {
+      latest.store(sid, std::memory_order_relaxed);
+    }
+  }
+  stop.store(true);
+  done.wait(-1);
+  // Survival IS the assertion (the old bug aborted the process); plus the
+  // slab must still hand out valid sockets.
+  Socket::Options opts;
+  SocketId sid;
+  assert(Socket::Connect(dead, opts, &sid, 50 * 1000) != 0);
+  printf("address_recycle_race OK\n");
+}
+
+// Wait-free write chain: many fibers write framed messages concurrently
+// on ONE socket; every frame must arrive intact (no interleaving inside a
+// WriteReq) and be echoed. Exercises CAS-push, inline flush, KeepWrite
+// handoff, and AdvanceWriteChain under contention.
+static std::atomic<int>* g_burst_got;
+static CountdownEvent* g_burst_done;
+static void tst_process_burst(IOBuf&& msg, SocketId) {
+  const std::string s = msg.to_string();
+  // Payload = one repeated letter; corruption (chain interleave) would mix.
+  assert(!s.empty());
+  for (char c : s) assert(c == s[0]);
+  if (g_burst_got->fetch_add(1) + 1 == 64) g_burst_done->signal();
+}
+
+static void test_concurrent_write_chain(const EndPoint& server_addr) {
+  static int burst_proto =
+      RegisterProtocol({"tst_burst", tst_parse, tst_process_burst});
+  Socket::Options copts;
+  copts.on_edge_triggered = InputMessengerOnEdgeTriggered;
+  copts.run_deferred = InputMessengerProcessDeferred;
+  SocketId cid;
+  assert(Socket::Connect(server_addr, copts, &cid) == 0);
+  SocketUniquePtr cptr;
+  assert(Socket::Address(cid, &cptr) == 0);
+  cptr->preferred_protocol = burst_proto;
+
+  CountdownEvent all_echoed(1);
+  g_burst_done = &all_echoed;
+  g_burst_got = new std::atomic<int>(0);
+  constexpr int kWriters = 8, kPerWriter = 8;
+  CountdownEvent writers_done(kWriters);
+  struct WArg {
+    Socket* s;
+    int letter;
+    CountdownEvent* done;
+  };
+  std::vector<WArg> args;
+  args.reserve(kWriters);
+  for (int w = 0; w < kWriters; ++w) {
+    args.push_back(WArg{cptr.get(), 'a' + w, &writers_done});
+  }
+  for (int w = 0; w < kWriters; ++w) {
+    fiber_t t;
+    fiber_start(&t, [](void* p) -> void* {
+      auto* a = static_cast<WArg*>(p);
+      for (int i = 0; i < kPerWriter; ++i) {
+        IOBuf out;
+        frame(&out, std::string(8000 + size_t(i) * 997, char(a->letter)));
+        assert(a->s->Write(&out) == 0);
+      }
+      a->done->signal();
+      return nullptr;
+    }, &args[size_t(w)]);
+  }
+  writers_done.wait(-1);
+  assert(all_echoed.wait(10 * 1000 * 1000) == 0);
+  assert(g_burst_got->load() == kWriters * kPerWriter);
+  cptr->SetFailed(ECANCELED, "burst done");
+  printf("concurrent_write_chain OK (64 frames intact)\n");
+}
+
+// CloseAfterFlush delivers the full queued chain before the fd dies.
+static void test_close_after_flush(const EndPoint& server_addr) {
+  Socket::Options copts;
+  copts.on_edge_triggered = InputMessengerOnEdgeTriggered;
+  copts.run_deferred = InputMessengerProcessDeferred;
+  SocketId cid;
+  assert(Socket::Connect(server_addr, copts, &cid) == 0);
+  SocketUniquePtr cptr;
+  assert(Socket::Address(cid, &cptr) == 0);
+  cptr->preferred_protocol = g_client_proto;
+
+  CountdownEvent got(1);
+  g_client_got = &got;
+  std::string big(512 * 1024, 'f');
+  IOBuf req;
+  frame(&req, big);
+  assert(cptr->Write(&req) == 0);
+  cptr->CloseAfterFlush();  // close request racing the in-flight write
+  // The echo still comes back whole: the request fully reached the
+  // server before the close landed.
+  assert(got.wait(10 * 1000 * 1000) == 0);
+  assert(g_client_payload == big);
+  printf("close_after_flush OK\n");
+}
+
 int main() {
   fiber_init(4);
   // Two protocol personalities of the same wire format: the server echoes,
@@ -138,8 +274,11 @@ int main() {
 
   test_stale_id();
   test_connect_refused();
+  test_address_recycle_race();
   test_echo_roundtrip(acceptor.listen_point());
   assert(g_server_msgs.load() == 2);
+  test_concurrent_write_chain(acceptor.listen_point());
+  test_close_after_flush(acceptor.listen_point());
   acceptor.StopAccept();
   printf("test_transport: ALL OK\n");
   return 0;
