@@ -37,36 +37,11 @@ def ensure_built() -> str:
     return bench
 
 
-def run_device_bench(deadline_s: int = 600) -> dict:
-    """Runs bench_device.py under a hard deadline; explicit skip otherwise."""
-    import socket
-
-    # Fast pre-check: the axon relay port. Closed → no chip, skip quickly.
-    s = socket.socket()
-    s.settimeout(0.5)
-    try:
-        s.connect(("127.0.0.1", 8082))
-    except OSError:
-        return {"skipped": "no device tunnel (port 8082 closed)"}
-    finally:
-        s.close()
-    # The port being open is NOT enough — a wedged tunnel accepts connects
-    # but blocks client init forever. Probe by real client creation under
-    # a short deadline before committing to the full measurement.
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "from brpc_tpu import rpc; rpc.DeviceClient().close(); "
-             "print('ok')"],
-            capture_output=True, text=True, timeout=60, cwd=ROOT,
-        )
-        if probe.returncode != 0 or "ok" not in probe.stdout:
-            return {"skipped": "device client probe failed"}
-    except subprocess.TimeoutExpired:
-        return {"skipped": "device tunnel wedged (probe init >60s)"}
+def _run_device_child(mode: str, deadline_s: int) -> dict:
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.join(ROOT, "bench_device.py")],
+            [sys.executable, os.path.join(ROOT, "bench_device.py"),
+             "--mode", mode],
             capture_output=True, text=True, timeout=deadline_s, cwd=ROOT,
         )
     except subprocess.TimeoutExpired:
@@ -80,6 +55,48 @@ def run_device_bench(deadline_s: int = 600) -> dict:
         return json.loads(proc.stdout.strip().splitlines()[-1])
     except ValueError:
         return {"skipped": "device bench emitted no JSON"}
+
+
+def run_device_bench(deadline_s: int = 900) -> dict:
+    """Measures the device tier: real chip if one answers, otherwise the
+    in-repo fake PJRT plugin (clearly labeled `device_sim`) so the path is
+    exercised every round. Returns {"device": ..., "device_sim": ...?}.
+
+    deadline_s bounds the WHOLE device tier (probe + real + sim children
+    share the budget) — a wedged tunnel must not hang the host bench.
+
+    The real-chip gate is __graft_entry__._probe_real_devices (deadline-
+    guarded `jax.devices()` child counting non-CPU platforms): backend
+    init on a wedged axon tunnel blocks forever rather than failing, and
+    a closed relay port alone proved too coarse a signal (it skipped four
+    rounds straight).
+    """
+    import time
+
+    t_end = time.monotonic() + deadline_s
+    budget = lambda: max(60, int(t_end - time.monotonic()))  # noqa: E731
+    sys.path.insert(0, ROOT)
+    try:
+        from __graft_entry__ import _probe_real_devices
+        n_real = _probe_real_devices(deadline_s=60.0)
+        probe_err = None
+    except Exception as e:  # noqa: BLE001
+        n_real = 0
+        probe_err = f"{type(e).__name__}: {e}"[:200]
+    if n_real > 0:
+        real = _run_device_child("real", budget())
+        if "h2d_gbps" in real and "step_time_ms" in real:
+            return {"device": real}
+        # A chip answered the probe but the measurement failed (fully, or
+        # partially via staging_error/step_error with rc=0) — record what
+        # happened AND still produce sim numbers below.
+        device = real
+    else:
+        device = {"skipped": probe_err or
+                  "no real accelerator (deadline-guarded probe found "
+                  "none; CPU fallback devices don't count)"}
+    sim = _run_device_child("sim", budget())
+    return {"device": device, "device_sim": sim}
 
 
 def main() -> int:
@@ -162,8 +179,10 @@ def main() -> int:
 
         # Device tier (BASELINE north stars): measured by bench_device.py
         # in a deadline-guarded child — a wedged TPU tunnel blocks device
-        # init forever and must not hang the host bench.
-        device = run_device_bench()
+        # init forever and must not hang the host bench. Yields a real
+        # `device` block when a chip answers, plus/or a clearly-labeled
+        # `device_sim` block (fake PJRT plugin + host CPU) otherwise.
+        device_blocks = run_device_bench()
 
         gbps = best["gbps"]
         print(json.dumps({
@@ -183,7 +202,7 @@ def main() -> int:
                              ("payload", "connections", "depth", "uds")},
             "small_scaling": scaling,
             "tls": tls_stats,
-            "device": device,
+            **device_blocks,
         }))
         return 0
     except Exception as e:  # noqa: BLE001

@@ -66,6 +66,10 @@ int ConsulNamingService::Start(const std::string& param,
 
 void ConsulNamingService::Stop() {
   stopping_.store(true, std::memory_order_release);
+  // Abort the in-flight blocking query: the poll fiber may be parked
+  // inside a wait_s (60s default) consul long-poll, and ~Channel must
+  // not stall shutdown for a minute waiting for the agent to answer.
+  cancel_.Cancel();
   if (fid_ != 0) {
     fiber_join(fid_);
     fid_ = 0;
@@ -83,7 +87,8 @@ void* ConsulNamingService::PollEntry(void* arg) {
                              "&wait=" + std::to_string(self->wait_s) + "s";
     HttpClientResult res;
     const int rc = HttpFetch(self->agent_, "GET", path, "", "", &res,
-                             (self->wait_s + 5) * 1000);
+                             (self->wait_s + 5) * 1000, /*use_tls=*/false,
+                             &self->cancel_);
     if (self->stopping_.load(std::memory_order_acquire)) break;
     if (rc != 0 || res.status != 200) {
       // Agent unreachable / 5xx: keep the last list, back off, re-poll

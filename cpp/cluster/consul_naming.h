@@ -16,6 +16,7 @@
 #include "base/endpoint.h"
 #include "cluster/naming_service.h"
 #include "fiber/fiber.h"
+#include "rpc/http_client.h"
 
 namespace brt {
 
@@ -37,6 +38,7 @@ class ConsulNamingService : public NamingService {
   ServerListCallback cb_;
   fiber_t fid_ = 0;
   std::atomic<bool> stopping_{false};
+  FetchCancel cancel_;  // aborts the in-flight long-poll on Stop()
 };
 
 }  // namespace brt

@@ -113,10 +113,21 @@ TlsContext* DefaultClientTls() {
   return ctx;
 }
 
+void FetchCancel::Cancel() {
+  cancelled.store(true, std::memory_order_seq_cst);
+  const SocketId s = sid.load(std::memory_order_seq_cst);
+  if (s != INVALID_SOCKET_ID) {
+    SocketUniquePtr p;
+    if (Socket::Address(s, &p) == 0) {
+      p->SetFailed(ECANCELED, "fetch cancelled");
+    }
+  }
+}
+
 int HttpFetch(const EndPoint& server, const std::string& method,
               const std::string& path, const std::string& body,
               const std::string& content_type, HttpClientResult* out,
-              int64_t timeout_ms, bool use_tls) {
+              int64_t timeout_ms, bool use_tls, FetchCancel* cancel) {
   fiber_init(0);
   auto* ctx = new FetchCtx;
   ctx->out = out;
@@ -129,7 +140,21 @@ int HttpFetch(const EndPoint& server, const std::string& method,
   opts.parsing_context_destroyer = DestroyFetchCtx;
   SocketId sid = INVALID_SOCKET_ID;
   const int64_t timeout_us = timeout_ms * 1000;
-  int rc = Socket::Connect(server, opts, &sid, timeout_us);
+  // Publish the socket id BEFORE the connect park: Cancel() must be able
+  // to abort a blackholed connect, not just a parked response wait.
+  std::function<void(SocketId)> on_created;
+  if (cancel != nullptr) {
+    on_created = [cancel](SocketId s) {
+      cancel->sid.store(s, std::memory_order_seq_cst);
+      if (cancel->cancelled.load(std::memory_order_seq_cst)) {
+        SocketUniquePtr c;
+        if (Socket::Address(s, &c) == 0) {
+          c->SetFailed(ECANCELED, "fetch cancelled");
+        }
+      }
+    };
+  }
+  int rc = Socket::Connect(server, opts, &sid, timeout_us, on_created);
   if (rc != 0) {
     // Create attaches ctx to the socket (freed at recycle); only a
     // pre-Create failure leaves it ours to free.

@@ -4,11 +4,13 @@
 // policy/http_rpc_protocol.cpp client half).
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <string>
 
 #include "base/endpoint.h"
 #include "rpc/http_message.h"
+#include "transport/socket.h"
 
 namespace brt {
 
@@ -18,13 +20,26 @@ struct HttpClientResult {
   HttpMessage head;  // headers etc.
 };
 
+// Lets another thread abort a blocking HttpFetch (e.g. a naming service
+// stopping while parked inside a 60s consul long-poll). The seq_cst
+// publish/check handshake guarantees one side observes the other: either
+// the fetch sees `cancelled` right after publishing its socket, or
+// Cancel() sees the published socket and fails it.
+struct FetchCancel {
+  std::atomic<SocketId> sid{INVALID_SOCKET_ID};
+  std::atomic<bool> cancelled{false};
+  void Cancel();
+};
+
 // Blocking GET/POST to host:port (fiber parks, worker stays free).
 // `path` includes query. Returns 0 or errno-style.
 // use_tls: speak https (certs accepted unverified — `curl -k` trust model).
+// cancel: optional; FetchCancel::Cancel() from any thread aborts the call.
 int HttpFetch(const EndPoint& server, const std::string& method,
               const std::string& path, const std::string& body,
               const std::string& content_type, HttpClientResult* out,
-              int64_t timeout_ms = 5000, bool use_tls = false);
+              int64_t timeout_ms = 5000, bool use_tls = false,
+              FetchCancel* cancel = nullptr);
 
 inline int HttpGet(const EndPoint& server, const std::string& path,
                    HttpClientResult* out, int64_t timeout_ms = 5000) {

@@ -162,8 +162,21 @@ void ReturnPooledSocket(const EndPoint& remote, SocketId sid, int group,
                         TlsContext* tls) {
   SocketUniquePtr p;
   if (Socket::Address(sid, &p) != 0 || p->Failed()) return;
-  std::unique_lock lk(g_mu);
-  g_map[MapKey{remote, group, tls}].pooled.push_back(sid);
+  {
+    std::unique_lock lk(g_mu);
+    // Append only to a still-live entry. The POOLED borrow path created it;
+    // absence means PurgeTlsEntries erased it (the TlsContext died while
+    // this call was in flight). Re-creating the entry here would key the fd
+    // by a freed pointer — unreachable forever, and a NEW context allocated
+    // at the same address would inherit a socket handshaked under a
+    // different trust config.
+    auto it = g_map.find(MapKey{remote, group, tls});
+    if (it != g_map.end()) {
+      it->second.pooled.push_back(sid);
+      return;
+    }
+  }
+  p->SetFailed(ECANCELED, "pool entry purged while call in flight");
 }
 
 void RemoveSingleSocket(const EndPoint& remote, SocketId sid) {

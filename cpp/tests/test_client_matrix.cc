@@ -7,6 +7,11 @@
 // recovers (a follow-up success call) after every failure cell. This is
 // the suite that shakes out connection-type bugs (pooled return on error,
 // single-socket drop on failure, short teardown) nothing else drives.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
 #include <cassert>
 #include <cstdio>
 #include <string>
@@ -166,9 +171,24 @@ int main() {
   server.AddService(&svc, "Echo");
   assert(server.Start("127.0.0.1:0", nullptr) == 0);
   const EndPoint live = server.listen_address();
-  // A port with no listener: bind+listen+close to reserve a refused port.
+  // A port with no listener: bind an ephemeral port, record it, close the
+  // listener. The kernel avoids handing the port back out immediately, so
+  // connects are refused — unlike live.port+1, which an unrelated process
+  // could be listening on (flaking the 12 CONNECT_FAIL cells).
   EndPoint dead = live;
-  dead.port = live.port == 65535 ? live.port - 1 : live.port + 1;
+  {
+    const int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    assert(lfd >= 0);
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_port = 0;
+    assert(bind(lfd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0);
+    socklen_t len = sizeof(sa);
+    assert(getsockname(lfd, reinterpret_cast<sockaddr*>(&sa), &len) == 0);
+    dead.port = ntohs(sa.sin_port);
+    close(lfd);  // no listen(): connects to this port are refused
+  }
 
   int cells = 0;
   for (Addressing a : {Addressing::DIRECT, Addressing::NS}) {

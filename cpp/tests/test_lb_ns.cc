@@ -181,8 +181,11 @@ class FakeConsul {
                "X-Consul-Index: %ld\r\nContent-Length: %zu\r\n"
                "Connection: close\r\n\r\n",
                idx, body.size());
-      (void)!::write(c, head, strlen(head));
-      (void)!::write(c, body.data(), body.size());
+      // MSG_NOSIGNAL: a stopping NamingService cancels its in-flight
+      // long-poll, so this answer may race the client's close (EPIPE is
+      // fine; SIGPIPE would kill the test).
+      (void)!::send(c, head, strlen(head), MSG_NOSIGNAL);
+      (void)!::send(c, body.data(), body.size(), MSG_NOSIGNAL);
       ::close(c);
       if (stop_.load()) return;
     }

@@ -483,6 +483,14 @@ void Socket::ReleaseChainOnError(WriteReq* cur, int err) {
 
 int Socket::WaitEpollOut(int64_t timeout_us) {
   int expected = butex_value(epollout_butex_).load(std::memory_order_acquire);
+  // Missed-wakeup guard: SetFailed CASes failed_, THEN bumps the butex and
+  // wakes. A failure landing between our expected-load and butex_wait would
+  // otherwise bump a butex nobody watches and leave this fiber parked to
+  // its full timeout (forever for the -1 KeepWrite wait). failed_'s CAS
+  // precedes the bump in SetFailed's program order, so seeing failed_==0
+  // here means any concurrent bump lands after `expected` was read —
+  // butex_wait then returns immediately on the value mismatch.
+  if (failed_.load(std::memory_order_acquire) != 0) return 0;
   dispatcher_->RegisterEpollOut(fd_, id_);
   int rc = butex_wait(epollout_butex_, expected, timeout_us);
   dispatcher_->UnregisterEpollOut(fd_, id_);
@@ -490,7 +498,8 @@ int Socket::WaitEpollOut(int64_t timeout_us) {
 }
 
 int Socket::Connect(const EndPoint& remote, const Options& opts,
-                    SocketId* id_out, int64_t timeout_us) {
+                    SocketId* id_out, int64_t timeout_us,
+                    const std::function<void(SocketId)>& on_created) {
   const int family = remote.is_unix() ? AF_UNIX : AF_INET;
   int fd = ::socket(family, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return errno;
@@ -521,6 +530,7 @@ int Socket::Connect(const EndPoint& remote, const Options& opts,
   o.fd = fd;
   o.remote = remote;
   if (Socket::Create(o, id_out) != 0) return ECONNREFUSED;
+  if (on_created) on_created(*id_out);
   if (rc != 0) {
     // Wait for writability, then check SO_ERROR.
     SocketUniquePtr ptr;

@@ -11,8 +11,9 @@
 
 #include <atomic>
 #include <cstdarg>
-#include <mutex>
 #include <cstdint>
+#include <functional>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -107,9 +108,14 @@ class Socket {
   static int Create(const Options& opts, SocketId* id);
 
   // Non-blocking connect + dispatcher registration; parks the calling fiber
-  // until connected or timeout. Returns 0 on success.
+  // until connected or timeout. Returns 0 on success. `on_created` (may be
+  // null) fires with the socket id right after Create, BEFORE the connect
+  // wait — a canceller can SetFailed the id to abort the park (SetFailed
+  // wakes the epollout butex the waiter parks on).
   static int Connect(const EndPoint& remote, const Options& opts,
-                     SocketId* id, int64_t timeout_us = 1000000);
+                     SocketId* id, int64_t timeout_us = 1000000,
+                     const std::function<void(SocketId)>& on_created =
+                         nullptr);
 
   // Live reference for id (nullptr-safe failure): EINVAL on stale id.
   static int Address(SocketId id, SocketUniquePtr* out);
