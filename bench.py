@@ -32,8 +32,8 @@ def ensure_built() -> str:
         # would silently miss sources added since it was generated.
         subprocess.run(["cmake", "."], cwd=BUILD, check=True,
                        capture_output=True)
-    subprocess.run(["ninja", "echo_bench"], cwd=BUILD, check=True,
-                   capture_output=True)
+    subprocess.run(["ninja", "echo_bench", "fiber_pingpong"], cwd=BUILD,
+                   check=True, capture_output=True)
     return bench
 
 
@@ -163,6 +163,17 @@ def main() -> int:
             if stats["qps"] > small_best["qps"]:
                 small_best = stats
 
+        # Fiber ping-pong: the park/wake context-switch floor underneath
+        # every sync RPC (ref test/bthread_ping_pong_unittest.cpp).
+        try:
+            pp = subprocess.run(
+                [os.path.join(BUILD, "fiber_pingpong"), "200000"],
+                check=True, capture_output=True, text=True, timeout=120,
+            ).stdout
+            pingpong = json.loads(pp.strip().splitlines()[-1])
+        except Exception as e:  # noqa: BLE001
+            pingpong = {"error": f"{type(e).__name__}: {e}"[:200]}
+
         # TLS row: the winning shape, encrypted, over TCP — paired with a
         # plaintext TCP run of the SAME shape so the delta is the crypto
         # tax alone (the sweep winner may have been uds).
@@ -201,6 +212,7 @@ def main() -> int:
             "small_config": {k: small_best[k] for k in
                              ("payload", "connections", "depth", "uds")},
             "small_scaling": scaling,
+            "fiber_pingpong": pingpong,
             "tls": tls_stats,
             **device_blocks,
         }))

@@ -1,0 +1,99 @@
+// Fiber ping-pong microbench: two fibers alternately wake each other
+// through butex waits — the context-switch + park/wake floor underneath
+// every sync RPC (reference test/bthread_ping_pong_unittest.cpp measures
+// the same primitive). Prints one JSON line {"switches_per_s": N}.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "base/time.h"
+#include "fiber/butex.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+
+using namespace brt;
+
+namespace {
+
+// Minimal counting semaphore on the raw butex — the same park/wake
+// primitive the RPC response wait rides, with no mutex on top.
+class Sema {
+ public:
+  Sema() : b_(butex_create()) {
+    butex_value(b_).store(0, std::memory_order_relaxed);
+  }
+  ~Sema() { butex_destroy(b_); }
+  void post() {
+    butex_value(b_).fetch_add(1, std::memory_order_release);
+    butex_wake(b_);
+  }
+  void wait() {
+    for (;;) {
+      int v = butex_value(b_).load(std::memory_order_acquire);
+      if (v > 0 && butex_value(b_).compare_exchange_weak(
+                       v, v - 1, std::memory_order_acq_rel)) {
+        return;
+      }
+      if (v <= 0) butex_wait(b_, v);
+    }
+  }
+
+ private:
+  Butex* b_;
+};
+
+struct Court {
+  Sema ping;
+  Sema pong;
+  long rallies = 0;
+};
+
+void* Pinger(void* arg) {
+  auto* c = static_cast<Court*>(arg);
+  for (long i = 0; i < c->rallies; ++i) {
+    c->ping.post();
+    c->pong.wait();
+  }
+  return nullptr;
+}
+
+void* Ponger(void* arg) {
+  auto* c = static_cast<Court*>(arg);
+  for (long i = 0; i < c->rallies; ++i) {
+    c->ping.wait();
+    c->pong.post();
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  long rallies = 200000;
+  if (argc > 1) rallies = atol(argv[1]);
+  fiber_init(0);
+  Court c;
+  c.rallies = rallies;
+  // Warm-up (stacks allocated, workers spun up).
+  {
+    Court w;
+    w.rallies = 1000;
+    fiber_t a, b;
+    fiber_start(&a, Pinger, &w);
+    fiber_start(&b, Ponger, &w);
+    fiber_join(a);
+    fiber_join(b);
+  }
+  const int64_t t0 = monotonic_us();
+  fiber_t a, b;
+  fiber_start(&a, Pinger, &c);
+  fiber_start(&b, Ponger, &c);
+  fiber_join(a);
+  fiber_join(b);
+  const double dt = double(monotonic_us() - t0) / 1e6;
+  // Each rally = 2 park/wake pairs = 2 "switches" in the reference's
+  // counting.
+  printf("{\"switches_per_s\": %.0f, \"rallies\": %ld, \"seconds\": %.3f}\n",
+         2.0 * rallies / dt, rallies, dt);
+  return 0;
+}

@@ -87,6 +87,7 @@ int ClusterChannel::InitWithLb(const std::string& lb_name,
   lb_ = CreateLoadBalancer(lb_name);
   if (!lb_) return EINVAL;
   RegisterBrtProtocol();
+  if (ResolveProtocol() != 0) return EINVAL;
   if (InitTls() != 0) return EINVAL;
   inited_ = true;
   return 0;
@@ -169,10 +170,10 @@ int ClusterChannel::IssueRPC(Controller* cntl) {
   }
 
   SocketUniquePtr sock;
-  rc = GetOrNewSocket(out.node.ep, options_.connection_type, &sock,
+  rc = GetOrNewSocket(out.node.ep, eff_conn_type_, &sock,
                       options_.connect_timeout_us,
                       options_.connection_group, tls_ctx_.get(),
-                      options_.ssl_sni);
+                      options_.ssl_sni, proto_);
   if (rc != 0) {
     // Connect failure counts against the node, then the caller's retry
     // loop re-enters and excludes it.
@@ -182,24 +183,8 @@ int ClusterChannel::IssueRPC(Controller* cntl) {
                     out.node.ep.to_string().c_str());
     return rc;
   }
-  if (c.last_socket != INVALID_SOCKET_ID && c.last_socket != sock->id()) {
-    SocketUniquePtr prev;
-    if (Socket::Address(c.last_socket, &prev) == 0) {
-      prev->RemoveWaiter(c.cid);
-    }
-  }
-  cntl->set_remote_side(out.node.ep);
   c.attempt_pending = true;
-  c.last_socket = sock->id();
-  c.conn_type = int(options_.connection_type);
-  c.conn_group = options_.connection_group;
-  c.conn_tls = tls_ctx_.get();
-  sock->AddWaiter(c.cid);
-  IOBuf frame;
-  IOBuf body = c.request_body;
-  PackFrame(&frame, c.request_meta, std::move(body));
-  sock->Write(&frame, c.cid);
-  return 0;
+  return SendAttempt(cntl, sock, out.node.ep);
 }
 
 }  // namespace brt

@@ -2,6 +2,7 @@
 
 #include "base/logging.h"
 #include "base/time.h"
+#include "rpc/client_protocol.h"
 #include "rpc/compress.h"
 #include "rpc/protocol_brt.h"
 #include "rpc/span.h"
@@ -43,10 +44,35 @@ int Channel::InitTls() {
   return 0;
 }
 
+int Channel::ResolveProtocol() {
+  RegisterBuiltinClientProtocols();
+  eff_conn_type_ = options_.connection_type;
+  if (options_.protocol.empty() || options_.protocol == "brt_std") {
+    proto_ = nullptr;
+    return 0;
+  }
+  proto_ = FindClientProtocol(options_.protocol);
+  if (proto_ == nullptr) {
+    BRT_LOG(ERROR) << "unknown client protocol '" << options_.protocol
+                   << "'";
+    return EINVAL;
+  }
+  // Without a pipelining guarantee a shared multiplexed connection would
+  // interleave concurrent callers' requests; exclusive POOLED connections
+  // keep the one-in-flight-per-connection invariant (reference forbids
+  // SINGLE for such protocols, adaptive_connection_type).
+  if (!proto_->pipelined_safe &&
+      eff_conn_type_ == ConnectionType::SINGLE) {
+    eff_conn_type_ = ConnectionType::POOLED;
+  }
+  return 0;
+}
+
 int Channel::Init(const EndPoint& server, const ChannelOptions* opts) {
   if (opts) options_ = *opts;
   server_ = server;
   RegisterBrtProtocol();
+  if (ResolveProtocol() != 0) return EINVAL;
   if (InitTls() != 0) return EINVAL;
   inited_ = true;
   return 0;
@@ -104,7 +130,9 @@ void Channel::CallMethod(const std::string& service, const std::string& method,
       options_.auth->GenerateCredential(&c.request_meta.auth) != 0;
   c.request_body = request;  // shares blocks — no copy
   c.request_body.append(cntl->request_attachment());
-  if (cntl->request_compress_type != 0) {
+  // Meta-signaled compression is a brt_std feature; foreign protocols
+  // carry their own content encodings (http veneers set headers).
+  if (cntl->request_compress_type != 0 && proto_ == nullptr) {
     const CompressHandler* h =
         GetCompressHandler(cntl->request_compress_type);
     IOBuf packed;
@@ -155,34 +183,47 @@ void Channel::CallMethod(const std::string& service, const std::string& method,
   if (sync) fid_join(cid);
 }
 
-int Channel::IssueRPC(Controller* cntl) {
+int Channel::SendAttempt(Controller* cntl, SocketUniquePtr& sock,
+                         const EndPoint& ep) {
   Controller::Call& c = cntl->call;
-  SocketUniquePtr sock;
-  const int rc = GetOrNewSocket(server_, options_.connection_type, &sock,
-                                options_.connect_timeout_us,
-                                options_.connection_group, tls_ctx_.get(),
-                                options_.ssl_sni);
-  if (rc != 0) {
-    cntl->SetFailed(rc == ETIMEDOUT ? ECONNREFUSED : rc,
-                    "fail to connect %s", server_.to_string().c_str());
-    return rc ? rc : ECONNREFUSED;
-  }
-  // A retry attempt abandons the previous socket's response wait.
+  // A retry attempt abandons the previous socket's response wait. On
+  // exclusive (POOLED/SHORT) connections the superseded socket must also
+  // be disposed of at EndRPC — it is not in the pool and nothing else
+  // references it — but NOT yet: a backup request's primary may still
+  // answer on it and win the hedge race.
   if (c.last_socket != INVALID_SOCKET_ID && c.last_socket != sock->id()) {
     SocketUniquePtr prev;
     if (Socket::Address(c.last_socket, &prev) == 0) {
       prev->RemoveWaiter(c.cid);
     }
+    if (eff_conn_type_ != ConnectionType::SINGLE) {
+      c.superseded.push_back(c.last_socket);
+    }
   }
-  cntl->set_remote_side(server_);
+  cntl->set_remote_side(ep);
   c.last_socket = sock->id();
-  c.conn_type = int(options_.connection_type);
+  c.reply_consumed = false;  // refers to THIS attempt's socket
+  c.conn_type = int(eff_conn_type_);
   c.conn_group = options_.connection_group;
   c.conn_tls = tls_ctx_.get();
+  c.conn_proto = proto_;
   // Register for failure notification BEFORE the bytes leave: a socket that
   // dies after a successful Write must still error this call.
   sock->AddWaiter(c.cid);
   IOBuf frame;
+  if (proto_ != nullptr) {
+    uint64_t cut_hint = 0;
+    const int prc =
+        proto_->pack(&frame, cntl, c.request_meta, c.request_body,
+                     &cut_hint);
+    if (prc != 0) {
+      cntl->SetFailed(prc, "cannot pack %s request", proto_->name);
+      return prc;
+    }
+    // Queue position and wire position must match atomically (FIFO reply
+    // matching); a write failure surfaces through fid_error(cid).
+    return FifoCallEnqueue(sock.get(), c.cid, &frame, cut_hint);
+  }
   IOBuf body = c.request_body;  // keep the original for retries
   PackFrame(&frame, c.request_meta, std::move(body));
   // A write failure surfaces through fid_error(cid) (Socket::Write
@@ -190,6 +231,20 @@ int Channel::IssueRPC(Controller* cntl) {
   // so the funnel stays single-entry.
   sock->Write(&frame, c.cid);
   return 0;
+}
+
+int Channel::IssueRPC(Controller* cntl) {
+  SocketUniquePtr sock;
+  const int rc = GetOrNewSocket(server_, eff_conn_type_, &sock,
+                                options_.connect_timeout_us,
+                                options_.connection_group, tls_ctx_.get(),
+                                options_.ssl_sni, proto_);
+  if (rc != 0) {
+    cntl->SetFailed(rc == ETIMEDOUT ? ECONNREFUSED : rc,
+                    "fail to connect %s", server_.to_string().c_str());
+    return rc ? rc : ECONNREFUSED;
+  }
+  return SendAttempt(cntl, sock, server_);
 }
 
 }  // namespace brt

@@ -20,6 +20,13 @@ struct ChannelOptions {
   int max_retry = 3;                 // reference default (channel.h:115)
   int64_t backup_request_ms = -1;    // <0: disabled
   int64_t connect_timeout_us = 200 * 1000;
+  // Wire protocol this channel speaks (reference ChannelOptions.protocol,
+  // channel.h:79): "brt_std" (default; correlation-id multiplexed), or a
+  // registered client protocol — "http", "redis", "thrift", "memcache",
+  // "mongo". NS/LB/circuit-breaker/retry/backup apply uniformly to all.
+  // Protocols without pipelining guarantees silently upgrade SINGLE
+  // connections to POOLED.
+  std::string protocol = "brt_std";
   ConnectionType connection_type = ConnectionType::SINGLE;
   // SINGLE connections are shared per (endpoint, connection_group): distinct
   // groups get private multiplexed connections (the reference's
@@ -88,10 +95,24 @@ class Channel : public ChannelBase, public CallIssuer {
   // and ClusterChannel inits). Returns 0 or EINVAL.
   int InitTls();
 
+  // Resolves options_.protocol against the client-protocol registry and
+  // computes the effective connection type (shared by Channel and
+  // ClusterChannel inits). Returns 0 or EINVAL for unknown protocols.
+  int ResolveProtocol();
+
+  // One attempt's tail, shared by Channel and ClusterChannel: waiter
+  // bookkeeping, pack (brt frame or foreign protocol), write/FIFO-enqueue.
+  // Called with the correlation id locked and `sock` live.
+  int SendAttempt(Controller* cntl, SocketUniquePtr& sock,
+                  const EndPoint& ep);
+
   ChannelOptions options_;
   EndPoint server_;
   bool inited_ = false;
   std::shared_ptr<class TlsContext> tls_ctx_;  // null for plaintext
+  // Null for brt_std (the InputMessenger multiplexing path).
+  const struct ClientProtocol* proto_ = nullptr;
+  ConnectionType eff_conn_type_ = ConnectionType::SINGLE;
 };
 
 }  // namespace brt

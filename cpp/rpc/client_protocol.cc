@@ -1,0 +1,422 @@
+#include "rpc/client_protocol.h"
+
+#include <arpa/inet.h>
+
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+
+#include "base/logging.h"
+#include "fiber/fiber.h"
+#include "rpc/controller.h"
+#include "rpc/errors.h"
+#include "rpc/redis.h"
+#include "transport/socket.h"
+
+namespace brt {
+
+// ---------------------------------------------------------------------------
+// Registry
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Leaked: protocol lookups happen from detached read fibers up to exit.
+auto& g_reg_mu = *new std::mutex();
+auto& g_registry =
+    *new std::unordered_map<std::string, const ClientProtocol*>();
+
+}  // namespace
+
+bool RegisterClientProtocol(const ClientProtocol* p) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  auto [it, inserted] = g_registry.emplace(p->name, p);
+  return inserted || it->second == p;
+}
+
+const ClientProtocol* FindClientProtocol(const std::string& name) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  auto it = g_registry.find(name);
+  return it == g_registry.end() ? nullptr : it->second;
+}
+
+// ---------------------------------------------------------------------------
+// FIFO reply matcher: the shared client-side read loop for request/reply
+// protocols. Wire order == queue order; a reply whose waiter already died
+// (timeout, cancel, backup-winner) is consumed and dropped, which KEEPS
+// the alignment — every written request has exactly one queue entry.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct FifoWaiter {
+  fid_t cid;
+  uint64_t hint;
+};
+
+struct FifoCore {
+  const ClientProtocol* proto;
+  std::mutex mu;
+  IOPortal inbuf;
+  std::deque<FifoWaiter> waiters;
+  void* parser = nullptr;
+
+  explicit FifoCore(const ClientProtocol* p) : proto(p) {
+    if (p->new_parser != nullptr) parser = p->new_parser();
+  }
+  ~FifoCore() {
+    if (parser != nullptr) proto->free_parser(parser);
+  }
+};
+
+// Hands one cut reply to its waiter (or drops it if the call already
+// ended). Runs OUTSIDE core->mu: OnForeignReply → EndRPC may call back
+// into socket/pool layers. This runs on the READ fiber, so a user done
+// closure is re-dispatched to a fresh fiber first — blocking user code
+// must not stall the connection's read loop (same contract as the brt
+// path, where responses process off the read fiber).
+void ResolveReply(fid_t cid, ClientReply&& reply) {
+  void* data = nullptr;
+  if (fid_lock(cid, &data) != 0) return;  // late reply: dropped
+  auto* cntl = static_cast<Controller*>(data);
+  if (cntl->call.done) {
+    struct Ctx {
+      Closure done;
+    };
+    auto* ctx = new Ctx{std::move(cntl->call.done)};
+    cntl->call.done = [ctx] {
+      fiber_t fid;
+      if (fiber_start(&fid, [](void* p) -> void* {
+            auto* x = static_cast<Ctx*>(p);
+            x->done();
+            delete x;
+            return nullptr;
+          }, ctx) != 0) {
+        // Fiber exhaustion: run inline rather than dropping the user's
+        // continuation (same fallback as the transport's deferred path).
+        ctx->done();
+        delete ctx;
+      }
+    };
+  }
+  cntl->OnForeignReply(std::move(reply));
+}
+
+}  // namespace
+
+void* NewFifoCore(const ClientProtocol* proto) {
+  return new FifoCore(proto);
+}
+
+void FreeFifoCore(void* core) { delete static_cast<FifoCore*>(core); }
+
+int FifoCallEnqueue(Socket* s, fid_t cid, IOBuf* frame, uint64_t cut_hint) {
+  auto* core = static_cast<FifoCore*>(s->parsing_context());
+  if (core == nullptr) return EINVAL;
+  // Enqueue order must equal wire order: with concurrent callers a reply
+  // would otherwise resolve the wrong FIFO waiter.
+  std::lock_guard<std::mutex> g(core->mu);
+  core->waiters.push_back({cid, cut_hint});
+  s->Write(frame, cid);
+  return 0;
+}
+
+void* FifoClientOnData(Socket* s) {
+  auto* core = static_cast<FifoCore*>(s->parsing_context());
+  bool eof = false;
+  for (;;) {
+    ssize_t nr = s->AppendFromFd(&core->inbuf);
+    if (nr == 0) {
+      // Finish cutting what's buffered before declaring the connection
+      // dead: the final reply often arrives in the same event as EOF.
+      eof = true;
+      break;
+    }
+    if (nr < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      s->SetFailed(errno, "read failed");
+      return nullptr;
+    }
+  }
+  for (;;) {
+    ClientReply reply;
+    fid_t cid = 0;
+    int rc;
+    {
+      std::lock_guard<std::mutex> g(core->mu);
+      if (core->waiters.empty()) {
+        // Bytes with no outstanding request are a protocol violation
+        // (timed-out calls keep their queue entry, so every legitimate
+        // reply has one).
+        rc = core->inbuf.empty() ? EAGAIN : EBADMSG;
+      } else {
+        rc = core->proto->cut(&core->inbuf, core->parser,
+                              core->waiters.front().hint, &reply);
+        if (rc == EAGAIN && eof && core->proto->on_eof != nullptr) {
+          // Close-delimited reply (http body ended by connection close).
+          rc = core->proto->on_eof(&core->inbuf, core->parser,
+                                   core->waiters.front().hint, &reply);
+          if (rc != 0) rc = EAGAIN;  // nothing deliverable at EOF
+        }
+        if (rc == 0) {
+          cid = core->waiters.front().cid;
+          core->waiters.pop_front();
+        }
+      }
+    }
+    if (rc == EAGAIN) break;
+    if (rc != 0) {
+      // Desync: the cursor cannot be trusted for any later reply.
+      s->SetFailed(rc, "client reply desynchronized");
+      return nullptr;
+    }
+    ResolveReply(cid, std::move(reply));
+  }
+  if (eof) {
+    s->SetFailed(ECONNRESET, "server closed");
+  }
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// Built-in protocols
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// ---- http/1.1 (keep-alive; reference policy/http_rpc_protocol.cpp
+// client half: non-2xx maps to EHTTP, headers ride the controller) ----
+
+constexpr uint64_t kHintNoBody = 1;  // HEAD: headers only, no body bytes
+
+int HttpPack(IOBuf* out, Controller* cntl, const RpcMeta& meta,
+             const IOBuf& body, uint64_t* cut_hint) {
+  HttpMessage req = *cntl->http_request();  // copy: retries re-pack
+  if (req.method.empty()) req.method = body.empty() ? "GET" : "POST";
+  if (req.path.empty()) {
+    req.path = meta.service.empty()
+                   ? "/"
+                   : "/" + meta.service +
+                         (meta.method.empty() ? "" : "/" + meta.method);
+  }
+  if (req.header("host") == nullptr) {
+    req.set_header("Host", cntl->remote_side().to_string());
+  }
+  req.set_header("Content-Length", std::to_string(body.size()));
+  if (req.method == "HEAD") *cut_hint = kHintNoBody;
+  SerializeHttpHead(req, /*is_request=*/true, out);
+  out->append(body);
+  return 0;
+}
+
+void* HttpNewParser() { return new HttpParser(/*is_request=*/false); }
+void HttpFreeParser(void* p) { delete static_cast<HttpParser*>(p); }
+
+int HttpFinish(HttpParser* hp, ClientReply* out) {
+  out->http = hp->steal();
+  hp->Reset();
+  hp->set_no_body_expected(false);
+  out->has_http = true;
+  out->body = std::move(out->http.body);
+  if (out->http.status < 200 || out->http.status >= 300) {
+    out->error_code = EHTTP;
+    out->error_text =
+        "http status " + std::to_string(out->http.status) +
+        (out->http.reason.empty() ? "" : " " + out->http.reason);
+  }
+  return 0;
+}
+
+int HttpCut(IOPortal* in, void* parser, uint64_t hint, ClientReply* out) {
+  auto* hp = static_cast<HttpParser*>(parser);
+  // HEAD responses carry Content-Length but no body bytes (RFC 9110
+  // §9.3.2); without this the parser would wait for a body forever.
+  hp->set_no_body_expected(hint == kHintNoBody);
+  switch (hp->Consume(in)) {
+    case HttpParser::NEED_MORE:
+      return EAGAIN;
+    case HttpParser::ERROR:
+      return EBADMSG;
+    case HttpParser::DONE:
+      break;
+  }
+  return HttpFinish(hp, out);
+}
+
+int HttpOnEof(IOPortal*, void* parser, uint64_t, ClientReply* out) {
+  // Close-delimited body (no Content-Length, not chunked): EOF is the
+  // message terminator.
+  auto* hp = static_cast<HttpParser*>(parser);
+  if (hp->OnEof() != HttpParser::DONE) return ECONNRESET;
+  return HttpFinish(hp, out);
+}
+
+// ---- redis (RESP; veneers pre-encode commands and parse replies —
+// RESP errors are application-level data, not RPC failures) ----
+
+int PassthroughPack(IOBuf* out, Controller*, const RpcMeta&,
+                    const IOBuf& body, uint64_t*) {
+  *out = body;  // shares blocks
+  return 0;
+}
+
+// Measures one complete RESP value: its total byte length, 0 if the
+// buffer is incomplete, SIZE_MAX if malformed. Touches only type/length
+// header lines — a half-arrived 64MB bulk string costs O(1) per read
+// event here, where a parse attempt would flatten and rescan the whole
+// buffered prefix every event (O(n²) across the transfer).
+size_t MeasureResp(const IOBuf& b) {
+  size_t pos = 0;
+  long pending = 1;  // values still to account for
+  while (pending > 0) {
+    char t;
+    if (b.copy_to(&t, 1, pos) < 1) return 0;
+    // Take the header line (to CRLF) in small chunks.
+    std::string line;
+    size_t i = pos + 1;
+    for (;;) {
+      char chunk[64];
+      const size_t n = b.copy_to(chunk, sizeof(chunk), i);
+      if (n == 0) return 0;
+      const void* nl = memchr(chunk, '\n', n);
+      if (nl != nullptr) {
+        const size_t k = size_t(static_cast<const char*>(nl) - chunk);
+        line.append(chunk, k);
+        i += k + 1;
+        break;
+      }
+      line.append(chunk, n);
+      i += n;
+      if (line.size() > 64) return SIZE_MAX;  // headers are short
+    }
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    pos = i;
+    switch (t) {
+      case '+':
+      case '-':
+      case ':':
+        --pending;
+        break;
+      case '$': {
+        const long len = atol(line.c_str());
+        if (len < -1 || len > (64l << 20)) return SIZE_MAX;
+        if (len >= 0) {
+          if (b.size() < pos + size_t(len) + 2) return 0;
+          pos += size_t(len) + 2;
+        }
+        --pending;
+        break;
+      }
+      case '*': {
+        const long n = atol(line.c_str());
+        if (n < -1 || n > (1l << 20)) return SIZE_MAX;
+        --pending;
+        if (n > 0) pending += n;
+        break;
+      }
+      default:
+        return SIZE_MAX;
+    }
+  }
+  return pos;
+}
+
+int RedisCut(IOPortal* in, void*, uint64_t, ClientReply* out) {
+  // RESP frames carry no length prefix: measure first (cheap, header
+  // lines only), and only when one whole reply is buffered parse it —
+  // once — on a block-sharing probe, keeping the tree for the veneer and
+  // the raw bytes for callers that want wire fidelity.
+  const size_t need = MeasureResp(*in);
+  if (need == 0) return EAGAIN;
+  if (need == SIZE_MAX) return EBADMSG;
+  IOBuf probe = *in;
+  auto parsed = std::make_shared<RedisReply>();
+  const int rc = parsed->ParseFrom(&probe);
+  if (rc != 0) return rc == EAGAIN ? EBADMSG : rc;  // measured ≠ parsed
+  in->cutn(&out->body, in->size() - probe.size());
+  out->redis = std::move(parsed);
+  return 0;
+}
+
+// ---- thrift framed TBinary ([len:4][0x80 0x01 ...]) ----
+
+int ThriftCut(IOPortal* in, void*, uint64_t, ClientReply* out) {
+  if (in->size() < 8) return EAGAIN;
+  uint8_t hdr[8];
+  in->copy_to(hdr, 8);
+  const uint32_t len = (uint32_t(hdr[0]) << 24) | (uint32_t(hdr[1]) << 16) |
+                       (uint32_t(hdr[2]) << 8) | hdr[3];
+  if (hdr[4] != 0x80 || hdr[5] != 0x01 || len < 4 || len > (64u << 20)) {
+    return EBADMSG;
+  }
+  if (in->size() < 4 + size_t(len)) return EAGAIN;
+  in->cutn(&out->body, 4 + size_t(len));  // frame kept whole for the veneer
+  return 0;
+}
+
+// ---- memcache binary (24-byte header, magic 0x81 responses) ----
+
+int MemcacheCut(IOPortal* in, void*, uint64_t, ClientReply* out) {
+  if (in->size() < 24) return EAGAIN;
+  uint8_t hdr[24];
+  in->copy_to(hdr, 24);
+  if (hdr[0] != 0x81) return EBADMSG;
+  uint32_t body_len;
+  memcpy(&body_len, hdr + 8, 4);
+  body_len = ntohl(body_len);
+  if (body_len > (64u << 20)) return EBADMSG;
+  if (in->size() < 24 + size_t(body_len)) return EAGAIN;
+  in->cutn(&out->body, 24 + size_t(body_len));
+  return 0;
+}
+
+// ---- mongo OP_MSG (little-endian length-prefixed) ----
+
+int MongoCut(IOPortal* in, void*, uint64_t, ClientReply* out) {
+  if (in->size() < 16) return EAGAIN;
+  int32_t h[4];
+  in->copy_to(h, 16);
+  if (h[3] != 2013 /*OP_MSG*/ || h[0] < 21 || uint32_t(h[0]) > (48u << 20)) {
+    return EBADMSG;
+  }
+  if (in->size() < size_t(h[0])) return EAGAIN;
+  in->cutn(&out->body, size_t(h[0]));
+  return 0;
+}
+
+const ClientProtocol kHttpClient = {
+    "http", /*pipelined_safe=*/false, HttpPack, HttpCut, HttpOnEof,
+    HttpNewParser, HttpFreeParser,
+};
+const ClientProtocol kRedisClient = {
+    "redis", /*pipelined_safe=*/true, PassthroughPack, RedisCut, nullptr,
+    nullptr, nullptr,
+};
+const ClientProtocol kThriftClient = {
+    "thrift", /*pipelined_safe=*/false, PassthroughPack, ThriftCut, nullptr,
+    nullptr, nullptr,
+};
+const ClientProtocol kMemcacheClient = {
+    "memcache", /*pipelined_safe=*/true, PassthroughPack, MemcacheCut,
+    nullptr, nullptr, nullptr,
+};
+const ClientProtocol kMongoClient = {
+    "mongo", /*pipelined_safe=*/false, PassthroughPack, MongoCut, nullptr,
+    nullptr, nullptr,
+};
+
+}  // namespace
+
+void RegisterBuiltinClientProtocols() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    RegisterClientProtocol(&kHttpClient);
+    RegisterClientProtocol(&kRedisClient);
+    RegisterClientProtocol(&kThriftClient);
+    RegisterClientProtocol(&kMemcacheClient);
+    RegisterClientProtocol(&kMongoClient);
+  });
+}
+
+}  // namespace brt

@@ -1,0 +1,107 @@
+// Client-side protocol registry: one Channel speaks any registered wire
+// protocol, selected by ChannelOptions.protocol, with naming service /
+// load balancing / circuit breaking / retry / backup applying uniformly.
+// Parity target: reference src/brpc/channel.h:41-149 (ChannelOptions.
+// protocol) + global.cpp:409-589 (protocol registration); the reference
+// routes every client protocol through Protocol::pack_request +
+// process_response — here brt_std keeps its correlation-id multiplexing
+// through the InputMessenger, and foreign request/reply protocols (http,
+// redis, thrift, memcache, mongo) share one FIFO reply matcher riding the
+// socket's parsing context (wire order == completion order, the invariant
+// redis/memcache/http pipelining guarantees).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include <memory>
+
+#include "base/iobuf.h"
+#include "fiber/fiber_id.h"
+#include "rpc/brt_meta.h"
+#include "rpc/http_message.h"
+
+namespace brt {
+
+class Controller;
+class Socket;
+struct RedisReply;
+
+// One complete reply cut off the wire, already split into transport
+// verdict + payload. `body` lands in the caller's response IOBuf; http
+// additionally carries status + headers into cntl->http_response();
+// protocols that must fully parse to find the frame boundary (redis)
+// also hand over the parsed form so veneers don't parse twice.
+struct ClientReply {
+  IOBuf body;
+  int error_code = 0;        // nonzero: RPC-level failure (EHTTP, ...)
+  std::string error_text;
+  HttpMessage http;          // valid when has_http
+  bool has_http = false;
+  std::shared_ptr<RedisReply> redis;  // redis protocol: parsed once in cut
+};
+
+struct ClientProtocol {
+  const char* name = "";
+
+  // Multiple in-flight calls may share one connection (strictly ordered
+  // request/reply wire contract: redis, memcache). When false, SINGLE
+  // connections are silently upgraded to POOLED — one in-flight call per
+  // exclusive connection (http/1 without pipelining guarantees, thrift,
+  // mongo).
+  bool pipelined_safe = false;
+
+  // Serializes one attempt. `meta` carries service/method/timeout;
+  // protocols use what their wire has room for (http reads
+  // cntl->http_request(), byte-oriented protocols pass `body` through —
+  // their veneers pre-encode it). `cut_hint` rides the reply queue to this
+  // request's cut call (http: "HEAD — expect no body"). Returns 0 or
+  // errno.
+  int (*pack)(IOBuf* out, Controller* cntl, const RpcMeta& meta,
+              const IOBuf& body, uint64_t* cut_hint) = nullptr;
+
+  // Cuts ONE complete reply. `parser` is this connection's state from
+  // new_parser (null when the protocol needs none); `hint` is the front
+  // waiter's cut_hint. Returns 0 (reply filled), EAGAIN (need more
+  // bytes), or errno (desync: the connection is failed and every waiter
+  // drains).
+  int (*cut)(IOPortal* in, void* parser, uint64_t hint,
+             ClientReply* out) = nullptr;
+
+  // Optional: peer EOF with bytes buffered — a close-delimited http body
+  // completes here. Return 0 with *out filled to deliver one final reply,
+  // nonzero otherwise. Null = EOF never completes a reply.
+  int (*on_eof)(IOPortal* in, void* parser, uint64_t hint,
+                ClientReply* out) = nullptr;
+
+  // Optional per-connection parser state (http's incremental parser).
+  void* (*new_parser)() = nullptr;
+  void (*free_parser)(void*) = nullptr;
+};
+
+// Registration is idempotent by name; lookups are lock-free after init.
+// Returns false if the name is already taken by a DIFFERENT descriptor.
+bool RegisterClientProtocol(const ClientProtocol* p);
+
+// nullptr for unknown names. "brt_std" is intentionally NOT here — the
+// default protocol multiplexes by correlation id through InputMessenger
+// (Channel treats a null protocol as brt_std).
+const ClientProtocol* FindClientProtocol(const std::string& name);
+
+// Registers the built-in client protocols (http, redis, thrift, memcache,
+// mongo). Called by Channel::Init; safe to call repeatedly.
+void RegisterBuiltinClientProtocols();
+
+// ---- FIFO reply matcher (socket plumbing; used by socket_map/Channel) ----
+
+// Socket::Options hooks for a FIFO client connection.
+void* FifoClientOnData(Socket* s);
+void* NewFifoCore(const ClientProtocol* proto);
+void FreeFifoCore(void* core);
+
+// Appends `cid` to the connection's reply queue and writes `frame`, under
+// one lock so queue order equals wire order even with concurrent callers.
+// The frame's write failure surfaces through fid_error(cid).
+int FifoCallEnqueue(Socket* s, fid_t cid, IOBuf* frame, uint64_t cut_hint);
+
+}  // namespace brt

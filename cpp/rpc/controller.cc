@@ -5,7 +5,9 @@
 #include <cstring>
 
 #include "base/time.h"
+#include "rpc/client_protocol.h"
 #include "rpc/compress.h"
+#include "rpc/http_message.h"
 #include "rpc/socket_map.h"
 
 namespace brt {
@@ -27,6 +29,7 @@ const char* RpcErrorText(int code) {
     case ECANCELEDRPC: return "rpc canceled";
     case EAUTH: return "authentication failed";
     case EREJECT: return "rejected by interceptor";
+    case EHTTP: return "non-2xx http response";
     default: return strerror(code);
   }
 }
@@ -34,6 +37,16 @@ const char* RpcErrorText(int code) {
 void (*g_stream_connect_hook)(Controller*) = nullptr;
 
 Controller::~Controller() = default;
+
+HttpMessage* Controller::http_request() {
+  if (!http_request_) http_request_ = std::make_unique<HttpMessage>();
+  return http_request_.get();
+}
+
+HttpMessage* Controller::http_response() {
+  if (!http_response_) http_response_ = std::make_unique<HttpMessage>();
+  return http_response_.get();
+}
 
 void Controller::SetFailed(int code, const char* fmt, ...) {
   error_code_ = code ? code : EINTERNAL;
@@ -51,6 +64,9 @@ void Controller::SetFailed(int code, const char* fmt, ...) {
 
 void Controller::Reset() {
   progressive_attachment.reset();
+  http_request_.reset();
+  http_response_.reset();
+  redis_reply.reset();
   error_code_ = 0;
   error_text_.clear();
   request_attachment_.clear();
@@ -133,6 +149,7 @@ int Controller::HandleError(fid_t id, void* data, int error_code) {
 
 void Controller::OnResponse(RpcMeta&& meta, IOBuf&& body) {
   Call& c = call;
+  c.reply_consumed = true;  // a whole frame arrived: connection aligned
   if (meta.error_code != 0) {
     // Server-reported failure: retryable codes re-issue like socket errors.
     const int64_t now = monotonic_us();
@@ -183,6 +200,25 @@ void Controller::OnResponse(RpcMeta&& meta, IOBuf&& body) {
   EndRPC();
 }
 
+void Controller::OnForeignReply(ClientReply&& reply) {
+  Call& c = call;
+  c.reply_consumed = true;  // a whole reply was cut: connection aligned
+  // Any error recorded by a failed earlier attempt is superseded.
+  error_code_ = 0;
+  error_text_.clear();
+  if (reply.has_http) *http_response() = std::move(reply.http);
+  redis_reply = std::move(reply.redis);
+  // Body is delivered even on EHTTP: a 404's payload is still the answer
+  // (reference http client keeps the body on failed status).
+  if (c.response) *c.response = std::move(reply.body);
+  if (reply.error_code != 0) {
+    error_code_ = reply.error_code;
+    error_text_ = !reply.error_text.empty() ? reply.error_text
+                                            : RpcErrorText(reply.error_code);
+  }
+  EndRPC();
+}
+
 void Controller::EndRPC() {
   Call& c = call;
   set_latency(monotonic_us() - c.start_us);
@@ -204,17 +240,39 @@ void Controller::EndRPC() {
     SocketUniquePtr p;
     if (Socket::Address(c.last_socket, &p) == 0) p->RemoveWaiter(id);
   }
+  // Exclusive sockets superseded by a later attempt (retry/backup): pool
+  // the healthy ones — a possibly in-flight late reply is safe because
+  // its FIFO queue entry (or brt correlation id) still consumes it for
+  // the next borrower — and close the rest.
+  for (SocketId sid : c.superseded) {
+    if (sid == c.last_socket) continue;
+    SocketUniquePtr p;
+    if (Socket::Address(sid, &p) != 0) continue;
+    p->RemoveWaiter(id);
+    if (ConnectionType(c.conn_type) == ConnectionType::POOLED &&
+        !p->Failed()) {
+      ReturnPooledSocket(p->remote(), sid, c.conn_group, c.conn_tls,
+                         c.conn_proto);
+    } else {
+      p->SetFailed(ECANCELED, "superseded attempt");
+    }
+  }
+  c.superseded.clear();
   // Exclusive connections: POOLED sockets go back to their group's freelist
-  // on success; errored POOLED sockets are closed (a late response may still
-  // be in flight on them) and SHORT sockets always close (reference
+  // when the connection is known aligned — success, OR a complete reply
+  // that merely carried an error (EHTTP 404, server-reported failure);
+  // closing those would defeat keep-alive on routine non-2xx statuses.
+  // POOLED sockets whose reply never arrived are closed (a late response
+  // may still be in flight) and SHORT sockets always close (reference
   // socket_map.h:147 / adaptive_connection_type.h:30-36).
   if (c.last_socket != INVALID_SOCKET_ID) {
     const ConnectionType ct = ConnectionType(c.conn_type);
-    if (ct == ConnectionType::POOLED && error_code_ == 0) {
+    const bool poolable = error_code_ == 0 || c.reply_consumed;
+    if (ct == ConnectionType::POOLED && poolable) {
       ReturnPooledSocket(remote_side_, c.last_socket, c.conn_group,
-                         c.conn_tls);
+                         c.conn_tls, c.conn_proto);
     } else if (ct == ConnectionType::SHORT ||
-               (ct == ConnectionType::POOLED && error_code_ != 0)) {
+               (ct == ConnectionType::POOLED && !poolable)) {
       SocketUniquePtr p;
       if (Socket::Address(c.last_socket, &p) == 0) {
         p->SetFailed(ECANCELED, "exclusive connection done");

@@ -18,12 +18,14 @@
 #include "fiber/timer.h"
 #include "rpc/brt_meta.h"
 #include "rpc/errors.h"
+#include "rpc/http_message.h"
 #include "rpc/span.h"
 #include "transport/socket.h"
 
 namespace brt {
 
 class Controller;
+struct ClientReply;   // rpc/client_protocol.h
 using Closure = std::function<void()>;
 
 // Set by stream.cc: invoked (with the correlation id locked) when a
@@ -105,6 +107,19 @@ class Controller {
   uint64_t span_id = 0;
   uint64_t parent_span_id = 0;
 
+  // ---- http-protocol calls (ChannelOptions.protocol = "http") ----
+  // Request line + headers out, status + headers back (reference
+  // Controller::http_request()/http_response(), controller.h:113).
+  // Lazily created; both survive Reset-less reuse of the controller.
+  HttpMessage* http_request();
+  HttpMessage* http_response();
+
+  // ---- redis-protocol calls (ChannelOptions.protocol = "redis") ----
+  // The reply parsed once by the wire cutter (finding a RESP frame
+  // boundary IS a parse); veneers consume this instead of re-parsing the
+  // raw bytes in the response IOBuf.
+  std::shared_ptr<struct RedisReply> redis_reply;
+
   // ================= internal (Channel / protocol / Server) =================
   struct Call {
     fid_t cid = 0;
@@ -120,8 +135,21 @@ class Controller {
     TimerId backup_timer = kInvalidTimerId;
     SocketId last_socket = INVALID_SOCKET_ID;
     int conn_type = 0;   // ConnectionType; POOLED sockets return on success
+    // True once a COMPLETE reply was cut off last_socket for this attempt
+    // — the connection is aligned even if the reply carried an error
+    // (EHTTP 404, server-reported failure), so a POOLED socket can go
+    // back to the freelist instead of being torn down. Reset per attempt.
+    bool reply_consumed = false;
     int conn_group = 0;  // SocketMap group the socket came from
     class TlsContext* conn_tls = nullptr;  // SocketMap TLS key part
+    // SocketMap protocol key part (null = brt_std/InputMessenger conns).
+    const struct ClientProtocol* conn_proto = nullptr;
+    // Exclusive (POOLED/SHORT) sockets of earlier attempts this call
+    // superseded (retry / backup request). Disposed of at EndRPC: pooled
+    // back when healthy (their FIFO queue entry keeps reply alignment for
+    // the next borrower), failed otherwise. Without this they would leak
+    // — they are not in any pool and nothing else references them.
+    std::vector<SocketId> superseded;
     // Cluster layer: endpoints already tried this call (reference
     // excluded_servers.h), and an end-of-call hook for LB feedback /
     // circuit breaker (reference LoadBalancer::Feedback +
@@ -143,6 +171,10 @@ class Controller {
 
   // Response arrival (id already locked by the caller).
   void OnResponse(RpcMeta&& meta, IOBuf&& body);
+
+  // Foreign-protocol reply arrival (FIFO matcher, client_protocol.cc;
+  // id already locked by the caller).
+  void OnForeignReply(ClientReply&& reply);
 
   // Finalizes: destroys the id, records latency, runs done / wakes joiner.
   // Id must be locked; consumed by this call.
@@ -172,6 +204,8 @@ class Controller {
   std::string error_text_;
   IOBuf request_attachment_;
   IOBuf response_attachment_;
+  std::unique_ptr<HttpMessage> http_request_;
+  std::unique_ptr<HttpMessage> http_response_;
   void* session_local_data_ = nullptr;
   EndPoint remote_side_;
   EndPoint local_side_;

@@ -20,6 +20,7 @@ enum RpcError {
   ECANCELEDRPC = 2005,   // StartCancel()ed by caller
   EAUTH = 1004,          // credential verification failed
   EREJECT = 2006,        // rejected by a server interceptor
+  EHTTP = 2007,          // non-2xx http response (reference errno EHTTP)
 };
 
 // Human-readable name for the codes above; falls back to strerror.

@@ -7,9 +7,10 @@
 #include "base/logging.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
+#include "rpc/channel.h"
+#include "rpc/controller.h"
 #include "rpc/server.h"
 #include "transport/input_messenger.h"
-#include "rpc/pipelined_client.h"
 #include "transport/socket.h"
 
 namespace brt {
@@ -269,16 +270,21 @@ void ServeRedisOn(Server* server, RedisService* service) {
 }
 
 // ---------------------------------------------------------------------------
-// Pipelined client (shared PipelinedClient scaffolding, FIFO matching)
+// Client: a veneer over the protocol-polymorphic Channel — the pipelined
+// FIFO reply matching lives in rpc/client_protocol.cc and is shared with
+// every other foreign-protocol client.
 // ---------------------------------------------------------------------------
 
-struct RedisClient::Impl
-    : PipelinedClient<RedisClient::Impl, RedisReply> {
-  using PipelinedClient::CallFrame;
-
-  static int CutReply(IOPortal* in, RedisReply* out) {
-    return out->ParseFrom(in);
+void SerializeRedisCommand(const std::vector<std::string>& args,
+                           IOBuf* out) {
+  out->append("*" + std::to_string(args.size()) + "\r\n");
+  for (const std::string& a : args) {
+    out->append("$" + std::to_string(a.size()) + "\r\n" + a + "\r\n");
   }
+}
+
+struct RedisClient::Impl {
+  Channel channel;
 };
 
 RedisClient::RedisClient() : impl_(new Impl) {}
@@ -292,19 +298,28 @@ int RedisClient::Init(const std::string& addr, int64_t timeout_ms) {
 }
 
 int RedisClient::Init(const EndPoint& server, int64_t timeout_ms) {
-  return impl_->Connect(server, timeout_ms);
+  ChannelOptions opts;
+  opts.protocol = "redis";
+  opts.timeout_ms = timeout_ms;
+  // Commands are not idempotent in general (INCR); surface failures to
+  // the caller instead of silently re-executing.
+  opts.max_retry = 0;
+  return impl_->channel.Init(server, &opts);
 }
 
 RedisReply RedisClient::Command(const std::vector<std::string>& args) {
   IOBuf cmd;
-  cmd.append("*" + std::to_string(args.size()) + "\r\n");
-  for (const std::string& a : args) {
-    cmd.append("$" + std::to_string(a.size()) + "\r\n" + a + "\r\n");
+  SerializeRedisCommand(args, &cmd);
+  Controller cntl;
+  IOBuf raw;
+  impl_->channel.CallMethod("", "", &cntl, cmd, &raw, nullptr);
+  if (cntl.Failed()) {
+    return RedisReply::Error(cntl.ErrorCode() == ERPCTIMEDOUT ? "timeout"
+                                                              : "io error");
   }
+  if (cntl.redis_reply) return std::move(*cntl.redis_reply);
   RedisReply reply;
-  const int rc = impl_->CallFrame(std::move(cmd), 0, &reply);
-  if (rc == ETIMEDOUT) return RedisReply::Error("timeout");
-  if (rc != 0) return RedisReply::Error("io error");
+  if (reply.ParseFrom(&raw) != 0) return RedisReply::Error("bad reply");
   return reply;
 }
 

@@ -63,8 +63,19 @@ class RedisService {
 class Server;
 void ServeRedisOn(Server* server, RedisService* service);
 
+// Encodes argv as one RESP command frame — the request body for
+// protocol="redis" channel calls (and the veneer client below).
+void SerializeRedisCommand(const std::vector<std::string>& args, IOBuf* out);
+
 // Pipelined client: commands are FIFO-matched to replies on one
-// connection (redis semantics). Thread/fiber-safe.
+// connection (redis semantics). Thread/fiber-safe. A veneer over the
+// protocol-polymorphic Channel (ChannelOptions.protocol = "redis"), so
+// timeouts/retries/socket pooling behave exactly like every other client;
+// point a ClusterChannel at protocol="redis" instead to add NS + LB +
+// circuit breaking (reference redis clients ride Channel the same way,
+// src/brpc/redis.h:43). (mongo/legacy clients still ride the older
+// PipelinedClient scaffolding — key-matched exhaust frames need the
+// MatchByKey mode the shared FIFO matcher doesn't carry yet.)
 class RedisClient {
  public:
   RedisClient();

@@ -6,6 +6,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "rpc/client_protocol.h"
 #include "transport/input_messenger.h"
 #include "transport/tls.h"
 
@@ -16,14 +17,16 @@ namespace {
 struct MapKey {
   EndPoint ep;
   int group;
-  const TlsContext* tls;  // distinct contexts never share connections
+  const TlsContext* tls;        // distinct contexts never share connections
+  const ClientProtocol* proto;  // distinct wire protocols never share either
   bool operator==(const MapKey&) const = default;
 };
 
 struct MapKeyHash {
   size_t operator()(const MapKey& k) const {
     return (size_t(k.ep.ip) << 16) ^ k.ep.port ^ (size_t(k.group) << 48) ^
-           (reinterpret_cast<uintptr_t>(k.tls) >> 4);
+           (reinterpret_cast<uintptr_t>(k.tls) >> 4) ^
+           (reinterpret_cast<uintptr_t>(k.proto) >> 3);
   }
 };
 
@@ -40,16 +43,32 @@ auto& g_map = *new std::unordered_map<MapKey, Entry, MapKeyHash>();
 
 int NewConnection(const EndPoint& remote, SocketUniquePtr* out,
                   int64_t timeout_us, TlsContext* tls,
-                  const std::string& sni) {
+                  const std::string& sni, const ClientProtocol* proto) {
   Socket::Options opts;
-  opts.on_edge_triggered = InputMessengerOnEdgeTriggered;
-  opts.run_deferred = InputMessengerProcessDeferred;
+  if (proto != nullptr && proto->cut != nullptr) {
+    // Foreign request/reply protocol: replies resolve FIFO waiters via
+    // the shared matcher instead of the InputMessenger.
+    opts.on_edge_triggered = FifoClientOnData;
+    opts.initial_parsing_context = NewFifoCore(proto);
+    opts.parsing_context_destroyer = FreeFifoCore;
+  } else {
+    opts.on_edge_triggered = InputMessengerOnEdgeTriggered;
+    opts.run_deferred = InputMessengerProcessDeferred;
+  }
   // Failed sockets are dropped from the map so the next call reconnects
   // (health-check-driven revival lands with the cluster layer).
   opts.on_failed = [](Socket* s) { RemoveSingleSocket(s->remote(), s->id()); };
-  SocketId sid;
+  SocketId sid = INVALID_SOCKET_ID;
   int rc = Socket::Connect(remote, opts, &sid, timeout_us);
-  if (rc != 0) return rc;
+  if (rc != 0) {
+    if (sid == INVALID_SOCKET_ID && opts.initial_parsing_context != nullptr) {
+      // Pre-Create failure (::socket/::connect errno): no socket ever
+      // took ownership of the FIFO core — free it here or it leaks once
+      // per connect attempt to a down endpoint.
+      FreeFifoCore(opts.initial_parsing_context);
+    }
+    return rc;
+  }
   rc = Socket::Address(sid, out);
   if (rc != 0) return ECONNREFUSED;  // failed+recycled right after connect
   if ((*out)->Failed()) {
@@ -102,14 +121,15 @@ std::once_flag g_tls_observer_once;
 
 int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
                    SocketUniquePtr* out, int64_t connect_timeout_us,
-                   int group, TlsContext* tls, const std::string& sni) {
+                   int group, TlsContext* tls, const std::string& sni,
+                   const ClientProtocol* proto) {
   if (tls != nullptr) {
     std::call_once(g_tls_observer_once,
                    [] { TlsContext::SetDestroyObserver(&PurgeTlsEntries); });
   }
-  const MapKey key{remote, group, tls};
+  const MapKey key{remote, group, tls, proto};
   if (type == ConnectionType::SHORT) {
-    return NewConnection(remote, out, connect_timeout_us, tls, sni);
+    return NewConnection(remote, out, connect_timeout_us, tls, sni, proto);
   }
   if (type == ConnectionType::POOLED) {
     for (;;) {
@@ -124,7 +144,7 @@ int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
       if (Socket::Address(sid, out) == 0 && !(*out)->Failed()) return 0;
       out->reset();
     }
-    return NewConnection(remote, out, connect_timeout_us, tls, sni);
+    return NewConnection(remote, out, connect_timeout_us, tls, sni, proto);
   }
   // SINGLE: shared multiplexed socket.
   {
@@ -140,7 +160,7 @@ int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
   // Connect OUTSIDE g_mu: a failing connect runs the socket's on_failed
   // (→ RemoveSingleSocket) on this thread, which must be free to relock.
   // Losers of a concurrent-connect race close their extra socket.
-  int rc = NewConnection(remote, out, connect_timeout_us, tls, sni);
+  int rc = NewConnection(remote, out, connect_timeout_us, tls, sni, proto);
   if (rc != 0) return rc;
   std::unique_lock lk(g_mu);
   auto& e = g_map[key];
@@ -159,7 +179,7 @@ int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
 }
 
 void ReturnPooledSocket(const EndPoint& remote, SocketId sid, int group,
-                        TlsContext* tls) {
+                        TlsContext* tls, const ClientProtocol* proto) {
   SocketUniquePtr p;
   if (Socket::Address(sid, &p) != 0 || p->Failed()) return;
   {
@@ -170,7 +190,7 @@ void ReturnPooledSocket(const EndPoint& remote, SocketId sid, int group,
     // by a freed pointer — unreachable forever, and a NEW context allocated
     // at the same address would inherit a socket handshaked under a
     // different trust config.
-    auto it = g_map.find(MapKey{remote, group, tls});
+    auto it = g_map.find(MapKey{remote, group, tls, proto});
     if (it != g_map.end()) {
       it->second.pooled.push_back(sid);
       return;

@@ -23,13 +23,19 @@ enum class ConnectionType { SINGLE, POOLED, SHORT };
 // When `tls` (a CLIENT TlsContext) is set, new connections complete a TLS
 // handshake before being returned/cached; the context pointer is part of
 // the pool key so TLS and plaintext connections never mix.
+// When `proto` (a registered ClientProtocol with a FIFO reply matcher) is
+// set, new connections parse replies with that protocol's matcher instead
+// of the InputMessenger; the descriptor pointer is part of the pool key so
+// e.g. redis and http connections to one endpoint never mix.
 int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
                    SocketUniquePtr* out, int64_t connect_timeout_us,
                    int group = 0, class TlsContext* tls = nullptr,
-                   const std::string& sni = "");
+                   const std::string& sni = "",
+                   const struct ClientProtocol* proto = nullptr);
 
 void ReturnPooledSocket(const EndPoint& remote, SocketId sid, int group = 0,
-                        class TlsContext* tls = nullptr);
+                        class TlsContext* tls = nullptr,
+                        const struct ClientProtocol* proto = nullptr);
 
 // Drops the cached SINGLE socket for `remote` if it matches sid (called on
 // failure so the next call reconnects).
