@@ -4,6 +4,7 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cerrno>
 #include <cstring>
 
@@ -292,6 +293,9 @@ bool IOBuf::equals(const std::string& s) const {
   return true;
 }
 
+std::atomic<long> g_wire_writes{0};   // sendmsg/writev syscalls issued
+std::atomic<long> g_wire_iovecs{0};   // refs shipped across them
+
 ssize_t IOBuf::cut_into_writev(int fd) {
   constexpr int kMaxIov = 64;
   iovec iov[kMaxIov];
@@ -313,7 +317,13 @@ ssize_t IOBuf::cut_into_writev(int fd) {
   msg.msg_iovlen = size_t(cnt);
   ssize_t nw = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
   if (nw < 0 && errno == ENOTSOCK) nw = ::writev(fd, iov, cnt);
-  if (nw > 0) pop_front(size_t(nw));
+  if (nw > 0) {
+    pop_front(size_t(nw));
+    // Diagnostics count only writes that shipped bytes: EAGAIN retries
+    // would inflate the denominator of msgs_per_write.
+    g_wire_writes.fetch_add(1, std::memory_order_relaxed);
+    g_wire_iovecs.fetch_add(cnt, std::memory_order_relaxed);
+  }
   return nw;
 }
 

@@ -19,6 +19,12 @@
 #include "rpc/channel.h"
 #include "rpc/server.h"
 
+namespace brt {
+extern std::atomic<long> g_wire_writes;  // base/iobuf.cc diagnostic
+extern std::atomic<long> g_msg_batches;  // input_messenger.cc diagnostic
+extern std::atomic<long> g_msg_batched;
+}
+
 using namespace brt;
 
 namespace {
@@ -160,11 +166,19 @@ int main(int argc, char** argv) {
     return lat.empty() ? 0 : long(lat[size_t(p * (lat.size() - 1))]);
   };
   const double gbps = double(bytes.load()) / elapsed / 1e9;
+  // Wire-write aggregation diagnostic: calls*2 messages (request +
+  // response) over N syscalls — ratio >1 means the batch hint is merging.
+  const long ww = g_wire_writes.load();
   printf("{\"gbps\": %.3f, \"qps\": %.0f, \"p50_us\": %ld, \"p99_us\": %ld, "
          "\"payload\": %zu, \"connections\": %d, \"depth\": %d, \"uds\": %d, "
-         "\"ssl\": %d}\n",
+         "\"ssl\": %d, \"wire_writes\": %ld, \"msgs_per_write\": %.2f, "
+         "\"msgs_per_read_batch\": %.2f}\n",
          gbps, double(calls.load()) / elapsed, pct(0.5), pct(0.99), payload,
-         connections, depth, uds, ssl);
+         connections, depth, uds, ssl, ww,
+         ww > 0 ? 2.0 * double(calls.load()) / double(ww) : 0.0,
+         g_msg_batches.load() > 0
+             ? double(g_msg_batched.load()) / double(g_msg_batches.load())
+             : 0.0);
   server.Stop();
   return 0;
 }

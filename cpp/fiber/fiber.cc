@@ -270,6 +270,21 @@ void TaskGroup::run_main_loop() {
 #ifdef BRT_TSAN_FIBERS
   main_meta_.tsan_fiber = __tsan_get_current_fiber();
 #endif
+#ifdef BRT_ASAN_FIBERS
+  {
+    // The main "fiber" runs on the worker pthread's real stack; ASan
+    // needs its true bounds when fibers switch back to it.
+    pthread_attr_t attr;
+    if (pthread_getattr_np(pthread_self(), &attr) == 0) {
+      void* addr = nullptr;
+      size_t size = 0;
+      pthread_attr_getstack(&attr, &addr, &size);
+      main_meta_.asan_bottom = addr;
+      main_meta_.asan_size = size;
+      pthread_attr_destroy(&attr);
+    }
+  }
+#endif
   fiber_t tid;
   for (;;) {
     if (!wait_task(&tid)) break;
@@ -304,7 +319,19 @@ static void cleanup_terminated(void* arg) {
 std::atomic<uint64_t> g_fibers_created{0};
 std::atomic<uint64_t> g_fibers_finished{0};
 
+// Completes the ASan side of a stack switch in the DESTINATION context
+// (both arrival paths: fresh fiber entry and post-jump resume). No-op in
+// normal builds.
+static inline void asan_finish_switch() {
+#ifdef BRT_ASAN_FIBERS
+  TaskMeta* m = tls_task_group->cur_meta();
+  __sanitizer_finish_switch_fiber(m->asan_fake_stack, nullptr, nullptr);
+  m->asan_fake_stack = nullptr;
+#endif
+}
+
 void TaskGroup::task_runner(void* /*jump_arg*/) {
+  asan_finish_switch();
   // Fresh fibers arrive here straight out of the stack switch: the
   // switch-guard set by sched_to must be cleared on this entry path too.
   t_in_context_switch = 0;
@@ -319,6 +346,9 @@ void TaskGroup::task_runner(void* /*jump_arg*/) {
     m->key_table = nullptr;
   }
   g_fibers_finished.fetch_add(1, std::memory_order_relaxed);
+#ifdef BRT_ASAN_FIBERS
+  m->asan_dying = true;  // final suspend: ASan frees this fake stack
+#endif
   // Fiber terminated. We might have migrated workers while running.
   g = tls_task_group;
   g->set_remained(cleanup_terminated, m);
@@ -353,7 +383,17 @@ void TaskGroup::sched_to(TaskMeta* next) {
 #ifdef BRT_TSAN_FIBERS
   __tsan_switch_to_fiber(next->tsan_fiber, 0);
 #endif
+#ifdef BRT_ASAN_FIBERS
+  // Tell ASan about the destination stack; the save slot belongs to the
+  // SUSPENDING fiber and is consumed by asan_finish_switch on resume. A
+  // terminating fiber passes null so ASan frees its fake stack instead.
+  const void* nb = next->is_main ? next->asan_bottom : next->stack.base;
+  const size_t ns = next->is_main ? next->asan_size : next->stack.size;
+  __sanitizer_start_switch_fiber(
+      cur->asan_dying ? nullptr : &cur->asan_fake_stack, nb, ns);
+#endif
   brt_jump_context(&cur->ctx_sp, next->ctx_sp, this);
+  asan_finish_switch();
   t_in_context_switch = 0;
   // 'cur' resumed — possibly on a different worker.
   tls_task_group->run_remained();
@@ -415,6 +455,13 @@ static fiber_t create_meta(void* (*fn)(void*), void* arg,
   m->stack_type = attr ? attr->stack_type : StackType::NORMAL;
   m->tag = attr ? attr->tag : 0;
   m->key_table = nullptr;
+#ifdef BRT_ASAN_FIBERS
+  // Pooled meta: the previous occupant died with asan_dying set; a stale
+  // flag would make EVERY suspend of the new fiber free its live fake
+  // stack.
+  m->asan_dying = false;
+  m->asan_fake_stack = nullptr;
+#endif
   if (m->has_stack && m->stack.type != m->stack_type) {
     return_stack(m->stack);
     m->has_stack = false;
@@ -435,6 +482,31 @@ int fiber_start(fiber_t* tid_out, void* (*fn)(void*), void* arg,
   if (tid_out) *tid_out = tid;
   g_fibers_created.fetch_add(1, std::memory_order_relaxed);
   requeue_fiber(tid);
+  return 0;
+}
+
+int fiber_start_lazy(fiber_t* tid_out, void* (*fn)(void*), void* arg,
+                     const FiberAttr* attr) {
+  if (attr != nullptr &&
+      (attr->tag < 0 || attr->tag >= TaskControl::kMaxTags)) {
+    return EINVAL;
+  }
+  TaskControl::get();
+  TaskMeta* m;
+  fiber_t tid = create_meta(fn, arg, attr, &m);
+  if (tid_out) *tid_out = tid;
+  g_fibers_created.fetch_add(1, std::memory_order_relaxed);
+  // FIFO remote queue OF THE CALLING WORKER: its wait_task drains the
+  // (LIFO) local queue first, so everything this worker already has
+  // runnable goes before the lazy fiber. Routing to another group (the
+  // fiber_start default for cross-tag) would hand the fiber to an idle
+  // worker that runs it IMMEDIATELY — defeating the run-last contract.
+  TaskGroup* g = tls_task_group;
+  if (g != nullptr && g->tag_ == m->tag) {
+    g->push_remote(tid);
+  } else {
+    TaskControl::get()->choose_group(m->tag)->push_remote(tid);
+  }
   return 0;
 }
 

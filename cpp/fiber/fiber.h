@@ -60,6 +60,16 @@ int fiber_start(fiber_t* tid, void* (*fn)(void*), void* arg,
 int fiber_start_urgent(fiber_t* tid, void* (*fn)(void*), void* arg,
                        const FiberAttr* attr = nullptr);
 
+// Schedules fn(arg) BEHIND everything already runnable on this worker:
+// the local runqueue is LIFO for the owner (Chase-Lev), so fiber_start
+// runs the newest fiber first — this routes through the FIFO remote
+// queue instead, which wait_task drains only after the local queue.
+// For work that should observe the effects of already-queued fibers
+// (e.g. the write-aggregation flusher, which wants every pending
+// response chained before it issues the one writev).
+int fiber_start_lazy(fiber_t* tid, void* (*fn)(void*), void* arg,
+                     const FiberAttr* attr = nullptr);
+
 // Waits for fiber termination. Safe on stale ids (returns immediately).
 int fiber_join(fiber_t tid);
 

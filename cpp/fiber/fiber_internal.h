@@ -33,6 +33,21 @@ void __tsan_switch_to_fiber(void* fiber, unsigned flags);
 }
 #endif
 
+// ASan likewise tracks stack bounds per thread; raw jumps onto pooled
+// fiber stacks read as stack-buffer-overflows unless each switch is
+// bracketed with start/finish_switch_fiber (the boost.context dance).
+#if defined(__SANITIZE_ADDRESS__)
+#define BRT_ASAN_FIBERS 1
+extern "C" {
+void __sanitizer_start_switch_fiber(void** fake_stack_save,
+                                    const void* stack_bottom,
+                                    size_t stack_size);
+void __sanitizer_finish_switch_fiber(void* fake_stack_save,
+                                     const void** stack_bottom_old,
+                                     size_t* stack_size_old);
+}
+#endif
+
 struct TaskMeta {
   void* (*fn)(void*) = nullptr;
   void* arg = nullptr;
@@ -45,6 +60,12 @@ struct TaskMeta {
   KeyTable* key_table = nullptr;  // lazily created; dtors run at exit
 #ifdef BRT_TSAN_FIBERS
   void* tsan_fiber = nullptr;
+#endif
+#ifdef BRT_ASAN_FIBERS
+  void* asan_fake_stack = nullptr;   // saved by start_switch on suspend
+  const void* asan_bottom = nullptr; // main fiber: real thread stack
+  size_t asan_size = 0;
+  bool asan_dying = false;  // final suspend: let ASan free the fake stack
 #endif
   uint32_t index = 0;           // slot index in the meta pool
   std::atomic<uint32_t> version{0};  // odd = live (id ABA guard)

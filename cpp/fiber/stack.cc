@@ -8,17 +8,28 @@
 
 #include "base/logging.h"
 
+#if defined(__SANITIZE_ADDRESS__)
+extern "C" void __asan_unpoison_memory_region(void const volatile*, size_t);
+#endif
+
 namespace brt {
 
 namespace {
 
 size_t stack_bytes(StackType t) {
+  // Sanitizer builds: redzones + fake frames inflate stack use ~3-4x; a
+  // 32KB SMALL stack that fits fine in production genuinely overflows.
+#if defined(__SANITIZE_ADDRESS__) || defined(__SANITIZE_THREAD__)
+  constexpr size_t kScale = 4;
+#else
+  constexpr size_t kScale = 1;
+#endif
   switch (t) {
-    case StackType::SMALL: return 32 * 1024;
-    case StackType::NORMAL: return 128 * 1024;
-    case StackType::LARGE: return 1024 * 1024;
+    case StackType::SMALL: return kScale * 32 * 1024;
+    case StackType::NORMAL: return kScale * 128 * 1024;
+    case StackType::LARGE: return kScale * 1024 * 1024;
   }
-  return 128 * 1024;
+  return kScale * 128 * 1024;
 }
 
 struct StackPool {
@@ -62,6 +73,13 @@ bool get_stack(StackType type, FiberStack* out) {
 }
 
 void return_stack(const FiberStack& s) {
+#if defined(__SANITIZE_ADDRESS__)
+  // A terminated fiber's frames are never epilogue-unwound (the context
+  // jump skips them), so their redzones stay poisoned in shadow memory;
+  // the next fiber on this pooled stack would trip false positives on
+  // its own legitimate locals. Clear the whole region before reuse.
+  __asan_unpoison_memory_region(s.base, s.size);
+#endif
   std::lock_guard<std::mutex> g(pool().mu);
   auto& v = pool().free_bases[int(s.type)];
   if (v.size() < 128) {

@@ -51,6 +51,8 @@ void SSL_CTX_set_verify(SSL_CTX* ctx, int mode, SSL_verify_cb callback);
 int SSL_CTX_load_verify_locations(SSL_CTX* ctx, const char* CAfile,
                                   const char* CApath);
 int SSL_CTX_set_default_verify_paths(SSL_CTX* ctx);
+int SSL_CTX_set_ciphersuites(SSL_CTX* ctx, const char* str);  // TLS 1.3
+int SSL_CTX_set_cipher_list(SSL_CTX* ctx, const char* str);   // <= TLS 1.2
 typedef int (*SSL_CTX_alpn_select_cb_func)(SSL* ssl, const unsigned char** out,
                                            unsigned char* outlen,
                                            const unsigned char* in,

@@ -11,6 +11,11 @@
 
 namespace brt {
 
+// Diagnostic: how many complete messages each read event yields (the
+// denominator of response-write aggregation).
+std::atomic<long> g_msg_batches{0};
+std::atomic<long> g_msg_batched{0};
+
 namespace {
 constexpr int kMaxProtocols = 32;
 Protocol g_protocols[kMaxProtocols];
@@ -181,6 +186,15 @@ void* InputMessengerOnEdgeTriggered(Socket* s) {
     s->SetFailed(pending_err, "%s", pending_msg);
   }
   if (batch.empty()) return nullptr;
+  g_msg_batches.fetch_add(1, std::memory_order_relaxed);
+  g_msg_batched.fetch_add(long(batch.size()), std::memory_order_relaxed);
+  // Response write aggregation: each of these messages will produce one
+  // write on this socket (server: a response; client: the woken waiter's
+  // follow-up request). Hint the socket so those writes coalesce into one
+  // writev instead of one sendmsg each — the dominant small-RPC cost
+  // (reference thread-jump + KeepWrite batching, input_messenger.cpp:286
+  // + socket.cpp:1758).
+  if (batch.size() > 1) s->SetWriteBatchHint(int(batch.size()));
   // All but the last message get their own fibers; the last is DEFERRED to
   // the caller ("thread jump": the read fiber becomes the processing fiber
   // — but only after it releases the socket's read gate, so a blocking

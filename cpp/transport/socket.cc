@@ -314,6 +314,43 @@ void Socket::RemoveWaiter(fid_t cid) {
 // becomes the flusher: writes inline once, and on EAGAIN hands off to a
 // KeepWrite fiber that parks on EPOLLOUT.
 // ---------------------------------------------------------------------------
+struct KeepWriteArg {
+  SocketId sid;
+  Socket::WriteReq* cur;
+};
+
+// Consumes one batch-hint unit; returns the pre-decrement value (0 when
+// no batch is expected).
+int Socket::TakeBatchHint() {
+  int hint = write_batch_hint_.load(std::memory_order_relaxed);
+  while (hint > 0 && !write_batch_hint_.compare_exchange_weak(
+                         hint, hint - 1, std::memory_order_relaxed)) {
+  }
+  return hint;
+}
+
+// Links req into the MPSC chain; the writer that becomes head flushes —
+// inline normally, or (when the batch hint says more writers are
+// imminent) from a lazily-scheduled fiber that runs AFTER them, so their
+// frames coalesce into this chain and leave in one writev. On
+// flusher-spawn failure falls back to inline.
+int Socket::QueueOrFlush(WriteReq* req) {
+  const int hint = TakeBatchHint();
+  WriteReq* prev = write_head_.exchange(req, std::memory_order_acq_rel);
+  if (prev != nullptr) {
+    // Another writer is (or will become) the flusher; just link in.
+    prev->next.store(req, std::memory_order_release);
+    return 0;
+  }
+  if (hint > 1) {
+    auto* arg = new KeepWriteArg{id_, req};
+    fiber_t tid;
+    if (fiber_start_lazy(&tid, &Socket::KeepWriteEntry, arg) == 0) return 0;
+    delete arg;
+  }
+  return FlushWriteChain(req, /*in_keepwrite_fiber=*/false);
+}
+
 int Socket::Write(IOBuf* data, fid_t cid) {
   int err = failed_.load(std::memory_order_acquire);
   if (err != 0) {
@@ -324,13 +361,7 @@ int Socket::Write(IOBuf* data, fid_t cid) {
   WriteReq* req = GetWriteReq();
   req->data.swap(*data);
   req->cid = cid;
-  WriteReq* prev = write_head_.exchange(req, std::memory_order_acq_rel);
-  if (prev != nullptr) {
-    // Another writer is (or will become) the flusher; just link in.
-    prev->next.store(req, std::memory_order_release);
-    return 0;
-  }
-  return FlushWriteChain(req, /*in_keepwrite_fiber=*/false);
+  return QueueOrFlush(req);
 }
 
 int Socket::WriteWire(IOBuf* data) {
@@ -342,18 +373,8 @@ int Socket::WriteWire(IOBuf* data) {
   WriteReq* req = GetWriteReq();
   req->data.swap(*data);
   req->raw = true;
-  WriteReq* prev = write_head_.exchange(req, std::memory_order_acq_rel);
-  if (prev != nullptr) {
-    prev->next.store(req, std::memory_order_release);
-    return 0;
-  }
-  return FlushWriteChain(req, /*in_keepwrite_fiber=*/false);
+  return QueueOrFlush(req);
 }
-
-struct KeepWriteArg {
-  SocketId sid;
-  Socket::WriteReq* cur;
-};
 
 void* Socket::KeepWriteEntry(void* argp) {
   auto* arg = static_cast<KeepWriteArg*>(argp);
@@ -376,6 +397,21 @@ void* Socket::KeepWriteEntry(void* argp) {
 
 int Socket::FlushWriteChain(WriteReq* cur, bool in_keepwrite_fiber) {
   for (;;) {
+    // Coalesce already-queued successors (same raw state) into cur before
+    // the syscall: k pipelined small frames leave in one writev — and,
+    // under TLS, in one record batch — instead of k. The flusher owns
+    // every linked node (producers only touch a node before publishing
+    // it), so moving their data is race-free; drained nodes stay in the
+    // chain empty so error accounting still walks them.
+    {
+      size_t merged = cur->data.size();
+      for (WriteReq* n = cur->next.load(std::memory_order_acquire);
+           n != nullptr && n->raw == cur->raw && merged < (1u << 20);
+           n = n->next.load(std::memory_order_acquire)) {
+        merged += n->data.size();
+        cur->data.append(std::move(n->data));
+      }
+    }
     // TLS: encrypt the request's plaintext into wire records. Exactly one
     // flusher runs at a time, so the session sees writes in chain order;
     // raw is flipped so a KeepWrite handoff can't double-encrypt.

@@ -125,6 +125,19 @@ class Socket {
   // Returns 0 if accepted (delivery still asynchronous).
   int Write(IOBuf* data, fid_t cid = 0);
 
+  // Hints that ~n more Write calls are imminent on this socket (the
+  // messenger just dispatched a batch of n messages, each of which will
+  // produce a response — or, client-side, a batch of n responses whose
+  // waiters will issue follow-up requests). While the hint is positive,
+  // a Write that would flush inline defers to a fiber scheduled AFTER
+  // the expected writers, so k pipelined small messages leave in ONE
+  // writev instead of k sendmsg calls (reference KeepWrite batching,
+  // socket.cpp:1758, made proactive). Self-correcting: each Write
+  // consumes one unit and a stale hint only costs one deferred flush.
+  void SetWriteBatchHint(int n) {
+    write_batch_hint_.store(n, std::memory_order_relaxed);
+  }
+
   // Marks failed; pending & future writes error out; on_failed runs once;
   // fd is closed when the last reference drops.
   void SetFailed(int err, const char* fmt = nullptr, ...);
@@ -252,9 +265,12 @@ class Socket {
   void* parsing_context_ = nullptr;
   void (*parsing_context_destroyer_)(void*) = nullptr;
   std::atomic<bool> close_after_flush_{false};
+  std::atomic<int> write_batch_hint_{0};  // see SetWriteBatchHint
   std::atomic<WriteReq*> write_head_{nullptr};  // MPSC chain, Vyukov-style
   // Wire-format write that bypasses TLS encryption (handshake replies).
   int WriteWire(IOBuf* data);
+  int TakeBatchHint();
+  int QueueOrFlush(WriteReq* req);
   std::atomic<TlsSession*> tls_{nullptr};  // owned; freed at recycle
   TlsContext* tls_server_ctx_ = nullptr;   // sniffing candidate (server)
   std::mutex waiters_mu_;

@@ -1,5 +1,7 @@
 #include "transport/tls.h"
 
+#include <algorithm>
+
 #include <cerrno>
 #include <cstring>
 
@@ -165,6 +167,18 @@ int GenerateSelfSignedCert(const std::string& cn, std::string* cert_pem,
 // ---------------------------------------------------------------------------
 // TlsContext
 // ---------------------------------------------------------------------------
+// Prefer AES-128-GCM: same security tier for transport encryption as the
+// 256 default but ~25% cheaper per byte, and on a loopback/echo path the
+// cipher IS the bottleneck (4 crypto passes per echoed byte in-process).
+// Failures are ignored — an exotic build without these suites just keeps
+// its defaults.
+void PreferFastCiphers(SSL_CTX* ctx) {
+  SSL_CTX_set_ciphersuites(ctx,
+                           "TLS_AES_128_GCM_SHA256:TLS_AES_256_GCM_SHA384:"
+                           "TLS_CHACHA20_POLY1305_SHA256");
+  SSL_CTX_set_cipher_list(ctx, "ECDHE+AESGCM:ECDHE+CHACHA20:HIGH");
+}
+
 std::unique_ptr<TlsContext> TlsContext::NewServer(const TlsOptions& opts,
                                                   std::string* err) {
   InitOpenssl();
@@ -174,6 +188,7 @@ std::unique_ptr<TlsContext> TlsContext::NewServer(const TlsOptions& opts,
     return nullptr;
   }
   SSL_CTX_set_min_proto_version(ctx, TLS1_2_VERSION);
+  PreferFastCiphers(ctx);
   TlsOptions o = opts;
   if (o.cert_pem.empty() && o.cert_file.empty()) {
     // Dev mode: self-signed on the fly (reference ssl_helper generates
@@ -208,6 +223,7 @@ std::unique_ptr<TlsContext> TlsContext::NewClient(const TlsOptions& opts,
     return nullptr;
   }
   SSL_CTX_set_min_proto_version(ctx, TLS1_2_VERSION);
+  PreferFastCiphers(ctx);
   if (opts.verify_peer) {
     SSL_CTX_set_verify(ctx, SSL_VERIFY_PEER, nullptr);
     if (!opts.ca_file.empty()) {
@@ -290,10 +306,21 @@ TlsSession::~TlsSession() {
   if (hs_butex_ != nullptr) butex_destroy(hs_butex_);
 }
 
+// 64KB copy chunks: fewer BIO_read/SSL_read round-trips per drained
+// record batch (the write path coalesces up to 1MB of plaintext per
+// Encrypt). Heap-backed thread_local — fiber stacks are 128KB and
+// OpenSSL needs its own headroom; no fiber switch happens while the
+// buffer is in use (these functions never park).
+static char* DrainChunk() {
+  static thread_local char* buf = new char[64 * 1024];
+  return buf;
+}
+constexpr size_t kDrainChunk = 64 * 1024;
+
 void TlsSession::DrainWbioLocked(IOBuf* wire_out) {
-  char buf[16 * 1024];
+  char* buf = DrainChunk();
   while (BIO_ctrl_pending(wbio_) > 0) {
-    int n = BIO_read(wbio_, buf, int(sizeof(buf)));
+    int n = BIO_read(wbio_, buf, int(kDrainChunk));
     if (n <= 0) break;
     wire_out->append(buf, size_t(n));
   }
@@ -317,9 +344,9 @@ int TlsSession::ProgressLocked(IOBuf* plain_out, IOBuf* wire_out) {
     // ahead of it. The socket layer publishes after queueing wire_out.
   }
   if (SSL_is_init_finished(ssl_) && plain_out != nullptr) {
-    char buf[16 * 1024];
+    char* buf = DrainChunk();
     for (;;) {
-      int n = SSL_read(ssl_, buf, int(sizeof(buf)));
+      int n = SSL_read(ssl_, buf, int(kDrainChunk));
       if (n > 0) {
         plain_out->append(buf, size_t(n));
         continue;
@@ -364,13 +391,27 @@ int TlsSession::Pump(IOBuf* wire_out) {
 
 int TlsSession::Encrypt(IOBuf* plain_in, IOBuf* wire_out) {
   std::lock_guard<std::mutex> g(mu_);
-  for (int i = 0; i < plain_in->block_count(); ++i) {
-    const auto& r = plain_in->ref_at(i);
+  // Gather pooled 8KB blocks into full 16KB records: one SSL_write per
+  // TLS maximum-size record halves the per-record cost (GCM setup, tag,
+  // BIO bookkeeping) vs writing per block; the gather memcpy is cheap
+  // against that. Whole refs >= 16KB (user-data blocks) encrypt in place.
+  constexpr size_t kRecord = 16 * 1024;
+  char* gather = DrainChunk();
+  while (!plain_in->empty()) {
+    const char* src;
+    size_t len;
+    const auto& r = plain_in->ref_at(0);
+    if (r.length >= kRecord || r.length == plain_in->size()) {
+      src = static_cast<const char*>(plain_in->ref_data(0));
+      len = r.length;
+    } else {
+      len = plain_in->copy_to(gather, kRecord);
+      src = gather;
+    }
     size_t off = 0;
-    while (off < r.length) {
-      int n = SSL_write(
-          ssl_, static_cast<const char*>(plain_in->ref_data(i)) + off,
-          int(r.length - off));
+    while (off < len) {
+      int n = SSL_write(ssl_, src + off,
+                        int(std::min(len - off, kRecord)));
       if (n <= 0) {
         // Post-handshake SSL_write into a memory BIO cannot legitimately
         // want IO; anything else is fatal for the connection.
@@ -380,8 +421,8 @@ int TlsSession::Encrypt(IOBuf* plain_in, IOBuf* wire_out) {
       }
       off += size_t(n);
     }
+    plain_in->pop_front(len);
   }
-  plain_in->clear();
   DrainWbioLocked(wire_out);
   return 0;
 }
