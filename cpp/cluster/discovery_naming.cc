@@ -1,0 +1,211 @@
+#include "cluster/discovery_naming.h"
+
+#include "base/logging.h"
+#include "rpc/json.h"
+
+namespace brt {
+
+namespace {
+
+// data.<appid>.instances[].addrs[] with scheme prefixes stripped; a node
+// appears once per address (reference parse, discovery_naming_service
+// .cpp:380-430).
+bool ParseFetchs(const std::string& body, const std::string& appid,
+                 std::vector<ServerNode>* out) {
+  JsonValue doc;
+  std::string err;
+  if (!JsonParse(body, &doc, &err)) {
+    BRT_LOG(WARNING) << "discovery: bad fetchs JSON: " << err;
+    return false;
+  }
+  const JsonValue* data = doc.member("data");
+  if (data == nullptr) return false;
+  const JsonValue* svc = data->member(appid);
+  if (svc == nullptr) return false;
+  const JsonValue* instances = svc->member("instances");
+  if (instances == nullptr || instances->type != JsonValue::Type::kArray) {
+    return false;
+  }
+  out->clear();
+  for (const JsonValue& inst : instances->elems) {
+    const JsonValue* addrs = inst.member("addrs");
+    if (addrs == nullptr || addrs->type != JsonValue::Type::kArray) continue;
+    for (const JsonValue& a : addrs->elems) {
+      if (a.type != JsonValue::Type::kString) continue;
+      std::string addr = a.str;
+      const size_t pos = addr.find("://");
+      if (pos != std::string::npos) addr = addr.substr(pos + 3);
+      ServerNode n;
+      if (EndPoint::parse(addr, &n.ep)) out->push_back(n);
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+int DiscoveryNamingService::Start(const std::string& param,
+                                  ServerListCallback cb) {
+  // param: host:port/appid[?env=E&zone=Z]
+  const size_t slash = param.find('/');
+  if (slash == std::string::npos) return EINVAL;
+  if (!EndPoint::parse(param.substr(0, slash), &agent_)) return EINVAL;
+  std::string rest = param.substr(slash + 1);
+  const size_t q = rest.find('?');
+  if (q != std::string::npos) {
+    std::string query = rest.substr(q + 1);
+    rest = rest.substr(0, q);
+    size_t p = 0;
+    while (p < query.size()) {
+      size_t amp = query.find('&', p);
+      if (amp == std::string::npos) amp = query.size();
+      const std::string kv = query.substr(p, amp - p);
+      const size_t eq = kv.find('=');
+      if (eq != std::string::npos) {
+        const std::string k = kv.substr(0, eq);
+        if (k == "env") env_ = kv.substr(eq + 1);
+        if (k == "zone") zone_ = kv.substr(eq + 1);
+      }
+      p = amp + 1;
+    }
+  }
+  appid_ = rest;
+  if (appid_.empty()) return EINVAL;
+  cb_ = std::move(cb);
+  fiber_init(0);
+  return fiber_start(&fid_, &DiscoveryNamingService::PollEntry, this);
+}
+
+void DiscoveryNamingService::Stop() {
+  stopping_.store(true, std::memory_order_release);
+  cancel_.Cancel();
+  if (fid_ != 0) {
+    fiber_join(fid_);
+    fid_ = 0;
+  }
+}
+
+void* DiscoveryNamingService::PollEntry(void* arg) {
+  auto* self = static_cast<DiscoveryNamingService*>(arg);
+  std::vector<ServerNode> last;
+  bool pushed_any = false;
+  while (!self->stopping_.load(std::memory_order_acquire)) {
+    std::string path = "/discovery/fetchs?appid=" + UrlEscape(self->appid_) +
+                       "&env=" + UrlEscape(self->env_) + "&status=1";
+    if (!self->zone_.empty()) path += "&zone=" + UrlEscape(self->zone_);
+    HttpClientResult res;
+    const int rc = HttpFetch(self->agent_, "GET", path, "", "", &res, 5000,
+                             /*use_tls=*/false, &self->cancel_);
+    if (self->stopping_.load(std::memory_order_acquire)) break;
+    std::vector<ServerNode> nodes;
+    if (rc == 0 && res.status == 200 &&
+        ParseFetchs(res.body, self->appid_, &nodes)) {
+      if (!pushed_any || nodes != last) {
+        self->cb_(nodes);
+        last = std::move(nodes);
+        pushed_any = true;
+      }
+    }
+    // Interruptible sleep: poll stopping every 100ms.
+    for (int waited = 0; waited < self->interval_ms &&
+                         !self->stopping_.load(std::memory_order_acquire);
+         waited += 100) {
+      fiber_usleep(100 * 1000);
+    }
+  }
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// DiscoveryClient (register / renew / cancel)
+// ---------------------------------------------------------------------------
+
+int DiscoveryClient::PostForm(const std::string& path,
+                              const std::string& form, FetchCancel* cancel) {
+  HttpClientResult res;
+  const int rc =
+      HttpFetch(params_.agent, "POST", path, form,
+                "application/x-www-form-urlencoded", &res, 5000,
+                /*use_tls=*/false, cancel);
+  if (rc != 0) return rc;
+  if (res.status != 200) return EPROTO;
+  // {"code": 0, ...} is the agent's common result envelope.
+  JsonValue doc;
+  std::string err;
+  if (JsonParse(res.body, &doc, &err)) {
+    const JsonValue* code = doc.member("code");
+    if (code != nullptr && code->type == JsonValue::Type::kInt &&
+        code->i != 0) {
+      return EPROTO;
+    }
+  }
+  return 0;
+}
+
+int DiscoveryClient::Register(const Params& p) {
+  if (p.appid.empty() || p.hostname.empty() || p.addr.empty()) return EINVAL;
+  params_ = p;
+  const std::string form =
+      "appid=" + UrlEscape(p.appid) + "&hostname=" + UrlEscape(p.hostname) +
+      "&addrs=" + UrlEscape("http://" + p.addr) + "&env=" + UrlEscape(p.env) +
+      "&zone=" + UrlEscape(p.zone) + "&status=1";
+  const int rc = PostForm("/discovery/register", form, &cancel_);
+  if (rc != 0) return rc;
+  registered_.store(true, std::memory_order_release);
+  fiber_init(0);
+  return fiber_start(&fid_, &DiscoveryClient::RenewEntry, this);
+}
+
+void* DiscoveryClient::RenewEntry(void* arg) {
+  auto* self = static_cast<DiscoveryClient*>(arg);
+  int consecutive_errors = 0;
+  while (!self->stopping_.load(std::memory_order_acquire)) {
+    for (int waited = 0;
+         waited < self->params_.renew_interval_ms &&
+         !self->stopping_.load(std::memory_order_acquire);
+         waited += 100) {
+      fiber_usleep(100 * 1000);
+    }
+    if (self->stopping_.load(std::memory_order_acquire)) break;
+    const std::string form =
+        "appid=" + UrlEscape(self->params_.appid) +
+        "&hostname=" + UrlEscape(self->params_.hostname) +
+        "&env=" + UrlEscape(self->params_.env) +
+        "&zone=" + UrlEscape(self->params_.zone);
+    if (self->PostForm("/discovery/renew", form, &self->cancel_) != 0) {
+      // Re-register after the error threshold (reference
+      // discovery_reregister_threshold = 3).
+      if (++consecutive_errors >= 3) {
+        const std::string reg =
+            form + "&addrs=" + UrlEscape("http://" + self->params_.addr) +
+            "&status=1";
+        if (self->PostForm("/discovery/register", reg, &self->cancel_) ==
+            0) {
+          consecutive_errors = 0;
+        }
+      }
+    } else {
+      consecutive_errors = 0;
+    }
+  }
+  return nullptr;
+}
+
+void DiscoveryClient::Cancel() {
+  if (!registered_.exchange(false)) return;
+  stopping_.store(true, std::memory_order_release);
+  cancel_.Cancel();
+  if (fid_ != 0) {
+    fiber_join(fid_);
+    fid_ = 0;
+  }
+  const std::string form =
+      "appid=" + UrlEscape(params_.appid) +
+      "&hostname=" + UrlEscape(params_.hostname) +
+      "&env=" + UrlEscape(params_.env) + "&zone=" + UrlEscape(params_.zone);
+  // No cancel token: cancel_ is already fired; the final deregistration
+  // runs under HttpFetch's own timeout.
+  (void)PostForm("/discovery/cancel", form, nullptr);
+}
+
+}  // namespace brt

@@ -1,0 +1,79 @@
+// Discovery-dialect naming service (Bilibili discovery): periodic
+// GET /discovery/fetchs?appid=<name>&env=<env>&status=1[&zone=<zone>]
+// against the agent; the JSON answer nests instances under
+// data.<appid>.instances[].addrs[] with scheme-prefixed addresses
+// ("grpc://ip:port") that are stripped before use. Also carries the
+// server-side registration client (POST /discovery/register, periodic
+// /discovery/renew, /discovery/cancel on shutdown).
+// Parity target: reference src/brpc/policy/discovery_naming_service.cpp
+// (fetch :345-430, register/renew/cancel client :140-345).
+//
+// url: discovery://host:port/appid[?env=E&zone=Z]   (env defaults "prod")
+#pragma once
+
+#include <atomic>
+#include <string>
+
+#include "base/endpoint.h"
+#include "cluster/naming_service.h"
+#include "fiber/fiber.h"
+#include "rpc/http_client.h"
+
+namespace brt {
+
+class DiscoveryNamingService : public NamingService {
+ public:
+  ~DiscoveryNamingService() override { Stop(); }
+  int Start(const std::string& param, ServerListCallback cb) override;
+  void Stop() override;
+
+  // Re-fetch period (reference NS default poll). Exposed for tests.
+  int interval_ms = 5000;
+
+ private:
+  static void* PollEntry(void* arg);
+
+  EndPoint agent_;
+  std::string appid_;
+  std::string env_ = "prod";
+  std::string zone_;
+  ServerListCallback cb_;
+  fiber_t fid_ = 0;
+  std::atomic<bool> stopping_{false};
+  FetchCancel cancel_;
+};
+
+// Registers this process as an instance of `appid` and keeps the lease
+// alive with periodic renews; Cancel() (or destruction) deregisters.
+// Reference DiscoveryClient (discovery_naming_service.cpp:140).
+class DiscoveryClient {
+ public:
+  ~DiscoveryClient() { Cancel(); }
+
+  struct Params {
+    EndPoint agent;
+    std::string appid;
+    std::string hostname;
+    std::string addr;  // "ip:port" this process serves on
+    std::string env = "prod";
+    std::string zone;
+    int renew_interval_ms = 30000;  // FLAGS_discovery_renew_interval_s
+  };
+
+  // Registers and starts the renew loop. Returns 0 or errno-style.
+  int Register(const Params& p);
+  void Cancel();
+
+ private:
+  static void* RenewEntry(void* arg);
+  int PostForm(const std::string& path, const std::string& form,
+               FetchCancel* cancel);
+
+  Params params_;
+  fiber_t fid_ = 0;
+  std::atomic<bool> stopping_{false};
+  std::atomic<bool> registered_{false};
+  FetchCancel cancel_;
+};
+
+}  // namespace brt

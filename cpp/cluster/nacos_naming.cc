@@ -1,0 +1,146 @@
+#include "cluster/nacos_naming.h"
+
+#include <ctime>
+
+#include "base/logging.h"
+#include "rpc/json.h"
+
+namespace brt {
+
+namespace {
+
+// hosts[] → nodes; disabled/unhealthy skipped; weight >= 1 (reference
+// nacos_naming_service.cpp:160-210).
+bool ParseHosts(const std::string& body, std::vector<ServerNode>* out) {
+  JsonValue doc;
+  std::string err;
+  if (!JsonParse(body, &doc, &err)) {
+    BRT_LOG(WARNING) << "nacos: bad instance/list JSON: " << err;
+    return false;
+  }
+  const JsonValue* hosts = doc.member("hosts");
+  if (hosts == nullptr || hosts->type != JsonValue::Type::kArray) {
+    return false;
+  }
+  out->clear();
+  for (const JsonValue& h : hosts->elems) {
+    const JsonValue* ip = h.member("ip");
+    const JsonValue* port = h.member("port");
+    if (ip == nullptr || port == nullptr ||
+        ip->type != JsonValue::Type::kString ||
+        port->type != JsonValue::Type::kInt) {
+      continue;
+    }
+    const JsonValue* enabled = h.member("enabled");
+    if (enabled != nullptr && enabled->type == JsonValue::Type::kBool &&
+        !enabled->b) {
+      continue;
+    }
+    const JsonValue* healthy = h.member("healthy");
+    if (healthy != nullptr && healthy->type == JsonValue::Type::kBool &&
+        !healthy->b) {
+      continue;
+    }
+    ServerNode n;
+    if (!EndPoint::parse(ip->str + ":" + std::to_string(port->i), &n.ep)) {
+      continue;
+    }
+    if (const JsonValue* w = h.member("weight")) {
+      const double wv = w->type == JsonValue::Type::kInt ? double(w->i)
+                                                         : w->d;
+      if (wv > 0) n.weight = wv < 1 ? 1 : int(wv);
+    }
+    out->push_back(n);
+  }
+  return true;
+}
+
+}  // namespace
+
+int NacosNamingService::Start(const std::string& param,
+                              ServerListCallback cb) {
+  // param: host:port/<raw instance/list query>
+  const size_t slash = param.find('/');
+  if (slash == std::string::npos) return EINVAL;
+  if (!EndPoint::parse(param.substr(0, slash), &registry_)) return EINVAL;
+  query_ = param.substr(slash + 1);
+  if (query_.empty()) return EINVAL;
+  cb_ = std::move(cb);
+  fiber_init(0);
+  return fiber_start(&fid_, &NacosNamingService::PollEntry, this);
+}
+
+void NacosNamingService::Stop() {
+  stopping_.store(true, std::memory_order_release);
+  cancel_.Cancel();
+  if (fid_ != 0) {
+    fiber_join(fid_);
+    fid_ = 0;
+  }
+}
+
+int NacosNamingService::RefreshToken() {
+  HttpClientResult res;
+  const std::string form = "username=" + UrlEscape(username) +
+                         "&password=" + UrlEscape(password);
+  const int rc = HttpFetch(registry_, "POST", "/nacos/v1/auth/login", form,
+                           "application/x-www-form-urlencoded", &res, 5000,
+                           /*use_tls=*/false, &cancel_);
+  if (rc != 0 || res.status != 200) return rc != 0 ? rc : EPROTO;
+  JsonValue doc;
+  std::string err;
+  if (!JsonParse(res.body, &doc, &err)) return EPROTO;
+  const JsonValue* tok = doc.member("accessToken");
+  if (tok == nullptr || tok->type != JsonValue::Type::kString) return EPROTO;
+  access_token_ = tok->str;
+  const JsonValue* ttl = doc.member("tokenTtl");
+  if (ttl != nullptr && ttl->type == JsonValue::Type::kInt && ttl->i > 0) {
+    // Refresh at 90% of the ttl (reference refreshes on expiry; earlier
+    // avoids a failed fetch at the boundary).
+    token_deadline_s = int64_t(time(nullptr)) + ttl->i * 9 / 10;
+  } else {
+    token_deadline_s = 0;
+  }
+  return 0;
+}
+
+void* NacosNamingService::PollEntry(void* arg) {
+  auto* self = static_cast<NacosNamingService*>(arg);
+  std::vector<ServerNode> last;
+  bool pushed_any = false;
+  while (!self->stopping_.load(std::memory_order_acquire)) {
+    const bool auth = !self->username.empty() && !self->password.empty();
+    if (auth && (self->access_token_.empty() ||
+                 (self->token_deadline_s > 0 &&
+                  time(nullptr) >= self->token_deadline_s))) {
+      (void)self->RefreshToken();
+    }
+    std::string path = "/nacos/v1/ns/instance/list?";
+    if (!self->access_token_.empty()) {
+      path += "accessToken=" + UrlEscape(self->access_token_) + "&";
+    }
+    path += self->query_;
+    HttpClientResult res;
+    const int rc = HttpFetch(self->registry_, "GET", path, "", "", &res,
+                             5000, /*use_tls=*/false, &self->cancel_);
+    if (self->stopping_.load(std::memory_order_acquire)) break;
+    std::vector<ServerNode> nodes;
+    if (rc == 0 && res.status == 200 && ParseHosts(res.body, &nodes)) {
+      if (!pushed_any || nodes != last) {
+        self->cb_(nodes);
+        last = std::move(nodes);
+        pushed_any = true;
+      }
+    } else if (rc == 0 && res.status == 403) {
+      self->access_token_.clear();  // stale token: re-login next round
+    }
+    for (int waited = 0; waited < self->interval_ms &&
+                         !self->stopping_.load(std::memory_order_acquire);
+         waited += 100) {
+      fiber_usleep(100 * 1000);
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace brt

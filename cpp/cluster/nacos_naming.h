@@ -1,0 +1,54 @@
+// Nacos-dialect naming service: periodic
+// GET /nacos/v1/ns/instance/list?<query>  (query carries serviceName=…)
+// → {"hosts":[{"ip","port","weight","enabled","healthy"}...]}; disabled
+// or unhealthy hosts are skipped and fractional weights round to >=1.
+// Optional auth: POST /nacos/v1/auth/login (username/password form) →
+// {"accessToken","tokenTtl"}; the token rides the list query and
+// refreshes before expiry.
+// Parity target: reference src/brpc/policy/nacos_naming_service.cpp.
+//
+// url: nacos://host:port/serviceName=my-svc[&groupName=g]
+//      (everything after '/' is the raw instance/list query string,
+//       matching the reference's FLAGS-driven usage; credentials are set
+//       on the object before Start for authenticated registries).
+#pragma once
+
+#include <atomic>
+#include <string>
+
+#include "base/endpoint.h"
+#include "cluster/naming_service.h"
+#include "fiber/fiber.h"
+#include "rpc/http_client.h"
+
+namespace brt {
+
+class NacosNamingService : public NamingService {
+ public:
+  ~NacosNamingService() override { Stop(); }
+  int Start(const std::string& param, ServerListCallback cb) override;
+  void Stop() override;
+
+  // Optional authentication (set BEFORE Start).
+  std::string username;
+  std::string password;
+
+  // Re-fetch period. Exposed for tests.
+  int interval_ms = 5000;
+
+ private:
+  static void* PollEntry(void* arg);
+  // Refreshes access_token_/token_deadline_; 0 on success.
+  int RefreshToken();
+
+  EndPoint registry_;
+  std::string query_;  // raw instance/list query (serviceName=...)
+  std::string access_token_;
+  int64_t token_deadline_s = 0;  // realtime seconds; 0 = no expiry
+  ServerListCallback cb_;
+  fiber_t fid_ = 0;
+  std::atomic<bool> stopping_{false};
+  FetchCancel cancel_;
+};
+
+}  // namespace brt

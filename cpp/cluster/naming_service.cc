@@ -1,6 +1,8 @@
 #include "cluster/naming_service.h"
 
 #include "cluster/consul_naming.h"
+#include "cluster/discovery_naming.h"
+#include "cluster/nacos_naming.h"
 #include "cluster/remote_naming.h"
 
 #include <netdb.h>
@@ -213,6 +215,17 @@ void RegisterBuiltinNs() {
     // (cluster/consul_naming.h; reference consul_naming_service.cpp).
     RegisterNamingService("consul", [] {
       return std::unique_ptr<NamingService>(new ConsulNamingService);
+    });
+    // discovery://host:port/appid?env=prod — the Bilibili discovery
+    // dialect (cluster/discovery_naming.h; reference
+    // discovery_naming_service.cpp).
+    RegisterNamingService("discovery", [] {
+      return std::unique_ptr<NamingService>(new DiscoveryNamingService);
+    });
+    // nacos://host:port/serviceName=x — the Nacos instance/list dialect
+    // (cluster/nacos_naming.h; reference nacos_naming_service.cpp).
+    RegisterNamingService("nacos", [] {
+      return std::unique_ptr<NamingService>(new NacosNamingService);
     });
   });
 }

@@ -371,6 +371,24 @@ int MemcacheCut(IOPortal* in, void*, uint64_t, ClientReply* out) {
   return 0;
 }
 
+// ---- nshead (36-byte head, magic 0xfb709394, body_len at offset 32) —
+// carries the whole legacy family (ubrpc/nova/public_pbrpc/
+// nshead_mcpack); veneers in rpc/ubrpc.cc pre-frame requests and strip
+// response heads ----
+
+int NsheadCut(IOPortal* in, void*, uint64_t, ClientReply* out) {
+  if (in->size() < 36) return EAGAIN;
+  uint8_t hdr[36];
+  in->copy_to(hdr, 36);
+  uint32_t magic, body_len;
+  memcpy(&magic, hdr + 24, 4);
+  memcpy(&body_len, hdr + 32, 4);
+  if (magic != 0xfb709394 || body_len > (64u << 20)) return EBADMSG;
+  if (in->size() < 36 + size_t(body_len)) return EAGAIN;
+  in->cutn(&out->body, 36 + size_t(body_len));  // head kept for veneers
+  return 0;
+}
+
 // ---- mongo OP_MSG (little-endian length-prefixed) ----
 
 int MongoCut(IOPortal* in, void*, uint64_t, ClientReply* out) {
@@ -405,6 +423,11 @@ const ClientProtocol kMongoClient = {
     "mongo", /*pipelined_safe=*/false, PassthroughPack, MongoCut, nullptr,
     nullptr, nullptr,
 };
+const ClientProtocol kNsheadClient = {
+    // Strictly ordered request/reply on legacy servers: pipelining holds.
+    "nshead", /*pipelined_safe=*/true, PassthroughPack, NsheadCut, nullptr,
+    nullptr, nullptr,
+};
 
 }  // namespace
 
@@ -416,6 +439,7 @@ void RegisterBuiltinClientProtocols() {
     RegisterClientProtocol(&kThriftClient);
     RegisterClientProtocol(&kMemcacheClient);
     RegisterClientProtocol(&kMongoClient);
+    RegisterClientProtocol(&kNsheadClient);
   });
 }
 

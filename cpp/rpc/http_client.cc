@@ -113,6 +113,22 @@ TlsContext* DefaultClientTls() {
   return ctx;
 }
 
+std::string UrlEscape(const std::string& in) {
+  static const char hex[] = "0123456789ABCDEF";
+  std::string out;
+  out.reserve(in.size());
+  for (unsigned char c : in) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
+      out.push_back(char(c));
+    } else {
+      out.push_back('%');
+      out.push_back(hex[c >> 4]);
+      out.push_back(hex[c & 0xf]);
+    }
+  }
+  return out;
+}
+
 void FetchCancel::Cancel() {
   cancelled.store(true, std::memory_order_seq_cst);
   const SocketId s = sid.load(std::memory_order_seq_cst);

@@ -41,6 +41,11 @@ int HttpFetch(const EndPoint& server, const std::string& method,
               int64_t timeout_ms = 5000, bool use_tls = false,
               FetchCancel* cancel = nullptr);
 
+// Percent-encodes a query/form VALUE (RFC 3986 unreserved set kept) —
+// credentials and service names with '&', '=', '%', '+' must not corrupt
+// the x-www-form-urlencoded bodies the NS dialects post.
+std::string UrlEscape(const std::string& in);
+
 inline int HttpGet(const EndPoint& server, const std::string& path,
                    HttpClientResult* out, int64_t timeout_ms = 5000) {
   return HttpFetch(server, "GET", path, "", "", out, timeout_ms);

@@ -1,0 +1,378 @@
+#include "rpc/mcpack.h"
+
+#include <cstring>
+
+namespace brt {
+
+namespace {
+
+// Field type bytes (reference src/mcpack2pb/field_type.h).
+constexpr uint8_t kObject = 0x10;
+constexpr uint8_t kArray = 0x20;
+constexpr uint8_t kIsoArray = 0x30;
+constexpr uint8_t kString = 0x50;
+constexpr uint8_t kBinary = 0x60;
+constexpr uint8_t kInt8 = 0x11;
+constexpr uint8_t kInt16 = 0x12;
+constexpr uint8_t kInt32 = 0x14;
+constexpr uint8_t kInt64 = 0x18;
+constexpr uint8_t kUint8 = 0x21;
+constexpr uint8_t kUint16 = 0x22;
+constexpr uint8_t kUint32 = 0x24;
+constexpr uint8_t kUint64 = 0x28;
+constexpr uint8_t kBool = 0x31;
+constexpr uint8_t kFloat = 0x44;
+constexpr uint8_t kDouble = 0x48;
+constexpr uint8_t kNull = 0x61;
+constexpr uint8_t kShortMask = 0x80;
+constexpr uint8_t kFixedMask = 0x0f;
+constexpr int kMaxDepth = 128;
+
+// ---------------------------------------------------------------------------
+// Encoder: build into a std::string (sizes of nested containers are only
+// known after encoding their items — long heads are patched in place).
+// ---------------------------------------------------------------------------
+
+void put_le(std::string* out, const void* p, size_t n) {
+  out->append(static_cast<const char*>(p), n);  // x86/LE host
+}
+
+// name as counted-with-NUL ('' => name_size 0, array items).
+void put_name(std::string* out, const std::string& name) {
+  if (!name.empty()) {
+    out->append(name);
+    out->push_back('\0');
+  }
+}
+
+uint8_t name_size(const std::string& name) {
+  return name.empty() ? 0 : uint8_t(name.size() + 1);
+}
+
+bool EncodeField(const JsonValue& v, const std::string& name,
+                 std::string* out, int depth) {
+  if (depth > kMaxDepth || name.size() > 254) return false;
+  switch (v.type) {
+    case JsonValue::Type::kNull: {
+      out->push_back(char(kNull));
+      out->push_back(char(name_size(name)));
+      put_name(out, name);
+      out->push_back('\0');
+      return true;
+    }
+    case JsonValue::Type::kBool: {
+      out->push_back(char(kBool));
+      out->push_back(char(name_size(name)));
+      put_name(out, name);
+      out->push_back(v.b ? 1 : 0);
+      return true;
+    }
+    case JsonValue::Type::kInt: {
+      out->push_back(char(kInt64));
+      out->push_back(char(name_size(name)));
+      put_name(out, name);
+      put_le(out, &v.i, 8);
+      return true;
+    }
+    case JsonValue::Type::kDouble: {
+      out->push_back(char(kDouble));
+      out->push_back(char(name_size(name)));
+      put_name(out, name);
+      put_le(out, &v.d, 8);
+      return true;
+    }
+    case JsonValue::Type::kString: {
+      // value = string bytes + NUL, counted in value_size.
+      const uint32_t vs = uint32_t(v.str.size() + 1);
+      if (vs <= 255) {
+        out->push_back(char(kString | kShortMask));
+        out->push_back(char(name_size(name)));
+        out->push_back(char(uint8_t(vs)));
+      } else {
+        out->push_back(char(kString));
+        out->push_back(char(name_size(name)));
+        put_le(out, &vs, 4);
+      }
+      put_name(out, name);
+      out->append(v.str);
+      out->push_back('\0');
+      return true;
+    }
+    case JsonValue::Type::kObject:
+    case JsonValue::Type::kArray: {
+      const bool obj = v.type == JsonValue::Type::kObject;
+      out->push_back(char(obj ? kObject : kArray));
+      out->push_back(char(name_size(name)));
+      const size_t size_pos = out->size();
+      uint32_t placeholder = 0;
+      put_le(out, &placeholder, 4);  // value_size, patched below
+      put_name(out, name);
+      const size_t value_pos = out->size();
+      const uint32_t count =
+          uint32_t(obj ? v.members.size() : v.elems.size());
+      put_le(out, &count, 4);  // ItemsHead
+      if (obj) {
+        for (const auto& [k, m] : v.members) {
+          if (k.empty() || !EncodeField(m, k, out, depth + 1)) return false;
+        }
+      } else {
+        for (const JsonValue& e : v.elems) {
+          if (!EncodeField(e, "", out, depth + 1)) return false;
+        }
+      }
+      const uint32_t vs = uint32_t(out->size() - value_pos);
+      memcpy(out->data() + size_pos, &vs, 4);
+      return true;
+    }
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------------------
+// Decoder
+// ---------------------------------------------------------------------------
+
+struct Cursor {
+  const uint8_t* p;
+  size_t n;
+  size_t off = 0;
+
+  bool take(void* out, size_t k) {
+    if (off + k > n) return false;
+    memcpy(out, p + off, k);
+    off += k;
+    return true;
+  }
+  bool skip(size_t k) {
+    if (off + k > n) return false;
+    off += k;
+    return true;
+  }
+};
+
+bool DecodeField(Cursor* c, JsonValue* out, std::string* name,
+                 std::string* err, int depth);
+
+bool DecodeItems(Cursor* c, JsonValue* out, bool obj, size_t end,
+                 std::string* err, int depth) {
+  uint32_t count = 0;
+  if (!c->take(&count, 4)) return false;
+  if (count > 16u << 20) {
+    *err = "mcpack: absurd item count";
+    return false;
+  }
+  for (uint32_t i = 0; i < count && c->off < end; ++i) {
+    JsonValue item;
+    std::string iname;
+    if (!DecodeField(c, &item, &iname, err, depth + 1)) return false;
+    if (obj) {
+      out->members.emplace_back(std::move(iname), std::move(item));
+    } else {
+      out->elems.push_back(std::move(item));
+    }
+  }
+  return true;
+}
+
+bool DecodeField(Cursor* c, JsonValue* out, std::string* name,
+                 std::string* err, int depth) {
+  if (depth > kMaxDepth) {
+    *err = "mcpack: too deep";
+    return false;
+  }
+  uint8_t type = 0, nsz = 0;
+  if (!c->take(&type, 1) || !c->take(&nsz, 1)) {
+    *err = "mcpack: truncated head";
+    return false;
+  }
+  uint32_t vsz = 0;
+  const uint8_t base = type & ~kShortMask;
+  const bool fixed = (type & kFixedMask) != 0 && base != kNull;
+  if (!fixed || base == kString || base == kBinary) {
+    if (type & kShortMask) {
+      uint8_t s = 0;
+      if (!c->take(&s, 1)) return false;
+      vsz = s;
+    } else if (base == kString || base == kBinary || base == kObject ||
+               base == kArray || base == kIsoArray) {
+      if (!c->take(&vsz, 4)) {
+        *err = "mcpack: truncated long head";
+        return false;
+      }
+    }
+  }
+  // Name (NUL included in nsz).
+  if (nsz > 0) {
+    if (c->off + nsz > c->n) {
+      *err = "mcpack: truncated name";
+      return false;
+    }
+    name->assign(reinterpret_cast<const char*>(c->p + c->off), nsz - 1);
+    c->skip(nsz);
+  } else {
+    name->clear();
+  }
+  switch (base) {
+    case kNull:
+      *out = JsonValue::Null();
+      return c->skip(1);
+    case kBool: {
+      uint8_t b = 0;
+      if (!c->take(&b, 1)) return false;
+      *out = JsonValue::Bool(b != 0);
+      return true;
+    }
+    case kString & ~kShortMask:  // 0x50 family (string)
+    {
+      if (vsz == 0 || c->off + vsz > c->n) {
+        *err = "mcpack: truncated string";
+        return false;
+      }
+      *out = JsonValue::String(std::string(
+          reinterpret_cast<const char*>(c->p + c->off), vsz - 1));
+      return c->skip(vsz);
+    }
+    case kBinary & ~kShortMask: {
+      if (c->off + vsz > c->n) {
+        *err = "mcpack: truncated binary";
+        return false;
+      }
+      *out = JsonValue::String(std::string(
+          reinterpret_cast<const char*>(c->p + c->off), vsz));
+      return c->skip(vsz);
+    }
+    case kObject:
+    case kArray: {
+      if (c->off + vsz > c->n) {
+        *err = "mcpack: truncated container";
+        return false;
+      }
+      const size_t end = c->off + vsz;
+      out->type = base == kObject ? JsonValue::Type::kObject
+                                  : JsonValue::Type::kArray;
+      if (!DecodeItems(c, out, base == kObject, end, err, depth)) {
+        return false;
+      }
+      if (c->off > end) {
+        *err = "mcpack: container overrun";
+        return false;
+      }
+      c->off = end;  // tolerate deleted/unknown trailing fields
+      return true;
+    }
+    case kIsoArray: {
+      // | u8 elem_type | items... | — decode to a plain array.
+      if (vsz < 1 || c->off + vsz > c->n) {
+        *err = "mcpack: truncated isoarray";
+        return false;
+      }
+      const size_t end = c->off + vsz;
+      uint8_t et = 0;
+      c->take(&et, 1);
+      const size_t esz = et & kFixedMask;
+      out->type = JsonValue::Type::kArray;
+      if (esz > 8) {
+        // The low nibble can claim up to 15 "value bytes" but no real
+        // primitive is wider than 8 — copying more would overflow the
+        // fixed-width element buffers below.
+        *err = "mcpack: bad isoarray element type";
+        return false;
+      }
+      if (esz == 0) {
+        c->off = end;
+        return true;
+      }
+      while (c->off + esz <= end) {
+        int64_t iv = 0;
+        double dv = 0;
+        if (et == kFloat) {
+          float f = 0;
+          c->take(&f, 4);
+          out->elems.push_back(JsonValue::Double(f));
+        } else if (et == kDouble) {
+          c->take(&dv, 8);
+          out->elems.push_back(JsonValue::Double(dv));
+        } else {
+          c->take(&iv, esz);  // LE: low bytes land correctly
+          if (et == kInt8) iv = int8_t(iv);
+          if (et == kInt16) iv = int16_t(iv);
+          if (et == kInt32) iv = int32_t(iv);
+          out->elems.push_back(JsonValue::Int(iv));
+        }
+      }
+      c->off = end;
+      return true;
+    }
+    default: {
+      // Fixed-width primitives.
+      const size_t k = type & kFixedMask;
+      if (k == 0 || k > 8) {
+        *err = "mcpack: unknown field type";
+        return false;
+      }
+      uint64_t raw = 0;
+      if (!c->take(&raw, k)) {
+        *err = "mcpack: truncated primitive";
+        return false;
+      }
+      switch (type) {
+        case kInt8: *out = JsonValue::Int(int8_t(raw)); return true;
+        case kInt16: *out = JsonValue::Int(int16_t(raw)); return true;
+        case kInt32: *out = JsonValue::Int(int32_t(raw)); return true;
+        case kInt64: *out = JsonValue::Int(int64_t(raw)); return true;
+        case kUint8:
+        case kUint16:
+        case kUint32: *out = JsonValue::Int(int64_t(raw)); return true;
+        case kUint64:
+          if (raw > uint64_t(INT64_MAX)) {
+            *out = JsonValue::Double(double(raw));
+          } else {
+            *out = JsonValue::Int(int64_t(raw));
+          }
+          return true;
+        case kFloat: {
+          float f;
+          memcpy(&f, &raw, 4);
+          *out = JsonValue::Double(f);
+          return true;
+        }
+        case kDouble: {
+          double d;
+          memcpy(&d, &raw, 8);
+          *out = JsonValue::Double(d);
+          return true;
+        }
+        default:
+          // Unknown-but-sized: skip (forward compatibility, reference
+          // parser.cpp skips deleted fields the same way).
+          *out = JsonValue::Null();
+          return true;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+bool McpackEncode(const JsonValue& v, IOBuf* out) {
+  if (v.type != JsonValue::Type::kObject) return false;
+  std::string buf;
+  if (!EncodeField(v, "", &buf, 0)) return false;
+  out->append(buf);
+  return true;
+}
+
+bool McpackDecode(const void* data, size_t n, JsonValue* out,
+                  std::string* err) {
+  Cursor c{static_cast<const uint8_t*>(data), n};
+  std::string name;
+  *out = JsonValue();
+  if (!DecodeField(&c, out, &name, err, 0)) return false;
+  if (out->type != JsonValue::Type::kObject) {
+    *err = "mcpack: top-level value is not an object";
+    return false;
+  }
+  return true;
+}
+
+}  // namespace brt

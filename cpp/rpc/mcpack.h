@@ -1,0 +1,33 @@
+// mcpack v2 binary codec over JsonValue — the compack/mcpack analog for
+// the ubrpc/nshead_mcpack legacy family.
+// Parity target: reference src/mcpack2pb/{field_type.h,serializer.cpp,
+// parser.cpp} — field heads (fixed: type+name_size; short: +value_size u8
+// for strings<=254/binary<=255; long: +value_size u32), NUL-terminated
+// names counted in name_size, array items unnamed (name_size 0),
+// OBJECT/ARRAY values = ItemsHead(item_count u32) + items, little-endian
+// primitives, depth capped at 128. Redesigned: the reference couples the
+// codec to protobuf messages via generated handlers (mcpack2pb); this
+// framework is pb-free, so the codec maps to the universal JsonValue the
+// json/bson/amf0 codecs already share.
+#pragma once
+
+#include <string>
+
+#include "base/iobuf.h"
+#include "rpc/json.h"
+
+namespace brt {
+
+// Serializes `v` (must be kObject — mcpack documents are objects) as one
+// unnamed top-level OBJECT field. False on unsupported shape.
+bool McpackEncode(const JsonValue& v, IOBuf* out);
+
+// Parses one top-level mcpack OBJECT from data[0, n). kInt absorbs every
+// integer width/signedness (uint64 overflowing int64 decodes as double,
+// matching JsonValue's integer model); FIELD_BINARY decodes as kString;
+// isomorphic arrays decode as plain kArray. False with *err on malformed
+// or >128-deep input.
+bool McpackDecode(const void* data, size_t n, JsonValue* out,
+                  std::string* err);
+
+}  // namespace brt

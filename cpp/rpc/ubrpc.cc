@@ -1,0 +1,600 @@
+#include "rpc/ubrpc.h"
+
+#include <cstring>
+#include <map>
+#include <mutex>
+
+#include "base/logging.h"
+#include "fiber/sync.h"
+#include "rpc/channel.h"
+#include "rpc/controller.h"
+#include "rpc/errors.h"
+#include "rpc/mcpack.h"
+#include "rpc/server.h"
+
+namespace brt {
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Minimal protobuf wire helpers for the public_pbrpc envelope (proto2
+// messages in reference policy/public_pbrpc_meta.proto; this build is
+// pb-free so the few fields used are coded by hand).
+// ---------------------------------------------------------------------------
+
+void pb_varint(std::string* out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(char(v | 0x80));
+    v >>= 7;
+  }
+  out->push_back(char(v));
+}
+
+void pb_tag(std::string* out, int field, int wire) {
+  pb_varint(out, uint64_t(field) << 3 | wire);
+}
+
+void pb_u64(std::string* out, int field, uint64_t v) {
+  pb_tag(out, field, 0);
+  pb_varint(out, v);
+}
+
+void pb_sint32(std::string* out, int field, int32_t v) {
+  pb_tag(out, field, 0);
+  pb_varint(out, uint64_t((uint32_t(v) << 1) ^ uint32_t(v >> 31)));
+}
+
+void pb_bytes(std::string* out, int field, const std::string& s) {
+  pb_tag(out, field, 2);
+  pb_varint(out, s.size());
+  out->append(s);
+}
+
+struct PbCursor {
+  const char* p;
+  size_t n;
+  size_t off = 0;
+
+  bool varint(uint64_t* v) {
+    *v = 0;
+    for (int shift = 0; shift < 64 && off < n; shift += 7) {
+      const uint8_t b = uint8_t(p[off++]);
+      *v |= uint64_t(b & 0x7f) << shift;
+      if (!(b & 0x80)) return true;
+    }
+    return false;
+  }
+  bool bytes(std::string* s) {
+    uint64_t len;
+    // `len > n - off`, not `off + len > n`: an attacker-controlled
+    // full-range varint could wrap the sum past the bound.
+    if (!varint(&len) || off > n || len > n - off) return false;
+    s->assign(p + off, size_t(len));
+    off += size_t(len);
+    return true;
+  }
+  bool skip(int wire) {
+    uint64_t v;
+    std::string s;
+    switch (wire) {
+      case 0: return varint(&v);
+      case 2: return bytes(&s);
+      case 5: off += 4; return off <= n;
+      case 1: off += 8; return off <= n;
+      default: return false;
+    }
+  }
+};
+
+// head/body walker shared by request and response decode: calls cb(field,
+// wire, cursor) for each field of the submessage.
+template <typename Fn>
+bool pb_walk(const std::string& msg, Fn&& cb) {
+  PbCursor c{msg.data(), msg.size()};
+  while (c.off < c.n) {
+    uint64_t key;
+    if (!c.varint(&key)) return false;
+    if (!cb(int(key >> 3), int(key & 7), &c)) return false;
+  }
+  return true;
+}
+
+std::string iobuf_str(const IOBuf& b) { return b.to_string(); }
+
+}  // namespace
+
+void EncodePublicPbrpcRequest(const PublicPbrpcCall& c, IOBuf* out) {
+  std::string head;
+  pb_u64(&head, 7, c.log_id);  // RequestHead.log_id
+  std::string body;
+  pb_bytes(&body, 3, c.service);   // RequestBody.service
+  pb_u64(&body, 4, c.method_id);   // RequestBody.method_id
+  pb_u64(&body, 5, c.id);          // RequestBody.id
+  pb_bytes(&body, 6, c.payload);   // RequestBody.serialized_request
+  std::string msg;
+  pb_bytes(&msg, 1, head);  // PublicPbrpcRequest.requestHead
+  pb_bytes(&msg, 2, body);  // PublicPbrpcRequest.requestBody
+  out->append(msg);
+}
+
+bool DecodePublicPbrpcRequest(const IOBuf& in, PublicPbrpcCall* out) {
+  bool have_body = false;
+  const bool ok = pb_walk(
+      iobuf_str(in), [&](int field, int wire, PbCursor* c) {
+        std::string sub;
+        if (wire != 2 || !c->bytes(&sub)) return c->skip(wire);
+        if (field == 1) {  // requestHead
+          return pb_walk(sub, [&](int f, int w, PbCursor* cc) {
+            uint64_t v;
+            if (f == 7 && w == 0 && cc->varint(&v)) {
+              out->log_id = v;
+              return true;
+            }
+            return cc->skip(w);
+          });
+        }
+        if (field == 2) {  // requestBody
+          have_body = true;
+          return pb_walk(sub, [&](int f, int w, PbCursor* cc) {
+            uint64_t v;
+            switch (f) {
+              case 3: return cc->bytes(&out->service);
+              case 4:
+                if (!cc->varint(&v)) return false;
+                out->method_id = uint32_t(v);
+                return true;
+              case 5:
+                if (!cc->varint(&v)) return false;
+                out->id = v;
+                return true;
+              case 6: return cc->bytes(&out->payload);
+              default: return cc->skip(w);
+            }
+          });
+        }
+        return true;  // unknown submessage
+      });
+  return ok && have_body && !out->service.empty();
+}
+
+void EncodePublicPbrpcResponse(const PublicPbrpcCall& c, IOBuf* out) {
+  std::string head;
+  pb_sint32(&head, 1, c.code);  // ResponseHead.code (sint32)
+  if (!c.error_text.empty()) pb_bytes(&head, 2, c.error_text);
+  std::string body;
+  pb_bytes(&body, 1, c.payload);  // ResponseBody.serialized_response
+  pb_u64(&body, 4, c.id);         // ResponseBody.id
+  std::string msg;
+  pb_bytes(&msg, 1, head);
+  pb_bytes(&msg, 2, body);
+  out->append(msg);
+}
+
+bool DecodePublicPbrpcResponse(const IOBuf& in, PublicPbrpcCall* out) {
+  bool have_body = false;
+  const bool ok = pb_walk(
+      iobuf_str(in), [&](int field, int wire, PbCursor* c) {
+        std::string sub;
+        if (wire != 2 || !c->bytes(&sub)) return c->skip(wire);
+        if (field == 1) {
+          return pb_walk(sub, [&](int f, int w, PbCursor* cc) {
+            uint64_t v;
+            if (f == 1 && w == 0) {
+              if (!cc->varint(&v)) return false;
+              out->code = int32_t((v >> 1) ^ uint64_t(-int64_t(v & 1)));
+              return true;
+            }
+            if (f == 2) return cc->bytes(&out->error_text);
+            return cc->skip(w);
+          });
+        }
+        if (field == 2) {
+          have_body = true;
+          return pb_walk(sub, [&](int f, int w, PbCursor* cc) {
+            uint64_t v;
+            switch (f) {
+              case 1: return cc->bytes(&out->payload);
+              case 4:
+                if (!cc->varint(&v)) return false;
+                out->id = v;
+                return true;
+              default: return cc->skip(w);
+            }
+          });
+        }
+        return true;
+      });
+  return ok && have_body;
+}
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Shared bits for adaptors: synchronous bridge into the (async) Service
+// registry. Runs in a processing fiber — parking is fine.
+// ---------------------------------------------------------------------------
+
+int CallServiceSync(Server* server, Service* svc, const std::string& method,
+                    const IOBuf& request, IOBuf* response,
+                    std::string* error_text) {
+  Controller cntl;
+  CountdownEvent done(1);
+  svc->CallMethod(method, &cntl, request, response, [&done] { done.signal(); });
+  done.wait(-1);
+  (void)server;
+  if (cntl.Failed()) {
+    *error_text = cntl.ErrorText();
+    return cntl.ErrorCode();
+  }
+  return 0;
+}
+
+const JsonValue* FindMember(const JsonValue& obj, const char* key) {
+  return obj.type == JsonValue::Type::kObject ? obj.member(key) : nullptr;
+}
+
+// ---- ubrpc adaptor ----
+
+class UbrpcAdaptor : public NsheadService {
+ public:
+  explicit UbrpcAdaptor(Server* s) : server_(s) {}
+
+  void ProcessNsheadRequest(const NsheadHead&, const IOBuf& body,
+                            IOBuf* response_body) override {
+    JsonValue doc;
+    std::string err;
+    int64_t id = 0;
+    const std::string raw = body.to_string();
+    if (!McpackDecode(raw.data(), raw.size(), &doc, &err)) {
+      return Error(id, EREQUEST, "bad mcpack: " + err, response_body);
+    }
+    const JsonValue* content = FindMember(doc, "content");
+    if (content == nullptr || content->type != JsonValue::Type::kArray ||
+        content->elems.empty()) {
+      return Error(id, EREQUEST, "missing request.content", response_body);
+    }
+    const JsonValue& c0 = content->elems[0];
+    const JsonValue* svc_name = FindMember(c0, "service_name");
+    const JsonValue* method = FindMember(c0, "method");
+    const JsonValue* idv = FindMember(c0, "id");
+    const JsonValue* params = FindMember(c0, "params");
+    if (idv != nullptr && idv->type == JsonValue::Type::kInt) id = idv->i;
+    if (svc_name == nullptr || method == nullptr ||
+        svc_name->type != JsonValue::Type::kString ||
+        method->type != JsonValue::Type::kString) {
+      return Error(id, EREQUEST, "missing service_name/method",
+                   response_body);
+    }
+    if (params == nullptr || params->type != JsonValue::Type::kObject) {
+      return Error(id, EREQUEST, "missing params", response_body);
+    }
+    Service* svc = server_->FindService(svc_name->str);
+    if (svc == nullptr) {
+      return Error(id, ENOSERVICE, "service not found", response_body);
+    }
+    IOBuf req, rsp;
+    JsonSerialize(*params, &req);
+    std::string etext;
+    const int rc = CallServiceSync(server_, svc, method->str, req, &rsp,
+                                   &etext);
+    if (rc != 0) return Error(id, rc, etext, response_body);
+    // The service answers JSON (the same bridge the restful tier uses);
+    // non-JSON answers ride as {"raw": <bytes>}.
+    JsonValue result;
+    std::string perr;
+    if (!JsonParse(rsp.to_string(), &result, &perr) ||
+        result.type != JsonValue::Type::kObject) {
+      result = JsonValue::Object();
+      result.members.emplace_back("raw", JsonValue::String(rsp.to_string()));
+    }
+    JsonValue env = JsonValue::Object();
+    JsonValue item = JsonValue::Object();
+    item.members.emplace_back("id", JsonValue::Int(id));
+    item.members.emplace_back("result_params", std::move(result));
+    JsonValue arr = JsonValue::Array();
+    arr.elems.push_back(std::move(item));
+    env.members.emplace_back("content", std::move(arr));
+    McpackEncode(env, response_body);
+  }
+
+ private:
+  static void Error(int64_t id, int code, const std::string& msg,
+                    IOBuf* out) {
+    // reference AppendError (ubrpc2pb_protocol.cpp:185):
+    // {"content":[{id, error:{code,message}}]}.
+    JsonValue e = JsonValue::Object();
+    e.members.emplace_back("code", JsonValue::Int(code));
+    e.members.emplace_back("message", JsonValue::String(msg));
+    JsonValue item = JsonValue::Object();
+    item.members.emplace_back("id", JsonValue::Int(id));
+    item.members.emplace_back("error", std::move(e));
+    JsonValue arr = JsonValue::Array();
+    arr.elems.push_back(std::move(item));
+    JsonValue env = JsonValue::Object();
+    env.members.emplace_back("content", std::move(arr));
+    McpackEncode(env, out);
+  }
+
+  Server* server_;
+};
+
+// ---- nova adaptor ----
+
+class NovaAdaptor : public NsheadService {
+ public:
+  NovaAdaptor(Server* s, Service* svc, std::vector<std::string> methods)
+      : server_(s), svc_(svc), methods_(std::move(methods)) {}
+
+  void ProcessNsheadRequest(const NsheadHead& head, const IOBuf& body,
+                            IOBuf* response_body) override {
+    const uint32_t idx = head.reserved;  // method INDEX (nova contract)
+    if (idx >= methods_.size()) return;  // nova cannot signal failure
+    std::string etext;
+    (void)CallServiceSync(server_, svc_, methods_[idx], body, response_body,
+                          &etext);
+  }
+
+ private:
+  Server* server_;
+  Service* svc_;
+  std::vector<std::string> methods_;
+};
+
+// ---- public_pbrpc adaptor ----
+
+class PublicPbrpcAdaptor : public NsheadService {
+ public:
+  PublicPbrpcAdaptor(Server* s, std::vector<std::string> methods)
+      : server_(s), methods_(std::move(methods)) {}
+
+  void ProcessNsheadRequest(const NsheadHead&, const IOBuf& body,
+                            IOBuf* response_body) override {
+    PublicPbrpcCall call;
+    PublicPbrpcCall reply;
+    if (!DecodePublicPbrpcRequest(body, &call)) {
+      reply.code = EREQUEST;
+      reply.error_text = "cannot parse PublicPbrpcRequest";
+      EncodePublicPbrpcResponse(reply, response_body);
+      return;
+    }
+    reply.id = call.id;
+    Service* svc = server_->FindService(call.service);
+    if (svc == nullptr || call.method_id >= methods_.size()) {
+      reply.code = svc == nullptr ? ENOSERVICE : ENOMETHOD;
+      reply.error_text = RpcErrorText(reply.code);
+      EncodePublicPbrpcResponse(reply, response_body);
+      return;
+    }
+    IOBuf req, rsp;
+    req.append(call.payload);
+    std::string etext;
+    const int rc = CallServiceSync(server_, svc, methods_[call.method_id],
+                                   req, &rsp, &etext);
+    if (rc != 0) {
+      reply.code = rc;
+      reply.error_text = etext;
+    } else {
+      reply.payload = rsp.to_string();
+    }
+    EncodePublicPbrpcResponse(reply, response_body);
+  }
+
+ private:
+  Server* server_;
+  std::vector<std::string> methods_;
+};
+
+// ---- nshead_mcpack adaptor ----
+
+class McpackAdaptor : public NsheadService {
+ public:
+  explicit McpackAdaptor(NsheadMcpackHandler h) : handler_(h) {}
+
+  void ProcessNsheadRequest(const NsheadHead&, const IOBuf& body,
+                            IOBuf* response_body) override {
+    JsonValue doc;
+    std::string err;
+    const std::string raw = body.to_string();
+    if (!McpackDecode(raw.data(), raw.size(), &doc, &err)) {
+      JsonValue e = JsonValue::Object();
+      e.members.emplace_back("error_code", JsonValue::Int(EREQUEST));
+      e.members.emplace_back("error_text", JsonValue::String(err));
+      McpackEncode(e, response_body);
+      return;
+    }
+    JsonValue out = handler_(doc);
+    if (out.type != JsonValue::Type::kObject) out = JsonValue::Object();
+    McpackEncode(out, response_body);
+  }
+
+ private:
+  NsheadMcpackHandler handler_;
+};
+
+// ---------------------------------------------------------------------------
+// Client plumbing shared by the four veneers.
+// ---------------------------------------------------------------------------
+
+struct NsheadChannel {
+  Channel channel;
+
+  int Init(const EndPoint& server, int64_t timeout_ms) {
+    ChannelOptions opts;
+    opts.protocol = "nshead";
+    opts.timeout_ms = timeout_ms;
+    opts.max_retry = 0;  // legacy dialects carry no idempotency promise
+    return channel.Init(server, &opts);
+  }
+
+  // Frames body under `head` and exchanges one nshead round trip;
+  // *rsp_body receives the RESPONSE body (head stripped).
+  int Call(NsheadHead head, const IOBuf& body, IOBuf* rsp_body) {
+    head.body_len = uint32_t(body.size());
+    IOBuf frame;
+    frame.append(&head, sizeof(head));
+    frame.append(body);
+    Controller cntl;
+    IOBuf raw;
+    channel.CallMethod("", "", &cntl, frame, &raw, nullptr);
+    if (cntl.Failed()) return cntl.ErrorCode();
+    if (raw.size() < sizeof(NsheadHead)) return EBADMSG;
+    raw.pop_front(sizeof(NsheadHead));
+    *rsp_body = std::move(raw);
+    return 0;
+  }
+};
+
+}  // namespace
+
+void ServeUbrpcOn(Server* server) {
+  ServeNsheadOn(server, new UbrpcAdaptor(server));  // leaked: lives with
+                                                    // the process
+}
+
+void ServeNovaOn(Server* server, Service* service,
+                 std::vector<std::string> methods) {
+  ServeNsheadOn(server, new NovaAdaptor(server, service, std::move(methods)));
+}
+
+void ServePublicPbrpcOn(Server* server, std::vector<std::string> methods) {
+  ServeNsheadOn(server, new PublicPbrpcAdaptor(server, std::move(methods)));
+}
+
+void ServeNsheadMcpackOn(Server* server, NsheadMcpackHandler handler) {
+  ServeNsheadOn(server, new McpackAdaptor(handler));
+}
+
+// ---------------------------------------------------------------------------
+// Veneer clients
+// ---------------------------------------------------------------------------
+
+struct UbrpcClient::Impl : NsheadChannel {
+  int64_t next_id = 1;
+};
+
+UbrpcClient::UbrpcClient() : impl_(new Impl) {}
+UbrpcClient::~UbrpcClient() = default;
+
+int UbrpcClient::Init(const std::string& addr, int64_t timeout_ms) {
+  EndPoint ep;
+  if (!EndPoint::parse(addr, &ep)) return EINVAL;
+  return Init(ep, timeout_ms);
+}
+
+int UbrpcClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  return impl_->Init(server, timeout_ms);
+}
+
+int UbrpcClient::Call(const std::string& service, const std::string& method,
+                      const JsonValue& params, JsonValue* result) {
+  if (params.type != JsonValue::Type::kObject) return EINVAL;
+  JsonValue item = JsonValue::Object();
+  item.members.emplace_back("service_name", JsonValue::String(service));
+  item.members.emplace_back("method", JsonValue::String(method));
+  item.members.emplace_back("id", JsonValue::Int(impl_->next_id++));
+  item.members.emplace_back("params", params);
+  JsonValue arr = JsonValue::Array();
+  arr.elems.push_back(std::move(item));
+  JsonValue env = JsonValue::Object();
+  env.members.emplace_back("content", std::move(arr));
+  IOBuf body;
+  if (!McpackEncode(env, &body)) return EINVAL;
+  NsheadHead head;
+  snprintf(head.provider, sizeof(head.provider), "ubrpc");
+  IOBuf rsp;
+  const int rc = impl_->Call(head, body, &rsp);
+  if (rc != 0) return rc;
+  JsonValue doc;
+  std::string err;
+  const std::string raw = rsp.to_string();
+  if (!McpackDecode(raw.data(), raw.size(), &doc, &err)) return EBADMSG;
+  const JsonValue* content = FindMember(doc, "content");
+  if (content == nullptr || content->type != JsonValue::Type::kArray ||
+      content->elems.empty()) {
+    return EBADMSG;
+  }
+  const JsonValue& c0 = content->elems[0];
+  if (const JsonValue* e = FindMember(c0, "error")) {
+    const JsonValue* code = FindMember(*e, "code");
+    return code != nullptr && code->type == JsonValue::Type::kInt
+               ? int(code->i)
+               : EINTERNAL;
+  }
+  if (const JsonValue* rp = FindMember(c0, "result_params")) {
+    *result = *rp;
+    return 0;
+  }
+  return EBADMSG;
+}
+
+struct NovaClient::Impl : NsheadChannel {};
+
+NovaClient::NovaClient() : impl_(new Impl) {}
+NovaClient::~NovaClient() = default;
+
+int NovaClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  return impl_->Init(server, timeout_ms);
+}
+
+int NovaClient::Call(int method_index, const IOBuf& request,
+                     IOBuf* response) {
+  NsheadHead head;
+  head.reserved = uint32_t(method_index);
+  return impl_->Call(head, request, response);
+}
+
+struct PublicPbrpcClient::Impl : NsheadChannel {
+  uint64_t next_id = 1;
+};
+
+PublicPbrpcClient::PublicPbrpcClient() : impl_(new Impl) {}
+PublicPbrpcClient::~PublicPbrpcClient() = default;
+
+int PublicPbrpcClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  return impl_->Init(server, timeout_ms);
+}
+
+int PublicPbrpcClient::Call(const std::string& service, uint32_t method_id,
+                            const IOBuf& request, IOBuf* response) {
+  PublicPbrpcCall call;
+  call.service = service;
+  call.method_id = method_id;
+  call.id = impl_->next_id++;
+  call.payload = request.to_string();
+  IOBuf body;
+  EncodePublicPbrpcRequest(call, &body);
+  NsheadHead head;
+  head.version = 1000;  // reference NSHEAD_VERSION
+  snprintf(head.provider, sizeof(head.provider), "public_pbrpc");
+  IOBuf rsp;
+  const int rc = impl_->Call(head, body, &rsp);
+  if (rc != 0) return rc;
+  PublicPbrpcCall reply;
+  if (!DecodePublicPbrpcResponse(rsp, &reply)) return EBADMSG;
+  if (reply.code != 0) return reply.code;
+  response->append(reply.payload);
+  return 0;
+}
+
+struct NsheadMcpackClient::Impl : NsheadChannel {};
+
+NsheadMcpackClient::NsheadMcpackClient() : impl_(new Impl) {}
+NsheadMcpackClient::~NsheadMcpackClient() = default;
+
+int NsheadMcpackClient::Init(const EndPoint& server, int64_t timeout_ms) {
+  return impl_->Init(server, timeout_ms);
+}
+
+int NsheadMcpackClient::Call(const JsonValue& request, JsonValue* response) {
+  IOBuf body;
+  if (!McpackEncode(request, &body)) return EINVAL;
+  NsheadHead head;
+  IOBuf rsp;
+  const int rc = impl_->Call(head, body, &rsp);
+  if (rc != 0) return rc;
+  std::string err;
+  const std::string raw = rsp.to_string();
+  return McpackDecode(raw.data(), raw.size(), response, &err) ? 0 : EBADMSG;
+}
+
+}  // namespace brt

@@ -1,0 +1,134 @@
+// The ubrpc / nova_pbrpc / public_pbrpc / nshead_mcpack legacy family:
+// four nshead-framed RPC dialects served as adaptors over the nshead
+// admission (exactly the reference's ServerOptions.nshead_service
+// design) and spoken client-side through the protocol-polymorphic
+// Channel (protocol="nshead"), so NS/LB/circuit-breaking apply.
+// Parity targets:
+//   ubrpc        — reference src/brpc/policy/ubrpc2pb_protocol.cpp:
+//                  body = mcpack {"content":[{service_name, method, id,
+//                  params{...}}]}; response {"content":[{id,
+//                  result_params{...}}]} or {"content":[{id,
+//                  error{code,message}}]}.
+//   nova_pbrpc   — policy/nova_pbrpc_protocol.cpp: nshead.reserved is
+//                  the method INDEX into one service; body is the raw
+//                  (pb) payload, opaque to the framework.
+//   public_pbrpc — policy/public_pbrpc_protocol.cpp + _meta.proto: body
+//                  is a PublicPbrpcRequest/Response protobuf envelope
+//                  (hand-rolled wire codec here — this build is pb-free)
+//                  carrying service / method_id / correlation id /
+//                  serialized payload.
+//   nshead_mcpack— policy/nshead_mcpack_protocol.cpp: body is one
+//                  mcpack document; a single handler per server.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include "rpc/json.h"
+#include "rpc/legacy.h"
+
+namespace brt {
+
+class Server;
+class Service;
+
+// ---- server adaptors (one nshead dialect per server; they claim the
+// server's nshead traffic via ServeNsheadOn under the hood) ----
+
+// Routes content[0].service_name/method through the server's Service
+// registry: the service sees JSON-serialized params as its request and
+// answers JSON, which returns as mcpack result_params.
+void ServeUbrpcOn(Server* server);
+
+// One service; nshead.reserved indexes into `methods`. Body passes
+// through untouched both ways (reference nova semantics: no meta).
+void ServeNovaOn(Server* server, Service* service,
+                 std::vector<std::string> methods);
+
+// Routes requestBody.service + method_id (index into `methods`) through
+// the server's Service registry; serialized_request/response pass
+// through opaque.
+void ServePublicPbrpcOn(Server* server, std::vector<std::string> methods);
+
+// One mcpack document in, one out.
+using NsheadMcpackHandler = JsonValue (*)(const JsonValue& request);
+void ServeNsheadMcpackOn(Server* server, NsheadMcpackHandler handler);
+
+// ---- clients (veneers over Channel protocol="nshead": FIFO-matched
+// frames with full timeout/retry/pooling semantics) ----
+
+class UbrpcClient {
+ public:
+  UbrpcClient();
+  ~UbrpcClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  int Init(const std::string& addr, int64_t timeout_ms = 1000);
+  // Calls service.method(params); *result receives result_params.
+  // Returns 0, a transport errno, or the server's error.code.
+  int Call(const std::string& service, const std::string& method,
+           const JsonValue& params, JsonValue* result);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+class NovaClient {
+ public:
+  NovaClient();
+  ~NovaClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  int Call(int method_index, const IOBuf& request, IOBuf* response);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+class PublicPbrpcClient {
+ public:
+  PublicPbrpcClient();
+  ~PublicPbrpcClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  // Returns 0, a transport errno, or the responseHead.code error.
+  int Call(const std::string& service, uint32_t method_id,
+           const IOBuf& request, IOBuf* response);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+class NsheadMcpackClient {
+ public:
+  NsheadMcpackClient();
+  ~NsheadMcpackClient();
+  int Init(const EndPoint& server, int64_t timeout_ms = 1000);
+  int Call(const JsonValue& request, JsonValue* response);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+// ---- wire codec for the public_pbrpc envelope (exposed for tests) ----
+
+struct PublicPbrpcCall {
+  uint64_t log_id = 0;
+  std::string service;
+  uint32_t method_id = 0;
+  uint64_t id = 0;          // correlation id
+  std::string payload;      // serialized_request / serialized_response
+  int32_t code = 0;         // responses: 0 = ok
+  std::string error_text;
+};
+void EncodePublicPbrpcRequest(const PublicPbrpcCall& c, IOBuf* out);
+bool DecodePublicPbrpcRequest(const IOBuf& in, PublicPbrpcCall* out);
+void EncodePublicPbrpcResponse(const PublicPbrpcCall& c, IOBuf* out);
+bool DecodePublicPbrpcResponse(const IOBuf& in, PublicPbrpcCall* out);
+
+}  // namespace brt

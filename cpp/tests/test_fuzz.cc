@@ -24,6 +24,7 @@
 #include "rpc/http_message.h"
 #include "rpc/json.h"
 #include "rpc/legacy.h"
+#include "rpc/mcpack.h"
 #include "rpc/mongo.h"
 #include "rpc/redis.h"
 #include "rpc/server.h"
@@ -309,6 +310,43 @@ void fuzz_amf0() {
   printf("fuzz_amf0 OK\n");
 }
 
+void fuzz_mcpack() {
+  JsonValue doc = JsonValue::Null();
+  std::string verr;
+  assert(JsonParse(R"({"s":"x","i":7,"d":1.5,"a":[1,"two",{"n":null}]})",
+                   &doc, &verr));
+  IOBuf enc;
+  assert(McpackEncode(doc, &enc));
+  const std::string valid = enc.to_string();
+  for (int iter = 0; iter < 20000; ++iter) {
+    std::string input = (iter % 2 == 0) ? random_bytes(rnd() % 96)
+                                        : mutate(valid);
+    JsonValue out;
+    std::string err;
+    (void)McpackDecode(input.data(), input.size(), &out, &err);
+  }
+  // Depth bound: 4000 CONSISTENTLY-sized nested objects (each head's
+  // value_size covers exactly its child) so decode genuinely recurses —
+  // it must stop cleanly at kMaxDepth, not overflow the stack.
+  std::string deep;  // built inside-out
+  for (int i = 0; i < 4000; ++i) {
+    std::string wrapped;
+    wrapped.push_back(char(0x10));
+    wrapped.push_back('\0');
+    const uint32_t vs = uint32_t(4 + deep.size());
+    const uint32_t count = deep.empty() ? 0 : 1;
+    wrapped.append(reinterpret_cast<const char*>(&vs), 4);
+    wrapped.append(reinterpret_cast<const char*>(&count), 4);
+    wrapped += deep;
+    deep = std::move(wrapped);
+  }
+  JsonValue out;
+  std::string err;
+  assert(!McpackDecode(deep.data(), deep.size(), &out, &err));
+  assert(err == "mcpack: too deep");
+  printf("fuzz_mcpack OK\n");
+}
+
 void fuzz_thrift_tbinary() {
   ThriftValue s;
   s.type = TType::STRUCT;
@@ -504,6 +542,7 @@ int main() {
   fuzz_json();
   fuzz_bson();
   fuzz_amf0();
+  fuzz_mcpack();
   fuzz_thrift_tbinary();
   fuzz_live_server();
   prop_meta_roundtrip();

@@ -1,0 +1,283 @@
+// discovery + nacos naming-service dialects against in-test fake
+// registries speaking the real HTTP APIs (model: test_lb_ns's
+// FakeConsul; reference test/brpc_naming_service_unittest.cpp discovery/
+// nacos sections).
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cassert>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "base/time.h"
+#include "cluster/discovery_naming.h"
+#include "cluster/nacos_naming.h"
+#include "fiber/fiber.h"
+
+using namespace brt;
+
+namespace {
+
+// Minimal fake HTTP registry: handler(path_with_query, body) -> response
+// body (always 200 unless the handler prefixes "STATUS:<code>:").
+class FakeRegistry {
+ public:
+  using Handler = std::function<std::string(const std::string& path,
+                                            const std::string& body)>;
+
+  explicit FakeRegistry(Handler h) : handler_(std::move(h)) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    assert(bind(fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0);
+    socklen_t len = sizeof(sa);
+    getsockname(fd_, reinterpret_cast<sockaddr*>(&sa), &len);
+    port_ = ntohs(sa.sin_port);
+    assert(listen(fd_, 16) == 0);
+    th_ = std::thread([this] { Serve(); });
+  }
+
+  ~FakeRegistry() {
+    stop_.store(true);
+    ::shutdown(fd_, SHUT_RDWR);
+    ::close(fd_);
+    th_.join();
+  }
+
+  uint16_t port() const { return port_; }
+
+ private:
+  void Serve() {
+    while (!stop_.load()) {
+      int c = ::accept(fd_, nullptr, nullptr);
+      if (c < 0) return;
+      std::string req;
+      char buf[4096];
+      // Read head, then honor Content-Length for the body.
+      size_t head_end;
+      for (;;) {
+        head_end = req.find("\r\n\r\n");
+        if (head_end != std::string::npos) break;
+        ssize_t n = ::read(c, buf, sizeof(buf));
+        if (n <= 0) {
+          ::close(c);
+          return;
+        }
+        req.append(buf, size_t(n));
+      }
+      size_t content_len = 0;
+      {
+        const size_t p = req.find("Content-Length:");
+        if (p != std::string::npos) content_len = atol(req.c_str() + p + 15);
+      }
+      while (req.size() < head_end + 4 + content_len) {
+        ssize_t n = ::read(c, buf, sizeof(buf));
+        if (n <= 0) break;
+        req.append(buf, size_t(n));
+      }
+      // "<METHOD> <path> HTTP/1.1"
+      const size_t sp1 = req.find(' ');
+      const size_t sp2 = req.find(' ', sp1 + 1);
+      const std::string path = req.substr(sp1 + 1, sp2 - sp1 - 1);
+      const std::string body = req.substr(head_end + 4, content_len);
+      std::string rsp = handler_(path, body);
+      int status = 200;
+      if (rsp.rfind("STATUS:", 0) == 0) {
+        status = atoi(rsp.c_str() + 7);
+        rsp = rsp.substr(rsp.find(':', 7) + 1);
+      }
+      char head[256];
+      snprintf(head, sizeof(head),
+               "HTTP/1.1 %d X\r\nContent-Type: application/json\r\n"
+               "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+               status, rsp.size());
+      (void)!::send(c, head, strlen(head), MSG_NOSIGNAL);
+      (void)!::send(c, rsp.data(), rsp.size(), MSG_NOSIGNAL);
+      ::close(c);
+    }
+  }
+
+  Handler handler_;
+  int fd_;
+  uint16_t port_ = 0;
+  std::thread th_;
+  std::atomic<bool> stop_{false};
+};
+
+struct Pushes {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<std::vector<ServerNode>> lists;
+
+  void push(const std::vector<ServerNode>& nodes) {
+    std::lock_guard<std::mutex> g(mu);
+    lists.push_back(nodes);
+    cv.notify_all();
+  }
+  // Waits until `n` pushes arrived (3s cap).
+  bool wait_for(size_t n) {
+    std::unique_lock<std::mutex> lk(mu);
+    return cv.wait_for(lk, std::chrono::seconds(5),
+                       [&] { return lists.size() >= n; });
+  }
+};
+
+void test_discovery_ns() {
+  std::atomic<int> gen{0};
+  FakeRegistry reg([&](const std::string& path, const std::string&) {
+    assert(path.find("/discovery/fetchs?appid=my.app&env=uat&status=1") ==
+           0);
+    if (gen.load() == 0) {
+      return std::string(
+          R"({"code":0,"data":{"my.app":{"instances":[)"
+          R"({"addrs":["grpc://10.0.0.1:9000","http://10.0.0.2:8080"]},)"
+          R"({"addrs":["10.0.0.3:7000"]}]}}})");
+    }
+    return std::string(
+        R"({"code":0,"data":{"my.app":{"instances":[)"
+        R"({"addrs":["grpc://10.0.0.9:9999"]}]}}})");
+  });
+
+  Pushes pushes;
+  DiscoveryNamingService ns;
+  ns.interval_ms = 200;
+  char param[96];
+  snprintf(param, sizeof(param), "127.0.0.1:%d/my.app?env=uat", reg.port());
+  assert(ns.Start(param, [&](const std::vector<ServerNode>& n) {
+    pushes.push(n);
+  }) == 0);
+  assert(pushes.wait_for(1));
+  {
+    std::lock_guard<std::mutex> g(pushes.mu);
+    assert(pushes.lists[0].size() == 3);  // scheme prefixes stripped
+    assert(pushes.lists[0][0].ep.to_string() == "10.0.0.1:9000");
+    assert(pushes.lists[0][2].ep.to_string() == "10.0.0.3:7000");
+  }
+  gen.store(1);  // membership change → ONE new push (dedup works)
+  assert(pushes.wait_for(2));
+  {
+    std::lock_guard<std::mutex> g(pushes.mu);
+    assert(pushes.lists[1].size() == 1);
+    assert(pushes.lists[1][0].ep.to_string() == "10.0.0.9:9999");
+  }
+  const int64_t t0 = monotonic_us();
+  ns.Stop();
+  assert(monotonic_us() - t0 < 2 * 1000 * 1000);  // prompt stop
+  printf("discovery_ns OK (fetch, strip-scheme, change push, fast stop)\n");
+}
+
+void test_discovery_client() {
+  std::mutex mu;
+  std::vector<std::string> posts;  // "path|body"
+  FakeRegistry reg([&](const std::string& path, const std::string& body) {
+    std::lock_guard<std::mutex> g(mu);
+    posts.push_back(path + "|" + body);
+    return std::string(R"({"code":0,"message":"ok"})");
+  });
+  {
+    DiscoveryClient client;
+    DiscoveryClient::Params p;
+    assert(EndPoint::parse("127.0.0.1:" + std::to_string(reg.port()),
+                           &p.agent));
+    p.appid = "my.app";
+    p.hostname = "host-1";
+    p.addr = "10.1.1.1:8000";
+    p.env = "uat";
+    p.zone = "z1";
+    p.renew_interval_ms = 150;
+    assert(client.Register(p) == 0);
+    // At least two renews land within ~0.6s.
+    const int64_t deadline = monotonic_us() + 3 * 1000 * 1000;
+    for (;;) {
+      {
+        std::lock_guard<std::mutex> g(mu);
+        int renews = 0;
+        for (const auto& s : posts) {
+          if (s.rfind("/discovery/renew|", 0) == 0) ++renews;
+        }
+        if (renews >= 2) break;
+      }
+      assert(monotonic_us() < deadline);
+      fiber_usleep(50 * 1000);
+    }
+  }  // ~DiscoveryClient → cancel
+  std::lock_guard<std::mutex> g(mu);
+  assert(posts.size() >= 4);
+  assert(posts[0].rfind("/discovery/register|", 0) == 0);
+  assert(posts[0].find("appid=my.app") != std::string::npos);
+  // Values are form-urlencoded (the scheme's :// must not split fields).
+  assert(posts[0].find("addrs=http%3A%2F%2F10.1.1.1%3A8000") !=
+         std::string::npos);
+  assert(posts.back().rfind("/discovery/cancel|", 0) == 0);
+  printf("discovery_client OK (register, %zu posts, renews, cancel)\n",
+         posts.size());
+}
+
+void test_nacos_ns() {
+  std::atomic<int> lists{0};
+  FakeRegistry reg([&](const std::string& path, const std::string& body) {
+    if (path.rfind("/nacos/v1/auth/login", 0) == 0) {
+      assert(body == "username=u1&password=p1");
+      return std::string(R"({"accessToken":"tok123","tokenTtl":3600})");
+    }
+    assert(path.rfind("/nacos/v1/ns/instance/list?", 0) == 0);
+    // The token must ride every list query.
+    assert(path.find("accessToken=tok123") != std::string::npos);
+    assert(path.find("serviceName=svc") != std::string::npos);
+    lists.fetch_add(1);
+    return std::string(
+        R"({"hosts":[)"
+        R"({"ip":"10.2.0.1","port":9000,"weight":2.6,"enabled":true,"healthy":true},)"
+        R"({"ip":"10.2.0.2","port":9001,"enabled":false},)"
+        R"({"ip":"10.2.0.3","port":9002,"healthy":false},)"
+        R"({"ip":"10.2.0.4","port":9003,"weight":0.4}]})");
+  });
+
+  Pushes pushes;
+  NacosNamingService ns;
+  ns.interval_ms = 200;
+  ns.username = "u1";
+  ns.password = "p1";
+  char param[96];
+  snprintf(param, sizeof(param), "127.0.0.1:%d/serviceName=svc",
+           reg.port());
+  assert(ns.Start(param, [&](const std::vector<ServerNode>& n) {
+    pushes.push(n);
+  }) == 0);
+  assert(pushes.wait_for(1));
+  {
+    std::lock_guard<std::mutex> g(pushes.mu);
+    const auto& nodes = pushes.lists[0];
+    assert(nodes.size() == 2);  // disabled + unhealthy filtered out
+    assert(nodes[0].ep.to_string() == "10.2.0.1:9000");
+    assert(nodes[0].weight == 2);      // 2.6 → 2
+    assert(nodes[1].ep.to_string() == "10.2.0.4:9003");
+    assert(nodes[1].weight == 1);      // 0.4 → floor 1
+  }
+  ns.Stop();
+  assert(lists.load() >= 1);
+  printf("nacos_ns OK (auth token, filtering, weights)\n");
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+  test_discovery_ns();
+  test_discovery_client();
+  test_nacos_ns();
+  printf("ALL ns-dialect tests OK\n");
+  return 0;
+}
