@@ -1,485 +1,52 @@
 #include "rpc/grpc_client.h"
 
-#include <cstring>
-#include <map>
-#include <mutex>
-
-#include "base/logging.h"
-#include "fiber/fiber.h"
-#include "fiber/sync.h"
-#include "rpc/hpack.h"
+#include "rpc/h2_client.h"
 #include "rpc/http2_protocol.h"
-#include "transport/tls.h"
-#include "transport/socket.h"
 
 namespace brt {
 
-namespace {
-
-constexpr uint32_t kClientConnWindow = 4u << 20;
-constexpr size_t kMaxReplyBody = 64u << 20;
-
-const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
-
-struct CallWaiter {
-  CountdownEvent done{1};
-  int rc = 0;
-  GrpcResult* out = nullptr;
-  HeaderList headers;   // response headers + trailers accumulate here
-  IOBuf body;           // raw DATA bytes (gRPC-framed)
-};
-
-// Socket-owned connection state (parsing_context; freed at recycle — the
-// PipelinedClient lifetime discipline).
-struct GrpcCore {
-  std::mutex mu;  // guards EVERYTHING below + HPACK state + writes
-  HpackDecoder dec{4096};
-  HpackEncoder enc{4096};
-  IOPortal inbuf;
-  std::string buf;  // contiguous staging for frame cutting
-  std::map<uint32_t, CallWaiter*> streams;
-  uint32_t next_stream_id = 1;
-  uint32_t peer_max_frame = 16384;
-  int64_t conn_send_window = 65535;
-  uint32_t peer_initial_window = 65535;
-  std::map<uint32_t, int64_t> stream_send_window;
-  int64_t timeout_us = 2000000;
-  bool saw_settings = false;
-  // Window waits: writers park here until WINDOW_UPDATE arrives.
-  FiberMutex wmu;
-  FiberCond wcond;
-  // continuation accumulation
-  uint32_t cont_stream = 0;
-  uint8_t cont_flags = 0;
-  std::string cont_buf;
-
-  void FailAllLocked(int err) {
-    for (auto& [id, w] : streams) {
-      w->rc = err;
-      w->done.signal();
-    }
-    streams.clear();
-  }
-  void FailAll(int err) {
-    std::lock_guard<std::mutex> g(mu);
-    FailAllLocked(err);
-  }
-};
-
-const std::string* Find(const HeaderList& h, const std::string& k) {
-  for (const HeaderField& f : h) {
-    if (f.name == k) return &f.value;
-  }
-  return nullptr;
-}
-
-void FinishStreamLocked(GrpcCore* core, uint32_t id, CallWaiter* w) {
-  core->streams.erase(id);
-  core->stream_send_window.erase(id);
-  GrpcResult* out = w->out;
-  if (const std::string* s = Find(w->headers, ":status")) {
-    out->http_status = atoi(s->c_str());
-  }
-  if (const std::string* s = Find(w->headers, "grpc-status")) {
-    out->grpc_status = atoi(s->c_str());
-  }
-  if (const std::string* s = Find(w->headers, "grpc-message")) {
-    out->grpc_message = *s;
-  }
-  // De-frame exactly one gRPC message (empty body = empty response, e.g.
-  // trailers-only errors).
-  if (!w->body.empty()) {
-    IOBuf msg;
-    if (CutGrpcMessage(&w->body, &msg)) {
-      out->response = std::move(msg);
-    } else {
-      w->rc = EBADMSG;
-    }
-  }
-  w->done.signal();
-}
-
-// Processes ONE complete frame. Caller holds core->mu. Returns false on a
-// connection-fatal error (*err set).
-bool ProcessFrame(Socket* s, GrpcCore* core, uint8_t type, uint8_t flags,
-                  uint32_t stream_id, const std::string& payload,
-                  std::string* err) {
-  switch (H2FrameType(type)) {
-    case H2FrameType::SETTINGS: {
-      if (flags & 0x1) return true;  // ACK
-      for (size_t off = 0; off + 6 <= payload.size(); off += 6) {
-        const uint16_t id = uint16_t(uint8_t(payload[off])) << 8 |
-                            uint8_t(payload[off + 1]);
-        const uint32_t v = uint32_t(uint8_t(payload[off + 2])) << 24 |
-                           uint32_t(uint8_t(payload[off + 3])) << 16 |
-                           uint32_t(uint8_t(payload[off + 4])) << 8 |
-                           uint8_t(payload[off + 5]);
-        if (id == 5) core->peer_max_frame = v;
-        if (id == 4) {
-          // RFC 9113 §6.9.2: a mid-connection INITIAL_WINDOW_SIZE change
-          // adjusts every open stream's send window by the delta.
-          const int64_t delta =
-              int64_t(v) - int64_t(core->peer_initial_window);
-          for (auto& kv : core->stream_send_window) kv.second += delta;
-          core->peer_initial_window = v;
-        }
-        (void)0;  // header-table-size updates not applied (we emit no
-                  // dynamic-table-dependent encodings beyond our own)
-      }
-      core->saw_settings = true;
-      IOBuf ack;
-      AppendH2FrameHeader(&ack, 0, H2FrameType::SETTINGS, 0x1, 0);
-      s->Write(&ack);
-      return true;
-    }
-    case H2FrameType::PING: {
-      if (flags & 0x1) return true;
-      IOBuf pong;
-      AppendH2FrameHeader(&pong, uint32_t(payload.size()),
-                          H2FrameType::PING, 0x1, 0);
-      pong.append(payload);
-      s->Write(&pong);
-      return true;
-    }
-    case H2FrameType::WINDOW_UPDATE: {
-      if (payload.size() != 4) {
-        *err = "bad WINDOW_UPDATE";
-        return false;
-      }
-      const uint32_t inc = (uint32_t(uint8_t(payload[0])) << 24 |
-                            uint32_t(uint8_t(payload[1])) << 16 |
-                            uint32_t(uint8_t(payload[2])) << 8 |
-                            uint8_t(payload[3])) &
-                           0x7FFFFFFF;
-      if (stream_id == 0) {
-        core->conn_send_window += inc;
-      } else {
-        // Only known streams: a WINDOW_UPDATE for a finished/RST stream
-        // must not re-insert a dead entry in the accounting map.
-        auto wit = core->stream_send_window.find(stream_id);
-        if (wit != core->stream_send_window.end()) wit->second += inc;
-      }
-      core->wcond.notify_all();
-      return true;
-    }
-    case H2FrameType::HEADERS:
-    case H2FrameType::CONTINUATION: {
-      std::string block = payload;
-      uint8_t hflags = flags;
-      if (H2FrameType(type) == H2FrameType::HEADERS) {
-        if (flags & 0x20) {  // PRIORITY fields
-          if (block.size() < 5) {
-            *err = "short HEADERS";
-            return false;
-          }
-          block.erase(0, 5);
-        }
-        if (flags & 0x8) {  // PADDED
-          *err = "padded HEADERS unsupported";
-          return false;
-        }
-        if (!(flags & 0x4)) {  // no END_HEADERS: continuation follows
-          core->cont_stream = stream_id;
-          core->cont_flags = flags;
-          core->cont_buf = block;
-          return true;
-        }
-      } else {
-        if (core->cont_stream != stream_id) {
-          *err = "CONTINUATION for wrong stream";
-          return false;
-        }
-        core->cont_buf += block;
-        if (!(flags & 0x4)) return true;
-        block = std::move(core->cont_buf);
-        hflags = core->cont_flags;
-        core->cont_stream = 0;
-      }
-      auto it = core->streams.find(stream_id);
-      CallWaiter* w = (it == core->streams.end()) ? nullptr : it->second;
-      // HPACK's dynamic table is connection-wide: the block must run
-      // through the decoder even for a stale (timed-out) stream, or every
-      // later header block on this connection decodes against a wrong
-      // table. Decode into a scratch list and discard if stream unknown.
-      HeaderList scratch;
-      if (!core->dec.Decode(
-              reinterpret_cast<const uint8_t*>(block.data()), block.size(),
-              w ? &w->headers : &scratch)) {
-        *err = "HPACK decode failed";
-        return false;
-      }
-      if (w != nullptr && (hflags & 0x1)) {
-        FinishStreamLocked(core, stream_id, w);
-      }
-      return true;
-    }
-    case H2FrameType::DATA: {
-      auto it = core->streams.find(stream_id);
-      if (it != core->streams.end()) {
-        CallWaiter* w = it->second;
-        if (w->body.size() + payload.size() > kMaxReplyBody) {
-          *err = "reply too large";
-          return false;
-        }
-        w->body.append(payload);
-        if (flags & 0x1) FinishStreamLocked(core, stream_id, w);
-      }
-      // Replenish both windows so the server's flow control keeps going.
-      if (!payload.empty()) {
-        IOBuf wu;
-        for (uint32_t target : {0u, stream_id}) {
-          AppendH2FrameHeader(&wu, 4, H2FrameType::WINDOW_UPDATE, 0,
-                              target);
-          const uint32_t inc = uint32_t(payload.size());
-          uint8_t b[4] = {uint8_t(inc >> 24), uint8_t(inc >> 16),
-                          uint8_t(inc >> 8), uint8_t(inc)};
-          wu.append(b, 4);
-        }
-        s->Write(&wu);
-      }
-      return true;
-    }
-    case H2FrameType::RST_STREAM: {
-      auto it = core->streams.find(stream_id);
-      if (it != core->streams.end()) {
-        CallWaiter* w = it->second;
-        core->streams.erase(it);
-        core->stream_send_window.erase(stream_id);
-        w->rc = ECONNRESET;
-        w->done.signal();
-      }
-      return true;
-    }
-    case H2FrameType::GOAWAY:
-      *err = "server sent GOAWAY";
-      return false;
-    default:
-      return true;  // PUSH_PROMISE etc: tolerate
-  }
-}
-
-void* GrpcOnData(Socket* s) {
-  auto* core = static_cast<GrpcCore*>(s->parsing_context());
-  for (;;) {
-    ssize_t nr = s->AppendFromFd(&core->inbuf);
-    if (nr == 0) {
-      s->SetFailed(ECONNRESET, "grpc server closed");
-      core->FailAll(ECONNRESET);
-      return nullptr;
-    }
-    if (nr < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-      if (errno == EINTR) continue;
-      s->SetFailed(errno, "grpc read failed");
-      core->FailAll(errno);
-      return nullptr;
-    }
-  }
-  std::lock_guard<std::mutex> g(core->mu);
-  {
-    const std::string more = core->inbuf.to_string();
-    core->inbuf.clear();
-    core->buf += more;
-  }
-  for (;;) {
-    if (core->buf.size() < 9) return nullptr;
-    const uint8_t* p = reinterpret_cast<const uint8_t*>(core->buf.data());
-    const uint32_t len = uint32_t(p[0]) << 16 | uint32_t(p[1]) << 8 | p[2];
-    if (len > (16u << 20)) {
-      s->SetFailed(EBADMSG, "h2 frame too large");
-      core->FailAllLocked(EBADMSG);
-      return nullptr;
-    }
-    if (core->buf.size() < 9 + size_t(len)) return nullptr;
-    const uint8_t type = p[3];
-    const uint8_t flags = p[4];
-    const uint32_t stream_id = (uint32_t(p[5]) << 24 | uint32_t(p[6]) << 16 |
-                                uint32_t(p[7]) << 8 | p[8]) &
-                               0x7FFFFFFF;
-    const std::string payload = core->buf.substr(9, len);
-    core->buf.erase(0, 9 + size_t(len));
-    std::string err;
-    if (!ProcessFrame(s, core, type, flags, stream_id, payload, &err)) {
-      s->SetFailed(EPROTO, "grpc client: %s", err.c_str());
-      core->FailAllLocked(EPROTO);
-      return nullptr;
-    }
-  }
-}
-
-}  // namespace
-
+// A veneer over the general H2Client session (rpc/h2_client.h): gRPC is
+// "HTTP/2 + length-prefixed frames + grpc-status trailers" (reference
+// policy/http2_rpc_protocol.cpp client half + grpc.h status mapping).
 struct GrpcClient::Impl {
-  SocketId sock = INVALID_SOCKET_ID;
-
-  ~Impl() {
-    if (sock == INVALID_SOCKET_ID) return;
-    SocketUniquePtr p;
-    if (Socket::Address(sock, &p) == 0) {
-      p->SetFailed(ECANCELED, "client closed");
-    }
-  }
+  H2Client h2;
 };
 
 GrpcClient::GrpcClient() : impl_(new Impl) {}
 GrpcClient::~GrpcClient() = default;
 
-bool GrpcClient::connected() const {
-  SocketUniquePtr p;
-  return impl_->sock != INVALID_SOCKET_ID &&
-         Socket::Address(impl_->sock, &p) == 0 && !p->Failed();
-}
+bool GrpcClient::connected() const { return impl_->h2.connected(); }
 
 int GrpcClient::Connect(const EndPoint& server, int64_t timeout_ms,
                         bool use_tls) {
-  fiber_init(0);
-  auto* core = new GrpcCore;
-  core->timeout_us = timeout_ms * 1000;
-  Socket::Options opts;
-  opts.on_edge_triggered = GrpcOnData;
-  opts.initial_parsing_context = core;
-  opts.parsing_context_destroyer = [](void* p) {
-    delete static_cast<GrpcCore*>(p);
-  };
-  SocketId sid = INVALID_SOCKET_ID;
-  const int rc = Socket::Connect(server, opts, &sid, core->timeout_us);
-  if (rc != 0) {
-    if (sid == INVALID_SOCKET_ID) delete core;  // pre-Create failure
-    else impl_->sock = sid;  // socket owns core; recycle frees it
-    return rc;
-  }
-  impl_->sock = sid;
-  SocketUniquePtr p;
-  if (Socket::Address(impl_->sock, &p) != 0) return ECONNRESET;
-  if (use_tls) {
-    // Shared anonymous-trust h2 context; a failed creation is retried on
-    // the next Connect, not cached forever.
-    static std::mutex tls_mu;
-    static TlsContext* tls = nullptr;
-    {
-      std::lock_guard<std::mutex> g(tls_mu);
-      if (tls == nullptr) {
-        TlsOptions to;
-        to.alpn = {"h2"};
-        std::string err;
-        tls = TlsContext::NewClient(to, &err).release();
-        if (tls == nullptr) {
-          BRT_LOG(ERROR) << "grpc client tls context: " << err;
-          return EPROTO;
-        }
-      }
-    }
-    // SNI omitted: the endpoint is an IP literal (RFC 6066 forbids those
-    // in server_name); hostname-carrying callers use Channel's ssl_sni.
-    const int trc = p->StartTlsClient(tls, "", core->timeout_us);
-    if (trc != 0) return trc;
-  }
-  IOBuf hello;
-  hello.append(kPreface, sizeof(kPreface) - 1);
-  AppendH2FrameHeader(&hello, 12, H2FrameType::SETTINGS, 0, 0);
-  const std::pair<uint16_t, uint32_t> kv[] = {
-      {4, kClientConnWindow}, {5, 1u << 20}};
-  for (auto [id, v] : kv) {
-    uint8_t b[6] = {uint8_t(id >> 8), uint8_t(id),     uint8_t(v >> 24),
-                    uint8_t(v >> 16), uint8_t(v >> 8), uint8_t(v)};
-    hello.append(b, 6);
-  }
-  // Grow the connection receive window up front (WINDOW_UPDATE on 0).
-  AppendH2FrameHeader(&hello, 4, H2FrameType::WINDOW_UPDATE, 0, 0);
-  const uint32_t inc = kClientConnWindow - 65535;
-  uint8_t b[4] = {uint8_t(inc >> 24), uint8_t(inc >> 16), uint8_t(inc >> 8),
-                  uint8_t(inc)};
-  hello.append(b, 4);
-  return p->Write(&hello);
+  return impl_->h2.Connect(server, timeout_ms, use_tls);
 }
 
 int GrpcClient::Call(const std::string& service, const std::string& method,
                      const IOBuf& request, GrpcResult* out,
                      int64_t timeout_ms) {
-  SocketUniquePtr p;  // held across the wait: keeps GrpcCore alive
-  if (impl_->sock == INVALID_SOCKET_ID ||
-      Socket::Address(impl_->sock, &p) != 0 || p->Failed()) {
-    return ECONNRESET;
-  }
-  auto* core = static_cast<GrpcCore*>(p->parsing_context());
-  CallWaiter waiter;
-  waiter.out = out;
-
   IOBuf framed;
   AppendGrpcMessage(&framed, request);
-  uint32_t id;
-  {
-    std::lock_guard<std::mutex> g(core->mu);
-    id = core->next_stream_id;
-    core->next_stream_id += 2;
-    core->streams[id] = &waiter;
-    core->stream_send_window[id] = core->peer_initial_window;
-
-    HeaderList req_headers;
-    req_headers.push_back({":method", "POST", false});
-    req_headers.push_back({":scheme", "http", false});
-    req_headers.push_back({":path", "/" + service + "/" + method, false});
-    req_headers.push_back({":authority", "grpc-client", false});
-    req_headers.push_back({"content-type", "application/grpc", false});
-    req_headers.push_back({"te", "trailers", false});
-    // HPACK encoder state must match wire order: encode AND enqueue under
-    // the lock.
-    std::string block;
-    core->enc.Encode(req_headers, &block);
-    IOBuf wire;
-    AppendH2FrameHeader(&wire, uint32_t(block.size()), H2FrameType::HEADERS,
-                        0x4 /*END_HEADERS*/, id);
-    wire.append(block);
-    // DATA with END_STREAM, chunked to the peer's max frame. Send-window
-    // handling is blocking: messages beyond the window park below.
-    size_t remaining = framed.size();
-    while (remaining > 0) {
-      const size_t n = remaining < core->peer_max_frame
-                           ? remaining
-                           : size_t(core->peer_max_frame);
-      IOBuf piece;
-      framed.cutn(&piece, n);
-      remaining -= n;
-      AppendH2FrameHeader(&wire, uint32_t(n), H2FrameType::DATA,
-                          remaining == 0 ? 0x1 : 0, id);
-      wire.append(piece);
-      core->conn_send_window -= int64_t(n);
-      core->stream_send_window[id] -= int64_t(n);
-      // NOTE: a request larger than the initial windows would need to
-      // park for WINDOW_UPDATEs mid-message; unary gRPC requests in this
-      // framework stay well under 64KB-1MB windows, and oversized ones
-      // fail loudly instead of deadlocking.
-      if (core->conn_send_window < 0 ||
-          core->stream_send_window[id] < 0) {
-        core->streams.erase(id);
-        core->stream_send_window.erase(id);
-        return EMSGSIZE;
-      }
-    }
-    p->Write(&wire);
+  HeaderList headers;
+  headers.push_back({"content-type", "application/grpc", false});
+  headers.push_back({"te", "trailers", false});
+  H2Result res;
+  const int rc = impl_->h2.Fetch("POST", "/" + service + "/" + method,
+                                 headers, framed, &res, timeout_ms);
+  if (rc != 0) return rc;
+  out->http_status = res.status;
+  if (const std::string* s = res.header("grpc-status")) {
+    out->grpc_status = atoi(s->c_str());
   }
-
-  const int64_t tmo = timeout_ms >= 0 ? timeout_ms * 1000 : core->timeout_us;
-  if (waiter.done.wait(tmo) != 0) {
-    {
-      std::lock_guard<std::mutex> g(core->mu);
-      auto it = core->streams.find(id);
-      if (it != core->streams.end() && it->second == &waiter) {
-        core->streams.erase(it);
-        core->stream_send_window.erase(id);
-        // Tell the server we gave up on this stream.
-        IOBuf rst;
-        AppendH2FrameHeader(&rst, 4, H2FrameType::RST_STREAM, 0, id);
-        uint8_t cancel[4] = {0, 0, 0, 8};  // CANCEL
-        rst.append(cancel, 4);
-        p->Write(&rst);
-        return ETIMEDOUT;
-      }
-    }
-    // A finisher claimed the waiter concurrently: take its result.
-    waiter.done.wait(-1);
+  if (const std::string* s = res.header("grpc-message")) {
+    out->grpc_message = *s;
   }
-  return waiter.rc;
+  // De-frame exactly one gRPC message (empty body = empty response, e.g.
+  // trailers-only errors).
+  if (!res.body.empty() && !CutGrpcMessage(&res.body, &out->response)) {
+    return EBADMSG;
+  }
+  return 0;
 }
 
 }  // namespace brt

@@ -1,0 +1,469 @@
+#include "rpc/h2_client.h"
+
+#include <cstring>
+#include <map>
+#include <mutex>
+
+#include "base/logging.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "rpc/http2_protocol.h"
+#include "transport/socket.h"
+#include "transport/tls.h"
+
+namespace brt {
+
+namespace {
+
+constexpr uint32_t kClientConnWindow = 4u << 20;
+constexpr size_t kMaxReplyBody = 64u << 20;
+
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+
+struct StreamWaiter {
+  CountdownEvent done{1};
+  int rc = 0;
+  H2Result* out = nullptr;
+  HeaderList headers;
+  IOBuf body;
+};
+
+// Socket-owned connection state (parsing_context; freed at recycle — the
+// PipelinedClient lifetime discipline).
+struct H2Core {
+  std::mutex mu;  // guards EVERYTHING below + HPACK state + writes
+  HpackDecoder dec{4096};
+  HpackEncoder enc{4096};
+  IOPortal inbuf;
+  std::string buf;  // contiguous staging for frame cutting
+  std::map<uint32_t, StreamWaiter*> streams;
+  uint32_t next_stream_id = 1;
+  uint32_t peer_max_frame = 16384;
+  int64_t conn_send_window = 65535;
+  uint32_t peer_initial_window = 65535;
+  std::map<uint32_t, int64_t> stream_send_window;
+  int64_t timeout_us = 2000000;
+  bool saw_settings = false;
+  bool tls = false;  // :scheme for requests on this connection
+  // continuation accumulation
+  uint32_t cont_stream = 0;
+  uint8_t cont_flags = 0;
+  std::string cont_buf;
+
+  void FailAllLocked(int err) {
+    for (auto& [id, w] : streams) {
+      w->rc = err;
+      w->done.signal();
+    }
+    streams.clear();
+  }
+  void FailAll(int err) {
+    std::lock_guard<std::mutex> g(mu);
+    FailAllLocked(err);
+  }
+};
+
+const std::string* Find(const HeaderList& h, const std::string& k) {
+  const std::string* hit = nullptr;
+  for (const HeaderField& f : h) {
+    if (f.name == k) hit = &f.value;
+  }
+  return hit;
+}
+
+void FinishStreamLocked(H2Core* core, uint32_t id, StreamWaiter* w) {
+  core->streams.erase(id);
+  core->stream_send_window.erase(id);
+  H2Result* out = w->out;
+  if (const std::string* s = Find(w->headers, ":status")) {
+    out->status = atoi(s->c_str());
+  }
+  out->headers = std::move(w->headers);
+  out->body = std::move(w->body);
+  w->done.signal();
+}
+
+// Processes ONE complete frame. Caller holds core->mu. Returns false on a
+// connection-fatal error (*err set).
+bool ProcessFrame(Socket* s, H2Core* core, uint8_t type, uint8_t flags,
+                  uint32_t stream_id, const std::string& payload,
+                  std::string* err) {
+  switch (H2FrameType(type)) {
+    case H2FrameType::SETTINGS: {
+      if (flags & 0x1) return true;  // ACK
+      for (size_t off = 0; off + 6 <= payload.size(); off += 6) {
+        const uint16_t id = uint16_t(uint8_t(payload[off])) << 8 |
+                            uint8_t(payload[off + 1]);
+        const uint32_t v = uint32_t(uint8_t(payload[off + 2])) << 24 |
+                           uint32_t(uint8_t(payload[off + 3])) << 16 |
+                           uint32_t(uint8_t(payload[off + 4])) << 8 |
+                           uint8_t(payload[off + 5]);
+        if (id == 5) core->peer_max_frame = v;
+        if (id == 4) {
+          // RFC 9113 §6.9.2: a mid-connection INITIAL_WINDOW_SIZE change
+          // adjusts every open stream's send window by the delta.
+          const int64_t delta =
+              int64_t(v) - int64_t(core->peer_initial_window);
+          for (auto& kv : core->stream_send_window) kv.second += delta;
+          core->peer_initial_window = v;
+        }
+      }
+      core->saw_settings = true;
+      IOBuf ack;
+      AppendH2FrameHeader(&ack, 0, H2FrameType::SETTINGS, 0x1, 0);
+      s->Write(&ack);
+      return true;
+    }
+    case H2FrameType::PING: {
+      if (flags & 0x1) return true;
+      IOBuf pong;
+      AppendH2FrameHeader(&pong, uint32_t(payload.size()),
+                          H2FrameType::PING, 0x1, 0);
+      pong.append(payload);
+      s->Write(&pong);
+      return true;
+    }
+    case H2FrameType::WINDOW_UPDATE: {
+      if (payload.size() != 4) {
+        *err = "bad WINDOW_UPDATE";
+        return false;
+      }
+      const uint32_t inc = (uint32_t(uint8_t(payload[0])) << 24 |
+                            uint32_t(uint8_t(payload[1])) << 16 |
+                            uint32_t(uint8_t(payload[2])) << 8 |
+                            uint8_t(payload[3])) &
+                           0x7FFFFFFF;
+      if (stream_id == 0) {
+        core->conn_send_window += inc;
+      } else {
+        // Only known streams: a WINDOW_UPDATE for a finished/RST stream
+        // must not re-insert a dead entry in the accounting map.
+        auto wit = core->stream_send_window.find(stream_id);
+        if (wit != core->stream_send_window.end()) wit->second += inc;
+      }
+      return true;
+    }
+    case H2FrameType::HEADERS:
+    case H2FrameType::CONTINUATION: {
+      std::string block = payload;
+      uint8_t hflags = flags;
+      if (H2FrameType(type) == H2FrameType::HEADERS) {
+        if (flags & 0x20) {  // PRIORITY fields
+          if (block.size() < 5) {
+            *err = "short HEADERS";
+            return false;
+          }
+          block.erase(0, 5);
+        }
+        if (flags & 0x8) {  // PADDED
+          *err = "padded HEADERS unsupported";
+          return false;
+        }
+        if (!(flags & 0x4)) {  // no END_HEADERS: continuation follows
+          core->cont_stream = stream_id;
+          core->cont_flags = flags;
+          core->cont_buf = block;
+          return true;
+        }
+      } else {
+        if (core->cont_stream != stream_id) {
+          *err = "CONTINUATION for wrong stream";
+          return false;
+        }
+        core->cont_buf += block;
+        if (!(flags & 0x4)) return true;
+        block = std::move(core->cont_buf);
+        hflags = core->cont_flags;
+        core->cont_stream = 0;
+      }
+      auto it = core->streams.find(stream_id);
+      StreamWaiter* w = (it == core->streams.end()) ? nullptr : it->second;
+      // HPACK's dynamic table is connection-wide: the block must run
+      // through the decoder even for a stale (timed-out) stream, or every
+      // later header block on this connection decodes against a wrong
+      // table. Decode into a scratch list and discard if stream unknown.
+      HeaderList scratch;
+      if (!core->dec.Decode(
+              reinterpret_cast<const uint8_t*>(block.data()), block.size(),
+              w ? &w->headers : &scratch)) {
+        *err = "HPACK decode failed";
+        return false;
+      }
+      if (w != nullptr && (hflags & 0x1)) {
+        FinishStreamLocked(core, stream_id, w);
+      }
+      return true;
+    }
+    case H2FrameType::DATA: {
+      auto it = core->streams.find(stream_id);
+      if (it != core->streams.end()) {
+        StreamWaiter* w = it->second;
+        if (w->body.size() + payload.size() > kMaxReplyBody) {
+          *err = "reply too large";
+          return false;
+        }
+        w->body.append(payload);
+        if (flags & 0x1) FinishStreamLocked(core, stream_id, w);
+      }
+      // Replenish both windows so the server's flow control keeps going.
+      if (!payload.empty()) {
+        IOBuf wu;
+        for (uint32_t target : {0u, stream_id}) {
+          AppendH2FrameHeader(&wu, 4, H2FrameType::WINDOW_UPDATE, 0,
+                              target);
+          const uint32_t inc = uint32_t(payload.size());
+          uint8_t b[4] = {uint8_t(inc >> 24), uint8_t(inc >> 16),
+                          uint8_t(inc >> 8), uint8_t(inc)};
+          wu.append(b, 4);
+        }
+        s->Write(&wu);
+      }
+      return true;
+    }
+    case H2FrameType::RST_STREAM: {
+      auto it = core->streams.find(stream_id);
+      if (it != core->streams.end()) {
+        StreamWaiter* w = it->second;
+        core->streams.erase(it);
+        core->stream_send_window.erase(stream_id);
+        w->rc = ECONNRESET;
+        w->done.signal();
+      }
+      return true;
+    }
+    case H2FrameType::GOAWAY:
+      *err = "server sent GOAWAY";
+      return false;
+    default:
+      return true;  // PUSH_PROMISE etc: tolerate
+  }
+}
+
+void* H2OnData(Socket* s) {
+  auto* core = static_cast<H2Core*>(s->parsing_context());
+  for (;;) {
+    ssize_t nr = s->AppendFromFd(&core->inbuf);
+    if (nr == 0) {
+      s->SetFailed(ECONNRESET, "h2 server closed");
+      core->FailAll(ECONNRESET);
+      return nullptr;
+    }
+    if (nr < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      s->SetFailed(errno, "h2 read failed");
+      core->FailAll(errno);
+      return nullptr;
+    }
+  }
+  std::lock_guard<std::mutex> g(core->mu);
+  {
+    const std::string more = core->inbuf.to_string();
+    core->inbuf.clear();
+    core->buf += more;
+  }
+  for (;;) {
+    if (core->buf.size() < 9) return nullptr;
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(core->buf.data());
+    const uint32_t len = uint32_t(p[0]) << 16 | uint32_t(p[1]) << 8 | p[2];
+    if (len > (16u << 20)) {
+      s->SetFailed(EBADMSG, "h2 frame too large");
+      core->FailAllLocked(EBADMSG);
+      return nullptr;
+    }
+    if (core->buf.size() < 9 + size_t(len)) return nullptr;
+    const uint8_t type = p[3];
+    const uint8_t flags = p[4];
+    const uint32_t stream_id = (uint32_t(p[5]) << 24 | uint32_t(p[6]) << 16 |
+                                uint32_t(p[7]) << 8 | p[8]) &
+                               0x7FFFFFFF;
+    const std::string payload = core->buf.substr(9, len);
+    core->buf.erase(0, 9 + size_t(len));
+    std::string err;
+    if (!ProcessFrame(s, core, type, flags, stream_id, payload, &err)) {
+      s->SetFailed(EPROTO, "h2 client: %s", err.c_str());
+      core->FailAllLocked(EPROTO);
+      return nullptr;
+    }
+  }
+}
+
+}  // namespace
+
+const std::string* H2Result::header(const std::string& name) const {
+  return Find(headers, name);
+}
+
+struct H2Client::Impl {
+  SocketId sock = INVALID_SOCKET_ID;
+
+  ~Impl() {
+    if (sock == INVALID_SOCKET_ID) return;
+    SocketUniquePtr p;
+    if (Socket::Address(sock, &p) == 0) {
+      p->SetFailed(ECANCELED, "client closed");
+    }
+  }
+};
+
+H2Client::H2Client() : impl_(new Impl) {}
+H2Client::~H2Client() = default;
+
+bool H2Client::connected() const {
+  SocketUniquePtr p;
+  return impl_->sock != INVALID_SOCKET_ID &&
+         Socket::Address(impl_->sock, &p) == 0 && !p->Failed();
+}
+
+int H2Client::Connect(const EndPoint& server, int64_t timeout_ms,
+                      bool use_tls) {
+  fiber_init(0);
+  auto* core = new H2Core;
+  core->timeout_us = timeout_ms * 1000;
+  core->tls = use_tls;
+  Socket::Options opts;
+  opts.on_edge_triggered = H2OnData;
+  opts.initial_parsing_context = core;
+  opts.parsing_context_destroyer = [](void* p) {
+    delete static_cast<H2Core*>(p);
+  };
+  SocketId sid = INVALID_SOCKET_ID;
+  const int rc = Socket::Connect(server, opts, &sid, core->timeout_us);
+  if (rc != 0) {
+    if (sid == INVALID_SOCKET_ID) delete core;  // pre-Create failure
+    else impl_->sock = sid;  // socket owns core; recycle frees it
+    return rc;
+  }
+  impl_->sock = sid;
+  SocketUniquePtr p;
+  if (Socket::Address(impl_->sock, &p) != 0) return ECONNRESET;
+  if (use_tls) {
+    // Shared anonymous-trust h2 context; a failed creation is retried on
+    // the next Connect, not cached forever.
+    static std::mutex tls_mu;
+    static TlsContext* tls = nullptr;
+    {
+      std::lock_guard<std::mutex> g(tls_mu);
+      if (tls == nullptr) {
+        TlsOptions to;
+        to.alpn = {"h2"};
+        std::string err;
+        tls = TlsContext::NewClient(to, &err).release();
+        if (tls == nullptr) {
+          BRT_LOG(ERROR) << "h2 client tls context: " << err;
+          return EPROTO;
+        }
+      }
+    }
+    // SNI omitted: the endpoint is an IP literal (RFC 6066 forbids those
+    // in server_name); hostname-carrying callers use Channel's ssl_sni.
+    const int trc = p->StartTlsClient(tls, "", core->timeout_us);
+    if (trc != 0) return trc;
+  }
+  IOBuf hello;
+  hello.append(kPreface, sizeof(kPreface) - 1);
+  AppendH2FrameHeader(&hello, 12, H2FrameType::SETTINGS, 0, 0);
+  const std::pair<uint16_t, uint32_t> kv[] = {
+      {4, kClientConnWindow}, {5, 1u << 20}};
+  for (auto [id, v] : kv) {
+    uint8_t b[6] = {uint8_t(id >> 8), uint8_t(id),     uint8_t(v >> 24),
+                    uint8_t(v >> 16), uint8_t(v >> 8), uint8_t(v)};
+    hello.append(b, 6);
+  }
+  // Grow the connection receive window up front (WINDOW_UPDATE on 0).
+  AppendH2FrameHeader(&hello, 4, H2FrameType::WINDOW_UPDATE, 0, 0);
+  const uint32_t inc = kClientConnWindow - 65535;
+  uint8_t b[4] = {uint8_t(inc >> 24), uint8_t(inc >> 16), uint8_t(inc >> 8),
+                  uint8_t(inc)};
+  hello.append(b, 4);
+  return p->Write(&hello);
+}
+
+int H2Client::Fetch(const std::string& method, const std::string& path,
+                    const HeaderList& headers, const IOBuf& body,
+                    H2Result* out, int64_t timeout_ms) {
+  SocketUniquePtr p;  // held across the wait: keeps H2Core alive
+  if (impl_->sock == INVALID_SOCKET_ID ||
+      Socket::Address(impl_->sock, &p) != 0 || p->Failed()) {
+    return ECONNRESET;
+  }
+  auto* core = static_cast<H2Core*>(p->parsing_context());
+  StreamWaiter waiter;
+  waiter.out = out;
+
+  IOBuf payload = body;  // shares blocks
+  uint32_t id;
+  {
+    std::lock_guard<std::mutex> g(core->mu);
+    // Requests beyond the send windows fail loudly instead of
+    // deadlocking (unary bodies in this framework stay far under the
+    // 64KB-4MB windows). Checked BEFORE any state is advanced: bailing
+    // after Encode would desync the connection-wide HPACK table from the
+    // peer and leave window accounting corrupted for later Fetches.
+    const int64_t need = int64_t(payload.size());
+    if (need > core->conn_send_window ||
+        need > int64_t(core->peer_initial_window)) {
+      return EMSGSIZE;
+    }
+    id = core->next_stream_id;
+    core->next_stream_id += 2;
+    core->streams[id] = &waiter;
+    core->stream_send_window[id] = core->peer_initial_window;
+
+    HeaderList req_headers;
+    req_headers.push_back({":method", method, false});
+    req_headers.push_back({":scheme", core->tls ? "https" : "http", false});
+    req_headers.push_back({":path", path, false});
+    req_headers.push_back({":authority", "h2-client", false});
+    for (const HeaderField& f : headers) req_headers.push_back(f);
+    // HPACK encoder state must match wire order: encode AND enqueue under
+    // the lock.
+    std::string block;
+    core->enc.Encode(req_headers, &block);
+    IOBuf wire;
+    const bool has_body = !payload.empty();
+    AppendH2FrameHeader(&wire, uint32_t(block.size()), H2FrameType::HEADERS,
+                        has_body ? 0x4 : 0x5 /*+END_STREAM*/, id);
+    wire.append(block);
+    // DATA with END_STREAM, chunked to the peer's max frame.
+    size_t remaining = payload.size();
+    while (remaining > 0) {
+      const size_t n = remaining < core->peer_max_frame
+                           ? remaining
+                           : size_t(core->peer_max_frame);
+      IOBuf piece;
+      payload.cutn(&piece, n);
+      remaining -= n;
+      AppendH2FrameHeader(&wire, uint32_t(n), H2FrameType::DATA,
+                          remaining == 0 ? 0x1 : 0, id);
+      wire.append(piece);
+      core->conn_send_window -= int64_t(n);
+      core->stream_send_window[id] -= int64_t(n);
+    }
+    p->Write(&wire);
+  }
+
+  const int64_t tmo = timeout_ms >= 0 ? timeout_ms * 1000 : core->timeout_us;
+  if (waiter.done.wait(tmo) != 0) {
+    {
+      std::lock_guard<std::mutex> g(core->mu);
+      auto it = core->streams.find(id);
+      if (it != core->streams.end() && it->second == &waiter) {
+        core->streams.erase(it);
+        core->stream_send_window.erase(id);
+        // Tell the server we gave up on this stream.
+        IOBuf rst;
+        AppendH2FrameHeader(&rst, 4, H2FrameType::RST_STREAM, 0, id);
+        uint8_t cancel[4] = {0, 0, 0, 8};  // CANCEL
+        rst.append(cancel, 4);
+        p->Write(&rst);
+        return ETIMEDOUT;
+      }
+    }
+    // A finisher claimed the waiter concurrently: take its result.
+    waiter.done.wait(-1);
+  }
+  return waiter.rc;
+}
+
+}  // namespace brt

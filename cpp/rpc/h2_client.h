@@ -1,0 +1,58 @@
+// General HTTP/2 client session: one connection, concurrent requests
+// multiplex as h2 streams, replies match by stream id. Carries ANY
+// client HTTP traffic — GrpcClient is a veneer adding gRPC framing and
+// status mapping, HttpFetchH2 (rpc/http_client.h) the one-shot fetch
+// used by rpc_view/parallel_http for h2c endpoints.
+// Parity target: reference src/brpc/policy/http2_rpc_protocol.cpp client
+// paths (H2Context stream management, SETTINGS/WINDOW_UPDATE handling,
+// connection-wide HPACK state). Redesigned to this framework's
+// blocking-client shape: Connect performs the preface/SETTINGS exchange;
+// Fetch opens a stream, sends HPACK-encoded headers (+DATA) and parks
+// the calling fiber until END_STREAM / RST / timeout.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include "rpc/hpack.h"
+
+namespace brt {
+
+struct H2Result {
+  int status = 0;       // :status pseudo-header
+  HeaderList headers;   // response headers AND trailers, wire order
+  IOBuf body;           // concatenated DATA payload
+
+  // Convenience: last header with this (lowercase) name, or nullptr.
+  const std::string* header(const std::string& name) const;
+};
+
+class H2Client {
+ public:
+  H2Client();
+  ~H2Client();
+
+  // use_tls: ALPN "h2" over TLS (certs accepted unverified — the
+  // in-framework `curl -k` trust model); otherwise h2c prior knowledge.
+  int Connect(const EndPoint& server, int64_t timeout_ms = 2000,
+              bool use_tls = false);
+
+  // One request/response exchange on its own stream; concurrent Fetches
+  // multiplex. `headers` are EXTRA request headers (lowercase names; the
+  // :method/:scheme/:path/:authority pseudo-headers are built from the
+  // other arguments). Returns 0 with *out filled, or errno-style.
+  int Fetch(const std::string& method, const std::string& path,
+            const HeaderList& headers, const IOBuf& body, H2Result* out,
+            int64_t timeout_ms = -1);  // -1: the Connect timeout
+
+  bool connected() const;
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace brt

@@ -3,6 +3,7 @@
 #include <atomic>
 
 #include "base/logging.h"
+#include "rpc/h2_client.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
 #include "transport/socket.h"
@@ -111,6 +112,34 @@ TlsContext* DefaultClientTls() {
     if (ctx == nullptr) BRT_LOG(ERROR) << "https client tls context: " << err;
   }
   return ctx;
+}
+
+int HttpFetchH2(const EndPoint& server, const std::string& method,
+                const std::string& path, const std::string& body,
+                const std::string& content_type, HttpClientResult* out,
+                int64_t timeout_ms, bool use_tls) {
+  H2Client h2;
+  int rc = h2.Connect(server, timeout_ms, use_tls);
+  if (rc != 0) return rc;
+  HeaderList headers;
+  if (!content_type.empty()) {
+    headers.push_back({"content-type", content_type, false});
+  }
+  IOBuf req;
+  req.append(body);
+  H2Result res;
+  rc = h2.Fetch(method, path, headers, req, &res, timeout_ms);
+  if (rc != 0) return rc;
+  out->status = res.status;
+  out->head = HttpMessage();
+  out->head.status = res.status;
+  for (const HeaderField& f : res.headers) {
+    if (!f.name.empty() && f.name[0] != ':') {
+      out->head.append_header(f.name, f.value);
+    }
+  }
+  out->body = res.body.to_string();
+  return 0;
 }
 
 std::string UrlEscape(const std::string& in) {

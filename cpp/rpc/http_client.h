@@ -57,4 +57,14 @@ inline int HttpsGet(const EndPoint& server, const std::string& path,
                    /*use_tls=*/true);
 }
 
+// Same contract as HttpFetch but over HTTP/2 (h2c prior knowledge, or
+// ALPN h2 under use_tls), riding the general H2Client session
+// (rpc/h2_client.h): one-shot — connect, exchange, tear down. Response
+// headers land in out->head.headers (lowercase names, h2 style) with
+// out->status from :status.
+int HttpFetchH2(const EndPoint& server, const std::string& method,
+                const std::string& path, const std::string& body,
+                const std::string& content_type, HttpClientResult* out,
+                int64_t timeout_ms = 5000, bool use_tls = false);
+
 }  // namespace brt

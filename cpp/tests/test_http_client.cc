@@ -22,6 +22,30 @@ int main() {
   HttpClientResult res;
   assert(HttpGet(s.listen_address(), "/health", &res) == 0);
   assert(res.status == 200 && res.body == "OK\n");
+  // 1b) the SAME fetches over h2c (prior knowledge) through the general
+  // H2Client session — identical status/body, h2-style headers.
+  {
+    HttpClientResult h2res;
+    assert(HttpFetchH2(s.listen_address(), "GET", "/health", "", "",
+                       &h2res) == 0);
+    assert(h2res.status == 200 && h2res.body == "OK\n");
+    HttpClientResult h1post, h2post;
+    assert(HttpFetch(s.listen_address(), "POST", "/Echo/Echo", "same-body",
+                     "application/octet-stream", &h1post) == 0);
+    assert(HttpFetchH2(s.listen_address(), "POST", "/Echo/Echo",
+                       "same-body", "application/octet-stream",
+                       &h2post) == 0);
+    assert(h1post.status == 200 && h2post.status == 200);
+    assert(h1post.body == h2post.body && h2post.body == "same-body");
+    // 404s agree too.
+    HttpClientResult h1miss, h2miss;
+    assert(HttpFetch(s.listen_address(), "GET", "/no/such", "", "",
+                     &h1miss) == 0);
+    assert(HttpFetchH2(s.listen_address(), "GET", "/no/such", "", "",
+                       &h2miss) == 0);
+    assert(h1miss.status == 404 && h2miss.status == 404);
+    printf("h1/h2c parity OK (GET, POST echo, 404)\n");
+  }
   // 2) close-delimited body (no Content-Length)
   int lfd = socket(AF_INET, SOCK_STREAM, 0);
   sockaddr_in sa{}; sa.sin_family = AF_INET; sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK); sa.sin_port = 0;

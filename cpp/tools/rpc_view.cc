@@ -13,6 +13,7 @@
 
 #include "base/endpoint.h"
 #include "fiber/fiber.h"
+#include "rpc/h2_client.h"
 #include "rpc/http_client.h"
 
 using namespace brt;
@@ -20,7 +21,7 @@ using namespace brt;
 int main(int argc, char** argv) {
   if (argc < 2) {
     fprintf(stderr,
-            "usage: rpc_view <ip:port> [page] [--watch seconds]\n"
+            "usage: rpc_view <ip:port> [page] [--watch seconds] [--h2]\n"
             "e.g.   rpc_view 127.0.0.1:8000 /status --watch 2\n");
     return 1;
   }
@@ -31,17 +32,37 @@ int main(int argc, char** argv) {
   }
   std::string page = "/status";
   int watch_s = 0;
+  bool use_h2 = false;
   for (int i = 2; i < argc; ++i) {
     if (strcmp(argv[i], "--watch") == 0 && i + 1 < argc) {
       watch_s = atoi(argv[++i]);
+    } else if (strcmp(argv[i], "--h2") == 0) {
+      use_h2 = true;
     } else if (argv[i][0] == '/') {
       page = argv[i];
     }
   }
   fiber_init(2);
+  // --h2: ONE session across watch polls (streams multiplex; no
+  // reconnect per refresh).
+  H2Client h2;
   for (;;) {
     HttpClientResult res;
-    const int rc = HttpGet(server, page, &res, 70 * 1000);
+    int rc;
+    if (use_h2) {
+      if (!h2.connected()) rc = h2.Connect(server, 70 * 1000);
+      else rc = 0;
+      if (rc == 0) {
+        H2Result hres;
+        rc = h2.Fetch("GET", page, {}, IOBuf(), &hres, 70 * 1000);
+        if (rc == 0) {
+          res.status = hres.status;
+          res.body = hres.body.to_string();
+        }
+      }
+    } else {
+      rc = HttpGet(server, page, &res, 70 * 1000);
+    }
     if (rc != 0) {
       fprintf(stderr, "fetch %s%s failed: %s\n", argv[1], page.c_str(),
               strerror(rc));
