@@ -170,7 +170,8 @@ int ClusterChannel::IssueRPC(Controller* cntl) {
   }
 
   SocketUniquePtr sock;
-  rc = GetOrNewSocket(out.node.ep, eff_conn_type_, &sock,
+  const ConnectionType ct = EffConnType(cntl);
+  rc = GetOrNewSocket(out.node.ep, ct, &sock,
                       options_.connect_timeout_us,
                       options_.connection_group, tls_ctx_.get(),
                       options_.ssl_sni, proto_);
@@ -184,7 +185,7 @@ int ClusterChannel::IssueRPC(Controller* cntl) {
     return rc;
   }
   c.attempt_pending = true;
-  return SendAttempt(cntl, sock, out.node.ep);
+  return SendAttempt(cntl, sock, out.node.ep, ct);
 }
 
 }  // namespace brt

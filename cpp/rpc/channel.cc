@@ -46,26 +46,45 @@ int Channel::InitTls() {
 
 int Channel::ResolveProtocol() {
   RegisterBuiltinClientProtocols();
-  eff_conn_type_ = options_.connection_type;
   if (options_.protocol.empty() || options_.protocol == "brt_std") {
     proto_ = nullptr;
-    return 0;
+  } else {
+    proto_ = FindClientProtocol(options_.protocol);
+    if (proto_ == nullptr) {
+      BRT_LOG(ERROR) << "unknown client protocol '" << options_.protocol
+                     << "'";
+      return EINVAL;
+    }
   }
-  proto_ = FindClientProtocol(options_.protocol);
-  if (proto_ == nullptr) {
-    BRT_LOG(ERROR) << "unknown client protocol '" << options_.protocol
-                   << "'";
-    return EINVAL;
+  return 0;
+}
+
+ConnectionType Channel::EffConnType(const Controller* cntl) const {
+  // Out-of-range per-call values fall back to the channel default: a
+  // bogus cast would be interpreted inconsistently across layers (the
+  // socket map would hand back the SHARED multiplexed socket while
+  // EndRPC's exclusive-socket disposal would SetFailed it, erroring
+  // every unrelated in-flight call on the connection).
+  ConnectionType t =
+      cntl != nullptr && cntl->connection_type >= 0 &&
+              cntl->connection_type <= int(ConnectionType::ADAPTIVE)
+          ? ConnectionType(cntl->connection_type)
+          : options_.connection_type;
+  // ADAPTIVE (reference adaptive_connection_type.h): multiplexed or
+  // pipelined protocols share one connection; the rest go exclusive.
+  if (t == ConnectionType::ADAPTIVE) {
+    t = (proto_ == nullptr || proto_->pipelined_safe)
+            ? ConnectionType::SINGLE
+            : ConnectionType::POOLED;
   }
   // Without a pipelining guarantee a shared multiplexed connection would
   // interleave concurrent callers' requests; exclusive POOLED connections
-  // keep the one-in-flight-per-connection invariant (reference forbids
-  // SINGLE for such protocols, adaptive_connection_type).
-  if (!proto_->pipelined_safe &&
-      eff_conn_type_ == ConnectionType::SINGLE) {
-    eff_conn_type_ = ConnectionType::POOLED;
+  // keep the one-in-flight-per-connection invariant.
+  if (proto_ != nullptr && !proto_->pipelined_safe &&
+      t == ConnectionType::SINGLE) {
+    t = ConnectionType::POOLED;
   }
-  return 0;
+  return t;
 }
 
 int Channel::Init(const EndPoint& server, const ChannelOptions* opts) {
@@ -130,15 +149,20 @@ void Channel::CallMethod(const std::string& service, const std::string& method,
       options_.auth->GenerateCredential(&c.request_meta.auth) != 0;
   c.request_body = request;  // shares blocks — no copy
   c.request_body.append(cntl->request_attachment());
+  // Channel-default request compression when the call didn't choose —
+  // an EFFECTIVE value like timeout/retry above, not a write-back (the
+  // controller may be Reset and reused on a channel with no default).
   // Meta-signaled compression is a brt_std feature; foreign protocols
   // carry their own content encodings (http veneers set headers).
-  if (cntl->request_compress_type != 0 && proto_ == nullptr) {
-    const CompressHandler* h =
-        GetCompressHandler(cntl->request_compress_type);
+  const uint8_t compress = cntl->request_compress_type != 0
+                               ? cntl->request_compress_type
+                               : options_.request_compress_type;
+  if (compress != 0 && proto_ == nullptr) {
+    const CompressHandler* h = GetCompressHandler(compress);
     IOBuf packed;
     if (h != nullptr && h->compress(c.request_body, &packed)) {
       c.request_body = std::move(packed);
-      c.request_meta.compress_type = cntl->request_compress_type;
+      c.request_meta.compress_type = compress;
     }
   }
 
@@ -184,7 +208,7 @@ void Channel::CallMethod(const std::string& service, const std::string& method,
 }
 
 int Channel::SendAttempt(Controller* cntl, SocketUniquePtr& sock,
-                         const EndPoint& ep) {
+                         const EndPoint& ep, ConnectionType conn_type) {
   Controller::Call& c = cntl->call;
   // A retry attempt abandons the previous socket's response wait. On
   // exclusive (POOLED/SHORT) connections the superseded socket must also
@@ -196,14 +220,14 @@ int Channel::SendAttempt(Controller* cntl, SocketUniquePtr& sock,
     if (Socket::Address(c.last_socket, &prev) == 0) {
       prev->RemoveWaiter(c.cid);
     }
-    if (eff_conn_type_ != ConnectionType::SINGLE) {
+    if (conn_type != ConnectionType::SINGLE) {
       c.superseded.push_back(c.last_socket);
     }
   }
   cntl->set_remote_side(ep);
   c.last_socket = sock->id();
   c.reply_consumed = false;  // refers to THIS attempt's socket
-  c.conn_type = int(eff_conn_type_);
+  c.conn_type = int(conn_type);
   c.conn_group = options_.connection_group;
   c.conn_tls = tls_ctx_.get();
   c.conn_proto = proto_;
@@ -235,7 +259,8 @@ int Channel::SendAttempt(Controller* cntl, SocketUniquePtr& sock,
 
 int Channel::IssueRPC(Controller* cntl) {
   SocketUniquePtr sock;
-  const int rc = GetOrNewSocket(server_, eff_conn_type_, &sock,
+  const ConnectionType ct = EffConnType(cntl);
+  const int rc = GetOrNewSocket(server_, ct, &sock,
                                 options_.connect_timeout_us,
                                 options_.connection_group, tls_ctx_.get(),
                                 options_.ssl_sni, proto_);
@@ -244,7 +269,7 @@ int Channel::IssueRPC(Controller* cntl) {
                     "fail to connect %s", server_.to_string().c_str());
     return rc ? rc : ECONNREFUSED;
   }
-  return SendAttempt(cntl, sock, server_);
+  return SendAttempt(cntl, sock, server_, ct);
 }
 
 }  // namespace brt

@@ -25,9 +25,15 @@ struct ChannelOptions {
   // registered client protocol — "http", "redis", "thrift", "memcache",
   // "mongo". NS/LB/circuit-breaker/retry/backup apply uniformly to all.
   // Protocols without pipelining guarantees silently upgrade SINGLE
-  // connections to POOLED.
+  // connections to POOLED; ADAPTIVE picks SINGLE for multiplexed/
+  // pipelined protocols and POOLED otherwise (reference
+  // adaptive_connection_type.h). Controller::connection_type overrides
+  // per call.
   std::string protocol = "brt_std";
   ConnectionType connection_type = ConnectionType::SINGLE;
+  // Default request compression when the controller doesn't set one
+  // (brt_std meta compression; 1 = zlib, 2 = snappy — rpc/compress.h).
+  uint8_t request_compress_type = 0;
   // SINGLE connections are shared per (endpoint, connection_group): distinct
   // groups get private multiplexed connections (the reference's
   // ChannelSignature role in SocketMap keys).
@@ -100,11 +106,16 @@ class Channel : public ChannelBase, public CallIssuer {
   // ClusterChannel inits). Returns 0 or EINVAL for unknown protocols.
   int ResolveProtocol();
 
+  // The connection type one attempt uses: the controller's per-call
+  // override (if any) or the channel default, with ADAPTIVE resolved per
+  // protocol and non-pipelined protocols upgraded off SINGLE.
+  ConnectionType EffConnType(const Controller* cntl) const;
+
   // One attempt's tail, shared by Channel and ClusterChannel: waiter
   // bookkeeping, pack (brt frame or foreign protocol), write/FIFO-enqueue.
   // Called with the correlation id locked and `sock` live.
   int SendAttempt(Controller* cntl, SocketUniquePtr& sock,
-                  const EndPoint& ep);
+                  const EndPoint& ep, ConnectionType conn_type);
 
   ChannelOptions options_;
   EndPoint server_;
@@ -112,7 +123,6 @@ class Channel : public ChannelBase, public CallIssuer {
   std::shared_ptr<class TlsContext> tls_ctx_;  // null for plaintext
   // Null for brt_std (the InputMessenger multiplexing path).
   const struct ClientProtocol* proto_ = nullptr;
-  ConnectionType eff_conn_type_ = ConnectionType::SINGLE;
 };
 
 }  // namespace brt

@@ -75,6 +75,7 @@ void Controller::Reset() {
   retried_ = 0;
   backup_fired_ = false;
   cid_.store(0, std::memory_order_release);
+  connection_type = -1;
   call = Call();
   trace_id = span_id = parent_span_id = 0;
 }

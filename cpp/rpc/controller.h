@@ -52,6 +52,11 @@ class Controller {
   int64_t timeout_ms = INT64_MIN;
   int max_retry = -1;
   int64_t backup_request_ms = INT64_MIN;
+  // Per-call connection-type override (reference
+  // Controller::set_connection_type): -1 inherits the channel's;
+  // ConnectionType::ADAPTIVE resolves per protocol. Protocols without a
+  // pipelining guarantee still upgrade SINGLE to POOLED.
+  int connection_type = -1;
 
   // ---- error state ----
   void SetFailed(int code, const char* fmt = nullptr, ...);

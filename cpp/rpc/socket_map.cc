@@ -127,6 +127,9 @@ int GetOrNewSocket(const EndPoint& remote, ConnectionType type,
     std::call_once(g_tls_observer_once,
                    [] { TlsContext::SetDestroyObserver(&PurgeTlsEntries); });
   }
+  // ADAPTIVE is a Channel-layer notion; a stray one here behaves as the
+  // safe multiplexed default.
+  if (type == ConnectionType::ADAPTIVE) type = ConnectionType::SINGLE;
   const MapKey key{remote, group, tls, proto};
   if (type == ConnectionType::SHORT) {
     return NewConnection(remote, out, connect_timeout_us, tls, sni, proto);

@@ -11,7 +11,10 @@
 
 namespace brt {
 
-enum class ConnectionType { SINGLE, POOLED, SHORT };
+// ADAPTIVE exists only at the Channel/Controller option layer (reference
+// adaptive_connection_type.h): it resolves to SINGLE for multiplexed /
+// pipelined-safe protocols and POOLED otherwise BEFORE reaching the map.
+enum class ConnectionType { SINGLE, POOLED, SHORT, ADAPTIVE };
 
 // Returns a live socket to `remote`, creating/reviving as needed.
 // For SINGLE the same multiplexed socket is shared by all callers with the

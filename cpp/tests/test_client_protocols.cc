@@ -342,6 +342,70 @@ void test_redis_pipelining(const EndPoint& ep) {
          F * PER);
 }
 
+// Client option surface (reference channel.h:41-149 / controller.h:113):
+// per-call connection-type override, ADAPTIVE resolution, channel-default
+// request compression.
+void test_client_options(const EndPoint& ep) {
+  // ADAPTIVE + redis (pipelined) → behaves like SINGLE.
+  {
+    Channel ch;
+    ChannelOptions opts;
+    opts.protocol = "redis";
+    opts.connection_type = ConnectionType::ADAPTIVE;
+    assert(ch.Init(ep, &opts) == 0);
+    IOBuf cmd, rsp;
+    SerializeRedisCommand({"WHOAMI"}, &cmd);
+    Controller cntl;
+    ch.CallMethod("", "", &cntl, cmd, &rsp, nullptr);
+    assert(!cntl.Failed() && cntl.redis_reply != nullptr);
+  }
+  // ADAPTIVE + http (not pipelined-safe) → resolves to POOLED and works.
+  {
+    Channel ch;
+    ChannelOptions opts;
+    opts.protocol = "http";
+    opts.connection_type = ConnectionType::ADAPTIVE;
+    assert(ch.Init(ep, &opts) == 0);
+    Controller cntl;
+    IOBuf req, rsp;
+    cntl.http_request()->path = "/status";
+    ch.CallMethod("", "", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed() && cntl.http_response()->status == 200);
+  }
+  // Per-call override: a SINGLE brt_std channel forced SHORT for one call
+  // (fresh connection, torn down after) — and back to inherited SINGLE.
+  {
+    Channel ch;
+    assert(ch.Init(ep, nullptr) == 0);
+    for (int ct : {int(ConnectionType::SHORT), -1}) {
+      Controller cntl;
+      cntl.connection_type = ct;
+      IOBuf req, rsp;
+      req.append("opt");
+      ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+      assert(!cntl.Failed() && rsp.to_string() == "opt");
+    }
+  }
+  // Channel-default request compression: the server decompresses
+  // transparently and echoes the plaintext.
+  {
+    Channel ch;
+    ChannelOptions opts;
+    opts.request_compress_type = 1;  // zlib
+    assert(ch.Init(ep, &opts) == 0);
+    Controller cntl;
+    IOBuf req, rsp;
+    const std::string big(8192, 'z');  // compressible
+    req.append(big);
+    ch.CallMethod("Echo", "Echo", &cntl, req, &rsp, nullptr);
+    assert(!cntl.Failed() && rsp.to_string() == big);
+    // The controller is NOT mutated: the default is an effective value.
+    assert(cntl.request_compress_type == 0);
+  }
+  printf("client_options OK (adaptive, per-call override, default "
+         "compression)\n");
+}
+
 }  // namespace
 
 int main() {
@@ -362,6 +426,7 @@ int main() {
   }
 
   test_http_single(nodes[0].server.listen_address());
+  test_client_options(nodes[0].server.listen_address());
   test_http_close_delimited();
   test_redis_pipelining(nodes[0].server.listen_address());
   test_redis_cluster_ketama(list);

@@ -1,0 +1,141 @@
+// Smoke-runs every example binary (SURVEY §2.9 tour coverage; previously
+// the examples were never executed by CI, so a drifting API could break
+// the documented tours silently). Self-terminating demos must exit 0;
+// server demos are spawned, probed over their real protocol, and torn
+// down.
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cassert>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "fiber/fiber.h"
+#include "rpc/channel.h"
+#include "rpc/http_client.h"
+#include "rpc/redis.h"
+#include "rpc/server.h"
+
+using namespace brt;
+
+namespace {
+
+int RunWithTimeout(const std::string& cmd, int seconds) {
+  const std::string full =
+      "timeout " + std::to_string(seconds) + " " + cmd + " >/dev/null 2>&1";
+  return system(full.c_str());
+}
+
+pid_t Spawn(const std::vector<std::string>& argv) {
+  fflush(stdout);  // the child inherits stdio buffers
+  fflush(stderr);
+  const pid_t pid = fork();
+  if (pid == 0) {
+    std::vector<char*> av;
+    for (const auto& a : argv) av.push_back(const_cast<char*>(a.c_str()));
+    av.push_back(nullptr);
+    freopen("/dev/null", "w", stdout);
+    freopen("/dev/null", "w", stderr);
+    execv(av[0], av.data());
+    _exit(127);
+  }
+  return pid;
+}
+
+void Kill(pid_t pid) {
+  kill(pid, SIGTERM);
+  int st;
+  waitpid(pid, &st, 0);
+}
+
+bool WaitHttp(const EndPoint& ep, const std::string& path, int tries = 50) {
+  for (int i = 0; i < tries; ++i) {
+    HttpClientResult res;
+    if (HttpGet(ep, path, &res, 1000) == 0 && res.status == 200) return true;
+    usleep(100 * 1000);
+  }
+  return false;
+}
+
+}  // namespace
+
+int main() {
+  fiber_init(4);
+
+  // Self-terminating tours (each prints its own demo output and exits 0).
+  const char* kSelfTerminating[] = {
+      "./backup_request",   "./cancel_echo",    "./cascade_echo",
+      "./combo_channels",   "./coroutine_echo", "./dynamic_partition_echo",
+      "./streaming_echo",   "./tag_echo",       "./idl_service",
+      "./tpu_ps",
+  };
+  for (const char* cmd : kSelfTerminating) {
+    const int rc = RunWithTimeout(cmd, 90);
+    if (rc != 0) {
+      fprintf(stderr, "%s exited rc=%d\n", cmd, rc);
+      assert(false);
+    }
+    printf("%s OK\n", cmd);
+  }
+  // naming_example demos a registry + watchers and then idles; a timeout
+  // exit (124) after its output is the expected shape.
+  {
+    const int rc = RunWithTimeout("./naming_example", 10);
+    assert(rc == 0 || WEXITSTATUS(rc) == 124);
+    printf("./naming_example OK\n");
+  }
+
+  // echo_server + echo_client + parallel_echo against it.
+  {
+    const pid_t srv = Spawn({"./echo_server", "18761"});
+    EndPoint ep;
+    EndPoint::parse("127.0.0.1:18761", &ep);
+    assert(WaitHttp(ep, "/health"));
+    assert(RunWithTimeout("./echo_client 127.0.0.1:18761 smoke", 20) == 0);
+    assert(RunWithTimeout(
+               "./parallel_echo 127.0.0.1:18761 127.0.0.1:18761", 20) == 0);
+    Kill(srv);
+    printf("./echo_server + ./echo_client + ./parallel_echo OK\n");
+  }
+
+  // http_restful: GET /Calc/Sum-style JSON service.
+  {
+    const pid_t srv = Spawn({"./http_restful", "18762"});
+    EndPoint ep;
+    EndPoint::parse("127.0.0.1:18762", &ep);
+    assert(WaitHttp(ep, "/health"));
+    HttpClientResult res;
+    assert(HttpFetch(ep, "POST", "/Calc/Sum", R"({"vals":[1,2,3]})",
+                     "application/json", &res) == 0);
+    assert(res.status == 200 &&
+           res.body.find("\"sum\":6") != std::string::npos);
+    Kill(srv);
+    printf("./http_restful OK (sum=6)\n");
+  }
+
+  // redis_server_example: real RESP round trip.
+  {
+    const pid_t srv = Spawn({"./redis_server_example", "18763"});
+    EndPoint ep;
+    EndPoint::parse("127.0.0.1:18763", &ep);
+    RedisReply r;
+    for (int i = 0; i < 50; ++i) {
+      RedisClient cli;
+      if (cli.Init(ep) == 0) {
+        r = cli.Command({"PING"});
+        if (r.type == RedisReply::STATUS) break;
+      }
+      usleep(100 * 1000);
+    }
+    assert(r.type == RedisReply::STATUS);
+    Kill(srv);
+    printf("./redis_server_example OK (PING -> %s)\n", r.str.c_str());
+  }
+
+  printf("ALL example smoke tests OK\n");
+  return 0;
+}
