@@ -14,6 +14,7 @@
 #include <mutex>
 
 #include "base/file_watcher.h"
+#include "rpc/http_client.h"
 #include "base/logging.h"
 #include "fiber/fiber.h"
 
@@ -194,6 +195,75 @@ class DnsNamingService : public NamingService {
   fiber_t fid_ = 0;
 };
 
+// ---- remotefile:// — a node-list file fetched over HTTP and re-polled
+// (reference policy/remote_file_naming_service.cpp: the body uses the
+// same "ip:port[:tag] per line" grammar as file://) ----
+class RemoteFileNamingService : public NamingService {
+ public:
+  ~RemoteFileNamingService() override { Stop(); }
+
+  int Start(const std::string& param, ServerListCallback cb) override {
+    // param: host:port/path/to/list
+    const size_t slash = param.find('/');
+    if (slash == std::string::npos) return EINVAL;
+    if (!EndPoint::parse(param.substr(0, slash), &server_)) return EINVAL;
+    path_ = param.substr(slash);
+    cb_ = std::move(cb);
+    fiber_init(0);
+    return fiber_start(&fid_, &RemoteFileNamingService::PollEntry, this);
+  }
+
+  void Stop() override {
+    stopping_.store(true, std::memory_order_release);
+    cancel_.Cancel();
+    if (fid_ != 0) {
+      fiber_join(fid_);
+      fid_ = 0;
+    }
+  }
+
+  int interval_ms = 5000;  // exposed for tests
+
+ private:
+  static void* PollEntry(void* arg) {
+    auto* self = static_cast<RemoteFileNamingService*>(arg);
+    std::vector<ServerNode> last;
+    bool pushed_any = false;
+    while (!self->stopping_.load(std::memory_order_acquire)) {
+      HttpClientResult res;
+      const int rc = HttpFetch(self->server_, "GET", self->path_, "", "",
+                               &res, 5000, /*use_tls=*/false,
+                               &self->cancel_);
+      if (self->stopping_.load(std::memory_order_acquire)) break;
+      if (rc == 0 && res.status == 200) {
+        // Empty lists push too (matching file:// at Reload): a drained
+        // file means every node was decommissioned, not "keep the old
+        // list forever".
+        auto nodes = ParseNodeList(res.body, "\n\r \t");
+        if (!pushed_any || nodes != last) {
+          self->cb_(nodes);
+          last = std::move(nodes);
+          pushed_any = true;
+        }
+      }
+      for (int waited = 0;
+           waited < self->interval_ms &&
+           !self->stopping_.load(std::memory_order_acquire);
+           waited += 100) {
+        fiber_usleep(100 * 1000);
+      }
+    }
+    return nullptr;
+  }
+
+  EndPoint server_;
+  std::string path_;
+  ServerListCallback cb_;
+  fiber_t fid_ = 0;
+  std::atomic<bool> stopping_{false};
+  FetchCancel cancel_;
+};
+
 void RegisterBuiltinNs() {
   static std::once_flag once;
   std::call_once(once, [] {
@@ -226,6 +296,11 @@ void RegisterBuiltinNs() {
     // (cluster/nacos_naming.h; reference nacos_naming_service.cpp).
     RegisterNamingService("nacos", [] {
       return std::unique_ptr<NamingService>(new NacosNamingService);
+    });
+    // remotefile://host:port/path — node-list file over HTTP, re-polled
+    // (reference policy/remote_file_naming_service.cpp).
+    RegisterNamingService("remotefile", [] {
+      return std::unique_ptr<NamingService>(new RemoteFileNamingService);
     });
   });
 }

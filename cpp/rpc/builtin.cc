@@ -1,5 +1,7 @@
 #include "rpc/builtin.h"
 
+#include "rpc/uri.h"
+
 #include <dirent.h>
 #include <sys/stat.h>
 
@@ -130,30 +132,6 @@ void PrintSchema(std::ostringstream& os, const StructSchema& s, int indent) {
   }
 }
 
-// Query values arrive percent-encoded (browsers always encode spaces,
-// '&', '+', non-ASCII); decode before touching the filesystem, as the
-// reference dir_service does for its argument.
-std::string QueryUnescape(const std::string& in) {
-  std::string out;
-  out.reserve(in.size());
-  for (size_t i = 0; i < in.size(); ++i) {
-    if (in[i] == '+') {
-      out += ' ';
-    } else if (in[i] == '%' && i + 2 < in.size() &&
-               isxdigit(static_cast<unsigned char>(in[i + 1])) &&
-               isxdigit(static_cast<unsigned char>(in[i + 2]))) {
-      auto hex = [](char c) {
-        return c <= '9' ? c - '0' : (c | 0x20) - 'a' + 10;
-      };
-      out += char(hex(in[i + 1]) * 16 + hex(in[i + 2]));
-      i += 2;
-    } else {
-      out += in[i];
-    }
-  }
-  return out;
-}
-
 // /dir?path=/x — filesystem browser (reference dir_service.cpp; an
 // internal debug page, gated by the same auth hook as every builtin).
 void DirPage(const std::string& query, HttpResponse* out) {
@@ -163,7 +141,9 @@ void DirPage(const std::string& query, HttpResponse* out) {
     path = query.substr(pos + 5);
     const size_t amp = path.find('&');
     if (amp != std::string::npos) path = path.substr(0, amp);
-    path = QueryUnescape(path);
+    // Query values arrive percent-encoded (browsers always
+    // encode spaces, '&', '+', non-ASCII).
+    path = UriUnescape(path);
   }
   DIR* d = opendir(path.c_str());
   if (d == nullptr) {

@@ -75,6 +75,15 @@ void Controller::Reset() {
   retried_ = 0;
   backup_fired_ = false;
   cid_.store(0, std::memory_order_release);
+  // Per-call option overrides revert to "inherit the channel's" as a
+  // group — resetting some but not others would surprise reuse-heavy
+  // clients.
+  timeout_ms = INT64_MIN;
+  max_retry = -1;
+  backup_request_ms = INT64_MIN;
+  request_compress_type = 0;
+  response_compress_type = 0;
+  request_code = 0;
   connection_type = -1;
   call = Call();
   trace_id = span_id = parent_span_id = 0;

@@ -1,0 +1,156 @@
+#include "rpc/uri.h"
+
+#include <cctype>
+
+namespace brt {
+
+std::string UriUnescape(const std::string& in, bool form) {
+  std::string out;
+  out.reserve(in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    if (form && in[i] == '+') {
+      out += ' ';
+    } else if (in[i] == '%' && i + 2 < in.size() &&
+               isxdigit(static_cast<unsigned char>(in[i + 1])) &&
+               isxdigit(static_cast<unsigned char>(in[i + 2]))) {
+      auto hex = [](char c) {
+        return c <= '9' ? c - '0' : (c | 0x20) - 'a' + 10;
+      };
+      out += char(hex(in[i + 1]) * 16 + hex(in[i + 2]));
+      i += 2;
+    } else {
+      out += in[i];
+    }
+  }
+  return out;
+}
+
+void Uri::Clear() {
+  scheme_.clear();
+  userinfo_.clear();
+  host_.clear();
+  path_ = "/";
+  query_.clear();
+  fragment_.clear();
+  queries_.clear();
+  port_ = -1;
+}
+
+bool Uri::Parse(const std::string& url) {
+  if (!ParseInternal(url)) {
+    Clear();  // header contract: failed parses leave no partial fields
+    return false;
+  }
+  return true;
+}
+
+bool Uri::ParseInternal(const std::string& url) {
+  Clear();
+  size_t b = 0, e = url.size();
+  while (b < e && isspace(static_cast<unsigned char>(url[b]))) ++b;
+  while (e > b && isspace(static_cast<unsigned char>(url[e - 1]))) --e;
+  if (b == e) return false;
+  std::string s = url.substr(b, e - b);
+
+  // Fragment first (never contains the other delimiters).
+  const size_t hash = s.find('#');
+  if (hash != std::string::npos) {
+    fragment_ = s.substr(hash + 1);
+    s = s.substr(0, hash);
+  }
+  const size_t q = s.find('?');
+  if (q != std::string::npos) {
+    query_ = s.substr(q + 1);
+    s = s.substr(0, q);
+  }
+  // scheme://
+  const size_t ss = s.find("://");
+  std::string rest;
+  if (ss != std::string::npos) {
+    scheme_ = s.substr(0, ss);
+    for (char c : scheme_) {
+      if (!isalnum(static_cast<unsigned char>(c)) && c != '+' && c != '-' &&
+          c != '.') {
+        return false;
+      }
+    }
+    rest = s.substr(ss + 3);
+  } else {
+    rest = s;
+  }
+  // authority [/path]
+  const size_t slash = rest.find('/');
+  std::string authority =
+      slash == std::string::npos ? rest : rest.substr(0, slash);
+  if (slash != std::string::npos) path_ = rest.substr(slash);
+  if (rest.empty() || rest[0] == '/') {
+    // Path-only form ("/a/b?x=1") — only valid WITHOUT a scheme; a
+    // scheme promises an authority ("http://" alone is malformed).
+    if (!scheme_.empty()) return false;
+    authority.clear();
+    path_ = rest.empty() ? "/" : rest;
+  }
+  if (!authority.empty()) {
+    const size_t at = authority.rfind('@');
+    if (at != std::string::npos) {
+      userinfo_ = authority.substr(0, at);
+      authority = authority.substr(at + 1);
+    }
+    const size_t colon = authority.rfind(':');
+    if (colon != std::string::npos &&
+        authority.find(':') == colon) {  // single colon = host:port
+      const std::string p = authority.substr(colon + 1);
+      if (p.empty()) return false;
+      long v = 0;
+      for (char c : p) {
+        if (!isdigit(static_cast<unsigned char>(c))) return false;
+        v = v * 10 + (c - '0');
+        if (v > 65535) return false;
+      }
+      port_ = int(v);
+      authority = authority.substr(0, colon);
+    }
+    host_ = authority;
+    if (host_.empty()) return false;
+  }
+  // Query map (decoded; raw kept in query_).
+  size_t p = 0;
+  while (p <= query_.size() && !query_.empty()) {
+    size_t amp = query_.find('&', p);
+    if (amp == std::string::npos) amp = query_.size();
+    const std::string kv = query_.substr(p, amp - p);
+    if (!kv.empty()) {
+      const size_t eq = kv.find('=');
+      if (eq == std::string::npos) {
+        queries_.emplace_back(UriUnescape(kv), "");
+      } else {
+        queries_.emplace_back(UriUnescape(kv.substr(0, eq)),
+                              UriUnescape(kv.substr(eq + 1)));
+      }
+    }
+    if (amp == query_.size()) break;
+    p = amp + 1;
+  }
+  return true;
+}
+
+const std::string* Uri::GetQuery(const std::string& key) const {
+  for (const auto& [k, v] : queries_) {
+    if (k == key) return &v;
+  }
+  return nullptr;
+}
+
+std::string Uri::to_string() const {
+  std::string s;
+  if (!scheme_.empty()) s += scheme_ + "://";
+  if (!userinfo_.empty()) s += userinfo_ + "@";
+  s += host_;
+  if (port_ >= 0) s += ":" + std::to_string(port_);
+  s += path_;
+  if (!query_.empty()) s += "?" + query_;
+  if (!fragment_.empty()) s += "#" + fragment_;
+  return s;
+}
+
+}  // namespace brt

@@ -1,0 +1,54 @@
+// URI type: decomposes http(ish) URLs into scheme/userinfo/host/port/
+// path/query/fragment with a parsed, percent-decoded query map.
+// Parity target: reference src/brpc/uri.h:52 (URI class + QueryMap;
+// fuzz_uri.cpp corpus). Redesigned small: one linear parse, fields as
+// plain strings, query iteration in insertion order.
+#pragma once
+
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace brt {
+
+class Uri {
+ public:
+  // Parses `url` (leading/trailing spaces skipped; scheme, userinfo,
+  // port, query, fragment all optional). False on malformed input —
+  // fields are left cleared.
+  bool Parse(const std::string& url);
+
+  void Clear();
+
+  const std::string& scheme() const { return scheme_; }
+  const std::string& userinfo() const { return userinfo_; }
+  const std::string& host() const { return host_; }
+  int port() const { return port_; }  // -1 when absent
+  const std::string& path() const { return path_; }  // "/" default
+  const std::string& query() const { return query_; }  // raw, no '?'
+  const std::string& fragment() const { return fragment_; }
+
+  // Percent-decoded query parameters, insertion-ordered; repeated keys
+  // keep every occurrence. nullptr when absent.
+  const std::string* GetQuery(const std::string& key) const;
+  const std::vector<std::pair<std::string, std::string>>& queries() const {
+    return queries_;
+  }
+
+  // Recomposes the URI (percent-encoding is NOT re-applied to fields;
+  // the raw query string is reused verbatim).
+  std::string to_string() const;
+
+ private:
+  bool ParseInternal(const std::string& url);
+
+  std::string scheme_, userinfo_, host_, path_ = "/", query_, fragment_;
+  int port_ = -1;
+  std::vector<std::pair<std::string, std::string>> queries_;
+};
+
+// Percent-decodes a URI component ('+' becomes space when `form` is
+// true). Exposed for builtins and query handling.
+std::string UriUnescape(const std::string& in, bool form = true);
+
+}  // namespace brt

@@ -30,6 +30,7 @@
 #include "rpc/server.h"
 #include "rpc/thrift.h"
 #include "rpc/thrift_binary.h"
+#include "rpc/uri.h"
 #include "fiber/fiber.h"
 
 using namespace brt;
@@ -310,6 +311,51 @@ void fuzz_amf0() {
   printf("fuzz_amf0 OK\n");
 }
 
+// Uri: conformance vectors (reference uri.h semantics) + mutation fuzz
+// (reference test/fuzzing/fuzz_uri.cpp).
+void fuzz_uri() {
+  {
+    Uri u;
+    assert(u.Parse(
+        "http://user:pw@www.example.com:8080/a/b%20c?x=1&y=%2F&flag#frag"));
+    assert(u.scheme() == "http" && u.userinfo() == "user:pw");
+    assert(u.host() == "www.example.com" && u.port() == 8080);
+    assert(u.path() == "/a/b%20c" && u.fragment() == "frag");
+    assert(u.GetQuery("x") != nullptr && *u.GetQuery("x") == "1");
+    assert(*u.GetQuery("y") == "/");  // percent-decoded
+    assert(u.GetQuery("flag") != nullptr && u.GetQuery("flag")->empty());
+    assert(u.GetQuery("nope") == nullptr);
+    assert(u.to_string().find("www.example.com:8080/a/b%20c?x=1") !=
+           std::string::npos);
+  }
+  {
+    Uri u;
+    assert(u.Parse("10.0.0.1:8000"));  // bare authority
+    assert(u.host() == "10.0.0.1" && u.port() == 8000 && u.path() == "/");
+    assert(u.Parse("/only/a/path?k=v"));  // path-only form
+    assert(u.host().empty() && *u.GetQuery("k") == "v");
+    assert(!u.Parse(""));
+    assert(!u.Parse("http://host:99999/"));  // port overflow
+    assert(!u.Parse("ht tp://h/"));          // bad scheme
+  }
+  assert(UriUnescape("a%2Fb+c", true) == "a/b c");
+  assert(UriUnescape("a+b", false) == "a+b");
+  const std::string valids[] = {
+      "http://u@h:80/p/q?a=1&b=%41#f",
+      "consul://127.0.0.1:8500/svc",
+      "/path?x=%zz&y",  // bad escapes pass through
+  };
+  for (int iter = 0; iter < 20000; ++iter) {
+    const std::string input = (iter % 2 == 0)
+                                  ? random_bytes(rnd() % 96)
+                                  : mutate(valids[rnd() % 3]);
+    Uri u;
+    (void)u.Parse(input);
+    if (!input.empty()) (void)UriUnescape(input);
+  }
+  printf("fuzz_uri OK\n");
+}
+
 void fuzz_mcpack() {
   JsonValue doc = JsonValue::Null();
   std::string verr;
@@ -542,6 +588,7 @@ int main() {
   fuzz_json();
   fuzz_bson();
   fuzz_amf0();
+  fuzz_uri();
   fuzz_mcpack();
   fuzz_thrift_tbinary();
   fuzz_live_server();

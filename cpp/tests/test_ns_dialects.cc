@@ -126,10 +126,10 @@ struct Pushes {
     lists.push_back(nodes);
     cv.notify_all();
   }
-  // Waits until `n` pushes arrived (3s cap).
-  bool wait_for(size_t n) {
+  // Waits until `n` pushes arrived.
+  bool wait_for(size_t n, int seconds = 5) {
     std::unique_lock<std::mutex> lk(mu);
-    return cv.wait_for(lk, std::chrono::seconds(5),
+    return cv.wait_for(lk, std::chrono::seconds(seconds),
                        [&] { return lists.size() >= n; });
   }
 };
@@ -271,6 +271,40 @@ void test_nacos_ns() {
   printf("nacos_ns OK (auth token, filtering, weights)\n");
 }
 
+void test_remotefile_ns() {
+  std::atomic<int> gen{0};
+  FakeRegistry reg([&](const std::string& path, const std::string&) {
+    assert(path == "/conf/servers.list");
+    return gen.load() == 0
+               ? std::string("10.3.0.1:9000\n10.3.0.2:9001:w=3\n# note\n")
+               : std::string("10.3.0.9:9999\n");
+  });
+  Pushes pushes;
+  auto ns = StartNamingService(
+      "remotefile://127.0.0.1:" + std::to_string(reg.port()) +
+          "/conf/servers.list",
+      [&](const std::vector<ServerNode>& n) { pushes.push(n); });
+  assert(ns != nullptr);
+  assert(pushes.wait_for(1));
+  {
+    std::lock_guard<std::mutex> g(pushes.mu);
+    assert(pushes.lists[0].size() >= 2);
+    assert(pushes.lists[0][0].ep.to_string() == "10.3.0.1:9000");
+    assert(pushes.lists[0][1].weight == 3);
+  }
+  gen.store(1);  // list change → exactly one new push on the next poll
+  // The registry-made NS keeps its default 5s poll interval; allow two
+  // full periods.
+  assert(pushes.wait_for(2, 12));
+  {
+    std::lock_guard<std::mutex> g(pushes.mu);
+    assert(pushes.lists[1].size() == 1);
+    assert(pushes.lists[1][0].ep.to_string() == "10.3.0.9:9999");
+  }
+  ns->Stop();
+  printf("remotefile_ns OK (fetch, weights, change push)\n");
+}
+
 }  // namespace
 
 int main() {
@@ -278,6 +312,7 @@ int main() {
   test_discovery_ns();
   test_discovery_client();
   test_nacos_ns();
+  test_remotefile_ns();
   printf("ALL ns-dialect tests OK\n");
   return 0;
 }

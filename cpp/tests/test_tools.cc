@@ -102,7 +102,7 @@ int main() {
         Run("./rpc_replay --file " + dump + " --server " + addr);
     // {"replayed": N, "failed": 0} with N > 0.
     assert(out.find("\"failed\": 0") != std::string::npos);
-    assert(out.find("\"replayed\": 0}") == std::string::npos);
+    assert(out.find("\"replayed\": 0,") == std::string::npos);
     remove(dump.c_str());
     printf("rpc_dump/rpc_replay OK\n");
   }
