@@ -194,6 +194,7 @@ void brt_event_destroy(void* event) {
 
 // ---- device staging (cpp/device/pjrt_device.h) ----
 
+#include "device/block_pool.h"
 #include "device/pjrt_device.h"
 #include "device/pjrt_executable.h"
 
@@ -222,8 +223,19 @@ int brt_device_count(void* client) {
 
 uint64_t brt_device_stage(void* client, const void* data, size_t len,
                           int device_index, char* errbuf, size_t errbuf_len) {
+  // Same single-contiguous-region discipline as brt_device_stage_shaped
+  // below (one copy, one DMA source, caller's pointer never pinned).
   brt::IOBuf buf;
-  buf.append(data, len);
+  size_t cap = 0;
+  char* flat = static_cast<char*>(
+      brt::DeviceBlockPool::singleton().Acquire(len ? len : 1, &cap));
+  if (flat == nullptr) {
+    if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "oom staging");
+    return 0;
+  }
+  memcpy(flat, data, len);
+  buf.append_user_data(flat, len, brt::DeviceBlockPool::IOBufDeleter,
+                       reinterpret_cast<void*>(uintptr_t(cap)));
   std::string err;
   uint64_t h = static_cast<brt::PjrtClient*>(client)->StageToDevice(
       buf, device_index, &err);
@@ -267,8 +279,24 @@ uint64_t brt_device_stage_shaped(void* client, const void* data, size_t len,
     if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "bad dtype");
     return 0;
   }
+  // One copy into a single registered region (NOT buf.append, which
+  // splinters a 64MB stage into 8K pooled blocks — per-block overhead ×
+  // thousands, then a second coalescing copy inside StageToDeviceShaped
+  // because PJRT wants one contiguous host region). The caller's pointer
+  // cannot be wrapped zero-copy: the DMA is async and the Python bytes
+  // object may be freed the moment this call returns, while the pooled
+  // region below is pinned by the transfer until its done event.
   brt::IOBuf buf;
-  buf.append(data, len);
+  size_t cap = 0;
+  char* flat = static_cast<char*>(
+      brt::DeviceBlockPool::singleton().Acquire(len ? len : 1, &cap));
+  if (flat == nullptr) {
+    if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "oom staging");
+    return 0;
+  }
+  memcpy(flat, data, len);
+  buf.append_user_data(flat, len, brt::DeviceBlockPool::IOBufDeleter,
+                       reinterpret_cast<void*>(uintptr_t(cap)));
   std::string err;
   uint64_t h = static_cast<brt::PjrtClient*>(client)->StageToDeviceShaped(
       buf, device_index, brt::PjrtClient::DType(dtype),
