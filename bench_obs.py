@@ -1,0 +1,96 @@
+"""Instrumentation-cost micro-benchmark: what does a metric write cost?
+
+The obs hooks sit on every RPC/PS hot path, so their per-op overhead IS a
+perf number for this repo — this starts the BENCH trajectory with the
+observer's own cost. Emits BENCH_obs.json next to the BENCH_r*.json
+series.
+
+Run: JAX_PLATFORMS=cpu python bench_obs.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from brpc_tpu import obs
+from brpc_tpu.obs import rpcz
+
+
+def _per_op_ns(fn, n: int, *, repeats: int = 5) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter_ns()
+        fn(n)
+        best = min(best, (time.perf_counter_ns() - t0) / n)
+    return best
+
+
+def main() -> dict:
+    adder = obs.Adder()
+    maxer = obs.Maxer()
+    rec = obs.LatencyRecorder()
+    ring = rpcz.SpanRing(capacity=1024)
+
+    def bench_adder(n):
+        add = adder.add
+        for _ in range(n):
+            add(1)
+
+    def bench_maxer(n):
+        up = maxer.update
+        for i in range(n):
+            up(i & 1023)
+
+    def bench_record(n):
+        r = rec.record
+        for _ in range(n):
+            r(0.000123)
+
+    def bench_span(n):
+        for _ in range(n):
+            with rpcz.span("Bench", "op", ring=ring):
+                pass
+
+    def bench_disabled_gate(n):
+        enabled = obs.enabled
+        for _ in range(n):
+            if enabled():
+                pass
+
+    n = 200_000
+    result = {
+        "metric": "obs_overhead",
+        "unit": "ns/op",
+        "adder_add_ns": round(_per_op_ns(bench_adder, n), 1),
+        "maxer_update_ns": round(_per_op_ns(bench_maxer, n), 1),
+        "latency_record_ns": round(_per_op_ns(bench_record, n), 1),
+        "span_ns": round(_per_op_ns(bench_span, n // 10), 1),
+        "enabled_gate_ns": round(_per_op_ns(bench_disabled_gate, n), 1),
+        "ops_per_measurement": n,
+    }
+
+    # dump cost at a realistic registry size (dashboards scrape this)
+    reg = obs.Registry()
+    for i in range(200):
+        a = obs.Adder()
+        a.add(i)
+        reg.expose(f"bench_var_{i}", a)
+    t0 = time.perf_counter_ns()
+    for _ in range(100):
+        reg.dump_exposed()
+    result["dump_exposed_200vars_us"] = round(
+        (time.perf_counter_ns() - t0) / 100 / 1e3, 1)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_obs.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""JAX version compatibility shims.
+
+``shard_map`` moved twice across JAX releases: ``jax.experimental.
+shard_map.shard_map`` (≤0.4.x) → ``jax.shard_map`` (≥0.5), and its
+replication-check kwarg was renamed ``check_rep`` → ``check_vma``.  The
+parallel/ps modules are written against the new surface; this shim maps
+them onto whichever JAX is installed so the pure-JAX tiers import (and
+their tests run) on the container's pinned JAX with no native toolchain
+involved.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map_impl  # jax >= 0.5
+except ImportError:  # pragma: no cover - exercised on jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+_PARAMS = set(inspect.signature(_shard_map_impl).parameters)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    if check_vma is not None:
+        if "check_vma" in _PARAMS:
+            kw["check_vma"] = check_vma
+        elif "check_rep" in _PARAMS:
+            kw["check_rep"] = check_vma
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)

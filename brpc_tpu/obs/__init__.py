@@ -1,0 +1,122 @@
+"""brpc_tpu.obs — observability: bvar-style metrics + rpcz tracing.
+
+Two layers, both pure Python/numpy (no native build required):
+
+- :mod:`brpc_tpu.obs.vars` — the metrics core: ``Adder``/``Maxer``/
+  ``Miner`` thread-local-agent reducers, ``PassiveStatus``, ``Window`` /
+  ``PerSecond`` time-windowed views, ``LatencyRecorder`` (count/qps/avg +
+  log-bucket percentiles), and a global ``Registry`` behind
+  ``expose`` / ``dump_exposed`` (the /vars page).
+- :mod:`brpc_tpu.obs.rpcz` — per-call ``Span`` records in a bounded ring
+  (``dump_rpcz``, the /rpcz page) plus a ``span(...)`` context manager
+  for user code.
+
+The RPC/PS fabric (``brpc_tpu.rpc``, ``brpc_tpu.ps_remote``,
+``brpc_tpu.parallel.collective_channel``) is instrumented through the
+cached helpers here (:func:`recorder`, :func:`counter`); every hook
+checks :func:`enabled` first and degrades to a no-op when observability
+is switched off (``set_enabled(False)`` or env
+``BRPC_TPU_OBS=0``).  ``Server.add_status_service()`` serves both dumps
+over the RPC fabric itself so a remote ``Channel`` can scrape any node
+(:mod:`brpc_tpu.obs.status_service`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Tuple
+
+from brpc_tpu.obs.vars import (  # noqa: F401
+    Adder,
+    LatencyRecorder,
+    Maxer,
+    Miner,
+    PassiveStatus,
+    PerSecond,
+    Registry,
+    Variable,
+    Window,
+    default_registry,
+    dump_exposed,
+    dump_exposed_dict,
+    expose,
+)
+from brpc_tpu.obs.rpcz import (  # noqa: F401
+    Span,
+    SpanRing,
+    default_ring,
+    dump_rpcz,
+    format_rpcz,
+    record_span,
+    span,
+)
+
+__all__ = [
+    # vars
+    "Adder", "Maxer", "Miner", "PassiveStatus", "Window", "PerSecond",
+    "LatencyRecorder", "Registry", "Variable", "default_registry",
+    "expose", "dump_exposed", "dump_exposed_dict",
+    # rpcz
+    "Span", "SpanRing", "default_ring", "dump_rpcz", "format_rpcz",
+    "record_span", "span",
+    # gate + cached fabric helpers
+    "enabled", "set_enabled", "recorder", "counter", "reset_fabric_vars",
+]
+
+_enabled = os.environ.get("BRPC_TPU_OBS", "1") not in ("0", "false", "off")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Global observability switch; instrumentation hooks become no-ops
+    when off (they check this before touching any recorder)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+# Cached, auto-exposed fabric variables.  Instrumented call sites resolve
+# their recorder by name on every call; the dict hit is the steady-state
+# cost, and creation (+ expose) happens once per distinct name.
+_fabric_mu = threading.Lock()
+_recorders: Dict[str, LatencyRecorder] = {}
+_counters: Dict[str, Adder] = {}
+
+
+def recorder(name: str, window_size: int = 10) -> LatencyRecorder:
+    """The process-wide LatencyRecorder exposed under ``name``."""
+    rec = _recorders.get(name)
+    if rec is None:
+        with _fabric_mu:
+            rec = _recorders.get(name)
+            if rec is None:
+                rec = LatencyRecorder(window_size=window_size)
+                rec.expose(name)
+                _recorders[name] = rec
+    return rec
+
+
+def counter(name: str) -> Adder:
+    """The process-wide Adder exposed under ``name``."""
+    c = _counters.get(name)
+    if c is None:
+        with _fabric_mu:
+            c = _counters.get(name)
+            if c is None:
+                c = Adder()
+                c.expose(name)
+                _counters[name] = c
+    return c
+
+
+def reset_fabric_vars() -> None:
+    """Drop all cached fabric recorders/counters and their registry
+    entries (test isolation)."""
+    with _fabric_mu:
+        for name in list(_recorders) + list(_counters):
+            default_registry().hide(name)
+        _recorders.clear()
+        _counters.clear()

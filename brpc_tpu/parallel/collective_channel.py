@@ -25,8 +25,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from brpc_tpu import obs
+from brpc_tpu._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _record_collective(op: str, x) -> None:
+    """Per-collective call + byte counters (``collective_<op>_calls`` /
+    ``collective_<op>_bytes``).  These fire when the python method runs:
+    eagerly that is once per collective; under ``jax.jit`` it is once per
+    trace — i.e. they count collective *programs* built, the compile-side
+    view of ICI traffic (sizes still come from the abstract value, which
+    tracers carry)."""
+    if not obs.enabled():
+        return
+    obs.counter(f"collective_{op}_calls").add(1)
+    obs.counter(f"collective_{op}_bytes").add(
+        int(np.prod(np.shape(x))) * np.dtype(x.dtype).itemsize)
 
 
 class CollectiveChannel:
@@ -56,6 +71,7 @@ class CollectiveChannel:
         x is sharded over ``axis`` on its leading dim; the result is the
         elementwise reduction, replicated.
         """
+        _record_collective("all_reduce", x)
         reducer = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}[op]
 
         @partial(
@@ -73,6 +89,7 @@ class CollectiveChannel:
     def all_reduce_inplace(self, x: jax.Array, op: str = "sum") -> jax.Array:
         """AllReduce of replicated-shape tensors (grad sync): x has the SAME
         shape on every shard; result is the cross-shard reduction."""
+        _record_collective("all_reduce_inplace", x)
         reducer = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}[op]
 
         @partial(
@@ -91,6 +108,7 @@ class CollectiveChannel:
         """Each shard's slice, concatenated everywhere (fan-out + concat
         merger — the reference's default "append responses in channel
         order")."""
+        _record_collective("all_gather", x)
 
         @partial(
             shard_map,
@@ -107,6 +125,7 @@ class CollectiveChannel:
     def reduce_scatter(self, x: jax.Array) -> jax.Array:
         """Sum across shards, then each shard keeps its slice (the sharded
         merger — PartitionChannel's write path)."""
+        _record_collective("reduce_scatter", x)
 
         @partial(
             shard_map,
@@ -124,6 +143,7 @@ class CollectiveChannel:
     def broadcast(self, x: jax.Array, root: int = 0) -> jax.Array:
         """Root shard's value everywhere (SelectiveChannel pick-one +
         replicate)."""
+        _record_collective("broadcast", x)
 
         @partial(
             shard_map,
@@ -143,6 +163,7 @@ class CollectiveChannel:
     def shift(self, x: jax.Array, offset: int = 1) -> jax.Array:
         """Neighbour exchange over the ring (ppermute) — the streaming-RPC/
         cascade analog; building block of ring attention and PP."""
+        _record_collective("shift", x)
         n = self.num_channels
         perm = [(i, (i + offset) % n) for i in range(n)]
 
@@ -166,6 +187,7 @@ class CollectiveChannel:
     ) -> jax.Array:
         """CallMapper + ResponseMerger in one: apply ``fn`` per shard
         (mapper), reduce results across shards (merger)."""
+        _record_collective("map_reduce", x)
         reducer = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}[op]
 
         @partial(

@@ -17,11 +17,24 @@ from __future__ import annotations
 
 import struct
 import threading
+import time
 from typing import List, Sequence
 
 import numpy as np
 
-from brpc_tpu import rpc
+from brpc_tpu import obs, rpc
+
+
+def _record_ps_server(shard_index: int, method: str, count: int,
+                      req_len: int, rsp_len: int, t0: int) -> None:
+    """PS-side counters: keys/s, bytes in/out, per-shard handler latency
+    (the ``add_service`` trampoline separately records the full RPC
+    latency; this recorder isolates the table work)."""
+    obs.recorder(f"ps_server_shard{shard_index}_{method}").record(
+        (time.monotonic_ns() - t0) / 1e9)
+    obs.counter("ps_server_keys").add(count)
+    obs.counter("ps_server_bytes_in").add(req_len)
+    obs.counter("ps_server_bytes_out").add(rsp_len)
 
 
 class PsShardServer:
@@ -31,6 +44,7 @@ class PsShardServer:
                  num_shards: int, lr: float = 0.1, seed: int = 0):
         if vocab % num_shards:
             raise ValueError("num_shards must divide vocab")
+        self.shard_index = shard_index
         self.rows_per = vocab // num_shards
         self.base = shard_index * self.rows_per
         self.dim = dim
@@ -47,6 +61,16 @@ class PsShardServer:
         return f"127.0.0.1:{self.port}"
 
     def _handle(self, method: str, payload: bytes) -> bytes:
+        if not obs.enabled():
+            return self._serve(method, payload)
+        t0 = time.monotonic_ns()
+        rsp = self._serve(method, payload)
+        (count,) = struct.unpack_from("<i", payload, 0)
+        _record_ps_server(self.shard_index, method, count, len(payload),
+                          len(rsp), t0)
+        return rsp
+
+    def _serve(self, method: str, payload: bytes) -> bytes:
         (count,) = struct.unpack_from("<i", payload, 0)
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
         if ids.size and (ids.min() < 0 or ids.max() >= self.rows_per):
@@ -89,6 +113,7 @@ class DevicePsShardServer:
                  device_index: int = 0):
         if vocab % num_shards:
             raise ValueError("num_shards must divide vocab")
+        self.shard_index = shard_index
         self.rows_per = vocab // num_shards
         self.base = shard_index * self.rows_per
         self.dim = dim
@@ -151,6 +176,16 @@ class DevicePsShardServer:
         return b
 
     def _handle(self, method: str, payload: bytes) -> bytes:
+        if not obs.enabled():
+            return self._serve(method, payload)
+        t0 = time.monotonic_ns()
+        rsp = self._serve(method, payload)
+        (count,) = struct.unpack_from("<i", payload, 0)
+        _record_ps_server(self.shard_index, method, count, len(payload),
+                          len(rsp), t0)
+        return rsp
+
+    def _serve(self, method: str, payload: bytes) -> bytes:
         (count,) = struct.unpack_from("<i", payload, 0)
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
         if ids.size and (ids.min() < 0 or ids.max() >= self.rows_per):
@@ -283,22 +318,47 @@ class RemoteEmbedding:
                 yield s, np.nonzero(mask)[0], flat_ids[mask]
 
     def lookup(self, ids: np.ndarray) -> np.ndarray:
+        rec = obs.enabled()
+        if rec:
+            t0 = time.monotonic_ns()
         flat = np.asarray(ids, np.int32).reshape(-1)
         out = np.empty((flat.size, self.dim), np.float32)
+        nbytes_in = 0
+        nbytes_out = 0
         for s, positions, owned in self._owner_split(flat):
             req = struct.pack("<i", owned.size) + owned.tobytes()
             rsp = self.channels[s].call("Ps", "Lookup", req)
             out[positions] = np.frombuffer(rsp, np.float32).reshape(
                 owned.size, self.dim)
+            nbytes_out += len(req)
+            nbytes_in += len(rsp)
+        if rec:
+            # Whole-batch latency across all owner shards (each per-shard
+            # RPC is additionally recorded by Channel.call).
+            obs.recorder("ps_client_lookup").record(
+                (time.monotonic_ns() - t0) / 1e9)
+            obs.counter("ps_client_lookup_keys").add(int(flat.size))
+            obs.counter("ps_client_bytes_out").add(nbytes_out)
+            obs.counter("ps_client_bytes_in").add(nbytes_in)
         return out.reshape(*np.shape(ids), self.dim)
 
     def apply_gradients(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        rec = obs.enabled()
+        if rec:
+            t0 = time.monotonic_ns()
         flat = np.asarray(ids, np.int32).reshape(-1)
         g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
+        nbytes_out = 0
         for s, positions, owned in self._owner_split(flat):
             req = (struct.pack("<i", owned.size) + owned.tobytes() +
                    g[positions].tobytes())
             self.channels[s].call("Ps", "ApplyGrad", req)
+            nbytes_out += len(req)
+        if rec:
+            obs.recorder("ps_client_apply").record(
+                (time.monotonic_ns() - t0) / 1e9)
+            obs.counter("ps_client_apply_keys").add(int(flat.size))
+            obs.counter("ps_client_bytes_out").add(nbytes_out)
 
     def close(self):
         for c in self.channels:

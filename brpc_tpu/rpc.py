@@ -11,7 +11,10 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import time
 from typing import Callable, Optional
+
+from brpc_tpu import obs
 
 _HANDLER = ctypes.CFUNCTYPE(
     None, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
@@ -19,6 +22,15 @@ _HANDLER = ctypes.CFUNCTYPE(
 )
 
 _lib = None
+_load_error: Optional[str] = None
+
+
+class NativeCoreUnavailable(RuntimeError):
+    """The native core (cpp/ → libbrpc_tpu_c.so) could not be built or
+    loaded — usually a missing cmake/ninja toolchain, a failed build, or
+    an unloadable .so.  Callers that can degrade (tests, pure-Python
+    tiers) catch this; ``native_core_available()`` probes without
+    raising."""
 
 
 def _build_dir() -> str:
@@ -26,10 +38,17 @@ def _build_dir() -> str:
         os.path.abspath(__file__))), "cpp", "build")
 
 
-def _load():
-    global _lib
-    if _lib is not None:
-        return _lib
+def native_core_available() -> bool:
+    """True when the native core is loadable (building it on first use
+    if a toolchain is present). Never raises."""
+    try:
+        _load()
+        return True
+    except NativeCoreUnavailable:
+        return False
+
+
+def _load_inner():
     so = os.path.join(_build_dir(), "libbrpc_tpu_c.so")
     if not os.path.exists(so):
         build = _build_dir()
@@ -39,7 +58,31 @@ def _load():
                        cwd=build, check=True, capture_output=True)
         subprocess.run(["ninja", "brpc_tpu_c"], cwd=build, check=True,
                        capture_output=True)
-    lib = ctypes.CDLL(so)
+    return ctypes.CDLL(so)
+
+
+def _load():
+    global _lib, _load_error
+    if _lib is not None:
+        return _lib
+    if _load_error is not None:
+        # Don't retry a cmake/ninja run per call — the toolchain won't
+        # appear mid-process.
+        raise NativeCoreUnavailable(_load_error)
+    try:
+        lib = _load_inner()
+    except FileNotFoundError as e:
+        _load_error = (f"native build toolchain missing ({e}); install "
+                       f"cmake+ninja or use a prebuilt "
+                       f"{_build_dir()}/libbrpc_tpu_c.so")
+        raise NativeCoreUnavailable(_load_error) from e
+    except subprocess.CalledProcessError as e:
+        tail = (e.stderr or b"").decode(errors="replace")[-2000:]
+        _load_error = f"native build failed ({e.cmd}):\n{tail}"
+        raise NativeCoreUnavailable(_load_error) from e
+    except OSError as e:
+        _load_error = f"native core failed to load: {e}"
+        raise NativeCoreUnavailable(_load_error) from e
     lib.brt_server_new.restype = ctypes.c_void_p
     lib.brt_server_add_service.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, _HANDLER, ctypes.c_void_p]
@@ -108,6 +151,38 @@ class RpcError(RuntimeError):
         self.code = code
 
 
+def _record_server_call(service: str, method: str, t0: int, wall: float,
+                        req_len: int, rsp_len: int,
+                        error: Optional[str]) -> None:
+    end = time.monotonic_ns()
+    obs.recorder(f"rpc_server_{service}_{method}").record((end - t0) / 1e9)
+    obs.counter("rpc_server_in_bytes").add(req_len)
+    obs.counter("rpc_server_out_bytes").add(rsp_len)
+    if error is not None:
+        obs.counter("rpc_server_errors").add(1)
+    obs.record_span(obs.Span(
+        service=service, method=method, side="server",
+        request_bytes=req_len, response_bytes=rsp_len, start_ns=t0,
+        end_ns=end, wall_time=wall, error_code=2001 if error else 0,
+        error_text=error or ""))
+
+
+def _record_client_call(service: str, method: str, peer: str, t0: int,
+                        wall: float, req_len: int, rsp_len: int,
+                        error_code: int, error_text: str) -> None:
+    end = time.monotonic_ns()
+    obs.recorder(f"rpc_client_{service}_{method}").record((end - t0) / 1e9)
+    obs.counter("rpc_client_out_bytes").add(req_len)
+    obs.counter("rpc_client_in_bytes").add(rsp_len)
+    if error_code:
+        obs.counter("rpc_client_errors").add(1)
+    obs.record_span(obs.Span(
+        service=service, method=method, side="client", peer=peer,
+        request_bytes=req_len, response_bytes=rsp_len, start_ns=t0,
+        end_ns=end, wall_time=wall, error_code=error_code,
+        error_text=error_text))
+
+
 class Server:
     """Native RPC server. Handlers: fn(method: str, request: bytes) -> bytes
     (raise to fail the call)."""
@@ -123,15 +198,28 @@ class Server:
 
         @_HANDLER
         def trampoline(user, method, req, req_len, session):
+            rec = obs.enabled()
+            if rec:
+                t0 = time.monotonic_ns()
+                wall = time.time()
+            m = b""
+            out_len = 0
+            err = None
             try:
+                m = method
                 data = ctypes.string_at(req, req_len) if req_len else b""
-                out = handler(method.decode(), data)
+                out = handler(m.decode(), data)
                 if out is None:
                     out = b""
-                lib.brt_session_respond(session, out, len(out), 0, None)
+                out_len = len(out)
+                lib.brt_session_respond(session, out, out_len, 0, None)
             except Exception as e:  # noqa: BLE001
+                err = str(e)
                 lib.brt_session_respond(session, None, 0, 2001,
-                                        str(e).encode())
+                                        err.encode())
+            if rec:
+                _record_server_call(name, m.decode(errors="replace"), t0,
+                                    wall, req_len, out_len, err)
 
         rc = lib.brt_server_add_service(self._ptr, name.encode(),
                                         trampoline, None)
@@ -151,17 +239,32 @@ class Server:
         def trampoline(user, method, req, req_len, session):
             data = ctypes.string_at(req, req_len) if req_len else b""
             sess = ctypes.c_void_p(session)
+            m = method.decode()
+            rec = obs.enabled()
+            if rec:
+                t0 = time.monotonic_ns()
+                wall = time.time()
+                nreq = req_len
 
             def respond(payload: bytes = b"", error: Optional[str] = None):
+                # Latency spans dispatch -> respond, wherever respond runs
+                # (the async contract: any thread, after the fiber worker
+                # is long gone).
                 if error is not None:
                     lib.brt_session_respond(sess, None, 0, 2001,
                                             error.encode())
+                    if rec:
+                        _record_server_call(name, m, t0, wall, nreq, 0,
+                                            error)
                 else:
                     lib.brt_session_respond(sess, payload, len(payload), 0,
                                             None)
+                    if rec:
+                        _record_server_call(name, m, t0, wall, nreq,
+                                            len(payload), None)
 
             try:
-                handler(method.decode(), data, respond)
+                handler(m, data, respond)
             except Exception as e:  # noqa: BLE001
                 respond(error=str(e))
 
@@ -170,6 +273,15 @@ class Server:
         if rc != 0:
             raise RuntimeError(f"add_async_service failed: {rc}")
         self._handlers.append(trampoline)
+
+    def add_status_service(self) -> None:
+        """Hosts the ``_status`` builtin service (vars + rpcz dumps over
+        the RPC fabric — the reference's builtin pages, src/brpc/builtin/)
+        so a remote ``Channel`` can scrape this node's metrics:
+        ``obs.status_service.scrape_vars(channel)``."""
+        from brpc_tpu.obs.status_service import (SERVICE_NAME,
+                                                 make_status_handler)
+        self.add_service(SERVICE_NAME, make_status_handler())
 
     def add_naming_registry(self) -> None:
         """Hosts the native service registry on this server ("Naming",
@@ -205,6 +317,7 @@ class Channel:
     def __init__(self, addr: str, lb: Optional[str] = None,
                  timeout_ms: int = 1000, max_retry: int = 3):
         self._lib = _load()
+        self._addr = addr
         self._ptr = self._lib.brt_channel_new(
             addr.encode(), lb.encode() if lb else None, timeout_ms,
             max_retry)
@@ -212,6 +325,10 @@ class Channel:
             raise RuntimeError(f"channel init failed for {addr}")
 
     def call(self, service: str, method: str, request: bytes = b"") -> bytes:
+        rec = obs.enabled()
+        if rec:
+            t0 = time.monotonic_ns()
+            wall = time.time()
         rsp = ctypes.c_void_p()
         rsp_len = ctypes.c_size_t()
         errbuf = ctypes.create_string_buffer(256)
@@ -220,11 +337,19 @@ class Channel:
             len(request), ctypes.byref(rsp), ctypes.byref(rsp_len), errbuf,
             256)
         if rc != 0:
-            raise RpcError(rc, errbuf.value.decode(errors="replace"))
+            text = errbuf.value.decode(errors="replace")
+            if rec:
+                _record_client_call(service, method, self._addr, t0, wall,
+                                    len(request), 0, rc, text)
+            raise RpcError(rc, text)
         try:
-            return ctypes.string_at(rsp, rsp_len.value)
+            out = ctypes.string_at(rsp, rsp_len.value)
         finally:
             self._lib.brt_free(rsp)
+        if rec:
+            _record_client_call(service, method, self._addr, t0, wall,
+                                len(request), len(out), 0, "")
+        return out
 
     def close(self) -> None:
         if self._ptr:

@@ -13,3 +13,51 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+# Test modules that need the native core (cpp/ -> libbrpc_tpu_c.so) end to
+# end; without a cmake/ninja toolchain they SKIP with a reason instead of
+# erroring at the first rpc.Server(). Individual tests elsewhere opt in
+# with @pytest.mark.needs_native.
+_NATIVE_TEST_FILES = {
+    "test_native_rpc.py",
+    "test_ps_remote.py",
+    "test_naming_py.py",
+    "test_ps_device.py",
+}
+
+_native_state = None  # (available: bool, reason: str), probed once
+
+
+def _native_core():
+    global _native_state
+    if _native_state is None:
+        from brpc_tpu import rpc
+        try:
+            rpc._load()
+            _native_state = (True, "")
+        except rpc.NativeCoreUnavailable as e:
+            _native_state = (False, str(e).splitlines()[0])
+    return _native_state
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "needs_native: test requires the native cpp core "
+        "(skipped when cmake/ninja can't build it)")
+
+
+def pytest_collection_modifyitems(config, items):
+    needy = [item for item in items
+             if item.fspath.basename in _NATIVE_TEST_FILES
+             or "needs_native" in item.keywords]
+    if not needy:
+        return
+    available, why = _native_core()
+    if available:
+        return
+    skip = pytest.mark.skip(reason=f"native core unavailable: {why}")
+    for item in needy:
+        item.add_marker(skip)
