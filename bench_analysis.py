@@ -1,0 +1,89 @@
+"""checked_lock overhead micro-benchmark: the race harness must be free
+when it is off.
+
+`checked_lock()` with BRPC_TPU_RACECHECK unset returns a plain
+``threading.Lock`` — per-op cost must be indistinguishable from
+constructing the lock directly (it IS the same object type).  The
+checked (RACECHECK=1) cost is reported alongside for scale: that mode is
+a debugging harness, not a production path.  Emits BENCH_analysis.json
+next to the BENCH_obs.json series.
+
+Run: JAX_PLATFORMS=cpu python bench_analysis.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from brpc_tpu.analysis import race
+
+
+def _per_op_ns(fn, n: int, *, repeats: int = 5) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter_ns()
+        fn(n)
+        best = min(best, (time.perf_counter_ns() - t0) / n)
+    return best
+
+
+def _acquire_release_loop(lock):
+    def run(n):
+        acquire = lock.acquire
+        release = lock.release
+        for _ in range(n):
+            acquire()
+            release()
+    return run
+
+
+def _with_loop(lock):
+    def run(n):
+        for _ in range(n):
+            with lock:
+                pass
+    return run
+
+
+def main() -> dict:
+    race.set_enabled(None)
+    os.environ.pop("BRPC_TPU_RACECHECK", None)
+
+    plain = threading.Lock()
+    off = race.checked_lock("bench.off")
+    race.set_enabled(True)
+    on = race.checked_lock("bench.on")
+    race.set_enabled(None)
+
+    n = 200_000
+    plain_ns = _per_op_ns(_acquire_release_loop(plain), n)
+    off_ns = _per_op_ns(_acquire_release_loop(off), n)
+    on_ns = _per_op_ns(_acquire_release_loop(on), n // 10)
+
+    result = {
+        "metric": "checked_lock_overhead",
+        "unit": "ns/op (acquire+release)",
+        "threading_lock_ns": round(plain_ns, 1),
+        "checked_lock_off_ns": round(off_ns, 1),
+        "checked_lock_on_ns": round(on_ns, 1),
+        "off_is_plain_lock_type": type(off) is type(plain),
+        "off_over_plain_ratio": round(off_ns / plain_ns, 3),
+        "with_stmt_plain_ns": round(_per_op_ns(_with_loop(plain), n), 1),
+        "with_stmt_off_ns": round(_per_op_ns(_with_loop(off), n), 1),
+        "ops_per_measurement": n,
+    }
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_analysis.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""brpc_tpu.analysis — correctness tooling for the fiber/RPC fabric.
+
+Two passes over the hazards the fabric creates (handlers running
+concurrently on fiber workers with the GIL released across ctypes,
+hand-placed locks, a truncation-prone ctypes boundary):
+
+- **static** (:mod:`brpc_tpu.analysis.lint`, ``python -m
+  brpc_tpu.analysis``): an AST linter with framework-specific checks —
+  ``ctypes-contract``, ``fiber-shared-state``, ``obs-guard``,
+  ``trace-purity``.  ``tests/test_lint_clean.py`` keeps the tree at zero
+  findings.
+- **dynamic** (:mod:`brpc_tpu.analysis.race`): the :func:`checked_lock`
+  factory every fabric lock is created through.  Plain
+  ``threading.Lock`` in steady state; under ``BRPC_TPU_RACECHECK=1`` a
+  lock-order graph that reports inversion cycles (with both acquisition
+  stacks) and locks held across blocking ``brt_*`` calls.
+
+The native side carries the same tier: ``cpp/.clang-tidy``
+(concurrency + bugprone) and ``cmake -DBRT_SANITIZE=thread|address``.
+
+This module stays stdlib-only below ``obs``/``rpc`` in the import
+order — both import :func:`checked_lock` from here.
+"""
+
+from brpc_tpu.analysis.race import (  # noqa: F401
+    CheckedLock,
+    checked_lock,
+    note_blocking,
+)
+from brpc_tpu.analysis import race  # noqa: F401
+
+__all__ = ["checked_lock", "CheckedLock", "note_blocking", "race"]

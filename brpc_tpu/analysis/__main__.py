@@ -1,0 +1,13 @@
+"""CLI entry: ``python -m brpc_tpu.analysis [paths...] [--format=json]``.
+
+Exit 0 when clean, 1 when any check fires, 2 on usage errors — suitable
+as a CI gate (``tests/test_lint_clean.py`` runs the same pass
+in-process).
+"""
+
+import sys
+
+from brpc_tpu.analysis.lint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

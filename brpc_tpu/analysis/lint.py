@@ -1,0 +1,623 @@
+"""Framework-invariant AST linter for the Python tier.
+
+The reference enforces its concurrency contracts with purpose-built
+tooling (contention profiler, bthread diagnostics, builtin hazard pages);
+this is the equivalent static pass for the hazards our fabric creates.
+Four checks, each encoding an invariant the runtime cannot enforce:
+
+- ``ctypes-contract`` — every ``*.brt_*`` symbol used anywhere must have
+  BOTH ``argtypes`` and ``restype`` declared somewhere in the scanned
+  tree (``rpc._load()`` is the canonical site).  ctypes defaults an
+  undeclared restype to c_int, which silently truncates 64-bit handles
+  on the way out of the native core.  Also: a ``CFUNCTYPE`` callback
+  passed inline to a ``brt_*`` call is owned by nobody — the native core
+  keeps the raw function pointer while Python GCs the closure.
+- ``fiber-shared-state`` — methods reachable from a handler registered
+  via ``add_service``/``add_async_service`` run concurrently on fiber
+  workers (the trampoline releases the GIL across ctypes); any mutation
+  of ``self``/module state they perform must sit inside a
+  ``with self._mu``-style block.
+- ``obs-guard`` — instrumentation outside ``brpc_tpu/obs`` must go
+  through the no-op-able helpers (``obs.counter``/``obs.recorder``/
+  ``obs.record_span``); constructing reducers or touching the Registry
+  directly bypasses the ``enabled()`` gate.
+- ``trace-purity`` — no wall-clock reads, ``print``, lock traffic, or
+  ``obs`` calls inside functions handed to ``jax.jit``/``shard_map``;
+  they run once at trace time and vanish from the compiled program.
+
+Entry points: :func:`run_lint` (in-process, returns findings) and
+:func:`main` (the ``python -m brpc_tpu.analysis`` CLI; exit 0 = clean,
+1 = findings, 2 = usage error).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "run_lint", "lint_files", "main", "ALL_CHECKS"]
+
+ALL_CHECKS = ("ctypes-contract", "fiber-shared-state", "obs-guard",
+              "trace-purity")
+
+#: attribute names that look like a lock on self / a module
+_LOCKISH = ("mu", "lock", "mutex")
+#: container methods that mutate their receiver in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "clear", "update", "setdefault", "add", "discard", "sort", "reverse",
+}
+#: obs surface that hot paths must NOT touch directly (the no-op-able
+#: helpers counter/recorder/record_span/span/enabled stay allowed)
+_OBS_GUARDED = {
+    "Registry", "default_registry", "expose", "Adder", "Maxer", "Miner",
+    "LatencyRecorder", "Window", "PerSecond", "PassiveStatus",
+}
+_TRACERS = {"jit", "shard_map", "pjit"}
+_TIME_FNS = {"time", "time_ns", "monotonic", "monotonic_ns",
+             "perf_counter", "perf_counter_ns", "sleep"}
+
+
+@dataclasses.dataclass
+class Finding:
+    check: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+def _last_name(expr: ast.AST) -> Optional[str]:
+    """'jax.jit' -> 'jit', 'jit' -> 'jit', else None."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    """'a.b.c' -> 'a' (the base Name of a dotted chain)."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_self_rooted(expr: ast.AST) -> bool:
+    return _root_name(expr) == "self"
+
+
+def _is_lockish_ctx(expr: ast.AST) -> bool:
+    """True for `with self._mu:` / `with _load_mu:` style context exprs."""
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Call):
+        # with self._mu.acquire_timeout(...) style — treat lock method
+        # calls on a lockish receiver as lock context too
+        return _is_lockish_ctx(expr.func)
+    if name is None:
+        return False
+    low = name.lower()
+    return any(part in low for part in _LOCKISH)
+
+
+def _describe(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse of synthetic nodes
+        return "<expr>"
+
+
+# ---------------------------------------------------------------------------
+# per-file scan state
+# ---------------------------------------------------------------------------
+
+class _FileScan:
+    """One parsed file plus everything the checks extract from it."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        # ctypes-contract
+        self.native_decls: Dict[str, Set[str]] = {}  # brt_x -> declared kinds
+        self.native_uses: List[Tuple[str, int]] = []  # (brt_x, line)
+        self.cfunctype_protos: Set[str] = set()
+        # obs-guard bookkeeping: names bound to obs modules / obs imports
+        self.obs_module_aliases: Set[str] = set()
+        self.obs_imported_names: Set[str] = set()
+        self._collect()
+
+    def _collect(self) -> None:
+        decl_nodes: Set[int] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    self._note_decl(tgt, decl_nodes)
+                if isinstance(node.value, ast.Call) and \
+                        _last_name(node.value.func) == "CFUNCTYPE":
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.cfunctype_protos.add(tgt.id)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.endswith(".obs") or ".obs." in alias.name:
+                        self.obs_module_aliases.add(
+                            alias.asname or alias.name.split(".")[-1])
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "brpc_tpu" or mod.endswith(".obs"):
+                    for alias in node.names:
+                        if alias.name == "obs" or mod.endswith(".obs"):
+                            tgt = alias.asname or alias.name
+                            if alias.name == "obs":
+                                self.obs_module_aliases.add(tgt)
+                            else:
+                                self.obs_imported_names.add(tgt)
+                elif ".obs." in mod or mod.startswith("obs."):
+                    for alias in node.names:
+                        self.obs_imported_names.add(alias.asname or alias.name)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr.startswith("brt_") and id(node) not in decl_nodes:
+                self.native_uses.append((node.attr, node.lineno))
+
+    def _note_decl(self, tgt: ast.AST, decl_nodes: Set[int]) -> None:
+        if isinstance(tgt, ast.Attribute) and \
+                tgt.attr in ("argtypes", "restype") and \
+                isinstance(tgt.value, ast.Attribute) and \
+                tgt.value.attr.startswith("brt_"):
+            self.native_decls.setdefault(tgt.value.attr, set()).add(tgt.attr)
+            decl_nodes.add(id(tgt.value))
+
+
+# ---------------------------------------------------------------------------
+# check: ctypes-contract
+# ---------------------------------------------------------------------------
+
+def _check_ctypes_contract(scans: List[_FileScan]) -> List[Finding]:
+    findings: List[Finding] = []
+    decls: Dict[str, Set[str]] = {}
+    for sc in scans:
+        for name, kinds in sc.native_decls.items():
+            decls.setdefault(name, set()).update(kinds)
+    reported: Set[Tuple[str, str]] = set()
+    for sc in scans:
+        for name, line in sc.native_uses:
+            have = decls.get(name, set())
+            missing = [k for k in ("argtypes", "restype") if k not in have]
+            if not missing or (name, sc.path) in reported:
+                continue
+            reported.add((name, sc.path))
+            findings.append(Finding(
+                "ctypes-contract", sc.path, line,
+                f"native symbol '{name}' used without "
+                f"{' and '.join(missing)} declared anywhere in the scanned "
+                f"tree (ctypes defaults restype to c_int — 64-bit handles "
+                f"truncate); declare it in rpc._load()"))
+    for sc in scans:
+        findings.extend(_check_cfunctype_pinning(sc))
+    return findings
+
+
+def _check_cfunctype_pinning(sc: _FileScan) -> List[Finding]:
+    protos = sc.cfunctype_protos
+    if not protos:
+        return []
+    findings: List[Finding] = []
+    # 1) inline construction passed straight to the native core (one walk
+    #    over the whole tree so each call site reports exactly once)
+    for node in ast.walk(sc.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn_last = _last_name(node.func)
+        if fn_last is None or not fn_last.startswith("brt_"):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Call) and _last_name(arg.func) in protos:
+                findings.append(Finding(
+                    "ctypes-contract", sc.path, arg.lineno,
+                    f"CFUNCTYPE callback constructed inline in a "
+                    f"'{fn_last}' call — nothing owns it and the GC frees "
+                    f"it under the native core's feet; store it on the "
+                    f"owner object first"))
+    # 2) named callbacks passed to the native core but never pinned.
+    #    Callbacks are attributed to the scope that DIRECTLY defines them;
+    #    pinning/passing is searched through that whole scope subtree.
+    scopes: List[ast.AST] = [sc.tree] + [
+        n for n in ast.walk(sc.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for scope in scopes:
+        callbacks = _callback_locals_shallow(scope, protos)
+        if not callbacks:
+            continue
+        passed_to_native: Dict[str, int] = {}
+        pinned: Set[str] = set()
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call):
+                fn_last = _last_name(node.func)
+                is_native = fn_last is not None and fn_last.startswith("brt_")
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in callbacks:
+                        if is_native:
+                            passed_to_native.setdefault(arg.id, arg.lineno)
+                        else:
+                            # arg of append()/add()/...: the owner keeps it
+                            pinned.add(arg.id)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in callbacks:
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        pinned.add(node.value.id)
+        for name, line in sorted(passed_to_native.items()):
+            if name not in pinned:
+                findings.append(Finding(
+                    "ctypes-contract", sc.path, line,
+                    f"CFUNCTYPE callback '{name}' is passed to the native "
+                    f"core but never pinned on an owner object "
+                    f"(self.<attr> = {name} or self.<list>.append({name})) "
+                    f"— it is GC'd while the core still holds the pointer"))
+    return findings
+
+
+def _callback_locals_shallow(scope: ast.AST, protos: Set[str]
+                             ) -> Dict[str, int]:
+    """Like :func:`_callback_locals` but only DIRECT children of the scope
+    (nested function scopes audit their own callbacks)."""
+    out: Dict[str, int] = {}
+    body = scope.body if hasattr(scope, "body") else []
+    for node in body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and _last_name(node.value.func) in protos:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.lineno
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _last_name(dec) in protos:
+                    out[node.name] = node.lineno
+    return out
+
+
+# ---------------------------------------------------------------------------
+# check: fiber-shared-state
+# ---------------------------------------------------------------------------
+
+def _check_fiber_shared_state(sc: _FileScan) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sc.tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_scan_handler_class(sc, node))
+    return findings
+
+
+def _handler_roots(cls: ast.ClassDef, methods: Dict[str, ast.AST]
+                   ) -> Set[str]:
+    roots: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_name(node.func) not in ("add_service", "add_async_service"):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Attribute) and \
+                    isinstance(arg.value, ast.Name) and \
+                    arg.value.id == "self" and arg.attr in methods:
+                roots.add(arg.attr)
+    return roots
+
+
+def _scan_handler_class(sc: _FileScan, cls: ast.ClassDef) -> List[Finding]:
+    methods: Dict[str, ast.AST] = {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    roots = _handler_roots(cls, methods)
+    if not roots:
+        return []
+    findings: List[Finding] = []
+    visited: Set[Tuple[str, bool]] = set()
+
+    def mutation(node: ast.AST, meth: str, what: str) -> None:
+        findings.append(Finding(
+            "fiber-shared-state", sc.path, node.lineno,
+            f"handler-reachable {cls.name}.{meth} mutates {what} outside a "
+            f"`with self._mu` block — handlers run concurrently on fiber "
+            f"workers (the ctypes trampoline releases the GIL)"))
+
+    def scan(node: ast.AST, meth: str, locked: bool,
+             global_names: Set[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            now_locked = locked or any(
+                _is_lockish_ctx(item.context_expr) for item in node.items)
+            for item in node.items:
+                scan(item.context_expr, meth, locked, global_names)
+            for child in node.body:
+                scan(child, meth, now_locked, global_names)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # nested defs get their own audit when reachable
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)) and \
+                        _is_self_rooted(tgt) and not locked:
+                    mutation(tgt, meth, _describe(tgt))
+                elif isinstance(tgt, ast.Name) and tgt.id in global_names \
+                        and not locked:
+                    mutation(tgt, meth, f"module global '{tgt.id}'")
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                if fn.attr == "at" and node.args and \
+                        _is_self_rooted(node.args[0]) and not locked:
+                    # np.<ufunc>.at(self.table, ...) mutates in place
+                    mutation(node, meth, _describe(node.args[0]))
+                elif fn.attr in _MUTATORS and _is_self_rooted(fn.value) \
+                        and not locked:
+                    mutation(node, meth,
+                             f"{_describe(fn.value)} (via .{fn.attr}())")
+                elif isinstance(fn.value, ast.Name) and \
+                        fn.value.id == "self" and fn.attr in methods:
+                    visit(fn.attr, locked)
+        for child in ast.iter_child_nodes(node):
+            scan(child, meth, locked, global_names)
+
+    def visit(meth: str, locked: bool) -> None:
+        if (meth, locked) in visited:
+            return
+        visited.add((meth, locked))
+        fn = methods[meth]
+        global_names = {
+            name for n in ast.walk(fn) if isinstance(n, ast.Global)
+            for name in n.names}
+        for child in fn.body:
+            scan(child, meth, locked, global_names)
+
+    for root in sorted(roots):
+        visit(root, False)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# check: obs-guard
+# ---------------------------------------------------------------------------
+
+def _in_pkg_dir(path: str, dirname: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    return dirname in parts
+
+
+def _check_obs_guard(sc: _FileScan) -> List[Finding]:
+    if _in_pkg_dir(sc.path, "obs"):
+        return []  # the obs package itself owns the Registry
+    findings: List[Finding] = []
+    for node in ast.walk(sc.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        hit: Optional[str] = None
+        if isinstance(fn, ast.Name) and fn.id in _OBS_GUARDED and \
+                fn.id in sc.obs_imported_names:
+            hit = fn.id
+        elif isinstance(fn, ast.Attribute) and fn.attr in _OBS_GUARDED:
+            root = _root_name(fn)
+            if root in sc.obs_module_aliases:
+                hit = f"{root}.{fn.attr}"
+            elif fn.attr == "expose" and isinstance(fn.value, ast.Call) and \
+                    _last_name(fn.value.func) in _OBS_GUARDED:
+                hit = f"{_describe(fn.value.func)}().expose"
+        if hit:
+            findings.append(Finding(
+                "obs-guard", sc.path, node.lineno,
+                f"direct obs call '{hit}' outside brpc_tpu/obs — hot-path "
+                f"instrumentation must use the no-op-able helpers "
+                f"(obs.counter / obs.recorder / obs.record_span) so "
+                f"disabling observability disables the cost"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# check: trace-purity
+# ---------------------------------------------------------------------------
+
+def _is_tracer_expr(expr: ast.AST) -> bool:
+    return _last_name(expr) in _TRACERS
+
+
+def _is_tracing_decorator(dec: ast.AST) -> bool:
+    if _is_tracer_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_tracer_expr(dec.func):
+            return True  # @jax.jit(...) / @shard_map(mesh=...)
+        if _last_name(dec.func) == "partial" and dec.args and \
+                _is_tracer_expr(dec.args[0]):
+            return True  # @partial(jax.jit, ...) / @partial(shard_map, ...)
+    return False
+
+
+def _traced_functions(tree: ast.Module) -> List[ast.AST]:
+    traced: List[ast.AST] = []
+    by_name: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name[node.name] = node
+            if any(_is_tracing_decorator(d) for d in node.decorator_list):
+                traced.append(node)
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Lambda):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    by_name[tgt.id] = node.value
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_tracer_expr(node.func) \
+                and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Lambda):
+                traced.append(arg)
+            elif isinstance(arg, ast.Name) and arg.id in by_name:
+                traced.append(by_name[arg.id])
+    # dedup while keeping order
+    seen: Set[int] = set()
+    out = []
+    for fn in traced:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append(fn)
+    return out
+
+
+def _check_trace_purity(sc: _FileScan) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def impure(node: ast.AST, fn_name: str, what: str) -> None:
+        findings.append(Finding(
+            "trace-purity", sc.path, node.lineno,
+            f"{what} inside '{fn_name}' which is traced by "
+            f"jax.jit/shard_map — it runs once at trace time and vanishes "
+            f"from the compiled program"))
+
+    for fn in _traced_functions(sc.tree):
+        fn_name = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if _is_lockish_ctx(item.context_expr):
+                        impure(node, fn_name,
+                               f"lock acquisition "
+                               f"'{_describe(item.context_expr)}'")
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "print":
+                impure(node, fn_name, "print()")
+            elif isinstance(f, ast.Attribute):
+                root = _root_name(f)
+                if root == "time" and f.attr in _TIME_FNS:
+                    impure(node, fn_name, f"wall-clock call time.{f.attr}()")
+                elif f.attr in ("acquire", "release") and \
+                        _is_lockish_ctx(f.value):
+                    impure(node, fn_name,
+                           f"lock call '{_describe(f)}()'")
+                elif root == "obs" or root in sc.obs_module_aliases:
+                    impure(node, fn_name,
+                           f"obs instrumentation '{_describe(f)}()'")
+                elif root == "threading" and f.attr in ("Lock", "RLock"):
+                    impure(node, fn_name, "lock construction")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", "build")]
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+    return out
+
+
+def lint_files(files: Iterable[str],
+               checks: Optional[Sequence[str]] = None) -> List[Finding]:
+    active = set(checks or ALL_CHECKS)
+    unknown = active - set(ALL_CHECKS)
+    if unknown:
+        raise ValueError(f"unknown checks: {sorted(unknown)}")
+    scans: List[_FileScan] = []
+    findings: List[Finding] = []
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "syntax", path, e.lineno or 0, f"does not parse: {e.msg}"))
+            continue
+        scans.append(_FileScan(path, tree))
+    for sc in scans:
+        if "fiber-shared-state" in active:
+            findings.extend(_check_fiber_shared_state(sc))
+        if "obs-guard" in active:
+            findings.extend(_check_obs_guard(sc))
+        if "trace-purity" in active:
+            findings.extend(_check_trace_purity(sc))
+    if "ctypes-contract" in active:
+        findings.extend(_check_ctypes_contract(scans))
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings
+
+
+def run_lint(paths: Sequence[str],
+             checks: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint every ``.py`` file under ``paths`` (files or directories)."""
+    return lint_files(_iter_py_files(paths), checks)
+
+
+def _default_target() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m brpc_tpu.analysis",
+        description="Framework-invariant linter for the brpc_tpu fabric")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint "
+                             "(default: the brpc_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--check", action="append", metavar="NAME",
+                        help=f"run only the named check(s); "
+                             f"known: {', '.join(ALL_CHECKS)}")
+    args = parser.parse_args(argv)
+    try:
+        findings = run_lint(args.paths or [_default_target()], args.check)
+    except ValueError as e:
+        parser.error(str(e))
+    if args.format == "json":
+        print(json.dumps({
+            "count": len(findings),
+            "checks": list(args.check or ALL_CHECKS),
+            "findings": [f.to_dict() for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        print(f"{len(findings)} finding(s)" if findings
+              else "clean: no findings", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

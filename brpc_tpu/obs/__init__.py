@@ -24,8 +24,9 @@ over the RPC fabric itself so a remote ``Channel`` can scrape any node
 from __future__ import annotations
 
 import os
-import threading
 from typing import Dict, Tuple
+
+from brpc_tpu.analysis.race import checked_lock
 
 from brpc_tpu.obs.vars import (  # noqa: F401
     Adder,
@@ -81,7 +82,7 @@ def set_enabled(on: bool) -> None:
 # Cached, auto-exposed fabric variables.  Instrumented call sites resolve
 # their recorder by name on every call; the dict hit is the steady-state
 # cost, and creation (+ expose) happens once per distinct name.
-_fabric_mu = threading.Lock()
+_fabric_mu = checked_lock("obs.fabric")
 _recorders: Dict[str, LatencyRecorder] = {}
 _counters: Dict[str, Adder] = {}
 

@@ -14,10 +14,11 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
+
+from brpc_tpu.analysis.race import checked_lock
 
 __all__ = ["Span", "SpanRing", "default_ring", "record_span", "span",
            "dump_rpcz", "set_capacity", "clear"]
@@ -69,7 +70,7 @@ class SpanRing:
     """Bounded, thread-safe span store."""
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
-        self._mu = threading.Lock()
+        self._mu = checked_lock("obs.rpcz_ring")
         self._ring: deque = deque(maxlen=capacity)
 
     @property

@@ -37,6 +37,8 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from brpc_tpu.analysis.race import checked_lock
+
 __all__ = [
     "Variable", "Adder", "Maxer", "Miner", "PassiveStatus", "Window",
     "PerSecond", "LatencyRecorder", "Registry", "default_registry",
@@ -74,7 +76,7 @@ class _TlsReducer(Variable):
 
     def __init__(self):
         self._local = threading.local()
-        self._mu = threading.Lock()
+        self._mu = checked_lock("obs.reducer")
         self._cells: List[list] = []        # all threads' [value] cells
         self._retired = self._IDENTITY      # folded cells of reset() epochs
 
@@ -199,7 +201,7 @@ class Window(Variable):
         self._reducer = reducer
         self.window_size = window_size
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = checked_lock("obs.window")
         # invertible: cumulative samples, newest-oldest is the window value;
         # keep window_size+1 so the diff spans exactly window_size seconds.
         self._samples: deque = deque(maxlen=window_size + 1)
@@ -287,7 +289,7 @@ class LatencyRecorder(Variable):
         # plain list, not numpy: a scalar ndarray increment is ~3x the cost
         # of a list slot increment, and this is the hot path
         self._hist = [0] * _NBUCKETS
-        self._hmu = threading.Lock()
+        self._hmu = checked_lock("obs.latency_hist")
 
     def record(self, seconds: float):
         us = seconds * 1e6
@@ -360,7 +362,7 @@ class Registry:
     (reference Variable::expose + dump_exposed, src/bvar/variable.cpp)."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = checked_lock("obs.registry")
         self._vars: Dict[str, Variable] = {}
 
     def expose(self, name: str, var: Variable) -> Variable:

@@ -16,13 +16,13 @@ rsp = float32 rows [count, dim]. ApplyGrad req = int32 count ++ int32 ids
 from __future__ import annotations
 
 import struct
-import threading
 import time
 from typing import List, Sequence
 
 import numpy as np
 
 from brpc_tpu import obs, rpc
+from brpc_tpu.analysis.race import checked_lock
 
 
 def _record_ps_server(shard_index: int, method: str, count: int,
@@ -52,6 +52,11 @@ class PsShardServer:
         rng = np.random.default_rng(seed + shard_index)
         self.table = (rng.standard_normal((self.rows_per, dim)) * 0.02
                       ).astype(np.float32)
+        # Handlers run concurrently on fiber workers (the trampoline
+        # releases the GIL, and numpy releases it again for big ops): a
+        # Lookup gather racing an ApplyGrad scatter-sub on overlapping
+        # rows reads torn updates — serialize table access.
+        self._mu = checked_lock("ps.shard")
         self.server = rpc.Server()
         self.server.add_service("Ps", self._handle)
         self.port = self.server.start("127.0.0.1:0")
@@ -80,12 +85,14 @@ class PsShardServer:
                 f"{self.base + self.rows_per}) for shard base {self.base}"
             )
         if method == "Lookup":
-            return self.table[ids].tobytes()
+            with self._mu:
+                return self.table[ids].tobytes()
         if method == "ApplyGrad":
             grads = np.frombuffer(payload, np.float32,
                                   count * self.dim, 4 + 4 * count)
-            np.subtract.at(self.table, ids,
-                           self.lr * grads.reshape(count, self.dim))
+            with self._mu:
+                np.subtract.at(self.table, ids,
+                               self.lr * grads.reshape(count, self.dim))
             return b""
         raise ValueError(f"unknown method {method}")
 
@@ -133,8 +140,11 @@ class DevicePsShardServer:
         # Handlers run concurrently on fiber workers (ctypes releases the
         # GIL across device calls): the read-execute-swap on table_h must
         # be serialized or a concurrent ApplyGrad uses a released handle /
-        # drops an update.
-        self._mu = threading.Lock()
+        # drops an update.  (BRPC_TPU_RACECHECK=1 will flag this lock as
+        # held across blocking brt_* calls — deliberate: per-shard
+        # serialization IS the consistency model; splitting the swap into
+        # a handle-generation scheme is a ROADMAP open item.)
+        self._mu = checked_lock("ps.device_shard")
         self.server = rpc.Server()
         self.server.add_service("Ps", self._handle)
         self.port = self.server.start("127.0.0.1:0")

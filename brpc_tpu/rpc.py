@@ -15,6 +15,7 @@ import time
 from typing import Callable, Optional
 
 from brpc_tpu import obs
+from brpc_tpu.analysis import race as _race
 
 _HANDLER = ctypes.CFUNCTYPE(
     None, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
@@ -23,6 +24,9 @@ _HANDLER = ctypes.CFUNCTYPE(
 
 _lib = None
 _load_error: Optional[str] = None
+# Serializes the first-touch cmake/ninja build + dlopen: two threads racing
+# into _load() would otherwise both run the build.
+_load_mu = _race.checked_lock("rpc.load")
 
 
 class NativeCoreUnavailable(RuntimeError):
@@ -62,9 +66,17 @@ def _load_inner():
 
 
 def _load():
-    global _lib, _load_error
+    global _lib
     if _lib is not None:
         return _lib
+    with _load_mu:
+        if _lib is None:
+            _lib = _load_locked()
+        return _lib
+
+
+def _load_locked():
+    global _load_error
     if _load_error is not None:
         # Don't retry a cmake/ninja run per call — the toolchain won't
         # appear mid-process.
@@ -83,17 +95,29 @@ def _load():
     except OSError as e:
         _load_error = f"native core failed to load: {e}"
         raise NativeCoreUnavailable(_load_error) from e
+    # Every brt_* symbol declares BOTH argtypes and restype (matching
+    # cpp/capi/c_api.h) — ctypes defaults an undeclared restype to c_int,
+    # which truncates 64-bit pointers/handles; the `ctypes-contract` check
+    # in brpc_tpu.analysis enforces this table stays complete.
+    lib.brt_server_new.argtypes = []
     lib.brt_server_new.restype = ctypes.c_void_p
     lib.brt_server_add_service.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, _HANDLER, ctypes.c_void_p]
+    lib.brt_server_add_service.restype = ctypes.c_int
     lib.brt_server_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.brt_server_start.restype = ctypes.c_int
     lib.brt_server_add_naming_registry.argtypes = [ctypes.c_void_p]
+    lib.brt_server_add_naming_registry.restype = ctypes.c_int
     lib.brt_server_port.argtypes = [ctypes.c_void_p]
+    lib.brt_server_port.restype = ctypes.c_int
     lib.brt_server_stop.argtypes = [ctypes.c_void_p]
+    lib.brt_server_stop.restype = None
     lib.brt_server_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_server_destroy.restype = None
     lib.brt_session_respond.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
         ctypes.c_char_p]
+    lib.brt_session_respond.restype = None
     lib.brt_channel_new.restype = ctypes.c_void_p
     lib.brt_channel_new.argtypes = [
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
@@ -101,18 +125,27 @@ def _load():
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
         ctypes.c_size_t, ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_channel_call.restype = ctypes.c_int
     lib.brt_channel_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_channel_destroy.restype = None
     lib.brt_free.argtypes = [ctypes.c_void_p]
+    lib.brt_free.restype = None
     lib.brt_init.argtypes = [ctypes.c_int]
+    lib.brt_init.restype = None
+    lib.brt_event_new.argtypes = []
     lib.brt_event_new.restype = ctypes.c_void_p
     lib.brt_event_set.argtypes = [ctypes.c_void_p]
+    lib.brt_event_set.restype = None
     lib.brt_event_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.brt_event_wait.restype = ctypes.c_int
     lib.brt_event_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_event_destroy.restype = None
     # device fabric (native PJRT staging + compiled execution)
     lib.brt_device_client_new.restype = ctypes.c_void_p
     lib.brt_device_client_new.argtypes = [
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
     lib.brt_device_count.argtypes = [ctypes.c_void_p]
+    lib.brt_device_count.restype = ctypes.c_int
     lib.brt_device_stage.restype = ctypes.c_uint64
     lib.brt_device_stage.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
@@ -125,8 +158,11 @@ def _load():
     lib.brt_device_fetch.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_device_fetch.restype = ctypes.c_int
     lib.brt_device_release.argtypes = [ctypes.c_uint64]
+    lib.brt_device_release.restype = ctypes.c_int
     lib.brt_device_client_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_device_client_destroy.restype = None
     lib.brt_mlir_module.restype = ctypes.c_void_p
     lib.brt_mlir_module.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
@@ -135,13 +171,15 @@ def _load():
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
         ctypes.c_size_t]
     lib.brt_device_executable_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.brt_device_executable_num_outputs.restype = ctypes.c_int
     lib.brt_device_execute.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
         ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
         ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_device_execute.restype = ctypes.c_int
     lib.brt_device_executable_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_device_executable_destroy.restype = None
     lib.brt_init(0)
-    _lib = lib
     return lib
 
 
@@ -329,6 +367,8 @@ class Channel:
         if rec:
             t0 = time.monotonic_ns()
             wall = time.time()
+        if _race.enabled():
+            _race.note_blocking("brt_channel_call")
         rsp = ctypes.c_void_p()
         rsp_len = ctypes.c_size_t()
         errbuf = ctypes.create_string_buffer(256)
@@ -369,6 +409,8 @@ class DeviceExecutable:
     def execute(self, args, nreplicas: int = 1):
         """args: flat list of buffer handles, row-major [replica][arg].
         Returns [replica][output] handles (release each when done)."""
+        if _race.enabled():
+            _race.note_blocking("brt_device_execute")
         nargs = len(args) // nreplicas
         a = (ctypes.c_uint64 * len(args))(*args)
         outs = (ctypes.c_uint64 * (nreplicas * self.num_outputs))()
@@ -435,6 +477,8 @@ class DeviceClient:
     def fetch(self, handle: int) -> bytes:
         """DMAs the buffer behind handle back to host (fiber parks during
         the DMA); the buffer stays resident until released."""
+        if _race.enabled():
+            _race.note_blocking("brt_device_fetch")
         out = ctypes.c_void_p()
         out_len = ctypes.c_size_t()
         errbuf = ctypes.create_string_buffer(512)

@@ -1,0 +1,341 @@
+"""Unit tests for the framework-invariant linter (brpc_tpu.analysis.lint):
+each check must fire on a seeded violation and stay quiet on the fixed
+form of the same code."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from brpc_tpu.analysis import lint
+
+
+def _lint_src(tmp_path, src, name="mod.py", checks=None):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return lint.lint_files([str(p)], checks)
+
+
+def _by_check(findings, check):
+    return [f for f in findings if f.check == check]
+
+
+# ---- ctypes-contract: argtypes/restype ----
+
+def test_undeclared_brt_symbol_flagged(tmp_path):
+    fs = _lint_src(tmp_path, "lib.brt_mystery(1)\n")
+    (f,) = _by_check(fs, "ctypes-contract")
+    assert "brt_mystery" in f.message
+    assert "argtypes and restype" in f.message
+    assert f.line == 1
+
+
+def test_partial_declaration_flags_missing_restype(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        lib.brt_thing.argtypes = []
+        lib.brt_thing(1)
+    """)
+    (f,) = _by_check(fs, "ctypes-contract")
+    assert "restype" in f.message and "argtypes and" not in f.message
+
+
+def test_fully_declared_symbol_clean(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import ctypes
+        lib.brt_ok.argtypes = [ctypes.c_int]
+        lib.brt_ok.restype = ctypes.c_void_p
+        lib.brt_ok(1)
+    """)
+    assert fs == []
+
+
+def test_declaration_in_sibling_file_counts(tmp_path):
+    (tmp_path / "decls.py").write_text(
+        "lib.brt_shared.argtypes = []\nlib.brt_shared.restype = None\n")
+    (tmp_path / "use.py").write_text("x._lib.brt_shared()\n")
+    assert lint.run_lint([str(tmp_path)]) == []
+
+
+# ---- ctypes-contract: CFUNCTYPE pinning ----
+
+def test_inline_cfunctype_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import ctypes
+        _H = ctypes.CFUNCTYPE(None)
+        lib.brt_reg.argtypes = [_H]
+        lib.brt_reg.restype = None
+        def register(lib, cb):
+            lib.brt_reg(_H(cb))
+    """)
+    (f,) = _by_check(fs, "ctypes-contract")
+    assert "inline" in f.message and "GC" in f.message
+
+
+def test_unpinned_callback_flagged_and_pinned_clean(tmp_path):
+    bad = """\
+        import ctypes
+        _H = ctypes.CFUNCTYPE(None)
+        lib.brt_reg.argtypes = [_H]
+        lib.brt_reg.restype = None
+        class S:
+            def add(self, lib):
+                @_H
+                def tramp():
+                    pass
+                lib.brt_reg(tramp)
+    """
+    fs = _lint_src(tmp_path, bad, name="bad.py")
+    (f,) = _by_check(fs, "ctypes-contract")
+    assert "tramp" in f.message and "pinned" in f.message
+
+    good = bad.replace("lib.brt_reg(tramp)",
+                       "lib.brt_reg(tramp)\n"
+                       "                self._handlers.append(tramp)")
+    assert _lint_src(tmp_path, good, name="good.py") == []
+
+
+def test_attribute_pinning_counts(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import ctypes
+        _H = ctypes.CFUNCTYPE(None)
+        lib.brt_reg.argtypes = [_H]
+        lib.brt_reg.restype = None
+        class S:
+            def add(self, lib):
+                cb = _H(lambda: None)
+                self._cb = cb
+                lib.brt_reg(cb)
+    """)
+    assert fs == []
+
+
+# ---- fiber-shared-state ----
+
+_HANDLER_CLASS = """\
+    import threading
+
+    class Shard:
+        def __init__(self, server):
+            self._mu = threading.Lock()
+            self.count = 0
+            server.add_service("Ps", self._handle)
+
+        def _handle(self, method, req):
+            {body}
+            return b""
+"""
+
+
+def test_unlocked_handler_mutation_flagged(tmp_path):
+    fs = _lint_src(tmp_path,
+                   _HANDLER_CLASS.format(body="self.count += 1"))
+    (f,) = _by_check(fs, "fiber-shared-state")
+    assert "Shard._handle" in f.message and "self.count" in f.message
+
+
+def test_locked_handler_mutation_clean(tmp_path):
+    fs = _lint_src(tmp_path, _HANDLER_CLASS.format(
+        body="with self._mu:\n                self.count += 1"))
+    assert _by_check(fs, "fiber-shared-state") == []
+
+
+def test_ufunc_at_mutation_flagged(tmp_path):
+    fs = _lint_src(tmp_path, _HANDLER_CLASS.format(
+        body="np.subtract.at(self.table, req, 1)"))
+    (f,) = _by_check(fs, "fiber-shared-state")
+    assert "self.table" in f.message
+
+
+def test_mutation_via_helper_method_flagged(tmp_path):
+    src = """\
+        class Shard:
+            def __init__(self, server):
+                server.add_service("Ps", self._handle)
+
+            def _handle(self, method, req):
+                self._serve(req)
+                return b""
+
+            def _serve(self, req):
+                self.rows.append(req)
+    """
+    fs = _lint_src(tmp_path, src)
+    (f,) = _by_check(fs, "fiber-shared-state")
+    assert "Shard._serve" in f.message
+
+
+def test_helper_only_called_under_lock_clean(tmp_path):
+    src = """\
+        import threading
+
+        class Shard:
+            def __init__(self, server):
+                self._mu = threading.Lock()
+                server.add_service("Ps", self._handle)
+
+            def _handle(self, method, req):
+                with self._mu:
+                    self._serve(req)
+                return b""
+
+            def _serve(self, req):
+                self.rows = req
+    """
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_non_handler_class_not_audited(tmp_path):
+    src = """\
+        class Plain:
+            def poke(self):
+                self.count = 1
+    """
+    assert _lint_src(tmp_path, src) == []
+
+
+# ---- obs-guard ----
+
+def test_direct_registry_use_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        from brpc_tpu import obs
+
+        def hot(n):
+            obs.counter("x").add(n)      # allowed: no-op-able helper
+            a = obs.Adder()              # direct reducer construction
+            obs.default_registry()       # direct registry access
+            obs.expose("y", a)           # direct expose
+    """)
+    fs = _by_check(fs, "obs-guard")
+    assert len(fs) == 3
+    assert all("no-op-able" in f.message for f in fs)
+
+
+def test_obs_package_itself_exempt(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        from brpc_tpu import obs
+        obs.Adder()
+    """, name=os.path.join("obs", "inner.py"))
+    assert _by_check(fs, "obs-guard") == []
+
+
+# ---- trace-purity ----
+
+def test_impure_jit_function_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import time
+        import jax
+        from functools import partial
+        from brpc_tpu import obs
+
+        @jax.jit
+        def step(x):
+            print(x)
+            t = time.time()
+            return x + t
+
+        @partial(jax.jit, static_argnames=())
+        def counted(x):
+            obs.counter("steps").add(1)
+            return x
+
+        traced = jax.jit(lambda x: print(x))
+    """)
+    fs = _by_check(fs, "trace-purity")
+    assert len(fs) == 4
+    kinds = " | ".join(f.message for f in fs)
+    assert "print" in kinds and "time.time" in kinds and "obs" in kinds
+
+
+def test_shard_map_lock_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        from functools import partial
+        from brpc_tpu._compat import shard_map
+
+        class C:
+            def op(self, x):
+                @partial(shard_map, mesh=self.mesh, in_specs=None,
+                         out_specs=None)
+                def _f(shard):
+                    with self._mu:
+                        return shard
+                return _f(x)
+    """)
+    (f,) = _by_check(fs, "trace-purity")
+    assert "lock" in f.message
+
+
+def test_pure_jit_function_clean(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.sum(x * 2)
+    """)
+    assert fs == []
+
+
+# ---- check selection + CLI ----
+
+def test_unknown_check_rejected(tmp_path):
+    try:
+        _lint_src(tmp_path, "x = 1\n", checks=["no-such-check"])
+    except ValueError as e:
+        assert "no-such-check" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+def test_check_filter(tmp_path):
+    src = """\
+        lib.brt_x()
+    """
+    assert _lint_src(tmp_path, src, checks=["obs-guard"]) == []
+    assert len(_lint_src(tmp_path, src, checks=["ctypes-contract"])) == 1
+
+
+def _run_cli(args, cwd):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = cwd + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "brpc_tpu.analysis"] + args,
+        capture_output=True, text=True, env=env, cwd=cwd)
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(lint.__file__))))
+    bad = tmp_path / "viol.py"
+    bad.write_text("lib.brt_bad(1)\n")
+    proc = _run_cli([str(bad), "--format=json"], cwd=repo)
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["count"] == 1
+    (f,) = payload["findings"]
+    assert f["check"] == "ctypes-contract" and f["line"] == 1
+    assert f["path"].endswith("viol.py")
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    proc = _run_cli([str(clean)], cwd=repo)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stderr
+
+
+def test_cli_text_format_has_file_line(tmp_path):
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(lint.__file__))))
+    bad = tmp_path / "viol.py"
+    bad.write_text("\nlib.brt_bad(1)\n")
+    proc = _run_cli([str(bad)], cwd=repo)
+    assert proc.returncode == 1
+    assert f"{bad}:2:" in proc.stdout
+
+
+def test_syntax_error_reported_not_crash(tmp_path):
+    fs = _lint_src(tmp_path, "def broken(:\n")
+    (f,) = fs
+    assert f.check == "syntax"

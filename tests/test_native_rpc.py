@@ -94,3 +94,42 @@ def test_async_handler_jax_completion():
     np.testing.assert_allclose(out, a @ a, rtol=1e-5)
     ch.close()
     srv.close()
+
+
+def test_handler_trampoline_survives_gc():
+    """The ctypes-contract invariant at runtime: the CFUNCTYPE trampoline
+    is pinned on the Server (Server._handlers); if it were not, the GC
+    would free it between add_service and the first call while the native
+    core still holds the raw function pointer — a segfault, not a Python
+    error."""
+    import gc
+
+    srv = rpc.Server()
+
+    def bounce(method, request):
+        return request[::-1]
+
+    srv.add_service("Gc", bounce)
+    assert len(srv._handlers) == 1  # the pin itself
+    del bounce
+    for _ in range(3):
+        gc.collect()
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}")
+    try:
+        assert ch.call("Gc", "Any", b"abc") == b"cba"
+        # a second service on the same server pins independently
+        srv2_calls = []
+
+        def second(method, request):
+            srv2_calls.append(method)
+            return b"ok"
+
+        srv.add_service("Gc2", second)
+        del second
+        gc.collect()
+        assert ch.call("Gc2", "Ping") == b"ok"
+        assert srv2_calls == ["Ping"]
+    finally:
+        ch.close()
+        srv.close()

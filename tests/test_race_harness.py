@@ -1,0 +1,207 @@
+"""Tests for the dynamic lock-order race detector
+(brpc_tpu.analysis.race): inversion cycles with both stacks, the
+blocking-native-call warning, and the zero-overhead-off contract."""
+
+import threading
+
+import pytest
+
+from brpc_tpu.analysis import race
+
+
+@pytest.fixture(autouse=True)
+def _isolated_race_state():
+    race.clear()
+    yield
+    race.set_enabled(None)
+    race.clear()
+
+
+# ---- off-mode contract ----
+
+def test_plain_lock_when_env_unset(monkeypatch):
+    monkeypatch.delenv("BRPC_TPU_RACECHECK", raising=False)
+    race.set_enabled(None)
+    lock = race.checked_lock("steady.state")
+    assert type(lock) is type(threading.Lock())
+    assert not isinstance(lock, race.CheckedLock)
+
+
+def test_env_var_turns_on_checked_locks(monkeypatch):
+    monkeypatch.setenv("BRPC_TPU_RACECHECK", "1")
+    race.set_enabled(None)
+    lock = race.checked_lock("checked.state")
+    assert isinstance(lock, race.CheckedLock)
+
+
+def test_env_var_off_values(monkeypatch):
+    for off in ("0", "false", "off", ""):
+        monkeypatch.setenv("BRPC_TPU_RACECHECK", off)
+        race.set_enabled(None)
+        assert not isinstance(race.checked_lock("x"), race.CheckedLock)
+
+
+def test_fabric_locks_are_plain_by_default():
+    """The obs tier built its locks at import time with RACECHECK unset
+    (the pytest environment) — steady state must carry plain locks."""
+    from brpc_tpu import obs
+    a = obs.Adder()
+    assert not isinstance(a._mu, race.CheckedLock)
+
+
+# ---- CheckedLock behaves like threading.Lock ----
+
+def test_checked_lock_api():
+    race.set_enabled(True)
+    lock = race.checked_lock("api.lock")
+    assert not lock.locked()
+    assert lock.acquire()
+    assert lock.locked()
+    lock.release()
+    assert not lock.locked()
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+    assert lock.acquire(blocking=False)
+    assert not lock.acquire(blocking=False)  # non-reentrant, like Lock
+    lock.release()
+
+
+# ---- lock-order inversion ----
+
+def test_inversion_cycle_reported_with_both_stacks():
+    race.set_enabled(True)
+    lock_a = race.checked_lock("inv.A")
+    lock_b = race.checked_lock("inv.B")
+
+    def order_ab():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def order_ba():
+        with lock_b:
+            with lock_a:
+                pass
+
+    t1 = threading.Thread(target=order_ab)
+    t1.start()
+    t1.join()
+    assert race.findings() == []  # one consistent order: no cycle yet
+
+    t2 = threading.Thread(target=order_ba)
+    t2.start()
+    t2.join()
+    inversions = [f for f in race.findings() if f.kind == "lock-inversion"]
+    assert len(inversions) == 1
+    f = inversions[0]
+    assert {"inv.A", "inv.B"} <= set(f.locks)
+    assert "potential" in f.message and "deadlock" in f.message
+    report = f.format()
+    # both acquisition stacks present: the A->B order and the B->A order
+    assert "order_ab" in report
+    assert "order_ba" in report
+
+
+def test_consistent_order_stays_clean():
+    race.set_enabled(True)
+    lock_a = race.checked_lock("ok.A")
+    lock_b = race.checked_lock("ok.B")
+    for _ in range(3):
+        with lock_a:
+            with lock_b:
+                pass
+    assert race.findings() == []
+
+
+def test_transitive_cycle_detected():
+    race.set_enabled(True)
+    la = race.checked_lock("tr.A")
+    lb = race.checked_lock("tr.B")
+    lc = race.checked_lock("tr.C")
+    with la:
+        with lb:
+            pass
+    with lb:
+        with lc:
+            pass
+    assert race.findings() == []
+    with lc:
+        with la:  # closes A -> B -> C -> A
+            pass
+    inversions = [f for f in race.findings() if f.kind == "lock-inversion"]
+    assert len(inversions) == 1
+    assert {"tr.A", "tr.B", "tr.C"} <= set(inversions[0].locks)
+
+
+def test_same_name_sibling_instances_not_an_edge():
+    """Two reducers' '_mu' locks share a name; nesting them is not an
+    ordering violation (there are thousands of same-name instances)."""
+    race.set_enabled(True)
+    m1 = race.checked_lock("sib.mu")
+    m2 = race.checked_lock("sib.mu")
+    with m1:
+        with m2:
+            pass
+    with m2:
+        with m1:
+            pass
+    assert race.findings() == []
+
+
+# ---- blocking native calls under a lock ----
+
+def test_blocking_call_under_lock_flagged():
+    race.set_enabled(True)
+    lock = race.checked_lock("blk.L")
+    with lock:
+        race.note_blocking("brt_channel_call")
+    flagged = [f for f in race.findings() if f.kind == "blocking-call"]
+    assert len(flagged) == 1
+    f = flagged[0]
+    assert f.locks == ["blk.L"]
+    assert "brt_channel_call" in f.message
+    assert "serializes fiber workers" in f.message
+    # repeat of the same shape dedups
+    with lock:
+        race.note_blocking("brt_channel_call")
+    assert len([f for f in race.findings()
+                if f.kind == "blocking-call"]) == 1
+
+
+def test_blocking_call_without_lock_clean():
+    race.set_enabled(True)
+    race.note_blocking("brt_channel_call")
+    assert race.findings() == []
+
+
+@pytest.mark.needs_native
+def test_blocking_rpc_call_detected_end_to_end():
+    """Holding a checked lock across a real Channel.call gets flagged
+    through the rpc.py hook (native-gated)."""
+    from brpc_tpu import rpc
+
+    race.set_enabled(True)
+    srv = rpc.Server()
+    srv.add_service("Echo", lambda method, req: req)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}")
+    lock = race.checked_lock("e2e.held")
+    try:
+        with lock:
+            assert ch.call("Echo", "Echo", b"x") == b"x"
+    finally:
+        ch.close()
+        srv.close()
+    flagged = [f for f in race.findings() if f.kind == "blocking-call"]
+    assert any("brt_channel_call" in f.message and "e2e.held" in f.locks
+               for f in flagged)
+
+
+def test_report_text():
+    race.set_enabled(True)
+    assert "no findings" in race.report()
+    lock = race.checked_lock("rep.L")
+    with lock:
+        race.note_blocking("brt_device_fetch")
+    assert "blocking-call" in race.report()
