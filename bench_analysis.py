@@ -4,9 +4,12 @@ when it is off.
 `checked_lock()` with BRPC_TPU_RACECHECK unset returns a plain
 ``threading.Lock`` — per-op cost must be indistinguishable from
 constructing the lock directly (it IS the same object type).  The
-checked (RACECHECK=1) cost is reported alongside for scale: that mode is
-a debugging harness, not a production path.  Emits BENCH_analysis.json
-next to the BENCH_obs.json series.
+checked (RACECHECK=1) cost is reported alongside for scale, in both
+full-capture mode (every acquisition captures its stack, ~26µs) and
+sampled mode (``BRPC_TPU_RACECHECK_SAMPLE=N``: every Nth stack, first
+observation of an edge always captured) — sampling must land at ≤ 1/5
+of the full-capture cost to be usable under production-shaped load.
+Emits BENCH_analysis.json next to the BENCH_obs.json series.
 
 Run: JAX_PLATFORMS=cpu python bench_analysis.py
 """
@@ -56,12 +59,19 @@ def main() -> dict:
     off = race.checked_lock("bench.off")
     race.set_enabled(True)
     on = race.checked_lock("bench.on")
+    sampled = race.checked_lock("bench.sampled")
     race.set_enabled(None)
 
     n = 200_000
+    sample_n = 64
     plain_ns = _per_op_ns(_acquire_release_loop(plain), n)
     off_ns = _per_op_ns(_acquire_release_loop(off), n)
     on_ns = _per_op_ns(_acquire_release_loop(on), n // 10)
+    race.set_sample(sample_n)
+    try:
+        sampled_ns = _per_op_ns(_acquire_release_loop(sampled), n // 10)
+    finally:
+        race.set_sample(None)
 
     result = {
         "metric": "checked_lock_overhead",
@@ -69,6 +79,10 @@ def main() -> dict:
         "threading_lock_ns": round(plain_ns, 1),
         "checked_lock_off_ns": round(off_ns, 1),
         "checked_lock_on_ns": round(on_ns, 1),
+        "checked_lock_sampled_ns": round(sampled_ns, 1),
+        "racecheck_sample_every": sample_n,
+        "sampled_over_full_ratio": round(sampled_ns / on_ns, 4),
+        "sampled_within_one_fifth_of_full": sampled_ns <= on_ns / 5,
         "off_is_plain_lock_type": type(off) is type(plain),
         "off_over_plain_ratio": round(off_ns / plain_ns, 3),
         "with_stmt_plain_ns": round(_per_op_ns(_with_loop(plain), n), 1),

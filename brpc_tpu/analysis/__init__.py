@@ -1,25 +1,38 @@
 """brpc_tpu.analysis — correctness tooling for the fiber/RPC fabric.
 
-Two passes over the hazards the fabric creates (handlers running
+Three layers over the hazards the fabric creates (handlers running
 concurrently on fiber workers with the GIL released across ctypes,
 hand-placed locks, a truncation-prone ctypes boundary):
 
+- **call graph** (:mod:`brpc_tpu.analysis.callgraph`): a whole-package
+  resolver over the tree's ASTs — module functions, methods through
+  ``self``/bases, imports, ``functools.partial`` targets — that the
+  static checks traverse (the lockdep/TSan polarity: interprocedural by
+  construction).
 - **static** (:mod:`brpc_tpu.analysis.lint`, ``python -m
   brpc_tpu.analysis``): an AST linter with framework-specific checks —
-  ``ctypes-contract``, ``fiber-shared-state``, ``obs-guard``,
-  ``trace-purity``.  ``tests/test_lint_clean.py`` keeps the tree at zero
+  ``ctypes-contract``, ``fiber-shared-state`` (handler-reachable
+  mutation across modules), ``obs-guard``, ``trace-purity`` (transitive,
+  with call chains + host-callback hazards), and ``lock-order`` (static
+  inversion cycles over the ``with checked_lock`` nesting graph).
+  Findings carry stable ids; ``--baseline`` diffs against an accepted
+  set.  ``tests/test_lint_clean.py`` keeps the tree at zero new
   findings.
 - **dynamic** (:mod:`brpc_tpu.analysis.race`): the :func:`checked_lock`
   factory every fabric lock is created through.  Plain
   ``threading.Lock`` in steady state; under ``BRPC_TPU_RACECHECK=1`` a
-  lock-order graph that reports inversion cycles (with both acquisition
-  stacks) and locks held across blocking ``brt_*`` calls.
+  lock-order graph that confirms the static pass's cycles at runtime
+  (with both acquisition stacks) and flags locks held across blocking
+  ``brt_*`` calls.  ``BRPC_TPU_RACECHECK_SAMPLE=N`` keeps edge/cycle
+  detection exact while sampling stack capture down to production-usable
+  cost.
 
 The native side carries the same tier: ``cpp/.clang-tidy``
 (concurrency + bugprone) and ``cmake -DBRT_SANITIZE=thread|address``.
 
 This module stays stdlib-only below ``obs``/``rpc`` in the import
-order — both import :func:`checked_lock` from here.
+order — both import :func:`checked_lock` from here (``lint`` and
+``callgraph`` are tool-side, imported only by the CLI and tests).
 """
 
 from brpc_tpu.analysis.race import (  # noqa: F401

@@ -1,8 +1,11 @@
-"""CLI entry: ``python -m brpc_tpu.analysis [paths...] [--format=json]``.
+"""CLI entry: ``python -m brpc_tpu.analysis [paths...] [--format=json]
+[--check NAME] [--baseline FILE] [--write-baseline FILE]``.
 
-Exit 0 when clean, 1 when any check fires, 2 on usage errors — suitable
-as a CI gate (``tests/test_lint_clean.py`` runs the same pass
-in-process).
+Exit 0 when clean (or every finding is suppressed by the baseline),
+1 when any new check fires, 2 on usage errors (unknown ``--check``
+names list the valid set) — suitable as a CI gate
+(``tests/test_lint_clean.py`` runs the same pass in-process against
+``tests/lint_baseline.json``).
 """
 
 import sys

@@ -1,0 +1,475 @@
+"""Whole-package call-graph resolver over the ASTs of a Python tree.
+
+The PR-2 linter enforced its concurrency contracts lexically: a traced
+function calling an impure helper defined in another module escaped
+``trace-purity``, and ``fiber-shared-state`` only saw mutation inside
+the handler's own class.  The known art this tier mirrors — lockdep's
+order-graph inference, ThreadSanitizer's happens-before checking — is
+interprocedural by construction; this module makes our static passes the
+same.
+
+What gets resolved (edges carry the call site's path + line):
+
+- bare-name calls to module-level functions (and module-level lambdas),
+  nested functions of the enclosing scope, and ``from mod import fn``
+  imports that land on a scanned module;
+- dotted calls through module aliases (``import brpc_tpu.rpc as rpc``,
+  ``from brpc_tpu import rpc`` → ``rpc.fn()``) and full dotted paths
+  (``brpc_tpu.rpc.fn()``);
+- method calls through ``self`` (``self._serve()``), including
+  in-package base classes, and unbound ``ClassName.meth`` calls;
+- constructor calls (``rpc.Server()`` → ``Server.__init__``);
+- ``functools.partial`` targets: ``h = partial(worker, 1); h()``
+  resolves to ``worker``, as does calling/constructing the partial
+  directly (the construction itself records an edge — the partial
+  exists to be called).
+
+Everything unresolvable (calls on arbitrary objects, call results,
+parameters) is silently skipped: the graph is an under-approximation,
+which is the right polarity for lint (no false edges → no false call
+chains in findings).
+
+Traversals tolerate recursion/cycles — ``reachable`` and the checks
+built on top memoize on visited nodes.
+
+Entry point: :func:`build_callgraph` over ``(path, ast.Module)`` pairs;
+:class:`CallGraph` answers ``node_for_ast`` / ``call_target`` /
+``callees`` / ``reachable`` / ``resolve_callable_expr``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["CallGraph", "FuncNode", "ModuleInfo", "ClassInfo", "CallSite",
+           "build_callgraph", "module_name_for_path"]
+
+
+def module_name_for_path(path: str) -> str:
+    """Dotted module name for a file, walking up through ``__init__.py``
+    packages (``brpc_tpu/obs/vars.py`` → ``brpc_tpu.obs.vars``); a file
+    outside any package is just its stem (fixture-friendly)."""
+    path = os.path.abspath(path)
+    d, fname = os.path.split(path)
+    stem = fname[:-3] if fname.endswith(".py") else fname
+    parts = [] if stem == "__init__" else [stem]
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        d, base = os.path.split(d)
+        if not base:
+            break
+        parts.append(base)
+    if not parts:
+        parts = [stem]
+    return ".".join(reversed(parts))
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: str
+    bases: List[ast.expr]
+    methods: Dict[str, str]          # method name -> node id
+
+
+@dataclasses.dataclass
+class FuncNode:
+    node_id: str                     # "<module>:<qual>"
+    module: str                      # dotted module name
+    qual: str                        # "Cls._handle" / "fn" / "fn.inner"
+    name: str                        # last component of qual
+    cls: Optional[str]               # owning class name, if a method
+    path: str
+    fn: ast.AST                      # FunctionDef / AsyncFunctionDef / Lambda
+    local_defs: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str
+    path: str
+    tree: ast.Module
+    funcs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    #: local alias -> dotted module name ("rpc" -> "brpc_tpu.rpc")
+    import_aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: local name -> (dotted module, original name) for `from m import n`
+    from_imports: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict)
+    #: names bound by module top-level statements (mutable module state)
+    module_globals: Set[str] = dataclasses.field(default_factory=set)
+    #: module-level `x = partial(target, ...)` -> resolved target node id
+    partial_aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class CallSite:
+    callee: str                      # node id
+    path: str
+    line: int
+
+
+def _last_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _dotted_chain(expr: ast.AST) -> Optional[List[str]]:
+    """['rpc', 'Server'] for ``rpc.Server``; None unless rooted at a Name."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return list(reversed(parts))
+    return None
+
+
+class CallGraph:
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.nodes: Dict[str, FuncNode] = {}
+        self.edges: Dict[str, List[CallSite]] = {}
+        self._by_ast: Dict[int, str] = {}
+        self._call_targets: Dict[int, str] = {}  # id(ast.Call) -> node id
+
+    # -- construction ------------------------------------------------------
+
+    def add_module(self, path: str, tree: ast.Module) -> ModuleInfo:
+        name = module_name_for_path(path)
+        if name in self.modules:  # two fixture files with one stem
+            name = f"{name}@{path}"
+        mi = ModuleInfo(name=name, path=path, tree=tree)
+        self.modules[name] = mi
+        self._collect_imports(mi)
+        self._collect_defs(mi)
+        return mi
+
+    def _collect_imports(self, mi: ModuleInfo) -> None:
+        # Imports anywhere in the file (the tree uses function-local
+        # imports to break cycles, e.g. ps_remote.from_registry).
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mi.import_aliases[alias.asname or
+                                      alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else
+                        alias.name.split(".")[0])
+                    if alias.asname is None and "." in alias.name:
+                        # `import a.b.c` binds `a`; remember the full path
+                        # too so `a.b.c.fn()` resolves by longest prefix.
+                        mi.import_aliases.setdefault(alias.name, alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:  # relative import: anchor at this package
+                    base = mi.name.split(".")
+                    base = base[:len(base) - node.level]
+                    mod = ".".join(base + ([node.module]
+                                           if node.module else []))
+                else:
+                    mod = node.module or ""
+                for alias in node.names:
+                    mi.from_imports[alias.asname or alias.name] = (
+                        mod, alias.name)
+
+    def _collect_defs(self, mi: ModuleInfo) -> None:
+        for stmt in mi.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._register_func(mi, stmt, qual_prefix="", cls=None,
+                                    into=mi.funcs)
+            elif isinstance(stmt, ast.ClassDef):
+                ci = ClassInfo(name=stmt.name, module=mi.name,
+                               bases=list(stmt.bases), methods={})
+                mi.classes[stmt.name] = ci
+                for item in stmt.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._register_func(
+                            mi, item, qual_prefix=stmt.name + ".",
+                            cls=stmt.name, into=ci.methods)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                   ast.For, ast.AsyncFor)):
+                targets = []
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [stmt.target]
+                else:
+                    targets = [stmt.target]
+                for tgt in targets:
+                    for leaf in ast.walk(tgt):
+                        if isinstance(leaf, ast.Name):
+                            mi.module_globals.add(leaf.id)
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Lambda):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            self._register_lambda(mi, tgt.id, stmt.value)
+
+    def _register_func(self, mi: ModuleInfo, fn: ast.AST, qual_prefix: str,
+                       cls: Optional[str], into: Dict[str, str]) -> str:
+        qual = qual_prefix + fn.name
+        node_id = f"{mi.name}:{qual}"
+        node = FuncNode(node_id=node_id, module=mi.name, qual=qual,
+                        name=fn.name, cls=cls, path=mi.path, fn=fn)
+        self.nodes[node_id] = node
+        self._by_ast[id(fn)] = node_id
+        into[fn.name] = node_id
+        # nested defs are their own nodes, visible by name to the parent
+        for stmt in fn.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child = self._register_func(
+                    mi, stmt, qual_prefix=qual + ".", cls=cls,
+                    into=node.local_defs)
+        return node_id
+
+    def _register_lambda(self, mi: ModuleInfo, name: str,
+                         fn: ast.Lambda) -> None:
+        node_id = f"{mi.name}:{name}"
+        self.nodes[node_id] = FuncNode(
+            node_id=node_id, module=mi.name, qual=name, name=name, cls=None,
+            path=mi.path, fn=fn)
+        self._by_ast[id(fn)] = node_id
+        mi.funcs.setdefault(name, node_id)
+
+    # -- module / class resolution ----------------------------------------
+
+    def _find_module(self, dotted: str) -> Optional[ModuleInfo]:
+        mi = self.modules.get(dotted)
+        if mi is not None:
+            return mi
+        # fixture trees have no package root: match by dotted suffix,
+        # then by last component, but only when unambiguous
+        for matcher in (lambda n: n.endswith("." + dotted),
+                        lambda n: n.split(".")[-1] == dotted.split(".")[-1]):
+            hits = [m for n, m in self.modules.items() if matcher(n)]
+            if len(hits) == 1:
+                return hits[0]
+        return None
+
+    def _resolve_class(self, mi: ModuleInfo, name: str,
+                       _seen: Optional[Set[str]] = None
+                       ) -> Optional[ClassInfo]:
+        ci = mi.classes.get(name)
+        if ci is not None:
+            return ci
+        src = mi.from_imports.get(name)
+        if src is not None:
+            target = self._find_module(src[0])
+            if target is not None and target is not mi:
+                return target.classes.get(src[1])
+        return None
+
+    def _method(self, mi: ModuleInfo, cls_name: str, meth: str,
+                _seen: Optional[Set[str]] = None) -> Optional[str]:
+        """Resolve ``cls_name.meth`` including in-package base classes."""
+        seen = _seen or set()
+        key = f"{mi.name}.{cls_name}"
+        if key in seen:
+            return None
+        seen.add(key)
+        ci = self._resolve_class(mi, cls_name)
+        if ci is None:
+            return None
+        hit = ci.methods.get(meth)
+        if hit is not None:
+            return hit
+        base_mi = self.modules.get(ci.module, mi)
+        for base in ci.bases:
+            base_name = _last_name(base)
+            if base_name is None:
+                continue
+            hit = self._method(base_mi, base_name, meth, seen)
+            if hit is not None:
+                return hit
+        return None
+
+    # -- expression resolution --------------------------------------------
+
+    def _resolve_name(self, name: str, ctx: FuncNode,
+                      local_partials: Optional[Dict[str, str]] = None
+                      ) -> Optional[str]:
+        if local_partials and name in local_partials:
+            return local_partials[name]
+        if name in ctx.local_defs:
+            return ctx.local_defs[name]
+        mi = self.modules[ctx.module]
+        if name in mi.funcs:
+            return mi.funcs[name]
+        if name in mi.partial_aliases:
+            return mi.partial_aliases[name]
+        if name in mi.classes:
+            return self._method(mi, name, "__init__")
+        src = mi.from_imports.get(name)
+        if src is not None:
+            target = self._find_module(src[0])
+            if target is not None and target is not mi:
+                if src[1] in target.funcs:
+                    return target.funcs[src[1]]
+                if src[1] in target.classes:
+                    return self._method(target, src[1], "__init__")
+        return None
+
+    def _resolve_dotted(self, chain: List[str], ctx: FuncNode
+                        ) -> Optional[str]:
+        mi = self.modules[ctx.module]
+        # ClassName.meth with a locally visible class (unbound call)
+        if len(chain) == 2:
+            hit = self._method(mi, chain[0], chain[1])
+            if hit is not None:
+                return hit
+        # expand a leading import alias, then longest-prefix module match
+        expanded = chain
+        if chain[0] in mi.import_aliases:
+            expanded = mi.import_aliases[chain[0]].split(".") + chain[1:]
+        for cut in range(len(expanded) - 1, 0, -1):
+            target = self._find_module(".".join(expanded[:cut]))
+            if target is None:
+                continue
+            rest = expanded[cut:]
+            if len(rest) == 1:
+                if rest[0] in target.funcs:
+                    return target.funcs[rest[0]]
+                if rest[0] in target.classes:
+                    return self._method(target, rest[0], "__init__")
+            elif len(rest) == 2:
+                return self._method(target, rest[0], rest[1])
+            return None
+        return None
+
+    def resolve_callable_expr(self, expr: ast.AST, ctx: FuncNode,
+                              local_partials: Optional[Dict[str, str]] = None
+                              ) -> Optional[str]:
+        """Resolve an expression in callable position (or passed as a
+        callback) to a node id; None when it lands outside the graph."""
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(expr.id, ctx, local_partials)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and ctx.cls is not None:
+                return self._method(self.modules[ctx.module], ctx.cls,
+                                    expr.attr)
+            chain = _dotted_chain(expr)
+            if chain is not None:
+                return self._resolve_dotted(chain, ctx)
+            return None
+        if isinstance(expr, ast.Call) and \
+                _last_name(expr.func) == "partial" and expr.args:
+            # partial(f, ...) called or passed directly
+            return self.resolve_callable_expr(expr.args[0], ctx,
+                                              local_partials)
+        return None
+
+    # -- edge extraction ---------------------------------------------------
+
+    def extract_edges(self) -> None:
+        for mi in self.modules.values():
+            # module top-level code gets a pseudo-node so inline lambdas /
+            # module-scope calls still resolve in a context
+            top_id = f"{mi.name}:<module>"
+            top = FuncNode(node_id=top_id, module=mi.name, qual="<module>",
+                           name="<module>", cls=None, path=mi.path,
+                           fn=mi.tree)
+            self.nodes[top_id] = top
+            self._extract_scope(mi, mi.tree.body, top, {})
+            # module-level partial aliases resolve against the pseudo-node
+            for stmt in mi.tree.body:
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Call) and \
+                        _last_name(stmt.value.func) == "partial" and \
+                        stmt.value.args:
+                    tgt = self.resolve_callable_expr(stmt.value.args[0], top)
+                    if tgt is not None:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                mi.partial_aliases[t.id] = tgt
+
+    def _extract_scope(self, mi: ModuleInfo, body: Sequence[ast.AST],
+                       ctx: FuncNode, local_partials: Dict[str, str]) -> None:
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner_id = self._by_ast.get(id(node))
+                inner = self.nodes.get(inner_id) if inner_id else None
+                for dec in node.decorator_list:
+                    visit(dec)  # decorators evaluate in the OUTER scope
+                if inner is not None:
+                    self._extract_scope(mi, node.body, inner,
+                                        dict(local_partials))
+                return
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    visit(item)
+                return
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _last_name(node.value.func) == "partial" and \
+                    node.value.args:
+                tgt = self.resolve_callable_expr(node.value.args[0], ctx,
+                                                 local_partials)
+                if tgt is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            local_partials[t.id] = tgt
+                    self._add_edge(ctx, tgt, node.lineno, node.value)
+            if isinstance(node, ast.Call):
+                tgt = self.resolve_callable_expr(node.func, ctx,
+                                                 local_partials)
+                if tgt is None and _last_name(node.func) == "partial" and \
+                        node.args:
+                    # bare partial construction: edge to the target (the
+                    # partial exists to be called, often out of our sight)
+                    tgt = self.resolve_callable_expr(node.args[0], ctx,
+                                                     local_partials)
+                if tgt is not None:
+                    self._add_edge(ctx, tgt, node.lineno, node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in body:
+            visit(stmt)
+
+    def _add_edge(self, ctx: FuncNode, callee: str, line: int,
+                  call: ast.AST) -> None:
+        self.edges.setdefault(ctx.node_id, []).append(
+            CallSite(callee=callee, path=ctx.path, line=line))
+        self._call_targets[id(call)] = callee
+
+    # -- queries -----------------------------------------------------------
+
+    def node_for_ast(self, fn: ast.AST) -> Optional[FuncNode]:
+        node_id = self._by_ast.get(id(fn))
+        return self.nodes.get(node_id) if node_id else None
+
+    def call_target(self, call: ast.AST) -> Optional[str]:
+        """Resolved callee node id of an ``ast.Call`` seen during
+        :func:`extract_edges`; None when unresolved."""
+        return self._call_targets.get(id(call))
+
+    def callees(self, node_id: str) -> List[CallSite]:
+        return self.edges.get(node_id, [])
+
+    def reachable(self, root: str) -> Set[str]:
+        """All node ids reachable from ``root`` (cycle-tolerant)."""
+        seen: Set[str] = set()
+        stack = [root]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(site.callee for site in self.callees(cur))
+        return seen
+
+
+def build_callgraph(files: Iterable[Tuple[str, ast.Module]]) -> CallGraph:
+    """Build the whole-package graph over ``(path, parsed module)``."""
+    g = CallGraph()
+    for path, tree in files:
+        g.add_module(path, tree)
+    g.extract_edges()
+    return g

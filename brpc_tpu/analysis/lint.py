@@ -3,7 +3,10 @@
 The reference enforces its concurrency contracts with purpose-built
 tooling (contention profiler, bthread diagnostics, builtin hazard pages);
 this is the equivalent static pass for the hazards our fabric creates.
-Four checks, each encoding an invariant the runtime cannot enforce:
+Five checks, each encoding an invariant the runtime cannot enforce, the
+concurrency ones interprocedural over the whole-package call graph
+(:mod:`brpc_tpu.analysis.callgraph` — the lockdep/TSan polarity: follow
+the calls, not the file):
 
 - ``ctypes-contract`` — every ``*.brt_*`` symbol used anywhere must have
   BOTH ``argtypes`` and ``restype`` declared somewhere in the scanned
@@ -15,19 +18,37 @@ Four checks, each encoding an invariant the runtime cannot enforce:
 - ``fiber-shared-state`` — methods reachable from a handler registered
   via ``add_service``/``add_async_service`` run concurrently on fiber
   workers (the trampoline releases the GIL across ctypes); any mutation
-  of ``self``/module state they perform must sit inside a
-  ``with self._mu``-style block.
+  of ``self``/module state anywhere in the handler-reachable set — across
+  modules, through helpers — must sit inside a ``with self._mu``-style
+  block.  Thread-local state (``self._local.*``/``*tls*``) is exempt.
 - ``obs-guard`` — instrumentation outside ``brpc_tpu/obs`` must go
   through the no-op-able helpers (``obs.counter``/``obs.recorder``/
   ``obs.record_span``); constructing reducers or touching the Registry
   directly bypasses the ``enabled()`` gate.
 - ``trace-purity`` — no wall-clock reads, ``print``, lock traffic, or
-  ``obs`` calls inside functions handed to ``jax.jit``/``shard_map``;
-  they run once at trace time and vanish from the compiled program.
+  ``obs`` calls anywhere transitively reachable (through in-package
+  helpers) from a function handed to ``jax.jit``/``shard_map``; they run
+  once at trace time and vanish from the compiled program.  Findings
+  carry the full call chain from the traced root to the impure site.
+  Host callbacks (``jax.debug.print``, ``pure_callback``/``io_callback``)
+  under trace are a separate hazard class: they DON'T vanish — they
+  stage a host round-trip into every step — and must be allowlisted
+  per-site with ``# lint: allow-host-callback`` when intended.
+- ``lock-order`` — the static half of the RACECHECK harness: derives
+  the ``with <checked_lock>`` nesting graph over the call graph and
+  reports inversion cycles without running anything; the dynamic
+  harness (:mod:`brpc_tpu.analysis.race`) becomes the confirmer, not
+  the only detector.
+
+Findings carry a stable id (hash of check + package-relative path +
+message, deliberately line-free) so CI can diff against an accepted
+baseline (``--baseline FILE`` suppresses known ids; ``--write-baseline``
+emits one).
 
 Entry points: :func:`run_lint` (in-process, returns findings) and
 :func:`main` (the ``python -m brpc_tpu.analysis`` CLI; exit 0 = clean,
-1 = findings, 2 = usage error).
+1 = findings, 2 = usage error — unknown ``--check`` names are rejected
+with the valid set listed).
 """
 
 from __future__ import annotations
@@ -35,15 +56,23 @@ from __future__ import annotations
 import argparse
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import sys
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import (Dict, Iterable, List, Optional, Sequence, Set, Tuple)
 
-__all__ = ["Finding", "run_lint", "lint_files", "main", "ALL_CHECKS"]
+from brpc_tpu.analysis.callgraph import (CallGraph, FuncNode,
+                                         build_callgraph)
+
+__all__ = ["Finding", "run_lint", "lint_files", "main", "ALL_CHECKS",
+           "load_baseline", "apply_baseline"]
 
 ALL_CHECKS = ("ctypes-contract", "fiber-shared-state", "obs-guard",
-              "trace-purity")
+              "trace-purity", "lock-order")
+
+#: checks that need the whole-package call graph
+_GRAPH_CHECKS = {"fiber-shared-state", "trace-purity", "lock-order"}
 
 #: attribute names that look like a lock on self / a module
 _LOCKISH = ("mu", "lock", "mutex")
@@ -61,6 +90,18 @@ _OBS_GUARDED = {
 _TRACERS = {"jit", "shard_map", "pjit"}
 _TIME_FNS = {"time", "time_ns", "monotonic", "monotonic_ns",
              "perf_counter", "perf_counter_ns", "sleep"}
+#: bare/attr names that stage a host callback into a traced program
+_HOST_CALLBACKS = {"pure_callback", "io_callback"}
+#: per-site pragma that allowlists a host callback under trace
+_ALLOW_HOST_CB = "lint: allow-host-callback"
+
+
+def _stable_path(path: str) -> str:
+    """Package-relative posix path (machine-independent id component)."""
+    parts = os.path.normpath(path).replace(os.sep, "/").split("/")
+    if "brpc_tpu" in parts:
+        return "/".join(parts[parts.index("brpc_tpu"):])
+    return parts[-1]
 
 
 @dataclasses.dataclass
@@ -69,9 +110,18 @@ class Finding:
     path: str
     line: int
     message: str
+    #: stable id: hash over check + package-relative path + message (no
+    #: line number, so pure drift doesn't churn baselines)
+    id: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.id:
+            raw = f"{self.check}|{_stable_path(self.path)}|{self.message}"
+            self.id = hashlib.sha1(raw.encode()).hexdigest()[:12]
 
     def format(self) -> str:
-        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+        return f"{self.path}:{self.line}: [{self.check}:{self.id}] " \
+               f"{self.message}"
 
     def to_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -103,6 +153,18 @@ def _is_self_rooted(expr: ast.AST) -> bool:
     return _root_name(expr) == "self"
 
 
+def _is_tls_path(expr: ast.AST) -> bool:
+    """True for thread-local chains (``self._local.cell``) — per-thread
+    state needs no lock."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        if isinstance(expr, ast.Attribute):
+            low = expr.attr.lower()
+            if "local" in low or "tls" in low:
+                return True
+        expr = expr.value
+    return False
+
+
 def _is_lockish_ctx(expr: ast.AST) -> bool:
     """True for `with self._mu:` / `with _load_mu:` style context exprs."""
     name = None
@@ -127,6 +189,52 @@ def _describe(node: ast.AST) -> str:
         return "<expr>"
 
 
+def _local_binds(fn: ast.AST) -> Set[str]:
+    """Names bound locally inside ``fn`` (params, plain assigns, loop and
+    with targets) — these shadow module globals for the shared-state
+    check."""
+    out: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (list(args.posonlyargs) + list(args.args) +
+                  list(args.kwonlyargs)):
+            out.add(a.arg)
+        if args.vararg:
+            out.add(args.vararg.arg)
+        if args.kwarg:
+            out.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            out -= set(node.names)  # `global x` un-shadows
+            continue
+        tgt_lists: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            tgt_lists = list(node.targets)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            tgt_lists = [node.target]
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            tgt_lists = [node.target]
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            tgt_lists = [i.optional_vars for i in node.items
+                         if i.optional_vars is not None]
+        for tgt in tgt_lists:
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for leaf in ast.walk(tgt):
+                    if isinstance(leaf, ast.Name):
+                        out.add(leaf.id)
+    return out
+
+
+def _node_display(node: FuncNode) -> str:
+    if node.cls is not None:
+        return f"{node.cls}.{node.name}"
+    if node.qual == "<module>":
+        return f"{node.module}:<module>"
+    return node.qual
+
+
 # ---------------------------------------------------------------------------
 # per-file scan state
 # ---------------------------------------------------------------------------
@@ -134,9 +242,11 @@ def _describe(node: ast.AST) -> str:
 class _FileScan:
     """One parsed file plus everything the checks extract from it."""
 
-    def __init__(self, path: str, tree: ast.Module):
+    def __init__(self, path: str, tree: ast.Module,
+                 src_lines: Optional[List[str]] = None):
         self.path = path
         self.tree = tree
+        self.src_lines = src_lines or []
         # ctypes-contract
         self.native_decls: Dict[str, Set[str]] = {}  # brt_x -> declared kinds
         self.native_uses: List[Tuple[str, int]] = []  # (brt_x, line)
@@ -187,6 +297,11 @@ class _FileScan:
                 tgt.value.attr.startswith("brt_"):
             self.native_decls.setdefault(tgt.value.attr, set()).add(tgt.attr)
             decl_nodes.add(id(tgt.value))
+
+    def line_has(self, lineno: int, marker: str) -> bool:
+        if 1 <= lineno <= len(self.src_lines):
+            return marker in self.src_lines[lineno - 1]
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -282,8 +397,8 @@ def _check_cfunctype_pinning(sc: _FileScan) -> List[Finding]:
 
 def _callback_locals_shallow(scope: ast.AST, protos: Set[str]
                              ) -> Dict[str, int]:
-    """Like :func:`_callback_locals` but only DIRECT children of the scope
-    (nested function scopes audit their own callbacks)."""
+    """Callback names defined as DIRECT children of the scope (nested
+    function scopes audit their own callbacks)."""
     out: Dict[str, int] = {}
     body = scope.body if hasattr(scope, "body") else []
     for node in body:
@@ -300,104 +415,139 @@ def _callback_locals_shallow(scope: ast.AST, protos: Set[str]
 
 
 # ---------------------------------------------------------------------------
-# check: fiber-shared-state
+# check: fiber-shared-state (interprocedural over the call graph)
 # ---------------------------------------------------------------------------
 
-def _check_fiber_shared_state(sc: _FileScan) -> List[Finding]:
-    findings: List[Finding] = []
-    for node in ast.walk(sc.tree):
-        if isinstance(node, ast.ClassDef):
-            findings.extend(_scan_handler_class(sc, node))
-    return findings
+def _find_handler_roots(sc: _FileScan, graph: CallGraph,
+                        top: Optional[FuncNode]) -> List[str]:
+    """Node ids of handlers registered via add_service/add_async_service
+    anywhere in this file (``self.X`` methods, bare function names,
+    partial targets)."""
+    roots: List[str] = []
 
+    def visit(node: ast.AST, ctx: Optional[FuncNode]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = graph.node_for_ast(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child, inner or ctx)
+            return
+        if isinstance(node, ast.Call) and ctx is not None and \
+                _last_name(node.func) in ("add_service",
+                                          "add_async_service"):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                tgt = graph.resolve_callable_expr(arg, ctx)
+                if tgt is not None:
+                    roots.append(tgt)
+        for child in ast.iter_child_nodes(node):
+            visit(child, ctx)
 
-def _handler_roots(cls: ast.ClassDef, methods: Dict[str, ast.AST]
-                   ) -> Set[str]:
-    roots: Set[str] = set()
-    for node in ast.walk(cls):
-        if not isinstance(node, ast.Call):
-            continue
-        if _last_name(node.func) not in ("add_service", "add_async_service"):
-            continue
-        for arg in list(node.args) + [kw.value for kw in node.keywords]:
-            if isinstance(arg, ast.Attribute) and \
-                    isinstance(arg.value, ast.Name) and \
-                    arg.value.id == "self" and arg.attr in methods:
-                roots.add(arg.attr)
+    visit(sc.tree, top)
     return roots
 
 
-def _scan_handler_class(sc: _FileScan, cls: ast.ClassDef) -> List[Finding]:
-    methods: Dict[str, ast.AST] = {
-        n.name: n for n in cls.body
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    roots = _handler_roots(cls, methods)
-    if not roots:
-        return []
+def _check_fiber_shared_state(scans: List[_FileScan],
+                              graph: CallGraph) -> List[Finding]:
+    sc_by_path = {sc.path: sc for sc in scans}
+    mi_by_path = {mi.path: mi for mi in graph.modules.values()}
+    roots: List[str] = []
+    for sc in scans:
+        mi = mi_by_path.get(sc.path)
+        top = graph.nodes.get(f"{mi.name}:<module>") if mi else None
+        roots.extend(_find_handler_roots(sc, graph, top))
     findings: List[Finding] = []
     visited: Set[Tuple[str, bool]] = set()
+    queue: List[Tuple[str, bool, Tuple[str, ...]]] = [
+        (r, False, (_node_display(graph.nodes[r]),))
+        for r in roots if r in graph.nodes]
+    while queue:
+        node_id, locked, chain = queue.pop()
+        if (node_id, locked) in visited:
+            continue
+        visited.add((node_id, locked))
+        node = graph.nodes.get(node_id)
+        if node is None or node.path not in sc_by_path:
+            continue
+        _scan_shared_state(sc_by_path[node.path], graph, node, locked,
+                           chain, queue, findings)
+    return findings
 
-    def mutation(node: ast.AST, meth: str, what: str) -> None:
+
+def _scan_shared_state(sc: _FileScan, graph: CallGraph, node: FuncNode,
+                       locked0: bool, chain: Tuple[str, ...],
+                       queue: List[Tuple[str, bool, Tuple[str, ...]]],
+                       findings: List[Finding]) -> None:
+    fn = node.fn
+    mi = graph.modules[node.module]
+    display = _node_display(node)
+    global_names = {name for n in ast.walk(fn) if isinstance(n, ast.Global)
+                    for name in n.names}
+    mod_state = (mi.module_globals - _local_binds(fn)) | global_names
+
+    def mutation(n: ast.AST, what: str) -> None:
+        via = ""
+        if len(chain) > 1:
+            via = f" [reached via {' -> '.join(chain)}]"
         findings.append(Finding(
-            "fiber-shared-state", sc.path, node.lineno,
-            f"handler-reachable {cls.name}.{meth} mutates {what} outside a "
+            "fiber-shared-state", sc.path, n.lineno,
+            f"handler-reachable {display} mutates {what} outside a "
             f"`with self._mu` block — handlers run concurrently on fiber "
-            f"workers (the ctypes trampoline releases the GIL)"))
+            f"workers (the ctypes trampoline releases the GIL){via}"))
 
-    def scan(node: ast.AST, meth: str, locked: bool,
-             global_names: Set[str]) -> None:
-        if isinstance(node, (ast.With, ast.AsyncWith)):
+    def scan(n: ast.AST, locked: bool) -> None:
+        if isinstance(n, (ast.With, ast.AsyncWith)):
             now_locked = locked or any(
-                _is_lockish_ctx(item.context_expr) for item in node.items)
-            for item in node.items:
-                scan(item.context_expr, meth, locked, global_names)
-            for child in node.body:
-                scan(child, meth, now_locked, global_names)
+                _is_lockish_ctx(item.context_expr) for item in n.items)
+            for item in n.items:
+                scan(item.context_expr, locked)
+            for child in n.body:
+                scan(child, now_locked)
             return
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda, ast.ClassDef)):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
             return  # nested defs get their own audit when reachable
-        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-            targets = node.targets if isinstance(node, ast.Assign) \
-                else [node.target]
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
             for tgt in targets:
-                if isinstance(tgt, (ast.Attribute, ast.Subscript)) and \
-                        _is_self_rooted(tgt) and not locked:
-                    mutation(tgt, meth, _describe(tgt))
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    if _is_tls_path(tgt) or locked:
+                        continue
+                    if node.cls is not None and _is_self_rooted(tgt):
+                        mutation(tgt, _describe(tgt))
+                    else:
+                        root = _root_name(tgt)
+                        if root is not None and root in mod_state:
+                            mutation(tgt, f"module state "
+                                          f"'{_describe(tgt)}'")
                 elif isinstance(tgt, ast.Name) and tgt.id in global_names \
                         and not locked:
-                    mutation(tgt, meth, f"module global '{tgt.id}'")
-        if isinstance(node, ast.Call):
-            fn = node.func
-            if isinstance(fn, ast.Attribute):
-                if fn.attr == "at" and node.args and \
-                        _is_self_rooted(node.args[0]) and not locked:
+                    mutation(tgt, f"module global '{tgt.id}'")
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and not locked:
+                if f.attr == "at" and n.args and not _is_tls_path(n.args[0]):
                     # np.<ufunc>.at(self.table, ...) mutates in place
-                    mutation(node, meth, _describe(node.args[0]))
-                elif fn.attr in _MUTATORS and _is_self_rooted(fn.value) \
-                        and not locked:
-                    mutation(node, meth,
-                             f"{_describe(fn.value)} (via .{fn.attr}())")
-                elif isinstance(fn.value, ast.Name) and \
-                        fn.value.id == "self" and fn.attr in methods:
-                    visit(fn.attr, locked)
-        for child in ast.iter_child_nodes(node):
-            scan(child, meth, locked, global_names)
+                    if node.cls is not None and _is_self_rooted(n.args[0]):
+                        mutation(n, _describe(n.args[0]))
+                    elif isinstance(n.args[0], ast.Name) and \
+                            n.args[0].id in mod_state:
+                        mutation(n, f"module state '{n.args[0].id}'")
+                elif f.attr in _MUTATORS and not _is_tls_path(f.value):
+                    if node.cls is not None and _is_self_rooted(f.value):
+                        mutation(n, f"{_describe(f.value)} (via .{f.attr}())")
+                    elif isinstance(f.value, ast.Name) and \
+                            f.value.id in mod_state:
+                        mutation(n, f"module state '{f.value.id}' "
+                                    f"(via .{f.attr}())")
+            tgt = graph.call_target(n)
+            if tgt is not None and tgt in graph.nodes:
+                queue.append((tgt, locked,
+                              chain + (_node_display(graph.nodes[tgt]),)))
+        for child in ast.iter_child_nodes(n):
+            scan(child, locked)
 
-    def visit(meth: str, locked: bool) -> None:
-        if (meth, locked) in visited:
-            return
-        visited.add((meth, locked))
-        fn = methods[meth]
-        global_names = {
-            name for n in ast.walk(fn) if isinstance(n, ast.Global)
-            for name in n.names}
-        for child in fn.body:
-            scan(child, meth, locked, global_names)
-
-    for root in sorted(roots):
-        visit(root, False)
-    return findings
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for child in body:
+        scan(child, locked0)
 
 
 # ---------------------------------------------------------------------------
@@ -439,7 +589,7 @@ def _check_obs_guard(sc: _FileScan) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
-# check: trace-purity
+# check: trace-purity (interprocedural over the call graph)
 # ---------------------------------------------------------------------------
 
 def _is_tracer_expr(expr: ast.AST) -> bool:
@@ -489,43 +639,278 @@ def _traced_functions(tree: ast.Module) -> List[ast.AST]:
     return out
 
 
-def _check_trace_purity(sc: _FileScan) -> List[Finding]:
-    findings: List[Finding] = []
+def _host_callback_desc(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if _last_name(f) in _HOST_CALLBACKS:
+        return _describe(f)
+    if isinstance(f, ast.Attribute) and \
+            f.attr in ("print", "callback", "breakpoint") and \
+            _last_name(f.value) == "debug":
+        return _describe(f)  # jax.debug.print / debug.callback / ...
+    return None
 
-    def impure(node: ast.AST, fn_name: str, what: str) -> None:
+
+def _check_trace_purity(scans: List[_FileScan],
+                        graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    sc_by_path = {sc.path: sc for sc in scans}
+    for sc in scans:
+        for fn in _traced_functions(sc.tree):
+            root_name = getattr(fn, "name", "<lambda>")
+            _walk_traced(sc, fn, root_name, graph, sc_by_path, findings)
+    return findings
+
+
+def _walk_traced(root_sc: _FileScan, root_fn: ast.AST, root_name: str,
+                 graph: CallGraph, sc_by_path: Dict[str, _FileScan],
+                 findings: List[Finding]) -> None:
+    scanned: Set[int] = set()
+    visited_nodes: Set[str] = set()
+    # (fn ast, owning scan, display name, chain from the traced root)
+    stack: List[Tuple[ast.AST, _FileScan, str, Tuple[str, ...]]] = [
+        (root_fn, root_sc, root_name, (root_name,))]
+
+    def impure(sc: _FileScan, node: ast.AST, name: str,
+               chain: Tuple[str, ...], what: str) -> None:
+        if len(chain) > 1:
+            where = (f"{what} inside '{name}' reached from traced "
+                     f"'{root_name}' via call chain {' -> '.join(chain)}")
+        else:
+            where = (f"{what} inside '{name}' which is traced by "
+                     f"jax.jit/shard_map")
         findings.append(Finding(
             "trace-purity", sc.path, node.lineno,
-            f"{what} inside '{fn_name}' which is traced by "
-            f"jax.jit/shard_map — it runs once at trace time and vanishes "
-            f"from the compiled program"))
+            f"{where} — it runs once at trace time and vanishes from the "
+            f"compiled program"))
 
-    for fn in _traced_functions(sc.tree):
-        fn_name = getattr(fn, "name", "<lambda>")
+    def host_cb(sc: _FileScan, node: ast.AST, name: str,
+                chain: Tuple[str, ...], desc: str) -> None:
+        via = (f" via call chain {' -> '.join(chain)}"
+               if len(chain) > 1 else "")
+        findings.append(Finding(
+            "trace-purity", sc.path, node.lineno,
+            f"host callback '{desc}' inside '{name}' under "
+            f"jax.jit/shard_map trace{via} — it stages a host round-trip "
+            f"into every compiled step; allowlist the site with "
+            f"`# {_ALLOW_HOST_CB}` if intended"))
+
+    while stack:
+        fn, sc, name, chain = stack.pop()
+        if id(fn) in scanned:
+            continue
         for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                scanned.add(id(node))
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 for item in node.items:
                     if _is_lockish_ctx(item.context_expr):
-                        impure(node, fn_name,
+                        impure(sc, node, name, chain,
                                f"lock acquisition "
                                f"'{_describe(item.context_expr)}'")
             if not isinstance(node, ast.Call):
                 continue
+            cb = _host_callback_desc(node)
+            if cb is not None and not sc.line_has(node.lineno,
+                                                 _ALLOW_HOST_CB):
+                host_cb(sc, node, name, chain, cb)
             f = node.func
             if isinstance(f, ast.Name) and f.id == "print":
-                impure(node, fn_name, "print()")
+                impure(sc, node, name, chain, "print()")
             elif isinstance(f, ast.Attribute):
                 root = _root_name(f)
                 if root == "time" and f.attr in _TIME_FNS:
-                    impure(node, fn_name, f"wall-clock call time.{f.attr}()")
+                    impure(sc, node, name, chain,
+                           f"wall-clock call time.{f.attr}()")
                 elif f.attr in ("acquire", "release") and \
                         _is_lockish_ctx(f.value):
-                    impure(node, fn_name,
+                    impure(sc, node, name, chain,
                            f"lock call '{_describe(f)}()'")
                 elif root == "obs" or root in sc.obs_module_aliases:
-                    impure(node, fn_name,
+                    impure(sc, node, name, chain,
                            f"obs instrumentation '{_describe(f)}()'")
                 elif root == "threading" and f.attr in ("Lock", "RLock"):
-                    impure(node, fn_name, "lock construction")
+                    impure(sc, node, name, chain, "lock construction")
+            tgt = graph.call_target(node)
+            if tgt is not None and tgt not in visited_nodes:
+                visited_nodes.add(tgt)
+                callee = graph.nodes.get(tgt)
+                if callee is None or callee.qual == "<module>":
+                    continue
+                callee_sc = sc_by_path.get(callee.path)
+                if callee_sc is not None and id(callee.fn) not in scanned:
+                    stack.append((callee.fn, callee_sc,
+                                  _node_display(callee),
+                                  chain + (_node_display(callee),)))
+
+
+# ---------------------------------------------------------------------------
+# check: lock-order (static inversion cycles over the call graph)
+# ---------------------------------------------------------------------------
+
+def _collect_checked_locks(scans: List[_FileScan], graph: CallGraph
+                           ) -> Tuple[Dict[str, Dict[str, str]],
+                                      Dict[Tuple[str, str], Dict[str, str]]]:
+    """Map ``x = checked_lock("name")`` assignments to lock names:
+    per-module ``var -> name`` and per-class ``self.attr -> name``."""
+    mi_by_path = {mi.path: mi for mi in graph.modules.values()}
+    mod_locks: Dict[str, Dict[str, str]] = {}
+    cls_locks: Dict[Tuple[str, str], Dict[str, str]] = {}
+
+    def lock_name(value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Call) and \
+                _last_name(value.func) == "checked_lock" and value.args and \
+                isinstance(value.args[0], ast.Constant) and \
+                isinstance(value.args[0].value, str):
+            return value.args[0].value
+        return None
+
+    for sc in scans:
+        mi = mi_by_path.get(sc.path)
+        if mi is None:
+            continue
+        for node in ast.walk(sc.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            name = lock_name(node.value)
+            if name is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    mod_locks.setdefault(mi.name, {})[tgt.id] = name
+        for stmt in sc.tree.body:
+            if not isinstance(stmt, ast.ClassDef):
+                continue
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Assign):
+                    continue
+                name = lock_name(node.value)
+                if name is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        cls_locks.setdefault(
+                            (mi.name, stmt.name), {})[tgt.attr] = name
+    return mod_locks, cls_locks
+
+
+def _order_path(adj: Dict[str, Set[str]], src: str,
+                dst: str) -> Optional[List[str]]:
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in sorted(adj.get(node, ())):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _check_lock_order(scans: List[_FileScan],
+                      graph: CallGraph) -> List[Finding]:
+    mod_locks, cls_locks = _collect_checked_locks(scans, graph)
+    if not mod_locks and not cls_locks:
+        return []
+
+    def resolve_lock(expr: ast.AST, node: FuncNode) -> Optional[str]:
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and node.cls is not None:
+                return cls_locks.get((node.module, node.cls),
+                                     {}).get(expr.attr)
+            root = _root_name(expr)
+            if root is None:
+                return None
+            mi = graph.modules[node.module]
+            target_name = mi.import_aliases.get(root)
+            if target_name is None and root in mi.from_imports:
+                m, orig = mi.from_imports[root]
+                target_name = f"{m}.{orig}" if m else orig
+            if target_name:
+                target = graph._find_module(target_name)
+                if target is not None:
+                    return mod_locks.get(target.name, {}).get(expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            return mod_locks.get(node.module, {}).get(expr.id)
+        return None
+
+    # acquisition edges: (held, acquired) -> first site (path, line, chain)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    adj: Dict[str, Set[str]] = {}
+    memo: Set[Tuple[str, Tuple[str, ...]]] = set()
+
+    def walk(node_id: str, held: Tuple[str, ...],
+             chain: Tuple[str, ...]) -> None:
+        key = (node_id, tuple(sorted(set(held))))
+        if key in memo or len(chain) > 25:
+            return
+        memo.add(key)
+        node = graph.nodes.get(node_id)
+        if node is None:
+            return
+
+        def scan(n: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in n.items:
+                    ln = resolve_lock(item.context_expr, node)
+                    if ln is None:
+                        continue
+                    for h in new_held:
+                        if h != ln and (h, ln) not in edges:
+                            edges[(h, ln)] = (node.path, n.lineno,
+                                              " -> ".join(chain))
+                            adj.setdefault(h, set()).add(ln)
+                    if ln not in new_held:
+                        new_held = new_held + (ln,)
+                for child in n.body:
+                    scan(child, new_held)
+                return
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(n, ast.Call):
+                tgt = graph.call_target(n)
+                if tgt is not None and tgt in graph.nodes:
+                    walk(tgt, held,
+                         chain + (_node_display(graph.nodes[tgt]),))
+            for child in ast.iter_child_nodes(n):
+                scan(child, held)
+
+        body = node.fn.body if isinstance(node.fn.body, list) \
+            else [node.fn.body]
+        for child in body:
+            scan(child, held)
+
+    for node_id in sorted(graph.nodes):
+        node = graph.nodes[node_id]
+        walk(node_id, (), (_node_display(node),))
+
+    findings: List[Finding] = []
+    reported: Set[frozenset] = set()
+    for (a, b), (path, line, chain_desc) in sorted(edges.items()):
+        cyc = _order_path(adj, b, a)
+        if cyc is None:
+            continue
+        cyc_set = frozenset([a] + cyc)
+        if cyc_set in reported:
+            continue
+        reported.add(cyc_set)
+        opposite = edges.get((cyc[0], cyc[1])) if len(cyc) > 1 else None
+        opp_desc = f"; opposite order acquired in {opposite[2]}" \
+            if opposite else ""
+        findings.append(Finding(
+            "lock-order", path, line,
+            f"static lock-order inversion: acquiring '{b}' while holding "
+            f"'{a}' (in {chain_desc}) closes the cycle "
+            f"{' -> '.join([a] + cyc)} — the two orders can deadlock under "
+            f"the right interleaving{opp_desc}"))
     return findings
 
 
@@ -552,7 +937,9 @@ def lint_files(files: Iterable[str],
     active = set(checks or ALL_CHECKS)
     unknown = active - set(ALL_CHECKS)
     if unknown:
-        raise ValueError(f"unknown checks: {sorted(unknown)}")
+        raise ValueError(
+            f"unknown checks: {sorted(unknown)}; "
+            f"valid checks: {', '.join(ALL_CHECKS)}")
     scans: List[_FileScan] = []
     findings: List[Finding] = []
     for path in files:
@@ -564,24 +951,67 @@ def lint_files(files: Iterable[str],
             findings.append(Finding(
                 "syntax", path, e.lineno or 0, f"does not parse: {e.msg}"))
             continue
-        scans.append(_FileScan(path, tree))
+        scans.append(_FileScan(path, tree, src.splitlines()))
+    graph: Optional[CallGraph] = None
+    if active & _GRAPH_CHECKS:
+        graph = build_callgraph((sc.path, sc.tree) for sc in scans)
     for sc in scans:
-        if "fiber-shared-state" in active:
-            findings.extend(_check_fiber_shared_state(sc))
         if "obs-guard" in active:
             findings.extend(_check_obs_guard(sc))
+    if graph is not None:
+        if "fiber-shared-state" in active:
+            findings.extend(_check_fiber_shared_state(scans, graph))
         if "trace-purity" in active:
-            findings.extend(_check_trace_purity(sc))
+            findings.extend(_check_trace_purity(scans, graph))
+        if "lock-order" in active:
+            findings.extend(_check_lock_order(scans, graph))
     if "ctypes-contract" in active:
         findings.extend(_check_ctypes_contract(scans))
-    findings.sort(key=lambda f: (f.path, f.line, f.check))
-    return findings
+    # dedup (a nested def can be reached both inside its parent's subtree
+    # and as its own call-graph node), then stable order
+    seen: Set[Tuple[str, str, int, str]] = set()
+    unique: List[Finding] = []
+    for f in findings:
+        key = (f.check, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    unique.sort(key=lambda f: (f.path, f.line, f.check))
+    return unique
 
 
 def run_lint(paths: Sequence[str],
              checks: Optional[Sequence[str]] = None) -> List[Finding]:
     """Lint every ``.py`` file under ``paths`` (files or directories)."""
     return lint_files(_iter_py_files(paths), checks)
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Accepted finding ids from a baseline file: either the
+    ``--format=json`` / ``--write-baseline`` output or a plain list of
+    ids."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    items: Iterable = ()
+    if isinstance(data, dict):
+        items = data.get("ids") or data.get("findings") or ()
+    elif isinstance(data, list):
+        items = data
+    ids: Set[str] = set()
+    for item in items:
+        if isinstance(item, str):
+            ids.add(item)
+        elif isinstance(item, dict) and "id" in item:
+            ids.add(str(item["id"]))
+    return ids
+
+
+def apply_baseline(findings: Sequence[Finding], baseline_ids: Set[str]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (new, suppressed-by-baseline)."""
+    new = [f for f in findings if f.id not in baseline_ids]
+    old = [f for f in findings if f.id in baseline_ids]
+    return new, old
 
 
 def _default_target() -> str:
@@ -600,22 +1030,50 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--check", action="append", metavar="NAME",
                         help=f"run only the named check(s); "
                              f"known: {', '.join(ALL_CHECKS)}")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="suppress findings whose stable id appears in "
+                             "FILE (json: --write-baseline output, "
+                             "--format=json output, or a list of ids)")
+    parser.add_argument("--write-baseline", metavar="FILE",
+                        help="write the current findings as an accepted "
+                             "baseline and exit 0")
     args = parser.parse_args(argv)
     try:
         findings = run_lint(args.paths or [_default_target()], args.check)
     except ValueError as e:
-        parser.error(str(e))
+        parser.error(str(e))  # exit 2, lists the valid check set
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump({"ids": sorted({x.id for x in findings}),
+                       "findings": [x.to_dict() for x in findings]},
+                      f, indent=2)
+            f.write("\n")
+        print(f"baseline: {len(findings)} finding(s) -> "
+              f"{args.write_baseline}", file=sys.stderr)
+        return 0
+    suppressed: List[Finding] = []
+    if args.baseline:
+        try:
+            baseline_ids = load_baseline(args.baseline)
+        except (OSError, json.JSONDecodeError) as e:
+            parser.error(f"cannot read baseline {args.baseline}: {e}")
+        findings, suppressed = apply_baseline(findings, baseline_ids)
     if args.format == "json":
-        print(json.dumps({
+        payload = {
             "count": len(findings),
             "checks": list(args.check or ALL_CHECKS),
             "findings": [f.to_dict() for f in findings],
-        }, indent=2))
+        }
+        if args.baseline:
+            payload["suppressed_count"] = len(suppressed)
+        print(json.dumps(payload, indent=2))
     else:
         for f in findings:
             print(f.format())
-        print(f"{len(findings)} finding(s)" if findings
-              else "clean: no findings", file=sys.stderr)
+        tail = f", {len(suppressed)} suppressed by baseline" \
+            if suppressed else ""
+        print((f"{len(findings)} finding(s){tail}" if findings
+               else f"clean: no findings{tail}"), file=sys.stderr)
     return 1 if findings else 0
 
 

@@ -28,6 +28,15 @@ cross-site ordering discipline that prevents deadlock.  Same-name nesting
 is therefore not recorded as an edge.  Stacks are captured at FIRST
 observation of an edge; repeat acquisitions only bump a counter.
 
+**Sampling mode** (``BRPC_TPU_RACECHECK_SAMPLE=N`` or
+:func:`set_sample`): the ~26µs/acquire checked-mode cost is almost all
+stack capture.  Under sampling only every Nth acquisition per lock
+captures its stack eagerly — but the FIRST observation of a new ordering
+edge always captures the acquiring stack (lazily, at edge-record time),
+so the order graph itself stays exact: sampling degrades stack
+*context* on repeat acquisitions (shown as a placeholder), never edge or
+cycle detection.  ``bench_analysis.py`` records the sampled overhead.
+
 This module imports only the stdlib — it sits below ``obs`` and ``rpc``
 in the dependency order, never above.
 """
@@ -43,9 +52,15 @@ from typing import Dict, List, Optional, Set, Tuple
 __all__ = [
     "checked_lock", "enabled", "set_enabled", "CheckedLock",
     "note_blocking", "findings", "clear", "report", "Finding",
+    "sample_every", "set_sample",
 ]
 
 _override: Optional[bool] = None
+_sample_override: Optional[int] = None
+
+#: held-stack placeholder for acquisitions whose capture was sampled out
+SAMPLED_OUT = ("<stack not captured: sampled out — lower "
+               "BRPC_TPU_RACECHECK_SAMPLE for full context>\n")
 
 
 def enabled() -> bool:
@@ -62,6 +77,36 @@ def set_enabled(on: Optional[bool]) -> None:
     var's verdict).  Affects locks created AFTER the call."""
     global _override
     _override = on
+
+
+_sample_env_cache: Optional[int] = None
+
+
+def sample_every() -> int:
+    """Stack-capture sampling period: 1 = capture every acquisition
+    (full-fidelity, ~26µs/acquire), N>1 = capture every Nth per lock
+    (``set_sample`` override first, else ``BRPC_TPU_RACECHECK_SAMPLE``).
+    The env var is parsed once and cached — this runs on every
+    acquisition."""
+    global _sample_env_cache
+    if _sample_override is not None:
+        return max(_sample_override, 1)
+    if _sample_env_cache is None:
+        try:
+            _sample_env_cache = max(
+                int(os.environ.get("BRPC_TPU_RACECHECK_SAMPLE", "1")), 1)
+        except ValueError:
+            _sample_env_cache = 1
+    return _sample_env_cache
+
+
+def set_sample(n: Optional[int]) -> None:
+    """Force the sampling period for this process (``None`` restores the
+    env var's verdict and re-reads it).  Takes effect on the next
+    acquisition."""
+    global _sample_override, _sample_env_cache
+    _sample_override = n
+    _sample_env_cache = None
 
 
 @dataclasses.dataclass
@@ -116,12 +161,17 @@ def _find_path(src: str, dst: str) -> Optional[List[str]]:
     return None
 
 
-def _note_acquire_intent(name: str, acq_stack: str) -> None:
+def _note_acquire_intent(name: str,
+                         acq_stack: Optional[str]) -> Optional[str]:
     """Record ordering edges BEFORE blocking on the lock, so a real
-    deadlock still gets its inversion reported."""
+    deadlock still gets its inversion reported.  ``acq_stack`` is None
+    when this acquisition was sampled out; a NEW edge then captures the
+    stack lazily (first observation of an edge is always captured).
+    Returns the stack actually recorded (still None when nothing needed
+    it)."""
     held = _held()
     if not held:
-        return
+        return acq_stack
     with _state_mu:
         for held_name, held_stack in held:
             if held_name == name:
@@ -129,6 +179,9 @@ def _note_acquire_intent(name: str, acq_stack: str) -> None:
             edge = (held_name, name)
             if edge in _edge_stacks:
                 continue
+            if acq_stack is None:
+                # sampled out, but this edge is new: capture after all
+                acq_stack = _stack(skip=3)
             # New edge: does the reverse direction already exist?
             cycle = _find_path(name, held_name)
             _adj.setdefault(held_name, set()).add(name)
@@ -154,23 +207,32 @@ def _note_acquire_intent(name: str, acq_stack: str) -> None:
                         rev_stacks[1],
                 },
             ))
+    return acq_stack
 
 
 class CheckedLock:
     """``threading.Lock`` work-alike that feeds the lock-order graph."""
 
-    __slots__ = ("name", "_lock")
+    __slots__ = ("name", "_lock", "_acquires")
 
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
+        self._acquires = 0
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        acq_stack = _stack(skip=2)
-        _note_acquire_intent(self.name, acq_stack)
+        n = sample_every()
+        self._acquires += 1
+        # Stack capture is ~the whole checked-mode cost; under sampling
+        # only every Nth acquisition (and the first) pays it eagerly.
+        acq_stack = _stack(skip=2) if n <= 1 or \
+            self._acquires % n == 1 else None
+        acq_stack = _note_acquire_intent(self.name, acq_stack)
         ok = self._lock.acquire(blocking, timeout)
         if ok:
-            _held().append((self.name, acq_stack))
+            _held().append((self.name,
+                            acq_stack if acq_stack is not None
+                            else SAMPLED_OUT))
         return ok
 
     def release(self) -> None:

@@ -1,0 +1,288 @@
+"""Unit tests for the whole-package call-graph resolver
+(brpc_tpu.analysis.callgraph) and the interprocedural lint passes built
+on it: cross-module edges, method resolution through self, partial
+targets, cycle tolerance — plus seeded cross-module violations that the
+old per-file lexical pass provably misses but the call-graph pass
+reports with the full call chain."""
+
+import ast
+import textwrap
+
+from brpc_tpu.analysis import lint
+from brpc_tpu.analysis.callgraph import (build_callgraph,
+                                         module_name_for_path)
+
+
+def _graph(tmp_path, **files):
+    pairs = []
+    for name, src in files.items():
+        p = tmp_path / f"{name}.py"
+        p.write_text(textwrap.dedent(src))
+        pairs.append((str(p), ast.parse(textwrap.dedent(src))))
+    return build_callgraph(pairs)
+
+
+def _only_node(g, suffix):
+    hits = [nid for nid in g.nodes if nid.endswith(suffix)]
+    assert len(hits) == 1, (suffix, sorted(g.nodes))
+    return hits[0]
+
+
+def _callee_ids(g, node_id):
+    return sorted({s.callee for s in g.callees(node_id)})
+
+
+# ---- resolver: edges ----
+
+def test_cross_module_edges_from_import_and_alias(tmp_path):
+    g = _graph(
+        tmp_path,
+        helpers="""\
+            def shared():
+                pass
+        """,
+        a="""\
+            from helpers import shared
+
+            def caller():
+                shared()
+        """,
+        b="""\
+            import helpers
+
+            def caller2():
+                helpers.shared()
+        """,
+    )
+    shared = _only_node(g, ":shared")
+    assert _callee_ids(g, _only_node(g, ":caller")) == [shared]
+    assert _callee_ids(g, _only_node(g, ":caller2")) == [shared]
+
+
+def test_method_resolution_through_self_and_base(tmp_path):
+    g = _graph(tmp_path, m="""\
+        class Base:
+            def inherited(self):
+                pass
+
+        class Impl(Base):
+            def entry(self):
+                self.helper()
+                self.inherited()
+
+            def helper(self):
+                pass
+    """)
+    entry = _only_node(g, "Impl.entry")
+    assert _callee_ids(g, entry) == sorted([
+        _only_node(g, "Base.inherited"), _only_node(g, "Impl.helper")])
+
+
+def test_constructor_edge_including_inherited_init(tmp_path):
+    g = _graph(
+        tmp_path,
+        lib="""\
+            class Base:
+                def __init__(self):
+                    pass
+
+            class Widget(Base):
+                pass
+        """,
+        use="""\
+            from lib import Widget
+
+            def make():
+                return Widget()
+        """,
+    )
+    assert _callee_ids(g, _only_node(g, ":make")) == \
+        [_only_node(g, "Base.__init__")]
+
+
+def test_partial_targets(tmp_path):
+    g = _graph(tmp_path, m="""\
+        from functools import partial
+
+        def worker(a, b):
+            pass
+
+        bound = partial(worker, 1)
+
+        def runner():
+            h = partial(worker, 2)
+            h(3)
+
+        def direct():
+            partial(worker, 4)(5)
+    """)
+    worker = _only_node(g, ":worker")
+    assert worker in _callee_ids(g, _only_node(g, ":runner"))
+    assert worker in _callee_ids(g, _only_node(g, ":direct"))
+    # the module-level alias resolves for callers too
+    assert g.modules[next(iter(g.modules))].partial_aliases["bound"] == worker
+
+
+def test_nested_function_edges(tmp_path):
+    g = _graph(tmp_path, m="""\
+        def outer():
+            def inner():
+                leaf()
+            inner()
+
+        def leaf():
+            pass
+    """)
+    outer = _only_node(g, ":outer")
+    inner = _only_node(g, "outer.inner")
+    assert inner in _callee_ids(g, outer)
+    assert _only_node(g, ":leaf") in _callee_ids(g, inner)
+
+
+def test_cycle_tolerance(tmp_path):
+    g = _graph(tmp_path, m="""\
+        def ping():
+            pong()
+
+        def pong():
+            ping()
+    """)
+    ping = _only_node(g, ":ping")
+    reach = g.reachable(ping)
+    assert ping in reach and _only_node(g, ":pong") in reach
+    assert len(reach) == 2  # terminated despite the cycle
+
+
+def test_module_name_for_path(tmp_path):
+    pkg = tmp_path / "pkg" / "sub"
+    pkg.mkdir(parents=True)
+    (tmp_path / "pkg" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text("")
+    assert module_name_for_path(str(pkg / "mod.py")) == "pkg.sub.mod"
+    assert module_name_for_path(str(pkg / "__init__.py")) == "pkg.sub"
+    lone = tmp_path / "lone.py"
+    lone.write_text("")
+    assert module_name_for_path(str(lone)) == "lone"
+
+
+# ---- seeded cross-module violations the lexical pass misses ----
+
+_IMPURE_HELPERS = """\
+    import time
+
+    def stamp(x):
+        return deeper(x)
+
+    def deeper(x):
+        return x + time.time()
+"""
+
+_TRACED_APP = """\
+    import jax
+    from helpers import stamp
+
+    @jax.jit
+    def step(x):
+        return stamp(x)
+"""
+
+
+def test_cross_module_trace_purity_with_chain(tmp_path):
+    (tmp_path / "helpers.py").write_text(textwrap.dedent(_IMPURE_HELPERS))
+    (tmp_path / "app.py").write_text(textwrap.dedent(_TRACED_APP))
+    # the old lexical shape — scanning app.py alone — sees nothing
+    assert lint.run_lint([str(tmp_path / "app.py")]) == []
+    # the whole-package pass follows the chain into the other module
+    findings = [f for f in lint.run_lint([str(tmp_path)])
+                if f.check == "trace-purity"]
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path.endswith("helpers.py")
+    assert "time.time" in f.message
+    assert "step -> stamp -> deeper" in f.message  # the full call chain
+
+
+_SHARED_HELPERS = """\
+    PENDING = []
+
+    def enqueue(item):
+        PENDING.append(item)
+"""
+
+_HANDLER_APP = """\
+    from helpers import enqueue
+
+    class Shard:
+        def __init__(self, server):
+            server.add_service("Ps", self._handle)
+
+        def _handle(self, method, req):
+            enqueue(req)
+            return b""
+"""
+
+
+def test_cross_module_fiber_shared_state_with_chain(tmp_path):
+    (tmp_path / "helpers.py").write_text(textwrap.dedent(_SHARED_HELPERS))
+    (tmp_path / "app.py").write_text(textwrap.dedent(_HANDLER_APP))
+    assert lint.run_lint([str(tmp_path / "app.py")]) == []
+    findings = [f for f in lint.run_lint([str(tmp_path)])
+                if f.check == "fiber-shared-state"]
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path.endswith("helpers.py")
+    assert "PENDING" in f.message
+    assert "Shard._handle -> enqueue" in f.message
+
+
+def test_cross_module_helper_called_under_lock_stays_clean(tmp_path):
+    (tmp_path / "helpers.py").write_text(textwrap.dedent(_SHARED_HELPERS))
+    (tmp_path / "app.py").write_text(textwrap.dedent("""\
+        import threading
+        from helpers import enqueue
+
+        class Shard:
+            def __init__(self, server):
+                self._mu = threading.Lock()
+                server.add_service("Ps", self._handle)
+
+            def _handle(self, method, req):
+                with self._mu:
+                    enqueue(req)
+                return b""
+    """))
+    assert lint.run_lint([str(tmp_path)]) == []
+
+
+def test_thread_local_state_exempt(tmp_path):
+    (tmp_path / "app.py").write_text(textwrap.dedent("""\
+        import threading
+
+        class Shard:
+            def __init__(self, server):
+                self._local = threading.local()
+                server.add_service("Ps", self._handle)
+
+            def _handle(self, method, req):
+                self._local.scratch = req
+                return b""
+    """))
+    assert lint.run_lint([str(tmp_path)]) == []
+
+
+def test_handler_registered_as_bare_function(tmp_path):
+    (tmp_path / "app.py").write_text(textwrap.dedent("""\
+        SEEN = []
+
+        def handle(method, req):
+            SEEN.append(req)
+            return b""
+
+        def setup(server):
+            server.add_service("Ps", handle)
+    """))
+    findings = [f for f in lint.run_lint([str(tmp_path)])
+                if f.check == "fiber-shared-state"]
+    assert len(findings) == 1
+    assert "SEEN" in findings[0].message

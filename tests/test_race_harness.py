@@ -14,6 +14,7 @@ def _isolated_race_state():
     race.clear()
     yield
     race.set_enabled(None)
+    race.set_sample(None)
     race.clear()
 
 
@@ -147,6 +148,80 @@ def test_same_name_sibling_instances_not_an_edge():
         with m1:
             pass
     assert race.findings() == []
+
+
+# ---- sampling mode ----
+
+def test_sample_every_env_and_override(monkeypatch):
+    monkeypatch.setenv("BRPC_TPU_RACECHECK_SAMPLE", "8")
+    race.set_sample(None)
+    assert race.sample_every() == 8
+    race.set_sample(3)
+    assert race.sample_every() == 3
+    monkeypatch.setenv("BRPC_TPU_RACECHECK_SAMPLE", "not-a-number")
+    race.set_sample(None)
+    assert race.sample_every() == 1  # bad values degrade to full capture
+    monkeypatch.setenv("BRPC_TPU_RACECHECK_SAMPLE", "0")
+    assert race.sample_every() == 1  # clamped
+
+
+def test_sampled_inversion_still_detected_with_real_edge_stacks():
+    """Edge and cycle detection are exact under sampling: a NEW ordering
+    edge captures its acquiring stack lazily even when the acquisition
+    was sampled out."""
+    race.set_enabled(True)
+    race.set_sample(1_000_000)  # only each lock's FIRST acquire is eager
+    lock_a = race.checked_lock("smp.A")
+    lock_b = race.checked_lock("smp.B")
+    # burn the first (eagerly captured) acquisitions outside any nesting
+    for lock in (lock_a, lock_b):
+        for _ in range(3):
+            with lock:
+                pass
+    assert race.findings() == []
+
+    def order_ab():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def order_ba():
+        with lock_b:
+            with lock_a:
+                pass
+
+    order_ab()
+    order_ba()
+    inversions = [f for f in race.findings() if f.kind == "lock-inversion"]
+    assert len(inversions) == 1
+    report = inversions[0].format()
+    # both edge-acquisition stacks were captured lazily at first
+    # observation despite sampling
+    assert "order_ab" in report
+    assert "order_ba" in report
+
+
+def test_sampled_out_held_stack_uses_placeholder():
+    race.set_enabled(True)
+    race.set_sample(1_000_000)
+    lock = race.checked_lock("smp.held")
+    with lock:
+        pass  # first acquire: captured eagerly
+    with lock:  # second acquire: sampled out, no edge to rescue it
+        race.note_blocking("brt_channel_call")
+    (f,) = [x for x in race.findings() if x.kind == "blocking-call"]
+    assert any(race.SAMPLED_OUT.strip() in s for s in f.stacks.values())
+
+
+def test_full_capture_unaffected_by_default_sample():
+    race.set_enabled(True)
+    assert race.sample_every() == 1
+    lock = race.checked_lock("smp.full")
+    with lock:
+        race.note_blocking("brt_device_fetch")
+    (f,) = [x for x in race.findings() if x.kind == "blocking-call"]
+    assert not any(race.SAMPLED_OUT.strip() in s
+                   for s in f.stacks.values())
 
 
 # ---- blocking native calls under a lock ----
