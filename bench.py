@@ -57,6 +57,27 @@ def _run_device_child(mode: str, deadline_s: int) -> dict:
         return {"skipped": "device bench emitted no JSON"}
 
 
+def run_ps_bench(deadline_s: int = 300) -> dict:
+    """PS hot-path numbers (bench_ps.py child): sequential-vs-parallel
+    fan-out latency and mutex-vs-rwlock single-shard throughput.  The
+    child degrades itself to {"skipped": ...} without the native core;
+    the deadline guards a wedged build/run."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench_ps.py")],
+            capture_output=True, text=True, timeout=deadline_s, cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        return {"skipped": f"ps bench exceeded {deadline_s}s deadline"}
+    if proc.returncode != 0 or not proc.stdout.strip():
+        tail = (proc.stderr or "").strip()[-200:]
+        return {"skipped": f"ps bench failed rc={proc.returncode}: {tail}"}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except ValueError:
+        return {"skipped": "ps bench emitted no JSON"}
+
+
 def run_device_bench(deadline_s: int = 900) -> dict:
     """Measures the device tier: real chip if one answers, otherwise the
     in-repo fake PJRT plugin (clearly labeled `device_sim`) so the path is
@@ -195,6 +216,10 @@ def main() -> int:
         # `device_sim` block (fake PJRT plugin + host CPU) otherwise.
         device_blocks = run_device_bench()
 
+        # PS hot path (ISSUE 4): fan-out + read-parallel serving, measured
+        # by bench_ps.py in a child (also refreshes BENCH_ps.json).
+        ps_block = run_ps_bench()
+
         gbps = best["gbps"]
         print(json.dumps({
             "metric": "same_host_echo_throughput",
@@ -214,6 +239,7 @@ def main() -> int:
             "small_scaling": scaling,
             "fiber_pingpong": pingpong,
             "tls": tls_stats,
+            "ps": ps_block,
             **device_blocks,
         }))
         return 0

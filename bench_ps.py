@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""PS hot-path benchmark: native async fan-out + read-parallel serving.
+
+Run BY bench.py in a deadline-guarded child (same pattern as
+bench_device.py); standalone `python bench_ps.py` works too.  Emits
+BENCH_ps.json next to the BENCH_obs/BENCH_analysis series and prints ONE
+JSON object.  Without the native core it degrades to {"skipped": ...}.
+
+What it measures (all loopback, CPU shards):
+
+  fanout        — ONE lookup batch whose ids span all shards, issued by
+                  the sequential per-shard call loop vs the call_async
+                  fan-out, at 1/4/8 shards.  Reports whole-batch mean/p99
+                  latency + keys/s and the parallel/sequential latency
+                  ratio — the fan-out's point is max(shard) vs
+                  sum(shard), so the ratio should approach 1/shards.
+  single_shard  — ONE shard hammered with Lookups by 1 vs 8 concurrent
+                  client threads, served under the pre-PR mutex
+                  (lock_mode="mutex") vs the read-parallel rwlock.
+                  Reports keys/s each way and the rwlock/mutex ratio at
+                  8 clients — reader parallelism is the whole difference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def bench_fanout(nshards: int, vocab: int = 65536, dim: int = 64,
+                 batch: int = 4096, secs: float = 2.0) -> dict:
+    from brpc_tpu.ps_remote import PsShardServer, RemoteEmbedding
+
+    servers = [PsShardServer(vocab, dim, i, nshards)
+               for i in range(nshards)]
+    addrs = [s.address for s in servers]
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, batch).astype(np.int32)  # spans all shards
+    out = {}
+    try:
+        for mode, parallel in (("sequential", False), ("parallel", True)):
+            emb = RemoteEmbedding(addrs, vocab, dim, timeout_ms=60000,
+                                  parallel=parallel)
+            try:
+                emb.lookup(ids)  # warm
+                lat = []
+                t_end = time.monotonic() + secs
+                while time.monotonic() < t_end:
+                    t0 = time.perf_counter_ns()
+                    emb.lookup(ids)
+                    lat.append((time.perf_counter_ns() - t0) / 1e6)
+            finally:
+                emb.close()
+            lat.sort()
+            mean_ms = sum(lat) / len(lat)
+            out[mode] = {
+                "mean_ms": round(mean_ms, 3),
+                "p50_ms": round(_percentile(lat, 0.50), 3),
+                "p99_ms": round(_percentile(lat, 0.99), 3),
+                "keys_per_s": round(batch * 1000.0 / mean_ms, 0),
+                "batches": len(lat),
+            }
+    finally:
+        for s in servers:
+            s.close()
+    out["latency_ratio"] = round(
+        out["parallel"]["mean_ms"] / out["sequential"]["mean_ms"], 3)
+    return out
+
+
+def bench_single_shard(clients: int, lock_mode: str, vocab: int = 65536,
+                       dim: int = 128, batch: int = 2048,
+                       secs: float = 2.0) -> dict:
+    import struct
+
+    from brpc_tpu import rpc
+    from brpc_tpu.ps_remote import PsShardServer
+
+    server = PsShardServer(vocab, dim, 0, 1, lock_mode=lock_mode)
+    counts = [0] * clients
+    stop = threading.Event()
+    ready = threading.Barrier(clients + 1, timeout=30)
+
+    def worker(i: int) -> None:
+        ch = rpc.Channel(server.address, timeout_ms=60000)
+        rng = np.random.default_rng(i)
+        ids = rng.integers(0, vocab, batch).astype(np.int32)
+        req = struct.pack("<i", batch) + ids.tobytes()
+        try:
+            ch.call("Ps", "Lookup", req)  # warm
+            ready.wait()
+            while not stop.is_set():
+                ch.call("Ps", "Lookup", req)
+                counts[i] += 1
+        finally:
+            ch.close()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(clients)]
+    try:
+        for t in threads:
+            t.start()
+        ready.wait()
+        t0 = time.monotonic()
+        time.sleep(secs)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        dt = time.monotonic() - t0
+    finally:
+        stop.set()
+        server.close()
+    total = sum(counts)
+    return {
+        "lookups_per_s": round(total / dt, 1),
+        "keys_per_s": round(total * batch / dt, 0),
+    }
+
+
+def main() -> int:
+    out_path = os.path.join(ROOT, "BENCH_ps.json")
+    # cpu_count matters for reading the numbers: on a 1-core host there
+    # is no idle time to overlap, so both ratios sit near 1.0 regardless
+    # of implementation — the fan-out/rwlock wins show with cores.
+    result: dict = {"metric": "ps_hot_path", "cpu_count": os.cpu_count()}
+    # 8 concurrent handlers need >= 8 fiber workers regardless of host
+    # size; must land before the first rpc call initializes the runtime.
+    os.environ.setdefault("BRT_WORKERS", str(max(8, os.cpu_count() or 1)))
+    try:
+        from brpc_tpu import obs, rpc
+
+        if not rpc.native_core_available():
+            result = {"metric": "ps_hot_path",
+                      "skipped": rpc._load_error or
+                      "native core unavailable"}
+        else:
+            obs.set_enabled(False)  # measure the fabric, not the meters
+            result["fanout"] = {
+                str(n): bench_fanout(n) for n in (1, 4, 8)}
+            result["fanout_latency_ratio_4shards"] = \
+                result["fanout"]["4"]["latency_ratio"]
+            single = {}
+            for lock_mode in ("mutex", "rw"):
+                single[lock_mode] = {
+                    str(c): bench_single_shard(c, lock_mode)
+                    for c in (1, 8)}
+            single["rw_over_mutex_8clients"] = round(
+                single["rw"]["8"]["keys_per_s"] /
+                max(single["mutex"]["8"]["keys_per_s"], 1.0), 3)
+            result["single_shard_lookup"] = single
+    except Exception as e:  # noqa: BLE001
+        result = {"metric": "ps_hot_path",
+                  "skipped": f"{type(e).__name__}: {e}"[:300]}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,9 +37,13 @@ order — both import :func:`checked_lock` from here (``lint`` and
 
 from brpc_tpu.analysis.race import (  # noqa: F401
     CheckedLock,
+    CheckedRWLock,
+    RWLock,
     checked_lock,
+    checked_rwlock,
     note_blocking,
 )
 from brpc_tpu.analysis import race  # noqa: F401
 
-__all__ = ["checked_lock", "CheckedLock", "note_blocking", "race"]
+__all__ = ["checked_lock", "checked_rwlock", "CheckedLock",
+           "CheckedRWLock", "RWLock", "note_blocking", "race"]

@@ -20,7 +20,10 @@ the calls, not the file):
   workers (the trampoline releases the GIL across ctypes); any mutation
   of ``self``/module state anywhere in the handler-reachable set — across
   modules, through helpers — must sit inside a ``with self._mu``-style
-  block.  Thread-local state (``self._local.*``/``*tls*``) is exempt.
+  block.  Rwlock sides are understood: ``with self._mu.write():`` is an
+  exclusive hold, ``with self._mu.read():`` is SHARED and never
+  legitimizes mutation.  Thread-local state (``self._local.*``/``*tls*``)
+  is exempt.
 - ``obs-guard`` — instrumentation outside ``brpc_tpu/obs`` must go
   through the no-op-able helpers (``obs.counter``/``obs.recorder``/
   ``obs.record_span``); constructing reducers or touching the Registry
@@ -38,7 +41,9 @@ the calls, not the file):
   the ``with <checked_lock>`` nesting graph over the call graph and
   reports inversion cycles without running anything; the dynamic
   harness (:mod:`brpc_tpu.analysis.race`) becomes the confirmer, not
-  the only detector.
+  the only detector.  ``checked_rwlock`` participates too: both
+  ``.read()`` and ``.write()`` contexts acquire under the lock's one
+  name, matching the dynamic graph's keying.
 
 Findings carry a stable id (hash of check + package-relative path +
 message, deliberately line-free) so CI can diff against an accepted
@@ -76,6 +81,8 @@ _GRAPH_CHECKS = {"fiber-shared-state", "trace-purity", "lock-order"}
 
 #: attribute names that look like a lock on self / a module
 _LOCKISH = ("mu", "lock", "mutex")
+#: rwlock side methods (checked_rwlock's read()/write() contexts)
+_RW_SIDES = ("read", "write")
 #: container methods that mutate their receiver in place
 _MUTATORS = {
     "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
@@ -166,20 +173,40 @@ def _is_tls_path(expr: ast.AST) -> bool:
 
 
 def _is_lockish_ctx(expr: ast.AST) -> bool:
-    """True for `with self._mu:` / `with _load_mu:` style context exprs."""
+    """True for `with self._mu:` / `with _load_mu:` style context exprs,
+    including rwlock sides (`with self._mu.read():` / `.write()`)."""
     name = None
     if isinstance(expr, ast.Attribute):
         name = expr.attr
     elif isinstance(expr, ast.Name):
         name = expr.id
     elif isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Attribute) and f.attr in _RW_SIDES:
+            # with self._mu.read()/.write(): lockish iff the receiver is
+            return _is_lockish_ctx(f.value)
         # with self._mu.acquire_timeout(...) style — treat lock method
         # calls on a lockish receiver as lock context too
-        return _is_lockish_ctx(expr.func)
+        return _is_lockish_ctx(f)
     if name is None:
         return False
     low = name.lower()
     return any(part in low for part in _LOCKISH)
+
+
+def _lock_ctx_kind(expr: ast.AST) -> Optional[str]:
+    """Classify a with-item context: ``"lock"`` for exclusive holds
+    (plain locks, rwlock ``.write()``), ``"read"`` for the SHARED rwlock
+    side, ``None`` for non-lock contexts.  The distinction matters to
+    `fiber-shared-state`: a read-side hold serializes against writers but
+    not against sibling readers, so it must never legitimize mutation."""
+    if not _is_lockish_ctx(expr):
+        return None
+    if isinstance(expr, ast.Call) and \
+            isinstance(expr.func, ast.Attribute) and \
+            expr.func.attr == "read":
+        return "read"
+    return "lock"
 
 
 def _describe(node: ast.AST) -> str:
@@ -483,24 +510,29 @@ def _scan_shared_state(sc: _FileScan, graph: CallGraph, node: FuncNode,
                     for name in n.names}
     mod_state = (mi.module_globals - _local_binds(fn)) | global_names
 
-    def mutation(n: ast.AST, what: str) -> None:
+    def mutation(n: ast.AST, what: str, in_read: bool = False) -> None:
         via = ""
         if len(chain) > 1:
             via = f" [reached via {' -> '.join(chain)}]"
+        hint = (" (a read-side `.read()` hold is SHARED — sibling "
+                "readers run concurrently; mutation needs the write "
+                "side)" if in_read else "")
         findings.append(Finding(
             "fiber-shared-state", sc.path, n.lineno,
             f"handler-reachable {display} mutates {what} outside a "
-            f"`with self._mu` block — handlers run concurrently on fiber "
-            f"workers (the ctypes trampoline releases the GIL){via}"))
+            f"`with self._mu` block{hint} — handlers run concurrently on "
+            f"fiber workers (the ctypes trampoline releases the GIL)"
+            f"{via}"))
 
-    def scan(n: ast.AST, locked: bool) -> None:
+    def scan(n: ast.AST, locked: bool, in_read: bool = False) -> None:
         if isinstance(n, (ast.With, ast.AsyncWith)):
-            now_locked = locked or any(
-                _is_lockish_ctx(item.context_expr) for item in n.items)
+            kinds = [_lock_ctx_kind(item.context_expr) for item in n.items]
+            now_locked = locked or "lock" in kinds
+            now_read = (in_read or "read" in kinds) and not now_locked
             for item in n.items:
-                scan(item.context_expr, locked)
+                scan(item.context_expr, locked, in_read)
             for child in n.body:
-                scan(child, now_locked)
+                scan(child, now_locked, now_read)
             return
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                           ast.Lambda, ast.ClassDef)):
@@ -512,38 +544,42 @@ def _scan_shared_state(sc: _FileScan, graph: CallGraph, node: FuncNode,
                     if _is_tls_path(tgt) or locked:
                         continue
                     if node.cls is not None and _is_self_rooted(tgt):
-                        mutation(tgt, _describe(tgt))
+                        mutation(tgt, _describe(tgt), in_read)
                     else:
                         root = _root_name(tgt)
                         if root is not None and root in mod_state:
                             mutation(tgt, f"module state "
-                                          f"'{_describe(tgt)}'")
+                                          f"'{_describe(tgt)}'", in_read)
                 elif isinstance(tgt, ast.Name) and tgt.id in global_names \
                         and not locked:
-                    mutation(tgt, f"module global '{tgt.id}'")
+                    mutation(tgt, f"module global '{tgt.id}'", in_read)
         if isinstance(n, ast.Call):
             f = n.func
             if isinstance(f, ast.Attribute) and not locked:
                 if f.attr == "at" and n.args and not _is_tls_path(n.args[0]):
                     # np.<ufunc>.at(self.table, ...) mutates in place
                     if node.cls is not None and _is_self_rooted(n.args[0]):
-                        mutation(n, _describe(n.args[0]))
+                        mutation(n, _describe(n.args[0]), in_read)
                     elif isinstance(n.args[0], ast.Name) and \
                             n.args[0].id in mod_state:
-                        mutation(n, f"module state '{n.args[0].id}'")
+                        mutation(n, f"module state '{n.args[0].id}'",
+                                 in_read)
                 elif f.attr in _MUTATORS and not _is_tls_path(f.value):
                     if node.cls is not None and _is_self_rooted(f.value):
-                        mutation(n, f"{_describe(f.value)} (via .{f.attr}())")
+                        mutation(n, f"{_describe(f.value)} "
+                                    f"(via .{f.attr}())", in_read)
                     elif isinstance(f.value, ast.Name) and \
                             f.value.id in mod_state:
                         mutation(n, f"module state '{f.value.id}' "
-                                    f"(via .{f.attr}())")
+                                    f"(via .{f.attr}())", in_read)
             tgt = graph.call_target(n)
             if tgt is not None and tgt in graph.nodes:
+                # Lock context propagates through calls; a read-side hold
+                # does NOT (the callee's mutations still race siblings).
                 queue.append((tgt, locked,
                               chain + (_node_display(graph.nodes[tgt]),)))
         for child in ast.iter_child_nodes(n):
-            scan(child, locked)
+            scan(child, locked, in_read)
 
     body = fn.body if isinstance(fn.body, list) else [fn.body]
     for child in body:
@@ -759,7 +795,9 @@ def _collect_checked_locks(scans: List[_FileScan], graph: CallGraph
 
     def lock_name(value: ast.AST) -> Optional[str]:
         if isinstance(value, ast.Call) and \
-                _last_name(value.func) == "checked_lock" and value.args and \
+                _last_name(value.func) in ("checked_lock",
+                                           "checked_rwlock") and \
+                value.args and \
                 isinstance(value.args[0], ast.Constant) and \
                 isinstance(value.args[0].value, str):
             return value.args[0].value
@@ -818,6 +856,14 @@ def _check_lock_order(scans: List[_FileScan],
         return []
 
     def resolve_lock(expr: ast.AST, node: FuncNode) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            # rwlock sides: `with rw.read():` / `.write()` acquire under
+            # the lock's one name, exactly as the dynamic harness keys
+            # them (a read-vs-write split would hide r/w inversions).
+            f = expr.func
+            if isinstance(f, ast.Attribute) and f.attr in _RW_SIDES:
+                return resolve_lock(f.value, node)
+            return None
         if isinstance(expr, ast.Attribute):
             if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
                     and node.cls is not None:

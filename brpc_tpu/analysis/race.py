@@ -28,6 +28,13 @@ cross-site ordering discipline that prevents deadlock.  Same-name nesting
 is therefore not recorded as an edge.  Stacks are captured at FIRST
 observation of an edge; repeat acquisitions only bump a counter.
 
+:func:`checked_rwlock` is the readers/writer companion (used by the PS
+read-parallel serving path): off mode returns a plain :class:`RWLock`
+(``with rw.read():`` shares, ``with rw.write():`` excludes), checked mode
+a :class:`CheckedRWLock` whose BOTH sides feed the order graph and the
+blocking-call report under the lock's one name — a read-side hold across
+an inverted write-side hold deadlocks just the same.
+
 **Sampling mode** (``BRPC_TPU_RACECHECK_SAMPLE=N`` or
 :func:`set_sample`): the ~26µs/acquire checked-mode cost is almost all
 stack capture.  Under sampling only every Nth acquisition per lock
@@ -50,9 +57,9 @@ import traceback
 from typing import Dict, List, Optional, Set, Tuple
 
 __all__ = [
-    "checked_lock", "enabled", "set_enabled", "CheckedLock",
-    "note_blocking", "findings", "clear", "report", "Finding",
-    "sample_every", "set_sample",
+    "checked_lock", "checked_rwlock", "enabled", "set_enabled",
+    "CheckedLock", "CheckedRWLock", "RWLock", "note_blocking", "findings",
+    "clear", "report", "Finding", "sample_every", "set_sample",
 ]
 
 _override: Optional[bool] = None
@@ -264,6 +271,162 @@ def checked_lock(name: str):
     if not enabled():
         return threading.Lock()
     return CheckedLock(name)
+
+
+class _ReaderSide:
+    """Reusable ``with rw.read():`` context (state-free: safe to share
+    across concurrent holders)."""
+
+    __slots__ = ("_rw",)
+
+    def __init__(self, rw: "RWLock"):
+        self._rw = rw
+
+    def __enter__(self) -> "_ReaderSide":
+        self._rw.acquire_read()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._rw.release_read()
+
+
+class _WriterSide:
+    __slots__ = ("_rw",)
+
+    def __init__(self, rw: "RWLock"):
+        self._rw = rw
+
+    def __enter__(self) -> "_WriterSide":
+        self._rw.acquire_write()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._rw.release_write()
+
+
+class RWLock:
+    """Write-preferring readers/writer lock — the Python-tier analog of
+    ``cpp/fiber/sync.h`` FiberRWLock.  ``with rw.read():`` shares with
+    other readers; ``with rw.write():`` excludes everyone.  Pending
+    writers block NEW readers so a read stream cannot starve a writer.
+    Non-reentrant on both sides, like ``threading.Lock``."""
+
+    __slots__ = ("_cond", "_readers", "_writer", "_wwaiters", "_r", "_w")
+
+    def __init__(self):
+        self._cond = threading.Condition(threading.Lock())
+        self._readers = 0
+        self._writer = False
+        self._wwaiters = 0
+        self._r = _ReaderSide(self)
+        self._w = _WriterSide(self)
+
+    def read(self) -> _ReaderSide:
+        return self._r
+
+    def write(self) -> _WriterSide:
+        return self._w
+
+    def acquire_read(self) -> None:
+        with self._cond:
+            while self._writer or self._wwaiters:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self) -> None:
+        with self._cond:
+            self._readers -= 1
+            if not self._readers:
+                self._cond.notify_all()
+
+    def acquire_write(self) -> None:
+        with self._cond:
+            self._wwaiters += 1
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._wwaiters -= 1
+            self._writer = True
+
+    def release_write(self) -> None:
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+
+class _CheckedSide:
+    """One side of a :class:`CheckedRWLock` (state-free, shared)."""
+
+    __slots__ = ("_owner", "_write")
+
+    def __init__(self, owner: "CheckedRWLock", write: bool):
+        self._owner = owner
+        self._write = write
+
+    def __enter__(self) -> "_CheckedSide":
+        self._owner._enter(self._write)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._owner._exit(self._write)
+
+
+class CheckedRWLock:
+    """:class:`RWLock` work-alike whose read AND write sides feed the
+    lock-order graph under the lock's one name — ordering edges are keyed
+    by name (see module docstring), and splitting the sides would hide
+    inversions between a reader and a writer of the same lock.  Sampling
+    behaves exactly as on :class:`CheckedLock`."""
+
+    __slots__ = ("name", "_rw", "_acquires")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._rw = RWLock()
+        self._acquires = 0
+
+    def read(self) -> _CheckedSide:
+        return _CheckedSide(self, False)
+
+    def write(self) -> _CheckedSide:
+        return _CheckedSide(self, True)
+
+    def _enter(self, write: bool) -> None:
+        n = sample_every()
+        self._acquires += 1
+        acq_stack = _stack(skip=3) if n <= 1 or \
+            self._acquires % n == 1 else None
+        acq_stack = _note_acquire_intent(self.name, acq_stack)
+        if write:
+            self._rw.acquire_write()
+        else:
+            self._rw.acquire_read()
+        _held().append((self.name,
+                        acq_stack if acq_stack is not None
+                        else SAMPLED_OUT))
+
+    def _exit(self, write: bool) -> None:
+        if write:
+            self._rw.release_write()
+        else:
+            self._rw.release_read()
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == self.name:
+                del held[i]
+                break
+
+    def __repr__(self) -> str:
+        return f"<CheckedRWLock {self.name!r}>"
+
+
+def checked_rwlock(name: str):
+    """Readers/writer companion of :func:`checked_lock`: a plain
+    :class:`RWLock` when checking is off, a named :class:`CheckedRWLock`
+    under ``BRPC_TPU_RACECHECK=1``.  Both sides participate in the order
+    graph and in :func:`note_blocking` held-lock reporting."""
+    if not enabled():
+        return RWLock()
+    return CheckedRWLock(name)
 
 
 def note_blocking(what: str) -> None:

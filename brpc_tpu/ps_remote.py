@@ -22,7 +22,7 @@ from typing import List, Sequence
 import numpy as np
 
 from brpc_tpu import obs, rpc
-from brpc_tpu.analysis.race import checked_lock
+from brpc_tpu.analysis.race import checked_lock, checked_rwlock
 
 
 def _record_ps_server(shard_index: int, method: str, count: int,
@@ -37,11 +37,29 @@ def _record_ps_server(shard_index: int, method: str, count: int,
     obs.counter("ps_server_bytes_out").add(rsp_len)
 
 
+class _ExclusiveAsRw:
+    """Presents a plain mutex through the ``read()``/``write()`` surface
+    (the pre-parallel single-lock serving model — kept as the bench
+    baseline for ``bench_ps.py``'s mutex-vs-rwlock comparison)."""
+
+    __slots__ = ("_lock",)
+
+    def __init__(self, lock):
+        self._lock = lock
+
+    def read(self):
+        return self._lock
+
+    def write(self):
+        return self._lock
+
+
 class PsShardServer:
     """One embedding shard behind a native RPC server."""
 
     def __init__(self, vocab: int, dim: int, shard_index: int,
-                 num_shards: int, lr: float = 0.1, seed: int = 0):
+                 num_shards: int, lr: float = 0.1, seed: int = 0,
+                 lock_mode: str = "rw"):
         if vocab % num_shards:
             raise ValueError("num_shards must divide vocab")
         self.shard_index = shard_index
@@ -55,8 +73,16 @@ class PsShardServer:
         # Handlers run concurrently on fiber workers (the trampoline
         # releases the GIL, and numpy releases it again for big ops): a
         # Lookup gather racing an ApplyGrad scatter-sub on overlapping
-        # rows reads torn updates — serialize table access.
-        self._mu = checked_lock("ps.shard")
+        # rows reads torn updates.  Reads share, writes exclude: hot read
+        # loads gather in parallel while ApplyGrad takes the write side.
+        # lock_mode="mutex" restores the old fully-serialized model (the
+        # bench baseline).
+        if lock_mode == "rw":
+            self._mu = checked_rwlock("ps.shard")
+        elif lock_mode == "mutex":
+            self._mu = _ExclusiveAsRw(checked_lock("ps.shard"))
+        else:
+            raise ValueError(f"unknown lock_mode {lock_mode!r}")
         self.server = rpc.Server()
         self.server.add_service("Ps", self._handle)
         self.port = self.server.start("127.0.0.1:0")
@@ -85,12 +111,12 @@ class PsShardServer:
                 f"{self.base + self.rows_per}) for shard base {self.base}"
             )
         if method == "Lookup":
-            with self._mu:
+            with self._mu.read():
                 return self.table[ids].tobytes()
         if method == "ApplyGrad":
             grads = np.frombuffer(payload, np.float32,
                                   count * self.dim, 4 + 4 * count)
-            with self._mu:
+            with self._mu.write():
                 np.subtract.at(self.table, ids,
                                self.lr * grads.reshape(count, self.dim))
             return b""
@@ -98,6 +124,19 @@ class PsShardServer:
 
     def close(self):
         self.server.close()
+
+
+class _TableGen:
+    """One generation of the device-resident table: the buffer handle plus
+    the pins keeping it alive.  A retired generation's handle is released
+    when the last pin drops (never while a Lookup gathers from it)."""
+
+    __slots__ = ("handle", "pins", "retired")
+
+    def __init__(self, handle: int):
+        self.handle = handle
+        self.pins = 0
+        self.retired = False
 
 
 class DevicePsShardServer:
@@ -112,6 +151,19 @@ class DevicePsShardServer:
     serving path — this is the reference's "transport swap is invisible
     above Socket" contract with PJRT as the transport
     (docs/en/rdma.md:34 analog).
+
+    Concurrency is a handle-GENERATION scheme, not a big lock: the update
+    is functional on-device (scatter-sub emits a fresh table buffer), so
+    ``ps.device_shard`` guards only the tiny generation map.  Lookup pins
+    the current generation, gathers/fetches OUTSIDE the lock, unpins.
+    ApplyGrad pins a snapshot, scatters outside the lock, then installs
+    the output under the lock IF its snapshot is still current — a lost
+    install race (concurrent ApplyGrad got there first) discards the
+    stale output and redoes the scatter against the new table, so no
+    update is ever lost and at least one writer makes progress per round.
+    Lookups overlap ApplyGrads and each other; no lock is ever held
+    across a blocking ``brt_device_*`` call (RACECHECK-clean by
+    construction).
     """
 
     def __init__(self, vocab: int, dim: int, shard_index: int,
@@ -131,20 +183,20 @@ class DevicePsShardServer:
         rng = np.random.default_rng(seed + shard_index)
         table = (rng.standard_normal((self.rows_per, dim)) * 0.02
                  ).astype(np.float32)
-        # The table lives on-device from here on; the handle is the table.
-        self.table_h = self.dev.stage(table, device_index)
+        # The table lives on-device from here on; the handle is the table,
+        # versioned by generation (see class docstring).
+        self._gen = 0
+        self._tables = {0: _TableGen(self.dev.stage(table, device_index))}
         # Resident lr scalar: scatter_sub's 4th operand (stays in HBM).
         self.lr_h = self.dev.stage(np.array(lr, np.float32), device_index)
         self._gather = {}   # bucket size -> compiled gather executable
         self._scatter = {}  # bucket size -> compiled scatter-sub executable
-        # Handlers run concurrently on fiber workers (ctypes releases the
-        # GIL across device calls): the read-execute-swap on table_h must
-        # be serialized or a concurrent ApplyGrad uses a released handle /
-        # drops an update.  (BRPC_TPU_RACECHECK=1 will flag this lock as
-        # held across blocking brt_* calls — deliberate: per-shard
-        # serialization IS the consistency model; splitting the swap into
-        # a handle-generation scheme is a ROADMAP open item.)
+        # Guards ONLY the generation map (_gen/_tables pins) — never held
+        # across a device call, so handlers on fiber workers overlap.
         self._mu = checked_lock("ps.device_shard")
+        # Guards the executable caches; held across the (cold, per-bucket)
+        # compile but never across execute/fetch.
+        self._exe_mu = checked_lock("ps.device_shard.exe")
         self.server = rpc.Server()
         self.server.add_service("Ps", self._handle)
         self.port = self.server.start("127.0.0.1:0")
@@ -153,37 +205,63 @@ class DevicePsShardServer:
     def address(self) -> str:
         return f"127.0.0.1:{self.port}"
 
+    def _pin_current(self):
+        """Pin the live table generation: returns ``(gen, handle)`` with
+        the handle guaranteed alive until the matching :meth:`_unpin`."""
+        with self._mu:
+            gen = self._gen
+            entry = self._tables[gen]
+            entry.pins += 1
+            return gen, entry.handle
+
+    def _unpin(self, gen: int) -> None:
+        release = 0
+        with self._mu:
+            entry = self._tables[gen]
+            entry.pins -= 1
+            if entry.retired and entry.pins == 0:
+                del self._tables[gen]
+                release = entry.handle
+        if release:
+            self.dev.release(release)
+
     @property
     def table(self) -> np.ndarray:
-        """Host snapshot (DMAs the resident table down; test/debug use)."""
-        with self._mu:  # table_h may be mid-swap in a concurrent ApplyGrad
-            raw = self.dev.fetch(self.table_h)
+        """Host snapshot (DMAs the resident table down; test/debug use).
+        The pin keeps the snapshot generation alive across the DMA — a
+        concurrent ApplyGrad swap retires it, never frees it mid-fetch."""
+        gen, table_h = self._pin_current()
+        try:
+            raw = self.dev.fetch(table_h)
+        finally:
+            self._unpin(gen)
         return np.frombuffer(raw, np.float32).reshape(self.rows_per,
                                                       self.dim).copy()
 
     def _gather_exe(self, k: int):
-        exe = self._gather.get(k)
-        if exe is None:
-            mlir = self.dev.mlir("gather_rows", self.rows_per, self.dim, k)
-            exe = self._gather[k] = self.dev.compile(mlir)
-        return exe
+        with self._exe_mu:
+            exe = self._gather.get(k)
+            if exe is None:
+                mlir = self.dev.mlir("gather_rows", self.rows_per,
+                                     self.dim, k)
+                exe = self._gather[k] = self.dev.compile(mlir)
+            return exe
 
     def _scatter_exe(self, k: int):
-        exe = self._scatter.get(k)
-        if exe is None:
-            mlir = self.dev.mlir("scatter_sub", self.rows_per, self.dim, k)
-            exe = self._scatter[k] = self.dev.compile(mlir)
-        return exe
+        with self._exe_mu:
+            exe = self._scatter.get(k)
+            if exe is None:
+                mlir = self.dev.mlir("scatter_sub", self.rows_per,
+                                     self.dim, k)
+                exe = self._scatter[k] = self.dev.compile(mlir)
+            return exe
 
     @staticmethod
     def _bucket(count: int) -> int:
         """Round the batch size up to a power of two so the executable
         cache stays log-bounded instead of compiling per distinct count
         (padding: extra ids hit row 0 with zero gradients — a no-op)."""
-        b = 1
-        while b < count:
-            b *= 2
-        return b
+        return 1 << max(0, count - 1).bit_length()
 
     def _handle(self, method: str, payload: bytes) -> bytes:
         if not obs.enabled():
@@ -206,54 +284,91 @@ class DevicePsShardServer:
         bucket = self._bucket(count)
         padded_ids = np.zeros(bucket, np.int32)
         padded_ids[:count] = ids
-        with self._mu:
-            ids_h = self.dev.stage(padded_ids, self.device_index)
-            try:
-                if method == "Lookup":
+        ids_h = self.dev.stage(padded_ids, self.device_index)
+        try:
+            if method == "Lookup":
+                gen, table_h = self._pin_current()
+                try:
                     outs = self._gather_exe(bucket).execute(
-                        [self.table_h, ids_h])
-                    rows_h = outs[0][0]
-                    try:
-                        raw = self.dev.fetch(rows_h)
-                    finally:
-                        self.dev.release(rows_h)
-                    return raw[:count * self.dim * 4]
-                if method == "ApplyGrad":
-                    grads = np.zeros((bucket, self.dim), np.float32)
-                    grads[:count] = np.frombuffer(
-                        payload, np.float32, count * self.dim,
-                        4 + 4 * count).reshape(count, self.dim)
-                    g_h = self.dev.stage(grads, self.device_index)
-                    try:
-                        # scatter_sub scales by the resident lr scalar
-                        # on-chip: table[ids] -= lr * grads.
-                        outs = self._scatter_exe(bucket).execute(
-                            [self.table_h, ids_h, g_h, self.lr_h])
-                    finally:
-                        self.dev.release(g_h)
-                    # The update is functional on-device: the output buffer
-                    # IS the new resident table; the old one retires.
-                    new_table = outs[0][0]
-                    self.dev.release(self.table_h)
-                    self.table_h = new_table
-                    return b""
-                raise ValueError(f"unknown method {method}")
+                        [table_h, ids_h])
+                finally:
+                    self._unpin(gen)
+                rows_h = outs[0][0]
+                try:
+                    raw = self.dev.fetch(rows_h)
+                finally:
+                    self.dev.release(rows_h)
+                return raw[:count * self.dim * 4]
+            if method == "ApplyGrad":
+                grads = np.zeros((bucket, self.dim), np.float32)
+                grads[:count] = np.frombuffer(
+                    payload, np.float32, count * self.dim,
+                    4 + 4 * count).reshape(count, self.dim)
+                g_h = self.dev.stage(grads, self.device_index)
+                try:
+                    return self._apply_grad(bucket, ids_h, g_h)
+                finally:
+                    self.dev.release(g_h)
+            raise ValueError(f"unknown method {method}")
+        finally:
+            self.dev.release(ids_h)
+
+    def _apply_grad(self, bucket: int, ids_h: int, g_h: int) -> bytes:
+        while True:
+            gen, table_h = self._pin_current()
+            try:
+                # scatter_sub scales by the resident lr scalar on-chip:
+                # out = table - scatter(lr * grads); functional — the
+                # output buffer is a CANDIDATE new table.
+                outs = self._scatter_exe(bucket).execute(
+                    [table_h, ids_h, g_h, self.lr_h])
             finally:
-                self.dev.release(ids_h)
+                self._unpin(gen)
+            new_table = outs[0][0]
+            release_old = 0
+            with self._mu:
+                installed = self._gen == gen
+                if installed:
+                    old = self._tables[gen]
+                    old.retired = True
+                    if old.pins == 0:
+                        del self._tables[gen]
+                        release_old = old.handle
+                    self._gen = gen + 1
+                    self._tables[gen + 1] = _TableGen(new_table)
+            if installed:
+                if release_old:
+                    self.dev.release(release_old)
+                return b""
+            # Install race lost: a concurrent ApplyGrad swapped first and
+            # our output was computed against a stale table.  Discard it
+            # and redo against the new current generation — the winner
+            # already made progress, so this terminates.
+            self.dev.release(new_table)
 
     def close(self):
         self.server.close()
         for exe in list(self._gather.values()) + list(
                 self._scatter.values()):
             exe.close()
-        self.dev.release(self.table_h)
+        with self._mu:
+            entries = list(self._tables.values())
+            self._tables.clear()
+        for entry in entries:
+            self.dev.release(entry.handle)
         self.dev.release(self.lr_h)
         if self._owns_dev:
             self.dev.close()
 
 
 class RemoteEmbedding:
-    """Client view of a sharded remote table (owner-routed access)."""
+    """Client view of a sharded remote table (owner-routed access).
+
+    Per-shard requests fan out CONCURRENTLY via ``Channel.call_async``
+    (the ParallelChannel-over-PartitionChannel shape, cpp/cluster/
+    parallel_channel.* + partition_channel.*): whole-batch latency is
+    max(shard RTT) instead of sum(shard RTT).  ``parallel=False``
+    restores the sequential per-shard loop (the bench baseline)."""
 
     @classmethod
     def from_registry(cls, registry_addr: str, cluster: str, vocab: int,
@@ -266,7 +381,6 @@ class RemoteEmbedding:
         — no static address list."""
         from brpc_tpu.naming import NamingClient
         reg = NamingClient(registry_addr)
-        import time
         deadline = time.monotonic() + wait_ms / 1000.0
         version = 0
         groups: dict = {}
@@ -292,7 +406,8 @@ class RemoteEmbedding:
                 # LAST occurrence is the newest.
                 shard_map[sh] = n["addr"]
             for num, shard_map in sorted(groups.items(), reverse=True):
-                if num > 0 and all(i in shard_map for i in range(num))                         and len(shard_map) == num:
+                if num > 0 and len(shard_map) == num and \
+                        all(i in shard_map for i in range(num)):
                     addrs = [shard_map[i] for i in range(num)]
                     reg.close()
                     return cls(addrs, vocab, dim, timeout_ms=timeout_ms)
@@ -303,11 +418,12 @@ class RemoteEmbedding:
                     f"{ {nm: sorted(m) for nm, m in groups.items()} }")
 
     def __init__(self, addresses: Sequence[str], vocab: int, dim: int,
-                 timeout_ms: int = 2000):
+                 timeout_ms: int = 2000, parallel: bool = True):
         self.vocab = vocab
         self.dim = dim
         self.n = len(addresses)
         self.rows_per = vocab // self.n
+        self.parallel = parallel
         self.channels: List[rpc.Channel] = [
             rpc.Channel(a, timeout_ms=timeout_ms) for a in addresses
         ]
@@ -335,16 +451,38 @@ class RemoteEmbedding:
         out = np.empty((flat.size, self.dim), np.float32)
         nbytes_in = 0
         nbytes_out = 0
-        for s, positions, owned in self._owner_split(flat):
-            req = struct.pack("<i", owned.size) + owned.tobytes()
-            rsp = self.channels[s].call("Ps", "Lookup", req)
-            out[positions] = np.frombuffer(rsp, np.float32).reshape(
-                owned.size, self.dim)
-            nbytes_out += len(req)
-            nbytes_in += len(rsp)
+        if self.parallel:
+            # Start every owner-shard call before joining any: the shards
+            # serve concurrently and the batch pays max(shard), not
+            # sum(shard).
+            pending = []
+            try:
+                for s, positions, owned in self._owner_split(flat):
+                    req = struct.pack("<i", owned.size) + owned.tobytes()
+                    nbytes_out += len(req)
+                    pending.append((positions, owned.size, self.channels[s]
+                                    .call_async("Ps", "Lookup", req)))
+                for positions, k, call in pending:
+                    rsp = call.join()
+                    nbytes_in += len(rsp)
+                    out[positions] = np.frombuffer(
+                        rsp, np.float32).reshape(k, self.dim)
+            finally:
+                # On a failed join, the un-joined rest must still be
+                # reaped (close waits for completion, then frees).
+                for _, _, call in pending:
+                    call.close()
+        else:
+            for s, positions, owned in self._owner_split(flat):
+                req = struct.pack("<i", owned.size) + owned.tobytes()
+                rsp = self.channels[s].call("Ps", "Lookup", req)
+                out[positions] = np.frombuffer(rsp, np.float32).reshape(
+                    owned.size, self.dim)
+                nbytes_out += len(req)
+                nbytes_in += len(rsp)
         if rec:
             # Whole-batch latency across all owner shards (each per-shard
-            # RPC is additionally recorded by Channel.call).
+            # RPC is additionally recorded by Channel.call/call_async).
             obs.recorder("ps_client_lookup").record(
                 (time.monotonic_ns() - t0) / 1e9)
             obs.counter("ps_client_lookup_keys").add(int(flat.size))
@@ -359,11 +497,26 @@ class RemoteEmbedding:
         flat = np.asarray(ids, np.int32).reshape(-1)
         g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
         nbytes_out = 0
-        for s, positions, owned in self._owner_split(flat):
-            req = (struct.pack("<i", owned.size) + owned.tobytes() +
-                   g[positions].tobytes())
-            self.channels[s].call("Ps", "ApplyGrad", req)
-            nbytes_out += len(req)
+        if self.parallel:
+            pending = []
+            try:
+                for s, positions, owned in self._owner_split(flat):
+                    req = (struct.pack("<i", owned.size) + owned.tobytes()
+                           + g[positions].tobytes())
+                    nbytes_out += len(req)
+                    pending.append(self.channels[s].call_async(
+                        "Ps", "ApplyGrad", req))
+                for call in pending:
+                    call.join()
+            finally:
+                for call in pending:
+                    call.close()
+        else:
+            for s, positions, owned in self._owner_split(flat):
+                req = (struct.pack("<i", owned.size) + owned.tobytes() +
+                       g[positions].tobytes())
+                self.channels[s].call("Ps", "ApplyGrad", req)
+                nbytes_out += len(req)
         if rec:
             obs.recorder("ps_client_apply").record(
                 (time.monotonic_ns() - t0) / 1e9)

@@ -126,6 +126,16 @@ def _load_locked():
         ctypes.c_size_t, ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t]
     lib.brt_channel_call.restype = ctypes.c_int
+    lib.brt_channel_call_start.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_size_t]
+    lib.brt_channel_call_start.restype = ctypes.c_void_p
+    lib.brt_call_join.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_call_join.restype = ctypes.c_int
+    lib.brt_call_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_call_destroy.restype = None
     lib.brt_channel_destroy.argtypes = [ctypes.c_void_p]
     lib.brt_channel_destroy.restype = None
     lib.brt_free.argtypes = [ctypes.c_void_p]
@@ -348,6 +358,69 @@ class Server:
             self._ptr = None
 
 
+class PendingCall:
+    """One in-flight async RPC (from :meth:`Channel.call_async`).
+
+    ``join()`` parks until the reply lands and returns the response bytes
+    (or raises :class:`RpcError` with the server/transport failure — same
+    contract as the synchronous ``call``).  The native handle is freed
+    exactly once, by ``join()`` or ``close()``; ``close()`` on an
+    un-joined call waits for completion first (the native core may still
+    be filling the response), so abandoning a fan-out mid-error is safe.
+    """
+
+    __slots__ = ("_lib", "_ptr", "_service", "_method", "_peer",
+                 "_req_len", "_t0", "_wall")
+
+    def __init__(self, lib, ptr, service, method, peer, req_len, t0, wall):
+        self._lib = lib
+        self._ptr = ptr
+        self._service = service
+        self._method = method
+        self._peer = peer
+        self._req_len = req_len
+        self._t0 = t0      # None when obs was disabled at start
+        self._wall = wall
+
+    def join(self) -> bytes:
+        if self._ptr is None:
+            raise RuntimeError("async call already joined/closed")
+        if _race.enabled():
+            _race.note_blocking("brt_call_join")
+        ptr, self._ptr = self._ptr, None
+        rsp = ctypes.c_void_p()
+        rsp_len = ctypes.c_size_t()
+        errbuf = ctypes.create_string_buffer(256)
+        try:
+            rc = self._lib.brt_call_join(ptr, ctypes.byref(rsp),
+                                         ctypes.byref(rsp_len), errbuf, 256)
+            if rc != 0:
+                text = errbuf.value.decode(errors="replace")
+                if self._t0 is not None:
+                    _record_client_call(self._service, self._method,
+                                        self._peer, self._t0, self._wall,
+                                        self._req_len, 0, rc, text)
+                raise RpcError(rc, text)
+            try:
+                out = ctypes.string_at(rsp, rsp_len.value)
+            finally:
+                self._lib.brt_free(rsp)
+        finally:
+            self._lib.brt_call_destroy(ptr)
+        if self._t0 is not None:
+            # start -> join latency: the caller-visible async window
+            _record_client_call(self._service, self._method, self._peer,
+                                self._t0, self._wall, self._req_len,
+                                len(out), 0, "")
+        return out
+
+    def close(self) -> None:
+        """Abandon without collecting the result (no-op after join)."""
+        if self._ptr is not None:
+            ptr, self._ptr = self._ptr, None
+            self._lib.brt_call_destroy(ptr)
+
+
 class Channel:
     """Client channel. addr: "ip:port" single-server, or a cluster url
     ("list://h1,h2", "file://path", "dns://host:port") + lb name."""
@@ -390,6 +463,26 @@ class Channel:
             _record_client_call(service, method, self._addr, t0, wall,
                                 len(request), len(out), 0, "")
         return out
+
+    def call_async(self, service: str, method: str,
+                   request: bytes = b"") -> PendingCall:
+        """Starts the call and returns immediately with a
+        :class:`PendingCall`; the RPC proceeds on the fiber scheduler and
+        ``join()`` collects the reply.  Starting N calls before joining
+        any fans out over N servers concurrently — whole-batch latency is
+        max(server) instead of sum(server) (the ParallelChannel shape,
+        cpp/cluster/parallel_channel.*).  The request bytes are copied by
+        the native core before this returns."""
+        rec = obs.enabled()
+        t0 = time.monotonic_ns() if rec else None
+        wall = time.time() if rec else 0.0
+        ptr = self._lib.brt_channel_call_start(
+            self._ptr, service.encode(), method.encode(), request,
+            len(request))
+        if not ptr:
+            raise RpcError(-1, f"call_start failed for {self._addr}")
+        return PendingCall(self._lib, ptr, service, method, self._addr,
+                           len(request), t0, wall)
 
     def close(self) -> None:
         if self._ptr:

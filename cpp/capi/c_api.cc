@@ -49,6 +49,15 @@ struct CChannel {
   std::unique_ptr<ChannelBase> channel;
 };
 
+// One in-flight async call (brt_channel_call_start).  The done closure
+// only touches the CountdownEvent; join/destroy wait on it before reading
+// cntl/response or freeing, so completion never races the caller.
+struct CCall {
+  Controller cntl;
+  IOBuf response;
+  CountdownEvent done{1};
+};
+
 }  // namespace
 
 extern "C" {
@@ -168,6 +177,46 @@ int brt_channel_call(void* channel, const char* service, const char* method,
 
 void brt_channel_destroy(void* channel) {
   delete static_cast<CChannel*>(channel);
+}
+
+void* brt_channel_call_start(void* channel, const char* service,
+                             const char* method, const void* req,
+                             size_t req_len) {
+  auto* c = static_cast<CChannel*>(channel);
+  auto* call = new CCall;
+  IOBuf request;
+  if (req && req_len) request.append(req, req_len);
+  // The done closure runs exactly once, in a fiber, after cntl/response
+  // are filled (including synchronous local failures, which invoke done
+  // before CallMethod returns).
+  CCall* raw = call;
+  c->channel->CallMethod(service, method, &call->cntl, request,
+                         &call->response, [raw] { raw->done.signal(); });
+  return call;
+}
+
+int brt_call_join(void* call, void** rsp, size_t* rsp_len, char* errbuf,
+                  size_t errbuf_len) {
+  auto* c = static_cast<CCall*>(call);
+  c->done.wait();
+  if (c->cntl.Failed()) {
+    if (errbuf && errbuf_len) {
+      snprintf(errbuf, errbuf_len, "%s", c->cntl.ErrorText().c_str());
+    }
+    return c->cntl.ErrorCode() ? c->cntl.ErrorCode() : -1;
+  }
+  const size_t n = c->response.size();
+  void* buf = malloc(n ? n : 1);
+  c->response.copy_to(buf, n);
+  *rsp = buf;
+  *rsp_len = n;
+  return 0;
+}
+
+void brt_call_destroy(void* call) {
+  auto* c = static_cast<CCall*>(call);
+  c->done.wait();
+  delete c;
 }
 
 void brt_free(void* p) { free(p); }

@@ -49,6 +49,26 @@ int brt_channel_call(void* channel, const char* service, const char* method,
                      size_t* rsp_len, char* errbuf, size_t errbuf_len);
 void brt_channel_destroy(void* channel);
 
+// ---- async client calls (the ParallelChannel fan-out primitive) ----
+// Starts `service`.`method` and returns a completion handle immediately;
+// the call proceeds on the fiber scheduler (the reference's done-closure
+// CallMethod, channel.h:89).  The request bytes are copied before return,
+// so the caller's buffer may be freed as soon as this returns.  Never
+// NULL for a live channel.
+void* brt_channel_call_start(void* channel, const char* service,
+                             const char* method, const void* req,
+                             size_t req_len);
+// Parks the calling fiber (or blocks a non-worker thread) until the call
+// behind the handle completes.  Same result contract as brt_channel_call:
+// returns 0 with *rsp/*rsp_len a malloc'd buffer (free with brt_free), or
+// the error code with errbuf filled.  Join at most once per handle, then
+// brt_call_destroy it.
+int brt_call_join(void* call, void** rsp, size_t* rsp_len, char* errbuf,
+                  size_t errbuf_len);
+// Frees the handle.  An un-joined in-flight call is waited for first, so
+// destroy-without-join never races the completion closure.
+void brt_call_destroy(void* call);
+
 void brt_free(void* p);
 
 // ---- runtime ----

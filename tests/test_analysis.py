@@ -195,6 +195,66 @@ def test_non_handler_class_not_audited(tmp_path):
     assert _lint_src(tmp_path, src) == []
 
 
+# ---- fiber-shared-state: rwlock read()/write() contexts ----
+
+_RW_HANDLER = """\
+    from brpc_tpu.analysis.race import checked_rwlock
+
+    class Shard:
+        def __init__(self, server):
+            self._mu = checked_rwlock("t.shard")
+            self.count = 0
+            server.add_service("Ps", self._handle)
+
+        def _handle(self, method, req):
+            {body}
+            return b""
+"""
+
+
+def test_mutation_under_write_side_clean(tmp_path):
+    fs = _lint_src(tmp_path, _RW_HANDLER.format(
+        body="with self._mu.write():\n                self.count += 1"))
+    assert _by_check(fs, "fiber-shared-state") == []
+
+
+def test_mutation_under_read_side_flagged(tmp_path):
+    """The read side is SHARED — it must never legitimize mutation."""
+    fs = _lint_src(tmp_path, _RW_HANDLER.format(
+        body="with self._mu.read():\n                self.count += 1"))
+    (f,) = _by_check(fs, "fiber-shared-state")
+    assert "self.count" in f.message
+    assert "read-side" in f.message and "write" in f.message
+
+
+def test_read_only_access_under_read_side_clean(tmp_path):
+    fs = _lint_src(tmp_path, _RW_HANDLER.format(
+        body="with self._mu.read():\n                x = self.count"))
+    assert _by_check(fs, "fiber-shared-state") == []
+
+
+def test_read_side_does_not_propagate_as_lock_through_calls(tmp_path):
+    src = """\
+        from brpc_tpu.analysis.race import checked_rwlock
+
+        class Shard:
+            def __init__(self, server):
+                self._mu = checked_rwlock("t.shard")
+                server.add_service("Ps", self._handle)
+
+            def _handle(self, method, req):
+                with self._mu.read():
+                    self._bump()
+                return b""
+
+            def _bump(self):
+                self.count = 1
+    """
+    fs = _lint_src(tmp_path, src)
+    (f,) = _by_check(fs, "fiber-shared-state")
+    assert "Shard._bump" in f.message
+
+
 # ---- obs-guard ----
 
 def test_direct_registry_use_flagged(tmp_path):
@@ -393,6 +453,77 @@ def test_static_lock_order_instance_locks(tmp_path):
     """)
     (f,) = _by_check(fs, "lock-order")
     assert "inst.A" in f.message and "inst.B" in f.message
+
+
+_RW_LOCK_FIXTURE = """\
+    from brpc_tpu.analysis.race import checked_lock, checked_rwlock
+
+    rw = checked_rwlock("rwfix.A")
+    mu = checked_lock("rwfix.B")
+
+    def read_then_lock():
+        with rw.read():
+            with mu:
+                pass
+
+    def lock_then_write():
+        with mu:
+            with rw.write():
+                pass
+"""
+
+
+def test_static_lock_order_sees_rwlock_sides(tmp_path):
+    """checked_rwlock's read()/write() contexts acquire under the lock's
+    one name, so a read-vs-write inversion against another lock is a
+    static cycle — parity with the dynamic harness's keying."""
+    fs = _lint_src(tmp_path, _RW_LOCK_FIXTURE)
+    (f,) = _by_check(fs, "lock-order")
+    assert "rwfix.A" in f.message and "rwfix.B" in f.message
+    assert "deadlock" in f.message
+
+
+def test_static_lock_order_rwlock_consistent_order_clean(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        from brpc_tpu.analysis.race import checked_lock, checked_rwlock
+
+        rw = checked_rwlock("rwok.A")
+        mu = checked_lock("rwok.B")
+
+        def reader():
+            with rw.read():
+                with mu:
+                    pass
+
+        def writer():
+            with rw.write():
+                with mu:
+                    pass
+    """)
+    assert _by_check(fs, "lock-order") == []
+
+
+def test_static_rwlock_inversion_matches_dynamic_harness(tmp_path):
+    from brpc_tpu.analysis import race
+
+    static = _by_check(_lint_src(tmp_path, _RW_LOCK_FIXTURE), "lock-order")
+    assert len(static) == 1
+
+    race.clear()
+    race.set_enabled(True)
+    try:
+        ns = {"checked_lock": race.checked_lock,
+              "checked_rwlock": race.checked_rwlock}
+        exec(textwrap.dedent(_RW_LOCK_FIXTURE).split("\n", 1)[1], ns)
+        ns["read_then_lock"]()
+        ns["lock_then_write"]()
+        dynamic = [f for f in race.findings()
+                   if f.kind == "lock-inversion"]
+    finally:
+        race.set_enabled(None)
+        race.clear()
+    assert len(dynamic) == 1
+    assert {"rwfix.A", "rwfix.B"} <= set(dynamic[0].locks)
 
 
 def test_static_lock_order_matches_dynamic_harness(tmp_path):

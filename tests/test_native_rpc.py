@@ -112,22 +112,23 @@ def test_handler_trampoline_survives_gc():
     srv.add_service("Gc", bounce)
     assert len(srv._handlers) == 1  # the pin itself
     del bounce
+    # a second service on the same server pins independently (registered
+    # before start: AddService on a RUNNING server is EPERM by contract)
+    srv2_calls = []
+
+    def second(method, request):
+        srv2_calls.append(method)
+        return b"ok"
+
+    srv.add_service("Gc2", second)
+    assert len(srv._handlers) == 2
+    del second
     for _ in range(3):
         gc.collect()
     port = srv.start("127.0.0.1:0")
     ch = rpc.Channel(f"127.0.0.1:{port}")
     try:
         assert ch.call("Gc", "Any", b"abc") == b"cba"
-        # a second service on the same server pins independently
-        srv2_calls = []
-
-        def second(method, request):
-            srv2_calls.append(method)
-            return b"ok"
-
-        srv.add_service("Gc2", second)
-        del second
-        gc.collect()
         assert ch.call("Gc2", "Ping") == b"ok"
         assert srv2_calls == ["Ping"]
     finally:

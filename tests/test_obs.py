@@ -292,7 +292,15 @@ def test_channel_call_records_spans_and_latency():
     obs.reset_fabric_vars()
     rpcz.clear()
     srv = rpc.Server()
-    srv.add_service("Echo", lambda method, req: req)
+
+    def echo(method, req):
+        if method != "Echo":
+            # unknown methods must FAIL (the error-span assertions below
+            # drive the Boom call through the failure path)
+            raise ValueError(f"no method {method}")
+        return req
+
+    srv.add_service("Echo", echo)
     srv.add_status_service()
     port = srv.start("127.0.0.1:0")
     ch = rpc.Channel(f"127.0.0.1:{port}")

@@ -273,6 +273,138 @@ def test_blocking_rpc_call_detected_end_to_end():
                for f in flagged)
 
 
+# ---- readers/writer lock (checked_rwlock) ----
+
+def test_rwlock_plain_when_checking_off(monkeypatch):
+    monkeypatch.delenv("BRPC_TPU_RACECHECK", raising=False)
+    race.set_enabled(None)
+    rw = race.checked_rwlock("rw.off")
+    assert isinstance(rw, race.RWLock)
+    assert not isinstance(rw, race.CheckedRWLock)
+    race.set_enabled(True)
+    assert isinstance(race.checked_rwlock("rw.on"), race.CheckedRWLock)
+
+
+@pytest.mark.parametrize("factory", ["plain", "checked"])
+def test_rwlock_readers_share_writers_exclude(factory):
+    """Two readers hold the lock at the same instant; a writer waits for
+    both, then holds alone.  Same contract for the plain and the checked
+    variant."""
+    import time
+
+    if factory == "checked":
+        race.set_enabled(True)
+    rw = (race.CheckedRWLock("rw.sem") if factory == "checked"
+          else race.RWLock())
+    both_in = threading.Barrier(3, timeout=5)
+    release = threading.Event()
+    state = {"write_entered_at": None, "readers_out_at": None}
+
+    def reader():
+        with rw.read():
+            both_in.wait()       # proves BOTH readers are inside at once
+            release.wait(5)
+        # last reader out stamps the time
+
+    def writer():
+        with rw.write():
+            state["write_entered_at"] = time.monotonic()
+
+    r1 = threading.Thread(target=reader)
+    r2 = threading.Thread(target=reader)
+    r1.start()
+    r2.start()
+    both_in.wait()               # readers are concurrent — no deadlock
+    w = threading.Thread(target=writer)
+    w.start()
+    time.sleep(0.05)             # writer is parked behind the readers
+    assert state["write_entered_at"] is None
+    state["readers_out_at"] = time.monotonic()
+    release.set()
+    for t in (r1, r2, w):
+        t.join(5)
+    assert state["write_entered_at"] >= state["readers_out_at"]
+
+
+def test_rwlock_write_preference_blocks_new_readers():
+    """A pending writer gates NEW readers (write-preferring, like the
+    native FiberRWLock) — a read stream cannot starve the writer."""
+    import time
+
+    rw = race.RWLock()
+    in_read = threading.Event()
+    release_first = threading.Event()
+    order = []
+
+    def first_reader():
+        with rw.read():
+            in_read.set()
+            release_first.wait(5)
+
+    def writer():
+        with rw.write():
+            order.append("w")
+
+    def late_reader():
+        with rw.read():
+            order.append("r")
+
+    t1 = threading.Thread(target=first_reader)
+    t1.start()
+    in_read.wait(5)
+    tw = threading.Thread(target=writer)
+    tw.start()
+    time.sleep(0.05)             # writer is now a registered waiter
+    tr = threading.Thread(target=late_reader)
+    tr.start()
+    time.sleep(0.05)
+    release_first.set()
+    for t in (t1, tw, tr):
+        t.join(5)
+    assert order[0] == "w"       # the pending writer beat the late reader
+
+
+def test_checked_rwlock_inversion_with_plain_lock():
+    """Read and write sides feed the order graph under the rwlock's one
+    name, so a read-vs-write inversion against another lock closes a
+    cycle exactly like two plain locks."""
+    race.set_enabled(True)
+    rw = race.checked_rwlock("rwinv.A")
+    mu = race.checked_lock("rwinv.B")
+    with rw.read():
+        with mu:
+            pass
+    assert race.findings() == []
+    with mu:
+        with rw.write():
+            pass
+    inversions = [f for f in race.findings() if f.kind == "lock-inversion"]
+    assert len(inversions) == 1
+    assert {"rwinv.A", "rwinv.B"} <= set(inversions[0].locks)
+
+
+def test_checked_rwlock_read_held_across_blocking_call_flagged():
+    race.set_enabled(True)
+    rw = race.checked_rwlock("rwblk.L")
+    with rw.read():
+        race.note_blocking("brt_device_execute")
+    flagged = [f for f in race.findings() if f.kind == "blocking-call"]
+    assert len(flagged) == 1
+    assert flagged[0].locks == ["rwblk.L"]
+
+
+def test_checked_rwlock_same_name_read_then_write_not_an_edge():
+    """Sibling same-name holds stay exempt for rwlocks too (the per-name
+    edge keying, not a reentrancy endorsement)."""
+    race.set_enabled(True)
+    a = race.checked_rwlock("rwsib.mu")
+    b = race.checked_rwlock("rwsib.mu")
+    with a.read():
+        with b.write():
+            pass
+    assert race.findings() == []
+
+
 def test_report_text():
     race.set_enabled(True)
     assert "no findings" in race.report()
