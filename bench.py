@@ -57,25 +57,39 @@ def _run_device_child(mode: str, deadline_s: int) -> dict:
         return {"skipped": "device bench emitted no JSON"}
 
 
-def run_ps_bench(deadline_s: int = 300) -> dict:
-    """PS hot-path numbers (bench_ps.py child): sequential-vs-parallel
-    fan-out latency and mutex-vs-rwlock single-shard throughput.  The
-    child degrades itself to {"skipped": ...} without the native core;
-    the deadline guards a wedged build/run."""
+def _run_json_child(script: str, label: str, deadline_s: int) -> dict:
+    """Runs a python bench child that prints ONE JSON line (the
+    bench_ps/bench_fault pattern: degrades itself to {"skipped": ...}
+    without the native core; the deadline guards a wedged build/run)."""
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.join(ROOT, "bench_ps.py")],
+            [sys.executable, os.path.join(ROOT, script)],
             capture_output=True, text=True, timeout=deadline_s, cwd=ROOT,
         )
     except subprocess.TimeoutExpired:
-        return {"skipped": f"ps bench exceeded {deadline_s}s deadline"}
+        return {"skipped": f"{label} bench exceeded {deadline_s}s deadline"}
     if proc.returncode != 0 or not proc.stdout.strip():
         tail = (proc.stderr or "").strip()[-200:]
-        return {"skipped": f"ps bench failed rc={proc.returncode}: {tail}"}
+        return {"skipped": f"{label} bench failed rc={proc.returncode}: "
+                           f"{tail}"}
     try:
         return json.loads(proc.stdout.strip().splitlines()[-1])
     except ValueError:
-        return {"skipped": "ps bench emitted no JSON"}
+        return {"skipped": f"{label} bench emitted no JSON"}
+
+
+def run_ps_bench(deadline_s: int = 300) -> dict:
+    """PS hot-path numbers (bench_ps.py child): sequential-vs-parallel
+    fan-out latency and mutex-vs-rwlock single-shard throughput."""
+    return _run_json_child("bench_ps.py", "ps", deadline_s)
+
+
+def run_fault_bench(deadline_s: int = 300) -> dict:
+    """Fault-tolerance numbers (bench_fault.py child): backup-request
+    p99 bounding under an injected slow shard, breaker availability and
+    error latency under a flapping shard (also refreshes
+    BENCH_fault.json)."""
+    return _run_json_child("bench_fault.py", "fault", deadline_s)
 
 
 def run_device_bench(deadline_s: int = 900) -> dict:
@@ -220,6 +234,10 @@ def main() -> int:
         # by bench_ps.py in a child (also refreshes BENCH_ps.json).
         ps_block = run_ps_bench()
 
+        # Fault tolerance (ISSUE 5): backup requests + circuit breaker
+        # under injected faults (bench_fault.py child).
+        fault_block = run_fault_bench()
+
         gbps = best["gbps"]
         print(json.dumps({
             "metric": "same_host_echo_throughput",
@@ -240,6 +258,7 @@ def main() -> int:
             "fiber_pingpong": pingpong,
             "tls": tls_stats,
             "ps": ps_block,
+            "fault": fault_block,
             **device_blocks,
         }))
         return 0

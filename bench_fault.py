@@ -1,0 +1,218 @@
+#!/usr/bin/env python3
+"""Fault-tolerance benchmark: backup requests vs a slow shard, breaker
+availability vs a flapping shard — all failures INJECTED by the seeded
+fault plan (brpc_tpu.fault), so every run replays the same schedule.
+
+Run BY bench.py in a deadline-guarded child (same pattern as
+bench_ps.py); standalone `python bench_fault.py` works too.  Emits
+BENCH_fault.json and prints ONE JSON object.  Without the native core it
+degrades to {"skipped": ...}.
+
+What it measures (loopback, 4 CPU shards, obs ON — the counters ARE part
+of what is being verified):
+
+  slow_shard  — shard 2's Lookup handler sleeps 30ms on 5% of calls
+                (deterministic schedule).  One multi-shard lookup batch,
+                no-hedge vs backup_ms=8.  Hedging math: p99 without the
+                hedge IS the delay (5% > 1%); with it, only
+                both-attempts-slow batches stay slow (0.25% < 1%), so
+                p99 collapses to the fast path and every losing attempt
+                is cancelled (counter-verified).
+  flapping    — shard 2 alternates down/up phases (down = 70% of calls
+                "dropped", burning the attempt timeout — wall-time
+                phases; decisions within a phase stay seeded).  Batches
+                under
+                three configs: bare, retry (2 extra attempts + budget),
+                retry+breaker+prober (EMA isolation, fail-fast, health
+                revival).  Retry buys availability (it rescues partial
+                drops); the breaker buys back throughput and bounds
+                error latency (fail in microseconds, not timeouts) while
+                the probe revives the shard for the up phase.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+def _counters(*names):
+    from brpc_tpu import obs
+
+    return {n: int(obs.counter(n).get_value()) for n in names}
+
+
+def bench_slow_shard(nshards: int = 4, vocab: int = 4096, dim: int = 32,
+                     batch: int = 512, rounds: int = 400) -> dict:
+    from brpc_tpu import fault, obs
+    from brpc_tpu.ps_remote import PsShardServer, RemoteEmbedding
+
+    servers = [PsShardServer(vocab, dim, i, nshards)
+               for i in range(nshards)]
+    addrs = [s.address for s in servers]
+    ids = np.arange(batch, dtype=np.int32) * (vocab // batch)  # all shards
+    out: dict = {"delay_ms": 30, "delay_probability": 0.05,
+                 "backup_ms": 8, "rounds": rounds}
+    try:
+        for mode, backup_ms in (("no_backup", None), ("backup", 8)):
+            fault.install(fault.FaultPlan([fault.FaultRule(
+                action="delay", side="server", service="Ps",
+                method="Lookup", endpoint=addrs[2], delay_ms=30,
+                probability=0.05)], seed=42))
+            obs.reset_fabric_vars()
+            emb = RemoteEmbedding(addrs, vocab, dim, timeout_ms=60000,
+                                  backup_ms=backup_ms)
+            lat = []
+            try:
+                emb.lookup(ids)  # warm
+                for _ in range(rounds):
+                    t0 = time.perf_counter_ns()
+                    emb.lookup(ids)
+                    lat.append((time.perf_counter_ns() - t0) / 1e6)
+            finally:
+                emb.close()
+                fault.clear()
+            lat.sort()
+            out[mode] = {
+                "mean_ms": round(sum(lat) / len(lat), 3),
+                "p50_ms": round(_pct(lat, 0.50), 3),
+                "p90_ms": round(_pct(lat, 0.90), 3),
+                "p99_ms": round(_pct(lat, 0.99), 3),
+                **_counters("rpc_backup_fired", "rpc_backup_wins",
+                            "rpc_cancels"),
+            }
+    finally:
+        for s in servers:
+            s.close()
+    out["p99_ratio_backup_over_none"] = round(
+        out["backup"]["p99_ms"] / max(out["no_backup"]["p99_ms"], 1e-9), 3)
+    return out
+
+
+def bench_flapping(nshards: int = 4, vocab: int = 4096, dim: int = 32,
+                   batch: int = 512, secs: float = 2.0,
+                   phase_ms: float = 300.0) -> dict:
+    from brpc_tpu import fault, obs, resilience, rpc
+    from brpc_tpu.ps_remote import PsShardServer, RemoteEmbedding
+
+    servers = [PsShardServer(vocab, dim, i, nshards)
+               for i in range(nshards)]
+    addrs = [s.address for s in servers]
+    ids = np.arange(batch, dtype=np.int32) * (vocab // batch)
+    # attempt cap: a black-holed (dropped) attempt costs <=60ms, leaving
+    # budget for the retries the deadline was supposed to buy
+    retry = resilience.RetryPolicy(
+        max_attempts=3, backoff=resilience.Backoff(base_ms=2, max_ms=10),
+        attempt_timeout_ms=60)
+
+    def breaker_cfg():
+        return {"retry": retry, "deadline_ms": 1000,
+                "breakers": resilience.BreakerRegistry(
+                    resilience.BreakerOptions(
+                        short_window=8, min_samples=2,
+                        min_isolation_ms=100)),
+                "health_check": True, "health_interval_ms": 20}
+
+    down_plan = fault.FaultPlan([fault.FaultRule(
+        action="drop", side="client", endpoint=addrs[2],
+        delay_ms=150, probability=0.7)], seed=7)
+    configs = {
+        "bare": lambda: {},
+        "retry": lambda: {"retry": retry, "deadline_ms": 1000},
+        "retry_breaker_probe": breaker_cfg,
+    }
+    out: dict = {"down_drop_probability": 0.7, "drop_cost_ms": 150,
+                 "phase_ms": phase_ms, "secs": secs}
+    try:
+        for name, make_kw in configs.items():
+            obs.reset_fabric_vars()
+            emb = RemoteEmbedding(addrs, vocab, dim, timeout_ms=60000,
+                                  **make_kw())
+            ok = fail = 0
+            ok_lat, err_lat = [], []
+            try:
+                t_start = time.monotonic()
+                t_end = t_start + secs
+                while time.monotonic() < t_end:
+                    # down/up phases keyed by wall time (shard 2 flaps,
+                    # the rest of the fleet stays healthy); the plan's
+                    # decisions WITHIN a phase stay seeded/deterministic
+                    phase = int((time.monotonic() - t_start) * 1000.0
+                                / phase_ms)
+                    if phase % 2 == 0:
+                        fault.install(down_plan)
+                    else:
+                        fault.clear()
+                    t0 = time.perf_counter_ns()
+                    try:
+                        emb.lookup(ids)
+                        ok += 1
+                        ok_lat.append((time.perf_counter_ns() - t0) / 1e6)
+                    except rpc.RpcError:
+                        fail += 1
+                        err_lat.append((time.perf_counter_ns() - t0) / 1e6)
+            finally:
+                emb.close()
+                fault.clear()
+            total = ok + fail
+            ok_lat.sort()
+            out[name] = {
+                "batches": total,
+                "availability": round(ok / max(total, 1), 4),
+                # successful batches per second is the cross-config
+                # yardstick: error batches are nearly free under the
+                # breaker, so raw batch counts would flatter it
+                "ok_per_s": round(ok / secs, 1),
+                "ok_mean_ms": round(sum(ok_lat) / len(ok_lat), 3)
+                if ok_lat else None,
+                "err_mean_ms": round(sum(err_lat) / len(err_lat), 3)
+                if err_lat else None,
+                **_counters("rpc_retries", "rpc_breaker_open",
+                            "rpc_breaker_fastfail",
+                            "rpc_breaker_revived"),
+            }
+    finally:
+        for s in servers:
+            s.close()
+    return out
+
+
+def main() -> int:
+    out_path = os.path.join(ROOT, "BENCH_fault.json")
+    result: dict = {"metric": "fault_tolerance",
+                    "cpu_count": os.cpu_count()}
+    os.environ.setdefault("BRT_WORKERS", str(max(8, os.cpu_count() or 1)))
+    try:
+        from brpc_tpu import obs, rpc
+
+        if not rpc.native_core_available():
+            result = {"metric": "fault_tolerance",
+                      "skipped": rpc._load_error or
+                      "native core unavailable"}
+        else:
+            obs.set_enabled(True)  # counters are part of the verdict
+            result["slow_shard"] = bench_slow_shard()
+            result["flapping"] = bench_flapping()
+    except Exception as e:  # noqa: BLE001
+        result = {"metric": "fault_tolerance",
+                  "skipped": f"{type(e).__name__}: {e}"[:300]}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,6 +20,11 @@ A from-scratch rebuild of the capabilities of Apache bRPC (reference:
                       (Llama-family embedding shards + transformer).
 - ``brpc_tpu.ops``    TPU kernels (pallas) and numerics helpers.
 - ``brpc_tpu.obs``    observability: metrics registry, rpcz-style tracing.
+- ``brpc_tpu.resilience`` fault tolerance: retry policy with deadline
+                      budgets, backup requests (hedging + native cancel),
+                      per-endpoint circuit breakers, health-check revival.
+- ``brpc_tpu.fault``  deterministic fault injection (seeded FaultPlan)
+                      hooked at the server trampolines and client calls.
 """
 
 __version__ = "0.1.0"

@@ -44,6 +44,14 @@ the calls, not the file):
   the only detector.  ``checked_rwlock`` participates too: both
   ``.read()`` and ``.write()`` contexts acquire under the lock's one
   name, matching the dynamic graph's keying.
+- ``fiber-blocking-sleep`` — a bare ``time.sleep`` anywhere
+  handler-reachable (interprocedural, same walk as
+  ``fiber-shared-state``) parks the fiber worker PTHREAD, not just the
+  fiber, stalling every handler scheduled on that worker.  The
+  sanctioned path is :mod:`brpc_tpu.resilience` (``sleep_ms`` +
+  ``Backoff``: deadline-capped, deterministically jittered) — calls
+  resolving into that module are not followed, and its own sleeps are
+  exempt.
 
 Findings carry a stable id (hash of check + package-relative path +
 message, deliberately line-free) so CI can diff against an accepted
@@ -74,10 +82,11 @@ __all__ = ["Finding", "run_lint", "lint_files", "main", "ALL_CHECKS",
            "load_baseline", "apply_baseline"]
 
 ALL_CHECKS = ("ctypes-contract", "fiber-shared-state", "obs-guard",
-              "trace-purity", "lock-order")
+              "trace-purity", "lock-order", "fiber-blocking-sleep")
 
 #: checks that need the whole-package call graph
-_GRAPH_CHECKS = {"fiber-shared-state", "trace-purity", "lock-order"}
+_GRAPH_CHECKS = {"fiber-shared-state", "trace-purity", "lock-order",
+                 "fiber-blocking-sleep"}
 
 #: attribute names that look like a lock on self / a module
 _LOCKISH = ("mu", "lock", "mutex")
@@ -587,6 +596,103 @@ def _scan_shared_state(sc: _FileScan, graph: CallGraph, node: FuncNode,
 
 
 # ---------------------------------------------------------------------------
+# check: fiber-blocking-sleep (interprocedural over the call graph)
+# ---------------------------------------------------------------------------
+
+def _is_sanctioned_sleep_module(path: str) -> bool:
+    """The resilience module OWNS blocking sleeps (``sleep_ms`` /
+    ``Backoff`` — deadline-capped, deterministically jittered); its
+    internals are exempt and calls resolving into it are not followed."""
+    return _stable_path(path).startswith("brpc_tpu/resilience")
+
+
+def _time_sleep_aliases(sc: _FileScan) -> Tuple[Set[str], Set[str]]:
+    """(module aliases of ``time``, bare names bound to ``time.sleep``)
+    in this file."""
+    mods: Set[str] = set()
+    bares: Set[str] = set()
+    for node in ast.walk(sc.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    mods.add(alias.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    bares.add(alias.asname or "sleep")
+    return mods, bares
+
+
+def _check_fiber_blocking_sleep(scans: List[_FileScan],
+                                graph: CallGraph) -> List[Finding]:
+    sc_by_path = {sc.path: sc for sc in scans}
+    mi_by_path = {mi.path: mi for mi in graph.modules.values()}
+    aliases: Dict[str, Tuple[Set[str], Set[str]]] = {}
+    roots: List[str] = []
+    for sc in scans:
+        mi = mi_by_path.get(sc.path)
+        top = graph.nodes.get(f"{mi.name}:<module>") if mi else None
+        roots.extend(_find_handler_roots(sc, graph, top))
+    findings: List[Finding] = []
+    visited: Set[str] = set()
+    queue: List[Tuple[str, Tuple[str, ...]]] = [
+        (r, (_node_display(graph.nodes[r]),))
+        for r in roots if r in graph.nodes]
+    while queue:
+        node_id, chain = queue.pop()
+        if node_id in visited:
+            continue
+        visited.add(node_id)
+        node = graph.nodes.get(node_id)
+        if node is None or node.path not in sc_by_path:
+            continue
+        if _is_sanctioned_sleep_module(node.path):
+            continue
+        sc = sc_by_path[node.path]
+        if sc.path not in aliases:
+            aliases[sc.path] = _time_sleep_aliases(sc)
+        time_mods, sleep_bares = aliases[sc.path]
+        display = _node_display(node)
+
+        def flag(n: ast.AST, desc: str) -> None:
+            via = f" [reached via {' -> '.join(chain)}]" \
+                if len(chain) > 1 else ""
+            findings.append(Finding(
+                "fiber-blocking-sleep", sc.path, n.lineno,
+                f"handler-reachable {display} calls {desc} — it parks "
+                f"the fiber worker PTHREAD (not just the fiber), "
+                f"stalling every handler scheduled on it; use "
+                f"brpc_tpu.resilience sleep_ms/Backoff (deadline-capped "
+                f"backoff) or an event wait{via}"))
+
+        def scan(n: ast.AST) -> None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                return  # nested defs audit when reachable themselves
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) and f.attr == "sleep" \
+                        and _root_name(f) in time_mods:
+                    flag(n, f"{_describe(f)}()")
+                elif isinstance(f, ast.Name) and f.id in sleep_bares:
+                    flag(n, f"{f.id}() (imported from time)")
+                tgt = graph.call_target(n)
+                if tgt is not None and tgt in graph.nodes and \
+                        not _is_sanctioned_sleep_module(
+                            graph.nodes[tgt].path):
+                    queue.append(
+                        (tgt, chain + (_node_display(graph.nodes[tgt]),)))
+            for child in ast.iter_child_nodes(n):
+                scan(child)
+
+        body = node.fn.body if isinstance(node.fn.body, list) \
+            else [node.fn.body]
+        for child in body:
+            scan(child)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # check: obs-guard
 # ---------------------------------------------------------------------------
 
@@ -1011,6 +1117,8 @@ def lint_files(files: Iterable[str],
             findings.extend(_check_trace_purity(scans, graph))
         if "lock-order" in active:
             findings.extend(_check_lock_order(scans, graph))
+        if "fiber-blocking-sleep" in active:
+            findings.extend(_check_fiber_blocking_sleep(scans, graph))
     if "ctypes-contract" in active:
         findings.extend(_check_ctypes_contract(scans))
     # dedup (a nested def can be reached both inside its parent's subtree

@@ -29,24 +29,60 @@ class NamingClient:
         self.timeout_s = timeout_s
         self._heartbeats: list[threading.Thread] = []
         self._stop = threading.Event()
+        # One persistent keep-alive connection PER THREAD (watch blocks
+        # for seconds while heartbeat threads keep renewing — they must
+        # not share a socket), reused across polls instead of paying a
+        # TCP handshake per probe.  All live connections are tracked for
+        # close(); a broken one is dropped and recreated once.
+        self._tls = threading.local()
+        self._conns_mu = threading.Lock()
+        self._conns: list[http.client.HTTPConnection] = []
+
+    def _thread_conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            host, port = self.addr.rsplit(":", 1)
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=self.timeout_s)
+            self._tls.conn = conn
+            with self._conns_mu:
+                self._conns.append(conn)
+        return conn
+
+    def _drop_thread_conn(self) -> None:
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            return
+        self._tls.conn = None
+        with self._conns_mu:
+            if conn in self._conns:
+                self._conns.remove(conn)
+        conn.close()
 
     def _call(self, method: str, payload: dict,
               timeout_s: Optional[float] = None) -> dict:
-        host, port = self.addr.rsplit(":", 1)
-        conn = http.client.HTTPConnection(
-            host, int(port), timeout=timeout_s or self.timeout_s)
-        try:
-            body = json.dumps(payload)
-            conn.request("POST", f"/Naming/{method}", body,
-                         {"Content-Type": "application/json"})
-            resp = conn.getresponse()
-            data = resp.read()
+        body = json.dumps(payload)
+        t = timeout_s or self.timeout_s
+        for attempt in (0, 1):
+            conn = self._thread_conn()
+            conn.timeout = t
+            if conn.sock is not None:
+                conn.sock.settimeout(t)
+            try:
+                conn.request("POST", f"/Naming/{method}", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                data = resp.read()
+            except Exception:  # noqa: BLE001 — stale keep-alive socket:
+                self._drop_thread_conn()   # reconnect once, then raise
+                if attempt:
+                    raise
+                continue
             if resp.status != 200:
                 raise RuntimeError(
                     f"Naming/{method} -> {resp.status}: {data!r}")
             return json.loads(data)
-        finally:
-            conn.close()
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def register(self, cluster: str, addr: str, weight: int = 1,
                  tag: str = "", ttl_ms: int = 0,
@@ -100,3 +136,7 @@ class NamingClient:
 
     def close(self) -> None:
         self._stop.set()
+        with self._conns_mu:
+            conns, self._conns = list(self._conns), []
+        for conn in conns:
+            conn.close()

@@ -11,7 +11,12 @@ Wire mapping (payloads are UTF-8/JSON, like the naming bridge):
                  errors_only} → rsp = JSON list of span dicts (newest
                  first)
 - ``rpcz_text``  same query → rsp = one-line-per-span text
-- ``health``     rsp = ``ok``
+- ``health``     empty req → ``ok`` (the plain liveness probe the
+                 resilience tier's HealthProber and the reference's
+                 health checker use); any non-empty req (convention:
+                 ``full``) → JSON per-component health — circuit-breaker
+                 states per endpoint, last probe results, racecheck/obs
+                 gates (``brpc_tpu.resilience.health_components``)
 
 Registered via ``rpc.Server.add_status_service()``; client side via
 :func:`scrape_vars` / :func:`scrape_rpcz` over an existing ``Channel``.
@@ -49,7 +54,12 @@ def make_status_handler(registry: "Optional[obs_vars.Registry]" = None,
 
     def handler(method: str, request: bytes) -> bytes:
         if method == "health":
-            return b"ok"
+            if not request:
+                return b"ok"  # plain probes keep the bare contract
+            # resilience imports obs; this hook runs lazily so the
+            # dependency stays one-way at import time
+            from brpc_tpu import resilience
+            return json.dumps(resilience.health_components()).encode()
         if method == "vars":
             return reg.dump_exposed(request.decode() or None).encode()
         if method == "vars_json":
@@ -71,6 +81,14 @@ def make_status_handler(registry: "Optional[obs_vars.Registry]" = None,
 
 
 # ---- client side: scrape a remote node over an existing Channel ----
+
+def scrape_health(channel, full: bool = False):
+    """Remote health: the bare ``"ok"`` string, or the structured
+    per-component dict with ``full=True``."""
+    if not full:
+        return channel.call(SERVICE_NAME, "health").decode()
+    raw = channel.call(SERVICE_NAME, "health", b"full")
+    return json.loads(raw.decode())
 
 def scrape_vars(channel, filter: str = "", json_form: bool = False):
     """Remote ``dump_exposed``: text by default, dict with json_form."""

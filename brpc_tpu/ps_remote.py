@@ -15,13 +15,14 @@ rsp = float32 rows [count, dim]. ApplyGrad req = int32 count ++ int32 ids
 
 from __future__ import annotations
 
+import dataclasses
 import struct
 import time
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
-from brpc_tpu import obs, rpc
+from brpc_tpu import obs, resilience, rpc
 from brpc_tpu.analysis.race import checked_lock, checked_rwlock
 
 
@@ -85,6 +86,9 @@ class PsShardServer:
             raise ValueError(f"unknown lock_mode {lock_mode!r}")
         self.server = rpc.Server()
         self.server.add_service("Ps", self._handle)
+        # `_status` rides along so the health-check prober can revive
+        # this shard after a circuit-breaker isolation (resilience tier).
+        self.server.add_status_service()
         self.port = self.server.start("127.0.0.1:0")
 
     @property
@@ -199,6 +203,7 @@ class DevicePsShardServer:
         self._exe_mu = checked_lock("ps.device_shard.exe")
         self.server = rpc.Server()
         self.server.add_service("Ps", self._handle)
+        self.server.add_status_service()
         self.port = self.server.start("127.0.0.1:0")
 
     @property
@@ -368,7 +373,26 @@ class RemoteEmbedding:
     (the ParallelChannel-over-PartitionChannel shape, cpp/cluster/
     parallel_channel.* + partition_channel.*): whole-batch latency is
     max(shard RTT) instead of sum(shard RTT).  ``parallel=False``
-    restores the sequential per-shard loop (the bench baseline)."""
+    restores the sequential per-shard loop (the bench baseline).
+
+    Fault tolerance (brpc_tpu.resilience) is per shard:
+
+    - ``retry`` — a failed shard attempt is retried with backoff under
+      the batch's remaining ``deadline_ms`` budget while the other
+      shards' responses are already in; a batch completes despite a
+      shard failing its first attempt.
+    - ``backup_ms`` — a shard that has not answered in N ms gets a
+      hedged second attempt; the first completion wins and the loser is
+      cancelled natively.
+    - ``breakers`` — a BreakerRegistry keyed by shard address: open
+      shards fail fast instead of burning the timeout, every outcome
+      feeds the shard's EMA windows, and ``health_check=True`` runs a
+      background prober that revives isolated shards via their
+      ``_status.health`` builtin.
+    - On a non-retriable partial failure the batch abandons its
+      straggler shards: still-pending calls are CANCELLED (native
+      ``StartCancel``) before being reaped, so the error surfaces at
+      max(shard) latency, not sum."""
 
     @classmethod
     def from_registry(cls, registry_addr: str, cluster: str, vocab: int,
@@ -384,9 +408,22 @@ class RemoteEmbedding:
         deadline = time.monotonic() + wait_ms / 1000.0
         version = 0
         groups: dict = {}
+        # Each watch IS the poll; its blocking window follows the shared
+        # backoff helper (exponential + deterministic jitter, capped by
+        # the remaining deadline) instead of a fixed interval — early
+        # polls catch a cluster mid-registration fast, later ones stop
+        # hammering a registry that clearly isn't filling up.  The
+        # NamingClient reuses one connection per thread across polls.
+        backoff = resilience.Backoff(base_ms=100.0, multiplier=2.0,
+                                     max_ms=2000.0, jitter=0.5)
+        poll = 0
         while True:
+            remaining_ms = (deadline - time.monotonic()) * 1000.0
+            watch_ms = max(1, int(min(backoff.delay_ms(poll),
+                                      max(remaining_ms, 1.0))))
+            poll += 1
             nodes, version = reg.watch(cluster, known_version=version,
-                                       wait_ms=1000)
+                                       wait_ms=watch_ms)
             # Group by the tag's "/num" so a stale entry from an old
             # sharding cannot block a complete consistent new set.
             groups = {}
@@ -418,15 +455,145 @@ class RemoteEmbedding:
                     f"{ {nm: sorted(m) for nm, m in groups.items()} }")
 
     def __init__(self, addresses: Sequence[str], vocab: int, dim: int,
-                 timeout_ms: int = 2000, parallel: bool = True):
+                 timeout_ms: int = 2000, parallel: bool = True, *,
+                 retry: "Optional[resilience.RetryPolicy]" = None,
+                 deadline_ms: Optional[float] = None,
+                 backup_ms: Optional[float] = None,
+                 breakers: "Optional[resilience.BreakerRegistry]" = None,
+                 health_check: bool = False,
+                 health_interval_ms: float = 200.0):
         self.vocab = vocab
         self.dim = dim
         self.n = len(addresses)
         self.rows_per = vocab // self.n
         self.parallel = parallel
+        self.addresses = [str(a) for a in addresses]
+        self.retry = retry
+        self.deadline_ms = deadline_ms
+        self.backup_ms = backup_ms
+        self.breakers = breakers
+        if health_check and breakers is None:
+            self.breakers = breakers = resilience.BreakerRegistry()
+        if self.breakers is not None:
+            # Register every shard up front: the cluster-recover guard
+            # counts working endpoints, so the registry must know the
+            # full cluster, not just the shards that have failed.
+            for a in self.addresses:
+                self.breakers.breaker_for(a)
+        self._prober: "Optional[resilience.HealthProber]" = None
+        if health_check:
+            self._prober = resilience.HealthProber(
+                self.breakers, interval_ms=health_interval_ms)
+            self._prober.start()
         self.channels: List[rpc.Channel] = [
             rpc.Channel(a, timeout_ms=timeout_ms) for a in addresses
         ]
+
+    def _breaker(self, s: int) -> "Optional[resilience.CircuitBreaker]":
+        if self.breakers is None:
+            return None
+        return self.breakers.breaker_for(self.addresses[s])
+
+    def _retry_shard(self, s: int, method: str, req: bytes,
+                     exc: Exception, deadline: Optional[float]) -> bytes:
+        """A shard's first (fan-out) attempt failed: classify, back off,
+        and retry it under the batch's remaining budget — the other
+        shards' work is already done, so only this shard re-runs."""
+        policy = self.retry
+        if policy is None or not policy.do_retry(exc, 0):
+            raise exc
+        remaining_ms: Optional[float] = None
+        if deadline is not None:
+            remaining_ms = (deadline - time.monotonic()) * 1000.0
+            if remaining_ms < 2.0:
+                raise exc
+        delay = policy.backoff.delay_ms(0)
+        if remaining_ms is not None:
+            delay = min(delay, remaining_ms - 1.0)
+        resilience.sleep_ms(delay)
+        if remaining_ms is not None:
+            remaining_ms = max(1.0, (deadline - time.monotonic()) * 1000.0)
+        follow = dataclasses.replace(
+            policy, max_attempts=max(1, policy.max_attempts - 1))
+        return resilience.call_with_retry(
+            self.channels[s], "Ps", method, req, policy=follow,
+            deadline_ms=remaining_ms, breaker=self._breaker(s),
+            backup_ms=self.backup_ms)
+
+    def _fan_out(self, method: str, items: List[tuple]) -> List[bytes]:
+        """Issue every (shard, req) concurrently, then collect with the
+        resilience policy applied per shard.  Responses align with
+        ``items``.  On an unrecoverable shard failure the remaining
+        in-flight calls are cancelled (straggler abandonment) before the
+        error propagates."""
+        deadline = time.monotonic() + self.deadline_ms / 1000.0 \
+            if self.deadline_ms is not None else None
+
+        def _budget() -> Optional[int]:
+            t = None
+            if deadline is not None:
+                t = max(1, int((deadline - time.monotonic()) * 1000.0))
+            if self.retry is not None:
+                t = self.retry.cap_attempt_timeout(t)
+            return t
+
+        # per item: a PendingCall in flight, an RpcError whose start
+        # failed (client fault / local transport error — handled like a
+        # failed attempt in the join phase), or None once consumed
+        pending: List[object] = [None] * len(items)
+        out: List[Optional[bytes]] = [None] * len(items)
+        try:
+            for i, (s, req) in enumerate(items):
+                b = self._breaker(s)
+                if b is not None and b.isolated():
+                    if obs.enabled():
+                        obs.counter("rpc_breaker_fastfail").add(1)
+                    raise rpc.RpcError(
+                        resilience.EBREAKEROPEN,
+                        f"shard {s} ({self.addresses[s]}) isolated by "
+                        f"circuit breaker")
+                try:
+                    pending[i] = self.channels[s].call_async(
+                        "Ps", method, req, timeout_ms=_budget(),
+                        tag="attempt=0")
+                except rpc.RpcError as e:
+                    pending[i] = e  # keep fanning out; retried below
+            for i, (s, req) in enumerate(items):
+                pc, pending[i] = pending[i], None
+                b = self._breaker(s)
+                try:
+                    if isinstance(pc, rpc.RpcError):
+                        raise pc
+                    if self.backup_ms is not None:
+                        rsp = resilience.backup_call(
+                            self.channels[s], "Ps", method, req,
+                            backup_ms=self.backup_ms,
+                            timeout_ms=_budget(), primary=pc)
+                    else:
+                        rsp = pc.join()
+                except rpc.RpcError as e:
+                    if b is not None:
+                        b.on_call_end(e.code)
+                    rsp = self._retry_shard(s, method, req, e, deadline)
+                else:
+                    if b is not None:
+                        b.on_call_end(0)
+                out[i] = rsp
+            return out  # type: ignore[return-value]
+        finally:
+            # Partial failure: cancel the stragglers so close() reaps
+            # them at cancel speed, not at their full timeout.
+            for pc in pending:
+                if isinstance(pc, rpc.PendingCall):
+                    pc.cancel()
+                    pc.close()
+
+    def _call_shard(self, s: int, method: str, req: bytes) -> bytes:
+        """Sequential-path shard call with the same per-shard policy."""
+        return self.channels[s].call(
+            "Ps", method, req, retry=self.retry,
+            deadline_ms=self.deadline_ms, backup_ms=self.backup_ms,
+            breaker=self._breaker(s))
 
     def _owner_split(self, flat_ids: np.ndarray):
         if flat_ids.size and (flat_ids.min() < 0
@@ -454,28 +621,24 @@ class RemoteEmbedding:
         if self.parallel:
             # Start every owner-shard call before joining any: the shards
             # serve concurrently and the batch pays max(shard), not
-            # sum(shard).
-            pending = []
-            try:
-                for s, positions, owned in self._owner_split(flat):
-                    req = struct.pack("<i", owned.size) + owned.tobytes()
-                    nbytes_out += len(req)
-                    pending.append((positions, owned.size, self.channels[s]
-                                    .call_async("Ps", "Lookup", req)))
-                for positions, k, call in pending:
-                    rsp = call.join()
-                    nbytes_in += len(rsp)
-                    out[positions] = np.frombuffer(
-                        rsp, np.float32).reshape(k, self.dim)
-            finally:
-                # On a failed join, the un-joined rest must still be
-                # reaped (close waits for completion, then frees).
-                for _, _, call in pending:
-                    call.close()
+            # sum(shard).  _fan_out applies the per-shard resilience
+            # policy (retry/hedge/breaker) and cancels stragglers on an
+            # unrecoverable partial failure.
+            split = list(self._owner_split(flat))
+            items = []
+            for s, positions, owned in split:
+                req = struct.pack("<i", owned.size) + owned.tobytes()
+                nbytes_out += len(req)
+                items.append((s, req))
+            for (s, positions, owned), rsp in zip(
+                    split, self._fan_out("Lookup", items)):
+                nbytes_in += len(rsp)
+                out[positions] = np.frombuffer(
+                    rsp, np.float32).reshape(owned.size, self.dim)
         else:
             for s, positions, owned in self._owner_split(flat):
                 req = struct.pack("<i", owned.size) + owned.tobytes()
-                rsp = self.channels[s].call("Ps", "Lookup", req)
+                rsp = self._call_shard(s, "Lookup", req)
                 out[positions] = np.frombuffer(rsp, np.float32).reshape(
                     owned.size, self.dim)
                 nbytes_out += len(req)
@@ -498,24 +661,18 @@ class RemoteEmbedding:
         g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
         nbytes_out = 0
         if self.parallel:
-            pending = []
-            try:
-                for s, positions, owned in self._owner_split(flat):
-                    req = (struct.pack("<i", owned.size) + owned.tobytes()
-                           + g[positions].tobytes())
-                    nbytes_out += len(req)
-                    pending.append(self.channels[s].call_async(
-                        "Ps", "ApplyGrad", req))
-                for call in pending:
-                    call.join()
-            finally:
-                for call in pending:
-                    call.close()
+            items = []
+            for s, positions, owned in self._owner_split(flat):
+                req = (struct.pack("<i", owned.size) + owned.tobytes()
+                       + g[positions].tobytes())
+                nbytes_out += len(req)
+                items.append((s, req))
+            self._fan_out("ApplyGrad", items)
         else:
             for s, positions, owned in self._owner_split(flat):
                 req = (struct.pack("<i", owned.size) + owned.tobytes() +
                        g[positions].tobytes())
-                self.channels[s].call("Ps", "ApplyGrad", req)
+                self._call_shard(s, "ApplyGrad", req)
                 nbytes_out += len(req)
         if rec:
             obs.recorder("ps_client_apply").record(
@@ -524,5 +681,8 @@ class RemoteEmbedding:
             obs.counter("ps_client_bytes_out").add(nbytes_out)
 
     def close(self):
+        if self._prober is not None:
+            self._prober.stop()
+            self._prober = None
         for c in self.channels:
             c.close()

@@ -14,8 +14,10 @@ import subprocess
 import time
 from typing import Callable, Optional
 
-from brpc_tpu import obs
+from brpc_tpu import fault, obs, resilience
 from brpc_tpu.analysis import race as _race
+
+_INT64_MIN = -(2 ** 63)  # "inherit the channel option" timeout sentinel
 
 _HANDLER = ctypes.CFUNCTYPE(
     None, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
@@ -130,10 +132,18 @@ def _load_locked():
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
         ctypes.c_size_t]
     lib.brt_channel_call_start.restype = ctypes.c_void_p
+    lib.brt_channel_call_start_opts.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_size_t, ctypes.c_int64]
+    lib.brt_channel_call_start_opts.restype = ctypes.c_void_p
     lib.brt_call_join.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t]
     lib.brt_call_join.restype = ctypes.c_int
+    lib.brt_call_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.brt_call_wait.restype = ctypes.c_int
+    lib.brt_call_cancel.argtypes = [ctypes.c_void_p]
+    lib.brt_call_cancel.restype = None
     lib.brt_call_destroy.argtypes = [ctypes.c_void_p]
     lib.brt_call_destroy.restype = None
     lib.brt_channel_destroy.argtypes = [ctypes.c_void_p]
@@ -201,7 +211,8 @@ class RpcError(RuntimeError):
 
 def _record_server_call(service: str, method: str, t0: int, wall: float,
                         req_len: int, rsp_len: int,
-                        error: Optional[str]) -> None:
+                        error: Optional[str],
+                        error_code: int = 2001) -> None:
     end = time.monotonic_ns()
     obs.recorder(f"rpc_server_{service}_{method}").record((end - t0) / 1e9)
     obs.counter("rpc_server_in_bytes").add(req_len)
@@ -211,13 +222,23 @@ def _record_server_call(service: str, method: str, t0: int, wall: float,
     obs.record_span(obs.Span(
         service=service, method=method, side="server",
         request_bytes=req_len, response_bytes=rsp_len, start_ns=t0,
-        end_ns=end, wall_time=wall, error_code=2001 if error else 0,
+        end_ns=end, wall_time=wall,
+        error_code=error_code if error else 0,
         error_text=error or ""))
+
+
+def _error_code_of(e: BaseException) -> int:
+    """Server-side failure code: a handler raising :class:`RpcError`
+    (fault injection, an overload rejection) keeps its code on the wire;
+    anything else is EINTERNAL (2001)."""
+    code = getattr(e, "code", None)
+    return code if isinstance(code, int) and code != 0 else 2001
 
 
 def _record_client_call(service: str, method: str, peer: str, t0: int,
                         wall: float, req_len: int, rsp_len: int,
-                        error_code: int, error_text: str) -> None:
+                        error_code: int, error_text: str,
+                        tag: Optional[str] = None) -> None:
     end = time.monotonic_ns()
     obs.recorder(f"rpc_client_{service}_{method}").record((end - t0) / 1e9)
     obs.counter("rpc_client_out_bytes").add(req_len)
@@ -228,7 +249,8 @@ def _record_client_call(service: str, method: str, peer: str, t0: int,
         service=service, method=method, side="client", peer=peer,
         request_bytes=req_len, response_bytes=rsp_len, start_ns=t0,
         end_ns=end, wall_time=wall, error_code=error_code,
-        error_text=error_text))
+        error_text=error_text,
+        annotations=[tag] if tag else []))
 
 
 class Server:
@@ -239,6 +261,7 @@ class Server:
         self._lib = _load()
         self._ptr = self._lib.brt_server_new()
         self._handlers = []  # keep CFUNCTYPE refs alive
+        self._listen: Optional[str] = None  # set by start()
 
     def add_service(self, name: str,
                     handler: Callable[[str, bytes], bytes]) -> None:
@@ -256,6 +279,8 @@ class Server:
             try:
                 m = method
                 data = ctypes.string_at(req, req_len) if req_len else b""
+                if fault.active():
+                    fault.server_intercept(name, m.decode(), self._listen)
                 out = handler(m.decode(), data)
                 if out is None:
                     out = b""
@@ -263,11 +288,13 @@ class Server:
                 lib.brt_session_respond(session, out, out_len, 0, None)
             except Exception as e:  # noqa: BLE001
                 err = str(e)
-                lib.brt_session_respond(session, None, 0, 2001,
+                err_code = _error_code_of(e)
+                lib.brt_session_respond(session, None, 0, err_code,
                                         err.encode())
             if rec:
                 _record_server_call(name, m.decode(errors="replace"), t0,
-                                    wall, req_len, out_len, err)
+                                    wall, req_len, out_len, err,
+                                    err_code if err else 2001)
 
         rc = lib.brt_server_add_service(self._ptr, name.encode(),
                                         trampoline, None)
@@ -294,16 +321,17 @@ class Server:
                 wall = time.time()
                 nreq = req_len
 
-            def respond(payload: bytes = b"", error: Optional[str] = None):
+            def respond(payload: bytes = b"", error: Optional[str] = None,
+                        error_code: int = 2001):
                 # Latency spans dispatch -> respond, wherever respond runs
                 # (the async contract: any thread, after the fiber worker
                 # is long gone).
                 if error is not None:
-                    lib.brt_session_respond(sess, None, 0, 2001,
+                    lib.brt_session_respond(sess, None, 0, error_code,
                                             error.encode())
                     if rec:
                         _record_server_call(name, m, t0, wall, nreq, 0,
-                                            error)
+                                            error, error_code)
                 else:
                     lib.brt_session_respond(sess, payload, len(payload), 0,
                                             None)
@@ -312,9 +340,11 @@ class Server:
                                             len(payload), None)
 
             try:
+                if fault.active():
+                    fault.server_intercept(name, m, self._listen)
                 handler(m, data, respond)
             except Exception as e:  # noqa: BLE001
-                respond(error=str(e))
+                respond(error=str(e), error_code=_error_code_of(e))
 
         rc = lib.brt_server_add_service(self._ptr, name.encode(),
                                         trampoline, None)
@@ -342,7 +372,11 @@ class Server:
         rc = self._lib.brt_server_start(self._ptr, addr.encode())
         if rc != 0:
             raise RuntimeError(f"server start failed: {rc}")
-        return self._lib.brt_server_port(self._ptr)
+        port = self._lib.brt_server_port(self._ptr)
+        # the resolved listen address identifies this server to the
+        # fault plan (per-endpoint server-side rules)
+        self._listen = f"{addr.rsplit(':', 1)[0]}:{port}"
+        return port
 
     @property
     def port(self) -> int:
@@ -363,16 +397,23 @@ class PendingCall:
 
     ``join()`` parks until the reply lands and returns the response bytes
     (or raises :class:`RpcError` with the server/transport failure — same
-    contract as the synchronous ``call``).  The native handle is freed
+    contract as the synchronous ``call``).  ``wait(timeout_s)`` peeks at
+    completion without consuming it; ``cancel()`` requests native
+    cancellation (reference ``StartCancel``) — the call still completes
+    exactly once, with ECANCELEDRPC (2005) if the cancel won, so
+    ``join``/``close`` stay mandatory.  The native handle is freed
     exactly once, by ``join()`` or ``close()``; ``close()`` on an
     un-joined call waits for completion first (the native core may still
-    be filling the response), so abandoning a fan-out mid-error is safe.
+    be filling the response), so abandoning a fan-out mid-error is safe —
+    and cheap after ``cancel()``, which is how the PS tier abandons
+    straggler shards.
     """
 
     __slots__ = ("_lib", "_ptr", "_service", "_method", "_peer",
-                 "_req_len", "_t0", "_wall")
+                 "_req_len", "_t0", "_wall", "_tag")
 
-    def __init__(self, lib, ptr, service, method, peer, req_len, t0, wall):
+    def __init__(self, lib, ptr, service, method, peer, req_len, t0, wall,
+                 tag=None):
         self._lib = lib
         self._ptr = ptr
         self._service = service
@@ -381,6 +422,29 @@ class PendingCall:
         self._req_len = req_len
         self._t0 = t0      # None when obs was disabled at start
         self._wall = wall
+        self._tag = tag
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        """True once the call has completed (``join`` will not block).
+        ``timeout_s=None`` waits indefinitely; ``0`` polls.  Callable
+        any number of times — nothing is consumed."""
+        if self._ptr is None:
+            return True
+        if timeout_s is None:
+            if _race.enabled():
+                _race.note_blocking("brt_call_wait")
+            return self._lib.brt_call_wait(self._ptr, -1) == 0
+        us = max(0, int(timeout_s * 1e6))
+        return self._lib.brt_call_wait(self._ptr, us) == 0
+
+    def cancel(self) -> None:
+        """Request cancellation (safe from any thread, idempotent, no-op
+        after completion).  The losing half of a backup-request hedge and
+        abandoned PS stragglers go through here."""
+        if self._ptr is not None:
+            self._lib.brt_call_cancel(self._ptr)
+            if obs.enabled():
+                obs.counter("rpc_cancels").add(1)
 
     def join(self) -> bytes:
         if self._ptr is None:
@@ -399,7 +463,8 @@ class PendingCall:
                 if self._t0 is not None:
                     _record_client_call(self._service, self._method,
                                         self._peer, self._t0, self._wall,
-                                        self._req_len, 0, rc, text)
+                                        self._req_len, 0, rc, text,
+                                        self._tag)
                 raise RpcError(rc, text)
             try:
                 out = ctypes.string_at(rsp, rsp_len.value)
@@ -411,7 +476,7 @@ class PendingCall:
             # start -> join latency: the caller-visible async window
             _record_client_call(self._service, self._method, self._peer,
                                 self._t0, self._wall, self._req_len,
-                                len(out), 0, "")
+                                len(out), 0, "", self._tag)
         return out
 
     def close(self) -> None:
@@ -435,11 +500,41 @@ class Channel:
         if not self._ptr:
             raise RuntimeError(f"channel init failed for {addr}")
 
-    def call(self, service: str, method: str, request: bytes = b"") -> bytes:
+    def call(self, service: str, method: str, request: bytes = b"", *,
+             timeout_ms: Optional[int] = None,
+             retry: "Optional[resilience.RetryPolicy]" = None,
+             deadline_ms: Optional[float] = None,
+             backup_ms: Optional[float] = None,
+             breaker: "Optional[resilience.CircuitBreaker]" = None
+             ) -> bytes:
+        """Synchronous call.  The keyword-only resilience options layer
+        policy over the bare native call (brpc_tpu.resilience):
+
+        - ``timeout_ms`` — per-call deadline override (reference
+          ``Controller::set_timeout_ms``).
+        - ``retry`` / ``deadline_ms`` — RetryPolicy attempts under a
+          total deadline budget; each attempt's native timeout is the
+          budget still remaining.
+        - ``backup_ms`` — hedge: a second attempt fires if no reply in
+          N ms, first completion wins, loser is cancelled natively.
+        - ``breaker`` — per-endpoint CircuitBreaker: fail fast while
+          open, feed every outcome.
+        """
+        if retry is not None or deadline_ms is not None \
+                or backup_ms is not None or breaker is not None:
+            return resilience.resilient_call(
+                self, service, method, request, retry=retry,
+                deadline_ms=deadline_ms, backup_ms=backup_ms,
+                breaker=breaker, timeout_ms=timeout_ms)
+        if timeout_ms is not None:
+            return self.call_async(service, method, request,
+                                   timeout_ms=timeout_ms).join()
         rec = obs.enabled()
         if rec:
             t0 = time.monotonic_ns()
             wall = time.time()
+        if fault.active():
+            fault.client_intercept(service, method, self._addr)
         if _race.enabled():
             _race.note_blocking("brt_channel_call")
         rsp = ctypes.c_void_p()
@@ -464,25 +559,32 @@ class Channel:
                                 len(request), len(out), 0, "")
         return out
 
-    def call_async(self, service: str, method: str,
-                   request: bytes = b"") -> PendingCall:
+    def call_async(self, service: str, method: str, request: bytes = b"",
+                   *, timeout_ms: Optional[int] = None,
+                   tag: Optional[str] = None) -> PendingCall:
         """Starts the call and returns immediately with a
         :class:`PendingCall`; the RPC proceeds on the fiber scheduler and
         ``join()`` collects the reply.  Starting N calls before joining
         any fans out over N servers concurrently — whole-batch latency is
         max(server) instead of sum(server) (the ParallelChannel shape,
         cpp/cluster/parallel_channel.*).  The request bytes are copied by
-        the native core before this returns."""
+        the native core before this returns.  ``timeout_ms`` overrides
+        the channel deadline for this one call (the retry loop's
+        shrinking budget rides this); ``tag`` annotates the client rpcz
+        span (attempt/hedge labels)."""
         rec = obs.enabled()
         t0 = time.monotonic_ns() if rec else None
         wall = time.time() if rec else 0.0
-        ptr = self._lib.brt_channel_call_start(
+        if fault.active():
+            fault.client_intercept(service, method, self._addr, timeout_ms)
+        ptr = self._lib.brt_channel_call_start_opts(
             self._ptr, service.encode(), method.encode(), request,
-            len(request))
+            len(request),
+            _INT64_MIN if timeout_ms is None else int(timeout_ms))
         if not ptr:
             raise RpcError(-1, f"call_start failed for {self._addr}")
         return PendingCall(self._lib, ptr, service, method, self._addr,
-                           len(request), t0, wall)
+                           len(request), t0, wall, tag)
 
     def close(self) -> None:
         if self._ptr:

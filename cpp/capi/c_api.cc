@@ -182,8 +182,16 @@ void brt_channel_destroy(void* channel) {
 void* brt_channel_call_start(void* channel, const char* service,
                              const char* method, const void* req,
                              size_t req_len) {
+  return brt_channel_call_start_opts(channel, service, method, req,
+                                     req_len, INT64_MIN);
+}
+
+void* brt_channel_call_start_opts(void* channel, const char* service,
+                                  const char* method, const void* req,
+                                  size_t req_len, int64_t timeout_ms) {
   auto* c = static_cast<CChannel*>(channel);
   auto* call = new CCall;
+  call->cntl.timeout_ms = timeout_ms;  // INT64_MIN inherits the channel
   IOBuf request;
   if (req && req_len) request.append(req, req_len);
   // The done closure runs exactly once, in a fiber, after cntl/response
@@ -193,6 +201,17 @@ void* brt_channel_call_start(void* channel, const char* service,
   c->channel->CallMethod(service, method, &call->cntl, request,
                          &call->response, [raw] { raw->done.signal(); });
   return call;
+}
+
+int brt_call_wait(void* call, int64_t timeout_us) {
+  return static_cast<CCall*>(call)->done.wait(timeout_us);
+}
+
+void brt_call_cancel(void* call) {
+  // StartCancel feeds ECANCELEDRPC into the correlation-id error funnel;
+  // the versioned fid makes a post-completion cancel a harmless no-op,
+  // so this needs no coordination with join/destroy.
+  static_cast<CCall*>(call)->cntl.StartCancel();
 }
 
 int brt_call_join(void* call, void** rsp, size_t* rsp_len, char* errbuf,

@@ -69,6 +69,29 @@ int brt_call_join(void* call, void** rsp, size_t* rsp_len, char* errbuf,
 // destroy-without-join never races the completion closure.
 void brt_call_destroy(void* call);
 
+// Like brt_channel_call_start, with per-call controller options
+// (reference Controller::set_timeout_ms — per-call values override the
+// channel defaults for this one RPC).  timeout_ms: INT64_MIN inherits
+// the channel option, -1 means no deadline, >=0 is the per-call
+// deadline.  The fault-tolerance tier uses this to shrink the attempt
+// timeout as a retry loop's deadline budget drains.
+void* brt_channel_call_start_opts(void* channel, const char* service,
+                                  const char* method, const void* req,
+                                  size_t req_len, int64_t timeout_ms);
+// Peek-waits for completion of the call behind the handle WITHOUT
+// consuming the result: returns 0 once complete (join still collects),
+// ETIMEDOUT if timeout_us elapses first (timeout_us < 0 = forever).
+// Callable any number of times — the completion latch is level-
+// triggered.  This is the primitive the Python backup-request hedge
+// polls ("did the primary answer within backup_ms?").
+int brt_call_wait(void* call, int64_t timeout_us);
+// Requests cancellation of the in-flight call (reference
+// Controller::StartCancel): completion still happens exactly once, with
+// ECANCELEDRPC (2005) if the cancel won the race.  Safe from any thread,
+// any time between start and destroy; idempotent; a no-op on a call
+// that already completed.  join/destroy remain mandatory.
+void brt_call_cancel(void* call);
+
 void brt_free(void* p);
 
 // ---- runtime ----

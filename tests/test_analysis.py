@@ -675,3 +675,124 @@ def test_syntax_error_reported_not_crash(tmp_path):
     fs = _lint_src(tmp_path, "def broken(:\n")
     (f,) = fs
     assert f.check == "syntax"
+
+
+# ---- fiber-blocking-sleep (interprocedural) ----
+
+_SLEEP_HANDLER = """\
+    import time
+
+    class S:
+        def __init__(self, server):
+            server.add_service("X", self._handle)
+
+        def _handle(self, method, req):
+            time.sleep(0.5)
+            return b""
+"""
+
+
+def test_handler_sleep_flagged(tmp_path):
+    fs = _lint_src(tmp_path, _SLEEP_HANDLER)
+    (f,) = _by_check(fs, "fiber-blocking-sleep")
+    assert "time.sleep" in f.message
+    assert "fiber worker" in f.message
+    assert "resilience" in f.message
+
+
+def test_sleep_via_helper_chain_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import time
+
+        def pause():
+            time.sleep(0.1)
+
+        def work():
+            pause()
+
+        class S:
+            def __init__(self, server):
+                server.add_service("X", self._handle)
+
+            def _handle(self, method, req):
+                work()
+                return b""
+    """)
+    (f,) = _by_check(fs, "fiber-blocking-sleep")
+    assert "pause" in f.message
+    assert "S._handle -> work -> pause" in f.message
+
+
+def test_sleep_alias_forms_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import time as t
+        from time import sleep as zzz
+
+        class S:
+            def __init__(self, server):
+                server.add_service("X", self._handle)
+
+            def _handle(self, method, req):
+                t.sleep(1)
+                zzz(2)
+                return b""
+    """)
+    fs = _by_check(fs, "fiber-blocking-sleep")
+    assert len(fs) == 2
+    assert any("imported from time" in f.message for f in fs)
+
+
+def test_sleep_outside_handlers_clean(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import time
+
+        def bench_loop():
+            time.sleep(1.0)  # not handler-reachable: fine
+
+        class S:
+            def __init__(self, server):
+                server.add_service("X", self._handle)
+
+            def _handle(self, method, req):
+                return b""
+    """)
+    assert _by_check(fs, "fiber-blocking-sleep") == []
+
+
+def test_sleep_via_resilience_helper_clean(tmp_path):
+    # The sanctioned path: resilience.sleep_ms — the call into the
+    # resilience module is not followed, and a fake sibling named
+    # resilience.py proves the cut is by module path, not luck.
+    (tmp_path / "brpc_tpu").mkdir()
+    (tmp_path / "brpc_tpu" / "__init__.py").write_text("")
+    (tmp_path / "brpc_tpu" / "resilience.py").write_text(
+        "import time\n\ndef sleep_ms(ms):\n    time.sleep(ms / 1000.0)\n")
+    (tmp_path / "brpc_tpu" / "svc.py").write_text(textwrap.dedent("""\
+        from brpc_tpu.resilience import sleep_ms
+
+        class S:
+            def __init__(self, server):
+                server.add_service("X", self._handle)
+
+            def _handle(self, method, req):
+                sleep_ms(5)
+                return b""
+    """))
+    fs = lint.run_lint([str(tmp_path / "brpc_tpu")])
+    assert _by_check(fs, "fiber-blocking-sleep") == []
+
+
+def test_async_handler_sleep_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import time
+
+        class S:
+            def __init__(self, server):
+                server.add_async_service("X", self._handle)
+
+            def _handle(self, method, req, respond):
+                time.sleep(0.2)
+                respond(b"")
+    """)
+    (f,) = _by_check(fs, "fiber-blocking-sleep")
+    assert "S._handle" in f.message
