@@ -19,6 +19,13 @@ What it measures (all loopback, CPU shards):
                   (lock_mode="mutex") vs the read-parallel rwlock.
                   Reports keys/s each way and the rwlock/mutex ratio at
                   8 clients — reader parallelism is the whole difference.
+  native_read   — the same 1/8-client hammer against the NATIVE Lookup
+                  handler (PsShardServer(native_read=True): zero Python,
+                  no GIL, no trampoline in the read loop) vs the Python
+                  rwlock path.  native_over_python_8clients is the
+                  headline: the rwlock path capped out at ~0.96x mutex
+                  because request framing held the GIL; the native path
+                  has no GIL to hold.
 """
 
 from __future__ import annotations
@@ -83,13 +90,15 @@ def bench_fanout(nshards: int, vocab: int = 65536, dim: int = 64,
 
 def bench_single_shard(clients: int, lock_mode: str, vocab: int = 65536,
                        dim: int = 128, batch: int = 2048,
-                       secs: float = 2.0) -> dict:
+                       secs: float = 2.0,
+                       native_read: bool = False) -> dict:
     import struct
 
     from brpc_tpu import rpc
     from brpc_tpu.ps_remote import PsShardServer
 
-    server = PsShardServer(vocab, dim, 0, 1, lock_mode=lock_mode)
+    server = PsShardServer(vocab, dim, 0, 1, lock_mode=lock_mode,
+                           native_read=native_read)
     counts = [0] * clients
     stop = threading.Event()
     ready = threading.Barrier(clients + 1, timeout=30)
@@ -120,14 +129,18 @@ def bench_single_shard(clients: int, lock_mode: str, vocab: int = 65536,
         for t in threads:
             t.join(30)
         dt = time.monotonic() - t0
+        native_served = int(server.native_lookups)
     finally:
         stop.set()
         server.close()
     total = sum(counts)
-    return {
+    out = {
         "lookups_per_s": round(total / dt, 1),
         "keys_per_s": round(total * batch / dt, 0),
     }
+    if native_read:
+        out["native_lookups"] = native_served  # proves the path served
+    return out
 
 
 def main() -> int:
@@ -161,6 +174,34 @@ def main() -> int:
                 single["rw"]["8"]["keys_per_s"] /
                 max(single["mutex"]["8"]["keys_per_s"], 1.0), 3)
             result["single_shard_lookup"] = single
+            # Native zero-Python read path vs the Python rwlock path.
+            # Serving-style geometry (dim=16, batch=256 — the small
+            # recommendation-lookup regime) so per-REQUEST overhead — the
+            # GIL-held trampoline/framing the native path deletes — is
+            # what gets measured, not response memcpy bandwidth; both
+            # paths run the SAME geometry and client hammer.  On a 1-core
+            # host this is the native path's WORST case (no handler
+            # parallelism to win back), so the ratio is a floor.
+            nr_kw = dict(dim=16, batch=256)
+
+            def best_of(n, clients, native):
+                # Shared 1-core hosts swing ~25% with neighbor noise
+                # (same rationale as bench.py's best-of-3 headline):
+                # noise only ever subtracts, so keep the best sample.
+                return max((bench_single_shard(clients, "rw",
+                                               native_read=native,
+                                               **nr_kw)
+                            for _ in range(n)),
+                           key=lambda r: r["keys_per_s"])
+
+            nat_block = {}
+            for mode, native in (("python_rw", False), ("native", True)):
+                nat_block[mode] = {
+                    str(c): best_of(2, c, native) for c in (1, 8)}
+            nat_block["native_over_python_8clients"] = round(
+                nat_block["native"]["8"]["keys_per_s"] /
+                max(nat_block["python_rw"]["8"]["keys_per_s"], 1.0), 3)
+            result["native_read"] = nat_block
     except Exception as e:  # noqa: BLE001
         result = {"metric": "ps_hot_path",
                   "skipped": f"{type(e).__name__}: {e}"[:300]}

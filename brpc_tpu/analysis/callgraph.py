@@ -18,6 +18,11 @@ What gets resolved (edges carry the call site's path + line):
   (``brpc_tpu.rpc.fn()``);
 - method calls through ``self`` (``self._serve()``), including
   in-package base classes, and unbound ``ClassName.meth`` calls;
+- method calls on HELD objects through a lightweight attr-type map:
+  ``self.dev = rpc.DeviceClient(...)`` (anywhere in the class, including
+  ``x or Class()`` defaults) lets ``self.dev.stage()`` resolve to
+  ``DeviceClient.stage``; an attr constructed as two different classes is
+  ambiguous and stays unresolved (no false edges);
 - constructor calls (``rpc.Server()`` → ``Server.__init__``);
 - ``functools.partial`` targets: ``h = partial(worker, 1); h()``
   resolves to ``worker``, as does calling/constructing the partial
@@ -72,6 +77,10 @@ class ClassInfo:
     module: str
     bases: List[ast.expr]
     methods: Dict[str, str]          # method name -> node id
+    #: every value ever assigned to self.<attr> inside the class body
+    #: (feeds the attr-type map; see CallGraph._build_attr_types)
+    attr_assigns: Dict[str, List[ast.expr]] = dataclasses.field(
+        default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -138,6 +147,10 @@ class CallGraph:
         self.edges: Dict[str, List[CallSite]] = {}
         self._by_ast: Dict[int, str] = {}
         self._call_targets: Dict[int, str] = {}  # id(ast.Call) -> node id
+        #: (module, class, attr) -> (owning ModuleInfo, class name) for
+        #: attrs whose every constructor assignment names ONE class
+        self._attr_types: Dict[Tuple[str, str, str],
+                               Tuple["ModuleInfo", str]] = {}
 
     # -- construction ------------------------------------------------------
 
@@ -192,6 +205,15 @@ class CallGraph:
                         self._register_func(
                             mi, item, qual_prefix=stmt.name + ".",
                             cls=stmt.name, into=ci.methods)
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            ci.attr_assigns.setdefault(
+                                tgt.attr, []).append(node.value)
             elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign,
                                    ast.For, ast.AsyncFor)):
                 targets = []
@@ -289,6 +311,68 @@ class CallGraph:
                 return hit
         return None
 
+    # -- attr-type map (self.<attr> = Class(...)) --------------------------
+
+    def _class_of_value(self, value: ast.AST, mi: ModuleInfo
+                        ) -> Optional[Tuple[ModuleInfo, str]]:
+        """Class constructed by an assigned value: a direct ``Class(...)``
+        call, or an ``x or Class(...)`` default (the injectable-dependency
+        idiom).  None for anything else — parameters, call results and
+        literals stay untyped (under-approximation)."""
+        if isinstance(value, ast.BoolOp):
+            hits: Dict[Tuple[str, str], Tuple[ModuleInfo, str]] = {}
+            for v in value.values:
+                h = self._class_of_value(v, mi)
+                if h is not None:
+                    hits[(h[0].name, h[1])] = h
+            return next(iter(hits.values())) if len(hits) == 1 else None
+        if not isinstance(value, ast.Call):
+            return None
+        f = value.func
+        if isinstance(f, ast.Name):
+            if f.id in mi.classes:
+                return mi, f.id
+            src = mi.from_imports.get(f.id)
+            if src is not None:
+                target = self._find_module(src[0])
+                if target is not None and target is not mi and \
+                        src[1] in target.classes:
+                    return target, src[1]
+            return None
+        chain = _dotted_chain(f)
+        if chain is None:
+            return None
+        expanded = chain
+        if chain[0] in mi.import_aliases:
+            expanded = mi.import_aliases[chain[0]].split(".") + chain[1:]
+        for cut in range(len(expanded) - 1, 0, -1):
+            target = self._find_module(".".join(expanded[:cut]))
+            if target is None:
+                continue
+            rest = expanded[cut:]
+            if len(rest) == 1 and rest[0] in target.classes:
+                return target, rest[0]
+            return None
+        return None
+
+    def _build_attr_types(self) -> None:
+        """Resolve every class's ``self.<attr> = Class(...)`` assignments
+        into the attr-type map.  An attr constructed as two DIFFERENT
+        classes is ambiguous and dropped; non-constructor assignments
+        (None sentinels, parameters) neither help nor hurt."""
+        for mi in self.modules.values():
+            for cls_name, ci in mi.classes.items():
+                for attr, values in ci.attr_assigns.items():
+                    hits: Dict[Tuple[str, str],
+                               Tuple[ModuleInfo, str]] = {}
+                    for v in values:
+                        h = self._class_of_value(v, mi)
+                        if h is not None:
+                            hits[(h[0].name, h[1])] = h
+                    if len(hits) == 1:
+                        self._attr_types[(mi.name, cls_name, attr)] = \
+                            next(iter(hits.values()))
+
     # -- expression resolution --------------------------------------------
 
     def _resolve_name(self, name: str, ctx: FuncNode,
@@ -354,6 +438,15 @@ class CallGraph:
                     and ctx.cls is not None:
                 return self._method(self.modules[ctx.module], ctx.cls,
                                     expr.attr)
+            if isinstance(expr.value, ast.Attribute) and \
+                    isinstance(expr.value.value, ast.Name) and \
+                    expr.value.value.id == "self" and ctx.cls is not None:
+                # self.<attr>.<meth> on a held object: the attr-type map
+                # knows what self.<attr> was constructed as
+                held = self._attr_types.get(
+                    (ctx.module, ctx.cls, expr.value.attr))
+                if held is not None:
+                    return self._method(held[0], held[1], expr.attr)
             chain = _dotted_chain(expr)
             if chain is not None:
                 return self._resolve_dotted(chain, ctx)
@@ -368,6 +461,9 @@ class CallGraph:
     # -- edge extraction ---------------------------------------------------
 
     def extract_edges(self) -> None:
+        # All modules are loaded by now, so cross-module constructor
+        # assignments resolve; the map must exist before any edge walk.
+        self._build_attr_types()
         for mi in self.modules.values():
             # module top-level code gets a pseudo-node so inline lambdas /
             # module-scope calls still resolve in a context

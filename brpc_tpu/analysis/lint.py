@@ -518,6 +518,11 @@ def _scan_shared_state(sc: _FileScan, graph: CallGraph, node: FuncNode,
     global_names = {name for n in ast.walk(fn) if isinstance(n, ast.Global)
                     for name in n.names}
     mod_state = (mi.module_globals - _local_binds(fn)) | global_names
+    # A constructor mutating its OWN self is initializing an object no
+    # other fiber can see yet (publication happens after __init__
+    # returns) — never a race.  Module-state mutation in a reachable
+    # __init__ still counts.
+    fresh_self = node.name == "__init__"
 
     def mutation(n: ast.AST, what: str, in_read: bool = False) -> None:
         via = ""
@@ -553,7 +558,8 @@ def _scan_shared_state(sc: _FileScan, graph: CallGraph, node: FuncNode,
                     if _is_tls_path(tgt) or locked:
                         continue
                     if node.cls is not None and _is_self_rooted(tgt):
-                        mutation(tgt, _describe(tgt), in_read)
+                        if not fresh_self:
+                            mutation(tgt, _describe(tgt), in_read)
                     else:
                         root = _root_name(tgt)
                         if root is not None and root in mod_state:
@@ -568,15 +574,17 @@ def _scan_shared_state(sc: _FileScan, graph: CallGraph, node: FuncNode,
                 if f.attr == "at" and n.args and not _is_tls_path(n.args[0]):
                     # np.<ufunc>.at(self.table, ...) mutates in place
                     if node.cls is not None and _is_self_rooted(n.args[0]):
-                        mutation(n, _describe(n.args[0]), in_read)
+                        if not fresh_self:
+                            mutation(n, _describe(n.args[0]), in_read)
                     elif isinstance(n.args[0], ast.Name) and \
                             n.args[0].id in mod_state:
                         mutation(n, f"module state '{n.args[0].id}'",
                                  in_read)
                 elif f.attr in _MUTATORS and not _is_tls_path(f.value):
                     if node.cls is not None and _is_self_rooted(f.value):
-                        mutation(n, f"{_describe(f.value)} "
-                                    f"(via .{f.attr}())", in_read)
+                        if not fresh_self:
+                            mutation(n, f"{_describe(f.value)} "
+                                        f"(via .{f.attr}())", in_read)
                     elif isinstance(f.value, ast.Name) and \
                             f.value.id in mod_state:
                         mutation(n, f"module state '{f.value.id}' "

@@ -55,12 +55,49 @@ class _ExclusiveAsRw:
         return self._lock
 
 
+def _pack_lookup_req(owned: np.ndarray) -> bytearray:
+    """Frame a Lookup request into ONE pre-sized buffer, written in place
+    (the old ``struct.pack + tobytes + concat`` built three intermediate
+    buffers per shard — measurable at 8-client fan-out even after the
+    native read path).  The native call paths accept writable buffers
+    zero-copy (:func:`rpc._req_ptr`)."""
+    req = bytearray(4 + 4 * owned.size)
+    struct.pack_into("<i", req, 0, owned.size)
+    np.frombuffer(req, np.int32, owned.size, 4)[:] = owned
+    return req
+
+
+def _pack_apply_req(owned: np.ndarray, grads: np.ndarray) -> bytearray:
+    """Frame an ApplyGrad request (count ++ ids ++ grads) into one
+    pre-sized buffer — same discipline as :func:`_pack_lookup_req`."""
+    n = owned.size
+    req = bytearray(4 + 4 * n + 4 * grads.size)
+    struct.pack_into("<i", req, 0, n)
+    np.frombuffer(req, np.int32, n, 4)[:] = owned
+    np.frombuffer(req, np.float32, grads.size, 4 + 4 * n)[:] = \
+        grads.reshape(-1)
+    return req
+
+
 class PsShardServer:
-    """One embedding shard behind a native RPC server."""
+    """One embedding shard behind a native RPC server.
+
+    ``native_read=True`` serves ``Lookup`` with ZERO Python in the loop:
+    a native generation-versioned shard (:class:`rpc.PsShard`) is
+    attached to the same service, and the Python tier keeps the whole
+    write path — ``ApplyGrad`` mutates the numpy table under the write
+    lock, then publishes an immutable snapshot via ``install``.  Both
+    paths serve ONE table; reads never see a torn row because snapshots
+    are immutable and generation-pinned (the device shard's
+    handle-generation scheme, moved into the native core).  Note that
+    server-side fault injection and obs hooks live in the Python
+    trampoline, so with ``native_read`` they apply to the write path
+    only — the reference's position (SURVEY §3.1) is that the read hot
+    path IS the native handler."""
 
     def __init__(self, vocab: int, dim: int, shard_index: int,
                  num_shards: int, lr: float = 0.1, seed: int = 0,
-                 lock_mode: str = "rw"):
+                 lock_mode: str = "rw", native_read: bool = False):
         if vocab % num_shards:
             raise ValueError("num_shards must divide vocab")
         self.shard_index = shard_index
@@ -84,8 +121,16 @@ class PsShardServer:
             self._mu = _ExclusiveAsRw(checked_lock("ps.shard"))
         else:
             raise ValueError(f"unknown lock_mode {lock_mode!r}")
+        self.native_read = bool(native_read)
+        self._shard: "Optional[rpc.PsShard]" = None
+        self._install_gen = 0
         self.server = rpc.Server()
-        self.server.add_service("Ps", self._handle)
+        if self.native_read:
+            self._shard = rpc.PsShard(vocab, dim, shard_index, num_shards)
+            self._shard.install(self.table, 0)
+            self.server.add_ps_service("Ps", self._shard, self._handle)
+        else:
+            self.server.add_service("Ps", self._handle)
         # `_status` rides along so the health-check prober can revive
         # this shard after a circuit-breaker isolation (resilience tier).
         self.server.add_status_service()
@@ -123,11 +168,29 @@ class PsShardServer:
             with self._mu.write():
                 np.subtract.at(self.table, ids,
                                self.lr * grads.reshape(count, self.dim))
+                if self._shard is not None:
+                    # Publish the post-update table as a fresh immutable
+                    # generation; the install snapshot happens under the
+                    # write lock so concurrent appliers serialize and no
+                    # update is ever skipped by a stale publish.
+                    self._install_gen += 1
+                    self._shard.install(self.table, self._install_gen)
             return b""
         raise ValueError(f"unknown method {method}")
 
+    @property
+    def native_lookups(self) -> int:
+        """Lookups served with zero Python in the loop (0 unless
+        ``native_read``)."""
+        return 0 if self._shard is None else self._shard.native_lookups
+
     def close(self):
+        # Server first: its native Lookup handlers gather from the
+        # shard's snapshots and must drain before the shard dies.
         self.server.close()
+        if self._shard is not None:
+            self._shard.close()
+            self._shard = None
 
 
 class _TableGen:
@@ -542,6 +605,7 @@ class RemoteEmbedding:
         # failed attempt in the join phase), or None once consumed
         pending: List[object] = [None] * len(items)
         out: List[Optional[bytes]] = [None] * len(items)
+        group: "Optional[rpc.CallGroup]" = None
         try:
             for i, (s, req) in enumerate(items):
                 b = self._breaker(s)
@@ -558,19 +622,63 @@ class RemoteEmbedding:
                         tag="attempt=0")
                 except rpc.RpcError as e:
                     pending[i] = e  # keep fanning out; retried below
-            for i, (s, req) in enumerate(items):
-                pc, pending[i] = pending[i], None
-                b = self._breaker(s)
-                try:
-                    if isinstance(pc, rpc.RpcError):
-                        raise pc
-                    if self.backup_ms is not None:
+            if self.backup_ms is not None:
+                # Hedged path: ordered per-shard collection — each hedge
+                # arms backup_ms on its in-flight primary and waits on its
+                # OWN native call group inside backup_call (exact wakes,
+                # no polling slices).
+                for i, (s, req) in enumerate(items):
+                    pc, pending[i] = pending[i], None
+                    b = self._breaker(s)
+                    try:
+                        if isinstance(pc, rpc.RpcError):
+                            raise pc
                         rsp = resilience.backup_call(
                             self.channels[s], "Ps", method, req,
                             backup_ms=self.backup_ms,
                             timeout_ms=_budget(), primary=pc)
+                    except rpc.RpcError as e:
+                        if b is not None:
+                            b.on_call_end(e.code)
+                        rsp = self._retry_shard(s, method, req, e,
+                                                deadline)
                     else:
-                        rsp = pc.join()
+                        if b is not None:
+                            b.on_call_end(0)
+                    out[i] = rsp
+                return out  # type: ignore[return-value]
+            # Unhedged path: completion-ORDER collection over one native
+            # fan-in group (the ParallelChannel CountdownEvent shape).
+            # Every wait_any wakes on exactly one shard completing — no
+            # time slices — and a failing shard starts its retry (or
+            # aborts the batch) the moment it fails, never behind a
+            # slower sibling.  Start-failures are already complete, so
+            # they are classified first (fail fast / retry immediately).
+            group = rpc.CallGroup()
+            waiting: List[int] = []
+            for i, pc in enumerate(pending):
+                if isinstance(pc, rpc.PendingCall):
+                    group.add(pc)
+                    waiting.append(i)
+            for i, (s, req) in enumerate(items):
+                if isinstance(pending[i], rpc.RpcError):
+                    e, pending[i] = pending[i], None
+                    b = self._breaker(s)
+                    if b is not None:
+                        b.on_call_end(e.code)
+                    out[i] = self._retry_shard(s, method, req, e, deadline)
+            while waiting:
+                group.wait_any()
+                done_i = next((i for i in waiting
+                               if pending[i].wait(0.0)), None)
+                if done_i is None:  # pragma: no cover — wait_any contract
+                    continue
+                waiting.remove(done_i)
+                s, req = items[done_i]
+                pc, pending[done_i] = pending[done_i], None
+                b = self._breaker(s)
+                try:
+                    rsp = pc.join()
                 except rpc.RpcError as e:
                     if b is not None:
                         b.on_call_end(e.code)
@@ -578,9 +686,11 @@ class RemoteEmbedding:
                 else:
                     if b is not None:
                         b.on_call_end(0)
-                out[i] = rsp
+                out[done_i] = rsp
             return out  # type: ignore[return-value]
         finally:
+            if group is not None:
+                group.close()
             # Partial failure: cancel the stragglers so close() reaps
             # them at cancel speed, not at their full timeout.
             for pc in pending:
@@ -627,7 +737,7 @@ class RemoteEmbedding:
             split = list(self._owner_split(flat))
             items = []
             for s, positions, owned in split:
-                req = struct.pack("<i", owned.size) + owned.tobytes()
+                req = _pack_lookup_req(owned)
                 nbytes_out += len(req)
                 items.append((s, req))
             for (s, positions, owned), rsp in zip(
@@ -637,7 +747,7 @@ class RemoteEmbedding:
                     rsp, np.float32).reshape(owned.size, self.dim)
         else:
             for s, positions, owned in self._owner_split(flat):
-                req = struct.pack("<i", owned.size) + owned.tobytes()
+                req = _pack_lookup_req(owned)
                 rsp = self._call_shard(s, "Lookup", req)
                 out[positions] = np.frombuffer(rsp, np.float32).reshape(
                     owned.size, self.dim)
@@ -663,15 +773,13 @@ class RemoteEmbedding:
         if self.parallel:
             items = []
             for s, positions, owned in self._owner_split(flat):
-                req = (struct.pack("<i", owned.size) + owned.tobytes()
-                       + g[positions].tobytes())
+                req = _pack_apply_req(owned, g[positions])
                 nbytes_out += len(req)
                 items.append((s, req))
             self._fan_out("ApplyGrad", items)
         else:
             for s, positions, owned in self._owner_split(flat):
-                req = (struct.pack("<i", owned.size) + owned.tobytes() +
-                       g[positions].tobytes())
+                req = _pack_apply_req(owned, g[positions])
                 self._call_shard(s, "ApplyGrad", req)
                 nbytes_out += len(req)
         if rec:

@@ -255,8 +255,7 @@ def call_with_retry(channel, service: str, method: str,
 
 def backup_call(channel, service: str, method: str, request: bytes = b"",
                 *, backup_ms: float, timeout_ms: Optional[int] = None,
-                tag: Optional[str] = None, poll_ms: float = 2.0,
-                primary=None) -> bytes:
+                tag: Optional[str] = None, primary=None) -> bytes:
     """Hedged call: start the primary; if it has not completed within
     ``backup_ms``, start a second identical attempt.  The FIRST
     completion wins and the loser is cancelled (native ``StartCancel``)
@@ -268,9 +267,13 @@ def backup_call(channel, service: str, method: str, request: bytes = b"",
     it is always consumed — joined, or cancelled and reaped.
 
     The reference arms this with a timer inside the controller
-    (controller.cpp:337); here the hedge lives in Python over the
-    ``brt_call_wait`` peek-primitive so the loser's cancellation is
-    observable (obs counters) and reusable by the PS straggler path.
+    (controller.cpp:337); here the hedge rides the native call-group
+    fan-in (``rpc.CallGroup``): both attempts signal one CountdownEvent
+    and every ``wait_any`` wakes on EXACTLY one completion — no
+    ``brt_call_wait`` polling slices anywhere in the loop.  The
+    ``rpc_hedge_waits`` counter tracks completions consumed (at most one
+    per attempt), not elapsed time — the exactness contract the tests
+    assert.
     """
     rec = obs.enabled()
 
@@ -281,26 +284,34 @@ def backup_call(channel, service: str, method: str, request: bytes = b"",
         primary = channel.call_async(service, method, request,
                                      timeout_ms=timeout_ms,
                                      tag=_tagged("hedge=primary"))
+    # The arming window: ONE bounded wait on the primary's own completion
+    # latch (level-triggered, not a poll loop).
     if primary.wait(backup_ms / 1000.0):
         return primary.join()
     if rec:
         obs.counter("rpc_backup_fired").add(1)
+    from brpc_tpu import rpc as _rpc  # lazy: rpc imports this module
     pending: List[Tuple[str, object]] = [("primary", primary)]
+    group = _rpc.CallGroup()
     try:
+        group.add(primary)
         try:
             backup = channel.call_async(service, method, request,
                                         timeout_ms=timeout_ms,
                                         tag=_tagged("hedge=backup"))
             pending.append(("backup", backup))
+            group.add(backup)
         except Exception as e:  # noqa: BLE001 — hedge must not lose the
             if getattr(e, "code", None) is None:  # primary to a failed
                 raise                             # backup start
         first_exc: Optional[Exception] = None
         while pending:
+            if rec:
+                obs.counter("rpc_hedge_waits").add(1)
+            group.wait_any()  # parks until one attempt completes; exact
             done_idx = next((i for i, (_, pc) in enumerate(pending)
                              if pc.wait(0.0)), None)
-            if done_idx is None:
-                pending[0][1].wait(poll_ms / 1000.0)
+            if done_idx is None:  # pragma: no cover — wait_any contract
                 continue
             label, pc = pending.pop(done_idx)
             try:
@@ -316,6 +327,7 @@ def backup_call(channel, service: str, method: str, request: bytes = b"",
             return out
         raise first_exc  # both attempts completed, both failed
     finally:
+        group.close()
         # Winner path: cancel the loser so it stops consuming the server
         # and the fabric, then reap.  Error paths reap whatever is left.
         for _, pc in pending:

@@ -142,6 +142,35 @@ def _load_locked():
     lib.brt_call_join.restype = ctypes.c_int
     lib.brt_call_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.brt_call_wait.restype = ctypes.c_int
+    lib.brt_call_group_new.argtypes = []
+    lib.brt_call_group_new.restype = ctypes.c_void_p
+    lib.brt_call_group_add.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.brt_call_group_add.restype = ctypes.c_int
+    lib.brt_call_group_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.brt_call_group_wait.restype = ctypes.c_int
+    lib.brt_call_group_wait_any.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_int64]
+    lib.brt_call_group_wait_any.restype = ctypes.c_int
+    lib.brt_call_group_completed.argtypes = [ctypes.c_void_p]
+    lib.brt_call_group_completed.restype = ctypes.c_int
+    lib.brt_call_group_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_call_group_destroy.restype = None
+    lib.brt_ps_shard_new.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+    lib.brt_ps_shard_new.restype = ctypes.c_void_p
+    lib.brt_ps_shard_install.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+    lib.brt_ps_shard_install.restype = ctypes.c_int
+    lib.brt_ps_shard_generation.argtypes = [ctypes.c_void_p]
+    lib.brt_ps_shard_generation.restype = ctypes.c_uint64
+    lib.brt_ps_shard_native_lookups.argtypes = [ctypes.c_void_p]
+    lib.brt_ps_shard_native_lookups.restype = ctypes.c_uint64
+    lib.brt_server_add_ps_service.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, _HANDLER,
+        ctypes.c_void_p]
+    lib.brt_server_add_ps_service.restype = ctypes.c_int
+    lib.brt_ps_shard_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_ps_shard_destroy.restype = None
     lib.brt_call_cancel.argtypes = [ctypes.c_void_p]
     lib.brt_call_cancel.restype = None
     lib.brt_call_destroy.argtypes = [ctypes.c_void_p]
@@ -209,6 +238,18 @@ class RpcError(RuntimeError):
         self.code = code
 
 
+def _req_ptr(request):
+    """Request bytes for a native call: ``bytes`` pass straight through;
+    writable buffers (``bytearray``/``memoryview``) are wrapped zero-copy
+    — legal because every native call path copies the request before
+    returning, so the caller may reuse the buffer immediately.  This is
+    what lets the PS client frame each request into ONE pre-sized
+    ``bytearray`` instead of concatenating intermediates."""
+    if isinstance(request, bytes) or request is None:
+        return request
+    return (ctypes.c_char * len(request)).from_buffer(request)
+
+
 def _record_server_call(service: str, method: str, t0: int, wall: float,
                         req_len: int, rsp_len: int,
                         error: Optional[str],
@@ -263,8 +304,11 @@ class Server:
         self._handlers = []  # keep CFUNCTYPE refs alive
         self._listen: Optional[str] = None  # set by start()
 
-    def add_service(self, name: str,
-                    handler: Callable[[str, bytes], bytes]) -> None:
+    def _sync_trampoline(self, name: str,
+                         handler: Callable[[str, bytes], bytes]):
+        """Builds the fiber->Python trampoline shared by
+        :meth:`add_service` and :meth:`add_ps_service` (the caller must
+        pin the returned CFUNCTYPE on ``self._handlers``)."""
         lib = self._lib
 
         @_HANDLER
@@ -296,10 +340,30 @@ class Server:
                                     wall, req_len, out_len, err,
                                     err_code if err else 2001)
 
-        rc = lib.brt_server_add_service(self._ptr, name.encode(),
-                                        trampoline, None)
+        return trampoline
+
+    def add_service(self, name: str,
+                    handler: Callable[[str, bytes], bytes]) -> None:
+        trampoline = self._sync_trampoline(name, handler)
+        rc = self._lib.brt_server_add_service(self._ptr, name.encode(),
+                                              trampoline, None)
         if rc != 0:
             raise RuntimeError(f"add_service failed: {rc}")
+        self._handlers.append(trampoline)
+
+    def add_ps_service(self, name: str, shard: "PsShard",
+                       fallback: Callable[[str, bytes], bytes]) -> None:
+        """Registers a PS service whose ``Lookup`` is served NATIVELY from
+        ``shard`` — zero Python (no GIL, no ctypes trampoline, no request
+        framing) in the read loop.  Every other method (``ApplyGrad``,
+        lifecycle, fault injection) dispatches to ``fallback`` on the
+        standard trampoline, so the Python tier keeps the write path.
+        The shard must outlive this server (close the server first)."""
+        trampoline = self._sync_trampoline(name, fallback)
+        rc = self._lib.brt_server_add_ps_service(
+            self._ptr, name.encode(), shard._ptr, trampoline, None)
+        if rc != 0:
+            raise RuntimeError(f"add_ps_service failed: {rc}")
         self._handlers.append(trampoline)
 
     def add_async_service(self, name: str, handler) -> None:
@@ -486,6 +550,123 @@ class PendingCall:
             self._lib.brt_call_destroy(ptr)
 
 
+class CallGroup:
+    """Exact multi-call fan-in: one native CountdownEvent signaled by the
+    done-closure of every registered call (the ParallelChannel shape,
+    cpp/cluster/parallel_channel.*).
+
+    ``add()`` registers an un-consumed :class:`PendingCall` (a call that
+    already completed counts immediately).  ``wait()`` parks until EVERY
+    registered call has completed; ``wait_any()`` parks until a completion
+    that no previous ``wait_any`` consumed exists, consumes it, and
+    returns — N calls yield exactly N successful ``wait_any`` returns, so
+    hedge/fan-out loops wake exactly instead of polling ``wait`` in time
+    slices.  The group observes completion only: ``join()``/``close()``
+    each call as usual.  ``close()`` is safe with members still in flight
+    (registration is refcounted natively)."""
+
+    __slots__ = ("_lib", "_ptr")
+
+    def __init__(self):
+        self._lib = _load()
+        self._ptr = self._lib.brt_call_group_new()
+
+    def add(self, call: PendingCall) -> None:
+        if self._ptr is None or call._ptr is None:
+            raise RuntimeError("cannot add a joined/closed call to a group")
+        self._lib.brt_call_group_add(self._ptr, call._ptr)
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        """True once every registered call has completed (all joins are
+        then non-blocking).  Level-triggered; callable repeatedly."""
+        if obs.enabled():
+            obs.counter("rpc_group_waits").add(1)
+        if timeout_s is None:
+            if _race.enabled():
+                _race.note_blocking("brt_call_group_wait")
+            return self._lib.brt_call_group_wait(self._ptr, -1) == 0
+        us = max(0, int(timeout_s * 1e6))
+        return self._lib.brt_call_group_wait(self._ptr, us) == 0
+
+    def wait_any(self, timeout_s: Optional[float] = None) -> bool:
+        """True once an unconsumed completion exists (consuming it): each
+        successful return corresponds to exactly one call completing."""
+        if obs.enabled():
+            obs.counter("rpc_group_waits").add(1)
+        if timeout_s is None:
+            if _race.enabled():
+                _race.note_blocking("brt_call_group_wait")
+            return self._lib.brt_call_group_wait_any(self._ptr, -1) == 0
+        us = max(0, int(timeout_s * 1e6))
+        return self._lib.brt_call_group_wait_any(self._ptr, us) == 0
+
+    @property
+    def completed(self) -> int:
+        """Completions observed so far (diagnostics)."""
+        return self._lib.brt_call_group_completed(self._ptr)
+
+    def close(self) -> None:
+        if self._ptr is not None:
+            ptr, self._ptr = self._ptr, None
+            self._lib.brt_call_group_destroy(ptr)
+
+
+class PsShard:
+    """Native generation-versioned PS shard (cpp/capi/ps_shard.cc): serves
+    ``Lookup`` entirely inside the C++ fiber handler once attached to a
+    server via :meth:`Server.add_ps_service`.
+
+    The caller owns the WRITE path: it keeps the mutable table (numpy),
+    applies gradients, then publishes an immutable snapshot with
+    :meth:`install` — readers pin a generation, gather outside any lock,
+    and the last reader frees a retired snapshot (the handle-generation
+    scheme of the device shard, moved into the native core)."""
+
+    __slots__ = ("_lib", "_ptr", "rows_per", "dim")
+
+    def __init__(self, vocab: int, dim: int, shard_index: int,
+                 num_shards: int):
+        self._lib = _load()
+        self._ptr = self._lib.brt_ps_shard_new(vocab, dim, shard_index,
+                                               num_shards)
+        if not self._ptr:
+            raise ValueError(
+                f"bad shard geometry: vocab={vocab} dim={dim} "
+                f"shard={shard_index}/{num_shards}")
+        self.rows_per = vocab // num_shards
+        self.dim = dim
+
+    def install(self, table, gen: int) -> None:
+        """Publishes ``table`` ([rows_per, dim] float32) as generation
+        ``gen``.  The native side snapshots the buffer before returning,
+        so the caller may keep mutating its array."""
+        import numpy as np
+        arr = np.ascontiguousarray(table, dtype=np.float32)
+        if arr.shape != (self.rows_per, self.dim):
+            raise ValueError(f"table shape {arr.shape} != "
+                             f"({self.rows_per}, {self.dim})")
+        rc = self._lib.brt_ps_shard_install(self._ptr, arr.ctypes.data,
+                                            self.rows_per, gen)
+        if rc != 0:
+            raise RpcError(rc, "ps shard install failed")
+
+    @property
+    def generation(self) -> int:
+        return self._lib.brt_ps_shard_generation(self._ptr)
+
+    @property
+    def native_lookups(self) -> int:
+        """Lookups served with zero Python in the loop."""
+        return self._lib.brt_ps_shard_native_lookups(self._ptr)
+
+    def close(self) -> None:
+        """Destroy the shard.  Servers it is attached to MUST already be
+        closed (their handlers gather from this shard's snapshots)."""
+        if self._ptr is not None:
+            ptr, self._ptr = self._ptr, None
+            self._lib.brt_ps_shard_destroy(ptr)
+
+
 class Channel:
     """Client channel. addr: "ip:port" single-server, or a cluster url
     ("list://h1,h2", "file://path", "dns://host:port") + lb name."""
@@ -541,9 +722,9 @@ class Channel:
         rsp_len = ctypes.c_size_t()
         errbuf = ctypes.create_string_buffer(256)
         rc = self._lib.brt_channel_call(
-            self._ptr, service.encode(), method.encode(), request,
-            len(request), ctypes.byref(rsp), ctypes.byref(rsp_len), errbuf,
-            256)
+            self._ptr, service.encode(), method.encode(),
+            _req_ptr(request), len(request), ctypes.byref(rsp),
+            ctypes.byref(rsp_len), errbuf, 256)
         if rc != 0:
             text = errbuf.value.decode(errors="replace")
             if rec:
@@ -578,8 +759,8 @@ class Channel:
         if fault.active():
             fault.client_intercept(service, method, self._addr, timeout_ms)
         ptr = self._lib.brt_channel_call_start_opts(
-            self._ptr, service.encode(), method.encode(), request,
-            len(request),
+            self._ptr, service.encode(), method.encode(),
+            _req_ptr(request), len(request),
             _INT64_MIN if timeout_ms is None else int(timeout_ms))
         if not ptr:
             raise RpcError(-1, f"call_start failed for {self._addr}")

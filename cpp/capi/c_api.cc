@@ -4,7 +4,10 @@
 #include <cstring>
 #include <memory>
 #include <string>
+#include <vector>
 
+#include "base/time.h"
+#include "capi/capi_internal.h"
 #include "cluster/cluster_channel.h"
 #include "cluster/remote_naming.h"
 #include "fiber/fiber.h"
@@ -15,12 +18,8 @@
 namespace {
 
 using namespace brt;
-
-struct CSession {
-  Controller* cntl;
-  IOBuf* response;
-  Closure done;
-};
+using brt_capi::CServer;
+using brt_capi::CSession;
 
 class CService : public Service {
  public:
@@ -39,23 +38,47 @@ class CService : public Service {
   void* user_;
 };
 
-struct CServer {
-  Server server;
-  std::vector<std::unique_ptr<CService>> services;
-  std::unique_ptr<NamingRegistryService> naming;
-};
-
 struct CChannel {
   std::unique_ptr<ChannelBase> channel;
 };
 
+// Exact multi-call fan-in (the ParallelChannel CountdownEvent shape,
+// cluster/parallel_channel.*): N done-closures signal one waiter, which
+// wakes exactly — never on a polling slice.  Refcounted so a group is
+// safe to destroy while registered calls are still in flight (each
+// incomplete registration holds a ref until its done-closure fires).
+struct CCallGroup {
+  FiberMutex mu;
+  FiberCond cond;
+  int total = 0;      // calls registered
+  int completed = 0;  // calls finished
+  int consumed = 0;   // completions handed out by wait_any
+  std::atomic<int> refs{1};
+};
+
+void group_unref(CCallGroup* g) {
+  if (g->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete g;
+}
+
+void group_notify(CCallGroup* g) {
+  g->mu.lock();
+  ++g->completed;
+  g->cond.notify_all();
+  g->mu.unlock();
+  group_unref(g);
+}
+
 // One in-flight async call (brt_channel_call_start).  The done closure
-// only touches the CountdownEvent; join/destroy wait on it before reading
+// marks completion (releasing any registered call groups), then signals
+// the CountdownEvent; join/destroy wait on it before reading
 // cntl/response or freeing, so completion never races the caller.
 struct CCall {
   Controller cntl;
   IOBuf response;
   CountdownEvent done{1};
+  FiberMutex group_mu;               // guards completed/groups
+  bool completed = false;
+  std::vector<CCallGroup*> groups;   // registered, not yet notified
 };
 
 }  // namespace
@@ -196,11 +219,96 @@ void* brt_channel_call_start_opts(void* channel, const char* service,
   if (req && req_len) request.append(req, req_len);
   // The done closure runs exactly once, in a fiber, after cntl/response
   // are filled (including synchronous local failures, which invoke done
-  // before CallMethod returns).
+  // before CallMethod returns).  Group notification happens AFTER the
+  // completion latch is signaled, so a waiter woken by the group always
+  // observes brt_call_wait(call, 0) == 0 for the finished call.
   CCall* raw = call;
   c->channel->CallMethod(service, method, &call->cntl, request,
-                         &call->response, [raw] { raw->done.signal(); });
+                         &call->response, [raw] {
+                           raw->group_mu.lock();
+                           raw->completed = true;
+                           std::vector<CCallGroup*> gs;
+                           gs.swap(raw->groups);
+                           raw->group_mu.unlock();
+                           raw->done.signal();  // last touch of raw
+                           for (CCallGroup* g : gs) group_notify(g);
+                         });
   return call;
+}
+
+void* brt_call_group_new(void) { return new CCallGroup; }
+
+int brt_call_group_add(void* group, void* call) {
+  auto* g = static_cast<CCallGroup*>(group);
+  auto* c = static_cast<CCall*>(call);
+  c->group_mu.lock();
+  const bool already_done = c->completed;
+  if (!already_done) {
+    c->groups.push_back(g);
+    g->refs.fetch_add(1, std::memory_order_relaxed);
+  }
+  c->group_mu.unlock();
+  g->mu.lock();
+  ++g->total;
+  if (already_done) {
+    ++g->completed;
+    g->cond.notify_all();
+  }
+  g->mu.unlock();
+  return 0;
+}
+
+int brt_call_group_wait(void* group, int64_t timeout_us) {
+  auto* g = static_cast<CCallGroup*>(group);
+  const int64_t deadline =
+      timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+  g->mu.lock();
+  while (g->completed < g->total) {
+    int64_t left = -1;
+    if (deadline >= 0) {
+      left = deadline - monotonic_us();
+      if (left <= 0) {
+        g->mu.unlock();
+        return ETIMEDOUT;
+      }
+    }
+    g->cond.wait(g->mu, left);
+  }
+  g->mu.unlock();
+  return 0;
+}
+
+int brt_call_group_wait_any(void* group, int64_t timeout_us) {
+  auto* g = static_cast<CCallGroup*>(group);
+  const int64_t deadline =
+      timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+  g->mu.lock();
+  while (g->completed <= g->consumed) {
+    int64_t left = -1;
+    if (deadline >= 0) {
+      left = deadline - monotonic_us();
+      if (left <= 0) {
+        g->mu.unlock();
+        return ETIMEDOUT;
+      }
+    }
+    g->cond.wait(g->mu, left);
+  }
+  ++g->consumed;
+  g->mu.unlock();
+  return 0;
+}
+
+int brt_call_group_completed(void* group) {
+  auto* g = static_cast<CCallGroup*>(group);
+  g->mu.lock();
+  const int n = g->completed;
+  g->mu.unlock();
+  return n;
+}
+
+void brt_call_group_destroy(void* group) {
+  group_unref(static_cast<CCallGroup*>(group));
 }
 
 int brt_call_wait(void* call, int64_t timeout_us) {

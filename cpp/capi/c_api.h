@@ -82,8 +82,9 @@ void* brt_channel_call_start_opts(void* channel, const char* service,
 // consuming the result: returns 0 once complete (join still collects),
 // ETIMEDOUT if timeout_us elapses first (timeout_us < 0 = forever).
 // Callable any number of times — the completion latch is level-
-// triggered.  This is the primitive the Python backup-request hedge
-// polls ("did the primary answer within backup_ms?").
+// triggered.  The Python hedge uses one bounded wait here as its arming
+// window ("did the primary answer within backup_ms?"); multi-call
+// waiting goes through brt_call_group_* below, never a wait loop.
 int brt_call_wait(void* call, int64_t timeout_us);
 // Requests cancellation of the in-flight call (reference
 // Controller::StartCancel): completion still happens exactly once, with
@@ -92,7 +93,64 @@ int brt_call_wait(void* call, int64_t timeout_us);
 // that already completed.  join/destroy remain mandatory.
 void brt_call_cancel(void* call);
 
+// ---- call groups (exact multi-call fan-in) ----
+// One CountdownEvent-shaped latch signaled by N done-closures (the
+// ParallelChannel fan-in, SURVEY §3.4): hedges and fan-out joins wake
+// EXACTLY on completion instead of polling brt_call_wait in time slices.
+// Register in-flight calls with brt_call_group_add (a call that already
+// completed counts immediately); a group may outlive or predate its
+// calls — registration is refcounted, so destroy is safe with members
+// still in flight.  Groups observe completion only; join/destroy of each
+// call remain the caller's responsibility.
+void* brt_call_group_new(void);
+// Registers the call (started via brt_channel_call_start*) with the
+// group.  Returns 0.  Add each call at most once per group.
+int brt_call_group_add(void* group, void* call);
+// Parks until EVERY registered call has completed (0), or ETIMEDOUT.
+// timeout_us < 0 = forever.  Level-triggered: callable repeatedly.
+int brt_call_group_wait(void* group, int64_t timeout_us);
+// Wait-any mode: parks until at least one completion has not yet been
+// consumed by a previous wait_any, consumes it, returns 0 (or
+// ETIMEDOUT).  N calls → N successful wait_any returns, one per
+// completion — the hedge loop's exact-wakeup primitive.
+int brt_call_group_wait_any(void* group, int64_t timeout_us);
+// Completions observed so far (diagnostics/tests).
+int brt_call_group_completed(void* group);
+void brt_call_group_destroy(void* group);
+
 void brt_free(void* p);
+
+// ---- native PS shard (zero-Python read path) ----
+// A generation-versioned row table serving `Lookup` straight from the
+// C++ fiber handler (SURVEY §3.1 — the reference serves all traffic
+// natively).  The bound language keeps the WRITE path: it owns the
+// mutable table, applies gradients, then publishes an immutable snapshot
+// with brt_ps_shard_install.  Readers pin a generation, gather outside
+// any lock, unpin; install swaps atomically and the last reader frees a
+// retired snapshot (the PR-4 handle-generation scheme, one layer down).
+//
+// vocab must divide by n_shards; the shard owns rows
+// [shard_index*vocab/n_shards, (shard_index+1)*vocab/n_shards).
+// Returns NULL on bad arguments.
+void* brt_ps_shard_new(int64_t vocab, int64_t dim, int shard_index,
+                       int n_shards);
+// Publishes a snapshot: copies rows*dim float32 values from `table`
+// (the caller may mutate its buffer again the moment this returns).
+// rows must equal the shard's rows-per-shard.  0 on success.
+int brt_ps_shard_install(void* shard, const void* table, int64_t rows,
+                         uint64_t gen);
+// Generation of the currently-served snapshot (0 before any install).
+uint64_t brt_ps_shard_generation(void* shard);
+// Lookups served natively since creation (proves zero-Python serving).
+uint64_t brt_ps_shard_native_lookups(void* shard);
+// Registers a service on `server` whose `Lookup` is served natively from
+// `shard`; every other method is dispatched to `fallback` with the
+// standard brt_service_handler session contract.  The shard must outlive
+// the server.  0 on success.
+int brt_server_add_ps_service(void* server, const char* name, void* shard,
+                              brt_service_handler fallback, void* user);
+// The server using the shard must be destroyed first.
+void brt_ps_shard_destroy(void* shard);
 
 // ---- runtime ----
 void brt_init(int fiber_workers);

@@ -1,0 +1,32 @@
+// Shared internals of the C ABI (capi/c_api.cc + capi/ps_shard.cc): the
+// session object brt_session_respond consumes and the server wrapper both
+// translation units register services on.  Not part of the public ABI —
+// language bindings see only c_api.h.
+#pragma once
+
+#include <memory>
+#include <vector>
+
+#include "cluster/remote_naming.h"
+#include "rpc/server.h"
+
+namespace brt_capi {
+
+// One in-flight server-side request handed to a bound-language handler.
+// brt_session_respond fills the response (or the failure), deletes the
+// session and runs the done closure exactly once.
+struct CSession {
+  brt::Controller* cntl;
+  brt::IOBuf* response;
+  brt::Closure done;
+};
+
+struct CServer {
+  brt::Server server;
+  // Keeps every registered service alive for the server's lifetime
+  // (AddService does not take ownership).
+  std::vector<std::unique_ptr<brt::Service>> services;
+  std::unique_ptr<brt::NamingRegistryService> naming;
+};
+
+}  // namespace brt_capi

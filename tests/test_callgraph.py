@@ -166,6 +166,125 @@ def test_module_name_for_path(tmp_path):
     assert module_name_for_path(str(lone)) == "lone"
 
 
+# ---- attr-type map: calls on held objects (self.<attr> = Class(...)) ----
+
+def test_held_object_method_edge_resolves(tmp_path):
+    """The PR-3 deferral: ``self.dev.stage()`` used to be a skipped edge;
+    the ``self.<attr> = Class(...)`` type map resolves it."""
+    g = _graph(tmp_path, m="""\
+        class Dev:
+            def stage(self):
+                pass
+
+        class Shard:
+            def __init__(self):
+                self.dev = Dev()
+
+            def handle(self):
+                self.dev.stage()
+    """)
+    assert _only_node(g, "Dev.stage") in \
+        _callee_ids(g, _only_node(g, "Shard.handle"))
+
+
+def test_held_object_edge_across_modules_and_alias(tmp_path):
+    g = _graph(
+        tmp_path,
+        rpclib="""\
+            class DeviceClient:
+                def fetch(self):
+                    pass
+        """,
+        app="""\
+            import rpclib
+
+            class Server:
+                def __init__(self, client=None):
+                    self.dev = client or rpclib.DeviceClient()
+
+                def handle(self):
+                    self.dev.fetch()
+        """,
+    )
+    # the `x or Class()` injectable-dependency default resolves too
+    assert _only_node(g, "DeviceClient.fetch") in \
+        _callee_ids(g, _only_node(g, "Server.handle"))
+
+
+def test_held_object_ambiguous_attr_stays_unresolved(tmp_path):
+    """An attr constructed as two different classes would make any edge a
+    guess — the under-approximation polarity drops it."""
+    g = _graph(tmp_path, m="""\
+        class A:
+            def go(self):
+                pass
+
+        class B:
+            def go(self):
+                pass
+
+        class User:
+            def __init__(self, fast):
+                if fast:
+                    self.impl = A()
+                else:
+                    self.impl = B()
+
+            def handle(self):
+                self.impl.go()
+    """)
+    assert _callee_ids(g, _only_node(g, "User.handle")) == []
+
+
+def test_held_object_mutation_reaches_fiber_shared_state(tmp_path):
+    """A handler mutating state THROUGH a held object was invisible to the
+    resolver before the attr-type map; now the chain is followed and the
+    unlocked mutation inside the held class is reported."""
+    (tmp_path / "app.py").write_text(textwrap.dedent("""\
+        class Sink:
+            def __init__(self):
+                self.items = []
+
+            def push(self, x):
+                self.items.append(x)
+
+        class Shard:
+            def __init__(self, server):
+                self.sink = Sink()
+                server.add_service("Ps", self._handle)
+
+            def _handle(self, method, req):
+                self.sink.push(req)
+                return b""
+    """))
+    findings = [f for f in lint.run_lint([str(tmp_path)])
+                if f.check == "fiber-shared-state"]
+    assert len(findings) == 1
+    f = findings[0]
+    assert "self.items" in f.message
+    assert "Shard._handle -> Sink.push" in f.message
+
+
+def test_constructor_self_mutation_exempt(tmp_path):
+    """__init__ initializing its OWN fresh object is not shared-state
+    mutation (nothing else can see the object before publication) — the
+    attr-type map makes constructors handler-reachable, so the check must
+    not flag them."""
+    (tmp_path / "app.py").write_text(textwrap.dedent("""\
+        class Item:
+            def __init__(self, v):
+                self.v = v
+
+        class Shard:
+            def __init__(self, server):
+                server.add_service("Ps", self._handle)
+
+            def _handle(self, method, req):
+                return Item(req).v
+    """))
+    assert lint.run_lint([str(tmp_path)]) == []
+
+
 # ---- seeded cross-module violations the lexical pass misses ----
 
 _IMPURE_HELPERS = """\

@@ -1,0 +1,68 @@
+"""C-ABI drift guard: the native header and the ctypes table must agree.
+
+Every ``brt_*`` function declared in ``cpp/capi/c_api.h`` needs BOTH
+``argtypes`` and ``restype`` declared in ``rpc._load()`` (ctypes defaults
+an undeclared restype to c_int, which truncates 64-bit pointers/handles),
+and every binding must point at a symbol the header still declares.
+
+This complements the ``ctypes-contract`` lint check, which only sees the
+Python side — a native symbol that was never bound at all is invisible to
+it.  Parsing the header catches the gap, for the ``brt_ps_*`` /
+call-group families and every future addition.  Pure text analysis: runs
+without the native toolchain."""
+
+import os
+import re
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEADER = os.path.join(ROOT, "cpp", "capi", "c_api.h")
+BINDINGS = os.path.join(ROOT, "brpc_tpu", "rpc.py")
+
+
+def _header_symbols():
+    with open(HEADER, "r", encoding="utf-8") as f:
+        src = f.read()
+    src = re.sub(r"//[^\n]*", "", src)        # comments don't declare
+    names = set(re.findall(r"\b(brt_\w+)\s*\(", src))
+    # function-POINTER typedefs (callback types) are not callable symbols
+    typedefs = set(re.findall(r"\(\s*\*\s*(brt_\w+)\s*\)", src))
+    return names - typedefs
+
+
+def _binding_decls():
+    with open(BINDINGS, "r", encoding="utf-8") as f:
+        src = f.read()
+    decls = {}
+    for name, kind in re.findall(
+            r"lib\.(brt_\w+)\.(argtypes|restype)\s*=", src):
+        decls.setdefault(name, set()).add(kind)
+    return decls
+
+
+def test_header_parses_to_a_plausible_symbol_set():
+    symbols = _header_symbols()
+    assert len(symbols) > 30                   # the ABI is not tiny
+    assert "brt_channel_call" in symbols
+    assert "brt_ps_shard_install" in symbols   # this PR's additions
+    assert "brt_call_group_wait_any" in symbols
+    assert "brt_service_handler" not in symbols  # typedef, not a symbol
+
+
+def test_every_header_symbol_has_full_ctypes_binding():
+    decls = _binding_decls()
+    missing = []
+    for name in sorted(_header_symbols()):
+        gap = {"argtypes", "restype"} - decls.get(name, set())
+        if gap:
+            missing.append(f"{name} (missing {', '.join(sorted(gap))})")
+    assert not missing, (
+        "c_api.h declares symbols without a complete ctypes binding in "
+        "rpc._load() — an undeclared restype truncates 64-bit handles:\n  "
+        + "\n  ".join(missing))
+
+
+def test_no_binding_for_a_symbol_the_header_dropped():
+    header = _header_symbols()
+    stale = sorted(n for n in _binding_decls() if n not in header)
+    assert not stale, (
+        f"rpc._load() binds symbols c_api.h no longer declares: {stale}")

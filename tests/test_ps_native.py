@@ -1,0 +1,359 @@
+"""Native zero-Python PS read path + exact multi-call wait fan-in.
+
+Covers the ISSUE-6 tentpole end to end: byte-for-byte parity of the
+native Lookup handler against the Python ``_serve`` path (randomized /
+empty / full-shard batches), proof that no Python runs in the native
+read loop, torn-row stress where native reads race Python ``ApplyGrad``
+generation installs (RACECHECK clean), ``rpc.CallGroup`` semantics, and
+the hedge's exact-wakeup contract (``rpc_hedge_waits`` counts
+completions, not 2ms polling slices)."""
+
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from brpc_tpu import fault, obs, resilience
+from brpc_tpu.ps_remote import PsShardServer, RemoteEmbedding
+
+VOCAB, DIM, SHARDS = 64, 16, 4
+
+
+def _lookup_req(ids: np.ndarray) -> bytes:
+    return struct.pack("<i", ids.size) + np.asarray(
+        ids, np.int32).tobytes()
+
+
+# ---- parity: native Lookup vs the Python _serve path ----
+
+@pytest.mark.needs_native
+def test_native_lookup_parity_with_python_serve():
+    server = PsShardServer(VOCAB, DIM, 1, SHARDS, native_read=True)
+    from brpc_tpu import rpc
+
+    ch = rpc.Channel(server.address)
+    rows_per = VOCAB // SHARDS
+    rng = np.random.default_rng(11)
+    batches = [
+        rng.integers(server.base, server.base + rows_per,
+                     37).astype(np.int32),              # randomized
+        np.empty(0, np.int32),                          # empty batch
+        np.arange(server.base, server.base + rows_per,
+                  dtype=np.int32),                      # full shard
+        np.array([server.base] * 5, np.int32),          # duplicates
+    ]
+    try:
+        for ids in batches:
+            req = _lookup_req(ids)
+            native = ch.call("Ps", "Lookup", req)
+            python = server._serve("Lookup", req)
+            assert native == python  # byte-for-byte
+        assert server.native_lookups == len(batches)
+    finally:
+        ch.close()
+        server.close()
+
+
+@pytest.mark.needs_native
+def test_native_lookup_matches_python_twin_server():
+    """Same seed => same table: a native_read server and a plain Python
+    server must serve identical bytes for identical requests."""
+    from brpc_tpu import rpc
+
+    nat = PsShardServer(VOCAB, DIM, 0, 1, seed=5, native_read=True)
+    py = PsShardServer(VOCAB, DIM, 0, 1, seed=5)
+    ch_n = rpc.Channel(nat.address)
+    ch_p = rpc.Channel(py.address)
+    try:
+        rng = np.random.default_rng(2)
+        for _ in range(5):
+            ids = rng.integers(0, VOCAB, 23).astype(np.int32)
+            req = _lookup_req(ids)
+            assert ch_n.call("Ps", "Lookup", req) == \
+                ch_p.call("Ps", "Lookup", req)
+        assert nat.native_lookups == 5
+        assert py.native_lookups == 0
+    finally:
+        ch_n.close()
+        ch_p.close()
+        nat.close()
+        py.close()
+
+
+@pytest.mark.needs_native
+def test_native_lookup_runs_with_zero_python_in_the_loop():
+    """Break the Python serving path entirely: native Lookups keep
+    working (nothing in the loop to break), while ApplyGrad — still
+    owned by Python — fails through the broken handler."""
+    from brpc_tpu import rpc
+
+    server = PsShardServer(VOCAB, DIM, 0, 1, native_read=True)
+    server._serve = None  # the Python path would now TypeError
+    ch = rpc.Channel(server.address)
+    ids = np.arange(8, dtype=np.int32)
+    try:
+        rsp = ch.call("Ps", "Lookup", _lookup_req(ids))
+        assert len(rsp) == 8 * DIM * 4
+        with pytest.raises(rpc.RpcError):
+            ch.call("Ps", "ApplyGrad",
+                    _lookup_req(ids) + b"\0" * (8 * DIM * 4))
+    finally:
+        ch.close()
+        server.close()
+
+
+@pytest.mark.needs_native
+def test_native_lookup_rejects_out_of_shard_ids():
+    from brpc_tpu import rpc
+
+    server = PsShardServer(VOCAB, DIM, 1, SHARDS, native_read=True)
+    ch = rpc.Channel(server.address)
+    try:
+        with pytest.raises(rpc.RpcError) as ei:
+            ch.call("Ps", "Lookup",
+                    _lookup_req(np.array([0], np.int32)))  # shard 0's row
+        assert "outside shard" in str(ei.value)
+        # malformed framing fails cleanly too (no native OOB read)
+        with pytest.raises(rpc.RpcError):
+            ch.call("Ps", "Lookup", struct.pack("<i", 99) + b"\x01\x02")
+    finally:
+        ch.close()
+        server.close()
+
+
+@pytest.mark.needs_native
+def test_install_publishes_new_generation_to_native_readers():
+    from brpc_tpu import rpc
+
+    server = PsShardServer(VOCAB, DIM, 0, 1, lr=1.0, native_read=True)
+    ch = rpc.Channel(server.address)
+    ids = np.array([3], np.int32)
+    try:
+        before = np.frombuffer(ch.call("Ps", "Lookup", _lookup_req(ids)),
+                               np.float32).copy()
+        grads = np.ones((1, DIM), np.float32)
+        ch.call("Ps", "ApplyGrad",
+                _lookup_req(ids) + grads.tobytes())  # Python write path
+        after = np.frombuffer(ch.call("Ps", "Lookup", _lookup_req(ids)),
+                              np.float32)
+        np.testing.assert_allclose(after, before - 1.0, atol=1e-6)
+        assert server._shard.generation == 1
+    finally:
+        ch.close()
+        server.close()
+
+
+# ---- torn-row stress: native reads race Python ApplyGrad installs ----
+
+def _row_deltas_are_whole(rows, init_rows):
+    d = rows - init_rows
+    return np.allclose(d.max(axis=-1), d.min(axis=-1), atol=1e-5)
+
+
+@pytest.mark.needs_native
+def test_native_read_no_torn_rows_under_write_race_racecheck_clean():
+    """call_async fan-outs of native Lookups racing Python ApplyGrad
+    generation installs: every served row is a whole snapshot, no update
+    is lost, and RACECHECK reports no lock held across a blocking call
+    on the serving path."""
+    from brpc_tpu import rpc
+    from brpc_tpu.analysis import race
+
+    vocab, dim = 64, 32
+    race.clear()
+    race.set_enabled(True)
+    try:
+        server = PsShardServer(vocab, dim, 0, 1, lr=0.25,
+                               native_read=True)
+        ch = rpc.Channel(server.address, timeout_ms=30000)
+        try:
+            init = server.table.copy()
+            all_ids = np.arange(vocab, dtype=np.int32)
+            grad = np.ones((vocab, dim), np.float32)
+            req_ids = _lookup_req(all_ids)
+            req_grad = req_ids + grad.tobytes()
+            rounds, lookups, applies = 25, 8, 2
+            for _ in range(rounds):
+                pending = [ch.call_async("Ps", "Lookup", req_ids)
+                           for _ in range(lookups)]
+                pending += [ch.call_async("Ps", "ApplyGrad", req_grad)
+                            for _ in range(applies)]
+                for i, call in enumerate(pending):
+                    rsp = call.join()
+                    if i < lookups:
+                        rows = np.frombuffer(rsp, np.float32).reshape(
+                            vocab, dim)
+                        assert _row_deltas_are_whole(rows, init)
+            # write lock lost no update: rounds x applies all-ones grads
+            # at lr=0.25 move every element by exactly -12.5, and the
+            # NATIVE read path serves the final generation
+            final = np.frombuffer(
+                ch.call("Ps", "Lookup", req_ids),
+                np.float32).reshape(vocab, dim)
+            np.testing.assert_allclose(final, init - 12.5, atol=1e-4)
+            assert server.native_lookups == rounds * lookups + 1
+        finally:
+            ch.close()
+            server.close()
+        blocked = [f for f in race.findings()
+                   if f.kind == "blocking-call" and "ps.shard" in f.locks]
+        assert blocked == [], race.report()
+    finally:
+        race.set_enabled(None)
+        race.clear()
+
+
+@pytest.mark.needs_native
+def test_remote_embedding_parity_native_vs_python_cluster():
+    nat = [PsShardServer(VOCAB, DIM, i, SHARDS, native_read=True)
+           for i in range(SHARDS)]
+    py = [PsShardServer(VOCAB, DIM, i, SHARDS) for i in range(SHARDS)]
+    emb_n = RemoteEmbedding([s.address for s in nat], VOCAB, DIM)
+    emb_p = RemoteEmbedding([s.address for s in py], VOCAB, DIM)
+    try:
+        rng = np.random.default_rng(7)
+        ids = rng.integers(0, VOCAB, size=(5, 6)).astype(np.int32)
+        np.testing.assert_array_equal(emb_n.lookup(ids), emb_p.lookup(ids))
+        grads = rng.standard_normal((5, 6, DIM)).astype(np.float32)
+        emb_n.apply_gradients(ids, grads)
+        emb_p.apply_gradients(ids, grads)
+        np.testing.assert_array_equal(emb_n.lookup(ids), emb_p.lookup(ids))
+        assert sum(s.native_lookups for s in nat) > 0
+    finally:
+        emb_n.close()
+        emb_p.close()
+        for s in nat + py:
+            s.close()
+
+
+# ---- call groups: exact multi-call fan-in ----
+
+@pytest.fixture
+def echo_server():
+    from brpc_tpu import rpc
+
+    srv = rpc.Server()
+    srv.add_service("Echo", lambda method, req: b"e:" + req)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000)
+    try:
+        yield srv, ch
+    finally:
+        fault.clear()
+        ch.close()
+        srv.close()
+
+
+@pytest.mark.needs_native
+def test_call_group_wait_all(echo_server):
+    from brpc_tpu import rpc
+
+    _, ch = echo_server
+    calls = [ch.call_async("Echo", "Hi", bytes([i])) for i in range(6)]
+    group = rpc.CallGroup()
+    for pc in calls:
+        group.add(pc)
+    assert group.wait(5.0)
+    assert group.completed == 6
+    # every join is now a non-blocking collection
+    assert [pc.join() for pc in calls] == \
+        [b"e:" + bytes([i]) for i in range(6)]
+    assert group.wait(0.0)  # level-triggered
+    group.close()
+
+
+@pytest.mark.needs_native
+def test_call_group_wait_any_consumes_one_per_completion(echo_server):
+    from brpc_tpu import rpc
+
+    _, ch = echo_server
+    calls = [ch.call_async("Echo", "Hi", b"x") for _ in range(3)]
+    group = rpc.CallGroup()
+    for pc in calls:
+        group.add(pc)
+    # exactly N successful wait_any returns for N calls
+    for _ in range(3):
+        assert group.wait_any(5.0)
+    assert not group.wait_any(0.05)  # all consumed -> times out
+    for pc in calls:
+        pc.join()
+    group.close()
+
+
+@pytest.mark.needs_native
+def test_call_group_completed_call_counts_immediately(echo_server):
+    from brpc_tpu import rpc
+
+    _, ch = echo_server
+    pc = ch.call_async("Echo", "Hi", b"y")
+    assert pc.wait(5.0)              # completes BEFORE registration
+    group = rpc.CallGroup()
+    group.add(pc)
+    assert group.wait(0.0)
+    assert group.wait_any(0.0)
+    pc.join()
+    group.close()
+
+
+@pytest.mark.needs_native
+def test_call_group_timeout_and_inflight_close(echo_server):
+    from brpc_tpu import rpc
+
+    _, ch = echo_server
+    fault.install(fault.FaultPlan([
+        fault.FaultRule(action="delay", side="server", service="Echo",
+                        delay_ms=300)]))
+    pc = ch.call_async("Echo", "Hi", b"z")
+    group = rpc.CallGroup()
+    group.add(pc)
+    assert not group.wait(0.02)       # times out while in flight
+    group.close()                     # safe with the call still pending
+    assert pc.join() == b"e:z"
+
+
+# ---- hedge exactness: group wait, not polling slices ----
+
+@pytest.mark.needs_native
+def test_backup_call_wakes_exactly_not_in_slices(echo_server):
+    """The hedge loop consumes at most one wakeup per attempt completion
+    (rpc_hedge_waits), independent of how long the slow primary takes —
+    the pre-group implementation polled brt_call_wait in 2ms slices,
+    which for a 400ms straggler would have been ~hundreds of waits."""
+    _, ch = echo_server
+    obs.set_enabled(True)
+    obs.reset_fabric_vars()
+    fault.install(fault.FaultPlan([
+        fault.FaultRule(action="delay", side="server", service="Echo",
+                        delay_ms=400, max_hits=1)]))
+    t0 = time.monotonic()
+    out = resilience.backup_call(ch, "Echo", "Hi", b"h", backup_ms=20)
+    dt_ms = (time.monotonic() - t0) * 1000
+    assert out == b"e:h"
+    assert dt_ms < 300                 # hedge bounded the latency
+    assert obs.counter("rpc_backup_fired").get_value() == 1
+    waits = obs.counter("rpc_hedge_waits").get_value()
+    assert 1 <= waits <= 2             # one per consumed completion
+    obs.reset_fabric_vars()
+    obs.set_enabled(False)
+
+
+@pytest.mark.needs_native
+def test_fan_out_uses_group_wait(echo_server):
+    """The unhedged PS fan-out collects by completion order over one
+    call group — rpc_group_waits moves, and results stay aligned."""
+    servers = [PsShardServer(VOCAB, DIM, i, SHARDS) for i in range(SHARDS)]
+    emb = RemoteEmbedding([s.address for s in servers], VOCAB, DIM)
+    obs.set_enabled(True)
+    obs.reset_fabric_vars()
+    try:
+        ids = np.arange(VOCAB, dtype=np.int32)  # touches every shard
+        out = emb.lookup(ids)
+        assert out.shape == (VOCAB, DIM)
+        assert obs.counter("rpc_group_waits").get_value() >= SHARDS
+    finally:
+        obs.reset_fabric_vars()
+        obs.set_enabled(False)
+        emb.close()
+        for s in servers:
+            s.close()
