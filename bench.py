@@ -57,13 +57,14 @@ def _run_device_child(mode: str, deadline_s: int) -> dict:
         return {"skipped": "device bench emitted no JSON"}
 
 
-def _run_json_child(script: str, label: str, deadline_s: int) -> dict:
+def _run_json_child(script: str, label: str, deadline_s: int,
+                    extra_args=()) -> dict:
     """Runs a python bench child that prints ONE JSON line (the
     bench_ps/bench_fault pattern: degrades itself to {"skipped": ...}
     without the native core; the deadline guards a wedged build/run)."""
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.join(ROOT, script)],
+            [sys.executable, os.path.join(ROOT, script), *extra_args],
             capture_output=True, text=True, timeout=deadline_s, cwd=ROOT,
         )
     except subprocess.TimeoutExpired:
@@ -84,7 +85,20 @@ def run_ps_bench(deadline_s: int = 420) -> dict:
     native_read block (zero-Python Lookup vs the Python rwlock path —
     its best-of-2 cells push the child past the old 300s budget on a
     noisy host)."""
-    return _run_json_child("bench_ps.py", "ps", deadline_s)
+    return _run_json_child("bench_ps.py", "ps", deadline_s,
+                           extra_args=("--block", "hot"))
+
+
+def run_ps_write_bench(deadline_s: int = 420) -> dict:
+    """PS write-path numbers (bench_ps.py --block write child): unary vs
+    combined vs streaming-push applied throughput at 1/4/8 writers on
+    one CPU shard, plus the device-shard wasted-scatter-launch cell with
+    and without the combiner.  Merges into the same BENCH_ps.json."""
+    out = _run_json_child("bench_ps.py", "ps_write", deadline_s,
+                          extra_args=("--block", "write"))
+    # the child's JSON carries every merged block; the ps_write section
+    # of the host line is just the write block
+    return out.get("write", out)
 
 
 def run_fault_bench(deadline_s: int = 300) -> dict:
@@ -237,6 +251,11 @@ def main() -> int:
         # by bench_ps.py in a child (also refreshes BENCH_ps.json).
         ps_block = run_ps_bench()
 
+        # PS write path (ISSUE 7): server-side gradient combiner +
+        # streaming push vs the unary write path (bench_ps.py --block
+        # write child; same BENCH_ps.json, "write" block).
+        ps_write_block = run_ps_write_bench()
+
         # Fault tolerance (ISSUE 5): backup requests + circuit breaker
         # under injected faults (bench_fault.py child).
         fault_block = run_fault_bench()
@@ -261,6 +280,7 @@ def main() -> int:
             "fiber_pingpong": pingpong,
             "tls": tls_stats,
             "ps": ps_block,
+            "ps_write": ps_write_block,
             "fault": fault_block,
             **device_blocks,
         }))

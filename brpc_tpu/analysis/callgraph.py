@@ -23,6 +23,13 @@ What gets resolved (edges carry the call site's path + line):
   ``x or Class()`` defaults) lets ``self.dev.stage()`` resolve to
   ``DeviceClient.stage``; an attr constructed as two different classes is
   ambiguous and stays unresolved (no false edges);
+- method calls on LOCAL variables bound directly to in-package
+  constructors (the same semantics, one scope down): ``x = Class();
+  x.meth()`` resolves, including ``x or Class()`` defaults and across
+  nested defs reading the enclosing scope; a local constructed as two
+  different classes is ambiguous and dropped, and calls on call results
+  (``x = factory(); x.meth()``) stay deferred — the factory's return
+  type is not tracked;
 - constructor calls (``rpc.Server()`` → ``Server.__init__``);
 - ``functools.partial`` targets: ``h = partial(worker, 1); h()``
   resolves to ``worker``, as does calling/constructing the partial
@@ -206,14 +213,21 @@ class CallGraph:
                             mi, item, qual_prefix=stmt.name + ".",
                             cls=stmt.name, into=ci.methods)
                 for node in ast.walk(stmt):
-                    if not isinstance(node, ast.Assign):
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif isinstance(node, ast.AnnAssign) and \
+                            node.value is not None:
+                        # self.<attr>: T = value — the annotated form of
+                        # the same binding
+                        targets, value = [node.target], node.value
+                    else:
                         continue
-                    for tgt in node.targets:
+                    for tgt in targets:
                         if isinstance(tgt, ast.Attribute) and \
                                 isinstance(tgt.value, ast.Name) and \
                                 tgt.value.id == "self":
                             ci.attr_assigns.setdefault(
-                                tgt.attr, []).append(node.value)
+                                tgt.attr, []).append(value)
             elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign,
                                    ast.For, ast.AsyncFor)):
                 targets = []
@@ -316,12 +330,21 @@ class CallGraph:
     def _class_of_value(self, value: ast.AST, mi: ModuleInfo
                         ) -> Optional[Tuple[ModuleInfo, str]]:
         """Class constructed by an assigned value: a direct ``Class(...)``
-        call, or an ``x or Class(...)`` default (the injectable-dependency
-        idiom).  None for anything else — parameters, call results and
+        call, an ``x or Class(...)`` default (the injectable-dependency
+        idiom), or a ``Class(...) if cond else None`` conditional (the
+        optional-subsystem idiom — a ``None`` arm neither helps nor
+        hurts).  None for anything else — parameters, call results and
         literals stay untyped (under-approximation)."""
         if isinstance(value, ast.BoolOp):
             hits: Dict[Tuple[str, str], Tuple[ModuleInfo, str]] = {}
             for v in value.values:
+                h = self._class_of_value(v, mi)
+                if h is not None:
+                    hits[(h[0].name, h[1])] = h
+            return next(iter(hits.values())) if len(hits) == 1 else None
+        if isinstance(value, ast.IfExp):
+            hits = {}
+            for v in (value.body, value.orelse):
                 h = self._class_of_value(v, mi)
                 if h is not None:
                     hits[(h[0].name, h[1])] = h
@@ -354,6 +377,45 @@ class CallGraph:
                 return target, rest[0]
             return None
         return None
+
+    def _local_constructor_types(
+            self, mi: ModuleInfo, body: Sequence[ast.AST]
+    ) -> Dict[str, Tuple["ModuleInfo", str]]:
+        """Attr-map semantics one scope down: locals of this scope bound
+        DIRECTLY to in-package constructors (``x = Class(...)``, incl.
+        ``x or Class()``).  Nested function/class/lambda bodies are their
+        own scopes and do not contribute; a name whose constructor
+        assignments disagree is ambiguous and dropped; non-constructor
+        assignments (call results, parameters, literals) neither help
+        nor hurt — the same polarity as the attr map."""
+        values: Dict[str, List[ast.expr]] = {}
+
+        def scan(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # inner scope: its assignments are not our locals
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                values.setdefault(node.targets[0].id, []).append(node.value)
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name) and \
+                    node.value is not None:
+                values.setdefault(node.target.id, []).append(node.value)
+            for child in ast.iter_child_nodes(node):
+                scan(child)
+
+        for stmt in body:
+            scan(stmt)
+        out: Dict[str, Tuple["ModuleInfo", str]] = {}
+        for name, vals in values.items():
+            hits: Dict[Tuple[str, str], Tuple["ModuleInfo", str]] = {}
+            for v in vals:
+                h = self._class_of_value(v, mi)
+                if h is not None:
+                    hits[(h[0].name, h[1])] = h
+            if len(hits) == 1:
+                out[name] = next(iter(hits.values()))
+        return out
 
     def _build_attr_types(self) -> None:
         """Resolve every class's ``self.<attr> = Class(...)`` assignments
@@ -427,10 +489,14 @@ class CallGraph:
         return None
 
     def resolve_callable_expr(self, expr: ast.AST, ctx: FuncNode,
-                              local_partials: Optional[Dict[str, str]] = None
+                              local_partials: Optional[Dict[str, str]] = None,
+                              local_types: Optional[Dict[str, Tuple[
+                                  "ModuleInfo", str]]] = None
                               ) -> Optional[str]:
         """Resolve an expression in callable position (or passed as a
-        callback) to a node id; None when it lands outside the graph."""
+        callback) to a node id; None when it lands outside the graph.
+        ``local_types`` is the scope's constructor-bound-local map (see
+        :meth:`_local_constructor_types`)."""
         if isinstance(expr, ast.Name):
             return self._resolve_name(expr.id, ctx, local_partials)
         if isinstance(expr, ast.Attribute):
@@ -447,6 +513,14 @@ class CallGraph:
                     (ctx.module, ctx.cls, expr.value.attr))
                 if held is not None:
                     return self._method(held[0], held[1], expr.attr)
+            if isinstance(expr.value, ast.Name) and local_types and \
+                    expr.value.id in local_types:
+                # x.<meth> on a constructor-bound local.  A typed local
+                # SHADOWS any module alias of the same name, so a miss
+                # stays unresolved rather than falling through to a
+                # (false) module-level resolution.
+                held = local_types[expr.value.id]
+                return self._method(held[0], held[1], expr.attr)
             chain = _dotted_chain(expr)
             if chain is not None:
                 return self._resolve_dotted(chain, ctx)
@@ -455,7 +529,7 @@ class CallGraph:
                 _last_name(expr.func) == "partial" and expr.args:
             # partial(f, ...) called or passed directly
             return self.resolve_callable_expr(expr.args[0], ctx,
-                                              local_partials)
+                                              local_partials, local_types)
         return None
 
     # -- edge extraction ---------------------------------------------------
@@ -486,7 +560,15 @@ class CallGraph:
                                 mi.partial_aliases[t.id] = tgt
 
     def _extract_scope(self, mi: ModuleInfo, body: Sequence[ast.AST],
-                       ctx: FuncNode, local_partials: Dict[str, str]) -> None:
+                       ctx: FuncNode, local_partials: Dict[str, str],
+                       outer_types: Optional[Dict[str, Tuple[
+                           "ModuleInfo", str]]] = None) -> None:
+        # Constructor-bound locals of THIS scope, over a copy of the
+        # enclosing scope's map (closures read outer locals; inner
+        # bindings shadow).
+        local_types = dict(outer_types or {})
+        local_types.update(self._local_constructor_types(mi, body))
+
         def visit(node: ast.AST) -> None:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 inner_id = self._by_ast.get(id(node))
@@ -495,7 +577,7 @@ class CallGraph:
                     visit(dec)  # decorators evaluate in the OUTER scope
                 if inner is not None:
                     self._extract_scope(mi, node.body, inner,
-                                        dict(local_partials))
+                                        dict(local_partials), local_types)
                 return
             if isinstance(node, ast.ClassDef):
                 for item in node.body:
@@ -506,7 +588,8 @@ class CallGraph:
                     _last_name(node.value.func) == "partial" and \
                     node.value.args:
                 tgt = self.resolve_callable_expr(node.value.args[0], ctx,
-                                                 local_partials)
+                                                 local_partials,
+                                                 local_types)
                 if tgt is not None:
                     for t in node.targets:
                         if isinstance(t, ast.Name):
@@ -514,13 +597,15 @@ class CallGraph:
                     self._add_edge(ctx, tgt, node.lineno, node.value)
             if isinstance(node, ast.Call):
                 tgt = self.resolve_callable_expr(node.func, ctx,
-                                                 local_partials)
+                                                 local_partials,
+                                                 local_types)
                 if tgt is None and _last_name(node.func) == "partial" and \
                         node.args:
                     # bare partial construction: edge to the target (the
                     # partial exists to be called, often out of our sight)
                     tgt = self.resolve_callable_expr(node.args[0], ctx,
-                                                     local_partials)
+                                                     local_partials,
+                                                     local_types)
                 if tgt is not None:
                     self._add_edge(ctx, tgt, node.lineno, node)
             for child in ast.iter_child_nodes(node):

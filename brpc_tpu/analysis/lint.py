@@ -37,6 +37,10 @@ the calls, not the file):
   under trace are a separate hazard class: they DON'T vanish — they
   stage a host round-trip into every step — and must be allowlisted
   per-site with ``# lint: allow-host-callback`` when intended.
+  DELIBERATE trace-time effects (e.g. counters of programs built) are
+  declared with ``# lint: allow-trace-impure`` on the call line or on
+  the helper's ``def`` line — the walk neither flags nor descends
+  there.
 - ``lock-order`` — the static half of the RACECHECK harness: derives
   the ``with <checked_lock>`` nesting graph over the call graph and
   reports inversion cycles without running anything; the dynamic
@@ -110,6 +114,13 @@ _TIME_FNS = {"time", "time_ns", "monotonic", "monotonic_ns",
 _HOST_CALLBACKS = {"pure_callback", "io_callback"}
 #: per-site pragma that allowlists a host callback under trace
 _ALLOW_HOST_CB = "lint: allow-host-callback"
+#: pragma declaring DELIBERATE trace-time impurity: on a call line, the
+#: call is neither flagged nor followed from traced roots; on a `def`
+#: line, traced walks never descend into that function (the canonical
+#: use: trace-time instrumentation like collective program counters,
+#: which by design runs once per trace and must not be reported as a
+#: vanishing side effect)
+_ALLOW_TRACE_IMPURE = "lint: allow-trace-impure"
 
 
 def _stable_path(path: str) -> str:
@@ -393,7 +404,10 @@ def _check_cfunctype_pinning(sc: _FileScan) -> List[Finding]:
     # 2) named callbacks passed to the native core but never pinned.
     #    Callbacks are attributed to the scope that DIRECTLY defines them;
     #    pinning/passing is searched through that whole scope subtree.
-    scopes: List[ast.AST] = [sc.tree] + [
+    #    MODULE-scope callbacks are exempt: a module-level name is held by
+    #    the module namespace for the life of the process — it cannot be
+    #    GC'd under the native core (only function locals can).
+    scopes: List[ast.AST] = [
         n for n in ast.walk(sc.tree)
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
     for scope in scopes:
@@ -402,6 +416,12 @@ def _check_cfunctype_pinning(sc: _FileScan) -> List[Finding]:
             continue
         passed_to_native: Dict[str, int] = {}
         pinned: Set[str] = set()
+        # `global X; X = cb` pins on the module namespace — as immortal
+        # as self.<attr> on a long-lived owner.
+        declared_global: Set[str] = set()
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
         for node in ast.walk(scope):
             if isinstance(node, ast.Call):
                 fn_last = _last_name(node.func)
@@ -419,6 +439,9 @@ def _check_cfunctype_pinning(sc: _FileScan) -> List[Finding]:
                     node.value.id in callbacks:
                 for tgt in node.targets:
                     if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        pinned.add(node.value.id)
+                    elif isinstance(tgt, ast.Name) and \
+                            tgt.id in declared_global:
                         pinned.add(node.value.id)
         for name, line in sorted(passed_to_native.items()):
             if name not in pinned:
@@ -580,7 +603,14 @@ def _scan_shared_state(sc: _FileScan, graph: CallGraph, node: FuncNode,
                             n.args[0].id in mod_state:
                         mutation(n, f"module state '{n.args[0].id}'",
                                  in_read)
-                elif f.attr in _MUTATORS and not _is_tls_path(f.value):
+                elif f.attr in _MUTATORS and not _is_tls_path(f.value) \
+                        and graph.call_target(n) is None:
+                    # A receiver whose method RESOLVES in the call graph
+                    # (attr-type/local-type map) is not a raw container:
+                    # the interprocedural walk below analyzes the callee's
+                    # body — its own mutations get checked against its own
+                    # locking, so the heuristic must not double-report
+                    # (e.g. an internally-synchronized combiner's .add()).
                     if node.cls is not None and _is_self_rooted(f.value):
                         if not fresh_self:
                             mutation(n, f"{_describe(f.value)} "
@@ -860,6 +890,8 @@ def _walk_traced(root_sc: _FileScan, root_fn: ast.AST, root_name: str,
                                f"'{_describe(item.context_expr)}'")
             if not isinstance(node, ast.Call):
                 continue
+            if sc.line_has(node.lineno, _ALLOW_TRACE_IMPURE):
+                continue  # declared deliberate trace-time effect
             cb = _host_callback_desc(node)
             if cb is not None and not sc.line_has(node.lineno,
                                                  _ALLOW_HOST_CB):
@@ -888,6 +920,10 @@ def _walk_traced(root_sc: _FileScan, root_fn: ast.AST, root_name: str,
                 if callee is None or callee.qual == "<module>":
                     continue
                 callee_sc = sc_by_path.get(callee.path)
+                if callee_sc is not None and callee_sc.line_has(
+                        getattr(callee.fn, "lineno", 0),
+                        _ALLOW_TRACE_IMPURE):
+                    continue  # def-level: deliberate trace-time function
                 if callee_sc is not None and id(callee.fn) not in scanned:
                     stack.append((callee.fn, callee_sc,
                                   _node_display(callee),

@@ -21,6 +21,14 @@ Hook points (both no-ops when no plan is installed — one module-global
   :func:`client_intercept` — ``error`` raises before the wire,
   ``delay`` stalls the caller, ``drop`` burns the call's timeout budget
   and raises ERPCTIMEDOUT (a lost request seen from the client).
+- **native pre-dispatch hook** (``brt_set_drop_hook``, installed by
+  :func:`install` when a plan carries SERVER-side ``drop`` rules):
+  :func:`server_drop_intercept` runs inside the native request path
+  after the meta is parsed but before dispatch — a firing rule discards
+  the request silently, NO response is ever written, and the client
+  exercises its REAL timeout machinery (native deadline timer, retry
+  budget, hedging), unlike the client-side ``drop`` which simulates the
+  cost without touching the wire.  Needs the native core.
 
 Rules (programmatic or ``BRPC_TPU_FAULTS`` env, JSON list)::
 
@@ -49,8 +57,8 @@ from brpc_tpu.resilience import _hash01, sleep_ms
 
 __all__ = [
     "FaultRule", "FaultPlan", "install", "install_from_env", "clear",
-    "current", "active", "server_intercept", "client_intercept",
-    "FAULTS_ENV",
+    "current", "active", "server_intercept", "server_drop_intercept",
+    "client_intercept", "FAULTS_ENV",
 ]
 
 FAULTS_ENV = "BRPC_TPU_FAULTS"
@@ -63,8 +71,10 @@ _SIDES = ("server", "client")
 class FaultRule:
     """One injection rule.  ``action``: ``error`` (respond/raise
     ``error_code``/``error_text``), ``delay`` (sleep ``delay_ms`` then
-    proceed), ``drop`` (client-side only: consume the call's timeout and
-    raise ERPCTIMEDOUT)."""
+    proceed), ``drop`` — client-side: consume the call's timeout and
+    raise ERPCTIMEDOUT; server-side: the native pre-dispatch hook
+    discards the parsed request silently (no response — the client's
+    real timeout machinery runs)."""
 
     action: str
     side: str = "server"
@@ -87,11 +97,9 @@ class FaultRule:
         if self.side not in _SIDES:
             raise ValueError(f"unknown fault side {self.side!r}; "
                              f"valid: {', '.join(_SIDES)}")
-        if self.action == "drop" and self.side != "client":
-            # A server cannot "drop" cleanly: the session must respond
-            # exactly once.  Model loss where it is observed — at the
-            # client, as a burned timeout.
-            raise ValueError("drop rules are client-side only")
+        # Server-side drop rules fire in the NATIVE pre-dispatch hook
+        # (the session never exists, so "respond exactly once" is moot);
+        # client-side drop burns the caller's timeout budget locally.
 
     def matches(self, side: str, service: str, method: str,
                 endpoint: Optional[str]) -> bool:
@@ -135,13 +143,28 @@ class FaultPlan:
             "rules": [dataclasses.asdict(r) for r in self.rules],
         })
 
+    def has_server_drop_rules(self) -> bool:
+        """True when any rule needs the native pre-dispatch drop hook."""
+        return any(r.side == "server" and r.action == "drop"
+                   for r in self.rules)
+
     def decide(self, side: str, service: str, method: str,
-               endpoint: Optional[str] = None) -> Optional[FaultRule]:
+               endpoint: Optional[str] = None,
+               actions: Optional[Tuple[str, ...]] = None
+               ) -> Optional[FaultRule]:
         """The first rule that matches AND fires for this call (counters
-        advance for every matching rule either way)."""
+        advance for every matching rule either way).  ``actions`` filters
+        which rules this decision point CONSIDERS — rules outside it are
+        skipped entirely, counters untouched: server-side ``drop`` rules
+        are decided by the native pre-dispatch hook (which sees every
+        request), ``error``/``delay`` by the trampoline (which never sees
+        a dropped request), and the two decision points must not consume
+        each other's hit sequence."""
         fired: Optional[FaultRule] = None
         with self._mu:
             for i, rule in enumerate(self.rules):
+                if actions is not None and rule.action not in actions:
+                    continue
                 if not rule.matches(side, service, method, endpoint):
                     continue
                 seq = self._seen[i]
@@ -179,6 +202,13 @@ def active() -> bool:
 
 def install(plan: Optional[FaultPlan]) -> None:
     global _plan
+    if plan is not None and plan.has_server_drop_rules():
+        # Server-side drop needs the native pre-dispatch hook (raises
+        # NativeCoreUnavailable without the toolchain/.so).  The hook
+        # stays installed after clear() — it gates on active() and costs
+        # one atomic load when no plan is live.
+        from brpc_tpu import rpc
+        rpc.install_drop_hook()
     _plan = plan
 
 
@@ -218,7 +248,11 @@ def server_intercept(service: str, method: str,
     plan = _plan
     if plan is None:
         return
-    rule = plan.decide("server", service, method, endpoint)
+    # drop rules belong to the native pre-dispatch hook: a dropped
+    # request never reaches this trampoline, so considering them here
+    # would double-consume their hit sequence.
+    rule = plan.decide("server", service, method, endpoint,
+                       actions=("error", "delay"))
     if rule is None:
         return
     if rule.action == "delay":
@@ -229,6 +263,25 @@ def server_intercept(service: str, method: str,
     if obs.enabled():
         obs.counter("fault_injected_errors").add(1)
     raise _injected_error(rule)
+
+
+def server_drop_intercept(service: str, method: str,
+                          endpoint: Optional[str] = None) -> bool:
+    """Called by the NATIVE pre-dispatch hook (``brt_set_drop_hook`` →
+    ``rpc.install_drop_hook``) for every parsed request.  True = discard
+    the request silently (no response; the client's real timeout path
+    runs).  Only server-side ``drop`` rules are considered — their hit
+    counters advance here, pre-dispatch, where every request is seen."""
+    plan = _plan
+    if plan is None:
+        return False
+    rule = plan.decide("server", service, method, endpoint,
+                       actions=("drop",))
+    if rule is None:
+        return False
+    if obs.enabled():
+        obs.counter("fault_injected_drops").add(1)
+    return True
 
 
 def client_intercept(service: str, method: str, endpoint: str,

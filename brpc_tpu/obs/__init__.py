@@ -62,7 +62,8 @@ __all__ = [
     "Span", "SpanRing", "default_ring", "dump_rpcz", "format_rpcz",
     "record_span", "span",
     # gate + cached fabric helpers
-    "enabled", "set_enabled", "recorder", "counter", "reset_fabric_vars",
+    "enabled", "set_enabled", "recorder", "counter", "maxer",
+    "reset_fabric_vars",
 ]
 
 _enabled = os.environ.get("BRPC_TPU_OBS", "1") not in ("0", "false", "off")
@@ -85,6 +86,7 @@ def set_enabled(on: bool) -> None:
 _fabric_mu = checked_lock("obs.fabric")
 _recorders: Dict[str, LatencyRecorder] = {}
 _counters: Dict[str, Adder] = {}
+_maxers: Dict[str, Maxer] = {}
 
 
 def recorder(name: str, window_size: int = 10) -> LatencyRecorder:
@@ -113,11 +115,26 @@ def counter(name: str) -> Adder:
     return c
 
 
+def maxer(name: str) -> Maxer:
+    """The process-wide Maxer exposed under ``name`` (high-water marks:
+    combine-queue depth, window occupancy)."""
+    m = _maxers.get(name)
+    if m is None:
+        with _fabric_mu:
+            m = _maxers.get(name)
+            if m is None:
+                m = Maxer()
+                m.expose(name)
+                _maxers[name] = m
+    return m
+
+
 def reset_fabric_vars() -> None:
     """Drop all cached fabric recorders/counters and their registry
     entries (test isolation)."""
     with _fabric_mu:
-        for name in list(_recorders) + list(_counters):
+        for name in list(_recorders) + list(_counters) + list(_maxers):
             default_registry().hide(name)
         _recorders.clear()
         _counters.clear()
+        _maxers.clear()

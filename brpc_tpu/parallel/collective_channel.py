@@ -30,13 +30,14 @@ from brpc_tpu._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _record_collective(op: str, x) -> None:
+def _record_collective(op: str, x) -> None:  # lint: allow-trace-impure
     """Per-collective call + byte counters (``collective_<op>_calls`` /
     ``collective_<op>_bytes``).  These fire when the python method runs:
     eagerly that is once per collective; under ``jax.jit`` it is once per
     trace — i.e. they count collective *programs* built, the compile-side
     view of ICI traffic (sizes still come from the abstract value, which
-    tracers carry)."""
+    tracers carry).  The pragma declares exactly that intent to the
+    ``trace-purity`` check: running once at trace time IS the design."""
     if not obs.enabled():
         return
     obs.counter(f"collective_{op}_calls").add(1)

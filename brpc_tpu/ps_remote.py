@@ -10,13 +10,17 @@ partition_channel.*) and runs Lookup / ApplyGrad calls. The intra-pod tier
 
 Wire format (little-endian): Lookup req = int32 count ++ int32 ids;
 rsp = float32 rows [count, dim]. ApplyGrad req = int32 count ++ int32 ids
-++ float32 grads [count, dim]; rsp = empty.
+++ float32 grads [count, dim]; rsp = empty.  The streaming push
+(``StreamApply``) reuses the ApplyGrad framing: the setup RPC carries an
+empty request and every stream FRAME is one framed delta — no per-frame
+response; application order/completion ride the stream close.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import struct
+import threading
 import time
 from typing import List, Optional, Sequence
 
@@ -79,6 +83,140 @@ def _pack_apply_req(owned: np.ndarray, grads: np.ndarray) -> bytearray:
     return req
 
 
+def _unpack_apply(payload: bytes, base: int, rows_per: int, dim: int):
+    """Parse + validate one ApplyGrad-framed delta (unary request body or
+    stream frame): returns ``(local_ids, grads[count, dim])``.  Raises
+    ``ValueError`` on out-of-range ids BEFORE anything is enqueued, so a
+    bad contribution can never poison a combined batch."""
+    (count,) = struct.unpack_from("<i", payload, 0)
+    ids = np.frombuffer(payload, np.int32, count, 4) - base
+    if ids.size and (ids.min() < 0 or ids.max() >= rows_per):
+        raise ValueError(
+            f"ids outside shard [{base}, {base + rows_per}) "
+            f"for shard base {base}")
+    grads = np.frombuffer(payload, np.float32, count * dim, 4 + 4 * count)
+    return ids, grads.reshape(count, dim)
+
+
+class GradCombiner:
+    """Per-shard server-side write combiner (the execution-queue
+    write-combining shape, cpp/fiber/execution_queue.h, applied to
+    gradient application).
+
+    ApplyGrad contributions ENQUEUE here instead of applying
+    individually; whoever finds the combiner idle becomes the LEADER and
+    drains every pending contribution into ONE concatenated application
+    per drained batch — ``apply_fn`` runs once per batch, so write-lock
+    hold time, snapshot installs (CPU shard) and scatter launches (device
+    shard) are paid per BATCH, not per request.  Duplicate-id
+    contributions sum exactly: both ``np.subtract.at`` and the device
+    scatter (``unique_indices = false``) accumulate repeated indices, so
+    concatenation IS the combine — commutative, order-independent up to
+    float addition order.
+
+    ``add(wait=True)`` (unary handlers) blocks until the caller's batch
+    is applied and re-raises the batch's failure; ``add(wait=False)``
+    (stream frames — no per-frame response exists) returns immediately,
+    and :meth:`flush` provides the "everything before this point is
+    applied" barrier by riding the queue as an empty contribution.
+    Followers never lead and the leader never waits on followers, so
+    there is no circular wait even on a single worker."""
+
+    __slots__ = ("_apply", "_dim", "_mu", "_q", "_draining", "last_error")
+
+    def __init__(self, apply_fn, dim: int):
+        self._apply = apply_fn          # apply_fn(local_ids, grads): ONE
+        self._dim = dim                 # combined application
+        self._mu = checked_lock("ps.combine")
+        self._q: list = []
+        self._draining = False
+        self.last_error: Optional[BaseException] = None
+
+    def add(self, ids: np.ndarray, grads: np.ndarray,
+            wait: bool = True) -> None:
+        # [ids, grads, done-event, error] — error is filled by whichever
+        # leader applies the batch this entry lands in.
+        entry = [ids, grads, threading.Event() if wait else None, None]
+        with self._mu:
+            self._q.append(entry)
+            leader = not self._draining
+            if leader:
+                self._draining = True
+        if not leader:
+            ev = entry[2]
+            if ev is not None:
+                ev.wait()
+                if entry[3] is not None:
+                    raise entry[3]
+            return
+        self._drain()
+        if entry[3] is not None:
+            raise entry[3]
+
+    def _drain(self) -> None:
+        """Leader loop: drain batches until the queue is empty (entries
+        enqueued while a batch applies land in the next one)."""
+        while True:
+            with self._mu:
+                batch = self._q
+                if not batch:
+                    self._draining = False
+                    return
+                self._q = []
+            err: Optional[BaseException] = None
+            try:
+                if len(batch) == 1:
+                    ids, grads = batch[0][0], batch[0][1]
+                else:
+                    ids = np.concatenate([e[0] for e in batch])
+                    grads = np.concatenate([e[1] for e in batch])
+                if ids.size:
+                    self._apply(ids, grads)
+                    if obs.enabled():
+                        obs.counter("ps_combined_applies").add(1)
+                        obs.counter("ps_combined_keys").add(int(ids.size))
+                        obs.maxer("ps_combine_depth").update(len(batch))
+            except Exception as e:  # noqa: BLE001 — delivered per entry
+                err = e
+                with self._mu:
+                    self.last_error = e
+                if obs.enabled():
+                    obs.counter("ps_combine_errors").add(1)
+            for e_ in batch:
+                e_[3] = err
+                if e_[2] is not None:
+                    e_[2].set()
+
+    def flush(self) -> None:
+        """Returns once every contribution enqueued BEFORE this call has
+        been applied (the stream-close barrier).  Raises the failure of
+        the flush batch, if any."""
+        self.add(np.empty(0, np.int32),
+                 np.empty((0, self._dim), np.float32), wait=True)
+
+
+class _ApplyStreamReceiver:
+    """Server half of the streaming gradient push: each frame is one
+    ApplyGrad-framed delta fed straight into the shard's combiner (no
+    per-frame response).  Runs serialized on the stream's native
+    delivery fiber — a combiner drain happening here delays the
+    consumed-bytes feedback, which is exactly how server-side apply cost
+    back-pressures the pushing trainer.  ``on_closed`` flushes the
+    combiner BEFORE the server's half closes, so a client's
+    ``close(); join()`` is an "every pushed delta is applied" barrier."""
+
+    __slots__ = ("_server",)
+
+    def __init__(self, server):
+        self._server = server
+
+    def on_data(self, data: bytes) -> None:
+        self._server._apply_frame(data)
+
+    def on_closed(self) -> None:
+        self._server._combiner.flush()
+
+
 class PsShardServer:
     """One embedding shard behind a native RPC server.
 
@@ -93,11 +231,29 @@ class PsShardServer:
     server-side fault injection and obs hooks live in the Python
     trampoline, so with ``native_read`` they apply to the write path
     only — the reference's position (SURVEY §3.1) is that the read hot
-    path IS the native handler."""
+    path IS the native handler.
+
+    Write-path scale (the read path's mirror image):
+
+    - ``combine=True`` routes unary ApplyGrad through a
+      :class:`GradCombiner` — concurrent writers' grads coalesce and the
+      write lock / snapshot install is paid once per DRAINED BATCH
+      instead of once per request (the dominant unary cost under
+      ``native_read``, where every apply memcpy's the whole table).
+    - ``stream=True`` additionally serves ``StreamApply``: a client
+      opens an ordered flow-controlled stream (``Channel.stream`` /
+      ``RemoteEmbedding.push_gradients``) and ships framed deltas at
+      wire rate, no per-call dispatch; frames feed the combiner
+      directly and the client's ``close(); join()`` barrier guarantees
+      application.  Because the combiner sums duplicate ids exactly and
+      float addition is commutative here, unary / combined / streamed
+      orderings land byte-identical tables for exactly-representable
+      gradients (proven in tests/test_ps_stream.py)."""
 
     def __init__(self, vocab: int, dim: int, shard_index: int,
                  num_shards: int, lr: float = 0.1, seed: int = 0,
-                 lock_mode: str = "rw", native_read: bool = False):
+                 lock_mode: str = "rw", native_read: bool = False,
+                 combine: bool = False, stream: bool = False):
         if vocab % num_shards:
             raise ValueError("num_shards must divide vocab")
         self.shard_index = shard_index
@@ -122,13 +278,26 @@ class PsShardServer:
         else:
             raise ValueError(f"unknown lock_mode {lock_mode!r}")
         self.native_read = bool(native_read)
+        self.combine = bool(combine)
+        self.stream = bool(stream)
         self._shard: "Optional[rpc.PsShard]" = None
         self._install_gen = 0
+        # The combiner exists whenever anything feeds it: unary combining
+        # (combine) or streamed deltas (stream — frames ALWAYS combine,
+        # they have no per-frame response to serialize on).
+        self._combiner: Optional[GradCombiner] = (
+            GradCombiner(self._apply_batch, dim)
+            if (self.combine or self.stream) else None)
         self.server = rpc.Server()
         if self.native_read:
             self._shard = rpc.PsShard(vocab, dim, shard_index, num_shards)
             self._shard.install(self.table, 0)
-            self.server.add_ps_service("Ps", self._shard, self._handle)
+            self.server.add_ps_service(
+                "Ps", self._shard,
+                self._handle_stream if self.stream else self._handle,
+                stream=self.stream)
+        elif self.stream:
+            self.server.add_stream_handler("Ps", self._handle_stream)
         else:
             self.server.add_service("Ps", self._handle)
         # `_status` rides along so the health-check prober can revive
@@ -150,6 +319,37 @@ class PsShardServer:
                           len(rsp), t0)
         return rsp
 
+    def _handle_stream(self, method: str, payload: bytes, accept) -> bytes:
+        """Stream-capable trampoline target: ``StreamApply`` binds the
+        client's push stream to this shard's combiner; everything else is
+        the plain :meth:`_handle` contract."""
+        if method == "StreamApply":
+            accept(_ApplyStreamReceiver(self))
+            return b""
+        return self._handle(method, payload)
+
+    def _apply_frame(self, payload: bytes) -> None:
+        """One streamed delta: parse/validate, enqueue without waiting
+        (frames have no response; the close barrier flushes)."""
+        t0 = time.monotonic_ns() if obs.enabled() else 0
+        ids, grads = _unpack_apply(payload, self.base, self.rows_per,
+                                   self.dim)
+        self._combiner.add(ids, grads, wait=False)
+        if t0:
+            _record_ps_server(self.shard_index, "StreamApply",
+                              int(ids.size), len(payload), 0, t0)
+
+    def _apply_batch(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        """ONE combined application for a drained batch: a single
+        unbuffered ``subtract.at`` (duplicate ids sum exactly) and — under
+        ``native_read`` — a single snapshot install, regardless of how
+        many requests combined into the batch."""
+        with self._mu.write():
+            np.subtract.at(self.table, ids, self.lr * grads)
+            if self._shard is not None:
+                self._install_gen += 1
+                self._shard.install(self.table, self._install_gen)
+
     def _serve(self, method: str, payload: bytes) -> bytes:
         (count,) = struct.unpack_from("<i", payload, 0)
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
@@ -165,6 +365,12 @@ class PsShardServer:
         if method == "ApplyGrad":
             grads = np.frombuffer(payload, np.float32,
                                   count * self.dim, 4 + 4 * count)
+            if self.combine:
+                # Combined write path: enqueue and wait for the batch —
+                # the combiner's leader applies once per drained batch.
+                self._combiner.add(ids,
+                                   grads.reshape(count, self.dim))
+                return b""
             with self._mu.write():
                 np.subtract.at(self.table, ids,
                                self.lr * grads.reshape(count, self.dim))
@@ -231,12 +437,25 @@ class DevicePsShardServer:
     Lookups overlap ApplyGrads and each other; no lock is ever held
     across a blocking ``brt_device_*`` call (RACECHECK-clean by
     construction).
+
+    The optimistic install has a cost under write FAN-IN: k racing
+    writers scatter k candidate tables but only one installs — the rest
+    discard whole scatter outputs and redo (``ps_device_wasted_launches``
+    counts them; ~linear in writers).  ``combine=True`` routes ApplyGrad
+    through a :class:`GradCombiner` instead: racing writers coalesce and
+    the leader launches ONE scatter per drained batch (the device
+    scatter sums duplicate ids — ``unique_indices = false``), so wasted
+    launches drop to at most one per batch (only a Lookup-free
+    concurrent installer could still race, and appliers all ride the
+    combiner).  ``stream=True`` serves ``StreamApply`` into the same
+    combiner.
     """
 
     def __init__(self, vocab: int, dim: int, shard_index: int,
                  num_shards: int, lr: float = 0.1, seed: int = 0,
                  device_client: "rpc.DeviceClient | None" = None,
-                 device_index: int = 0):
+                 device_index: int = 0, combine: bool = False,
+                 stream: bool = False):
         if vocab % num_shards:
             raise ValueError("num_shards must divide vocab")
         self.shard_index = shard_index
@@ -264,8 +483,16 @@ class DevicePsShardServer:
         # Guards the executable caches; held across the (cold, per-bucket)
         # compile but never across execute/fetch.
         self._exe_mu = checked_lock("ps.device_shard.exe")
+        self.combine = bool(combine)
+        self.stream = bool(stream)
+        self._combiner: Optional[GradCombiner] = (
+            GradCombiner(self._apply_batch, dim)
+            if (self.combine or self.stream) else None)
         self.server = rpc.Server()
-        self.server.add_service("Ps", self._handle)
+        if self.stream:
+            self.server.add_stream_handler("Ps", self._handle_stream)
+        else:
+            self.server.add_service("Ps", self._handle)
         self.server.add_status_service()
         self.port = self.server.start("127.0.0.1:0")
 
@@ -341,6 +568,41 @@ class DevicePsShardServer:
                           len(rsp), t0)
         return rsp
 
+    def _handle_stream(self, method: str, payload: bytes, accept) -> bytes:
+        if method == "StreamApply":
+            accept(_ApplyStreamReceiver(self))
+            return b""
+        return self._handle(method, payload)
+
+    def _apply_frame(self, payload: bytes) -> None:
+        t0 = time.monotonic_ns() if obs.enabled() else 0
+        ids, grads = _unpack_apply(payload, self.base, self.rows_per,
+                                   self.dim)
+        self._combiner.add(ids, grads, wait=False)
+        if t0:
+            _record_ps_server(self.shard_index, "StreamApply",
+                              int(ids.size), len(payload), 0, t0)
+
+    def _apply_batch(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        """ONE combined scatter launch + install for a drained batch:
+        the on-chip scatter sums duplicate ids, so the concatenated
+        batch applies exactly; padding ids hit row 0 with zero grads
+        (a no-op, same trick as the unary path)."""
+        bucket = self._bucket(int(ids.size))
+        padded_ids = np.zeros(bucket, np.int32)
+        padded_ids[:ids.size] = ids
+        padded_g = np.zeros((bucket, self.dim), np.float32)
+        padded_g[:ids.size] = grads
+        ids_h = self.dev.stage(padded_ids, self.device_index)
+        try:
+            g_h = self.dev.stage(padded_g, self.device_index)
+            try:
+                self._apply_grad(bucket, ids_h, g_h)
+            finally:
+                self.dev.release(g_h)
+        finally:
+            self.dev.release(ids_h)
+
     def _serve(self, method: str, payload: bytes) -> bytes:
         (count,) = struct.unpack_from("<i", payload, 0)
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
@@ -349,6 +611,13 @@ class DevicePsShardServer:
                 f"ids outside shard [{self.base}, "
                 f"{self.base + self.rows_per}) for shard base {self.base}"
             )
+        if method == "ApplyGrad" and self.combine:
+            # Combined write path: no per-request staging/launch — the
+            # combiner's leader stages and launches once per batch.
+            grads = np.frombuffer(payload, np.float32, count * self.dim,
+                                  4 + 4 * count).reshape(count, self.dim)
+            self._combiner.add(ids, grads)
+            return b""
         bucket = self._bucket(count)
         padded_ids = np.zeros(bucket, np.int32)
         padded_ids[:count] = ids
@@ -411,7 +680,11 @@ class DevicePsShardServer:
             # Install race lost: a concurrent ApplyGrad swapped first and
             # our output was computed against a stale table.  Discard it
             # and redo against the new current generation — the winner
-            # already made progress, so this terminates.
+            # already made progress, so this terminates.  Each discard is
+            # a whole wasted scatter launch; the combiner exists to make
+            # this counter stop scaling with write fan-in.
+            if obs.enabled():
+                obs.counter("ps_device_wasted_launches").add(1)
             self.dev.release(new_table)
 
     def close(self):
@@ -455,7 +728,15 @@ class RemoteEmbedding:
     - On a non-retriable partial failure the batch abandons its
       straggler shards: still-pending calls are CANCELLED (native
       ``StartCancel``) before being reaped, so the error surfaces at
-      max(shard) latency, not sum."""
+      max(shard) latency, not sum.
+
+    The WRITE path additionally has a streaming mode:
+    :meth:`push_gradients` ships framed deltas over one persistent
+    ordered flow-controlled stream per owner shard (feeding the server's
+    gradient combiner directly — no per-call dispatch), with
+    :meth:`flush_gradients` as the applied-everything barrier and
+    reconnect-under-the-retry-budget on stream breakage.  The unary
+    :meth:`apply_gradients` stays as the synchronous path."""
 
     @classmethod
     def from_registry(cls, registry_addr: str, cluster: str, vocab: int,
@@ -524,12 +805,18 @@ class RemoteEmbedding:
                  backup_ms: Optional[float] = None,
                  breakers: "Optional[resilience.BreakerRegistry]" = None,
                  health_check: bool = False,
-                 health_interval_ms: float = 200.0):
+                 health_interval_ms: float = 200.0,
+                 push_window_bytes: int = 0):
         self.vocab = vocab
         self.dim = dim
         self.n = len(addresses)
         self.rows_per = vocab // self.n
         self.parallel = parallel
+        self.timeout_ms = timeout_ms
+        #: per-shard unconsumed-bytes window for push streams (0 = the
+        #: native 2MB default) — the backpressure knob of push_gradients
+        self.push_window_bytes = push_window_bytes
+        self._push_streams: dict = {}
         self.addresses = [str(a) for a in addresses]
         self.retry = retry
         self.deadline_ms = deadline_ms
@@ -788,9 +1075,106 @@ class RemoteEmbedding:
             obs.counter("ps_client_apply_keys").add(int(flat.size))
             obs.counter("ps_client_bytes_out").add(nbytes_out)
 
+    # -- streaming gradient push (the write-path mirror of the native
+    # -- read path: framed deltas over one ordered flow-controlled
+    # -- stream per owner shard, feeding the server combiner directly)
+
+    def _push_stream(self, s: int) -> "rpc.Stream":
+        st = self._push_streams.get(s)
+        if st is None:
+            st = self.channels[s].stream(
+                "Ps", "StreamApply",
+                max_buf_size=self.push_window_bytes)
+            self._push_streams[s] = st
+        return st
+
+    def _push_frame(self, s: int, frame) -> None:
+        """Write one framed delta to shard ``s``'s push stream,
+        RECONNECTING under the embedding's retry policy on error: the
+        broken stream is aborted, a fresh one is created (the setup RPC
+        pays the shard's real state — timeouts included), and THIS frame
+        is replayed on it.  A frame whose write was reported failed may
+        still have reached the server before the break, so the streamed
+        push is at-least-once across reconnects — exactly-once holds on
+        a fault-free stream (ordered, flow-controlled, no retransmits)."""
+        attempt = 0
+        while True:
+            try:
+                self._push_stream(s).write(frame)
+                return
+            except rpc.RpcError as e:
+                st = self._push_streams.pop(s, None)
+                if st is not None:
+                    st.abort()
+                policy = self.retry
+                # Stream breakage (EPIPE/EINVAL/EFAILEDSOCKET) means
+                # reconnect regardless of the unary retriable set; the
+                # policy still owns the ATTEMPT budget and backoff.
+                reconnectable = e.code in (32, 22, 1009) or \
+                    (policy is not None and
+                     e.code in policy.retriable)
+                if policy is None or not reconnectable or \
+                        not attempt + 1 < policy.max_attempts:
+                    raise
+                if obs.enabled():
+                    obs.counter("ps_stream_reconnects").add(1)
+                resilience.sleep_ms(policy.backoff.delay_ms(attempt))
+                attempt += 1
+
+    def push_gradients(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        """Streaming gradient push: ships this batch's per-owner-shard
+        deltas as ONE framed message per shard over a persistent
+        ordered stream (opened lazily, kept across batches) — no unary
+        dispatch/response per apply, and a shard whose combiner falls
+        behind back-pressures THIS call through the stream's
+        flow-control window (``push_window_bytes``;
+        ``stream_stall_ms`` counts the stalls).  Fire-and-forget:
+        application is guaranteed only after :meth:`flush_gradients`.
+        Requires shards serving ``StreamApply``
+        (``PsShardServer(stream=True)``); the unary
+        :meth:`apply_gradients` remains the synchronous/fallback path."""
+        rec = obs.enabled()
+        if rec:
+            t0 = time.monotonic_ns()
+        flat = np.asarray(ids, np.int32).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
+        nbytes_out = 0
+        for s, positions, owned in self._owner_split(flat):
+            frame = _pack_apply_req(owned, g[positions])
+            nbytes_out += len(frame)
+            self._push_frame(s, frame)
+        if rec:
+            obs.recorder("ps_client_push").record(
+                (time.monotonic_ns() - t0) / 1e9)
+            obs.counter("ps_client_push_keys").add(int(flat.size))
+            obs.counter("ps_client_bytes_out").add(nbytes_out)
+
+    def flush_gradients(self) -> None:
+        """Closes every push stream and waits until each shard has
+        consumed AND applied everything pushed so far (the server
+        flushes its combiner before answering the close).  The next
+        :meth:`push_gradients` opens fresh streams.  Raises
+        :class:`rpc.RpcError` (ERPCTIMEDOUT) if a shard fails to drain
+        within the embedding's timeout."""
+        streams, self._push_streams = self._push_streams, {}
+        for st in streams.values():
+            st.close()
+        deadline_s = max(1.0, self.timeout_ms / 1000.0)
+        for s, st in streams.items():
+            if not st.join(timeout_s=deadline_s):
+                st.abort()
+                raise rpc.RpcError(
+                    1008, f"shard {s} ({self.addresses[s]}) did not drain "
+                          f"its push stream within {deadline_s:.1f}s")
+
     def close(self):
         if self._prober is not None:
             self._prober.stop()
             self._prober = None
+        for st in self._push_streams.values():
+            # Abrupt: close() is teardown, not a flush barrier — callers
+            # wanting the guarantee use flush_gradients() first.
+            st.abort()
+        self._push_streams.clear()
         for c in self.channels:
             c.close()

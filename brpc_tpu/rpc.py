@@ -24,6 +24,19 @@ _HANDLER = ctypes.CFUNCTYPE(
     ctypes.c_size_t, ctypes.c_void_p
 )
 
+# brt_stream_handler: (user, stream_id, data, len, closed) — data frames
+# arrive with closed=0, the final callback is (NULL, 0, 1).
+_STREAM_HANDLER = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+    ctypes.c_size_t, ctypes.c_int
+)
+
+# brt_drop_hook: (user, service, method, port) -> nonzero to drop.
+_DROP_HOOK = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.c_int
+)
+
 _lib = None
 _load_error: Optional[str] = None
 # Serializes the first-touch cmake/ninja build + dlopen: two threads racing
@@ -171,6 +184,28 @@ def _load_locked():
     lib.brt_server_add_ps_service.restype = ctypes.c_int
     lib.brt_ps_shard_destroy.argtypes = [ctypes.c_void_p]
     lib.brt_ps_shard_destroy.restype = None
+    lib.brt_stream_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_size_t, ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_stream_create.restype = ctypes.c_int
+    lib.brt_stream_accept.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, _STREAM_HANDLER, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.brt_stream_accept.restype = ctypes.c_int
+    lib.brt_stream_write.argtypes = [
+        ctypes.c_uint64, ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.brt_stream_write.restype = ctypes.c_int
+    lib.brt_stream_close.argtypes = [ctypes.c_uint64]
+    lib.brt_stream_close.restype = ctypes.c_int
+    lib.brt_stream_join.argtypes = [ctypes.c_uint64, ctypes.c_int64]
+    lib.brt_stream_join.restype = ctypes.c_int
+    lib.brt_stream_abort.argtypes = [ctypes.c_uint64]
+    lib.brt_stream_abort.restype = ctypes.c_int
+    lib.brt_set_drop_hook.argtypes = [_DROP_HOOK, ctypes.c_void_p]
+    lib.brt_set_drop_hook.restype = None
     lib.brt_call_cancel.argtypes = [ctypes.c_void_p]
     lib.brt_call_cancel.restype = None
     lib.brt_call_destroy.argtypes = [ctypes.c_void_p]
@@ -250,6 +285,132 @@ def _req_ptr(request):
     return (ctypes.c_char * len(request)).from_buffer(request)
 
 
+# ---------------------------------------------------------------------------
+# server-side stream receivers (one process-global dispatch trampoline)
+# ---------------------------------------------------------------------------
+
+# stream_id -> receiver (an object with on_data(bytes) / on_closed()).
+# Registered by Server.add_stream_handler's accept() before the response
+# leaves (so no frame can beat the registration), removed when the peer's
+# CLOSE is delivered.
+_stream_mu = _race.checked_lock("rpc.stream.receivers")
+_stream_receivers: dict = {}
+
+
+def _register_stream_receiver(stream_id: int, receiver) -> None:
+    with _stream_mu:
+        _stream_receivers[stream_id] = receiver
+
+
+def _pop_stream_receiver(stream_id: int):
+    with _stream_mu:
+        return _stream_receivers.pop(stream_id, None)
+
+
+@_STREAM_HANDLER
+def _stream_dispatch(user, stream_id, data, length, closed):
+    """Runs serialized per stream on the native ExecutionQueue consumer
+    (same fiber→Python shape as the service trampoline).  A slow receiver
+    back-pressures the writer through the consumed-bytes feedback — that
+    is the design, not a bug.  Exceptions cannot reach a response (frames
+    have none), so they are counted and swallowed."""
+    try:
+        if closed:
+            receiver = _pop_stream_receiver(stream_id)
+            if receiver is None:
+                return
+            try:
+                receiver.on_closed()
+            finally:
+                # Complete the close handshake: the peer already closed,
+                # closing our side fully retires the native stream (and
+                # wakes the peer's join).
+                _load().brt_stream_close(stream_id)
+        else:
+            with _stream_mu:
+                receiver = _stream_receivers.get(stream_id)
+            if receiver is None:
+                return
+            payload = ctypes.string_at(data, length) if length else b""
+            receiver.on_data(payload)
+    except Exception:  # noqa: BLE001 — no response channel for frames
+        if obs.enabled():
+            obs.counter("stream_handler_errors").add(1)
+
+
+def _make_stream_accept(lib, session):
+    """The ``accept`` callable handed to a stream-capable handler: binds
+    the stream riding the in-flight request to ``receiver`` and registers
+    it for dispatch.  Must run inside the handler, before the response
+    leaves — which is guaranteed, because the trampoline responds only
+    after the handler returns."""
+
+    def accept(receiver, max_buf_size: int = 0) -> int:
+        sid = ctypes.c_uint64()
+        rc = lib.brt_stream_accept(session, max_buf_size, _stream_dispatch,
+                                   None, ctypes.byref(sid))
+        if rc != 0:
+            raise RpcError(rc, "stream accept failed "
+                               "(request carries no stream?)")
+        # Register before the response can reach the client: no data
+        # frame can arrive until the client learns the peer stream id
+        # from the response meta.
+        _register_stream_receiver(sid.value, receiver)
+        if obs.enabled():
+            obs.counter("stream_accepts").add(1)
+        return sid.value
+
+    return accept
+
+
+# ---------------------------------------------------------------------------
+# native pre-dispatch drop hook (fault-injection tier)
+# ---------------------------------------------------------------------------
+
+# listen port -> "ip:port" of live servers, so the drop hook can hand the
+# fault plan the same endpoint string its per-endpoint rules match on.
+_servers_by_port: dict = {}
+_drop_hook_ref = None  # pinned CFUNCTYPE while installed
+
+
+def install_drop_hook() -> None:
+    """Installs the native pre-dispatch drop hook (idempotent): every
+    parsed request consults :func:`brpc_tpu.fault.server_drop_intercept`
+    before dispatch, and a firing ``drop`` rule discards it silently —
+    no response, so the CLIENT's real timeout path runs.  Called by
+    ``fault.install`` when a plan carries server-side drop rules; raises
+    :class:`NativeCoreUnavailable` without the native core."""
+    global _drop_hook_ref
+    if _drop_hook_ref is not None:
+        return
+    lib = _load()
+
+    @_DROP_HOOK
+    def hook(user, service, method, port):
+        try:
+            if not fault.active():
+                return 0
+            dropped = fault.server_drop_intercept(
+                service.decode(errors="replace"),
+                method.decode(errors="replace"),
+                _servers_by_port.get(port))
+            return 1 if dropped else 0
+        except Exception:  # noqa: BLE001 — never fail the request path
+            return 0
+
+    _drop_hook_ref = hook  # pin before install: the native side keeps it
+    lib.brt_set_drop_hook(hook, None)
+
+
+def uninstall_drop_hook() -> None:
+    """Removes the native drop hook (test isolation)."""
+    global _drop_hook_ref
+    if _drop_hook_ref is None:
+        return
+    _load().brt_set_drop_hook(ctypes.cast(None, _DROP_HOOK), None)
+    _drop_hook_ref = None
+
+
 def _record_server_call(service: str, method: str, t0: int, wall: float,
                         req_len: int, rsp_len: int,
                         error: Optional[str],
@@ -305,10 +466,15 @@ class Server:
         self._listen: Optional[str] = None  # set by start()
 
     def _sync_trampoline(self, name: str,
-                         handler: Callable[[str, bytes], bytes]):
+                         handler: Callable[[str, bytes], bytes], *,
+                         pass_accept: bool = False):
         """Builds the fiber->Python trampoline shared by
-        :meth:`add_service` and :meth:`add_ps_service` (the caller must
-        pin the returned CFUNCTYPE on ``self._handlers``)."""
+        :meth:`add_service`, :meth:`add_ps_service` and
+        :meth:`add_stream_handler` (the caller must pin the returned
+        CFUNCTYPE on ``self._handlers``).  With ``pass_accept`` the
+        handler is called as ``handler(method, request, accept)`` and may
+        invoke ``accept(receiver, max_buf_size=0)`` once, BEFORE
+        returning, to bind the stream riding this request."""
         lib = self._lib
 
         @_HANDLER
@@ -325,7 +491,11 @@ class Server:
                 data = ctypes.string_at(req, req_len) if req_len else b""
                 if fault.active():
                     fault.server_intercept(name, m.decode(), self._listen)
-                out = handler(m.decode(), data)
+                if pass_accept:
+                    out = handler(m.decode(), data,
+                                  _make_stream_accept(lib, session))
+                else:
+                    out = handler(m.decode(), data)
                 if out is None:
                     out = b""
                 out_len = len(out)
@@ -351,15 +521,41 @@ class Server:
             raise RuntimeError(f"add_service failed: {rc}")
         self._handlers.append(trampoline)
 
+    def add_stream_handler(self, name: str, handler) -> None:
+        """Registers a service whose handler may ACCEPT streams:
+        ``handler(method, request, accept) -> bytes``.  A method that
+        wants the client's stream calls ``accept(receiver,
+        max_buf_size=0)`` (at most once, before returning); ``receiver``
+        then gets ``on_data(bytes)`` per frame and ``on_closed()`` once,
+        serialized, after the client's graceful close — a slow receiver
+        back-pressures the writer through the stream's consumed-bytes
+        window.  Methods that ignore ``accept`` behave exactly like
+        :meth:`add_service` handlers.  The server auto-closes its half of
+        a stream after ``on_closed`` (completing the handshake the
+        client's ``Stream.join`` waits on); a client that dies WITHOUT
+        closing leaks the receiver until process exit."""
+        trampoline = self._sync_trampoline(name, handler, pass_accept=True)
+        rc = self._lib.brt_server_add_service(self._ptr, name.encode(),
+                                              trampoline, None)
+        if rc != 0:
+            raise RuntimeError(f"add_stream_handler failed: {rc}")
+        self._handlers.append(trampoline)
+
     def add_ps_service(self, name: str, shard: "PsShard",
-                       fallback: Callable[[str, bytes], bytes]) -> None:
+                       fallback: Callable[[str, bytes], bytes], *,
+                       stream: bool = False) -> None:
         """Registers a PS service whose ``Lookup`` is served NATIVELY from
         ``shard`` — zero Python (no GIL, no ctypes trampoline, no request
         framing) in the read loop.  Every other method (``ApplyGrad``,
         lifecycle, fault injection) dispatches to ``fallback`` on the
         standard trampoline, so the Python tier keeps the write path.
-        The shard must outlive this server (close the server first)."""
-        trampoline = self._sync_trampoline(name, fallback)
+        With ``stream=True`` the fallback is stream-capable and called as
+        ``fallback(method, request, accept)`` (see
+        :meth:`add_stream_handler`) — the streaming gradient push rides
+        the same service as the native read path.  The shard must outlive
+        this server (close the server first)."""
+        trampoline = self._sync_trampoline(name, fallback,
+                                           pass_accept=stream)
         rc = self._lib.brt_server_add_ps_service(
             self._ptr, name.encode(), shard._ptr, trampoline, None)
         if rc != 0:
@@ -438,8 +634,10 @@ class Server:
             raise RuntimeError(f"server start failed: {rc}")
         port = self._lib.brt_server_port(self._ptr)
         # the resolved listen address identifies this server to the
-        # fault plan (per-endpoint server-side rules)
+        # fault plan (per-endpoint server-side rules); the port map lets
+        # the NATIVE drop hook translate its port back to this string
         self._listen = f"{addr.rsplit(':', 1)[0]}:{port}"
+        _servers_by_port[port] = self._listen
         return port
 
     @property
@@ -611,6 +809,86 @@ class CallGroup:
             self._lib.brt_call_group_destroy(ptr)
 
 
+class Stream:
+    """Client write side of a streaming RPC (from :meth:`Channel.stream`).
+
+    An ordered, flow-controlled frame pipe bound to the channel's
+    connection (the reference's StreamCreate/StreamWrite,
+    cpp/rpc/stream.*): ``write()`` ships one framed message at wire rate
+    and PARKS when the peer's unconsumed window (``max_buf_size``) is
+    full — backpressure is real, not advisory; the stalled time feeds the
+    ``stream_stall_ms`` counter.  ``close()`` is graceful: in-flight
+    frames drain to the receiver IN ORDER before its ``on_closed`` runs,
+    and ``join()`` returns once the peer has consumed everything and
+    closed its half — the "every pushed delta is applied" barrier the PS
+    tier builds on.  ``abort()`` is the error-path teardown (failed
+    setup, dead connection): immediate, nothing reaches the peer.
+
+    Writes on one stream must come from one thread at a time (frame
+    order is the caller's once two writers interleave).
+    """
+
+    # Stalls below this are the wait-free socket write itself, not
+    # backpressure; counting them would drown the signal in noise.
+    _STALL_FLOOR_US = 1000
+
+    __slots__ = ("_lib", "_id", "response", "service", "method", "peer",
+                 "_closed")
+
+    def __init__(self, lib, stream_id: int, response: bytes, service: str,
+                 method: str, peer: str):
+        self._lib = lib
+        self._id = stream_id
+        #: the setup RPC's response bytes (the server's accept-time answer)
+        self.response = response
+        self.service = service
+        self.method = method
+        self.peer = peer
+        self._closed = False
+
+    def write(self, data) -> None:
+        """Ordered framed write (bytes/bytearray/memoryview — the native
+        side copies before returning).  Parks while the flow-control
+        window is full; raises :class:`RpcError` on a closed/broken
+        stream (EPIPE: peer closed; EINVAL: locally closed/unknown)."""
+        if self._closed:
+            raise RpcError(22, f"stream to {self.peer} is closed")
+        if _race.enabled():
+            _race.note_blocking("brt_stream_write")
+        stall = ctypes.c_int64()
+        rc = self._lib.brt_stream_write(self._id, _req_ptr(data),
+                                        len(data), ctypes.byref(stall))
+        if obs.enabled():
+            obs.counter("stream_writes").add(1)
+            obs.counter("stream_bytes_out").add(len(data))
+            if stall.value > self._STALL_FLOOR_US:
+                obs.counter("stream_stall_ms").add(stall.value / 1000.0)
+        if rc != 0:
+            raise RpcError(rc, f"stream write to {self.peer} failed")
+
+    def close(self) -> None:
+        """Graceful close: flushes in-flight frames, then tells the peer.
+        Idempotent; pair with :meth:`join` to wait for full application."""
+        if not self._closed:
+            self._closed = True
+            self._lib.brt_stream_close(self._id)
+
+    def join(self, timeout_s: Optional[float] = None) -> bool:
+        """True once BOTH sides closed — every written frame was
+        delivered, consumed, and the peer answered CLOSE.  Call after
+        :meth:`close`; ``timeout_s=None`` waits forever."""
+        if _race.enabled():
+            _race.note_blocking("brt_stream_join")
+        us = -1 if timeout_s is None else max(0, int(timeout_s * 1e6))
+        return self._lib.brt_stream_join(self._id, us) == 0
+
+    def abort(self) -> None:
+        """Abrupt local teardown (reconnect/error paths): wakes any
+        writer/joiner, frees native state, sends nothing.  Idempotent."""
+        self._closed = True
+        self._lib.brt_stream_abort(self._id)
+
+
 class PsShard:
     """Native generation-versioned PS shard (cpp/capi/ps_shard.cc): serves
     ``Lookup`` entirely inside the C++ fiber handler once attached to a
@@ -766,6 +1044,53 @@ class Channel:
             raise RpcError(-1, f"call_start failed for {self._addr}")
         return PendingCall(self._lib, ptr, service, method, self._addr,
                            len(request), t0, wall, tag)
+
+    def stream(self, service: str, method: str, request: bytes = b"", *,
+               max_buf_size: int = 0) -> Stream:
+        """Creates an ordered flow-controlled byte-frame stream bound to
+        this channel's connection by running ``service``.``method``
+        synchronously — the server's handler must ``accept`` the stream
+        (see :meth:`Server.add_stream_handler`); its response comes back
+        on ``Stream.response``.  ``max_buf_size`` bounds the unconsumed
+        bytes in flight (0 = the native 2MB default): writers park beyond
+        it until the receiver's consumed-bytes feedback returns credit.
+        Raises :class:`RpcError` when the setup RPC fails or the server
+        never accepted — nothing is left behind either way."""
+        rec = obs.enabled()
+        if rec:
+            t0 = time.monotonic_ns()
+            wall = time.time()
+        if fault.active():
+            fault.client_intercept(service, method, self._addr)
+        if _race.enabled():
+            _race.note_blocking("brt_stream_create")
+        sid = ctypes.c_uint64()
+        rsp = ctypes.c_void_p()
+        rsp_len = ctypes.c_size_t()
+        errbuf = ctypes.create_string_buffer(256)
+        rc = self._lib.brt_stream_create(
+            self._ptr, service.encode(), method.encode(),
+            _req_ptr(request), len(request), max_buf_size,
+            ctypes.byref(sid), ctypes.byref(rsp), ctypes.byref(rsp_len),
+            errbuf, 256)
+        if rc != 0:
+            text = errbuf.value.decode(errors="replace")
+            if rec:
+                _record_client_call(service, method, self._addr, t0, wall,
+                                    len(request), 0, rc, text,
+                                    tag="stream")
+            raise RpcError(rc, text)
+        try:
+            out = ctypes.string_at(rsp, rsp_len.value)
+        finally:
+            self._lib.brt_free(rsp)
+        if rec:
+            obs.counter("stream_creates").add(1)
+            _record_client_call(service, method, self._addr, t0, wall,
+                                len(request), len(out), 0, "",
+                                tag="stream")
+        return Stream(self._lib, sid.value, out, service, method,
+                      self._addr)
 
     def close(self) -> None:
         if self._ptr:

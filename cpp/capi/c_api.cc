@@ -18,6 +18,7 @@
 namespace {
 
 using namespace brt;
+using brt_capi::CChannel;
 using brt_capi::CServer;
 using brt_capi::CSession;
 
@@ -36,10 +37,6 @@ class CService : public Service {
  private:
   brt_service_handler handler_;
   void* user_;
-};
-
-struct CChannel {
-  std::unique_ptr<ChannelBase> channel;
 };
 
 // Exact multi-call fan-in (the ParallelChannel CountdownEvent shape,

@@ -120,6 +120,80 @@ void brt_call_group_destroy(void* group);
 
 void brt_free(void* p);
 
+// ---- streaming RPC (ordered, flow-controlled; rpc/stream.h) ----
+// A stream is an ordered byte-frame pipe bound to an RPC's connection
+// (reference src/brpc/stream.{h,cpp}): the client creates it together
+// with a normal RPC, the server accepts it inside the handler, then the
+// client writes framed messages at wire rate under credit-based flow
+// control — the receiver acknowledges consumed bytes and a writer whose
+// unconsumed window (max_buf_size, default 2MB) is full parks until
+// credit returns.  This is the gradient-push substrate: per-frame cost
+// is one framed socket write, no per-call dispatch/response.
+//
+// Receive callback: runs SERIALIZED per stream (an ExecutionQueue
+// consumer — a slow callback back-pressures the writer through the
+// consumed-bytes feedback).  Data frames arrive with closed == 0; the
+// final callback is (NULL, 0, closed=1) exactly once, after every data
+// frame, when the peer closes gracefully.  NOT invoked on
+// brt_stream_abort or peer death without CLOSE.
+typedef void (*brt_stream_handler)(void* user, uint64_t stream_id,
+                                   const void* data, size_t len,
+                                   int closed);
+
+// Client side: creates a stream and binds it by running
+// `service`.`method` synchronously on `channel` (the stream settings
+// ride the request meta; the stream becomes writable when the RPC
+// succeeds).  max_buf_size <= 0 takes the 2MB default.  On success
+// returns 0, fills *stream_id and the RPC's response (*rsp malloc'd,
+// free with brt_free).  On failure returns the RPC error code, fills
+// errbuf, and the half-created stream is aborted — nothing to clean up.
+int brt_stream_create(void* channel, const char* service,
+                      const char* method, const void* req, size_t req_len,
+                      int64_t max_buf_size, uint64_t* stream_id,
+                      void** rsp, size_t* rsp_len, char* errbuf,
+                      size_t errbuf_len);
+// Server side: accepts the stream riding the in-flight request behind
+// `session` (call INSIDE the handler, BEFORE brt_session_respond).
+// `handler` receives the frames; it must stay valid until its
+// closed == 1 callback runs (after which the native side forgets it).
+// Returns 0 and fills *stream_id, or EINVAL when the request carries no
+// stream.
+int brt_stream_accept(void* session, int64_t max_buf_size,
+                      brt_stream_handler handler, void* user,
+                      uint64_t* stream_id);
+// Ordered framed write.  Parks the calling fiber/thread while the
+// flow-control window is full; *stall_us (may be NULL) receives the
+// time spent inside the native write — parked time plus the wait-free
+// socket write, i.e. the backpressure stall for any write that did not
+// return immediately.  Returns 0, EINVAL (unknown/locally-closed id),
+// EPIPE (peer closed), or a socket error.  Writes on one stream must
+// come from one caller at a time — concurrent writers interleave frame
+// order.
+int brt_stream_write(uint64_t stream_id, const void* data, size_t len,
+                     int64_t* stall_us);
+// Graceful close: in-flight frames drain to the peer IN ORDER before
+// its closed callback fires.  Idempotent; 0 always.
+int brt_stream_close(uint64_t stream_id);
+// Waits until BOTH sides have closed (the peer consumed everything and
+// answered CLOSE).  0, or ETIMEDOUT (timeout_us < 0 = forever).
+int brt_stream_join(uint64_t stream_id, int64_t timeout_us);
+// Abrupt teardown for error paths (failed setup RPC, dead connection):
+// wakes writers/joiners, frees the local state, sends nothing.  Only
+// for streams without a receive handler still consuming (write-only
+// client streams are always safe).  Idempotent; 0 always.
+int brt_stream_abort(uint64_t stream_id);
+
+// ---- pre-dispatch request drop (fault-injection tier) ----
+// Process-global hook consulted for EVERY parsed request before
+// dispatch/accounting; returning nonzero silently discards the request
+// (no response — the client times out for real, unlike a client-side
+// simulated drop).  `port` is the receiving server's listen port, so a
+// plan can target one shard of a fleet.  NULL uninstalls; the uninstalled
+// cost is one atomic load per request.
+typedef int (*brt_drop_hook)(void* user, const char* service,
+                             const char* method, int port);
+void brt_set_drop_hook(brt_drop_hook hook, void* user);
+
 // ---- native PS shard (zero-Python read path) ----
 // A generation-versioned row table serving `Lookup` straight from the
 // C++ fiber handler (SURVEY §3.1 — the reference serves all traffic

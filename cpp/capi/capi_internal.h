@@ -8,6 +8,7 @@
 #include <vector>
 
 #include "cluster/remote_naming.h"
+#include "rpc/channel.h"
 #include "rpc/server.h"
 
 namespace brt_capi {
@@ -27,6 +28,13 @@ struct CServer {
   // (AddService does not take ownership).
   std::vector<std::unique_ptr<brt::Service>> services;
   std::unique_ptr<brt::NamingRegistryService> naming;
+};
+
+// A channel handle: plain single-server Channel or ClusterChannel behind
+// the shared ChannelBase surface (capi/c_api.cc owns construction; the
+// stream TU issues stream-binding calls through it).
+struct CChannel {
+  std::unique_ptr<brt::ChannelBase> channel;
 };
 
 }  // namespace brt_capi

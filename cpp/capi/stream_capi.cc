@@ -1,0 +1,171 @@
+// Streaming-RPC C ABI (brt_stream_*) + the pre-dispatch drop hook.
+//
+// The native substrate is rpc/stream.{h,cc} (StreamCreate/Accept/Write
+// with consumed-bytes flow control, reference src/brpc/stream.cpp); this
+// TU flattens it for language bindings the same way c_api.cc flattens
+// Channel/Server.  A client stream is write-only (no handler) and
+// identified by its StreamId alone; a server stream's frames are relayed
+// into a bound-language callback that runs serialized on the stream's
+// ExecutionQueue consumer — the same "native fiber calls into the
+// binding" shape as the service trampoline.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "base/time.h"
+#include "capi/c_api.h"
+#include "capi/capi_internal.h"
+#include "rpc/errors.h"
+#include "rpc/protocol_brt.h"
+#include "rpc/stream.h"
+
+namespace {
+
+using namespace brt;
+using brt_capi::CChannel;
+using brt_capi::CSession;
+
+// Relays native stream callbacks into the binding.  Owned by the stream's
+// lifecycle: on_closed is the LAST serialized callback for a gracefully
+// closed stream, so the relay frees itself right after forwarding it.  A
+// peer that dies without CLOSE leaks one relay (documented in c_api.h);
+// brt_stream_abort must not be used on handler-carrying streams.
+class CStreamRelay : public StreamHandler {
+ public:
+  CStreamRelay(brt_stream_handler h, void* user) : h_(h), user_(user) {}
+
+  void on_received(StreamId id, IOBuf&& message) override {
+    const std::string data = message.to_string();
+    h_(user_, id, data.data(), data.size(), 0);
+  }
+
+  void on_closed(StreamId id) override {
+    h_(user_, id, nullptr, 0, 1);
+    delete this;  // no further callbacks can follow a CLOSE (ordered queue)
+  }
+
+ private:
+  brt_stream_handler h_;
+  void* user_;
+};
+
+// Hook + user swap atomically as one allocation (a torn pair would call
+// the new hook with the old cookie).  Install happens O(once) per
+// process; superseded pairs are intentionally leaked rather than raced.
+struct DropHookPair {
+  brt_drop_hook fn;
+  void* user;
+};
+std::atomic<DropHookPair*> g_drop_pair{nullptr};
+
+int DropBridge(const char* service, const char* method, int port) {
+  DropHookPair* p = g_drop_pair.load(std::memory_order_acquire);
+  if (p == nullptr) return 0;
+  return p->fn(p->user, service, method, port);
+}
+
+}  // namespace
+
+extern "C" {
+
+int brt_stream_create(void* channel, const char* service,
+                      const char* method, const void* req, size_t req_len,
+                      int64_t max_buf_size, uint64_t* stream_id,
+                      void** rsp, size_t* rsp_len, char* errbuf,
+                      size_t errbuf_len) {
+  auto* c = static_cast<CChannel*>(channel);
+  if (c == nullptr || stream_id == nullptr) return EINVAL;
+  StreamOptions opts;
+  if (max_buf_size > 0) opts.max_buf_size = size_t(max_buf_size);
+  Controller cntl;
+  StreamId id = INVALID_STREAM_ID;
+  int rc = StreamCreate(&id, &cntl, opts);
+  if (rc != 0) return rc;
+  IOBuf request, response;
+  if (req != nullptr && req_len > 0) request.append(req, req_len);
+  // Synchronous bind: the stream settings ride this request's meta and
+  // the response meta carries the peer's stream id (g_stream_connect_hook
+  // binds the stream before the call completes).
+  c->channel->CallMethod(service, method, &cntl, request, &response,
+                         nullptr);
+  if (cntl.Failed()) {
+    StreamAbort(id);  // never bound; nothing reaches the peer
+    if (errbuf != nullptr && errbuf_len > 0) {
+      snprintf(errbuf, errbuf_len, "%s", cntl.ErrorText().c_str());
+    }
+    return cntl.ErrorCode() ? cntl.ErrorCode() : -1;
+  }
+  if (cntl.peer_stream_id == 0) {
+    // The server answered but never accepted (handler without
+    // brt_stream_accept): a write would buffer forever.
+    StreamAbort(id);
+    if (errbuf != nullptr && errbuf_len > 0) {
+      snprintf(errbuf, errbuf_len, "peer did not accept the stream");
+    }
+    return EREQUEST;
+  }
+  *stream_id = id;
+  if (rsp != nullptr && rsp_len != nullptr) {
+    const size_t n = response.size();
+    void* buf = malloc(n ? n : 1);
+    response.copy_to(buf, n);
+    *rsp = buf;
+    *rsp_len = n;
+  }
+  return 0;
+}
+
+int brt_stream_accept(void* session, int64_t max_buf_size,
+                      brt_stream_handler handler, void* user,
+                      uint64_t* stream_id) {
+  auto* sess = static_cast<CSession*>(session);
+  if (sess == nullptr || stream_id == nullptr || handler == nullptr) {
+    return EINVAL;
+  }
+  auto* relay = new CStreamRelay(handler, user);
+  StreamOptions opts;
+  if (max_buf_size > 0) opts.max_buf_size = size_t(max_buf_size);
+  opts.handler = relay;
+  StreamId id = INVALID_STREAM_ID;
+  const int rc = StreamAccept(&id, sess->cntl, opts);
+  if (rc != 0) {
+    delete relay;
+    return rc;
+  }
+  *stream_id = id;
+  return 0;
+}
+
+int brt_stream_write(uint64_t stream_id, const void* data, size_t len,
+                     int64_t* stall_us) {
+  IOBuf message;
+  if (data != nullptr && len > 0) message.append(data, len);
+  const int64_t t0 = monotonic_us();
+  const int rc = StreamWrite(stream_id, &message);
+  if (stall_us != nullptr) *stall_us = monotonic_us() - t0;
+  return rc;
+}
+
+int brt_stream_close(uint64_t stream_id) { return StreamClose(stream_id); }
+
+int brt_stream_join(uint64_t stream_id, int64_t timeout_us) {
+  return StreamJoinFor(stream_id, timeout_us);
+}
+
+int brt_stream_abort(uint64_t stream_id) { return StreamAbort(stream_id); }
+
+void brt_set_drop_hook(brt_drop_hook hook, void* user) {
+  if (hook == nullptr) {
+    SetRequestDropHook(nullptr);
+    g_drop_pair.store(nullptr, std::memory_order_release);
+    return;
+  }
+  g_drop_pair.store(new DropHookPair{hook, user},
+                    std::memory_order_release);
+  SetRequestDropHook(&DropBridge);
+}
+
+}  // extern "C"

@@ -28,6 +28,7 @@ uint32_t FLAGS_max_body_size = 64u * 1024 * 1024;
 namespace {
 
 std::atomic<StreamFrameHandler> g_stream_handler{nullptr};
+std::atomic<RequestDropHook> g_drop_hook{nullptr};
 
 constexpr size_t kHeaderLen = 12;
 
@@ -128,6 +129,15 @@ void ProcessRequest(RpcMeta&& meta, IOBuf&& body, SocketId sock,
   auto* server = static_cast<Server*>(s->user());
   if (!server || !server->IsRunning()) {
     SendErrorResponse(sock, meta.correlation_id, ELOGOFF, nullptr);
+    return;
+  }
+  // Fault-injection drop: parsed, then silently discarded — no response,
+  // no accounting (OnRequestArrived has not run), the client sees only
+  // its own deadline expire.
+  RequestDropHook drop = g_drop_hook.load(std::memory_order_acquire);
+  if (drop != nullptr &&
+      drop(meta.service.c_str(), meta.method.c_str(),
+           server->listen_address().port) != 0) {
     return;
   }
   // Credential gate (reference authenticator.h:58): verified before any
@@ -286,6 +296,10 @@ int g_proto_index = -1;
 
 void SetStreamFrameHandler(StreamFrameHandler h) {
   g_stream_handler.store(h, std::memory_order_release);
+}
+
+void SetRequestDropHook(RequestDropHook h) {
+  g_drop_hook.store(h, std::memory_order_release);
 }
 
 int RegisterBrtProtocol() {

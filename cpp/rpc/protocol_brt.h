@@ -23,4 +23,14 @@ using StreamFrameHandler = void (*)(RpcMeta&& meta, IOBuf&& body,
                                     SocketId sock);
 void SetStreamFrameHandler(StreamFrameHandler h);
 
+// Pre-dispatch drop hook (fault-injection tier): consulted after the
+// request meta is parsed but BEFORE any concurrency/accounting is taken.
+// Returning nonzero silently discards the request — no response is ever
+// written, so the client exercises its REAL timeout path (unlike a
+// client-side simulated drop, which never touches the wire).  Null (the
+// default) is a single relaxed atomic load on the request path.
+using RequestDropHook = int (*)(const char* service, const char* method,
+                                int server_port);
+void SetRequestDropHook(RequestDropHook h);
+
 }  // namespace brt

@@ -4,6 +4,7 @@
 #include <unordered_map>
 
 #include "base/logging.h"
+#include "base/time.h"
 #include "fiber/butex.h"
 #include "fiber/execution_queue.h"
 #include "rpc/protocol_brt.h"
@@ -277,7 +278,10 @@ int StreamClose(StreamId id) {
   return 0;
 }
 
-int StreamJoin(StreamId id) {
+int StreamJoin(StreamId id) { return StreamJoinFor(id, -1); }
+
+int StreamJoinFor(StreamId id, int64_t timeout_us) {
+  const int64_t deadline = timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
   for (;;) {
     auto s = find_stream(id);
     if (!s) return 0;  // fully closed & unregistered
@@ -287,8 +291,25 @@ int StreamJoin(StreamId id) {
         s->peer_closed.load(std::memory_order_acquire)) {
       return 0;
     }
-    butex_wait(s->join_butex, expected, -1);
+    int64_t left = -1;
+    if (deadline >= 0) {
+      left = deadline - monotonic_us();
+      if (left <= 0) return ETIMEDOUT;
+    }
+    butex_wait(s->join_butex, expected, left);
   }
+}
+
+int StreamAbort(StreamId id) {
+  auto s = find_stream(id);
+  if (!s) return 0;
+  // Both flags up front: finish_if_fully_closed tears down (wakes joiners,
+  // stops the exec queue, unregisters) exactly once.
+  s->local_closed.store(true, std::memory_order_release);
+  s->peer_closed.store(true, std::memory_order_release);
+  wake_writers(s.get());
+  finish_if_fully_closed(s);
+  return 0;
 }
 
 }  // namespace brt

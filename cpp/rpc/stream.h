@@ -56,4 +56,19 @@ int StreamClose(StreamId id);
 // Blocks until the peer closes (or the stream dies). Test/shutdown helper.
 int StreamJoin(StreamId id);
 
+// StreamJoin with a deadline: 0 once both sides closed, ETIMEDOUT if
+// timeout_us elapses first (timeout_us < 0 = forever).  The language
+// bindings use this — a peer that died without CLOSE must not hang a
+// joiner forever.
+int StreamJoinFor(StreamId id, int64_t timeout_us);
+
+// Abrupt local teardown: marks BOTH sides closed, wakes writers and
+// joiners, unregisters.  No CLOSE frame reaches the peer and a handler's
+// on_closed is NOT invoked — this is the error-path cleanup for streams
+// whose setup RPC failed or whose connection died (graceful shutdown is
+// StreamClose + the peer's CLOSE).  Do not abort a stream whose handler
+// may still be consuming queued frames (write-only streams are always
+// safe).  Idempotent.
+int StreamAbort(StreamId id);
+
 }  // namespace brt

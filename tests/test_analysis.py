@@ -796,3 +796,90 @@ def test_async_handler_sleep_flagged(tmp_path):
     """)
     (f,) = _by_check(fs, "fiber-blocking-sleep")
     assert "S._handle" in f.message
+
+
+# ---- ctypes-contract: module-scope / global pinning refinements ----
+
+def test_module_level_callback_is_pinned_by_the_module(tmp_path):
+    # a module-level CFUNCTYPE def is held by the module namespace for
+    # the life of the process — it cannot be GC'd under the native core
+    fs = _lint_src(tmp_path, """\
+        import ctypes
+        _H = ctypes.CFUNCTYPE(None)
+        lib.brt_reg.argtypes = [_H]
+        lib.brt_reg.restype = None
+
+        @_H
+        def dispatch():
+            pass
+
+        def install(lib):
+            lib.brt_reg(dispatch)
+    """)
+    assert _by_check(fs, "ctypes-contract") == []
+
+
+def test_global_assignment_pins_callback(tmp_path):
+    good = """\
+        import ctypes
+        _H = ctypes.CFUNCTYPE(None)
+        lib.brt_reg.argtypes = [_H]
+        lib.brt_reg.restype = None
+        _ref = None
+
+        def install(lib):
+            global _ref
+
+            @_H
+            def hook():
+                pass
+            _ref = hook
+            lib.brt_reg(hook)
+    """
+    assert _by_check(_lint_src(tmp_path, good, name="good.py"),
+                     "ctypes-contract") == []
+    # without the global pin the function-local callback is still flagged
+    bad = textwrap.dedent(good).replace("    global _ref\n", "") \
+                               .replace("    _ref = hook\n", "")
+    assert bad != textwrap.dedent(good)
+    (tmp_path / "good.py").write_text(bad)
+    findings = _by_check(lint.lint_files([str(tmp_path / "good.py")]),
+                         "ctypes-contract")
+    assert len(findings) == 1 and "hook" in findings[0].message
+
+
+# ---- trace-purity: the allow-trace-impure pragma ----
+
+_TRACED_WITH_COUNTER = """\
+    import jax
+    from brpc_tpu import obs
+
+    def _count(op):{pragma_def}
+        obs.counter(op).add(1)
+
+    def step(x):
+        _count("steps"){pragma_call}
+        return x
+
+    run = jax.jit(step)
+"""
+
+
+def test_deliberate_trace_time_effect_flagged_without_pragma(tmp_path):
+    fs = _lint_src(tmp_path,
+                   _TRACED_WITH_COUNTER.format(pragma_def="",
+                                               pragma_call=""))
+    assert any("obs instrumentation" in f.message
+               for f in _by_check(fs, "trace-purity"))
+
+
+def test_def_level_allow_trace_impure_pragma(tmp_path):
+    fs = _lint_src(tmp_path, _TRACED_WITH_COUNTER.format(
+        pragma_def="  # lint: allow-trace-impure", pragma_call=""))
+    assert _by_check(fs, "trace-purity") == []
+
+
+def test_call_site_allow_trace_impure_pragma(tmp_path):
+    fs = _lint_src(tmp_path, _TRACED_WITH_COUNTER.format(
+        pragma_def="", pragma_call="  # lint: allow-trace-impure"))
+    assert _by_check(fs, "trace-purity") == []

@@ -405,3 +405,175 @@ def test_handler_registered_as_bare_function(tmp_path):
                 if f.check == "fiber-shared-state"]
     assert len(findings) == 1
     assert "SEEN" in findings[0].message
+
+
+# ---- local-variable type inference (x = Class(); x.meth()) ----
+
+def test_local_constructor_binding_resolves(tmp_path):
+    g = _graph(
+        tmp_path,
+        lib="""\
+            class Worker:
+                def run(self):
+                    pass
+        """,
+        app="""\
+            from lib import Worker
+
+            def main():
+                w = Worker()
+                w.run()
+        """,
+    )
+    main = _only_node(g, ":main")
+    assert _only_node(g, "Worker.run") in _callee_ids(g, main)
+
+
+def test_local_binding_through_module_alias_and_ifexp(tmp_path):
+    g = _graph(
+        tmp_path,
+        lib="""\
+            class Worker:
+                def run(self):
+                    pass
+        """,
+        app="""\
+            import lib
+
+            def main(flag, given):
+                w = lib.Worker() if flag else None
+                v = given or lib.Worker()
+                w.run()
+                v.run()
+        """,
+    )
+    main = _only_node(g, ":main")
+    run = _only_node(g, "Worker.run")
+    # both the conditional and the or-default bind to ONE class each
+    assert [c for c in _callee_ids(g, main) if c == run] == [run]
+    assert sum(1 for s in g.callees(main) if s.callee == run) == 2
+
+
+def test_local_ambiguous_or_call_result_stays_deferred(tmp_path):
+    g = _graph(
+        tmp_path,
+        lib="""\
+            class A:
+                def run(self):
+                    pass
+
+            class B:
+                def run(self):
+                    pass
+
+            def factory():
+                return A()
+        """,
+        app="""\
+            from lib import A, B, factory
+
+            def ambiguous(flag):
+                x = A()
+                if flag:
+                    x = B()
+                x.run()
+
+            def call_result():
+                y = factory()
+                y.run()
+        """,
+    )
+    run_a = _only_node(g, "A.run")
+    run_b = _only_node(g, "B.run")
+    amb = _callee_ids(g, _only_node(g, ":ambiguous"))
+    assert run_a not in amb and run_b not in amb
+    # calls on call results remain deferred (factory's return type is
+    # not tracked) — only the factory edge itself exists
+    cr = _callee_ids(g, _only_node(g, ":call_result"))
+    assert run_a not in cr and run_b not in cr
+    assert _only_node(g, ":factory") in cr
+
+
+def test_nested_def_reads_enclosing_local_binding(tmp_path):
+    g = _graph(
+        tmp_path,
+        lib="""\
+            class Worker:
+                def run(self):
+                    pass
+        """,
+        app="""\
+            from lib import Worker
+
+            def outer():
+                w = Worker()
+
+                def inner():
+                    w.run()
+                return inner
+        """,
+    )
+    inner = _only_node(g, "outer.inner")
+    assert _only_node(g, "Worker.run") in _callee_ids(g, inner)
+
+
+def test_annotated_attr_and_conditional_constructor_resolve(tmp_path):
+    # self.<attr>: T = Class(...) if cond else None — the AnnAssign +
+    # IfExp form the combiner uses; the attr-type map must see through
+    # both or handler-reachable calls on the held object stay opaque.
+    g = _graph(tmp_path, m="""\
+        class Helper:
+            def work(self):
+                pass
+
+        class Owner:
+            def __init__(self, on):
+                self.h: "Helper | None" = Helper() if on else None
+
+            def entry(self):
+                self.h.work()
+    """)
+    entry = _only_node(g, "Owner.entry")
+    assert _only_node(g, "Helper.work") in _callee_ids(g, entry)
+
+
+def test_synchronized_helper_method_not_flagged_as_container(tmp_path):
+    # self.q.add() where q's class is in-package and add() locks
+    # internally: the call RESOLVES, the interprocedural walk checks the
+    # callee's body, and the raw-container mutator heuristic must not
+    # double-report.  An UNLOCKED helper still yields a finding — inside
+    # the helper, with the chain.
+    good = """\
+        import threading
+
+        class Combiner:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._q = []
+
+            def add(self, item):
+                with self._mu:
+                    self._q.append(item)
+
+        class Shard:
+            def __init__(self, server):
+                self.q = Combiner()
+                server.add_service("Ps", self._handle)
+
+            def _handle(self, method, req):
+                self.q.add(req)
+                return b""
+    """
+    src = textwrap.dedent(good)
+    (tmp_path / "good.py").write_text(src)
+    assert [f for f in lint.run_lint([str(tmp_path)])
+            if f.check == "fiber-shared-state"] == []
+    bad = src.replace("        with self._mu:\n"
+                      "            self._q.append(item)",
+                      "        self._q.append(item)")
+    assert bad != src
+    (tmp_path / "good.py").write_text(bad)
+    findings = [f for f in lint.run_lint([str(tmp_path)])
+                if f.check == "fiber-shared-state"]
+    assert len(findings) == 1
+    assert "Combiner.add" in findings[0].message

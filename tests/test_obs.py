@@ -394,3 +394,16 @@ def test_collective_channel_counters():
     assert obs.counter("collective_all_reduce_bytes").get_value() == 64 * 4
     chan.all_gather(x)
     assert obs.counter("collective_all_gather_calls").get_value() == 1
+
+
+def test_maxer_helper_cached_exposed_and_reset():
+    obs.reset_fabric_vars()
+    m = obs.maxer("test_high_water")
+    assert obs.maxer("test_high_water") is m  # cached per name
+    m.update(3)
+    m.update(7)
+    m.update(5)
+    assert m.get_value() == 7
+    assert "test_high_water" in obs.dump_exposed_dict()
+    obs.reset_fabric_vars()
+    assert "test_high_water" not in obs.dump_exposed_dict()

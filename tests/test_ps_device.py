@@ -115,3 +115,68 @@ def test_device_training_step_roundtrip(shard):
             first_loss = loss
         emb.apply_gradients(ids, rows - target)
     assert float(((emb.lookup(ids) - target) ** 2).mean()) < first_loss
+
+
+def test_device_combiner_single_launch_no_wasted_scatters():
+    """combine=True routes every ApplyGrad through the combiner: the
+    lost-swap redo loop never races itself (one installer at a time), so
+    wasted scatter launches stay at ZERO under 8-writer fan-in and the
+    table still sums exactly."""
+    from brpc_tpu import obs
+    import threading
+
+    obs.set_enabled(True)  # earlier suites may leave obs off
+    dev = _device_client()
+    s = DevicePsShardServer(VOCAB, DIM, 0, 1, lr=0.5, device_client=dev,
+                            combine=True)
+    emb = RemoteEmbedding([s.address], VOCAB, DIM, timeout_ms=120000)
+    try:
+        before = s.table
+        wasted0 = obs.counter("ps_device_wasted_launches").get_value()
+        ids = np.arange(8, dtype=np.int32)
+        g = np.ones((8, DIM), np.float32)
+
+        def writer():
+            e = RemoteEmbedding([s.address], VOCAB, DIM,
+                                timeout_ms=120000)
+            try:
+                for _ in range(3):
+                    e.apply_gradients(ids, g)
+            finally:
+                e.close()
+
+        threads = [threading.Thread(target=writer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        after = s.table
+        # 8 writers x 3 rounds x lr 0.5 x ones = exactly -12.0
+        np.testing.assert_allclose(after[:8], before[:8] - 12.0,
+                                   rtol=1e-5)
+        assert obs.counter("ps_device_wasted_launches").get_value() \
+            == wasted0
+        assert obs.counter("ps_combined_applies").get_value() > 0
+    finally:
+        emb.close()
+        s.close()
+        dev.close()
+
+
+def test_device_stream_push_applies_through_combiner():
+    dev = _device_client()
+    s = DevicePsShardServer(VOCAB, DIM, 0, 1, lr=0.5, device_client=dev,
+                            stream=True)
+    emb = RemoteEmbedding([s.address], VOCAB, DIM, timeout_ms=120000)
+    try:
+        before = s.table
+        ids = np.array([2, 3, 3], np.int32)  # duplicate: must accumulate
+        emb.push_gradients(ids, np.ones((3, DIM), np.float32))
+        emb.flush_gradients()
+        after = s.table
+        np.testing.assert_allclose(after[2], before[2] - 0.5, rtol=1e-5)
+        np.testing.assert_allclose(after[3], before[3] - 1.0, rtol=1e-5)
+    finally:
+        emb.close()
+        s.close()
+        dev.close()

@@ -1,0 +1,465 @@
+"""Write-path scale: server-side gradient combiner + native streaming
+gradient push.
+
+Covers the stream ABI surfaced as ``rpc.Stream`` /
+``Server.add_stream_handler`` (ordered frames, backpressure stalls,
+close-drains-in-flight, reject-without-accept), the
+:class:`ps_remote.GradCombiner` (leader drains everything pending into
+ONE application; error propagation; flush barrier), byte-level table
+equivalence between unary / combined / streamed apply orderings
+(commutative exact-arithmetic sums), torn-row/no-lost-update stress for
+combined writes racing NATIVE reads (RACECHECK clean), and stream
+reconnect driven by a server-side ``drop`` fault rule (the client's REAL
+timeout path, closing the PR-5 deferral)."""
+
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from brpc_tpu import fault, obs, resilience, rpc
+from brpc_tpu.ps_remote import (GradCombiner, PsShardServer,
+                                RemoteEmbedding, _pack_apply_req)
+
+pytestmark = pytest.mark.needs_native
+
+VOCAB, DIM = 256, 8
+
+
+@pytest.fixture(autouse=True)
+def _obs_on():
+    # earlier suites may leave obs disabled (test_ps_native's counter
+    # tests switch it off on exit); these tests read counters
+    was = obs.enabled()
+    obs.set_enabled(True)
+    yield
+    obs.set_enabled(was)
+
+
+def _apply_frame_bytes(ids, grads):
+    return bytes(_pack_apply_req(np.asarray(ids, np.int32),
+                                 np.asarray(grads, np.float32)))
+
+
+# ---- stream ABI: rpc.Stream / Server.add_stream_handler ----
+
+class _Collector:
+    def __init__(self):
+        self.frames = []
+        self.closed = threading.Event()
+
+    def on_data(self, data):
+        self.frames.append(data)
+
+    def on_closed(self):
+        self.closed.set()
+
+
+def test_stream_roundtrip_ordered_close_drains():
+    got = _Collector()
+
+    def handler(method, request, accept):
+        assert method == "Open"
+        accept(got)
+        return b"hello:" + request
+
+    srv = rpc.Server()
+    srv.add_stream_handler("S", handler)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=10000)
+    try:
+        st = ch.stream("S", "Open", b"cfg")
+        assert st.response == b"hello:cfg"
+        frames = [bytes([i % 251]) * (1 + i * 7) for i in range(64)]
+        for f in frames:
+            st.write(f)
+        st.close()
+        # close is graceful: every in-flight frame drains IN ORDER
+        # before on_closed; join returns only after the peer closed too
+        assert st.join(timeout_s=10)
+        assert got.closed.wait(5)
+        assert got.frames == frames
+        # idempotent close / writes after close fail cleanly
+        st.close()
+        with pytest.raises(rpc.RpcError):
+            st.write(b"late")
+    finally:
+        ch.close()
+        srv.close()
+
+
+def test_stream_rejected_when_handler_does_not_accept():
+    srv = rpc.Server()
+    srv.add_stream_handler("S", lambda m, r, accept: b"no-stream")
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000)
+    try:
+        with pytest.raises(rpc.RpcError) as ei:
+            ch.stream("S", "Open")
+        assert ei.value.code == 1003  # EREQUEST: peer never accepted
+        # plain unary methods on the same service keep working
+        assert ch.call("S", "Anything") == b"no-stream"
+    finally:
+        ch.close()
+        srv.close()
+
+
+def test_backpressure_stalls_writer_and_feeds_counter():
+    """A slow receiver behind a small window parks the writer: writes
+    take real wall time and the stalled time lands in stream_stall_ms."""
+    before = obs.counter("stream_stall_ms").get_value()
+    got = _Collector()
+    slow = _Collector()
+    slow.on_data = lambda data, _g=got: (time.sleep(0.015),
+                                         _g.frames.append(data))
+
+    srv = rpc.Server()
+    srv.add_stream_handler(
+        "S", lambda m, r, accept: (accept(slow, max_buf_size=8192), b"")[1])
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=10000)
+    try:
+        st = ch.stream("S", "Open", max_buf_size=8192)
+        t0 = time.monotonic()
+        for _ in range(24):
+            st.write(b"x" * 4096)
+        wall = time.monotonic() - t0
+        st.close()
+        assert st.join(timeout_s=10)
+        assert len(got.frames) == 24
+        # 24 * 4KB through an 8KB window at ~15ms/frame: the writer MUST
+        # have parked waiting for consumed-bytes credit
+        assert wall > 0.15
+        assert obs.counter("stream_stall_ms").get_value() - before > 50
+    finally:
+        ch.close()
+        srv.close()
+
+
+# ---- GradCombiner unit semantics ----
+
+def test_combiner_leader_drains_pending_into_one_apply():
+    """While the leader's apply is in flight, everything that queues up
+    combines into the NEXT single application (one apply for N adds)."""
+    applied = []
+    release = threading.Event()
+    first_started = threading.Event()
+
+    def apply_fn(ids, grads):
+        if not applied:
+            first_started.set()
+            release.wait(5)
+        applied.append((ids.copy(), grads.copy()))
+
+    c = GradCombiner(apply_fn, DIM)
+    g = np.ones((1, DIM), np.float32)
+    leader = threading.Thread(
+        target=c.add, args=(np.array([0], np.int32), g))
+    leader.start()
+    assert first_started.wait(5)
+    followers = [threading.Thread(
+        target=c.add, args=(np.array([i], np.int32), i * g))
+        for i in (1, 2, 3)]
+    for t in followers:
+        t.start()
+    # followers are queued behind the in-flight apply, not applying
+    time.sleep(0.05)
+    assert len(applied) == 1 or not applied
+    release.set()
+    leader.join(5)
+    for t in followers:
+        t.join(5)
+    assert len(applied) == 2  # leader's own + ONE combined batch of 3
+    batch_ids = sorted(applied[1][0].tolist())
+    assert batch_ids == [1, 2, 3]
+    assert obs.maxer("ps_combine_depth").get_value() >= 3
+
+
+def test_combiner_error_propagates_to_every_waiter_then_recovers():
+    calls = []
+
+    def apply_fn(ids, grads):
+        calls.append(ids.size)
+        if len(calls) == 1:
+            raise ValueError("boom")
+
+    c = GradCombiner(apply_fn, DIM)
+    with pytest.raises(ValueError, match="boom"):
+        c.add(np.array([1], np.int32), np.ones((1, DIM), np.float32))
+    assert isinstance(c.last_error, ValueError)
+    # the combiner is not wedged: the next batch applies
+    c.add(np.array([2], np.int32), np.ones((1, DIM), np.float32))
+    assert len(calls) == 2
+
+
+def test_combiner_flush_is_an_applied_barrier():
+    applied = []
+    c = GradCombiner(lambda i, g: applied.append(i.size), DIM)
+    c.add(np.array([1, 2], np.int32), np.ones((2, DIM), np.float32),
+          wait=False)
+    c.flush()
+    assert applied == [2]
+
+
+# ---- byte-level equivalence: unary == combined == streamed ----
+
+def _integer_table(server, rng):
+    """Overwrite the shard's table with exactly-representable values
+    (multiples of 0.5): with integer grads and lr=0.5 every intermediate
+    value is exact in float32, so application ORDER cannot change a
+    single bit — the commutative-sum property the equivalence test
+    needs."""
+    t = rng.integers(-50, 50, server.table.shape).astype(np.float32) * 0.5
+    server.table[:] = t
+    return t.copy()
+
+
+def _hammer(address, chunks, mode):
+    """8 concurrent writers, one chunk each, via `mode`."""
+    def work(chunk):
+        emb = RemoteEmbedding([address], VOCAB, DIM, timeout_ms=30000)
+        try:
+            if mode == "stream":
+                emb.push_gradients(chunk[0], chunk[1])
+                emb.flush_gradients()
+            else:
+                emb.apply_gradients(chunk[0], chunk[1])
+        finally:
+            emb.close()
+    threads = [threading.Thread(target=work, args=(c,)) for c in chunks]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+
+
+def test_unary_combined_stream_byte_equivalence():
+    """The acceptance-criteria proof: the SAME multiset of exact
+    gradient contributions applied through the unary path, the combiner,
+    and the stream (8 concurrent writers each, arbitrary interleavings)
+    lands the byte-identical table — combining is a pure reordering of a
+    commutative sum."""
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, VOCAB, 512).astype(np.int32)
+    grads = rng.integers(-4, 5, (512, DIM)).astype(np.float32)
+    chunks = [(ids[i::8], grads[i::8]) for i in range(8)]
+    tables = {}
+    for mode, kw in (
+            ("unary", {}),
+            ("combined", dict(combine=True)),
+            ("stream", dict(combine=True, stream=True))):
+        s = PsShardServer(VOCAB, DIM, 0, 1, lr=0.5, seed=3,
+                          native_read=True, **kw)
+        try:
+            base = _integer_table(s, np.random.default_rng(5))
+            _hammer(s.address, chunks, mode)
+            tables[mode] = s.table.copy()
+        finally:
+            s.close()
+    expect = base
+    np.subtract.at(expect, ids, 0.5 * grads)
+    for mode, got in tables.items():
+        assert np.array_equal(got, expect), f"{mode} lost/None updates"
+    assert np.array_equal(tables["unary"], tables["combined"])
+    assert np.array_equal(tables["unary"], tables["stream"])
+
+
+# ---- torn-row / no-lost-update stress vs native reads (RACECHECK) ----
+
+def test_combined_writes_race_native_reads_racecheck_clean():
+    """Streamed + unary combined writes racing the NATIVE read path:
+    every row a reader sees is a whole generation snapshot (no torn
+    rows), no update is lost, and RACECHECK reports no lock held across
+    a blocking call on either path."""
+    from brpc_tpu.analysis import race
+
+    vocab, dim = 64, 16
+    race.clear()
+    race.set_enabled(True)
+    try:
+        s = PsShardServer(vocab, dim, 0, 1, lr=0.25, native_read=True,
+                          combine=True, stream=True)
+        ch = rpc.Channel(s.address, timeout_ms=30000)
+        try:
+            init = s.table.copy()
+            all_ids = np.arange(vocab, dtype=np.int32)
+            req_ids = bytes(struct.pack("<i", vocab) + all_ids.tobytes())
+            grad = np.ones((vocab, dim), np.float32)
+            frame = _apply_frame_bytes(all_ids, grad)
+
+            stop = threading.Event()
+            torn = []
+
+            def reader():
+                rch = rpc.Channel(s.address, timeout_ms=30000)
+                try:
+                    while not stop.is_set():
+                        rows = np.frombuffer(
+                            rch.call("Ps", "Lookup", req_ids),
+                            np.float32).reshape(vocab, dim)
+                        d = rows - init
+                        if not np.allclose(d.max(axis=-1), d.min(axis=-1),
+                                           atol=1e-5):
+                            torn.append(d)
+                            return
+                finally:
+                    rch.close()
+
+            readers = [threading.Thread(target=reader) for _ in range(2)]
+            for t in readers:
+                t.start()
+            # 4 unary writers + 2 stream pushers, whole-row deltas
+            rounds = 10
+            def unary_writer():
+                wch = rpc.Channel(s.address, timeout_ms=30000)
+                try:
+                    for _ in range(rounds):
+                        wch.call("Ps", "ApplyGrad", frame)
+                finally:
+                    wch.close()
+
+            def stream_writer():
+                wch = rpc.Channel(s.address, timeout_ms=30000)
+                try:
+                    st = wch.stream("Ps", "StreamApply")
+                    for _ in range(rounds):
+                        st.write(frame)
+                    st.close()
+                    assert st.join(timeout_s=30)
+                finally:
+                    wch.close()
+
+            writers = [threading.Thread(target=unary_writer)
+                       for _ in range(4)]
+            writers += [threading.Thread(target=stream_writer)
+                        for _ in range(2)]
+            for t in writers:
+                t.start()
+            for t in writers:
+                t.join(60)
+            stop.set()
+            for t in readers:
+                t.join(30)
+            assert not torn, "reader saw a torn row"
+            # 6 writers x 10 rounds x lr 0.25 x all-ones = exactly -15.0
+            np.testing.assert_allclose(s.table, init - 15.0, atol=1e-4)
+            assert s.native_lookups > 0
+        finally:
+            ch.close()
+            s.close()
+        blocked = [f for f in race.findings()
+                   if f.kind == "blocking-call"
+                   and ("ps.shard" in f.locks or "ps.combine" in f.locks)]
+        assert blocked == [], race.report()
+    finally:
+        race.set_enabled(None)
+        race.clear()
+
+
+# ---- push_gradients / flush barrier ----
+
+def test_push_gradients_flush_barrier_and_reuse():
+    s = PsShardServer(VOCAB, DIM, 0, 1, lr=0.5, stream=True)
+    emb = RemoteEmbedding([s.address], VOCAB, DIM, timeout_ms=20000)
+    try:
+        base = s.table.copy()
+        ids = np.arange(16, dtype=np.int32)
+        g = np.ones((16, DIM), np.float32)
+        emb.push_gradients(ids, g)
+        emb.flush_gradients()
+        np.testing.assert_allclose(s.table[:16], base[:16] - 0.5,
+                                   atol=1e-6)
+        # streams reopen lazily: a second push round works
+        emb.push_gradients(ids, g)
+        emb.flush_gradients()
+        np.testing.assert_allclose(s.table[:16], base[:16] - 1.0,
+                                   atol=1e-6)
+        assert obs.counter("ps_combined_applies").get_value() > 0
+    finally:
+        emb.close()
+        s.close()
+
+
+def test_stream_frame_error_is_counted_not_fatal():
+    """An out-of-range streamed delta cannot answer an error (frames
+    have no response): it is counted and the shard stays healthy."""
+    before = obs.counter("stream_handler_errors").get_value()
+    s = PsShardServer(VOCAB, DIM, 0, 2, stream=True)  # owns rows [0,128)
+    ch = rpc.Channel(s.address, timeout_ms=10000)
+    try:
+        st = ch.stream("Ps", "StreamApply")
+        bad = _apply_frame_bytes(np.array([200], np.int32),
+                                 np.ones((1, DIM), np.float32))
+        st.write(bad)
+        st.close()
+        assert st.join(timeout_s=10)
+        assert obs.counter("stream_handler_errors").get_value() > before
+        # the unary path still serves
+        req = struct.pack("<i", 1) + np.array([5], np.int32).tobytes()
+        assert len(ch.call("Ps", "Lookup", bytes(req))) == DIM * 4
+    finally:
+        ch.close()
+        s.close()
+
+
+# ---- stream reconnect via a SERVER-side drop rule (PR-5 deferral) ----
+
+def test_server_drop_rule_exercises_real_timeout_path():
+    """A server-side drop rule discards the request pre-dispatch: the
+    handler never runs, no response is written, and the client's REAL
+    deadline expires (ERPCTIMEDOUT after ~timeout, not an instant
+    error)."""
+    ran = []
+    srv = rpc.Server()
+    srv.add_service("E", lambda m, d: ran.append(m) or b"pong")
+    port = srv.start("127.0.0.1:0")
+    plan = fault.FaultPlan([fault.FaultRule(
+        action="drop", side="server", service="E", max_hits=1)])
+    fault.install(plan)
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=300, max_retry=0)
+    try:
+        before = obs.counter("fault_injected_drops").get_value()
+        t0 = time.monotonic()
+        with pytest.raises(rpc.RpcError) as ei:
+            ch.call("E", "Ping")
+        assert ei.value.code == 1008  # the client's own deadline fired
+        assert time.monotonic() - t0 > 0.25
+        assert ran == []  # never dispatched
+        assert obs.counter("fault_injected_drops").get_value() == before + 1
+        assert ch.call("E", "Ping") == b"pong"  # max_hits exhausted
+    finally:
+        fault.clear()
+        ch.close()
+        srv.close()
+
+
+def test_push_reconnects_through_dropped_stream_setup():
+    """The drop rule hits the StreamApply SETUP call: stream creation
+    times out for real, and push_gradients reconnects under the retry
+    budget — closing the loop the PR-5 deferral asked for."""
+    s = PsShardServer(VOCAB, DIM, 0, 1, lr=0.5, stream=True)
+    plan = fault.FaultPlan([fault.FaultRule(
+        action="drop", side="server", service="Ps", method="StreamApply",
+        max_hits=1)])
+    fault.install(plan)
+    emb = RemoteEmbedding(
+        [s.address], VOCAB, DIM, timeout_ms=400,
+        retry=resilience.RetryPolicy(
+            max_attempts=3,
+            backoff=resilience.Backoff(base_ms=5.0, max_ms=20.0)))
+    try:
+        base = s.table.copy()
+        before = obs.counter("ps_stream_reconnects").get_value()
+        ids = np.arange(8, dtype=np.int32)
+        emb.push_gradients(ids, np.ones((8, DIM), np.float32))
+        emb.flush_gradients()
+        np.testing.assert_allclose(s.table[:8], base[:8] - 0.5, atol=1e-6)
+        assert obs.counter("ps_stream_reconnects").get_value() == \
+            before + 1
+        assert plan.hits() == [1]
+    finally:
+        fault.clear()
+        emb.close()
+        s.close()

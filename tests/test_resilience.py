@@ -360,9 +360,49 @@ def test_fault_rule_validation():
     with pytest.raises(ValueError):
         fault.FaultRule(action="explode")
     with pytest.raises(ValueError):
-        fault.FaultRule(action="drop", side="server")  # client-only
-    with pytest.raises(ValueError):
         fault.FaultRule(action="error", side="nowhere")
+    # server-side drop is now a first-class rule (fires in the native
+    # pre-dispatch hook; see server_drop_intercept)
+    r = fault.FaultRule(action="drop", side="server")
+    assert r.side == "server"
+
+
+def test_decide_actions_filter_keeps_counters_separate():
+    """The two decision points (pre-dispatch drop hook vs trampoline
+    error/delay) must not consume each other's hit sequences: an
+    ``actions`` filter skips out-of-scope rules entirely — matched
+    counters untouched."""
+    plan = fault.FaultPlan([
+        fault.FaultRule(action="drop", side="server", max_hits=1),
+        fault.FaultRule(action="error", side="server", max_hits=1),
+    ])
+    # the trampoline path never sees the drop rule
+    rule = plan.decide("server", "S", "M", actions=("error", "delay"))
+    assert rule is not None and rule.action == "error"
+    assert plan.hits() == [0, 1]
+    # the drop path never sees the error rule
+    rule = plan.decide("server", "S", "M", actions=("drop",))
+    assert rule is not None and rule.action == "drop"
+    assert plan.hits() == [1, 1]
+
+
+def test_server_drop_intercept_consults_only_drop_rules():
+    plan = fault.FaultPlan([
+        fault.FaultRule(action="error", side="server"),
+        fault.FaultRule(action="drop", side="server", service="Ps",
+                        max_hits=2),
+    ])
+    # install() would wire the native hook (needs the .so); exercise the
+    # pure decision function directly
+    fault._plan = plan
+    try:
+        assert fault.server_drop_intercept("Ps", "Apply") is True
+        assert fault.server_drop_intercept("Other", "Apply") is False
+        assert fault.server_drop_intercept("Ps", "Apply") is True
+        assert fault.server_drop_intercept("Ps", "Apply") is False  # spent
+        assert plan.hits() == [0, 2]
+    finally:
+        fault._plan = None
 
 
 # ---------------------------------------------------------------------------
