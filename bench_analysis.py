@@ -1,5 +1,5 @@
-"""checked_lock overhead micro-benchmark: the race harness must be free
-when it is off.
+"""Analysis-tier overhead micro-benchmarks: the race harness AND the
+handle ledger must be free when they are off.
 
 `checked_lock()` with BRPC_TPU_RACECHECK unset returns a plain
 ``threading.Lock`` — per-op cost must be indistinguishable from
@@ -9,7 +9,14 @@ full-capture mode (every acquisition captures its stack, ~26µs) and
 sampled mode (``BRPC_TPU_RACECHECK_SAMPLE=N``: every Nth stack, first
 observation of an edge always captured) — sampling must land at ≤ 1/5
 of the full-capture cost to be usable under production-shaped load.
-Emits BENCH_analysis.json next to the BENCH_obs.json series.
+
+The handle ledger (BRPC_TPU_HANDLECHECK) follows the same contract:
+with the env unset, ``rpc._load()`` wraps NOTHING — the native ABI is
+the raw CFuncPtr, ~1.0x by construction (measured against a wrapped-
+but-disabled proxy as the worst case); enabled, the per-handle cost is
+stack capture, and sampling (the same RACECHECK knob) bounds it exactly
+like the lock harness.  Emits BENCH_analysis.json next to the
+BENCH_obs.json series.
 
 Run: JAX_PLATFORMS=cpu python bench_analysis.py
 """
@@ -21,7 +28,7 @@ import os
 import threading
 import time
 
-from brpc_tpu.analysis import race
+from brpc_tpu.analysis import handles, race
 
 
 def _per_op_ns(fn, n: int, *, repeats: int = 5) -> float:
@@ -51,9 +58,88 @@ def _with_loop(lock):
     return run
 
 
+def _note_pair_loop():
+    def run(n):
+        create = handles.note_create
+        destroy = handles.note_destroy
+        for i in range(n):
+            create("bench", 0x10000 + (i & 1023))
+            destroy("bench", 0x10000 + (i & 1023))
+    return run
+
+
+def _bench_handles() -> dict:
+    """Per-handle ledger cost: disabled (the off-mode early return —
+    the worst case of a wrapped-but-disabled ABI; true off-mode installs
+    no wrapper at all), full capture, and sampled capture."""
+    handles.clear()
+    handles.set_enabled(False)
+    race.set_sample(None)
+    n = 100_000
+    off_ns = _per_op_ns(_note_pair_loop(), n)
+    handles.set_enabled(True)
+    full_ns = _per_op_ns(_note_pair_loop(), n // 20)
+    race.set_sample(64)
+    try:
+        sampled_ns = _per_op_ns(_note_pair_loop(), n // 4)
+    finally:
+        race.set_sample(None)
+        handles.set_enabled(None)
+        handles.clear()
+    out = {
+        "unit": "ns per create+destroy pair",
+        "ledger_disabled_ns": round(off_ns, 1),
+        "ledger_full_ns": round(full_ns, 1),
+        "ledger_sampled_ns": round(sampled_ns, 1),
+        "handlecheck_sample_every": 64,
+        "sampled_over_full_ratio": round(sampled_ns / full_ns, 4),
+        "sampled_within_one_fifth_of_full": sampled_ns <= full_ns / 5,
+    }
+    # the real off-mode claim: with HANDLECHECK unset nothing is wrapped
+    # — measure the raw native pair vs the same pair behind a DISABLED
+    # wrapper (the upper bound of what off-mode could ever cost)
+    try:
+        from brpc_tpu import rpc
+        lib = rpc._load()
+        new = lib.brt_event_new
+        destroy = lib.brt_event_destroy
+        if isinstance(new, rpc._LedgerFn):  # env had HANDLECHECK on
+            new, destroy = new._fn, destroy._fn
+        wrapped_new = rpc._LedgerFn(new, "event", True)
+        wrapped_destroy = rpc._LedgerFn(destroy, "event", False)
+
+        def raw(n):
+            for _ in range(n):
+                destroy(new())
+
+        handles.set_enabled(False)
+
+        def wrapped(n):
+            for _ in range(n):
+                wrapped_destroy(wrapped_new())
+
+        raw_ns = _per_op_ns(raw, 20_000)
+        wrapped_off_ns = _per_op_ns(wrapped, 20_000)
+        handles.set_enabled(None)
+        out["native_event_pair_raw_ns"] = round(raw_ns, 1)
+        out["native_event_pair_wrapped_off_ns"] = round(wrapped_off_ns, 1)
+        out["wrapped_off_over_raw_ratio"] = round(wrapped_off_ns / raw_ns,
+                                                  3)
+        # with HANDLECHECK unset _load() installs NO wrapper: the ABI is
+        # the raw CFuncPtr itself — off-mode is 1.0x by construction,
+        # and the wrapped_off ratio above is the bound it never pays
+        out["off_mode_installs_no_wrapper"] = not isinstance(
+            rpc._load().brt_event_new, rpc._LedgerFn) or \
+            handles.enabled()
+    except Exception as e:  # noqa: BLE001 — no native core: skip
+        out["native_event_pair"] = f"skipped: {e}"
+    return out
+
+
 def main() -> dict:
     race.set_enabled(None)
     os.environ.pop("BRPC_TPU_RACECHECK", None)
+    os.environ.pop("BRPC_TPU_HANDLECHECK", None)
 
     plain = threading.Lock()
     off = race.checked_lock("bench.off")
@@ -88,6 +174,7 @@ def main() -> dict:
         "with_stmt_plain_ns": round(_per_op_ns(_with_loop(plain), n), 1),
         "with_stmt_off_ns": round(_per_op_ns(_with_loop(off), n), 1),
         "ops_per_measurement": n,
+        "handle_ledger": _bench_handles(),
     }
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -1,8 +1,9 @@
 """brpc_tpu.analysis — correctness tooling for the fiber/RPC fabric.
 
-Three layers over the hazards the fabric creates (handlers running
+Four layers over the hazards the fabric creates (handlers running
 concurrently on fiber workers with the GIL released across ctypes,
-hand-placed locks, a truncation-prone ctypes boundary):
+hand-placed locks, a truncation-prone ctypes boundary, explicit-destroy
+native handles):
 
 - **call graph** (:mod:`brpc_tpu.analysis.callgraph`): a whole-package
   resolver over the tree's ASTs — module functions, methods through
@@ -26,6 +27,15 @@ hand-placed locks, a truncation-prone ctypes boundary):
   ``brt_*`` calls.  ``BRPC_TPU_RACECHECK_SAMPLE=N`` keeps edge/cycle
   detection exact while sampling stack capture down to production-usable
   cost.
+- **handles** (:mod:`brpc_tpu.analysis.handles`): the dynamic handle
+  ledger — under ``BRPC_TPU_HANDLECHECK=1``, ``rpc._load()`` wraps every
+  owning ``brt_*_new``/``_destroy`` pair so live native handles are
+  tracked with creation stacks (LeakSanitizer-shaped, sampling shared
+  with RACECHECK), cross-checked against the C++ side's own counters
+  (``brt_debug_handle_counts``).  The static complement is the
+  ``handle-lifecycle`` lint check; the tier-1 leak gate in
+  ``tests/conftest.py`` asserts zero net leaked handles per native
+  test.
 
 The native side carries the same tier: ``cpp/.clang-tidy``
 (concurrency + bugprone) and ``cmake -DBRT_SANITIZE=thread|address``.
@@ -43,7 +53,8 @@ from brpc_tpu.analysis.race import (  # noqa: F401
     checked_rwlock,
     note_blocking,
 )
+from brpc_tpu.analysis import handles  # noqa: F401
 from brpc_tpu.analysis import race  # noqa: F401
 
 __all__ = ["checked_lock", "checked_rwlock", "CheckedLock",
-           "CheckedRWLock", "RWLock", "note_blocking", "race"]
+           "CheckedRWLock", "RWLock", "note_blocking", "race", "handles"]

@@ -27,9 +27,16 @@ What gets resolved (edges carry the call site's path + line):
   constructors (the same semantics, one scope down): ``x = Class();
   x.meth()`` resolves, including ``x or Class()`` defaults and across
   nested defs reading the enclosing scope; a local constructed as two
-  different classes is ambiguous and dropped, and calls on call results
-  (``x = factory(); x.meth()``) stay deferred — the factory's return
-  type is not tracked;
+  different classes is ambiguous and dropped;
+- method calls on CALL RESULTS through per-function return-type
+  inference: a function whose ``->`` annotation (incl. string forms,
+  one ``Optional[...]`` layer unwrapped) or whose direct in-package
+  returns (``return Class(...)``, returns of constructor-bound locals,
+  ``return factory()`` chains via a bounded fixpoint) name ONE class
+  lets both ``obs.recorder(name).record(...)`` and ``x = factory();
+  x.meth()`` resolve; conflicting returns are ambiguous and dropped —
+  the factory-call assignment also feeds the attr/local type maps
+  (``self.ch = make_channel()`` types ``self.ch``);
 - constructor calls (``rpc.Server()`` → ``Server.__init__``);
 - ``functools.partial`` targets: ``h = partial(worker, 1); h()``
   resolves to ``worker``, as does calling/constructing the partial
@@ -158,6 +165,10 @@ class CallGraph:
         #: attrs whose every constructor assignment names ONE class
         self._attr_types: Dict[Tuple[str, str, str],
                                Tuple["ModuleInfo", str]] = {}
+        #: node id -> (owning ModuleInfo, class name) for functions whose
+        #: return type resolves to ONE in-package class (annotation, or
+        #: direct in-package returns — see _infer_return_types)
+        self._return_types: Dict[str, Tuple["ModuleInfo", str]] = {}
 
     # -- construction ------------------------------------------------------
 
@@ -358,9 +369,15 @@ class CallGraph:
             src = mi.from_imports.get(f.id)
             if src is not None:
                 target = self._find_module(src[0])
-                if target is not None and target is not mi and \
-                        src[1] in target.classes:
-                    return target, src[1]
+                if target is not None and target is not mi:
+                    if src[1] in target.classes:
+                        return target, src[1]
+                    if src[1] in target.funcs:
+                        # from m import factory; x = factory()
+                        return self._return_types.get(target.funcs[src[1]])
+            if f.id in mi.funcs:
+                # x = local_factory() — the factory's inferred return type
+                return self._return_types.get(mi.funcs[f.id])
             return None
         chain = _dotted_chain(f)
         if chain is None:
@@ -373,8 +390,12 @@ class CallGraph:
             if target is None:
                 continue
             rest = expanded[cut:]
-            if len(rest) == 1 and rest[0] in target.classes:
-                return target, rest[0]
+            if len(rest) == 1:
+                if rest[0] in target.classes:
+                    return target, rest[0]
+                if rest[0] in target.funcs:
+                    # x = mod.factory() — dotted factory call
+                    return self._return_types.get(target.funcs[rest[0]])
             return None
         return None
 
@@ -416,6 +437,130 @@ class CallGraph:
             if len(hits) == 1:
                 out[name] = next(iter(hits.values()))
         return out
+
+    # -- return-type inference (direct in-package returns) -----------------
+
+    def _class_from_dotted(self, parts: List[str], mi: ModuleInfo
+                           ) -> Optional[Tuple["ModuleInfo", str]]:
+        """Resolve a dotted name (['rpc', 'Stream'] / ['Channel']) to an
+        in-package class, through this module's imports."""
+        if len(parts) == 1:
+            name = parts[0]
+            if name in mi.classes:
+                return mi, name
+            src = mi.from_imports.get(name)
+            if src is not None:
+                target = self._find_module(src[0])
+                if target is not None and src[1] in target.classes:
+                    return target, src[1]
+            return None
+        expanded = parts
+        if parts[0] in mi.import_aliases:
+            expanded = mi.import_aliases[parts[0]].split(".") + parts[1:]
+        for cut in range(len(expanded) - 1, 0, -1):
+            target = self._find_module(".".join(expanded[:cut]))
+            if target is None:
+                continue
+            rest = expanded[cut:]
+            if len(rest) == 1 and rest[0] in target.classes:
+                return target, rest[0]
+            return None
+        return None
+
+    def _class_from_annotation(self, ann: Optional[ast.AST], mi: ModuleInfo
+                               ) -> Optional[Tuple["ModuleInfo", str]]:
+        """Resolve a ``-> T`` return annotation to an in-package class.
+        Handles bare/dotted names, string annotations (the `from
+        __future__ import annotations` / forward-reference idiom, incl.
+        quoted dotted forms like ``"rpc.Stream"``), and unwraps a single
+        ``Optional[...]`` layer — an annotated None possibility doesn't
+        change which class's methods resolve."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            text = ann.value.strip().strip("'\"")
+            if text.startswith("Optional[") and text.endswith("]"):
+                text = text[len("Optional["):-1].strip()
+            parts = text.split(".")
+            if all(p.isidentifier() for p in parts):
+                return self._class_from_dotted(parts, mi)
+            return None
+        if isinstance(ann, ast.Subscript) and \
+                _last_name(ann.value) == "Optional":
+            return self._class_from_annotation(ann.slice, mi)
+        parts = _dotted_chain(ann)
+        if parts is not None:
+            return self._class_from_dotted(parts, mi)
+        return None
+
+    def _infer_return_types(self) -> None:
+        """Infer each function's return class from its ``->`` annotation
+        or, failing that, from DIRECT in-package returns: ``return
+        Class(...)``, returns of locals bound to in-package constructors,
+        and ``return factory()`` where the factory's own return type is
+        already known (a bounded fixpoint resolves chains).  Conflicting
+        resolved returns are ambiguous and dropped; unresolved returns
+        neither help nor hurt — the attr-map polarity.  This is what lets
+        call-RESULT method calls resolve (``obs.recorder(name).record``,
+        factory functions)."""
+        for _ in range(4):  # bounded fixpoint: chains are shallow
+            changed = False
+            for node in self.nodes.values():
+                if node.node_id in self._return_types:
+                    continue
+                fn = node.fn
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                mi = self.modules[node.module]
+                rt = self._class_from_annotation(
+                    getattr(fn, "returns", None), mi)
+                if rt is None:
+                    rt = self._returns_from_body(fn, mi)
+                if rt is not None:
+                    self._return_types[node.node_id] = rt
+                    changed = True
+            if not changed:
+                break
+
+    def _returns_from_body(self, fn: ast.AST, mi: ModuleInfo
+                           ) -> Optional[Tuple["ModuleInfo", str]]:
+        local_types = self._local_constructor_types(mi, fn.body)
+        hits: Dict[Tuple[str, str], Tuple["ModuleInfo", str]] = {}
+
+        def scan(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # nested scopes return for themselves
+            if isinstance(node, ast.Return) and node.value is not None:
+                h = None
+                if isinstance(node.value, ast.Name):
+                    h = local_types.get(node.value.id)
+                else:
+                    h = self._class_of_value(node.value, mi)
+                if h is not None:
+                    hits[(h[0].name, h[1])] = h
+            for child in ast.iter_child_nodes(node):
+                scan(child)
+
+        for stmt in fn.body:
+            scan(stmt)
+        return next(iter(hits.values())) if len(hits) == 1 else None
+
+    def return_type(self, node_id: str
+                    ) -> Optional[Tuple["ModuleInfo", str]]:
+        """The class a function's calls evaluate to, when inferred; a
+        constructor (``__init__``) yields its own class."""
+        rt = self._return_types.get(node_id)
+        if rt is not None:
+            return rt
+        node = self.nodes.get(node_id)
+        if node is not None and node.cls is not None and \
+                node.name == "__init__":
+            mi = self.modules[node.module]
+            if node.cls in mi.classes:
+                return mi, node.cls
+        return None
 
     def _build_attr_types(self) -> None:
         """Resolve every class's ``self.<attr> = Class(...)`` assignments
@@ -521,6 +666,18 @@ class CallGraph:
                 # (false) module-level resolution.
                 held = local_types[expr.value.id]
                 return self._method(held[0], held[1], expr.attr)
+            if isinstance(expr.value, ast.Call):
+                # <call>().<meth> — a method on a CALL RESULT: resolve the
+                # inner call, then its inferred return type (factory
+                # functions, obs.recorder(name).record, Class().meth).
+                inner = self.resolve_callable_expr(expr.value.func, ctx,
+                                                   local_partials,
+                                                   local_types)
+                if inner is not None:
+                    rt = self.return_type(inner)
+                    if rt is not None:
+                        return self._method(rt[0], rt[1], expr.attr)
+                return None
             chain = _dotted_chain(expr)
             if chain is not None:
                 return self._resolve_dotted(chain, ctx)
@@ -536,7 +693,10 @@ class CallGraph:
 
     def extract_edges(self) -> None:
         # All modules are loaded by now, so cross-module constructor
-        # assignments resolve; the map must exist before any edge walk.
+        # assignments resolve; the maps must exist before any edge walk.
+        # Return types FIRST: the attr/local type maps consult them for
+        # factory-call assignments (self.x = make_channel()).
+        self._infer_return_types()
         self._build_attr_types()
         for mi in self.modules.values():
             # module top-level code gets a pseudo-node so inline lambdas /

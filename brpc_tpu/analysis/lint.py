@@ -3,7 +3,7 @@
 The reference enforces its concurrency contracts with purpose-built
 tooling (contention profiler, bthread diagnostics, builtin hazard pages);
 this is the equivalent static pass for the hazards our fabric creates.
-Five checks, each encoding an invariant the runtime cannot enforce, the
+Seven checks, each encoding an invariant the runtime cannot enforce, the
 concurrency ones interprocedural over the whole-package call graph
 (:mod:`brpc_tpu.analysis.callgraph` — the lockdep/TSan polarity: follow
 the calls, not the file):
@@ -56,6 +56,25 @@ the calls, not the file):
   ``Backoff``: deadline-capped, deterministically jittered) — calls
   resolving into that module are not followed, and its own sleeps are
   exempt.
+- ``handle-lifecycle`` — every call that returns an OWNING native
+  handle (constructors/factories of ``rpc``'s owner classes — Server,
+  Channel, PendingCall, CallGroup, Stream, PsShard, DeviceClient,
+  DeviceExecutable — plus in-package functions inferred to return a
+  fresh one) must, on every normal-flow path, reach its release
+  (``close``/``join``/``abort``), be returned to the caller, or be
+  stored on an object whose own close-style method releases it
+  (ownership transfer, audited through the attr/local/return type
+  maps).  Escapes into containers or thread targets are reported;
+  deliberate registries carry ``# lint: allow-handle-escape``.  The
+  flow analysis is may-leak at explicit exits (an early ``return``
+  with a live handle is THE classic leak) and trusts a release seen on
+  any branch (the guard idiom) — no false positives from merges.
+  Exception paths (``raise``, a callee throwing) are out of scope
+  (ROADMAP deferral).  The ABI half audits ``rpc._load()``'s restype
+  registry itself: every ``c_void_p``-returning constructor symbol
+  needs its destroy symbol declared.  The dynamic complement is the
+  handle ledger (:mod:`brpc_tpu.analysis.handles`,
+  ``BRPC_TPU_HANDLECHECK=1``).
 
 Findings carry a stable id (hash of check + package-relative path +
 message, deliberately line-free) so CI can diff against an accepted
@@ -86,11 +105,12 @@ __all__ = ["Finding", "run_lint", "lint_files", "main", "ALL_CHECKS",
            "load_baseline", "apply_baseline"]
 
 ALL_CHECKS = ("ctypes-contract", "fiber-shared-state", "obs-guard",
-              "trace-purity", "lock-order", "fiber-blocking-sleep")
+              "trace-purity", "lock-order", "fiber-blocking-sleep",
+              "handle-lifecycle")
 
 #: checks that need the whole-package call graph
 _GRAPH_CHECKS = {"fiber-shared-state", "trace-purity", "lock-order",
-                 "fiber-blocking-sleep"}
+                 "fiber-blocking-sleep", "handle-lifecycle"}
 
 #: attribute names that look like a lock on self / a module
 _LOCKISH = ("mu", "lock", "mutex")
@@ -121,6 +141,52 @@ _ALLOW_HOST_CB = "lint: allow-host-callback"
 #: which by design runs once per trace and must not be reported as a
 #: vanishing side effect)
 _ALLOW_TRACE_IMPURE = "lint: allow-trace-impure"
+#: pragma declaring a DELIBERATE handle escape (a managed registry /
+#: fan-out set whose owner releases its members out of the static
+#: check's sight) — suppresses handle-lifecycle escape/leak findings on
+#: that line
+_ALLOW_HANDLE_ESCAPE = "lint: allow-handle-escape"
+
+# ---- handle-lifecycle owner tables -----------------------------------------
+# Owning native-handle classes of brpc_tpu.rpc (each wraps a brt_* handle
+# that MUST be explicitly destroyed) -> the methods that release it.  The
+# table mirrors rpc._load()'s restype registry: every class here fronts a
+# brt_* constructor declared with a c_void_p restype (the ABI-pairing
+# sub-check below keeps that registry itself paired new<->destroy).
+_HANDLE_OWNERS: Dict[str, frozenset] = {
+    "Server": frozenset({"close"}),
+    "Channel": frozenset({"close"}),
+    "PendingCall": frozenset({"join", "close"}),
+    "CallGroup": frozenset({"close"}),
+    "Stream": frozenset({"close", "abort"}),
+    "PsShard": frozenset({"close"}),
+    "DeviceClient": frozenset({"close"}),
+    "DeviceExecutable": frozenset({"close"}),
+}
+#: factory methods returning a FRESH owning handle: (class, method) ->
+#: produced owner class
+_HANDLE_FACTORIES = {
+    ("Channel", "call_async"): "PendingCall",
+    ("Channel", "stream"): "Stream",
+    ("DeviceClient", "compile"): "DeviceExecutable",
+}
+#: method-NAME fallback for receivers the type maps cannot resolve
+#: (`self.channels[s].call_async(...)`): the name is unambiguous enough
+#: to imply ownership even without a resolved receiver
+_FACTORY_NAME_FALLBACK = {"call_async": "PendingCall"}
+#: methods whose body counts as "releases what self.<attr> holds" for
+#: the ownership-transfer audit of attr-stored handles
+_RELEASEISH_METHODS = {"close", "stop", "shutdown", "abort", "__exit__",
+                       "__del__", "clear", "reset"}
+#: ABI pairing for c_void_p-returning symbols that don't follow the
+#: brt_X_new -> brt_X_destroy naming rule
+_ABI_NEW_PAIRS = {
+    "brt_channel_call_start": "brt_call_destroy",
+    "brt_channel_call_start_opts": "brt_call_destroy",
+    "brt_device_compile": "brt_device_executable_destroy",
+    "brt_mlir_module": "brt_free",
+    "brt_debug_handle_counts": "brt_free",
+}
 
 
 def _stable_path(path: str) -> str:
@@ -297,6 +363,9 @@ class _FileScan:
         # ctypes-contract
         self.native_decls: Dict[str, Set[str]] = {}  # brt_x -> declared kinds
         self.native_uses: List[Tuple[str, int]] = []  # (brt_x, line)
+        # brt_x -> (restype name, decl line) — the restype registry the
+        # handle-lifecycle ABI-pairing sub-check audits
+        self.native_restypes: Dict[str, Tuple[str, int]] = {}
         self.cfunctype_protos: Set[str] = set()
         # obs-guard bookkeeping: names bound to obs modules / obs imports
         self.obs_module_aliases: Set[str] = set()
@@ -308,7 +377,7 @@ class _FileScan:
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Assign):
                 for tgt in node.targets:
-                    self._note_decl(tgt, decl_nodes)
+                    self._note_decl(tgt, node.value, decl_nodes)
                 if isinstance(node.value, ast.Call) and \
                         _last_name(node.value.func) == "CFUNCTYPE":
                     for tgt in node.targets:
@@ -337,13 +406,19 @@ class _FileScan:
                     node.attr.startswith("brt_") and id(node) not in decl_nodes:
                 self.native_uses.append((node.attr, node.lineno))
 
-    def _note_decl(self, tgt: ast.AST, decl_nodes: Set[int]) -> None:
+    def _note_decl(self, tgt: ast.AST, value: ast.AST,
+                   decl_nodes: Set[int]) -> None:
         if isinstance(tgt, ast.Attribute) and \
                 tgt.attr in ("argtypes", "restype") and \
                 isinstance(tgt.value, ast.Attribute) and \
                 tgt.value.attr.startswith("brt_"):
             self.native_decls.setdefault(tgt.value.attr, set()).add(tgt.attr)
             decl_nodes.add(id(tgt.value))
+            if tgt.attr == "restype":
+                rname = _last_name(value)
+                if rname is not None:
+                    self.native_restypes[tgt.value.attr] = (rname,
+                                                            tgt.lineno)
 
     def line_has(self, lineno: int, marker: str) -> bool:
         if 1 <= lineno <= len(self.src_lines):
@@ -1005,14 +1080,16 @@ def _check_lock_order(scans: List[_FileScan],
     if not mod_locks and not cls_locks:
         return []
 
-    def resolve_lock(expr: ast.AST, node: FuncNode) -> Optional[str]:
+    def resolve_lock(expr: ast.AST, node: FuncNode,
+                     param_locks: Optional[Dict[str, str]] = None
+                     ) -> Optional[str]:
         if isinstance(expr, ast.Call):
             # rwlock sides: `with rw.read():` / `.write()` acquire under
             # the lock's one name, exactly as the dynamic harness keys
             # them (a read-vs-write split would hide r/w inversions).
             f = expr.func
             if isinstance(f, ast.Attribute) and f.attr in _RW_SIDES:
-                return resolve_lock(f.value, node)
+                return resolve_lock(f.value, node, param_locks)
             return None
         if isinstance(expr, ast.Attribute):
             if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
@@ -1033,29 +1110,64 @@ def _check_lock_order(scans: List[_FileScan],
                     return mod_locks.get(target.name, {}).get(expr.attr)
             return None
         if isinstance(expr, ast.Name):
+            if param_locks and expr.id in param_locks:
+                # a lock received as a function PARAMETER, named by
+                # binding the caller's argument through the call graph
+                return param_locks[expr.id]
             return mod_locks.get(node.module, {}).get(expr.id)
         return None
 
     # acquisition edges: (held, acquired) -> first site (path, line, chain)
     edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
     adj: Dict[str, Set[str]] = {}
-    memo: Set[Tuple[str, Tuple[str, ...]]] = set()
+    memo: Set[Tuple[str, Tuple[str, ...], Tuple[Tuple[str, str], ...]]] = \
+        set()
+
+    def callee_bindings(call: ast.Call, node: FuncNode,
+                        callee: FuncNode,
+                        params: Dict[str, str]) -> Dict[str, str]:
+        """Bind lock-valued arguments of `call` to the callee's parameter
+        names, so `def use(lk): with lk:` acquires under the CALLER's
+        lock name (shrinks the PR-3 param-passed-lock blind spot;
+        container-stored locks stay deferred)."""
+        cargs = getattr(callee.fn, "args", None)
+        if cargs is None:
+            return {}
+        names = [a.arg for a in (list(cargs.posonlyargs) +
+                                 list(cargs.args))]
+        offset = 1 if callee.cls is not None and names and \
+            names[0] == "self" else 0
+        out: Dict[str, str] = {}
+        for i, arg in enumerate(call.args):
+            ln = resolve_lock(arg, node, params)
+            if ln is not None and offset + i < len(names):
+                out[names[offset + i]] = ln
+        kw_ok = set(names) | {a.arg for a in cargs.kwonlyargs}
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            ln = resolve_lock(kw.value, node, params)
+            if ln is not None and kw.arg in kw_ok:
+                out[kw.arg] = ln
+        return out
 
     def walk(node_id: str, held: Tuple[str, ...],
-             chain: Tuple[str, ...]) -> None:
-        key = (node_id, tuple(sorted(set(held))))
+             chain: Tuple[str, ...],
+             param_locks: Tuple[Tuple[str, str], ...] = ()) -> None:
+        key = (node_id, tuple(sorted(set(held))), param_locks)
         if key in memo or len(chain) > 25:
             return
         memo.add(key)
         node = graph.nodes.get(node_id)
         if node is None:
             return
+        params = dict(param_locks)
 
         def scan(n: ast.AST, held: Tuple[str, ...]) -> None:
             if isinstance(n, (ast.With, ast.AsyncWith)):
                 new_held = held
                 for item in n.items:
-                    ln = resolve_lock(item.context_expr, node)
+                    ln = resolve_lock(item.context_expr, node, params)
                     if ln is None:
                         continue
                     for h in new_held:
@@ -1074,8 +1186,11 @@ def _check_lock_order(scans: List[_FileScan],
             if isinstance(n, ast.Call):
                 tgt = graph.call_target(n)
                 if tgt is not None and tgt in graph.nodes:
+                    callee = graph.nodes[tgt]
+                    bound = callee_bindings(n, node, callee, params)
                     walk(tgt, held,
-                         chain + (_node_display(graph.nodes[tgt]),))
+                         chain + (_node_display(callee),),
+                         tuple(sorted(bound.items())))
             for child in ast.iter_child_nodes(n):
                 scan(child, held)
 
@@ -1107,6 +1222,612 @@ def _check_lock_order(scans: List[_FileScan],
             f"'{a}' (in {chain_desc}) closes the cycle "
             f"{' -> '.join([a] + cyc)} — the two orders can deadlock under "
             f"the right interleaving{opp_desc}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# check: handle-lifecycle (interprocedural ownership over the call graph)
+# ---------------------------------------------------------------------------
+
+class _HBinding:
+    """One live owned handle bound to a local name.  Branch copies of the
+    flow state SHARE binding objects, so a release observed on any path
+    marks the same object every sibling path sees — reporting stays
+    may-leak at explicit exits (the state at THAT point) and must-leak
+    nowhere (no false positives from merge order)."""
+
+    __slots__ = ("kind", "line", "origin", "released")
+
+    def __init__(self, kind: str, line: int, origin: str = ""):
+        self.kind = kind
+        self.line = line
+        self.origin = origin
+        self.released = False
+
+
+def _handle_producer_nodes(graph: CallGraph) -> Dict[str, str]:
+    """node id -> produced owner class, for the constructors and factory
+    methods of the ``rpc`` module's owner table."""
+    producers: Dict[str, str] = {}
+    for mi in graph.modules.values():
+        if mi.name != "brpc_tpu.rpc" and mi.name.split(".")[-1] != "rpc":
+            continue
+        for cls in _HANDLE_OWNERS:
+            ci = mi.classes.get(cls)
+            if ci is not None and "__init__" in ci.methods:
+                producers[ci.methods["__init__"]] = cls
+        for (cls, meth), kind in _HANDLE_FACTORIES.items():
+            ci = mi.classes.get(cls)
+            if ci is not None and meth in ci.methods:
+                producers[ci.methods[meth]] = kind
+    return producers
+
+
+def _name_chain(expr: ast.AST) -> Optional[List[str]]:
+    """['rpc', 'Channel'] for ``rpc.Channel``; None unless Name-rooted."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return list(reversed(parts))
+    return None
+
+
+def _is_rpc_module_name(name: str) -> bool:
+    return name == "brpc_tpu.rpc" or name.split(".")[-1] == "rpc"
+
+
+def _producer_kind(call: ast.Call, graph: CallGraph, module: str,
+                   producers: Dict[str, str],
+                   sources: Dict[str, Tuple[str, str]]
+                   ) -> Optional[Tuple[str, str]]:
+    """(owner kind, origin description) when this call returns a FRESH
+    owning handle; None otherwise.  ``module`` is the calling module (for
+    import-aware constructor resolution)."""
+    tgt = graph.call_target(call)
+    if tgt is not None:
+        kind = producers.get(tgt)
+        if kind is not None:
+            return kind, ""
+        src = sources.get(tgt)
+        if src is not None:
+            return src
+        return None
+    f = call.func
+    # Constructor of an owner class (covers classes whose __init__ is
+    # inherited/implicit, where no call edge exists)
+    parts = _name_chain(f)
+    mi = graph.modules.get(module)
+    if parts is not None and mi is not None:
+        hit = graph._class_from_dotted(parts, mi)
+        if hit is not None and _is_rpc_module_name(hit[0].name) and \
+                hit[1] in _HANDLE_OWNERS:
+            return hit[1], ""
+    if isinstance(f, ast.Attribute) and f.attr in _FACTORY_NAME_FALLBACK:
+        return _FACTORY_NAME_FALLBACK[f.attr], ""
+    return None
+
+
+def _handle_sources(graph: CallGraph, producers: Dict[str, str]
+                    ) -> Dict[str, Tuple[str, str]]:
+    """Functions that hand a FRESH owning handle to their caller: every
+    valued top-scope ``return`` is a producer call, or a local whose
+    every top-scope assignment is a producer call of one kind (``return
+    None`` error arms are neutral).  Cached accessors — a local that is
+    ALSO assigned from a dict lookup, like ``obs.recorder`` — do not
+    qualify: they return a handle the callee still owns, and claiming
+    ownership at the caller would be a false finding."""
+    sources: Dict[str, Tuple[str, str]] = {}
+    for node in graph.nodes.values():
+        fn = node.fn
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) or \
+                node.node_id in producers:
+            continue
+        # top-scope assignments per local name (nested scopes excluded)
+        assigns: Dict[str, List[ast.AST]] = {}
+        returns: List[ast.expr] = []
+
+        def scan(n: ast.AST) -> None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                assigns.setdefault(n.targets[0].id, []).append(n.value)
+            elif isinstance(n, ast.Return) and n.value is not None:
+                returns.append(n.value)
+            for child in ast.iter_child_nodes(n):
+                scan(child)
+
+        for stmt in fn.body:
+            scan(stmt)
+        kinds: Set[str] = set()
+        fresh = bool(returns)
+        for value in returns:
+            if isinstance(value, ast.Constant) and value.value is None:
+                continue  # error arm: neutral
+            pk = _producer_kind(value, graph, node.module,
+                                producers, {}) \
+                if isinstance(value, ast.Call) else None
+            if pk is not None:
+                kinds.add(pk[0])
+                continue
+            if isinstance(value, ast.Name):
+                vals = assigns.get(value.id, [])
+                val_kinds = set()
+                ok = bool(vals)
+                for v in vals:
+                    p = _producer_kind(v, graph, node.module,
+                                       producers, {}) \
+                        if isinstance(v, ast.Call) else None
+                    if p is None:
+                        ok = False  # mixed origin: may be a cached handle
+                        break
+                    val_kinds.add(p[0])
+                if ok and len(val_kinds) == 1:
+                    kinds.add(next(iter(val_kinds)))
+                    continue
+            fresh = False
+            break
+        if fresh and len(kinds) == 1:
+            kind = next(iter(kinds))
+            sources[node.node_id] = (
+                kind, f" (fresh {kind} produced by {_node_display(node)})")
+    return sources
+
+
+def _self_attr_of(tgt: ast.AST) -> Optional[str]:
+    """'attr' for self.<attr> or self.<attr>[...] targets, else None."""
+    if isinstance(tgt, ast.Subscript):
+        tgt = tgt.value
+    if isinstance(tgt, ast.Attribute) and \
+            isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+        return tgt.attr
+    return None
+
+
+def _check_handle_lifecycle(scans: List[_FileScan],
+                            graph: CallGraph) -> List[Finding]:
+    sc_by_path = {sc.path: sc for sc in scans}
+    producers = _handle_producer_nodes(graph)
+    findings: List[Finding] = []
+    findings.extend(_check_abi_pairing(scans))
+    if not producers:
+        return findings
+    sources = _handle_sources(graph, producers)
+    # (module, class, attr, kind, line, path) for the attr-store audit
+    attr_stores: List[Tuple[str, str, str, str, int, str]] = []
+    for node in graph.nodes.values():
+        if not isinstance(node.fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sc = sc_by_path.get(node.path)
+        if sc is None:
+            continue
+        _flow_handles(sc, graph, node, producers, sources, attr_stores,
+                      findings)
+    findings.extend(_audit_attr_stores(attr_stores, graph, sc_by_path))
+    return findings
+
+
+def _check_abi_pairing(scans: List[_FileScan]) -> List[Finding]:
+    """The restype-registry half: every c_void_p-returning constructor
+    symbol must have its destroy symbol declared in the same tree — a
+    handle type nothing can free leaks by construction."""
+    restypes: Dict[str, Tuple[str, int, str]] = {}
+    declared: Set[str] = set()
+    for sc in scans:
+        declared.update(sc.native_decls)
+        for name, (rname, line) in sc.native_restypes.items():
+            restypes.setdefault(name, (rname, line, sc.path))
+    findings: List[Finding] = []
+    for name in sorted(restypes):
+        rname, line, path = restypes[name]
+        if rname != "c_void_p":
+            continue
+        if name in _ABI_NEW_PAIRS:
+            expected = _ABI_NEW_PAIRS[name]
+        elif name.endswith("_new"):
+            expected = name[:-len("_new")] + "_destroy"
+        else:
+            continue
+        if expected not in declared:
+            findings.append(Finding(
+                "handle-lifecycle", path, line,
+                f"constructor symbol '{name}' returns an owning c_void_p "
+                f"handle but its destroy symbol '{expected}' is not "
+                f"declared anywhere in the scanned tree — handles of this "
+                f"type cannot be freed"))
+    return findings
+
+
+def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
+                  producers: Dict[str, str],
+                  sources: Dict[str, Tuple[str, str]],
+                  attr_stores: List[Tuple[str, str, str, str, int, str]],
+                  findings: List[Finding]) -> None:
+    """Abstract interpretation of one function body: owning handles must
+    reach a release on every normal-flow path, be returned, be stored on
+    self (audited separately), or carry the escape pragma.  Exception
+    paths (`raise`, a callee throwing) are out of scope — recorded as a
+    deferral in ROADMAP."""
+    display = _node_display(node)
+
+    def kind_of(call: ast.Call) -> Optional[Tuple[str, str]]:
+        return _producer_kind(call, graph, node.module, producers,
+                              sources)
+
+    def allow(line: int) -> bool:
+        return sc.line_has(line, _ALLOW_HANDLE_ESCAPE)
+
+    def releases_of(kind: str) -> frozenset:
+        return _HANDLE_OWNERS.get(kind, frozenset({"close"}))
+
+    def report(line: int, msg: str) -> None:
+        if not allow(line):
+            findings.append(Finding("handle-lifecycle", sc.path, line, msg))
+
+    # producer calls consumed inline by a chained release
+    # (`ch.call_async(...).join()`): collected up front, skipped later
+    consumed: Set[int] = set()
+    for n in ast.walk(node.fn):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and isinstance(n.func.value, ast.Call):
+            pk = kind_of(n.func.value)
+            if pk is not None and n.func.attr in releases_of(pk[0]):
+                consumed.add(id(n.func.value))
+
+    def release_name(state: Dict[str, _HBinding], name: str) -> None:
+        b = state.get(name)
+        if b is not None:
+            b.released = True
+
+    def scan_expr(n: ast.AST, state: Dict[str, _HBinding],
+                  transfer: bool) -> None:
+        """Generic walk of an expression: classifies producer calls and
+        owned-name stores that the statement dispatch didn't already
+        claim.  `transfer` marks return-value context (everything the
+        expression mentions goes to the caller)."""
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return  # nested scopes audit themselves
+        if isinstance(n, ast.Call):
+            f = n.func
+            # x.close() / x.join() — release of an owned local
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name):
+                b = state.get(f.value.id)
+                if b is not None and f.attr in releases_of(b.kind):
+                    b.released = True
+            # container.append(x) / registry.add(x): ownership moves
+            # into a container the check cannot follow
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                for arg in n.args:
+                    for leaf in ast.walk(arg):
+                        if isinstance(leaf, ast.Name) and \
+                                leaf.id in state and \
+                                not state[leaf.id].released:
+                            report(n.lineno,
+                                   f"{display}: owned "
+                                   f"{state[leaf.id].kind} '{leaf.id}' "
+                                   f"escapes into a container via "
+                                   f".{f.attr}() — the static check "
+                                   f"cannot see its release; mark a "
+                                   f"deliberate registry with "
+                                   f"`# {_ALLOW_HANDLE_ESCAPE}`")
+                            state[leaf.id].released = True
+            # threading.Thread(target=..., args=(x,)): the handle's
+            # lifetime now belongs to a thread this walk can't follow
+            if _last_name(f) == "Thread":
+                for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                    for leaf in ast.walk(arg):
+                        if isinstance(leaf, ast.Name) and \
+                                leaf.id in state and \
+                                not state[leaf.id].released:
+                            report(n.lineno,
+                                   f"{display}: owned "
+                                   f"{state[leaf.id].kind} '{leaf.id}' "
+                                   f"escapes into a thread target — "
+                                   f"release moves off every path this "
+                                   f"check walks; mark deliberate "
+                                   f"hand-off with "
+                                   f"`# {_ALLOW_HANDLE_ESCAPE}`")
+                            state[leaf.id].released = True
+            pk = kind_of(n) if id(n) not in consumed else None
+            if pk is not None:
+                if transfer:
+                    pass  # returned to the caller: its obligation now
+                else:
+                    # a fresh handle with no binding in a non-transfer
+                    # context: argument passing transfers ownership to
+                    # the callee (under-approximation); everything else
+                    # is a drop, reported by the statement dispatch
+                    pass
+        if isinstance(n, ast.Name) and transfer:
+            release_name(state, n.id)
+        for child in ast.iter_child_nodes(n):
+            scan_expr(child, state, transfer)
+
+    def container_producers(value: ast.AST) -> List[ast.Call]:
+        """Producer calls nested under a non-call expression (list/tuple/
+        dict literals, comprehensions, conditionals)."""
+        out = []
+        for leaf in ast.walk(value):
+            if isinstance(leaf, ast.Call) and id(leaf) not in consumed:
+                if kind_of(leaf) is not None:
+                    out.append(leaf)
+        return out
+
+    def finally_releases(finalbody: List[ast.AST]) -> Set[str]:
+        """Names a finally block releases (context-insensitively: any
+        `x.<release>()` or transfer anywhere inside it counts — finally
+        runs on every exit, which is the whole point of the idiom)."""
+        names: Set[str] = set()
+        for stmt in finalbody:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        isinstance(n.func.value, ast.Name) and \
+                        n.func.attr in {m for rel in _HANDLE_OWNERS.values()
+                                        for m in rel} | {"cancel"}:
+                    names.add(n.func.value.id)
+        return names
+
+    def report_exit(state: Dict[str, _HBinding], line: int,
+                    finally_rel: Set[str], where: str) -> None:
+        for name, b in sorted(state.items()):
+            if b.released or name in finally_rel:
+                continue
+            if allow(b.line):
+                continue
+            report(line,
+                   f"{display}: {b.kind} '{name}' (created line {b.line}"
+                   f"{b.origin}) is still live at this {where} — this "
+                   f"path leaks the native handle; release it "
+                   f"({'/'.join(sorted(releases_of(b.kind)))}), return "
+                   f"it, or store it on an owner whose close releases it")
+
+    def exec_block(stmts: List[ast.AST], state: Dict[str, _HBinding],
+                   finally_rel: Set[str]
+                   ) -> Tuple[Dict[str, _HBinding], bool]:
+        """Returns (state after the block, terminated-by-return/raise)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    scan_expr(stmt.value, state, transfer=True)
+                report_exit(state, stmt.lineno, finally_rel,
+                            "early return" if stmt is not stmts[-1]
+                            or stmt.value is None else "return")
+                return state, True
+            if isinstance(stmt, ast.Raise):
+                # exception paths: out of scope (ROADMAP deferral) — the
+                # caller's except/finally may still release
+                return state, True
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                _exec_assign(stmt, state)
+                continue
+            if isinstance(stmt, ast.Expr):
+                _exec_expr_stmt(stmt, state)
+                continue
+            if isinstance(stmt, ast.If):
+                s1, t1 = exec_block(list(stmt.body), dict(state),
+                                    finally_rel)
+                s2, t2 = exec_block(list(stmt.orelse), dict(state),
+                                    finally_rel)
+                if t1 and t2:
+                    return state, True
+                merged: Dict[str, _HBinding] = {}
+                for s in ([s1] if not t1 else []) + \
+                         ([s2] if not t2 else []):
+                    for name, b in s.items():
+                        if name not in merged or (merged[name].released
+                                                  and not b.released):
+                            merged[name] = b
+                state = merged
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                scan_expr(getattr(stmt, "iter", None) or stmt.test,
+                          state, transfer=False)
+                body_state, _t = exec_block(list(stmt.body), dict(state),
+                                            finally_rel)
+                for name, b in body_state.items():
+                    if name not in state:
+                        state[name] = b
+                exec_block(list(stmt.orelse), state, finally_rel)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    pk = kind_of(item.context_expr) \
+                        if isinstance(item.context_expr, ast.Call) else None
+                    if pk is not None and \
+                            isinstance(item.optional_vars, ast.Name):
+                        state[item.optional_vars.id] = _HBinding(
+                            pk[0], stmt.lineno, pk[1])
+                    else:
+                        scan_expr(item.context_expr, state, transfer=False)
+                state, t = exec_block(list(stmt.body), state, finally_rel)
+                if t:
+                    return state, True
+                continue
+            if isinstance(stmt, ast.Try):
+                fin_rel = finally_rel | finally_releases(
+                    list(stmt.finalbody))
+                body_state, body_t = exec_block(list(stmt.body),
+                                                dict(state), fin_rel)
+                branch_states = [] if body_t else [body_state]
+                if not body_t and stmt.orelse:
+                    body_state, t2 = exec_block(list(stmt.orelse),
+                                                body_state, fin_rel)
+                    branch_states = [] if t2 else [body_state]
+                for handler in stmt.handlers:
+                    h_state, h_t = exec_block(list(handler.body),
+                                              dict(state), fin_rel)
+                    if not h_t:
+                        branch_states.append(h_state)
+                merged = {}
+                for s in branch_states:
+                    for name, b in s.items():
+                        if name not in merged or (merged[name].released
+                                                  and not b.released):
+                            merged[name] = b
+                merged, fin_t = exec_block(list(stmt.finalbody), merged,
+                                           finally_rel)
+                if not branch_states or fin_t:
+                    return merged, True
+                state = merged
+                continue
+            # anything else: scan its expressions generically
+            for child in ast.iter_child_nodes(stmt):
+                scan_expr(child, state, transfer=False)
+        return state, False
+
+    def _exec_assign(stmt, state: Dict[str, _HBinding]) -> None:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = stmt.value
+        if value is None:
+            return
+        pk = kind_of(value) if isinstance(value, ast.Call) and \
+            id(value) not in consumed else None
+        name_tgts = [t for t in targets if isinstance(t, ast.Name)]
+        attr_tgts = [a for a in (_self_attr_of(t) for t in targets)
+                     if a is not None]
+        sub_local_tgts = [t for t in targets
+                          if isinstance(t, ast.Subscript)
+                          and _self_attr_of(t) is None]
+        if pk is not None:
+            kind, origin = pk
+            if attr_tgts:
+                for attr in attr_tgts:
+                    if node.cls is not None:
+                        attr_stores.append((node.module, node.cls, attr,
+                                            kind, stmt.lineno, sc.path))
+                if name_tgts:  # exe = self._cache[k] = producer(): both
+                    for t in name_tgts:
+                        state[t.id] = _HBinding(kind, stmt.lineno, origin)
+                        state[t.id].released = True  # the attr owns it
+                return
+            if sub_local_tgts:
+                report(stmt.lineno,
+                       f"{display}: fresh {kind} stored straight into a "
+                       f"container — its release is invisible to the "
+                       f"static check; mark a deliberate registry with "
+                       f"`# {_ALLOW_HANDLE_ESCAPE}`")
+                return
+            if name_tgts:
+                for t in name_tgts:
+                    state[t.id] = _HBinding(kind, stmt.lineno, origin)
+                return
+        # owned name moved onto self.<attr> / into a container
+        if isinstance(value, ast.Name) and value.id in state:
+            b = state[value.id]
+            if attr_tgts and not b.released:
+                for attr in attr_tgts:
+                    if node.cls is not None:
+                        attr_stores.append((node.module, node.cls, attr,
+                                            b.kind, stmt.lineno, sc.path))
+                b.released = True
+                return
+            if sub_local_tgts and not b.released:
+                report(stmt.lineno,
+                       f"{display}: owned {b.kind} '{value.id}' escapes "
+                       f"into a container — mark a deliberate registry "
+                       f"with `# {_ALLOW_HANDLE_ESCAPE}`")
+                b.released = True
+                return
+        # producers nested deeper (container literals, comprehensions,
+        # conditionals) assigned somewhere
+        nested = container_producers(value)
+        if nested:
+            if attr_tgts:
+                for call in nested:
+                    k = kind_of(call)[0]
+                    for attr in attr_tgts:
+                        if node.cls is not None:
+                            attr_stores.append((node.module, node.cls,
+                                                attr, k, stmt.lineno,
+                                                sc.path))
+            else:
+                for call in nested:
+                    k = kind_of(call)[0]
+                    report(call.lineno,
+                           f"{display}: fresh {k} constructed inside a "
+                           f"local container/expression — no name owns "
+                           f"it, so no release path exists; bind it "
+                           f"first or mark a deliberate registry with "
+                           f"`# {_ALLOW_HANDLE_ESCAPE}`")
+        scan_expr(value, state, transfer=False)
+
+    def _exec_expr_stmt(stmt: ast.Expr,
+                        state: Dict[str, _HBinding]) -> None:
+        value = stmt.value
+        if isinstance(value, ast.Call) and id(value) not in consumed:
+            pk = kind_of(value)
+            if pk is not None:
+                kind, origin = pk
+                report(stmt.lineno,
+                       f"{display}: result of this call is a fresh "
+                       f"{kind}{origin} and is DROPPED — the native "
+                       f"handle leaks immediately; bind it and release "
+                       f"it ({'/'.join(sorted(releases_of(kind)))})")
+                return
+        scan_expr(value, state, transfer=False)
+
+    end_state, terminated = exec_block(list(node.fn.body), {}, set())
+    if not terminated:
+        last = node.fn.body[-1]
+        report_exit(end_state, getattr(last, "lineno", node.fn.lineno),
+                    set(), "fall-through function exit")
+
+
+def _audit_attr_stores(
+        attr_stores: List[Tuple[str, str, str, str, int, str]],
+        graph: CallGraph,
+        sc_by_path: Dict[str, _FileScan]) -> List[Finding]:
+    """Ownership-transfer audit: a handle stored on ``self.<attr>`` is
+    properly owned only if its class has a release-ish method whose body
+    touches that attr (``close`` iterating ``self.channels``, etc.)."""
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str, str]] = set()
+    for module, cls, attr, kind, line, path in attr_stores:
+        key = (module, cls, attr)
+        if key in seen:
+            continue
+        seen.add(key)
+        mi = graph.modules.get(module)
+        ci = mi.classes.get(cls) if mi is not None else None
+        if ci is None:
+            continue
+        released = False
+        for meth_name, node_id in ci.methods.items():
+            if meth_name not in _RELEASEISH_METHODS:
+                continue
+            meth = graph.nodes.get(node_id)
+            if meth is None:
+                continue
+            for n in ast.walk(meth.fn):
+                if isinstance(n, ast.Attribute) and n.attr == attr and \
+                        isinstance(n.value, ast.Name) and \
+                        n.value.id == "self":
+                    released = True
+                    break
+            if released:
+                break
+        if released:
+            continue
+        sc = sc_by_path.get(path)
+        if sc is not None and sc.line_has(line, _ALLOW_HANDLE_ESCAPE):
+            continue
+        findings.append(Finding(
+            "handle-lifecycle", path, line,
+            f"owning {kind} stored on {cls}.{attr}, but {cls} has no "
+            f"close/stop/shutdown-style method touching self.{attr} — "
+            f"ownership was transferred to an object that never releases "
+            f"it"))
     return findings
 
 
@@ -1163,6 +1884,8 @@ def lint_files(files: Iterable[str],
             findings.extend(_check_lock_order(scans, graph))
         if "fiber-blocking-sleep" in active:
             findings.extend(_check_fiber_blocking_sleep(scans, graph))
+        if "handle-lifecycle" in active:
+            findings.extend(_check_handle_lifecycle(scans, graph))
     if "ctypes-contract" in active:
         findings.extend(_check_ctypes_contract(scans))
     # dedup (a nested def can be reached both inside its parent's subtree

@@ -122,7 +122,8 @@ class GradCombiner:
     Followers never lead and the leader never waits on followers, so
     there is no circular wait even on a single worker."""
 
-    __slots__ = ("_apply", "_dim", "_mu", "_q", "_draining", "last_error")
+    __slots__ = ("_apply", "_dim", "_mu", "_q", "_draining", "_shut",
+                 "last_error")
 
     def __init__(self, apply_fn, dim: int):
         self._apply = apply_fn          # apply_fn(local_ids, grads): ONE
@@ -130,6 +131,7 @@ class GradCombiner:
         self._mu = checked_lock("ps.combine")
         self._q: list = []
         self._draining = False
+        self._shut = False
         self.last_error: Optional[BaseException] = None
 
     def add(self, ids: np.ndarray, grads: np.ndarray,
@@ -138,6 +140,12 @@ class GradCombiner:
         # leader applies the batch this entry lands in.
         entry = [ids, grads, threading.Event() if wait else None, None]
         with self._mu:
+            if self._shut:
+                # Server teardown: late contributions (a dead client's
+                # stream receiver being torn down by the socket-failure
+                # hook, frames still in its delivery queue) are dropped —
+                # the shard/device behind apply_fn may already be gone.
+                return
             self._q.append(entry)
             leader = not self._draining
             if leader:
@@ -190,9 +198,23 @@ class GradCombiner:
     def flush(self) -> None:
         """Returns once every contribution enqueued BEFORE this call has
         been applied (the stream-close barrier).  Raises the failure of
-        the flush batch, if any."""
+        the flush batch, if any.  A no-op after :meth:`shutdown`."""
         self.add(np.empty(0, np.int32),
                  np.empty((0, self._dim), np.float32), wait=True)
+
+    def shutdown(self) -> None:
+        """Stops accepting contributions and waits for any in-flight
+        drain to finish.  Server close paths call this BEFORE destroying
+        the table/shard/device behind ``apply_fn``, so a drain can never
+        race resource teardown — late frames from dying streams are
+        dropped instead of applied to freed state."""
+        with self._mu:
+            self._shut = True
+            draining = self._draining
+        while draining:
+            time.sleep(0.001)
+            with self._mu:
+                draining = self._draining
 
 
 class _ApplyStreamReceiver:
@@ -392,8 +414,13 @@ class PsShardServer:
 
     def close(self):
         # Server first: its native Lookup handlers gather from the
-        # shard's snapshots and must drain before the shard dies.
+        # shard's snapshots and must drain before the shard dies.  Then
+        # the combiner: a dying stream's receiver teardown can still
+        # flush into it after Join (its delivery queue outlives the
+        # connection), and an applying drain must not race shard death.
         self.server.close()
+        if self._combiner is not None:
+            self._combiner.shutdown()
         if self._shard is not None:
             self._shard.close()
             self._shard = None
@@ -689,6 +716,11 @@ class DevicePsShardServer:
 
     def close(self):
         self.server.close()
+        # Latch the combiner before device teardown (same reasoning as
+        # PsShardServer.close: late stream frames must drop, not scatter
+        # into released buffers).
+        if self._combiner is not None:
+            self._combiner.shutdown()
         for exe in list(self._gather.values()) + list(
                 self._scatter.values()):
             exe.close()
@@ -904,7 +936,9 @@ class RemoteEmbedding:
                         f"shard {s} ({self.addresses[s]}) isolated by "
                         f"circuit breaker")
                 try:
-                    pending[i] = self.channels[s].call_async(
+                    # managed fan-out set: every entry is joined or
+                    # cancelled+closed in the finally below
+                    pending[i] = self.channels[s].call_async(  # lint: allow-handle-escape
                         "Ps", method, req, timeout_ms=_budget(),
                         tag="attempt=0")
                 except rpc.RpcError as e:

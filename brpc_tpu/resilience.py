@@ -299,7 +299,8 @@ def backup_call(channel, service: str, method: str, request: bytes = b"",
             backup = channel.call_async(service, method, request,
                                         timeout_ms=timeout_ms,
                                         tag=_tagged("hedge=backup"))
-            pending.append(("backup", backup))
+            # hedge registry: the finally reaps every entry not joined
+            pending.append(("backup", backup))  # lint: allow-handle-escape
             group.add(backup)
         except Exception as e:  # noqa: BLE001 — hedge must not lose the
             if getattr(e, "code", None) is None:  # primary to a failed

@@ -15,6 +15,7 @@ import time
 from typing import Callable, Optional
 
 from brpc_tpu import fault, obs, resilience
+from brpc_tpu.analysis import handles as _handles
 from brpc_tpu.analysis import race as _race
 
 _INT64_MIN = -(2 ** 63)  # "inherit the channel option" timeout sentinel
@@ -263,8 +264,129 @@ def _load_locked():
     lib.brt_device_execute.restype = ctypes.c_int
     lib.brt_device_executable_destroy.argtypes = [ctypes.c_void_p]
     lib.brt_device_executable_destroy.restype = None
+    lib.brt_debug_handle_counts.argtypes = []
+    lib.brt_debug_handle_counts.restype = ctypes.c_void_p
+    lib.brt_debug_handle_count.argtypes = [ctypes.c_char_p]
+    lib.brt_debug_handle_count.restype = ctypes.c_long
+    lib.brt_debug_fail_connections.argtypes = [ctypes.c_char_p]
+    lib.brt_debug_fail_connections.restype = ctypes.c_int
     lib.brt_init(0)
+    if _handles.enabled():
+        _install_handle_ledger(lib)
     return lib
+
+
+# ---------------------------------------------------------------------------
+# dynamic handle ledger (BRPC_TPU_HANDLECHECK=1)
+# ---------------------------------------------------------------------------
+
+# The owning brt_* constructor/destructor pairs, keyed the same way as
+# the native ground-truth counters (cpp/capi/handle_ledger.cc) so
+# debug_handle_counts() and the Python ledger compare directly.  Streams
+# are tracked at the Python object layer instead (Channel.stream /
+# the receiver registry): their ABI uses out-param ids, not returns.
+_HANDLE_NEW = {
+    "brt_server_new": "server",
+    "brt_channel_new": "channel",
+    "brt_channel_call_start": "call",
+    "brt_channel_call_start_opts": "call",
+    "brt_call_group_new": "call_group",
+    "brt_ps_shard_new": "ps_shard",
+    "brt_event_new": "event",
+    "brt_device_client_new": "device_client",
+    "brt_device_compile": "device_executable",
+}
+_HANDLE_DESTROY = {
+    "brt_server_destroy": "server",
+    "brt_channel_destroy": "channel",
+    "brt_call_destroy": "call",
+    "brt_call_group_destroy": "call_group",
+    "brt_ps_shard_destroy": "ps_shard",
+    "brt_event_destroy": "event",
+    "brt_device_client_destroy": "device_client",
+    "brt_device_executable_destroy": "device_executable",
+}
+
+
+class _LedgerFn:
+    """Transparent wrapper over one bound ctypes function that feeds the
+    handle ledger: constructors record their returned handle (with
+    creation stack), destructors release the first argument.  The
+    ``argtypes``/``restype`` surface delegates to the wrapped function so
+    the C-ABI contract tests (and any later re-declaration) see through
+    the wrapper."""
+
+    __slots__ = ("_fn", "_kind", "_is_new")
+
+    def __init__(self, fn, kind: str, is_new: bool):
+        self._fn = fn
+        self._kind = kind
+        self._is_new = is_new
+
+    def __call__(self, *args):
+        if self._is_new:
+            out = self._fn(*args)
+            _handles.note_create(self._kind, out)
+            return out
+        _handles.note_destroy(self._kind, args[0])
+        return self._fn(*args)
+
+    @property
+    def argtypes(self):
+        return self._fn.argtypes
+
+    @argtypes.setter
+    def argtypes(self, value):
+        self._fn.argtypes = value
+
+    @property
+    def restype(self):
+        return self._fn.restype
+
+    @restype.setter
+    def restype(self, value):
+        self._fn.restype = value
+
+
+def _install_handle_ledger(lib) -> None:
+    """Wraps every owning ``brt_*_new``/``_destroy`` pair so the dynamic
+    ledger sees each native handle's birth and death.  Installed once, at
+    load time, only under ``BRPC_TPU_HANDLECHECK`` — the unwrapped ABI
+    carries zero overhead."""
+    for name, kind in _HANDLE_NEW.items():
+        setattr(lib, name, _LedgerFn(getattr(lib, name), kind, True))
+    for name, kind in _HANDLE_DESTROY.items():
+        setattr(lib, name, _LedgerFn(getattr(lib, name), kind, False))
+
+
+def debug_handle_counts() -> dict:
+    """Ground-truth live native-object counts per handle type, reported
+    by the C++ side itself (``brt_debug_handle_counts``): the native
+    cross-check for :mod:`brpc_tpu.analysis.handles` — the Python ledger
+    knows creation stacks, this table knows the truth."""
+    lib = _load()
+    p = lib.brt_debug_handle_counts()
+    if not p:
+        return {}
+    try:
+        text = ctypes.string_at(p).decode()
+    finally:
+        lib.brt_free(p)
+    out = {}
+    for line in text.splitlines():
+        name, _, count = line.partition(" ")
+        if name:
+            out[name] = int(count)
+    return out
+
+
+def debug_fail_connections(addr: str) -> int:
+    """Fails every live client connection to ``addr`` ("ip:port") —
+    exactly what the peer observes when the process holding those
+    sockets dies.  The abrupt-death lever for leak/teardown tests (the
+    stream registry's socket-failure teardown fires, receivers see
+    ``on_closed``).  Returns the number of sockets failed."""
+    return _load().brt_debug_fail_connections(addr.encode())
 
 
 class RpcError(RuntimeError):
@@ -298,13 +420,17 @@ _stream_receivers: dict = {}
 
 
 def _register_stream_receiver(stream_id: int, receiver) -> None:
+    _handles.note_create("stream_receiver", stream_id)
     with _stream_mu:
         _stream_receivers[stream_id] = receiver
 
 
 def _pop_stream_receiver(stream_id: int):
     with _stream_mu:
-        return _stream_receivers.pop(stream_id, None)
+        receiver = _stream_receivers.pop(stream_id, None)
+    if receiver is not None:
+        _handles.note_destroy("stream_receiver", stream_id)
+    return receiver
 
 
 @_STREAM_HANDLER
@@ -533,7 +659,10 @@ class Server:
         :meth:`add_service` handlers.  The server auto-closes its half of
         a stream after ``on_closed`` (completing the handshake the
         client's ``Stream.join`` waits on); a client that dies WITHOUT
-        closing leaks the receiver until process exit."""
+        closing gets the same teardown — the socket-failure hook in the
+        native stream registry delivers a synthetic close (ordered after
+        queued data), so ``on_closed`` still fires and the receiver is
+        freed, not leaked."""
         trampoline = self._sync_trampoline(name, handler, pass_accept=True)
         rc = self._lib.brt_server_add_service(self._ptr, name.encode(),
                                               trampoline, None)
@@ -871,6 +1000,7 @@ class Stream:
         Idempotent; pair with :meth:`join` to wait for full application."""
         if not self._closed:
             self._closed = True
+            _handles.note_destroy("stream", self._id)
             self._lib.brt_stream_close(self._id)
 
     def join(self, timeout_s: Optional[float] = None) -> bool:
@@ -885,7 +1015,9 @@ class Stream:
     def abort(self) -> None:
         """Abrupt local teardown (reconnect/error paths): wakes any
         writer/joiner, frees native state, sends nothing.  Idempotent."""
-        self._closed = True
+        if not self._closed:
+            self._closed = True
+            _handles.note_destroy("stream", self._id)
         self._lib.brt_stream_abort(self._id)
 
 
@@ -1089,6 +1221,7 @@ class Channel:
             _record_client_call(service, method, self._addr, t0, wall,
                                 len(request), len(out), 0, "",
                                 tag="stream")
+        _handles.note_create("stream", sid.value)
         return Stream(self._lib, sid.value, out, service, method,
                       self._addr)
 

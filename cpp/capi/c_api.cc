@@ -14,6 +14,7 @@
 #include "fiber/sync.h"
 #include "rpc/channel.h"
 #include "rpc/server.h"
+#include "transport/socket.h"
 
 namespace {
 
@@ -21,6 +22,7 @@ using namespace brt;
 using brt_capi::CChannel;
 using brt_capi::CServer;
 using brt_capi::CSession;
+using brt_capi::HandleKind;
 
 class CService : public Service {
  public:
@@ -84,7 +86,10 @@ extern "C" {
 
 void brt_init(int fiber_workers) { brt::fiber_init(fiber_workers); }
 
-void* brt_server_new(void) { return new CServer; }
+void* brt_server_new(void) {
+  brt_capi::handle_inc(HandleKind::kServer);
+  return new CServer;
+}
 
 int brt_server_add_service(void* server, const char* name,
                            brt_service_handler handler, void* user) {
@@ -130,6 +135,7 @@ void brt_server_destroy(void* server) {
   s->server.Stop();
   s->server.Join();
   delete s;
+  brt_capi::handle_dec(HandleKind::kServer);
 }
 
 void brt_session_respond(void* session, const void* data, size_t len,
@@ -169,6 +175,7 @@ void* brt_channel_new(const char* addr, const char* lb, int64_t timeout_ms,
     }
     c->channel = std::move(ch);
   }
+  brt_capi::handle_inc(HandleKind::kChannel);
   return c;
 }
 
@@ -196,7 +203,9 @@ int brt_channel_call(void* channel, const char* service, const char* method,
 }
 
 void brt_channel_destroy(void* channel) {
+  if (channel == nullptr) return;
   delete static_cast<CChannel*>(channel);
+  brt_capi::handle_dec(HandleKind::kChannel);
 }
 
 void* brt_channel_call_start(void* channel, const char* service,
@@ -211,6 +220,7 @@ void* brt_channel_call_start_opts(void* channel, const char* service,
                                   size_t req_len, int64_t timeout_ms) {
   auto* c = static_cast<CChannel*>(channel);
   auto* call = new CCall;
+  brt_capi::handle_inc(HandleKind::kCall);
   call->cntl.timeout_ms = timeout_ms;  // INT64_MIN inherits the channel
   IOBuf request;
   if (req && req_len) request.append(req, req_len);
@@ -233,7 +243,10 @@ void* brt_channel_call_start_opts(void* channel, const char* service,
   return call;
 }
 
-void* brt_call_group_new(void) { return new CCallGroup; }
+void* brt_call_group_new(void) {
+  brt_capi::handle_inc(HandleKind::kCallGroup);
+  return new CCallGroup;
+}
 
 int brt_call_group_add(void* group, void* call) {
   auto* g = static_cast<CCallGroup*>(group);
@@ -305,7 +318,10 @@ int brt_call_group_completed(void* group) {
 }
 
 void brt_call_group_destroy(void* group) {
+  // The ABI handle is released here; the refcounted object itself may
+  // outlive this until in-flight done-closures drop their refs.
   group_unref(static_cast<CCallGroup*>(group));
+  brt_capi::handle_dec(HandleKind::kCallGroup);
 }
 
 int brt_call_wait(void* call, int64_t timeout_us) {
@@ -341,15 +357,35 @@ void brt_call_destroy(void* call) {
   auto* c = static_cast<CCall*>(call);
   c->done.wait();
   delete c;
+  brt_capi::handle_dec(HandleKind::kCall);
 }
 
 void brt_free(void* p) { free(p); }
+
+int brt_debug_fail_connections(const char* addr) {
+  EndPoint target;
+  if (addr == nullptr || !EndPoint::parse(addr, &target)) return -1;
+  std::vector<SocketId> all;
+  Socket::ListSockets(&all);
+  int failed = 0;
+  for (SocketId sid : all) {
+    SocketUniquePtr p;
+    if (Socket::Address(sid, &p) == 0 && p->remote() == target) {
+      p->SetFailed(ECONNRESET, "brt_debug_fail_connections(%s)", addr);
+      ++failed;
+    }
+  }
+  return failed;
+}
 
 }  // extern "C"
 
 extern "C" {
 
-void* brt_event_new(void) { return new brt::CountdownEvent(1); }
+void* brt_event_new(void) {
+  brt_capi::handle_inc(HandleKind::kEvent);
+  return new brt::CountdownEvent(1);
+}
 
 void brt_event_set(void* event) {
   static_cast<brt::CountdownEvent*>(event)->signal();
@@ -361,6 +397,7 @@ int brt_event_wait(void* event, int64_t timeout_us) {
 
 void brt_event_destroy(void* event) {
   delete static_cast<brt::CountdownEvent*>(event);
+  brt_capi::handle_dec(HandleKind::kEvent);
 }
 
 }  // extern "C"
@@ -387,6 +424,7 @@ void* brt_device_client_new(const char* plugin_path, char* errbuf,
   // calling OS thread, never fiber-park — ctypes' GIL state is bound to
   // the OS thread, and a fiber resuming on another worker would corrupt it.
   client->set_thread_wait(true);
+  brt_capi::handle_inc(brt_capi::HandleKind::kDeviceClient);
   return client.release();
 }
 
@@ -512,6 +550,7 @@ void* brt_device_compile(void* client, const char* mlir, int num_replicas,
     if (errbuf && errbuf_len) snprintf(errbuf, errbuf_len, "%s", err.c_str());
     return nullptr;
   }
+  brt_capi::handle_inc(brt_capi::HandleKind::kDeviceExecutable);
   return exe.release();
 }
 
@@ -555,10 +594,12 @@ int brt_device_execute(void* exe, const uint64_t* args, size_t nargs,
 
 void brt_device_executable_destroy(void* exe) {
   delete static_cast<brt::PjrtExecutable*>(exe);
+  brt_capi::handle_dec(brt_capi::HandleKind::kDeviceExecutable);
 }
 
 void brt_device_client_destroy(void* client) {
   delete static_cast<brt::PjrtClient*>(client);
+  brt_capi::handle_dec(brt_capi::HandleKind::kDeviceClient);
 }
 
 }  // extern "C"

@@ -226,6 +226,28 @@ int brt_server_add_ps_service(void* server, const char* name, void* shard,
 // The server using the shard must be destroyed first.
 void brt_ps_shard_destroy(void* shard);
 
+// ---- native handle ledger (leak diagnostics) ----
+// Ground-truth live-object counts per ABI handle family, bumped by the
+// objects themselves at construction/destruction.  The bound language's
+// dynamic handle ledger (BRPC_TPU_HANDLECHECK=1) cross-checks its own
+// bookkeeping against these — Python knows creation stacks, C++ knows
+// the truth.  brt_debug_handle_counts returns a malloc'd "kind count\n"
+// table (free with brt_free) covering server/channel/call/call_group/
+// ps_shard/event/stream_relay/device_client/device_executable plus
+// "stream" (live entries in the stream registry, BOTH directions);
+// brt_debug_handle_count returns one kind's count, or -1 for an unknown
+// kind name.
+char* brt_debug_handle_counts(void);
+long brt_debug_handle_count(const char* kind);
+
+// Fault-injection lever for abrupt-death testing: SetFailed()s every live
+// client connection whose REMOTE endpoint is `addr` ("ip:port"), exactly
+// what happens when the process holding those sockets dies — the peer
+// sees EOF and fails its half, which (among other teardown) tears down
+// any streams riding the connection.  Returns the number of sockets
+// failed, or -1 on a malformed address.  Debug/test surface only.
+int brt_debug_fail_connections(const char* addr);
+
 // ---- runtime ----
 void brt_init(int fiber_workers);
 

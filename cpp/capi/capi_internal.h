@@ -37,4 +37,27 @@ struct CChannel {
   std::unique_ptr<brt::ChannelBase> channel;
 };
 
+// ---- native handle ledger (capi/handle_ledger.cc) ----
+// Ground-truth live-object counts per ABI handle type, bumped at every
+// brt_*_new/_destroy pair across the capi TUs and reported through
+// brt_debug_handle_counts().  The Python-side dynamic ledger
+// (brpc_tpu.analysis.handles) cross-checks its bookkeeping against these
+// counters — a drift means a wrapper lost track, not just a leak.
+enum class HandleKind : int {
+  kServer = 0,
+  kChannel,
+  kCall,
+  kCallGroup,
+  kPsShard,
+  kEvent,
+  kStreamRelay,
+  kDeviceClient,
+  kDeviceExecutable,
+  kNumKinds,
+};
+
+void handle_inc(HandleKind kind);
+void handle_dec(HandleKind kind);
+long handle_count(HandleKind kind);
+
 }  // namespace brt_capi

@@ -1,0 +1,87 @@
+// Native handle ledger: per-type live-object counts for every C-ABI
+// handle family (brt_*_new/_destroy pairs across the capi TUs) plus the
+// stream registry.  This is the GROUND TRUTH the Python-side dynamic
+// ledger (brpc_tpu.analysis.handles, BRPC_TPU_HANDLECHECK=1) is
+// cross-checked against: the Python ledger knows creation stacks but only
+// sees what its wrappers saw; these counters are bumped by the objects
+// themselves, so a disagreement means lost bookkeeping, not just a leak.
+//
+// Counters are relaxed atomics — the inc/dec sites are object
+// construction/destruction, never a hot loop, and readers only want an
+// eventually-consistent snapshot.
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "capi/c_api.h"
+#include "capi/capi_internal.h"
+#include "rpc/stream.h"
+
+namespace brt_capi {
+
+namespace {
+
+constexpr int kNumKinds = static_cast<int>(HandleKind::kNumKinds);
+
+// Names match the Python ledger's kind strings (brpc_tpu/rpc.py keys its
+// wrappers the same way) so the cross-check compares keys directly.
+const char* const kKindNames[kNumKinds] = {
+    "server",        "channel",       "call",
+    "call_group",    "ps_shard",      "event",
+    "stream_relay",  "device_client", "device_executable",
+};
+
+std::atomic<long> g_counts[kNumKinds];
+
+}  // namespace
+
+void handle_inc(HandleKind kind) {
+  g_counts[static_cast<int>(kind)].fetch_add(1, std::memory_order_relaxed);
+}
+
+void handle_dec(HandleKind kind) {
+  g_counts[static_cast<int>(kind)].fetch_sub(1, std::memory_order_relaxed);
+}
+
+long handle_count(HandleKind kind) {
+  return g_counts[static_cast<int>(kind)].load(std::memory_order_relaxed);
+}
+
+}  // namespace brt_capi
+
+extern "C" {
+
+long brt_debug_handle_count(const char* kind) {
+  if (kind == nullptr) return -1;
+  if (strcmp(kind, "stream") == 0) {
+    return static_cast<long>(brt::LiveStreamCount());
+  }
+  for (int i = 0; i < brt_capi::kNumKinds; ++i) {
+    if (strcmp(kind, brt_capi::kKindNames[i]) == 0) {
+      return brt_capi::handle_count(static_cast<brt_capi::HandleKind>(i));
+    }
+  }
+  return -1;
+}
+
+char* brt_debug_handle_counts(void) {
+  std::string out;
+  for (int i = 0; i < brt_capi::kNumKinds; ++i) {
+    out += brt_capi::kKindNames[i];
+    out += ' ';
+    out += std::to_string(
+        brt_capi::handle_count(static_cast<brt_capi::HandleKind>(i)));
+    out += '\n';
+  }
+  out += "stream ";
+  out += std::to_string(brt::LiveStreamCount());
+  out += '\n';
+  char* buf = static_cast<char*>(malloc(out.size() + 1));
+  if (buf == nullptr) return nullptr;
+  memcpy(buf, out.c_str(), out.size() + 1);
+  return buf;
+}
+
+}  // extern "C"

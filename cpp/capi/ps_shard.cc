@@ -176,6 +176,7 @@ void* brt_ps_shard_new(int64_t vocab, int64_t dim, int shard_index,
   s->n_shards = n_shards;
   s->rows_per = vocab / n_shards;
   s->base = int64_t(shard_index) * s->rows_per;
+  brt_capi::handle_inc(brt_capi::HandleKind::kPsShard);
   return s;
 }
 
@@ -226,7 +227,9 @@ int brt_server_add_ps_service(void* server, const char* name, void* shard,
 }
 
 void brt_ps_shard_destroy(void* shard) {
+  if (shard == nullptr) return;
   delete static_cast<CPsShard*>(shard);
+  brt_capi::handle_dec(brt_capi::HandleKind::kPsShard);
 }
 
 }  // extern "C"

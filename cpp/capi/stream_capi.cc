@@ -29,13 +29,24 @@ using brt_capi::CChannel;
 using brt_capi::CSession;
 
 // Relays native stream callbacks into the binding.  Owned by the stream's
-// lifecycle: on_closed is the LAST serialized callback for a gracefully
-// closed stream, so the relay frees itself right after forwarding it.  A
-// peer that dies without CLOSE leaks one relay (documented in c_api.h);
-// brt_stream_abort must not be used on handler-carrying streams.
+// lifecycle: on_closed is the LAST serialized callback for a closed
+// stream — a graceful peer CLOSE or the socket-failure teardown
+// (stream.cc delivers a synthetic close when the connection under a
+// stream dies, so a peer that vanishes without CLOSE no longer leaks the
+// relay) — and the relay frees itself right after forwarding it.
+// brt_stream_abort must still not be used on handler-carrying streams
+// (abort suppresses on_closed by design).  Live relays are counted in
+// the handle ledger ("stream_relay"): a nonzero steady-state count IS a
+// leaked receiver.
 class CStreamRelay : public StreamHandler {
  public:
-  CStreamRelay(brt_stream_handler h, void* user) : h_(h), user_(user) {}
+  CStreamRelay(brt_stream_handler h, void* user) : h_(h), user_(user) {
+    brt_capi::handle_inc(brt_capi::HandleKind::kStreamRelay);
+  }
+
+  ~CStreamRelay() override {
+    brt_capi::handle_dec(brt_capi::HandleKind::kStreamRelay);
+  }
 
   void on_received(StreamId id, IOBuf&& message) override {
     const std::string data = message.to_string();

@@ -2,6 +2,7 @@
 
 #include <mutex>
 #include <unordered_map>
+#include <vector>
 
 #include "base/logging.h"
 #include "base/time.h"
@@ -130,8 +131,12 @@ int DeliverMessages(void* meta, ExecutionQueue<Stream::Msg>::TaskIterator& iter)
     Stream::Msg& msg = *iter;
     if (!s) continue;
     if (msg.close) {
-      s->peer_closed.store(true, std::memory_order_release);
-      if (s->handler) s->handler->on_closed(s->id);
+      // exchange, not store: a peer CLOSE and the socket-failure teardown
+      // can both enqueue a close for one stream — on_closed (which frees
+      // the handler/relay) must run exactly once.
+      if (!s->peer_closed.exchange(true, std::memory_order_acq_rel)) {
+        if (s->handler) s->handler->on_closed(s->id);
+      }
       wake_writers(s.get());
       finish_if_fully_closed(s);
       continue;
@@ -148,6 +153,41 @@ int DeliverMessages(void* meta, ExecutionQueue<Stream::Msg>::TaskIterator& iter)
     }
   }
   return 0;
+}
+
+// Delivers a close to the stream's serialized queue (ordered after any
+// queued data) with an inline fallback when the queue already stopped
+// (local close first) so joiners still wake.  Shared by the peer's CLOSE
+// frame and the socket-failure teardown; on_closed runs exactly once
+// either way (the exchange guard in DeliverMessages / here).
+void deliver_close(const std::shared_ptr<Stream>& s) {
+  if (s->exec.execute(Stream::Msg{IOBuf(), true}) != 0) {
+    if (!s->peer_closed.exchange(true, std::memory_order_acq_rel)) {
+      if (s->handler) s->handler->on_closed(s->id);
+    }
+    wake_writers(s.get());
+    finish_if_fully_closed(s);
+  }
+}
+
+// Socket-failure teardown (the ROADMAP stream-receiver leak): a peer that
+// dies WITHOUT a graceful CLOSE fails the connection under its streams —
+// EOF, RST, or a local SetFailed.  Every stream bound to the dead socket
+// gets a synthetic close: receivers see on_closed (ordered after queued
+// data, so nothing already delivered is lost), relays/registry entries
+// free, writers wake with EPIPE, and the server side completes the close
+// handshake exactly as if the peer had closed gracefully.
+void OnSocketFailed(SocketId sid) {
+  std::vector<std::shared_ptr<Stream>> hit;
+  {
+    std::lock_guard<std::mutex> g(g_streams_mu);
+    for (auto& [id, s] : streams()) {
+      if (s->sock == sid && s->bound.load(std::memory_order_acquire)) {
+        hit.push_back(s);
+      }
+    }
+  }
+  for (auto& s : hit) deliver_close(s);
 }
 
 std::shared_ptr<Stream> new_stream(const StreamOptions& opts) {
@@ -184,14 +224,7 @@ void OnStreamFrame(RpcMeta&& meta, IOBuf&& body, SocketId /*sock*/) {
       break;
     }
     case STREAM_CLOSE:
-      // Ordered after queued data; if our side already stopped the queue
-      // (local close first), handle inline so joiners still wake.
-      if (s->exec.execute(Stream::Msg{IOBuf(), true}) != 0) {
-        s->peer_closed.store(true, std::memory_order_release);
-        if (s->handler) s->handler->on_closed(s->id);
-        wake_writers(s.get());
-        finish_if_fully_closed(s);
-      }
+      deliver_close(s);
       break;
     default:
       break;
@@ -213,10 +246,18 @@ void InitStreamLayer() {
     RegisterBrtProtocol();
     SetStreamFrameHandler(OnStreamFrame);
     g_stream_connect_hook = StreamConnectHook;
+    // Dead-connection teardown: without this, a peer dying without CLOSE
+    // leaked its streams' receivers until process exit.
+    Socket::set_failure_hook(OnSocketFailed);
   });
 }
 
 }  // namespace
+
+size_t LiveStreamCount() {
+  std::lock_guard<std::mutex> g(g_streams_mu);
+  return streams().size();
+}
 
 int StreamCreate(StreamId* id, Controller* cntl, const StreamOptions& opts) {
   if (!id || !cntl) return EINVAL;
@@ -303,6 +344,18 @@ int StreamJoinFor(StreamId id, int64_t timeout_us) {
 int StreamAbort(StreamId id) {
   auto s = find_stream(id);
   if (!s) return 0;
+  // Best-effort CLOSE first: when the transport under the stream is
+  // still alive (the in-process teardown case — pooled SINGLE sockets
+  // outlive the channel that used them), telling the peer lets IT tear
+  // down gracefully instead of stranding its receiver until the socket
+  // eventually dies.  On a broken socket this send just fails, which is
+  // the classic abort path — nothing reaches the peer, and the peer's
+  // socket-failure teardown handles its side.
+  if (s->bound.load(std::memory_order_acquire) &&
+      !s->peer_closed.load(std::memory_order_acquire) &&
+      !s->local_closed.load(std::memory_order_acquire)) {
+    send_stream_frame(s, STREAM_CLOSE, IOBuf());  // errors ignored
+  }
   // Both flags up front: finish_if_fully_closed tears down (wakes joiners,
   // stops the exec queue, unregisters) exactly once.
   s->local_closed.store(true, std::memory_order_release);

@@ -63,12 +63,23 @@ int StreamJoin(StreamId id);
 int StreamJoinFor(StreamId id, int64_t timeout_us);
 
 // Abrupt local teardown: marks BOTH sides closed, wakes writers and
-// joiners, unregisters.  No CLOSE frame reaches the peer and a handler's
-// on_closed is NOT invoked — this is the error-path cleanup for streams
+// joiners, unregisters — this is the error-path cleanup for streams
 // whose setup RPC failed or whose connection died (graceful shutdown is
-// StreamClose + the peer's CLOSE).  Do not abort a stream whose handler
-// may still be consuming queued frames (write-only streams are always
-// safe).  Idempotent.
+// StreamClose + the peer's CLOSE).  A bound stream on a still-healthy
+// socket sends one best-effort CLOSE so the PEER can free its receiver
+// (in-process teardown over pooled connections); on a dead socket the
+// send fails silently and the peer's socket-failure teardown covers it.
+// Locally nothing is flushed and the local handler's on_closed is NOT
+// invoked.  Do not abort a stream whose handler may still be consuming
+// queued frames (write-only streams are always safe).  Idempotent.
 int StreamAbort(StreamId id);
+
+// Streams currently registered (either direction, not yet fully closed).
+// The handle ledger's ground-truth "stream" count: a count that stays
+// nonzero after every side closed/joined is a leak.  Note that a peer
+// dying WITHOUT a graceful close no longer strands entries here — the
+// socket-failure hook delivers a synthetic close to every stream bound
+// to the dead connection (on_closed fires, ordered after queued data).
+size_t LiveStreamCount();
 
 }  // namespace brt

@@ -251,6 +251,15 @@ void Socket::OnRecycle() {
   slab.free_index(id_index(id_));
 }
 
+// Global failure hook (stream-layer teardown). Installed once at stream
+// init; relaxed is enough — installation happens-before any socket the
+// installer cares about exists.
+static std::atomic<Socket::FailureHook> g_failure_hook{nullptr};
+
+void Socket::set_failure_hook(FailureHook hook) {
+  g_failure_hook.store(hook, std::memory_order_release);
+}
+
 void Socket::SetFailed(int err, const char* fmt, ...) {
   int expected = 0;
   if (!failed_.compare_exchange_strong(expected, err ? err : ECONNRESET,
@@ -282,6 +291,11 @@ void Socket::SetFailed(int err, const char* fmt, ...) {
   const int werr = failed_.load(std::memory_order_acquire);
   for (fid_t cid : waiters) fid_error(cid, werr);
   if (on_failed_) on_failed_(this);
+  // Global notification (stream teardown) AFTER per-socket cleanup, while
+  // the ownership ref still pins the id: hooks may Address() this socket.
+  if (FailureHook hook = g_failure_hook.load(std::memory_order_acquire)) {
+    hook(id_);
+  }
   Dereference();  // drop the ownership ref
 }
 

@@ -142,6 +142,16 @@ class Socket {
   // fd is closed when the last reference drops.
   void SetFailed(int err, const char* fmt = nullptr, ...);
 
+  // Process-global failure notification, fired exactly once per socket
+  // inside SetFailed (after the failure is latched, before the ownership
+  // ref drops).  Layers that key per-connection state by SocketId — the
+  // stream registry, which must tear down receivers whose peer died
+  // WITHOUT a graceful CLOSE — register here at init.  One hook; the
+  // installer owns composition.  Must not block: it runs on whatever
+  // thread/fiber noticed the failure.
+  using FailureHook = void (*)(SocketId);
+  static void set_failure_hook(FailureHook hook);
+
   // Graceful close: fails the socket once the write chain has fully
   // drained (HTTP "Connection: close" — the final response must reach the
   // kernel before the fd dies). If nothing is in flight, fails now.

@@ -883,3 +883,353 @@ def test_call_site_allow_trace_impure_pragma(tmp_path):
     fs = _lint_src(tmp_path, _TRACED_WITH_COUNTER.format(
         pragma_def="", pragma_call="  # lint: allow-trace-impure"))
     assert _by_check(fs, "trace-purity") == []
+
+
+# ---- lock-order: param-passed locks bound through the call graph ----
+
+_PARAM_LOCK_FIXTURE = """\
+    from brpc_tpu.analysis.race import checked_lock
+    A = checked_lock("pfix.A")
+    B = checked_lock("pfix.B")
+
+    def use_inner(lk):
+        with lk:
+            pass
+
+    def order_ab():
+        with A:
+            use_inner(B)
+
+    def order_ba():
+        with B:
+            with A:
+                pass
+"""
+
+
+def test_static_lock_order_resolves_param_passed_lock(tmp_path):
+    static = _by_check(_lint_src(tmp_path, _PARAM_LOCK_FIXTURE),
+                       "lock-order")
+    assert len(static) == 1
+    assert "pfix.A" in static[0].message and "pfix.B" in static[0].message
+    assert "use_inner" in static[0].message  # the chain names the callee
+
+
+def test_param_passed_lock_matches_dynamic_harness(tmp_path):
+    """Parity on the PR-3 blind spot: a lock received as a function
+    parameter now resolves statically by binding the caller's argument
+    through the call graph — the dynamic harness stays the confirmer."""
+    from brpc_tpu.analysis import race
+
+    static = _by_check(_lint_src(tmp_path, _PARAM_LOCK_FIXTURE),
+                       "lock-order")
+    assert len(static) == 1
+
+    race.clear()
+    race.set_enabled(True)
+    try:
+        ns = {"checked_lock": race.checked_lock}
+        src = textwrap.dedent(_PARAM_LOCK_FIXTURE)
+        exec(src.split("\n", 1)[1], ns)
+        ns["order_ab"]()
+        ns["order_ba"]()
+        dynamic = [f for f in race.findings()
+                   if f.kind == "lock-inversion"]
+    finally:
+        race.set_enabled(None)
+        race.clear()
+    assert len(dynamic) == 1
+    assert {"pfix.A", "pfix.B"} <= set(dynamic[0].locks)
+
+
+def test_param_lock_keyword_argument_binds(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        from brpc_tpu.analysis.race import checked_lock
+        A = checked_lock("kw.A")
+        B = checked_lock("kw.B")
+
+        def helper(*, lk=None):
+            with lk:
+                pass
+
+        def outer():
+            with B:
+                helper(lk=A)
+
+        def reverse():
+            with A:
+                with B:
+                    pass
+    """)
+    (f,) = _by_check(fs, "lock-order")
+    assert "kw.A" in f.message and "kw.B" in f.message
+
+
+def test_container_stored_lock_stays_deferred(tmp_path):
+    # the documented remaining blind spot: a lock pulled out of a
+    # container is not resolved — no false edges, no finding
+    fs = _lint_src(tmp_path, """\
+        from brpc_tpu.analysis.race import checked_lock
+        A = checked_lock("cd.A")
+        B = checked_lock("cd.B")
+        LOCKS = {"a": A}
+
+        def inner():
+            with LOCKS["a"]:
+                pass
+
+        def outer():
+            with B:
+                inner()
+
+        def reverse():
+            with A:
+                with B:
+                    pass
+    """)
+    assert _by_check(fs, "lock-order") == []
+
+
+# ---- handle-lifecycle ----
+
+_RPC_STUB = """\
+    class RpcError(RuntimeError):
+        pass
+
+
+    class PendingCall:
+        def __init__(self):
+            self._ptr = 1
+
+        def join(self):
+            return b""
+
+        def wait(self, t=None):
+            return True
+
+        def cancel(self):
+            pass
+
+        def close(self):
+            pass
+
+
+    class Stream:
+        def __init__(self):
+            self._id = 1
+
+        def write(self, data):
+            pass
+
+        def close(self):
+            pass
+
+        def join(self, timeout_s=None):
+            return True
+
+        def abort(self):
+            pass
+
+
+    class Channel:
+        def __init__(self, addr):
+            self._ptr = 1
+
+        def call_async(self, service, method, request=b""):
+            return PendingCall()
+
+        def stream(self, service, method, request=b""):
+            return Stream()
+
+        def close(self):
+            pass
+
+
+    class Server:
+        def __init__(self):
+            self._ptr = 1
+
+        def close(self):
+            pass
+"""
+
+
+def _lint_handle_fixture(tmp_path, app_src, name="app.py"):
+    (tmp_path / "rpc.py").write_text(textwrap.dedent(_RPC_STUB))
+    (tmp_path / name).write_text(textwrap.dedent(app_src))
+    return lint.run_lint([str(tmp_path)], checks=["handle-lifecycle"])
+
+
+def test_dropped_pending_call_flagged(tmp_path):
+    fs = _lint_handle_fixture(tmp_path, """\
+        def fire_and_forget(ch):
+            ch.call_async("Ps", "ApplyGrad", b"x")
+    """)
+    (f,) = fs
+    assert "PendingCall" in f.message and "DROPPED" in f.message
+    assert f.line == 2
+
+
+def test_unclosed_stream_on_early_return_path_flagged(tmp_path):
+    fs = _lint_handle_fixture(tmp_path, """\
+        import rpc
+
+        def push(addr, flag):
+            ch = rpc.Channel(addr)
+            st = ch.stream("Ps", "StreamApply")
+            if flag:
+                ch.close()
+                return None
+            st.write(b"delta")
+            st.close()
+            ch.close()
+    """)
+    (f,) = fs
+    assert "Stream 'st'" in f.message and "leaks" in f.message
+    assert f.line == 8  # the early return, not the binding
+
+
+def test_clean_ownership_transfer_is_clean(tmp_path):
+    fs = _lint_handle_fixture(tmp_path, """\
+        import rpc
+        from rpc import Channel
+
+
+        def make_channel(addr):
+            return Channel(addr)
+
+
+        def round_trip(addr):
+            ch = make_channel(addr)
+            try:
+                pc = ch.call_async("Echo", "M")
+                return pc.join()
+            finally:
+                ch.close()
+
+
+        class Holder:
+            def __init__(self, addr):
+                self.ch = rpc.Channel(addr)
+                self.srv = rpc.Server()
+
+            def close(self):
+                self.ch.close()
+                self.srv.close()
+    """)
+    assert fs == []
+
+
+def test_inline_consumed_factory_chain_is_clean(tmp_path):
+    fs = _lint_handle_fixture(tmp_path, """\
+        def call(ch, req):
+            return ch.call_async("S", "M", req).join()
+    """)
+    assert fs == []
+
+
+def test_attr_store_without_release_method_flagged(tmp_path):
+    fs = _lint_handle_fixture(tmp_path, """\
+        import rpc
+
+
+        class LeakyHolder:
+            def __init__(self, addr):
+                self.ch = rpc.Channel(addr)
+    """)
+    (f,) = fs
+    assert "LeakyHolder.ch" in f.message
+    assert "never releases" in f.message
+
+
+def test_container_escape_flagged_and_pragma_accepted(tmp_path):
+    bad = """\
+        import rpc
+
+        def pool(addrs):
+            out = {}
+            for i, a in enumerate(addrs):
+                out[i] = rpc.Channel(a)
+            return out
+    """
+    (f,) = _lint_handle_fixture(tmp_path, bad)
+    assert "container" in f.message and "allow-handle-escape" in f.message
+    good = bad.replace(
+        "out[i] = rpc.Channel(a)",
+        "out[i] = rpc.Channel(a)  # lint: allow-handle-escape")
+    assert _lint_handle_fixture(tmp_path, good) == []
+
+
+def test_thread_target_escape_flagged(tmp_path):
+    fs = _lint_handle_fixture(tmp_path, """\
+        import threading
+
+        import rpc
+
+        def spawn(addr):
+            ch = rpc.Channel(addr)
+            t = threading.Thread(target=worker, args=(ch,))
+            t.start()
+
+        def worker(ch):
+            pass
+    """)
+    (f,) = fs
+    assert "thread target" in f.message
+
+
+def test_fall_through_leak_flagged_and_release_any_branch_clean(tmp_path):
+    (f,) = _lint_handle_fixture(tmp_path, """\
+        import rpc
+
+        def leaky(addr):
+            ch = rpc.Channel(addr)
+            ch.call_async("S", "M").join()
+    """)
+    assert "Channel 'ch'" in f.message and "fall-through" in f.message
+    # may-leak polarity: a release on SOME branch is trusted (the guard
+    # idiom) — no false positive
+    assert _lint_handle_fixture(tmp_path, """\
+        import rpc
+
+        def guarded(addr, cond):
+            ch = rpc.Channel(addr)
+            if cond:
+                ch.close()
+    """) == []
+
+
+def test_finally_release_covers_returns_inside_try(tmp_path):
+    assert _lint_handle_fixture(tmp_path, """\
+        import rpc
+
+        def fan_out(addr, reqs):
+            group = rpc.Server()
+            try:
+                for r in reqs:
+                    if not r:
+                        return None
+                return len(reqs)
+            finally:
+                group.close()
+    """) == []
+
+
+def test_abi_pairing_requires_destroy_symbol(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import ctypes
+        lib.brt_widget_new.argtypes = []
+        lib.brt_widget_new.restype = ctypes.c_void_p
+        lib.brt_widget_new()
+    """, checks=["handle-lifecycle"])
+    (f,) = fs
+    assert "brt_widget_destroy" in f.message
+    fixed = _lint_src(tmp_path, """\
+        import ctypes
+        lib.brt_widget_new.argtypes = []
+        lib.brt_widget_new.restype = ctypes.c_void_p
+        lib.brt_widget_destroy.argtypes = [ctypes.c_void_p]
+        lib.brt_widget_destroy.restype = None
+        lib.brt_widget_new()
+    """, name="fixed.py", checks=["handle-lifecycle"])
+    assert fixed == []

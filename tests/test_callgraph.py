@@ -454,7 +454,12 @@ def test_local_binding_through_module_alias_and_ifexp(tmp_path):
     assert sum(1 for s in g.callees(main) if s.callee == run) == 2
 
 
-def test_local_ambiguous_or_call_result_stays_deferred(tmp_path):
+def test_local_ambiguous_stays_deferred_but_call_results_resolve(tmp_path):
+    # The PR-3 deferral's second half: calls on CALL RESULTS now resolve
+    # through return-type inference — `y = factory(); y.run()` follows
+    # the factory's direct in-package return.  Ambiguity rules are
+    # unchanged: a local bound to two classes (or a factory whose returns
+    # disagree) stays unresolved.
     g = _graph(
         tmp_path,
         lib="""\
@@ -468,9 +473,14 @@ def test_local_ambiguous_or_call_result_stays_deferred(tmp_path):
 
             def factory():
                 return A()
+
+            def two_faced(flag):
+                if flag:
+                    return A()
+                return B()
         """,
         app="""\
-            from lib import A, B, factory
+            from lib import A, B, factory, two_faced
 
             def ambiguous(flag):
                 x = A()
@@ -481,17 +491,23 @@ def test_local_ambiguous_or_call_result_stays_deferred(tmp_path):
             def call_result():
                 y = factory()
                 y.run()
+
+            def ambiguous_factory():
+                z = two_faced(True)
+                z.run()
         """,
     )
     run_a = _only_node(g, "A.run")
     run_b = _only_node(g, "B.run")
     amb = _callee_ids(g, _only_node(g, ":ambiguous"))
     assert run_a not in amb and run_b not in amb
-    # calls on call results remain deferred (factory's return type is
-    # not tracked) — only the factory edge itself exists
     cr = _callee_ids(g, _only_node(g, ":call_result"))
-    assert run_a not in cr and run_b not in cr
+    assert run_a in cr            # the closed deferral
+    assert run_b not in cr
     assert _only_node(g, ":factory") in cr
+    # a factory whose returns name two classes is ambiguous → no edge
+    af = _callee_ids(g, _only_node(g, ":ambiguous_factory"))
+    assert run_a not in af and run_b not in af
 
 
 def test_nested_def_reads_enclosing_local_binding(tmp_path):
@@ -577,3 +593,166 @@ def test_synchronized_helper_method_not_flagged_as_container(tmp_path):
                 if f.check == "fiber-shared-state"]
     assert len(findings) == 1
     assert "Combiner.add" in findings[0].message
+
+
+# ---- return-type inference (calls on CALL RESULTS resolve) ----
+
+def test_cached_accessor_call_result_resolves(tmp_path):
+    # the obs.recorder(name).record shape: the accessor returns a local
+    # that is ALSO fed from a cache lookup, but every resolved return
+    # names one class — annotation-free inference from the constructor
+    # binding
+    g = _graph(
+        tmp_path,
+        vars="""\
+            class LatencyRecorder:
+                def record(self, s):
+                    pass
+        """,
+        obs="""\
+            from vars import LatencyRecorder
+
+            _cache = {}
+
+            def recorder(name):
+                rec = _cache.get(name)
+                if rec is None:
+                    rec = LatencyRecorder()
+                    _cache[name] = rec
+                return rec
+        """,
+        app="""\
+            import obs
+
+            def instrument(name, v):
+                obs.recorder(name).record(v)
+        """,
+    )
+    rec = _only_node(g, "LatencyRecorder.record")
+    assert rec in _callee_ids(g, _only_node(g, ":instrument"))
+
+
+def test_string_annotation_return_type_resolves(tmp_path):
+    g = _graph(
+        tmp_path,
+        rpc="""\
+            class Stream:
+                def write(self, b):
+                    pass
+        """,
+        client="""\
+            from brpc_tpu import nothing  # noqa
+            import rpc
+
+            class Client:
+                def _push_stream(self, s) -> "rpc.Stream":
+                    return self._streams[s]
+
+                def push(self, s, frame):
+                    self._push_stream(s).write(frame)
+        """,
+    )
+    write = _only_node(g, "Stream.write")
+    assert write in _callee_ids(g, _only_node(g, "Client.push"))
+
+
+def test_optional_annotation_unwraps(tmp_path):
+    g = _graph(
+        tmp_path,
+        lib="""\
+            class Thing:
+                def go(self):
+                    pass
+        """,
+        app="""\
+            from typing import Optional
+
+            from lib import Thing
+
+            def maybe_thing(flag) -> Optional[Thing]:
+                return Thing() if flag else None
+
+            def use(flag):
+                t = maybe_thing(flag)
+                t.go()
+        """,
+    )
+    go = _only_node(g, "Thing.go")
+    assert go in _callee_ids(g, _only_node(g, ":use"))
+
+
+def test_constructor_call_result_chain_resolves(tmp_path):
+    g = _graph(
+        tmp_path,
+        lib="""\
+            class W:
+                def __init__(self):
+                    pass
+
+                def run(self):
+                    pass
+        """,
+        app="""\
+            from lib import W
+
+            def inline():
+                W().run()
+        """,
+    )
+    assert _only_node(g, "W.run") in _callee_ids(g, _only_node(g, ":inline"))
+
+
+def test_factory_typed_attr_assignment(tmp_path):
+    # self.<attr> = make_channel() types the attr through the factory's
+    # return type — held-object calls resolve
+    g = _graph(
+        tmp_path,
+        lib="""\
+            class Channel:
+                def __init__(self, addr):
+                    pass
+
+                def call(self, m):
+                    pass
+
+            def make_channel(addr):
+                return Channel(addr)
+        """,
+        app="""\
+            from lib import make_channel
+
+            class Client:
+                def __init__(self, addr):
+                    self.ch = make_channel(addr)
+
+                def go(self):
+                    self.ch.call("M")
+        """,
+    )
+    call = _only_node(g, "Channel.call")
+    assert call in _callee_ids(g, _only_node(g, "Client.go"))
+
+
+def test_factory_return_chain_fixpoint(tmp_path):
+    g = _graph(
+        tmp_path,
+        lib="""\
+            class C:
+                def m(self):
+                    pass
+
+            def inner():
+                return C()
+
+            def outer():
+                return inner()
+        """,
+        app="""\
+            from lib import outer
+
+            def use():
+                x = outer()
+                x.m()
+        """,
+    )
+    assert _only_node(g, "C.m") in _callee_ids(g, _only_node(g, ":use"))

@@ -1,0 +1,261 @@
+"""Dynamic handle ledger (brpc_tpu.analysis.handles): Python-side
+bookkeeping of every owning brt_* handle with creation stacks, cross-
+checked against the native ground-truth counters
+(``brt_debug_handle_counts``) — and the proof that it catches the
+ROADMAP stream-receiver leak (a stream client dying WITHOUT a graceful
+close) before the socket-failure teardown clears it."""
+
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.analysis import handles, race
+
+
+@pytest.fixture(autouse=True)
+def _ledger_isolation():
+    handles.set_enabled(True)
+    yield
+    race.set_sample(None)
+    handles.set_enabled(None)
+
+
+# ---- ledger unit behavior (no native core needed) ----
+
+
+def test_create_destroy_roundtrip_with_fake_handles():
+    base = handles.live_counts().get("widget", 0)
+    handles.note_create("widget", 0x1111)
+    handles.note_create("widget", 0x2222)
+    assert handles.live_counts().get("widget", 0) == base + 2
+    recs = handles.live("widget")
+    assert {r.handle for r in recs} >= {0x1111, 0x2222}
+    assert any("test_create_destroy_roundtrip" in r.stack for r in recs)
+    handles.note_destroy("widget", 0x1111)
+    handles.note_destroy("widget", 0x2222)
+    assert handles.live_counts().get("widget", 0) == base
+
+
+def test_failed_constructor_and_unknown_destroy_are_tolerated():
+    base = dict(handles.live_counts())
+    handles.note_create("gizmo", 0)       # NULL: constructor failed
+    handles.note_create("gizmo", None)    # ctypes NULL return
+    assert handles.live_counts().get("gizmo", 0) == base.get("gizmo", 0)
+    handles.note_destroy("gizmo", 0xdead)  # never created: no underflow
+    assert handles.live_counts().get("gizmo", 0) == base.get("gizmo", 0)
+    assert handles.stats()["gizmo"]["unknown_destroys"] >= 1
+
+
+def test_sampling_reuses_racecheck_machinery():
+    race.set_sample(1000)
+    try:
+        for i in range(5):
+            handles.note_create("sampled", 0x9000 + i)
+        recs = [r for r in handles.live("sampled")]
+        # first creation of the kind is always captured; later ones
+        # carry the placeholder (counts stay exact either way)
+        stacks = [r.stack for r in sorted(recs, key=lambda r: r.seq)]
+        assert handles.SAMPLED_OUT in stacks
+        assert any(handles.SAMPLED_OUT not in s for s in stacks)
+        assert handles.live_counts()["sampled"] == 5
+    finally:
+        for i in range(5):
+            handles.note_destroy("sampled", 0x9000 + i)
+
+
+def test_report_carries_kind_count_and_stack():
+    handles.note_create("reported", 0x7777)
+    try:
+        text = handles.report()
+        assert "reported=1" in text or "reported" in text
+        assert "0x7777" in text
+        assert "created here" in text
+    finally:
+        handles.note_destroy("reported", 0x7777)
+
+
+def test_disabled_ledger_records_nothing():
+    handles.set_enabled(False)
+    handles.note_create("off", 0x1234)
+    assert handles.live_counts().get("off", 0) == 0
+    handles.set_enabled(True)
+
+
+# ---- native cross-check: Python bookkeeping vs C++ ground truth ----
+
+
+@pytest.mark.needs_native
+def test_python_ledger_agrees_with_native_counts_across_lifecycle():
+    if not rpc._lib or not isinstance(
+            getattr(rpc._lib, "brt_server_new", None), rpc._LedgerFn):
+        pytest.skip("ABI wrappers not installed "
+                    "(BRPC_TPU_HANDLECHECK was off at load)")
+    py0 = handles.live_counts()
+    nat0 = rpc.debug_handle_counts()
+    srv = rpc.Server()
+    srv.add_service("Echo", lambda m, b: b)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}")
+    pc = ch.call_async("Echo", "M", b"x")
+    group = rpc.CallGroup()
+    group.add(pc)
+    assert pc.join() == b"x"
+    py1 = handles.live_counts()
+    nat1 = rpc.debug_handle_counts()
+    for kind in ("server", "channel", "call_group"):
+        py_delta = py1.get(kind, 0) - py0.get(kind, 0)
+        nat_delta = nat1[kind] - nat0.get(kind, 0)
+        assert py_delta == nat_delta == 1, (kind, py_delta, nat_delta)
+    # the joined call was destroyed on both sides
+    assert py1.get("call", 0) == py0.get("call", 0)
+    group.close()
+    ch.close()
+    srv.close()
+    py2 = handles.live_counts()
+    nat2 = rpc.debug_handle_counts()
+    for kind in ("server", "channel", "call_group", "call"):
+        assert py2.get(kind, 0) == py0.get(kind, 0), kind
+        assert nat2[kind] == nat0.get(kind, 0), kind
+
+
+@pytest.mark.needs_native
+def test_leaked_pending_call_is_visible_then_reaped():
+    srv = rpc.Server()
+    srv.add_service("Echo", lambda m, b: b)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}")
+    before = handles.live_counts().get("call", 0)
+    race.set_sample(1)
+    pc = ch.call_async("Echo", "M", b"y")
+    live = handles.live("call")
+    assert handles.live_counts().get("call", 0) == before + 1
+    assert any("call_async" in r.stack for r in live)
+    pc.close()  # reap
+    assert handles.live_counts().get("call", 0) == before
+    ch.close()
+    srv.close()
+
+
+# ---- THE seeded leak: stream client dies without a graceful close ----
+
+
+class _Recorder:
+    def __init__(self):
+        self.frames = []
+        self.closed = False
+
+    def on_data(self, data):
+        self.frames.append(bytes(data))
+
+    def on_closed(self):
+        self.closed = True
+
+
+def _settle(predicate, timeout_s=3.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+@pytest.mark.needs_native
+def test_ledger_catches_stream_receiver_leak_and_teardown_clears_it():
+    """The ROADMAP leak, end to end: a server-side stream receiver whose
+    client vanishes without CLOSE is (1) visible in the dynamic ledger —
+    nonzero live ``stream_receiver`` with a creation stack — with the
+    native ``stream_relay`` ground truth agreeing, and (2) torn down to
+    zero by the socket-failure hook once the dead connection fails
+    (``on_closed`` fires, the registry entry frees, both ledgers return
+    to baseline)."""
+    race.set_sample(1)  # the leak report must carry a real stack
+    recorder = _Recorder()
+    srv = rpc.Server()
+
+    def handler(method, request, accept):
+        accept(recorder)
+        return b"accepted"
+
+    srv.add_stream_handler("T", handler)
+    port = srv.start("127.0.0.1:0")
+    addr = f"127.0.0.1:{port}"
+    py0 = handles.live_counts().get("stream_receiver", 0)
+    nat0 = rpc.debug_handle_counts().get("stream_relay", 0)
+
+    ch = rpc.Channel(addr)
+    st = ch.stream("T", "S")
+    st.write(b"delta-1")
+    assert _settle(lambda: recorder.frames == [b"delta-1"])
+
+    # The client now ABANDONS the stream: no close, no abort — the
+    # receiver is live on the server with nothing left to release it.
+    # This is the leak; both ledgers must see it.
+    assert handles.live_counts().get("stream_receiver", 0) == py0 + 1
+    assert rpc.debug_handle_counts().get("stream_relay", 0) == nat0 + 1
+    (leak,) = [r for r in handles.live("stream_receiver")
+               if r.handle not in ()][-1:]
+    assert "accept" in leak.stack  # creation stack points at the bind
+
+    # "Client death": every connection to the server fails (what the
+    # kernel delivers when the client process dies).  The socket-failure
+    # teardown must fire on_closed and drain BOTH ledgers to baseline.
+    assert rpc.debug_fail_connections(addr) >= 1
+    assert _settle(lambda: handles.live_counts().get(
+        "stream_receiver", 0) == py0), handles.report()
+    assert _settle(lambda: rpc.debug_handle_counts().get(
+        "stream_relay", 0) == nat0)
+    assert recorder.closed  # the receiver was told, not just dropped
+
+    # local client half: release bookkeeping, then teardown
+    st.abort()
+    assert _settle(
+        lambda: rpc.debug_handle_counts().get("stream", 0) == 0)
+    ch.close()
+    srv.close()
+
+
+@pytest.mark.needs_native
+def test_graceful_close_never_trips_the_ledger():
+    recorder = _Recorder()
+    srv = rpc.Server()
+    srv.add_stream_handler("T", lambda m, r, accept:
+                           (accept(recorder), b"")[1])
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}")
+    py0 = handles.live_counts()
+    st = ch.stream("T", "S")
+    st.write(b"a")
+    st.write(b"b")
+    st.close()
+    assert st.join(timeout_s=5.0)
+    assert _settle(lambda: handles.live_counts().get(
+        "stream_receiver", 0) == py0.get("stream_receiver", 0))
+    assert recorder.frames == [b"a", b"b"] and recorder.closed
+    ch.close()
+    srv.close()
+
+
+@pytest.mark.needs_native
+def test_abort_over_healthy_socket_frees_the_peer_receiver():
+    """In-process teardown: pooled SINGLE connections outlive the
+    channel, so a plain abort used to strand the server receiver until
+    process exit.  Abort now sends a best-effort CLOSE when the socket
+    is healthy — the peer frees its receiver without a connection
+    death."""
+    recorder = _Recorder()
+    srv = rpc.Server()
+    srv.add_stream_handler("T", lambda m, r, accept:
+                           (accept(recorder), b"")[1])
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}")
+    py0 = handles.live_counts().get("stream_receiver", 0)
+    st = ch.stream("T", "S")
+    st.write(b"x")
+    st.abort()
+    assert _settle(lambda: handles.live_counts().get(
+        "stream_receiver", 0) == py0), handles.report()
+    assert recorder.closed
+    ch.close()
+    srv.close()

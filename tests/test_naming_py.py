@@ -57,7 +57,7 @@ def test_registry_register_list_watch():
     nodes, _ = reg.list("c3")
     assert [n["addr"] for n in nodes] == ["10.0.0.4:1"]
     reg.close()
-    reg_server.stop()
+    reg_server.close()
 
 
 def test_remote_embedding_from_registry():
@@ -92,7 +92,7 @@ def test_remote_embedding_from_registry():
     reg.close()
     for s in shards:
         s.close()
-    reg_server.stop()
+    reg_server.close()
 
 
 def test_from_registry_times_out_on_incomplete_cluster():
@@ -109,4 +109,4 @@ def test_from_registry_times_out_on_incomplete_cluster():
     except TimeoutError:
         pass
     reg.close()
-    reg_server.stop()
+    reg_server.close()

@@ -215,6 +215,10 @@ def test_sampled_out_held_stack_uses_placeholder():
 
 def test_full_capture_unaffected_by_default_sample():
     race.set_enabled(True)
+    # tier-1's conftest exports a sampled default for the HANDLE ledger;
+    # pin full capture explicitly — the property under test is that
+    # sample_every()==1 never yields a placeholder stack.
+    race.set_sample(1)
     assert race.sample_every() == 1
     lock = race.checked_lock("smp.full")
     with lock:
