@@ -15,7 +15,9 @@ native handles):
   ``ctypes-contract``, ``fiber-shared-state`` (handler-reachable
   mutation across modules), ``obs-guard``, ``trace-purity`` (transitive,
   with call chains + host-callback hazards), and ``lock-order`` (static
-  inversion cycles over the ``with checked_lock`` nesting graph).
+  inversion cycles over the ``with checked_lock`` nesting graph; locks
+  resolve through module/class/parameter bindings and module-level
+  literal dict containers — ``LOCKS["a"]`` binds by key).
   Findings carry stable ids; ``--baseline`` diffs against an accepted
   set.  ``tests/test_lint_clean.py`` keeps the tree at zero new
   findings.

@@ -47,7 +47,9 @@ the calls, not the file):
   harness (:mod:`brpc_tpu.analysis.race`) becomes the confirmer, not
   the only detector.  ``checked_rwlock`` participates too: both
   ``.read()`` and ``.write()`` contexts acquire under the lock's one
-  name, matching the dynamic graph's keying.
+  name, matching the dynamic graph's keying.  Locks resolve through
+  module/class/parameter bindings AND module-level literal dict
+  containers (``LOCKS["a"]`` binds by key).
 - ``fiber-blocking-sleep`` — a bare ``time.sleep`` anywhere
   handler-reachable (interprocedural, same walk as
   ``fiber-shared-state``) parks the fiber worker PTHREAD, not just the
@@ -1011,12 +1013,17 @@ def _walk_traced(root_sc: _FileScan, root_fn: ast.AST, root_name: str,
 
 def _collect_checked_locks(scans: List[_FileScan], graph: CallGraph
                            ) -> Tuple[Dict[str, Dict[str, str]],
-                                      Dict[Tuple[str, str], Dict[str, str]]]:
+                                      Dict[Tuple[str, str], Dict[str, str]],
+                                      Dict[str, Dict[str, Dict[str, str]]]]:
     """Map ``x = checked_lock("name")`` assignments to lock names:
-    per-module ``var -> name`` and per-class ``self.attr -> name``."""
+    per-module ``var -> name``, per-class ``self.attr -> name``, and
+    per-module literal-dict CONTAINERS ``var -> {key -> name}`` (a
+    module-level ``LOCKS = {"a": checked_lock(...), "b": A}`` makes
+    ``LOCKS["a"]`` resolvable by key)."""
     mi_by_path = {mi.path: mi for mi in graph.modules.values()}
     mod_locks: Dict[str, Dict[str, str]] = {}
     cls_locks: Dict[Tuple[str, str], Dict[str, str]] = {}
+    cont_locks: Dict[str, Dict[str, Dict[str, str]]] = {}
 
     def lock_name(value: ast.AST) -> Optional[str]:
         if isinstance(value, ast.Call) and \
@@ -1056,7 +1063,33 @@ def _collect_checked_locks(scans: List[_FileScan], graph: CallGraph
                             tgt.value.id == "self":
                         cls_locks.setdefault(
                             (mi.name, stmt.name), {})[tgt.attr] = name
-    return mod_locks, cls_locks
+    # Second sweep: MODULE-LEVEL literal dict containers.  Values may be
+    # direct checked_lock(...) calls or names of locks collected above
+    # (same module), so this runs after the direct pass.
+    for sc in scans:
+        mi = mi_by_path.get(sc.path)
+        if mi is None:
+            continue
+        for stmt in sc.tree.body:
+            if not isinstance(stmt, ast.Assign) or \
+                    not isinstance(stmt.value, ast.Dict):
+                continue
+            entries: Dict[str, str] = {}
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    continue
+                name = lock_name(v)
+                if name is None and isinstance(v, ast.Name):
+                    name = mod_locks.get(mi.name, {}).get(v.id)
+                if name is not None:
+                    entries[k.value] = name
+            if entries:
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        cont_locks.setdefault(mi.name, {})[tgt.id] = \
+                            entries
+    return mod_locks, cls_locks, cont_locks
 
 
 def _order_path(adj: Dict[str, Set[str]], src: str,
@@ -1076,9 +1109,20 @@ def _order_path(adj: Dict[str, Set[str]], src: str,
 
 def _check_lock_order(scans: List[_FileScan],
                       graph: CallGraph) -> List[Finding]:
-    mod_locks, cls_locks = _collect_checked_locks(scans, graph)
-    if not mod_locks and not cls_locks:
+    mod_locks, cls_locks, cont_locks = _collect_checked_locks(scans,
+                                                              graph)
+    if not mod_locks and not cls_locks and not cont_locks:
         return []
+
+    def _target_module(node: FuncNode, root: str):
+        """Resolve an imported-module alias / from-import in ``node``'s
+        module to the graph module it names (or None)."""
+        mi = graph.modules[node.module]
+        target_name = mi.import_aliases.get(root)
+        if target_name is None and root in mi.from_imports:
+            m, orig = mi.from_imports[root]
+            target_name = f"{m}.{orig}" if m else orig
+        return graph._find_module(target_name) if target_name else None
 
     def resolve_lock(expr: ast.AST, node: FuncNode,
                      param_locks: Optional[Dict[str, str]] = None
@@ -1109,6 +1153,38 @@ def _check_lock_order(scans: List[_FileScan],
                 if target is not None:
                     return mod_locks.get(target.name, {}).get(expr.attr)
             return None
+        if isinstance(expr, ast.Subscript):
+            # Container-stored locks: `LOCKS["a"]` where LOCKS is a
+            # module-level literal dict — the subscript load binds by
+            # key (closes the last PR-3 lock blind spot; non-constant
+            # keys and non-literal containers stay unresolved).
+            sl = expr.slice
+            if not (isinstance(sl, ast.Constant)
+                    and isinstance(sl.value, str)):
+                return None
+            base = expr.value
+            if isinstance(base, ast.Name):
+                cont = cont_locks.get(node.module, {}).get(base.id)
+                if cont is None:
+                    # `from mod import LOCKS`: the container lives in
+                    # the source module under its original name.
+                    mi = graph.modules[node.module]
+                    if base.id in mi.from_imports:
+                        m, orig = mi.from_imports[base.id]
+                        target = graph._find_module(m) if m else None
+                        if target is not None:
+                            cont = cont_locks.get(target.name,
+                                                  {}).get(orig)
+                return cont.get(sl.value) if cont else None
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name):
+                # `mod.LOCKS["a"]` through an imported module
+                target = _target_module(node, base.value.id)
+                if target is not None:
+                    return cont_locks.get(target.name,
+                                          {}).get(base.attr,
+                                                  {}).get(sl.value)
+            return None
         if isinstance(expr, ast.Name):
             if param_locks and expr.id in param_locks:
                 # a lock received as a function PARAMETER, named by
@@ -1128,8 +1204,9 @@ def _check_lock_order(scans: List[_FileScan],
                         params: Dict[str, str]) -> Dict[str, str]:
         """Bind lock-valued arguments of `call` to the callee's parameter
         names, so `def use(lk): with lk:` acquires under the CALLER's
-        lock name (shrinks the PR-3 param-passed-lock blind spot;
-        container-stored locks stay deferred)."""
+        lock name (with module-literal containers also resolved, the
+        PR-3 lock blind spots are closed; locks in mutated/non-literal
+        containers stay dynamic-harness-only)."""
         cargs = getattr(callee.fn, "args", None)
         if cargs is None:
             return {}

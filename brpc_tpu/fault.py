@@ -58,7 +58,7 @@ from brpc_tpu.resilience import _hash01, sleep_ms
 __all__ = [
     "FaultRule", "FaultPlan", "install", "install_from_env", "clear",
     "current", "active", "server_intercept", "server_drop_intercept",
-    "client_intercept", "FAULTS_ENV",
+    "client_intercept", "kill_rules", "FAULTS_ENV",
 ]
 
 FAULTS_ENV = "BRPC_TPU_FAULTS"
@@ -112,6 +112,28 @@ class FaultRule:
         if self.endpoint is not None and self.endpoint != endpoint:
             return False
         return True
+
+
+def kill_rules(*endpoints: str, code: int = 1009,
+               text: str = "injected kill",
+               probability: float = 1.0,
+               max_hits: Optional[int] = None) -> "List[FaultRule]":
+    """Rules that make ``endpoints`` DEAD: every client call to the
+    address fails before the wire and every request still reaching the
+    server (a peer's replication Sync, a prober's health check) errors
+    — the deterministic kill-primary / kill-replica lever for the
+    replication tests and benches.  The default code (EFAILEDSOCKET
+    1009) is retriable and breaker-feeding, so the fabric's failover
+    machinery — redirect, promotion, revival once the rules clear —
+    is what gets exercised, not a special-cased error path."""
+    rules: List[FaultRule] = []
+    for ep in endpoints:
+        for side in _SIDES:
+            rules.append(FaultRule(
+                action="error", side=side, endpoint=ep,
+                error_code=code, error_text=f"{text} ({ep})",
+                probability=probability, max_hits=max_hits))
+    return rules
 
 
 class FaultPlan:

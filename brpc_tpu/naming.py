@@ -17,10 +17,71 @@ from a cluster, ordered by registration tag "<shard>/<num_shards>".
 
 from __future__ import annotations
 
+import dataclasses
 import http.client
 import json
 import threading
-from typing import Optional
+from typing import Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaSet:
+    """One shard range's replica group: every address serves the SAME
+    row range; ``primary`` indexes the replica that owns writes at boot
+    (runtime promotion is the client's/fabric's business — this is the
+    declared topology, reference SelectiveChannel's "replica groups per
+    partition" shape, SURVEY §2.6–2.7).
+
+    Declared in the naming registry with tags ``"<shard>/<num>"``
+    (replica 0 — the boot primary, also the legacy single-owner form)
+    and ``"<shard>/<num>/<replica>"`` (backups); parsed back by
+    :func:`parse_shard_tag` / consumed by
+    ``RemoteEmbedding.from_registry``."""
+
+    addresses: Tuple[str, ...]
+    primary: int = 0
+
+    def __post_init__(self):
+        if not self.addresses:
+            raise ValueError("ReplicaSet needs at least one address")
+        if not 0 <= self.primary < len(self.addresses):
+            raise ValueError(
+                f"primary index {self.primary} outside "
+                f"[0, {len(self.addresses)})")
+
+    @classmethod
+    def of(cls, addrs: "str | Sequence[str]") -> "ReplicaSet":
+        """Normalize a bare address or an address sequence."""
+        if isinstance(addrs, ReplicaSet):
+            return addrs
+        if isinstance(addrs, str):
+            return cls((addrs,))
+        return cls(tuple(str(a) for a in addrs))
+
+
+def shard_tag(shard: int, num_shards: int, replica: int = 0) -> str:
+    """Registration tag for shard ``shard`` of ``num_shards``: replica 0
+    keeps the legacy two-field form so pre-replication registrants and
+    resolvers interoperate."""
+    if replica == 0:
+        return f"{shard}/{num_shards}"
+    return f"{shard}/{num_shards}/{replica}"
+
+
+def parse_shard_tag(tag: str) -> Optional[Tuple[int, int, int]]:
+    """``(shard, num_shards, replica)`` from a registration tag, or
+    ``None`` for tags that are not shard tags."""
+    parts = tag.split("/")
+    if len(parts) not in (2, 3):
+        return None
+    try:
+        shard, num = int(parts[0]), int(parts[1])
+        replica = int(parts[2]) if len(parts) == 3 else 0
+    except ValueError:
+        return None
+    if replica < 0:
+        return None
+    return shard, num, replica
 
 
 class NamingClient:

@@ -11,23 +11,39 @@ partition_channel.*) and runs Lookup / ApplyGrad calls. The intra-pod tier
 Wire format (little-endian): Lookup req = int32 count ++ int32 ids;
 rsp = float32 rows [count, dim]. ApplyGrad req = int32 count ++ int32 ids
 ++ float32 grads [count, dim]; rsp = empty.  The streaming push
-(``StreamApply``) reuses the ApplyGrad framing: the setup RPC carries an
-empty request and every stream FRAME is one framed delta — no per-frame
-response; application order/completion ride the stream close.
+(``StreamApply``) reuses the ApplyGrad framing: the setup RPC carries the
+writer's id (empty = the legacy unframed mode) and every stream FRAME is
+one ``(seq, epoch, gen)`` int64 header + framed delta — no per-frame
+response; application order/completion ride the stream close, and the
+server's per-writer seq window makes reconnect replay IDEMPOTENT (a
+frame whose write failed may still have reached the server; replaying it
+dedups instead of double-applying).
+
+Replication (this tier's availability story): a :class:`naming.ReplicaSet`
+per shard range declares primary+backups.  Reads route to any live
+replica by latency+inflight score; writes go to the primary, which
+propagates every APPLIED batch to its backups over the same stream
+framing (``ReplicaApply``), generation-tagged so a backup installing
+gen N+1 is byte-identical to the primary.  Promotion is fenced by an
+epoch: a stale primary's propagation is rejected (EFENCED) and demotes
+itself.  See the "Replication & failover" README section.
 """
 
 from __future__ import annotations
 
-import dataclasses
+import collections
+import json
 import struct
 import threading
 import time
-from typing import List, Optional, Sequence
+import uuid
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from brpc_tpu import obs, resilience, rpc
 from brpc_tpu.analysis.race import checked_lock, checked_rwlock
+from brpc_tpu.naming import ReplicaSet, parse_shard_tag
 
 
 def _record_ps_server(shard_index: int, method: str, count: int,
@@ -81,6 +97,23 @@ def _pack_apply_req(owned: np.ndarray, grads: np.ndarray) -> bytearray:
     np.frombuffer(req, np.float32, grads.size, 4 + 4 * n)[:] = \
         grads.reshape(-1)
     return req
+
+
+#: stream frame header: (seq, epoch, gen) int64 — StreamApply uses seq
+#: (per-writer dedup window), ReplicaApply uses epoch (fencing) + gen
+#: (in-order install / dedup); unused fields are 0.
+_FRAME_HDR = struct.Struct("<qqq")
+
+
+def _pack_stream_frame(seq: int, epoch: int, gen: int,
+                       body) -> bytearray:
+    """One framed stream message: header + ApplyGrad-framed body, built
+    into a single pre-sized buffer (same discipline as the request
+    packers)."""
+    out = bytearray(_FRAME_HDR.size + len(body))
+    _FRAME_HDR.pack_into(out, 0, seq, epoch, gen)
+    out[_FRAME_HDR.size:] = body
+    return out
 
 
 def _unpack_apply(payload: bytes, base: int, rows_per: int, dim: int):
@@ -224,19 +257,360 @@ class _ApplyStreamReceiver:
     delivery fiber — a combiner drain happening here delays the
     consumed-bytes feedback, which is exactly how server-side apply cost
     back-pressures the pushing trainer.  ``on_closed`` flushes the
-    combiner BEFORE the server's half closes, so a client's
-    ``close(); join()`` is an "every pushed delta is applied" barrier."""
+    combiner (and, on a replicated primary, waits for backup acks)
+    BEFORE the server's half closes, so a client's ``close(); join()``
+    is an "every pushed delta is applied everywhere" barrier.
 
-    __slots__ = ("_server",)
+    ``writer`` non-empty = the framed mode: every frame carries a
+    ``(seq, 0, 0)`` header and the server's per-writer monotonic seq
+    window drops replays (reconnect-after-partial-write ships the same
+    frame twice at most; the window makes the second a no-op instead of
+    a double apply).  Empty writer = the legacy unframed mode."""
 
-    def __init__(self, server):
+    __slots__ = ("_server", "_writer")
+
+    def __init__(self, server, writer: str = ""):
         self._server = server
+        self._writer = writer
 
     def on_data(self, data: bytes) -> None:
-        self._server._apply_frame(data)
+        if not self._writer:
+            self._server._apply_frame(data)
+            return
+        seq, _epoch, _gen = _FRAME_HDR.unpack_from(data, 0)
+        if not self._server._reserve_seq(self._writer, seq):
+            if obs.enabled():
+                obs.counter("ps_stream_dedup_drops").add(1)
+            return
+        self._server._apply_frame(memoryview(data)[_FRAME_HDR.size:])
 
     def on_closed(self) -> None:
         self._server._combiner.flush()
+        self._server.flush_replication()
+
+
+class _ReplicaStreamReceiver:
+    """Backup half of primary→backup delta propagation: each frame is
+    one applied batch, epoch-fenced and generation-tagged.  Frames apply
+    IN ORDER (the stream is ordered and this receiver is serialized), so
+    after any prefix the backup's table is byte-identical to the
+    primary's table at that generation — same concatenated batches, same
+    ``subtract.at`` order, same float ops.  ``reply`` is the server half
+    of the stream: every processed frame acks the backup's current
+    generation back to the primary (the server→client direction), which
+    is what the primary's flush barrier waits on."""
+
+    __slots__ = ("_server", "reply")
+
+    def __init__(self, server):
+        self._server = server
+        self.reply: "Optional[rpc.Stream]" = None
+
+    def on_data(self, data: bytes) -> None:
+        _seq, epoch, gen = _FRAME_HDR.unpack_from(data, 0)
+        acked = self._server._apply_replica_frame(
+            epoch, gen, memoryview(data)[_FRAME_HDR.size:])
+        if acked is None:
+            # Gap: break the stream so the primary reconnects through a
+            # full sync instead of streaming into divergence.
+            if self.reply is not None:
+                self.reply.close()
+            return
+        if self.reply is not None:
+            try:
+                # negative = FENCE notification (acked is -epoch): the
+                # sender is stale — tell it synchronously so an
+                # in-flight flush fails with EFENCED instead of a
+                # write being acked by a zombie, then break the stream.
+                self.reply.write(struct.pack("<q", acked))
+            except rpc.RpcError:
+                pass  # primary gone; its reconnect re-learns the gen
+            if acked < 0:
+                self.reply.close()
+
+    def on_closed(self) -> None:
+        pass
+
+
+class _ReplicaAckReceiver:
+    """Primary-side read half of a propagation stream: collects the
+    backup's per-frame generation acks."""
+
+    __slots__ = ("_replicator", "_addr")
+
+    def __init__(self, replicator, addr: str):
+        self._replicator = replicator
+        self._addr = addr
+
+    def on_data(self, data: bytes) -> None:
+        (gen,) = struct.unpack_from("<q", data, 0)
+        if gen < 0:   # fence notification: a newer primary exists
+            self._replicator._note_fenced(self._addr)
+            return
+        self._replicator._note_ack(self._addr, gen)
+
+    def on_closed(self) -> None:
+        self._replicator._note_closed(self._addr)
+
+
+class _PeerState:
+    """One backup's propagation state (owned by its worker thread; the
+    queue/ack fields are shared under the replicator lock)."""
+
+    __slots__ = ("addr", "queue", "wake", "stream", "synced_gen",
+                 "acked_gen", "need_sync", "fenced", "down")
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.queue: collections.deque = collections.deque()
+        self.wake = threading.Event()
+        self.stream: "Optional[rpc.Stream]" = None
+        self.synced_gen = -1     # -1 = never connected
+        self.acked_gen = 0
+        self.need_sync = True
+        self.fenced = False
+        # True after a failed connect attempt (network, not fencing):
+        # the ack barrier skips an unreachable peer — its eventual
+        # reconnect resyncs the FULL table, so nothing shipped while it
+        # was down can be lost, only delayed.
+        self.down = False
+
+
+class _Replicator:
+    """Primary-side delta propagation: one worker thread per backup
+    ships every applied batch, in generation order, over a persistent
+    ``ReplicaApply`` stream (reconnect → full ``Sync`` first, so a gap
+    can never stream into divergence).  ``ship`` is an append under the
+    lock — the applying writer never blocks on a slow backup; a backup
+    that falls more than ``max_queue`` batches behind is resynced
+    wholesale instead of queueing unboundedly.  ``flush(target_gen)``
+    waits until every un-fenced backup has ACKED ``target_gen`` (acks
+    ride the server→client half of the stream) — the zero-lost-updates
+    barrier.  An EFENCED from any backup means a newer primary exists:
+    the owner demotes itself and every worker stops."""
+
+    def __init__(self, server, peers: Sequence[str], epoch: int,
+                 max_queue: int = 512, timeout_ms: int = 5000):
+        self._server = server
+        self.epoch = epoch
+        self.max_queue = max_queue
+        self.timeout_ms = timeout_ms
+        self._mu = checked_lock("ps.replicate")
+        self._stop = threading.Event()
+        self._ack_ev = threading.Event()
+        self._chans: Dict[str, rpc.Channel] = {}
+        self._peers = [_PeerState(a) for a in peers]
+        self._threads: List[threading.Thread] = []
+        for p in self._peers:
+            t = threading.Thread(target=self._worker, args=(p,),
+                                 daemon=True,
+                                 name=f"brt-replicate-{p.addr}")
+            t.start()
+            self._threads.append(t)
+
+    # -- the apply path's side (non-blocking) -----------------------------
+
+    def ship(self, gen: int, body) -> None:
+        """Enqueue one applied batch (already ApplyGrad-framed with
+        GLOBAL ids) for every backup.  Called under the shard write lock
+        — append-only, never blocks on the network."""
+        frame = bytes(_pack_stream_frame(gen, self.epoch, gen, body))
+        with self._mu:
+            for p in self._peers:
+                p.queue.append((gen, frame))
+                if len(p.queue) > self.max_queue:
+                    # Hopelessly behind: resync wholesale on reconnect
+                    # rather than holding every batch in memory.
+                    p.queue.clear()
+                    p.need_sync = True
+        for p in self._peers:
+            p.wake.set()
+        if obs.enabled():
+            obs.counter("ps_replica_frames").add(len(self._peers))
+            obs.counter("ps_replica_bytes").add(
+                len(frame) * len(self._peers))
+
+    # -- ack plumbing ------------------------------------------------------
+
+    def _note_ack(self, addr: str, gen: int) -> None:
+        with self._mu:
+            for p in self._peers:
+                if p.addr == addr and gen > p.acked_gen:
+                    p.acked_gen = gen
+        self._ack_ev.set()
+
+    def _note_closed(self, addr: str) -> None:
+        with self._mu:
+            for p in self._peers:
+                if p.addr == addr:
+                    p.need_sync = True
+        self._ack_ev.set()
+
+    def _note_fenced(self, addr: str) -> None:
+        """A backup refused a frame with a FENCE notification: a newer
+        primary exists.  Fail any in-flight flush with EFENCED and
+        demote the owner."""
+        with self._mu:
+            for p in self._peers:
+                if p.addr == addr:
+                    p.fenced = True
+        self._ack_ev.set()
+        self._server._demote_on_fence()
+
+    def acked_gens(self) -> Dict[str, int]:
+        with self._mu:
+            return {p.addr: p.acked_gen for p in self._peers}
+
+    def flush(self, target_gen: int, timeout_s: float = 5.0) -> None:
+        """Returns once every CONNECTED backup acked ``target_gen``.  A
+        peer without an established delta stream (never synced, mid
+        resync, or unreachable) is skipped — a missing backup must not
+        stall the write path, and its (re)connect starts with a full
+        ``Sync`` of the current table (which includes ``target_gen``),
+        so skipping delays its copy without losing updates.  Raises
+        ERPCTIMEDOUT naming the laggard on timeout, EFENCED if a newer
+        primary fenced this one mid-flush."""
+        deadline = time.monotonic() + timeout_s
+        for p in self._peers:
+            while True:
+                with self._mu:
+                    acked, fenced = p.acked_gen, p.fenced
+                    live = (p.stream is not None and not p.need_sync
+                            and not p.down)
+                if fenced:
+                    raise rpc.RpcError(
+                        resilience.EFENCED,
+                        f"fenced by a newer primary while flushing "
+                        f"to {p.addr}")
+                if acked >= target_gen or not live or \
+                        self._stop.is_set():
+                    break
+                if time.monotonic() > deadline:
+                    raise rpc.RpcError(
+                        1008, f"replica {p.addr} acked gen {acked} < "
+                              f"{target_gen} within {timeout_s:.1f}s")
+                self._ack_ev.clear()
+                with self._mu:
+                    if p.acked_gen >= target_gen:
+                        break
+                self._ack_ev.wait(0.005)
+
+    # -- per-backup worker -------------------------------------------------
+
+    def _channel(self, addr: str) -> rpc.Channel:
+        ch = self._chans.get(addr)
+        if ch is None:
+            ch = rpc.Channel(addr, timeout_ms=self.timeout_ms)
+            self._chans[addr] = ch
+        return ch
+
+    def _connect(self, p: _PeerState) -> bool:
+        """Full-state handoff then a fresh delta stream: ``Sync`` ships
+        a consistent (epoch, gen, table) snapshot — the backup installs
+        it wholesale — and the stream resumes from that generation, so
+        queued frames at or below it are ship-skipped (the backup would
+        dedup them anyway)."""
+        epoch, gen, table = self._server._replication_snapshot()
+        ch = self._channel(p.addr)
+        try:
+            ch.call("Ps", "Sync",
+                    struct.pack("<qqq", epoch, gen,
+                                len(table) // 4) + table,
+                    timeout_ms=self.timeout_ms)
+            st = ch.stream("Ps", "ReplicaApply",
+                           struct.pack("<q", epoch),
+                           receiver=_ReplicaAckReceiver(self, p.addr))
+        except rpc.RpcError as e:
+            if e.code == resilience.EFENCED:
+                with self._mu:
+                    p.fenced = True
+                self._ack_ev.set()
+                self._server._demote_on_fence()
+                return False
+            with self._mu:
+                p.down = True   # unreachable: the ack barrier skips it
+            self._ack_ev.set()
+            if obs.enabled():
+                obs.counter("ps_replica_connect_errors").add(1)
+            return False
+        with self._mu:
+            p.stream = st
+            p.synced_gen = gen
+            p.need_sync = False
+            p.down = False
+            if gen > p.acked_gen:
+                p.acked_gen = gen   # the Sync response IS the ack
+        self._ack_ev.set()
+        if obs.enabled():
+            obs.counter("ps_replica_syncs").add(1)
+        return True
+
+    def _worker(self, p: _PeerState) -> None:
+        backoff = resilience.Backoff(base_ms=5.0, max_ms=200.0)
+        fails = 0
+        while not self._stop.is_set():
+            with self._mu:
+                fenced = p.fenced
+                item = p.queue[0] if (p.queue and not p.need_sync
+                                      and p.stream is not None) else None
+                # Eager: (re)connect whether or not anything is queued —
+                # backups sync at boot/recovery time, not first-write
+                # time, which shrinks the window where the ack barrier
+                # has no established stream to wait on.
+                need_connect = (not fenced
+                                and (p.need_sync or p.stream is None))
+            if fenced:
+                return
+            if need_connect:
+                old, p.stream = p.stream, None
+                if old is not None:
+                    old.close()   # rx stream: close (abort strands relay)
+                if self._connect(p):
+                    fails = 0
+                else:
+                    if self._stop.is_set() or p.fenced:
+                        return
+                    fails += 1
+                    resilience.sleep_ms(backoff.delay_ms(min(fails, 6)))
+                continue
+            if item is None:
+                p.wake.wait(0.05)
+                p.wake.clear()
+                continue
+            gen, frame = item
+            if gen <= p.synced_gen:
+                with self._mu:
+                    if p.queue and p.queue[0] is item:
+                        p.queue.popleft()
+                continue
+            try:
+                p.stream.write(frame)
+            except rpc.RpcError:
+                st, p.stream = p.stream, None
+                if st is not None:
+                    st.close()
+                with self._mu:
+                    p.need_sync = True
+                continue  # frame stays queued; resync covers ordering
+            with self._mu:
+                if p.queue and p.queue[0] is item:
+                    p.queue.popleft()
+
+    def stop(self, join: bool = True) -> None:
+        self._stop.set()
+        self._ack_ev.set()
+        for p in self._peers:
+            p.wake.set()
+        if join:
+            for t in self._threads:
+                t.join(timeout=5)
+        for p in self._peers:
+            st, p.stream = p.stream, None
+            if st is not None:
+                st.close()
+        for ch in self._chans.values():
+            ch.close()
+        self._chans.clear()
 
 
 class PsShardServer:
@@ -304,6 +678,25 @@ class PsShardServer:
         self.stream = bool(stream)
         self._shard: "Optional[rpc.PsShard]" = None
         self._install_gen = 0
+        # Replication state (configure_replication): fencing epoch,
+        # whether THIS replica owns writes, the declared replica set, and
+        # the primary-side propagation machinery.
+        self._epoch = 0
+        self._primary_flag = True
+        self._replica_set: Optional[ReplicaSet] = None
+        self._replica_index = 0
+        self._replicator: Optional[_Replicator] = None
+        self._repl_mu = checked_lock("ps.repl_state")
+        #: how long a replicated apply waits for backup acks before
+        #: failing the write (sync replication among reachable replicas)
+        self.repl_ack_timeout_s = 5.0
+        #: per-call timeout for replication control traffic (Sync /
+        #: stream setup to backups) — bounds how long a blackholed
+        #: backup can stall the first flush before it is marked down
+        self.repl_timeout_ms = 2000
+        # Per-writer monotonic seq window for idempotent stream replay.
+        self._seq_mu = checked_lock("ps.writer_seq")
+        self._writer_seqs: Dict[str, int] = {}
         # The combiner exists whenever anything feeds it: unary combining
         # (combine) or streamed deltas (stream — frames ALWAYS combine,
         # they have no per-frame response to serialize on).
@@ -311,17 +704,16 @@ class PsShardServer:
             GradCombiner(self._apply_batch, dim)
             if (self.combine or self.stream) else None)
         self.server = rpc.Server()
+        # The trampoline is ALWAYS stream-capable: replica delta
+        # propagation (ReplicaApply) rides a stream whether or not the
+        # client-facing StreamApply mode is on.
         if self.native_read:
             self._shard = rpc.PsShard(vocab, dim, shard_index, num_shards)
             self._shard.install(self.table, 0)
             self.server.add_ps_service(
-                "Ps", self._shard,
-                self._handle_stream if self.stream else self._handle,
-                stream=self.stream)
-        elif self.stream:
-            self.server.add_stream_handler("Ps", self._handle_stream)
+                "Ps", self._shard, self._handle_stream, stream=True)
         else:
-            self.server.add_service("Ps", self._handle)
+            self.server.add_stream_handler("Ps", self._handle_stream)
         # `_status` rides along so the health-check prober can revive
         # this shard after a circuit-breaker isolation (resilience tier).
         self.server.add_status_service()
@@ -331,26 +723,196 @@ class PsShardServer:
     def address(self) -> str:
         return f"127.0.0.1:{self.port}"
 
+    # -- replication surface ----------------------------------------------
+
+    def configure_replication(self, replica_set: ReplicaSet,
+                              replica_index: int, *,
+                              timeout_ms: Optional[int] = None,
+                              ack_timeout_s: Optional[float] = None
+                              ) -> None:
+        """Declares this server's place in its range's replica group
+        (call after every replica has started — addresses are only known
+        then).  The replica at ``replica_set.primary`` owns writes and
+        starts propagating applied batches to the others; everyone else
+        serves reads and applies ``ReplicaApply`` deltas.
+        ``timeout_ms``/``ack_timeout_s`` tune the propagation control
+        timeout and the per-apply ack wait."""
+        if replica_set.addresses[replica_index] != self.address:
+            raise ValueError(
+                f"replica_index {replica_index} is "
+                f"{replica_set.addresses[replica_index]}, not this "
+                f"server ({self.address})")
+        if timeout_ms is not None:
+            self.repl_timeout_ms = int(timeout_ms)
+        if ack_timeout_s is not None:
+            self.repl_ack_timeout_s = float(ack_timeout_s)
+        with self._repl_mu:
+            self._replica_set = replica_set
+            self._replica_index = replica_index
+            self._primary_flag = replica_index == replica_set.primary
+            if self._primary_flag and len(replica_set.addresses) > 1:
+                self._replicator = _Replicator(
+                    self, [a for a in replica_set.addresses
+                           if a != self.address], epoch=self._epoch,
+                    timeout_ms=self.repl_timeout_ms)
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def is_primary(self) -> bool:
+        """True when this replica owns writes (always true without a
+        configured replica set — the legacy single-owner mode)."""
+        return self._primary_flag
+
+    def _peers(self) -> List[str]:
+        rs = self._replica_set
+        if rs is None:
+            return []
+        return [a for a in rs.addresses if a != self.address]
+
+    def _check_primary(self) -> None:
+        if not self._primary_flag:
+            raise rpc.RpcError(
+                resilience.ENOTPRIMARY,
+                f"shard {self.shard_index} replica {self._replica_index} "
+                f"({self.address}) is not the primary (epoch "
+                f"{self._epoch})")
+
+    def _check_repl_epoch(self, epoch: int) -> None:
+        """Fencing: a replication message (Sync / ReplicaApply setup)
+        carrying a stale epoch is rejected; a NEWER epoch means a newer
+        primary exists — adopt it and demote if this node thought it was
+        primary."""
+        demote = None
+        with self._repl_mu:
+            if epoch < self._epoch or (epoch == self._epoch
+                                       and self._primary_flag):
+                if obs.enabled():
+                    obs.counter("ps_replica_fenced").add(1)
+                raise rpc.RpcError(
+                    resilience.EFENCED,
+                    f"stale replication epoch {epoch} (current "
+                    f"{self._epoch}, primary={self._primary_flag})")
+            if epoch > self._epoch:
+                self._epoch = epoch
+                if self._primary_flag:
+                    self._primary_flag = False
+                    demote, self._replicator = self._replicator, None
+        if demote is not None:
+            demote.stop(join=False)
+
+    def _demote_on_fence(self) -> None:
+        """A backup rejected our propagation with EFENCED: a newer
+        primary exists.  Stop propagating and stop accepting writes; the
+        new primary's Sync will overwrite any divergence."""
+        demote = None
+        with self._repl_mu:
+            if self._primary_flag:
+                self._primary_flag = False
+                demote, self._replicator = self._replicator, None
+                if obs.enabled():
+                    obs.counter("ps_replica_demotions").add(1)
+        if demote is not None:
+            demote.stop(join=False)
+
+    def _replication_snapshot(self):
+        """Consistent ``(epoch, gen, table bytes)`` for a full-state
+        Sync (the read lock excludes writers, so gen and table match)."""
+        with self._mu.read():
+            return self._epoch, self._install_gen, self.table.tobytes()
+
+    def flush_replication(self, timeout_s: float = 5.0) -> None:
+        """Blocks until every backup has ACKED everything applied so far
+        (no-op for an unreplicated or backup server) — the zero-lost-
+        updates half of the flush barrier."""
+        rep = self._replicator
+        if rep is None:
+            return
+        with self._mu.read():
+            target = self._install_gen
+        rep.flush(target, timeout_s)
+
+    def _reserve_seq(self, writer: str, seq: int) -> bool:
+        """True exactly once per (writer, seq): the server-side dedup
+        window that makes reconnect replay idempotent.  Monotonic per
+        writer — the stream is ordered, so a lower-or-equal seq can only
+        be a replay of something already enqueued."""
+        with self._seq_mu:
+            if seq <= self._writer_seqs.get(writer, 0):
+                return False
+            self._writer_seqs[writer] = seq
+            return True
+
+    def _apply_replica_frame(self, epoch: int, gen: int,
+                             body) -> Optional[int]:
+        """One propagated batch from the primary: fence-checked,
+        applied only when it is the NEXT generation (duplicates ack the
+        current gen; a gap returns None so the receiver breaks the
+        stream and forces a full resync).  Returns the gen to ack, or a
+        NEGATIVE value (-epoch) when the sender is fenced — the
+        receiver relays it as an explicit fence notification."""
+        if epoch < self._epoch:
+            if obs.enabled():
+                obs.counter("ps_replica_fenced").add(1)
+            return -self._epoch
+        ids, grads = _unpack_apply(body, self.base, self.rows_per,
+                                   self.dim)
+        with self._mu.write():
+            if gen <= self._install_gen:
+                return self._install_gen   # duplicate: ack, don't apply
+            if gen != self._install_gen + 1:
+                if obs.enabled():
+                    obs.counter("ps_replica_gaps").add(1)
+                return None
+            np.subtract.at(self.table, ids, self.lr * grads)
+            self._install_gen = gen
+            if self._shard is not None:
+                self._shard.install(self.table, gen)
+            return gen
+
+    # -- request handling --------------------------------------------------
+
     def _handle(self, method: str, payload: bytes) -> bytes:
         if not obs.enabled():
             return self._serve(method, payload)
         t0 = time.monotonic_ns()
         rsp = self._serve(method, payload)
-        (count,) = struct.unpack_from("<i", payload, 0)
+        count = struct.unpack_from("<i", payload, 0)[0] \
+            if method in ("Lookup", "ApplyGrad") else 0
         _record_ps_server(self.shard_index, method, count, len(payload),
                           len(rsp), t0)
         return rsp
 
     def _handle_stream(self, method: str, payload: bytes, accept) -> bytes:
-        """Stream-capable trampoline target: ``StreamApply`` binds the
-        client's push stream to this shard's combiner; everything else is
-        the plain :meth:`_handle` contract."""
+        """Stream-capable trampoline target: ``StreamApply`` binds a
+        client's push stream to this shard's combiner (primary only;
+        a non-empty setup request is the writer id for the idempotent
+        framed mode and answers with that writer's seq high-water mark);
+        ``ReplicaApply`` binds the primary's delta stream to this
+        backup's table; everything else is the plain :meth:`_handle`
+        contract."""
         if method == "StreamApply":
-            accept(_ApplyStreamReceiver(self))
+            if not self.stream:
+                raise ValueError(f"unknown method {method}")
+            self._check_primary()
+            writer = payload.decode(errors="replace") if payload else ""
+            accept(_ApplyStreamReceiver(self, writer))
+            if writer:
+                with self._seq_mu:
+                    last = self._writer_seqs.get(writer, 0)
+                return struct.pack("<q", last)
             return b""
+        if method == "ReplicaApply":
+            (epoch,) = struct.unpack_from("<q", payload, 0)
+            self._check_repl_epoch(epoch)
+            recv = _ReplicaStreamReceiver(self)
+            recv.reply = accept(recv)
+            return struct.pack("<qq", self._epoch, self._install_gen)
         return self._handle(method, payload)
 
-    def _apply_frame(self, payload: bytes) -> None:
+    def _apply_frame(self, payload) -> None:
         """One streamed delta: parse/validate, enqueue without waiting
         (frames have no response; the close barrier flushes)."""
         t0 = time.monotonic_ns() if obs.enabled() else 0
@@ -363,16 +925,89 @@ class PsShardServer:
 
     def _apply_batch(self, ids: np.ndarray, grads: np.ndarray) -> None:
         """ONE combined application for a drained batch: a single
-        unbuffered ``subtract.at`` (duplicate ids sum exactly) and — under
-        ``native_read`` — a single snapshot install, regardless of how
-        many requests combined into the batch."""
+        unbuffered ``subtract.at`` (duplicate ids sum exactly), a
+        generation bump, under ``native_read`` a single snapshot
+        install — and, on a replicated primary, ONE propagation frame
+        shipped to every backup (enqueued under the write lock so
+        backups see batches in exactly the apply order)."""
+        if not ids.size:
+            return   # nothing applied: no generation, nothing to ship
         with self._mu.write():
             np.subtract.at(self.table, ids, self.lr * grads)
+            self._install_gen += 1
+            gen = self._install_gen
             if self._shard is not None:
-                self._install_gen += 1
-                self._shard.install(self.table, self._install_gen)
+                self._shard.install(self.table, gen)
+            rep = self._replicator
+            if rep is not None:
+                rep.ship(gen, _pack_apply_req(
+                    (ids + self.base).astype(np.int32), grads))
+        # Synchronous replication: the apply (and therefore the unary
+        # response / combiner barrier riding it) completes only once
+        # every CONNECTED backup acked this batch — a write acked to
+        # the client can never be lost to a failover among synced
+        # replicas.  Disconnected backups are skipped (their reconnect
+        # starts with a full-table Sync, so nothing is lost, only
+        # delayed); the wait happens OUTSIDE the write lock so reads
+        # keep flowing.
+        if rep is not None:
+            rep.flush(gen, timeout_s=self.repl_ack_timeout_s)
+
+    def _serve_control(self, method: str, payload: bytes) -> bytes:
+        """Replication control plane (unary, tiny, off the data path)."""
+        if method == "ReplicaState":
+            return json.dumps({
+                "epoch": self._epoch, "gen": self._install_gen,
+                "primary": self._primary_flag,
+                "replica_index": self._replica_index,
+                "addr": self.address,
+            }).encode()
+        if method == "Promote":
+            (epoch,) = struct.unpack_from("<q", payload, 0)
+            with self._repl_mu:
+                if epoch <= self._epoch:
+                    raise rpc.RpcError(
+                        resilience.EFENCED,
+                        f"promote epoch {epoch} <= current "
+                        f"{self._epoch}")
+                self._epoch = epoch
+                self._primary_flag = True
+                old, self._replicator = self._replicator, None
+                peers = self._peers()
+                if peers:
+                    self._replicator = _Replicator(
+                        self, peers, epoch=epoch,
+                        timeout_ms=self.repl_timeout_ms)
+            if old is not None:
+                old.stop(join=False)
+            if obs.enabled():
+                obs.counter("ps_replica_promotions").add(1)
+            return struct.pack("<qq", self._epoch, self._install_gen)
+        if method == "Sync":
+            epoch, gen, count = struct.unpack_from("<qqq", payload, 0)
+            self._check_repl_epoch(epoch)
+            if count != self.rows_per * self.dim:
+                raise ValueError(
+                    f"sync size {count} != shard table "
+                    f"{self.rows_per * self.dim}")
+            table = np.frombuffer(payload, np.float32, count,
+                                  24).reshape(self.rows_per, self.dim)
+            with self._mu.write():
+                self.table[:] = table
+                self._install_gen = gen
+                if self._shard is not None:
+                    self._shard.install(self.table, gen)
+            return b""
+        if method == "Flush":
+            if self._combiner is not None:
+                self._combiner.flush()
+            self.flush_replication()
+            return struct.pack("<q", self._install_gen)
+        raise ValueError(f"unknown method {method}")
 
     def _serve(self, method: str, payload: bytes) -> bytes:
+        if method in ("ReplicaState", "Promote", "Sync", "Flush"):
+            return self._serve_control(method, payload)
         (count,) = struct.unpack_from("<i", payload, 0)
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
         if ids.size and (ids.min() < 0 or ids.max() >= self.rows_per):
@@ -385,6 +1020,9 @@ class PsShardServer:
             with self._mu.read():
                 return self.table[ids].tobytes()
         if method == "ApplyGrad":
+            # Writes belong to the primary: a demoted/backup replica
+            # rejects so the client re-resolves and fails over.
+            self._check_primary()
             grads = np.frombuffer(payload, np.float32,
                                   count * self.dim, 4 + 4 * count)
             if self.combine:
@@ -393,16 +1031,7 @@ class PsShardServer:
                 self._combiner.add(ids,
                                    grads.reshape(count, self.dim))
                 return b""
-            with self._mu.write():
-                np.subtract.at(self.table, ids,
-                               self.lr * grads.reshape(count, self.dim))
-                if self._shard is not None:
-                    # Publish the post-update table as a fresh immutable
-                    # generation; the install snapshot happens under the
-                    # write lock so concurrent appliers serialize and no
-                    # update is ever skipped by a stale publish.
-                    self._install_gen += 1
-                    self._shard.install(self.table, self._install_gen)
+            self._apply_batch(ids, grads.reshape(count, self.dim))
             return b""
         raise ValueError(f"unknown method {method}")
 
@@ -413,11 +1042,17 @@ class PsShardServer:
         return 0 if self._shard is None else self._shard.native_lookups
 
     def close(self):
-        # Server first: its native Lookup handlers gather from the
-        # shard's snapshots and must drain before the shard dies.  Then
-        # the combiner: a dying stream's receiver teardown can still
-        # flush into it after Join (its delivery queue outlives the
-        # connection), and an applying drain must not race shard death.
+        # Replicator first (stop shipping; its streams point at OTHER
+        # servers).  Then the server: its native Lookup handlers gather
+        # from the shard's snapshots and must drain before the shard
+        # dies.  Then the combiner: a dying stream's receiver teardown
+        # can still flush into it after Join (its delivery queue outlives
+        # the connection), and an applying drain must not race shard
+        # death.
+        with self._repl_mu:
+            rep, self._replicator = self._replicator, None
+        if rep is not None:
+            rep.stop()
         self.server.close()
         if self._combiner is not None:
             self._combiner.shutdown()
@@ -512,6 +1147,10 @@ class DevicePsShardServer:
         self._exe_mu = checked_lock("ps.device_shard.exe")
         self.combine = bool(combine)
         self.stream = bool(stream)
+        # Per-writer monotonic seq window (same idempotent replay
+        # contract as the CPU shard — push_gradients always frames now).
+        self._seq_mu = checked_lock("ps.writer_seq")
+        self._writer_seqs: Dict[str, int] = {}
         self._combiner: Optional[GradCombiner] = (
             GradCombiner(self._apply_batch, dim)
             if (self.combine or self.stream) else None)
@@ -597,9 +1236,26 @@ class DevicePsShardServer:
 
     def _handle_stream(self, method: str, payload: bytes, accept) -> bytes:
         if method == "StreamApply":
-            accept(_ApplyStreamReceiver(self))
+            writer = payload.decode(errors="replace") if payload else ""
+            accept(_ApplyStreamReceiver(self, writer))
+            if writer:
+                with self._seq_mu:
+                    last = self._writer_seqs.get(writer, 0)
+                return struct.pack("<q", last)
             return b""
         return self._handle(method, payload)
+
+    def _reserve_seq(self, writer: str, seq: int) -> bool:
+        """Per-(writer, seq) admission — see PsShardServer._reserve_seq."""
+        with self._seq_mu:
+            if seq <= self._writer_seqs.get(writer, 0):
+                return False
+            self._writer_seqs[writer] = seq
+            return True
+
+    def flush_replication(self, timeout_s: float = 5.0) -> None:
+        """Device shards are not replicated (yet); the shared stream
+        receiver's close barrier calls this unconditionally."""
 
     def _apply_frame(self, payload: bytes) -> None:
         t0 = time.monotonic_ns() if obs.enabled() else 0
@@ -761,6 +1417,20 @@ class RemoteEmbedding:
       straggler shards: still-pending calls are CANCELLED (native
       ``StartCancel``) before being reaped, so the error surfaces at
       max(shard) latency, not sum.
+    - Retries of k failed shards re-fan CONCURRENTLY (one backoff sleep,
+      one native call group per round), so retry latency is max(shard).
+
+    REPLICATION (availability over fail-fast): pass
+    :class:`naming.ReplicaSet` entries (or address sequences) instead of
+    bare addresses and the embedding becomes replica-aware — reads route
+    to any live replica by latency+inflight score
+    (:class:`resilience.ReplicaScorer`), an open breaker REDIRECTS to a
+    sibling instead of raising ``BreakerOpen``, and writes follow the
+    primary: a failed/demoted primary triggers client-driven failover
+    (``ReplicaState`` sweep, fenced ``Promote`` of the freshest backup).
+    A non-redirect ``BreakerRegistry(redirect=False)`` restores
+    fail-fast.  The health prober revives isolated replicas back into
+    the read set.
 
     The WRITE path additionally has a streaming mode:
     :meth:`push_gradients` ships framed deltas over one persistent
@@ -773,12 +1443,16 @@ class RemoteEmbedding:
     @classmethod
     def from_registry(cls, registry_addr: str, cluster: str, vocab: int,
                       dim: int, timeout_ms: int = 2000,
-                      wait_ms: int = 5000) -> "RemoteEmbedding":
+                      wait_ms: int = 5000, **kwargs) -> "RemoteEmbedding":
         """Resolves the shard list from the native naming registry
-        (brpc_tpu.naming): shards register with tag "<shard>/<num>", and
+        (brpc_tpu.naming): shards register with tag "<shard>/<num>"
+        (the boot primary) or "<shard>/<num>/<replica>" (backups), and
         the watch blocks until a CONSISTENT full set is present (all
-        shards 0..num-1 with one num). Service discovery for the PS tier
-        — no static address list."""
+        shards 0..num-1 with one num, each with its replica 0).  Backups
+        present at resolution time join their shard's ReplicaSet in
+        replica order.  Service discovery for the PS tier — no static
+        address list.  ``kwargs`` pass through to the constructor
+        (retry/breakers/...)."""
         from brpc_tpu.naming import NamingClient
         reg = NamingClient(registry_addr)
         deadline = time.monotonic() + wait_ms / 1000.0
@@ -804,33 +1478,36 @@ class RemoteEmbedding:
             # sharding cannot block a complete consistent new set.
             groups = {}
             for n in nodes:
-                tag = n.get("tag", "")
-                if "/" not in tag:
+                parsed = parse_shard_tag(n.get("tag", ""))
+                if parsed is None:
                     continue
-                s_str, num_str = tag.split("/", 1)
-                try:
-                    sh, nm = int(s_str), int(num_str)
-                except ValueError:
-                    continue
-                shard_map = groups.setdefault(nm, {})
-                # Duplicate index within one sharding: a restarted shard's
-                # fresh registration supersedes a TTL-lingering stale one;
-                # the registry lists entries in registration order, so the
-                # LAST occurrence is the newest.
-                shard_map[sh] = n["addr"]
+                sh, nm, rep = parsed
+                # Duplicate (shard, replica) within one sharding: a
+                # restarted shard's fresh registration supersedes a
+                # TTL-lingering stale one; the registry lists entries in
+                # registration order, so the LAST occurrence is newest.
+                groups.setdefault(nm, {}).setdefault(sh, {})[rep] = \
+                    n["addr"]
             for num, shard_map in sorted(groups.items(), reverse=True):
                 if num > 0 and len(shard_map) == num and \
-                        all(i in shard_map for i in range(num)):
-                    addrs = [shard_map[i] for i in range(num)]
+                        all(i in shard_map and 0 in shard_map[i]
+                            for i in range(num)):
+                    sets = []
+                    for i in range(num):
+                        reps = shard_map[i]
+                        sets.append(ReplicaSet(
+                            tuple(reps[r] for r in sorted(reps)),
+                            primary=sorted(reps).index(0)))
                     reg.close()
-                    return cls(addrs, vocab, dim, timeout_ms=timeout_ms)
+                    return cls(sets, vocab, dim, timeout_ms=timeout_ms,
+                               **kwargs)
             if time.monotonic() > deadline:
                 reg.close()
                 raise TimeoutError(
                     f"cluster '{cluster}' has no complete sharding: "
                     f"{ {nm: sorted(m) for nm, m in groups.items()} }")
 
-    def __init__(self, addresses: Sequence[str], vocab: int, dim: int,
+    def __init__(self, addresses: Sequence, vocab: int, dim: int,
                  timeout_ms: int = 2000, parallel: bool = True, *,
                  retry: "Optional[resilience.RetryPolicy]" = None,
                  deadline_ms: Optional[float] = None,
@@ -838,10 +1515,16 @@ class RemoteEmbedding:
                  breakers: "Optional[resilience.BreakerRegistry]" = None,
                  health_check: bool = False,
                  health_interval_ms: float = 200.0,
-                 push_window_bytes: int = 0):
+                 push_window_bytes: int = 0,
+                 scorer: "Optional[resilience.ReplicaScorer]" = None):
         self.vocab = vocab
         self.dim = dim
-        self.n = len(addresses)
+        # Each entry is one shard RANGE: a bare address (single owner,
+        # the legacy form) or a naming.ReplicaSet / address sequence
+        # (primary + backups all serving the same rows).
+        self.replica_sets: List[ReplicaSet] = [
+            ReplicaSet.of(a) for a in addresses]
+        self.n = len(self.replica_sets)
         self.rows_per = vocab // self.n
         self.parallel = parallel
         self.timeout_ms = timeout_ms
@@ -849,67 +1532,290 @@ class RemoteEmbedding:
         #: native 2MB default) — the backpressure knob of push_gradients
         self.push_window_bytes = push_window_bytes
         self._push_streams: dict = {}
-        self.addresses = [str(a) for a in addresses]
+        self._push_addr: Dict[int, str] = {}
+        # Framed idempotent push: one stable writer identity, one
+        # monotonically increasing seq per shard (never reset — the
+        # server's per-writer window is the dedup state).
+        self._writer_id = f"w{uuid.uuid4().hex[:12]}"
+        self._push_seq: Dict[int, int] = {}
+        #: current believed primary per shard (index into the replica
+        #: set; moved by observed promotions / client-driven failover)
+        self._primary_idx: List[int] = [rs.primary
+                                        for rs in self.replica_sets]
+        #: highest fencing epoch ever observed per shard — failover
+        #: ignores claims/candidates BEHIND it, so a temporarily
+        #: unreachable new primary is never undercut by re-adopting (or
+        #: re-promoting) a stale one, which would lose acked updates
+        self._epoch_seen: List[int] = [0] * self.n
+        #: boot-time primary addresses — the legacy single-owner surface
+        self.addresses = [rs.addresses[rs.primary]
+                          for rs in self.replica_sets]
+        self.replicated = any(len(rs.addresses) > 1
+                              for rs in self.replica_sets)
         self.retry = retry
         self.deadline_ms = deadline_ms
         self.backup_ms = backup_ms
         self.breakers = breakers
         if health_check and breakers is None:
-            self.breakers = breakers = resilience.BreakerRegistry()
+            self.breakers = breakers = resilience.BreakerRegistry(
+                redirect=self.replicated)
         if self.breakers is not None:
-            # Register every shard up front: the cluster-recover guard
+            # Register every replica up front: the cluster-recover guard
             # counts working endpoints, so the registry must know the
-            # full cluster, not just the shards that have failed.
-            for a in self.addresses:
-                self.breakers.breaker_for(a)
+            # full cluster, not just the endpoints that have failed.
+            for rs in self.replica_sets:
+                for a in rs.addresses:
+                    self.breakers.breaker_for(a)
+        # REDIRECT mode (the SelectiveChannel behavior): reads route to
+        # any live replica by latency+inflight score, an open breaker
+        # re-routes instead of rejecting, and a failed/isolated primary
+        # fails WRITES over via fenced promotion.  On by default when
+        # replicas exist, unless a non-redirect BreakerRegistry
+        # explicitly asks for fail-fast.
+        self._redirect = self.replicated and (
+            self.breakers is None or self.breakers.redirect)
+        self.scorer = scorer or resilience.ReplicaScorer()
         self._prober: "Optional[resilience.HealthProber]" = None
         if health_check:
             self._prober = resilience.HealthProber(
                 self.breakers, interval_ms=health_interval_ms)
             self._prober.start()
+        self._chans: Dict[str, rpc.Channel] = {}
+        for rs in self.replica_sets:
+            for a in rs.addresses:
+                if a not in self._chans:
+                    self._chans[a] = rpc.Channel(a, timeout_ms=timeout_ms)
+        #: legacy per-shard view: the boot primaries' channels
         self.channels: List[rpc.Channel] = [
-            rpc.Channel(a, timeout_ms=timeout_ms) for a in addresses
-        ]
+            self._chans[a] for a in self.addresses]
+
+    # -- replica routing (SelectiveChannel / locality-aware LB analog) ----
+
+    def _chan(self, addr: str) -> rpc.Channel:
+        ch = self._chans.get(addr)
+        if ch is None:
+            ch = self._chans[addr] = rpc.Channel(
+                addr, timeout_ms=self.timeout_ms)
+        return ch
+
+    def _addr_breaker(self, addr: str
+                      ) -> "Optional[resilience.CircuitBreaker]":
+        if self.breakers is None:
+            return None
+        return self.breakers.breaker_for(addr)
+
+    def _isolated(self, addr: str) -> bool:
+        if self.breakers is None:
+            return False
+        return self.breakers.breaker_for(addr).isolated()
 
     def _breaker(self, s: int) -> "Optional[resilience.CircuitBreaker]":
         if self.breakers is None:
             return None
         return self.breakers.breaker_for(self.addresses[s])
 
+    def _ctl_timeout_ms(self) -> int:
+        """Control-plane calls (ReplicaState/Promote) stay snappy: they
+        run inside a failing data call's recovery path."""
+        return max(50, min(self.timeout_ms, 1000))
+
+    def _route_read(self, s: int, exclude=frozenset()) -> str:
+        """Pick the replica serving shard ``s``'s next READ: in redirect
+        mode, the lowest latency*(inflight+1) score among live (not
+        isolated, not just-failed) replicas — an open breaker on one
+        replica REDIRECTS traffic to its siblings; only when every
+        replica is isolated does the shard fail fast.  Outside redirect
+        mode reads stick to the primary (the legacy reject behavior)."""
+        rs = self.replica_sets[s]
+        if len(rs.addresses) > 1 and self._redirect:
+            cands = [a for a in rs.addresses if a not in exclude]
+            if not cands:
+                cands = list(rs.addresses)   # tried everyone: start over
+            live = [a for a in cands if not self._isolated(a)]
+            if not live:
+                raise rpc.RpcError(
+                    resilience.EBREAKEROPEN,
+                    f"shard {s}: every replica isolated by circuit "
+                    f"breaker ({', '.join(rs.addresses)})")
+            if len(live) < len(cands) and obs.enabled():
+                # an open breaker pushed this read to a sibling —
+                # redirected, not rejected
+                obs.counter("rpc_breaker_redirects").add(1)
+            return self.scorer.pick(live)
+        return self._route_write(s, exclude)
+
+    def _route_write(self, s: int, exclude=frozenset()) -> str:
+        """WRITES go to the primary.  In redirect mode a failed or
+        breaker-isolated primary triggers failover (fenced promotion of
+        a backup); otherwise an isolated primary rejects, exactly the
+        single-owner behavior."""
+        rs = self.replica_sets[s]
+        addr = rs.addresses[self._primary_idx[s]]
+        if len(rs.addresses) > 1 and self._redirect and \
+                (addr in exclude or self._isolated(addr)):
+            return self._failover(s, exclude)
+        if self._isolated(addr):
+            raise rpc.RpcError(
+                resilience.EBREAKEROPEN,
+                f"shard {s} ({addr}) isolated by circuit breaker")
+        return addr
+
+    def _failover(self, s: int, exclude=frozenset()) -> str:
+        """Re-resolve — and, when nobody owns the range, PROMOTE — shard
+        ``s``'s primary among reachable replicas.  Promotion carries a
+        fencing epoch above every epoch observed in the sweep, so a
+        concurrent stale primary is fenced the moment it next touches a
+        fenced replica; losing a promote race (EFENCED back) just
+        re-resolves.  Returns the new primary's address."""
+        rs = self.replica_sets[s]
+        last_err: Optional[rpc.RpcError] = None
+        for _ in range(3):
+            states: Dict[str, dict] = {}
+            for a in rs.addresses:
+                if a in exclude or self._isolated(a):
+                    continue
+                try:
+                    states[a] = json.loads(self._chan(a).call(
+                        "Ps", "ReplicaState", b"",
+                        timeout_ms=self._ctl_timeout_ms()))
+                except rpc.RpcError as e:
+                    last_err = e
+            if not states:
+                raise rpc.RpcError(
+                    resilience.EBREAKEROPEN,
+                    f"shard {s}: no reachable replica to fail over to "
+                    f"(candidates {', '.join(rs.addresses)}; last error: "
+                    f"{last_err})")
+            seen = max([self._epoch_seen[s]]
+                       + [st["epoch"] for st in states.values()])
+            self._epoch_seen[s] = seen
+            # Claims and candidates BEHIND the highest epoch this client
+            # has observed are stale — a blackholed new primary must not
+            # be undercut by its demoted predecessor (that would lose
+            # acked updates).
+            claims = [(st["epoch"], a) for a, st in states.items()
+                      if st.get("primary") and st["epoch"] >= seen]
+            if claims:
+                _, addr = max(claims)
+            else:
+                cands = {a: st for a, st in states.items()
+                         if st["epoch"] >= seen}
+                if not cands:
+                    raise rpc.RpcError(
+                        resilience.EBREAKEROPEN,
+                        f"shard {s}: every reachable replica is behind "
+                        f"epoch {seen} — the authoritative replica is "
+                        f"unreachable, refusing a lossy promotion")
+                # Nobody owns the range: promote the freshest current-
+                # epoch replica (highest generation; index breaks ties
+                # deterministically) with a fencing epoch above all.
+                addr = max(cands, key=lambda a: (
+                    cands[a]["gen"], -rs.addresses.index(a)))
+                epoch = seen + 1
+                try:
+                    self._chan(addr).call(
+                        "Ps", "Promote", struct.pack("<q", epoch),
+                        timeout_ms=self._ctl_timeout_ms())
+                except rpc.RpcError as e:
+                    if e.code != resilience.EFENCED:
+                        raise
+                    continue   # promote race lost: re-resolve
+                self._epoch_seen[s] = epoch
+                if obs.enabled():
+                    obs.counter("ps_client_promotes").add(1)
+            self._primary_idx[s] = rs.addresses.index(addr)
+            if obs.enabled():
+                obs.counter("ps_client_failovers").add(1)
+            return addr
+        raise rpc.RpcError(
+            resilience.EFENCED,
+            f"shard {s}: lost the promote race on every attempt")
+
+    def _reroutable(self, s: int, exc: rpc.RpcError) -> bool:
+        """True for routing-correction errors (the write reached a
+        demoted/fenced replica) that re-route via failover immediately,
+        outside the retry policy's attempt budget."""
+        return exc.code in (resilience.ENOTPRIMARY, resilience.EFENCED) \
+            and len(self.replica_sets[s].addresses) > 1
+
     def _retry_shard(self, s: int, method: str, req: bytes,
-                     exc: Exception, deadline: Optional[float]) -> bytes:
-        """A shard's first (fan-out) attempt failed: classify, back off,
-        and retry it under the batch's remaining budget — the other
-        shards' work is already done, so only this shard re-runs."""
-        policy = self.retry
-        if policy is None or not policy.do_retry(exc, 0):
-            raise exc
-        remaining_ms: Optional[float] = None
-        if deadline is not None:
-            remaining_ms = (deadline - time.monotonic()) * 1000.0
-            if remaining_ms < 2.0:
-                raise exc
-        delay = policy.backoff.delay_ms(0)
-        if remaining_ms is not None:
-            delay = min(delay, remaining_ms - 1.0)
-        resilience.sleep_ms(delay)
-        if remaining_ms is not None:
-            remaining_ms = max(1.0, (deadline - time.monotonic()) * 1000.0)
-        follow = dataclasses.replace(
-            policy, max_attempts=max(1, policy.max_attempts - 1))
-        return resilience.call_with_retry(
-            self.channels[s], "Ps", method, req, policy=follow,
-            deadline_ms=remaining_ms, breaker=self._breaker(s),
-            backup_ms=self.backup_ms)
+                     exc: rpc.RpcError, deadline: Optional[float],
+                     tried: Optional[set] = None) -> bytes:
+        """A shard's attempt failed on the hedged/sequential path:
+        classify, back off, re-route (a replica that just failed is
+        excluded, so the retry lands on a SIBLING when one exists), and
+        retry under the batch's remaining budget."""
+        read = method == "Lookup"
+        tried = set() if tried is None else tried
+        e = exc
+        attempt = 0
+        reroutes = 0
+        while True:
+            reroute = not read and self._reroutable(s, e)
+            if reroute:
+                reroutes += 1
+                if reroutes > len(self.replica_sets[s].addresses) + 1:
+                    raise e
+            else:
+                policy = self.retry
+                if policy is None or not policy.do_retry(e, attempt):
+                    raise e
+            remaining_ms: Optional[float] = None
+            if deadline is not None:
+                remaining_ms = (deadline - time.monotonic()) * 1000.0
+                if remaining_ms < 2.0:
+                    raise e
+            if not reroute:
+                delay = policy.backoff.delay_ms(attempt)
+                if remaining_ms is not None:
+                    delay = min(delay, remaining_ms - 1.0)
+                resilience.sleep_ms(delay)
+                attempt += 1
+                if obs.enabled():
+                    obs.counter("rpc_retries").add(1)
+            addr = self._route_read(s, tried) if read \
+                else self._route_write(s, tried)
+            tried.add(addr)
+            t = None
+            if deadline is not None:
+                t = max(1, int((deadline - time.monotonic()) * 1000.0))
+            if self.retry is not None:
+                t = self.retry.cap_attempt_timeout(t)
+            b = self._addr_breaker(addr)
+            self.scorer.note_start(addr)
+            t0 = time.monotonic()
+            try:
+                rsp = self._chan(addr).call("Ps", method, req,
+                                            timeout_ms=t,
+                                            backup_ms=self.backup_ms)
+            except rpc.RpcError as e2:
+                routing = e2.code in (resilience.ENOTPRIMARY,
+                                      resilience.EFENCED)
+                self.scorer.note_end(addr, time.monotonic() - t0,
+                                     routing)
+                if b is not None:
+                    b.on_call_end(0 if routing else e2.code)
+                e = e2
+                continue
+            self.scorer.note_end(addr, time.monotonic() - t0, True)
+            if b is not None:
+                b.on_call_end(0)
+            return rsp
 
     def _fan_out(self, method: str, items: List[tuple]) -> List[bytes]:
-        """Issue every (shard, req) concurrently, then collect with the
-        resilience policy applied per shard.  Responses align with
-        ``items``.  On an unrecoverable shard failure the remaining
-        in-flight calls are cancelled (straggler abandonment) before the
-        error propagates."""
+        """Issue every (shard, req) concurrently — each routed to a
+        replica (reads: best live score; writes: the primary) — then
+        collect with the resilience policy applied per shard.  Responses
+        align with ``items``.  Failed shards retry as a CONCURRENT
+        re-fan: each round re-issues the whole failed subset as one
+        native call group after a single backoff sleep, so k failing
+        shards pay max(shard) retry latency, not sum — and each retry is
+        re-routed AWAY from the replica that just failed.  On an
+        unrecoverable shard failure the remaining in-flight calls are
+        cancelled (straggler abandonment) before the error propagates."""
         deadline = time.monotonic() + self.deadline_ms / 1000.0 \
             if self.deadline_ms is not None else None
+        read = method == "Lookup"
 
         def _budget() -> Optional[int]:
             t = None
@@ -923,26 +1829,50 @@ class RemoteEmbedding:
         # failed (client fault / local transport error — handled like a
         # failed attempt in the join phase), or None once consumed
         pending: List[object] = [None] * len(items)
+        addrs: List[Optional[str]] = [None] * len(items)
+        t0s: List[float] = [0.0] * len(items)
+        tried: List[set] = [set() for _ in items]
+        attempts: List[int] = [0] * len(items)
+        reroutes: List[int] = [0] * len(items)
         out: List[Optional[bytes]] = [None] * len(items)
         group: "Optional[rpc.CallGroup]" = None
+
+        def _start(i: int, s: int, req) -> None:
+            """Route item i and start its call; a start failure parks
+            the RpcError in pending[i] for classification."""
+            addr = self._route_read(s, tried[i]) if read \
+                else self._route_write(s, tried[i])
+            addrs[i] = addr
+            tried[i].add(addr)
+            self.scorer.note_start(addr)
+            t0s[i] = time.monotonic()
+            try:
+                # managed fan-out set: every entry is joined or
+                # cancelled+closed in the finally below
+                pending[i] = self._chan(addr).call_async(  # lint: allow-handle-escape
+                    "Ps", method, req, timeout_ms=_budget(),
+                    tag=f"attempt={attempts[i]}")
+            except rpc.RpcError as e:
+                pending[i] = e
+
+        def _settle(i: int, pc: object, ok: bool, code: int = 0) -> None:
+            """Feed one finished attempt to the scorer + breaker.
+            Routing corrections (ENOTPRIMARY/EFENCED) are PROOF the
+            endpoint is alive — they must not open its breaker or
+            poison its latency score."""
+            addr = addrs[i]
+            routing = code in (resilience.ENOTPRIMARY,
+                               resilience.EFENCED)
+            lat = time.monotonic() - t0s[i] \
+                if isinstance(pc, rpc.PendingCall) else None
+            self.scorer.note_end(addr, lat, ok or routing)
+            b = self._addr_breaker(addr)
+            if b is not None:
+                b.on_call_end(0 if routing else code)
+
         try:
             for i, (s, req) in enumerate(items):
-                b = self._breaker(s)
-                if b is not None and b.isolated():
-                    if obs.enabled():
-                        obs.counter("rpc_breaker_fastfail").add(1)
-                    raise rpc.RpcError(
-                        resilience.EBREAKEROPEN,
-                        f"shard {s} ({self.addresses[s]}) isolated by "
-                        f"circuit breaker")
-                try:
-                    # managed fan-out set: every entry is joined or
-                    # cancelled+closed in the finally below
-                    pending[i] = self.channels[s].call_async(  # lint: allow-handle-escape
-                        "Ps", method, req, timeout_ms=_budget(),
-                        tag="attempt=0")
-                except rpc.RpcError as e:
-                    pending[i] = e  # keep fanning out; retried below
+                _start(i, s, req)
             if self.backup_ms is not None:
                 # Hedged path: ordered per-shard collection — each hedge
                 # arms backup_ms on its in-flight primary and waits on its
@@ -950,64 +1880,111 @@ class RemoteEmbedding:
                 # no polling slices).
                 for i, (s, req) in enumerate(items):
                     pc, pending[i] = pending[i], None
-                    b = self._breaker(s)
                     try:
                         if isinstance(pc, rpc.RpcError):
                             raise pc
                         rsp = resilience.backup_call(
-                            self.channels[s], "Ps", method, req,
+                            self._chan(addrs[i]), "Ps", method, req,
                             backup_ms=self.backup_ms,
                             timeout_ms=_budget(), primary=pc)
                     except rpc.RpcError as e:
-                        if b is not None:
-                            b.on_call_end(e.code)
+                        _settle(i, pc, False, e.code)
                         rsp = self._retry_shard(s, method, req, e,
-                                                deadline)
+                                                deadline, tried[i])
                     else:
-                        if b is not None:
-                            b.on_call_end(0)
+                        _settle(i, pc, True)
                     out[i] = rsp
                 return out  # type: ignore[return-value]
             # Unhedged path: completion-ORDER collection over one native
             # fan-in group (the ParallelChannel CountdownEvent shape).
             # Every wait_any wakes on exactly one shard completing — no
-            # time slices — and a failing shard starts its retry (or
-            # aborts the batch) the moment it fails, never behind a
-            # slower sibling.  Start-failures are already complete, so
-            # they are classified first (fail fast / retry immediately).
+            # time slices.  Failures collect into `failed` and re-fan
+            # concurrently once the round drains; non-retriable errors
+            # abort the batch the moment they surface.
             group = rpc.CallGroup()
             waiting: List[int] = []
-            for i, pc in enumerate(pending):
+            failed: List[int] = []
+            excs: List[Optional[rpc.RpcError]] = [None] * len(items)
+
+            def _classify(i: int, e: rpc.RpcError) -> None:
+                """Queue item i for the next re-fan round, or abort."""
+                s = items[i][0]
+                if not read and self._reroutable(s, e):
+                    reroutes[i] += 1
+                    if reroutes[i] <= \
+                            len(self.replica_sets[s].addresses) + 1:
+                        excs[i] = e
+                        failed.append(i)
+                        return
+                    raise e
+                policy = self.retry
+                if policy is None or not policy.do_retry(e, attempts[i]):
+                    raise e
+                excs[i] = e
+                failed.append(i)
+
+            def _enqueue(i: int) -> None:
+                pc = pending[i]
                 if isinstance(pc, rpc.PendingCall):
                     group.add(pc)
                     waiting.append(i)
-            for i, (s, req) in enumerate(items):
-                if isinstance(pending[i], rpc.RpcError):
-                    e, pending[i] = pending[i], None
-                    b = self._breaker(s)
-                    if b is not None:
-                        b.on_call_end(e.code)
-                    out[i] = self._retry_shard(s, method, req, e, deadline)
-            while waiting:
-                group.wait_any()
-                done_i = next((i for i in waiting
-                               if pending[i].wait(0.0)), None)
-                if done_i is None:  # pragma: no cover — wait_any contract
-                    continue
-                waiting.remove(done_i)
-                s, req = items[done_i]
-                pc, pending[done_i] = pending[done_i], None
-                b = self._breaker(s)
-                try:
-                    rsp = pc.join()
-                except rpc.RpcError as e:
-                    if b is not None:
-                        b.on_call_end(e.code)
-                    rsp = self._retry_shard(s, method, req, e, deadline)
-                else:
-                    if b is not None:
-                        b.on_call_end(0)
-                out[done_i] = rsp
+                else:   # start failure: already complete — classify now
+                    e: rpc.RpcError = pc  # type: ignore[assignment]
+                    pending[i] = None
+                    _settle(i, pc, False, e.code)
+                    _classify(i, e)
+
+            for i in range(len(items)):
+                _enqueue(i)
+            while waiting or failed:
+                while waiting:
+                    group.wait_any()
+                    done_i = next((i for i in waiting
+                                   if pending[i].wait(0.0)), None)
+                    if done_i is None:  # pragma: no cover — wait_any
+                        continue
+                    waiting.remove(done_i)
+                    pc, pending[done_i] = pending[done_i], None
+                    try:
+                        rsp = pc.join()
+                    except rpc.RpcError as e:
+                        _settle(done_i, pc, False, e.code)
+                        _classify(done_i, e)
+                    else:
+                        _settle(done_i, pc, True)
+                        out[done_i] = rsp
+                if not failed:
+                    break
+                # ---- concurrent re-fan of the failed subset: ONE
+                # backoff sleep (the max of the round's delays, capped
+                # by the remaining budget), then every failed shard
+                # re-issues together and collects by completion order —
+                # retry latency is max(shard), not sum(shard).
+                refan, failed = failed, []
+                round_delay = 0.0
+                for i in refan:
+                    s = items[i][0]
+                    if not read and self._reroutable(s, excs[i]):
+                        continue   # routing correction: no backoff
+                    round_delay = max(round_delay,
+                                      self.retry.backoff.delay_ms(
+                                          attempts[i]))
+                if deadline is not None:
+                    remaining_ms = (deadline
+                                    - time.monotonic()) * 1000.0
+                    if remaining_ms < 2.0:
+                        raise excs[refan[0]]  # type: ignore[misc]
+                    round_delay = min(round_delay, remaining_ms - 1.0)
+                if round_delay > 0:
+                    resilience.sleep_ms(round_delay)
+                for i in refan:
+                    s, req = items[i]
+                    if read or not self._reroutable(s, excs[i]):
+                        attempts[i] += 1
+                        if obs.enabled():
+                            obs.counter("rpc_retries").add(1)
+                    _start(i, s, req)
+                    _enqueue(i)
             return out  # type: ignore[return-value]
         finally:
             if group is not None:
@@ -1020,11 +1997,24 @@ class RemoteEmbedding:
                     pc.close()
 
     def _call_shard(self, s: int, method: str, req: bytes) -> bytes:
-        """Sequential-path shard call with the same per-shard policy."""
-        return self.channels[s].call(
-            "Ps", method, req, retry=self.retry,
-            deadline_ms=self.deadline_ms, backup_ms=self.backup_ms,
-            breaker=self._breaker(s))
+        """Sequential-path shard call with the same per-shard policy
+        (routed; a routing-correction error fails over once)."""
+        addr = self._route_read(s) if method == "Lookup" \
+            else self._route_write(s)
+        try:
+            return self._chan(addr).call(
+                "Ps", method, req, retry=self.retry,
+                deadline_ms=self.deadline_ms, backup_ms=self.backup_ms,
+                breaker=self._addr_breaker(addr))
+        except rpc.RpcError as e:
+            if method != "Lookup" and self._reroutable(s, e):
+                addr = self._route_write(s, {addr})
+                return self._chan(addr).call(
+                    "Ps", method, req, retry=self.retry,
+                    deadline_ms=self.deadline_ms,
+                    backup_ms=self.backup_ms,
+                    breaker=self._addr_breaker(addr))
+            raise
 
     def _owner_split(self, flat_ids: np.ndarray):
         if flat_ids.size and (flat_ids.min() < 0
@@ -1113,33 +2103,62 @@ class RemoteEmbedding:
     # -- read path: framed deltas over one ordered flow-controlled
     # -- stream per owner shard, feeding the server combiner directly)
 
-    def _push_stream(self, s: int) -> "rpc.Stream":
+    def _push_stream(self, s: int, exclude=frozenset()) -> "rpc.Stream":
         st = self._push_streams.get(s)
         if st is None:
-            st = self.channels[s].stream(
-                "Ps", "StreamApply",
+            addr = self._route_write(s, exclude)
+            # The setup request carries the writer id: the server opens
+            # (or re-opens) this writer's monotonic seq window and
+            # answers its high-water mark, which decides replay below.
+            st = self._chan(addr).stream(
+                "Ps", "StreamApply", self._writer_id.encode(),
                 max_buf_size=self.push_window_bytes)
             self._push_streams[s] = st
+            self._push_addr[s] = addr
         return st
 
-    def _push_frame(self, s: int, frame) -> None:
-        """Write one framed delta to shard ``s``'s push stream,
+    def _push_frame(self, s: int, seq: int, body) -> None:
+        """Write delta ``seq`` to shard ``s``'s push stream,
         RECONNECTING under the embedding's retry policy on error: the
         broken stream is aborted, a fresh one is created (the setup RPC
         pays the shard's real state — timeouts included), and THIS frame
         is replayed on it.  A frame whose write was reported failed may
-        still have reached the server before the break, so the streamed
-        push is at-least-once across reconnects — exactly-once holds on
-        a fault-free stream (ordered, flow-controlled, no retransmits)."""
+        still have reached the server before the break — the per-writer
+        seq in every frame makes the replay IDEMPOTENT: the server's
+        window drops anything at or below its high-water mark, and the
+        setup response carries that mark so an already-received frame is
+        not even resent.  A failed or demoted primary re-routes:
+        ENOTPRIMARY/EFENCED fails over immediately, a dead endpoint is
+        excluded from the reconnect's routing (redirect mode)."""
         attempt = 0
+        fails = 0
+        exclude: set = set()
         while True:
+            addr = None
             try:
-                self._push_stream(s).write(frame)
+                st = self._push_stream(s, exclude)
+                if len(st.response) >= 8:
+                    (high,) = struct.unpack_from("<q", st.response, 0)
+                    if seq <= high:
+                        # The server already has this frame (the write
+                        # that "failed" reached it before the break).
+                        if obs.enabled():
+                            obs.counter("ps_stream_replay_skips").add(1)
+                        return
+                st.write(_pack_stream_frame(seq, 0, 0, body))
                 return
             except rpc.RpcError as e:
                 st = self._push_streams.pop(s, None)
                 if st is not None:
                     st.abort()
+                addr = self._push_addr.pop(s, None)
+                rs = self.replica_sets[s]
+                if self._reroutable(s, e):
+                    fails += 1
+                    if fails > len(rs.addresses) + 1:
+                        raise
+                    self._failover(s)
+                    continue
                 policy = self.retry
                 # Stream breakage (EPIPE/EINVAL/EFAILEDSOCKET) means
                 # reconnect regardless of the unary retriable set; the
@@ -1150,6 +2169,9 @@ class RemoteEmbedding:
                 if policy is None or not reconnectable or \
                         not attempt + 1 < policy.max_attempts:
                     raise
+                if addr is not None and len(rs.addresses) > 1 \
+                        and self._redirect:
+                    exclude.add(addr)   # prefer a surviving replica
                 if obs.enabled():
                     obs.counter("ps_stream_reconnects").add(1)
                 resilience.sleep_ms(policy.backoff.delay_ms(attempt))
@@ -1174,9 +2196,11 @@ class RemoteEmbedding:
         g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
         nbytes_out = 0
         for s, positions, owned in self._owner_split(flat):
-            frame = _pack_apply_req(owned, g[positions])
-            nbytes_out += len(frame)
-            self._push_frame(s, frame)
+            body = _pack_apply_req(owned, g[positions])
+            nbytes_out += len(body)
+            seq = self._push_seq.get(s, 0) + 1
+            self._push_seq[s] = seq
+            self._push_frame(s, seq, body)
         if rec:
             obs.recorder("ps_client_push").record(
                 (time.monotonic_ns() - t0) / 1e9)
@@ -1191,6 +2215,7 @@ class RemoteEmbedding:
         :class:`rpc.RpcError` (ERPCTIMEDOUT) if a shard fails to drain
         within the embedding's timeout."""
         streams, self._push_streams = self._push_streams, {}
+        push_addr, self._push_addr = self._push_addr, {}
         for st in streams.values():
             st.close()
         deadline_s = max(1.0, self.timeout_ms / 1000.0)
@@ -1198,8 +2223,8 @@ class RemoteEmbedding:
             if not st.join(timeout_s=deadline_s):
                 st.abort()
                 raise rpc.RpcError(
-                    1008, f"shard {s} ({self.addresses[s]}) did not drain "
-                          f"its push stream within {deadline_s:.1f}s")
+                    1008, f"shard {s} ({push_addr.get(s, '?')}) did not "
+                          f"drain its push stream within {deadline_s:.1f}s")
 
     def close(self):
         if self._prober is not None:
@@ -1210,5 +2235,7 @@ class RemoteEmbedding:
             # wanting the guarantee use flush_gradients() first.
             st.abort()
         self._push_streams.clear()
-        for c in self.channels:
+        self._push_addr.clear()
+        for c in self._chans.values():
             c.close()
+        self._chans.clear()

@@ -56,14 +56,22 @@ from brpc_tpu.analysis.race import checked_lock
 
 __all__ = [
     "Backoff", "sleep_ms", "RetryPolicy", "RETRIABLE_CODES",
-    "EBREAKEROPEN", "call_with_retry", "backup_call", "resilient_call",
-    "BreakerOptions", "CircuitBreaker", "BreakerRegistry", "HealthProber",
+    "EBREAKEROPEN", "ENOTPRIMARY", "EFENCED", "call_with_retry",
+    "backup_call", "resilient_call", "BreakerOptions", "CircuitBreaker",
+    "BreakerRegistry", "HealthProber", "ReplicaScorer",
     "default_registry", "set_default_registry", "health_components",
 ]
 
 #: python-side error code for a breaker fast-fail (outside the native
 #: errors.h space — the call never reached the wire)
 EBREAKEROPEN = 2008
+#: a write reached a replica that is not (or no longer) the primary for
+#: its row range — the caller should re-resolve/promote and re-route
+ENOTPRIMARY = 2009
+#: a replication message carried a stale fencing epoch: a newer primary
+#: exists and the sender must demote itself (never retriable — retrying
+#: the same epoch yields the same rejection)
+EFENCED = 2010
 
 #: native error codes worth retrying: the request may never have reached
 #: the server, or the failure is transient by construction.  Application
@@ -515,13 +523,22 @@ class BreakerRegistry:
     """Per-endpoint breakers plus the cluster-recover guard: an
     isolation is refused when it would leave fewer than ``min_working``
     endpoints un-isolated (reference cluster_recover_policy.h — a dying
-    cluster must keep taking traffic rather than excluding everyone)."""
+    cluster must keep taking traffic rather than excluding everyone).
+
+    ``redirect=True`` declares the REDIRECT policy for components that
+    route over replica groups (the PS fan-out): an open breaker re-routes
+    the call to the next live replica instead of raising ``BreakerOpen``
+    — availability over fail-fast (SelectiveChannel's "retry picks a
+    different sub-channel", selective_channel.cpp).  The registry only
+    CARRIES the flag (routing lives with the router); with a single
+    replica there is nowhere to redirect and open still means reject."""
 
     def __init__(self, options: Optional[BreakerOptions] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 min_working: int = 1):
+                 min_working: int = 1, redirect: bool = False):
         self.options = options or BreakerOptions()
         self.min_working = min_working
+        self.redirect = bool(redirect)
         self._clock = clock
         self._mu = checked_lock("resilience.breakers")
         self._breakers: Dict[str, CircuitBreaker] = {}
@@ -578,6 +595,85 @@ class BreakerRegistry:
                 d["last_probe"] = p
             out[ep] = d
         return out
+
+
+# ---------------------------------------------------------------------------
+# replica scoring (the locality-aware LB analog: latency x inflight)
+# ---------------------------------------------------------------------------
+
+class ReplicaScorer:
+    """Per-endpoint latency+inflight scoring for replica selection (the
+    reference's ``la`` locality-aware load balancer,
+    locality_aware_load_balancer.cpp / docs/cn/lalb.md, reduced to the
+    two signals that matter for a read fan-out): an endpoint's score is
+    ``ewma_latency * (inflight + 1)`` — expected queueing-adjusted
+    completion time — and the router picks the minimum among live
+    replicas.  An endpoint nothing is known about scores as the OPTIMIST
+    (``prior_ms`` with its real inflight), so fresh/revived replicas get
+    probed by real traffic instead of starving forever behind a warm
+    sibling.
+
+    ``note_start``/``note_end`` bracket every routed call; failures count
+    as a latency PENALTY (``fail_penalty_ms`` fed to the EWMA) so a
+    flapping replica scores itself out of the rotation even before its
+    breaker trips.  All state is per-endpoint ints/floats under one lock;
+    reads take the same lock (selection is per-batch, not per-byte)."""
+
+    def __init__(self, alpha: float = 0.25, prior_ms: float = 1.0,
+                 fail_penalty_ms: float = 100.0):
+        self.alpha = alpha
+        self.prior_ms = prior_ms
+        self.fail_penalty_ms = fail_penalty_ms
+        self._mu = checked_lock("resilience.scorer")
+        self._ewma_ms: Dict[str, float] = {}
+        self._inflight: Dict[str, int] = {}
+
+    def note_start(self, endpoint: str) -> None:
+        with self._mu:
+            self._inflight[endpoint] = self._inflight.get(endpoint, 0) + 1
+
+    def note_end(self, endpoint: str, latency_s: Optional[float],
+                 ok: bool) -> None:
+        """One routed call finished.  ``latency_s`` may be None when the
+        caller could not measure (start-failure); failures feed the
+        penalty either way."""
+        sample_ms = (latency_s or 0.0) * 1000.0
+        if not ok:
+            sample_ms = max(sample_ms, self.fail_penalty_ms)
+        with self._mu:
+            n = self._inflight.get(endpoint, 0)
+            if n > 0:
+                self._inflight[endpoint] = n - 1
+            prev = self._ewma_ms.get(endpoint)
+            if prev is None:
+                self._ewma_ms[endpoint] = sample_ms
+            else:
+                self._ewma_ms[endpoint] = \
+                    prev + self.alpha * (sample_ms - prev)
+
+    def score(self, endpoint: str) -> float:
+        with self._mu:
+            lat = self._ewma_ms.get(endpoint, self.prior_ms)
+            inflight = self._inflight.get(endpoint, 0)
+        return max(lat, 0.001) * (inflight + 1)
+
+    def pick(self, candidates: List[str]) -> Optional[str]:
+        """The lowest-scoring candidate (ties break by order, so a
+        deterministic candidate list yields deterministic routing)."""
+        best, best_score = None, None
+        for ep in candidates:
+            s = self.score(ep)
+            if best_score is None or s < best_score:
+                best, best_score = ep, s
+        return best
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._mu:
+            eps = set(self._ewma_ms) | set(self._inflight)
+            return {ep: {"ewma_ms": round(self._ewma_ms.get(
+                             ep, self.prior_ms), 3),
+                         "inflight": self._inflight.get(ep, 0)}
+                    for ep in sorted(eps)}
 
 
 # ---------------------------------------------------------------------------

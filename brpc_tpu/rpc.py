@@ -191,6 +191,12 @@ def _load_locked():
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
         ctypes.c_char_p, ctypes.c_size_t]
     lib.brt_stream_create.restype = ctypes.c_int
+    lib.brt_stream_create_rx.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_size_t, ctypes.c_int64, _STREAM_HANDLER, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_stream_create_rx.restype = ctypes.c_int
     lib.brt_stream_accept.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, _STREAM_HANDLER, ctypes.c_void_p,
         ctypes.POINTER(ctypes.c_uint64)]
@@ -412,25 +418,74 @@ def _req_ptr(request):
 # ---------------------------------------------------------------------------
 
 # stream_id -> receiver (an object with on_data(bytes) / on_closed()).
-# Registered by Server.add_stream_handler's accept() before the response
-# leaves (so no frame can beat the registration), removed when the peer's
-# CLOSE is delivered.
+# Server side: registered by Server.add_stream_handler's accept() before
+# the response leaves (so no frame can beat the registration).  Client
+# side (``Channel.stream(receiver=...)``): the native create returns the
+# stream id only AFTER the setup RPC — a fast server can write frames
+# that arrive BEFORE the Python registration, so unknown-sid frames are
+# buffered (bounded) and drained through a two-phase handoff when the
+# registration lands; ordering is preserved because the native exec
+# fiber only appends while the handoff placeholder is present.  Entries
+# are removed when the peer's CLOSE is delivered.
 _stream_mu = _race.checked_lock("rpc.stream.receivers")
 _stream_receivers: dict = {}
+_stream_orphans: dict = {}   # sid -> [frame bytes | None (= close)]
+_STREAM_ORPHAN_SIDS = 64     # dropped-oldest bound on unclaimed sids
+
+
+class _PreRegistration:
+    """Handoff placeholder: while present, the dispatch fiber APPENDS
+    frames instead of delivering, and the registering thread drains in
+    order before flipping the entry to the real receiver."""
+
+    __slots__ = ("queued",)
+
+    def __init__(self, queued):
+        self.queued = queued   # list of frames; None element = close
+
+
+def _deliver(receiver, item, stream_id: int) -> None:
+    if item is None:
+        _handles.note_destroy("stream_receiver", stream_id)
+        try:
+            receiver.on_closed()
+        finally:
+            # Complete the close handshake: the peer already closed,
+            # closing our side fully retires the native stream (and
+            # wakes the peer's join).
+            _load().brt_stream_close(stream_id)
+    else:
+        receiver.on_data(item)
 
 
 def _register_stream_receiver(stream_id: int, receiver) -> None:
     _handles.note_create("stream_receiver", stream_id)
+    pre = None
     with _stream_mu:
-        _stream_receivers[stream_id] = receiver
-
-
-def _pop_stream_receiver(stream_id: int):
-    with _stream_mu:
-        receiver = _stream_receivers.pop(stream_id, None)
-    if receiver is not None:
-        _handles.note_destroy("stream_receiver", stream_id)
-    return receiver
+        orphans = _stream_orphans.pop(stream_id, None)
+        if orphans:
+            pre = _PreRegistration(orphans)
+            _stream_receivers[stream_id] = pre
+        else:
+            _stream_receivers[stream_id] = receiver
+    if pre is None:
+        return
+    # Drain-then-flip: pop one queued frame at a time (the exec fiber may
+    # still be appending), deliver it on THIS thread, and atomically swap
+    # in the receiver once the queue is empty.
+    while True:
+        with _stream_mu:
+            if pre.queued:
+                item = pre.queued.pop(0)
+            else:
+                if _stream_receivers.get(stream_id) is pre:
+                    _stream_receivers[stream_id] = receiver
+                return
+        _deliver(receiver, item, stream_id)
+        if item is None:
+            with _stream_mu:
+                _stream_receivers.pop(stream_id, None)
+            return
 
 
 @_STREAM_HANDLER
@@ -441,23 +496,32 @@ def _stream_dispatch(user, stream_id, data, length, closed):
     is the design, not a bug.  Exceptions cannot reach a response (frames
     have none), so they are counted and swallowed."""
     try:
-        if closed:
-            receiver = _pop_stream_receiver(stream_id)
-            if receiver is None:
+        payload = None
+        if not closed:
+            payload = ctypes.string_at(data, length) if length else b""
+        with _stream_mu:
+            receiver = _stream_receivers.get(stream_id)
+            if isinstance(receiver, _PreRegistration):
+                receiver.queued.append(payload)
                 return
+            if receiver is None:
+                # Not (yet) registered: buffer for a racing client-side
+                # registration (Channel.stream(receiver=...)); unclaimed
+                # sids are bounded by dropping the oldest.
+                q = _stream_orphans.setdefault(stream_id, [])
+                q.append(payload)
+                while len(_stream_orphans) > _STREAM_ORPHAN_SIDS:
+                    _stream_orphans.pop(next(iter(_stream_orphans)))
+                return
+            if closed:
+                _stream_receivers.pop(stream_id, None)
+        if closed:
+            _handles.note_destroy("stream_receiver", stream_id)
             try:
                 receiver.on_closed()
             finally:
-                # Complete the close handshake: the peer already closed,
-                # closing our side fully retires the native stream (and
-                # wakes the peer's join).
                 _load().brt_stream_close(stream_id)
         else:
-            with _stream_mu:
-                receiver = _stream_receivers.get(stream_id)
-            if receiver is None:
-                return
-            payload = ctypes.string_at(data, length) if length else b""
             receiver.on_data(payload)
     except Exception:  # noqa: BLE001 — no response channel for frames
         if obs.enabled():
@@ -469,9 +533,13 @@ def _make_stream_accept(lib, session):
     the stream riding the in-flight request to ``receiver`` and registers
     it for dispatch.  Must run inside the handler, before the response
     leaves — which is guaranteed, because the trampoline responds only
-    after the handler returns."""
+    after the handler returns.  Returns the server half as a writable
+    :class:`Stream` — the native stream layer is symmetric, so the
+    handler (or its receiver) may WRITE frames back to the client
+    (server→client direction: acks, progress, catch-up data); the client
+    reads them by passing ``receiver=`` to :meth:`Channel.stream`."""
 
-    def accept(receiver, max_buf_size: int = 0) -> int:
+    def accept(receiver, max_buf_size: int = 0) -> "Stream":
         sid = ctypes.c_uint64()
         rc = lib.brt_stream_accept(session, max_buf_size, _stream_dispatch,
                                    None, ctypes.byref(sid))
@@ -484,7 +552,10 @@ def _make_stream_accept(lib, session):
         _register_stream_receiver(sid.value, receiver)
         if obs.enabled():
             obs.counter("stream_accepts").add(1)
-        return sid.value
+        # track=False: the server half's lifecycle belongs to the close
+        # handshake in _stream_dispatch (receiver registry is the ledger
+        # entry); this wrapper is a write surface, not an owner.
+        return Stream(lib, sid.value, b"", "", "", "peer", track=False)
 
     return accept
 
@@ -962,10 +1033,10 @@ class Stream:
     _STALL_FLOOR_US = 1000
 
     __slots__ = ("_lib", "_id", "response", "service", "method", "peer",
-                 "_closed")
+                 "_closed", "_track")
 
     def __init__(self, lib, stream_id: int, response: bytes, service: str,
-                 method: str, peer: str):
+                 method: str, peer: str, track: bool = True):
         self._lib = lib
         self._id = stream_id
         #: the setup RPC's response bytes (the server's accept-time answer)
@@ -974,6 +1045,10 @@ class Stream:
         self.method = method
         self.peer = peer
         self._closed = False
+        # Client streams own their ledger entry; the server-half write
+        # surface returned by accept() does not (the receiver registry
+        # entry is that stream's ledger record).
+        self._track = track
 
     def write(self, data) -> None:
         """Ordered framed write (bytes/bytearray/memoryview — the native
@@ -1000,7 +1075,8 @@ class Stream:
         Idempotent; pair with :meth:`join` to wait for full application."""
         if not self._closed:
             self._closed = True
-            _handles.note_destroy("stream", self._id)
+            if self._track:
+                _handles.note_destroy("stream", self._id)
             self._lib.brt_stream_close(self._id)
 
     def join(self, timeout_s: Optional[float] = None) -> bool:
@@ -1017,7 +1093,8 @@ class Stream:
         writer/joiner, frees native state, sends nothing.  Idempotent."""
         if not self._closed:
             self._closed = True
-            _handles.note_destroy("stream", self._id)
+            if self._track:
+                _handles.note_destroy("stream", self._id)
         self._lib.brt_stream_abort(self._id)
 
 
@@ -1178,7 +1255,7 @@ class Channel:
                            len(request), t0, wall, tag)
 
     def stream(self, service: str, method: str, request: bytes = b"", *,
-               max_buf_size: int = 0) -> Stream:
+               max_buf_size: int = 0, receiver=None) -> Stream:
         """Creates an ordered flow-controlled byte-frame stream bound to
         this channel's connection by running ``service``.``method``
         synchronously — the server's handler must ``accept`` the stream
@@ -1187,7 +1264,19 @@ class Channel:
         bytes in flight (0 = the native 2MB default): writers park beyond
         it until the receiver's consumed-bytes feedback returns credit.
         Raises :class:`RpcError` when the setup RPC fails or the server
-        never accepted — nothing is left behind either way."""
+        never accepted — nothing is left behind either way.
+
+        ``receiver`` (an object with ``on_data(bytes)``/``on_closed()``)
+        attaches a READ side: frames the server writes on its accepted
+        half deliver to it, serialized, with a final ``on_closed`` after
+        the server closes — the server→client direction (replica acks,
+        catch-up data).  Frames the server wrote before this call
+        returned are buffered and delivered first, possibly on the
+        calling thread.  ``close()`` is a FULL close, not a half-close:
+        peer frames arriving after it are discarded, so collect what you
+        expect before closing.  An rx stream must be torn down with
+        ``close()`` (``abort()`` would strand the native relay — the
+        closed callback is what frees it)."""
         rec = obs.enabled()
         if rec:
             t0 = time.monotonic_ns()
@@ -1200,11 +1289,18 @@ class Channel:
         rsp = ctypes.c_void_p()
         rsp_len = ctypes.c_size_t()
         errbuf = ctypes.create_string_buffer(256)
-        rc = self._lib.brt_stream_create(
-            self._ptr, service.encode(), method.encode(),
-            _req_ptr(request), len(request), max_buf_size,
-            ctypes.byref(sid), ctypes.byref(rsp), ctypes.byref(rsp_len),
-            errbuf, 256)
+        if receiver is not None:
+            rc = self._lib.brt_stream_create_rx(
+                self._ptr, service.encode(), method.encode(),
+                _req_ptr(request), len(request), max_buf_size,
+                _stream_dispatch, None, ctypes.byref(sid),
+                ctypes.byref(rsp), ctypes.byref(rsp_len), errbuf, 256)
+        else:
+            rc = self._lib.brt_stream_create(
+                self._ptr, service.encode(), method.encode(),
+                _req_ptr(request), len(request), max_buf_size,
+                ctypes.byref(sid), ctypes.byref(rsp), ctypes.byref(rsp_len),
+                errbuf, 256)
         if rc != 0:
             text = errbuf.value.decode(errors="replace")
             if rec:
@@ -1222,6 +1318,10 @@ class Channel:
                                 len(request), len(out), 0, "",
                                 tag="stream")
         _handles.note_create("stream", sid.value)
+        if receiver is not None:
+            # Registration drains any frames the server raced ahead of
+            # this return (ordered handoff — see _register_stream_receiver).
+            _register_stream_receiver(sid.value, receiver)
         return Stream(self._lib, sid.value, out, service, method,
                       self._addr)
 

@@ -152,6 +152,21 @@ int brt_stream_create(void* channel, const char* service,
                       int64_t max_buf_size, uint64_t* stream_id,
                       void** rsp, size_t* rsp_len, char* errbuf,
                       size_t errbuf_len);
+// Like brt_stream_create, but the CLIENT side carries a receive handler
+// too: the native stream layer is symmetric (both ends StreamWrite
+// freely once bound) and `handler` gets the frames the SERVER writes on
+// its accepted half — the server->client direction (replica acks,
+// progress reports, catch-up data).  Same handler contract as
+// brt_stream_accept: serialized delivery, final (NULL, 0, closed=1)
+// exactly once after the peer's graceful close or the socket-failure
+// teardown.  Tear an rx stream down with brt_stream_close (abort
+// suppresses the closed callback and would strand the relay).
+int brt_stream_create_rx(void* channel, const char* service,
+                         const char* method, const void* req,
+                         size_t req_len, int64_t max_buf_size,
+                         brt_stream_handler handler, void* user,
+                         uint64_t* stream_id, void** rsp, size_t* rsp_len,
+                         char* errbuf, size_t errbuf_len);
 // Server side: accepts the stream riding the in-flight request behind
 // `session` (call INSIDE the handler, BEFORE brt_session_respond).
 // `handler` receives the frames; it must stay valid until its

@@ -129,6 +129,60 @@ int brt_stream_create(void* channel, const char* service,
   return 0;
 }
 
+int brt_stream_create_rx(void* channel, const char* service,
+                         const char* method, const void* req,
+                         size_t req_len, int64_t max_buf_size,
+                         brt_stream_handler handler, void* user,
+                         uint64_t* stream_id, void** rsp, size_t* rsp_len,
+                         char* errbuf, size_t errbuf_len) {
+  auto* c = static_cast<CChannel*>(channel);
+  if (c == nullptr || stream_id == nullptr || handler == nullptr) {
+    return EINVAL;
+  }
+  // Same shape as brt_stream_create, but the client side carries a
+  // receive relay too: the stream layer is symmetric (both ends
+  // StreamWrite freely), only the write-only ABI hid the read half.
+  auto* relay = new CStreamRelay(handler, user);
+  StreamOptions opts;
+  if (max_buf_size > 0) opts.max_buf_size = size_t(max_buf_size);
+  opts.handler = relay;
+  Controller cntl;
+  StreamId id = INVALID_STREAM_ID;
+  int rc = StreamCreate(&id, &cntl, opts);
+  if (rc != 0) {
+    delete relay;
+    return rc;
+  }
+  IOBuf request, response;
+  if (req != nullptr && req_len > 0) request.append(req, req_len);
+  c->channel->CallMethod(service, method, &cntl, request, &response,
+                         nullptr);
+  const bool failed = cntl.Failed() || cntl.peer_stream_id == 0;
+  if (failed) {
+    // Never bound: no frame was ever queued for the relay and abort
+    // suppresses on_closed, so the relay is freed here, not by the
+    // close path it will never see.
+    StreamAbort(id);
+    delete relay;
+    if (errbuf != nullptr && errbuf_len > 0) {
+      snprintf(errbuf, errbuf_len, "%s",
+               cntl.Failed() ? cntl.ErrorText().c_str()
+                             : "peer did not accept the stream");
+    }
+    return cntl.Failed() ? (cntl.ErrorCode() ? cntl.ErrorCode() : -1)
+                         : EREQUEST;
+  }
+  *stream_id = id;
+  if (rsp != nullptr && rsp_len != nullptr) {
+    const size_t n = response.size();
+    void* buf = malloc(n ? n : 1);
+    response.copy_to(buf, n);
+    *rsp = buf;
+    *rsp_len = n;
+  }
+  return 0;
+}
+
 int brt_stream_accept(void* session, int64_t max_buf_size,
                       brt_stream_handler handler, void* user,
                       uint64_t* stream_id) {

@@ -965,14 +965,96 @@ def test_param_lock_keyword_argument_binds(tmp_path):
     assert "kw.A" in f.message and "kw.B" in f.message
 
 
-def test_container_stored_lock_stays_deferred(tmp_path):
-    # the documented remaining blind spot: a lock pulled out of a
-    # container is not resolved — no false edges, no finding
+_CONTAINER_LOCK_FIXTURE = """\
+    from brpc_tpu.analysis.race import checked_lock
+    A = checked_lock("cd.A")
+    B = checked_lock("cd.B")
+    LOCKS = {"a": A, "b": checked_lock("cd.C")}
+
+    def inner():
+        with LOCKS["a"]:
+            pass
+
+    def outer():
+        with B:
+            inner()
+
+    def reverse():
+        with A:
+            with B:
+                pass
+"""
+
+
+def test_container_stored_lock_resolves(tmp_path):
+    # the last PR-3 lock blind spot, now closed: a lock pulled out of a
+    # MODULE-LEVEL LITERAL dict resolves by subscript key — both
+    # name-valued ({"a": A}) and direct checked_lock(...) entries
+    fs = _lint_src(tmp_path, _CONTAINER_LOCK_FIXTURE)
+    (f,) = _by_check(fs, "lock-order")
+    assert "cd.A" in f.message and "cd.B" in f.message
+    assert "inner" in f.message  # the chain names the callee
+
+
+def test_container_stored_lock_matches_dynamic_harness(tmp_path):
+    """Parity: the container-lock inversion the static pass now reports
+    is exactly the one the dynamic harness observes at runtime."""
+    from brpc_tpu.analysis import race
+
+    static = _by_check(_lint_src(tmp_path, _CONTAINER_LOCK_FIXTURE),
+                       "lock-order")
+    assert len(static) == 1
+
+    race.clear()
+    race.set_enabled(True)
+    try:
+        ns = {"checked_lock": race.checked_lock}
+        exec(textwrap.dedent(_CONTAINER_LOCK_FIXTURE).split("\n", 1)[1],
+             ns)
+        ns["outer"]()
+        ns["reverse"]()
+        dynamic = [f for f in race.findings()
+                   if f.kind == "lock-inversion"]
+    finally:
+        race.set_enabled(None)
+        race.clear()
+    assert len(dynamic) == 1
+    assert {"cd.A", "cd.B"} <= set(dynamic[0].locks)
+
+
+def test_container_lock_non_constant_key_stays_deferred(tmp_path):
+    # a dynamic key cannot bind statically — no false edges, no finding
     fs = _lint_src(tmp_path, """\
         from brpc_tpu.analysis.race import checked_lock
-        A = checked_lock("cd.A")
-        B = checked_lock("cd.B")
+        A = checked_lock("cdk.A")
+        B = checked_lock("cdk.B")
         LOCKS = {"a": A}
+
+        def inner(k):
+            with LOCKS[k]:
+                pass
+
+        def outer():
+            with B:
+                inner("a")
+
+        def reverse():
+            with A:
+                with B:
+                    pass
+    """)
+    assert _by_check(fs, "lock-order") == []
+
+
+def test_container_lock_mutated_container_stays_deferred(tmp_path):
+    # only LITERAL module dicts participate: a container built by
+    # subscript stores is not trusted (its contents are runtime state)
+    fs = _lint_src(tmp_path, """\
+        from brpc_tpu.analysis.race import checked_lock
+        A = checked_lock("cm.A")
+        B = checked_lock("cm.B")
+        LOCKS = {}
+        LOCKS["a"] = A
 
         def inner():
             with LOCKS["a"]:

@@ -90,6 +90,101 @@ def test_stream_roundtrip_ordered_close_drains():
         srv.close()
 
 
+class _Echoer:
+    """Server receiver that writes every frame back on the server half
+    (the write surface ``accept`` now returns)."""
+
+    def __init__(self):
+        self.reply = None
+        self.closed = threading.Event()
+
+    def on_data(self, data):
+        self.reply.write(b"echo:" + data)
+
+    def on_closed(self):
+        self.closed.set()
+
+
+def test_server_to_client_stream_writes():
+    """The PR-7 deferral closed: the native stream layer is symmetric,
+    and ``Channel.stream(receiver=...)`` surfaces the read side — the
+    server's handler gets a writable server half back from ``accept``
+    and frames it writes deliver to the client's receiver, serialized,
+    with a final ``on_closed`` after the server closes its half."""
+    server_side = _Echoer()
+
+    def handler(method, request, accept):
+        server_side.reply = accept(server_side)
+        return b"ok"
+
+    srv = rpc.Server()
+    srv.add_stream_handler("S", handler)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=10000)
+    client_side = _Collector()
+    try:
+        st = ch.stream("S", "Open", b"", receiver=client_side)
+        assert st.response == b"ok"
+        frames = [f"f{i}".encode() for i in range(32)]
+        for f in frames:
+            st.write(f)
+        # collect every echo BEFORE closing: close is a full close, not
+        # a half-close — peer frames after it are discarded by design
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                len(client_side.frames) < len(frames):
+            time.sleep(0.005)
+        assert client_side.frames == [b"echo:" + f for f in frames]
+        st.close()
+        assert server_side.closed.wait(5)
+        server_side.reply.close()
+        assert client_side.closed.wait(5)
+    finally:
+        ch.close()
+        srv.close()
+
+
+def test_rx_stream_delivers_frames_written_before_registration():
+    """A fast server can write frames that arrive BEFORE the client's
+    receiver registration lands: they buffer as orphans and the
+    registration drains them in order (the two-phase handoff)."""
+    class _Greeter:
+        def on_data(self, data):
+            pass
+
+        def on_closed(self):
+            pass
+
+    holder = {}
+
+    def handler(method, request, accept):
+        reply = accept(_Greeter())
+        # written INSIDE the handler — the client cannot have
+        # registered yet (the setup response hasn't even left)
+        reply.write(b"early-1")
+        reply.write(b"early-2")
+        holder["reply"] = reply
+        return b"ok"
+
+    srv = rpc.Server()
+    srv.add_stream_handler("S", handler)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=10000)
+    got = _Collector()
+    try:
+        st = ch.stream("S", "Open", b"", receiver=got)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(got.frames) < 2:
+            time.sleep(0.005)
+        assert got.frames == [b"early-1", b"early-2"]
+        st.close()
+        holder["reply"].close()
+        assert got.closed.wait(5)
+    finally:
+        ch.close()
+        srv.close()
+
+
 def test_stream_rejected_when_handler_does_not_accept():
     srv = rpc.Server()
     srv.add_stream_handler("S", lambda m, r, accept: b"no-stream")

@@ -725,3 +725,67 @@ def test_server_side_rule_targets_one_endpoint():
             ch.close()
         for srv in servers:
             srv.close()
+
+
+# ---- ReplicaScorer (the locality-aware LB's two load signals) ----
+
+def test_replica_scorer_prefers_fast_low_inflight():
+    from brpc_tpu.resilience import ReplicaScorer
+
+    sc = ReplicaScorer()
+    sc.note_start("fast")
+    sc.note_end("fast", 0.001, True)    # 1ms
+    sc.note_start("slow")
+    sc.note_end("slow", 0.050, True)    # 50ms
+    assert sc.pick(["slow", "fast"]) == "fast"
+    # inflight multiplies: queue depth on the fast one flips the choice
+    for _ in range(60):
+        sc.note_start("fast")
+    assert sc.score("fast") > sc.score("slow")
+    assert sc.pick(["slow", "fast"]) == "slow"
+
+
+def test_replica_scorer_failure_penalty_and_recovery():
+    from brpc_tpu.resilience import ReplicaScorer
+
+    sc = ReplicaScorer(fail_penalty_ms=100.0)
+    sc.note_start("a")
+    sc.note_end("a", 0.001, True)
+    sc.note_start("b")
+    sc.note_end("b", 0.001, False)      # failure: penalty >= 100ms
+    assert sc.score("b") > sc.score("a")
+    assert sc.pick(["a", "b"]) == "a"
+    # successes decay the EWMA back down — the endpoint recovers
+    for _ in range(40):
+        sc.note_start("b")
+        sc.note_end("b", 0.0005, True)
+    assert sc.score("b") < sc.score("a")
+
+
+def test_replica_scorer_optimist_prior_and_ties():
+    from brpc_tpu.resilience import ReplicaScorer
+
+    sc = ReplicaScorer(prior_ms=1.0)
+    # unknown endpoints score the optimist prior: a fresh/revived
+    # replica is probed by real traffic instead of starving
+    sc.note_start("warm")
+    sc.note_end("warm", 0.020, True)    # 20ms known
+    assert sc.pick(["warm", "fresh"]) == "fresh"
+    # deterministic tie-break: first candidate wins on equal scores
+    assert sc.pick(["x", "y"]) == "x"
+    assert sc.pick([]) is None
+    snap = sc.snapshot()
+    assert snap["warm"]["inflight"] == 0
+    assert snap["warm"]["ewma_ms"] > 1.0
+
+
+def test_kill_rules_shape():
+    from brpc_tpu import fault
+
+    rules = fault.kill_rules("1.2.3.4:5", "6.7.8.9:10", max_hits=3)
+    assert len(rules) == 4              # client + server per endpoint
+    sides = {(r.side, r.endpoint) for r in rules}
+    assert ("client", "1.2.3.4:5") in sides
+    assert ("server", "6.7.8.9:10") in sides
+    assert all(r.action == "error" and r.error_code == 1009
+               and r.max_hits == 3 for r in rules)
