@@ -116,6 +116,36 @@ def _pack_stream_frame(seq: int, epoch: int, gen: int,
     return out
 
 
+def _pack_windows(windows: Dict[str, int]) -> bytes:
+    """Writer seq high-water map on the wire: ``int32 count`` ++ per
+    entry ``int32 len ++ writer utf8 ++ int64 seq``.  Rides every
+    ``ReplicaApply`` frame and the ``Sync`` payload so a promoted backup
+    inherits the dedup window — replay idempotence must survive
+    failover, not just reconnect-to-the-same-primary."""
+    parts = [struct.pack("<i", len(windows))]
+    for w, seq in windows.items():
+        wb = w.encode()
+        parts.append(struct.pack("<i", len(wb)) + wb
+                     + struct.pack("<q", seq))
+    return b"".join(parts)
+
+
+def _unpack_windows(payload, offset: int = 0):
+    """Inverse of :func:`_pack_windows`: returns ``(windows, end)``."""
+    (count,) = struct.unpack_from("<i", payload, offset)
+    offset += 4
+    windows: Dict[str, int] = {}
+    for _ in range(count):
+        (wlen,) = struct.unpack_from("<i", payload, offset)
+        offset += 4
+        w = bytes(payload[offset:offset + wlen]).decode(errors="replace")
+        offset += wlen
+        (seq,) = struct.unpack_from("<q", payload, offset)
+        offset += 8
+        windows[w] = seq
+    return windows, offset
+
+
 def _unpack_apply(payload: bytes, base: int, rows_per: int, dim: int):
     """Parse + validate one ApplyGrad-framed delta (unary request body or
     stream frame): returns ``(local_ids, grads[count, dim])``.  Raises
@@ -156,22 +186,28 @@ class GradCombiner:
     there is no circular wait even on a single worker."""
 
     __slots__ = ("_apply", "_dim", "_mu", "_q", "_draining", "_shut",
-                 "last_error")
+                 "_pass_meta", "last_error")
 
-    def __init__(self, apply_fn, dim: int):
+    def __init__(self, apply_fn, dim: int, pass_meta: bool = False):
         self._apply = apply_fn          # apply_fn(local_ids, grads): ONE
         self._dim = dim                 # combined application
         self._mu = checked_lock("ps.combine")
         self._q: list = []
         self._draining = False
         self._shut = False
+        # pass_meta: apply_fn(ids, grads, metas) — the drained batch's
+        # per-contribution (writer, seq) tags ride along, so a
+        # replicated shard can propagate its applied dedup window with
+        # the batch it belongs to (never ahead of the data).
+        self._pass_meta = bool(pass_meta)
         self.last_error: Optional[BaseException] = None
 
     def add(self, ids: np.ndarray, grads: np.ndarray,
-            wait: bool = True) -> None:
-        # [ids, grads, done-event, error] — error is filled by whichever
-        # leader applies the batch this entry lands in.
-        entry = [ids, grads, threading.Event() if wait else None, None]
+            wait: bool = True, meta=None) -> None:
+        # [ids, grads, done-event, error, meta] — error is filled by
+        # whichever leader applies the batch this entry lands in.
+        entry = [ids, grads, threading.Event() if wait else None, None,
+                 meta]
         with self._mu:
             if self._shut:
                 # Server teardown: late contributions (a dead client's
@@ -212,7 +248,12 @@ class GradCombiner:
                     ids = np.concatenate([e[0] for e in batch])
                     grads = np.concatenate([e[1] for e in batch])
                 if ids.size:
-                    self._apply(ids, grads)
+                    if self._pass_meta:
+                        self._apply(ids, grads,
+                                    [e[4] for e in batch
+                                     if e[4] is not None])
+                    else:
+                        self._apply(ids, grads)
                     if obs.enabled():
                         obs.counter("ps_combined_applies").add(1)
                         obs.counter("ps_combined_keys").add(int(ids.size))
@@ -265,15 +306,51 @@ class _ApplyStreamReceiver:
     ``(seq, 0, 0)`` header and the server's per-writer monotonic seq
     window drops replays (reconnect-after-partial-write ships the same
     frame twice at most; the window makes the second a no-op instead of
-    a double apply).  Empty writer = the legacy unframed mode."""
+    a double apply).  Empty writer = the legacy unframed mode.
 
-    __slots__ = ("_server", "_writer")
+    FENCING is re-checked per frame, not just at stream setup: a
+    primary demoted while a push stream is up must not keep applying
+    frames locally (the new primary's Sync would overwrite them — an
+    acked-then-lost write).  A frame landing on a demoted server is
+    DROPPED without reserving its seq, a fence notification (a negative
+    int64) is written on the reply half, and the reply closes to break
+    the stream — the pushing client fails over and replays; the dropped
+    frame's seq stays below every replica's window so the replay
+    applies."""
+
+    __slots__ = ("_server", "_writer", "reply", "_fenced")
 
     def __init__(self, server, writer: str = ""):
         self._server = server
         self._writer = writer
+        self.reply: "Optional[rpc.Stream]" = None
+        self._fenced = False
+
+    def _demoted(self) -> bool:
+        fenced = getattr(self._server, "_stream_write_fenced", None)
+        return fenced is not None and fenced()
+
+    def _fence(self) -> None:
+        """Mark this stream fenced and tell the client: a negative ack
+        frame, then break the stream so the next write fails over."""
+        if self._fenced:
+            return
+        self._fenced = True
+        if obs.enabled():
+            obs.counter("ps_stream_fenced").add(1)
+        if self.reply is not None:
+            try:
+                self.reply.write(struct.pack("<q", -1))
+            except rpc.RpcError:
+                pass   # client gone; its reconnect pays ENOTPRIMARY
+            self.reply.close()
 
     def on_data(self, data: bytes) -> None:
+        if self._fenced:
+            return
+        if self._demoted():
+            self._fence()
+            return
         if not self._writer:
             self._server._apply_frame(data)
             return
@@ -282,11 +359,21 @@ class _ApplyStreamReceiver:
             if obs.enabled():
                 obs.counter("ps_stream_dedup_drops").add(1)
             return
-        self._server._apply_frame(memoryview(data)[_FRAME_HDR.size:])
+        self._server._apply_frame(memoryview(data)[_FRAME_HDR.size:],
+                                  (self._writer, seq))
 
     def on_closed(self) -> None:
-        self._server._combiner.flush()
-        self._server.flush_replication()
+        try:
+            self._server._combiner.flush()
+            self._server.flush_replication()
+        except rpc.RpcError:
+            # ENOTPRIMARY from a demotion racing the drain, or EFENCED
+            # from the replication barrier: the close must not read as
+            # an "applied everywhere" ack.
+            self._fence()
+            return
+        if self._demoted():
+            self._fence()
 
 
 class _ReplicaStreamReceiver:
@@ -397,6 +484,10 @@ class _Replicator:
         self.timeout_ms = timeout_ms
         self._mu = checked_lock("ps.replicate")
         self._stop = threading.Event()
+        # True when stopped BECAUSE of a fence/demotion: an in-flight
+        # flush must raise EFENCED (the new primary's Sync will wipe the
+        # batch), never break out as success.
+        self._demoted = False
         self._ack_ev = threading.Event()
         self._chans: Dict[str, rpc.Channel] = {}
         self._peers = [_PeerState(a) for a in peers]
@@ -477,7 +568,7 @@ class _Replicator:
                     acked, fenced = p.acked_gen, p.fenced
                     live = (p.stream is not None and not p.need_sync
                             and not p.down)
-                if fenced:
+                if fenced or self._demoted:
                     raise rpc.RpcError(
                         resilience.EFENCED,
                         f"fenced by a newer primary while flushing "
@@ -510,12 +601,14 @@ class _Replicator:
         it wholesale — and the stream resumes from that generation, so
         queued frames at or below it are ship-skipped (the backup would
         dedup them anyway)."""
-        epoch, gen, table = self._server._replication_snapshot()
+        epoch, gen, table, windows = \
+            self._server._replication_snapshot()
         ch = self._channel(p.addr)
         try:
             ch.call("Ps", "Sync",
                     struct.pack("<qqq", epoch, gen,
-                                len(table) // 4) + table,
+                                len(table) // 4) + table
+                    + _pack_windows(windows),
                     timeout_ms=self.timeout_ms)
             st = ch.stream("Ps", "ReplicaApply",
                            struct.pack("<q", epoch),
@@ -596,7 +689,9 @@ class _Replicator:
                 if p.queue and p.queue[0] is item:
                     p.queue.popleft()
 
-    def stop(self, join: bool = True) -> None:
+    def stop(self, join: bool = True, fenced: bool = False) -> None:
+        if fenced:
+            self._demoted = True
         self._stop.set()
         self._ack_ev.set()
         for p in self._peers:
@@ -694,14 +789,20 @@ class PsShardServer:
         #: stream setup to backups) — bounds how long a blackholed
         #: backup can stall the first flush before it is marked down
         self.repl_timeout_ms = 2000
-        # Per-writer monotonic seq window for idempotent stream replay.
+        # Per-writer monotonic seq windows for idempotent stream replay:
+        # _writer_seqs is the ADMISSION window (reserved at enqueue —
+        # dedups replays on this server); _writer_applied trails it at
+        # APPLY time and is what replication propagates (Sync +
+        # per-frame), so a promoted backup inherits a window that never
+        # claims a seq whose data it does not hold.
         self._seq_mu = checked_lock("ps.writer_seq")
         self._writer_seqs: Dict[str, int] = {}
+        self._writer_applied: Dict[str, int] = {}
         # The combiner exists whenever anything feeds it: unary combining
         # (combine) or streamed deltas (stream — frames ALWAYS combine,
         # they have no per-frame response to serialize on).
         self._combiner: Optional[GradCombiner] = (
-            GradCombiner(self._apply_batch, dim)
+            GradCombiner(self._apply_batch, dim, pass_meta=True)
             if (self.combine or self.stream) else None)
         self.server = rpc.Server()
         # The trampoline is ALWAYS stream-capable: replica delta
@@ -801,7 +902,7 @@ class PsShardServer:
                     self._primary_flag = False
                     demote, self._replicator = self._replicator, None
         if demote is not None:
-            demote.stop(join=False)
+            demote.stop(join=False, fenced=True)
 
     def _demote_on_fence(self) -> None:
         """A backup rejected our propagation with EFENCED: a newer
@@ -815,13 +916,27 @@ class PsShardServer:
                 if obs.enabled():
                     obs.counter("ps_replica_demotions").add(1)
         if demote is not None:
-            demote.stop(join=False)
+            demote.stop(join=False, fenced=True)
+
+    def _stream_write_fenced(self) -> bool:
+        """True when streamed writes must be refused: this replica was
+        demoted (or never was primary) while carrying a push stream."""
+        return self._replica_set is not None and not self._primary_flag
 
     def _replication_snapshot(self):
-        """Consistent ``(epoch, gen, table bytes)`` for a full-state
-        Sync (the read lock excludes writers, so gen and table match)."""
-        with self._mu.read():
-            return self._epoch, self._install_gen, self.table.tobytes()
+        """Consistent ``(epoch, gen, table bytes, applied windows)`` for
+        a full-state Sync.  Epoch is read under ``_repl_mu`` (it is
+        mutated there — Promote/fence adoption), THEN the table read
+        lock pins (gen, table, windows) together: a concurrent promotion
+        can no longer pair a stale epoch with a fresh table.  Lock order
+        is repl_mu → shard → writer_seq everywhere."""
+        with self._repl_mu:
+            epoch = self._epoch
+            with self._mu.read():
+                with self._seq_mu:
+                    windows = dict(self._writer_applied)
+                return (epoch, self._install_gen, self.table.tobytes(),
+                        windows)
 
     def flush_replication(self, timeout_s: float = 5.0) -> None:
         """Blocks until every backup has ACKED everything applied so far
@@ -857,8 +972,9 @@ class PsShardServer:
             if obs.enabled():
                 obs.counter("ps_replica_fenced").add(1)
             return -self._epoch
-        ids, grads = _unpack_apply(body, self.base, self.rows_per,
-                                   self.dim)
+        windows, off = _unpack_windows(body)
+        ids, grads = _unpack_apply(memoryview(body)[off:], self.base,
+                                   self.rows_per, self.dim)
         with self._mu.write():
             if gen <= self._install_gen:
                 return self._install_gen   # duplicate: ack, don't apply
@@ -870,6 +986,16 @@ class PsShardServer:
             self._install_gen = gen
             if self._shard is not None:
                 self._shard.install(self.table, gen)
+            if windows:
+                # Inherit the primary's dedup window WITH the batch it
+                # covers: on promotion, a replayed frame at or below
+                # this mark dedups instead of double-applying.
+                with self._seq_mu:
+                    for w, q in windows.items():
+                        if q > self._writer_seqs.get(w, 0):
+                            self._writer_seqs[w] = q
+                        if q > self._writer_applied.get(w, 0):
+                            self._writer_applied[w] = q
             return gen
 
     # -- request handling --------------------------------------------------
@@ -898,7 +1024,11 @@ class PsShardServer:
                 raise ValueError(f"unknown method {method}")
             self._check_primary()
             writer = payload.decode(errors="replace") if payload else ""
-            accept(_ApplyStreamReceiver(self, writer))
+            recv = _ApplyStreamReceiver(self, writer)
+            # The reply half carries the fence notification (a demotion
+            # mid-stream must fail the client's flush, not silently
+            # drop into a zombie's table).
+            recv.reply = accept(recv)
             if writer:
                 with self._seq_mu:
                     last = self._writer_seqs.get(writer, 0)
@@ -912,36 +1042,59 @@ class PsShardServer:
             return struct.pack("<qq", self._epoch, self._install_gen)
         return self._handle(method, payload)
 
-    def _apply_frame(self, payload) -> None:
+    def _apply_frame(self, payload, meta=None) -> None:
         """One streamed delta: parse/validate, enqueue without waiting
-        (frames have no response; the close barrier flushes)."""
+        (frames have no response; the close barrier flushes).  ``meta``
+        is the frame's (writer, seq) tag — it rides the combiner into
+        :meth:`_apply_batch` so the applied window propagates with the
+        batch that covers it."""
         t0 = time.monotonic_ns() if obs.enabled() else 0
         ids, grads = _unpack_apply(payload, self.base, self.rows_per,
                                    self.dim)
-        self._combiner.add(ids, grads, wait=False)
+        self._combiner.add(ids, grads, wait=False, meta=meta)
         if t0:
             _record_ps_server(self.shard_index, "StreamApply",
                               int(ids.size), len(payload), 0, t0)
 
-    def _apply_batch(self, ids: np.ndarray, grads: np.ndarray) -> None:
+    def _apply_batch(self, ids: np.ndarray, grads: np.ndarray,
+                     metas=()) -> None:
         """ONE combined application for a drained batch: a single
         unbuffered ``subtract.at`` (duplicate ids sum exactly), a
         generation bump, under ``native_read`` a single snapshot
         install — and, on a replicated primary, ONE propagation frame
         shipped to every backup (enqueued under the write lock so
-        backups see batches in exactly the apply order)."""
+        backups see batches in exactly the apply order).  A DEMOTED
+        replica refuses outright: applying here would land updates only
+        the new primary's next Sync erases."""
         if not ids.size:
             return   # nothing applied: no generation, nothing to ship
+        with self._repl_mu:
+            if self._replica_set is not None and not self._primary_flag:
+                raise rpc.RpcError(
+                    resilience.ENOTPRIMARY,
+                    f"shard {self.shard_index} replica "
+                    f"{self._replica_index} was demoted (epoch "
+                    f"{self._epoch}); refusing the apply")
+        updates: Dict[str, int] = {}
+        for m in metas:
+            if m[1] > updates.get(m[0], 0):
+                updates[m[0]] = m[1]
         with self._mu.write():
             np.subtract.at(self.table, ids, self.lr * grads)
             self._install_gen += 1
             gen = self._install_gen
             if self._shard is not None:
                 self._shard.install(self.table, gen)
+            if updates:
+                with self._seq_mu:
+                    for w, q in updates.items():
+                        if q > self._writer_applied.get(w, 0):
+                            self._writer_applied[w] = q
             rep = self._replicator
             if rep is not None:
-                rep.ship(gen, _pack_apply_req(
-                    (ids + self.base).astype(np.int32), grads))
+                rep.ship(gen, _pack_windows(updates) + bytes(
+                    _pack_apply_req(
+                        (ids + self.base).astype(np.int32), grads)))
         # Synchronous replication: the apply (and therefore the unary
         # response / combiner barrier riding it) completes only once
         # every CONNECTED backup acked this batch — a write acked to
@@ -972,6 +1125,12 @@ class PsShardServer:
                         f"{self._epoch}")
                 self._epoch = epoch
                 self._primary_flag = True
+                # Reserved-but-never-applied seqs (enqueued on a
+                # since-demoted run, failed with the demotion) must not
+                # survive into the new reign's admission window — they
+                # would dedup a replay whose data this table lacks.
+                with self._seq_mu:
+                    self._writer_seqs = dict(self._writer_applied)
                 old, self._replicator = self._replicator, None
                 peers = self._peers()
                 if peers:
@@ -992,12 +1151,43 @@ class PsShardServer:
                     f"{self.rows_per * self.dim}")
             table = np.frombuffer(payload, np.float32, count,
                                   24).reshape(self.rows_per, self.dim)
-            with self._mu.write():
-                self.table[:] = table
-                self._install_gen = gen
-                if self._shard is not None:
-                    self._shard.install(self.table, gen)
+            tbl_end = 24 + count * 4
+            windows = _unpack_windows(payload, tbl_end)[0] \
+                if len(payload) > tbl_end else {}
+            with self._repl_mu:
+                # Re-verify under the epoch's own lock: a Promote that
+                # slipped in between the fence check and this install
+                # must not let a now-stale Sync overwrite the new
+                # primary's table.
+                if epoch < self._epoch or self._primary_flag:
+                    raise rpc.RpcError(
+                        resilience.EFENCED,
+                        f"stale sync epoch {epoch} (current "
+                        f"{self._epoch}, primary={self._primary_flag})")
+                with self._mu.write():
+                    self.table[:] = table
+                    self._install_gen = gen
+                    if self._shard is not None:
+                        self._shard.install(self.table, gen)
+                    # Full-state handoff: the received (table, gen,
+                    # windows) triple is authoritative — local window
+                    # history refers to a table this install replaces.
+                    with self._seq_mu:
+                        self._writer_seqs = dict(windows)
+                        self._writer_applied = dict(windows)
             return b""
+        if method == "WriterSeq":
+            # Applied high-water for one writer + current gen: the
+            # client's flush barrier verifies against the PRIMARY's
+            # applied window (a zombie answers ENOTPRIMARY and the
+            # client re-resolves).
+            self._check_primary()
+            writer = payload.decode(errors="replace")
+            with self._seq_mu:
+                applied = self._writer_applied.get(writer, 0)
+            with self._mu.read():
+                gen = self._install_gen
+            return struct.pack("<qq", applied, gen)
         if method == "Flush":
             if self._combiner is not None:
                 self._combiner.flush()
@@ -1006,7 +1196,8 @@ class PsShardServer:
         raise ValueError(f"unknown method {method}")
 
     def _serve(self, method: str, payload: bytes) -> bytes:
-        if method in ("ReplicaState", "Promote", "Sync", "Flush"):
+        if method in ("ReplicaState", "Promote", "Sync", "WriterSeq",
+                      "Flush"):
             return self._serve_control(method, payload)
         (count,) = struct.unpack_from("<i", payload, 0)
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
@@ -1030,8 +1221,16 @@ class PsShardServer:
                 # the combiner's leader applies once per drained batch.
                 self._combiner.add(ids,
                                    grads.reshape(count, self.dim))
-                return b""
-            self._apply_batch(ids, grads.reshape(count, self.dim))
+            else:
+                self._apply_batch(ids, grads.reshape(count, self.dim))
+            if self._replica_set is not None:
+                # Replicated: answer the gen this write is covered by
+                # (>= the batch it landed in).  The client records it as
+                # its acked floor — failover refuses any candidate whose
+                # gen is behind it, so "acked then lost" becomes "acked
+                # or loudly refused".
+                with self._mu.read():
+                    return struct.pack("<q", self._install_gen)
             return b""
         raise ValueError(f"unknown method {method}")
 
@@ -1237,7 +1436,8 @@ class DevicePsShardServer:
     def _handle_stream(self, method: str, payload: bytes, accept) -> bytes:
         if method == "StreamApply":
             writer = payload.decode(errors="replace") if payload else ""
-            accept(_ApplyStreamReceiver(self, writer))
+            recv = _ApplyStreamReceiver(self, writer)
+            recv.reply = accept(recv)
             if writer:
                 with self._seq_mu:
                     last = self._writer_seqs.get(writer, 0)
@@ -1257,7 +1457,7 @@ class DevicePsShardServer:
         """Device shards are not replicated (yet); the shared stream
         receiver's close barrier calls this unconditionally."""
 
-    def _apply_frame(self, payload: bytes) -> None:
+    def _apply_frame(self, payload, meta=None) -> None:
         t0 = time.monotonic_ns() if obs.enabled() else 0
         ids, grads = _unpack_apply(payload, self.base, self.rows_per,
                                    self.dim)
@@ -1388,6 +1588,26 @@ class DevicePsShardServer:
         self.dev.release(self.lr_h)
         if self._owns_dev:
             self.dev.close()
+
+
+class _PushStreamReceiver:
+    """Client read half of a gradient push stream: the only frame the
+    server ever writes back is a FENCE notification (a negative int64 —
+    the primary was demoted mid-stream and dropped frames).  Seeing it
+    flips ``fenced`` so the pusher fails over instead of trusting the
+    close barrier."""
+
+    __slots__ = ("fenced",)
+
+    def __init__(self):
+        self.fenced = False
+
+    def on_data(self, data: bytes) -> None:
+        if len(data) >= 8 and struct.unpack_from("<q", data, 0)[0] < 0:
+            self.fenced = True
+
+    def on_closed(self) -> None:
+        pass
 
 
 class RemoteEmbedding:
@@ -1533,11 +1753,27 @@ class RemoteEmbedding:
         self.push_window_bytes = push_window_bytes
         self._push_streams: dict = {}
         self._push_addr: Dict[int, str] = {}
+        self._push_recv: Dict[int, "_PushStreamReceiver"] = {}
         # Framed idempotent push: one stable writer identity, one
         # monotonically increasing seq per shard (never reset — the
         # server's per-writer window is the dedup state).
         self._writer_id = f"w{uuid.uuid4().hex[:12]}"
         self._push_seq: Dict[int, int] = {}
+        #: highest seq written to the CURRENT stream per shard (reset to
+        #: the server's high-water on every (re)connect — the replay
+        #: cursor)
+        self._push_sent: Dict[int, int] = {}
+        #: frames pushed since the last successful flush barrier, per
+        #: shard: (seq, body) in order.  A failover mid-window replays
+        #: these above the new primary's inherited high-water — pushed-
+        #: but-unflushed deltas survive the primary, not just the
+        #: stream.  Cleared only when the flush barrier confirms.
+        self._push_unacked: Dict[int, List[tuple]] = {}
+        #: highest replicated gen this client has been ACKED per shard —
+        #: failover refuses a promotion candidate behind it (a backup
+        #: that missed acked writes must not be promoted into losing
+        #: them; unavailability over silent loss)
+        self._gen_seen: List[int] = [0] * self.n
         #: current believed primary per shard (index into the replica
         #: set; moved by observed promotions / client-driven failover)
         self._primary_idx: List[int] = [rs.primary
@@ -1697,15 +1933,28 @@ class RemoteEmbedding:
                       if st.get("primary") and st["epoch"] >= seen]
             if claims:
                 _, addr = max(claims)
+                if states[addr]["gen"] < self._gen_seen[s]:
+                    # A primary whose table is behind writes this client
+                    # was ACKED can only exist through a lossy promotion
+                    # elsewhere — refuse to adopt it silently.
+                    raise rpc.RpcError(
+                        resilience.EBREAKEROPEN,
+                        f"shard {s}: claimed primary {addr} is at gen "
+                        f"{states[addr]['gen']} < acked gen "
+                        f"{self._gen_seen[s]} — acked updates are "
+                        f"missing, refusing the lossy adoption")
             else:
                 cands = {a: st for a, st in states.items()
-                         if st["epoch"] >= seen}
+                         if st["epoch"] >= seen
+                         and st["gen"] >= self._gen_seen[s]}
                 if not cands:
                     raise rpc.RpcError(
                         resilience.EBREAKEROPEN,
                         f"shard {s}: every reachable replica is behind "
-                        f"epoch {seen} — the authoritative replica is "
-                        f"unreachable, refusing a lossy promotion")
+                        f"epoch {seen} or acked gen "
+                        f"{self._gen_seen[s]} — the authoritative "
+                        f"replica is unreachable, refusing a lossy "
+                        f"promotion")
                 # Nobody owns the range: promote the freshest current-
                 # epoch replica (highest generation; index breaks ties
                 # deterministically) with a fencing epoch above all.
@@ -1730,6 +1979,14 @@ class RemoteEmbedding:
         raise rpc.RpcError(
             resilience.EFENCED,
             f"shard {s}: lost the promote race on every attempt")
+
+    def _note_acked_gen(self, s: int, rsp) -> None:
+        """A replicated shard answers writes with the covering gen —
+        the client's acked floor for failover's lossy-promotion guard."""
+        if rsp is not None and len(rsp) >= 8:
+            (gen,) = struct.unpack_from("<q", rsp, 0)
+            if gen > self._gen_seen[s]:
+                self._gen_seen[s] = gen
 
     def _reroutable(self, s: int, exc: rpc.RpcError) -> bool:
         """True for routing-correction errors (the write reached a
@@ -2087,11 +2344,14 @@ class RemoteEmbedding:
                 req = _pack_apply_req(owned, g[positions])
                 nbytes_out += len(req)
                 items.append((s, req))
-            self._fan_out("ApplyGrad", items)
+            for (s, _), rsp in zip(items,
+                                   self._fan_out("ApplyGrad", items)):
+                self._note_acked_gen(s, rsp)
         else:
             for s, positions, owned in self._owner_split(flat):
                 req = _pack_apply_req(owned, g[positions])
-                self._call_shard(s, "ApplyGrad", req)
+                self._note_acked_gen(
+                    s, self._call_shard(s, "ApplyGrad", req))
                 nbytes_out += len(req)
         if rec:
             obs.recorder("ps_client_apply").record(
@@ -2109,49 +2369,86 @@ class RemoteEmbedding:
             addr = self._route_write(s, exclude)
             # The setup request carries the writer id: the server opens
             # (or re-opens) this writer's monotonic seq window and
-            # answers its high-water mark, which decides replay below.
+            # answers its high-water mark — the replay cursor.  The
+            # receiver is the fence channel: a primary demoted while
+            # this stream is up notifies instead of silently dropping.
+            recv = _PushStreamReceiver()
             st = self._chan(addr).stream(
                 "Ps", "StreamApply", self._writer_id.encode(),
-                max_buf_size=self.push_window_bytes)
+                max_buf_size=self.push_window_bytes, receiver=recv)
             self._push_streams[s] = st
             self._push_addr[s] = addr
+            self._push_recv[s] = recv
+            high = 0
+            if len(st.response) >= 8:
+                (high,) = struct.unpack_from("<q", st.response, 0)
+            self._push_sent[s] = high
+            if obs.enabled():
+                # frames this server already holds (the write that
+                # "failed" reached it before the break) are not resent
+                nskip = sum(1 for q, _ in self._push_unacked.get(s, ())
+                            if q <= high)
+                if nskip:
+                    obs.counter("ps_stream_replay_skips").add(nskip)
         return st
 
-    def _push_frame(self, s: int, seq: int, body) -> None:
-        """Write delta ``seq`` to shard ``s``'s push stream,
-        RECONNECTING under the embedding's retry policy on error: the
-        broken stream is aborted, a fresh one is created (the setup RPC
-        pays the shard's real state — timeouts included), and THIS frame
-        is replayed on it.  A frame whose write was reported failed may
-        still have reached the server before the break — the per-writer
-        seq in every frame makes the replay IDEMPOTENT: the server's
-        window drops anything at or below its high-water mark, and the
-        setup response carries that mark so an already-received frame is
-        not even resent.  A failed or demoted primary re-routes:
-        ENOTPRIMARY/EFENCED fails over immediately, a dead endpoint is
-        excluded from the reconnect's routing (redirect mode)."""
+    def _drop_push_stream(self, s: int) -> Optional[str]:
+        """Tear down shard ``s``'s push stream state (reconnect/error
+        path).  Returns the address it was bound to, if any."""
+        st = self._push_streams.pop(s, None)
+        if st is not None:
+            # rx stream: close, never abort (the closed callback is
+            # what frees the native read relay)
+            st.close()
+        self._push_recv.pop(s, None)
+        self._push_sent.pop(s, None)
+        return self._push_addr.pop(s, None)
+
+    def _push_frames(self, s: int) -> None:
+        """Write every unacked frame past the replay cursor to shard
+        ``s``'s push stream, RECONNECTING under the embedding's retry
+        policy on error: the broken stream is torn down, a fresh one is
+        created (the setup RPC pays the shard's real state — timeouts
+        included), and the unacked TAIL above the server's high-water
+        mark is replayed on it.  The per-writer seq in every frame makes
+        replay IDEMPOTENT (the server's window drops anything at or
+        below its mark), and because the window a promoted backup
+        inherits covers exactly the frames whose data it holds, the same
+        replay is also LOSSLESS across failover.  A failed or demoted
+        primary re-routes: ENOTPRIMARY/EFENCED (including the fence
+        notification on the stream's reply half) fails over immediately;
+        a dead endpoint is excluded from the reconnect's routing
+        (redirect mode)."""
         attempt = 0
         fails = 0
         exclude: set = set()
         while True:
-            addr = None
             try:
                 st = self._push_stream(s, exclude)
-                if len(st.response) >= 8:
-                    (high,) = struct.unpack_from("<q", st.response, 0)
-                    if seq <= high:
-                        # The server already has this frame (the write
-                        # that "failed" reached it before the break).
-                        if obs.enabled():
-                            obs.counter("ps_stream_replay_skips").add(1)
-                        return
-                st.write(_pack_stream_frame(seq, 0, 0, body))
+                recv = self._push_recv.get(s)
+                sent = self._push_sent.get(s, 0)
+                frames = self._push_unacked.get(s, [])
+                # seqs are contiguous per shard: the unsent tail starts
+                # right past the cursor
+                start = max(0, sent - frames[0][0] + 1) if frames else 0
+                for seq, body in frames[start:]:
+                    if recv is not None and recv.fenced:
+                        raise rpc.RpcError(
+                            resilience.ENOTPRIMARY,
+                            f"shard {s} push stream fenced "
+                            f"(primary demoted mid-stream)")
+                    if seq <= sent:
+                        continue
+                    st.write(_pack_stream_frame(seq, 0, 0, body))
+                    self._push_sent[s] = sent = seq
+                if recv is not None and recv.fenced:
+                    raise rpc.RpcError(
+                        resilience.ENOTPRIMARY,
+                        f"shard {s} push stream fenced "
+                        f"(primary demoted mid-stream)")
                 return
             except rpc.RpcError as e:
-                st = self._push_streams.pop(s, None)
-                if st is not None:
-                    st.abort()
-                addr = self._push_addr.pop(s, None)
+                addr = self._drop_push_stream(s)
                 rs = self.replica_sets[s]
                 if self._reroutable(s, e):
                     fails += 1
@@ -2196,11 +2493,14 @@ class RemoteEmbedding:
         g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
         nbytes_out = 0
         for s, positions, owned in self._owner_split(flat):
-            body = _pack_apply_req(owned, g[positions])
+            body = bytes(_pack_apply_req(owned, g[positions]))
             nbytes_out += len(body)
             seq = self._push_seq.get(s, 0) + 1
             self._push_seq[s] = seq
-            self._push_frame(s, seq, body)
+            # Unacked until the flush barrier confirms: the window is
+            # what a mid-push failover replays onto the new primary.
+            self._push_unacked.setdefault(s, []).append((seq, body))
+            self._push_frames(s)
         if rec:
             obs.recorder("ps_client_push").record(
                 (time.monotonic_ns() - t0) / 1e9)
@@ -2210,32 +2510,109 @@ class RemoteEmbedding:
     def flush_gradients(self) -> None:
         """Closes every push stream and waits until each shard has
         consumed AND applied everything pushed so far (the server
-        flushes its combiner before answering the close).  The next
-        :meth:`push_gradients` opens fresh streams.  Raises
-        :class:`rpc.RpcError` (ERPCTIMEDOUT) if a shard fails to drain
-        within the embedding's timeout."""
+        flushes its combiner before answering the close).  On a
+        REPLICATED shard the close barrier alone is not trusted: a
+        primary demoted mid-stream drops frames, so the barrier then
+        verifies the CURRENT primary's applied window covers the last
+        pushed seq, replaying the unacked tail (failover included) on a
+        shortfall — a flush that returns means every pushed delta is
+        applied on the live primary and its synced backups; a flush
+        that cannot prove it raises.  The next :meth:`push_gradients`
+        opens fresh streams.  Raises :class:`rpc.RpcError`
+        (ERPCTIMEDOUT) if a shard fails to drain within the embedding's
+        timeout."""
         streams, self._push_streams = self._push_streams, {}
         push_addr, self._push_addr = self._push_addr, {}
+        recvs, self._push_recv = self._push_recv, {}
+        self._push_sent.clear()
         for st in streams.values():
             st.close()
         deadline_s = max(1.0, self.timeout_ms / 1000.0)
         for s, st in streams.items():
-            if not st.join(timeout_s=deadline_s):
-                st.abort()
+            drained = st.join(timeout_s=deadline_s)
+            replicated = len(self.replica_sets[s].addresses) > 1
+            if not drained and not replicated:
                 raise rpc.RpcError(
                     1008, f"shard {s} ({push_addr.get(s, '?')}) did not "
                           f"drain its push stream within {deadline_s:.1f}s")
+            # replicated: a wedged/fenced stream is recovered below —
+            # the verify barrier replays onto the live primary
+        for s in list(streams):
+            if len(self.replica_sets[s].addresses) > 1:
+                self._confirm_push(s)
+            self._push_unacked.pop(s, None)
+
+    def _confirm_push(self, s: int) -> None:
+        """The zero-lost-acked half of the push barrier on a replicated
+        shard: the CURRENT primary's applied window for this writer must
+        reach the last pushed seq.  A shortfall means frames died with a
+        demoted primary — replay the unacked tail (the reconnect routes
+        through failover) and run the close barrier again.  Raises when
+        the window cannot be confirmed within the retry budget; the
+        caller's push window stays intact for a later retry."""
+        last = self._push_seq.get(s, 0)
+        if not last:
+            return
+        policy = self.retry
+        rounds = max(2, policy.max_attempts if policy is not None else 2)
+        err: Optional[rpc.RpcError] = None
+        for _ in range(rounds):
+            addr = None
+            try:
+                addr = self._route_write(s)
+                rsp = self._chan(addr).call(
+                    "Ps", "WriterSeq", self._writer_id.encode(),
+                    timeout_ms=self._ctl_timeout_ms())
+            except rpc.RpcError as e:
+                err = e
+                if len(self.replica_sets[s].addresses) > 1 and \
+                        self._redirect:
+                    # demoted (reroutable) or dead primary: re-resolve;
+                    # a dead endpoint is excluded from the sweep
+                    exclude = frozenset()
+                    if addr is not None and not self._reroutable(s, e):
+                        exclude = frozenset({addr})
+                    self._failover(s, exclude)
+                    continue
+                raise
+            applied, gen = struct.unpack_from("<qq", rsp, 0)
+            if applied >= last:
+                # confirmed on the live primary — NOW the covering gen
+                # is an acked floor for the lossy-promotion guard
+                if gen > self._gen_seen[s]:
+                    self._gen_seen[s] = gen
+                return
+            if obs.enabled():
+                obs.counter("ps_push_replays").add(1)
+            err = rpc.RpcError(
+                resilience.ENOTPRIMARY,
+                f"shard {s}: applied window {applied} < last pushed "
+                f"seq {last} after the close barrier")
+            self._push_frames(s)          # replay tail, failover-aware
+            st = self._push_streams.pop(s, None)
+            self._push_addr.pop(s, None)
+            self._push_recv.pop(s, None)
+            self._push_sent.pop(s, None)
+            if st is not None:
+                st.close()
+                st.join(timeout_s=max(1.0, self.timeout_ms / 1000.0))
+        raise err  # type: ignore[misc]
 
     def close(self):
         if self._prober is not None:
             self._prober.stop()
             self._prober = None
         for st in self._push_streams.values():
-            # Abrupt: close() is teardown, not a flush barrier — callers
-            # wanting the guarantee use flush_gradients() first.
-            st.abort()
+            # Teardown, not a flush barrier — callers wanting the
+            # guarantee use flush_gradients() first.  close(), not
+            # abort(): these carry a read half whose native relay is
+            # freed by the close handshake.
+            st.close()
         self._push_streams.clear()
         self._push_addr.clear()
+        self._push_recv.clear()
+        self._push_sent.clear()
+        self._push_unacked.clear()
         for c in self._chans.values():
             c.close()
         self._chans.clear()

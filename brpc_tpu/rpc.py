@@ -429,8 +429,10 @@ def _req_ptr(request):
 # are removed when the peer's CLOSE is delivered.
 _stream_mu = _race.checked_lock("rpc.stream.receivers")
 _stream_receivers: dict = {}
-_stream_orphans: dict = {}   # sid -> [frame bytes | None (= close)]
+# sid -> [queued_bytes, frames]; a frame of None = the close sentinel
+_stream_orphans: dict = {}
 _STREAM_ORPHAN_SIDS = 64     # dropped-oldest bound on unclaimed sids
+_STREAM_ORPHAN_BYTES = 1 << 20   # per-sid queued-bytes bound
 
 
 class _PreRegistration:
@@ -463,8 +465,8 @@ def _register_stream_receiver(stream_id: int, receiver) -> None:
     pre = None
     with _stream_mu:
         orphans = _stream_orphans.pop(stream_id, None)
-        if orphans:
-            pre = _PreRegistration(orphans)
+        if orphans and orphans[1]:
+            pre = _PreRegistration(orphans[1])
             _stream_receivers[stream_id] = pre
         else:
             _stream_receivers[stream_id] = receiver
@@ -499,6 +501,7 @@ def _stream_dispatch(user, stream_id, data, length, closed):
         payload = None
         if not closed:
             payload = ctypes.string_at(data, length) if length else b""
+        evicted: list = []
         with _stream_mu:
             receiver = _stream_receivers.get(stream_id)
             if isinstance(receiver, _PreRegistration):
@@ -506,15 +509,37 @@ def _stream_dispatch(user, stream_id, data, length, closed):
                 return
             if receiver is None:
                 # Not (yet) registered: buffer for a racing client-side
-                # registration (Channel.stream(receiver=...)); unclaimed
-                # sids are bounded by dropping the oldest.
-                q = _stream_orphans.setdefault(stream_id, [])
-                q.append(payload)
+                # registration (Channel.stream(receiver=...)).  Unclaimed
+                # sids are bounded two ways — count (drop the oldest sid)
+                # and per-sid queued bytes (a firehose nobody claims is
+                # garbage, not a registration race: the race window is
+                # one Python call).  An evicted sid gets its native close
+                # completed below so the peer's join isn't stranded.
+                entry = _stream_orphans.setdefault(stream_id, [0, []])
+                entry[0] += length if payload is not None else 0
+                entry[1].append(payload)
+                if entry[0] > _STREAM_ORPHAN_BYTES:
+                    _stream_orphans.pop(stream_id, None)
+                    evicted.append(stream_id)
                 while len(_stream_orphans) > _STREAM_ORPHAN_SIDS:
-                    _stream_orphans.pop(next(iter(_stream_orphans)))
-                return
-            if closed:
+                    sid = next(iter(_stream_orphans))
+                    _stream_orphans.pop(sid)
+                    evicted.append(sid)
+            elif closed:
                 _stream_receivers.pop(stream_id, None)
+        if evicted:
+            lib = _load()
+            for sid in evicted:
+                # Complete/abort the native half regardless of whether
+                # the dropped queue held the close sentinel — this is
+                # what retires the native stream for a sid no receiver
+                # will ever claim.
+                lib.brt_stream_close(sid)
+                if obs.enabled():
+                    obs.counter("stream_orphans_evicted").add(1)
+            return
+        if receiver is None:
+            return
         if closed:
             _handles.note_destroy("stream_receiver", stream_id)
             try:

@@ -134,3 +134,37 @@ def test_handler_trampoline_survives_gc():
     finally:
         ch.close()
         srv.close()
+
+
+def test_stream_orphan_bounds_evict_and_close_native():
+    """Unclaimed-stream buffering is bounded in BYTES per sid as well
+    as sid COUNT, and an evicted sid runs its native close (StreamClose
+    tolerates unknown ids, so fake sids exercise exactly the eviction
+    path) instead of stranding the peer's close handshake."""
+    import ctypes
+
+    payload = ctypes.create_string_buffer(b"x" * 65536, 65536)
+    ptr = ctypes.cast(payload, ctypes.c_void_p)
+    fat = (1 << 62) + 12345          # never a real native sid
+    n = rpc._STREAM_ORPHAN_BYTES // 65536 + 2
+    for _ in range(n):
+        rpc._stream_dispatch(None, fat, ptr, 65536, 0)
+    with rpc._stream_mu:
+        # the firehose sid keeps getting evicted: whatever remains
+        # buffered stays under the per-sid byte bound at all times
+        entry = rpc._stream_orphans.pop(fat, None)
+        assert entry is None or entry[0] <= rpc._STREAM_ORPHAN_BYTES
+    base = (1 << 62) + 20000
+    extra = 8
+    for i in range(rpc._STREAM_ORPHAN_SIDS + extra):
+        rpc._stream_dispatch(None, base + i, ptr, 16, 0)
+    try:
+        with rpc._stream_mu:
+            assert len(rpc._stream_orphans) <= rpc._STREAM_ORPHAN_SIDS
+            # the newest sids survived; the oldest were dropped
+            assert base + rpc._STREAM_ORPHAN_SIDS + extra - 1 \
+                in rpc._stream_orphans
+    finally:
+        with rpc._stream_mu:
+            for i in range(rpc._STREAM_ORPHAN_SIDS + extra):
+                rpc._stream_orphans.pop(base + i, None)

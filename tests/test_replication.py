@@ -516,6 +516,150 @@ def test_push_gradients_dedups_across_reconnect():
 
 
 # ---------------------------------------------------------------------------
+# zombie fencing + window inheritance + lossy-promotion refusal
+# ---------------------------------------------------------------------------
+
+def test_zombie_primary_push_stream_fenced_no_lost_acks():
+    """A primary demoted WHILE carrying a push stream must not keep
+    applying frames into a table the new primary's Sync will erase: the
+    per-frame fence drops them, the flush barrier detects the applied-
+    window shortfall on the live primary, replays the unacked tail onto
+    it, and only then acks — exact arithmetic proves every pushed delta
+    landed exactly once."""
+    servers, sets = _cluster(nshards=1, nrep=2, stream=True, lr=1.0)
+    old, new = servers[0][0], servers[0][1]
+    before = old.table.copy()
+    emb = RemoteEmbedding(sets, VOCAB, DIM, timeout_ms=10000,
+                          retry=_retry_policy(attempts=4))
+    ids = np.arange(VOCAB, dtype=np.int32)
+    delta = np.full((VOCAB, DIM), 0.5, np.float32)
+    try:
+        emb.push_gradients(ids, delta)
+        emb.flush_gradients()            # frame 1 acked everywhere
+        # Out-of-band promotion: the old primary still holds the
+        # client's push stream and may not know it is a zombie yet.
+        ch = rpc.Channel(new.address, timeout_ms=5000)
+        try:
+            ch.call("Ps", "Promote", struct.pack("<q", 1))
+        finally:
+            ch.close()
+        emb.push_gradients(ids, delta)   # frames 2..3 race the fence
+        emb.push_gradients(ids, delta)
+        emb.flush_gradients()            # must fail over + replay
+        expect = before.copy()
+        for _ in range(3):
+            expect[ids] -= np.float32(0.5)
+        assert np.array_equal(new.table, expect)
+        assert emb._primary_idx[0] == 1
+    finally:
+        emb.close()
+        _close_all(servers)
+
+
+def test_seq_window_survives_failover_no_double_apply():
+    """The per-writer dedup window is replicated WITH the batches it
+    covers: after an out-of-band promotion the backup's inherited
+    window already spans both unflushed frames, so the client's flush
+    barrier confirms without resending — no double apply, no replay."""
+    servers, sets = _cluster(nshards=1, nrep=2, stream=True, lr=1.0)
+    prim, backup = servers[0][0], servers[0][1]
+    before = prim.table.copy()
+    emb = RemoteEmbedding(sets, VOCAB, DIM, timeout_ms=10000,
+                          retry=_retry_policy())
+    ids = np.arange(16, dtype=np.int32)
+    delta = np.full((16, DIM), 0.25, np.float32)
+    try:
+        emb.push_gradients(ids, delta)
+        emb.push_gradients(ids, delta)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                backup._writer_applied.get(emb._writer_id, 0) < 2:
+            time.sleep(0.01)
+        assert backup._writer_applied.get(emb._writer_id, 0) == 2
+        assert backup._writer_seqs.get(emb._writer_id, 0) == 2
+        ch = rpc.Channel(backup.address, timeout_ms=5000)
+        try:
+            ch.call("Ps", "Promote", struct.pack("<q", 1))
+        finally:
+            ch.close()
+        replays0 = int(obs.counter("ps_push_replays").get_value())
+        emb.flush_gradients()
+        assert int(obs.counter("ps_push_replays").get_value()) \
+            == replays0
+        expect = before.copy()
+        expect[ids] -= np.float32(0.25)
+        expect[ids] -= np.float32(0.25)
+        assert np.array_equal(backup.table, expect)
+    finally:
+        emb.close()
+        _close_all(servers)
+
+
+def test_failover_refuses_gen_behind_promotion():
+    """Single-fault loss window closed client-side: writes acked by the
+    primary alone (backup partitioned from replication) raise the
+    client's acked-gen floor; when the primary then dies, promoting the
+    gen-behind backup would lose those acks — the failover REFUSES
+    loudly instead of promoting silently."""
+    servers = [[PsShardServer(VOCAB, DIM, 0, 1, lr=1.0)
+                for _ in range(2)]]
+    prim, backup = servers[0][0], servers[0][1]
+    rs = ReplicaSet((prim.address, backup.address), primary=0)
+    # Partition the backup's replication plane BEFORE the replica set
+    # is configured, so the primary acks every write alone.
+    fault.install(fault.FaultPlan([
+        fault.FaultRule(action="error", side="server", service="Ps",
+                        method="Sync", endpoint=backup.address,
+                        error_code=1009),
+        fault.FaultRule(action="error", side="server", service="Ps",
+                        method="ReplicaApply", endpoint=backup.address,
+                        error_code=1009)], seed=7))
+    prim.configure_replication(rs, 0)
+    backup.configure_replication(rs, 1)
+    emb = RemoteEmbedding(
+        [rs], VOCAB, DIM, timeout_ms=2000, retry=_retry_policy(),
+        breakers=resilience.BreakerRegistry(
+            resilience.BreakerOptions(short_window=4, min_samples=2,
+                                      min_isolation_ms=50),
+            redirect=True))
+    ids = np.arange(8, dtype=np.int32)
+    grads = np.ones((8, DIM), np.float32)
+    try:
+        for _ in range(3):
+            emb.apply_gradients(ids, grads)
+        assert emb._gen_seen[0] >= 1
+        assert backup._install_gen == 0
+        # primary dies with the backup still partitioned: the only
+        # candidate is gen-behind
+        fault.install(fault.FaultPlan(
+            list(fault.kill_rules(prim.address)) + [
+                fault.FaultRule(action="error", side="server",
+                                service="Ps", method="Sync",
+                                endpoint=backup.address,
+                                error_code=1009),
+                fault.FaultRule(action="error", side="server",
+                                service="Ps", method="ReplicaApply",
+                                endpoint=backup.address,
+                                error_code=1009)], seed=7))
+        refusal = None
+        for _ in range(40):
+            try:
+                emb.apply_gradients(ids, grads)
+            except rpc.RpcError as e:
+                if e.code == resilience.EBREAKEROPEN and \
+                        "refusing" in str(e):
+                    refusal = e
+                    break
+        assert refusal is not None
+        assert backup._install_gen == 0      # never lossily promoted
+        assert not backup.is_primary
+    finally:
+        fault.clear()
+        emb.close()
+        _close_all(servers)
+
+
+# ---------------------------------------------------------------------------
 # concurrent retry re-fan (satellite: max(shard), not sum)
 # ---------------------------------------------------------------------------
 
