@@ -101,6 +101,16 @@ def run_ps_write_bench(deadline_s: int = 420) -> dict:
     return out.get("write", out)
 
 
+def run_reshard_bench(deadline_s: int = 300) -> dict:
+    """Elastic-resharding numbers (bench_reshard.py child): a live 4→8
+    shard split under sustained lookup+push load — zero failed
+    lookups, bounded p99 through the migration window, post-split
+    throughput over pre-split, the exact zero-lost-acked-updates
+    ledger, and the retirement handle-release proof (also refreshes
+    BENCH_reshard.json)."""
+    return _run_json_child("bench_reshard.py", "reshard", deadline_s)
+
+
 def run_fault_bench(deadline_s: int = 300) -> dict:
     """Fault-tolerance numbers (bench_fault.py child): backup-request
     p99 bounding under an injected slow shard, breaker availability and
@@ -260,6 +270,10 @@ def main() -> int:
         # under injected faults (bench_fault.py child).
         fault_block = run_fault_bench()
 
+        # Elastic resharding (ISSUE 10): live 4→8 split under traffic
+        # (bench_reshard.py child).
+        reshard_block = run_reshard_bench()
+
         gbps = best["gbps"]
         print(json.dumps({
             "metric": "same_host_echo_throughput",
@@ -282,6 +296,7 @@ def main() -> int:
             "ps": ps_block,
             "ps_write": ps_write_block,
             "fault": fault_block,
+            "reshard": reshard_block,
             **device_blocks,
         }))
         return 0

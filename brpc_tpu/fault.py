@@ -58,7 +58,7 @@ from brpc_tpu.resilience import _hash01, sleep_ms
 __all__ = [
     "FaultRule", "FaultPlan", "install", "install_from_env", "clear",
     "current", "active", "server_intercept", "server_drop_intercept",
-    "client_intercept", "kill_rules", "FAULTS_ENV",
+    "client_intercept", "kill_rules", "partition_rules", "FAULTS_ENV",
 ]
 
 FAULTS_ENV = "BRPC_TPU_FAULTS"
@@ -133,6 +133,40 @@ def kill_rules(*endpoints: str, code: int = 1009,
                 action="error", side=side, endpoint=ep,
                 error_code=code, error_text=f"{text} ({ep})",
                 probability=probability, max_hits=max_hits))
+    return rules
+
+
+#: the state-propagation control/data plane between servers: replication
+#: sync + delta streams and migration sync + delta streams.  Severing
+#: exactly these (and nothing else) is how tests create a server that
+#: SERVES clients but cannot receive peer state — the control-plane
+#: partition behind stale-primary and mid-migration failure scenarios.
+PROPAGATION_METHODS = ("Sync", "ReplicaApply", "MigrateSync",
+                       "MigrateApply")
+
+
+def partition_rules(*endpoints: str, code: int = 1009,
+                    methods: Tuple[str, ...] = PROPAGATION_METHODS,
+                    max_hits: Optional[int] = None) -> "List[FaultRule]":
+    """Rules that sever ``endpoints``' replication/migration
+    PROPAGATION plane only: Sync/ReplicaApply (replication) and
+    MigrateSync/MigrateApply (resharding handoff) fail — on BOTH sides,
+    like :func:`kill_rules`, because a server-only rule is silently
+    absorbed by the native channel's transparent retry (max_retry
+    attempts per call each consume one hit) — while client data
+    traffic still flows: the deterministic "partitioned but serving"
+    lever (a stale primary that cannot be informed; a migration
+    destination the source cannot reach mid-stream).  With the
+    client-side rule, ``max_hits`` counts logical peer calls."""
+    rules: List[FaultRule] = []
+    for ep in endpoints:
+        for method in methods:
+            for side in _SIDES:
+                rules.append(FaultRule(
+                    action="error", side=side, service="Ps",
+                    method=method, endpoint=ep, error_code=code,
+                    error_text=f"injected partition ({ep} {method})",
+                    max_hits=max_hits))
     return rules
 
 

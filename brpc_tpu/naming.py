@@ -13,6 +13,20 @@ HTTP (the restful bridge, cpp/rpc/json.h) so no binary codec is needed:
 
 `RemoteEmbedding.from_registry` (ps_remote.py) builds the PS shard list
 from a cluster, ordered by registration tag "<shard>/<num_shards>".
+
+Two higher-level records also live in the same registry namespace:
+
+- :class:`PartitionScheme` — a VERSIONED partitioning of the table
+  (shard count + row-range map + replica sets + capacity weight +
+  lifecycle state), published as one registry node per scheme
+  (``addr="scheme#<version>"``, JSON tag).  Multiple schemes coexist
+  during a live reshard (the DynamicPartitionChannel contract, SURVEY
+  §2.7): clients weight read traffic across them and the migration
+  driver walks a scheme through active → draining → retired.
+- primary/epoch CLAIMS — shard tags may carry an ``@e<epoch>P|B``
+  suffix refreshed per heartbeat (``register(tag_fn=...)``), so
+  failover state converges from one shared view instead of every
+  client re-sweeping replicas (see ``parse_claim_tag``).
 """
 
 from __future__ import annotations
@@ -21,7 +35,7 @@ import dataclasses
 import http.client
 import json
 import threading
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,19 +73,28 @@ class ReplicaSet:
         return cls(tuple(str(a) for a in addrs))
 
 
-def shard_tag(shard: int, num_shards: int, replica: int = 0) -> str:
+def shard_tag(shard: int, num_shards: int, replica: int = 0, *,
+              epoch: Optional[int] = None,
+              primary: Optional[bool] = None) -> str:
     """Registration tag for shard ``shard`` of ``num_shards``: replica 0
     keeps the legacy two-field form so pre-replication registrants and
-    resolvers interoperate."""
-    if replica == 0:
-        return f"{shard}/{num_shards}"
-    return f"{shard}/{num_shards}/{replica}"
+    resolvers interoperate.  ``epoch``/``primary`` append a CLAIM suffix
+    (``@e<epoch>P`` or ``@e<epoch>B``) — the server's current failover
+    state, refreshed per heartbeat via ``register(tag_fn=...)`` so
+    clients can adopt the claimed primary without sweeping replicas."""
+    base = f"{shard}/{num_shards}" if replica == 0 \
+        else f"{shard}/{num_shards}/{replica}"
+    if epoch is None:
+        return base
+    return f"{base}@e{epoch}{'P' if primary else 'B'}"
 
 
 def parse_shard_tag(tag: str) -> Optional[Tuple[int, int, int]]:
     """``(shard, num_shards, replica)`` from a registration tag, or
-    ``None`` for tags that are not shard tags."""
-    parts = tag.split("/")
+    ``None`` for tags that are not shard tags.  A claim suffix
+    (``@e<epoch>P|B``) is tolerated and stripped — claim-carrying
+    heartbeats stay visible to claim-unaware resolvers."""
+    parts = tag.split("@", 1)[0].split("/")
     if len(parts) not in (2, 3):
         return None
     try:
@@ -82,6 +105,178 @@ def parse_shard_tag(tag: str) -> Optional[Tuple[int, int, int]]:
     if replica < 0:
         return None
     return shard, num, replica
+
+
+def parse_claim_tag(tag: str
+                    ) -> Optional[Tuple[int, int, int, int, bool]]:
+    """``(shard, num_shards, replica, epoch, is_primary)`` from a
+    claim-suffixed shard tag, or ``None`` when the tag carries no claim
+    (plain shard tags parse with :func:`parse_shard_tag`)."""
+    base = parse_shard_tag(tag)
+    if base is None or "@" not in tag:
+        return None
+    suffix = tag.split("@", 1)[1]
+    if not suffix.startswith("e") or suffix[-1] not in ("P", "B"):
+        return None
+    try:
+        epoch = int(suffix[1:-1])
+    except ValueError:
+        return None
+    return base[0], base[1], base[2], epoch, suffix[-1] == "P"
+
+
+#: lifecycle states a published scheme moves through: ``preparing``
+#: (published at copy start — its shards still import; a fallback
+#: route, never the weighted pick or the write owner), ``active``
+#: (serves reads and — the newest active — owns writes), ``draining``
+#: (reads only while its traffic weight decays), ``retired`` (must not
+#: be routed to at all; its servers may already be gone).
+SCHEME_STATES = ("preparing", "active", "draining", "retired")
+
+#: scheme records are registry nodes too, but the native registry
+#: validates ``addr`` as a real endpoint — so a scheme registers under
+#: the reserved address ``0.0.0.0:<version>`` (never a routable server)
+#: and is recognized by its TAG prefix; the JSON payload rides the tag.
+SCHEME_TAG_PREFIX = "scheme!"
+
+
+def scheme_record_addr(version: int) -> str:
+    if not 0 <= version < 65536:
+        raise ValueError(
+            f"scheme version {version} outside the registry-encodable "
+            f"range [0, 65536)")
+    return f"0.0.0.0:{version}"
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionScheme:
+    """One VERSIONED partitioning of a table: the row-range map, the
+    replica group serving each range, and how much read traffic the
+    scheme should carry (the reference DynamicPartitionChannel keeps
+    multiple partitioning schemes alive simultaneously and weights
+    traffic by capacity, partition_channel.h:136 /
+    dynpart_load_balancer.cpp — this is that object made first-class
+    and published through the naming registry).
+
+    ``bounds`` is the explicit row-range map (``bounds[s] <= id <
+    bounds[s+1]`` owns shard ``s``); ``None`` means uniform ranges over
+    the consumer's vocab.  ``weight`` is the scheme's capacity share of
+    READ traffic (writes always go to the newest active scheme).
+    """
+
+    version: int
+    replica_sets: Tuple[ReplicaSet, ...]
+    weight: float = 1.0
+    state: str = "active"
+    bounds: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if self.version < 0:
+            raise ValueError(f"scheme version {self.version} < 0")
+        if not self.replica_sets:
+            raise ValueError("a scheme needs at least one shard")
+        object.__setattr__(self, "replica_sets", tuple(
+            ReplicaSet.of(rs) for rs in self.replica_sets))
+        if self.weight < 0:
+            raise ValueError(f"scheme weight {self.weight} < 0")
+        if self.state not in SCHEME_STATES:
+            raise ValueError(f"unknown scheme state {self.state!r}; "
+                             f"valid: {', '.join(SCHEME_STATES)}")
+        if self.bounds is not None:
+            b = tuple(int(x) for x in self.bounds)
+            if len(b) != len(self.replica_sets) + 1 or b[0] != 0 or \
+                    any(b[i] >= b[i + 1] for i in range(len(b) - 1)):
+                raise ValueError(
+                    f"bounds {b} must be strictly increasing, start at "
+                    f"0, and have num_shards+1 entries")
+            object.__setattr__(self, "bounds", b)
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.replica_sets)
+
+    def shard_bounds(self, s: int, vocab: int) -> Tuple[int, int]:
+        """``[lo, hi)`` row range of shard ``s`` under this scheme."""
+        if self.bounds is not None:
+            return self.bounds[s], self.bounds[s + 1]
+        rows_per = vocab // self.num_shards
+        return s * rows_per, (s + 1) * rows_per
+
+    def with_(self, **changes) -> "PartitionScheme":
+        """A copy with ``changes`` applied (weight/state transitions)."""
+        return dataclasses.replace(self, **changes)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": self.version,
+            "replica_sets": [
+                {"addresses": list(rs.addresses), "primary": rs.primary}
+                for rs in self.replica_sets],
+            "weight": self.weight,
+            "state": self.state,
+            "bounds": list(self.bounds) if self.bounds else None,
+        })
+
+    @classmethod
+    def from_json(cls, text: str) -> "PartitionScheme":
+        d = json.loads(text)
+        return cls(
+            version=int(d["version"]),
+            replica_sets=tuple(
+                ReplicaSet(tuple(rs["addresses"]),
+                           primary=int(rs.get("primary", 0)))
+                for rs in d["replica_sets"]),
+            weight=float(d.get("weight", 1.0)),
+            state=d.get("state", "active"),
+            bounds=tuple(d["bounds"]) if d.get("bounds") else None)
+
+
+def publish_scheme(client: "NamingClient", cluster: str,
+                   scheme: PartitionScheme) -> int:
+    """Publishes (or re-publishes — weight/state updates re-register the
+    same node) ``scheme`` into ``cluster``.  Returns the new registry
+    version; watchers holding the old version wake immediately."""
+    return client.register(
+        cluster, scheme_record_addr(scheme.version),
+        tag=SCHEME_TAG_PREFIX + scheme.to_json(), heartbeat=False)
+
+
+def parse_schemes(nodes: Sequence[dict]) -> Dict[int, PartitionScheme]:
+    """Every scheme record in a cluster listing, by version (the LAST
+    occurrence of a version wins — registration order is publication
+    order, so re-published weight/state transitions supersede)."""
+    out: Dict[int, PartitionScheme] = {}
+    for n in nodes:
+        tag = n.get("tag", "")
+        if not tag.startswith(SCHEME_TAG_PREFIX):
+            continue
+        try:
+            scheme = PartitionScheme.from_json(
+                tag[len(SCHEME_TAG_PREFIX):])
+        except (ValueError, KeyError, TypeError):
+            continue
+        out[scheme.version] = scheme
+    return out
+
+
+def parse_claims(nodes: Sequence[dict]
+                 ) -> Dict[Tuple[int, int], Tuple[int, str]]:
+    """Primary claims from claim-suffixed shard tags:
+    ``{(num_shards, shard): (epoch, addr)}`` keeping the highest epoch
+    per shard.  Only PRIMARY claims are returned — a backup's claim
+    says who it is, not who owns the range."""
+    out: Dict[Tuple[int, int], Tuple[int, str]] = {}
+    for n in nodes:
+        parsed = parse_claim_tag(n.get("tag", ""))
+        if parsed is None:
+            continue
+        shard, num, _replica, epoch, is_primary = parsed
+        if not is_primary:
+            continue
+        key = (num, shard)
+        if key not in out or epoch >= out[key][0]:
+            out[key] = (epoch, n["addr"])
+    return out
 
 
 class NamingClient:
@@ -147,28 +342,38 @@ class NamingClient:
 
     def register(self, cluster: str, addr: str, weight: int = 1,
                  tag: str = "", ttl_ms: int = 0,
-                 heartbeat: bool = True) -> int:
+                 heartbeat: bool = True, tag_fn=None) -> int:
         """Registers addr in cluster; with a TTL and heartbeat=True a
-        daemon thread renews at ttl/3 until close()."""
+        daemon thread renews at ttl/3 until close().  ``tag_fn`` (a
+        callable returning the CURRENT tag) is re-evaluated on every
+        heartbeat, so registrants can publish live state — a PS
+        replica's primary/epoch claim rides its shard tag this way
+        (see :func:`parse_claim_tag`)."""
         if self._stop.is_set():
             raise RuntimeError("NamingClient is closed")
         req = {"cluster": cluster, "addr": addr, "weight": weight}
-        if tag:
+        if tag_fn is not None:
+            req["tag"] = str(tag_fn())
+        elif tag:
             req["tag"] = tag
         if ttl_ms > 0:
             req["ttl_ms"] = ttl_ms
         version = int(self._call("Register", req).get("version", 0))
         if ttl_ms > 0 and heartbeat:
             t = threading.Thread(
-                target=self._heartbeat_loop, args=(dict(req), ttl_ms / 3000.0),
+                target=self._heartbeat_loop,
+                args=(dict(req), ttl_ms / 3000.0, tag_fn),
                 daemon=True)
             t.start()
             self._heartbeats.append(t)
         return version
 
-    def _heartbeat_loop(self, req: dict, period_s: float) -> None:
+    def _heartbeat_loop(self, req: dict, period_s: float,
+                        tag_fn=None) -> None:
         while not self._stop.wait(period_s):
             try:
+                if tag_fn is not None:
+                    req["tag"] = str(tag_fn())
                 self._call("Register", req)
             except Exception:  # noqa: BLE001 — registry outage: keep trying
                 pass

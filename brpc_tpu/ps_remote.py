@@ -43,7 +43,8 @@ import numpy as np
 
 from brpc_tpu import obs, resilience, rpc
 from brpc_tpu.analysis.race import checked_lock, checked_rwlock
-from brpc_tpu.naming import ReplicaSet, parse_shard_tag
+from brpc_tpu.naming import (PartitionScheme, ReplicaSet, parse_claims,
+                             parse_schemes, parse_shard_tag)
 
 
 def _record_ps_server(shard_index: int, method: str, count: int,
@@ -144,6 +145,60 @@ def _unpack_windows(payload, offset: int = 0):
         offset += 8
         windows[w] = seq
     return windows, offset
+
+
+def _pack_apply_id_req(writer: str, seq: int, guards, owned: np.ndarray,
+                       grads: np.ndarray) -> bytearray:
+    """Frame an ``ApplyGradId`` request: the idempotent unary write.
+    Header = writer key + per-(writer, shard) monotonic seq (the same
+    high-water machinery as the framed push — a timed-out-but-applied
+    attempt that retries is dropped server-side) + optional GUARDS:
+    each names a superseded frame ``(key, seq)`` from a retired
+    partition scheme that fully contained this delta — if the server's
+    inherited applied window already covers a guard, the delta migrated
+    here with the old shard's data and must not apply twice."""
+    wb = writer.encode()
+    guards = list(guards or ())
+    gsz = sum(4 + len(k.encode()) + 8 for k, _ in guards)
+    body = _pack_apply_req(owned, grads)
+    req = bytearray(4 + len(wb) + 8 + 4 + gsz + len(body))
+    struct.pack_into("<i", req, 0, len(wb))
+    off = 4
+    req[off:off + len(wb)] = wb
+    off += len(wb)
+    struct.pack_into("<qi", req, off, seq, len(guards))
+    off += 12
+    for k, q in guards:
+        kb = k.encode()
+        struct.pack_into("<i", req, off, len(kb))
+        off += 4
+        req[off:off + len(kb)] = kb
+        off += len(kb)
+        struct.pack_into("<q", req, off, q)
+        off += 8
+    req[off:] = body
+    return req
+
+
+def _unpack_apply_id(payload):
+    """Inverse of :func:`_pack_apply_id_req`: returns
+    ``(writer, seq, guards, apply_body)``."""
+    (wlen,) = struct.unpack_from("<i", payload, 0)
+    off = 4
+    writer = bytes(payload[off:off + wlen]).decode(errors="replace")
+    off += wlen
+    seq, nguards = struct.unpack_from("<qi", payload, off)
+    off += 12
+    guards = []
+    for _ in range(nguards):
+        (klen,) = struct.unpack_from("<i", payload, off)
+        off += 4
+        key = bytes(payload[off:off + klen]).decode(errors="replace")
+        off += klen
+        (q,) = struct.unpack_from("<q", payload, off)
+        off += 8
+        guards.append((key, q))
+    return writer, seq, guards, memoryview(payload)[off:]
 
 
 def _unpack_apply(payload: bytes, base: int, rows_per: int, dim: int):
@@ -332,15 +387,19 @@ class _ApplyStreamReceiver:
 
     def _fence(self) -> None:
         """Mark this stream fenced and tell the client: a negative ack
-        frame, then break the stream so the next write fails over."""
+        frame (-1 = replica demotion, -2 = the partition scheme was
+        retired by a cutover), then break the stream so the next write
+        fails over / refreshes its scheme."""
         if self._fenced:
             return
         self._fenced = True
         if obs.enabled():
             obs.counter("ps_stream_fenced").add(1)
         if self.reply is not None:
+            code = -2 if getattr(self._server, "_scheme_fenced", False) \
+                else -1
             try:
-                self.reply.write(struct.pack("<q", -1))
+                self.reply.write(struct.pack("<q", code))
             except rpc.RpcError:
                 pass   # client gone; its reconnect pays ENOTPRIMARY
             self.reply.close()
@@ -438,6 +497,44 @@ class _ReplicaAckReceiver:
 
     def on_closed(self) -> None:
         self._replicator._note_closed(self._addr)
+
+
+class _MigrateStreamReceiver:
+    """Import half of a live reshard on the DESTINATION shard: each
+    frame is one source-shard applied batch FILTERED to this shard's
+    row range (global ids; the ``ReplicaApply`` framing with the
+    source's generation in the header), applied in arrival order —
+    the stream is ordered and this receiver serialized, so per source
+    the destination replays the source's exact float ops on the
+    migrated rows.  Every processed frame acks the source-generation
+    watermark back on the reply half (what the source's cutover flush
+    waits on); a frame arriving after the import completed is refused
+    (``None``) and the stream breaks — the source's resync attempt
+    then fails loudly with ESCHEMEMOVED instead of silently diverging."""
+
+    __slots__ = ("_server", "_src", "reply")
+
+    def __init__(self, server, src: str):
+        self._server = server
+        self._src = src
+        self.reply: "Optional[rpc.Stream]" = None
+
+    def on_data(self, data: bytes) -> None:
+        gen, _scheme, _gen2 = _FRAME_HDR.unpack_from(data, 0)
+        acked = self._server._apply_migrate_frame(
+            self._src, gen, memoryview(data)[_FRAME_HDR.size:])
+        if acked is None:
+            if self.reply is not None:
+                self.reply.close()
+            return
+        if self.reply is not None:
+            try:
+                self.reply.write(struct.pack("<q", acked))
+            except rpc.RpcError:
+                pass  # source gone; its reconnect re-syncs the range
+
+    def on_closed(self) -> None:
+        pass
 
 
 class _PeerState:
@@ -744,10 +841,12 @@ class PsShardServer:
     def __init__(self, vocab: int, dim: int, shard_index: int,
                  num_shards: int, lr: float = 0.1, seed: int = 0,
                  lock_mode: str = "rw", native_read: bool = False,
-                 combine: bool = False, stream: bool = False):
+                 combine: bool = False, stream: bool = False,
+                 importing: bool = False, scheme_version: int = 0):
         if vocab % num_shards:
             raise ValueError("num_shards must divide vocab")
         self.shard_index = shard_index
+        self.num_shards = num_shards
         self.rows_per = vocab // num_shards
         self.base = shard_index * self.rows_per
         self.dim = dim
@@ -782,6 +881,24 @@ class PsShardServer:
         self._replica_index = 0
         self._replicator: Optional[_Replicator] = None
         self._repl_mu = checked_lock("ps.repl_state")
+        # Elastic-resharding state: which partition scheme this shard
+        # belongs to, whether it is still IMPORTING its row range (a
+        # split/merge destination before cutover — data paths answer
+        # EMIGRATING until CompleteImport), whether its scheme was
+        # retired by a fenced cutover (writes answer ESCHEMEMOVED — the
+        # redirect that drives client scheme refresh), and the
+        # primary-side migration shipper streaming this shard's rows to
+        # the successor scheme (brpc_tpu.reshard.MigrationShipper).
+        self.scheme_version = int(scheme_version)
+        self._importing = bool(importing)
+        self._scheme_fenced = False
+        self._next_scheme: Optional[int] = None
+        self._migrator = None
+        #: per-source migration watermark: the source shard's generation
+        #: covered by this import so far (guarded by the table WRITE
+        #: lock — every mutation happens inside an apply/sync install)
+        self._import_gens: Dict[str, int] = {}
+        self._read_count = 0
         #: how long a replicated apply waits for backup acks before
         #: failing the write (sync replication among reachable replicas)
         self.repl_ack_timeout_s = 5.0
@@ -810,7 +927,13 @@ class PsShardServer:
         # client-facing StreamApply mode is on.
         if self.native_read:
             self._shard = rpc.PsShard(vocab, dim, shard_index, num_shards)
-            self._shard.install(self.table, 0)
+            if not self._importing:
+                self._shard.install(self.table, 0)
+            # An IMPORTING destination defers its first install to
+            # CompleteImport: until then the native handler answers
+            # Lookup with "no table generation installed" (EINTERNAL) —
+            # never unmigrated garbage — and scheme-aware clients fall
+            # back to the source scheme.
             self.server.add_ps_service(
                 "Ps", self._shard, self._handle_stream, stream=True)
         else:
@@ -920,8 +1043,48 @@ class PsShardServer:
 
     def _stream_write_fenced(self) -> bool:
         """True when streamed writes must be refused: this replica was
-        demoted (or never was primary) while carrying a push stream."""
-        return self._replica_set is not None and not self._primary_flag
+        demoted (or never was primary) while carrying a push stream, or
+        its partition scheme was retired by a cutover."""
+        return self._scheme_fenced or (
+            self._replica_set is not None and not self._primary_flag)
+
+    def _check_scheme(self) -> None:
+        """Scheme gate for the WRITE paths (+ the importing half for
+        reads): a cutover-fenced shard redirects writers to the
+        successor scheme; an importing destination asks callers to wait
+        out (writes) or fall back across schemes (reads)."""
+        if self._scheme_fenced:
+            nxt = f" (successor scheme v{self._next_scheme})" \
+                if self._next_scheme is not None else ""
+            raise rpc.RpcError(
+                resilience.ESCHEMEMOVED,
+                f"shard {self.shard_index} scheme "
+                f"v{self.scheme_version} was retired by a fenced "
+                f"cutover{nxt}; refresh the partition scheme")
+        if self._importing:
+            raise rpc.RpcError(
+                resilience.EMIGRATING,
+                f"shard {self.shard_index} scheme "
+                f"v{self.scheme_version} is still importing rows "
+                f"[{self.base}, {self.base + self.rows_per})")
+
+    def claim_tag(self) -> str:
+        """This replica's shard tag WITH its live primary/epoch claim —
+        pass as ``tag_fn=`` to :meth:`naming.NamingClient.register` so
+        every heartbeat publishes failover state into the registry
+        (clients adopt the claimed primary instead of sweeping)."""
+        from brpc_tpu import naming
+        return naming.shard_tag(self.shard_index, self.num_shards,
+                                self._replica_index, epoch=self._epoch,
+                                primary=self._primary_flag)
+
+    def _reads(self) -> int:
+        """Total reads ever served (Python + native path) — the drain
+        signal: a retiring scheme's shards are idle once this stops
+        moving."""
+        with self._seq_mu:
+            n = self._read_count
+        return n + self.native_lookups
 
     def _replication_snapshot(self):
         """Consistent ``(epoch, gen, table bytes, applied windows)`` for
@@ -948,6 +1111,56 @@ class PsShardServer:
         with self._mu.read():
             target = self._install_gen
         rep.flush(target, timeout_s)
+
+    def _migration_snapshot(self, row0: int, count: int):
+        """Consistent ``(gen, rows bytes, applied windows)`` for one
+        destination's row-range handoff: the read lock pins the triple
+        together (the PR-4/PR-6 generation-pinning discipline — the
+        shipped rows are exactly the table at ``gen`` and the windows
+        cover exactly the frames applied by then)."""
+        lo = row0 - self.base
+        if lo < 0 or row0 + count > self.base + self.rows_per:
+            raise ValueError(
+                f"migration range [{row0}, {row0 + count}) outside "
+                f"shard [{self.base}, {self.base + self.rows_per})")
+        with self._mu.read():
+            with self._seq_mu:
+                windows = dict(self._writer_applied)
+            return (self._install_gen,
+                    self.table[lo:lo + count].tobytes(), windows)
+
+    def _apply_migrate_frame(self, src: str, gen: int,
+                             body) -> Optional[int]:
+        """One source-shard batch (filtered to this shard's range)
+        during import: applied in arrival order, deduped by the
+        per-source generation watermark (a resync replays from its
+        sync point; anything at or below the watermark is already
+        here).  Returns the watermark to ack, or ``None`` once the
+        import has completed — late frames must break the stream, not
+        mutate a live table."""
+        windows, off = _unpack_windows(body)
+        ids, grads = _unpack_apply(memoryview(body)[off:], self.base,
+                                   self.rows_per, self.dim)
+        with self._mu.write():
+            if not self._importing:
+                return None
+            last = self._import_gens.get(src, -1)
+            if gen <= last:
+                return last   # duplicate after resync: ack, don't apply
+            if ids.size:
+                np.subtract.at(self.table, ids, self.lr * grads)
+                self._install_gen += 1
+            self._import_gens[src] = gen
+            if windows:
+                with self._seq_mu:
+                    for w, q in windows.items():
+                        if q > self._writer_seqs.get(w, 0):
+                            self._writer_seqs[w] = q
+                        if q > self._writer_applied.get(w, 0):
+                            self._writer_applied[w] = q
+            if obs.enabled():
+                obs.counter("ps_migrate_frames_in").add(1)
+            return gen
 
     def _reserve_seq(self, writer: str, seq: int) -> bool:
         """True exactly once per (writer, seq): the server-side dedup
@@ -1000,15 +1213,24 @@ class PsShardServer:
 
     # -- request handling --------------------------------------------------
 
+    @staticmethod
+    def _payload_keys(method: str, payload: bytes) -> int:
+        """Key count of one data-path request (0 for control traffic)."""
+        if method in ("Lookup", "ApplyGrad"):
+            return struct.unpack_from("<i", payload, 0)[0]
+        if method == "ApplyGradId":
+            body = _unpack_apply_id(payload)[3]
+            return struct.unpack_from("<i", body, 0)[0]
+        return 0
+
     def _handle(self, method: str, payload: bytes) -> bytes:
         if not obs.enabled():
             return self._serve(method, payload)
         t0 = time.monotonic_ns()
         rsp = self._serve(method, payload)
-        count = struct.unpack_from("<i", payload, 0)[0] \
-            if method in ("Lookup", "ApplyGrad") else 0
-        _record_ps_server(self.shard_index, method, count, len(payload),
-                          len(rsp), t0)
+        _record_ps_server(self.shard_index, method,
+                          self._payload_keys(method, payload),
+                          len(payload), len(rsp), t0)
         return rsp
 
     def _handle_stream(self, method: str, payload: bytes, accept) -> bytes:
@@ -1023,6 +1245,7 @@ class PsShardServer:
             if not self.stream:
                 raise ValueError(f"unknown method {method}")
             self._check_primary()
+            self._check_scheme()
             writer = payload.decode(errors="replace") if payload else ""
             recv = _ApplyStreamReceiver(self, writer)
             # The reply half carries the fence notification (a demotion
@@ -1034,6 +1257,22 @@ class PsShardServer:
                     last = self._writer_seqs.get(writer, 0)
                 return struct.pack("<q", last)
             return b""
+        if method == "MigrateApply":
+            # A migration source binds its delta stream to this
+            # importing destination; the setup answers the per-source
+            # watermark so a resync can skip already-covered frames.
+            (alen,) = struct.unpack_from("<i", payload, 8)
+            src = bytes(payload[12:12 + alen]).decode(errors="replace")
+            with self._mu.read():
+                if not self._importing:
+                    raise rpc.RpcError(
+                        resilience.ESCHEMEMOVED,
+                        f"shard {self.shard_index} completed its "
+                        f"import; late migration streams are refused")
+                last = self._import_gens.get(src, -1)
+            recv = _MigrateStreamReceiver(self, src)
+            recv.reply = accept(recv)
+            return struct.pack("<q", last)
         if method == "ReplicaApply":
             (epoch,) = struct.unpack_from("<q", payload, 0)
             self._check_repl_epoch(epoch)
@@ -1080,6 +1319,18 @@ class PsShardServer:
             if m[1] > updates.get(m[0], 0):
                 updates[m[0]] = m[1]
         with self._mu.write():
+            # Re-checked INSIDE the write lock: SchemeFence reads its
+            # final generation under this lock after setting the flag,
+            # so an apply that raced the fence either finished (its gen
+            # is covered by the cutover flush) or refuses here and the
+            # caller re-routes — an acked-but-unmigrated write cannot
+            # exist.
+            if self._scheme_fenced:
+                raise rpc.RpcError(
+                    resilience.ESCHEMEMOVED,
+                    f"shard {self.shard_index} scheme "
+                    f"v{self.scheme_version} was fenced mid-apply; "
+                    f"refusing the write")
             np.subtract.at(self.table, ids, self.lr * grads)
             self._install_gen += 1
             gen = self._install_gen
@@ -1091,10 +1342,18 @@ class PsShardServer:
                         if q > self._writer_applied.get(w, 0):
                             self._writer_applied[w] = q
             rep = self._replicator
+            mig = self._migrator
+            if rep is not None or mig is not None:
+                gids = (ids + self.base).astype(np.int32)
             if rep is not None:
-                rep.ship(gen, _pack_windows(updates) + bytes(
-                    _pack_apply_req(
-                        (ids + self.base).astype(np.int32), grads)))
+                rep.ship(gen, _pack_windows(updates)
+                         + bytes(_pack_apply_req(gids, grads)))
+            if mig is not None:
+                # Live reshard: the successor scheme's shards subscribe
+                # to this shard's applied batches (range-filtered by the
+                # shipper) — enqueued under the write lock so the
+                # destinations see batches in exactly the apply order.
+                mig.ship(gen, gids, grads, updates)
         # Synchronous replication: the apply (and therefore the unary
         # response / combiner barrier riding it) completes only once
         # every CONNECTED backup acked this batch — a write acked to
@@ -1105,6 +1364,41 @@ class PsShardServer:
         # keep flowing.
         if rep is not None:
             rep.flush(gen, timeout_s=self.repl_ack_timeout_s)
+
+    def _serve_apply_id(self, payload) -> bytes:
+        """Idempotent unary write (``ApplyGradId``): the per-(writer,
+        shard) seq window drops a timed-out-but-APPLIED attempt's retry
+        server-side (exactly-once against this shard), and a GUARD
+        naming a superseded frame from a retired scheme drops a
+        re-split delta whose content already migrated here with the
+        old shard's rows.  Always answers the covering install gen."""
+        self._check_primary()
+        self._check_scheme()
+        writer, seq, guards, body = _unpack_apply_id(payload)
+        ids, grads = _unpack_apply(body, self.base, self.rows_per,
+                                   self.dim)
+        apply = True
+        if guards:
+            with self._seq_mu:
+                covered = any(self._writer_applied.get(k, 0) >= q
+                              for k, q in guards)
+            if covered:
+                apply = False
+                if obs.enabled():
+                    obs.counter("ps_scheme_guard_drops").add(1)
+        if apply and not self._reserve_seq(writer, seq):
+            # an earlier attempt of this exact request was admitted:
+            # the retry is a replay, not a new write
+            apply = False
+            if obs.enabled():
+                obs.counter("ps_unary_dedup_drops").add(1)
+        if apply and ids.size:
+            if self.combine:
+                self._combiner.add(ids, grads, meta=(writer, seq))
+            else:
+                self._apply_batch(ids, grads, metas=[(writer, seq)])
+        with self._mu.read():
+            return struct.pack("<q", self._install_gen)
 
     def _serve_control(self, method: str, payload: bytes) -> bytes:
         """Replication control plane (unary, tiny, off the data path)."""
@@ -1193,12 +1487,170 @@ class PsShardServer:
                 self._combiner.flush()
             self.flush_replication()
             return struct.pack("<q", self._install_gen)
+        if method == "SchemeInfo":
+            with self._mu.read():
+                gen = self._install_gen
+            return json.dumps({
+                "scheme": self.scheme_version,
+                "importing": self._importing,
+                "fenced": self._scheme_fenced,
+                "next_scheme": self._next_scheme,
+                "gen": gen,
+                "reads": self._reads(),
+                "primary": self._primary_flag,
+                "epoch": self._epoch,
+            }).encode()
+        if method == "MigrateStart":
+            # Begin streaming this shard's rows to the successor
+            # scheme's shards: one shipper per overlapping destination
+            # (range-filtered Sync at a pinned generation, then every
+            # applied batch).  Idempotent — a re-issued start replaces
+            # the shipper and the destinations resync wholesale.
+            self._check_primary()
+            spec = json.loads(payload)
+            from brpc_tpu import reshard  # lazy: reshard imports us
+            with self._repl_mu:
+                if self._scheme_fenced or self._importing:
+                    raise rpc.RpcError(
+                        resilience.ESCHEMEMOVED,
+                        f"shard {self.shard_index} cannot source a "
+                        f"migration (fenced={self._scheme_fenced}, "
+                        f"importing={self._importing})")
+                old, self._migrator = self._migrator, None
+            if old is not None:
+                old.stop()
+            shipper = reshard.MigrationShipper(
+                self, spec["targets"], int(spec["scheme"]),
+                timeout_ms=self.repl_timeout_ms)
+            with self._repl_mu:
+                self._migrator = shipper
+            # Workers start only once the apply path sees the shipper:
+            # every batch from here on either ships or predates the
+            # workers' range snapshots — never neither.
+            shipper.start()
+            with self._mu.read():
+                return struct.pack("<q", self._install_gen)
+        if method == "MigrateState":
+            mig = self._migrator
+            with self._mu.read():
+                gen = self._install_gen
+            return json.dumps({
+                "gen": gen, "active": mig is not None,
+                "fenced": self._scheme_fenced,
+                "targets": mig.state() if mig is not None else {},
+            }).encode()
+        if method == "MigrateStop":
+            # Abort path: stop shipping, forget the successor.  The
+            # destinations stay importing (their owner closes them).
+            with self._repl_mu:
+                mig, self._migrator = self._migrator, None
+            if mig is not None:
+                # join the workers BEFORE the channel set closes — an
+                # aborted migration must leave no native handle behind
+                mig.stop()
+            return b""
+        if method == "SchemeFence":
+            # The CUTOVER write fence: no new writes are admitted under
+            # the retiring scheme (they answer ESCHEMEMOVED and the
+            # client refreshes its routing), already-admitted writes
+            # drain, and the final migration flush waits until every
+            # destination acked the final generation — after this
+            # returns, the successor shards hold every acked update.
+            (ver,) = struct.unpack_from("<q", payload, 0)
+            with self._repl_mu:
+                if self._importing:
+                    raise rpc.RpcError(
+                        resilience.EMIGRATING,
+                        f"shard {self.shard_index} is importing; an "
+                        f"importing destination cannot be fenced")
+                self._scheme_fenced = True
+                self._next_scheme = int(ver)
+            if self._combiner is not None:
+                # Drain what was admitted before the flag: entries that
+                # lost the race bounce with ESCHEMEMOVED (their callers
+                # re-route with guards) — expected, not a fence failure.
+                try:
+                    self._combiner.flush()
+                except rpc.RpcError as e:
+                    if e.code != resilience.ESCHEMEMOVED:
+                        raise
+            self.flush_replication()
+            mig = self._migrator
+            # The WRITE lock is the fence barrier: any apply that
+            # passed the admission check before the flag has either
+            # bumped the generation (covered by the flush below) or
+            # will refuse inside the lock after we release it.
+            with self._mu.write():
+                gen = self._install_gen
+            if mig is not None:
+                mig.flush(gen, timeout_s=self.repl_ack_timeout_s)
+            if obs.enabled():
+                obs.counter("ps_scheme_fences").add(1)
+            return struct.pack("<q", gen)
+        if method == "MigrateSync":
+            # Range handoff: install the source's rows for (a slice of)
+            # this shard's range wholesale, at the source's pinned
+            # generation, windows included — the import-side mirror of
+            # the replication Sync.
+            scheme, src_gen, row0, count = struct.unpack_from(
+                "<qqqq", payload, 0)
+            (alen,) = struct.unpack_from("<i", payload, 32)
+            src = bytes(payload[36:36 + alen]).decode(errors="replace")
+            off = 36 + alen
+            lo = row0 - self.base
+            if lo < 0 or row0 + count > self.base + self.rows_per:
+                raise ValueError(
+                    f"sync range [{row0}, {row0 + count}) outside "
+                    f"shard [{self.base}, {self.base + self.rows_per})")
+            rows = np.frombuffer(payload, np.float32, count * self.dim,
+                                 off).reshape(count, self.dim)
+            windows = _unpack_windows(
+                payload, off + count * self.dim * 4)[0]
+            with self._mu.write():
+                if not self._importing:
+                    raise rpc.RpcError(
+                        resilience.ESCHEMEMOVED,
+                        f"shard {self.shard_index} completed its "
+                        f"import; a late source sync must not "
+                        f"overwrite a live table")
+                self.table[lo:lo + count] = rows
+                self._import_gens[src] = src_gen
+                self._install_gen += 1
+                if windows:
+                    with self._seq_mu:
+                        for w, q in windows.items():
+                            if q > self._writer_seqs.get(w, 0):
+                                self._writer_seqs[w] = q
+                            if q > self._writer_applied.get(w, 0):
+                                self._writer_applied[w] = q
+            if obs.enabled():
+                obs.counter("ps_migrate_syncs").add(1)
+            return b""
+        if method == "CompleteImport":
+            # The import is byte-complete (every source fenced and
+            # flushed): open for business.  Publishes the first native
+            # snapshot — until here the native read path answered
+            # errors, never unmigrated rows.
+            with self._repl_mu:
+                with self._mu.write():
+                    was = self._importing
+                    self._importing = False
+                    gen = self._install_gen
+                    if was and self._shard is not None:
+                        self._shard.install(self.table, gen)
+            if obs.enabled() and was:
+                obs.counter("ps_imports_completed").add(1)
+            return struct.pack("<q", gen)
         raise ValueError(f"unknown method {method}")
 
     def _serve(self, method: str, payload: bytes) -> bytes:
         if method in ("ReplicaState", "Promote", "Sync", "WriterSeq",
-                      "Flush"):
+                      "Flush", "SchemeInfo", "MigrateStart",
+                      "MigrateState", "MigrateStop", "SchemeFence",
+                      "MigrateSync", "CompleteImport"):
             return self._serve_control(method, payload)
+        if method == "ApplyGradId":
+            return self._serve_apply_id(payload)
         (count,) = struct.unpack_from("<i", payload, 0)
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
         if ids.size and (ids.min() < 0 or ids.max() >= self.rows_per):
@@ -1208,12 +1660,20 @@ class PsShardServer:
                 f"{self.base + self.rows_per}) for shard base {self.base}"
             )
         if method == "Lookup":
+            if self._importing:
+                # The range is still streaming in: answer a scheme-aware
+                # miss so the client falls back to the source scheme.
+                self._check_scheme()
+            with self._seq_mu:
+                self._read_count += 1
             with self._mu.read():
                 return self.table[ids].tobytes()
         if method == "ApplyGrad":
             # Writes belong to the primary: a demoted/backup replica
-            # rejects so the client re-resolves and fails over.
+            # rejects so the client re-resolves and fails over.  A
+            # cutover-fenced or importing shard redirects instead.
             self._check_primary()
+            self._check_scheme()
             grads = np.frombuffer(payload, np.float32,
                                   count * self.dim, 4 + 4 * count)
             if self.combine:
@@ -1250,8 +1710,11 @@ class PsShardServer:
         # death.
         with self._repl_mu:
             rep, self._replicator = self._replicator, None
+            mig, self._migrator = self._migrator, None
         if rep is not None:
             rep.stop()
+        if mig is not None:
+            mig.stop()
         self.server.close()
         if self._combiner is not None:
             self._combiner.shutdown()
@@ -1428,9 +1891,9 @@ class DevicePsShardServer:
             return self._serve(method, payload)
         t0 = time.monotonic_ns()
         rsp = self._serve(method, payload)
-        (count,) = struct.unpack_from("<i", payload, 0)
-        _record_ps_server(self.shard_index, method, count, len(payload),
-                          len(rsp), t0)
+        _record_ps_server(self.shard_index, method,
+                          PsShardServer._payload_keys(method, payload),
+                          len(payload), len(rsp), t0)
         return rsp
 
     def _handle_stream(self, method: str, payload: bytes, accept) -> bytes:
@@ -1444,6 +1907,34 @@ class DevicePsShardServer:
                 return struct.pack("<q", last)
             return b""
         return self._handle(method, payload)
+
+    def _serve_apply_id(self, payload) -> bytes:
+        """Idempotent unary write for the device shard: same
+        per-(writer, shard) admission window as the CPU server (the
+        device tier has no migration inheritance, so guards check the
+        admission window)."""
+        writer, seq, guards, body = _unpack_apply_id(payload)
+        ids, grads = _unpack_apply(body, self.base, self.rows_per,
+                                   self.dim)
+        apply = True
+        if guards:
+            with self._seq_mu:
+                covered = any(self._writer_seqs.get(k, 0) >= q
+                              for k, q in guards)
+            if covered:
+                apply = False
+                if obs.enabled():
+                    obs.counter("ps_scheme_guard_drops").add(1)
+        if apply and not self._reserve_seq(writer, seq):
+            apply = False
+            if obs.enabled():
+                obs.counter("ps_unary_dedup_drops").add(1)
+        if apply and ids.size:
+            if self.combine:
+                self._combiner.add(ids, grads)
+            else:
+                self._apply_batch(ids, grads)
+        return struct.pack("<q", 0)
 
     def _reserve_seq(self, writer: str, seq: int) -> bool:
         """Per-(writer, seq) admission — see PsShardServer._reserve_seq."""
@@ -1487,6 +1978,17 @@ class DevicePsShardServer:
             self.dev.release(ids_h)
 
     def _serve(self, method: str, payload: bytes) -> bytes:
+        if method == "ApplyGradId":
+            return self._serve_apply_id(payload)
+        if method == "WriterSeq":
+            # the push flush barrier verifies every shard's window; the
+            # device tier's admission window is its applied proxy — the
+            # stream-close combiner flush precedes this call, so every
+            # admitted frame has been applied by then
+            writer = payload.decode(errors="replace")
+            with self._seq_mu:
+                applied = self._writer_seqs.get(writer, 0)
+            return struct.pack("<qq", applied, 0)
         (count,) = struct.unpack_from("<i", payload, 0)
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
         if ids.size and (ids.min() < 0 or ids.max() >= self.rows_per):
@@ -1593,21 +2095,141 @@ class DevicePsShardServer:
 class _PushStreamReceiver:
     """Client read half of a gradient push stream: the only frame the
     server ever writes back is a FENCE notification (a negative int64 —
-    the primary was demoted mid-stream and dropped frames).  Seeing it
-    flips ``fenced`` so the pusher fails over instead of trusting the
-    close barrier."""
+    -1: the primary was demoted mid-stream and dropped frames; -2: the
+    partition scheme was retired by a cutover).  Seeing it flips
+    ``fenced`` so the pusher fails over (or refreshes its scheme)
+    instead of trusting the close barrier."""
 
-    __slots__ = ("fenced",)
+    __slots__ = ("fenced", "scheme_moved")
 
     def __init__(self):
         self.fenced = False
+        self.scheme_moved = False
 
     def on_data(self, data: bytes) -> None:
-        if len(data) >= 8 and struct.unpack_from("<q", data, 0)[0] < 0:
-            self.fenced = True
+        if len(data) >= 8:
+            (val,) = struct.unpack_from("<q", data, 0)
+            if val < 0:
+                self.fenced = True
+                if val == -2:
+                    self.scheme_moved = True
 
     def on_closed(self) -> None:
         pass
+
+
+class _SchemeMovedError(Exception):
+    """A write batch hit a scheme boundary mid-flight (cutover fence or
+    a still-importing destination): ``remainder`` holds the UNAPPLIED
+    units ``(global_ids, grads, guards)`` to re-route once the write
+    view settles; everything else in the batch is already acked."""
+
+    def __init__(self, code: int, remainder):
+        super().__init__(f"partition scheme moved (code {code})")
+        self.code = code
+        self.remainder = remainder
+
+
+class _SchemeView:
+    """Per-scheme routing state inside :class:`RemoteEmbedding`: the
+    scheme's replica sets plus everything the router tracks per shard —
+    believed primary, observed fencing epochs, acked-gen floors, unary
+    write seq counters — and a scheme-scoped scorer so one scheme's
+    latency history never poisons another's (the ISSUE's "breaker/
+    scorer keyed per scheme-replica").  Usually one view exists; during
+    a live reshard two serve reads side by side with traffic weighted
+    by ``scheme.weight``."""
+
+    __slots__ = ("scheme", "version", "replica_sets", "n", "rows_per",
+                 "bounds", "weight", "state", "addresses", "scorer",
+                 "useq", "_primary_idx", "_epoch_seen", "_gen_seen")
+
+    def __init__(self, emb: "RemoteEmbedding", scheme: PartitionScheme):
+        self.scheme = scheme
+        self.version = scheme.version
+        self.replica_sets: List[ReplicaSet] = list(scheme.replica_sets)
+        self.n = len(self.replica_sets)
+        if scheme.bounds is not None:
+            if scheme.bounds[-1] != emb.vocab:
+                raise ValueError(
+                    f"scheme v{scheme.version} bounds end at "
+                    f"{scheme.bounds[-1]}, vocab is {emb.vocab}")
+            self.bounds = np.asarray(scheme.bounds, np.int64)
+            self.rows_per = 0
+        else:
+            if emb.vocab % self.n:
+                raise ValueError(
+                    f"scheme v{scheme.version}: {self.n} shards must "
+                    f"divide vocab {emb.vocab} (or carry bounds)")
+            self.bounds = None
+            self.rows_per = emb.vocab // self.n
+        self.weight = float(scheme.weight)
+        self.state = scheme.state
+        #: boot-time primary addresses (the legacy per-shard surface)
+        self.addresses = [rs.addresses[rs.primary]
+                          for rs in self.replica_sets]
+        self.scorer = emb.scorer.scoped(
+            "" if scheme.version == 0 else f"v{scheme.version}")
+        #: per-shard unary write seq counters (ApplyGradId windows)
+        self.useq: Dict[int, int] = {}
+        self._primary_idx = [rs.primary for rs in self.replica_sets]
+        self._epoch_seen = [0] * self.n
+        self._gen_seen = [0] * self.n
+
+    def update(self, scheme: PartitionScheme) -> None:
+        """Adopt a re-published record's weight/state (the topology of
+        a version never changes — a new topology is a new version)."""
+        self.scheme = scheme
+        self.weight = float(scheme.weight)
+        self.state = scheme.state
+
+    def shard_bounds(self, s: int, vocab: int):
+        return self.scheme.shard_bounds(s, vocab)
+
+
+class _SchemeWatcher(threading.Thread):
+    """Registry watcher feeding a :class:`RemoteEmbedding`: blocks on
+    the cluster's version and ingests scheme records (weight/state
+    transitions drive the dual-scheme read router) and primary/epoch
+    claims (failover adopts the claimed primary instead of sweeping).
+    ``refresh()`` is the synchronous poke used by the scheme-moved
+    write path — it lists the cluster on the CALLER's thread (the
+    NamingClient keeps one connection per thread), so a redirect error
+    converges without waiting out the watch cadence."""
+
+    def __init__(self, emb: "RemoteEmbedding", registry_addr: str,
+                 cluster: str, wait_ms: int = 2000):
+        super().__init__(daemon=True, name="brt-scheme-watcher")
+        from brpc_tpu.naming import NamingClient
+        self._emb = emb
+        self._cluster = cluster
+        self._wait_ms = wait_ms
+        self._reg = NamingClient(registry_addr)
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        version = 0
+        while not self._stop.is_set():
+            try:
+                nodes, version = self._reg.watch(
+                    self._cluster, known_version=version,
+                    wait_ms=self._wait_ms)
+            except Exception:  # noqa: BLE001 — registry outage: retry
+                if self._stop.wait(0.2):
+                    break
+                continue
+            self._emb._ingest_nodes(nodes)
+
+    def refresh(self) -> None:
+        try:
+            nodes, _ = self._reg.list(self._cluster)
+        except Exception:  # noqa: BLE001 — caller keeps its stale view
+            return
+        self._emb._ingest_nodes(nodes)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._reg.close()
 
 
 class RemoteEmbedding:
@@ -1663,16 +2285,20 @@ class RemoteEmbedding:
     @classmethod
     def from_registry(cls, registry_addr: str, cluster: str, vocab: int,
                       dim: int, timeout_ms: int = 2000,
-                      wait_ms: int = 5000, **kwargs) -> "RemoteEmbedding":
-        """Resolves the shard list from the native naming registry
-        (brpc_tpu.naming): shards register with tag "<shard>/<num>"
-        (the boot primary) or "<shard>/<num>/<replica>" (backups), and
-        the watch blocks until a CONSISTENT full set is present (all
-        shards 0..num-1 with one num, each with its replica 0).  Backups
-        present at resolution time join their shard's ReplicaSet in
-        replica order.  Service discovery for the PS tier — no static
-        address list.  ``kwargs`` pass through to the constructor
-        (retry/breakers/...)."""
+                      wait_ms: int = 5000, watch: bool = False,
+                      **kwargs) -> "RemoteEmbedding":
+        """Resolves the shard topology from the native naming registry
+        (brpc_tpu.naming).  PREFERRED form: the cluster carries
+        :class:`naming.PartitionScheme` records (``scheme#<version>``
+        nodes) — every published scheme becomes a routing view, so a
+        client booted mid-reshard serves both schemes immediately.
+        Legacy form: shards register with tag "<shard>/<num>" (the boot
+        primary) or "<shard>/<num>/<replica>" (backups), and the watch
+        blocks until a CONSISTENT full set is present.  ``watch=True``
+        attaches a registry watcher after construction: scheme
+        transitions (cutover, drain, retire) and primary/epoch claims
+        flow into the router live.  ``kwargs`` pass through to the
+        constructor (retry/breakers/...)."""
         from brpc_tpu.naming import NamingClient
         reg = NamingClient(registry_addr)
         deadline = time.monotonic() + wait_ms / 1000.0
@@ -1687,6 +2313,7 @@ class RemoteEmbedding:
         backoff = resilience.Backoff(base_ms=100.0, multiplier=2.0,
                                      max_ms=2000.0, jitter=0.5)
         poll = 0
+        emb: "Optional[RemoteEmbedding]" = None
         while True:
             remaining_ms = (deadline - time.monotonic()) * 1000.0
             watch_ms = max(1, int(min(backoff.delay_ms(poll),
@@ -1694,6 +2321,13 @@ class RemoteEmbedding:
             poll += 1
             nodes, version = reg.watch(cluster, known_version=version,
                                        wait_ms=watch_ms)
+            schemes = parse_schemes(nodes)
+            live = [sc for sc in schemes.values()
+                    if sc.state != "retired"]
+            if any(sc.state == "active" for sc in live):
+                emb = cls(sorted(live, key=lambda sc: sc.version),
+                          vocab, dim, timeout_ms=timeout_ms, **kwargs)
+                break
             # Group by the tag's "/num" so a stale entry from an old
             # sharding cannot block a complete consistent new set.
             groups = {}
@@ -1718,14 +2352,21 @@ class RemoteEmbedding:
                         sets.append(ReplicaSet(
                             tuple(reps[r] for r in sorted(reps)),
                             primary=sorted(reps).index(0)))
-                    reg.close()
-                    return cls(sets, vocab, dim, timeout_ms=timeout_ms,
-                               **kwargs)
+                    emb = cls(sets, vocab, dim, timeout_ms=timeout_ms,
+                              **kwargs)
+                    break
+            if emb is not None:
+                break
             if time.monotonic() > deadline:
                 reg.close()
                 raise TimeoutError(
                     f"cluster '{cluster}' has no complete sharding: "
                     f"{ {nm: sorted(m) for nm, m in groups.items()} }")
+        emb._ingest_nodes(nodes)
+        reg.close()
+        if watch:
+            emb.attach_registry(registry_addr, cluster)
+        return emb
 
     def __init__(self, addresses: Sequence, vocab: int, dim: int,
                  timeout_ms: int = 2000, parallel: bool = True, *,
@@ -1739,13 +2380,6 @@ class RemoteEmbedding:
                  scorer: "Optional[resilience.ReplicaScorer]" = None):
         self.vocab = vocab
         self.dim = dim
-        # Each entry is one shard RANGE: a bare address (single owner,
-        # the legacy form) or a naming.ReplicaSet / address sequence
-        # (primary + backups all serving the same rows).
-        self.replica_sets: List[ReplicaSet] = [
-            ReplicaSet.of(a) for a in addresses]
-        self.n = len(self.replica_sets)
-        self.rows_per = vocab // self.n
         self.parallel = parallel
         self.timeout_ms = timeout_ms
         #: per-shard unconsumed-bytes window for push streams (0 = the
@@ -1754,9 +2388,10 @@ class RemoteEmbedding:
         self._push_streams: dict = {}
         self._push_addr: Dict[int, str] = {}
         self._push_recv: Dict[int, "_PushStreamReceiver"] = {}
-        # Framed idempotent push: one stable writer identity, one
-        # monotonically increasing seq per shard (never reset — the
-        # server's per-writer window is the dedup state).
+        # Framed idempotent push: one stable writer identity; the wire
+        # writer KEYS are per (scheme, shard) so seq spaces from
+        # different schemes/shards never collide in a migrated window
+        # (see _stream_writer_key / _unary_writer_key).
         self._writer_id = f"w{uuid.uuid4().hex[:12]}"
         self._push_seq: Dict[int, int] = {}
         #: highest seq written to the CURRENT stream per shard (reset to
@@ -1767,41 +2402,38 @@ class RemoteEmbedding:
         #: shard: (seq, body) in order.  A failover mid-window replays
         #: these above the new primary's inherited high-water — pushed-
         #: but-unflushed deltas survive the primary, not just the
-        #: stream.  Cleared only when the flush barrier confirms.
+        #: stream.  Cleared only when the flush barrier confirms.  A
+        #: SCHEME move re-routes them as guarded unary writes.
         self._push_unacked: Dict[int, List[tuple]] = {}
-        #: highest replicated gen this client has been ACKED per shard —
-        #: failover refuses a promotion candidate behind it (a backup
-        #: that missed acked writes must not be promoted into losing
-        #: them; unavailability over silent loss)
-        self._gen_seen: List[int] = [0] * self.n
-        #: current believed primary per shard (index into the replica
-        #: set; moved by observed promotions / client-driven failover)
-        self._primary_idx: List[int] = [rs.primary
-                                        for rs in self.replica_sets]
-        #: highest fencing epoch ever observed per shard — failover
-        #: ignores claims/candidates BEHIND it, so a temporarily
-        #: unreachable new primary is never undercut by re-adopting (or
-        #: re-promoting) a stale one, which would lose acked updates
-        self._epoch_seen: List[int] = [0] * self.n
-        #: boot-time primary addresses — the legacy single-owner surface
-        self.addresses = [rs.addresses[rs.primary]
-                          for rs in self.replica_sets]
-        self.replicated = any(len(rs.addresses) > 1
-                              for rs in self.replica_sets)
         self.retry = retry
         self.deadline_ms = deadline_ms
         self.backup_ms = backup_ms
+        self.scorer = scorer or resilience.ReplicaScorer()
+        # Partition-scheme views (the DynamicPartitionChannel shape):
+        # `addresses` is either the legacy form — one entry per shard
+        # range (bare address / ReplicaSet / address sequence), wrapped
+        # into scheme version 0 — or a sequence of PartitionScheme
+        # records (a client booted mid-reshard serves them all).
+        items = list(addresses)
+        if items and all(isinstance(a, PartitionScheme) for a in items):
+            schemes = sorted(items, key=lambda sc: sc.version)
+        else:
+            schemes = [PartitionScheme(
+                version=0,
+                replica_sets=tuple(ReplicaSet.of(a) for a in items))]
+        self._view_mu = checked_lock("ps.views")
+        self._views: List[_SchemeView] = []
+        self._claims: Dict[tuple, tuple] = {}
+        self._watcher: Optional[_SchemeWatcher] = None
+        self._read_seq = 0
+        self._chans: Dict[str, rpc.Channel] = {}
+        views = [_SchemeView(self, sc) for sc in schemes]
+        self.replicated = any(len(rs.addresses) > 1
+                              for v in views for rs in v.replica_sets)
         self.breakers = breakers
         if health_check and breakers is None:
             self.breakers = breakers = resilience.BreakerRegistry(
                 redirect=self.replicated)
-        if self.breakers is not None:
-            # Register every replica up front: the cluster-recover guard
-            # counts working endpoints, so the registry must know the
-            # full cluster, not just the endpoints that have failed.
-            for rs in self.replica_sets:
-                for a in rs.addresses:
-                    self.breakers.breaker_for(a)
         # REDIRECT mode (the SelectiveChannel behavior): reads route to
         # any live replica by latency+inflight score, an open breaker
         # re-routes instead of rejecting, and a failed/isolated primary
@@ -1810,20 +2442,189 @@ class RemoteEmbedding:
         # explicitly asks for fail-fast.
         self._redirect = self.replicated and (
             self.breakers is None or self.breakers.redirect)
-        self.scorer = scorer or resilience.ReplicaScorer()
+        for v in views:
+            self._admit_view(v)
+        with self._view_mu:
+            self._views = views
+            # newest ACTIVE scheme owns writes
+            act = [v for v in views if v.state == "active"] or views
+            self._wv = max(act, key=lambda v: v.version)
         self._prober: "Optional[resilience.HealthProber]" = None
         if health_check:
             self._prober = resilience.HealthProber(
                 self.breakers, interval_ms=health_interval_ms)
             self._prober.start()
-        self._chans: Dict[str, rpc.Channel] = {}
-        for rs in self.replica_sets:
+
+    def _admit_view(self, view: _SchemeView) -> None:
+        """Channels + breakers for every replica of a (new) view: the
+        cluster-recover guard counts working endpoints, so the breaker
+        registry must know the full cluster up front."""
+        for rs in view.replica_sets:
             for a in rs.addresses:
                 if a not in self._chans:
-                    self._chans[a] = rpc.Channel(a, timeout_ms=timeout_ms)
-        #: legacy per-shard view: the boot primaries' channels
-        self.channels: List[rpc.Channel] = [
-            self._chans[a] for a in self.addresses]
+                    self._chans[a] = rpc.Channel(
+                        a, timeout_ms=self.timeout_ms)
+                if self.breakers is not None:
+                    self.breakers.breaker_for(a)
+
+    # -- legacy single-scheme surface (delegates to the write view) -------
+
+    @property
+    def _wview(self) -> _SchemeView:
+        return self._wv
+
+    @property
+    def replica_sets(self) -> List[ReplicaSet]:
+        return self._wv.replica_sets
+
+    @property
+    def n(self) -> int:
+        return self._wv.n
+
+    @property
+    def rows_per(self) -> int:
+        return self._wv.rows_per
+
+    @property
+    def addresses(self) -> List[str]:
+        return self._wv.addresses
+
+    @property
+    def channels(self) -> List[rpc.Channel]:
+        return [self._chans[a] for a in self._wv.addresses]
+
+    @property
+    def _primary_idx(self) -> List[int]:
+        return self._wv._primary_idx
+
+    @property
+    def _epoch_seen(self) -> List[int]:
+        return self._wv._epoch_seen
+
+    @property
+    def _gen_seen(self) -> List[int]:
+        return self._wv._gen_seen
+
+    # -- scheme lifecycle (the dual-scheme router's control surface) ------
+
+    def schemes(self) -> List[PartitionScheme]:
+        with self._view_mu:
+            return [v.scheme for v in self._views]
+
+    def set_schemes(self, schemes: Sequence[PartitionScheme]) -> None:
+        """Adopt the given scheme records: known versions take the new
+        weight/state (topology per version is immutable), unknown ones
+        become routing views, RETIRED ones are dropped — after which no
+        read or write ever routes to them again.  Safe to call from a
+        watcher thread; the write view itself only switches on the
+        writer's thread (see ``_write_view``)."""
+        by_ver = {sc.version: sc for sc in schemes}
+        fresh: List[_SchemeView] = []
+        with self._view_mu:
+            known = {v.version: v for v in self._views}
+            for ver, sc in by_ver.items():
+                if ver in known:
+                    known[ver].update(sc)
+                elif sc.state != "retired":
+                    fresh.append(_SchemeView(self, sc))
+        for v in fresh:
+            self._admit_view(v)
+            if obs.enabled():
+                obs.counter("ps_scheme_refreshes").add(1)
+        with self._view_mu:
+            allv = self._views + fresh
+            cur = self._wv
+            if cur.state == "retired" and not any(
+                    self._push_unacked.values()):
+                # a read-only client's write view never moves through
+                # _write_view(); when its scheme retires with no push
+                # window pending, hop to the successor here so the
+                # retired view can actually drop
+                act = [v for v in allv if v.state == "active"] or allv
+                cur = self._wv = max(act, key=lambda v: v.version)
+            self._views = [v for v in allv
+                           if v.state != "retired" or v is cur]
+            self.replicated = self.replicated or any(
+                len(rs.addresses) > 1
+                for v in fresh for rs in v.replica_sets)
+            self._redirect = self.replicated and (
+                self.breakers is None or self.breakers.redirect)
+
+    def add_scheme(self, scheme: PartitionScheme) -> None:
+        self.set_schemes([scheme])
+
+    def attach_registry(self, registry_addr: str, cluster: str,
+                        wait_ms: int = 2000) -> None:
+        """Start watching the naming registry: published scheme
+        transitions and primary/epoch claims flow into this router
+        live (cutover redirects then only pay one refresh round
+        trip)."""
+        if self._watcher is not None:
+            return
+        self._watcher = _SchemeWatcher(self, registry_addr, cluster,
+                                       wait_ms=wait_ms)
+        self._watcher.start()
+
+    def _ingest_nodes(self, nodes) -> None:
+        """Registry listing → scheme views + primary claims."""
+        schemes = parse_schemes(nodes)
+        if schemes:
+            self.set_schemes(list(schemes.values()))
+        claims = parse_claims(nodes)
+        if claims:
+            with self._view_mu:
+                self._claims.update(claims)
+
+    def _claim_for(self, view: _SchemeView, s: int):
+        with self._view_mu:
+            return self._claims.get((view.n, s))
+
+    def _write_view(self) -> _SchemeView:
+        """The view owning WRITES: the newest active scheme.  Switching
+        away from a view transfers its unacked push window onto the
+        successor (guarded unary re-splits — exactly-once across the
+        scheme boundary) before any new write routes there."""
+        while True:
+            with self._view_mu:
+                act = [v for v in self._views if v.state == "active"] \
+                    or list(self._views)
+                best = max(act, key=lambda v: v.version)
+                cur = self._wv
+                if best is cur:
+                    return cur
+                self._wv = best
+            if obs.enabled():
+                obs.counter("ps_scheme_switches").add(1)
+            self._transfer_pushes(cur, best)
+
+    def _on_stale_scheme(self, view: _SchemeView,
+                         err: BaseException) -> None:
+        """A write was redirected with ESCHEMEMOVED.  The redirect is
+        AUTHORITATIVE: the server declared this scheme fenced, so
+        demote the view locally (the write view moves even before the
+        registry publication lands) and poke the registry for the
+        successor; with nothing newer known the redirect error
+        propagates (a stale client with no discovery path must fail
+        loudly, not spin)."""
+        with self._view_mu:
+            if view.state == "active":
+                view.state = "draining"
+        if self._watcher is not None:
+            self._watcher.refresh()
+        with self._view_mu:
+            newest = max(self._views, key=lambda v: v.version)
+        if newest.version <= view.version:
+            raise err
+
+    def _stream_writer_key(self, view: _SchemeView, s: int) -> str:
+        """Per-(client, scheme, shard) stream writer key: seq spaces
+        from different schemes/shards must never collide inside a
+        migrated dedup window (a merge destination inherits windows
+        from several sources)."""
+        return f"{self._writer_id}/s{view.version}.{s}"
+
+    def _unary_writer_key(self, view: _SchemeView, s: int) -> str:
+        return f"{self._writer_id}/u{view.version}.{s}"
 
     # -- replica routing (SelectiveChannel / locality-aware LB analog) ----
 
@@ -1845,24 +2646,27 @@ class RemoteEmbedding:
             return False
         return self.breakers.breaker_for(addr).isolated()
 
-    def _breaker(self, s: int) -> "Optional[resilience.CircuitBreaker]":
+    def _breaker(self, view: _SchemeView, s: int
+                 ) -> "Optional[resilience.CircuitBreaker]":
         if self.breakers is None:
             return None
-        return self.breakers.breaker_for(self.addresses[s])
+        return self.breakers.breaker_for(view.addresses[s])
 
     def _ctl_timeout_ms(self) -> int:
         """Control-plane calls (ReplicaState/Promote) stay snappy: they
         run inside a failing data call's recovery path."""
         return max(50, min(self.timeout_ms, 1000))
 
-    def _route_read(self, s: int, exclude=frozenset()) -> str:
-        """Pick the replica serving shard ``s``'s next READ: in redirect
-        mode, the lowest latency*(inflight+1) score among live (not
-        isolated, not just-failed) replicas — an open breaker on one
-        replica REDIRECTS traffic to its siblings; only when every
-        replica is isolated does the shard fail fast.  Outside redirect
-        mode reads stick to the primary (the legacy reject behavior)."""
-        rs = self.replica_sets[s]
+    def _route_read(self, view: _SchemeView, s: int,
+                    exclude=frozenset()) -> str:
+        """Pick the replica serving shard ``s``'s next READ under
+        ``view``: in redirect mode, the lowest latency*(inflight+1)
+        score among live (not isolated, not just-failed) replicas — an
+        open breaker on one replica REDIRECTS traffic to its siblings;
+        only when every replica is isolated does the shard fail fast.
+        Outside redirect mode reads stick to the primary (the legacy
+        reject behavior)."""
+        rs = view.replica_sets[s]
         if len(rs.addresses) > 1 and self._redirect:
             cands = [a for a in rs.addresses if a not in exclude]
             if not cands:
@@ -1877,33 +2681,72 @@ class RemoteEmbedding:
                 # an open breaker pushed this read to a sibling —
                 # redirected, not rejected
                 obs.counter("rpc_breaker_redirects").add(1)
-            return self.scorer.pick(live)
-        return self._route_write(s, exclude)
+            return view.scorer.pick(live)
+        return self._route_write(view, s, exclude)
 
-    def _route_write(self, s: int, exclude=frozenset()) -> str:
+    def _route_write(self, view: _SchemeView, s: int,
+                     exclude=frozenset()) -> str:
         """WRITES go to the primary.  In redirect mode a failed or
         breaker-isolated primary triggers failover (fenced promotion of
         a backup); otherwise an isolated primary rejects, exactly the
         single-owner behavior."""
-        rs = self.replica_sets[s]
-        addr = rs.addresses[self._primary_idx[s]]
+        rs = view.replica_sets[s]
+        addr = rs.addresses[view._primary_idx[s]]
         if len(rs.addresses) > 1 and self._redirect and \
                 (addr in exclude or self._isolated(addr)):
-            return self._failover(s, exclude)
+            return self._failover(view, s, exclude)
         if self._isolated(addr):
             raise rpc.RpcError(
                 resilience.EBREAKEROPEN,
                 f"shard {s} ({addr}) isolated by circuit breaker")
         return addr
 
-    def _failover(self, s: int, exclude=frozenset()) -> str:
+    def _adopt_claim(self, view: _SchemeView, s: int,
+                     exclude=frozenset()) -> Optional[str]:
+        """The registry-claim fast path (PR-9 deferral): when the
+        naming heartbeat carries a primary claim for this range at or
+        above every epoch we've seen, verify it with ONE ReplicaState
+        call and adopt — no replica sweep, no promote race.  Returns
+        the adopted address or None (fall back to sweeping)."""
+        claim = self._claim_for(view, s)
+        if claim is None:
+            return None
+        epoch_c, addr = claim
+        rs = view.replica_sets[s]
+        if addr not in rs.addresses or addr in exclude or \
+                epoch_c < view._epoch_seen[s] or self._isolated(addr):
+            return None
+        try:
+            st = json.loads(self._chan(addr).call(
+                "Ps", "ReplicaState", b"",
+                timeout_ms=self._ctl_timeout_ms()))
+        except rpc.RpcError:
+            return None
+        if not st.get("primary") or st["epoch"] < epoch_c or \
+                st["gen"] < view._gen_seen[s]:
+            return None
+        view._epoch_seen[s] = max(view._epoch_seen[s], int(st["epoch"]))
+        view._primary_idx[s] = rs.addresses.index(addr)
+        if obs.enabled():
+            obs.counter("ps_claim_adoptions").add(1)
+        return addr
+
+    def _failover(self, view: _SchemeView, s: int,
+                  exclude=frozenset()) -> str:
         """Re-resolve — and, when nobody owns the range, PROMOTE — shard
-        ``s``'s primary among reachable replicas.  Promotion carries a
-        fencing epoch above every epoch observed in the sweep, so a
-        concurrent stale primary is fenced the moment it next touches a
-        fenced replica; losing a promote race (EFENCED back) just
-        re-resolves.  Returns the new primary's address."""
-        rs = self.replica_sets[s]
+        ``s``'s primary among reachable replicas.  A primary claim
+        published through the registry heartbeat short-circuits the
+        sweep.  Promotion carries a fencing epoch above every epoch
+        observed in the sweep, so a concurrent stale primary is fenced
+        the moment it next touches a fenced replica; losing a promote
+        race (EFENCED back) just re-resolves.  Returns the new
+        primary's address."""
+        adopted = self._adopt_claim(view, s, exclude)
+        if adopted is not None:
+            if obs.enabled():
+                obs.counter("ps_client_failovers").add(1)
+            return adopted
+        rs = view.replica_sets[s]
         last_err: Optional[rpc.RpcError] = None
         for _ in range(3):
             states: Dict[str, dict] = {}
@@ -1922,9 +2765,9 @@ class RemoteEmbedding:
                     f"shard {s}: no reachable replica to fail over to "
                     f"(candidates {', '.join(rs.addresses)}; last error: "
                     f"{last_err})")
-            seen = max([self._epoch_seen[s]]
+            seen = max([view._epoch_seen[s]]
                        + [st["epoch"] for st in states.values()])
-            self._epoch_seen[s] = seen
+            view._epoch_seen[s] = seen
             # Claims and candidates BEHIND the highest epoch this client
             # has observed are stale — a blackholed new primary must not
             # be undercut by its demoted predecessor (that would lose
@@ -1933,7 +2776,7 @@ class RemoteEmbedding:
                       if st.get("primary") and st["epoch"] >= seen]
             if claims:
                 _, addr = max(claims)
-                if states[addr]["gen"] < self._gen_seen[s]:
+                if states[addr]["gen"] < view._gen_seen[s]:
                     # A primary whose table is behind writes this client
                     # was ACKED can only exist through a lossy promotion
                     # elsewhere — refuse to adopt it silently.
@@ -1941,18 +2784,18 @@ class RemoteEmbedding:
                         resilience.EBREAKEROPEN,
                         f"shard {s}: claimed primary {addr} is at gen "
                         f"{states[addr]['gen']} < acked gen "
-                        f"{self._gen_seen[s]} — acked updates are "
+                        f"{view._gen_seen[s]} — acked updates are "
                         f"missing, refusing the lossy adoption")
             else:
                 cands = {a: st for a, st in states.items()
                          if st["epoch"] >= seen
-                         and st["gen"] >= self._gen_seen[s]}
+                         and st["gen"] >= view._gen_seen[s]}
                 if not cands:
                     raise rpc.RpcError(
                         resilience.EBREAKEROPEN,
                         f"shard {s}: every reachable replica is behind "
                         f"epoch {seen} or acked gen "
-                        f"{self._gen_seen[s]} — the authoritative "
+                        f"{view._gen_seen[s]} — the authoritative "
                         f"replica is unreachable, refusing a lossy "
                         f"promotion")
                 # Nobody owns the range: promote the freshest current-
@@ -1969,10 +2812,10 @@ class RemoteEmbedding:
                     if e.code != resilience.EFENCED:
                         raise
                     continue   # promote race lost: re-resolve
-                self._epoch_seen[s] = epoch
+                view._epoch_seen[s] = epoch
                 if obs.enabled():
                     obs.counter("ps_client_promotes").add(1)
-            self._primary_idx[s] = rs.addresses.index(addr)
+            view._primary_idx[s] = rs.addresses.index(addr)
             if obs.enabled():
                 obs.counter("ps_client_failovers").add(1)
             return addr
@@ -1980,38 +2823,52 @@ class RemoteEmbedding:
             resilience.EFENCED,
             f"shard {s}: lost the promote race on every attempt")
 
-    def _note_acked_gen(self, s: int, rsp) -> None:
+    def _note_acked_gen(self, view: _SchemeView, s: int, rsp) -> None:
         """A replicated shard answers writes with the covering gen —
         the client's acked floor for failover's lossy-promotion guard."""
         if rsp is not None and len(rsp) >= 8:
             (gen,) = struct.unpack_from("<q", rsp, 0)
-            if gen > self._gen_seen[s]:
-                self._gen_seen[s] = gen
+            if gen > view._gen_seen[s]:
+                view._gen_seen[s] = gen
 
-    def _reroutable(self, s: int, exc: rpc.RpcError) -> bool:
+    def _reroutable(self, view: _SchemeView, s: int,
+                    exc: rpc.RpcError) -> bool:
         """True for routing-correction errors (the write reached a
         demoted/fenced replica) that re-route via failover immediately,
         outside the retry policy's attempt budget."""
         return exc.code in (resilience.ENOTPRIMARY, resilience.EFENCED) \
-            and len(self.replica_sets[s].addresses) > 1
+            and len(view.replica_sets[s].addresses) > 1
 
-    def _retry_shard(self, s: int, method: str, req: bytes,
-                     exc: rpc.RpcError, deadline: Optional[float],
+    @staticmethod
+    def _scheme_miss(exc: rpc.RpcError) -> bool:
+        """A scheme-boundary error: the shard exists and answered, but
+        the SCHEME this client routed under is stale (fenced cutover)
+        or not yet open (importing destination)."""
+        return exc.code in (resilience.ESCHEMEMOVED,
+                            resilience.EMIGRATING)
+
+    def _retry_shard(self, view: _SchemeView, s: int, method: str,
+                     req: bytes, exc: rpc.RpcError,
+                     deadline: Optional[float],
                      tried: Optional[set] = None) -> bytes:
         """A shard's attempt failed on the hedged/sequential path:
         classify, back off, re-route (a replica that just failed is
         excluded, so the retry lands on a SIBLING when one exists), and
-        retry under the batch's remaining budget."""
+        retry under the batch's remaining budget.  Scheme-boundary
+        errors escape immediately — they are view-level, not
+        replica-level."""
         read = method == "Lookup"
         tried = set() if tried is None else tried
         e = exc
         attempt = 0
         reroutes = 0
         while True:
-            reroute = not read and self._reroutable(s, e)
+            if self._scheme_miss(e):
+                raise e
+            reroute = not read and self._reroutable(view, s, e)
             if reroute:
                 reroutes += 1
-                if reroutes > len(self.replica_sets[s].addresses) + 1:
+                if reroutes > len(view.replica_sets[s].addresses) + 1:
                     raise e
             else:
                 policy = self.retry
@@ -2030,8 +2887,8 @@ class RemoteEmbedding:
                 attempt += 1
                 if obs.enabled():
                     obs.counter("rpc_retries").add(1)
-            addr = self._route_read(s, tried) if read \
-                else self._route_write(s, tried)
+            addr = self._route_read(view, s, tried) if read \
+                else self._route_write(view, s, tried)
             tried.add(addr)
             t = None
             if deadline is not None:
@@ -2039,7 +2896,7 @@ class RemoteEmbedding:
             if self.retry is not None:
                 t = self.retry.cap_attempt_timeout(t)
             b = self._addr_breaker(addr)
-            self.scorer.note_start(addr)
+            view.scorer.note_start(addr)
             t0 = time.monotonic()
             try:
                 rsp = self._chan(addr).call("Ps", method, req,
@@ -2047,29 +2904,35 @@ class RemoteEmbedding:
                                             backup_ms=self.backup_ms)
             except rpc.RpcError as e2:
                 routing = e2.code in (resilience.ENOTPRIMARY,
-                                      resilience.EFENCED)
-                self.scorer.note_end(addr, time.monotonic() - t0,
+                                      resilience.EFENCED,
+                                      resilience.EMIGRATING,
+                                      resilience.ESCHEMEMOVED)
+                view.scorer.note_end(addr, time.monotonic() - t0,
                                      routing)
                 if b is not None:
                     b.on_call_end(0 if routing else e2.code)
                 e = e2
                 continue
-            self.scorer.note_end(addr, time.monotonic() - t0, True)
+            view.scorer.note_end(addr, time.monotonic() - t0, True)
             if b is not None:
                 b.on_call_end(0)
             return rsp
 
-    def _fan_out(self, method: str, items: List[tuple]) -> List[bytes]:
-        """Issue every (shard, req) concurrently — each routed to a
-        replica (reads: best live score; writes: the primary) — then
-        collect with the resilience policy applied per shard.  Responses
-        align with ``items``.  Failed shards retry as a CONCURRENT
-        re-fan: each round re-issues the whole failed subset as one
-        native call group after a single backoff sleep, so k failing
-        shards pay max(shard) retry latency, not sum — and each retry is
-        re-routed AWAY from the replica that just failed.  On an
-        unrecoverable shard failure the remaining in-flight calls are
-        cancelled (straggler abandonment) before the error propagates."""
+    def _fan_out(self, view: _SchemeView, method: str,
+                 items: List[tuple], on_done=None) -> List[bytes]:
+        """Issue every (shard, req) concurrently under ``view`` — each
+        routed to a replica (reads: best live score; writes: the
+        primary) — then collect with the resilience policy applied per
+        shard.  Responses align with ``items``; ``on_done(i, rsp)``
+        fires as each lands, so a caller interrupted by a scheme
+        boundary knows exactly which items are acked.  Failed shards
+        retry as a CONCURRENT re-fan: each round re-issues the whole
+        failed subset as one native call group after a single backoff
+        sleep, so k failing shards pay max(shard) retry latency, not
+        sum — and each retry is re-routed AWAY from the replica that
+        just failed.  On an unrecoverable shard failure the remaining
+        in-flight calls are cancelled (straggler abandonment) before
+        the error propagates."""
         deadline = time.monotonic() + self.deadline_ms / 1000.0 \
             if self.deadline_ms is not None else None
         read = method == "Lookup"
@@ -2097,11 +2960,11 @@ class RemoteEmbedding:
         def _start(i: int, s: int, req) -> None:
             """Route item i and start its call; a start failure parks
             the RpcError in pending[i] for classification."""
-            addr = self._route_read(s, tried[i]) if read \
-                else self._route_write(s, tried[i])
+            addr = self._route_read(view, s, tried[i]) if read \
+                else self._route_write(view, s, tried[i])
             addrs[i] = addr
             tried[i].add(addr)
-            self.scorer.note_start(addr)
+            view.scorer.note_start(addr)
             t0s[i] = time.monotonic()
             try:
                 # managed fan-out set: every entry is joined or
@@ -2114,15 +2977,18 @@ class RemoteEmbedding:
 
         def _settle(i: int, pc: object, ok: bool, code: int = 0) -> None:
             """Feed one finished attempt to the scorer + breaker.
-            Routing corrections (ENOTPRIMARY/EFENCED) are PROOF the
-            endpoint is alive — they must not open its breaker or
-            poison its latency score."""
+            Routing corrections (ENOTPRIMARY/EFENCED) and scheme
+            boundaries (EMIGRATING/ESCHEMEMOVED) are PROOF the endpoint
+            is alive — they must not open its breaker or poison its
+            latency score."""
             addr = addrs[i]
             routing = code in (resilience.ENOTPRIMARY,
-                               resilience.EFENCED)
+                               resilience.EFENCED,
+                               resilience.EMIGRATING,
+                               resilience.ESCHEMEMOVED)
             lat = time.monotonic() - t0s[i] \
                 if isinstance(pc, rpc.PendingCall) else None
-            self.scorer.note_end(addr, lat, ok or routing)
+            view.scorer.note_end(addr, lat, ok or routing)
             b = self._addr_breaker(addr)
             if b is not None:
                 b.on_call_end(0 if routing else code)
@@ -2146,11 +3012,13 @@ class RemoteEmbedding:
                             timeout_ms=_budget(), primary=pc)
                     except rpc.RpcError as e:
                         _settle(i, pc, False, e.code)
-                        rsp = self._retry_shard(s, method, req, e,
-                                                deadline, tried[i])
+                        rsp = self._retry_shard(view, s, method, req,
+                                                e, deadline, tried[i])
                     else:
                         _settle(i, pc, True)
                     out[i] = rsp
+                    if on_done is not None:
+                        on_done(i, rsp)
                 return out  # type: ignore[return-value]
             # Unhedged path: completion-ORDER collection over one native
             # fan-in group (the ParallelChannel CountdownEvent shape).
@@ -2164,12 +3032,16 @@ class RemoteEmbedding:
             excs: List[Optional[rpc.RpcError]] = [None] * len(items)
 
             def _classify(i: int, e: rpc.RpcError) -> None:
-                """Queue item i for the next re-fan round, or abort."""
+                """Queue item i for the next re-fan round, or abort.
+                Scheme-boundary errors abort immediately — the caller
+                re-routes the remainder through the successor view."""
+                if self._scheme_miss(e):
+                    raise e
                 s = items[i][0]
-                if not read and self._reroutable(s, e):
+                if not read and self._reroutable(view, s, e):
                     reroutes[i] += 1
                     if reroutes[i] <= \
-                            len(self.replica_sets[s].addresses) + 1:
+                            len(view.replica_sets[s].addresses) + 1:
                         excs[i] = e
                         failed.append(i)
                         return
@@ -2210,6 +3082,8 @@ class RemoteEmbedding:
                     else:
                         _settle(done_i, pc, True)
                         out[done_i] = rsp
+                        if on_done is not None:
+                            on_done(done_i, rsp)
                 if not failed:
                     break
                 # ---- concurrent re-fan of the failed subset: ONE
@@ -2221,7 +3095,7 @@ class RemoteEmbedding:
                 round_delay = 0.0
                 for i in refan:
                     s = items[i][0]
-                    if not read and self._reroutable(s, excs[i]):
+                    if not read and self._reroutable(view, s, excs[i]):
                         continue   # routing correction: no backoff
                     round_delay = max(round_delay,
                                       self.retry.backoff.delay_ms(
@@ -2236,7 +3110,7 @@ class RemoteEmbedding:
                     resilience.sleep_ms(round_delay)
                 for i in refan:
                     s, req = items[i]
-                    if read or not self._reroutable(s, excs[i]):
+                    if read or not self._reroutable(view, s, excs[i]):
                         attempts[i] += 1
                         if obs.enabled():
                             obs.counter("rpc_retries").add(1)
@@ -2253,19 +3127,21 @@ class RemoteEmbedding:
                     pc.cancel()
                     pc.close()
 
-    def _call_shard(self, s: int, method: str, req: bytes) -> bytes:
+    def _call_shard(self, view: _SchemeView, s: int, method: str,
+                    req: bytes) -> bytes:
         """Sequential-path shard call with the same per-shard policy
         (routed; a routing-correction error fails over once)."""
-        addr = self._route_read(s) if method == "Lookup" \
-            else self._route_write(s)
+        addr = self._route_read(view, s) if method == "Lookup" \
+            else self._route_write(view, s)
         try:
             return self._chan(addr).call(
                 "Ps", method, req, retry=self.retry,
                 deadline_ms=self.deadline_ms, backup_ms=self.backup_ms,
                 breaker=self._addr_breaker(addr))
         except rpc.RpcError as e:
-            if method != "Lookup" and self._reroutable(s, e):
-                addr = self._route_write(s, {addr})
+            if method != "Lookup" and not self._scheme_miss(e) and \
+                    self._reroutable(view, s, e):
+                addr = self._route_write(view, s, {addr})
                 return self._chan(addr).call(
                     "Ps", method, req, retry=self.retry,
                     deadline_ms=self.deadline_ms,
@@ -2273,7 +3149,7 @@ class RemoteEmbedding:
                     breaker=self._addr_breaker(addr))
             raise
 
-    def _owner_split(self, flat_ids: np.ndarray):
+    def _owner_split(self, view: _SchemeView, flat_ids: np.ndarray):
         if flat_ids.size and (flat_ids.min() < 0
                               or flat_ids.max() >= self.vocab):
             # An out-of-range id matches no shard: lookup() would otherwise
@@ -2282,11 +3158,79 @@ class RemoteEmbedding:
                 f"ids must be in [0, {self.vocab}); got "
                 f"[{flat_ids.min()}, {flat_ids.max()}]"
             )
-        owners = flat_ids // self.rows_per
-        for s in range(self.n):
+        if view.bounds is None:
+            owners = flat_ids // view.rows_per
+        else:
+            # Explicit row-range map: bounds[s] <= id < bounds[s+1].
+            owners = np.searchsorted(view.bounds, flat_ids,
+                                     side="right") - 1
+        for s in range(view.n):
             mask = owners == s
             if mask.any():
                 yield s, np.nonzero(mask)[0], flat_ids[mask]
+
+    def _read_views(self) -> List[_SchemeView]:
+        """Read routing order: the weighted pick first (traffic share
+        follows each scheme's live capacity weight — the dynpart load
+        balancer's contract), then every other non-retired view newest
+        first as FALLBACKS — a miss on the picked scheme (importing
+        destination, dead retiring shard) re-runs the batch on the
+        next view instead of failing the read."""
+        with self._view_mu:
+            views = [v for v in self._views if v.state != "retired"]
+            self._read_seq += 1
+            seq = self._read_seq
+        order = sorted(views, key=lambda v: -v.version)
+        if len(order) <= 1:
+            return order
+        # only ACTIVE schemes join the weighted pick; preparing (still
+        # importing) and draining schemes serve as fallbacks only
+        active = [v for v in order if v.state == "active"]
+        total = sum(v.weight for v in active)
+        if total <= 0:
+            return order
+        r = resilience._hash01(0x5EED, seq) * total
+        pick = active[0]
+        for v in active:
+            if r < v.weight:
+                pick = v
+                break
+            r -= v.weight
+        return [pick] + [v for v in order if v is not pick]
+
+    def _lookup_view(self, view: _SchemeView, flat: np.ndarray,
+                     out: np.ndarray):
+        """One whole-batch lookup under one scheme view.  Returns
+        ``(bytes_out, bytes_in)``; raises on any shard miss (the caller
+        falls back across schemes)."""
+        nbytes_in = 0
+        nbytes_out = 0
+        if self.parallel:
+            # Start every owner-shard call before joining any: the
+            # shards serve concurrently and the batch pays max(shard),
+            # not sum(shard).  _fan_out applies the per-shard
+            # resilience policy (retry/hedge/breaker) and cancels
+            # stragglers on an unrecoverable partial failure.
+            split = list(self._owner_split(view, flat))
+            items = []
+            for s, positions, owned in split:
+                req = _pack_lookup_req(owned)
+                nbytes_out += len(req)
+                items.append((s, req))
+            for (s, positions, owned), rsp in zip(
+                    split, self._fan_out(view, "Lookup", items)):
+                nbytes_in += len(rsp)
+                out[positions] = np.frombuffer(
+                    rsp, np.float32).reshape(owned.size, self.dim)
+        else:
+            for s, positions, owned in self._owner_split(view, flat):
+                req = _pack_lookup_req(owned)
+                rsp = self._call_shard(view, s, "Lookup", req)
+                out[positions] = np.frombuffer(rsp, np.float32).reshape(
+                    owned.size, self.dim)
+                nbytes_out += len(req)
+                nbytes_in += len(rsp)
+        return nbytes_out, nbytes_in
 
     def lookup(self, ids: np.ndarray) -> np.ndarray:
         rec = obs.enabled()
@@ -2294,33 +3238,23 @@ class RemoteEmbedding:
             t0 = time.monotonic_ns()
         flat = np.asarray(ids, np.int32).reshape(-1)
         out = np.empty((flat.size, self.dim), np.float32)
-        nbytes_in = 0
-        nbytes_out = 0
-        if self.parallel:
-            # Start every owner-shard call before joining any: the shards
-            # serve concurrently and the batch pays max(shard), not
-            # sum(shard).  _fan_out applies the per-shard resilience
-            # policy (retry/hedge/breaker) and cancels stragglers on an
-            # unrecoverable partial failure.
-            split = list(self._owner_split(flat))
-            items = []
-            for s, positions, owned in split:
-                req = _pack_lookup_req(owned)
-                nbytes_out += len(req)
-                items.append((s, req))
-            for (s, positions, owned), rsp in zip(
-                    split, self._fan_out("Lookup", items)):
-                nbytes_in += len(rsp)
-                out[positions] = np.frombuffer(
-                    rsp, np.float32).reshape(owned.size, self.dim)
-        else:
-            for s, positions, owned in self._owner_split(flat):
-                req = _pack_lookup_req(owned)
-                rsp = self._call_shard(s, "Lookup", req)
-                out[positions] = np.frombuffer(rsp, np.float32).reshape(
-                    owned.size, self.dim)
-                nbytes_out += len(req)
-                nbytes_in += len(rsp)
+        # Dual-scheme reads: weighted pick, then fall back across the
+        # remaining schemes on ANY failure — during a live reshard the
+        # other scheme holds the same rows (an importing destination
+        # answers EMIGRATING; a draining scheme's tables are frozen at
+        # exactly the cutover state, so its answers stay correct).
+        views = self._read_views()
+        nbytes_out = nbytes_in = 0
+        for i, view in enumerate(views):
+            try:
+                nbytes_out, nbytes_in = self._lookup_view(view, flat,
+                                                          out)
+                break
+            except rpc.RpcError:
+                if i + 1 >= len(views):
+                    raise
+                if obs.enabled():
+                    obs.counter("ps_scheme_fallback_reads").add(1)
         if rec:
             # Whole-batch latency across all owner shards (each per-shard
             # RPC is additionally recorded by Channel.call/call_async).
@@ -2331,28 +3265,93 @@ class RemoteEmbedding:
             obs.counter("ps_client_bytes_in").add(nbytes_in)
         return out.reshape(*np.shape(ids), self.dim)
 
+    def _apply_unit(self, view: _SchemeView, uids: np.ndarray,
+                    ugrads: np.ndarray, guards: tuple) -> int:
+        """Apply one write unit (global ids + grads + scheme guards)
+        under ``view`` via idempotent ``ApplyGradId`` items, one per
+        owner shard.  Returns bytes sent.  A scheme boundary raises
+        :class:`_SchemeMovedError` carrying the UNAPPLIED remainder —
+        each unacked item becomes a unit whose guard chain grows by its
+        own (writer key, seq), so re-routing it through the successor
+        scheme can never double-apply content that already migrated."""
+        split = list(self._owner_split(view, uids))
+        items = []
+        meta = []
+        nbytes = 0
+        for s, positions, owned in split:
+            wkey = self._unary_writer_key(view, s)
+            seq = view.useq.get(s, 0) + 1
+            view.useq[s] = seq
+            item_guards = guards + ((wkey, seq),)
+            req = bytes(_pack_apply_id_req(wkey, seq, guards, owned,
+                                           ugrads[positions]))
+            nbytes += len(req)
+            items.append((s, req))
+            meta.append((owned, ugrads[positions], item_guards))
+        done: List[Optional[bytes]] = [None] * len(items)
+
+        def _on_done(i: int, rsp) -> None:
+            done[i] = rsp
+            self._note_acked_gen(view, items[i][0], rsp)
+
+        try:
+            if self.parallel:
+                self._fan_out(view, "ApplyGradId", items,
+                              on_done=_on_done)
+            else:
+                for i, (s, req) in enumerate(items):
+                    _on_done(i, self._call_shard(view, s, "ApplyGradId",
+                                                 req))
+        except rpc.RpcError as e:
+            if not self._scheme_miss(e):
+                raise
+            remainder = [(meta[i][0], meta[i][1], meta[i][2])
+                         for i in range(len(items)) if done[i] is None]
+            raise _SchemeMovedError(e.code, remainder) from e
+        return nbytes
+
+    def _apply_units(self, units: List[tuple]) -> int:
+        """Drive write units to completion across scheme moves: a unit
+        interrupted by a cutover re-splits through the refreshed write
+        view (guard chain intact), an EMIGRATING unit waits out the
+        fence→open window with bounded backoff.  Units issue
+        SEQUENTIALLY so per-(scheme, shard) seqs stay in arrival order
+        (one batch normally is one unit — the fan-out inside it is
+        still concurrent)."""
+        nbytes = 0
+        moves = 0
+        backoff = resilience.Backoff(base_ms=5.0, max_ms=100.0)
+        queue = list(units)
+        while queue:
+            view = self._write_view()
+            uids, ugrads, guards = queue[0]
+            try:
+                nbytes += self._apply_unit(view, uids, ugrads, guards)
+            except _SchemeMovedError as e:
+                moves += 1
+                if moves > 16:
+                    raise rpc.RpcError(
+                        e.code, "write could not settle across the "
+                                "scheme cutover (16 rounds)") from e
+                queue[0:1] = e.remainder
+                if e.code == resilience.ESCHEMEMOVED:
+                    if obs.enabled():
+                        obs.counter("ps_scheme_moved_writes").add(1)
+                    self._on_stale_scheme(view, e.__cause__ or e)
+                else:
+                    # cutover window: destinations fenced open shortly
+                    resilience.sleep_ms(backoff.delay_ms(min(moves, 6)))
+                continue
+            queue.pop(0)
+        return nbytes
+
     def apply_gradients(self, ids: np.ndarray, grads: np.ndarray) -> None:
         rec = obs.enabled()
         if rec:
             t0 = time.monotonic_ns()
         flat = np.asarray(ids, np.int32).reshape(-1)
         g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
-        nbytes_out = 0
-        if self.parallel:
-            items = []
-            for s, positions, owned in self._owner_split(flat):
-                req = _pack_apply_req(owned, g[positions])
-                nbytes_out += len(req)
-                items.append((s, req))
-            for (s, _), rsp in zip(items,
-                                   self._fan_out("ApplyGrad", items)):
-                self._note_acked_gen(s, rsp)
-        else:
-            for s, positions, owned in self._owner_split(flat):
-                req = _pack_apply_req(owned, g[positions])
-                self._note_acked_gen(
-                    s, self._call_shard(s, "ApplyGrad", req))
-                nbytes_out += len(req)
+        nbytes_out = self._apply_units([(flat, g, ())])
         if rec:
             obs.recorder("ps_client_apply").record(
                 (time.monotonic_ns() - t0) / 1e9)
@@ -2363,18 +3362,21 @@ class RemoteEmbedding:
     # -- read path: framed deltas over one ordered flow-controlled
     # -- stream per owner shard, feeding the server combiner directly)
 
-    def _push_stream(self, s: int, exclude=frozenset()) -> "rpc.Stream":
+    def _push_stream(self, view: _SchemeView, s: int,
+                     exclude=frozenset()) -> "rpc.Stream":
         st = self._push_streams.get(s)
         if st is None:
-            addr = self._route_write(s, exclude)
-            # The setup request carries the writer id: the server opens
-            # (or re-opens) this writer's monotonic seq window and
-            # answers its high-water mark — the replay cursor.  The
-            # receiver is the fence channel: a primary demoted while
-            # this stream is up notifies instead of silently dropping.
+            addr = self._route_write(view, s, exclude)
+            # The setup request carries the writer key (scheme- and
+            # shard-qualified): the server opens (or re-opens) this
+            # writer's monotonic seq window and answers its high-water
+            # mark — the replay cursor.  The receiver is the fence
+            # channel: a primary demoted (or scheme-fenced) while this
+            # stream is up notifies instead of silently dropping.
             recv = _PushStreamReceiver()
             st = self._chan(addr).stream(
-                "Ps", "StreamApply", self._writer_id.encode(),
+                "Ps", "StreamApply",
+                self._stream_writer_key(view, s).encode(),
                 max_buf_size=self.push_window_bytes, receiver=recv)
             self._push_streams[s] = st
             self._push_addr[s] = addr
@@ -2404,7 +3406,12 @@ class RemoteEmbedding:
         self._push_sent.pop(s, None)
         return self._push_addr.pop(s, None)
 
-    def _push_frames(self, s: int) -> None:
+    def _fence_code(self, recv) -> int:
+        return resilience.ESCHEMEMOVED \
+            if recv is not None and recv.scheme_moved \
+            else resilience.ENOTPRIMARY
+
+    def _push_frames(self, view: _SchemeView, s: int) -> None:
         """Write every unacked frame past the replay cursor to shard
         ``s``'s push stream, RECONNECTING under the embedding's retry
         policy on error: the broken stream is torn down, a fresh one is
@@ -2418,13 +3425,15 @@ class RemoteEmbedding:
         primary re-routes: ENOTPRIMARY/EFENCED (including the fence
         notification on the stream's reply half) fails over immediately;
         a dead endpoint is excluded from the reconnect's routing
-        (redirect mode)."""
+        (redirect mode).  A SCHEME fence (cutover) raises ESCHEMEMOVED
+        to the caller — the unacked window transfers to the successor
+        scheme instead of replaying here."""
         attempt = 0
         fails = 0
         exclude: set = set()
         while True:
             try:
-                st = self._push_stream(s, exclude)
+                st = self._push_stream(view, s, exclude)
                 recv = self._push_recv.get(s)
                 sent = self._push_sent.get(s, 0)
                 frames = self._push_unacked.get(s, [])
@@ -2434,33 +3443,36 @@ class RemoteEmbedding:
                 for seq, body in frames[start:]:
                     if recv is not None and recv.fenced:
                         raise rpc.RpcError(
-                            resilience.ENOTPRIMARY,
-                            f"shard {s} push stream fenced "
-                            f"(primary demoted mid-stream)")
+                            self._fence_code(recv),
+                            f"shard {s} push stream fenced")
                     if seq <= sent:
                         continue
                     st.write(_pack_stream_frame(seq, 0, 0, body))
                     self._push_sent[s] = sent = seq
                 if recv is not None and recv.fenced:
                     raise rpc.RpcError(
-                        resilience.ENOTPRIMARY,
-                        f"shard {s} push stream fenced "
-                        f"(primary demoted mid-stream)")
+                        self._fence_code(recv),
+                        f"shard {s} push stream fenced")
                 return
             except rpc.RpcError as e:
                 addr = self._drop_push_stream(s)
-                rs = self.replica_sets[s]
-                if self._reroutable(s, e):
+                if e.code == resilience.ESCHEMEMOVED:
+                    raise   # cutover: the caller transfers the window
+                rs = view.replica_sets[s]
+                if self._reroutable(view, s, e):
                     fails += 1
                     if fails > len(rs.addresses) + 1:
                         raise
-                    self._failover(s)
+                    self._failover(view, s)
                     continue
                 policy = self.retry
                 # Stream breakage (EPIPE/EINVAL/EFAILEDSOCKET) means
-                # reconnect regardless of the unary retriable set; the
-                # policy still owns the ATTEMPT budget and backoff.
-                reconnectable = e.code in (32, 22, 1009) or \
+                # reconnect regardless of the unary retriable set; an
+                # EMIGRATING destination (cutover still opening) also
+                # retries under the same budget.  The policy still owns
+                # the ATTEMPT budget and backoff.
+                reconnectable = e.code in (32, 22, 1009,
+                                           resilience.EMIGRATING) or \
                     (policy is not None and
                      e.code in policy.retriable)
                 if policy is None or not reconnectable or \
@@ -2485,14 +3497,24 @@ class RemoteEmbedding:
         application is guaranteed only after :meth:`flush_gradients`.
         Requires shards serving ``StreamApply``
         (``PsShardServer(stream=True)``); the unary
-        :meth:`apply_gradients` remains the synchronous/fallback path."""
+        :meth:`apply_gradients` remains the synchronous/fallback path.
+
+        Across a live reshard: a cutover fence (``ESCHEMEMOVED``, as a
+        setup rejection or a -2 fence frame) transfers the ENTIRE
+        unacked window — this batch included — onto the successor
+        scheme as guarded unary writes (exactly-once either side of the
+        boundary), after which pushes stream to the new shards."""
         rec = obs.enabled()
         if rec:
             t0 = time.monotonic_ns()
         flat = np.asarray(ids, np.int32).reshape(-1)
         g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
+        view = self._write_view()
         nbytes_out = 0
-        for s, positions, owned in self._owner_split(flat):
+        shards = []
+        # Frame every owner shard FIRST: a scheme fence hit while
+        # writing shard k must transfer the whole batch, not a prefix.
+        for s, positions, owned in self._owner_split(view, flat):
             body = bytes(_pack_apply_req(owned, g[positions]))
             nbytes_out += len(body)
             seq = self._push_seq.get(s, 0) + 1
@@ -2500,12 +3522,72 @@ class RemoteEmbedding:
             # Unacked until the flush barrier confirms: the window is
             # what a mid-push failover replays onto the new primary.
             self._push_unacked.setdefault(s, []).append((seq, body))
-            self._push_frames(s)
+            shards.append(s)
+        try:
+            for s in shards:
+                self._push_frames(view, s)
+        except rpc.RpcError as e:
+            if e.code != resilience.ESCHEMEMOVED:
+                raise
+            self._transfer_pushes(view, None)
         if rec:
             obs.recorder("ps_client_push").record(
                 (time.monotonic_ns() - t0) / 1e9)
             obs.counter("ps_client_push_keys").add(int(flat.size))
             obs.counter("ps_client_bytes_out").add(nbytes_out)
+
+    def _transfer_pushes(self, old_view: _SchemeView,
+                         new_view: Optional[_SchemeView]) -> None:
+        """Carry the unacked push window across a scheme boundary: for
+        every shard, ask the OLD primary's applied window (WriterSeq —
+        a scheme-fenced primary still answers; its data is frozen and
+        complete) and drop the acked prefix; whatever remains — or the
+        whole window when the old primary is unreachable — re-routes
+        through the successor scheme as GUARDED unary writes: each
+        frame's guard names its (stream writer key, seq), and the
+        destinations inherited the old windows with the migrated rows,
+        so a frame that DID land (and migrated) is dropped server-side
+        while a frame that died with the fence applies exactly once."""
+        tails: List[tuple] = []   # (global ids, grads, guards)
+        for s, frames in sorted(self._push_unacked.items()):
+            if not frames:
+                continue
+            wkey = self._stream_writer_key(old_view, s)
+            applied = None
+            try:
+                rs = old_view.replica_sets[s]
+                addr = rs.addresses[old_view._primary_idx[s]]
+                rsp = self._chan(addr).call(
+                    "Ps", "WriterSeq", wkey.encode(),
+                    timeout_ms=self._ctl_timeout_ms())
+                applied = struct.unpack_from("<qq", rsp, 0)[0]
+            except rpc.RpcError:
+                applied = None   # unreachable: transfer guarded, blind
+            for seq, body in frames:
+                if applied is not None and seq <= applied:
+                    continue
+                (count,) = struct.unpack_from("<i", body, 0)
+                gids = np.frombuffer(body, np.int32, count, 4)
+                grads = np.frombuffer(
+                    body, np.float32, count * self.dim,
+                    4 + 4 * count).reshape(count, self.dim)
+                tails.append((gids, grads, ((wkey, seq),)))
+        for s in list(self._push_streams):
+            self._drop_push_stream(s)
+        self._push_unacked.clear()
+        self._push_seq.clear()
+        self._push_sent.clear()
+        if new_view is None:
+            # make sure a successor exists before re-routing
+            self._on_stale_scheme(
+                old_view, rpc.RpcError(
+                    resilience.ESCHEMEMOVED,
+                    f"scheme v{old_view.version} fenced with no known "
+                    f"successor"))
+        if tails:
+            if obs.enabled():
+                obs.counter("ps_push_transfers").add(len(tails))
+            self._apply_units(tails)
 
     def flush_gradients(self) -> None:
         """Closes every push stream and waits until each shard has
@@ -2517,10 +3599,13 @@ class RemoteEmbedding:
         pushed seq, replaying the unacked tail (failover included) on a
         shortfall — a flush that returns means every pushed delta is
         applied on the live primary and its synced backups; a flush
-        that cannot prove it raises.  The next :meth:`push_gradients`
+        that cannot prove it raises.  A scheme CUTOVER racing the flush
+        transfers the unacked window to the successor scheme instead
+        (guarded — exactly-once).  The next :meth:`push_gradients`
         opens fresh streams.  Raises :class:`rpc.RpcError`
         (ERPCTIMEDOUT) if a shard fails to drain within the embedding's
         timeout."""
+        view = self._wv
         streams, self._push_streams = self._push_streams, {}
         push_addr, self._push_addr = self._push_addr, {}
         recvs, self._push_recv = self._push_recv, {}
@@ -2528,59 +3613,74 @@ class RemoteEmbedding:
         for st in streams.values():
             st.close()
         deadline_s = max(1.0, self.timeout_ms / 1000.0)
+        moved = any(r.scheme_moved for r in recvs.values())
         for s, st in streams.items():
             drained = st.join(timeout_s=deadline_s)
-            replicated = len(self.replica_sets[s].addresses) > 1
-            if not drained and not replicated:
+            replicated = len(view.replica_sets[s].addresses) > 1
+            if not drained and not replicated and not moved:
                 raise rpc.RpcError(
                     1008, f"shard {s} ({push_addr.get(s, '?')}) did not "
                           f"drain its push stream within {deadline_s:.1f}s")
-            # replicated: a wedged/fenced stream is recovered below —
-            # the verify barrier replays onto the live primary
+            # a wedged/fenced stream is recovered below — the verify
+            # barrier replays onto the live primary / successor scheme
+        if moved:
+            self._transfer_pushes(view, None)
+            return
         for s in list(streams):
-            if len(self.replica_sets[s].addresses) > 1:
-                self._confirm_push(s)
+            # EVERY pushed shard verifies the applied window — the
+            # close barrier alone cannot be trusted even unreplicated:
+            # a scheme fence racing the close drops frames server-side
+            # and its -2 notification can land after the client's full
+            # close (discarded); the WriterSeq shortfall is what
+            # reliably routes the tail to the successor scheme.
+            self._confirm_push(view, s)
             self._push_unacked.pop(s, None)
 
-    def _confirm_push(self, s: int) -> None:
+    def _confirm_push(self, view: _SchemeView, s: int) -> None:
         """The zero-lost-acked half of the push barrier on a replicated
         shard: the CURRENT primary's applied window for this writer must
         reach the last pushed seq.  A shortfall means frames died with a
         demoted primary — replay the unacked tail (the reconnect routes
         through failover) and run the close barrier again.  Raises when
         the window cannot be confirmed within the retry budget; the
-        caller's push window stays intact for a later retry."""
+        caller's push window stays intact for a later retry.  A scheme
+        cutover discovered here transfers the window instead."""
         last = self._push_seq.get(s, 0)
         if not last:
             return
+        wkey = self._stream_writer_key(view, s)
         policy = self.retry
         rounds = max(2, policy.max_attempts if policy is not None else 2)
         err: Optional[rpc.RpcError] = None
         for _ in range(rounds):
             addr = None
             try:
-                addr = self._route_write(s)
+                addr = self._route_write(view, s)
                 rsp = self._chan(addr).call(
-                    "Ps", "WriterSeq", self._writer_id.encode(),
+                    "Ps", "WriterSeq", wkey.encode(),
                     timeout_ms=self._ctl_timeout_ms())
             except rpc.RpcError as e:
                 err = e
-                if len(self.replica_sets[s].addresses) > 1 and \
+                if e.code == resilience.ESCHEMEMOVED:
+                    self._transfer_pushes(view, None)
+                    return
+                if len(view.replica_sets[s].addresses) > 1 and \
                         self._redirect:
                     # demoted (reroutable) or dead primary: re-resolve;
                     # a dead endpoint is excluded from the sweep
                     exclude = frozenset()
-                    if addr is not None and not self._reroutable(s, e):
+                    if addr is not None and \
+                            not self._reroutable(view, s, e):
                         exclude = frozenset({addr})
-                    self._failover(s, exclude)
+                    self._failover(view, s, exclude)
                     continue
                 raise
             applied, gen = struct.unpack_from("<qq", rsp, 0)
             if applied >= last:
                 # confirmed on the live primary — NOW the covering gen
                 # is an acked floor for the lossy-promotion guard
-                if gen > self._gen_seen[s]:
-                    self._gen_seen[s] = gen
+                if gen > view._gen_seen[s]:
+                    view._gen_seen[s] = gen
                 return
             if obs.enabled():
                 obs.counter("ps_push_replays").add(1)
@@ -2588,7 +3688,13 @@ class RemoteEmbedding:
                 resilience.ENOTPRIMARY,
                 f"shard {s}: applied window {applied} < last pushed "
                 f"seq {last} after the close barrier")
-            self._push_frames(s)          # replay tail, failover-aware
+            try:
+                self._push_frames(view, s)   # replay tail, failover-aware
+            except rpc.RpcError as e:
+                if e.code != resilience.ESCHEMEMOVED:
+                    raise
+                self._transfer_pushes(view, None)
+                return
             st = self._push_streams.pop(s, None)
             self._push_addr.pop(s, None)
             self._push_recv.pop(s, None)
@@ -2599,6 +3705,9 @@ class RemoteEmbedding:
         raise err  # type: ignore[misc]
 
     def close(self):
+        if self._watcher is not None:
+            self._watcher.stop()
+            self._watcher = None
         if self._prober is not None:
             self._prober.stop()
             self._prober = None

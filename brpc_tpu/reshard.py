@@ -1,0 +1,616 @@
+"""Elastic resharding: live shard split/merge under traffic.
+
+The DynamicPartitionChannel analog over the naming registry (SURVEY
+§2.7 — multiple partitioning schemes live *simultaneously*, traffic
+weighted by capacity; reference ``partition_channel.h:136`` /
+``dynpart_load_balancer.cpp``): a table's partitioning is a versioned
+:class:`brpc_tpu.naming.PartitionScheme`, and growing (or shrinking)
+the shard count is a RUNTIME operation, not a redeploy:
+
+1. **Copy** — every source shard (the retiring scheme's primaries)
+   streams its rows to the successor scheme's shards: a
+   :class:`MigrationShipper` per source ships a range-filtered
+   ``MigrateSync`` (rows pinned at one generation — the PR-4/PR-6
+   handle-generation discipline) and then every APPLIED batch over the
+   same ``ReplicaApply`` framing as replication, per-writer dedup
+   windows riding along so replay stays idempotent across the scheme
+   boundary.  Writes keep landing on the source the whole time.
+2. **Cutover** — ``SchemeFence``: the source stops admitting writes
+   (stale-scheme writers get ``ESCHEMEMOVED``, the redirect error that
+   triggers client scheme refresh — the PR-9 EFENCED machinery one
+   level up), drains what it already admitted, and flushes the final
+   generation to every destination.  Then ``CompleteImport`` opens the
+   destinations (which until now answered ``EMIGRATING`` so reads fell
+   back to the source scheme) and the registry publishes the successor
+   as the active scheme.
+3. **Drain & retire** — the retired scheme keeps serving READS (its
+   tables are frozen at exactly the cutover state, so they stay
+   correct) while clients refresh and its traffic weight decays to
+   zero; once its shards go idle the scheme is retired and its servers
+   released.
+
+:class:`MigrationDriver` orchestrates the phases over plain control
+RPCs — it holds no data path and can run anywhere.  The shipper runs
+INSIDE the source server process (installed by the ``MigrateStart``
+control), because only the source can enqueue applied batches under
+its own write lock in apply order.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from brpc_tpu import obs, resilience, rpc
+from brpc_tpu.analysis.race import checked_lock
+from brpc_tpu.naming import (NamingClient, PartitionScheme,
+                             publish_scheme)
+from brpc_tpu.ps_remote import (_pack_apply_req, _pack_stream_frame,
+                                _pack_windows)
+
+
+class _ShipperAckReceiver:
+    """Source-side read half of a migration stream: collects the
+    destination's per-frame watermark acks."""
+
+    __slots__ = ("_shipper", "_addr")
+
+    def __init__(self, shipper, addr: str):
+        self._shipper = shipper
+        self._addr = addr
+
+    def on_data(self, data: bytes) -> None:
+        (gen,) = struct.unpack_from("<q", data, 0)
+        self._shipper._note_ack(self._addr, gen)
+
+    def on_closed(self) -> None:
+        self._shipper._note_closed(self._addr)
+
+
+class _TargetState:
+    """One destination shard's handoff state (owned by its worker
+    thread; queue/ack fields shared under the shipper lock)."""
+
+    __slots__ = ("addr", "base", "rows", "queue", "wake", "stream",
+                 "synced_gen", "acked_gen", "last_gen", "need_sync",
+                 "down", "refused")
+
+    def __init__(self, addr: str, base: int, rows: int):
+        self.addr = addr
+        self.base = base
+        self.rows = rows
+        self.queue: collections.deque = collections.deque()
+        self.wake = threading.Event()
+        self.stream: "Optional[rpc.Stream]" = None
+        self.synced_gen = -1
+        self.acked_gen = -1
+        #: highest source generation that actually SHIPPED something to
+        #: this target (batches with no ids in the target's range skip
+        #: the queue; the flush barrier waits on this, not the raw gen)
+        self.last_gen = -1
+        self.need_sync = True
+        self.down = False
+        #: terminal: the destination refused (import already completed)
+        self.refused = False
+
+
+class MigrationShipper:
+    """Source-side row-range handoff: one worker thread per destination
+    ships a consistent range Sync (rows + windows pinned at one
+    generation under the read lock) and then every applied batch,
+    range-filtered, in apply order, over a persistent ``MigrateApply``
+    stream.  ``ship`` is an append under the shipper lock — the
+    applying writer never blocks on a slow destination; a destination
+    more than ``max_queue`` batches behind is resynced wholesale.
+    ``flush(target_gen)`` is the cutover barrier: it returns only once
+    EVERY destination holds everything shipped up to ``target_gen`` —
+    unlike the replication flush, an unreachable destination is waited
+    for (and times out loudly), never skipped: cutover must not
+    complete with a hole."""
+
+    def __init__(self, server, targets: List[dict], scheme: int,
+                 max_queue: int = 1024, timeout_ms: int = 5000):
+        self._server = server
+        self.scheme = int(scheme)
+        self.max_queue = max_queue
+        self.timeout_ms = timeout_ms
+        self._mu = checked_lock("ps.migrate")
+        self._stop = threading.Event()
+        self._ack_ev = threading.Event()
+        self._chans: Dict[str, rpc.Channel] = {}
+        self._targets = [_TargetState(t["addr"], int(t["base"]),
+                                      int(t["rows"])) for t in targets]
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        """Spawn the per-destination workers.  MUST be called only
+        after this shipper is INSTALLED as the server's migrator: the
+        workers' range snapshots race the apply path otherwise — a
+        batch applied between a worker's snapshot and the installation
+        would neither be in the snapshot nor shipped (a silently lost
+        update, found the hard way)."""
+        if self._threads:
+            return
+        for t in self._targets:
+            th = threading.Thread(target=self._worker, args=(t,),
+                                  daemon=True,
+                                  name=f"brt-migrate-{t.addr}")
+            th.start()
+            self._threads.append(th)
+
+    # -- the apply path's side (non-blocking, under the shard write lock)
+
+    def ship(self, gen: int, gids: np.ndarray, grads: np.ndarray,
+             windows: Dict[str, int]) -> None:
+        """Enqueue one applied batch (GLOBAL ids) for every destination
+        whose range it touches.  Batches are filtered per target — an
+        untouched target's watermark is advanced by the flush barrier's
+        ``last_gen`` accounting instead of an empty frame."""
+        wire_windows = _pack_windows(windows)
+        shipped = 0
+        for t in self._targets:
+            mask = (gids >= t.base) & (gids < t.base + t.rows)
+            if not mask.any():
+                continue
+            body = wire_windows + bytes(
+                _pack_apply_req(gids[mask], grads[mask]))
+            frame = bytes(_pack_stream_frame(gen, self.scheme, gen,
+                                             body))
+            with self._mu:
+                t.queue.append((gen, frame))
+                t.last_gen = gen
+                if len(t.queue) > self.max_queue:
+                    # Hopelessly behind: resync wholesale on reconnect
+                    # rather than holding every batch in memory.
+                    t.queue.clear()
+                    t.need_sync = True
+            t.wake.set()
+            shipped += 1
+        if shipped and obs.enabled():
+            obs.counter("ps_migrate_frames").add(shipped)
+
+    # -- ack plumbing ------------------------------------------------------
+
+    def _note_ack(self, addr: str, gen: int) -> None:
+        with self._mu:
+            for t in self._targets:
+                if t.addr == addr and gen > t.acked_gen:
+                    t.acked_gen = gen
+        self._ack_ev.set()
+
+    def _note_closed(self, addr: str) -> None:
+        with self._mu:
+            for t in self._targets:
+                if t.addr == addr:
+                    t.need_sync = True
+        self._ack_ev.set()
+
+    def state(self) -> Dict[str, dict]:
+        with self._mu:
+            return {t.addr: {
+                "acked": t.acked_gen, "pending": len(t.queue),
+                "synced": t.stream is not None and not t.need_sync,
+                "down": t.down, "refused": t.refused,
+            } for t in self._targets}
+
+    def flush(self, target_gen: int, timeout_s: float = 5.0) -> None:
+        """Returns once every destination holds everything shipped at
+        or below ``target_gen``: its sync landed, its queue drained,
+        and its last relevant frame was acked.  Raises ERPCTIMEDOUT
+        naming the laggard, or ESCHEMEMOVED if a destination refused
+        (completed import) — both mean the cutover must not proceed as
+        if the handoff were complete."""
+        deadline = time.monotonic() + timeout_s
+        for t in self._targets:
+            while True:
+                with self._mu:
+                    live = (t.stream is not None and not t.need_sync
+                            and not t.down)
+                    settled = (live and not t.queue
+                               and t.acked_gen >= min(t.last_gen,
+                                                      target_gen)
+                               and t.synced_gen >= 0)
+                    refused = t.refused
+                if refused:
+                    raise rpc.RpcError(
+                        resilience.ESCHEMEMOVED,
+                        f"destination {t.addr} refused the handoff "
+                        f"(import already completed)")
+                if settled or self._stop.is_set():
+                    break
+                if time.monotonic() > deadline:
+                    raise rpc.RpcError(
+                        1008,
+                        f"destination {t.addr} did not settle at gen "
+                        f"{target_gen} within {timeout_s:.1f}s "
+                        f"(acked {t.acked_gen}, pending "
+                        f"{len(t.queue)}, down={t.down})")
+                self._ack_ev.clear()
+                self._ack_ev.wait(0.005)
+
+    # -- per-destination worker -------------------------------------------
+
+    def _channel(self, addr: str) -> "Optional[rpc.Channel]":
+        """None once the shipper stopped — a worker racing ``stop``
+        must not recreate a channel behind the closed set."""
+        with self._mu:
+            if self._stop.is_set():
+                return None
+            ch = self._chans.get(addr)
+            if ch is None:
+                ch = rpc.Channel(addr, timeout_ms=self.timeout_ms)
+                self._chans[addr] = ch
+            return ch
+
+    def _connect(self, t: _TargetState) -> bool:
+        """Range handoff then a fresh delta stream: ``MigrateSync``
+        ships a consistent (gen, rows, windows) slice — the destination
+        installs it wholesale — and the stream resumes from that
+        generation (queued frames at or below it are ship-skipped)."""
+        gen, rows, windows = self._server._migration_snapshot(
+            t.base, t.rows)
+        src = self._server.address.encode()
+        ch = self._channel(t.addr)
+        if ch is None:
+            return False
+        try:
+            ch.call("Ps", "MigrateSync",
+                    struct.pack("<qqqq", self.scheme, gen, t.base,
+                                t.rows)
+                    + struct.pack("<i", len(src)) + src
+                    + rows + _pack_windows(windows),
+                    timeout_ms=self.timeout_ms)
+            st = ch.stream("Ps", "MigrateApply",
+                           struct.pack("<q", self.scheme)
+                           + struct.pack("<i", len(src)) + src,
+                           receiver=_ShipperAckReceiver(self, t.addr))
+        except rpc.RpcError as e:
+            if e.code == resilience.ESCHEMEMOVED:
+                with self._mu:
+                    t.refused = True
+                self._ack_ev.set()
+                return False
+            with self._mu:
+                t.down = True
+            self._ack_ev.set()
+            if obs.enabled():
+                obs.counter("ps_migrate_connect_errors").add(1)
+            return False
+        with self._mu:
+            t.stream = st
+            t.synced_gen = gen
+            t.need_sync = False
+            t.down = False
+            if gen > t.acked_gen:
+                t.acked_gen = gen   # the Sync response IS the ack
+            if gen > t.last_gen:
+                t.last_gen = gen
+        self._ack_ev.set()
+        if obs.enabled():
+            obs.counter("ps_migrate_syncs_out").add(1)
+        return True
+
+    def _worker(self, t: _TargetState) -> None:
+        backoff = resilience.Backoff(base_ms=5.0, max_ms=200.0)
+        fails = 0
+        while not self._stop.is_set():
+            with self._mu:
+                refused = t.refused
+                item = t.queue[0] if (t.queue and not t.need_sync
+                                      and t.stream is not None) else None
+                need_connect = (not refused
+                                and (t.need_sync or t.stream is None))
+            if refused:
+                return
+            if need_connect:
+                old, t.stream = t.stream, None
+                if old is not None:
+                    old.close()   # rx stream: close (abort strands relay)
+                if self._connect(t):
+                    fails = 0
+                else:
+                    if self._stop.is_set() or t.refused:
+                        return
+                    fails += 1
+                    resilience.sleep_ms(backoff.delay_ms(min(fails, 6)))
+                continue
+            if item is None:
+                t.wake.wait(0.05)
+                t.wake.clear()
+                continue
+            gen, frame = item
+            if gen <= t.synced_gen:
+                with self._mu:
+                    if t.queue and t.queue[0] is item:
+                        t.queue.popleft()
+                continue
+            try:
+                t.stream.write(frame)
+            except rpc.RpcError:
+                st, t.stream = t.stream, None
+                if st is not None:
+                    st.close()
+                with self._mu:
+                    t.need_sync = True
+                continue  # frame stays queued; resync covers ordering
+            with self._mu:
+                if t.queue and t.queue[0] is item:
+                    t.queue.popleft()
+
+    def stop(self, join: bool = True) -> None:
+        self._stop.set()
+        self._ack_ev.set()
+        for t in self._targets:
+            t.wake.set()
+        if join:
+            for th in self._threads:
+                th.join(timeout=5)
+        for t in self._targets:
+            st, t.stream = t.stream, None
+            if st is not None:
+                st.close()
+        for ch in self._chans.values():
+            ch.close()
+        self._chans.clear()
+
+
+# ---------------------------------------------------------------------------
+# the migration driver (control plane only — runs anywhere)
+# ---------------------------------------------------------------------------
+
+def _overlaps(lo_a: int, hi_a: int, lo_b: int, hi_b: int) -> bool:
+    return lo_a < hi_b and lo_b < hi_a
+
+
+class MigrationDriver:
+    """Drives one live reshard ``old_scheme -> new_scheme`` end to end
+    over control RPCs:
+
+    - :meth:`start` installs a :class:`MigrationShipper` on every
+      source primary (``MigrateStart`` with its overlapping
+      destinations);
+    - :meth:`wait_caught_up` polls ``MigrateState`` until every
+      destination synced and drained its queue;
+    - :meth:`cutover` fences every source (``SchemeFence`` — the write
+      redirect + final flush), then opens every destination
+      (``CompleteImport``), then publishes the scheme transition to the
+      registry (successor active, retiring scheme draining at weight
+      0);
+    - :meth:`wait_drained` watches the retiring shards' read counters
+      until traffic stops; :meth:`retire` publishes the retired state
+      (the owner then closes the old servers, releasing their tables);
+    - :meth:`abort` tears the shippers down and leaves the old scheme
+      exactly as it was (the untouched write path) — the destination
+      servers stay importing and can simply be closed.
+
+    ``run()`` chains copy → catch-up → cutover and returns a summary.
+    The driver never touches row data; a lost driver can re-run any
+    phase (every control is idempotent)."""
+
+    def __init__(self, old_scheme: PartitionScheme,
+                 new_scheme: PartitionScheme, vocab: int, *,
+                 registry_addr: Optional[str] = None,
+                 cluster: Optional[str] = None,
+                 timeout_ms: int = 10_000):
+        if new_scheme.version <= old_scheme.version:
+            raise ValueError(
+                f"successor version {new_scheme.version} must exceed "
+                f"{old_scheme.version}")
+        self.old = old_scheme
+        self.new = new_scheme
+        self.vocab = vocab
+        self.registry_addr = registry_addr
+        self.cluster = cluster
+        self.timeout_ms = timeout_ms
+        self._chans: Dict[str, rpc.Channel] = {}
+        self._registry: Optional[NamingClient] = None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _chan(self, addr: str) -> rpc.Channel:
+        ch = self._chans.get(addr)
+        if ch is None:
+            ch = rpc.Channel(addr, timeout_ms=self.timeout_ms)
+            self._chans[addr] = ch
+        return ch
+
+    def _naming(self) -> Optional[NamingClient]:
+        if self.registry_addr is None:
+            return None
+        if self._registry is None:
+            self._registry = NamingClient(self.registry_addr)
+        return self._registry
+
+    @staticmethod
+    def _primary(scheme: PartitionScheme, s: int) -> str:
+        rs = scheme.replica_sets[s]
+        return rs.addresses[rs.primary]
+
+    def targets_for(self, s: int) -> List[dict]:
+        """The successor shards overlapping source shard ``s``, each
+        with the INTERSECTION row range it receives from this source
+        (a merge destination collects slices from several sources)."""
+        olo, ohi = self.old.shard_bounds(s, self.vocab)
+        out = []
+        for d in range(self.new.num_shards):
+            nlo, nhi = self.new.shard_bounds(d, self.vocab)
+            if _overlaps(olo, ohi, nlo, nhi):
+                lo, hi = max(olo, nlo), min(ohi, nhi)
+                out.append({"addr": self._primary(self.new, d),
+                            "base": lo, "rows": hi - lo})
+        return out
+
+    # -- phases ------------------------------------------------------------
+
+    def start(self) -> Dict[int, int]:
+        """Install the shippers; returns each source's generation at
+        start time.  Idempotent: re-issuing replaces the shipper and
+        the destinations resync wholesale.  With a registry, the
+        successor is published as PREPARING first — a writer fenced in
+        the cutover-to-publication gap already finds its redirect
+        target."""
+        reg = self._naming()
+        if reg is not None and self.cluster is not None:
+            publish_scheme(reg, self.cluster,
+                           self.new.with_(state="preparing"))
+        gens: Dict[int, int] = {}
+        for s in range(self.old.num_shards):
+            spec = json.dumps({"scheme": self.new.version,
+                               "targets": self.targets_for(s)})
+            rsp = self._chan(self._primary(self.old, s)).call(
+                "Ps", "MigrateStart", spec.encode(),
+                timeout_ms=self.timeout_ms)
+            gens[s] = struct.unpack_from("<q", rsp, 0)[0]
+        return gens
+
+    def migrate_state(self, s: int) -> dict:
+        rsp = self._chan(self._primary(self.old, s)).call(
+            "Ps", "MigrateState", b"", timeout_ms=self.timeout_ms)
+        return json.loads(rsp)
+
+    def wait_caught_up(self, deadline_s: float = 30.0,
+                       poll_ms: float = 20.0) -> None:
+        """Blocks until every destination of every source is synced
+        with an empty ship queue (the copy phase is done and deltas
+        flow at wire rate — cutover will only have the in-flight tail
+        to flush)."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            lagging = []
+            for s in range(self.old.num_shards):
+                st = self.migrate_state(s)
+                if not st["active"]:
+                    lagging.append((s, "no shipper"))
+                    continue
+                for addr, t in st["targets"].items():
+                    if t["refused"]:
+                        raise rpc.RpcError(
+                            resilience.ESCHEMEMOVED,
+                            f"destination {addr} refused shard {s}'s "
+                            f"handoff")
+                    if not t["synced"] or t["pending"] or t["down"]:
+                        lagging.append((s, addr))
+            if not lagging:
+                return
+            if time.monotonic() > deadline:
+                raise rpc.RpcError(
+                    1008, f"copy phase did not catch up within "
+                          f"{deadline_s:.1f}s; lagging: {lagging}")
+            resilience.sleep_ms(poll_ms)
+
+    def cutover(self) -> Dict[int, int]:
+        """The fenced scheme switch: fence every source (writes start
+        redirecting, final generations flush to the destinations), then
+        open every destination, then publish the transition.  Returns
+        each source's FINAL generation.  Only after every fence
+        succeeded are destinations opened — a half-fenced cutover never
+        exposes a destination that could still receive source syncs."""
+        final: Dict[int, int] = {}
+        for s in range(self.old.num_shards):
+            rsp = self._chan(self._primary(self.old, s)).call(
+                "Ps", "SchemeFence",
+                struct.pack("<q", self.new.version),
+                timeout_ms=self.timeout_ms)
+            final[s] = struct.unpack_from("<q", rsp, 0)[0]
+        for d in range(self.new.num_shards):
+            self._chan(self._primary(self.new, d)).call(
+                "Ps", "CompleteImport", b"",
+                timeout_ms=self.timeout_ms)
+        if obs.enabled():
+            obs.counter("reshard_cutovers").add(1)
+        self.publish()
+        return final
+
+    def publish(self) -> None:
+        """Publish the post-cutover scheme records: the successor
+        ACTIVE at its declared weight, the retiring scheme DRAINING at
+        weight 0 (reads may still fall back to it; no new traffic is
+        weighted onto it).  No-op without a registry."""
+        reg = self._naming()
+        if reg is None or self.cluster is None:
+            return
+        publish_scheme(reg, self.cluster,
+                       self.new.with_(state="active"))
+        publish_scheme(reg, self.cluster,
+                       self.old.with_(state="draining", weight=0.0))
+
+    def run(self, deadline_s: float = 60.0) -> Dict[str, object]:
+        """copy → catch-up → cutover; returns a summary."""
+        t0 = time.monotonic()
+        start_gens = self.start()
+        self.wait_caught_up(deadline_s=deadline_s)
+        final = self.cutover()
+        return {
+            "old_version": self.old.version,
+            "new_version": self.new.version,
+            "start_gens": start_gens,
+            "final_gens": final,
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+
+    # -- drain & retire ----------------------------------------------------
+
+    def reads(self) -> int:
+        """Total reads ever served by the RETIRING scheme's shards."""
+        total = 0
+        for s in range(self.old.num_shards):
+            info = json.loads(self._chan(self._primary(self.old, s))
+                              .call("Ps", "SchemeInfo", b"",
+                                    timeout_ms=self.timeout_ms))
+            total += int(info.get("reads", 0))
+        return total
+
+    def wait_drained(self, idle_s: float = 0.5,
+                     deadline_s: float = 30.0) -> bool:
+        """True once the retiring shards served NO read for ``idle_s``
+        — the observable form of "the old scheme's traffic weight
+        drained to zero"."""
+        deadline = time.monotonic() + deadline_s
+        last = self.reads()
+        while time.monotonic() <= deadline:
+            resilience.sleep_ms(idle_s * 1000.0)
+            cur = self.reads()
+            if cur == last:
+                return True
+            last = cur
+        return False
+
+    def retire(self) -> None:
+        """Publish the retiring scheme as RETIRED (clients must drop
+        it).  The owner of the old servers closes them afterwards —
+        that close releases their native tables, which is the handle-
+        ledger half of the retirement proof."""
+        reg = self._naming()
+        if reg is not None and self.cluster is not None:
+            publish_scheme(reg, self.cluster,
+                           self.old.with_(state="retired", weight=0.0))
+        if obs.enabled():
+            obs.counter("reshard_retired").add(1)
+
+    def abort(self) -> None:
+        """Stop every shipper; the old scheme keeps serving exactly as
+        before (its write path was never touched).  The importing
+        destinations are left for their owner to close."""
+        for s in range(self.old.num_shards):
+            try:
+                self._chan(self._primary(self.old, s)).call(
+                    "Ps", "MigrateStop", b"",
+                    timeout_ms=self.timeout_ms)
+            except rpc.RpcError:
+                pass  # a dead source has nothing left to stop
+        if obs.enabled():
+            obs.counter("reshard_aborts").add(1)
+
+    def close(self) -> None:
+        for ch in self._chans.values():
+            ch.close()
+        self._chans.clear()
+        if self._registry is not None:
+            self._registry.close()
+            self._registry = None

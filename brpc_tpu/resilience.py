@@ -56,7 +56,8 @@ from brpc_tpu.analysis.race import checked_lock
 
 __all__ = [
     "Backoff", "sleep_ms", "RetryPolicy", "RETRIABLE_CODES",
-    "EBREAKEROPEN", "ENOTPRIMARY", "EFENCED", "call_with_retry",
+    "EBREAKEROPEN", "ENOTPRIMARY", "EFENCED", "EMIGRATING",
+    "ESCHEMEMOVED", "call_with_retry",
     "backup_call", "resilient_call", "BreakerOptions", "CircuitBreaker",
     "BreakerRegistry", "HealthProber", "ReplicaScorer",
     "default_registry", "set_default_registry", "health_components",
@@ -72,6 +73,15 @@ ENOTPRIMARY = 2009
 #: exists and the sender must demote itself (never retriable — retrying
 #: the same epoch yields the same rejection)
 EFENCED = 2010
+#: the shard is still IMPORTING its row range (a resharding migration
+#: destination before cutover completes): reads should fall back to
+#: another partition scheme, writes should wait out the cutover window
+EMIGRATING = 2011
+#: the shard's partition scheme was retired by a fenced cutover: the
+#: caller holds a stale scheme and must refresh its routing (the
+#: redirect error that drives client scheme refresh during a live
+#: reshard — never retriable against the same scheme)
+ESCHEMEMOVED = 2012
 
 #: native error codes worth retrying: the request may never have reached
 #: the server, or the failure is transient by construction.  Application
@@ -674,6 +684,52 @@ class ReplicaScorer:
                              ep, self.prior_ms), 3),
                          "inflight": self._inflight.get(ep, 0)}
                     for ep in sorted(eps)}
+
+    def scoped(self, namespace: str) -> "ReplicaScorer":
+        """A view of this scorer whose bookkeeping keys are prefixed
+        with ``namespace`` — per-SCHEME replica scoring during a live
+        reshard (the same address serving two partition schemes scores
+        independently per scheme, so one scheme's routing state can
+        drain without poisoning the other's).  An empty namespace is
+        this scorer itself."""
+        if not namespace:
+            return self
+        return _ScopedScorer(self, namespace)
+
+
+class _ScopedScorer:
+    """Key-prefixing facade over a shared :class:`ReplicaScorer` (see
+    :meth:`ReplicaScorer.scoped`).  ``pick`` accepts and returns RAW
+    addresses; only the score bookkeeping is namespaced."""
+
+    __slots__ = ("_base", "_ns")
+
+    def __init__(self, base: ReplicaScorer, namespace: str):
+        self._base = base
+        self._ns = namespace + "|"
+
+    def note_start(self, endpoint: str) -> None:
+        self._base.note_start(self._ns + endpoint)
+
+    def note_end(self, endpoint: str, latency_s: Optional[float],
+                 ok: bool) -> None:
+        self._base.note_end(self._ns + endpoint, latency_s, ok)
+
+    def score(self, endpoint: str) -> float:
+        return self._base.score(self._ns + endpoint)
+
+    def pick(self, candidates: List[str]) -> Optional[str]:
+        best, best_score = None, None
+        for ep in candidates:
+            s = self.score(ep)
+            if best_score is None or s < best_score:
+                best, best_score = ep, s
+        return best
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        full = self._base.snapshot()
+        return {ep[len(self._ns):]: d for ep, d in full.items()
+                if ep.startswith(self._ns)}
 
 
 # ---------------------------------------------------------------------------

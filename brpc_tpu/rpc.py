@@ -386,6 +386,15 @@ def debug_handle_counts() -> dict:
     return out
 
 
+def debug_handle_count(kind: str) -> int:
+    """Live native-object count for ONE handle kind (e.g. ``ps_shard``,
+    ``server``) straight from the C++ atomics — the cheap point probe
+    behind retirement proofs: after a resharding drain, the retired
+    scheme's shards must return the ``ps_shard``/``server`` counts to
+    their pre-scale-out baseline."""
+    return int(_load().brt_debug_handle_count(kind.encode()))
+
+
 def debug_fail_connections(addr: str) -> int:
     """Fails every live client connection to ``addr`` ("ip:port") —
     exactly what the peer observes when the process holding those

@@ -370,7 +370,8 @@ def test_ps_path_records_counters():
         # per-shard recorders saw one Lookup + one ApplyGrad each
         dump = obs.dump_exposed_dict("ps_server_shard")
         assert dump["ps_server_shard0_Lookup"]["count"] == 1
-        assert dump["ps_server_shard1_ApplyGrad"]["count"] == 1
+        # apply_gradients rides the idempotent unary write method
+        assert dump["ps_server_shard1_ApplyGradId"]["count"] == 1
         # dump_exposed shows live ps_* lines after the instrumented path
         assert "ps_client_lookup" in obs.dump_exposed("ps_")
     finally:

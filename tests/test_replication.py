@@ -571,12 +571,15 @@ def test_seq_window_survives_failover_no_double_apply():
     try:
         emb.push_gradients(ids, delta)
         emb.push_gradients(ids, delta)
+        # the wire writer key is scheme- and shard-qualified (seq
+        # spaces must not collide inside migrated dedup windows)
+        wkey = emb._stream_writer_key(emb._wv, 0)
         deadline = time.monotonic() + 5.0
         while time.monotonic() < deadline and \
-                backup._writer_applied.get(emb._writer_id, 0) < 2:
+                backup._writer_applied.get(wkey, 0) < 2:
             time.sleep(0.01)
-        assert backup._writer_applied.get(emb._writer_id, 0) == 2
-        assert backup._writer_seqs.get(emb._writer_id, 0) == 2
+        assert backup._writer_applied.get(wkey, 0) == 2
+        assert backup._writer_seqs.get(wkey, 0) == 2
         ch = rpc.Channel(backup.address, timeout_ms=5000)
         try:
             ch.call("Ps", "Promote", struct.pack("<q", 1))
