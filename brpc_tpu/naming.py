@@ -24,9 +24,10 @@ Two higher-level records also live in the same registry namespace:
   §2.7): clients weight read traffic across them and the migration
   driver walks a scheme through active → draining → retired.
 - primary/epoch CLAIMS — shard tags may carry an ``@e<epoch>P|B``
-  suffix refreshed per heartbeat (``register(tag_fn=...)``), so
-  failover state converges from one shared view instead of every
-  client re-sweeping replicas (see ``parse_claim_tag``).
+  suffix (scheme-scoped form ``@v<scheme>e<epoch>P|B``) refreshed per
+  heartbeat (``register(tag_fn=...)``), so failover state converges
+  from one shared view instead of every client re-sweeping replicas
+  (see ``parse_claim_tag``).
 """
 
 from __future__ import annotations
@@ -75,18 +76,24 @@ class ReplicaSet:
 
 def shard_tag(shard: int, num_shards: int, replica: int = 0, *,
               epoch: Optional[int] = None,
-              primary: Optional[bool] = None) -> str:
+              primary: Optional[bool] = None,
+              scheme: Optional[int] = None) -> str:
     """Registration tag for shard ``shard`` of ``num_shards``: replica 0
     keeps the legacy two-field form so pre-replication registrants and
     resolvers interoperate.  ``epoch``/``primary`` append a CLAIM suffix
     (``@e<epoch>P`` or ``@e<epoch>B``) — the server's current failover
     state, refreshed per heartbeat via ``register(tag_fn=...)`` so
-    clients can adopt the claimed primary without sweeping replicas."""
+    clients can adopt the claimed primary without sweeping replicas.
+    ``scheme`` scopes the claim to one partition scheme VERSION
+    (``@v<scheme>e<epoch>P``): two coexisting schemes with the same
+    shard count (a bounds-only reshard, a merge back) must not mask
+    each other's claims, mirroring the per-scheme writer keys."""
     base = f"{shard}/{num_shards}" if replica == 0 \
         else f"{shard}/{num_shards}/{replica}"
     if epoch is None:
         return base
-    return f"{base}@e{epoch}{'P' if primary else 'B'}"
+    ver = "" if scheme is None else f"v{scheme}"
+    return f"{base}@{ver}e{epoch}{'P' if primary else 'B'}"
 
 
 def parse_shard_tag(tag: str) -> Optional[Tuple[int, int, int]]:
@@ -107,22 +114,35 @@ def parse_shard_tag(tag: str) -> Optional[Tuple[int, int, int]]:
     return shard, num, replica
 
 
-def parse_claim_tag(tag: str
-                    ) -> Optional[Tuple[int, int, int, int, bool]]:
-    """``(shard, num_shards, replica, epoch, is_primary)`` from a
-    claim-suffixed shard tag, or ``None`` when the tag carries no claim
-    (plain shard tags parse with :func:`parse_shard_tag`)."""
+def parse_claim_tag(
+        tag: str
+) -> Optional[Tuple[int, int, int, int, bool, Optional[int]]]:
+    """``(shard, num_shards, replica, epoch, is_primary, scheme)`` from
+    a claim-suffixed shard tag, or ``None`` when the tag carries no
+    claim (plain shard tags parse with :func:`parse_shard_tag`).
+    ``scheme`` is ``None`` for legacy unscoped claims (``@e<epoch>P``);
+    scheme-scoped claims carry ``@v<scheme>e<epoch>P``."""
     base = parse_shard_tag(tag)
     if base is None or "@" not in tag:
         return None
     suffix = tag.split("@", 1)[1]
+    scheme: Optional[int] = None
+    if suffix.startswith("v"):
+        head, sep, rest = suffix[1:].partition("e")
+        if not sep:
+            return None
+        try:
+            scheme = int(head)
+        except ValueError:
+            return None
+        suffix = "e" + rest
     if not suffix.startswith("e") or suffix[-1] not in ("P", "B"):
         return None
     try:
         epoch = int(suffix[1:-1])
     except ValueError:
         return None
-    return base[0], base[1], base[2], epoch, suffix[-1] == "P"
+    return base[0], base[1], base[2], epoch, suffix[-1] == "P", scheme
 
 
 #: lifecycle states a published scheme moves through: ``preparing``
@@ -259,21 +279,25 @@ def parse_schemes(nodes: Sequence[dict]) -> Dict[int, PartitionScheme]:
     return out
 
 
-def parse_claims(nodes: Sequence[dict]
-                 ) -> Dict[Tuple[int, int], Tuple[int, str]]:
+def parse_claims(
+        nodes: Sequence[dict]
+) -> Dict[Tuple[Optional[int], int, int], Tuple[int, str]]:
     """Primary claims from claim-suffixed shard tags:
-    ``{(num_shards, shard): (epoch, addr)}`` keeping the highest epoch
-    per shard.  Only PRIMARY claims are returned — a backup's claim
-    says who it is, not who owns the range."""
-    out: Dict[Tuple[int, int], Tuple[int, str]] = {}
+    ``{(scheme, num_shards, shard): (epoch, addr)}`` keeping the
+    highest epoch per key.  Claims are SCOPED per scheme version so two
+    coexisting schemes with the same shard count never mask each other
+    (``scheme`` is ``None`` for legacy unscoped claims).  Only PRIMARY
+    claims are returned — a backup's claim says who it is, not who owns
+    the range."""
+    out: Dict[Tuple[Optional[int], int, int], Tuple[int, str]] = {}
     for n in nodes:
         parsed = parse_claim_tag(n.get("tag", ""))
         if parsed is None:
             continue
-        shard, num, _replica, epoch, is_primary = parsed
+        shard, num, _replica, epoch, is_primary, scheme = parsed
         if not is_primary:
             continue
-        key = (num, shard)
+        key = (scheme, num, shard)
         if key not in out or epoch >= out[key][0]:
             out[key] = (epoch, n["addr"])
     return out

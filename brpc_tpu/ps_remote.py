@@ -1076,7 +1076,8 @@ class PsShardServer:
         from brpc_tpu import naming
         return naming.shard_tag(self.shard_index, self.num_shards,
                                 self._replica_index, epoch=self._epoch,
-                                primary=self._primary_flag)
+                                primary=self._primary_flag,
+                                scheme=self.scheme_version)
 
     def _reads(self) -> int:
         """Total reads ever served (Python + native path) — the drain
@@ -1563,30 +1564,59 @@ class PsShardServer:
                         resilience.EMIGRATING,
                         f"shard {self.shard_index} is importing; an "
                         f"importing destination cannot be fenced")
+                was_fenced = self._scheme_fenced
+                prev_next = self._next_scheme
                 self._scheme_fenced = True
                 self._next_scheme = int(ver)
-            if self._combiner is not None:
-                # Drain what was admitted before the flag: entries that
-                # lost the race bounce with ESCHEMEMOVED (their callers
-                # re-route with guards) — expected, not a fence failure.
-                try:
-                    self._combiner.flush()
-                except rpc.RpcError as e:
-                    if e.code != resilience.ESCHEMEMOVED:
-                        raise
-            self.flush_replication()
-            mig = self._migrator
-            # The WRITE lock is the fence barrier: any apply that
-            # passed the admission check before the flag has either
-            # bumped the generation (covered by the flush below) or
-            # will refuse inside the lock after we release it.
-            with self._mu.write():
-                gen = self._install_gen
-            if mig is not None:
-                mig.flush(gen, timeout_s=self.repl_ack_timeout_s)
+            try:
+                if self._combiner is not None:
+                    # Drain what was admitted before the flag: entries
+                    # that lost the race bounce with ESCHEMEMOVED
+                    # (their callers re-route with guards) — expected,
+                    # not a fence failure.
+                    try:
+                        self._combiner.flush()
+                    except rpc.RpcError as e:
+                        if e.code != resilience.ESCHEMEMOVED:
+                            raise
+                self.flush_replication()
+                mig = self._migrator
+                # The WRITE lock is the fence barrier: any apply that
+                # passed the admission check before the flag has either
+                # bumped the generation (covered by the flush below) or
+                # will refuse inside the lock after we release it.
+                with self._mu.write():
+                    gen = self._install_gen
+                if mig is not None:
+                    mig.flush(gen, timeout_s=self.repl_ack_timeout_s)
+            except BaseException:
+                # A fence that cannot PROVE the handoff must not stick:
+                # with no successor ever published, a stuck flag would
+                # refuse every write forever while no scheme owns the
+                # range.  Roll back (unless a previous fence already
+                # completed — a failed re-issue must not unfence a
+                # cut-over shard) and let the driver retry or abort.
+                if not was_fenced:
+                    with self._repl_mu:
+                        self._scheme_fenced = False
+                        self._next_scheme = prev_next
+                raise
             if obs.enabled():
                 obs.counter("ps_scheme_fences").add(1)
             return struct.pack("<q", gen)
+        if method == "SchemeUnfence":
+            # Abort-path rollback (MigrationDriver.abort): a cutover
+            # that fenced SOME sources and then failed leaves them
+            # refusing writes with no successor ever published; this
+            # control readmits writes under the retiring scheme.  Must
+            # not be issued after a COMPLETED cutover (the destinations
+            # are open and own the ranges).
+            with self._repl_mu:
+                self._scheme_fenced = False
+                self._next_scheme = None
+            if obs.enabled():
+                obs.counter("ps_scheme_unfences").add(1)
+            return b""
         if method == "MigrateSync":
             # Range handoff: install the source's rows for (a slice of)
             # this shard's range wholesale, at the source's pinned
@@ -1647,7 +1677,7 @@ class PsShardServer:
         if method in ("ReplicaState", "Promote", "Sync", "WriterSeq",
                       "Flush", "SchemeInfo", "MigrateStart",
                       "MigrateState", "MigrateStop", "SchemeFence",
-                      "MigrateSync", "CompleteImport"):
+                      "SchemeUnfence", "MigrateSync", "CompleteImport"):
             return self._serve_control(method, payload)
         if method == "ApplyGradId":
             return self._serve_apply_id(payload)
@@ -2218,14 +2248,20 @@ class _SchemeWatcher(threading.Thread):
                 if self._stop.wait(0.2):
                     break
                 continue
-            self._emb._ingest_nodes(nodes)
+            try:
+                self._emb._ingest_nodes(nodes)
+            except Exception:  # noqa: BLE001 — a bad published record
+                # must not kill the watch loop: the client would then
+                # silently miss every later cutover/retire/claim.
+                if obs.enabled():
+                    obs.counter("ps_scheme_ingest_errors").add(1)
 
     def refresh(self) -> None:
         try:
             nodes, _ = self._reg.list(self._cluster)
+            self._emb._ingest_nodes(nodes)
         except Exception:  # noqa: BLE001 — caller keeps its stale view
             return
-        self._emb._ingest_nodes(nodes)
 
     def stop(self) -> None:
         self._stop.set()
@@ -2405,6 +2441,12 @@ class RemoteEmbedding:
         #: stream.  Cleared only when the flush barrier confirms.  A
         #: SCHEME move re-routes them as guarded unary writes.
         self._push_unacked: Dict[int, List[tuple]] = {}
+        #: transfer units (ids, grads, guards) that survived a FAILED
+        #: scheme-boundary transfer: the guards make re-driving them
+        #: idempotent, and the next flush/transfer must drain them
+        #: before it may report success — a failed transfer never
+        #: silently drops pushed deltas.
+        self._push_carry: List[tuple] = []
         self.retry = retry
         self.deadline_ms = deadline_ms
         self.backup_ms = backup_ms
@@ -2511,13 +2553,17 @@ class RemoteEmbedding:
         with self._view_mu:
             return [v.scheme for v in self._views]
 
-    def set_schemes(self, schemes: Sequence[PartitionScheme]) -> None:
+    def set_schemes(self, schemes: Sequence[PartitionScheme],
+                    strict: bool = True) -> None:
         """Adopt the given scheme records: known versions take the new
         weight/state (topology per version is immutable), unknown ones
         become routing views, RETIRED ones are dropped — after which no
         read or write ever routes to them again.  Safe to call from a
         watcher thread; the write view itself only switches on the
-        writer's thread (see ``_write_view``)."""
+        writer's thread (see ``_write_view``).  With ``strict=False``
+        (the registry-ingest path) a record this client cannot build a
+        view for is skipped instead of raising, so one bad publication
+        never blocks the usable ones."""
         by_ver = {sc.version: sc for sc in schemes}
         fresh: List[_SchemeView] = []
         with self._view_mu:
@@ -2526,7 +2572,13 @@ class RemoteEmbedding:
                 if ver in known:
                     known[ver].update(sc)
                 elif sc.state != "retired":
-                    fresh.append(_SchemeView(self, sc))
+                    try:
+                        fresh.append(_SchemeView(self, sc))
+                    except ValueError:
+                        if strict:
+                            raise
+                        if obs.enabled():
+                            obs.counter("ps_scheme_rejects").add(1)
         for v in fresh:
             self._admit_view(v)
             if obs.enabled():
@@ -2566,18 +2618,29 @@ class RemoteEmbedding:
         self._watcher.start()
 
     def _ingest_nodes(self, nodes) -> None:
-        """Registry listing → scheme views + primary claims."""
+        """Registry listing → scheme views + primary claims.  Ingest is
+        non-strict: a published scheme this client cannot route (bounds
+        not ending at its vocab, shard count not dividing it) is
+        counted and skipped — the watcher must keep consuming the
+        records it CAN use."""
         schemes = parse_schemes(nodes)
         if schemes:
-            self.set_schemes(list(schemes.values()))
+            self.set_schemes(list(schemes.values()), strict=False)
         claims = parse_claims(nodes)
         if claims:
             with self._view_mu:
                 self._claims.update(claims)
 
     def _claim_for(self, view: _SchemeView, s: int):
+        """This view's claim for shard ``s`` — claims are keyed per
+        scheme VERSION so coexisting schemes with equal shard counts
+        never mask each other; a legacy unscoped claim (``scheme``
+        ``None``) is accepted only when no scoped one exists."""
         with self._view_mu:
-            return self._claims.get((view.n, s))
+            claim = self._claims.get((view.version, view.n, s))
+            if claim is None:
+                claim = self._claims.get((None, view.n, s))
+            return claim
 
     def _write_view(self) -> _SchemeView:
         """The view owning WRITES: the newest active scheme.  Switching
@@ -3547,8 +3610,31 @@ class RemoteEmbedding:
         frame's guard names its (stream writer key, seq), and the
         destinations inherited the old windows with the migrated rows,
         so a frame that DID land (and migrated) is dropped server-side
-        while a frame that died with the fence applies exactly once."""
-        tails: List[tuple] = []   # (global ids, grads, guards)
+        while a frame that died with the fence applies exactly once.
+
+        FAILURE SAFETY: the unacked window is consumed only once a
+        successor view is known, and the transfer units re-stash into
+        ``_push_carry`` if applying them fails partway — either way a
+        later :meth:`flush_gradients` still holds (and must drain) the
+        full window, so a failed transfer can never turn into a
+        vacuously successful flush over dropped deltas."""
+        # The fenced streams are dead either way; the unacked WINDOW is
+        # the source of truth and must survive any failure below.
+        for s in list(self._push_streams):
+            self._drop_push_stream(s)
+        if new_view is None:
+            # Resolve a successor BEFORE consuming the window: with no
+            # discovery path this raises (window intact — the caller
+            # retries once a successor is published).
+            self._on_stale_scheme(
+                old_view, rpc.RpcError(
+                    resilience.ESCHEMEMOVED,
+                    f"scheme v{old_view.version} fenced with no known "
+                    f"successor"))
+        # units from a PREVIOUS failed transfer re-drive first (guards
+        # keep them exactly-once)
+        tails: List[tuple] = self._push_carry   # (ids, grads, guards)
+        self._push_carry = []
         for s, frames in sorted(self._push_unacked.items()):
             if not frames:
                 continue
@@ -3572,22 +3658,20 @@ class RemoteEmbedding:
                     body, np.float32, count * self.dim,
                     4 + 4 * count).reshape(count, self.dim)
                 tails.append((gids, grads, ((wkey, seq),)))
-        for s in list(self._push_streams):
-            self._drop_push_stream(s)
         self._push_unacked.clear()
         self._push_seq.clear()
         self._push_sent.clear()
-        if new_view is None:
-            # make sure a successor exists before re-routing
-            self._on_stale_scheme(
-                old_view, rpc.RpcError(
-                    resilience.ESCHEMEMOVED,
-                    f"scheme v{old_view.version} fenced with no known "
-                    f"successor"))
         if tails:
             if obs.enabled():
                 obs.counter("ps_push_transfers").add(len(tails))
-            self._apply_units(tails)
+            try:
+                self._apply_units(tails)
+            except BaseException:
+                # Re-stash the WHOLE batch (applied units are dropped
+                # server-side by their guards) so the next flush
+                # re-drives it instead of succeeding over a hole.
+                self._push_carry = tails
+                raise
 
     def flush_gradients(self) -> None:
         """Closes every push stream and waits until each shard has
@@ -3626,15 +3710,33 @@ class RemoteEmbedding:
         if moved:
             self._transfer_pushes(view, None)
             return
-        for s in list(streams):
+        for s in sorted(set(streams) | set(self._push_unacked)):
             # EVERY pushed shard verifies the applied window — the
             # close barrier alone cannot be trusted even unreplicated:
             # a scheme fence racing the close drops frames server-side
             # and its -2 notification can land after the client's full
             # close (discarded); the WriterSeq shortfall is what
-            # reliably routes the tail to the successor scheme.
+            # reliably routes the tail to the successor scheme.  Shards
+            # holding unacked frames with NO live stream (a transfer
+            # that failed before consuming the window) verify too —
+            # their replay is what re-drives the stranded window.
             self._confirm_push(view, s)
             self._push_unacked.pop(s, None)
+        self._drain_carry()
+
+    def _drain_carry(self) -> None:
+        """Re-drive transfer units stranded by a FAILED scheme-boundary
+        transfer.  Part of the flush barrier: a flush may only report
+        success once the carry is empty (the guards make a re-drive of
+        already-applied units exactly-once)."""
+        if not self._push_carry:
+            return
+        tails, self._push_carry = self._push_carry, []
+        try:
+            self._apply_units(tails)
+        except BaseException:
+            self._push_carry = tails
+            raise
 
     def _confirm_push(self, view: _SchemeView, s: int) -> None:
         """The zero-lost-acked half of the push barrier on a replicated
@@ -3722,6 +3824,7 @@ class RemoteEmbedding:
         self._push_recv.clear()
         self._push_sent.clear()
         self._push_unacked.clear()
+        self._push_carry.clear()
         for c in self._chans.values():
             c.close()
         self._chans.clear()

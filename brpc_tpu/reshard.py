@@ -203,9 +203,10 @@ class MigrationShipper:
         """Returns once every destination holds everything shipped at
         or below ``target_gen``: its sync landed, its queue drained,
         and its last relevant frame was acked.  Raises ERPCTIMEDOUT
-        naming the laggard, or ESCHEMEMOVED if a destination refused
-        (completed import) — both mean the cutover must not proceed as
-        if the handoff were complete."""
+        naming the laggard (also when the shipper is STOPPED before the
+        wait settles — an abort racing the fence), or ESCHEMEMOVED if a
+        destination refused (completed import) — all mean the cutover
+        must not proceed as if the handoff were complete."""
         deadline = time.monotonic() + timeout_s
         for t in self._targets:
             while True:
@@ -222,8 +223,18 @@ class MigrationShipper:
                         resilience.ESCHEMEMOVED,
                         f"destination {t.addr} refused the handoff "
                         f"(import already completed)")
-                if settled or self._stop.is_set():
+                if settled:
                     break
+                if self._stop.is_set():
+                    # A stop/abort racing the cutover flush must fail
+                    # it loudly: returning would let the fence report
+                    # success without every destination holding the
+                    # final generation.
+                    raise rpc.RpcError(
+                        1008,
+                        f"migration shipper stopped before destination "
+                        f"{t.addr} confirmed gen {target_gen} "
+                        f"(acked {t.acked_gen})")
                 if time.monotonic() > deadline:
                     raise rpc.RpcError(
                         1008,
@@ -594,16 +605,25 @@ class MigrationDriver:
             obs.counter("reshard_retired").add(1)
 
     def abort(self) -> None:
-        """Stop every shipper; the old scheme keeps serving exactly as
-        before (its write path was never touched).  The importing
-        destinations are left for their owner to close."""
+        """Stop every shipper AND unfence every source, so the old
+        scheme keeps serving exactly as before: a cutover that fenced
+        some sources and then failed (laggard destination, driver
+        crash) would otherwise leave them refusing writes forever with
+        no successor ever published.  The importing destinations are
+        left for their owner to close.  Must not be called after a
+        COMPLETED cutover — the destinations are open and own the
+        ranges then."""
         for s in range(self.old.num_shards):
+            addr = self._primary(self.old, s)
             try:
-                self._chan(self._primary(self.old, s)).call(
+                self._chan(addr).call(
                     "Ps", "MigrateStop", b"",
                     timeout_ms=self.timeout_ms)
+                self._chan(addr).call(
+                    "Ps", "SchemeUnfence", b"",
+                    timeout_ms=self.timeout_ms)
             except rpc.RpcError:
-                pass  # a dead source has nothing left to stop
+                pass  # a dead source has nothing left to roll back
         if obs.enabled():
             obs.counter("reshard_aborts").add(1)
 

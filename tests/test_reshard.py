@@ -107,13 +107,20 @@ def test_partition_scheme_roundtrip_and_validation():
 def test_claim_tags_roundtrip():
     assert shard_tag(1, 4, 0, epoch=3, primary=True) == "1/4@e3P"
     assert shard_tag(1, 4, 2, epoch=0, primary=False) == "1/4/2@e0B"
+    assert shard_tag(1, 4, 0, epoch=3, primary=True, scheme=7) \
+        == "1/4@v7e3P"
     # claim-unaware resolvers still parse the shard part
     assert parse_shard_tag("1/4@e3P") == (1, 4, 0)
     assert parse_shard_tag("1/4/2@e0B") == (1, 4, 2)
-    assert parse_claim_tag("1/4@e3P") == (1, 4, 0, 3, True)
-    assert parse_claim_tag("1/4/2@e0B") == (1, 4, 2, 0, False)
+    assert parse_shard_tag("1/4@v7e3P") == (1, 4, 0)
+    # legacy claims parse with scheme=None; scoped ones carry it
+    assert parse_claim_tag("1/4@e3P") == (1, 4, 0, 3, True, None)
+    assert parse_claim_tag("1/4/2@e0B") == (1, 4, 2, 0, False, None)
+    assert parse_claim_tag("1/4@v7e3P") == (1, 4, 0, 3, True, 7)
     assert parse_claim_tag("1/4") is None
     assert parse_claim_tag("1/4@zzz") is None
+    assert parse_claim_tag("1/4@vxe3P") is None
+    assert parse_claim_tag("1/4@v7") is None
 
 
 def test_parse_schemes_and_claims_from_nodes():
@@ -132,7 +139,7 @@ def test_parse_schemes_and_claims_from_nodes():
     schemes = parse_schemes(nodes)
     assert schemes[0].state == "draining"      # last occurrence wins
     claims = parse_claims(nodes)
-    assert claims[(1, 0)] == (2, "a:1")        # primary claim only
+    assert claims[(None, 1, 0)] == (2, "a:1")  # primary claim only
     with pytest.raises(ValueError):
         scheme_record_addr(70000)
 
@@ -517,7 +524,8 @@ def test_failover_adopts_registry_claim_without_sweeping():
             ch.call("Ps", "Promote", struct.pack("<q", 1))
         finally:
             ch.close()
-        assert parse_claim_tag(backup.claim_tag()) == (0, 1, 1, 1, True)
+        assert parse_claim_tag(backup.claim_tag()) \
+            == (0, 1, 1, 1, True, 0)
         emb._ingest_nodes([{"addr": backup.address,
                             "tag": backup.claim_tag()}])
         # primary dies; the next write must adopt the CLAIMED primary
@@ -551,7 +559,7 @@ def test_heartbeat_republishes_claim_tag():
     try:
         nc.register("ps", sv.address, ttl_ms=300, tag_fn=sv.claim_tag)
         nodes, _ = nc.list("ps")
-        assert parse_claims(nodes)[(1, 0)] == (0, sv.address)
+        assert parse_claims(nodes)[(0, 1, 0)] == (0, sv.address)
         # state changes; the next heartbeat re-publishes the new claim
         with sv._repl_mu:
             sv._epoch = 3
@@ -559,7 +567,7 @@ def test_heartbeat_republishes_claim_tag():
         claim = None
         while time.monotonic() < deadline:
             nodes, _ = nc.list("ps")
-            claim = parse_claims(nodes).get((1, 0))
+            claim = parse_claims(nodes).get((0, 1, 0))
             if claim == (3, sv.address):
                 break
             time.sleep(0.05)
@@ -568,3 +576,185 @@ def test_heartbeat_republishes_claim_tag():
         nc.close()
         sv.close()
         reg_server.close()
+
+
+# ---------------------------------------------------------------------------
+# review regressions: failure paths of the transfer/fence machinery
+# ---------------------------------------------------------------------------
+
+def test_push_window_survives_failed_transfer_then_drains():
+    """A fence with NO known successor must fail the push/flush loudly
+    while keeping the unacked window intact — a later flush (once the
+    successor is published) drains it exactly once.  Regression: the
+    window used to be cleared before the successor lookup, so the
+    frames were silently dropped and the next flush vacuously
+    succeeded."""
+    old = _servers(1)
+    new = _servers(1, version=1)       # live successor, not yet known
+    emb = RemoteEmbedding([_scheme(old, 0)], VOCAB, DIM,
+                          timeout_ms=5000, retry=_retry_policy())
+    ids = np.arange(8, dtype=np.int32)
+    grads = np.ones((8, DIM), np.float32)
+    try:
+        ch = rpc.Channel(old[0].address, timeout_ms=5000)
+        try:
+            ch.call("Ps", "SchemeFence", struct.pack("<q", 1))
+        finally:
+            ch.close()
+        before_new = new[0].table.copy()
+        with pytest.raises(rpc.RpcError):
+            emb.push_gradients(ids, grads)   # redirect, nowhere to go
+        assert any(emb._push_unacked.values())
+        with pytest.raises(rpc.RpcError):
+            emb.flush_gradients()            # still loud, never vacuous
+        assert any(emb._push_unacked.values()) or emb._push_carry
+        emb.set_schemes([_scheme(new, 1)])   # successor published
+        emb.flush_gradients()
+        assert not any(emb._push_unacked.values())
+        assert not emb._push_carry
+        expect = before_new.copy()
+        expect[ids] -= np.float32(1.0)
+        assert np.array_equal(new[0].table, expect)
+        emb.flush_gradients()                # nothing left to re-apply
+        assert np.array_equal(new[0].table, expect)
+    finally:
+        emb.close()
+        _close_all(old, new)
+
+
+def test_fence_rolls_back_when_final_flush_fails():
+    """SchemeFence whose migration flush cannot settle (dead
+    destination) must not leave the source stuck fenced: the flag rolls
+    back, writes are readmitted, and the driver can retry or abort."""
+    old = _servers(1)
+    old[0].repl_ack_timeout_s = 0.5
+    emb = RemoteEmbedding([_scheme(old, 0)], VOCAB, DIM,
+                          timeout_ms=5000, retry=_retry_policy())
+    ids = np.arange(8, dtype=np.int32)
+    ch = rpc.Channel(old[0].address, timeout_ms=5000)
+    try:
+        spec = json.dumps({"scheme": 1, "targets": [
+            {"addr": "127.0.0.1:9", "base": 0, "rows": VOCAB}]})
+        ch.call("Ps", "MigrateStart", spec.encode())
+        with pytest.raises(rpc.RpcError):
+            ch.call("Ps", "SchemeFence", struct.pack("<q", 1))
+        info = json.loads(ch.call("Ps", "SchemeInfo", b""))
+        assert not info["fenced"]
+        assert info["next_scheme"] is None
+        ch.call("Ps", "MigrateStop", b"")
+        before = old[0].table.copy()
+        emb.apply_gradients(ids, np.ones((8, DIM), np.float32))
+        expect = before.copy()
+        expect[ids] -= np.float32(1.0)
+        assert np.array_equal(old[0].table, expect)
+    finally:
+        ch.close()
+        emb.close()
+        _close_all(old)
+
+
+def test_abort_unfences_every_source():
+    """A cutover that fenced a source and then died strands writers
+    unless abort() rolls the fence back: MigrateStop alone used to
+    leave the source answering ESCHEMEMOVED forever with no successor
+    ever published."""
+    old = _servers(1)
+    sc0 = _scheme(old, 0)
+    sc1 = PartitionScheme(1, (ReplicaSet.of("127.0.0.1:9"),))
+    emb = RemoteEmbedding([sc0], VOCAB, DIM, timeout_ms=5000,
+                          retry=_retry_policy())
+    ids = np.arange(8, dtype=np.int32)
+    drv = MigrationDriver(sc0, sc1, VOCAB, timeout_ms=2000)
+    try:
+        ch = rpc.Channel(old[0].address, timeout_ms=5000)
+        try:
+            ch.call("Ps", "SchemeFence", struct.pack("<q", 1))
+        finally:
+            ch.close()
+        with pytest.raises(rpc.RpcError):
+            emb.apply_gradients(ids, np.ones((8, DIM), np.float32))
+        before = old[0].table.copy()
+        drv.abort()                      # MigrateStop + SchemeUnfence
+        emb.apply_gradients(ids, np.full((8, DIM), 0.5, np.float32))
+        expect = before.copy()
+        expect[ids] -= np.float32(0.5)
+        assert np.array_equal(old[0].table, expect)
+    finally:
+        drv.close()
+        emb.close()
+        _close_all(old)
+
+
+def test_ingest_skips_unroutable_scheme_records():
+    """A published scheme this client cannot build a view for (shard
+    count not dividing its vocab) must not kill ingestion — the watcher
+    keeps consuming the records it CAN use.  Direct set_schemes stays
+    strict."""
+    from brpc_tpu.naming import SCHEME_TAG_PREFIX, scheme_record_addr
+    old = _servers(1)
+    emb = RemoteEmbedding([_scheme(old, 0)], VOCAB, DIM,
+                          timeout_ms=5000, retry=_retry_policy())
+    bad = PartitionScheme(3, tuple(
+        ReplicaSet.of(f"127.0.0.1:{p}") for p in (11, 12, 13)))
+    assert VOCAB % 3                     # genuinely unroutable
+    rejects0 = int(obs.counter("ps_scheme_rejects").get_value())
+    try:
+        emb._ingest_nodes([
+            {"addr": scheme_record_addr(3),
+             "tag": SCHEME_TAG_PREFIX + bad.to_json()},
+            {"addr": old[0].address,
+             "tag": shard_tag(0, 1, epoch=5, primary=True, scheme=0)},
+        ])                               # must not raise
+        assert int(obs.counter("ps_scheme_rejects").get_value()) \
+            == rejects0 + 1
+        # the claim in the same listing still landed
+        assert emb._claims[(0, 1, 0)] == (5, old[0].address)
+        assert [v.version for v in emb._views] == [0]
+        with pytest.raises(ValueError):
+            emb.set_schemes([bad])       # the public API stays strict
+    finally:
+        emb.close()
+        _close_all(old)
+
+
+def test_claims_scoped_per_scheme_version():
+    """Two coexisting schemes with the SAME shard count must not mask
+    each other's primary claims; a view prefers its own scheme's claim
+    and falls back to a legacy unscoped one only when no scoped claim
+    exists."""
+    claims = parse_claims([
+        {"addr": "a:1", "tag": "0/2@v0e4P"},
+        {"addr": "b:1", "tag": "0/2@v1e9P"},
+        {"addr": "c:1", "tag": "0/2@e2P"},
+    ])
+    assert claims[(0, 2, 0)] == (4, "a:1")
+    assert claims[(1, 2, 0)] == (9, "b:1")
+    assert claims[(None, 2, 0)] == (2, "c:1")
+    old = _servers(2)
+    emb = RemoteEmbedding([_scheme(old, 0)], VOCAB, DIM,
+                          timeout_ms=5000)
+    try:
+        with emb._view_mu:
+            emb._claims.update(claims)
+        view = emb._wv
+        # v1's higher epoch no longer masks this view's own claim
+        assert emb._claim_for(view, 0) == (4, "a:1")
+        with emb._view_mu:
+            del emb._claims[(0, 2, 0)]
+        assert emb._claim_for(view, 0) == (2, "c:1")   # legacy fallback
+    finally:
+        emb.close()
+        _close_all(old)
+
+
+def test_shipper_flush_raises_when_stopped_early():
+    """A stop/abort racing the cutover flush must fail it loudly — a
+    fence that 'succeeds' without every destination holding the final
+    generation is exactly the hole the barrier exists to close."""
+    from brpc_tpu.reshard import MigrationShipper
+    sh = MigrationShipper(None, [{"addr": "x:1", "base": 0, "rows": 8}],
+                          scheme=1)
+    sh._stop.set()
+    with pytest.raises(rpc.RpcError) as ei:
+        sh.flush(3, timeout_s=1.0)
+    assert "stopped" in str(ei.value)
