@@ -28,6 +28,7 @@ import os
 import threading
 import time
 
+from brpc_tpu.analysis import fuzz as wire_fuzz
 from brpc_tpu.analysis import handles, race
 
 
@@ -136,6 +137,25 @@ def _bench_handles() -> dict:
     return out
 
 
+def _bench_fuzz() -> dict:
+    """Fuzz throughput per parser (execs/sec, memcheck off — the raw
+    mutation+parse loop): how much hostile-input coverage one core buys
+    per second, and the deterministic proof the seeded run stays green
+    at bench scale too."""
+    report = wire_fuzz.run(seed=0, iters=2000, memcheck=False)
+    out = {
+        "unit": "execs/sec per parser (seed 0, 2000 iters, memcheck "
+                "off)",
+        "ok": report["ok"],
+        "failures": len(report["failures"]),
+        "per_parser": {name: stats["execs_per_sec"]
+                       for name, stats in report["targets"].items()},
+    }
+    total = sum(stats["execs"] for stats in report["targets"].values())
+    out["total_execs"] = total
+    return out
+
+
 def main() -> dict:
     race.set_enabled(None)
     os.environ.pop("BRPC_TPU_RACECHECK", None)
@@ -175,6 +195,7 @@ def main() -> dict:
         "with_stmt_off_ns": round(_per_op_ns(_with_loop(off), n), 1),
         "ops_per_measurement": n,
         "handle_ledger": _bench_handles(),
+        "fuzz": _bench_fuzz(),
     }
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),

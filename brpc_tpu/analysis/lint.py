@@ -3,7 +3,7 @@
 The reference enforces its concurrency contracts with purpose-built
 tooling (contention profiler, bthread diagnostics, builtin hazard pages);
 this is the equivalent static pass for the hazards our fabric creates.
-Seven checks, each encoding an invariant the runtime cannot enforce, the
+Eight checks, each encoding an invariant the runtime cannot enforce, the
 concurrency ones interprocedural over the whole-package call graph
 (:mod:`brpc_tpu.analysis.callgraph` — the lockdep/TSan polarity: follow
 the calls, not the file):
@@ -77,6 +77,18 @@ the calls, not the file):
   needs its destroy symbol declared.  The dynamic complement is the
   handle ledger (:mod:`brpc_tpu.analysis.handles`,
   ``BRPC_TPU_HANDLECHECK=1``).
+- ``wire-contract`` — frame-schema symmetry and parse-path bounds for
+  every hand-rolled framing: ``_pack_X``/``_unpack_X`` pairs must move
+  the same field stream (order + width), every site registered in
+  :mod:`brpc_tpu.wire`'s schema registry must match its declared
+  scalar sequence (exactly for dedicated functions, in-order
+  subsequence for shared multi-frame handlers), struct formats must be
+  explicit little-endian, counts/lengths read off the wire on
+  handler-reachable parse paths must reach a bounds check before they
+  drive a size/loop, and every declared schema/text parser must have a
+  fuzz target (:mod:`brpc_tpu.analysis.fuzz` — the "fuzzers for every
+  parser" gate).  The dynamic complement is the structure-aware fuzzer
+  itself.
 
 Findings carry a stable id (hash of check + package-relative path +
 message, deliberately line-free) so CI can diff against an accepted
@@ -108,11 +120,12 @@ __all__ = ["Finding", "run_lint", "lint_files", "main", "ALL_CHECKS",
 
 ALL_CHECKS = ("ctypes-contract", "fiber-shared-state", "obs-guard",
               "trace-purity", "lock-order", "fiber-blocking-sleep",
-              "handle-lifecycle")
+              "handle-lifecycle", "wire-contract")
 
 #: checks that need the whole-package call graph
 _GRAPH_CHECKS = {"fiber-shared-state", "trace-purity", "lock-order",
-                 "fiber-blocking-sleep", "handle-lifecycle"}
+                 "fiber-blocking-sleep", "handle-lifecycle",
+                 "wire-contract"}
 
 #: attribute names that look like a lock on self / a module
 _LOCKISH = ("mu", "lock", "mutex")
@@ -555,10 +568,15 @@ def _callback_locals_shallow(scope: ast.AST, protos: Set[str]
 # ---------------------------------------------------------------------------
 
 def _find_handler_roots(sc: _FileScan, graph: CallGraph,
-                        top: Optional[FuncNode]) -> List[str]:
+                        top: Optional[FuncNode],
+                        register_names: Tuple[str, ...] = (
+                            "add_service", "add_async_service"),
+                        ) -> List[str]:
     """Node ids of handlers registered via add_service/add_async_service
     anywhere in this file (``self.X`` methods, bare function names,
-    partial targets)."""
+    partial targets).  ``register_names`` widens the registration set
+    (the wire-contract check also treats ``add_ps_service`` /
+    ``add_stream_handler`` trampoline targets as hostile-input roots)."""
     roots: List[str] = []
 
     def visit(node: ast.AST, ctx: Optional[FuncNode]) -> None:
@@ -568,8 +586,7 @@ def _find_handler_roots(sc: _FileScan, graph: CallGraph,
                 visit(child, inner or ctx)
             return
         if isinstance(node, ast.Call) and ctx is not None and \
-                _last_name(node.func) in ("add_service",
-                                          "add_async_service"):
+                _last_name(node.func) in register_names:
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 tgt = graph.resolve_callable_expr(arg, ctx)
                 if tgt is not None:
@@ -1909,6 +1926,448 @@ def _audit_attr_stores(
 
 
 # ---------------------------------------------------------------------------
+# check: wire-contract (frame-schema symmetry + parse-path bounds)
+# ---------------------------------------------------------------------------
+
+_PACK_DIRS = {"pack", "pack_into"}
+_UNPACK_DIRS = {"unpack", "unpack_from"}
+#: sanctioned bounds-validation calls: a count/length passed to one of
+#: these (or to any *check*-named helper) counts as validated
+_WIRE_VALIDATORS = {"need", "check_count", "check_span", "read"}
+#: call names whose arguments are SIZE positions (an unvalidated wire
+#: count reaching one of these drives an allocation or a loop)
+_SIZE_SINKS = {"frombuffer", "range", "bytearray", "zeros", "empty",
+               "ones", "full"}
+
+
+def _flatten_fmt(fmt: str) -> str:
+    """'<qqi' -> 'qqi': strip byte-order marks and repeat digits — the
+    drift comparison cares about field order and width, not grouping."""
+    return "".join(ch for ch in fmt if ch.isalpha())
+
+
+def _struct_consts_of(sc: _FileScan) -> Dict[str, str]:
+    """Module-level ``NAME = struct.Struct("<fmt")`` constants — their
+    ``.pack_into``/``.unpack_from`` uses carry the constant's format."""
+    out: Dict[str, str] = {}
+    for stmt in sc.tree.body:
+        if not isinstance(stmt, ast.Assign) or \
+                not isinstance(stmt.value, ast.Call):
+            continue
+        call = stmt.value
+        if _last_name(call.func) == "Struct" and call.args and \
+                isinstance(call.args[0], ast.Constant) and \
+                isinstance(call.args[0].value, str):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = call.args[0].value
+    return out
+
+
+def _call_wire_direction(call: ast.Call,
+                         struct_consts: Dict[str, str]
+                         ) -> Optional[Tuple[str, Optional[str], bool]]:
+    """``(direction, fmt, explicit)`` for a struct-format call site:
+    ``struct.pack/pack_into/unpack/unpack_from``, a struct-Struct
+    constant's method, or ``wire.read`` (unpack direction).  ``fmt`` is
+    None for non-constant formats; ``explicit`` is False for Struct
+    constants (their endianness is checked at the constant)."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    root = _root_name(f)
+    if f.attr in _PACK_DIRS | _UNPACK_DIRS and root == "struct":
+        direction = "pack" if f.attr in _PACK_DIRS else "unpack"
+        fmt = None
+        if call.args and isinstance(call.args[0], ast.Constant) and \
+                isinstance(call.args[0].value, str):
+            fmt = call.args[0].value
+        return direction, fmt, True
+    if f.attr == "read" and root == "wire":
+        fmt = None
+        if call.args and isinstance(call.args[0], ast.Constant) and \
+                isinstance(call.args[0].value, str):
+            fmt = call.args[0].value
+        return "unpack", fmt, True
+    if f.attr in _PACK_DIRS | _UNPACK_DIRS and \
+            isinstance(f.value, ast.Name) and \
+            f.value.id in struct_consts:
+        direction = "pack" if f.attr in _PACK_DIRS else "unpack"
+        return direction, struct_consts[f.value.id], False
+    return None
+
+
+def _fmt_stream(fn: ast.AST, struct_consts: Dict[str, str],
+                direction: str) -> str:
+    """The ordered, flattened struct-format characters ``fn`` moves in
+    ``direction`` — what gets matched against a schema's scalar
+    sequence."""
+    events: List[Tuple[int, int, str]] = []
+    seq = 0
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        hit = _call_wire_direction(n, struct_consts)
+        if hit is None or hit[0] != direction or hit[1] is None:
+            continue
+        seq += 1
+        events.append((n.lineno, seq, _flatten_fmt(hit[1])))
+    events.sort()
+    return "".join(e[2] for e in events)
+
+
+def _is_subsequence(needle: str, hay: str) -> bool:
+    it = iter(hay)
+    return all(ch in it for ch in needle)
+
+
+def _wire_site_index(scans: List[_FileScan], graph: CallGraph
+                     ) -> Dict[str, FuncNode]:
+    """``"<module-basename>.<Class>.<fn>"`` / ``"<module-basename>.<fn>"``
+    -> FuncNode, the resolution table for schema site qualnames."""
+    out: Dict[str, FuncNode] = {}
+    for node in graph.nodes.values():
+        if not isinstance(node.fn, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+            continue
+        base = node.module.split(".")[-1]
+        out[f"{base}.{_node_display(node)}"] = node
+    return out
+
+
+def _norm_frame_stem(name: str) -> str:
+    """'_pack_apply_id_req' / '_unpack_apply_id' -> 'apply_id': the
+    name-pairing key for hand-rolled framing functions."""
+    for prefix in ("_pack_", "_unpack_"):
+        if name.startswith(prefix):
+            name = name[len(prefix):]
+            break
+    for suffix in ("_req", "_rsp"):
+        if name.endswith(suffix):
+            name = name[:-len(suffix)]
+    return name
+
+
+def _load_wire_registry():
+    """The schema registry + fuzz coverage table, imported lazily so the
+    linter stays usable on trees that aren't this package."""
+    try:
+        from brpc_tpu import wire as wire_mod
+    except Exception:  # pragma: no cover - package not importable
+        return None, None
+    covers = None
+    try:
+        from brpc_tpu.analysis import fuzz as fuzz_mod
+        covers = fuzz_mod.coverage_map()
+    except Exception:
+        covers = None
+    return wire_mod, covers
+
+
+def _check_wire_contract(scans: List[_FileScan],
+                         graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    sc_by_path = {sc.path: sc for sc in scans}
+    consts_by_path = {sc.path: _struct_consts_of(sc) for sc in scans}
+    site_index = _wire_site_index(scans, graph)
+    scanned_modules = {mi.name.split(".")[-1]
+                       for mi in graph.modules.values()}
+    wire_mod, covers = _load_wire_registry()
+    # the registry half only applies when the scan actually contains the
+    # real package (a tmp-dir fixture scan must not fail stale-site
+    # checks for modules it never included)
+    in_package_scan = any(
+        _stable_path(sc.path).startswith("brpc_tpu/") for sc in scans)
+
+    # -- endianness: every constant struct format must be explicit
+    # little-endian (this fabric's wire order); a bare "qqq" silently
+    # follows host order AND host padding
+    for sc in scans:
+        consts = consts_by_path[sc.path]
+        for stmt in sc.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    _last_name(stmt.value.func) == "Struct" and \
+                    stmt.value.args and \
+                    isinstance(stmt.value.args[0], ast.Constant) and \
+                    isinstance(stmt.value.args[0].value, str) and \
+                    not stmt.value.args[0].value.startswith("<"):
+                findings.append(Finding(
+                    "wire-contract", sc.path, stmt.lineno,
+                    f"struct.Struct format "
+                    f"'{stmt.value.args[0].value}' is not explicit "
+                    f"little-endian — native byte order AND padding "
+                    f"silently differ across hosts; prefix it with '<'"))
+        for n in ast.walk(sc.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            hit = _call_wire_direction(n, consts)
+            if hit is None or hit[1] is None or not hit[2]:
+                continue
+            if not hit[1].startswith("<"):
+                findings.append(Finding(
+                    "wire-contract", sc.path, n.lineno,
+                    f"struct format '{hit[1]}' is not explicit "
+                    f"little-endian — native byte order AND padding "
+                    f"silently differ across hosts; prefix it with '<'"))
+
+    # -- hand-rolled framing functions: collect and name-pair
+    frame_fns: Dict[str, FuncNode] = {}   # site key -> node
+    for key, node in site_index.items():
+        if node.name.startswith(("_pack_", "_unpack_")):
+            consts = consts_by_path.get(node.path, {})
+            if _fmt_stream(node.fn, consts, "pack") or \
+                    _fmt_stream(node.fn, consts, "unpack"):
+                frame_fns[key] = node
+
+    registry_claimed: Set[str] = set()
+    schemas = dict(wire_mod.REGISTRY) if wire_mod is not None else {}
+    for sch in schemas.values():
+        registry_claimed.update(sch.pack_sites)
+        registry_claimed.update(sch.unpack_sites)
+
+    by_stem: Dict[Tuple[str, str], Dict[str, FuncNode]] = {}
+    for key, node in frame_fns.items():
+        mod = key.split(".")[0]
+        stem = _norm_frame_stem(node.name)
+        side = "pack" if node.name.startswith("_pack_") else "unpack"
+        by_stem.setdefault((mod, stem), {})[side] = node
+    for (mod, stem), sides in sorted(by_stem.items()):
+        pack_node = sides.get("pack")
+        unpack_node = sides.get("unpack")
+        if pack_node is not None and unpack_node is not None:
+            p_stream = _fmt_stream(pack_node.fn,
+                                   consts_by_path[pack_node.path],
+                                   "pack")
+            u_stream = _fmt_stream(unpack_node.fn,
+                                   consts_by_path[unpack_node.path],
+                                   "unpack")
+            if p_stream != u_stream:
+                findings.append(Finding(
+                    "wire-contract", unpack_node.path,
+                    unpack_node.fn.lineno,
+                    f"pack/unpack drift for frame '{stem}': "
+                    f"{pack_node.name} writes field stream "
+                    f"'{p_stream}' but {unpack_node.name} reads "
+                    f"'{u_stream}' — the two sides disagree on field "
+                    f"order or width"))
+            continue
+        lone = pack_node or unpack_node
+        key = f"{mod}.{_node_display(lone)}"
+        if key in registry_claimed:
+            continue  # one-sided by declared design (native consumer,
+            #           response frame) — the registry is the explanation
+        findings.append(Finding(
+            "wire-contract", lone.path, lone.fn.lineno,
+            f"unpaired framing function {lone.name}: no "
+            f"{'_unpack_' if pack_node else '_pack_'}{stem}* "
+            f"counterpart in the scanned tree and no wire.REGISTRY "
+            f"schema claims it — undeclared one-sided framings drift "
+            f"silently; declare it in brpc_tpu/wire.py"))
+
+    # -- registry conformance: every declared site exists and its format
+    # stream matches the schema
+    if wire_mod is not None and in_package_scan:
+        for sch in sorted(schemas.values(), key=lambda s: s.name):
+            expected = "".join(
+                _flatten_fmt(f) for f in sch.scalar_formats())
+            for direction, sites in (("pack", sch.pack_sites),
+                                     ("unpack", sch.unpack_sites)):
+                for site in sites:
+                    node = site_index.get(site)
+                    if node is None:
+                        if site.split(".")[0] in scanned_modules:
+                            findings.append(Finding(
+                                "wire-contract",
+                                "brpc_tpu/wire.py", 1,
+                                f"schema '{sch.name}' names "
+                                f"{direction} site '{site}' which does "
+                                f"not exist in the scanned tree — the "
+                                f"registry is stale"))
+                        continue
+                    stream = _fmt_stream(
+                        node.fn, consts_by_path.get(node.path, {}),
+                        direction)
+                    if site in sch.exact_sites:
+                        if stream != expected:
+                            findings.append(Finding(
+                                "wire-contract", node.path,
+                                node.fn.lineno,
+                                f"schema '{sch.name}' {direction} site "
+                                f"{site} has field stream '{stream}', "
+                                f"schema declares '{expected}' — the "
+                                f"hand-rolled site drifted from the "
+                                f"declared frame"))
+                    elif expected and not _is_subsequence(expected,
+                                                          stream):
+                        findings.append(Finding(
+                            "wire-contract", node.path, node.fn.lineno,
+                            f"schema '{sch.name}' {direction} site "
+                            f"{site}: declared field sequence "
+                            f"'{expected}' does not appear in the "
+                            f"site's {direction} stream '{stream}' — "
+                            f"the site drifted from the declared "
+                            f"frame"))
+            if not sch.pack_sites and not sch.response:
+                findings.append(Finding(
+                    "wire-contract", "brpc_tpu/wire.py", 1,
+                    f"schema '{sch.name}' declares no pack site — an "
+                    f"unproduced frame, or an undeclared producer"))
+            if not sch.unpack_sites and not sch.native_sites and \
+                    not sch.response:
+                findings.append(Finding(
+                    "wire-contract", "brpc_tpu/wire.py", 1,
+                    f"schema '{sch.name}' declares no unpack site and "
+                    f"no native consumer — an unparsed frame, or an "
+                    f"undeclared parser"))
+        # text parsers must exist...
+        for qual in wire_mod.TEXT_PARSERS:
+            if qual not in site_index and \
+                    qual.split(".")[0] in scanned_modules:
+                findings.append(Finding(
+                    "wire-contract", "brpc_tpu/wire.py", 1,
+                    f"TEXT_PARSERS names '{qual}' which does not exist "
+                    f"in the scanned tree — the registry is stale"))
+        # ...and every declared parser must have a fuzz target (the
+        # "fuzzers for every parser" gate, SURVEY §4)
+        if covers is not None:
+            covered = {c for cs in covers.values() for c in cs}
+            for sch in sorted(schemas.values(), key=lambda s: s.name):
+                if sch.name not in covered:
+                    findings.append(Finding(
+                        "wire-contract", "brpc_tpu/wire.py", 1,
+                        f"schema '{sch.name}' has no fuzz target in "
+                        f"brpc_tpu.analysis.fuzz — every declared "
+                        f"framing must be fuzzed"))
+            for qual in wire_mod.TEXT_PARSERS:
+                if qual not in covered:
+                    findings.append(Finding(
+                        "wire-contract", "brpc_tpu/wire.py", 1,
+                        f"text parser '{qual}' has no fuzz target in "
+                        f"brpc_tpu.analysis.fuzz — every parser must "
+                        f"be fuzzed"))
+
+    # -- unvalidated counts on parse paths
+    scope: Dict[str, FuncNode] = {}
+    for key, node in frame_fns.items():
+        if node.name.startswith("_unpack_"):
+            scope[node.node_id] = node
+    if wire_mod is not None:
+        for sch in schemas.values():
+            for site in sch.unpack_sites:
+                node = site_index.get(site)
+                if node is not None:
+                    scope[node.node_id] = node
+    mi_by_path = {mi.path: mi for mi in graph.modules.values()}
+    reach_roots: List[str] = []
+    for sc in scans:
+        mi = mi_by_path.get(sc.path)
+        top = graph.nodes.get(f"{mi.name}:<module>") if mi else None
+        reach_roots.extend(_find_handler_roots(
+            sc, graph, top,
+            register_names=("add_service", "add_async_service",
+                            "add_ps_service", "add_stream_handler")))
+    seen: Set[str] = set()
+    queue = list(reach_roots)
+    while queue:
+        node_id = queue.pop()
+        if node_id in seen:
+            continue
+        seen.add(node_id)
+        node = graph.nodes.get(node_id)
+        if node is None or node.path not in sc_by_path:
+            continue
+        scope.setdefault(node_id, node)
+        for n in ast.walk(node.fn):
+            if isinstance(n, ast.Call):
+                tgt = graph.call_target(n)
+                if tgt is not None:
+                    queue.append(tgt)
+    for node in sorted(scope.values(), key=lambda n: (n.path,
+                                                      n.fn.lineno)):
+        sc = sc_by_path.get(node.path)
+        if sc is None:
+            continue
+        _scan_count_validation(sc, node,
+                               consts_by_path.get(node.path, {}),
+                               findings)
+    return findings
+
+
+def _scan_count_validation(sc: _FileScan, node: FuncNode,
+                           struct_consts: Dict[str, str],
+                           findings: List[Finding]) -> None:
+    """Flag integer fields read off the wire that drive a SIZE (an
+    allocation, a loop bound, a slice) without ever reaching a bounds
+    check — the unvalidated-count hazard class (`_unpack_windows`'s
+    pre-hardening loop, numpy's count=-1 re-interpretation)."""
+    fn = node.fn
+    display = _node_display(node)
+    unpacked: Dict[str, int] = {}
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Assign) or \
+                not isinstance(n.value, ast.Call):
+            continue
+        hit = _call_wire_direction(n.value, struct_consts)
+        if hit is None or hit[0] != "unpack":
+            continue
+        for tgt in n.targets:
+            leaves = [tgt] if isinstance(tgt, ast.Name) else [
+                leaf for leaf in ast.walk(tgt)
+                if isinstance(leaf, ast.Name)
+            ] if isinstance(tgt, (ast.Tuple, ast.List, ast.Starred)) \
+                else []
+            for leaf in leaves:
+                unpacked.setdefault(leaf.id, n.lineno)
+    if not unpacked:
+        return
+    size_used: Dict[str, int] = {}
+    validated: Set[str] = set()
+
+    def mark_size(exprs, line: int) -> None:
+        for e in exprs:
+            if e is None:
+                continue
+            for leaf in ast.walk(e):
+                if isinstance(leaf, ast.Name) and leaf.id in unpacked:
+                    size_used.setdefault(leaf.id, line)
+
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            fl = _last_name(n.func)
+            args = list(n.args) + [kw.value for kw in n.keywords]
+            if fl in _WIRE_VALIDATORS or (fl is not None
+                                          and "check" in fl.lower()):
+                for a in args:
+                    for leaf in ast.walk(a):
+                        if isinstance(leaf, ast.Name):
+                            validated.add(leaf.id)
+            elif fl == "frombuffer":
+                mark_size(args[1:], n.lineno)
+            elif fl in _SIZE_SINKS:
+                mark_size(args, n.lineno)
+        elif isinstance(n, ast.Subscript) and \
+                isinstance(n.slice, ast.Slice):
+            mark_size([n.slice.lower, n.slice.upper, n.slice.step],
+                      n.lineno)
+        elif isinstance(n, ast.Compare):
+            for leaf in ast.walk(n):
+                if isinstance(leaf, ast.Name):
+                    validated.add(leaf.id)
+    for name in sorted(size_used):
+        if name in validated:
+            continue
+        findings.append(Finding(
+            "wire-contract", sc.path, size_used[name],
+            f"{display}: '{name}' is read off the wire (line "
+            f"{unpacked[name]}) and used as a size/loop bound with no "
+            f"bounds validation on any path — a hostile count drives "
+            f"unbounded allocation or numpy's count=-1 whole-buffer "
+            f"re-interpretation; guard it with wire.check_count / "
+            f"wire.need"))
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1963,6 +2422,8 @@ def lint_files(files: Iterable[str],
             findings.extend(_check_fiber_blocking_sleep(scans, graph))
         if "handle-lifecycle" in active:
             findings.extend(_check_handle_lifecycle(scans, graph))
+        if "wire-contract" in active:
+            findings.extend(_check_wire_contract(scans, graph))
     if "ctypes-contract" in active:
         findings.extend(_check_ctypes_contract(scans))
     # dedup (a nested def can be reached both inside its parent's subtree

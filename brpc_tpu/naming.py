@@ -35,6 +35,7 @@ from __future__ import annotations
 import dataclasses
 import http.client
 import json
+import math
 import threading
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -109,7 +110,10 @@ def parse_shard_tag(tag: str) -> Optional[Tuple[int, int, int]]:
         replica = int(parts[2]) if len(parts) == 3 else 0
     except ValueError:
         return None
-    if replica < 0:
+    # Fuzz-hardened: int() happily parses "-1" and "+0007", but a shard
+    # outside [0, num) or a non-positive shard count can only poison the
+    # resolver's grouping arithmetic downstream — not a shard tag.
+    if replica < 0 or shard < 0 or num <= 0 or shard >= num:
         return None
     return shard, num, replica
 
@@ -141,6 +145,11 @@ def parse_claim_tag(
     try:
         epoch = int(suffix[1:-1])
     except ValueError:
+        return None
+    # Negative epochs/scheme versions never exist (fencing epochs only
+    # grow from 0; scheme versions are registry-encodable naturals) — a
+    # tag carrying one is hostile or corrupt, not a claim.
+    if epoch < 0 or (scheme is not None and scheme < 0):
         return None
     return base[0], base[1], base[2], epoch, suffix[-1] == "P", scheme
 
@@ -239,16 +248,41 @@ class PartitionScheme:
 
     @classmethod
     def from_json(cls, text: str) -> "PartitionScheme":
+        """Strict record parse — registry records are hostile input
+        (anything can publish a ``scheme!`` tag).  Shape violations the
+        dataclass validation cannot see raise ``ValueError`` here: a
+        string where an address LIST belongs (``tuple("abc")`` silently
+        becomes three one-char addresses), a non-finite weight (inf/nan
+        poisons every capacity-weighting comparison downstream), or a
+        non-list bounds."""
         d = json.loads(text)
+        if not isinstance(d, dict):
+            raise ValueError("scheme record must be a JSON object")
+        rs_in = d["replica_sets"]
+        if not isinstance(rs_in, (list, tuple)):
+            raise ValueError("replica_sets must be a list")
+        sets = []
+        for rs in rs_in:
+            if not isinstance(rs, dict):
+                raise ValueError("replica set must be an object")
+            addrs = rs["addresses"]
+            if not isinstance(addrs, (list, tuple)) or not all(
+                    isinstance(a, str) for a in addrs):
+                raise ValueError("addresses must be a list of strings")
+            sets.append(ReplicaSet(tuple(addrs),
+                                   primary=int(rs.get("primary", 0))))
+        weight = float(d.get("weight", 1.0))
+        if not math.isfinite(weight):
+            raise ValueError(f"scheme weight {weight} is not finite")
+        bounds = d.get("bounds")
+        if bounds is not None and not isinstance(bounds, (list, tuple)):
+            raise ValueError("bounds must be a list")
         return cls(
             version=int(d["version"]),
-            replica_sets=tuple(
-                ReplicaSet(tuple(rs["addresses"]),
-                           primary=int(rs.get("primary", 0)))
-                for rs in d["replica_sets"]),
-            weight=float(d.get("weight", 1.0)),
+            replica_sets=tuple(sets),
+            weight=weight,
             state=d.get("state", "active"),
-            bounds=tuple(d["bounds"]) if d.get("bounds") else None)
+            bounds=tuple(bounds) if bounds else None)
 
 
 def publish_scheme(client: "NamingClient", cluster: str,
@@ -268,12 +302,16 @@ def parse_schemes(nodes: Sequence[dict]) -> Dict[int, PartitionScheme]:
     out: Dict[int, PartitionScheme] = {}
     for n in nodes:
         tag = n.get("tag", "")
-        if not tag.startswith(SCHEME_TAG_PREFIX):
+        if not isinstance(tag, str) or \
+                not tag.startswith(SCHEME_TAG_PREFIX):
             continue
         try:
             scheme = PartitionScheme.from_json(
                 tag[len(SCHEME_TAG_PREFIX):])
-        except (ValueError, KeyError, TypeError):
+        except (ValueError, KeyError, TypeError, RecursionError):
+            # RecursionError: json.loads on a deeply-nested hostile
+            # payload ("[[[[…") overflows the decoder's stack — a
+            # malformed record, not a parser crash.
             continue
         out[scheme.version] = scheme
     return out
@@ -291,15 +329,21 @@ def parse_claims(
     the range."""
     out: Dict[Tuple[Optional[int], int, int], Tuple[int, str]] = {}
     for n in nodes:
-        parsed = parse_claim_tag(n.get("tag", ""))
+        tag = n.get("tag", "")
+        parsed = parse_claim_tag(tag) if isinstance(tag, str) else None
         if parsed is None:
             continue
         shard, num, _replica, epoch, is_primary, scheme = parsed
         if not is_primary:
             continue
+        # a claim-tagged node without a routable addr is corrupt — a
+        # KeyError here used to kill the whole listing's ingest
+        addr = n.get("addr")
+        if not isinstance(addr, str) or not addr:
+            continue
         key = (scheme, num, shard)
         if key not in out or epoch >= out[key][0]:
-            out[key] = (epoch, n["addr"])
+            out[key] = (epoch, addr)
     return out
 
 

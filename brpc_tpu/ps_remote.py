@@ -41,10 +41,19 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from brpc_tpu import obs, resilience, rpc
+from brpc_tpu import obs, resilience, rpc, wire
 from brpc_tpu.analysis.race import checked_lock, checked_rwlock
 from brpc_tpu.naming import (PartitionScheme, ReplicaSet, parse_claims,
                              parse_schemes, parse_shard_tag)
+
+
+def _reject_frame(method: str) -> None:
+    """Count one malformed-frame rejection (``ps_parse_rejects`` total +
+    per method) — fuzz runs and hostile real traffic both show up in the
+    ``_status`` vars instead of vanishing into generic errors."""
+    if obs.enabled():
+        obs.counter("ps_parse_rejects").add(1)
+        obs.counter(f"ps_parse_rejects_{method}").add(1)
 
 
 def _record_ps_server(shard_index: int, method: str, count: int,
@@ -132,13 +141,23 @@ def _pack_windows(windows: Dict[str, int]) -> bytes:
 
 
 def _unpack_windows(payload, offset: int = 0):
-    """Inverse of :func:`_pack_windows`: returns ``(windows, end)``."""
-    (count,) = struct.unpack_from("<i", payload, offset)
+    """Inverse of :func:`_pack_windows`: returns ``(windows, end)``.
+    Guarded (wire schema ``windows``): the entry count is bounded by the
+    bytes actually present (min 12/entry) and every writer length is
+    span-checked, so a hostile count can neither drive an unbounded loop
+    nor walk the read off the payload."""
+    (count,) = wire.read("<i", payload, offset, "windows.count")
     offset += 4
+    wire.check_count(count, (len(payload) - offset) // 12,
+                     "windows.count")
     windows: Dict[str, int] = {}
     for _ in range(count):
-        (wlen,) = struct.unpack_from("<i", payload, offset)
+        (wlen,) = wire.read("<i", payload, offset, "windows.wlen")
         offset += 4
+        # check_count, not need: a NEGATIVE length passes a `wlen + 8`
+        # span check and walks the offset backwards
+        wire.check_count(wlen, len(payload) - offset - 8,
+                         "windows.wlen")
         w = bytes(payload[offset:offset + wlen]).decode(errors="replace")
         offset += wlen
         (seq,) = struct.unpack_from("<q", payload, offset)
@@ -182,17 +201,24 @@ def _pack_apply_id_req(writer: str, seq: int, guards, owned: np.ndarray,
 
 def _unpack_apply_id(payload):
     """Inverse of :func:`_pack_apply_id_req`: returns
-    ``(writer, seq, guards, apply_body)``."""
-    (wlen,) = struct.unpack_from("<i", payload, 0)
+    ``(writer, seq, guards, apply_body)``.  Guarded (wire schema
+    ``apply_id_req``): writer/guard-key lengths are span-checked and the
+    guard count is bounded by the bytes present (min 12/guard) before
+    any loop runs."""
+    (wlen,) = wire.read("<i", payload, 0, "apply_id.wlen")
     off = 4
+    wire.check_count(wlen, len(payload) - off - 12, "apply_id.wlen")
     writer = bytes(payload[off:off + wlen]).decode(errors="replace")
     off += wlen
     seq, nguards = struct.unpack_from("<qi", payload, off)
     off += 12
+    wire.check_count(nguards, (len(payload) - off) // 12,
+                     "apply_id.nguards")
     guards = []
     for _ in range(nguards):
-        (klen,) = struct.unpack_from("<i", payload, off)
+        (klen,) = wire.read("<i", payload, off, "apply_id.klen")
         off += 4
+        wire.check_count(klen, len(payload) - off - 8, "apply_id.klen")
         key = bytes(payload[off:off + klen]).decode(errors="replace")
         off += klen
         (q,) = struct.unpack_from("<q", payload, off)
@@ -205,8 +231,15 @@ def _unpack_apply(payload: bytes, base: int, rows_per: int, dim: int):
     """Parse + validate one ApplyGrad-framed delta (unary request body or
     stream frame): returns ``(local_ids, grads[count, dim])``.  Raises
     ``ValueError`` on out-of-range ids BEFORE anything is enqueued, so a
-    bad contribution can never poison a combined batch."""
-    (count,) = struct.unpack_from("<i", payload, 0)
+    bad contribution can never poison a combined batch.  The count is
+    guarded first (wire schema ``apply_req``): a negative count would
+    make ``np.frombuffer`` silently re-interpret the whole payload
+    (``count=-1`` means "read everything" to numpy — garbage ids AND
+    garbage grads that can pass the range check), and an oversized one
+    must reject cleanly instead of surfacing numpy internals."""
+    (count,) = wire.read("<i", payload, 0, "apply.count")
+    wire.check_count(count, (len(payload) - 4) // (4 + 4 * dim),
+                     "apply.count")
     ids = np.frombuffer(payload, np.int32, count, 4) - base
     if ids.size and (ids.min() < 0 or ids.max() >= rows_per):
         raise ValueError(
@@ -410,16 +443,26 @@ class _ApplyStreamReceiver:
         if self._demoted():
             self._fence()
             return
-        if not self._writer:
-            self._server._apply_frame(data)
-            return
-        seq, _epoch, _gen = _FRAME_HDR.unpack_from(data, 0)
-        if not self._server._reserve_seq(self._writer, seq):
-            if obs.enabled():
-                obs.counter("ps_stream_dedup_drops").add(1)
-            return
-        self._server._apply_frame(memoryview(data)[_FRAME_HDR.size:],
-                                  (self._writer, seq))
+        try:
+            if not self._writer:
+                self._server._apply_frame(data)
+                return
+            if len(data) < _FRAME_HDR.size:
+                raise wire.WireError(
+                    f"stream frame shorter than its header "
+                    f"({len(data)} < {_FRAME_HDR.size})")
+            seq, _epoch, _gen = _FRAME_HDR.unpack_from(data, 0)
+            if not self._server._reserve_seq(self._writer, seq):
+                if obs.enabled():
+                    obs.counter("ps_stream_dedup_drops").add(1)
+                return
+            self._server._apply_frame(memoryview(data)[_FRAME_HDR.size:],
+                                      (self._writer, seq))
+        except wire.WireError:
+            # Frames have no response channel: a malformed frame is
+            # counted and DROPPED — it must not kill the receiver or
+            # poison the combiner.
+            _reject_frame("StreamApply")
 
     def on_closed(self) -> None:
         try:
@@ -453,9 +496,20 @@ class _ReplicaStreamReceiver:
         self.reply: "Optional[rpc.Stream]" = None
 
     def on_data(self, data: bytes) -> None:
-        _seq, epoch, gen = _FRAME_HDR.unpack_from(data, 0)
-        acked = self._server._apply_replica_frame(
-            epoch, gen, memoryview(data)[_FRAME_HDR.size:])
+        try:
+            if len(data) < _FRAME_HDR.size:
+                raise wire.WireError(
+                    f"ReplicaApply frame shorter than its header "
+                    f"({len(data)} < {_FRAME_HDR.size})")
+            _seq, epoch, gen = _FRAME_HDR.unpack_from(data, 0)
+            acked = self._server._apply_replica_frame(
+                epoch, gen, memoryview(data)[_FRAME_HDR.size:])
+        except wire.WireError:
+            # A malformed propagation frame means the stream itself is
+            # corrupt: count it and break the stream so the primary
+            # reconnects through a full Sync (same treatment as a gap).
+            _reject_frame("ReplicaApply")
+            acked = None
         if acked is None:
             # Gap: break the stream so the primary reconnects through a
             # full sync instead of streaming into divergence.
@@ -489,6 +543,9 @@ class _ReplicaAckReceiver:
         self._addr = addr
 
     def on_data(self, data: bytes) -> None:
+        if len(data) < 8:
+            _reject_frame("ReplicaAck")
+            return
         (gen,) = struct.unpack_from("<q", data, 0)
         if gen < 0:   # fence notification: a newer primary exists
             self._replicator._note_fenced(self._addr)
@@ -520,9 +577,19 @@ class _MigrateStreamReceiver:
         self.reply: "Optional[rpc.Stream]" = None
 
     def on_data(self, data: bytes) -> None:
-        gen, _scheme, _gen2 = _FRAME_HDR.unpack_from(data, 0)
-        acked = self._server._apply_migrate_frame(
-            self._src, gen, memoryview(data)[_FRAME_HDR.size:])
+        try:
+            if len(data) < _FRAME_HDR.size:
+                raise wire.WireError(
+                    f"MigrateApply frame shorter than its header "
+                    f"({len(data)} < {_FRAME_HDR.size})")
+            gen, _scheme, _gen2 = _FRAME_HDR.unpack_from(data, 0)
+            acked = self._server._apply_migrate_frame(
+                self._src, gen, memoryview(data)[_FRAME_HDR.size:])
+        except wire.WireError:
+            # Same contract as the replica receiver: a malformed handoff
+            # frame breaks the stream so the source resyncs wholesale.
+            _reject_frame("MigrateApply")
+            acked = None
         if acked is None:
             if self.reply is not None:
                 self.reply.close()
@@ -1225,10 +1292,14 @@ class PsShardServer:
         return 0
 
     def _handle(self, method: str, payload: bytes) -> bytes:
-        if not obs.enabled():
-            return self._serve(method, payload)
-        t0 = time.monotonic_ns()
-        rsp = self._serve(method, payload)
+        try:
+            if not obs.enabled():
+                return self._serve(method, payload)
+            t0 = time.monotonic_ns()
+            rsp = self._serve(method, payload)
+        except wire.WireError:
+            _reject_frame(method)
+            raise
         _record_ps_server(self.shard_index, method,
                           self._payload_keys(method, payload),
                           len(payload), len(rsp), t0)
@@ -1242,6 +1313,16 @@ class PsShardServer:
         ``ReplicaApply`` binds the primary's delta stream to this
         backup's table; everything else is the plain :meth:`_handle`
         contract."""
+        if method in ("StreamApply", "MigrateApply", "ReplicaApply"):
+            try:
+                return self._serve_stream_setup(method, payload, accept)
+            except wire.WireError:
+                _reject_frame(method)
+                raise
+        return self._handle(method, payload)
+
+    def _serve_stream_setup(self, method: str, payload: bytes,
+                            accept) -> bytes:
         if method == "StreamApply":
             if not self.stream:
                 raise ValueError(f"unknown method {method}")
@@ -1262,7 +1343,9 @@ class PsShardServer:
             # A migration source binds its delta stream to this
             # importing destination; the setup answers the per-source
             # watermark so a resync can skip already-covered frames.
-            (alen,) = struct.unpack_from("<i", payload, 8)
+            _scheme, alen = wire.read("<qi", payload, 0,
+                                      "MigrateApply.setup")
+            wire.need(payload, 12, alen, "MigrateApply.src")
             src = bytes(payload[12:12 + alen]).decode(errors="replace")
             with self._mu.read():
                 if not self._importing:
@@ -1275,12 +1358,12 @@ class PsShardServer:
             recv.reply = accept(recv)
             return struct.pack("<q", last)
         if method == "ReplicaApply":
-            (epoch,) = struct.unpack_from("<q", payload, 0)
+            (epoch,) = wire.read("<q", payload, 0, "ReplicaApply.setup")
             self._check_repl_epoch(epoch)
             recv = _ReplicaStreamReceiver(self)
             recv.reply = accept(recv)
             return struct.pack("<qq", self._epoch, self._install_gen)
-        return self._handle(method, payload)
+        raise ValueError(f"unknown stream method {method}")
 
     def _apply_frame(self, payload, meta=None) -> None:
         """One streamed delta: parse/validate, enqueue without waiting
@@ -1411,7 +1494,7 @@ class PsShardServer:
                 "addr": self.address,
             }).encode()
         if method == "Promote":
-            (epoch,) = struct.unpack_from("<q", payload, 0)
+            (epoch,) = wire.read("<q", payload, 0, "Promote.epoch")
             with self._repl_mu:
                 if epoch <= self._epoch:
                     raise rpc.RpcError(
@@ -1438,12 +1521,13 @@ class PsShardServer:
                 obs.counter("ps_replica_promotions").add(1)
             return struct.pack("<qq", self._epoch, self._install_gen)
         if method == "Sync":
-            epoch, gen, count = struct.unpack_from("<qqq", payload, 0)
+            epoch, gen, count = wire.read("<qqq", payload, 0, "Sync.hdr")
             self._check_repl_epoch(epoch)
             if count != self.rows_per * self.dim:
                 raise ValueError(
                     f"sync size {count} != shard table "
                     f"{self.rows_per * self.dim}")
+            wire.need(payload, 24, count * 4, "Sync.table")
             table = np.frombuffer(payload, np.float32, count,
                                   24).reshape(self.rows_per, self.dim)
             tbl_end = 24 + count * 4
@@ -1508,7 +1592,20 @@ class PsShardServer:
             # applied batch).  Idempotent — a re-issued start replaces
             # the shipper and the destinations resync wholesale.
             self._check_primary()
-            spec = json.loads(payload)
+            try:
+                spec = json.loads(payload)
+                targets = spec["targets"]
+                scheme_ver = int(spec["scheme"])
+                if not isinstance(targets, list) or not all(
+                        isinstance(t, dict)
+                        and isinstance(t.get("addr"), str)
+                        and int(t["base"]) >= 0 and int(t["rows"]) > 0
+                        for t in targets):
+                    raise ValueError("bad targets")
+            except (ValueError, KeyError, TypeError,
+                    RecursionError) as e:
+                raise wire.WireError(
+                    f"malformed MigrateStart spec: {e}") from e
             from brpc_tpu import reshard  # lazy: reshard imports us
             with self._repl_mu:
                 if self._scheme_fenced or self._importing:
@@ -1521,7 +1618,7 @@ class PsShardServer:
             if old is not None:
                 old.stop()
             shipper = reshard.MigrationShipper(
-                self, spec["targets"], int(spec["scheme"]),
+                self, targets, scheme_ver,
                 timeout_ms=self.repl_timeout_ms)
             with self._repl_mu:
                 self._migrator = shipper
@@ -1557,7 +1654,7 @@ class PsShardServer:
             # drain, and the final migration flush waits until every
             # destination acked the final generation — after this
             # returns, the successor shards hold every acked update.
-            (ver,) = struct.unpack_from("<q", payload, 0)
+            (ver,) = wire.read("<q", payload, 0, "SchemeFence.ver")
             with self._repl_mu:
                 if self._importing:
                     raise rpc.RpcError(
@@ -1622,16 +1719,19 @@ class PsShardServer:
             # this shard's range wholesale, at the source's pinned
             # generation, windows included — the import-side mirror of
             # the replication Sync.
-            scheme, src_gen, row0, count = struct.unpack_from(
-                "<qqqq", payload, 0)
-            (alen,) = struct.unpack_from("<i", payload, 32)
+            scheme, src_gen, row0, count, alen = wire.read(
+                "<qqqqi", payload, 0, "MigrateSync.hdr")
+            wire.need(payload, 36, alen, "MigrateSync.src")
             src = bytes(payload[36:36 + alen]).decode(errors="replace")
             off = 36 + alen
+            wire.check_count(count, self.rows_per, "MigrateSync.count")
             lo = row0 - self.base
             if lo < 0 or row0 + count > self.base + self.rows_per:
                 raise ValueError(
                     f"sync range [{row0}, {row0 + count}) outside "
                     f"shard [{self.base}, {self.base + self.rows_per})")
+            wire.need(payload, off, count * self.dim * 4,
+                      "MigrateSync.rows")
             rows = np.frombuffer(payload, np.float32, count * self.dim,
                                  off).reshape(count, self.dim)
             windows = _unpack_windows(
@@ -1681,7 +1781,22 @@ class PsShardServer:
             return self._serve_control(method, payload)
         if method == "ApplyGradId":
             return self._serve_apply_id(payload)
-        (count,) = struct.unpack_from("<i", payload, 0)
+        if method not in ("Lookup", "ApplyGrad"):
+            raise ValueError(f"unknown method {method}")
+        # Guarded header (wire schemas lookup_req/apply_req): a negative
+        # count would make frombuffer re-interpret the whole payload; an
+        # oversized one must reject cleanly, and Lookup mirrors the
+        # native handler's EXACT-length contract (ps_shard.cc).
+        (count,) = wire.read("<i", payload, 0, f"{method}.count")
+        wire.check_count(count, (len(payload) - 4) // 4,
+                         f"{method}.count")
+        if method == "Lookup" and len(payload) != 4 + 4 * count:
+            raise wire.WireError(
+                f"Lookup request length mismatch (count={count}, "
+                f"{len(payload)} bytes)")
+        if method == "ApplyGrad":
+            wire.need(payload, 4 + 4 * count, count * self.dim * 4,
+                      "ApplyGrad.grads")
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
         if ids.size and (ids.min() < 0 or ids.max() >= self.rows_per):
             # Out-of-range ids would wrap to wrong rows via negative indexing.
@@ -1917,10 +2032,14 @@ class DevicePsShardServer:
         return 1 << max(0, count - 1).bit_length()
 
     def _handle(self, method: str, payload: bytes) -> bytes:
-        if not obs.enabled():
-            return self._serve(method, payload)
-        t0 = time.monotonic_ns()
-        rsp = self._serve(method, payload)
+        try:
+            if not obs.enabled():
+                return self._serve(method, payload)
+            t0 = time.monotonic_ns()
+            rsp = self._serve(method, payload)
+        except wire.WireError:
+            _reject_frame(method)
+            raise
         _record_ps_server(self.shard_index, method,
                           PsShardServer._payload_keys(method, payload),
                           len(payload), len(rsp), t0)
@@ -2019,7 +2138,21 @@ class DevicePsShardServer:
             with self._seq_mu:
                 applied = self._writer_seqs.get(writer, 0)
             return struct.pack("<qq", applied, 0)
-        (count,) = struct.unpack_from("<i", payload, 0)
+        if method not in ("Lookup", "ApplyGrad"):
+            raise ValueError(f"unknown method {method}")
+        # Same wire guards as the CPU shard (schemas lookup_req /
+        # apply_req): counts bounded by the bytes present BEFORE any
+        # staging allocation or device launch.
+        (count,) = wire.read("<i", payload, 0, f"{method}.count")
+        wire.check_count(count, (len(payload) - 4) // 4,
+                         f"{method}.count")
+        if method == "Lookup" and len(payload) != 4 + 4 * count:
+            raise wire.WireError(
+                f"Lookup request length mismatch (count={count}, "
+                f"{len(payload)} bytes)")
+        if method == "ApplyGrad":
+            wire.need(payload, 4 + 4 * count, count * self.dim * 4,
+                      "ApplyGrad.grads")
         ids = np.frombuffer(payload, np.int32, count, 4) - self.base
         if ids.size and (ids.min() < 0 or ids.max() >= self.rows_per):
             raise ValueError(
@@ -3652,7 +3785,13 @@ class RemoteEmbedding:
             for seq, body in frames:
                 if applied is not None and seq <= applied:
                     continue
-                (count,) = struct.unpack_from("<i", body, 0)
+                # our own unacked window, but the same guarded parse as
+                # the servers — a corrupt stash must fail loudly, not
+                # re-split garbage through numpy's count=-1 semantics
+                (count,) = wire.read("<i", body, 0, "transfer.count")
+                wire.check_count(count,
+                                 (len(body) - 4) // (4 + 4 * self.dim),
+                                 "transfer.count")
                 gids = np.frombuffer(body, np.int32, count, 4)
                 grads = np.frombuffer(
                     body, np.float32, count * self.dim,

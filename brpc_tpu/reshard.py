@@ -47,12 +47,12 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from brpc_tpu import obs, resilience, rpc
+from brpc_tpu import obs, resilience, rpc, wire
 from brpc_tpu.analysis.race import checked_lock
 from brpc_tpu.naming import (NamingClient, PartitionScheme,
                              publish_scheme)
 from brpc_tpu.ps_remote import (_pack_apply_req, _pack_stream_frame,
-                                _pack_windows)
+                                _pack_windows, _reject_frame)
 
 
 class _ShipperAckReceiver:
@@ -66,6 +66,9 @@ class _ShipperAckReceiver:
         self._addr = addr
 
     def on_data(self, data: bytes) -> None:
+        if len(data) < 8:
+            _reject_frame("MigrateAck")
+            return
         (gen,) = struct.unpack_from("<q", data, 0)
         self._shipper._note_ack(self._addr, gen)
 
@@ -477,7 +480,7 @@ class MigrationDriver:
             rsp = self._chan(self._primary(self.old, s)).call(
                 "Ps", "MigrateStart", spec.encode(),
                 timeout_ms=self.timeout_ms)
-            gens[s] = struct.unpack_from("<q", rsp, 0)[0]
+            gens[s] = wire.read("<q", rsp, 0, "MigrateStart.rsp")[0]
         return gens
 
     def migrate_state(self, s: int) -> dict:
@@ -528,7 +531,7 @@ class MigrationDriver:
                 "Ps", "SchemeFence",
                 struct.pack("<q", self.new.version),
                 timeout_ms=self.timeout_ms)
-            final[s] = struct.unpack_from("<q", rsp, 0)[0]
+            final[s] = wire.read("<q", rsp, 0, "SchemeFence.rsp")[0]
         for d in range(self.new.num_shards):
             self._chan(self._primary(self.new, d)).call(
                 "Ps", "CompleteImport", b"",

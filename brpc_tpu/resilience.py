@@ -51,13 +51,14 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from brpc_tpu import obs
+from brpc_tpu import wire as _wire
 from brpc_tpu.analysis import race as _race
 from brpc_tpu.analysis.race import checked_lock
 
 __all__ = [
     "Backoff", "sleep_ms", "RetryPolicy", "RETRIABLE_CODES",
     "EBREAKEROPEN", "ENOTPRIMARY", "EFENCED", "EMIGRATING",
-    "ESCHEMEMOVED", "call_with_retry",
+    "ESCHEMEMOVED", "EBADFRAME", "call_with_retry",
     "backup_call", "resilient_call", "BreakerOptions", "CircuitBreaker",
     "BreakerRegistry", "HealthProber", "ReplicaScorer",
     "default_registry", "set_default_registry", "health_components",
@@ -82,6 +83,10 @@ EMIGRATING = 2011
 #: redirect error that drives client scheme refresh during a live
 #: reshard — never retriable against the same scheme)
 ESCHEMEMOVED = 2012
+#: a malformed frame was rejected by a wire-contract guard before any
+#: allocation or state mutation (:mod:`brpc_tpu.wire`) — never
+#: retriable: the same bytes parse the same way twice
+EBADFRAME = _wire.EBADFRAME
 
 #: native error codes worth retrying: the request may never have reached
 #: the server, or the failure is transient by construction.  Application

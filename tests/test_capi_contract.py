@@ -66,3 +66,119 @@ def test_no_binding_for_a_symbol_the_header_dropped():
     stale = sorted(n for n in _binding_decls() if n not in header)
     assert not stale, (
         f"rpc._load() binds symbols c_api.h no longer declares: {stale}")
+
+
+# ---------------------------------------------------------------------------
+# cpp-side constructor/destructor + handle-ledger symmetry
+# ---------------------------------------------------------------------------
+# The no-toolchain native lint fallback (the ROADMAP clang-tidy deferral
+# stays honest): every `brt_*_new` DEFINED in the capi TUs must have its
+# `_destroy`, and both must bump the native handle ledger
+# (handle_inc/handle_dec) so brt_debug_handle_counts stays ground truth.
+# Pure text analysis over cpp/capi/*.cc — no clang binary required.
+
+CAPI_DIR = os.path.join(ROOT, "cpp", "capi")
+
+#: constructor symbols that don't follow the _new naming rule, and the
+#: destroy symbol owning their handle kind (mirrors the lint's
+#: _ABI_NEW_PAIRS table)
+_IRREGULAR_PAIRS = {
+    "brt_channel_call_start_opts": "brt_call_destroy",
+    "brt_device_compile": "brt_device_executable_destroy",
+}
+
+
+def _capi_sources():
+    out = {}
+    for fname in sorted(os.listdir(CAPI_DIR)):
+        if fname.endswith(".cc"):
+            with open(os.path.join(CAPI_DIR, fname), "r",
+                      encoding="utf-8") as f:
+                out[fname] = f.read()
+    return out
+
+
+def _function_bodies(src: str):
+    """symbol -> body text for top-level C function definitions, by
+    brace balancing from each definition header."""
+    out = {}
+    for m in re.finditer(r"^(?:void\*?|char\*|long|int|int64_t)\s+"
+                         r"(brt_\w+)\s*\([^;]*?\)\s*\{",
+                         src, re.MULTILINE | re.DOTALL):
+        name = m.group(1)
+        depth, i = 1, m.end()
+        while i < len(src) and depth:
+            if src[i] == "{":
+                depth += 1
+            elif src[i] == "}":
+                depth -= 1
+            i += 1
+        out[name] = src[m.end():i]
+    return out
+
+
+def _strip_line_comments(src: str) -> str:
+    """Remove ``//`` comments without eating string literals that
+    contain ``//`` (``a.find("://")`` must survive — a naive regex
+    truncates the line mid-string and corrupts brace balance)."""
+    out_lines = []
+    for line in src.split("\n"):
+        pos = 0
+        while True:
+            idx = line.find("//", pos)
+            if idx < 0:
+                break
+            if line.count('"', 0, idx) % 2 == 0:
+                line = line[:idx]
+                break
+            pos = idx + 2
+        out_lines.append(line)
+    return "\n".join(out_lines)
+
+
+def _all_capi_bodies():
+    bodies = {}
+    for fname, src in _capi_sources().items():
+        clean = _strip_line_comments(src)
+        for name, body in _function_bodies(clean).items():
+            bodies[name] = (fname, body)
+    return bodies
+
+
+def test_every_capi_constructor_has_its_destroy():
+    bodies = _all_capi_bodies()
+    news = [n for n in bodies if n.endswith("_new")]
+    assert len(news) >= 5          # server/channel/event/group/ps_shard
+    missing = []
+    for name in sorted(news):
+        expected = name[:-len("_new")] + "_destroy"
+        if expected not in bodies:
+            missing.append(f"{name} -> {expected}")
+    for ctor, dtor in _IRREGULAR_PAIRS.items():
+        if ctor in bodies and dtor not in bodies:
+            missing.append(f"{ctor} -> {dtor}")
+    assert not missing, (
+        "capi constructors without a destroy in cpp/capi/*.cc — "
+        "handles of these kinds cannot be freed:\n  "
+        + "\n  ".join(missing))
+
+
+def test_every_capi_pair_bumps_the_handle_ledger():
+    """Both halves of every pair must feed the native ledger: a
+    constructor that skips handle_inc (or a destroy that skips
+    handle_dec) silently un-grounds the Python-vs-native ledger
+    cross-check (brt_debug_handle_counts)."""
+    bodies = _all_capi_bodies()
+    pairs = [(n, n[:-len("_new")] + "_destroy")
+             for n in bodies if n.endswith("_new")]
+    pairs += [(c, d) for c, d in _IRREGULAR_PAIRS.items()
+              if c in bodies]
+    bad = []
+    for ctor, dtor in sorted(pairs):
+        if "handle_inc(" not in bodies[ctor][1]:
+            bad.append(f"{ctor} ({bodies[ctor][0]}): no handle_inc")
+        if dtor in bodies and "handle_dec(" not in bodies[dtor][1]:
+            bad.append(f"{dtor} ({bodies[dtor][0]}): no handle_dec")
+    assert not bad, (
+        "capi constructor/destroy bodies not feeding the native handle "
+        "ledger:\n  " + "\n  ".join(bad))

@@ -1,0 +1,436 @@
+"""Wire-contract tier: frame-schema registry, hardened parsers, and the
+``wire-contract`` lint check.
+
+Three layers under test:
+
+1. the declarative schemas in :mod:`brpc_tpu.wire` are byte-identical
+   to the hand-rolled hot-path packers they describe (the schema is the
+   shared truth the lint and fuzzer both derive from);
+2. the hardened parsers reject hostile counts/lengths with a clean
+   :class:`wire.WireError` (EBADFRAME) — including the numpy
+   ``count=-1`` whole-buffer re-interpretation that parsed SILENTLY
+   before this tier;
+3. the ``wire-contract`` lint check flags drifted/unpaired framings and
+   unvalidated counts on seeded fixtures, and the SAME seeded asymmetry
+   is caught at runtime by ``fuzz.parity_fuzz`` (static/dynamic parity,
+   the lock-order discipline applied to framing).
+"""
+
+import os
+import random
+import struct
+import tempfile
+import textwrap
+
+import numpy as np
+import pytest
+
+from brpc_tpu import naming, obs, resilience, wire
+from brpc_tpu import ps_remote
+from brpc_tpu.analysis import fuzz
+from brpc_tpu.analysis.lint import run_lint
+
+
+def _wire_findings(paths):
+    return [f for f in run_lint(paths, checks=["wire-contract"])]
+
+
+@pytest.fixture(autouse=True)
+def _obs_on():
+    """Counter-reading tests must pin obs themselves: earlier tier-1
+    files (test_ps_native) deliberately leave obs disabled."""
+    was = obs.enabled()
+    obs.set_enabled(True)
+    yield
+    obs.set_enabled(was)
+
+
+# ---------------------------------------------------------------------------
+# schema <-> hand-rolled parity
+# ---------------------------------------------------------------------------
+
+def test_windows_schema_matches_hand_rolled():
+    rng = random.Random(7)
+    for _ in range(25):
+        d = {f"w{i}-{rng.randrange(999)}": rng.randrange(1 << 40)
+             for i in range(rng.randrange(0, 5))}
+        hand = ps_remote._pack_windows(d)
+        ref = wire.REGISTRY["windows"].pack({
+            "entries": [{"writer": w.encode(), "seq": q}
+                        for w, q in d.items()]})
+        assert hand == ref
+        got, end = ps_remote._unpack_windows(hand)
+        assert got == d and end == len(hand)
+        vals, end2 = wire.REGISTRY["windows"].unpack(hand)
+        assert end2 == len(hand)
+        assert {e["writer"].decode(): e["seq"]
+                for e in vals["entries"]} == d
+
+
+def test_apply_schema_matches_hand_rolled():
+    ids = np.array([3, 5, 5, 11], np.int32)
+    grads = np.arange(16, dtype=np.float32).reshape(4, 4)
+    hand = bytes(ps_remote._pack_apply_req(ids, grads))
+    ref = wire.REGISTRY["apply_req"].pack({"ids": ids, "grads": grads},
+                                          dim=4)
+    assert hand == ref
+    got_ids, got_grads = ps_remote._unpack_apply(hand, 0, 64, 4)
+    np.testing.assert_array_equal(got_ids, ids)
+    np.testing.assert_array_equal(got_grads, grads)
+
+
+def test_apply_id_schema_matches_hand_rolled():
+    ids = np.array([1, 2], np.int32)
+    grads = np.ones((2, 4), np.float32)
+    body = wire.REGISTRY["apply_req"].pack({"ids": ids, "grads": grads},
+                                           dim=4)
+    hand = bytes(ps_remote._pack_apply_id_req(
+        "writer-a", 9, [("old-key", 4)], ids, grads))
+    ref = wire.REGISTRY["apply_id_req"].pack({
+        "writer": b"writer-a", "seq": 9,
+        "guards": [{"key": b"old-key", "q": 4}], "body": body}, dim=4)
+    assert hand == ref
+    writer, seq, guards, got_body = ps_remote._unpack_apply_id(hand)
+    assert (writer, seq, guards) == ("writer-a", 9, [("old-key", 4)])
+    assert bytes(got_body) == body
+
+
+def test_stream_frame_schema_matches_hand_rolled():
+    hand = bytes(ps_remote._pack_stream_frame(1, 2, 3, b"payload"))
+    ref = wire.REGISTRY["stream_frame"].pack(
+        {"seq": 1, "epoch": 2, "gen": 3, "body": b"payload"})
+    assert hand == ref
+
+
+def test_every_schema_roundtrips_through_reference_impl():
+    rng = random.Random(0)
+    for name, sch in wire.REGISTRY.items():
+        for _ in range(10):
+            values = sch.example(rng, dim=4)
+            payload = sch.pack(values, dim=4)
+            _, end = sch.unpack(payload, dim=4)
+            assert end == len(payload), name
+
+
+# ---------------------------------------------------------------------------
+# guard helpers + hardened parsers
+# ---------------------------------------------------------------------------
+
+def test_guard_helpers_raise_wire_error_with_code():
+    with pytest.raises(wire.WireError):
+        wire.need(b"abc", 0, 4)
+    with pytest.raises(wire.WireError):
+        wire.need(b"abc", -1, 1)
+    with pytest.raises(wire.WireError):
+        wire.check_count(-1, 100)
+    with pytest.raises(wire.WireError):
+        wire.check_count(101, 100)
+    with pytest.raises(wire.WireError):
+        wire.read("<q", b"abc")
+    assert wire.check_count(5, 5) == 5
+    try:
+        wire.read("<q", b"")
+    except wire.WireError as e:
+        assert e.code == wire.EBADFRAME
+        assert isinstance(e, ValueError)
+    assert resilience.EBADFRAME == wire.EBADFRAME == 2013
+
+
+def test_unpack_apply_rejects_negative_count():
+    # count=-1 is numpy's "read everything": pre-hardening this parsed
+    # SILENTLY, re-interpreting the whole payload as ids+grads
+    p = struct.pack("<i", -1) + np.arange(16, dtype=np.int32).tobytes()
+    with pytest.raises(wire.WireError):
+        ps_remote._unpack_apply(p, 0, 1 << 30, 1)
+
+
+def test_unpack_apply_rejects_oversized_count():
+    p = struct.pack("<i", 2**31 - 1) + b"\0" * 64
+    with pytest.raises(wire.WireError):
+        ps_remote._unpack_apply(p, 0, 1 << 30, 4)
+
+
+def test_unpack_windows_rejects_hostile_counts():
+    with pytest.raises(wire.WireError):
+        ps_remote._unpack_windows(struct.pack("<i", 2**31 - 1))
+    with pytest.raises(wire.WireError):  # negative writer length
+        ps_remote._unpack_windows(
+            struct.pack("<i", 1) + struct.pack("<i", -8)
+            + struct.pack("<q", 7))
+    with pytest.raises(wire.WireError):  # truncated mid-entry
+        ps_remote._unpack_windows(
+            struct.pack("<i", 1) + struct.pack("<i", 3) + b"ab")
+
+
+def test_unpack_apply_id_rejects_hostile_lengths():
+    with pytest.raises(wire.WireError):
+        ps_remote._unpack_apply_id(struct.pack("<i", -4) + b"\0" * 16)
+    with pytest.raises(wire.WireError):
+        ps_remote._unpack_apply_id(
+            struct.pack("<i", 0) + struct.pack("<qi", 1, 2**31 - 1))
+
+
+# ---------------------------------------------------------------------------
+# naming-plane hardening
+# ---------------------------------------------------------------------------
+
+def test_parse_claims_survives_missing_or_non_string_addr():
+    nodes = [{"tag": "3/8@e7P"},                       # no addr at all
+             {"addr": 7, "tag": "2/8@e7P"},            # non-string addr
+             {"addr": "127.0.0.1:1", "tag": "1/8@e7P"}]
+    claims = naming.parse_claims(nodes)
+    assert claims == {(None, 8, 1): (7, "127.0.0.1:1")}
+
+
+def test_from_json_rejects_string_addresses():
+    # tuple("abc") silently becomes ('a','b','c') — three garbage
+    # one-char addresses — unless the shape is validated
+    bad = '{"version": 1, "replica_sets": [{"addresses": "abc"}]}'
+    with pytest.raises(ValueError):
+        naming.PartitionScheme.from_json(bad)
+
+
+def test_from_json_rejects_non_finite_weight():
+    for w in ("1e999", "-1e999"):
+        bad = ('{"version": 1, "weight": ' + w +
+               ', "replica_sets": [{"addresses": ["h:1"]}]}')
+        with pytest.raises(ValueError):
+            naming.PartitionScheme.from_json(bad)
+
+
+def test_parse_schemes_skips_hostile_records_without_raising():
+    deep = naming.SCHEME_TAG_PREFIX + "[" * 4000 + "]" * 4000
+    good = naming.PartitionScheme(
+        version=2, replica_sets=(naming.ReplicaSet(("h:1",)),))
+    nodes = [
+        {"addr": "0.0.0.0:9", "tag": deep},
+        {"addr": "0.0.0.0:9", "tag": 42},            # non-string tag
+        {"addr": "0.0.0.0:9",
+         "tag": naming.SCHEME_TAG_PREFIX + '{"version": "x"}'},
+        {"addr": "0.0.0.0:2",
+         "tag": naming.SCHEME_TAG_PREFIX + good.to_json()},
+    ]
+    out = naming.parse_schemes(nodes)
+    assert list(out) == [2]
+
+
+def test_shard_tag_parsers_reject_nonsense_numbers():
+    assert naming.parse_shard_tag("-1/8") is None
+    assert naming.parse_shard_tag("3/0") is None
+    assert naming.parse_shard_tag("9/8") is None
+    assert naming.parse_shard_tag("3/8") == (3, 8, 0)
+    assert naming.parse_claim_tag("3/8@e-3P") is None
+    assert naming.parse_claim_tag("3/8@v-2e3P") is None
+    assert naming.parse_claim_tag("3/8@v2e3P") == (3, 8, 0, 3, True, 2)
+
+
+@pytest.mark.needs_native
+def test_set_schemes_strict_lenient_parity():
+    """The strict path and the lenient ingest path must agree RECORD BY
+    RECORD: ``strict=False`` skips exactly the records ``strict=True``
+    raises on, counting each in ``ps_scheme_rejects``."""
+    vocab = 256
+    a = "127.0.0.1:7901"
+    records = [
+        naming.PartitionScheme(
+            version=1, replica_sets=(naming.ReplicaSet((a,)),) * 4),
+        naming.PartitionScheme(              # bounds end != vocab
+            version=2, replica_sets=(naming.ReplicaSet((a,)),) * 2,
+            bounds=(0, 64, 128)),
+        naming.PartitionScheme(              # 5 shards don't divide 256
+            version=3, replica_sets=(naming.ReplicaSet((a,)),) * 5),
+        naming.PartitionScheme(
+            version=4, replica_sets=(naming.ReplicaSet((a,)),) * 2,
+            bounds=(0, 96, vocab)),
+    ]
+    strict_rejects = []
+    for rec in records:
+        emb = ps_remote.RemoteEmbedding([a], vocab, 4)
+        try:
+            emb.set_schemes([rec], strict=True)
+        except ValueError:
+            strict_rejects.append(rec.version)
+        finally:
+            emb.close()
+    assert strict_rejects == [2, 3]
+    emb = ps_remote.RemoteEmbedding([a], vocab, 4)
+    try:
+        before = obs.counter("ps_scheme_rejects").get_value()
+        emb.set_schemes(records, strict=False)
+        got = {v.version for v in emb.schemes()}
+        assert got == {0, 1, 4}
+        assert obs.counter("ps_scheme_rejects").get_value() - before \
+            == len(strict_rejects)
+    finally:
+        emb.close()
+
+
+# ---------------------------------------------------------------------------
+# the wire-contract lint check on seeded fixtures
+# ---------------------------------------------------------------------------
+
+#: the seeded asymmetric pair (satellite fixture): the packer writes
+#: (i32 a, i64 b) but the unpacker reads (i64 a, i64 b) — field-width
+#: drift of exactly the kind docstring symmetry cannot catch
+_DRIFT_FIXTURE = textwrap.dedent("""\
+    import struct
+
+    def _pack_rec(v):
+        return struct.pack("<i", v["a"]) + struct.pack("<q", v["b"])
+
+    def _unpack_rec(p):
+        (a,) = struct.unpack_from("<q", p, 0)
+        (b,) = struct.unpack_from("<q", p, 8)
+        return a, b
+""")
+
+
+def _lint_tmp(source: str):
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "fixture.py")
+        with open(path, "w") as f:
+            f.write(source)
+        return _wire_findings([d])
+
+
+def test_lint_flags_seeded_pack_unpack_drift():
+    findings = _lint_tmp(_DRIFT_FIXTURE)
+    assert any("drift" in f.message and "'iq'" in f.message
+               and "'qq'" in f.message for f in findings), \
+        [f.message for f in findings]
+
+
+def test_fuzzer_catches_the_same_seeded_drift_at_runtime():
+    """Static/dynamic parity: the fixture the lint flags above must
+    also fail ``parity_fuzz`` when executed."""
+    ns = {}
+    exec(_DRIFT_FIXTURE, ns)  # noqa: S102 — the fixture under test
+    sch = wire.FrameSchema(
+        name="rec", fields=(wire.Int("a", "<i"), wire.Int("b", "<q")))
+    failures = fuzz.parity_fuzz(sch, ns["_pack_rec"],
+                                ns["_unpack_rec"], seed=3, iters=20)
+    assert failures, "runtime parity fuzz must catch the drifted pair"
+    assert any(f.kind == "contract" for f in failures)
+    # and a symmetric pair passes both ways
+    def good_pack(v):
+        return struct.pack("<i", v["a"]) + struct.pack("<q", v["b"])
+
+    def good_unpack(p):
+        return wire.read("<iq", p, 0, "rec")
+
+    assert fuzz.parity_fuzz(sch, good_pack, good_unpack, seed=3,
+                            iters=20) == []
+
+
+def test_lint_flags_unpaired_framing_function():
+    findings = _lint_tmp(textwrap.dedent("""\
+        import struct
+
+        def _pack_solo(a):
+            return struct.pack("<q", a)
+    """))
+    assert any("unpaired framing" in f.message for f in findings)
+
+
+def test_lint_flags_unvalidated_count_on_parse_path():
+    findings = _lint_tmp(textwrap.dedent("""\
+        import struct
+
+        def _unpack_list(p):
+            (count,) = struct.unpack_from("<i", p, 0)
+            out = []
+            for i in range(count):
+                out.append(struct.unpack_from("<q", p, 4 + 8 * i))
+            return out
+
+        def _pack_list(vals):
+            out = struct.pack("<i", len(vals))
+            for v in vals:
+                out += struct.pack("<q", v)
+            return out
+    """))
+    assert any("bounds validation" in f.message and "'count'" in
+               f.message for f in findings), \
+        [f.message for f in findings]
+
+
+def test_lint_accepts_guarded_symmetric_pair():
+    findings = _lint_tmp(textwrap.dedent("""\
+        import struct
+
+        def check_count(n, limit):
+            if not 0 <= n <= limit:
+                raise ValueError(n)
+            return n
+
+        def _pack_list(vals):
+            out = struct.pack("<i", len(vals))
+            for v in vals:
+                out += struct.pack("<q", v)
+            return out
+
+        def _unpack_list(p):
+            (count,) = struct.unpack_from("<i", p, 0)
+            check_count(count, (len(p) - 4) // 8)
+            out = []
+            for i in range(count):
+                out.append(struct.unpack_from("<q", p, 4 + 8 * i))
+            return out
+    """))
+    assert findings == [], [f.message for f in findings]
+
+
+def test_lint_flags_native_endian_format():
+    findings = _lint_tmp(textwrap.dedent("""\
+        import struct
+
+        def _pack_rec(a):
+            return struct.pack("qq", a, a)
+
+        def _unpack_rec(p):
+            return struct.unpack_from("qq", p, 0)
+    """))
+    assert sum("little-endian" in f.message for f in findings) == 2
+
+
+def test_fuzz_coverage_map_covers_every_declared_parser():
+    covered = {c for cs in fuzz.coverage_map().values() for c in cs}
+    for name in wire.REGISTRY:
+        assert name in covered, f"schema {name} has no fuzz target"
+    for qual in wire.TEXT_PARSERS:
+        assert qual in covered, f"text parser {qual} has no fuzz target"
+
+
+# ---------------------------------------------------------------------------
+# ps_parse_rejects: malformed frames are visible in _status vars
+# ---------------------------------------------------------------------------
+
+@pytest.mark.needs_native
+def test_malformed_unary_counts_ps_parse_rejects():
+    from brpc_tpu import rpc
+    from brpc_tpu.ps_remote import PsShardServer
+
+    server = PsShardServer(64, 4, 0, 1)
+    ch = rpc.Channel(server.address)
+    try:
+        before = obs.counter("ps_parse_rejects").get_value()
+        before_m = obs.counter("ps_parse_rejects_ApplyGrad").get_value()
+        bad = struct.pack("<i", -1) + b"\0" * 32
+        with pytest.raises(rpc.RpcError) as ei:
+            ch.call("Ps", "ApplyGrad", bad)
+        assert ei.value.code == wire.EBADFRAME
+        with pytest.raises(rpc.RpcError) as ei:
+            ch.call("Ps", "Lookup", struct.pack("<i", 3) + b"\0" * 4)
+        assert ei.value.code == wire.EBADFRAME
+        assert obs.counter("ps_parse_rejects").get_value() \
+            - before == 2
+        assert obs.counter("ps_parse_rejects_ApplyGrad").get_value() \
+            - before_m == 1
+        # a well-formed call still serves
+        ids = np.array([1, 2], np.int32)
+        rsp = ch.call("Ps", "Lookup",
+                      bytes(ps_remote._pack_lookup_req(ids)))
+        assert len(rsp) == 2 * 4 * 4
+    finally:
+        ch.close()
+        server.close()
