@@ -111,6 +111,16 @@ def run_reshard_bench(deadline_s: int = 300) -> dict:
     return _run_json_child("bench_reshard.py", "reshard", deadline_s)
 
 
+def run_scenarios_bench(deadline_s: int = 300) -> dict:
+    """Overload-control SLO matrix (bench_scenarios.py child): the
+    press harness (zipf skew, read/write mix, open-loop bursts) against
+    the limiter/deadline config matrix — availability, p99 of
+    successes, and goodput per scenario x config, plus trace
+    record/replay determinism (also refreshes BENCH_scenarios.json)."""
+    return _run_json_child("bench_scenarios.py", "scenarios",
+                           deadline_s)
+
+
 def run_fault_bench(deadline_s: int = 300) -> dict:
     """Fault-tolerance numbers (bench_fault.py child): backup-request
     p99 bounding under an injected slow shard, breaker availability and
@@ -274,6 +284,10 @@ def main() -> int:
         # (bench_reshard.py child).
         reshard_block = run_reshard_bench()
 
+        # Overload control (ISSUE 12): scenario SLO matrix under the
+        # limiter/deadline config cross (bench_scenarios.py child).
+        scenarios_block = run_scenarios_bench()
+
         gbps = best["gbps"]
         print(json.dumps({
             "metric": "same_host_echo_throughput",
@@ -297,6 +311,7 @@ def main() -> int:
             "ps_write": ps_write_block,
             "fault": fault_block,
             "reshard": reshard_block,
+            "scenarios": scenarios_block,
             **device_blocks,
         }))
         return 0

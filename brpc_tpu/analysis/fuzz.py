@@ -70,11 +70,13 @@ SANCTIONED_LIVE_CODES = frozenset({
     1003,   # EREQUEST — native parser reject
     2001,   # EINTERNAL — handler ValueError (out-of-range ids, ...)
     2002,   # ENOMETHOD/unknown-method family
+    2004,   # ELIMIT — a mutated burst may trip a configured limiter
     2009,   # ENOTPRIMARY
     2010,   # EFENCED
     2011,   # EMIGRATING
     2012,   # ESCHEMEMOVED
     wire.EBADFRAME,
+    2014,   # EDEADLINE — a mutated deadline header may be expired
 })
 
 #: per-exec wall bound: a parser that takes longer than this on a
@@ -293,6 +295,42 @@ def python_targets(*, dim: int = 4) -> List[FuzzTarget]:
         gen=lambda rng, n: mutated_frames(
             wire.REGISTRY["apply_id_req"], rng, n, dim=dim),
         exec_fn=_apply_id))
+
+    targets.append(FuzzTarget(
+        name="unpack_deadline",
+        covers=("deadline_hdr",),
+        gen=lambda rng, n: mutated_frames(
+            wire.REGISTRY["deadline_hdr"], rng, n, dim=dim),
+        exec_fn=lambda p: ps_remote._unpack_deadline(bytes(p))))
+
+    def _press_trace_cases(rng: random.Random, iters: int):
+        """Mutated whole trace files: schema-mutated headers, and a
+        valid header (claiming one record) followed by schema-mutated
+        record bytes — the parser must reject mid-file corruption
+        cleanly, never crash or replay garbage."""
+        from brpc_tpu import press
+        hdr_sch = wire.REGISTRY["press_header"]
+        rec_sch = wire.REGISTRY["press_record"]
+        good_hdr = press._pack_press_header(seed=1, vocab=64, dim=4,
+                                            count=1)
+        # exactly `iters` cases total: the tier-1 smoke asserts every
+        # target runs its full budget
+        for desc, frame in mutated_frames(hdr_sch, rng, iters // 2,
+                                          dim=dim):
+            yield f"hdr:{desc}", frame
+        for desc, frame in mutated_frames(rec_sch, rng,
+                                          iters - iters // 2, dim=dim):
+            yield f"rec:{desc}", good_hdr + frame
+
+    def _parse_trace(p):
+        from brpc_tpu import press
+        return press.parse_trace(p)
+
+    targets.append(FuzzTarget(
+        name="press_trace",
+        covers=("press_header", "press_record"),
+        gen=_press_trace_cases,
+        exec_fn=_parse_trace))
 
     targets.append(FuzzTarget(
         name="parse_shard_tag",

@@ -24,7 +24,7 @@ over the RPC fabric itself so a remote ``Channel`` can scrape any node
 from __future__ import annotations
 
 import os
-from typing import Dict, Tuple
+from typing import Callable, Dict, Tuple
 
 from brpc_tpu.analysis.race import checked_lock
 
@@ -62,8 +62,8 @@ __all__ = [
     "Span", "SpanRing", "default_ring", "dump_rpcz", "format_rpcz",
     "record_span", "span",
     # gate + cached fabric helpers
-    "enabled", "set_enabled", "recorder", "counter", "maxer",
-    "reset_fabric_vars",
+    "enabled", "set_enabled", "recorder", "counter", "maxer", "gauge",
+    "drop_var", "reset_fabric_vars",
 ]
 
 _enabled = os.environ.get("BRPC_TPU_OBS", "1") not in ("0", "false", "off")
@@ -87,6 +87,7 @@ _fabric_mu = checked_lock("obs.fabric")
 _recorders: Dict[str, LatencyRecorder] = {}
 _counters: Dict[str, Adder] = {}
 _maxers: Dict[str, Maxer] = {}
+_gauges: Dict[str, PassiveStatus] = {}
 
 
 def recorder(name: str, window_size: int = 10) -> LatencyRecorder:
@@ -129,12 +130,38 @@ def maxer(name: str) -> Maxer:
     return m
 
 
+def gauge(name: str, fn: Callable[[], object]) -> PassiveStatus:
+    """Exposes (or replaces) a :class:`PassiveStatus` under ``name`` —
+    a value computed on read (live inflight, the adaptive limiter's
+    current max_concurrency).  Components with a lifetime (a shard
+    server's overload gauges) pair this with :func:`drop_var` at
+    teardown."""
+    g = PassiveStatus(fn)
+    with _fabric_mu:
+        g.expose(name)
+        _gauges[name] = g
+    return g
+
+
+def drop_var(name: str) -> None:
+    """Hide one fabric variable (any kind) and drop its cache entry —
+    the teardown half of per-component gauges."""
+    with _fabric_mu:
+        default_registry().hide(name)
+        _recorders.pop(name, None)
+        _counters.pop(name, None)
+        _maxers.pop(name, None)
+        _gauges.pop(name, None)
+
+
 def reset_fabric_vars() -> None:
     """Drop all cached fabric recorders/counters and their registry
     entries (test isolation)."""
     with _fabric_mu:
-        for name in list(_recorders) + list(_counters) + list(_maxers):
+        for name in list(_recorders) + list(_counters) + list(_maxers) \
+                + list(_gauges):
             default_registry().hide(name)
         _recorders.clear()
         _counters.clear()
         _maxers.clear()
+        _gauges.clear()

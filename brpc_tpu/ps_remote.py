@@ -43,6 +43,7 @@ import numpy as np
 
 from brpc_tpu import obs, resilience, rpc, wire
 from brpc_tpu.analysis.race import checked_lock, checked_rwlock
+from brpc_tpu.limiter import ServerLimiter
 from brpc_tpu.naming import (PartitionScheme, ReplicaSet, parse_claims,
                              parse_schemes, parse_shard_tag)
 
@@ -107,6 +108,53 @@ def _pack_apply_req(owned: np.ndarray, grads: np.ndarray) -> bytearray:
     np.frombuffer(req, np.float32, grads.size, 4 + 4 * n)[:] = \
         grads.reshape(-1)
     return req
+
+
+def _pack_deadline(deadline_us: int, body) -> bytearray:
+    """Prefix a data-plane request with its deadline header (wire
+    schema ``deadline_hdr``): magic ++ absolute wall-clock deadline in
+    microseconds ++ the original body.  The magic sits above any
+    legitimate count/length field, so stamped and bare framings never
+    collide; servers (Python AND the native Lookup handler) peel it and
+    shed expired work before touching the table."""
+    out = bytearray(12 + len(body))
+    struct.pack_into("<iq", out, 0, wire.DEADLINE_MAGIC, deadline_us)
+    out[12:] = body
+    return out
+
+
+def _unpack_deadline(payload):
+    """Inverse of :func:`_pack_deadline`: returns ``(body,
+    deadline_us)`` — ``(payload, 0)`` when no header is present.  A
+    frame that DOES open with the magic must carry the full 12-byte
+    header (guarded: truncation is a hostile frame, not a legacy
+    one — no legitimate count field equals the magic)."""
+    if len(payload) < 4:
+        return payload, 0
+    (magic,) = struct.unpack_from("<i", payload, 0)
+    if magic != wire.DEADLINE_MAGIC:
+        return payload, 0
+    (deadline_us,) = wire.read("<q", payload, 4, "deadline.us")
+    return bytes(memoryview(payload)[12:]), deadline_us
+
+
+def _admit_deadline(method: str, payload: bytes) -> bytes:
+    """Deadline admission for one request: peel the optional header
+    and SHED work whose propagated budget is already exhausted —
+    before any parse, any lock, any table touch (``EDEADLINE``; the
+    acceptance contract of the overload tier).  Counted per method in
+    ``ps_deadline_drops[_<Method>]``; the server span carries a
+    ``shed=deadline`` rpcz tag via the trampoline."""
+    body, deadline_us = _unpack_deadline(payload)
+    if deadline_us > 0 and time.time() * 1e6 > deadline_us:
+        if obs.enabled():
+            obs.counter("ps_deadline_drops").add(1)
+            obs.counter(f"ps_deadline_drops_{method}").add(1)
+        raise rpc.RpcError(
+            resilience.EDEADLINE,
+            f"{method}: propagated deadline budget exhausted before "
+            f"the handler started")
+    return body
 
 
 #: stream frame header: (seq, epoch, gen) int64 — StreamApply uses seq
@@ -905,11 +953,18 @@ class PsShardServer:
       orderings land byte-identical tables for exactly-representable
       gradients (proven in tests/test_ps_stream.py)."""
 
+    #: data-plane methods gated by a spec-string limiter; control
+    #: traffic (failover, migration, flush barriers) stays admissible
+    #: under overload — shedding a Promote would turn an overload into
+    #: an availability incident
+    LIMITED_METHODS = ("Lookup", "ApplyGrad", "ApplyGradId")
+
     def __init__(self, vocab: int, dim: int, shard_index: int,
                  num_shards: int, lr: float = 0.1, seed: int = 0,
                  lock_mode: str = "rw", native_read: bool = False,
                  combine: bool = False, stream: bool = False,
-                 importing: bool = False, scheme_version: int = 0):
+                 importing: bool = False, scheme_version: int = 0,
+                 limiter=None):
         if vocab % num_shards:
             raise ValueError("num_shards must divide vocab")
         self.shard_index = shard_index
@@ -989,6 +1044,36 @@ class PsShardServer:
             GradCombiner(self._apply_batch, dim, pass_meta=True)
             if (self.combine or self.stream) else None)
         self.server = rpc.Server()
+        # Overload control (brpc_tpu.limiter): a spec string ("auto" /
+        # "constant:<n>") gates the DATA-PLANE methods with per-method
+        # adaptive admission, and — under native_read — installs the
+        # same policy as the NATIVE server-wide limiter so the
+        # zero-Python Lookup path sheds too (both answer ELIMIT).  A
+        # ready-built ServerLimiter passes through as-is (callers pick
+        # their own method set / options / clock).
+        self.limiter: Optional[ServerLimiter] = None
+        self._gauge_names: tuple = ()
+        if limiter is not None:
+            if isinstance(limiter, str):
+                self.limiter = ServerLimiter(
+                    limiter, methods=self.LIMITED_METHODS,
+                    counter_prefix="ps")
+                if self.native_read:
+                    name, _, arg = limiter.partition(":")
+                    self.server.set_native_concurrency_limiter(
+                        name, int(arg) if arg else 0)
+            else:
+                self.limiter = limiter
+            self.server.set_concurrency_limiter(self.limiter)
+            if obs.enabled():
+                lim = self.limiter
+                self._gauge_names = (
+                    f"ps_inflight_shard{shard_index}",
+                    f"ps_max_concurrency_shard{shard_index}")
+                obs.gauge(self._gauge_names[0], lim.total_inflight)
+                obs.gauge(self._gauge_names[1],
+                          lambda: max(lim.max_concurrency().values(),
+                                      default=0))
         # The trampoline is ALWAYS stream-capable: replica delta
         # propagation (ReplicaApply) rides a stream whether or not the
         # client-facing StreamApply mode is on.
@@ -1293,6 +1378,9 @@ class PsShardServer:
 
     def _handle(self, method: str, payload: bytes) -> bytes:
         try:
+            # Deadline admission FIRST: expired queued work sheds here
+            # (EDEADLINE), before any parse or table touch.
+            payload = _admit_deadline(method, payload)
             if not obs.enabled():
                 return self._serve(method, payload)
             t0 = time.monotonic_ns()
@@ -1866,6 +1954,9 @@ class PsShardServer:
         if self._shard is not None:
             self._shard.close()
             self._shard = None
+        for name in self._gauge_names:
+            obs.drop_var(name)
+        self._gauge_names = ()
 
 
 class _TableGen:
@@ -1924,7 +2015,7 @@ class DevicePsShardServer:
                  num_shards: int, lr: float = 0.1, seed: int = 0,
                  device_client: "rpc.DeviceClient | None" = None,
                  device_index: int = 0, combine: bool = False,
-                 stream: bool = False):
+                 stream: bool = False, limiter=None):
         if vocab % num_shards:
             raise ValueError("num_shards must divide vocab")
         self.shard_index = shard_index
@@ -1962,6 +2053,16 @@ class DevicePsShardServer:
             GradCombiner(self._apply_batch, dim)
             if (self.combine or self.stream) else None)
         self.server = rpc.Server()
+        # Same overload-control surface as the CPU shard: a spec string
+        # gates the data-plane methods (device launches are the scarce
+        # resource here), a ready ServerLimiter passes through.
+        self.limiter: Optional[ServerLimiter] = None
+        if limiter is not None:
+            self.limiter = ServerLimiter(
+                limiter, methods=PsShardServer.LIMITED_METHODS,
+                counter_prefix="ps") if isinstance(limiter, str) \
+                else limiter
+            self.server.set_concurrency_limiter(self.limiter)
         if self.stream:
             self.server.add_stream_handler("Ps", self._handle_stream)
         else:
@@ -2033,6 +2134,9 @@ class DevicePsShardServer:
 
     def _handle(self, method: str, payload: bytes) -> bytes:
         try:
+            # Same admission order as the CPU shard: expired work sheds
+            # before any parse or device launch.
+            payload = _admit_deadline(method, payload)
             if not obs.enabled():
                 return self._serve(method, payload)
             t0 = time.monotonic_ns()
@@ -2546,11 +2650,20 @@ class RemoteEmbedding:
                  health_check: bool = False,
                  health_interval_ms: float = 200.0,
                  push_window_bytes: int = 0,
-                 scorer: "Optional[resilience.ReplicaScorer]" = None):
+                 scorer: "Optional[resilience.ReplicaScorer]" = None,
+                 propagate_deadline: bool = True):
         self.vocab = vocab
         self.dim = dim
         self.parallel = parallel
         self.timeout_ms = timeout_ms
+        #: deadline propagation: with a ``deadline_ms`` budget set,
+        #: every data-plane request (and every retry/hedge leg,
+        #: re-stamped at issue time) carries its REMAINING budget as a
+        #: wall-clock deadline header, so servers shed queued work that
+        #: can no longer answer in time (EDEADLINE) instead of
+        #: executing it into a void.  Same-host clocks agree exactly;
+        #: cross-host this assumes NTP-grade wall-clock agreement.
+        self.propagate_deadline = bool(propagate_deadline)
         #: per-shard unconsumed-bytes window for push streams (0 = the
         #: native 2MB default) — the backpressure knob of push_gradients
         self.push_window_bytes = push_window_bytes
@@ -3027,6 +3140,18 @@ class RemoteEmbedding:
             if gen > view._gen_seen[s]:
                 view._gen_seen[s] = gen
 
+    def _stamp(self, req, deadline: Optional[float]):
+        """Deadline propagation for one request LEG: prefix ``req``
+        with the batch's remaining budget (``deadline`` is the batch's
+        ``time.monotonic`` instant) converted to an absolute wall-clock
+        deadline at THIS issue.  Called per attempt — a retry or hedge
+        leg carries what is left NOW, not the original budget."""
+        if deadline is None or not self.propagate_deadline:
+            return req
+        deadline_us = int((time.time() + (deadline - time.monotonic()))
+                          * 1e6)
+        return _pack_deadline(deadline_us, req)
+
     def _reroutable(self, view: _SchemeView, s: int,
                     exc: rpc.RpcError) -> bool:
         """True for routing-correction errors (the write reached a
@@ -3076,7 +3201,10 @@ class RemoteEmbedding:
                 if remaining_ms < 2.0:
                     raise e
             if not reroute:
-                delay = policy.backoff.delay_ms(attempt)
+                # ELIMIT sheds take the MANDATORY backoff floor
+                # (retry_delay_ms): never re-issue immediately into the
+                # overload that just shed us.
+                delay = policy.retry_delay_ms(e, attempt)
                 if remaining_ms is not None:
                     delay = min(delay, remaining_ms - 1.0)
                 resilience.sleep_ms(delay)
@@ -3095,9 +3223,9 @@ class RemoteEmbedding:
             view.scorer.note_start(addr)
             t0 = time.monotonic()
             try:
-                rsp = self._chan(addr).call("Ps", method, req,
-                                            timeout_ms=t,
-                                            backup_ms=self.backup_ms)
+                rsp = self._chan(addr).call(
+                    "Ps", method, self._stamp(req, deadline),
+                    timeout_ms=t, backup_ms=self.backup_ms)
             except rpc.RpcError as e2:
                 routing = e2.code in (resilience.ENOTPRIMARY,
                                       resilience.EFENCED,
@@ -3164,10 +3292,11 @@ class RemoteEmbedding:
             t0s[i] = time.monotonic()
             try:
                 # managed fan-out set: every entry is joined or
-                # cancelled+closed in the finally below
+                # cancelled+closed in the finally below; each leg is
+                # stamped with the budget remaining at ITS issue
                 pending[i] = self._chan(addr).call_async(  # lint: allow-handle-escape
-                    "Ps", method, req, timeout_ms=_budget(),
-                    tag=f"attempt={attempts[i]}")
+                    "Ps", method, self._stamp(req, deadline),
+                    timeout_ms=_budget(), tag=f"attempt={attempts[i]}")
             except rpc.RpcError as e:
                 pending[i] = e
 
@@ -3202,8 +3331,11 @@ class RemoteEmbedding:
                     try:
                         if isinstance(pc, rpc.RpcError):
                             raise pc
+                        # the hedge leg re-stamps: a backup fired
+                        # backup_ms late carries the budget left THEN
                         rsp = resilience.backup_call(
-                            self._chan(addrs[i]), "Ps", method, req,
+                            self._chan(addrs[i]), "Ps", method,
+                            self._stamp(req, deadline),
                             backup_ms=self.backup_ms,
                             timeout_ms=_budget(), primary=pc)
                     except rpc.RpcError as e:
@@ -3293,9 +3425,11 @@ class RemoteEmbedding:
                     s = items[i][0]
                     if not read and self._reroutable(view, s, excs[i]):
                         continue   # routing correction: no backoff
+                    # retry_delay_ms floors ELIMIT sheds (mandatory
+                    # backoff — never re-fan straight into overload)
                     round_delay = max(round_delay,
-                                      self.retry.backoff.delay_ms(
-                                          attempts[i]))
+                                      self.retry.retry_delay_ms(
+                                          excs[i], attempts[i]))
                 if deadline is not None:
                     remaining_ms = (deadline
                                     - time.monotonic()) * 1000.0
@@ -3327,20 +3461,23 @@ class RemoteEmbedding:
                     req: bytes) -> bytes:
         """Sequential-path shard call with the same per-shard policy
         (routed; a routing-correction error fails over once)."""
+        deadline = time.monotonic() + self.deadline_ms / 1000.0 \
+            if self.deadline_ms is not None else None
         addr = self._route_read(view, s) if method == "Lookup" \
             else self._route_write(view, s)
         try:
             return self._chan(addr).call(
-                "Ps", method, req, retry=self.retry,
-                deadline_ms=self.deadline_ms, backup_ms=self.backup_ms,
+                "Ps", method, self._stamp(req, deadline),
+                retry=self.retry, deadline_ms=self.deadline_ms,
+                backup_ms=self.backup_ms,
                 breaker=self._addr_breaker(addr))
         except rpc.RpcError as e:
             if method != "Lookup" and not self._scheme_miss(e) and \
                     self._reroutable(view, s, e):
                 addr = self._route_write(view, s, {addr})
                 return self._chan(addr).call(
-                    "Ps", method, req, retry=self.retry,
-                    deadline_ms=self.deadline_ms,
+                    "Ps", method, self._stamp(req, deadline),
+                    retry=self.retry, deadline_ms=self.deadline_ms,
                     backup_ms=self.backup_ms,
                     breaker=self._addr_breaker(addr))
             raise

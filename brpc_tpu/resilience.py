@@ -58,7 +58,8 @@ from brpc_tpu.analysis.race import checked_lock
 __all__ = [
     "Backoff", "sleep_ms", "RetryPolicy", "RETRIABLE_CODES",
     "EBREAKEROPEN", "ENOTPRIMARY", "EFENCED", "EMIGRATING",
-    "ESCHEMEMOVED", "EBADFRAME", "call_with_retry",
+    "ESCHEMEMOVED", "EBADFRAME", "ELIMIT", "EDEADLINE",
+    "call_with_retry",
     "backup_call", "resilient_call", "BreakerOptions", "CircuitBreaker",
     "BreakerRegistry", "HealthProber", "ReplicaScorer",
     "default_registry", "set_default_registry", "health_components",
@@ -87,6 +88,16 @@ ESCHEMEMOVED = 2012
 #: allocation or state mutation (:mod:`brpc_tpu.wire`) — never
 #: retriable: the same bytes parse the same way twice
 EBADFRAME = _wire.EBADFRAME
+#: the server's concurrency limiter shed the request before the handler
+#: ran (native errors.h ELIMIT; brpc_tpu.limiter) — transient by
+#: definition, but retriable ONLY with a mandatory backoff: an
+#: immediate re-issue lands straight back in the overload that shed it
+ELIMIT = 2004
+#: the request's propagated deadline budget was exhausted before the
+#: handler started (the server shed queued work it could no longer
+#: finish in time) — never retriable: the caller's budget is gone, and
+#: the answer the retry would fetch is already too late
+EDEADLINE = 2014
 
 #: native error codes worth retrying: the request may never have reached
 #: the server, or the failure is transient by construction.  Application
@@ -174,12 +185,31 @@ class RetryPolicy:
     ``attempt_timeout_ms`` caps any SINGLE attempt's native timeout
     below the total deadline budget — without it, one black-holed
     attempt (lost request, dead peer) eats the whole budget and the
-    retries the budget was supposed to buy never run."""
+    retries the budget was supposed to buy never run.
+
+    ``limit_backoff_floor_ms`` is the MANDATORY minimum backoff before
+    retrying an ``ELIMIT`` shed: a limiter rejection is proof the
+    server is past capacity right now, and an immediate re-issue (a
+    zero-base backoff, a jittered-to-nothing delay) just feeds the
+    overload it bounced off.  The floor still yields to the caller's
+    total deadline budget — it raises the sleep, never the deadline."""
 
     max_attempts: int = 3
     retriable: frozenset = RETRIABLE_CODES
     backoff: Backoff = Backoff()
     attempt_timeout_ms: Optional[float] = None
+    limit_backoff_floor_ms: float = 5.0
+
+    def retry_delay_ms(self, exc: BaseException, attempt: int) -> float:
+        """The backoff before retrying ``attempt``'s failure: the
+        schedule's delay, floored at ``limit_backoff_floor_ms`` for
+        ``ELIMIT`` sheds (counted in ``rpc_limit_backoffs``)."""
+        delay = self.backoff.delay_ms(attempt)
+        if getattr(exc, "code", None) == ELIMIT:
+            delay = max(delay, self.limit_backoff_floor_ms)
+            if obs.enabled():
+                obs.counter("rpc_limit_backoffs").add(1)
+        return delay
 
     def cap_attempt_timeout(
             self, timeout_ms: Optional[int]) -> Optional[int]:
@@ -255,7 +285,7 @@ def call_with_retry(channel, service: str, method: str,
                 if obs.enabled() and attempt > 0:
                     obs.counter("rpc_retry_give_up").add(1)
                 raise
-            delay = policy.backoff.delay_ms(attempt)
+            delay = policy.retry_delay_ms(e, attempt)
             if deadline is not None:
                 remaining_ms = (deadline - clock()) * 1000.0
                 if remaining_ms < 2.0:

@@ -128,6 +128,11 @@ def _load_locked():
     lib.brt_server_port.restype = ctypes.c_int
     lib.brt_server_stop.argtypes = [ctypes.c_void_p]
     lib.brt_server_stop.restype = None
+    lib.brt_server_set_concurrency_limiter.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.brt_server_set_concurrency_limiter.restype = ctypes.c_int
+    lib.brt_server_max_concurrency.argtypes = [ctypes.c_void_p]
+    lib.brt_server_max_concurrency.restype = ctypes.c_int
     lib.brt_server_destroy.argtypes = [ctypes.c_void_p]
     lib.brt_server_destroy.restype = None
     lib.brt_session_respond.argtypes = [
@@ -642,6 +647,11 @@ def uninstall_drop_hook() -> None:
     _drop_hook_ref = None
 
 
+#: overload-shed error codes -> the rpcz annotation that keeps shed
+#: requests visible in traces instead of vanishing as generic errors
+_SHED_TAGS = {2004: "shed=limiter", 2014: "shed=deadline"}
+
+
 def _record_server_call(service: str, method: str, t0: int, wall: float,
                         req_len: int, rsp_len: int,
                         error: Optional[str],
@@ -652,12 +662,14 @@ def _record_server_call(service: str, method: str, t0: int, wall: float,
     obs.counter("rpc_server_out_bytes").add(rsp_len)
     if error is not None:
         obs.counter("rpc_server_errors").add(1)
+    tag = _SHED_TAGS.get(error_code) if error is not None else None
     obs.record_span(obs.Span(
         service=service, method=method, side="server",
         request_bytes=req_len, response_bytes=rsp_len, start_ns=t0,
         end_ns=end, wall_time=wall,
         error_code=error_code if error else 0,
-        error_text=error or ""))
+        error_text=error or "",
+        annotations=[tag] if tag else []))
 
 
 def _error_code_of(e: BaseException) -> int:
@@ -695,6 +707,40 @@ class Server:
         self._ptr = self._lib.brt_server_new()
         self._handlers = []  # keep CFUNCTYPE refs alive
         self._listen: Optional[str] = None  # set by start()
+        # per-method overload control (brpc_tpu.limiter.ServerLimiter);
+        # consulted by both trampolines on every dispatch
+        self._limiter = None
+
+    def set_concurrency_limiter(self, limiter) -> None:
+        """Installs per-method overload control on the PYTHON
+        trampolines: ``limiter`` is a
+        :class:`brpc_tpu.limiter.ServerLimiter` (None clears).  A
+        request its method gate refuses answers ``ELIMIT`` (2004)
+        without touching the handler; admitted requests feed the
+        gate's limiter with their outcome and handler latency.
+        Live-switchable — gates are consulted per dispatch."""
+        self._limiter = limiter
+
+    def set_native_concurrency_limiter(self, name: str,
+                                       max_concurrency: int = 0) -> None:
+        """Installs the NATIVE server-wide concurrency limiter
+        (``"auto"``, ``"constant"`` + ``max_concurrency``,
+        ``"timeout[:us]"``, ``""`` = off — cpp/rpc/concurrency_limiter):
+        enforced in the C++ dispatch path before ANY Python runs, so the
+        zero-Python native Lookup path (``add_ps_service``) sheds too.
+        Must be called before :meth:`start`."""
+        rc = self._lib.brt_server_set_concurrency_limiter(
+            self._ptr, name.encode(), max_concurrency)
+        if rc != 0:
+            raise RuntimeError(
+                f"set_native_concurrency_limiter failed: {rc} "
+                f"(server already started?)")
+
+    @property
+    def native_max_concurrency(self) -> int:
+        """The native limiter's current ceiling (0 = off/unlimited) —
+        the adaptive gauge for the native dispatch path."""
+        return self._lib.brt_server_max_concurrency(self._ptr)
 
     def _sync_trampoline(self, name: str,
                          handler: Callable[[str, bytes], bytes], *,
@@ -711,35 +757,66 @@ class Server:
         @_HANDLER
         def trampoline(user, method, req, req_len, session):
             rec = obs.enabled()
-            if rec:
-                t0 = time.monotonic_ns()
-                wall = time.time()
+            # t0 is unconditional: the method gate's limiter needs the
+            # handler latency whether or not obs is recording
+            t0 = time.monotonic_ns()
+            wall = time.time() if rec else 0.0
             m = b""
+            mstr = ""
             out_len = 0
             err = None
+            err_code = 0
+            gate = None
             try:
                 m = method
+                mstr = m.decode()
+                lim = self._limiter
+                if lim is not None:
+                    g = lim.gate(mstr)
+                    if g is not None:
+                        if not g.admit():
+                            # per-method overload shed: answered before
+                            # the handler (or even the request bytes)
+                            # are touched — the MethodStatus::OnRequested
+                            # contract
+                            raise RpcError(
+                                resilience.ELIMIT,
+                                f"{name}.{mstr} shed: concurrency limit "
+                                f"{g.max_concurrency} reached")
+                        gate = g
                 data = ctypes.string_at(req, req_len) if req_len else b""
                 if fault.active():
-                    fault.server_intercept(name, m.decode(), self._listen)
+                    fault.server_intercept(name, mstr, self._listen)
                 if pass_accept:
-                    out = handler(m.decode(), data,
+                    out = handler(mstr, data,
                                   _make_stream_accept(lib, session))
                 else:
-                    out = handler(m.decode(), data)
+                    out = handler(mstr, data)
                 if out is None:
                     out = b""
                 out_len = len(out)
-                lib.brt_session_respond(session, out, out_len, 0, None)
             except Exception as e:  # noqa: BLE001
                 err = str(e)
                 err_code = _error_code_of(e)
-                lib.brt_session_respond(session, None, 0, err_code,
-                                        err.encode())
-            if rec:
-                _record_server_call(name, m.decode(errors="replace"), t0,
-                                    wall, req_len, out_len, err,
-                                    err_code if err else 2001)
+            # Accounting BEFORE the response leaves: the moment the
+            # client sees the reply it may read this server's vars —
+            # a record landing after the respond races that read.
+            try:
+                if gate is not None:
+                    gate.on_responded(
+                        err_code, (time.monotonic_ns() - t0) // 1000)
+                if rec:
+                    _record_server_call(
+                        name, mstr or m.decode(errors="replace"), t0,
+                        wall, req_len, out_len, err,
+                        err_code if err else 2001)
+            finally:
+                if err is None:
+                    lib.brt_session_respond(session, out, out_len, 0,
+                                            None)
+                else:
+                    lib.brt_session_respond(session, None, 0, err_code,
+                                            err.encode())
 
         return trampoline
 
@@ -810,29 +887,47 @@ class Server:
             sess = ctypes.c_void_p(session)
             m = method.decode()
             rec = obs.enabled()
+            t0 = time.monotonic_ns()  # gate latency needs it without obs
             if rec:
-                t0 = time.monotonic_ns()
                 wall = time.time()
                 nreq = req_len
+            gate = None
 
             def respond(payload: bytes = b"", error: Optional[str] = None,
                         error_code: int = 2001):
                 # Latency spans dispatch -> respond, wherever respond runs
                 # (the async contract: any thread, after the fiber worker
-                # is long gone).
+                # is long gone).  Accounting lands BEFORE the response
+                # leaves — a client reading this server's vars right
+                # after its reply must see this call counted.
+                if gate is not None:
+                    gate.on_responded(
+                        error_code if error is not None else 0,
+                        (time.monotonic_ns() - t0) // 1000)
                 if error is not None:
-                    lib.brt_session_respond(sess, None, 0, error_code,
-                                            error.encode())
                     if rec:
                         _record_server_call(name, m, t0, wall, nreq, 0,
                                             error, error_code)
+                    lib.brt_session_respond(sess, None, 0, error_code,
+                                            error.encode())
                 else:
-                    lib.brt_session_respond(sess, payload, len(payload), 0,
-                                            None)
                     if rec:
                         _record_server_call(name, m, t0, wall, nreq,
                                             len(payload), None)
+                    lib.brt_session_respond(sess, payload, len(payload), 0,
+                                            None)
 
+            lim = self._limiter
+            if lim is not None:
+                g = lim.gate(m)
+                if g is not None and not g.admit():
+                    # refused: respond ELIMIT with gate still None, so
+                    # nothing is released on a request never admitted
+                    respond(error=f"{name}.{m} shed: concurrency limit "
+                                  f"{g.max_concurrency} reached",
+                            error_code=resilience.ELIMIT)
+                    return
+                gate = g
             try:
                 if fault.active():
                     fault.server_intercept(name, m, self._listen)

@@ -55,6 +55,18 @@ EBADFRAME = 2013
 #: bound is additionally clamped by the bytes actually present.
 MAX_WIRE_COUNT = 1 << 24
 
+#: first-int32 sentinel of the optional deadline header
+#: (schema ``deadline_hdr``): > MAX_WIRE_COUNT, so no legitimate count
+#: or length field of any data-plane framing can collide with it — a
+#: request starting with this value carries a 12-byte deadline prefix,
+#: anything else is the bare legacy framing.  The native Lookup parser
+#: (cpp/capi/ps_shard.cc) tests the same constant.
+DEADLINE_MAGIC = 0x7EAD11E5
+
+#: first-int32 sentinel of a press trace file ("PRS1" little-endian,
+#: schema ``press_header``)
+PRESS_MAGIC = 0x31535250
+
 
 class WireError(ValueError):
     """Malformed frame, rejected by a bounds/validity check BEFORE any
@@ -506,6 +518,43 @@ schema(
     pack_sites=("ps_remote.PsShardServer._serve_control",
                 "ps_remote.PsShardServer._serve_stream_setup"),
     response=True)
+
+schema(
+    "deadline_hdr",
+    Int("magic", "<i"), Int("deadline_us"), Tail("body"),
+    doc="optional request prefix (overload control): DEADLINE_MAGIC ++ "
+        "absolute wall-clock deadline in microseconds ++ the original "
+        "request body — servers shed work whose budget is already "
+        "exhausted (EDEADLINE 2014) before touching the table; the "
+        "native Lookup handler peels the same header",
+    pack_sites=("ps_remote._pack_deadline",),
+    unpack_sites=("ps_remote._unpack_deadline",),
+    exact_sites=("ps_remote._pack_deadline",
+                 "ps_remote._unpack_deadline"),
+    native_sites=("cpp/capi/ps_shard.cc:CPsService::ServeLookup",))
+
+schema(
+    "press_header",
+    Int("magic", "<i"), Int("version", "<i"), Int("seed"),
+    Int("vocab"), Int("dim", "<i"), Int("count", "<i"),
+    doc="press trace file header: PRESS_MAGIC ++ format version ++ "
+        "workload seed ++ vocab ++ dim ++ record count",
+    pack_sites=("press._pack_press_header",),
+    unpack_sites=("press._unpack_press_header",),
+    exact_sites=("press._pack_press_header",
+                 "press._unpack_press_header"))
+
+schema(
+    "press_record",
+    Int("t_us"), Int("op", "<i"), Int("nids", "<i"),
+    Array("ids", "<i4", "nids"),
+    doc="one recorded traffic op: scheduled arrival offset (us from "
+        "trace start) ++ op kind (0=lookup, 1=apply) ++ key ids; "
+        "gradients are re-derived from the header seed on replay",
+    pack_sites=("press._pack_press_record",),
+    unpack_sites=("press._unpack_press_record",),
+    exact_sites=("press._pack_press_record",
+                 "press._unpack_press_record"))
 
 schema(
     "writer_seq_rsp",

@@ -101,7 +101,24 @@ int brt_server_add_service(void* server, const char* name,
 }
 
 int brt_server_start(void* server, const char* addr) {
-  return static_cast<CServer*>(server)->server.Start(std::string(addr));
+  auto* s = static_cast<CServer*>(server);
+  // Always pass the staged options: defaults are identical to a bare
+  // Start, and brt_server_set_concurrency_limiter writes into them.
+  return s->server.Start(std::string(addr), &s->opts);
+}
+
+int brt_server_set_concurrency_limiter(void* server, const char* name,
+                                       int max_concurrency) {
+  auto* s = static_cast<CServer*>(server);
+  if (s->server.IsRunning()) return EPERM;
+  s->opts.concurrency_limiter = name ? name : "";
+  s->opts.max_concurrency = max_concurrency;
+  return 0;
+}
+
+int brt_server_max_concurrency(void* server) {
+  auto* l = static_cast<CServer*>(server)->server.limiter();
+  return l ? l->max_concurrency() : 0;
 }
 
 int brt_server_add_naming_registry(void* server) {

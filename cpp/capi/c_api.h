@@ -30,6 +30,17 @@ int brt_server_start(void* server, const char* addr);
 int brt_server_port(void* server);
 void brt_server_stop(void* server);
 void brt_server_destroy(void* server);
+// Server-wide overload control (rpc/concurrency_limiter.h), enforced in
+// the native dispatch path BEFORE any bound-language code runs — shed
+// requests answer ELIMIT (2004).  name: "auto" (adaptive
+// gradient/Vegas), "constant" (bounded by max_concurrency),
+// "timeout[:us]", "" = off.  Must precede brt_server_start; returns 0
+// on success, EPERM once the server is running.
+int brt_server_set_concurrency_limiter(void* server, const char* name,
+                                       int max_concurrency);
+// The installed limiter's current ceiling (0 = off/unlimited) — the
+// adaptive gauge for the native path.
+int brt_server_max_concurrency(void* server);
 
 void brt_session_respond(void* session, const void* data, size_t len,
                          int error_code, const char* error_text);

@@ -28,6 +28,10 @@ struct CServer {
   // (AddService does not take ownership).
   std::vector<std::unique_ptr<brt::Service>> services;
   std::unique_ptr<brt::NamingRegistryService> naming;
+  // Options applied at Start (brt_server_start always passes these):
+  // brt_server_set_concurrency_limiter writes the limiter fields here
+  // before the server runs.
+  brt::Server::Options opts;
 };
 
 // A channel handle: plain single-server Channel or ClusterChannel behind

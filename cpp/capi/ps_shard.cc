@@ -19,6 +19,7 @@
 #include <string>
 #include <vector>
 
+#include "base/time.h"
 #include "capi/c_api.h"
 #include "capi/capi_internal.h"
 #include "fiber/sync.h"
@@ -84,22 +85,47 @@ class CPsService : public Service {
   void ServeLookup(Controller* cntl, const IOBuf& request,
                    IOBuf* response) {
     // Wire format (ps_remote.py): int32 count ++ int32 ids (absolute);
-    // response float32 rows [count, dim].
+    // response float32 rows [count, dim].  An optional deadline header
+    // (wire schema deadline_hdr: magic int32 0x7EAD11E5 ++ absolute
+    // wall-clock deadline in us) may prefix the frame — the magic is
+    // above any legitimate count, so the two framings cannot collide.
+    // Expired work is shed HERE, before ids are even copied out: the
+    // overload-control contract for the zero-Python read path.
+    size_t off = 0;
     int32_t count = 0;
     if (request.size() < 4) {
       cntl->SetFailed(EREQUEST, "Lookup request shorter than its header");
       return;
     }
     request.copy_to(&count, 4);
+    if (count == 0x7EAD11E5 /* wire.DEADLINE_MAGIC */) {
+      if (request.size() < 12) {
+        cntl->SetFailed(EREQUEST, "Lookup deadline header truncated");
+        return;
+      }
+      int64_t deadline_us = 0;
+      request.copy_to(&deadline_us, 8, 4);
+      off = 12;
+      if (deadline_us > 0 && realtime_us() > deadline_us) {
+        cntl->SetFailed(EDEADLINE,
+                        "deadline budget exhausted before Lookup started");
+        return;
+      }
+      if (request.size() < off + 4) {
+        cntl->SetFailed(EREQUEST, "Lookup request shorter than its header");
+        return;
+      }
+      request.copy_to(&count, 4, off);
+    }
     if (count < 0 ||
-        request.size() != 4 + size_t(count) * 4) {
+        request.size() != off + 4 + size_t(count) * 4) {
       cntl->SetFailed(EREQUEST, "Lookup request length mismatch "
                                 "(count=%d, %zu bytes)",
-                      int(count), request.size());
+                      int(count), request.size() - off);
       return;
     }
     std::vector<int32_t> ids(static_cast<size_t>(count));
-    if (count > 0) request.copy_to(ids.data(), size_t(count) * 4, 4);
+    if (count > 0) request.copy_to(ids.data(), size_t(count) * 4, off + 4);
     for (int32_t& id : ids) {
       const int64_t local = int64_t(id) - shard_->base;
       if (local < 0 || local >= shard_->rows_per) {

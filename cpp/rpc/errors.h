@@ -21,6 +21,10 @@ enum RpcError {
   EAUTH = 1004,          // credential verification failed
   EREJECT = 2006,        // rejected by a server interceptor
   EHTTP = 2007,          // non-2xx http response (reference errno EHTTP)
+  // 2008-2013 are Python-tier codes (breaker/replication/scheme/frame,
+  // brpc_tpu.resilience); EDEADLINE is shared with the native Lookup
+  // shed path.
+  EDEADLINE = 2014,      // propagated deadline budget exhausted pre-work
 };
 
 // Human-readable name for the codes above; falls back to strerror.

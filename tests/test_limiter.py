@@ -1,0 +1,567 @@
+"""Overload control: adaptive concurrency limiting + deadline
+propagation (brpc_tpu.limiter + the server/client wiring).
+
+Three layers of proof:
+
+1. the limiter state machines under a FAKE microsecond clock — window
+   accounting, Little's-law limit setting, explore walk, all-failed
+   halving, remeasure drain, shed-outcome exclusion — no wall time
+   anywhere;
+2. gate/ServerLimiter mechanics (method filtering, inflight
+   accounting, shed counters);
+3. live servers (native-gated): per-method ELIMIT shedding answers
+   FAST while admitted work queues, the native Lookup path sheds via
+   the new capi limiter, a deadline-expired request provably never
+   mutates the table (exact arithmetic), EDEADLINE/ELIMIT are visible
+   in counters and rpcz, retry treats ELIMIT as
+   retriable-with-mandatory-backoff, and fault.py delay rules composed
+   with the auto limiter drive the limit down and let it recover.
+"""
+
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from brpc_tpu import fault, obs, resilience, wire
+from brpc_tpu.limiter import (AutoLimiter, AutoOptions, ConstantLimiter,
+                              MethodGate, ServerLimiter, make_limiter)
+
+
+# ---------------------------------------------------------------------------
+# factory + constant
+# ---------------------------------------------------------------------------
+
+def test_make_limiter_specs():
+    assert make_limiter(None) is None
+    assert make_limiter("") is None
+    assert make_limiter("none") is None
+    assert make_limiter("off") is None
+    assert make_limiter("constant") is None       # a constant needs one
+    c = make_limiter("constant:7")
+    assert isinstance(c, ConstantLimiter) and c.max_concurrency == 7
+    assert isinstance(make_limiter("auto"), AutoLimiter)
+    with pytest.raises(ValueError):
+        make_limiter("gradient2")
+
+
+def test_constant_limiter_admits_to_its_bound():
+    c = ConstantLimiter(2)
+    assert c.on_requested(1) and c.on_requested(2)
+    assert not c.on_requested(3)
+    assert ConstantLimiter(0).on_requested(10 ** 6)  # 0 = unlimited
+
+
+# ---------------------------------------------------------------------------
+# AutoLimiter under a fake clock
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, start: int = 0):
+        self.now = start
+
+    def __call__(self) -> int:
+        return self.now
+
+
+def _opts(**kw) -> AutoOptions:
+    base = dict(initial_limit=40, min_limit=1, window_us=1000,
+                min_samples=2, max_samples=1000, sample_interval_us=0,
+                ema_alpha=0.5, remeasure_interval_us=10 ** 12)
+    base.update(kw)
+    return AutoOptions(**base)
+
+
+def test_auto_window_sets_limit_by_littles_law():
+    clk = FakeClock()
+    lim = AutoLimiter(_opts(), clock_us=clk)
+    assert lim.max_concurrency == 40
+    # three successes at ~1ms latency spread over 1.2ms of clock: the
+    # closing window estimates floor ~1001us, qps = 3 / 1.2ms = 2500/s
+    # -> limit = floor*qps*(1+explore)+1 with explore at max 0.3
+    for now in (100, 600, 1200):
+        clk.now = now
+        lim.on_responded(0, 1000)
+    assert lim.max_concurrency != 40          # the window closed
+    assert 2 <= lim.max_concurrency <= 6      # ~ 1001us * 2500/s * 1.3
+
+
+def test_auto_all_failed_window_halves_the_limit():
+    clk = FakeClock()
+    lim = AutoLimiter(_opts(), clock_us=clk)
+    for now in (100, 600, 1200):
+        clk.now = now
+        lim.on_responded(1008, 5000)
+    assert lim.max_concurrency == 20          # 40 // 2
+
+
+def test_auto_ignores_its_own_sheds():
+    clk = FakeClock()
+    lim = AutoLimiter(_opts(), clock_us=clk)
+    for now in range(100, 5000, 100):
+        clk.now = now
+        lim.on_responded(2004, 1)             # ELIMIT: not a signal
+        lim.on_responded(2014, 1)             # EDEADLINE: not a signal
+    assert lim.max_concurrency == 40          # no window ever formed
+
+
+def test_auto_small_window_is_discarded():
+    clk = FakeClock()
+    lim = AutoLimiter(_opts(min_samples=5), clock_us=clk)
+    clk.now = 100
+    lim.on_responded(0, 1000)
+    clk.now = 2000                            # window expires with n=2
+    lim.on_responded(0, 1000)
+    assert lim.max_concurrency == 40
+
+
+def test_auto_queueing_does_not_inflate_the_limit():
+    clk = FakeClock()
+    lim = AutoLimiter(_opts(), clock_us=clk)
+    for now in (100, 600, 1200):              # healthy window: floor
+        clk.now = now
+        lim.on_responded(0, 1000)
+    healthy = lim.max_concurrency
+    # queueing: latency x20 at the same throughput — Vegas narrows the
+    # explore ratio instead of chasing the inflated latency
+    for now in (1300, 1800, 2400):
+        clk.now = now
+        lim.on_responded(0, 20000)
+    assert lim.max_concurrency <= healthy + 1
+
+
+def test_auto_remeasure_pulls_load_down_then_remeasures():
+    clk = FakeClock()
+    lim = AutoLimiter(_opts(remeasure_interval_us=2000), clock_us=clk)
+    for now in (100, 600, 1200):
+        clk.now = now
+        lim.on_responded(0, 1000)
+    # next window closes past the remeasure instant: the limiter pulls
+    # the limit to reduce_ratio x estimate and enters the drain phase
+    for now in (1300, 1900, 2600):
+        clk.now = now
+        lim.on_responded(0, 1000)
+    drained = lim.max_concurrency
+    # samples during the drain are ignored
+    clk.now = 2700
+    lim.on_responded(0, 999999)
+    assert lim.max_concurrency == drained
+    # after the drain expires, the floor re-measures from scratch
+    clk.now = 3 * 10 ** 6
+    lim.on_responded(0, 500)
+    clk.now = 3 * 10 ** 6 + 600
+    lim.on_responded(0, 500)
+    clk.now = 3 * 10 ** 6 + 1300
+    lim.on_responded(0, 500)
+    assert lim.max_concurrency >= 1
+
+
+# ---------------------------------------------------------------------------
+# MethodGate / ServerLimiter mechanics
+# ---------------------------------------------------------------------------
+
+def test_method_gate_admits_and_sheds():
+    g = MethodGate("Lookup", ConstantLimiter(2), "t")
+    assert g.admit() and g.admit()
+    assert g.inflight == 2
+    assert not g.admit()                       # third refused
+    assert g.inflight == 2 and g.shed == 1
+    g.on_responded(0, 100)
+    assert g.inflight == 1
+    assert g.admit()                           # slot freed
+
+
+def test_server_limiter_method_filter_and_lazy_gates():
+    lim = ServerLimiter("constant:1", methods=("Lookup",),
+                        counter_prefix="t")
+    assert lim.gate("Promote") is None          # ungated control plane
+    g = lim.gate("Lookup")
+    assert g is not None and lim.gate("Lookup") is g
+    assert g.admit() and not g.admit()
+    assert lim.total_inflight() == 1
+    assert lim.max_concurrency() == {"Lookup": 1}
+    snap = lim.snapshot()
+    assert snap["Lookup"]["shed"] == 1
+    g.on_responded(0, 10)
+    assert lim.total_inflight() == 0
+
+
+def test_server_limiter_per_method_gates_are_independent():
+    lim = ServerLimiter("constant:1", counter_prefix="t")
+    a, b = lim.gate("Lookup"), lim.gate("ApplyGrad")
+    assert a is not b
+    assert a.admit() and b.admit()             # each has its own slot
+    assert not a.admit()
+    a.on_responded(0, 1)
+    b.on_responded(0, 1)
+
+
+def test_server_limiter_off_spec_gates_nothing():
+    lim = ServerLimiter("none")
+    assert lim.gate("Lookup") is None
+    assert lim.total_inflight() == 0
+
+
+def test_retry_policy_elimit_mandatory_backoff():
+    pol = resilience.RetryPolicy(
+        backoff=resilience.Backoff(base_ms=0.0, jitter=0.0),
+        limit_backoff_floor_ms=7.0)
+    err = resilience._rpc_error(resilience.ELIMIT, "shed")
+    before = obs.counter("rpc_limit_backoffs").get_value()
+    assert pol.retry_delay_ms(err, 0) == 7.0   # floored, never 0
+    assert obs.counter("rpc_limit_backoffs").get_value() == before + 1
+    other = resilience._rpc_error(1008, "timeout")
+    assert pol.retry_delay_ms(other, 0) == 0.0  # only ELIMIT floors
+
+
+# ---------------------------------------------------------------------------
+# live servers (native)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def shard_server():
+    from brpc_tpu.ps_remote import PsShardServer
+    servers = []
+
+    def make(**kw):
+        srv = PsShardServer(256, 8, 0, 1, **kw)
+        servers.append(srv)
+        return srv
+
+    yield make
+    for srv in servers:
+        srv.close()
+
+
+def _lookup_req(ids) -> bytes:
+    a = np.asarray(ids, np.int32)
+    return struct.pack("<i", a.size) + a.tobytes()
+
+
+@pytest.mark.needs_native
+def test_shed_answers_fast_while_admitted_work_queues(shard_server):
+    """The shed-vs-queue latency bound: with a 250ms handler and a
+    2-slot gate, refused requests answer ELIMIT in milliseconds while
+    admitted ones take the full handler time."""
+    from brpc_tpu import rpc
+    srv = shard_server(limiter="constant:2")
+    ch = rpc.Channel(srv.address, timeout_ms=5000)
+    try:
+        fault.install(fault.FaultPlan([fault.FaultRule(
+            action="delay", side="server", service="Ps",
+            method="Lookup", delay_ms=250)]))
+        results = []
+
+        def one():
+            t0 = time.monotonic()
+            try:
+                ch.call("Ps", "Lookup", _lookup_req([1, 2]))
+                results.append((0, time.monotonic() - t0))
+            except rpc.RpcError as e:
+                results.append((e.code, time.monotonic() - t0))
+
+        ts = [threading.Thread(target=one) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        fault.clear()
+        ch.close()
+    codes = sorted(c for c, _ in results)
+    assert codes.count(0) == 2
+    assert codes.count(2004) == 6
+    shed_lats = [lat for c, lat in results if c == 2004]
+    ok_lats = [lat for c, lat in results if c == 0]
+    assert max(shed_lats) < 0.15, shed_lats    # shed << queue
+    assert min(ok_lats) >= 0.24                # admitted paid the work
+    assert srv.limiter.snapshot()["Lookup"]["shed"] >= 6
+
+
+@pytest.mark.needs_native
+def test_native_lookup_path_sheds_via_capi_limiter(shard_server):
+    """The zero-Python native Lookup path enforces the capi-installed
+    limiter: concurrency beyond the bound answers ELIMIT from the C++
+    dispatch, no Python anywhere."""
+    from brpc_tpu import rpc
+    srv = shard_server(native_read=True, limiter="constant:1")
+    assert srv.server.native_max_concurrency == 1
+    ch = rpc.Channel(srv.address, timeout_ms=5000)
+    codes = []
+    try:
+        # the native limiter is server-wide: saturate the one slot
+        # with a slow PYTHON method, then native Lookups must shed
+        fault.install(fault.FaultPlan([fault.FaultRule(
+            action="delay", side="server", service="Ps",
+            method="ApplyGrad", delay_ms=300)]))
+        grads = np.zeros((1, 8), np.float32)
+        req = struct.pack("<i", 1) + np.array([1], np.int32).tobytes() \
+            + grads.tobytes()
+
+        def apply_slow():
+            try:
+                ch.call("Ps", "ApplyGrad", req)
+                codes.append(0)
+            except rpc.RpcError as e:
+                codes.append(e.code)
+
+        t = threading.Thread(target=apply_slow)
+        t.start()
+        time.sleep(0.08)                       # the slot is taken
+        try:
+            ch.call("Ps", "Lookup", _lookup_req([1, 2, 3]))
+            codes.append(0)
+        except rpc.RpcError as e:
+            codes.append(e.code)
+        t.join()
+    finally:
+        fault.clear()
+        ch.close()
+    assert 2004 in codes, codes
+
+
+@pytest.mark.needs_native
+def test_deadline_expired_request_never_mutates_table(shard_server):
+    """The exact-arithmetic no-mutation proof: an expired ApplyGrad /
+    ApplyGradId answers EDEADLINE before any table work, counted per
+    method, and the table is byte-identical after."""
+    from brpc_tpu import rpc
+    from brpc_tpu.ps_remote import (_pack_apply_id_req, _pack_apply_req,
+                                    _pack_deadline)
+    srv = shard_server()
+    ch = rpc.Channel(srv.address, timeout_ms=2000)
+    try:
+        before = srv.table.copy()
+        ids = np.arange(4, dtype=np.int32)
+        grads = np.full((4, 8), 0.25, np.float32)
+        expired = int(time.time() * 1e6) - 1_000_000
+        d0 = obs.counter("ps_deadline_drops").get_value()
+        with pytest.raises(rpc.RpcError) as ei:
+            ch.call("Ps", "ApplyGrad", bytes(_pack_deadline(
+                expired, _pack_apply_req(ids, grads))))
+        assert ei.value.code == resilience.EDEADLINE
+        with pytest.raises(rpc.RpcError) as ei:
+            ch.call("Ps", "ApplyGradId", bytes(_pack_deadline(
+                expired, _pack_apply_id_req("w1", 1, (), ids, grads))))
+        assert ei.value.code == resilience.EDEADLINE
+        assert np.array_equal(before, srv.table)     # untouched, exactly
+        assert obs.counter("ps_deadline_drops").get_value() == d0 + 2
+        assert obs.counter(
+            "ps_deadline_drops_ApplyGrad").get_value() >= 1
+        assert obs.counter(
+            "ps_deadline_drops_ApplyGradId").get_value() >= 1
+        # a FUTURE deadline applies normally (the header peels away)
+        rsp = ch.call("Ps", "ApplyGrad", bytes(_pack_deadline(
+            int(time.time() * 1e6) + 5_000_000,
+            _pack_apply_req(ids, grads))))
+        del rsp
+        after = before.copy()
+        np.subtract.at(after, ids, srv.lr * grads)
+        assert np.array_equal(after, srv.table)
+        # shed spans carry the rpcz tag instead of vanishing
+        spans = obs.dump_rpcz(limit=100, side="server",
+                              errors_only=True)
+        tags = [s["annotations"] for s in spans
+                if s.get("error_code") == resilience.EDEADLINE]
+        assert tags and all(t == ["shed=deadline"] for t in tags)
+    finally:
+        ch.close()
+
+
+@pytest.mark.needs_native
+def test_native_lookup_deadline_shed_and_peel(shard_server):
+    """The NATIVE Lookup handler peels the deadline header: a future
+    deadline serves (byte-identical to the bare framing), an expired
+    one sheds with EDEADLINE — all with zero Python in the loop."""
+    from brpc_tpu import rpc
+    from brpc_tpu.ps_remote import _pack_deadline
+    srv = shard_server(native_read=True)
+    ch = rpc.Channel(srv.address, timeout_ms=2000)
+    try:
+        bare = _lookup_req([3, 4, 5])
+        rsp = ch.call("Ps", "Lookup", bare)
+        future = bytes(_pack_deadline(
+            int(time.time() * 1e6) + 5_000_000, bare))
+        assert ch.call("Ps", "Lookup", future) == rsp
+        expired = bytes(_pack_deadline(
+            int(time.time() * 1e6) - 1_000_000, bare))
+        with pytest.raises(rpc.RpcError) as ei:
+            ch.call("Ps", "Lookup", expired)
+        assert ei.value.code == resilience.EDEADLINE
+        assert srv.native_lookups >= 2         # both served natively
+    finally:
+        ch.close()
+
+
+@pytest.mark.needs_native
+def test_elimit_retries_with_mandatory_backoff_then_succeeds(
+        shard_server):
+    """The client contract: ELIMIT is retriable, but only after the
+    mandatory backoff floor — a held slot releases during the backoff
+    and the retry lands."""
+    from brpc_tpu import rpc
+    srv = shard_server(limiter="constant:1")
+    ch = rpc.Channel(srv.address, timeout_ms=5000)
+    try:
+        fault.install(fault.FaultPlan([fault.FaultRule(
+            action="delay", side="server", service="Ps",
+            method="Lookup", delay_ms=150, max_hits=1)]))
+        holder_done = []
+
+        def holder():
+            ch.call("Ps", "Lookup", _lookup_req([1]))
+            holder_done.append(True)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        time.sleep(0.04)                       # the slot is held
+        b0 = obs.counter("rpc_limit_backoffs").get_value()
+        out = ch.call(
+            "Ps", "Lookup", _lookup_req([2]),
+            retry=resilience.RetryPolicy(
+                max_attempts=8,
+                backoff=resilience.Backoff(base_ms=0.0, jitter=0.0),
+                limit_backoff_floor_ms=25.0))
+        t.join()
+        assert len(out) == 1 * 8 * 4
+        assert holder_done
+        assert obs.counter("rpc_limit_backoffs").get_value() > b0
+    finally:
+        fault.clear()
+        ch.close()
+
+
+@pytest.mark.needs_native
+def test_fault_delay_composes_with_auto_limiter_drop_and_recover(
+        shard_server):
+    """Slow handler (fault delay rule) → the auto limiter's windows see
+    inflated latency at low throughput and pull max_concurrency down
+    from its warm-up ceiling; once the rule exhausts, served throughput
+    and latency recover (the limit itself settles wherever Little's law
+    puts it for the now-fast service — smaller is correct, not a
+    failure to recover)."""
+    from brpc_tpu import rpc
+    opts = AutoOptions(initial_limit=12, min_limit=2,
+                       window_us=60_000, min_samples=5,
+                       max_samples=60, sample_interval_us=0)
+    lim = ServerLimiter("auto", options=opts, methods=("Lookup",),
+                        counter_prefix="ps")
+    srv = shard_server()
+    srv.limiter = lim
+    srv.server.set_concurrency_limiter(lim)
+    ch = rpc.Channel(srv.address, timeout_ms=5000)
+    req = _lookup_req([1, 2, 3, 4])
+
+    def hammer(seconds: float, oks: list, lats: list) -> None:
+        stop = time.monotonic() + seconds
+        while time.monotonic() < stop:
+            t0 = time.monotonic()
+            try:
+                ch.call("Ps", "Lookup", req)
+            except rpc.RpcError:
+                resilience.sleep_ms(5)
+                continue
+            oks.append(1)
+            lats.append(time.monotonic() - t0)
+
+    def phase(seconds: float):
+        oks: list = []
+        lats: list = []
+        ts = [threading.Thread(target=hammer,
+                               args=(seconds, oks, lats))
+              for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return len(oks), (sum(lats) / len(lats) if lats else 0.0)
+
+    try:
+        fault.install(fault.FaultPlan([fault.FaultRule(
+            action="delay", side="server", service="Ps",
+            method="Lookup", delay_ms=30)]))
+        n_faulted, lat_faulted = phase(1.2)
+        degraded = lim.gate("Lookup").max_concurrency
+        assert degraded < 12                   # the limit came down
+        assert lat_faulted >= 0.02             # the fault was real
+        fault.clear()
+        n_healthy, lat_healthy = phase(1.2)
+        # recovery: the system SERVES again — more throughput at a
+        # fraction of the latency, through the adapted limit
+        assert n_healthy > 2 * n_faulted
+        assert lat_healthy < lat_faulted / 3
+        assert lim.gate("Lookup").max_concurrency >= opts.min_limit
+    finally:
+        fault.clear()
+        ch.close()
+
+
+@pytest.mark.needs_native
+def test_remote_embedding_propagates_deadline_budget(shard_server):
+    """RemoteEmbedding stamps its remaining budget: a server-side
+    delay longer than the budget means the handler sees the request
+    only after expiry — the server sheds it (counted) instead of
+    mutating the table, and the table proves it."""
+    from brpc_tpu import rpc
+    from brpc_tpu.ps_remote import RemoteEmbedding
+    srv = shard_server()
+    emb = RemoteEmbedding([srv.address], 256, 8, deadline_ms=60,
+                          retry=None)
+    try:
+        before = srv.table.copy()
+        fault.install(fault.FaultPlan([fault.FaultRule(
+            action="delay", side="server", service="Ps",
+            method="ApplyGradId", delay_ms=150)]))
+        d0 = obs.counter("ps_deadline_drops").get_value()
+        with pytest.raises(rpc.RpcError):
+            emb.apply_gradients(np.arange(4),
+                                np.full((4, 8), 0.5, np.float32))
+        # the server-side drop may land after the client's own timeout
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and \
+                obs.counter("ps_deadline_drops").get_value() == d0:
+            time.sleep(0.02)
+        assert obs.counter("ps_deadline_drops").get_value() > d0
+        assert np.array_equal(before, srv.table)
+        # without the delay the same write applies fine
+        fault.clear()
+        emb.apply_gradients(np.arange(4),
+                            np.full((4, 8), 0.5, np.float32))
+        assert not np.array_equal(before, srv.table)
+    finally:
+        fault.clear()
+        emb.close()
+
+
+def test_deadline_header_roundtrip_and_magic_disambiguation():
+    from brpc_tpu.ps_remote import _pack_deadline, _unpack_deadline
+    body = b"\x07\x00\x00\x00payload"
+    framed = bytes(_pack_deadline(123456789, body))
+    out, dl = _unpack_deadline(framed)
+    assert out == body and dl == 123456789
+    # bare frames pass through untouched (no magic)
+    out, dl = _unpack_deadline(body)
+    assert out == body and dl == 0
+    # magic present but truncated header: hostile, not legacy
+    with pytest.raises(wire.WireError):
+        _unpack_deadline(struct.pack("<i", wire.DEADLINE_MAGIC) + b"xx")
+    # the magic cannot collide with a legitimate count field
+    assert wire.DEADLINE_MAGIC > wire.MAX_WIRE_COUNT
+
+
+def test_limiter_gauges_ride_status_vars():
+    lim = ServerLimiter("constant:5", methods=("Lookup",),
+                        counter_prefix="t")
+    lim.gate("Lookup")
+    obs.gauge("t_inflight", lim.total_inflight)
+    obs.gauge("t_maxc",
+              lambda: max(lim.max_concurrency().values(), default=0))
+    try:
+        d = obs.dump_exposed_dict("t_")
+        assert d["t_inflight"] == 0 and d["t_maxc"] == 5
+    finally:
+        obs.drop_var("t_inflight")
+        obs.drop_var("t_maxc")
+        assert "t_inflight" not in obs.dump_exposed_dict("t_")
