@@ -121,6 +121,16 @@ def run_scenarios_bench(deadline_s: int = 300) -> dict:
                            deadline_s)
 
 
+def run_churn_bench(deadline_s: int = 420) -> dict:
+    """Self-driving elasticity (bench_churn.py child): a long-running
+    churn scenario — quorum-replicated shards under press-driven load
+    with seeded kills, an autonomous rebalancer split + merge, a
+    failure-driven failover and an autonomous failback — holding
+    availability >= 0.999 with the exact zero-lost-acked-update
+    ledger intact end to end (also refreshes BENCH_churn.json)."""
+    return _run_json_child("bench_churn.py", "churn", deadline_s)
+
+
 def run_fault_bench(deadline_s: int = 300) -> dict:
     """Fault-tolerance numbers (bench_fault.py child): backup-request
     p99 bounding under an injected slow shard, breaker availability and

@@ -1,0 +1,426 @@
+#!/usr/bin/env python3
+"""Long-running churn bench: the self-driving-elasticity acceptance
+workload (ISSUE 13).
+
+One continuous scenario against a quorum-replicated PS fabric
+(2 shards x 3 replicas, majority-ack writes) with a live
+:class:`brpc_tpu.rebalance.Rebalancer` in the loop and NO operator
+anywhere:
+
+- press-driven sustained load (``press.build_ops`` arrival schedules +
+  zipf key draws executed through the scheme-aware client) with a
+  single exact-arithmetic writer;
+- a kill DURING BOOTSTRAP (the primary dies right after the first
+  quorum-acked write — the window the legacy connected-only barrier
+  lost writes in);
+- a HIGH-load phase the rebalancer answers with an autonomous 2→4
+  split, a primary kill + revival the fabric answers with failover and
+  an autonomous FAILBACK, and a LOW-load phase answered with an
+  autonomous 4→2 merge;
+- throughout: availability over every op (reads and writes), and at
+  the end the exact zero-lost-acked-update ledger — final tables must
+  equal the seed tables minus exactly one ``GRAD_VALUE`` per acked
+  occurrence, replayed with the servers' own float order.
+
+Emits ONE JSON line and refreshes BENCH_churn.json.  Degrades to
+{"skipped": ...} without the native core.
+"""
+
+import json
+import os
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+# The fiber worker pool is PROCESS-GLOBAL (cpp/fiber TaskControl): on a
+# 1-core host it defaults to 4 workers shared by every in-process
+# server.  This scenario runs up to 18 servers whose handlers hold a
+# worker through quorum ack barriers — 4 workers starve into a timeout
+# spiral.  The waits sleep (no CPU), so a wider pool is pure headroom.
+os.environ.setdefault("BRT_WORKERS", "16")
+
+VOCAB, DIM = 512, 8
+REPLICAS = 3
+WRITE_BATCH = 32
+SEED = 42
+AVAIL_TARGET = 0.999
+
+
+def main() -> int:  # noqa: C901 — one scenario, phases inline
+    try:
+        from brpc_tpu import rpc
+        if not rpc.native_core_available():
+            print(json.dumps({"skipped": "native core unavailable"}))
+            return 0
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        print(json.dumps({"skipped": f"{type(e).__name__}: {e}"[:200]}))
+        return 0
+    import numpy as np
+
+    from brpc_tpu import fault, obs, press, resilience
+    from brpc_tpu.naming import (NamingClient, PartitionScheme,
+                                 ReplicaSet, parse_schemes)
+    from brpc_tpu.ps_remote import PsShardServer, RemoteEmbedding
+    from brpc_tpu.rebalance import (RebalanceOptions, RebalancePolicy,
+                                    Rebalancer)
+
+    obs.set_enabled(True)
+    t_bench0 = time.monotonic()
+    GRAD = press.GRAD_VALUE
+
+    # -- cluster bring-up --------------------------------------------------
+    reg_server = rpc.Server()
+    reg_server.add_naming_registry()
+    reg_addr = f"127.0.0.1:{reg_server.start('127.0.0.1:0')}"
+    nc = NamingClient(reg_addr)
+
+    groups = {}          # scheme version -> [PsShardServer]
+    parked = {}          # retired, awaiting the deferred close
+    closed_groups = []
+
+    def spawn_group(version: int, num_shards: int,
+                    importing: bool) -> PartitionScheme:
+        for sv in groups.pop(version, []):
+            sv.close()   # a stillborn earlier attempt at this version
+        servers = []
+        sets = []
+        for s in range(num_shards):
+            row = [PsShardServer(VOCAB, DIM, s, num_shards, lr=1.0,
+                                 seed=SEED, importing=importing,
+                                 scheme_version=version)
+                   for _ in range(REPLICAS)]
+            rs = ReplicaSet(tuple(sv.address for sv in row), primary=0)
+            for r, sv in enumerate(row):
+                sv.configure_replication(rs, r)   # auto: majority=2
+                nc.register("ps", sv.address, ttl_ms=1000,
+                            tag_fn=sv.claim_tag)
+            servers.extend(row)
+            sets.append(rs)
+        groups[version] = servers
+        return PartitionScheme(version, tuple(sets))
+
+    def close_group(scheme: PartitionScheme) -> None:
+        """Retirement close with a GRACE period: clients learn of the
+        retirement through the registry watch — closing the old
+        servers on the same instant races that ingest (a writer one
+        beat behind would hit connection-refused instead of a clean
+        redirect).  The deferred close is the operational equivalent
+        of a decommission delay."""
+        servers = groups.pop(scheme.version, [])
+        parked[scheme.version] = servers
+        closed_groups.append(scheme.version)
+
+        def _close_later():
+            time.sleep(3.0)
+            for sv in parked.pop(scheme.version, []):
+                sv.close()
+
+        threading.Thread(target=_close_later, daemon=True).start()
+
+    sc1 = spawn_group(1, 2, importing=False)
+    from brpc_tpu.naming import publish_scheme
+    publish_scheme(nc, "ps", sc1)
+    init_tables = np.concatenate(
+        [groups[1][s * REPLICAS].table.copy() for s in range(2)])
+
+    # Thresholds sized to the phase rates below ON A 1-CORE HOST:
+    # the per-shard signal is reads + applied write batches, and the
+    # ~12/s writer touches every shard each batch, so the write floor
+    # (~12/s/shard) sits between merge_qps and split_qps.
+    policy = RebalancePolicy(RebalanceOptions(
+        split_qps=30.0, merge_qps=15.0, sustain_s=0.4,
+        min_interval_s=2.0, max_shards=4, min_shards=2,
+        failback_sustain_s=0.2))
+    reb = Rebalancer(reg_addr, "ps", VOCAB, policy=policy,
+                     provisioner=lambda v, n: spawn_group(
+                         v, n, importing=True),
+                     on_retired=close_group, interval_ms=250.0,
+                     timeout_ms=1000, migrate_deadline_s=60.0,
+                     drain_deadline_s=10.0)
+
+    retry = resilience.RetryPolicy(
+        max_attempts=6,
+        backoff=resilience.Backoff(base_ms=2, max_ms=50),
+        attempt_timeout_ms=800)
+    emb = RemoteEmbedding.from_registry(reg_addr, "ps", VOCAB, DIM,
+                                        timeout_ms=4000, watch=True,
+                                        retry=retry)
+
+    # -- load engine -------------------------------------------------------
+    ok_ops = [0]
+    failed_ops = []
+    counts = np.zeros(VOCAB, np.int64)     # acked apply occurrences
+    tainted = []                           # a failed write = ambiguous
+    stop = threading.Event()
+    read_qps = [0.0]                       # phase-controlled
+    rng = np.random.default_rng(SEED)
+
+    def writer() -> None:
+        """One sequential exact-ledger writer: ~25 batches/s, every
+        acked batch recorded per id occurrence."""
+        wrng = np.random.default_rng(SEED + 1)
+        while not stop.is_set():
+            ids = wrng.integers(0, VOCAB, WRITE_BATCH).astype(np.int32)
+            grads = np.full((WRITE_BATCH, DIM), GRAD, np.float32)
+            try:
+                emb.apply_gradients(ids, grads)
+            except Exception as e:  # noqa: BLE001 — the verdict
+                failed_ops.append(f"write:{e!r}"[:160])
+                tainted.append(True)
+                time.sleep(0.05)
+                continue
+            np.add.at(counts, ids, 1)
+            ok_ops[0] += 1
+            time.sleep(0.08)
+
+    def reader(k: int) -> None:
+        """Press-schedule readers: each runs the zipf key draws of a
+        press scenario at the CURRENT phase rate (open-ish loop: the
+        pace follows read_qps, the draws stay seeded)."""
+        sc = press.Scenario(duration_s=3600.0, qps=1.0, batch=16,
+                            zipf_s=1.1, seed=SEED + 10 + k)
+        keys = press.zipf_weights(VOCAB, sc.zipf_s)
+        rrng = np.random.default_rng(sc.seed)
+        while not stop.is_set():
+            rate = read_qps[0]
+            if rate <= 0:
+                time.sleep(0.02)
+                continue
+            ids = rrng.choice(VOCAB, size=sc.batch,
+                              p=keys).astype(np.int32)
+            try:
+                emb.lookup(np.sort(ids))
+            except Exception as e:  # noqa: BLE001 — the verdict
+                failed_ops.append(f"read:{e!r}"[:160])
+                time.sleep(0.02)
+                continue
+            ok_ops[0] += 1
+            time.sleep(1.0 / rate)
+
+    timeline = []
+
+    def monitor() -> None:
+        last = [0, 0]
+        while not stop.is_set():
+            time.sleep(2.0)
+            try:
+                with emb._view_mu:
+                    views = [(v.version, v.state) for v in emb._views]
+            except Exception:  # noqa: BLE001 — sampling only
+                views = ["?"]
+            nf = len(failed_ops)
+            timeline.append(
+                f"t+{time.monotonic() - t_bench0:.0f}s ok={ok_ops[0]} "
+                f"(+{ok_ops[0] - last[0]}) fail={nf} (+{nf - last[1]}) "
+                f"views={views}")
+            last = [ok_ops[0], nf]
+
+    threads = [threading.Thread(target=writer, daemon=True)]
+    threads += [threading.Thread(target=reader, args=(k,),
+                                 daemon=True) for k in range(3)]
+    threads += [threading.Thread(target=monitor, daemon=True)]
+
+    phases = []
+    kills = []
+
+    def active_version() -> int:
+        nodes, _ = nc.list("ps")
+        schemes = parse_schemes(nodes)
+        act = [sc for sc in schemes.values() if sc.state == "active"]
+        return max((sc.version for sc in act), default=0)
+
+    def wait_for(cond, what: str, deadline_s: float) -> bool:
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.1)
+        failed_ops.append(f"phase-timeout:{what}")
+        return False
+
+    def kill(addr: str) -> None:
+        kills.append(addr)
+        fault.install(fault.FaultPlan(fault.kill_rules(addr),
+                                      seed=SEED))
+        rpc.debug_fail_connections(addr)
+
+    ok = True
+    out = {}
+    try:
+        # -- phase 0: bootstrap kill --------------------------------------
+        t0 = time.monotonic()
+        ids0 = np.arange(WRITE_BATCH, dtype=np.int32)
+        emb.apply_gradients(ids0, np.full((WRITE_BATCH, DIM), GRAD,
+                                          np.float32))
+        np.add.at(counts, ids0, 1)
+        ok_ops[0] += 1
+        boot_primary = groups[1][0].address   # shard 0 replica 0
+        kill(boot_primary)
+        # the acked write must survive the primary: the next write
+        # fails over through the majority and lands on a quorum holder
+        emb.apply_gradients(ids0, np.full((WRITE_BATCH, DIM), GRAD,
+                                          np.float32))
+        np.add.at(counts, ids0, 1)
+        ok_ops[0] += 1
+        phases.append({"phase": "bootstrap_kill",
+                       "killed": boot_primary,
+                       "wall_s": round(time.monotonic() - t0, 2)})
+        fault.clear()    # the zombie rejoins as a backup via fencing
+
+        for t in threads:
+            t.start()
+        reb.start()
+
+        # -- phase 1: high load -> autonomous split 2->4 ------------------
+        t0 = time.monotonic()
+        read_qps[0] = 17.0     # x3 readers + ~12/s writes: per-shard
+        #                        ~37/s on 2 shards, above split_qps
+        split_ok = wait_for(lambda: active_version() >= 2,
+                            "autonomous split", 120.0)
+        if split_ok:
+            time.sleep(10.0)   # sustained traffic on the new topology
+        phases.append({"phase": "high_load_split", "ok": split_ok,
+                       "active_version": active_version(),
+                       "wall_s": round(time.monotonic() - t0, 2)})
+        ok &= split_ok
+
+        # -- phase 2: primary kill -> failover -> revival -> failback -----
+        t0 = time.monotonic()
+        v2_servers = groups.get(2, [])
+        victim = v2_servers[0] if v2_servers else None
+        failback_ok = False
+        if split_ok and victim is not None:
+            fb0 = int(obs.counter("ps_failbacks").get_value())
+            kill(victim.address)
+            promoted = wait_for(
+                lambda: any(sv.is_primary
+                            for sv in v2_servers[1:REPLICAS]),
+                "failover promotion", 30.0)
+            # revive: the zombie re-fences into a backup, catches up,
+            # and the rebalancer promotes it back on its own
+            fault.clear()
+            failback_ok = promoted and wait_for(
+                lambda: int(obs.counter("ps_failbacks").get_value())
+                > fb0 and victim.is_primary,
+                "autonomous failback", 45.0)
+        if failback_ok:
+            time.sleep(5.0)    # steady traffic behind the restored
+            #                    primary before the load drops
+        phases.append({"phase": "kill_revive_failback",
+                       "ok": failback_ok,
+                       "wall_s": round(time.monotonic() - t0, 2)})
+        ok &= failback_ok
+
+        # -- phase 3: low load -> autonomous merge 4->2 -------------------
+        t0 = time.monotonic()
+        read_qps[0] = 0.3      # per-shard ~13/s (the write floor),
+        #                        inside the merge band on 4 shards
+        merge_ok = split_ok and wait_for(
+            lambda: active_version() >= 3, "autonomous merge", 120.0)
+        if merge_ok:
+            time.sleep(10.0)   # the merged topology carries the tail
+        phases.append({"phase": "low_load_merge", "ok": merge_ok,
+                       "active_version": active_version(),
+                       "wall_s": round(time.monotonic() - t0, 2)})
+        ok &= merge_ok
+
+        # -- wind down + ledger -------------------------------------------
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        reb.stop()
+
+        n_failed = len([f for f in failed_ops
+                        if not f.startswith("phase-timeout")])
+        total_ops = ok_ops[0] + n_failed
+        availability = ok_ops[0] / total_ops if total_ops else 0.0
+
+        # exact replay: every acked occurrence subtracts one GRAD, in
+        # the same per-id float order the servers applied
+        expect = init_tables.copy()
+        for step in range(int(counts.max())):
+            expect[counts > step] -= np.float32(GRAD)
+        final_version = active_version()
+        final_scheme_servers = groups.get(final_version, [])
+        nsh = len(final_scheme_servers) // REPLICAS
+        ledger_exact = False
+        if not tainted and nsh:
+            finals = []
+            for s in range(nsh):
+                row = final_scheme_servers[s * REPLICAS:
+                                           (s + 1) * REPLICAS]
+                prim = next((sv for sv in row if sv.is_primary),
+                            row[0])
+                finals.append(prim.table)
+            got = np.concatenate(finals)
+            ledger_exact = bool(np.array_equal(got, expect))
+
+        out = {
+            "metric": "churn_availability",
+            "value": round(availability, 5),
+            "unit": "fraction",
+            "ops": total_ops,
+            "ok_ops": ok_ops[0],
+            "failed_ops": failed_ops[:20],
+            "kills": kills,
+            "phases": phases,
+            "splits": int(obs.counter(
+                "ps_rebalance_splits").get_value()),
+            "merges": int(obs.counter(
+                "ps_rebalance_merges").get_value()),
+            "failbacks": int(obs.counter("ps_failbacks").get_value()),
+            "promotions": int(obs.counter(
+                "ps_replica_promotions").get_value()),
+            "redrives": int(obs.counter(
+                "ps_migration_redrives").get_value()),
+            "rebalance_errors": int(obs.counter(
+                "ps_rebalance_errors").get_value()),
+            "rebalance_error_detail": reb.errors[:6],
+            "rebalance_log": reb.log,
+            "timeline": timeline,
+            "final_active_version": final_version,
+            "ledger_exact": ledger_exact,
+            "ledger_tainted": bool(tainted),
+            "criteria": {
+                "availability_ge_0p999": availability >= AVAIL_TARGET,
+                "autonomous_split": bool(int(obs.counter(
+                    "ps_rebalance_splits").get_value()) >= 1),
+                "autonomous_merge": bool(int(obs.counter(
+                    "ps_rebalance_merges").get_value()) >= 1),
+                "autonomous_failback": bool(int(obs.counter(
+                    "ps_failbacks").get_value()) >= 1),
+                "bootstrap_kill_lossless_ledger": ledger_exact,
+            },
+            "wall_s": round(time.monotonic() - t_bench0, 2),
+        }
+        out["ok"] = bool(ok and all(out["criteria"].values()))
+    finally:
+        stop.set()
+        fault.clear()
+        try:
+            reb.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        emb.close()
+        nc.close()
+        for servers in (list(groups.values())
+                        + list(parked.values())):
+            for sv in servers:
+                try:
+                    sv.close()
+                except Exception:  # noqa: BLE001 — deferred-close race
+                    pass
+        reg_server.close()
+
+    with open(os.path.join(ROOT, "BENCH_churn.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -303,6 +303,13 @@ def python_targets(*, dim: int = 4) -> List[FuzzTarget]:
             wire.REGISTRY["deadline_hdr"], rng, n, dim=dim),
         exec_fn=lambda p: ps_remote._unpack_deadline(bytes(p))))
 
+    targets.append(FuzzTarget(
+        name="unpack_deadline_v2",
+        covers=("deadline_hdr_v2",),
+        gen=lambda rng, n: mutated_frames(
+            wire.REGISTRY["deadline_hdr_v2"], rng, n, dim=dim),
+        exec_fn=lambda p: ps_remote._unpack_deadline(bytes(p))))
+
     def _press_trace_cases(rng: random.Random, iters: int):
         """Mutated whole trace files: schema-mutated headers, and a
         valid header (claiming one record) followed by schema-mutated
@@ -503,6 +510,28 @@ class _NullReceiver:
         pass
 
 
+class _StreamSet:
+    """Holder for the interleaved fuzz writers' streams: owns every
+    stream put into it; :meth:`close` releases them all (the handle
+    ledger cross-checks the teardown)."""
+
+    def __init__(self):
+        self._streams: Dict[int, object] = {}
+
+    def open(self, ch, k: int, writer: bytes) -> None:
+        st = ch.stream("Ps", "StreamApply", writer,
+                       receiver=_NullReceiver())
+        self._streams[k] = st
+
+    def get(self, k: int):
+        return self._streams[k]
+
+    def close(self) -> None:
+        for st in self._streams.values():
+            st.close()
+        self._streams.clear()
+
+
 def fuzz_live(seed: int, iters: int = 150, *, timeout_ms: int = 3000,
               dim: int = 4) -> Dict[str, object]:
     """Mutated unary requests + stream frames against LIVE shard
@@ -575,20 +604,33 @@ def fuzz_live(seed: int, iters: int = 150, *, timeout_ms: int = 3000,
                                      range(rng.randrange(0, 64)))
                         one_call(ch, method, blob, "blob")
         # stream frames: mutated stream_frame payloads at the framed
-        # push path (no per-frame response — liveness is the verdict)
-        st = data_ch.stream("Ps", "StreamApply", b"fuzz-writer",
-                            receiver=_NullReceiver())
+        # push path (no per-frame response — liveness is the verdict).
+        # THREE concurrent writer ids interleave over the same stream
+        # server round-robin: the per-writer seq windows and the shared
+        # combiner must keep their state disjoint under hostile
+        # interleavings, not just under one writer (the PR-11
+        # single-writer deferral).
+        writers = (b"fuzz-w0", b"fuzz-w1", b"fuzz-w2")
+        streams = _StreamSet()
         try:
-            for desc, payload in mutated_frames(
+            for k, w in enumerate(writers):
+                streams.open(data_ch, k, w)
+            alive = set(range(len(writers)))
+            for i, (desc, payload) in enumerate(mutated_frames(
                     wire.REGISTRY["stream_frame"], rng,
-                    iters // 4 + 1, dim=dim):
+                    3 * (iters // 4 + 1), dim=dim)):
+                if not alive:
+                    break
+                k = sorted(alive)[i % len(alive)]
                 try:
-                    st.write(payload)
+                    streams.get(k).write(payload)
                     execs += 1
                 except rpc.RpcError:
-                    break   # server broke the stream: allowed teardown
+                    # server broke THIS stream: allowed teardown; the
+                    # other writers must keep interleaving
+                    alive.discard(k)
         finally:
-            st.close()
+            streams.close()
         # liveness: both servers still answer a well-formed Lookup
         ids = np.arange(4, dtype=np.int32)
         req = struct.pack("<i", 4) + ids.tobytes()

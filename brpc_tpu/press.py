@@ -237,10 +237,38 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+class _ChannelPool:
+    """Round-robin native-channel registry for the multi-connection
+    pacer: one connection serializes its socket writes, so ``n > 1``
+    raises the open-loop client ceiling on multi-core hosts.  Owns its
+    channels — :meth:`close` releases every one (the dynamic handle
+    ledger cross-checks)."""
+
+    def __init__(self, addr: str, n: int, timeout_ms: int):
+        from brpc_tpu import rpc  # lazy: press imports without the core
+        self._chs: Dict[int, object] = {}
+        for i in range(max(1, n)):
+            ch = rpc.Channel(addr, timeout_ms=timeout_ms)
+            self._chs[i] = ch
+
+    def __len__(self) -> int:
+        return len(self._chs)
+
+    def pick(self, i: int):
+        return self._chs[i % len(self._chs)]
+
+    def close(self) -> None:
+        for ch in self._chs.values():
+            ch.close()
+        self._chs.clear()
+
+
 def run_press(addr: str, ops: List[PressOp], dim: int, *,
               deadline_ms: Optional[float] = None,
               stamp_deadline: bool = False,
+              stamp_mode: str = "absolute",
               collectors: int = 4,
+              channels: int = 1,
               timeout_ms: Optional[int] = None,
               retry_on_limit: int = 0,
               limit_backoff_ms: float = 5.0,
@@ -266,13 +294,24 @@ def run_press(addr: str, ops: List[PressOp], dim: int, *,
     ``limit_backoff_ms`` pause (never straight back into the overload)
     and only while the op's own deadline budget still has room — a
     transient admission spike is absorbed, a sustained overload stays
-    a shed."""
+    a shed.
+
+    ``channels=N`` paces over N native connections round-robin: one
+    channel serializes its socket's writes, so on a multi-core host a
+    single connection caps the open-loop driver below what the server
+    could absorb — the multi-connection pacer raises the client
+    ceiling (the reference rpc_press's connection fan-out).
+    ``stamp_mode="relative"`` stamps the v2 relative-budget header
+    instead of the absolute wall-clock form."""
     from brpc_tpu import rpc  # lazy: press imports without the native core
     from brpc_tpu.ps_remote import (_pack_apply_req, _pack_deadline,
-                                    _pack_lookup_req)
+                                    _pack_deadline_rel, _pack_lookup_req)
 
-    ch = rpc.Channel(addr, timeout_ms=timeout_ms or
-                     int(deadline_ms * 4 if deadline_ms else 2000))
+    # channel registry keyed by pacer index (every entry is closed
+    # before run_press returns; the dynamic handle ledger checks it)
+    chs = _ChannelPool(addr, channels,
+                       timeout_ms or int(deadline_ms * 4
+                                         if deadline_ms else 2000))
     results: List[Tuple[bool, int, float, float]] = []
     res_mu = checked_lock("press.results")
     inflight: collections.deque = collections.deque()
@@ -288,7 +327,7 @@ def run_press(addr: str, ops: List[PressOp], dim: int, *,
 
     def pacer() -> None:
         wall0 = time.time()
-        for op in ops:
+        for i, op in enumerate(ops):
             due = start + op.t_us / 1e6
             now = time.monotonic()
             if due > now:
@@ -300,29 +339,40 @@ def run_press(addr: str, ops: List[PressOp], dim: int, *,
                                 np.float32)
                 method, req = "ApplyGrad", _pack_apply_req(op.ids, grads)
             if stamp_deadline and deadline_ms is not None:
-                # absolute wall-clock deadline: scheduled arrival +
-                # budget (NOT issue + budget — an op the pacer issued
-                # late has already burned part of its budget queueing
-                # client-side)
-                req = _pack_deadline(
-                    int((wall0 + op.t_us / 1e6
-                         + deadline_ms / 1000.0) * 1e6), req)
+                if stamp_mode == "relative":
+                    # v2: remaining budget at ISSUE; the server
+                    # arrival-stamps with its own clock (no wall-clock
+                    # agreement assumed).  Client-side catch-up lag
+                    # already burned part of the budget.
+                    req = _pack_deadline_rel(
+                        int((due + deadline_ms / 1000.0
+                             - time.monotonic()) * 1e6), req)
+                else:
+                    # absolute wall-clock deadline: scheduled arrival +
+                    # budget (NOT issue + budget — an op the pacer
+                    # issued late has already burned part of its
+                    # budget queueing client-side)
+                    req = _pack_deadline(
+                        int((wall0 + op.t_us / 1e6
+                             + deadline_ms / 1000.0) * 1e6), req)
+            op_ch = chs.pick(i)
             t_issue = time.monotonic()
             try:
-                pc = ch.call_async(service, method, req,
-                                   timeout_ms=call_timeout)
+                pc = op_ch.call_async(service, method, req,
+                                      timeout_ms=call_timeout)
             except rpc.RpcError as e:
                 _record(False, e.code, t_issue - due, 0.0)
                 continue
             # collector-pool registry: every queued PendingCall is
             # joined by exactly one collector before the run returns
-            inflight.append((due, t_issue, method, req, 0, pc))  # lint: allow-handle-escape
+            inflight.append((due, t_issue, method, req, 0, op_ch, pc))  # lint: allow-handle-escape
         pacing_done.set()
 
     def collector() -> None:
         while True:
             try:
-                due, t_issue, method, req, tries, pc = inflight.popleft()
+                due, t_issue, method, req, tries, op_ch, pc = \
+                    inflight.popleft()
             except IndexError:
                 if pacing_done.is_set() and not inflight:
                     return
@@ -343,13 +393,13 @@ def run_press(addr: str, ops: List[PressOp], dim: int, *,
                 # sojourn keeps accruing from the original arrival
                 time.sleep(limit_backoff_ms / 1000.0)
                 try:
-                    pc2 = ch.call_async(service, method, req,
-                                        timeout_ms=call_timeout)
+                    pc2 = op_ch.call_async(service, method, req,
+                                           timeout_ms=call_timeout)
                 except rpc.RpcError as e:
                     _record(False, e.code, time.monotonic() - due, 0.0)
                     continue
                 inflight.append((due, t_issue, method, req,  # lint: allow-handle-escape
-                                 tries + 1, pc2))
+                                 tries + 1, op_ch, pc2))
                 continue
             _record(ok, code, end - due, end - t_issue)
 
@@ -390,11 +440,13 @@ def run_press(addr: str, ops: List[PressOp], dim: int, *,
         "duration_s": round(wall_s, 3),
         "deadline_ms": deadline_ms,
         "stamped": bool(stamp_deadline and deadline_ms is not None),
+        "stamp_mode": stamp_mode,
+        "channels": len(chs),
     }
     if obs.enabled():
         obs.counter("press_ops").add(n)
         obs.counter("press_errors").add(n - n_ok)
-    ch.close()
+    chs.close()
     return report
 
 
@@ -425,6 +477,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--stamp", action="store_true",
                         help="propagate the deadline header so the "
                              "server sheds expired work")
+    parser.add_argument("--stamp-mode", choices=("absolute",
+                                                 "relative"),
+                        default="absolute",
+                        help="deadline header form: absolute "
+                             "wall-clock us (v1) or relative budget "
+                             "with server-side arrival stamp (v2)")
+    parser.add_argument("--channels", type=int, default=1,
+                        help="native connections to pace over "
+                             "round-robin (raises the open-loop "
+                             "client ceiling on multi-core hosts)")
     parser.add_argument("--record", metavar="FILE",
                         help="write the generated op stream to FILE")
     parser.add_argument("--replay", metavar="FILE",
@@ -456,7 +518,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "given")
     report = run_press(args.target, ops, dim,
                        deadline_ms=args.deadline_ms,
-                       stamp_deadline=args.stamp)
+                       stamp_deadline=args.stamp,
+                       stamp_mode=args.stamp_mode,
+                       channels=args.channels)
     print(json.dumps(report))
     return 0
 

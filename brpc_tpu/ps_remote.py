@@ -123,28 +123,59 @@ def _pack_deadline(deadline_us: int, body) -> bytearray:
     return out
 
 
+def _pack_deadline_rel(budget_us: int, body) -> bytearray:
+    """The v2 deadline header (wire schema ``deadline_hdr_v2``):
+    magic ++ RELATIVE budget in microseconds ++ the original body.
+    Unlike the absolute-us form this makes no same-host/NTP wall-clock
+    assumption — the server stamps ARRIVAL with its own clock and
+    computes expiry as ``local_arrival + budget``, so only transit
+    time (not clock skew) eats into the budget."""
+    out = bytearray(12 + len(body))
+    struct.pack_into("<iq", out, 0, wire.DEADLINE_MAGIC2, budget_us)
+    out[12:] = body
+    return out
+
+
+def _peel_deadline_rel(payload):
+    """Server half of the v2 header: read the relative budget and
+    convert it to an ABSOLUTE local deadline at arrival time (the
+    arrival stamp).  Downstream admission/drain checks then compare
+    against the same local clock the stamp came from."""
+    (budget_us,) = wire.read("<q", payload, 4, "deadline.budget")
+    deadline_us = int(time.time() * 1e6) + budget_us
+    return bytes(memoryview(payload)[12:]), deadline_us
+
+
 def _unpack_deadline(payload):
     """Inverse of :func:`_pack_deadline`: returns ``(body,
     deadline_us)`` — ``(payload, 0)`` when no header is present.  A
     frame that DOES open with the magic must carry the full 12-byte
     header (guarded: truncation is a hostile frame, not a legacy
-    one — no legitimate count field equals the magic)."""
+    one — no legitimate count field equals the magic).  The v2 magic
+    (relative budget) dispatches to :func:`_peel_deadline_rel`, which
+    arrival-stamps with the LOCAL clock."""
     if len(payload) < 4:
         return payload, 0
     (magic,) = struct.unpack_from("<i", payload, 0)
+    if magic == wire.DEADLINE_MAGIC2:
+        return _peel_deadline_rel(payload)
     if magic != wire.DEADLINE_MAGIC:
         return payload, 0
     (deadline_us,) = wire.read("<q", payload, 4, "deadline.us")
     return bytes(memoryview(payload)[12:]), deadline_us
 
 
-def _admit_deadline(method: str, payload: bytes) -> bytes:
+def _admit_deadline(method: str, payload: bytes):
     """Deadline admission for one request: peel the optional header
-    and SHED work whose propagated budget is already exhausted —
-    before any parse, any lock, any table touch (``EDEADLINE``; the
-    acceptance contract of the overload tier).  Counted per method in
+    (absolute v1 or relative arrival-stamped v2) and SHED work whose
+    propagated budget is already exhausted — before any parse, any
+    lock, any table touch (``EDEADLINE``; the acceptance contract of
+    the overload tier).  Counted per method in
     ``ps_deadline_drops[_<Method>]``; the server span carries a
-    ``shed=deadline`` rpcz tag via the trampoline."""
+    ``shed=deadline`` rpcz tag via the trampoline.  Returns ``(body,
+    deadline_us)`` — the surviving LOCAL absolute deadline rides into
+    the combiner so work whose budget dies in the combine queue sheds
+    again at drain time."""
     body, deadline_us = _unpack_deadline(payload)
     if deadline_us > 0 and time.time() * 1e6 > deadline_us:
         if obs.enabled():
@@ -154,7 +185,7 @@ def _admit_deadline(method: str, payload: bytes) -> bytes:
             resilience.EDEADLINE,
             f"{method}: propagated deadline budget exhausted before "
             f"the handler started")
-    return body
+    return body, deadline_us
 
 
 #: stream frame header: (seq, epoch, gen) int64 — StreamApply uses seq
@@ -339,11 +370,15 @@ class GradCombiner:
         self.last_error: Optional[BaseException] = None
 
     def add(self, ids: np.ndarray, grads: np.ndarray,
-            wait: bool = True, meta=None) -> None:
-        # [ids, grads, done-event, error, meta] — error is filled by
-        # whichever leader applies the batch this entry lands in.
+            wait: bool = True, meta=None, deadline_us: int = 0) -> None:
+        # [ids, grads, done-event, error, meta, deadline_us] — error is
+        # filled by whichever leader applies the batch this entry lands
+        # in.  deadline_us > 0 re-checks at DRAIN time: a contribution
+        # whose propagated budget died while queued behind a slow batch
+        # is dropped, not applied (the admission check alone cannot see
+        # queueing inside the combiner — the PR-12 deferral).
         entry = [ids, grads, threading.Event() if wait else None, None,
-                 meta]
+                 meta, deadline_us]
         with self._mu:
             if self._shut:
                 # Server teardown: late contributions (a dead client's
@@ -376,6 +411,32 @@ class GradCombiner:
                     self._draining = False
                     return
                 self._q = []
+            # Drain-time deadline shedding: a deadline that expired
+            # while the entry sat in the combine queue must not apply —
+            # its caller's budget is gone and a late mutation is worse
+            # than a clean EDEADLINE (the answer is already too late,
+            # the write would still burn the lock/snapshot).
+            now_us = time.time() * 1e6
+            expired = []
+            live = []
+            for e in batch:
+                (expired if 0 < e[5] < now_us else live).append(e)
+            if expired:
+                batch = live
+                if obs.enabled():
+                    obs.counter("ps_deadline_drops").add(len(expired))
+                    obs.counter("ps_deadline_drops_Drain").add(
+                        len(expired))
+                shed_err = rpc.RpcError(
+                    resilience.EDEADLINE,
+                    "propagated deadline budget exhausted in the "
+                    "combine queue; contribution shed at drain")
+                for e_ in expired:
+                    e_[3] = shed_err
+                    if e_[2] is not None:
+                        e_[2].set()
+                if not batch:
+                    continue
             err: Optional[BaseException] = None
             try:
                 if len(batch) == 1:
@@ -686,14 +747,30 @@ class _Replicator:
     waits until every un-fenced backup has ACKED ``target_gen`` (acks
     ride the server→client half of the stream) — the zero-lost-updates
     barrier.  An EFENCED from any backup means a newer primary exists:
-    the owner demotes itself and every worker stops."""
+    the owner demotes itself and every worker stops.
+
+    QUORUM mode (``quorum`` = the total number of replicas, primary
+    included, that must hold a write before it acks): ``flush`` waits
+    until ``quorum - 1`` backups acked ``target_gen`` — and unlike the
+    legacy connected-only barrier it does NOT skip a disconnected peer:
+    a bootstrap write blocks until real acks exist, which is what
+    closes the PR-9 single-fault loss window (an acked write on
+    ``quorum`` replicas intersects every majority promotion sweep, so
+    the client's acked-gen floor becomes a guarantee instead of a
+    refusal heuristic)."""
 
     def __init__(self, server, peers: Sequence[str], epoch: int,
-                 max_queue: int = 512, timeout_ms: int = 5000):
+                 max_queue: int = 512, timeout_ms: int = 5000,
+                 quorum: Optional[int] = None):
         self._server = server
         self.epoch = epoch
         self.max_queue = max_queue
         self.timeout_ms = timeout_ms
+        if quorum is not None and not 1 <= quorum <= len(peers) + 1:
+            raise ValueError(
+                f"quorum {quorum} outside [1, {len(peers) + 1}] for "
+                f"{len(peers)} backup(s)")
+        self.quorum = quorum
         self._mu = checked_lock("ps.replicate")
         self._stop = threading.Event()
         # True when stopped BECAUSE of a fence/demotion: an in-flight
@@ -764,15 +841,34 @@ class _Replicator:
         with self._mu:
             return {p.addr: p.acked_gen for p in self._peers}
 
+    def resync_peers(self) -> None:
+        """Force every backup through a wholesale resync: the next
+        frame each worker would ship is superseded by a full-table
+        ``Sync`` of the current state.  The import path uses this after
+        a ``MigrateSync`` range install — a wholesale row overwrite the
+        delta framing cannot express."""
+        with self._mu:
+            for p in self._peers:
+                p.queue.clear()
+                p.need_sync = True
+        for p in self._peers:
+            p.wake.set()
+
     def flush(self, target_gen: int, timeout_s: float = 5.0) -> None:
-        """Returns once every CONNECTED backup acked ``target_gen``.  A
-        peer without an established delta stream (never synced, mid
-        resync, or unreachable) is skipped — a missing backup must not
-        stall the write path, and its (re)connect starts with a full
-        ``Sync`` of the current table (which includes ``target_gen``),
-        so skipping delays its copy without losing updates.  Raises
-        ERPCTIMEDOUT naming the laggard on timeout, EFENCED if a newer
-        primary fenced this one mid-flush."""
+        """The ack barrier.  QUORUM mode (``quorum`` set): returns once
+        this primary plus ``quorum - 1`` backups hold ``target_gen`` —
+        a disconnected peer is NOT skipped, the write waits for real
+        acks (or fails loudly).  Legacy mode: returns once every
+        CONNECTED backup acked ``target_gen``; a peer without an
+        established delta stream (never synced, mid resync, or
+        unreachable) is skipped — its (re)connect starts with a full
+        ``Sync`` of the current table, so skipping delays its copy
+        without losing updates.  Raises ERPCTIMEDOUT naming the laggard
+        on timeout, EFENCED if a newer primary fenced this one
+        mid-flush."""
+        if self.quorum is not None:
+            self._flush_quorum(target_gen, timeout_s)
+            return
         deadline = time.monotonic() + timeout_s
         for p in self._peers:
             while True:
@@ -797,6 +893,45 @@ class _Replicator:
                     if p.acked_gen >= target_gen:
                         break
                 self._ack_ev.wait(0.005)
+
+    def _flush_quorum(self, target_gen: int, timeout_s: float) -> None:
+        """Majority-ack barrier: blocks until ``quorum - 1`` backups
+        acked ``target_gen`` (this primary is the remaining voter).
+        Never skips a disconnected peer — with the quorum unreachable
+        the write FAILS after ``timeout_s`` instead of acking on the
+        primary alone (loud unavailability over silent loss)."""
+        need = self.quorum - 1
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._mu:
+                acked = sum(1 for p in self._peers
+                            if p.acked_gen >= target_gen)
+                fenced = any(p.fenced for p in self._peers)
+            if fenced or self._demoted:
+                raise rpc.RpcError(
+                    resilience.EFENCED,
+                    f"fenced by a newer primary while awaiting quorum "
+                    f"for gen {target_gen}")
+            if acked >= need:
+                return
+            if self._stop.is_set():
+                raise rpc.RpcError(
+                    1008,
+                    f"replicator stopped before gen {target_gen} "
+                    f"reached quorum ({acked + 1}/{self.quorum})")
+            if time.monotonic() > deadline:
+                raise rpc.RpcError(
+                    1008,
+                    f"quorum {self.quorum} not reached for gen "
+                    f"{target_gen} within {timeout_s:.1f}s "
+                    f"({acked + 1}/{self.quorum} hold it; acked "
+                    f"{self.acked_gens()})")
+            self._ack_ev.clear()
+            with self._mu:
+                if sum(1 for p in self._peers
+                       if p.acked_gen >= target_gen) >= need:
+                    return
+            self._ack_ev.wait(0.005)
 
     # -- per-backup worker -------------------------------------------------
 
@@ -902,15 +1037,38 @@ class _Replicator:
                     p.queue.popleft()
 
     def stop(self, join: bool = True, fenced: bool = False) -> None:
+        """Stop propagation.  Channels/streams are closed only AFTER
+        every worker exited: a worker can be mid-``ch.call`` on one of
+        them, and closing the native channel under it is a
+        use-after-free (the bring-up crash the churn bench found — a
+        fence-driven ``stop(join=False)`` used to close the channel
+        set while a sibling worker's Sync was still on the wire).
+        ``join=False`` (and any call from a worker/receiver thread —
+        ``_demote_on_fence`` runs on both) defers the teardown to a
+        reaper thread instead of blocking the caller."""
         if fenced:
             self._demoted = True
         self._stop.set()
         self._ack_ev.set()
         for p in self._peers:
             p.wake.set()
-        if join:
+        if join and threading.current_thread() not in self._threads:
             for t in self._threads:
                 t.join(timeout=5)
+            self.close()
+        else:
+            threading.Thread(target=self._reap, daemon=True,
+                             name="brt-replicator-reaper").start()
+
+    def _reap(self) -> None:
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5)
+        self.close()
+
+    def close(self) -> None:
+        """Release the peer streams and channels.  Only safe once the
+        workers exited — ``stop``/``_reap`` are the callers."""
         for p in self._peers:
             st, p.stream = p.stream, None
             if st is not None:
@@ -1002,6 +1160,14 @@ class PsShardServer:
         self._replica_set: Optional[ReplicaSet] = None
         self._replica_index = 0
         self._replicator: Optional[_Replicator] = None
+        #: resolved write-quorum size (replicas, primary included, that
+        #: must hold a write before it acks); None = the legacy
+        #: connected-backups-only barrier
+        self._quorum: Optional[int] = None
+        #: replicated migration spec (MigrateStart payload): a promoted
+        #: source re-installs its shipper from this — the automatic
+        #: re-drive that replaces the manual re-issued MigrateStart
+        self._pending_migration: Optional[dict] = None
         self._repl_mu = checked_lock("ps.repl_state")
         # Elastic-resharding state: which partition scheme this shard
         # belongs to, whether it is still IMPORTING its row range (a
@@ -1104,7 +1270,8 @@ class PsShardServer:
     def configure_replication(self, replica_set: ReplicaSet,
                               replica_index: int, *,
                               timeout_ms: Optional[int] = None,
-                              ack_timeout_s: Optional[float] = None
+                              ack_timeout_s: Optional[float] = None,
+                              quorum: "int | str | None" = "auto"
                               ) -> None:
         """Declares this server's place in its range's replica group
         (call after every replica has started — addresses are only known
@@ -1112,7 +1279,17 @@ class PsShardServer:
         starts propagating applied batches to the others; everyone else
         serves reads and applies ``ReplicaApply`` deltas.
         ``timeout_ms``/``ack_timeout_s`` tune the propagation control
-        timeout and the per-apply ack wait."""
+        timeout and the per-apply ack wait.
+
+        ``quorum`` is the write-ack quorum (replicas, primary included,
+        that must HOLD a write before it acks): ``"auto"`` (the
+        default) takes the majority for groups of three or more and the
+        legacy connected-backups barrier for pairs; ``"majority"``
+        forces the majority; an int passes through; ``None`` forces the
+        legacy barrier.  With a quorum, the bootstrap loss window is
+        closed — the first write blocks until a backup really holds it
+        — and a majority promotion sweep provably intersects every
+        acked write."""
         if replica_set.addresses[replica_index] != self.address:
             raise ValueError(
                 f"replica_index {replica_index} is "
@@ -1122,15 +1299,27 @@ class PsShardServer:
             self.repl_timeout_ms = int(timeout_ms)
         if ack_timeout_s is not None:
             self.repl_ack_timeout_s = float(ack_timeout_s)
+        n = len(replica_set.addresses)
+        if quorum == "auto":
+            quorum = n // 2 + 1 if n >= 3 else None
+        elif quorum == "majority":
+            quorum = n // 2 + 1
+        elif quorum is not None:
+            quorum = int(quorum)
+            if not 1 <= quorum <= n:
+                raise ValueError(
+                    f"quorum {quorum} outside [1, {n}]")
         with self._repl_mu:
             self._replica_set = replica_set
             self._replica_index = replica_index
+            self._quorum = quorum
             self._primary_flag = replica_index == replica_set.primary
             if self._primary_flag and len(replica_set.addresses) > 1:
                 self._replicator = _Replicator(
                     self, [a for a in replica_set.addresses
                            if a != self.address], epoch=self._epoch,
-                    timeout_ms=self.repl_timeout_ms)
+                    timeout_ms=self.repl_timeout_ms,
+                    quorum=self._quorum)
 
     @property
     def epoch(self) -> int:
@@ -1163,6 +1352,18 @@ class PsShardServer:
         primary."""
         demote = None
         with self._repl_mu:
+            if self._replica_set is None:
+                # Bring-up race: this server has not been configured
+                # into its replica group yet, so it cannot judge epochs
+                # — and it must NOT answer the equal-epoch EFENCED
+                # meant for stale primaries (an eager-connecting real
+                # primary would demote itself off it).  Reject
+                # retriably; the sender backs off and resyncs once
+                # configuration lands.
+                raise rpc.RpcError(
+                    2001,
+                    f"shard {self.shard_index} ({self.address}) has no "
+                    f"replica group configured yet; retry the sync")
             if epoch < self._epoch or (epoch == self._epoch
                                        and self._primary_flag):
                 if obs.enabled():
@@ -1290,10 +1491,19 @@ class PsShardServer:
         sync point; anything at or below the watermark is already
         here).  Returns the watermark to ack, or ``None`` once the
         import has completed — late frames must break the stream, not
-        mutate a live table."""
+        mutate a live table.
+
+        On a REPLICATED destination the batch propagates to this
+        shard's backups (the same ``ReplicaApply`` framing, enqueued
+        under the write lock = apply order) and the watermark is acked
+        only once the ack barrier holds — a destination primary dying
+        right after cutover can then promote a backup that already
+        holds every migrated row."""
         windows, off = _unpack_windows(body)
         ids, grads = _unpack_apply(memoryview(body)[off:], self.base,
                                    self.rows_per, self.dim)
+        rep = None
+        new_gen = 0
         with self._mu.write():
             if not self._importing:
                 return None
@@ -1303,6 +1513,12 @@ class PsShardServer:
             if ids.size:
                 np.subtract.at(self.table, ids, self.lr * grads)
                 self._install_gen += 1
+                new_gen = self._install_gen
+                rep = self._replicator
+                if rep is not None:
+                    gids = (ids + self.base).astype(np.int32)
+                    rep.ship(new_gen, _pack_windows(windows)
+                             + bytes(_pack_apply_req(gids, grads)))
             self._import_gens[src] = gen
             if windows:
                 with self._seq_mu:
@@ -1313,7 +1529,62 @@ class PsShardServer:
                             self._writer_applied[w] = q
             if obs.enabled():
                 obs.counter("ps_migrate_frames_in").add(1)
-            return gen
+        if rep is not None:
+            try:
+                rep.flush(new_gen, timeout_s=self.repl_ack_timeout_s)
+            except rpc.RpcError:
+                # Backups did not confirm: the watermark must NOT ack
+                # (the source's cutover flush would count rows safe
+                # that only this process holds).  Breaking the stream
+                # forces a wholesale resync, which converges.
+                return None
+        return gen
+
+    @staticmethod
+    def _parse_migration_spec(payload, what: str) -> dict:
+        """Validate one MigrateStart/MigrateSpec JSON spec — hostile
+        input like every control payload."""
+        try:
+            spec = json.loads(payload)
+            targets = spec["targets"]
+            int(spec["scheme"])
+            if not isinstance(targets, list) or not all(
+                    isinstance(t, dict)
+                    and isinstance(t.get("addr"), str)
+                    and int(t["base"]) >= 0 and int(t["rows"]) > 0
+                    for t in targets):
+                raise ValueError("bad targets")
+        except (ValueError, KeyError, TypeError,
+                RecursionError) as e:
+            raise wire.WireError(
+                f"malformed {what} spec: {e}") from e
+        return spec
+
+    def _install_migrator(self, spec: dict) -> None:
+        """Install (or replace) the migration shipper described by
+        ``spec`` and remember the spec — a later promotion of a backup
+        re-drives from its replicated copy."""
+        from brpc_tpu import reshard  # lazy: reshard imports us
+        with self._repl_mu:
+            if self._scheme_fenced or self._importing:
+                raise rpc.RpcError(
+                    resilience.ESCHEMEMOVED,
+                    f"shard {self.shard_index} cannot source a "
+                    f"migration (fenced={self._scheme_fenced}, "
+                    f"importing={self._importing})")
+            old, self._migrator = self._migrator, None
+        if old is not None:
+            old.stop()
+        shipper = reshard.MigrationShipper(
+            self, spec["targets"], int(spec["scheme"]),
+            timeout_ms=self.repl_timeout_ms)
+        with self._repl_mu:
+            self._migrator = shipper
+            self._pending_migration = spec
+        # Workers start only once the apply path sees the shipper:
+        # every batch from here on either ships or predates the
+        # workers' range snapshots — never neither.
+        shipper.start()
 
     def _reserve_seq(self, writer: str, seq: int) -> bool:
         """True exactly once per (writer, seq): the server-side dedup
@@ -1350,7 +1621,10 @@ class PsShardServer:
                 return None
             np.subtract.at(self.table, ids, self.lr * grads)
             self._install_gen = gen
-            if self._shard is not None:
+            if self._shard is not None and not self._importing:
+                # An importing destination's backup defers its first
+                # native snapshot to CompleteImport — the native read
+                # path must never serve unmigrated rows.
                 self._shard.install(self.table, gen)
             if windows:
                 # Inherit the primary's dedup window WITH the batch it
@@ -1380,11 +1654,11 @@ class PsShardServer:
         try:
             # Deadline admission FIRST: expired queued work sheds here
             # (EDEADLINE), before any parse or table touch.
-            payload = _admit_deadline(method, payload)
+            payload, deadline_us = _admit_deadline(method, payload)
             if not obs.enabled():
-                return self._serve(method, payload)
+                return self._serve(method, payload, deadline_us)
             t0 = time.monotonic_ns()
-            rsp = self._serve(method, payload)
+            rsp = self._serve(method, payload, deadline_us)
         except wire.WireError:
             _reject_frame(method)
             raise
@@ -1537,7 +1811,7 @@ class PsShardServer:
         if rep is not None:
             rep.flush(gen, timeout_s=self.repl_ack_timeout_s)
 
-    def _serve_apply_id(self, payload) -> bytes:
+    def _serve_apply_id(self, payload, deadline_us: int = 0) -> bytes:
         """Idempotent unary write (``ApplyGradId``): the per-(writer,
         shard) seq window drops a timed-out-but-APPLIED attempt's retry
         server-side (exactly-once against this shard), and a GUARD
@@ -1566,7 +1840,8 @@ class PsShardServer:
                 obs.counter("ps_unary_dedup_drops").add(1)
         if apply and ids.size:
             if self.combine:
-                self._combiner.add(ids, grads, meta=(writer, seq))
+                self._combiner.add(ids, grads, meta=(writer, seq),
+                                   deadline_us=deadline_us)
             else:
                 self._apply_batch(ids, grads, metas=[(writer, seq)])
         with self._mu.read():
@@ -1602,11 +1877,26 @@ class PsShardServer:
                 if peers:
                     self._replicator = _Replicator(
                         self, peers, epoch=epoch,
-                        timeout_ms=self.repl_timeout_ms)
+                        timeout_ms=self.repl_timeout_ms,
+                        quorum=self._quorum)
+                pending = self._pending_migration
             if old is not None:
                 old.stop(join=False)
             if obs.enabled():
                 obs.counter("ps_replica_promotions").add(1)
+            if pending is not None and not self._scheme_fenced \
+                    and not self._importing:
+                # Automatic re-drive: the dead primary carried an
+                # in-flight migration whose spec was replicated here.
+                # The fresh shipper resyncs every destination wholesale
+                # from THIS table (byte-identical at its generation) and
+                # resumes deltas — no manual MigrateStart; destinations
+                # key their watermarks per source ADDRESS, so the new
+                # source starts its own watermark and the old one goes
+                # quiet.
+                self._install_migrator(pending)
+                if obs.enabled():
+                    obs.counter("ps_migration_redrives").add(1)
             return struct.pack("<qq", self._epoch, self._install_gen)
         if method == "Sync":
             epoch, gen, count = wire.read("<qqq", payload, 0, "Sync.hdr")
@@ -1634,7 +1924,7 @@ class PsShardServer:
                 with self._mu.write():
                     self.table[:] = table
                     self._install_gen = gen
-                    if self._shard is not None:
+                    if self._shard is not None and not self._importing:
                         self._shard.install(self.table, gen)
                     # Full-state handoff: the received (table, gen,
                     # windows) triple is authoritative — local window
@@ -1672,6 +1962,8 @@ class PsShardServer:
                 "reads": self._reads(),
                 "primary": self._primary_flag,
                 "epoch": self._epoch,
+                "addr": self.address,
+                "table_bytes": self.rows_per * self.dim * 4,
             }).encode()
         if method == "MigrateStart":
             # Begin streaming this shard's rows to the successor
@@ -1680,42 +1972,21 @@ class PsShardServer:
             # applied batch).  Idempotent — a re-issued start replaces
             # the shipper and the destinations resync wholesale.
             self._check_primary()
-            try:
-                spec = json.loads(payload)
-                targets = spec["targets"]
-                scheme_ver = int(spec["scheme"])
-                if not isinstance(targets, list) or not all(
-                        isinstance(t, dict)
-                        and isinstance(t.get("addr"), str)
-                        and int(t["base"]) >= 0 and int(t["rows"]) > 0
-                        for t in targets):
-                    raise ValueError("bad targets")
-            except (ValueError, KeyError, TypeError,
-                    RecursionError) as e:
-                raise wire.WireError(
-                    f"malformed MigrateStart spec: {e}") from e
-            from brpc_tpu import reshard  # lazy: reshard imports us
-            with self._repl_mu:
-                if self._scheme_fenced or self._importing:
-                    raise rpc.RpcError(
-                        resilience.ESCHEMEMOVED,
-                        f"shard {self.shard_index} cannot source a "
-                        f"migration (fenced={self._scheme_fenced}, "
-                        f"importing={self._importing})")
-                old, self._migrator = self._migrator, None
-            if old is not None:
-                old.stop()
-            shipper = reshard.MigrationShipper(
-                self, targets, scheme_ver,
-                timeout_ms=self.repl_timeout_ms)
-            with self._repl_mu:
-                self._migrator = shipper
-            # Workers start only once the apply path sees the shipper:
-            # every batch from here on either ships or predates the
-            # workers' range snapshots — never neither.
-            shipper.start()
+            spec = self._parse_migration_spec(payload, "MigrateStart")
+            self._install_migrator(spec)
             with self._mu.read():
                 return struct.pack("<q", self._install_gen)
+        if method == "MigrateSpec":
+            # The re-drive half of fault-tolerant migration: a source
+            # BACKUP stores the in-flight migration's spec; if it is
+            # later promoted (the source primary died mid-copy), the
+            # Promote handler re-installs the shipper from it — no
+            # manual MigrateStart.  The driver distributes this to
+            # every non-primary source replica at start().
+            spec = self._parse_migration_spec(payload, "MigrateSpec")
+            with self._repl_mu:
+                self._pending_migration = spec
+            return b""
         if method == "MigrateState":
             mig = self._migrator
             with self._mu.read():
@@ -1726,10 +1997,13 @@ class PsShardServer:
                 "targets": mig.state() if mig is not None else {},
             }).encode()
         if method == "MigrateStop":
-            # Abort path: stop shipping, forget the successor.  The
-            # destinations stay importing (their owner closes them).
+            # Abort path: stop shipping, forget the successor AND the
+            # replicated spec (a later promotion must not re-drive an
+            # aborted migration).  The destinations stay importing
+            # (their owner closes them).
             with self._repl_mu:
                 mig, self._migrator = self._migrator, None
+                self._pending_migration = None
             if mig is not None:
                 # join the workers BEFORE the channel set closes — an
                 # aborted migration must leave no native handle behind
@@ -1788,6 +2062,10 @@ class PsShardServer:
                 raise
             if obs.enabled():
                 obs.counter("ps_scheme_fences").add(1)
+            with self._repl_mu:
+                # cutover complete for this source: a later promotion
+                # must not re-drive the finished migration
+                self._pending_migration = None
             return struct.pack("<q", gen)
         if method == "SchemeUnfence":
             # Abort-path rollback (MigrationDriver.abort): a cutover
@@ -1824,6 +2102,7 @@ class PsShardServer:
                                  off).reshape(count, self.dim)
             windows = _unpack_windows(
                 payload, off + count * self.dim * 4)[0]
+            rep = None
             with self._mu.write():
                 if not self._importing:
                     raise rpc.RpcError(
@@ -1834,6 +2113,8 @@ class PsShardServer:
                 self.table[lo:lo + count] = rows
                 self._import_gens[src] = src_gen
                 self._install_gen += 1
+                sync_gen = self._install_gen
+                rep = self._replicator
                 if windows:
                     with self._seq_mu:
                         for w, q in windows.items():
@@ -1841,6 +2122,14 @@ class PsShardServer:
                                 self._writer_seqs[w] = q
                             if q > self._writer_applied.get(w, 0):
                                 self._writer_applied[w] = q
+            if rep is not None:
+                # A wholesale range overwrite is inexpressible in the
+                # delta framing: force this destination's backups
+                # through a full-table Sync and hold the source's
+                # response until the ack barrier covers it — the Sync
+                # response IS the source's ack that this slice is safe.
+                rep.resync_peers()
+                rep.flush(sync_gen, timeout_s=self.repl_ack_timeout_s)
             if obs.enabled():
                 obs.counter("ps_migrate_syncs").add(1)
             return b""
@@ -1850,25 +2139,77 @@ class PsShardServer:
             # snapshot — until here the native read path answered
             # errors, never unmigrated rows.
             with self._repl_mu:
+                backup = (self._replica_set is not None
+                          and not self._primary_flag)
                 with self._mu.write():
                     was = self._importing
+                    if was and backup and self._install_gen == 0:
+                        # A destination backup that never received its
+                        # primary's Sync holds seed garbage — opening
+                        # it would serve unmigrated rows.  Stay
+                        # importing; the reconnect Sync brings the data
+                        # and the driver's retry opens it then.
+                        raise rpc.RpcError(
+                            resilience.EMIGRATING,
+                            f"shard {self.shard_index} backup has no "
+                            f"replicated state yet; refusing to open "
+                            f"an empty import")
                     self._importing = False
                     gen = self._install_gen
                     if was and self._shard is not None:
                         self._shard.install(self.table, gen)
+                rep = self._replicator
+            if was and rep is not None:
+                # Open the backups too: force a fresh full-table Sync
+                # (one may have lagged the import propagation) and
+                # clear their import flags — a destination backup that
+                # missed the driver's open would otherwise answer
+                # EMIGRATING until restarted.  The unary fan-out runs
+                # on its OWN thread: a native call from inside this
+                # fiber-served handler would park the fiber and resume
+                # it on another pthread (the PyGILState crash) — the
+                # same rule that keeps replicator/shipper traffic on
+                # dedicated threads.
+                rep.resync_peers()
+                peers = self._peers()
+                timeout_ms = self.repl_timeout_ms
+                ack_s = self.repl_ack_timeout_s
+
+                def _open_backups() -> None:
+                    try:
+                        rep.flush(gen, timeout_s=ack_s)
+                    except rpc.RpcError:
+                        pass   # a dead backup stays importing; reads
+                        #        route around it (replica-level miss)
+                    for a in peers:
+                        ch = rpc.Channel(a, timeout_ms=timeout_ms)
+                        try:
+                            ch.call("Ps", "CompleteImport", b"",
+                                    timeout_ms=timeout_ms)
+                        except rpc.RpcError:
+                            if obs.enabled():
+                                obs.counter(
+                                    "ps_import_open_errors").add(1)
+                        finally:
+                            ch.close()
+
+                threading.Thread(target=_open_backups, daemon=True,
+                                 name="brt-import-open").start()
             if obs.enabled() and was:
                 obs.counter("ps_imports_completed").add(1)
             return struct.pack("<q", gen)
         raise ValueError(f"unknown method {method}")
 
-    def _serve(self, method: str, payload: bytes) -> bytes:
+    def _serve(self, method: str, payload: bytes,
+               deadline_us: int = 0) -> bytes:
         if method in ("ReplicaState", "Promote", "Sync", "WriterSeq",
                       "Flush", "SchemeInfo", "MigrateStart",
-                      "MigrateState", "MigrateStop", "SchemeFence",
-                      "SchemeUnfence", "MigrateSync", "CompleteImport"):
+                      "MigrateSpec", "MigrateState", "MigrateStop",
+                      "SchemeFence", "SchemeUnfence", "MigrateSync",
+                      "CompleteImport"):
             return self._serve_control(method, payload)
         if method == "ApplyGradId":
-            return self._serve_apply_id(payload)
+            return self._serve_apply_id(payload, deadline_us)
         if method not in ("Lookup", "ApplyGrad"):
             raise ValueError(f"unknown method {method}")
         # Guarded header (wire schemas lookup_req/apply_req): a negative
@@ -1913,7 +2254,8 @@ class PsShardServer:
                 # Combined write path: enqueue and wait for the batch —
                 # the combiner's leader applies once per drained batch.
                 self._combiner.add(ids,
-                                   grads.reshape(count, self.dim))
+                                   grads.reshape(count, self.dim),
+                                   deadline_us=deadline_us)
             else:
                 self._apply_batch(ids, grads.reshape(count, self.dim))
             if self._replica_set is not None:
@@ -2136,11 +2478,11 @@ class DevicePsShardServer:
         try:
             # Same admission order as the CPU shard: expired work sheds
             # before any parse or device launch.
-            payload = _admit_deadline(method, payload)
+            payload, deadline_us = _admit_deadline(method, payload)
             if not obs.enabled():
-                return self._serve(method, payload)
+                return self._serve(method, payload, deadline_us)
             t0 = time.monotonic_ns()
-            rsp = self._serve(method, payload)
+            rsp = self._serve(method, payload, deadline_us)
         except wire.WireError:
             _reject_frame(method)
             raise
@@ -2161,7 +2503,7 @@ class DevicePsShardServer:
             return b""
         return self._handle(method, payload)
 
-    def _serve_apply_id(self, payload) -> bytes:
+    def _serve_apply_id(self, payload, deadline_us: int = 0) -> bytes:
         """Idempotent unary write for the device shard: same
         per-(writer, shard) admission window as the CPU server (the
         device tier has no migration inheritance, so guards check the
@@ -2184,7 +2526,8 @@ class DevicePsShardServer:
                 obs.counter("ps_unary_dedup_drops").add(1)
         if apply and ids.size:
             if self.combine:
-                self._combiner.add(ids, grads)
+                self._combiner.add(ids, grads,
+                                   deadline_us=deadline_us)
             else:
                 self._apply_batch(ids, grads)
         return struct.pack("<q", 0)
@@ -2230,9 +2573,10 @@ class DevicePsShardServer:
         finally:
             self.dev.release(ids_h)
 
-    def _serve(self, method: str, payload: bytes) -> bytes:
+    def _serve(self, method: str, payload: bytes,
+               deadline_us: int = 0) -> bytes:
         if method == "ApplyGradId":
-            return self._serve_apply_id(payload)
+            return self._serve_apply_id(payload, deadline_us)
         if method == "WriterSeq":
             # the push flush barrier verifies every shard's window; the
             # device tier's admission window is its applied proxy — the
@@ -2268,7 +2612,7 @@ class DevicePsShardServer:
             # combiner's leader stages and launches once per batch.
             grads = np.frombuffer(payload, np.float32, count * self.dim,
                                   4 + 4 * count).reshape(count, self.dim)
-            self._combiner.add(ids, grads)
+            self._combiner.add(ids, grads, deadline_us=deadline_us)
             return b""
         bucket = self._bucket(count)
         padded_ids = np.zeros(bucket, np.int32)
@@ -2651,7 +2995,8 @@ class RemoteEmbedding:
                  health_interval_ms: float = 200.0,
                  push_window_bytes: int = 0,
                  scorer: "Optional[resilience.ReplicaScorer]" = None,
-                 propagate_deadline: bool = True):
+                 propagate_deadline: bool = True,
+                 deadline_mode: str = "absolute"):
         self.vocab = vocab
         self.dim = dim
         self.parallel = parallel
@@ -2662,8 +3007,16 @@ class RemoteEmbedding:
         #: wall-clock deadline header, so servers shed queued work that
         #: can no longer answer in time (EDEADLINE) instead of
         #: executing it into a void.  Same-host clocks agree exactly;
-        #: cross-host this assumes NTP-grade wall-clock agreement.
+        #: cross-host the "absolute" form assumes NTP-grade wall-clock
+        #: agreement while "relative" (the v2 header) drops it — the
+        #: server arrival-stamps the remaining budget with its own
+        #: clock.
         self.propagate_deadline = bool(propagate_deadline)
+        if deadline_mode not in ("absolute", "relative"):
+            raise ValueError(
+                f"deadline_mode {deadline_mode!r}: expected "
+                f"'absolute' or 'relative'")
+        self.deadline_mode = deadline_mode
         #: per-shard unconsumed-bytes window for push streams (0 = the
         #: native 2MB default) — the backpressure knob of push_gradients
         self.push_window_bytes = push_window_bytes
@@ -3096,6 +3449,21 @@ class RemoteEmbedding:
                         f"{view._gen_seen[s]} — acked updates are "
                         f"missing, refusing the lossy adoption")
             else:
+                # Quorum intersection: for >=3-replica groups a
+                # promotion may only happen off a MAJORITY sweep — an
+                # acked write holds on a write quorum, and any majority
+                # of replicas intersects that quorum in at least one
+                # member, so the freshest candidate of a majority sweep
+                # provably carries every acked update.  A sub-majority
+                # sweep refuses loudly instead of guessing.
+                majority = len(rs.addresses) // 2 + 1
+                if len(rs.addresses) >= 3 and len(states) < majority:
+                    raise rpc.RpcError(
+                        resilience.EBREAKEROPEN,
+                        f"shard {s}: only {len(states)} of "
+                        f"{len(rs.addresses)} replicas reachable — a "
+                        f"majority sweep is required before promoting "
+                        f"(acked quorum writes must intersect it)")
                 cands = {a: st for a, st in states.items()
                          if st["epoch"] >= seen
                          and st["gen"] >= view._gen_seen[s]}
@@ -3143,14 +3511,19 @@ class RemoteEmbedding:
     def _stamp(self, req, deadline: Optional[float]):
         """Deadline propagation for one request LEG: prefix ``req``
         with the batch's remaining budget (``deadline`` is the batch's
-        ``time.monotonic`` instant) converted to an absolute wall-clock
-        deadline at THIS issue.  Called per attempt — a retry or hedge
-        leg carries what is left NOW, not the original budget."""
+        ``time.monotonic`` instant).  Called per attempt — a retry or
+        hedge leg carries what is left NOW, not the original budget.
+        ``deadline_mode="absolute"`` converts to a wall-clock deadline
+        (same-host/NTP assumption); ``"relative"`` ships the remaining
+        budget itself (v2 header) and the server arrival-stamps with
+        its own clock — no cross-host wall-clock agreement needed."""
         if deadline is None or not self.propagate_deadline:
             return req
-        deadline_us = int((time.time() + (deadline - time.monotonic()))
-                          * 1e6)
-        return _pack_deadline(deadline_us, req)
+        remaining_s = deadline - time.monotonic()
+        if self.deadline_mode == "relative":
+            return _pack_deadline_rel(int(remaining_s * 1e6), req)
+        return _pack_deadline(int((time.time() + remaining_s) * 1e6),
+                              req)
 
     def _reroutable(self, view: _SchemeView, s: int,
                     exc: rpc.RpcError) -> bool:
@@ -3184,9 +3557,16 @@ class RemoteEmbedding:
         attempt = 0
         reroutes = 0
         while True:
-            if self._scheme_miss(e):
+            # a READ answered EMIGRATING with siblings untried is a
+            # replica-level miss (a lagging destination backup): route
+            # around it; only an all-replicas miss is a view miss
+            miss_reroute = (read and e.code == resilience.EMIGRATING
+                            and len(tried)
+                            < len(view.replica_sets[s].addresses))
+            if self._scheme_miss(e) and not miss_reroute:
                 raise e
-            reroute = not read and self._reroutable(view, s, e)
+            reroute = miss_reroute or (
+                not read and self._reroutable(view, s, e))
             if reroute:
                 reroutes += 1
                 if reroutes > len(view.replica_sets[s].addresses) + 1:
@@ -3362,10 +3742,21 @@ class RemoteEmbedding:
             def _classify(i: int, e: rpc.RpcError) -> None:
                 """Queue item i for the next re-fan round, or abort.
                 Scheme-boundary errors abort immediately — the caller
-                re-routes the remainder through the successor view."""
-                if self._scheme_miss(e):
-                    raise e
+                re-routes the remainder through the successor view.
+                Exception: a READ answered EMIGRATING with sibling
+                replicas untried is a REPLICA-level miss (a destination
+                backup that lagged the cutover open), not a view-level
+                one — try a sibling before declaring the view a miss."""
                 s = items[i][0]
+                if self._scheme_miss(e):
+                    if read and e.code == resilience.EMIGRATING and \
+                            len(tried[i]) < len(
+                                view.replica_sets[s].addresses):
+                        reroutes[i] += 1
+                        excs[i] = e
+                        failed.append(i)
+                        return
+                    raise e
                 if not read and self._reroutable(view, s, e):
                     reroutes[i] += 1
                     if reroutes[i] <= \
@@ -3423,7 +3814,9 @@ class RemoteEmbedding:
                 round_delay = 0.0
                 for i in refan:
                     s = items[i][0]
-                    if not read and self._reroutable(view, s, excs[i]):
+                    if self._scheme_miss(excs[i]) or (
+                            not read
+                            and self._reroutable(view, s, excs[i])):
                         continue   # routing correction: no backoff
                     # retry_delay_ms floors ELIMIT sheds (mandatory
                     # backoff — never re-fan straight into overload)
@@ -3440,7 +3833,9 @@ class RemoteEmbedding:
                     resilience.sleep_ms(round_delay)
                 for i in refan:
                     s, req = items[i]
-                    if read or not self._reroutable(view, s, excs[i]):
+                    if not (self._scheme_miss(excs[i])
+                            or (not read and self._reroutable(
+                                view, s, excs[i]))):
                         attempts[i] += 1
                         if obs.enabled():
                             obs.counter("rpc_retries").add(1)

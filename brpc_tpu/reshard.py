@@ -43,7 +43,7 @@ import json
 import struct
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -423,6 +423,8 @@ class MigrationDriver:
         self.cluster = cluster
         self.timeout_ms = timeout_ms
         self._chans: Dict[str, rpc.Channel] = {}
+        #: resolved live primaries, keyed (scheme version, shard)
+        self._primaries: Dict[tuple, str] = {}
         self._registry: Optional[NamingClient] = None
 
     # -- plumbing ----------------------------------------------------------
@@ -446,6 +448,51 @@ class MigrationDriver:
         rs = scheme.replica_sets[s]
         return rs.addresses[rs.primary]
 
+    def _live_primary(self, scheme: PartitionScheme, s: int,
+                      refresh: bool = False) -> str:
+        """The CURRENT primary of shard ``s`` — for replicated sources
+        the boot primary may have died mid-migration and a promoted
+        backup (which re-drove the shipper from its replicated spec)
+        now owns the range.  Resolved by a ``ReplicaState`` sweep
+        (highest claiming epoch wins), cached per (scheme, shard), and
+        re-resolved when a cached answer fails (``refresh=True``).
+        Single-replica shards short-circuit to the declared address."""
+        rs = scheme.replica_sets[s]
+        if len(rs.addresses) == 1:
+            return rs.addresses[rs.primary]
+        key = (scheme.version, s)
+        if not refresh:
+            cached = self._primaries.get(key)
+            if cached is not None:
+                return cached
+        best: "Optional[tuple]" = None
+        for a in rs.addresses:
+            try:
+                st = json.loads(self._chan(a).call(
+                    "Ps", "ReplicaState", b"",
+                    timeout_ms=min(self.timeout_ms, 1000)))
+            except rpc.RpcError:
+                continue
+            if st.get("primary") and (best is None
+                                      or int(st["epoch"]) > best[0]):
+                best = (int(st["epoch"]), a)
+        addr = best[1] if best is not None else rs.addresses[rs.primary]
+        self._primaries[key] = addr
+        return addr
+
+    def _call_shard(self, scheme: PartitionScheme, s: int, method: str,
+                    payload: bytes) -> bytes:
+        """One control call to shard ``s``'s live primary, re-resolving
+        once when the cached primary fails (died, or answered
+        ENOTPRIMARY after a failover)."""
+        try:
+            return self._chan(self._live_primary(scheme, s)).call(
+                "Ps", method, payload, timeout_ms=self.timeout_ms)
+        except rpc.RpcError:
+            addr = self._live_primary(scheme, s, refresh=True)
+            return self._chan(addr).call(
+                "Ps", method, payload, timeout_ms=self.timeout_ms)
+
     def targets_for(self, s: int) -> List[dict]:
         """The successor shards overlapping source shard ``s``, each
         with the INTERSECTION row range it receives from this source
@@ -468,7 +515,10 @@ class MigrationDriver:
         the destinations resync wholesale.  With a registry, the
         successor is published as PREPARING first — a writer fenced in
         the cutover-to-publication gap already finds its redirect
-        target."""
+        target.  On a REPLICATED source the spec is also distributed to
+        every backup (``MigrateSpec``): a backup promoted after the
+        primary dies mid-copy re-installs the shipper from its copy —
+        the automatic re-drive, no manual ``MigrateStart``."""
         reg = self._naming()
         if reg is not None and self.cluster is not None:
             publish_scheme(reg, self.cluster,
@@ -476,29 +526,46 @@ class MigrationDriver:
         gens: Dict[int, int] = {}
         for s in range(self.old.num_shards):
             spec = json.dumps({"scheme": self.new.version,
-                               "targets": self.targets_for(s)})
-            rsp = self._chan(self._primary(self.old, s)).call(
-                "Ps", "MigrateStart", spec.encode(),
-                timeout_ms=self.timeout_ms)
+                               "targets": self.targets_for(s)}).encode()
+            rsp = self._call_shard(self.old, s, "MigrateStart", spec)
             gens[s] = wire.read("<q", rsp, 0, "MigrateStart.rsp")[0]
+            primary = self._live_primary(self.old, s)
+            for a in self.old.replica_sets[s].addresses:
+                if a == primary:
+                    continue
+                try:
+                    self._chan(a).call("Ps", "MigrateSpec", spec,
+                                       timeout_ms=self.timeout_ms)
+                except rpc.RpcError:
+                    # a dead backup just cannot re-drive if promoted
+                    # later; the migration itself is unaffected
+                    if obs.enabled():
+                        obs.counter("ps_migrate_spec_errors").add(1)
         return gens
 
     def migrate_state(self, s: int) -> dict:
-        rsp = self._chan(self._primary(self.old, s)).call(
-            "Ps", "MigrateState", b"", timeout_ms=self.timeout_ms)
-        return json.loads(rsp)
+        return json.loads(self._call_shard(self.old, s, "MigrateState",
+                                           b""))
 
     def wait_caught_up(self, deadline_s: float = 30.0,
                        poll_ms: float = 20.0) -> None:
         """Blocks until every destination of every source is synced
         with an empty ship queue (the copy phase is done and deltas
         flow at wire rate — cutover will only have the in-flight tail
-        to flush)."""
+        to flush).  An unreachable source counts as lagging, not fatal:
+        a source primary dying mid-copy is survived by its promoted
+        backup re-driving the shipper, and this poll keeps waiting for
+        that to converge instead of aborting the migration."""
         deadline = time.monotonic() + deadline_s
         while True:
             lagging = []
             for s in range(self.old.num_shards):
-                st = self.migrate_state(s)
+                try:
+                    st = self.migrate_state(s)
+                except rpc.RpcError:
+                    self._live_primary(self.old, s, refresh=True)
+                    lagging.append((s, "unreachable"))
+                    continue
                 if not st["active"]:
                     lagging.append((s, "no shipper"))
                     continue
@@ -521,25 +588,69 @@ class MigrationDriver:
     def cutover(self) -> Dict[int, int]:
         """The fenced scheme switch: fence every source (writes start
         redirecting, final generations flush to the destinations), then
-        open every destination, then publish the transition.  Returns
-        each source's FINAL generation.  Only after every fence
-        succeeded are destinations opened — a half-fenced cutover never
-        exposes a destination that could still receive source syncs."""
+        open every destination — the live primary FIRST (its failure is
+        fatal), then its backups (best-effort: a dead backup stays
+        importing and opens on a later retry, its reconnect Sync
+        carries the data) — then publish the transition.  Returns each
+        source's FINAL generation.  Only after every fence succeeded
+        are destinations opened — a half-fenced cutover never exposes a
+        destination that could still receive source syncs."""
         final: Dict[int, int] = {}
         for s in range(self.old.num_shards):
-            rsp = self._chan(self._primary(self.old, s)).call(
-                "Ps", "SchemeFence",
-                struct.pack("<q", self.new.version),
-                timeout_ms=self.timeout_ms)
+            rsp = self._call_shard(self.old, s, "SchemeFence",
+                                   struct.pack("<q", self.new.version))
             final[s] = wire.read("<q", rsp, 0, "SchemeFence.rsp")[0]
         for d in range(self.new.num_shards):
-            self._chan(self._primary(self.new, d)).call(
-                "Ps", "CompleteImport", b"",
-                timeout_ms=self.timeout_ms)
+            primary = self._live_primary(self.new, d)
+            self._chan(primary).call("Ps", "CompleteImport", b"",
+                                     timeout_ms=self.timeout_ms)
+            for a in self.new.replica_sets[d].addresses:
+                if a == primary:
+                    continue
+                try:
+                    self._chan(a).call("Ps", "CompleteImport", b"",
+                                       timeout_ms=self.timeout_ms)
+                except rpc.RpcError:
+                    if obs.enabled():
+                        obs.counter("ps_import_open_errors").add(1)
         if obs.enabled():
             obs.counter("reshard_cutovers").add(1)
         self.publish()
         return final
+
+    def ramp_weights(self, steps: "Sequence[float]" = (0.25, 0.5,
+                                                       0.75, 1.0),
+                     interval_s: float = 0.5) -> None:
+        """GRADUAL capacity-weighted scheme shift — replaces the binary
+        1→0 read cutover.  Call after :meth:`cutover`: each step
+        re-publishes the successor ACTIVE at weight ``w`` and the
+        retiring scheme still ACTIVE at ``1 - w``, so the weighted read
+        pick moves traffic over in increments (writes already moved at
+        the fence — the successor is the newest active scheme).  The
+        final step publishes the retiring scheme DRAINING at weight 0,
+        exactly the binary cutover's end state.  No-op without a
+        registry."""
+        reg = self._naming()
+        if reg is None or self.cluster is None:
+            return
+        for i, w in enumerate(steps):
+            w = min(max(float(w), 0.0), 1.0)
+            last = i + 1 == len(steps)
+            publish_scheme(reg, self.cluster,
+                           self.new.with_(state="active", weight=w))
+            if last or w >= 1.0:
+                publish_scheme(
+                    reg, self.cluster,
+                    self.old.with_(state="draining", weight=0.0))
+                if obs.enabled():
+                    obs.counter("reshard_ramp_steps").add(1)
+                break
+            publish_scheme(
+                reg, self.cluster,
+                self.old.with_(state="active", weight=1.0 - w))
+            if obs.enabled():
+                obs.counter("reshard_ramp_steps").add(1)
+            resilience.sleep_ms(interval_s * 1000.0)
 
     def publish(self) -> None:
         """Publish the post-cutover scheme records: the successor
@@ -554,12 +665,17 @@ class MigrationDriver:
         publish_scheme(reg, self.cluster,
                        self.old.with_(state="draining", weight=0.0))
 
-    def run(self, deadline_s: float = 60.0) -> Dict[str, object]:
-        """copy → catch-up → cutover; returns a summary."""
+    def run(self, deadline_s: float = 60.0, *,
+            ramp_steps: "Optional[Sequence[float]]" = None,
+            ramp_interval_s: float = 0.5) -> Dict[str, object]:
+        """copy → catch-up → cutover (→ optional weight ramp); returns
+        a summary."""
         t0 = time.monotonic()
         start_gens = self.start()
         self.wait_caught_up(deadline_s=deadline_s)
         final = self.cutover()
+        if ramp_steps:
+            self.ramp_weights(ramp_steps, interval_s=ramp_interval_s)
         return {
             "old_version": self.old.version,
             "new_version": self.new.version,
@@ -574,9 +690,8 @@ class MigrationDriver:
         """Total reads ever served by the RETIRING scheme's shards."""
         total = 0
         for s in range(self.old.num_shards):
-            info = json.loads(self._chan(self._primary(self.old, s))
-                              .call("Ps", "SchemeInfo", b"",
-                                    timeout_ms=self.timeout_ms))
+            info = json.loads(self._call_shard(self.old, s,
+                                               "SchemeInfo", b""))
             total += int(info.get("reads", 0))
         return total
 
@@ -617,16 +732,31 @@ class MigrationDriver:
         COMPLETED cutover — the destinations are open and own the
         ranges then."""
         for s in range(self.old.num_shards):
-            addr = self._primary(self.old, s)
             try:
-                self._chan(addr).call(
-                    "Ps", "MigrateStop", b"",
-                    timeout_ms=self.timeout_ms)
-                self._chan(addr).call(
-                    "Ps", "SchemeUnfence", b"",
-                    timeout_ms=self.timeout_ms)
+                self._call_shard(self.old, s, "MigrateStop", b"")
+                self._call_shard(self.old, s, "SchemeUnfence", b"")
             except rpc.RpcError:
                 pass  # a dead source has nothing left to roll back
+            # backups forget the replicated spec too — a promotion
+            # after an abort must not resurrect the migration
+            for a in self.old.replica_sets[s].addresses:
+                try:
+                    self._chan(a).call("Ps", "MigrateStop", b"",
+                                       timeout_ms=self.timeout_ms)
+                except rpc.RpcError:
+                    pass
+        reg = self._naming()
+        if reg is not None and self.cluster is not None:
+            # the stillborn successor's PREPARING record must not
+            # linger: watchers (the rebalancer included) treat a
+            # preparing scheme as a migration in flight and would
+            # never decide again
+            try:
+                publish_scheme(reg, self.cluster,
+                               self.new.with_(state="retired",
+                                              weight=0.0))
+            except Exception:  # noqa: BLE001 — registry outage
+                pass
         if obs.enabled():
             obs.counter("reshard_aborts").add(1)
 

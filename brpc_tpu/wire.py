@@ -63,6 +63,12 @@ MAX_WIRE_COUNT = 1 << 24
 #: (cpp/capi/ps_shard.cc) tests the same constant.
 DEADLINE_MAGIC = 0x7EAD11E5
 
+#: first-int32 sentinel of the v2 deadline header (schema
+#: ``deadline_hdr_v2``): RELATIVE budget + server-side arrival stamp —
+#: drops the same-host/NTP wall-clock assumption of the absolute form.
+#: Also above MAX_WIRE_COUNT, and tested by the native Lookup parser.
+DEADLINE_MAGIC2 = 0x7EAD11E6
+
 #: first-int32 sentinel of a press trace file ("PRS1" little-endian,
 #: schema ``press_header``)
 PRESS_MAGIC = 0x31535250
@@ -531,6 +537,20 @@ schema(
     unpack_sites=("ps_remote._unpack_deadline",),
     exact_sites=("ps_remote._pack_deadline",
                  "ps_remote._unpack_deadline"),
+    native_sites=("cpp/capi/ps_shard.cc:CPsService::ServeLookup",))
+
+schema(
+    "deadline_hdr_v2",
+    Int("magic", "<i"), Int("budget_us"), Tail("body"),
+    doc="v2 deadline prefix: DEADLINE_MAGIC2 ++ RELATIVE budget in "
+        "microseconds ++ the original request body — the server stamps "
+        "arrival with its OWN clock and computes expiry as arrival + "
+        "budget, so no same-host/NTP wall-clock agreement is assumed; "
+        "the shared _unpack_deadline dispatches on the magic and the "
+        "native Lookup handler peels both forms",
+    pack_sites=("ps_remote._pack_deadline_rel",),
+    unpack_sites=("ps_remote._unpack_deadline",),
+    exact_sites=("ps_remote._pack_deadline_rel",),
     native_sites=("cpp/capi/ps_shard.cc:CPsService::ServeLookup",))
 
 schema(

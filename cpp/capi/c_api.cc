@@ -387,7 +387,12 @@ int brt_debug_fail_connections(const char* addr) {
   int failed = 0;
   for (SocketId sid : all) {
     SocketUniquePtr p;
-    if (Socket::Address(sid, &p) == 0 && p->remote() == target) {
+    // Skip LISTEN sockets: a listener records its own listen address
+    // as `remote`, and failing it would kill an in-process server's
+    // accept path forever — the lever severs CONNECTIONS to the
+    // address, it does not decommission the address.
+    if (Socket::Address(sid, &p) == 0 && p->remote() == target &&
+        !p->is_listener()) {
       p->SetFailed(ECONNRESET, "brt_debug_fail_connections(%s)", addr);
       ++failed;
     }

@@ -98,13 +98,25 @@ class CPsService : public Service {
       return;
     }
     request.copy_to(&count, 4);
-    if (count == 0x7EAD11E5 /* wire.DEADLINE_MAGIC */) {
+    if (count == 0x7EAD11E5 /* wire.DEADLINE_MAGIC */ ||
+        count == 0x7EAD11E6 /* wire.DEADLINE_MAGIC2 (relative) */) {
       if (request.size() < 12) {
         cntl->SetFailed(EREQUEST, "Lookup deadline header truncated");
         return;
       }
       int64_t deadline_us = 0;
       request.copy_to(&deadline_us, 8, 4);
+      if (count == 0x7EAD11E6) {
+        // v2: the field is the REMAINING budget; expiry is the local
+        // arrival stamp plus that budget — no cross-host wall-clock
+        // agreement is assumed (wire schema deadline_hdr_v2).
+        if (deadline_us <= 0) {
+          cntl->SetFailed(EDEADLINE,
+                          "deadline budget exhausted before Lookup started");
+          return;
+        }
+        deadline_us += realtime_us();
+      }
       off = 12;
       if (deadline_us > 0 && realtime_us() > deadline_us) {
         cntl->SetFailed(EDEADLINE,

@@ -113,6 +113,7 @@ int Acceptor::StartAccept(const EndPoint& listen_point) {
   Socket::Options o;
   o.fd = fd;
   o.remote = listen_point_;
+  o.is_listener = true;
   o.user = this;
   o.on_edge_triggered = &Acceptor::OnNewConnections;
   int rc = Socket::Create(o, &listen_sid_);

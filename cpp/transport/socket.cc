@@ -142,6 +142,7 @@ int Socket::Create(const Options& opts, SocketId* id_out) {
   BRT_CHECK(v & 1);
   s->fd_ = opts.fd;
   s->remote_ = opts.remote;
+  s->is_listener_ = opts.is_listener;
   s->user_ = opts.user;
   s->on_edge_triggered_ = opts.on_edge_triggered;
   s->run_deferred_ = opts.run_deferred;

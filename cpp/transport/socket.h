@@ -68,6 +68,12 @@ class Socket {
   struct Options {
     int fd = -1;
     EndPoint remote;
+    // True for an acceptor's LISTEN socket: it records its own listen
+    // address as `remote`, so remote-matching sweeps (the
+    // debug_fail_connections test lever) must be able to tell it from
+    // a client connection TO that address — failing the listener kills
+    // the server's accept path, not a connection.
+    bool is_listener = false;
     void* user = nullptr;  // owner cookie (Server*, Channel state, ...)
     // Called in a fiber when the fd becomes readable (edge-triggered:
     // implementations must read until EAGAIN). Null for connect-only
@@ -165,6 +171,7 @@ class Socket {
   SocketId id() const { return id_; }
   int fd() const { return fd_; }
   const EndPoint& remote() const { return remote_; }
+  bool is_listener() const { return is_listener_; }
   void* user() const { return user_; }
 
   // Last-matched protocol index for InputMessenger (reference keeps this on
@@ -266,6 +273,7 @@ class Socket {
   SocketId id_ = INVALID_SOCKET_ID;
   int fd_ = -1;
   EndPoint remote_;
+  bool is_listener_ = false;
   void* user_ = nullptr;
   void* (*on_edge_triggered_)(Socket*) = nullptr;
   void* (*run_deferred_)(void*) = nullptr;

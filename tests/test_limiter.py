@@ -565,3 +565,225 @@ def test_limiter_gauges_ride_status_vars():
         obs.drop_var("t_inflight")
         obs.drop_var("t_maxc")
         assert "t_inflight" not in obs.dump_exposed_dict("t_")
+
+
+# ---------------------------------------------------------------------------
+# deadline header v2 (relative budget + arrival stamp) and drain-time
+# shedding (ISSUE 13 satellites)
+# ---------------------------------------------------------------------------
+
+def test_deadline_v2_pack_unpack_roundtrip():
+    """The v2 header carries a RELATIVE budget; _unpack_deadline
+    arrival-stamps it against the LOCAL clock — a positive budget
+    yields a deadline just past now, a non-positive one a deadline in
+    the past (shed at admission)."""
+    from brpc_tpu.ps_remote import (_pack_deadline_rel,
+                                    _unpack_deadline)
+    body = b"\x01\x02\x03payload"
+    framed = bytes(_pack_deadline_rel(250_000, body))
+    assert struct.unpack_from("<i", framed, 0)[0] == \
+        wire.DEADLINE_MAGIC2
+    now_us = time.time() * 1e6
+    out, deadline_us = _unpack_deadline(framed)
+    assert out == body
+    assert now_us + 100_000 < deadline_us < now_us + 1_000_000
+    # expired budget: deadline lands at/behind the local arrival stamp
+    out, deadline_us = _unpack_deadline(
+        bytes(_pack_deadline_rel(-5, body)))
+    assert out == body and deadline_us <= time.time() * 1e6
+    # truncated v2 header is hostile, not legacy
+    with pytest.raises(wire.WireError):
+        _unpack_deadline(framed[:7])
+    # bare frames still pass through untouched
+    assert _unpack_deadline(body) == (body, 0)
+
+
+@pytest.mark.needs_native
+def test_deadline_v2_sheds_expired_work_server_side(shard_server):
+    """A v2-stamped write whose budget is spent never mutates the
+    table (EDEADLINE), on both the Python ApplyGrad path and the
+    NATIVE Lookup parser; live budgets serve normally."""
+    from brpc_tpu import rpc
+    from brpc_tpu.ps_remote import (_pack_apply_req,
+                                    _pack_deadline_rel)
+    srv = shard_server(lr=1.0, native_read=True)
+    ch = rpc.Channel(srv.address, timeout_ms=5000)
+    ids = np.arange(8, dtype=np.int32)
+    before = srv.table.copy()
+    try:
+        apply_body = bytes(_pack_apply_req(
+            ids, np.full((8, 8), 0.5, np.float32)))
+        with pytest.raises(rpc.RpcError) as ei:
+            ch.call("Ps", "ApplyGrad",
+                    bytes(_pack_deadline_rel(-1, apply_body)))
+        assert ei.value.code == resilience.EDEADLINE
+        assert np.array_equal(srv.table, before)
+        # native Lookup peels the v2 magic: expired budget sheds with
+        # EDEADLINE before the ids are even copied out
+        native0 = srv.native_lookups
+        with pytest.raises(rpc.RpcError) as ei:
+            ch.call("Ps", "Lookup",
+                    bytes(_pack_deadline_rel(-1, _lookup_req(ids))))
+        assert ei.value.code == resilience.EDEADLINE
+        # a live budget serves through the same native path
+        rsp = ch.call("Ps", "Lookup", bytes(_pack_deadline_rel(
+            2_000_000, _lookup_req(ids))))
+        assert len(rsp) == 8 * 8 * 4
+        assert srv.native_lookups == native0 + 1
+        # and the write path applies normally under a live v2 budget
+        ch.call("Ps", "ApplyGrad", bytes(_pack_deadline_rel(
+            2_000_000, apply_body)))
+        expect = before.copy()
+        expect[ids] -= np.float32(0.5)
+        assert np.array_equal(srv.table, expect)
+    finally:
+        ch.close()
+
+
+@pytest.mark.needs_native
+def test_remote_embedding_relative_deadline_mode(shard_server):
+    """RemoteEmbedding(deadline_mode="relative") stamps every leg with
+    the v2 header; a generous budget serves, an impossible one sheds
+    at the server with EDEADLINE (never a silent apply)."""
+    from brpc_tpu import rpc
+    from brpc_tpu.ps_remote import RemoteEmbedding
+    srv = shard_server(lr=1.0)
+    ids = np.arange(8, dtype=np.int32)
+    before = srv.table.copy()
+    emb = RemoteEmbedding([srv.address], 256, 8, timeout_ms=5000,
+                          deadline_ms=2000.0,
+                          deadline_mode="relative")
+    try:
+        emb.apply_gradients(ids, np.full((8, 8), 0.5, np.float32))
+        expect = before.copy()
+        expect[ids] -= np.float32(0.5)
+        assert np.array_equal(srv.table, expect)
+        # the stamp really is the v2 form: the header opens with the
+        # v2 magic and carries (a tad under) the remaining budget
+        framed = emb._stamp(b"body", time.monotonic() + 1.5)
+        magic, budget_us = struct.unpack_from("<iq", framed, 0)
+        assert magic == wire.DEADLINE_MAGIC2
+        assert 1_000_000 < budget_us <= 1_500_000
+        assert bytes(framed[12:]) == b"body"
+    finally:
+        emb.close()
+
+
+def test_combiner_drain_time_deadline_shed():
+    """The PR-12 deferral closed: a contribution whose deadline
+    expires while WAITING in the combine queue is dropped at drain
+    (counted, EDEADLINE to its waiter) — not applied."""
+    from brpc_tpu import rpc
+    from brpc_tpu.ps_remote import GradCombiner
+    applied = []
+    gate = threading.Event()
+
+    def apply_fn(ids, grads):
+        applied.append(np.array(ids))
+        gate.wait(2.0)   # the leader's batch is slow: followers queue
+
+    comb = GradCombiner(apply_fn, dim=4)
+    drops0 = int(obs.counter("ps_deadline_drops_Drain").get_value())
+    t_lead = threading.Thread(
+        target=lambda: comb.add(np.array([1], np.int32),
+                                np.zeros((1, 4), np.float32)))
+    t_lead.start()
+    time.sleep(0.05)     # the leader is inside apply_fn now
+    # follower with a deadline that dies in the queue
+    err = []
+
+    def follower():
+        try:
+            comb.add(np.array([2], np.int32),
+                     np.zeros((1, 4), np.float32),
+                     deadline_us=int(time.time() * 1e6 + 50_000))
+        except rpc.RpcError as e:
+            err.append(e.code)
+
+    t_f = threading.Thread(target=follower)
+    t_f.start()
+    time.sleep(0.2)      # its 50ms budget dies while queued
+    gate.set()           # leader finishes; drain runs NOW
+    t_lead.join(timeout=5)
+    t_f.join(timeout=5)
+    assert err == [resilience.EDEADLINE]
+    # only the leader's contribution ever applied
+    assert len(applied) == 1 and applied[0].tolist() == [1]
+    assert int(obs.counter("ps_deadline_drops_Drain").get_value()) \
+        == drops0 + 1
+    # a LIVE follower behind the same slow leader still applies
+    gate.clear()
+    t_lead2 = threading.Thread(
+        target=lambda: comb.add(np.array([3], np.int32),
+                                np.zeros((1, 4), np.float32)))
+    t_lead2.start()
+    time.sleep(0.05)
+    t_f2 = threading.Thread(
+        target=lambda: comb.add(np.array([4], np.int32),
+                                np.zeros((1, 4), np.float32),
+                                deadline_us=int(time.time() * 1e6
+                                                + 10_000_000)))
+    t_f2.start()
+    time.sleep(0.05)
+    gate.set()
+    t_lead2.join(timeout=5)
+    t_f2.join(timeout=5)
+    assert any(a.tolist() == [4] for a in applied)
+
+
+@pytest.mark.needs_native
+def test_combiner_drain_shed_through_server(shard_server):
+    """End to end: a combined server whose leader batch is slowed by a
+    fault delay sheds a queued v1-stamped write at drain — the table
+    moves only by the surviving contributions (exact arithmetic)."""
+    from brpc_tpu import rpc
+    from brpc_tpu.ps_remote import _pack_apply_req, _pack_deadline
+    srv = shard_server(lr=1.0, combine=True)
+    ch = rpc.Channel(srv.address, timeout_ms=8000)
+    ids = np.arange(4, dtype=np.int32)
+    body = bytes(_pack_apply_req(ids, np.full((4, 8), 0.5,
+                                              np.float32)))
+    before = srv.table.copy()
+    # slow the COMBINER's apply itself (not the trampoline): the
+    # follower must wait in the combine queue, where its budget dies
+    orig = srv._combiner._apply
+    in_apply = threading.Event()
+    gate = threading.Event()
+
+    def slow_apply(aids, agrads, metas=()):
+        in_apply.set()
+        gate.wait(5.0)
+        orig(aids, agrads, metas)
+
+    srv._combiner._apply = slow_apply
+    try:
+        t = threading.Thread(target=lambda: ch.call(
+            "Ps", "ApplyGrad", body, timeout_ms=8000))
+        t.start()
+        assert in_apply.wait(5.0)    # the leader is mid-apply
+        ch2 = rpc.Channel(srv.address, timeout_ms=8000)
+        t2_err = []
+
+        def follower():
+            try:
+                ch2.call("Ps", "ApplyGrad", bytes(_pack_deadline(
+                    int(time.time() * 1e6 + 100_000), body)),
+                    timeout_ms=8000)
+            except rpc.RpcError as e:
+                t2_err.append(e.code)
+
+        t2 = threading.Thread(target=follower)
+        t2.start()
+        time.sleep(0.3)              # its 100ms budget dies queued
+        gate.set()
+        t.join(timeout=10)
+        t2.join(timeout=10)
+        assert t2_err == [resilience.EDEADLINE]
+        expect = before.copy()
+        expect[ids] -= np.float32(0.5)   # the leader alone applied
+        assert np.array_equal(srv.table, expect)
+        ch2.close()
+    finally:
+        srv._combiner._apply = orig
+        fault.clear()
+        ch.close()

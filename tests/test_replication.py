@@ -738,3 +738,168 @@ def test_from_registry_builds_replica_sets():
     finally:
         reg_server.close()
         _close_all(servers)
+
+
+# ---------------------------------------------------------------------------
+# quorum replication (ISSUE 13): majority-ack writes + majority promotion
+# ---------------------------------------------------------------------------
+
+def test_quorum_auto_resolution():
+    """configure_replication(quorum="auto") resolves to the majority
+    for >=3-replica groups and to the legacy connected-only barrier
+    for pairs; explicit forms pass through / validate."""
+    servers, _ = _cluster(nshards=1, nrep=3, lr=1.0)
+    try:
+        assert all(sv._quorum == 2 for sv in servers[0])
+    finally:
+        _close_all(servers)
+    servers, _ = _cluster(nshards=1, nrep=2, lr=1.0)
+    try:
+        assert all(sv._quorum is None for sv in servers[0])
+    finally:
+        _close_all(servers)
+    sv = PsShardServer(VOCAB, DIM, 0, 1, lr=1.0)
+    try:
+        rs = ReplicaSet((sv.address, "127.0.0.1:9", "127.0.0.1:10"))
+        with pytest.raises(ValueError):
+            sv.configure_replication(rs, 0, quorum=7)
+        sv.configure_replication(rs, 0, quorum="majority")
+        assert sv._quorum == 2
+    finally:
+        sv.close()
+
+
+def test_quorum_bootstrap_kill_loses_nothing():
+    """THE bootstrap loss window: with 3 replicas and a majority
+    quorum, the very first acked write already sits on >=2 replicas —
+    killing the primary right after it can no longer lose it (the
+    legacy connected-only barrier acked on the primary alone until the
+    backups' first Sync landed)."""
+    servers, sets = _cluster(nshards=1, nrep=3, lr=1.0)
+    flat = [sv for row in servers for sv in row]
+    prim = servers[0][0]
+    emb = RemoteEmbedding(sets, VOCAB, DIM, timeout_ms=10000,
+                          retry=_retry_policy(attempts=4))
+    ids = np.arange(16, dtype=np.int32)
+    before = prim.table.copy()
+    try:
+        # the FIRST write: the quorum barrier blocks until a backup
+        # really holds it (its connect Sync covers the gen)
+        emb.apply_gradients(ids, np.full((16, DIM), 0.5, np.float32))
+        fault.install(fault.FaultPlan(
+            fault.kill_rules(prim.address), seed=13))
+        # failover must find the acked write on a surviving replica
+        emb.apply_gradients(ids, np.full((16, DIM), 0.25, np.float32))
+        expect = before.copy()
+        for d in (0.5, 0.25):
+            expect[ids] -= np.float32(d)
+        new_prim = next(sv for sv in flat
+                        if sv is not prim and sv.is_primary)
+        assert np.array_equal(new_prim.table, expect)
+        assert np.array_equal(emb.lookup(ids), expect[ids])
+    finally:
+        fault.clear()
+        emb.close()
+        _close_all(servers)
+
+
+def test_quorum_unreachable_fails_loudly_never_acks():
+    """With every backup black-holed a quorum write must FAIL (loud
+    unavailability) — and the failed write must not have mutated the
+    acked state observable after the backups return."""
+    servers, sets = _cluster(nshards=1, nrep=3, lr=1.0)
+    prim = servers[0][0]
+    prim.repl_ack_timeout_s = 0.4
+    emb = RemoteEmbedding(sets, VOCAB, DIM, timeout_ms=3000,
+                          retry=_retry_policy(attempts=2,
+                                              attempt_ms=1500))
+    ids = np.arange(8, dtype=np.int32)
+    try:
+        emb.apply_gradients(ids, np.full((8, DIM), 0.5, np.float32))
+        fault.install(fault.FaultPlan(
+            fault.kill_rules(servers[0][1].address)
+            + fault.kill_rules(servers[0][2].address), seed=17))
+        # sever the ESTABLISHED propagation streams too (fault rules
+        # only gate call paths): acks stop flowing and reconnects die
+        rpc.debug_fail_connections(servers[0][1].address)
+        rpc.debug_fail_connections(servers[0][2].address)
+        with pytest.raises(rpc.RpcError):
+            emb.apply_gradients(ids, np.full((8, DIM), 0.25,
+                                             np.float32))
+    finally:
+        fault.clear()
+        emb.close()
+        _close_all(servers)
+
+
+def test_promotion_requires_majority_sweep():
+    """For a 3-replica group, losing TWO replicas leaves a minority —
+    promotion must refuse loudly (a sub-majority sweep cannot prove it
+    intersects the write quorum); with exactly a majority reachable it
+    proceeds."""
+    servers, sets = _cluster(nshards=1, nrep=3, lr=1.0)
+    emb = RemoteEmbedding(sets, VOCAB, DIM, timeout_ms=3000,
+                          retry=_retry_policy(attempts=2,
+                                              attempt_ms=400))
+    ids = np.arange(8, dtype=np.int32)
+    try:
+        emb.apply_gradients(ids, np.full((8, DIM), 0.5, np.float32))
+        # kill primary AND one backup: 1 of 3 reachable < majority 2
+        fault.install(fault.FaultPlan(
+            fault.kill_rules(servers[0][0].address)
+            + fault.kill_rules(servers[0][1].address), seed=19))
+        with pytest.raises(rpc.RpcError):
+            emb.apply_gradients(ids, np.full((8, DIM), 0.25,
+                                             np.float32))
+        # the surviving minority was not promoted behind our back
+        assert not servers[0][2].is_primary
+        # majority restored (primary still dead): promotion proceeds
+        fault.install(fault.FaultPlan(
+            fault.kill_rules(servers[0][0].address), seed=19))
+        emb.apply_gradients(ids, np.full((8, DIM), 0.25, np.float32))
+        assert servers[0][1].is_primary or servers[0][2].is_primary
+    finally:
+        fault.clear()
+        emb.close()
+        _close_all(servers)
+
+
+def test_staggered_bringup_no_self_demotion():
+    """THE bring-up race the churn bench found: with real delays
+    between the replicas' configure_replication calls, the primary's
+    eager connect used to hit a NOT-YET-CONFIGURED backup, read its
+    default primary flag as a stale-primary EFENCED, demote itself,
+    and stop(join=False) closed its channel set under a sibling
+    worker's in-flight Sync — a native use-after-free.  Now an
+    unconfigured backup rejects retriably, the primary stays primary,
+    and teardown always joins workers before closing channels."""
+    for _ in range(3):   # the race was timing-dependent: iterate
+        servers = [[PsShardServer(VOCAB, DIM, s, 2, lr=1.0)
+                    for _ in range(3)] for s in range(2)]
+        try:
+            sets = []
+            for s in range(2):
+                rs = ReplicaSet(tuple(sv.address for sv in servers[s]),
+                                primary=0)
+                sets.append(rs)
+                for r, sv in enumerate(servers[s]):
+                    sv.configure_replication(rs, r)
+                    time.sleep(0.003)   # the staggered bring-up
+            time.sleep(0.3)             # eager connects settle
+            assert all(servers[s][0].is_primary for s in range(2))
+            assert not any(sv.is_primary
+                           for s in range(2) for sv in servers[s][1:])
+            emb = RemoteEmbedding(sets, VOCAB, DIM, timeout_ms=10000,
+                                  retry=_retry_policy(attempts=4))
+            try:
+                ids = np.arange(8, dtype=np.int32)
+                before = servers[0][0].table.copy()
+                emb.apply_gradients(ids, np.full((8, DIM), 0.5,
+                                                 np.float32))
+                expect = before.copy()
+                expect[ids] -= np.float32(0.5)
+                assert np.array_equal(servers[0][0].table, expect)
+            finally:
+                emb.close()
+        finally:
+            _close_all(servers)

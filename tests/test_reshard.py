@@ -758,3 +758,241 @@ def test_shipper_flush_raises_when_stopped_early():
     with pytest.raises(rpc.RpcError) as ei:
         sh.flush(3, timeout_s=1.0)
     assert "stopped" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# fault-tolerant migration (ISSUE 13): re-drive, replicated successors,
+# gradual weights
+# ---------------------------------------------------------------------------
+
+def _replicated_servers(num, nrep, version=0, importing=False, **kw):
+    """num shards x nrep replicas, replication configured (auto quorum:
+    majority for nrep>=3).  Returns (servers[s][r], replica_sets)."""
+    servers = [[PsShardServer(VOCAB, DIM, s, num, lr=1.0, stream=True,
+                              importing=importing,
+                              scheme_version=version, **kw)
+                for _ in range(nrep)] for s in range(num)]
+    sets = []
+    for s in range(num):
+        rs = ReplicaSet(tuple(sv.address for sv in servers[s]),
+                        primary=0)
+        sets.append(rs)
+        for r, sv in enumerate(servers[s]):
+            sv.configure_replication(rs, r)
+    return servers, sets
+
+
+def test_source_primary_death_mid_migration_redrives():
+    """Kill the source primary MID-COPY: the promoted backup re-drives
+    the migration from its replicated spec (no manual MigrateStart),
+    the driver's live-primary resolution follows it, the cutover
+    completes, and the exactly-once ApplyGradId windows hold across
+    the re-drive — the exact ledger is the proof."""
+    src, src_sets = _replicated_servers(1, 3)
+    dst = _servers(2, version=1, importing=True)
+    sc0 = PartitionScheme(0, tuple(src_sets))
+    sc1 = _scheme(dst, 1)
+    emb = RemoteEmbedding([sc0], VOCAB, DIM, timeout_ms=10000,
+                          retry=_retry_policy())
+    ids = np.arange(VOCAB, dtype=np.int32)
+    before = src[0][0].table.copy()
+    drv = MigrationDriver(sc0, sc1, VOCAB, timeout_ms=3000)
+    redrives0 = int(obs.counter("ps_migration_redrives").get_value())
+    try:
+        emb.apply_gradients(ids, np.full((VOCAB, DIM), 0.5,
+                                         np.float32))
+        drv.start()
+        # the source primary dies mid-copy (streams severed too)
+        fault.install(fault.FaultPlan(
+            fault.kill_rules(src[0][0].address), seed=23))
+        rpc.debug_fail_connections(src[0][0].address)
+        # a write triggers client failover -> Promote -> auto re-drive;
+        # its seq window must survive the re-drive exactly-once
+        emb.apply_gradients(ids, np.full((VOCAB, DIM), 0.25,
+                                         np.float32))
+        assert int(obs.counter("ps_migration_redrives").get_value()) \
+            == redrives0 + 1
+        drv.wait_caught_up(deadline_s=30)
+        emb.apply_gradients(ids, np.full((VOCAB, DIM), 0.125,
+                                         np.float32))
+        drv.cutover()
+        emb.set_schemes([sc0.with_(state="draining", weight=0.0), sc1])
+        emb.apply_gradients(ids, np.full((VOCAB, DIM), 0.0625,
+                                         np.float32))
+        expect = before.copy()
+        for d in (0.5, 0.25, 0.125, 0.0625):
+            expect[ids] -= np.float32(d)
+        assert np.array_equal(
+            np.concatenate([sv.table for sv in dst]), expect)
+        assert np.array_equal(emb.lookup(ids), expect)
+    finally:
+        fault.clear()
+        drv.close()
+        emb.close()
+        _close_all(dst)
+        _close_all(*src)
+
+
+def test_replicated_successor_backups_hold_import():
+    """MigrateSync/MigrateApply propagate to DESTINATION backups: after
+    the cutover every destination backup is byte-identical to its
+    primary, and killing a destination primary right after cutover
+    loses nothing — the promoted backup already holds every migrated
+    row (majority sweep over 3 replicas)."""
+    old = _servers(1)
+    dst, dst_sets = _replicated_servers(2, 3, version=1,
+                                        importing=True)
+    sc0 = _scheme(old, 0)
+    sc1 = PartitionScheme(1, tuple(dst_sets))
+    emb = RemoteEmbedding([sc0], VOCAB, DIM, timeout_ms=10000,
+                          retry=_retry_policy())
+    ids = np.arange(VOCAB, dtype=np.int32)
+    before = old[0].table.copy()
+    drv = MigrationDriver(sc0, sc1, VOCAB, timeout_ms=3000)
+    try:
+        emb.apply_gradients(ids, np.full((VOCAB, DIM), 0.5,
+                                         np.float32))
+        drv.start()
+        drv.wait_caught_up(deadline_s=30)
+        emb.apply_gradients(ids, np.full((VOCAB, DIM), 0.25,
+                                         np.float32))
+        drv.cutover()
+        emb.set_schemes([sc0.with_(state="draining", weight=0.0), sc1])
+        expect = before.copy()
+        for d in (0.5, 0.25):
+            expect[ids] -= np.float32(d)
+        # every destination replica holds the migrated rows
+        deadline = time.monotonic() + 5.0
+        def _replicas_identical():
+            return all(np.array_equal(sv.table, dst[s][0].table)
+                       for s in range(2) for sv in dst[s][1:])
+        while time.monotonic() < deadline and not _replicas_identical():
+            time.sleep(0.02)
+        assert _replicas_identical()
+        assert np.array_equal(
+            np.concatenate([dst[s][0].table for s in range(2)]),
+            expect)
+        # kill destination shard 0's primary: the write fails over to
+        # a backup that already holds the import
+        fault.install(fault.FaultPlan(
+            fault.kill_rules(dst[0][0].address), seed=29))
+        rpc.debug_fail_connections(dst[0][0].address)
+        emb.apply_gradients(ids, np.full((VOCAB, DIM), 0.125,
+                                         np.float32))
+        expect2 = expect.copy()
+        expect2[ids] -= np.float32(0.125)
+        got = np.concatenate([
+            next(sv for sv in dst[0] if sv.is_primary
+                 and sv is not dst[0][0]).table,
+            next(sv for sv in dst[1] if sv.is_primary).table])
+        assert np.array_equal(got, expect2)
+    finally:
+        fault.clear()
+        drv.close()
+        emb.close()
+        _close_all(old)
+        _close_all(*dst)
+
+
+def test_weight_ramp_publishes_gradual_shift():
+    """ramp_weights replaces the binary 1->0 read cutover: each step
+    publishes successor ACTIVE at w and the retiring scheme ACTIVE at
+    1-w; the final step lands exactly the binary end state (successor
+    active 1.0, old draining 0).  Writes already belong to the
+    successor at every step (newest active)."""
+    reg_server = rpc.Server()
+    reg_server.add_naming_registry()
+    reg_addr = f"127.0.0.1:{reg_server.start('127.0.0.1:0')}"
+    old = _servers(1)
+    new = _servers(2, version=1, importing=True)
+    sc0, sc1 = _scheme(old, 0), _scheme(new, 1)
+    nc = NamingClient(reg_addr)
+    publish_scheme(nc, "ps", sc0)
+    drv = MigrationDriver(sc0, sc1, VOCAB, registry_addr=reg_addr,
+                          cluster="ps", timeout_ms=3000)
+    emb = RemoteEmbedding([sc0], VOCAB, DIM, timeout_ms=10000,
+                          retry=_retry_policy())
+    ids = np.arange(VOCAB, dtype=np.int32)
+    mid_states = []
+    try:
+        emb.apply_gradients(ids, np.full((VOCAB, DIM), 0.5,
+                                         np.float32))
+        drv.start()
+        drv.wait_caught_up(deadline_s=20)
+        drv.cutover()   # publishes the binary transition...
+        # ...then the ramp re-publishes the gradual shift
+        drv.ramp_weights(steps=(0.5, 1.0), interval_s=0.05)
+        nodes, _ = nc.list("ps")
+        schemes = parse_schemes(nodes)
+        assert schemes[1].state == "active"
+        assert schemes[1].weight == 1.0
+        assert schemes[0].state == "draining"
+        assert schemes[0].weight == 0.0
+        # a mid-ramp publication really happened: run a ramp with a
+        # long interval and observe the registry between its steps
+        drv2 = MigrationDriver(sc0, sc1, VOCAB,
+                               registry_addr=reg_addr, cluster="ps",
+                               timeout_ms=3000)
+        t = threading.Thread(
+            target=lambda: drv2.ramp_weights(steps=(0.25, 1.0),
+                                             interval_s=0.6),
+            daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            nodes, _ = nc.list("ps")
+            schemes = parse_schemes(nodes)
+            state = (schemes[1].weight, schemes[0].weight,
+                     schemes[0].state)
+            if state == (0.25, 0.75, "active"):
+                mid_states.append(state)
+                break
+            time.sleep(0.02)
+        t.join(timeout=10)
+        drv2.close()
+        # the sub-1.0 step kept BOTH schemes active with complementary
+        # weights — the gradual read shift; the final step completed
+        assert mid_states == [(0.25, 0.75, "active")]
+        nodes, _ = nc.list("ps")
+        schemes = parse_schemes(nodes)
+        assert (schemes[1].weight, schemes[0].state) == (1.0,
+                                                         "draining")
+    finally:
+        drv.close()
+        emb.close()
+        nc.close()
+        reg_server.close()
+        _close_all(old, new)
+
+
+def test_scheme_watcher_ingests_hostile_claims_keeps_valid():
+    """_SchemeWatcher ingest: malformed claim nodes (no addr, non-str
+    tags, negative epochs), DUPLICATE claims (highest epoch must win),
+    and junk scheme records must neither raise nor shadow the valid
+    records in the same listing."""
+    old = _servers(1)
+    emb = RemoteEmbedding([_scheme(old, 0)], VOCAB, DIM,
+                          timeout_ms=5000)
+    from brpc_tpu.naming import SCHEME_TAG_PREFIX
+    good = _scheme(old, 0).with_(weight=0.5)
+    try:
+        emb._ingest_nodes([
+            {"tag": "0/1@e7P"},                      # claim, no addr
+            {"addr": 9, "tag": "0/1@e8P"},           # non-str addr
+            {"addr": "x:1", "tag": ["0/1@e9P"]},     # non-str tag
+            {"addr": "x:1", "tag": "0/1@e-3P"},      # negative epoch
+            {"addr": "a:1", "tag": "0/1@e2P"},       # valid, low epoch
+            {"addr": "b:1", "tag": "0/1@e5P"},       # valid duplicate
+            {"addr": "c:1", "tag": "0/1@e4P"},       # lower: ignored
+            {"addr": "0.0.0.0:0",
+             "tag": SCHEME_TAG_PREFIX + "{not json"},
+            {"addr": "0.0.0.0:0",
+             "tag": SCHEME_TAG_PREFIX + good.to_json()},
+        ])
+        # highest-epoch duplicate won; nothing raised; the valid
+        # re-published scheme updated the view's weight
+        assert emb._claims[(None, 1, 0)] == (5, "b:1")
+        assert emb._wv.weight == 0.5
+    finally:
+        emb.close()
+        _close_all(old)
