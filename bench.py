@@ -131,6 +131,15 @@ def run_churn_bench(deadline_s: int = 420) -> dict:
     return _run_json_child("bench_churn.py", "churn", deadline_s)
 
 
+def run_durable_bench(deadline_s: int = 300) -> dict:
+    """Durable fabric (bench_durable.py child): full-fleet kill
+    mid-load + checkpoint restore with the exact acked-update ledger
+    and a measured recovery-time bound, plus snapshot-hydrated
+    replica/split provisioning vs wholesale Sync source-side bytes
+    (also refreshes BENCH_durable.json)."""
+    return _run_json_child("bench_durable.py", "durable", deadline_s)
+
+
 def run_fault_bench(deadline_s: int = 300) -> dict:
     """Fault-tolerance numbers (bench_fault.py child): backup-request
     p99 bounding under an injected slow shard, breaker availability and
@@ -298,6 +307,10 @@ def main() -> int:
         # limiter/deadline config cross (bench_scenarios.py child).
         scenarios_block = run_scenarios_bench()
 
+        # Durable fabric (ISSUE 16): fleet-kill restore + hydrated
+        # provisioning (bench_durable.py child).
+        durable_block = run_durable_bench()
+
         gbps = best["gbps"]
         print(json.dumps({
             "metric": "same_host_echo_throughput",
@@ -322,6 +335,7 @@ def main() -> int:
             "fault": fault_block,
             "reshard": reshard_block,
             "scenarios": scenarios_block,
+            "durable": durable_block,
             **device_blocks,
         }))
         return 0

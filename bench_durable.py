@@ -1,0 +1,405 @@
+#!/usr/bin/env python3
+"""Durable-fabric bench: full-fleet kill + checkpoint restore, and
+snapshot-hydrated provisioning vs wholesale Sync (ISSUE 16).
+
+Two phases against a quorum-replicated PS fabric, every server carrying
+a :class:`brpc_tpu.durable.CheckpointStore`:
+
+- **fleet kill**: a single exact-ledger writer streams acked batches;
+  MID-load the ENTIRE fleet is closed (nothing survives in memory).
+  Fresh servers attach the same stores, replay base + delta chain, and
+  the restored tables must equal the seed tables minus exactly one
+  ``GRAD_VALUE`` per acked occurrence — the one write in flight at the
+  kill is the ONLY permitted ambiguity (it was never acked, so either
+  applied-or-not is a legal outcome, checked per shard).  The
+  wall-clock from kill to first served lookup is the measured
+  recovery-time bound.
+- **provisioning**: a new backup seeded the OLD way (wholesale Sync:
+  the live primary ships its whole table) vs the NEW way
+  (``durable.hydrate_replica`` seeds from the store; the primary ships
+  only the delta tail), plus a 1→2 split whose destinations hydrate
+  via ``durable.hydrate_destination`` — the source-side bytes shipped
+  are read off the obs counters and the hydrated paths must be
+  measurably cheaper on the live source.
+
+Emits ONE JSON line and refreshes BENCH_durable.json.  Degrades to
+{"skipped": ...} without the native core.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+# Process-global fiber pool: this scenario runs up to ~10 servers whose
+# handlers hold a worker through quorum ack barriers.
+os.environ.setdefault("BRT_WORKERS", "16")
+
+VOCAB, DIM = 1024, 16
+NSHARDS, REPLICAS = 2, 2
+WRITE_BATCH = 32
+SEED = 23
+KILL_AFTER_BATCHES = 40
+RECOVERY_BOUND_S = 10.0
+
+
+def main() -> int:  # noqa: C901 — one scenario, phases inline
+    try:
+        from brpc_tpu import rpc
+        if not rpc.native_core_available():
+            print(json.dumps({"skipped": "native core unavailable"}))
+            return 0
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        print(json.dumps({"skipped": f"{type(e).__name__}: {e}"[:200]}))
+        return 0
+    import numpy as np
+
+    from brpc_tpu import durable, obs, press, resilience
+    from brpc_tpu.durable import CheckpointStore
+    from brpc_tpu.naming import PartitionScheme, ReplicaSet
+    from brpc_tpu.ps_remote import PsShardServer, RemoteEmbedding
+    from brpc_tpu.reshard import MigrationDriver
+
+    obs.set_enabled(True)
+    t_bench0 = time.monotonic()
+    GRAD = press.GRAD_VALUE
+    rows_per = VOCAB // NSHARDS
+    ckpt_root = tempfile.mkdtemp(prefix="bench_durable_")
+
+    def counter(name):
+        return int(obs.counter(name).get_value())
+
+    def spawn_fleet():
+        """NSHARDS x REPLICAS quorum fleet, one store per server."""
+        servers, stores, sets = [], [], []
+        for s in range(NSHARDS):
+            row, srow = [], []
+            for r in range(REPLICAS):
+                sv = PsShardServer(VOCAB, DIM, s, NSHARDS, lr=1.0,
+                                   seed=SEED)
+                st = CheckpointStore(
+                    os.path.join(ckpt_root, f"shard{s}-rep{r}"))
+                row.append(sv)
+                srow.append(st)
+            servers.append(row)
+            stores.append(srow)
+            sets.append(ReplicaSet(tuple(sv.address for sv in row),
+                                   primary=0))
+        return servers, stores, sets
+
+    out = {}
+    ok = True
+    servers = stores = []
+    emb = emb2 = emb3 = drv = None
+    extra = []
+    try:
+        # -- phase 1: acked load, then kill the ENTIRE fleet --------------
+        servers, stores, sets = spawn_fleet()
+        init_tables = np.concatenate(
+            [servers[s][0].table.copy() for s in range(NSHARDS)])
+        for s in range(NSHARDS):
+            for r in range(REPLICAS):
+                servers[s][r].attach_checkpoint(stores[s][r])
+                servers[s][r].configure_replication(sets[s], r)
+        sc = PartitionScheme(0, tuple(sets))
+        emb = RemoteEmbedding([sc], VOCAB, DIM, timeout_ms=2000,
+                              retry=resilience.RetryPolicy(
+                                  max_attempts=2,
+                                  backoff=resilience.Backoff(
+                                      base_ms=1, max_ms=10),
+                                  attempt_timeout_ms=800))
+
+        counts = np.zeros(VOCAB, np.int64)      # acked occurrences
+        acked = [0]
+        failed_ids = [None]                     # the in-flight batch
+        stop = threading.Event()
+
+        def writer():
+            wrng = np.random.default_rng(SEED + 1)
+            while not stop.is_set():
+                ids = wrng.integers(0, VOCAB,
+                                    WRITE_BATCH).astype(np.int32)
+                grads = np.full((WRITE_BATCH, DIM), GRAD, np.float32)
+                try:
+                    emb.apply_gradients(ids, grads)
+                except Exception:  # noqa: BLE001 — the fleet died
+                    failed_ids[0] = ids
+                    return
+                np.add.at(counts, ids, 1)
+                acked[0] += 1
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        while acked[0] < KILL_AFTER_BATCHES and wt.is_alive():
+            time.sleep(0.01)
+
+        # the kill: every server in the fleet closes MID-load; nothing
+        # survives in process memory, only the checkpoint stores
+        t_kill = time.monotonic()
+        for row in servers:
+            for sv in row:
+                sv.close()
+        wt.join(timeout=15)
+        stop.set()
+        acked_batches = acked[0]
+
+        # -- restore: fresh servers, same stores --------------------------
+        servers2, stores2, sets2 = [], [], []
+        for s in range(NSHARDS):
+            row, srow = [], []
+            for r in range(REPLICAS):
+                sv = PsShardServer(VOCAB, DIM, s, NSHARDS, lr=1.0,
+                                   seed=SEED)
+                st = CheckpointStore(
+                    os.path.join(ckpt_root, f"shard{s}-rep{r}"))
+                sv.attach_checkpoint(st)        # replay base + deltas
+                row.append(sv)
+                srow.append(st)
+            servers2.append(row)
+            stores2.append(srow)
+            sets2.append(ReplicaSet(tuple(sv.address for sv in row),
+                                    primary=0))
+        hyd0 = counter("ps_replica_hydrates")
+        for s in range(NSHARDS):
+            for r in range(REPLICAS):
+                servers2[s][r].configure_replication(sets2[s], r)
+        sc2 = PartitionScheme(0, tuple(sets2))
+        emb2 = RemoteEmbedding([sc2], VOCAB, DIM, timeout_ms=5000,
+                               retry=resilience.RetryPolicy(
+                                   max_attempts=4,
+                                   backoff=resilience.Backoff(
+                                       base_ms=2, max_ms=50),
+                                   attempt_timeout_ms=1000))
+        emb2.lookup(np.arange(8, dtype=np.int32))   # first served read
+        recovery_s = time.monotonic() - t_kill
+
+        # the restored fleet keeps taking acked writes
+        post_ids = np.arange(WRITE_BATCH, dtype=np.int32)
+        emb2.apply_gradients(post_ids, np.full((WRITE_BATCH, DIM),
+                                               GRAD, np.float32))
+        np.add.at(counts, post_ids, 1)
+
+        # -- the exact ledger (order-free replay: GRAD is a power of
+        # two, so per-id subtraction is exact in any order) --------------
+        expect = init_tables.copy()
+        for step in range(int(counts.max())):
+            expect[counts > step] -= np.float32(GRAD)
+        ledger_exact = True
+        ambiguous_applied = []
+        for s in range(NSHARDS):
+            got = servers2[s][0].table
+            base = expect[s * rows_per:(s + 1) * rows_per]
+            cands = [("without_inflight", base)]
+            if failed_ids[0] is not None:
+                # the unacked in-flight batch may legally have landed
+                alt = base.copy()
+                sel = failed_ids[0][(failed_ids[0] >= s * rows_per)
+                                    & (failed_ids[0] <
+                                       (s + 1) * rows_per)] \
+                    - s * rows_per
+                if sel.size:
+                    np.subtract.at(
+                        alt, sel,
+                        np.full((sel.size, DIM), GRAD, np.float32))
+                    cands.append(("with_inflight", alt))
+            hit = next((name for name, c in cands
+                        if np.array_equal(got, c)), None)
+            ambiguous_applied.append(hit)
+            ledger_exact &= hit is not None
+        # every backup reconnected through the hydrate path (its gen is
+        # inside its primary's delta window after restore)
+        restore_hydrates = counter("ps_replica_hydrates") - hyd0
+
+        phase1 = {
+            "acked_batches": acked_batches,
+            "recovery_s": round(recovery_s, 3),
+            "ledger_exact": bool(ledger_exact),
+            "inflight_batch_outcome": ambiguous_applied,
+            "restore_deltas": counter("ps_ckpt_restore_deltas"),
+            "restores": counter("ps_ckpt_restores"),
+            "restore_hydrates": restore_hydrates,
+        }
+        ok &= ledger_exact and recovery_s <= RECOVERY_BOUND_S
+
+        # -- phase 2a: new backup — wholesale Sync vs hydrated seed -------
+        prim = servers2[0][0]
+        store0 = stores2[0][0]
+        table_bytes = rows_per * DIM * 4
+        b1 = PsShardServer(VOCAB, DIM, 0, NSHARDS, lr=1.0, seed=SEED)
+        extra.append(b1)
+        rs3 = ReplicaSet((prim.address, servers2[0][1].address,
+                          b1.address), primary=0)
+        b1.configure_replication(rs3, 2)
+        servers2[0][1].configure_replication(rs3, 1)
+        sync_b0 = counter("ps_replica_sync_bytes")
+        prim.configure_replication(rs3, 0)
+        # b1 was never seeded -> the hydrate guard refuses -> wholesale
+        t0 = time.monotonic()
+        while (counter("ps_replica_sync_bytes") == sync_b0
+               and time.monotonic() - t0 < 15):
+            time.sleep(0.02)
+        wholesale_bytes = counter("ps_replica_sync_bytes") - sync_b0
+
+        b2 = PsShardServer(VOCAB, DIM, 0, NSHARDS, lr=1.0, seed=SEED)
+        extra.append(b2)
+        rs4 = ReplicaSet((prim.address, servers2[0][1].address,
+                          b1.address, b2.address), primary=0)
+        b2.configure_replication(rs4, 3)
+        # seed the NEW backup from the checkpoint store, off the
+        # primary's serving path, then let the primary ship the tail
+        durable.hydrate_replica(store0, b2.address)
+        sync_b1 = counter("ps_replica_sync_bytes")
+        tail_b0 = counter("ps_replica_hydrate_tail_bytes")
+        hyd1 = counter("ps_replica_hydrates")
+        servers2[0][1].configure_replication(rs4, 1)
+        b1.configure_replication(rs4, 2)
+        prim.configure_replication(rs4, 0)
+        t0 = time.monotonic()
+        while (counter("ps_replica_hydrates") - hyd1 < 3
+               and time.monotonic() - t0 < 15):
+            time.sleep(0.02)
+        prim.flush_replication()
+        hydrate_tail_bytes = (counter("ps_replica_hydrate_tail_bytes")
+                              - tail_b0)
+        hydrate_sync_bytes = counter("ps_replica_sync_bytes") - sync_b1
+        replica_converged = bool(
+            np.array_equal(prim.table, b2.table))
+
+        phase2a = {
+            "table_bytes": table_bytes,
+            "wholesale_source_bytes": wholesale_bytes,
+            "hydrate_source_tail_bytes": hydrate_tail_bytes,
+            "hydrate_wholesale_fallbacks_bytes": hydrate_sync_bytes,
+            "converged": replica_converged,
+        }
+
+        # -- phase 2b: 1->2 split, destinations hydrated from the store ---
+        src = PsShardServer(VOCAB, DIM, 0, 1, lr=1.0, seed=SEED + 9,
+                            stream=True)
+        extra.append(src)
+        src_store = CheckpointStore(os.path.join(ckpt_root, "split-src"))
+        src.attach_checkpoint(src_store)
+        sc_src = PartitionScheme(0, (ReplicaSet.of(src.address),))
+        emb3 = RemoteEmbedding([sc_src], VOCAB, DIM, timeout_ms=5000)
+        ids = np.arange(VOCAB, dtype=np.int32)
+        for _ in range(8):
+            emb3.apply_gradients(ids, np.full((VOCAB, DIM), GRAD,
+                                              np.float32))
+        src.attach_checkpoint(src_store, recover=False)  # re-base
+        # the tail: SMALL batches spread across both halves — the whole
+        # point of hydrate-first is that the source only ships these
+        tail_ids = (np.arange(WRITE_BATCH, dtype=np.int32)
+                    * (VOCAB // WRITE_BATCH))
+        for _ in range(2):
+            emb3.apply_gradients(tail_ids,
+                                 np.full((WRITE_BATCH, DIM), GRAD,
+                                         np.float32))
+        dst = [PsShardServer(VOCAB, DIM, s, 2, lr=1.0, seed=SEED + 9,
+                             stream=True, importing=True,
+                             scheme_version=1) for s in range(2)]
+        extra.extend(dst)
+        half = VOCAB // 2
+        for s, sv in enumerate(dst):
+            durable.hydrate_destination(src_store, sv.address, 1,
+                                        src.address, 0, s * half, half)
+        sc_dst = PartitionScheme(1, tuple(ReplicaSet.of(sv.address)
+                                          for sv in dst))
+        mig_syncs0 = counter("ps_migrate_syncs_out")
+        mig_sync_b0 = counter("ps_migrate_sync_bytes")
+        mig_tail_b0 = counter("ps_migrate_hydrate_tail_bytes")
+        drv = MigrationDriver(sc_src, sc_dst, VOCAB)
+        drv.start()
+        drv.wait_caught_up(deadline_s=30)
+        drv.cutover()
+        emb3.close()
+        split_wholesale_syncs = (counter("ps_migrate_syncs_out")
+                                 - mig_syncs0)
+        split_sync_bytes = counter("ps_migrate_sync_bytes") - mig_sync_b0
+        split_tail_bytes = (counter("ps_migrate_hydrate_tail_bytes")
+                            - mig_tail_b0)
+        split_exact = bool(np.array_equal(
+            np.concatenate([sv.table for sv in dst]),
+            src.table))
+
+        phase2b = {
+            "src_table_bytes": VOCAB * DIM * 4,
+            "wholesale_range_syncs": split_wholesale_syncs,
+            "wholesale_source_bytes": split_sync_bytes,
+            "hydrate_source_tail_bytes": split_tail_bytes,
+            "hydrates": counter("ps_migrate_hydrates"),
+            "split_exact": split_exact,
+        }
+
+        criteria = {
+            "fleet_kill_lossless_ledger": bool(ledger_exact),
+            "recovery_under_bound_s": bool(
+                recovery_s <= RECOVERY_BOUND_S),
+            "replica_hydrate_cheaper_on_source": bool(
+                replica_converged
+                and hydrate_tail_bytes + hydrate_sync_bytes
+                < wholesale_bytes),
+            "split_hydrate_no_wholesale_sync": bool(
+                split_exact and split_wholesale_syncs == 0
+                and split_tail_bytes < VOCAB * DIM * 4),
+        }
+        out = {
+            "metric": "durable_recovery_time",
+            "value": round(recovery_s, 3),
+            "unit": "s",
+            "recovery_bound_s": RECOVERY_BOUND_S,
+            "fleet": f"{NSHARDS}x{REPLICAS}",
+            "fleet_kill": phase1,
+            "replica_provisioning": phase2a,
+            "split_provisioning": phase2b,
+            "ckpt": {
+                "snapshots": counter("ps_ckpt_snapshots"),
+                "deltas": counter("ps_ckpt_deltas"),
+                "compactions": counter("ps_ckpt_compactions"),
+                "snapshot_bytes": counter("ps_ckpt_snapshot_bytes"),
+                "delta_bytes": counter("ps_ckpt_delta_bytes"),
+            },
+            "criteria": criteria,
+            "wall_s": round(time.monotonic() - t_bench0, 2),
+        }
+        out["ok"] = bool(ok and all(criteria.values()))
+    finally:
+        if drv is not None:
+            try:
+                drv.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for e in (emb, emb2, emb3):
+            if e is not None:
+                try:
+                    e.close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+        for group in (servers, locals().get("servers2") or []):
+            for row in group:
+                for sv in row:
+                    try:
+                        sv.close()
+                    except Exception:  # noqa: BLE001 — already dead
+                        pass
+        for sv in extra:
+            try:
+                sv.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        shutil.rmtree(ckpt_root, ignore_errors=True)
+
+    with open(os.path.join(ROOT, "BENCH_durable.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -296,6 +296,29 @@ def python_targets(*, dim: int = 4) -> List[FuzzTarget]:
             wire.REGISTRY["apply_id_req"], rng, n, dim=dim),
         exec_fn=_apply_id))
 
+    from brpc_tpu import durable
+
+    targets.append(FuzzTarget(
+        name="unpack_ckpt_snap",
+        covers=("ckpt_snap",),
+        gen=lambda rng, n: mutated_frames(
+            wire.REGISTRY["ckpt_snap"], rng, n, dim=dim),
+        exec_fn=lambda p: durable._unpack_snapshot(bytes(p))))
+
+    targets.append(FuzzTarget(
+        name="unpack_ckpt_delta",
+        covers=("ckpt_delta",),
+        gen=lambda rng, n: mutated_frames(
+            wire.REGISTRY["ckpt_delta"], rng, n, dim=dim),
+        exec_fn=lambda p: durable._unpack_delta(bytes(p))))
+
+    targets.append(FuzzTarget(
+        name="unpack_ckpt_marker",
+        covers=("ckpt_marker",),
+        gen=lambda rng, n: mutated_frames(
+            wire.REGISTRY["ckpt_marker"], rng, n, dim=dim),
+        exec_fn=lambda p: durable._unpack_marker(bytes(p))))
+
     targets.append(FuzzTarget(
         name="unpack_deadline",
         covers=("deadline_hdr",),

@@ -32,6 +32,7 @@ itself.  See the "Replication & failover" README section.
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import struct
 import threading
@@ -771,6 +772,10 @@ class _Replicator:
                 f"quorum {quorum} outside [1, {len(peers) + 1}] for "
                 f"{len(peers)} backup(s)")
         self.quorum = quorum
+        #: hydrate-first (re)connect: when the owning server has a
+        #: checkpoint store attached, a peer already inside the store's
+        #: delta window gets the TAIL instead of a wholesale Sync
+        self.hydrate = True
         self._mu = checked_lock("ps.replicate")
         self._stop = threading.Event()
         # True when stopped BECAUSE of a fence/demotion: an in-flight
@@ -841,12 +846,17 @@ class _Replicator:
         with self._mu:
             return {p.addr: p.acked_gen for p in self._peers}
 
-    def resync_peers(self) -> None:
-        """Force every backup through a wholesale resync: the next
-        frame each worker would ship is superseded by a full-table
-        ``Sync`` of the current state.  The import path uses this after
-        a ``MigrateSync`` range install — a wholesale row overwrite the
-        delta framing cannot express."""
+    def resync_peers(self, hydrate: Optional[bool] = None) -> None:
+        """Force every backup through a resync.  With a checkpoint
+        store attached (and ``hydrate`` mode on) the reconnect tries
+        hydrate-first: a backup whose generation still sits inside the
+        store's delta window receives only the tail; anyone else — and
+        every backup after a ``MigrateSync`` range install, which
+        re-bases the store — falls through to the full-table ``Sync``
+        of the current state.  ``hydrate`` (when not None) stickily
+        switches the mode."""
+        if hydrate is not None:
+            self.hydrate = bool(hydrate)
         with self._mu:
             for p in self._peers:
                 p.queue.clear()
@@ -983,6 +993,82 @@ class _Replicator:
         self._ack_ev.set()
         if obs.enabled():
             obs.counter("ps_replica_syncs").add(1)
+            obs.counter("ps_replica_sync_bytes").add(len(table))
+        return True
+
+    def _try_hydrate(self, p: _PeerState) -> Optional[bool]:
+        """Hydrate-first (re)connect: when the backup's current
+        generation sits inside the checkpoint store's delta window,
+        open the delta stream and ship only the missing TAIL from disk
+        — the live table is never snapshotted or shipped.  Safe because
+        within one epoch the generation sequence is a function of the
+        primary's apply chain (the stream setup adopts our epoch or
+        fences us), and a ``Promote``/wholesale install always re-bases
+        the store, pushing any possibly-divergent peer out of the
+        window.  Returns True on success, False on a hard failure
+        (fenced/unreachable — the caller backs off), None to fall
+        through to the wholesale ``_connect``."""
+        store = getattr(self._server, "_durable", None)
+        if store is None or not self.hydrate:
+            return None
+        ch = self._channel(p.addr)
+        try:
+            st = ch.stream("Ps", "ReplicaApply",
+                           struct.pack("<q", self.epoch),
+                           receiver=_ReplicaAckReceiver(self, p.addr))
+        except rpc.RpcError as e:
+            if e.code == resilience.EFENCED:
+                with self._mu:
+                    p.fenced = True
+                self._ack_ev.set()
+                self._server._demote_on_fence()
+                return False
+            with self._mu:
+                p.down = True
+            self._ack_ev.set()
+            if obs.enabled():
+                obs.counter("ps_replica_connect_errors").add(1)
+            return False
+        try:
+            _peer_epoch, peer_gen = wire.read("<qq", st.response, 0,
+                                              "ReplicaApply.rsp")
+        except wire.WireError:
+            st.close()
+            return None
+        if peer_gen <= 0:
+            # A fresh backup's seed table is not provably this chain's
+            # gen-0 image — only the wholesale Sync may establish it.
+            st.close()
+            return None
+        deltas = store.tail_since(peer_gen)
+        if deltas is None or peer_gen > store.last_gen:
+            # The peer predates the base — or claims a generation the
+            # log never recorded (a divergent history): wholesale.
+            st.close()
+            return None
+        last = peer_gen
+        tail_bytes = 0
+        try:
+            for gen, body in deltas:
+                frame = bytes(_pack_stream_frame(gen, self.epoch, gen,
+                                                 body))
+                st.write(frame)
+                tail_bytes += len(frame)
+                last = gen
+        except rpc.RpcError:
+            st.close()
+            return None   # stream died mid-tail: wholesale converges
+        with self._mu:
+            p.stream = st
+            p.synced_gen = last
+            p.need_sync = False
+            p.down = False
+            if peer_gen > p.acked_gen:
+                p.acked_gen = peer_gen
+        self._ack_ev.set()
+        if obs.enabled():
+            obs.counter("ps_replica_hydrates").add(1)
+            obs.counter("ps_replica_hydrate_tail_bytes").add(tail_bytes)
         return True
 
     def _worker(self, p: _PeerState) -> None:
@@ -1005,7 +1091,10 @@ class _Replicator:
                 old, p.stream = p.stream, None
                 if old is not None:
                     old.close()   # rx stream: close (abort strands relay)
-                if self._connect(p):
+                ok = self._try_hydrate(p)
+                if ok is None:
+                    ok = self._connect(p)
+                if ok:
                     fails = 0
                 else:
                     if self._stop.is_set() or p.fenced:
@@ -1076,6 +1165,12 @@ class _Replicator:
         for ch in self._chans.values():
             ch.close()
         self._chans.clear()
+
+
+#: process-unique suffix for per-SERVER obs variables (two servers with
+#: the same shard_index — a primary and its backup — must not pool their
+#: tail-pressure signals)
+_server_seq = itertools.count()
 
 
 class PsShardServer:
@@ -1168,6 +1263,11 @@ class PsShardServer:
         #: source re-installs its shipper from this — the automatic
         #: re-drive that replaces the manual re-issued MigrateStart
         self._pending_migration: Optional[dict] = None
+        #: attached checkpoint store (brpc_tpu.durable.CheckpointStore;
+        #: None = volatile).  The apply paths tee every generation into
+        #: it UNDER the table write lock — log order is apply order —
+        #: and replica reconnects go hydrate-first through its tail.
+        self._durable = None
         self._repl_mu = checked_lock("ps.repl_state")
         # Elastic-resharding state: which partition scheme this shard
         # belongs to, whether it is still IMPORTING its row range (a
@@ -1187,6 +1287,15 @@ class PsShardServer:
         #: lock — every mutation happens inside an apply/sync install)
         self._import_gens: Dict[str, int] = {}
         self._read_count = 0
+        #: per-SERVER tail-pressure signals surfaced through SchemeInfo
+        #: (uniquely named on purpose: the process-wide per-shard-index
+        #: recorders blur same-index servers across schemes/replicas);
+        #: dropped at close alongside the limiter gauges
+        sid = next(_server_seq)
+        self._sig_names = (f"ps_p99_shard{shard_index}_{sid}",
+                           f"ps_sheds_shard{shard_index}_{sid}")
+        self._lat = obs.recorder(self._sig_names[0])
+        self._sheds = obs.counter(self._sig_names[1])
         #: how long a replicated apply waits for backup acks before
         #: failing the write (sync replication among reachable replicas)
         self.repl_ack_timeout_s = 5.0
@@ -1455,6 +1564,88 @@ class PsShardServer:
                 return (epoch, self._install_gen, self.table.tobytes(),
                         windows)
 
+    # -- durable checkpoint (brpc_tpu.durable) ----------------------------
+
+    def attach_checkpoint(self, store, *, recover: bool = True):
+        """Attach a :class:`brpc_tpu.durable.CheckpointStore`: from here
+        on every applied generation is teed into its delta log under
+        the table write lock, wholesale installs and promotions fold
+        into fresh base snapshots, and replica reconnects go
+        hydrate-first through its tail.
+
+        With ``recover=True`` (the default) the store's on-disk state
+        is restored FIRST — base installed, delta bodies replayed
+        through the exact live-apply parse and arithmetic
+        (``_unpack_apply`` + ``subtract.at`` with this server's ``lr``),
+        writer windows merged — so the acked ledger continues bit for
+        bit across a cold start.  Either way a fresh base is snapshotted
+        before the tee arms: the delta chain always extends a base this
+        process wrote.  Returns the ``durable.RestorePoint`` (or None
+        when nothing was recovered)."""
+        point = store.restore() if recover else None
+        if point is not None:
+            if point.table.shape != (self.rows_per, self.dim):
+                raise ValueError(
+                    f"checkpoint geometry {point.table.shape} does not "
+                    f"match shard ({self.rows_per}, {self.dim})")
+            with self._repl_mu:
+                if point.epoch > self._epoch:
+                    self._epoch = point.epoch
+                with self._mu.write():
+                    self.table[:] = point.table
+                    with self._seq_mu:
+                        for w, q in point.windows.items():
+                            if q > self._writer_seqs.get(w, 0):
+                                self._writer_seqs[w] = q
+                            if q > self._writer_applied.get(w, 0):
+                                self._writer_applied[w] = q
+                    for _gen, body in point.deltas:
+                        windows, off = _unpack_windows(body)
+                        ids, grads = _unpack_apply(
+                            memoryview(body)[off:], self.base,
+                            self.rows_per, self.dim)
+                        if ids.size:
+                            np.subtract.at(self.table, ids,
+                                           self.lr * grads)
+                        if windows:
+                            with self._seq_mu:
+                                for w, q in windows.items():
+                                    if q > self._writer_seqs.get(w, 0):
+                                        self._writer_seqs[w] = q
+                                    if q > self._writer_applied.get(
+                                            w, 0):
+                                        self._writer_applied[w] = q
+                    self._install_gen = point.gen
+                    if self._shard is not None and not self._importing:
+                        self._shard.install(self.table,
+                                            self._install_gen)
+        epoch, gen, table, windows = self._replication_snapshot()
+        store.save_snapshot(
+            epoch, gen,
+            np.frombuffer(table, np.float32).reshape(self.rows_per,
+                                                     self.dim),
+            windows)
+        self._durable = store
+        return point
+
+    def _tee_delta(self, dur, gen: int, body: bytes) -> None:
+        """Tee one applied generation into the checkpoint store.
+        Called under the table WRITE lock, so log order is apply order.
+        A refused append (generation jump the delta framing cannot
+        express) or a compaction-due tail folds the current state into
+        a fresh base instead."""
+        if not dur.append_delta(gen, body) or dur.should_compact():
+            self._snapshot_to(dur, gen)
+
+    def _snapshot_to(self, dur, gen: int) -> None:
+        """Fold the CURRENT table into a new base.  Must run under the
+        table write lock — (gen, table, windows) are pinned; the epoch
+        is a racy read and a concurrent Promote re-snapshots on its own
+        once it lands."""
+        with self._seq_mu:
+            windows = dict(self._writer_applied)
+        dur.save_snapshot(self._epoch, gen, self.table, windows)
+
     def flush_replication(self, timeout_s: float = 5.0) -> None:
         """Blocks until every backup has ACKED everything applied so far
         (no-op for an unreplicated or backup server) — the zero-lost-
@@ -1515,10 +1706,15 @@ class PsShardServer:
                 self._install_gen += 1
                 new_gen = self._install_gen
                 rep = self._replicator
-                if rep is not None:
+                dur = self._durable
+                if rep is not None or dur is not None:
                     gids = (ids + self.base).astype(np.int32)
-                    rep.ship(new_gen, _pack_windows(windows)
-                             + bytes(_pack_apply_req(gids, grads)))
+                    rbody = _pack_windows(windows) + bytes(
+                        _pack_apply_req(gids, grads))
+                if rep is not None:
+                    rep.ship(new_gen, rbody)
+                if dur is not None:
+                    self._tee_delta(dur, new_gen, rbody)
             self._import_gens[src] = gen
             if windows:
                 with self._seq_mu:
@@ -1636,6 +1832,12 @@ class PsShardServer:
                             self._writer_seqs[w] = q
                         if q > self._writer_applied.get(w, 0):
                             self._writer_applied[w] = q
+            dur = self._durable
+            if dur is not None:
+                # A backup's checkpoint tees the propagated frames
+                # verbatim: a promoted backup restarts with the same
+                # durable ledger the primary had.
+                self._tee_delta(dur, gen, bytes(body))
             return gen
 
     # -- request handling --------------------------------------------------
@@ -1662,6 +1864,18 @@ class PsShardServer:
         except wire.WireError:
             _reject_frame(method)
             raise
+        except rpc.RpcError as e:
+            if e.code == resilience.EDEADLINE:
+                # Per-SERVER shed mark: SchemeInfo reports it alongside
+                # the limiter gate sheds as the rebalancer's
+                # tail-pressure input.
+                self._sheds.add(1)
+            raise
+        if method in self.LIMITED_METHODS:
+            # Per-server data-plane latency — the SchemeInfo p99 the
+            # rebalancer consumes (per server, unlike the process-wide
+            # per-shard-index recorders above).
+            self._lat.record((time.monotonic_ns() - t0) / 1e9)
         _record_ps_server(self.shard_index, method,
                           self._payload_keys(method, payload),
                           len(payload), len(rsp), t0)
@@ -1789,11 +2003,16 @@ class PsShardServer:
                             self._writer_applied[w] = q
             rep = self._replicator
             mig = self._migrator
-            if rep is not None or mig is not None:
+            dur = self._durable
+            if rep is not None or mig is not None or dur is not None:
                 gids = (ids + self.base).astype(np.int32)
+            if rep is not None or dur is not None:
+                body = _pack_windows(updates) + bytes(
+                    _pack_apply_req(gids, grads))
             if rep is not None:
-                rep.ship(gen, _pack_windows(updates)
-                         + bytes(_pack_apply_req(gids, grads)))
+                rep.ship(gen, body)
+            if dur is not None:
+                self._tee_delta(dur, gen, body)
             if mig is not None:
                 # Live reshard: the successor scheme's shards subscribe
                 # to this shard's applied batches (range-filtered by the
@@ -1897,6 +2116,17 @@ class PsShardServer:
                 self._install_migrator(pending)
                 if obs.enabled():
                     obs.counter("ps_migration_redrives").add(1)
+            dur = self._durable
+            if dur is not None:
+                # Make the new reign durable: an epoch-only change has
+                # no delta record, so fold it into a fresh base.  This
+                # also re-bases the store, which pushes any peer with a
+                # possibly-divergent history out of the hydrate window.
+                e2, g2, tbl, w2 = self._replication_snapshot()
+                dur.save_snapshot(
+                    e2, g2,
+                    np.frombuffer(tbl, np.float32).reshape(
+                        self.rows_per, self.dim), w2)
             return struct.pack("<qq", self._epoch, self._install_gen)
         if method == "Sync":
             epoch, gen, count = wire.read("<qqq", payload, 0, "Sync.hdr")
@@ -1932,6 +2162,11 @@ class PsShardServer:
                     with self._seq_mu:
                         self._writer_seqs = dict(windows)
                         self._writer_applied = dict(windows)
+                    dur = self._durable
+                    if dur is not None:
+                        # A wholesale install jumps the generation — the
+                        # delta framing cannot express it, so re-base.
+                        self._snapshot_to(dur, gen)
             return b""
         if method == "WriterSeq":
             # Applied high-water for one writer + current gen: the
@@ -1953,6 +2188,11 @@ class PsShardServer:
         if method == "SchemeInfo":
             with self._mu.read():
                 gen = self._install_gen
+            shed = int(self._sheds.get_value())
+            lim = self.limiter
+            if lim is not None:
+                shed += sum(int(g.get("shed", 0))
+                            for g in lim.snapshot().values())
             return json.dumps({
                 "scheme": self.scheme_version,
                 "importing": self._importing,
@@ -1964,6 +2204,11 @@ class PsShardServer:
                 "epoch": self._epoch,
                 "addr": self.address,
                 "table_bytes": self.rows_per * self.dim * 4,
+                # Tail-pressure inputs (RebalancePolicy): data-plane
+                # handler p99 on THIS server and its cumulative shed
+                # count (deadline admission + limiter gates).
+                "p99_us": self._lat.percentile(0.99),
+                "shed": shed,
             }).encode()
         if method == "MigrateStart":
             # Begin streaming this shard's rows to the successor
@@ -2122,6 +2367,13 @@ class PsShardServer:
                                 self._writer_seqs[w] = q
                             if q > self._writer_applied.get(w, 0):
                                 self._writer_applied[w] = q
+                dur = self._durable
+                if dur is not None:
+                    # The range overwrite jumped the generation: re-base
+                    # the checkpoint (which also pushes this shard's
+                    # backups out of the hydrate window — they really do
+                    # need the wholesale resync below).
+                    self._snapshot_to(dur, sync_gen)
             if rep is not None:
                 # A wholesale range overwrite is inexpressible in the
                 # delta framing: force this destination's backups
@@ -2299,6 +2551,9 @@ class PsShardServer:
         for name in self._gauge_names:
             obs.drop_var(name)
         self._gauge_names = ()
+        for name in self._sig_names:
+            obs.drop_var(name)
+        self._sig_names = ()
 
 
 class _TableGen:

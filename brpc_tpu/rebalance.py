@@ -58,7 +58,16 @@ class RebalanceOptions:
     continuously before it may act; ``min_interval_s`` separates
     consecutive topology changes (a migration's cost is amortized over
     at least this long).  ``failback_sustain_s`` is deliberately
-    shorter — promoting the declared primary back moves no data."""
+    shorter — promoting the declared primary back moves no data.
+
+    ``split_p99_ms`` / ``split_shed_per_s`` are the TAIL-PRESSURE
+    inputs (0.0 = disabled): a shard whose data-plane p99 or shed rate
+    (deadline admission + limiter gates, from ``SchemeInfo``) sustains
+    above the threshold splits even when its raw qps sits below
+    ``split_qps`` — saturation shows up as latency and sheds first.
+    Tail pressure also VETOES a merge: a shard can be slow precisely
+    because it is starved of capacity, and halving capacity on a
+    low-qps-high-latency signal would be the wrong direction."""
 
     split_qps: float = 200.0
     merge_qps: float = 20.0
@@ -68,6 +77,8 @@ class RebalanceOptions:
     min_shards: int = 1
     failback: bool = True
     failback_sustain_s: float = 0.5
+    split_p99_ms: float = 0.0
+    split_shed_per_s: float = 0.0
 
     def __post_init__(self):
         if self.merge_qps * 2 > self.split_qps:
@@ -138,14 +149,20 @@ class RebalancePolicy:
     # -- the decision function --------------------------------------------
 
     def decide(self, num_shards: int, shard_qps: Sequence[float], *,
-               misplaced: Sequence[Tuple[int, str]] = ()
+               misplaced: Sequence[Tuple[int, str]] = (),
+               shard_p99_ms: Sequence[float] = (),
+               shed_per_s: Sequence[float] = ()
                ) -> Optional[Decision]:
         """``shard_qps[s]`` is shard ``s``'s observed rate;
         ``misplaced`` lists ``(shard, declared_primary_addr)`` pairs
         whose current primary is NOT the declared one and whose
         declared one is caught up (the daemon verifies reachability
-        and generation before reporting one).  Priority: failback
-        (cheap, no data moves) over split over merge."""
+        and generation before reporting one).  ``shard_p99_ms`` /
+        ``shed_per_s`` are the optional tail-pressure signals (worst
+        replica data-plane p99 per shard, shed rate per shard) — only
+        consulted when the corresponding option threshold is set.
+        Priority: failback (cheap, no data moves) over split over
+        merge."""
         opt = self.opt
         if opt.failback and misplaced:
             s, addr = misplaced[0]
@@ -160,20 +177,34 @@ class RebalancePolicy:
                       if k.startswith("failback:")]:
                 self._since.pop(k)
         hot = max(shard_qps, default=0.0)
+        hot_p99 = max(shard_p99_ms, default=0.0)
+        hot_shed = max(shed_per_s, default=0.0)
+        pressure = ((opt.split_p99_ms > 0.0
+                     and hot_p99 > opt.split_p99_ms)
+                    or (opt.split_shed_per_s > 0.0
+                        and hot_shed > opt.split_shed_per_s))
         split_cond = (num_shards * 2 <= opt.max_shards
-                      and hot > opt.split_qps)
+                      and (hot > opt.split_qps or pressure))
         split_due = self._sustained("split", split_cond, opt.sustain_s)
         cold = max(shard_qps, default=0.0)
         merge_cond = (num_shards > opt.min_shards
                       and num_shards % 2 == 0
-                      and cold < opt.merge_qps)
+                      and cold < opt.merge_qps
+                      and not pressure)
         merge_due = self._sustained("merge", merge_cond, opt.sustain_s)
         if self._in_cooldown():
             return None
         if split_due:
+            if hot > opt.split_qps:
+                why = (f"hottest shard at {hot:.1f}/s > split "
+                       f"threshold {opt.split_qps}")
+            else:
+                why = (f"tail pressure: p99 {hot_p99:.1f}ms / shed "
+                       f"{hot_shed:.1f}/s over thresholds "
+                       f"(p99>{opt.split_p99_ms}ms, "
+                       f"shed>{opt.split_shed_per_s}/s)")
             return Decision("split", num_shards=num_shards * 2,
-                            reason=f"hottest shard at {hot:.1f}/s > "
-                                   f"split threshold {opt.split_qps}")
+                            reason=why)
         if merge_due:
             return Decision("merge", num_shards=num_shards // 2,
                             reason=f"every shard below "
@@ -229,6 +260,9 @@ class Rebalancer(threading.Thread):
         #: last (reads+gen, monotonic instant) sample per (version,
         #: shard) — rate signals are deltas between polls
         self._samples: Dict[tuple, Tuple[int, float]] = {}
+        #: last (shed total, monotonic instant) per (version, shard) —
+        #: the shed-rate half of the tail-pressure signal
+        self._shed_samples: Dict[tuple, Tuple[int, float]] = {}
         self.actions: List[Decision] = []
         #: failed executions, newest last (bounded) — the observable
         #: trail behind ps_rebalance_errors
@@ -291,6 +325,8 @@ class Rebalancer(threading.Thread):
         scheme = max(active, key=lambda sc: sc.version)
         claims = parse_claims(nodes)
         rates: List[float] = []
+        p99s: List[float] = []
+        sheds: List[float] = []
         misplaced: List[Tuple[int, str]] = []
         now = time.monotonic()
         for s in range(scheme.num_shards):
@@ -301,6 +337,8 @@ class Rebalancer(threading.Thread):
             reads = 0
             gen = 0
             reachable = 0
+            p99_us = 0.0
+            shed_total = 0
             for a in scheme.replica_sets[s].addresses:
                 try:
                     info = json.loads(self._chan(a).call(
@@ -311,8 +349,14 @@ class Rebalancer(threading.Thread):
                 reachable += 1
                 reads += int(info.get("reads", 0))
                 gen = max(gen, int(info.get("gen", 0)))
+                # worst replica's data-plane p99 + the shard's total
+                # shed count: the tail-pressure inputs
+                p99_us = max(p99_us, float(info.get("p99_us", 0.0)))
+                shed_total += int(info.get("shed", 0))
             if not reachable:
                 rates.append(0.0)
+                p99s.append(0.0)
+                sheds.append(0.0)
                 continue
             total = reads + gen
             key = (scheme.version, s)
@@ -322,6 +366,14 @@ class Rebalancer(threading.Thread):
                 rates.append(0.0)
             else:
                 rates.append((total - prev[0]) / (now - prev[1]))
+            p99s.append(p99_us / 1000.0)
+            sprev = self._shed_samples.get(key)
+            self._shed_samples[key] = (shed_total, now)
+            if sprev is None or now <= sprev[1] or \
+                    shed_total < sprev[0]:
+                sheds.append(0.0)
+            else:
+                sheds.append((shed_total - sprev[0]) / (now - sprev[1]))
             declared = scheme.replica_sets[s].addresses[
                 scheme.replica_sets[s].primary]
             if cur is not None and cur != declared:
@@ -339,8 +391,9 @@ class Rebalancer(threading.Thread):
                     # the declared primary is back, demoted, and holds
                     # everything the usurper holds: safe to fail back
                     misplaced.append((s, declared))
-        return {"scheme": scheme, "rates": rates,
-                "misplaced": misplaced, "claims": claims}
+        return {"scheme": scheme, "rates": rates, "p99s": p99s,
+                "sheds": sheds, "misplaced": misplaced,
+                "claims": claims}
 
     def step(self) -> Optional[Decision]:
         """One full cycle; returns the executed decision, if any."""
@@ -349,7 +402,9 @@ class Rebalancer(threading.Thread):
             return None
         scheme: PartitionScheme = view["scheme"]
         decision = self.policy.decide(scheme.num_shards, view["rates"],
-                                      misplaced=view["misplaced"])
+                                      misplaced=view["misplaced"],
+                                      shard_p99_ms=view["p99s"],
+                                      shed_per_s=view["sheds"])
         if decision is None:
             return None
         self.log.append(
@@ -437,9 +492,22 @@ class Rebalancer(threading.Thread):
                             decision.shard))
         if claim is not None:
             epochs.append(int(claim[0]))
-        self._chan(decision.addr).call(
-            "Ps", "Promote", struct.pack("<q", max(epochs) + 1),
-            timeout_ms=self.timeout_ms)
+        try:
+            self._chan(decision.addr).call(
+                "Ps", "Promote", struct.pack("<q", max(epochs) + 1),
+                timeout_ms=self.timeout_ms)
+        except rpc.RpcError as e:
+            if e.code == resilience.EFENCED:
+                # Lost a Promote race: a client failover (or another
+                # rebalancer) claimed a higher epoch between our epoch
+                # sweep and the call.  Benign — the next tick
+                # re-observes placement against the winner's epoch —
+                # so re-resolve QUIETLY behind a counter instead of
+                # surfacing an error (PR-13 residue).
+                if obs.enabled():
+                    obs.counter("ps_promote_races").add(1)
+                return
+            raise
         if obs.enabled():
             obs.counter("ps_failbacks").add(1)
 

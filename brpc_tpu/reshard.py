@@ -52,7 +52,8 @@ from brpc_tpu.analysis.race import checked_lock
 from brpc_tpu.naming import (NamingClient, PartitionScheme,
                              publish_scheme)
 from brpc_tpu.ps_remote import (_pack_apply_req, _pack_stream_frame,
-                                _pack_windows, _reject_frame)
+                                _pack_windows, _reject_frame,
+                                _unpack_apply, _unpack_windows)
 
 
 class _ShipperAckReceiver:
@@ -308,6 +309,96 @@ class MigrationShipper:
         self._ack_ev.set()
         if obs.enabled():
             obs.counter("ps_migrate_syncs_out").add(1)
+            obs.counter("ps_migrate_sync_bytes").add(len(rows))
+        return True
+
+    def _try_hydrate(self, t: _TargetState) -> Optional[bool]:
+        """Hydrate-first (re)connect: a destination already seeded from
+        the source's checkpoint store (``durable.hydrate_destination``)
+        — or surviving a stream blip — advertises its per-source
+        watermark in the ``MigrateApply`` setup answer; when that
+        watermark sits inside the store's delta window, ship only the
+        range-filtered TAIL from disk instead of snapshotting and
+        wholesaling the live rows.  Returns True on success, False on a
+        hard failure, None to fall through to the wholesale
+        ``_connect``."""
+        store = getattr(self._server, "_durable", None)
+        if store is None:
+            return None
+        src = self._server.address.encode()
+        ch = self._channel(t.addr)
+        if ch is None:
+            return False
+        try:
+            st = ch.stream("Ps", "MigrateApply",
+                           struct.pack("<q", self.scheme)
+                           + struct.pack("<i", len(src)) + src,
+                           receiver=_ShipperAckReceiver(self, t.addr))
+        except rpc.RpcError as e:
+            if e.code == resilience.ESCHEMEMOVED:
+                with self._mu:
+                    t.refused = True
+                self._ack_ev.set()
+                return False
+            with self._mu:
+                t.down = True
+            self._ack_ev.set()
+            if obs.enabled():
+                obs.counter("ps_migrate_connect_errors").add(1)
+            return False
+        try:
+            (mark,) = wire.read("<q", st.response, 0,
+                                "MigrateApply.rsp")
+        except wire.WireError:
+            st.close()
+            return None
+        if mark < 0:
+            st.close()
+            return None   # never seeded: only the wholesale path may
+        deltas = store.tail_since(mark)
+        if deltas is None or mark > store.last_gen:
+            st.close()
+            return None   # watermark outside the delta window
+        # Delta bodies carry GLOBAL ids across the whole source shard;
+        # parse against the source range, then re-filter per target —
+        # the destination's parser rejects out-of-range ids.
+        glast = mark        # last source gen RELEVANT to this target
+        slast = mark        # last source gen covered (relevant or not)
+        tail_bytes = 0
+        try:
+            for gen, body in deltas:
+                windows, off = _unpack_windows(body)
+                gids, grads = _unpack_apply(
+                    memoryview(body)[off:], 0,
+                    self._server.base + self._server.rows_per,
+                    self._server.dim)
+                slast = gen
+                mask = (gids >= t.base) & (gids < t.base + t.rows)
+                if not mask.any():
+                    continue
+                frame = bytes(_pack_stream_frame(
+                    gen, self.scheme, gen,
+                    _pack_windows(windows) + bytes(_pack_apply_req(
+                        gids[mask].astype(np.int32), grads[mask]))))
+                st.write(frame)
+                tail_bytes += len(frame)
+                glast = gen
+        except (rpc.RpcError, wire.WireError):
+            st.close()
+            return None   # bad tail or dead stream: wholesale converges
+        with self._mu:
+            t.stream = st
+            t.synced_gen = slast
+            t.need_sync = False
+            t.down = False
+            if mark > t.acked_gen:
+                t.acked_gen = mark   # the seed watermark IS an ack
+            if glast > t.last_gen:
+                t.last_gen = glast
+        self._ack_ev.set()
+        if obs.enabled():
+            obs.counter("ps_migrate_hydrates").add(1)
+            obs.counter("ps_migrate_hydrate_tail_bytes").add(tail_bytes)
         return True
 
     def _worker(self, t: _TargetState) -> None:
@@ -326,7 +417,10 @@ class MigrationShipper:
                 old, t.stream = t.stream, None
                 if old is not None:
                     old.close()   # rx stream: close (abort strands relay)
-                if self._connect(t):
+                ok = self._try_hydrate(t)
+                if ok is None:
+                    ok = self._connect(t)
+                if ok:
                     fails = 0
                 else:
                     if self._stop.is_set() or t.refused:

@@ -73,6 +73,19 @@ DEADLINE_MAGIC2 = 0x7EAD11E6
 #: schema ``press_header``)
 PRESS_MAGIC = 0x31535250
 
+#: first-int32 sentinel of a checkpoint base snapshot file ("SNAP"
+#: little-endian, schema ``ckpt_snap``).  Like the deadline magics it
+#: sits above MAX_WIRE_COUNT, so no legitimate count field collides.
+CKPT_SNAP_MAGIC = 0x50414E53
+
+#: first-int32 sentinel of one delta-log record ("DLT1" little-endian,
+#: schema ``ckpt_delta``)
+CKPT_DELTA_MAGIC = 0x31544C44
+
+#: first-int32 sentinel of the compaction marker file ("CMK1"
+#: little-endian, schema ``ckpt_marker``)
+CKPT_MARKER_MAGIC = 0x314B4D43
+
 
 class WireError(ValueError):
     """Malformed frame, rejected by a bounds/validity check BEFORE any
@@ -453,7 +466,8 @@ schema(
     "replica_apply_setup",
     Int("epoch"),
     doc="ReplicaApply stream setup: the sender's fencing epoch",
-    pack_sites=("ps_remote._Replicator._connect",),
+    pack_sites=("ps_remote._Replicator._connect",
+                "ps_remote._Replicator._try_hydrate"),
     unpack_sites=("ps_remote.PsShardServer._serve_stream_setup",))
 
 schema(
@@ -461,7 +475,8 @@ schema(
     Int("epoch"), Int("gen"), Int("count"),
     Array("table", "<f4", "count"), Tail("windows", schema="windows"),
     doc="replication Sync: epoch ++ gen ++ f32 count ++ table ++ windows",
-    pack_sites=("ps_remote._Replicator._connect",),
+    pack_sites=("ps_remote._Replicator._connect",
+                "durable.hydrate_replica"),
     unpack_sites=("ps_remote.PsShardServer._serve_control",))
 
 schema(
@@ -486,14 +501,16 @@ schema(
     Tail("windows", schema="windows"),
     doc="MigrateSync: range handoff header ++ source addr ++ rows ++ "
         "windows",
-    pack_sites=("reshard.MigrationShipper._connect",),
+    pack_sites=("reshard.MigrationShipper._connect",
+                "durable.hydrate_destination"),
     unpack_sites=("ps_remote.PsShardServer._serve_control",))
 
 schema(
     "migrate_apply_setup",
     Int("scheme"), Int("alen", "<i"), Bytes("src", "alen"),
     doc="MigrateApply stream setup: successor scheme ++ source addr",
-    pack_sites=("reshard.MigrationShipper._connect",),
+    pack_sites=("reshard.MigrationShipper._connect",
+                "reshard.MigrationShipper._try_hydrate"),
     unpack_sites=("ps_remote.PsShardServer._serve_stream_setup",))
 
 schema(
@@ -575,6 +592,45 @@ schema(
     unpack_sites=("press._unpack_press_record",),
     exact_sites=("press._pack_press_record",
                  "press._unpack_press_record"))
+
+schema(
+    "ckpt_snap",
+    Int("magic", "<i"), Int("version", "<i"), Int("epoch"), Int("gen"),
+    Int("rows", "<i"), Int("dim", "<i"), Int("crc"), Int("count"),
+    Array("table", "<f4", "count"), Tail("windows", schema="windows"),
+    doc="checkpoint base snapshot file (brpc_tpu.durable): "
+        "CKPT_SNAP_MAGIC ++ format version ++ fencing epoch ++ "
+        "generation ++ table geometry ++ crc32 of everything after the "
+        "header ++ f32 element count ++ the table image ++ writer "
+        "dedup windows — restore parses disk bytes as hostile input, "
+        "so torn/bit-flipped files must answer a clean reject",
+    pack_sites=("durable._pack_snapshot",),
+    unpack_sites=("durable._unpack_snapshot",),
+    exact_sites=("durable._pack_snapshot", "durable._unpack_snapshot"))
+
+schema(
+    "ckpt_delta",
+    Int("magic", "<i"), Int("gen"), Int("crc"), Int("blen", "<i"),
+    Bytes("body", "blen"),
+    doc="one delta-log record (brpc_tpu.durable): CKPT_DELTA_MAGIC ++ "
+        "the generation this batch produced ++ crc32 of the body ++ "
+        "body length ++ a replica_apply_body frame (windows ++ "
+        "apply_req) — the ReplicaApply framing teed to disk, so "
+        "apply order is log order",
+    pack_sites=("durable._pack_delta",),
+    unpack_sites=("durable._unpack_delta",),
+    exact_sites=("durable._pack_delta", "durable._unpack_delta"))
+
+schema(
+    "ckpt_marker",
+    Int("magic", "<i"), Int("version", "<i"), Int("base_gen"),
+    doc="compaction marker file (brpc_tpu.durable): the generation of "
+        "the newest durable base snapshot — advisory cross-check only "
+        "(restore trusts the validated snapshots themselves), so a "
+        "stale marker after a crash mid-compaction is tolerated",
+    pack_sites=("durable._pack_marker",),
+    unpack_sites=("durable._unpack_marker",),
+    exact_sites=("durable._pack_marker", "durable._unpack_marker"))
 
 schema(
     "writer_seq_rsp",

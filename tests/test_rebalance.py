@@ -151,6 +151,74 @@ def test_failback_can_be_disabled():
 
 
 # ---------------------------------------------------------------------------
+# tail-pressure signals: p99 / shed rate as split triggers (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def test_tail_pressure_p99_splits_without_qps():
+    pol, clock = _policy(split_p99_ms=50.0)
+    # qps WELL below the split threshold: only the p99 signal is hot
+    assert pol.decide(2, [10.0, 5.0],
+                      shard_p99_ms=[80.0, 1.0]) is None   # first sight
+    clock.advance(1.1)
+    d = pol.decide(2, [10.0, 5.0], shard_p99_ms=[80.0, 1.0])
+    assert d is not None and d.kind == "split" and d.num_shards == 4
+    assert "tail pressure" in d.reason
+
+
+def test_tail_pressure_shed_rate_splits_without_qps():
+    pol, clock = _policy(split_shed_per_s=5.0)
+    assert pol.decide(2, [10.0, 5.0],
+                      shed_per_s=[20.0, 0.0]) is None
+    clock.advance(1.1)
+    d = pol.decide(2, [10.0, 5.0], shed_per_s=[20.0, 0.0])
+    assert d is not None and d.kind == "split"
+    assert "tail pressure" in d.reason
+
+
+def test_tail_pressure_requires_sustain_like_qps():
+    pol, clock = _policy(split_p99_ms=50.0)
+    for _ in range(10):
+        # flapping p99 never acts: hot sample, then a cold one resets
+        assert pol.decide(2, [10.0, 5.0],
+                          shard_p99_ms=[80.0, 1.0]) is None
+        clock.advance(0.6)
+        assert pol.decide(2, [10.0, 5.0],
+                          shard_p99_ms=[5.0, 1.0]) is None
+        clock.advance(0.6)
+
+
+def test_tail_pressure_vetoes_merge():
+    clock = FakeClock()
+    pol = RebalancePolicy(
+        RebalanceOptions(split_qps=100.0, merge_qps=10.0, sustain_s=1.0,
+                         min_interval_s=5.0, max_shards=4,
+                         split_p99_ms=50.0), clock=clock)
+    clock.advance(10.0)
+    # 4 shards, qps cold enough to merge — but one shard's tail is on
+    # fire: shrinking the fleet under pressure would make it worse
+    for _ in range(4):
+        assert pol.decide(4, [1.0, 1.0, 1.0, 1.0],
+                          shard_p99_ms=[80.0, 1.0, 1.0, 1.0]) is None
+        clock.advance(1.1)
+    # pressure clears: merge sustain starts fresh, then fires
+    assert pol.decide(4, [1.0, 1.0, 1.0, 1.0],
+                      shard_p99_ms=[5.0, 1.0, 1.0, 1.0]) is None
+    clock.advance(1.1)
+    d = pol.decide(4, [1.0, 1.0, 1.0, 1.0],
+                   shard_p99_ms=[5.0, 1.0, 1.0, 1.0])
+    assert d is not None and d.kind == "merge"
+
+
+def test_tail_pressure_knobs_default_off():
+    pol, clock = _policy()                 # both thresholds at 0.0
+    for _ in range(4):
+        clock.advance(1.1)
+        # enormous signals are IGNORED until a threshold is configured
+        assert pol.decide(2, [10.0, 5.0], shard_p99_ms=[9999.0, 0.0],
+                          shed_per_s=[9999.0, 0.0]) is None
+
+
+# ---------------------------------------------------------------------------
 # the daemon end to end (native)
 # ---------------------------------------------------------------------------
 
