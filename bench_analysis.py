@@ -137,6 +137,39 @@ def _bench_handles() -> dict:
     return out
 
 
+def _bench_native_extract() -> dict:
+    """Cross-language tier throughput: how fast the clang-free C++
+    extractor + the three native checks sweep the whole ``cpp/capi``
+    surface (files/sec over full check runs), and the current in-tree
+    findings count (0 = the ABI contract holds)."""
+    from brpc_tpu.analysis import native
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    files = native.default_cpp_files(root)
+    if not files:
+        return {"skipped": "no cpp/capi tree next to this script"}
+    repeats, best = 5, float("inf")
+    findings = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        findings = native.run_native_checks(files, root)
+        best = min(best, time.perf_counter() - t0)
+    total_lines = 0
+    for p in files:
+        with open(p, "r", encoding="utf-8") as f:
+            total_lines += sum(1 for _ in f)
+    return {
+        "unit": "full wire-contract-native + native-errors + "
+                "native-handle-balance sweep",
+        "files": len(files),
+        "source_lines": total_lines,
+        "sweep_s": round(best, 4),
+        "files_per_sec": round(len(files) / best, 1),
+        "lines_per_sec": round(total_lines / best, 1),
+        "findings": len(findings),
+    }
+
+
 def _bench_fuzz() -> dict:
     """Fuzz throughput per parser (execs/sec, memcheck off — the raw
     mutation+parse loop): how much hostile-input coverage one core buys
@@ -196,6 +229,7 @@ def main() -> dict:
         "ops_per_measurement": n,
         "handle_ledger": _bench_handles(),
         "fuzz": _bench_fuzz(),
+        "native_extract": _bench_native_extract(),
     }
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -3,8 +3,8 @@
 The reference enforces its concurrency contracts with purpose-built
 tooling (contention profiler, bthread diagnostics, builtin hazard pages);
 this is the equivalent static pass for the hazards our fabric creates.
-Eight checks, each encoding an invariant the runtime cannot enforce, the
-concurrency ones interprocedural over the whole-package call graph
+Eleven checks, each encoding an invariant the runtime cannot enforce,
+the concurrency ones interprocedural over the whole-package call graph
 (:mod:`brpc_tpu.analysis.callgraph` — the lockdep/TSan polarity: follow
 the calls, not the file):
 
@@ -48,8 +48,10 @@ the calls, not the file):
   the only detector.  ``checked_rwlock`` participates too: both
   ``.read()`` and ``.write()`` contexts acquire under the lock's one
   name, matching the dynamic graph's keying.  Locks resolve through
-  module/class/parameter bindings AND module-level literal dict
-  containers (``LOCKS["a"]`` binds by key).
+  module/class/parameter bindings AND literal dict containers at
+  module scope (``LOCKS["a"]``) or class scope (``self.LOCKS["a"]``) —
+  constant keys bind by key; dynamic keys and mutated containers stay
+  unresolved (dynamic-harness territory).
 - ``fiber-blocking-sleep`` — a bare ``time.sleep`` anywhere
   handler-reachable (interprocedural, same walk as
   ``fiber-shared-state``) parks the fiber worker PTHREAD, not just the
@@ -71,8 +73,12 @@ the calls, not the file):
   flow analysis is may-leak at explicit exits (an early ``return``
   with a live handle is THE classic leak) and trusts a release seen on
   any branch (the guard idiom) — no false positives from merges.
-  Exception paths (``raise``, a callee throwing) are out of scope
-  (ROADMAP deferral).  The ABI half audits ``rpc._load()``'s restype
+  Exception paths are in scope for explicit ``raise``: a handle
+  acquired and still live at a ``raise`` is a leak unless a
+  ``finally`` or an enclosing ``except`` handler releases it
+  (try/except joins are modeled like the existing try/finally
+  support); implicit throws from callees remain out of scope.  The
+  ABI half audits ``rpc._load()``'s restype
   registry itself: every ``c_void_p``-returning constructor symbol
   needs its destroy symbol declared.  The dynamic complement is the
   handle ledger (:mod:`brpc_tpu.analysis.handles`,
@@ -81,14 +87,33 @@ the calls, not the file):
   every hand-rolled framing: ``_pack_X``/``_unpack_X`` pairs must move
   the same field stream (order + width), every site registered in
   :mod:`brpc_tpu.wire`'s schema registry must match its declared
-  scalar sequence (exactly for dedicated functions, in-order
-  subsequence for shared multi-frame handlers), struct formats must be
+  scalar sequence (exactly for dedicated functions; shared multi-frame
+  handlers like ``_serve_control`` are checked by **exact segmented
+  matching** — each schema binds to its dispatch-discriminant branch
+  via the schema's ``segments`` declaration and that branch's stream
+  must equal the schema exactly, with in-order subsequence only the
+  fallback for shared sites with no segment key), struct formats must
+  be
   explicit little-endian, counts/lengths read off the wire on
   handler-reachable parse paths must reach a bounds check before they
   drive a size/loop, and every declared schema/text parser must have a
   fuzz target (:mod:`brpc_tpu.analysis.fuzz` — the "fuzzers for every
   parser" gate).  The dynamic complement is the structure-aware fuzzer
   itself.
+- ``wire-contract-native`` / ``native-errors`` /
+  ``native-handle-balance`` — the cross-language tier
+  (:mod:`brpc_tpu.analysis.native`): a clang-free tokenizer +
+  function-body extractor over ``cpp/capi/*.cc`` checks every
+  ``wire.REGISTRY`` schema with a declared ``native_sites`` twin
+  field-for-field against the C++ parser's extracted read sequence
+  (widths, order, literal offsets, count-before-bounds, magic
+  sentinels; stale site declarations and undeclared native parsers are
+  findings too), resolves every ``SetFailed`` constant against
+  ``errors.h``/errno and holds serve-path handlers to the live
+  fuzzer's sanctioned code set (static/dynamic parity), and flags
+  ``handle_inc`` ledger bumps left unbalanced on native error-return
+  paths.  These run only when the scan covers the real package (the
+  native tree is located relative to ``brpc_tpu/``).
 
 Findings carry a stable id (hash of check + package-relative path +
 message, deliberately line-free) so CI can diff against an accepted
@@ -120,7 +145,13 @@ __all__ = ["Finding", "run_lint", "lint_files", "main", "ALL_CHECKS",
 
 ALL_CHECKS = ("ctypes-contract", "fiber-shared-state", "obs-guard",
               "trace-purity", "lock-order", "fiber-blocking-sleep",
-              "handle-lifecycle", "wire-contract")
+              "handle-lifecycle", "wire-contract",
+              "wire-contract-native", "native-errors",
+              "native-handle-balance")
+
+#: checks implemented by the cross-language tier (analysis.native)
+_NATIVE_CHECKS = ("wire-contract-native", "native-errors",
+                  "native-handle-balance")
 
 #: checks that need the whole-package call graph
 _GRAPH_CHECKS = {"fiber-shared-state", "trace-purity", "lock-order",
@@ -205,10 +236,13 @@ _ABI_NEW_PAIRS = {
 
 
 def _stable_path(path: str) -> str:
-    """Package-relative posix path (machine-independent id component)."""
+    """Package-relative posix path (machine-independent id component).
+    Native-tier findings anchor on ``cpp/`` the same way Python ones
+    anchor on ``brpc_tpu/``."""
     parts = os.path.normpath(path).replace(os.sep, "/").split("/")
-    if "brpc_tpu" in parts:
-        return "/".join(parts[parts.index("brpc_tpu"):])
+    for anchor in ("brpc_tpu", "cpp"):
+        if anchor in parts:
+            return "/".join(parts[parts.index(anchor):])
     return parts[-1]
 
 
@@ -1031,16 +1065,22 @@ def _walk_traced(root_sc: _FileScan, root_fn: ast.AST, root_name: str,
 def _collect_checked_locks(scans: List[_FileScan], graph: CallGraph
                            ) -> Tuple[Dict[str, Dict[str, str]],
                                       Dict[Tuple[str, str], Dict[str, str]],
-                                      Dict[str, Dict[str, Dict[str, str]]]]:
+                                      Dict[str, Dict[str, Dict[str, str]]],
+                                      Dict[Tuple[str, str],
+                                           Dict[str, Dict[str, str]]]]:
     """Map ``x = checked_lock("name")`` assignments to lock names:
-    per-module ``var -> name``, per-class ``self.attr -> name``, and
+    per-module ``var -> name``, per-class ``self.attr -> name``,
     per-module literal-dict CONTAINERS ``var -> {key -> name}`` (a
     module-level ``LOCKS = {"a": checked_lock(...), "b": A}`` makes
-    ``LOCKS["a"]`` resolvable by key)."""
+    ``LOCKS["a"]`` resolvable by key), and per-CLASS literal-dict
+    containers ``(module, cls) -> attr -> {key -> name}`` (a class-scope
+    ``LOCKS = {...}`` makes ``self.LOCKS["a"]`` resolvable the same
+    way)."""
     mi_by_path = {mi.path: mi for mi in graph.modules.values()}
     mod_locks: Dict[str, Dict[str, str]] = {}
     cls_locks: Dict[Tuple[str, str], Dict[str, str]] = {}
     cont_locks: Dict[str, Dict[str, Dict[str, str]]] = {}
+    ccont_locks: Dict[Tuple[str, str], Dict[str, Dict[str, str]]] = {}
 
     def lock_name(value: ast.AST) -> Optional[str]:
         if isinstance(value, ast.Call) and \
@@ -1087,12 +1127,10 @@ def _collect_checked_locks(scans: List[_FileScan], graph: CallGraph
         mi = mi_by_path.get(sc.path)
         if mi is None:
             continue
-        for stmt in sc.tree.body:
-            if not isinstance(stmt, ast.Assign) or \
-                    not isinstance(stmt.value, ast.Dict):
-                continue
+
+        def dict_entries(value: ast.Dict) -> Dict[str, str]:
             entries: Dict[str, str] = {}
-            for k, v in zip(stmt.value.keys, stmt.value.values):
+            for k, v in zip(value.keys, value.values):
                 if not (isinstance(k, ast.Constant)
                         and isinstance(k.value, str)):
                     continue
@@ -1101,12 +1139,34 @@ def _collect_checked_locks(scans: List[_FileScan], graph: CallGraph
                     name = mod_locks.get(mi.name, {}).get(v.id)
                 if name is not None:
                     entries[k.value] = name
-            if entries:
-                for tgt in stmt.targets:
-                    if isinstance(tgt, ast.Name):
-                        cont_locks.setdefault(mi.name, {})[tgt.id] = \
-                            entries
-    return mod_locks, cls_locks, cont_locks
+            return entries
+
+        for stmt in sc.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Dict):
+                entries = dict_entries(stmt.value)
+                if entries:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            cont_locks.setdefault(
+                                mi.name, {})[tgt.id] = entries
+            elif isinstance(stmt, ast.ClassDef):
+                # class-scope literal dicts: `self.LOCKS["a"]` binds by
+                # key exactly like the module-level form (direct class
+                # body only — no inheritance walk; a subclass override
+                # would shadow the mapping anyway)
+                for inner in stmt.body:
+                    if not (isinstance(inner, ast.Assign)
+                            and isinstance(inner.value, ast.Dict)):
+                        continue
+                    entries = dict_entries(inner.value)
+                    if entries:
+                        for tgt in inner.targets:
+                            if isinstance(tgt, ast.Name):
+                                ccont_locks.setdefault(
+                                    (mi.name, stmt.name),
+                                    {})[tgt.id] = entries
+    return mod_locks, cls_locks, cont_locks, ccont_locks
 
 
 def _order_path(adj: Dict[str, Set[str]], src: str,
@@ -1126,9 +1186,10 @@ def _order_path(adj: Dict[str, Set[str]], src: str,
 
 def _check_lock_order(scans: List[_FileScan],
                       graph: CallGraph) -> List[Finding]:
-    mod_locks, cls_locks, cont_locks = _collect_checked_locks(scans,
-                                                              graph)
-    if not mod_locks and not cls_locks and not cont_locks:
+    mod_locks, cls_locks, cont_locks, ccont_locks = \
+        _collect_checked_locks(scans, graph)
+    if not mod_locks and not cls_locks and not cont_locks \
+            and not ccont_locks:
         return []
 
     def _target_module(node: FuncNode, root: str):
@@ -1180,6 +1241,14 @@ def _check_lock_order(scans: List[_FileScan],
                     and isinstance(sl.value, str)):
                 return None
             base = expr.value
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and node.cls is not None:
+                # `self.LOCKS["a"]`: class-scope literal-dict container
+                hit = ccont_locks.get((node.module, node.cls),
+                                      {}).get(base.attr, {}).get(sl.value)
+                if hit is not None:
+                    return hit
             if isinstance(base, ast.Name):
                 cont = cont_locks.get(node.module, {}).get(base.id)
                 if cont is None:
@@ -1544,8 +1613,11 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
     """Abstract interpretation of one function body: owning handles must
     reach a release on every normal-flow path, be returned, be stored on
     self (audited separately), or carry the escape pragma.  Exception
-    paths (`raise`, a callee throwing) are out of scope — recorded as a
-    deferral in ROADMAP."""
+    paths are modeled at explicit ``raise`` statements: a handle still
+    live there leaks unless an enclosing ``finally`` or a catching
+    ``except`` handler releases it (``except_rel`` threads the handler
+    releases, same shape as the try/finally support).  Implicit throws
+    from callees remain out of scope."""
     display = _node_display(node)
 
     def kind_of(call: ast.Call) -> Optional[Tuple[str, str]]:
@@ -1683,9 +1755,12 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                    f"it, or store it on an owner whose close releases it")
 
     def exec_block(stmts: List[ast.AST], state: Dict[str, _HBinding],
-                   finally_rel: Set[str]
+                   finally_rel: Set[str], except_rel: Set[str]
                    ) -> Tuple[Dict[str, _HBinding], bool]:
-        """Returns (state after the block, terminated-by-return/raise)."""
+        """Returns (state after the block, terminated-by-return/raise).
+        ``except_rel`` holds names released by every enclosing handler
+        that would catch a raise here — the exception-path analogue of
+        ``finally_rel``."""
         for stmt in stmts:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
@@ -1698,8 +1773,13 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                             or stmt.value is None else "return")
                 return state, True
             if isinstance(stmt, ast.Raise):
-                # exception paths: out of scope (ROADMAP deferral) — the
-                # caller's except/finally may still release
+                # the exception path IS a function exit: anything still
+                # live here leaks unless a finally or a catching except
+                # handler releases it on the way out
+                scan_expr(stmt, state, transfer=False)
+                report_exit(state, stmt.lineno,
+                            finally_rel | except_rel,
+                            "raise (exception path)")
                 return state, True
             if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
                 _exec_assign(stmt, state)
@@ -1709,9 +1789,9 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                 continue
             if isinstance(stmt, ast.If):
                 s1, t1 = exec_block(list(stmt.body), dict(state),
-                                    finally_rel)
+                                    finally_rel, except_rel)
                 s2, t2 = exec_block(list(stmt.orelse), dict(state),
-                                    finally_rel)
+                                    finally_rel, except_rel)
                 if t1 and t2:
                     return state, True
                 merged: Dict[str, _HBinding] = {}
@@ -1727,11 +1807,12 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                 scan_expr(getattr(stmt, "iter", None) or stmt.test,
                           state, transfer=False)
                 body_state, _t = exec_block(list(stmt.body), dict(state),
-                                            finally_rel)
+                                            finally_rel, except_rel)
                 for name, b in body_state.items():
                     if name not in state:
                         state[name] = b
-                exec_block(list(stmt.orelse), state, finally_rel)
+                exec_block(list(stmt.orelse), state, finally_rel,
+                           except_rel)
                 continue
             if isinstance(stmt, (ast.With, ast.AsyncWith)):
                 for item in stmt.items:
@@ -1743,23 +1824,37 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                             pk[0], stmt.lineno, pk[1])
                     else:
                         scan_expr(item.context_expr, state, transfer=False)
-                state, t = exec_block(list(stmt.body), state, finally_rel)
+                state, t = exec_block(list(stmt.body), state, finally_rel,
+                                      except_rel)
                 if t:
                     return state, True
                 continue
             if isinstance(stmt, ast.Try):
                 fin_rel = finally_rel | finally_releases(
                     list(stmt.finalbody))
+                # a raise inside the try body lands in these handlers:
+                # whatever they release is covered on that path (same
+                # context-insensitive collection as finally — a handler
+                # that releases at all is trusted to release on the
+                # paths it catches)
+                exc_rel = except_rel | finally_releases(
+                    [s for h in stmt.handlers for s in h.body]) \
+                    if stmt.handlers else except_rel
                 body_state, body_t = exec_block(list(stmt.body),
-                                                dict(state), fin_rel)
+                                                dict(state), fin_rel,
+                                                exc_rel)
                 branch_states = [] if body_t else [body_state]
                 if not body_t and stmt.orelse:
+                    # else runs only after the body completed and is NOT
+                    # covered by this try's handlers
                     body_state, t2 = exec_block(list(stmt.orelse),
-                                                body_state, fin_rel)
+                                                body_state, fin_rel,
+                                                except_rel)
                     branch_states = [] if t2 else [body_state]
                 for handler in stmt.handlers:
                     h_state, h_t = exec_block(list(handler.body),
-                                              dict(state), fin_rel)
+                                              dict(state), fin_rel,
+                                              except_rel)
                     if not h_t:
                         branch_states.append(h_state)
                 merged = {}
@@ -1769,7 +1864,7 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                                                   and not b.released):
                             merged[name] = b
                 merged, fin_t = exec_block(list(stmt.finalbody), merged,
-                                           finally_rel)
+                                           finally_rel, except_rel)
                 if not branch_states or fin_t:
                     return merged, True
                 state = merged
@@ -1871,7 +1966,8 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                 return
         scan_expr(value, state, transfer=False)
 
-    end_state, terminated = exec_block(list(node.fn.body), {}, set())
+    end_state, terminated = exec_block(list(node.fn.body), {}, set(),
+                                       set())
     if not terminated:
         last = node.fn.body[-1]
         report_exit(end_state, getattr(last, "lineno", node.fn.lineno),
@@ -2019,6 +2115,33 @@ def _fmt_stream(fn: ast.AST, struct_consts: Dict[str, str],
 def _is_subsequence(needle: str, hay: str) -> bool:
     it = iter(hay)
     return all(ch in it for ch in needle)
+
+
+def _segment_streams(fn: ast.AST, struct_consts: Dict[str, str],
+                     direction: str, key: str) -> Optional[str]:
+    """The ``direction`` format stream of the dispatch branch keyed on
+    string constant ``key`` — the bodies of every ``if <x> == "key"``
+    (or reversed) inside ``fn``, concatenated in line order.  ``None``
+    when no such branch exists (a stale segment declaration)."""
+    streams: List[Tuple[int, str]] = []
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.If):
+            continue
+        test = n.test
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.Eq)):
+            continue
+        operands = [test.left] + list(test.comparators)
+        if not any(isinstance(c, ast.Constant) and c.value == key
+                   for c in operands):
+            continue
+        body = ast.Module(body=n.body, type_ignores=[])
+        streams.append((n.lineno,
+                        _fmt_stream(body, struct_consts, direction)))
+    if not streams:
+        return None
+    streams.sort()
+    return "".join(s for _ln, s in streams)
 
 
 def _wire_site_index(scans: List[_FileScan], graph: CallGraph
@@ -2185,9 +2308,9 @@ def _check_wire_contract(scans: List[_FileScan],
                                 f"not exist in the scanned tree — the "
                                 f"registry is stale"))
                         continue
-                    stream = _fmt_stream(
-                        node.fn, consts_by_path.get(node.path, {}),
-                        direction)
+                    consts = consts_by_path.get(node.path, {})
+                    stream = _fmt_stream(node.fn, consts, direction)
+                    seg_keys = dict(sch.segments).get(site)
                     if site in sch.exact_sites:
                         if stream != expected:
                             findings.append(Finding(
@@ -2198,6 +2321,35 @@ def _check_wire_contract(scans: List[_FileScan],
                                 f"schema declares '{expected}' — the "
                                 f"hand-rolled site drifted from the "
                                 f"declared frame"))
+                    elif seg_keys is not None:
+                        # shared multi-frame handler with a declared
+                        # dispatch discriminant: the keyed branch must
+                        # carry this schema EXACTLY — subsequence can
+                        # hide a reordered or restretched frame behind
+                        # a sibling branch's fields
+                        for key in seg_keys:
+                            seg = _segment_streams(node.fn, consts,
+                                                   direction, key)
+                            if seg is None:
+                                findings.append(Finding(
+                                    "wire-contract", node.path,
+                                    node.fn.lineno,
+                                    f"schema '{sch.name}' declares "
+                                    f"segment '{key}' of {direction} "
+                                    f"site {site} but the site has no "
+                                    f"branch dispatching on '{key}' — "
+                                    f"the segment declaration is "
+                                    f"stale"))
+                            elif seg != expected:
+                                findings.append(Finding(
+                                    "wire-contract", node.path,
+                                    node.fn.lineno,
+                                    f"schema '{sch.name}' segment "
+                                    f"'{key}' of {direction} site "
+                                    f"{site} has field stream '{seg}', "
+                                    f"schema declares '{expected}' — "
+                                    f"exact segmented match failed for "
+                                    f"the dispatch branch"))
                     elif expected and not _is_subsequence(expected,
                                                           stream):
                         findings.append(Finding(
@@ -2426,6 +2578,13 @@ def lint_files(files: Iterable[str],
             findings.extend(_check_wire_contract(scans, graph))
     if "ctypes-contract" in active:
         findings.extend(_check_ctypes_contract(scans))
+    if active & set(_NATIVE_CHECKS):
+        # the cross-language tier lives in its own module (its own
+        # parsing stack); import lazily so Python-only lint runs don't
+        # pay for it
+        from brpc_tpu.analysis import native as _native
+        findings.extend(_native.check_scans(
+            [sc.path for sc in scans], active & set(_NATIVE_CHECKS)))
     # dedup (a nested def can be reached both inside its parent's subtree
     # and as its own call-graph node), then stable order
     seen: Set[Tuple[str, str, int, str]] = set()

@@ -305,6 +305,26 @@ class LatencyRecorder(Variable):
         self._sum_us.add(us)
         self._max.update(us)
 
+    def record_bulk(self, seconds: float, n: int):
+        """Fold ``n`` samples of the same latency in one shot.  For
+        draining counters maintained OUTSIDE Python (e.g. the native
+        Lookup path's sum/count pair): the per-sample distribution is
+        gone by then, so all ``n`` land in one bucket at their mean."""
+        if n <= 0:
+            return
+        us = seconds * 1e6
+        if us < 0.1:
+            idx = 0
+        else:
+            idx = int((math.log10(us) - _LOG_MIN) * _BUCKETS_PER_DECADE)
+            if idx >= _NBUCKETS:
+                idx = _NBUCKETS - 1
+        with self._hmu:
+            self._hist[idx] += n
+        self._count.add(n)
+        self._sum_us.add(us * n)
+        self._max.update(us)
+
     @property
     def count(self) -> int:
         return self._count.get_value()

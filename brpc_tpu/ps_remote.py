@@ -1296,6 +1296,11 @@ class PsShardServer:
                            f"ps_sheds_shard{shard_index}_{sid}")
         self._lat = obs.recorder(self._sig_names[0])
         self._sheds = obs.counter(self._sig_names[1])
+        #: last (sum_us, count) folded from the native Lookup path into
+        #: self._lat — zero-Python reads never cross the Python recorder,
+        #: so SchemeInfo drains the native counters (PsShard.lookup_stats)
+        #: into it incrementally before reporting p99
+        self._native_lat_seen = (0, 0)
         #: how long a replicated apply waits for backup acks before
         #: failing the write (sync replication among reachable replicas)
         self.repl_ack_timeout_s = 5.0
@@ -1548,6 +1553,25 @@ class PsShardServer:
         with self._seq_mu:
             n = self._read_count
         return n + self.native_lookups
+
+    def _fold_native_latency(self) -> None:
+        """Drain the native Lookup latency counters into ``self._lat``.
+
+        The zero-Python read path (ps_shard.cc ServeLookup) stamps a
+        sum/count pair instead of calling the Python recorder; folding
+        the delta since the last poll (as its mean, via record_bulk)
+        makes SchemeInfo's p99 — and with it RebalancePolicy's
+        tail-pressure input — see native-served traffic too."""
+        shard = self._shard
+        if shard is None:
+            return
+        sum_us, count = shard.lookup_stats()
+        seen_sum, seen_count = self._native_lat_seen
+        dn = count - seen_count
+        if dn <= 0:
+            return
+        self._native_lat_seen = (sum_us, count)
+        self._lat.record_bulk(max(sum_us - seen_sum, 0) / dn / 1e6, dn)
 
     def _replication_snapshot(self):
         """Consistent ``(epoch, gen, table bytes, applied windows)`` for
@@ -2188,6 +2212,7 @@ class PsShardServer:
         if method == "SchemeInfo":
             with self._mu.read():
                 gen = self._install_gen
+            self._fold_native_latency()
             shed = int(self._sheds.get_value())
             lim = self.limiter
             if lim is not None:

@@ -184,6 +184,10 @@ def _load_locked():
     lib.brt_ps_shard_generation.restype = ctypes.c_uint64
     lib.brt_ps_shard_native_lookups.argtypes = [ctypes.c_void_p]
     lib.brt_ps_shard_native_lookups.restype = ctypes.c_uint64
+    lib.brt_ps_shard_lookup_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.brt_ps_shard_lookup_stats.restype = None
     lib.brt_server_add_ps_service.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, _HANDLER,
         ctypes.c_void_p]
@@ -1274,6 +1278,16 @@ class PsShard:
     def native_lookups(self) -> int:
         """Lookups served with zero Python in the loop."""
         return self._lib.brt_ps_shard_native_lookups(self._ptr)
+
+    def lookup_stats(self) -> "tuple[int, int]":
+        """``(sum_us, count)`` of native Lookup service times — the
+        zero-Python read path never touches the server's Python latency
+        recorder, so its tail stats are reconstructed from this pair."""
+        sum_us = ctypes.c_int64(0)
+        count = ctypes.c_int64(0)
+        self._lib.brt_ps_shard_lookup_stats(
+            self._ptr, ctypes.byref(sum_us), ctypes.byref(count))
+        return sum_us.value, count.value
 
     def close(self) -> None:
         """Destroy the shard.  Servers it is attached to MUST already be

@@ -207,9 +207,16 @@ class FrameSchema:
     must EXACTLY equal this schema's scalar sequence (shared multi-frame
     functions are checked by in-order subsequence instead).
     ``native_sites`` documents native-side consumers (cpp paths) — they
-    satisfy the pairing requirement without a Python unpack site.
-    ``response=True`` marks server→client response frames whose client
-    consumer is trusted/optional — unpaired is explained, not flagged."""
+    satisfy the pairing requirement without a Python unpack site, and
+    the cross-language tier (``analysis.native``) checks each one's C++
+    read sequence against this schema.  ``segments`` upgrades a shared
+    multi-frame site from subsequence to EXACT matching: each entry maps
+    a site qualname to the dispatch-discriminant keys
+    (``("ps_remote.PsShardServer._serve_control", ("Sync",))`` means
+    "inside the ``method == \"Sync\"`` branch the stream must equal this
+    schema exactly").  ``response=True`` marks server→client response
+    frames whose client consumer is trusted/optional — unpaired is
+    explained, not flagged."""
 
     name: str
     fields: Tuple
@@ -218,6 +225,7 @@ class FrameSchema:
     unpack_sites: Tuple[str, ...] = ()
     exact_sites: Tuple[str, ...] = ()
     native_sites: Tuple[str, ...] = ()
+    segments: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
     response: bool = False
 
     # -- derived ----------------------------------------------------------
@@ -477,21 +485,26 @@ schema(
     doc="replication Sync: epoch ++ gen ++ f32 count ++ table ++ windows",
     pack_sites=("ps_remote._Replicator._connect",
                 "durable.hydrate_replica"),
-    unpack_sites=("ps_remote.PsShardServer._serve_control",))
+    unpack_sites=("ps_remote.PsShardServer._serve_control",),
+    segments=(("ps_remote.PsShardServer._serve_control", ("Sync",)),))
 
 schema(
     "promote_req",
     Int("epoch"),
     doc="Promote: the new fencing epoch",
     pack_sites=("ps_remote.RemoteEmbedding._failover",),
-    unpack_sites=("ps_remote.PsShardServer._serve_control",))
+    unpack_sites=("ps_remote.PsShardServer._serve_control",),
+    segments=(("ps_remote.PsShardServer._serve_control",
+               ("Promote",)),))
 
 schema(
     "scheme_fence_req",
     Int("ver"),
     doc="SchemeFence: the successor scheme version",
     pack_sites=("reshard.MigrationDriver.cutover",),
-    unpack_sites=("ps_remote.PsShardServer._serve_control",))
+    unpack_sites=("ps_remote.PsShardServer._serve_control",),
+    segments=(("ps_remote.PsShardServer._serve_control",
+               ("SchemeFence",)),))
 
 schema(
     "migrate_sync_req",
@@ -503,7 +516,9 @@ schema(
         "windows",
     pack_sites=("reshard.MigrationShipper._connect",
                 "durable.hydrate_destination"),
-    unpack_sites=("ps_remote.PsShardServer._serve_control",))
+    unpack_sites=("ps_remote.PsShardServer._serve_control",),
+    segments=(("ps_remote.PsShardServer._serve_control",
+               ("MigrateSync",)),))
 
 schema(
     "migrate_apply_setup",
@@ -532,6 +547,9 @@ schema(
     pack_sites=("ps_remote.PsShardServer._serve_control",
                 "ps_remote.PsShardServer._serve_apply_id",),
     unpack_sites=("ps_remote.RemoteEmbedding._note_acked_gen",),
+    segments=(("ps_remote.PsShardServer._serve_control",
+               ("Flush", "MigrateStart", "SchemeFence",
+                "CompleteImport")),),
     response=True)
 
 schema(
@@ -540,6 +558,8 @@ schema(
     doc="(epoch, gen) int64 pair: Promote / ReplicaApply setup response",
     pack_sites=("ps_remote.PsShardServer._serve_control",
                 "ps_remote.PsShardServer._serve_stream_setup"),
+    segments=(("ps_remote.PsShardServer._serve_control",
+               ("Promote",)),),
     response=True)
 
 schema(
@@ -640,6 +660,10 @@ schema(
                 "ps_remote.DevicePsShardServer._serve"),
     unpack_sites=("ps_remote.RemoteEmbedding._transfer_pushes",
                   "ps_remote.RemoteEmbedding._confirm_push"),
+    segments=(("ps_remote.PsShardServer._serve_control",
+               ("WriterSeq",)),
+              ("ps_remote.DevicePsShardServer._serve",
+               ("WriterSeq",))),
     response=True)
 
 

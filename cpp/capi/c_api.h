@@ -243,6 +243,12 @@ int brt_ps_shard_install(void* shard, const void* table, int64_t rows,
 uint64_t brt_ps_shard_generation(void* shard);
 // Lookups served natively since creation (proves zero-Python serving).
 uint64_t brt_ps_shard_native_lookups(void* shard);
+// Native Lookup service-time accounting (debug/observability surface,
+// brt_debug-style): writes the sum of per-request service times in us
+// and the number of requests it covers.  Lets the bound language fold
+// the zero-Python read path into its per-server tail-latency stats.
+void brt_ps_shard_lookup_stats(void* shard, int64_t* sum_us,
+                               int64_t* count);
 // Registers a service on `server` whose `Lookup` is served natively from
 // `shard`; every other method is dispatched to `fallback` with the
 // standard brt_service_handler session contract.  The shard must outlive

@@ -52,6 +52,11 @@ struct CPsShard {
   ShardGen* current = nullptr;         // owned; swapped by install
   std::atomic<uint64_t> generation{0};
   std::atomic<uint64_t> native_lookups{0};
+  // Service-time accounting for the zero-Python read path: the bound
+  // language's per-server latency recorder never sees native Lookups,
+  // so the sum/count pair is exported (brt_ps_shard_lookup_stats) and
+  // folded into its tail stats there.
+  std::atomic<uint64_t> lookup_us_sum{0};
 
   ~CPsShard() {
     // By contract the server (and with it every in-flight handler) is
@@ -91,6 +96,7 @@ class CPsService : public Service {
     // above any legitimate count, so the two framings cannot collide.
     // Expired work is shed HERE, before ids are even copied out: the
     // overload-control contract for the zero-Python read path.
+    const int64_t t0 = monotonic_us();
     size_t off = 0;
     int32_t count = 0;
     if (request.size() < 4) {
@@ -182,6 +188,8 @@ class CPsService : public Service {
           out, nbytes, [](void* data, void*) { free(data); }, nullptr);
     }
     Unpin(g);
+    shard_->lookup_us_sum.fetch_add(uint64_t(monotonic_us() - t0),
+                                    std::memory_order_relaxed);
     shard_->native_lookups.fetch_add(1, std::memory_order_relaxed);
   }
 
@@ -252,6 +260,19 @@ uint64_t brt_ps_shard_generation(void* shard) {
 uint64_t brt_ps_shard_native_lookups(void* shard) {
   return static_cast<CPsShard*>(shard)->native_lookups.load(
       std::memory_order_relaxed);
+}
+
+void brt_ps_shard_lookup_stats(void* shard, int64_t* sum_us,
+                               int64_t* count) {
+  auto* s = static_cast<CPsShard*>(shard);
+  // count is read after sum so a racing Lookup can only make the pair
+  // conservative (sum missing its newest sample), never inflate the mean.
+  if (sum_us != nullptr) {
+    *sum_us = int64_t(s->lookup_us_sum.load(std::memory_order_relaxed));
+  }
+  if (count != nullptr) {
+    *count = int64_t(s->native_lookups.load(std::memory_order_relaxed));
+  }
 }
 
 int brt_server_add_ps_service(void* server, const char* name, void* shard,

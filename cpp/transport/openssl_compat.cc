@@ -1,0 +1,75 @@
+// OpenSSL compatibility definitions for runtimes older than 3.0.
+//
+// third_party/openssl_shim.h declares the OpenSSL 3 ABI subset the TLS
+// tier uses, but some deployment images ship only libssl.so.1.1 /
+// libcrypto.so.1.1, which lack the 3.0-only convenience entry points.
+// This TU provides those entry points in terms of primitives that exist
+// in BOTH the 1.1 and 3.0 ABIs, so the same source links against either
+// runtime.  When the process does load a real OpenSSL 3 libcrypto, the
+// definition here shadows the library's inside this shared object with
+// equivalent behavior.
+
+#include <cstdarg>
+#include <cstring>
+
+#include "third_party/openssl_shim.h"
+
+extern "C" {
+
+// EVP_PKEY_CTX keygen primitives — stable exported symbols in OpenSSL
+// 1.1.0+ and 3.x alike (verified with nm -D against both runtimes).
+typedef struct evp_pkey_ctx_st EVP_PKEY_CTX;
+EVP_PKEY_CTX* EVP_PKEY_CTX_new_id(int id, void* engine);
+void EVP_PKEY_CTX_free(EVP_PKEY_CTX* ctx);
+int EVP_PKEY_keygen_init(EVP_PKEY_CTX* ctx);
+int EVP_PKEY_CTX_ctrl(EVP_PKEY_CTX* ctx, int keytype, int optype, int cmd,
+                      int p1, void* p2);
+int EVP_PKEY_keygen(EVP_PKEY_CTX* ctx, EVP_PKEY** ppkey);
+
+}  // extern "C"
+
+namespace {
+
+// Documented constants (OpenSSL public headers; values are ABI-stable).
+constexpr int kEvpPkeyEc = 408;                    // EVP_PKEY_EC
+constexpr int kOpParamgen = 1 << 1;                // EVP_PKEY_OP_PARAMGEN
+constexpr int kOpKeygen = 1 << 2;                  // EVP_PKEY_OP_KEYGEN
+constexpr int kCtrlEcCurveNid = 0x1000 + 1;  // EVP_PKEY_CTRL_EC_PARAMGEN_CURVE_NID
+constexpr int kNidP256 = 415;                      // NID_X9_62_prime256v1
+
+int CurveNid(const char* name) {
+  if (name == nullptr) return 0;
+  if (strcmp(name, "P-256") == 0 || strcmp(name, "prime256v1") == 0) {
+    return kNidP256;
+  }
+  return 0;
+}
+
+}  // namespace
+
+// One-shot EC keygen, the only EVP_PKEY_Q_keygen shape the TLS tier uses
+// (GenerateSelfSignedCert: type="EC", vararg = curve group name).
+extern "C" EVP_PKEY* EVP_PKEY_Q_keygen(OSSL_LIB_CTX* libctx,
+                                       const char* propq, const char* type,
+                                       ...) {
+  (void)libctx;
+  (void)propq;
+  if (type == nullptr || strcmp(type, "EC") != 0) return nullptr;
+  va_list ap;
+  va_start(ap, type);
+  const char* curve = va_arg(ap, const char*);
+  va_end(ap);
+  const int nid = CurveNid(curve);
+  if (nid == 0) return nullptr;
+
+  EVP_PKEY_CTX* ctx = EVP_PKEY_CTX_new_id(kEvpPkeyEc, nullptr);
+  if (ctx == nullptr) return nullptr;
+  EVP_PKEY* pkey = nullptr;
+  if (EVP_PKEY_keygen_init(ctx) > 0 &&
+      EVP_PKEY_CTX_ctrl(ctx, kEvpPkeyEc, kOpParamgen | kOpKeygen,
+                        kCtrlEcCurveNid, nid, nullptr) > 0) {
+    if (EVP_PKEY_keygen(ctx, &pkey) <= 0) pkey = nullptr;
+  }
+  EVP_PKEY_CTX_free(ctx);
+  return pkey;
+}

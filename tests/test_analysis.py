@@ -1315,3 +1315,169 @@ def test_abi_pairing_requires_destroy_symbol(tmp_path):
         lib.brt_widget_new()
     """, name="fixed.py", checks=["handle-lifecycle"])
     assert fixed == []
+
+
+# ---- lock-order: class-scope literal-dict containers ----
+
+_CLASS_CONTAINER_LOCK_FIXTURE = """\
+    from brpc_tpu.analysis.race import checked_lock
+
+    class Engine:
+        LOCKS = {"a": checked_lock("ccd.A"), "b": checked_lock("ccd.B")}
+
+        def fwd(self):
+            with self.LOCKS["a"]:
+                with self.LOCKS["b"]:
+                    pass
+
+        def rev(self):
+            with self.LOCKS["b"]:
+                with self.LOCKS["a"]:
+                    pass
+"""
+
+
+def test_class_container_stored_lock_resolves(tmp_path):
+    # `self.LOCKS["a"]` on a CLASS-scope literal dict binds by constant
+    # key, same as the module-level container form
+    fs = _lint_src(tmp_path, _CLASS_CONTAINER_LOCK_FIXTURE)
+    (f,) = _by_check(fs, "lock-order")
+    assert "ccd.A" in f.message and "ccd.B" in f.message
+
+
+def test_class_container_lock_matches_dynamic_harness(tmp_path):
+    """Parity: the class-container inversion the static pass now
+    reports is exactly the one the dynamic harness observes."""
+    import textwrap as _tw
+
+    from brpc_tpu.analysis import race
+
+    static = _by_check(_lint_src(tmp_path,
+                                 _CLASS_CONTAINER_LOCK_FIXTURE),
+                       "lock-order")
+    assert len(static) == 1
+
+    race.clear()
+    race.set_enabled(True)
+    try:
+        ns = {"checked_lock": race.checked_lock}
+        exec(_tw.dedent(_CLASS_CONTAINER_LOCK_FIXTURE).split("\n", 1)[1],
+             ns)
+        eng = ns["Engine"]()
+        eng.fwd()
+        eng.rev()
+        dynamic = [f for f in race.findings()
+                   if f.kind == "lock-inversion"]
+    finally:
+        race.set_enabled(None)
+        race.clear()
+    assert len(dynamic) == 1
+    assert {"ccd.A", "ccd.B"} <= set(dynamic[0].locks)
+
+
+def test_class_container_non_constant_key_stays_deferred(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        from brpc_tpu.analysis.race import checked_lock
+
+        class Engine:
+            LOCKS = {"a": checked_lock("cck.A")}
+            B = None
+
+        OTHER = checked_lock("cck.B")
+
+        def use(eng, k):
+            with OTHER:
+                with eng.LOCKS[k]:
+                    pass
+
+        def reverse(eng):
+            with eng.LOCKS["a"]:
+                with OTHER:
+                    pass
+    """)
+    assert _by_check(fs, "lock-order") == []
+
+
+# ---- handle-lifecycle: exception paths (raise = an exit) ----
+
+def test_handle_live_at_raise_flagged(tmp_path):
+    fs = _lint_handle_fixture(tmp_path, """\
+        import rpc
+
+        def leaky(addr, payload):
+            ch = rpc.Channel(addr)
+            if not payload:
+                raise ValueError("empty payload")
+            ch.close()
+    """)
+    (f,) = fs
+    assert "raise (exception path)" in f.message
+    assert "'ch'" in f.message and "created line 4" in f.message
+
+
+def test_handle_released_by_catching_except_clean(tmp_path):
+    # the handler that catches the raise releases (and may re-raise
+    # after cleanup): the exception path is covered
+    assert _lint_handle_fixture(tmp_path, """\
+        import rpc
+
+        def covered(addr, payload):
+            ch = rpc.Channel(addr)
+            try:
+                if not payload:
+                    raise ValueError("bad")
+            except ValueError:
+                ch.close()
+                raise
+            ch.close()
+    """) == []
+
+
+def test_handle_released_by_finally_at_raise_clean(tmp_path):
+    assert _lint_handle_fixture(tmp_path, """\
+        import rpc
+
+        def covered(addr, payload):
+            ch = rpc.Channel(addr)
+            try:
+                if not payload:
+                    raise ValueError("bad")
+                return ch.call_async("S", "m").join()
+            finally:
+                ch.close()
+    """) == []
+
+
+def test_raise_in_else_clause_not_covered_by_handlers(tmp_path):
+    # except handlers do NOT catch raises from the else clause: a
+    # release that lives only in the handler does not cover this path
+    fs = _lint_handle_fixture(tmp_path, """\
+        import rpc
+
+        def leaky(addr, payload):
+            ch = rpc.Channel(addr)
+            try:
+                n = len(payload)
+            except TypeError:
+                ch.close()
+                raise
+            else:
+                if n == 0:
+                    raise ValueError("empty")
+            ch.close()
+    """)
+    (f,) = fs
+    assert "raise (exception path)" in f.message
+
+
+def test_raise_after_release_clean(tmp_path):
+    assert _lint_handle_fixture(tmp_path, """\
+        import rpc
+
+        def strict(addr, payload):
+            ch = rpc.Channel(addr)
+            if not payload:
+                ch.close()
+                raise ValueError("empty payload")
+            ch.close()
+    """) == []

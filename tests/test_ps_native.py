@@ -357,3 +357,53 @@ def test_fan_out_uses_group_wait(echo_server):
         emb.close()
         for s in servers:
             s.close()
+
+
+# ---- native latency export: SchemeInfo p99 sees the zero-Python path ----
+
+@pytest.mark.needs_native
+def test_native_lookup_latency_reaches_scheme_info_p99_and_policy():
+    """Zero-Python Lookups never cross the Python latency recorder; the
+    server drains the native sum/count pair (PsShard.lookup_stats) into
+    it on SchemeInfo, so per-server p99 — and with it RebalancePolicy's
+    tail-pressure input — sees native-served traffic.  The fold is
+    delta-based: a second SchemeInfo with no new traffic adds nothing."""
+    import json
+
+    from brpc_tpu import rpc
+    from brpc_tpu.rebalance import RebalanceOptions, RebalancePolicy
+
+    vocab, dim, n_lookups = 1 << 15, 32, 6  # 4MB rsp: µs-visible work
+    server = PsShardServer(vocab, dim, 0, 1, native_read=True)
+    ch = rpc.Channel(server.address, timeout_ms=30000)
+    try:
+        req = _lookup_req(np.arange(vocab, dtype=np.int32))
+        for _ in range(n_lookups):
+            assert len(ch.call("Ps", "Lookup", req)) == vocab * dim * 4
+        assert server.native_lookups == n_lookups
+        sum_us, count = server._shard.lookup_stats()
+        assert count == n_lookups and sum_us > 0
+        assert server._lat.count == 0  # nothing crossed Python yet
+
+        p99_us = json.loads(ch.call("Ps", "SchemeInfo", b""))["p99_us"]
+        assert p99_us > 0.0
+        assert server._lat.count == n_lookups
+        json.loads(ch.call("Ps", "SchemeInfo", b""))
+        assert server._lat.count == n_lookups  # no double count
+
+        # close the loop: the measured p99 (in ms) sustained over a
+        # lower threshold splits with zero qps signal
+        t = [0.0]
+        pol = RebalancePolicy(RebalanceOptions(
+            split_qps=1e9, merge_qps=1.0, sustain_s=1.0,
+            min_interval_s=5.0, max_shards=8,
+            split_p99_ms=p99_us / 1000.0 / 2.0), clock=lambda: t[0])
+        p99_ms = [p99_us / 1000.0]
+        assert pol.decide(1, [0.0], shard_p99_ms=p99_ms) is None
+        t[0] += 1.1
+        d = pol.decide(1, [0.0], shard_p99_ms=p99_ms)
+        assert d is not None and d.kind == "split"
+        assert "tail pressure" in d.reason
+    finally:
+        ch.close()
+        server.close()

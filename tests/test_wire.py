@@ -434,3 +434,92 @@ def test_malformed_unary_counts_ps_parse_rejects():
     finally:
         ch.close()
         server.close()
+
+
+# ---------------------------------------------------------------------------
+# exact segmented matching for shared multi-frame handlers
+# ---------------------------------------------------------------------------
+
+def _lint_fake_package(tmp_path, source):
+    """A fixture scanned AS the package: the dir is named ``brpc_tpu``
+    so the registry-conformance arm (which gates on an in-package scan)
+    runs against the fixture's ``ps_remote`` module."""
+    pkg = tmp_path / "brpc_tpu"
+    pkg.mkdir()
+    (pkg / "ps_remote.py").write_text(textwrap.dedent(source))
+    return _wire_findings([str(pkg)])
+
+
+def test_registry_segment_declarations_are_consistent():
+    segmented = [s for s in wire.REGISTRY.values() if s.segments]
+    assert {s.name for s in segmented} >= {
+        "sync_req", "promote_req", "scheme_fence_req",
+        "migrate_sync_req", "gen_rsp", "epoch_gen_rsp",
+        "writer_seq_rsp"}
+    for sch in segmented:
+        for site, keys in sch.segments:
+            assert keys, f"{sch.name}: empty segment key set"
+            assert site in sch.pack_sites + sch.unpack_sites, \
+                f"{sch.name}: segment site {site} is not a declared " \
+                f"pack/unpack site"
+
+
+def test_segment_drift_flagged_where_subsequence_would_pass(tmp_path):
+    """The upgrade's point: the Sync branch reads only (q, q) but a
+    SIBLING branch supplies the third q, so the whole-function stream
+    still contains 'qqq' as a subsequence — only exact matching keyed
+    on the dispatch discriminant can see the drifted branch."""
+    findings = _lint_fake_package(tmp_path, """\
+        import struct
+
+        class PsShardServer:
+            def _serve_control(self, method, payload):
+                if method == "Sync":
+                    epoch, gen = struct.unpack_from("<qq", payload, 0)
+                    return b""
+                if method == "Tail":
+                    (count,) = struct.unpack_from("<q", payload, 16)
+                    return b""
+                return b""
+    """)
+    seg = [f for f in findings
+           if "segment 'Sync'" in f.message and "sync_req" in f.message]
+    assert seg, [f.message for f in findings]
+    assert "'qq'" in seg[0].message and "'qqq'" in seg[0].message
+    assert "exact segmented match failed" in seg[0].message
+    # and the old subsequence rule would NOT have fired here
+    from brpc_tpu.analysis.lint import _is_subsequence
+    assert _is_subsequence("qqq", "qq" + "q")
+
+
+def test_stale_segment_declaration_flagged(tmp_path):
+    # the handler exists but no branch dispatches on the declared key:
+    # the segment declaration itself has rotted
+    findings = _lint_fake_package(tmp_path, """\
+        import struct
+
+        class PsShardServer:
+            def _serve_control(self, method, payload):
+                if method == "Resync":
+                    a, b, c = struct.unpack_from("<qqq", payload, 0)
+                return b""
+    """)
+    stale = [f for f in findings
+             if "no branch dispatching on 'Sync'" in f.message]
+    assert stale, [f.message for f in findings]
+
+
+def test_segment_exact_match_accepts_faithful_branch(tmp_path):
+    findings = _lint_fake_package(tmp_path, """\
+        import struct
+
+        class PsShardServer:
+            def _serve_control(self, method, payload):
+                if method == "Sync":
+                    epoch, gen, n = struct.unpack_from(
+                        "<qqq", payload, 0)
+                    return b""
+                return b""
+    """)
+    assert not any("segment 'Sync'" in f.message for f in findings), \
+        [f.message for f in findings]
