@@ -170,6 +170,59 @@ def _bench_native_extract() -> dict:
     }
 
 
+def _bench_exception_flow() -> dict:
+    """Exception-flow tier cost: the whole-tree may-throw fixpoint
+    (call-graph build + summary propagation) wall time, the finding
+    counts of the two checks it feeds (0 = every handle and lock
+    obligation is exception-safe in-tree), and the determinism proof —
+    two independent runs must produce identical finding ids."""
+    from brpc_tpu.analysis import callgraph, lint
+
+    pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "brpc_tpu")
+    if not os.path.isdir(pkg):
+        return {"skipped": "no brpc_tpu tree next to this script"}
+    import ast as _ast
+    paths = sorted(
+        os.path.join(dp, fn)
+        for dp, _dirs, fns in os.walk(pkg)
+        for fn in fns if fn.endswith(".py"))
+    files = []
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as f:
+            files.append((p, _ast.parse(f.read())))
+    repeats = 3
+    best_build = best_fix = float("inf")
+    n_nodes = n_proven = 0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        graph = callgraph.build_callgraph(files)
+        t1 = time.perf_counter()
+        summaries = graph.compute_throws()
+        t2 = time.perf_counter()
+        best_build = min(best_build, t1 - t0)
+        best_fix = min(best_fix, t2 - t1)
+        n_nodes = len(summaries)
+        n_proven = sum(1 for s in summaries.values()
+                       if s.may_throw and s.confidence == "high")
+    checks = ["exception-flow", "lock-exception-safety"]
+    run1 = lint.run_lint([pkg], checks=checks)
+    run2 = lint.run_lint([pkg], checks=checks)
+    return {
+        "unit": "whole-tree may-throw fixpoint (build + propagate)",
+        "functions": n_nodes,
+        "proven_may_throw": n_proven,
+        "build_s": round(best_build, 4),
+        "fixpoint_s": round(best_fix, 4),
+        "within_budget_5s": (best_build + best_fix) < 5.0,
+        "exception_flow_findings": sum(
+            1 for f in run1 if f.check == "exception-flow"),
+        "lock_exception_safety_findings": sum(
+            1 for f in run1 if f.check == "lock-exception-safety"),
+        "deterministic_ids": [f.id for f in run1] == [f.id for f in run2],
+    }
+
+
 def _bench_fuzz() -> dict:
     """Fuzz throughput per parser (execs/sec, memcheck off — the raw
     mutation+parse loop): how much hostile-input coverage one core buys
@@ -230,6 +283,7 @@ def main() -> dict:
         "handle_ledger": _bench_handles(),
         "fuzz": _bench_fuzz(),
         "native_extract": _bench_native_extract(),
+        "exception_flow": _bench_exception_flow(),
     }
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -54,6 +54,22 @@ built on top memoize on visited nodes.
 Entry point: :func:`build_callgraph` over ``(path, ast.Module)`` pairs;
 :class:`CallGraph` answers ``node_for_ast`` / ``call_target`` /
 ``callees`` / ``reachable`` / ``resolve_callable_expr``.
+
+May-throw analysis (:meth:`CallGraph.compute_throws`): a per-function
+fixpoint over the same edges answering "can this function raise, and
+what?".  Explicit ``raise``/``assert`` statements contribute proven
+types (``raise X(...)`` / ``raise X`` where ``X`` names a known
+exception class; a bare re-raise or a dynamic raise expression is a
+proven throw of *unknown* type); resolved calls propagate their
+callee's summary; a call or raise lexically inside a ``try`` is
+absorbed by handlers that can catch it (matching by class name through
+the in-package class hierarchy plus the builtin exception tree —
+``except RpcError`` absorbs a raised ``StreamClosed`` subclass; an
+unknown-typed throw is absorbed only by a catch-all handler).
+Unresolvable calls never contribute proven types — they set only the
+low-confidence ``external`` bit, so a finding built on a proven
+summary never rests on a guessed chain.  Per-call query:
+:meth:`CallGraph.call_throws`.
 """
 
 from __future__ import annotations
@@ -64,7 +80,7 @@ import os
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = ["CallGraph", "FuncNode", "ModuleInfo", "ClassInfo", "CallSite",
-           "build_callgraph", "module_name_for_path"]
+           "ThrowSummary", "build_callgraph", "module_name_for_path"]
 
 
 def module_name_for_path(path: str) -> str:
@@ -134,6 +150,92 @@ class CallSite:
     line: int
 
 
+#: handler types that catch everything we model (all proven raises in
+#: this tree derive from Exception; BaseException is a superset)
+_CATCH_ALL = frozenset({"Exception", "BaseException"})
+
+#: direct bases of the builtin exceptions this tree actually raises or
+#: catches — enough hierarchy that ``except LookupError`` absorbs a
+#: raised ``KeyError`` without importing anything at analysis time
+_BUILTIN_EXC_BASES: Dict[str, Tuple[str, ...]] = {
+    "ValueError": ("Exception",),
+    "TypeError": ("Exception",),
+    "KeyError": ("LookupError",),
+    "IndexError": ("LookupError",),
+    "LookupError": ("Exception",),
+    "AttributeError": ("Exception",),
+    "NameError": ("Exception",),
+    "RuntimeError": ("Exception",),
+    "NotImplementedError": ("RuntimeError",),
+    "RecursionError": ("RuntimeError",),
+    "OSError": ("Exception",),
+    "IOError": ("OSError",),
+    "TimeoutError": ("OSError",),
+    "ConnectionError": ("OSError",),
+    "BrokenPipeError": ("ConnectionError",),
+    "ConnectionResetError": ("ConnectionError",),
+    "ConnectionAbortedError": ("ConnectionError",),
+    "ConnectionRefusedError": ("ConnectionError",),
+    "FileNotFoundError": ("OSError",),
+    "FileExistsError": ("OSError",),
+    "PermissionError": ("OSError",),
+    "InterruptedError": ("OSError",),
+    "BlockingIOError": ("OSError",),
+    "ArithmeticError": ("Exception",),
+    "ZeroDivisionError": ("ArithmeticError",),
+    "OverflowError": ("ArithmeticError",),
+    "FloatingPointError": ("ArithmeticError",),
+    "AssertionError": ("Exception",),
+    "StopIteration": ("Exception",),
+    "StopAsyncIteration": ("Exception",),
+    "MemoryError": ("Exception",),
+    "BufferError": ("Exception",),
+    "UnicodeError": ("ValueError",),
+    "UnicodeDecodeError": ("UnicodeError",),
+    "UnicodeEncodeError": ("UnicodeError",),
+    "EOFError": ("Exception",),
+    "ImportError": ("Exception",),
+    "ModuleNotFoundError": ("ImportError",),
+    "SystemExit": ("BaseException",),
+    "KeyboardInterrupt": ("BaseException",),
+    "GeneratorExit": ("BaseException",),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ThrowSummary:
+    """What one function can raise, per the may-throw fixpoint.
+
+    ``types`` and ``unknown`` are PROVEN: they trace back through
+    resolved call edges to an explicit ``raise``/``assert`` in scanned
+    code.  ``external`` is the conservative low-confidence bit — some
+    unresolved or out-of-package call on an unguarded path might throw,
+    but the analysis cannot name a chain.  Checks that emit findings
+    consult only the proven half; the external bit exists so callers
+    can distinguish "proven not to raise from scanned code" from
+    "nothing is known"."""
+
+    #: proven raisable exception type names, sorted (e.g. ("RpcError",
+    #: "ValueError")); a name is a class' last path component
+    types: Tuple[str, ...] = ()
+    #: proven throw whose type the analysis cannot name (bare re-raise,
+    #: ``raise make_error()``, ``raise e`` through a variable)
+    unknown: bool = False
+    #: an unresolved/external call sits on an unguarded path
+    external: bool = False
+
+    @property
+    def may_throw(self) -> bool:
+        """Proven: an explicit raise in scanned code can unwind out."""
+        return bool(self.types) or self.unknown
+
+    @property
+    def confidence(self) -> str:
+        if self.may_throw:
+            return "high"
+        return "external" if self.external else "none"
+
+
 def _last_name(expr: ast.AST) -> Optional[str]:
     if isinstance(expr, ast.Attribute):
         return expr.attr
@@ -169,6 +271,13 @@ class CallGraph:
         #: return type resolves to ONE in-package class (annotation, or
         #: direct in-package returns — see _infer_return_types)
         self._return_types: Dict[str, Tuple["ModuleInfo", str]] = {}
+        #: lazy results of the may-throw fixpoint (compute_throws)
+        self._throws: Optional[Dict[str, ThrowSummary]] = None
+        #: class name -> direct base names, over every scanned module
+        #: (built lazily; name-keyed — class names are unique enough in
+        #: one package, and a collision only widens absorption)
+        self._class_bases: Optional[Dict[str, Tuple[str, ...]]] = None
+        self._ancestor_cache: Dict[str, frozenset] = {}
 
     # -- construction ------------------------------------------------------
 
@@ -805,6 +914,193 @@ class CallGraph:
             seen.add(cur)
             stack.extend(site.callee for site in self.callees(cur))
         return seen
+
+    # -- may-throw analysis ------------------------------------------------
+
+    def exception_ancestors(self, name: str) -> frozenset:
+        """``name`` plus every base class name reachable through scanned
+        ``ClassDef`` bases and the builtin exception tree (cycle-safe)."""
+        cached = self._ancestor_cache.get(name)
+        if cached is not None:
+            return cached
+        if self._class_bases is None:
+            bases: Dict[str, Tuple[str, ...]] = {}
+            for mi in self.modules.values():
+                for ci in mi.classes.values():
+                    names = tuple(n for n in (_last_name(b)
+                                              for b in ci.bases) if n)
+                    # first definition wins (deterministic: add order)
+                    bases.setdefault(ci.name, names)
+            self._class_bases = bases
+        out: Set[str] = set()
+        stack = [name]
+        while stack:
+            cur = stack.pop()
+            if cur in out:
+                continue
+            out.add(cur)
+            stack.extend(self._class_bases.get(cur, ()))
+            stack.extend(_BUILTIN_EXC_BASES.get(cur, ()))
+        result = frozenset(out)
+        self._ancestor_cache[name] = result
+        return result
+
+    def handler_catch_names(self, handler: ast.excepthandler
+                            ) -> Optional[frozenset]:
+        """Exception names one ``except`` clause catches; None means
+        catch-all (bare ``except:``, ``except Exception``, or a dynamic
+        type expression we cannot name — trusting the latter to catch
+        keeps the throw summary an under-approximation)."""
+        t = handler.type
+        if t is None:
+            return None
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        names = []
+        for e in elts:
+            n = _last_name(e)
+            if n is None:
+                return None
+            names.append(n)
+        if set(names) & _CATCH_ALL:
+            return None
+        return frozenset(names)
+
+    def exception_catches(self, catch: Optional[frozenset],
+                          raised: Optional[str]) -> bool:
+        """Does a handler with catch-set ``catch`` absorb a raise of
+        ``raised``?  ``catch=None`` is catch-all; ``raised=None`` is an
+        unknown-typed throw (only catch-all absorbs it)."""
+        if catch is None:
+            return True
+        if raised is None:
+            return False
+        return bool(catch & self.exception_ancestors(raised))
+
+    def raised_type_name(self, node: ast.Raise) -> Optional[str]:
+        """Exception class name of ``raise X(...)`` / ``raise X`` when
+        ``X`` names a class the analysis knows (scanned ``ClassDef`` or
+        the builtin table); None for bare re-raise or dynamic raises —
+        a proven throw of unknown type."""
+        exc = node.exc
+        if exc is None:
+            return None
+        name = _last_name(exc.func if isinstance(exc, ast.Call) else exc)
+        if name is None:
+            return None
+        if self._class_bases is None:
+            self.exception_ancestors("Exception")  # builds the map
+        if name in self._class_bases or name in _BUILTIN_EXC_BASES or \
+                name in _CATCH_ALL:
+            return name
+        return None
+
+    def _absorbed(self, raised: Optional[str],
+                  handlers: Tuple[Tuple[Optional[frozenset], ...], ...]
+                  ) -> bool:
+        return any(self.exception_catches(c, raised)
+                   for level in handlers for c in level)
+
+    def _eval_throws(self, node: FuncNode,
+                     summaries: Dict[str, ThrowSummary]) -> ThrowSummary:
+        types: Set[str] = set()
+        unknown = False
+        external = False
+
+        def add_call(call: ast.Call, handlers) -> None:
+            nonlocal unknown, external
+            tgt = self._call_targets.get(id(call))
+            sub = summaries.get(tgt) if tgt else None
+            if sub is None:
+                if not self._absorbed(None, handlers):
+                    external = True
+                return
+            for t in sub.types:
+                if not self._absorbed(t, handlers):
+                    types.add(t)
+            if (sub.unknown or sub.external) and \
+                    not self._absorbed(None, handlers):
+                if sub.unknown:
+                    unknown = True
+                if sub.external:
+                    external = True
+
+        def walk(n: ast.AST, handlers) -> None:
+            nonlocal unknown, external
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                return  # nested defs throw when *called*, via their node
+            if isinstance(n, ast.Try):
+                inner = handlers + (tuple(self.handler_catch_names(h)
+                                          for h in n.handlers),) \
+                    if n.handlers else handlers
+                for s in n.body:
+                    walk(s, inner)
+                for s in n.orelse:      # else runs after the protected
+                    walk(s, handlers)   # region — handlers do not apply
+                for h in n.handlers:
+                    for s in h.body:
+                        walk(s, handlers)
+                for s in n.finalbody:
+                    walk(s, handlers)
+                return
+            if isinstance(n, ast.Raise):
+                t = self.raised_type_name(n)
+                if not self._absorbed(t, handlers):
+                    if t is None:
+                        unknown = True
+                    else:
+                        types.add(t)
+            elif isinstance(n, ast.Assert):
+                if not self._absorbed("AssertionError", handlers):
+                    types.add("AssertionError")
+            elif isinstance(n, ast.Call):
+                add_call(n, handlers)
+            for child in ast.iter_child_nodes(n):
+                walk(child, handlers)
+
+        fn = node.fn
+        body = fn.body if isinstance(fn, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Module)) else [fn.body]
+        for stmt in body:
+            walk(stmt, ())
+        return ThrowSummary(types=tuple(sorted(types)), unknown=unknown,
+                            external=external)
+
+    def compute_throws(self) -> Dict[str, ThrowSummary]:
+        """Run (once) and return the per-function may-throw fixpoint:
+        node id -> :class:`ThrowSummary`.  Deterministic — nodes are
+        iterated sorted and summaries carry sorted tuples."""
+        if self._throws is not None:
+            return self._throws
+        summaries: Dict[str, ThrowSummary] = {
+            nid: ThrowSummary() for nid in self.nodes}
+        order = sorted(self.nodes)
+        # monotone (sets only grow) over a finite lattice; the bound is
+        # a backstop, not a tuning knob
+        for _ in range(len(order) + 2):
+            changed = False
+            for nid in order:
+                new = self._eval_throws(self.nodes[nid], summaries)
+                if new != summaries[nid]:
+                    summaries[nid] = new
+                    changed = True
+            if not changed:
+                break
+        self._throws = summaries
+        return summaries
+
+    def throw_summary(self, node_id: str) -> ThrowSummary:
+        return self.compute_throws().get(node_id, ThrowSummary())
+
+    def call_throws(self, call: ast.AST) -> Optional[ThrowSummary]:
+        """Throw summary of a call's resolved callee; None when the
+        call never resolved (external — low confidence by definition,
+        so checks emit no finding for it)."""
+        tgt = self._call_targets.get(id(call))
+        if tgt is None:
+            return None
+        return self.compute_throws().get(tgt)
 
 
 def build_callgraph(files: Iterable[Tuple[str, ast.Module]]) -> CallGraph:

@@ -590,8 +590,9 @@ def fuzz_live(seed: int, iters: int = 150, *, timeout_ms: int = 3000,
     data_srv = PsShardServer(vocab, dim, 0, 4, native_read=True,
                              combine=True, stream=True)
     ctl_srv = PsShardServer(vocab, dim, 1, 4, native_read=True)
-    data_ch = rpc.Channel(data_srv.address, timeout_ms=timeout_ms)
-    ctl_ch = rpc.Channel(ctl_srv.address, timeout_ms=timeout_ms)
+    # both channels are constructed inside the try below: if the second
+    # constructor throws, the finally still releases the first
+    data_ch = ctl_ch = None
 
     def one_call(ch, method: str, payload: bytes, desc: str) -> None:
         nonlocal execs
@@ -612,6 +613,8 @@ def fuzz_live(seed: int, iters: int = 150, *, timeout_ms: int = 3000,
                 payload.hex()))
 
     try:
+        data_ch = rpc.Channel(data_srv.address, timeout_ms=timeout_ms)
+        ctl_ch = rpc.Channel(ctl_srv.address, timeout_ms=timeout_ms)
         for ch, methods in ((data_ch, data_methods),
                             (ctl_ch, ctl_methods)):
             for method, schema_name in methods:
@@ -668,8 +671,10 @@ def fuzz_live(seed: int, iters: int = 150, *, timeout_ms: int = 3000,
         ctl_ch.call("Ps", "Lookup", req2, timeout_ms=timeout_ms)
         execs += 2
     finally:
-        data_ch.close()
-        ctl_ch.close()
+        if data_ch is not None:
+            data_ch.close()
+        if ctl_ch is not None:
+            ctl_ch.close()
         data_srv.close()
         ctl_srv.close()
     if ledger_before is not None:

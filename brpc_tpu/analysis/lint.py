@@ -3,7 +3,7 @@
 The reference enforces its concurrency contracts with purpose-built
 tooling (contention profiler, bthread diagnostics, builtin hazard pages);
 this is the equivalent static pass for the hazards our fabric creates.
-Eleven checks, each encoding an invariant the runtime cannot enforce,
+Fourteen checks, each encoding an invariant the runtime cannot enforce,
 the concurrency ones interprocedural over the whole-package call graph
 (:mod:`brpc_tpu.analysis.callgraph` — the lockdep/TSan polarity: follow
 the calls, not the file):
@@ -49,7 +49,9 @@ the calls, not the file):
   ``.read()`` and ``.write()`` contexts acquire under the lock's one
   name, matching the dynamic graph's keying.  Locks resolve through
   module/class/parameter bindings AND literal dict containers at
-  module scope (``LOCKS["a"]``) or class scope (``self.LOCKS["a"]``) —
+  module scope (``LOCKS["a"]``) or class scope (``self.LOCKS["a"]``,
+  including containers inherited from base classes — the direct class
+  bodies along the base chain are walked, nearest assignment wins) —
   constant keys bind by key; dynamic keys and mutated containers stay
   unresolved (dynamic-harness territory).
 - ``fiber-blocking-sleep`` — a bare ``time.sleep`` anywhere
@@ -73,16 +75,48 @@ the calls, not the file):
   flow analysis is may-leak at explicit exits (an early ``return``
   with a live handle is THE classic leak) and trusts a release seen on
   any branch (the guard idiom) — no false positives from merges.
-  Exception paths are in scope for explicit ``raise``: a handle
-  acquired and still live at a ``raise`` is a leak unless a
-  ``finally`` or an enclosing ``except`` handler releases it
-  (try/except joins are modeled like the existing try/finally
-  support); implicit throws from callees remain out of scope.  The
+  Exception paths are fully in scope: a handle acquired and still
+  live at an explicit ``raise`` is a leak unless a ``finally``, a
+  ``with``, or an enclosing ``except`` handler that actually covers
+  the raised type releases it — handler trust is SCOPED to the
+  statements inside the handler's own ``try`` and to the exception
+  types it can catch (resolved through the in-package class hierarchy
+  plus the builtin exception tree), replacing the old
+  context-insensitive trust.  The deferred dataflow is closed too:
+  handles appended into a local container become a tracked may-leak
+  set (drained by iterating-and-releasing, discharged by returning or
+  storing the container; ``# lint: allow-handle-escape`` on the append
+  still marks a deliberate registry), rebinding a name over an
+  un-released handle (``h = new(); h = other``) is flagged as a drop
+  of the first obligation, and module-scope producer assignments are
+  audited like attrs (some function in the module must release the
+  global, or the singleton is declared with the pragma).  The
   ABI half audits ``rpc._load()``'s restype
   registry itself: every ``c_void_p``-returning constructor symbol
   needs its destroy symbol declared.  The dynamic complement is the
   handle ledger (:mod:`brpc_tpu.analysis.handles`,
   ``BRPC_TPU_HANDLECHECK=1``).
+- ``exception-flow`` — the interprocedural half of exception-safe
+  handle lifecycle, built on the may-throw fixpoint in
+  :mod:`brpc_tpu.analysis.callgraph`: every in-package function gets a
+  summary of the exception types it can raise (explicit ``raise`` and
+  ``assert`` propagated through resolved call edges, with
+  ``except``-guarded calls absorbing what their handlers can catch),
+  and a live handle at a call site whose callee PROVABLY may throw is
+  an exit — a leak on the unwinding edge unless an enclosing
+  ``finally``/``with`` or a handler covering that call (and that
+  thrown type) releases it.  Unresolvable/external callees carry a
+  low-confidence ``external`` bit and are deliberately silent, so a
+  finding never rests on a false chain.
+- ``lock-exception-safety`` — same machinery pointed at locks and
+  obligations: a ``checked_lock``/``checked_rwlock`` acquired
+  manually (``.acquire()`` outside ``with``) and still held across a
+  may-throw site is left locked forever on the unwinding edge unless
+  a ``finally`` (or a covering handler) releases it; and a fence-flag
+  obligation (``self._x = True`` … ``self._x = False`` in the same
+  block) with a may-throw site between set and reset unwinds
+  half-done unless the reset sits in a ``finally``.  No pragma
+  escape — these are fixed, not baselined.
 - ``wire-contract`` — frame-schema symmetry and parse-path bounds for
   every hand-rolled framing: ``_pack_X``/``_unpack_X`` pairs must move
   the same field stream (order + width), every site registered in
@@ -91,8 +125,12 @@ the calls, not the file):
   handlers like ``_serve_control`` are checked by **exact segmented
   matching** — each schema binds to its dispatch-discriminant branch
   via the schema's ``segments`` declaration and that branch's stream
-  must equal the schema exactly, with in-order subsequence only the
-  fallback for shared sites with no segment key), struct formats must
+  must equal the schema exactly; shared reads BEFORE the dispatch
+  branch — ``_serve``'s header — are declared per-site with the
+  schema's ``prebranch`` field and prepended to the branch stream for
+  the exact comparison, stale declarations included, leaving in-order
+  subsequence only for shared sites with no segment key), struct
+  formats must
   be
   explicit little-endian, counts/lengths read off the wire on
   handler-reachable parse paths must reach a bounds check before they
@@ -101,7 +139,7 @@ the calls, not the file):
   parser" gate).  The dynamic complement is the structure-aware fuzzer
   itself.
 - ``wire-contract-native`` / ``native-errors`` /
-  ``native-handle-balance`` — the cross-language tier
+  ``native-handle-balance`` / ``native-endian`` — the cross-language tier
   (:mod:`brpc_tpu.analysis.native`): a clang-free tokenizer +
   function-body extractor over ``cpp/capi/*.cc`` checks every
   ``wire.REGISTRY`` schema with a declared ``native_sites`` twin
@@ -112,7 +150,12 @@ the calls, not the file):
   ``errors.h``/errno and holds serve-path handlers to the live
   fuzzer's sanctioned code set (static/dynamic parity), and flags
   ``handle_inc`` ledger bumps left unbalanced on native error-return
-  paths.  These run only when the scan covers the real package (the
+  paths.  ``native-endian`` closes the byte-order hole: every native
+  parser a schema claims whose extracted read stream contains a
+  multi-byte scalar must be covered by a runtime parity-fuzz target
+  (cross-checked against :func:`brpc_tpu.analysis.fuzz.coverage_map`),
+  so an endianness mismatch cannot hide in a parser no fuzzer drives.
+  These run only when the scan covers the real package (the
   native tree is located relative to ``brpc_tpu/``).
 
 Findings carry a stable id (hash of check + package-relative path +
@@ -145,17 +188,19 @@ __all__ = ["Finding", "run_lint", "lint_files", "main", "ALL_CHECKS",
 
 ALL_CHECKS = ("ctypes-contract", "fiber-shared-state", "obs-guard",
               "trace-purity", "lock-order", "fiber-blocking-sleep",
-              "handle-lifecycle", "wire-contract",
+              "handle-lifecycle", "exception-flow",
+              "lock-exception-safety", "wire-contract",
               "wire-contract-native", "native-errors",
-              "native-handle-balance")
+              "native-handle-balance", "native-endian")
 
 #: checks implemented by the cross-language tier (analysis.native)
 _NATIVE_CHECKS = ("wire-contract-native", "native-errors",
-                  "native-handle-balance")
+                  "native-handle-balance", "native-endian")
 
 #: checks that need the whole-package call graph
 _GRAPH_CHECKS = {"fiber-shared-state", "trace-purity", "lock-order",
                  "fiber-blocking-sleep", "handle-lifecycle",
+                 "exception-flow", "lock-exception-safety",
                  "wire-contract"}
 
 #: attribute names that look like a lock on self / a module
@@ -1152,9 +1197,7 @@ def _collect_checked_locks(scans: List[_FileScan], graph: CallGraph
                                 mi.name, {})[tgt.id] = entries
             elif isinstance(stmt, ast.ClassDef):
                 # class-scope literal dicts: `self.LOCKS["a"]` binds by
-                # key exactly like the module-level form (direct class
-                # body only — no inheritance walk; a subclass override
-                # would shadow the mapping anyway)
+                # key exactly like the module-level form
                 for inner in stmt.body:
                     if not (isinstance(inner, ast.Assign)
                             and isinstance(inner.value, ast.Dict)):
@@ -1166,6 +1209,90 @@ def _collect_checked_locks(scans: List[_FileScan], graph: CallGraph
                                 ccont_locks.setdefault(
                                     (mi.name, stmt.name),
                                     {})[tgt.id] = entries
+    # Third sweep: INHERITED class-scope containers.  `self.LOCKS["a"]`
+    # in a subclass resolves through the base chain's DIRECT class
+    # bodies (nearest assignment wins, bases left-to-right depth-first
+    # through the call graph's class resolution).  Any direct
+    # assignment of the same name in a nearer class shadows the
+    # inherited mapping — a class that rebuilds the container
+    # non-literally stays deferred — and a container MUTATED anywhere
+    # along the chain (subscript-store or in-place mutator on
+    # ``self.<attr>``) is never inherited: dynamic-harness territory,
+    # same policy as the module-level form.
+    cls_defs: Dict[Tuple[str, str], Tuple[object, ast.ClassDef]] = {}
+    cls_assigned: Dict[Tuple[str, str], Set[str]] = {}
+    cls_mutated: Dict[Tuple[str, str], Set[str]] = {}
+    for sc in scans:
+        mi = mi_by_path.get(sc.path)
+        if mi is None:
+            continue
+        for stmt in sc.tree.body:
+            if not isinstance(stmt, ast.ClassDef):
+                continue
+            key = (mi.name, stmt.name)
+            cls_defs[key] = (mi, stmt)
+            names: Set[str] = set()
+            for inner in stmt.body:
+                if isinstance(inner, ast.Assign):
+                    names.update(t.id for t in inner.targets
+                                 if isinstance(t, ast.Name))
+                elif isinstance(inner, ast.AnnAssign) and \
+                        isinstance(inner.target, ast.Name):
+                    names.add(inner.target.id)
+            cls_assigned[key] = names
+            mut: Set[str] = set()
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Assign, ast.Delete)):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript) and \
+                                isinstance(t.value, ast.Attribute):
+                            mut.add(t.value.attr)
+                elif isinstance(node, ast.AugAssign) and \
+                        isinstance(node.target, ast.Subscript) and \
+                        isinstance(node.target.value, ast.Attribute):
+                    mut.add(node.target.value.attr)
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATORS and \
+                        isinstance(node.func.value, ast.Attribute):
+                    mut.add(node.func.value.attr)
+            cls_mutated[key] = mut
+
+    def chain(key: Tuple[str, str],
+              seen: Set[Tuple[str, str]]) -> List[Tuple[str, str]]:
+        if key in seen or key not in cls_defs:
+            return []
+        seen.add(key)
+        cmi, cdef = cls_defs[key]
+        out = [key]
+        for base in cdef.bases:
+            bname = _last_name(base)
+            if bname is None:
+                continue
+            binfo = graph._resolve_class(cmi, bname)
+            if binfo is None:
+                continue
+            out.extend(chain((binfo.module, binfo.name), seen))
+        return out
+
+    for key in list(cls_defs):
+        order = chain(key, set())
+        if len(order) < 2:
+            continue
+        mutated_chain: Set[str] = set()
+        for k in order:
+            mutated_chain |= cls_mutated.get(k, set())
+        claimed: Set[str] = set()
+        for k in order:
+            for attr in sorted(cls_assigned.get(k, ())):
+                if attr in claimed:
+                    continue
+                claimed.add(attr)
+                if k == key:
+                    continue          # direct entries already collected
+                entries = ccont_locks.get(k, {}).get(attr)
+                if entries and attr not in mutated_chain:
+                    ccont_locks.setdefault(key, {})[attr] = dict(entries)
     return mod_locks, cls_locks, cont_locks, ccont_locks
 
 
@@ -1184,13 +1311,15 @@ def _order_path(adj: Dict[str, Set[str]], src: str,
     return None
 
 
-def _check_lock_order(scans: List[_FileScan],
-                      graph: CallGraph) -> List[Finding]:
-    mod_locks, cls_locks, cont_locks, ccont_locks = \
-        _collect_checked_locks(scans, graph)
-    if not mod_locks and not cls_locks and not cont_locks \
-            and not ccont_locks:
-        return []
+def _make_lock_resolver(graph: CallGraph,
+                        mod_locks: Dict[str, Dict[str, str]],
+                        cls_locks: Dict[Tuple[str, str], Dict[str, str]],
+                        cont_locks: Dict[str, Dict[str, Dict[str, str]]],
+                        ccont_locks: Dict[Tuple[str, str],
+                                          Dict[str, Dict[str, str]]]):
+    """Shared lock-expression resolver over the maps from
+    :func:`_collect_checked_locks` — used by ``lock-order`` and
+    ``lock-exception-safety`` so both checks name locks identically."""
 
     def _target_module(node: FuncNode, root: str):
         """Resolve an imported-module alias / from-import in ``node``'s
@@ -1278,6 +1407,19 @@ def _check_lock_order(scans: List[_FileScan],
                 return param_locks[expr.id]
             return mod_locks.get(node.module, {}).get(expr.id)
         return None
+
+    return resolve_lock
+
+
+def _check_lock_order(scans: List[_FileScan],
+                      graph: CallGraph) -> List[Finding]:
+    mod_locks, cls_locks, cont_locks, ccont_locks = \
+        _collect_checked_locks(scans, graph)
+    if not mod_locks and not cls_locks and not cont_locks \
+            and not ccont_locks:
+        return []
+    resolve_lock = _make_lock_resolver(graph, mod_locks, cls_locks,
+                                       cont_locks, ccont_locks)
 
     # acquisition edges: (held, acquired) -> first site (path, line, chain)
     edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
@@ -1389,6 +1531,216 @@ def _check_lock_order(scans: List[_FileScan],
 
 
 # ---------------------------------------------------------------------------
+# check: lock-exception-safety (manual acquire/release across throwing edges)
+# ---------------------------------------------------------------------------
+
+
+def _check_lock_exception_safety(scans: List[_FileScan],
+                                 graph: CallGraph) -> List[Finding]:
+    """Two exception-unwind obligations on the may-throw fixpoint:
+
+    1. a ``checked_lock``/``checked_rwlock`` acquired via a bare
+       ``.acquire()`` (outside ``with``) and still held at a site the
+       fixpoint PROVES can raise — unless an enclosing ``finally``
+       releases the lock or a handler that catches every thrown type
+       does — leaves the lock held forever on the unwinding edge;
+    2. a fence flag (``self.x = True`` … ``self.x = False`` in the same
+       block) with a proven-throwing site between set and reset and no
+       ``try/finally`` resetting it — the flag is left half-done.
+
+    Unresolved calls (external confidence) never produce findings."""
+    mod_locks, cls_locks, cont_locks, ccont_locks = \
+        _collect_checked_locks(scans, graph)
+    findings: List[Finding] = []
+    resolve_lock = _make_lock_resolver(graph, mod_locks, cls_locks,
+                                       cont_locks, ccont_locks)
+    sc_paths = {sc.path for sc in scans}
+    reported: Set[Tuple[str, str]] = set()
+
+    def releases_in(stmts: List[ast.AST], fnode: FuncNode) -> Set[str]:
+        out: Set[str] = set()
+        for s in stmts:
+            for n in ast.walk(s):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "release":
+                    ln = resolve_lock(n.func.value, fnode)
+                    if ln is not None:
+                        out.add(ln)
+        return out
+
+    def throw_events(n: ast.AST
+                     ) -> Optional[Tuple[List[Optional[str]], str]]:
+        """(thrown types, description) when ``n`` is a proven-throwing
+        site — an explicit raise or a call with a proven summary."""
+        if isinstance(n, ast.Raise):
+            t = graph.raised_type_name(n)
+            return [t], f"raise {t or 'of a dynamic type'}"
+        if isinstance(n, ast.Call):
+            tgt = graph.call_target(n)
+            if tgt is None:
+                return None
+            summ = graph.throw_summary(tgt)
+            if not summ.may_throw:
+                return None
+            thrown = list(summ.types) + ([None] if summ.unknown else [])
+            callee = graph.nodes.get(tgt)
+            cdisp = _node_display(callee) if callee else tgt
+            tdesc = "/".join(summ.types) if summ.types else "an exception"
+            return thrown, f"call to {cdisp}, which can raise {tdesc}"
+        return None
+
+    def flag_held(fnode: FuncNode, held: Dict[str, int], line: int,
+                  thrown: List[Optional[str]], desc: str,
+                  fin_locks: Set[str], scopes: Tuple) -> None:
+        for lname in sorted(held):
+            if lname in fin_locks:
+                continue
+            if all(any(graph.exception_catches(c, t) and lname in rel
+                       for c, rel in scopes) for t in thrown):
+                continue
+            key = (fnode.node_id, lname)
+            if key in reported:
+                continue
+            reported.add(key)
+            findings.append(Finding(
+                "lock-exception-safety", fnode.path, line,
+                f"{_node_display(fnode)}: checked lock '{lname}' "
+                f"acquired at line {held[lname]} outside `with` is "
+                f"still held at this may-throw site ({desc}) — the "
+                f"unwinding edge leaves it locked forever; acquire "
+                f"with `with` or pair acquire/release in try/finally"))
+
+    def scan(n: ast.AST, fnode: FuncNode, held: Dict[str, int],
+             fin_locks: Set[str], scopes: Tuple) -> None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(n, ast.Try):
+            fin2 = fin_locks | releases_in(list(n.finalbody), fnode)
+            sc2 = scopes + tuple(
+                (graph.handler_catch_names(h),
+                 frozenset(releases_in(list(h.body), fnode)))
+                for h in n.handlers)
+            for s in n.body:
+                scan(s, fnode, held, fin2, sc2)
+            for s in n.orelse:
+                scan(s, fnode, held, fin2, scopes)
+            for h in n.handlers:
+                for s in h.body:
+                    scan(s, fnode, held, fin_locks, scopes)
+            for s in n.finalbody:
+                scan(s, fnode, held, fin_locks, scopes)
+            return
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in ("acquire", "release"):
+                ln = resolve_lock(f.value, fnode)
+                if ln is not None:
+                    if f.attr == "acquire":
+                        held[ln] = n.lineno
+                    else:
+                        held.pop(ln, None)
+                    return
+            ev = throw_events(n)
+            if ev is not None and held:
+                flag_held(fnode, held, n.lineno, ev[0], ev[1],
+                          fin_locks, scopes)
+        elif isinstance(n, ast.Raise) and held:
+            ev = throw_events(n)
+            flag_held(fnode, held, n.lineno, ev[0], ev[1], fin_locks,
+                      scopes)
+        for child in ast.iter_child_nodes(n):
+            scan(child, fnode, held, fin_locks, scopes)
+
+    def scan_flags(fnode: FuncNode) -> None:
+        """Fence flags: self.<x> = True ... self.<x> = False with a
+        proven-throwing site between, no finally resetting it."""
+
+        def flag_attr(s: ast.AST, value: bool) -> Optional[str]:
+            if isinstance(s, ast.Assign) and len(s.targets) == 1 and \
+                    isinstance(s.value, ast.Constant) and \
+                    s.value.value is value:
+                return _self_attr_of(s.targets[0])
+            return None
+
+        def first_throw_in(s: ast.AST, attr: str
+                           ) -> Optional[Tuple[int, str]]:
+            # skip subtrees protected by a finally that resets the flag
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                return None
+            if isinstance(s, ast.Try) and any(
+                    flag_attr(fs, False) == attr or
+                    flag_attr(fs, True) == attr
+                    for fs in s.finalbody):
+                return None
+            ev = throw_events(s)
+            if ev is not None:
+                return s.lineno, ev[1]
+            for child in ast.iter_child_nodes(s):
+                hit = first_throw_in(child, attr)
+                if hit is not None:
+                    return hit
+            return None
+
+        def blocks(n: ast.AST):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)) and \
+                    n is not fnode.fn:
+                return
+            for field in ("body", "orelse", "finalbody"):
+                b = getattr(n, field, None)
+                if isinstance(b, list) and b and isinstance(b[0], ast.stmt):
+                    yield b
+            for child in ast.iter_child_nodes(n):
+                yield from blocks(child)
+
+        for block in blocks(fnode.fn):
+            pending: Dict[str, Tuple[int, int]] = {}
+            for idx, s in enumerate(block):
+                a_set = flag_attr(s, True)
+                if a_set is not None:
+                    pending[a_set] = (s.lineno, idx)
+                    continue
+                a_clr = flag_attr(s, False)
+                if a_clr is not None and a_clr in pending:
+                    set_line, set_idx = pending.pop(a_clr)
+                    for span_stmt in block[set_idx + 1:idx]:
+                        hit = first_throw_in(span_stmt, a_clr)
+                        if hit is None:
+                            continue
+                        key = (fnode.node_id, f"flag:{a_clr}")
+                        if key in reported:
+                            break
+                        reported.add(key)
+                        findings.append(Finding(
+                            "lock-exception-safety", fnode.path, hit[0],
+                            f"{_node_display(fnode)}: fence flag "
+                            f"self.{a_clr} is set at line {set_line} "
+                            f"and reset at line {s.lineno}, but this "
+                            f"may-throw site between them ({hit[1]}) "
+                            f"can unwind with the flag still set — "
+                            f"half-done obligation; reset it in a "
+                            f"finally"))
+                        break
+
+    for node_id in sorted(graph.nodes):
+        fnode = graph.nodes[node_id]
+        if not isinstance(fnode.fn, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+            continue
+        if fnode.path not in sc_paths:
+            continue
+        held: Dict[str, int] = {}
+        for stmt in fnode.fn.body:
+            scan(stmt, fnode, held, set(), ())
+        scan_flags(fnode)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # check: handle-lifecycle (interprocedural ownership over the call graph)
 # ---------------------------------------------------------------------------
 
@@ -1397,15 +1749,31 @@ class _HBinding:
     flow state SHARE binding objects, so a release observed on any path
     marks the same object every sibling path sees — reporting stays
     may-leak at explicit exits (the state at THAT point) and must-leak
-    nowhere (no false positives from merge order)."""
+    nowhere (no false positives from merge order).
 
-    __slots__ = ("kind", "line", "origin", "released")
+    A binding with ``members is not None`` is a LOCAL CONTAINER (``pcs =
+    []``) rather than a handle: appends of owned handles move their
+    obligation into ``members`` (the may-leak set), and the container is
+    released by draining it (a loop or comprehension releasing each
+    element), returning it, or storing it on an owner."""
 
-    def __init__(self, kind: str, line: int, origin: str = ""):
+    __slots__ = ("kind", "line", "origin", "released", "members")
+
+    def __init__(self, kind: str, line: int, origin: str = "",
+                 members: Optional[Set[str]] = None):
         self.kind = kind
         self.line = line
         self.origin = origin
         self.released = False
+        self.members = members
+
+    @property
+    def live(self) -> bool:
+        """Carries an unmet obligation (a container is only live once it
+        actually holds handles)."""
+        if self.released:
+            return False
+        return self.members is None or bool(self.members)
 
 
 def _handle_producer_nodes(graph: CallGraph) -> Dict[str, str]:
@@ -1551,12 +1919,16 @@ def _self_attr_of(tgt: ast.AST) -> Optional[str]:
     return None
 
 
-def _check_handle_lifecycle(scans: List[_FileScan],
-                            graph: CallGraph) -> List[Finding]:
+def _check_handle_lifecycle(scans: List[_FileScan], graph: CallGraph,
+                            active: Set[str]) -> List[Finding]:
+    """Runs the shared handle-flow machinery; normal-path findings carry
+    check ``handle-lifecycle``, implicit-exception-edge findings carry
+    ``exception-flow`` — ``active`` picks which of the two surface."""
     sc_by_path = {sc.path: sc for sc in scans}
     producers = _handle_producer_nodes(graph)
     findings: List[Finding] = []
-    findings.extend(_check_abi_pairing(scans))
+    if "handle-lifecycle" in active:
+        findings.extend(_check_abi_pairing(scans))
     if not producers:
         return findings
     sources = _handle_sources(graph, producers)
@@ -1569,8 +1941,76 @@ def _check_handle_lifecycle(scans: List[_FileScan],
         if sc is None:
             continue
         _flow_handles(sc, graph, node, producers, sources, attr_stores,
-                      findings)
-    findings.extend(_audit_attr_stores(attr_stores, graph, sc_by_path))
+                      findings, active)
+    if "handle-lifecycle" in active:
+        findings.extend(_audit_attr_stores(attr_stores, graph, sc_by_path))
+        findings.extend(_audit_module_producers(graph, sc_by_path,
+                                                producers, sources))
+    return findings
+
+
+def _audit_module_producers(graph: CallGraph,
+                            sc_by_path: Dict[str, "_FileScan"],
+                            producers: Dict[str, str],
+                            sources: Dict[str, Tuple[str, str]]
+                            ) -> List[Finding]:
+    """Module-scope producers audited like attr stores: a global bound
+    to a fresh owning handle at import time is fine only if some
+    function in the same module releases it (a shutdown/atexit path) —
+    otherwise nothing can ever free it."""
+    findings: List[Finding] = []
+    for mod_name in sorted(graph.modules):
+        mi = graph.modules[mod_name]
+        sc = sc_by_path.get(mi.path)
+        if sc is None:
+            continue
+        # (global name, kind, line) for module-level producer assigns;
+        # walk top-level statements but never into defs/classes (those
+        # flows are audited per-function)
+        bound: List[Tuple[str, str, int]] = []
+
+        def top_walk(n: ast.AST) -> None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                pk = _producer_kind(n.value, graph, mod_name, producers,
+                                    sources)
+                if pk is not None:
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            bound.append((t.id, pk[0], n.lineno))
+            for child in ast.iter_child_nodes(n):
+                top_walk(child)
+
+        for stmt in mi.tree.body:
+            top_walk(stmt)
+        for gname, kind, line in bound:
+            if sc.line_has(line, _ALLOW_HANDLE_ESCAPE):
+                continue
+            releases = _HANDLE_OWNERS.get(kind, frozenset({"close"}))
+            released = False
+            for node in graph.nodes.values():
+                if node.module != mod_name or released:
+                    continue
+                for n in ast.walk(node.fn):
+                    if isinstance(n, ast.Call) and \
+                            isinstance(n.func, ast.Attribute) and \
+                            isinstance(n.func.value, ast.Name) and \
+                            n.func.value.id == gname and \
+                            n.func.attr in releases:
+                        released = True
+                        break
+            if not released:
+                findings.append(Finding(
+                    "handle-lifecycle", sc.path, line,
+                    f"module-scope {kind} bound to global '{gname}' at "
+                    f"import time, but no function in this module ever "
+                    f"releases it ({'/'.join(sorted(releases))}) — the "
+                    f"native handle lives until process exit with no "
+                    f"shutdown path; add one (atexit or an explicit "
+                    f"close hook) or mark a deliberate singleton with "
+                    f"`# {_ALLOW_HANDLE_ESCAPE}`"))
     return findings
 
 
@@ -1609,15 +2049,25 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                   producers: Dict[str, str],
                   sources: Dict[str, Tuple[str, str]],
                   attr_stores: List[Tuple[str, str, str, str, int, str]],
-                  findings: List[Finding]) -> None:
+                  findings: List[Finding], active: Set[str]) -> None:
     """Abstract interpretation of one function body: owning handles must
     reach a release on every normal-flow path, be returned, be stored on
-    self (audited separately), or carry the escape pragma.  Exception
-    paths are modeled at explicit ``raise`` statements: a handle still
-    live there leaks unless an enclosing ``finally`` or a catching
-    ``except`` handler releases it (``except_rel`` threads the handler
-    releases, same shape as the try/finally support).  Implicit throws
-    from callees remain out of scope."""
+    self (audited separately), or carry the escape pragma.
+
+    Exception paths are modeled at explicit ``raise`` statements AND at
+    every call whose resolved callee the may-throw fixpoint PROVES can
+    raise (``exception-flow`` findings): a handle still live there leaks
+    unless an enclosing ``finally``/``with`` releases it or an ``except``
+    handler that (a) lexically encloses that site and (b) can catch the
+    thrown type releases it — handler trust is scoped per ``try`` and
+    per exception type, never context-insensitive.  Unresolved calls
+    carry only the low-confidence ``external`` tag and never produce a
+    finding.
+
+    Handles appended to LOCAL containers become a tracked may-leak set
+    (the container must be drained/returned/stored), rebinding a live
+    handle's only name is a drop, and module-scope producers are audited
+    separately (:func:`_audit_module_producers`)."""
     display = _node_display(node)
 
     def kind_of(call: ast.Call) -> Optional[Tuple[str, str]]:
@@ -1630,9 +2080,10 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
     def releases_of(kind: str) -> frozenset:
         return _HANDLE_OWNERS.get(kind, frozenset({"close"}))
 
-    def report(line: int, msg: str) -> None:
-        if not allow(line):
-            findings.append(Finding("handle-lifecycle", sc.path, line, msg))
+    def report(line: int, msg: str, check: str = "handle-lifecycle"
+               ) -> None:
+        if check in active and not allow(line):
+            findings.append(Finding(check, sc.path, line, msg))
 
     # producer calls consumed inline by a chained release
     # (`ch.call_async(...).join()`): collected up front, skipped later
@@ -1649,40 +2100,156 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
         if b is not None:
             b.released = True
 
+    def fork_state(state: Dict[str, _HBinding]) -> Dict[str, _HBinding]:
+        """A copy with CLONED bindings: releases observed inside it stay
+        inside it.  Except-handler bodies run on forks — a handler's
+        release covers only the exception edges of its own try (via the
+        scope entries), never the fall-through path after the try."""
+        out: Dict[str, _HBinding] = {}
+        for name, b in state.items():
+            nb = _HBinding(b.kind, b.line, b.origin,
+                           None if b.members is None else set(b.members))
+            nb.released = b.released
+            out[name] = nb
+        return out
+
+    def handler_covers(name: str, raised: Optional[str],
+                       scopes: Tuple[Tuple[Optional[frozenset],
+                                           frozenset], ...]) -> bool:
+        """Does some enclosing handler that can catch ``raised`` release
+        ``name``?  Scoped trust: ``scopes`` holds only the handlers of
+        the trys lexically enclosing the SITE being judged."""
+        return any(graph.exception_catches(catch, raised) and name in rel
+                   for catch, rel in scopes)
+
+    # exception-flow reports at most one throwing site per binding — the
+    # first unprotected one is the leak edge worth fixing
+    throw_reported: Set[int] = set()
+
+    def report_throw(state: Dict[str, _HBinding], call: ast.Call,
+                     tgt: str, summ, fin_rel: Set[str],
+                     scopes: Tuple) -> None:
+        thrown = list(summ.types) + ([None] if summ.unknown else [])
+        callee = graph.nodes.get(tgt)
+        cdisp = _node_display(callee) if callee else tgt
+        tdesc = "/".join(summ.types) if summ.types else "an exception"
+        if summ.unknown and summ.types:
+            tdesc += " (and unknown types)"
+        for name, b in sorted(state.items()):
+            if not b.live or name in fin_rel or allow(b.line):
+                continue
+            if all(handler_covers(name, t, scopes) for t in thrown):
+                continue
+            if id(b) in throw_reported:
+                continue
+            throw_reported.add(id(b))
+            if b.members is not None:
+                what = (f"container '{name}' holding owned "
+                        f"{'/'.join(sorted(b.members))} handles "
+                        f"(filled since line {b.line})")
+            else:
+                what = (f"{b.kind} '{name}' (created line {b.line}"
+                        f"{b.origin})")
+            report(call.lineno,
+                   f"{display}: {what} is live across this call to "
+                   f"{cdisp}, which can raise {tdesc} — on that "
+                   f"unwinding edge the handle leaks; hold it in a "
+                   f"`with`/try-finally or release it before the call",
+                   check="exception-flow")
+
+    def maybe_report_throw(call: ast.Call, state: Dict[str, _HBinding],
+                           fin_rel: Set[str], scopes: Tuple) -> None:
+        if "exception-flow" not in active:
+            return
+        tgt = graph.call_target(call)
+        if tgt is None:
+            return  # unresolved: external-only confidence, no finding
+        summ = graph.throw_summary(tgt)
+        if summ.may_throw:
+            report_throw(state, call, tgt, summ, fin_rel, scopes)
+
     def scan_expr(n: ast.AST, state: Dict[str, _HBinding],
-                  transfer: bool) -> None:
+                  transfer: bool, fin_rel: Set[str] = frozenset(),
+                  scopes: Tuple = ()) -> None:
         """Generic walk of an expression: classifies producer calls and
         owned-name stores that the statement dispatch didn't already
         claim.  `transfer` marks return-value context (everything the
-        expression mentions goes to the caller)."""
+        expression mentions goes to the caller).  ``fin_rel``/``scopes``
+        carry the enclosing finally/handler coverage for judging
+        throwing call sites."""
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                           ast.Lambda)):
             return  # nested scopes audit themselves
+        if isinstance(n, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            # `[pc.join() for pc in pcs]`: draining a tracked container
+            for gen in n.generators:
+                if not (isinstance(gen.iter, ast.Name)
+                        and isinstance(gen.target, ast.Name)):
+                    continue
+                cb = state.get(gen.iter.id)
+                if cb is None or cb.members is None or not cb.members:
+                    continue
+                rel = set().union(*(releases_of(k) for k in cb.members))
+                rel |= {"cancel"}
+                for leaf in ast.walk(n.elt):
+                    if isinstance(leaf, ast.Call) and \
+                            isinstance(leaf.func, ast.Attribute) and \
+                            isinstance(leaf.func.value, ast.Name) and \
+                            leaf.func.value.id == gen.target.id and \
+                            leaf.func.attr in rel:
+                        cb.released = True
         if isinstance(n, ast.Call):
             f = n.func
             # x.close() / x.join() — release of an owned local
             if isinstance(f, ast.Attribute) and \
                     isinstance(f.value, ast.Name):
                 b = state.get(f.value.id)
-                if b is not None and f.attr in releases_of(b.kind):
+                if b is not None and b.members is None and \
+                        f.attr in releases_of(b.kind):
                     b.released = True
             # container.append(x) / registry.add(x): ownership moves
-            # into a container the check cannot follow
+            # into a container.  A LOCAL container binding tracks the
+            # obligation as a may-leak set; anything else (module
+            # global, attr, parameter) is an escape the check cannot
+            # follow.
             if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
-                for arg in n.args:
-                    for leaf in ast.walk(arg):
-                        if isinstance(leaf, ast.Name) and \
-                                leaf.id in state and \
-                                not state[leaf.id].released:
-                            report(n.lineno,
-                                   f"{display}: owned "
-                                   f"{state[leaf.id].kind} '{leaf.id}' "
-                                   f"escapes into a container via "
-                                   f".{f.attr}() — the static check "
-                                   f"cannot see its release; mark a "
-                                   f"deliberate registry with "
-                                   f"`# {_ALLOW_HANDLE_ESCAPE}`")
-                            state[leaf.id].released = True
+                recv = state.get(f.value.id) \
+                    if isinstance(f.value, ast.Name) else None
+                if recv is not None and recv.members is not None and \
+                        f.attr in {"append", "add", "appendleft",
+                                   "insert"}:
+                    deliberate = allow(n.lineno)
+                    for arg in n.args:
+                        if isinstance(arg, ast.Call) and \
+                                id(arg) not in consumed:
+                            pk2 = kind_of(arg)
+                            if pk2 is not None and not deliberate:
+                                recv.members.add(pk2[0])
+                        for leaf in ast.walk(arg):
+                            if isinstance(leaf, ast.Name) and \
+                                    leaf.id in state and \
+                                    state[leaf.id].live and \
+                                    state[leaf.id].members is None:
+                                if not deliberate:
+                                    recv.members.add(state[leaf.id].kind)
+                                state[leaf.id].released = True
+                else:
+                    for arg in n.args:
+                        for leaf in ast.walk(arg):
+                            if isinstance(leaf, ast.Name) and \
+                                    leaf.id in state and \
+                                    state[leaf.id].live and \
+                                    state[leaf.id].members is None:
+                                report(n.lineno,
+                                       f"{display}: owned "
+                                       f"{state[leaf.id].kind} "
+                                       f"'{leaf.id}' escapes into a "
+                                       f"container via .{f.attr}() — "
+                                       f"the static check cannot see "
+                                       f"its release; mark a "
+                                       f"deliberate registry with "
+                                       f"`# {_ALLOW_HANDLE_ESCAPE}`")
+                                state[leaf.id].released = True
             # threading.Thread(target=..., args=(x,)): the handle's
             # lifetime now belongs to a thread this walk can't follow
             if _last_name(f) == "Thread":
@@ -1710,10 +2277,15 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                     # the callee (under-approximation); everything else
                     # is a drop, reported by the statement dispatch
                     pass
+            # a PROVEN-throwing callee unwinds through here: every live
+            # handle not covered by finally/with or a catching handler
+            # leaks on that edge (releases above ran first, so a
+            # release call never flags its own receiver)
+            maybe_report_throw(n, state, fin_rel, scopes)
         if isinstance(n, ast.Name) and transfer:
             release_name(state, n.id)
         for child in ast.iter_child_nodes(n):
-            scan_expr(child, state, transfer)
+            scan_expr(child, state, transfer, fin_rel, scopes)
 
     def container_producers(value: ast.AST) -> List[ast.Call]:
         """Producer calls nested under a non-call expression (list/tuple/
@@ -1741,57 +2313,83 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
         return names
 
     def report_exit(state: Dict[str, _HBinding], line: int,
-                    finally_rel: Set[str], where: str) -> None:
+                    finally_rel: Set[str], where: str,
+                    scopes: Tuple = (),
+                    raised: Tuple = ()) -> None:
+        """``raised`` is the tuple of thrown type names (None = unknown)
+        when this exit is an exception edge; a handler scope covers a
+        name only if it catches EVERY thrown type and releases the
+        name.  Empty ``raised`` (return/fall-through) means handler
+        coverage does not apply."""
         for name, b in sorted(state.items()):
-            if b.released or name in finally_rel:
+            if not b.live or name in finally_rel:
                 continue
             if allow(b.line):
                 continue
-            report(line,
-                   f"{display}: {b.kind} '{name}' (created line {b.line}"
-                   f"{b.origin}) is still live at this {where} — this "
-                   f"path leaks the native handle; release it "
-                   f"({'/'.join(sorted(releases_of(b.kind)))}), return "
-                   f"it, or store it on an owner whose close releases it")
+            if raised and all(handler_covers(name, t, scopes)
+                              for t in raised):
+                continue
+            if b.members is not None:
+                report(line,
+                       f"{display}: local container '{name}' still "
+                       f"holds owned {'/'.join(sorted(b.members))} "
+                       f"handle(s) (filled since line {b.line}) at this "
+                       f"{where} — the may-leak set was never drained; "
+                       f"release every element, return the container, "
+                       f"or store it on an owner whose close drains it")
+            else:
+                report(line,
+                       f"{display}: {b.kind} '{name}' (created line "
+                       f"{b.line}{b.origin}) is still live at this "
+                       f"{where} — this path leaks the native handle; "
+                       f"release it "
+                       f"({'/'.join(sorted(releases_of(b.kind)))}), "
+                       f"return it, or store it on an owner whose close "
+                       f"releases it")
 
     def exec_block(stmts: List[ast.AST], state: Dict[str, _HBinding],
-                   finally_rel: Set[str], except_rel: Set[str]
+                   finally_rel: Set[str], exc_scopes: Tuple
                    ) -> Tuple[Dict[str, _HBinding], bool]:
         """Returns (state after the block, terminated-by-return/raise).
-        ``except_rel`` holds names released by every enclosing handler
-        that would catch a raise here — the exception-path analogue of
-        ``finally_rel``."""
+        ``exc_scopes`` holds one ``(catch-set, released-names)`` entry
+        per handler of every ``try`` lexically enclosing this block —
+        coverage is judged per site and per thrown type, so a handler is
+        trusted only for raises it both encloses and catches."""
         for stmt in stmts:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
                 continue
             if isinstance(stmt, ast.Return):
                 if stmt.value is not None:
-                    scan_expr(stmt.value, state, transfer=True)
+                    scan_expr(stmt.value, state, transfer=True,
+                              fin_rel=finally_rel, scopes=exc_scopes)
                 report_exit(state, stmt.lineno, finally_rel,
                             "early return" if stmt is not stmts[-1]
                             or stmt.value is None else "return")
                 return state, True
             if isinstance(stmt, ast.Raise):
                 # the exception path IS a function exit: anything still
-                # live here leaks unless a finally or a catching except
-                # handler releases it on the way out
-                scan_expr(stmt, state, transfer=False)
-                report_exit(state, stmt.lineno,
-                            finally_rel | except_rel,
-                            "raise (exception path)")
+                # live here leaks unless a finally or an enclosing
+                # handler that CATCHES this raise releases it
+                scan_expr(stmt, state, transfer=False,
+                          fin_rel=finally_rel, scopes=exc_scopes)
+                report_exit(state, stmt.lineno, finally_rel,
+                            "raise (exception path)", scopes=exc_scopes,
+                            raised=(graph.raised_type_name(stmt),))
                 return state, True
             if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
-                _exec_assign(stmt, state)
+                _exec_assign(stmt, state, finally_rel, exc_scopes)
                 continue
             if isinstance(stmt, ast.Expr):
-                _exec_expr_stmt(stmt, state)
+                _exec_expr_stmt(stmt, state, finally_rel, exc_scopes)
                 continue
             if isinstance(stmt, ast.If):
+                scan_expr(stmt.test, state, transfer=False,
+                          fin_rel=finally_rel, scopes=exc_scopes)
                 s1, t1 = exec_block(list(stmt.body), dict(state),
-                                    finally_rel, except_rel)
+                                    finally_rel, exc_scopes)
                 s2, t2 = exec_block(list(stmt.orelse), dict(state),
-                                    finally_rel, except_rel)
+                                    finally_rel, exc_scopes)
                 if t1 and t2:
                     return state, True
                 merged: Dict[str, _HBinding] = {}
@@ -1805,16 +2403,40 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                 continue
             if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
                 scan_expr(getattr(stmt, "iter", None) or stmt.test,
-                          state, transfer=False)
+                          state, transfer=False, fin_rel=finally_rel,
+                          scopes=exc_scopes)
+                # `for pc in pcs: pc.join()` — draining a tracked
+                # container releases its may-leak set
+                it = getattr(stmt, "iter", None)
+                if isinstance(it, ast.Name) and \
+                        isinstance(getattr(stmt, "target", None),
+                                   ast.Name):
+                    cb = state.get(it.id)
+                    if cb is not None and cb.members:
+                        rel = set().union(*(releases_of(k)
+                                            for k in cb.members))
+                        rel |= {"cancel"}
+                        for bstmt in stmt.body:
+                            for leaf in ast.walk(bstmt):
+                                if isinstance(leaf, ast.Call) and \
+                                        isinstance(leaf.func,
+                                                   ast.Attribute) and \
+                                        isinstance(leaf.func.value,
+                                                   ast.Name) and \
+                                        leaf.func.value.id == \
+                                        stmt.target.id and \
+                                        leaf.func.attr in rel:
+                                    cb.released = True
                 body_state, _t = exec_block(list(stmt.body), dict(state),
-                                            finally_rel, except_rel)
+                                            finally_rel, exc_scopes)
                 for name, b in body_state.items():
                     if name not in state:
                         state[name] = b
                 exec_block(list(stmt.orelse), state, finally_rel,
-                           except_rel)
+                           exc_scopes)
                 continue
             if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                with_names: List[str] = []
                 for item in stmt.items:
                     pk = kind_of(item.context_expr) \
                         if isinstance(item.context_expr, ast.Call) else None
@@ -1822,39 +2444,59 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                             isinstance(item.optional_vars, ast.Name):
                         state[item.optional_vars.id] = _HBinding(
                             pk[0], stmt.lineno, pk[1])
+                        with_names.append(item.optional_vars.id)
                     else:
-                        scan_expr(item.context_expr, state, transfer=False)
-                state, t = exec_block(list(stmt.body), state, finally_rel,
-                                      except_rel)
+                        # `with ch:` / `with closing(ch):` over an owned
+                        # binding — __exit__ releases on every edge
+                        for leaf in ast.walk(item.context_expr):
+                            if isinstance(leaf, ast.Name) and \
+                                    leaf.id in state:
+                                with_names.append(leaf.id)
+                        scan_expr(item.context_expr, state,
+                                  transfer=False, fin_rel=finally_rel,
+                                  scopes=exc_scopes)
+                # inside the block the context manager guarantees
+                # release on any unwind; after it, the handle is done
+                state, t = exec_block(list(stmt.body), state,
+                                      finally_rel | set(with_names),
+                                      exc_scopes)
+                for nm in with_names:
+                    release_name(state, nm)
                 if t:
                     return state, True
                 continue
             if isinstance(stmt, ast.Try):
                 fin_rel = finally_rel | finally_releases(
                     list(stmt.finalbody))
-                # a raise inside the try body lands in these handlers:
-                # whatever they release is covered on that path (same
-                # context-insensitive collection as finally — a handler
-                # that releases at all is trusted to release on the
-                # paths it catches)
-                exc_rel = except_rel | finally_releases(
-                    [s for h in stmt.handlers for s in h.body]) \
-                    if stmt.handlers else except_rel
+                # handler trust is SCOPED: each handler contributes a
+                # (catch-set, released-names) entry that covers only
+                # sites inside THIS try's body, and only for raises its
+                # clause can actually catch
+                scopes_for_body = exc_scopes
+                if stmt.handlers:
+                    scopes_for_body = exc_scopes + tuple(
+                        (graph.handler_catch_names(h),
+                         frozenset(finally_releases(list(h.body))))
+                        for h in stmt.handlers)
                 body_state, body_t = exec_block(list(stmt.body),
                                                 dict(state), fin_rel,
-                                                exc_rel)
+                                                scopes_for_body)
                 branch_states = [] if body_t else [body_state]
                 if not body_t and stmt.orelse:
                     # else runs only after the body completed and is NOT
                     # covered by this try's handlers
                     body_state, t2 = exec_block(list(stmt.orelse),
                                                 body_state, fin_rel,
-                                                except_rel)
+                                                exc_scopes)
                     branch_states = [] if t2 else [body_state]
                 for handler in stmt.handlers:
+                    # forked bindings: a release inside the handler is
+                    # trusted for this try's exception edges (the scope
+                    # entry built above) but never for the code AFTER
+                    # the try — the normal path never ran the handler
                     h_state, h_t = exec_block(list(handler.body),
-                                              dict(state), fin_rel,
-                                              except_rel)
+                                              fork_state(state), fin_rel,
+                                              exc_scopes)
                     if not h_t:
                         branch_states.append(h_state)
                 merged = {}
@@ -1864,17 +2506,19 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                                                   and not b.released):
                             merged[name] = b
                 merged, fin_t = exec_block(list(stmt.finalbody), merged,
-                                           finally_rel, except_rel)
+                                           finally_rel, exc_scopes)
                 if not branch_states or fin_t:
                     return merged, True
                 state = merged
                 continue
             # anything else: scan its expressions generically
             for child in ast.iter_child_nodes(stmt):
-                scan_expr(child, state, transfer=False)
+                scan_expr(child, state, transfer=False,
+                          fin_rel=finally_rel, scopes=exc_scopes)
         return state, False
 
-    def _exec_assign(stmt, state: Dict[str, _HBinding]) -> None:
+    def _exec_assign(stmt, state: Dict[str, _HBinding],
+                     fin_rel: Set[str], scopes: Tuple) -> None:
         targets = stmt.targets if isinstance(stmt, ast.Assign) \
             else [stmt.target]
         value = stmt.value
@@ -1888,8 +2532,26 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
         sub_local_tgts = [t for t in targets
                           if isinstance(t, ast.Subscript)
                           and _self_attr_of(t) is None]
+        # rebinding a live handle's only name drops its obligation —
+        # unless the value still mentions the name (`ch = ch or ...`)
+        value_names = {leaf.id for leaf in ast.walk(value)
+                       if isinstance(leaf, ast.Name)}
+        for t in name_tgts:
+            old = state.get(t.id)
+            if old is not None and old.live and old.members is None and \
+                    t.id not in value_names:
+                report(stmt.lineno,
+                       f"{display}: rebinding '{t.id}' discards the "
+                       f"un-released {old.kind} created line {old.line}"
+                       f"{old.origin} — the old handle leaks with no "
+                       f"name left to release it; release it before "
+                       f"rebinding")
+                old.released = True
         if pk is not None:
             kind, origin = pk
+            # the producer call itself can throw while other handles
+            # are live (second-constructor leak)
+            maybe_report_throw(value, state, fin_rel, scopes)
             if attr_tgts:
                 for attr in attr_tgts:
                     if node.cls is not None:
@@ -1911,17 +2573,35 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                 for t in name_tgts:
                     state[t.id] = _HBinding(kind, stmt.lineno, origin)
                 return
+        # a fresh EMPTY local container: tracked so appended handles
+        # become a may-leak set instead of an opaque escape
+        if name_tgts and not attr_tgts and not sub_local_tgts and (
+                (isinstance(value, (ast.List, ast.Set))
+                 and not value.elts)
+                or (isinstance(value, ast.Dict) and not value.keys)
+                or (isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Name)
+                    and value.func.id in {"list", "set", "deque"}
+                    and not value.args and not value.keywords)):
+            for t in name_tgts:
+                state[t.id] = _HBinding("container", stmt.lineno,
+                                        members=set())
+            return
         # owned name moved onto self.<attr> / into a container
         if isinstance(value, ast.Name) and value.id in state:
             b = state[value.id]
             if attr_tgts and not b.released:
+                kinds = sorted(b.members) if b.members is not None \
+                    else [b.kind]
                 for attr in attr_tgts:
                     if node.cls is not None:
-                        attr_stores.append((node.module, node.cls, attr,
-                                            b.kind, stmt.lineno, sc.path))
+                        for k in kinds:
+                            attr_stores.append((node.module, node.cls,
+                                                attr, k, stmt.lineno,
+                                                sc.path))
                 b.released = True
                 return
-            if sub_local_tgts and not b.released:
+            if sub_local_tgts and b.live and b.members is None:
                 report(stmt.lineno,
                        f"{display}: owned {b.kind} '{value.id}' escapes "
                        f"into a container — mark a deliberate registry "
@@ -1949,10 +2629,11 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                            f"it, so no release path exists; bind it "
                            f"first or mark a deliberate registry with "
                            f"`# {_ALLOW_HANDLE_ESCAPE}`")
-        scan_expr(value, state, transfer=False)
+        scan_expr(value, state, transfer=False, fin_rel=fin_rel,
+                  scopes=scopes)
 
-    def _exec_expr_stmt(stmt: ast.Expr,
-                        state: Dict[str, _HBinding]) -> None:
+    def _exec_expr_stmt(stmt: ast.Expr, state: Dict[str, _HBinding],
+                        fin_rel: Set[str], scopes: Tuple) -> None:
         value = stmt.value
         if isinstance(value, ast.Call) and id(value) not in consumed:
             pk = kind_of(value)
@@ -1964,10 +2645,10 @@ def _flow_handles(sc: _FileScan, graph: CallGraph, node: FuncNode,
                        f"handle leaks immediately; bind it and release "
                        f"it ({'/'.join(sorted(releases_of(kind)))})")
                 return
-        scan_expr(value, state, transfer=False)
+        scan_expr(value, state, transfer=False, fin_rel=fin_rel,
+                  scopes=scopes)
 
-    end_state, terminated = exec_block(list(node.fn.body), {}, set(),
-                                       set())
+    end_state, terminated = exec_block(list(node.fn.body), {}, set(), ())
     if not terminated:
         last = node.fn.body[-1]
         report_exit(end_state, getattr(last, "lineno", node.fn.lineno),
@@ -2142,6 +2823,41 @@ def _segment_streams(fn: ast.AST, struct_consts: Dict[str, str],
         return None
     streams.sort()
     return "".join(s for _ln, s in streams)
+
+
+def _prebranch_stream(fn: ast.AST, struct_consts: Dict[str, str],
+                      direction: str) -> str:
+    """The ``direction`` format stream OUTSIDE every string-keyed
+    dispatch branch of ``fn`` — the shared header a multi-frame handler
+    moves before branching on the discriminant.  Matched against a
+    schema's ``prebranch`` declaration."""
+    excluded: Set[int] = set()
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.If):
+            continue
+        test = n.test
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.Eq)):
+            continue
+        operands = [test.left] + list(test.comparators)
+        if not any(isinstance(c, ast.Constant)
+                   and isinstance(c.value, str) for c in operands):
+            continue
+        for stmt in n.body:
+            for sub in ast.walk(stmt):
+                excluded.add(id(sub))
+    events: List[Tuple[int, int, str]] = []
+    seq = 0
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Call) or id(n) in excluded:
+            continue
+        hit = _call_wire_direction(n, struct_consts)
+        if hit is None or hit[0] != direction or hit[1] is None:
+            continue
+        seq += 1
+        events.append((n.lineno, seq, _flatten_fmt(hit[1])))
+    events.sort()
+    return "".join(e[2] for e in events)
 
 
 def _wire_site_index(scans: List[_FileScan], graph: CallGraph
@@ -2326,7 +3042,25 @@ def _check_wire_contract(scans: List[_FileScan],
                         # dispatch discriminant: the keyed branch must
                         # carry this schema EXACTLY — subsequence can
                         # hide a reordered or restretched frame behind
-                        # a sibling branch's fields
+                        # a sibling branch's fields.  A declared
+                        # pre-branch header (shared reads outside the
+                        # dispatch) prepends to the branch stream and
+                        # is itself held to the actual shared reads.
+                        head = dict(sch.prebranch).get(site, "")
+                        if head:
+                            pre = _prebranch_stream(node.fn, consts,
+                                                    direction)
+                            if pre != head:
+                                findings.append(Finding(
+                                    "wire-contract", node.path,
+                                    node.fn.lineno,
+                                    f"schema '{sch.name}' declares "
+                                    f"pre-branch stream '{head}' for "
+                                    f"{direction} site {site} but the "
+                                    f"shared reads outside its "
+                                    f"dispatch branches move '{pre}' "
+                                    f"— the pre-branch declaration is "
+                                    f"stale"))
                         for key in seg_keys:
                             seg = _segment_streams(node.fn, consts,
                                                    direction, key)
@@ -2340,13 +3074,16 @@ def _check_wire_contract(scans: List[_FileScan],
                                     f"branch dispatching on '{key}' — "
                                     f"the segment declaration is "
                                     f"stale"))
-                            elif seg != expected:
+                            elif head + seg != expected:
+                                got = (f"'{head + seg}' (pre-branch "
+                                       f"'{head}' ++ branch '{seg}')"
+                                       if head else f"'{seg}'")
                                 findings.append(Finding(
                                     "wire-contract", node.path,
                                     node.fn.lineno,
                                     f"schema '{sch.name}' segment "
                                     f"'{key}' of {direction} site "
-                                    f"{site} has field stream '{seg}', "
+                                    f"{site} has field stream {got}, "
                                     f"schema declares '{expected}' — "
                                     f"exact segmented match failed for "
                                     f"the dispatch branch"))
@@ -2360,6 +3097,16 @@ def _check_wire_contract(scans: List[_FileScan],
                             f"site's {direction} stream '{stream}' — "
                             f"the site drifted from the declared "
                             f"frame"))
+            seg_sites = {s for s, _keys in sch.segments}
+            for psite, _stream in sch.prebranch:
+                if psite not in seg_sites:
+                    findings.append(Finding(
+                        "wire-contract", "brpc_tpu/wire.py", 1,
+                        f"schema '{sch.name}' declares a pre-branch "
+                        f"stream for site '{psite}' with no segments "
+                        f"entry for that site — an unanchored "
+                        f"pre-branch declaration checks nothing; add "
+                        f"the segment key or drop it"))
             if not sch.pack_sites and not sch.response:
                 findings.append(Finding(
                     "wire-contract", "brpc_tpu/wire.py", 1,
@@ -2572,8 +3319,11 @@ def lint_files(files: Iterable[str],
             findings.extend(_check_lock_order(scans, graph))
         if "fiber-blocking-sleep" in active:
             findings.extend(_check_fiber_blocking_sleep(scans, graph))
-        if "handle-lifecycle" in active:
-            findings.extend(_check_handle_lifecycle(scans, graph))
+        if active & {"handle-lifecycle", "exception-flow"}:
+            findings.extend(_check_handle_lifecycle(scans, graph,
+                                                    active))
+        if "lock-exception-safety" in active:
+            findings.extend(_check_lock_exception_safety(scans, graph))
         if "wire-contract" in active:
             findings.extend(_check_wire_contract(scans, graph))
     if "ctypes-contract" in active:

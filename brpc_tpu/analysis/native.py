@@ -33,6 +33,12 @@ stable-finding-id, and baseline machinery of
   ``handle_inc`` followed by an error return (``nullptr``/``NULL``/
   error constant) with no interleaving ``handle_dec`` leaks a ledger
   count on exactly the path the pairing test never walks.
+- ``native-endian`` — byte order on the native side is only PROVEN by
+  the runtime parity fuzzer; every claimed native parser whose
+  extracted read stream moves a multi-byte scalar must have its
+  claiming schema covered by a fuzz target
+  (:func:`brpc_tpu.analysis.fuzz.coverage_map`) — an uncovered
+  multi-byte read is an endianness assumption no harness exercises.
 
 Everything here is stdlib-only and operates on source text; no
 compiler, no clang bindings, no build tree.  The extraction layer
@@ -57,7 +63,7 @@ __all__ = [
 ]
 
 #: the check names this module implements (mirrored in lint.ALL_CHECKS)
-NATIVE_CHECKS = ("wire-contract-native", "native-errors",
+NATIVE_CHECKS = ("wire-contract-native", "native-errors", "native-endian",
                  "native-handle-balance")
 
 #: control keywords that look like `name (...) {` but open plain blocks
@@ -646,13 +652,15 @@ def _resolve_site(site: str, repo_root: str,
 def run_native_checks(cpp_files: Sequence[str], repo_root: str,
                       checks: Optional[Iterable[str]] = None,
                       wire_mod=None, errors_h: Optional[str] = None,
-                      sanctioned: Optional[Set[int]] = None) -> List:
+                      sanctioned: Optional[Set[int]] = None,
+                      covers=None) -> List:
     """Run the native checks over ``cpp_files``; returns lint Findings.
 
-    ``wire_mod``/``errors_h``/``sanctioned`` are injectable so fixture
-    tests can drive the checks against seeded TUs and synthetic
-    registries; by default the real :mod:`brpc_tpu.wire`,
-    ``cpp/rpc/errors.h`` and the fuzzer's sanctioned set are used."""
+    ``wire_mod``/``errors_h``/``sanctioned``/``covers`` are injectable
+    so fixture tests can drive the checks against seeded TUs and
+    synthetic registries; by default the real :mod:`brpc_tpu.wire`,
+    ``cpp/rpc/errors.h`` and the fuzzer's sanctioned set and coverage
+    map are used."""
     from brpc_tpu.analysis.lint import Finding
     active = set(checks if checks is not None else NATIVE_CHECKS)
     findings: List[Finding] = []
@@ -775,6 +783,41 @@ def run_native_checks(cpp_files: Sequence[str], repo_root: str,
                                 f"ledger leaks a count on exactly the "
                                 f"path the new/destroy pairing test "
                                 f"never walks"))
+
+    if "native-endian" in active:
+        # Byte order on the C++ side is only PROVEN by the runtime
+        # parity fuzzer (the native parser and the Python reference
+        # unpack the same frames).  Gate the hole: every claimed native
+        # parser whose extracted read stream moves a multi-byte scalar
+        # must have its claiming schema covered by some fuzz target —
+        # an uncovered multi-byte read is an endianness assumption no
+        # harness ever exercises.
+        if covers is None:
+            try:
+                from brpc_tpu.analysis import fuzz as fuzz_mod
+                covers = fuzz_mod.coverage_map()
+            except Exception:  # pragma: no cover - fuzzer unavailable
+                covers = None
+        if covers is not None:
+            covered: Set[str] = set()
+            for names in covers.values():
+                covered.update(names)
+            for fn in serve_fns:
+                sch_name = claimed.get(f"{fn.path}:{fn.qual}")
+                if sch_name is None or sch_name in covered:
+                    continue
+                multi = [e for e in wire_reads_of(fn)
+                         if e.kind == "scalar" and e.width > 1]
+                if multi:
+                    findings.append(Finding(
+                        "native-endian", fn.path, multi[0].line,
+                        f"native parser {fn.qual} reads "
+                        f"{len(multi)} multi-byte wire field(s) for "
+                        f"schema '{sch_name}' but no runtime "
+                        f"parity-fuzz target covers that schema "
+                        f"(fuzz.coverage_map) — its byte order is "
+                        f"never proven against the Python reference; "
+                        f"add a fuzz target covering '{sch_name}'"))
     return findings
 
 

@@ -214,7 +214,13 @@ class FrameSchema:
     a site qualname to the dispatch-discriminant keys
     (``("ps_remote.PsShardServer._serve_control", ("Sync",))`` means
     "inside the ``method == \"Sync\"`` branch the stream must equal this
-    schema exactly").  ``response=True`` marks server→client response
+    schema exactly").  ``prebranch`` declares, per segmented site, the
+    format stream of the SHARED header the handler reads before (i.e.
+    outside) its dispatch branches — ``("ps_remote.PsShardServer._serve",
+    "i")`` says "one int32 is read pre-branch"; the lint prepends it to
+    the keyed branch's stream for the exact comparison and flags a
+    declaration that drifts from the actual shared reads.
+    ``response=True`` marks server→client response
     frames whose client consumer is trusted/optional — unpaired is
     explained, not flagged."""
 
@@ -226,6 +232,7 @@ class FrameSchema:
     exact_sites: Tuple[str, ...] = ()
     native_sites: Tuple[str, ...] = ()
     segments: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
+    prebranch: Tuple[Tuple[str, str], ...] = ()
     response: bool = False
 
     # -- derived ----------------------------------------------------------
@@ -415,7 +422,11 @@ schema(
     unpack_sites=("ps_remote.PsShardServer._serve",
                   "ps_remote.DevicePsShardServer._serve"),
     exact_sites=("ps_remote._pack_lookup_req",),
-    native_sites=("cpp/capi/ps_shard.cc:CPsService::ServeLookup",))
+    native_sites=("cpp/capi/ps_shard.cc:CPsService::ServeLookup",),
+    segments=(("ps_remote.PsShardServer._serve", ("Lookup",)),
+              ("ps_remote.DevicePsShardServer._serve", ("Lookup",))),
+    prebranch=(("ps_remote.PsShardServer._serve", "i"),
+               ("ps_remote.DevicePsShardServer._serve", "i")))
 
 schema(
     "apply_req",

@@ -1481,3 +1481,582 @@ def test_raise_after_release_clean(tmp_path):
                 raise ValueError("empty payload")
             ch.close()
     """) == []
+
+
+# ---- exception-flow: implicit throws from callees are exits, proven
+# ---- statically AND reproduced on the BRPC_TPU_HANDLECHECK ledger ----
+
+import itertools as _it
+import types as _types
+
+import pytest
+
+from brpc_tpu.analysis import handles as _handles
+from brpc_tpu.analysis import race as _race
+
+
+def _lint_exc_fixture(tmp_path, app_src, name="app.py"):
+    """Static half: handle-lifecycle + the exception-flow tier built on
+    the may-throw fixpoint."""
+    (tmp_path / "rpc.py").write_text(textwrap.dedent(_RPC_STUB))
+    (tmp_path / name).write_text(textwrap.dedent(app_src))
+    return lint.run_lint([str(tmp_path)],
+                         checks=["handle-lifecycle", "exception-flow"])
+
+
+def _ledger_rpc_module():
+    """An ``rpc`` twin whose owner classes book every construct/release
+    in the HANDLECHECK ledger — the runtime half of the static/dynamic
+    parity below runs the SAME fixture source against it."""
+    seq = _it.count(0x4000)
+    mod = _types.ModuleType("rpc")
+
+    class PendingCall:
+        def __init__(self):
+            self._h = next(seq)
+            _handles.note_create("pending", self._h)
+
+        def join(self):
+            _handles.note_destroy("pending", self._h)
+            return b""
+
+    class Channel:
+        def __init__(self, addr):
+            self._h = next(seq)
+            _handles.note_create("chan", self._h)
+
+        def call_async(self, service, method, request=b""):
+            return PendingCall()
+
+        def close(self):
+            _handles.note_destroy("chan", self._h)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            self.close()
+            return False
+
+    mod.PendingCall = PendingCall
+    mod.Channel = Channel
+    return mod
+
+
+def _ledger_run(fixture, call=None, expect=None):
+    """Exec ``fixture`` (its ``import rpc`` redirected to the ledger
+    twin), optionally invoke ``call=(fn, *args)`` (expecting ``expect``
+    to raise), and return the non-zero live ledger counts — {} means
+    every handle the run created was released."""
+    _handles.set_enabled(True)
+    _handles.clear()
+    try:
+        ns = {"rpc": _ledger_rpc_module()}
+        src = textwrap.dedent(fixture).replace("import rpc\n", "", 1)
+        exec(src, ns)
+        if call is not None:
+            fn, args = call[0], call[1:]
+            if expect is not None:
+                with pytest.raises(expect):
+                    ns[fn](*args)
+            else:
+                ns[fn](*args)
+        return {k: v for k, v in _handles.live_counts().items() if v}
+    finally:
+        _handles.set_enabled(None)
+        _handles.clear()
+
+
+_IMPLICIT_THROW_FIXTURE = """\
+    import rpc
+
+    def parse(payload):
+        if not payload:
+            raise ValueError("empty frame")
+        return payload
+
+    def leaky(addr, payload):
+        ch = rpc.Channel(addr)
+        body = parse(payload)
+        ch.close()
+        return body
+"""
+
+
+def test_implicit_throw_leak_static(tmp_path):
+    # the handle leaks ONLY via the callee's raise — no explicit raise,
+    # return, or fall-through in sight of the old per-statement pass
+    (f,) = _lint_exc_fixture(tmp_path, _IMPLICIT_THROW_FIXTURE)
+    assert f.check == "exception-flow"
+    assert f.line == 10          # the throwing call, not the binding
+    assert "'ch'" in f.message and "ValueError" in f.message
+    assert "unwinding edge" in f.message
+
+
+def test_implicit_throw_leak_dynamic_ledger():
+    live = _ledger_run(_IMPLICIT_THROW_FIXTURE,
+                       call=("leaky", "addr", b""), expect=ValueError)
+    assert live.get("chan") == 1   # the ledger reproduces the leak
+
+
+_IMPLICIT_THROW_FIXED = """\
+    import rpc
+
+    def parse(payload):
+        if not payload:
+            raise ValueError("empty frame")
+        return payload
+
+    def fin(addr, payload):
+        ch = rpc.Channel(addr)
+        try:
+            return parse(payload)
+        finally:
+            ch.close()
+
+    def ctx(addr, payload):
+        with rpc.Channel(addr) as ch:
+            return parse(payload)
+"""
+
+
+def test_implicit_throw_finally_and_with_clean_static(tmp_path):
+    assert _lint_exc_fixture(tmp_path, _IMPLICIT_THROW_FIXED) == []
+
+
+def test_implicit_throw_finally_and_with_clean_dynamic():
+    for fn in ("fin", "ctx"):
+        live = _ledger_run(_IMPLICIT_THROW_FIXED,
+                           call=(fn, "addr", b""), expect=ValueError)
+        assert live == {}, (fn, live)
+
+
+_OVERTRUST_FIXTURE = """\
+    import rpc
+
+    def parse(payload):
+        if not payload:
+            raise ValueError("empty frame")
+        return payload
+
+    def overtrusting(addr, payload):
+        ch = rpc.Channel(addr)
+        try:
+            size = len(payload)
+        except TypeError:
+            ch.close()
+            raise
+        body = parse(payload)
+        ch.close()
+        return body
+"""
+
+
+def test_handler_trust_scoped_to_its_own_try_static(tmp_path):
+    # a release inside SOME handler no longer blesses the whole
+    # function: the throwing call sits outside that handler's try
+    (f,) = _lint_exc_fixture(tmp_path, _OVERTRUST_FIXTURE)
+    assert f.check == "exception-flow"
+    assert f.line == 15
+    assert "ValueError" in f.message
+
+
+def test_handler_trust_scoped_dynamic_ledger():
+    live = _ledger_run(_OVERTRUST_FIXTURE,
+                       call=("overtrusting", "addr", b""),
+                       expect=ValueError)
+    assert live.get("chan") == 1
+
+
+def test_handler_covering_call_and_type_clean(tmp_path):
+    covered = """\
+        import rpc
+
+        def parse(payload):
+            if not payload:
+                raise ValueError("empty frame")
+            return payload
+
+        def covered(addr, payload):
+            ch = rpc.Channel(addr)
+            try:
+                body = parse(payload)
+            except ValueError:
+                ch.close()
+                raise
+            ch.close()
+            return body
+    """
+    assert _lint_exc_fixture(tmp_path, covered) == []
+    live = _ledger_run(covered, call=("covered", "addr", b""),
+                       expect=ValueError)
+    assert live == {}
+
+
+def test_handler_of_wrong_type_does_not_cover(tmp_path):
+    (f,) = _lint_exc_fixture(tmp_path, """\
+        import rpc
+
+        def parse(payload):
+            if not payload:
+                raise ValueError("empty frame")
+            return payload
+
+        def wrong(addr, payload):
+            ch = rpc.Channel(addr)
+            try:
+                body = parse(payload)
+            except OSError:
+                ch.close()
+                raise
+            ch.close()
+            return body
+    """)
+    assert f.check == "exception-flow"
+    assert f.line == 11
+
+
+def test_handler_catches_base_class_of_thrown_type(tmp_path):
+    # LookupError covers KeyError through the builtin hierarchy
+    assert _lint_exc_fixture(tmp_path, """\
+        import rpc
+
+        def pick(table, key):
+            return table[key] if key in table else _boom(key)
+
+        def _boom(key):
+            raise KeyError(key)
+
+        def covered(addr, table, key):
+            ch = rpc.Channel(addr)
+            try:
+                row = pick(table, key)
+            except LookupError:
+                ch.close()
+                raise
+            ch.close()
+            return row
+    """) == []
+
+
+_CONTAINER_ESCAPE_FIXTURE = """\
+    import rpc
+
+    def burst(addr, n):
+        ch = rpc.Channel(addr)
+        calls = []
+        for _i in range(n):
+            calls.append(ch.call_async("Ps", "Apply"))
+        ch.close()
+"""
+
+
+def test_container_may_leak_set_static(tmp_path):
+    (f,) = _lint_exc_fixture(tmp_path, _CONTAINER_ESCAPE_FIXTURE)
+    assert f.check == "handle-lifecycle"
+    assert "container 'calls'" in f.message
+    assert "never drained" in f.message
+
+
+def test_container_may_leak_set_dynamic_ledger():
+    live = _ledger_run(_CONTAINER_ESCAPE_FIXTURE, call=("burst", "a", 3))
+    assert live.get("pending") == 3
+
+
+_CONTAINER_DRAINED_FIXTURE = """\
+    import rpc
+
+    def burst(addr, n):
+        ch = rpc.Channel(addr)
+        calls = []
+        for _i in range(n):
+            calls.append(ch.call_async("Ps", "Apply"))
+        for pc in calls:
+            pc.join()
+        ch.close()
+"""
+
+
+def test_container_drained_clean_both_ways(tmp_path):
+    assert _lint_exc_fixture(tmp_path, _CONTAINER_DRAINED_FIXTURE) == []
+    assert _ledger_run(_CONTAINER_DRAINED_FIXTURE,
+                       call=("burst", "a", 3)) == {}
+
+
+def test_container_returned_or_pragmad_clean(tmp_path):
+    returned = _CONTAINER_ESCAPE_FIXTURE.replace(
+        "        ch.close()",
+        "        ch.close()\n        return calls")
+    assert _lint_exc_fixture(tmp_path, returned) == []
+    pragmad = _CONTAINER_ESCAPE_FIXTURE.replace(
+        'calls.append(ch.call_async("Ps", "Apply"))',
+        'calls.append(ch.call_async("Ps", "Apply"))'
+        '  # lint: allow-handle-escape')
+    assert _lint_exc_fixture(tmp_path, pragmad) == []
+
+
+_REBIND_FIXTURE = """\
+    import rpc
+
+    def reconnect(addr, backup):
+        ch = rpc.Channel(addr)
+        ch = rpc.Channel(backup)
+        ch.close()
+"""
+
+
+def test_rebind_drop_static(tmp_path):
+    (f,) = _lint_exc_fixture(tmp_path, _REBIND_FIXTURE)
+    assert f.check == "handle-lifecycle"
+    assert "rebinding 'ch'" in f.message
+    assert f.line == 5
+
+
+def test_rebind_drop_dynamic_ledger():
+    live = _ledger_run(_REBIND_FIXTURE, call=("reconnect", "a", "b"))
+    assert live.get("chan") == 1   # the first channel has no name left
+
+
+def test_rebind_after_release_clean(tmp_path):
+    fixed = """\
+        import rpc
+
+        def reconnect(addr, backup):
+            ch = rpc.Channel(addr)
+            ch.close()
+            ch = rpc.Channel(backup)
+            ch.close()
+    """
+    assert _lint_exc_fixture(tmp_path, fixed) == []
+    assert _ledger_run(fixed, call=("reconnect", "a", "b")) == {}
+
+
+_MODULE_SCOPE_FIXTURE = """\
+    import rpc
+
+    CH = rpc.Channel("127.0.0.1:9999")
+"""
+
+
+def test_module_scope_producer_static(tmp_path):
+    (f,) = _lint_exc_fixture(tmp_path, _MODULE_SCOPE_FIXTURE)
+    assert f.check == "handle-lifecycle"
+    assert "module-scope" in f.message and "'CH'" in f.message
+
+
+def test_module_scope_producer_dynamic_ledger():
+    # the handle is live from import time with no release path
+    live = _ledger_run(_MODULE_SCOPE_FIXTURE)
+    assert live.get("chan") == 1
+
+
+def test_module_scope_producer_with_shutdown_clean(tmp_path):
+    fixed = _MODULE_SCOPE_FIXTURE + \
+        "\n\n    def shutdown():\n        CH.close()\n"
+    assert _lint_exc_fixture(tmp_path, fixed) == []
+    assert _ledger_run(fixed, call=("shutdown",)) == {}
+
+
+def test_module_scope_singleton_pragma_accepted(tmp_path):
+    pragmad = _MODULE_SCOPE_FIXTURE.replace(
+        'CH = rpc.Channel("127.0.0.1:9999")',
+        'CH = rpc.Channel("127.0.0.1:9999")  # lint: allow-handle-escape')
+    assert _lint_exc_fixture(tmp_path, pragmad) == []
+
+
+# ---- lock-exception-safety: locks and obligations on unwinding edges ----
+
+_LOCK_MANUAL_FIXTURE = """\
+    from brpc_tpu.analysis.race import checked_lock
+
+    MU = checked_lock("lxs.MU")
+
+    def risky(payload):
+        if not payload:
+            raise ValueError("empty")
+        return payload
+
+    def unsafe(payload):
+        MU.acquire()
+        body = risky(payload)
+        MU.release()
+        return body
+"""
+
+
+def test_manual_lock_across_throw_static(tmp_path):
+    (f,) = _lint_src(tmp_path, _LOCK_MANUAL_FIXTURE,
+                     checks=["lock-exception-safety"])
+    assert f.check == "lock-exception-safety"
+    assert "lxs.MU" in f.message and "may-throw" in f.message
+    assert f.line == 12
+
+
+def test_manual_lock_across_throw_dynamic_parity():
+    ns = {"checked_lock": _race.checked_lock}
+    exec(textwrap.dedent(_LOCK_MANUAL_FIXTURE).split("\n", 1)[1], ns)
+    with pytest.raises(ValueError):
+        ns["unsafe"](b"")
+    assert ns["MU"].locked()   # left locked forever on the unwind
+    ns["MU"].release()
+
+
+_LOCK_FIXED_FIXTURE = """\
+    from brpc_tpu.analysis.race import checked_lock
+
+    MU = checked_lock("lxf.MU")
+
+    def risky(payload):
+        if not payload:
+            raise ValueError("empty")
+        return payload
+
+    def paired(payload):
+        MU.acquire()
+        try:
+            return risky(payload)
+        finally:
+            MU.release()
+
+    def scoped(payload):
+        with MU:
+            return risky(payload)
+"""
+
+
+def test_lock_release_in_finally_or_with_clean(tmp_path):
+    assert _lint_src(tmp_path, _LOCK_FIXED_FIXTURE,
+                     checks=["lock-exception-safety"]) == []
+    ns = {"checked_lock": _race.checked_lock}
+    exec(textwrap.dedent(_LOCK_FIXED_FIXTURE).split("\n", 1)[1], ns)
+    for fn in ("paired", "scoped"):
+        with pytest.raises(ValueError):
+            ns[fn](b"")
+        assert not ns["MU"].locked(), fn
+
+
+_FENCE_FIXTURE = """\
+    class Shard:
+        def risky(self, payload):
+            if not payload:
+                raise ValueError("empty")
+            return payload
+
+        def fenced_apply(self, payload):
+            self._fencing = True
+            body = self.risky(payload)
+            self._fencing = False
+            return body
+"""
+
+
+def test_fence_flag_half_done_on_unwind_static(tmp_path):
+    (f,) = _lint_src(tmp_path, _FENCE_FIXTURE,
+                     checks=["lock-exception-safety"])
+    assert f.check == "lock-exception-safety"
+    assert "_fencing" in f.message and "finally" in f.message
+    assert f.line == 9
+
+
+def test_fence_flag_half_done_on_unwind_dynamic():
+    ns = {}
+    exec(textwrap.dedent(_FENCE_FIXTURE), ns)
+    sh = ns["Shard"]()
+    with pytest.raises(ValueError):
+        sh.fenced_apply(b"")
+    assert sh._fencing is True   # the half-done obligation, observable
+
+
+def test_fence_flag_reset_in_finally_clean(tmp_path):
+    fixed = """\
+        class Shard:
+            def risky(self, payload):
+                if not payload:
+                    raise ValueError("empty")
+                return payload
+
+            def fenced_apply(self, payload):
+                self._fencing = True
+                try:
+                    return self.risky(payload)
+                finally:
+                    self._fencing = False
+    """
+    assert _lint_src(tmp_path, fixed,
+                     checks=["lock-exception-safety"]) == []
+    ns = {}
+    exec(textwrap.dedent(fixed), ns)
+    sh = ns["Shard"]()
+    with pytest.raises(ValueError):
+        sh.fenced_apply(b"")
+    assert sh._fencing is False
+
+
+# ---- lock-order: class containers inherited from base classes ----
+
+_INHERITED_CONTAINER_LOCK_FIXTURE = """\
+    from brpc_tpu.analysis.race import checked_lock
+
+    class Base:
+        LOCKS = {"a": checked_lock("mro.A"), "b": checked_lock("mro.B")}
+
+    class Engine(Base):
+        def fwd(self):
+            with self.LOCKS["a"]:
+                with self.LOCKS["b"]:
+                    pass
+
+        def rev(self):
+            with self.LOCKS["b"]:
+                with self.LOCKS["a"]:
+                    pass
+"""
+
+
+def test_inherited_class_container_lock_resolves(tmp_path):
+    # the container lives on Base; the inversion is in the subclass —
+    # the base-chain walk binds self.LOCKS["a"] through the MRO
+    fs = _lint_src(tmp_path, _INHERITED_CONTAINER_LOCK_FIXTURE)
+    (f,) = _by_check(fs, "lock-order")
+    assert "mro.A" in f.message and "mro.B" in f.message
+
+
+def test_inherited_container_lock_matches_dynamic_harness(tmp_path):
+    static = _by_check(
+        _lint_src(tmp_path, _INHERITED_CONTAINER_LOCK_FIXTURE),
+        "lock-order")
+    assert len(static) == 1
+
+    _race.clear()
+    _race.set_enabled(True)
+    try:
+        ns = {"checked_lock": _race.checked_lock}
+        exec(textwrap.dedent(
+            _INHERITED_CONTAINER_LOCK_FIXTURE).split("\n", 1)[1], ns)
+        eng = ns["Engine"]()
+        eng.fwd()
+        eng.rev()
+        dynamic = [f for f in _race.findings()
+                   if f.kind == "lock-inversion"]
+    finally:
+        _race.set_enabled(None)
+        _race.clear()
+    assert len(dynamic) == 1
+    assert {"mro.A", "mro.B"} <= set(dynamic[0].locks)
+
+
+def test_inherited_container_shadowed_nonliteral_stays_deferred(tmp_path):
+    shadowed = _INHERITED_CONTAINER_LOCK_FIXTURE.replace(
+        "class Engine(Base):",
+        "class Engine(Base):\n    LOCKS = dict(Base.LOCKS)")
+    assert _by_check(_lint_src(tmp_path, shadowed), "lock-order") == []
+
+
+def test_inherited_container_mutated_in_subclass_stays_deferred(tmp_path):
+    mutated = _INHERITED_CONTAINER_LOCK_FIXTURE.replace(
+        "    def fwd(self):",
+        "    def grow(self):\n"
+        "        self.LOCKS[\"c\"] = checked_lock(\"mro.C\")\n"
+        "\n"
+        "    def fwd(self):")
+    assert _by_check(_lint_src(tmp_path, mutated), "lock-order") == []

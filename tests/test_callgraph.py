@@ -756,3 +756,183 @@ def test_factory_return_chain_fixpoint(tmp_path):
         """,
     )
     assert _only_node(g, "C.m") in _callee_ids(g, _only_node(g, ":use"))
+
+
+# ---- may-throw fixpoint ----
+
+def _throws(g, suffix):
+    return g.throw_summary(_only_node(g, suffix))
+
+
+def test_may_throw_explicit_raise_and_propagation(tmp_path):
+    g = _graph(tmp_path, m="""\
+        def boom():
+            raise ValueError("bad")
+
+        def mid():
+            boom()
+
+        def top():
+            mid()
+
+        def quiet():
+            return 1 + 2
+    """)
+    for suffix in (":boom", ":mid", ":top"):
+        s = _throws(g, suffix)
+        assert s.may_throw, suffix
+        assert s.types == ("ValueError",), suffix
+        assert s.confidence == "high", suffix
+    q = _throws(g, ":quiet")
+    assert not q.may_throw and not q.external
+    assert q.confidence == "none"
+
+
+def test_may_throw_absorbed_by_base_class_handler(tmp_path):
+    g = _graph(tmp_path, m="""\
+        def boom():
+            raise KeyError("k")
+
+        def guarded():
+            try:
+                boom()
+            except LookupError:
+                return None
+
+        def misguarded():
+            try:
+                boom()
+            except OSError:
+                return None
+    """)
+    # KeyError < LookupError: the guard absorbs the proven throw
+    assert not _throws(g, ":guarded").may_throw
+    # an unrelated clause absorbs nothing — the KeyError unwinds out
+    s = _throws(g, ":misguarded")
+    assert s.types == ("KeyError",) and s.confidence == "high"
+
+
+def test_may_throw_external_call_is_low_confidence_only(tmp_path):
+    g = _graph(tmp_path, m="""\
+        import os
+
+        def rm(path):
+            os.remove(path)
+    """)
+    s = _throws(g, ":rm")
+    # os.remove can obviously raise, but the analysis cannot prove a
+    # chain — external bit only, NEVER a proven may-throw (findings
+    # built on summaries stay free of unverifiable chains)
+    assert not s.may_throw
+    assert s.external
+    assert s.confidence == "external"
+
+
+def test_may_throw_assert_statement(tmp_path):
+    g = _graph(tmp_path, m="""\
+        def check(x):
+            assert x > 0, "positive"
+            return x
+    """)
+    s = _throws(g, ":check")
+    assert s.types == ("AssertionError",)
+
+
+def test_may_throw_unknown_type_absorbed_only_by_catch_all(tmp_path):
+    g = _graph(tmp_path, m="""\
+        def relay(e):
+            raise e
+
+        def narrow():
+            try:
+                relay(make())
+            except ValueError:
+                return None
+
+        def wide():
+            try:
+                relay(make())
+            except Exception:
+                return None
+
+        def make():
+            return RuntimeError("x")
+    """)
+    assert _throws(g, ":relay").unknown
+    # a named clause cannot prove it absorbs an unknown-typed throw
+    assert _throws(g, ":narrow").unknown
+    # only a catch-all absorbs it
+    assert not _throws(g, ":wide").may_throw
+
+
+def test_may_throw_in_package_exception_hierarchy(tmp_path):
+    g = _graph(tmp_path, m="""\
+        class FabricError(RuntimeError):
+            pass
+
+        class WireError(FabricError):
+            pass
+
+        def boom():
+            raise WireError("frame")
+
+        def guarded():
+            try:
+                boom()
+            except FabricError:
+                return None
+
+        def misguarded():
+            try:
+                boom()
+            except OSError:
+                return None
+    """)
+    assert _throws(g, ":boom").types == ("WireError",)
+    # the scanned ClassDef chain WireError -> FabricError is honoured
+    assert not _throws(g, ":guarded").may_throw
+    assert _throws(g, ":misguarded").types == ("WireError",)
+
+
+def test_may_throw_recursive_cycle_terminates(tmp_path):
+    g = _graph(tmp_path, m="""\
+        def ping(n):
+            if n <= 0:
+                raise TimeoutError("spin")
+            return pong(n - 1)
+
+        def pong(n):
+            return ping(n)
+    """)
+    assert _throws(g, ":ping").types == ("TimeoutError",)
+    assert _throws(g, ":pong").types == ("TimeoutError",)
+
+
+def test_may_throw_fixpoint_deterministic(tmp_path):
+    src = """\
+        class AppError(Exception):
+            pass
+
+        def a():
+            raise AppError("a")
+
+        def b():
+            a()
+            assert True
+
+        def c(x):
+            if x:
+                raise ValueError(x)
+            b()
+    """
+    g1 = _graph(tmp_path, m=src)
+    other = tmp_path / "again"
+    other.mkdir()
+    g2 = _graph(other, m=src)
+    t1 = {nid.split(":", 1)[-1]: g1.compute_throws()[nid]
+          for nid in g1.nodes}
+    t2 = {nid.split(":", 1)[-1]: g2.compute_throws()[nid]
+          for nid in g2.nodes}
+    assert t1 == t2
+    # and re-computation on the same graph is cached + identical
+    assert g1.compute_throws() is g1.compute_throws()

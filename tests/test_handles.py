@@ -259,3 +259,103 @@ def test_abort_over_healthy_socket_frees_the_peer_receiver():
     assert recorder.closed
     ch.close()
     srv.close()
+
+
+# ---- exception-edge leaks: the unwinding path is what the ledger sees ----
+
+
+class _FakeOwner:
+    """A minimal owner the ledger tracks, shaped like the rpc wrappers:
+    create on construction, destroy on close, context-managed."""
+
+    def __init__(self, kind):
+        self._kind = kind
+        self._h = id(self) & 0xffffffff
+        handles.note_create(kind, self._h)
+
+    def close(self):
+        if self._h:
+            handles.note_destroy(self._kind, self._h)
+            self._h = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def test_implicit_throw_between_create_and_close_leaks():
+    """The exception-flow finding class, witnessed dynamically: a callee
+    raise between create and close leaks the handle on the unwind —
+    exactly what the static check flags at the throwing call site."""
+    handles.clear()
+    base = handles.live_counts().get("exc", 0)
+
+    def parse(payload):
+        raise ValueError("bad frame")
+
+    def serve(payload):
+        ch = _FakeOwner("exc")
+        body = parse(payload)   # unwinds: ch.close() below never runs
+        ch.close()
+        return body
+
+    with pytest.raises(ValueError):
+        serve(b"x")
+    assert handles.live_counts().get("exc", 0) == base + 1
+    handles.clear()
+
+
+def test_finally_and_with_cover_the_unwinding_edge():
+    handles.clear()
+    base = handles.live_counts().get("exc", 0)
+
+    def parse(payload):
+        raise ValueError("bad frame")
+
+    def serve_finally(payload):
+        ch = _FakeOwner("exc")
+        try:
+            return parse(payload)
+        finally:
+            ch.close()
+
+    def serve_with(payload):
+        with _FakeOwner("exc"):
+            return parse(payload)
+
+    for fn in (serve_finally, serve_with):
+        with pytest.raises(ValueError):
+            fn(b"x")
+        assert handles.live_counts().get("exc", 0) == base
+    handles.clear()
+
+
+def test_handler_release_covers_only_its_own_try():
+    """The scoped-trust rule, dynamically: the except clause's close
+    runs only when ITS try raises — an exception after the try finds
+    the handle live and leaks it, which is why the static check never
+    lets a handler bless call sites outside its own try."""
+    handles.clear()
+    base = handles.live_counts().get("exc", 0)
+
+    def parse(payload):
+        raise ValueError("bad frame")
+
+    def serve(payload):
+        ch = _FakeOwner("exc")
+        try:
+            head = len(payload)
+        except TypeError:
+            ch.close()
+            raise
+        body = parse(payload)   # NOT covered by the handler above
+        ch.close()
+        return head, body
+
+    with pytest.raises(ValueError):
+        serve(b"x")
+    assert handles.live_counts().get("exc", 0) == base + 1
+    handles.clear()

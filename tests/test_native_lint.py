@@ -407,3 +407,78 @@ def test_native_finding_baseline_roundtrip(tmp_path):
     new, suppressed = lint.apply_baseline(
         fs, lint.load_baseline(str(baseline)))
     assert new == [] and len(suppressed) == len(fs)
+
+
+# ---------------------------------------------------------------------------
+# native-endian: byte order must be proven by the parity fuzzer
+# ---------------------------------------------------------------------------
+
+#: a FAITHFUL claimed parser (matches its schema field-for-field) whose
+#: multi-byte reads still need a runtime endianness witness
+_ENDIAN_CC = """
+    #include "x.h"
+    namespace {
+    void ServeFix(brt::IOBuf& request, brt::IOBuf* out) {
+      int32_t count = 0;
+      request.copy_to(&count, 4);
+      if (count < 0 || request.size() != 4 + size_t(count) * 4) return;
+      std::vector<int32_t> ids(size_t(count));
+      request.copy_to(ids.data(), size_t(count) * 4, 4);
+    }
+    }
+"""
+
+
+def test_native_endian_uncovered_schema_flagged(tmp_path):
+    cc, root = _fixture_tree(tmp_path, _ENDIAN_CC)
+    wm = _schema_for([wire.Int("count", "<i"),
+                      wire.Array("ids", "<i4", "count")])
+    fs = native.run_native_checks([cc], root, checks=["native-endian"],
+                                  wire_mod=wm, covers={})
+    assert len(fs) == 1, [f.message for f in fs]
+    f = fs[0]
+    assert f.check == "native-endian"
+    assert "fix_req" in f.message and "byte order" in f.message
+    assert "coverage_map" in f.message
+
+
+def test_native_endian_covered_schema_clean(tmp_path):
+    cc, root = _fixture_tree(tmp_path, _ENDIAN_CC)
+    wm = _schema_for([wire.Int("count", "<i"),
+                      wire.Array("ids", "<i4", "count")])
+    fs = native.run_native_checks(
+        [cc], root, checks=["native-endian"], wire_mod=wm,
+        covers={"fix_target": ("fix_req",)})
+    assert fs == [], [f.message for f in fs]
+
+
+def test_native_endian_single_byte_reads_exempt(tmp_path):
+    # one-byte fields have no byte order: nothing to prove
+    cc, root = _fixture_tree(tmp_path, """
+        #include "x.h"
+        namespace {
+        void ServeFix(brt::IOBuf& request, brt::IOBuf* out) {
+          uint8_t tag = 0;
+          request.copy_to(&tag, 1);
+        }
+        }
+    """)
+    wm = _schema_for([wire.Int("tag", "<b")])
+    fs = native.run_native_checks([cc], root, checks=["native-endian"],
+                                  wire_mod=wm, covers={})
+    assert fs == [], [f.message for f in fs]
+
+
+def test_native_endian_in_tree_every_twin_is_fuzz_covered():
+    """The acceptance gate for the sub-check: every claimed native
+    parser in the REAL tree is already covered by a parity-fuzz target
+    — the default coverage map closes the loop with zero findings."""
+    files = native.default_cpp_files(REPO)
+    assert files
+    covered = set()
+    for names in fuzz.coverage_map().values():
+        covered.update(names)
+    claimed = {s.name for s in wire.REGISTRY.values() if s.native_sites}
+    assert claimed <= covered, claimed - covered
+    fs = native.run_native_checks(files, REPO, checks=["native-endian"])
+    assert fs == [], [f.message for f in fs]

@@ -523,3 +523,87 @@ def test_segment_exact_match_accepts_faithful_branch(tmp_path):
     """)
     assert not any("segment 'Sync'" in f.message for f in findings), \
         [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# pre-branch header matching for shared multi-frame handlers
+# ---------------------------------------------------------------------------
+
+def test_registry_prebranch_declarations_are_consistent():
+    withpre = [s for s in wire.REGISTRY.values() if s.prebranch]
+    assert {s.name for s in withpre} >= {"lookup_req"}
+    for sch in withpre:
+        seg_sites = {site for site, _keys in sch.segments}
+        for site, head in sch.prebranch:
+            # a pre-branch stream anchors to a SEGMENTED site: the
+            # whole point is splitting shared-header reads from the
+            # per-branch remainder
+            assert site in seg_sites, \
+                f"{sch.name}: pre-branch site {site} has no segment " \
+                f"declaration"
+            assert isinstance(head, str), (sch.name, site)
+    lk = wire.REGISTRY["lookup_req"]
+    assert dict(lk.prebranch) == {
+        "ps_remote.PsShardServer._serve": "i",
+        "ps_remote.DevicePsShardServer._serve": "i"}
+
+
+def test_prebranch_faithful_shared_header_accepted(tmp_path):
+    findings = _lint_fake_package(tmp_path, """\
+        import struct
+
+        class PsShardServer:
+            def _serve(self, method, payload):
+                (count,) = struct.unpack_from("<i", payload, 0)
+                if method == "Lookup":
+                    return b""
+                return b""
+    """)
+    # the registry-staleness arm flags every in-tree site the fixture
+    # does not define — irrelevant here; the point is that the defined
+    # _serve passes both the pre-branch and the segment arm
+    bad = [f for f in findings
+           if "pre-branch" in f.message or "segment 'Lookup'" in f.message]
+    assert not bad, [f.message for f in findings]
+
+
+def test_prebranch_read_moved_into_branch_is_stale(tmp_path):
+    # the header read migrated inside the dispatch branch: the declared
+    # pre-branch stream no longer matches what the shared prefix moves
+    findings = _lint_fake_package(tmp_path, """\
+        import struct
+
+        class PsShardServer:
+            def _serve(self, method, payload):
+                if method == "Lookup":
+                    (count,) = struct.unpack_from("<i", payload, 0)
+                    return b""
+                return b""
+    """)
+    stale = [f for f in findings
+             if "pre-branch" in f.message and "stale" in f.message
+             and "lookup_req" in f.message]
+    assert stale, [f.message for f in findings]
+    assert "'i'" in stale[0].message
+
+
+def test_prebranch_doubled_header_read_flagged_exactly(tmp_path):
+    """Subsequence matching would bless a doubled header read ('i' is a
+    subsequence of 'ii'); the pre-branch stream is matched EXACTLY."""
+    findings = _lint_fake_package(tmp_path, """\
+        import struct
+
+        class PsShardServer:
+            def _serve(self, method, payload):
+                (count,) = struct.unpack_from("<i", payload, 0)
+                (flags,) = struct.unpack_from("<i", payload, 4)
+                if method == "Lookup":
+                    return b""
+                return b""
+    """)
+    bad = [f for f in findings
+           if "pre-branch" in f.message and "lookup_req" in f.message]
+    assert bad, [f.message for f in findings]
+    assert "'ii'" in bad[0].message
+    from brpc_tpu.analysis.lint import _is_subsequence
+    assert _is_subsequence("i", "ii")
