@@ -140,6 +140,15 @@ def run_durable_bench(deadline_s: int = 300) -> dict:
     return _run_json_child("bench_durable.py", "durable", deadline_s)
 
 
+def run_zerocopy_bench(deadline_s: int = 300) -> dict:
+    """Zero-copy buffer currency (bench_zerocopy.py child): brt_iobuf
+    borrow path vs the copy path, A/B in one run — large-payload echo
+    GB/s, stream-push throughput, 16-byte echo qps, end-to-end
+    push_gradients, and the bytes-copied-per-request ledger (also
+    refreshes BENCH_zerocopy.json)."""
+    return _run_json_child("bench_zerocopy.py", "zerocopy", deadline_s)
+
+
 def run_fault_bench(deadline_s: int = 300) -> dict:
     """Fault-tolerance numbers (bench_fault.py child): backup-request
     p99 bounding under an injected slow shard, breaker availability and
@@ -311,6 +320,10 @@ def main() -> int:
         # provisioning (bench_durable.py child).
         durable_block = run_durable_bench()
 
+        # Zero-copy buffer currency (ISSUE 19): brt_iobuf borrow path
+        # vs the copy path, A/B in one run (bench_zerocopy.py child).
+        zerocopy_block = run_zerocopy_bench()
+
         gbps = best["gbps"]
         print(json.dumps({
             "metric": "same_host_echo_throughput",
@@ -336,6 +349,7 @@ def main() -> int:
             "reshard": reshard_block,
             "scenarios": scenarios_block,
             "durable": durable_block,
+            "zerocopy": zerocopy_block,
             **device_blocks,
         }))
         return 0

@@ -23,6 +23,13 @@ The split's throughput gain is the fabric actually moving load onto
 the new units; the failure/latency/ledger measurements involve no
 model at all.  Emits ONE JSON line; degrades to {"skipped": ...}
 without the native core.
+
+``--raw`` drops the model entirely: stock servers, no service slot,
+fiber workers sized to the host's cores — the post/pre throughput
+ratio then measures REAL multi-core scaling (on a multi-core host the
+split should add throughput with no model anywhere; on one core it
+honestly reports ~1.0x and the ratio criterion is waived).  Raw
+results go to BENCH_reshard_raw.json so the modeled record survives.
 """
 
 import json
@@ -51,7 +58,7 @@ def _pct(sorted_vals, q):
                            int(q * len(sorted_vals)))]
 
 
-def bench_reshard() -> dict:
+def bench_reshard(raw: bool = False) -> dict:
     import numpy as np
 
     from brpc_tpu import obs, resilience, rpc
@@ -84,17 +91,18 @@ def bench_reshard() -> dict:
             attempt_timeout_ms=2000)
 
     obs.set_enabled(True)
+    shard_cls = PsShardServer if raw else CapacityShard
     reg_server = rpc.Server()
     reg_server.add_naming_registry()
     reg_addr = f"127.0.0.1:{reg_server.start('127.0.0.1:0')}"
     servers_baseline = rpc.debug_handle_count("server")
 
-    old = [CapacityShard(VOCAB, DIM, s, 4, lr=1.0, stream=True)
+    old = [shard_cls(VOCAB, DIM, s, 4, lr=1.0, stream=True)
            for s in range(4)]
     for sv in old:
         sv.table[:] = 0       # dyadic ledger: exact from a zero table
-    new = [CapacityShard(VOCAB, DIM, s, 8, lr=1.0, stream=True,
-                         importing=True, scheme_version=1)
+    new = [shard_cls(VOCAB, DIM, s, 8, lr=1.0, stream=True,
+                     importing=True, scheme_version=1)
            for s in range(8)]
     sc0 = PartitionScheme(0, tuple(ReplicaSet.of(sv.address)
                                    for sv in old))
@@ -162,11 +170,16 @@ def bench_reshard() -> dict:
     drv = MigrationDriver(sc0, sc1, VOCAB, registry_addr=reg_addr,
                           cluster="ps")
     out = {"metric": "elastic_reshard", "cpu_count": os.cpu_count(),
-           "model": {"service_ms_per_lookup": SERVICE_MS,
-                     "slots_per_shard": 1, "readers": READERS,
-                     "note": "each shard = one fixed-rate capacity "
-                             "unit (serialized service slot); the "
-                             "split doubles the units"}}
+           "raw": raw,
+           "model": ({"note": "raw mode: stock servers, no service "
+                              "slot — post/pre ratio measures real "
+                              "multi-core scaling", "readers": READERS}
+                     if raw else
+                     {"service_ms_per_lookup": SERVICE_MS,
+                      "slots_per_shard": 1, "readers": READERS,
+                      "note": "each shard = one fixed-rate capacity "
+                              "unit (serialized service slot); the "
+                              "split doubles the units"})}
     try:
         time.sleep(1.0)           # warmup: streams, watchers, caches
         phase[0] = "pre"
@@ -216,6 +229,11 @@ def bench_reshard() -> dict:
         ratio = blocks["post"]["lookups_per_s"] / max(
             blocks["pre"]["lookups_per_s"], 1e-9)
         out["post_over_pre_throughput"] = round(ratio, 3)
+        # one core cannot scale a raw (unmodeled) split: the ratio
+        # criterion only binds where the host can physically deliver it
+        ratio_ok = (ratio >= 1.0
+                    if (not raw or (os.cpu_count() or 1) > 1)
+                    else True)
 
         # exact zero-lost-acked-updates ledger: every counted push was
         # flushed; DELTA is dyadic so float32 subtraction is exact
@@ -253,7 +271,7 @@ def bench_reshard() -> dict:
             counters[k] = int(obs.counter(k).get_value())
         out["counters"] = counters
         out["ok"] = bool(not failed and not push_errors and exact
-                         and ratio >= 1.0 and views_clean and released)
+                         and ratio_ok and views_clean and released)
     finally:
         stop.set()
         drv.close()
@@ -265,8 +283,13 @@ def bench_reshard() -> dict:
 
 
 def main() -> int:
-    out_path = os.path.join(ROOT, "BENCH_reshard.json")
-    os.environ.setdefault("BRT_WORKERS", "24")
+    import sys
+    raw = "--raw" in sys.argv[1:]
+    out_path = os.path.join(
+        ROOT, "BENCH_reshard_raw.json" if raw else "BENCH_reshard.json")
+    os.environ.setdefault(
+        "BRT_WORKERS",
+        str(max(24, 4 * (os.cpu_count() or 1))) if raw else "24")
     try:
         from brpc_tpu import rpc
 
@@ -274,7 +297,7 @@ def main() -> int:
             result = {"metric": "elastic_reshard",
                       "skipped": "native core unavailable"}
         else:
-            result = bench_reshard()
+            result = bench_reshard(raw=raw)
     except Exception as e:  # noqa: BLE001
         result = {"metric": "elastic_reshard",
                   "skipped": f"{type(e).__name__}: {e}"[:300]}

@@ -292,17 +292,24 @@ class CheckpointStore:
             if compacting:
                 obs.counter("ps_ckpt_compactions").add(1)
 
-    def append_delta(self, gen: int, body: bytes) -> bool:
+    def append_delta(self, gen: int, body: bytes,
+                     epoch: Optional[int] = None) -> bool:
         """Tee one applied generation to the open segment.  Returns
-        False when the record cannot extend the log — no base yet, or
+        False when the record cannot extend the log — no base yet,
         ``gen`` is not the next link in the chain (a wholesale install
-        jumped the generation) — in which case the caller snapshots
-        instead."""
+        jumped the generation), or ``epoch`` (when given) differs from
+        the epoch the open base was written under (a promotion bumped
+        the epoch WITHOUT an install: the generation chain continued,
+        but a restore of the old base would resurrect the stale epoch
+        and un-fence retired writers) — in each case the caller
+        re-bases via :meth:`save_snapshot` instead."""
         body = bytes(body)
         with self._mu:
             if self._seg_f is None or self._base_gen < 0:
                 return False
             if gen != self._last_gen + 1:
+                return False
+            if epoch is not None and epoch != self._epoch:
                 return False
             rec = _pack_delta(gen, body)
             self._seg_f.write(rec)

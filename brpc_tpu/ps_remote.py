@@ -206,6 +206,99 @@ def _pack_stream_frame(seq: int, epoch: int, gen: int,
     return out
 
 
+# --- zero-copy framings (brt_iobuf) ------------------------------------
+# Byte-identical on the wire to the bytearray packers above (the
+# wire-contract registry claims them under the same schemas), but the
+# payload rides as BORROWED blocks: the few-byte header is the only copy.
+# Runtime-switchable so the zerocopy bench can measure the copy path as
+# its baseline in the same process.
+
+_zerocopy = [True]
+
+
+#: borrow-path engagement floor: below this payload size the per-call
+#: handle lifecycle (new/pin/destroy + finalizers) costs more than the
+#: memcpys it saves (bench_zerocopy's 16-byte cell measures the
+#: crossover), so small unary legs stay on the bytes path
+_ZC_MIN_BYTES = 4096
+
+
+def zerocopy_enabled() -> bool:
+    """True when the PS hot paths frame through borrowed IOBuf blocks
+    instead of copying into request buffers."""
+    return _zerocopy[0] and rpc.native_core_available()
+
+
+def set_zerocopy(on: bool) -> bool:
+    """Flips the zero-copy hot paths (returns the previous setting) —
+    the A/B switch for ``bench_zerocopy``."""
+    prev = _zerocopy[0]
+    _zerocopy[0] = bool(on)
+    return prev
+
+
+def _pack_lookup_req_iobuf(owned: np.ndarray) -> "rpc.IOBuf":
+    """Zero-copy ``lookup_req`` framing: the 4-byte count header is the
+    only copied byte span — the ids array itself is appended as a
+    borrowed block (pinned until the wire write drains)."""
+    ids = np.ascontiguousarray(owned, np.int32)
+    io = rpc.IOBuf()
+    io.append(struct.pack("<i", ids.size))
+    io.append_pinned(ids)
+    return io
+
+
+def _pack_apply_req_iobuf(owned: np.ndarray,
+                          grads: np.ndarray) -> "rpc.IOBuf":
+    """Zero-copy ``apply_req`` framing: count header owned, ids and
+    grads borrowed."""
+    ids = np.ascontiguousarray(owned, np.int32)
+    g = np.ascontiguousarray(grads, np.float32).reshape(-1)
+    io = rpc.IOBuf()
+    io.append(struct.pack("<i", ids.size))
+    io.append_pinned(ids)
+    io.append_pinned(g)
+    return io
+
+
+def _pack_stream_frame_iobuf(seq: int, epoch: int, gen: int,
+                             body) -> "rpc.IOBuf":
+    """Zero-copy ``stream_frame`` framing: 24-byte header owned, body
+    borrowed (bytes) or block-shared (:class:`rpc.IOBuf`)."""
+    io = rpc.IOBuf()
+    io.append(struct.pack("<qqq", seq, epoch, gen))
+    if isinstance(body, rpc.IOBuf):
+        io.append_iobuf(body)
+    elif len(body):
+        io.append_pinned(body)
+    return io
+
+
+def _pack_deadline_iobuf(deadline_us: int, body) -> "rpc.IOBuf":
+    """Zero-copy ``deadline_hdr`` framing: the 12-byte header becomes a
+    PREPENDED owned block and the body's blocks are shared — stamping a
+    deadline no longer re-copies the whole request."""
+    io = rpc.IOBuf()
+    io.append(struct.pack("<iq", wire.DEADLINE_MAGIC, deadline_us))
+    if isinstance(body, rpc.IOBuf):
+        io.append_iobuf(body)
+    elif len(body):
+        io.append_pinned(body)
+    return io
+
+
+def _pack_deadline_rel_iobuf(budget_us: int, body) -> "rpc.IOBuf":
+    """Zero-copy ``deadline_hdr_v2`` framing (relative budget): header
+    owned, body shared/borrowed."""
+    io = rpc.IOBuf()
+    io.append(struct.pack("<iq", wire.DEADLINE_MAGIC2, budget_us))
+    if isinstance(body, rpc.IOBuf):
+        io.append_iobuf(body)
+    elif len(body):
+        io.append_pinned(body)
+    return io
+
+
 def _pack_windows(windows: Dict[str, int]) -> bytes:
     """Writer seq high-water map on the wire: ``int32 count`` ++ per
     entry ``int32 len ++ writer utf8 ++ int64 seq``.  Rides every
@@ -1048,16 +1141,35 @@ class _Replicator:
             return None
         last = peer_gen
         tail_bytes = 0
-        try:
-            for gen, body in deltas:
-                frame = bytes(_pack_stream_frame(gen, self.epoch, gen,
-                                                 body))
-                st.write(frame)
-                tail_bytes += len(frame)
-                last = gen
-        except rpc.RpcError:
-            st.close()
-            return None   # stream died mid-tail: wholesale converges
+        if zerocopy_enabled():
+            # Whole tail in one batched native crossing, delta bodies
+            # borrowed rather than copied into frame bytes.
+            batch = []
+            try:
+                for gen, body in deltas:
+                    batch.append(_pack_stream_frame_iobuf(
+                        gen, self.epoch, gen, body))
+                    tail_bytes += len(batch[-1])
+                    last = gen
+                try:
+                    st.writev(batch)
+                except rpc.RpcError:
+                    st.close()
+                    return None   # died mid-tail: wholesale converges
+            finally:
+                for io in batch:
+                    io.close()
+        else:
+            try:
+                for gen, body in deltas:
+                    frame = bytes(_pack_stream_frame(gen, self.epoch,
+                                                     gen, body))
+                    st.write(frame)
+                    tail_bytes += len(frame)
+                    last = gen
+            except rpc.RpcError:
+                st.close()
+                return None   # stream died mid-tail: wholesale converges
         with self._mu:
             p.stream = st
             p.synced_gen = last
@@ -1111,6 +1223,39 @@ class _Replicator:
                 with self._mu:
                     if p.queue and p.queue[0] is item:
                         p.queue.popleft()
+                continue
+            if zerocopy_enabled():
+                # Drain the eligible head run in ONE native crossing —
+                # queue gens are append-ordered, so once the head
+                # clears ``synced_gen`` the whole run does.  Frame
+                # bytes are pinned (not copied) by ``writev``.
+                with self._mu:
+                    batch = []
+                    for it in p.queue:
+                        if it[0] <= p.synced_gen:
+                            break
+                        batch.append(it)
+                        if len(batch) >= 64:
+                            break
+                try:
+                    p.stream.writev([it[1] for it in batch])
+                except rpc.RpcError as e:
+                    # frames before the break ARE on the wire: pop
+                    # them so the resync does not re-ship
+                    nw = getattr(e, "frames_written", 0)
+                    st, p.stream = p.stream, None
+                    if st is not None:
+                        st.close()
+                    with self._mu:
+                        for it in batch[:nw]:
+                            if p.queue and p.queue[0] is it:
+                                p.queue.popleft()
+                        p.need_sync = True
+                    continue
+                with self._mu:
+                    for it in batch:
+                        if p.queue and p.queue[0] is it:
+                            p.queue.popleft()
                 continue
             try:
                 p.stream.write(frame)
@@ -1655,10 +1800,12 @@ class PsShardServer:
     def _tee_delta(self, dur, gen: int, body: bytes) -> None:
         """Tee one applied generation into the checkpoint store.
         Called under the table WRITE lock, so log order is apply order.
-        A refused append (generation jump the delta framing cannot
-        express) or a compaction-due tail folds the current state into
-        a fresh base instead."""
-        if not dur.append_delta(gen, body) or dur.should_compact():
+        A refused append — generation jump the delta framing cannot
+        express, or an epoch bump (promotion without install) the open
+        base predates — or a compaction-due tail folds the current
+        state into a fresh base instead."""
+        if (not dur.append_delta(gen, body, epoch=self._epoch)
+                or dur.should_compact()):
             self._snapshot_to(dur, gen)
 
     def _snapshot_to(self, dur, gen: int) -> None:
@@ -2518,7 +2665,16 @@ class PsShardServer:
             with self._seq_mu:
                 self._read_count += 1
             with self._mu.read():
-                return self.table[ids].tobytes()
+                gathered = self.table[ids]
+            # The gather above is the ONE unavoidable copy (fancy
+            # indexing materializes the rows); zero-copy mode responds
+            # with the gathered array pinned as a borrowed block instead
+            # of paying tobytes + the respond append on top of it.
+            if zerocopy_enabled() and gathered.nbytes >= _ZC_MIN_BYTES:
+                out = rpc.IOBuf()
+                out.append_pinned(gathered)
+                return out
+            return gathered.tobytes()
         if method == "ApplyGrad":
             # Writes belong to the primary: a demoted/backup replica
             # rejects so the client re-resolves and fails over.  A
@@ -2911,6 +3067,14 @@ class DevicePsShardServer:
                     raw = self.dev.fetch(rows_h)
                 finally:
                     self.dev.release(rows_h)
+                if zerocopy_enabled() and \
+                        count * self.dim * 4 >= _ZC_MIN_BYTES:
+                    # Borrow the fetched bytes (pinning them) instead of
+                    # slicing off a truncated copy + the respond append.
+                    out = rpc.IOBuf()
+                    out.append_pinned(
+                        memoryview(raw)[:count * self.dim * 4])
+                    return out
                 return raw[:count * self.dim * 4]
             if method == "ApplyGrad":
                 grads = np.zeros((bucket, self.dim), np.float32)
@@ -3800,10 +3964,30 @@ class RemoteEmbedding:
         if deadline is None or not self.propagate_deadline:
             return req
         remaining_s = deadline - time.monotonic()
+        if isinstance(req, rpc.IOBuf):
+            # Zero-copy stamp: the 12-byte header rides as a prepended
+            # owned block and the body's blocks are SHARED — the old
+            # path re-copied the whole request to prepend 12 bytes.
+            # The caller closes the stamped wrapper after the leg
+            # starts (_close_stamped); `req` itself stays intact for
+            # further attempts.
+            if self.deadline_mode == "relative":
+                return _pack_deadline_rel_iobuf(int(remaining_s * 1e6),
+                                                req)
+            return _pack_deadline_iobuf(
+                int((time.time() + remaining_s) * 1e6), req)
         if self.deadline_mode == "relative":
             return _pack_deadline_rel(int(remaining_s * 1e6), req)
         return _pack_deadline(int((time.time() + remaining_s) * 1e6),
                               req)
+
+    @staticmethod
+    def _close_stamped(req, stamped) -> None:
+        """Release a per-leg stamped IOBuf once its call has started or
+        finished — the native request shares the blocks, so the wrapper
+        handle is no longer needed (and ``req`` is untouched)."""
+        if stamped is not req and isinstance(stamped, rpc.IOBuf):
+            stamped.close()
 
     def _reroutable(self, view: _SchemeView, s: int,
                     exc: rpc.RpcError) -> bool:
@@ -3882,9 +4066,10 @@ class RemoteEmbedding:
             b = self._addr_breaker(addr)
             view.scorer.note_start(addr)
             t0 = time.monotonic()
+            stamped = self._stamp(req, deadline)
             try:
                 rsp = self._chan(addr).call(
-                    "Ps", method, self._stamp(req, deadline),
+                    "Ps", method, stamped,
                     timeout_ms=t, backup_ms=self.backup_ms)
             except rpc.RpcError as e2:
                 routing = e2.code in (resilience.ENOTPRIMARY,
@@ -3897,6 +4082,8 @@ class RemoteEmbedding:
                     b.on_call_end(0 if routing else e2.code)
                 e = e2
                 continue
+            finally:
+                self._close_stamped(req, stamped)
             view.scorer.note_end(addr, time.monotonic() - t0, True)
             if b is not None:
                 b.on_call_end(0)
@@ -3950,15 +4137,20 @@ class RemoteEmbedding:
             tried[i].add(addr)
             view.scorer.note_start(addr)
             t0s[i] = time.monotonic()
+            stamped = self._stamp(req, deadline)
             try:
                 # managed fan-out set: every entry is joined or
                 # cancelled+closed in the finally below; each leg is
                 # stamped with the budget remaining at ITS issue
                 pending[i] = self._chan(addr).call_async(  # lint: allow-handle-escape
-                    "Ps", method, self._stamp(req, deadline),
+                    "Ps", method, stamped,
                     timeout_ms=_budget(), tag=f"attempt={attempts[i]}")
             except rpc.RpcError as e:
                 pending[i] = e
+            finally:
+                # the started call shares the blocks; the stamped
+                # wrapper handle is done its job
+                self._close_stamped(req, stamped)
 
         def _settle(i: int, pc: object, ok: bool, code: int = 0) -> None:
             """Feed one finished attempt to the scorer + breaker.
@@ -3993,11 +4185,15 @@ class RemoteEmbedding:
                             raise pc
                         # the hedge leg re-stamps: a backup fired
                         # backup_ms late carries the budget left THEN
-                        rsp = resilience.backup_call(
-                            self._chan(addrs[i]), "Ps", method,
-                            self._stamp(req, deadline),
-                            backup_ms=self.backup_ms,
-                            timeout_ms=_budget(), primary=pc)
+                        stamped = self._stamp(req, deadline)
+                        try:
+                            rsp = resilience.backup_call(
+                                self._chan(addrs[i]), "Ps", method,
+                                stamped,
+                                backup_ms=self.backup_ms,
+                                timeout_ms=_budget(), primary=pc)
+                        finally:
+                            self._close_stamped(req, stamped)
                     except rpc.RpcError as e:
                         _settle(i, pc, False, e.code)
                         rsp = self._retry_shard(view, s, method, req,
@@ -4122,6 +4318,18 @@ class RemoteEmbedding:
                     _start(i, s, req)
                     _enqueue(i)
             return out  # type: ignore[return-value]
+        except BaseException:
+            # Aborted batch: the caller never sees `out`, so close any
+            # already-collected IOBuf responses — the propagating
+            # traceback pins this frame (and with it `out`), which
+            # would otherwise hold the handles past the test/leak
+            # ledger's horizon.  With on_done the caller owns delivered
+            # responses and closes them itself.
+            if on_done is None:
+                for rsp in out:
+                    if isinstance(rsp, rpc.IOBuf):
+                        rsp.close()
+            raise
         finally:
             if group is not None:
                 group.close()
@@ -4140,9 +4348,10 @@ class RemoteEmbedding:
             if self.deadline_ms is not None else None
         addr = self._route_read(view, s) if method == "Lookup" \
             else self._route_write(view, s)
+        stamped = self._stamp(req, deadline)
         try:
             return self._chan(addr).call(
-                "Ps", method, self._stamp(req, deadline),
+                "Ps", method, stamped,
                 retry=self.retry, deadline_ms=self.deadline_ms,
                 backup_ms=self.backup_ms,
                 breaker=self._addr_breaker(addr))
@@ -4150,12 +4359,18 @@ class RemoteEmbedding:
             if method != "Lookup" and not self._scheme_miss(e) and \
                     self._reroutable(view, s, e):
                 addr = self._route_write(view, s, {addr})
-                return self._chan(addr).call(
-                    "Ps", method, self._stamp(req, deadline),
-                    retry=self.retry, deadline_ms=self.deadline_ms,
-                    backup_ms=self.backup_ms,
-                    breaker=self._addr_breaker(addr))
+                restamped = self._stamp(req, deadline)
+                try:
+                    return self._chan(addr).call(
+                        "Ps", method, restamped,
+                        retry=self.retry, deadline_ms=self.deadline_ms,
+                        backup_ms=self.backup_ms,
+                        breaker=self._addr_breaker(addr))
+                finally:
+                    self._close_stamped(req, restamped)
             raise
+        finally:
+            self._close_stamped(req, stamped)
 
     def _owner_split(self, view: _SchemeView, flat_ids: np.ndarray):
         if flat_ids.size and (flat_ids.min() < 0
@@ -4213,6 +4428,24 @@ class RemoteEmbedding:
         falls back across schemes)."""
         nbytes_in = 0
         nbytes_out = 0
+        zc = zerocopy_enabled()
+
+        def _consume(rsp, owned):
+            """Response rows as float32 — zero-copy for single-block
+            IOBuf replies (one gather for multi-block), plain
+            frombuffer for the bytes path."""
+            if isinstance(rsp, rpc.IOBuf):
+                try:
+                    return np.frombuffer(rsp.as_memoryview(),
+                                         np.float32).reshape(
+                                             owned.size, self.dim)
+                finally:
+                    # A live view defers actual destruction; the rows
+                    # are copied into `out` before the array dies.
+                    rsp.close()
+            return np.frombuffer(rsp, np.float32).reshape(
+                owned.size, self.dim)
+
         if self.parallel:
             # Start every owner-shard call before joining any: the
             # shards serve concurrently and the batch pays max(shard),
@@ -4221,23 +4454,40 @@ class RemoteEmbedding:
             # stragglers on an unrecoverable partial failure.
             split = list(self._owner_split(view, flat))
             items = []
-            for s, positions, owned in split:
-                req = _pack_lookup_req(owned)
-                nbytes_out += len(req)
-                items.append((s, req))
-            for (s, positions, owned), rsp in zip(
-                    split, self._fan_out(view, "Lookup", items)):
-                nbytes_in += len(rsp)
-                out[positions] = np.frombuffer(
-                    rsp, np.float32).reshape(owned.size, self.dim)
+            rsps: List[object] = []
+            try:
+                for s, positions, owned in split:
+                    req = _pack_lookup_req_iobuf(owned) \
+                        if zc and owned.nbytes >= _ZC_MIN_BYTES \
+                        else _pack_lookup_req(owned)
+                    nbytes_out += len(req)
+                    items.append((s, req))
+                rsps = self._fan_out(view, "Lookup", items)
+                for (s, positions, owned), rsp in zip(split, rsps):
+                    nbytes_in += len(rsp)
+                    out[positions] = _consume(rsp, owned)
+            finally:
+                for _, req in items:
+                    if isinstance(req, rpc.IOBuf):
+                        req.close()
+                # a consume interrupted mid-batch must not strand the
+                # remaining response handles (close() is idempotent)
+                for rsp in rsps:
+                    if isinstance(rsp, rpc.IOBuf):
+                        rsp.close()
         else:
             for s, positions, owned in self._owner_split(view, flat):
-                req = _pack_lookup_req(owned)
-                rsp = self._call_shard(view, s, "Lookup", req)
-                out[positions] = np.frombuffer(rsp, np.float32).reshape(
-                    owned.size, self.dim)
+                req = _pack_lookup_req_iobuf(owned) \
+                    if zc and owned.nbytes >= _ZC_MIN_BYTES \
+                    else _pack_lookup_req(owned)
                 nbytes_out += len(req)
+                try:
+                    rsp = self._call_shard(view, s, "Lookup", req)
+                finally:
+                    if isinstance(req, rpc.IOBuf):
+                        req.close()
                 nbytes_in += len(rsp)
+                out[positions] = _consume(rsp, owned)
         return nbytes_out, nbytes_in
 
     def lookup(self, ids: np.ndarray) -> np.ndarray:
@@ -4448,15 +4698,53 @@ class RemoteEmbedding:
                 # seqs are contiguous per shard: the unsent tail starts
                 # right past the cursor
                 start = max(0, sent - frames[0][0] + 1) if frames else 0
-                for seq, body in frames[start:]:
+                if zerocopy_enabled():
+                    # Batched zero-copy replay: every eligible frame in
+                    # ONE native crossing (header blocks owned, bodies
+                    # borrowed).  The fence check moves to batch
+                    # granularity — a fence landing mid-batch is the
+                    # same race the per-frame path had between check
+                    # and write.
                     if recv is not None and recv.fenced:
                         raise rpc.RpcError(
                             self._fence_code(recv),
                             f"shard {s} push stream fenced")
-                    if seq <= sent:
-                        continue
-                    st.write(_pack_stream_frame(seq, 0, 0, body))
-                    self._push_sent[s] = sent = seq
+                    seqs = []
+                    batch = []
+                    try:
+                        for seq, body in frames[start:]:
+                            if seq <= sent:
+                                continue
+                            seqs.append(seq)
+                            batch.append(
+                                _pack_stream_frame_iobuf(seq, 0, 0,
+                                                         body))
+                        if batch:
+                            try:
+                                st.writev(batch)
+                            except rpc.RpcError as e:
+                                nw = getattr(e, "frames_written", 0)
+                                if nw:
+                                    # frames before the break ARE on
+                                    # the wire: advance the cursor so
+                                    # the reconnect replays the tail
+                                    self._push_sent[s] = sent = \
+                                        seqs[nw - 1]
+                                raise
+                            self._push_sent[s] = sent = seqs[-1]
+                    finally:
+                        for io in batch:
+                            io.close()
+                else:
+                    for seq, body in frames[start:]:
+                        if recv is not None and recv.fenced:
+                            raise rpc.RpcError(
+                                self._fence_code(recv),
+                                f"shard {s} push stream fenced")
+                        if seq <= sent:
+                            continue
+                        st.write(_pack_stream_frame(seq, 0, 0, body))
+                        self._push_sent[s] = sent = seq
                 if recv is not None and recv.fenced:
                     raise rpc.RpcError(
                         self._fence_code(recv),

@@ -239,7 +239,8 @@ class Rebalancer(threading.Thread):
                  interval_ms: float = 200.0, timeout_ms: int = 2000,
                  migrate_deadline_s: float = 30.0,
                  drain_deadline_s: float = 10.0,
-                 ramp_steps: Optional[Sequence[float]] = None):
+                 ramp_steps: Optional[Sequence[float]] = None,
+                 checkpoint_stores=None):
         super().__init__(daemon=True, name="brt-rebalancer")
         self.registry_addr = registry_addr
         self.cluster = cluster
@@ -252,6 +253,14 @@ class Rebalancer(threading.Thread):
         self.migrate_deadline_s = migrate_deadline_s
         self.drain_deadline_s = drain_deadline_s
         self.ramp_steps = ramp_steps
+        #: source-shard checkpoint stores for split/merge destination
+        #: seeding: a ``{shard_index: CheckpointStore}`` map over the
+        #: ACTIVE scheme, or a callable ``(scheme, shard) -> store``
+        #: (indices shift across versions — a callable tracks them).
+        #: When a source has one, every decided migration seeds its
+        #: destinations from the on-disk base BEFORE the copy phase,
+        #: so the live source ships only the delta tail.
+        self.checkpoint_stores = checkpoint_stores
         self._reg = NamingClient(registry_addr)
         # All mutable state below is owned by the rebalancer thread
         # (step() from tests runs before start() or after stop()).
@@ -446,6 +455,7 @@ class Rebalancer(threading.Thread):
                               cluster=self.cluster,
                               timeout_ms=self.timeout_ms)
         try:
+            self._auto_hydrate(scheme, drv)
             try:
                 drv.run(deadline_s=self.migrate_deadline_s,
                         ramp_steps=self.ramp_steps)
@@ -475,6 +485,41 @@ class Rebalancer(threading.Thread):
                 self.on_retired(scheme)
         finally:
             drv.close()
+
+    def _auto_hydrate(self, scheme: PartitionScheme,
+                      drv: MigrationDriver) -> None:
+        """Seed every destination of the decided migration from the
+        source's attached checkpoint store, before the copy phase: the
+        destination records the seeded watermark, so the live source's
+        shipper (hydrate-first mode) ships only the delta tail instead
+        of a wholesale range snapshot.  Strictly best-effort — any
+        failure leaves the destination unseeded and the shipper's
+        wholesale path converges exactly as without a store."""
+        if self.checkpoint_stores is None:
+            return
+        from brpc_tpu import durable
+        for s in range(scheme.num_shards):
+            store = (self.checkpoint_stores(scheme, s)
+                     if callable(self.checkpoint_stores)
+                     else self.checkpoint_stores.get(s))
+            if store is None:
+                continue
+            try:
+                src_addr = drv._live_primary(scheme, s)
+                olo, _ = scheme.shard_bounds(s, self.vocab)
+                for t in drv.targets_for(s):
+                    durable.hydrate_destination(
+                        store, t["addr"], drv.new.version, src_addr,
+                        olo, t["base"], t["rows"],
+                        timeout_ms=self.timeout_ms)
+                    if obs.enabled():
+                        obs.counter("ps_rebalance_hydrations").add(1)
+            except (rpc.RpcError, ValueError, OSError) as e:
+                if obs.enabled():
+                    obs.counter("ps_rebalance_hydrate_errors").add(1)
+                self.errors.append(
+                    f"hydrate s{s}: {type(e).__name__}: {e}"[:200])
+                del self.errors[:-20]
 
     def _failback(self, scheme: PartitionScheme, decision: Decision,
                   claims: dict) -> None:

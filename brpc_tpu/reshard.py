@@ -52,8 +52,9 @@ from brpc_tpu.analysis.race import checked_lock
 from brpc_tpu.naming import (NamingClient, PartitionScheme,
                              publish_scheme)
 from brpc_tpu.ps_remote import (_pack_apply_req, _pack_stream_frame,
-                                _pack_windows, _reject_frame,
-                                _unpack_apply, _unpack_windows)
+                                _pack_stream_frame_iobuf, _pack_windows,
+                                _reject_frame, _unpack_apply,
+                                _unpack_windows, zerocopy_enabled)
 
 
 class _ShipperAckReceiver:
@@ -365,6 +366,7 @@ class MigrationShipper:
         glast = mark        # last source gen RELEVANT to this target
         slast = mark        # last source gen covered (relevant or not)
         tail_bytes = 0
+        batch = []          # zero-copy mode: whole tail in one writev
         try:
             for gen, body in deltas:
                 windows, off = _unpack_windows(body)
@@ -376,16 +378,28 @@ class MigrationShipper:
                 mask = (gids >= t.base) & (gids < t.base + t.rows)
                 if not mask.any():
                     continue
-                frame = bytes(_pack_stream_frame(
-                    gen, self.scheme, gen,
-                    _pack_windows(windows) + bytes(_pack_apply_req(
-                        gids[mask].astype(np.int32), grads[mask]))))
-                st.write(frame)
-                tail_bytes += len(frame)
+                filtered = (_pack_windows(windows)
+                            + bytes(_pack_apply_req(
+                                gids[mask].astype(np.int32),
+                                grads[mask])))
+                if zerocopy_enabled():
+                    batch.append(_pack_stream_frame_iobuf(
+                        gen, self.scheme, gen, filtered))
+                    tail_bytes += len(batch[-1])
+                else:
+                    frame = bytes(_pack_stream_frame(
+                        gen, self.scheme, gen, filtered))
+                    st.write(frame)
+                    tail_bytes += len(frame)
                 glast = gen
+            if batch:
+                st.writev(batch)
         except (rpc.RpcError, wire.WireError):
             st.close()
             return None   # bad tail or dead stream: wholesale converges
+        finally:
+            for io in batch:
+                io.close()
         with self._mu:
             t.stream = st
             t.synced_gen = slast
@@ -437,6 +451,36 @@ class MigrationShipper:
                 with self._mu:
                     if t.queue and t.queue[0] is item:
                         t.queue.popleft()
+                continue
+            if zerocopy_enabled():
+                # Batch the eligible head run through one writev —
+                # queue gens are append-ordered, so once the head
+                # clears ``synced_gen`` the whole run does.
+                with self._mu:
+                    batch = []
+                    for it in t.queue:
+                        if it[0] <= t.synced_gen:
+                            break
+                        batch.append(it)
+                        if len(batch) >= 64:
+                            break
+                try:
+                    t.stream.writev([it[1] for it in batch])
+                except rpc.RpcError as e:
+                    nw = getattr(e, "frames_written", 0)
+                    st, t.stream = t.stream, None
+                    if st is not None:
+                        st.close()
+                    with self._mu:
+                        for it in batch[:nw]:
+                            if t.queue and t.queue[0] is it:
+                                t.queue.popleft()
+                        t.need_sync = True
+                    continue
+                with self._mu:
+                    for it in batch:
+                        if t.queue and t.queue[0] is it:
+                            t.queue.popleft()
                 continue
             try:
                 t.stream.write(frame)

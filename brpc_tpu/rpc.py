@@ -11,7 +11,10 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import sys
+import threading
 import time
+import weakref
 from typing import Callable, Optional
 
 from brpc_tpu import fault, obs, resilience
@@ -37,6 +40,12 @@ _DROP_HOOK = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
     ctypes.c_int
 )
+
+# brt_iobuf_release: (data, arg) — fired when the last native reference
+# to a borrowed (append_pinned) block drops; arg is the pin-registry
+# token.  ctypes auto-acquires the GIL, so the callback may fire from
+# any fiber/socket thread.
+_IOBUF_RELEASE = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
 
 _lib = None
 _load_error: Optional[str] = None
@@ -220,6 +229,54 @@ def _load_locked():
     lib.brt_stream_join.restype = ctypes.c_int
     lib.brt_stream_abort.argtypes = [ctypes.c_uint64]
     lib.brt_stream_abort.restype = ctypes.c_int
+    # zero-copy buffer currency (capi/iobuf_capi.cc + c_api.cc variants)
+    lib.brt_iobuf_new.argtypes = []
+    lib.brt_iobuf_new.restype = ctypes.c_void_p
+    lib.brt_iobuf_destroy.argtypes = [ctypes.c_void_p]
+    lib.brt_iobuf_destroy.restype = None
+    lib.brt_iobuf_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.brt_iobuf_append.restype = ctypes.c_int
+    lib.brt_iobuf_appendv.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_int]
+    lib.brt_iobuf_appendv.restype = ctypes.c_int
+    lib.brt_iobuf_append_user_data.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, _IOBUF_RELEASE,
+        ctypes.c_void_p]
+    lib.brt_iobuf_append_user_data.restype = ctypes.c_int
+    lib.brt_iobuf_append_iobuf.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.brt_iobuf_append_iobuf.restype = ctypes.c_int
+    lib.brt_iobuf_size.argtypes = [ctypes.c_void_p]
+    lib.brt_iobuf_size.restype = ctypes.c_int64
+    lib.brt_iobuf_copy_out.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t]
+    lib.brt_iobuf_copy_out.restype = ctypes.c_int64
+    lib.brt_iobuf_block_count.argtypes = [ctypes.c_void_p]
+    lib.brt_iobuf_block_count.restype = ctypes.c_int
+    lib.brt_iobuf_block_data.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.brt_iobuf_block_data.restype = ctypes.c_void_p
+    lib.brt_iobuf_block_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.brt_iobuf_block_len.restype = ctypes.c_int64
+    lib.brt_channel_call_iobuf.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_size_t]
+    lib.brt_channel_call_iobuf.restype = ctypes.c_void_p
+    lib.brt_channel_call_start_iobuf.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_int64]
+    lib.brt_channel_call_start_iobuf.restype = ctypes.c_void_p
+    lib.brt_call_join_iobuf.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_char_p,
+        ctypes.c_size_t]
+    lib.brt_call_join_iobuf.restype = ctypes.c_void_p
+    lib.brt_session_respond_iobuf.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p]
+    lib.brt_session_respond_iobuf.restype = None
+    lib.brt_stream_writev.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int64)]
+    lib.brt_stream_writev.restype = ctypes.c_int
     lib.brt_set_drop_hook.argtypes = [_DROP_HOOK, ctypes.c_void_p]
     lib.brt_set_drop_hook.restype = None
     lib.brt_call_cancel.argtypes = [ctypes.c_void_p]
@@ -310,6 +367,10 @@ _HANDLE_NEW = {
     "brt_event_new": "event",
     "brt_device_client_new": "device_client",
     "brt_device_compile": "device_executable",
+    "brt_iobuf_new": "iobuf",
+    "brt_channel_call_iobuf": "iobuf",
+    "brt_call_join_iobuf": "iobuf",
+    "brt_channel_call_start_iobuf": "call",
 }
 _HANDLE_DESTROY = {
     "brt_server_destroy": "server",
@@ -320,6 +381,7 @@ _HANDLE_DESTROY = {
     "brt_event_destroy": "event",
     "brt_device_client_destroy": "device_client",
     "brt_device_executable_destroy": "device_executable",
+    "brt_iobuf_destroy": "iobuf",
 }
 
 
@@ -429,6 +491,243 @@ def _req_ptr(request):
     if isinstance(request, bytes) or request is None:
         return request
     return (ctypes.c_char * len(request)).from_buffer(request)
+
+
+# ---------------------------------------------------------------------------
+# zero-copy buffer currency (brt_iobuf_* — capi/iobuf_capi.cc)
+# ---------------------------------------------------------------------------
+
+# Pin registry for borrowed blocks: append_pinned hands the native core a
+# raw pointer into a Python buffer and parks the owning object here; the
+# native release callback (last-ref drop — possibly on a socket thread,
+# GIL auto-acquired) pops it.  The ledger of live pins is exact: a pinned
+# buffer outlives every wire write that borrowed it, never longer.
+_iobuf_pin_mu = threading.Lock()
+_iobuf_pins: dict = {}
+_iobuf_pin_seq = [0]
+
+
+@_IOBUF_RELEASE
+def _iobuf_release_cb(data, arg):
+    with _iobuf_pin_mu:
+        _iobuf_pins.pop(arg, None)
+
+
+def debug_iobuf_pins() -> int:
+    """Live borrowed-block pins (buffers the native core still holds a
+    reference into).  Drops to zero once every in-flight write drained."""
+    with _iobuf_pin_mu:
+        return len(_iobuf_pins)
+
+
+def _pin_buffer(data):
+    """(address, nbytes, keepalive) of ``data``'s memory WITHOUT copying.
+    Accepts bytes, writable buffers (bytearray/memoryview/numpy) and
+    read-only numpy arrays; the keepalive object must stay referenced
+    until the native side releases the block."""
+    if isinstance(data, bytes):
+        addr = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value
+        return addr, len(data), data
+    if hasattr(data, "__array_interface__"):       # numpy, any writability
+        ai = data.__array_interface__
+        if ai.get("strides") is not None:
+            raise ValueError("append_pinned needs a contiguous array")
+        return ai["data"][0], data.nbytes, data
+    mv = memoryview(data)
+    if not mv.contiguous:
+        raise ValueError("append_pinned needs a contiguous buffer")
+    if mv.readonly:
+        # ctypes can't from_buffer a read-only view; numpy can still
+        # surface the address (the pin keeps the chain alive).
+        import numpy as np
+        arr = np.frombuffer(mv, np.uint8)
+        return (arr.__array_interface__["data"][0], mv.nbytes,
+                (data, mv, arr))
+    c = (ctypes.c_char * mv.nbytes).from_buffer(mv)
+    return ctypes.addressof(c), mv.nbytes, (data, mv, c)
+
+
+class _IobufToken:
+    """Keepalive anchor: every exported view holds a reference, and the
+    native handle is destroyed by the token's finalizer once the LAST
+    holder (wrapper or view) is gone — a borrowed view can therefore
+    never dangle."""
+
+    __slots__ = ("__weakref__",)
+
+
+class IOBuf:
+    """A native refcounted buffer chain (``brt::IOBuf``) addressed from
+    Python — the zero-copy currency of the RPC tier.
+
+    Build requests as [small owned header ++ borrowed payload]:
+    ``append()`` copies (use it for the few-byte framing headers),
+    ``append_pinned()`` borrows the caller's buffer with NO copy — the
+    buffer is pinned in a registry until the native core drops its last
+    reference (i.e. after the socket write drained), so mutating it
+    before then is a data race the caller owns.  Responses come back as
+    an :class:`IOBuf` from ``Channel.call``/``PendingCall.join`` when the
+    request went in as one; read them with ``as_memoryview()`` (zero-copy
+    for single-block bodies) or ``tobytes()``.
+
+    Lifetime: ``close()`` releases the handle — unless live views exist,
+    in which case destruction defers to the last view's death (the
+    borrow-not-dangle contract).  Abandoned handles are reclaimed by GC
+    via the same finalizer, but the ledger check expects explicit
+    ``close()``.
+    """
+
+    __slots__ = ("_lib", "_ptr", "_token", "_fin")
+
+    def __init__(self, data=None):
+        lib = _load()
+        ptr = lib.brt_iobuf_new()
+        if not ptr:
+            raise MemoryError("brt_iobuf_new failed")
+        self._lib = lib
+        self._ptr = ptr
+        self._token = _IobufToken()
+        self._fin = weakref.finalize(self._token, lib.brt_iobuf_destroy,
+                                     ptr)
+        if data:
+            self.append(data)
+
+    @classmethod
+    def _adopt(cls, lib, ptr) -> "IOBuf":
+        """Wraps a native handle we already own (response swaps)."""
+        io = cls.__new__(cls)
+        io._lib = lib
+        io._ptr = ptr
+        io._token = _IobufToken()
+        io._fin = weakref.finalize(io._token, lib.brt_iobuf_destroy,
+                                   ptr)
+        return io
+
+    def __len__(self) -> int:
+        if self._ptr is None:
+            return 0
+        return int(self._lib.brt_iobuf_size(self._ptr))
+
+    @property
+    def size(self) -> int:
+        return len(self)
+
+    @property
+    def block_count(self) -> int:
+        if self._ptr is None:
+            return 0
+        return self._lib.brt_iobuf_block_count(self._ptr)
+
+    def _require(self):
+        if self._ptr is None:
+            raise RuntimeError("IOBuf is closed")
+        return self._ptr
+
+    def append(self, data) -> None:
+        """Copying append (the native side owns a copy) — right for the
+        few-byte framing headers in front of a borrowed payload."""
+        ptr = self._require()
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            data = bytes(data)
+        n = len(data)
+        if n == 0:
+            return
+        rc = self._lib.brt_iobuf_append(ptr, _req_ptr(data), n)
+        if rc != 0:
+            raise RpcError(rc, "iobuf append failed")
+
+    def append_pinned(self, data) -> None:
+        """Zero-copy append: the native chain BORROWS ``data``'s memory.
+        ``data`` is pinned (kept alive and counted in
+        :func:`debug_iobuf_pins`) until the core's last reference drops;
+        the caller must not mutate it before then."""
+        ptr = self._require()
+        addr, n, keep = _pin_buffer(data)
+        if n == 0:
+            return
+        with _iobuf_pin_mu:
+            _iobuf_pin_seq[0] += 1
+            token = _iobuf_pin_seq[0]
+            _iobuf_pins[token] = keep
+        rc = self._lib.brt_iobuf_append_user_data(
+            ptr, addr, n, _iobuf_release_cb, token)
+        if rc != 0:
+            with _iobuf_pin_mu:
+                _iobuf_pins.pop(token, None)
+            raise RpcError(rc, "iobuf append_pinned failed")
+
+    def append_iobuf(self, other: "IOBuf") -> None:
+        """Shares ``other``'s blocks (refcount bump, no payload copy)."""
+        ptr = self._require()
+        rc = self._lib.brt_iobuf_append_iobuf(ptr, other._require())
+        if rc != 0:
+            raise RpcError(rc, "iobuf append_iobuf failed")
+
+    def as_memoryview(self) -> memoryview:
+        """The contents as a buffer suitable for ``np.frombuffer``.
+
+        Single-block chains (bodies under the native 8KB block size, and
+        swapped-in responses whose payload was one borrowed block) export
+        a ZERO-COPY view over native memory: the view holds the handle's
+        keepalive token, so it stays valid after ``close()`` — the
+        handle's destruction defers to the view's death.  Multi-block
+        chains gather once into fresh memory (still one copy fewer than
+        the bytes path)."""
+        ptr = self._require()
+        nblocks = self._lib.brt_iobuf_block_count(ptr)
+        if nblocks == 1:
+            n = int(self._lib.brt_iobuf_block_len(ptr, 0))
+            base = self._lib.brt_iobuf_block_data(ptr, 0)
+            arr = (ctypes.c_char * n).from_address(base)
+            # The view must pin the native handle: ctypes instances keep
+            # arbitrary attributes, and memoryview(arr) keeps arr.
+            arr._brt_keepalive = self._token
+            return memoryview(arr)
+        total = int(self._lib.brt_iobuf_size(ptr))
+        out = bytearray(total)
+        if total:
+            got = self._lib.brt_iobuf_copy_out(
+                ptr, (ctypes.c_char * total).from_buffer(out), total, 0)
+            if got != total:
+                raise RpcError(-1, f"iobuf gather {got} != {total}")
+            if obs.enabled():
+                obs.counter("rpc_bytes_copied").add(total)
+        return memoryview(out)
+
+    def tobytes(self) -> bytes:
+        """Copy out the full contents (the compatibility exit)."""
+        ptr = self._require()
+        total = int(self._lib.brt_iobuf_size(ptr))
+        out = bytearray(total)
+        if total:
+            self._lib.brt_iobuf_copy_out(
+                ptr, (ctypes.c_char * total).from_buffer(out), total, 0)
+            if obs.enabled():
+                obs.counter("rpc_bytes_copied").add(total)
+        return bytes(out)
+
+    def close(self) -> None:
+        """Release the handle.  With live ``as_memoryview()`` views the
+        native buffer stays pinned and destruction happens when the last
+        view dies; without views it is destroyed here, now."""
+        if self._ptr is None:
+            return
+        ptr, self._ptr = self._ptr, None
+        token, self._token = self._token, None
+        # 2 = the local `token` + getrefcount's argument ref: no view
+        # holds the anchor, so the handle can die synchronously.
+        # Otherwise the finalizer owns destruction — it fires when the
+        # last view drops the token.
+        if sys.getrefcount(token) <= 2:
+            self._fin.detach()
+            self._lib.brt_iobuf_destroy(ptr)
+        del token
+
+    def __enter__(self) -> "IOBuf":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 # ---------------------------------------------------------------------------
@@ -789,6 +1088,8 @@ class Server:
                                 f"{g.max_concurrency} reached")
                         gate = g
                 data = ctypes.string_at(req, req_len) if req_len else b""
+                if rec and req_len:
+                    obs.counter("rpc_bytes_copied").add(req_len)
                 if fault.active():
                     fault.server_intercept(name, mstr, self._listen)
                 if pass_accept:
@@ -816,8 +1117,17 @@ class Server:
                         err_code if err else 2001)
             finally:
                 if err is None:
-                    lib.brt_session_respond(session, out, out_len, 0,
-                                            None)
+                    if isinstance(out, IOBuf):
+                        # The response SHARES the handler's blocks (no
+                        # copy); the handle is not consumed — close it
+                        # here, which defers actual destruction past the
+                        # socket write via the block refcounts.
+                        lib.brt_session_respond_iobuf(
+                            session, out._require(), 0, None)
+                        out.close()
+                    else:
+                        lib.brt_session_respond(session, out, out_len, 0,
+                                                None)
                 else:
                     lib.brt_session_respond(session, None, 0, err_code,
                                             err.encode())
@@ -1005,10 +1315,10 @@ class PendingCall:
     """
 
     __slots__ = ("_lib", "_ptr", "_service", "_method", "_peer",
-                 "_req_len", "_t0", "_wall", "_tag")
+                 "_req_len", "_t0", "_wall", "_tag", "_iobuf")
 
     def __init__(self, lib, ptr, service, method, peer, req_len, t0, wall,
-                 tag=None):
+                 tag=None, iobuf=False):
         self._lib = lib
         self._ptr = ptr
         self._service = service
@@ -1018,6 +1328,9 @@ class PendingCall:
         self._t0 = t0      # None when obs was disabled at start
         self._wall = wall
         self._tag = tag
+        # Calls started with an IOBuf request join to an IOBuf response
+        # (brt_call_join_iobuf swaps the blocks out — no copy).
+        self._iobuf = iobuf
 
     def wait(self, timeout_s: Optional[float] = None) -> bool:
         """True once the call has completed (``join`` will not block).
@@ -1046,6 +1359,8 @@ class PendingCall:
             raise RuntimeError("async call already joined/closed")
         if _race.enabled():
             _race.note_blocking("brt_call_join")
+        if self._iobuf:
+            return self._join_iobuf()
         ptr, self._ptr = self._ptr, None
         rsp = ctypes.c_void_p()
         rsp_len = ctypes.c_size_t()
@@ -1069,6 +1384,33 @@ class PendingCall:
             self._lib.brt_call_destroy(ptr)
         if self._t0 is not None:
             # start -> join latency: the caller-visible async window
+            _record_client_call(self._service, self._method, self._peer,
+                                self._t0, self._wall, self._req_len,
+                                len(out), 0, "", self._tag)
+            obs.counter("rpc_bytes_copied").add(len(out))
+        return out
+
+    def _join_iobuf(self) -> "IOBuf":
+        """Collects the reply as an :class:`IOBuf` — the response blocks
+        are swapped out of the call, not copied."""
+        ptr, self._ptr = self._ptr, None
+        err = ctypes.c_int()
+        errbuf = ctypes.create_string_buffer(256)
+        try:
+            h = self._lib.brt_call_join_iobuf(ptr, ctypes.byref(err),
+                                              errbuf, 256)
+            if not h:
+                text = errbuf.value.decode(errors="replace")
+                if self._t0 is not None:
+                    _record_client_call(self._service, self._method,
+                                        self._peer, self._t0, self._wall,
+                                        self._req_len, 0, err.value, text,
+                                        self._tag)
+                raise RpcError(err.value or -1, text)
+        finally:
+            self._lib.brt_call_destroy(ptr)
+        out = IOBuf._adopt(self._lib, h)
+        if self._t0 is not None:
             _record_client_call(self._service, self._method, self._peer,
                                 self._t0, self._wall, self._req_len,
                                 len(out), 0, "", self._tag)
@@ -1202,6 +1544,57 @@ class Stream:
                 obs.counter("stream_stall_ms").add(stall.value / 1000.0)
         if rc != 0:
             raise RpcError(rc, f"stream write to {self.peer} failed")
+
+    def writev(self, frames) -> int:
+        """Batched ordered write: N framed messages in ONE native
+        crossing, each frame's payload borrowed, not copied — bytes
+        frames are pinned until the socket write drains them, and
+        :class:`IOBuf` frames ride their own block refcounts.  Returns
+        the number of frames written.  On failure raises
+        :class:`RpcError` with ``e.frames_written`` set — frames before
+        it are on the wire, frames from it on are NOT (the caller's
+        retry queue still holds them)."""
+        if self._closed:
+            raise RpcError(22, f"stream to {self.peer} is closed")
+        frames = list(frames)
+        if not frames:
+            return 0
+        if _race.enabled():
+            _race.note_blocking("brt_stream_writev")
+        temps = []
+        handles = []
+        total = 0
+        try:
+            for f in frames:
+                if isinstance(f, IOBuf):
+                    handles.append(f._require())
+                    total += len(f)
+                else:
+                    io = IOBuf()
+                    io.append_pinned(f)
+                    temps.append(io)
+                    handles.append(io._require())
+                    total += len(f)
+            arr = (ctypes.c_void_p * len(handles))(*handles)
+            nw = ctypes.c_int()
+            stall = ctypes.c_int64()
+            rc = self._lib.brt_stream_writev(
+                self._id, arr, len(handles), ctypes.byref(nw),
+                ctypes.byref(stall))
+        finally:
+            for io in temps:
+                io.close()
+        if obs.enabled():
+            obs.counter("stream_writes").add(nw.value)
+            obs.counter("stream_bytes_out").add(total)
+            if stall.value > self._STALL_FLOOR_US:
+                obs.counter("stream_stall_ms").add(stall.value / 1000.0)
+        if rc != 0:
+            e = RpcError(rc, f"stream writev to {self.peer} failed at "
+                             f"frame {nw.value}/{len(handles)}")
+            e.frames_written = nw.value
+            raise e
+        return nw.value
 
     def close(self) -> None:
         """Graceful close: flushes in-flight frames, then tells the peer.
@@ -1348,6 +1741,28 @@ class Channel:
             fault.client_intercept(service, method, self._addr)
         if _race.enabled():
             _race.note_blocking("brt_channel_call")
+        if isinstance(request, IOBuf):
+            # Zero-copy currency: the request's blocks are shared into
+            # the native call (no payload copy; the caller's handle keeps
+            # its contents for retries) and the reply comes back as an
+            # IOBuf whose blocks were swapped out of the response.
+            err = ctypes.c_int()
+            errbuf = ctypes.create_string_buffer(256)
+            h = self._lib.brt_channel_call_iobuf(
+                self._ptr, service.encode(), method.encode(),
+                request._require(), ctypes.byref(err), errbuf, 256)
+            if not h:
+                text = errbuf.value.decode(errors="replace")
+                if rec:
+                    _record_client_call(service, method, self._addr, t0,
+                                        wall, len(request), 0, err.value,
+                                        text)
+                raise RpcError(err.value or -1, text)
+            out = IOBuf._adopt(self._lib, h)
+            if rec:
+                _record_client_call(service, method, self._addr, t0, wall,
+                                    len(request), len(out), 0, "")
+            return out
         rsp = ctypes.c_void_p()
         rsp_len = ctypes.c_size_t()
         errbuf = ctypes.create_string_buffer(256)
@@ -1368,6 +1783,7 @@ class Channel:
         if rec:
             _record_client_call(service, method, self._addr, t0, wall,
                                 len(request), len(out), 0, "")
+            obs.counter("rpc_bytes_copied").add(len(out))
         return out
 
     def call_async(self, service: str, method: str, request: bytes = b"",
@@ -1388,6 +1804,15 @@ class Channel:
         wall = time.time() if rec else 0.0
         if fault.active():
             fault.client_intercept(service, method, self._addr, timeout_ms)
+        if isinstance(request, IOBuf):
+            ptr = self._lib.brt_channel_call_start_iobuf(
+                self._ptr, service.encode(), method.encode(),
+                request._require(),
+                _INT64_MIN if timeout_ms is None else int(timeout_ms))
+            if not ptr:
+                raise RpcError(-1, f"call_start failed for {self._addr}")
+            return PendingCall(self._lib, ptr, service, method, self._addr,
+                               len(request), t0, wall, tag, iobuf=True)
         ptr = self._lib.brt_channel_call_start_opts(
             self._ptr, service.encode(), method.encode(),
             _req_ptr(request), len(request),

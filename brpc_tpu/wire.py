@@ -418,7 +418,8 @@ schema(
     "lookup_req",
     Int("count", "<i"), Array("ids", "<i4", "count"),
     doc="Lookup request: int32 count ++ int32 ids (absolute)",
-    pack_sites=("ps_remote._pack_lookup_req",),
+    pack_sites=("ps_remote._pack_lookup_req",
+                "ps_remote._pack_lookup_req_iobuf"),
     unpack_sites=("ps_remote.PsShardServer._serve",
                   "ps_remote.DevicePsShardServer._serve"),
     exact_sites=("ps_remote._pack_lookup_req",),
@@ -433,7 +434,8 @@ schema(
     Int("count", "<i"), Array("ids", "<i4", "count"),
     Array("grads", "<f4", "count", mult="dim"),
     doc="ApplyGrad framing: count ++ ids ++ float32 grads [count, dim]",
-    pack_sites=("ps_remote._pack_apply_req",),
+    pack_sites=("ps_remote._pack_apply_req",
+                "ps_remote._pack_apply_req_iobuf"),
     unpack_sites=("ps_remote._unpack_apply",),
     exact_sites=("ps_remote._pack_apply_req", "ps_remote._unpack_apply"))
 
@@ -441,7 +443,8 @@ schema(
     "stream_frame",
     Int("seq"), Int("epoch"), Int("gen"), Tail("body"),
     doc="stream frame header (seq, epoch, gen int64) + framed body",
-    pack_sites=("ps_remote._pack_stream_frame",),
+    pack_sites=("ps_remote._pack_stream_frame",
+                "ps_remote._pack_stream_frame_iobuf"),
     unpack_sites=("ps_remote._ApplyStreamReceiver.on_data",
                   "ps_remote._ReplicaStreamReceiver.on_data",
                   "ps_remote._MigrateStreamReceiver.on_data"),
@@ -581,7 +584,8 @@ schema(
         "request body — servers shed work whose budget is already "
         "exhausted (EDEADLINE 2014) before touching the table; the "
         "native Lookup handler peels the same header",
-    pack_sites=("ps_remote._pack_deadline",),
+    pack_sites=("ps_remote._pack_deadline",
+                "ps_remote._pack_deadline_iobuf"),
     unpack_sites=("ps_remote._unpack_deadline",),
     exact_sites=("ps_remote._pack_deadline",
                  "ps_remote._unpack_deadline"),
@@ -596,7 +600,8 @@ schema(
         "budget, so no same-host/NTP wall-clock agreement is assumed; "
         "the shared _unpack_deadline dispatches on the magic and the "
         "native Lookup handler peels both forms",
-    pack_sites=("ps_remote._pack_deadline_rel",),
+    pack_sites=("ps_remote._pack_deadline_rel",
+                "ps_remote._pack_deadline_rel_iobuf"),
     unpack_sites=("ps_remote._unpack_deadline",),
     exact_sites=("ps_remote._pack_deadline_rel",),
     native_sites=("cpp/capi/ps_shard.cc:CPsService::ServeLookup",))

@@ -169,6 +169,24 @@ void brt_session_respond(void* session, const void* data, size_t len,
   done();
 }
 
+void brt_session_respond_iobuf(void* session, const void* iobuf,
+                               int error_code, const char* error_text) {
+  auto* sess = static_cast<CSession*>(session);
+  auto* io = static_cast<const brt_capi::CIobuf*>(iobuf);
+  if (error_code != 0) {
+    sess->cntl->SetFailed(error_code, "%s",
+                          error_text ? error_text : "handler error");
+  } else if (io != nullptr && !io->buf.empty()) {
+    // Shares the iobuf's blocks into the response — no payload copy; a
+    // borrowed (user-data) block stays pinned until the socket write
+    // drops the last ref.
+    sess->response->append(io->buf);
+  }
+  Closure done = std::move(sess->done);
+  delete sess;
+  done();
+}
+
 void* brt_channel_new(const char* addr, const char* lb, int64_t timeout_ms,
                       int max_retry) {
   brt::fiber_init(0);
@@ -225,6 +243,37 @@ void brt_channel_destroy(void* channel) {
   brt_capi::handle_dec(HandleKind::kChannel);
 }
 
+void* brt_channel_call_iobuf(void* channel, const char* service,
+                             const char* method, const void* req_iobuf,
+                             int* error_code, char* errbuf,
+                             size_t errbuf_len) {
+  auto* c = static_cast<CChannel*>(channel);
+  Controller cntl;
+  IOBuf request, response;
+  if (req_iobuf != nullptr) {
+    // Shares the request blocks (refcount bump): borrowed numpy-backed
+    // blocks go to the socket without a copy and stay pinned until the
+    // write drains.
+    request.append(static_cast<const brt_capi::CIobuf*>(req_iobuf)->buf);
+  }
+  c->channel->CallMethod(service, method, &cntl, request, &response,
+                         nullptr);
+  if (cntl.Failed()) {
+    if (errbuf && errbuf_len) {
+      snprintf(errbuf, errbuf_len, "%s", cntl.ErrorText().c_str());
+    }
+    if (error_code != nullptr) {
+      *error_code = cntl.ErrorCode() ? cntl.ErrorCode() : -1;
+    }
+    return nullptr;
+  }
+  if (error_code != nullptr) *error_code = 0;
+  auto* out = new brt_capi::CIobuf;
+  out->buf.swap(response);  // steal the wire blocks, no copy
+  brt_capi::handle_inc(HandleKind::kIobuf);
+  return out;
+}
+
 void* brt_channel_call_start(void* channel, const char* service,
                              const char* method, const void* req,
                              size_t req_len) {
@@ -246,6 +295,32 @@ void* brt_channel_call_start_opts(void* channel, const char* service,
   // before CallMethod returns).  Group notification happens AFTER the
   // completion latch is signaled, so a waiter woken by the group always
   // observes brt_call_wait(call, 0) == 0 for the finished call.
+  CCall* raw = call;
+  c->channel->CallMethod(service, method, &call->cntl, request,
+                         &call->response, [raw] {
+                           raw->group_mu.lock();
+                           raw->completed = true;
+                           std::vector<CCallGroup*> gs;
+                           gs.swap(raw->groups);
+                           raw->group_mu.unlock();
+                           raw->done.signal();  // last touch of raw
+                           for (CCallGroup* g : gs) group_notify(g);
+                         });
+  return call;
+}
+
+void* brt_channel_call_start_iobuf(void* channel, const char* service,
+                                   const char* method,
+                                   const void* req_iobuf,
+                                   int64_t timeout_ms) {
+  auto* c = static_cast<CChannel*>(channel);
+  auto* call = new CCall;
+  brt_capi::handle_inc(HandleKind::kCall);
+  call->cntl.timeout_ms = timeout_ms;  // INT64_MIN inherits the channel
+  IOBuf request;
+  if (req_iobuf != nullptr) {
+    request.append(static_cast<const brt_capi::CIobuf*>(req_iobuf)->buf);
+  }
   CCall* raw = call;
   c->channel->CallMethod(service, method, &call->cntl, request,
                          &call->response, [raw] {
@@ -368,6 +443,26 @@ int brt_call_join(void* call, void** rsp, size_t* rsp_len, char* errbuf,
   *rsp = buf;
   *rsp_len = n;
   return 0;
+}
+
+void* brt_call_join_iobuf(void* call, int* error_code, char* errbuf,
+                          size_t errbuf_len) {
+  auto* c = static_cast<CCall*>(call);
+  c->done.wait();
+  if (c->cntl.Failed()) {
+    if (errbuf && errbuf_len) {
+      snprintf(errbuf, errbuf_len, "%s", c->cntl.ErrorText().c_str());
+    }
+    if (error_code != nullptr) {
+      *error_code = c->cntl.ErrorCode() ? c->cntl.ErrorCode() : -1;
+    }
+    return nullptr;
+  }
+  if (error_code != nullptr) *error_code = 0;
+  auto* out = new brt_capi::CIobuf;
+  out->buf.swap(c->response);  // steal the wire blocks, no copy
+  brt_capi::handle_inc(HandleKind::kIobuf);
+  return out;
 }
 
 void brt_call_destroy(void* call) {

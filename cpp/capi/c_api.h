@@ -209,6 +209,90 @@ int brt_stream_join(uint64_t stream_id, int64_t timeout_us);
 // client streams are always safe).  Idempotent; 0 always.
 int brt_stream_abort(uint64_t stream_id);
 
+// ---- zero-copy buffer currency (brt_iobuf; capi/iobuf_capi.cc) ----
+// An ABI handle over the native IOBuf (cpp/base/iobuf.h): a refcounted
+// chain of block references.  Appends either COPY into pooled 8KB blocks
+// (brt_iobuf_append/appendv — small headers) or BORROW caller memory
+// zero-copy (brt_iobuf_append_user_data — the numpy-grads path); borrowed
+// blocks hold the caller's buffer via `release(data, arg)`, which fires
+// on the LAST block-ref drop, possibly after the handle itself was
+// destroyed (the payload may still sit in a socket write queue or a
+// response the peer side borrowed).  Handles are tracked in the handle
+// ledger under kind "iobuf"; every constructor below pairs with
+// brt_iobuf_destroy.
+typedef void (*brt_iobuf_release)(void* data, void* arg);
+
+void* brt_iobuf_new(void);
+void brt_iobuf_destroy(void* iobuf);
+// Copying append (one pooled-block copy).  Returns 0, EINVAL on NULL.
+int brt_iobuf_append(void* iobuf, const void* data, size_t len);
+// Copying append of n buffers in order — one ABI crossing for a
+// header+payload pair.  Returns 0, EINVAL on NULL input.
+int brt_iobuf_appendv(void* iobuf, const void* const* datas,
+                      const size_t* lens, int n);
+// Zero-copy append of caller-owned memory: the block borrows `data`
+// until the last ref drops, then calls `release(data, arg)` exactly
+// once.  The caller must keep `data` valid and UNCHANGED until release
+// (a mutated borrowed block would change bytes already "sent").
+int brt_iobuf_append_user_data(void* iobuf, void* data, size_t len,
+                               brt_iobuf_release release, void* arg);
+// Shares src's blocks into dst (refcount bump, no payload copy) — the
+// prepend-a-header composition: build a small header iobuf, then share
+// the big body in behind it.
+int brt_iobuf_append_iobuf(void* iobuf, const void* src);
+int64_t brt_iobuf_size(const void* iobuf);
+// Copies up to `max` bytes starting at `from` into `out`; returns the
+// byte count copied (the ONE copy the borrow path still pays when a
+// multi-block response must be materialized contiguously).
+int64_t brt_iobuf_copy_out(const void* iobuf, void* out, size_t max,
+                           size_t from);
+// Borrowed block list: count, then per-block data pointer/length.  The
+// pointers are valid while the handle lives — the Python side wraps a
+// single-block response in a memoryview without copying and pins the
+// handle for the view's lifetime.
+int brt_iobuf_block_count(const void* iobuf);
+const void* brt_iobuf_block_data(const void* iobuf, int i);
+int64_t brt_iobuf_block_len(const void* iobuf, int i);
+
+// Synchronous call whose request rides an iobuf (borrowed request blocks
+// are NOT copied before the socket write) and whose response comes back
+// as a NEW iobuf handle holding the wire blocks (no malloc+copy_to).
+// Returns the handle on success; on failure returns NULL with
+// *error_code/errbuf filled.  Destroy the returned handle with
+// brt_iobuf_destroy.
+void* brt_channel_call_iobuf(void* channel, const char* service,
+                             const char* method, const void* req_iobuf,
+                             int* error_code, char* errbuf,
+                             size_t errbuf_len);
+// Async variant: like brt_channel_call_start_opts but the request rides
+// an iobuf (blocks shared, not copied — keep borrowed request memory
+// alive until the call completes).  Join with brt_call_join_iobuf (or
+// the copying brt_call_join); destroy with brt_call_destroy as usual.
+void* brt_channel_call_start_iobuf(void* channel, const char* service,
+                                   const char* method,
+                                   const void* req_iobuf,
+                                   int64_t timeout_ms);
+// Joins the call and MOVES its response into a new iobuf handle (block
+// steal, no copy).  Join at most once per call handle (a second join of
+// either flavor sees an empty response); brt_call_destroy remains the
+// caller's responsibility.  Returns the handle, or NULL with
+// *error_code/errbuf filled on RPC failure.
+void* brt_call_join_iobuf(void* call, int* error_code, char* errbuf,
+                          size_t errbuf_len);
+// Responds with the iobuf's blocks shared into the RPC response (no
+// payload copy; borrowed blocks stay pinned until the socket write
+// drains).  The iobuf handle is NOT consumed — destroy it after.
+void brt_session_respond_iobuf(void* session, const void* iobuf,
+                               int error_code, const char* error_text);
+// Batched ordered writes: each iobuf is ONE framed stream message,
+// written in order with a single ABI crossing for the batch.  Stops at
+// the first failing write: returns its error code with *nwritten the
+// count of fully written frames (0 on success ⇒ *nwritten == n).
+// *stall_us (may be NULL) accumulates backpressure time across the
+// batch.  Same single-writer rule as brt_stream_write.
+int brt_stream_writev(uint64_t stream_id, const void* const* iobufs,
+                      int n, int* nwritten, int64_t* stall_us);
+
 // ---- pre-dispatch request drop (fault-injection tier) ----
 // Process-global hook consulted for EVERY parsed request before
 // dispatch/accounting; returning nonzero silently discards the request

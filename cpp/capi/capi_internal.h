@@ -41,6 +41,13 @@ struct CChannel {
   std::unique_ptr<brt::ChannelBase> channel;
 };
 
+// An ABI-visible IOBuf handle (capi/iobuf_capi.cc owns the container
+// functions; c_api.cc's call/respond variants move block refs in and out
+// of it without copying payload bytes).
+struct CIobuf {
+  brt::IOBuf buf;
+};
+
 // ---- native handle ledger (capi/handle_ledger.cc) ----
 // Ground-truth live-object counts per ABI handle type, bumped at every
 // brt_*_new/_destroy pair across the capi TUs and reported through
@@ -57,6 +64,7 @@ enum class HandleKind : int {
   kStreamRelay,
   kDeviceClient,
   kDeviceExecutable,
+  kIobuf,
   kNumKinds,
 };
 

@@ -31,6 +31,7 @@ const char* const kKindNames[kNumKinds] = {
     "server",        "channel",       "call",
     "call_group",    "ps_shard",      "event",
     "stream_relay",  "device_client", "device_executable",
+    "iobuf",
 };
 
 std::atomic<long> g_counts[kNumKinds];

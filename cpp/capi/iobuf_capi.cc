@@ -1,0 +1,133 @@
+// Zero-copy buffer currency C ABI (brt_iobuf_*) + the batched stream
+// write (brt_stream_writev).
+//
+// The native substrate is base/iobuf.{h,cc}: a refcounted chain of block
+// references where append(const IOBuf&) shares blocks and
+// append_user_data borrows caller memory until the last ref drops.  This
+// TU flattens that for language bindings so the Python rim can build
+// requests as [small owned header block ++ borrowed numpy block] and
+// read responses as a borrowed block list — the copy taxes this replaces
+// (request append, malloc+copy_to response, per-frame stream copies) are
+// what BENCH_zerocopy.json measures.
+//
+// The call/respond/join variants that need CChannel/CCall internals live
+// in c_api.cc; everything here touches only the shared CIobuf container
+// (capi_internal.h) and the public stream surface (rpc/stream.h).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include "base/time.h"
+#include "capi/c_api.h"
+#include "capi/capi_internal.h"
+#include "rpc/stream.h"
+
+using brt_capi::CIobuf;
+using brt_capi::HandleKind;
+
+extern "C" {
+
+void* brt_iobuf_new(void) {
+  brt_capi::handle_inc(HandleKind::kIobuf);
+  return new CIobuf;
+}
+
+void brt_iobuf_destroy(void* iobuf) {
+  if (iobuf == nullptr) return;
+  delete static_cast<CIobuf*>(iobuf);
+  brt_capi::handle_dec(HandleKind::kIobuf);
+}
+
+int brt_iobuf_append(void* iobuf, const void* data, size_t len) {
+  if (iobuf == nullptr || (data == nullptr && len > 0)) return EINVAL;
+  if (len > 0) static_cast<CIobuf*>(iobuf)->buf.append(data, len);
+  return 0;
+}
+
+int brt_iobuf_appendv(void* iobuf, const void* const* datas,
+                      const size_t* lens, int n) {
+  if (iobuf == nullptr || n < 0 ||
+      (n > 0 && (datas == nullptr || lens == nullptr))) {
+    return EINVAL;
+  }
+  auto* io = static_cast<CIobuf*>(iobuf);
+  for (int i = 0; i < n; ++i) {
+    if (datas[i] == nullptr && lens[i] > 0) return EINVAL;
+    if (lens[i] > 0) io->buf.append(datas[i], lens[i]);
+  }
+  return 0;
+}
+
+int brt_iobuf_append_user_data(void* iobuf, void* data, size_t len,
+                               brt_iobuf_release release, void* arg) {
+  if (iobuf == nullptr || data == nullptr || len == 0 ||
+      release == nullptr) {
+    return EINVAL;
+  }
+  static_cast<CIobuf*>(iobuf)->buf.append_user_data(data, len, release,
+                                                    arg);
+  return 0;
+}
+
+int brt_iobuf_append_iobuf(void* iobuf, const void* src) {
+  if (iobuf == nullptr || src == nullptr) return EINVAL;
+  static_cast<CIobuf*>(iobuf)->buf.append(
+      static_cast<const CIobuf*>(src)->buf);
+  return 0;
+}
+
+int64_t brt_iobuf_size(const void* iobuf) {
+  if (iobuf == nullptr) return -1;
+  return static_cast<int64_t>(static_cast<const CIobuf*>(iobuf)->buf.size());
+}
+
+int64_t brt_iobuf_copy_out(const void* iobuf, void* out, size_t max,
+                           size_t from) {
+  if (iobuf == nullptr || (out == nullptr && max > 0)) return -1;
+  return static_cast<int64_t>(
+      static_cast<const CIobuf*>(iobuf)->buf.copy_to(out, max, from));
+}
+
+int brt_iobuf_block_count(const void* iobuf) {
+  if (iobuf == nullptr) return -1;
+  return static_cast<const CIobuf*>(iobuf)->buf.block_count();
+}
+
+const void* brt_iobuf_block_data(const void* iobuf, int i) {
+  if (iobuf == nullptr) return nullptr;
+  const auto& buf = static_cast<const CIobuf*>(iobuf)->buf;
+  if (i < 0 || i >= buf.block_count()) return nullptr;
+  return buf.ref_data(i);
+}
+
+int64_t brt_iobuf_block_len(const void* iobuf, int i) {
+  if (iobuf == nullptr) return -1;
+  const auto& buf = static_cast<const CIobuf*>(iobuf)->buf;
+  if (i < 0 || i >= buf.block_count()) return -1;
+  return static_cast<int64_t>(buf.ref_at(i).length);
+}
+
+int brt_stream_writev(uint64_t stream_id, const void* const* iobufs,
+                      int n, int* nwritten, int64_t* stall_us) {
+  if (nwritten != nullptr) *nwritten = 0;
+  if (stall_us != nullptr) *stall_us = 0;
+  if (n < 0 || (n > 0 && iobufs == nullptr)) return EINVAL;
+  for (int i = 0; i < n; ++i) {
+    if (iobufs[i] == nullptr) return EINVAL;
+    // StreamWrite cuts the message into the socket queue, so hand it a
+    // block-sharing copy: the caller's handle keeps its contents (a
+    // failed batch can be retried frame by frame) and borrowed blocks
+    // stay pinned until the socket write drains their last ref.
+    brt::IOBuf message(static_cast<const CIobuf*>(iobufs[i])->buf);
+    const int64_t t0 = brt::monotonic_us();
+    const int rc =
+        brt::StreamWrite(static_cast<brt::StreamId>(stream_id), &message);
+    if (stall_us != nullptr) *stall_us += brt::monotonic_us() - t0;
+    if (rc != 0) return rc;
+    if (nwritten != nullptr) *nwritten = i + 1;
+  }
+  return 0;
+}
+
+}  // extern "C"

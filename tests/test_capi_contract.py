@@ -85,6 +85,9 @@ CAPI_DIR = os.path.join(ROOT, "cpp", "capi")
 _IRREGULAR_PAIRS = {
     "brt_channel_call_start_opts": "brt_call_destroy",
     "brt_device_compile": "brt_device_executable_destroy",
+    "brt_channel_call_iobuf": "brt_iobuf_destroy",
+    "brt_call_join_iobuf": "brt_iobuf_destroy",
+    "brt_channel_call_start_iobuf": "brt_call_destroy",
 }
 
 

@@ -519,3 +519,32 @@ def test_hydrate_destination_split_ships_tail(tmp_path):
         for sv in dst:
             sv.close()
         store.close()
+
+
+def test_append_delta_epoch_mismatch_rebases(tmp_path):
+    """An epoch bump WITHOUT a wholesale install (a promotion: the
+    generation chain continues, only the epoch moves) must re-base —
+    restoring the old base would resurrect the stale epoch and
+    un-fence retired writers.  ``append_delta(..., epoch=)`` refuses
+    the mismatched record; the caller snapshots under the new epoch
+    and the chain resumes."""
+    st = CheckpointStore(str(tmp_path))
+    base = _table(3)
+    st.save_snapshot(7, 0, base, {})
+    body1, _, _ = _body([1], 1)
+    assert st.append_delta(1, body1, epoch=7)
+    body2, _, _ = _body([2], 2)
+    # promotion bumped the epoch; gen 2 IS the next chain link, yet
+    # the record must be refused — the base was written under epoch 7
+    assert not st.append_delta(2, body2, epoch=8)
+    # the caller's response: fold the current table into a new base
+    st.save_snapshot(8, 2, base, {})
+    body3, _, _ = _body([3], 3)
+    assert st.append_delta(3, body3, epoch=8)
+    # epoch-blind callers (legacy) keep appending on the chain
+    body4, _, _ = _body([4], 4)
+    assert st.append_delta(4, body4)
+    point = st.restore()
+    assert point is not None
+    assert point.epoch == 8 and point.base_gen == 2 and point.gen == 4
+    st.close()

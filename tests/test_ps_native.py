@@ -48,6 +48,9 @@ def test_native_lookup_parity_with_python_serve():
             req = _lookup_req(ids)
             native = ch.call("Ps", "Lookup", req)
             python = server._serve("Lookup", req)
+            if isinstance(python, rpc.IOBuf):   # zero-copy return
+                with python:
+                    python = python.tobytes()
             assert native == python  # byte-for-byte
         assert server.native_lookups == len(batches)
     finally:

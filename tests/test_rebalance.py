@@ -376,3 +376,88 @@ def test_rebalancer_splits_on_sustained_load_end_to_end():
         for s in old + spawned:
             s.close()
         reg_server.close()
+
+
+@pytest.mark.needs_native
+def test_rebalancer_split_auto_hydrates_from_checkpoint_stores(tmp_path):
+    """A policy-decided split on sources with attached checkpoint
+    stores seeds every destination from the on-disk base BEFORE the
+    copy phase: ps_rebalance_hydrations counts the seeded
+    destinations and no source ships a wholesale range snapshot
+    (ps_migrate_syncs_out stays flat)."""
+    from brpc_tpu import rpc
+    from brpc_tpu.durable import CheckpointStore
+    from brpc_tpu.naming import (NamingClient, PartitionScheme,
+                                 ReplicaSet, publish_scheme)
+    from brpc_tpu.ps_remote import PsShardServer, RemoteEmbedding
+    reg_server, reg_addr = _registry(rpc)
+    old = [PsShardServer(VOCAB, DIM, s, 2, lr=1.0, stream=True)
+           for s in range(2)]
+    stores = {s: CheckpointStore(str(tmp_path / f"shard{s}"))
+              for s in range(2)}
+    for s, srv in enumerate(old):
+        srv.attach_checkpoint(stores[s])   # arms the tee + first base
+    sc1 = PartitionScheme(1, tuple(ReplicaSet.of(s.address)
+                                   for s in old))
+    nc = NamingClient(reg_addr)
+    publish_scheme(nc, "ps", sc1)
+    spawned = []
+
+    def provisioner(version, num_shards):
+        servers = [PsShardServer(VOCAB, DIM, s, num_shards, lr=1.0,
+                                 stream=True, importing=True,
+                                 scheme_version=version)
+                   for s in range(num_shards)]
+        spawned.extend(servers)
+        return PartitionScheme(version, tuple(
+            ReplicaSet.of(s.address) for s in servers))
+
+    pol = RebalancePolicy(RebalanceOptions(
+        split_qps=30.0, merge_qps=1.0, sustain_s=0.2,
+        min_interval_s=0.5))
+    reb = Rebalancer(reg_addr, "ps", VOCAB, policy=pol,
+                     provisioner=provisioner,
+                     migrate_deadline_s=30.0, drain_deadline_s=8.0,
+                     checkpoint_stores=stores)
+    emb = RemoteEmbedding.from_registry(reg_addr, "ps", VOCAB, DIM,
+                                        timeout_ms=10000, watch=True)
+    ids = np.arange(VOCAB, dtype=np.int32)
+    before = np.concatenate([s.table.copy() for s in old])
+    hyd0 = int(obs.counter("ps_rebalance_hydrations").get_value())
+    errs0 = int(obs.counter("ps_rebalance_hydrate_errors").get_value())
+    syncs0 = int(obs.counter("ps_migrate_syncs_out").get_value())
+    try:
+        emb.apply_gradients(ids, np.full((VOCAB, DIM), 0.5,
+                                         np.float32))
+        decided = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and decided is None:
+            for _ in range(10):
+                emb.lookup(ids[:64])
+            decided = reb.step()
+        assert decided is not None and decided.kind == "split"
+        assert decided.num_shards == 4
+        # 2 sources x 2 overlapping destinations each, all seeded from
+        # disk, none via a live wholesale range snapshot
+        assert int(obs.counter(
+            "ps_rebalance_hydrations").get_value()) == hyd0 + 4
+        assert int(obs.counter(
+            "ps_rebalance_hydrate_errors").get_value()) == errs0
+        assert int(obs.counter(
+            "ps_migrate_syncs_out").get_value()) == syncs0
+        emb.apply_gradients(ids, np.full((VOCAB, DIM), 0.25,
+                                         np.float32))
+        expect = before.copy()
+        for d in (0.5, 0.25):
+            expect[ids] -= np.float32(d)
+        assert np.array_equal(
+            np.concatenate([s.table for s in spawned]), expect)
+    finally:
+        reb.stop()
+        emb.close()
+        nc.close()
+        for s in old + spawned:
+            s.close()
+        for st in stores.values():
+            st.close()
+        reg_server.close()

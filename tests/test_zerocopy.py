@@ -1,0 +1,346 @@
+"""Zero-copy buffer currency (brt_iobuf): the borrow-not-copy contract.
+
+Covers the capi family end to end from Python: building chains from
+owned headers + borrowed (pinned) payloads, the exact pin/handle
+ledgers (Python analysis ledger vs the native ground-truth counts),
+the borrow-lifetime rule (a view exported from a chain stays valid
+after ``close()`` — destruction defers to the last view's death), the
+call/respond iobuf variants riding a real server, batched
+``Stream.writev``, and runtime byte-parity of every refactored iobuf
+packer against its wire schema (the dynamic twin of the wire-contract
+lint, proving the borrow path changes NOTHING on the wire)."""
+
+import gc
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from brpc_tpu import obs, rpc, wire
+from brpc_tpu.analysis import fuzz, handles
+from brpc_tpu.ps_remote import (_pack_apply_req, _pack_apply_req_iobuf,
+                                _pack_deadline, _pack_deadline_iobuf,
+                                _pack_deadline_rel,
+                                _pack_deadline_rel_iobuf,
+                                _pack_lookup_req, _pack_lookup_req_iobuf,
+                                _pack_stream_frame,
+                                _pack_stream_frame_iobuf)
+
+pytestmark = pytest.mark.needs_native
+
+
+@pytest.fixture(autouse=True)
+def _obs_on():
+    was = obs.enabled()
+    obs.set_enabled(True)
+    yield
+    obs.set_enabled(was)
+
+
+def _native_iobufs() -> int:
+    return rpc.debug_handle_counts().get("iobuf", 0)
+
+
+def _settle(baseline_fn, want, deadline_s=5.0):
+    """Finalizers and native release callbacks may run a beat late."""
+    deadline = time.time() + deadline_s
+    while baseline_fn() != want and time.time() < deadline:
+        gc.collect()
+        time.sleep(0.01)
+    return baseline_fn()
+
+
+# ---------------------------------------------------------------------------
+# chain building + ledgers
+# ---------------------------------------------------------------------------
+
+def test_iobuf_build_owned_and_borrowed_roundtrip():
+    header = b"\x01\x02\x03\x04"
+    payload = np.arange(64, dtype=np.int32)
+    io = rpc.IOBuf()
+    io.append(header)                 # owned copy (framing header)
+    io.append_pinned(payload)         # borrowed, no copy
+    assert len(io) == len(header) + payload.nbytes
+    assert io.block_count >= 2
+    assert io.tobytes() == header + payload.tobytes()
+
+    # block-sharing append: no payload copy, same bytes
+    outer = rpc.IOBuf(b"hdr2")
+    outer.append_iobuf(io)
+    assert outer.tobytes() == b"hdr2" + header + payload.tobytes()
+    io.close()
+    # outer's shared blocks survive the inner handle's death
+    assert outer.tobytes() == b"hdr2" + header + payload.tobytes()
+    outer.close()
+    with pytest.raises(RuntimeError):
+        io.append(b"closed")
+
+
+def test_iobuf_ledger_python_native_parity():
+    gc.collect()
+    py0 = handles.live_counts().get("iobuf", 0)
+    nat0 = _native_iobufs()
+    ios = [rpc.IOBuf(b"x" * (i + 1)) for i in range(5)]
+    assert handles.live_counts().get("iobuf", 0) == py0 + 5
+    assert _native_iobufs() == nat0 + 5
+    # the two ledgers must agree while live and after release
+    assert (handles.live_counts().get("iobuf", 0) - py0
+            == _native_iobufs() - nat0)
+    for io in ios:
+        io.close()
+    assert handles.live_counts().get("iobuf", 0) == py0
+    assert _settle(_native_iobufs, nat0) == nat0
+
+
+def test_pinned_buffer_released_with_handle():
+    pins0 = rpc.debug_iobuf_pins()
+    arr = np.full(1024, 7, np.int64)
+    io = rpc.IOBuf()
+    io.append_pinned(arr)
+    assert rpc.debug_iobuf_pins() == pins0 + 1
+    # the pin is the keepalive: the chain reads the live buffer
+    assert io.tobytes() == arr.tobytes()
+    io.close()
+    assert _settle(rpc.debug_iobuf_pins, pins0) == pins0
+
+
+# ---------------------------------------------------------------------------
+# borrow lifetime: views never dangle
+# ---------------------------------------------------------------------------
+
+def test_view_outlives_close():
+    gc.collect()
+    nat0 = _native_iobufs()
+    io = rpc.IOBuf(b"borrow-me")      # single block: zero-copy view
+    view = io.as_memoryview()
+    io.close()
+    # the live view defers the handle's destruction...
+    assert _native_iobufs() == nat0 + 1
+    # ...and still reads valid native memory
+    assert bytes(view) == b"borrow-me"
+    del view
+    assert _settle(_native_iobufs, nat0) == nat0
+
+
+def test_response_view_outlives_the_call():
+    srv = rpc.Server()
+    srv.add_service("Echo", lambda m, req: req)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000)
+    try:
+        req = rpc.IOBuf(b"tiny-response")
+        rsp = ch.call("Echo", "Echo", req)
+        req.close()
+        assert isinstance(rsp, rpc.IOBuf)
+        view = rsp.as_memoryview()
+        rsp.close()                   # view keeps the blocks pinned
+        assert bytes(view) == b"tiny-response"
+        del view
+    finally:
+        ch.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# call/respond iobuf variants against a live server
+# ---------------------------------------------------------------------------
+
+def test_echo_call_iobuf_roundtrip_and_copy_ledger():
+    payload = np.random.default_rng(0).bytes(32 * 1024)
+    srv = rpc.Server()
+
+    def echo(method, request):
+        rsp = rpc.IOBuf()
+        rsp.append_pinned(request)    # respond shares, never copies
+        return rsp
+    srv.add_service("Echo", echo)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000)
+    gc.collect()
+    nat0 = _native_iobufs()
+    pins0 = rpc.debug_iobuf_pins()
+    try:
+        c0 = int(obs.counter("rpc_bytes_copied").get_value())
+        req = rpc.IOBuf()
+        req.append_pinned(payload)
+        rsp = ch.call("Echo", "Echo", req)
+        try:
+            assert rsp.tobytes() == payload
+        finally:
+            rsp.close()
+            req.close()
+        copied = int(obs.counter("rpc_bytes_copied").get_value()) - c0
+        # the only counted copies: the server trampoline materializing
+        # the request for the Python handler, and our own tobytes()
+        # verification readback — the transport itself borrowed
+        assert copied == 2 * len(payload)
+    finally:
+        ch.close()
+        srv.close()
+    assert _settle(_native_iobufs, nat0) == nat0
+    assert _settle(rpc.debug_iobuf_pins, pins0) == pins0
+
+
+def test_call_async_join_iobuf():
+    srv = rpc.Server()
+    srv.add_service("Echo", lambda m, req: req)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000)
+    try:
+        reqs = [rpc.IOBuf(struct.pack("<q", i)) for i in range(4)]
+        pending = [ch.call_async("Echo", "Echo", r) for r in reqs]
+        for i, p in enumerate(pending):
+            rsp = p.join()
+            assert isinstance(rsp, rpc.IOBuf)
+            with rsp:
+                assert rsp.tobytes() == struct.pack("<q", i)
+        for r in reqs:
+            r.close()
+    finally:
+        ch.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# batched stream writes
+# ---------------------------------------------------------------------------
+
+def test_stream_writev_frames_arrive_intact_and_ordered():
+    frames_in = []
+    closed = []
+
+    class Sink:
+        def on_data(self, data):
+            frames_in.append(bytes(data))
+
+        def on_closed(self):
+            closed.append(True)
+
+    srv = rpc.Server()
+
+    def h(method, request, accept):
+        accept(Sink())
+        return b"ok"
+    srv.add_stream_handler("Push", h)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000)
+    gc.collect()
+    nat0 = _native_iobufs()
+    pins0 = rpc.debug_iobuf_pins()
+    try:
+        st = ch.stream("Push", "Open")
+        body = np.arange(256, dtype=np.float32)
+        expect = []
+        batch = []
+        for seq in range(3):
+            io = _pack_stream_frame_iobuf(seq, 0, 0, body.tobytes())
+            batch.append(io)
+            expect.append(bytes(_pack_stream_frame(seq, 0, 0,
+                                                   body.tobytes())))
+        batch.append(b"raw-bytes-frame")   # mixed batch: bytes get pinned
+        expect.append(b"raw-bytes-frame")
+        assert st.writev(batch[:2]) == 2
+        assert st.writev(batch[2:]) == 2
+        for io in batch[:3]:
+            io.close()
+        st.close()
+        deadline = time.time() + 5
+        while not closed and time.time() < deadline:
+            time.sleep(0.01)
+        assert closed, "stream close handshake never completed"
+        assert frames_in == expect
+    finally:
+        ch.close()
+        srv.close()
+    assert _settle(_native_iobufs, nat0) == nat0
+    assert _settle(rpc.debug_iobuf_pins, pins0) == pins0
+
+
+# ---------------------------------------------------------------------------
+# wire parity: the borrow path changes NOTHING on the wire
+# ---------------------------------------------------------------------------
+
+def _materialized(pack_fn):
+    """parity_fuzz adapter: run an iobuf builder, hand back its bytes."""
+    def packer(values):
+        io = pack_fn(values)
+        try:
+            return io.tobytes()
+        finally:
+            io.close()
+    return packer
+
+
+def test_parity_fuzz_lookup_req_iobuf():
+    sch = wire.REGISTRY["lookup_req"]
+    failures = fuzz.parity_fuzz(
+        sch,
+        _materialized(lambda v: _pack_lookup_req_iobuf(
+            np.asarray(v["ids"], np.int32))),
+        lambda p: np.frombuffer(
+            p, np.int32, struct.unpack_from("<i", p, 0)[0], 4),
+        seed=11, iters=30)
+    assert failures == [], [f.detail for f in failures]
+    # and the iobuf framing is byte-identical to the bytearray packer
+    ids = np.arange(17, dtype=np.int32)
+    io = _pack_lookup_req_iobuf(ids)
+    with io:
+        assert io.tobytes() == bytes(_pack_lookup_req(ids))
+
+
+def test_parity_fuzz_apply_req_iobuf():
+    sch = wire.REGISTRY["apply_req"]
+
+    def unpack(p):
+        (count,) = struct.unpack_from("<i", p, 0)
+        ids = np.frombuffer(p, np.int32, count, 4)
+        grads = np.frombuffer(p, np.float32, count * 4, 4 + 4 * count)
+        return ids, grads
+
+    failures = fuzz.parity_fuzz(
+        sch,
+        _materialized(lambda v: _pack_apply_req_iobuf(
+            np.asarray(v["ids"], np.int32),
+            np.asarray(v["grads"], np.float32))),
+        unpack, seed=12, iters=30, dim=4)
+    assert failures == [], [f.detail for f in failures]
+    ids = np.arange(9, dtype=np.int32)
+    grads = np.full((9, 4), 0.25, np.float32)
+    io = _pack_apply_req_iobuf(ids, grads)
+    with io:
+        assert io.tobytes() == bytes(_pack_apply_req(ids, grads))
+
+
+def test_parity_fuzz_stream_frame_iobuf():
+    sch = wire.REGISTRY["stream_frame"]
+    failures = fuzz.parity_fuzz(
+        sch,
+        _materialized(lambda v: _pack_stream_frame_iobuf(
+            v["seq"], v["epoch"], v["gen"], v["body"])),
+        lambda p: struct.unpack_from("<qqq", p, 0),
+        seed=13, iters=30)
+    assert failures == [], [f.detail for f in failures]
+
+
+def test_deadline_iobuf_byte_parity():
+    """The deadline schemas carry a fixed magic the schema fuzzer
+    randomizes, so parity here is direct: both header forms, as a
+    prepended block over borrowed bodies, must be byte-identical to
+    the re-copying bytearray packers."""
+    rng = np.random.default_rng(5)
+    for _ in range(20):
+        body = rng.bytes(int(rng.integers(0, 4096)))
+        us = int(rng.integers(0, 1 << 60))
+        io = _pack_deadline_iobuf(us, body)
+        with io:
+            assert io.tobytes() == bytes(_pack_deadline(us, body))
+        io = _pack_deadline_rel_iobuf(us, body)
+        with io:
+            assert io.tobytes() == bytes(_pack_deadline_rel(us, body))
+    # and block-sharing over an IOBuf body, not just bytes
+    inner = rpc.IOBuf(b"inner-body")
+    io = _pack_deadline_iobuf(123, inner)
+    with io:
+        assert io.tobytes() == bytes(_pack_deadline(123, b"inner-body"))
+    inner.close()
