@@ -199,6 +199,21 @@ def run_device_bench(deadline_s: int = 900) -> dict:
     return {"device": device, "device_sim": sim}
 
 
+def run_device_parity_bench(deadline_s: int = 300) -> dict:
+    """Device-tier parity scenario (bench_device.py --block parity
+    child): an HBM-serving replicated pair under sustained load through
+    kill-primary → failover → revival → failback, then a live 1→2
+    device split — availability over every op and the exact
+    zero-lost-acked-update ledger (also refreshes BENCH_device.json).
+    Runs against the fake PJRT plugin: the scenario proves fabric
+    control flow, not chip speed, and a wedged tunnel must not eat the
+    deadline."""
+    return _run_json_child("bench_device.py", "device_parity",
+                           deadline_s,
+                           extra_args=("--block", "parity",
+                                       "--mode", "sim"))
+
+
 def main() -> int:
     try:
         bench = ensure_built()
@@ -295,6 +310,11 @@ def main() -> int:
         # `device_sim` block (fake PJRT plugin + host CPU) otherwise.
         device_blocks = run_device_bench()
 
+        # Device-tier parity (ISSUE 20): failover/failback + live
+        # device split with the exact ledger (bench_device.py --block
+        # parity child; refreshes BENCH_device.json).
+        device_parity_block = run_device_parity_bench()
+
         # PS hot path (ISSUE 4): fan-out + read-parallel serving, measured
         # by bench_ps.py in a child (also refreshes BENCH_ps.json).
         ps_block = run_ps_bench()
@@ -350,6 +370,7 @@ def main() -> int:
             "scenarios": scenarios_block,
             "durable": durable_block,
             "zerocopy": zerocopy_block,
+            "device_parity": device_parity_block,
             **device_blocks,
         }))
         return 0

@@ -77,7 +77,12 @@ def bench_zerocopy() -> dict:
 
     def echo(method, request):
         if zc_respond[0]:
-            rsp = rpc.IOBuf()
+            # force_iobuf: this bench measures the borrow path on BOTH
+            # sides of the IOBUF_MIN_BYTES engagement floor (the 16B
+            # cell IS the below-floor cost probe) — without it the
+            # small cell would silently measure the bytes twin the
+            # production path auto-routes to.
+            rsp = rpc.IOBuf(force_iobuf=True)
             rsp.append_pinned(request)   # borrow the request bytes
             return rsp
         return request
@@ -101,7 +106,7 @@ def bench_zerocopy() -> dict:
         c0 = int(obs.counter("rpc_bytes_copied").get_value())
         t0 = time.perf_counter()
         while time.perf_counter() - t0 < CELL_S:
-            req = rpc.IOBuf()
+            req = rpc.IOBuf(force_iobuf=True)   # probe below the floor too
             req.append_pinned(payload)
             rsp = ch.call("Echo", "Echo", req)
             try:

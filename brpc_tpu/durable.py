@@ -62,10 +62,14 @@ __all__ = [
     "hydrate_destination",
 ]
 
-#: on-disk format version stamped into every snapshot and marker
-CKPT_VERSION = 1
+#: on-disk format version stamped into every snapshot and marker.
+#: v2 added the ``seeded`` flag to the snapshot header: a gen-0 base
+#: written by a chain-seeded server is now distinguishable from a
+#: fresh (never-synced) table, so first-boot backups can hydrate the
+#: delta tail instead of always falling back to a wholesale Sync.
+CKPT_VERSION = 2
 
-_SNAP_HDR = struct.calcsize("<iiqqiiqq")    # 48
+_SNAP_HDR = struct.calcsize("<iiqqiiiqq")   # 52
 _DELTA_HDR = struct.calcsize("<iqqi")       # 24
 _MARKER_LEN = struct.calcsize("<iiq")       # 16
 
@@ -75,25 +79,31 @@ _MARKER_LEN = struct.calcsize("<iiq")       # 16
 # ---------------------------------------------------------------------------
 
 def _pack_snapshot(epoch: int, gen: int, table: np.ndarray,
-                   windows: Dict[str, int]) -> bytes:
-    """Pack one base snapshot file (schema ``ckpt_snap``)."""
+                   windows: Dict[str, int],
+                   seeded: bool = False) -> bytes:
+    """Pack one base snapshot file (schema ``ckpt_snap``).
+
+    ``seeded`` records whether the writing server's table was
+    established by the replication chain (primary, or a backup that
+    received a wholesale Sync) — without it a gen-0 base is
+    indistinguishable from a fresh random-init table."""
     table = np.ascontiguousarray(table, dtype=np.float32)
     rows, dim = table.shape
     body = table.tobytes() + _pack_windows(windows)
-    return struct.pack("<iiqqiiqq", wire.CKPT_SNAP_MAGIC, CKPT_VERSION,
-                       epoch, gen, rows, dim, zlib.crc32(body),
-                       rows * dim) + body
+    return struct.pack("<iiqqiiiqq", wire.CKPT_SNAP_MAGIC, CKPT_VERSION,
+                       epoch, gen, rows, dim, 1 if seeded else 0,
+                       zlib.crc32(body), rows * dim) + body
 
 
 def _unpack_snapshot(payload):
     """Parse one base snapshot file; returns
-    ``(epoch, gen, table, windows)``.
+    ``(epoch, gen, table, windows, seeded)``.
 
     The crc covers EVERYTHING after the header (table ++ windows), so a
     bit flip anywhere in the body — or junk appended past the windows —
     rejects before any value is trusted."""
-    magic, version, epoch, gen, rows, dim, crc, count = wire.read(
-        "<iiqqiiqq", payload, 0, "ckpt_snap.hdr")
+    magic, version, epoch, gen, rows, dim, seeded, crc, count = wire.read(
+        "<iiqqiiiqq", payload, 0, "ckpt_snap.hdr")
     if magic != wire.CKPT_SNAP_MAGIC:
         raise wire.WireError("ckpt_snap: bad magic 0x%x" % (magic & 0xffffffff))
     if version != CKPT_VERSION:
@@ -112,7 +122,7 @@ def _unpack_snapshot(payload):
     table = np.frombuffer(payload, np.float32, n,
                           _SNAP_HDR).reshape(rows, dim).copy()
     windows, _ = _unpack_windows(payload, _SNAP_HDR + n * 4)
-    return epoch, gen, table, windows
+    return epoch, gen, table, windows, bool(seeded)
 
 
 def _pack_delta(gen: int, body: bytes) -> bytes:
@@ -179,6 +189,7 @@ class RestorePoint:
     table: np.ndarray
     windows: Dict[str, int]
     deltas: List[Tuple[int, bytes]] = field(default_factory=list)
+    seeded: bool = False           # base written by a chain-seeded server
 
 
 class CheckpointStore:
@@ -251,12 +262,13 @@ class CheckpointStore:
     # -- write path ---------------------------------------------------------
 
     def save_snapshot(self, epoch: int, gen: int, table: np.ndarray,
-                      windows: Dict[str, int]) -> None:
+                      windows: Dict[str, int], *,
+                      seeded: bool = False) -> None:
         """Write a new base at ``gen``, open a fresh segment for its
         tail, and retire everything older than the ``keep_bases``
         newest bases (compaction: the previous tail is now folded into
         this base)."""
-        payload = _pack_snapshot(epoch, gen, table, windows or {})
+        payload = _pack_snapshot(epoch, gen, table, windows or {}, seeded)
         with self._mu:
             compacting = self._base_gen >= 0
             self._write_atomic(
@@ -343,18 +355,18 @@ class CheckpointStore:
             return [(g, b) for g, b in self._tail if g > after_gen]
 
     def load_base(self):
-        """Newest VALID base as ``(epoch, gen, table, windows)``, or
-        None.  Lock-free: base files are immutable once renamed into
-        place, so provisioning reads race nothing."""
+        """Newest VALID base as ``(epoch, gen, table, windows,
+        seeded)``, or None.  Lock-free: base files are immutable once
+        renamed into place, so provisioning reads race nothing."""
         for g, path in self._base_paths():
             try:
                 with open(path, "rb") as f:
-                    epoch, gen, table, windows = _unpack_snapshot(f.read())
+                    parsed = _unpack_snapshot(f.read())
             except (OSError, wire.WireError):
                 continue
-            if gen != g:
+            if parsed[1] != g:
                 continue            # filename lies about the content
-            return epoch, gen, table, windows
+            return parsed
         return None
 
     def restore(self) -> Optional[RestorePoint]:
@@ -388,7 +400,7 @@ class CheckpointStore:
                 break
             if chosen is None:
                 return None
-            epoch, base_gen, table, windows = chosen
+            epoch, base_gen, table, windows, seeded = chosen
             records: List[Tuple[int, bytes]] = []
             for _, path in self._seg_paths():
                 try:
@@ -421,7 +433,7 @@ class CheckpointStore:
             obs.counter("ps_ckpt_restore_deltas").add(len(deltas))
         return RestorePoint(epoch=epoch, base_gen=base_gen,
                             gen=base_gen + len(deltas), table=table,
-                            windows=windows, deltas=deltas)
+                            windows=windows, deltas=deltas, seeded=seeded)
 
     # -- introspection ------------------------------------------------------
 
@@ -462,7 +474,7 @@ def hydrate_replica(store: CheckpointStore, addr: str, *,
     base = store.load_base()
     if base is None:
         raise ValueError("durable: no usable base snapshot to hydrate from")
-    epoch, gen, table, windows = base
+    epoch, gen, table, windows, _seeded = base
     payload = (struct.pack("<qqq", epoch, gen, table.size)
                + np.ascontiguousarray(table, np.float32).tobytes()
                + _pack_windows(windows))
@@ -489,7 +501,7 @@ def hydrate_destination(store: CheckpointStore, addr: str, scheme: int,
     base = store.load_base()
     if base is None:
         raise ValueError("durable: no usable base snapshot to hydrate from")
-    epoch, gen, table, windows = base
+    epoch, gen, table, windows, _seeded = base
     lo = row0 - src_base
     if lo < 0 or lo + rows > table.shape[0]:
         raise ValueError("durable: rows [%d, %d) outside snapshot range"

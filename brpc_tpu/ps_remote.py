@@ -219,8 +219,11 @@ _zerocopy = [True]
 #: borrow-path engagement floor: below this payload size the per-call
 #: handle lifecycle (new/pin/destroy + finalizers) costs more than the
 #: memcpys it saves (bench_zerocopy's 16-byte cell measures the
-#: crossover), so small unary legs stay on the bytes path
-_ZC_MIN_BYTES = 4096
+#: crossover), so small unary legs stay on the bytes path.  The RPC
+#: tier enforces the same floor for explicit IOBuf callers
+#: (rpc.IOBUF_MIN_BYTES routes sub-floor payloads to the bytes twin),
+#: so the two crossovers are one constant.
+_ZC_MIN_BYTES = rpc.IOBUF_MIN_BYTES
 
 
 def zerocopy_enabled() -> bool:
@@ -1123,14 +1126,16 @@ class _Replicator:
                 obs.counter("ps_replica_connect_errors").add(1)
             return False
         try:
-            _peer_epoch, peer_gen = wire.read("<qq", st.response, 0,
-                                              "ReplicaApply.rsp")
+            _peer_epoch, peer_gen, peer_seeded = wire.read(
+                "<qqq", st.response, 0, "ReplicaApply.rsp")
         except wire.WireError:
             st.close()
             return None
-        if peer_gen <= 0:
+        if peer_gen < 0 or (peer_gen == 0 and not peer_seeded):
             # A fresh backup's seed table is not provably this chain's
-            # gen-0 image — only the wholesale Sync may establish it.
+            # gen-0 image — only a wholesale Sync (or a restored
+            # seeded checkpoint base, which the setup response's
+            # seeded flag attests) may establish it.
             st.close()
             return None
         deltas = store.tail_since(peer_gen)
@@ -1413,6 +1418,15 @@ class PsShardServer:
         #: it UNDER the table write lock — log order is apply order —
         #: and replica reconnects go hydrate-first through its tail.
         self._durable = None
+        #: whether this table was established by the replication chain
+        #: (a wholesale Sync landed, a seeded checkpoint base restored,
+        #: or this node was promoted).  A PRIMARY is implicitly seeded
+        #: — its table IS the chain origin — so consumers read
+        #: ``self._seeded or self._primary_flag``.  This is what makes
+        #: a gen-0 backup hydratable: without it, gen 0 could mean
+        #: "fresh random-init table" just as well as "the chain's
+        #: exact gen-0 image" (the PR-16 first-boot residue).
+        self._seeded = False
         self._repl_mu = checked_lock("ps.repl_state")
         # Elastic-resharding state: which partition scheme this shard
         # belongs to, whether it is still IMPORTING its row range (a
@@ -1718,6 +1732,22 @@ class PsShardServer:
         self._native_lat_seen = (sum_us, count)
         self._lat.record_bulk(max(sum_us - seen_sum, 0) / dn / 1e6, dn)
 
+    def _install_full(self, gen: int) -> None:
+        """One wholesale table establishment landed — checkpoint
+        restore, replication Sync, a propagated ReplicaApply install,
+        or CompleteImport opening the import: publish it to the native
+        read path.  Called under the table WRITE lock.  The device
+        subclass hooks here to stage the fresh host image into HBM
+        when this replica is the serving primary."""
+        if self._shard is not None and not self._importing:
+            self._shard.install(self.table, gen)
+
+    def _on_promoted(self) -> None:
+        """Subclass hook: runs once per Promote, after the replicator
+        swap and before the migration re-drive / durable re-base.  The
+        device tier stages its host mirror into HBM here (backups hold
+        the cheap host mirror; HBM is paid only on promotion)."""
+
     def _replication_snapshot(self):
         """Consistent ``(epoch, gen, table bytes, applied windows)`` for
         a full-state Sync.  Epoch is read under ``_repl_mu`` (it is
@@ -1760,6 +1790,8 @@ class PsShardServer:
             with self._repl_mu:
                 if point.epoch > self._epoch:
                     self._epoch = point.epoch
+                if point.seeded:
+                    self._seeded = True
                 with self._mu.write():
                     self.table[:] = point.table
                     with self._seq_mu:
@@ -1785,15 +1817,13 @@ class PsShardServer:
                                             w, 0):
                                         self._writer_applied[w] = q
                     self._install_gen = point.gen
-                    if self._shard is not None and not self._importing:
-                        self._shard.install(self.table,
-                                            self._install_gen)
+                    self._install_full(self._install_gen)
         epoch, gen, table, windows = self._replication_snapshot()
         store.save_snapshot(
             epoch, gen,
             np.frombuffer(table, np.float32).reshape(self.rows_per,
                                                      self.dim),
-            windows)
+            windows, seeded=self._seeded or self._primary_flag)
         self._durable = store
         return point
 
@@ -1815,7 +1845,8 @@ class PsShardServer:
         once it lands."""
         with self._seq_mu:
             windows = dict(self._writer_applied)
-        dur.save_snapshot(self._epoch, gen, self.table, windows)
+        dur.save_snapshot(self._epoch, gen, self.table, windows,
+                          seeded=self._seeded or self._primary_flag)
 
     def flush_replication(self, timeout_s: float = 5.0) -> None:
         """Blocks until every backup has ACKED everything applied so far
@@ -1919,6 +1950,9 @@ class PsShardServer:
                     isinstance(t, dict)
                     and isinstance(t.get("addr"), str)
                     and int(t["base"]) >= 0 and int(t["rows"]) > 0
+                    and isinstance(t.get("replicas", []), list)
+                    and all(isinstance(a, str)
+                            for a in t.get("replicas", []))
                     for t in targets):
                 raise ValueError("bad targets")
         except (ValueError, KeyError, TypeError,
@@ -1988,11 +2022,10 @@ class PsShardServer:
                 return None
             np.subtract.at(self.table, ids, self.lr * grads)
             self._install_gen = gen
-            if self._shard is not None and not self._importing:
-                # An importing destination's backup defers its first
-                # native snapshot to CompleteImport — the native read
-                # path must never serve unmigrated rows.
-                self._shard.install(self.table, gen)
+            # An importing destination's backup defers its first
+            # native snapshot to CompleteImport — the native read
+            # path must never serve unmigrated rows.
+            self._install_full(gen)
             if windows:
                 # Inherit the primary's dedup window WITH the batch it
                 # covers: on promotion, a replayed frame at or below
@@ -2109,7 +2142,13 @@ class PsShardServer:
             self._check_repl_epoch(epoch)
             recv = _ReplicaStreamReceiver(self)
             recv.reply = accept(recv)
-            return struct.pack("<qq", self._epoch, self._install_gen)
+            # Schema replica_setup_rsp: the seeded flag is what lets a
+            # gen-0 backup that holds the chain's exact gen-0 image
+            # (Sync'd, or restored from a seeded base) hydrate the
+            # delta tail instead of forcing another wholesale Sync.
+            return struct.pack(
+                "<qqq", self._epoch, self._install_gen,
+                1 if (self._seeded or self._primary_flag) else 0)
         raise ValueError(f"unknown stream method {method}")
 
     def _apply_frame(self, payload, meta=None) -> None:
@@ -2256,6 +2295,10 @@ class PsShardServer:
                         f"{self._epoch}")
                 self._epoch = epoch
                 self._primary_flag = True
+                # The promoted table is the chain from here on — it
+                # stays provably chain-established across a later
+                # demotion too.
+                self._seeded = True
                 # Reserved-but-never-applied seqs (enqueued on a
                 # since-demoted run, failed with the demotion) must not
                 # survive into the new reign's admission window — they
@@ -2274,6 +2317,7 @@ class PsShardServer:
                 old.stop(join=False)
             if obs.enabled():
                 obs.counter("ps_replica_promotions").add(1)
+            self._on_promoted()
             if pending is not None and not self._scheme_fenced \
                     and not self._importing:
                 # Automatic re-drive: the dead primary carried an
@@ -2297,7 +2341,7 @@ class PsShardServer:
                 dur.save_snapshot(
                     e2, g2,
                     np.frombuffer(tbl, np.float32).reshape(
-                        self.rows_per, self.dim), w2)
+                        self.rows_per, self.dim), w2, seeded=True)
             return struct.pack("<qq", self._epoch, self._install_gen)
         if method == "Sync":
             epoch, gen, count = wire.read("<qqq", payload, 0, "Sync.hdr")
@@ -2325,8 +2369,11 @@ class PsShardServer:
                 with self._mu.write():
                     self.table[:] = table
                     self._install_gen = gen
-                    if self._shard is not None and not self._importing:
-                        self._shard.install(self.table, gen)
+                    # A wholesale Sync IS chain establishment: even at
+                    # gen 0 this table is now provably the chain's
+                    # image, so later hydrates may trust it.
+                    self._seeded = True
+                    self._install_full(gen)
                     # Full-state handoff: the received (table, gen,
                     # windows) triple is authoritative — local window
                     # history refers to a table this install replaces.
@@ -2580,8 +2627,8 @@ class PsShardServer:
                             f"an empty import")
                     self._importing = False
                     gen = self._install_gen
-                    if was and self._shard is not None:
-                        self._shard.install(self.table, gen)
+                    if was:
+                        self._install_full(gen)
                 rep = self._replicator
             if was and rep is not None:
                 # Open the backups too: force a fresh full-table Sync
@@ -2750,140 +2797,244 @@ class _TableGen:
         self.retired = False
 
 
-class DevicePsShardServer:
-    """Embedding shard whose table is RESIDENT IN DEVICE HBM.
+class DevicePsShardServer(PsShardServer):
+    """Embedding shard whose SERVING table is RESIDENT IN DEVICE HBM —
+    and, since ISSUE 20, a first-class citizen of the CPU tier's
+    replication / migration / rebalance machinery: it subclasses
+    :class:`PsShardServer` and reuses its wire contracts verbatim
+    (``ReplicaApply`` framing, ``Promote``/``EFENCED`` fencing, the
+    ``MigrateSync``/``MigrateApply`` handoff, the ``CheckpointStore``
+    delta tee), so ``configure_replication(quorum=)``, failover,
+    live splits and cold-restart replay all behave identically on the
+    device tier.
 
-    The CPU variant above holds its table in host numpy; this one keeps it
-    behind a native device-buffer handle (the RDMA-lkey analog,
-    cpp/device/pjrt_device.h) and serves Lookup/ApplyGrad as compiled
-    gather / scatter-sub launches (cpp/device/pjrt_executable.cc). Request
-    ids and gradients DMA host->HBM through the registered block pool;
-    looked-up rows DMA back into pooled blocks. No JAX anywhere in the
-    serving path — this is the reference's "transport swap is invisible
-    above Socket" contract with PJRT as the transport
-    (docs/en/rdma.md:34 analog).
+    The table keeps living behind a native device-buffer handle (the
+    RDMA-lkey analog, cpp/device/pjrt_device.h); Lookup/ApplyGrad are
+    compiled gather / scatter-sub launches (cpp/device/
+    pjrt_executable.cc).  Request ids and gradients DMA host->HBM
+    through the registered block pool; looked-up rows DMA back into
+    pooled blocks.  No JAX anywhere in the serving path — this is the
+    reference's "transport swap is invisible above Socket" contract
+    with PJRT as the transport (docs/en/rdma.md:34 analog).
 
-    Concurrency is a handle-GENERATION scheme, not a big lock: the update
-    is functional on-device (scatter-sub emits a fresh table buffer), so
-    ``ps.device_shard`` guards only the tiny generation map.  Lookup pins
-    the current generation, gathers/fetches OUTSIDE the lock, unpins.
-    ApplyGrad pins a snapshot, scatters outside the lock, then installs
-    the output under the lock IF its snapshot is still current — a lost
-    install race (concurrent ApplyGrad got there first) discards the
-    stale output and redoes the scatter against the new table, so no
-    update is ever lost and at least one writer makes progress per round.
-    Lookups overlap ApplyGrads and each other; no lock is ever held
-    across a blocking ``brt_device_*`` call (RACECHECK-clean by
-    construction).
+    **Two serving modes.**  A PRIMARY that is open for business serves
+    from HBM (``_dev_serving``): updates are functional on-device
+    (scatter-sub emits a fresh table buffer), so the tiny
+    ``ps.device_shard`` leaf lock guards only the pin map; Lookup pins
+    the current buffer, gathers/fetches OUTSIDE the locks, unpins.
+    Everyone else — backups, importing split destinations, demoted
+    ex-primaries — runs the inherited CPU paths against the cheap HOST
+    MIRROR (``_host_table``): ReplicaApply deltas, Sync installs,
+    MigrateSync range writes and checkpoint replay all mutate it in
+    place exactly as on the CPU tier.  Mode flips happen under the
+    table write lock: promotion (and CompleteImport on a primary)
+    stages the mirror into HBM (``_on_promoted`` /
+    ``_install_full``); demotion and fence adoption DMA the live
+    table down into the mirror first (``_mirror_down``) so nothing
+    applied on-device is lost.
 
-    The optimistic install has a cost under write FAN-IN: k racing
-    writers scatter k candidate tables but only one installs — the rest
-    discard whole scatter outputs and redo (``ps_device_wasted_launches``
-    counts them; ~linear in writers).  ``combine=True`` routes ApplyGrad
-    through a :class:`GradCombiner` instead: racing writers coalesce and
-    the leader launches ONE scatter per drained batch (the device
-    scatter sums duplicate ids — ``unique_indices = false``), so wasted
-    launches drop to at most one per batch (only a Lookup-free
-    concurrent installer could still race, and appliers all ride the
-    combiner).  ``stream=True`` serves ``StreamApply`` into the same
-    combiner.
+    **Replication off the write path**: the serving ``_apply_batch``
+    launches the scatter outside the table lock against a pinned
+    buffer, then — under the write lock, exactly like the CPU tier —
+    installs the new handle and tees ONE ``replica_apply_body`` frame
+    (ids + grads + writer windows, NOT the table) to the replicator,
+    the checkpoint delta log and any migration shipper, so backups and
+    the durable ledger see device batches in apply order.  Snapshot
+    reads (Sync wholesale, MigrateSync range handoffs, checkpoint
+    re-bases) pin one generation under the lock and DMA it down
+    OUTSIDE the lock — no blocking ``brt_device_*`` call ever runs
+    under a checked lock (RACECHECK-clean by construction).
+
+    The optimistic install keeps its pre-parity cost model under write
+    FAN-IN: k racing writers scatter k candidate tables but only one
+    installs — the rest discard and redo (``ps_device_wasted_launches``
+    counts them).  ``combine=True`` routes ApplyGrad through the
+    inherited :class:`GradCombiner` so the leader launches ONE scatter
+    per drained batch; ``stream=True`` serves ``StreamApply`` into the
+    same combiner.
     """
 
     def __init__(self, vocab: int, dim: int, shard_index: int,
                  num_shards: int, lr: float = 0.1, seed: int = 0,
                  device_client: "rpc.DeviceClient | None" = None,
                  device_index: int = 0, combine: bool = False,
-                 stream: bool = False, limiter=None):
-        if vocab % num_shards:
-            raise ValueError("num_shards must divide vocab")
-        self.shard_index = shard_index
-        self.rows_per = vocab // num_shards
-        self.base = shard_index * self.rows_per
-        self.dim = dim
-        self.lr = lr
+                 stream: bool = False, importing: bool = False,
+                 scheme_version: int = 0, limiter=None):
         self._owns_dev = device_client is None
         self.dev = device_client or rpc.DeviceClient()
         self.device_index = device_index
-        rng = np.random.default_rng(seed + shard_index)
-        table = (rng.standard_normal((self.rows_per, dim)) * 0.02
-                 ).astype(np.float32)
-        # The table lives on-device from here on; the handle is the table,
-        # versioned by generation (see class docstring).
-        self._gen = 0
-        self._tables = {0: _TableGen(self.dev.stage(table, device_index))}
-        # Resident lr scalar: scatter_sub's 4th operand (stays in HBM).
-        self.lr_h = self.dev.stage(np.array(lr, np.float32), device_index)
+        # Device state must exist before the base constructor runs: it
+        # assigns ``self.table`` (routed through the property setter
+        # into the host mirror) and starts the server — early requests
+        # simply serve from the mirror until the stage-up below.
+        self._dev_mu = checked_lock("ps.device_shard")
+        self._dev_serving = False
+        self._dev_cur: Optional[int] = None
+        self._dev_seq = 0
+        self._tables: Dict[int, _TableGen] = {}
+        self._host_table: Optional[np.ndarray] = None
+        self._rebase_pending = False
         self._gather = {}   # bucket size -> compiled gather executable
-        self._scatter = {}  # bucket size -> compiled scatter-sub executable
-        # Guards ONLY the generation map (_gen/_tables pins) — never held
-        # across a device call, so handlers on fiber workers overlap.
-        self._mu = checked_lock("ps.device_shard")
-        # Guards the executable caches; held across the (cold, per-bucket)
-        # compile but never across execute/fetch.
+        self._scatter = {}  # bucket size -> compiled scatter-sub exe
+        # Guards the executable caches; held across the (cold,
+        # per-bucket) compile but never across execute/fetch.
         self._exe_mu = checked_lock("ps.device_shard.exe")
-        self.combine = bool(combine)
-        self.stream = bool(stream)
-        # Per-writer monotonic seq window (same idempotent replay
-        # contract as the CPU shard — push_gradients always frames now).
-        self._seq_mu = checked_lock("ps.writer_seq")
-        self._writer_seqs: Dict[str, int] = {}
-        self._combiner: Optional[GradCombiner] = (
-            GradCombiner(self._apply_batch, dim)
-            if (self.combine or self.stream) else None)
-        self.server = rpc.Server()
-        # Same overload-control surface as the CPU shard: a spec string
-        # gates the data-plane methods (device launches are the scarce
-        # resource here), a ready ServerLimiter passes through.
-        self.limiter: Optional[ServerLimiter] = None
-        if limiter is not None:
-            self.limiter = ServerLimiter(
-                limiter, methods=PsShardServer.LIMITED_METHODS,
-                counter_prefix="ps") if isinstance(limiter, str) \
-                else limiter
-            self.server.set_concurrency_limiter(self.limiter)
-        if self.stream:
-            self.server.add_stream_handler("Ps", self._handle_stream)
-        else:
-            self.server.add_service("Ps", self._handle)
-        self.server.add_status_service()
-        self.port = self.server.start("127.0.0.1:0")
+        self.lr_h = 0
+        super().__init__(vocab, dim, shard_index, num_shards, lr=lr,
+                         seed=seed, lock_mode="rw", native_read=False,
+                         combine=combine, stream=stream,
+                         importing=importing,
+                         scheme_version=scheme_version,
+                         limiter=limiter)
+        # Resident lr scalar: scatter_sub's 4th operand (stays in HBM).
+        self.lr_h = self.dev.stage(np.array(lr, np.float32),
+                                   device_index)
+        if not self._importing:
+            # Open for business from HBM immediately (a server starts
+            # in the legacy single-owner primary mode); an importing
+            # split destination stays on the host mirror until
+            # CompleteImport opens it.
+            with self._mu.write():
+                self._stage_up_locked()
 
-    @property
-    def address(self) -> str:
-        return f"127.0.0.1:{self.port}"
+    # -- pin map / serving-mode machinery ---------------------------------
 
     def _pin_current(self):
-        """Pin the live table generation: returns ``(gen, handle)`` with
-        the handle guaranteed alive until the matching :meth:`_unpin`."""
-        with self._mu:
-            gen = self._gen
-            entry = self._tables[gen]
+        """Pin the live device table: ``(key, handle)`` with the handle
+        guaranteed alive until the matching :meth:`_unpin`, or None
+        when the shard is not serving from HBM.  Pin under the table
+        read (or write) lock whenever the pinned buffer must
+        correspond to ``_install_gen`` — installs hold the write lock,
+        so the pair is consistent there."""
+        with self._dev_mu:
+            key = self._dev_cur
+            if key is None:
+                return None
+            entry = self._tables[key]
             entry.pins += 1
-            return gen, entry.handle
+            return key, entry.handle
 
-    def _unpin(self, gen: int) -> None:
+    def _unpin(self, key: int) -> None:
         release = 0
-        with self._mu:
-            entry = self._tables[gen]
+        with self._dev_mu:
+            entry = self._tables[key]
             entry.pins -= 1
             if entry.retired and entry.pins == 0:
-                del self._tables[gen]
+                del self._tables[key]
                 release = entry.handle
         if release:
             self.dev.release(release)
 
+    def _stage_up_locked(self) -> None:
+        """Stage the host mirror into HBM and serve from it.  Caller
+        holds the table WRITE lock.  Already serving: the fresh host
+        image replaces the resident table (a wholesale install landed
+        while staged, e.g. a re-issued checkpoint restore)."""
+        handle = self.dev.stage(self._host_table, self.device_index)
+        if self._dev_serving:
+            self._swap_dev_locked(handle)
+            return
+        with self._dev_mu:
+            self._dev_seq += 1
+            self._dev_cur = self._dev_seq
+            self._tables[self._dev_cur] = _TableGen(handle)
+        self._dev_serving = True
+
+    def _swap_dev_locked(self, handle: int) -> None:
+        """Install a fresh table buffer as the current generation.
+        Caller holds the table WRITE lock; the retiring buffer is
+        released once its last pin drops."""
+        release = 0
+        with self._dev_mu:
+            old = self._tables[self._dev_cur]
+            old.retired = True
+            if old.pins == 0:
+                del self._tables[self._dev_cur]
+                release = old.handle
+            self._dev_seq += 1
+            self._dev_cur = self._dev_seq
+            self._tables[self._dev_cur] = _TableGen(handle)
+        if release:
+            self.dev.release(release)
+
+    def _retire_dev_locked(self) -> None:
+        """Retire every device generation (mirror-down / close).
+        Caller holds the table write lock; pinned entries release when
+        their last pin drops."""
+        release = []
+        with self._dev_mu:
+            self._dev_cur = None
+            for k in list(self._tables):
+                entry = self._tables[k]
+                entry.retired = True
+                if entry.pins == 0:
+                    del self._tables[k]
+                    release.append(entry.handle)
+        for h in release:
+            self.dev.release(h)
+
+    def _mirror_down(self) -> None:
+        """Leave HBM-serving mode: DMA the live table into the host
+        mirror and retire every device generation, so the inherited
+        CPU paths (Sync installs, ReplicaApply deltas, checkpoint
+        replay) mutate a live array again.  The fetch runs OUTSIDE the
+        lock against a pinned buffer; an install racing the fetch
+        restarts it — the loop terminates because callers mirror down
+        exactly when writes are stopping (demotion, fence adoption, a
+        checkpoint attach serializing with appliers)."""
+        while True:
+            with self._mu.write():
+                if not self._dev_serving:
+                    return
+                pinned = self._pin_current()
+            key, table_h = pinned
+            raw = None
+            try:
+                raw = self.dev.fetch(table_h)
+            finally:
+                if raw is None:
+                    self._unpin(key)
+            with self._mu.write():
+                if not self._dev_serving:
+                    self._unpin(key)
+                    return
+                with self._dev_mu:
+                    moved = self._dev_cur != key
+                if moved:
+                    self._unpin(key)
+                    continue
+                self._host_table[:] = np.frombuffer(
+                    raw, np.float32).reshape(self.rows_per, self.dim)
+                self._dev_serving = False
+                self._retire_dev_locked()
+            self._unpin(key)
+            if obs.enabled():
+                obs.counter("ps_device_mirror_downs").add(1)
+            return
+
     @property
     def table(self) -> np.ndarray:
-        """Host snapshot (DMAs the resident table down; test/debug use).
-        The pin keeps the snapshot generation alive across the DMA — a
-        concurrent ApplyGrad swap retires it, never frees it mid-fetch."""
-        gen, table_h = self._pin_current()
+        """Host view of the table.  In host-mirror mode (backup /
+        importing / demoted) this IS the live mutable array — the base
+        class applies into it in place under the write lock.  In
+        HBM-serving mode it is a pinned DMA snapshot COPY (test/debug
+        use; never called on a locked path while serving)."""
+        if not self._dev_serving:
+            return self._host_table
+        pinned = self._pin_current()
+        if pinned is None:
+            return self._host_table
+        key, table_h = pinned
         try:
             raw = self.dev.fetch(table_h)
         finally:
-            self._unpin(gen)
+            self._unpin(key)
         return np.frombuffer(raw, np.float32).reshape(self.rows_per,
                                                       self.dim).copy()
+
+    @table.setter
+    def table(self, value: np.ndarray) -> None:
+        self._host_table = value
 
     def _gather_exe(self, k: int):
         with self._exe_mu:
@@ -2910,120 +3061,313 @@ class DevicePsShardServer:
         (padding: extra ids hit row 0 with zero gradients — a no-op)."""
         return 1 << max(0, count - 1).bit_length()
 
-    def _handle(self, method: str, payload: bytes) -> bytes:
-        try:
-            # Same admission order as the CPU shard: expired work sheds
-            # before any parse or device launch.
-            payload, deadline_us = _admit_deadline(method, payload)
-            if not obs.enabled():
-                return self._serve(method, payload, deadline_us)
-            t0 = time.monotonic_ns()
-            rsp = self._serve(method, payload, deadline_us)
-        except wire.WireError:
-            _reject_frame(method)
-            raise
-        _record_ps_server(self.shard_index, method,
-                          PsShardServer._payload_keys(method, payload),
-                          len(payload), len(rsp), t0)
-        return rsp
+    # -- replication / migration / durability parity ----------------------
 
-    def _handle_stream(self, method: str, payload: bytes, accept) -> bytes:
-        if method == "StreamApply":
-            writer = payload.decode(errors="replace") if payload else ""
-            recv = _ApplyStreamReceiver(self, writer)
-            recv.reply = accept(recv)
-            if writer:
-                with self._seq_mu:
-                    last = self._writer_seqs.get(writer, 0)
-                return struct.pack("<q", last)
-            return b""
-        return self._handle(method, payload)
+    def _install_full(self, gen: int) -> None:
+        """A wholesale host-image install landed (under the write
+        lock).  On the device tier 'publish' means stage the fresh
+        host mirror into HBM — but only for a PRIMARY that is open for
+        business; backups and importing split destinations keep the
+        cheap host mirror (promotion / CompleteImport stages later)."""
+        super()._install_full(gen)
+        if self._primary_flag and not self._importing:
+            self._stage_up_locked()
 
-    def _serve_apply_id(self, payload, deadline_us: int = 0) -> bytes:
-        """Idempotent unary write for the device shard: same
-        per-(writer, shard) admission window as the CPU server (the
-        device tier has no migration inheritance, so guards check the
-        admission window)."""
-        writer, seq, guards, body = _unpack_apply_id(payload)
-        ids, grads = _unpack_apply(body, self.base, self.rows_per,
-                                   self.dim)
-        apply = True
-        if guards:
+    def _on_promoted(self) -> None:
+        """Promotion point: the backup's host mirror (hydrated by the
+        ReplicaApply stream) becomes the serving table — stage it into
+        HBM before the promote response releases clients to retry."""
+        staged = False
+        with self._mu.write():
+            if not self._dev_serving and not self._importing:
+                self._stage_up_locked()
+                staged = True
+        if staged and obs.enabled():
+            obs.counter("ps_device_promote_stages").add(1)
+
+    def configure_replication(self, replica_set: ReplicaSet,
+                              replica_index: int, *,
+                              timeout_ms: Optional[int] = None,
+                              ack_timeout_s: Optional[float] = None,
+                              quorum: "int | str | None" = "auto"
+                              ) -> None:
+        super().configure_replication(replica_set, replica_index,
+                                      timeout_ms=timeout_ms,
+                                      ack_timeout_s=ack_timeout_s,
+                                      quorum=quorum)
+        if not self._primary_flag:
+            # Demoted to backup: fold the live HBM table into the host
+            # mirror so the inherited Sync/ReplicaApply paths mutate a
+            # live array.
+            self._mirror_down()
+
+    def _check_repl_epoch(self, epoch: int) -> None:
+        super()._check_repl_epoch(epoch)
+        if not self._primary_flag:
+            # Adopted a newer epoch (self-demotion): same fold as an
+            # explicit demotion.  Runs lock-free, exactly like the
+            # base's demote.stop() at this point.
+            self._mirror_down()
+
+    def _demote_on_fence(self) -> None:
+        super()._demote_on_fence()
+        if not self._primary_flag:
+            self._mirror_down()
+
+    def attach_checkpoint(self, store, *, recover: bool = True):
+        """Attach the checkpoint store, device edition: restore/replay
+        mutate the host image in place, so leave HBM-serving mode for
+        the duration (the mirror-down folds the live table into the
+        host mirror first — nothing applied before the attach is
+        lost).  The restore's install hook re-stages a primary; a
+        shard with nothing to recover re-stages here."""
+        self._mirror_down()
+        point = super().attach_checkpoint(store, recover=recover)
+        with self._repl_mu:
+            with self._mu.write():
+                if (not self._dev_serving and not self._importing
+                        and self._primary_flag):
+                    self._stage_up_locked()
+        return point
+
+    def _tee_delta(self, dur, gen: int, body: bytes) -> None:
+        if not self._dev_serving:
+            return super()._tee_delta(dur, gen, body)
+        if (not dur.append_delta(gen, body, epoch=self._epoch)
+                or dur.should_compact()):
+            # The base helper folds the table into a fresh base HERE,
+            # under the write lock — but this table is in HBM and the
+            # DMA must not run under a checked lock.  Defer: the
+            # applier re-bases outside the lock before acking.
+            self._rebase_pending = True
+
+    def _maybe_device_rebase(self) -> None:
+        """Perform a deferred checkpoint re-base (set by the serving
+        tee): capture (epoch, gen, windows) + a pin under the write
+        lock, DMA the table down outside it, write the base.
+        Concurrent appliers may interleave re-bases out of order; the
+        store converges — restore picks the NEWEST valid base and the
+        chain check skips deltas already folded in — and every acked
+        batch runs this before its ack, so the durable image always
+        covers the acked generation."""
+        dur = self._durable
+        if dur is None or not self._rebase_pending:
+            return
+        with self._mu.write():
+            if not self._rebase_pending:
+                return
+            self._rebase_pending = False
+            if not self._dev_serving:
+                self._snapshot_to(dur, self._install_gen)
+                return
+            epoch = self._epoch
+            gen = self._install_gen
             with self._seq_mu:
-                covered = any(self._writer_seqs.get(k, 0) >= q
-                              for k, q in guards)
-            if covered:
-                apply = False
-                if obs.enabled():
-                    obs.counter("ps_scheme_guard_drops").add(1)
-        if apply and not self._reserve_seq(writer, seq):
-            apply = False
-            if obs.enabled():
-                obs.counter("ps_unary_dedup_drops").add(1)
-        if apply and ids.size:
-            if self.combine:
-                self._combiner.add(ids, grads,
-                                   deadline_us=deadline_us)
-            else:
-                self._apply_batch(ids, grads)
-        return struct.pack("<q", 0)
+                windows = dict(self._writer_applied)
+            key, table_h = self._pin_current()
+        try:
+            raw = self.dev.fetch(table_h)
+        finally:
+            self._unpin(key)
+        dur.save_snapshot(
+            epoch, gen,
+            np.frombuffer(raw, np.float32).reshape(self.rows_per,
+                                                   self.dim),
+            windows, seeded=self._seeded or self._primary_flag)
 
-    def _reserve_seq(self, writer: str, seq: int) -> bool:
-        """Per-(writer, seq) admission — see PsShardServer._reserve_seq."""
-        with self._seq_mu:
-            if seq <= self._writer_seqs.get(writer, 0):
-                return False
-            self._writer_seqs[writer] = seq
-            return True
+    def _replication_snapshot(self):
+        """Device-aware Sync snapshot: (epoch, gen, table bytes,
+        windows), consistent because installs hold the table write
+        lock.  In HBM-serving mode the generation is pinned under the
+        locks and FETCHED OUTSIDE them (a blocking DMA under a checked
+        lock is a RACECHECK violation) — safe because a pinned
+        buffer is immutable (updates are functional) and the pin keeps
+        it alive across the fetch."""
+        with self._repl_mu:
+            epoch = self._epoch
+            with self._mu.read():
+                with self._seq_mu:
+                    windows = dict(self._writer_applied)
+                gen = self._install_gen
+                if not self._dev_serving:
+                    return (epoch, gen, self._host_table.tobytes(),
+                            windows)
+                key, table_h = self._pin_current()
+        try:
+            raw = self.dev.fetch(table_h)
+        finally:
+            self._unpin(key)
+        return (epoch, gen, bytes(raw), windows)
 
-    def flush_replication(self, timeout_s: float = 5.0) -> None:
-        """Device shards are not replicated (yet); the shared stream
-        receiver's close barrier calls this unconditionally."""
+    def _migration_snapshot(self, row0: int, count: int):
+        """Generation-pinned MigrateSync source read: pin one table
+        generation under the read lock, DMA it down outside the lock,
+        slice the requested range host-side.  Fetching the WHOLE table
+        per range sync is an honest cost (no range-gather launch yet —
+        see ROADMAP residue); correctness matches the CPU tier: the
+        (gen, rows, windows) triple is consistent because installs
+        hold the write lock."""
+        lo = row0 - self.base
+        if lo < 0 or row0 + count > self.base + self.rows_per:
+            raise ValueError(
+                f"migration range [{row0}, {row0 + count}) outside "
+                f"shard [{self.base}, {self.base + self.rows_per})")
+        with self._mu.read():
+            with self._seq_mu:
+                windows = dict(self._writer_applied)
+            gen = self._install_gen
+            if not self._dev_serving:
+                return (gen,
+                        self._host_table[lo:lo + count].tobytes(),
+                        windows)
+            key, table_h = self._pin_current()
+        try:
+            raw = self.dev.fetch(table_h)
+        finally:
+            self._unpin(key)
+        rows = np.frombuffer(raw, np.float32).reshape(
+            self.rows_per, self.dim)[lo:lo + count]
+        return (gen, rows.tobytes(), windows)
 
-    def _apply_frame(self, payload, meta=None) -> None:
-        t0 = time.monotonic_ns() if obs.enabled() else 0
-        ids, grads = _unpack_apply(payload, self.base, self.rows_per,
-                                   self.dim)
-        self._combiner.add(ids, grads, wait=False)
-        if t0:
-            _record_ps_server(self.shard_index, "StreamApply",
-                              int(ids.size), len(payload), 0, t0)
+    def _apply_batch(self, ids: np.ndarray, grads: np.ndarray,
+                     metas=()) -> None:
+        """ONE combined application for a drained batch, device
+        edition: the scatter-sub launches OUTSIDE the table lock
+        against a pinned generation; the install + the replication /
+        durability / migration tee run under the write lock — so
+        backups, the delta log and migration shippers see device
+        batches in exactly apply order, framed identically to the CPU
+        tier (schema replica_apply_body).  The on-chip scatter sums
+        duplicate ids, so the concatenated batch applies exactly;
+        padding ids hit row 0 with zero grads (a no-op).
 
-    def _apply_batch(self, ids: np.ndarray, grads: np.ndarray) -> None:
-        """ONE combined scatter launch + install for a drained batch:
-        the on-chip scatter sums duplicate ids, so the concatenated
-        batch applies exactly; padding ids hit row 0 with zero grads
-        (a no-op, same trick as the unary path)."""
+        The launch races other appliers exactly like the pre-parity
+        optimistic loop: a lost install discards the candidate table
+        and redoes the scatter (``ps_device_wasted_launches``); the
+        combiner exists to keep that counter flat under fan-in.  When
+        the shard is NOT serving from HBM (backup host mirror,
+        importing destination, demoted), the inherited CPU-tier apply
+        runs unchanged against the host mirror."""
+        if not ids.size:
+            return
+        with self._repl_mu:
+            if self._replica_set is not None and not self._primary_flag:
+                raise rpc.RpcError(
+                    resilience.ENOTPRIMARY,
+                    f"shard {self.shard_index} replica "
+                    f"{self._replica_index} was demoted (epoch "
+                    f"{self._epoch}); refusing the apply")
+        if not self._dev_serving:
+            return super()._apply_batch(ids, grads, metas=metas)
+        updates: Dict[str, int] = {}
+        for m in metas:
+            if m[1] > updates.get(m[0], 0):
+                updates[m[0]] = m[1]
         bucket = self._bucket(int(ids.size))
         padded_ids = np.zeros(bucket, np.int32)
         padded_ids[:ids.size] = ids
         padded_g = np.zeros((bucket, self.dim), np.float32)
         padded_g[:ids.size] = grads
+        rep = mig = dur = None
+        gen = 0
         ids_h = self.dev.stage(padded_ids, self.device_index)
         try:
             g_h = self.dev.stage(padded_g, self.device_index)
             try:
-                self._apply_grad(bucket, ids_h, g_h)
+                while True:
+                    pinned = self._pin_current()
+                    if pinned is None:
+                        # Raced a mirror-down (demotion / checkpoint
+                        # attach): the host path owns the table now.
+                        return super()._apply_batch(ids, grads,
+                                                    metas=metas)
+                    key, table_h = pinned
+                    try:
+                        # scatter_sub scales by the resident lr scalar
+                        # on-chip: out = table - scatter(lr * grads);
+                        # functional — the output is a CANDIDATE table.
+                        outs = self._scatter_exe(bucket).execute(
+                            [table_h, ids_h, g_h, self.lr_h])
+                    finally:
+                        self._unpin(key)
+                    new_table = outs[0][0]
+                    installed = False
+                    serving = True
+                    with self._mu.write():
+                        # Same fence discipline as the CPU tier: an
+                        # apply that raced SchemeFence refuses inside
+                        # the lock and the caller re-resolves.
+                        if self._scheme_fenced:
+                            self.dev.release(new_table)
+                            raise rpc.RpcError(
+                                resilience.ESCHEMEMOVED,
+                                f"shard {self.shard_index} scheme "
+                                f"v{self.scheme_version} was fenced "
+                                f"mid-apply; refusing the write")
+                        serving = self._dev_serving
+                        if serving:
+                            with self._dev_mu:
+                                stale = self._dev_cur != key
+                            if not stale:
+                                self._install_gen += 1
+                                gen = self._install_gen
+                                self._swap_dev_locked(new_table)
+                                if updates:
+                                    with self._seq_mu:
+                                        for w, q in updates.items():
+                                            if q > self._writer_applied\
+                                                    .get(w, 0):
+                                                self._writer_applied[
+                                                    w] = q
+                                rep = self._replicator
+                                mig = self._migrator
+                                dur = self._durable
+                                if (rep is not None or mig is not None
+                                        or dur is not None):
+                                    gids = (ids + self.base).astype(
+                                        np.int32)
+                                if rep is not None or dur is not None:
+                                    body = _pack_windows(
+                                        updates) + bytes(
+                                        _pack_apply_req(gids, grads))
+                                if rep is not None:
+                                    rep.ship(gen, body)
+                                if dur is not None:
+                                    self._tee_delta(dur, gen, body)
+                                if mig is not None:
+                                    mig.ship(gen, gids, grads, updates)
+                                installed = True
+                    if installed:
+                        break
+                    self.dev.release(new_table)
+                    if not serving:
+                        return super()._apply_batch(ids, grads,
+                                                    metas=metas)
+                    # Install race lost: a concurrent applier swapped
+                    # first and our output was computed against a
+                    # stale table.  Discard and redo — the winner made
+                    # progress, so this terminates.
+                    if obs.enabled():
+                        obs.counter("ps_device_wasted_launches").add(1)
             finally:
                 self.dev.release(g_h)
         finally:
             self.dev.release(ids_h)
+        # Durability before the ack: a pending re-base (refused append
+        # or compaction threshold) folds the HBM table into a fresh
+        # base now, outside the lock, before the replication barrier
+        # releases the caller.
+        self._maybe_device_rebase()
+        if rep is not None:
+            rep.flush(gen, timeout_s=self.repl_ack_timeout_s)
 
     def _serve(self, method: str, payload: bytes,
                deadline_us: int = 0) -> bytes:
-        if method == "ApplyGradId":
-            return self._serve_apply_id(payload, deadline_us)
-        if method == "WriterSeq":
-            # the push flush barrier verifies every shard's window; the
-            # device tier's admission window is its applied proxy — the
-            # stream-close combiner flush precedes this call, so every
-            # admitted frame has been applied by then
-            writer = payload.decode(errors="replace")
-            with self._seq_mu:
-                applied = self._writer_seqs.get(writer, 0)
-            return struct.pack("<qq", applied, 0)
+        # Control plane (Sync / Promote / MigrateSync / ApplyGradId /
+        # WriterSeq / ...) is the inherited CPU machinery verbatim —
+        # it mutates the host mirror and the shared replication state.
         if method not in ("Lookup", "ApplyGrad"):
-            raise ValueError(f"unknown method {method}")
+            return super()._serve(method, payload, deadline_us)
         # Same wire guards as the CPU shard (schemas lookup_req /
         # apply_req): counts bounded by the bytes present BEFORE any
         # staging allocation or device launch.
@@ -3043,106 +3387,85 @@ class DevicePsShardServer:
                 f"ids outside shard [{self.base}, "
                 f"{self.base + self.rows_per}) for shard base {self.base}"
             )
-        if method == "ApplyGrad" and self.combine:
+        if method == "Lookup":
+            if self._importing:
+                self._check_scheme()
+            with self._seq_mu:
+                self._read_count += 1
+            pinned = None
+            with self._mu.read():
+                if self._dev_serving:
+                    pinned = self._pin_current()
+                else:
+                    gathered = self._host_table[ids]
+            if pinned is None:
+                # Host-mirror read (backup serving a failover window /
+                # importing destination): identical to the CPU tier.
+                if zerocopy_enabled() and \
+                        gathered.nbytes >= _ZC_MIN_BYTES:
+                    out = rpc.IOBuf()
+                    out.append_pinned(gathered)
+                    return out
+                return gathered.tobytes()
+            key, table_h = pinned
+            bucket = self._bucket(count)
+            padded_ids = np.zeros(bucket, np.int32)
+            padded_ids[:count] = ids
+            ids_h = self.dev.stage(padded_ids, self.device_index)
+            try:
+                outs = self._gather_exe(bucket).execute(
+                    [table_h, ids_h])
+            finally:
+                self.dev.release(ids_h)
+                self._unpin(key)
+            rows_h = outs[0][0]
+            try:
+                raw = self.dev.fetch(rows_h)
+            finally:
+                self.dev.release(rows_h)
+            if zerocopy_enabled() and \
+                    count * self.dim * 4 >= _ZC_MIN_BYTES:
+                # Borrow the fetched bytes (pinning them) instead of
+                # slicing off a truncated copy + the respond append.
+                out = rpc.IOBuf()
+                out.append_pinned(
+                    memoryview(raw)[:count * self.dim * 4])
+                return out
+            return raw[:count * self.dim * 4]
+        # ApplyGrad: writes belong to the primary of the current
+        # scheme, identical contract to the CPU tier.
+        self._check_primary()
+        self._check_scheme()
+        grads = np.frombuffer(payload, np.float32, count * self.dim,
+                              4 + 4 * count)
+        if self.combine:
             # Combined write path: no per-request staging/launch — the
             # combiner's leader stages and launches once per batch.
-            grads = np.frombuffer(payload, np.float32, count * self.dim,
-                                  4 + 4 * count).reshape(count, self.dim)
-            self._combiner.add(ids, grads, deadline_us=deadline_us)
-            return b""
-        bucket = self._bucket(count)
-        padded_ids = np.zeros(bucket, np.int32)
-        padded_ids[:count] = ids
-        ids_h = self.dev.stage(padded_ids, self.device_index)
-        try:
-            if method == "Lookup":
-                gen, table_h = self._pin_current()
-                try:
-                    outs = self._gather_exe(bucket).execute(
-                        [table_h, ids_h])
-                finally:
-                    self._unpin(gen)
-                rows_h = outs[0][0]
-                try:
-                    raw = self.dev.fetch(rows_h)
-                finally:
-                    self.dev.release(rows_h)
-                if zerocopy_enabled() and \
-                        count * self.dim * 4 >= _ZC_MIN_BYTES:
-                    # Borrow the fetched bytes (pinning them) instead of
-                    # slicing off a truncated copy + the respond append.
-                    out = rpc.IOBuf()
-                    out.append_pinned(
-                        memoryview(raw)[:count * self.dim * 4])
-                    return out
-                return raw[:count * self.dim * 4]
-            if method == "ApplyGrad":
-                grads = np.zeros((bucket, self.dim), np.float32)
-                grads[:count] = np.frombuffer(
-                    payload, np.float32, count * self.dim,
-                    4 + 4 * count).reshape(count, self.dim)
-                g_h = self.dev.stage(grads, self.device_index)
-                try:
-                    return self._apply_grad(bucket, ids_h, g_h)
-                finally:
-                    self.dev.release(g_h)
-            raise ValueError(f"unknown method {method}")
-        finally:
-            self.dev.release(ids_h)
-
-    def _apply_grad(self, bucket: int, ids_h: int, g_h: int) -> bytes:
-        while True:
-            gen, table_h = self._pin_current()
-            try:
-                # scatter_sub scales by the resident lr scalar on-chip:
-                # out = table - scatter(lr * grads); functional — the
-                # output buffer is a CANDIDATE new table.
-                outs = self._scatter_exe(bucket).execute(
-                    [table_h, ids_h, g_h, self.lr_h])
-            finally:
-                self._unpin(gen)
-            new_table = outs[0][0]
-            release_old = 0
-            with self._mu:
-                installed = self._gen == gen
-                if installed:
-                    old = self._tables[gen]
-                    old.retired = True
-                    if old.pins == 0:
-                        del self._tables[gen]
-                        release_old = old.handle
-                    self._gen = gen + 1
-                    self._tables[gen + 1] = _TableGen(new_table)
-            if installed:
-                if release_old:
-                    self.dev.release(release_old)
-                return b""
-            # Install race lost: a concurrent ApplyGrad swapped first and
-            # our output was computed against a stale table.  Discard it
-            # and redo against the new current generation — the winner
-            # already made progress, so this terminates.  Each discard is
-            # a whole wasted scatter launch; the combiner exists to make
-            # this counter stop scaling with write fan-in.
-            if obs.enabled():
-                obs.counter("ps_device_wasted_launches").add(1)
-            self.dev.release(new_table)
+            self._combiner.add(ids, grads.reshape(count, self.dim),
+                               deadline_us=deadline_us)
+        else:
+            self._apply_batch(ids, grads.reshape(count, self.dim))
+        if self._replica_set is not None:
+            with self._mu.read():
+                return struct.pack("<q", self._install_gen)
+        return b""
 
     def close(self):
-        self.server.close()
-        # Latch the combiner before device teardown (same reasoning as
-        # PsShardServer.close: late stream frames must drop, not scatter
-        # into released buffers).
-        if self._combiner is not None:
-            self._combiner.shutdown()
+        # Server + combiner + replicator/migrator latch first (the
+        # inherited close), so late frames drop instead of scattering
+        # into released buffers; device teardown after.
+        super().close()
         for exe in list(self._gather.values()) + list(
                 self._scatter.values()):
             exe.close()
-        with self._mu:
-            entries = list(self._tables.values())
-            self._tables.clear()
-        for entry in entries:
-            self.dev.release(entry.handle)
-        self.dev.release(self.lr_h)
+        self._gather = {}
+        self._scatter = {}
+        with self._mu.write():
+            self._dev_serving = False
+            self._retire_dev_locked()
+        if self.lr_h:
+            self.dev.release(self.lr_h)
+            self.lr_h = 0
         if self._owns_dev:
             self.dev.close()
 

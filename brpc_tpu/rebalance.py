@@ -219,7 +219,14 @@ class Rebalancer(threading.Thread):
     ``provisioner(version, num_shards) -> PartitionScheme`` is the only
     thing the rebalancer cannot do itself — bringing up the successor
     scheme's (importing) servers is the owner's business; the returned
-    scheme must be registered/replicated and ready to import.
+    scheme must be registered/replicated and ready to import.  The
+    contract is TIER-AGNOSTIC: a provisioner that builds
+    :class:`~brpc_tpu.ps_remote.DevicePsShardServer` rows gets live
+    DEVICE splits and failbacks for free — every action here is a wire
+    call (``ReplicaState``/``Promote``/the migration driver) that the
+    device tier answers identically, staging/folding its HBM table at
+    the promotion/demotion edges itself
+    (tests/test_ps_device.py::test_device_rebalancer_failback_restages_declared_primary).
     ``on_retired(scheme)`` fires after a retiring scheme drains so the
     owner can close its servers (the handle-release half of
     retirement).  Both callbacks run on the rebalancer thread.

@@ -82,14 +82,18 @@ class _TargetState:
     """One destination shard's handoff state (owned by its worker
     thread; queue/ack fields shared under the shipper lock)."""
 
-    __slots__ = ("addr", "base", "rows", "queue", "wake", "stream",
-                 "synced_gen", "acked_gen", "last_gen", "need_sync",
-                 "down", "refused")
+    __slots__ = ("addr", "base", "rows", "replicas", "queue", "wake",
+                 "stream", "synced_gen", "acked_gen", "last_gen",
+                 "need_sync", "down", "refused")
 
-    def __init__(self, addr: str, base: int, rows: int):
+    def __init__(self, addr: str, base: int, rows: int, replicas=()):
         self.addr = addr
         self.base = base
         self.rows = rows
+        #: the destination's full replica group (spec "replicas"): a
+        #: dead destination PRIMARY is re-resolved against it instead
+        #: of stranding the worker on the spec's fixed address
+        self.replicas = tuple(replicas)
         self.queue: collections.deque = collections.deque()
         self.wake = threading.Event()
         self.stream: "Optional[rpc.Stream]" = None
@@ -130,7 +134,9 @@ class MigrationShipper:
         self._ack_ev = threading.Event()
         self._chans: Dict[str, rpc.Channel] = {}
         self._targets = [_TargetState(t["addr"], int(t["base"]),
-                                      int(t["rows"])) for t in targets]
+                                      int(t["rows"]),
+                                      t.get("replicas") or ())
+                         for t in targets]
         self._threads: List[threading.Thread] = []
 
     def start(self) -> None:
@@ -415,6 +421,41 @@ class MigrationShipper:
             obs.counter("ps_migrate_hydrate_tail_bytes").add(tail_bytes)
         return True
 
+    def _retarget(self, t: _TargetState) -> bool:
+        """A destination PRIMARY died mid-copy and the spec's fixed
+        address strands the worker (the PR-13 residue): sweep the
+        destination's replica group for the CURRENT primary — the same
+        ``ReplicaState`` highest-claiming-epoch discipline the driver
+        uses — and re-point the worker at it.  The next connect
+        re-issues the handoff against the survivor (hydrate-first,
+        wholesale fallback: a promoted backup that never saw
+        ``MigrateApply`` answers watermark -1 and resyncs wholesale).
+        Returns True when the worker was re-pointed somewhere new."""
+        best: "Optional[tuple]" = None
+        for a in t.replicas:
+            ch = self._channel(a)
+            if ch is None:
+                return False    # shipper stopping
+            try:
+                st = json.loads(ch.call(
+                    "Ps", "ReplicaState", b"",
+                    timeout_ms=min(self.timeout_ms, 1000)))
+            except (rpc.RpcError, ValueError):
+                continue
+            if st.get("primary") and (best is None
+                                      or int(st["epoch"]) > best[0]):
+                best = (int(st["epoch"]), a)
+        if best is None or best[1] == t.addr:
+            return False
+        with self._mu:
+            t.addr = best[1]
+            t.need_sync = True
+            t.down = False
+        self._ack_ev.set()
+        if obs.enabled():
+            obs.counter("ps_migration_retargets").add(1)
+        return True
+
     def _worker(self, t: _TargetState) -> None:
         backoff = resilience.Backoff(base_ms=5.0, max_ms=200.0)
         fails = 0
@@ -440,6 +481,12 @@ class MigrationShipper:
                     if self._stop.is_set() or t.refused:
                         return
                     fails += 1
+                    # Two straight connect failures against a
+                    # replicated destination: stop hammering the dead
+                    # address and chase the promoted primary.
+                    if fails >= 2 and t.replicas and self._retarget(t):
+                        fails = 0
+                        continue
                     resilience.sleep_ms(backoff.delay_ms(min(fails, 6)))
                 continue
             if item is None:
@@ -641,8 +688,14 @@ class MigrationDriver:
             nlo, nhi = self.new.shard_bounds(d, self.vocab)
             if _overlaps(olo, ohi, nlo, nhi):
                 lo, hi = max(olo, nlo), min(ohi, nhi)
-                out.append({"addr": self._primary(self.new, d),
-                            "base": lo, "rows": hi - lo})
+                # Resolve the LIVE destination primary (the declared
+                # one may already have failed over) and ship the full
+                # replica group along so the shipper can re-resolve on
+                # its own when the destination primary dies mid-copy.
+                out.append({"addr": self._live_primary(self.new, d),
+                            "base": lo, "rows": hi - lo,
+                            "replicas": list(
+                                self.new.replica_sets[d].addresses)})
         return out
 
     # -- phases ------------------------------------------------------------

@@ -556,6 +556,16 @@ class _IobufToken:
     __slots__ = ("__weakref__",)
 
 
+#: Crossover below which the zero-copy machinery COSTS more than the
+#: copy it saves (native handle + pin-registry lifecycle vs a sub-page
+#: memcpy): requests/responses carried as an :class:`IOBuf` under this
+#: size are routed through the plain bytes twin automatically — the
+#: wire bytes are identical — unless the handle was built with
+#: ``force_iobuf=True``.  The PS tier keys its engagement floor off
+#: this same constant (ps_remote._ZC_MIN_BYTES).
+IOBUF_MIN_BYTES = 4096
+
+
 class IOBuf:
     """A native refcounted buffer chain (``brt::IOBuf``) addressed from
     Python — the zero-copy currency of the RPC tier.
@@ -577,9 +587,9 @@ class IOBuf:
     ``close()``.
     """
 
-    __slots__ = ("_lib", "_ptr", "_token", "_fin")
+    __slots__ = ("_lib", "_ptr", "_token", "_fin", "force_iobuf")
 
-    def __init__(self, data=None):
+    def __init__(self, data=None, *, force_iobuf: bool = False):
         lib = _load()
         ptr = lib.brt_iobuf_new()
         if not ptr:
@@ -589,6 +599,10 @@ class IOBuf:
         self._token = _IobufToken()
         self._fin = weakref.finalize(self._token, lib.brt_iobuf_destroy,
                                      ptr)
+        #: escape hatch for the sub-IOBUF_MIN_BYTES bytes-twin routing:
+        #: True keeps this handle on the native iobuf path end to end
+        #: no matter how small the payload is
+        self.force_iobuf = bool(force_iobuf)
         if data:
             self.append(data)
 
@@ -601,6 +615,7 @@ class IOBuf:
         io._token = _IobufToken()
         io._fin = weakref.finalize(io._token, lib.brt_iobuf_destroy,
                                    ptr)
+        io.force_iobuf = False
         return io
 
     def __len__(self) -> int:
@@ -1117,7 +1132,16 @@ class Server:
                         err_code if err else 2001)
             finally:
                 if err is None:
-                    if isinstance(out, IOBuf):
+                    if isinstance(out, IOBuf) and not out.force_iobuf \
+                            and out_len < IOBUF_MIN_BYTES:
+                        # Sub-crossover response: the bytes twin is
+                        # cheaper than the respond_iobuf handle dance
+                        # (identical wire bytes).
+                        data = out.tobytes()
+                        out.close()
+                        lib.brt_session_respond(session, data, out_len,
+                                                0, None)
+                    elif isinstance(out, IOBuf):
                         # The response SHARES the handler's blocks (no
                         # copy); the handle is not consumed — close it
                         # here, which defers actual destruction past the
@@ -1741,6 +1765,13 @@ class Channel:
             fault.client_intercept(service, method, self._addr)
         if _race.enabled():
             _race.note_blocking("brt_channel_call")
+        if isinstance(request, IOBuf) and not request.force_iobuf \
+                and len(request) < IOBUF_MIN_BYTES:
+            # Below the crossover the handle-lifecycle tax outweighs
+            # the saved copy: route through the bytes twin (identical
+            # wire bytes; the caller still closes its handle, and the
+            # response comes back as plain bytes).
+            request = request.tobytes()
         if isinstance(request, IOBuf):
             # Zero-copy currency: the request's blocks are shared into
             # the native call (no payload copy; the caller's handle keeps
@@ -1804,6 +1835,11 @@ class Channel:
         wall = time.time() if rec else 0.0
         if fault.active():
             fault.client_intercept(service, method, self._addr, timeout_ms)
+        if isinstance(request, IOBuf) and not request.force_iobuf \
+                and len(request) < IOBUF_MIN_BYTES:
+            # Same bytes-twin routing as the sync call: sub-crossover
+            # payloads skip the handle tax (join() then returns bytes).
+            request = request.tobytes()
         if isinstance(request, IOBuf):
             ptr = self._lib.brt_channel_call_start_iobuf(
                 self._ptr, service.encode(), method.encode(),

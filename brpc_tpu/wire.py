@@ -480,6 +480,7 @@ schema(
         "(two nested frames back to back; the windows parser returns "
         "its end offset)",
     pack_sites=("ps_remote.PsShardServer._apply_batch",
+                "ps_remote.DevicePsShardServer._apply_batch",
                 "reshard.MigrationShipper.ship"),
     unpack_sites=("ps_remote.PsShardServer._apply_replica_frame",
                   "ps_remote.PsShardServer._apply_migrate_frame"))
@@ -569,11 +570,23 @@ schema(
 schema(
     "epoch_gen_rsp",
     Int("epoch"), Int("gen"),
-    doc="(epoch, gen) int64 pair: Promote / ReplicaApply setup response",
-    pack_sites=("ps_remote.PsShardServer._serve_control",
-                "ps_remote.PsShardServer._serve_stream_setup"),
+    doc="(epoch, gen) int64 pair: the Promote response",
+    pack_sites=("ps_remote.PsShardServer._serve_control",),
     segments=(("ps_remote.PsShardServer._serve_control",
                ("Promote",)),),
+    response=True)
+
+schema(
+    "replica_setup_rsp",
+    Int("epoch"), Int("gen"), Int("seeded"),
+    doc="ReplicaApply stream setup response: the backup's fencing "
+        "epoch ++ installed generation ++ chain-seeded flag — seeded "
+        "distinguishes a gen-0 backup whose table WAS established by a "
+        "wholesale Sync (or a seeded checkpoint base) from a fresh "
+        "random-init table, so first-boot hydration can ship only the "
+        "delta tail",
+    pack_sites=("ps_remote.PsShardServer._serve_stream_setup",),
+    unpack_sites=("ps_remote._Replicator._try_hydrate",),
     response=True)
 
 schema(
@@ -632,14 +645,17 @@ schema(
 schema(
     "ckpt_snap",
     Int("magic", "<i"), Int("version", "<i"), Int("epoch"), Int("gen"),
-    Int("rows", "<i"), Int("dim", "<i"), Int("crc"), Int("count"),
+    Int("rows", "<i"), Int("dim", "<i"), Int("seeded", "<i"),
+    Int("crc"), Int("count"),
     Array("table", "<f4", "count"), Tail("windows", schema="windows"),
-    doc="checkpoint base snapshot file (brpc_tpu.durable): "
+    doc="checkpoint base snapshot file (brpc_tpu.durable), format v2: "
         "CKPT_SNAP_MAGIC ++ format version ++ fencing epoch ++ "
-        "generation ++ table geometry ++ crc32 of everything after the "
-        "header ++ f32 element count ++ the table image ++ writer "
-        "dedup windows — restore parses disk bytes as hostile input, "
-        "so torn/bit-flipped files must answer a clean reject",
+        "generation ++ table geometry ++ chain-seeded flag (a gen-0 "
+        "base from a Sync'd server is not a fresh random table) ++ "
+        "crc32 of everything after the header ++ f32 element count ++ "
+        "the table image ++ writer dedup windows — restore parses disk "
+        "bytes as hostile input, so torn/bit-flipped files must answer "
+        "a clean reject",
     pack_sites=("durable._pack_snapshot",),
     unpack_sites=("durable._unpack_snapshot",),
     exact_sites=("durable._pack_snapshot", "durable._unpack_snapshot"))
@@ -672,14 +688,11 @@ schema(
     "writer_seq_rsp",
     Int("applied"), Int("gen"),
     doc="WriterSeq response: applied high-water ++ covering gen",
-    pack_sites=("ps_remote.PsShardServer._serve_control",
-                "ps_remote.DevicePsShardServer._serve"),
+    pack_sites=("ps_remote.PsShardServer._serve_control",),
     unpack_sites=("ps_remote.RemoteEmbedding._transfer_pushes",
                   "ps_remote.RemoteEmbedding._confirm_push"),
     segments=(("ps_remote.PsShardServer._serve_control",
-               ("WriterSeq",)),
-              ("ps_remote.DevicePsShardServer._serve",
-               ("WriterSeq",))),
+               ("WriterSeq",)),),
     response=True)
 
 

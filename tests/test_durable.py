@@ -93,10 +93,13 @@ def _replay(point):
 def test_snapshot_roundtrip_exact():
     tbl = _table(3)
     payload = _pack_snapshot(9, 42, tbl, {"writer-a": 5, "writer-b": 11})
-    epoch, gen, out, windows = _unpack_snapshot(payload)
+    epoch, gen, out, windows, seeded = _unpack_snapshot(payload)
     assert (epoch, gen) == (9, 42)
     assert np.array_equal(out, tbl)
     assert windows == {"writer-a": 5, "writer-b": 11}
+    assert seeded is False
+    payload = _pack_snapshot(9, 0, tbl, {}, seeded=True)
+    assert _unpack_snapshot(payload)[4] is True
 
 
 def test_snapshot_rejects_truncation_everywhere():
@@ -328,7 +331,7 @@ def test_load_base_skips_corrupt_and_lying_files(tmp_path):
     lying = _pack_snapshot(7, 8, _table(1), {})
     with open(os.path.join(tmp_path, "base-%016d.snap" % 9), "wb") as f:
         f.write(lying)
-    epoch, gen, tbl, _ = CheckpointStore(str(tmp_path)).load_base()
+    epoch, gen, tbl, _, _seeded = CheckpointStore(str(tmp_path)).load_base()
     assert (epoch, gen) == (7, 0)
     assert np.array_equal(tbl, base)
 

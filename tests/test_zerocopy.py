@@ -129,7 +129,9 @@ def test_response_view_outlives_the_call():
     port = srv.start("127.0.0.1:0")
     ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000)
     try:
-        req = rpc.IOBuf(b"tiny-response")
+        # force_iobuf: sub-floor payloads normally reroute to the bytes
+        # twin — the escape hatch keeps the native path under test.
+        req = rpc.IOBuf(b"tiny-response", force_iobuf=True)
         rsp = ch.call("Echo", "Echo", req)
         req.close()
         assert isinstance(rsp, rpc.IOBuf)
@@ -188,7 +190,8 @@ def test_call_async_join_iobuf():
     port = srv.start("127.0.0.1:0")
     ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000)
     try:
-        reqs = [rpc.IOBuf(struct.pack("<q", i)) for i in range(4)]
+        reqs = [rpc.IOBuf(struct.pack("<q", i), force_iobuf=True)
+                for i in range(4)]
         pending = [ch.call_async("Echo", "Echo", r) for r in reqs]
         for i, p in enumerate(pending):
             rsp = p.join()
@@ -197,6 +200,49 @@ def test_call_async_join_iobuf():
                 assert rsp.tobytes() == struct.pack("<q", i)
         for r in reqs:
             r.close()
+    finally:
+        ch.close()
+        srv.close()
+
+
+def test_small_iobuf_routes_through_bytes_twin():
+    """PR-19 residue closed: explicit IOBuf payloads below
+    ``rpc.IOBUF_MIN_BYTES`` ride the bytes twin automatically — the
+    response is byte-identical, arrives as plain bytes, and no native
+    iobuf handle is spent on the wire leg; ``force_iobuf=True`` opts
+    back into the native path; at-floor payloads keep it."""
+    srv = rpc.Server()
+
+    def echo_io(method, request):
+        out = rpc.IOBuf()            # respond path: also auto-routed
+        out.append(request)
+        return out
+    srv.add_service("Echo", echo_io)
+    port = srv.start("127.0.0.1:0")
+    ch = rpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000)
+    try:
+        small = b"s" * (rpc.IOBUF_MIN_BYTES - 1)
+        big = b"b" * rpc.IOBUF_MIN_BYTES
+        req = rpc.IOBuf(small)
+        rsp = ch.call("Echo", "Echo", req)
+        req.close()
+        assert isinstance(rsp, bytes) and rsp == small   # byte parity
+        req = rpc.IOBuf(small)
+        rsp = ch.call_async("Echo", "Echo", req).join()
+        req.close()
+        assert isinstance(rsp, bytes) and rsp == small
+        req = rpc.IOBuf(small, force_iobuf=True)
+        rsp = ch.call("Echo", "Echo", req)
+        req.close()
+        assert isinstance(rsp, rpc.IOBuf)
+        with rsp:
+            assert rsp.tobytes() == small
+        req = rpc.IOBuf(big)         # at the floor: native path kept
+        rsp = ch.call("Echo", "Echo", req)
+        req.close()
+        assert isinstance(rsp, rpc.IOBuf)
+        with rsp:
+            assert rsp.tobytes() == big
     finally:
         ch.close()
         srv.close()
